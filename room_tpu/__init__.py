@@ -1,0 +1,24 @@
+"""room_tpu — a TPU-native autonomous agent-swarm framework.
+
+A ground-up rebuild of the capabilities of quoroom-ai/room (reference surveyed
+in SURVEY.md) with the out-of-process inference path replaced by an in-tree
+JAX/XLA/Pallas serving stack:
+
+- ``room_tpu.core``     — rooms, queen/worker agent loops, quorum governance,
+                          goals, skills, self-modification, memory.
+- ``room_tpu.db``       — SQLite persistence (WAL, FTS5 hybrid search).
+- ``room_tpu.models``   — JAX model definitions (Qwen3-MoE, Qwen2 dense,
+                          384-d MiniLM-class embedder).
+- ``room_tpu.ops``      — Pallas TPU kernels (ragged paged attention, et al.)
+                          with XLA reference fallbacks.
+- ``room_tpu.parallel`` — mesh construction, sharding rules, ring attention,
+                          collective helpers (ICI/DCN aware).
+- ``room_tpu.serving``  — continuous-batching inference engine: paged KV
+                          cache, prefill/decode scheduler, sampling, sessions.
+- ``room_tpu.providers``— model-provider registry (tpu:, echo:, openai:, ...).
+- ``room_tpu.server``   — HTTP/WS API, auth/RBAC, event bus, runtime loops.
+- ``room_tpu.mcp``      — MCP stdio tool server.
+- ``room_tpu.cli``      — command-line entry points.
+"""
+
+__version__ = "0.1.0"

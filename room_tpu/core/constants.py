@@ -1,0 +1,167 @@
+"""Domain enums, presets, and defaults.
+
+Behavioral parity with the reference's constants module (reference:
+src/shared/constants.ts:16-231): state enums, worker role presets with
+cadences, plan-aware queen cycle defaults, and the default room governance
+config. Chain/wallet constants live in ``room_tpu.core.chains``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# ---- state enums (stored as TEXT in SQLite) ----
+
+TRIGGER_TYPES = ("cron", "once", "webhook", "watch")
+TASK_STATUSES = ("active", "paused", "archived")
+RUN_STATUSES = ("running", "success", "error", "cancelled")
+ROOM_STATUSES = ("active", "paused", "archived")
+AGENT_STATES = ("idle", "running", "waiting", "rate_limited", "stopped")
+DECISION_STATUSES = ("voting", "announced", "effective", "passed", "rejected", "expired")
+DECISION_TYPES = ("low_impact", "high_impact", "critical")
+GOAL_STATUSES = ("active", "completed", "abandoned")
+ESCALATION_STATUSES = ("pending", "answered", "dismissed")
+TX_STATUSES = ("pending", "confirmed", "failed")
+MESSAGE_STATUSES = ("unread", "read", "replied")
+VISIBILITIES = ("private", "public")
+AUTONOMY_MODES = ("manual", "semi", "full")
+
+
+# ---- queen cycle cadence, plan-aware defaults ----
+# (reference: src/shared/constants.ts:161-175 — cadence scales with the
+# keeper's provider plan; the tpu: provider is in-tree so it gets the
+# fastest cadence.)
+
+QUEEN_CYCLE_GAP_MS_DEFAULT = 30 * 60 * 1000
+QUEEN_MAX_TURNS_DEFAULT = 50
+QUEEN_MAX_TURNS_FLOOR = 50
+
+PLAN_QUEEN_DEFAULTS: dict[str, int] = {
+    # plan -> queen cycle gap ms
+    "none": 10 * 60 * 1000,
+    "pro": 5 * 60 * 1000,
+    "max": 30 * 1000,
+    "api": 2 * 60 * 1000,
+    "tpu": 30 * 1000,
+}
+
+
+# ---- worker role presets ----
+# (reference: src/shared/constants.ts:183-219)
+
+@dataclass(frozen=True)
+class RolePreset:
+    role: str
+    cycle_gap_ms: int
+    max_turns: int
+    prompt_prefix: str
+
+
+WORKER_ROLE_PRESETS: dict[str, RolePreset] = {
+    "executor": RolePreset(
+        "executor", 15_000, 200,
+        "You are an executor: pick up assigned goals and drive them to "
+        "completion with tools. Prefer action over discussion.",
+    ),
+    "guardian": RolePreset(
+        "guardian", 30_000, 30,
+        "You are a guardian: review announced decisions and recent activity "
+        "for risk; object when a decision would harm the room.",
+    ),
+    "analyst": RolePreset(
+        "analyst", 60_000, 100,
+        "You are an analyst: study the room's goals, memory, and metrics; "
+        "produce concise findings that help the queen decide.",
+    ),
+    "writer": RolePreset(
+        "writer", 60_000, 100,
+        "You are a writer: turn the room's work into clear prose — reports, "
+        "summaries, documentation.",
+    ),
+    "researcher": RolePreset(
+        "researcher", 30_000, 100,
+        "You are a researcher: gather information with web tools, verify it, "
+        "and store durable findings in memory.",
+    ),
+}
+
+
+# ---- room governance config ----
+# (reference: src/shared/constants.ts:221-231, types.ts:262-272)
+
+@dataclass
+class RoomConfig:
+    vote_threshold: str = "majority"        # majority | two_thirds | unanimous
+    vote_timeout_minutes: int = 10          # announce->effective delay
+    queen_tie_breaker: bool = True
+    auto_approve: tuple[str, ...] = ("low_impact",)
+    sealed_ballot: bool = False
+    min_voter_health: float = 0.0
+
+    @classmethod
+    def from_json(cls, raw: dict | None) -> "RoomConfig":
+        cfg = cls()
+        if not raw:
+            return cfg
+        cfg.vote_threshold = raw.get("voteThreshold", cfg.vote_threshold)
+        cfg.vote_timeout_minutes = int(
+            raw.get("voteTimeoutMinutes", cfg.vote_timeout_minutes)
+        )
+        cfg.queen_tie_breaker = bool(
+            raw.get("queenTieBreaker", cfg.queen_tie_breaker)
+        )
+        aa = raw.get("autoApprove")
+        if aa is not None:
+            cfg.auto_approve = tuple(aa)
+        cfg.sealed_ballot = bool(raw.get("sealedBallot", cfg.sealed_ballot))
+        cfg.min_voter_health = float(
+            raw.get("minVoterHealth", cfg.min_voter_health)
+        )
+        return cfg
+
+    def to_json(self) -> dict:
+        return {
+            "voteThreshold": self.vote_threshold,
+            "voteTimeoutMinutes": self.vote_timeout_minutes,
+            "queenTieBreaker": self.queen_tie_breaker,
+            "autoApprove": list(self.auto_approve),
+            "sealedBallot": self.sealed_ballot,
+            "minVoterHealth": self.min_voter_health,
+        }
+
+
+# ---- context/session policy knobs ----
+# (reference: agent-loop.ts:462-532, queen-tools.ts:647, skills.ts:5-6,
+#  task-runner.ts:33)
+
+CLI_SESSION_ROTATE_CYCLES = 20
+CLI_SESSION_ROTATE_DAYS = 7
+API_HISTORY_COMPRESS_AT = 30
+API_HISTORY_TRIM_AT = 40
+TASK_SESSION_ROTATE_RUNS = 20
+WIP_MAX_CHARS = 2000
+SKILLS_CONTEXT_MAX = 8
+SKILLS_CONTEXT_MAX_CHARS = 6000
+MEMORY_RECALL_TOP_K = 5
+
+# default queen system prompt: the control-plane contract. The queen plans,
+# delegates, and governs; she does not execute work herself.
+# (reference: src/shared/room.ts:9-24)
+DEFAULT_QUEEN_PROMPT = (
+    "You are the Queen of this room: its coordinator and planner, not its "
+    "executor. Each cycle: (1) review the objective, goal tree, announced "
+    "decisions, escalations, and unread messages; (2) decompose the "
+    "objective into goals and delegate them to workers with delegate(); "
+    "(3) announce significant decisions for quorum review before acting on "
+    "them; (4) record durable facts with remember(); (5) save a WIP note "
+    "describing where to continue. Create workers when the room lacks the "
+    "needed role. Escalate to the keeper only when blocked on something "
+    "outside the room's authority."
+)
+
+MAX_CONCURRENT_TASKS_DEFAULT = 3
+MAX_CONCURRENT_TASKS_MIN = 1
+MAX_CONCURRENT_TASKS_MAX = 10
+
+SELF_MOD_MIN_INTERVAL_S = 60

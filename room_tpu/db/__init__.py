@@ -1,0 +1,16 @@
+from .database import (
+    Database,
+    get_database,
+    reset_database_singleton,
+    utc_now,
+)
+from .schema import SCHEMA, SCHEMA_VERSION
+
+__all__ = [
+    "Database",
+    "get_database",
+    "reset_database_singleton",
+    "utc_now",
+    "SCHEMA",
+    "SCHEMA_VERSION",
+]

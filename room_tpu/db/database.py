@@ -1,0 +1,179 @@
+"""Connection management and migrations.
+
+The engine keeps the reference's storage posture (reference:
+src/server/db.ts:32-55): one SQLite file in WAL mode with foreign keys on
+and a generous busy timeout, opened by each surface (server, MCP, tests).
+Unlike the reference's synchronous single-threaded Node access, the Python
+engine serves HTTP and runtime loops from multiple threads, so the
+connection is wrapped in a re-entrant lock.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from contextlib import contextmanager
+from datetime import datetime, timezone
+from typing import Any, Iterator, Optional
+
+from .schema import SCHEMA, SCHEMA_VERSION
+
+# Ordered (version, ddl) pairs applied after the base schema. Version 1 is
+# the base schema itself. Future migrations append here.
+MIGRATIONS: list[tuple[int, str]] = []
+
+
+def utc_now() -> str:
+    """UTC ISO-8601 timestamp with millisecond precision, Z-suffixed."""
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+class Database:
+    """Thread-safe wrapper around a sqlite3 connection.
+
+    All engine code takes a ``Database`` and uses :meth:`query`,
+    :meth:`query_one`, :meth:`execute`, and :meth:`transaction`. Rows come
+    back as plain dicts.
+    """
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self.path = path
+        self._lock = threading.RLock()
+        self._txn_depth = 0
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
+        self._conn.row_factory = sqlite3.Row
+        with self._lock:
+            if path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode = WAL")
+            self._conn.execute("PRAGMA foreign_keys = ON")
+            self._conn.execute("PRAGMA busy_timeout = 5000")
+            self._conn.executescript(SCHEMA)
+            self._migrate()
+
+    # -- migrations ------------------------------------------------------
+
+    def _migrate(self) -> None:
+        applied = {
+            r[0]
+            for r in self._conn.execute(
+                "SELECT version FROM schema_migrations"
+            ).fetchall()
+        }
+        fresh = not applied
+        if SCHEMA_VERSION not in applied:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO schema_migrations(version) VALUES (?)",
+                (SCHEMA_VERSION,),
+            )
+        for version, ddl in MIGRATIONS:
+            if version in applied:
+                continue
+            # A fresh database already has the latest shape from SCHEMA, so
+            # migrations are stamped as applied without being executed.
+            if not fresh:
+                self._conn.executescript(ddl)
+            self._conn.execute(
+                "INSERT INTO schema_migrations(version) VALUES (?)",
+                (version,),
+            )
+
+    @property
+    def schema_version(self) -> int:
+        row = self._conn.execute(
+            "SELECT MAX(version) FROM schema_migrations"
+        ).fetchone()
+        return int(row[0] or 0)
+
+    # -- statement helpers ----------------------------------------------
+
+    def execute(self, sql: str, params: tuple | dict = ()) -> sqlite3.Cursor:
+        with self._lock:
+            return self._conn.execute(sql, params)
+
+    def insert(self, sql: str, params: tuple | dict = ()) -> int:
+        """Execute an INSERT and return the new rowid."""
+        with self._lock:
+            return int(self._conn.execute(sql, params).lastrowid or 0)
+
+    def query(self, sql: str, params: tuple | dict = ()) -> list[dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._conn.execute(sql, params).fetchall()]
+
+    def query_one(
+        self, sql: str, params: tuple | dict = ()
+    ) -> Optional[dict[str, Any]]:
+        with self._lock:
+            row = self._conn.execute(sql, params).fetchone()
+            return dict(row) if row is not None else None
+
+    @contextmanager
+    def transaction(self) -> Iterator["Database"]:
+        """Group statements atomically; rolls back on exception.
+
+        Re-entrant: nested calls become savepoints, so an inner rollback
+        only unwinds the inner scope.
+        """
+        with self._lock:
+            if self._txn_depth == 0:
+                begin, commit, rollback = (
+                    "BEGIN IMMEDIATE", "COMMIT", "ROLLBACK"
+                )
+            else:
+                sp = f"sp_{self._txn_depth}"
+                begin = f"SAVEPOINT {sp}"
+                commit = f"RELEASE {sp}"
+                rollback = f"ROLLBACK TO {sp}; RELEASE {sp}"
+            self._conn.execute(begin)
+            self._txn_depth += 1
+            try:
+                yield self
+            except BaseException:
+                for stmt in rollback.split(";"):
+                    self._conn.execute(stmt)
+                raise
+            else:
+                self._conn.execute(commit)
+            finally:
+                self._txn_depth -= 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+_default_db: Optional[Database] = None
+_default_lock = threading.Lock()
+
+
+def default_db_path() -> str:
+    """Resolve the on-disk database path (env-overridable like the
+    reference's QUOROOM_DB_PATH / QUOROOM_DATA_DIR, src/server/db.ts:28-39)."""
+    explicit = os.environ.get("ROOM_TPU_DB_PATH")
+    if explicit:
+        return explicit
+    data_dir = os.environ.get(
+        "ROOM_TPU_DATA_DIR", os.path.join(os.path.expanduser("~"), ".room_tpu")
+    )
+    os.makedirs(data_dir, exist_ok=True)
+    return os.path.join(data_dir, "data.db")
+
+
+def get_database() -> Database:
+    """Process-wide singleton opened on first use."""
+    global _default_db
+    with _default_lock:
+        if _default_db is None:
+            _default_db = Database(default_db_path())
+        return _default_db
+
+
+def reset_database_singleton() -> None:
+    """Testing hook: drop the singleton so the next get_database() reopens."""
+    global _default_db
+    with _default_lock:
+        if _default_db is not None:
+            _default_db.close()
+        _default_db = None

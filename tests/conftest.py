@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh *before* any jax import so
+multi-chip sharding logic is exercised hermetically (the real-TPU path is
+covered by bench.py and __graft_entry__.py on hardware).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from room_tpu.db import Database  # noqa: E402
+
+
+@pytest.fixture()
+def db():
+    """Fresh in-memory database with the production schema (the reference
+    tests never mock the data layer; neither do we)."""
+    d = Database(":memory:")
+    yield d
+    d.close()

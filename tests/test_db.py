@@ -1,0 +1,154 @@
+"""Persistence-layer tests: schema integrity, FTS sync triggers, WAL-style
+concurrency basics, migration ledger."""
+
+import threading
+
+from room_tpu.db import Database, SCHEMA_VERSION, utc_now
+
+
+def test_schema_creates_all_tables(db):
+    tables = {
+        r["name"]
+        for r in db.query(
+            "SELECT name FROM sqlite_master WHERE type='table'"
+        )
+    }
+    expected = {
+        "settings", "workers", "rooms", "entities", "observations",
+        "relations", "embeddings", "tasks", "task_runs", "console_logs",
+        "watches", "chat_messages", "room_activity", "quorum_decisions",
+        "quorum_votes", "goals", "goal_updates", "skills", "self_mod_audit",
+        "self_mod_snapshots", "escalations", "credentials", "wallets",
+        "wallet_transactions", "room_messages", "worker_cycles",
+        "cycle_logs", "agent_sessions", "clerk_messages", "clerk_usage",
+        "schema_migrations",
+    }
+    missing = expected - tables
+    assert not missing, f"missing tables: {missing}"
+
+
+def test_schema_is_idempotent(db):
+    from room_tpu.db import SCHEMA
+    db._conn.executescript(SCHEMA)  # second run must not raise
+
+
+def test_migration_ledger(db):
+    assert db.schema_version == SCHEMA_VERSION
+
+
+def test_fts_triggers_track_entities(db):
+    eid = db.insert(
+        "INSERT INTO entities(name, type, category) VALUES (?,?,?)",
+        ("deploy pipeline", "fact", "ops"),
+    )
+    hits = db.query(
+        "SELECT entity_id FROM memory_fts WHERE memory_fts MATCH ?", ("deploy",)
+    )
+    assert [h["entity_id"] for h in hits] == [eid]
+
+    db.execute("UPDATE entities SET name='release train' WHERE id=?", (eid,))
+    assert db.query(
+        "SELECT entity_id FROM memory_fts WHERE memory_fts MATCH ?", ("deploy",)
+    ) == []
+    assert [
+        h["entity_id"]
+        for h in db.query(
+            "SELECT entity_id FROM memory_fts WHERE memory_fts MATCH ?",
+            ("release",),
+        )
+    ] == [eid]
+
+    db.execute("DELETE FROM entities WHERE id=?", (eid,))
+    assert db.query(
+        "SELECT entity_id FROM memory_fts WHERE memory_fts MATCH ?", ("release",)
+    ) == []
+
+
+def test_foreign_keys_cascade(db):
+    rid = db.insert("INSERT INTO rooms(name) VALUES (?)", ("r",))
+    gid = db.insert(
+        "INSERT INTO goals(room_id, description) VALUES (?,?)", (rid, "g")
+    )
+    db.insert(
+        "INSERT INTO goal_updates(goal_id, observation) VALUES (?,?)",
+        (gid, "obs"),
+    )
+    db.execute("DELETE FROM rooms WHERE id=?", (rid,))
+    assert db.query("SELECT * FROM goals") == []
+    assert db.query("SELECT * FROM goal_updates") == []
+
+
+def test_transaction_rollback(db):
+    try:
+        with db.transaction():
+            db.insert("INSERT INTO rooms(name) VALUES (?)", ("a",))
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert db.query("SELECT * FROM rooms") == []
+
+
+def test_threaded_access(db):
+    errors = []
+
+    def worker(n):
+        try:
+            for i in range(25):
+                db.insert(
+                    "INSERT INTO settings(key, value) VALUES (?,?) "
+                    "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                    (f"k{n}-{i}", str(i)),
+                )
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(n,)) for n in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(db.query("SELECT * FROM settings")) == 100
+
+
+def test_utc_now_format():
+    ts = utc_now()
+    assert ts.endswith("Z") and "T" in ts and len(ts) == 24
+
+
+def test_nested_transaction_savepoints(db):
+    with db.transaction():
+        db.insert("INSERT INTO rooms(name) VALUES ('outer')")
+        try:
+            with db.transaction():
+                db.insert("INSERT INTO rooms(name) VALUES ('inner')")
+                raise RuntimeError("inner fails")
+        except RuntimeError:
+            pass
+    names = [r["name"] for r in db.query("SELECT name FROM rooms")]
+    assert names == ["outer"]
+
+
+def test_room_delete_cascades_worker_cycles(db):
+    rid = db.insert("INSERT INTO rooms(name) VALUES ('r')")
+    wid = db.insert(
+        "INSERT INTO workers(name, system_prompt, room_id) VALUES ('w','p',?)",
+        (rid,),
+    )
+    db.insert(
+        "INSERT INTO worker_cycles(worker_id, room_id) VALUES (?,?)",
+        (wid, rid),
+    )
+    db.execute("DELETE FROM rooms WHERE id=?", (rid,))
+    assert db.query("SELECT * FROM worker_cycles") == []
+
+
+def test_fresh_db_stamps_future_migrations(tmp_path):
+    from room_tpu.db import database as dbmod
+    dbmod.MIGRATIONS.append((999, "THIS WOULD FAIL IF EXECUTED;"))
+    try:
+        d = Database(str(tmp_path / "fresh.db"))
+        assert d.schema_version == 999  # stamped, never executed
+        d.close()
+    finally:
+        dbmod.MIGRATIONS.pop()
