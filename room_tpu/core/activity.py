@@ -1,0 +1,53 @@
+"""Room activity audit log (reference: room_activity writes scattered
+through src/shared; public/private flag feeds the public feed)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ..db import Database
+
+
+def log_room_activity(
+    db: Database,
+    room_id: int,
+    event_type: str,
+    summary: str,
+    details: Optional[Any] = None,
+    actor_id: Optional[int] = None,
+    is_public: bool = True,
+) -> int:
+    return db.insert(
+        "INSERT INTO room_activity(room_id, event_type, actor_id, summary, "
+        "details, is_public) VALUES (?,?,?,?,?,?)",
+        (
+            room_id,
+            event_type,
+            actor_id,
+            summary,
+            json.dumps(details) if details is not None else None,
+            int(is_public),
+        ),
+    )
+
+
+def recent_activity(
+    db: Database, room_id: int, limit: int = 50, public_only: bool = False
+) -> list[dict]:
+    sql = "SELECT * FROM room_activity WHERE room_id=?"
+    if public_only:
+        sql += " AND is_public=1"
+    sql += " ORDER BY id DESC LIMIT ?"
+    return db.query(sql, (room_id, limit))
+
+
+def get_public_feed(db: Database, limit: int = 100) -> list[dict]:
+    """Cross-room public feed (reference: src/shared/public-feed.ts)."""
+    return db.query(
+        "SELECT a.*, r.name AS room_name FROM room_activity a "
+        "JOIN rooms r ON r.id = a.room_id "
+        "WHERE a.is_public=1 AND r.visibility='public' "
+        "ORDER BY a.id DESC LIMIT ?",
+        (limit,),
+    )

@@ -1,0 +1,59 @@
+"""EVM chain + token constants (reference: src/shared/constants.ts:72-159).
+
+Multi-chain USDC/USDT addresses and the ERC-8004 identity-registry
+addresses used for on-chain room identity."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ChainConfig:
+    key: str
+    chain_id: int
+    name: str
+    rpc_url: str
+    explorer: str
+    usdc: str
+    usdt: str | None = None
+
+
+CHAINS: dict[str, ChainConfig] = {
+    "base": ChainConfig(
+        "base", 8453, "Base", "https://mainnet.base.org",
+        "https://basescan.org",
+        usdc="0x833589fCD6eDb6E08f4c7C32D4f71b54bdA02913",
+    ),
+    "ethereum": ChainConfig(
+        "ethereum", 1, "Ethereum", "https://eth.llamarpc.com",
+        "https://etherscan.io",
+        usdc="0xA0b86991c6218b36c1d19D4a2e9Eb0cE3606eB48",
+        usdt="0xdAC17F958D2ee523a2206206994597C13D831ec7",
+    ),
+    "arbitrum": ChainConfig(
+        "arbitrum", 42161, "Arbitrum One", "https://arb1.arbitrum.io/rpc",
+        "https://arbiscan.io",
+        usdc="0xaf88d065e77c8cC2239327C5EDb3A432268e5831",
+        usdt="0xFd086bC7CD5C481DCC9C85ebE478A1C0b69FCbb9",
+    ),
+    "optimism": ChainConfig(
+        "optimism", 10, "OP Mainnet", "https://mainnet.optimism.io",
+        "https://optimistic.etherscan.io",
+        usdc="0x0b2C639c533813f4Aa9D7837CAf62653d097Ff85",
+        usdt="0x94b008aA00579c1307B0EF2c499aD98a8ce58e58",
+    ),
+    "polygon": ChainConfig(
+        "polygon", 137, "Polygon PoS", "https://polygon-rpc.com",
+        "https://polygonscan.com",
+        usdc="0x3c499c542cEF5E3811e1192ce70d8cC03d5c3359",
+        usdt="0xc2132D05D31c914a87C6611C10748AEb04B58e8F",
+    ),
+}
+
+DEFAULT_CHAIN = "base"
+
+# ERC-8004 identity registry (agent registration), per chain.
+ERC8004_REGISTRY: dict[str, str] = {
+    "base": "0x8004A169FB4a3325136EB29fA0d6Dc21C87d1cb3",
+}

@@ -18,7 +18,10 @@ TASK_STATUSES = ("active", "paused", "archived")
 RUN_STATUSES = ("running", "success", "error", "cancelled")
 ROOM_STATUSES = ("active", "paused", "archived")
 AGENT_STATES = ("idle", "running", "waiting", "rate_limited", "stopped")
-DECISION_STATUSES = ("voting", "announced", "effective", "passed", "rejected", "expired")
+DECISION_STATUSES = (
+    "voting", "announced", "approved", "objected", "effective",
+    "passed", "rejected", "expired",
+)
 DECISION_TYPES = ("low_impact", "high_impact", "critical")
 GOAL_STATUSES = ("active", "completed", "abandoned")
 ESCALATION_STATUSES = ("pending", "answered", "dismissed")

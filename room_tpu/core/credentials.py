@@ -1,0 +1,83 @@
+"""Encrypted per-room credential store + API-key resolution chain
+(reference: src/shared/model-provider.ts:87-141 — this room's credential →
+any room's credential → clerk setting → environment variable)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..db import Database
+from .messages import get_setting
+from .secrets import decrypt_secret, encrypt_secret, is_encrypted
+
+
+def store_credential(
+    db: Database,
+    room_id: int,
+    name: str,
+    value: str,
+    type_: str = "other",
+    provided_by: str = "keeper",
+) -> int:
+    db.execute(
+        "INSERT INTO credentials(room_id, name, type, value_encrypted, "
+        "provided_by) VALUES (?,?,?,?,?) "
+        "ON CONFLICT(room_id, name) DO UPDATE SET "
+        "value_encrypted=excluded.value_encrypted, type=excluded.type",
+        (room_id, name, type_, encrypt_secret(value), provided_by),
+    )
+    row = db.query_one(
+        "SELECT id FROM credentials WHERE room_id=? AND name=?",
+        (room_id, name),
+    )
+    return int(row["id"])  # upserts can't trust lastrowid
+
+
+def get_credential(db: Database, room_id: int, name: str) -> Optional[str]:
+    row = db.query_one(
+        "SELECT value_encrypted FROM credentials WHERE room_id=? AND name=?",
+        (room_id, name),
+    )
+    if row is None:
+        return None
+    v = row["value_encrypted"]
+    return decrypt_secret(v) if is_encrypted(v) else v
+
+
+def list_credentials(db: Database, room_id: int) -> list[dict]:
+    """Metadata only — values never leave the store unencrypted in bulk."""
+    return db.query(
+        "SELECT id, room_id, name, type, provided_by, created_at "
+        "FROM credentials WHERE room_id=? ORDER BY id",
+        (room_id,),
+    )
+
+
+def delete_credential(db: Database, room_id: int, name: str) -> bool:
+    return db.execute(
+        "DELETE FROM credentials WHERE room_id=? AND name=?", (room_id, name)
+    ).rowcount > 0
+
+
+def resolve_api_key(
+    db: Database, key_name: str, room_id: Optional[int] = None
+) -> Optional[str]:
+    """Resolution chain: this room's credential → any room's credential →
+    settings table → environment variable."""
+    if room_id is not None:
+        v = get_credential(db, room_id, key_name)
+        if v:
+            return v
+    row = db.query_one(
+        "SELECT value_encrypted FROM credentials WHERE name=? ORDER BY id "
+        "LIMIT 1",
+        (key_name,),
+    )
+    if row:
+        v = row["value_encrypted"]
+        return decrypt_secret(v) if is_encrypted(v) else v
+    v = get_setting(db, key_name)
+    if v:
+        return v
+    return os.environ.get(key_name)

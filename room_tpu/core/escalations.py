@@ -1,0 +1,64 @@
+"""Keeper escalations: questions an agent can't resolve inside the room."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database, utc_now
+
+
+def create_escalation(
+    db: Database,
+    room_id: int,
+    question: str,
+    from_agent_id: Optional[int] = None,
+    to_agent_id: Optional[int] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO escalations(room_id, from_agent_id, to_agent_id, "
+        "question) VALUES (?,?,?,?)",
+        (room_id, from_agent_id, to_agent_id, question),
+    )
+
+
+def get_escalation(db: Database, escalation_id: int) -> Optional[dict]:
+    return db.query_one(
+        "SELECT * FROM escalations WHERE id=?", (escalation_id,)
+    )
+
+
+def answer_escalation(db: Database, escalation_id: int, answer: str) -> None:
+    db.execute(
+        "UPDATE escalations SET answer=?, status='answered', resolved_at=? "
+        "WHERE id=?",
+        (answer, utc_now(), escalation_id),
+    )
+
+
+def dismiss_escalation(db: Database, escalation_id: int) -> None:
+    db.execute(
+        "UPDATE escalations SET status='dismissed', resolved_at=? WHERE id=?",
+        (utc_now(), escalation_id),
+    )
+
+
+def pending_escalations(db: Database, room_id: Optional[int] = None) -> list[dict]:
+    if room_id is None:
+        return db.query(
+            "SELECT * FROM escalations WHERE status='pending' ORDER BY id"
+        )
+    return db.query(
+        "SELECT * FROM escalations WHERE room_id=? AND status='pending' "
+        "ORDER BY id",
+        (room_id,),
+    )
+
+
+def recently_answered(db: Database, room_id: int, limit: int = 5) -> list[dict]:
+    """Answered-but-unseen keeper replies surfaced into the next cycle
+    prompt."""
+    return db.query(
+        "SELECT * FROM escalations WHERE room_id=? AND status='answered' "
+        "ORDER BY resolved_at DESC LIMIT ?",
+        (room_id, limit),
+    )

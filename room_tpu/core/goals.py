@@ -1,0 +1,153 @@
+"""Hierarchical goals (reference: src/shared/goals.ts, progress recalc in
+src/shared/db-queries.ts:1488-1520)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database, utc_now
+
+
+def set_room_objective(db: Database, room_id: int, description: str) -> int:
+    """The root goal. A room has exactly one active root; setting a new one
+    abandons the old root."""
+    existing = db.query_one(
+        "SELECT id FROM goals WHERE room_id=? AND parent_goal_id IS NULL "
+        "AND status='active'",
+        (room_id,),
+    )
+    if existing:
+        db.execute(
+            "UPDATE goals SET status='abandoned', updated_at=? WHERE id=?",
+            (utc_now(), existing["id"]),
+        )
+    db.execute(
+        "UPDATE rooms SET goal=?, updated_at=? WHERE id=?",
+        (description, utc_now(), room_id),
+    )
+    return db.insert(
+        "INSERT INTO goals(room_id, description) VALUES (?,?)",
+        (room_id, description),
+    )
+
+
+def get_root_goal(db: Database, room_id: int) -> Optional[dict]:
+    return db.query_one(
+        "SELECT * FROM goals WHERE room_id=? AND parent_goal_id IS NULL "
+        "AND status='active' ORDER BY id DESC LIMIT 1",
+        (room_id,),
+    )
+
+
+def create_goal(
+    db: Database,
+    room_id: int,
+    description: str,
+    parent_goal_id: Optional[int] = None,
+    assigned_worker_id: Optional[int] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO goals(room_id, description, parent_goal_id, "
+        "assigned_worker_id) VALUES (?,?,?,?)",
+        (room_id, description, parent_goal_id, assigned_worker_id),
+    )
+
+
+def get_goal(db: Database, goal_id: int) -> Optional[dict]:
+    return db.query_one("SELECT * FROM goals WHERE id=?", (goal_id,))
+
+
+def assign_goal(db: Database, goal_id: int, worker_id: Optional[int]) -> None:
+    db.execute(
+        "UPDATE goals SET assigned_worker_id=?, updated_at=? WHERE id=?",
+        (worker_id, utc_now(), goal_id),
+    )
+
+
+def add_goal_update(
+    db: Database,
+    goal_id: int,
+    observation: str,
+    worker_id: Optional[int] = None,
+    metric_value: Optional[float] = None,
+) -> int:
+    uid = db.insert(
+        "INSERT INTO goal_updates(goal_id, worker_id, observation, "
+        "metric_value) VALUES (?,?,?,?)",
+        (goal_id, worker_id, observation, metric_value),
+    )
+    if metric_value is not None:
+        set_goal_progress(db, goal_id, max(0.0, min(1.0, metric_value)))
+    return uid
+
+
+def set_goal_progress(db: Database, goal_id: int, progress: float) -> None:
+    db.execute(
+        "UPDATE goals SET progress=?, updated_at=? WHERE id=?",
+        (progress, utc_now(), goal_id),
+    )
+    _recalc_ancestors(db, goal_id)
+
+
+def complete_goal(db: Database, goal_id: int) -> None:
+    db.execute(
+        "UPDATE goals SET status='completed', progress=1.0, updated_at=? "
+        "WHERE id=?",
+        (utc_now(), goal_id),
+    )
+    _recalc_ancestors(db, goal_id)
+
+
+def abandon_goal(db: Database, goal_id: int) -> None:
+    db.execute(
+        "UPDATE goals SET status='abandoned', updated_at=? WHERE id=?",
+        (utc_now(), goal_id),
+    )
+    _recalc_ancestors(db, goal_id)
+
+
+def _recalc_ancestors(db: Database, goal_id: int) -> None:
+    """Parent progress = mean of non-abandoned children, recursively
+    upward (reference: db-queries.ts:1488-1520)."""
+    goal = get_goal(db, goal_id)
+    while goal and goal["parent_goal_id"] is not None:
+        pid = goal["parent_goal_id"]
+        row = db.query_one(
+            "SELECT AVG(CASE WHEN status='completed' THEN 1.0 ELSE progress "
+            "END) AS p FROM goals WHERE parent_goal_id=? AND "
+            "status != 'abandoned'",
+            (pid,),
+        )
+        if row and row["p"] is not None:
+            db.execute(
+                "UPDATE goals SET progress=?, updated_at=? WHERE id=?",
+                (float(row["p"]), utc_now(), pid),
+            )
+        goal = get_goal(db, pid)
+
+
+def get_goal_tree(db: Database, room_id: int) -> list[dict]:
+    """Nested goal forest for the room, children under 'children'."""
+    rows = db.query(
+        "SELECT * FROM goals WHERE room_id=? ORDER BY id", (room_id,)
+    )
+    by_id: dict[int, dict] = {}
+    for r in rows:
+        r["children"] = []
+        by_id[r["id"]] = r
+    roots = []
+    for r in rows:
+        pid = r["parent_goal_id"]
+        if pid is not None and pid in by_id:
+            by_id[pid]["children"].append(r)
+        else:
+            roots.append(r)
+    return roots
+
+
+def active_goals_for_worker(db: Database, worker_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM goals WHERE assigned_worker_id=? AND status='active' "
+        "ORDER BY id",
+        (worker_id,),
+    )

@@ -1,0 +1,326 @@
+"""Semantic memory: entity/observation/relation graph + hybrid recall.
+
+Behavioral parity with the reference memory model (reference:
+src/shared/schema.ts:69-130, src/shared/db-queries.ts:927-1059): entities
+carry observations and typed relations; full-text search runs over an FTS5
+mirror; semantic search runs over stored embedding vectors; hybrid recall
+merges both rankings with reciprocal-rank fusion (k=60, weights 0.4 FTS /
+0.6 semantic).
+
+TPU-first difference: vectors are stored as float32 blobs for durability,
+but ranking happens over an in-process matrix (numpy on host; the serving
+engine mirrors the same matrix on-device and ranks with one dot + top_k on
+the mesh — see room_tpu.serving.embed_index).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..db import Database, utc_now
+
+RRF_K = 60
+FTS_WEIGHT = 0.4
+SEMANTIC_WEIGHT = 0.6
+EMBED_DIM = 384
+EMBED_MODEL = "tpu-embed-384"
+
+
+# ---- entity graph ----
+
+def create_entity(
+    db: Database,
+    name: str,
+    type_: str = "fact",
+    category: Optional[str] = None,
+    room_id: Optional[int] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO entities(name, type, category, room_id) VALUES (?,?,?,?)",
+        (name, type_, category, room_id),
+    )
+
+
+def get_entity(db: Database, entity_id: int) -> Optional[dict]:
+    return db.query_one("SELECT * FROM entities WHERE id=?", (entity_id,))
+
+
+def find_entity(
+    db: Database, name: str, room_id: Optional[int] = None
+) -> Optional[dict]:
+    if room_id is None:
+        return db.query_one("SELECT * FROM entities WHERE name=?", (name,))
+    return db.query_one(
+        "SELECT * FROM entities WHERE name=? AND room_id=?", (name, room_id)
+    )
+
+
+def delete_entity(db: Database, entity_id: int) -> bool:
+    return db.execute(
+        "DELETE FROM entities WHERE id=?", (entity_id,)
+    ).rowcount > 0
+
+
+def add_observation(
+    db: Database, entity_id: int, content: str, source: str = "agent"
+) -> int:
+    oid = db.insert(
+        "INSERT INTO observations(entity_id, content, source) VALUES (?,?,?)",
+        (entity_id, content, source),
+    )
+    db.execute(
+        "UPDATE entities SET updated_at=?, embedded_at=NULL WHERE id=?",
+        (utc_now(), entity_id),
+    )
+    return oid
+
+
+def get_observations(db: Database, entity_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM observations WHERE entity_id=? ORDER BY id",
+        (entity_id,),
+    )
+
+
+def create_relation(
+    db: Database, from_entity: int, to_entity: int, relation_type: str
+) -> int:
+    return db.insert(
+        "INSERT INTO relations(from_entity, to_entity, relation_type) "
+        "VALUES (?,?,?)",
+        (from_entity, to_entity, relation_type),
+    )
+
+
+def get_relations(db: Database, entity_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM relations WHERE from_entity=? OR to_entity=?",
+        (entity_id, entity_id),
+    )
+
+
+def remember(
+    db: Database,
+    name: str,
+    content: str,
+    category: Optional[str] = None,
+    room_id: Optional[int] = None,
+    source: str = "agent",
+) -> int:
+    """Upsert-style memory write: find-or-create the entity, then append
+    the observation."""
+    existing = find_entity(db, name, room_id)
+    eid = existing["id"] if existing else create_entity(
+        db, name, "fact", category, room_id
+    )
+    add_observation(db, eid, content, source)
+    return eid
+
+
+# ---- embeddings store ----
+
+def vector_to_blob(vec: Sequence[float]) -> bytes:
+    return np.asarray(vec, dtype=np.float32).tobytes()
+
+
+def blob_to_vector(blob: bytes) -> np.ndarray:
+    return np.frombuffer(blob, dtype=np.float32)
+
+
+def text_hash(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()[:32]
+
+
+def store_embedding(
+    db: Database,
+    entity_id: int,
+    text: str,
+    vector: Sequence[float],
+    source_type: str = "entity",
+    source_id: Optional[int] = None,
+    model: str = EMBED_MODEL,
+) -> int:
+    vec = np.asarray(vector, dtype=np.float32)
+    sid = source_id if source_id is not None else entity_id
+    db.execute(
+        "INSERT INTO embeddings"
+        "(entity_id, source_type, source_id, text_hash, vector, model, dim) "
+        "VALUES (?,?,?,?,?,?,?) "
+        "ON CONFLICT(source_type, source_id, model) DO UPDATE SET "
+        "vector=excluded.vector, text_hash=excluded.text_hash, "
+        "entity_id=excluded.entity_id",
+        (
+            entity_id,
+            source_type,
+            sid,
+            text_hash(text),
+            vec.tobytes(),
+            model,
+            int(vec.shape[0]),
+        ),
+    )
+    db.execute(
+        "UPDATE entities SET embedded_at=? WHERE id=?", (utc_now(), entity_id)
+    )
+    row = db.query_one(
+        "SELECT id FROM embeddings WHERE source_type=? AND source_id=? "
+        "AND model=?",
+        (source_type, sid, model),
+    )
+    return int(row["id"])  # upserts can't trust lastrowid
+
+
+def embedding_matrix(
+    db: Database, room_id: Optional[int] = None, model: str = EMBED_MODEL
+) -> tuple[np.ndarray, list[int]]:
+    """All stored vectors as an (N, D) float32 matrix + parallel entity ids.
+
+    Room-scoped recall includes global (room-less) memories, matching the
+    reference's scoping.
+    """
+    if room_id is None:
+        rows = db.query(
+            "SELECT e.entity_id AS eid, e.vector FROM embeddings e "
+            "WHERE e.model=? ORDER BY e.id",
+            (model,),
+        )
+    else:
+        rows = db.query(
+            "SELECT e.entity_id AS eid, e.vector FROM embeddings e "
+            "JOIN entities t ON t.id = e.entity_id "
+            "WHERE e.model=? AND (t.room_id=? OR t.room_id IS NULL) "
+            "ORDER BY e.id",
+            (model, room_id),
+        )
+    if not rows:
+        return np.zeros((0, EMBED_DIM), dtype=np.float32), []
+    mat = np.stack([blob_to_vector(r["vector"]) for r in rows])
+    return mat, [r["eid"] for r in rows]
+
+
+# ---- search ----
+
+def sanitize_fts_query(query: str) -> str:
+    """Turn arbitrary user text into a safe FTS5 MATCH expression: bare
+    terms OR'd together, quoted to disarm operators."""
+    terms = re.findall(r"[\w]+", query, flags=re.UNICODE)
+    if not terms:
+        return '""'
+    return " OR ".join(f'"{t}"' for t in terms[:16])
+
+
+def fts_search(
+    db: Database,
+    query: str,
+    limit: int = 20,
+    room_id: Optional[int] = None,
+) -> list[dict]:
+    """BM25-ranked full-text hits: [{entity_id, score, name}] best-first."""
+    match = sanitize_fts_query(query)
+    if room_id is None:
+        rows = db.query(
+            "SELECT f.entity_id, f.name, bm25(memory_fts) AS rank "
+            "FROM memory_fts f WHERE memory_fts MATCH ? "
+            "ORDER BY rank LIMIT ?",
+            (match, limit),
+        )
+    else:
+        rows = db.query(
+            "SELECT f.entity_id, f.name, bm25(memory_fts) AS rank "
+            "FROM memory_fts f JOIN entities t ON t.id = f.entity_id "
+            "WHERE memory_fts MATCH ? AND (t.room_id=? OR t.room_id IS NULL) "
+            "ORDER BY rank LIMIT ?",
+            (match, room_id, limit),
+        )
+    return [
+        {"entity_id": r["entity_id"], "name": r["name"], "score": -r["rank"]}
+        for r in rows
+    ]
+
+
+def semantic_search(
+    db: Database,
+    query_vector: Sequence[float],
+    limit: int = 20,
+    room_id: Optional[int] = None,
+) -> list[dict]:
+    """Cosine-ranked semantic hits over the stored embedding matrix."""
+    mat, eids = embedding_matrix(db, room_id)
+    if not eids:
+        return []
+    q = np.asarray(query_vector, dtype=np.float32)
+    qn = np.linalg.norm(q) + 1e-9
+    mn = np.linalg.norm(mat, axis=1) + 1e-9
+    sims = (mat @ q) / (mn * qn)
+    order = np.argsort(-sims)[:limit]
+    return [
+        {"entity_id": eids[i], "score": float(sims[i])} for i in order
+    ]
+
+
+def hybrid_search(
+    db: Database,
+    query: str,
+    query_vector: Optional[Sequence[float]] = None,
+    limit: int = 5,
+    room_id: Optional[int] = None,
+) -> list[dict]:
+    """Reciprocal-rank fusion of FTS and semantic rankings (reference:
+    src/shared/db-queries.ts:1021-1059 — RRF k=60, 0.4 FTS / 0.6 semantic).
+
+    Falls back to pure FTS when no query vector is supplied (embedder
+    offline)."""
+    fts_hits = fts_search(db, query, limit=20, room_id=room_id)
+    sem_hits = (
+        semantic_search(db, query_vector, limit=20, room_id=room_id)
+        if query_vector is not None
+        else []
+    )
+    scores: dict[int, float] = {}
+    for rank, hit in enumerate(fts_hits):
+        scores[hit["entity_id"]] = scores.get(hit["entity_id"], 0.0) + (
+            FTS_WEIGHT / (RRF_K + rank + 1)
+        )
+    for rank, hit in enumerate(sem_hits):
+        scores[hit["entity_id"]] = scores.get(hit["entity_id"], 0.0) + (
+            SEMANTIC_WEIGHT / (RRF_K + rank + 1)
+        )
+    ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:limit]
+    out = []
+    for eid, score in ranked:
+        ent = get_entity(db, eid)
+        if ent is None:
+            continue
+        obs = get_observations(db, eid)
+        out.append(
+            {
+                "entity_id": eid,
+                "name": ent["name"],
+                "category": ent["category"],
+                "score": score,
+                "observations": [o["content"] for o in obs[-5:]],
+            }
+        )
+    return out
+
+
+def entities_needing_embedding(db: Database, limit: int = 10) -> list[dict]:
+    """Background-indexer work queue: entities whose content changed since
+    they were last embedded (reference: src/shared/embedding-indexer.ts)."""
+    return db.query(
+        "SELECT * FROM entities WHERE embedded_at IS NULL "
+        "ORDER BY updated_at LIMIT ?",
+        (limit,),
+    )
+
+
+def embedding_text_for_entity(db: Database, entity: dict) -> str:
+    """Entity name + its most recent 5 observations, the same digest the
+    reference embeds (src/shared/embedding-indexer.ts:7-61)."""
+    obs = get_observations(db, entity["id"])[-5:]
+    parts = [entity["name"]] + [o["content"] for o in obs]
+    return "\n".join(parts)

@@ -1,0 +1,125 @@
+"""Inter-room messages + room chat + settings KV."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database, utc_now
+
+
+# ---- inter-room messages ----
+
+def send_room_message(
+    db: Database,
+    from_room_id: int,
+    to_room_id: int,
+    subject: str,
+    body: str,
+) -> tuple[int, int]:
+    """Record outbound on sender + inbound on recipient. Returns both ids."""
+    out_id = db.insert(
+        "INSERT INTO room_messages(room_id, direction, from_room_id, "
+        "to_room_id, subject, body, status) "
+        "VALUES (?,?,?,?,?,?,'read')",
+        (from_room_id, "outbound", str(from_room_id), str(to_room_id),
+         subject, body),
+    )
+    in_id = db.insert(
+        "INSERT INTO room_messages(room_id, direction, from_room_id, "
+        "to_room_id, subject, body) VALUES (?,?,?,?,?,?)",
+        (to_room_id, "inbound", str(from_room_id), str(to_room_id),
+         subject, body),
+    )
+    return out_id, in_id
+
+
+def receive_external_message(
+    db: Database,
+    room_id: int,
+    from_room_id: str,
+    subject: str,
+    body: str,
+) -> int:
+    """Inbound message from another machine (cloud relay)."""
+    return db.insert(
+        "INSERT INTO room_messages(room_id, direction, from_room_id, "
+        "to_room_id, subject, body) VALUES (?,?,?,?,?,?)",
+        (room_id, "inbound", from_room_id, str(room_id), subject, body),
+    )
+
+
+def unread_messages(db: Database, room_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM room_messages WHERE room_id=? AND direction='inbound' "
+        "AND status='unread' ORDER BY id",
+        (room_id,),
+    )
+
+
+def mark_message_read(db: Database, message_id: int) -> None:
+    db.execute(
+        "UPDATE room_messages SET status='read' WHERE id=?", (message_id,)
+    )
+
+
+def mark_message_replied(db: Database, message_id: int) -> None:
+    db.execute(
+        "UPDATE room_messages SET status='replied' WHERE id=?", (message_id,)
+    )
+
+
+# ---- room chat (keeper <-> queen) ----
+
+def add_chat_message(
+    db: Database, room_id: int, role: str, content: str
+) -> int:
+    return db.insert(
+        "INSERT INTO chat_messages(room_id, role, content) VALUES (?,?,?)",
+        (room_id, role, content),
+    )
+
+
+def chat_history(db: Database, room_id: int, limit: int = 50) -> list[dict]:
+    rows = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=? ORDER BY id DESC LIMIT ?",
+        (room_id, limit),
+    )
+    return list(reversed(rows))
+
+
+def unanswered_keeper_messages(db: Database, room_id: int) -> list[dict]:
+    """User chat messages newer than the last assistant reply — the queen
+    inbox poll looks for these."""
+    last_reply = db.query_one(
+        "SELECT id FROM chat_messages WHERE room_id=? AND role='assistant' "
+        "ORDER BY id DESC LIMIT 1",
+        (room_id,),
+    )
+    floor = last_reply["id"] if last_reply else 0
+    return db.query(
+        "SELECT * FROM chat_messages WHERE room_id=? AND role='user' "
+        "AND id > ? ORDER BY id",
+        (room_id, floor),
+    )
+
+
+# ---- settings KV ----
+
+def get_setting(db: Database, key: str, default: Optional[str] = None) -> Optional[str]:
+    row = db.query_one("SELECT value FROM settings WHERE key=?", (key,))
+    return row["value"] if row else default
+
+
+def set_setting(db: Database, key: str, value: Optional[str]) -> None:
+    db.execute(
+        "INSERT INTO settings(key, value, updated_at) VALUES (?,?,?) "
+        "ON CONFLICT(key) DO UPDATE SET value=excluded.value, "
+        "updated_at=excluded.updated_at",
+        (key, value, utc_now()),
+    )
+
+
+def all_settings(db: Database) -> dict[str, Optional[str]]:
+    return {
+        r["key"]: r["value"] for r in db.query("SELECT * FROM settings")
+    }

@@ -1,0 +1,295 @@
+"""Announce-and-object quorum governance (reference: src/shared/quorum.ts).
+
+The queen announces a decision; it auto-becomes effective after a delay
+(default 10 minutes) unless a worker objects first. Decision types on the
+room's auto-approve list skip the delay entirely. A legacy ballot model
+(explicit yes/no/abstain votes with thresholds) is kept for MCP tools and
+the keeper."""
+
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+from typing import Optional
+
+from ..db import Database, utc_now
+from .activity import log_room_activity
+from .constants import RoomConfig
+from .rooms import get_room, room_config
+
+ANNOUNCE_DELAY_MINUTES_DEFAULT = 10
+
+
+class QuorumError(ValueError):
+    pass
+
+
+def _future(minutes: float) -> str:
+    t = datetime.now(timezone.utc) + timedelta(minutes=minutes)
+    return t.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def get_decision(db: Database, decision_id: int) -> Optional[dict]:
+    return db.query_one(
+        "SELECT * FROM quorum_decisions WHERE id=?", (decision_id,)
+    )
+
+
+def _resolve(db: Database, decision_id: int, status: str, result: str) -> None:
+    db.execute(
+        "UPDATE quorum_decisions SET status=?, result=?, resolved_at=? "
+        "WHERE id=?",
+        (status, result, utc_now(), decision_id),
+    )
+
+
+def announce(
+    db: Database,
+    room_id: int,
+    proposer_id: Optional[int],
+    proposal: str,
+    decision_type: str = "low_impact",
+    delay_minutes: Optional[float] = None,
+) -> dict:
+    room = get_room(db, room_id)
+    if room is None:
+        raise QuorumError(f"room {room_id} not found")
+    cfg = room_config(room)
+
+    if decision_type in cfg.auto_approve:
+        did = db.insert(
+            "INSERT INTO quorum_decisions"
+            "(room_id, proposer_id, proposal, decision_type, status, result, "
+            "resolved_at) VALUES (?,?,?,?,'approved','Auto-approved',?)",
+            (room_id, proposer_id, proposal, decision_type, utc_now()),
+        )
+        log_room_activity(
+            db, room_id, "decision", f"Auto-approved: {proposal}",
+            actor_id=proposer_id,
+        )
+        return get_decision(db, did)  # type: ignore[return-value]
+
+    delay = (
+        delay_minutes
+        if delay_minutes is not None
+        else ANNOUNCE_DELAY_MINUTES_DEFAULT
+    )
+    did = db.insert(
+        "INSERT INTO quorum_decisions"
+        "(room_id, proposer_id, proposal, decision_type, status, "
+        "effective_at) VALUES (?,?,?,?,'announced',?)",
+        (room_id, proposer_id, proposal, decision_type, _future(delay)),
+    )
+    log_room_activity(
+        db, room_id, "decision",
+        f"Announced: {proposal} (effective in {delay:g} min)",
+        actor_id=proposer_id,
+    )
+    return get_decision(db, did)  # type: ignore[return-value]
+
+
+def object_to(
+    db: Database, decision_id: int, worker_id: int, reason: str
+) -> dict:
+    decision = get_decision(db, decision_id)
+    if decision is None:
+        raise QuorumError(f"decision {decision_id} not found")
+    if decision["status"] != "announced":
+        raise QuorumError(
+            f"decision {decision_id} is not open for objection "
+            f"(status: {decision['status']})"
+        )
+    _resolve(
+        db, decision_id, "objected",
+        f"Objected by worker #{worker_id}: {reason}",
+    )
+    log_room_activity(
+        db, decision["room_id"], "decision",
+        f"Objected: {decision['proposal']} — {reason}", actor_id=worker_id,
+    )
+    return get_decision(db, decision_id)  # type: ignore[return-value]
+
+
+def check_expired_decisions(db: Database) -> int:
+    """Flip past-deadline announcements to effective and expire stale
+    ballots. Called at the top of every agent cycle."""
+    count = 0
+    now = utc_now()
+    for d in db.query(
+        "SELECT * FROM quorum_decisions WHERE status='announced' "
+        "AND effective_at IS NOT NULL AND effective_at <= ?",
+        (now,),
+    ):
+        _resolve(db, d["id"], "effective", "No objections — auto-effective")
+        log_room_activity(
+            db, d["room_id"], "decision",
+            f"Effective: {d['proposal']} (no objections)",
+        )
+        count += 1
+    for d in db.query(
+        "SELECT * FROM quorum_decisions WHERE status='voting' "
+        "AND timeout_at IS NOT NULL AND timeout_at <= ?",
+        (now,),
+    ):
+        resolved = _tally_and_resolve(db, d)
+        if not resolved:
+            _resolve(db, d["id"], "expired", "Voting period expired")
+            log_room_activity(
+                db, d["room_id"], "decision", f"Expired: {d['proposal']}"
+            )
+        count += 1
+    return count
+
+
+# ---- legacy ballot model ----
+
+def open_ballot(
+    db: Database,
+    room_id: int,
+    proposer_id: Optional[int],
+    proposal: str,
+    decision_type: str = "high_impact",
+    timeout_minutes: float = 10,
+    threshold: Optional[str] = None,
+    min_voters: int = 0,
+    sealed: bool = False,
+) -> dict:
+    room = get_room(db, room_id)
+    if room is None:
+        raise QuorumError(f"room {room_id} not found")
+    cfg = room_config(room)
+    did = db.insert(
+        "INSERT INTO quorum_decisions"
+        "(room_id, proposer_id, proposal, decision_type, status, threshold, "
+        "timeout_at, min_voters, sealed) VALUES (?,?,?,?,'voting',?,?,?,?)",
+        (
+            room_id, proposer_id, proposal, decision_type,
+            threshold or cfg.vote_threshold,
+            _future(timeout_minutes), min_voters, int(sealed),
+        ),
+    )
+    return get_decision(db, did)  # type: ignore[return-value]
+
+
+def vote(
+    db: Database,
+    decision_id: int,
+    worker_id: int,
+    vote_value: str,
+    reasoning: Optional[str] = None,
+) -> dict:
+    if vote_value not in ("yes", "no", "abstain"):
+        raise QuorumError(f"invalid vote {vote_value!r}")
+    decision = get_decision(db, decision_id)
+    if decision is None:
+        raise QuorumError(f"decision {decision_id} not found")
+    if decision["status"] != "voting":
+        raise QuorumError(
+            f"decision {decision_id} is not open for voting "
+            f"(status: {decision['status']})"
+        )
+    first_vote = db.query_one(
+        "SELECT 1 AS x FROM quorum_votes WHERE decision_id=? AND worker_id=?",
+        (decision_id, worker_id),
+    ) is None
+    db.insert(
+        "INSERT INTO quorum_votes(decision_id, worker_id, vote, reasoning) "
+        "VALUES (?,?,?,?) ON CONFLICT(decision_id, worker_id) DO UPDATE SET "
+        "vote=excluded.vote, reasoning=excluded.reasoning",
+        (decision_id, worker_id, vote_value, reasoning),
+    )
+    if first_vote:  # vote changes don't inflate the participation metric
+        db.execute(
+            "UPDATE workers SET votes_cast = votes_cast + 1 WHERE id=?",
+            (worker_id,),
+        )
+    decision = get_decision(db, decision_id)
+    _tally_and_resolve(db, decision)  # resolve early if outcome is decided
+    return get_decision(db, decision_id)  # type: ignore[return-value]
+
+
+def keeper_vote(db: Database, decision_id: int, vote_value: str) -> dict:
+    decision = get_decision(db, decision_id)
+    if decision is None:
+        raise QuorumError(f"decision {decision_id} not found")
+    if decision["status"] == "announced":
+        if vote_value == "no":
+            _resolve(db, decision_id, "objected", "Keeper objected")
+        else:
+            _resolve(db, decision_id, "effective", "Keeper approved")
+        return get_decision(db, decision_id)  # type: ignore[return-value]
+    if decision["status"] != "voting":
+        raise QuorumError(
+            f"decision {decision_id} is not open for voting "
+            f"(status: {decision['status']})"
+        )
+    db.execute(
+        "UPDATE quorum_decisions SET keeper_vote=? WHERE id=?",
+        (vote_value, decision_id),
+    )
+    _tally_and_resolve(db, get_decision(db, decision_id))
+    return get_decision(db, decision_id)  # type: ignore[return-value]
+
+
+def _threshold_fraction(threshold: str) -> float:
+    return {
+        "majority": 0.5,
+        "two_thirds": 2.0 / 3.0,
+        "unanimous": 1.0,
+    }.get(threshold, 0.5)
+
+
+def tally(db: Database, decision_id: int) -> dict:
+    votes = db.query(
+        "SELECT vote FROM quorum_votes WHERE decision_id=?", (decision_id,)
+    )
+    yes = sum(1 for v in votes if v["vote"] == "yes")
+    no = sum(1 for v in votes if v["vote"] == "no")
+    abstain = sum(1 for v in votes if v["vote"] == "abstain")
+    return {"yes": yes, "no": no, "abstain": abstain, "total": len(votes)}
+
+
+def _tally_and_resolve(db: Database, decision: dict) -> bool:
+    """Resolve a ballot whose outcome is already decided by the eligible
+    electorate. Returns True if resolved."""
+    if decision["status"] != "voting":
+        return False
+    voters = db.query(
+        "SELECT id FROM workers WHERE room_id=?", (decision["room_id"],)
+    )
+    electorate = max(len(voters), decision["min_voters"], 1)
+    t = tally(db, decision["id"])
+    frac = _threshold_fraction(decision["threshold"])
+    need = int(electorate * frac) + (1 if frac < 1.0 else 0)
+    need = max(need, 1)
+    if decision["threshold"] == "unanimous":
+        need = electorate
+
+    keeper = decision["keeper_vote"]
+    yes = t["yes"] + (1 if keeper == "yes" else 0)
+    no = t["no"] + (1 if keeper == "no" else 0)
+
+    if yes >= need:
+        _resolve(db, decision["id"], "passed", f"{yes}/{electorate} yes")
+        log_room_activity(
+            db, decision["room_id"], "decision",
+            f"Passed: {decision['proposal']}",
+        )
+        return True
+    # rejection once yes can no longer reach the threshold
+    remaining = electorate - t["total"]
+    if yes + remaining < need:
+        _resolve(db, decision["id"], "rejected", f"{no}/{electorate} no")
+        log_room_activity(
+            db, decision["room_id"], "decision",
+            f"Rejected: {decision['proposal']}",
+        )
+        return True
+    return False
+
+
+def pending_decisions(db: Database, room_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM quorum_decisions WHERE room_id=? AND status IN "
+        "('announced','voting') ORDER BY id",
+        (room_id,),
+    )

@@ -1,0 +1,159 @@
+"""Room lifecycle (reference: src/shared/room.ts).
+
+create_room builds the full collective in one transaction: the room row,
+its queen worker, the root goal, and the room wallet."""
+
+from __future__ import annotations
+
+import json
+import secrets
+from typing import Optional
+
+from ..db import Database, utc_now
+from .constants import (
+    DEFAULT_QUEEN_PROMPT,
+    QUEEN_CYCLE_GAP_MS_DEFAULT,
+    QUEEN_MAX_TURNS_DEFAULT,
+    RoomConfig,
+)
+
+
+def room_config(room: dict) -> RoomConfig:
+    raw = room.get("config")
+    return RoomConfig.from_json(json.loads(raw) if raw else None)
+
+
+def create_room(
+    db: Database,
+    name: str,
+    goal: Optional[str] = None,
+    worker_model: str = "tpu",
+    queen_model: Optional[str] = None,
+    queen_cycle_gap_ms: int = QUEEN_CYCLE_GAP_MS_DEFAULT,
+    config: Optional[RoomConfig] = None,
+    create_wallet: bool = True,
+) -> dict:
+    """Create room + queen + root goal (+ wallet). Returns the room row."""
+    from . import goals as goals_mod
+    from . import wallet as wallet_mod
+    from .workers import create_worker
+
+    with db.transaction():
+        room_id = db.insert(
+            "INSERT INTO rooms(name, goal, worker_model, queen_cycle_gap_ms, "
+            "queen_max_turns, config, webhook_token) VALUES (?,?,?,?,?,?,?)",
+            (
+                name, goal, worker_model, queen_cycle_gap_ms,
+                QUEEN_MAX_TURNS_DEFAULT,
+                json.dumps((config or RoomConfig()).to_json()),
+                secrets.token_urlsafe(24),
+            ),
+        )
+        queen_id = create_worker(
+            db,
+            name=f"{name} Queen",
+            system_prompt=DEFAULT_QUEEN_PROMPT,
+            room_id=room_id,
+            role="queen",
+            model=queen_model or worker_model,
+            cycle_gap_ms=queen_cycle_gap_ms,
+            max_turns=QUEEN_MAX_TURNS_DEFAULT,
+        )
+        db.execute(
+            "UPDATE rooms SET queen_worker_id=? WHERE id=?",
+            (queen_id, room_id),
+        )
+        if goal:
+            goals_mod.set_room_objective(db, room_id, goal)
+        if create_wallet:
+            wallet_mod.create_room_wallet(db, room_id)
+    return get_room(db, room_id)  # type: ignore[return-value]
+
+
+def get_room(db: Database, room_id: int) -> Optional[dict]:
+    return db.query_one("SELECT * FROM rooms WHERE id=?", (room_id,))
+
+
+def list_rooms(db: Database, status: Optional[str] = None) -> list[dict]:
+    if status is None:
+        return db.query("SELECT * FROM rooms ORDER BY id")
+    return db.query(
+        "SELECT * FROM rooms WHERE status=? ORDER BY id", (status,)
+    )
+
+
+def update_room(db: Database, room_id: int, **fields) -> None:
+    allowed = {
+        "name", "goal", "status", "visibility", "autonomy_mode",
+        "max_concurrent_tasks", "worker_model", "queen_cycle_gap_ms",
+        "queen_max_turns", "queen_quiet_from", "queen_quiet_until",
+        "config", "queen_nickname", "allowed_tools",
+    }
+    cols = {k: v for k, v in fields.items() if k in allowed}
+    if not cols:
+        return
+    assignments = ", ".join(f"{k}=?" for k in cols)
+    db.execute(
+        f"UPDATE rooms SET {assignments}, updated_at=? WHERE id=?",
+        (*cols.values(), utc_now(), room_id),
+    )
+
+
+def pause_room(db: Database, room_id: int) -> None:
+    update_room(db, room_id, status="paused")
+
+
+def restart_room(db: Database, room_id: int) -> None:
+    update_room(db, room_id, status="active")
+
+
+def delete_room(db: Database, room_id: int) -> bool:
+    """Deletes the room and everything cascading from it; the queen worker
+    row is removed explicitly (workers have no FK to rooms)."""
+    with db.transaction():
+        db.execute("DELETE FROM workers WHERE room_id=?", (room_id,))
+        return db.execute(
+            "DELETE FROM rooms WHERE id=?", (room_id,)
+        ).rowcount > 0
+
+
+def get_room_status(db: Database, room_id: int) -> Optional[dict]:
+    """Aggregate dashboard view (reference: room.ts getRoomStatus)."""
+    room = get_room(db, room_id)
+    if room is None:
+        return None
+    workers = db.query(
+        "SELECT COUNT(*) AS n FROM workers WHERE room_id=?", (room_id,)
+    )[0]["n"]
+    goals_active = db.query(
+        "SELECT COUNT(*) AS n FROM goals WHERE room_id=? AND status='active'",
+        (room_id,),
+    )[0]["n"]
+    decisions_open = db.query(
+        "SELECT COUNT(*) AS n FROM quorum_decisions WHERE room_id=? "
+        "AND status IN ('announced','voting')",
+        (room_id,),
+    )[0]["n"]
+    escalations_pending = db.query(
+        "SELECT COUNT(*) AS n FROM escalations WHERE room_id=? "
+        "AND status='pending'",
+        (room_id,),
+    )[0]["n"]
+    unread_messages = db.query(
+        "SELECT COUNT(*) AS n FROM room_messages WHERE room_id=? "
+        "AND direction='inbound' AND status='unread'",
+        (room_id,),
+    )[0]["n"]
+    tasks_active = db.query(
+        "SELECT COUNT(*) AS n FROM tasks WHERE room_id=? AND status='active'",
+        (room_id,),
+    )[0]["n"]
+    return {
+        "room": room,
+        "worker_count": workers,
+        "active_goals": goals_active,
+        "open_decisions": decisions_open,
+        "pending_escalations": escalations_pending,
+        "unread_messages": unread_messages,
+        "active_tasks": tasks_active,
+    }

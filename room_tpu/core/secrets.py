@@ -1,0 +1,42 @@
+"""Secret store: AES-256-GCM envelope for credentials and wallet keys
+(reference: src/shared/secret-store.ts — enc:v1: envelope, key derived
+from env override or host identity)."""
+
+from __future__ import annotations
+
+import base64
+import getpass
+import hashlib
+import os
+import socket
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+ENVELOPE_PREFIX = "enc:v1:"
+
+
+def _derive_key(extra: str = "") -> bytes:
+    seed = os.environ.get("ROOM_TPU_SECRET_KEY")
+    if not seed:
+        seed = socket.gethostname() + ":" + getpass.getuser()
+    return hashlib.sha256((seed + extra).encode()).digest()
+
+
+def encrypt_secret(plaintext: str, context: str = "") -> str:
+    key = _derive_key(context)
+    nonce = os.urandom(12)
+    ct = AESGCM(key).encrypt(nonce, plaintext.encode(), None)
+    return ENVELOPE_PREFIX + base64.b64encode(nonce + ct).decode()
+
+
+def decrypt_secret(envelope: str, context: str = "") -> str:
+    if not envelope.startswith(ENVELOPE_PREFIX):
+        raise ValueError("not an encrypted envelope")
+    raw = base64.b64decode(envelope[len(ENVELOPE_PREFIX):])
+    nonce, ct = raw[:12], raw[12:]
+    key = _derive_key(context)
+    return AESGCM(key).decrypt(nonce, ct, None).decode()
+
+
+def is_encrypted(value: str) -> bool:
+    return value.startswith(ENVELOPE_PREFIX)

@@ -1,0 +1,131 @@
+"""Audited self-modification with rate limiting, forbidden paths, and true
+revert from snapshots (reference: src/shared/self-mod.ts)."""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import time
+from typing import Optional
+
+from ..db import Database, utc_now
+from .constants import SELF_MOD_MIN_INTERVAL_S
+
+# Paths agents may never modify: credentials, wallets, env files, and the
+# self-modification machinery itself.
+FORBIDDEN_PATTERNS = [
+    re.compile(p, re.IGNORECASE)
+    for p in (
+        r"secret", r"credential", r"wallet", r"private[_-]?key",
+        r"\.env", r"selfmod", r"self[_-]mod", r"auth\.tokens",
+    )
+]
+
+
+class SelfModError(RuntimeError):
+    pass
+
+
+def _content_hash(content: Optional[str]) -> Optional[str]:
+    if content is None:
+        return None
+    return hashlib.sha256(content.encode()).hexdigest()[:16]
+
+
+def can_modify(db: Database, worker_id: Optional[int], path: str) -> tuple[bool, str]:
+    for pat in FORBIDDEN_PATTERNS:
+        if pat.search(path):
+            return False, f"path {path!r} is protected from self-modification"
+    if worker_id is not None:
+        last = db.query_one(
+            "SELECT created_at FROM self_mod_audit WHERE worker_id=? "
+            "ORDER BY id DESC LIMIT 1",
+            (worker_id,),
+        )
+        if last:
+            # created_at is UTC ISO; compare against now-60s
+            from datetime import datetime, timezone
+
+            then = datetime.strptime(
+                last["created_at"], "%Y-%m-%dT%H:%M:%S.%fZ"
+            ).replace(tzinfo=timezone.utc)
+            age = (datetime.now(timezone.utc) - then).total_seconds()
+            if age < SELF_MOD_MIN_INTERVAL_S:
+                return False, (
+                    f"rate limited: one modification per "
+                    f"{SELF_MOD_MIN_INTERVAL_S}s per worker"
+                )
+    return True, ""
+
+
+def perform_modification(
+    db: Database,
+    room_id: Optional[int],
+    worker_id: Optional[int],
+    target_type: str,
+    target_id: Optional[int],
+    path: str,
+    old_content: Optional[str],
+    new_content: str,
+    reason: str,
+) -> int:
+    """Record the audit row + snapshot, then apply the edit for known
+    target types (currently 'skill')."""
+    ok, why = can_modify(db, worker_id, path)
+    if not ok:
+        raise SelfModError(why)
+    with db.transaction():
+        audit_id = db.insert(
+            "INSERT INTO self_mod_audit(room_id, worker_id, file_path, "
+            "old_hash, new_hash, reason) VALUES (?,?,?,?,?,?)",
+            (
+                room_id, worker_id, path,
+                _content_hash(old_content), _content_hash(new_content),
+                reason,
+            ),
+        )
+        db.insert(
+            "INSERT INTO self_mod_snapshots(audit_id, target_type, "
+            "target_id, old_content, new_content) VALUES (?,?,?,?,?)",
+            (audit_id, target_type, target_id, old_content, new_content),
+        )
+        if target_type == "skill" and target_id is not None:
+            from .skills import update_skill
+
+            update_skill(db, target_id, new_content)
+    return audit_id
+
+
+def revert_modification(db: Database, audit_id: int) -> bool:
+    """Restore the snapshot's old content (reference: true revert of skill
+    content, self-mod.ts:57-84)."""
+    audit = db.query_one(
+        "SELECT * FROM self_mod_audit WHERE id=?", (audit_id,)
+    )
+    snap = db.query_one(
+        "SELECT * FROM self_mod_snapshots WHERE audit_id=?", (audit_id,)
+    )
+    if audit is None or snap is None:
+        return False
+    if audit["reverted"]:
+        return False
+    if not audit["reversible"] or snap["old_content"] is None:
+        raise SelfModError(f"audit {audit_id} is not reversible")
+    with db.transaction():
+        if snap["target_type"] == "skill" and snap["target_id"] is not None:
+            from .skills import update_skill
+
+            update_skill(db, snap["target_id"], snap["old_content"])
+        db.execute(
+            "UPDATE self_mod_audit SET reverted=1 WHERE id=?", (audit_id,)
+        )
+    return True
+
+
+def audit_log(db: Database, room_id: Optional[int] = None) -> list[dict]:
+    if room_id is None:
+        return db.query("SELECT * FROM self_mod_audit ORDER BY id DESC")
+    return db.query(
+        "SELECT * FROM self_mod_audit WHERE room_id=? ORDER BY id DESC",
+        (room_id,),
+    )

@@ -1,0 +1,86 @@
+"""Reusable skills with versioning + context loader caps (reference:
+src/shared/skills.ts — max 8 skills / 6,000 chars injected)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database, utc_now
+from .constants import SKILLS_CONTEXT_MAX, SKILLS_CONTEXT_MAX_CHARS
+
+
+def create_skill(
+    db: Database,
+    name: str,
+    content: str,
+    room_id: Optional[int] = None,
+    activation_context: Optional[str] = None,
+    auto_activate: bool = False,
+    agent_created: bool = False,
+    created_by_worker_id: Optional[int] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO skills(room_id, name, content, activation_context, "
+        "auto_activate, agent_created, created_by_worker_id) "
+        "VALUES (?,?,?,?,?,?,?)",
+        (
+            room_id, name, content, activation_context,
+            int(auto_activate), int(agent_created), created_by_worker_id,
+        ),
+    )
+
+
+def get_skill(db: Database, skill_id: int) -> Optional[dict]:
+    return db.query_one("SELECT * FROM skills WHERE id=?", (skill_id,))
+
+
+def list_skills(db: Database, room_id: Optional[int] = None) -> list[dict]:
+    if room_id is None:
+        return db.query("SELECT * FROM skills ORDER BY id")
+    return db.query(
+        "SELECT * FROM skills WHERE room_id=? OR room_id IS NULL ORDER BY id",
+        (room_id,),
+    )
+
+
+def update_skill(db: Database, skill_id: int, content: str) -> None:
+    db.execute(
+        "UPDATE skills SET content=?, version=version+1, updated_at=? "
+        "WHERE id=?",
+        (content, utc_now(), skill_id),
+    )
+
+
+def delete_skill(db: Database, skill_id: int) -> bool:
+    return db.execute(
+        "DELETE FROM skills WHERE id=?", (skill_id,)
+    ).rowcount > 0
+
+
+def load_skills_for_agent(
+    db: Database, room_id: Optional[int], context_hint: str = ""
+) -> str:
+    """Auto-activating skills rendered for the cycle prompt, capped at 8
+    skills / 6,000 chars. Skills with an activation_context are included
+    only when the hint mentions it."""
+    skills = [
+        s for s in list_skills(db, room_id) if s["auto_activate"]
+    ]
+    hint = context_hint.lower()
+    chosen = []
+    for s in skills:
+        ctx = (s["activation_context"] or "").lower()
+        if ctx and ctx not in hint:
+            continue
+        chosen.append(s)
+        if len(chosen) >= SKILLS_CONTEXT_MAX:
+            break
+    out: list[str] = []
+    used = 0
+    for s in chosen:
+        block = f"## Skill: {s['name']} (v{s['version']})\n{s['content']}\n"
+        if used + len(block) > SKILLS_CONTEXT_MAX_CHARS:
+            break
+        out.append(block)
+        used += len(block)
+    return "\n".join(out)

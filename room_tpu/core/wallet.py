@@ -1,0 +1,167 @@
+"""Per-room EVM wallet (reference: src/shared/wallet.ts).
+
+Key generation and address derivation run fully offline (secp256k1 via the
+cryptography package, Keccak-256 in-tree). Balance reads and ERC-20
+transfers need chain RPC; with no network they fail closed with a clear
+error, mirroring the reference's fail-closed posture for its local model."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from ..db import Database
+from .chains import CHAINS, DEFAULT_CHAIN
+from .keccak import keccak256
+from .secrets import decrypt_secret, encrypt_secret
+
+
+class WalletError(RuntimeError):
+    pass
+
+
+def private_key_to_address(private_key: bytes) -> str:
+    """0x-address = last 20 bytes of keccak256(uncompressed pubkey x||y)."""
+    sk = ec.derive_private_key(
+        int.from_bytes(private_key, "big"), ec.SECP256K1()
+    )
+    nums = sk.public_key().public_numbers()
+    pub = nums.x.to_bytes(32, "big") + nums.y.to_bytes(32, "big")
+    return to_checksum_address("0x" + keccak256(pub)[-20:].hex())
+
+
+def to_checksum_address(address: str) -> str:
+    """EIP-55 mixed-case checksum."""
+    addr = address.lower().replace("0x", "")
+    digest = keccak256(addr.encode()).hex()
+    out = "".join(
+        c.upper() if int(digest[i], 16) >= 8 else c
+        for i, c in enumerate(addr)
+    )
+    return "0x" + out
+
+
+def create_room_wallet(
+    db: Database, room_id: int, chain: str = DEFAULT_CHAIN
+) -> dict:
+    existing = get_room_wallet(db, room_id)
+    if existing:
+        return existing
+    private_key = os.urandom(32)
+    address = private_key_to_address(private_key)
+    encrypted = encrypt_secret(private_key.hex(), context=f"wallet:{room_id}")
+    wid = db.insert(
+        "INSERT INTO wallets(room_id, address, private_key_encrypted, chain) "
+        "VALUES (?,?,?,?)",
+        (room_id, address, encrypted, chain),
+    )
+    return db.query_one("SELECT * FROM wallets WHERE id=?", (wid,))  # type: ignore[return-value]
+
+
+def get_room_wallet(db: Database, room_id: int) -> Optional[dict]:
+    return db.query_one(
+        "SELECT * FROM wallets WHERE room_id=? ORDER BY id LIMIT 1",
+        (room_id,),
+    )
+
+
+def decrypt_wallet_key(wallet: dict) -> bytes:
+    hexkey = decrypt_secret(
+        wallet["private_key_encrypted"], context=f"wallet:{wallet['room_id']}"
+    )
+    return bytes.fromhex(hexkey)
+
+
+def record_transaction(
+    db: Database,
+    wallet_id: int,
+    type_: str,
+    amount: str,
+    counterparty: Optional[str] = None,
+    tx_hash: Optional[str] = None,
+    description: Optional[str] = None,
+    status: str = "confirmed",
+    category: Optional[str] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO wallet_transactions(wallet_id, type, amount, "
+        "counterparty, tx_hash, description, status, category) "
+        "VALUES (?,?,?,?,?,?,?,?)",
+        (
+            wallet_id, type_, amount, counterparty, tx_hash, description,
+            status, category,
+        ),
+    )
+
+
+def list_transactions(db: Database, wallet_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM wallet_transactions WHERE wallet_id=? ORDER BY id DESC",
+        (wallet_id,),
+    )
+
+
+# ---- chain RPC (fail-closed without network) ----
+
+_ERC20_BALANCE_OF = "70a08231"  # balanceOf(address)
+_ERC20_TRANSFER = "a9059cbb"    # transfer(address,uint256)
+
+
+def _rpc(chain: str, method: str, params: list) -> dict:
+    cfg = CHAINS.get(chain)
+    if cfg is None:
+        raise WalletError(f"unknown chain {chain!r}")
+    url = os.environ.get(f"ROOM_TPU_RPC_{chain.upper()}", cfg.rpc_url)
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            out = json.loads(resp.read())
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise WalletError(
+            f"chain RPC unreachable for {chain} ({e}); wallet operations "
+            "requiring the network are unavailable"
+        ) from e
+    if "error" in out:
+        raise WalletError(f"RPC error: {out['error']}")
+    return out["result"]
+
+
+def get_native_balance(db: Database, room_id: int) -> int:
+    wallet = get_room_wallet(db, room_id)
+    if wallet is None:
+        raise WalletError(f"room {room_id} has no wallet")
+    result = _rpc(
+        wallet["chain"], "eth_getBalance", [wallet["address"], "latest"]
+    )
+    return int(result, 16)
+
+
+def get_token_balance(
+    db: Database, room_id: int, token: str = "usdc"
+) -> int:
+    wallet = get_room_wallet(db, room_id)
+    if wallet is None:
+        raise WalletError(f"room {room_id} has no wallet")
+    cfg = CHAINS[wallet["chain"]]
+    token_addr = getattr(cfg, token, None)
+    if not token_addr:
+        raise WalletError(f"no {token} on chain {wallet['chain']}")
+    calldata = (
+        "0x" + _ERC20_BALANCE_OF
+        + wallet["address"][2:].lower().rjust(64, "0")
+    )
+    result = _rpc(
+        wallet["chain"], "eth_call",
+        [{"to": token_addr, "data": calldata}, "latest"],
+    )
+    return int(result, 16) if result not in (None, "0x") else 0

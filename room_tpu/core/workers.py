@@ -1,0 +1,86 @@
+"""Worker CRUD and agent-state bookkeeping."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database, utc_now
+from .constants import WORKER_ROLE_PRESETS
+
+
+def create_worker(
+    db: Database,
+    name: str,
+    system_prompt: str,
+    room_id: Optional[int] = None,
+    role: Optional[str] = None,
+    model: Optional[str] = None,
+    cycle_gap_ms: Optional[int] = None,
+    max_turns: Optional[int] = None,
+    description: Optional[str] = None,
+) -> int:
+    preset = WORKER_ROLE_PRESETS.get(role or "")
+    if preset is not None:
+        if cycle_gap_ms is None:
+            cycle_gap_ms = preset.cycle_gap_ms
+        if max_turns is None:
+            max_turns = preset.max_turns
+        if preset.prompt_prefix not in system_prompt:
+            system_prompt = preset.prompt_prefix + "\n\n" + system_prompt
+    return db.insert(
+        "INSERT INTO workers(name, role, system_prompt, description, model, "
+        "room_id, cycle_gap_ms, max_turns) VALUES (?,?,?,?,?,?,?,?)",
+        (
+            name, role, system_prompt, description, model, room_id,
+            cycle_gap_ms, max_turns,
+        ),
+    )
+
+
+def get_worker(db: Database, worker_id: int) -> Optional[dict]:
+    return db.query_one("SELECT * FROM workers WHERE id=?", (worker_id,))
+
+
+def list_room_workers(db: Database, room_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM workers WHERE room_id=? ORDER BY id", (room_id,)
+    )
+
+
+def update_worker(db: Database, worker_id: int, **fields) -> None:
+    allowed = {
+        "name", "role", "system_prompt", "description", "model",
+        "cycle_gap_ms", "max_turns", "agent_state", "wip",
+    }
+    cols = {k: v for k, v in fields.items() if k in allowed}
+    if not cols:
+        return
+    assignments = ", ".join(f"{k}=?" for k in cols)
+    db.execute(
+        f"UPDATE workers SET {assignments}, updated_at=? WHERE id=?",
+        (*cols.values(), utc_now(), worker_id),
+    )
+
+
+def delete_worker(db: Database, worker_id: int) -> bool:
+    return db.execute(
+        "DELETE FROM workers WHERE id=?", (worker_id,)
+    ).rowcount > 0
+
+
+def set_agent_state(db: Database, worker_id: int, state: str) -> None:
+    db.execute(
+        "UPDATE workers SET agent_state=?, updated_at=? WHERE id=?",
+        (state, utc_now(), worker_id),
+    )
+
+
+def save_wip(db: Database, worker_id: int, wip: Optional[str]) -> None:
+    from .constants import WIP_MAX_CHARS
+
+    if wip is not None:
+        wip = wip[:WIP_MAX_CHARS]
+    db.execute(
+        "UPDATE workers SET wip=?, updated_at=? WHERE id=?",
+        (wip, utc_now(), worker_id),
+    )

@@ -76,7 +76,7 @@ class Database:
             if not fresh:
                 self._conn.executescript(ddl)
             self._conn.execute(
-                "INSERT INTO schema_migrations(version) VALUES (?)",
+                "INSERT OR IGNORE INTO schema_migrations(version) VALUES (?)",
                 (version,),
             )
 
@@ -94,7 +94,12 @@ class Database:
             return self._conn.execute(sql, params)
 
     def insert(self, sql: str, params: tuple | dict = ()) -> int:
-        """Execute an INSERT and return the new rowid."""
+        """Execute an INSERT and return the new rowid.
+
+        Only meaningful for plain INSERTs: when an upsert resolves to its
+        UPDATE branch, sqlite leaves lastrowid at the previous successful
+        insert. Upsert callers must re-select the id instead.
+        """
         with self._lock:
             return int(self._conn.execute(sql, params).lastrowid or 0)
 

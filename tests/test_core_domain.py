@@ -1,0 +1,304 @@
+"""Domain-core tests: rooms, goals, quorum, skills, self-mod, memory,
+escalations, messages, credentials, wallet (offline paths)."""
+
+import numpy as np
+import pytest
+
+from room_tpu.core import (
+    activity, credentials, escalations, goals, memory, messages, quorum,
+    rooms, selfmod, skills, wallet, workers,
+)
+from room_tpu.core.constants import RoomConfig
+
+
+@pytest.fixture()
+def room(db):
+    return rooms.create_room(db, "alpha", goal="ship the thing")
+
+
+def test_create_room_builds_collective(db, room):
+    assert room["queen_worker_id"] is not None
+    queen = workers.get_worker(db, room["queen_worker_id"])
+    assert queen["role"] == "queen"
+    root = goals.get_root_goal(db, room["id"])
+    assert root["description"] == "ship the thing"
+    w = wallet.get_room_wallet(db, room["id"])
+    assert w["address"].startswith("0x") and len(w["address"]) == 42
+
+
+def test_room_status_aggregate(db, room):
+    st = rooms.get_room_status(db, room["id"])
+    assert st["worker_count"] == 1
+    assert st["active_goals"] == 1
+
+
+def test_delete_room_removes_workers(db, room):
+    rooms.delete_room(db, room["id"])
+    assert workers.list_room_workers(db, room["id"]) == []
+    assert rooms.get_room(db, room["id"]) is None
+
+
+# ---- goals ----
+
+def test_goal_tree_and_progress_rollup(db, room):
+    root = goals.get_root_goal(db, room["id"])
+    a = goals.create_goal(db, room["id"], "a", parent_goal_id=root["id"])
+    b = goals.create_goal(db, room["id"], "b", parent_goal_id=root["id"])
+    goals.complete_goal(db, a)
+    assert goals.get_goal(db, root["id"])["progress"] == pytest.approx(0.5)
+    goals.set_goal_progress(db, b, 0.5)
+    assert goals.get_goal(db, root["id"])["progress"] == pytest.approx(0.75)
+    tree = goals.get_goal_tree(db, room["id"])
+    assert len(tree) == 1 and len(tree[0]["children"]) == 2
+
+
+def test_new_objective_abandons_old_root(db, room):
+    old_root = goals.get_root_goal(db, room["id"])
+    goals.set_room_objective(db, room["id"], "new direction")
+    assert goals.get_goal(db, old_root["id"])["status"] == "abandoned"
+    assert goals.get_root_goal(db, room["id"])["description"] == "new direction"
+
+
+# ---- quorum ----
+
+def test_announce_auto_approves_low_impact(db, room):
+    d = quorum.announce(db, room["id"], None, "tidy the docs", "low_impact")
+    assert d["status"] == "approved"
+
+
+def test_announce_object_flow(db, room):
+    d = quorum.announce(db, room["id"], None, "rewrite core", "high_impact")
+    assert d["status"] == "announced"
+    wid = workers.create_worker(db, "w", "p", room_id=room["id"])
+    d2 = quorum.object_to(db, d["id"], wid, "too risky")
+    assert d2["status"] == "objected"
+    with pytest.raises(quorum.QuorumError):
+        quorum.object_to(db, d["id"], wid, "again")
+
+
+def test_announce_becomes_effective_after_deadline(db, room):
+    d = quorum.announce(
+        db, room["id"], None, "migrate db", "high_impact", delay_minutes=0
+    )
+    n = quorum.check_expired_decisions(db)
+    assert n == 1
+    assert quorum.get_decision(db, d["id"])["status"] == "effective"
+
+
+def test_ballot_majority_resolves_early(db, room):
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    w2 = workers.create_worker(db, "w2", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "buy domain")
+    # electorate = queen + w1 + w2 = 3, majority needs 2
+    quorum.vote(db, d["id"], w1, "yes")
+    assert quorum.get_decision(db, d["id"])["status"] == "voting"
+    quorum.vote(db, d["id"], w2, "yes")
+    assert quorum.get_decision(db, d["id"])["status"] == "passed"
+
+
+def test_keeper_vote_on_announcement(db, room):
+    d = quorum.announce(db, room["id"], None, "risky", "high_impact")
+    d2 = quorum.keeper_vote(db, d["id"], "no")
+    assert d2["status"] == "objected"
+
+
+# ---- memory ----
+
+def test_remember_and_fts_recall(db, room):
+    memory.remember(
+        db, "deploy runbook", "use blue-green on fridays",
+        room_id=room["id"],
+    )
+    hits = memory.fts_search(db, "blue-green runbook", room_id=room["id"])
+    assert hits and hits[0]["name"] == "deploy runbook"
+
+
+def test_fts_handles_hostile_query(db, room):
+    memory.remember(db, "x", "y", room_id=room["id"])
+    assert memory.fts_search(db, '"unbalanced AND (', room_id=room["id"]) \
+        is not None  # must not raise
+
+
+def test_hybrid_search_rrf_merges(db, room):
+    e1 = memory.remember(db, "tpu sharding", "mesh is 2x4", room_id=room["id"])
+    e2 = memory.remember(db, "lunch spot", "tacos on 3rd", room_id=room["id"])
+    memory.store_embedding(db, e1, "tpu sharding", np.ones(8))
+    memory.store_embedding(db, e2, "lunch spot", -np.ones(8))
+    out = memory.hybrid_search(
+        db, "tpu sharding", query_vector=np.ones(8), room_id=room["id"]
+    )
+    assert out[0]["entity_id"] == e1
+    assert out[0]["observations"] == ["mesh is 2x4"]
+
+
+def test_embedding_room_scope_includes_global(db, room):
+    eg = memory.remember(db, "global fact", "applies everywhere")
+    memory.store_embedding(db, eg, "global fact", np.ones(4))
+    mat, ids = memory.embedding_matrix(db, room_id=room["id"])
+    assert eg in ids
+
+
+def test_indexer_queue_tracks_staleness(db, room):
+    e = memory.remember(db, "fresh", "one", room_id=room["id"])
+    queue = memory.entities_needing_embedding(db)
+    assert e in [q["id"] for q in queue]
+    memory.store_embedding(db, e, "fresh one", np.ones(4))
+    assert e not in [q["id"] for q in memory.entities_needing_embedding(db)]
+    memory.add_observation(db, e, "two")  # re-dirty
+    assert e in [q["id"] for q in memory.entities_needing_embedding(db)]
+
+
+# ---- skills + self-mod ----
+
+def test_skill_context_loader_caps(db, room):
+    for i in range(12):
+        skills.create_skill(
+            db, f"s{i}", "x" * 400, room_id=room["id"], auto_activate=True
+        )
+    ctx = skills.load_skills_for_agent(db, room["id"])
+    assert ctx.count("## Skill:") <= 8
+    assert len(ctx) <= 6000
+
+
+def test_selfmod_forbidden_and_ratelimit(db, room):
+    wid = workers.create_worker(db, "w", "p", room_id=room["id"])
+    ok, why = selfmod.can_modify(db, wid, "wallets/keys.json")
+    assert not ok and "protected" in why
+    sid = skills.create_skill(db, "s", "v1", room_id=room["id"])
+    selfmod.perform_modification(
+        db, room["id"], wid, "skill", sid, "skills/s", "v1", "v2", "improve"
+    )
+    assert skills.get_skill(db, sid)["content"] == "v2"
+    with pytest.raises(selfmod.SelfModError):
+        selfmod.perform_modification(
+            db, room["id"], wid, "skill", sid, "skills/s", "v2", "v3", "again"
+        )
+
+
+def test_selfmod_revert_restores_snapshot(db, room):
+    sid = skills.create_skill(db, "s", "v1", room_id=room["id"])
+    aid = selfmod.perform_modification(
+        db, room["id"], None, "skill", sid, "skills/s", "v1", "v2", "r"
+    )
+    assert selfmod.revert_modification(db, aid)
+    assert skills.get_skill(db, sid)["content"] == "v1"
+    assert not selfmod.revert_modification(db, aid)  # only once
+
+
+# ---- escalations + messages + credentials ----
+
+def test_escalation_lifecycle(db, room):
+    eid = escalations.create_escalation(db, room["id"], "may I buy a domain?")
+    assert len(escalations.pending_escalations(db, room["id"])) == 1
+    escalations.answer_escalation(db, eid, "yes, under $20")
+    assert escalations.pending_escalations(db, room["id"]) == []
+    assert escalations.recently_answered(db, room["id"])[0]["answer"] \
+        == "yes, under $20"
+
+
+def test_inter_room_messaging(db, room):
+    other = rooms.create_room(db, "beta", create_wallet=False)
+    messages.send_room_message(
+        db, room["id"], other["id"], "hello", "let's collaborate"
+    )
+    unread = messages.unread_messages(db, other["id"])
+    assert len(unread) == 1 and unread[0]["subject"] == "hello"
+    messages.mark_message_read(db, unread[0]["id"])
+    assert messages.unread_messages(db, other["id"]) == []
+
+
+def test_chat_inbox_poll(db, room):
+    messages.add_chat_message(db, room["id"], "user", "status?")
+    assert len(messages.unanswered_keeper_messages(db, room["id"])) == 1
+    messages.add_chat_message(db, room["id"], "assistant", "all good")
+    assert messages.unanswered_keeper_messages(db, room["id"]) == []
+
+
+def test_credential_resolution_chain(db, room, monkeypatch):
+    monkeypatch.setenv("SOME_API_KEY", "from-env")
+    assert credentials.resolve_api_key(db, "SOME_API_KEY", room["id"]) \
+        == "from-env"
+    messages.set_setting(db, "SOME_API_KEY", "from-settings")
+    assert credentials.resolve_api_key(db, "SOME_API_KEY", room["id"]) \
+        == "from-settings"
+    credentials.store_credential(db, room["id"], "SOME_API_KEY", "from-room")
+    assert credentials.resolve_api_key(db, "SOME_API_KEY", room["id"]) \
+        == "from-room"
+    # stored values are encrypted at rest
+    raw = db.query_one("SELECT value_encrypted FROM credentials")
+    assert raw["value_encrypted"].startswith("enc:v1:")
+
+
+# ---- wallet (offline) ----
+
+def test_wallet_key_roundtrip_and_checksum(db, room):
+    w = wallet.get_room_wallet(db, room["id"])
+    key = wallet.decrypt_wallet_key(w)
+    assert len(key) == 32
+    assert wallet.private_key_to_address(key) == w["address"]
+    # EIP-55 known vector
+    assert wallet.to_checksum_address(
+        "0x5aaeb6053f3e94c9b9a09f33669435e7ef1beaed"
+    ) == "0x5aAeb6053F3E94C9b9A09f33669435E7Ef1BeAed"
+
+
+def test_wallet_rpc_fails_closed(db, room, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_RPC_BASE", "http://127.0.0.1:1")
+    with pytest.raises(wallet.WalletError, match="unreachable"):
+        wallet.get_native_balance(db, room["id"])
+
+
+def test_keccak_known_vectors():
+    from room_tpu.core.keccak import keccak256
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+
+
+def test_public_feed_requires_public_room(db, room):
+    activity.log_room_activity(db, room["id"], "note", "hi")
+    assert activity.get_public_feed(db) == []
+    rooms.update_room(db, room["id"], visibility="public")
+    assert len(activity.get_public_feed(db)) >= 1
+
+
+def test_vote_change_does_not_inflate_participation(db, room):
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    for _ in range(2):
+        workers.create_worker(db, "x", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "p")
+    quorum.vote(db, d["id"], w1, "abstain")
+    quorum.vote(db, d["id"], w1, "yes")
+    assert workers.get_worker(db, w1)["votes_cast"] == 1
+
+
+def test_keeper_vote_resolves_ballot(db, room):
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "p")  # electorate 2, need 2
+    quorum.vote(db, d["id"], w1, "yes")
+    d2 = quorum.keeper_vote(db, d["id"], "yes")
+    assert d2["status"] == "passed"
+
+
+def test_upsert_returns_real_ids(db, room):
+    cid1 = credentials.store_credential(db, room["id"], "K", "v1")
+    db.insert("INSERT INTO rooms(name) VALUES ('decoy')")
+    cid2 = credentials.store_credential(db, room["id"], "K", "v2")
+    assert cid1 == cid2
+    e = memory.remember(db, "e", "o", room_id=room["id"])
+    r1 = memory.store_embedding(db, e, "t", np.ones(4))
+    db.insert("INSERT INTO rooms(name) VALUES ('decoy2')")
+    r2 = memory.store_embedding(db, e, "t2", np.zeros(4))
+    assert r1 == r2
+
+
+def test_explicit_zero_overrides_preset(db, room):
+    wid = workers.create_worker(
+        db, "e", "p", room_id=room["id"], role="executor",
+        cycle_gap_ms=0, max_turns=0,
+    )
+    w = workers.get_worker(db, wid)
+    assert w["cycle_gap_ms"] == 0 and w["max_turns"] == 0
