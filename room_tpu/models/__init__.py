@@ -1,0 +1,25 @@
+from . import config, embedder, qwen3
+from .config import (
+    DecoderConfig,
+    EncoderConfig,
+    minilm_384,
+    qwen2_72b,
+    qwen3_coder_30b,
+    tiny_dense,
+    tiny_encoder,
+    tiny_moe,
+)
+
+__all__ = [
+    "config",
+    "embedder",
+    "qwen3",
+    "DecoderConfig",
+    "EncoderConfig",
+    "minilm_384",
+    "qwen2_72b",
+    "qwen3_coder_30b",
+    "tiny_dense",
+    "tiny_encoder",
+    "tiny_moe",
+]

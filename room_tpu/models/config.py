@@ -1,0 +1,160 @@
+"""Model configurations.
+
+Flagship serving targets (BASELINE.md): qwen3-coder-30B (MoE, the worker
+model) and Qwen2.5-72B (dense, the hetero-swarm queen), plus a 384-d
+MiniLM-class embedder for semantic memory. Tiny variants of each exist for
+hermetic tests and the virtual-device dry runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    name: str
+    vocab_size: int
+    hidden: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    intermediate: int               # dense FFN width (MoE: unused)
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-6
+    tie_embeddings: bool = False
+    qkv_bias: bool = False          # Qwen2 yes, Qwen3 no
+    qk_norm: bool = True            # Qwen3 per-head q/k RMSNorm
+    # MoE (None => dense)
+    n_experts: Optional[int] = None
+    top_k: int = 8
+    moe_intermediate: int = 0
+    norm_topk_prob: bool = True
+    dtype: str = "bfloat16"
+    max_seq_len: int = 32768
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts is not None
+
+    @property
+    def activation_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+
+def qwen3_coder_30b() -> DecoderConfig:
+    """qwen3-coder-30B (30B-A3B MoE): the pinned worker model — the tpu:
+    provider's default, replacing the reference's `qwen3-coder:30b` Ollama
+    tag (reference: src/shared/local-model.ts:3-5)."""
+    return DecoderConfig(
+        name="qwen3-coder-30b",
+        vocab_size=151_936,
+        hidden=2048,
+        n_layers=48,
+        n_heads=32,
+        n_kv_heads=4,
+        head_dim=128,
+        intermediate=0,
+        rope_theta=1e7,
+        qkv_bias=False,
+        qk_norm=True,
+        n_experts=128,
+        top_k=8,
+        moe_intermediate=768,
+    )
+
+
+def qwen2_72b() -> DecoderConfig:
+    """Qwen2.5-72B dense: the hetero-swarm queen model (BASELINE.md
+    config #5)."""
+    return DecoderConfig(
+        name="qwen2.5-72b",
+        vocab_size=152_064,
+        hidden=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        head_dim=128,
+        intermediate=29_568,
+        rope_theta=1e6,
+        qkv_bias=True,
+        qk_norm=False,
+    )
+
+
+def tiny_moe(vocab_size: int = 512) -> DecoderConfig:
+    """Hermetic-test stand-in with the 30B's *shape* (MoE, GQA, qk-norm)."""
+    return DecoderConfig(
+        name="tiny-moe",
+        vocab_size=vocab_size,
+        hidden=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        intermediate=0,
+        rope_theta=1e4,
+        qk_norm=True,
+        n_experts=8,
+        top_k=2,
+        moe_intermediate=32,
+        dtype="float32",
+        max_seq_len=512,
+    )
+
+
+def tiny_dense(vocab_size: int = 512) -> DecoderConfig:
+    return DecoderConfig(
+        name="tiny-dense",
+        vocab_size=vocab_size,
+        hidden=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        intermediate=128,
+        rope_theta=1e4,
+        qkv_bias=True,
+        qk_norm=False,
+        dtype="float32",
+        max_seq_len=512,
+    )
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """Bidirectional encoder for the 384-d memory embedder (the reference
+    ran all-MiniLM-L6-v2 on CPU ONNX; here it is a JAX model on the mesh —
+    reference: src/shared/embeddings.ts:33-69)."""
+    name: str = "tpu-embed-384"
+    vocab_size: int = 30_522
+    hidden: int = 384
+    n_layers: int = 6
+    n_heads: int = 12
+    intermediate: int = 1536
+    max_positions: int = 512
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+
+
+def minilm_384() -> EncoderConfig:
+    return EncoderConfig()
+
+
+def tiny_encoder() -> EncoderConfig:
+    return EncoderConfig(
+        name="tiny-embed", vocab_size=256, hidden=32, n_layers=2,
+        n_heads=4, intermediate=64, max_positions=128,
+    )

@@ -1,0 +1,120 @@
+"""384-d bidirectional text encoder (MiniLM-class) in functional JAX.
+
+Replaces the reference's CPU ONNX all-MiniLM-L6-v2 pipeline (reference:
+src/shared/embeddings.ts:33-100) with an XLA model that lives on the same
+mesh as the LLM. Mean-pooled, L2-normalized sentence vectors; weights map
+onto the upstream BERT-style checkpoint (word+position+type embeddings,
+post-LN transformer, GELU FFN).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import attention_ref
+from .config import EncoderConfig
+
+Params = dict[str, Any]
+
+
+def init_params(cfg: EncoderConfig, key: jax.Array) -> Params:
+    dt = jnp.dtype(cfg.dtype)
+    ks = jax.random.split(key, 9)
+    D, L, F = cfg.hidden, cfg.n_layers, cfg.intermediate
+    s = 1.0 / np.sqrt(D)
+
+    def n(k, shape, scale=s):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "word_embed": n(ks[0], (cfg.vocab_size, D), 0.02),
+        "pos_embed": n(ks[1], (cfg.max_positions, D), 0.02),
+        "type_embed": n(ks[2], (2, D), 0.02),
+        "embed_ln_scale": jnp.ones((D,), dt),
+        "embed_ln_bias": jnp.zeros((D,), dt),
+        "layers": {
+            "wq": n(ks[3], (L, D, D)),
+            "bq": jnp.zeros((L, D), dt),
+            "wk": n(ks[4], (L, D, D)),
+            "bk": jnp.zeros((L, D), dt),
+            "wv": n(ks[5], (L, D, D)),
+            "bv": jnp.zeros((L, D), dt),
+            "wo": n(ks[6], (L, D, D)),
+            "bo": jnp.zeros((L, D), dt),
+            "attn_ln_scale": jnp.ones((L, D), dt),
+            "attn_ln_bias": jnp.zeros((L, D), dt),
+            "w_in": n(ks[7], (L, D, F)),
+            "b_in": jnp.zeros((L, F), dt),
+            "w_out": n(ks[8], (L, F, D)),
+            "b_out": jnp.zeros((L, D), dt),
+            "ffn_ln_scale": jnp.ones((L, D), dt),
+            "ffn_ln_bias": jnp.zeros((L, D), dt),
+        },
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def encode(
+    params: Params,
+    cfg: EncoderConfig,
+    tokens: jax.Array,      # [B, S] int32
+    mask: jax.Array,        # [B, S] 1 for real tokens
+) -> jax.Array:
+    """Sentence embeddings [B, hidden]: mean-pool over valid tokens, then
+    L2-normalize."""
+    b, s = tokens.shape
+    dh = cfg.hidden // cfg.n_heads
+    x = (
+        params["word_embed"][tokens]
+        + params["pos_embed"][jnp.arange(s)][None]
+        + params["type_embed"][0][None, None]
+    )
+    x = _layer_norm(
+        x, params["embed_ln_scale"], params["embed_ln_bias"],
+        cfg.layer_norm_eps,
+    )
+    kv_mask = mask.astype(bool)
+
+    def body(x, lp):
+        def proj(w, bias):
+            return (jnp.einsum("bsd,de->bse", x, w) + bias).reshape(
+                b, s, cfg.n_heads, dh
+            )
+
+        q, k, v = proj(lp["wq"], lp["bq"]), proj(lp["wk"], lp["bk"]), \
+            proj(lp["wv"], lp["bv"])
+        ctx = attention_ref(q, k, v, causal=False, kv_mask=kv_mask)
+        ctx = ctx.reshape(b, s, cfg.hidden).astype(x.dtype)
+        attn_out = jnp.einsum("bsd,de->bse", ctx, lp["wo"]) + lp["bo"]
+        x = _layer_norm(
+            x + attn_out, lp["attn_ln_scale"], lp["attn_ln_bias"],
+            cfg.layer_norm_eps,
+        )
+        h = jax.nn.gelu(
+            jnp.einsum("bsd,df->bsf", x, lp["w_in"]) + lp["b_in"]
+        )
+        h = jnp.einsum("bsf,fd->bsd", h, lp["w_out"]) + lp["b_out"]
+        x = _layer_norm(
+            x + h, lp["ffn_ln_scale"], lp["ffn_ln_bias"],
+            cfg.layer_norm_eps,
+        )
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    m = mask[..., None].astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1e-9)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )

@@ -1,0 +1,260 @@
+"""Qwen3/Qwen2-family decoder in functional JAX.
+
+One implementation serves both flagship models (qwen3-coder-30B MoE and
+Qwen2.5-72B dense) — the config toggles MoE, qk-norm, and qkv-bias.
+
+TPU-first design choices:
+- Layer parameters are *stacked* along a leading [L, ...] axis and the
+  forward pass is a ``lax.scan`` over layers: one traced layer body
+  regardless of depth, so the 48-layer model compiles as fast as the
+  2-layer test model.
+- Activations stay in bf16; norms/softmax/rope accumulate in fp32.
+- The KV cache is a dense [L, B, Smax, Hkv, Dh] pair updated with
+  per-batch scatter writes; the serving engine swaps in its paged cache
+  by passing a custom ``attention_fn`` (same contract as
+  ops.attention_ref).
+
+Weights map 1:1 onto the upstream checkpoints' tensors (q/k/v/o, gate/up/
+down, router, per-head q/k norms) so a converter can load the real 30B.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import apply_rope, attention_ref, moe_ffn, rms_norm, rope_angles, swiglu
+from .config import DecoderConfig
+
+Params = dict[str, Any]
+
+
+# ---- init ----
+
+def _normal(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(cfg: DecoderConfig, key: jax.Array) -> Params:
+    """Random-init parameter pytree (layer axes stacked at dim 0)."""
+    dt = cfg.activation_dtype
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    scale = 1.0 / np.sqrt(cfg.hidden)
+    lk = jax.random.split(k_layers, 12)
+    L, D, Hq, Hkv, Dh = (
+        cfg.n_layers, cfg.hidden, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    )
+
+    layers: Params = {
+        "wq": _normal(lk[0], (L, D, Hq * Dh), scale, dt),
+        "wk": _normal(lk[1], (L, D, Hkv * Dh), scale, dt),
+        "wv": _normal(lk[2], (L, D, Hkv * Dh), scale, dt),
+        "wo": _normal(lk[3], (L, Hq * Dh, D), scale, dt),
+        "ln1": jnp.ones((L, D), dt),
+        "ln2": jnp.ones((L, D), dt),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, Hq * Dh), dt)
+        layers["bk"] = jnp.zeros((L, Hkv * Dh), dt)
+        layers["bv"] = jnp.zeros((L, Hkv * Dh), dt)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, Dh), dt)
+        layers["k_norm"] = jnp.ones((L, Dh), dt)
+    if cfg.is_moe:
+        E, F = cfg.n_experts, cfg.moe_intermediate
+        layers["router"] = _normal(lk[4], (L, D, E), scale, jnp.float32)
+        layers["w_gate"] = _normal(lk[5], (L, E, D, F), scale, dt)
+        layers["w_up"] = _normal(lk[6], (L, E, D, F), scale, dt)
+        layers["w_down"] = _normal(
+            lk[7], (L, E, F, D), 1.0 / np.sqrt(F), dt
+        )
+    else:
+        F = cfg.intermediate
+        layers["w_gate"] = _normal(lk[5], (L, D, F), scale, dt)
+        layers["w_up"] = _normal(lk[6], (L, D, F), scale, dt)
+        layers["w_down"] = _normal(lk[7], (L, F, D), 1.0 / np.sqrt(F), dt)
+
+    params: Params = {
+        "embed": _normal(k_embed, (cfg.vocab_size, D), 1.0, dt),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _normal(k_head, (D, cfg.vocab_size), scale, dt)
+    return params
+
+
+# ---- KV cache ----
+
+def init_kv_cache(
+    cfg: DecoderConfig, batch: int, max_len: int, dtype=None
+) -> Params:
+    dt = dtype or cfg.activation_dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dt),
+        "v": jnp.zeros(shape, dt),
+        "lengths": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+# ---- forward ----
+
+AttentionFn = Callable[..., jax.Array]
+
+
+def _layer(
+    cfg: DecoderConfig,
+    attention_fn: AttentionFn,
+    x: jax.Array,                 # [B, S, D]
+    lp: Params,                   # this layer's params (leading axis removed)
+    cos: jax.Array,
+    sin: jax.Array,
+    layer_cache: Optional[Params],  # {"k","v"} [B, Smax, Hkv, Dh] or None
+    write_pos: Optional[jax.Array],  # [B, S] absolute positions to write
+    kv_mask: Optional[jax.Array],
+    q_positions: jax.Array,
+) -> tuple[jax.Array, Optional[Params]]:
+    b, s, d = x.shape
+    h = rms_norm(x, lp["ln1"], cfg.rms_eps)
+    q = jnp.einsum("bsd,de->bse", h, lp["wq"])
+    k = jnp.einsum("bsd,de->bse", h, lp["wk"])
+    v = jnp.einsum("bsd,de->bse", h, lp["wv"])
+    if cfg.qkv_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_cache = None
+    if layer_cache is not None:
+        # scatter this chunk into the cache at its absolute positions
+        bidx = jnp.arange(b)[:, None]
+        ck = layer_cache["k"].at[bidx, write_pos].set(k)
+        cv = layer_cache["v"].at[bidx, write_pos].set(v)
+        new_cache = {"k": ck, "v": cv}
+        kv_len = ck.shape[1]
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(kv_len)[None], (b, kv_len)
+        )
+        attn = attention_fn(
+            q, ck, cv, causal=True, q_positions=q_positions,
+            kv_positions=kv_positions, kv_mask=kv_mask,
+        )
+    else:
+        attn = attention_fn(
+            q, k, v, causal=True, q_positions=q_positions,
+            kv_positions=q_positions, kv_mask=None,
+        )
+
+    attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    x = x + jnp.einsum("bse,ed->bsd", attn, lp["wo"])
+
+    h = rms_norm(x, lp["ln2"], cfg.rms_eps)
+    if cfg.is_moe:
+        flat = h.reshape(b * s, d)
+        y = moe_ffn(
+            flat, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            top_k=cfg.top_k, renormalize=cfg.norm_topk_prob,
+        ).reshape(b, s, d)
+    else:
+        y = swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+    return x + y, new_cache
+
+
+def forward(
+    params: Params,
+    cfg: DecoderConfig,
+    tokens: jax.Array,                     # [B, S]
+    positions: Optional[jax.Array] = None,  # [B, S] absolute positions
+    kv_cache: Optional[Params] = None,
+    attention_fn: AttentionFn = attention_ref,
+) -> tuple[jax.Array, Optional[Params]]:
+    """Run the decoder. Returns (logits [B, S, V], updated cache or None).
+
+    Without a cache this is plain causal prefill/training. With a cache,
+    ``positions`` gives each token's absolute slot; cached entries at
+    positions < per-batch length are attended to (prefix continuation /
+    single-token decode are the same code path).
+    """
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    x = params["embed"][tokens]
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    kv_mask = None
+    if kv_cache is not None:
+        # Capacity is the caller's contract (the serving engine's admission
+        # control never schedules past max_len). Inside jit we can't raise,
+        # so out-of-range writes are dropped by scatter semantics and
+        # lengths is clamped to stay bounded.
+        max_len = kv_cache["k"].shape[2]
+        new_lengths = jnp.minimum(
+            jnp.maximum(kv_cache["lengths"], positions.max(axis=1) + 1),
+            max_len,
+        )
+        kv_mask = (
+            jnp.arange(max_len)[None] < new_lengths[:, None]
+        )
+
+    def body(carry, xs):
+        x = carry
+        lp, layer_cache = xs
+        x, new_layer_cache = _layer(
+            cfg, attention_fn, x, lp, cos, sin, layer_cache,
+            positions if kv_cache is not None else None,
+            kv_mask, positions,
+        )
+        return x, new_layer_cache
+
+    if kv_cache is None:
+        x, _ = jax.lax.scan(
+            lambda c, lp: (body(c, (lp, None))[0], None),
+            x, params["layers"],
+        )
+        new_cache = None
+    else:
+        x, new_kv = jax.lax.scan(
+            body, x, (params["layers"], {"k": kv_cache["k"],
+                                         "v": kv_cache["v"]}),
+        )
+        new_cache = {
+            "k": new_kv["k"], "v": new_kv["v"], "lengths": new_lengths,
+        }
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return logits, new_cache
+
+
+def decode_step(
+    params: Params,
+    cfg: DecoderConfig,
+    tokens: jax.Array,          # [B] next token per sequence
+    kv_cache: Params,
+    attention_fn: AttentionFn = attention_ref,
+) -> tuple[jax.Array, Params]:
+    """One continuous-decode step: append each sequence's token at its
+    current length. Returns (logits [B, V], cache)."""
+    positions = kv_cache["lengths"][:, None]
+    logits, new_cache = forward(
+        params, cfg, tokens[:, None], positions, kv_cache, attention_fn
+    )
+    return logits[:, 0], new_cache
+
+
+def param_count(params: Params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -1,0 +1,17 @@
+from .core import (
+    apply_rope,
+    attention_ref,
+    moe_ffn,
+    rms_norm,
+    rope_angles,
+    swiglu,
+)
+
+__all__ = [
+    "apply_rope",
+    "attention_ref",
+    "moe_ffn",
+    "rms_norm",
+    "rope_angles",
+    "swiglu",
+]

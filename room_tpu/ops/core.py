@@ -1,0 +1,164 @@
+"""Core model ops, XLA-first.
+
+These are the reference implementations every kernel must match: plain
+jnp/lax compositions that XLA fuses well on TPU (bf16 matmuls on the MXU,
+elementwise fused into them). Pallas kernels in room_tpu.ops.pallas_*
+override the hot paths (paged attention decode) and are tested against
+these.
+
+Conventions: activations are [batch, seq, heads, head_dim] ("BSHD"),
+weights live in dicts of jnp arrays, everything is jit-traceable with
+static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in fp32 accumulation, cast back to input dtype."""
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(orig_dtype)
+
+
+def rope_angles(
+    positions: jax.Array, head_dim: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables for rotary embeddings: [..., head_dim//2]."""
+    freqs = 1.0 / (
+        theta
+        ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array
+) -> jax.Array:
+    """Rotate [B, S, H, D] by per-position cos/sin [B, S, D//2].
+
+    Uses the half-split convention (first/second half pairing) used by the
+    Qwen/Llama families."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :].astype(jnp.float32)
+    s = sin[:, :, None, :].astype(jnp.float32)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1f * c - x2f * s, x2f * c + x1f * s], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU FFN: down( silu(x@gate) * (x@up) )."""
+    g = jax.nn.silu(jnp.einsum("...d,df->...f", x, w_gate))
+    u = jnp.einsum("...d,df->...f", x, w_up)
+    return jnp.einsum("...f,fd->...d", g * u, w_down)
+
+
+def attention_ref(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_positions: jax.Array | None = None,
+    kv_positions: jax.Array | None = None,
+    kv_mask: jax.Array | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """XLA reference attention with grouped query heads.
+
+    q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] with Hq a multiple of Hkv.
+    Masking uses positions so the same code serves prefill (Sq == Skv) and
+    single-token decode against a longer cache (Sq == 1). kv_mask marks
+    valid cache slots ([B, Skv] bool).
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    group = hq // hkv
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(d))
+
+    qg = q.reshape(b, sq, hkv, group, d)
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
+        k.astype(jnp.float32),
+    ) * scale
+
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq)[None], (b, sq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(skv)[None], (b, skv))
+
+    mask = jnp.ones((b, sq, skv), dtype=bool)
+    if causal:
+        mask &= kv_positions[:, None, :] <= q_positions[:, :, None]
+    if kv_mask is not None:
+        mask &= kv_mask[:, None, :]
+    logits = jnp.where(
+        mask[:, None, None, :, :], logits, jnp.float32(-1e30)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs, v.astype(jnp.float32)
+    )
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int,
+    renormalize: bool = True,
+    precision: jax.lax.Precision | None = None,
+) -> jax.Array:
+    """Mixture-of-experts SwiGLU via sort-based dispatch + grouped matmul.
+
+    x: [T, D] tokens; router_w: [D, E]; expert weights [E, D, F] / [E, F, D].
+    Tokens are sorted by assigned expert and pushed through
+    ``lax.ragged_dot`` (TPU grouped matmul), then combined with router
+    weights. All shapes static: T*top_k rows regardless of routing.
+    """
+    t, d = x.shape
+    e = router_w.shape[-1]
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    weights, chosen = jax.lax.top_k(logits, top_k)  # [T, K]
+    weights = jax.nn.softmax(weights, axis=-1) if renormalize else \
+        jax.nn.softmax(logits, axis=-1)[
+            jnp.arange(t)[:, None], chosen
+        ]
+
+    flat_expert = chosen.reshape(-1)              # [T*K]
+    order = jnp.argsort(flat_expert)              # stable
+    token_of_row = order // top_k                 # source token per row
+    xs = x[token_of_row]                          # [T*K, D] sorted by expert
+    group_sizes = jnp.bincount(flat_expert, length=e)
+
+    g = jax.lax.ragged_dot(xs, w_gate, group_sizes, precision=precision)
+    u = jax.lax.ragged_dot(xs, w_up, group_sizes, precision=precision)
+    h = jax.nn.silu(g) * u
+    y = jax.lax.ragged_dot(
+        h.astype(x.dtype), w_down, group_sizes, precision=precision
+    )
+
+    # scatter-add rows back to their tokens, weighted by router prob
+    w_sorted = weights.reshape(-1)[order].astype(y.dtype)
+    out = jnp.zeros((t, y.shape[-1]), dtype=jnp.float32)
+    out = out.at[token_of_row].add(
+        y.astype(jnp.float32) * w_sorted[:, None].astype(jnp.float32)
+    )
+    return out.astype(x.dtype)

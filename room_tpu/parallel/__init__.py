@@ -1,0 +1,17 @@
+from .mesh import (
+    MeshSpec,
+    decoder_param_specs,
+    encoder_param_specs,
+    kv_cache_specs,
+    make_mesh,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshSpec",
+    "decoder_param_specs",
+    "encoder_param_specs",
+    "kv_cache_specs",
+    "make_mesh",
+    "shard_pytree",
+]

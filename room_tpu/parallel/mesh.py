@@ -1,0 +1,146 @@
+"""Device mesh construction and sharding rules.
+
+The communication backend of the framework is XLA collectives over the
+mesh (ICI within a slice, DCN across slices) — the role NCCL/MPI plays in
+GPU frameworks; the reference had no collective backend at all (SURVEY.md
+§2.7). Axes:
+
+- ``dp``: data/batch parallelism (independent sequences; no collectives
+  on the forward path)
+- ``ep``: expert parallelism for MoE layers (all-to-all style exchange,
+  delegated to XLA's SPMD partitioner from sharding annotations)
+- ``tp``: tensor parallelism (megatron-style head/ffn sharding;
+  all-reduce on the residual stream)
+
+A 2D mesh is the workhorse: v5e-8 serving the 30B runs (dp=1, ep=4,
+tp=2) or (ep=8,); the 72B queen runs pure tp. Sequence parallelism for
+long context lives in room_tpu.parallel.ring (its own axis over
+shard_map).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import DecoderConfig, EncoderConfig
+
+AXES = ("dp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.ep * self.tp
+
+
+def make_mesh(
+    spec: MeshSpec, devices: Optional[list] = None
+) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    if len(devs) < spec.n_devices:
+        raise ValueError(
+            f"mesh {spec} needs {spec.n_devices} devices, have {len(devs)}"
+        )
+    arr = np.array(devs[: spec.n_devices]).reshape(spec.dp, spec.ep, spec.tp)
+    return Mesh(arr, AXES)
+
+
+# ---- sharding rules ----
+
+def decoder_param_specs(cfg: DecoderConfig) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.qwen3.init_params.
+
+    Layer-stacked arrays lead with the (unsharded) layer axis. Attention
+    and dense-FFN weights shard megatron-style over ``tp``; expert weights
+    shard over ``ep`` on the expert axis and ``tp`` on the hidden-expansion
+    axis; the vocab axes of embed/lm_head shard over ``tp``.
+    """
+    layers: dict[str, Any] = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "ln1": P(None, None),
+        "ln2": P(None, None),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = P(None, "tp")
+        layers["bk"] = P(None, "tp")
+        layers["bv"] = P(None, "tp")
+    if cfg.qk_norm:
+        layers["q_norm"] = P(None, None)
+        layers["k_norm"] = P(None, None)
+    if cfg.is_moe:
+        layers["router"] = P(None, None, None)
+        layers["w_gate"] = P(None, "ep", None, "tp")
+        layers["w_up"] = P(None, "ep", None, "tp")
+        layers["w_down"] = P(None, "ep", "tp", None)
+    else:
+        layers["w_gate"] = P(None, None, "tp")
+        layers["w_up"] = P(None, None, "tp")
+        layers["w_down"] = P(None, "tp", None)
+    specs: dict[str, Any] = {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def kv_cache_specs(cfg: DecoderConfig) -> dict[str, Any]:
+    """Cache shards over batch (dp); KV heads are few (GQA), so they stay
+    replicated across tp rather than forcing head-count divisibility."""
+    return {
+        "k": P(None, "dp", None, None, None),
+        "v": P(None, "dp", None, None, None),
+        "lengths": P("dp"),
+    }
+
+
+def encoder_param_specs(cfg: EncoderConfig) -> dict[str, Any]:
+    return {
+        "word_embed": P("tp", None),
+        "pos_embed": P(None, None),
+        "type_embed": P(None, None),
+        "embed_ln_scale": P(None),
+        "embed_ln_bias": P(None),
+        "layers": {
+            "wq": P(None, None, "tp"),
+            "bq": P(None, "tp"),
+            "wk": P(None, None, "tp"),
+            "bk": P(None, "tp"),
+            "wv": P(None, None, "tp"),
+            "bv": P(None, "tp"),
+            "wo": P(None, "tp", None),
+            "bo": P(None, None),
+            "attn_ln_scale": P(None, None),
+            "attn_ln_bias": P(None, None),
+            "w_in": P(None, None, "tp"),
+            "b_in": P(None, "tp"),
+            "w_out": P(None, "tp", None),
+            "b_out": P(None, None),
+            "ffn_ln_scale": P(None, None),
+            "ffn_ln_bias": P(None, None),
+        },
+    }
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a pytree onto the mesh per its PartitionSpec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

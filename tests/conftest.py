@@ -1,18 +1,30 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh *before* any jax import so
-multi-chip sharding logic is exercised hermetically (the real-TPU path is
-covered by bench.py and __graft_entry__.py on hardware).
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding logic
+is exercised hermetically (the real-TPU path is covered by bench.py and
+__graft_entry__.py on hardware).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be in the env before the CPU client is created.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The ambient environment points JAX at the real TPU tunnel (axon): its
+# PJRT plugin is registered from sitecustomize at interpreter start, which
+# also imports jax — so jax's config has already snapshotted
+# JAX_PLATFORMS=axon and mutating os.environ above is not sufficient.
+# Backends are not initialized yet at conftest time, though, so
+# config.update still redirects everything to the virtual CPU platform.
+# Tests must never touch the chip.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
