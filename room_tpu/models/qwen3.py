@@ -107,6 +107,9 @@ def init_kv_cache(
 AttentionFn = Callable[..., jax.Array]
 
 
+KvHook = Callable[..., tuple[jax.Array, Any]]
+
+
 def _layer(
     cfg: DecoderConfig,
     attention_fn: AttentionFn,
@@ -118,6 +121,7 @@ def _layer(
     write_pos: Optional[jax.Array],  # [B, S] absolute positions to write
     kv_mask: Optional[jax.Array],
     q_positions: jax.Array,
+    kv_hook: Optional[KvHook] = None,
 ) -> tuple[jax.Array, Optional[Params]]:
     b, s, d = x.shape
     h = rms_norm(x, lp["ln1"], cfg.rms_eps)
@@ -136,7 +140,11 @@ def _layer(
     k = apply_rope(k, cos, sin)
 
     new_cache = None
-    if layer_cache is not None:
+    if kv_hook is not None:
+        # serving-engine cache (e.g. paged KV): the hook owns both the
+        # cache write and the attention read
+        attn, new_cache = kv_hook(q, k, v, layer_cache)
+    elif layer_cache is not None:
         # scatter this chunk into the cache at its absolute positions
         bidx = jnp.arange(b)[:, None]
         ck = layer_cache["k"].at[bidx, write_pos].set(k)
@@ -178,19 +186,36 @@ def forward(
     positions: Optional[jax.Array] = None,  # [B, S] absolute positions
     kv_cache: Optional[Params] = None,
     attention_fn: AttentionFn = attention_ref,
+    kv_hook: Optional[KvHook] = None,
 ) -> tuple[jax.Array, Optional[Params]]:
     """Run the decoder. Returns (logits [B, S, V], updated cache or None).
 
     Without a cache this is plain causal prefill/training. With a cache,
     ``positions`` gives each token's absolute slot; cached entries at
     positions < per-batch length are attended to (prefix continuation /
-    single-token decode are the same code path).
+    single-token decode are the same code path). With ``kv_hook``, the
+    hook owns cache write + attention and ``kv_cache`` is an opaque
+    pytree whose leaves lead with the layer axis (scanned).
     """
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
     x = params["embed"][tokens]
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    if kv_hook is not None:
+        def body_hook(carry, xs):
+            lp, layer_cache = xs
+            y, new_layer_cache = _layer(
+                cfg, attention_fn, carry, lp, cos, sin, layer_cache,
+                None, None, positions, kv_hook,
+            )
+            return y, new_layer_cache
+
+        x, new_cache = jax.lax.scan(
+            body_hook, x, (params["layers"], kv_cache)
+        )
+        return _head(params, cfg, x), new_cache
 
     kv_mask = None
     if kv_cache is not None:
@@ -232,12 +257,15 @@ def forward(
             "k": new_kv["k"], "v": new_kv["v"], "lengths": new_lengths,
         }
 
+    return _head(params, cfg, x), new_cache
+
+
+def _head(params: Params, cfg: DecoderConfig, x: jax.Array) -> jax.Array:
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    logits = jnp.einsum("bsd,dv->bsv", x, head)
-    return logits, new_cache
+    return jnp.einsum("bsd,dv->bsv", x, head)
 
 
 def decode_step(

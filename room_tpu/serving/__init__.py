@@ -1,0 +1,26 @@
+from .engine import ServingEngine, Turn
+from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
+from .sampler import SamplingParams, sample, sample_batched
+from .tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    extract_tool_call,
+    load_tokenizer,
+    render_chat,
+)
+
+__all__ = [
+    "ServingEngine",
+    "Turn",
+    "PageTable",
+    "init_page_cache",
+    "make_paged_kv_hook",
+    "SamplingParams",
+    "sample",
+    "sample_batched",
+    "ByteTokenizer",
+    "HFTokenizer",
+    "extract_tool_call",
+    "load_tokenizer",
+    "render_chat",
+]

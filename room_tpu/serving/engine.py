@@ -1,0 +1,447 @@
+"""Continuous-batching inference engine.
+
+The in-tree replacement for the reference's out-of-process Ollama daemon
+(reference: src/shared/local-model.ts, agent-executor.ts:327-338): all
+Queen/Worker turns across every room land in one decode batch on the
+mesh.
+
+Shape of the loop (SURVEY.md §7 stage 5):
+- admission: queued turns are prefilled (bucketed chunk lengths to bound
+  recompiles) into pages from the shared pool, then occupy a decode slot
+- decode: one jitted step advances every active slot a token; sampling
+  happens on-device so only [B] token ids cross the host boundary
+- completion: EOS / im_end / max-tokens / a closed tool-call block ends
+  the turn; tool calls *park* the session (pages retained) so the host
+  can run the tool and resume with the result appended — preemptible
+  decode, the on-TPU equivalent of the reference's mid-turn tool loop
+  (reference: src/shared/agent-executor.ts:404-471)
+- sessions map 1:1 onto the engine's page table; parked sessions keep
+  their KV (the serving-side twin of the reference's agent_sessions
+  continuity rules)
+
+Everything device-side is static-shaped: fixed decode slots, fixed page
+pool, bucketed prefill lengths.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import qwen3
+from ..models.config import DecoderConfig
+from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
+from .sampler import SamplingParams, sample, sample_batched
+from .tokenizer import ByteTokenizer, Tokenizer
+
+PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+@dataclass
+class Turn:
+    """One generation request against a session."""
+    session_id: str
+    prompt_tokens: list[int]
+    sampling: SamplingParams
+    on_token: Optional[Callable[[int], None]] = None
+    # filled by the engine:
+    new_tokens: list[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None   # stop | length | tool_call | error
+    error: Optional[str] = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+    def wait(self, timeout: Optional[float] = None) -> "Turn":
+        self.done.wait(timeout)
+        return self
+
+
+@dataclass
+class _Session:
+    id: str
+    length: int = 0                 # tokens materialized in KV pages
+    parked: bool = False
+    # last sampled token not yet written to KV (stop/park happens before
+    # its decode step); prepended to the next resume prompt
+    pending: Optional[int] = None
+
+
+class ServingEngine:
+    """Single-model continuous batcher over a paged KV pool."""
+
+    def __init__(
+        self,
+        cfg: DecoderConfig,
+        params: Any,
+        tokenizer: Optional[Tokenizer] = None,
+        *,
+        max_batch: int = 8,
+        page_size: int = 16,
+        n_pages: int = 512,
+        max_seq_len: Optional[int] = None,
+        stop_token_ids: Optional[list[int]] = None,
+        rng_seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.max_batch = max_batch
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.max_seq_len = max_seq_len or min(
+            cfg.max_seq_len, (n_pages - 1) * page_size
+        )
+        self.max_pages_per_seq = -(-self.max_seq_len // page_size)
+
+        if stop_token_ids is not None:
+            self.stop_token_ids = set(stop_token_ids)
+        else:
+            stops = set()
+            eos = getattr(self.tokenizer, "eos_id", None)
+            if eos is not None:
+                stops.add(eos)
+            # add <|im_end|> only when the tokenizer maps it to one id
+            # (ByteTokenizer always does; a BPE vocab may not)
+            im_end_ids = self.tokenizer.encode("<|im_end|>")
+            if len(im_end_ids) == 1:
+                stops.add(im_end_ids[0])
+            self.stop_token_ids = stops
+
+        # page 0 is the scratch page idle decode slots write into
+        self.page_table = PageTable(n_pages, page_size)
+        self.page_table.ensure_capacity("__null__", page_size)
+
+        self.cache = init_page_cache(cfg, n_pages, page_size)
+        self.sessions: dict[str, _Session] = {}
+        self._queue: queue.Queue[Turn] = queue.Queue()
+        self._active: list[Optional[Turn]] = [None] * max_batch
+        self._slot_tables = np.zeros(
+            (max_batch, self.max_pages_per_seq), np.int32
+        )
+        self._slot_lengths = np.zeros((max_batch,), np.int32)
+        self._key = jax.random.PRNGKey(rng_seed)
+        self._deferred_release: set[str] = set()
+        self._lock = threading.Lock()
+        self._jit_cache: dict[Any, Callable] = {}
+        self._stats = {
+            "tokens_decoded": 0, "turns_completed": 0, "prefill_tokens": 0,
+            "decode_steps": 0,
+        }
+
+    # ---- jitted device functions ----
+
+    def _prefill_fn(self, bucket: int):
+        key = ("prefill", bucket)
+        if key not in self._jit_cache:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill(params, cache, tokens, block_table, length):
+                hook = make_paged_kv_hook(
+                    block_table, length, self.page_size
+                )
+                positions = length[:, None] + jnp.arange(tokens.shape[1])
+                logits, cache = qwen3.forward(
+                    params, cfg, tokens, positions, cache, kv_hook=hook
+                )
+                return logits, cache
+
+            self._jit_cache[key] = prefill
+        return self._jit_cache[key]
+
+    def _decode_fn(self, top_k: int):
+        key = ("decode", top_k)
+        if key not in self._jit_cache:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def decode(params, cache, tokens, block_tables, lengths, rng,
+                       temperature, top_p):
+                hook = make_paged_kv_hook(
+                    block_tables, lengths, self.page_size
+                )
+                positions = lengths[:, None]
+                logits, cache = qwen3.forward(
+                    params, cfg, tokens[:, None], positions, cache,
+                    kv_hook=hook,
+                )
+                next_tokens = sample_batched(
+                    logits[:, 0], rng, temperature, top_p, top_k
+                )
+                return next_tokens, cache
+
+            self._jit_cache[key] = decode
+        return self._jit_cache[key]
+
+    # ---- public API ----
+
+    def submit(
+        self,
+        prompt_tokens: list[int],
+        *,
+        session_id: Optional[str] = None,
+        sampling: Optional[SamplingParams] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+    ) -> Turn:
+        """Queue a turn. If session_id names a parked session, generation
+        resumes on top of its retained KV."""
+        sid = session_id or f"s{id(object())}-{time.monotonic_ns()}"
+        turn = Turn(
+            session_id=sid,
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling or SamplingParams(),
+            on_token=on_token,
+        )
+        self._queue.put(turn)
+        return turn
+
+    def release_session(self, session_id: str) -> None:
+        """Free a session's pages. If the session is mid-turn, the release
+        happens when that turn finishes (freeing live pages would let a
+        new session reuse them while the old slot still writes KV)."""
+        with self._lock:
+            if any(
+                t is not None and t.session_id == session_id
+                for t in self._active
+            ):
+                self._deferred_release.add(session_id)
+                return
+            self.sessions.pop(session_id, None)
+            self.page_table.release(session_id)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    # ---- engine loop ----
+
+    def step(self) -> int:
+        """One scheduler iteration: admit + one decode step. Returns the
+        number of active slots (0 = idle)."""
+        self._admit()
+        return self._decode_once()
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        for _ in range(max_steps):
+            if self.step() == 0 and self._queue.empty():
+                return
+        raise RuntimeError("run_until_idle exceeded max_steps")
+
+    def serve_forever(self, stop_event: threading.Event, idle_sleep=0.002):
+        while not stop_event.is_set():
+            if self.step() == 0 and self._queue.empty():
+                time.sleep(idle_sleep)
+
+    # ---- internals ----
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, t in enumerate(self._active) if t is None]
+
+    def _admit(self) -> None:
+        free = self._free_slots()
+        while free and not self._queue.empty():
+            turn = self._queue.get()
+            slot = free.pop(0)
+            try:
+                self._start_turn(slot, turn)
+            except MemoryError as e:
+                # pool exhausted: requeue and stop admitting; decode will
+                # drain sessions and free pages
+                if self._free_slots() == list(range(self.max_batch)):
+                    turn.error = str(e)
+                    turn.finish_reason = "error"
+                    turn.done.set()
+                else:
+                    self._queue.put(turn)
+                return
+
+    def _start_turn(self, slot: int, turn: Turn) -> None:
+        sess = self.sessions.get(turn.session_id)
+        if sess is None:
+            sess = _Session(id=turn.session_id)
+            self.sessions[turn.session_id] = sess
+        sess.parked = False
+
+        prompt = turn.prompt_tokens
+        if turn.sampling.max_new_tokens <= 0:
+            turn.finish_reason = "length"
+            turn.done.set()
+            return
+        if sess.pending is not None:
+            # re-materialize the sampled-but-unwritten token from the
+            # previous turn so its KV lands before the continuation.
+            # pending is cleared only after prefill succeeds, so a
+            # MemoryError requeue keeps the token.
+            prompt = [sess.pending] + prompt
+        total = sess.length + len(prompt)
+        if total + turn.sampling.max_new_tokens > self.max_seq_len:
+            turn.error = (
+                f"sequence would exceed max_seq_len {self.max_seq_len}"
+            )
+            turn.finish_reason = "error"
+            turn.done.set()
+            return
+
+        bucket = next(
+            (b for b in PREFILL_BUCKETS if b >= len(prompt)),
+            None,
+        )
+        capacity = self.max_pages_per_seq * self.page_size
+        if bucket is None or sess.length + bucket > capacity:
+            # the padded prefill must also fit the block table; reject
+            # rather than write past capacity
+            turn.error = (
+                f"prompt too long: {len(prompt)} at session length "
+                f"{sess.length} (capacity {capacity})"
+            )
+            turn.finish_reason = "error"
+            turn.done.set()
+            return
+
+        pages = self.page_table.ensure_capacity(
+            sess.id, sess.length + bucket
+        )
+        sess.pending = None
+        table = np.zeros((self.max_pages_per_seq,), np.int32)
+        table[: len(pages)] = pages
+
+        toks = np.full((bucket,), self.tokenizer.pad_id, np.int32)
+        toks[: len(prompt)] = prompt
+        prefill = self._prefill_fn(bucket)
+        logits, self.cache = prefill(
+            self.params,
+            self.cache,
+            jnp.asarray(toks[None]),
+            jnp.asarray(table[None]),
+            jnp.asarray([sess.length], jnp.int32),
+        )
+        self._stats["prefill_tokens"] += len(prompt)
+
+        sess.length += len(prompt)
+        # sample the first generated token from the last real position
+        self._key, sub = jax.random.split(self._key)
+        first = int(
+            sample(logits[:, len(prompt) - 1], sub, turn.sampling)[0]
+        )
+        self._slot_tables[slot] = table
+        self._slot_lengths[slot] = sess.length
+        self._active[slot] = turn
+        self._append_token(slot, turn, first)
+
+    def _decode_once(self) -> int:
+        active_idx = [
+            i for i, t in enumerate(self._active) if t is not None
+        ]
+        if not active_idx:
+            return 0
+
+        # slots must have page capacity for the token they are about to
+        # write at position `length`
+        for i in list(active_idx):
+            turn = self._active[i]
+            sess = self.sessions[turn.session_id]
+            try:
+                pages = self.page_table.ensure_capacity(
+                    sess.id, sess.length + 1
+                )
+            except MemoryError as e:
+                turn.error = str(e)
+                self._finish_turn(i, turn, "error")
+                active_idx.remove(i)
+                continue
+            self._slot_tables[i, : len(pages)] = pages
+            self._slot_lengths[i] = sess.length
+        if not active_idx:
+            return 0
+
+        tokens = np.zeros((self.max_batch,), np.int32)
+        for i in active_idx:
+            t = self._active[i]
+            tokens[i] = t.new_tokens[-1] if t.new_tokens else \
+                t.prompt_tokens[-1]
+
+        temps = np.ones((self.max_batch,), np.float32)
+        top_ps = np.ones((self.max_batch,), np.float32)
+        top_k = 0
+        for i in active_idx:
+            sp = self._active[i].sampling
+            temps[i] = sp.temperature
+            top_ps[i] = sp.top_p
+            top_k = max(top_k, sp.top_k)  # static knob: widest request
+
+        decode = self._decode_fn(top_k)
+        self._key, sub = jax.random.split(self._key)
+        next_tokens, self.cache = decode(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(self._slot_tables),
+            jnp.asarray(self._slot_lengths),
+            sub,
+            jnp.asarray(temps),
+            jnp.asarray(top_ps),
+        )
+        next_host = np.asarray(next_tokens)
+        self._stats["decode_steps"] += 1
+
+        for i in active_idx:
+            turn = self._active[i]
+            sess = self.sessions[turn.session_id]
+            sess.length += 1  # the token just written at position length
+            self._stats["tokens_decoded"] += 1
+            self._append_token(i, turn, int(next_host[i]))
+        return len(active_idx)
+
+    def _append_token(self, slot: int, turn: Turn, token: int) -> None:
+        turn.new_tokens.append(token)
+        if turn.on_token is not None:
+            try:
+                turn.on_token(token)
+            except Exception:
+                pass
+
+        reason = None
+        if token in self.stop_token_ids:
+            reason = "stop"
+        elif len(turn.new_tokens) >= turn.sampling.max_new_tokens:
+            reason = "length"
+        else:
+            tail = self.tokenizer.decode(turn.new_tokens[-24:])
+            if "</tool_call>" in tail:
+                reason = "tool_call"
+
+        if reason is not None:
+            self._finish_turn(slot, turn, reason)
+
+    def _finish_turn(self, slot: int, turn: Turn, reason: str) -> None:
+        sess = self.sessions[turn.session_id]
+        if turn.new_tokens and reason != "error":
+            # the final sampled token never got a decode step, so its KV
+            # is unwritten; it re-enters via the next resume prompt
+            sess.pending = turn.new_tokens[-1]
+        if reason == "tool_call":
+            sess.parked = True        # pages retained for resume
+        turn.finish_reason = reason
+        self._active[slot] = None
+        # point the freed slot at the scratch page so idle rows of the
+        # batched decode never write through a stale block table into
+        # pages that get reallocated to another session
+        self._slot_tables[slot] = 0
+        self._slot_lengths[slot] = 0
+        self._stats["turns_completed"] += 1
+        if sess.id in self._deferred_release:
+            self._deferred_release.discard(sess.id)
+            self.sessions.pop(sess.id, None)
+            self.page_table.release(sess.id)
+        turn.done.set()
+
+    def text_of(self, turn: Turn) -> str:
+        return self.tokenizer.decode(turn.new_tokens)

@@ -1,0 +1,131 @@
+"""Paged KV cache: device page pool + host page table.
+
+The TPU analogue of vLLM-style paged attention (PAPERS.md: Ragged Paged
+Attention for TPU): KV lives in fixed-size pages [L, n_pages, page_size,
+Hkv, Dh]; a session owns a list of pages; the decode batch addresses them
+through a block table [B, max_pages]. Sessions can be parked mid-turn
+(tool call) and resumed later without recomputing prefix KV — the
+on-chip equivalent of the reference's session continuity
+(reference: src/shared/agent-loop.ts:462-532, agent_sessions table).
+
+The device side is pure functions (jit/scan-safe); the host-side
+PageTable does allocation bookkeeping only.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import DecoderConfig
+from ..ops import attention_ref
+
+Params = dict[str, Any]
+
+
+def init_page_cache(
+    cfg: DecoderConfig, n_pages: int, page_size: int, dtype=None
+) -> Params:
+    dt = dtype or cfg.activation_dtype
+    shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return {"k_pages": jnp.zeros(shape, dt), "v_pages": jnp.zeros(shape, dt)}
+
+
+def make_paged_kv_hook(
+    block_tables: jax.Array,   # [B, max_pages] page ids (0 = also a real page; unused slots may be any valid id, masked by length)
+    lengths: jax.Array,        # [B] tokens already in cache per sequence
+    page_size: int,
+):
+    """Build the kv_hook used by models.qwen3.forward: writes the chunk's
+    k/v into the page pool and attends over (prefix + chunk).
+
+    Works for single-token decode (S=1) and chunked prefill (S>1) alike.
+    """
+    b, max_pages = block_tables.shape
+
+    def hook(q, k, v, layer_cache):
+        s = q.shape[1]
+        positions = lengths[:, None] + jnp.arange(s)[None]      # [B, S]
+        page_of = jnp.take_along_axis(
+            block_tables, positions // page_size, axis=1
+        )                                                        # [B, S]
+        offset = positions % page_size
+
+        flat_pages = page_of.reshape(-1)
+        flat_off = offset.reshape(-1)
+        kp = layer_cache["k_pages"].at[flat_pages, flat_off].set(
+            k.reshape(-1, *k.shape[2:])
+        )
+        vp = layer_cache["v_pages"].at[flat_pages, flat_off].set(
+            v.reshape(-1, *v.shape[2:])
+        )
+
+        # gather this batch's pages into a dense view (XLA reference path;
+        # the Pallas kernel replaces this gather)
+        k_all = kp[block_tables]                                 # [B,P,p,H,D]
+        v_all = vp[block_tables]
+        kv_len = max_pages * page_size
+        k_all = k_all.reshape(b, kv_len, *k.shape[2:])
+        v_all = v_all.reshape(b, kv_len, *v.shape[2:])
+
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(kv_len)[None], (b, kv_len)
+        )
+        kv_mask = kv_positions < (lengths + s)[:, None]
+        attn = attention_ref(
+            q, k_all, v_all, causal=True,
+            q_positions=positions, kv_positions=kv_positions,
+            kv_mask=kv_mask,
+        )
+        return attn, {"k_pages": kp, "v_pages": vp}
+
+    return hook
+
+
+class PageTable:
+    """Host-side page allocator: free list + per-session page lists."""
+
+    def __init__(self, n_pages: int, page_size: int) -> None:
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(n_pages - 1, -1, -1))
+        self._sessions: dict[str, list[int]] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def pages_of(self, session_id: str) -> list[int]:
+        with self._lock:
+            return list(self._sessions.get(session_id, []))
+
+    def tokens_capacity(self, session_id: str) -> int:
+        return len(self.pages_of(session_id)) * self.page_size
+
+    def ensure_capacity(self, session_id: str, n_tokens: int) -> list[int]:
+        """Grow the session's page list to hold n_tokens total. Raises
+        MemoryError when the pool is exhausted (caller pre-empts or
+        queues)."""
+        with self._lock:
+            pages = self._sessions.setdefault(session_id, [])
+            need = -(-n_tokens // self.page_size) - len(pages)
+            if need > len(self._free):
+                raise MemoryError(
+                    f"page pool exhausted: need {need}, free "
+                    f"{len(self._free)}"
+                )
+            for _ in range(max(need, 0)):
+                pages.append(self._free.pop())
+            return list(pages)
+
+    def release(self, session_id: str) -> int:
+        """Free all pages of a session (session end or eviction)."""
+        with self._lock:
+            pages = self._sessions.pop(session_id, [])
+            self._free.extend(reversed(pages))
+            return len(pages)

@@ -1,0 +1,77 @@
+"""Token sampling: temperature / top-k / top-p, jit-safe with static
+knobs folded into the compiled step."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.7
+    top_k: int = 0          # 0 = off
+    top_p: float = 1.0      # 1.0 = off
+    max_new_tokens: int = 1024
+
+
+def sample(
+    logits: jax.Array,       # [B, V]
+    key: jax.Array,
+    params: SamplingParams,
+) -> jax.Array:
+    """Returns sampled token ids [B]. Greedy when temperature == 0."""
+    if params.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+
+    logits = logits.astype(jnp.float32) / params.temperature
+
+    if params.top_k > 0:
+        kth = jax.lax.top_k(logits, params.top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p
+        cutoff_idx = jnp.sum(cum < params.top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def sample_batched(
+    logits: jax.Array,        # [B, V]
+    key: jax.Array,
+    temperature: jax.Array,   # [B] (0 = greedy for that row)
+    top_p: jax.Array,         # [B] (1 = off)
+    top_k: int = 0,           # static, engine-wide
+) -> jax.Array:
+    """Per-row sampling knobs as arrays so one compiled decode step serves
+    heterogeneous turns in the same batch."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(
+        cum < top_p[:, None], axis=-1, keepdims=True
+    )
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    apply_p = (top_p < 1.0)[:, None]
+    scaled = jnp.where(apply_p & (scaled < cutoff), -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -1,0 +1,154 @@
+"""Tokenizers for the serving engine.
+
+Production path: the upstream Qwen BPE via a local `transformers`
+tokenizer directory (no network; pass the path or set
+ROOM_TPU_TOKENIZER_PATH). Hermetic path: a byte-level tokenizer with the
+same special-token interface, used by tests and bench so the whole stack
+runs with zero downloads.
+
+The chat template follows the Qwen convention the reference's Ollama path
+relied on (im_start/im_end role blocks; tool calls fenced by
+<tool_call>/</tool_call> carrying JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """Bytes 0-255 + specials. Deterministic, download-free."""
+
+    PAD, BOS, EOS = 256, 257, 258
+    IM_START, IM_END = 259, 260
+    TOOL_START, TOOL_END = 261, 262
+
+    SPECIAL_STRINGS = {
+        IM_START: "<|im_start|>",
+        IM_END: "<|im_end|>",
+        TOOL_START: "<tool_call>",
+        TOOL_END: "</tool_call>",
+    }
+
+    def __init__(self) -> None:
+        self.vocab_size = 512
+        self.pad_id = self.PAD
+        self.bos_id = self.BOS
+        self.eos_id = self.EOS
+
+    def encode(self, text: str) -> list[int]:
+        out: list[int] = []
+        i = 0
+        specials = sorted(
+            self.SPECIAL_STRINGS.items(), key=lambda kv: -len(kv[1])
+        )
+        while i < len(text):
+            for tok_id, s in specials:
+                if text.startswith(s, i):
+                    out.append(tok_id)
+                    i += len(s)
+                    break
+            else:
+                out.extend(text[i].encode("utf-8"))
+                i += 1
+        return out
+
+    def decode(self, ids: list[int]) -> str:
+        parts: list[str] = []
+        buf = bytearray()
+        for t in ids:
+            t = int(t)
+            if t < 256:
+                buf.append(t)
+            else:
+                if buf:
+                    parts.append(buf.decode("utf-8", errors="replace"))
+                    buf = bytearray()
+                if t in self.SPECIAL_STRINGS:
+                    parts.append(self.SPECIAL_STRINGS[t])
+                # PAD/BOS/EOS render as nothing
+        if buf:
+            parts.append(buf.decode("utf-8", errors="replace"))
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """transformers-backed tokenizer loaded from a local directory."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(
+            path, local_files_only=True
+        )
+        self.vocab_size = len(self._tok)
+        self.eos_id = self._tok.eos_token_id
+        self.pad_id = self._tok.pad_token_id or self.eos_id
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=False)
+
+
+def load_tokenizer(path: Optional[str] = None) -> Tokenizer:
+    path = path or os.environ.get("ROOM_TPU_TOKENIZER_PATH")
+    if path and os.path.isdir(path):
+        return HFTokenizer(path)
+    return ByteTokenizer()
+
+
+# ---- chat template ----
+
+def render_chat(
+    messages: list[dict],
+    tools: Optional[list[dict]] = None,
+    add_generation_prompt: bool = True,
+) -> str:
+    """messages: [{role, content}]; tools: OpenAI-format tool defs."""
+    parts: list[str] = []
+    if tools:
+        tool_lines = "\n".join(
+            json.dumps(t, separators=(",", ":")) for t in tools
+        )
+        parts.append(
+            "<|im_start|>system\nYou may call tools. Available tools:\n"
+            f"{tool_lines}\n"
+            "To call a tool, emit <tool_call>{\"name\": ..., "
+            "\"arguments\": ...}</tool_call>.<|im_end|>\n"
+        )
+    for m in messages:
+        parts.append(
+            f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n"
+        )
+    if add_generation_prompt:
+        parts.append("<|im_start|>assistant\n")
+    return "".join(parts)
+
+
+def extract_tool_call(text: str) -> Optional[dict]:
+    """Parse the first <tool_call>...</tool_call> block, if any."""
+    start = text.find("<tool_call>")
+    if start < 0:
+        return None
+    end = text.find("</tool_call>", start)
+    if end < 0:
+        return None
+    payload = text[start + len("<tool_call>"):end].strip()
+    try:
+        out = json.loads(payload)
+    except json.JSONDecodeError:
+        return None
+    return out if isinstance(out, dict) else None

