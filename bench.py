@@ -1,0 +1,133 @@
+"""Round benchmark — prints ONE JSON line.
+
+Measures sustained decode throughput of the serving engine (continuous
+batching + paged KV) on the qwen3-coder architecture scaled to fit a
+single chip's HBM (same hidden/heads/GQA/qk-norm/MoE shape as the 30B
+target; depth and expert count reduced). vs_baseline is measured against
+the BASELINE.md north-star of 800 decode tok/s/chip.
+
+A watchdog guarantees the JSON line is printed even if the TPU tunnel is
+unreachable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+BASELINE_TOK_S = 800.0
+WATCHDOG_S = float(os.environ.get("ROOM_TPU_BENCH_WATCHDOG_S", "480"))
+TINY = os.environ.get("ROOM_TPU_BENCH_TINY") == "1"  # CPU smoke mode
+
+_result_printed = threading.Event()
+
+
+def _emit(value: float, unit: str, note: str = "") -> None:
+    if _result_printed.is_set():
+        return
+    _result_printed.set()
+    line = {
+        "metric": "decode_tok_per_s_per_chip",
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / BASELINE_TOK_S, 4),
+    }
+    if note:
+        line["note"] = note
+    print(json.dumps(line), flush=True)
+
+
+def _watchdog() -> None:
+    time.sleep(WATCHDOG_S)
+    if not _result_printed.is_set():
+        _emit(0.0, "tok/s", "watchdog: TPU backend unreachable")
+        os._exit(1)
+
+
+def bench_config():
+    from room_tpu.models.config import DecoderConfig, tiny_moe
+
+    if TINY:
+        return tiny_moe()
+    return DecoderConfig(
+        name="qwen3-coder-bench",
+        vocab_size=151_936,
+        hidden=2048,
+        n_layers=8,
+        n_heads=32,
+        n_kv_heads=4,
+        head_dim=128,
+        intermediate=0,
+        rope_theta=1e7,
+        qk_norm=True,
+        n_experts=16,
+        top_k=8,
+        moe_intermediate=768,
+        dtype="bfloat16",
+    )
+
+
+def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    import jax.numpy as jnp
+
+    from room_tpu.models import qwen3
+    from room_tpu.serving import SamplingParams, ServingEngine
+
+    cfg = bench_config()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+
+    max_batch = 4 if TINY else 8
+    eng = ServingEngine(
+        cfg, params, max_batch=max_batch, page_size=32, n_pages=1024
+    )
+
+    gen_tokens = 16 if TINY else 64
+    sp = SamplingParams(
+        temperature=0.7, top_p=0.95, max_new_tokens=gen_tokens
+    )
+    prompt = list(range(1, 33))
+
+    # warmup: compile prefill + decode
+    warm = [eng.submit(prompt, sampling=sp) for _ in range(max_batch)]
+    eng.run_until_idle()
+    for t in warm:
+        eng.release_session(t.session_id)
+
+    # timed: keep all slots busy; count decoded tokens over the window
+    start_stats = eng.stats()
+    turns = [
+        eng.submit(prompt, sampling=SamplingParams(
+            temperature=0.7, top_p=0.95,
+            max_new_tokens=32 if TINY else 256,
+        ))
+        for _ in range(max_batch * 2)
+    ]
+    t0 = time.perf_counter()
+    eng.run_until_idle()
+    dt = time.perf_counter() - t0
+    end_stats = eng.stats()
+
+    decoded = end_stats["tokens_decoded"] - start_stats["tokens_decoded"]
+    tok_s = decoded / dt
+    _emit(
+        tok_s,
+        "tok/s",
+        f"{platform}; {cfg.name} bs={max_batch} "
+        f"({decoded} tok / {dt:.1f}s)",
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # the one JSON line must always appear
+        _emit(0.0, "tok/s", f"error: {type(e).__name__}: {e}")
+        sys.exit(1)

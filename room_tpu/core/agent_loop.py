@@ -1,0 +1,610 @@
+"""Per-worker agent loop: observe → prompt → execute → persist.
+
+Behavioral parity with the reference loop (reference:
+src/shared/agent-loop.ts): quiet hours (:30-51), WIP momentum gap
+(:204-217), rate-limit wait state (:166-190), stuck detector (:605-617),
+session rotation after 20 cycles (:462-493), history compression at 30
+messages (:495-532), auto-created executor for a worker-less queen
+(:414-449), auto-WIP fallback (:855-863), and the §3.2 prompt assembly
+order — re-built on Python threads with the tpu: provider as the default
+execution path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from ..db import Database, utc_now
+from ..providers import (
+    ExecutionRequest, RateLimitExceeded, get_model_provider,
+)
+from . import (
+    escalations as escalations_mod,
+    goals as goals_mod,
+    memory as memory_mod,
+    messages as messages_mod,
+    quorum as quorum_mod,
+    rooms as rooms_mod,
+    skills as skills_mod,
+    workers as workers_mod,
+)
+from .constants import (
+    API_HISTORY_COMPRESS_AT,
+    API_HISTORY_TRIM_AT,
+    CLI_SESSION_ROTATE_CYCLES,
+    MEMORY_RECALL_TOP_K,
+)
+from .cycle_logs import CycleLogBuffer
+from .events import event_bus
+from .queen_tools import (
+    QUEEN_TOOLS, WORKER_TOOLS, execute_queen_tool,
+)
+from .rate_limit import clamp_wait
+
+WIP_MOMENTUM_GAP_S = 10.0
+STUCK_CYCLE_WINDOW = 5
+
+
+@dataclass
+class LoopHandle:
+    worker_id: int
+    room_id: int
+    thread: Optional[threading.Thread] = None
+    stop: threading.Event = field(default_factory=threading.Event)
+    wake: threading.Event = field(default_factory=threading.Event)
+    state: str = "idle"
+
+
+_running_loops: dict[int, LoopHandle] = {}
+_launched_rooms: set[int] = set()
+_registry_lock = threading.Lock()
+
+
+# ---- lifecycle ----
+
+def set_room_launch_enabled(room_id: int, enabled: bool) -> None:
+    with _registry_lock:
+        if enabled:
+            _launched_rooms.add(room_id)
+        else:
+            _launched_rooms.discard(room_id)
+
+
+def is_room_launched(room_id: int) -> bool:
+    with _registry_lock:
+        return room_id in _launched_rooms
+
+
+def running_workers() -> list[int]:
+    with _registry_lock:
+        return [
+            wid for wid, h in _running_loops.items()
+            if h.thread is not None and h.thread.is_alive()
+        ]
+
+
+def start_agent_loop(
+    db: Database, room_id: int, worker_id: int
+) -> LoopHandle:
+    with _registry_lock:
+        existing = _running_loops.get(worker_id)
+        if existing and existing.thread and existing.thread.is_alive():
+            existing.wake.set()
+            return existing
+        handle = LoopHandle(worker_id=worker_id, room_id=room_id)
+        _running_loops[worker_id] = handle
+    handle.thread = threading.Thread(
+        target=_loop, args=(db, handle), daemon=True,
+        name=f"agent-loop-{worker_id}",
+    )
+    handle.thread.start()
+    return handle
+
+
+def trigger_agent(
+    db: Database,
+    room_id: int,
+    worker_id: int,
+    allow_cold_start: bool = False,
+) -> Optional[LoopHandle]:
+    """Wake a sleeping loop, or start one (reference: triggerAgent:266)."""
+    if allow_cold_start:
+        set_room_launch_enabled(room_id, True)
+    if not is_room_launched(room_id):
+        return None
+    return start_agent_loop(db, room_id, worker_id)
+
+
+def pause_agent(worker_id: int) -> bool:
+    with _registry_lock:
+        handle = _running_loops.get(worker_id)
+    if handle is None:
+        return False
+    handle.stop.set()
+    handle.wake.set()
+    return True
+
+
+def stop_room_loops(db: Database, room_id: int, reason: str = "") -> int:
+    set_room_launch_enabled(room_id, False)
+    n = 0
+    with _registry_lock:
+        handles = [
+            h for h in _running_loops.values() if h.room_id == room_id
+        ]
+    for h in handles:
+        h.stop.set()
+        h.wake.set()
+        n += 1
+    return n
+
+
+# ---- the loop ----
+
+def _loop(db: Database, handle: LoopHandle) -> None:
+    while not handle.stop.is_set():
+        worker = workers_mod.get_worker(db, handle.worker_id)
+        room = rooms_mod.get_room(db, handle.room_id)
+        if worker is None or room is None:
+            break
+        if room["status"] != "active" or not is_room_launched(room["id"]):
+            break
+
+        if _in_quiet_hours(room):
+            handle.state = "waiting"
+            workers_mod.set_agent_state(db, worker["id"], "waiting")
+            if handle.wake.wait(timeout=60):
+                handle.wake.clear()
+            continue
+
+        handle.state = "running"
+        try:
+            run_cycle(db, room, worker)
+            gap_s = _cycle_gap_s(db, room, worker)
+        except RateLimitExceeded as e:
+            handle.state = "rate_limited"
+            workers_mod.set_agent_state(db, worker["id"], "rate_limited")
+            gap_s = clamp_wait(e.wait_s)
+        except Exception as e:
+            event_bus.emit(
+                "cycle:error", f"room:{room['id']}",
+                {"worker_id": worker["id"], "error": str(e)},
+            )
+            gap_s = 30.0
+
+        handle.state = "idle"
+        workers_mod.set_agent_state(db, handle.worker_id, "idle")
+        if handle.wake.wait(timeout=gap_s):
+            handle.wake.clear()
+
+    handle.state = "stopped"
+    workers_mod.set_agent_state(db, handle.worker_id, "stopped")
+    with _registry_lock:
+        if _running_loops.get(handle.worker_id) is handle:
+            del _running_loops[handle.worker_id]
+
+
+def _cycle_gap_s(db: Database, room: dict, worker: dict) -> float:
+    gap_ms = worker["cycle_gap_ms"] or room["queen_cycle_gap_ms"]
+    gap_s = gap_ms / 1000.0
+    fresh = workers_mod.get_worker(db, worker["id"])
+    if fresh and fresh.get("wip"):
+        # momentum: keep pushing while work is in flight
+        return min(gap_s, WIP_MOMENTUM_GAP_S)
+    return gap_s
+
+
+def _in_quiet_hours(room: dict) -> bool:
+    start, end = room.get("queen_quiet_from"), room.get("queen_quiet_until")
+    if not start or not end:
+        return False
+    now = datetime.now().strftime("%H:%M")
+    if start <= end:
+        return start <= now < end
+    return now >= start or now < end  # window crosses midnight
+
+
+# ---- one cycle ----
+
+def run_cycle(db: Database, room: dict, worker: dict) -> dict:
+    """Execute one observe→prompt→execute→persist cycle. Returns the
+    worker_cycles row."""
+    # refetch both rows: callers may hold stale dicts
+    room = rooms_mod.get_room(db, room["id"]) or room
+    worker = workers_mod.get_worker(db, worker["id"]) or worker
+    is_queen = worker["id"] == room["queen_worker_id"]
+    model = worker["model"] or room["worker_model"]
+
+    cycle_id = db.insert(
+        "INSERT INTO worker_cycles(worker_id, room_id, model) "
+        "VALUES (?,?,?)",
+        (worker["id"], room["id"], model),
+    )
+    logs = CycleLogBuffer(db, cycle_id)
+    event_bus.emit(
+        "cycle:started", f"room:{room['id']}",
+        {"cycle_id": cycle_id, "worker_id": worker["id"]},
+    )
+    started = time.monotonic()
+
+    try:
+        provider = get_model_provider(model, db)
+        ready, why = provider.is_ready()
+        if not ready:
+            raise RuntimeError(f"model {model!r} not ready: {why}")
+
+        quorum_mod.check_expired_decisions(db)
+        if is_queen:
+            _ensure_executor_exists(db, room)
+
+        prompt = _build_cycle_prompt(db, room, worker, is_queen)
+        logs.append("prompt", prompt[-2000:])
+
+        session_id, messages = _load_session(db, worker, model)
+        tools = QUEEN_TOOLS if is_queen else WORKER_TOOLS
+
+        def on_tool_call(name: str, args: dict) -> str:
+            logs.append("tool_call", json.dumps({"name": name,
+                                                 "args": args}))
+            out = execute_queen_tool(db, room["id"], worker["id"], name,
+                                     args)
+            logs.append("tool_result", out[:2000])
+            return out
+
+        result = provider.execute(ExecutionRequest(
+            prompt=prompt,
+            system_prompt=worker["system_prompt"],
+            model=model,
+            tools=tools,
+            on_tool_call=on_tool_call,
+            max_turns=worker["max_turns"] or room["queen_max_turns"],
+            session_id=session_id,
+            messages=messages,
+            on_text=lambda t: logs.append("assistant", t[:4000]),
+        ))
+
+        if not result.success and result.error:
+            from .rate_limit import detect_rate_limit
+
+            wait = detect_rate_limit(result.error)
+            if wait is not None:
+                raise RateLimitExceeded(result.error, wait)
+
+        _save_session(db, worker, model, result, provider)
+        _auto_wip(db, worker, result)
+
+        status = "success" if result.success else "error"
+        db.execute(
+            "UPDATE worker_cycles SET finished_at=?, status=?, "
+            "error_message=?, duration_ms=?, input_tokens=?, "
+            "output_tokens=? WHERE id=?",
+            (
+                utc_now(), status, result.error,
+                int((time.monotonic() - started) * 1000),
+                result.input_tokens, result.output_tokens, cycle_id,
+            ),
+        )
+        _prune_old_cycles(db, room["id"])
+        event_bus.emit(
+            "cycle:finished", f"room:{room['id']}",
+            {"cycle_id": cycle_id, "status": status},
+        )
+        return db.query_one(
+            "SELECT * FROM worker_cycles WHERE id=?", (cycle_id,)
+        )  # type: ignore[return-value]
+    except Exception as e:
+        db.execute(
+            "UPDATE worker_cycles SET finished_at=?, status='error', "
+            "error_message=?, duration_ms=? WHERE id=?",
+            (utc_now(), str(e),
+             int((time.monotonic() - started) * 1000), cycle_id),
+        )
+        raise
+    finally:
+        logs.close()
+
+
+# ---- prompt assembly (reference order, agent-loop.ts:451-685) ----
+
+def _build_cycle_prompt(
+    db: Database, room: dict, worker: dict, is_queen: bool
+) -> str:
+    parts: list[str] = []
+    role = "Queen (coordinator)" if is_queen else \
+        f"Worker ({worker['role'] or 'generalist'})"
+    parts.append(
+        f"You are {worker['name']}, {role} of room "
+        f"'{room['name']}' (room #{room['id']}, your worker id "
+        f"#{worker['id']})."
+    )
+
+    if worker.get("wip"):
+        parts.append(
+            "CONTINUE FORWARD — your work-in-progress note from last "
+            f"cycle:\n{worker['wip']}"
+        )
+
+    if room.get("goal"):
+        parts.append(f"Room objective: {room['goal']}")
+
+    # goals / assignments
+    if is_queen:
+        tree = goals_mod.get_goal_tree(db, room["id"])
+        if tree:
+            parts.append("Goal tree:\n" + _render_goal_tree(tree))
+        team = workers_mod.list_room_workers(db, room["id"])
+        others = [w for w in team if w["id"] != worker["id"]]
+        if others:
+            parts.append(
+                "Workers:\n" + "\n".join(
+                    f"- #{w['id']} {w['name']} ({w['role']}) "
+                    f"state={w['agent_state']}"
+                    for w in others
+                )
+            )
+    else:
+        assigned = goals_mod.active_goals_for_worker(db, worker["id"])
+        if assigned:
+            parts.append(
+                "Your assigned goals:\n" + "\n".join(
+                    f"- #{g['id']} {g['description']} "
+                    f"(progress {g['progress']:.0%})"
+                    for g in assigned
+                )
+            )
+
+    # memory: top-5 hybrid hits against objective+WIP
+    query = " ".join(
+        x for x in (room.get("goal"), worker.get("wip")) if x
+    )
+    if query:
+        from .queen_tools import _embed_query
+
+        hits = memory_mod.hybrid_search(
+            db, query, query_vector=_embed_query(query),
+            limit=MEMORY_RECALL_TOP_K, room_id=room["id"],
+        )
+        if hits:
+            parts.append(
+                "Relevant memory:\n" + "\n".join(
+                    f"- {h['name']}: "
+                    f"{'; '.join(h['observations'][-2:])}"
+                    for h in hits
+                )
+            )
+
+    skills_ctx = skills_mod.load_skills_for_agent(
+        db, room["id"], context_hint=query or ""
+    )
+    if skills_ctx:
+        parts.append(skills_ctx)
+
+    stuck = _stuck_note(db, worker)
+    if stuck:
+        parts.append(stuck)
+
+    # housekeeping: decisions / escalations / messages
+    pending = quorum_mod.pending_decisions(db, room["id"])
+    if pending:
+        parts.append(
+            "Open decisions:\n" + "\n".join(
+                f"- #{d['id']} [{d['status']}] {d['proposal']}"
+                for d in pending
+            )
+        )
+    answered = escalations_mod.recently_answered(db, room["id"], limit=3)
+    if answered:
+        parts.append(
+            "Keeper answers:\n" + "\n".join(
+                f"- Q: {e['question']} → A: {e['answer']}"
+                for e in answered
+            )
+        )
+    keeper_msgs = messages_mod.unanswered_keeper_messages(db, room["id"])
+    if is_queen and keeper_msgs:
+        parts.append(
+            "Unanswered keeper messages (reply with send_message "
+            "to='keeper'):\n" + "\n".join(
+                f"- {m['content']}" for m in keeper_msgs[-5:]
+            )
+        )
+    unread = messages_mod.unread_messages(db, room["id"])
+    if unread:
+        parts.append(
+            "Unread inter-room messages:\n" + "\n".join(
+                f"- #{m['id']} from room {m['from_room_id']}: "
+                f"[{m['subject']}] {m['body'][:200]}"
+                for m in unread[:5]
+            )
+        )
+
+    parts.append(
+        "Act now using your tools. Finish by saving a WIP note "
+        "(save_wip) describing exactly where to continue next cycle."
+    )
+    return "\n\n".join(parts)
+
+
+def _render_goal_tree(tree: list[dict], depth: int = 0) -> str:
+    lines = []
+    for g in tree:
+        assignee = (
+            f" → worker #{g['assigned_worker_id']}"
+            if g.get("assigned_worker_id") else ""
+        )
+        lines.append(
+            "  " * depth
+            + f"- #{g['id']} [{g['status']} {g['progress']:.0%}] "
+            f"{g['description']}{assignee}"
+        )
+        if g.get("children"):
+            lines.append(_render_goal_tree(g["children"], depth + 1))
+    return "\n".join(lines)
+
+
+def _stuck_note(db: Database, worker: dict) -> Optional[str]:
+    """Flag repeated failing cycles (reference stuck detector :605-617)."""
+    recent = db.query(
+        "SELECT status FROM worker_cycles WHERE worker_id=? "
+        "ORDER BY id DESC LIMIT ?",
+        (worker["id"], STUCK_CYCLE_WINDOW),
+    )
+    failures = sum(1 for r in recent if r["status"] == "error")
+    if len(recent) >= STUCK_CYCLE_WINDOW and failures >= STUCK_CYCLE_WINDOW - 1:
+        return (
+            "NOTE: your recent cycles keep failing. Change approach: "
+            "simplify the next action, or escalate to the keeper."
+        )
+    return None
+
+
+def _ensure_executor_exists(db: Database, room: dict) -> None:
+    """A queen alone gets a default executor (reference :414-449)."""
+    team = workers_mod.list_room_workers(db, room["id"])
+    if len(team) > 1:
+        return
+    workers_mod.create_worker(
+        db,
+        name=f"{room['name']} Executor",
+        system_prompt="Execute goals delegated by the Queen.",
+        room_id=room["id"],
+        role="executor",
+        model=room["worker_model"],
+    )
+
+
+# ---- session continuity ----
+
+def _load_session(
+    db: Database, worker: dict, model: str
+) -> tuple[Optional[str], Optional[list[dict]]]:
+    row = db.query_one(
+        "SELECT * FROM agent_sessions WHERE worker_id=?", (worker["id"],)
+    )
+    if row is None:
+        return None, None
+    rotate = (
+        row["model"] != model
+        or row["turn_count"] >= CLI_SESSION_ROTATE_CYCLES
+    )
+    if rotate:
+        _release_engine_session(row["session_id"], model)
+        db.execute(
+            "DELETE FROM agent_sessions WHERE worker_id=?",
+            (worker["id"],),
+        )
+        return None, None
+    messages = (
+        json.loads(row["messages_json"]) if row["messages_json"] else None
+    )
+    if messages is not None and len(messages) >= API_HISTORY_COMPRESS_AT:
+        messages = _compress_messages(db, worker, model, messages)
+    return row["session_id"], messages
+
+
+def _save_session(
+    db: Database, worker: dict, model: str, result, provider
+) -> None:
+    messages_json = (
+        json.dumps(result.messages[-API_HISTORY_TRIM_AT:])
+        if result.messages else None
+    )
+    db.execute(
+        "INSERT INTO agent_sessions(worker_id, session_id, messages_json, "
+        "model, turn_count, updated_at) VALUES (?,?,?,?,1,?) "
+        "ON CONFLICT(worker_id) DO UPDATE SET session_id=excluded."
+        "session_id, messages_json=excluded.messages_json, "
+        "model=excluded.model, turn_count=turn_count+1, "
+        "updated_at=excluded.updated_at",
+        (
+            worker["id"], result.session_id, messages_json, model,
+            utc_now(),
+        ),
+    )
+
+
+def _compress_messages(
+    db: Database, worker: dict, model: str, messages: list[dict]
+) -> list[dict]:
+    """Summarize old history into one message via a single LLM call,
+    persisting the summary to room memory (reference compressSession,
+    agent-executor.ts:878-948). Falls back to a hard trim."""
+    head, tail = messages[:-10], messages[-10:]
+    try:
+        provider = get_model_provider(model, db)
+        digest = "\n".join(
+            f"{m.get('role')}: {str(m.get('content'))[:300]}"
+            for m in head
+        )
+        r = provider.execute(ExecutionRequest(
+            prompt=(
+                "Summarize this conversation history into a compact "
+                "briefing (decisions, open threads, facts):\n" + digest
+            ),
+            max_turns=1,
+            max_new_tokens=512,
+            timeout_s=120,
+        ))
+        summary = r.text if r.success and r.text else None
+    except Exception:
+        summary = None
+    if summary:
+        if worker.get("room_id"):
+            memory_mod.remember(
+                db, f"session summary: {worker['name']}", summary,
+                category="session", room_id=worker["room_id"],
+            )
+        return (
+            [{"role": "user",
+              "content": f"[Conversation summary]\n{summary}"}] + tail
+        )
+    return messages[-API_HISTORY_TRIM_AT:]
+
+
+def _release_engine_session(
+    session_id: Optional[str], model: str
+) -> None:
+    """Rotation frees the paged-KV session on the engine side."""
+    if not session_id:
+        return
+    try:
+        from ..providers.registry import model_name, provider_kind
+        from ..providers.tpu import get_model_host
+
+        if provider_kind(model) == "tpu":
+            host = get_model_host(model_name(model) or "qwen3-coder-30b")
+            if host._engine is not None:
+                host._engine.release_session(session_id)
+    except Exception:
+        pass
+
+
+def _auto_wip(db: Database, worker: dict, result) -> None:
+    """If the agent didn't save a WIP, derive one from its final text
+    (reference auto-WIP fallback :855-863)."""
+    fresh = workers_mod.get_worker(db, worker["id"])
+    if fresh is None:
+        return
+    before = worker.get("wip") or ""
+    if (fresh.get("wip") or "") != before:
+        return  # agent saved one itself this cycle
+    if result.text:
+        workers_mod.save_wip(
+            db, worker["id"], f"[auto] last output: {result.text[:500]}"
+        )
+
+
+def _prune_old_cycles(
+    db: Database, room_id: int, keep: int = 200
+) -> None:
+    db.execute(
+        "DELETE FROM worker_cycles WHERE room_id=? AND id NOT IN ("
+        "SELECT id FROM worker_cycles WHERE room_id=? "
+        "ORDER BY id DESC LIMIT ?)",
+        (room_id, room_id, keep),
+    )

@@ -1,0 +1,68 @@
+"""Cycle log buffer: seq-numbered entries buffered and flushed to the DB
+periodically, with a live event per entry for WS streaming (reference:
+src/shared/console-log-buffer.ts — 1 s flush cadence)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..db import Database
+from .events import event_bus
+
+FLUSH_INTERVAL_S = 1.0
+
+
+class CycleLogBuffer:
+    def __init__(
+        self,
+        db: Database,
+        cycle_id: int,
+        flush_interval_s: float = FLUSH_INTERVAL_S,
+    ) -> None:
+        self.db = db
+        self.cycle_id = cycle_id
+        self.flush_interval_s = flush_interval_s
+        self._seq = 0
+        self._pending: list[tuple[int, str, str]] = []
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+
+    def append(self, entry_type: str, content: str) -> int:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            self._pending.append((seq, entry_type, content))
+        event_bus.emit(
+            "cycle:log",
+            f"cycle:{self.cycle_id}",
+            {"seq": seq, "entry_type": entry_type, "content": content},
+        )
+        if time.monotonic() - self._last_flush >= self.flush_interval_s:
+            self.flush()
+        return seq
+
+    def flush(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, []
+            self._last_flush = time.monotonic()
+        if not pending:
+            return
+        with self.db.transaction():
+            for seq, entry_type, content in pending:
+                self.db.insert(
+                    "INSERT INTO cycle_logs(cycle_id, seq, entry_type, "
+                    "content) VALUES (?,?,?,?)",
+                    (self.cycle_id, seq, entry_type, content),
+                )
+
+    def close(self) -> None:
+        self.flush()
+
+
+def get_cycle_logs(db: Database, cycle_id: int) -> list[dict]:
+    return db.query(
+        "SELECT * FROM cycle_logs WHERE cycle_id=? ORDER BY seq",
+        (cycle_id,),
+    )

@@ -1,0 +1,64 @@
+"""In-process event bus (reference: src/server/event-bus.ts — channel +
+wildcard pub/sub, fanned out over WebSocket by the server layer)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..db import utc_now
+
+Handler = Callable[["Event"], None]
+
+
+@dataclass
+class Event:
+    type: str
+    channel: str
+    data: Any = None
+    timestamp: str = field(default_factory=utc_now)
+
+
+class EventBus:
+    def __init__(self) -> None:
+        self._handlers: dict[str, list[Handler]] = {}
+        self._wildcard: list[Handler] = []
+        self._lock = threading.Lock()
+
+    def subscribe(
+        self, channel: Optional[str], handler: Handler
+    ) -> Callable[[], None]:
+        """channel=None subscribes to everything. Returns unsubscribe."""
+        with self._lock:
+            if channel is None:
+                self._wildcard.append(handler)
+            else:
+                self._handlers.setdefault(channel, []).append(handler)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                try:
+                    if channel is None:
+                        self._wildcard.remove(handler)
+                    else:
+                        self._handlers.get(channel, []).remove(handler)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def emit(self, type_: str, channel: str, data: Any = None) -> Event:
+        event = Event(type_, channel, data)
+        with self._lock:
+            handlers = list(self._handlers.get(channel, []))
+            handlers += list(self._wildcard)
+        for h in handlers:
+            try:
+                h(event)
+            except Exception:
+                pass  # a broken subscriber must not break the emitter
+        return event
+
+
+event_bus = EventBus()

@@ -1,0 +1,421 @@
+"""Queen/worker tool surface: OpenAI-format tool defs + the dispatcher
+that executes them against the engine (reference:
+src/shared/queen-tools.ts — QUEEN_TOOLS:348, WORKER_TOOLS:361,
+executeQueenTool:394)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..db import Database
+from . import (
+    escalations as escalations_mod,
+    goals as goals_mod,
+    memory as memory_mod,
+    messages as messages_mod,
+    quorum as quorum_mod,
+    rooms as rooms_mod,
+    skills as skills_mod,
+    wallet as wallet_mod,
+    workers as workers_mod,
+)
+from .activity import log_room_activity
+from .constants import WIP_MAX_CHARS
+from .events import event_bus
+
+
+def _tool(name: str, description: str, properties: dict,
+          required: list[str]) -> dict:
+    return {
+        "name": name,
+        "description": description,
+        "parameters": {
+            "type": "object",
+            "properties": properties,
+            "required": required,
+        },
+    }
+
+
+_SHARED_TOOLS = [
+    _tool(
+        "remember",
+        "Store a durable fact in the room's semantic memory.",
+        {
+            "name": {"type": "string", "description": "short entity name"},
+            "content": {"type": "string"},
+            "category": {"type": "string"},
+        },
+        ["name", "content"],
+    ),
+    _tool(
+        "recall",
+        "Search the room's memory (hybrid full-text + semantic).",
+        {"query": {"type": "string"}},
+        ["query"],
+    ),
+    _tool(
+        "send_message",
+        "Send a message to another room (to_room_id) or to the keeper "
+        "(to='keeper').",
+        {
+            "to": {"type": "string",
+                   "description": "'keeper' or a room id"},
+            "subject": {"type": "string"},
+            "body": {"type": "string"},
+        },
+        ["to", "body"],
+    ),
+    _tool(
+        "save_wip",
+        "Save a work-in-progress note; the next cycle starts from it.",
+        {"note": {"type": "string"}},
+        ["note"],
+    ),
+    _tool(
+        "web_fetch",
+        "Fetch a URL and return readable text.",
+        {"url": {"type": "string"}},
+        ["url"],
+    ),
+    _tool(
+        "web_search",
+        "Search the web; returns result titles+urls+snippets.",
+        {"query": {"type": "string"}},
+        ["query"],
+    ),
+]
+
+QUEEN_TOOLS: list[dict] = [
+    _tool(
+        "set_goal",
+        "Create a goal (optionally under a parent goal).",
+        {
+            "description": {"type": "string"},
+            "parent_goal_id": {"type": "integer"},
+        },
+        ["description"],
+    ),
+    _tool(
+        "delegate",
+        "Create a goal and assign it to a worker; wakes the worker.",
+        {
+            "description": {"type": "string"},
+            "worker_id": {"type": "integer"},
+            "parent_goal_id": {"type": "integer"},
+        },
+        ["description", "worker_id"],
+    ),
+    _tool(
+        "announce_decision",
+        "Announce a decision for quorum review; it becomes effective "
+        "after the objection window unless a worker objects.",
+        {
+            "proposal": {"type": "string"},
+            "decision_type": {
+                "type": "string",
+                "enum": ["low_impact", "high_impact", "critical"],
+            },
+        },
+        ["proposal"],
+    ),
+    _tool(
+        "create_worker",
+        "Add a worker to the room with a role preset.",
+        {
+            "name": {"type": "string"},
+            "role": {
+                "type": "string",
+                "enum": ["executor", "guardian", "analyst", "writer",
+                         "researcher"],
+            },
+            "system_prompt": {"type": "string"},
+        },
+        ["name", "role"],
+    ),
+    _tool(
+        "update_worker",
+        "Update a worker's prompt/cadence.",
+        {
+            "worker_id": {"type": "integer"},
+            "system_prompt": {"type": "string"},
+            "cycle_gap_ms": {"type": "integer"},
+            "max_turns": {"type": "integer"},
+        },
+        ["worker_id"],
+    ),
+    _tool(
+        "configure_room",
+        "Update room settings (cycle gap, autonomy, quiet hours).",
+        {
+            "queen_cycle_gap_ms": {"type": "integer"},
+            "autonomy_mode": {"type": "string",
+                              "enum": ["manual", "semi", "full"]},
+            "queen_quiet_from": {"type": "string"},
+            "queen_quiet_until": {"type": "string"},
+        },
+        [],
+    ),
+    _tool(
+        "escalate_to_keeper",
+        "Ask the keeper a question the room cannot resolve itself.",
+        {"question": {"type": "string"}},
+        ["question"],
+    ),
+    _tool(
+        "wallet_status",
+        "Room wallet address and recorded transactions.",
+        {},
+        [],
+    ),
+] + _SHARED_TOOLS
+
+WORKER_TOOLS: list[dict] = [
+    _tool(
+        "complete_goal",
+        "Mark an assigned goal complete (include evidence).",
+        {
+            "goal_id": {"type": "integer"},
+            "evidence": {"type": "string"},
+        },
+        ["goal_id"],
+    ),
+    _tool(
+        "update_goal_progress",
+        "Report progress (0..1) on an assigned goal.",
+        {
+            "goal_id": {"type": "integer"},
+            "progress": {"type": "number"},
+            "observation": {"type": "string"},
+        },
+        ["goal_id", "progress"],
+    ),
+    _tool(
+        "object_to_decision",
+        "Object to an announced decision before it becomes effective.",
+        {
+            "decision_id": {"type": "integer"},
+            "reason": {"type": "string"},
+        },
+        ["decision_id", "reason"],
+    ),
+    _tool(
+        "create_skill",
+        "Save a reusable skill (recipe) for the room.",
+        {
+            "name": {"type": "string"},
+            "content": {"type": "string"},
+            "activation_context": {"type": "string"},
+        },
+        ["name", "content"],
+    ),
+] + _SHARED_TOOLS
+
+
+def execute_queen_tool(
+    db: Database,
+    room_id: int,
+    worker_id: int,
+    name: str,
+    args: dict,
+) -> str:
+    """Dispatch one tool call; returns the string shown to the model."""
+    try:
+        return _dispatch(db, room_id, worker_id, name, args or {})
+    except Exception as e:
+        return f"tool error: {type(e).__name__}: {e}"
+
+
+def _dispatch(
+    db: Database, room_id: int, worker_id: int, name: str, args: dict
+) -> str:
+    if name == "set_goal":
+        gid = goals_mod.create_goal(
+            db, room_id, args["description"],
+            parent_goal_id=args.get("parent_goal_id"),
+        )
+        return f"goal #{gid} created"
+
+    if name == "delegate":
+        target = workers_mod.get_worker(db, int(args["worker_id"]))
+        if target is None or target["room_id"] != room_id:
+            return f"no worker #{args['worker_id']} in this room"
+        gid = goals_mod.create_goal(
+            db, room_id, args["description"],
+            parent_goal_id=args.get("parent_goal_id"),
+            assigned_worker_id=target["id"],
+        )
+        log_room_activity(
+            db, room_id, "delegate",
+            f"Delegated to {target['name']}: {args['description']}",
+            actor_id=worker_id,
+        )
+        from .agent_loop import trigger_agent
+
+        trigger_agent(db, room_id, target["id"])
+        return f"goal #{gid} delegated to {target['name']}"
+
+    if name == "announce_decision":
+        # dedupe: identical open proposal -> return existing
+        for d in quorum_mod.pending_decisions(db, room_id):
+            if d["proposal"] == args["proposal"]:
+                return f"decision #{d['id']} already announced"
+        d = quorum_mod.announce(
+            db, room_id, worker_id, args["proposal"],
+            args.get("decision_type", "low_impact"),
+        )
+        return f"decision #{d['id']} {d['status']}"
+
+    if name == "create_worker":
+        wid = workers_mod.create_worker(
+            db,
+            name=args["name"],
+            system_prompt=args.get("system_prompt", ""),
+            room_id=room_id,
+            role=args["role"],
+        )
+        log_room_activity(
+            db, room_id, "worker",
+            f"Created worker {args['name']} ({args['role']})",
+            actor_id=worker_id,
+        )
+        return f"worker #{wid} created"
+
+    if name == "update_worker":
+        wid = int(args.pop("worker_id"))
+        target = workers_mod.get_worker(db, wid)
+        if target is None or target["room_id"] != room_id:
+            return f"no worker #{wid} in this room"
+        workers_mod.update_worker(db, wid, **args)
+        return f"worker #{wid} updated"
+
+    if name == "configure_room":
+        rooms_mod.update_room(db, room_id, **args)
+        return "room configured"
+
+    if name == "escalate_to_keeper":
+        eid = escalations_mod.create_escalation(
+            db, room_id, args["question"], from_agent_id=worker_id
+        )
+        event_bus.emit(
+            "escalation:created", f"room:{room_id}", {"id": eid}
+        )
+        return f"escalation #{eid} sent to keeper"
+
+    if name == "wallet_status":
+        w = wallet_mod.get_room_wallet(db, room_id)
+        if w is None:
+            return "no wallet for this room"
+        txs = wallet_mod.list_transactions(db, w["id"])[:5]
+        return json.dumps(
+            {"address": w["address"], "chain": w["chain"],
+             "recent_transactions": txs}
+        )
+
+    if name == "complete_goal":
+        goal = goals_mod.get_goal(db, int(args["goal_id"]))
+        if goal is None or goal["room_id"] != room_id:
+            return f"no goal #{args['goal_id']} in this room"
+        if args.get("evidence"):
+            goals_mod.add_goal_update(
+                db, goal["id"], args["evidence"], worker_id=worker_id
+            )
+        goals_mod.complete_goal(db, goal["id"])
+        log_room_activity(
+            db, room_id, "goal",
+            f"Goal completed: {goal['description']}", actor_id=worker_id,
+        )
+        return f"goal #{goal['id']} completed"
+
+    if name == "update_goal_progress":
+        goal = goals_mod.get_goal(db, int(args["goal_id"]))
+        if goal is None or goal["room_id"] != room_id:
+            return f"no goal #{args['goal_id']} in this room"
+        goals_mod.add_goal_update(
+            db, goal["id"], args.get("observation", ""),
+            worker_id=worker_id,
+            metric_value=float(args["progress"]),
+        )
+        return f"goal #{goal['id']} progress={args['progress']}"
+
+    if name == "object_to_decision":
+        d = quorum_mod.object_to(
+            db, int(args["decision_id"]), worker_id, args["reason"]
+        )
+        return f"objected to decision #{d['id']}"
+
+    if name == "create_skill":
+        sid = skills_mod.create_skill(
+            db, args["name"], args["content"], room_id=room_id,
+            activation_context=args.get("activation_context"),
+            agent_created=True, created_by_worker_id=worker_id,
+        )
+        return f"skill #{sid} saved"
+
+    if name == "remember":
+        eid = memory_mod.remember(
+            db, args["name"], args["content"],
+            category=args.get("category"), room_id=room_id,
+        )
+        return f"remembered as entity #{eid}"
+
+    if name == "recall":
+        hits = memory_mod.hybrid_search(
+            db, args["query"], query_vector=_embed_query(args["query"]),
+            room_id=room_id,
+        )
+        if not hits:
+            return "no memories found"
+        return "\n".join(
+            f"- {h['name']}: {'; '.join(h['observations'][-2:])}"
+            for h in hits
+        )
+
+    if name == "send_message":
+        to = str(args["to"])
+        if to == "keeper":
+            messages_mod.add_chat_message(
+                db, room_id, "assistant", args["body"]
+            )
+            event_bus.emit(
+                "chat:message", f"room:{room_id}", {"body": args["body"]}
+            )
+            return "message delivered to keeper"
+        try:
+            to_id = int(to)
+        except ValueError:
+            return f"unknown recipient {to!r}"
+        if rooms_mod.get_room(db, to_id) is None:
+            return f"no room #{to_id}"
+        messages_mod.send_room_message(
+            db, room_id, to_id, args.get("subject", ""), args["body"]
+        )
+        return f"message sent to room #{to_id}"
+
+    if name == "save_wip":
+        workers_mod.save_wip(db, worker_id, args["note"][:WIP_MAX_CHARS])
+        return "WIP saved"
+
+    if name == "web_fetch":
+        from .web_tools import web_fetch
+
+        return web_fetch(args["url"])
+
+    if name == "web_search":
+        from .web_tools import web_search
+
+        return web_search(args["query"])
+
+    return f"unknown tool {name!r}"
+
+
+def _embed_query(query: str):
+    """Query embedding via the on-mesh embedder when it is live; None
+    degrades recall to FTS-only."""
+    try:
+        from ..serving.embed_service import embed_texts
+
+        return embed_texts([query])[0]
+    except Exception:
+        return None

@@ -1,0 +1,93 @@
+"""LLM rate-limit detection + wait policy (reference:
+src/shared/rate-limit.ts — regex detection, reset-time parsing, wait
+clamped 30 s–60 min, abortable sleep)."""
+
+from __future__ import annotations
+
+import re
+import threading
+from datetime import datetime, timezone
+from typing import Optional
+
+WAIT_MIN_S = 30.0
+WAIT_MAX_S = 60 * 60.0
+WAIT_DEFAULT_S = 5 * 60.0
+MAX_RETRIES = 3
+
+_PATTERNS = [
+    re.compile(p, re.IGNORECASE)
+    for p in (
+        r"rate[ _-]?limit",
+        r"usage[ _-]?limit",
+        r"too many requests",
+        r"\b429\b",
+        r"quota exceeded",
+        r"overloaded",
+        r"capacity .*exceeded",
+    )
+]
+
+_RESET_AT = re.compile(
+    r"reset(?:s)?\s+at\s+(\d{1,2}):(\d{2})\s*(am|pm)?", re.IGNORECASE
+)
+_RESET_IN = re.compile(
+    r"(?:in|after)\s+(\d+)\s*(seconds?|secs?|minutes?|mins?|hours?|hrs?)",
+    re.IGNORECASE,
+)
+_RESET_TS = re.compile(r"reset[^0-9]*(1[6-9]\d{8})")
+
+
+def detect_rate_limit(text: str) -> Optional[float]:
+    """Returns the wait in seconds if the text looks like a rate-limit
+    failure, else None."""
+    if not text or not any(p.search(text) for p in _PATTERNS):
+        return None
+    return clamp_wait(parse_reset_wait(text))
+
+
+def parse_reset_wait(text: str) -> float:
+    m = _RESET_IN.search(text)
+    if m:
+        n, unit = int(m.group(1)), m.group(2).lower()
+        if unit.startswith(("sec",)):
+            return float(n)
+        if unit.startswith(("min",)):
+            return n * 60.0
+        return n * 3600.0
+
+    m = _RESET_TS.search(text)
+    if m:
+        ts = int(m.group(1))
+        return ts - datetime.now(timezone.utc).timestamp()
+
+    m = _RESET_AT.search(text)
+    if m:
+        hour, minute = int(m.group(1)), int(m.group(2))
+        ampm = (m.group(3) or "").lower()
+        if ampm == "pm" and hour < 12:
+            hour += 12
+        if ampm == "am" and hour == 12:
+            hour = 0
+        now = datetime.now()
+        target = now.replace(
+            hour=hour % 24, minute=minute, second=0, microsecond=0
+        )
+        wait = (target - now).total_seconds()
+        if wait < 0:
+            wait += 24 * 3600
+        return wait
+
+    return WAIT_DEFAULT_S
+
+
+def clamp_wait(wait_s: float) -> float:
+    return max(WAIT_MIN_S, min(WAIT_MAX_S, wait_s))
+
+
+def abortable_sleep(
+    seconds: float, abort: Optional[threading.Event] = None
+) -> bool:
+    """Sleep up to `seconds`; returns True if aborted early."""
+    if abort is None:
+        abort = threading.Event()
+    return abort.wait(timeout=seconds)

@@ -1,0 +1,94 @@
+"""Keyless web access for agents (reference: src/shared/web-tools.ts —
+Jina Reader + DDG via a persistent browser; here: stdlib HTTP with
+readable-text extraction, fail-closed offline).
+
+A browser-automation backend can be layered in later; the tool contract
+(web_fetch/web_search returning text) stays the same."""
+
+from __future__ import annotations
+
+import html.parser
+import json
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+
+FETCH_TIMEOUT_S = 20
+MAX_TEXT_CHARS = 8000
+_UA = "Mozilla/5.0 (compatible; room-tpu/0.1)"
+
+
+class _TextExtractor(html.parser.HTMLParser):
+    SKIP = {"script", "style", "noscript", "svg", "head"}
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._skip_depth = 0
+        self.chunks: list[str] = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag in self.SKIP:
+            self._skip_depth += 1
+
+    def handle_endtag(self, tag):
+        if tag in self.SKIP and self._skip_depth > 0:
+            self._skip_depth -= 1
+
+    def handle_data(self, data):
+        if self._skip_depth == 0 and data.strip():
+            self.chunks.append(data.strip())
+
+
+def _extract_text(html_text: str) -> str:
+    p = _TextExtractor()
+    try:
+        p.feed(html_text)
+    except Exception:
+        pass
+    text = "\n".join(p.chunks)
+    return re.sub(r"\n{3,}", "\n\n", text)
+
+
+def web_fetch(url: str) -> str:
+    if not url.startswith(("http://", "https://")):
+        return f"invalid url: {url!r}"
+    req = urllib.request.Request(url, headers={"User-Agent": _UA})
+    try:
+        with urllib.request.urlopen(req, timeout=FETCH_TIMEOUT_S) as resp:
+            raw = resp.read(2_000_000)
+            ctype = resp.headers.get("Content-Type", "")
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return f"fetch failed: {e} (network may be unavailable)"
+    body = raw.decode("utf-8", errors="replace")
+    if "html" in ctype:
+        body = _extract_text(body)
+    return body[:MAX_TEXT_CHARS]
+
+
+def web_search(query: str, max_results: int = 5) -> str:
+    """DuckDuckGo HTML endpoint, parsed for title/url/snippet."""
+    url = (
+        "https://html.duckduckgo.com/html/?q="
+        + urllib.parse.quote(query)
+    )
+    req = urllib.request.Request(url, headers={"User-Agent": _UA})
+    try:
+        with urllib.request.urlopen(req, timeout=FETCH_TIMEOUT_S) as resp:
+            body = resp.read().decode("utf-8", errors="replace")
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return f"search failed: {e} (network may be unavailable)"
+
+    results = []
+    for m in re.finditer(
+        r'<a[^>]+class="result__a"[^>]+href="([^"]+)"[^>]*>(.*?)</a>',
+        body,
+        re.DOTALL,
+    ):
+        href, title = m.group(1), re.sub(r"<[^>]+>", "", m.group(2))
+        results.append({"title": title.strip(), "url": href})
+        if len(results) >= max_results:
+            break
+    if not results:
+        return "no results"
+    return json.dumps(results, indent=1)

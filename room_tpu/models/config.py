@@ -111,7 +111,9 @@ def tiny_moe(vocab_size: int = 512) -> DecoderConfig:
         top_k=2,
         moe_intermediate=32,
         dtype="float32",
-        max_seq_len=512,
+        # generous context: agent prompts under the byte tokenizer run
+        # thousands of tokens even for the tiny test model
+        max_seq_len=8192,
     )
 
 
@@ -129,7 +131,7 @@ def tiny_dense(vocab_size: int = 512) -> DecoderConfig:
         qkv_bias=True,
         qk_norm=False,
         dtype="float32",
-        max_seq_len=512,
+        max_seq_len=8192,
     )
 
 

@@ -1,0 +1,31 @@
+from .base import (
+    ExecutionRequest,
+    ExecutionResult,
+    Provider,
+    ProviderError,
+    RateLimitExceeded,
+    ToolDef,
+)
+from .registry import (
+    get_model_auth_status,
+    get_model_provider,
+    model_name,
+    normalize_model,
+    provider_kind,
+    reset_provider_cache,
+)
+
+__all__ = [
+    "ExecutionRequest",
+    "ExecutionResult",
+    "Provider",
+    "ProviderError",
+    "RateLimitExceeded",
+    "ToolDef",
+    "get_model_auth_status",
+    "get_model_provider",
+    "model_name",
+    "normalize_model",
+    "provider_kind",
+    "reset_provider_cache",
+]

@@ -1,0 +1,70 @@
+"""Provider abstraction: one interface over every way a turn can execute.
+
+Mirrors the reference's executor contract (reference:
+src/shared/agent-executor.ts:11-39 AgentExecutionOptions /
+executeAgent:91) — prompt + system + tools + tool-callback + session
+continuity — with the tpu: provider as the first-class in-tree path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Protocol
+
+# OpenAI-format tool definition
+ToolDef = dict  # {"name","description","parameters": json-schema}
+
+# on_tool_call(name, arguments) -> result string
+ToolCallback = Callable[[str, dict], str]
+
+
+@dataclass
+class ExecutionRequest:
+    prompt: str
+    system_prompt: Optional[str] = None
+    model: str = "tpu"
+    tools: list[ToolDef] = field(default_factory=list)
+    on_tool_call: Optional[ToolCallback] = None
+    max_turns: int = 10
+    timeout_s: float = 900.0
+    # continuity: either a provider-native session id, or the full
+    # message history for stateless API providers
+    session_id: Optional[str] = None
+    messages: Optional[list[dict]] = None
+    temperature: float = 0.7
+    max_new_tokens: int = 1024
+    on_text: Optional[Callable[[str], None]] = None
+
+
+@dataclass
+class ExecutionResult:
+    text: str = ""
+    success: bool = True
+    error: Optional[str] = None
+    session_id: Optional[str] = None
+    messages: Optional[list[dict]] = None
+    input_tokens: int = 0
+    output_tokens: int = 0
+    tool_calls: list[dict] = field(default_factory=list)
+    turns_used: int = 0
+
+
+class Provider(Protocol):
+    name: str
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult: ...
+
+    def is_ready(self) -> tuple[bool, str]: ...
+
+
+class ProviderError(RuntimeError):
+    pass
+
+
+class RateLimitExceeded(ProviderError):
+    """Raised (or recorded) when the underlying model reports a
+    rate/usage limit; carries the suggested wait."""
+
+    def __init__(self, message: str, wait_s: float = 300.0) -> None:
+        super().__init__(message)
+        self.wait_s = wait_s

@@ -1,0 +1,71 @@
+"""Deterministic fake provider for hermetic tests — the equivalent of the
+reference suite's mocked executor boundary (reference:
+src/shared/__tests__/agent-loop.test.ts:7-19 vi.mock('../agent-executor')).
+
+Behavior is scriptable per instance:
+- default: echoes a digest of the prompt
+- `responses` queue: pop one per call
+- `tool_script`: list of (tool_name, arguments) the fake "model" calls
+  through on_tool_call before emitting its final text
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .base import ExecutionRequest, ExecutionResult
+
+
+@dataclass
+class EchoProvider:
+    script: str = ""
+    name: str = "echo"
+    responses: list[str] = field(default_factory=list)
+    tool_script: list[tuple[str, dict]] = field(default_factory=list)
+    fail_with: Optional[str] = None
+    calls: list[ExecutionRequest] = field(default_factory=list)
+
+    def is_ready(self) -> tuple[bool, str]:
+        return True, "echo provider always ready"
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        self.calls.append(request)
+        if self.fail_with:
+            return ExecutionResult(
+                success=False, error=self.fail_with,
+                session_id=request.session_id,
+            )
+
+        tool_calls = []
+        turns = 1
+        if request.on_tool_call is not None:
+            for name, args in self.tool_script[: request.max_turns]:
+                result = request.on_tool_call(name, args)
+                tool_calls.append(
+                    {"name": name, "arguments": args, "result": result}
+                )
+                turns += 1
+
+        if self.responses:
+            text = self.responses.pop(0)
+        else:
+            text = f"echo: {request.prompt[:120]}"
+        if request.on_text:
+            request.on_text(text)
+
+        prompt_len = len(request.prompt.split())
+        return ExecutionResult(
+            text=text,
+            success=True,
+            session_id=request.session_id or "echo-session",
+            messages=(request.messages or [])
+            + [
+                {"role": "user", "content": request.prompt},
+                {"role": "assistant", "content": text},
+            ],
+            input_tokens=prompt_len,
+            output_tokens=len(text.split()),
+            tool_calls=tool_calls,
+            turns_used=turns,
+        )

@@ -1,0 +1,279 @@
+"""HTTP API providers: OpenAI-compatible (OpenAI / Gemini / Ollama
+localhost) and Anthropic — the external-provider paths the reference
+drives with fetch (reference: src/shared/agent-executor.ts:327-740).
+
+All requests go through one seam (`_post_json`) so tests can stub the
+network; failures are fail-closed with rate-limit detection feeding the
+engine's backoff."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+from ..core.rate_limit import detect_rate_limit
+from .base import (
+    ExecutionRequest,
+    ExecutionResult,
+    ProviderError,
+    RateLimitExceeded,
+)
+
+API_BASES = {
+    "openai": "https://api.openai.com/v1",
+    "gemini": "https://generativelanguage.googleapis.com/v1beta/openai",
+    "ollama": "http://127.0.0.1:11434/v1",
+    "anthropic": "https://api.anthropic.com/v1",
+}
+
+KEY_ENV = {
+    "openai": "OPENAI_API_KEY",
+    "gemini": "GEMINI_API_KEY",
+    "anthropic": "ANTHROPIC_API_KEY",
+    "ollama": None,
+}
+
+
+def _post_json(
+    url: str, body: dict, headers: dict, timeout: float
+) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **headers},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        payload = e.read().decode(errors="replace")
+        wait = detect_rate_limit(payload) or (
+            60.0 if e.code == 429 else None
+        )
+        if wait is not None:
+            raise RateLimitExceeded(payload[:500], wait) from e
+        raise ProviderError(f"HTTP {e.code}: {payload[:500]}") from e
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise ProviderError(f"network unreachable: {e}") from e
+
+
+def _resolve_key(kind: str, db) -> Optional[str]:
+    env = KEY_ENV.get(kind)
+    if env is None:
+        return None  # keyless (ollama)
+    if db is not None:
+        from ..core.credentials import resolve_api_key
+
+        v = resolve_api_key(db, env)
+        if v:
+            return v
+    return os.environ.get(env)
+
+
+class OpenAICompatProvider:
+    def __init__(self, kind: str, model: str, db=None) -> None:
+        self.name = kind
+        self.model = model
+        self._db = db
+        self.base = os.environ.get(
+            f"ROOM_TPU_{kind.upper()}_BASE", API_BASES[kind]
+        )
+
+    def is_ready(self) -> tuple[bool, str]:
+        if KEY_ENV.get(self.name) is None:
+            return True, "keyless provider"
+        key = _resolve_key(self.name, self._db)
+        if key:
+            return True, "api key resolved"
+        return False, f"no {KEY_ENV[self.name]} available"
+
+    def _headers(self) -> dict:
+        key = _resolve_key(self.name, self._db)
+        return {"Authorization": f"Bearer {key}"} if key else {}
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        messages = list(request.messages or [])
+        if not messages and request.system_prompt:
+            messages.append(
+                {"role": "system", "content": request.system_prompt}
+            )
+        messages.append({"role": "user", "content": request.prompt})
+
+        tools = [
+            {"type": "function", "function": t} for t in request.tools
+        ] or None
+        result = ExecutionResult(session_id=request.session_id)
+
+        for _ in range(max(request.max_turns, 1)):
+            body: dict[str, Any] = {
+                "model": self.model,
+                "messages": messages,
+                "temperature": request.temperature,
+            }
+            if tools:
+                body["tools"] = tools
+            try:
+                out = _post_json(
+                    f"{self.base}/chat/completions", body,
+                    self._headers(), request.timeout_s,
+                )
+            except RateLimitExceeded:
+                raise
+            except ProviderError as e:
+                result.success = False
+                result.error = str(e)
+                result.messages = messages
+                return result
+
+            usage = out.get("usage", {})
+            result.input_tokens += usage.get("prompt_tokens", 0)
+            result.output_tokens += usage.get("completion_tokens", 0)
+            result.turns_used += 1
+
+            choice = out.get("choices", [{}])[0]
+            msg = choice.get("message", {})
+            messages.append(msg)
+            calls = msg.get("tool_calls")
+            if calls and request.on_tool_call:
+                for call in calls:
+                    fn = call.get("function", {})
+                    try:
+                        args = json.loads(fn.get("arguments") or "{}")
+                    except json.JSONDecodeError:
+                        args = {}
+                    tool_out = request.on_tool_call(
+                        fn.get("name", ""), args
+                    )
+                    result.tool_calls.append(
+                        {"name": fn.get("name"), "arguments": args,
+                         "result": tool_out}
+                    )
+                    messages.append(
+                        {
+                            "role": "tool",
+                            "tool_call_id": call.get("id", ""),
+                            "content": tool_out,
+                        }
+                    )
+                continue
+
+            result.text = msg.get("content") or ""
+            if request.on_text:
+                request.on_text(result.text)
+            result.messages = messages
+            return result
+
+        result.success = False
+        result.error = f"max_turns {request.max_turns} exceeded"
+        result.messages = messages
+        return result
+
+
+class AnthropicProvider:
+    def __init__(self, model: str, db=None) -> None:
+        self.name = "anthropic"
+        self.model = model
+        self._db = db
+        self.base = os.environ.get(
+            "ROOM_TPU_ANTHROPIC_BASE", API_BASES["anthropic"]
+        )
+
+    def is_ready(self) -> tuple[bool, str]:
+        key = _resolve_key("anthropic", self._db)
+        return (True, "api key resolved") if key else (
+            False, "no ANTHROPIC_API_KEY available"
+        )
+
+    def _headers(self) -> dict:
+        return {
+            "x-api-key": _resolve_key("anthropic", self._db) or "",
+            "anthropic-version": "2023-06-01",
+        }
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        messages = list(request.messages or [])
+        messages.append({"role": "user", "content": request.prompt})
+        tools = [
+            {
+                "name": t["name"],
+                "description": t.get("description", ""),
+                "input_schema": t.get(
+                    "parameters", {"type": "object", "properties": {}}
+                ),
+            }
+            for t in request.tools
+        ] or None
+
+        result = ExecutionResult(session_id=request.session_id)
+        for _ in range(max(request.max_turns, 1)):
+            body: dict[str, Any] = {
+                "model": self.model,
+                "max_tokens": request.max_new_tokens,
+                "messages": messages,
+            }
+            if request.system_prompt:
+                body["system"] = request.system_prompt
+            if tools:
+                body["tools"] = tools
+            try:
+                out = _post_json(
+                    f"{self.base}/messages", body, self._headers(),
+                    request.timeout_s,
+                )
+            except RateLimitExceeded:
+                raise
+            except ProviderError as e:
+                result.success = False
+                result.error = str(e)
+                result.messages = messages
+                return result
+
+            usage = out.get("usage", {})
+            result.input_tokens += usage.get("input_tokens", 0)
+            result.output_tokens += usage.get("output_tokens", 0)
+            result.turns_used += 1
+
+            content = out.get("content", [])
+            messages.append({"role": "assistant", "content": content})
+            tool_uses = [
+                c for c in content if c.get("type") == "tool_use"
+            ]
+            if tool_uses and request.on_tool_call:
+                tool_results = []
+                for tu in tool_uses:
+                    tool_out = request.on_tool_call(
+                        tu.get("name", ""), tu.get("input", {}) or {}
+                    )
+                    result.tool_calls.append(
+                        {"name": tu.get("name"),
+                         "arguments": tu.get("input"),
+                         "result": tool_out}
+                    )
+                    tool_results.append(
+                        {
+                            "type": "tool_result",
+                            "tool_use_id": tu.get("id", ""),
+                            "content": tool_out,
+                        }
+                    )
+                messages.append(
+                    {"role": "user", "content": tool_results}
+                )
+                continue
+
+            result.text = "".join(
+                c.get("text", "") for c in content
+                if c.get("type") == "text"
+            )
+            if request.on_text:
+                request.on_text(result.text)
+            result.messages = messages
+            return result
+
+        result.success = False
+        result.error = f"max_turns {request.max_turns} exceeded"
+        result.messages = messages
+        return result

@@ -1,0 +1,98 @@
+"""Model-provider registry (reference: src/shared/model-provider.ts —
+model-string grammar → provider, readiness probes, normalization).
+
+Grammar:
+    tpu / tpu:<model-name>      in-tree TPU serving engine (default)
+    echo / echo:<script>        deterministic fake for hermetic tests
+    openai:<model>              OpenAI-compatible HTTP API
+    anthropic:<model>           Anthropic HTTP API
+    gemini:<model>              Gemini HTTP API (OpenAI-compat endpoint)
+    ollama:<tag>                localhost Ollama daemon (compat path)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database
+from .base import Provider, ProviderError
+
+PROVIDER_PREFIXES = (
+    "tpu", "echo", "openai", "anthropic", "gemini", "ollama",
+)
+DEFAULT_MODEL = "tpu"
+DEFAULT_TPU_MODEL = "qwen3-coder-30b"
+
+_instances: dict[str, Provider] = {}
+
+
+def normalize_model(model: Optional[str]) -> str:
+    if not model or model.strip() == "":
+        return DEFAULT_MODEL
+    return model.strip()
+
+
+def provider_kind(model: Optional[str]) -> str:
+    model = normalize_model(model)
+    head = model.split(":", 1)[0]
+    if head in PROVIDER_PREFIXES:
+        return head
+    # bare model names route to the in-tree engine
+    return "tpu"
+
+
+def model_name(model: Optional[str]) -> str:
+    model = normalize_model(model)
+    if ":" in model:
+        return model.split(":", 1)[1]
+    if model in PROVIDER_PREFIXES:
+        return DEFAULT_TPU_MODEL if model == "tpu" else ""
+    return model
+
+
+def get_model_provider(
+    model: Optional[str], db: Optional[Database] = None
+) -> Provider:
+    kind = provider_kind(model)
+    key = f"{kind}:{model_name(model)}"
+    if key in _instances:
+        return _instances[key]
+
+    if kind == "echo":
+        from .echo import EchoProvider
+
+        inst: Provider = EchoProvider(script=model_name(model))
+    elif kind == "tpu":
+        from .tpu import TpuProvider
+
+        inst = TpuProvider(model_name(model) or DEFAULT_TPU_MODEL)
+    elif kind in ("openai", "gemini", "ollama"):
+        from .http_api import OpenAICompatProvider
+
+        inst = OpenAICompatProvider(kind, model_name(model), db=db)
+    elif kind == "anthropic":
+        from .http_api import AnthropicProvider
+
+        inst = AnthropicProvider(model_name(model), db=db)
+    else:  # pragma: no cover
+        raise ProviderError(f"unknown provider for model {model!r}")
+
+    _instances[key] = inst
+    return inst
+
+
+def get_model_auth_status(
+    model: Optional[str], db: Optional[Database] = None
+) -> dict:
+    """Readiness probe (reference: getModelAuthStatus): can this model
+    execute right now, and if not, why."""
+    kind = provider_kind(model)
+    try:
+        ready, detail = get_model_provider(model, db).is_ready()
+    except Exception as e:  # construction failure == not ready
+        ready, detail = False, str(e)
+    return {"provider": kind, "ready": ready, "detail": detail}
+
+
+def reset_provider_cache() -> None:
+    _instances.clear()

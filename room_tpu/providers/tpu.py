@@ -1,0 +1,258 @@
+"""The tpu: provider — in-tree serving replacing the reference's Ollama
+daemon (reference: src/shared/local-model.ts pinned qwen3-coder:30b;
+src/server/local-model.ts hardware gate / install session).
+
+A ModelHost owns one served model: mesh, sharded params, tokenizer, and a
+ServingEngine running on a background thread. The provider implements the
+multi-turn tool loop *on top of parked decode*: when the engine stops a
+turn at a closed <tool_call> block, the session's KV pages stay resident,
+the host runs the tool, and the conversation resumes with only the tool
+response prefilled (reference behavior: agent-executor.ts:404-471, but
+with suspended-KV resume instead of a stateless re-send).
+
+Weight resolution is fail-closed like the reference's Ollama probe
+(local-model.ts:69-108): a checkpoint directory must exist for real
+models; random-init is allowed only for tiny/bench models or when
+ROOM_TPU_ALLOW_RANDOM_INIT=1.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from ..models import config as model_configs
+from ..models import qwen3
+from .base import ExecutionRequest, ExecutionResult, ProviderError
+
+MODEL_CONFIGS: dict[str, Callable] = {
+    "qwen3-coder-30b": model_configs.qwen3_coder_30b,
+    "qwen2.5-72b": model_configs.qwen2_72b,
+    "tiny-moe": model_configs.tiny_moe,
+    "tiny-dense": model_configs.tiny_dense,
+}
+
+_hosts: dict[str, "ModelHost"] = {}
+_hosts_lock = threading.Lock()
+
+
+def _random_init_allowed(name: str) -> bool:
+    return (
+        name.startswith("tiny")
+        or os.environ.get("ROOM_TPU_ALLOW_RANDOM_INIT") == "1"
+    )
+
+
+def checkpoint_dir(name: str) -> Optional[str]:
+    base = os.environ.get("ROOM_TPU_CKPT_DIR")
+    if not base:
+        return None
+    path = os.path.join(base, name)
+    return path if os.path.isdir(path) else None
+
+
+class ModelHost:
+    """One served model: engine + tokenizer + background scheduler."""
+
+    def __init__(self, name: str) -> None:
+        if name not in MODEL_CONFIGS:
+            raise ProviderError(
+                f"unknown tpu model {name!r}; known: "
+                f"{sorted(MODEL_CONFIGS)}"
+            )
+        self.name = name
+        self.cfg = MODEL_CONFIGS[name]()
+        self._engine = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    def readiness(self) -> tuple[bool, str]:
+        if checkpoint_dir(self.name):
+            return True, f"checkpoint found for {self.name}"
+        if _random_init_allowed(self.name):
+            return True, f"random-init allowed for {self.name}"
+        return False, (
+            f"no checkpoint for {self.name}: set ROOM_TPU_CKPT_DIR to a "
+            f"directory containing {self.name}/ (orbax tree), or "
+            "ROOM_TPU_ALLOW_RANDOM_INIT=1 for synthetic weights"
+        )
+
+    def engine(self):
+        with self._lock:
+            if self._engine is not None:
+                return self._engine
+            ok, why = self.readiness()
+            if not ok:
+                raise ProviderError(why)
+
+            import jax
+
+            from ..parallel import (
+                MeshSpec, decoder_param_specs, make_mesh, shard_pytree,
+            )
+            from ..serving import ServingEngine, load_tokenizer
+
+            params = qwen3.init_params(self.cfg, jax.random.PRNGKey(0))
+            ckpt = checkpoint_dir(self.name)
+            if ckpt:
+                from ..utils.checkpoint import load_params
+
+                params = load_params(ckpt, like=params)
+
+            mesh_env = os.environ.get("ROOM_TPU_MESH")
+            if mesh_env:
+                dp, ep, tp = (int(x) for x in mesh_env.split(","))
+                mesh = make_mesh(MeshSpec(dp, ep, tp))
+                params = shard_pytree(
+                    params, decoder_param_specs(self.cfg), mesh
+                )
+
+            self._engine = ServingEngine(
+                self.cfg,
+                params,
+                tokenizer=load_tokenizer(),
+                max_batch=int(os.environ.get("ROOM_TPU_MAX_BATCH", "8")),
+                page_size=int(os.environ.get("ROOM_TPU_PAGE_SIZE", "16")),
+                n_pages=int(os.environ.get("ROOM_TPU_N_PAGES", "2048")),
+            )
+            self._thread = threading.Thread(
+                target=self._engine.serve_forever,
+                args=(self._stop,),
+                daemon=True,
+                name=f"tpu-engine-{self.name}",
+            )
+            self._thread.start()
+            return self._engine
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def get_model_host(name: str) -> ModelHost:
+    with _hosts_lock:
+        if name not in _hosts:
+            _hosts[name] = ModelHost(name)
+        return _hosts[name]
+
+
+def reset_model_hosts() -> None:
+    with _hosts_lock:
+        for h in _hosts.values():
+            h.shutdown()
+        _hosts.clear()
+
+
+class TpuProvider:
+    def __init__(self, model_name: str) -> None:
+        self.name = "tpu"
+        self.model_name = model_name
+
+    def is_ready(self) -> tuple[bool, str]:
+        return get_model_host(self.model_name).readiness()
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        from ..serving import (
+            SamplingParams, extract_tool_call, render_chat,
+        )
+
+        host = get_model_host(self.model_name)
+        engine = host.engine()
+        tok = engine.tokenizer
+
+        messages = list(request.messages or [])
+        if not messages and request.system_prompt:
+            messages.append(
+                {"role": "system", "content": request.system_prompt}
+            )
+        messages.append({"role": "user", "content": request.prompt})
+
+        session_id = request.session_id or f"tpu-{time.monotonic_ns()}"
+        fresh_session = session_id not in engine.sessions
+
+        # a fresh session prefills the whole conversation; a resumed one
+        # only prefills the new user turn
+        if fresh_session:
+            prompt_text = render_chat(messages, request.tools)
+        else:
+            prompt_text = render_chat(
+                messages[-1:], None, add_generation_prompt=True
+            )
+
+        sampling = SamplingParams(
+            temperature=request.temperature,
+            top_p=0.95,
+            max_new_tokens=request.max_new_tokens,
+        )
+
+        deadline = time.monotonic() + request.timeout_s
+        result = ExecutionResult(session_id=session_id)
+        assistant_text = ""
+        prompt_tokens = tok.encode(prompt_text)
+
+        for turn_no in range(max(request.max_turns, 1)):
+            t = engine.submit(
+                prompt_tokens, session_id=session_id, sampling=sampling
+            )
+            remaining = deadline - time.monotonic()
+            if not t.done.wait(timeout=max(remaining, 0.001)):
+                result.success = False
+                result.error = f"timeout after {request.timeout_s}s"
+                break
+            result.turns_used += 1
+            result.input_tokens += len(prompt_tokens)
+            result.output_tokens += len(t.new_tokens)
+
+            text = engine.text_of(t)
+            if request.on_text:
+                request.on_text(text)
+
+            if t.finish_reason == "error":
+                result.success = False
+                result.error = t.error
+                break
+
+            if t.finish_reason == "tool_call" and request.on_tool_call:
+                call = extract_tool_call(text)
+                assistant_text += text
+                if call is None:
+                    # corrective nudge instead of failing the turn
+                    prompt_tokens = tok.encode(
+                        "\n<tool_response>\nerror: malformed tool call —"
+                        " emit exactly one JSON object with \"name\" and"
+                        " \"arguments\".\n</tool_response>\n"
+                    )
+                    continue
+                tool_result = request.on_tool_call(
+                    call.get("name", ""), call.get("arguments", {}) or {}
+                )
+                result.tool_calls.append(
+                    {
+                        "name": call.get("name"),
+                        "arguments": call.get("arguments"),
+                        "result": tool_result,
+                    }
+                )
+                # resume the parked session with only the tool response
+                prompt_tokens = tok.encode(
+                    f"\n<tool_response>\n{tool_result}\n"
+                    "</tool_response>\n"
+                )
+                continue
+
+            assistant_text += text
+            break
+        else:
+            result.success = False
+            result.error = f"max_turns {request.max_turns} exceeded"
+
+        # strip chat scaffolding from the visible reply
+        visible = assistant_text.replace("<|im_end|>", "").strip()
+        result.text = visible
+        messages.append({"role": "assistant", "content": visible})
+        result.messages = messages
+        return result

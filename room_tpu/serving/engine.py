@@ -43,7 +43,8 @@ from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
 from .sampler import SamplingParams, sample, sample_batched
 from .tokenizer import ByteTokenizer, Tokenizer
 
-PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                   16384, 32768)
 
 
 @dataclass
@@ -295,9 +296,13 @@ class ServingEngine:
             None,
         )
         capacity = self.max_pages_per_seq * self.page_size
-        if bucket is None or sess.length + bucket > capacity:
-            # the padded prefill must also fit the block table; reject
-            # rather than write past capacity
+        # the padded prefill must also fit the block table: clamp the
+        # bucket to the remaining page-aligned capacity (an off-bucket
+        # length near capacity costs one extra compile, not a rejection)
+        remaining = capacity - sess.length
+        if bucket is not None and bucket > remaining:
+            bucket = (remaining // self.page_size) * self.page_size
+        if bucket is None or bucket < len(prompt):
             turn.error = (
                 f"prompt too long: {len(prompt)} at session length "
                 f"{sess.length} (capacity {capacity})"

@@ -1,0 +1,42 @@
+"""Model checkpoint save/restore via orbax.
+
+Weights are immutable at serving time; per-session state lives in the KV
+pages (SURVEY.md §5 checkpoint/resume — the WIP/session tables map to
+paged-KV session ids, while model checkpoints are plain orbax trees)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def save_params(path: str, params: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params)
+    ckptr.wait_until_finished()
+
+
+def load_params(
+    path: str, like: Optional[Any] = None, shardings: Optional[Any] = None
+) -> Any:
+    """Restore a param pytree. `like` provides structure/dtypes;
+    `shardings` (a pytree of NamedSharding) restores directly onto the
+    mesh without a host round-trip."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if like is None:
+        return ckptr.restore(path)
+    target = like
+    if shardings is not None:
+        target = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            like, shardings,
+        )
+    return ckptr.restore(path, target)

@@ -1,0 +1,256 @@
+"""Agent-loop tests over the echo provider — the reference's pattern of
+mocking only the model boundary and asserting on prompt content, DB
+side-effects, and state transitions (reference:
+src/shared/__tests__/agent-loop.test.ts)."""
+
+import pytest
+
+from room_tpu.core import (
+    agent_loop, goals, memory, messages, quorum, rooms, workers,
+)
+from room_tpu.core.queen_tools import QUEEN_TOOLS, WORKER_TOOLS, execute_queen_tool
+from room_tpu.providers import reset_provider_cache, get_model_provider
+from room_tpu.providers.echo import EchoProvider
+
+
+@pytest.fixture()
+def room(db):
+    r = rooms.create_room(
+        db, "hive", goal="grow revenue", worker_model="echo",
+        create_wallet=False,
+    )
+    agent_loop.set_room_launch_enabled(r["id"], True)
+    yield r
+    agent_loop.set_room_launch_enabled(r["id"], False)
+
+
+@pytest.fixture()
+def echo(room):
+    reset_provider_cache()
+    provider = get_model_provider("echo")
+    provider.responses.clear()
+    provider.tool_script.clear()
+    provider.calls.clear()
+    provider.fail_with = None
+    return provider
+
+
+def queen_of(db, room):
+    return workers.get_worker(db, room["queen_worker_id"])
+
+
+def test_cycle_records_tokens_and_status(db, room, echo):
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    assert cycle["status"] == "success"
+    assert cycle["input_tokens"] > 0 and cycle["output_tokens"] > 0
+    assert cycle["finished_at"] is not None
+
+
+def test_prompt_assembly_order_and_content(db, room, echo):
+    queen = queen_of(db, room)
+    workers.save_wip(db, queen["id"], "halfway through pricing analysis")
+    memory.remember(db, "pricing data", "competitor charges $40",
+                    room_id=room["id"])
+    quorum.announce(db, room["id"], queen["id"], "redo website",
+                    "high_impact")
+    messages.add_chat_message(db, room["id"], "user", "status update?")
+
+    agent_loop.run_cycle(db, room, queen)
+    prompt = echo.calls[-1].prompt
+
+    assert "CONTINUE FORWARD" in prompt
+    assert "halfway through pricing analysis" in prompt
+    assert "Room objective: grow revenue" in prompt
+    assert "pricing data" in prompt
+    assert "redo website" in prompt
+    assert "status update?" in prompt
+    assert prompt.index("CONTINUE FORWARD") < prompt.index("Room objective")
+    # queen gets the queen tool set
+    tool_names = {t["name"] for t in echo.calls[-1].tools}
+    assert "delegate" in tool_names and "announce_decision" in tool_names
+
+
+def test_worker_gets_worker_tools_and_assignments(db, room, echo):
+    wid = workers.create_worker(db, "W", "do things", room_id=room["id"],
+                                role="executor", model="echo")
+    root = goals.get_root_goal(db, room["id"])
+    goals.create_goal(db, room["id"], "ship feature x",
+                      parent_goal_id=root["id"], assigned_worker_id=wid)
+    w = workers.get_worker(db, wid)
+    agent_loop.run_cycle(db, room, w)
+    req = echo.calls[-1]
+    assert "ship feature x" in req.prompt
+    names = {t["name"] for t in req.tools}
+    assert "complete_goal" in names and "delegate" not in names
+
+
+def test_queen_alone_gets_executor(db, room, echo):
+    assert len(workers.list_room_workers(db, room["id"])) == 1
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    team = workers.list_room_workers(db, room["id"])
+    assert len(team) == 2
+    assert any(w["role"] == "executor" for w in team)
+
+
+def test_cycle_checks_expired_decisions(db, room, echo):
+    d = quorum.announce(db, room["id"], None, "x", "high_impact",
+                        delay_minutes=0)
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    assert quorum.get_decision(db, d["id"])["status"] == "effective"
+
+
+def test_session_rotation_after_20_cycles(db, room, echo):
+    queen = queen_of(db, room)
+    db.execute(
+        "INSERT INTO agent_sessions(worker_id, session_id, model, "
+        "turn_count) VALUES (?,?,?,?)",
+        (queen["id"], "old-session", "echo", 20),
+    )
+    agent_loop.run_cycle(db, room, queen)
+    # rotated: the request must NOT carry the old session
+    assert echo.calls[-1].session_id is None
+    row = db.query_one("SELECT * FROM agent_sessions WHERE worker_id=?",
+                       (queen["id"],))
+    assert row["turn_count"] == 1
+
+
+def test_session_persists_and_increments(db, room, echo):
+    queen = queen_of(db, room)
+    agent_loop.run_cycle(db, room, queen)
+    agent_loop.run_cycle(db, room, queen)
+    row = db.query_one("SELECT * FROM agent_sessions WHERE worker_id=?",
+                       (queen["id"],))
+    assert row["turn_count"] == 2
+    assert row["session_id"] == "echo-session"
+
+
+def test_history_compression_at_threshold(db, room, echo):
+    import json as _json
+
+    queen = queen_of(db, room)
+    long_history = [
+        {"role": "user", "content": f"msg {i}"} for i in range(32)
+    ]
+    db.execute(
+        "INSERT INTO agent_sessions(worker_id, session_id, messages_json, "
+        "model, turn_count) VALUES (?,?,?,?,?)",
+        (queen["id"], "s", _json.dumps(long_history), "echo", 3),
+    )
+    echo.responses.append("SUMMARY-OF-HISTORY")  # compression call
+    agent_loop.run_cycle(db, room, queen)
+    # the compressed history was handed to the provider
+    cycle_req = echo.calls[-1]
+    assert cycle_req.messages is not None
+    assert len(cycle_req.messages) < 32
+    assert "SUMMARY-OF-HISTORY" in cycle_req.messages[0]["content"]
+    # and the summary was persisted to room memory
+    hits = memory.fts_search(db, "SUMMARY", room_id=room["id"])
+    assert hits
+
+
+def test_auto_wip_fallback(db, room, echo):
+    queen = queen_of(db, room)
+    echo.responses.append("I analyzed the funnel and found issues.")
+    agent_loop.run_cycle(db, room, queen)
+    w = workers.get_worker(db, queen["id"])
+    assert w["wip"].startswith("[auto]")
+    assert "analyzed the funnel" in w["wip"]
+
+
+def test_rate_limit_raises_typed_error(db, room, echo):
+    echo.fail_with = "429 rate limit exceeded, retry in 2 minutes"
+    from room_tpu.providers import RateLimitExceeded
+
+    with pytest.raises(RateLimitExceeded) as e:
+        agent_loop.run_cycle(db, room, queen_of(db, room))
+    assert e.value.wait_s == 120.0
+    cycle = db.query_one(
+        "SELECT * FROM worker_cycles ORDER BY id DESC LIMIT 1"
+    )
+    assert cycle["status"] == "error"
+
+
+def test_stuck_detector_note(db, room, echo):
+    queen = queen_of(db, room)
+    for _ in range(5):
+        db.insert(
+            "INSERT INTO worker_cycles(worker_id, room_id, status) "
+            "VALUES (?,?,'error')",
+            (queen["id"], room["id"]),
+        )
+    agent_loop.run_cycle(db, room, queen)
+    assert "keep failing" in echo.calls[-1].prompt
+
+
+def test_delegate_tool_creates_goal_and_assigns(db, room, echo):
+    queen = queen_of(db, room)
+    wid = workers.create_worker(db, "Builder", "p", room_id=room["id"],
+                                role="executor")
+    out = execute_queen_tool(
+        db, room["id"], queen["id"], "delegate",
+        {"description": "build the landing page", "worker_id": wid},
+    )
+    assert "delegated to Builder" in out
+    assigned = goals.active_goals_for_worker(db, wid)
+    assert len(assigned) == 1
+
+
+def test_tool_errors_are_returned_not_raised(db, room, echo):
+    out = execute_queen_tool(
+        db, room["id"], 1, "object_to_decision",
+        {"decision_id": 999, "reason": "x"},
+    )
+    assert out.startswith("tool error:")
+
+
+def test_announce_dedupe(db, room):
+    queen = queen_of(db, room)
+    a = execute_queen_tool(
+        db, room["id"], queen["id"], "announce_decision",
+        {"proposal": "migrate to k8s", "decision_type": "high_impact"},
+    )
+    b = execute_queen_tool(
+        db, room["id"], queen["id"], "announce_decision",
+        {"proposal": "migrate to k8s", "decision_type": "high_impact"},
+    )
+    assert "already announced" in b
+
+
+def test_send_message_to_keeper_and_room(db, room):
+    queen = queen_of(db, room)
+    other = rooms.create_room(db, "other", create_wallet=False)
+    out1 = execute_queen_tool(
+        db, room["id"], queen["id"], "send_message",
+        {"to": "keeper", "body": "weekly report ready"},
+    )
+    assert "keeper" in out1
+    hist = messages.chat_history(db, room["id"])
+    assert hist[-1]["content"] == "weekly report ready"
+    out2 = execute_queen_tool(
+        db, room["id"], queen["id"], "send_message",
+        {"to": str(other["id"]), "subject": "hi", "body": "collab?"},
+    )
+    assert f"room #{other['id']}" in out2
+    assert len(messages.unread_messages(db, other["id"])) == 1
+
+
+def test_loop_thread_lifecycle(db, room, echo):
+    queen = queen_of(db, room)
+    # long gap so the loop sleeps after one cycle
+    rooms.update_room(db, room["id"], queen_cycle_gap_ms=3_600_000)
+    room2 = rooms.get_room(db, room["id"])
+    handle = agent_loop.start_agent_loop(db, room2["id"], queen["id"])
+    import time
+
+    for _ in range(100):
+        if db.query_one(
+            "SELECT * FROM worker_cycles WHERE worker_id=?",
+            (queen["id"],),
+        ):
+            break
+        time.sleep(0.05)
+    assert handle.thread.is_alive()
+    agent_loop.pause_agent(queen["id"])
+    handle.thread.join(timeout=5)
+    assert not handle.thread.is_alive()
+    assert workers.get_worker(db, queen["id"])["agent_state"] == "stopped"

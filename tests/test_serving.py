@@ -282,3 +282,17 @@ def test_resume_near_capacity_rejected_cleanly(engine_setup):
     eng.run_until_idle()
     assert t2.finish_reason == "error"
     assert "capacity" in t2.error or "exceed" in t2.error
+
+
+def test_prefill_bucket_clamped_to_capacity(engine_setup):
+    """A prompt whose bucket would exceed page capacity gets a clamped
+    page-aligned prefill instead of a rejection (regression)."""
+    cfg, params = engine_setup
+    # capacity = 8 pages x 8 = 64 usable; a 40-token prompt buckets to 64
+    eng = make_engine(cfg, params, n_pages=16, page_size=8,
+                      max_seq_len=64)
+    t = eng.submit(list(range(1, 41)),
+                   sampling=SamplingParams(temperature=0.0,
+                                           max_new_tokens=4))
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length"), t.error
