@@ -1,0 +1,88 @@
+"""CLI entry point (reference: src/cli/index.ts — serve / mcp / status /
+help). Run as `python -m room_tpu.cli.main <command>` or via the
+`room-tpu` console script."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    from ..server.app import start_server
+
+    app = start_server(port=args.port, install_signal_handlers=True)
+    print(f"room-tpu server listening on http://127.0.0.1:{app.port}")
+    print(f"data dir: {app.db.path}")
+    try:
+        while not getattr(app, "_done").wait(timeout=3600):
+            pass
+    except KeyboardInterrupt:
+        app.stop()
+    return 0
+
+
+def cmd_mcp(args: argparse.Namespace) -> int:
+    from ..mcp.server import run_stdio_server
+
+    return run_stdio_server()
+
+
+def cmd_status(args: argparse.Namespace) -> int:
+    import json
+    import os
+    import urllib.request
+
+    from ..server.auth import data_dir
+
+    try:
+        with open(os.path.join(data_dir(), "api.port")) as f:
+            port = int(f.read().strip())
+        with open(os.path.join(data_dir(), "api.token")) as f:
+            token = f.read().strip()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/status",
+            headers={"Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            print(json.dumps(json.loads(resp.read())["data"], indent=2))
+        return 0
+    except Exception as e:
+        print(f"server not reachable: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    import runpy
+
+    runpy.run_module("bench", run_name="__main__")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="room-tpu",
+        description="TPU-native autonomous agent-swarm engine",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    serve = sub.add_parser("serve", help="run the API server + runtime")
+    serve.add_argument("--port", type=int, default=3700)
+    serve.set_defaults(fn=cmd_serve)
+
+    mcp = sub.add_parser("mcp", help="run the MCP stdio server")
+    mcp.set_defaults(fn=cmd_mcp)
+
+    status = sub.add_parser("status", help="query a running server")
+    status.set_defaults(fn=cmd_status)
+
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 2
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

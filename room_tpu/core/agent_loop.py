@@ -93,9 +93,16 @@ def start_agent_loop(
 ) -> LoopHandle:
     with _registry_lock:
         existing = _running_loops.get(worker_id)
-        if existing and existing.thread and existing.thread.is_alive():
+        if (
+            existing
+            and existing.thread
+            and existing.thread.is_alive()
+            and not existing.stop.is_set()
+        ):
             existing.wake.set()
             return existing
+        # a stopping handle is as good as dead: replace it (the old
+        # thread only deletes the registry entry if it is still its own)
         handle = LoopHandle(worker_id=worker_id, room_id=room_id)
         _running_loops[worker_id] = handle
     handle.thread = threading.Thread(
@@ -163,12 +170,12 @@ def _loop(db: Database, handle: LoopHandle) -> None:
             continue
 
         handle.state = "running"
+        rate_limited = False
         try:
             run_cycle(db, room, worker)
             gap_s = _cycle_gap_s(db, room, worker)
         except RateLimitExceeded as e:
-            handle.state = "rate_limited"
-            workers_mod.set_agent_state(db, worker["id"], "rate_limited")
+            rate_limited = True
             gap_s = clamp_wait(e.wait_s)
         except Exception as e:
             event_bus.emit(
@@ -177,8 +184,10 @@ def _loop(db: Database, handle: LoopHandle) -> None:
             )
             gap_s = 30.0
 
-        handle.state = "idle"
-        workers_mod.set_agent_state(db, handle.worker_id, "idle")
+        # the wait state stays observable for the whole backoff window
+        state = "rate_limited" if rate_limited else "idle"
+        handle.state = state
+        workers_mod.set_agent_state(db, handle.worker_id, state)
         if handle.wake.wait(timeout=gap_s):
             handle.wake.clear()
 

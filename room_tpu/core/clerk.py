@@ -1,0 +1,268 @@
+"""Clerk: the system-wide keeper assistant (reference:
+src/shared/clerk-tools.ts, src/server/clerk-profile.ts,
+clerk-profile-config.ts).
+
+One chat turn = one provider execution with the clerk tool surface
+(room/task/runtime management executed directly against the engine),
+tried across a fallback chain of models; token burn lands in
+clerk_usage."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ..db import Database
+from ..providers import ExecutionRequest, get_model_provider
+from . import rooms as rooms_mod, task_runner, workers as workers_mod
+from . import escalations as escalations_mod, quorum as quorum_mod
+from .messages import add_chat_message, get_setting
+from .queen_tools import _tool
+
+CLERK_SYSTEM_PROMPT = (
+    "You are the Clerk: the keeper's assistant for managing their agent "
+    "rooms. You can create and configure rooms, start/stop them, manage "
+    "scheduled tasks, relay messages, resolve escalations, and cast "
+    "keeper votes. Be concise and act through tools; confirm what you "
+    "did. Never invent room or task ids — list first if unsure."
+)
+
+CLERK_FALLBACK_CHAIN = (
+    "tpu:qwen3-coder-30b", "openai:gpt-4o-mini",
+    "anthropic:claude-3-5-haiku-latest",
+)
+
+CLERK_TOOLS: list[dict] = [
+    _tool("list_rooms", "List all rooms with status.", {}, []),
+    _tool(
+        "create_room",
+        "Create a new room with a queen.",
+        {"name": {"type": "string"}, "goal": {"type": "string"}},
+        ["name"],
+    ),
+    _tool(
+        "start_room", "Start a room's agent loops.",
+        {"room_id": {"type": "integer"}}, ["room_id"],
+    ),
+    _tool(
+        "stop_room", "Stop a room's agent loops.",
+        {"room_id": {"type": "integer"}}, ["room_id"],
+    ),
+    _tool(
+        "room_status", "Aggregate status of one room.",
+        {"room_id": {"type": "integer"}}, ["room_id"],
+    ),
+    _tool("list_tasks", "List scheduled tasks.",
+          {"room_id": {"type": "integer"}}, []),
+    _tool(
+        "create_task",
+        "Create a scheduled task (cron or one-time).",
+        {
+            "name": {"type": "string"},
+            "prompt": {"type": "string"},
+            "cron_expression": {"type": "string"},
+            "scheduled_at": {"type": "string"},
+            "room_id": {"type": "integer"},
+        },
+        ["name", "prompt"],
+    ),
+    _tool(
+        "run_task_now", "Trigger a task immediately.",
+        {"task_id": {"type": "integer"}}, ["task_id"],
+    ),
+    _tool(
+        "create_reminder",
+        "Schedule a one-time keeper reminder at an ISO datetime.",
+        {
+            "text": {"type": "string"},
+            "at": {"type": "string", "description": "UTC ISO timestamp"},
+        },
+        ["text", "at"],
+    ),
+    _tool(
+        "message_room",
+        "Leave a keeper chat message for a room's queen.",
+        {
+            "room_id": {"type": "integer"},
+            "content": {"type": "string"},
+        },
+        ["room_id", "content"],
+    ),
+    _tool(
+        "answer_escalation", "Answer a pending escalation.",
+        {
+            "escalation_id": {"type": "integer"},
+            "answer": {"type": "string"},
+        },
+        ["escalation_id", "answer"],
+    ),
+    _tool(
+        "keeper_vote", "Cast the keeper's vote on a decision.",
+        {
+            "decision_id": {"type": "integer"},
+            "vote": {"type": "string", "enum": ["yes", "no", "abstain"]},
+        },
+        ["decision_id", "vote"],
+    ),
+]
+
+
+def execute_clerk_tool(
+    db: Database, name: str, args: dict, runtime=None
+) -> str:
+    try:
+        return _dispatch(db, name, args or {}, runtime)
+    except Exception as e:
+        return f"tool error: {type(e).__name__}: {e}"
+
+
+def _dispatch(db: Database, name: str, args: dict, runtime) -> str:
+    if name == "list_rooms":
+        return json.dumps([
+            {"id": r["id"], "name": r["name"], "status": r["status"],
+             "goal": r["goal"]}
+            for r in rooms_mod.list_rooms(db)
+        ])
+    if name == "create_room":
+        room = rooms_mod.create_room(
+            db, args["name"], goal=args.get("goal"),
+            worker_model=get_setting(db, "worker_model", "tpu") or "tpu",
+        )
+        return f"room #{room['id']} '{room['name']}' created"
+    if name == "start_room":
+        if runtime is None:
+            return "runtime not running"
+        okay = runtime.start_room(int(args["room_id"]))
+        return f"room #{args['room_id']} " + ("started" if okay else
+                                              "could not start")
+    if name == "stop_room":
+        if runtime is None:
+            return "runtime not running"
+        runtime.stop_room(int(args["room_id"]))
+        return f"room #{args['room_id']} stopped"
+    if name == "room_status":
+        st = rooms_mod.get_room_status(db, int(args["room_id"]))
+        if st is None:
+            return "room not found"
+        st = dict(st)
+        st["room"] = {"id": st["room"]["id"], "name": st["room"]["name"],
+                      "status": st["room"]["status"]}
+        return json.dumps(st)
+    if name == "list_tasks":
+        return json.dumps([
+            {"id": t["id"], "name": t["name"], "status": t["status"],
+             "trigger": t["trigger_type"], "cron": t["cron_expression"]}
+            for t in task_runner.list_tasks(db, args.get("room_id"))
+        ])
+    if name == "create_task":
+        trigger = "cron" if args.get("cron_expression") else "once"
+        tid = task_runner.create_task(
+            db, args["name"], args["prompt"], trigger_type=trigger,
+            cron_expression=args.get("cron_expression"),
+            scheduled_at=args.get("scheduled_at"),
+            room_id=args.get("room_id"),
+        )
+        return f"task #{tid} created ({trigger})"
+    if name == "run_task_now":
+        if runtime is None:
+            return "runtime not running"
+        queued = runtime.run_task_now(int(args["task_id"]))
+        return f"task #{args['task_id']} " + ("queued" if queued else
+                                              "already pending")
+    if name == "create_reminder":
+        tid = task_runner.create_task(
+            db, f"reminder: {args['text'][:40]}", args["text"],
+            trigger_type="once", scheduled_at=args["at"],
+        )
+        db.execute(
+            "UPDATE tasks SET executor='keeper_reminder' WHERE id=?",
+            (tid,),
+        )
+        return f"reminder #{tid} scheduled for {args['at']}"
+    if name == "message_room":
+        add_chat_message(db, int(args["room_id"]), "user",
+                         args["content"])
+        return f"message left for room #{args['room_id']}"
+    if name == "answer_escalation":
+        escalations_mod.answer_escalation(
+            db, int(args["escalation_id"]), args["answer"]
+        )
+        return f"escalation #{args['escalation_id']} answered"
+    if name == "keeper_vote":
+        d = quorum_mod.keeper_vote(
+            db, int(args["decision_id"]), args["vote"]
+        )
+        return f"keeper vote recorded; decision now {d['status']}"
+    return f"unknown tool {name!r}"
+
+
+def run_clerk_turn(
+    db: Database, content: str, runtime=None
+) -> dict[str, Any]:
+    """One keeper↔clerk chat turn with model fallback (reference:
+    executeClerkWithFallback)."""
+    db.insert(
+        "INSERT INTO clerk_messages(role, content, source) "
+        "VALUES ('user', ?, 'chat')",
+        (content,),
+    )
+    history = list(reversed(db.query(
+        "SELECT role, content FROM clerk_messages "
+        "WHERE role IN ('user','assistant') ORDER BY id DESC LIMIT 20"
+    )))[:-1]
+
+    preferred = get_setting(db, "clerk_model")
+    chain = ([preferred] if preferred else []) + [
+        m for m in CLERK_FALLBACK_CHAIN if m != preferred
+    ]
+
+    last_error = "no provider available"
+    for attempt, model in enumerate(chain):
+        provider = get_model_provider(model, db)
+        ready, why = provider.is_ready()
+        if not ready:
+            last_error = why
+            continue
+        result = provider.execute(ExecutionRequest(
+            prompt=content,
+            system_prompt=CLERK_SYSTEM_PROMPT,
+            model=model,
+            tools=CLERK_TOOLS,
+            on_tool_call=lambda n, a: execute_clerk_tool(
+                db, n, a, runtime
+            ),
+            messages=[
+                {"role": m["role"], "content": m["content"]}
+                for m in history
+            ],
+            max_turns=8,
+            timeout_s=300,
+        ))
+        db.insert(
+            "INSERT INTO clerk_usage(source, model, input_tokens, "
+            "output_tokens, total_tokens, success, used_fallback, "
+            "attempts) VALUES ('chat', ?,?,?,?,?,?,?)",
+            (
+                model, result.input_tokens, result.output_tokens,
+                result.input_tokens + result.output_tokens,
+                int(result.success), int(attempt > 0), attempt + 1,
+            ),
+        )
+        if result.success:
+            reply = result.text or "(no reply)"
+            db.insert(
+                "INSERT INTO clerk_messages(role, content, source) "
+                "VALUES ('assistant', ?, 'chat')",
+                (reply,),
+            )
+            return {"reply": reply, "model": model,
+                    "toolCalls": result.tool_calls}
+        last_error = result.error or "execution failed"
+
+    reply = f"(clerk unavailable: {last_error})"
+    db.insert(
+        "INSERT INTO clerk_messages(role, content, source) "
+        "VALUES ('assistant', ?, 'chat')",
+        (reply,),
+    )
+    return {"reply": reply, "model": None, "toolCalls": []}

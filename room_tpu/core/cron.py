@@ -1,0 +1,94 @@
+"""Minimal 5-field cron matcher (the reference leans on node-cron;
+src/server/runtime.ts:244-275 refreshes a cron job registry every 15 s —
+here the runtime tick asks "is this expression due now?")."""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Optional
+
+
+class CronError(ValueError):
+    pass
+
+
+_FIELDS = (
+    ("minute", 0, 59),
+    ("hour", 0, 23),
+    ("dom", 1, 31),
+    ("month", 1, 12),
+    ("dow", 0, 6),  # 0 = Sunday; 7 normalized to 0
+)
+
+
+def _parse_field(expr: str, lo: int, hi: int, name: str) -> set[int]:
+    values: set[int] = set()
+    for part in expr.split(","):
+        part = part.strip()
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronError(f"bad step in {name}: {step_s!r}")
+            if step <= 0:
+                raise CronError(f"step must be positive in {name}")
+        if part in ("*", ""):
+            rng = range(lo, hi + 1)
+        elif "-" in part:
+            a_s, b_s = part.split("-", 1)
+            try:
+                a, b = int(a_s), int(b_s)
+            except ValueError:
+                raise CronError(f"bad range in {name}: {part!r}")
+            if not (lo <= a <= hi and lo <= b <= hi and a <= b):
+                raise CronError(f"range out of bounds in {name}: {part!r}")
+            rng = range(a, b + 1)
+        else:
+            try:
+                v = int(part)
+            except ValueError:
+                raise CronError(f"bad value in {name}: {part!r}")
+            if name == "dow" and v == 7:
+                v = 0
+            if not lo <= v <= hi:
+                raise CronError(f"{name} value out of bounds: {v}")
+            rng = range(v, v + 1)
+        values.update(x for x in rng if (x - rng.start) % step == 0)
+    return values
+
+
+def parse_cron(expr: str) -> list[set[int]]:
+    parts = expr.split()
+    if len(parts) != 5:
+        raise CronError(
+            f"cron needs 5 fields (minute hour dom month dow), got "
+            f"{len(parts)}: {expr!r}"
+        )
+    return [
+        _parse_field(p, lo, hi, name)
+        for p, (name, lo, hi) in zip(parts, _FIELDS)
+    ]
+
+
+def cron_matches(expr: str, at: Optional[datetime] = None) -> bool:
+    minute, hour, dom, month, dow = parse_cron(expr)
+    t = at or datetime.now()
+    return (
+        t.minute in minute
+        and t.hour in hour
+        and t.day in dom
+        and t.month in month
+        and t.weekday() in {(d - 1) % 7 for d in dow}
+        # python weekday(): Monday=0; cron: Sunday=0 → shift
+    )
+
+
+def validate_cron(expr: str) -> Optional[str]:
+    """Returns an error message or None."""
+    try:
+        parse_cron(expr)
+        return None
+    except CronError as e:
+        return str(e)

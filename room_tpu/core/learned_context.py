@@ -1,0 +1,60 @@
+"""Learned-context distillation: after every 3 runs, distill a compact
+methodology memo from recent results and feed it into future prompts
+(reference: src/shared/learned-context.ts — ≤1,500 chars, refreshed every
+3 runs, via a single 1-turn LLM call)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..db import Database, utc_now
+from ..providers import ExecutionRequest, get_model_provider
+
+DISTILL_EVERY_RUNS = 3
+MEMO_MAX_CHARS = 1500
+
+
+def should_distill(task: dict) -> bool:
+    runs = task["run_count"]
+    return runs >= DISTILL_EVERY_RUNS and runs % DISTILL_EVERY_RUNS == 0
+
+
+def distill_learned_context(
+    db: Database, task: dict, model: str
+) -> Optional[str]:
+    runs = db.query(
+        "SELECT status, result, error_message FROM task_runs "
+        "WHERE task_id=? ORDER BY id DESC LIMIT 5",
+        (task["id"],),
+    )
+    if not runs:
+        return None
+    digest = "\n".join(
+        f"- [{r['status']}] {(r['result'] or r['error_message'] or '')[:300]}"
+        for r in runs
+    )
+    try:
+        provider = get_model_provider(model, db)
+        r = provider.execute(ExecutionRequest(
+            prompt=(
+                "You maintain a methodology memo for a recurring task.\n"
+                f"Task: {task['name']} — {task['prompt'][:500]}\n"
+                f"Recent runs:\n{digest}\n\n"
+                "Write a concise memo (max 1200 chars): what approach "
+                "works, what to avoid, and any state worth carrying "
+                "forward."
+            ),
+            max_turns=1,
+            max_new_tokens=400,
+            timeout_s=120,
+        ))
+        if not (r.success and r.text):
+            return None
+    except Exception:
+        return None
+    memo = r.text[:MEMO_MAX_CHARS]
+    db.execute(
+        "UPDATE tasks SET learned_context=?, updated_at=? WHERE id=?",
+        (memo, utc_now(), task["id"]),
+    )
+    return memo

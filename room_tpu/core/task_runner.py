@@ -1,0 +1,445 @@
+"""Scheduled-task execution (reference: src/shared/task-runner.ts):
+per-room concurrency slots (1-10, default 3), built-in non-LLM
+executors, session continuity with rotation after 20 runs, learned
+context + memory injection, rate-limit retry ×3, result persistence,
+auto-pause on repeated terminal errors — with the LLM leg running
+through the provider registry (tpu: by default) instead of a spawned
+CLI."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from ..db import Database, utc_now
+from ..providers import (
+    ExecutionRequest, RateLimitExceeded, get_model_provider,
+)
+from . import memory as memory_mod
+from .constants import (
+    MAX_CONCURRENT_TASKS_DEFAULT,
+    MAX_CONCURRENT_TASKS_MAX,
+    MAX_CONCURRENT_TASKS_MIN,
+    TASK_SESSION_ROTATE_RUNS,
+)
+from .events import event_bus
+from .learned_context import distill_learned_context, should_distill
+from .rate_limit import MAX_RETRIES, abortable_sleep, clamp_wait
+
+AUTO_PAUSE_ERROR_COUNT = 5
+
+
+# ---- concurrency slots ----
+
+class _SlotPool:
+    def __init__(self) -> None:
+        self._used: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, room_id: Optional[int], limit: int) -> bool:
+        key = room_id or 0
+        with self._lock:
+            if self._used.get(key, 0) >= limit:
+                return False
+            self._used[key] = self._used.get(key, 0) + 1
+            return True
+
+    def release(self, room_id: Optional[int]) -> None:
+        key = room_id or 0
+        with self._lock:
+            self._used[key] = max(0, self._used.get(key, 0) - 1)
+
+    def in_use(self, room_id: Optional[int]) -> int:
+        with self._lock:
+            return self._used.get(room_id or 0, 0)
+
+
+slots = _SlotPool()
+
+
+def max_concurrent_tasks(db: Database, room_id: Optional[int]) -> int:
+    if room_id is not None:
+        room = db.query_one(
+            "SELECT max_concurrent_tasks FROM rooms WHERE id=?", (room_id,)
+        )
+        if room:
+            return max(
+                MAX_CONCURRENT_TASKS_MIN,
+                min(MAX_CONCURRENT_TASKS_MAX,
+                    room["max_concurrent_tasks"]),
+            )
+    from .messages import get_setting
+
+    raw = get_setting(db, "max_concurrent_tasks")
+    try:
+        return max(MAX_CONCURRENT_TASKS_MIN,
+                   min(MAX_CONCURRENT_TASKS_MAX, int(raw or "")))
+    except ValueError:
+        return MAX_CONCURRENT_TASKS_DEFAULT
+
+
+# ---- task CRUD ----
+
+def create_task(
+    db: Database,
+    name: str,
+    prompt: str,
+    trigger_type: str = "cron",
+    cron_expression: Optional[str] = None,
+    scheduled_at: Optional[str] = None,
+    room_id: Optional[int] = None,
+    worker_id: Optional[int] = None,
+    session_continuity: bool = False,
+    max_runs: Optional[int] = None,
+    description: Optional[str] = None,
+    timeout_minutes: Optional[int] = None,
+    max_turns: Optional[int] = None,
+) -> int:
+    if trigger_type == "cron":
+        from .cron import validate_cron
+
+        err = validate_cron(cron_expression or "")
+        if err:
+            raise ValueError(f"invalid cron expression: {err}")
+    import secrets as _secrets
+
+    return db.insert(
+        "INSERT INTO tasks(name, description, prompt, cron_expression, "
+        "trigger_type, webhook_token, room_id, worker_id, "
+        "session_continuity, scheduled_at, max_runs, timeout_minutes, "
+        "max_turns) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+        (
+            name, description, prompt, cron_expression, trigger_type,
+            _secrets.token_urlsafe(16), room_id, worker_id,
+            int(session_continuity), scheduled_at, max_runs,
+            timeout_minutes, max_turns,
+        ),
+    )
+
+
+def get_task(db: Database, task_id: int) -> Optional[dict]:
+    return db.query_one("SELECT * FROM tasks WHERE id=?", (task_id,))
+
+
+def list_tasks(db: Database, room_id: Optional[int] = None) -> list[dict]:
+    if room_id is None:
+        return db.query("SELECT * FROM tasks ORDER BY id")
+    return db.query(
+        "SELECT * FROM tasks WHERE room_id=? ORDER BY id", (room_id,)
+    )
+
+
+def pause_task(db: Database, task_id: int) -> None:
+    db.execute(
+        "UPDATE tasks SET status='paused', updated_at=? WHERE id=?",
+        (utc_now(), task_id),
+    )
+
+
+def resume_task(db: Database, task_id: int) -> None:
+    db.execute(
+        "UPDATE tasks SET status='active', error_count=0, updated_at=? "
+        "WHERE id=?",
+        (utc_now(), task_id),
+    )
+
+
+def delete_task(db: Database, task_id: int) -> bool:
+    return db.execute(
+        "DELETE FROM tasks WHERE id=?", (task_id,)
+    ).rowcount > 0
+
+
+def cancel_running_tasks_for_room(db: Database, room_id: int) -> int:
+    rows = db.query(
+        "SELECT r.id FROM task_runs r JOIN tasks t ON t.id = r.task_id "
+        "WHERE t.room_id=? AND r.status='running'",
+        (room_id,),
+    )
+    for r in rows:
+        db.execute(
+            "UPDATE task_runs SET status='cancelled', finished_at=? "
+            "WHERE id=?",
+            (utc_now(), r["id"]),
+        )
+    return len(rows)
+
+
+# ---- execution ----
+
+def execute_task(
+    db: Database,
+    task_id: int,
+    abort: Optional[threading.Event] = None,
+) -> Optional[dict]:
+    """Run one task now. Returns the finished task_runs row (None if it
+    could not start)."""
+    task = get_task(db, task_id)
+    if task is None or task["status"] != "active":
+        return None
+
+    # cross-process duplicate guard: a run already marked running
+    if db.query_one(
+        "SELECT 1 AS x FROM task_runs WHERE task_id=? AND "
+        "status='running'",
+        (task_id,),
+    ):
+        return None
+
+    limit = max_concurrent_tasks(db, task["room_id"])
+    if not slots.acquire(task["room_id"], limit):
+        return None
+
+    run_id = db.insert(
+        "INSERT INTO task_runs(task_id) VALUES (?)", (task_id,)
+    )
+    event_bus.emit("run:created", "tasks",
+                   {"run_id": run_id, "task_id": task_id})
+    started = time.monotonic()
+    try:
+        if task["executor"] in _BUILTIN_EXECUTORS:
+            result_text = _BUILTIN_EXECUTORS[task["executor"]](db, task)
+            success, error = True, None
+            session_id = None
+        else:
+            success, result_text, error, session_id = _run_llm_task(
+                db, task, abort
+            )
+        _finish_run(
+            db, task, run_id, success, result_text, error, session_id,
+            int((time.monotonic() - started) * 1000),
+        )
+    except Exception as e:
+        _finish_run(
+            db, task, run_id, False, "", str(e), None,
+            int((time.monotonic() - started) * 1000),
+        )
+    finally:
+        slots.release(task["room_id"])
+    return db.query_one("SELECT * FROM task_runs WHERE id=?", (run_id,))
+
+
+def _run_llm_task(
+    db: Database, task: dict, abort: Optional[threading.Event]
+) -> tuple[bool, str, Optional[str], Optional[str]]:
+    model = _resolve_task_model(db, task)
+    provider = get_model_provider(model, db)
+    ready, why = provider.is_ready()
+    if not ready:
+        return False, "", f"model {model!r} not ready: {why}", None
+
+    prompt = _assemble_task_prompt(db, task)
+    session_id = (
+        task["session_id"] if task["session_continuity"] else None
+    )
+    if session_id and task["run_count"] >= TASK_SESSION_ROTATE_RUNS and \
+            task["run_count"] % TASK_SESSION_ROTATE_RUNS == 0:
+        session_id = None  # rotate
+
+    request = ExecutionRequest(
+        prompt=prompt,
+        model=model,
+        session_id=session_id,
+        max_turns=task["max_turns"] or 10,
+        timeout_s=(task["timeout_minutes"] or 15) * 60,
+    )
+
+    last_error: Optional[str] = None
+    for attempt in range(MAX_RETRIES):
+        try:
+            result = provider.execute(request)
+        except RateLimitExceeded as e:
+            last_error = str(e)
+            if abortable_sleep(clamp_wait(e.wait_s), abort):
+                return False, "", "aborted during rate-limit wait", None
+            continue
+        if result.success:
+            return True, result.text, None, result.session_id
+        # resume failure: retry once without the session
+        if session_id and attempt == 0:
+            request.session_id = None
+            session_id = None
+            last_error = result.error
+            continue
+        return False, result.text, result.error, result.session_id
+    return False, "", last_error or "retries exhausted", None
+
+
+def _resolve_task_model(db: Database, task: dict) -> str:
+    """worker model > room worker_model > global default (reference
+    :343-377, including the 'queen' indirection)."""
+    if task["worker_id"]:
+        w = db.query_one(
+            "SELECT model FROM workers WHERE id=?", (task["worker_id"],)
+        )
+        if w and w["model"]:
+            return w["model"]
+    if task["room_id"]:
+        room = db.query_one(
+            "SELECT worker_model FROM rooms WHERE id=?",
+            (task["room_id"],),
+        )
+        if room and room["worker_model"]:
+            return room["worker_model"]
+    from .messages import get_setting
+
+    return get_setting(db, "default_task_model", "tpu") or "tpu"
+
+
+def _assemble_task_prompt(db: Database, task: dict) -> str:
+    parts = [task["prompt"]]
+    if task["learned_context"]:
+        parts.insert(
+            0,
+            f"Methodology memo from previous runs:\n"
+            f"{task['learned_context']}\n",
+        )
+    if task["room_id"]:
+        hits = memory_mod.hybrid_search(
+            db, task["name"] + " " + task["prompt"][:200],
+            room_id=task["room_id"], limit=3,
+        )
+        if hits:
+            parts.insert(
+                0,
+                "Relevant memory:\n" + "\n".join(
+                    f"- {h['name']}: {'; '.join(h['observations'][-1:])}"
+                    for h in hits
+                ) + "\n",
+            )
+    return "\n".join(parts)
+
+
+def _finish_run(
+    db: Database,
+    task: dict,
+    run_id: int,
+    success: bool,
+    result_text: str,
+    error: Optional[str],
+    session_id: Optional[str],
+    duration_ms: int,
+) -> None:
+    status = "success" if success else "error"
+    result_file = _save_result_file(task, run_id, result_text) if (
+        success and result_text
+    ) else None
+    db.execute(
+        "UPDATE task_runs SET finished_at=?, status=?, result=?, "
+        "result_file=?, error_message=?, duration_ms=?, session_id=? "
+        "WHERE id=?",
+        (
+            utc_now(), status, result_text[:10_000], result_file, error,
+            duration_ms, session_id, run_id,
+        ),
+    )
+    db.execute(
+        "UPDATE tasks SET last_run=?, last_result=?, run_count=run_count+1,"
+        " error_count=?, session_id=?, updated_at=? WHERE id=?",
+        (
+            utc_now(),
+            (result_text or error or "")[:1000],
+            0 if success else task["error_count"] + 1,
+            session_id if task["session_continuity"] else None,
+            utc_now(),
+            task["id"],
+        ),
+    )
+
+    if success and result_text and task["room_id"]:
+        memory_mod.remember(
+            db, f"task result: {task['name']}", result_text[:1000],
+            category="task", room_id=task["room_id"], source="task",
+        )
+
+    task_after = get_task(db, task["id"])
+    if task_after:
+        if success and should_distill(task_after):
+            threading.Thread(
+                target=distill_learned_context,
+                args=(db, task_after, _resolve_task_model(db, task_after)),
+                daemon=True,
+            ).start()
+        if not success and task_after["error_count"] >= \
+                AUTO_PAUSE_ERROR_COUNT:
+            pause_task(db, task["id"])
+            event_bus.emit("task:auto_paused", "tasks",
+                           {"task_id": task["id"], "error": error})
+        if task_after["max_runs"] and \
+                task_after["run_count"] >= task_after["max_runs"]:
+            db.execute(
+                "UPDATE tasks SET status='archived', updated_at=? "
+                "WHERE id=?",
+                (utc_now(), task["id"]),
+            )
+
+    event_bus.emit(
+        "run:finished", "tasks",
+        {"run_id": run_id, "task_id": task["id"], "status": status},
+    )
+
+
+def _save_result_file(task: dict, run_id: int, text: str) -> Optional[str]:
+    base = os.environ.get("ROOM_TPU_DATA_DIR")
+    if not base:
+        base = os.path.join(os.path.expanduser("~"), ".room_tpu")
+    try:
+        results_dir = os.path.join(base, "results")
+        os.makedirs(results_dir, exist_ok=True)
+        path = os.path.join(
+            results_dir, f"task{task['id']}-run{run_id}.md"
+        )
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+    except OSError:
+        return None
+
+
+# ---- built-in non-LLM executors (reference :256-329) ----
+
+def _keeper_reminder(db: Database, task: dict) -> str:
+    from .messages import add_chat_message
+
+    if task["room_id"]:
+        add_chat_message(
+            db, task["room_id"], "assistant",
+            f"Reminder: {task['prompt']}",
+        )
+    db.insert(
+        "INSERT INTO clerk_messages(role, content, source) "
+        "VALUES ('assistant', ?, 'reminder')",
+        (f"Reminder: {task['prompt']}",),
+    )
+    event_bus.emit("reminder", "clerk", {"text": task["prompt"]})
+    return f"reminder delivered: {task['prompt'][:100]}"
+
+
+def _keeper_contact_check(db: Database, task: dict) -> str:
+    from .messages import get_setting
+
+    channels = [
+        k for k in ("keeper_email", "keeper_telegram")
+        if get_setting(db, k)
+    ]
+    msg = (
+        "keeper contact configured: " + ", ".join(channels)
+        if channels
+        else "no keeper contact configured — ask the keeper to add email "
+        "or telegram in settings"
+    )
+    db.insert(
+        "INSERT INTO clerk_messages(role, content, source) "
+        "VALUES ('assistant', ?, 'contact_check')",
+        (msg,),
+    )
+    return msg
+
+
+_BUILTIN_EXECUTORS = {
+    "keeper_reminder": _keeper_reminder,
+    "keeper_contact_check": _keeper_contact_check,
+}

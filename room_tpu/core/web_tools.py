@@ -86,9 +86,30 @@ def web_search(query: str, max_results: int = 5) -> str:
         re.DOTALL,
     ):
         href, title = m.group(1), re.sub(r"<[^>]+>", "", m.group(2))
-        results.append({"title": title.strip(), "url": href})
+        results.append(
+            {"title": title.strip(), "url": _resolve_ddg_url(href)}
+        )
         if len(results) >= max_results:
             break
     if not results:
         return "no results"
+    # snippets, matched positionally with the result links
+    snippets = re.findall(
+        r'class="result__snippet"[^>]*>(.*?)</a>', body, re.DOTALL
+    )
+    for i, s in enumerate(snippets[: len(results)]):
+        results[i]["snippet"] = re.sub(r"<[^>]+>", "", s).strip()[:300]
     return json.dumps(results, indent=1)
+
+
+def _resolve_ddg_url(href: str) -> str:
+    """DDG wraps targets in //duckduckgo.com/l/?uddg=<encoded> redirect
+    links; unwrap to the real URL so web_fetch accepts it."""
+    if href.startswith("//"):
+        href = "https:" + href
+    if "duckduckgo.com/l/" in href:
+        qs = urllib.parse.parse_qs(urllib.parse.urlparse(href).query)
+        target = (qs.get("uddg") or [None])[0]
+        if target:
+            return target
+    return href

@@ -96,9 +96,11 @@ class OpenAICompatProvider:
 
     def execute(self, request: ExecutionRequest) -> ExecutionResult:
         messages = list(request.messages or [])
-        if not messages and request.system_prompt:
-            messages.append(
-                {"role": "system", "content": request.system_prompt}
+        if request.system_prompt and not any(
+            m.get("role") == "system" for m in messages
+        ):
+            messages.insert(
+                0, {"role": "system", "content": request.system_prompt}
             )
         messages.append({"role": "user", "content": request.prompt})
 
@@ -112,6 +114,7 @@ class OpenAICompatProvider:
                 "model": self.model,
                 "messages": messages,
                 "temperature": request.temperature,
+                "max_tokens": request.max_new_tokens,
             }
             if tools:
                 body["tools"] = tools

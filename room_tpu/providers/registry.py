@@ -54,7 +54,15 @@ def get_model_provider(
     model: Optional[str], db: Optional[Database] = None
 ) -> Provider:
     kind = provider_kind(model)
-    key = f"{kind}:{model_name(model)}"
+    # HTTP providers resolve credentials through the db, so the binding
+    # is part of their identity (a db-less probe must not pin a cached
+    # instance that can never see DB-stored keys); tpu/echo are db-free
+    # and stay process-wide.
+    db_key = id(db) if (
+        db is not None and kind in ("openai", "anthropic", "gemini",
+                                    "ollama")
+    ) else 0
+    key = f"{kind}:{model_name(model)}:{db_key}"
     if key in _instances:
         return _instances[key]
 

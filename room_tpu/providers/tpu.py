@@ -165,12 +165,15 @@ class TpuProvider:
         tok = engine.tokenizer
 
         messages = list(request.messages or [])
-        if not messages and request.system_prompt:
-            messages.append(
-                {"role": "system", "content": request.system_prompt}
+        if request.system_prompt and not any(
+            m.get("role") == "system" for m in messages
+        ):
+            messages.insert(
+                0, {"role": "system", "content": request.system_prompt}
             )
         messages.append({"role": "user", "content": request.prompt})
 
+        ephemeral = request.session_id is None
         session_id = request.session_id or f"tpu-{time.monotonic_ns()}"
         fresh_session = session_id not in engine.sessions
 
@@ -255,4 +258,8 @@ class TpuProvider:
         result.text = visible
         messages.append({"role": "assistant", "content": visible})
         result.messages = messages
+        if ephemeral:
+            # one-shot calls must not leak paged-KV pages
+            engine.release_session(session_id)
+            result.session_id = None
         return result

@@ -1,0 +1,37 @@
+"""Role-based access control (reference: src/server/access.ts):
+agent/user get everything; member is read-only plus a small write
+whitelist (votes, keeper votes, escalation resolution, message replies,
+mark-read)."""
+
+from __future__ import annotations
+
+import re
+
+MEMBER_WRITE_WHITELIST = [
+    re.compile(p)
+    for p in (
+        r"^/api/decisions/\d+/vote$",
+        r"^/api/decisions/\d+/keeper-vote$",
+        r"^/api/escalations/\d+/answer$",
+        r"^/api/messages/\d+/reply$",
+        r"^/api/messages/\d+/read$",
+    )
+]
+
+MEMBER_READ_BLOCKLIST = [
+    re.compile(p)
+    for p in (
+        r"^/api/credentials",      # secret material stays hidden
+        r"^/api/rooms/\d+/credentials",
+    )
+]
+
+
+def is_allowed_for_role(role: str, method: str, path: str) -> bool:
+    if role in ("agent", "user"):
+        return True
+    if role != "member":
+        return False
+    if method in ("GET", "HEAD"):
+        return not any(p.match(path) for p in MEMBER_READ_BLOCKLIST)
+    return any(p.match(path) for p in MEMBER_WRITE_WHITELIST)

@@ -1,0 +1,67 @@
+"""Server boot orchestration (reference: src/server/index.ts
+startServer:867): open the DB, start runtime loops, bring up the API
+server (+WS), write token/port files, and tear it all down in reverse
+order on shutdown."""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ..db import Database, get_database
+from .http import ApiServer
+from .runtime import ServerRuntime, start_server_runtime, stop_server_runtime
+
+
+@dataclass
+class ServerApp:
+    db: Database
+    runtime: ServerRuntime
+    api: ApiServer
+
+    @property
+    def port(self) -> int:
+        return self.api.port
+
+    def stop(self) -> None:
+        # reverse boot order: stop loops, stop serving, close DB
+        stop_server_runtime()
+        self.api.stop()
+        from ..providers.tpu import reset_model_hosts
+
+        reset_model_hosts()
+        self.db.close()
+
+
+def start_server(
+    port: int = 0,
+    db: Optional[Database] = None,
+    static_dir: Optional[str] = None,
+    install_signal_handlers: bool = False,
+) -> ServerApp:
+    db = db or get_database()
+    runtime = start_server_runtime(db)
+    api = ApiServer(
+        db,
+        runtime=runtime,
+        port=port,
+        static_dir=static_dir or os.environ.get("ROOM_TPU_STATIC_DIR"),
+        cloud_mode=os.environ.get("ROOM_TPU_DEPLOYMENT_MODE") == "cloud",
+    )
+    api.start()
+    app = ServerApp(db=db, runtime=runtime, api=api)
+
+    if install_signal_handlers:
+        done = threading.Event()
+
+        def shutdown(signum, frame):
+            app.stop()
+            done.set()
+
+        signal.signal(signal.SIGINT, shutdown)
+        signal.signal(signal.SIGTERM, shutdown)
+        app._done = done  # type: ignore[attr-defined]
+    return app

@@ -1,0 +1,166 @@
+"""Authentication (reference: src/server/auth.ts): dual persistent
+bearer tokens — agent (full) and user (keeper) — stored 0600; timing-safe
+comparison; optional cloud-mode HS256 JWT validation mapping to the
+member role; origin allow-lists for local vs cloud deployments."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import time
+from typing import Optional
+
+TOKENS_FILE = "auth.tokens.json"
+
+
+def data_dir() -> str:
+    d = os.environ.get(
+        "ROOM_TPU_DATA_DIR",
+        os.path.join(os.path.expanduser("~"), ".room_tpu"),
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _tokens_path() -> str:
+    return os.path.join(data_dir(), TOKENS_FILE)
+
+
+def load_or_create_tokens() -> dict[str, str]:
+    path = _tokens_path()
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                tokens = json.load(f)
+            if tokens.get("agent") and tokens.get("user"):
+                return tokens
+        except (json.JSONDecodeError, OSError):
+            pass
+    tokens = {
+        "agent": secrets.token_urlsafe(32),
+        "user": secrets.token_urlsafe(32),
+    }
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(tokens, f)
+    return tokens
+
+
+def write_runtime_files(port: int, tokens: dict[str, str]) -> None:
+    """api.port / api.token files other processes (MCP nudge) read
+    (reference: writeTokenFile:275, mcp/nudge.ts:14-43)."""
+    d = data_dir()
+    with open(os.path.join(d, "api.port"), "w") as f:
+        f.write(str(port))
+    fd = os.open(
+        os.path.join(d, "api.token"),
+        os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600,
+    )
+    with os.fdopen(fd, "w") as f:
+        f.write(tokens["agent"])
+
+
+def _safe_equal(a: str, b: str) -> bool:
+    return hmac.compare_digest(a.encode(), b.encode())
+
+
+def get_token_principal(
+    token: Optional[str], tokens: dict[str, str]
+) -> Optional[dict]:
+    """token -> {"role": ...} or None. Roles: agent (full), user
+    (keeper full), member (cloud read-mostly)."""
+    if not token:
+        return None
+    if _safe_equal(token, tokens["agent"]):
+        return {"role": "agent"}
+    if _safe_equal(token, tokens["user"]):
+        return {"role": "user"}
+    principal = validate_cloud_jwt(token)
+    if principal:
+        return principal
+    return None
+
+
+# ---- cloud JWT (HS256, stdlib) ----
+
+JWT_ISS = "room-tpu-cloud"
+JWT_AUD = "room-tpu-runtime"
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _b64url_encode(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def sign_cloud_jwt(
+    claims: dict, secret: str, header: Optional[dict] = None
+) -> str:
+    header = header or {"alg": "HS256", "typ": "JWT"}
+    signing = (
+        _b64url_encode(json.dumps(header).encode())
+        + "."
+        + _b64url_encode(json.dumps(claims).encode())
+    )
+    sig = hmac.new(
+        secret.encode(), signing.encode(), hashlib.sha256
+    ).digest()
+    return signing + "." + _b64url_encode(sig)
+
+
+def validate_cloud_jwt(token: str) -> Optional[dict]:
+    """Validate iss/aud/exp/nbf + instance binding against the deployment
+    secret (reference: validateCloudJwt:106-165). Returns a member/user
+    principal or None."""
+    secret = os.environ.get("ROOM_TPU_CLOUD_JWT_SECRET")
+    if not secret or token.count(".") != 2:
+        return None
+    head_s, claims_s, sig_s = token.split(".")
+    try:
+        header = json.loads(_b64url_decode(head_s))
+        claims = json.loads(_b64url_decode(claims_s))
+        sig = _b64url_decode(sig_s)
+    except (ValueError, json.JSONDecodeError):
+        return None
+    if header.get("alg") != "HS256":
+        return None
+    expected = hmac.new(
+        secret.encode(), f"{head_s}.{claims_s}".encode(), hashlib.sha256
+    ).digest()
+    if not hmac.compare_digest(sig, expected):
+        return None
+
+    now = time.time()
+    if claims.get("iss") != JWT_ISS or claims.get("aud") != JWT_AUD:
+        return None
+    if claims.get("exp") is not None and now >= float(claims["exp"]):
+        return None
+    if claims.get("nbf") is not None and now < float(claims["nbf"]):
+        return None
+    instance = os.environ.get("ROOM_TPU_INSTANCE_ID")
+    if instance and claims.get("instanceId") != instance:
+        return None
+    role = claims.get("role", "member")
+    return {"role": role if role in ("user", "member") else "member",
+            "claims": claims}
+
+
+# ---- origins ----
+
+def allowed_origin(origin: Optional[str], port: int) -> bool:
+    if not origin:
+        return True  # same-origin / non-browser
+    local = {
+        f"http://localhost:{port}",
+        f"http://127.0.0.1:{port}",
+    }
+    if origin in local:
+        return True
+    extra = os.environ.get("ROOM_TPU_ALLOWED_ORIGINS", "")
+    return origin in {o.strip() for o in extra.split(",") if o.strip()}

@@ -1,0 +1,291 @@
+"""HTTP API server (reference: src/server/index.ts): CORS/origin
+validation, localhost-only auth handshake, tokened webhooks before auth,
+bearer auth + RBAC + router dispatch, security headers, rate limiting for
+cloud deployments, SPA static serving with a traversal guard, graceful
+shutdown — on a threading stdlib server instead of node:http."""
+
+from __future__ import annotations
+
+import json
+import mimetypes
+import os
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from ..db import Database
+from .access import is_allowed_for_role
+from .auth import (
+    allowed_origin, get_token_principal, load_or_create_tokens,
+    write_runtime_files,
+)
+from .router import RequestContext, Router
+from .routes import register_all_routes
+from .webhooks import handle_webhook_request
+from .ws import WebSocketHub
+
+RATE_LIMIT_GET_PER_MIN = 300
+RATE_LIMIT_WRITE_PER_MIN = 120
+
+
+class _RateLimiter:
+    def __init__(self) -> None:
+        self._hits: dict[tuple[str, str], list[float]] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, ip: str, kind: str, limit: int) -> bool:
+        now = time.monotonic()
+        key = (ip, kind)
+        with self._lock:
+            hits = [t for t in self._hits.get(key, []) if now - t < 60]
+            if len(hits) >= limit:
+                self._hits[key] = hits
+                return False
+            hits.append(now)
+            self._hits[key] = hits
+            return True
+
+
+class ApiServer:
+    def __init__(
+        self,
+        db: Database,
+        runtime=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        static_dir: Optional[str] = None,
+        cloud_mode: bool = False,
+    ) -> None:
+        self.db = db
+        self.runtime = runtime
+        self.router = Router()
+        register_all_routes(self.router)
+        self.tokens = load_or_create_tokens()
+        self.static_dir = static_dir
+        self.cloud_mode = cloud_mode
+        self.rate_limiter = _RateLimiter()
+        self.ws_hub = WebSocketHub(self)
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _respond(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self._common_headers()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _common_headers(self) -> None:
+                origin = self.headers.get("Origin")
+                if origin and allowed_origin(origin, server.port):
+                    self.send_header("Access-Control-Allow-Origin", origin)
+                    self.send_header(
+                        "Access-Control-Allow-Headers",
+                        "Authorization, Content-Type",
+                    )
+                    self.send_header(
+                        "Access-Control-Allow-Methods",
+                        "GET, POST, PUT, DELETE, OPTIONS",
+                    )
+                self.send_header("X-Content-Type-Options", "nosniff")
+                self.send_header("X-Frame-Options", "DENY")
+                self.send_header("Referrer-Policy", "no-referrer")
+
+            def do_OPTIONS(self):
+                self.send_response(204)
+                self._common_headers()
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if self.headers.get("Upgrade", "").lower() == "websocket":
+                    server.ws_hub.handle_upgrade(self)
+                    return
+                self._handle()
+
+            def do_POST(self):
+                self._handle()
+
+            def do_PUT(self):
+                self._handle()
+
+            def do_DELETE(self):
+                self._handle()
+
+            # ---- core dispatch ----
+
+            def _client_ip(self) -> str:
+                return self.client_address[0]
+
+            def _is_localhost(self) -> bool:
+                return self._client_ip() in ("127.0.0.1", "::1")
+
+            def _read_body(self) -> Any:
+                length = int(self.headers.get("Content-Length") or 0)
+                if length <= 0:
+                    return None
+                if length > 5_000_000:
+                    return None
+                raw = self.rfile.read(length)
+                try:
+                    return json.loads(raw)
+                except json.JSONDecodeError:
+                    return None
+
+            def _handle(self) -> None:
+                try:
+                    self._handle_inner()
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    try:
+                        self._respond(500, {"error": str(e)})
+                    except Exception:
+                        pass
+
+            def _handle_inner(self) -> None:
+                parsed = urllib.parse.urlparse(self.path)
+                path = parsed.path
+                query = {
+                    k: v[0]
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                origin = self.headers.get("Origin")
+                if origin and not allowed_origin(origin, server.port):
+                    self._respond(403, {"error": "origin not allowed"})
+                    return
+
+                # localhost-only auth handshake (reference :504-522)
+                if path == "/api/auth/handshake":
+                    if not self._is_localhost():
+                        self._respond(403, {"error": "localhost only"})
+                        return
+                    self._respond(200, {
+                        "status": 200,
+                        "data": {"userToken": server.tokens["user"]},
+                    })
+                    return
+
+                # tokened webhooks, before auth (reference :602-608)
+                if path.startswith("/api/hooks/"):
+                    self._respond(*handle_webhook_request(
+                        server, self.command, path, self._read_body()
+                    ))
+                    return
+
+                if not path.startswith("/api/"):
+                    self._serve_static(path)
+                    return
+
+                # cloud rate limiting (reference :384-415)
+                if server.cloud_mode:
+                    kind = "r" if self.command in ("GET", "HEAD") else "w"
+                    limit = (
+                        RATE_LIMIT_GET_PER_MIN if kind == "r"
+                        else RATE_LIMIT_WRITE_PER_MIN
+                    )
+                    if not server.rate_limiter.allow(
+                        self._client_ip(), kind, limit
+                    ):
+                        self._respond(429, {"error": "rate limited"})
+                        return
+
+                auth = self.headers.get("Authorization", "")
+                token = auth[7:] if auth.startswith("Bearer ") else None
+                principal = get_token_principal(token, server.tokens)
+                if principal is None:
+                    self._respond(401, {"error": "unauthorized"})
+                    return
+                if not is_allowed_for_role(
+                    principal["role"], self.command, path
+                ):
+                    self._respond(403, {"error": "forbidden"})
+                    return
+
+                matched = server.router.match(self.command, path)
+                if matched is None:
+                    self._respond(404, {"error": "not found"})
+                    return
+                handler, params = matched
+                ctx = RequestContext(
+                    method=self.command,
+                    path=path,
+                    params=params,
+                    query=query,
+                    body=self._read_body(),
+                    principal=principal,
+                    db=server.db,
+                    runtime=server.runtime,
+                )
+                out = handler(ctx)
+                status = out.get("status", 200)
+                payload = {"status": status}
+                if "data" in out:
+                    payload["data"] = out["data"]
+                if out.get("error"):
+                    payload["error"] = out["error"]
+                self._respond(status, payload)
+
+            def _serve_static(self, path: str) -> None:
+                """SPA static serving with traversal guard (reference
+                serveStatic:322-368)."""
+                root = server.static_dir
+                if not root:
+                    self._respond(404, {"error": "not found"})
+                    return
+                rel = path.lstrip("/") or "index.html"
+                real_root = os.path.realpath(root)
+                full = os.path.realpath(os.path.join(real_root, rel))
+                if full != real_root and not full.startswith(
+                    real_root + os.sep
+                ):
+                    self._respond(403, {"error": "forbidden"})
+                    return
+                if not os.path.isfile(full):
+                    full = os.path.join(root, "index.html")  # SPA routes
+                    if not os.path.isfile(full):
+                        self._respond(404, {"error": "not found"})
+                        return
+                ctype = mimetypes.guess_type(full)[0] or \
+                    "application/octet-stream"
+                with open(full, "rb") as f:
+                    body = f.read()
+                self.send_response(200)
+                self._common_headers()
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._handler_cls = Handler
+        bind_host = os.environ.get("ROOM_TPU_BIND_HOST", host)
+        self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        write_runtime_files(self.port, self.tokens)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="api-server",
+        )
+        self._thread.start()
+        self.ws_hub.start()
+
+    def stop(self) -> None:
+        self.ws_hub.stop()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,71 @@
+"""Minimal pattern router (reference: src/server/router.ts — ':param'
+patterns compiled to regex; handlers return an ApiResponse dict)."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+# handler(ctx) -> {"status": int, "data": ..., "error": ...}
+Handler = Callable[["RequestContext"], dict]
+
+
+@dataclass
+class RequestContext:
+    method: str
+    path: str
+    params: dict[str, str]
+    query: dict[str, str]
+    body: Any
+    principal: Optional[dict] = None  # {"role": agent|user|member}
+    db: Any = None
+    runtime: Any = None
+
+
+def ok(data: Any = None, status: int = 200) -> dict:
+    return {"status": status, "data": data}
+
+
+def err(error: str, status: int = 400) -> dict:
+    return {"status": status, "error": error}
+
+
+class Router:
+    def __init__(self) -> None:
+        self._routes: list[tuple[str, re.Pattern, list[str], Handler]] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        names: list[str] = []
+
+        def sub(m: re.Match) -> str:
+            names.append(m.group(1))
+            return r"([^/]+)"
+
+        regex = re.sub(r":(\w+)", sub, pattern)
+        self._routes.append(
+            (method.upper(), re.compile(f"^{regex}$"), names, handler)
+        )
+
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.add("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.add("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: Handler) -> None:
+        self.add("PUT", pattern, handler)
+
+    def delete(self, pattern: str, handler: Handler) -> None:
+        self.add("DELETE", pattern, handler)
+
+    def match(
+        self, method: str, path: str
+    ) -> Optional[tuple[Handler, dict[str, str]]]:
+        for m, regex, names, handler in self._routes:
+            if m != method.upper():
+                continue
+            match = regex.match(path)
+            if match:
+                return handler, dict(zip(names, match.groups()))
+        return None

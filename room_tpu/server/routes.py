@@ -1,0 +1,828 @@
+"""REST route registration (reference: src/server/routes/ — 20 modules;
+grouped here by domain, same /api contract shape {status,data,error})."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .. import __version__
+from ..core import (
+    activity as activity_mod,
+    agent_loop,
+    credentials as credentials_mod,
+    escalations as escalations_mod,
+    goals as goals_mod,
+    memory as memory_mod,
+    messages as messages_mod,
+    quorum as quorum_mod,
+    rooms as rooms_mod,
+    selfmod as selfmod_mod,
+    skills as skills_mod,
+    task_runner,
+    wallet as wallet_mod,
+    workers as workers_mod,
+)
+from ..core.cycle_logs import get_cycle_logs
+from ..providers import get_model_auth_status
+from .router import RequestContext, Router, err, ok
+
+
+def _room_or_404(ctx: RequestContext):
+    room = rooms_mod.get_room(ctx.db, int(ctx.params["id"]))
+    if room is None:
+        return None, err("room not found", 404)
+    return room, None
+
+
+def register_all_routes(r: Router) -> None:
+    register_room_routes(r)
+    register_worker_routes(r)
+    register_goal_routes(r)
+    register_task_routes(r)
+    register_memory_routes(r)
+    register_decision_routes(r)
+    register_skill_routes(r)
+    register_escalation_routes(r)
+    register_message_routes(r)
+    register_credential_routes(r)
+    register_wallet_routes(r)
+    register_settings_routes(r)
+    register_status_routes(r)
+    register_clerk_routes(r)
+
+
+# ---- rooms ----
+
+def register_room_routes(r: Router) -> None:
+    def list_rooms(ctx):
+        return ok([
+            dict(room, launched=agent_loop.is_room_launched(room["id"]))
+            for room in rooms_mod.list_rooms(ctx.db)
+        ])
+
+    def create_room(ctx):
+        b = ctx.body or {}
+        if not b.get("name"):
+            return err("name is required")
+        room = rooms_mod.create_room(
+            ctx.db,
+            b["name"],
+            goal=b.get("goal"),
+            worker_model=b.get("workerModel", "tpu"),
+            queen_model=b.get("queenModel"),
+            create_wallet=b.get("createWallet", True),
+        )
+        return ok(room, 201)
+
+    def get_room(ctx):
+        room, e = _room_or_404(ctx)
+        return e or ok(room)
+
+    def update_room(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        allowed = {
+            "name", "goal", "status", "visibility", "autonomy_mode",
+            "max_concurrent_tasks", "worker_model", "queen_cycle_gap_ms",
+            "queen_max_turns", "queen_quiet_from", "queen_quiet_until",
+            "queen_nickname", "allowed_tools",
+        }
+        fields = {
+            k: v for k, v in (ctx.body or {}).items() if k in allowed
+        }
+        rooms_mod.update_room(ctx.db, room["id"], **fields)
+        if "config" in (ctx.body or {}):
+            ctx.db.execute(
+                "UPDATE rooms SET config=? WHERE id=?",
+                (json.dumps(ctx.body["config"]), room["id"]),
+            )
+        return ok(rooms_mod.get_room(ctx.db, room["id"]))
+
+    def delete_room(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        if ctx.runtime:
+            ctx.runtime.stop_room(room["id"])
+        rooms_mod.delete_room(ctx.db, room["id"])
+        return ok({"deleted": room["id"]})
+
+    def start_room(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        if ctx.runtime is None:
+            return err("runtime not running", 503)
+        if not ctx.runtime.start_room(room["id"]):
+            return err("room has no queen", 409)
+        return ok({"started": room["id"]})
+
+    def stop_room(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        n = ctx.runtime.stop_room(room["id"]) if ctx.runtime else 0
+        return ok({"stopped": room["id"], "loops": n})
+
+    def pause_room(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        rooms_mod.pause_room(ctx.db, room["id"])
+        if ctx.runtime:
+            ctx.runtime.stop_room(room["id"])
+        return ok({"paused": room["id"]})
+
+    def room_status(ctx):
+        st = rooms_mod.get_room_status(ctx.db, int(ctx.params["id"]))
+        if st is None:
+            return err("room not found", 404)
+        st["launched"] = agent_loop.is_room_launched(int(ctx.params["id"]))
+        return ok(st)
+
+    def room_cycles(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        return ok(ctx.db.query(
+            "SELECT * FROM worker_cycles WHERE room_id=? "
+            "ORDER BY id DESC LIMIT 50",
+            (room["id"],),
+        ))
+
+    def cycle_logs(ctx):
+        return ok(get_cycle_logs(ctx.db, int(ctx.params["cycle_id"])))
+
+    def room_activity(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        return ok(activity_mod.recent_activity(ctx.db, room["id"]))
+
+    def room_usage(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        return ok(ctx.db.query_one(
+            "SELECT COUNT(*) AS cycles, "
+            "COALESCE(SUM(input_tokens),0) AS input_tokens, "
+            "COALESCE(SUM(output_tokens),0) AS output_tokens "
+            "FROM worker_cycles WHERE room_id=?",
+            (room["id"],),
+        ))
+
+    def room_chat(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        return ok(messages_mod.chat_history(ctx.db, room["id"]))
+
+    def post_chat(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        content = (ctx.body or {}).get("content", "").strip()
+        if not content:
+            return err("content is required")
+        mid = messages_mod.add_chat_message(
+            ctx.db, room["id"], "user", content
+        )
+        # wake the queen to answer (reference queen inbox poll)
+        if room["queen_worker_id"] and agent_loop.is_room_launched(
+            room["id"]
+        ):
+            agent_loop.trigger_agent(
+                ctx.db, room["id"], room["queen_worker_id"]
+            )
+        return ok({"id": mid}, 201)
+
+    r.get("/api/rooms", list_rooms)
+    r.post("/api/rooms", create_room)
+    r.get("/api/rooms/:id", get_room)
+    r.put("/api/rooms/:id", update_room)
+    r.delete("/api/rooms/:id", delete_room)
+    r.post("/api/rooms/:id/start", start_room)
+    r.post("/api/rooms/:id/stop", stop_room)
+    r.post("/api/rooms/:id/pause", pause_room)
+    r.get("/api/rooms/:id/status", room_status)
+    r.get("/api/rooms/:id/cycles", room_cycles)
+    r.get("/api/cycles/:cycle_id/logs", cycle_logs)
+    r.get("/api/rooms/:id/activity", room_activity)
+    r.get("/api/rooms/:id/usage", room_usage)
+    r.get("/api/rooms/:id/chat", room_chat)
+    r.post("/api/rooms/:id/chat", post_chat)
+
+
+# ---- workers ----
+
+def register_worker_routes(r: Router) -> None:
+    def list_workers(ctx):
+        room, e = _room_or_404(ctx)
+        return e or ok(workers_mod.list_room_workers(ctx.db, room["id"]))
+
+    def create_worker(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        b = ctx.body or {}
+        if not b.get("name"):
+            return err("name is required")
+        wid = workers_mod.create_worker(
+            ctx.db, b["name"], b.get("systemPrompt", ""),
+            room_id=room["id"], role=b.get("role"),
+            model=b.get("model"),
+        )
+        return ok(workers_mod.get_worker(ctx.db, wid), 201)
+
+    def get_worker(ctx):
+        w = workers_mod.get_worker(ctx.db, int(ctx.params["id"]))
+        return ok(w) if w else err("worker not found", 404)
+
+    def update_worker(ctx):
+        wid = int(ctx.params["id"])
+        if workers_mod.get_worker(ctx.db, wid) is None:
+            return err("worker not found", 404)
+        b = ctx.body or {}
+        mapped = {
+            "name": b.get("name"),
+            "role": b.get("role"),
+            "system_prompt": b.get("systemPrompt"),
+            "model": b.get("model"),
+            "cycle_gap_ms": b.get("cycleGapMs"),
+            "max_turns": b.get("maxTurns"),
+        }
+        workers_mod.update_worker(
+            ctx.db, wid,
+            **{k: v for k, v in mapped.items() if v is not None},
+        )
+        return ok(workers_mod.get_worker(ctx.db, wid))
+
+    def delete_worker(ctx):
+        wid = int(ctx.params["id"])
+        w = workers_mod.get_worker(ctx.db, wid)
+        if w is None:
+            return err("worker not found", 404)
+        room = rooms_mod.get_room(ctx.db, w["room_id"]) if w["room_id"] \
+            else None
+        if room and room["queen_worker_id"] == wid:
+            return err("cannot delete the queen", 409)
+        agent_loop.pause_agent(wid)
+        workers_mod.delete_worker(ctx.db, wid)
+        return ok({"deleted": wid})
+
+    def start_worker(ctx):
+        """The cross-process nudge target (reference mcp/nudge.ts)."""
+        wid = int(ctx.params["id"])
+        w = workers_mod.get_worker(ctx.db, wid)
+        if w is None or w["room_id"] is None:
+            return err("worker not found", 404)
+        handle = agent_loop.trigger_agent(
+            ctx.db, w["room_id"], wid,
+            allow_cold_start=bool((ctx.body or {}).get("coldStart")),
+        )
+        return ok({"triggered": handle is not None})
+
+    r.get("/api/rooms/:id/workers", list_workers)
+    r.post("/api/rooms/:id/workers", create_worker)
+    r.get("/api/workers/:id", get_worker)
+    r.put("/api/workers/:id", update_worker)
+    r.delete("/api/workers/:id", delete_worker)
+    r.post("/api/workers/:id/start", start_worker)
+
+
+# ---- goals ----
+
+def register_goal_routes(r: Router) -> None:
+    def list_goals(ctx):
+        room, e = _room_or_404(ctx)
+        return e or ok(goals_mod.get_goal_tree(ctx.db, room["id"]))
+
+    def create_goal(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        b = ctx.body or {}
+        if not b.get("description"):
+            return err("description is required")
+        gid = goals_mod.create_goal(
+            ctx.db, room["id"], b["description"],
+            parent_goal_id=b.get("parentGoalId"),
+            assigned_worker_id=b.get("workerId"),
+        )
+        return ok(goals_mod.get_goal(ctx.db, gid), 201)
+
+    def complete(ctx):
+        gid = int(ctx.params["id"])
+        if goals_mod.get_goal(ctx.db, gid) is None:
+            return err("goal not found", 404)
+        goals_mod.complete_goal(ctx.db, gid)
+        return ok(goals_mod.get_goal(ctx.db, gid))
+
+    def abandon(ctx):
+        gid = int(ctx.params["id"])
+        if goals_mod.get_goal(ctx.db, gid) is None:
+            return err("goal not found", 404)
+        goals_mod.abandon_goal(ctx.db, gid)
+        return ok(goals_mod.get_goal(ctx.db, gid))
+
+    r.get("/api/rooms/:id/goals", list_goals)
+    r.post("/api/rooms/:id/goals", create_goal)
+    r.post("/api/goals/:id/complete", complete)
+    r.post("/api/goals/:id/abandon", abandon)
+
+
+# ---- tasks + runs ----
+
+def register_task_routes(r: Router) -> None:
+    def list_tasks(ctx):
+        room_id = ctx.query.get("roomId")
+        return ok(task_runner.list_tasks(
+            ctx.db, int(room_id) if room_id else None
+        ))
+
+    def create_task(ctx):
+        b = ctx.body or {}
+        for field in ("name", "prompt"):
+            if not b.get(field):
+                return err(f"{field} is required")
+        try:
+            tid = task_runner.create_task(
+                ctx.db, b["name"], b["prompt"],
+                trigger_type=b.get("triggerType", "cron"),
+                cron_expression=b.get("cronExpression"),
+                scheduled_at=b.get("scheduledAt"),
+                room_id=b.get("roomId"),
+                worker_id=b.get("workerId"),
+                session_continuity=bool(b.get("sessionContinuity")),
+                max_runs=b.get("maxRuns"),
+                description=b.get("description"),
+                timeout_minutes=b.get("timeoutMinutes"),
+                max_turns=b.get("maxTurns"),
+            )
+        except ValueError as e:
+            return err(str(e))
+        return ok(task_runner.get_task(ctx.db, tid), 201)
+
+    def get_task(ctx):
+        t = task_runner.get_task(ctx.db, int(ctx.params["id"]))
+        return ok(t) if t else err("task not found", 404)
+
+    def delete_task(ctx):
+        if not task_runner.delete_task(ctx.db, int(ctx.params["id"])):
+            return err("task not found", 404)
+        return ok({"deleted": int(ctx.params["id"])})
+
+    def run_now(ctx):
+        tid = int(ctx.params["id"])
+        if task_runner.get_task(ctx.db, tid) is None:
+            return err("task not found", 404)
+        if ctx.runtime is None:
+            return err("runtime not running", 503)
+        queued = ctx.runtime.run_task_now(tid)
+        return ok({"queued": queued})
+
+    def pause(ctx):
+        task_runner.pause_task(ctx.db, int(ctx.params["id"]))
+        return ok(task_runner.get_task(ctx.db, int(ctx.params["id"])))
+
+    def resume(ctx):
+        task_runner.resume_task(ctx.db, int(ctx.params["id"]))
+        return ok(task_runner.get_task(ctx.db, int(ctx.params["id"])))
+
+    def task_runs(ctx):
+        return ok(ctx.db.query(
+            "SELECT * FROM task_runs WHERE task_id=? ORDER BY id DESC "
+            "LIMIT 50",
+            (int(ctx.params["id"]),),
+        ))
+
+    def get_run(ctx):
+        run = ctx.db.query_one(
+            "SELECT * FROM task_runs WHERE id=?",
+            (int(ctx.params["id"]),),
+        )
+        return ok(run) if run else err("run not found", 404)
+
+    def run_logs(ctx):
+        return ok(ctx.db.query(
+            "SELECT * FROM console_logs WHERE run_id=? ORDER BY seq",
+            (int(ctx.params["id"]),),
+        ))
+
+    r.get("/api/tasks", list_tasks)
+    r.post("/api/tasks", create_task)
+    r.get("/api/tasks/:id", get_task)
+    r.delete("/api/tasks/:id", delete_task)
+    r.post("/api/tasks/:id/run", run_now)
+    r.post("/api/tasks/:id/pause", pause)
+    r.post("/api/tasks/:id/resume", resume)
+    r.get("/api/tasks/:id/runs", task_runs)
+    r.get("/api/runs/:id", get_run)
+    r.get("/api/runs/:id/logs", run_logs)
+
+
+# ---- memory ----
+
+def register_memory_routes(r: Router) -> None:
+    def search(ctx):
+        q = ctx.query.get("q", "")
+        if not q:
+            return err("q is required")
+        room_id = ctx.query.get("roomId")
+        from ..core.queen_tools import _embed_query
+
+        return ok(memory_mod.hybrid_search(
+            ctx.db, q, query_vector=_embed_query(q),
+            room_id=int(room_id) if room_id else None,
+            limit=int(ctx.query.get("limit", "10")),
+        ))
+
+    def remember(ctx):
+        b = ctx.body or {}
+        for field in ("name", "content"):
+            if not b.get(field):
+                return err(f"{field} is required")
+        eid = memory_mod.remember(
+            ctx.db, b["name"], b["content"],
+            category=b.get("category"), room_id=b.get("roomId"),
+        )
+        return ok({"entityId": eid}, 201)
+
+    def get_entity(ctx):
+        ent = memory_mod.get_entity(ctx.db, int(ctx.params["id"]))
+        if ent is None:
+            return err("entity not found", 404)
+        ent["observations"] = memory_mod.get_observations(
+            ctx.db, ent["id"]
+        )
+        ent["relations"] = memory_mod.get_relations(ctx.db, ent["id"])
+        return ok(ent)
+
+    def delete_entity(ctx):
+        if not memory_mod.delete_entity(ctx.db, int(ctx.params["id"])):
+            return err("entity not found", 404)
+        return ok({"deleted": int(ctx.params["id"])})
+
+    r.get("/api/memory/search", search)
+    r.post("/api/memory", remember)
+    r.get("/api/memory/:id", get_entity)
+    r.delete("/api/memory/:id", delete_entity)
+
+
+# ---- decisions ----
+
+def register_decision_routes(r: Router) -> None:
+    def list_decisions(ctx):
+        room, e = _room_or_404(ctx)
+        return e or ok(ctx.db.query(
+            "SELECT * FROM quorum_decisions WHERE room_id=? "
+            "ORDER BY id DESC LIMIT 50",
+            (room["id"],),
+        ))
+
+    def vote(ctx):
+        b = ctx.body or {}
+        try:
+            d = quorum_mod.vote(
+                ctx.db, int(ctx.params["id"]), int(b.get("workerId", 0)),
+                b.get("vote", ""), b.get("reasoning"),
+            )
+        except quorum_mod.QuorumError as e:
+            return err(str(e), 409)
+        return ok(d)
+
+    def keeper_vote(ctx):
+        b = ctx.body or {}
+        try:
+            d = quorum_mod.keeper_vote(
+                ctx.db, int(ctx.params["id"]), b.get("vote", "")
+            )
+        except quorum_mod.QuorumError as e:
+            return err(str(e), 409)
+        return ok(d)
+
+    def object_to(ctx):
+        b = ctx.body or {}
+        try:
+            d = quorum_mod.object_to(
+                ctx.db, int(ctx.params["id"]),
+                int(b.get("workerId", 0)), b.get("reason", ""),
+            )
+        except quorum_mod.QuorumError as e:
+            return err(str(e), 409)
+        return ok(d)
+
+    r.get("/api/rooms/:id/decisions", list_decisions)
+    r.post("/api/decisions/:id/vote", vote)
+    r.post("/api/decisions/:id/keeper-vote", keeper_vote)
+    r.post("/api/decisions/:id/object", object_to)
+
+
+# ---- skills + self-mod ----
+
+def register_skill_routes(r: Router) -> None:
+    def list_skills(ctx):
+        room_id = ctx.query.get("roomId")
+        return ok(skills_mod.list_skills(
+            ctx.db, int(room_id) if room_id else None
+        ))
+
+    def create(ctx):
+        b = ctx.body or {}
+        for field in ("name", "content"):
+            if not b.get(field):
+                return err(f"{field} is required")
+        sid = skills_mod.create_skill(
+            ctx.db, b["name"], b["content"], room_id=b.get("roomId"),
+            activation_context=b.get("activationContext"),
+            auto_activate=bool(b.get("autoActivate")),
+        )
+        return ok(skills_mod.get_skill(ctx.db, sid), 201)
+
+    def update(ctx):
+        sid = int(ctx.params["id"])
+        if skills_mod.get_skill(ctx.db, sid) is None:
+            return err("skill not found", 404)
+        content = (ctx.body or {}).get("content")
+        if content is None:
+            return err("content is required")
+        skills_mod.update_skill(ctx.db, sid, content)
+        return ok(skills_mod.get_skill(ctx.db, sid))
+
+    def delete(ctx):
+        if not skills_mod.delete_skill(ctx.db, int(ctx.params["id"])):
+            return err("skill not found", 404)
+        return ok({"deleted": int(ctx.params["id"])})
+
+    def audit(ctx):
+        room_id = ctx.query.get("roomId")
+        return ok(selfmod_mod.audit_log(
+            ctx.db, int(room_id) if room_id else None
+        ))
+
+    def revert(ctx):
+        try:
+            done = selfmod_mod.revert_modification(
+                ctx.db, int(ctx.params["id"])
+            )
+        except selfmod_mod.SelfModError as e:
+            return err(str(e), 409)
+        if not done:
+            return err("nothing to revert", 409)
+        return ok({"reverted": int(ctx.params["id"])})
+
+    r.get("/api/skills", list_skills)
+    r.post("/api/skills", create)
+    r.put("/api/skills/:id", update)
+    r.delete("/api/skills/:id", delete)
+    r.get("/api/self-mod/audit", audit)
+    r.post("/api/self-mod/:id/revert", revert)
+
+
+# ---- escalations ----
+
+def register_escalation_routes(r: Router) -> None:
+    def list_escalations(ctx):
+        room_id = ctx.query.get("roomId")
+        return ok(escalations_mod.pending_escalations(
+            ctx.db, int(room_id) if room_id else None
+        ))
+
+    def answer(ctx):
+        eid = int(ctx.params["id"])
+        esc = escalations_mod.get_escalation(ctx.db, eid)
+        if esc is None:
+            return err("escalation not found", 404)
+        answer_text = (ctx.body or {}).get("answer", "").strip()
+        if not answer_text:
+            return err("answer is required")
+        escalations_mod.answer_escalation(ctx.db, eid, answer_text)
+        # answered escalations wake the asking room's queen
+        room = rooms_mod.get_room(ctx.db, esc["room_id"])
+        if room and room["queen_worker_id"]:
+            agent_loop.trigger_agent(
+                ctx.db, room["id"], room["queen_worker_id"]
+            )
+        return ok(escalations_mod.get_escalation(ctx.db, eid))
+
+    def dismiss(ctx):
+        eid = int(ctx.params["id"])
+        if escalations_mod.get_escalation(ctx.db, eid) is None:
+            return err("escalation not found", 404)
+        escalations_mod.dismiss_escalation(ctx.db, eid)
+        return ok(escalations_mod.get_escalation(ctx.db, eid))
+
+    r.get("/api/escalations", list_escalations)
+    r.post("/api/escalations/:id/answer", answer)
+    r.post("/api/escalations/:id/dismiss", dismiss)
+
+
+# ---- inter-room messages ----
+
+def register_message_routes(r: Router) -> None:
+    def list_messages(ctx):
+        room, e = _room_or_404(ctx)
+        return e or ok(ctx.db.query(
+            "SELECT * FROM room_messages WHERE room_id=? "
+            "ORDER BY id DESC LIMIT 100",
+            (room["id"],),
+        ))
+
+    def send(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        b = ctx.body or {}
+        to_room = b.get("toRoomId")
+        if to_room is None or not b.get("body"):
+            return err("toRoomId and body are required")
+        if rooms_mod.get_room(ctx.db, int(to_room)) is None:
+            return err("destination room not found", 404)
+        out_id, in_id = messages_mod.send_room_message(
+            ctx.db, room["id"], int(to_room), b.get("subject", ""),
+            b["body"],
+        )
+        return ok({"outboundId": out_id, "inboundId": in_id}, 201)
+
+    def mark_read(ctx):
+        messages_mod.mark_message_read(ctx.db, int(ctx.params["id"]))
+        return ok({"read": int(ctx.params["id"])})
+
+    def reply(ctx):
+        mid = int(ctx.params["id"])
+        msg = ctx.db.query_one(
+            "SELECT * FROM room_messages WHERE id=?", (mid,)
+        )
+        if msg is None:
+            return err("message not found", 404)
+        body = (ctx.body or {}).get("body", "").strip()
+        if not body:
+            return err("body is required")
+        try:
+            from_room = int(msg["from_room_id"])
+        except (TypeError, ValueError):
+            return err("message has no replyable source", 409)
+        messages_mod.send_room_message(
+            ctx.db, msg["room_id"], from_room,
+            f"Re: {msg['subject']}", body,
+        )
+        messages_mod.mark_message_replied(ctx.db, mid)
+        return ok({"replied": mid})
+
+    r.get("/api/rooms/:id/messages", list_messages)
+    r.post("/api/rooms/:id/messages", send)
+    r.post("/api/messages/:id/read", mark_read)
+    r.post("/api/messages/:id/reply", reply)
+
+
+# ---- credentials ----
+
+def register_credential_routes(r: Router) -> None:
+    def list_creds(ctx):
+        room, e = _room_or_404(ctx)
+        return e or ok(credentials_mod.list_credentials(
+            ctx.db, room["id"]
+        ))
+
+    def store(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        b = ctx.body or {}
+        for field in ("name", "value"):
+            if not b.get(field):
+                return err(f"{field} is required")
+        credentials_mod.store_credential(
+            ctx.db, room["id"], b["name"], b["value"],
+            type_=b.get("type", "other"),
+        )
+        return ok({"stored": b["name"]}, 201)
+
+    def delete(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        name = ctx.params["name"]
+        if not credentials_mod.delete_credential(ctx.db, room["id"], name):
+            return err("credential not found", 404)
+        return ok({"deleted": name})
+
+    r.get("/api/rooms/:id/credentials", list_creds)
+    r.post("/api/rooms/:id/credentials", store)
+    r.delete("/api/rooms/:id/credentials/:name", delete)
+
+
+# ---- wallet ----
+
+def register_wallet_routes(r: Router) -> None:
+    def get_wallet(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        w = wallet_mod.get_room_wallet(ctx.db, room["id"])
+        if w is None:
+            return err("room has no wallet", 404)
+        safe = {k: v for k, v in w.items()
+                if k != "private_key_encrypted"}
+        return ok(safe)
+
+    def transactions(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        w = wallet_mod.get_room_wallet(ctx.db, room["id"])
+        if w is None:
+            return err("room has no wallet", 404)
+        return ok(wallet_mod.list_transactions(ctx.db, w["id"]))
+
+    def balance(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        try:
+            native = wallet_mod.get_native_balance(ctx.db, room["id"])
+            usdc = wallet_mod.get_token_balance(ctx.db, room["id"])
+        except wallet_mod.WalletError as ex:
+            return err(str(ex), 503)
+        return ok({"native_wei": str(native), "usdc_units": str(usdc)})
+
+    r.get("/api/rooms/:id/wallet", get_wallet)
+    r.get("/api/rooms/:id/wallet/transactions", transactions)
+    r.get("/api/rooms/:id/wallet/balance", balance)
+
+
+# ---- settings / status / clerk ----
+
+def register_settings_routes(r: Router) -> None:
+    SECRET_HINTS = ("key", "token", "secret", "password")
+
+    def get_settings(ctx):
+        out = {}
+        for k, v in messages_mod.all_settings(ctx.db).items():
+            if any(h in k.lower() for h in SECRET_HINTS) and v:
+                out[k] = "***"
+            else:
+                out[k] = v
+        return ok(out)
+
+    def put_settings(ctx):
+        for k, v in (ctx.body or {}).items():
+            messages_mod.set_setting(ctx.db, str(k),
+                                     None if v is None else str(v))
+        return ok({"updated": len(ctx.body or {})})
+
+    r.get("/api/settings", get_settings)
+    r.put("/api/settings", put_settings)
+
+
+def register_status_routes(r: Router) -> None:
+    def status(ctx):
+        import jax
+
+        rooms_active = len(rooms_mod.list_rooms(ctx.db, "active"))
+        return ok({
+            "version": __version__,
+            "platform": jax.default_backend(),
+            "devices": jax.device_count(),
+            "activeRooms": rooms_active,
+            "runningWorkers": agent_loop.running_workers(),
+            "runtime": ctx.runtime is not None,
+        })
+
+    def models(ctx):
+        out = {}
+        for model in ("tpu:qwen3-coder-30b", "tpu:qwen2.5-72b",
+                      "openai:gpt-4o-mini", "anthropic:claude-3-5-haiku",
+                      "ollama:qwen3-coder:30b"):
+            out[model] = get_model_auth_status(model, ctx.db)
+        return ok(out)
+
+    r.get("/api/status", status)
+    r.get("/api/models/status", models)
+
+
+def register_clerk_routes(r: Router) -> None:
+    def clerk_messages(ctx):
+        return ok(list(reversed(ctx.db.query(
+            "SELECT * FROM clerk_messages ORDER BY id DESC LIMIT 100"
+        ))))
+
+    def clerk_message(ctx):
+        content = (ctx.body or {}).get("content", "").strip()
+        if not content:
+            return err("content is required")
+        from ..core.clerk import run_clerk_turn
+
+        reply = run_clerk_turn(ctx.db, content, runtime=ctx.runtime)
+        return ok(reply, 201)
+
+    def clerk_usage(ctx):
+        return ok(ctx.db.query(
+            "SELECT * FROM clerk_usage ORDER BY id DESC LIMIT 100"
+        ))
+
+    r.get("/api/clerk/messages", clerk_messages)
+    r.post("/api/clerk/message", clerk_message)
+    r.get("/api/clerk/usage", clerk_usage)

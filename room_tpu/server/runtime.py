@@ -1,0 +1,216 @@
+"""Server runtime loops (reference: src/server/runtime.ts): scheduler
+tick (cron + due one-time tasks), maintenance sweep (stale runs/cycles),
+queen inbox poll, with in-flight flags so a slow tick never stacks.
+
+Thread-per-loop replaces node timers; all loops stop via one event."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Optional
+
+from ..db import Database, utc_now
+from ..core import messages as messages_mod
+from ..core import rooms as rooms_mod
+from ..core import task_runner
+from ..core.agent_loop import (
+    is_room_launched, set_room_launch_enabled, stop_room_loops,
+    trigger_agent,
+)
+from ..core.cron import cron_matches
+from ..core.events import event_bus
+
+SCHEDULER_TICK_S = 15.0
+MAINTENANCE_TICK_S = 60.0
+INBOX_POLL_S = 2.5
+STALE_RUN_MINUTES = 120
+
+
+@dataclass
+class ServerRuntime:
+    db: Database
+    stop_event: threading.Event = field(default_factory=threading.Event)
+    threads: list[threading.Thread] = field(default_factory=list)
+    _pending_tasks: set[int] = field(default_factory=set)
+    _pending_lock: threading.Lock = field(default_factory=threading.Lock)
+    _last_cron_minute: Optional[str] = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self.cleanup_stale(startup=True)
+        self.scheduler_tick()
+        for target, interval in (
+            (self.scheduler_tick, SCHEDULER_TICK_S),
+            (self.maintenance_tick, MAINTENANCE_TICK_S),
+            (self.inbox_poll, INBOX_POLL_S),
+        ):
+            t = threading.Thread(
+                target=self._loop, args=(target, interval),
+                daemon=True, name=f"runtime-{target.__name__}",
+            )
+            t.start()
+            self.threads.append(t)
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        for t in self.threads:
+            t.join(timeout=5)
+
+    def _loop(self, tick, interval: float) -> None:
+        busy = threading.Lock()
+        while not self.stop_event.wait(timeout=interval):
+            if not busy.acquire(blocking=False):
+                continue  # previous tick still running
+            try:
+                tick()
+            except Exception as e:
+                event_bus.emit("runtime:error", "runtime",
+                               {"loop": tick.__name__, "error": str(e)})
+            finally:
+                busy.release()
+
+    # ---- ticks ----
+
+    def scheduler_tick(self) -> None:
+        now = datetime.now()
+        minute_key = now.strftime("%Y-%m-%dT%H:%M")
+        fire_cron = minute_key != self._last_cron_minute
+        if fire_cron:
+            self._last_cron_minute = minute_key
+
+        if fire_cron:
+            for task in self.db.query(
+                "SELECT * FROM tasks WHERE status='active' AND "
+                "trigger_type='cron' AND cron_expression IS NOT NULL"
+            ):
+                try:
+                    due = cron_matches(task["cron_expression"], now)
+                except Exception:
+                    continue
+                if due:
+                    self.queue_task_execution(task["id"])
+
+        for task in self.db.query(
+            "SELECT * FROM tasks WHERE status='active' AND "
+            "trigger_type='once' AND scheduled_at IS NOT NULL AND "
+            "scheduled_at <= ?",
+            (utc_now(),),
+        ):
+            self.queue_task_execution(task["id"])
+            self.db.execute(
+                "UPDATE tasks SET status='archived', updated_at=? "
+                "WHERE id=?",
+                (utc_now(), task["id"]),
+            )
+
+    def maintenance_tick(self) -> None:
+        self.cleanup_stale()
+
+    def inbox_poll(self) -> None:
+        """Unanswered keeper chat wakes the room's queen (reference:
+        runtime.ts:47-61)."""
+        for room in rooms_mod.list_rooms(self.db, status="active"):
+            if not is_room_launched(room["id"]):
+                continue
+            if not room["queen_worker_id"]:
+                continue
+            if messages_mod.unanswered_keeper_messages(
+                self.db, room["id"]
+            ):
+                trigger_agent(
+                    self.db, room["id"], room["queen_worker_id"]
+                )
+
+    # ---- operations ----
+
+    def queue_task_execution(self, task_id: int) -> bool:
+        """Dedupe + background execution (reference:
+        queueTaskExecution:96-150)."""
+        with self._pending_lock:
+            if task_id in self._pending_tasks:
+                return False
+            self._pending_tasks.add(task_id)
+
+        def run() -> None:
+            try:
+                task_runner.execute_task(
+                    self.db, task_id, abort=self.stop_event
+                )
+            finally:
+                with self._pending_lock:
+                    self._pending_tasks.discard(task_id)
+
+        threading.Thread(
+            target=run, daemon=True, name=f"task-{task_id}"
+        ).start()
+        return True
+
+    def run_task_now(self, task_id: int) -> bool:
+        return self.queue_task_execution(task_id)
+
+    def start_room(self, room_id: int) -> bool:
+        """POST /rooms/:id/start semantics (reference:
+        routes/rooms.ts:336-359): enable launch, reset runtime, cold-start
+        the queen."""
+        room = rooms_mod.get_room(self.db, room_id)
+        if room is None or not room["queen_worker_id"]:
+            return False
+        rooms_mod.restart_room(self.db, room_id)
+        set_room_launch_enabled(room_id, True)
+        stop_room_loops(self.db, room_id, "runtime reset")
+        trigger_agent(
+            self.db, room_id, room["queen_worker_id"],
+            allow_cold_start=True,
+        )
+        event_bus.emit("room:started", f"room:{room_id}", {})
+        return True
+
+    def stop_room(self, room_id: int) -> int:
+        n = stop_room_loops(self.db, room_id, "stopped by keeper")
+        task_runner.cancel_running_tasks_for_room(self.db, room_id)
+        event_bus.emit("room:stopped", f"room:{room_id}", {})
+        return n
+
+    def cleanup_stale(self, startup: bool = False) -> int:
+        """Mark long-running/orphaned runs and cycles failed (reference:
+        db-queries.ts:544-573, runtime.ts:336)."""
+        n = 0
+        cutoff = f"-{STALE_RUN_MINUTES} minutes"
+        for table, col in (("task_runs", "started_at"),
+                           ("worker_cycles", "started_at")):
+            cur = self.db.execute(
+                f"UPDATE {table} SET status='error', "
+                "error_message='stale: abandoned run', finished_at=? "
+                f"WHERE status='running' AND ({col} < "
+                "strftime('%Y-%m-%dT%H:%M:%fZ','now', ?) OR ?)",
+                (utc_now(), cutoff, 1 if startup else 0),
+            )
+            n += cur.rowcount
+        return n
+
+
+_runtime: Optional[ServerRuntime] = None
+
+
+def start_server_runtime(db: Database) -> ServerRuntime:
+    global _runtime
+    if _runtime is not None:
+        return _runtime
+    _runtime = ServerRuntime(db=db)
+    _runtime.start()
+    return _runtime
+
+
+def get_server_runtime() -> Optional[ServerRuntime]:
+    return _runtime
+
+
+def stop_server_runtime() -> None:
+    global _runtime
+    if _runtime is not None:
+        _runtime.stop()
+        _runtime = None

@@ -1,0 +1,77 @@
+"""Tokened webhook endpoints, dispatched before auth (reference:
+src/server/webhooks.ts): POST /api/hooks/task/:token runs the matching
+task; POST /api/hooks/queen/:token files an escalation and wakes the
+queen. 30 req/min per token."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+WEBHOOK_RATE_PER_MIN = 30
+
+_hits: dict[str, list[float]] = {}
+_lock = threading.Lock()
+
+
+def _rate_ok(token: str) -> bool:
+    now = time.monotonic()
+    with _lock:
+        hits = [t for t in _hits.get(token, []) if now - t < 60]
+        if len(hits) >= WEBHOOK_RATE_PER_MIN:
+            _hits[token] = hits
+            return False
+        hits.append(now)
+        _hits[token] = hits
+        return True
+
+
+def handle_webhook_request(
+    server, method: str, path: str, body: Any
+) -> tuple[int, dict]:
+    if method != "POST":
+        return 405, {"error": "POST only"}
+    parts = path.strip("/").split("/")
+    # api/hooks/<kind>/<token>
+    if len(parts) != 4:
+        return 404, {"error": "not found"}
+    kind, token = parts[2], parts[3]
+    if not _rate_ok(token):
+        return 429, {"error": "rate limited"}
+
+    db = server.db
+    if kind == "task":
+        task = db.query_one(
+            "SELECT * FROM tasks WHERE webhook_token=?", (token,)
+        )
+        if task is None:
+            return 404, {"error": "unknown token"}
+        if server.runtime is None:
+            return 503, {"error": "runtime not running"}
+        queued = server.runtime.run_task_now(task["id"])
+        return 200, {"status": 200,
+                     "data": {"taskId": task["id"], "queued": queued}}
+
+    if kind == "queen":
+        room = db.query_one(
+            "SELECT * FROM rooms WHERE webhook_token=?", (token,)
+        )
+        if room is None:
+            return 404, {"error": "unknown token"}
+        question = ""
+        if isinstance(body, dict):
+            question = str(
+                body.get("message") or body.get("question") or body
+            )[:2000]
+        from ..core.agent_loop import trigger_agent
+        from ..core.escalations import create_escalation
+
+        eid = create_escalation(
+            db, room["id"], question or "webhook ping"
+        )
+        if room["queen_worker_id"]:
+            trigger_agent(db, room["id"], room["queen_worker_id"])
+        return 200, {"status": 200, "data": {"escalationId": eid}}
+
+    return 404, {"error": "not found"}

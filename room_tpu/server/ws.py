@@ -1,0 +1,236 @@
+"""WebSocket server on the stdlib HTTP stack (reference:
+src/server/ws.ts): ?token= upgrade auth, channel subscribe/unsubscribe
+protocol, 30 s ping heartbeat, event-bus fan-out to subscribed channels.
+
+RFC 6455 implemented directly (no external ws dependency): handshake
+accept key, masked client frames, server text/ping/pong/close frames."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import threading
+import urllib.parse
+from typing import Optional
+
+from ..core.events import event_bus
+from .auth import get_token_principal
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+HEARTBEAT_S = 30.0
+
+
+def _accept_key(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((key + _WS_GUID).encode()).digest()
+    ).decode()
+
+
+def _encode_frame(opcode: int, payload: bytes) -> bytes:
+    header = bytearray([0x80 | opcode])
+    n = len(payload)
+    if n < 126:
+        header.append(n)
+    elif n < 65536:
+        header.append(126)
+        header += struct.pack(">H", n)
+    else:
+        header.append(127)
+        header += struct.pack(">Q", n)
+    return bytes(header) + payload
+
+
+class _Client:
+    def __init__(self, sock) -> None:
+        self.sock = sock
+        self.channels: set[str] = set()
+        self.alive = True
+        self._send_lock = threading.Lock()
+
+    def send_text(self, text: str) -> bool:
+        try:
+            with self._send_lock:
+                self.sock.sendall(_encode_frame(0x1, text.encode()))
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+    def ping(self) -> bool:
+        try:
+            with self._send_lock:
+                self.sock.sendall(_encode_frame(0x9, b""))
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+    def close(self) -> None:
+        try:
+            with self._send_lock:
+                self.sock.sendall(_encode_frame(0x8, b""))
+        except OSError:
+            pass
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class WebSocketHub:
+    def __init__(self, server) -> None:
+        self.server = server
+        self._clients: list[_Client] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._unsubscribe = None
+
+    def start(self) -> None:
+        self._unsubscribe = event_bus.subscribe(None, self._on_event)
+        threading.Thread(
+            target=self._heartbeat, daemon=True, name="ws-heartbeat"
+        ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._unsubscribe:
+            self._unsubscribe()
+        with self._lock:
+            clients, self._clients = self._clients, []
+        for c in clients:
+            c.close()
+
+    @property
+    def client_count(self) -> int:
+        with self._lock:
+            return len(self._clients)
+
+    # ---- upgrade + per-connection loop ----
+
+    def handle_upgrade(self, handler) -> None:
+        parsed = urllib.parse.urlparse(handler.path)
+        if parsed.path != "/ws":
+            handler.send_response(404)
+            handler.end_headers()
+            return
+        query = urllib.parse.parse_qs(parsed.query)
+        token = (query.get("token") or [None])[0]
+        if get_token_principal(token, self.server.tokens) is None:
+            handler.send_response(401)
+            handler.end_headers()
+            return
+        key = handler.headers.get("Sec-WebSocket-Key")
+        if not key:
+            handler.send_response(400)
+            handler.end_headers()
+            return
+
+        handler.send_response(101, "Switching Protocols")
+        handler.send_header("Upgrade", "websocket")
+        handler.send_header("Connection", "Upgrade")
+        handler.send_header("Sec-WebSocket-Accept", _accept_key(key))
+        handler.end_headers()
+
+        sock = handler.connection
+        sock.settimeout(None)
+        client = _Client(sock)
+        with self._lock:
+            self._clients.append(client)
+        try:
+            self._reader_loop(client, handler)
+        finally:
+            with self._lock:
+                if client in self._clients:
+                    self._clients.remove(client)
+            client.alive = False
+        handler.close_connection = True
+
+    def _reader_loop(self, client: _Client, handler) -> None:
+        rfile = handler.rfile
+        while client.alive and not self._stop.is_set():
+            frame = self._read_frame(rfile)
+            if frame is None:
+                return
+            opcode, payload = frame
+            if opcode == 0x8:        # close
+                client.close()
+                return
+            if opcode == 0x9:        # ping -> pong
+                try:
+                    with client._send_lock:
+                        client.sock.sendall(_encode_frame(0xA, payload))
+                except OSError:
+                    return
+                continue
+            if opcode == 0xA:        # pong
+                continue
+            if opcode != 0x1:
+                continue
+            try:
+                msg = json.loads(payload)
+            except json.JSONDecodeError:
+                continue
+            action = msg.get("type")
+            channel = msg.get("channel")
+            if action == "subscribe" and channel:
+                client.channels.add(channel)
+                client.send_text(json.dumps(
+                    {"type": "subscribed", "channel": channel}
+                ))
+            elif action == "unsubscribe" and channel:
+                client.channels.discard(channel)
+                client.send_text(json.dumps(
+                    {"type": "unsubscribed", "channel": channel}
+                ))
+
+    @staticmethod
+    def _read_frame(rfile) -> Optional[tuple[int, bytes]]:
+        try:
+            head = rfile.read(2)
+            if len(head) < 2:
+                return None
+            opcode = head[0] & 0x0F
+            masked = bool(head[1] & 0x80)
+            length = head[1] & 0x7F
+            if length == 126:
+                length = struct.unpack(">H", rfile.read(2))[0]
+            elif length == 127:
+                length = struct.unpack(">Q", rfile.read(8))[0]
+            if length > 1_000_000:
+                return None
+            mask = rfile.read(4) if masked else b"\x00" * 4
+            payload = bytearray(rfile.read(length))
+            if masked:
+                for i in range(len(payload)):
+                    payload[i] ^= mask[i % 4]
+            return opcode, bytes(payload)
+        except (OSError, struct.error):
+            return None
+
+    # ---- fan-out ----
+
+    def _on_event(self, event) -> None:
+        text = json.dumps({
+            "type": event.type,
+            "channel": event.channel,
+            "data": event.data,
+            "timestamp": event.timestamp,
+        })
+        with self._lock:
+            clients = list(self._clients)
+        for c in clients:
+            if event.channel in c.channels or "*" in c.channels:
+                c.send_text(text)
+
+    def _heartbeat(self) -> None:
+        while not self._stop.wait(timeout=HEARTBEAT_S):
+            with self._lock:
+                clients = list(self._clients)
+            for c in clients:
+                if not c.ping():
+                    with self._lock:
+                        if c in self._clients:
+                            self._clients.remove(c)

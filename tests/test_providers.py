@@ -131,6 +131,11 @@ class _StubEngine:
         self.script = list(script)
         self.sessions = {}
         self.submits = []
+        self.released = []
+
+    def release_session(self, session_id):
+        self.released.append(session_id)
+        self.sessions.pop(session_id, None)
 
     def submit(self, tokens, *, session_id=None, sampling=None,
                on_token=None):
@@ -342,3 +347,39 @@ def test_network_unreachable_fails_closed(monkeypatch):
     p = http_api.OpenAICompatProvider("openai", "gpt-4o-mini")
     r = p.execute(ExecutionRequest(prompt="x", timeout_s=2))
     assert not r.success and "unreachable" in r.error
+
+
+def test_tpu_ephemeral_sessions_release_pages(tpu_provider_with_stub):
+    from room_tpu.providers import tpu as tpu_mod
+
+    provider, install = tpu_provider_with_stub
+    eng = install([("one-shot<|im_end|>", "stop")])
+    released = []
+    eng.release_session = lambda sid: released.append(sid)
+    r = provider.execute(ExecutionRequest(prompt="x"))  # no session_id
+    assert r.success and released, "ephemeral session must be released"
+    assert r.session_id is None
+
+
+def test_system_prompt_survives_message_history(monkeypatch):
+    from room_tpu.providers import http_api
+
+    bodies = []
+
+    def fake_post(url, body, headers, timeout):
+        bodies.append(body)
+        return {"choices": [{"message": {"role": "assistant",
+                                        "content": "ok"}}], "usage": {}}
+
+    monkeypatch.setattr(http_api, "_post_json", fake_post)
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-test")
+    p = http_api.OpenAICompatProvider("openai", "gpt-4o-mini")
+    p.execute(ExecutionRequest(
+        prompt="next turn",
+        system_prompt="you are the clerk",
+        messages=[{"role": "user", "content": "old"},
+                  {"role": "assistant", "content": "old reply"}],
+    ))
+    roles = [m["role"] for m in bodies[0]["messages"]]
+    assert roles[0] == "system"
+    assert bodies[0]["max_tokens"] == 1024

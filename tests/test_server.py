@@ -1,0 +1,314 @@
+"""HTTP/WS integration tests: real server on port 0, real HTTP requests,
+real WebSocket client (reference pattern:
+src/server/__tests__/helpers/test-server.ts — in-memory DB, ephemeral
+port, agent/user/no-auth request helpers)."""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.core import rooms, workers, task_runner
+from room_tpu.core.events import event_bus
+from room_tpu.providers import get_model_provider, reset_provider_cache
+from room_tpu.server.http import ApiServer
+from room_tpu.server.runtime import ServerRuntime
+from room_tpu.server.auth import sign_cloud_jwt
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    db = Database(":memory:")
+    runtime = ServerRuntime(db=db)
+    api = ApiServer(db, runtime=runtime, port=0)
+    api.start()
+    yield api
+    api.stop()
+    db.close()
+
+
+def req(server, method, path, body=None, token="agent", raw_token=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    headers = {}
+    if raw_token is not None:
+        headers["Authorization"] = f"Bearer {raw_token}"
+    elif token is not None:
+        headers["Authorization"] = f"Bearer {server.tokens[token]}"
+    data = json.dumps(body).encode() if body is not None else None
+    if data:
+        headers["Content-Type"] = "application/json"
+    r = urllib.request.Request(url, data=data, headers=headers,
+                               method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_auth_required(server):
+    status, out = req(server, "GET", "/api/rooms", token=None)
+    assert status == 401
+    status, out = req(server, "GET", "/api/rooms", raw_token="wrong")
+    assert status == 401
+    status, out = req(server, "GET", "/api/rooms")
+    assert status == 200 and out["data"] == []
+
+
+def test_handshake_returns_user_token(server):
+    status, out = req(server, "GET", "/api/auth/handshake", token=None)
+    assert status == 200
+    assert out["data"]["userToken"] == server.tokens["user"]
+
+
+def test_member_jwt_rbac(server, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_CLOUD_JWT_SECRET", "s3cret")
+    jwt = sign_cloud_jwt(
+        {"iss": "room-tpu-cloud", "aud": "room-tpu-runtime",
+         "exp": time.time() + 60, "role": "member"},
+        "s3cret",
+    )
+    status, _ = req(server, "GET", "/api/rooms", raw_token=jwt)
+    assert status == 200
+    # member cannot write outside the whitelist
+    status, _ = req(server, "POST", "/api/rooms", {"name": "x"},
+                    raw_token=jwt)
+    assert status == 403
+    # bad signature rejected
+    status, _ = req(server, "GET", "/api/rooms",
+                    raw_token=jwt[:-3] + "abc")
+    assert status == 401
+
+
+def test_room_crud_over_http(server):
+    status, out = req(server, "POST", "/api/rooms",
+                      {"name": "api-room", "goal": "test the API",
+                       "workerModel": "echo", "createWallet": False})
+    assert status == 201
+    room_id = out["data"]["id"]
+
+    status, out = req(server, "GET", f"/api/rooms/{room_id}/status")
+    assert status == 200 and out["data"]["worker_count"] == 1
+
+    status, out = req(server, "PUT", f"/api/rooms/{room_id}",
+                      {"goal": "new goal"})
+    assert out["data"]["goal"] == "new goal"
+
+    status, out = req(server, "GET", f"/api/rooms/{room_id}/workers")
+    assert len(out["data"]) == 1
+    assert out["data"][0]["role"] == "queen"
+
+    status, out = req(server, "DELETE", f"/api/rooms/{room_id}")
+    assert status == 200
+    status, _ = req(server, "GET", f"/api/rooms/{room_id}")
+    assert status == 404
+
+
+def test_room_start_runs_real_cycle(server):
+    reset_provider_cache()
+    echo = get_model_provider("echo")
+    echo.responses.clear()
+
+    status, out = req(server, "POST", "/api/rooms",
+                      {"name": "live", "goal": "g", "workerModel": "echo",
+                       "createWallet": False})
+    room_id = out["data"]["id"]
+    status, out = req(server, "POST", f"/api/rooms/{room_id}/start")
+    assert status == 200
+
+    for _ in range(100):
+        _, out = req(server, "GET", f"/api/rooms/{room_id}/cycles")
+        if out["data"] and out["data"][0]["status"] == "success":
+            break
+        time.sleep(0.05)
+    assert out["data"], "no cycle ran"
+    cycle_id = out["data"][0]["id"]
+    _, logs = req(server, "GET", f"/api/cycles/{cycle_id}/logs")
+    assert any(e["entry_type"] == "prompt" for e in logs["data"])
+
+    req(server, "POST", f"/api/rooms/{room_id}/stop")
+
+
+def test_task_webhook_no_auth(server):
+    reset_provider_cache()
+    get_model_provider("echo").responses.append("webhook ran")
+    db = server.db
+    tid = task_runner.create_task(db, "hooked", "p", trigger_type="webhook")
+    token = task_runner.get_task(db, tid)["webhook_token"]
+
+    status, out = req(server, "POST", f"/api/hooks/task/{token}",
+                      {"x": 1}, token=None)
+    assert status == 200 and out["data"]["queued"]
+    status, _ = req(server, "POST", "/api/hooks/task/not-a-token", {},
+                    token=None)
+    assert status == 404
+
+
+def test_queen_webhook_files_escalation(server):
+    db = server.db
+    room = rooms.create_room(db, "hooked", worker_model="echo",
+                             create_wallet=False)
+    status, out = req(
+        server, "POST", f"/api/hooks/queen/{room['webhook_token']}",
+        {"message": "deploy finished"}, token=None,
+    )
+    assert status == 200
+    _, esc = req(server, "GET", "/api/escalations")
+    assert any("deploy finished" in e["question"] for e in esc["data"])
+
+
+def test_settings_masks_secrets(server):
+    req(server, "PUT", "/api/settings",
+        {"keeper_email": "k@x.com", "openai_api_key": "sk-secret"})
+    _, out = req(server, "GET", "/api/settings")
+    assert out["data"]["keeper_email"] == "k@x.com"
+    assert out["data"]["openai_api_key"] == "***"
+
+
+def test_status_endpoint(server):
+    _, out = req(server, "GET", "/api/status")
+    assert out["data"]["version"]
+    assert out["data"]["runtime"] is True
+
+
+def test_memory_over_http(server):
+    req(server, "POST", "/api/memory",
+        {"name": "deploy notes", "content": "use blue-green"})
+    _, out = req(server, "GET", "/api/memory/search?q=blue-green")
+    assert out["data"] and out["data"][0]["name"] == "deploy notes"
+
+
+def test_static_traversal_guard(server, tmp_path):
+    server.static_dir = str(tmp_path)
+    (tmp_path / "index.html").write_text("<html>app</html>")
+    url = f"http://127.0.0.1:{server.port}/"
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        assert b"app" in resp.read()
+    # traversal attempt
+    conn = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    conn.sendall(
+        b"GET /../../etc/passwd HTTP/1.1\r\nHost: x\r\n\r\n"
+    )
+    data = conn.recv(4096)
+    conn.close()
+    assert b"passwd" not in data or b"root:" not in data
+
+
+# ---- WebSocket ----
+
+class WsClient:
+    def __init__(self, port: int, token: str) -> None:
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self.sock.sendall(
+            f"GET /ws?token={token} HTTP/1.1\r\n"
+            f"Host: 127.0.0.1:{port}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n".encode()
+        )
+        # read HTTP response head
+        head = b""
+        while b"\r\n\r\n" not in head:
+            head += self.sock.recv(1)
+        self.status = int(head.split(b" ")[1])
+
+    def send_json(self, obj) -> None:
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        header = bytearray([0x81])
+        n = len(payload)
+        if n < 126:
+            header.append(0x80 | n)
+        else:
+            header.append(0x80 | 126)
+            header += struct.pack(">H", n)
+        self.sock.sendall(bytes(header) + mask + masked)
+
+    def recv_json(self, timeout=5):
+        self.sock.settimeout(timeout)
+        while True:
+            head = self._read_exact(2)
+            opcode = head[0] & 0x0F
+            length = head[1] & 0x7F
+            if length == 126:
+                length = struct.unpack(">H", self._read_exact(2))[0]
+            payload = self._read_exact(length)
+            if opcode == 0x9:  # server ping: ignore
+                continue
+            if opcode == 0x1:
+                return json.loads(payload)
+
+    def _read_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    def close(self):
+        self.sock.close()
+
+
+def test_ws_auth_and_fanout(server):
+    bad = WsClient(server.port, "wrong-token")
+    assert bad.status == 401
+    bad.close()
+
+    ws = WsClient(server.port, server.tokens["user"])
+    assert ws.status == 101
+    ws.send_json({"type": "subscribe", "channel": "tasks"})
+    assert ws.recv_json()["type"] == "subscribed"
+
+    event_bus.emit("run:created", "tasks", {"run_id": 1})
+    msg = ws.recv_json()
+    assert msg["type"] == "run:created"
+    assert msg["data"] == {"run_id": 1}
+
+    # unsubscribed channel events don't arrive
+    ws.send_json({"type": "unsubscribe", "channel": "tasks"})
+    assert ws.recv_json()["type"] == "unsubscribed"
+    event_bus.emit("run:created", "tasks", {"run_id": 2})
+    with pytest.raises((TimeoutError, socket.timeout)):
+        ws.recv_json(timeout=0.5)
+    ws.close()
+
+
+def test_start_room_twice_keeps_loop_alive(server):
+    """Restarting a running room must hand back a LIVE loop, not the
+    dying handle of the loop being stopped (regression)."""
+    import room_tpu.core.agent_loop as al
+
+    reset_provider_cache()
+    _, out = req(server, "POST", "/api/rooms",
+                 {"name": "restartable", "workerModel": "echo",
+                  "createWallet": False})
+    room_id = out["data"]["id"]
+    req(server, "POST", f"/api/rooms/{room_id}/start")
+    time.sleep(0.2)
+    req(server, "POST", f"/api/rooms/{room_id}/start")  # restart
+    deadline = time.time() + 5
+    alive = False
+    while time.time() < deadline:
+        handles = [h for h in al._running_loops.values()
+                   if h.room_id == room_id and h.thread
+                   and h.thread.is_alive() and not h.stop.is_set()]
+        if handles:
+            alive = True
+            break
+        time.sleep(0.05)
+    assert alive, "no live loop after restart"
+    req(server, "POST", f"/api/rooms/{room_id}/stop")

@@ -1,0 +1,225 @@
+"""Task scheduler tests: cron parsing, task runner semantics (slots,
+retry, sessions, learned context, auto-pause), runtime ticks."""
+
+import threading
+import time
+from datetime import datetime
+
+import pytest
+
+from room_tpu.core import task_runner, rooms, workers, messages, memory
+from room_tpu.core.cron import CronError, cron_matches, validate_cron
+from room_tpu.providers import get_model_provider, reset_provider_cache
+from room_tpu.server.runtime import ServerRuntime
+
+
+# ---- cron ----
+
+def test_cron_basics():
+    t = datetime(2026, 7, 28, 14, 30)  # Tuesday
+    assert cron_matches("30 14 * * *", t)
+    assert cron_matches("*/15 * * * *", t)
+    assert not cron_matches("0 14 * * *", t)
+    assert cron_matches("30 14 28 7 *", t)
+    assert cron_matches("30 14 * * 2", t)      # Tuesday = 2
+    assert not cron_matches("30 14 * * 0", t)  # Sunday
+    assert cron_matches("30 8-16 * * 1-5", t)
+    assert cron_matches("0,30 14 * * *", t)
+
+
+def test_cron_validation():
+    assert validate_cron("* * * * *") is None
+    assert validate_cron("bad") is not None
+    assert validate_cron("61 * * * *") is not None
+    assert validate_cron("*/0 * * * *") is not None
+    with pytest.raises(CronError):
+        cron_matches("1 2 3", datetime.now())
+
+
+def test_cron_sunday_seven():
+    sunday = datetime(2026, 7, 26, 9, 0)
+    assert cron_matches("0 9 * * 0", sunday)
+    assert cron_matches("0 9 * * 7", sunday)
+
+
+# ---- task runner ----
+
+@pytest.fixture()
+def echo(db):
+    reset_provider_cache()
+    p = get_model_provider("echo")
+    p.responses.clear()
+    p.calls.clear()
+    p.fail_with = None
+    return p
+
+
+@pytest.fixture()
+def room(db):
+    return rooms.create_room(db, "ops", worker_model="echo",
+                             create_wallet=False)
+
+
+def test_create_task_validates_cron(db):
+    with pytest.raises(ValueError):
+        task_runner.create_task(db, "bad", "p", cron_expression="nope")
+    tid = task_runner.create_task(db, "ok", "p",
+                                  cron_expression="*/5 * * * *")
+    assert task_runner.get_task(db, tid)["webhook_token"]
+
+
+def test_execute_task_end_to_end(db, room, echo):
+    echo.responses.append("task output here")
+    tid = task_runner.create_task(
+        db, "report", "write the report", trigger_type="once",
+        room_id=room["id"],
+    )
+    run = task_runner.execute_task(db, tid)
+    assert run["status"] == "success"
+    assert run["result"] == "task output here"
+    task = task_runner.get_task(db, tid)
+    assert task["run_count"] == 1 and task["error_count"] == 0
+    # result stored in room memory
+    assert memory.fts_search(db, "task output", room_id=room["id"])
+    # result file written
+    assert run["result_file"] and run["result_file"].endswith(".md")
+
+
+def test_task_model_resolution_chain(db, room, echo):
+    wid = workers.create_worker(db, "w", "p", room_id=room["id"],
+                                model="echo:special")
+    tid = task_runner.create_task(db, "t", "p", trigger_type="once",
+                                  room_id=room["id"], worker_id=wid)
+    task_runner.execute_task(db, tid)
+    # worker model wins over room model
+    assert get_model_provider("echo:special").calls
+
+
+def test_learned_context_injection_and_distillation(db, room, echo):
+    tid = task_runner.create_task(db, "recurring", "do the thing",
+                                  trigger_type="once", room_id=room["id"])
+    db.execute("UPDATE tasks SET learned_context='USE THE SIDE DOOR' "
+               "WHERE id=?", (tid,))
+    echo.responses.append("done")
+    task_runner.execute_task(db, tid)
+    assert "USE THE SIDE DOOR" in echo.calls[-1].prompt
+
+    # run #3 triggers distillation (background thread)
+    db.execute("UPDATE tasks SET run_count=2, status='active' WHERE id=?",
+               (tid,))
+    echo.responses.extend(["run3 output", "DISTILLED MEMO"])
+    task_runner.execute_task(db, tid)
+    for _ in range(100):
+        t = task_runner.get_task(db, tid)
+        if t["learned_context"] == "DISTILLED MEMO":
+            break
+        time.sleep(0.05)
+    assert task_runner.get_task(db, tid)["learned_context"] == \
+        "DISTILLED MEMO"
+
+
+def test_task_failure_counts_and_auto_pause(db, room, echo):
+    tid = task_runner.create_task(db, "flaky", "p", trigger_type="once",
+                                  room_id=room["id"])
+    echo.fail_with = "boom"
+    for i in range(task_runner.AUTO_PAUSE_ERROR_COUNT):
+        db.execute("UPDATE tasks SET status='active' WHERE id=?", (tid,))
+        task_runner.execute_task(db, tid)
+    task = task_runner.get_task(db, tid)
+    assert task["status"] == "paused"
+    assert task["error_count"] == task_runner.AUTO_PAUSE_ERROR_COUNT
+
+
+def test_max_runs_archives(db, room, echo):
+    tid = task_runner.create_task(db, "limited", "p", trigger_type="once",
+                                  room_id=room["id"], max_runs=1)
+    task_runner.execute_task(db, tid)
+    assert task_runner.get_task(db, tid)["status"] == "archived"
+
+
+def test_concurrency_slots(db, room):
+    rooms.update_room(db, room["id"], max_concurrent_tasks=1)
+    assert task_runner.slots.acquire(room["id"], 1)
+    assert not task_runner.slots.acquire(room["id"], 1)
+    task_runner.slots.release(room["id"])
+    assert task_runner.slots.acquire(room["id"], 1)
+    task_runner.slots.release(room["id"])
+
+
+def test_duplicate_running_guard(db, room, echo):
+    tid = task_runner.create_task(db, "t", "p", trigger_type="once",
+                                  room_id=room["id"])
+    db.insert("INSERT INTO task_runs(task_id, status) VALUES (?, "
+              "'running')", (tid,))
+    assert task_runner.execute_task(db, tid) is None
+
+
+def test_builtin_keeper_reminder(db, room):
+    tid = task_runner.create_task(db, "remind", "drink water",
+                                  trigger_type="once", room_id=room["id"])
+    db.execute("UPDATE tasks SET executor='keeper_reminder' WHERE id=?",
+               (tid,))
+    run = task_runner.execute_task(db, tid)
+    assert run["status"] == "success"
+    hist = messages.chat_history(db, room["id"])
+    assert "drink water" in hist[-1]["content"]
+
+
+# ---- runtime ----
+
+def test_runtime_cron_fires_due_tasks(db, room, echo):
+    rt = ServerRuntime(db=db)
+    echo.responses.append("cron ran")
+    tid = task_runner.create_task(db, "every-minute", "p",
+                                  cron_expression="* * * * *",
+                                  room_id=room["id"])
+    rt.scheduler_tick()
+    for _ in range(100):
+        run = db.query_one("SELECT * FROM task_runs WHERE task_id=?",
+                           (tid,))
+        if run and run["status"] != "running":
+            break
+        time.sleep(0.05)
+    assert run and run["status"] == "success"
+    # same minute: no duplicate fire
+    rt.scheduler_tick()
+    time.sleep(0.2)
+    assert len(db.query("SELECT * FROM task_runs WHERE task_id=?",
+                        (tid,))) == 1
+
+
+def test_runtime_due_once_task(db, room, echo):
+    rt = ServerRuntime(db=db)
+    echo.responses.append("once ran")
+    tid = task_runner.create_task(
+        db, "soon", "p", trigger_type="once",
+        scheduled_at="2020-01-01T00:00:00.000Z", room_id=room["id"],
+    )
+    rt.scheduler_tick()
+    for _ in range(100):
+        run = db.query_one("SELECT * FROM task_runs WHERE task_id=?",
+                           (tid,))
+        if run and run["status"] != "running":
+            break
+        time.sleep(0.05)
+    assert run["status"] == "success"
+    assert task_runner.get_task(db, tid)["status"] == "archived"
+
+
+def test_runtime_stale_cleanup(db, room):
+    rid = db.insert(
+        "INSERT INTO task_runs(task_id, status, started_at) "
+        "SELECT id, 'running', '2020-01-01T00:00:00.000Z' FROM tasks "
+        "LIMIT 1"
+    )
+    tid = task_runner.create_task(db, "t", "p", trigger_type="once")
+    db.insert(
+        "INSERT INTO task_runs(task_id, status, started_at) VALUES "
+        "(?, 'running', '2020-01-01T00:00:00.000Z')",
+        (tid,),
+    )
+    rt = ServerRuntime(db=db)
+    n = rt.cleanup_stale()
+    assert n >= 1
+    stale = db.query("SELECT * FROM task_runs WHERE status='error'")
+    assert stale and "stale" in stale[0]["error_message"]
