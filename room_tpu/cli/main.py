@@ -77,6 +77,9 @@ def main(argv: list[str] | None = None) -> int:
     status = sub.add_parser("status", help="query a running server")
     status.set_defaults(fn=cmd_status)
 
+    bench = sub.add_parser("bench", help="run the decode benchmark")
+    bench.set_defaults(fn=cmd_bench)
+
     args = parser.parse_args(argv)
     if not getattr(args, "fn", None):
         parser.print_help()

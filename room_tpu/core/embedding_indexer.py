@@ -1,0 +1,94 @@
+"""Background embedding indexer (reference:
+src/shared/embedding-indexer.ts): batches of dirty entities (name + last
+observations, hash-deduped) get embedded and stored; the device index is
+refreshed so semantic recall sees new memories within one pass."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..db import Database
+from . import memory as memory_mod
+
+BATCH_SIZE = 10
+PASS_INTERVAL_S = 5.0
+
+
+class EmbeddingIndexer:
+    def __init__(
+        self, db: Database, interval_s: float = PASS_INTERVAL_S
+    ) -> None:
+        self.db = db
+        self.interval_s = interval_s
+        self.stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._index = None
+
+    def index_pass(self) -> int:
+        """Embed one batch of stale entities; returns how many."""
+        from ..serving.embed_service import embed_texts
+
+        entities = memory_mod.entities_needing_embedding(
+            self.db, limit=BATCH_SIZE
+        )
+        if not entities:
+            return 0
+        texts, keep = [], []
+        for ent in entities:
+            text = memory_mod.embedding_text_for_entity(self.db, ent)
+            h = memory_mod.text_hash(text)
+            existing = self.db.query_one(
+                "SELECT text_hash FROM embeddings WHERE source_type="
+                "'entity' AND source_id=?",
+                (ent["id"],),
+            )
+            if existing and existing["text_hash"] == h:
+                # unchanged content: just clear the dirty flag
+                from ..db import utc_now
+
+                self.db.execute(
+                    "UPDATE entities SET embedded_at=? WHERE id=?",
+                    (utc_now(), ent["id"]),
+                )
+                continue
+            texts.append(text)
+            keep.append(ent)
+        if not texts:
+            return 0
+        vectors = embed_texts(texts)
+        for ent, text, vec in zip(keep, texts, vectors):
+            memory_mod.store_embedding(self.db, ent["id"], text, vec)
+        self.refresh_device_index()
+        return len(keep)
+
+    def refresh_device_index(self) -> None:
+        from ..serving.embed_service import DeviceEmbedIndex
+
+        mat, ids = memory_mod.embedding_matrix(self.db)
+        if self._index is None:
+            dim = mat.shape[1] if len(ids) else 384
+            self._index = DeviceEmbedIndex(dim)
+        self._index.rebuild(mat, ids)
+
+    @property
+    def device_index(self):
+        return self._index
+
+    def start(self) -> None:
+        def loop():
+            while not self.stop_event.wait(timeout=self.interval_s):
+                try:
+                    self.index_pass()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="embedding-indexer"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        if self._thread:
+            self._thread.join(timeout=5)

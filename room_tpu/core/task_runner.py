@@ -368,8 +368,9 @@ def _finish_run(
             pause_task(db, task["id"])
             event_bus.emit("task:auto_paused", "tasks",
                            {"task_id": task["id"], "error": error})
-        if task_after["max_runs"] and \
-                task_after["run_count"] >= task_after["max_runs"]:
+        if task_after["trigger_type"] == "once" or (
+                task_after["max_runs"] and
+                task_after["run_count"] >= task_after["max_runs"]):
             db.execute(
                 "UPDATE tasks SET status='archived', updated_at=? "
                 "WHERE id=?",

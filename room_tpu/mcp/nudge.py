@@ -1,0 +1,41 @@
+"""Cross-process agent wake-up (reference: src/mcp/nudge.ts): the MCP
+process shares the SQLite file with the API server but runs its own
+process, so waking an agent goes over local HTTP using the api.port /
+api.token files the server wrote."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from typing import Optional
+
+from ..server.auth import data_dir
+
+
+def _read(name: str) -> Optional[str]:
+    try:
+        with open(os.path.join(data_dir(), name)) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def nudge_worker(worker_id: int, cold_start: bool = False) -> bool:
+    """Fire-and-forget POST /api/workers/:id/start."""
+    port, token = _read("api.port"), _read("api.token")
+    if not port or not token:
+        return False
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/workers/{worker_id}/start",
+            data=json.dumps({"coldStart": cold_start}).encode(),
+            headers={
+                "Authorization": f"Bearer {token}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=5):
+            return True
+    except OSError:
+        return False

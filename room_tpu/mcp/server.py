@@ -1,0 +1,129 @@
+"""MCP stdio server (reference: src/mcp/server.ts): JSON-RPC 2.0 over
+stdin/stdout implementing the Model Context Protocol tool surface —
+initialize, tools/list, tools/call — against the shared SQLite file
+(ROOM_TPU_DB_PATH), in its own process alongside the API server."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Optional, TextIO
+
+from .. import __version__
+from ..db import Database
+from .tools import TOOLS
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+def _tool_index():
+    return {name: (schema, fn) for name, _, schema, fn in TOOLS}
+
+
+def tools_list_payload() -> list[dict]:
+    return [
+        {"name": name, "description": desc, "inputSchema": schema}
+        for name, desc, schema, _ in TOOLS
+    ]
+
+
+class McpServer:
+    def __init__(self, db: Optional[Database] = None) -> None:
+        if db is None:
+            path = os.environ.get("ROOM_TPU_DB_PATH")
+            if not path:
+                from ..db.database import default_db_path
+
+                path = default_db_path()
+            db = Database(path)
+        self.db = db
+        self._tools = _tool_index()
+
+    def handle(self, message: dict) -> Optional[dict]:
+        """Process one JSON-RPC message; returns the response (None for
+        notifications)."""
+        msg_id = message.get("id")
+        method = message.get("method", "")
+        params = message.get("params") or {}
+
+        if method == "initialize":
+            return self._result(msg_id, {
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": {"tools": {}},
+                "serverInfo": {"name": "room-tpu",
+                               "version": __version__},
+            })
+        if method == "notifications/initialized":
+            return None
+        if method == "ping":
+            return self._result(msg_id, {})
+        if method == "tools/list":
+            return self._result(msg_id, {"tools": tools_list_payload()})
+        if method == "tools/call":
+            name = params.get("name", "")
+            args = params.get("arguments") or {}
+            entry = self._tools.get(name)
+            if entry is None:
+                return self._error(msg_id, -32602,
+                                   f"unknown tool {name!r}")
+            schema, fn = entry
+            missing = [
+                k for k in schema.get("required", []) if k not in args
+            ]
+            if missing:
+                return self._result(msg_id, {
+                    "content": [{"type": "text",
+                                 "text": f"missing required arguments: "
+                                         f"{missing}"}],
+                    "isError": True,
+                })
+            try:
+                text = fn(self.db, args)
+                return self._result(msg_id, {
+                    "content": [{"type": "text", "text": text}],
+                })
+            except Exception as e:
+                return self._result(msg_id, {
+                    "content": [{"type": "text",
+                                 "text": f"{type(e).__name__}: {e}"}],
+                    "isError": True,
+                })
+        if msg_id is None:
+            return None  # unknown notification
+        return self._error(msg_id, -32601, f"unknown method {method!r}")
+
+    @staticmethod
+    def _result(msg_id: Any, result: dict) -> dict:
+        return {"jsonrpc": "2.0", "id": msg_id, "result": result}
+
+    @staticmethod
+    def _error(msg_id: Any, code: int, message: str) -> dict:
+        return {
+            "jsonrpc": "2.0", "id": msg_id,
+            "error": {"code": code, "message": message},
+        }
+
+    def serve(self, stdin: TextIO, stdout: TextIO) -> int:
+        """Line-delimited JSON-RPC loop until EOF."""
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                message = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            response = self.handle(message)
+            if response is not None:
+                stdout.write(json.dumps(response) + "\n")
+                stdout.flush()
+        return 0
+
+
+def run_stdio_server() -> int:
+    server = McpServer()
+    try:
+        return server.serve(sys.stdin, sys.stdout)
+    finally:
+        server.db.close()

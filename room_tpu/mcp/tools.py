@@ -1,0 +1,483 @@
+"""MCP tool catalog (reference: src/mcp/tools/ — 19 modules, ~40
+``quoroom_*`` tools; here ``room_*`` tools over the same engine).
+
+Every tool is a (name, description, json-schema, handler) tuple; handlers
+take (db, args) and return text. The registry is data, so the stdio
+server and tests iterate it uniformly."""
+
+from __future__ import annotations
+
+import json
+import platform
+import shutil
+from typing import Any, Callable
+
+from ..core import (
+    escalations as escalations_mod,
+    goals as goals_mod,
+    memory as memory_mod,
+    messages as messages_mod,
+    quorum as quorum_mod,
+    rooms as rooms_mod,
+    selfmod as selfmod_mod,
+    skills as skills_mod,
+    task_runner,
+    wallet as wallet_mod,
+    workers as workers_mod,
+)
+from ..core.cron import validate_cron
+from ..db import Database
+from .nudge import nudge_worker
+
+Tool = tuple[str, str, dict, Callable[[Database, dict], str]]
+TOOLS: list[Tool] = []
+
+
+def tool(name: str, description: str, schema: dict | None = None):
+    def wrap(fn):
+        TOOLS.append((
+            name, description,
+            {"type": "object", "properties": schema or {},
+             "required": [k for k, v in (schema or {}).items()
+                          if v.pop("required", False)]},
+            fn,
+        ))
+        return fn
+
+    return wrap
+
+
+def _j(data: Any) -> str:
+    return json.dumps(data, indent=1, default=str)
+
+
+# ---- rooms ----
+
+@tool("room_list", "List all rooms with status and goals.")
+def room_list(db, args):
+    return _j([
+        {"id": r["id"], "name": r["name"], "status": r["status"],
+         "goal": r["goal"]}
+        for r in rooms_mod.list_rooms(db)
+    ])
+
+
+@tool(
+    "room_create", "Create a room with its queen (and wallet).",
+    {"name": {"type": "string", "required": True},
+     "goal": {"type": "string"}},
+)
+def room_create(db, args):
+    room = rooms_mod.create_room(db, args["name"], goal=args.get("goal"))
+    return f"room #{room['id']} created with queen #{room['queen_worker_id']}"
+
+
+@tool(
+    "room_status", "Aggregate status for one room.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def room_status(db, args):
+    st = rooms_mod.get_room_status(db, int(args["room_id"]))
+    return _j(st) if st else "room not found"
+
+
+@tool(
+    "room_start", "Start a room's agent loops (via the server).",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def room_start(db, args):
+    room = rooms_mod.get_room(db, int(args["room_id"]))
+    if room is None:
+        return "room not found"
+    if nudge_worker(room["queen_worker_id"], cold_start=True):
+        return f"room #{room['id']} queen nudged"
+    return "server not reachable — is `room-tpu serve` running?"
+
+
+# ---- workers ----
+
+@tool(
+    "worker_list", "List a room's workers.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def worker_list(db, args):
+    return _j(workers_mod.list_room_workers(db, int(args["room_id"])))
+
+
+@tool(
+    "worker_create", "Add a worker to a room.",
+    {"room_id": {"type": "integer", "required": True},
+     "name": {"type": "string", "required": True},
+     "role": {"type": "string"},
+     "system_prompt": {"type": "string"}},
+)
+def worker_create(db, args):
+    wid = workers_mod.create_worker(
+        db, args["name"], args.get("system_prompt", ""),
+        room_id=int(args["room_id"]), role=args.get("role"),
+    )
+    return f"worker #{wid} created"
+
+
+@tool(
+    "worker_nudge", "Wake a worker's agent loop now.",
+    {"worker_id": {"type": "integer", "required": True}},
+)
+def worker_nudge(db, args):
+    okay = nudge_worker(int(args["worker_id"]))
+    return "nudged" if okay else "server not reachable"
+
+
+# ---- goals ----
+
+@tool(
+    "goal_tree", "The room's goal tree.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def goal_tree(db, args):
+    return _j(goals_mod.get_goal_tree(db, int(args["room_id"])))
+
+
+@tool(
+    "goal_create", "Create a goal (optionally under a parent/worker).",
+    {"room_id": {"type": "integer", "required": True},
+     "description": {"type": "string", "required": True},
+     "parent_goal_id": {"type": "integer"},
+     "worker_id": {"type": "integer"}},
+)
+def goal_create(db, args):
+    gid = goals_mod.create_goal(
+        db, int(args["room_id"]), args["description"],
+        parent_goal_id=args.get("parent_goal_id"),
+        assigned_worker_id=args.get("worker_id"),
+    )
+    return f"goal #{gid} created"
+
+
+@tool(
+    "goal_complete", "Mark a goal complete.",
+    {"goal_id": {"type": "integer", "required": True}},
+)
+def goal_complete(db, args):
+    goals_mod.complete_goal(db, int(args["goal_id"]))
+    return f"goal #{args['goal_id']} completed"
+
+
+# ---- memory ----
+
+@tool(
+    "memory_remember", "Store a durable fact in semantic memory.",
+    {"name": {"type": "string", "required": True},
+     "content": {"type": "string", "required": True},
+     "category": {"type": "string"},
+     "room_id": {"type": "integer"}},
+)
+def memory_remember(db, args):
+    eid = memory_mod.remember(
+        db, args["name"], args["content"],
+        category=args.get("category"), room_id=args.get("room_id"),
+    )
+    return f"remembered as entity #{eid}"
+
+
+@tool(
+    "memory_recall", "Hybrid search over memory.",
+    {"query": {"type": "string", "required": True},
+     "room_id": {"type": "integer"}},
+)
+def memory_recall(db, args):
+    hits = memory_mod.hybrid_search(
+        db, args["query"], room_id=args.get("room_id")
+    )
+    if not hits:
+        return "no memories found"
+    return "\n".join(
+        f"- #{h['entity_id']} {h['name']}: "
+        f"{'; '.join(h['observations'][-2:])}"
+        for h in hits
+    )
+
+
+@tool(
+    "memory_forget", "Delete a memory entity.",
+    {"entity_id": {"type": "integer", "required": True}},
+)
+def memory_forget(db, args):
+    okay = memory_mod.delete_entity(db, int(args["entity_id"]))
+    return "forgotten" if okay else "entity not found"
+
+
+# ---- quorum ----
+
+@tool(
+    "quorum_decisions", "Open decisions for a room.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def quorum_decisions(db, args):
+    return _j(quorum_mod.pending_decisions(db, int(args["room_id"])))
+
+
+@tool(
+    "quorum_vote", "Cast a worker's vote on a ballot.",
+    {"decision_id": {"type": "integer", "required": True},
+     "worker_id": {"type": "integer", "required": True},
+     "vote": {"type": "string", "required": True},
+     "reasoning": {"type": "string"}},
+)
+def quorum_vote(db, args):
+    try:
+        d = quorum_mod.vote(
+            db, int(args["decision_id"]), int(args["worker_id"]),
+            args["vote"], args.get("reasoning"),
+        )
+    except quorum_mod.QuorumError as e:
+        return str(e)
+    return f"vote recorded; decision is {d['status']}"
+
+
+@tool(
+    "quorum_keeper_vote", "Cast the keeper's vote.",
+    {"decision_id": {"type": "integer", "required": True},
+     "vote": {"type": "string", "required": True}},
+)
+def quorum_keeper_vote(db, args):
+    try:
+        d = quorum_mod.keeper_vote(db, int(args["decision_id"]),
+                                   args["vote"])
+    except quorum_mod.QuorumError as e:
+        return str(e)
+    return f"keeper vote recorded; decision is {d['status']}"
+
+
+# ---- scheduler ----
+
+@tool(
+    "schedule_task",
+    "Schedule a task. Use cron_expression for recurring (5-field cron) "
+    "or scheduled_at (UTC ISO) for one-time.",
+    {"name": {"type": "string", "required": True},
+     "prompt": {"type": "string", "required": True},
+     "cron_expression": {"type": "string"},
+     "scheduled_at": {"type": "string"},
+     "room_id": {"type": "integer"}},
+)
+def schedule_task(db, args):
+    trigger = "cron" if args.get("cron_expression") else "once"
+    try:
+        tid = task_runner.create_task(
+            db, args["name"], args["prompt"], trigger_type=trigger,
+            cron_expression=args.get("cron_expression"),
+            scheduled_at=args.get("scheduled_at"),
+            room_id=args.get("room_id"),
+        )
+    except ValueError as e:
+        return str(e)
+    task = task_runner.get_task(db, tid)
+    return (
+        f"task #{tid} scheduled ({trigger}); webhook: "
+        f"/api/hooks/task/{task['webhook_token']}"
+    )
+
+
+@tool("task_list", "List scheduled tasks.",
+      {"room_id": {"type": "integer"}})
+def task_list(db, args):
+    return _j([
+        {"id": t["id"], "name": t["name"], "status": t["status"],
+         "trigger": t["trigger_type"], "cron": t["cron_expression"],
+         "runs": t["run_count"]}
+        for t in task_runner.list_tasks(db, args.get("room_id"))
+    ])
+
+
+@tool(
+    "task_history", "Recent runs of a task.",
+    {"task_id": {"type": "integer", "required": True}},
+)
+def task_history(db, args):
+    return _j(db.query(
+        "SELECT id, status, started_at, duration_ms, "
+        "substr(COALESCE(result, error_message, ''), 1, 200) AS summary "
+        "FROM task_runs WHERE task_id=? ORDER BY id DESC LIMIT 10",
+        (int(args["task_id"]),),
+    ))
+
+
+@tool(
+    "task_pause", "Pause a task.",
+    {"task_id": {"type": "integer", "required": True}},
+)
+def task_pause(db, args):
+    task_runner.pause_task(db, int(args["task_id"]))
+    return f"task #{args['task_id']} paused"
+
+
+@tool(
+    "task_resume", "Resume a paused task.",
+    {"task_id": {"type": "integer", "required": True}},
+)
+def task_resume(db, args):
+    task_runner.resume_task(db, int(args["task_id"]))
+    return f"task #{args['task_id']} resumed"
+
+
+@tool(
+    "cron_validate", "Validate a 5-field cron expression.",
+    {"expression": {"type": "string", "required": True}},
+)
+def cron_validate(db, args):
+    e = validate_cron(args["expression"])
+    return e or "valid"
+
+
+# ---- skills + self-mod ----
+
+@tool("skill_list", "List skills.", {"room_id": {"type": "integer"}})
+def skill_list(db, args):
+    return _j([
+        {"id": s["id"], "name": s["name"], "version": s["version"],
+         "auto": bool(s["auto_activate"])}
+        for s in skills_mod.list_skills(db, args.get("room_id"))
+    ])
+
+
+@tool(
+    "skill_create", "Save a reusable skill.",
+    {"name": {"type": "string", "required": True},
+     "content": {"type": "string", "required": True},
+     "room_id": {"type": "integer"},
+     "activation_context": {"type": "string"}},
+)
+def skill_create(db, args):
+    sid = skills_mod.create_skill(
+        db, args["name"], args["content"], room_id=args.get("room_id"),
+        activation_context=args.get("activation_context"),
+    )
+    return f"skill #{sid} saved"
+
+
+@tool("selfmod_audit", "Self-modification audit log.",
+      {"room_id": {"type": "integer"}})
+def selfmod_audit(db, args):
+    return _j(selfmod_mod.audit_log(db, args.get("room_id"))[:20])
+
+
+@tool(
+    "selfmod_revert", "Revert a self-modification by audit id.",
+    {"audit_id": {"type": "integer", "required": True}},
+)
+def selfmod_revert(db, args):
+    try:
+        okay = selfmod_mod.revert_modification(db, int(args["audit_id"]))
+    except selfmod_mod.SelfModError as e:
+        return str(e)
+    return "reverted" if okay else "nothing to revert"
+
+
+# ---- inbox / messaging / escalations ----
+
+@tool(
+    "inbox_unread", "Unread inter-room messages for a room.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def inbox_unread(db, args):
+    return _j(messages_mod.unread_messages(db, int(args["room_id"])))
+
+
+@tool(
+    "message_send", "Send a message from one room to another.",
+    {"from_room_id": {"type": "integer", "required": True},
+     "to_room_id": {"type": "integer", "required": True},
+     "subject": {"type": "string"},
+     "body": {"type": "string", "required": True}},
+)
+def message_send(db, args):
+    messages_mod.send_room_message(
+        db, int(args["from_room_id"]), int(args["to_room_id"]),
+        args.get("subject", ""), args["body"],
+    )
+    return "sent"
+
+
+@tool("escalation_list", "Pending keeper escalations.",
+      {"room_id": {"type": "integer"}})
+def escalation_list(db, args):
+    return _j(escalations_mod.pending_escalations(
+        db, args.get("room_id")
+    ))
+
+
+@tool(
+    "escalation_answer", "Answer an escalation as the keeper.",
+    {"escalation_id": {"type": "integer", "required": True},
+     "answer": {"type": "string", "required": True}},
+)
+def escalation_answer(db, args):
+    escalations_mod.answer_escalation(
+        db, int(args["escalation_id"]), args["answer"]
+    )
+    return "answered"
+
+
+# ---- wallet / wip / settings / resources ----
+
+@tool(
+    "wallet_info", "Room wallet address + recent transactions.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def wallet_info(db, args):
+    w = wallet_mod.get_room_wallet(db, int(args["room_id"]))
+    if w is None:
+        return "no wallet"
+    return _j({
+        "address": w["address"], "chain": w["chain"],
+        "transactions": wallet_mod.list_transactions(db, w["id"])[:5],
+    })
+
+
+@tool(
+    "wip_save", "Save a worker's work-in-progress note.",
+    {"worker_id": {"type": "integer", "required": True},
+     "note": {"type": "string", "required": True}},
+)
+def wip_save(db, args):
+    workers_mod.save_wip(db, int(args["worker_id"]), args["note"])
+    return "WIP saved"
+
+
+@tool("setting_get", "Read a settings key.",
+      {"key": {"type": "string", "required": True}})
+def setting_get(db, args):
+    v = messages_mod.get_setting(db, args["key"])
+    return v if v is not None else "(unset)"
+
+
+@tool(
+    "setting_set", "Write a settings key.",
+    {"key": {"type": "string", "required": True},
+     "value": {"type": "string", "required": True}},
+)
+def setting_set(db, args):
+    messages_mod.set_setting(db, args["key"], args["value"])
+    return f"{args['key']} updated"
+
+
+@tool("system_resources", "Host CPU/memory/disk + TPU availability.")
+def system_resources(db, args):
+    disk = shutil.disk_usage("/")
+    out = {
+        "platform": platform.platform(),
+        "cpus": __import__("os").cpu_count(),
+        "disk_free_gb": round(disk.free / 1e9, 1),
+    }
+    try:
+        with open("/proc/meminfo") as f:
+            mem = dict(
+                line.split(":")[:2] for line in f.read().splitlines()
+                if ":" in line
+            )
+        out["mem_total"] = mem.get("MemTotal", "?").strip()
+        out["mem_available"] = mem.get("MemAvailable", "?").strip()
+    except OSError:
+        pass
+    return _j(out)

@@ -1,0 +1,197 @@
+"""Pallas TPU kernel: paged-attention decode.
+
+The hot op of the serving engine (PAPERS.md: Ragged Paged Attention for
+TPU): one query token per sequence attends over that sequence's KV pages
+without materializing a gathered dense cache. Per sequence the kernel
+walks its block table, DMAs pages HBM→VMEM double-buffered, and runs an
+online-softmax accumulation (flash-attention style) with GQA.
+
+Shapes
+    q         [B, Hq, D]
+    k_pages   [P, page, Hkv, D]   (one layer's pool)
+    v_pages   [P, page, Hkv, D]
+    tables    [B, max_pages]      int32 page ids (scalar-prefetched)
+    lengths   [B]                 int32 valid tokens (scalar-prefetched)
+    out       [B, Hq, D]
+
+The XLA reference path (kv_pages.make_paged_kv_hook) stays the default
+on CPU; the engine switches to this kernel on TPU via
+ROOM_TPU_PAGED_KERNEL=pallas. Numerics are pinned against
+ops.attention_ref in tests (interpret mode)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,      # [B, max_pages] SMEM
+    lengths_ref,     # [B] SMEM
+    # inputs
+    q_ref,           # [1, Hq, D] VMEM (this sequence's query)
+    k_pages_hbm,     # [P, page, Hkv, D] ANY/HBM
+    v_pages_hbm,     # [P, page, Hkv, D] ANY/HBM
+    # output
+    o_ref,           # [1, Hq, D] VMEM
+    # scratch
+    k_buf,           # [2, page, Hkv, D] VMEM
+    v_buf,           # [2, page, Hkv, D] VMEM
+    acc_ref,         # [Hq, D] f32 VMEM
+    m_ref,           # [Hq, 1] f32 VMEM
+    l_ref,           # [Hq, 1] f32 VMEM
+    sems,            # DMA sems [2, 2]
+    *,
+    page_size: int,
+    max_pages: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    n_pages = jax.lax.div(length + page_size - 1, page_size)
+
+    hq = q_ref.shape[1]
+    hkv = k_buf.shape[2]
+    d = q_ref.shape[2]
+    group = hq // hkv
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def start_fetch(i, slot):
+        page_id = tables_ref[b, i]
+        pltpu.make_async_copy(
+            k_pages_hbm.at[page_id], k_buf.at[slot], sems.at[slot, 0]
+        ).start()
+        pltpu.make_async_copy(
+            v_pages_hbm.at[page_id], v_buf.at[slot], sems.at[slot, 1]
+        ).start()
+
+    def wait_fetch(i, slot):
+        page_id = tables_ref[b, i]
+        pltpu.make_async_copy(
+            k_pages_hbm.at[page_id], k_buf.at[slot], sems.at[slot, 0]
+        ).wait()
+        pltpu.make_async_copy(
+            v_pages_hbm.at[page_id], v_buf.at[slot], sems.at[slot, 1]
+        ).wait()
+
+    @pl.when(n_pages > 0)
+    def _():
+        start_fetch(0, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
+    qg = q.reshape(hkv, group, d)
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            start_fetch(i + 1, 1 - slot)
+
+        wait_fetch(i, slot)
+        k = k_buf[slot].astype(jnp.float32)           # [page, Hkv, D]
+        v = v_buf[slot].astype(jnp.float32)
+
+        # logits [Hkv, G, page]
+        logits = jax.lax.dot_general(
+            qg, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        # mask past the sequence length within this page
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2
+        )
+        logits = jnp.where(pos < length, logits, NEG_INF)
+        logits2 = logits.reshape(hq, page_size)
+
+        m_prev = m_ref[:]                              # [Hq, 1]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(logits2, axis=1, keepdims=True)
+        )
+        p = jnp.exp(logits2 - m_new)                   # [Hq, page]
+        alpha = jnp.exp(m_prev - m_new)                # [Hq, 1]
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # pv [Hkv, G, D]
+        pv = jax.lax.dot_general(
+            p.reshape(hkv, group, page_size), v,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(hq, d)
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    denom = jnp.maximum(l_ref[:], 1e-30)
+    o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "interpret")
+)
+def paged_attention_decode(
+    q: jax.Array,          # [B, Hq, D]
+    k_pages: jax.Array,    # [P, page, Hkv, D]
+    v_pages: jax.Array,    # [P, page, Hkv, D]
+    tables: jax.Array,     # [B, max_pages] int32
+    lengths: jax.Array,    # [B] int32
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    b, hq, d = q.shape
+    _, _, hkv, _ = k_pages.shape
+    max_pages = tables.shape[1]
+    scale = 1.0 / float(np.sqrt(d))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, hq, d), lambda i, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hq, d), lambda i, *_: (i, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, hkv, d), k_pages.dtype),
+            pltpu.VMEM((2, page_size, hkv, d), v_pages.dtype),
+            pltpu.VMEM((hq, d), jnp.float32),
+            pltpu.VMEM((hq, 1), jnp.float32),
+            pltpu.VMEM((hq, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        max_pages=max_pages,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, q, k_pages, v_pages)

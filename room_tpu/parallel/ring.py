@@ -1,0 +1,122 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+For sequences too long for one chip's HBM share, q/k/v shard along the
+sequence axis; each device computes blockwise attention against its local
+KV chunk, then the KV chunks rotate around the ring via ppermute
+(ICI-neighbor traffic only) while an online-softmax accumulator folds
+each visiting chunk in. After n_devices steps every query has attended
+to the full sequence without any device ever holding it.
+
+(SURVEY.md §5: the reference has no long-context path at all — it
+shrinks context instead; this is a first-class capability of the
+rebuild.)"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_pos, kv_pos, causal, scale):
+    """One (q-chunk × kv-chunk) block: returns (pv [*, Hq, D] f32,
+    row max m, row sum l) for online-softmax combination.
+
+    q: [B, Cq, Hq, Dh]; k/v: [B, Ckv, Hkv, Dh] (GQA)."""
+    b, cq, hq, d = q.shape
+    _, ckv, hkv, _ = k.shape
+    group = hq // hkv
+
+    qg = q.reshape(b, cq, hkv, group, d).astype(jnp.float32) * scale
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k.astype(jnp.float32)
+    )                                                # [B,Hkv,G,Cq,Ckv]
+    if causal:
+        mask = kv_pos[None, :] <= q_pos[:, None]     # [Cq, Ckv]
+        logits = jnp.where(
+            mask[None, None, None], logits, NEG_INF
+        )
+    m = jnp.max(logits, axis=-1, keepdims=True)      # [B,Hkv,G,Cq,1]
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32))
+    return pv, m, l
+
+
+def ring_attention(
+    q: jax.Array,   # [B, S, Hq, Dh] — S sharded over axis `axis_name`
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Full-sequence attention with S sharded over `axis_name`."""
+    d = q.shape[-1]
+    scale = 1.0 / float(np.sqrt(d))
+    n = mesh.shape[axis_name]
+
+    def local_fn(q_loc, k_loc, v_loc):
+        idx = jax.lax.axis_index(axis_name)
+        b, c, hq, dh = q_loc.shape
+        q_pos = idx * c + jnp.arange(c)
+
+        acc = jnp.zeros(
+            (b, k_loc.shape[2], hq // k_loc.shape[2], c, dh),
+            jnp.float32,
+        )
+        m = jnp.full(
+            (b, k_loc.shape[2], hq // k_loc.shape[2], c, 1), NEG_INF,
+            jnp.float32,
+        )
+        l = jnp.zeros_like(m)
+        # constants start unvarying over the manual axis; the loop carry
+        # becomes varying, so align the initial types
+        acc, m, l = (
+            jax.lax.pcast(x, (axis_name,), to="varying")
+            for x in (acc, m, l)
+        )
+
+        def step(t, carry):
+            acc, m, l, k_cur, v_cur = carry
+            src = jax.lax.rem(idx - t + n, n)   # owner of the visiting chunk
+            kv_pos = src * c + jnp.arange(c)
+            pv, m_blk, l_blk = _block_attention(
+                q_loc, k_cur, v_cur, q_pos, kv_pos, causal, scale
+            )
+            m_new = jnp.maximum(m, m_blk)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(m_blk - m_new)
+            acc = acc * alpha + pv * beta
+            l = l * alpha + l_blk * beta
+            # rotate kv to the next device (skip after the last step)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            return acc, m_new, l, k_nxt, v_nxt
+
+        acc, m, l, _, _ = jax.lax.fori_loop(
+            0, n, step, (acc, m, l, k_loc, v_loc)
+        )
+        out = acc / jnp.maximum(l, 1e-30)
+        return out.transpose(0, 3, 1, 2, 4).reshape(b, c, hq, dh) \
+            .astype(q_loc.dtype)
+
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def sequence_sharded(mesh: Mesh, axis_name: str = "sp"):
+    """NamedSharding for [B, S, H, D] arrays with S over the ring."""
+    return NamedSharding(mesh, P(None, axis_name, None, None))

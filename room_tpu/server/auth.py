@@ -139,7 +139,9 @@ def validate_cloud_jwt(token: str) -> Optional[dict]:
     now = time.time()
     if claims.get("iss") != JWT_ISS or claims.get("aud") != JWT_AUD:
         return None
-    if claims.get("exp") is not None and now >= float(claims["exp"]):
+    # exp is mandatory: a token without one would never expire
+    exp = claims.get("exp")
+    if not isinstance(exp, (int, float)) or now >= float(exp):
         return None
     if claims.get("nbf") is not None and now < float(claims["nbf"]):
         return None

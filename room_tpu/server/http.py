@@ -135,6 +135,13 @@ class ApiServer:
                 if length <= 0:
                     return None
                 if length > 5_000_000:
+                    # drain so the keep-alive stream stays framed
+                    remaining = length
+                    while remaining > 0:
+                        chunk = self.rfile.read(min(remaining, 65536))
+                        if not chunk:
+                            break
+                        remaining -= len(chunk)
                     return None
                 raw = self.rfile.read(length)
                 try:

@@ -4,6 +4,7 @@ grouped here by domain, same /api contract shape {status,data,error})."""
 from __future__ import annotations
 
 import json
+import re
 from typing import Any
 
 from .. import __version__
@@ -89,9 +90,12 @@ def register_room_routes(r: Router) -> None:
             "queen_max_turns", "queen_quiet_from", "queen_quiet_until",
             "queen_nickname", "allowed_tools",
         }
-        fields = {
-            k: v for k, v in (ctx.body or {}).items() if k in allowed
-        }
+        camel = re.compile(r"(?<!^)(?=[A-Z])")
+        fields = {}
+        for k, v in (ctx.body or {}).items():
+            snake = camel.sub("_", k).lower()
+            if snake in allowed:
+                fields[snake] = v
         rooms_mod.update_room(ctx.db, room["id"], **fields)
         if "config" in (ctx.body or {}):
             ctx.db.execute(

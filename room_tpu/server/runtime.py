@@ -40,9 +40,15 @@ class ServerRuntime:
 
     # ---- lifecycle ----
 
+    indexer: Optional[object] = None
+
     def start(self) -> None:
         self.cleanup_stale(startup=True)
         self.scheduler_tick()
+        from ..core.embedding_indexer import EmbeddingIndexer
+
+        self.indexer = EmbeddingIndexer(self.db)
+        self.indexer.start()
         for target, interval in (
             (self.scheduler_tick, SCHEDULER_TICK_S),
             (self.maintenance_tick, MAINTENANCE_TICK_S),
@@ -57,6 +63,8 @@ class ServerRuntime:
 
     def stop(self) -> None:
         self.stop_event.set()
+        if self.indexer is not None:
+            self.indexer.stop()
         for t in self.threads:
             t.join(timeout=5)
 
@@ -100,12 +108,9 @@ class ServerRuntime:
             "scheduled_at <= ?",
             (utc_now(),),
         ):
+            # archiving happens in _finish_run, after the run completes;
+            # archiving here would race the worker's active-status check
             self.queue_task_execution(task["id"])
-            self.db.execute(
-                "UPDATE tasks SET status='archived', updated_at=? "
-                "WHERE id=?",
-                (utc_now(), task["id"]),
-            )
 
     def maintenance_tick(self) -> None:
         self.cleanup_stale()

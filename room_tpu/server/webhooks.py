@@ -15,9 +15,22 @@ _hits: dict[str, list[float]] = {}
 _lock = threading.Lock()
 
 
+MAX_TRACKED_TOKENS = 4096
+
+
 def _rate_ok(token: str) -> bool:
     now = time.monotonic()
     with _lock:
+        # bounded memory on a pre-auth endpoint: evict stale tokens
+        # before tracking yet another attacker-supplied key
+        if len(_hits) >= MAX_TRACKED_TOKENS:
+            for key in [
+                k for k, v in _hits.items()
+                if not v or now - v[-1] >= 60
+            ]:
+                del _hits[key]
+            if len(_hits) >= MAX_TRACKED_TOKENS:
+                return False  # fully saturated: fail closed
         hits = [t for t in _hits.get(token, []) if now - t < 60]
         if len(hits) >= WEBHOOK_RATE_PER_MIN:
             _hits[token] = hits

@@ -1,0 +1,140 @@
+"""On-mesh embedding service (reference: src/shared/embeddings.ts ran
+all-MiniLM-L6-v2 on CPU ONNX; here the 384-d encoder is a JAX model on
+the same platform as the LLM, with an on-device similarity index so
+recall is one dot + top_k).
+
+Hermetic default: tiny encoder + byte tokenizer, random weights (vector
+quality is irrelevant to the machinery; tests pin determinism and
+geometry). Production: ROOM_TPU_EMBED_CKPT + ROOM_TPU_TOKENIZER_PATH load
+the real MiniLM-class weights."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_host_lock = threading.Lock()
+_host: Optional["EmbedHost"] = None
+
+MAX_TOKENS = 128
+
+
+class EmbedHost:
+    def __init__(self) -> None:
+        import jax
+
+        from ..models import embedder
+        from ..models.config import minilm_384, tiny_encoder
+        from .tokenizer import load_tokenizer
+
+        use_real = bool(os.environ.get("ROOM_TPU_EMBED_CKPT"))
+        self.cfg = minilm_384() if use_real else tiny_encoder()
+        self.tokenizer = load_tokenizer()
+        params = embedder.init_params(self.cfg, jax.random.PRNGKey(7))
+        ckpt = os.environ.get("ROOM_TPU_EMBED_CKPT")
+        if ckpt and os.path.isdir(ckpt):
+            from ..utils.checkpoint import load_params
+
+            params = load_params(ckpt, like=params)
+        self.params = params
+        self._encode = jax.jit(
+            lambda p, t, m: embedder.encode(p, self.cfg, t, m)
+        )
+        self.dim = self.cfg.hidden
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if not texts:
+            return np.zeros((0, self.dim), np.float32)
+        batch = []
+        for text in texts:
+            ids = self.tokenizer.encode(text)[:MAX_TOKENS]
+            ids = [min(t, self.cfg.vocab_size - 1) for t in ids] or [0]
+            batch.append(ids)
+        max_len = max(len(x) for x in batch)
+        # bucket to limit recompiles
+        bucket = 16
+        while bucket < max_len:
+            bucket *= 2
+        toks = np.zeros((len(batch), bucket), np.int32)
+        mask = np.zeros((len(batch), bucket), np.float32)
+        for i, ids in enumerate(batch):
+            toks[i, : len(ids)] = ids
+            mask[i, : len(ids)] = 1.0
+        out = self._encode(
+            self.params, jnp.asarray(toks), jnp.asarray(mask)
+        )
+        return np.asarray(out, np.float32)
+
+
+def get_embed_host() -> EmbedHost:
+    global _host
+    with _host_lock:
+        if _host is None:
+            _host = EmbedHost()
+        return _host
+
+
+def reset_embed_host() -> None:
+    global _host
+    with _host_lock:
+        _host = None
+
+
+def embed_texts(texts: Sequence[str]) -> np.ndarray:
+    return get_embed_host().embed(texts)
+
+
+class DeviceEmbedIndex:
+    """Device-resident similarity index: the room's embedding matrix
+    lives on the accelerator; recall = one matmul + top_k (the role
+    sqlite-vec's vec_distance_cosine played in the reference)."""
+
+    def __init__(self, dim: int) -> None:
+        import jax.numpy as jnp
+
+        self.dim = dim
+        self._jnp = jnp
+        self._matrix = jnp.zeros((0, dim), jnp.float32)
+        self._ids: list[int] = []
+        self._lock = threading.Lock()
+
+    def rebuild(self, vectors: np.ndarray, ids: list[int]) -> None:
+        import jax.numpy as jnp
+
+        with self._lock:
+            if len(ids) == 0:
+                self._matrix = jnp.zeros((0, self.dim), jnp.float32)
+                self._ids = []
+                return
+            norms = np.linalg.norm(vectors, axis=1, keepdims=True)
+            self._matrix = jnp.asarray(
+                vectors / np.maximum(norms, 1e-9), jnp.float32
+            )
+            self._ids = list(ids)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ids)
+
+    def top_k(
+        self, query: np.ndarray, k: int = 5
+    ) -> list[tuple[int, float]]:
+        import jax
+
+        with self._lock:
+            if not self._ids:
+                return []
+            q = np.asarray(query, np.float32)
+            q = q / max(float(np.linalg.norm(q)), 1e-9)
+            sims = self._matrix @ self._jnp.asarray(q)
+            k_eff = min(k, len(self._ids))
+            vals, idx = jax.lax.top_k(sims, k_eff)
+            return [
+                (self._ids[int(i)], float(v))
+                for v, i in zip(np.asarray(vals), np.asarray(idx))
+            ]

@@ -18,7 +18,7 @@ import threading
 from typing import Any, Optional
 
 import jax
-import jax.numpy as jnp
+import jax.numpy as jnp  # noqa: F401  (used in jit-side helpers)
 
 from ..models.config import DecoderConfig
 from ..ops import attention_ref
@@ -34,17 +34,38 @@ def init_page_cache(
     return {"k_pages": jnp.zeros(shape, dt), "v_pages": jnp.zeros(shape, dt)}
 
 
+def use_pallas_kernel() -> bool:
+    """Decode attention backend selection: the Pallas kernel on TPU when
+    ROOM_TPU_PAGED_KERNEL=pallas, XLA gather reference otherwise."""
+    import os
+
+    mode = os.environ.get("ROOM_TPU_PAGED_KERNEL", "auto")
+    if mode == "pallas":
+        return True
+    if mode == "xla":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def make_paged_kv_hook(
     block_tables: jax.Array,   # [B, max_pages] page ids (0 = also a real page; unused slots may be any valid id, masked by length)
     lengths: jax.Array,        # [B] tokens already in cache per sequence
     page_size: int,
+    pallas_decode: Optional[bool] = None,
 ):
     """Build the kv_hook used by models.qwen3.forward: writes the chunk's
     k/v into the page pool and attends over (prefix + chunk).
 
     Works for single-token decode (S=1) and chunked prefill (S>1) alike.
+    Single-token decode can route through the Pallas paged-attention
+    kernel (no dense gather); prefill always uses the XLA path.
     """
     b, max_pages = block_tables.shape
+    if pallas_decode is None:
+        pallas_decode = use_pallas_kernel()
 
     def hook(q, k, v, layer_cache):
         s = q.shape[1]
@@ -62,6 +83,15 @@ def make_paged_kv_hook(
         vp = layer_cache["v_pages"].at[flat_pages, flat_off].set(
             v.reshape(-1, *v.shape[2:])
         )
+
+        if s == 1 and pallas_decode:
+            from ..ops.paged_attention import paged_attention_decode
+
+            attn = paged_attention_decode(
+                q[:, 0], kp, vp, block_tables, lengths + 1,
+                page_size=page_size,
+            )[:, None]
+            return attn, {"k_pages": kp, "v_pages": vp}
 
         # gather this batch's pages into a dense view (XLA reference path;
         # the Pallas kernel replaces this gather)

@@ -1,0 +1,133 @@
+"""MCP server tests: the JSON-RPC surface in-process (reference pattern:
+a fake McpServer harness capturing handlers, src/mcp/tools/__tests__/)
+plus one真 stdio round-trip via subprocess."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from room_tpu.core import rooms, task_runner
+from room_tpu.mcp.server import McpServer, tools_list_payload
+from room_tpu.mcp.tools import TOOLS
+
+
+@pytest.fixture()
+def mcp(db):
+    return McpServer(db=db)
+
+
+def call(mcp, name, args=None, msg_id=1):
+    resp = mcp.handle({
+        "jsonrpc": "2.0", "id": msg_id, "method": "tools/call",
+        "params": {"name": name, "arguments": args or {}},
+    })
+    content = resp["result"]["content"][0]["text"]
+    return content, resp["result"].get("isError", False)
+
+
+def test_initialize_and_list(mcp):
+    resp = mcp.handle({"jsonrpc": "2.0", "id": 1,
+                       "method": "initialize", "params": {}})
+    assert resp["result"]["serverInfo"]["name"] == "room-tpu"
+    resp = mcp.handle({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+    names = {t["name"] for t in resp["result"]["tools"]}
+    # the catalog covers the reference's tool families
+    for expected in ("room_create", "worker_create", "goal_create",
+                     "memory_remember", "memory_recall", "quorum_vote",
+                     "schedule_task", "skill_create", "selfmod_audit",
+                     "wallet_info", "wip_save", "setting_set",
+                     "system_resources", "escalation_answer"):
+        assert expected in names, expected
+    assert len(names) >= 30
+
+
+def test_every_tool_has_valid_schema():
+    for name, desc, schema, fn in TOOLS:
+        assert schema["type"] == "object"
+        assert desc
+        for req in schema.get("required", []):
+            assert req in schema["properties"], (name, req)
+
+
+def test_room_lifecycle_via_tools(mcp, db):
+    out, is_err = call(mcp, "room_create",
+                       {"name": "mcp-room", "goal": "test mcp"})
+    assert not is_err and "room #1" in out
+    out, _ = call(mcp, "room_list")
+    assert "mcp-room" in out
+    out, _ = call(mcp, "worker_create",
+                  {"room_id": 1, "name": "W", "role": "executor"})
+    assert "worker #" in out
+    out, _ = call(mcp, "goal_create",
+                  {"room_id": 1, "description": "subgoal"})
+    out, _ = call(mcp, "goal_tree", {"room_id": 1})
+    assert "subgoal" in out
+    out, _ = call(mcp, "memory_remember",
+                  {"name": "fact", "content": "the sky is blue",
+                   "room_id": 1})
+    out, _ = call(mcp, "memory_recall", {"query": "sky", "room_id": 1})
+    assert "fact" in out
+
+
+def test_scheduler_tools(mcp, db):
+    out, _ = call(mcp, "schedule_task",
+                  {"name": "daily", "prompt": "do it",
+                   "cron_expression": "0 9 * * *"})
+    assert "webhook" in out
+    out, _ = call(mcp, "cron_validate", {"expression": "0 9 * * *"})
+    assert out == "valid"
+    out, _ = call(mcp, "cron_validate", {"expression": "nope"})
+    assert "cron" in out
+    out, _ = call(mcp, "task_list", {})
+    assert "daily" in out
+
+
+def test_missing_required_args(mcp):
+    out, is_err = call(mcp, "room_create", {})
+    assert is_err and "name" in out
+
+
+def test_unknown_tool_and_method(mcp):
+    resp = mcp.handle({"jsonrpc": "2.0", "id": 1, "method": "tools/call",
+                       "params": {"name": "nope"}})
+    assert resp["error"]["code"] == -32602
+    resp = mcp.handle({"jsonrpc": "2.0", "id": 2, "method": "bogus"})
+    assert resp["error"]["code"] == -32601
+
+
+def test_tool_exception_becomes_is_error(mcp, db):
+    # room_status on a non-integer id raises ValueError inside the tool
+    out, is_err = call(mcp, "room_status", {"room_id": "not-a-number"})
+    assert is_err and "ValueError" in out
+
+
+def test_stdio_round_trip(tmp_path):
+    """Real process: spawn the MCP server over stdio against a temp DB
+    and drive initialize -> tools/list -> tools/call."""
+    env = dict(os.environ)
+    env["ROOM_TPU_DB_PATH"] = str(tmp_path / "mcp.db")
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "room_tpu.cli.main", "mcp"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env=env, cwd="/root/repo",
+    )
+    msgs = [
+        {"jsonrpc": "2.0", "id": 1, "method": "initialize", "params": {}},
+        {"jsonrpc": "2.0", "id": 2, "method": "tools/call",
+         "params": {"name": "room_create",
+                    "arguments": {"name": "stdio-room"}}},
+        {"jsonrpc": "2.0", "id": 3, "method": "tools/call",
+         "params": {"name": "room_list", "arguments": {}}},
+    ]
+    input_text = "".join(json.dumps(m) + "\n" for m in msgs)
+    out, _ = proc.communicate(input_text, timeout=60)
+    lines = [json.loads(l) for l in out.strip().splitlines()]
+    assert lines[0]["result"]["protocolVersion"]
+    assert "room #1 created" in lines[1]["result"]["content"][0]["text"]
+    assert "stdio-room" in lines[2]["result"]["content"][0]["text"]
+    assert proc.returncode == 0

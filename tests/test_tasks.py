@@ -119,7 +119,8 @@ def test_learned_context_injection_and_distillation(db, room, echo):
 
 
 def test_task_failure_counts_and_auto_pause(db, room, echo):
-    tid = task_runner.create_task(db, "flaky", "p", trigger_type="once",
+    tid = task_runner.create_task(db, "flaky", "p",
+                                  trigger_type="webhook",
                                   room_id=room["id"])
     echo.fail_with = "boom"
     for i in range(task_runner.AUTO_PAUSE_ERROR_COUNT):
