@@ -1,0 +1,64 @@
+// Native vector-search core — the in-tree equivalent of the reference's
+// sqlite-vec C extension (reference dependency: vec_distance_cosine used
+// by src/shared/db-queries.ts:995-1010). Serves the host-side recall
+// path when the device index is cold; the TPU path lives in
+// room_tpu/serving/embed_service.py.
+//
+// Build: make -C native   (g++ -O3 -march=native -shared -fPIC)
+// Bind:  ctypes from room_tpu/utils/native.py — no pybind11 needed.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Cosine top-k: matrix [n, d] row-major, query [d]. Writes k indices and
+// scores (descending). Returns the number of results (<= k).
+int topk_cosine(const float* matrix, int64_t n, int64_t d,
+                const float* query, int k,
+                int32_t* out_idx, float* out_score) {
+    if (n <= 0 || d <= 0 || k <= 0) return 0;
+
+    double qnorm = 0.0;
+    for (int64_t j = 0; j < d; ++j) qnorm += (double)query[j] * query[j];
+    qnorm = std::sqrt(qnorm) + 1e-9;
+
+    struct Hit { float score; int32_t idx; };
+    std::vector<Hit> hits;
+    hits.reserve(n);
+
+    for (int64_t i = 0; i < n; ++i) {
+        const float* row = matrix + i * d;
+        double dot = 0.0, rnorm = 0.0;
+        // simple loops: -O3 -march=native auto-vectorizes these
+        for (int64_t j = 0; j < d; ++j) {
+            dot += (double)row[j] * query[j];
+            rnorm += (double)row[j] * row[j];
+        }
+        float score = (float)(dot / ((std::sqrt(rnorm) + 1e-9) * qnorm));
+        hits.push_back({score, (int32_t)i});
+    }
+
+    int kk = (int)std::min<int64_t>(k, n);
+    std::partial_sort(
+        hits.begin(), hits.begin() + kk, hits.end(),
+        [](const Hit& a, const Hit& b) { return a.score > b.score; });
+    for (int i = 0; i < kk; ++i) {
+        out_idx[i] = hits[i].idx;
+        out_score[i] = hits[i].score;
+    }
+    return kk;
+}
+
+// Batched float32 blob pack/unpack helpers (BLOB <-> contiguous matrix).
+void unpack_blobs(const uint8_t* blob, int64_t n, int64_t d,
+                  float* out) {
+    std::memcpy(out, blob, (size_t)n * d * sizeof(float));
+}
+
+int version() { return 1; }
+
+}  // extern "C"

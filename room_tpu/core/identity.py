@@ -1,0 +1,119 @@
+"""On-chain room identity: ERC-8004 agent registration on Base
+(reference: src/shared/identity.ts — minimal registry ABI, data-URI
+metadata describing the room).
+
+Offline parts (metadata, calldata construction, registration records)
+work everywhere; the actual chain write needs RPC and fails closed like
+the wallet."""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Optional
+
+from ..db import Database
+from .chains import ERC8004_REGISTRY
+from .keccak import keccak256
+from .wallet import WalletError, get_room_wallet
+from . import rooms as rooms_mod
+
+
+def _selector(signature: str) -> str:
+    return keccak256(signature.encode())[:4].hex()
+
+
+# registerAgent(string metadataURI)
+REGISTER_SELECTOR = _selector("registerAgent(string)")
+# updateAgent(uint256 agentId, string metadataURI)
+UPDATE_SELECTOR = _selector("updateAgent(uint256,string)")
+
+
+def build_agent_metadata(db: Database, room_id: int) -> dict:
+    room = rooms_mod.get_room(db, room_id)
+    if room is None:
+        raise ValueError(f"room {room_id} not found")
+    wallet = get_room_wallet(db, room_id)
+    workers = db.query(
+        "SELECT name, role FROM workers WHERE room_id=?", (room_id,)
+    )
+    return {
+        "name": room["name"],
+        "description": room["goal"] or "",
+        "type": "autonomous-agent-collective",
+        "framework": "room-tpu",
+        "address": wallet["address"] if wallet else None,
+        "agents": [
+            {"name": w["name"], "role": w["role"]} for w in workers
+        ],
+    }
+
+
+def metadata_data_uri(metadata: dict) -> str:
+    payload = base64.b64encode(
+        json.dumps(metadata, separators=(",", ":")).encode()
+    ).decode()
+    return f"data:application/json;base64,{payload}"
+
+
+def _abi_encode_string(s: str) -> str:
+    raw = s.encode()
+    padded = raw + b"\x00" * (-len(raw) % 32)
+    return (
+        hex(32)[2:].rjust(64, "0")          # offset
+        + hex(len(raw))[2:].rjust(64, "0")  # length
+        + padded.hex()
+    )
+
+
+def build_register_calldata(metadata_uri: str) -> str:
+    return "0x" + REGISTER_SELECTOR + _abi_encode_string(metadata_uri)
+
+
+def register_room_identity(
+    db: Database, room_id: int, chain: str = "base",
+    dry_run: bool = True,
+) -> dict:
+    """Prepare (and, with RPC access, submit) the registration. dry_run
+    returns the transaction without network access."""
+    registry = ERC8004_REGISTRY.get(chain)
+    if registry is None:
+        raise WalletError(f"no ERC-8004 registry configured for {chain}")
+    wallet = get_room_wallet(db, room_id)
+    if wallet is None:
+        raise WalletError(f"room {room_id} has no wallet")
+    metadata = build_agent_metadata(db, room_id)
+    uri = metadata_data_uri(metadata)
+    tx = {
+        "to": registry,
+        "from": wallet["address"],
+        "data": build_register_calldata(uri),
+        "chain": chain,
+    }
+    if dry_run:
+        return {"tx": tx, "metadata": metadata, "submitted": False}
+    raise WalletError(
+        "on-chain submission requires network access; run with RPC "
+        "available and dry_run=False via the wallet signer"
+    )
+
+
+def record_registration(
+    db: Database, room_id: int, agent_id: str
+) -> None:
+    db.execute(
+        "UPDATE wallets SET erc8004_agent_id=? WHERE room_id=?",
+        (agent_id, room_id),
+    )
+
+
+def get_identity(db: Database, room_id: int) -> Optional[dict]:
+    w = get_room_wallet(db, room_id)
+    if w is None:
+        return None
+    return {
+        "address": w["address"],
+        "chain": w["chain"],
+        "erc8004_agent_id": w["erc8004_agent_id"],
+        "registered": w["erc8004_agent_id"] is not None,
+    }

@@ -252,13 +252,14 @@ def semantic_search(
     mat, eids = embedding_matrix(db, room_id)
     if not eids:
         return []
-    q = np.asarray(query_vector, dtype=np.float32)
-    qn = np.linalg.norm(q) + 1e-9
-    mn = np.linalg.norm(mat, axis=1) + 1e-9
-    sims = (mat @ q) / (mn * qn)
-    order = np.argsort(-sims)[:limit]
+    from ..utils.native import topk_cosine
+
+    idx, scores = topk_cosine(
+        mat, np.asarray(query_vector, dtype=np.float32), limit
+    )
     return [
-        {"entity_id": eids[i], "score": float(sims[i])} for i in order
+        {"entity_id": eids[int(i)], "score": float(s)}
+        for i, s in zip(idx, scores)
     ]
 
 

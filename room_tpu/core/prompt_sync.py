@@ -1,0 +1,112 @@
+"""Worker prompt sync (reference: src/shared/worker-prompt-sync.ts):
+explicit export/import of worker system prompts as YAML-frontmatter
+markdown under <data>/prompts/workers/room-<id>/worker-<id>.md, with a
+newest-mtime-wins conflict policy unless forced."""
+
+from __future__ import annotations
+
+import os
+import re
+from datetime import datetime, timezone
+from typing import Optional
+
+from ..db import Database
+from . import workers as workers_mod
+
+
+def prompts_dir(room_id: int) -> str:
+    base = os.environ.get(
+        "ROOM_TPU_DATA_DIR",
+        os.path.join(os.path.expanduser("~"), ".room_tpu"),
+    )
+    d = os.path.join(base, "prompts", "workers", f"room-{room_id}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _worker_path(room_id: int, worker_id: int) -> str:
+    return os.path.join(prompts_dir(room_id), f"worker-{worker_id}.md")
+
+
+def _render(worker: dict) -> str:
+    return (
+        "---\n"
+        f"worker_id: {worker['id']}\n"
+        f"name: {worker['name']}\n"
+        f"role: {worker['role'] or ''}\n"
+        f"model: {worker['model'] or ''}\n"
+        f"updated_at: {worker['updated_at']}\n"
+        "---\n\n"
+        f"{worker['system_prompt']}\n"
+    )
+
+
+_FRONTMATTER = re.compile(
+    r"^---\n(.*?)\n---\n\n?(.*)$", re.DOTALL
+)
+
+
+def _parse(text: str) -> Optional[tuple[dict, str]]:
+    m = _FRONTMATTER.match(text)
+    if m is None:
+        return None
+    meta: dict = {}
+    for line in m.group(1).splitlines():
+        if ":" in line:
+            k, v = line.split(":", 1)
+            meta[k.strip()] = v.strip()
+    return meta, m.group(2).rstrip("\n")
+
+
+def export_worker_prompts(db: Database, room_id: int) -> list[str]:
+    """Write every worker's prompt file. Returns paths written."""
+    paths = []
+    for w in workers_mod.list_room_workers(db, room_id):
+        path = _worker_path(room_id, w["id"])
+        with open(path, "w") as f:
+            f.write(_render(w))
+        paths.append(path)
+    return paths
+
+
+def _db_updated_at(worker: dict) -> float:
+    try:
+        return datetime.strptime(
+            worker["updated_at"], "%Y-%m-%dT%H:%M:%S.%fZ"
+        ).replace(tzinfo=timezone.utc).timestamp()
+    except (ValueError, TypeError):
+        return 0.0
+
+
+def import_worker_prompts(
+    db: Database, room_id: int, force: bool = False
+) -> dict:
+    """Apply edited prompt files back to the DB. Without force, a file
+    only wins when its mtime is newer than the DB row's updated_at."""
+    applied, skipped = [], []
+    d = prompts_dir(room_id)
+    for fname in sorted(os.listdir(d)):
+        m = re.match(r"worker-(\d+)\.md$", fname)
+        if not m:
+            continue
+        wid = int(m.group(1))
+        worker = workers_mod.get_worker(db, wid)
+        if worker is None or worker["room_id"] != room_id:
+            skipped.append((fname, "no such worker in room"))
+            continue
+        path = os.path.join(d, fname)
+        with open(path) as f:
+            parsed = _parse(f.read())
+        if parsed is None:
+            skipped.append((fname, "missing frontmatter"))
+            continue
+        _, prompt = parsed
+        if prompt == worker["system_prompt"]:
+            skipped.append((fname, "unchanged"))
+            continue
+        if not force and os.path.getmtime(path) <= _db_updated_at(worker):
+            skipped.append((fname, "db is newer (use force)"))
+            continue
+        workers_mod.update_worker(db, wid, system_prompt=prompt)
+        applied.append(fname)
+    return {"applied": applied, "skipped": skipped}

@@ -1,0 +1,132 @@
+"""Process supervisor (reference: src/shared/process-supervisor.ts):
+registry of managed child processes with tree-kill (descendant walk) and
+a graceful-then-forced shutdown sweep. Agents and tasks that spawn
+external programs register them here so server shutdown never strands
+orphans."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Optional
+
+_managed: dict[int, str] = {}
+_lock = threading.Lock()
+
+
+def register_managed_process(pid: int, label: str = "") -> None:
+    with _lock:
+        _managed[pid] = label
+
+
+def unregister_managed_process(pid: int) -> None:
+    with _lock:
+        _managed.pop(pid, None)
+
+
+def managed_processes() -> dict[int, str]:
+    with _lock:
+        return dict(_managed)
+
+
+def _descendants(root_pid: int) -> list[int]:
+    """Walk /proc (or ps fallback) for the full descendant set."""
+    children: dict[int, list[int]] = {}
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as f:
+                    fields = f.read().split()
+                ppid = int(fields[3])
+            except (OSError, IndexError, ValueError):
+                continue
+            children.setdefault(ppid, []).append(int(entry))
+    except OSError:
+        try:
+            out = subprocess.run(
+                ["ps", "-axo", "pid,ppid"], capture_output=True,
+                text=True, timeout=10,
+            ).stdout
+            for line in out.splitlines()[1:]:
+                parts = line.split()
+                if len(parts) >= 2:
+                    children.setdefault(
+                        int(parts[1]), []
+                    ).append(int(parts[0]))
+        except (OSError, subprocess.SubprocessError, ValueError):
+            return []
+
+    result: list[int] = []
+    stack = [root_pid]
+    while stack:
+        pid = stack.pop()
+        for child in children.get(pid, []):
+            result.append(child)
+            stack.append(child)
+    return result
+
+
+def kill_pid_tree(
+    pid: int, sig: int = signal.SIGTERM, include_root: bool = True
+) -> int:
+    """Signal a process and all its descendants (deepest first)."""
+    targets = _descendants(pid)
+    if include_root:
+        targets = targets + [pid]
+    killed = 0
+    for target in reversed(targets):
+        try:
+            os.kill(target, sig)
+            killed += 1
+        except (ProcessLookupError, PermissionError):
+            pass
+    return killed
+
+
+def terminate_managed_processes(grace_s: float = 3.0) -> int:
+    """SIGTERM every managed tree, wait, then SIGKILL survivors."""
+    pids = list(managed_processes())
+    for pid in pids:
+        kill_pid_tree(pid, signal.SIGTERM)
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        alive = [p for p in pids if _alive(p)]
+        if not alive:
+            break
+        time.sleep(0.1)
+    for pid in pids:
+        if _alive(pid):
+            kill_pid_tree(pid, signal.SIGKILL)
+    with _lock:
+        for pid in pids:
+            _managed.pop(pid, None)
+    return len(pids)
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    # an unreaped zombie answers signal 0 but is effectively dead
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split()[2] != "Z"
+    except (OSError, IndexError):
+        return True
+
+
+def spawn_managed(
+    args: list[str], label: str = "", **popen_kwargs
+) -> subprocess.Popen:
+    """Popen + registration in one step."""
+    proc = subprocess.Popen(args, **popen_kwargs)
+    register_managed_process(proc.pid, label or args[0])
+    return proc

@@ -1,0 +1,90 @@
+"""Anonymous telemetry (reference: src/shared/telemetry.ts): machine id
+= sha256(hostname+user) prefix; crash reports + daily heartbeats are
+dispatched only when an endpoint token is configured at build/deploy
+time — disabled entirely otherwise."""
+
+from __future__ import annotations
+
+import getpass
+import hashlib
+import json
+import os
+import socket
+import traceback
+import urllib.request
+from typing import Optional
+
+from ..db import Database, utc_now
+from .messages import get_setting, set_setting
+
+
+def get_machine_id() -> str:
+    seed = socket.gethostname() + ":" + getpass.getuser()
+    return hashlib.sha256(seed.encode()).hexdigest()[:12]
+
+
+def telemetry_enabled() -> bool:
+    return bool(os.environ.get("ROOM_TPU_TELEMETRY_TOKEN"))
+
+
+def _endpoint() -> Optional[str]:
+    return os.environ.get("ROOM_TPU_TELEMETRY_URL")
+
+
+def _post(payload: dict) -> bool:
+    url = _endpoint()
+    if not url or not telemetry_enabled():
+        return False
+    try:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(payload).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization":
+                    f"Bearer {os.environ['ROOM_TPU_TELEMETRY_TOKEN']}",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=10):
+            return True
+    except OSError:
+        return False
+
+
+def submit_crash_report(
+    db: Database, error: BaseException, context: str = ""
+) -> bool:
+    """Deduped by error signature (one report per signature per day)."""
+    if not telemetry_enabled():
+        return False
+    sig = hashlib.sha256(
+        f"{type(error).__name__}:{error}".encode()
+    ).hexdigest()[:16]
+    key = f"telemetry_crash_{sig}"
+    today = utc_now()[:10]
+    if (get_setting(db, key) or "")[:10] == today:
+        return False
+    set_setting(db, key, utc_now())
+    return _post({
+        "kind": "crash",
+        "machine": get_machine_id(),
+        "signature": sig,
+        "error": f"{type(error).__name__}: {error}",
+        "trace": "".join(traceback.format_exception(error))[-4000:],
+        "context": context,
+    })
+
+
+def submit_heartbeat(db: Database) -> bool:
+    if not telemetry_enabled():
+        return False
+    today = utc_now()[:10]
+    if (get_setting(db, "telemetry_heartbeat") or "")[:10] == today:
+        return False
+    set_setting(db, "telemetry_heartbeat", utc_now())
+    rooms = db.query_one("SELECT COUNT(*) AS n FROM rooms")
+    return _post({
+        "kind": "heartbeat",
+        "machine": get_machine_id(),
+        "rooms": rooms["n"] if rooms else 0,
+    })

@@ -1,0 +1,140 @@
+"""Worker and room templates (reference: src/shared/worker-templates.ts,
+room-templates.ts): named presets a keeper (or the clerk) instantiates
+with one call."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..db import Database
+from . import rooms as rooms_mod, workers as workers_mod
+
+
+@dataclass(frozen=True)
+class WorkerTemplate:
+    key: str
+    name: str
+    role: str
+    description: str
+    system_prompt: str
+
+
+WORKER_TEMPLATES: dict[str, WorkerTemplate] = {
+    t.key: t
+    for t in (
+        WorkerTemplate(
+            "scout", "Scout", "researcher",
+            "Finds and verifies information fast.",
+            "You are Scout. Hunt down the information the room needs: "
+            "search, cross-check at least two sources, store verified "
+            "findings with remember(), and flag anything dubious.",
+        ),
+        WorkerTemplate(
+            "forge", "Forge", "executor",
+            "Builds whatever the queen delegates.",
+            "You are Forge. Take delegated goals and produce concrete "
+            "artifacts. Break work into steps, do the next step every "
+            "cycle, and report progress on your goals honestly.",
+        ),
+        WorkerTemplate(
+            "blaze", "Blaze", "executor",
+            "Ships quickly and iterates.",
+            "You are Blaze. Bias to shipping: prefer a rough working "
+            "version now over a perfect one later. Close goals fast and "
+            "note follow-ups in memory.",
+        ),
+        WorkerTemplate(
+            "warden", "Warden", "guardian",
+            "Reviews decisions and guards the room.",
+            "You are Warden. Each cycle review announced decisions and "
+            "recent activity for risk, waste, or scope creep. Object "
+            "with a clear reason when warranted; stay silent otherwise.",
+        ),
+        WorkerTemplate(
+            "scribe", "Scribe", "writer",
+            "Turns the room's work into prose.",
+            "You are Scribe. Maintain clear written artifacts: status "
+            "summaries, documentation, reports. Pull from goals, memory "
+            "and activity; store finished documents with remember().",
+        ),
+        WorkerTemplate(
+            "ledger", "Ledger", "analyst",
+            "Watches numbers and metrics.",
+            "You are Ledger. Track the room's measurable outcomes, "
+            "reconcile them against goals, and surface trends the queen "
+            "should act on.",
+        ),
+    )
+}
+
+
+@dataclass(frozen=True)
+class RoomTemplate:
+    key: str
+    name: str
+    goal: str
+    description: str
+    workers: tuple[str, ...] = field(default=())
+
+
+ROOM_TEMPLATES: dict[str, RoomTemplate] = {
+    t.key: t
+    for t in (
+        RoomTemplate(
+            "saas-builder", "SaaS Builder",
+            "Design, build, and launch a small SaaS product end to end.",
+            "Queen + Forge/Blaze builders + Scout research + Warden "
+            "review.",
+            ("scout", "forge", "blaze", "warden"),
+        ),
+        RoomTemplate(
+            "research-desk", "Research Desk",
+            "Continuously research a topic and maintain a living brief.",
+            "Scout-heavy room with a Scribe for synthesis.",
+            ("scout", "scout", "scribe"),
+        ),
+        RoomTemplate(
+            "ops-room", "Ops Room",
+            "Keep scheduled jobs healthy and report anomalies.",
+            "Executor + analyst + guardian for steady-state operations.",
+            ("forge", "ledger", "warden"),
+        ),
+    )
+}
+
+
+def instantiate_room_template(
+    db: Database,
+    template_key: str,
+    name: Optional[str] = None,
+    worker_model: str = "tpu",
+) -> dict:
+    tpl = ROOM_TEMPLATES.get(template_key)
+    if tpl is None:
+        raise KeyError(
+            f"unknown room template {template_key!r}; known: "
+            f"{sorted(ROOM_TEMPLATES)}"
+        )
+    room = rooms_mod.create_room(
+        db, name or tpl.name, goal=tpl.goal, worker_model=worker_model
+    )
+    for wkey in tpl.workers:
+        add_worker_from_template(db, room["id"], wkey, model=worker_model)
+    return rooms_mod.get_room(db, room["id"])  # type: ignore[return-value]
+
+
+def add_worker_from_template(
+    db: Database, room_id: int, template_key: str,
+    model: Optional[str] = None,
+) -> int:
+    tpl = WORKER_TEMPLATES.get(template_key)
+    if tpl is None:
+        raise KeyError(
+            f"unknown worker template {template_key!r}; known: "
+            f"{sorted(WORKER_TEMPLATES)}"
+        )
+    return workers_mod.create_worker(
+        db, tpl.name, tpl.system_prompt, room_id=room_id, role=tpl.role,
+        model=model, description=tpl.description,
+    )

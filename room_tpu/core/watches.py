@@ -1,0 +1,165 @@
+"""File watches with a real polling runtime.
+
+The reference stored watches and validated paths but never actually
+watched anything (SURVEY.md: markWatchTriggered never called — vestigial
+trigger path). Here the runtime polls registered paths and fires the
+watch's action prompt as a one-time task when content changes.
+
+Path safety mirrors the reference (src/shared/watch-path.ts): home/tmp
+only, sensitive directories denied, symlinks resolved before checking."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Optional
+
+from ..db import Database, utc_now
+
+DENIED_PARTS = {
+    ".ssh", ".aws", ".gnupg", ".gpg", ".keychain", ".password-store",
+    ".config/gcloud", ".kube", ".docker", ".netrc",
+}
+
+
+def validate_watch_path(path: str) -> Optional[str]:
+    """Returns an error message, or None when the path is watchable."""
+    real = os.path.realpath(os.path.expanduser(path))
+    home = os.path.realpath(os.path.expanduser("~"))
+    tmp = os.path.realpath("/tmp")
+    data_dir = os.path.realpath(
+        os.environ.get("ROOM_TPU_DATA_DIR", os.path.join(home, ".room_tpu"))
+    )
+    if not (
+        real == home or real.startswith(home + os.sep)
+        or real.startswith(tmp + os.sep)
+        or real.startswith(data_dir + os.sep)
+    ):
+        return f"path {path!r} is outside the home/tmp sandbox"
+    rel = real[len(home):] if real.startswith(home) else real
+    # normalize to /-separated with sentinels so both single components
+    # (".ssh") and nested entries (".config/gcloud") match anywhere on
+    # the path, including files inside them
+    hay = "/" + "/".join(p for p in rel.split(os.sep) if p) + "/"
+    for denied in DENIED_PARTS:
+        if f"/{denied}/" in hay:
+            return f"path {path!r} touches a protected directory"
+    return None
+
+
+def create_watch(
+    db: Database,
+    path: str,
+    action_prompt: str,
+    description: Optional[str] = None,
+    room_id: Optional[int] = None,
+) -> int:
+    err = validate_watch_path(path)
+    if err:
+        raise ValueError(err)
+    return db.insert(
+        "INSERT INTO watches(path, description, action_prompt, room_id) "
+        "VALUES (?,?,?,?)",
+        (os.path.realpath(os.path.expanduser(path)), description,
+         action_prompt, room_id),
+    )
+
+
+def list_watches(db: Database, room_id: Optional[int] = None) -> list[dict]:
+    if room_id is None:
+        return db.query("SELECT * FROM watches ORDER BY id")
+    return db.query(
+        "SELECT * FROM watches WHERE room_id=? ORDER BY id", (room_id,)
+    )
+
+
+def delete_watch(db: Database, watch_id: int) -> bool:
+    return db.execute(
+        "DELETE FROM watches WHERE id=?", (watch_id,)
+    ).rowcount > 0
+
+
+def _fingerprint(path: str) -> Optional[str]:
+    """Cheap change detector: mtime+size for files, listing hash for
+    directories."""
+    try:
+        if os.path.isdir(path):
+            entries = sorted(os.listdir(path))[:500]
+            seed = "|".join(entries)
+        else:
+            st = os.stat(path)
+            seed = f"{st.st_mtime_ns}:{st.st_size}"
+    except OSError:
+        return None
+    return hashlib.sha256(seed.encode()).hexdigest()[:16]
+
+
+class WatchRuntime:
+    """Polls active watches; on change, fires the action prompt as a
+    one-time task for the watch's room."""
+
+    def __init__(self, db: Database, interval_s: float = 10.0) -> None:
+        self.db = db
+        self.interval_s = interval_s
+        self.stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._fingerprints: dict[int, Optional[str]] = {}
+
+    def poll_once(self) -> int:
+        """Returns how many watches fired."""
+        fired = 0
+        for w in self.db.query(
+            "SELECT * FROM watches WHERE status='active'"
+        ):
+            fp = _fingerprint(w["path"])
+            if fp is None:
+                # transient stat failure: keep the old fingerprint so
+                # the next successful poll doesn't false-fire
+                continue
+            prev = self._fingerprints.get(w["id"], "__first__")
+            self._fingerprints[w["id"]] = fp
+            if prev == "__first__" or fp == prev:
+                continue
+            self._trigger(w)
+            fired += 1
+        return fired
+
+    def _trigger(self, watch: dict) -> None:
+        from .task_runner import create_task
+
+        self.db.execute(
+            "UPDATE watches SET last_triggered=?, "
+            "trigger_count=trigger_count+1 WHERE id=?",
+            (utc_now(), watch["id"]),
+        )
+        if watch["action_prompt"]:
+            create_task(
+                self.db,
+                name=f"watch: {os.path.basename(watch['path'])}",
+                prompt=(
+                    f"The watched path {watch['path']} changed.\n"
+                    f"{watch['action_prompt']}"
+                ),
+                trigger_type="once",
+                scheduled_at=utc_now(),
+                room_id=watch["room_id"],
+            )
+
+    def start(self) -> None:
+        def loop():
+            while not self.stop_event.wait(timeout=self.interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="watch-runtime"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.stop_event.set()
+        if self._thread:
+            self._thread.join(timeout=5)

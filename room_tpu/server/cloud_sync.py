@@ -1,0 +1,160 @@
+"""Cloud sync (reference: src/shared/cloud-sync.ts + runtime.ts:290-329):
+optional registration + heartbeats to a cloud endpoint, per-room tokens
+persisted 0600, and an inter-room message relay so rooms on different
+machines can talk. Every network failure is silent; nothing here is on
+any critical path."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import urllib.request
+from typing import Optional
+
+from ..core import messages as messages_mod
+from ..core.telemetry import get_machine_id
+from ..db import Database
+
+HEARTBEAT_S = 5 * 60.0
+MESSAGE_SYNC_S = 60.0
+TOKENS_FILE = "cloud-room-tokens.json"
+
+
+def cloud_api_base() -> Optional[str]:
+    return os.environ.get("ROOM_TPU_CLOUD_API")
+
+
+def _tokens_path() -> str:
+    from .auth import data_dir
+
+    return os.path.join(data_dir(), TOKENS_FILE)
+
+
+def _load_tokens() -> dict[str, str]:
+    try:
+        with open(_tokens_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _save_tokens(tokens: dict[str, str]) -> None:
+    fd = os.open(
+        _tokens_path(), os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600
+    )
+    with os.fdopen(fd, "w") as f:
+        json.dump(tokens, f)
+
+
+def _post(path: str, payload: dict, token: Optional[str] = None
+          ) -> Optional[dict]:
+    base = cloud_api_base()
+    if not base:
+        return None
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    try:
+        req = urllib.request.Request(
+            base.rstrip("/") + path,
+            data=json.dumps(payload).encode(),
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+    except (OSError, json.JSONDecodeError):
+        return None  # all cloud failures are silent
+
+
+def ensure_cloud_room_token(db: Database, room_id: int) -> Optional[str]:
+    tokens = _load_tokens()
+    key = str(room_id)
+    if key in tokens:
+        return tokens[key]
+    room = db.query_one("SELECT * FROM rooms WHERE id=?", (room_id,))
+    if room is None:
+        return None
+    out = _post("/rooms/register", {
+        "machine": get_machine_id(),
+        "roomId": room_id,
+        "name": room["name"],
+        "visibility": room["visibility"],
+    })
+    if not out or "token" not in out:
+        return None
+    tokens[key] = out["token"]
+    _save_tokens(tokens)
+    return out["token"]
+
+
+def send_heartbeat(db: Database, room_id: int) -> bool:
+    token = ensure_cloud_room_token(db, room_id)
+    if not token:
+        return False
+    return _post(
+        "/rooms/heartbeat", {"roomId": room_id}, token
+    ) is not None
+
+
+def sync_cloud_messages(db: Database) -> int:
+    """Push queued outbound messages to remote rooms; pull inbound.
+    Returns how many messages moved."""
+    moved = 0
+    for room in db.query(
+        "SELECT id FROM rooms WHERE visibility='public'"
+    ):
+        token = ensure_cloud_room_token(db, room["id"])
+        if not token:
+            continue
+        out = _post("/rooms/messages/pull", {"roomId": room["id"]}, token)
+        for msg in (out or {}).get("messages", []):
+            messages_mod.receive_external_message(
+                db, room["id"], str(msg.get("fromRoomId", "cloud")),
+                msg.get("subject", ""), msg.get("body", ""),
+            )
+            moved += 1
+    return moved
+
+
+class CloudSync:
+    def __init__(self, db: Database) -> None:
+        self.db = db
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    @property
+    def enabled(self) -> bool:
+        return cloud_api_base() is not None
+
+    def start(self) -> None:
+        if not self.enabled:
+            return
+
+        def heartbeat_loop():
+            while not self._stop.wait(timeout=HEARTBEAT_S):
+                try:
+                    for room in self.db.query(
+                        "SELECT id FROM rooms WHERE status='active'"
+                    ):
+                        send_heartbeat(self.db, room["id"])
+                except Exception:
+                    pass  # transient DB error must not kill heartbeats
+
+        def message_loop():
+            while not self._stop.wait(timeout=MESSAGE_SYNC_S):
+                try:
+                    sync_cloud_messages(self.db)
+                except Exception:
+                    pass
+
+        for fn in (heartbeat_loop, message_loop):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"cloud-{fn.__name__}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)

@@ -1,0 +1,130 @@
+"""Clerk commentary engine (reference: src/server/clerk-commentary.ts):
+subscribes to cycle events, buffers what the swarm is doing, and
+periodically narrates it to the 'clerk' WS channel in a lively
+commentator voice — active pace while cycles flow, light pace when
+quiet, paused while the keeper is chatting."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..core.events import event_bus
+from ..core.messages import get_setting
+from ..db import Database
+from ..providers import ExecutionRequest, get_model_provider
+
+ACTIVE_PACE_S = (8.0, 30.0)
+LIGHT_PACE_S = 2 * 3600.0
+KEEPER_SILENCE_RESUME_S = 60.0
+
+COMMENTARY_PROMPT = (
+    "You are the live commentator for an agent swarm. Narrate the "
+    "recent activity below in 1-2 punchy sentences — present tense, "
+    "energetic, concrete. Never invent events. No preamble, no quotes."
+)
+
+
+class CommentaryEngine:
+    def __init__(
+        self, db: Database, model: Optional[str] = None
+    ) -> None:
+        self.db = db
+        self._model = model
+        self._buffer: list[str] = []
+        self._lock = threading.Lock()
+        self._last_keeper_msg = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._unsubs = []
+
+    # ---- event intake ----
+
+    def _on_event(self, event) -> None:
+        if event.type == "cycle:log":
+            data = event.data or {}
+            if data.get("entry_type") in ("assistant", "tool_call"):
+                with self._lock:
+                    self._buffer.append(
+                        f"{event.channel}: {str(data.get('content'))[:200]}"
+                    )
+                    del self._buffer[:-40]
+        elif event.type in ("cycle:started", "run:created",
+                            "decision", "escalation:created"):
+            with self._lock:
+                self._buffer.append(f"{event.type} on {event.channel}")
+                del self._buffer[:-40]
+        elif event.type == "chat:message":
+            self._last_keeper_msg = time.monotonic()
+
+    # ---- narration ----
+
+    def narrate_once(self) -> Optional[str]:
+        with self._lock:
+            events, self._buffer = self._buffer, []
+        if not events:
+            return None
+        if time.monotonic() - self._last_keeper_msg < \
+                KEEPER_SILENCE_RESUME_S:
+            return None  # keeper is talking; stay quiet
+
+        model = self._model or get_setting(
+            self.db, "clerk_model", "echo"
+        ) or "echo"
+        provider = get_model_provider(model, self.db)
+        ready, _ = provider.is_ready()
+        if not ready:
+            return None
+        result = provider.execute(ExecutionRequest(
+            prompt="\n".join(events[-20:]),
+            system_prompt=COMMENTARY_PROMPT,
+            max_turns=1, max_new_tokens=120, timeout_s=60,
+        ))
+        self.db.insert(
+            "INSERT INTO clerk_usage(source, model, input_tokens, "
+            "output_tokens, total_tokens, success) VALUES "
+            "('commentary', ?,?,?,?,?)",
+            (model, result.input_tokens, result.output_tokens,
+             result.input_tokens + result.output_tokens,
+             int(result.success)),
+        )
+        if not (result.success and result.text):
+            return None
+        self.db.insert(
+            "INSERT INTO clerk_messages(role, content, source) "
+            "VALUES ('commentary', ?, 'commentary')",
+            (result.text,),
+        )
+        event_bus.emit("clerk:commentary", "clerk",
+                       {"text": result.text})
+        return result.text
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._unsubs.append(event_bus.subscribe(None, self._on_event))
+
+        def loop():
+            while not self._stop.is_set():
+                with self._lock:
+                    busy = len(self._buffer) > 0
+                pace = ACTIVE_PACE_S[1] if busy else LIGHT_PACE_S
+                if self._stop.wait(timeout=pace):
+                    return
+                try:
+                    self.narrate_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="clerk-commentary"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for unsub in self._unsubs:
+            unsub()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,153 @@
+"""Keeper notification digests (reference:
+src/server/clerk-notifications.ts): pending escalations and unanswered
+queen messages are batched into digest messages on a 6 h cadence (1 h
+when anything urgent), with per-source cursors so nothing is re-sent.
+Delivery lands in clerk_messages (and, when configured, the keeper's
+email/telegram relays — gated on settings)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..core.events import event_bus
+from ..core.messages import get_setting, set_setting
+from ..db import Database, utc_now
+
+DIGEST_INTERVAL_S = 6 * 3600.0
+URGENT_INTERVAL_S = 3600.0
+URGENT_KEYWORDS = ("urgent", "blocked", "failed", "error", "money",
+                   "payment")
+
+
+def _cursor(db: Database, source: str) -> int:
+    try:
+        return int(get_setting(db, f"notify_cursor_{source}") or 0)
+    except ValueError:
+        return 0
+
+
+def _set_cursor(db: Database, source: str, value: int) -> None:
+    set_setting(db, f"notify_cursor_{source}", str(value))
+
+
+def collect_pending(db: Database) -> dict:
+    """Unsent escalations + queen->keeper chat since the cursors."""
+    esc = db.query(
+        "SELECT * FROM escalations WHERE status='pending' AND id > ? "
+        "ORDER BY id",
+        (_cursor(db, "escalations"),),
+    )
+    chats = db.query(
+        "SELECT c.*, r.name AS room_name FROM chat_messages c "
+        "JOIN rooms r ON r.id = c.room_id "
+        "WHERE c.role='assistant' AND c.id > ? ORDER BY c.id",
+        (_cursor(db, "chat"),),
+    )
+    urgent = any(
+        any(k in (e["question"] or "").lower() for k in URGENT_KEYWORDS)
+        for e in esc
+    )
+    return {"escalations": esc, "chats": chats, "urgent": urgent}
+
+
+def build_digest(pending: dict) -> Optional[str]:
+    parts: list[str] = []
+    if pending["escalations"]:
+        parts.append(
+            f"{len(pending['escalations'])} escalation(s) need you:\n"
+            + "\n".join(
+                f"  - [{e['id']}] {e['question'][:150]}"
+                for e in pending["escalations"][:5]
+            )
+        )
+    if pending["chats"]:
+        parts.append(
+            f"{len(pending['chats'])} message(s) from your queens:\n"
+            + "\n".join(
+                f"  - {c['room_name']}: {c['content'][:120]}"
+                for c in pending["chats"][:5]
+            )
+        )
+    if not parts:
+        return None
+    return "Keeper digest:\n" + "\n".join(parts)
+
+
+def relay_pending(db: Database) -> Optional[str]:
+    """One digest pass; advances cursors only for what was included."""
+    pending = collect_pending(db)
+    digest = build_digest(pending)
+    if digest is None:
+        return None
+    db.insert(
+        "INSERT INTO clerk_messages(role, content, source) "
+        "VALUES ('assistant', ?, 'digest')",
+        (digest,),
+    )
+    event_bus.emit("keeper:digest", "clerk", {"text": digest})
+    _deliver_external(db, digest)
+    if pending["escalations"]:
+        _set_cursor(db, "escalations", pending["escalations"][-1]["id"])
+    if pending["chats"]:
+        _set_cursor(db, "chat", pending["chats"][-1]["id"])
+    return digest
+
+
+def _deliver_external(db: Database, digest: str) -> None:
+    """Email/Telegram relays, gated on configured settings; failures are
+    silent like the reference's cloud relays."""
+    telegram_token = get_setting(db, "telegram_bot_token")
+    telegram_chat = get_setting(db, "telegram_chat_id")
+    if telegram_token and telegram_chat:
+        try:
+            import json
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"https://api.telegram.org/bot{telegram_token}"
+                "/sendMessage",
+                data=json.dumps({
+                    "chat_id": telegram_chat, "text": digest,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=10)
+        except OSError:
+            pass
+
+
+class NotificationEngine:
+    def __init__(self, db: Database) -> None:
+        self.db = db
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        def loop():
+            while True:
+                try:
+                    pending = collect_pending(self.db)
+                    urgent = pending["urgent"]
+                except Exception:
+                    urgent = False  # transient DB error must not kill us
+                wait = (
+                    URGENT_INTERVAL_S if urgent else DIGEST_INTERVAL_S
+                )
+                if self._stop.wait(timeout=wait):
+                    return
+                try:
+                    relay_pending(self.db)
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="keeper-notifications"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -51,6 +51,145 @@ def register_all_routes(r: Router) -> None:
     register_settings_routes(r)
     register_status_routes(r)
     register_clerk_routes(r)
+    register_aux_routes(r)
+
+
+def register_aux_routes(r: Router) -> None:
+    """Templates, identity, watches, prompt sync, TPU manager, feed."""
+
+    def list_templates(ctx):
+        from ..core.templates import ROOM_TEMPLATES, WORKER_TEMPLATES
+
+        return ok({
+            "rooms": [vars(t) for t in ROOM_TEMPLATES.values()],
+            "workers": [vars(t) for t in WORKER_TEMPLATES.values()],
+        })
+
+    def instantiate_template(ctx):
+        from ..core.templates import instantiate_room_template
+
+        key = (ctx.body or {}).get("template")
+        if not key:
+            return err("template is required")
+        try:
+            room = instantiate_room_template(
+                ctx.db, key, name=(ctx.body or {}).get("name"),
+                worker_model=(ctx.body or {}).get("workerModel", "tpu"),
+            )
+        except KeyError as e:
+            return err(str(e), 404)
+        return ok(room, 201)
+
+    def identity(ctx):
+        from ..core.identity import get_identity
+
+        ident = get_identity(ctx.db, int(ctx.params["id"]))
+        return ok(ident) if ident else err("room has no wallet", 404)
+
+    def identity_register(ctx):
+        from ..core.identity import register_room_identity
+        from ..core.wallet import WalletError
+
+        try:
+            out = register_room_identity(
+                ctx.db, int(ctx.params["id"]),
+                dry_run=bool((ctx.body or {}).get("dryRun", True)),
+            )
+        except WalletError as e:
+            return err(str(e), 503)
+        return ok(out)
+
+    def list_watches_route(ctx):
+        from ..core.watches import list_watches
+
+        room_id = ctx.query.get("roomId")
+        return ok(list_watches(
+            ctx.db, int(room_id) if room_id else None
+        ))
+
+    def create_watch_route(ctx):
+        from ..core.watches import create_watch
+
+        b = ctx.body or {}
+        if not b.get("path"):
+            return err("path is required")
+        try:
+            wid = create_watch(
+                ctx.db, b["path"], b.get("actionPrompt", ""),
+                description=b.get("description"),
+                room_id=b.get("roomId"),
+            )
+        except ValueError as e:
+            return err(str(e))
+        return ok({"id": wid}, 201)
+
+    def delete_watch_route(ctx):
+        from ..core.watches import delete_watch
+
+        if not delete_watch(ctx.db, int(ctx.params["id"])):
+            return err("watch not found", 404)
+        return ok({"deleted": int(ctx.params["id"])})
+
+    def export_prompts(ctx):
+        from ..core.prompt_sync import export_worker_prompts
+
+        return ok({"paths": export_worker_prompts(
+            ctx.db, int(ctx.params["id"])
+        )})
+
+    def import_prompts(ctx):
+        from ..core.prompt_sync import import_worker_prompts
+
+        return ok(import_worker_prompts(
+            ctx.db, int(ctx.params["id"]),
+            force=bool((ctx.body or {}).get("force")),
+        ))
+
+    def tpu_status(ctx):
+        from .tpu_manager import get_tpu_status
+
+        return ok(get_tpu_status(
+            ctx.query.get("model", "qwen3-coder-30b")
+        ))
+
+    def tpu_provision(ctx):
+        from .tpu_manager import start_provision_session
+
+        session = start_provision_session(
+            (ctx.body or {}).get("model", "qwen3-coder-30b")
+        )
+        return ok({"session": session}, 202)
+
+    def tpu_session(ctx):
+        from .tpu_manager import get_provision_session
+
+        s = get_provision_session(ctx.params["sid"])
+        return ok(s) if s else err("session not found", 404)
+
+    def tpu_apply(ctx):
+        from .tpu_manager import apply_tpu_model_to_all
+
+        return ok(apply_tpu_model_to_all(
+            ctx.db, (ctx.body or {}).get("model", "qwen3-coder-30b")
+        ))
+
+    def public_feed(ctx):
+        return ok(activity_mod.get_public_feed(ctx.db))
+
+    r.get("/api/templates", list_templates)
+    r.post("/api/templates/instantiate", instantiate_template)
+    r.get("/api/rooms/:id/identity", identity)
+    r.post("/api/rooms/:id/identity/register", identity_register)
+    r.get("/api/watches", list_watches_route)
+    r.post("/api/watches", create_watch_route)
+    r.delete("/api/watches/:id", delete_watch_route)
+    r.post("/api/rooms/:id/prompts/export", export_prompts)
+    r.post("/api/rooms/:id/prompts/import", import_prompts)
+    r.get("/api/tpu/status", tpu_status)
+    r.post("/api/tpu/provision", tpu_provision)
+    r.get("/api/tpu/provision/:sid", tpu_session)
+    r.post("/api/tpu/apply", tpu_apply)
+    r.get("/api/feed", public_feed)
 
 
 # ---- rooms ----

@@ -41,14 +41,30 @@ class ServerRuntime:
     # ---- lifecycle ----
 
     indexer: Optional[object] = None
+    watch_runtime: Optional[object] = None
+    commentary: Optional[object] = None
+    notifications: Optional[object] = None
+    cloud: Optional[object] = None
 
     def start(self) -> None:
         self.cleanup_stale(startup=True)
         self.scheduler_tick()
         from ..core.embedding_indexer import EmbeddingIndexer
+        from ..core.watches import WatchRuntime
+        from .cloud_sync import CloudSync
+        from .commentary import CommentaryEngine
+        from .notifications import NotificationEngine
 
         self.indexer = EmbeddingIndexer(self.db)
         self.indexer.start()
+        self.watch_runtime = WatchRuntime(self.db)
+        self.watch_runtime.start()
+        self.commentary = CommentaryEngine(self.db)
+        self.commentary.start()
+        self.notifications = NotificationEngine(self.db)
+        self.notifications.start()
+        self.cloud = CloudSync(self.db)
+        self.cloud.start()
         for target, interval in (
             (self.scheduler_tick, SCHEDULER_TICK_S),
             (self.maintenance_tick, MAINTENANCE_TICK_S),
@@ -63,8 +79,13 @@ class ServerRuntime:
 
     def stop(self) -> None:
         self.stop_event.set()
-        if self.indexer is not None:
-            self.indexer.stop()
+        for aux in (self.indexer, self.watch_runtime, self.commentary,
+                    self.notifications, self.cloud):
+            if aux is not None:
+                aux.stop()
+        from ..core.supervisor import terminate_managed_processes
+
+        terminate_managed_processes()
         for t in self.threads:
             t.join(timeout=5)
 

@@ -1,0 +1,203 @@
+"""TPU host manager (reference: src/server/local-model.ts — the Ollama
+host gate + one-click install session, re-targeted at TPU serving):
+
+- hardware gate: device platform/count, HBM headroom vs the model's
+  weight footprint, host RAM/disk floors
+- provisioning session: bring up a ModelHost (weights load / random
+  init) with line-streamed progress over the event bus, the same UX the
+  reference used for its install session
+- apply-to-all: point the clerk, every queen, and every room's
+  worker_model at the tpu: provider in one transaction
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import Optional
+
+from ..core.events import event_bus
+from ..core.messages import set_setting
+from ..db import Database, utc_now
+from ..providers.tpu import MODEL_CONFIGS, checkpoint_dir, get_model_host
+
+MIN_HOST_RAM_GB = 8
+MIN_FREE_DISK_GB = 10
+
+_sessions: dict[str, dict] = {}
+_lock = threading.Lock()
+
+
+def _bytes_per_param(dtype: str) -> int:
+    return 2 if dtype == "bfloat16" else 4
+
+
+def model_weight_bytes(name: str) -> int:
+    cfg = MODEL_CONFIGS[name]()
+    D, L = cfg.hidden, cfg.n_layers
+    attn = D * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * D
+    if cfg.is_moe:
+        ffn = cfg.n_experts * 3 * D * cfg.moe_intermediate
+    else:
+        ffn = 3 * D * cfg.intermediate
+    embed = cfg.vocab_size * D * 2  # embed + lm head
+    return (L * (attn + ffn) + embed) * _bytes_per_param(cfg.dtype)
+
+
+def get_tpu_status(model: str = "qwen3-coder-30b") -> dict:
+    """The hardware gate (reference LIMITS:22-30 reimagined for TPU)."""
+    checks: list[dict] = []
+
+    def check(name: str, okay: bool, detail: str) -> None:
+        checks.append({"name": name, "ok": bool(okay), "detail": detail})
+
+    try:
+        import jax
+
+        platform = jax.default_backend()
+        n_devices = jax.device_count()
+        check(
+            "accelerator", platform in ("tpu", "cpu"),
+            f"{n_devices}x {platform}",
+        )
+        hbm_bytes = 0
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            hbm_bytes = stats.get("bytes_limit", 0)
+        except Exception:
+            pass
+        need = model_weight_bytes(model)
+        if hbm_bytes:
+            total = hbm_bytes * n_devices
+            check(
+                "hbm", need * 1.3 < total,
+                f"model needs ~{need/1e9:.1f} GB, mesh has "
+                f"{total/1e9:.1f} GB",
+            )
+        else:
+            check("hbm", True, "memory stats unavailable; unchecked")
+    except Exception as e:
+        check("accelerator", False, f"jax backend error: {e}")
+
+    try:
+        with open("/proc/meminfo") as f:
+            mem_kb = int(f.readline().split()[1])
+        check(
+            "host_ram", mem_kb / 1e6 >= MIN_HOST_RAM_GB,
+            f"{mem_kb/1e6:.1f} GB total",
+        )
+    except (OSError, ValueError, IndexError):
+        check("host_ram", True, "unknown; unchecked")
+
+    free_gb = shutil.disk_usage("/").free / 1e9
+    check("disk", free_gb >= MIN_FREE_DISK_GB, f"{free_gb:.1f} GB free")
+
+    ckpt = checkpoint_dir(model)
+    check(
+        "weights",
+        bool(ckpt) or os.environ.get("ROOM_TPU_ALLOW_RANDOM_INIT") == "1"
+        or model.startswith("tiny"),
+        ckpt or "no checkpoint (set ROOM_TPU_CKPT_DIR or allow "
+        "random init)",
+    )
+
+    return {
+        "model": model,
+        "ready": all(c["ok"] for c in checks),
+        "checks": checks,
+    }
+
+
+MAX_SESSIONS_KEPT = 20
+
+
+def start_provision_session(
+    model: str = "qwen3-coder-30b",
+) -> str:
+    """Async weight-load session with streamed logs on channel
+    'tpu-model' (reference install-session pattern,
+    local-model.ts:427-519). One session per model at a time; repeat
+    requests return the running session instead of double-loading
+    weights."""
+    with _lock:
+        for s in _sessions.values():
+            if s["model"] == model and s["status"] == "running":
+                return s["id"]
+    session_id = uuid.uuid4().hex[:12]
+    with _lock:
+        # bounded history: drop the oldest finished sessions
+        finished = [
+            k for k, s in _sessions.items() if s["status"] != "running"
+        ]
+        for k in finished[: max(0, len(_sessions) - MAX_SESSIONS_KEPT)]:
+            del _sessions[k]
+        _sessions[session_id] = {
+            "id": session_id, "model": model, "status": "running",
+            "log": [], "started_at": utc_now(),
+        }
+
+    def log(line: str) -> None:
+        with _lock:
+            _sessions[session_id]["log"].append(line)
+        event_bus.emit(
+            "tpu:provision", "tpu-model",
+            {"session": session_id, "line": line},
+        )
+
+    def run() -> None:
+        try:
+            log(f"checking hardware gate for {model}...")
+            status = get_tpu_status(model)
+            for c in status["checks"]:
+                log(f"  [{'ok' if c['ok'] else 'FAIL'}] {c['name']}: "
+                    f"{c['detail']}")
+            if not status["ready"]:
+                raise RuntimeError("hardware gate failed")
+            log("bringing up model host (mesh + weights)...")
+            t0 = time.monotonic()
+            host = get_model_host(model)
+            host.engine()  # builds params, shards, starts scheduler
+            log(f"model host ready in {time.monotonic()-t0:.1f}s")
+            with _lock:
+                _sessions[session_id]["status"] = "success"
+            event_bus.emit("tpu:provisioned", "tpu-model",
+                           {"session": session_id, "model": model})
+        except Exception as e:
+            log(f"provisioning failed: {e}")
+            with _lock:
+                _sessions[session_id]["status"] = "error"
+
+    threading.Thread(target=run, daemon=True,
+                     name=f"provision-{session_id}").start()
+    return session_id
+
+
+def get_provision_session(session_id: str) -> Optional[dict]:
+    with _lock:
+        s = _sessions.get(session_id)
+        return dict(s) if s else None
+
+
+def apply_tpu_model_to_all(
+    db: Database, model: str = "qwen3-coder-30b"
+) -> dict:
+    """Atomically point clerk + queens + room worker models at tpu:
+    (reference applyLocalModelToAll:568-619)."""
+    model_str = f"tpu:{model}"
+    with db.transaction():
+        set_setting(db, "clerk_model", model_str)
+        set_setting(db, "worker_model", model_str)
+        rooms = db.execute(
+            "UPDATE rooms SET worker_model=?, updated_at=?",
+            (model_str, utc_now()),
+        ).rowcount
+        queens = db.execute(
+            "UPDATE workers SET model=?, updated_at=? WHERE id IN "
+            "(SELECT queen_worker_id FROM rooms WHERE queen_worker_id "
+            "IS NOT NULL)",
+            (model_str, utc_now()),
+        ).rowcount
+    return {"model": model_str, "rooms": rooms, "queens": queens}

@@ -1,0 +1,123 @@
+"""ctypes bindings for the native vector-search core (native/vecsearch.cpp
+— the sqlite-vec replacement). Builds lazily via make on first use;
+everything degrades to numpy when the toolchain or artifact is missing."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libvecsearch.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _bind(path: str) -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.topk_cosine.restype = ctypes.c_int
+    lib.topk_cosine.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    return lib
+
+
+def _build_async() -> None:
+    """Compile in the background; callers use numpy until ready (the
+    build must never block a request path)."""
+    global _lib
+
+    def build():
+        global _lib
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                capture_output=True, timeout=120, check=True,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return
+        lib = _bind(_LIB_PATH)
+        with _lock:
+            _lib = lib
+
+    threading.Thread(target=build, daemon=True,
+                     name="vecsearch-build").start()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _tried:
+            return None
+        _tried = True
+        if os.path.exists(_LIB_PATH):
+            _lib = _bind(_LIB_PATH)
+            return _lib
+    _build_async()
+    return None
+
+
+def native_available(wait_s: float = 0.0) -> bool:
+    """True when the native library is bound. With wait_s, polls for a
+    background build to finish (tests use this; request paths don't)."""
+    import time
+
+    deadline = time.monotonic() + wait_s
+    while True:
+        if _load() is not None:
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.05)
+
+
+def topk_cosine(
+    matrix: np.ndarray, query: np.ndarray, k: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """(indices, scores) of the k most cosine-similar rows, descending.
+    Native when available, numpy otherwise — identical results."""
+    matrix = np.ascontiguousarray(matrix, dtype=np.float32)
+    query = np.ascontiguousarray(query, dtype=np.float32)
+    n = matrix.shape[0]
+    if n == 0 or k <= 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.float32)
+
+    lib = _load()
+    if lib is not None:
+        k_eff = min(k, n)
+        out_idx = np.zeros(k_eff, np.int32)
+        out_score = np.zeros(k_eff, np.float32)
+        got = lib.topk_cosine(
+            matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, matrix.shape[1],
+            query.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            k_eff,
+            out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_score.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out_idx[:got], out_score[:got]
+
+    qn = query / (np.linalg.norm(query) + 1e-9)
+    mn = matrix / (
+        np.linalg.norm(matrix, axis=1, keepdims=True) + 1e-9
+    )
+    sims = mn @ qn
+    order = np.argsort(-sims)[:k]
+    return order.astype(np.int32), sims[order].astype(np.float32)

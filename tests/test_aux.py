@@ -1,0 +1,265 @@
+"""Aux subsystem tests: templates, prompt sync, identity, watches,
+telemetry, supervisor, tpu manager, commentary, notifications, native
+top-k."""
+
+import os
+import signal
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from room_tpu.core import (
+    escalations, memory, messages, rooms, supervisor, telemetry, watches,
+    workers,
+)
+from room_tpu.core.identity import (
+    build_register_calldata, get_identity, metadata_data_uri,
+    register_room_identity,
+)
+from room_tpu.core.prompt_sync import (
+    export_worker_prompts, import_worker_prompts,
+)
+from room_tpu.core.templates import (
+    ROOM_TEMPLATES, WORKER_TEMPLATES, instantiate_room_template,
+)
+from room_tpu.providers import get_model_provider, reset_provider_cache
+from room_tpu.server.commentary import CommentaryEngine
+from room_tpu.server.notifications import collect_pending, relay_pending
+from room_tpu.server.tpu_manager import (
+    apply_tpu_model_to_all, get_tpu_status, model_weight_bytes,
+)
+from room_tpu.utils.native import native_available, topk_cosine
+
+
+# ---- templates ----
+
+def test_room_template_builds_full_team(db):
+    room = instantiate_room_template(db, "saas-builder",
+                                     worker_model="echo")
+    team = workers.list_room_workers(db, room["id"])
+    # queen + 4 template workers
+    assert len(team) == 5
+    roles = {w["role"] for w in team}
+    assert {"queen", "researcher", "executor", "guardian"} <= roles
+
+
+def test_unknown_template_raises(db):
+    with pytest.raises(KeyError):
+        instantiate_room_template(db, "nope")
+    assert len(ROOM_TEMPLATES) >= 3 and len(WORKER_TEMPLATES) >= 6
+
+
+# ---- prompt sync ----
+
+def test_prompt_export_import_roundtrip(db, tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    room = rooms.create_room(db, "r", create_wallet=False)
+    paths = export_worker_prompts(db, room["id"])
+    assert len(paths) == 1 and os.path.exists(paths[0])
+
+    # edit the file (newer mtime than the DB row) and re-import
+    with open(paths[0]) as f:
+        text = f.read()
+    assert text.startswith("---\n")
+    new_text = text.rsplit("---\n", 1)[0] + "---\n\nEDITED PROMPT\n"
+    time.sleep(0.01)
+    with open(paths[0], "w") as f:
+        f.write(new_text)
+    future = time.time() + 5
+    os.utime(paths[0], (future, future))
+    out = import_worker_prompts(db, room["id"])
+    assert out["applied"], out
+    queen = workers.get_worker(db, room["queen_worker_id"])
+    assert queen["system_prompt"] == "EDITED PROMPT"
+
+    # stale file (older than db) is skipped unless forced
+    past = time.time() - 3600
+    os.utime(paths[0], (past, past))
+    with open(paths[0], "w") as f:
+        f.write(text.rsplit("---\n", 1)[0] + "---\n\nSTALE\n")
+    os.utime(paths[0], (past, past))
+    out = import_worker_prompts(db, room["id"])
+    assert not out["applied"]
+    out = import_worker_prompts(db, room["id"], force=True)
+    assert out["applied"]
+
+
+# ---- identity ----
+
+def test_identity_calldata_and_metadata(db):
+    room = rooms.create_room(db, "chainy", goal="do things")
+    ident = get_identity(db, room["id"])
+    assert ident["address"].startswith("0x")
+    assert not ident["registered"]
+    out = register_room_identity(db, room["id"], dry_run=True)
+    tx = out["tx"]
+    assert tx["data"].startswith("0x")
+    uri = metadata_data_uri(out["metadata"])
+    assert uri.startswith("data:application/json;base64,")
+    # calldata embeds the uri
+    assert uri.encode().hex() in tx["data"]
+
+
+# ---- watches ----
+
+def test_watch_path_validation():
+    assert watches.validate_watch_path("~/projects") is None
+    assert watches.validate_watch_path("/tmp/x") is None
+    assert watches.validate_watch_path("/etc/passwd") is not None
+    assert watches.validate_watch_path("~/.ssh/id_rsa") is not None
+
+
+def test_watch_fires_task_on_change(db, tmp_path):
+    target = tmp_path / "watched.txt"
+    target.write_text("v1")
+    # tmp_path is under /tmp on this host
+    wid = watches.create_watch(
+        db, str(target), "summarize the change",
+    )
+    rt = watches.WatchRuntime(db)
+    assert rt.poll_once() == 0        # baseline pass
+    time.sleep(0.01)
+    target.write_text("v2 changed")
+    assert rt.poll_once() == 1
+    w = db.query_one("SELECT * FROM watches WHERE id=?", (wid,))
+    assert w["trigger_count"] == 1
+    task = db.query_one("SELECT * FROM tasks ORDER BY id DESC LIMIT 1")
+    assert "watched.txt" in task["name"]
+    assert rt.poll_once() == 0        # no re-fire without change
+
+
+# ---- telemetry ----
+
+def test_telemetry_disabled_without_token(db, monkeypatch):
+    monkeypatch.delenv("ROOM_TPU_TELEMETRY_TOKEN", raising=False)
+    assert not telemetry.telemetry_enabled()
+    assert not telemetry.submit_crash_report(db, ValueError("x"))
+    assert not telemetry.submit_heartbeat(db)
+    assert len(telemetry.get_machine_id()) == 12
+
+
+# ---- supervisor ----
+
+def test_supervisor_tree_kill():
+    # parent shell that spawns a child sleep
+    proc = supervisor.spawn_managed(
+        ["/bin/sh", "-c", "sleep 300 & wait"], label="test-tree"
+    )
+    time.sleep(0.3)
+    descendants = supervisor._descendants(proc.pid)
+    assert descendants, "child sleep not found"
+    n = supervisor.terminate_managed_processes(grace_s=1.0)
+    assert n == 1
+    time.sleep(0.2)
+    assert not supervisor._alive(proc.pid)
+    assert proc.pid not in supervisor.managed_processes()
+    proc.wait(timeout=5)
+
+
+# ---- tpu manager ----
+
+def test_tpu_status_gate(monkeypatch):
+    monkeypatch.delenv("ROOM_TPU_CKPT_DIR", raising=False)
+    monkeypatch.delenv("ROOM_TPU_ALLOW_RANDOM_INIT", raising=False)
+    st = get_tpu_status("qwen3-coder-30b")
+    names = {c["name"] for c in st["checks"]}
+    assert {"accelerator", "hbm", "disk", "weights"} <= names
+    weights = next(c for c in st["checks"] if c["name"] == "weights")
+    assert not weights["ok"]          # fail-closed without a checkpoint
+    st2 = get_tpu_status("tiny-moe")
+    assert st2["ready"]
+    # the 30B MoE weight estimate lands in a sane range (50-70 GB bf16)
+    gb = model_weight_bytes("qwen3-coder-30b") / 1e9
+    assert 40 < gb < 80
+
+
+def test_apply_tpu_model_to_all(db):
+    r1 = rooms.create_room(db, "a", create_wallet=False)
+    r2 = rooms.create_room(db, "b", create_wallet=False)
+    out = apply_tpu_model_to_all(db, "qwen3-coder-30b")
+    assert out["rooms"] == 2 and out["queens"] == 2
+    assert rooms.get_room(db, r1["id"])["worker_model"] == \
+        "tpu:qwen3-coder-30b"
+    assert messages.get_setting(db, "clerk_model") == \
+        "tpu:qwen3-coder-30b"
+
+
+# ---- commentary + notifications ----
+
+def test_commentary_narrates_buffered_events(db):
+    reset_provider_cache()
+    echo = get_model_provider("echo")
+    echo.responses.append("The queen delegates — the hive is buzzing!")
+    engine = CommentaryEngine(db, model="echo")
+    engine._on_event(type("E", (), {
+        "type": "cycle:log", "channel": "cycle:1",
+        "data": {"entry_type": "assistant", "content": "planning..."},
+    })())
+    text = engine.narrate_once()
+    assert text == "The queen delegates — the hive is buzzing!"
+    row = db.query_one(
+        "SELECT * FROM clerk_messages WHERE role='commentary'"
+    )
+    assert row is not None
+    usage = db.query_one(
+        "SELECT * FROM clerk_usage WHERE source='commentary'"
+    )
+    assert usage is not None
+    # empty buffer -> no narration
+    assert engine.narrate_once() is None
+
+
+def test_notification_digest_and_cursors(db):
+    room = rooms.create_room(db, "hive", create_wallet=False)
+    escalations.create_escalation(db, room["id"], "need budget approval")
+    messages.add_chat_message(db, room["id"], "assistant",
+                              "weekly summary ready")
+    digest = relay_pending(db)
+    assert "escalation" in digest and "weekly summary" in digest
+    # cursors advanced: nothing new -> no digest
+    assert relay_pending(db) is None
+    escalations.create_escalation(db, room["id"], "urgent: prod down")
+    pending = collect_pending(db)
+    assert pending["urgent"]
+    assert relay_pending(db) is not None
+
+
+# ---- native ----
+
+def test_native_topk_matches_numpy():
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((500, 64)).astype(np.float32)
+    q = rng.standard_normal(64).astype(np.float32)
+    idx, scores = topk_cosine(M, q, 7)
+    mn = M / np.linalg.norm(M, axis=1, keepdims=True)
+    ref = np.argsort(-(mn @ (q / np.linalg.norm(q))))[:7]
+    assert list(idx) == list(ref)
+    assert native_available() in (True, False)  # works either way
+    # degenerate cases
+    i2, s2 = topk_cosine(np.zeros((0, 8), np.float32), q[:8], 3)
+    assert len(i2) == 0
+
+
+def test_watch_denies_nested_protected_paths():
+    assert watches.validate_watch_path(
+        "~/.config/gcloud/application_default_credentials.json"
+    ) is not None
+    assert watches.validate_watch_path("~/.ssh/config") is not None
+    assert watches.validate_watch_path("~/.config/someapp/ok.txt") is None
+
+
+def test_provision_dedupes_running_sessions():
+    from room_tpu.server import tpu_manager
+
+    a = tpu_manager.start_provision_session("tiny-dense")
+    b = tpu_manager.start_provision_session("tiny-dense")
+    assert a == b  # second request joins the running session
+    for _ in range(200):
+        s = tpu_manager.get_provision_session(a)
+        if s["status"] != "running":
+            break
+        time.sleep(0.1)
+    from room_tpu.providers.tpu import reset_model_hosts
+    reset_model_hosts()
