@@ -44,11 +44,22 @@ def start_server(
 ) -> ServerApp:
     db = db or get_database()
     runtime = start_server_runtime(db)
+    if static_dir is None:
+        static_dir = os.environ.get("ROOM_TPU_STATIC_DIR")
+    if static_dir is None:
+        bundled = os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
+            "ui",
+        )
+        if os.path.isdir(bundled):
+            static_dir = bundled
     api = ApiServer(
         db,
         runtime=runtime,
         port=port,
-        static_dir=static_dir or os.environ.get("ROOM_TPU_STATIC_DIR"),
+        static_dir=static_dir,
         cloud_mode=os.environ.get("ROOM_TPU_DEPLOYMENT_MODE") == "cloud",
     )
     api.start()

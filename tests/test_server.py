@@ -312,3 +312,57 @@ def test_start_room_twice_keeps_loop_alive(server):
         time.sleep(0.05)
     assert alive, "no live loop after restart"
     req(server, "POST", f"/api/rooms/{room_id}/stop")
+
+
+def test_dashboard_served_and_wired(server, tmp_path):
+    """The bundled SPA serves at / and only references API routes that
+    exist on this server."""
+    import re as _re
+
+    server.static_dir = os.path.join(
+        os.path.dirname(__file__), os.pardir, "ui"
+    )
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}/", timeout=5
+    ) as resp:
+        html = resp.read().decode()
+    assert "room-tpu" in html
+    # every /api path the page references — double-quoted literals AND
+    # template literals like `/api/rooms/${id}/chat` — must match a
+    # registered route (params substituted with 1)
+    refs = set(_re.findall(r'["`](/api/[a-z\-/${}]+)', html))
+    assert any("${" in m for m in refs), "template-literal routes missed"
+    for m in refs:
+        if m == "/api/auth/handshake":
+            continue  # handled before the router
+        path = m.replace("${action}", "start")
+        path = _re.sub(r"\$\{[a-z]+\}", "1", path).rstrip("/")
+        found = any(
+            server.router.match(method, path)
+            for method in ("GET", "POST", "PUT", "DELETE")
+        )
+        assert found, f"dashboard references unknown route {m}"
+
+
+def test_hetero_two_models_serve_concurrently(server):
+    """BASELINE config #5 shape: two model hosts (worker MoE + queen
+    dense) serving turns side by side."""
+    from room_tpu.providers import ExecutionRequest
+    from room_tpu.providers.tpu import TpuProvider, reset_model_hosts
+
+    reset_model_hosts()
+    try:
+        moe = TpuProvider("tiny-moe")
+        dense = TpuProvider("tiny-dense")
+        r1 = moe.execute(ExecutionRequest(
+            prompt="worker turn", max_new_tokens=4, max_turns=1,
+            timeout_s=300,
+        ))
+        r2 = dense.execute(ExecutionRequest(
+            prompt="queen turn", max_new_tokens=4, max_turns=1,
+            timeout_s=300,
+        ))
+        assert r1.success and r2.success
+        assert r1.output_tokens > 0 and r2.output_tokens > 0
+    finally:
+        reset_model_hosts()
