@@ -139,15 +139,16 @@ class ServingEngine:
 
     # ---- jitted device functions ----
 
-    def _prefill_fn(self, bucket: int):
-        key = ("prefill", bucket)
+    def _prefill_fn(self, bucket: int, fresh: bool):
+        key = ("prefill", bucket, fresh)
         if key not in self._jit_cache:
             cfg = self.cfg
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill(params, cache, tokens, block_table, length):
                 hook = make_paged_kv_hook(
-                    block_table, length, self.page_size
+                    block_table, length, self.page_size,
+                    fresh_prefill=fresh,
                 )
                 positions = length[:, None] + jnp.arange(tokens.shape[1])
                 logits, cache = qwen3.forward(
@@ -320,7 +321,7 @@ class ServingEngine:
 
         toks = np.full((bucket,), self.tokenizer.pad_id, np.int32)
         toks[: len(prompt)] = prompt
-        prefill = self._prefill_fn(bucket)
+        prefill = self._prefill_fn(bucket, fresh=sess.length == 0)
         logits, self.cache = prefill(
             self.params,
             self.cache,

@@ -55,13 +55,17 @@ def make_paged_kv_hook(
     lengths: jax.Array,        # [B] tokens already in cache per sequence
     page_size: int,
     pallas_decode: Optional[bool] = None,
+    fresh_prefill: bool = False,
 ):
     """Build the kv_hook used by models.qwen3.forward: writes the chunk's
     k/v into the page pool and attends over (prefix + chunk).
 
     Works for single-token decode (S=1) and chunked prefill (S>1) alike.
     Single-token decode can route through the Pallas paged-attention
-    kernel (no dense gather); prefill always uses the XLA path.
+    kernel (no dense gather). ``fresh_prefill`` is a static promise that
+    every sequence starts at length 0, so attention runs over the chunk
+    itself and the page gather is skipped entirely — the common
+    new-session prefill does no cache reads at all.
     """
     b, max_pages = block_tables.shape
     if pallas_decode is None:
@@ -83,6 +87,14 @@ def make_paged_kv_hook(
         vp = layer_cache["v_pages"].at[flat_pages, flat_off].set(
             v.reshape(-1, *v.shape[2:])
         )
+
+        if fresh_prefill:
+            positions_q = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            attn = attention_ref(
+                q, k, v, causal=True,
+                q_positions=positions_q, kv_positions=positions_q,
+            )
+            return attn, {"k_pages": kp, "v_pages": vp}
 
         if s == 1 and pallas_decode:
             from ..ops.paged_attention import paged_attention_decode

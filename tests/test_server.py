@@ -314,7 +314,7 @@ def test_start_room_twice_keeps_loop_alive(server):
     req(server, "POST", f"/api/rooms/{room_id}/stop")
 
 
-def test_dashboard_served_and_wired(server, tmp_path):
+def test_dashboard_served_and_wired(server):
     """The bundled SPA serves at / and only references API routes that
     exist on this server."""
     import re as _re
@@ -335,13 +335,17 @@ def test_dashboard_served_and_wired(server, tmp_path):
     for m in refs:
         if m == "/api/auth/handshake":
             continue  # handled before the router
-        path = m.replace("${action}", "start")
-        path = _re.sub(r"\$\{[a-z]+\}", "1", path).rstrip("/")
-        found = any(
-            server.router.match(method, path)
-            for method in ("GET", "POST", "PUT", "DELETE")
+        actions = (
+            ("start", "stop", "pause") if "${action}" in m else (None,)
         )
-        assert found, f"dashboard references unknown route {m}"
+        for action in actions:
+            path = m.replace("${action}", action) if action else m
+            path = _re.sub(r"\$\{[a-z]+\}", "1", path).rstrip("/")
+            found = any(
+                server.router.match(method, path)
+                for method in ("GET", "POST", "PUT", "DELETE")
+            )
+            assert found, f"dashboard references unknown route {path}"
 
 
 def test_hetero_two_models_serve_concurrently(server):
@@ -366,3 +370,24 @@ def test_hetero_two_models_serve_concurrently(server):
         assert r1.output_tokens > 0 and r2.output_tokens > 0
     finally:
         reset_model_hosts()
+
+
+def test_start_server_defaults_to_bundled_ui(tmp_path, monkeypatch):
+    """The serve entry point must resolve the bundled ui/ dir on its
+    own (the other dashboard test sets static_dir by hand)."""
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    monkeypatch.delenv("ROOM_TPU_STATIC_DIR", raising=False)
+    from room_tpu.server import runtime as rt_mod
+    from room_tpu.server.app import start_server
+
+    rt_mod._runtime = None  # isolate from other tests' singleton
+    app = start_server(port=0, db=Database(":memory:"))
+    try:
+        assert app.api.static_dir and app.api.static_dir.endswith("ui")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/", timeout=5
+        ) as resp:
+            assert b"room-tpu" in resp.read()
+    finally:
+        app.stop()
+        rt_mod._runtime = None
