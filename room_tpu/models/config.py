@@ -34,6 +34,10 @@ class DecoderConfig:
     top_k: int = 8
     moe_intermediate: int = 0
     norm_topk_prob: bool = True
+    # "ragged": sort + lax.ragged_dot (best single-chip / dp+tp).
+    # "gshard": capacity-based dense dispatch — partitions expert compute
+    # over the ep mesh axis with only activation psums.
+    moe_impl: str = "ragged"
     dtype: str = "bfloat16"
     max_seq_len: int = 32768
 

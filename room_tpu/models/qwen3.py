@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import apply_rope, attention_ref, moe_ffn, rms_norm, rope_angles, swiglu
+from ..ops import (
+    apply_rope, attention_ref, moe_ffn, moe_ffn_gshard, rms_norm,
+    rope_angles, swiglu,
+)
 from .config import DecoderConfig
 
 Params = dict[str, Any]
@@ -169,9 +172,14 @@ def _layer(
 
     h = rms_norm(x, lp["ln2"], cfg.rms_eps)
     if cfg.is_moe:
-        flat = h.reshape(b * s, d)
-        y = moe_ffn(
-            flat, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+        if cfg.moe_impl not in ("ragged", "gshard"):
+            raise ValueError(
+                f"unknown moe_impl {cfg.moe_impl!r} (ragged|gshard)"
+            )
+        moe = moe_ffn_gshard if cfg.moe_impl == "gshard" else moe_ffn
+        y = moe(
+            h.reshape(b * s, d), lp["router"], lp["w_gate"], lp["w_up"],
+            lp["w_down"],
             top_k=cfg.top_k, renormalize=cfg.norm_topk_prob,
         ).reshape(b, s, d)
     else:

@@ -2,6 +2,7 @@ from .core import (
     apply_rope,
     attention_ref,
     moe_ffn,
+    moe_ffn_gshard,
     rms_norm,
     rope_angles,
     swiglu,
@@ -11,6 +12,7 @@ __all__ = [
     "apply_rope",
     "attention_ref",
     "moe_ffn",
+    "moe_ffn_gshard",
     "rms_norm",
     "rope_angles",
     "swiglu",

@@ -134,13 +134,7 @@ def moe_ffn(
     """
     t, d = x.shape
     e = router_w.shape[-1]
-    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
-                        router_w.astype(jnp.float32))
-    weights, chosen = jax.lax.top_k(logits, top_k)  # [T, K]
-    weights = jax.nn.softmax(weights, axis=-1) if renormalize else \
-        jax.nn.softmax(logits, axis=-1)[
-            jnp.arange(t)[:, None], chosen
-        ]
+    weights, chosen = _route(x, router_w, top_k, renormalize)
 
     flat_expert = chosen.reshape(-1)              # [T*K]
     order = jnp.argsort(flat_expert)              # stable
@@ -161,4 +155,102 @@ def moe_ffn(
     out = out.at[token_of_row].add(
         y.astype(jnp.float32) * w_sorted[:, None].astype(jnp.float32)
     )
+    return out.astype(x.dtype)
+
+
+def _route(
+    x: jax.Array, router_w: jax.Array, top_k: int, renormalize: bool
+) -> tuple[jax.Array, jax.Array]:
+    """Shared router: returns (gates [T, K] f32, chosen [T, K] int)."""
+    t = x.shape[0]
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    top_vals, chosen = jax.lax.top_k(logits, top_k)
+    gates = jax.nn.softmax(top_vals, axis=-1) if renormalize else \
+        jax.nn.softmax(logits, axis=-1)[
+            jnp.arange(t)[:, None], chosen
+        ]
+    return gates, chosen
+
+
+GSHARD_GROUP_SIZE = 128
+
+
+def moe_ffn_gshard(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int,
+    renormalize: bool = True,
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    """GShard-style capacity-based MoE: dense dispatch/combine einsums.
+
+    Unlike the sort+ragged_dot path (best on one chip), every tensor here
+    carries an explicit expert axis, so sharding the expert weights over
+    the ``ep`` mesh axis partitions the expert FFN compute directly and
+    the combine einsum reduces to one activation psum — no expert-weight
+    all-gather.
+
+    Tokens are processed in fixed-size groups so dispatch memory/compute
+    stay LINEAR in token count (capacity is per-group, independent of
+    T). Within a group, capacity has a floor of min(group, 8) so
+    decode-sized batches never drop on collisions; beyond capacity,
+    tokens are dropped (zero FFN contribution), standard Switch/GShard
+    semantics.
+    """
+    t, d = x.shape
+    e = router_w.shape[-1]
+    group = min(t, GSHARD_GROUP_SIZE)
+    n_groups = -(-t // group)
+    padded = n_groups * group
+    capacity = max(
+        int(group * top_k * capacity_factor / e), min(group, 8)
+    )
+
+    gates, chosen = _route(x, router_w, top_k, renormalize)
+    valid = (jnp.arange(padded) < t)
+
+    if padded != t:
+        x = jnp.pad(x, ((0, padded - t), (0, 0)))
+        gates = jnp.pad(gates, ((0, padded - t), (0, 0)))
+        chosen = jnp.pad(chosen, ((0, padded - t), (0, 0)))
+    # padded rows must not claim capacity slots
+    gates = gates * valid[:, None]
+
+    xg = x.reshape(n_groups, group, d)
+    gates_g = gates.reshape(n_groups, group, top_k)
+    assign = jax.nn.one_hot(
+        chosen.reshape(n_groups, group, top_k), e, dtype=jnp.float32
+    ) * (valid.reshape(n_groups, group, 1, 1))        # [G, g, K, E]
+
+    # per-group slot position of each (token, k) within its expert
+    flat = assign.reshape(n_groups, group * top_k, e)
+    position = (jnp.cumsum(flat, axis=1) - flat).reshape(
+        n_groups, group, top_k, e
+    )
+    in_capacity = (position < capacity) & (assign > 0)
+    slot_onehot = jax.nn.one_hot(
+        position.astype(jnp.int32), capacity, dtype=jnp.float32
+    ) * in_capacity[..., None]                        # [G, g, K, E, C]
+
+    dispatch = slot_onehot.sum(axis=2)                # [G, g, E, C] 0/1
+    combine = jnp.einsum(
+        "gtk,gtkec->gtec", gates_g.astype(jnp.float32), slot_onehot
+    )
+
+    xe = jnp.einsum(
+        "gtec,gtd->gecd", dispatch, xg.astype(jnp.float32)
+    ).astype(x.dtype)
+    g_ = jnp.einsum("gecd,edf->gecf", xe, w_gate)
+    u = jnp.einsum("gecd,edf->gecf", xe, w_up)
+    h = (jax.nn.silu(g_.astype(jnp.float32)) *
+         u.astype(jnp.float32)).astype(x.dtype)
+    y = jnp.einsum("gecf,efd->gecd", h, w_down)
+    out = jnp.einsum(
+        "gtec,gecd->gtd", combine, y.astype(jnp.float32)
+    ).reshape(padded, d)[:t]
     return out.astype(x.dtype)

@@ -162,3 +162,34 @@ def test_sharded_decode_with_cache():
     )
     assert logits.shape == (4, cfg.vocab_size)
     assert int(cache["lengths"][0]) == 6
+
+
+def test_gshard_moe_matches_ragged_in_model():
+    """Full model forward with moe_impl=gshard equals the ragged path
+    (generous capacity; same weights)."""
+    import dataclasses
+
+    cfg = tiny_moe()
+    cfg_g = dataclasses.replace(cfg, moe_impl="gshard")
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 6), 0,
+                                cfg.vocab_size)
+    want, _ = qwen3.forward(params, cfg, tokens)
+    got, _ = qwen3.forward(params, cfg_g, tokens)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_gshard_model_shards_over_ep():
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_moe(), moe_impl="gshard")
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshSpec(dp=1, ep=4, tp=2))
+    sharded = shard_pytree(params, decoder_param_specs(cfg), mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 0,
+                                cfg.vocab_size)
+    want, _ = qwen3.forward(params, cfg, tokens)
+    got = jax.jit(lambda p, t: qwen3.forward(p, cfg, t)[0])(
+        sharded, tokens
+    )
+    np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
