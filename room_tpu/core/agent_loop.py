@@ -49,6 +49,10 @@ from .rate_limit import clamp_wait
 WIP_MOMENTUM_GAP_S = 10.0
 STUCK_CYCLE_WINDOW = 5
 
+# execution-plane tools: fine for workers, a logged deviation when the
+# queen runs them herself instead of delegating
+QUEEN_DEVIATION_TOOLS = {"web_fetch", "web_search"}
+
 
 @dataclass
 class LoopHandle:
@@ -260,6 +264,18 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
         def on_tool_call(name: str, args: dict) -> str:
             logs.append("tool_call", json.dumps({"name": name,
                                                  "args": args}))
+            if is_queen and name in QUEEN_DEVIATION_TOOLS:
+                # control-plane contract: the queen plans and delegates;
+                # doing execution work herself is logged as a deviation
+                # (reference "Model B" policy, agent-loop.ts:22-28,699-728)
+                from .activity import log_room_activity
+
+                log_room_activity(
+                    db, room["id"], "deviation",
+                    f"Queen executed {name} directly instead of "
+                    "delegating",
+                    actor_id=worker["id"], is_public=False,
+                )
             out = execute_queen_tool(db, room["id"], worker["id"], name,
                                      args)
             logs.append("tool_result", out[:2000])

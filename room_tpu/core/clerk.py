@@ -173,10 +173,7 @@ def _dispatch(db: Database, name: str, args: dict, runtime) -> str:
         tid = task_runner.create_task(
             db, f"reminder: {args['text'][:40]}", args["text"],
             trigger_type="once", scheduled_at=args["at"],
-        )
-        db.execute(
-            "UPDATE tasks SET executor='keeper_reminder' WHERE id=?",
-            (tid,),
+            executor="keeper_reminder",
         )
         return f"reminder #{tid} scheduled for {args['at']}"
     if name == "message_room":

@@ -97,6 +97,7 @@ def create_task(
     description: Optional[str] = None,
     timeout_minutes: Optional[int] = None,
     max_turns: Optional[int] = None,
+    executor: str = "agent",
 ) -> int:
     if trigger_type == "cron":
         from .cron import validate_cron
@@ -110,12 +111,12 @@ def create_task(
         "INSERT INTO tasks(name, description, prompt, cron_expression, "
         "trigger_type, webhook_token, room_id, worker_id, "
         "session_continuity, scheduled_at, max_runs, timeout_minutes, "
-        "max_turns) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)",
+        "max_turns, executor) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
         (
             name, description, prompt, cron_expression, trigger_type,
             _secrets.token_urlsafe(16), room_id, worker_id,
             int(session_continuity), scheduled_at, max_runs,
-            timeout_minutes, max_turns,
+            timeout_minutes, max_turns, executor,
         ),
     )
 

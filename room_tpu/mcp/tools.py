@@ -481,3 +481,121 @@ def system_resources(db, args):
     except OSError:
         pass
     return _j(out)
+
+
+# ---- templates / watches / identity / web / payments ----
+
+@tool("template_list", "Available room and worker templates.")
+def template_list(db, args):
+    from ..core.templates import ROOM_TEMPLATES, WORKER_TEMPLATES
+
+    return _j({
+        "rooms": [
+            {"key": t.key, "name": t.name, "goal": t.goal}
+            for t in ROOM_TEMPLATES.values()
+        ],
+        "workers": [
+            {"key": t.key, "name": t.name, "role": t.role,
+             "description": t.description}
+            for t in WORKER_TEMPLATES.values()
+        ],
+    })
+
+
+@tool(
+    "template_instantiate", "Create a room from a template.",
+    {"template": {"type": "string", "required": True},
+     "name": {"type": "string"}},
+)
+def template_instantiate(db, args):
+    from ..core.templates import instantiate_room_template
+
+    try:
+        room = instantiate_room_template(
+            db, args["template"], name=args.get("name")
+        )
+    except KeyError as e:
+        return str(e.args[0])  # str(KeyError) wraps in quotes
+    return f"room #{room['id']} '{room['name']}' created from template"
+
+
+@tool(
+    "watch_create",
+    "Watch a file/directory; when it changes, run the action prompt as "
+    "a one-time task.",
+    {"path": {"type": "string", "required": True},
+     "action_prompt": {"type": "string", "required": True},
+     "room_id": {"type": "integer"}},
+)
+def watch_create(db, args):
+    from ..core.watches import create_watch
+
+    try:
+        wid = create_watch(
+            db, args["path"], args["action_prompt"],
+            room_id=args.get("room_id"),
+        )
+    except ValueError as e:
+        return str(e)
+    return f"watch #{wid} created"
+
+
+@tool("watch_list", "List file watches.", {"room_id": {"type": "integer"}})
+def watch_list(db, args):
+    from ..core.watches import list_watches
+
+    return _j(list_watches(db, args.get("room_id")))
+
+
+@tool(
+    "identity_info", "Room's on-chain identity status (ERC-8004).",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def identity_info(db, args):
+    from ..core.identity import get_identity
+
+    ident = get_identity(db, int(args["room_id"]))
+    return _j(ident) if ident else "room has no wallet"
+
+
+@tool(
+    "web_fetch", "Fetch a URL and return readable text.",
+    {"url": {"type": "string", "required": True}},
+)
+def mcp_web_fetch(db, args):
+    from ..core.web_tools import web_fetch
+
+    return web_fetch(args["url"])
+
+
+@tool(
+    "web_search", "Search the web (returns titles/urls/snippets).",
+    {"query": {"type": "string", "required": True}},
+)
+def mcp_web_search(db, args):
+    from ..core.web_tools import web_search
+
+    return web_search(args["query"])
+
+
+@tool(
+    "payment_audit", "Audit a room's wallet transaction history.",
+    {"room_id": {"type": "integer", "required": True}},
+)
+def payment_audit(db, args):
+    from ..core import wallet as wallet_mod
+
+    w = wallet_mod.get_room_wallet(db, int(args["room_id"]))
+    if w is None:
+        return "room has no wallet"
+    txs = wallet_mod.list_transactions(db, w["id"])
+    total_out = sum(
+        float(t["amount"]) for t in txs
+        if t["type"] == "send" and t["status"] == "confirmed"
+    )
+    return _j({
+        "address": w["address"],
+        "transaction_count": len(txs),
+        "confirmed_outbound_total": total_out,
+        "transactions": txs[:20],
+    })

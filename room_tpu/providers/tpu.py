@@ -147,6 +147,27 @@ def reset_model_hosts() -> None:
         _hosts.clear()
 
 
+def engines_snapshot() -> dict[str, dict]:
+    """Public stats view over the live model hosts (for /api/tpu/engines
+    and monitoring) — takes the registry lock, never exposes internals."""
+    with _hosts_lock:
+        hosts = dict(_hosts)
+    out: dict[str, dict] = {}
+    for name, host in hosts.items():
+        engine = host._engine
+        if engine is None:
+            out[name] = {"status": "cold"}
+        else:
+            out[name] = {
+                "status": "serving",
+                **engine.stats(),
+                "free_pages": engine.page_table.free_pages,
+                "sessions": len(engine.sessions),
+                "max_batch": engine.max_batch,
+            }
+    return out
+
+
 class TpuProvider:
     def __init__(self, model_name: str) -> None:
         self.name = "tpu"

@@ -77,7 +77,7 @@ def register_aux_routes(r: Router) -> None:
                 worker_model=(ctx.body or {}).get("workerModel", "tpu"),
             )
         except KeyError as e:
-            return err(str(e), 404)
+            return err(str(e.args[0]), 404)
         return ok(room, 201)
 
     def identity(ctx):
@@ -185,6 +185,12 @@ def register_aux_routes(r: Router) -> None:
     r.delete("/api/watches/:id", delete_watch_route)
     r.post("/api/rooms/:id/prompts/export", export_prompts)
     r.post("/api/rooms/:id/prompts/import", import_prompts)
+    def engine_stats(ctx):
+        from ..providers.tpu import engines_snapshot
+
+        return ok(engines_snapshot())
+
+    r.get("/api/tpu/engines", engine_stats)
     r.get("/api/tpu/status", tpu_status)
     r.post("/api/tpu/provision", tpu_provision)
     r.get("/api/tpu/provision/:sid", tpu_session)

@@ -65,6 +65,31 @@ class ServerRuntime:
         self.notifications.start()
         self.cloud = CloudSync(self.db)
         self.cloud.start()
+        self._schedule_contact_checks()
+
+    def _schedule_contact_checks(self) -> None:
+        """First-boot keeper contact checks at day 1 and day 7
+        (reference: runtime.ts:217-242)."""
+        from datetime import datetime, timedelta, timezone
+
+        from ..core.messages import get_setting, set_setting
+        from ..core.task_runner import create_task
+
+        if get_setting(self.db, "contact_checks_scheduled"):
+            return
+        for days in (1, 7):
+            at = (
+                datetime.now(timezone.utc) + timedelta(days=days)
+            ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+            create_task(
+                self.db,
+                name=f"keeper contact check (day {days})",
+                prompt="verify the keeper has a reachable contact",
+                trigger_type="once",
+                scheduled_at=at,
+                executor="keeper_contact_check",
+            )
+        set_setting(self.db, "contact_checks_scheduled", utc_now())
         for target, interval in (
             (self.scheduler_tick, SCHEDULER_TICK_S),
             (self.maintenance_tick, MAINTENANCE_TICK_S),

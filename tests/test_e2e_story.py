@@ -1,0 +1,127 @@
+"""End-to-end story (the reference's e2e suite equivalent, over real
+HTTP): a keeper stands up a templated room, the swarm runs cycles with
+tool calls, governance and escalation flow through the API, the keeper
+answers, notifications digest, and the room winds down."""
+
+import json
+import time
+import urllib.request
+import urllib.error
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.providers import get_model_provider, reset_provider_cache
+from room_tpu.server.http import ApiServer
+from room_tpu.server.runtime import ServerRuntime
+from room_tpu.server.notifications import relay_pending
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    db = Database(":memory:")
+    runtime = ServerRuntime(db=db)
+    api = ApiServer(db, runtime=runtime, port=0)
+    api.start()
+    yield api
+    api.stop()
+    db.close()
+
+
+def req(server, method, path, body=None):
+    headers = {"Authorization": f"Bearer {server.tokens['agent']}"}
+    data = json.dumps(body).encode() if body is not None else None
+    if data:
+        headers["Content-Type"] = "application/json"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=data, headers=headers, method=method,
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_full_swarm_story(server):
+    reset_provider_cache()
+    echo = get_model_provider("echo")
+    echo.responses.clear()
+    echo.tool_script.clear()
+    db = server.db
+
+    # 1. keeper instantiates a templated room on the echo model
+    _, out = req(server, "POST", "/api/templates/instantiate",
+                 {"template": "research-desk", "workerModel": "echo"})
+    room_id = out["data"]["id"]
+    _, team = req(server, "GET", f"/api/rooms/{room_id}/workers")
+    assert len(team["data"]) == 4  # queen + 2 scouts + scribe
+    req(server, "PUT", f"/api/rooms/{room_id}",
+        {"workerModel": "echo"})
+
+    # 2. queen's first cycle: the scripted model delegates + remembers +
+    # escalates through real tool dispatch
+    scout_id = next(w["id"] for w in team["data"]
+                    if w["role"] == "researcher")
+    echo.tool_script.extend([
+        ("set_goal", {"description": "map the competitive landscape"}),
+        ("delegate", {"description": "collect competitor list",
+                      "worker_id": scout_id}),
+        ("remember", {"name": "research scope",
+                      "content": "focus on open-source rivals"}),
+        ("announce_decision", {"proposal": "publish weekly brief",
+                               "decision_type": "high_impact"}),
+        ("escalate_to_keeper", {"question": "budget for data access?"}),
+        ("save_wip", {"note": "kicked off landscape mapping"}),
+    ])
+    _, started = req(server, "POST", f"/api/rooms/{room_id}/start")
+    assert started["data"]["started"] == room_id
+
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        _, cycles = req(server, "GET", f"/api/rooms/{room_id}/cycles")
+        done = [c for c in cycles["data"] if c["status"] == "success"]
+        if done:
+            break
+        time.sleep(0.1)
+    assert done, "queen cycle never completed"
+    echo.tool_script.clear()
+
+    # 3. the tool calls took real effect
+    _, goals = req(server, "GET", f"/api/rooms/{room_id}/goals")
+    descs = json.dumps(goals["data"])
+    assert "competitive landscape" in descs
+    assert "competitor list" in descs
+    _, mem = req(server, "GET",
+                 f"/api/memory/search?q=research+scope&roomId={room_id}")
+    assert mem["data"]
+    _, dec = req(server, "GET", f"/api/rooms/{room_id}/decisions")
+    assert any(d["status"] == "announced" for d in dec["data"])
+    _, esc = req(server, "GET", "/api/escalations")
+    esc_id = next(e["id"] for e in esc["data"]
+                  if "budget" in e["question"])
+
+    # 4. a worker objects to the announced decision via the API
+    d_id = next(d["id"] for d in dec["data"]
+                if d["status"] == "announced")
+    _, obj = req(server, "POST", f"/api/decisions/{d_id}/object",
+                 {"workerId": scout_id, "reason": "too early"})
+    assert obj["data"]["status"] == "objected"
+
+    # 5. keeper answers the escalation; digest includes it beforehand
+    digest = relay_pending(db)
+    assert digest and "budget" in digest
+    _, ans = req(server, "POST", f"/api/escalations/{esc_id}/answer",
+                 {"answer": "yes, $50/month"})
+    assert ans["data"]["status"] == "answered"
+
+    # 6. activity + usage audit trails exist; wind down
+    _, act = req(server, "GET", f"/api/rooms/{room_id}/activity")
+    types = {a["event_type"] for a in act["data"]}
+    assert "delegate" in types and "decision" in types
+    _, usage = req(server, "GET", f"/api/rooms/{room_id}/usage")
+    assert usage["data"]["cycles"] >= 1
+    _, stopped = req(server, "POST", f"/api/rooms/{room_id}/stop")
+    assert stopped["status"] == 200
