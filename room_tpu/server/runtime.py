@@ -66,6 +66,17 @@ class ServerRuntime:
         self.cloud = CloudSync(self.db)
         self.cloud.start()
         self._schedule_contact_checks()
+        for target, interval in (
+            (self.scheduler_tick, SCHEDULER_TICK_S),
+            (self.maintenance_tick, MAINTENANCE_TICK_S),
+            (self.inbox_poll, INBOX_POLL_S),
+        ):
+            t = threading.Thread(
+                target=self._loop, args=(target, interval),
+                daemon=True, name=f"runtime-{target.__name__}",
+            )
+            t.start()
+            self.threads.append(t)
 
     def _schedule_contact_checks(self) -> None:
         """First-boot keeper contact checks at day 1 and day 7
@@ -80,7 +91,7 @@ class ServerRuntime:
         for days in (1, 7):
             at = (
                 datetime.now(timezone.utc) + timedelta(days=days)
-            ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+            ).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
             create_task(
                 self.db,
                 name=f"keeper contact check (day {days})",
@@ -90,17 +101,6 @@ class ServerRuntime:
                 executor="keeper_contact_check",
             )
         set_setting(self.db, "contact_checks_scheduled", utc_now())
-        for target, interval in (
-            (self.scheduler_tick, SCHEDULER_TICK_S),
-            (self.maintenance_tick, MAINTENANCE_TICK_S),
-            (self.inbox_poll, INBOX_POLL_S),
-        ):
-            t = threading.Thread(
-                target=self._loop, args=(target, interval),
-                daemon=True, name=f"runtime-{target.__name__}",
-            )
-            t.start()
-            self.threads.append(t)
 
     def stop(self) -> None:
         self.stop_event.set()

@@ -1,0 +1,178 @@
+"""Checkpoint converter: HuggingFace Qwen3-MoE / Qwen2 safetensors →
+room_tpu param tree (stacked layer axes), saved as an orbax tree the
+`tpu:` provider loads directly.
+
+Usage:
+    python -m room_tpu.utils.convert /path/to/hf-model \
+        /path/to/ckpts/qwen3-coder-30b --model qwen3-coder-30b
+
+The mapping is the inverse of models.qwen3.init_params' layout: per-layer
+HF tensors are transposed into [in, out] matmul orientation and stacked
+along a leading layer axis; MoE experts stack along the expert axis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from typing import Iterator
+
+import numpy as np
+
+from ..models import qwen3
+from ..models.config import DecoderConfig
+
+
+def _iter_safetensors(model_dir: str) -> Iterator[tuple[str, np.ndarray]]:
+    from safetensors import safe_open
+
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(
+            f"no .safetensors files under {model_dir}"
+        )
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def convert_hf_decoder(
+    model_dir: str, cfg: DecoderConfig, dtype: str = "bfloat16"
+):
+    """Returns the room_tpu param pytree as numpy (ml_dtypes for bf16)."""
+    import ml_dtypes
+
+    np_dtype = (
+        ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float32
+    )
+    L = cfg.n_layers
+
+    def zeros(shape):
+        return np.zeros(shape, np_dtype)
+
+    layers: dict[str, np.ndarray] = {
+        "wq": zeros((L, cfg.hidden, cfg.q_dim)),
+        "wk": zeros((L, cfg.hidden, cfg.kv_dim)),
+        "wv": zeros((L, cfg.hidden, cfg.kv_dim)),
+        "wo": zeros((L, cfg.q_dim, cfg.hidden)),
+        "ln1": zeros((L, cfg.hidden)),
+        "ln2": zeros((L, cfg.hidden)),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = zeros((L, cfg.q_dim))
+        layers["bk"] = zeros((L, cfg.kv_dim))
+        layers["bv"] = zeros((L, cfg.kv_dim))
+    if cfg.qk_norm:
+        layers["q_norm"] = zeros((L, cfg.head_dim))
+        layers["k_norm"] = zeros((L, cfg.head_dim))
+    if cfg.is_moe:
+        E, F = cfg.n_experts, cfg.moe_intermediate
+        layers["router"] = np.zeros((L, cfg.hidden, E), np.float32)
+        layers["w_gate"] = zeros((L, E, cfg.hidden, F))
+        layers["w_up"] = zeros((L, E, cfg.hidden, F))
+        layers["w_down"] = zeros((L, E, F, cfg.hidden))
+    else:
+        F = cfg.intermediate
+        layers["w_gate"] = zeros((L, cfg.hidden, F))
+        layers["w_up"] = zeros((L, cfg.hidden, F))
+        layers["w_down"] = zeros((L, F, cfg.hidden))
+
+    params: dict = {
+        "embed": zeros((cfg.vocab_size, cfg.hidden)),
+        "layers": layers,
+        "final_norm": zeros((cfg.hidden,)),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = zeros((cfg.hidden, cfg.vocab_size))
+
+    def put(target: np.ndarray, index, tensor: np.ndarray,
+            transpose: bool = False) -> None:
+        t = tensor.astype(np.float32)
+        if transpose:
+            t = t.T
+        target[index] = t.astype(target.dtype)
+
+    n_loaded = 0
+    for name, tensor in _iter_safetensors(model_dir):
+        n_loaded += 1
+        if name == "model.embed_tokens.weight":
+            put(params["embed"], slice(None), tensor)
+            continue
+        if name == "lm_head.weight":
+            if "lm_head" in params:
+                put(params["lm_head"], slice(None), tensor,
+                    transpose=True)
+            continue
+        if name == "model.norm.weight":
+            put(params["final_norm"], slice(None), tensor)
+            continue
+        parts = name.split(".")
+        if len(parts) < 4 or parts[1] != "layers":
+            continue
+        li = int(parts[2])
+        rest = ".".join(parts[3:])
+
+        # attention (HF Linear weights are [out, in] -> transpose)
+        simple = {
+            "self_attn.q_proj.weight": ("wq", True),
+            "self_attn.k_proj.weight": ("wk", True),
+            "self_attn.v_proj.weight": ("wv", True),
+            "self_attn.o_proj.weight": ("wo", True),
+            "self_attn.q_proj.bias": ("bq", False),
+            "self_attn.k_proj.bias": ("bk", False),
+            "self_attn.v_proj.bias": ("bv", False),
+            "self_attn.q_norm.weight": ("q_norm", False),
+            "self_attn.k_norm.weight": ("k_norm", False),
+            "input_layernorm.weight": ("ln1", False),
+            "post_attention_layernorm.weight": ("ln2", False),
+            "mlp.gate_proj.weight": ("w_gate", True),
+            "mlp.up_proj.weight": ("w_up", True),
+            "mlp.down_proj.weight": ("w_down", True),
+            "mlp.gate.weight": ("router", True),
+        }
+        if rest in simple:
+            key, transpose = simple[rest]
+            if key in layers:
+                put(layers[key], li, tensor, transpose=transpose)
+            continue
+        # MoE experts: mlp.experts.<e>.{gate,up,down}_proj.weight
+        if rest.startswith("mlp.experts."):
+            ep = rest.split(".")
+            ei = int(ep[2])
+            proj = ep[3]
+            key = {"gate_proj": "w_gate", "up_proj": "w_up",
+                   "down_proj": "w_down"}.get(proj)
+            if key is not None:
+                put(layers[key], (li, ei), tensor, transpose=True)
+            continue
+    if n_loaded == 0:
+        raise RuntimeError("no tensors read")
+    return params
+
+
+def main() -> int:
+    from .checkpoint import save_params
+    from ..providers.tpu import MODEL_CONFIGS
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("hf_dir")
+    ap.add_argument("out_dir")
+    ap.add_argument("--model", default="qwen3-coder-30b",
+                    choices=sorted(MODEL_CONFIGS))
+    args = ap.parse_args()
+
+    cfg = MODEL_CONFIGS[args.model]()
+    params = convert_hf_decoder(args.hf_dir, cfg, cfg.dtype)
+    save_params(args.out_dir, params)
+    total = sum(int(np.prod(v.shape)) for v in
+                __import__("jax").tree.leaves(params))
+    print(json.dumps({"model": args.model, "params": total,
+                      "out": args.out_dir}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

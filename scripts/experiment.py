@@ -1,0 +1,108 @@
+"""Live swarm benchmark harness (reference: scripts/experiment.js — the
+reference's only perf harness: isolated server, temp DB, N cycles across
+models, comparison table).
+
+Runs real rooms against an in-process server + runtime on an isolated
+temp database, measures cycle latency per model, and prints a table plus
+a JSON summary.
+
+Usage:
+    python scripts/experiment.py --models echo tpu:tiny-moe \
+        --workers 4 --cycles 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def run_config(model: str, n_workers: int, n_cycles: int) -> dict:
+    from room_tpu.core import agent_loop, rooms, workers
+    from room_tpu.db import Database
+
+    db = Database(":memory:")
+    room = rooms.create_room(
+        db, f"bench-{model.replace(':', '-')}", goal="benchmark run",
+        worker_model=model, create_wallet=False,
+    )
+    agent_loop.set_room_launch_enabled(room["id"], True)
+    team = [room["queen_worker_id"]]
+    for i in range(n_workers):
+        team.append(workers.create_worker(
+            db, f"w{i}", "benchmark worker", room_id=room["id"],
+            role="executor", model=model,
+        ))
+
+    latencies: list[float] = []
+    tokens_out = 0
+    errors = 0
+    wall_start = time.perf_counter()
+    for cycle_no in range(n_cycles):
+        for wid in team:
+            w = workers.get_worker(db, wid)
+            t0 = time.perf_counter()
+            try:
+                row = agent_loop.run_cycle(db, room, w)
+                latencies.append(time.perf_counter() - t0)
+                tokens_out += row["output_tokens"] or 0
+                if row["status"] != "success":
+                    errors += 1
+            except Exception:
+                errors += 1
+    wall = time.perf_counter() - wall_start
+
+    agent_loop.set_room_launch_enabled(room["id"], False)
+    db.close()
+    lat_sorted = sorted(latencies) or [0.0]
+    return {
+        "model": model,
+        "agents": len(team),
+        "cycles_run": len(latencies),
+        "errors": errors,
+        "p50_cycle_s": round(statistics.median(lat_sorted), 3),
+        "p90_cycle_s": round(
+            lat_sorted[int(0.9 * (len(lat_sorted) - 1))], 3
+        ),
+        "output_tokens": tokens_out,
+        "wall_s": round(wall, 2),
+        "tokens_per_s": round(tokens_out / wall, 1) if wall else 0.0,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--models", nargs="+", default=["echo"])
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--cycles", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ.setdefault("ROOM_TPU_DATA_DIR", tempfile.mkdtemp())
+
+    results = [
+        run_config(m, args.workers, args.cycles) for m in args.models
+    ]
+
+    cols = ("model", "agents", "cycles_run", "errors", "p50_cycle_s",
+            "p90_cycle_s", "output_tokens", "tokens_per_s")
+    widths = {c: max(len(c), *(len(str(r[c])) for r in results))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    print("  ".join("-" * widths[c] for c in cols))
+    for r in results:
+        print("  ".join(str(r[c]).ljust(widths[c]) for c in cols))
+    print(json.dumps({"results": results}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

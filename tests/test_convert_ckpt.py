@@ -1,0 +1,118 @@
+"""Checkpoint round-trip (orbax) and HF-weights converter mapping."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_dense, tiny_moe
+from room_tpu.utils.checkpoint import load_params, save_params
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt")
+    save_params(path, params)
+    like = qwen3.init_params(cfg, jax.random.PRNGKey(1))  # different values
+    restored = load_params(path, like=like)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _write_hf_safetensors(tmp_path, cfg, params):
+    """Reverse-map our param tree into HF tensor names/orientations."""
+    from safetensors.numpy import save_file
+
+    def T(x):  # safetensors writes raw buffers: transposes must be materialized
+        return np.ascontiguousarray(np.asarray(x, np.float32).T)
+
+    tensors = {}
+    tensors["model.embed_tokens.weight"] = np.asarray(
+        params["embed"], np.float32
+    )
+    tensors["model.norm.weight"] = np.asarray(
+        params["final_norm"], np.float32
+    )
+    tensors["lm_head.weight"] = T(params["lm_head"])
+    lp = params["layers"]
+    for li in range(cfg.n_layers):
+        p = f"model.layers.{li}"
+        tensors[f"{p}.self_attn.q_proj.weight"] = T(lp["wq"][li])
+        tensors[f"{p}.self_attn.k_proj.weight"] = T(lp["wk"][li])
+        tensors[f"{p}.self_attn.v_proj.weight"] = T(lp["wv"][li])
+        tensors[f"{p}.self_attn.o_proj.weight"] = T(lp["wo"][li])
+        tensors[f"{p}.input_layernorm.weight"] = np.asarray(
+            lp["ln1"][li], np.float32)
+        tensors[f"{p}.post_attention_layernorm.weight"] = np.asarray(
+            lp["ln2"][li], np.float32)
+        if cfg.qkv_bias:
+            tensors[f"{p}.self_attn.q_proj.bias"] = np.asarray(
+                lp["bq"][li], np.float32)
+            tensors[f"{p}.self_attn.k_proj.bias"] = np.asarray(
+                lp["bk"][li], np.float32)
+            tensors[f"{p}.self_attn.v_proj.bias"] = np.asarray(
+                lp["bv"][li], np.float32)
+        if cfg.qk_norm:
+            tensors[f"{p}.self_attn.q_norm.weight"] = np.asarray(
+                lp["q_norm"][li], np.float32)
+            tensors[f"{p}.self_attn.k_norm.weight"] = np.asarray(
+                lp["k_norm"][li], np.float32)
+        if cfg.is_moe:
+            tensors[f"{p}.mlp.gate.weight"] = T(lp["router"][li])
+            for ei in range(cfg.n_experts):
+                for hf, ours in (("gate_proj", "w_gate"),
+                                 ("up_proj", "w_up"),
+                                 ("down_proj", "w_down")):
+                    tensors[f"{p}.mlp.experts.{ei}.{hf}.weight"] = \
+                        T(lp[ours][li, ei])
+        else:
+            for hf, ours in (("gate_proj", "w_gate"), ("up_proj", "w_up"),
+                             ("down_proj", "w_down")):
+                tensors[f"{p}.mlp.{hf}.weight"] = T(lp[ours][li])
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+
+
+@pytest.mark.parametrize("cfg_fn", [tiny_moe, tiny_dense])
+def test_hf_converter_roundtrip(tmp_path, cfg_fn):
+    """Our params -> HF layout -> converter -> identical logits."""
+    from room_tpu.utils.convert import convert_hf_decoder
+
+    cfg = cfg_fn()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    _write_hf_safetensors(tmp_path, cfg, params)
+    converted = convert_hf_decoder(str(tmp_path), cfg, dtype="float32")
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                                cfg.vocab_size)
+    want, _ = qwen3.forward(params, cfg, tokens)
+    got, _ = qwen3.forward(
+        jax.tree.map(lambda x: np.asarray(x, np.float32), converted),
+        cfg, tokens,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_experiment_harness_runs():
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "scripts/experiment.py", "--models", "echo",
+         "--workers", "2", "--cycles", "2"],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo",
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    import json
+
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    r = summary["results"][0]
+    assert r["model"] == "echo"
+    assert r["cycles_run"] == 6 and r["errors"] == 0  # 3 agents x 2

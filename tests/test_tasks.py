@@ -224,3 +224,25 @@ def test_runtime_stale_cleanup(db, room):
     assert n >= 1
     stale = db.query("SELECT * FROM task_runs WHERE status='error'")
     assert stale and "stale" in stale[0]["error_message"]
+
+
+def test_runtime_restart_still_starts_loops(db, tmp_path, monkeypatch):
+    """Regression: a second boot on a persisted DB (contact checks
+    already scheduled) must still spawn the runtime loop threads."""
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    rt1 = ServerRuntime(db=db)
+    rt1.start()
+    n1 = len(rt1.threads)
+    rt1.stop()
+    rt2 = ServerRuntime(db=db)  # same DB: settings flag already set
+    rt2.start()
+    try:
+        assert len(rt2.threads) == n1 == 3
+        # contact checks were not duplicated
+        n_checks = db.query_one(
+            "SELECT COUNT(*) AS n FROM tasks WHERE "
+            "executor='keeper_contact_check'"
+        )["n"]
+        assert n_checks == 2
+    finally:
+        rt2.stop()
