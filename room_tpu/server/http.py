@@ -150,6 +150,11 @@ class ApiServer:
                     return None
 
             def _handle(self) -> None:
+                from ..utils.profiling import (
+                    http_profiler, http_profiling_enabled,
+                )
+
+                t0 = time.perf_counter()
                 try:
                     self._handle_inner()
                 except BrokenPipeError:
@@ -159,6 +164,12 @@ class ApiServer:
                         self._respond(500, {"error": str(e)})
                     except Exception:
                         pass
+                finally:
+                    if http_profiling_enabled():
+                        http_profiler.record(
+                            self.command, self.path.split("?")[0],
+                            (time.perf_counter() - t0) * 1000,
+                        )
 
             def _handle_inner(self) -> None:
                 parsed = urllib.parse.urlparse(self.path)

@@ -190,6 +190,12 @@ def register_aux_routes(r: Router) -> None:
 
         return ok(engines_snapshot())
 
+    def profiling(ctx):
+        from ..utils.profiling import http_profiler
+
+        return ok(http_profiler.snapshot())
+
+    r.get("/api/profiling/http", profiling)
     r.get("/api/tpu/engines", engine_stats)
     r.get("/api/tpu/status", tpu_status)
     r.post("/api/tpu/provision", tpu_provision)

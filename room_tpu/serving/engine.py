@@ -136,6 +136,9 @@ class ServingEngine:
             "tokens_decoded": 0, "turns_completed": 0, "prefill_tokens": 0,
             "decode_steps": 0,
         }
+        from ..utils.profiling import StepTimer
+
+        self.timer = StepTimer()
 
     # ---- jitted device functions ----
 
@@ -221,7 +224,9 @@ class ServingEngine:
 
     def stats(self) -> dict:
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+        out["phases"] = self.timer.snapshot()
+        return out
 
     # ---- engine loop ----
 
@@ -322,13 +327,15 @@ class ServingEngine:
         toks = np.full((bucket,), self.tokenizer.pad_id, np.int32)
         toks[: len(prompt)] = prompt
         prefill = self._prefill_fn(bucket, fresh=sess.length == 0)
-        logits, self.cache = prefill(
-            self.params,
-            self.cache,
-            jnp.asarray(toks[None]),
-            jnp.asarray(table[None]),
-            jnp.asarray([sess.length], jnp.int32),
-        )
+        with self.timer.phase(f"prefill_{bucket}"):
+            logits, self.cache = prefill(
+                self.params,
+                self.cache,
+                jnp.asarray(toks[None]),
+                jnp.asarray(table[None]),
+                jnp.asarray([sess.length], jnp.int32),
+            )
+            logits.block_until_ready()
         self._stats["prefill_tokens"] += len(prompt)
 
         sess.length += len(prompt)
@@ -385,17 +392,18 @@ class ServingEngine:
 
         decode = self._decode_fn(top_k)
         self._key, sub = jax.random.split(self._key)
-        next_tokens, self.cache = decode(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray(self._slot_tables),
-            jnp.asarray(self._slot_lengths),
-            sub,
-            jnp.asarray(temps),
-            jnp.asarray(top_ps),
-        )
-        next_host = np.asarray(next_tokens)
+        with self.timer.phase("decode"):
+            next_tokens, self.cache = decode(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(self._slot_tables),
+                jnp.asarray(self._slot_lengths),
+                sub,
+                jnp.asarray(temps),
+                jnp.asarray(top_ps),
+            )
+            next_host = np.asarray(next_tokens)
         self._stats["decode_steps"] += 1
 
         for i in active_idx:

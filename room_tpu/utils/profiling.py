@@ -1,0 +1,131 @@
+"""Tracing / profiling (SURVEY.md §5):
+
+- HTTP endpoint latency profiling, opt-in via ROOM_TPU_PROFILE_HTTP=1
+  (reference: QUOROOM_PROFILE_HTTP, src/server/index.ts:289-320):
+  per-endpoint count/mean/p95 with slow-request marks and path
+  normalization (ids collapsed to :id).
+- Device traces: jax.profiler wrapper writing TensorBoard-format traces
+  (the reference had nothing on this axis; the TPU engine does).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+SLOW_MS = float(os.environ.get("ROOM_TPU_PROFILE_SLOW_MS", "500"))
+
+_ID_SEG = re.compile(r"/\d+")
+# opaque ids/secrets: webhook tokens, session ids, uuids — any long
+# url-safe segment collapses so secrets never become profiler keys
+_OPAQUE_SEG = re.compile(r"/[A-Za-z0-9_\-]{10,}")
+MAX_KEYS = 512
+
+
+def http_profiling_enabled() -> bool:
+    return os.environ.get("ROOM_TPU_PROFILE_HTTP") == "1"
+
+
+def normalize_path(path: str) -> str:
+    path = _ID_SEG.sub("/:id", path)
+    return _OPAQUE_SEG.sub("/:token", path)
+
+
+class HttpProfiler:
+    def __init__(self) -> None:
+        self._stats: dict[str, list[float]] = {}
+        self._lock = threading.Lock()
+
+    def record(self, method: str, path: str, ms: float) -> None:
+        key = f"{method} {normalize_path(path)}"
+        with self._lock:
+            if key not in self._stats and len(self._stats) >= MAX_KEYS:
+                return  # bounded cardinality (records run pre-auth)
+            samples = self._stats.setdefault(key, [])
+            samples.append(ms)
+            del samples[:-500]
+        if ms >= SLOW_MS:
+            print(f"[http-prof] SLOW {key} {ms:.0f}ms", flush=True)
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            stats = {k: list(v) for k, v in self._stats.items()}
+        out = {}
+        for key, samples in stats.items():
+            s = sorted(samples)
+            out[key] = {
+                "count": len(s),
+                "mean_ms": round(sum(s) / len(s), 2),
+                "p95_ms": round(s[int(0.95 * (len(s) - 1))], 2),
+            }
+        return out
+
+
+http_profiler = HttpProfiler()
+
+
+@contextmanager
+def device_trace(name: str = "room-tpu") -> Iterator[None]:
+    """jax.profiler trace scope writing to ROOM_TPU_TRACE_DIR (or the
+    data dir); open the output with TensorBoard or xprof."""
+    import jax
+
+    base = os.environ.get("ROOM_TPU_TRACE_DIR")
+    if not base:
+        from ..server.auth import data_dir
+
+        base = os.path.join(data_dir(), "traces")
+    os.makedirs(base, exist_ok=True)
+    jax.profiler.start_trace(base)
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Lightweight annotation visible in device traces."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Host-side timing for engine phases (prefill/decode), feeding the
+    per-batch decode metrics the engine exposes in stats()."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+    def snapshot(self) -> dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "count": self.counts[name],
+                    "total_s": round(self.totals[name], 3),
+                    "mean_ms": round(
+                        1000 * self.totals[name] / self.counts[name], 2
+                    ),
+                }
+                for name in self.totals
+            }

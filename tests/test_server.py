@@ -391,3 +391,37 @@ def test_start_server_defaults_to_bundled_ui(tmp_path, monkeypatch):
     finally:
         app.stop()
         rt_mod._runtime = None
+
+
+def test_http_profiling_endpoint(server, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_PROFILE_HTTP", "1")
+    for _ in range(3):
+        req(server, "GET", "/api/rooms")
+    req(server, "GET", "/api/rooms/123")  # normalized to /:id
+    _, out = req(server, "GET", "/api/profiling/http")
+    stats = out["data"]
+    assert stats["GET /api/rooms"]["count"] >= 3
+    assert any(k == "GET /api/rooms/:id" for k in stats)
+    assert all("p95_ms" in v for v in stats.values())
+
+
+def test_profiler_redacts_tokens_and_bounds_keys(server, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_PROFILE_HTTP", "1")
+    req(server, "POST", "/api/hooks/task/sekrit-webhook-token-value",
+        {}, token=None)
+    # recording happens in the handler's finally, which can lag the
+    # response by a beat — poll briefly
+    keys = ""
+    for _ in range(50):
+        _, out = req(server, "GET", "/api/profiling/http")
+        keys = " ".join(out["data"].keys())
+        if "/api/hooks/task/:token" in keys:
+            break
+        time.sleep(0.05)
+    assert "sekrit" not in keys
+    assert "/api/hooks/task/:token" in keys
+    # unbounded-path spray cannot grow keys past the cap
+    from room_tpu.utils.profiling import MAX_KEYS, http_profiler
+    for i in range(MAX_KEYS + 50):
+        http_profiler.record("GET", f"/x{i}a/{'q'*3}", 1.0)
+    assert len(http_profiler.snapshot()) <= MAX_KEYS
