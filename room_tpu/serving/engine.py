@@ -26,6 +26,7 @@ pool, bucketed prefill lengths.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -101,6 +102,16 @@ class ServingEngine:
             cfg.max_seq_len, (n_pages - 1) * page_size
         )
         self.max_pages_per_seq = -(-self.max_seq_len // page_size)
+        # tokens decoded per host round-trip: high values amortize host
+        # sync latency (vital over the TPU tunnel); finished slots waste
+        # their chunk remainder
+        env_chunk = os.environ.get("ROOM_TPU_DECODE_CHUNK")
+        if env_chunk:
+            self.decode_chunk = max(1, int(env_chunk))
+        else:
+            self.decode_chunk = (
+                8 if jax.default_backend() == "tpu" else 1
+            )
 
         if stop_token_ids is not None:
             self.stop_token_ids = set(stop_token_ids)
@@ -162,26 +173,39 @@ class ServingEngine:
             self._jit_cache[key] = prefill
         return self._jit_cache[key]
 
-    def _decode_fn(self, top_k: int):
-        key = ("decode", top_k)
+    def _decode_fn(self, top_k: int, n_steps: int):
+        """One compiled function advancing every slot ``n_steps`` tokens
+        with a single host round-trip (lax.scan over the decode step).
+        Slots that hit a stop mid-chunk keep generating; the host trims
+        — their extra KV writes sit beyond the session length and are
+        overwritten on resume."""
+        key = ("decode", top_k, n_steps)
         if key not in self._jit_cache:
             cfg = self.cfg
 
             @partial(jax.jit, donate_argnums=(1,))
             def decode(params, cache, tokens, block_tables, lengths, rng,
                        temperature, top_p):
-                hook = make_paged_kv_hook(
-                    block_tables, lengths, self.page_size
+                def step(carry, step_rng):
+                    toks, cache, lens = carry
+                    hook = make_paged_kv_hook(
+                        block_tables, lens, self.page_size
+                    )
+                    logits, cache = qwen3.forward(
+                        params, cfg, toks[:, None], lens[:, None],
+                        cache, kv_hook=hook,
+                    )
+                    nxt = sample_batched(
+                        logits[:, 0], step_rng, temperature, top_p,
+                        top_k,
+                    )
+                    return (nxt, cache, lens + 1), nxt
+
+                (_, cache, _), out = jax.lax.scan(
+                    step, (tokens, cache, lengths),
+                    jax.random.split(rng, n_steps),
                 )
-                positions = lengths[:, None]
-                logits, cache = qwen3.forward(
-                    params, cfg, tokens[:, None], positions, cache,
-                    kv_hook=hook,
-                )
-                next_tokens = sample_batched(
-                    logits[:, 0], rng, temperature, top_p, top_k
-                )
-                return next_tokens, cache
+                return out.T, cache  # [B, n_steps]
 
             self._jit_cache[key] = decode
         return self._jit_cache[key]
@@ -356,21 +380,31 @@ class ServingEngine:
         if not active_idx:
             return 0
 
-        # slots must have page capacity for the token they are about to
-        # write at position `length`
+        chunk = self.decode_chunk
+        capacity = self.max_pages_per_seq * self.page_size
+        # ensure pages only for tokens the turn can actually accept:
+        # min(chunk, its remaining budget), clamped to capacity. Device
+        # writes past that divert to the scratch page and the host trims.
         for i in list(active_idx):
             turn = self._active[i]
             sess = self.sessions[turn.session_id]
+            remaining = max(
+                turn.sampling.max_new_tokens - len(turn.new_tokens), 1
+            )
+            target = min(
+                sess.length + min(chunk, remaining), capacity
+            )
             try:
-                pages = self.page_table.ensure_capacity(
-                    sess.id, sess.length + 1
-                )
+                pages = self.page_table.ensure_capacity(sess.id, target)
             except MemoryError as e:
                 turn.error = str(e)
                 self._finish_turn(i, turn, "error")
                 active_idx.remove(i)
                 continue
             self._slot_tables[i, : len(pages)] = pages
+            # stale entries from a previous occupant of this slot must
+            # never receive overrun writes — point them at scratch
+            self._slot_tables[i, len(pages):] = 0
             self._slot_lengths[i] = sess.length
         if not active_idx:
             return 0
@@ -390,7 +424,7 @@ class ServingEngine:
             top_ps[i] = sp.top_p
             top_k = max(top_k, sp.top_k)  # static knob: widest request
 
-        decode = self._decode_fn(top_k)
+        decode = self._decode_fn(top_k, chunk)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode"):
             next_tokens, self.cache = decode(
@@ -403,15 +437,23 @@ class ServingEngine:
                 jnp.asarray(temps),
                 jnp.asarray(top_ps),
             )
-            next_host = np.asarray(next_tokens)
+            next_host = np.asarray(next_tokens)   # [B, chunk]
         self._stats["decode_steps"] += 1
 
         for i in active_idx:
             turn = self._active[i]
             sess = self.sessions[turn.session_id]
-            sess.length += 1  # the token just written at position length
-            self._stats["tokens_decoded"] += 1
-            self._append_token(i, turn, int(next_host[i]))
+            for j in range(chunk):
+                # step j wrote the previous token at position `length`
+                # and sampled next_host[i, j]
+                sess.length += 1
+                self._stats["tokens_decoded"] += 1
+                self._append_token(i, turn, int(next_host[i, j]))
+                if self._active[i] is not turn:
+                    # turn finished mid-chunk: the remaining sampled
+                    # tokens (and their KV writes past sess.length) are
+                    # discarded
+                    break
         return len(active_idx)
 
     def _append_token(self, slot: int, turn: Turn, token: int) -> None:

@@ -74,8 +74,18 @@ def make_paged_kv_hook(
     def hook(q, k, v, layer_cache):
         s = q.shape[1]
         positions = lengths[:, None] + jnp.arange(s)[None]      # [B, S]
-        page_of = jnp.take_along_axis(
-            block_tables, positions // page_size, axis=1
+        # positions beyond the block table (chunked decode can overrun a
+        # finishing turn) divert to scratch page 0 rather than clamping
+        # into the last real page and corrupting live KV
+        page_idx = positions // page_size
+        in_range = page_idx < max_pages
+        page_of = jnp.where(
+            in_range,
+            jnp.take_along_axis(
+                block_tables, jnp.minimum(page_idx, max_pages - 1),
+                axis=1,
+            ),
+            0,
         )                                                        # [B, S]
         offset = positions % page_size
 

@@ -296,3 +296,72 @@ def test_prefill_bucket_clamped_to_capacity(engine_setup):
                                            max_new_tokens=4))
     eng.run_until_idle()
     assert t.finish_reason in ("stop", "length"), t.error
+
+
+def test_chunked_decode_matches_single_step(engine_setup, monkeypatch):
+    """ROOM_TPU_DECODE_CHUNK=4 must produce the same greedy stream as
+    chunk=1, including turns that stop mid-chunk."""
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=7)  # 7 % 4 != 0
+
+    monkeypatch.setenv("ROOM_TPU_DECODE_CHUNK", "1")
+    e1 = make_engine(cfg, params)
+    a = e1.submit([4, 8, 15], session_id="s", sampling=sp)
+    e1.run_until_idle()
+    # resume after a mid-chunk-style stop: continuation must align
+    a2 = e1.submit([16, 23], session_id="s", sampling=sp)
+    e1.run_until_idle()
+
+    monkeypatch.setenv("ROOM_TPU_DECODE_CHUNK", "4")
+    e2 = make_engine(cfg, params)
+    b = e2.submit([4, 8, 15], session_id="s", sampling=sp)
+    e2.run_until_idle()
+    b2 = e2.submit([16, 23], session_id="s", sampling=sp)
+    e2.run_until_idle()
+
+    assert a.new_tokens == b.new_tokens
+    assert a2.new_tokens == b2.new_tokens
+    # chunked run used ~1/4 the host round-trips
+    assert e2.stats()["decode_steps"] < e1.stats()["decode_steps"]
+
+
+def test_chunked_decode_at_capacity_edge(engine_setup, monkeypatch):
+    """A turn whose budget ends near max_seq_len must complete under a
+    large decode chunk (regression: capacity over-ensure crash)."""
+    cfg, params = engine_setup
+    monkeypatch.setenv("ROOM_TPU_DECODE_CHUNK", "16")
+    # capacity 64; prompt 56 + 8 new tokens == 64 exactly
+    eng = make_engine(cfg, params, n_pages=32, page_size=16,
+                      max_seq_len=64)
+    t = eng.submit(list(range(1, 57)),
+                   sampling=SamplingParams(temperature=0.0,
+                                           max_new_tokens=8))
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length"), t.error
+    assert len(t.new_tokens) <= 8
+
+    # and the stream matches chunk=1 on the same inputs
+    monkeypatch.setenv("ROOM_TPU_DECODE_CHUNK", "1")
+    eng2 = make_engine(cfg, params, n_pages=32, page_size=16,
+                       max_seq_len=64)
+    t2 = eng2.submit(list(range(1, 57)),
+                     sampling=SamplingParams(temperature=0.0,
+                                             max_new_tokens=8))
+    eng2.run_until_idle()
+    assert t.new_tokens == t2.new_tokens
+
+
+def test_chunked_decode_finishes_under_pool_pressure(engine_setup,
+                                                     monkeypatch):
+    """A turn with few tokens left and room in its current page must
+    finish even when the pool is empty (regression: chunk over-ensure)."""
+    cfg, params = engine_setup
+    monkeypatch.setenv("ROOM_TPU_DECODE_CHUNK", "8")
+    # pool: scratch + 2 usable pages of 16 = 32 tokens capacity
+    eng = make_engine(cfg, params, n_pages=3, page_size=16,
+                      max_seq_len=32)
+    t = eng.submit([5, 4, 3],
+                   sampling=SamplingParams(temperature=0.0,
+                                           max_new_tokens=4))
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length"), t.error
