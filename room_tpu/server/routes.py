@@ -176,6 +176,41 @@ def register_aux_routes(r: Router) -> None:
     def public_feed(ctx):
         return ok(activity_mod.get_public_feed(ctx.db))
 
+    def create_invite(ctx):
+        """Mint a member-role JWT so a collaborator can watch/vote
+        (reference: src/mcp/tools/invite.ts, re-based on the cloud-JWT
+        auth instead of a cloud service)."""
+        import os as _os
+        import time as _time
+
+        from .auth import JWT_AUD, JWT_ISS, sign_cloud_jwt
+
+        # member POSTs never reach here: access.py whitelists exclude
+        # /api/invites, so only agent/user tokens can mint
+        secret = _os.environ.get("ROOM_TPU_CLOUD_JWT_SECRET")
+        if not secret:
+            return err(
+                "set ROOM_TPU_CLOUD_JWT_SECRET to enable invites", 503
+            )
+        try:
+            days = float((ctx.body or {}).get("ttlDays", 7))
+        except (TypeError, ValueError):
+            return err("ttlDays must be a number")
+        if not (0 < days <= 365):  # rejects inf/nan and zero/negative
+            return err("ttlDays must be in (0, 365]")
+        claims = {
+            "iss": JWT_ISS, "aud": JWT_AUD, "role": "member",
+            "exp": _time.time() + days * 86400,
+        }
+        instance = _os.environ.get("ROOM_TPU_INSTANCE_ID")
+        if instance:
+            claims["instanceId"] = instance
+        return ok({
+            "token": sign_cloud_jwt(claims, secret),
+            "role": "member",
+            "expiresInDays": days,
+        }, 201)
+
     r.get("/api/templates", list_templates)
     r.post("/api/templates/instantiate", instantiate_template)
     r.get("/api/rooms/:id/identity", identity)
@@ -202,6 +237,7 @@ def register_aux_routes(r: Router) -> None:
     r.get("/api/tpu/provision/:sid", tpu_session)
     r.post("/api/tpu/apply", tpu_apply)
     r.get("/api/feed", public_feed)
+    r.post("/api/invites", create_invite)
 
 
 # ---- rooms ----

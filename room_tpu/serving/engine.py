@@ -396,11 +396,19 @@ class ServingEngine:
             )
             try:
                 pages = self.page_table.ensure_capacity(sess.id, target)
-            except MemoryError as e:
-                turn.error = str(e)
-                self._finish_turn(i, turn, "error")
-                active_idx.remove(i)
-                continue
+            except MemoryError:
+                # degrade to single-token pacing before giving up: a turn
+                # finishing within its current pages must not die because
+                # the full chunk couldn't be reserved
+                try:
+                    pages = self.page_table.ensure_capacity(
+                        sess.id, min(sess.length + 1, capacity)
+                    )
+                except MemoryError as e:
+                    turn.error = str(e)
+                    self._finish_turn(i, turn, "error")
+                    active_idx.remove(i)
+                    continue
             self._slot_tables[i, : len(pages)] = pages
             # stale entries from a previous occupant of this slot must
             # never receive overrun writes — point them at scratch

@@ -425,3 +425,33 @@ def test_profiler_redacts_tokens_and_bounds_keys(server, monkeypatch):
     for i in range(MAX_KEYS + 50):
         http_profiler.record("GET", f"/x{i}a/{'q'*3}", 1.0)
     assert len(http_profiler.snapshot()) <= MAX_KEYS
+
+
+def test_invite_minting_and_use(server, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_CLOUD_JWT_SECRET", "invite-secret")
+    status, out = req(server, "POST", "/api/invites", {"ttlDays": 1})
+    assert status == 201
+    invite = out["data"]["token"]
+    # the minted token works as a member credential
+    status, rooms_out = req(server, "GET", "/api/rooms",
+                            raw_token=invite)
+    assert status == 200
+    # ... but cannot write, nor mint further invites
+    status, _ = req(server, "POST", "/api/rooms", {"name": "x"},
+                    raw_token=invite)
+    assert status == 403
+    status, _ = req(server, "POST", "/api/invites", {},
+                    raw_token=invite)
+    assert status == 403
+    # without the secret configured, minting is unavailable
+    monkeypatch.delenv("ROOM_TPU_CLOUD_JWT_SECRET")
+    status, _ = req(server, "POST", "/api/invites", {})
+    assert status == 503
+
+
+def test_invite_ttl_validation(server, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_CLOUD_JWT_SECRET", "s")
+    for bad in ("inf", "nan", "abc", -1, 0, 9999):
+        status, _ = req(server, "POST", "/api/invites",
+                        {"ttlDays": bad})
+        assert status == 400, bad
