@@ -277,35 +277,55 @@ class ServingEngine:
         return [i for i, t in enumerate(self._active) if t is None]
 
     def _admit(self) -> None:
+        """Admission with batched prefill: queued turns that share a
+        (bucket, fresh) shape prefill together in one device call —
+        multi-tenant rooms submitting simultaneously don't serialize."""
         free = self._free_slots()
-        while free and not self._queue.empty():
+        preps: list[dict] = []
+        while free and not self._queue.empty() and \
+                len(preps) < len(free):
             turn = self._queue.get()
-            slot = free.pop(0)
             try:
-                self._start_turn(slot, turn)
+                prep = self._prepare_turn(turn)
             except MemoryError as e:
                 # pool exhausted: requeue and stop admitting; decode will
                 # drain sessions and free pages
-                if self._free_slots() == list(range(self.max_batch)):
+                if self._free_slots() == list(range(self.max_batch)) \
+                        and not preps:
                     turn.error = str(e)
                     turn.finish_reason = "error"
                     turn.done.set()
                 else:
                     self._queue.put(turn)
-                return
+                break
+            if prep is not None:
+                preps.append(prep)
 
-    def _start_turn(self, slot: int, turn: Turn) -> None:
+        # group by identical prefill shape
+        groups: dict[tuple, list[dict]] = {}
+        for prep in preps:
+            groups.setdefault(
+                (prep["bucket"], prep["fresh"]), []
+            ).append(prep)
+        for (bucket, fresh), group in groups.items():
+            slots = [free.pop(0) for _ in group]
+            self._prefill_group(bucket, fresh, group, slots)
+
+    def _prepare_turn(self, turn: Turn) -> Optional[dict]:
+        """Validate + reserve pages for a queued turn. Returns the
+        prefill prep dict, or None when the turn ended during
+        validation. Raises MemoryError when the pool can't hold it."""
         sess = self.sessions.get(turn.session_id)
         if sess is None:
             sess = _Session(id=turn.session_id)
             self.sessions[turn.session_id] = sess
         sess.parked = False
 
-        prompt = turn.prompt_tokens
         if turn.sampling.max_new_tokens <= 0:
             turn.finish_reason = "length"
             turn.done.set()
-            return
+            return None
+        prompt = turn.prompt_tokens
         if sess.pending is not None:
             # re-materialize the sampled-but-unwritten token from the
             # previous turn so its KV lands before the continuation.
@@ -319,7 +339,7 @@ class ServingEngine:
             )
             turn.finish_reason = "error"
             turn.done.set()
-            return
+            return None
 
         bucket = next(
             (b for b in PREFILL_BUCKETS if b >= len(prompt)),
@@ -339,7 +359,7 @@ class ServingEngine:
             )
             turn.finish_reason = "error"
             turn.done.set()
-            return
+            return None
 
         pages = self.page_table.ensure_capacity(
             sess.id, sess.length + bucket
@@ -347,31 +367,67 @@ class ServingEngine:
         sess.pending = None
         table = np.zeros((self.max_pages_per_seq,), np.int32)
         table[: len(pages)] = pages
+        return {
+            "turn": turn, "sess": sess, "prompt": prompt,
+            "bucket": bucket, "fresh": sess.length == 0,
+            "table": table, "base_length": sess.length,
+        }
 
-        toks = np.full((bucket,), self.tokenizer.pad_id, np.int32)
-        toks[: len(prompt)] = prompt
-        prefill = self._prefill_fn(bucket, fresh=sess.length == 0)
-        with self.timer.phase(f"prefill_{bucket}"):
+    def _prefill_group(
+        self, bucket: int, fresh: bool, group: list[dict],
+        slots: list[int],
+    ) -> None:
+        n = len(group)
+        # pad the batch to a power of two so compiles stay bounded at
+        # (buckets x log2(max_batch) x 2); padding rows write into the
+        # scratch page and their samples are discarded
+        n_pad = 1
+        while n_pad < n:
+            n_pad *= 2
+        toks = np.full((n_pad, bucket), self.tokenizer.pad_id, np.int32)
+        tables = np.zeros((n_pad, self.max_pages_per_seq), np.int32)
+        lengths = np.zeros((n_pad,), np.int32)
+        for r, prep in enumerate(group):
+            toks[r, : len(prep["prompt"])] = prep["prompt"]
+            tables[r] = prep["table"]
+            lengths[r] = prep["base_length"]
+
+        prefill = self._prefill_fn(bucket, fresh=fresh)
+        with self.timer.phase(f"prefill_{bucket}x{n}"):
             logits, self.cache = prefill(
                 self.params,
                 self.cache,
-                jnp.asarray(toks[None]),
-                jnp.asarray(table[None]),
-                jnp.asarray([sess.length], jnp.int32),
+                jnp.asarray(toks),
+                jnp.asarray(tables),
+                jnp.asarray(lengths),
             )
-            logits.block_until_ready()
-        self._stats["prefill_tokens"] += len(prompt)
+            # first generated token per row, from its last real position
+            last_idx = jnp.asarray(
+                [len(p["prompt"]) - 1 for p in group]
+                + [0] * (n_pad - n),
+                jnp.int32,
+            )
+            last_logits = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1
+            )[:, 0]
+            self._key, sub = jax.random.split(self._key)
+            temps = [p["turn"].sampling.temperature for p in group]
+            top_ps = [p["turn"].sampling.top_p for p in group]
+            firsts = np.asarray(sample_batched(
+                last_logits, sub,
+                jnp.asarray(temps + [1.0] * (n_pad - n), jnp.float32),
+                jnp.asarray(top_ps + [1.0] * (n_pad - n), jnp.float32),
+                max(p["turn"].sampling.top_k for p in group),
+            ))
 
-        sess.length += len(prompt)
-        # sample the first generated token from the last real position
-        self._key, sub = jax.random.split(self._key)
-        first = int(
-            sample(logits[:, len(prompt) - 1], sub, turn.sampling)[0]
-        )
-        self._slot_tables[slot] = table
-        self._slot_lengths[slot] = sess.length
-        self._active[slot] = turn
-        self._append_token(slot, turn, first)
+        for r, (prep, slot) in enumerate(zip(group, slots)):
+            turn, sess = prep["turn"], prep["sess"]
+            self._stats["prefill_tokens"] += len(prep["prompt"])
+            sess.length += len(prep["prompt"])
+            self._slot_tables[slot] = prep["table"]
+            self._slot_lengths[slot] = sess.length
+            self._active[slot] = turn
+            self._append_token(slot, turn, int(firsts[r]))
 
     def _decode_once(self) -> int:
         active_idx = [

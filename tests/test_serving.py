@@ -365,3 +365,26 @@ def test_chunked_decode_finishes_under_pool_pressure(engine_setup,
                                            max_new_tokens=4))
     eng.run_until_idle()
     assert t.finish_reason in ("stop", "length"), t.error
+
+
+def test_batched_prefill_matches_sequential(engine_setup):
+    """Simultaneously queued same-bucket turns prefill together; greedy
+    results must equal one-at-a-time admission."""
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=5)
+    prompts = [[3, 1, 4], [1, 5, 9, 2], [6, 5], [3, 5, 8, 9, 7]]
+
+    eng_seq = make_engine(cfg, params, max_batch=1)  # forces 1-by-1
+    seq = []
+    for p in prompts:
+        t = eng_seq.submit(p, sampling=sp)
+        eng_seq.run_until_idle()
+        seq.append(t.new_tokens)
+
+    eng_bat = make_engine(cfg, params, max_batch=4)
+    turns = [eng_bat.submit(p, sampling=sp) for p in prompts]
+    eng_bat.run_until_idle()
+    assert [t.new_tokens for t in turns] == seq
+    # all four shared one grouped prefill (bucket 16 x batch 4)
+    phases = eng_bat.stats()["phases"]
+    assert any("x4" in k for k in phases), phases
