@@ -25,7 +25,6 @@ pool, bucketed prefill lengths.
 
 from __future__ import annotations
 
-import itertools
 import os
 import queue
 import threading
@@ -41,7 +40,7 @@ import numpy as np
 from ..models import qwen3
 from ..models.config import DecoderConfig
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
-from .sampler import SamplingParams, sample, sample_batched
+from .sampler import SamplingParams, sample_batched
 from .tokenizer import ByteTokenizer, Tokenizer
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
@@ -250,6 +249,10 @@ class ServingEngine:
         with self._lock:
             out = dict(self._stats)
         out["phases"] = self.timer.snapshot()
+        out["queued"] = self._queue.qsize()
+        out["active_slots"] = sum(
+            1 for t in self._active if t is not None
+        )
         return out
 
     # ---- engine loop ----

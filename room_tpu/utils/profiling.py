@@ -51,6 +51,10 @@ class HttpProfiler:
         if ms >= SLOW_MS:
             print(f"[http-prof] SLOW {key} {ms:.0f}ms", flush=True)
 
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
     def snapshot(self) -> dict[str, dict]:
         with self._lock:
             stats = {k: list(v) for k, v in self._stats.items()}

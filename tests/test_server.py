@@ -394,12 +394,22 @@ def test_start_server_defaults_to_bundled_ui(tmp_path, monkeypatch):
 
 
 def test_http_profiling_endpoint(server, monkeypatch):
+    from room_tpu.utils.profiling import http_profiler
+
+    http_profiler.reset()
     monkeypatch.setenv("ROOM_TPU_PROFILE_HTTP", "1")
     for _ in range(3):
         req(server, "GET", "/api/rooms")
     req(server, "GET", "/api/rooms/123")  # normalized to /:id
-    _, out = req(server, "GET", "/api/profiling/http")
-    stats = out["data"]
+    # recording happens in the handler's finally — poll briefly
+    stats = {}
+    for _ in range(50):
+        _, out = req(server, "GET", "/api/profiling/http")
+        stats = out["data"]
+        if "GET /api/rooms/:id" in stats and \
+                stats.get("GET /api/rooms", {}).get("count", 0) >= 3:
+            break
+        time.sleep(0.05)
     assert stats["GET /api/rooms"]["count"] >= 3
     assert any(k == "GET /api/rooms/:id" for k in stats)
     assert all("p95_ms" in v for v in stats.values())
@@ -422,9 +432,12 @@ def test_profiler_redacts_tokens_and_bounds_keys(server, monkeypatch):
     assert "/api/hooks/task/:token" in keys
     # unbounded-path spray cannot grow keys past the cap
     from room_tpu.utils.profiling import MAX_KEYS, http_profiler
-    for i in range(MAX_KEYS + 50):
-        http_profiler.record("GET", f"/x{i}a/{'q'*3}", 1.0)
-    assert len(http_profiler.snapshot()) <= MAX_KEYS
+    try:
+        for i in range(MAX_KEYS + 50):
+            http_profiler.record("GET", f"/x{i}a/{'q'*3}", 1.0)
+        assert len(http_profiler.snapshot()) <= MAX_KEYS
+    finally:
+        http_profiler.reset()  # don't saturate the global for others
 
 
 def test_invite_minting_and_use(server, monkeypatch):
