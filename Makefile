@@ -1,0 +1,36 @@
+# room-tpu developer entry points
+
+PY ?= python
+CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+
+.PHONY: test bench bench-tiny serve mcp native experiment dryrun clean
+
+test:            ## hermetic suite on the virtual 8-device CPU mesh
+	$(PY) -m pytest tests/ -q
+
+bench:           ## decode benchmark (real accelerator; one JSON line)
+	$(PY) bench.py
+
+bench-tiny:      ## CPU smoke of the benchmark harness
+	env $(CPU_ENV) ROOM_TPU_BENCH_TINY=1 $(PY) bench.py
+
+serve:           ## API server + dashboard + runtime loops
+	$(PY) -m room_tpu.cli.main serve
+
+mcp:             ## MCP stdio server (shares the data dir's database)
+	$(PY) -m room_tpu.cli.main mcp
+
+native:          ## build the C++ vector-search core
+	$(MAKE) -C native
+
+experiment:      ## swarm perf harness (reference experiment.js parity)
+	env $(CPU_ENV) $(PY) scripts/experiment.py --models echo \
+		--workers 4 --cycles 3
+
+dryrun:          ## multi-chip sharding dry run on 8 virtual devices
+	env $(CPU_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -158,9 +158,14 @@ def stop_room_loops(db: Database, room_id: int, reason: str = "") -> int:
 # ---- the loop ----
 
 def _loop(db: Database, handle: LoopHandle) -> None:
+    import sqlite3
+
     while not handle.stop.is_set():
-        worker = workers_mod.get_worker(db, handle.worker_id)
-        room = rooms_mod.get_room(db, handle.room_id)
+        try:
+            worker = workers_mod.get_worker(db, handle.worker_id)
+            room = rooms_mod.get_room(db, handle.room_id)
+        except sqlite3.ProgrammingError:
+            return  # database closed underneath us: shutdown in progress
         if worker is None or room is None:
             break
         if room["status"] != "active" or not is_room_launched(room["id"]):
@@ -191,12 +196,18 @@ def _loop(db: Database, handle: LoopHandle) -> None:
         # the wait state stays observable for the whole backoff window
         state = "rate_limited" if rate_limited else "idle"
         handle.state = state
-        workers_mod.set_agent_state(db, handle.worker_id, state)
+        try:
+            workers_mod.set_agent_state(db, handle.worker_id, state)
+        except sqlite3.ProgrammingError:
+            return
         if handle.wake.wait(timeout=gap_s):
             handle.wake.clear()
 
     handle.state = "stopped"
-    workers_mod.set_agent_state(db, handle.worker_id, "stopped")
+    try:
+        workers_mod.set_agent_state(db, handle.worker_id, "stopped")
+    except sqlite3.ProgrammingError:
+        pass  # database already closed during shutdown
     with _registry_lock:
         if _running_loops.get(handle.worker_id) is handle:
             del _running_loops[handle.worker_id]
