@@ -71,7 +71,8 @@ def run_config(model: str, n_workers: int, n_cycles: int) -> dict:
         "errors": errors,
         "p50_cycle_s": round(statistics.median(lat_sorted), 3),
         "p90_cycle_s": round(
-            lat_sorted[int(0.9 * (len(lat_sorted) - 1))], 3
+            lat_sorted[min(len(lat_sorted) - 1,
+                           -(-9 * len(lat_sorted) // 10) - 1)], 3
         ),
         "output_tokens": tokens_out,
         "wall_s": round(wall, 2),
