@@ -7,14 +7,17 @@ from __future__ import annotations
 
 import re
 
+# keyed on "METHOD path" (reference keys `${method} ${pathname}`) so a
+# future PUT/DELETE at a whitelisted path doesn't silently become
+# member-writable
 MEMBER_WRITE_WHITELIST = [
     re.compile(p)
     for p in (
-        r"^/api/decisions/\d+/vote$",
-        r"^/api/decisions/\d+/keeper-vote$",
-        r"^/api/escalations/\d+/answer$",
-        r"^/api/messages/\d+/reply$",
-        r"^/api/messages/\d+/read$",
+        r"^POST /api/decisions/\d+/vote$",
+        r"^POST /api/decisions/\d+/keeper-vote$",
+        r"^POST /api/escalations/\d+/answer$",
+        r"^POST /api/messages/\d+/reply$",
+        r"^POST /api/messages/\d+/read$",
     )
 ]
 
@@ -34,4 +37,5 @@ def is_allowed_for_role(role: str, method: str, path: str) -> bool:
         return False
     if method in ("GET", "HEAD"):
         return not any(p.match(path) for p in MEMBER_READ_BLOCKLIST)
-    return any(p.match(path) for p in MEMBER_WRITE_WHITELIST)
+    key = f"{method} {path}"
+    return any(p.match(key) for p in MEMBER_WRITE_WHITELIST)

@@ -145,6 +145,12 @@ def validate_cloud_jwt(token: str) -> Optional[dict]:
         return None
     if claims.get("nbf") is not None and now < float(claims["nbf"]):
         return None
+    # a principal must have an identity for auditing (reference checks
+    # sub is a non-empty string); tokens minted before this check existed
+    # are deliberately invalidated — reissue via POST /api/invites
+    sub = claims.get("sub")
+    if not isinstance(sub, str) or not sub:
+        return None
     instance = os.environ.get("ROOM_TPU_INSTANCE_ID")
     if instance and claims.get("instanceId") != instance:
         return None

@@ -76,7 +76,34 @@ class ApiServer:
             def log_message(self, *a):  # quiet
                 pass
 
+            def _drain_unread_body(self) -> None:
+                """Keep-alive framing: early-exit responses (403 origin,
+                401, 429, 404...) must consume the request body, or the
+                leftover bytes get parsed as the next request on the
+                persistent connection."""
+                if getattr(self, "_body_consumed", False):
+                    return
+                self._body_consumed = True
+                try:
+                    remaining = int(
+                        self.headers.get("Content-Length") or 0
+                    )
+                except ValueError:
+                    self.close_connection = True
+                    return
+                if remaining > 5_000_000:
+                    # don't let an unauthenticated client stream GBs at a
+                    # rejection response; drop the connection instead
+                    self.close_connection = True
+                    return
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 65536))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+
             def _respond(self, status: int, payload: dict) -> None:
+                self._drain_unread_body()
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self._common_headers()
@@ -131,7 +158,14 @@ class ApiServer:
                 return self._client_ip() in ("127.0.0.1", "::1")
 
             def _read_body(self) -> Any:
-                length = int(self.headers.get("Content-Length") or 0)
+                self._body_consumed = True
+                try:
+                    length = int(
+                        self.headers.get("Content-Length") or 0
+                    )
+                except ValueError:
+                    self.close_connection = True
+                    return None
                 if length <= 0:
                     return None
                 if length > 5_000_000:
@@ -155,6 +189,7 @@ class ApiServer:
                 )
 
                 t0 = time.perf_counter()
+                self._body_consumed = False
                 try:
                     self._handle_inner()
                 except BrokenPipeError:
@@ -278,6 +313,7 @@ class ApiServer:
                     "application/octet-stream"
                 with open(full, "rb") as f:
                     body = f.read()
+                self._drain_unread_body()
                 self.send_response(200)
                 self._common_headers()
                 self.send_header("Content-Type", ctype)

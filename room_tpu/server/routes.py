@@ -198,8 +198,10 @@ def register_aux_routes(r: Router) -> None:
             return err("ttlDays must be a number")
         if not (0 < days <= 365):  # rejects inf/nan and zero/negative
             return err("ttlDays must be in (0, 365]")
+        import secrets as _secrets
         claims = {
             "iss": JWT_ISS, "aud": JWT_AUD, "role": "member",
+            "sub": f"invite-{_secrets.token_hex(8)}",
             "exp": _time.time() + days * 86400,
         }
         instance = _os.environ.get("ROOM_TPU_INSTANCE_ID")

@@ -172,19 +172,19 @@ class ServingEngine:
             self._jit_cache[key] = prefill
         return self._jit_cache[key]
 
-    def _decode_fn(self, top_k: int, n_steps: int):
+    def _decode_fn(self, n_steps: int):
         """One compiled function advancing every slot ``n_steps`` tokens
         with a single host round-trip (lax.scan over the decode step).
         Slots that hit a stop mid-chunk keep generating; the host trims
         — their extra KV writes sit beyond the session length and are
         overwritten on resume."""
-        key = ("decode", top_k, n_steps)
+        key = ("decode", n_steps)
         if key not in self._jit_cache:
             cfg = self.cfg
 
             @partial(jax.jit, donate_argnums=(1,))
             def decode(params, cache, tokens, block_tables, lengths, rng,
-                       temperature, top_p):
+                       temperature, top_p, top_k):
                 def step(carry, step_rng):
                     toks, cache, lens = carry
                     hook = make_paged_kv_hook(
@@ -416,11 +416,12 @@ class ServingEngine:
             self._key, sub = jax.random.split(self._key)
             temps = [p["turn"].sampling.temperature for p in group]
             top_ps = [p["turn"].sampling.top_p for p in group]
+            top_ks = [p["turn"].sampling.top_k for p in group]
             firsts = np.asarray(sample_batched(
                 last_logits, sub,
                 jnp.asarray(temps + [1.0] * (n_pad - n), jnp.float32),
                 jnp.asarray(top_ps + [1.0] * (n_pad - n), jnp.float32),
-                max(p["turn"].sampling.top_k for p in group),
+                jnp.asarray(top_ks + [0] * (n_pad - n), jnp.int32),
             ))
 
         for r, (prep, slot) in enumerate(zip(group, slots)):
@@ -484,14 +485,14 @@ class ServingEngine:
 
         temps = np.ones((self.max_batch,), np.float32)
         top_ps = np.ones((self.max_batch,), np.float32)
-        top_k = 0
+        top_ks = np.zeros((self.max_batch,), np.int32)
         for i in active_idx:
             sp = self._active[i].sampling
             temps[i] = sp.temperature
             top_ps[i] = sp.top_p
-            top_k = max(top_k, sp.top_k)  # static knob: widest request
+            top_ks[i] = sp.top_k
 
-        decode = self._decode_fn(top_k, chunk)
+        decode = self._decode_fn(chunk)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode"):
             next_tokens, self.cache = decode(
@@ -503,6 +504,7 @@ class ServingEngine:
                 sub,
                 jnp.asarray(temps),
                 jnp.asarray(top_ps),
+                jnp.asarray(top_ks),
             )
             next_host = np.asarray(next_tokens)   # [B, chunk]
         self._stats["decode_steps"] += 1

@@ -49,21 +49,32 @@ def sample_batched(
     key: jax.Array,
     temperature: jax.Array,   # [B] (0 = greedy for that row)
     top_p: jax.Array,         # [B] (1 = off)
-    top_k: int = 0,           # static, engine-wide
+    top_k: jax.Array,         # [B] int32 (0 = off for that row)
 ) -> jax.Array:
     """Per-row sampling knobs as arrays so one compiled decode step serves
-    heterogeneous turns in the same batch."""
+    heterogeneous turns in the same batch. top_k is per-row: a row with
+    top_k=0 samples the full vocabulary regardless of its batchmates."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
 
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
 
-    if top_k > 0:
-        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
-        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-
+    # one descending sort serves both top-k (rank threshold) and
+    # top-p (mass threshold)
     sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+
+    vocab = logits.shape[-1]
+    k_idx = jnp.clip(top_k[:, None] - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(sorted_logits, k_idx, axis=-1)
+    apply_k = (top_k > 0)[:, None]
+    scaled = jnp.where(apply_k & (scaled < kth), -jnp.inf, scaled)
+    # top-p applies to the k-filtered distribution (sequential semantics);
+    # masking the sorted copy by the same value threshold avoids a resort
+    sorted_logits = jnp.where(
+        apply_k & (sorted_logits < kth), -jnp.inf, sorted_logits
+    )
+
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     cutoff_idx = jnp.sum(

@@ -74,11 +74,19 @@ def test_member_jwt_rbac(server, monkeypatch):
     monkeypatch.setenv("ROOM_TPU_CLOUD_JWT_SECRET", "s3cret")
     jwt = sign_cloud_jwt(
         {"iss": "room-tpu-cloud", "aud": "room-tpu-runtime",
-         "exp": time.time() + 60, "role": "member"},
+         "exp": time.time() + 60, "role": "member", "sub": "m-1"},
         "s3cret",
     )
     status, _ = req(server, "GET", "/api/rooms", raw_token=jwt)
     assert status == 200
+    # token without a subject carries no auditable identity
+    nosub = sign_cloud_jwt(
+        {"iss": "room-tpu-cloud", "aud": "room-tpu-runtime",
+         "exp": time.time() + 60, "role": "member"},
+        "s3cret",
+    )
+    status, _ = req(server, "GET", "/api/rooms", raw_token=nosub)
+    assert status == 401
     # member cannot write outside the whitelist
     status, _ = req(server, "POST", "/api/rooms", {"name": "x"},
                     raw_token=jwt)

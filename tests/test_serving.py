@@ -193,8 +193,30 @@ def test_sample_batched_greedy_rows():
     toks = sample_batched(
         logits, jax.random.PRNGKey(0),
         jnp.array([0.0, 0.0]), jnp.array([1.0, 1.0]),
+        jnp.array([0, 0], dtype=jnp.int32),
     )
     assert toks.tolist() == [1, 0]
+
+
+def test_sample_batched_per_row_top_k():
+    # row 0: top_k=0 (full vocab) — must still be able to sample any
+    # token even when batched with a narrow top_k row. Make the
+    # non-argmax tokens dominate collectively: near-uniform logits.
+    logits = jnp.array([
+        [1.0, 1.01, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0],
+        [9.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0],
+    ])
+    top_k = jnp.array([0, 1], dtype=jnp.int32)
+    seen0 = set()
+    for seed in range(64):
+        toks = sample_batched(
+            logits, jax.random.PRNGKey(seed),
+            jnp.array([1.0, 1.0]), jnp.array([1.0, 1.0]), top_k,
+        )
+        seen0.add(int(toks[0]))
+        assert int(toks[1]) == 0  # top_k=1 row is pinned to argmax
+    # full-vocab row reached tokens outside any widened top-k window
+    assert len(seen0) > 4
 
 
 # ---- tokenizer + chat template ----
