@@ -4,6 +4,7 @@ from .mesh import (
     encoder_param_specs,
     kv_cache_specs,
     make_mesh,
+    page_cache_specs,
     shard_pytree,
 )
 
@@ -13,5 +14,6 @@ __all__ = [
     "encoder_param_specs",
     "kv_cache_specs",
     "make_mesh",
+    "page_cache_specs",
     "shard_pytree",
 ]

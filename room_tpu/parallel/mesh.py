@@ -109,6 +109,22 @@ def kv_cache_specs(cfg: DecoderConfig) -> dict[str, Any]:
     }
 
 
+def page_cache_specs(cfg: DecoderConfig, mesh: Mesh) -> dict[str, Any]:
+    """Sharding rule for the serving engine's paged KV pool
+    [L, n_pages, page_size, Hkv, Dh].
+
+    The page axis is addressed dynamically by block tables, so it stays
+    replicated over dp/ep; the KV-head axis shards over ``tp`` alongside
+    the attention weights (qwen3-30b: 4 kv heads, qwen2-72b: 8 — both
+    divide the practical tp sizes). Falls back to replicated heads when
+    tp doesn't divide, mirroring kv_cache_specs' GQA posture.
+    """
+    tp = mesh.shape.get("tp", 1)
+    head_ax = "tp" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
+    spec = P(None, None, None, head_ax, None)
+    return {"k_pages": spec, "v_pages": spec}
+
+
 def encoder_param_specs(cfg: EncoderConfig) -> dict[str, Any]:
     return {
         "word_embed": P("tp", None),

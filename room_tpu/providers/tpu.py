@@ -103,6 +103,7 @@ class ModelHost:
                 params = load_params(ckpt, like=params)
 
             mesh_env = os.environ.get("ROOM_TPU_MESH")
+            mesh = None
             if mesh_env:
                 dp, ep, tp = (int(x) for x in mesh_env.split(","))
                 mesh = make_mesh(MeshSpec(dp, ep, tp))
@@ -110,6 +111,8 @@ class ModelHost:
                     params, decoder_param_specs(self.cfg), mesh
                 )
 
+            # the engine places its page pool on the same mesh as the
+            # params so KV reads never cross chips
             self._engine = ServingEngine(
                 self.cfg,
                 params,
@@ -117,6 +120,7 @@ class ModelHost:
                 max_batch=int(os.environ.get("ROOM_TPU_MAX_BATCH", "8")),
                 page_size=int(os.environ.get("ROOM_TPU_PAGE_SIZE", "16")),
                 n_pages=int(os.environ.get("ROOM_TPU_N_PAGES", "2048")),
+                mesh=mesh,
             )
             self._thread = threading.Thread(
                 target=self._engine.serve_forever,

@@ -90,9 +90,15 @@ class ServingEngine:
         max_seq_len: Optional[int] = None,
         stop_token_ids: Optional[list[int]] = None,
         rng_seed: int = 0,
+        mesh: Optional[Any] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
+        # multi-chip serving: cache+params live together on the mesh —
+        # KV heads shard over tp next to the attention weights, decode
+        # batch rows over dp (reference serves through a single-process
+        # Ollama daemon; here the mesh is the daemon)
+        self.mesh = mesh
         self.tokenizer = tokenizer or ByteTokenizer()
         self.max_batch = max_batch
         self.page_size = page_size
@@ -131,6 +137,16 @@ class ServingEngine:
         self.page_table.ensure_capacity("__null__", page_size)
 
         self.cache = init_page_cache(cfg, n_pages, page_size)
+        self._cache_specs = None
+        self._dp_size = 1
+        if mesh is not None:
+            from ..parallel.mesh import page_cache_specs, shard_pytree
+
+            self._cache_specs = page_cache_specs(cfg, mesh)
+            self.cache = shard_pytree(self.cache, self._cache_specs, mesh)
+            dp = mesh.shape.get("dp", 1)
+            if dp > 1 and max_batch % dp == 0:
+                self._dp_size = dp
         self.sessions: dict[str, _Session] = {}
         self._queue: queue.Queue[Turn] = queue.Queue()
         self._active: list[Optional[Turn]] = [None] * max_batch
@@ -152,6 +168,33 @@ class ServingEngine:
 
     # ---- jitted device functions ----
 
+    def _constrain_cache(self, cache):
+        """Pin the page pool's sharding inside jit so donation reuses the
+        sharded buffers instead of letting GSPMD re-layout them."""
+        if self._cache_specs is None:
+            return cache
+        from jax.sharding import NamedSharding
+
+        mesh = self.mesh
+        return jax.tree.map(
+            lambda a, s: jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, s)
+            ),
+            cache, self._cache_specs,
+        )
+
+    def _place_batch(self, arr: np.ndarray, *, jnp_dtype=None) -> jax.Array:
+        """Decode-batch inputs shard their leading (slot) axis over dp
+        when the mesh has one; replicated otherwise."""
+        x = jnp.asarray(arr) if jnp_dtype is None else \
+            jnp.asarray(arr, jnp_dtype)
+        if self._dp_size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = P(*(("dp",) + (None,) * (x.ndim - 1)))
+            x = jax.device_put(x, NamedSharding(self.mesh, spec))
+        return x
+
     def _prefill_fn(self, bucket: int, fresh: bool):
         key = ("prefill", bucket, fresh)
         if key not in self._jit_cache:
@@ -167,7 +210,7 @@ class ServingEngine:
                 logits, cache = qwen3.forward(
                     params, cfg, tokens, positions, cache, kv_hook=hook
                 )
-                return logits, cache
+                return logits, self._constrain_cache(cache)
 
             self._jit_cache[key] = prefill
         return self._jit_cache[key]
@@ -204,7 +247,7 @@ class ServingEngine:
                     step, (tokens, cache, lengths),
                     jax.random.split(rng, n_steps),
                 )
-                return out.T, cache  # [B, n_steps]
+                return out.T, self._constrain_cache(cache)  # [B, n_steps]
 
             self._jit_cache[key] = decode
         return self._jit_cache[key]
@@ -498,13 +541,13 @@ class ServingEngine:
             next_tokens, self.cache = decode(
                 self.params,
                 self.cache,
-                jnp.asarray(tokens),
-                jnp.asarray(self._slot_tables),
-                jnp.asarray(self._slot_lengths),
+                self._place_batch(tokens),
+                self._place_batch(self._slot_tables),
+                self._place_batch(self._slot_lengths),
                 sub,
-                jnp.asarray(temps),
-                jnp.asarray(top_ps),
-                jnp.asarray(top_ks),
+                self._place_batch(temps),
+                self._place_batch(top_ps),
+                self._place_batch(top_ks),
             )
             next_host = np.asarray(next_tokens)   # [B, chunk]
         self._stats["decode_steps"] += 1
