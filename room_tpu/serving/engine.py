@@ -73,6 +73,12 @@ class _Session:
     # last sampled token not yet written to KV (stop/park happens before
     # its decode step); prepended to the next resume prompt
     pending: Optional[int] = None
+    # host-side mirror of the KV contents (|history| == length always):
+    # the tokens to re-prefill if this session's pages get evicted under
+    # pool pressure. Ints only — a 32k-token session costs ~256KB host
+    # memory against its pages' HBM footprint.
+    history: list[int] = field(default_factory=list)
+    last_used: float = field(default_factory=time.monotonic)
 
 
 class ServingEngine:
@@ -160,7 +166,7 @@ class ServingEngine:
         self._jit_cache: dict[Any, Callable] = {}
         self._stats = {
             "tokens_decoded": 0, "turns_completed": 0, "prefill_tokens": 0,
-            "decode_steps": 0,
+            "decode_steps": 0, "evictions": 0,
         }
         from ..utils.profiling import StepTimer
 
@@ -322,6 +328,45 @@ class ServingEngine:
     def _free_slots(self) -> list[int]:
         return [i for i, t in enumerate(self._active) if t is None]
 
+    def _ensure_capacity_evicting(
+        self, session_id: str, n_tokens: int
+    ) -> list[int]:
+        """ensure_capacity with LRU eviction under pool pressure: parked
+        / idle sessions lose their pages (their context survives in the
+        host-side history mirror and re-prefills on resume) instead of
+        new work erroring out. The on-TPU analogue of the reference's
+        session-rotation bound (agent-loop.ts:462-493)."""
+        while True:
+            try:
+                return self.page_table.ensure_capacity(
+                    session_id, n_tokens
+                )
+            except MemoryError:
+                if not self._evict_lru(exclude=session_id):
+                    raise
+
+    def _evict_lru(self, exclude: str) -> bool:
+        active_ids = {
+            t.session_id for t in self._active if t is not None
+        }
+        candidates = [
+            s for s in self.sessions.values()
+            if s.id != exclude and s.id not in active_ids
+            and self.page_table.pages_of(s.id)
+        ]
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda s: s.last_used)
+        # fold the unwritten pending token into history so the restore
+        # prompt reproduces the full context in order
+        if victim.pending is not None:
+            victim.history.append(victim.pending)
+            victim.pending = None
+        self.page_table.release(victim.id)
+        victim.length = 0
+        self._stats["evictions"] += 1
+        return True
+
     def _admit(self) -> None:
         """Admission with batched prefill: queued turns that share a
         (bucket, fresh) shape prefill together in one device call —
@@ -366,6 +411,7 @@ class ServingEngine:
             sess = _Session(id=turn.session_id)
             self.sessions[turn.session_id] = sess
         sess.parked = False
+        sess.last_used = time.monotonic()
 
         if turn.sampling.max_new_tokens <= 0:
             turn.finish_reason = "length"
@@ -378,6 +424,13 @@ class ServingEngine:
             # pending is cleared only after prefill succeeds, so a
             # MemoryError requeue keeps the token.
             prompt = [sess.pending] + prompt
+        restoring = sess.length == 0 and bool(sess.history)
+        if restoring:
+            # pages were evicted under pool pressure: rebuild the whole
+            # context from the host-side mirror. history is cleared only
+            # after pages are reserved (the prefill bookkeeping re-fills
+            # it), so a MemoryError requeue loses nothing.
+            prompt = sess.history + prompt
         total = sess.length + len(prompt)
         if total + turn.sampling.max_new_tokens > self.max_seq_len:
             turn.error = (
@@ -407,10 +460,12 @@ class ServingEngine:
             turn.done.set()
             return None
 
-        pages = self.page_table.ensure_capacity(
+        pages = self._ensure_capacity_evicting(
             sess.id, sess.length + bucket
         )
         sess.pending = None
+        if restoring:
+            sess.history = []
         table = np.zeros((self.max_pages_per_seq,), np.int32)
         table[: len(pages)] = pages
         return {
@@ -471,6 +526,7 @@ class ServingEngine:
             turn, sess = prep["turn"], prep["sess"]
             self._stats["prefill_tokens"] += len(prep["prompt"])
             sess.length += len(prep["prompt"])
+            sess.history.extend(prep["prompt"])
             self._slot_tables[slot] = prep["table"]
             self._slot_lengths[slot] = sess.length
             self._active[slot] = turn
@@ -498,13 +554,13 @@ class ServingEngine:
                 sess.length + min(chunk, remaining), capacity
             )
             try:
-                pages = self.page_table.ensure_capacity(sess.id, target)
+                pages = self._ensure_capacity_evicting(sess.id, target)
             except MemoryError:
                 # degrade to single-token pacing before giving up: a turn
                 # finishing within its current pages must not die because
                 # the full chunk couldn't be reserved
                 try:
-                    pages = self.page_table.ensure_capacity(
+                    pages = self._ensure_capacity_evicting(
                         sess.id, min(sess.length + 1, capacity)
                     )
                 except MemoryError as e:
@@ -558,6 +614,9 @@ class ServingEngine:
             for j in range(chunk):
                 # step j wrote the previous token at position `length`
                 # and sampled next_host[i, j]
+                sess.history.append(
+                    int(tokens[i]) if j == 0 else int(next_host[i, j - 1])
+                )
                 sess.length += 1
                 self._stats["tokens_decoded"] += 1
                 self._append_token(i, turn, int(next_host[i, j]))
@@ -591,6 +650,7 @@ class ServingEngine:
 
     def _finish_turn(self, slot: int, turn: Turn, reason: str) -> None:
         sess = self.sessions[turn.session_id]
+        sess.last_used = time.monotonic()
         if turn.new_tokens and reason != "error":
             # the final sampled token never got a decode step, so its KV
             # is unwritten; it re-enters via the next resume prompt

@@ -152,6 +152,57 @@ def test_engine_on_mesh_matches_single_device(engine_setup):
     assert shard_mesh.shape == mesh.shape
 
 
+def test_eviction_oversubscribed_pool(engine_setup):
+    """12 sessions against a pool that holds ~3: LRU eviction must keep
+    admission moving and every turn must complete (no MemoryError
+    turns)."""
+    cfg, params = engine_setup
+    # 17 pages * page_size 4 = 68 tokens; each session buckets to 16
+    # tokens (4 pages) -> ~4 resident; 12 sessions ~= 3-4x oversubscribed
+    eng = make_engine(cfg, params, max_batch=2, page_size=4, n_pages=17)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    turns = [
+        eng.submit([i + 1, i + 2, i + 3], session_id=f"s{i}", sampling=sp)
+        for i in range(12)
+    ]
+    eng.run_until_idle()
+    assert all(t.finish_reason in ("stop", "length") for t in turns), [
+        (t.finish_reason, t.error) for t in turns
+    ]
+    assert eng.stats()["evictions"] > 0
+
+
+def test_evicted_session_resumes_identically(engine_setup):
+    """A session whose pages were evicted re-prefills from its host-side
+    history on resume and generates exactly the tokens it would have
+    with resident KV."""
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=5)
+
+    def run(n_pages):
+        eng = make_engine(
+            cfg, params, max_batch=1, page_size=4, n_pages=n_pages
+        )
+        t1 = eng.submit([1, 2, 3], session_id="keep", sampling=sp)
+        eng.run_until_idle()
+        # fill the pool with other sessions so "keep" gets evicted in
+        # the small-pool engine (and stays resident in the big one)
+        for i in range(3):
+            eng.submit([50 + i], session_id=f"fill{i}", sampling=sp)
+            eng.run_until_idle()
+        t2 = eng.submit([7, 8], session_id="keep", sampling=sp)
+        eng.run_until_idle()
+        assert t1.finish_reason in ("stop", "length")
+        assert t2.finish_reason in ("stop", "length"), t2.error
+        return t1.new_tokens, t2.new_tokens, eng.stats()["evictions"]
+
+    small = run(n_pages=9)    # scratch + 8 usable -> 2 resident sessions
+    big = run(n_pages=64)     # everything stays resident
+    assert small[2] > 0 and big[2] == 0  # eviction happened only in small
+    assert small[0] == big[0]
+    assert small[1] == big[1]
+
+
 def test_engine_more_turns_than_slots(engine_setup):
     cfg, params = engine_setup
     eng = make_engine(cfg, params, max_batch=2)
