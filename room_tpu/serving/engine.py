@@ -138,6 +138,13 @@ class ServingEngine:
                 stops.add(im_end_ids[0])
             self.stop_token_ids = stops
 
+        # tool-call detection: with a real Qwen vocab </tool_call> is one
+        # added special token, so the check is an exact id compare; only
+        # a vocab without that special falls back to scanning decoded
+        # text (reference relies on Ollama doing this internally)
+        tool_end = self.tokenizer.encode("</tool_call>")
+        self._tool_end_id = tool_end[0] if len(tool_end) == 1 else None
+
         # page 0 is the scratch page idle decode slots write into
         self.page_table = PageTable(n_pages, page_size)
         self.page_table.ensure_capacity("__null__", page_size)
@@ -640,6 +647,9 @@ class ServingEngine:
             reason = "stop"
         elif len(turn.new_tokens) >= turn.sampling.max_new_tokens:
             reason = "length"
+        elif self._tool_end_id is not None:
+            if token == self._tool_end_id:
+                reason = "tool_call"
         else:
             tail = self.tokenizer.decode(turn.new_tokens[-24:])
             if "</tool_call>" in tail:

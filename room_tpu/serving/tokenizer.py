@@ -94,7 +94,8 @@ class HFTokenizer:
         )
         self.vocab_size = len(self._tok)
         self.eos_id = self._tok.eos_token_id
-        self.pad_id = self._tok.pad_token_id or self.eos_id
+        pad = self._tok.pad_token_id
+        self.pad_id = pad if pad is not None else self.eos_id  # id 0 is a valid pad
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)
@@ -117,25 +118,87 @@ def render_chat(
     tools: Optional[list[dict]] = None,
     add_generation_prompt: bool = True,
 ) -> str:
-    """messages: [{role, content}]; tools: OpenAI-format tool defs."""
-    parts: list[str] = []
+    """Render a conversation in the upstream Qwen3 chat template.
+
+    Faithful to the Jinja template shipped in the Qwen3 tokenizer config
+    (the model family the reference pinned via Ollama's qwen3-coder:30b —
+    reference: src/shared/local-model.ts:3-5): tools render as a
+    ``# Tools`` section inside the system block with one JSON signature
+    per line in <tools></tools>; assistant tool calls are
+    ``<tool_call>\\n{...}\\n</tool_call>`` blocks; role="tool" results
+    are <tool_response> blocks, with consecutive tool messages sharing
+    one ``<|im_start|>user`` envelope. Golden fixtures:
+    tests/fixtures/chat_template/.
+
+    messages: [{role, content, tool_calls?}]; tools: OpenAI-format defs.
+    """
+    out: list[str] = []
+    msgs = list(messages)
+
     if tools:
-        tool_lines = "\n".join(
-            json.dumps(t, separators=(",", ":")) for t in tools
+        out.append("<|im_start|>system\n")
+        if msgs and msgs[0].get("role") == "system":
+            out.append(f"{msgs[0]['content']}\n\n")
+            msgs = msgs[1:]
+        out.append(
+            "# Tools\n\nYou may call one or more functions to assist "
+            "with the user query.\n\nYou are provided with function "
+            "signatures within <tools></tools> XML tags:\n<tools>"
         )
-        parts.append(
-            "<|im_start|>system\nYou may call tools. Available tools:\n"
-            f"{tool_lines}\n"
-            "To call a tool, emit <tool_call>{\"name\": ..., "
-            "\"arguments\": ...}</tool_call>.<|im_end|>\n"
+        for t in tools:
+            out.append("\n" + json.dumps(t, ensure_ascii=False))
+        out.append(
+            "\n</tools>\n\nFor each function call, return a json object "
+            "with function name and arguments within <tool_call>"
+            "</tool_call> XML tags:\n<tool_call>\n{\"name\": "
+            "<function-name>, \"arguments\": <args-json-object>}\n"
+            "</tool_call><|im_end|>\n"
         )
-    for m in messages:
-        parts.append(
-            f"<|im_start|>{m['role']}\n{m['content']}<|im_end|>\n"
+    elif msgs and msgs[0].get("role") == "system":
+        out.append(
+            f"<|im_start|>system\n{msgs[0]['content']}<|im_end|>\n"
         )
+        msgs = msgs[1:]
+
+    for i, m in enumerate(msgs):
+        role = m.get("role")
+        content = m.get("content") or ""
+        if role == "assistant":
+            out.append("<|im_start|>assistant\n")
+            out.append(content)
+            for call in m.get("tool_calls") or []:
+                fn = call.get("function", call)
+                args = fn.get("arguments")
+                if isinstance(args, str):
+                    try:
+                        args = json.loads(args)
+                    except json.JSONDecodeError:
+                        pass
+                out.append(
+                    "\n<tool_call>\n"
+                    + json.dumps(
+                        {"name": fn.get("name"), "arguments": args},
+                        ensure_ascii=False,
+                    )
+                    + "\n</tool_call>"
+                )
+            out.append("<|im_end|>\n")
+        elif role == "tool":
+            prev_tool = i > 0 and msgs[i - 1].get("role") == "tool"
+            if not prev_tool:
+                out.append("<|im_start|>user")
+            out.append(f"\n<tool_response>\n{content}\n</tool_response>")
+            next_tool = (
+                i + 1 < len(msgs) and msgs[i + 1].get("role") == "tool"
+            )
+            if not next_tool:
+                out.append("<|im_end|>\n")
+        else:
+            out.append(f"<|im_start|>{role}\n{content}<|im_end|>\n")
+
     if add_generation_prompt:
-        parts.append("<|im_start|>assistant\n")
-    return "".join(parts)
+        out.append("<|im_start|>assistant\n")
+    return "".join(out)
 
 
 def extract_tool_call(text: str) -> Optional[dict]:

@@ -153,6 +153,91 @@ def convert_hf_decoder(
     return params
 
 
+def convert_hf_encoder(model_dir: str, cfg=None):
+    """BERT/MiniLM-style safetensors → models.embedder param tree
+    (stacked layer axes, [in, out] matmul orientation). Covers the
+    all-MiniLM-L6-v2 checkpoint the reference embedded with via ONNX
+    (reference: src/shared/embeddings.ts:33-100)."""
+    from ..models.config import EncoderConfig
+
+    cfg = cfg or EncoderConfig()
+    dt = np.float32
+    D, L, F = cfg.hidden, cfg.n_layers, cfg.intermediate
+
+    def zeros(shape):
+        return np.zeros(shape, dt)
+
+    layers = {
+        "wq": zeros((L, D, D)), "bq": zeros((L, D)),
+        "wk": zeros((L, D, D)), "bk": zeros((L, D)),
+        "wv": zeros((L, D, D)), "bv": zeros((L, D)),
+        "wo": zeros((L, D, D)), "bo": zeros((L, D)),
+        "attn_ln_scale": zeros((L, D)), "attn_ln_bias": zeros((L, D)),
+        "w_in": zeros((L, D, F)), "b_in": zeros((L, F)),
+        "w_out": zeros((L, F, D)), "b_out": zeros((L, D)),
+        "ffn_ln_scale": zeros((L, D)), "ffn_ln_bias": zeros((L, D)),
+    }
+    params = {
+        "word_embed": zeros((cfg.vocab_size, D)),
+        "pos_embed": zeros((cfg.max_positions, D)),
+        "type_embed": zeros((2, D)),
+        "embed_ln_scale": zeros((D,)),
+        "embed_ln_bias": zeros((D,)),
+        "layers": layers,
+    }
+
+    top = {
+        "embeddings.word_embeddings.weight": "word_embed",
+        "embeddings.position_embeddings.weight": "pos_embed",
+        "embeddings.token_type_embeddings.weight": "type_embed",
+        "embeddings.LayerNorm.weight": "embed_ln_scale",
+        "embeddings.LayerNorm.bias": "embed_ln_bias",
+    }
+    per_layer = {
+        "attention.self.query.weight": ("wq", True),
+        "attention.self.query.bias": ("bq", False),
+        "attention.self.key.weight": ("wk", True),
+        "attention.self.key.bias": ("bk", False),
+        "attention.self.value.weight": ("wv", True),
+        "attention.self.value.bias": ("bv", False),
+        "attention.output.dense.weight": ("wo", True),
+        "attention.output.dense.bias": ("bo", False),
+        "attention.output.LayerNorm.weight": ("attn_ln_scale", False),
+        "attention.output.LayerNorm.bias": ("attn_ln_bias", False),
+        "intermediate.dense.weight": ("w_in", True),
+        "intermediate.dense.bias": ("b_in", False),
+        "output.dense.weight": ("w_out", True),
+        "output.dense.bias": ("b_out", False),
+        "output.LayerNorm.weight": ("ffn_ln_scale", False),
+        "output.LayerNorm.bias": ("ffn_ln_bias", False),
+    }
+
+    n_loaded = 0
+    for name, tensor in _iter_safetensors(model_dir):
+        # some exports nest under "bert." / "model."
+        for prefix in ("bert.", "model.", ""):
+            if name.startswith(prefix):
+                key = name[len(prefix):]
+                break
+        if key in top:
+            params[top[key]][...] = tensor.astype(dt)
+            n_loaded += 1
+            continue
+        parts = key.split(".")
+        if len(parts) > 3 and parts[0] == "encoder" and \
+                parts[1] == "layer":
+            li = int(parts[2])
+            rest = ".".join(parts[3:])
+            if rest in per_layer:
+                tgt, transpose = per_layer[rest]
+                t = tensor.astype(dt)
+                layers[tgt][li] = t.T if transpose else t
+                n_loaded += 1
+    if n_loaded == 0:
+        raise RuntimeError("no encoder tensors read")
+    return params
+
+
 def main() -> int:
     from .checkpoint import save_params
     from ..providers.tpu import MODEL_CONFIGS
@@ -161,11 +246,14 @@ def main() -> int:
     ap.add_argument("hf_dir")
     ap.add_argument("out_dir")
     ap.add_argument("--model", default="qwen3-coder-30b",
-                    choices=sorted(MODEL_CONFIGS))
+                    choices=sorted(MODEL_CONFIGS) + ["embedder"])
     args = ap.parse_args()
 
-    cfg = MODEL_CONFIGS[args.model]()
-    params = convert_hf_decoder(args.hf_dir, cfg, cfg.dtype)
+    if args.model == "embedder":
+        params = convert_hf_encoder(args.hf_dir)
+    else:
+        cfg = MODEL_CONFIGS[args.model]()
+        params = convert_hf_decoder(args.hf_dir, cfg, cfg.dtype)
     save_params(args.out_dir, params)
     total = sum(int(np.prod(v.shape)) for v in
                 __import__("jax").tree.leaves(params))

@@ -1,0 +1,108 @@
+"""Generate the chat-template golden fixtures
+(tests/fixtures/chat_template/golden.json).
+
+Each case pins (a) the exact rendered string of render_chat — the
+Qwen3-template contract — and (b) the exact token ids under the
+committed mini fixture tokenizer. Regenerate ONLY when the template
+contract deliberately changes; the point of the file is that accidental
+renderer/tokenizer drift fails the golden tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from room_tpu.serving.tokenizer import HFTokenizer, render_chat  # noqa: E402
+
+FIXTURES = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures"
+)
+
+WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the weather for a city",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+
+CASES = [
+    {
+        "name": "system_user",
+        "messages": [
+            {"role": "system", "content": "You are a helpful assistant."},
+            {"role": "user",
+             "content": "What is the weather in Paris today?"},
+        ],
+        "tools": None,
+        "add_generation_prompt": True,
+    },
+    {
+        "name": "tools_section",
+        "messages": [
+            {"role": "system", "content": "You are a helpful assistant."},
+            {"role": "user",
+             "content": "What is the weather in Paris today?"},
+        ],
+        "tools": [WEATHER_TOOL],
+        "add_generation_prompt": True,
+    },
+    {
+        "name": "tool_call_roundtrip",
+        "messages": [
+            {"role": "user",
+             "content": "What is the weather in Paris today?"},
+            {"role": "assistant", "content": "",
+             "tool_calls": [{
+                 "function": {
+                     "name": "get_weather",
+                     "arguments": {"city": "Paris"},
+                 },
+             }]},
+            {"role": "tool",
+             "content": "The weather in Paris is sunny, 22 degrees."},
+            {"role": "tool", "content": "hello world"},
+        ],
+        "tools": [WEATHER_TOOL],
+        "add_generation_prompt": True,
+    },
+    {
+        "name": "no_system_no_genprompt",
+        "messages": [
+            {"role": "user", "content": "hello world"},
+            {"role": "assistant", "content": "the quick brown fox"},
+        ],
+        "tools": None,
+        "add_generation_prompt": False,
+    },
+]
+
+
+def main() -> None:
+    tok = HFTokenizer(os.path.join(FIXTURES, "qwen_mini_tokenizer"))
+    out = []
+    for case in CASES:
+        rendered = render_chat(
+            case["messages"], case["tools"],
+            add_generation_prompt=case["add_generation_prompt"],
+        )
+        out.append({**case, "rendered": rendered,
+                    "ids": tok.encode(rendered)})
+    dest = os.path.join(FIXTURES, "chat_template")
+    os.makedirs(dest, exist_ok=True)
+    with open(os.path.join(dest, "golden.json"), "w") as f:
+        json.dump(out, f, indent=1, ensure_ascii=False)
+    print(f"wrote {len(out)} golden cases to {dest}/golden.json")
+
+
+if __name__ == "__main__":
+    main()

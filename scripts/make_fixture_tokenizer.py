@@ -1,0 +1,95 @@
+"""Generate the committed mini-BPE fixture tokenizer used by the
+tokenizer-fidelity golden tests (tests/fixtures/qwen_mini_tokenizer/).
+
+The fixture mirrors the *shape* of the upstream Qwen3 tokenizer — a
+byte-level BPE with the chat/tool special tokens registered as added
+special tokens (each one id) and eos = <|im_end|> — at a tiny vocab so
+it loads instantly and lives in-tree. Real-vocab golden ids require the
+actual checkpoint assets, which the image cannot download; the fixture
+pins the HFTokenizer code path, the specials-are-single-ids contract,
+and the chat-template renderer byte-for-byte.
+
+Deterministic: re-running produces identical files (fixed corpus, fixed
+vocab size, sorted merges).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures",
+    "qwen_mini_tokenizer",
+)
+
+# the Qwen3 chat/tool specials, one token id each (upstream ids differ;
+# the contract under test is single-id-ness, not the numeric value)
+SPECIALS = [
+    "<|endoftext|>",
+    "<|im_start|>",
+    "<|im_end|>",
+    "<tool_call>",
+    "</tool_call>",
+    "<tool_response>",
+    "</tool_response>",
+    "<tools>",
+    "</tools>",
+]
+
+CORPUS = [
+    "You are a helpful assistant.",
+    "You may call one or more functions to assist with the user query.",
+    "You are provided with function signatures within XML tags:",
+    "For each function call, return a json object with function name "
+    "and arguments within XML tags:",
+    '{"name": "get_weather", "arguments": {"city": "Paris"}}',
+    '{"type": "function", "function": {"name": "search", '
+    '"description": "Search the web", "parameters": {"type": "object", '
+    '"properties": {"query": {"type": "string"}}}}}',
+    "# Tools",
+    "What is the weather in Paris today?",
+    "The weather in Paris is sunny, 22 degrees.",
+    "system user assistant tool",
+    "hello world the quick brown fox jumps over the lazy dog",
+]
+
+
+def main() -> None:
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from tokenizers import decoders
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=1024,
+        special_tokens=SPECIALS,
+        show_progress=False,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(CORPUS, trainer)
+
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    tok.save(os.path.join(FIXTURE_DIR, "tokenizer.json"))
+
+    import json
+
+    with open(
+        os.path.join(FIXTURE_DIR, "tokenizer_config.json"), "w"
+    ) as f:
+        json.dump(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "eos_token": "<|im_end|>",
+                "pad_token": "<|endoftext|>",
+                "model_max_length": 32768,
+            },
+            f,
+            indent=1,
+        )
+    print(f"wrote fixture tokenizer to {FIXTURE_DIR}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

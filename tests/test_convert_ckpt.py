@@ -96,6 +96,74 @@ def test_hf_converter_roundtrip(tmp_path, cfg_fn):
     )
 
 
+def test_hf_encoder_converter_roundtrip(tmp_path):
+    """Encoder params -> BERT-style HF layout -> converter -> identical
+    embeddings (the all-MiniLM-class path the memory system loads)."""
+    from safetensors.numpy import save_file
+
+    from room_tpu.models import embedder
+    from room_tpu.models.config import tiny_encoder
+    from room_tpu.utils.convert import convert_hf_encoder
+
+    cfg = tiny_encoder()
+    params = embedder.init_params(cfg, jax.random.PRNGKey(3))
+
+    def T(x):
+        return np.ascontiguousarray(np.asarray(x, np.float32).T)
+
+    def A(x):
+        return np.asarray(x, np.float32)
+
+    tensors = {
+        "embeddings.word_embeddings.weight": A(params["word_embed"]),
+        "embeddings.position_embeddings.weight": A(params["pos_embed"]),
+        "embeddings.token_type_embeddings.weight": A(params["type_embed"]),
+        "embeddings.LayerNorm.weight": A(params["embed_ln_scale"]),
+        "embeddings.LayerNorm.bias": A(params["embed_ln_bias"]),
+    }
+    lp = params["layers"]
+    hf_map = [
+        ("attention.self.query.weight", "wq", True),
+        ("attention.self.query.bias", "bq", False),
+        ("attention.self.key.weight", "wk", True),
+        ("attention.self.key.bias", "bk", False),
+        ("attention.self.value.weight", "wv", True),
+        ("attention.self.value.bias", "bv", False),
+        ("attention.output.dense.weight", "wo", True),
+        ("attention.output.dense.bias", "bo", False),
+        ("attention.output.LayerNorm.weight", "attn_ln_scale", False),
+        ("attention.output.LayerNorm.bias", "attn_ln_bias", False),
+        ("intermediate.dense.weight", "w_in", True),
+        ("intermediate.dense.bias", "b_in", False),
+        ("output.dense.weight", "w_out", True),
+        ("output.dense.bias", "b_out", False),
+        ("output.LayerNorm.weight", "ffn_ln_scale", False),
+        ("output.LayerNorm.bias", "ffn_ln_bias", False),
+    ]
+    for li in range(cfg.n_layers):
+        for hf, ours, transpose in hf_map:
+            # use the "bert." prefix variant to exercise prefix stripping
+            tensors[f"bert.encoder.layer.{li}.{hf}"] = (
+                T(lp[ours][li]) if transpose else A(lp[ours][li])
+            )
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+
+    import dataclasses
+
+    converted = convert_hf_encoder(str(tmp_path), cfg)
+    tokens = np.array([[5, 6, 7, 8]], np.int32)
+    mask = np.ones((1, 4), np.float32)
+    cfg32 = dataclasses.replace(cfg, dtype="float32")
+    want = embedder.encode(
+        jax.tree.map(lambda x: np.asarray(x, np.float32), params),
+        cfg32, tokens, mask,
+    )
+    got = embedder.encode(converted, cfg32, tokens, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_experiment_harness_runs():
     import subprocess
     import sys

@@ -318,7 +318,8 @@ def test_render_chat_and_tool_extraction():
     tools = [{"name": "ls", "parameters": {}}]
     text = render_chat(msgs, tools)
     assert text.endswith("<|im_start|>assistant\n")
-    assert '"name":"ls"' in text
+    assert '"name": "ls"' in text
+    assert "# Tools" in text and "<tools>" in text  # Qwen3 template shape
 
     call = extract_tool_call(
         'thinking... <tool_call>{"name": "ls", "arguments": {"d": "."}}'

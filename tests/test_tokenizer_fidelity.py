@@ -1,0 +1,125 @@
+"""Tokenizer/chat-template fidelity: golden-pinned rendering + token
+ids under the committed mini-BPE fixture (same shape as the upstream
+Qwen3 tokenizer: byte-level BPE, chat/tool specials as single-id added
+tokens, eos = <|im_end|>). Reference pinned model behavior via Ollama
+(src/shared/local-model.ts:3-5); here the contract is pinned in-tree.
+"""
+
+import json
+import os
+
+import pytest
+
+from room_tpu.serving import SamplingParams, ServingEngine, render_chat
+from room_tpu.serving.tokenizer import HFTokenizer, load_tokenizer
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+TOK_DIR = os.path.join(FIXTURES, "qwen_mini_tokenizer")
+GOLDEN = os.path.join(FIXTURES, "chat_template", "golden.json")
+
+
+@pytest.fixture(scope="module")
+def hf_tok():
+    return HFTokenizer(TOK_DIR)
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    with open(GOLDEN) as f:
+        return json.load(f)
+
+
+def test_goldens_cover_the_contract(goldens):
+    names = {g["name"] for g in goldens}
+    assert {
+        "system_user", "tools_section", "tool_call_roundtrip",
+        "no_system_no_genprompt",
+    } <= names
+
+
+def test_render_chat_matches_goldens(goldens):
+    for g in goldens:
+        got = render_chat(
+            g["messages"], g["tools"],
+            add_generation_prompt=g["add_generation_prompt"],
+        )
+        assert got == g["rendered"], f"template drift in {g['name']}"
+
+
+def test_token_ids_match_goldens(hf_tok, goldens):
+    for g in goldens:
+        assert hf_tok.encode(g["rendered"]) == g["ids"], (
+            f"token-id drift in {g['name']}"
+        )
+
+
+def test_specials_are_single_ids(hf_tok):
+    seen = {}
+    for s in ("<|im_start|>", "<|im_end|>", "<tool_call>",
+              "</tool_call>", "<tool_response>", "</tool_response>",
+              "<|endoftext|>"):
+        ids = hf_tok.encode(s)
+        assert len(ids) == 1, f"{s} tokenized to {ids}"
+        seen[s] = ids[0]
+    assert len(set(seen.values())) == len(seen)  # distinct ids
+    assert hf_tok.eos_id == seen["<|im_end|>"]
+    # pad id 0 must not fall back to eos (`or` bug regression)
+    assert hf_tok.pad_id == seen["<|endoftext|>"]
+
+
+def test_specials_survive_adjacent_text(hf_tok):
+    """A special embedded mid-text still maps to its single id — the
+    property the engine's id-compare stop/tool detection relies on."""
+    text = 'x{"a":1}</tool_call>y'
+    ids = hf_tok.encode(text)
+    tool_end = hf_tok.encode("</tool_call>")[0]
+    assert ids.count(tool_end) == 1
+    assert hf_tok.decode(ids) == text
+
+
+def test_roundtrip(hf_tok, goldens):
+    for g in goldens:
+        assert hf_tok.decode(g["ids"]) == g["rendered"]
+
+
+def test_load_tokenizer_env(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_TOKENIZER_PATH", TOK_DIR)
+    tok = load_tokenizer()
+    assert isinstance(tok, HFTokenizer)
+
+
+def test_engine_tool_detection_is_token_aware(hf_tok):
+    """With a BPE vocab the engine detects </tool_call> by id compare,
+    and stops on <|im_end|> by id — no decoded-substring scanning."""
+    import jax
+
+    from room_tpu.models import qwen3, tiny_moe
+
+    cfg = tiny_moe(vocab_size=max(512, hf_tok.vocab_size))
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(
+        cfg, params, tokenizer=hf_tok, max_batch=2, page_size=8,
+        n_pages=32,
+    )
+    assert eng._tool_end_id == hf_tok.encode("</tool_call>")[0]
+    assert hf_tok.eos_id in eng.stop_token_ids
+
+    # force the model to emit the tool-end id first: pin sampling via a
+    # turn whose max_new_tokens=1 then feed the id through the stop path
+    t = eng.submit(
+        hf_tok.encode("hello world"),
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=3),
+    )
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length", "tool_call")
+    # direct unit check of the detection branch
+    slot_turn = type(t)(
+        session_id="probe", prompt_tokens=[1],
+        sampling=SamplingParams(max_new_tokens=8),
+    )
+    from room_tpu.serving.engine import _Session
+
+    eng.sessions["probe"] = _Session(id="probe")
+    eng._active[0] = slot_turn
+    eng._append_token(0, slot_turn, eng._tool_end_id)
+    assert slot_turn.finish_reason == "tool_call"
