@@ -1,0 +1,409 @@
+"""claude/codex CLI providers: subprocess spawn + streamed JSON parsing.
+
+The reference's primary execution path for subscription users drives the
+installed agent CLIs (reference: src/shared/claude-code.ts:165-353 spawns
+``claude -p <prompt> --output-format stream-json --verbose`` and parses
+assistant/result events; src/shared/agent-executor.ts:154-313 spawns
+``codex exec --json --skip-git-repo-check`` and parses thread.started /
+item.completed JSONL; src/server/provider-cli.ts probes install/auth
+state). This module rebuilds that contract on subprocess + reader
+threads: line-buffered stream parsing, hard timeout with SIGTERM→SIGKILL
+escalation, cooperative abort, session-id capture for resume.
+
+Test seam: ROOM_TPU_CLAUDE_CLI / ROOM_TPU_CODEX_CLI point at the binary
+(mock scripts in tests); otherwise PATH lookup like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .base import ExecutionRequest, ExecutionResult
+
+PROBE_TIMEOUT_S = 1.5
+KILL_GRACE_S = 5.0
+
+
+def resolve_cli_path(provider: str) -> Optional[str]:
+    env_override = os.environ.get(f"ROOM_TPU_{provider.upper()}_CLI")
+    if env_override:
+        return env_override if os.path.exists(env_override) else None
+    return shutil.which(provider)
+
+
+def probe_installed(provider: str) -> dict:
+    """{"installed": bool, "version": str?} via `<cli> --version`
+    (reference: provider-cli.ts probeProviderInstalled)."""
+    path = resolve_cli_path(provider)
+    if not path:
+        return {"installed": False}
+    try:
+        out = subprocess.run(
+            [path, "--version"], capture_output=True, text=True,
+            timeout=PROBE_TIMEOUT_S, env=_clean_env(),
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return {"installed": False}
+    if out.returncode != 0:
+        return {"installed": False}
+    return {"installed": True, "version": out.stdout.strip() or None}
+
+
+def probe_connected(provider: str) -> Optional[bool]:
+    """True/False when determinable, None when the CLI isn't installed
+    (reference: provider-cli.ts probeProviderConnected)."""
+    if not probe_installed(provider)["installed"]:
+        return None
+    home = os.path.expanduser("~")
+    if provider == "claude":
+        if os.environ.get("ANTHROPIC_API_KEY"):
+            return True
+        cred = os.path.join(home, ".claude", ".credentials.json")
+        try:
+            with open(cred) as f:
+                creds = json.load(f)
+            return bool(
+                (creds.get("claudeAiOAuth") or {}).get("accessToken")
+            )
+        except (OSError, json.JSONDecodeError):
+            return False
+    if provider == "codex":
+        if os.environ.get("OPENAI_API_KEY"):
+            return True
+        auth = os.path.join(home, ".codex", "auth.json")
+        try:
+            with open(auth) as f:
+                data = json.load(f)
+            return bool(data.get("OPENAI_API_KEY") or data.get("tokens"))
+        except (OSError, json.JSONDecodeError):
+            return False
+    return None
+
+
+def _clean_env() -> dict:
+    """Reference deletes ELECTRON_RUN_AS_NODE / CLAUDECODE so a spawned
+    claude doesn't think it's nested inside itself."""
+    env = dict(os.environ)
+    env.pop("ELECTRON_RUN_AS_NODE", None)
+    env.pop("CLAUDECODE", None)
+    return env
+
+
+@dataclass
+class CliRunResult:
+    exit_code: int
+    stdout: str = ""
+    stderr: str = ""
+    timed_out: bool = False
+    aborted: bool = False
+    duration_s: float = 0.0
+
+
+def stream_cli(
+    cmd: list[str],
+    on_line: Callable[[str], None],
+    *,
+    timeout_s: float = 900.0,
+    abort_event: Optional[threading.Event] = None,
+) -> CliRunResult:
+    """Spawn, stream stdout line-by-line into ``on_line`` (partial final
+    line included at close, matching the reference's buffer flush), hard
+    timeout + abort with SIGTERM then SIGKILL."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=_clean_env(),
+        )
+    except OSError as e:
+        return CliRunResult(exit_code=1, stderr=f"failed to spawn: {e}")
+
+    stdout_parts: list[str] = []
+    stderr_parts: list[str] = []
+
+    def read_stdout() -> None:
+        buf = ""
+        while True:
+            chunk = proc.stdout.read(4096)
+            if not chunk:
+                break
+            stdout_parts.append(chunk)
+            buf += chunk
+            *lines, buf = buf.split("\n")
+            for line in lines:
+                if line.strip():
+                    try:
+                        on_line(line)
+                    except Exception:
+                        pass
+        if buf.strip():
+            try:
+                on_line(buf)
+            except Exception:
+                pass
+
+    def read_stderr() -> None:
+        while True:
+            chunk = proc.stderr.read(4096)
+            if not chunk:
+                break
+            stderr_parts.append(chunk)
+
+    t_out = threading.Thread(target=read_stdout, daemon=True)
+    t_err = threading.Thread(target=read_stderr, daemon=True)
+    t_out.start()
+    t_err.start()
+
+    deadline = t0 + timeout_s
+    timed_out = aborted = False
+    while True:
+        if proc.poll() is not None:
+            break
+        if abort_event is not None and abort_event.is_set():
+            aborted = True
+            _kill_graceful(proc)
+            break
+        if time.monotonic() >= deadline:
+            timed_out = True
+            _kill_graceful(proc)
+            break
+        time.sleep(0.05)
+
+    proc.wait()
+    t_out.join(timeout=2)
+    t_err.join(timeout=2)
+    code = proc.returncode
+    if aborted:
+        code = 130
+    elif timed_out and code == 0:
+        code = 124
+    return CliRunResult(
+        exit_code=code,
+        stdout="".join(stdout_parts),
+        stderr="".join(stderr_parts),
+        timed_out=timed_out,
+        aborted=aborted,
+        duration_s=time.monotonic() - t0,
+    )
+
+
+def _kill_graceful(proc: subprocess.Popen) -> None:
+    """SIGTERM, then SIGKILL after the grace window (reference's
+    kill('SIGTERM') + 5s setTimeout SIGKILL)."""
+    try:
+        proc.terminate()
+    except OSError:
+        return
+    try:
+        proc.wait(timeout=KILL_GRACE_S)
+    except subprocess.TimeoutExpired:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+# ---- event parsers ----
+
+@dataclass
+class StreamEvents:
+    """Accumulated output of one CLI run."""
+    texts: list[str] = field(default_factory=list)
+    tool_calls: list[dict] = field(default_factory=list)
+    session_id: Optional[str] = None
+    result_text: Optional[str] = None
+
+
+def parse_claude_line(line: str, ev: StreamEvents,
+                      on_text: Optional[Callable] = None) -> None:
+    """claude stream-json events (reference: claude-code.ts:286-330)."""
+    try:
+        event = json.loads(line)
+    except json.JSONDecodeError:
+        return
+    etype = event.get("type")
+    if etype == "assistant":
+        content = (event.get("message") or {}).get("content")
+        if isinstance(content, list):
+            for block in content:
+                if not isinstance(block, dict):
+                    continue
+                if block.get("type") == "text" and \
+                        isinstance(block.get("text"), str):
+                    ev.texts.append(block["text"])
+                    if on_text:
+                        on_text(block["text"])
+                elif block.get("type") == "tool_use":
+                    ev.tool_calls.append({
+                        "name": block.get("name", "tool"),
+                        "arguments": block.get("input") or {},
+                    })
+    elif etype == "result":
+        if event.get("result"):
+            ev.result_text = str(event["result"])
+        if event.get("session_id"):
+            ev.session_id = str(event["session_id"])
+
+
+def parse_codex_line(line: str, ev: StreamEvents,
+                     on_text: Optional[Callable] = None) -> None:
+    """codex exec --json JSONL (reference: agent-executor.ts:768-808)."""
+    try:
+        event = json.loads(line)
+    except json.JSONDecodeError:
+        return
+    etype = event.get("type")
+    if etype == "thread.started" and \
+            isinstance(event.get("thread_id"), str):
+        ev.session_id = event["thread_id"]
+        return
+    if etype == "item.completed":
+        item = event.get("item") or {}
+        itype = item.get("type")
+        if itype == "agent_message" and isinstance(item.get("text"), str):
+            ev.texts.append(item["text"])
+            if on_text:
+                on_text(item["text"])
+        elif itype == "mcp_tool_call":
+            ev.tool_calls.append({
+                "name": item.get("tool", "unknown"),
+                "arguments": item.get("arguments") or {},
+            })
+
+
+# ---- providers ----
+
+class ClaudeCliProvider:
+    """Drives the installed `claude` CLI in headless print mode."""
+
+    def __init__(self, model: str = "") -> None:
+        self.name = "claude"
+        self.model = model  # suffix after claude:, may be ""
+
+    def is_ready(self) -> tuple[bool, str]:
+        probe = probe_installed("claude")
+        if not probe["installed"]:
+            return False, (
+                "claude CLI not found. Install from "
+                "https://docs.anthropic.com/en/docs/claude-code"
+            )
+        connected = probe_connected("claude")
+        if connected is False:
+            return False, (
+                "claude CLI not authenticated: run `claude login` or "
+                "set ANTHROPIC_API_KEY"
+            )
+        return True, f"claude CLI {probe.get('version') or ''}".strip()
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        path = resolve_cli_path("claude")
+        if not path:
+            return ExecutionResult(
+                success=False, error="claude CLI not found",
+            )
+        args = [path, "-p", request.prompt,
+                "--output-format", "stream-json", "--verbose"]
+        if request.session_id:
+            args += ["--resume", request.session_id]
+        if request.system_prompt:
+            args += ["--system-prompt", request.system_prompt]
+        if self.model:
+            args += ["--model", self.model]
+        if request.max_turns:
+            args += ["--max-turns", str(request.max_turns)]
+
+        ev = StreamEvents()
+        run = stream_cli(
+            args,
+            lambda line: parse_claude_line(line, ev, request.on_text),
+            timeout_s=request.timeout_s,
+        )
+        text = ev.result_text or "\n\n".join(ev.texts).strip()
+        result = ExecutionResult(
+            text=text,
+            session_id=ev.session_id,
+            tool_calls=ev.tool_calls,
+            turns_used=1,
+        )
+        if run.timed_out:
+            result.success = False
+            result.error = f"timeout after {request.timeout_s}s"
+        elif run.exit_code != 0:
+            result.success = False
+            result.error = (
+                run.stderr.strip() or f"claude exited {run.exit_code}"
+            )
+        return result
+
+
+class CodexCliProvider:
+    """Drives the installed `codex` CLI in exec JSONL mode."""
+
+    def __init__(self, model: str = "") -> None:
+        self.name = "codex"
+        self.model = model
+
+    def is_ready(self) -> tuple[bool, str]:
+        probe = probe_installed("codex")
+        if not probe["installed"]:
+            return False, (
+                "codex CLI not found. Install with "
+                "`npm install -g @openai/codex`"
+            )
+        connected = probe_connected("codex")
+        if connected is False:
+            return False, (
+                "codex CLI not authenticated: run `codex login` or set "
+                "OPENAI_API_KEY"
+            )
+        return True, f"codex CLI {probe.get('version') or ''}".strip()
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        path = resolve_cli_path("codex")
+        if not path:
+            return ExecutionResult(
+                success=False, error="codex CLI not found",
+            )
+        prompt = request.prompt
+        if request.system_prompt:
+            prompt = f"{request.system_prompt}\n\n{prompt}"
+        if request.session_id:
+            args = [path, "exec", "resume", "--json",
+                    "--skip-git-repo-check", request.session_id, prompt]
+        else:
+            args = [path, "exec", "--json", "--skip-git-repo-check",
+                    prompt]
+        if self.model:
+            # lands before --json in both forms (reference's splice(2))
+            i = args.index("--json")
+            args[i:i] = ["--model", self.model]
+
+        ev = StreamEvents()
+        run = stream_cli(
+            args,
+            lambda line: parse_codex_line(line, ev, request.on_text),
+            timeout_s=request.timeout_s,
+        )
+        text = "\n\n".join(ev.texts).strip() or run.stderr.strip()
+        result = ExecutionResult(
+            text=text,
+            session_id=ev.session_id or request.session_id,
+            tool_calls=ev.tool_calls,
+            turns_used=1,
+        )
+        if run.timed_out:
+            result.success = False
+            result.error = f"timeout after {request.timeout_s}s"
+        elif run.exit_code != 0:
+            result.success = False
+            result.error = (
+                run.stderr.strip() or f"codex exited {run.exit_code}"
+            )
+        return result

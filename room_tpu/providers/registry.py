@@ -8,6 +8,8 @@ Grammar:
     anthropic:<model>           Anthropic HTTP API
     gemini:<model>              Gemini HTTP API (OpenAI-compat endpoint)
     ollama:<tag>                localhost Ollama daemon (compat path)
+    claude / claude:<model>     installed claude CLI (subscription auth)
+    codex / codex:<model>       installed codex CLI (subscription auth)
 """
 
 from __future__ import annotations
@@ -19,6 +21,7 @@ from .base import Provider, ProviderError
 
 PROVIDER_PREFIXES = (
     "tpu", "echo", "openai", "anthropic", "gemini", "ollama",
+    "claude", "codex",
 )
 DEFAULT_MODEL = "tpu"
 DEFAULT_TPU_MODEL = "qwen3-coder-30b"
@@ -82,6 +85,14 @@ def get_model_provider(
         from .http_api import AnthropicProvider
 
         inst = AnthropicProvider(model_name(model), db=db)
+    elif kind == "claude":
+        from .cli import ClaudeCliProvider
+
+        inst = ClaudeCliProvider(model_name(model))
+    elif kind == "codex":
+        from .cli import CodexCliProvider
+
+        inst = CodexCliProvider(model_name(model))
     else:  # pragma: no cover
         raise ProviderError(f"unknown provider for model {model!r}")
 

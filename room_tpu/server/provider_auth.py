@@ -1,0 +1,256 @@
+"""Provider auth sessions: run `<cli> login` server-side and stream its
+output to the dashboard so the keeper can complete OAuth device flows
+(reference: src/server/provider-auth.ts — session store, line ring
+buffer, verification-URL/device-code extraction, one active session per
+provider, timeout + TTL cleanup).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..providers.cli import _clean_env, resolve_cli_path
+
+MAX_LINES = max(
+    50, int(os.environ.get("ROOM_TPU_PROVIDER_AUTH_MAX_LINES", "300"))
+)
+SESSION_TIMEOUT_S = max(
+    30.0,
+    float(os.environ.get("ROOM_TPU_PROVIDER_AUTH_TIMEOUT_S", "900")),
+)
+SESSION_TTL_S = max(
+    60.0,
+    float(os.environ.get("ROOM_TPU_PROVIDER_AUTH_TTL_S", "7200")),
+)
+
+_URL_RE = re.compile(r"https://\S+", re.IGNORECASE)
+_CODE_RE = re.compile(r"\b([A-Z0-9]{4}-?[A-Z0-9]{4,6})\b")
+
+ACTIVE_STATUSES = ("starting", "running")
+
+
+@dataclass
+class AuthSession:
+    session_id: str
+    provider: str
+    command: str
+    status: str = "starting"  # starting|running|completed|failed|canceled|timeout
+    started_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+    ended_at: Optional[float] = None
+    exit_code: Optional[int] = None
+    verification_url: Optional[str] = None
+    device_code: Optional[str] = None
+    lines: list[dict] = field(default_factory=list)
+    _proc: Optional[subprocess.Popen] = None
+    _seq: int = 0
+    _stop_reason: Optional[str] = None
+
+    def view(self) -> dict:
+        return {
+            "sessionId": self.session_id,
+            "provider": self.provider,
+            "status": self.status,
+            "command": self.command,
+            "startedAt": self.started_at,
+            "updatedAt": self.updated_at,
+            "endedAt": self.ended_at,
+            "exitCode": self.exit_code,
+            "verificationUrl": self.verification_url,
+            "deviceCode": self.device_code,
+            "active": self.status in ACTIVE_STATUSES,
+            "lines": list(self.lines),
+        }
+
+
+class ProviderAuthManager:
+    def __init__(self) -> None:
+        self._sessions: dict[str, AuthSession] = {}
+        self._active_by_provider: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # ---- public API ----
+
+    def start(self, provider: str) -> dict:
+        if provider not in ("claude", "codex"):
+            raise ValueError(f"unknown provider {provider!r}")
+        path = resolve_cli_path(provider)
+        if not path:
+            raise FileNotFoundError(f"{provider} CLI not installed")
+
+        with self._lock:
+            self._cleanup_locked()
+            active_id = self._active_by_provider.get(provider)
+            if active_id:
+                active = self._sessions.get(active_id)
+                if active and active.status in ACTIVE_STATUSES:
+                    return active.view()  # one login flow at a time
+
+            sess = AuthSession(
+                session_id=uuid.uuid4().hex,
+                provider=provider,
+                command=f"{provider} login",
+            )
+            try:
+                sess._proc = subprocess.Popen(
+                    [path, "login"],
+                    stdin=subprocess.DEVNULL,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=_clean_env(),
+                )
+            except OSError as e:
+                sess.status = "failed"
+                sess.ended_at = time.time()
+                self._append_line(sess, "system", f"spawn failed: {e}")
+                self._sessions[sess.session_id] = sess
+                return sess.view()
+            sess.status = "running"
+            self._sessions[sess.session_id] = sess
+            self._active_by_provider[provider] = sess.session_id
+
+        threading.Thread(
+            target=self._pump, args=(sess, "stdout"), daemon=True,
+        ).start()
+        threading.Thread(
+            target=self._pump, args=(sess, "stderr"), daemon=True,
+        ).start()
+        threading.Thread(
+            target=self._reap, args=(sess,), daemon=True,
+        ).start()
+        return sess.view()
+
+    def get(self, session_id: str) -> Optional[dict]:
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            return sess.view() if sess else None
+
+    def active_for(self, provider: str) -> Optional[dict]:
+        with self._lock:
+            sid = self._active_by_provider.get(provider)
+            sess = self._sessions.get(sid) if sid else None
+            if sess and sess.status in ACTIVE_STATUSES:
+                return sess.view()
+            return None
+
+    def cancel(self, session_id: str) -> Optional[dict]:
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is None:
+                return None
+            if sess.status in ACTIVE_STATUSES and sess._proc:
+                sess._stop_reason = "canceled"
+                try:
+                    sess._proc.terminate()
+                except OSError:
+                    pass
+            return sess.view()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for sess in sessions:
+            if sess.status in ACTIVE_STATUSES and sess._proc:
+                sess._stop_reason = "canceled"
+                try:
+                    sess._proc.kill()
+                except OSError:
+                    pass
+
+    # ---- internals ----
+
+    def _append_line(self, sess: AuthSession, stream: str,
+                     text: str) -> None:
+        sess._seq += 1
+        sess.lines.append({
+            "id": sess._seq, "stream": stream, "text": text,
+            "timestamp": time.time(),
+        })
+        if len(sess.lines) > MAX_LINES:
+            del sess.lines[: len(sess.lines) - MAX_LINES]
+        sess.updated_at = time.time()
+        if sess.verification_url is None:
+            m = _URL_RE.search(text)
+            if m:
+                sess.verification_url = m.group(0).rstrip(".,)")
+        if sess.device_code is None:
+            m = _CODE_RE.search(text)
+            if m:
+                sess.device_code = m.group(1)
+
+    def _pump(self, sess: AuthSession, which: str) -> None:
+        pipe = getattr(sess._proc, which)
+        for line in iter(pipe.readline, ""):
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            with self._lock:
+                self._append_line(sess, which, line)
+
+    def _reap(self, sess: AuthSession) -> None:
+        deadline = sess.started_at + SESSION_TIMEOUT_S
+        while True:
+            code = sess._proc.poll()
+            if code is not None:
+                break
+            if time.time() >= deadline:
+                sess._stop_reason = sess._stop_reason or "timeout"
+                try:
+                    sess._proc.terminate()
+                except OSError:
+                    pass
+                try:
+                    sess._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    try:
+                        sess._proc.kill()
+                    except OSError:
+                        pass
+                code = sess._proc.wait()
+                break
+            time.sleep(0.1)
+        with self._lock:
+            sess.exit_code = code
+            sess.ended_at = time.time()
+            if sess._stop_reason == "canceled":
+                sess.status = "canceled"
+            elif sess._stop_reason == "timeout":
+                sess.status = "timeout"
+            else:
+                sess.status = "completed" if code == 0 else "failed"
+            self._append_line(
+                sess, "system",
+                f"{sess.command} exited with {code} ({sess.status})",
+            )
+            if self._active_by_provider.get(sess.provider) == \
+                    sess.session_id:
+                del self._active_by_provider[sess.provider]
+
+    def _cleanup_locked(self) -> None:
+        cutoff = time.time() - SESSION_TTL_S
+        for sid in [
+            sid for sid, s in self._sessions.items()
+            if s.status not in ACTIVE_STATUSES
+            and (s.ended_at or s.started_at) < cutoff
+        ]:
+            del self._sessions[sid]
+
+
+_manager: Optional[ProviderAuthManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_auth_manager() -> ProviderAuthManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = ProviderAuthManager()
+        return _manager

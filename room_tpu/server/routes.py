@@ -51,7 +51,63 @@ def register_all_routes(r: Router) -> None:
     register_settings_routes(r)
     register_status_routes(r)
     register_clerk_routes(r)
+    register_provider_routes(r)
     register_aux_routes(r)
+
+
+def register_provider_routes(r: Router) -> None:
+    """CLI provider probes + server-side login sessions (reference:
+    src/server/provider-cli.ts, provider-auth.ts, routes/providers)."""
+
+    def providers_status(ctx):
+        from ..providers.cli import probe_connected, probe_installed
+
+        out = {}
+        for provider in ("claude", "codex"):
+            probe = probe_installed(provider)
+            out[provider] = {
+                "installed": probe["installed"],
+                "version": probe.get("version"),
+                "connected": probe_connected(provider),
+            }
+        return ok(out)
+
+    def auth_start(ctx):
+        from .provider_auth import get_auth_manager
+
+        provider = ctx.params["provider"]
+        try:
+            return ok(get_auth_manager().start(provider), 201)
+        except ValueError as e:
+            return err(str(e))
+        except FileNotFoundError as e:
+            return err(str(e), 409)
+
+    def auth_get(ctx):
+        from .provider_auth import get_auth_manager
+
+        view = get_auth_manager().active_for(ctx.params["provider"])
+        if view is None:
+            return err("no active auth session", 404)
+        return ok(view)
+
+    def auth_session_get(ctx):
+        from .provider_auth import get_auth_manager
+
+        view = get_auth_manager().get(ctx.params["sid"])
+        return ok(view) if view else err("unknown session", 404)
+
+    def auth_cancel(ctx):
+        from .provider_auth import get_auth_manager
+
+        view = get_auth_manager().cancel(ctx.params["sid"])
+        return ok(view) if view else err("unknown session", 404)
+
+    r.get("/api/providers", providers_status)
+    r.post("/api/providers/:provider/auth/start", auth_start)
+    r.get("/api/providers/:provider/auth", auth_get)
+    r.get("/api/providers/auth/sessions/:sid", auth_session_get)
+    r.post("/api/providers/auth/sessions/:sid/cancel", auth_cancel)
 
 
 def register_aux_routes(r: Router) -> None:
