@@ -1,0 +1,286 @@
+"""EVM transaction signing: secp256k1 ECDSA (RFC 6979 deterministic
+nonce, EIP-2 low-s), RLP, and EIP-1559 (type-2) encoding — fully
+offline, stdlib-only (reference: src/shared/wallet.ts:19-37 signs and
+sends via viem; identity.ts:19-61 registers on-chain).
+
+Pure Python is the right tool here: signing happens a handful of times
+per agent action on the host, nowhere near the TPU hot path. The ECDSA
+implementation is cross-checked in tests against the independent
+`cryptography` package verifier and the widely published RFC 6979
+secp256k1 vectors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Optional, Sequence, Union
+
+from .keccak import keccak256
+
+# secp256k1 domain parameters
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+Gx = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+Gy = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+# ---- EC arithmetic (Jacobian coordinates) ----
+
+def _jac_double(p):
+    x, y, z = p
+    if y == 0:
+        return (0, 0, 0)
+    ysq = (y * y) % P
+    s = (4 * x * ysq) % P
+    m = (3 * x * x) % P
+    nx = (m * m - 2 * s) % P
+    ny = (m * (s - nx) - 8 * ysq * ysq) % P
+    nz = (2 * y * z) % P
+    return (nx, ny, nz)
+
+
+def _jac_add(p, q):
+    if p[2] == 0:
+        return q
+    if q[2] == 0:
+        return p
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = (z1 * z1) % P
+    z2z2 = (z2 * z2) % P
+    u1 = (x1 * z2z2) % P
+    u2 = (x2 * z1z1) % P
+    s1 = (y1 * z2 * z2z2) % P
+    s2 = (y2 * z1 * z1z1) % P
+    if u1 == u2:
+        if s1 != s2:
+            return (0, 0, 0)
+        return _jac_double(p)
+    h = (u2 - u1) % P
+    r = (s2 - s1) % P
+    h2 = (h * h) % P
+    h3 = (h * h2) % P
+    u1h2 = (u1 * h2) % P
+    nx = (r * r - h3 - 2 * u1h2) % P
+    ny = (r * (u1h2 - nx) - s1 * h3) % P
+    nz = (h * z1 * z2) % P
+    return (nx, ny, nz)
+
+
+def _jac_mul(p, k: int):
+    result = (0, 0, 0)
+    addend = p
+    while k:
+        if k & 1:
+            result = _jac_add(result, addend)
+        addend = _jac_double(addend)
+        k >>= 1
+    return result
+
+
+def _to_affine(p) -> Optional[tuple[int, int]]:
+    x, y, z = p
+    if z == 0:
+        return None
+    zinv = pow(z, P - 2, P)
+    zinv2 = (zinv * zinv) % P
+    return (x * zinv2) % P, (y * zinv2 * zinv) % P
+
+
+def pubkey_point(private_key: bytes) -> tuple[int, int]:
+    d = int.from_bytes(private_key, "big")
+    if not 0 < d < N:
+        raise ValueError("private key out of range")
+    pt = _to_affine(_jac_mul((Gx, Gy, 1), d))
+    assert pt is not None
+    return pt
+
+
+# ---- RFC 6979 deterministic nonce ----
+
+def _rfc6979_k(msg_hash: bytes, private_key: bytes) -> int:
+    qlen = 32
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    x = private_key.rjust(qlen, b"\x00")
+    h1 = int.from_bytes(msg_hash, "big") % N
+    bh = h1.to_bytes(qlen, "big")
+    k = hmac.new(k, v + b"\x00" + x + bh, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + bh, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def ecdsa_sign(msg_hash: bytes, private_key: bytes) -> tuple[int, int, int]:
+    """Sign a 32-byte digest. Returns (r, s, y_parity) with low-s
+    (EIP-2) so the signature is Ethereum-canonical."""
+    if len(msg_hash) != 32:
+        raise ValueError("msg_hash must be 32 bytes")
+    d = int.from_bytes(private_key, "big")
+    if not 0 < d < N:
+        raise ValueError("private key out of range")
+    z = int.from_bytes(msg_hash, "big") % N
+    while True:
+        k = _rfc6979_k(msg_hash, private_key)
+        pt = _to_affine(_jac_mul((Gx, Gy, 1), k))
+        if pt is None:
+            continue
+        x1, y1 = pt
+        r = x1 % N
+        if r == 0:
+            continue
+        s = (pow(k, N - 2, N) * (z + r * d)) % N
+        if s == 0:
+            continue
+        recid = (y1 & 1) | (2 if x1 >= N else 0)
+        if s > N // 2:
+            s = N - s
+            recid ^= 1
+        return r, s, recid
+
+
+def ecdsa_recover(msg_hash: bytes, r: int, s: int,
+                  y_parity: int) -> tuple[int, int]:
+    """Recover the public key point (the ecrecover primitive)."""
+    x = r + (N if y_parity >= 2 else 0)
+    if x >= P:
+        raise ValueError("invalid r")
+    alpha = (pow(x, 3, P) + 7) % P
+    y = pow(alpha, (P + 1) // 4, P)
+    if (y * y) % P != alpha:
+        raise ValueError("point not on curve")
+    if (y & 1) != (y_parity & 1):
+        y = P - y
+    z = int.from_bytes(msg_hash, "big") % N
+    rinv = pow(r, N - 2, N)
+    # Q = r^-1 (sR - zG)
+    srp = _jac_mul((x, y, 1), s)
+    zg = _jac_mul((Gx, Gy, 1), (N - z) % N)
+    q = _to_affine(_jac_mul(_jac_add(srp, zg), rinv))
+    if q is None:
+        raise ValueError("recovery failed")
+    return q
+
+
+def point_to_address(pt: tuple[int, int]) -> str:
+    pub = pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+    return "0x" + keccak256(pub)[-20:].hex()
+
+
+# ---- RLP ----
+
+RlpItem = Union[bytes, int, str, Sequence]
+
+
+def _to_bytes(item: RlpItem) -> bytes:
+    if isinstance(item, bytes):
+        return item
+    if isinstance(item, bytearray):
+        return bytes(item)
+    if isinstance(item, int):
+        if item < 0:
+            raise ValueError("RLP cannot encode negative ints")
+        if item == 0:
+            return b""
+        return item.to_bytes((item.bit_length() + 7) // 8, "big")
+    if isinstance(item, str):
+        if item.startswith("0x"):
+            h = item[2:]
+            if len(h) % 2:
+                h = "0" + h
+            return bytes.fromhex(h)
+        return item.encode()
+    raise TypeError(f"cannot RLP-encode {type(item)}")
+
+
+def rlp_encode(item: RlpItem) -> bytes:
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(rlp_encode(x) for x in item)
+        if len(payload) <= 55:
+            return bytes([0xC0 + len(payload)]) + payload
+        ln = _to_bytes(len(payload))
+        return bytes([0xF7 + len(ln)]) + ln + payload
+    b = _to_bytes(item)
+    if len(b) == 1 and b[0] <= 0x7F:
+        return b
+    if len(b) <= 55:
+        return bytes([0x80 + len(b)]) + b
+    ln = _to_bytes(len(b))
+    return bytes([0xB7 + len(ln)]) + ln + b
+
+
+# ---- EIP-1559 transactions ----
+
+def encode_eip1559_unsigned(
+    *,
+    chain_id: int,
+    nonce: int,
+    max_priority_fee_per_gas: int,
+    max_fee_per_gas: int,
+    gas_limit: int,
+    to: Optional[str],
+    value: int,
+    data: bytes = b"",
+    access_list: Sequence = (),
+) -> bytes:
+    fields = [
+        chain_id, nonce, max_priority_fee_per_gas, max_fee_per_gas,
+        gas_limit, to if to is not None else b"", value, data,
+        list(access_list),
+    ]
+    return b"\x02" + rlp_encode(fields)
+
+
+def sign_eip1559(
+    private_key: bytes,
+    *,
+    chain_id: int,
+    nonce: int,
+    max_priority_fee_per_gas: int,
+    max_fee_per_gas: int,
+    gas_limit: int,
+    to: Optional[str],
+    value: int,
+    data: bytes = b"",
+    access_list: Sequence = (),
+) -> dict:
+    """Returns {"raw": 0x-hex raw tx, "hash": 0x-hex tx hash, r, s,
+    yParity} ready for eth_sendRawTransaction."""
+    unsigned = encode_eip1559_unsigned(
+        chain_id=chain_id, nonce=nonce,
+        max_priority_fee_per_gas=max_priority_fee_per_gas,
+        max_fee_per_gas=max_fee_per_gas, gas_limit=gas_limit, to=to,
+        value=value, data=data, access_list=access_list,
+    )
+    digest = keccak256(unsigned)
+    r, s, y_parity = ecdsa_sign(digest, private_key)
+    if y_parity >= 2:  # astronomically rare r >= N wrap; not canonical
+        raise ValueError("non-canonical signature (r overflow), retry")
+    fields = [
+        chain_id, nonce, max_priority_fee_per_gas, max_fee_per_gas,
+        gas_limit, to if to is not None else b"", value, data,
+        list(access_list), y_parity, r, s,
+    ]
+    raw = b"\x02" + rlp_encode(fields)
+    return {
+        "raw": "0x" + raw.hex(),
+        "hash": "0x" + keccak256(raw).hex(),
+        "r": hex(r),
+        "s": hex(s),
+        "yParity": y_parity,
+    }
+
+
+def erc20_transfer_data(to: str, amount: int) -> bytes:
+    """transfer(address,uint256) calldata."""
+    selector = bytes.fromhex("a9059cbb")
+    addr = bytes.fromhex(to[2:].lower()).rjust(32, b"\x00")
+    return selector + addr + amount.to_bytes(32, "big")

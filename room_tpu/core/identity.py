@@ -92,10 +92,35 @@ def register_room_identity(
     }
     if dry_run:
         return {"tx": tx, "metadata": metadata, "submitted": False}
-    raise WalletError(
-        "on-chain submission requires network access; run with RPC "
-        "available and dry_run=False via the wallet signer"
+
+    # live submission: sign the registration call and broadcast
+    # (fail-closed: the nonce/fee RPC reads raise without network)
+    from .ethtx import sign_eip1559
+    from .wallet import _rpc, decrypt_wallet_key
+    from .chains import CHAINS
+
+    cfg = CHAINS[chain]
+    nonce = int(_rpc(
+        chain, "eth_getTransactionCount", [wallet["address"], "pending"]
+    ), 16)
+    base_fee = int(_rpc(chain, "eth_gasPrice", []), 16)
+    priority = max(base_fee // 10, 1_000_000)
+    signed = sign_eip1559(
+        decrypt_wallet_key(wallet),
+        chain_id=cfg.chain_id,
+        nonce=nonce,
+        max_priority_fee_per_gas=priority,
+        max_fee_per_gas=base_fee * 2 + priority,
+        gas_limit=300_000,
+        to=registry,
+        value=0,
+        data=bytes.fromhex(tx["data"][2:]),
     )
+    tx_hash = _rpc(chain, "eth_sendRawTransaction", [signed["raw"]])
+    return {
+        "tx": tx, "metadata": metadata, "submitted": True,
+        "txHash": tx_hash,
+    }
 
 
 def record_registration(

@@ -1,9 +1,10 @@
 """Per-room EVM wallet (reference: src/shared/wallet.ts).
 
-Key generation and address derivation run fully offline (secp256k1 via the
-cryptography package, Keccak-256 in-tree). Balance reads and ERC-20
-transfers need chain RPC; with no network they fail closed with a clear
-error, mirroring the reference's fail-closed posture for its local model."""
+Key generation, address derivation, and transaction signing run fully
+offline (secp256k1 + RFC 6979 + EIP-1559 in-tree via core.ethtx,
+Keccak-256 in-tree). Balance reads and broadcast need chain RPC; with no
+network they fail closed with a clear error, mirroring the reference's
+fail-closed posture for its local model."""
 
 from __future__ import annotations
 
@@ -165,3 +166,85 @@ def get_token_balance(
         [{"to": token_addr, "data": calldata}, "latest"],
     )
     return int(result, 16) if result not in (None, "0x") else 0
+
+
+# ---- transfers (reference: wallet.ts:19-37 signs + sends via viem) ----
+
+DEFAULT_GAS_LIMIT = 120_000
+
+
+def build_signed_transfer(
+    db: Database,
+    room_id: int,
+    to: str,
+    amount: int,
+    token: str = "usdc",
+    *,
+    nonce: int,
+    max_fee_per_gas: int,
+    max_priority_fee_per_gas: int,
+    gas_limit: int = DEFAULT_GAS_LIMIT,
+) -> dict:
+    """Sign an ERC-20 transfer fully offline (explicit nonce/fees).
+    Returns {"raw", "hash", ...} for eth_sendRawTransaction."""
+    from .ethtx import erc20_transfer_data, sign_eip1559
+
+    wallet = get_room_wallet(db, room_id)
+    if wallet is None:
+        raise WalletError(f"room {room_id} has no wallet")
+    cfg = CHAINS[wallet["chain"]]
+    token_addr = getattr(cfg, token, None)
+    if not token_addr:
+        raise WalletError(f"no {token} on chain {wallet['chain']}")
+    if not (isinstance(to, str) and to.startswith("0x")
+            and len(to) == 42):
+        raise WalletError(f"invalid recipient address {to!r}")
+    if amount <= 0:
+        raise WalletError("amount must be positive")
+    key = decrypt_wallet_key(wallet)
+    return sign_eip1559(
+        key,
+        chain_id=cfg.chain_id,
+        nonce=nonce,
+        max_priority_fee_per_gas=max_priority_fee_per_gas,
+        max_fee_per_gas=max_fee_per_gas,
+        gas_limit=gas_limit,
+        to=token_addr,
+        value=0,
+        data=erc20_transfer_data(to, amount),
+    )
+
+
+def transfer_token(
+    db: Database,
+    room_id: int,
+    to: str,
+    amount: int,
+    token: str = "usdc",
+    description: Optional[str] = None,
+) -> dict:
+    """Online transfer: fetch nonce + fees over RPC, sign, broadcast,
+    record. Fail-closed without network (the RPC fetch raises first)."""
+    wallet = get_room_wallet(db, room_id)
+    if wallet is None:
+        raise WalletError(f"room {room_id} has no wallet")
+    chain = wallet["chain"]
+    nonce = int(_rpc(
+        chain, "eth_getTransactionCount",
+        [wallet["address"], "pending"],
+    ), 16)
+    base_fee = int(_rpc(chain, "eth_gasPrice", []), 16)
+    priority = max(base_fee // 10, 1_000_000)  # modest tip
+    signed = build_signed_transfer(
+        db, room_id, to, amount, token,
+        nonce=nonce,
+        max_fee_per_gas=base_fee * 2 + priority,
+        max_priority_fee_per_gas=priority,
+    )
+    tx_hash = _rpc(chain, "eth_sendRawTransaction", [signed["raw"]])
+    record_transaction(
+        db, wallet["id"], "debit", str(amount), counterparty=to,
+        tx_hash=tx_hash, description=description, status="pending",
+        category="transfer",
+    )
+    return {"txHash": tx_hash, "raw": signed["raw"]}

@@ -997,9 +997,41 @@ def register_wallet_routes(r: Router) -> None:
             return err(str(ex), 503)
         return ok({"native_wei": str(native), "usdc_units": str(usdc)})
 
+    def withdraw(ctx):
+        """ERC-20 withdraw (reference: routes/wallet.ts:162-230
+        POST /wallet/withdraw): validate → sign → broadcast; fails
+        closed (503) without chain RPC."""
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        body = ctx.body or {}
+        to = (body.get("to") or "").strip()
+        amount_raw = str(body.get("amount") or "").strip()
+        token = body.get("token") or "usdc"
+        if not to or not amount_raw:
+            return err("Missing required fields: to, amount")
+        if not re.fullmatch(r"0x[0-9a-fA-F]{40}", to):
+            return err("Invalid address")
+        try:
+            amount = int(amount_raw)
+        except ValueError:
+            return err("Invalid amount: integer token units required")
+        if amount <= 0:
+            return err("Invalid amount")
+        try:
+            out = wallet_mod.transfer_token(
+                ctx.db, room["id"], to, amount, token,
+                description=body.get("description"),
+            )
+        except wallet_mod.WalletError as ex:
+            status = 503 if "unreachable" in str(ex) else 400
+            return err(str(ex), status)
+        return ok(out)
+
     r.get("/api/rooms/:id/wallet", get_wallet)
     r.get("/api/rooms/:id/wallet/transactions", transactions)
     r.get("/api/rooms/:id/wallet/balance", balance)
+    r.post("/api/rooms/:id/wallet/withdraw", withdraw)
 
 
 # ---- settings / status / clerk ----

@@ -1,0 +1,267 @@
+"""EVM signing tests: RFC 6979 published vectors, cross-verification
+against the independent `cryptography` ECDSA implementation, RLP
+goldens from the Ethereum spec, EIP-1559 encode/recover round-trip,
+and the wallet transfer path (reference: src/shared/wallet.ts:19-37)."""
+
+import hashlib
+
+import pytest
+
+from room_tpu.core.ethtx import (
+    N, ecdsa_recover, ecdsa_sign, encode_eip1559_unsigned,
+    erc20_transfer_data, point_to_address, pubkey_point, rlp_encode,
+    sign_eip1559, _rfc6979_k,
+)
+from room_tpu.core.keccak import keccak256
+
+
+# ---- RFC 6979 deterministic nonces (published secp256k1 vectors,
+# SHA-256; the classic set circulated by the Bitcoin implementations) --
+
+def test_rfc6979_vector_satoshi():
+    priv = (1).to_bytes(32, "big")
+    h = hashlib.sha256(b"Satoshi Nakamoto").digest()
+    k = _rfc6979_k(h, priv)
+    assert k == int(
+        "8F8A276C19F4149656B280621E358CCE24F5F52542772691EE69063B74F15D15",
+        16,
+    )
+    r, s, _ = ecdsa_sign(h, priv)
+    assert r == int(
+        "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8",
+        16,
+    )
+    assert s == int(
+        "2442ce9d2b916064108014783e923ec36b49743e2ffa1c4496f01a512aafd9e5",
+        16,
+    )
+
+
+def test_rfc6979_vector_tears_in_rain():
+    priv = (1).to_bytes(32, "big")
+    h = hashlib.sha256(
+        b"All those moments will be lost in time, like tears in rain. "
+        b"Time to die..."
+    ).digest()
+    k = _rfc6979_k(h, priv)
+    assert k == int(
+        "38AA22D72376B4DBC472E06C3BA403EE0A394DA63FC58D88686C611ABA98D6B3",
+        16,
+    )
+
+
+# ---- cross-check against the independent library ----
+
+def test_signature_verifies_under_cryptography():
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed, encode_dss_signature,
+    )
+
+    priv = bytes.fromhex(
+        "4c0883a69102937d6231471b5dbb6204fe5129617082792ae468d01a3f362318"
+    )
+    digest = keccak256(b"room_tpu signing cross-check")
+    r, s, _ = ecdsa_sign(digest, priv)
+
+    pub_nums = ec.EllipticCurvePublicNumbers(
+        *pubkey_point(priv), ec.SECP256K1()
+    )
+    pub = pub_nums.public_key()
+    # raises InvalidSignature on mismatch
+    pub.verify(
+        encode_dss_signature(r, s), digest,
+        ec.ECDSA(Prehashed(hashes_sha256_like(digest))),
+    )
+
+
+def hashes_sha256_like(digest: bytes):
+    """Prehashed needs an algorithm whose digest_size matches."""
+    from cryptography.hazmat.primitives import hashes
+
+    assert len(digest) == 32
+    return hashes.SHA256()
+
+
+def test_pubkey_matches_cryptography_derivation():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    priv = bytes.fromhex("01" * 32)
+    sk = ec.derive_private_key(
+        int.from_bytes(priv, "big"), ec.SECP256K1()
+    )
+    nums = sk.public_key().public_numbers()
+    assert pubkey_point(priv) == (nums.x, nums.y)
+
+
+# ---- recovery ----
+
+def test_ecrecover_roundtrip():
+    priv = bytes.fromhex("aa" * 32)
+    digest = keccak256(b"recover me")
+    r, s, y = ecdsa_sign(digest, priv)
+    assert s <= N // 2  # EIP-2 low-s
+    pt = ecdsa_recover(digest, r, s, y)
+    assert pt == pubkey_point(priv)
+    assert point_to_address(pt) == point_to_address(pubkey_point(priv))
+
+
+# ---- RLP goldens (Ethereum spec examples) ----
+
+@pytest.mark.parametrize("value,expected", [
+    ("dog", "83646f67"),
+    (["cat", "dog"], "c88363617483646f67"),
+    ("", "80"),
+    (0, "80"),
+    (15, "0f"),
+    (1024, "820400"),
+    ([], "c0"),
+    ([[], [[]], [[], [[]]]], "c7c0c1c0c3c0c1c0"),
+])
+def test_rlp_goldens(value, expected):
+    assert rlp_encode(value).hex() == expected
+
+
+def test_rlp_long_string():
+    s = "Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert rlp_encode(s).hex() == "b8" + "38" + s.encode().hex()
+
+
+# ---- EIP-1559 ----
+
+def _rlp_decode(data: bytes):
+    """Minimal decoder for the round-trip test."""
+    def dec(b, i):
+        x = b[i]
+        if x <= 0x7F:
+            return b[i:i + 1], i + 1
+        if x <= 0xB7:
+            ln = x - 0x80
+            return b[i + 1:i + 1 + ln], i + 1 + ln
+        if x <= 0xBF:
+            lln = x - 0xB7
+            ln = int.from_bytes(b[i + 1:i + 1 + lln], "big")
+            st = i + 1 + lln
+            return b[st:st + ln], st + ln
+        if x <= 0xF7:
+            ln = x - 0xC0
+            end = i + 1 + ln
+            out, j = [], i + 1
+            while j < end:
+                item, j = dec(b, j)
+                out.append(item)
+            return out, end
+        lln = x - 0xF7
+        ln = int.from_bytes(b[i + 1:i + 1 + lln], "big")
+        st = i + 1 + lln
+        end = st + ln
+        out, j = [], st
+        while j < end:
+            item, j = dec(b, j)
+            out.append(item)
+        return out, end
+
+    out, end = dec(data, 0)
+    assert end == len(data)
+    return out
+
+
+def test_sign_eip1559_structure_and_sender():
+    priv = bytes.fromhex("bb" * 32)
+    signed = sign_eip1559(
+        priv,
+        chain_id=8453,           # base
+        nonce=7,
+        max_priority_fee_per_gas=1_000_000,
+        max_fee_per_gas=30_000_000_000,
+        gas_limit=21_000,
+        to="0x833589fCD6eDb6E08f4c7C32D4f71b54bdA02913",
+        value=0,
+        data=b"\x01\x02",
+    )
+    raw = bytes.fromhex(signed["raw"][2:])
+    assert raw[0] == 0x02
+    fields = _rlp_decode(raw[1:])
+    assert len(fields) == 12
+    assert int.from_bytes(fields[0], "big") == 8453
+    assert int.from_bytes(fields[1], "big") == 7
+    assert fields[5].hex() == "833589fcd6edb6e08f4c7c32d4f71b54bda02913"
+    assert fields[7] == b"\x01\x02"
+    # recover the sender from the signature over the unsigned payload
+    unsigned = encode_eip1559_unsigned(
+        chain_id=8453, nonce=7, max_priority_fee_per_gas=1_000_000,
+        max_fee_per_gas=30_000_000_000, gas_limit=21_000,
+        to="0x833589fCD6eDb6E08f4c7C32D4f71b54bdA02913", value=0,
+        data=b"\x01\x02",
+    )
+    digest = keccak256(unsigned)
+    y = int.from_bytes(fields[9], "big") if fields[9] else 0
+    r = int.from_bytes(fields[10], "big")
+    s = int.from_bytes(fields[11], "big")
+    sender = point_to_address(ecdsa_recover(digest, r, s, y))
+    assert sender == point_to_address(pubkey_point(priv))
+    assert signed["hash"] == "0x" + keccak256(raw).hex()
+
+
+def test_deterministic_signing():
+    priv = bytes.fromhex("cc" * 32)
+    kwargs = dict(
+        chain_id=1, nonce=0, max_priority_fee_per_gas=1,
+        max_fee_per_gas=2, gas_limit=21_000, to="0x" + "11" * 20,
+        value=10**18,
+    )
+    assert sign_eip1559(priv, **kwargs) == sign_eip1559(priv, **kwargs)
+
+
+def test_erc20_transfer_data():
+    data = erc20_transfer_data("0x" + "ab" * 20, 123456)
+    assert data[:4].hex() == "a9059cbb"
+    assert data[4:36].hex() == "00" * 12 + "ab" * 20
+    assert int.from_bytes(data[36:], "big") == 123456
+    assert len(data) == 68
+
+
+# ---- wallet integration ----
+
+def test_wallet_build_signed_transfer(tmp_path, monkeypatch):
+    from room_tpu.core.ethtx import ecdsa_recover as rec
+    from room_tpu.core.wallet import (
+        WalletError, build_signed_transfer, create_room_wallet,
+        to_checksum_address, transfer_token,
+    )
+    from room_tpu.db import Database
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    db = Database(":memory:")
+    rid = db.insert("INSERT INTO rooms(name) VALUES ('w')")
+    wallet = create_room_wallet(db, rid)
+
+    signed = build_signed_transfer(
+        db, rid, "0x" + "22" * 20, 1_000_000,
+        nonce=0, max_fee_per_gas=10**9, max_priority_fee_per_gas=10**6,
+    )
+    raw = bytes.fromhex(signed["raw"][2:])
+    assert raw[0] == 0x02
+    fields = _rlp_decode(raw[1:])
+    digest = keccak256(b"\x02" + rlp_encode(fields[:9]))
+    y = int.from_bytes(fields[9], "big") if fields[9] else 0
+    sender = point_to_address(rec(
+        digest, int.from_bytes(fields[10], "big"),
+        int.from_bytes(fields[11], "big"), y,
+    ))
+    assert to_checksum_address(sender) == wallet["address"]
+
+    # validation + offline broadcast fails closed
+    with pytest.raises(WalletError):
+        build_signed_transfer(
+            db, rid, "not-an-address", 1, nonce=0,
+            max_fee_per_gas=1, max_priority_fee_per_gas=1,
+        )
+    with pytest.raises(WalletError):
+        build_signed_transfer(
+            db, rid, "0x" + "22" * 20, 0, nonce=0,
+            max_fee_per_gas=1, max_priority_fee_per_gas=1,
+        )
+    monkeypatch.setenv("ROOM_TPU_RPC_BASE", "http://127.0.0.1:1")
+    with pytest.raises(WalletError, match="unreachable"):
+        transfer_token(db, rid, "0x" + "22" * 20, 1)
