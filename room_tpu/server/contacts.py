@@ -1,0 +1,353 @@
+"""Keeper contact channels: email + Telegram verification state
+machines, and clerk-originated email sending (reference:
+src/server/routes/contacts.ts — code issuance with HMAC'd 6-digit
+codes, TTL/resend-cooldown/hourly rate window, telegram deep-link token
+flow; src/server/keeper-email.ts — clerk sends email and records it as
+a clerk message).
+
+State lives in the settings table under the same keys the reference
+uses, so the status endpoint is a pure read. Delivery is transport-
+pluggable: a file outbox (ROOM_TPU_EMAIL_OUTBOX — also the test seam),
+SMTP (ROOM_TPU_SMTP_HOST/PORT/USER/PASS/FROM), or the cloud relay; all
+absent -> ApiError 502 fail-closed like the reference's cloud misses.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import re
+import secrets
+import time
+from typing import Optional
+
+from ..core.messages import get_setting, set_setting
+from ..db import Database
+
+EMAIL_CODE_TTL_MIN = 15
+EMAIL_RESEND_COOLDOWN_S = 60
+EMAIL_MAX_SENDS_PER_HOUR = 6
+TELEGRAM_TTL_MIN = 20
+DEFAULT_TELEGRAM_BOT = "room_tpu_bot"
+
+K_EMAIL = "contact_email"
+K_EMAIL_VERIFIED_AT = "contact_email_verified_at"
+K_EMAIL_CODE_HASH = "contact_email_verify_code_hash"
+K_EMAIL_CODE_EXPIRES = "contact_email_verify_code_expires_at"
+K_EMAIL_LAST_SENT = "contact_email_verify_last_sent_at"
+K_EMAIL_RATE_START = "contact_email_verify_rate_window_start"
+K_EMAIL_RATE_COUNT = "contact_email_verify_rate_window_count"
+K_TG_ID = "contact_telegram_id"
+K_TG_USERNAME = "contact_telegram_username"
+K_TG_FIRST_NAME = "contact_telegram_first_name"
+K_TG_VERIFIED_AT = "contact_telegram_verified_at"
+K_TG_PENDING_HASH = "contact_telegram_pending_hash"
+K_TG_PENDING_EXPIRES = "contact_telegram_pending_expires_at"
+K_TG_BOT = "contact_telegram_bot_username"
+
+_EMAIL_RE = re.compile(r"^[^@\s]+@[^@\s]+\.[^@\s]+$")
+
+
+class ApiError(RuntimeError):
+    def __init__(self, message: str, status: int = 400,
+                 retry_after_s: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+def _get(db: Database, key: str) -> str:
+    return (get_setting(db, key) or "").strip()
+
+
+def _clear(db: Database, key: str) -> None:
+    set_setting(db, key, "")
+
+
+def is_valid_email(email: str) -> bool:
+    return bool(_EMAIL_RE.fullmatch(email)) and len(email) <= 254
+
+
+def _contact_secret(db: Database) -> bytes:
+    """Stable per-install HMAC key for code hashes."""
+    existing = _get(db, "contact_secret")
+    if existing:
+        return existing.encode()
+    fresh = secrets.token_hex(32)
+    set_setting(db, "contact_secret", fresh)
+    return fresh.encode()
+
+
+def hash_email_code(db: Database, email: str, code: str) -> str:
+    return hmac.new(
+        _contact_secret(db),
+        f"email:{email.lower()}\ncode:{code}".encode(),
+        hashlib.sha256,
+    ).hexdigest()
+
+
+def hash_telegram_token(token: str) -> str:
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+def _hashes_equal(a: str, b: str) -> bool:
+    if not re.fullmatch(r"[a-f0-9]{64}", a or "") or \
+            not re.fullmatch(r"[a-f0-9]{64}", b or ""):
+        return False
+    return hmac.compare_digest(bytes.fromhex(a), bytes.fromhex(b))
+
+
+# ---- email transport ----
+
+def send_email(db: Database, to: str, subject: str, body: str) -> None:
+    """Raises ApiError(502) when no transport is configured/working."""
+    outbox = os.environ.get("ROOM_TPU_EMAIL_OUTBOX")
+    if outbox:
+        os.makedirs(outbox, exist_ok=True)
+        name = f"{int(time.time() * 1000)}-{secrets.token_hex(4)}.json"
+        with open(os.path.join(outbox, name), "w") as f:
+            json.dump({"to": to, "subject": subject, "body": body}, f)
+        return
+
+    host = os.environ.get("ROOM_TPU_SMTP_HOST")
+    if host:
+        import smtplib
+        from email.message import EmailMessage
+
+        msg = EmailMessage()
+        msg["From"] = os.environ.get(
+            "ROOM_TPU_SMTP_FROM", "clerk@room-tpu.local"
+        )
+        msg["To"] = to
+        msg["Subject"] = subject
+        msg.set_content(body)
+        try:
+            port = int(os.environ.get("ROOM_TPU_SMTP_PORT", "587"))
+            with smtplib.SMTP(host, port, timeout=12) as smtp:
+                smtp.starttls()
+                user = os.environ.get("ROOM_TPU_SMTP_USER")
+                if user:
+                    smtp.login(
+                        user, os.environ.get("ROOM_TPU_SMTP_PASS", "")
+                    )
+                smtp.send_message(msg)
+            return
+        except (OSError, smtplib.SMTPException) as e:
+            raise ApiError(f"SMTP send failed: {e}", 502) from e
+
+    raise ApiError(
+        "no email transport configured (set ROOM_TPU_EMAIL_OUTBOX or "
+        "ROOM_TPU_SMTP_HOST)", 502,
+    )
+
+
+# ---- email verification ----
+
+def issue_email_verification(db: Database, email: str) -> dict:
+    """6-digit code: cooldown + hourly window enforced, HMAC hash +
+    expiry persisted, code delivered by the transport (reference:
+    contacts.ts issueEmailVerification)."""
+    now = time.time()
+
+    last_sent = _get(db, K_EMAIL_LAST_SENT)
+    if last_sent:
+        elapsed = now - float(last_sent)
+        if elapsed < EMAIL_RESEND_COOLDOWN_S:
+            wait = int(EMAIL_RESEND_COOLDOWN_S - elapsed) + 1
+            raise ApiError(
+                f"Please wait {wait}s before requesting another code",
+                429, retry_after_s=wait,
+            )
+
+    window_start = float(_get(db, K_EMAIL_RATE_START) or 0)
+    count = int(_get(db, K_EMAIL_RATE_COUNT) or 0)
+    if now - window_start >= 3600:
+        window_start, count = now, 0
+    if count >= EMAIL_MAX_SENDS_PER_HOUR:
+        wait = int(3600 - (now - window_start)) + 1
+        raise ApiError(
+            "Too many verification emails; try again later", 429,
+            retry_after_s=wait,
+        )
+
+    code = f"{secrets.randbelow(1_000_000):06d}"
+    expires_at = now + EMAIL_CODE_TTL_MIN * 60
+    send_email(
+        db, email, "Your verification code",
+        f"Your verification code is {code}. It expires in "
+        f"{EMAIL_CODE_TTL_MIN} minutes.",
+    )
+    set_setting(db, K_EMAIL, email)
+    _clear(db, K_EMAIL_VERIFIED_AT)
+    set_setting(db, K_EMAIL_CODE_HASH, hash_email_code(db, email, code))
+    set_setting(db, K_EMAIL_CODE_EXPIRES, str(expires_at))
+    set_setting(db, K_EMAIL_LAST_SENT, str(now))
+    set_setting(db, K_EMAIL_RATE_START, str(window_start))
+    set_setting(db, K_EMAIL_RATE_COUNT, str(count + 1))
+    return {
+        "sentTo": email,
+        "expiresAt": expires_at,
+        "retryAfterSec": EMAIL_RESEND_COOLDOWN_S,
+    }
+
+
+def verify_email_code(db: Database, code: str) -> dict:
+    if not re.fullmatch(r"\d{6}", code or ""):
+        raise ApiError("Verification code must be 6 digits")
+    email = _get(db, K_EMAIL).lower()
+    stored = _get(db, K_EMAIL_CODE_HASH).lower()
+    expires_raw = _get(db, K_EMAIL_CODE_EXPIRES)
+    if not is_valid_email(email) or not stored or not expires_raw:
+        raise ApiError(
+            "No pending verification code. Request a new code first."
+        )
+    if float(expires_raw) <= time.time():
+        _clear(db, K_EMAIL_CODE_HASH)
+        _clear(db, K_EMAIL_CODE_EXPIRES)
+        raise ApiError("Verification code expired. Request a new code.")
+    if not _hashes_equal(stored, hash_email_code(db, email, code)):
+        raise ApiError("Invalid verification code")
+    verified_at = time.time()
+    set_setting(db, K_EMAIL_VERIFIED_AT, str(verified_at))
+    _clear(db, K_EMAIL_CODE_HASH)
+    _clear(db, K_EMAIL_CODE_EXPIRES)
+    return {"email": email, "verifiedAt": verified_at}
+
+
+# ---- telegram verification ----
+
+def telegram_bot_username() -> str:
+    configured = (
+        os.environ.get("ROOM_TPU_TELEGRAM_BOT", "").strip().lstrip("@")
+    )
+    return configured or DEFAULT_TELEGRAM_BOT
+
+
+def start_telegram_verification(db: Database) -> dict:
+    token = base64.urlsafe_b64encode(secrets.token_bytes(24)) \
+        .decode().rstrip("=")
+    expires_at = time.time() + TELEGRAM_TTL_MIN * 60
+    bot = telegram_bot_username()
+    set_setting(db, K_TG_PENDING_HASH, hash_telegram_token(token))
+    set_setting(db, K_TG_PENDING_EXPIRES, str(expires_at))
+    set_setting(db, K_TG_BOT, bot)
+    return {
+        "pending": True,
+        "expiresAt": expires_at,
+        "botUsername": bot,
+        "deepLink": f"https://t.me/{bot}?start=tv1_{token}",
+    }
+
+
+def check_telegram_verification(db: Database) -> dict:
+    """Poll step. Without the cloud relay the pending state just ages
+    out; the webhook path (confirm_telegram_verification) completes it
+    when the bot calls back."""
+    token_hash = _get(db, K_TG_PENDING_HASH).lower()
+    expires_raw = _get(db, K_TG_PENDING_EXPIRES)
+    if not re.fullmatch(r"[a-f0-9]{64}", token_hash) or not expires_raw:
+        if _get(db, K_TG_VERIFIED_AT):
+            return {"status": "verified", "telegram": telegram_view(db)}
+        return {"status": "not_pending"}
+    if float(expires_raw) <= time.time():
+        _clear(db, K_TG_PENDING_HASH)
+        _clear(db, K_TG_PENDING_EXPIRES)
+        return {"status": "expired"}
+    return {"status": "pending", "botUsername": _get(db, K_TG_BOT)}
+
+
+def confirm_telegram_verification(
+    db: Database, token: str, telegram_id: str,
+    username: str = "", first_name: str = "",
+) -> bool:
+    """Webhook-side completion: the bot relays the /start token back."""
+    token_hash = _get(db, K_TG_PENDING_HASH).lower()
+    expires_raw = _get(db, K_TG_PENDING_EXPIRES)
+    if not token_hash or not expires_raw or \
+            float(expires_raw) <= time.time():
+        return False
+    if not _hashes_equal(token_hash, hash_telegram_token(token)):
+        return False
+    set_setting(db, K_TG_ID, str(telegram_id))
+    set_setting(db, K_TG_USERNAME, username or "")
+    set_setting(db, K_TG_FIRST_NAME, first_name or "")
+    set_setting(db, K_TG_VERIFIED_AT, str(time.time()))
+    _clear(db, K_TG_PENDING_HASH)
+    _clear(db, K_TG_PENDING_EXPIRES)
+    return True
+
+
+def disconnect_telegram(db: Database) -> None:
+    for key in (K_TG_ID, K_TG_USERNAME, K_TG_FIRST_NAME,
+                K_TG_VERIFIED_AT, K_TG_PENDING_HASH,
+                K_TG_PENDING_EXPIRES):
+        _clear(db, key)
+
+
+def telegram_view(db: Database) -> Optional[dict]:
+    if not _get(db, K_TG_VERIFIED_AT):
+        return None
+    return {
+        "id": _get(db, K_TG_ID),
+        "username": _get(db, K_TG_USERNAME) or None,
+        "firstName": _get(db, K_TG_FIRST_NAME) or None,
+        "verifiedAt": float(_get(db, K_TG_VERIFIED_AT)),
+    }
+
+
+# ---- status ----
+
+def contacts_status(db: Database) -> dict:
+    email = _get(db, K_EMAIL)
+    email_verified = _get(db, K_EMAIL_VERIFIED_AT)
+    pending_code = bool(
+        _get(db, K_EMAIL_CODE_HASH)
+        and float(_get(db, K_EMAIL_CODE_EXPIRES) or 0) > time.time()
+    )
+    tg_pending = bool(
+        _get(db, K_TG_PENDING_HASH)
+        and float(_get(db, K_TG_PENDING_EXPIRES) or 0) > time.time()
+    )
+    return {
+        "email": {
+            "address": email or None,
+            "verified": bool(email_verified),
+            "verifiedAt": float(email_verified) if email_verified
+            else None,
+            "pendingCode": pending_code,
+        },
+        "telegram": {
+            "connected": bool(_get(db, K_TG_VERIFIED_AT)),
+            "details": telegram_view(db),
+            "pending": tg_pending,
+            "botUsername": _get(db, K_TG_BOT) or telegram_bot_username(),
+        },
+    }
+
+
+# ---- keeper email (reference: keeper-email.ts sendKeeperEmail) ----
+
+def send_keeper_email(
+    db: Database, to: str, content: str, subject: Optional[str] = None,
+) -> bool:
+    """Send from the clerk to any address ("admin" resolves to the
+    verified keeper email). Records a clerk message on success."""
+    if to == "admin":
+        to = _get(db, K_EMAIL)
+        if not to or not _get(db, K_EMAIL_VERIFIED_AT):
+            return False
+    if not is_valid_email(to):
+        return False
+    try:
+        send_email(db, to, subject or "Message from Clerk", content)
+    except ApiError:
+        return False
+    db.insert(
+        "INSERT INTO clerk_messages(role, content, source) "
+        "VALUES ('assistant', ?, 'email')",
+        (content,),
+    )
+    return True

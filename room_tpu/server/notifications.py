@@ -98,6 +98,17 @@ def relay_pending(db: Database) -> Optional[str]:
 def _deliver_external(db: Database, digest: str) -> None:
     """Email/Telegram relays, gated on configured settings; failures are
     silent like the reference's cloud relays."""
+    from .contacts import (
+        ApiError, K_EMAIL, K_EMAIL_VERIFIED_AT, send_email,
+    )
+
+    email = (get_setting(db, K_EMAIL) or "").strip()
+    if email and (get_setting(db, K_EMAIL_VERIFIED_AT) or "").strip():
+        try:
+            send_email(db, email, "Keeper digest", digest)
+        except ApiError:
+            pass
+
     telegram_token = get_setting(db, "telegram_bot_token")
     telegram_chat = get_setting(db, "telegram_chat_id")
     if telegram_token and telegram_chat:

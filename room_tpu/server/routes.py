@@ -52,7 +52,83 @@ def register_all_routes(r: Router) -> None:
     register_status_routes(r)
     register_clerk_routes(r)
     register_provider_routes(r)
+    register_contact_routes(r)
     register_aux_routes(r)
+
+
+def register_contact_routes(r: Router) -> None:
+    """Keeper contact channels (reference: src/server/routes/contacts.ts
+    — email code verification + telegram deep-link flow)."""
+    from . import contacts as contacts_mod
+
+    def _api_err(e: contacts_mod.ApiError):
+        out = err(str(e), e.status)
+        if e.retry_after_s is not None:
+            out["data"] = {"retryAfterSec": e.retry_after_s}
+        return out
+
+    def status(ctx):
+        return ok(contacts_mod.contacts_status(ctx.db))
+
+    def email_start(ctx):
+        email = str((ctx.body or {}).get("email") or "").strip().lower()
+        if not contacts_mod.is_valid_email(email):
+            return err("Valid email is required")
+        current = (contacts_mod._get(ctx.db, contacts_mod.K_EMAIL)
+                   or "").lower()
+        verified = contacts_mod._get(
+            ctx.db, contacts_mod.K_EMAIL_VERIFIED_AT
+        )
+        if current == email and verified:
+            return ok({"ok": True, "alreadyVerified": True,
+                       "email": email})
+        try:
+            out = contacts_mod.issue_email_verification(ctx.db, email)
+        except contacts_mod.ApiError as e:
+            return _api_err(e)
+        return ok({"ok": True, **out})
+
+    def email_resend(ctx):
+        email = (contacts_mod._get(ctx.db, contacts_mod.K_EMAIL)
+                 or "").lower()
+        if not contacts_mod.is_valid_email(email):
+            return err("No email to resend verification to")
+        if contacts_mod._get(ctx.db, contacts_mod.K_EMAIL_VERIFIED_AT):
+            return ok({"ok": True, "alreadyVerified": True,
+                       "email": email})
+        try:
+            out = contacts_mod.issue_email_verification(ctx.db, email)
+        except contacts_mod.ApiError as e:
+            return _api_err(e)
+        return ok({"ok": True, **out})
+
+    def email_verify(ctx):
+        code = str((ctx.body or {}).get("code") or "").strip()
+        try:
+            out = contacts_mod.verify_email_code(ctx.db, code)
+        except contacts_mod.ApiError as e:
+            return _api_err(e)
+        return ok({"ok": True, **out})
+
+    def tg_start(ctx):
+        return ok({"ok": True,
+                   **contacts_mod.start_telegram_verification(ctx.db)})
+
+    def tg_check(ctx):
+        return ok({"ok": True,
+                   **contacts_mod.check_telegram_verification(ctx.db)})
+
+    def tg_disconnect(ctx):
+        contacts_mod.disconnect_telegram(ctx.db)
+        return ok({"ok": True})
+
+    r.get("/api/contacts/status", status)
+    r.post("/api/contacts/email/start", email_start)
+    r.post("/api/contacts/email/resend", email_resend)
+    r.post("/api/contacts/email/verify", email_verify)
+    r.post("/api/contacts/telegram/start", tg_start)
+    r.post("/api/contacts/telegram/check", tg_check)
+    r.post("/api/contacts/telegram/disconnect", tg_disconnect)
 
 
 def register_provider_routes(r: Router) -> None:

@@ -66,6 +66,23 @@ def handle_webhook_request(
         return 200, {"status": 200,
                      "data": {"taskId": task["id"], "queued": queued}}
 
+    if kind == "telegram":
+        # the bot relays a /start deep-link token back; the path token
+        # is the raw verification token itself (single-use, hashed at
+        # rest — reference: contacts.ts telegram flow)
+        from .contacts import confirm_telegram_verification
+
+        info = body if isinstance(body, dict) else {}
+        ok = confirm_telegram_verification(
+            db, token,
+            telegram_id=str(info.get("id") or ""),
+            username=str(info.get("username") or ""),
+            first_name=str(info.get("firstName") or ""),
+        )
+        if not ok:
+            return 404, {"error": "unknown or expired token"}
+        return 200, {"status": 200, "data": {"verified": True}}
+
     if kind == "queen":
         room = db.query_one(
             "SELECT * FROM rooms WHERE webhook_token=?", (token,)

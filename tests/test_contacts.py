@@ -1,0 +1,204 @@
+"""Contacts subsystem: email code verification state machine, telegram
+deep-link flow + webhook confirm, keeper email, route surface
+(reference behaviors: src/server/routes/contacts.ts,
+keeper-email.ts)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.server import contacts
+from room_tpu.server.contacts import (
+    ApiError, check_telegram_verification, confirm_telegram_verification,
+    contacts_status, disconnect_telegram, hash_email_code,
+    issue_email_verification, send_keeper_email,
+    start_telegram_verification, verify_email_code,
+)
+
+
+@pytest.fixture
+def db(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setenv("ROOM_TPU_EMAIL_OUTBOX", str(tmp_path / "outbox"))
+    return Database(":memory:")
+
+
+def _outbox(tmp_path) -> list[dict]:
+    out = []
+    box = tmp_path / "outbox"
+    if box.is_dir():
+        for p in sorted(box.iterdir()):
+            out.append(json.loads(p.read_text()))
+    return out
+
+
+def _sent_code(tmp_path) -> str:
+    mails = _outbox(tmp_path)
+    assert mails, "no email delivered"
+    import re
+
+    m = re.search(r"\b(\d{6})\b", mails[-1]["body"])
+    assert m, mails[-1]
+    return m.group(1)
+
+
+def test_email_verification_happy_path(db, tmp_path):
+    out = issue_email_verification(db, "keeper@example.com")
+    assert out["sentTo"] == "keeper@example.com"
+    code = _sent_code(tmp_path)
+    result = verify_email_code(db, code)
+    assert result["email"] == "keeper@example.com"
+    st = contacts_status(db)
+    assert st["email"]["verified"] is True
+    assert st["email"]["address"] == "keeper@example.com"
+    assert st["email"]["pendingCode"] is False
+
+
+def test_email_wrong_code_and_expiry(db, tmp_path):
+    issue_email_verification(db, "k@example.com")
+    with pytest.raises(ApiError, match="Invalid verification code"):
+        wrong = "000000" if _sent_code(tmp_path) != "000000" else "111111"
+        verify_email_code(db, wrong)
+    # expire the code
+    from room_tpu.core.messages import set_setting
+
+    set_setting(db, contacts.K_EMAIL_CODE_EXPIRES,
+                str(time.time() - 1))
+    with pytest.raises(ApiError, match="expired"):
+        verify_email_code(db, _sent_code(tmp_path))
+    # expired code was cleared -> "no pending"
+    with pytest.raises(ApiError, match="No pending"):
+        verify_email_code(db, "123456")
+
+
+def test_email_resend_cooldown_and_rate_window(db, tmp_path):
+    issue_email_verification(db, "k@example.com")
+    with pytest.raises(ApiError) as exc:
+        issue_email_verification(db, "k@example.com")
+    assert exc.value.status == 429
+    assert exc.value.retry_after_s is not None
+    # hourly cap: wind the cooldown back each time but keep the window
+    from room_tpu.core.messages import set_setting
+
+    for _ in range(contacts.EMAIL_MAX_SENDS_PER_HOUR - 1):
+        set_setting(db, contacts.K_EMAIL_LAST_SENT,
+                    str(time.time() - 61))
+        issue_email_verification(db, "k@example.com")
+    set_setting(db, contacts.K_EMAIL_LAST_SENT, str(time.time() - 61))
+    with pytest.raises(ApiError, match="Too many"):
+        issue_email_verification(db, "k@example.com")
+
+
+def test_email_no_transport_fails_closed(db, monkeypatch):
+    monkeypatch.delenv("ROOM_TPU_EMAIL_OUTBOX")
+    with pytest.raises(ApiError) as exc:
+        issue_email_verification(db, "k@example.com")
+    assert exc.value.status == 502
+    # nothing was persisted: no pending code
+    assert contacts_status(db)["email"]["pendingCode"] is False
+
+
+def test_code_hash_is_keyed_per_install(db):
+    h1 = hash_email_code(db, "a@b.co", "123456")
+    h2 = hash_email_code(db, "a@b.co", "123457")
+    assert h1 != h2 and len(h1) == 64
+
+
+def test_telegram_flow_webhook_confirm(db):
+    out = start_telegram_verification(db)
+    assert out["pending"] and "t.me/" in out["deepLink"]
+    token = out["deepLink"].split("start=tv1_")[1]
+    assert check_telegram_verification(db)["status"] == "pending"
+
+    assert not confirm_telegram_verification(db, "wrong-token", "99")
+    assert confirm_telegram_verification(
+        db, token, "42", username="keeper", first_name="Kay"
+    )
+    st = check_telegram_verification(db)
+    assert st["status"] == "verified"
+    assert st["telegram"]["id"] == "42"
+    assert contacts_status(db)["telegram"]["connected"] is True
+
+    disconnect_telegram(db)
+    assert contacts_status(db)["telegram"]["connected"] is False
+    assert check_telegram_verification(db)["status"] == "not_pending"
+
+
+def test_telegram_expiry(db):
+    from room_tpu.core.messages import set_setting
+
+    start_telegram_verification(db)
+    set_setting(db, contacts.K_TG_PENDING_EXPIRES,
+                str(time.time() - 1))
+    assert check_telegram_verification(db)["status"] == "expired"
+    # and confirm after expiry fails
+    assert not confirm_telegram_verification(db, "anything", "7")
+
+
+def test_send_keeper_email_admin_requires_verified(db, tmp_path):
+    assert send_keeper_email(db, "admin", "hello") is False
+    issue_email_verification(db, "keeper@example.com")
+    verify_email_code(db, _sent_code(tmp_path))
+    assert send_keeper_email(db, "admin", "hello keeper") is True
+    mails = _outbox(tmp_path)
+    assert mails[-1]["to"] == "keeper@example.com"
+    assert mails[-1]["body"] == "hello keeper"
+    msg = db.query_one(
+        "SELECT * FROM clerk_messages ORDER BY id DESC LIMIT 1"
+    )
+    assert msg["source"] == "email"
+
+
+def test_contact_routes_and_webhook(db, tmp_path, monkeypatch):
+    from tests.test_server import req
+
+    from room_tpu.server.http import ApiServer
+
+    server = ApiServer(db)
+    server.start()
+    try:
+        status, out = req(server, "GET", "/api/contacts/status")
+        assert status == 200
+        assert out["data"]["email"]["verified"] is False
+
+        status, out = req(server, "POST", "/api/contacts/email/start",
+                          {"email": "not-an-email"})
+        assert status == 400
+
+        status, out = req(server, "POST", "/api/contacts/email/start",
+                          {"email": "K@Example.com"})
+        assert status == 200 and out["data"]["sentTo"] == "k@example.com"
+        status, out = req(server, "POST", "/api/contacts/email/verify",
+                          {"code": _sent_code(tmp_path)})
+        assert status == 200 and out["data"]["email"] == "k@example.com"
+        # idempotent start on verified email
+        status, out = req(server, "POST", "/api/contacts/email/start",
+                          {"email": "k@example.com"})
+        assert status == 200 and out["data"]["alreadyVerified"] is True
+
+        status, out = req(server, "POST",
+                          "/api/contacts/telegram/start", {})
+        assert status == 200
+        token = out["data"]["deepLink"].split("start=tv1_")[1]
+        # webhook confirm rides the pre-auth tokened path
+        import urllib.request
+
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/hooks/telegram/{token}",
+            data=json.dumps({"id": "777", "username": "kp"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            assert resp.status == 200
+        status, out = req(server, "POST",
+                          "/api/contacts/telegram/check", {})
+        assert out["data"]["status"] == "verified"
+        status, out = req(server, "POST",
+                          "/api/contacts/telegram/disconnect", {})
+        assert out["data"]["ok"] is True
+    finally:
+        server.stop()
