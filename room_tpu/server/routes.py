@@ -753,15 +753,25 @@ def register_task_routes(r: Router) -> None:
 def register_memory_routes(r: Router) -> None:
     def search(ctx):
         q = ctx.query.get("q", "")
-        if not q:
-            return err("q is required")
         room_id = ctx.query.get("roomId")
+        limit = int(ctx.query.get("limit", "10"))
+        if not q:
+            # memory browser: empty query lists the newest entities
+            rows = ctx.db.query(
+                "SELECT e.*, (SELECT content FROM observations o "
+                " WHERE o.entity_id = e.id ORDER BY o.id DESC LIMIT 1)"
+                " AS content FROM entities e "
+                + ("WHERE e.room_id=? " if room_id else "")
+                + "ORDER BY e.id DESC LIMIT ?",
+                ((int(room_id), limit) if room_id else (limit,)),
+            )
+            return ok(rows)
         from ..core.queen_tools import _embed_query
 
         return ok(memory_mod.hybrid_search(
             ctx.db, q, query_vector=_embed_query(q),
             room_id=int(room_id) if room_id else None,
-            limit=int(ctx.query.get("limit", "10")),
+            limit=limit,
         ))
 
     def remember(ctx):

@@ -1,0 +1,237 @@
+"""Dashboard request-flow tests (no browser in the image): static
+bundle serves, and every API call the panels make resolves against the
+live router with a 2xx on seeded data — so panel drift against the
+REST surface fails CI (reference analogue: src/ui/ integration tests).
+"""
+
+import json
+import os
+import re
+import urllib.request
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.server.http import ApiServer
+
+UI_DIR = os.path.join(os.path.dirname(__file__), "..", "ui")
+
+
+@pytest.fixture
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "data"))
+    monkeypatch.setenv("ROOM_TPU_EMAIL_OUTBOX", str(tmp_path / "outbox"))
+    db = Database(":memory:")
+    srv = ApiServer(db, static_dir=UI_DIR)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def fetch(server, path, token=None):
+    headers = {}
+    if token:
+        headers["Authorization"] = f"Bearer {server.tokens['user']}"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", headers=headers
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, resp.headers, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, e.read()
+
+
+def test_static_bundle_serves(server):
+    for path, ctype in [
+        ("/", "text/html"),
+        ("/app.js", "text/javascript"),
+        ("/panels.js", "text/javascript"),
+        ("/style.css", "text/css"),
+    ]:
+        status, headers, body = fetch(server, path)
+        assert status == 200, path
+        assert ctype in headers["Content-Type"], (path, headers)
+        assert len(body) > 200, path
+    # SPA fallback: unknown path serves index.html
+    status, headers, body = fetch(server, "/some/spa/route")
+    assert status == 200 and b"room_tpu" in body
+
+
+def _strip_js(src: str) -> str:
+    """Remove strings/template literals/comments so delimiter counting
+    sees only code (no JS engine in the image)."""
+    out = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c in "'\"`":
+            q = c
+            i += 1
+            while i < n and src[i] != q:
+                if src[i] == "\\":
+                    i += 1
+                elif q == "`" and src.startswith("${", i):
+                    # template interpolations contain code: keep them
+                    depth = 0
+                    j = i + 2
+                    while j < n:
+                        if src[j] == "{":
+                            depth += 1
+                        elif src[j] == "}":
+                            if depth == 0:
+                                break
+                            depth -= 1
+                        j += 1
+                    out.append(" " + _strip_js(src[i + 2:j]) + " ")
+                    i = j
+                i += 1
+        elif src.startswith("//", i):
+            while i < n and src[i] != "\n":
+                i += 1
+            continue
+        elif src.startswith("/*", i):
+            j = src.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+@pytest.mark.parametrize("fname", ["app.js", "panels.js"])
+def test_js_delimiters_balanced(fname):
+    code = _strip_js(open(os.path.join(UI_DIR, fname)).read())
+    for o, c in ("()", "[]", "{}"):
+        assert code.count(o) == code.count(c), (
+            f"{fname}: unbalanced {o}{c} "
+            f"({code.count(o)} vs {code.count(c)})"
+        )
+
+
+def test_onclick_handlers_defined():
+    """Every inline onclick/onkeydown handler resolves to a function
+    defined in the bundle (catches typo'd handler names)."""
+    js = open(os.path.join(UI_DIR, "app.js")).read()
+    js += open(os.path.join(UI_DIR, "panels.js")).read()
+    html = open(os.path.join(UI_DIR, "index.html")).read()
+    defined = set(re.findall(r"(?:async\s+)?function\s+(\w+)", js))
+    defined |= set(re.findall(r"const\s+(\w+)\s*=", js))
+    used = set()
+    for m in re.finditer(r'on(?:click|keydown)="([^"]+)"', js + html):
+        for name in re.findall(r"(\w+)\s*\(", m.group(1)):
+            if name not in ("if", "JSON"):
+                used.add(name)
+    missing = used - defined - {"event"}
+    assert not missing, f"handlers not defined: {missing}"
+
+
+def _panel_api_calls() -> list[tuple[str, str]]:
+    src = open(os.path.join(UI_DIR, "panels.js")).read()
+    src += open(os.path.join(UI_DIR, "app.js")).read()
+    # dynamic `${action}` segments expand to the concrete verbs the
+    # panel can pass
+    actions = {
+        "/api/goals/1/@A@": ("complete", "abandon"),
+        "/api/rooms/1/@A@": ("start", "stop", "pause"),
+        "/api/tasks/1/@A@": ("run", "pause", "resume"),
+        "/api/escalations/1/@A@": ("answer", "dismiss"),
+    }
+    calls = set()
+    for m in re.finditer(
+        r'api\(\s*"(GET|POST|PUT|DELETE)",\s*[`"]([^`"?]+)', src
+    ):
+        method, path = m.group(1), m.group(2)
+        path = path.replace("${action}", "@A@")
+        # normalize remaining template interpolations to a concrete id
+        path = re.sub(r"\$\{[^}]+\}", "1", path)
+        if "@A@" in path:
+            for verb in actions.get(path, ()):
+                calls.add((method, path.replace("@A@", verb)))
+            continue
+        calls.add((method, path))
+    assert len(calls) > 30, "extraction regression"
+    return sorted(calls)
+
+
+def test_every_panel_call_resolves(server):
+    """Seed one of everything, then hit each (method, path) the panels
+    use. 2xx/4xx-with-known-reason allowed; 404-route or 405 = drift."""
+    from room_tpu.core import (
+        escalations as esc_mod, goals as goals_mod,
+        memory as memory_mod, messages as messages_mod,
+        quorum as quorum_mod, rooms as rooms_mod, skills as skills_mod,
+        task_runner, workers as workers_mod,
+    )
+
+    db = server.db
+    room = rooms_mod.create_room(db, "ui", worker_model="echo")
+    rid = room["id"]
+    task_runner.create_task(db, "t", "do", trigger_type="manual")
+    goals_mod.create_goal(db, rid, "g")
+    quorum_mod.announce(db, rid, None, "p")
+    esc_mod.create_escalation(db, rid, "q")
+    messages_mod.send_room_message(db, rid, rid, "subj", "m")
+    memory_mod.remember(db, "ui-fact", "fact")
+    skills_mod.create_skill(db, "s", "how-to")
+    assert workers_mod  # queen auto-created with the room
+
+    bodies = {
+        ("POST", "/api/rooms"): {"name": "x"},
+        ("POST", "/api/rooms/1/chat"): {"content": "hi"},
+        ("POST", "/api/rooms/1/goals"): {"description": "g2"},
+        ("POST", "/api/rooms/1/workers"): {"name": "w2"},
+        ("POST", "/api/rooms/1/wallet/withdraw"):
+            {"to": "0x" + "11" * 20, "amount": "5"},
+        ("POST", "/api/memory"): {"name": "f2", "content": "f2"},
+        ("POST", "/api/skills"): {"name": "s2", "content": "c"},
+        ("POST", "/api/escalations/1/answer"): {"answer": "a"},
+        ("POST", "/api/messages/1/reply"): {"body": "r"},
+        ("POST", "/api/decisions/1/vote"): {"vote": "approve"},
+        ("POST", "/api/decisions/1/keeper-vote"): {"vote": "reject"},
+        ("POST", "/api/clerk/message"): {"content": "hello"},
+        ("POST", "/api/contacts/email/start"):
+            {"email": "k@example.com"},
+        ("POST", "/api/contacts/email/verify"): {"code": "000000"},
+        ("POST", "/api/templates/instantiate"):
+            {"template": "research-desk", "workerModel": "echo"},
+        ("PUT", "/api/settings"): {"ui_test": "1"},
+    }
+    # endpoints whose 4xx is data-dependent, not drift
+    allowed_4xx = {
+        ("POST", "/api/contacts/email/verify"),   # wrong code
+        ("POST", "/api/rooms/1/wallet/withdraw"), # no chain RPC (503)
+        ("POST", "/api/providers/1/auth/start"),  # mock id, no CLI
+        ("GET", "/api/providers/1/auth"),         # no active session
+        ("GET", "/api/providers/auth/sessions/1"),  # unknown session
+        ("POST", "/api/rooms/1/start"),           # provider not ready
+        ("POST", "/api/workers/1/start"),         # provider not ready
+        ("POST", "/api/decisions/1/keeper-vote"), # already resolved (409)
+        ("POST", "/api/decisions/1/vote"),        # quorum state (409)
+        ("POST", "/api/tasks/1/run"),             # no runtime thread (503)
+    }
+    for method, path in _panel_api_calls():
+        body = bodies.get((method, path))
+        headers = {
+            "Authorization": f"Bearer {server.tokens['user']}",
+            "Content-Type": "application/json",
+        }
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}",
+            data=json.dumps(body).encode() if body is not None
+            else (b"{}" if method in ("POST", "PUT") else None),
+            headers=headers, method=method,
+        )
+        try:
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        if (method, path) in allowed_4xx:
+            assert status != 404 or "providers" in path or \
+                "sessions" in path, (method, path, status)
+            continue
+        assert 200 <= status < 300, (
+            f"{method} {path} -> {status} (panel/API drift)"
+        )

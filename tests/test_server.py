@@ -327,33 +327,41 @@ def test_dashboard_served_and_wired(server):
     exist on this server."""
     import re as _re
 
-    server.static_dir = os.path.join(
-        os.path.dirname(__file__), os.pardir, "ui"
-    )
-    with urllib.request.urlopen(
-        f"http://127.0.0.1:{server.port}/", timeout=5
-    ) as resp:
-        html = resp.read().decode()
-    assert "room-tpu" in html
-    # every /api path the page references — double-quoted literals AND
+    ui_dir = os.path.join(os.path.dirname(__file__), os.pardir, "ui")
+    server.static_dir = ui_dir
+    html = ""
+    for page in ("/", "/app.js", "/panels.js"):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{page}", timeout=5
+        ) as resp:
+            html += resp.read().decode()
+    assert "room_tpu" in html
+    # every /api path the bundle references — double-quoted literals AND
     # template literals like `/api/rooms/${id}/chat` — must match a
     # registered route (params substituted with 1)
-    refs = set(_re.findall(r'["`](/api/[a-z\-/${}]+)', html))
+    refs = set(_re.findall(r'["`](/api/[a-z\-/${}.]+)', html))
     assert any("${" in m for m in refs), "template-literal routes missed"
     for m in refs:
         if m == "/api/auth/handshake":
             continue  # handled before the router
         actions = (
-            ("start", "stop", "pause") if "${action}" in m else (None,)
+            ("start", "stop", "pause", "run", "resume", "complete",
+             "abandon", "answer", "dismiss")
+            if "${action}" in m else (None,)
         )
+        hits = 0
         for action in actions:
             path = m.replace("${action}", action) if action else m
-            path = _re.sub(r"\$\{[a-z]+\}", "1", path).rstrip("/")
+            path = _re.sub(r"\$\{[^}]+\}", "1", path).rstrip("/")
             found = any(
                 server.router.match(method, path)
                 for method in ("GET", "POST", "PUT", "DELETE")
             )
-            assert found, f"dashboard references unknown route {path}"
+            hits += found
+            if "${action}" not in m:
+                assert found, \
+                    f"dashboard references unknown route {path}"
+        assert hits, f"no action verb of {m} resolves to a route"
 
 
 def test_hetero_two_models_serve_concurrently(server):
@@ -395,7 +403,7 @@ def test_start_server_defaults_to_bundled_ui(tmp_path, monkeypatch):
         with urllib.request.urlopen(
             f"http://127.0.0.1:{app.port}/", timeout=5
         ) as resp:
-            assert b"room-tpu" in resp.read()
+            assert b"room_tpu" in resp.read()
     finally:
         app.stop()
         rt_mod._runtime = None

@@ -1,0 +1,154 @@
+/* room_tpu dashboard core: auth, fetch wrapper, WS hub, view router.
+   Panels register themselves in PANELS (panels.js). */
+"use strict";
+
+let TOKEN = localStorage.getItem("room_tpu_token") || "";
+let ws = null;
+let currentView = localStorage.getItem("room_tpu_view") || "swarm";
+
+const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s ?? "")
+  .replaceAll("&", "&amp;").replaceAll("<", "&lt;")
+  .replaceAll(">", "&gt;").replaceAll('"', "&quot;");
+const when = (ts) => {
+  if (!ts) return "";
+  const d = typeof ts === "number" ? new Date(ts * 1000) : new Date(ts);
+  return isNaN(d) ? String(ts) : d.toLocaleString();
+};
+
+async function api(method, path, body) {
+  const res = await fetch(path, {
+    method,
+    headers: {
+      "Authorization": "Bearer " + TOKEN,
+      ...(body ? {"Content-Type": "application/json"} : {}),
+    },
+    body: body ? JSON.stringify(body) : undefined,
+  });
+  if (res.status === 401) { showLogin(); throw new Error("unauthorized"); }
+  const out = await res.json().catch(() => ({}));
+  if (out.error && res.status >= 400) toast(out.error);
+  return out;
+}
+
+function toast(text) {
+  let el = $("toast");
+  if (!el) {
+    el = document.createElement("div");
+    el.id = "toast";
+    el.style.cssText = "position:fixed;bottom:1rem;right:1rem;" +
+      "background:#3a2020;color:#ff9b9b;padding:.6rem .9rem;" +
+      "border-radius:8px;z-index:50;max-width:40ch";
+    document.body.appendChild(el);
+  }
+  el.textContent = text;
+  el.style.display = "block";
+  clearTimeout(el._t);
+  el._t = setTimeout(() => { el.style.display = "none"; }, 5000);
+}
+
+function showLogin() {
+  $("login").classList.remove("hidden");
+  $("views").classList.add("hidden");
+}
+
+function saveToken() {
+  TOKEN = $("tokenInput").value.trim();
+  localStorage.setItem("room_tpu_token", TOKEN);
+  boot();
+}
+
+// ---- view router ----
+
+function buildNav() {
+  $("nav").innerHTML = Object.keys(PANELS).map(key =>
+    `<button data-view="${key}"` +
+    `${key === currentView ? ' class="active"' : ""}>` +
+    `${esc(PANELS[key].title)}</button>`).join("");
+  $("nav").querySelectorAll("button").forEach(btn => {
+    btn.onclick = () => showView(btn.dataset.view);
+  });
+  $("views").innerHTML = Object.keys(PANELS).map(key =>
+    `<div id="view-${key}" class="hidden"></div>`).join("");
+}
+
+function showView(key) {
+  currentView = key;
+  localStorage.setItem("room_tpu_view", key);
+  $("nav").querySelectorAll("button").forEach(b =>
+    b.classList.toggle("active", b.dataset.view === key));
+  Object.keys(PANELS).forEach(k =>
+    $("view-" + k).classList.toggle("hidden", k !== key));
+  refreshView();
+}
+
+function refreshView() {
+  const panel = PANELS[currentView];
+  if (panel) panel.render($("view-" + currentView)).catch(e =>
+    toast(`${currentView}: ${e.message}`));
+}
+
+// ---- websocket ----
+
+const subscribed = new Set();
+function subscribe(channel) {
+  if (ws && ws.readyState === 1 && !subscribed.has(channel)) {
+    ws.send(JSON.stringify({type: "subscribe", channel}));
+    subscribed.add(channel);
+  }
+}
+
+function connectWs() {
+  ws = new WebSocket(
+    `${location.protocol === "https:" ? "wss" : "ws"}://${location.host}` +
+    `/ws?token=${encodeURIComponent(TOKEN)}`);
+  ws.onopen = () => {
+    subscribed.clear();
+    ["*"].forEach(subscribe);
+  };
+  ws.onmessage = (e) => {
+    let msg;
+    try { msg = JSON.parse(e.data); } catch { return; }
+    if (msg.type === "subscribed" || msg.type === "unsubscribed") return;
+    wsLog.push(msg);
+    if (wsLog.length > 400) wsLog.shift();
+    for (const fn of Object.values(wsHandlers)) {
+      try { fn(msg); } catch {}
+    }
+  };
+  ws.onclose = () => {
+    $("statusline").textContent = "disconnected — retrying";
+    setTimeout(connectWs, 3000);
+  };
+}
+
+const wsLog = [];          // rolling event buffer for the feed panel
+const wsHandlers = {};     // name -> fn(msg), panels register here
+
+// ---- boot ----
+
+async function boot() {
+  if (!TOKEN) {
+    try {
+      const res = await fetch("/api/auth/handshake");
+      const out = await res.json();
+      if (out.data?.userToken) {
+        TOKEN = out.data.userToken;
+        localStorage.setItem("room_tpu_token", TOKEN);
+      }
+    } catch {}
+  }
+  let st;
+  try {
+    st = await api("GET", "/api/status");
+  } catch { return; }
+  $("statusline").textContent =
+    `v${st.data.version} · ${st.data.platform} x${st.data.devices}` +
+    ` · ${st.data.activeRooms} rooms`;
+  $("login").classList.add("hidden");
+  $("views").classList.remove("hidden");
+  buildNav();
+  showView(currentView in PANELS ? currentView : "swarm");
+  connectWs();
+  setInterval(refreshView, 20000);
+}

@@ -1,0 +1,710 @@
+/* room_tpu dashboard panels (reference: src/ui/components/ —
+   SwarmPanel, RoomsPanel, WorkersPanel, TasksPanel, MemoryPanel,
+   SkillsPanel, MessagesPanel, VotesPanel, TransactionsPanel,
+   ClerkPanel, SettingsPanel/StatusPanel — rebuilt dependency-free).
+   Each panel: {title, render(el)}; live updates ride wsHandlers. */
+"use strict";
+
+let selectedRoom = null;
+
+// ---- swarm (live view over cycle events) ----
+
+const swarmState = {cards: {}, logs: {}};
+
+wsHandlers.swarm = (msg) => {
+  const m = /^room:(\d+)$/.exec(msg.channel || "");
+  if (m) {
+    const d = msg.data || {};
+    if (msg.type === "cycle:started") {
+      swarmState.cards[d.worker_id] = {
+        status: "cycling", cycle: d.cycle_id, at: Date.now()};
+      subscribe(`cycle:${d.cycle_id}`);
+      swarmState.logs[d.cycle_id] = [];
+    } else if (msg.type === "cycle:finished" || msg.type === "cycle:error") {
+      for (const [wid, card] of Object.entries(swarmState.cards)) {
+        if (card.cycle === d.cycle_id || msg.type === "cycle:error" &&
+            String(d.worker_id) === wid) {
+          card.status = msg.type === "cycle:error" ? "err"
+            : (d.status === "error" ? "err" : "idle");
+          card.last = d.status || d.error || "";
+        }
+      }
+    }
+  }
+  const c = /^cycle:(\d+)$/.exec(msg.channel || "");
+  if (c && msg.type === "cycle:log") {
+    const logs = swarmState.logs[c[1]] || (swarmState.logs[c[1]] = []);
+    logs.push(msg.data || {});
+    if (logs.length > 30) logs.shift();
+  }
+  if ((m || c) && currentView === "swarm") renderSwarmCards();
+};
+
+async function renderSwarm(el) {
+  el.innerHTML = `
+    <div class="panel"><h2>swarm</h2>
+      <div class="dim" id="swarmSummary">loading…</div>
+      <div class="swarm-grid" id="swarmGrid" style="margin-top:.6rem"></div>
+    </div>
+    <div class="panel"><h2>event feed</h2>
+      <div class="log" id="eventLog"></div></div>`;
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const workers = [];
+  await Promise.all(rooms.map(async r => {
+    const ws_ = (await api("GET", `/api/rooms/${r.id}/workers`)).data || [];
+    ws_.forEach(w => workers.push({...w, room_name: r.name}));
+    subscribe(`room:${r.id}`);
+  }));
+  swarmState.workers = workers;
+  $("swarmSummary").textContent =
+    `${rooms.length} rooms · ${workers.length} workers · ` +
+    `${rooms.filter(r => r.launched).length} running`;
+  renderSwarmCards();
+  renderEventFeed();
+}
+
+function renderSwarmCards() {
+  const grid = $("swarmGrid");
+  if (!grid) return;
+  const workers = swarmState.workers || [];
+  grid.innerHTML = workers.map(w => {
+    const card = swarmState.cards[w.id] || {};
+    const cls = card.status === "cycling" ? "cycling"
+      : card.status === "err" ? "err" : "";
+    const logs = (swarmState.logs[card.cycle] || []).slice(-4);
+    return `<div class="swarm-card ${cls}">
+      <div class="who">${esc(w.name)}
+        <span class="pill">${esc(w.room_name || "")}</span></div>
+      <div class="dim" style="font-size:.8em">${esc(w.role || "worker")}
+        · ${esc(card.status || w.agent_state || "idle")}</div>
+      <div class="what">${logs.map(l =>
+        `[${esc(l.entry_type)}] ${esc(String(l.content).slice(0, 160))}`
+      ).join("\n") || esc(card.last || "")}</div>
+    </div>`;
+  }).join("") ||
+    '<div class="dim">no workers yet — create a room first</div>';
+  renderEventFeed();
+}
+
+function renderEventFeed() {
+  const log = $("eventLog");
+  if (!log) return;
+  log.innerHTML = wsLog.slice(-120).reverse().map(m =>
+    `<div><span class="t">${esc(m.channel)}</span>${esc(m.type)} ` +
+    `${esc(JSON.stringify(m.data) || "")}</div>`).join("");
+}
+
+// ---- rooms ----
+
+async function renderRooms(el) {
+  el.innerHTML = `<div class="cols">
+    <div>
+      <div class="panel"><h2>rooms</h2>
+        <div id="roomList"></div>
+        <div class="row">
+          <input id="newRoomName" placeholder="new room name…">
+          <button class="act" onclick="createRoom()">create</button>
+        </div>
+        <div class="row">
+          <select id="roomTemplate"></select>
+          <button class="ghost" onclick="instantiateTemplate()">
+            from template</button>
+        </div>
+      </div>
+    </div>
+    <div id="roomDetail" class="panel"><h2>room</h2>
+      <div class="dim">select a room</div></div>
+  </div>`;
+  loadRoomList();
+  const t = (await api("GET", "/api/templates")).data || {};
+  $("roomTemplate").innerHTML = (t.rooms || []).map(x =>
+    `<option value="${esc(x.key)}">${esc(x.name)}</option>`).join("");
+  if (selectedRoom) selectRoom(selectedRoom);
+}
+
+async function loadRoomList() {
+  const out = await api("GET", "/api/rooms");
+  const list = $("roomList");
+  if (!list) return;
+  list.innerHTML = (out.data || []).map(r => `
+    <div class="card ${r.id === selectedRoom ? "sel" : ""}"
+         onclick="selectRoom(${r.id})">
+      <span class="name">#${r.id} ${esc(r.name)}</span>
+      <span class="pill ${esc(r.status)}">${esc(r.status)}</span>
+      ${r.launched ? '<span class="pill active">running</span>' : ""}
+      <div class="meta">${esc(r.goal || "no objective")}</div>
+    </div>`).join("") || '<div class="dim">no rooms yet</div>';
+}
+
+async function createRoom() {
+  const name = $("newRoomName").value.trim();
+  if (!name) return;
+  await api("POST", "/api/rooms", {name, workerModel: "tpu"});
+  $("newRoomName").value = "";
+  loadRoomList();
+}
+
+async function instantiateTemplate() {
+  const key = $("roomTemplate").value;
+  if (!key) return;
+  await api("POST", "/api/templates/instantiate", {template: key});
+  loadRoomList();
+}
+
+async function selectRoom(id) {
+  selectedRoom = id;
+  loadRoomList();
+  const [st, goals, decisions, chat] = await Promise.all([
+    api("GET", `/api/rooms/${id}/status`),
+    api("GET", `/api/rooms/${id}/goals`),
+    api("GET", `/api/rooms/${id}/decisions`),
+    api("GET", `/api/rooms/${id}/chat`),
+  ]);
+  const s = st.data || {};
+  const renderGoal = (g, depth) =>
+    `<tr><td style="padding-left:${depth * 14 + 4}px">` +
+    `${esc(g.description)}</td><td>${Math.round(g.progress * 100)}%` +
+    `</td><td>${esc(g.status)}</td>` +
+    `<td><button class="ghost" onclick="goalAction(${g.id},'complete')">
+       done</button></td></tr>` +
+    (g.children || []).map(c => renderGoal(c, depth + 1)).join("");
+  $("roomDetail").innerHTML = `
+    <h2>#${id} ${esc(s.room?.name)}
+      <span class="pill ${esc(s.room?.status)}">${esc(s.room?.status)}
+      </span></h2>
+    <div class="row" style="margin:.2rem 0 .8rem">
+      <button class="act" onclick="roomAction(${id},'start')">start</button>
+      <button class="ghost" onclick="roomAction(${id},'stop')">stop</button>
+      <button class="ghost" onclick="roomAction(${id},'pause')">pause</button>
+      <span class="status dim" style="align-self:center">
+        ${s.worker_count} workers · ${s.active_goals} goals ·
+        ${s.open_decisions} open decisions ·
+        ${s.pending_escalations} escalations</span>
+    </div>
+    <h2>goal tree</h2>
+    <table>${(goals.data || []).map(g => renderGoal(g, 0)).join("")}</table>
+    <div class="row">
+      <input id="newGoal" placeholder="add a goal…">
+      <button class="ghost" onclick="addGoal(${id})">add</button>
+    </div>
+    <h2 style="margin-top:.8rem">decisions</h2>
+    <table>${(decisions.data || []).slice(0, 8).map(d => `
+      <tr><td>${esc(d.proposal)}</td>
+      <td><span class="pill">${esc(d.status)}</span></td></tr>`
+    ).join("")}</table>
+    <h2 style="margin-top:.8rem">chat with the queen</h2>
+    <div class="log" id="roomChat">${(chat.data || []).map(m =>
+      `<div><span class="t">${esc(m.role)}</span>${esc(m.content)}</div>`
+    ).join("")}</div>
+    <div class="row">
+      <input id="chatInput" placeholder="message the queen…"
+             onkeydown="if(event.key==='Enter')roomChatSend(${id})">
+      <button class="act" onclick="roomChatSend(${id})">send</button>
+    </div>`;
+  const log = $("roomChat");
+  if (log) log.scrollTop = log.scrollHeight;
+  subscribe(`room:${id}`);
+}
+
+async function goalAction(id, action) {
+  await api("POST", `/api/goals/${id}/${action}`);
+  if (selectedRoom) selectRoom(selectedRoom);
+}
+
+async function addGoal(id) {
+  const input = $("newGoal");
+  if (!input.value.trim()) return;
+  await api("POST", `/api/rooms/${id}/goals`,
+    {description: input.value.trim()});
+  selectRoom(id);
+}
+
+async function roomAction(id, action) {
+  await api("POST", `/api/rooms/${id}/${action}`);
+  selectRoom(id);
+}
+
+async function roomChatSend(id) {
+  const input = $("chatInput");
+  if (!input.value.trim()) return;
+  await api("POST", `/api/rooms/${id}/chat`, {content: input.value});
+  input.value = "";
+  selectRoom(id);
+}
+
+// ---- workers ----
+
+async function renderWorkers(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const blocks = await Promise.all(rooms.map(async r => {
+    const ws_ = (await api("GET", `/api/rooms/${r.id}/workers`)).data || [];
+    return `<div class="panel"><h2>${esc(r.name)}</h2>
+      <table><tr><th>worker</th><th>role</th><th>model</th>
+        <th>state</th><th>cycles</th><th></th></tr>
+      ${ws_.map(w => `<tr>
+        <td>#${w.id} ${esc(w.name)}</td><td>${esc(w.role || "")}</td>
+        <td>${esc(w.model || "room default")}</td>
+        <td><span class="pill">${esc(w.agent_state)}</span></td>
+        <td>${w.cycle_count ?? ""}</td>
+        <td><button class="ghost" onclick="triggerWorker(${w.id})">
+          trigger</button></td></tr>`).join("")}</table>
+      <div class="row">
+        <input id="newWorker-${r.id}" placeholder="new worker name…">
+        <button class="ghost" onclick="addWorker(${r.id})">add</button>
+      </div></div>`;
+  }));
+  el.innerHTML = blocks.join("") ||
+    '<div class="panel"><div class="dim">no rooms yet</div></div>';
+}
+
+async function triggerWorker(id) {
+  await api("POST", `/api/workers/${id}/start`);
+  refreshView();
+}
+
+async function addWorker(roomId) {
+  const input = $(`newWorker-${roomId}`);
+  if (!input.value.trim()) return;
+  await api("POST", `/api/rooms/${roomId}/workers`,
+    {name: input.value.trim()});
+  refreshView();
+}
+
+// ---- tasks ----
+
+async function renderTasks(el) {
+  const out = await api("GET", "/api/tasks");
+  el.innerHTML = `<div class="panel"><h2>tasks</h2>
+    <table><tr><th>task</th><th>trigger</th><th>runs</th>
+      <th>status</th><th></th></tr>
+    ${(out.data || []).map(t => `
+      <tr><td>#${t.id} ${esc(t.name)}
+        <div class="dim" style="font-size:.82em">
+          ${esc((t.instructions || "").slice(0, 110))}</div></td>
+      <td>${esc(t.cron_expression || t.trigger_type)}</td>
+      <td><a href="#" onclick="showRuns(${t.id});return false">
+        ${t.run_count}</a></td>
+      <td><span class="pill ${esc(t.status)}">${esc(t.status)}</span></td>
+      <td class="row" style="margin:0">
+        <button class="ghost" onclick="taskAction(${t.id},'run')">run</button>
+        <button class="ghost" onclick="taskAction(${t.id},
+          '${t.status === "paused" ? "resume" : "pause"}')">
+          ${t.status === "paused" ? "resume" : "pause"}</button>
+      </td></tr>`).join("")}</table>
+    <div id="taskRuns"></div></div>`;
+}
+
+async function taskAction(id, action) {
+  await api("POST", `/api/tasks/${id}/${action}`);
+  refreshView();
+}
+
+async function showRuns(id) {
+  const out = await api("GET", `/api/tasks/${id}/runs`);
+  $("taskRuns").innerHTML = `<h2 style="margin-top:.8rem">
+    runs of #${id}</h2>
+    <table>${(out.data || []).slice(0, 10).map(r => `
+      <tr><td>#${r.id}</td><td>${esc(when(r.started_at))}</td>
+      <td><span class="pill ${esc(r.status)}">${esc(r.status)}</span></td>
+      <td>${esc((r.result || r.error || "").slice(0, 150))}</td></tr>`
+    ).join("")}</table>`;
+}
+
+// ---- memory ----
+
+async function renderMemory(el) {
+  el.innerHTML = `<div class="panel"><h2>memory</h2>
+    <div class="row">
+      <input id="memQuery" placeholder="search memories…"
+        onkeydown="if(event.key==='Enter')memSearch()">
+      <button class="act" onclick="memSearch()">search</button>
+    </div>
+    <div class="row">
+      <input id="memNew" placeholder="remember something…">
+      <button class="ghost" onclick="memAdd()">add</button>
+    </div>
+    <div id="memResults" style="margin-top:.6rem"></div></div>`;
+  memSearch();
+}
+
+async function memSearch() {
+  const q = $("memQuery") ? $("memQuery").value.trim() : "";
+  const out = await api("GET",
+    "/api/memory/search?q=" + encodeURIComponent(q || ""));
+  $("memResults").innerHTML = `<table>
+    ${(out.data || []).map(m => `
+      <tr><td>${esc(m.content)}
+        <div class="dim" style="font-size:.8em">
+          ${esc(m.category || "")} · ${esc(when(m.created_at))}</div></td>
+      <td style="width:4rem">
+        <button class="ghost" onclick="memDelete(${m.id})">forget</button>
+      </td></tr>`).join("")}
+  </table>` || '<div class="dim">nothing stored yet</div>';
+}
+
+async function memAdd() {
+  const input = $("memNew");
+  const content = input.value.trim();
+  if (!content) return;
+  await api("POST", "/api/memory",
+    {name: content.slice(0, 48), content});
+  input.value = "";
+  memSearch();
+}
+
+async function memDelete(id) {
+  await api("DELETE", `/api/memory/${id}`);
+  memSearch();
+}
+
+// ---- skills ----
+
+async function renderSkills(el) {
+  const out = await api("GET", "/api/skills");
+  el.innerHTML = `<div class="panel"><h2>skills</h2>
+    <table>${(out.data || []).map(s => `
+      <tr><td><b>${esc(s.name)}</b>
+        <div class="dim" style="font-size:.84em">
+          ${esc((s.content || s.description || "").slice(0, 160))}</div>
+      </td>
+      <td style="width:4rem">
+        <button class="ghost" onclick="skillDelete(${s.id})">delete</button>
+      </td></tr>`).join("")}</table>
+    <div class="row">
+      <input id="skillName" placeholder="skill name…">
+      <input id="skillContent" placeholder="what was learned…">
+      <button class="ghost" onclick="skillAdd()">add</button>
+    </div></div>`;
+}
+
+async function skillAdd() {
+  const name = $("skillName").value.trim();
+  const content = $("skillContent").value.trim();
+  if (!name || !content) return;
+  await api("POST", "/api/skills", {name, content});
+  refreshView();
+}
+
+async function skillDelete(id) {
+  await api("DELETE", `/api/skills/${id}`);
+  refreshView();
+}
+
+// ---- inbox (escalations + queen messages) ----
+
+async function renderInbox(el) {
+  const esc_ = (await api("GET", "/api/escalations")).data || [];
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const msgBlocks = await Promise.all(rooms.map(async r => {
+    const ms = (await api("GET", `/api/rooms/${r.id}/messages`)).data || [];
+    return ms.filter(m => m.status === "unread")
+             .map(m => ({...m, room: r.name}));
+  }));
+  const msgs = msgBlocks.flat();
+  el.innerHTML = `
+    <div class="panel"><h2>escalations</h2>
+      <table>${esc_.filter(e => e.status === "pending").map(e => `
+        <tr><td>${esc(e.question)}</td>
+        <td style="min-width:16rem"><div class="row" style="margin:0">
+          <input id="esc-${e.id}" placeholder="answer…">
+          <button class="act" onclick="escAnswer(${e.id})">send</button>
+          <button class="ghost" onclick="escDismiss(${e.id})">dismiss</button>
+        </div></td></tr>`).join("") ||
+        '<tr><td class="dim">nothing pending</td></tr>'}</table></div>
+    <div class="panel"><h2>unread messages</h2>
+      <table>${msgs.map(m => `
+        <tr><td><span class="pill">${esc(m.room)}</span>
+          <b>${esc(m.subject || "")}</b> ${esc(m.body || "")}</td>
+        <td style="min-width:16rem"><div class="row" style="margin:0">
+          <input id="msg-${m.id}" placeholder="reply…">
+          <button class="act" onclick="msgReply(${m.id})">reply</button>
+          <button class="ghost" onclick="msgRead(${m.id})">mark read</button>
+        </div></td></tr>`).join("") ||
+        '<tr><td class="dim">inbox zero</td></tr>'}</table></div>`;
+}
+
+async function escAnswer(id) {
+  const v = $(`esc-${id}`).value.trim();
+  if (!v) return;
+  await api("POST", `/api/escalations/${id}/answer`, {answer: v});
+  refreshView();
+}
+
+async function escDismiss(id) {
+  await api("POST", `/api/escalations/${id}/dismiss`);
+  refreshView();
+}
+
+async function msgReply(id) {
+  const v = $(`msg-${id}`).value.trim();
+  if (!v) return;
+  await api("POST", `/api/messages/${id}/reply`, {body: v});
+  refreshView();
+}
+
+async function msgRead(id) {
+  await api("POST", `/api/messages/${id}/read`);
+  refreshView();
+}
+
+// ---- votes ----
+
+async function renderVotes(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const blocks = await Promise.all(rooms.map(async r => {
+    const ds = (await api("GET", `/api/rooms/${r.id}/decisions`)).data || [];
+    const open = ds.filter(d => d.status === "announced" ||
+                                d.status === "voting");
+    if (!open.length) return "";
+    return `<div class="panel"><h2>${esc(r.name)}</h2>
+      <table>${open.map(d => `
+        <tr><td>${esc(d.proposal)}
+          <div class="dim" style="font-size:.8em">
+            ${esc(when(d.created_at))}</div></td>
+        <td><div class="row" style="margin:0">
+          <button class="act"
+            onclick="vote(${d.id},'approve')">approve</button>
+          <button class="ghost"
+            onclick="vote(${d.id},'reject')">reject</button>
+          <button class="ghost"
+            onclick="keeperVote(${d.id})">keeper veto</button>
+        </div></td></tr>`).join("")}</table></div>`;
+  }));
+  el.innerHTML = blocks.join("") ||
+    `<div class="panel"><div class="dim">no open decisions</div></div>`;
+}
+
+async function vote(id, v) {
+  await api("POST", `/api/decisions/${id}/vote`, {vote: v});
+  refreshView();
+}
+
+async function keeperVote(id) {
+  await api("POST", `/api/decisions/${id}/keeper-vote`, {vote: "reject"});
+  refreshView();
+}
+
+// ---- wallet ----
+
+async function renderWallet(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const blocks = await Promise.all(rooms.map(async r => {
+    const w = (await api("GET", `/api/rooms/${r.id}/wallet`)).data;
+    if (!w) return "";
+    const txs = (await api("GET",
+      `/api/rooms/${r.id}/wallet/transactions`)).data || [];
+    return `<div class="panel"><h2>${esc(r.name)} wallet</h2>
+      <div class="kv">
+        <span class="k">address</span><span>
+          <code>${esc(w.address)}</code></span>
+        <span class="k">chain</span><span>${esc(w.chain)}</span>
+      </div>
+      <div class="row">
+        <input id="wdTo-${r.id}" placeholder="0x recipient…">
+        <input id="wdAmt-${r.id}" placeholder="amount (token units)">
+        <button class="ghost" onclick="withdraw(${r.id})">withdraw</button>
+      </div>
+      <table style="margin-top:.5rem">${txs.slice(0, 8).map(t => `
+        <tr><td>${esc(t.type)}</td><td>${esc(t.amount)}</td>
+        <td>${esc(t.counterparty || "")}</td>
+        <td><span class="pill ${esc(t.status)}">${esc(t.status)}</span>
+        </td></tr>`).join("")}</table></div>`;
+  }));
+  el.innerHTML = blocks.join("") ||
+    `<div class="panel"><div class="dim">
+      no wallets — rooms create theirs on launch</div></div>`;
+}
+
+async function withdraw(roomId) {
+  const to = $(`wdTo-${roomId}`).value.trim();
+  const amount = $(`wdAmt-${roomId}`).value.trim();
+  if (!to || !amount) return;
+  const out = await api("POST", `/api/rooms/${roomId}/wallet/withdraw`,
+    {to, amount});
+  if (out.data?.txHash) toast(`sent: ${out.data.txHash}`);
+  refreshView();
+}
+
+// ---- clerk ----
+
+wsHandlers.clerk = (msg) => {
+  if (msg.type === "clerk:commentary" && currentView === "clerk") {
+    refreshView();
+  }
+};
+
+async function renderClerk(el) {
+  const out = await api("GET", "/api/clerk/messages");
+  el.innerHTML = `<div class="panel"><h2>clerk</h2>
+    <div class="log" id="clerkLog" style="max-height:460px">
+      ${(out.data || []).map(m =>
+        `<div><span class="t">${esc(m.role)}</span>${esc(m.content)}</div>`
+      ).join("")}</div>
+    <div class="row">
+      <input id="clerkInput" placeholder="ask the clerk…"
+        onkeydown="if(event.key==='Enter')clerkSend()">
+      <button class="act" onclick="clerkSend()">send</button>
+    </div></div>`;
+  const log = $("clerkLog");
+  if (log) log.scrollTop = log.scrollHeight;
+}
+
+async function clerkSend() {
+  const input = $("clerkInput");
+  if (!input.value.trim()) return;
+  const text = input.value;
+  input.value = "";
+  $("clerkLog").innerHTML +=
+    `<div><span class="t">user</span>${esc(text)}</div>`;
+  await api("POST", "/api/clerk/message", {content: text});
+  refreshView();
+}
+
+// ---- settings / status ----
+
+async function renderSettings(el) {
+  const [settings, providers, contactsOut, engines, status] =
+    await Promise.all([
+      api("GET", "/api/settings"),
+      api("GET", "/api/providers"),
+      api("GET", "/api/contacts/status"),
+      api("GET", "/api/tpu/engines"),
+      api("GET", "/api/status"),
+    ]);
+  const s = status.data || {};
+  const c = contactsOut.data || {email: {}, telegram: {}};
+  el.innerHTML = `
+    <div class="panel"><h2>runtime</h2>
+      <div class="kv">
+        <span class="k">version</span><span>${esc(s.version)}</span>
+        <span class="k">platform</span>
+          <span>${esc(s.platform)} × ${esc(s.devices)}</span>
+        <span class="k">active rooms</span><span>${esc(s.activeRooms)}</span>
+      </div></div>
+    <div class="panel"><h2>serving engines</h2>
+      <table>${Object.entries(engines.data || {}).map(([name, e]) => `
+        <tr><td>${esc(name)}</td>
+        <td><span class="pill ${esc(e.status)}">${esc(e.status)}</span></td>
+        <td>${e.tokens_decoded ?? ""} tok ·
+            ${e.free_pages ?? ""} free pages ·
+            ${e.evictions ?? 0} evictions</td></tr>`).join("") ||
+        '<tr><td class="dim">no engines warm</td></tr>'}</table></div>
+    <div class="panel"><h2>cli providers</h2>
+      <table>${Object.entries(providers.data || {}).map(([name, p]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${p.installed ? esc(p.version || "installed")
+             : '<span class="dim">not installed</span>'}</td>
+        <td>${p.connected === true
+              ? '<span class="pill verified">connected</span>'
+              : p.connected === false
+                ? '<span class="pill pending">not authenticated</span>'
+                : ""}</td>
+        <td>${p.installed && p.connected === false
+          ? `<button class="ghost" onclick="providerLogin('${esc(name)}')">
+              login</button>` : ""}</td></tr>`).join("")}</table>
+      <div id="providerAuth"></div></div>
+    <div class="panel"><h2>contacts</h2>
+      <div class="kv">
+        <span class="k">email</span>
+        <span>${esc(c.email.address || "not set")}
+          ${c.email.verified ? '<span class="pill verified">verified</span>'
+            : c.email.pendingCode
+              ? '<span class="pill pending">code sent</span>' : ""}</span>
+        <span class="k">telegram</span>
+        <span>${c.telegram.connected
+          ? `connected <span class="pill verified">
+              ${esc(c.telegram.details?.username || "")}</span>`
+          : '<span class="dim">not connected</span>'}</span>
+      </div>
+      <div class="row">
+        <input id="contactEmail" placeholder="keeper email…">
+        <button class="ghost" onclick="emailStart()">send code</button>
+        <input id="contactCode" placeholder="6-digit code">
+        <button class="ghost" onclick="emailVerify()">verify</button>
+      </div>
+      <div class="row">
+        <button class="ghost" onclick="tgStart()">
+          connect telegram</button>
+        <span id="tgLink" class="dim"></span>
+      </div></div>
+    <div class="panel"><h2>settings</h2>
+      <table id="settingsTable">${
+        Object.entries(settings.data || {}).map(([k, v]) => `
+        <tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`).join("")}
+      </table>
+      <div class="row">
+        <input id="setKey" placeholder="key">
+        <input id="setVal" placeholder="value">
+        <button class="ghost" onclick="setSetting()">set</button>
+      </div></div>`;
+}
+
+async function setSetting() {
+  const k = $("setKey").value.trim();
+  if (!k) return;
+  await api("PUT", "/api/settings", {[k]: $("setVal").value});
+  refreshView();
+}
+
+async function providerLogin(provider) {
+  const out = await api("POST",
+    `/api/providers/${provider}/auth/start`, {});
+  const sid = out.data?.sessionId;
+  if (!sid) return;
+  const poll = async () => {
+    const v = (await api("GET",
+      `/api/providers/auth/sessions/${sid}`)).data;
+    if (!v) return;
+    $("providerAuth").innerHTML = `<div class="dim"
+        style="margin-top:.5rem">
+      ${esc(v.status)} ${v.verificationUrl
+        ? `— visit <a href="${esc(v.verificationUrl)}" target="_blank"
+            style="color:var(--accent)">${esc(v.verificationUrl)}</a>`
+        : ""}
+      ${v.deviceCode ? `— code <code>${esc(v.deviceCode)}</code>` : ""}
+      <div>${v.lines.slice(-4).map(l => esc(l.text)).join("<br>")}</div>
+    </div>`;
+    if (v.active) setTimeout(poll, 1500);
+    else refreshView();
+  };
+  poll();
+}
+
+async function emailStart() {
+  const email = $("contactEmail").value.trim();
+  if (!email) return;
+  await api("POST", "/api/contacts/email/start", {email});
+  toast("verification code sent");
+}
+
+async function emailVerify() {
+  const code = $("contactCode").value.trim();
+  if (!code) return;
+  const out = await api("POST", "/api/contacts/email/verify", {code});
+  if (out.data?.ok) refreshView();
+}
+
+async function tgStart() {
+  const out = await api("POST", "/api/contacts/telegram/start", {});
+  if (out.data?.deepLink) {
+    $("tgLink").innerHTML = `open <a href="${esc(out.data.deepLink)}"
+      target="_blank" style="color:var(--accent)">
+      ${esc(out.data.deepLink)}</a>`;
+  }
+}
+
+// ---- registry ----
+
+const PANELS = {
+  swarm: {title: "swarm", render: renderSwarm},
+  rooms: {title: "rooms", render: renderRooms},
+  workers: {title: "workers", render: renderWorkers},
+  tasks: {title: "tasks", render: renderTasks},
+  inbox: {title: "inbox", render: renderInbox},
+  votes: {title: "votes", render: renderVotes},
+  memory: {title: "memory", render: renderMemory},
+  skills: {title: "skills", render: renderSkills},
+  wallet: {title: "wallet", render: renderWallet},
+  clerk: {title: "clerk", render: renderClerk},
+  settings: {title: "settings", render: renderSettings},
+};
