@@ -86,7 +86,29 @@ def main() -> None:
     from room_tpu.serving import SamplingParams, ServingEngine
 
     cfg = bench_config()
+    # ROOM_TPU_MOE_IMPL=ragged|gshard|shardmap selects the MoE path so
+    # the three implementations are benchable head-to-head (shardmap
+    # builds a pure-ep mesh over all visible devices)
+    moe_env = os.environ.get("ROOM_TPU_MOE_IMPL")
+    if moe_env and cfg.is_moe:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, moe_impl=moe_env)
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    if cfg.moe_impl == "shardmap":
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from room_tpu.ops.moe_shardmap import set_ep_mesh
+
+        devs = jax.devices()
+        mesh = Mesh(np.array(devs).reshape(len(devs)), ("ep",))
+        set_ep_mesh(mesh)
+        for key in ("w_gate", "w_up", "w_down"):
+            params["layers"][key] = jax.device_put(
+                params["layers"][key],
+                NamedSharding(mesh, P(None, "ep", None, None)),
+            )
 
     max_batch = 4 if TINY else 8
     eng = ServingEngine(

@@ -172,11 +172,18 @@ def _layer(
 
     h = rms_norm(x, lp["ln2"], cfg.rms_eps)
     if cfg.is_moe:
-        if cfg.moe_impl not in ("ragged", "gshard"):
+        if cfg.moe_impl not in ("ragged", "gshard", "shardmap"):
             raise ValueError(
-                f"unknown moe_impl {cfg.moe_impl!r} (ragged|gshard)"
+                f"unknown moe_impl {cfg.moe_impl!r} "
+                "(ragged|gshard|shardmap)"
             )
-        moe = moe_ffn_gshard if cfg.moe_impl == "gshard" else moe_ffn
+        if cfg.moe_impl == "shardmap":
+            from ..ops.moe_shardmap import moe_ffn_shardmap_padded
+
+            moe = moe_ffn_shardmap_padded
+        else:
+            moe = moe_ffn_gshard if cfg.moe_impl == "gshard" \
+                else moe_ffn
         y = moe(
             h.reshape(b * s, d), lp["router"], lp["w_gate"], lp["w_up"],
             lp["w_down"],

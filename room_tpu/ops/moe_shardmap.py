@@ -1,0 +1,166 @@
+"""Expert-parallel MoE via explicit shard_map all-to-all + grouped
+matmuls (SURVEY §2.7 rung 3; the perf path for MoE decode).
+
+The GSPMD paths (`moe_ffn` sort+ragged_dot on one chip, `moe_ffn_gshard`
+dense dispatch einsums under annotations) leave the communication
+schedule to the compiler. This path writes it by hand, the way TPU MoE
+serving stacks do:
+
+  1. tokens are sharded over the ``ep`` axis; each device routes its
+     local tokens with the replicated router
+  2. one `lax.all_to_all` ships each (token, k) row to the device that
+     owns its expert, into fixed-capacity per-peer buffers
+  3. the owning device runs ONE grouped matmul (`lax.ragged_dot`) over
+     its local expert shard — rows pre-sorted by local expert id
+  4. a second all_to_all ships results back; the source device combines
+     with router gates
+
+Static shapes throughout: per-peer capacity C bounds the exchange
+buffers ([ep, C, D] both ways); rows beyond capacity drop (GShard
+semantics), with C sized so decode-shaped batches never drop at the
+default factor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .core import _route
+
+# mesh registry: the model layer (qwen3._layer) has no mesh argument —
+# the host that builds the mesh installs it here before tracing with
+# moe_impl="shardmap"
+_EP_MESH: Mesh | None = None
+
+
+def set_ep_mesh(mesh: Mesh | None) -> None:
+    global _EP_MESH
+    _EP_MESH = mesh
+
+
+def get_ep_mesh() -> Mesh:
+    if _EP_MESH is None:
+        raise RuntimeError(
+            "moe_impl='shardmap' needs set_ep_mesh(mesh) before tracing"
+        )
+    return _EP_MESH
+
+
+def moe_ffn_shardmap_padded(
+    x: jax.Array, router_w, w_gate, w_up, w_down, *,
+    top_k: int, renormalize: bool = True,
+) -> jax.Array:
+    """Model-layer entry: pads the token axis to a multiple of ep (the
+    pad rows route but their outputs are sliced away), mesh from the
+    registry."""
+    mesh = get_ep_mesh()
+    ep = mesh.shape["ep"]
+    t = x.shape[0]
+    padded = -(-t // ep) * ep
+    if padded != t:
+        x = jnp.pad(x, ((0, padded - t), (0, 0)))
+    out = moe_ffn_shardmap(
+        x, router_w, w_gate, w_up, w_down,
+        top_k=top_k, renormalize=renormalize, mesh=mesh,
+    )
+    return out[:t]
+
+
+def moe_ffn_shardmap(
+    x: jax.Array,            # [T, D], T divisible by ep (caller pads)
+    router_w: jax.Array,     # [D, E] replicated
+    w_gate: jax.Array,       # [E, D, F] sharded over ep on axis 0
+    w_up: jax.Array,
+    w_down: jax.Array,       # [E, F, D]
+    *,
+    top_k: int,
+    renormalize: bool = True,
+    mesh: Mesh,
+    axis: str = "ep",
+    capacity_factor: float = 2.0,
+) -> jax.Array:
+    t, d = x.shape
+    e = router_w.shape[-1]
+    ep = mesh.shape[axis]
+    if t % ep != 0:
+        raise ValueError(f"token count {t} not divisible by ep={ep}")
+    if e % ep != 0:
+        raise ValueError(f"experts {e} not divisible by ep={ep}")
+    e_local = e // ep
+    t_local = t // ep
+    # per-peer exchange capacity: even routing sends t_local*K/ep rows
+    # to each peer; factor covers skew, floor covers tiny decode batches
+    cap = max(
+        int(t_local * top_k / ep * capacity_factor), min(t_local, 8),
+        top_k,
+    )
+
+    def local(x_l, router_l, wg_l, wu_l, wd_l):
+        # x_l [Tl, D]; wg_l [e_local, D, F]
+        tl = x_l.shape[0]
+        gates, chosen = _route(x_l, router_l, top_k, renormalize)
+        flat_choice = chosen.reshape(-1)              # [Tl*K]
+        dest = flat_choice // e_local                 # peer owning expert
+        local_eid = flat_choice % e_local
+
+        # slot of each row inside its destination buffer
+        dest_onehot = jax.nn.one_hot(dest, ep, dtype=jnp.int32)
+        pos = jnp.cumsum(dest_onehot, axis=0) - dest_onehot
+        slot = jnp.sum(pos * dest_onehot, axis=1)     # [Tl*K]
+        keep = slot < cap
+
+        token_of = jnp.arange(tl * top_k) // top_k
+        rows = x_l[token_of]                          # [Tl*K, D]
+        safe_dest = jnp.where(keep, dest, 0)
+        safe_slot = jnp.where(keep, slot, cap - 1)
+
+        send_x = jnp.zeros((ep, cap, d), x_l.dtype).at[
+            safe_dest, safe_slot
+        ].set(jnp.where(keep[:, None], rows, 0))
+        # empty slots carry expert id 0 with zeroed rows: their FFN
+        # output is combined with gate 0, so they are harmless
+        send_eid = jnp.zeros((ep, cap), jnp.int32).at[
+            safe_dest, safe_slot
+        ].set(jnp.where(keep, local_eid, 0))
+
+        recv_x = jax.lax.all_to_all(send_x, axis, 0, 0)   # [ep, cap, D]
+        recv_eid = jax.lax.all_to_all(send_eid, axis, 0, 0)
+
+        # grouped matmul over the local expert shard
+        flat_x = recv_x.reshape(ep * cap, d)
+        flat_eid = recv_eid.reshape(ep * cap)
+        order = jnp.argsort(flat_eid)
+        xs = flat_x[order]
+        group_sizes = jnp.bincount(flat_eid, length=e_local)
+        g = jax.lax.ragged_dot(xs, wg_l, group_sizes)
+        u = jax.lax.ragged_dot(xs, wu_l, group_sizes)
+        h = (jax.nn.silu(g.astype(jnp.float32)) *
+             u.astype(jnp.float32)).astype(x_l.dtype)
+        y_sorted = jax.lax.ragged_dot(h, wd_l, group_sizes)
+        y = jnp.zeros_like(y_sorted).at[order].set(y_sorted)
+        y = y.reshape(ep, cap, d)
+
+        y_back = jax.lax.all_to_all(y, axis, 0, 0)    # source layout
+
+        gathered = y_back[safe_dest, safe_slot]       # [Tl*K, D]
+        w = (gates.reshape(-1) * keep).astype(jnp.float32)
+        out = jnp.zeros((tl, d), jnp.float32).at[token_of].add(
+            gathered.astype(jnp.float32) * w[:, None]
+        )
+        return out.astype(x_l.dtype)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(axis, None),          # tokens sharded over ep
+            P(None, None),          # router replicated
+            P(axis, None, None),    # expert weights sharded on E
+            P(axis, None, None),
+            P(axis, None, None),
+        ),
+        out_specs=P(axis, None),
+    )
+    return fn(x, router_w, w_gate, w_up, w_down)

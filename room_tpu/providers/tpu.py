@@ -95,6 +95,14 @@ class ModelHost:
             )
             from ..serving import ServingEngine, load_tokenizer
 
+            moe_env = os.environ.get("ROOM_TPU_MOE_IMPL")
+            if moe_env and self.cfg.is_moe:
+                import dataclasses
+
+                self.cfg = dataclasses.replace(
+                    self.cfg, moe_impl=moe_env
+                )
+
             params = qwen3.init_params(self.cfg, jax.random.PRNGKey(0))
             ckpt = checkpoint_dir(self.name)
             if ckpt:
@@ -110,6 +118,15 @@ class ModelHost:
                 params = shard_pytree(
                     params, decoder_param_specs(self.cfg), mesh
                 )
+            if self.cfg.moe_impl == "shardmap":
+                if mesh is None:
+                    raise ProviderError(
+                        "moe_impl=shardmap needs ROOM_TPU_MESH with an "
+                        "ep axis"
+                    )
+                from ..ops.moe_shardmap import set_ep_mesh
+
+                set_ep_mesh(mesh)
 
             # the engine places its page pool on the same mesh as the
             # params so KV reads never cross chips
