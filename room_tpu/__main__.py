@@ -1,0 +1,6 @@
+"""`python -m room_tpu <cmd>` — the spawn form MCP auto-registration
+writes into client configs (reference ships a server.js path instead)."""
+
+from .cli.main import main
+
+raise SystemExit(main())

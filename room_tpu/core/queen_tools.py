@@ -85,6 +85,26 @@ _SHARED_TOOLS = [
         {"query": {"type": "string"}},
         ["query"],
     ),
+    _tool(
+        "web_browse",
+        "Persistent browser session (cookies survive between calls). "
+        "action=open navigates (url required; omit session_id to start "
+        "a session); click follows link #index from the last snapshot; "
+        "submit fills+submits form #index with fields; text returns "
+        "page text (optionally only lines matching find); back goes to "
+        "the previous page; close ends the session.",
+        {
+            "action": {"type": "string",
+                       "enum": ["open", "click", "submit", "text",
+                                "back", "close"]},
+            "session_id": {"type": "string"},
+            "url": {"type": "string"},
+            "index": {"type": "integer"},
+            "fields": {"type": "object"},
+            "find": {"type": "string"},
+        },
+        ["action"],
+    ),
 ]
 
 QUEEN_TOOLS: list[dict] = [
@@ -407,7 +427,51 @@ def _dispatch(
 
         return web_search(args["query"])
 
+    if name == "web_browse":
+        return _web_browse(args)
+
     return f"unknown tool {name!r}"
+
+
+def _web_browse(args: dict) -> str:
+    import json as _json
+
+    from .web_tools import (
+        close_web_session, get_web_session, open_web_session,
+    )
+
+    action = args.get("action")
+    sid = args.get("session_id")
+    if action == "open" and not sid:
+        sess = open_web_session()
+    else:
+        sess = get_web_session(sid or "")
+        if sess is None:
+            return (
+                f"unknown web session {sid!r}; start one with "
+                "action=open"
+            )
+
+    if action == "open":
+        if not args.get("url"):
+            return "url is required for action=open"
+        out = sess.goto(args["url"])
+    elif action == "click":
+        out = sess.click(int(args.get("index", -1)))
+    elif action == "submit":
+        out = sess.submit_form(
+            int(args.get("index", 0)), args.get("fields") or {}
+        )
+    elif action == "text":
+        return sess.text(args.get("find"))
+    elif action == "back":
+        out = sess.back()
+    elif action == "close":
+        close_web_session(sess.id)
+        return "session closed"
+    else:
+        return f"unknown action {action!r}"
+    return _json.dumps({"session_id": sess.id, **out}, indent=1)
 
 
 def _embed_query(query: str):

@@ -1,15 +1,26 @@
 """Keyless web access for agents (reference: src/shared/web-tools.ts —
-Jina Reader + DDG via a persistent browser; here: stdlib HTTP with
-readable-text extraction, fail-closed offline).
+persistent Playwright sessions with accessibility-tree snapshots + Jina
+fallback; here: a stdlib browser-lite).
 
-A browser-automation backend can be layered in later; the tool contract
-(web_fetch/web_search returning text) stays the same."""
+Two layers:
+- one-shot `web_fetch` / `web_search` (readable-text extraction,
+  fail-closed offline)
+- persistent `WebSession`s (the reference's browser-session
+  equivalent): cookie jar shared across navigations, page snapshots as
+  an accessibility-style outline (headings, indexed links, forms,
+  buttons), link clicking by index, form fill+submit, history/back.
+  No JS execution — the snapshot contract matches what agents actually
+  consume from the reference's ARIA dumps (roles + names + refs).
+"""
 
 from __future__ import annotations
 
 import html.parser
+import http.cookiejar
 import json
 import re
+import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -113,3 +124,270 @@ def _resolve_ddg_url(href: str) -> str:
         if target:
             return target
     return href
+
+
+# ---- persistent sessions (reference: web-tools.ts:19-116) ----
+
+class _OutlineParser(html.parser.HTMLParser):
+    """Accessibility-style page outline: headings, indexed links,
+    forms with their fields, buttons, and title."""
+
+    # unlike the text extractor, <head> stays parsed: <title> lives there
+    SKIP = {"script", "style", "noscript", "svg"}
+    HEADINGS = {"h1", "h2", "h3", "h4", "h5", "h6"}
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.title = ""
+        self.links: list[dict] = []
+        self.forms: list[dict] = []
+        self.buttons: list[str] = []
+        self.outline: list[str] = []
+        self._skip = 0
+        self._capture: list[str] | None = None
+        self._capture_tag = ""
+        self._form: dict | None = None
+        self._in_title = False
+
+    def handle_starttag(self, tag, attrs):
+        a = dict(attrs)
+        if tag in self.SKIP:
+            self._skip += 1
+            return
+        if self._skip:
+            return
+        if tag == "title":
+            self._in_title = True
+        elif tag in self.HEADINGS or tag == "a" or tag == "button":
+            self._capture = []
+            self._capture_tag = tag
+            if tag == "a":
+                self._capture_href = a.get("href") or ""
+        elif tag == "form":
+            self._form = {
+                "action": a.get("action") or "",
+                "method": (a.get("method") or "get").lower(),
+                "fields": [],
+            }
+            self.forms.append(self._form)
+        elif tag in ("input", "textarea", "select") and \
+                self._form is not None:
+            if a.get("type") in ("submit", "hidden"):
+                if a.get("type") == "hidden" and a.get("name"):
+                    self._form["fields"].append({
+                        "name": a["name"], "type": "hidden",
+                        "value": a.get("value", ""),
+                    })
+                return
+            if a.get("name"):
+                self._form["fields"].append({
+                    "name": a["name"],
+                    "type": a.get("type") or tag,
+                    "placeholder": a.get("placeholder", ""),
+                })
+
+    def handle_endtag(self, tag):
+        if tag in self.SKIP and self._skip:
+            self._skip -= 1
+            return
+        if tag == "title":
+            self._in_title = False
+        elif tag == "form":
+            self._form = None
+        elif self._capture is not None and tag == self._capture_tag:
+            text = re.sub(r"\s+", " ", " ".join(self._capture)).strip()
+            if self._capture_tag in self.HEADINGS:
+                depth = int(self._capture_tag[1])
+                self.outline.append(f"{'#' * depth} {text}")
+            elif self._capture_tag == "a":
+                if text or self._capture_href:
+                    self.links.append(
+                        {"text": text, "href": self._capture_href}
+                    )
+            elif self._capture_tag == "button" and text:
+                self.buttons.append(text)
+            self._capture = None
+
+    def handle_data(self, data):
+        if self._skip:
+            return
+        if self._in_title:
+            self.title += data
+        if self._capture is not None and data.strip():
+            self._capture.append(data.strip())
+
+
+class WebSession:
+    """One persistent browsing session: cookies + history + the parsed
+    current page."""
+
+    def __init__(self, session_id: str) -> None:
+        self.id = session_id
+        self.created_at = time.time()
+        self.last_used = time.time()
+        self._jar = http.cookiejar.CookieJar()
+        self._opener = urllib.request.build_opener(
+            urllib.request.HTTPCookieProcessor(self._jar)
+        )
+        self.url: str | None = None
+        self.history: list[str] = []
+        self._page: _OutlineParser | None = None
+        self._text = ""
+
+    # -- navigation --
+
+    def goto(self, url: str, data: bytes | None = None) -> dict:
+        if not url.startswith(("http://", "https://")):
+            return {"error": f"invalid url: {url!r}"}
+        self.last_used = time.time()
+        req = urllib.request.Request(
+            url, data=data, headers={"User-Agent": _UA}
+        )
+        try:
+            with self._opener.open(req, timeout=FETCH_TIMEOUT_S) as resp:
+                raw = resp.read(2_000_000)
+                final_url = resp.geturl()
+                ctype = resp.headers.get("Content-Type", "")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            return {"error":
+                    f"fetch failed: {e} (network may be unavailable)"}
+        body = raw.decode("utf-8", errors="replace")
+        if self.url:
+            self.history.append(self.url)
+        self.url = final_url
+        if "html" in ctype or body.lstrip()[:1] == "<":
+            page = _OutlineParser()
+            try:
+                page.feed(body)
+            except Exception:
+                pass
+            self._page = page
+            self._text = _extract_text(body)
+        else:
+            self._page = None
+            self._text = body
+        return self.snapshot()
+
+    def back(self) -> dict:
+        if not self.history:
+            return {"error": "no history"}
+        url = self.history.pop()
+        prev_history = list(self.history)
+        out = self.goto(url)
+        # goto() pushed the page we came FROM; restore the real stack
+        self.history = prev_history
+        return out
+
+    # -- interaction --
+
+    def click(self, link_index: int) -> dict:
+        """Follow link #i from the current snapshot."""
+        if self._page is None:
+            return {"error": "no page loaded"}
+        links = self._page.links
+        if not 0 <= link_index < len(links):
+            return {"error":
+                    f"link index {link_index} out of range "
+                    f"(0..{len(links) - 1})"}
+        href = links[link_index]["href"]
+        if not href:
+            return {"error": "link has no href"}
+        return self.goto(urllib.parse.urljoin(self.url or "", href))
+
+    def submit_form(self, form_index: int, fields: dict) -> dict:
+        """Fill + submit form #i (GET query or POST urlencoded)."""
+        if self._page is None:
+            return {"error": "no page loaded"}
+        forms = self._page.forms
+        if not 0 <= form_index < len(forms):
+            return {"error": f"form index {form_index} out of range"}
+        form = forms[form_index]
+        values = {
+            f["name"]: f.get("value", "")
+            for f in form["fields"] if f.get("type") == "hidden"
+        }
+        values.update(fields or {})
+        action = urllib.parse.urljoin(
+            self.url or "", form["action"] or (self.url or "")
+        )
+        encoded = urllib.parse.urlencode(values)
+        if form["method"] == "post":
+            return self.goto(action, data=encoded.encode())
+        sep = "&" if "?" in action else "?"
+        return self.goto(f"{action}{sep}{encoded}")
+
+    # -- views --
+
+    def snapshot(self) -> dict:
+        """Accessibility-style outline the agent navigates by."""
+        self.last_used = time.time()
+        if self._page is None:
+            return {
+                "url": self.url,
+                "text": self._text[:MAX_TEXT_CHARS],
+            }
+        p = self._page
+        return {
+            "url": self.url,
+            "title": re.sub(r"\s+", " ", p.title).strip(),
+            "outline": p.outline[:40],
+            "links": [
+                {"i": i, "text": l["text"][:80], "href": l["href"][:200]}
+                for i, l in enumerate(p.links[:60])
+            ],
+            "forms": [
+                {"i": i, "action": f["action"], "method": f["method"],
+                 "fields": [x for x in f["fields"]
+                            if x.get("type") != "hidden"]}
+                for i, f in enumerate(p.forms[:10])
+            ],
+            "buttons": p.buttons[:20],
+        }
+
+    def text(self, find: str | None = None) -> str:
+        self.last_used = time.time()
+        if find:
+            hits = []
+            for line in self._text.splitlines():
+                if find.lower() in line.lower():
+                    hits.append(line.strip())
+                if len(hits) >= 20:
+                    break
+            return "\n".join(hits) or f"{find!r} not found"
+        return self._text[:MAX_TEXT_CHARS]
+
+
+SESSION_TTL_S = 1800.0
+MAX_SESSIONS = 8
+
+_sessions: dict[str, WebSession] = {}
+_sessions_lock = threading.Lock()
+
+
+def open_web_session() -> WebSession:
+    with _sessions_lock:
+        now = time.time()
+        for sid in [s for s, v in _sessions.items()
+                    if now - v.last_used > SESSION_TTL_S]:
+            del _sessions[sid]
+        if len(_sessions) >= MAX_SESSIONS:
+            oldest = min(_sessions.values(), key=lambda s: s.last_used)
+            del _sessions[oldest.id]
+        sess = WebSession(f"web-{int(now * 1000) % 10**10}")
+        _sessions[sess.id] = sess
+        return sess
+
+
+def get_web_session(session_id: str) -> WebSession | None:
+    with _sessions_lock:
+        return _sessions.get(session_id)
+
+
+def close_web_session(session_id: str) -> bool:
+    with _sessions_lock:
+        return _sessions.pop(session_id, None) is not None
+
+
+def reset_web_sessions() -> None:
+    with _sessions_lock:
+        _sessions.clear()

@@ -1,0 +1,168 @@
+"""MCP auto-registration: patch the configs of installed AI clients so
+their agents can immediately drive this engine (reference:
+src/server/index.ts:729-864 — Claude Code, Claude Desktop, Cursor,
+Windsurf get JSON `mcpServers` entries; Codex gets a TOML section;
+Claude Code additionally gets the tools auto-approved).
+
+Only EXISTING config files are patched (a missing file means the client
+isn't installed — registration must not scatter config files around) and
+failures are silent: registration can never break server startup.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+SERVER_NAME = "room_tpu"
+
+
+def _mcp_entry(db_path: str, source: str) -> dict:
+    return {
+        "command": sys.executable,
+        "args": ["-m", "room_tpu", "mcp"],
+        "env": {"ROOM_TPU_DB_PATH": db_path,
+                "ROOM_TPU_SOURCE": source},
+    }
+
+
+def patch_mcp_config(config_path: str, entry: dict) -> bool:
+    try:
+        if not os.path.exists(config_path):
+            return False
+        try:
+            with open(config_path) as f:
+                config = json.load(f)
+            if not isinstance(config, dict):
+                config = {}
+        except (json.JSONDecodeError, OSError):
+            config = {}  # invalid JSON — rewrite
+        servers = config.get("mcpServers")
+        if not isinstance(servers, dict):
+            servers = {}
+        servers[SERVER_NAME] = entry
+        config["mcpServers"] = servers
+        with open(config_path, "w") as f:
+            json.dump(config, f, indent=2)
+            f.write("\n")
+        return True
+    except OSError:
+        return False
+
+
+def patch_codex_config(config_path: str, db_path: str) -> bool:
+    """Codex stores MCP servers in TOML; replace any existing
+    [mcp_servers.room_tpu] section line-based, then append ours."""
+    try:
+        if not os.path.exists(config_path):
+            return False
+        with open(config_path) as f:
+            raw = f.read()
+        filtered: list[str] = []
+        in_section = False
+        for line in raw.split("\n"):
+            if re.match(rf"^\[mcp_servers\.{SERVER_NAME}[\].]", line):
+                in_section = True
+                continue
+            if in_section and line.startswith("["):
+                in_section = False
+            if not in_section:
+                filtered.append(line)
+        content = "\n".join(filtered).rstrip()
+        content += (
+            f"\n\n[mcp_servers.{SERVER_NAME}]\n"
+            f"command = '{sys.executable}'\n"
+            f"args = ['-m', 'room_tpu', 'mcp']\n\n"
+            f"[mcp_servers.{SERVER_NAME}.env]\n"
+            f"ROOM_TPU_DB_PATH = '{db_path}'\n"
+            f'ROOM_TPU_SOURCE = "codex"\n'
+        )
+        with open(config_path, "w") as f:
+            f.write(content)
+        return True
+    except OSError:
+        return False
+
+
+def patch_claude_code_permissions(home: str) -> bool:
+    """Auto-approve our MCP tools in ~/.claude/settings.json so headless
+    queen sessions don't stall on permission prompts."""
+    try:
+        settings_path = os.path.join(home, ".claude", "settings.json")
+        if not os.path.exists(settings_path):
+            return False
+        try:
+            with open(settings_path) as f:
+                settings = json.load(f)
+            if not isinstance(settings, dict):
+                settings = {}
+        except (json.JSONDecodeError, OSError):
+            settings = {}
+        perms = settings.get("permissions")
+        if not isinstance(perms, dict):
+            perms = {}
+        allow = perms.get("allow")
+        allow = list(allow) if isinstance(allow, list) else []
+        pattern = f"mcp__{SERVER_NAME}__*"
+        if pattern in allow:
+            return False
+        allow.append(pattern)
+        perms["allow"] = allow
+        settings["permissions"] = perms
+        with open(settings_path, "w") as f:
+            json.dump(settings, f, indent=2)
+            f.write("\n")
+        return True
+    except OSError:
+        return False
+
+
+def register_mcp_globally(
+    db_path: str, home: Optional[str] = None
+) -> dict[str, bool]:
+    """Patch every known client; returns {client: patched} for the
+    status surface. Never raises."""
+    out: dict[str, bool] = {}
+    try:
+        home = home or os.path.expanduser("~")
+        out["claude-code"] = patch_mcp_config(
+            os.path.join(home, ".claude.json"),
+            _mcp_entry(db_path, "claude-code"),
+        )
+        out["claude-code-permissions"] = \
+            patch_claude_code_permissions(home)
+        if sys.platform == "win32":  # pragma: no cover
+            desktop = os.path.join(
+                home, "AppData", "Roaming", "Claude",
+                "claude_desktop_config.json",
+            )
+        elif sys.platform == "darwin":  # pragma: no cover
+            desktop = os.path.join(
+                home, "Library", "Application Support", "Claude",
+                "claude_desktop_config.json",
+            )
+        else:
+            desktop = os.path.join(
+                home, ".config", "Claude", "claude_desktop_config.json"
+            )
+        out["claude-desktop"] = patch_mcp_config(
+            desktop, _mcp_entry(db_path, "claude-desktop")
+        )
+        out["cursor"] = patch_mcp_config(
+            os.path.join(home, ".cursor", "mcp.json"),
+            _mcp_entry(db_path, "cursor"),
+        )
+        out["windsurf"] = patch_mcp_config(
+            os.path.join(home, ".codeium", "windsurf",
+                         "mcp_config.json"),
+            _mcp_entry(db_path, "windsurf"),
+        )
+        out["codex"] = patch_codex_config(
+            os.path.join(home, ".codex", "config.toml"), db_path
+        )
+    except Exception:
+        pass
+    return out

@@ -28,6 +28,9 @@ class ServerApp:
 
     def stop(self) -> None:
         # reverse boot order: stop loops, stop serving, close DB
+        from .updater import reset_update_checker
+
+        reset_update_checker()
         stop_server_runtime()
         self.api.stop()
         from ..providers.tpu import reset_model_hosts
@@ -42,8 +45,23 @@ def start_server(
     static_dir: Optional[str] = None,
     install_signal_handlers: bool = False,
 ) -> ServerApp:
+    from .updater import get_update_checker, init_boot_health_check
+
+    # crash-rollback check before anything serves (reference
+    # initBootHealthCheck), then the background update checker
+    init_boot_health_check()
+
     db = db or get_database()
     runtime = start_server_runtime(db)
+
+    # register our MCP server with installed AI clients (reference
+    # registerMcpGlobally; never breaks startup)
+    if os.environ.get("ROOM_TPU_MCP_AUTOREGISTER", "1") != "0":
+        from ..mcp.autoregister import register_mcp_globally
+
+        register_mcp_globally(db.path or "")
+
+    get_update_checker().start()
     if static_dir is None:
         static_dir = os.environ.get("ROOM_TPU_STATIC_DIR")
     if static_dir is None:

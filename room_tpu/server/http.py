@@ -229,6 +229,40 @@ class ApiServer:
                     })
                     return
 
+                # localhost-only restart endpoints, before auth
+                # (reference index.ts:526-576)
+                if path in ("/api/server/restart",
+                            "/api/server/update-restart") and \
+                        self.command == "POST":
+                    from .updater import (
+                        get_ready_update_version,
+                        promote_staged_update, schedule_self_restart,
+                    )
+
+                    if not self._is_localhost():
+                        self._respond(403, {
+                            "error": "restart allowed only from "
+                                     "localhost clients"
+                        })
+                        return
+                    payload = {"ok": True, "restarting": True}
+                    if path.endswith("update-restart"):
+                        version = get_ready_update_version()
+                        if not version:
+                            self._respond(404, {
+                                "error": "no update ready to apply"
+                            })
+                            return
+                        promote_staged_update()
+                        payload["version"] = version
+                    if not schedule_self_restart():
+                        self._respond(500, {
+                            "error": "failed to schedule restart"
+                        })
+                        return
+                    self._respond(202, payload)
+                    return
+
                 # tokened webhooks, before auth (reference :602-608)
                 if path.startswith("/api/hooks/"):
                     self._respond(*handle_webhook_request(

@@ -179,6 +179,22 @@ def register_provider_routes(r: Router) -> None:
         view = get_auth_manager().cancel(ctx.params["sid"])
         return ok(view) if view else err("unknown session", 404)
 
+    def update_status(ctx):
+        from .updater import get_update_checker
+
+        return ok(get_update_checker().status_view())
+
+    def update_check(ctx):
+        from .updater import get_update_checker
+
+        checker = get_update_checker()
+        checker.force_check(
+            ignore_backoff=bool((ctx.body or {}).get("ignoreBackoff"))
+        )
+        return ok(checker.status_view())
+
+    r.get("/api/update", update_status)
+    r.post("/api/update/check", update_check)
     r.get("/api/providers", providers_status)
     r.post("/api/providers/:provider/auth/start", auth_start)
     r.get("/api/providers/:provider/auth", auth_get)

@@ -1,0 +1,463 @@
+"""Update checker + staged auto-update + safe restart (reference:
+src/server/updateChecker.ts — 4h poll, cloud source with GitHub-releases
+fallback, exponential backoff, diagnostics; src/server/autoUpdate.ts —
+lightweight bundle downloaded to a staging dir, sha256 checksums from
+version.json, promote-on-restart, `.booting` marker with a 3-strike
+crash rollback; src/server/index.ts:526-576 — localhost-only
+restart / update-restart endpoints).
+
+The update *source* is an HTTP JSON endpoint
+(ROOM_TPU_UPDATE_SOURCE_URL -> {"version", "updateBundleUrl",
+"releaseUrl"}) or the GitHub releases API (ROOM_TPU_UPDATE_GITHUB_REPO,
+"owner/name"); with neither configured the checker idles — this image
+has no egress, so tests stub the source with a local HTTP server.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import shutil
+import sys
+import tarfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+from .. import __version__
+
+DEFAULT_POLL_S = 4 * 3600.0
+INITIAL_DELAY_S = 15.0
+BACKOFF_BASE_S = 30.0
+BACKOFF_MAX_S = 30 * 60.0
+BOOT_GRACE_S = 30.0
+CRASH_ROLLBACK_THRESHOLD = 3
+
+
+def data_dir() -> str:
+    return os.environ.get(
+        "ROOM_TPU_DATA_DIR",
+        os.path.join(os.path.expanduser("~"), ".room_tpu"),
+    )
+
+
+def app_dir() -> str:
+    return os.path.join(data_dir(), "app")
+
+
+def staging_dir() -> str:
+    return os.path.join(data_dir(), "app-staging")
+
+
+def _version_file(base: str) -> str:
+    return os.path.join(base, "version.json")
+
+
+# ---- semver ----
+
+def parse_semver(tag: str) -> Optional[tuple[int, int, int]]:
+    m = re.match(
+        r"^(\d+)\.(\d+)\.(\d+)(?:[-+].*)?$",
+        tag.strip().lstrip("vV"),
+    )
+    return (int(m[1]), int(m[2]), int(m[3])) if m else None
+
+
+def semver_gt(a: str, b: str) -> bool:
+    pa, pb = parse_semver(a), parse_semver(b)
+    if pa is None or pb is None:
+        return False
+    return pa > pb
+
+
+# ---- checker ----
+
+class UpdateChecker:
+    def __init__(
+        self,
+        poll_s: float = DEFAULT_POLL_S,
+        on_ready_update: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.poll_s = poll_s
+        self.on_ready_update = on_ready_update
+        self.cached: Optional[dict] = None
+        self.auto_status: dict = {"state": "idle"}
+        self.diagnostics = {
+            "lastCheckAt": None, "lastSuccessAt": None,
+            "lastErrorAt": None, "lastErrorCode": None,
+            "lastErrorMessage": None, "updateSource": None,
+            "nextCheckAt": None, "consecutiveFailures": 0,
+        }
+        self._failures = 0
+        self._backoff_until = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- sources --
+
+    def _cloud_source(self) -> Optional[dict]:
+        url = (os.environ.get("ROOM_TPU_UPDATE_SOURCE_URL")
+               or "").strip()
+        if not url:
+            return None
+        token = (os.environ.get("ROOM_TPU_UPDATE_SOURCE_TOKEN")
+                 or "").strip() or None
+        return {"url": url, "token": token}
+
+    def _github_repo(self) -> Optional[str]:
+        return (os.environ.get("ROOM_TPU_UPDATE_GITHUB_REPO")
+                or "").strip() or None
+
+    def _fetch_json(self, url: str,
+                    headers: Optional[dict] = None) -> Any:
+        req = urllib.request.Request(
+            url,
+            headers={
+                "User-Agent": "room-tpu-update-checker",
+                "Accept": "application/json",
+                **(headers or {}),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def _resolve(self) -> tuple[dict, str]:
+        cloud = self._cloud_source()
+        cloud_err: Optional[str] = None
+        if cloud:
+            try:
+                raw = self._fetch_json(
+                    cloud["url"],
+                    {"Authorization": f"Bearer {cloud['token']}"}
+                    if cloud["token"] else {},
+                )
+                version = str(raw.get("version") or "").lstrip("v")
+                bundle = str(raw.get("updateBundleUrl") or "").strip()
+                if not version:
+                    raise ValueError("cloud source missing version")
+                if not bundle:
+                    raise ValueError("cloud source missing bundle url")
+                return {
+                    "latestVersion": version,
+                    "releaseUrl": raw.get("releaseUrl"),
+                    "updateBundle": bundle,
+                }, "cloud"
+            except Exception as e:
+                cloud_err = str(e)
+        repo = self._github_repo()
+        if repo:
+            raw = self._fetch_json(
+                f"https://api.github.com/repos/{repo}/releases"
+                "?per_page=100"
+            )
+            info = self._parse_github(raw)
+            if info:
+                return info, "github"
+            raise ValueError("no stable release with a bundle asset")
+        if cloud_err:
+            raise ValueError(f"cloud source failed: {cloud_err}")
+        raise ValueError("no update source configured")
+
+    @staticmethod
+    def _parse_github(releases: Any) -> Optional[dict]:
+        best = None
+        best_ver = None
+        for r in releases or []:
+            if r.get("draft") or r.get("prerelease"):
+                continue
+            if "-test" in (r.get("tag_name") or "").lower():
+                continue
+            ver = parse_semver(r.get("tag_name") or "")
+            if ver and (best_ver is None or ver > best_ver):
+                best, best_ver = r, ver
+        if best is None:
+            return None
+        bundle = None
+        for a in best.get("assets") or []:
+            name = a.get("name") or ""
+            if name.startswith("room-tpu-update-") and \
+                    name.endswith(".tar.gz"):
+                bundle = a.get("browser_download_url")
+        return {
+            "latestVersion": (best.get("tag_name") or "").lstrip("v"),
+            "releaseUrl": best.get("html_url"),
+            "updateBundle": bundle,
+        }
+
+    # -- check loop --
+
+    def force_check(self, ignore_backoff: bool = False) -> None:
+        with self._lock:
+            now = time.time()
+            self.diagnostics["lastCheckAt"] = now
+            if not ignore_backoff and self._backoff_until > now:
+                self.diagnostics["nextCheckAt"] = self._backoff_until
+                return
+            try:
+                info, source = self._resolve()
+                self.cached = info
+                self.diagnostics.update(
+                    updateSource=source, lastSuccessAt=time.time(),
+                    lastErrorAt=None, lastErrorCode=None,
+                    lastErrorMessage=None, nextCheckAt=None,
+                    consecutiveFailures=0,
+                )
+                self._failures = 0
+                self._backoff_until = 0.0
+            except Exception as e:
+                self._failures += 1
+                backoff = 0.0 if self._failures <= 1 else min(
+                    BACKOFF_MAX_S,
+                    BACKOFF_BASE_S * 2 ** min(8, self._failures - 2),
+                )
+                self._backoff_until = (
+                    time.time() + backoff if backoff else 0.0
+                )
+                self.diagnostics.update(
+                    lastErrorAt=time.time(),
+                    lastErrorCode=type(e).__name__,
+                    lastErrorMessage=str(e),
+                    consecutiveFailures=self._failures,
+                    nextCheckAt=self._backoff_until or None,
+                )
+                return
+
+        info = self.cached
+        if info and info.get("updateBundle") and \
+                semver_gt(info["latestVersion"], __version__):
+            before = get_ready_update_version()
+            try:
+                self.download_and_stage(
+                    info["updateBundle"], info["latestVersion"]
+                )
+            except Exception as e:
+                self.auto_status = {"state": "error", "error": str(e)}
+                return
+            after = get_ready_update_version()
+            if self.on_ready_update and after and after != before:
+                try:
+                    self.on_ready_update(after)
+                except Exception:
+                    pass
+
+    def download_and_stage(self, bundle_url: str, version: str) -> None:
+        """Download → extract → verify checksums → mark ready. The
+        staged tree is promoted on the next (update-)restart."""
+        if get_ready_update_version() == version:
+            return
+        self.auto_status = {"state": "downloading", "version": version}
+        stage = staging_dir()
+        try:
+            self._download_and_stage_inner(bundle_url, version, stage)
+        except Exception:
+            # a failed/unverified stage must not look "ready"
+            shutil.rmtree(stage, ignore_errors=True)
+            raise
+
+    def _download_and_stage_inner(
+        self, bundle_url: str, version: str, stage: str
+    ) -> None:
+        shutil.rmtree(stage, ignore_errors=True)
+        os.makedirs(stage, exist_ok=True)
+        tarball = os.path.join(stage, "update.tar.gz")
+        req = urllib.request.Request(
+            bundle_url,
+            headers={"User-Agent": "room-tpu-auto-updater/1.0"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp, \
+                open(tarball, "wb") as f:
+            shutil.copyfileobj(resp, f)
+
+        self.auto_status = {"state": "verifying", "version": version}
+        with tarfile.open(tarball, "r:gz") as tf:
+            tf.extractall(stage, filter="data")
+        os.unlink(tarball)
+
+        vf = _version_file(stage)
+        if not os.path.exists(vf):
+            raise ValueError("missing version.json in update bundle")
+        with open(vf) as f:
+            vinfo = json.load(f)
+        if not vinfo.get("version"):
+            raise ValueError("version.json missing version field")
+        for rel, expected in (vinfo.get("checksums") or {}).items():
+            path = os.path.join(stage, rel)
+            if not os.path.exists(path):
+                raise ValueError(f"missing file in update: {rel}")
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(65536), b""):
+                    h.update(chunk)
+            if h.hexdigest() != expected:
+                raise ValueError(f"checksum mismatch for {rel}")
+        self.auto_status = {
+            "state": "ready", "version": vinfo["version"],
+        }
+
+    def start(self) -> None:
+        def loop() -> None:
+            if self._stop.wait(INITIAL_DELAY_S):
+                return
+            while not self._stop.is_set():
+                try:
+                    self.force_check()
+                except Exception:
+                    pass
+                if self._stop.wait(self.poll_s):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="update-checker"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def status_view(self) -> dict:
+        auto = dict(self.auto_status)
+        if auto.get("state") == "idle":
+            ready = get_ready_update_version()
+            if ready:
+                auto = {"state": "ready", "version": ready}
+        return {
+            "currentVersion": __version__,
+            "updateInfo": self.cached,
+            "autoUpdate": auto,
+            "diagnostics": dict(self.diagnostics),
+        }
+
+
+# ---- staged-update promotion + crash rollback ----
+
+def get_ready_update_version() -> Optional[str]:
+    vf = _version_file(staging_dir())
+    try:
+        with open(vf) as f:
+            version = json.load(f).get("version")
+        return version if version and \
+            semver_gt(version, __version__) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def promote_staged_update() -> Optional[str]:
+    """Move the verified staging tree into the live app dir (called on
+    the way into an update-restart)."""
+    version = get_ready_update_version()
+    if not version:
+        return None
+    target = app_dir()
+    shutil.rmtree(target, ignore_errors=True)
+    os.rename(staging_dir(), target)
+    return version
+
+
+def init_boot_health_check(grace_s: float = BOOT_GRACE_S) -> None:
+    """On startup: roll back a crash-looping user-space update (3
+    strikes), clean a stale one (bundled version >= staged), then arm
+    the boot marker that the crash counter rides on."""
+    target = app_dir()
+    marker = os.path.join(target, ".booting")
+    crash_file = os.path.join(target, ".crash_count")
+    if not os.path.isdir(target):
+        return
+    vf = _version_file(target)
+    if not os.path.exists(vf):
+        shutil.rmtree(target, ignore_errors=True)  # legacy/unversioned
+        return
+    try:
+        with open(vf) as f:
+            staged_version = json.load(f).get("version") or ""
+    except (OSError, json.JSONDecodeError):
+        staged_version = ""
+    if not semver_gt(staged_version, __version__):
+        shutil.rmtree(target, ignore_errors=True)  # stale
+        return
+
+    crashes = 0
+    if os.path.exists(marker):
+        # previous boot never survived the grace window
+        try:
+            with open(crash_file) as f:
+                crashes = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            crashes = 0
+        crashes += 1
+        if crashes >= CRASH_ROLLBACK_THRESHOLD:
+            shutil.rmtree(target, ignore_errors=True)
+            return
+        try:
+            with open(crash_file, "w") as f:
+                f.write(str(crashes))
+        except OSError:
+            pass
+    try:
+        with open(marker, "w") as f:
+            json.dump({"pid": os.getpid(), "at": time.time()}, f)
+    except OSError:
+        return
+
+    def clear() -> None:
+        for path in (marker, crash_file):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    t = threading.Timer(grace_s, clear)
+    t.daemon = True
+    t.start()
+
+
+# ---- restart ----
+
+_restart_hook: Optional[Callable[[], None]] = None
+
+
+def set_restart_hook(hook: Optional[Callable[[], None]]) -> None:
+    """Tests and embedders override what 'restart' means."""
+    global _restart_hook
+    _restart_hook = hook
+
+
+def schedule_self_restart(delay_s: float = 0.12) -> bool:
+    """Exec a fresh copy of this process after a short delay so the
+    HTTP response gets out first (reference: scheduleSelfRestart)."""
+    def do_restart() -> None:
+        if _restart_hook is not None:
+            _restart_hook()
+            return
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+
+    try:
+        t = threading.Timer(delay_s, do_restart)
+        t.daemon = True
+        t.start()
+        return True
+    except Exception:
+        return False
+
+
+_checker: Optional[UpdateChecker] = None
+
+
+def get_update_checker() -> UpdateChecker:
+    global _checker
+    if _checker is None:
+        _checker = UpdateChecker()
+    return _checker
+
+
+def reset_update_checker() -> None:
+    global _checker
+    if _checker is not None:
+        _checker.stop()
+    _checker = None

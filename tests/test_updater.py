@@ -1,0 +1,361 @@
+"""Update checker / staged auto-update / restart + MCP auto-registration
+(reference behaviors: src/server/updateChecker.ts, autoUpdate.ts,
+index.ts:526-576 restart endpoints, index.ts:729-864 registerMcpGlobally).
+All network is stubbed with a local HTTP server (zero egress image)."""
+
+import hashlib
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from room_tpu import __version__
+from room_tpu.server import updater
+from room_tpu.server.updater import (
+    UpdateChecker, get_ready_update_version, init_boot_health_check,
+    parse_semver, promote_staged_update, semver_gt,
+)
+
+
+# ---- semver ----
+
+def test_semver():
+    assert parse_semver("v1.2.3") == (1, 2, 3)
+    assert parse_semver("1.2.3-rc1") == (1, 2, 3)
+    assert parse_semver("nope") is None
+    assert semver_gt("1.2.10", "1.2.9")
+    assert not semver_gt("1.2.3", "1.2.3")
+    assert not semver_gt("garbage", "1.0.0")
+
+
+def test_github_release_pick():
+    releases = [
+        {"tag_name": "v2.0.0", "prerelease": True, "assets": []},
+        {"tag_name": "v1.4.0-test", "assets": []},
+        {"tag_name": "v1.3.0", "html_url": "u3", "assets": [
+            {"name": "room-tpu-update-1.3.0.tar.gz",
+             "browser_download_url": "http://x/b.tar.gz"},
+            {"name": "installer.pkg", "browser_download_url": "p"},
+        ]},
+        {"tag_name": "v1.2.0", "html_url": "u2", "assets": []},
+    ]
+    info = UpdateChecker._parse_github(releases)
+    assert info["latestVersion"] == "1.3.0"
+    assert info["updateBundle"] == "http://x/b.tar.gz"
+
+
+# ---- bundle fixture server ----
+
+NEXT_VERSION = "99.0.0"
+
+
+def _make_bundle() -> bytes:
+    app_js = b"console.log('new version')\n"
+    version_json = json.dumps({
+        "version": NEXT_VERSION,
+        "checksums": {
+            "app.js": hashlib.sha256(app_js).hexdigest(),
+        },
+    }).encode()
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for name, data in (("version.json", version_json),
+                           ("app.js", app_js)):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+@pytest.fixture
+def update_source(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "data"))
+    bundle = _make_bundle()
+    corrupt = {"on": False}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/release.json":
+                body = json.dumps({
+                    "version": NEXT_VERSION,
+                    "updateBundleUrl":
+                        f"http://127.0.0.1:{srv.server_address[1]}"
+                        "/bundle.tar.gz",
+                    "releaseUrl": "http://example/release",
+                }).encode()
+                self.send_response(200)
+            elif self.path == "/bundle.tar.gz":
+                body = bundle
+                if corrupt["on"]:
+                    # flip payload bytes so a checksum must fail
+                    raw = bytearray(_make_bundle_with(
+                        b"console.log('evil')\n"
+                    ))
+                    body = bytes(raw)
+                self.send_response(200)
+            else:
+                self.send_response(404)
+                body = b"{}"
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    def _make_bundle_with(app_js: bytes) -> bytes:
+        version_json = json.dumps({
+            "version": NEXT_VERSION,
+            "checksums": {
+                "app.js": hashlib.sha256(b"different").hexdigest(),
+            },
+        }).encode()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            for name, data in (("version.json", version_json),
+                               ("app.js", app_js)):
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        return buf.getvalue()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/release.json"
+    monkeypatch.setenv("ROOM_TPU_UPDATE_SOURCE_URL", url)
+    yield {"corrupt": corrupt, "port": srv.server_address[1]}
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_check_download_stage_promote(update_source):
+    ready_events = []
+    checker = UpdateChecker(on_ready_update=ready_events.append)
+    checker.force_check()
+    assert checker.cached["latestVersion"] == NEXT_VERSION
+    assert checker.diagnostics["updateSource"] == "cloud"
+    assert checker.auto_status == {
+        "state": "ready", "version": NEXT_VERSION,
+    }
+    assert ready_events == [NEXT_VERSION]
+    assert get_ready_update_version() == NEXT_VERSION
+    # staged content verified and present
+    assert os.path.exists(
+        os.path.join(updater.staging_dir(), "app.js")
+    )
+    # second check is a no-op re-stage (already ready)
+    checker.force_check()
+    assert ready_events == [NEXT_VERSION]
+
+    version = promote_staged_update()
+    assert version == NEXT_VERSION
+    assert os.path.exists(os.path.join(updater.app_dir(), "app.js"))
+    assert get_ready_update_version() is None  # staging gone
+
+
+def test_checksum_mismatch_rejected(update_source):
+    update_source["corrupt"]["on"] = True
+    checker = UpdateChecker()
+    checker.force_check()
+    assert checker.auto_status["state"] == "error"
+    assert "checksum" in checker.auto_status["error"].lower()
+    assert get_ready_update_version() is None
+
+
+def test_backoff_on_failures(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    monkeypatch.setenv(
+        "ROOM_TPU_UPDATE_SOURCE_URL", "http://127.0.0.1:1/x"
+    )
+    checker = UpdateChecker()
+    checker.force_check()   # failure 1: no backoff yet
+    assert checker.diagnostics["consecutiveFailures"] == 1
+    assert checker.diagnostics["nextCheckAt"] is None
+    checker.force_check()   # failure 2: 30s backoff armed
+    assert checker.diagnostics["consecutiveFailures"] == 2
+    assert checker.diagnostics["nextCheckAt"] > time.time()
+    before = checker.diagnostics["consecutiveFailures"]
+    checker.force_check()   # inside backoff: skipped
+    assert checker.diagnostics["consecutiveFailures"] == before
+    checker.force_check(ignore_backoff=True)  # forced through
+    assert checker.diagnostics["consecutiveFailures"] == before + 1
+
+
+# ---- crash rollback ----
+
+def _write_user_app(tmp_path, version):
+    target = updater.app_dir()
+    os.makedirs(target, exist_ok=True)
+    with open(os.path.join(target, "version.json"), "w") as f:
+        json.dump({"version": version}, f)
+    return target
+
+
+def test_boot_health_crash_rollback(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    target = _write_user_app(tmp_path, "99.0.0")
+    # boot 1: arms the marker
+    init_boot_health_check(grace_s=9999)
+    assert os.path.exists(os.path.join(target, ".booting"))
+    # each boot that finds a live marker is a crash; third strike rolls
+    # the user-space update back
+    init_boot_health_check(grace_s=9999)   # crash 1
+    assert os.path.isdir(target)
+    init_boot_health_check(grace_s=9999)   # crash 2
+    assert os.path.isdir(target)
+    init_boot_health_check(grace_s=9999)   # crash 3: rollback
+    assert not os.path.isdir(target)
+
+
+def test_boot_health_clears_marker_after_grace(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    target = _write_user_app(tmp_path, "99.0.0")
+    init_boot_health_check(grace_s=0.1)
+    time.sleep(0.4)
+    assert not os.path.exists(os.path.join(target, ".booting"))
+    assert os.path.isdir(target)
+
+
+def test_boot_health_cleans_stale_update(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    target = _write_user_app(tmp_path, "0.0.1")  # older than current
+    init_boot_health_check()
+    assert not os.path.isdir(target)
+
+
+# ---- restart endpoints ----
+
+def test_restart_endpoints(tmp_path, monkeypatch, update_source):
+    from room_tpu.db import Database
+    from room_tpu.server.http import ApiServer
+    from room_tpu.server.updater import set_restart_hook
+
+    restarted = []
+    set_restart_hook(lambda: restarted.append(True))
+    try:
+        db = Database(":memory:")
+        srv = ApiServer(db)
+        srv.start()
+        try:
+            def post(path):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}{path}",
+                    method="POST", data=b"{}",
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(r, timeout=5) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read() or b"{}")
+
+            # nothing staged yet
+            status, out = post("/api/server/update-restart")
+            assert status == 404
+
+            checker = UpdateChecker()
+            checker.force_check()
+            status, out = post("/api/server/update-restart")
+            assert status == 202 and out["version"] == NEXT_VERSION
+            assert os.path.exists(
+                os.path.join(updater.app_dir(), "app.js")
+            )
+
+            status, out = post("/api/server/restart")
+            assert status == 202 and out["restarting"] is True
+            time.sleep(0.4)
+            assert len(restarted) >= 2
+        finally:
+            srv.stop()
+    finally:
+        set_restart_hook(None)
+
+
+# ---- update status routes ----
+
+def test_update_routes(update_source):
+    from tests.test_server import req
+
+    from room_tpu.db import Database
+    from room_tpu.server.http import ApiServer
+
+    updater.reset_update_checker()
+    db = Database(":memory:")
+    srv = ApiServer(db)
+    srv.start()
+    try:
+        status, out = req(srv, "GET", "/api/update")
+        assert status == 200
+        assert out["data"]["currentVersion"] == __version__
+        status, out = req(srv, "POST", "/api/update/check", {})
+        assert status == 200
+        assert out["data"]["updateInfo"]["latestVersion"] == NEXT_VERSION
+        assert out["data"]["autoUpdate"]["state"] == "ready"
+    finally:
+        srv.stop()
+        updater.reset_update_checker()
+
+
+# ---- MCP auto-registration ----
+
+def test_register_mcp_globally(tmp_path):
+    from room_tpu.mcp.autoregister import register_mcp_globally
+
+    home = tmp_path / "home"
+    (home / ".claude").mkdir(parents=True)
+    (home / ".claude.json").write_text(
+        json.dumps({"mcpServers": {"other": {"command": "x"}}})
+    )
+    (home / ".claude" / "settings.json").write_text(
+        json.dumps({"permissions": {"allow": ["Bash(ls:*)"]}})
+    )
+    (home / ".cursor").mkdir()
+    (home / ".cursor" / "mcp.json").write_text("not json at all")
+    (home / ".codex").mkdir()
+    (home / ".codex" / "config.toml").write_text(
+        "[mcp_servers.room_tpu]\ncommand = 'stale'\n\n"
+        "[mcp_servers.other]\ncommand = 'keep'\n"
+    )
+    # windsurf NOT installed: no config dir
+
+    out = register_mcp_globally("/data/db.sqlite", home=str(home))
+    assert out["claude-code"] is True
+    assert out["claude-code-permissions"] is True
+    assert out["cursor"] is True
+    assert out["codex"] is True
+    assert out["windsurf"] is False  # absent config untouched
+    assert not (home / ".codeium").exists()
+
+    cc = json.loads((home / ".claude.json").read_text())
+    assert "room_tpu" in cc["mcpServers"]
+    assert cc["mcpServers"]["other"] == {"command": "x"}  # preserved
+    assert cc["mcpServers"]["room_tpu"]["env"]["ROOM_TPU_DB_PATH"] == \
+        "/data/db.sqlite"
+
+    perms = json.loads(
+        (home / ".claude" / "settings.json").read_text()
+    )["permissions"]["allow"]
+    assert "mcp__room_tpu__*" in perms and "Bash(ls:*)" in perms
+
+    cursor = json.loads((home / ".cursor" / "mcp.json").read_text())
+    assert "room_tpu" in cursor["mcpServers"]  # invalid JSON rewritten
+
+    toml = (home / ".codex" / "config.toml").read_text()
+    assert "command = 'stale'" not in toml  # old section replaced
+    assert "[mcp_servers.other]" in toml    # others preserved
+    assert "[mcp_servers.room_tpu]" in toml
+
+    # idempotent permissions patch
+    out2 = register_mcp_globally("/data/db.sqlite", home=str(home))
+    assert out2["claude-code-permissions"] is False
+    perms2 = json.loads(
+        (home / ".claude" / "settings.json").read_text()
+    )["permissions"]["allow"]
+    assert perms2.count("mcp__room_tpu__*") == 1

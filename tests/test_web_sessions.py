@@ -1,0 +1,206 @@
+"""Persistent web sessions: cookie continuity, outline snapshots, link
+clicking, form submit, history, registry eviction, and the web_browse
+tool dispatch — against a local stub site (reference behaviors:
+src/shared/web-tools.ts persistent browser sessions)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from room_tpu.core.queen_tools import execute_queen_tool
+from room_tpu.core.web_tools import (
+    get_web_session, open_web_session, reset_web_sessions,
+)
+
+PAGES = {
+    "/": """
+      <html><head><title>Stub Site</title></head><body>
+      <h1>Welcome</h1>
+      <a href="/about">About us</a>
+      <a href="/login">Log in</a>
+      <h2>News</h2>
+      <p>Nothing happened today.</p>
+      <script>ignored()</script>
+      </body></html>""",
+    "/about": """
+      <html><head><title>About</title></head><body>
+      <h1>About</h1><p>We are a stub.</p>
+      <a href="/">Home</a>
+      </body></html>""",
+    "/login": """
+      <html><head><title>Login</title></head><body>
+      <form action="/do-login" method="post">
+        <input type="hidden" name="csrf" value="tok123">
+        <input type="text" name="user" placeholder="username">
+        <input type="password" name="pass">
+        <button type="submit">Sign in</button>
+      </form>
+      <form action="/search" method="get">
+        <input type="text" name="q">
+      </form>
+      </body></html>""",
+}
+
+
+class _Site(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, body: str, cookie: str | None = None):
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(data)))
+        if cookie:
+            self.send_header("Set-Cookie", cookie)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        if parsed.path == "/search":
+            q = parse_qs(parsed.query).get("q", [""])[0]
+            self._send(f"<html><body><h1>Results for {q}</h1>"
+                       "</body></html>")
+        elif parsed.path == "/private":
+            cookies = self.headers.get("Cookie", "")
+            if "auth=yes" in cookies:
+                self._send("<html><body><h1>Secret page</h1>"
+                           "</body></html>")
+            else:
+                self._send("<html><body><h1>Please log in</h1>"
+                           "</body></html>")
+        elif parsed.path in PAGES:
+            self._send(PAGES[parsed.path])
+        else:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = parse_qs(self.rfile.read(length).decode())
+        if self.path == "/do-login":
+            assert body.get("csrf") == ["tok123"]  # hidden field kept
+            user = body.get("user", [""])[0]
+            self._send(
+                f"<html><body><h1>Hello {user}</h1>"
+                '<a href="/private">private area</a></body></html>',
+                cookie="auth=yes",
+            )
+        else:
+            self._send("<html><body>posted</body></html>")
+
+
+@pytest.fixture(scope="module")
+def site():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Site)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture(autouse=True)
+def clean_sessions():
+    reset_web_sessions()
+    yield
+    reset_web_sessions()
+
+
+def test_snapshot_outline_links_forms(site):
+    sess = open_web_session()
+    snap = sess.goto(site + "/")
+    assert snap["title"] == "Stub Site"
+    assert "# Welcome" in snap["outline"]
+    assert "## News" in snap["outline"]
+    assert [l["text"] for l in snap["links"]] == ["About us", "Log in"]
+
+    snap = sess.goto(site + "/login")
+    assert snap["forms"][0]["method"] == "post"
+    names = [f["name"] for f in snap["forms"][0]["fields"]]
+    assert names == ["user", "pass"]  # hidden csrf not shown
+    assert snap["buttons"] == ["Sign in"]
+
+
+def test_click_and_back(site):
+    sess = open_web_session()
+    sess.goto(site + "/")
+    snap = sess.click(0)
+    assert snap["title"] == "About"
+    assert sess.url.endswith("/about")
+    snap = sess.back()
+    assert snap["title"] == "Stub Site"
+    out = sess.click(99)
+    assert "out of range" in out["error"]
+
+
+def test_form_login_sets_cookie_and_persists(site):
+    """The whole point of sessions: the login cookie carries into the
+    next navigation."""
+    sess = open_web_session()
+    sess.goto(site + "/login")
+    snap = sess.submit_form(0, {"user": "keeper", "pass": "pw"})
+    assert "# Hello keeper" in snap["outline"]
+    snap = sess.goto(site + "/private")
+    assert "# Secret page" in snap["outline"]
+    # a FRESH session has no cookie
+    other = open_web_session()
+    snap = other.goto(site + "/private")
+    assert "# Please log in" in snap["outline"]
+
+
+def test_get_form_builds_query(site):
+    sess = open_web_session()
+    sess.goto(site + "/login")
+    snap = sess.submit_form(1, {"q": "tpu kernels"})
+    assert "# Results for tpu kernels" in snap["outline"]
+
+
+def test_text_find(site):
+    sess = open_web_session()
+    sess.goto(site + "/")
+    assert "Nothing happened" in sess.text()
+    assert "Nothing happened today." == sess.text(find="nothing")
+    assert "not found" in sess.text(find="absent-string")
+
+
+def test_registry_eviction():
+    from room_tpu.core import web_tools
+
+    sessions = [open_web_session() for _ in range(web_tools.MAX_SESSIONS)]
+    sessions[0].last_used -= 10  # oldest
+    extra = open_web_session()
+    assert get_web_session(sessions[0].id) is None  # evicted
+    assert get_web_session(extra.id) is extra
+
+
+def test_web_browse_tool_dispatch(site):
+    out = json.loads(execute_queen_tool(
+        None, None, None, "web_browse",
+        {"action": "open", "url": site + "/"},
+    ))
+    sid = out["session_id"]
+    assert out["title"] == "Stub Site"
+    out = json.loads(execute_queen_tool(
+        None, None, None, "web_browse",
+        {"action": "click", "session_id": sid, "index": 0},
+    ))
+    assert out["title"] == "About"
+    text = execute_queen_tool(
+        None, None, None, "web_browse",
+        {"action": "text", "session_id": sid},
+    )
+    assert "We are a stub." in text
+    assert execute_queen_tool(
+        None, None, None, "web_browse",
+        {"action": "close", "session_id": sid},
+    ) == "session closed"
+    assert "unknown web session" in execute_queen_tool(
+        None, None, None, "web_browse",
+        {"action": "click", "session_id": sid, "index": 0},
+    )
