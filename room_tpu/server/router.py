@@ -33,7 +33,9 @@ def err(error: str, status: int = 400) -> dict:
 
 class Router:
     def __init__(self) -> None:
-        self._routes: list[tuple[str, re.Pattern, list[str], Handler]] = []
+        self._routes: list[
+            tuple[str, re.Pattern, list[str], Handler, str]
+        ] = []
 
     def add(self, method: str, pattern: str, handler: Handler) -> None:
         names: list[str] = []
@@ -44,8 +46,14 @@ class Router:
 
         regex = re.sub(r":(\w+)", sub, pattern)
         self._routes.append(
-            (method.upper(), re.compile(f"^{regex}$"), names, handler)
+            (method.upper(), re.compile(f"^{regex}$"), names, handler,
+             pattern)
         )
+
+    def routes(self) -> list[tuple[str, str, Handler]]:
+        """(method, pattern, handler) triples — the coverage surface."""
+        return [(m, pattern, handler)
+                for m, _rx, _n, handler, pattern in self._routes]
 
     def get(self, pattern: str, handler: Handler) -> None:
         self.add("GET", pattern, handler)
@@ -62,7 +70,7 @@ class Router:
     def match(
         self, method: str, path: str
     ) -> Optional[tuple[Handler, dict[str, str]]]:
-        for m, regex, names, handler in self._routes:
+        for m, regex, names, handler, _pattern in self._routes:
             if m != method.upper():
                 continue
             match = regex.match(path)

