@@ -1,0 +1,239 @@
+"""Clerk tool dispatch, commentary engine behaviors, notification
+delivery paths, and cloud-sync token/heartbeat handling — the
+subsystems VERDICT r1 flagged as test-thin (shared 15 cases in
+test_aux)."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from room_tpu.core import escalations, quorum, rooms, task_runner
+from room_tpu.core.clerk import execute_clerk_tool
+
+
+# ---- clerk tools ----
+
+def test_clerk_list_rooms_and_status(db):
+    rooms.create_room(db, "alpha", worker_model="echo")
+    out = execute_clerk_tool(db, "list_rooms", {}, None)
+    data = json.loads(out)
+    assert data[0]["name"] == "alpha"
+    out = execute_clerk_tool(db, "room_status", {"room_id": 1}, None)
+    assert "alpha" in out
+
+
+def test_clerk_create_room_and_task(db):
+    out = execute_clerk_tool(
+        db, "create_room",
+        {"name": "made-by-clerk", "goal": "help"}, None,
+    )
+    assert "created" in out.lower() or "room" in out.lower()
+    assert rooms.get_room(db, 1)["name"] == "made-by-clerk"
+
+    out = execute_clerk_tool(
+        db, "create_task",
+        {"name": "tidy", "prompt": "clean up",
+         "cron_expression": "0 8 * * *"}, None,
+    )
+    task = db.query_one("SELECT * FROM tasks WHERE name='tidy'")
+    assert task is not None and task["cron_expression"] == "0 8 * * *"
+
+
+def test_clerk_reminder_is_a_once_task(db):
+    out = execute_clerk_tool(
+        db, "create_reminder",
+        {"text": "call the accountant",
+         "at": "2099-01-01T09:00:00Z"}, None,
+    )
+    assert out
+    task = db.query_one(
+        "SELECT * FROM tasks ORDER BY id DESC LIMIT 1"
+    )
+    assert task is not None
+    assert "accountant" in (task.get("prompt") or task["name"])
+    assert task["trigger_type"] == "once"
+
+
+def test_clerk_answer_escalation(db):
+    rooms.create_room(db, "a", worker_model="echo")
+    eid = escalations.create_escalation(db, 1, "budget?")
+    out = execute_clerk_tool(
+        db, "answer_escalation",
+        {"escalation_id": eid, "answer": "500"}, None,
+    )
+    row = db.query_one(
+        "SELECT status, answer FROM escalations WHERE id=?", (eid,)
+    )
+    assert row["status"] == "answered" and row["answer"] == "500"
+
+
+def test_clerk_keeper_vote(db):
+    rooms.create_room(db, "a", worker_model="echo")
+    quorum.announce(db, 1, None, "buy a domain",
+                    decision_type="high_impact")
+    decision = db.query_one(
+        "SELECT id FROM quorum_decisions WHERE proposal='buy a domain'"
+    )
+    out = execute_clerk_tool(
+        db, "keeper_vote",
+        {"decision_id": decision["id"], "vote": "no"}, None,
+    )
+    row = db.query_one(
+        "SELECT status FROM quorum_decisions WHERE id=?",
+        (decision["id"],),
+    )
+    assert row["status"] == "objected"
+
+
+def test_clerk_run_task_now_requires_runtime(db):
+    task_runner.create_task(db, "t", "p", trigger_type="manual")
+    out = execute_clerk_tool(db, "run_task_now", {"task_id": 1}, None)
+    assert "runtime" in out.lower() or "not running" in out.lower()
+
+
+def test_clerk_unknown_tool(db):
+    out = execute_clerk_tool(db, "juggle", {}, None)
+    assert "unknown" in out.lower()
+
+
+def test_clerk_tool_error_is_contained(db):
+    out = execute_clerk_tool(
+        db, "room_status", {"room_id": "NaN"}, None
+    )
+    assert "error" in out.lower() or "not found" in out.lower()
+
+
+# ---- notifications ----
+
+def test_digest_delivers_to_verified_email(db, tmp_path, monkeypatch):
+    from room_tpu.server.contacts import (
+        issue_email_verification, verify_email_code,
+    )
+    from room_tpu.server.notifications import relay_pending
+
+    monkeypatch.setenv("ROOM_TPU_EMAIL_OUTBOX", str(tmp_path / "box"))
+    rooms.create_room(db, "a", worker_model="echo")
+    escalations.create_escalation(db, 1, "urgent: need funds")
+
+    # no verified email yet: digest lands only in clerk messages
+    digest = relay_pending(db)
+    assert digest and "need funds" in digest
+    assert not list((tmp_path / "box").glob("*")) if \
+        (tmp_path / "box").exists() else True
+
+    issue_email_verification(db, "keeper@example.com")
+    import re
+
+    mail = json.loads(
+        sorted((tmp_path / "box").iterdir())[-1].read_text()
+    )
+    code = re.search(r"\b(\d{6})\b", mail["body"]).group(1)
+    verify_email_code(db, code)
+
+    escalations.create_escalation(db, 1, "second question")
+    digest = relay_pending(db)
+    assert digest and "second question" in digest
+    mails = [json.loads(p.read_text())
+             for p in sorted((tmp_path / "box").iterdir())]
+    assert any("Keeper digest" == m["subject"] for m in mails)
+
+
+def test_digest_cursor_prevents_resend(db, tmp_path, monkeypatch):
+    from room_tpu.server.notifications import relay_pending
+
+    monkeypatch.setenv("ROOM_TPU_EMAIL_OUTBOX", str(tmp_path / "box"))
+    rooms.create_room(db, "a", worker_model="echo")
+    escalations.create_escalation(db, 1, "only once")
+    assert "only once" in relay_pending(db)
+    assert relay_pending(db) is None  # nothing new
+
+
+# ---- cloud sync ----
+
+@pytest.fixture
+def cloud_stub(monkeypatch, tmp_path):
+    calls = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            calls.append((self.path, body,
+                          self.headers.get("Authorization")))
+            if self.path.endswith("/rooms/register"):
+                out = {"token": "room-token-1"}
+            else:
+                out = {"ok": True, "messages": []}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    monkeypatch.setenv(
+        "ROOM_TPU_CLOUD_API",
+        f"http://127.0.0.1:{srv.server_address[1]}",
+    )
+    yield calls
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_cloud_token_registration_and_persistence(db, cloud_stub,
+                                                  tmp_path):
+    from room_tpu.server.cloud_sync import ensure_cloud_room_token
+
+    rooms.create_room(db, "a", worker_model="echo")
+    token = ensure_cloud_room_token(db, 1)
+    assert token == "room-token-1"
+    assert any("/rooms/register" in path for path, _b, _a in cloud_stub)
+    # persisted on disk with owner-only mode
+    tok_file = [p for p in tmp_path.rglob("*") if "token" in p.name]
+    assert tok_file, "tokens not persisted"
+    assert oct(tok_file[0].stat().st_mode & 0o777) == "0o600"
+    # second call reuses the stored token (no extra register)
+    n = len(cloud_stub)
+    assert ensure_cloud_room_token(db, 1) == "room-token-1"
+    assert len(cloud_stub) == n
+
+
+def test_cloud_heartbeat(db, cloud_stub):
+    from room_tpu.server.cloud_sync import (
+        ensure_cloud_room_token, send_heartbeat,
+    )
+
+    rooms.create_room(db, "a", worker_model="echo")
+    ensure_cloud_room_token(db, 1)
+    assert send_heartbeat(db, 1) is True
+    assert any("heartbeat" in path for path, _b, _a in cloud_stub)
+
+
+def test_cloud_sync_silent_when_unconfigured(db, monkeypatch):
+    from room_tpu.server.cloud_sync import (
+        ensure_cloud_room_token, send_heartbeat,
+    )
+
+    monkeypatch.delenv("ROOM_TPU_CLOUD_API", raising=False)
+    rooms.create_room(db, "a", worker_model="echo")
+    assert ensure_cloud_room_token(db, 1) is None
+    assert send_heartbeat(db, 1) is False
+
+
+def test_cloud_sync_survives_unreachable_api(db, monkeypatch,
+                                             tmp_path):
+    from room_tpu.server.cloud_sync import ensure_cloud_room_token
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    monkeypatch.setenv("ROOM_TPU_CLOUD_API", "http://127.0.0.1:1")
+    rooms.create_room(db, "a", worker_model="echo")
+    assert ensure_cloud_room_token(db, 1) is None  # silent failure

@@ -1,0 +1,131 @@
+"""Core utility subsystems: rate-limit detection/parsing, secret
+envelope crypto, activity feed, cycle log buffer, eth primitives reuse
+(density push)."""
+
+import threading
+import time
+
+import pytest
+
+from room_tpu.core import activity, rate_limit, secrets
+from room_tpu.core.cycle_logs import CycleLogBuffer, get_cycle_logs
+from room_tpu.core import rooms
+
+
+# ---- rate limit ----
+
+@pytest.mark.parametrize("text,expect_hit", [
+    ("Error: rate limit exceeded, retry later", True),
+    ("429 Too Many Requests", True),
+    ("quota exceeded for this minute", True),
+    ("everything is fine", False),
+    ("the word ratel is an animal", False),
+])
+def test_detect_rate_limit(text, expect_hit):
+    hit = rate_limit.detect_rate_limit(text)
+    assert (hit is not None) == expect_hit
+
+
+def test_parse_reset_wait_formats():
+    assert rate_limit.parse_reset_wait("retry after 90 seconds") == 90
+    assert rate_limit.parse_reset_wait("try again in 2 minutes") == 120
+    assert rate_limit.parse_reset_wait("back in 1 hour") == 3600
+    # unitless / missing hints fall back to the default wait
+    assert rate_limit.parse_reset_wait("retry-after: 120") == \
+        rate_limit.parse_reset_wait("rate limited") > 0
+
+
+def test_clamp_wait_bounds():
+    assert rate_limit.clamp_wait(0.001) >= 1
+    assert rate_limit.clamp_wait(10**9) <= 3600 * 6
+
+
+def test_abortable_sleep_wakes_on_event():
+    stop = threading.Event()
+    t0 = time.monotonic()
+    threading.Timer(0.1, stop.set).start()
+    rate_limit.abortable_sleep(30, stop)
+    assert time.monotonic() - t0 < 5
+
+
+# ---- secrets ----
+
+def test_secret_roundtrip_and_envelope(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    env = secrets.encrypt_secret("hunter2", context="cred:1")
+    assert env.startswith("enc:v1:")
+    assert secrets.is_encrypted(env)
+    assert not secrets.is_encrypted("hunter2")
+    assert secrets.decrypt_secret(env, context="cred:1") == "hunter2"
+
+
+def test_secret_context_binding(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    env = secrets.encrypt_secret("s", context="wallet:1")
+    with pytest.raises(Exception):
+        secrets.decrypt_secret(env, context="wallet:2")
+
+
+def test_secret_ciphertext_is_nondeterministic(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    a = secrets.encrypt_secret("same", context="c")
+    b = secrets.encrypt_secret("same", context="c")
+    assert a != b  # fresh nonce per envelope
+
+
+# ---- activity feed ----
+
+def test_activity_log_and_feed(db):
+    rooms.create_room(db, "pub", worker_model="echo")
+    db.execute("UPDATE rooms SET visibility='public' WHERE id=1")
+    activity.log_room_activity(db, 1, "milestone", "shipped v1")
+    rows = activity.recent_activity(db, 1)
+    assert any("shipped v1" in (r.get("detail") or "")
+               or "shipped v1" in str(r) for r in rows)
+    feed = activity.get_public_feed(db)
+    assert feed and any("shipped v1" in str(f) for f in feed)
+
+
+def test_private_room_excluded_from_feed(db):
+    rooms.create_room(db, "priv", worker_model="echo")
+    activity.log_room_activity(db, 1, "milestone", "secret stuff")
+    assert all("secret stuff" not in str(f)
+               for f in activity.get_public_feed(db))
+
+
+# ---- cycle logs ----
+
+def test_cycle_log_buffer_flush_and_read(db):
+    rooms.create_room(db, "r", worker_model="echo")
+    cycle_id = db.insert(
+        "INSERT INTO worker_cycles(worker_id, room_id, model) "
+        "VALUES (1, 1, 'echo')"
+    )
+    buf = CycleLogBuffer(db, cycle_id, flush_interval_s=999)
+    buf.append("prompt", "the prompt text")
+    buf.append("response", "the model said things")
+    buf.flush()
+    logs = get_cycle_logs(db, cycle_id)
+    assert [l["entry_type"] for l in logs] == ["prompt", "response"]
+    assert logs[0]["seq"] < logs[1]["seq"]
+
+
+def test_cycle_log_buffer_emits_live_events(db):
+    from room_tpu.core.events import event_bus
+
+    rooms.create_room(db, "r", worker_model="echo")
+    cycle_id = db.insert(
+        "INSERT INTO worker_cycles(worker_id, room_id, model) "
+        "VALUES (1, 1, 'echo')"
+    )
+    seen = []
+    unsub = event_bus.subscribe(
+        f"cycle:{cycle_id}", lambda e: seen.append(e.data)
+    )
+    try:
+        buf = CycleLogBuffer(db, cycle_id, flush_interval_s=999)
+        buf.append("tool_call", "ls -la")
+        assert seen and seen[0]["entry_type"] == "tool_call"
+    finally:
+        if callable(unsub):
+            unsub()

@@ -1,0 +1,229 @@
+"""Serving-engine edge behaviors + profiling subsystem: parking/resume
+token identity, eviction interplay with parked tool-call sessions,
+stats surface, StepTimer/HttpProfiler (density push, VERDICT r1 #9)."""
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_engine(cfg, params, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("n_pages", 32)
+    return ServingEngine(cfg, params, **kw)
+
+
+def test_parked_session_resume_is_token_identical(setup):
+    """Park via tool-call stop, resume with the tool response: the
+    generated continuation must equal a run where the whole context was
+    prefilled fresh (KV-resident resume is exact, not approximate)."""
+    cfg, params = setup
+    tok_end = None
+    eng = make_engine(cfg, params)
+    tok_end = eng.tokenizer.encode("</tool_call>")[0]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+
+    # drive a session to a parked state by feeding the tool-end token
+    # through the prompt (engine parks on the sampled token, so instead
+    # emulate: turn 1 normally, then resume turn with extra tokens)
+    t1 = eng.submit([5, 6, 7], session_id="park", sampling=sp)
+    eng.run_until_idle()
+    resume_prompt = [9, 9, tok_end, 8]
+    t2 = eng.submit(resume_prompt, session_id="park", sampling=sp)
+    eng.run_until_idle()
+    assert t2.finish_reason in ("stop", "length", "tool_call")
+
+    # fresh engine, one flat prefill of the full equivalent context
+    eng2 = make_engine(cfg, params)
+    full = ([5, 6, 7] + t1.new_tokens[:-1] + [t1.new_tokens[-1]]
+            + resume_prompt)
+    t3 = eng2.submit(full, session_id="flat", sampling=sp)
+    eng2.run_until_idle()
+    assert t2.new_tokens == t3.new_tokens
+
+
+def test_eviction_prefers_idle_over_parked_recency(setup):
+    """LRU considers last_used: the most recently parked session
+    survives longer than one idle for ages."""
+    cfg, params = setup
+    eng = make_engine(cfg, params, max_batch=1, n_pages=9)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=3)
+    eng.submit([1, 2], session_id="old", sampling=sp)
+    eng.run_until_idle()
+    eng.submit([3, 4], session_id="new", sampling=sp)
+    eng.run_until_idle()
+    eng.sessions["old"].last_used -= 1000
+    # a third session forces one eviction: "old" must be the victim
+    eng.submit([5, 6], session_id="third", sampling=sp)
+    eng.run_until_idle()
+    assert eng.stats()["evictions"] >= 1
+    assert eng.sessions["old"].length == 0      # evicted
+    assert eng.sessions["new"].length > 0       # survived
+
+
+def test_stats_surface(setup):
+    cfg, params = setup
+    eng = make_engine(cfg, params)
+    st = eng.stats()
+    for key in ("tokens_decoded", "turns_completed", "prefill_tokens",
+                "decode_steps", "evictions", "queued", "active_slots",
+                "phases"):
+        assert key in st
+    eng.submit([1], sampling=SamplingParams(temperature=0.0,
+                                            max_new_tokens=2))
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["turns_completed"] == 1
+    assert st["tokens_decoded"] >= 1
+    assert st["phases"]  # StepTimer recorded prefill/decode
+
+
+def test_max_new_tokens_zero_finishes_immediately(setup):
+    cfg, params = setup
+    eng = make_engine(cfg, params)
+    t = eng.submit([1, 2], sampling=SamplingParams(max_new_tokens=0))
+    eng.run_until_idle()
+    assert t.finish_reason == "length" and t.new_tokens == []
+
+
+def test_on_token_callback_streams(setup):
+    cfg, params = setup
+    eng = make_engine(cfg, params)
+    seen = []
+    t = eng.submit(
+        [1, 2, 3],
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=4),
+        on_token=seen.append,
+    )
+    eng.run_until_idle()
+    assert seen == t.new_tokens
+
+
+def test_on_token_exception_does_not_kill_turn(setup):
+    cfg, params = setup
+    eng = make_engine(cfg, params)
+
+    def boom(tok):
+        raise RuntimeError("subscriber bug")
+
+    t = eng.submit(
+        [1, 2], sampling=SamplingParams(temperature=0.0,
+                                        max_new_tokens=3),
+        on_token=boom,
+    )
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length")
+
+
+# ---- profiling ----
+
+def test_step_timer_phases():
+    from room_tpu.utils.profiling import StepTimer
+
+    t = StepTimer()
+    with t.phase("prefill"):
+        pass
+    with t.phase("prefill"):
+        pass
+    with t.phase("decode"):
+        pass
+    snap = t.snapshot()
+    assert snap["prefill"]["count"] == 2
+    assert snap["decode"]["count"] == 1
+    assert snap["prefill"]["total_s"] >= 0
+
+
+def test_http_profiler_aggregates():
+    from room_tpu.utils.profiling import HttpProfiler
+
+    p = HttpProfiler()
+    p.record("GET", "/api/rooms", 12.0)
+    p.record("GET", "/api/rooms", 8.0)
+    p.record("POST", "/api/tasks", 5.0)
+    snap = p.snapshot()
+    rooms_key = [k for k in snap if "rooms" in k][0]
+    assert snap[rooms_key]["count"] == 2
+    assert snap[rooms_key]["mean_ms"] == pytest.approx(10.0)
+    assert snap[rooms_key]["p95_ms"] in (8.0, 12.0)
+
+
+def test_http_profiling_endpoint(tmp_path, monkeypatch):
+    from tests.test_server import req
+
+    from room_tpu.db import Database
+    from room_tpu.server.http import ApiServer
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    monkeypatch.setenv("ROOM_TPU_PROFILE_HTTP", "1")
+    db = Database(":memory:")
+    srv = ApiServer(db)
+    srv.start()
+    try:
+        req(srv, "GET", "/api/rooms")
+        status, out = req(srv, "GET", "/api/profiling/http")
+        assert status == 200
+        assert any("rooms" in k for k in out["data"])
+    finally:
+        srv.stop()
+
+
+# ---- small accounting/spec edges ----
+
+def test_page_table_capacity_accounting():
+    from room_tpu.serving import PageTable
+
+    pt = PageTable(n_pages=8, page_size=4)
+    pt.ensure_capacity("s", 9)  # 3 pages
+    assert pt.tokens_capacity("s") == 12
+    assert pt.pages_of("s") != pt.pages_of("missing") == []
+
+
+def test_make_mesh_rejects_oversized_spec():
+    from room_tpu.parallel import MeshSpec, make_mesh
+
+    spec = MeshSpec(dp=64, ep=64, tp=64)
+    assert spec.n_devices == 64 ** 3
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh(spec)
+
+
+def test_page_cache_specs_tp_fallback():
+    """KV-head axis shards over tp only when divisible; otherwise the
+    heads stay replicated rather than erroring."""
+    import jax
+    from jax.sharding import Mesh
+    from room_tpu.models.config import tiny_moe as tiny_cfg
+    from room_tpu.parallel import page_cache_specs
+
+    cfg = tiny_cfg()  # 2 kv heads
+    devs = np.array(jax.devices()[:8])
+    mesh2 = Mesh(devs.reshape(4, 2), ("dp", "tp"))   # tp=2 divides
+    spec = page_cache_specs(cfg, mesh2)
+    assert spec["k_pages"][3] == "tp"
+    mesh8 = Mesh(devs.reshape(1, 8), ("dp", "tp"))   # tp=8 doesn't
+    spec = page_cache_specs(cfg, mesh8)
+    assert spec["k_pages"][3] is None
+
+
+def test_sampling_params_defaults():
+    assert SamplingParams().top_k == 0          # full vocab
+    assert SamplingParams().top_p == 1.0        # off
+    assert SamplingParams().max_new_tokens > 0
+
+
+def test_release_unknown_session_is_noop(setup):
+    cfg, params = setup
+    eng = make_engine(cfg, params)
+    eng.release_session("never-existed")  # must not raise
+    assert eng.stats()["turns_completed"] == 0
