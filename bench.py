@@ -25,7 +25,8 @@ TINY = os.environ.get("ROOM_TPU_BENCH_TINY") == "1"  # CPU smoke mode
 _result_printed = threading.Event()
 
 
-def _emit(value: float, unit: str, note: str = "") -> None:
+def _emit(value: float, unit: str, note: str = "",
+          extra: dict | None = None) -> None:
     if _result_printed.is_set():
         return
     _result_printed.set()
@@ -37,7 +38,27 @@ def _emit(value: float, unit: str, note: str = "") -> None:
     }
     if note:
         line["note"] = note
+    if extra:
+        line.update(extra)
     print(json.dumps(line), flush=True)
+
+
+def decode_flops_per_token(cfg, mean_ctx: float) -> float:
+    """Forward FLOPs per decoded token: 2*active-params matmuls +
+    attention reads over the mean context."""
+    d, dh = cfg.hidden, cfg.head_dim
+    hq, hkv = cfg.n_heads, cfg.n_kv_heads
+    attn_w = d * hq * dh + 2 * d * hkv * dh + hq * dh * d
+    if cfg.is_moe:
+        ffn_w = cfg.top_k * 3 * d * cfg.moe_intermediate
+        ffn_w += d * cfg.n_experts  # router
+    else:
+        ffn_w = 3 * d * cfg.intermediate
+    per_layer = 2 * (attn_w + ffn_w)
+    # attention score+value reads against the KV cache
+    per_layer += 2 * 2 * mean_ctx * hq * dh
+    head = 2 * d * cfg.vocab_size
+    return cfg.n_layers * per_layer + head
 
 
 def _watchdog() -> None:
@@ -111,43 +132,74 @@ def main() -> None:
             )
 
     max_batch = 4 if TINY else 8
-    eng = ServingEngine(
-        cfg, params, max_batch=max_batch, page_size=32, n_pages=1024
-    )
-
-    gen_tokens = 16 if TINY else 64
-    sp = SamplingParams(
-        temperature=0.7, top_p=0.95, max_new_tokens=gen_tokens
-    )
     prompt = list(range(1, 33))
+    gen_timed = 32 if TINY else 256
 
-    # warmup: compile prefill + decode
-    warm = [eng.submit(prompt, sampling=sp) for _ in range(max_batch)]
-    eng.run_until_idle()
-    for t in warm:
-        eng.release_session(t.session_id)
-
-    # timed: keep all slots busy; count decoded tokens over the window
-    start_stats = eng.stats()
-    turns = [
-        eng.submit(prompt, sampling=SamplingParams(
+    def measure() -> tuple[float, int, float]:
+        eng = ServingEngine(
+            cfg, params, max_batch=max_batch, page_size=32,
+            n_pages=1024,
+        )
+        sp = SamplingParams(
             temperature=0.7, top_p=0.95,
-            max_new_tokens=32 if TINY else 256,
-        ))
-        for _ in range(max_batch * 2)
-    ]
-    t0 = time.perf_counter()
-    eng.run_until_idle()
-    dt = time.perf_counter() - t0
-    end_stats = eng.stats()
+            max_new_tokens=16 if TINY else 64,
+        )
+        warm = [eng.submit(prompt, sampling=sp)
+                for _ in range(max_batch)]
+        eng.run_until_idle()
+        for t in warm:
+            eng.release_session(t.session_id)
+        start = eng.stats()
+        for _ in range(max_batch * 2):
+            eng.submit(prompt, sampling=SamplingParams(
+                temperature=0.7, top_p=0.95,
+                max_new_tokens=gen_timed,
+            ))
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        decoded = (eng.stats()["tokens_decoded"]
+                   - start["tokens_decoded"])
+        return decoded / dt, decoded, dt
 
-    decoded = end_stats["tokens_decoded"] - start_stats["tokens_decoded"]
-    tok_s = decoded / dt
+    tok_s, decoded, dt = measure()
+
+    # MFU estimate against the chip's peak bf16 matmul throughput
+    # (override ROOM_TPU_PEAK_TFLOPS for the actual TPU generation;
+    # default 197 = v5e bf16)
+    peak_tflops = float(
+        os.environ.get("ROOM_TPU_PEAK_TFLOPS", "197")
+    )
+    mean_ctx = len(prompt) + gen_timed / 2
+    flops_tok = decode_flops_per_token(cfg, mean_ctx)
+    mfu = tok_s * flops_tok / (peak_tflops * 1e12)
+
+    extra = {
+        "mfu": round(mfu, 4),
+        "mfu_peak_tflops_assumed": peak_tflops,
+        "flops_per_token": int(flops_tok),
+    }
+
+    # decode-attention backend comparison (Pallas paged kernel vs the
+    # XLA gather reference) — only meaningful on real TPU hardware
+    if platform == "tpu":
+        compare = {}
+        for backend in ("pallas", "xla"):
+            os.environ["ROOM_TPU_PAGED_KERNEL"] = backend
+            try:
+                b_tok_s, _, _ = measure()
+                compare[backend] = round(b_tok_s, 2)
+            except Exception as e:
+                compare[backend] = f"error: {e}"
+        os.environ.pop("ROOM_TPU_PAGED_KERNEL", None)
+        extra["kernel_tok_s"] = compare
+
     _emit(
         tok_s,
         "tok/s",
         f"{platform}; {cfg.name} bs={max_batch} "
         f"({decoded} tok / {dt:.1f}s)",
+        extra=extra,
     )
 
 
