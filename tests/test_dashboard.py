@@ -176,6 +176,13 @@ def test_every_panel_call_resolves(server):
     memory_mod.remember(db, "ui-fact", "fact")
     skills_mod.create_skill(db, "s", "how-to")
     assert workers_mod  # queen auto-created with the room
+    from room_tpu.core import credentials as credentials_mod
+    from room_tpu.core import watches as watches_mod
+
+    # the extractor substitutes interpolations with "1": store matching
+    # fixtures so parameterized DELETEs resolve
+    credentials_mod.store_credential(db, rid, "1", "v")
+    watches_mod.create_watch(db, "/tmp/ui-watch", "check")
 
     bodies = {
         ("POST", "/api/rooms"): {"name": "x"},
@@ -184,6 +191,13 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/rooms/1/workers"): {"name": "w2"},
         ("POST", "/api/rooms/1/wallet/withdraw"):
             {"to": "0x" + "11" * 20, "amount": "5"},
+        ("POST", "/api/rooms/1/credentials"):
+            {"name": "k2", "value": "v2"},
+        ("PUT", "/api/rooms/1"): {"goal": "edited"},
+        ("POST", "/api/watches"):
+            {"path": "/tmp/ui-watch2", "actionPrompt": "a"},
+        ("POST", "/api/update/check"): {},
+        ("POST", "/api/self-mod/1/revert"): {},
         ("POST", "/api/memory"): {"name": "f2", "content": "f2"},
         ("POST", "/api/skills"): {"name": "s2", "content": "c"},
         ("POST", "/api/escalations/1/answer"): {"answer": "a"},
@@ -208,6 +222,7 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/rooms/1/start"),           # provider not ready
         ("POST", "/api/workers/1/start"),         # provider not ready
         ("POST", "/api/decisions/1/keeper-vote"), # already resolved (409)
+        ("POST", "/api/self-mod/1/revert"),       # no audit entry (409)
         ("POST", "/api/decisions/1/vote"),        # quorum state (409)
         ("POST", "/api/tasks/1/run"),             # no runtime thread (503)
     }

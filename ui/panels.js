@@ -154,11 +154,12 @@ async function instantiateTemplate() {
 async function selectRoom(id) {
   selectedRoom = id;
   loadRoomList();
-  const [st, goals, decisions, chat] = await Promise.all([
+  const [st, goals, decisions, chat, creds] = await Promise.all([
     api("GET", `/api/rooms/${id}/status`),
     api("GET", `/api/rooms/${id}/goals`),
     api("GET", `/api/rooms/${id}/decisions`),
     api("GET", `/api/rooms/${id}/chat`),
+    api("GET", `/api/rooms/${id}/credentials`),
   ]);
   const s = st.data || {};
   const renderGoal = (g, depth) =>
@@ -192,6 +193,30 @@ async function selectRoom(id) {
       <tr><td>${esc(d.proposal)}</td>
       <td><span class="pill">${esc(d.status)}</span></td></tr>`
     ).join("")}</table>
+    <h2 style="margin-top:.8rem">credentials</h2>
+    <table>${(creds.data || []).map(c => `
+      <tr><td><code>${esc(c.name)}</code></td>
+      <td class="dim">${esc(c.type || "other")}</td>
+      <td style="width:4rem"><button class="ghost"
+        onclick="credDelete(${id},'${esc(c.name)}')">remove</button>
+      </td></tr>`).join("") ||
+      '<tr><td class="dim">none stored</td></tr>'}</table>
+    <div class="row">
+      <input id="credName" placeholder="name (e.g. api_key)">
+      <input id="credValue" placeholder="secret value" type="password">
+      <button class="ghost" onclick="credAdd(${id})">store</button>
+    </div>
+    <h2 style="margin-top:.8rem">room config</h2>
+    <div class="row">
+      <select id="roomAutonomy">
+        ${["full", "semi", "manual"].map(m =>
+          `<option value="${m}"${s.room?.autonomy_mode === m
+            ? " selected" : ""}>${m}</option>`).join("")}
+      </select>
+      <input id="roomGoalEdit" placeholder="objective…"
+             value="${esc(s.room?.goal || "")}">
+      <button class="ghost" onclick="roomConfigSave(${id})">save</button>
+    </div>
     <h2 style="margin-top:.8rem">chat with the queen</h2>
     <div class="log" id="roomChat">${(chat.data || []).map(m =>
       `<div><span class="t">${esc(m.role)}</span>${esc(m.content)}</div>`
@@ -221,6 +246,28 @@ async function addGoal(id) {
 
 async function roomAction(id, action) {
   await api("POST", `/api/rooms/${id}/${action}`);
+  selectRoom(id);
+}
+
+async function credAdd(id) {
+  const name = $("credName").value.trim();
+  const value = $("credValue").value;
+  if (!name || !value) return;
+  await api("POST", `/api/rooms/${id}/credentials`, {name, value});
+  selectRoom(id);
+}
+
+async function credDelete(id, name) {
+  await api("DELETE",
+    `/api/rooms/${id}/credentials/${encodeURIComponent(name)}`);
+  selectRoom(id);
+}
+
+async function roomConfigSave(id) {
+  await api("PUT", `/api/rooms/${id}`, {
+    autonomyMode: $("roomAutonomy").value,
+    goal: $("roomGoalEdit").value.trim(),
+  });
   selectRoom(id);
 }
 
@@ -693,6 +740,137 @@ async function tgStart() {
   }
 }
 
+// ---- cycles (live console browser) ----
+
+async function renderCycles(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  el.innerHTML = `<div class="panel"><h2>cycle browser</h2>
+    <div class="row">
+      <select id="cycleRoom" onchange="loadCycles()">
+        ${rooms.map(r =>
+          `<option value="${r.id}">${esc(r.name)}</option>`).join("")}
+      </select>
+      <button class="ghost" onclick="loadCycles()">load</button>
+    </div>
+    <div id="cycleList" style="margin-top:.6rem"></div>
+    <div id="cycleLogs" style="margin-top:.6rem"></div></div>`;
+  if (rooms.length) loadCycles();
+}
+
+async function loadCycles() {
+  const rid = $("cycleRoom").value;
+  if (!rid) return;
+  const out = await api("GET", `/api/rooms/${rid}/cycles`);
+  $("cycleList").innerHTML = `<table>
+    <tr><th>cycle</th><th>worker</th><th>status</th><th>tokens</th>
+    <th>ms</th><th></th></tr>
+    ${(out.data || []).slice(0, 20).map(c => `
+      <tr><td>#${c.id}</td><td>${esc(c.worker_id)}</td>
+      <td><span class="pill ${esc(c.status)}">${esc(c.status)}</span></td>
+      <td>${(c.input_tokens || 0) + (c.output_tokens || 0)}</td>
+      <td>${c.duration_ms ?? ""}</td>
+      <td><button class="ghost" onclick="loadCycleLogs(${c.id})">
+        logs</button></td></tr>`).join("")}</table>`;
+}
+
+async function loadCycleLogs(cid) {
+  const out = await api("GET", `/api/cycles/${cid}/logs`);
+  $("cycleLogs").innerHTML = `<h2>cycle #${cid}</h2>
+    <div class="log" style="max-height:420px">
+      ${(out.data || []).map(l =>
+        `<div><span class="t">${esc(l.entry_type)}</span>` +
+        `${esc(String(l.content).slice(0, 600))}</div>`).join("")}
+    </div>`;
+}
+
+// ---- system (self-mod audit, watches, updates) ----
+
+async function renderSystem(el) {
+  const [audit, watches, update] = await Promise.all([
+    api("GET", "/api/self-mod/audit"),
+    api("GET", "/api/watches"),
+    api("GET", "/api/update"),
+  ]);
+  const u = update.data || {};
+  const auto = u.autoUpdate || {state: "idle"};
+  el.innerHTML = `
+    <div class="panel"><h2>updates</h2>
+      <div class="kv">
+        <span class="k">running</span>
+          <span>v${esc(u.currentVersion)}</span>
+        <span class="k">latest</span>
+          <span>${esc(u.updateInfo?.latestVersion || "unknown")}</span>
+        <span class="k">auto-update</span>
+          <span><span class="pill ${esc(auto.state)}">
+            ${esc(auto.state)}</span>
+            ${auto.version ? esc(auto.version) : ""}</span>
+      </div>
+      <div class="row">
+        <button class="ghost" onclick="updateCheck()">check now</button>
+        ${auto.state === "ready"
+          ? `<button class="act" onclick="updateRestart()">
+              apply v${esc(auto.version)} + restart</button>`
+          : ""}
+        <button class="ghost" onclick="serverRestart()">restart</button>
+      </div></div>
+    <div class="panel"><h2>watched paths</h2>
+      <table>${(watches.data || []).map(w => `
+        <tr><td><code>${esc(w.path)}</code></td>
+        <td>${esc(w.action_prompt || "")}</td>
+        <td style="width:4rem"><button class="ghost"
+          onclick="watchDelete(${w.id})">remove</button></td></tr>`
+      ).join("")}</table>
+      <div class="row">
+        <input id="watchPath" placeholder="~/path/to/watch">
+        <input id="watchPrompt" placeholder="what to do on change…">
+        <button class="ghost" onclick="watchAdd()">watch</button>
+      </div></div>
+    <div class="panel"><h2>self-modification audit</h2>
+      <table>${(audit.data || []).slice(0, 15).map(a => `
+        <tr><td>#${a.id}</td><td><code>${esc(a.file_path)}</code></td>
+        <td>${esc(a.reason || "")}</td>
+        <td><span class="pill">${esc(a.status || "")}</span></td>
+        <td style="width:4rem"><button class="ghost"
+          onclick="selfmodRevert(${a.id})">revert</button></td></tr>`
+      ).join("") ||
+        '<tr><td class="dim">no self-modifications recorded</td></tr>'}
+      </table></div>`;
+}
+
+async function updateCheck() {
+  await api("POST", "/api/update/check", {ignoreBackoff: true});
+  refreshView();
+}
+
+async function updateRestart() {
+  // localhost-only pre-auth endpoint (no bearer token needed)
+  await fetch("/api/server/update-restart", {method: "POST"});
+  toast("applying update and restarting…");
+}
+
+async function serverRestart() {
+  await fetch("/api/server/restart", {method: "POST"});
+  toast("restarting…");
+}
+
+async function watchAdd() {
+  const path = $("watchPath").value.trim();
+  if (!path) return;
+  await api("POST", "/api/watches",
+    {path, actionPrompt: $("watchPrompt").value.trim()});
+  refreshView();
+}
+
+async function watchDelete(id) {
+  await api("DELETE", `/api/watches/${id}`);
+  refreshView();
+}
+
+async function selfmodRevert(id) {
+  await api("POST", `/api/self-mod/${id}/revert`, {});
+  refreshView();
+}
+
 // ---- registry ----
 
 const PANELS = {
@@ -705,6 +883,8 @@ const PANELS = {
   memory: {title: "memory", render: renderMemory},
   skills: {title: "skills", render: renderSkills},
   wallet: {title: "wallet", render: renderWallet},
+  cycles: {title: "cycles", render: renderCycles},
   clerk: {title: "clerk", render: renderClerk},
+  system: {title: "system", render: renderSystem},
   settings: {title: "settings", render: renderSettings},
 };
