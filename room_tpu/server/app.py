@@ -45,8 +45,12 @@ def start_server(
     static_dir: Optional[str] = None,
     install_signal_handlers: bool = False,
 ) -> ServerApp:
+    from .shell_path import inherit_shell_path
     from .updater import get_update_checker, init_boot_health_check
 
+    # GUI launches get a minimal PATH: merge the login shell's before
+    # probing provider CLIs (reference inheritShellPath)
+    inherit_shell_path()
     # crash-rollback check before anything serves (reference
     # initBootHealthCheck), then the background update checker
     init_boot_health_check()

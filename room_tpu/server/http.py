@@ -357,7 +357,22 @@ class ApiServer:
 
         self._handler_cls = Handler
         bind_host = os.environ.get("ROOM_TPU_BIND_HOST", host)
-        self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        # explicit-port conflicts reclaim the port from a stale
+        # instance, kill-and-retry up to 3 times (reference:
+        # index.ts:944-962)
+        for attempt in range(3):
+            try:
+                self._httpd = ThreadingHTTPServer(
+                    (bind_host, port), Handler
+                )
+                break
+            except OSError as e:
+                if port == 0 or attempt == 2 or \
+                        getattr(e, "errno", None) not in (48, 98):
+                    raise
+                from .shell_path import kill_process_listening_on
+
+                kill_process_listening_on(port)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -373,7 +388,10 @@ class ApiServer:
 
     def stop(self) -> None:
         self.ws_hub.stop()
-        self._httpd.shutdown()
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever and deadlocks
+            # if the serve loop never started
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)

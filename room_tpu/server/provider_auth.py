@@ -1,8 +1,9 @@
-"""Provider auth sessions: run `<cli> login` server-side and stream its
-output to the dashboard so the keeper can complete OAuth device flows
-(reference: src/server/provider-auth.ts — session store, line ring
-buffer, verification-URL/device-code extraction, one active session per
-provider, timeout + TTL cleanup).
+"""Provider auth + install sessions: run `<cli> login` or the npm
+install server-side and stream output to the dashboard (reference:
+src/server/provider-auth.ts — session store, line ring buffer,
+verification-URL/device-code extraction, one active session per
+provider, timeout + TTL cleanup; src/server/provider-install.ts — same
+machinery around `npm install -g <package>`).
 """
 
 from __future__ import annotations
@@ -76,14 +77,21 @@ class ProviderAuthManager:
         self._active_by_provider: dict[str, str] = {}
         self._lock = threading.Lock()
 
+    def _command_for(self, provider: str) -> list[str]:
+        path = resolve_cli_path(provider)
+        if not path:
+            raise FileNotFoundError(f"{provider} CLI not installed")
+        return [path, "login"]
+
+    def _label_for(self, provider: str) -> str:
+        return f"{provider} login"
+
     # ---- public API ----
 
     def start(self, provider: str) -> dict:
         if provider not in ("claude", "codex"):
             raise ValueError(f"unknown provider {provider!r}")
-        path = resolve_cli_path(provider)
-        if not path:
-            raise FileNotFoundError(f"{provider} CLI not installed")
+        command = self._command_for(provider)
 
         with self._lock:
             self._cleanup_locked()
@@ -96,11 +104,11 @@ class ProviderAuthManager:
             sess = AuthSession(
                 session_id=uuid.uuid4().hex,
                 provider=provider,
-                command=f"{provider} login",
+                command=self._label_for(provider),
             )
             try:
                 sess._proc = subprocess.Popen(
-                    [path, "login"],
+                    command,
                     stdin=subprocess.DEVNULL,
                     stdout=subprocess.PIPE,
                     stderr=subprocess.PIPE,
@@ -244,7 +252,35 @@ class ProviderAuthManager:
             del self._sessions[sid]
 
 
+INSTALL_PACKAGES = {
+    "claude": "@anthropic-ai/claude-code",
+    "codex": "@openai/codex",
+}
+
+
+class ProviderInstallManager(ProviderAuthManager):
+    """Same session machinery around `npm install -g <package>`
+    (reference: provider-install.ts). ROOM_TPU_NPM overrides the npm
+    binary (the test seam; also how deployments pin a package
+    manager)."""
+
+    def _command_for(self, provider: str) -> list[str]:
+        import shutil
+
+        npm = os.environ.get("ROOM_TPU_NPM") or shutil.which("npm")
+        if not npm:
+            raise FileNotFoundError(
+                "npm not found; install Node.js to install provider "
+                "CLIs"
+            )
+        return [npm, "install", "-g", INSTALL_PACKAGES[provider]]
+
+    def _label_for(self, provider: str) -> str:
+        return f"npm install -g {INSTALL_PACKAGES[provider]}"
+
+
 _manager: Optional[ProviderAuthManager] = None
+_install_manager: Optional[ProviderInstallManager] = None
 _manager_lock = threading.Lock()
 
 
@@ -254,3 +290,11 @@ def get_auth_manager() -> ProviderAuthManager:
         if _manager is None:
             _manager = ProviderAuthManager()
         return _manager
+
+
+def get_install_manager() -> ProviderInstallManager:
+    global _install_manager
+    with _manager_lock:
+        if _install_manager is None:
+            _install_manager = ProviderInstallManager()
+        return _install_manager

@@ -195,11 +195,38 @@ def register_provider_routes(r: Router) -> None:
 
     r.get("/api/update", update_status)
     r.post("/api/update/check", update_check)
+    def install_start(ctx):
+        from .provider_auth import get_install_manager
+
+        provider = ctx.params["provider"]
+        try:
+            return ok(get_install_manager().start(provider), 201)
+        except ValueError as e:
+            return err(str(e))
+        except FileNotFoundError as e:
+            return err(str(e), 409)
+
+    def install_get(ctx):
+        from .provider_auth import get_install_manager
+
+        view = get_install_manager().get(ctx.params["sid"])
+        return ok(view) if view else err("unknown session", 404)
+
+    def install_cancel(ctx):
+        from .provider_auth import get_install_manager
+
+        view = get_install_manager().cancel(ctx.params["sid"])
+        return ok(view) if view else err("unknown session", 404)
+
     r.get("/api/providers", providers_status)
     r.post("/api/providers/:provider/auth/start", auth_start)
     r.get("/api/providers/:provider/auth", auth_get)
     r.get("/api/providers/auth/sessions/:sid", auth_session_get)
     r.post("/api/providers/auth/sessions/:sid/cancel", auth_cancel)
+    r.post("/api/providers/:provider/install/start", install_start)
+    r.get("/api/providers/install/sessions/:sid", install_get)
+    r.post("/api/providers/install/sessions/:sid/cancel",
+           install_cancel)
 
 
 def register_aux_routes(r: Router) -> None:

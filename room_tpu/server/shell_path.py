@@ -1,0 +1,111 @@
+"""Shell PATH inheritance + port reclamation (reference:
+src/server/shell-path.ts inheritShellPath — GUI-launched processes get
+a minimal PATH, so ask the user's login shell for the real one;
+src/server/index.ts killProcessListeningOnPort — on EADDRINUSE, kill
+the stale instance and retry).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from typing import Optional
+
+_PROBE_TIMEOUT_S = 3.0
+
+
+def inherit_shell_path() -> bool:
+    """Merge the login shell's PATH into this process's PATH. Returns
+    True when new entries were added. Never raises."""
+    try:
+        shell = os.environ.get("SHELL")
+        if not shell or os.name == "nt":
+            return False
+        out = subprocess.run(
+            [shell, "-l", "-c", "echo -n \"$PATH\""],
+            capture_output=True, text=True, timeout=_PROBE_TIMEOUT_S,
+        )
+        if out.returncode != 0:
+            return False
+        shell_path = out.stdout.strip()
+        if not shell_path:
+            return False
+        current = os.environ.get("PATH", "").split(os.pathsep)
+        merged = list(current)
+        added = False
+        for entry in shell_path.split(os.pathsep):
+            if entry and entry not in merged:
+                merged.append(entry)
+                added = True
+        if added:
+            os.environ["PATH"] = os.pathsep.join(merged)
+        return added
+    except Exception:
+        return False
+
+
+# ---- port reclamation (linux /proc, no psutil) ----
+
+def _hex_port(port: int) -> str:
+    return f"{port:04X}"
+
+
+def find_pid_listening_on(port: int) -> Optional[int]:
+    """Walk /proc/net/tcp{,6} for a LISTEN socket on the port, then map
+    its inode to a pid via /proc/*/fd."""
+    inodes = set()
+    want = _hex_port(port)
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(table) as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    local, state, inode = parts[1], parts[3], parts[9]
+                    if state == "0A" and local.endswith(f":{want}"):
+                        inodes.add(inode)
+        except OSError:
+            continue
+    if not inodes:
+        return None
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        fd_dir = f"/proc/{pid}/fd"
+        try:
+            for fd in os.listdir(fd_dir):
+                try:
+                    target = os.readlink(os.path.join(fd_dir, fd))
+                except OSError:
+                    continue
+                if target.startswith("socket:[") and \
+                        target[8:-1] in inodes:
+                    return int(pid)
+        except OSError:
+            continue
+    return None
+
+
+def kill_process_listening_on(port: int, grace_s: float = 2.0) -> bool:
+    """SIGTERM (then SIGKILL) whatever holds the port. Returns True if
+    the port was freed."""
+    pid = find_pid_listening_on(port)
+    if pid is None or pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except OSError:
+        return False
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if find_pid_listening_on(port) is None:
+            return True
+        time.sleep(0.1)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError:
+        pass
+    time.sleep(0.2)
+    return find_pid_listening_on(port) is None

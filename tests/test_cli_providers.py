@@ -319,3 +319,114 @@ def test_provider_routes(tmp_path, monkeypatch):
         assert status == 409  # CLI not installed
     finally:
         server.stop()
+
+
+# ---- install sessions ----
+
+MOCK_NPM = r'''
+import sys
+assert sys.argv[1:4] == ["install", "-g", "@anthropic-ai/claude-code"]
+print("added 120 packages in 4s")
+sys.exit(0)
+'''
+
+
+def test_install_session_with_mock_npm(tmp_path, monkeypatch):
+    from room_tpu.server.provider_auth import ProviderInstallManager
+
+    npm = _write_script(tmp_path / "npm.py", MOCK_NPM)
+    monkeypatch.setenv("ROOM_TPU_NPM", npm)
+    mgr = ProviderInstallManager()
+    view = mgr.start("claude")
+    assert "npm install -g @anthropic-ai/claude-code" == view["command"]
+    sid = view["sessionId"]
+    for _ in range(100):
+        view = mgr.get(sid)
+        if view["status"] not in ("starting", "running"):
+            break
+        time.sleep(0.05)
+    assert view["status"] == "completed"
+    assert any("120 packages" in l["text"] for l in view["lines"])
+
+
+def test_install_session_requires_npm(monkeypatch):
+    from room_tpu.server.provider_auth import ProviderInstallManager
+
+    monkeypatch.setenv("ROOM_TPU_NPM", "")
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(FileNotFoundError, match="npm"):
+        ProviderInstallManager().start("codex")
+
+
+# ---- shell path ----
+
+def test_inherit_shell_path(tmp_path, monkeypatch):
+    from room_tpu.server.shell_path import inherit_shell_path
+
+    fake_shell = tmp_path / "shell.sh"
+    fake_shell.write_text(
+        "#!/bin/sh\n"
+        '[ "$1" = "-l" ] || exit 1\n'
+        'printf "/opt/extra/bin:/usr/bin"\n'
+    )
+    fake_shell.chmod(0o755)
+    monkeypatch.setenv("SHELL", str(fake_shell))
+    monkeypatch.setenv("PATH", "/usr/bin:/bin")
+    assert inherit_shell_path() is True
+    assert "/opt/extra/bin" in os.environ["PATH"].split(":")
+    # idempotent: nothing new the second time
+    assert inherit_shell_path() is False
+
+
+def test_inherit_shell_path_broken_shell(monkeypatch):
+    from room_tpu.server.shell_path import inherit_shell_path
+
+    monkeypatch.setenv("SHELL", "/nonexistent/zsh")
+    assert inherit_shell_path() is False
+
+
+# ---- port reclamation ----
+
+def test_port_conflict_kill_retry(tmp_path, monkeypatch):
+    import socket
+    import subprocess
+    import sys
+
+    from room_tpu.db import Database
+    from room_tpu.server.http import ApiServer
+    from room_tpu.server.shell_path import find_pid_listening_on
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    # a sacrificial child occupies a port
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    child = subprocess.Popen(
+        [sys.executable, "-E", "-S", "-c",
+         "import socket,time\n"
+         "s=socket.socket()\n"
+         "s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+         f"s.bind(('127.0.0.1',{port}))\n"
+         "s.listen()\n"
+         "print('up',flush=True)\n"
+         "time.sleep(60)"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert child.stdout.readline().strip() == "up"
+    assert find_pid_listening_on(port) == child.pid
+
+    srv = ApiServer(Database(":memory:"), port=port)
+    srv.start()
+    try:
+        assert srv.port == port  # reclaimed from the stale process
+        import urllib.request
+
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/auth/handshake"
+        )
+        with urllib.request.urlopen(r, timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        srv.stop()
+        child.wait(timeout=10)

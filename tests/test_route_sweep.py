@@ -96,6 +96,9 @@ EXPECTED_NON_2XX = {
     ("GET", "/api/providers/auth/sessions/:sid"),   # unknown sid
     ("POST", "/api/providers/:provider/auth/start"),  # "1" not a provider
     ("POST", "/api/providers/auth/sessions/:sid/cancel"),
+    ("POST", "/api/providers/:provider/install/start"),
+    ("GET", "/api/providers/install/sessions/:sid"),
+    ("POST", "/api/providers/install/sessions/:sid/cancel"),
     ("GET", "/api/tpu/provision/:sid"),        # unknown session
     ("GET", "/api/runs/:id"),                  # no runs seeded
     ("POST", "/api/update/check"),             # may 200 w/ error diag
