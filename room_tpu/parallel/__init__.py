@@ -7,8 +7,16 @@ from .mesh import (
     page_cache_specs,
     shard_pytree,
 )
+from .pipeline import (
+    pipeline_forward,
+    pipeline_spec,
+    shard_params_for_pipeline,
+)
 
 __all__ = [
+    "pipeline_forward",
+    "pipeline_spec",
+    "shard_params_for_pipeline",
     "MeshSpec",
     "decoder_param_specs",
     "encoder_param_specs",

@@ -1,0 +1,170 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pp``
+mesh axis via shard_map + ppermute (SURVEY §2.7 — the one parallelism
+the reference family leaves to multi-process host plumbing; here it is
+an XLA collective schedule over ICI).
+
+Design: the transformer trunk's stacked layer parameters [L, ...] are
+sharded over ``pp`` on the layer axis, so each device owns L/pp
+consecutive layers. Microbatches march through the stages; at each of
+the M + P - 1 schedule steps every device runs its local layer stack on
+its resident microbatch, then activations rotate one stage forward with
+a single `ppermute`. Embedding and the LM head stay outside the trunk
+(replicated compute), so the pipelined forward composes with the same
+qwen3 params pytree used everywhere else.
+
+Bubble fraction is the textbook (P-1)/(M+P-1): choose M >= 4*P for
+training-shaped batches. All shapes static; the schedule is a
+`lax.scan` over step indices, jit/GSPMD-compatible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import qwen3
+from ..models.config import DecoderConfig
+from ..ops import rope_angles
+
+
+def pipeline_spec(n_stages: int, devices=None) -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    if len(devs) < n_stages:
+        raise ValueError(
+            f"pipeline of {n_stages} stages needs {n_stages} devices, "
+            f"have {len(devs)}"
+        )
+    return Mesh(np.array(devs[:n_stages]).reshape(n_stages), ("pp",))
+
+
+def shard_params_for_pipeline(params, cfg: DecoderConfig, mesh: Mesh):
+    """Layer-stacked tensors shard over pp on axis 0; embed/head/norm
+    replicate."""
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp != 0:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by pp={pp}"
+        )
+    layer_spec = jax.tree.map(
+        lambda a: P(*(("pp",) + (None,) * (a.ndim - 1))),
+        params["layers"],
+    )
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+        params["layers"], layer_spec,
+    )
+    return out
+
+
+def pipeline_forward(
+    params,
+    cfg: DecoderConfig,
+    tokens: jax.Array,          # [B, S], B divisible by n_microbatches
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jax.Array:
+    """Full forward with the trunk pipelined over the pp axis. Returns
+    logits [B, S, V]; numerics match qwen3.forward (same params)."""
+    b, s = tokens.shape
+    m = n_microbatches
+    if b % m != 0:
+        raise ValueError(f"batch {b} not divisible by microbatches {m}")
+    pp = mesh.shape["pp"]
+    mb = b // m
+
+    # replicated pre/post compute
+    x = params["embed"][tokens]                       # [B, S, D]
+    positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    xs = x.reshape(m, mb, s, -1)
+    cos_m = cos.reshape(m, mb, *cos.shape[1:])
+    sin_m = sin.reshape(m, mb, *sin.shape[1:])
+
+    def local(layers_local, xs, cos_m, sin_m):
+        """One pipeline stage. layers_local: this stage's [L/pp, ...]
+        slice; xs/cos/sin arrive replicated [M, mb, ...]."""
+        stage = jax.lax.axis_index("pp")
+        pos_q = jnp.broadcast_to(
+            jnp.arange(s)[None], (mb, s)
+        )
+
+        def run_stage(x_mb, cos_mb, sin_mb):
+            def body(carry, lp):
+                y, _ = qwen3._layer(
+                    cfg, qwen3.attention_ref, carry, lp, cos_mb,
+                    sin_mb, None, None, None, pos_q,
+                )
+                return y, None
+
+            out, _ = jax.lax.scan(body, x_mb, layers_local)
+            return out
+
+        n_steps = m + pp - 1
+        zero = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+
+        def step(carry, t):
+            current, outputs = carry
+            # stage 0 ingests microbatch t (or keeps garbage past M —
+            # masked out when collecting)
+            feed_idx = jnp.clip(t, 0, m - 1)
+            current = jnp.where(
+                stage == 0,
+                jnp.where(t < m, xs[feed_idx], zero),
+                current,
+            )
+            cos_mb = cos_m[jnp.clip(t - stage, 0, m - 1)]
+            sin_mb = sin_m[jnp.clip(t - stage, 0, m - 1)]
+            y = run_stage(current, cos_mb, sin_mb)
+            # the LAST stage finishes microbatch t-(pp-1) at step t
+            done_idx = jnp.clip(t - (pp - 1), 0, m - 1)
+            outputs = jnp.where(
+                (stage == pp - 1) & (t >= pp - 1),
+                outputs.at[done_idx].set(y),
+                outputs,
+            )
+            # rotate activations one stage forward
+            current = jax.lax.ppermute(
+                y, "pp",
+                [(i, (i + 1) % pp) for i in range(pp)],
+            )
+            return (current, outputs), None
+
+        # jax 0.9 shard_map: loop carries become axis-varying after the
+        # first step (axis_index/ppermute), so the initial values must
+        # be pcast to varying or scan rejects the carry types
+        init = (
+            jax.lax.pcast(zero, ("pp",), to="varying"),
+            jax.lax.pcast(outputs, ("pp",), to="varying"),
+        )
+        (_, outputs), _ = jax.lax.scan(
+            step, init, jnp.arange(n_steps)
+        )
+        # only the last stage holds real outputs; broadcast them back
+        outputs = jax.lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
+            "pp",
+        )
+        return outputs
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(
+                lambda a: P(*(("pp",) + (None,) * (a.ndim - 1))),
+                params["layers"],
+            ),
+            P(),  # microbatches replicated
+            P(),
+            P(),
+        ),
+        out_specs=P(),
+    )
+    hidden = fn(params["layers"], xs, cos_m, sin_m)   # [M, mb, S, D]
+    hidden = hidden.reshape(b, s, -1)
+    return qwen3._head(params, cfg, hidden)

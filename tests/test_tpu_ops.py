@@ -282,3 +282,82 @@ def test_model_forward_shardmap_matches_ragged():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=5e-3, atol=5e-3
     )
+
+
+# ---- pipeline parallelism ----
+
+def test_pipeline_forward_matches_dense():
+    """GPipe trunk over a 2-stage pp mesh == plain forward (tiny_moe
+    has 2 layers -> 1 per stage), across microbatch counts."""
+    import dataclasses
+
+    from room_tpu.parallel.pipeline import (
+        pipeline_forward, pipeline_spec, shard_params_for_pipeline,
+    )
+
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(7), (8, 5), 0, cfg.vocab_size
+    )
+    want, _ = qwen3.forward(params, cfg, tokens)
+
+    mesh = pipeline_spec(2)
+    sharded = shard_params_for_pipeline(params, cfg, mesh)
+    for m in (1, 2, 4, 8):
+        got = pipeline_forward(
+            sharded, cfg, tokens, mesh=mesh, n_microbatches=m
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-3, atol=5e-3,
+            err_msg=f"microbatches={m}",
+        )
+
+
+def test_pipeline_forward_deeper_model_4_stages():
+    """4 stages x 2 layers each on a deeper config."""
+    import dataclasses
+
+    from room_tpu.models.config import tiny_moe as tiny_cfg
+    from room_tpu.parallel.pipeline import (
+        pipeline_forward, pipeline_spec, shard_params_for_pipeline,
+    )
+
+    cfg = dataclasses.replace(tiny_cfg(), n_layers=8)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(1))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(8), (4, 6), 0, cfg.vocab_size
+    )
+    want, _ = qwen3.forward(params, cfg, tokens)
+
+    mesh = pipeline_spec(4)
+    sharded = shard_params_for_pipeline(params, cfg, mesh)
+    got = pipeline_forward(
+        sharded, cfg, tokens, mesh=mesh, n_microbatches=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=5e-3, atol=5e-3
+    )
+
+
+def test_pipeline_validation():
+    import dataclasses
+
+    from room_tpu.models.config import tiny_moe as tiny_cfg
+    from room_tpu.parallel.pipeline import (
+        pipeline_forward, pipeline_spec, shard_params_for_pipeline,
+    )
+
+    cfg = dataclasses.replace(tiny_cfg(), n_layers=3)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = pipeline_spec(2)
+    with pytest.raises(ValueError, match="divisible"):
+        shard_params_for_pipeline(params, cfg, mesh)
+    cfg2 = tiny_moe()
+    params2 = qwen3.init_params(cfg2, jax.random.PRNGKey(0))
+    sharded = shard_params_for_pipeline(params2, cfg2, mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_forward(
+            sharded, cfg2, jnp.ones((5, 4), jnp.int32), mesh=mesh,
+            n_microbatches=2,
+        )
