@@ -202,6 +202,7 @@ def forward(
     kv_cache: Optional[Params] = None,
     attention_fn: AttentionFn = attention_ref,
     kv_hook: Optional[KvHook] = None,
+    apply_head: bool = True,
 ) -> tuple[jax.Array, Optional[Params]]:
     """Run the decoder. Returns (logits [B, S, V], updated cache or None).
 
@@ -211,6 +212,12 @@ def forward(
     single-token decode are the same code path). With ``kv_hook``, the
     hook owns cache write + attention and ``kv_cache`` is an opaque
     pytree whose leaves lead with the layer axis (scanned).
+
+    ``apply_head=False`` returns the final hidden states [B, S, D]
+    instead of logits — serving prefill samples only each row's last
+    real position, and at a 151k vocab the full [B, S, V] head matmul
+    dominates prefill FLOPs; callers slice then run ``_head`` on
+    [B, 1, D].
     """
     b, s = tokens.shape
     if positions is None:
@@ -230,6 +237,9 @@ def forward(
         x, new_cache = jax.lax.scan(
             body_hook, x, (params["layers"], kv_cache)
         )
+        if not apply_head:
+            return rms_norm(x, params["final_norm"], cfg.rms_eps), \
+                new_cache
         return _head(params, cfg, x), new_cache
 
     kv_mask = None
@@ -276,11 +286,19 @@ def forward(
 
 
 def _head(params: Params, cfg: DecoderConfig, x: jax.Array) -> jax.Array:
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return lm_head(
+        params, cfg, rms_norm(x, params["final_norm"], cfg.rms_eps)
+    )
+
+
+def lm_head(params: Params, cfg: DecoderConfig,
+            normed: jax.Array) -> jax.Array:
+    """Vocabulary projection over ALREADY-final-normed hidden states
+    (what forward(apply_head=False) returns)."""
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
-    return jnp.einsum("bsd,dv->bsv", x, head)
+    return jnp.einsum("bsd,dv->bsv", normed, head)
 
 
 def decode_step(

@@ -214,16 +214,25 @@ class ServingEngine:
             cfg = self.cfg
 
             @partial(jax.jit, donate_argnums=(1,))
-            def prefill(params, cache, tokens, block_table, length):
+            def prefill(params, cache, tokens, block_table, length,
+                        last_idx):
                 hook = make_paged_kv_hook(
                     block_table, length, self.page_size,
                     fresh_prefill=fresh,
                 )
                 positions = length[:, None] + jnp.arange(tokens.shape[1])
-                logits, cache = qwen3.forward(
-                    params, cfg, tokens, positions, cache, kv_hook=hook
+                # only each row's last real position gets sampled; at a
+                # 151k vocab the full [B, bucket, V] head matmul would
+                # dominate prefill FLOPs, so the head runs on [B, 1, D]
+                hidden, cache = qwen3.forward(
+                    params, cfg, tokens, positions, cache,
+                    kv_hook=hook, apply_head=False,
                 )
-                return logits, self._constrain_cache(cache)
+                last_h = jnp.take_along_axis(
+                    hidden, last_idx[:, None, None], axis=1
+                )
+                last_logits = qwen3.lm_head(params, cfg, last_h)[:, 0]
+                return last_logits, self._constrain_cache(cache)
 
             self._jit_cache[key] = prefill
         return self._jit_cache[key]
@@ -502,22 +511,21 @@ class ServingEngine:
 
         prefill = self._prefill_fn(bucket, fresh=fresh)
         with self.timer.phase(f"prefill_{bucket}x{n}"):
-            logits, self.cache = prefill(
-                self.params,
-                self.cache,
-                jnp.asarray(toks),
-                jnp.asarray(tables),
-                jnp.asarray(lengths),
-            )
-            # first generated token per row, from its last real position
+            # first generated token per row comes from its last real
+            # position (the head runs only there, device-side)
             last_idx = jnp.asarray(
                 [len(p["prompt"]) - 1 for p in group]
                 + [0] * (n_pad - n),
                 jnp.int32,
             )
-            last_logits = jnp.take_along_axis(
-                logits, last_idx[:, None, None], axis=1
-            )[:, 0]
+            last_logits, self.cache = prefill(
+                self.params,
+                self.cache,
+                jnp.asarray(toks),
+                jnp.asarray(tables),
+                jnp.asarray(lengths),
+                last_idx,
+            )
             self._key, sub = jax.random.split(self._key)
             temps = [p["turn"].sampling.temperature for p in group]
             top_ps = [p["turn"].sampling.top_p for p in group]
