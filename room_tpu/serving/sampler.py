@@ -44,6 +44,9 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1)
 
 
+SAMPLE_FAST_K = 128
+
+
 def sample_batched(
     logits: jax.Array,        # [B, V]
     key: jax.Array,
@@ -53,36 +56,135 @@ def sample_batched(
 ) -> jax.Array:
     """Per-row sampling knobs as arrays so one compiled decode step serves
     heterogeneous turns in the same batch. top_k is per-row: a row with
-    top_k=0 samples the full vocabulary regardless of its batchmates."""
+    top_k=0 samples the full vocabulary regardless of its batchmates.
+
+    Fast path: LLM next-token distributions are peaked, so the top-p
+    cutoff almost always lies within the top ``SAMPLE_FAST_K`` logits —
+    `lax.top_k` over those replaces the full-vocab sort (151k entries
+    every decode step). A `lax.cond` falls back to the exact full sort
+    whenever any row's top-K prefix doesn't cover its top_p mass (or
+    requests top_k > K), so the result is bit-identical to the sorted
+    reference in all cases (`_sample_batched_sorted`, which also serves
+    as the test oracle).
+    """
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
 
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
-
-    # one descending sort serves both top-k (rank threshold) and
-    # top-p (mass threshold)
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-
     vocab = logits.shape[-1]
-    k_idx = jnp.clip(top_k[:, None] - 1, 0, vocab - 1)
-    kth = jnp.take_along_axis(sorted_logits, k_idx, axis=-1)
+
+    if vocab <= SAMPLE_FAST_K * 2:
+        masked = _mask_sorted(scaled, jnp.sort(scaled, axis=-1)[:, ::-1],
+                              top_p, top_k, vocab)
+        sampled = jax.random.categorical(key, masked, axis=-1)
+        return jnp.where(temperature > 0, sampled, greedy)
+
+    kk = SAMPLE_FAST_K
+    top_vals = jax.lax.top_k(scaled, kk)[0]           # [B, K] descending
+    # the top-p cumulative mass needs the k-masked softmax denominator,
+    # which is a full-vocab reduction either way (O(V), no sort)
+    prefix_ok = _prefix_covers(scaled, top_vals, top_p, top_k, kk)
+
+    def fast(_):
+        return _mask_sorted(scaled, top_vals, top_p, top_k, vocab)
+
+    def slow(_):
+        return _mask_sorted(
+            scaled, jnp.sort(scaled, axis=-1)[:, ::-1], top_p, top_k,
+            vocab,
+        )
+
+    masked = jax.lax.cond(prefix_ok, fast, slow, None)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def _mask_sorted(
+    scaled: jax.Array,         # [B, V]
+    sorted_desc: jax.Array,    # [B, K>=needed] descending prefix (or full)
+    top_p: jax.Array,
+    top_k: jax.Array,
+    vocab: int,
+) -> jax.Array:
+    """Shared top-k + top-p masking given a descending (prefix of the)
+    sorted logits. Exact when the prefix covers the cutoffs."""
+    width = sorted_desc.shape[-1]
+    k_idx = jnp.clip(top_k[:, None] - 1, 0, width - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)
     apply_k = (top_k > 0)[:, None]
-    scaled = jnp.where(apply_k & (scaled < kth), -jnp.inf, scaled)
-    # top-p applies to the k-filtered distribution (sequential semantics);
-    # masking the sorted copy by the same value threshold avoids a resort
-    sorted_logits = jnp.where(
-        apply_k & (sorted_logits < kth), -jnp.inf, sorted_logits
+    masked = jnp.where(apply_k & (scaled < kth), -jnp.inf, scaled)
+    # top-p applies to the k-filtered distribution (sequential
+    # semantics); mask the sorted view by the same value threshold
+    sorted_m = jnp.where(
+        apply_k & (sorted_desc < kth), -jnp.inf, sorted_desc
     )
-
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # softmax denominator over the FULL masked vocab, not the prefix
+    denom = jnp.sum(
+        jnp.where(jnp.isfinite(masked), jnp.exp(
+            masked - jnp.max(sorted_m, axis=-1, keepdims=True)
+        ), 0.0),
+        axis=-1, keepdims=True,
+    )
+    probs = jnp.where(
+        jnp.isfinite(sorted_m),
+        jnp.exp(sorted_m - jnp.max(sorted_m, axis=-1, keepdims=True)),
+        0.0,
+    ) / denom
     cum = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(
-        cum < top_p[:, None], axis=-1, keepdims=True
+    cutoff_idx = jnp.clip(
+        jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True),
+        0, width - 1,
     )
-    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    cutoff = jnp.take_along_axis(sorted_m, cutoff_idx, axis=-1)
     apply_p = (top_p < 1.0)[:, None]
-    scaled = jnp.where(apply_p & (scaled < cutoff), -jnp.inf, scaled)
+    return jnp.where(apply_p & (masked < cutoff), -jnp.inf, masked)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+
+def _prefix_covers(
+    scaled: jax.Array, top_vals: jax.Array, top_p: jax.Array,
+    top_k: jax.Array, kk: int,
+) -> jax.Array:
+    """True iff, for every row, the top-K prefix contains both the
+    top_k rank cutoff and >= top_p of the k-masked mass."""
+    k_ok = jnp.all(top_k <= kk)
+    k_idx = jnp.clip(top_k[:, None] - 1, 0, kk - 1)
+    kth = jnp.take_along_axis(top_vals, k_idx, axis=-1)
+    apply_k = (top_k > 0)[:, None]
+    masked = jnp.where(apply_k & (scaled < kth), -jnp.inf, scaled)
+    mx = jnp.max(top_vals, axis=-1, keepdims=True)
+    denom = jnp.sum(
+        jnp.where(jnp.isfinite(masked), jnp.exp(masked - mx), 0.0),
+        axis=-1,
+    )
+    prefix_vals = jnp.where(
+        apply_k & (top_vals < kth), -jnp.inf, top_vals
+    )
+    prefix_mass = jnp.sum(
+        jnp.where(jnp.isfinite(prefix_vals),
+                  jnp.exp(prefix_vals - mx), 0.0),
+        axis=-1,
+    )
+    p_ok = jnp.all(prefix_mass >= top_p * denom)
+    return k_ok & p_ok
+
+
+def _sample_batched_sorted(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+) -> jax.Array:
+    """Reference implementation: one full-vocab sort (the test oracle
+    for the fast path)."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+    masked = _mask_sorted(
+        scaled, jnp.sort(scaled, axis=-1)[:, ::-1], top_p, top_k,
+        logits.shape[-1],
+    )
+    sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy)

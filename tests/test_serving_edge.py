@@ -227,3 +227,66 @@ def test_release_unknown_session_is_noop(setup):
     eng = make_engine(cfg, params)
     eng.release_session("never-existed")  # must not raise
     assert eng.stats()["turns_completed"] == 0
+
+
+# ---- sampler fast path ----
+
+def test_sampler_fast_path_matches_full_sort_oracle():
+    """Peaked distributions ride the top-K fast path; the token chosen
+    must equal the full-sort reference bit-for-bit."""
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import (
+        _sample_batched_sorted, sample_batched,
+    )
+
+    rng = np.random.default_rng(0)
+    vocab = 4096
+    for trial in range(6):
+        # peaked: a few dominant logits per row
+        logits = rng.standard_normal((4, vocab)).astype(np.float32)
+        logits[:, :8] += 12.0
+        logits = jnp.asarray(logits)
+        key = jax.random.PRNGKey(trial)
+        temps = jnp.asarray(rng.uniform(0.2, 1.2, 4), jnp.float32)
+        tops = jnp.asarray([0.9, 0.95, 1.0, 0.8][: 4], jnp.float32)
+        ks = jnp.asarray([0, 40, 5, 0], jnp.int32)
+        fast = sample_batched(logits, key, temps, tops, ks)
+        want = _sample_batched_sorted(logits, key, temps, tops, ks)
+        assert fast.tolist() == want.tolist(), trial
+
+
+def test_sampler_flat_distribution_falls_back_exactly():
+    """Near-uniform logits can't cover top_p in the prefix: the cond
+    fallback must produce the same tokens as the reference."""
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import (
+        _sample_batched_sorted, sample_batched,
+    )
+
+    vocab = 4096
+    logits = jnp.zeros((3, vocab), jnp.float32) + \
+        jax.random.normal(jax.random.PRNGKey(9), (3, vocab)) * 0.01
+    key = jax.random.PRNGKey(1)
+    temps = jnp.asarray([1.0, 0.7, 1.0], jnp.float32)
+    tops = jnp.asarray([0.99, 0.95, 0.9], jnp.float32)
+    ks = jnp.asarray([0, 0, 200], jnp.int32)  # k=200 > K forces slow
+    fast = sample_batched(logits, key, temps, tops, ks)
+    want = _sample_batched_sorted(logits, key, temps, tops, ks)
+    assert fast.tolist() == want.tolist()
+
+
+def test_sampler_greedy_rows_unaffected_by_fast_path():
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import sample_batched
+
+    vocab = 4096
+    logits = jax.random.normal(jax.random.PRNGKey(3), (2, vocab))
+    toks = sample_batched(
+        logits, jax.random.PRNGKey(0),
+        jnp.asarray([0.0, 0.0]), jnp.asarray([0.9, 1.0]),
+        jnp.asarray([40, 0], jnp.int32),
+    )
+    assert toks.tolist() == jnp.argmax(logits, axis=-1).tolist()
