@@ -37,9 +37,13 @@ def patch_mcp_config(config_path: str, entry: dict) -> bool:
             with open(config_path) as f:
                 config = json.load(f)
             if not isinstance(config, dict):
-                config = {}
+                return False
         except (json.JSONDecodeError, OSError):
-            config = {}  # invalid JSON — rewrite
+            # unparseable (possibly mid-write by the client): touching
+            # it risks destroying the user's whole config — leave it
+            # alone (deliberate deviation from the reference, which
+            # rewrites)
+            return False
         servers = config.get("mcpServers")
         if not isinstance(servers, dict):
             servers = {}

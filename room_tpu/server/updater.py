@@ -251,12 +251,17 @@ class UpdateChecker:
         if get_ready_update_version() == version:
             return
         self.auto_status = {"state": "downloading", "version": version}
-        stage = staging_dir()
+        # build + verify in a scratch dir; staging_dir only ever comes
+        # into existence via the atomic rename AFTER checksums pass, so
+        # a crash mid-download/mid-verify can never leave a tree that
+        # get_ready_update_version would report as ready
+        scratch = staging_dir() + ".tmp"
         try:
-            self._download_and_stage_inner(bundle_url, version, stage)
+            self._download_and_stage_inner(bundle_url, version, scratch)
+            shutil.rmtree(staging_dir(), ignore_errors=True)
+            os.rename(scratch, staging_dir())
         except Exception:
-            # a failed/unverified stage must not look "ready"
-            shutil.rmtree(stage, ignore_errors=True)
+            shutil.rmtree(scratch, ignore_errors=True)
             raise
 
     def _download_and_stage_inner(

@@ -169,6 +169,7 @@ class ServingEngine:
         self._slot_lengths = np.zeros((max_batch,), np.int32)
         self._key = jax.random.PRNGKey(rng_seed)
         self._deferred_release: set[str] = set()
+        self._admitting: set[str] = set()
         self._lock = threading.Lock()
         self._jit_cache: dict[Any, Callable] = {}
         self._stats = {
@@ -365,6 +366,11 @@ class ServingEngine:
         active_ids = {
             t.session_id for t in self._active if t is not None
         }
+        # sessions prepped earlier in the SAME admission batch hold
+        # page reservations but aren't in _active yet — evicting one
+        # would hand its pages to a batchmate and the imminent batched
+        # prefill would write two sessions' KV into the same pages
+        active_ids |= self._admitting
         candidates = [
             s for s in self.sessions.values()
             if s.id != exclude and s.id not in active_ids
@@ -389,34 +395,39 @@ class ServingEngine:
         multi-tenant rooms submitting simultaneously don't serialize."""
         free = self._free_slots()
         preps: list[dict] = []
-        while free and not self._queue.empty() and \
-                len(preps) < len(free):
-            turn = self._queue.get()
-            try:
-                prep = self._prepare_turn(turn)
-            except MemoryError as e:
-                # pool exhausted: requeue and stop admitting; decode will
-                # drain sessions and free pages
-                if self._free_slots() == list(range(self.max_batch)) \
-                        and not preps:
-                    turn.error = str(e)
-                    turn.finish_reason = "error"
-                    turn.done.set()
-                else:
-                    self._queue.put(turn)
-                break
-            if prep is not None:
-                preps.append(prep)
+        self._admitting.clear()
+        try:
+            while free and not self._queue.empty() and \
+                    len(preps) < len(free):
+                turn = self._queue.get()
+                try:
+                    prep = self._prepare_turn(turn)
+                except MemoryError as e:
+                    # pool exhausted: requeue and stop admitting; decode
+                    # will drain sessions and free pages
+                    if self._free_slots() == \
+                            list(range(self.max_batch)) and not preps:
+                        turn.error = str(e)
+                        turn.finish_reason = "error"
+                        turn.done.set()
+                    else:
+                        self._queue.put(turn)
+                    break
+                if prep is not None:
+                    preps.append(prep)
+                    self._admitting.add(turn.session_id)
 
-        # group by identical prefill shape
-        groups: dict[tuple, list[dict]] = {}
-        for prep in preps:
-            groups.setdefault(
-                (prep["bucket"], prep["fresh"]), []
-            ).append(prep)
-        for (bucket, fresh), group in groups.items():
-            slots = [free.pop(0) for _ in group]
-            self._prefill_group(bucket, fresh, group, slots)
+            # group by identical prefill shape
+            groups: dict[tuple, list[dict]] = {}
+            for prep in preps:
+                groups.setdefault(
+                    (prep["bucket"], prep["fresh"]), []
+                ).append(prep)
+            for (bucket, fresh), group in groups.items():
+                slots = [free.pop(0) for _ in group]
+                self._prefill_group(bucket, fresh, group, slots)
+        finally:
+            self._admitting.clear()
 
     def _prepare_turn(self, turn: Turn) -> Optional[dict]:
         """Validate + reserve pages for a queued turn. Returns the

@@ -165,7 +165,11 @@ def _prefix_covers(
                   jnp.exp(prefix_vals - mx), 0.0),
         axis=-1,
     )
-    p_ok = jnp.all(prefix_mass >= top_p * denom)
+    # rows with top_p >= 1 don't apply a mass cutoff at all (idle decode
+    # slots are padded with top_p=1), so they never need prefix coverage
+    p_ok = jnp.all(
+        (top_p >= 1.0) | (prefix_mass >= top_p * denom)
+    )
     return k_ok & p_ok
 
 

@@ -290,3 +290,48 @@ def test_sampler_greedy_rows_unaffected_by_fast_path():
         jnp.asarray([40, 0], jnp.int32),
     )
     assert toks.tolist() == jnp.argmax(logits, axis=-1).tolist()
+
+
+def test_admission_batch_never_evicts_its_own_preps(setup):
+    """Two sessions prepped in ONE _admit pass with a pool that only
+    fits one: the second must requeue (not evict the first, whose
+    prefill is imminent). Both eventually complete token-identically to
+    sequential runs on a roomy pool."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+
+    eng = make_engine(cfg, params, max_batch=2, n_pages=7)
+    a = eng.submit([1, 2, 3], session_id="A", sampling=sp)
+    b = eng.submit([9, 8, 7], session_id="B", sampling=sp)
+    eng.run_until_idle()
+    assert a.finish_reason in ("stop", "length")
+    assert b.finish_reason in ("stop", "length"), b.error
+
+    big = make_engine(cfg, params, max_batch=2, n_pages=64)
+    a2 = big.submit([1, 2, 3], session_id="A", sampling=sp)
+    big.run_until_idle()
+    b2 = big.submit([9, 8, 7], session_id="B", sampling=sp)
+    big.run_until_idle()
+    assert a.new_tokens == a2.new_tokens
+    assert b.new_tokens == b2.new_tokens
+
+
+def test_prefix_covers_exempts_disabled_top_p():
+    """top_p=1 rows (incl. idle slot padding) must not force the
+    full-sort fallback."""
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import SAMPLE_FAST_K, _prefix_covers
+
+    vocab = 4096
+    flat = jax.random.normal(jax.random.PRNGKey(0), (2, vocab)) * 0.01
+    top_vals = jax.lax.top_k(flat, SAMPLE_FAST_K)[0]
+    assert bool(_prefix_covers(
+        flat, top_vals, jnp.asarray([1.0, 1.0]),
+        jnp.asarray([0, 0], jnp.int32), SAMPLE_FAST_K,
+    ))
+    # but a row genuinely needing mass coverage still falls back
+    assert not bool(_prefix_covers(
+        flat, top_vals, jnp.asarray([0.95, 1.0]),
+        jnp.asarray([0, 0], jnp.int32), SAMPLE_FAST_K,
+    ))

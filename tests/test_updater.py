@@ -317,7 +317,10 @@ def test_register_mcp_globally(tmp_path):
         json.dumps({"permissions": {"allow": ["Bash(ls:*)"]}})
     )
     (home / ".cursor").mkdir()
-    (home / ".cursor" / "mcp.json").write_text("not json at all")
+    (home / ".cursor" / "mcp.json").write_text(
+        json.dumps({"mcpServers": {}})
+    )
+    (home / ".codeium").mkdir()  # windsurf dir but NO config file
     (home / ".codex").mkdir()
     (home / ".codex" / "config.toml").write_text(
         "[mcp_servers.room_tpu]\ncommand = 'stale'\n\n"
@@ -331,7 +334,7 @@ def test_register_mcp_globally(tmp_path):
     assert out["cursor"] is True
     assert out["codex"] is True
     assert out["windsurf"] is False  # absent config untouched
-    assert not (home / ".codeium").exists()
+    assert not (home / ".codeium" / "windsurf").exists()
 
     cc = json.loads((home / ".claude.json").read_text())
     assert "room_tpu" in cc["mcpServers"]
@@ -345,7 +348,7 @@ def test_register_mcp_globally(tmp_path):
     assert "mcp__room_tpu__*" in perms and "Bash(ls:*)" in perms
 
     cursor = json.loads((home / ".cursor" / "mcp.json").read_text())
-    assert "room_tpu" in cursor["mcpServers"]  # invalid JSON rewritten
+    assert "room_tpu" in cursor["mcpServers"]
 
     toml = (home / ".codex" / "config.toml").read_text()
     assert "command = 'stale'" not in toml  # old section replaced
@@ -359,3 +362,29 @@ def test_register_mcp_globally(tmp_path):
         (home / ".claude" / "settings.json").read_text()
     )["permissions"]["allow"]
     assert perms2.count("mcp__room_tpu__*") == 1
+
+
+def test_register_mcp_never_rewrites_unparseable_config(tmp_path):
+    """An unparseable config (possibly mid-write by the client) must be
+    left untouched — rewriting would destroy the user's whole file."""
+    from room_tpu.mcp.autoregister import patch_mcp_config
+
+    cfg = tmp_path / "broken.json"
+    cfg.write_text("{truncated mid-write")
+    assert patch_mcp_config(str(cfg), {"command": "x"}) is False
+    assert cfg.read_text() == "{truncated mid-write"
+    # non-dict JSON likewise untouched
+    cfg.write_text("[1, 2, 3]")
+    assert patch_mcp_config(str(cfg), {"command": "x"}) is False
+    assert cfg.read_text() == "[1, 2, 3]"
+
+
+def test_scratch_stage_never_looks_ready(tmp_path, monkeypatch):
+    """A crash mid-download/mid-verify leaves only the .tmp scratch
+    tree, which get_ready_update_version must ignore."""
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    scratch = updater.staging_dir() + ".tmp"
+    os.makedirs(scratch, exist_ok=True)
+    with open(os.path.join(scratch, "version.json"), "w") as f:
+        json.dump({"version": "99.0.0"}, f)
+    assert get_ready_update_version() is None
