@@ -125,3 +125,55 @@ def test_full_swarm_story(server):
     assert usage["data"]["cycles"] >= 1
     _, stopped = req(server, "POST", f"/api/rooms/{room_id}/stop")
     assert stopped["status"] == 200
+
+
+def test_swarm_cycle_on_real_engine(server, monkeypatch):
+    """The agent loop driving the ACTUAL serving engine (tiny-moe,
+    random weights): room starts, a queen cycle prefills + decodes on
+    the engine, the cycle is recorded, engine stats advance. This is
+    the SURVEY §7 integration the echo provider can't cover."""
+    from room_tpu.providers.tpu import get_model_host, reset_model_hosts
+
+    reset_provider_cache()
+    reset_model_hosts()
+    # keep the turn small so CPU decode stays fast
+    monkeypatch.setenv("ROOM_TPU_MAX_BATCH", "2")
+    monkeypatch.setenv("ROOM_TPU_N_PAGES", "1024")
+    try:
+        _, out = req(server, "POST", "/api/rooms",
+                     {"name": "on-engine", "goal": "exercise the tpu",
+                      "workerModel": "tpu:tiny-moe",
+                      "createWallet": False})
+        room_id = out["data"]["id"]
+        status, _ = req(server, "POST", f"/api/rooms/{room_id}/start")
+        assert status == 200
+
+        cycles = []
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            _, out = req(server, "GET", f"/api/rooms/{room_id}/cycles")
+            cycles = [c for c in out["data"]
+                      if c["status"] != "running"]
+            if cycles:
+                break
+            time.sleep(0.5)
+        req(server, "POST", f"/api/rooms/{room_id}/stop")
+        assert cycles, "no cycle finished on the engine"
+        assert cycles[0]["status"] == "success", cycles[0]
+        assert cycles[0]["model"] == "tpu:tiny-moe"
+        assert cycles[0]["output_tokens"] > 0
+
+        engine = get_model_host("tiny-moe")._engine
+        assert engine is not None
+        st = engine.stats()
+        assert st["prefill_tokens"] > 0 and st["tokens_decoded"] > 0
+
+        # the cycle's prompt + response went through the engine's
+        # tokenizer round-trip into the log buffer
+        _, logs = req(server, "GET",
+                      f"/api/cycles/{cycles[0]['id']}/logs")
+        kinds = {l["entry_type"] for l in logs["data"]}
+        assert "prompt" in kinds and "assistant" in kinds
+    finally:
+        req(server, "POST", f"/api/rooms/{room_id}/stop")
+        reset_model_hosts()
