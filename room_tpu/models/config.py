@@ -40,6 +40,10 @@ class DecoderConfig:
     moe_impl: str = "ragged"
     dtype: str = "bfloat16"
     max_seq_len: int = 32768
+    # rematerialize layer activations in the backward pass (training /
+    # fine-tuning memory lever: trades one extra forward of FLOPs per
+    # layer for not keeping every layer's activations in HBM)
+    remat: bool = False
 
     @property
     def is_moe(self) -> bool:

@@ -268,10 +268,13 @@ def forward(
         return x, new_layer_cache
 
     if kv_cache is None:
-        x, _ = jax.lax.scan(
-            lambda c, lp: (body(c, (lp, None))[0], None),
-            x, params["layers"],
-        )
+        layer_fn = lambda c, lp: (body(c, (lp, None))[0], None)  # noqa: E731
+        if cfg.remat:
+            # recompute layer activations in backward: HBM usage drops
+            # from O(L) live activation sets to O(1) at the cost of one
+            # extra forward per layer (the standard TPU training trade)
+            layer_fn = jax.checkpoint(layer_fn)
+        x, _ = jax.lax.scan(layer_fn, x, params["layers"])
         new_cache = None
     else:
         x, new_kv = jax.lax.scan(

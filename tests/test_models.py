@@ -193,3 +193,43 @@ def test_gshard_model_shards_over_ep():
         sharded, tokens
     )
     np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
+def test_remat_grads_match():
+    """cfg.remat recomputes activations in backward; loss and grads
+    must be identical to the non-remat path."""
+    import dataclasses
+
+    from room_tpu.train import init_train_state, make_train_step
+
+    cfg = tiny_moe()
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0,
+                                cfg.vocab_size)
+    mask = jnp.ones((2, 8), jnp.float32)
+
+    def loss_and_grads(cfg):
+        params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+
+        def loss_fn(p):
+            logits, _ = qwen3.forward(p, cfg, tokens)
+            targets = jnp.roll(tokens, -1, axis=1)
+            ll = jax.nn.log_softmax(logits.astype(jnp.float32))
+            nll = -jnp.take_along_axis(
+                ll, targets[..., None], axis=-1
+            )[..., 0]
+            return (nll * mask).sum() / mask.sum()
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    base_loss, base_grads = loss_and_grads(cfg)
+    r_loss, r_grads = loss_and_grads(
+        dataclasses.replace(cfg, remat=True)
+    )
+    np.testing.assert_allclose(float(base_loss), float(r_loss),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(base_grads),
+                    jax.tree.leaves(r_grads)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-4, atol=1e-5,
+        )
