@@ -123,6 +123,11 @@ class ServingEngine:
             self.decode_chunk = (
                 8 if jax.default_backend() == "tpu" else 1
             )
+        # long prompts prefill in chunks of this width (0 disables):
+        # bounds compile widths + prefill activation memory at 32k ctx
+        self.prefill_chunk = int(
+            os.environ.get("ROOM_TPU_PREFILL_CHUNK", "2048")
+        )
 
         if stop_token_ids is not None:
             self.stop_token_ids = set(stop_token_ids)
@@ -467,18 +472,34 @@ class ServingEngine:
             turn.done.set()
             return None
 
+        # long prompts prefill in fixed-width chunks through the
+        # KV-continuation path, so compile widths and activation memory
+        # are bounded by prefill_chunk regardless of prompt length; only
+        # the final chunk samples
+        chunk_limit = self.prefill_chunk
+        pre_chunks: list[list[int]] = []
+        tail = prompt
+        if chunk_limit and len(prompt) > chunk_limit:
+            n_full = (len(prompt) - 1) // chunk_limit
+            pre_chunks = [
+                prompt[i * chunk_limit:(i + 1) * chunk_limit]
+                for i in range(n_full)
+            ]
+            tail = prompt[n_full * chunk_limit:]
+        pre_total = sum(len(c) for c in pre_chunks)
+
         bucket = next(
-            (b for b in PREFILL_BUCKETS if b >= len(prompt)),
+            (b for b in PREFILL_BUCKETS if b >= len(tail)),
             None,
         )
         capacity = self.max_pages_per_seq * self.page_size
         # the padded prefill must also fit the block table: clamp the
         # bucket to the remaining page-aligned capacity (an off-bucket
         # length near capacity costs one extra compile, not a rejection)
-        remaining = capacity - sess.length
+        remaining = capacity - sess.length - pre_total
         if bucket is not None and bucket > remaining:
             bucket = (remaining // self.page_size) * self.page_size
-        if bucket is None or bucket < len(prompt):
+        if bucket is None or bucket < len(tail):
             turn.error = (
                 f"prompt too long: {len(prompt)} at session length "
                 f"{sess.length} (capacity {capacity})"
@@ -488,18 +509,59 @@ class ServingEngine:
             return None
 
         pages = self._ensure_capacity_evicting(
-            sess.id, sess.length + bucket
+            sess.id, sess.length + pre_total + bucket
         )
         sess.pending = None
         if restoring:
             sess.history = []
         table = np.zeros((self.max_pages_per_seq,), np.int32)
         table[: len(pages)] = pages
+        for chunk_toks in pre_chunks:
+            self._prefill_write_chunk(sess, chunk_toks, table)
         return {
-            "turn": turn, "sess": sess, "prompt": prompt,
+            "turn": turn, "sess": sess, "prompt": tail,
             "bucket": bucket, "fresh": sess.length == 0,
             "table": table, "base_length": sess.length,
         }
+
+    def _prefill_write_chunk(
+        self, sess: _Session, toks: list[int], table: np.ndarray
+    ) -> None:
+        """KV-write-only prefill of one full chunk (no head, no
+        sampling)."""
+        width = len(toks)
+        fresh = sess.length == 0
+        key = ("prefill_write", width, fresh)
+        if key not in self._jit_cache:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def write(params, cache, tokens, block_table, length):
+                hook = make_paged_kv_hook(
+                    block_table, length, self.page_size,
+                    fresh_prefill=fresh,
+                )
+                positions = length[:, None] + \
+                    jnp.arange(tokens.shape[1])
+                _, cache = qwen3.forward(
+                    params, cfg, tokens, positions, cache,
+                    kv_hook=hook, apply_head=False,
+                )
+                return self._constrain_cache(cache)
+
+            self._jit_cache[key] = write
+
+        with self.timer.phase(f"prefill_write_{width}"):
+            self.cache = self._jit_cache[key](
+                self.params,
+                self.cache,
+                jnp.asarray([toks], jnp.int32),
+                jnp.asarray(table[None, :]),
+                jnp.asarray([sess.length], jnp.int32),
+            )
+        self._stats["prefill_tokens"] += width
+        sess.length += width
+        sess.history.extend(toks)
 
     def _prefill_group(
         self, bucket: int, fresh: bool, group: list[dict],

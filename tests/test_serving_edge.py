@@ -335,3 +335,47 @@ def test_prefix_covers_exempts_disabled_top_p():
         flat, top_vals, jnp.asarray([0.95, 1.0]),
         jnp.asarray([0, 0], jnp.int32), SAMPLE_FAST_K,
     ))
+
+
+def test_chunked_long_prefill_token_identical(setup):
+    """A prompt longer than prefill_chunk streams through KV-write-only
+    chunks, then the tail samples — tokens identical to one-shot
+    prefill."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=5)
+    prompt = [(i * 7) % 100 + 1 for i in range(45)]
+
+    one_shot = make_engine(cfg, params, n_pages=64)
+    one_shot.prefill_chunk = 0
+    a = one_shot.submit(prompt, sampling=sp)
+    one_shot.run_until_idle()
+
+    chunked = make_engine(cfg, params, n_pages=64)
+    chunked.prefill_chunk = 16   # 45 tokens -> 2 chunks + 13-token tail
+    b = chunked.submit(prompt, sampling=sp)
+    chunked.run_until_idle()
+
+    assert a.finish_reason in ("stop", "length")
+    assert b.new_tokens == a.new_tokens
+    # chunk writes showed up as their own timer phases
+    assert any(k.startswith("prefill_write_16")
+               for k in chunked.timer.snapshot())
+
+
+def test_chunked_prefill_resume_continuation(setup):
+    """Chunked prefill composes with session continuation (the resume
+    prompt itself gets chunked against existing KV)."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+
+    def run(chunk):
+        eng = make_engine(cfg, params, n_pages=64)
+        eng.prefill_chunk = chunk
+        t1 = eng.submit([1, 2, 3], session_id="s", sampling=sp)
+        eng.run_until_idle()
+        t2 = eng.submit(list(range(5, 45)), session_id="s",
+                        sampling=sp)
+        eng.run_until_idle()
+        return t1.new_tokens, t2.new_tokens
+
+    assert run(0) == run(12)
