@@ -361,3 +361,32 @@ def test_pipeline_validation():
             sharded, cfg2, jnp.ones((5, 4), jnp.int32), mesh=mesh,
             n_microbatches=2,
         )
+
+
+@pytest.mark.parametrize("shape", [
+    # (B, Hq, Hkv, D, page, P, maxp) — TPU-realistic ratios: wide GQA
+    # groups, 128-dim heads, larger pages; first-real-chip de-risk
+    dict(B=1, Hq=8, Hkv=1, D=32, page=8, P=16, maxp=4),     # Hq/Hkv=8
+    dict(B=4, Hq=16, Hkv=2, D=64, page=16, P=24, maxp=4),
+    dict(B=2, Hq=8, Hkv=8, D=32, page=8, P=16, maxp=4),     # MHA (no GQA)
+    dict(B=8, Hq=32, Hkv=4, D=128, page=32, P=12, maxp=3),  # 30B shape
+    dict(B=5, Hq=4, Hkv=2, D=16, page=4, P=40, maxp=8),     # many pages
+])
+def test_pallas_paged_decode_shape_sweep(shape):
+    maxp, page = shape["maxp"], shape["page"]
+    caps = maxp * page
+    # lengths hugging every boundary class: 1, mid-page, page-1, page,
+    # page+1, full capacity
+    lengths = [1, page // 2 + 1, page - 1, page, page + 1, caps]
+    B = shape["B"]
+    _pallas_case((lengths * ((B // len(lengths)) + 1))[:B], **shape)
+
+
+def test_pallas_paged_decode_single_token_context():
+    """length=1 everywhere (first decode step after a 1-token prompt)."""
+    _pallas_case([1, 1, 1])
+
+
+def test_pallas_paged_decode_full_capacity():
+    """every sequence at exactly max_pages*page tokens."""
+    _pallas_case([32, 32, 32])
