@@ -2,11 +2,13 @@ from . import config, embedder, qwen3
 from .config import (
     DecoderConfig,
     EncoderConfig,
+    llama31_8b,
     minilm_384,
     qwen2_72b,
     qwen3_coder_30b,
     tiny_dense,
     tiny_encoder,
+    tiny_llama,
     tiny_moe,
 )
 
@@ -16,10 +18,12 @@ __all__ = [
     "qwen3",
     "DecoderConfig",
     "EncoderConfig",
+    "llama31_8b",
     "minilm_384",
     "qwen2_72b",
     "qwen3_coder_30b",
     "tiny_dense",
     "tiny_encoder",
+    "tiny_llama",
     "tiny_moe",
 ]

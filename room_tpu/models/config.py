@@ -102,6 +102,46 @@ def qwen2_72b() -> DecoderConfig:
     )
 
 
+def llama31_8b() -> DecoderConfig:
+    """Llama-3.1-8B — third supported family: GQA without qk-norm or
+    qkv-bias, 500k rope theta, 128k-token vocabulary. The decoder and
+    the safetensors converter already cover this tensor layout (same
+    q/k/v/o + gate/up/down naming, no extra tensors)."""
+    return DecoderConfig(
+        name="llama31-8b",
+        vocab_size=128_256,
+        hidden=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        intermediate=14_336,
+        rope_theta=5e5,
+        qkv_bias=False,
+        qk_norm=False,
+    )
+
+
+def tiny_llama(vocab_size: int = 512) -> DecoderConfig:
+    """Hermetic stand-in with the llama family's shape (GQA, no
+    qk-norm/bias, dense FFN, tied-free head)."""
+    return DecoderConfig(
+        name="tiny-llama",
+        vocab_size=vocab_size,
+        hidden=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        intermediate=128,
+        rope_theta=5e5,
+        qkv_bias=False,
+        qk_norm=False,
+        dtype="float32",
+        max_seq_len=8192,
+    )
+
+
 def tiny_moe(vocab_size: int = 512) -> DecoderConfig:
     """Hermetic-test stand-in with the 30B's *shape* (MoE, GQA, qk-norm)."""
     return DecoderConfig(

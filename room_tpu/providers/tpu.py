@@ -30,8 +30,10 @@ from .base import ExecutionRequest, ExecutionResult, ProviderError
 MODEL_CONFIGS: dict[str, Callable] = {
     "qwen3-coder-30b": model_configs.qwen3_coder_30b,
     "qwen2.5-72b": model_configs.qwen2_72b,
+    "llama31-8b": model_configs.llama31_8b,
     "tiny-moe": model_configs.tiny_moe,
     "tiny-dense": model_configs.tiny_dense,
+    "tiny-llama": model_configs.tiny_llama,
 }
 
 _hosts: dict[str, "ModelHost"] = {}

@@ -233,3 +233,61 @@ def test_remat_grads_match():
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             rtol=1e-4, atol=1e-5,
         )
+
+
+def test_llama_family_forward_and_decode():
+    """Third model family (llama shape: GQA, no qk-norm/bias, 500k
+    theta) runs the shared decoder + cache path."""
+    from room_tpu.models.config import tiny_llama
+
+    cfg = tiny_llama()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    assert "bq" not in params["layers"] and \
+        "q_norm" not in params["layers"]
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0,
+                                cfg.vocab_size)
+    logits, _ = qwen3.forward(params, cfg, tokens)
+    assert logits.shape == (2, 6, cfg.vocab_size)
+    cache = qwen3.init_kv_cache(cfg, 2, 16)
+    _, cache = qwen3.forward(params, cfg, tokens, None, cache)
+    step, _ = qwen3.decode_step(
+        params, cfg, jnp.ones((2,), jnp.int32), cache
+    )
+    assert np.isfinite(np.asarray(step)).all()
+
+
+def test_llama_converter_roundtrip(tmp_path):
+    """The HF converter covers the llama tensor layout (same names,
+    no bias/qk-norm tensors present)."""
+    from room_tpu.models.config import tiny_llama
+    from room_tpu.utils.convert import convert_hf_decoder
+    from tests.test_convert_ckpt import _write_hf_safetensors
+
+    cfg = tiny_llama()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    _write_hf_safetensors(tmp_path, cfg, params)
+    converted = convert_hf_decoder(str(tmp_path), cfg, dtype="float32")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                                cfg.vocab_size)
+    want, _ = qwen3.forward(params, cfg, tokens)
+    got, _ = qwen3.forward(
+        jax.tree.map(lambda x: np.asarray(x, np.float32), converted),
+        cfg, tokens,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llama_serves_in_engine():
+    from room_tpu.models.config import tiny_llama
+    from room_tpu.serving import SamplingParams, ServingEngine
+
+    cfg = tiny_llama()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, max_batch=2, page_size=8,
+                        n_pages=32)
+    t = eng.submit([1, 2, 3], sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=4))
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length")
+    assert len(t.new_tokens) >= 1
