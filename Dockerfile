@@ -1,0 +1,33 @@
+# room-tpu server image (reference analogue: .github/workflows/docker.yml
+# image). CPU base works everywhere; on TPU VMs the host-provided libtpu
+# is picked up automatically by jax[tpu].
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make curl \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY setup.py pyproject.toml* README.md ./
+COPY room_tpu ./room_tpu
+COPY native ./native
+COPY ui ./ui
+COPY bench.py ./
+
+# jax pinned CPU by default; install `jax[tpu]` in TPU deployments
+RUN pip install --no-cache-dir \
+        "jax>=0.9" optax orbax-checkpoint transformers safetensors \
+        ml_dtypes cryptography \
+    && pip install --no-cache-dir -e . \
+    && make -C native
+
+ENV ROOM_TPU_DATA_DIR=/data \
+    ROOM_TPU_BIND_HOST=0.0.0.0 \
+    ROOM_TPU_DEPLOYMENT_MODE=cloud
+VOLUME /data
+EXPOSE 3700
+
+HEALTHCHECK --interval=30s --timeout=5s \
+    CMD curl -fs http://127.0.0.1:3700/api/auth/handshake || exit 1
+
+CMD ["python", "-m", "room_tpu", "serve", "--port", "3700"]

@@ -1,0 +1,28 @@
+#!/bin/sh
+# room-tpu installer (reference analogue: the project's install.sh /
+# platform installers): installs the package into the current Python
+# environment, builds the native helpers, and registers the MCP server
+# with installed AI clients on first `serve`.
+set -eu
+
+PYTHON="${PYTHON:-python3}"
+
+echo "==> checking python"
+"$PYTHON" -c 'import sys; assert sys.version_info >= (3, 10), \
+    "python 3.10+ required"'
+
+echo "==> installing room-tpu"
+"$PYTHON" -m pip install -e .
+
+echo "==> building native helpers"
+if command -v make >/dev/null 2>&1 && command -v g++ >/dev/null 2>&1; then
+    make -C native || echo "   (native build failed — the pure-JAX \
+fallbacks will be used)"
+else
+    echo "   (make/g++ not found — skipping; pure-JAX fallbacks used)"
+fi
+
+echo "==> done"
+echo "    start the server:  room-tpu serve"
+echo "    open the dashboard: http://127.0.0.1:3700/"
+echo "    TPU deployments:    pip install 'jax[tpu]' first"

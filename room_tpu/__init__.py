@@ -21,4 +21,4 @@ JAX/XLA/Pallas serving stack:
 - ``room_tpu.cli``      — command-line entry points.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

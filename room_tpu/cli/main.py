@@ -60,6 +60,48 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_update(args: argparse.Namespace) -> int:
+    """Check for (and optionally apply) a staged update (reference:
+    updateChecker/autoUpdate driven from the CLI)."""
+    import json
+
+    from ..server.updater import (
+        UpdateChecker, get_ready_update_version, promote_staged_update,
+    )
+
+    checker = UpdateChecker()
+    checker.force_check(ignore_backoff=True)
+    view = checker.status_view()
+    print(json.dumps(view, indent=1, default=str))
+    ready = get_ready_update_version()
+    if ready and args.apply:
+        version = promote_staged_update()
+        print(f"update v{version} promoted; restart the server to "
+              "pick it up")
+    elif ready:
+        print(f"update v{ready} staged; run `room-tpu update --apply` "
+              "or POST /api/server/update-restart")
+    return 0
+
+
+def cmd_uninstall(args: argparse.Namespace) -> int:
+    """Remove the data directory (DB, tokens, staged updates). Keeps
+    user files outside the data dir untouched; refuses without
+    --yes."""
+    import shutil
+
+    from ..server.auth import data_dir
+
+    target = data_dir()
+    if not args.yes:
+        print(f"would remove {target} (db, tokens, staged updates); "
+              "re-run with --yes to confirm")
+        return 2
+    shutil.rmtree(target, ignore_errors=True)
+    print(f"removed {target}")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="room-tpu",
@@ -79,6 +121,17 @@ def main(argv: list[str] | None = None) -> int:
 
     bench = sub.add_parser("bench", help="run the decode benchmark")
     bench.set_defaults(fn=cmd_bench)
+
+    update = sub.add_parser("update", help="check for updates")
+    update.add_argument("--apply", action="store_true",
+                        help="promote a staged update")
+    update.set_defaults(fn=cmd_update)
+
+    uninstall = sub.add_parser(
+        "uninstall", help="remove the data directory"
+    )
+    uninstall.add_argument("--yes", action="store_true")
+    uninstall.set_defaults(fn=cmd_uninstall)
 
     args = parser.parse_args(argv)
     if not getattr(args, "fn", None):

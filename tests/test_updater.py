@@ -388,3 +388,31 @@ def test_scratch_stage_never_looks_ready(tmp_path, monkeypatch):
     with open(os.path.join(scratch, "version.json"), "w") as f:
         json.dump({"version": "99.0.0"}, f)
     assert get_ready_update_version() is None
+
+
+# ---- CLI update / uninstall ----
+
+def test_cli_update_command(update_source, capsys):
+    from room_tpu.cli.main import main
+
+    updater.reset_update_checker()
+    assert main(["update"]) == 0
+    out = capsys.readouterr().out
+    assert NEXT_VERSION in out and "staged" in out
+    assert main(["update", "--apply"]) == 0
+    out = capsys.readouterr().out
+    assert "promoted" in out
+    assert os.path.exists(os.path.join(updater.app_dir(), "app.js"))
+
+
+def test_cli_uninstall_requires_confirmation(tmp_path, monkeypatch,
+                                             capsys):
+    from room_tpu.cli.main import main
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "d"))
+    os.makedirs(tmp_path / "d", exist_ok=True)
+    (tmp_path / "d" / "room.db").write_text("x")
+    assert main(["uninstall"]) == 2       # refuses without --yes
+    assert (tmp_path / "d").exists()
+    assert main(["uninstall", "--yes"]) == 0
+    assert not (tmp_path / "d").exists()
