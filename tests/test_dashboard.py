@@ -219,6 +219,8 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/providers/1/auth/start"),  # mock id, no CLI
         ("GET", "/api/providers/1/auth"),         # no active session
         ("GET", "/api/providers/auth/sessions/1"),  # unknown session
+        ("GET", "/api/tpu/provision/1"),          # unknown session
+        ("POST", "/api/tpu/provision"),           # spawns a load thread
         ("POST", "/api/rooms/1/start"),           # provider not ready
         ("POST", "/api/workers/1/start"),         # provider not ready
         ("POST", "/api/decisions/1/keeper-vote"), # already resolved (409)
@@ -245,7 +247,8 @@ def test_every_panel_call_resolves(server):
             status = e.code
         if (method, path) in allowed_4xx:
             assert status != 404 or "providers" in path or \
-                "sessions" in path, (method, path, status)
+                "sessions" in path or "provision" in path, \
+                (method, path, status)
             continue
         assert 200 <= status < 300, (
             f"{method} {path} -> {status} (panel/API drift)"

@@ -540,11 +540,21 @@ async function renderWallet(el) {
     if (!w) return "";
     const txs = (await api("GET",
       `/api/rooms/${r.id}/wallet/transactions`)).data || [];
+    const ident = (await api("GET",
+      `/api/rooms/${r.id}/identity`)).data;
     return `<div class="panel"><h2>${esc(r.name)} wallet</h2>
       <div class="kv">
         <span class="k">address</span><span>
           <code>${esc(w.address)}</code></span>
         <span class="k">chain</span><span>${esc(w.chain)}</span>
+        <span class="k">identity</span>
+        <span>${ident?.registered
+          ? `<span class="pill verified">ERC-8004
+              #${esc(ident.erc8004_agent_id)}</span>`
+          : `<span class="dim">unregistered</span>
+             <button class="ghost"
+               onclick="identityRegister(${r.id})">
+               prepare registration</button>`}</span>
       </div>
       <div class="row">
         <input id="wdTo-${r.id}" placeholder="0x recipient…">
@@ -560,6 +570,14 @@ async function renderWallet(el) {
   el.innerHTML = blocks.join("") ||
     `<div class="panel"><div class="dim">
       no wallets — rooms create theirs on launch</div></div>`;
+}
+
+async function identityRegister(roomId) {
+  const out = await api("POST",
+    `/api/rooms/${roomId}/identity/register`, {dryRun: true});
+  if (out.data?.tx) {
+    toast(`registration tx prepared for ${out.data.tx.to}`);
+  }
 }
 
 async function withdraw(roomId) {
@@ -871,6 +889,80 @@ async function selfmodRevert(id) {
   refreshView();
 }
 
+// ---- tpu (engines + weight provisioning) ----
+
+wsHandlers.tpu = (msg) => {
+  if (msg.channel === "tpu-model" && currentView === "tpu") {
+    const log = $("provisionLog");
+    if (log && msg.data?.line) {
+      log.innerHTML += `<div>${esc(msg.data.line)}</div>`;
+      log.scrollTop = log.scrollHeight;
+    }
+  }
+};
+
+async function renderTpu(el) {
+  const [status, engines, models] = await Promise.all([
+    api("GET", "/api/tpu/status"),
+    api("GET", "/api/tpu/engines"),
+    api("GET", "/api/models/status"),
+  ]);
+  const st = status.data || {};
+  el.innerHTML = `
+    <div class="panel"><h2>accelerator</h2>
+      <div class="kv">
+        <span class="k">platform</span><span>${esc(st.platform)}</span>
+        <span class="k">devices</span><span>${esc(st.devices)}</span>
+        <span class="k">ready</span>
+          <span>${st.ready
+            ? '<span class="pill verified">yes</span>'
+            : `<span class="pill failed">no</span>
+               <span class="dim">${esc(st.reason || "")}</span>`}</span>
+      </div></div>
+    <div class="panel"><h2>serving engines</h2>
+      <table><tr><th>model</th><th>status</th><th>decoded</th>
+        <th>prefill</th><th>sessions</th><th>free pages</th>
+        <th>evictions</th></tr>
+      ${Object.entries(engines.data || {}).map(([name, e]) => `
+        <tr><td>${esc(name)}</td>
+        <td><span class="pill ${esc(e.status)}">${esc(e.status)}</span>
+        </td>
+        <td>${e.tokens_decoded ?? ""}</td>
+        <td>${e.prefill_tokens ?? ""}</td>
+        <td>${e.sessions ?? ""}</td>
+        <td>${e.free_pages ?? ""}</td>
+        <td>${e.evictions ?? ""}</td></tr>`).join("") ||
+        '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
+      </table></div>
+    <div class="panel"><h2>model status</h2>
+      <table>${Object.entries(models.data || {}).map(([name, m]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${m.ready
+          ? '<span class="pill verified">ready</span>'
+          : '<span class="pill pending">cold</span>'}</td>
+        <td class="dim">${esc(m.detail || "")}</td>
+        <td><button class="ghost" onclick="provision('${esc(name)}')">
+          load weights</button></td></tr>`).join("")}</table>
+      <div class="log hidden" id="provisionLog"
+           style="margin-top:.5rem"></div></div>`;
+  subscribe("tpu-model");
+}
+
+async function provision(model) {
+  const out = await api("POST", "/api/tpu/provision", {model});
+  if (!out.data) return;
+  $("provisionLog").classList.remove("hidden");
+  $("provisionLog").innerHTML =
+    `<div class="t">provision session ${esc(out.data.session)}</div>`;
+  const sid = out.data.session;
+  const poll = async () => {
+    const v = (await api("GET", `/api/tpu/provision/${sid}`)).data;
+    if (v && v.status === "running") setTimeout(poll, 1500);
+    else refreshView();
+  };
+  poll();
+}
+
 // ---- registry ----
 
 const PANELS = {
@@ -883,6 +975,7 @@ const PANELS = {
   memory: {title: "memory", render: renderMemory},
   skills: {title: "skills", render: renderSkills},
   wallet: {title: "wallet", render: renderWallet},
+  tpu: {title: "tpu", render: renderTpu},
   cycles: {title: "cycles", render: renderCycles},
   clerk: {title: "clerk", render: renderClerk},
   system: {title: "system", render: renderSystem},
