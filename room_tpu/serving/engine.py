@@ -79,6 +79,28 @@ class _Session:
     # memory against its pages' HBM footprint.
     history: list[int] = field(default_factory=list)
     last_used: float = field(default_factory=time.monotonic)
+    # shared read-only prefix pages referenced (not owned) by this
+    # session: its block table is prefix_pages + own pages, and all its
+    # KV writes land at positions >= prefix_len
+    prefix_key: Optional[tuple] = None
+    prefix_pages: list[int] = field(default_factory=list)
+    prefix_len: int = 0
+
+
+@dataclass
+class _PrefixEntry:
+    """One cached page-aligned prompt prefix (vLLM-style automatic
+    prefix caching): the swarm's workers share multi-thousand-token
+    system prompts, so repeat prefills become block-table references.
+    Pages are owned by a pseudo-session in the page table; `sessions`
+    is the live refcount — an entry is evictable only at refcount 0."""
+    key: tuple
+    owner_id: str
+    pages: list[int]
+    length: int
+    ready: bool = False          # KV written (first prefill completed)
+    sessions: set = field(default_factory=set)
+    last_used: float = field(default_factory=time.monotonic)
 
 
 class ServingEngine:
@@ -175,12 +197,23 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(rng_seed)
         self._deferred_release: set[str] = set()
         self._admitting: set[str] = set()
+        # automatic prefix caching (0 disables; value = min prefix
+        # pages worth sharing)
+        self.prefix_cache_min_pages = int(
+            os.environ.get("ROOM_TPU_PREFIX_CACHE_PAGES", "2")
+        )
+        self._prefix_cache: dict[tuple, _PrefixEntry] = {}
         self._lock = threading.Lock()
         self._jit_cache: dict[Any, Callable] = {}
         self._stats = {
             "tokens_decoded": 0, "turns_completed": 0, "prefill_tokens": 0,
             "decode_steps": 0, "evictions": 0,
+            "prefix_hits": 0, "prefix_tokens_reused": 0,
+            "prefix_evictions": 0,
         }
+        from collections import Counter
+
+        self._prefix_lengths: Counter = Counter()
         from ..utils.profiling import StepTimer
 
         self.timer = StepTimer()
@@ -313,7 +346,9 @@ class ServingEngine:
             ):
                 self._deferred_release.add(session_id)
                 return
-            self.sessions.pop(session_id, None)
+            sess = self.sessions.pop(session_id, None)
+            if sess is not None:
+                self._release_session_prefix(sess)
             self.page_table.release(session_id)
 
     def stats(self) -> dict:
@@ -364,7 +399,8 @@ class ServingEngine:
                     session_id, n_tokens
                 )
             except MemoryError:
-                if not self._evict_lru(exclude=session_id):
+                if not self._evict_lru(exclude=session_id) and \
+                        not self._evict_prefix():
                     raise
 
     def _evict_lru(self, exclude: str) -> bool:
@@ -390,9 +426,84 @@ class ServingEngine:
             victim.history.append(victim.pending)
             victim.pending = None
         self.page_table.release(victim.id)
+        self._release_session_prefix(victim)
         victim.length = 0
         self._stats["evictions"] += 1
         return True
+
+    def _evict_prefix(self) -> bool:
+        """Drop the least-recently-used cached prefix no live session
+        references (its pages return to the pool)."""
+        candidates = [
+            e for e in self._prefix_cache.values() if not e.sessions
+        ]
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda e: e.last_used)
+        self.page_table.release(victim.owner_id)
+        del self._prefix_cache[victim.key]
+        self._prefix_lengths[victim.length] -= 1
+        if self._prefix_lengths[victim.length] <= 0:
+            del self._prefix_lengths[victim.length]
+        self._stats["prefix_evictions"] += 1
+        return True
+
+    def _prefix_lookup(self, prompt: list[int]) -> Optional["_PrefixEntry"]:
+        """Longest ready cached prefix of ``prompt`` (only lengths that
+        actually exist in the cache are probed)."""
+        page = self.page_size
+        max_len = ((len(prompt) - 1) // page) * page
+        for length in sorted(self._prefix_lengths, reverse=True):
+            if length > max_len:
+                continue
+            entry = self._prefix_cache.get(tuple(prompt[:length]))
+            if entry is not None and entry.ready:
+                return entry
+        return None
+
+    def _prefix_register(
+        self, sess: _Session, prompt: list[int]
+    ) -> Optional["_PrefixEntry"]:
+        """Allocate cache-owned pages for this prompt's aligned prefix;
+        the session's own prefill writes the KV, and the entry becomes
+        ready (shareable) once that completes. Best-effort: under pool
+        pressure the session simply admits uncached."""
+        page = self.page_size
+        aligned = ((len(prompt) - 1) // page) * page
+        if aligned < self.prefix_cache_min_pages * page:
+            return None
+        if aligned // page >= self.max_pages_per_seq:
+            return None
+        key = tuple(prompt[:aligned])
+        if key in self._prefix_cache:
+            return None   # duplicate in the same admission batch
+        owner = f"__prefix__{len(self._prefix_cache)}_" \
+            f"{time.monotonic_ns()}"
+        try:
+            pages = self.page_table.ensure_capacity(owner, aligned)
+        except MemoryError:
+            return None
+        entry = _PrefixEntry(
+            key=key, owner_id=owner, pages=list(pages), length=aligned,
+        )
+        entry.sessions.add(sess.id)
+        self._prefix_cache[key] = entry
+        self._prefix_lengths[aligned] += 1
+        sess.prefix_key = key
+        sess.prefix_pages = list(pages)
+        sess.prefix_len = aligned
+        return entry
+
+    def _release_session_prefix(self, sess: _Session) -> None:
+        if sess.prefix_key is None:
+            return
+        entry = self._prefix_cache.get(sess.prefix_key)
+        if entry is not None:
+            entry.sessions.discard(sess.id)
+            entry.last_used = time.monotonic()
+        sess.prefix_key = None
+        sess.prefix_pages = []
+        sess.prefix_len = 0
 
     def _admit(self) -> None:
         """Admission with batched prefill: queued turns that share a
@@ -472,6 +583,28 @@ class ServingEngine:
             turn.done.set()
             return None
 
+        # automatic prefix caching: a fresh session whose prompt starts
+        # with a cached page-aligned prefix references those read-only
+        # pages instead of re-prefilling them (the swarm's shared
+        # system prompts); a fresh long prompt with no hit registers
+        # its own prefix for the next session
+        register_entry: Optional[_PrefixEntry] = None
+        if sess.length == 0 and self.prefix_cache_min_pages > 0:
+            hit = self._prefix_lookup(prompt)
+            if hit is not None:
+                hit.sessions.add(sess.id)
+                hit.last_used = time.monotonic()
+                sess.prefix_key = hit.key
+                sess.prefix_pages = list(hit.pages)
+                sess.prefix_len = hit.length
+                sess.length = hit.length
+                sess.history = list(prompt[: hit.length])
+                prompt = prompt[hit.length:]
+                self._stats["prefix_hits"] += 1
+                self._stats["prefix_tokens_reused"] += hit.length
+            else:
+                register_entry = self._prefix_register(sess, prompt)
+
         # long prompts prefill in fixed-width chunks through the
         # KV-continuation path, so compile widths and activation memory
         # are bounded by prefill_chunk regardless of prompt length; only
@@ -508,14 +641,36 @@ class ServingEngine:
             turn.done.set()
             return None
 
-        pages = self._ensure_capacity_evicting(
-            sess.id, sess.length + pre_total + bucket
-        )
+        own_target = sess.length + pre_total + bucket - sess.prefix_len
+        try:
+            pages = self._ensure_capacity_evicting(sess.id, own_target)
+        except MemoryError:
+            # roll the prefix state back so the requeued turn
+            # re-prepares from scratch (hit: undo the consumed prefix;
+            # registration: free the cache-owned pages)
+            if sess.prefix_key is not None:
+                key = sess.prefix_key
+                self._release_session_prefix(sess)
+                entry = self._prefix_cache.get(key)
+                if entry is not None and not entry.ready and \
+                        not entry.sessions:
+                    self.page_table.release(entry.owner_id)
+                    del self._prefix_cache[key]
+                    self._prefix_lengths[entry.length] -= 1
+                    if self._prefix_lengths[entry.length] <= 0:
+                        del self._prefix_lengths[entry.length]
+                sess.length = 0
+                sess.history = []
+            raise
         sess.pending = None
-        if restoring:
+        if restoring and sess.length == 0:
+            # a prefix HIT already rebuilt history as prompt[:L] (and
+            # set length=L); every other restore path starts clean and
+            # lets the prefill bookkeeping re-fill the mirror
             sess.history = []
         table = np.zeros((self.max_pages_per_seq,), np.int32)
-        table[: len(pages)] = pages
+        all_pages = sess.prefix_pages + pages
+        table[: len(all_pages)] = all_pages
         for chunk_toks in pre_chunks:
             self._prefill_write_chunk(sess, chunk_toks, table)
         return {
@@ -615,6 +770,11 @@ class ServingEngine:
             self._stats["prefill_tokens"] += len(prep["prompt"])
             sess.length += len(prep["prompt"])
             sess.history.extend(prep["prompt"])
+            # a prefix this session registered is fully written now
+            if sess.prefix_key is not None:
+                entry = self._prefix_cache.get(sess.prefix_key)
+                if entry is not None:
+                    entry.ready = True
             self._slot_tables[slot] = prep["table"]
             self._slot_lengths[slot] = sess.length
             self._active[slot] = turn
@@ -642,24 +802,29 @@ class ServingEngine:
                 sess.length + min(chunk, remaining), capacity
             )
             try:
-                pages = self._ensure_capacity_evicting(sess.id, target)
+                pages = self._ensure_capacity_evicting(
+                    sess.id, target - sess.prefix_len
+                )
             except MemoryError:
                 # degrade to single-token pacing before giving up: a turn
                 # finishing within its current pages must not die because
                 # the full chunk couldn't be reserved
                 try:
                     pages = self._ensure_capacity_evicting(
-                        sess.id, min(sess.length + 1, capacity)
+                        sess.id,
+                        min(sess.length + 1, capacity)
+                        - sess.prefix_len,
                     )
                 except MemoryError as e:
                     turn.error = str(e)
                     self._finish_turn(i, turn, "error")
                     active_idx.remove(i)
                     continue
-            self._slot_tables[i, : len(pages)] = pages
+            all_pages = sess.prefix_pages + pages
+            self._slot_tables[i, : len(all_pages)] = all_pages
             # stale entries from a previous occupant of this slot must
             # never receive overrun writes — point them at scratch
-            self._slot_tables[i, len(pages):] = 0
+            self._slot_tables[i, len(all_pages):] = 0
             self._slot_lengths[i] = sess.length
         if not active_idx:
             return 0
@@ -759,6 +924,7 @@ class ServingEngine:
         if sess.id in self._deferred_release:
             self._deferred_release.discard(sess.id)
             self.sessions.pop(sess.id, None)
+            self._release_session_prefix(sess)
             self.page_table.release(sess.id)
         turn.done.set()
 

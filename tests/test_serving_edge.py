@@ -379,3 +379,110 @@ def test_chunked_prefill_resume_continuation(setup):
         return t1.new_tokens, t2.new_tokens
 
     assert run(0) == run(12)
+
+
+# ---- automatic prefix caching ----
+
+def _shared_prompts():
+    shared = [(i * 3) % 90 + 1 for i in range(17)]   # aligned 16 @ page 4
+    return shared + [7, 8], shared + [70, 71, 72]
+
+
+def test_prefix_cache_hit_token_identical(setup):
+    """Second session sharing a long prompt prefix reuses the cached
+    pages: same tokens as an uncached engine, fewer prefill tokens."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    p1, p2 = _shared_prompts()
+
+    off = make_engine(cfg, params, n_pages=64)
+    off.prefix_cache_min_pages = 0
+    a_off = off.submit(p1, session_id="a", sampling=sp)
+    off.run_until_idle()
+    b_off = off.submit(p2, session_id="b", sampling=sp)
+    off.run_until_idle()
+
+    on = make_engine(cfg, params, n_pages=64)
+    on.prefix_cache_min_pages = 2
+    a_on = on.submit(p1, session_id="a", sampling=sp)
+    on.run_until_idle()
+    b_on = on.submit(p2, session_id="b", sampling=sp)
+    on.run_until_idle()
+
+    assert a_on.new_tokens == a_off.new_tokens
+    assert b_on.new_tokens == b_off.new_tokens
+    st = on.stats()
+    assert st["prefix_hits"] == 1
+    assert st["prefix_tokens_reused"] == 16
+    # the hit prefilled only the unshared tail
+    assert st["prefill_tokens"] < off.stats()["prefill_tokens"]
+
+
+def test_prefix_cache_share_pages_accounting(setup):
+    """Cached prefix pages are owned once: two sessions referencing
+    them hold fewer pool pages than two full copies."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=3)
+    p1, p2 = _shared_prompts()
+
+    on = make_engine(cfg, params, n_pages=64)
+    on.prefix_cache_min_pages = 2
+    on.submit(p1, session_id="a", sampling=sp)
+    on.run_until_idle()
+    free_after_first = on.page_table.free_pages
+    on.submit(p2, session_id="b", sampling=sp)
+    on.run_until_idle()
+    # session b added only bucket-padded tail pages (4 @ page_size 4),
+    # NOT another copy of the 4-page prefix + tail (the uncached cost:
+    # 19 tokens -> bucket 32 -> 8 pages)
+    assert free_after_first - on.page_table.free_pages <= 4
+
+    # released sessions return their own pages; the prefix entry stays
+    # cached (refcount 0) until pool pressure evicts it
+    on.release_session("a")
+    on.release_session("b")
+    assert len(on._prefix_cache) == 1
+    entry = next(iter(on._prefix_cache.values()))
+    assert not entry.sessions and entry.ready
+
+
+def test_prefix_cache_evicted_under_pressure(setup):
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=3)
+    p1, _ = _shared_prompts()
+    eng = make_engine(cfg, params, max_batch=1, n_pages=14)
+    eng.prefix_cache_min_pages = 2
+    eng.submit(p1, session_id="a", sampling=sp)
+    eng.run_until_idle()
+    eng.release_session("a")
+    assert len(eng._prefix_cache) == 1
+    # one big new session needs more pages than remain free, and no
+    # idle session exists to evict: the orphaned prefix must go
+    t = eng.submit([300] * 33, session_id="big", sampling=sp)
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length"), t.error
+    assert eng.stats()["prefix_evictions"] >= 1
+
+
+def test_prefix_hit_session_survives_own_eviction(setup):
+    """A session that used a cached prefix, got evicted, and resumes:
+    restore re-hits the cache and stays token-identical."""
+    cfg, params = setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=3)
+    p1, p2 = _shared_prompts()
+
+    def run(n_pages):
+        eng = make_engine(cfg, params, max_batch=1, n_pages=n_pages)
+        eng.prefix_cache_min_pages = 2
+        eng.submit(p1, session_id="keep", sampling=sp)
+        eng.run_until_idle()
+        for i in range(2):
+            eng.submit([150 + i] * 9, session_id=f"fill{i}",
+                       sampling=sp)
+            eng.run_until_idle()
+        t = eng.submit([5, 6], session_id="keep", sampling=sp)
+        eng.run_until_idle()
+        assert t.finish_reason in ("stop", "length"), t.error
+        return t.new_tokens
+
+    assert run(n_pages=15) == run(n_pages=64)
