@@ -141,6 +141,18 @@ def pause_agent(worker_id: int) -> bool:
     return True
 
 
+def stop_worker_loop(worker_id: int) -> bool:
+    """Stop one worker's loop thread (reference: per-worker stop route
+    routes/workers.ts)."""
+    with _registry_lock:
+        handle = _running_loops.get(worker_id)
+    if handle is None:
+        return False
+    handle.stop.set()
+    handle.wake.set()
+    return True
+
+
 def stop_room_loops(db: Database, room_id: int, reason: str = "") -> int:
     set_room_launch_enabled(room_id, False)
     n = 0

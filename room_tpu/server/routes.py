@@ -37,6 +37,10 @@ def _room_or_404(ctx: RequestContext):
 
 
 def register_all_routes(r: Router) -> None:
+    # literal paths that would otherwise be shadowed by ':param'
+    # patterns (e.g. /api/memory/entities vs /api/memory/:id) register
+    # first — the router matches in registration order
+    register_extended_routes(r)
     register_room_routes(r)
     register_worker_routes(r)
     register_goal_routes(r)
@@ -54,6 +58,356 @@ def register_all_routes(r: Router) -> None:
     register_provider_routes(r)
     register_contact_routes(r)
     register_aux_routes(r)
+
+
+def register_extended_routes(r: Router) -> None:
+    """Detail/patch endpoints matching the remainder of the reference's
+    route surface (reference: src/server/routes/{goals,decisions,
+    memory,messages,workers,runs,rooms,settings,tasks,clerk}.ts)."""
+
+    # -- goals --
+    def get_goal_detail(ctx):
+        g = goals_mod.get_goal(ctx.db, int(ctx.params["id"]))
+        if g is None:
+            return err("goal not found", 404)
+        g["updates"] = ctx.db.query(
+            "SELECT * FROM goal_updates WHERE goal_id=? ORDER BY id",
+            (g["id"],),
+        )
+        g["subgoals"] = ctx.db.query(
+            "SELECT * FROM goals WHERE parent_goal_id=? ORDER BY id",
+            (g["id"],),
+        )
+        return ok(g)
+
+    def add_goal_update_route(ctx):
+        b = ctx.body or {}
+        if goals_mod.get_goal(ctx.db, int(ctx.params["id"])) is None:
+            return err("goal not found", 404)
+        goals_mod.add_goal_update(
+            ctx.db, int(ctx.params["id"]),
+            b.get("update") or b.get("content") or "",
+            worker_id=b.get("workerId"),
+            metric_value=b.get("progress"),
+        )
+        return ok(goals_mod.get_goal(ctx.db, int(ctx.params["id"])),
+                  201)
+
+    def patch_goal(ctx):
+        gid = int(ctx.params["id"])
+        g = goals_mod.get_goal(ctx.db, gid)
+        if g is None:
+            return err("goal not found", 404)
+        b = ctx.body or {}
+        if "description" in b:
+            ctx.db.execute(
+                "UPDATE goals SET description=? WHERE id=?",
+                (b["description"], gid),
+            )
+        if "workerId" in b:
+            goals_mod.assign_goal(ctx.db, gid, b["workerId"])
+        if "progress" in b:
+            goals_mod.set_goal_progress(
+                ctx.db, gid, float(b["progress"])
+            )
+        return ok(goals_mod.get_goal(ctx.db, gid))
+
+    def delete_goal(ctx):
+        gid = int(ctx.params["id"])
+        if goals_mod.get_goal(ctx.db, gid) is None:
+            return err("goal not found", 404)
+        ctx.db.execute("DELETE FROM goals WHERE id=?", (gid,))
+        return ok({"deleted": gid})
+
+    r.get("/api/goals/:id", get_goal_detail)
+    r.post("/api/goals/:id/updates", add_goal_update_route)
+    r.put("/api/goals/:id", patch_goal)
+    r.delete("/api/goals/:id", delete_goal)
+
+    # -- decisions --
+    def get_decision_detail(ctx):
+        d = quorum_mod.get_decision(ctx.db, int(ctx.params["id"]))
+        if d is None:
+            return err("decision not found", 404)
+        d["votes"] = ctx.db.query(
+            "SELECT * FROM quorum_votes WHERE decision_id=? ORDER BY id",
+            (d["id"],),
+        )
+        d["tally"] = quorum_mod.tally(ctx.db, d["id"])
+        return ok(d)
+
+    def decision_votes(ctx):
+        return ok(ctx.db.query(
+            "SELECT * FROM quorum_votes WHERE decision_id=? ORDER BY id",
+            (int(ctx.params["id"]),),
+        ))
+
+    def create_decision(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        b = ctx.body or {}
+        if not b.get("proposal"):
+            return err("proposal is required")
+        try:
+            d = quorum_mod.announce(
+                ctx.db, room["id"], b.get("proposerId"),
+                b["proposal"],
+                decision_type=b.get("decisionType", "low_impact"),
+            )
+        except quorum_mod.QuorumError as ex:
+            return err(str(ex))
+        return ok(d, 201)
+
+    def resolve_decision(ctx):
+        """Keeper force-resolve (reference: decisions.ts resolve)."""
+        did = int(ctx.params["id"])
+        d = quorum_mod.get_decision(ctx.db, did)
+        if d is None:
+            return err("decision not found", 404)
+        if d["status"] not in ("announced", "voting"):
+            return err(f"decision already {d['status']}", 409)
+        approve = bool((ctx.body or {}).get("approve", True))
+        quorum_mod._resolve(
+            ctx.db, did,
+            "effective" if approve else "rejected",
+            "Resolved by keeper",
+        )
+        return ok(quorum_mod.get_decision(ctx.db, did))
+
+    r.get("/api/decisions/:id", get_decision_detail)
+    r.get("/api/decisions/:id/votes", decision_votes)
+    r.post("/api/rooms/:id/decisions", create_decision)
+    r.post("/api/decisions/:id/resolve", resolve_decision)
+
+    # -- memory graph --
+    def list_entities(ctx):
+        room_id = ctx.query.get("roomId")
+        limit = int(ctx.query.get("limit", "100"))
+        return ok(ctx.db.query(
+            "SELECT * FROM entities "
+            + ("WHERE room_id=? " if room_id else "")
+            + "ORDER BY id DESC LIMIT ?",
+            ((int(room_id), limit) if room_id else (limit,)),
+        ))
+
+    def memory_stats(ctx):
+        return ok({
+            "entities": ctx.db.query_one(
+                "SELECT COUNT(*) AS n FROM entities")["n"],
+            "observations": ctx.db.query_one(
+                "SELECT COUNT(*) AS n FROM observations")["n"],
+            "relations": ctx.db.query_one(
+                "SELECT COUNT(*) AS n FROM relations")["n"],
+            "embedded": ctx.db.query_one(
+                "SELECT COUNT(*) AS n FROM embeddings")["n"],
+        })
+
+    def add_observation_route(ctx):
+        eid = int(ctx.params["id"])
+        if memory_mod.get_entity(ctx.db, eid) is None:
+            return err("entity not found", 404)
+        content = (ctx.body or {}).get("content")
+        if not content:
+            return err("content is required")
+        oid = memory_mod.add_observation(ctx.db, eid, content)
+        return ok({"observationId": oid}, 201)
+
+    def add_relation_route(ctx):
+        b = ctx.body or {}
+        for field in ("fromId", "toId", "relationType"):
+            if not b.get(field):
+                return err(f"{field} is required")
+        rid = memory_mod.create_relation(
+            ctx.db, int(b["fromId"]), int(b["toId"]),
+            b["relationType"],
+        )
+        return ok({"relationId": rid}, 201)
+
+    def delete_observation(ctx):
+        ctx.db.execute(
+            "DELETE FROM observations WHERE id=?",
+            (int(ctx.params["id"]),),
+        )
+        return ok({"deleted": int(ctx.params["id"])})
+
+    def delete_relation(ctx):
+        ctx.db.execute(
+            "DELETE FROM relations WHERE id=?",
+            (int(ctx.params["id"]),),
+        )
+        return ok({"deleted": int(ctx.params["id"])})
+
+    r.get("/api/memory/entities", list_entities)
+    r.get("/api/memory/stats", memory_stats)
+    r.post("/api/memory/entities/:id/observations",
+           add_observation_route)
+    r.post("/api/memory/relations", add_relation_route)
+    r.delete("/api/memory/observations/:id", delete_observation)
+    r.delete("/api/memory/relations/:id", delete_relation)
+
+    # -- messages --
+    def get_message(ctx):
+        m = ctx.db.query_one(
+            "SELECT * FROM room_messages WHERE id=?",
+            (int(ctx.params["id"]),),
+        )
+        return ok(m) if m else err("message not found", 404)
+
+    def delete_message(ctx):
+        ctx.db.execute(
+            "DELETE FROM room_messages WHERE id=?",
+            (int(ctx.params["id"]),),
+        )
+        return ok({"deleted": int(ctx.params["id"])})
+
+    def read_all_messages(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        ctx.db.execute(
+            "UPDATE room_messages SET status='read' WHERE room_id=? "
+            "AND status='unread'",
+            (room["id"],),
+        )
+        return ok({"ok": True})
+
+    r.get("/api/messages/:id", get_message)
+    r.delete("/api/messages/:id", delete_message)
+    r.post("/api/rooms/:id/messages/read-all", read_all_messages)
+
+    # -- workers / runs / rooms --
+    def list_all_workers(ctx):
+        return ok(ctx.db.query(
+            "SELECT * FROM workers ORDER BY room_id, id"
+        ))
+
+    def stop_worker(ctx):
+        w = workers_mod.get_worker(ctx.db, int(ctx.params["id"]))
+        if w is None:
+            return err("worker not found", 404)
+        from ..core.agent_loop import stop_worker_loop
+
+        stopped = stop_worker_loop(w["id"])
+        return ok({"stopped": stopped})
+
+    def list_runs(ctx):
+        return ok(ctx.db.query(
+            "SELECT * FROM task_runs ORDER BY id DESC LIMIT ?",
+            (int(ctx.query.get("limit", "50")),),
+        ))
+
+    def room_queen(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        if not room["queen_worker_id"]:
+            return err("room has no queen", 404)
+        return ok(workers_mod.get_worker(
+            ctx.db, room["queen_worker_id"]
+        ))
+
+    def restart_room(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        if ctx.runtime is None:
+            return err("runtime not running", 503)
+        ctx.runtime.stop_room(room["id"])
+        started = ctx.runtime.start_room(room["id"])
+        return ok({"restarted": started})
+
+    def queen_states(ctx):
+        out = {}
+        for room in rooms_mod.list_rooms(ctx.db):
+            qid = room["queen_worker_id"]
+            queen = workers_mod.get_worker(ctx.db, qid) if qid else None
+            out[str(room["id"])] = {
+                "queenWorkerId": qid,
+                "state": queen["agent_state"] if queen else None,
+            }
+        return ok(out)
+
+    def room_selfmod(ctx):
+        room, e = _room_or_404(ctx)
+        if e:
+            return e
+        return ok(ctx.db.query(
+            "SELECT * FROM self_mod_audit WHERE room_id=? "
+            "ORDER BY id DESC",
+            (room["id"],),
+        ))
+
+    r.get("/api/workers", list_all_workers)
+    r.post("/api/workers/:id/stop", stop_worker)
+    r.get("/api/runs", list_runs)
+    r.get("/api/rooms/:id/queen", room_queen)
+    r.post("/api/rooms/:id/restart", restart_room)
+    r.get("/api/rooms/queen-states", queen_states)
+    r.get("/api/rooms/:id/self-mod", room_selfmod)
+
+    # -- settings / tasks / clerk --
+    def get_setting_route(ctx):
+        value = messages_mod.get_setting(ctx.db, ctx.params["key"])
+        return ok({"key": ctx.params["key"], "value": value})
+
+    def put_setting_route(ctx):
+        value = (ctx.body or {}).get("value")
+        messages_mod.set_setting(
+            ctx.db, ctx.params["key"],
+            "" if value is None else str(value),
+        )
+        return ok({"key": ctx.params["key"], "value": value})
+
+    def patch_task(ctx):
+        tid = int(ctx.params["id"])
+        if task_runner.get_task(ctx.db, tid) is None:
+            return err("task not found", 404)
+        b = ctx.body or {}
+        fields = {"name": "name", "prompt": "prompt",
+                  "cronExpression": "cron_expression",
+                  "description": "description"}
+        for api_key, col in fields.items():
+            if api_key in b:
+                ctx.db.execute(
+                    f"UPDATE tasks SET {col}=? WHERE id=?",
+                    (b[api_key], tid),
+                )
+        return ok(task_runner.get_task(ctx.db, tid))
+
+    def reset_task_session(ctx):
+        tid = int(ctx.params["id"])
+        if task_runner.get_task(ctx.db, tid) is None:
+            return err("task not found", 404)
+        ctx.db.execute(
+            "UPDATE tasks SET session_id=NULL WHERE id=?", (tid,),
+        )
+        return ok(task_runner.get_task(ctx.db, tid))
+
+    def clerk_status(ctx):
+        last = ctx.db.query_one(
+            "SELECT * FROM clerk_messages ORDER BY id DESC LIMIT 1"
+        )
+        usage = ctx.db.query_one(
+            "SELECT COUNT(*) AS turns FROM clerk_usage"
+        )
+        return ok({
+            "lastMessageAt": last["created_at"] if last else None,
+            "messages": ctx.db.query_one(
+                "SELECT COUNT(*) AS n FROM clerk_messages")["n"],
+            "turns": usage["turns"] if usage else 0,
+        })
+
+    def clerk_reset(ctx):
+        ctx.db.execute("DELETE FROM clerk_messages")
+        return ok({"ok": True})
+
+    r.get("/api/settings/:key", get_setting_route)
+    r.put("/api/settings/:key", put_setting_route)
+    r.put("/api/tasks/:id", patch_task)
+    r.post("/api/tasks/:id/reset-session", reset_task_session)
+    r.get("/api/clerk/status", clerk_status)
+    r.post("/api/clerk/reset", clerk_reset)
 
 
 def register_contact_routes(r: Router) -> None:

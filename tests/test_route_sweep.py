@@ -75,6 +75,21 @@ BODIES = {
     ("POST", "/api/tpu/apply"): {"model": "tiny-moe"},
     ("POST", "/api/self-mod/:id/revert"): {},
     ("POST", "/api/update/check"): {},
+    ("POST", "/api/goals/:id/updates"): {"update": "making progress"},
+    ("PUT", "/api/goals/:id"): {"progress": 0.5},
+    ("POST", "/api/rooms/:id/decisions"): {"proposal": "swept decision"},
+    ("POST", "/api/decisions/:id/resolve"): {"approve": False},
+    ("POST", "/api/memory/entities/:id/observations"):
+        {"content": "observed"},
+    ("POST", "/api/memory/relations"):
+        {"fromId": 1, "toId": 1, "relationType": "related_to"},
+    ("POST", "/api/rooms/:id/messages/read-all"): {},
+    ("PUT", "/api/settings/:key"): {"value": "v"},
+    ("PUT", "/api/tasks/:id"): {"name": "renamed"},
+    ("POST", "/api/tasks/:id/reset-session"): {},
+    ("POST", "/api/clerk/reset"): {},
+    ("POST", "/api/workers/:id/stop"): {},
+    ("POST", "/api/rooms/:id/restart"): {},
 }
 
 # routes where a non-2xx is the correct answer for the seeded state
@@ -104,6 +119,8 @@ EXPECTED_NON_2XX = {
     ("POST", "/api/update/check"),             # may 200 w/ error diag
     ("GET", "/api/cycles/:cycle_id/logs"),     # no cycles seeded (may 200 [])
     ("DELETE", "/api/workers/:id"),            # worker 1 is the queen (409)
+    ("POST", "/api/decisions/:id/resolve"),    # already auto-approved (409)
+    ("POST", "/api/rooms/:id/restart"),        # no runtime attached (503)
 }
 
 
