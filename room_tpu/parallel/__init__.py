@@ -7,6 +7,7 @@ from .mesh import (
     page_cache_specs,
     shard_pytree,
 )
+from .multihost import initialize_multihost, make_global_mesh
 from .pipeline import (
     pipeline_forward,
     pipeline_spec,
@@ -14,6 +15,8 @@ from .pipeline import (
 )
 
 __all__ = [
+    "initialize_multihost",
+    "make_global_mesh",
     "pipeline_forward",
     "pipeline_spec",
     "shard_params_for_pipeline",

@@ -1,0 +1,82 @@
+"""Multi-host initialization: the DCN story (SURVEY §2.7 — the role the
+reference family delegates to NCCL/MPI launchers; here it is
+`jax.distributed` + XLA collectives, which ride ICI within a slice and
+DCN across hosts).
+
+One call per process, before any other JAX use:
+
+    initialize_multihost()            # env-driven (see below)
+    mesh = make_global_mesh(MeshSpec(dp=2, ep=2, tp=2))
+
+Env contract (mirrors the usual TPU pod launcher variables):
+    ROOM_TPU_COORDINATOR   host:port of process 0
+    ROOM_TPU_NUM_PROCESSES world size
+    ROOM_TPU_PROCESS_ID    this process's rank
+
+After initialization `jax.devices()` is GLOBAL (every host's chips);
+meshes built from it produce programs whose collectives span hosts —
+the same `psum`/`all_gather`/`ppermute` code that runs single-host.
+Data order note: `make_global_mesh` keeps device order host-major so
+the dp axis splits across hosts first (gradient all-reduce inside a
+host rides ICI; only the cross-host slice crosses DCN).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXES, MeshSpec
+
+
+def initialize_multihost(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Idempotent `jax.distributed.initialize` from args or env.
+    Returns True when multi-process mode is active (False = single
+    process, nothing to do)."""
+    coordinator = coordinator or os.environ.get("ROOM_TPU_COORDINATOR")
+    if num_processes is None:
+        raw = os.environ.get("ROOM_TPU_NUM_PROCESSES")
+        num_processes = int(raw) if raw else None
+    if process_id is None:
+        raw = os.environ.get("ROOM_TPU_PROCESS_ID")
+        process_id = int(raw) if raw else None
+
+    if not coordinator or not num_processes or num_processes <= 1:
+        return False
+    # probe initialization state WITHOUT jax.process_count(): that
+    # would initialize the XLA backend, after which distributed
+    # initialize refuses to run
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "coordinator_address", None):
+        return True  # already initialized
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id or 0,
+    )
+    return True
+
+
+def make_global_mesh(spec: MeshSpec) -> Mesh:
+    """dp/ep/tp mesh over the GLOBAL device list, host-major so model
+    axes (ep/tp) stay within a host wherever the shape allows —
+    their collectives are latency-sensitive and belong on ICI."""
+    devs = jax.devices()
+    if len(devs) < spec.n_devices:
+        raise ValueError(
+            f"mesh {spec} needs {spec.n_devices} devices, have "
+            f"{len(devs)} across {jax.process_count()} processes"
+        )
+    arr = np.array(devs[: spec.n_devices]).reshape(
+        spec.dp, spec.ep, spec.tp
+    )
+    return Mesh(arr, AXES)

@@ -1,0 +1,94 @@
+"""One rank of the multi-host smoke test (spawned by
+tests/test_multihost.py with ROOM_TPU_COORDINATOR / NUM_PROCESSES /
+PROCESS_ID set): initializes jax.distributed, checks the global device
+view, runs a cross-process psum, then ONE full sharded training step
+over the global dp mesh — the multi-host path of SURVEY §2.7's
+distributed backend, exercised with REAL separate processes."""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), "..", "..")
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from room_tpu.models.config import tiny_moe  # noqa: E402
+from room_tpu.parallel import (  # noqa: E402
+    MeshSpec, decoder_param_specs, shard_pytree,
+)
+from room_tpu.parallel.multihost import (  # noqa: E402
+    initialize_multihost, make_global_mesh,
+)
+from room_tpu.train import init_train_state, make_train_step  # noqa: E402
+
+
+def main() -> None:
+    assert initialize_multihost(), "env-driven init failed"
+    rank = jax.process_index()
+    n_local = len(jax.local_devices())
+    n_global = len(jax.devices())
+    assert jax.process_count() == 2
+    assert n_global == 2 * n_local
+
+    # 1. cross-process psum: every device contributes global_index + 1
+    mesh = make_global_mesh(MeshSpec(dp=n_global, ep=1, tp=1))
+    fn = jax.shard_map(
+        lambda x: jax.lax.psum(x, ("dp", "ep", "tp")),
+        mesh=mesh,
+        in_specs=P(("dp", "ep", "tp")),
+        out_specs=P(),
+    )
+    local_vals = np.array(
+        [i + 1.0 for i in range(rank * n_local, (rank + 1) * n_local)],
+        np.float32,
+    )
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(("dp", "ep", "tp"))),
+        local_vals, (n_global,),
+    )
+    got = float(np.asarray(fn(garr).addressable_data(0)))
+    want = n_global * (n_global + 1) / 2
+    assert got == want, (got, want)
+    print(f"RANK{rank} psum OK ({got})", flush=True)
+
+    # 2. one sharded training step with the batch dp-split ACROSS the
+    # two processes (grad all-reduce crosses the process boundary)
+    cfg = tiny_moe()
+    spec = MeshSpec(dp=n_global, ep=1, tp=1)
+    tmesh = make_global_mesh(spec)
+    state, tx = init_train_state(cfg, jax.random.PRNGKey(0))
+    state.params = shard_pytree(
+        state.params, decoder_param_specs(cfg), tmesh
+    )
+    state.opt_state = tx.init(state.params)
+    train_step = jax.jit(make_train_step(cfg, tx), donate_argnums=(0,))
+
+    batch, seq = n_global, 16
+    rng = np.random.default_rng(0)   # same data on both ranks
+    tokens_all = rng.integers(
+        0, cfg.vocab_size, (batch, seq)
+    ).astype(np.int32)
+    mask_all = np.ones((batch, seq), np.float32)
+    tok_shard = NamedSharding(tmesh, P("dp", None))
+    local_rows = slice(rank * (batch // 2), (rank + 1) * (batch // 2))
+    tokens = jax.make_array_from_process_local_data(
+        tok_shard, tokens_all[local_rows], (batch, seq)
+    )
+    mask = jax.make_array_from_process_local_data(
+        tok_shard, mask_all[local_rows], (batch, seq)
+    )
+    state, loss = train_step(state, tokens, mask)
+    loss_val = float(np.asarray(
+        jax.device_get(loss)
+    ))
+    assert np.isfinite(loss_val)
+    print(f"RANK{rank} train OK loss={loss_val:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
