@@ -10,6 +10,13 @@ import time
 
 
 def cmd_serve(args: argparse.Namespace) -> int:
+    # multi-host pods: jax.distributed must initialize before anything
+    # touches an XLA backend, so this runs before the server imports
+    # (env contract: ROOM_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID)
+    from ..parallel.multihost import initialize_multihost
+
+    initialize_multihost()
+
     from ..server.app import start_server
 
     app = start_server(port=args.port, install_signal_handlers=True)
