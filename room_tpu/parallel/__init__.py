@@ -4,7 +4,9 @@ from .mesh import (
     encoder_param_specs,
     kv_cache_specs,
     make_mesh,
+    make_submesh,
     page_cache_specs,
+    parse_mesh_spec,
     shard_pytree,
 )
 from .multihost import initialize_multihost, make_global_mesh
@@ -25,6 +27,8 @@ __all__ = [
     "encoder_param_specs",
     "kv_cache_specs",
     "make_mesh",
+    "make_submesh",
     "page_cache_specs",
+    "parse_mesh_spec",
     "shard_pytree",
 ]

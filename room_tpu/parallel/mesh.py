@@ -55,6 +55,33 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
+def parse_mesh_spec(value: str) -> tuple[MeshSpec, int]:
+    """Parse a mesh env string ``"dp,ep,tp"`` or ``"dp,ep,tp@start"``.
+
+    The optional ``@start`` selects a device offset so independent models
+    can occupy disjoint submeshes of one pod — the hetero-swarm layout
+    (BASELINE.md config #5: the 72B queen on one slice of chips, 30B
+    workers on the rest). Returns (spec, device_start).
+    """
+    body, _, off = value.partition("@")
+    dp, ep, tp = (int(x) for x in body.split(","))
+    start = int(off) if off else 0
+    if start < 0:
+        raise ValueError(f"negative device offset in mesh spec {value!r}")
+    return MeshSpec(dp, ep, tp), start
+
+
+def make_submesh(spec: MeshSpec, start: int) -> Mesh:
+    """Mesh over the device window [start, start+n) of jax.devices()."""
+    devs = jax.devices()
+    if start + spec.n_devices > len(devs):
+        raise ValueError(
+            f"submesh {spec}@{start} needs devices "
+            f"[{start},{start + spec.n_devices}), have {len(devs)}"
+        )
+    return make_mesh(spec, devs[start:start + spec.n_devices])
+
+
 # ---- sharding rules ----
 
 def decoder_param_specs(cfg: DecoderConfig) -> dict[str, Any]:

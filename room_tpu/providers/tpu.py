@@ -55,6 +55,21 @@ def checkpoint_dir(name: str) -> Optional[str]:
     return path if os.path.isdir(path) else None
 
 
+def mesh_env_for(name: str) -> Optional[str]:
+    """Resolve the mesh spec string for a model: a per-model override
+    (``ROOM_TPU_MESH_QWEN2_5_72B="1,1,4@0"``) wins over the global
+    ``ROOM_TPU_MESH``. The ``@start`` device offset lets the hetero swarm
+    place the queen and worker models on disjoint submeshes of one pod
+    (BASELINE.md config #5)."""
+    import re
+
+    slug = re.sub(r"[^A-Z0-9]", "_", name.upper())
+    return (
+        os.environ.get(f"ROOM_TPU_MESH_{slug}")
+        or os.environ.get("ROOM_TPU_MESH")
+    )
+
+
 class ModelHost:
     """One served model: engine + tokenizer + background scheduler."""
 
@@ -93,7 +108,8 @@ class ModelHost:
             import jax
 
             from ..parallel import (
-                MeshSpec, decoder_param_specs, make_mesh, shard_pytree,
+                decoder_param_specs, make_submesh, parse_mesh_spec,
+                shard_pytree,
             )
             from ..serving import ServingEngine, load_tokenizer
 
@@ -112,11 +128,11 @@ class ModelHost:
 
                 params = load_params(ckpt, like=params)
 
-            mesh_env = os.environ.get("ROOM_TPU_MESH")
+            mesh_env = mesh_env_for(self.name)
             mesh = None
             if mesh_env:
-                dp, ep, tp = (int(x) for x in mesh_env.split(","))
-                mesh = make_mesh(MeshSpec(dp, ep, tp))
+                spec, start = parse_mesh_spec(mesh_env)
+                mesh = make_submesh(spec, start)
                 params = shard_pytree(
                     params, decoder_param_specs(self.cfg), mesh
                 )

@@ -152,6 +152,69 @@ def test_engine_on_mesh_matches_single_device(engine_setup):
     assert shard_mesh.shape == mesh.shape
 
 
+def test_hetero_disjoint_submeshes(engine_setup):
+    """Hetero-swarm placement (BASELINE config #5): two engines on
+    disjoint device windows of one pod — params and KV pools must land
+    on non-overlapping device sets, and each engine's tokens must match
+    its unsharded twin."""
+    from room_tpu.parallel import (
+        MeshSpec, decoder_param_specs, make_submesh, parse_mesh_spec,
+        shard_pytree,
+    )
+
+    cfg, params = engine_setup
+    sp = SamplingParams(temperature=0.0, max_new_tokens=5)
+    prompts = [[1, 2, 3], [9, 8, 7, 6]]
+
+    spec_a, start_a = parse_mesh_spec("1,2,2@0")
+    spec_b, start_b = parse_mesh_spec("1,1,4@4")
+    assert (spec_a, start_a) == (MeshSpec(1, 2, 2), 0)
+    assert (spec_b, start_b) == (MeshSpec(1, 1, 4), 4)
+    mesh_a = make_submesh(spec_a, start_a)
+    mesh_b = make_submesh(spec_b, start_b)
+    devs_a = {d.id for d in mesh_a.devices.flat}
+    devs_b = {d.id for d in mesh_b.devices.flat}
+    assert not (devs_a & devs_b)
+
+    eng0 = make_engine(cfg, params)
+    want = [eng0.submit(p, sampling=sp) for p in prompts]
+    eng0.run_until_idle()
+    want = [t.new_tokens for t in want]
+
+    for mesh, devs in ((mesh_a, devs_a), (mesh_b, devs_b)):
+        sharded = shard_pytree(params, decoder_param_specs(cfg), mesh)
+        eng = make_engine(cfg, sharded, mesh=mesh)
+        got = [eng.submit(p, sampling=sp) for p in prompts]
+        eng.run_until_idle()
+        assert [t.new_tokens for t in got] == want
+        pool_devs = {
+            d.id for d in eng.cache["k_pages"].sharding.device_set
+        }
+        assert pool_devs <= devs
+
+    # a window past the device count must refuse, not wrap
+    with pytest.raises(ValueError):
+        make_submesh(MeshSpec(1, 1, 4), 6)
+
+
+def test_mesh_env_per_model_override(monkeypatch):
+    """ROOM_TPU_MESH_<SLUG> wins over the global ROOM_TPU_MESH, slugged
+    from the model name (dots/dashes -> underscores)."""
+    from room_tpu.providers.tpu import mesh_env_for
+
+    import os
+
+    monkeypatch.delenv("ROOM_TPU_MESH", raising=False)
+    for key in [k for k in os.environ if k.startswith("ROOM_TPU_MESH_")]:
+        monkeypatch.delenv(key, raising=False)
+    assert mesh_env_for("tiny-moe") is None
+    monkeypatch.setenv("ROOM_TPU_MESH", "2,2,2")
+    assert mesh_env_for("tiny-moe") == "2,2,2"
+    monkeypatch.setenv("ROOM_TPU_MESH_QWEN2_5_72B", "1,1,4@0")
+    assert mesh_env_for("qwen2.5-72b") == "1,1,4@0"
+    assert mesh_env_for("qwen3-coder-30b") == "2,2,2"
+
+
 def test_eviction_oversubscribed_pool(engine_setup):
     """12 sessions against a pool that holds ~3: LRU eviction must keep
     admission moving and every turn must complete (no MemoryError
