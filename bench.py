@@ -116,6 +116,17 @@ def main() -> None:
 
         cfg = dataclasses.replace(cfg, moe_impl=moe_env)
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    # ROOM_TPU_QUANT=int8 serves weight-only int8 (halves HBM bytes per
+    # decode step — the bandwidth-bound path's main lever)
+    quant = os.environ.get("ROOM_TPU_QUANT")
+    if quant:
+        if quant != "int8":
+            raise ValueError(
+                f"unknown ROOM_TPU_QUANT mode {quant!r} (supported: int8)"
+            )
+        from room_tpu.ops.quant import quantize_decoder_params
+
+        params = quantize_decoder_params(params, cfg)
     if cfg.moe_impl == "shardmap":
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -126,6 +137,8 @@ def main() -> None:
         mesh = Mesh(np.array(devs).reshape(len(devs)), ("ep",))
         set_ep_mesh(mesh)
         for key in ("w_gate", "w_up", "w_down"):
+            # device_put maps over pytrees, so a QTensor's q and s
+            # (same rank, scale axis size-1) take the same spec
             params["layers"][key] = jax.device_put(
                 params["layers"][key],
                 NamedSharding(mesh, P(None, "ep", None, None)),
@@ -179,6 +192,8 @@ def main() -> None:
         "mfu_peak_tflops_assumed": peak_tflops,
         "flops_per_token": int(flops_tok),
     }
+    if quant:
+        extra["quant"] = quant
 
     # decode-attention backend comparison (Pallas paged kernel vs the
     # XLA gather reference) — only meaningful on real TPU hardware

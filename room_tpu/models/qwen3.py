@@ -31,6 +31,7 @@ from ..ops import (
     apply_rope, attention_ref, moe_ffn, moe_ffn_gshard, rms_norm,
     rope_angles, swiglu,
 )
+from ..ops.quant import QTensor, qeinsum
 from .config import DecoderConfig
 
 Params = dict[str, Any]
@@ -128,9 +129,9 @@ def _layer(
 ) -> tuple[jax.Array, Optional[Params]]:
     b, s, d = x.shape
     h = rms_norm(x, lp["ln1"], cfg.rms_eps)
-    q = jnp.einsum("bsd,de->bse", h, lp["wq"])
-    k = jnp.einsum("bsd,de->bse", h, lp["wk"])
-    v = jnp.einsum("bsd,de->bse", h, lp["wv"])
+    q = qeinsum("bsd,de->bse", h, lp["wq"])
+    k = qeinsum("bsd,de->bse", h, lp["wk"])
+    v = qeinsum("bsd,de->bse", h, lp["wv"])
     if cfg.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
@@ -168,7 +169,7 @@ def _layer(
         )
 
     attn = attn.reshape(b, s, cfg.n_heads * cfg.head_dim)
-    x = x + jnp.einsum("bse,ed->bsd", attn, lp["wo"])
+    x = x + qeinsum("bse,ed->bsd", attn, lp["wo"])
 
     h = rms_norm(x, lp["ln2"], cfg.rms_eps)
     if cfg.is_moe:
@@ -180,7 +181,7 @@ def _layer(
         if cfg.moe_impl == "shardmap":
             from ..ops.moe_shardmap import moe_ffn_shardmap_padded
 
-            moe = moe_ffn_shardmap_padded
+            moe = partial(moe_ffn_shardmap_padded, mesh_key=cfg.name)
         else:
             moe = moe_ffn_gshard if cfg.moe_impl == "gshard" \
                 else moe_ffn
@@ -222,7 +223,14 @@ def forward(
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-    x = params["embed"][tokens]
+    emb = params["embed"]
+    if isinstance(emb, QTensor):
+        # per-row scale: gather + scale is exact dequantization
+        x = (
+            emb.q[tokens].astype(jnp.float32) * emb.s[tokens]
+        ).astype(cfg.activation_dtype)
+    else:
+        x = emb[tokens]
     cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
     if kv_hook is not None:
@@ -300,8 +308,16 @@ def lm_head(params: Params, cfg: DecoderConfig,
     (what forward(apply_head=False) returns)."""
     head = params.get("lm_head")
     if head is None:
-        head = params["embed"].T
-    return jnp.einsum("bsd,dv->bsv", normed, head)
+        emb = params["embed"]
+        if isinstance(emb, QTensor):
+            # tied head: per-row embed scale lands on the vocab axis
+            y = jnp.einsum("bsd,vd->bsv", normed,
+                           emb.q.astype(normed.dtype))
+            return (
+                y.astype(jnp.float32) * emb.s.reshape(-1)
+            ).astype(normed.dtype)
+        return jnp.einsum("bsd,dv->bsv", normed, emb.T)
+    return qeinsum("bsd,dv->bsv", normed, head)
 
 
 def decode_step(

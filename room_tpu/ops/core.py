@@ -59,10 +59,13 @@ def apply_rope(
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
            w_down: jax.Array) -> jax.Array:
-    """SwiGLU FFN: down( silu(x@gate) * (x@up) )."""
-    g = jax.nn.silu(jnp.einsum("...d,df->...f", x, w_gate))
-    u = jnp.einsum("...d,df->...f", x, w_up)
-    return jnp.einsum("...f,fd->...d", g * u, w_down)
+    """SwiGLU FFN: down( silu(x@gate) * (x@up) ). Weights may be
+    int8 QTensors (quant.qeinsum applies the scale to each output)."""
+    from .quant import qeinsum
+
+    g = jax.nn.silu(qeinsum("...d,df->...f", x, w_gate))
+    u = qeinsum("...d,df->...f", x, w_up)
+    return qeinsum("...f,fd->...d", g * u, w_down)
 
 
 def attention_ref(
@@ -132,6 +135,8 @@ def moe_ffn(
     ``lax.ragged_dot`` (TPU grouped matmul), then combined with router
     weights. All shapes static: T*top_k rows regardless of routing.
     """
+    from .quant import qragged_dot
+
     t, d = x.shape
     e = router_w.shape[-1]
     weights, chosen = _route(x, router_w, top_k, renormalize)
@@ -141,12 +146,16 @@ def moe_ffn(
     token_of_row = order // top_k                 # source token per row
     xs = x[token_of_row]                          # [T*K, D] sorted by expert
     group_sizes = jnp.bincount(flat_expert, length=e)
+    eid_sorted = flat_expert[order]               # expert of each row
 
-    g = jax.lax.ragged_dot(xs, w_gate, group_sizes, precision=precision)
-    u = jax.lax.ragged_dot(xs, w_up, group_sizes, precision=precision)
+    g = qragged_dot(xs, w_gate, group_sizes, eid_sorted,
+                    precision=precision)
+    u = qragged_dot(xs, w_up, group_sizes, eid_sorted,
+                    precision=precision)
     h = jax.nn.silu(g) * u
-    y = jax.lax.ragged_dot(
-        h.astype(x.dtype), w_down, group_sizes, precision=precision
+    y = qragged_dot(
+        h.astype(x.dtype), w_down, group_sizes, eid_sorted,
+        precision=precision,
     )
 
     # scatter-add rows back to their tokens, weighted by router prob
@@ -242,14 +251,16 @@ def moe_ffn_gshard(
         "gtk,gtkec->gtec", gates_g.astype(jnp.float32), slot_onehot
     )
 
+    from .quant import qexpert_einsum
+
     xe = jnp.einsum(
         "gtec,gtd->gecd", dispatch, xg.astype(jnp.float32)
     ).astype(x.dtype)
-    g_ = jnp.einsum("gecd,edf->gecf", xe, w_gate)
-    u = jnp.einsum("gecd,edf->gecf", xe, w_up)
+    g_ = qexpert_einsum("gecd,edf->gecf", xe, w_gate)
+    u = qexpert_einsum("gecd,edf->gecf", xe, w_up)
     h = (jax.nn.silu(g_.astype(jnp.float32)) *
          u.astype(jnp.float32)).astype(x.dtype)
-    y = jnp.einsum("gecf,efd->gecd", h, w_down)
+    y = qexpert_einsum("gecf,efd->gecd", h, w_down)
     out = jnp.einsum(
         "gtec,gecd->gtd", combine, y.astype(jnp.float32)
     ).reshape(padded, d)[:t]

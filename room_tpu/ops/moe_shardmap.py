@@ -31,31 +31,37 @@ from .core import _route
 
 # mesh registry: the model layer (qwen3._layer) has no mesh argument —
 # the host that builds the mesh installs it here before tracing with
-# moe_impl="shardmap"
-_EP_MESH: Mesh | None = None
+# moe_impl="shardmap". Keyed by model/config name so hetero hosts on
+# disjoint submeshes don't clobber each other (lazy per-bucket retraces
+# would otherwise pick up whichever host registered last); key None is
+# the single-model default.
+_EP_MESHES: dict[str | None, Mesh] = {}
 
 
-def set_ep_mesh(mesh: Mesh | None) -> None:
-    global _EP_MESH
-    _EP_MESH = mesh
+def set_ep_mesh(mesh: Mesh | None, key: str | None = None) -> None:
+    if mesh is None:
+        _EP_MESHES.pop(key, None)
+    else:
+        _EP_MESHES[key] = mesh
 
 
-def get_ep_mesh() -> Mesh:
-    if _EP_MESH is None:
+def get_ep_mesh(key: str | None = None) -> Mesh:
+    mesh = _EP_MESHES.get(key) or _EP_MESHES.get(None)
+    if mesh is None:
         raise RuntimeError(
             "moe_impl='shardmap' needs set_ep_mesh(mesh) before tracing"
         )
-    return _EP_MESH
+    return mesh
 
 
 def moe_ffn_shardmap_padded(
     x: jax.Array, router_w, w_gate, w_up, w_down, *,
-    top_k: int, renormalize: bool = True,
+    top_k: int, renormalize: bool = True, mesh_key: str | None = None,
 ) -> jax.Array:
     """Model-layer entry: pads the token axis to a multiple of ep (the
     pad rows route but their outputs are sliced away), mesh from the
-    registry."""
-    mesh = get_ep_mesh()
+    registry (keyed per model for hetero hosts)."""
+    mesh = get_ep_mesh(mesh_key)
     ep = mesh.shape["ep"]
     t = x.shape[0]
     padded = -(-t // ep) * ep
@@ -129,16 +135,19 @@ def moe_ffn_shardmap(
         recv_eid = jax.lax.all_to_all(send_eid, axis, 0, 0)
 
         # grouped matmul over the local expert shard
+        from .quant import qragged_dot
+
         flat_x = recv_x.reshape(ep * cap, d)
         flat_eid = recv_eid.reshape(ep * cap)
         order = jnp.argsort(flat_eid)
         xs = flat_x[order]
         group_sizes = jnp.bincount(flat_eid, length=e_local)
-        g = jax.lax.ragged_dot(xs, wg_l, group_sizes)
-        u = jax.lax.ragged_dot(xs, wu_l, group_sizes)
+        eid_sorted = flat_eid[order]
+        g = qragged_dot(xs, wg_l, group_sizes, eid_sorted)
+        u = qragged_dot(xs, wu_l, group_sizes, eid_sorted)
         h = (jax.nn.silu(g.astype(jnp.float32)) *
              u.astype(jnp.float32)).astype(x_l.dtype)
-        y_sorted = jax.lax.ragged_dot(h, wd_l, group_sizes)
+        y_sorted = qragged_dot(h, wd_l, group_sizes, eid_sorted)
         y = jnp.zeros_like(y_sorted).at[order].set(y_sorted)
         y = y.reshape(ep, cap, d)
 
@@ -151,15 +160,25 @@ def moe_ffn_shardmap(
         )
         return out.astype(x_l.dtype)
 
+    from .quant import QTensor
+
+    def wspec(w):
+        # expert weights shard on E (axis 0); a QTensor's scale keeps
+        # the same rank (size-1 contracted axis) so it shards the same
+        base = P(axis, None, None)
+        if isinstance(w, QTensor):
+            return QTensor(q=base, s=base)
+        return base
+
     fn = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(
             P(axis, None),          # tokens sharded over ep
             P(None, None),          # router replicated
-            P(axis, None, None),    # expert weights sharded on E
-            P(axis, None, None),
-            P(axis, None, None),
+            wspec(w_gate),
+            wspec(w_up),
+            wspec(w_down),
         ),
         out_specs=P(axis, None),
     )

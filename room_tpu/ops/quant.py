@@ -1,0 +1,157 @@
+"""Weight-only int8 quantization for serving.
+
+Decode on TPU is HBM-bandwidth bound: every step streams the full weight
+set through the MXU, so halving the bytes per weight is the single
+biggest single-chip throughput lever (and halves the chips needed to
+*hold* a model — qwen3-coder-30B drops from ~60 GB to ~30 GB, v5e has
+16 GB/chip). The reference has no counterpart (its quantization lives
+inside Ollama's GGUF files, local-model.ts:3-5); here it is a first-class
+transform on the param pytree.
+
+Scheme: symmetric per-output-channel absmax int8. For a weight W
+contracted over axes A, the scale s = max|W| over A / 127 (keepdims), so
+``x @ W  ≈  (x @ W_q) * s`` exactly commutes — the matmul runs on the
+int8 tensor (XLA fuses the int8→bf16 convert into the dot's operand
+read, so only int8 bytes leave HBM) and the f32 scale is applied to the
+matmul *output*, preserving bf16 activation precision. Activations stay
+bf16 throughout (weight-only, AWQ-style without calibration).
+
+A quantized leaf is a ``QTensor`` NamedTuple (a pytree node), so
+sharding, scanning over stacked layers, jit, and donation all work
+unchanged; ``quantized_decoder_param_specs`` mirrors
+``parallel.mesh.decoder_param_specs`` for mesh placement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 weight + f32 scale. ``s`` keeps the weight's rank with
+    size-1 contracted axes, so stacked-layer leaves still lead with L
+    and slice correctly under ``lax.scan``."""
+
+    q: jax.Array  # int8, same shape as the original weight
+    s: jax.Array  # float32, size-1 on contracted axes
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, QTensor)
+
+
+def quantize_tensor(w: jax.Array, contract_axes: tuple[int, ...]) -> QTensor:
+    """Symmetric absmax int8 over the contraction axes (keepdims)."""
+    a = jnp.max(
+        jnp.abs(w.astype(jnp.float32)), axis=contract_axes, keepdims=True
+    )
+    s = jnp.maximum(a, 1e-12) / 127.0
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / s), -127, 127
+    ).astype(jnp.int8)
+    return QTensor(q=q, s=s)
+
+
+def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (w.q.astype(jnp.float32) * w.s).astype(dtype)
+
+
+def qeinsum(sub: str, x: jax.Array, w: Any) -> jax.Array:
+    """einsum over a 2D weight [in, out] (contraction on axis 0),
+    transparent to quantization: the scale [1, out] lands on the output."""
+    if isinstance(w, QTensor):
+        y = jnp.einsum(sub, x, w.q.astype(x.dtype))
+        return (y.astype(jnp.float32) * w.s.reshape(-1)).astype(x.dtype)
+    return jnp.einsum(sub, x, w)
+
+
+def qragged_dot(
+    xs: jax.Array,
+    w: Any,
+    group_sizes: jax.Array,
+    eid_sorted: jax.Array,
+    *,
+    precision=None,
+) -> jax.Array:
+    """ragged_dot over expert weights [E, in, out]; for a QTensor the
+    per-(expert, out-channel) scale is gathered per row by its expert id
+    (``eid_sorted``, aligned with ``xs``)."""
+    if isinstance(w, QTensor):
+        y = jax.lax.ragged_dot(
+            xs, w.q.astype(xs.dtype), group_sizes, precision=precision
+        )
+        scale = jnp.squeeze(w.s, axis=1)[eid_sorted]  # [rows, out]
+        return (y.astype(jnp.float32) * scale).astype(xs.dtype)
+    return jax.lax.ragged_dot(xs, w, group_sizes, precision=precision)
+
+
+def qexpert_einsum(sub: str, x: jax.Array, w: Any) -> jax.Array:
+    """Expert einsum keeping the E axis in the output (gshard dense
+    dispatch, e.g. "gecd,edf->gecf"): scale [E, 1, out] broadcasts as
+    [1, E, 1, out] over the output."""
+    if isinstance(w, QTensor):
+        y = jnp.einsum(sub, x, w.q.astype(x.dtype))
+        return (y.astype(jnp.float32) * w.s[None]).astype(x.dtype)
+    return jnp.einsum(sub, x, w)
+
+
+# ---- param-tree transforms ----
+
+# leaf name -> contraction axes, for stacked-layer decoder params
+_MOE_AXES = {
+    "wq": (1,), "wk": (1,), "wv": (1,), "wo": (1,),
+    "w_gate": (2,), "w_up": (2,), "w_down": (2,),
+}
+_DENSE_AXES = {
+    "wq": (1,), "wk": (1,), "wv": (1,), "wo": (1,),
+    "w_gate": (1,), "w_up": (1,), "w_down": (1,),
+}
+
+
+def quantize_decoder_params(params: dict, cfg) -> dict:
+    """int8-quantize the matmul weights of a qwen3.init_params tree.
+
+    Router and norms stay f32/bf16 (tiny, accuracy-critical). The
+    embedding quantizes per-row (exact under gather + scale); lm_head
+    per-vocab-column (it is streamed in full every decode step)."""
+    axes = _MOE_AXES if cfg.is_moe else _DENSE_AXES
+    layers = dict(params["layers"])
+    for name, ax in axes.items():
+        layers[name] = quantize_tensor(layers[name], ax)
+    out = dict(params, layers=layers)
+    out["embed"] = quantize_tensor(params["embed"], (1,))
+    if "lm_head" in params:
+        out["lm_head"] = quantize_tensor(params["lm_head"], (0,))
+    return out
+
+
+def quantized_decoder_param_specs(cfg) -> dict:
+    """Sharding specs mirroring quantize_decoder_params: q shards like
+    the original weight; s drops the sharding on contracted (now size-1)
+    axes."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import decoder_param_specs
+
+    specs = decoder_param_specs(cfg)
+    axes = _MOE_AXES if cfg.is_moe else _DENSE_AXES
+
+    def scale_spec(p: P, contract: tuple[int, ...]) -> P:
+        return P(*[
+            None if i in contract else ax for i, ax in enumerate(p)
+        ])
+
+    layers = dict(specs["layers"])
+    for name, ax in axes.items():
+        layers[name] = QTensor(q=layers[name],
+                               s=scale_spec(layers[name], ax))
+    out = dict(specs, layers=layers)
+    out["embed"] = QTensor(q=specs["embed"],
+                           s=scale_spec(specs["embed"], (1,)))
+    if "lm_head" in specs:
+        out["lm_head"] = QTensor(q=specs["lm_head"],
+                                 s=scale_spec(specs["lm_head"], (0,)))
+    return out

@@ -55,19 +55,30 @@ def checkpoint_dir(name: str) -> Optional[str]:
     return path if os.path.isdir(path) else None
 
 
+def _env_for(prefix: str, name: str) -> Optional[str]:
+    """Per-model env override: ``<prefix>_<SLUG>`` wins over the bare
+    ``<prefix>`` (slug = model name uppercased, non-alnum → ``_``)."""
+    import re
+
+    slug = re.sub(r"[^A-Z0-9]", "_", name.upper())
+    return (
+        os.environ.get(f"{prefix}_{slug}") or os.environ.get(prefix)
+    )
+
+
 def mesh_env_for(name: str) -> Optional[str]:
     """Resolve the mesh spec string for a model: a per-model override
     (``ROOM_TPU_MESH_QWEN2_5_72B="1,1,4@0"``) wins over the global
     ``ROOM_TPU_MESH``. The ``@start`` device offset lets the hetero swarm
     place the queen and worker models on disjoint submeshes of one pod
     (BASELINE.md config #5)."""
-    import re
+    return _env_for("ROOM_TPU_MESH", name)
 
-    slug = re.sub(r"[^A-Z0-9]", "_", name.upper())
-    return (
-        os.environ.get(f"ROOM_TPU_MESH_{slug}")
-        or os.environ.get("ROOM_TPU_MESH")
-    )
+
+def quant_env_for(name: str) -> Optional[str]:
+    """Weight quantization mode for a model: ``ROOM_TPU_QUANT=int8``
+    (or per-model ``ROOM_TPU_QUANT_<SLUG>``) serves int8 weight-only."""
+    return _env_for("ROOM_TPU_QUANT", name)
 
 
 class ModelHost:
@@ -128,14 +139,27 @@ class ModelHost:
 
                 params = load_params(ckpt, like=params)
 
+            quant = quant_env_for(self.name)
+            param_specs = decoder_param_specs(self.cfg)
+            if quant:
+                if quant != "int8":
+                    raise ProviderError(
+                        f"unknown ROOM_TPU_QUANT mode {quant!r} "
+                        "(supported: int8)"
+                    )
+                from ..ops.quant import (
+                    quantize_decoder_params, quantized_decoder_param_specs,
+                )
+
+                params = quantize_decoder_params(params, self.cfg)
+                param_specs = quantized_decoder_param_specs(self.cfg)
+
             mesh_env = mesh_env_for(self.name)
             mesh = None
             if mesh_env:
                 spec, start = parse_mesh_spec(mesh_env)
                 mesh = make_submesh(spec, start)
-                params = shard_pytree(
-                    params, decoder_param_specs(self.cfg), mesh
-                )
+                params = shard_pytree(params, param_specs, mesh)
             if self.cfg.moe_impl == "shardmap":
                 if mesh is None:
                     raise ProviderError(
@@ -144,7 +168,7 @@ class ModelHost:
                     )
                 from ..ops.moe_shardmap import set_ep_mesh
 
-                set_ep_mesh(mesh)
+                set_ep_mesh(mesh, key=self.cfg.name)
 
             # the engine places its page pool on the same mesh as the
             # params so KV reads never cross chips
@@ -170,6 +194,10 @@ class ModelHost:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.cfg.moe_impl == "shardmap":
+            from ..ops.moe_shardmap import set_ep_mesh
+
+            set_ep_mesh(None, key=self.cfg.name)
 
 
 def get_model_host(name: str) -> ModelHost:

@@ -284,6 +284,47 @@ def test_model_forward_shardmap_matches_ragged():
     )
 
 
+def test_shardmap_mesh_registry_per_model():
+    """Hetero hosts on disjoint submeshes must each trace shard_map over
+    THEIR mesh: the registry is keyed by cfg.name (a lazy retrace after
+    another host registered would otherwise pick up the wrong mesh)."""
+    import dataclasses
+
+    from room_tpu.ops.moe_shardmap import get_ep_mesh, set_ep_mesh
+    from room_tpu.parallel import MeshSpec, make_submesh
+
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 6), 0, cfg.vocab_size
+    )
+    want, _ = qwen3.forward(params, cfg, tokens)
+
+    cfg_a = dataclasses.replace(cfg, name="hetero-a", moe_impl="shardmap")
+    cfg_b = dataclasses.replace(cfg, name="hetero-b", moe_impl="shardmap")
+    mesh_a = make_submesh(MeshSpec(1, 2, 1), 0)   # devices 0-1
+    mesh_b = make_submesh(MeshSpec(1, 4, 1), 4)   # devices 4-7
+    set_ep_mesh(mesh_a, key="hetero-a")
+    set_ep_mesh(mesh_b, key="hetero-b")
+    try:
+        assert get_ep_mesh("hetero-a") is mesh_a
+        assert get_ep_mesh("hetero-b") is mesh_b
+        # unknown key without a default entry must refuse
+        with pytest.raises(RuntimeError):
+            get_ep_mesh("hetero-c")
+        got_a, _ = qwen3.forward(params, cfg_a, tokens)
+        got_b, _ = qwen3.forward(params, cfg_b, tokens)
+    finally:
+        set_ep_mesh(None, key="hetero-a")
+        set_ep_mesh(None, key="hetero-b")
+    np.testing.assert_allclose(
+        np.asarray(got_a), np.asarray(want), rtol=5e-3, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_b), np.asarray(want), rtol=5e-3, atol=5e-3
+    )
+
+
 # ---- pipeline parallelism ----
 
 def test_pipeline_forward_matches_dense():
