@@ -120,12 +120,11 @@ def main() -> None:
     # decode step — the bandwidth-bound path's main lever)
     quant = os.environ.get("ROOM_TPU_QUANT")
     if quant:
-        if quant != "int8":
-            raise ValueError(
-                f"unknown ROOM_TPU_QUANT mode {quant!r} (supported: int8)"
-            )
-        from room_tpu.ops.quant import quantize_decoder_params
+        from room_tpu.ops.quant import (
+            quantize_decoder_params, validate_quant_mode,
+        )
 
+        validate_quant_mode(quant)
         params = quantize_decoder_params(params, cfg)
     if cfg.moe_impl == "shardmap":
         import numpy as np

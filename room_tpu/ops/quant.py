@@ -24,10 +24,22 @@ unchanged; ``quantized_decoder_param_specs`` mirrors
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+SUPPORTED_MODES = ("int8",)
+
+
+def validate_quant_mode(mode: str) -> None:
+    """Single source of truth for supported ROOM_TPU_QUANT values."""
+    if mode not in SUPPORTED_MODES:
+        raise ValueError(
+            f"unknown ROOM_TPU_QUANT mode {mode!r} "
+            f"(supported: {', '.join(SUPPORTED_MODES)})"
+        )
 
 
 class QTensor(NamedTuple):
@@ -111,20 +123,37 @@ _DENSE_AXES = {
 }
 
 
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(1,))
+def _quantize_leaf(w: jax.Array, contract_axes: tuple[int, ...]) -> QTensor:
+    return quantize_tensor(w, contract_axes)
+
+
 def quantize_decoder_params(params: dict, cfg) -> dict:
     """int8-quantize the matmul weights of a qwen3.init_params tree.
 
     Router and norms stay f32/bf16 (tiny, accuracy-critical). The
     embedding quantizes per-row (exact under gather + scale); lm_head
-    per-vocab-column (it is streamed in full every decode step)."""
+    per-vocab-column (it is streamed in full every decode step).
+
+    Leaves are popped and quantized one at a time through a DONATED jit
+    so the source buffer is released as each leaf converts — peak HBM
+    stays near the bf16 tree rather than bf16 + f32 temporaries for the
+    whole model (the 30B's stacked expert leaf alone is most of the
+    weights). The input tree is consumed."""
     axes = _MOE_AXES if cfg.is_moe else _DENSE_AXES
-    layers = dict(params["layers"])
+    layers = params["layers"]
     for name, ax in axes.items():
-        layers[name] = quantize_tensor(layers[name], ax)
+        w = layers.pop(name)
+        layers[name] = _quantize_leaf(w, ax)
+        del w
     out = dict(params, layers=layers)
-    out["embed"] = quantize_tensor(params["embed"], (1,))
+    w = params.pop("embed")
+    out["embed"] = _quantize_leaf(w, (1,))
+    del w
     if "lm_head" in params:
-        out["lm_head"] = quantize_tensor(params["lm_head"], (0,))
+        w = params.pop("lm_head")
+        out["lm_head"] = _quantize_leaf(w, (0,))
+        del w
     return out
 
 

@@ -142,15 +142,15 @@ class ModelHost:
             quant = quant_env_for(self.name)
             param_specs = decoder_param_specs(self.cfg)
             if quant:
-                if quant != "int8":
-                    raise ProviderError(
-                        f"unknown ROOM_TPU_QUANT mode {quant!r} "
-                        "(supported: int8)"
-                    )
                 from ..ops.quant import (
                     quantize_decoder_params, quantized_decoder_param_specs,
+                    validate_quant_mode,
                 )
 
+                try:
+                    validate_quant_mode(quant)
+                except ValueError as e:
+                    raise ProviderError(str(e)) from None
                 params = quantize_decoder_params(params, self.cfg)
                 param_specs = quantized_decoder_param_specs(self.cfg)
 

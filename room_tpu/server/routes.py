@@ -293,7 +293,9 @@ def register_extended_routes(r: Router) -> None:
 
     def list_runs(ctx):
         return ok(ctx.db.query(
-            "SELECT * FROM task_runs ORDER BY id DESC LIMIT ?",
+            "SELECT tr.*, t.name AS task_name FROM task_runs tr "
+            "LEFT JOIN tasks t ON t.id = tr.task_id "
+            "ORDER BY tr.id DESC LIMIT ?",
             (int(ctx.query.get("limit", "50")),),
         ))
 

@@ -183,6 +183,8 @@ def test_every_panel_call_resolves(server):
     # fixtures so parameterized DELETEs resolve
     credentials_mod.store_credential(db, rid, "1", "v")
     watches_mod.create_watch(db, "/tmp/ui-watch", "check")
+    # a finished run so the runs panel's detail/log calls resolve
+    db.insert("INSERT INTO task_runs(task_id, status) VALUES (1, 'ok')")
 
     bodies = {
         ("POST", "/api/rooms"): {"name": "x"},
@@ -211,6 +213,9 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/templates/instantiate"):
             {"template": "research-desk", "workerModel": "echo"},
         ("PUT", "/api/settings"): {"ui_test": "1"},
+        ("POST", "/api/rooms/1/messages"):
+            {"toRoomId": 1, "subject": "s", "body": "b"},
+        ("POST", "/api/goals/1/updates"): {"update": "progress note"},
     }
     # endpoints whose 4xx is data-dependent, not drift
     allowed_4xx = {
@@ -227,6 +232,7 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/self-mod/1/revert"),       # no audit entry (409)
         ("POST", "/api/decisions/1/vote"),        # quorum state (409)
         ("POST", "/api/tasks/1/run"),             # no runtime thread (503)
+        ("GET", "/api/rooms/1/wallet/balance"),   # no chain RPC (503)
     }
     for method, path in _panel_api_calls():
         body = bodies.get((method, path))

@@ -44,7 +44,11 @@ def test_forward_quantized_close(cfg_fn):
     norm — int8 per-channel on randn weights keeps a few % error."""
     cfg = cfg_fn()
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    qparams = quantize_decoder_params(params, cfg)
+    # quantization consumes its input tree (leaf donation), so
+    # quantize a fresh identically-seeded init
+    qparams = quantize_decoder_params(
+        qwen3.init_params(cfg, jax.random.PRNGKey(0)), cfg
+    )
     assert qparams["layers"]["wq"].q.dtype == jnp.int8
     # norms and router stay unquantized
     assert not isinstance(qparams["layers"]["ln1"], QTensor)
@@ -64,7 +68,11 @@ def test_forward_quantized_close(cfg_fn):
 def test_quantized_weights_halve_bytes():
     cfg = tiny_moe()
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    qparams = quantize_decoder_params(params, cfg)
+    # quantization consumes its input tree (leaf donation), so
+    # quantize a fresh identically-seeded init
+    qparams = quantize_decoder_params(
+        qwen3.init_params(cfg, jax.random.PRNGKey(0)), cfg
+    )
 
     def nbytes(tree):
         return sum(x.nbytes for x in jax.tree.leaves(tree))
@@ -84,7 +92,11 @@ def test_quant_moe_impls_agree():
 
     cfg = tiny_moe()
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    qparams = quantize_decoder_params(params, cfg)
+    # quantization consumes its input tree (leaf donation), so
+    # quantize a fresh identically-seeded init
+    qparams = quantize_decoder_params(
+        qwen3.init_params(cfg, jax.random.PRNGKey(0)), cfg
+    )
     tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
                                 cfg.vocab_size)
 
@@ -122,7 +134,11 @@ def test_quantized_sharded_token_identity():
 
     cfg = tiny_moe()
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
-    qparams = quantize_decoder_params(params, cfg)
+    # quantization consumes its input tree (leaf donation), so
+    # quantize a fresh identically-seeded init
+    qparams = quantize_decoder_params(
+        qwen3.init_params(cfg, jax.random.PRNGKey(0)), cfg
+    )
     sp = SamplingParams(temperature=0.0, max_new_tokens=5)
     prompts = [[1, 2, 3], [9, 8, 7, 6]]
 

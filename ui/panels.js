@@ -963,21 +963,398 @@ async function provision(model) {
   poll();
 }
 
+// ---- status (reference: StatusPanel.tsx — version, update
+// diagnostics, runtime + usage at a glance) ----
+
+async function renderStatus(el) {
+  const [st, upd, queens, rooms] = await Promise.all([
+    api("GET", "/api/status"),
+    api("GET", "/api/update"),
+    api("GET", "/api/rooms/queen-states"),
+    api("GET", "/api/rooms"),
+  ]);
+  const s = st.data || {};
+  const u = upd.data || {};
+  const usage = await Promise.all((rooms.data || []).map(async r => ({
+    room: r,
+    u: (await api("GET", `/api/rooms/${r.id}/usage`)).data || {},
+  })));
+  el.innerHTML = `
+    <div class="cols"><div>
+    <div class="panel"><h2>server</h2>
+      <div class="kv">
+        <span class="k">version</span><span>${esc(s.version)}</span>
+        <span class="k">platform</span>
+          <span>${esc(s.platform)} × ${esc(s.devices)}</span>
+        <span class="k">rooms</span>
+          <span>${esc(s.activeRooms)} active / ${esc(s.rooms)}</span>
+        <span class="k">uptime</span>
+          <span>${Math.round(s.uptime_s || 0)}s</span>
+      </div></div>
+    <div class="panel"><h2>update</h2>
+      <div class="kv">
+        <span class="k">current</span>
+          <span>${esc(u.currentVersion)}</span>
+        <span class="k">latest</span>
+          <span>${esc(u.updateInfo?.latestVersion || "unknown")}
+          ${u.updateInfo?.updateAvailable
+            ? '<span class="pill pending">update available</span>'
+            : ""}</span>
+        <span class="k">auto-update</span>
+          <span>${esc(u.autoUpdate?.state || "idle")}</span>
+        <span class="k">last check</span>
+          <span>${when(u.diagnostics?.lastCheckAt) || "never"}</span>
+        <span class="k">diagnostics</span>
+          <span class="dim">${esc(u.diagnostics?.lastErrorMessage ||
+            "ok")}</span>
+      </div>
+      <div class="row">
+        <button class="ghost" onclick="statusCheckUpdate()">
+          check now</button>
+      </div></div>
+    </div><div>
+    <div class="panel"><h2>queens</h2>
+      <table><tr><th>room</th><th>queen</th><th>state</th></tr>
+      ${Object.entries(queens.data || {}).map(([roomId, q]) => `
+        <tr><td>#${esc(roomId)} ${esc((rooms.data || []).find(r =>
+          r.id === Number(roomId))?.name || "")}</td>
+        <td>#${esc(q.queenWorkerId)}</td>
+        <td><span class="pill">${esc(q.state || "idle")}</span></td>
+        </tr>`).join("") ||
+        '<tr><td class="dim" colspan="3">no rooms</td></tr>'}</table>
+    </div>
+    <div class="panel"><h2>token usage</h2>
+      <table><tr><th>room</th><th>cycles</th><th>in</th><th>out</th></tr>
+      ${usage.map(x => `
+        <tr><td>${esc(x.room.name)}</td><td>${x.u.cycles ?? 0}</td>
+        <td>${x.u.input_tokens ?? 0}</td>
+        <td>${x.u.output_tokens ?? 0}</td></tr>`).join("") ||
+        '<tr><td class="dim" colspan="4">no usage yet</td></tr>'}</table>
+    </div></div></div>`;
+}
+
+async function statusCheckUpdate() {
+  await api("POST", "/api/update/check", {});
+  refreshView();
+}
+
+// ---- goals (all-rooms tree browser; reference: GoalsPanel.tsx) ----
+
+async function renderGoals(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const blocks = await Promise.all(rooms.map(async r => {
+    const goals = (await api("GET", `/api/rooms/${r.id}/goals`)).data
+      || [];
+    const row = (g, depth) =>
+      `<tr><td style="padding-left:${depth * 14 + 4}px">
+        #${g.id} ${esc(g.description)}</td>
+      <td>${Math.round((g.progress || 0) * 100)}%</td>
+      <td><span class="pill ${esc(g.status)}">${esc(g.status)}</span></td>
+      <td style="white-space:nowrap">
+        <button class="ghost" onclick="goalAction(${g.id},'complete')">
+          done</button>
+        <button class="ghost" onclick="goalAction(${g.id},'abandon')">
+          drop</button>
+        <button class="ghost" onclick="goalNote(${g.id})">note</button>
+      </td></tr>` +
+      (g.children || []).map(c => row(c, depth + 1)).join("");
+    return `<div class="panel"><h2>${esc(r.name)}</h2>
+      <table>${goals.map(g => row(g, 0)).join("") ||
+        '<tr><td class="dim">no goals</td></tr>'}</table>
+      <div class="row">
+        <input id="goalAdd-${r.id}" placeholder="add a goal…">
+        <button class="ghost" onclick="goalAddTo(${r.id})">add</button>
+      </div></div>`;
+  }));
+  el.innerHTML = blocks.join("") ||
+    '<div class="panel"><div class="dim">no rooms yet</div></div>';
+}
+
+async function goalAddTo(roomId) {
+  const input = $(`goalAdd-${roomId}`);
+  if (!input.value.trim()) return;
+  await api("POST", `/api/rooms/${roomId}/goals`,
+    {description: input.value.trim()});
+  refreshView();
+}
+
+async function goalNote(goalId) {
+  const update = prompt("progress note for goal #" + goalId);
+  if (!update) return;
+  await api("POST", `/api/goals/${goalId}/updates`, {update});
+  refreshView();
+}
+
+// ---- messages (inter-room mail; reference: MessagesPanel.tsx) ----
+
+let msgRoom = null;
+
+async function renderMessages(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  if (msgRoom === null && rooms.length) msgRoom = rooms[0].id;
+  el.innerHTML = `
+    <div class="panel"><h2>room messages</h2>
+      <div class="row">
+        <select id="msgRoomSel">
+          ${rooms.map(r => `<option value="${r.id}"
+            ${r.id === msgRoom ? "selected" : ""}>
+            ${esc(r.name)}</option>`).join("")}
+        </select>
+        <button class="ghost" onclick="msgPick()">open</button>
+        <button class="ghost" onclick="msgReadAll()">mark all read
+        </button>
+      </div>
+      <table id="msgTable"></table>
+      <h2 style="margin-top:.8rem">send</h2>
+      <div class="row">
+        <select id="msgTo">${rooms.map(r =>
+          `<option value="${r.id}">${esc(r.name)}</option>`).join("")}
+        </select>
+        <input id="msgSubject" placeholder="subject">
+        <input id="msgBody" placeholder="message…">
+        <button class="act" onclick="msgSend()">send</button>
+      </div></div>`;
+  if (msgRoom !== null) loadMessages();
+}
+
+async function loadMessages() {
+  const out = await api("GET", `/api/rooms/${msgRoom}/messages`);
+  const tbl = $("msgTable");
+  if (!tbl) return;
+  tbl.innerHTML =
+    "<tr><th>from</th><th>subject</th><th>body</th><th></th></tr>" +
+    ((out.data || []).map(m => `
+      <tr class="${m.read_at ? "dim" : ""}">
+      <td>#${esc(m.from_room_id ?? "?")}</td>
+      <td>${esc(m.subject || "")}</td>
+      <td>${esc(String(m.body || "").slice(0, 140))}</td>
+      <td style="white-space:nowrap">
+        ${m.read_at ? "" : `<button class="ghost"
+          onclick="msgRead(${m.id})">read</button>`}
+        <button class="ghost" onclick="msgReply(${m.id})">reply</button>
+      </td></tr>`).join("") ||
+      '<tr><td class="dim" colspan="4">no messages</td></tr>');
+}
+
+function msgPick() {
+  msgRoom = parseInt($("msgRoomSel").value, 10);
+  loadMessages();
+}
+
+async function msgSend() {
+  const body = $("msgBody").value.trim();
+  if (!body || msgRoom === null) return;
+  await api("POST", `/api/rooms/${msgRoom}/messages`, {
+    toRoomId: parseInt($("msgTo").value, 10),
+    subject: $("msgSubject").value.trim(),
+    body,
+  });
+  $("msgBody").value = "";
+  loadMessages();
+}
+
+async function msgRead(id) {
+  await api("POST", `/api/messages/${id}/read`, {});
+  loadMessages();
+}
+
+async function msgReadAll() {
+  if (msgRoom === null) return;
+  await api("POST", `/api/rooms/${msgRoom}/messages/read-all`, {});
+  loadMessages();
+}
+
+async function msgReply(id) {
+  const body = prompt("reply to message #" + id);
+  if (!body) return;
+  await api("POST", `/api/messages/${id}/reply`, {body});
+  loadMessages();
+}
+
+// ---- transactions (reference: TransactionsPanel.tsx) ----
+
+async function renderTransactions(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const blocks = await Promise.all(rooms.map(async r => {
+    const [bal, txs] = await Promise.all([
+      api("GET", `/api/rooms/${r.id}/wallet/balance`),
+      api("GET", `/api/rooms/${r.id}/wallet/transactions`),
+    ]);
+    const b = bal.data || {};
+    return `<div class="panel"><h2>${esc(r.name)}
+        <span class="dim" style="font-weight:normal;font-size:.8em">
+        ${esc(b.address || "")}</span></h2>
+      <div class="dim">${Object.entries(b.balances || {}).map(
+        ([chain, v]) => `${esc(chain)}: ${esc(JSON.stringify(v))}`
+      ).join(" · ") || "balances unavailable offline"}</div>
+      <table><tr><th>when</th><th>type</th><th>category</th>
+        <th>amount</th><th>counterparty</th><th>status</th></tr>
+      ${((txs.data || [])).map(t => `
+        <tr><td class="dim">${when(t.created_at)}</td>
+        <td>${esc(t.type)}</td>
+        <td>${esc(t.category || "")}</td><td>${esc(t.amount)}</td>
+        <td class="dim">
+          ${esc(String(t.counterparty || "").slice(0, 14))}</td>
+        <td><span class="pill ${esc(t.status)}">${esc(t.status)}</span>
+          ${t.tx_hash ? `<span class="dim">
+            ${esc(String(t.tx_hash).slice(0, 12))}…</span>` : ""}
+        </td></tr>`).join("") ||
+        '<tr><td class="dim" colspan="6">no transactions</td></tr>'}
+      </table></div>`;
+  }));
+  el.innerHTML = blocks.join("") ||
+    '<div class="panel"><div class="dim">no rooms yet</div></div>';
+}
+
+// ---- runs (task run history; reference: routes/runs.ts + ui) ----
+
+async function renderRuns(el) {
+  const runs = (await api("GET", "/api/runs")).data || [];
+  el.innerHTML = `
+    <div class="cols"><div class="panel"><h2>task runs</h2>
+      <table><tr><th>run</th><th>task</th><th>status</th>
+        <th>started</th><th></th></tr>
+      ${runs.map(r => `
+        <tr><td>#${r.id}</td><td>${esc(r.task_name || r.task_id)}</td>
+        <td><span class="pill ${esc(r.status)}">${esc(r.status)}</span>
+        </td>
+        <td class="dim">${when(r.started_at)}</td>
+        <td><button class="ghost" onclick="runLogs(${r.id})">logs
+        </button></td></tr>`).join("") ||
+        '<tr><td class="dim" colspan="5">no runs yet</td></tr>'}
+      </table></div>
+    <div class="panel"><h2>run logs</h2>
+      <div class="log" id="runLog">
+        <span class="dim">pick a run</span></div></div></div>`;
+}
+
+async function runLogs(id) {
+  const [run, logs] = await Promise.all([
+    api("GET", `/api/runs/${id}`),
+    api("GET", `/api/runs/${id}/logs`),
+  ]);
+  const r = run.data || {};
+  $("runLog").innerHTML =
+    `<div class="t">run #${id} · ${esc(r.status)} ·
+      ${esc(String(r.result || "").slice(0, 200))}</div>` +
+    ((logs.data || []).map(l =>
+      `<div><span class="t">${esc(l.entry_type || l.level)}</span>
+       ${esc(String(l.content || l.message || "").slice(0, 300))}</div>`
+    ).join("") || '<div class="dim">no log entries</div>');
+}
+
+// ---- feed (public activity; reference: public-feed.ts + cloud UI) ----
+
+async function renderFeed(el) {
+  const out = await api("GET", "/api/feed");
+  el.innerHTML = `<div class="panel"><h2>public feed</h2>
+    <div class="log">${((out.data || [])).map(a => `
+      <div><span class="t">${when(a.created_at)}</span>
+        <b>${esc(a.room_name || a.room_id || "")}</b>
+        ${esc(a.event_type || "")}:
+        ${esc(String(a.summary || "").slice(0, 240))}
+      </div>`).join("") ||
+      '<div class="dim">nothing public yet</div>'}</div></div>`;
+}
+
+// ---- setup (guided room creation; reference:
+// RoomSetupGuideModal.tsx) ----
+
+async function renderSetup(el) {
+  const [models, providers, templates] = await Promise.all([
+    api("GET", "/api/models/status"),
+    api("GET", "/api/providers"),
+    api("GET", "/api/templates"),
+  ]);
+  const ms = models.data || {};
+  const tpuReady = Object.values(ms).some(m => m.ready);
+  el.innerHTML = `
+    <div class="panel"><h2>set up a room</h2>
+      <div class="dim">three steps: pick a compute backend, pick a
+        template, name the room. The queen starts herself.</div>
+      <h2 style="margin-top:.8rem">1 · compute</h2>
+      <table><tr><th>backend</th><th>status</th><th></th></tr>
+        <tr><td>tpu (in-tree serving)</td>
+          <td>${tpuReady
+            ? '<span class="pill verified">ready</span>'
+            : '<span class="pill pending">weights not loaded</span>'}
+          </td>
+          <td class="dim">load weights in the tpu panel</td></tr>
+        ${Object.entries(providers.data || {}).map(([key, p]) => `
+          <tr><td>${esc(key)} cli</td>
+          <td>${p.connected
+            ? '<span class="pill verified">ready</span>'
+            : p.installed
+              ? '<span class="pill pending">not logged in</span>'
+              : '<span class="pill pending">not installed</span>'}</td>
+          <td class="dim">${esc(p.version || "")}</td></tr>`).join("")}
+      </table>
+      <h2 style="margin-top:.8rem">2 · template</h2>
+      <div class="row">
+        <select id="setupTemplate">
+          <option value="">blank room</option>
+          ${((templates.data || {}).rooms || []).map(t =>
+            `<option value="${esc(t.key)}">${esc(t.name)} —
+             ${esc(t.description || "")}</option>`).join("")}
+        </select>
+        <select id="setupModel">
+          <option value="tpu">tpu</option>
+          <option value="echo">echo (test)</option>
+          ${Object.entries(providers.data || {}).filter(([, p]) =>
+            p.connected).map(([key]) =>
+            `<option value="${esc(key)}">${esc(key)}</option>`
+          ).join("")}
+        </select>
+      </div>
+      <h2 style="margin-top:.8rem">3 · name + create</h2>
+      <div class="row">
+        <input id="setupName" placeholder="room name…">
+        <button class="act" onclick="setupCreate()">create room</button>
+      </div>
+      <div class="dim" id="setupResult"></div></div>`;
+}
+
+async function setupCreate() {
+  const name = $("setupName").value.trim();
+  const template = $("setupTemplate").value;
+  const model = $("setupModel").value;
+  let out;
+  if (template) {
+    out = await api("POST", "/api/templates/instantiate",
+      {template, name: name || undefined, workerModel: model});
+  } else {
+    if (!name) return;
+    out = await api("POST", "/api/rooms",
+      {name, workerModel: model});
+  }
+  if (out.data?.id) {
+    $("setupResult").textContent =
+      `room #${out.data.id} created — open the rooms panel to start it`;
+  }
+}
+
 // ---- registry ----
 
 const PANELS = {
   swarm: {title: "swarm", render: renderSwarm},
   rooms: {title: "rooms", render: renderRooms},
+  setup: {title: "setup", render: renderSetup},
   workers: {title: "workers", render: renderWorkers},
+  goals: {title: "goals", render: renderGoals},
   tasks: {title: "tasks", render: renderTasks},
+  runs: {title: "runs", render: renderRuns},
   inbox: {title: "inbox", render: renderInbox},
+  messages: {title: "messages", render: renderMessages},
   votes: {title: "votes", render: renderVotes},
   memory: {title: "memory", render: renderMemory},
   skills: {title: "skills", render: renderSkills},
   wallet: {title: "wallet", render: renderWallet},
+  transactions: {title: "transactions", render: renderTransactions},
   tpu: {title: "tpu", render: renderTpu},
   cycles: {title: "cycles", render: renderCycles},
   clerk: {title: "clerk", render: renderClerk},
+  status: {title: "status", render: renderStatus},
+  feed: {title: "feed", render: renderFeed},
   system: {title: "system", render: renderSystem},
   settings: {title: "settings", render: renderSettings},
 };
