@@ -146,14 +146,19 @@ def main() -> None:
     max_batch = 4 if TINY else 8
     prompt = list(range(1, 33))
     gen_timed = 32 if TINY else 256
+    # greedy mode exercises the speculative path (drafting is exact
+    # only under argmax); default matches serving traffic at temp 0.7
+    greedy = os.environ.get("ROOM_TPU_BENCH_GREEDY") == "1"
+    temp = 0.0 if greedy else 0.7
+    top_p = 1.0 if greedy else 0.95
 
-    def measure() -> tuple[float, int, float]:
+    def measure() -> tuple[float, int, float, dict]:
         eng = ServingEngine(
             cfg, params, max_batch=max_batch, page_size=32,
             n_pages=1024,
         )
         sp = SamplingParams(
-            temperature=0.7, top_p=0.95,
+            temperature=temp, top_p=top_p,
             max_new_tokens=16 if TINY else 64,
         )
         warm = [eng.submit(prompt, sampling=sp)
@@ -164,7 +169,7 @@ def main() -> None:
         start = eng.stats()
         for _ in range(max_batch * 2):
             eng.submit(prompt, sampling=SamplingParams(
-                temperature=0.7, top_p=0.95,
+                temperature=temp, top_p=top_p,
                 max_new_tokens=gen_timed,
             ))
         t0 = time.perf_counter()
@@ -172,9 +177,9 @@ def main() -> None:
         dt = time.perf_counter() - t0
         decoded = (eng.stats()["tokens_decoded"]
                    - start["tokens_decoded"])
-        return decoded / dt, decoded, dt
+        return decoded / dt, decoded, dt, eng.stats()
 
-    tok_s, decoded, dt = measure()
+    tok_s, decoded, dt, eng_stats = measure()
 
     # MFU estimate against the chip's peak bf16 matmul throughput
     # (override ROOM_TPU_PEAK_TFLOPS for the actual TPU generation;
@@ -193,6 +198,15 @@ def main() -> None:
     }
     if quant:
         extra["quant"] = quant
+    spec_env = os.environ.get("ROOM_TPU_SPEC_TOKENS")
+    if spec_env and spec_env != "0":
+        # speculative decoding only engages on greedy rows; report what
+        # actually ran so a no-draft run can't masquerade as a spec
+        # result (the default bench samples at temperature 0.7, which
+        # never drafts — use ROOM_TPU_BENCH_GREEDY=1 to exercise it)
+        extra["spec_tokens"] = int(spec_env)
+        for k in ("spec_rounds", "spec_proposed", "spec_accepted"):
+            extra[k] = eng_stats[k]
 
     # decode-attention backend comparison (Pallas paged kernel vs the
     # XLA gather reference) — only meaningful on real TPU hardware
@@ -201,7 +215,7 @@ def main() -> None:
         for backend in ("pallas", "xla"):
             os.environ["ROOM_TPU_PAGED_KERNEL"] = backend
             try:
-                b_tok_s, _, _ = measure()
+                b_tok_s, _, _, _ = measure()
                 compare[backend] = round(b_tok_s, 2)
             except Exception as e:
                 compare[backend] = f"error: {e}"

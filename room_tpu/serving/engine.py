@@ -47,6 +47,34 @@ PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
                    16384, 32768)
 
 
+def propose_ngram(seq: list[int], gamma: int) -> list[int]:
+    """Prompt-lookup draft: match the sequence's trailing n-gram against
+    its own earlier content and propose the tokens that followed the most
+    recent previous occurrence (agent turns repeat tool-call JSON, code,
+    and prompt fragments constantly). Returns up to ``gamma`` proposals,
+    possibly empty. Pure host-side; the device only verifies."""
+    arr = np.asarray(seq, np.int32)
+    n_total = len(arr)
+    for n in (3, 2):
+        if n_total <= n:
+            continue
+        pat = arr[-n:]
+        body = arr[:-1]
+        if len(body) < n:
+            continue
+        wins = np.lib.stride_tricks.sliding_window_view(body, n)
+        matches = np.nonzero((wins == pat).all(axis=1))[0]
+        # a window starting at i proposes tokens from i+n; the suffix
+        # itself (start n_total-n) proposes nothing
+        matches = matches[matches < n_total - n]
+        if len(matches):
+            start = int(matches[-1]) + n
+            prop = arr[start:start + gamma]
+            if len(prop):
+                return prop.tolist()
+    return []
+
+
 @dataclass
 class Turn:
     """One generation request against a session."""
@@ -119,6 +147,7 @@ class ServingEngine:
         stop_token_ids: Optional[list[int]] = None,
         rng_seed: int = 0,
         mesh: Optional[Any] = None,
+        spec_tokens: Optional[int] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -150,6 +179,15 @@ class ServingEngine:
         self.prefill_chunk = int(
             os.environ.get("ROOM_TPU_PREFILL_CHUNK", "2048")
         )
+        # speculative decoding (prompt-lookup drafting): propose up to
+        # this many tokens per round from each session's own history and
+        # verify them in ONE forward — decode streams the full weight
+        # set per device call, so every accepted token divides the HBM
+        # bill. 0 disables (the chunked scan path runs instead). Greedy
+        # rows are token-identical to non-speculative decoding; sampling
+        # rows fall back to one token per round.
+        self.spec_tokens = spec_tokens if spec_tokens is not None else \
+            int(os.environ.get("ROOM_TPU_SPEC_TOKENS", "0"))
 
         if stop_token_ids is not None:
             self.stop_token_ids = set(stop_token_ids)
@@ -194,6 +232,8 @@ class ServingEngine:
             (max_batch, self.max_pages_per_seq), np.int32
         )
         self._slot_lengths = np.zeros((max_batch,), np.int32)
+        # tokens of page headroom _reserve_slot actually secured per slot
+        self._reserved_tokens = np.zeros((max_batch,), np.int32)
         self._key = jax.random.PRNGKey(rng_seed)
         self._deferred_release: set[str] = set()
         self._admitting: set[str] = set()
@@ -210,6 +250,7 @@ class ServingEngine:
             "decode_steps": 0, "evictions": 0,
             "prefix_hits": 0, "prefix_tokens_reused": 0,
             "prefix_evictions": 0,
+            "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
         }
         from collections import Counter
 
@@ -311,6 +352,38 @@ class ServingEngine:
                 return out.T, self._constrain_cache(cache)  # [B, n_steps]
 
             self._jit_cache[key] = decode
+        return self._jit_cache[key]
+
+    def _spec_fn(self, width: int):
+        """Speculative verify: one forward over [B, width] windows
+        (current token + width-1 proposals), KV written through the
+        paged hook at positions length..length+width-1. Returns the
+        greedy continuation at every position (for verification) plus a
+        sampled token from position 0 (for stochastic rows)."""
+        key = ("spec", width)
+        if key not in self._jit_cache:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def spec(params, cache, tokens, block_tables, lengths, rng,
+                     temperature, top_p, top_k):
+                hook = make_paged_kv_hook(
+                    block_tables, lengths, self.page_size
+                )
+                positions = lengths[:, None] + jnp.arange(width)
+                logits, cache = qwen3.forward(
+                    params, cfg, tokens, positions, cache, kv_hook=hook,
+                )
+                logits = logits.astype(jnp.float32)
+                # same argmax as sample_batched's greedy branch, so
+                # tie-breaking matches the non-speculative path exactly
+                greedy = jnp.argmax(logits, axis=-1)        # [B, width]
+                sampled = sample_batched(
+                    logits[:, 0], rng, temperature, top_p, top_k,
+                )
+                return greedy, sampled, self._constrain_cache(cache)
+
+            self._jit_cache[key] = spec
         return self._jit_cache[key]
 
     # ---- public API ----
@@ -780,52 +853,66 @@ class ServingEngine:
             self._active[slot] = turn
             self._append_token(slot, turn, int(firsts[r]))
 
+    def _reserve_slot(self, i: int, want_tokens: int) -> bool:
+        """Reserve pages so slot ``i``'s session can hold
+        length+want_tokens (clamped to capacity), degrading to a single
+        token under pool pressure; device writes past the reservation
+        divert to the scratch page and the host trims. Finishes the
+        turn with an error only when even one token won't fit. Updates
+        the slot's block table + length row."""
+        turn = self._active[i]
+        sess = self.sessions[turn.session_id]
+        capacity = self.max_pages_per_seq * self.page_size
+        target = min(sess.length + want_tokens, capacity)
+        try:
+            pages = self._ensure_capacity_evicting(
+                sess.id, target - sess.prefix_len
+            )
+        except MemoryError:
+            # degrade to single-token pacing before giving up: a turn
+            # finishing within its current pages must not die because
+            # the full chunk couldn't be reserved
+            try:
+                target = min(sess.length + 1, capacity)
+                pages = self._ensure_capacity_evicting(
+                    sess.id, target - sess.prefix_len
+                )
+            except MemoryError as e:
+                turn.error = str(e)
+                self._finish_turn(i, turn, "error")
+                return False
+        all_pages = sess.prefix_pages + pages
+        self._slot_tables[i, : len(all_pages)] = all_pages
+        # stale entries from a previous occupant of this slot must
+        # never receive overrun writes — point them at scratch
+        self._slot_tables[i, len(all_pages):] = 0
+        self._slot_lengths[i] = sess.length
+        self._reserved_tokens[i] = target - sess.length
+        return True
+
     def _decode_once(self) -> int:
         active_idx = [
             i for i, t in enumerate(self._active) if t is not None
         ]
         if not active_idx:
             return 0
+        if self.spec_tokens > 0:
+            n = self._decode_once_spec(active_idx)
+            if n is not None:
+                return n
+            # no row drafted anything this round: the chunked scan path
+            # below is strictly better (it amortizes host round-trips)
 
         chunk = self.decode_chunk
-        capacity = self.max_pages_per_seq * self.page_size
         # ensure pages only for tokens the turn can actually accept:
-        # min(chunk, its remaining budget), clamped to capacity. Device
-        # writes past that divert to the scratch page and the host trims.
+        # min(chunk, its remaining budget), clamped to capacity
         for i in list(active_idx):
             turn = self._active[i]
-            sess = self.sessions[turn.session_id]
             remaining = max(
                 turn.sampling.max_new_tokens - len(turn.new_tokens), 1
             )
-            target = min(
-                sess.length + min(chunk, remaining), capacity
-            )
-            try:
-                pages = self._ensure_capacity_evicting(
-                    sess.id, target - sess.prefix_len
-                )
-            except MemoryError:
-                # degrade to single-token pacing before giving up: a turn
-                # finishing within its current pages must not die because
-                # the full chunk couldn't be reserved
-                try:
-                    pages = self._ensure_capacity_evicting(
-                        sess.id,
-                        min(sess.length + 1, capacity)
-                        - sess.prefix_len,
-                    )
-                except MemoryError as e:
-                    turn.error = str(e)
-                    self._finish_turn(i, turn, "error")
-                    active_idx.remove(i)
-                    continue
-            all_pages = sess.prefix_pages + pages
-            self._slot_tables[i, : len(all_pages)] = all_pages
-            # stale entries from a previous occupant of this slot must
-            # never receive overrun writes — point them at scratch
-            self._slot_tables[i, len(all_pages):] = 0
-            self._slot_lengths[i] = sess.length
+            if not self._reserve_slot(i, min(chunk, remaining)):
+                active_idx.remove(i)
         if not active_idx:
             return 0
 
@@ -877,6 +964,130 @@ class ServingEngine:
                     # turn finished mid-chunk: the remaining sampled
                     # tokens (and their KV writes past sess.length) are
                     # discarded
+                    break
+        return len(active_idx)
+
+    def _decode_once_spec(self, active_idx: list[int]) -> Optional[int]:
+        """One speculative round: active slots draft continuation tokens
+        from their own history (prompt-lookup), one forward verifies the
+        whole window, and greedy rows keep the longest draft prefix that
+        matches the model's own argmax — token-identical to sequential
+        greedy decoding, but amortizing the per-call weight streaming
+        over every accepted token. KV for rejected draft positions sits
+        past the session length and is overwritten by later writes (the
+        same overrun contract as the chunked scan path).
+
+        Returns None (caller runs the chunked scan path) when no row
+        drafted anything — stochastic rows and non-repetitive contexts
+        must not pay the wider forward for nothing."""
+        gamma = self.spec_tokens
+        width = gamma + 1
+
+        # draft first: only greedy rows with token budget propose
+        drafts: dict[int, tuple[int, list[int]]] = {}
+        n_proposed = 0
+        for i in active_idx:
+            t = self._active[i]
+            sess = self.sessions[t.session_id]
+            last = t.new_tokens[-1] if t.new_tokens else \
+                t.prompt_tokens[-1]
+            p: list[int] = []
+            remaining = t.sampling.max_new_tokens - len(t.new_tokens)
+            if t.sampling.temperature == 0.0 and remaining > 1:
+                p = propose_ngram(
+                    sess.history + [last], min(gamma, remaining - 1)
+                )
+            drafts[i] = (last, p)
+            n_proposed += len(p)
+        if n_proposed == 0:
+            return None
+
+        # reserve only what each row can actually consume: its drafts'
+        # KV plus the current token (the bonus token stays pending)
+        max_accept: dict[int, int] = {}
+        for i in list(active_idx):
+            sess = self.sessions[self._active[i].session_id]
+            if not self._reserve_slot(i, 1 + len(drafts[i][1])):
+                active_idx.remove(i)
+                continue
+            # accepted tokens must have real KV: cap by the headroom
+            # actually reserved (degrade path may have given only 1)
+            max_accept[i] = max(
+                0, min(len(drafts[i][1]),
+                       int(self._reserved_tokens[i]) - 1)
+            )
+        if not active_idx:
+            return 0
+
+        tokens = np.zeros((self.max_batch, width), np.int32)
+        props: dict[int, list[int]] = {}
+        for i in active_idx:
+            last, p = drafts[i]
+            p = p[: max_accept[i]]
+            props[i] = p
+            tokens[i, 0] = last
+            tokens[i, 1:1 + len(p)] = p
+
+        temps = np.ones((self.max_batch,), np.float32)
+        top_ps = np.ones((self.max_batch,), np.float32)
+        top_ks = np.zeros((self.max_batch,), np.int32)
+        for i in active_idx:
+            sp = self._active[i].sampling
+            temps[i] = sp.temperature
+            top_ps[i] = sp.top_p
+            top_ks[i] = sp.top_k
+
+        spec = self._spec_fn(width)
+        self._key, sub = jax.random.split(self._key)
+        with self.timer.phase("decode_spec"):
+            greedy_d, sampled_d, self.cache = spec(
+                self.params,
+                self.cache,
+                self._place_batch(tokens),
+                self._place_batch(self._slot_tables),
+                self._place_batch(self._slot_lengths),
+                sub,
+                self._place_batch(temps),
+                self._place_batch(top_ps),
+                self._place_batch(top_ks),
+            )
+            greedy = np.asarray(greedy_d)     # [B, width]
+            sampled = np.asarray(sampled_d)   # [B]
+        self._stats["decode_steps"] += 1
+        self._stats["spec_rounds"] += 1
+        self._stats["spec_proposed"] += sum(
+            len(props[i]) for i in active_idx
+        )
+
+        for i in active_idx:
+            turn = self._active[i]
+            sess = self.sessions[turn.session_id]
+            if turn.sampling.temperature == 0.0:
+                # longest draft prefix matching the model's own argmax
+                accepted = 0
+                for j, p in enumerate(props[i]):
+                    if p != int(greedy[i, j]):
+                        break
+                    accepted += 1
+                emitted = [int(greedy[i, j]) for j in range(accepted + 1)]
+            else:
+                emitted = [int(sampled[i])]
+            for j, tok in enumerate(emitted):
+                # token j's KV was written at sess.length by the verify
+                # forward (the final emitted token stays pending, like
+                # every other decode path)
+                sess.history.append(
+                    int(tokens[i, 0]) if j == 0 else emitted[j - 1]
+                )
+                sess.length += 1
+                self._stats["tokens_decoded"] += 1
+                # emitted[j] for j < accepted is a consumed draft token
+                # (count only drafts the turn actually kept — a stop
+                # token mid-window discards the rest)
+                if j < len(props[i]) and j < len(emitted) - 1:
+                    self._stats["spec_accepted"] += 1
+                self._append_token(i, turn, tok)
+                if self._active[i] is not turn:
                     break
         return len(active_idx)
 
