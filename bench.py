@@ -146,8 +146,8 @@ def main() -> None:
     max_batch = 4 if TINY else 8
     prompt = list(range(1, 33))
     gen_timed = 32 if TINY else 256
-    # greedy mode exercises the speculative path (drafting is exact
-    # only under argmax); default matches serving traffic at temp 0.7
+    # greedy mode measures deterministic decoding (and makes any
+    # speculative gains reproducible); default matches serving traffic
     greedy = os.environ.get("ROOM_TPU_BENCH_GREEDY") == "1"
     temp = 0.0 if greedy else 0.7
     top_p = 1.0 if greedy else 0.95
@@ -200,10 +200,9 @@ def main() -> None:
         extra["quant"] = quant
     spec_env = os.environ.get("ROOM_TPU_SPEC_TOKENS")
     if spec_env and spec_env != "0":
-        # speculative decoding only engages on greedy rows; report what
-        # actually ran so a no-draft run can't masquerade as a spec
-        # result (the default bench samples at temperature 0.7, which
-        # never drafts — use ROOM_TPU_BENCH_GREEDY=1 to exercise it)
+        # speculation engages only when contexts repeat (prompt-lookup
+        # drafting); report what actually ran so a no-draft run can't
+        # masquerade as a spec result
         extra["spec_tokens"] = int(spec_env)
         for k in ("spec_rounds", "spec_proposed", "spec_accepted"):
             extra[k] = eng_stats[k]

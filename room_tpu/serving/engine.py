@@ -40,7 +40,7 @@ import numpy as np
 from ..models import qwen3
 from ..models.config import DecoderConfig
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
-from .sampler import SamplingParams, sample_batched
+from .sampler import SamplingParams, sample_batched, spec_verify
 from .tokenizer import ByteTokenizer, Tokenizer
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
@@ -356,10 +356,11 @@ class ServingEngine:
 
     def _spec_fn(self, width: int):
         """Speculative verify: one forward over [B, width] windows
-        (current token + width-1 proposals), KV written through the
-        paged hook at positions length..length+width-1. Returns the
-        greedy continuation at every position (for verification) plus a
-        sampled token from position 0 (for stochastic rows)."""
+        (current token + width-1 draft tokens), KV written through the
+        paged hook at positions length..length+width-1. Verification is
+        full speculative sampling (sampler.spec_verify): greedy rows
+        reduce to exact argmax equivalence, stochastic rows keep their
+        exact sampling distribution via accept/residual draws."""
         key = ("spec", width)
         if key not in self._jit_cache:
             cfg = self.cfg
@@ -374,14 +375,12 @@ class ServingEngine:
                 logits, cache = qwen3.forward(
                     params, cfg, tokens, positions, cache, kv_hook=hook,
                 )
-                logits = logits.astype(jnp.float32)
-                # same argmax as sample_batched's greedy branch, so
-                # tie-breaking matches the non-speculative path exactly
-                greedy = jnp.argmax(logits, axis=-1)        # [B, width]
-                sampled = sample_batched(
-                    logits[:, 0], rng, temperature, top_p, top_k,
+                accept, residual, plain = spec_verify(
+                    logits, tokens[:, 1:], rng,
+                    temperature, top_p, top_k,
                 )
-                return greedy, sampled, self._constrain_cache(cache)
+                return accept, residual, plain, \
+                    self._constrain_cache(cache)
 
             self._jit_cache[key] = spec
         return self._jit_cache[key]
@@ -970,20 +969,26 @@ class ServingEngine:
     def _decode_once_spec(self, active_idx: list[int]) -> Optional[int]:
         """One speculative round: active slots draft continuation tokens
         from their own history (prompt-lookup), one forward verifies the
-        whole window, and greedy rows keep the longest draft prefix that
-        matches the model's own argmax — token-identical to sequential
-        greedy decoding, but amortizing the per-call weight streaming
-        over every accepted token. KV for rejected draft positions sits
-        past the session length and is overwritten by later writes (the
-        same overrun contract as the chunked scan path).
+        whole window via speculative sampling (sampler.spec_verify) —
+        greedy rows keep the longest draft prefix matching the model's
+        own argmax (token-identical to sequential decoding); stochastic
+        rows accept each draft with the target distribution's own
+        probability and emit a residual draw on rejection (exactly
+        preserving their sampling distribution). Every accepted token
+        amortizes the per-call weight streaming. KV for rejected draft
+        positions sits past the session length and is overwritten by
+        later writes (the same overrun contract as the chunked scan
+        path).
 
-        Returns None (caller runs the chunked scan path) when no row
-        drafted anything — stochastic rows and non-repetitive contexts
-        must not pay the wider forward for nothing."""
+        Returns None (caller runs the chunked scan path, which
+        amortizes host round-trips) when no row drafted anything — i.e.
+        no active context has a repeating n-gram this round."""
         gamma = self.spec_tokens
         width = gamma + 1
 
-        # draft first: only greedy rows with token budget propose
+        # draft first: any row with token budget proposes (greedy rows
+        # verify by argmax; stochastic rows by speculative sampling —
+        # both exactly preserve their decoding distribution)
         drafts: dict[int, tuple[int, list[int]]] = {}
         n_proposed = 0
         for i in active_idx:
@@ -993,7 +998,7 @@ class ServingEngine:
                 t.prompt_tokens[-1]
             p: list[int] = []
             remaining = t.sampling.max_new_tokens - len(t.new_tokens)
-            if t.sampling.temperature == 0.0 and remaining > 1:
+            if remaining > 1:
                 p = propose_ngram(
                     sess.history + [last], min(gamma, remaining - 1)
                 )
@@ -1040,7 +1045,7 @@ class ServingEngine:
         spec = self._spec_fn(width)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode_spec"):
-            greedy_d, sampled_d, self.cache = spec(
+            accept_d, residual_d, plain_d, self.cache = spec(
                 self.params,
                 self.cache,
                 self._place_batch(tokens),
@@ -1051,8 +1056,9 @@ class ServingEngine:
                 self._place_batch(top_ps),
                 self._place_batch(top_ks),
             )
-            greedy = np.asarray(greedy_d)     # [B, width]
-            sampled = np.asarray(sampled_d)   # [B]
+            accept = np.asarray(accept_d)     # [B, width-1]
+            residual = np.asarray(residual_d)  # [B, width-1]
+            plain = np.asarray(plain_d)       # [B, width]
         self._stats["decode_steps"] += 1
         self._stats["spec_rounds"] += 1
         self._stats["spec_proposed"] += sum(
@@ -1062,16 +1068,17 @@ class ServingEngine:
         for i in active_idx:
             turn = self._active[i]
             sess = self.sessions[turn.session_id]
-            if turn.sampling.temperature == 0.0:
-                # longest draft prefix matching the model's own argmax
-                accepted = 0
-                for j, p in enumerate(props[i]):
-                    if p != int(greedy[i, j]):
-                        break
-                    accepted += 1
-                emitted = [int(greedy[i, j]) for j in range(accepted + 1)]
+            n = len(props[i])
+            a = 0
+            while a < n and accept[i, a]:
+                a += 1
+            if a < n:
+                # first rejection: emit the residual draw (for greedy
+                # rows that's the argmax — identical to plain decoding)
+                emitted = props[i][:a] + [int(residual[i, a])]
             else:
-                emitted = [int(sampled[i])]
+                # every draft accepted: bonus token from position n
+                emitted = props[i][:n] + [int(plain[i, n])]
             for j, tok in enumerate(emitted):
                 # token j's KV was written at sess.length by the verify
                 # forward (the final emitted token stays pending, like

@@ -47,16 +47,15 @@ def sample(
 SAMPLE_FAST_K = 128
 
 
-def sample_batched(
-    logits: jax.Array,        # [B, V]
-    key: jax.Array,
-    temperature: jax.Array,   # [B] (0 = greedy for that row)
-    top_p: jax.Array,         # [B] (1 = off)
-    top_k: jax.Array,         # [B] int32 (0 = off for that row)
+def masked_scaled_logits(
+    logits: jax.Array,        # [B, V] float32
+    temperature: jax.Array,   # [B]
+    top_p: jax.Array,         # [B]
+    top_k: jax.Array,         # [B]
 ) -> jax.Array:
-    """Per-row sampling knobs as arrays so one compiled decode step serves
-    heterogeneous turns in the same batch. top_k is per-row: a row with
-    top_k=0 samples the full vocabulary regardless of its batchmates.
+    """Temperature-scaled, top-k/top-p-masked logits: the categorical
+    over a row of these IS that row's sampling distribution (rows with
+    temperature 0 are handled by callers via argmax).
 
     Fast path: LLM next-token distributions are peaked, so the top-p
     cutoff almost always lies within the top ``SAMPLE_FAST_K`` logits —
@@ -64,21 +63,14 @@ def sample_batched(
     every decode step). A `lax.cond` falls back to the exact full sort
     whenever any row's top-K prefix doesn't cover its top_p mass (or
     requests top_k > K), so the result is bit-identical to the sorted
-    reference in all cases (`_sample_batched_sorted`, which also serves
-    as the test oracle).
-    """
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1)
-
+    reference in all cases."""
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
     vocab = logits.shape[-1]
 
     if vocab <= SAMPLE_FAST_K * 2:
-        masked = _mask_sorted(scaled, jnp.sort(scaled, axis=-1)[:, ::-1],
-                              top_p, top_k, vocab)
-        sampled = jax.random.categorical(key, masked, axis=-1)
-        return jnp.where(temperature > 0, sampled, greedy)
+        return _mask_sorted(scaled, jnp.sort(scaled, axis=-1)[:, ::-1],
+                            top_p, top_k, vocab)
 
     kk = SAMPLE_FAST_K
     top_vals = jax.lax.top_k(scaled, kk)[0]           # [B, K] descending
@@ -95,9 +87,95 @@ def sample_batched(
             vocab,
         )
 
-    masked = jax.lax.cond(prefix_ok, fast, slow, None)
+    return jax.lax.cond(prefix_ok, fast, slow, None)
+
+
+def sample_batched(
+    logits: jax.Array,        # [B, V]
+    key: jax.Array,
+    temperature: jax.Array,   # [B] (0 = greedy for that row)
+    top_p: jax.Array,         # [B] (1 = off)
+    top_k: jax.Array,         # [B] int32 (0 = off for that row)
+) -> jax.Array:
+    """Per-row sampling knobs as arrays so one compiled decode step serves
+    heterogeneous turns in the same batch. top_k is per-row: a row with
+    top_k=0 samples the full vocabulary regardless of its batchmates.
+    (`_sample_batched_sorted` is the full-sort test oracle.)"""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    masked = masked_scaled_logits(logits, temperature, top_p, top_k)
     sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def spec_verify(
+    logits: jax.Array,        # [B, W, V] at the verify window positions
+    drafts: jax.Array,        # [B, W-1] proposed continuation tokens
+    key: jax.Array,
+    temperature: jax.Array,   # [B]
+    top_p: jax.Array,         # [B]
+    top_k: jax.Array,         # [B]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative-sampling verification (Leviathan et al.) with a
+    DETERMINISTIC draft distribution (prompt-lookup proposes exactly one
+    candidate, so q(d)=1 and the acceptance probability is simply the
+    target distribution's p(d)).
+
+    Returns per position:
+      accept   [B, W-1] — draft j is kept iff all of 0..j accepted
+      residual [B, W-1] — token to emit at the first rejection: a draw
+                          from the renormalized target-minus-draft
+                          distribution (exactly preserves the target)
+      plain    [B, W]   — ordinary sample at each position (used for
+                          the bonus token when every draft is accepted,
+                          and for rows that proposed nothing)
+
+    Rows with temperature 0 reduce to argmax verification: accept iff
+    the draft IS the argmax; residual/plain are the argmax (removing a
+    rejected, non-argmax draft cannot change it) — identical to greedy
+    decoding."""
+    b, w, v = logits.shape
+    flat = logits.reshape(b * w, v).astype(jnp.float32)
+    rep = lambda x: jnp.repeat(x, w)                    # noqa: E731
+    masked = masked_scaled_logits(
+        flat, rep(temperature), rep(top_p), rep(top_k)
+    )
+    argmax_full = jnp.argmax(flat, axis=-1)             # [B*W]
+
+    k_u, k_resid, k_plain = jax.random.split(key, 3)
+    stoch = (rep(temperature) > 0)
+
+    plain_flat = jnp.where(
+        stoch,
+        jax.random.categorical(k_plain, masked, axis=-1),
+        argmax_full,
+    )
+    plain = plain_flat.reshape(b, w)
+
+    # acceptance of draft j happens against position j's distribution
+    d_flat = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), drafts.dtype)], axis=1
+    ).reshape(b * w)
+    mx = jnp.max(masked, axis=-1, keepdims=True)
+    exp_m = jnp.where(jnp.isfinite(masked), jnp.exp(masked - mx), 0.0)
+    denom = jnp.sum(exp_m, axis=-1)
+    p_draft = jnp.take_along_axis(
+        exp_m, d_flat[:, None], axis=-1
+    )[:, 0] / jnp.maximum(denom, 1e-30)
+    u = jax.random.uniform(k_u, (b * w,))
+    accept_flat = jnp.where(
+        stoch, u < p_draft, d_flat == argmax_full
+    )
+
+    resid_logits = masked.at[jnp.arange(b * w), d_flat].set(-jnp.inf)
+    residual_flat = jnp.where(
+        stoch,
+        jax.random.categorical(k_resid, resid_logits, axis=-1),
+        jnp.argmax(resid_logits, axis=-1),
+    )
+    accept = accept_flat.reshape(b, w)[:, : w - 1]
+    residual = residual_flat.reshape(b, w)[:, : w - 1]
+    return accept, residual, plain
 
 
 def _mask_sorted(

@@ -7,6 +7,7 @@ so every accepted draft token divides the HBM-bandwidth bill.
 """
 
 import jax
+import numpy as np
 import pytest
 
 from room_tpu.models import qwen3, tiny_moe
@@ -129,9 +130,76 @@ def test_spec_session_continuation(setup):
     assert spec == base
 
 
+def test_spec_verify_greedy_reduction():
+    """temperature=0 rows reduce to argmax verification: accept iff the
+    draft IS the argmax, and the rejection emission is the argmax."""
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import spec_verify
+
+    logits = jnp.asarray([[[0.0, 3.0, 1.0, 2.0],
+                           [5.0, 0.0, 1.0, 2.0],
+                           [0.0, 0.0, 9.0, 2.0]]])   # argmax: 1, 0, 2
+    drafts = jnp.asarray([[1, 3]])   # draft0 == argmax, draft1 != argmax
+    accept, residual, plain = spec_verify(
+        logits, drafts, jax.random.PRNGKey(0),
+        jnp.zeros((1,)), jnp.ones((1,)), jnp.zeros((1,), jnp.int32),
+    )
+    assert accept[0, 0] and not accept[0, 1]
+    assert int(residual[0, 1]) == 0      # argmax at the rejected slot
+    assert plain[0].tolist() == [1, 0, 2]
+
+
+def test_spec_verify_preserves_distribution():
+    """The accept/residual scheme must exactly preserve the target
+    sampling distribution: P(emit = x) = p(x) regardless of the draft
+    (Leviathan et al. with a deterministic draft)."""
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import spec_verify
+
+    base = jnp.asarray([2.0, 1.0, 0.5, 0.0])
+    target = np.asarray(jax.nn.softmax(base / 0.8))
+    n = 20_000
+
+    def one(key):
+        accept, residual, _ = spec_verify(
+            base[None, None, :].repeat(2, axis=1),  # W=2: draft pos + bonus
+            jnp.asarray([[2]]),                     # draft a mid-prob token
+            key,
+            jnp.asarray([0.8]), jnp.ones((1,)),
+            jnp.zeros((1,), jnp.int32),
+        )
+        return jnp.where(accept[0, 0], 2, residual[0, 0])
+
+    toks = np.asarray(jax.vmap(one)(
+        jax.random.split(jax.random.PRNGKey(1), n)
+    ))
+    freq = np.bincount(toks, minlength=4) / n
+    np.testing.assert_allclose(freq, target, atol=0.015)
+
+
+def test_spec_top_k1_matches_greedy(setup):
+    """top_k=1 sampling is a delta distribution, so a stochastic spec
+    run must emit exactly the greedy sequence (drafting included)."""
+    cfg, params = setup
+    prompt = [5, 6, 7, 5, 6, 7, 5, 6]
+
+    base_eng = make_engine(cfg, params, spec_tokens=0)
+    want = base_eng.submit(prompt, sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=10))
+    base_eng.run_until_idle()
+
+    eng = make_engine(cfg, params, spec_tokens=4)
+    got = eng.submit(prompt, sampling=SamplingParams(
+        temperature=0.9, top_k=1, max_new_tokens=10))
+    eng.run_until_idle()
+    assert got.new_tokens == want.new_tokens
+
+
 def test_spec_stochastic_rows_complete(setup):
-    """Sampling rows fall back to one token per round but still finish
-    alongside greedy batchmates."""
+    """Sampling rows draft too (speculative sampling keeps their exact
+    distribution) and finish alongside greedy batchmates."""
     cfg, params = setup
     eng = make_engine(cfg, params, spec_tokens=4)
     greedy = eng.submit(
