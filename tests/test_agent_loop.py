@@ -234,6 +234,101 @@ def test_send_message_to_keeper_and_room(db, room):
     assert len(messages.unread_messages(db, other["id"])) == 1
 
 
+def test_quiet_hours_window(db, room, monkeypatch):
+    from datetime import datetime
+
+    def at(hhmm):
+        """Freeze the loop's clock at hh:mm (deterministic at any CI
+        wall time, incl. the first hour after midnight)."""
+        h, m = (int(x) for x in hhmm.split(":"))
+
+        class Frozen:
+            @staticmethod
+            def now():
+                return datetime(2026, 1, 15, h, m)
+
+        monkeypatch.setattr(agent_loop, "datetime", Frozen)
+
+    r = dict(rooms.get_room(db, room["id"]))
+    # no window configured -> never quiet
+    r["queen_quiet_from"] = r["queen_quiet_until"] = None
+    at("12:00")
+    assert not agent_loop._in_quiet_hours(r)
+    # same-day window
+    r["queen_quiet_from"], r["queen_quiet_until"] = "09:00", "17:00"
+    at("12:00")
+    assert agent_loop._in_quiet_hours(r)
+    at("08:59")
+    assert not agent_loop._in_quiet_hours(r)
+    at("17:00")   # end is exclusive
+    assert not agent_loop._in_quiet_hours(r)
+    # midnight-crossing window 22:00-07:00
+    r["queen_quiet_from"], r["queen_quiet_until"] = "22:00", "07:00"
+    for hhmm, quiet in (("23:30", True), ("00:30", True),
+                        ("06:59", True), ("07:00", False),
+                        ("12:00", False)):
+        at(hhmm)
+        assert agent_loop._in_quiet_hours(r) is quiet, hhmm
+
+
+def test_wip_momentum_shortens_gap(db, room, echo):
+    queen = queen_of(db, room)
+    rooms.update_room(db, room["id"], queen_cycle_gap_ms=1_800_000)
+    r = rooms.get_room(db, room["id"])
+    gap = agent_loop._cycle_gap_s(db, r, queen)
+    assert gap == 1800.0
+    workers.update_worker(db, queen["id"], wip="mid-flight work note")
+    gap = agent_loop._cycle_gap_s(db, r, queen)
+    assert gap == agent_loop.WIP_MOMENTUM_GAP_S
+
+
+def test_worker_gap_overrides_room_gap(db, room, echo):
+    wid = workers.create_worker(
+        db, "fast", "p", room_id=room["id"], cycle_gap_ms=5_000
+    )
+    w = workers.get_worker(db, wid)
+    r = rooms.get_room(db, room["id"])
+    assert agent_loop._cycle_gap_s(db, r, w) == 5.0
+
+
+def test_cycle_prune_keeps_recent(db, room, echo):
+    queen = queen_of(db, room)
+    for _ in range(5):
+        agent_loop.run_cycle(db, room, queen)
+    agent_loop._prune_old_cycles(db, room["id"], keep=2)
+    left = db.query(
+        "SELECT id FROM worker_cycles WHERE room_id=? ORDER BY id",
+        (room["id"],),
+    )
+    assert len(left) == 2
+    # newest survive
+    all_max = db.query_one(
+        "SELECT MAX(id) AS m FROM worker_cycles")["m"]
+    assert left[-1]["id"] == all_max
+
+
+def test_failed_cycle_records_error(db, room, echo):
+    echo.fail_with = "provider exploded"
+    queen = queen_of(db, room)
+    cycle = agent_loop.run_cycle(db, room, queen)
+    assert cycle["status"] == "error"
+    assert "provider exploded" in (cycle["error_message"] or "")
+
+
+def test_trigger_agent_cold_start_requires_flag(db, room, echo):
+    queen = queen_of(db, room)
+    agent_loop.set_room_launch_enabled(room["id"], False)
+    assert not agent_loop.trigger_agent(
+        db, room["id"], queen["id"], allow_cold_start=False
+    )
+    assert agent_loop.trigger_agent(
+        db, room["id"], queen["id"], allow_cold_start=True
+    )
+    # loop is now live; clean up
+    agent_loop.pause_agent(queen["id"])
+    agent_loop.stop_room_loops(db, room["id"], "test done")
+
+
 def test_loop_thread_lifecycle(db, room, echo):
     queen = queen_of(db, room)
     # long gap so the loop sleeps after one cycle

@@ -102,6 +102,80 @@ def test_keeper_vote_on_announcement(db, room):
     assert d2["status"] == "objected"
 
 
+def test_ballot_two_thirds_threshold(db, room):
+    # electorate = queen + 2 workers = 3; two_thirds needs 3 (int(3*2/3)+1)
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    w2 = workers.create_worker(db, "w2", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "migrate stack",
+                           threshold="two_thirds")
+    quorum.vote(db, d["id"], w1, "yes")
+    quorum.vote(db, d["id"], w2, "yes")
+    assert quorum.get_decision(db, d["id"])["status"] == "voting"
+    quorum.vote(db, d["id"], room["queen_worker_id"], "yes")
+    assert quorum.get_decision(db, d["id"])["status"] == "passed"
+
+
+def test_ballot_unanimous_one_no_rejects(db, room):
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "rewrite in cobol",
+                           threshold="unanimous")
+    quorum.vote(db, d["id"], w1, "no")
+    # yes can never reach electorate once a no is in
+    assert quorum.get_decision(db, d["id"])["status"] == "rejected"
+
+
+def test_ballot_early_rejection_when_unreachable(db, room):
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    w2 = workers.create_worker(db, "w2", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "p")   # majority of 3 = 2
+    quorum.vote(db, d["id"], w1, "no")
+    quorum.vote(db, d["id"], w2, "no")
+    # 1 remaining voter can bring yes to at most 1 < 2
+    assert quorum.get_decision(db, d["id"])["status"] == "rejected"
+
+
+def test_ballot_min_voters_raises_bar(db, room):
+    # electorate floor via min_voters: one room worker but min 3 voters
+    d = quorum.open_ballot(db, room["id"], None, "p", min_voters=3)
+    quorum.vote(db, d["id"], room["queen_worker_id"], "yes")
+    # 1 yes < majority of 3 (=2); and 2 remaining seats exist, not decided
+    assert quorum.get_decision(db, d["id"])["status"] == "voting"
+
+
+def test_keeper_vote_counts_in_ballot_tally(db, room):
+    w1 = workers.create_worker(db, "w1", "p", room_id=room["id"])
+    w2 = workers.create_worker(db, "w2", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "p")   # majority of 3 = 2
+    quorum.vote(db, d["id"], w1, "yes")
+    assert quorum.get_decision(db, d["id"])["status"] == "voting"
+    d2 = quorum.keeper_vote(db, d["id"], "yes")
+    assert d2["status"] == "passed"
+    assert w2  # silent voter never needed
+
+
+def test_expired_ballot_with_undecided_tally_expires(db, room):
+    workers.create_worker(db, "w1", "p", room_id=room["id"])
+    d = quorum.open_ballot(db, room["id"], None, "p",
+                           timeout_minutes=-1)   # already past deadline
+    assert quorum.check_expired_decisions(db) == 1
+    assert quorum.get_decision(db, d["id"])["status"] == "expired"
+
+
+def test_object_rejected_after_effective(db, room):
+    d = quorum.announce(db, room["id"], None, "p", "high_impact",
+                        delay_minutes=-1)
+    quorum.check_expired_decisions(db)
+    assert quorum.get_decision(db, d["id"])["status"] == "effective"
+    with pytest.raises(quorum.QuorumError):
+        quorum.object_to(db, d["id"], 1, "too late")
+
+
+def test_invalid_vote_value_rejected(db, room):
+    d = quorum.open_ballot(db, room["id"], None, "p")
+    with pytest.raises(quorum.QuorumError):
+        quorum.vote(db, d["id"], room["queen_worker_id"], "maybe")
+
+
 # ---- memory ----
 
 def test_remember_and_fts_recall(db, room):

@@ -121,6 +121,47 @@ def test_room_crud_over_http(server):
     assert status == 404
 
 
+def test_room_settings_round_trip(server):
+    """The dashboard's full settings form: every field the panel PUTs
+    must persist and read back (reference: RoomSettingsPanel.tsx)."""
+    import json as json_mod
+
+    _, out = req(server, "POST", "/api/rooms",
+                 {"name": "cfg-room", "workerModel": "echo",
+                  "createWallet": False})
+    rid = out["data"]["id"]
+    payload = {
+        "goal": "tuned", "autonomyMode": "semi",
+        "visibility": "public", "workerModel": "echo",
+        "queenNickname": "Her Majesty",
+        "queenCycleGapMs": 300000, "queenMaxTurns": 75,
+        "queenQuietFrom": "22:00", "queenQuietUntil": "07:00",
+        "maxConcurrentTasks": 5,
+        "config": {
+            "voteThreshold": "two_thirds", "voteTimeoutMinutes": 20,
+            "queenTieBreaker": False, "sealedBallot": True,
+            "autoApprove": [],
+        },
+    }
+    status, out = req(server, "PUT", f"/api/rooms/{rid}", payload)
+    assert status == 200
+    r = out["data"]
+    assert (r["goal"], r["autonomy_mode"], r["visibility"]) == \
+        ("tuned", "semi", "public")
+    assert r["queen_nickname"] == "Her Majesty"
+    assert r["queen_cycle_gap_ms"] == 300000
+    assert r["queen_max_turns"] == 75
+    assert (r["queen_quiet_from"], r["queen_quiet_until"]) == \
+        ("22:00", "07:00")
+    assert r["max_concurrent_tasks"] == 5
+    cfg = json_mod.loads(r["config"])
+    assert cfg["voteThreshold"] == "two_thirds"
+    assert cfg["voteTimeoutMinutes"] == 20
+    assert cfg["queenTieBreaker"] is False
+    assert cfg["sealedBallot"] is True
+    assert cfg["autoApprove"] == []
+
+
 def test_room_start_runs_real_cycle(server):
     reset_provider_cache()
     echo = get_model_provider("echo")

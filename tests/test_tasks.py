@@ -168,6 +168,80 @@ def test_builtin_keeper_reminder(db, room):
 
 # ---- runtime ----
 
+def test_task_session_rotation_after_20_runs(db, room, echo):
+    tid = task_runner.create_task(
+        db, "steady", "keep going", trigger_type="manual",
+        room_id=room["id"], session_continuity=True,
+    )
+    # run_count 19 with a live session: next run keeps it (the echo
+    # provider echoes the session id it was resumed with)
+    db.execute("UPDATE tasks SET run_count=19, session_id='sess-old' "
+               "WHERE id=?", (tid,))
+    echo.responses.append("ok")
+    task_runner.execute_task(db, tid)
+    assert task_runner.get_task(db, tid)["session_id"] == "sess-old"
+    # run 20 (run_count now 20): next execute rotates the session away
+    db.execute("UPDATE tasks SET run_count=20, session_id='sess-old' "
+               "WHERE id=?", (tid,))
+    echo.responses.append("ok again")
+    task_runner.execute_task(db, tid)
+    t = task_runner.get_task(db, tid)
+    assert t["session_id"] != "sess-old"
+
+
+def test_task_error_result_has_no_file_but_counts(db, room, echo):
+    echo.fail_with = "boom"
+    tid = task_runner.create_task(db, "fragile", "p",
+                                  trigger_type="manual",
+                                  room_id=room["id"])
+    run = task_runner.execute_task(db, tid)
+    assert run["status"] == "error"
+    assert run["result_file"] is None
+    t = task_runner.get_task(db, tid)
+    assert t["error_count"] == 1
+    # a subsequent success resets the error streak
+    echo.fail_with = None
+    echo.responses.append("recovered")
+    task_runner.execute_task(db, tid)
+    assert task_runner.get_task(db, tid)["error_count"] == 0
+
+
+def test_cancel_running_tasks_for_room(db, room):
+    tid = task_runner.create_task(db, "t", "p", trigger_type="manual",
+                                  room_id=room["id"])
+    rid = db.insert(
+        "INSERT INTO task_runs(task_id, status) VALUES (?, 'running')",
+        (tid,),
+    )
+    n = task_runner.cancel_running_tasks_for_room(db, room["id"])
+    assert n == 1
+    run = db.query_one("SELECT * FROM task_runs WHERE id=?", (rid,))
+    assert run["status"] == "cancelled"
+
+
+def test_builtin_contact_check_notes_clerk(db, room):
+    tid = task_runner.create_task(
+        db, "contact check", "check in", trigger_type="once",
+        room_id=room["id"], executor="keeper_contact_check",
+    )
+    run = task_runner.execute_task(db, tid)
+    assert run["status"] == "success"
+    msg = db.query_one(
+        "SELECT * FROM clerk_messages WHERE source='contact_check'"
+    )
+    assert msg and "keeper" in msg["content"].lower()
+    # with a configured channel the note names it instead
+    from room_tpu.core.messages import set_setting
+
+    set_setting(db, "keeper_email", "k@example.com")
+    tid2 = task_runner.create_task(
+        db, "contact check 2", "check in", trigger_type="once",
+        room_id=room["id"], executor="keeper_contact_check",
+    )
+    run2 = task_runner.execute_task(db, tid2)
+    assert "keeper_email" in run2["result"]
+
+
 def test_runtime_cron_fires_due_tasks(db, room, echo):
     rt = ServerRuntime(db=db)
     echo.responses.append("cron ran")

@@ -206,17 +206,72 @@ async function selectRoom(id) {
       <input id="credValue" placeholder="secret value" type="password">
       <button class="ghost" onclick="credAdd(${id})">store</button>
     </div>
-    <h2 style="margin-top:.8rem">room config</h2>
-    <div class="row">
-      <select id="roomAutonomy">
-        ${["full", "semi", "manual"].map(m =>
-          `<option value="${m}"${s.room?.autonomy_mode === m
-            ? " selected" : ""}>${m}</option>`).join("")}
-      </select>
-      <input id="roomGoalEdit" placeholder="objective…"
-             value="${esc(s.room?.goal || "")}">
-      <button class="ghost" onclick="roomConfigSave(${id})">save</button>
-    </div>
+    <h2 style="margin-top:.8rem">room settings</h2>
+    ${(() => {
+      const r = s.room || {};
+      let cfg = {};
+      try { cfg = JSON.parse(r.config || "{}"); } catch {}
+      // stash for save: unknown config keys must survive a panel save,
+      // and a blank/invalid gap must keep the current value
+      roomDetailCtx = {cfg, gapMs: r.queen_cycle_gap_ms ?? 1800000};
+      const sel = (id_, opts, cur) => `<select id="${id_}">${opts.map(o =>
+        `<option value="${o}"${String(cur) === String(o)
+          ? " selected" : ""}>${o}</option>`).join("")}</select>`;
+      return `
+      <div class="kv">
+        <span class="k">objective</span>
+          <input id="roomGoalEdit" value="${esc(r.goal || "")}">
+        <span class="k">autonomy</span>
+          ${sel("roomAutonomy", ["full", "semi", "manual"],
+                r.autonomy_mode || "full")}
+        <span class="k">visibility</span>
+          ${sel("roomVisibility", ["private", "public"],
+                r.visibility || "private")}
+        <span class="k">worker model</span>
+          <input id="roomWorkerModel"
+                 value="${esc(r.worker_model || "tpu")}">
+        <span class="k">queen nickname</span>
+          <input id="roomNickname"
+                 value="${esc(r.queen_nickname || "")}">
+        <span class="k">cycle gap (min)</span>
+          <input id="roomCycleGap" type="number" min="0.05" step="any"
+                 value="${(r.queen_cycle_gap_ms ?? 1800000) / 60000}">
+        <span class="k">max turns / cycle</span>
+          <input id="roomMaxTurns" type="number" min="1"
+                 value="${r.queen_max_turns ?? 50}">
+        <span class="k">quiet hours</span>
+          <span class="row" style="margin:0">
+            <input id="roomQuietFrom" type="time"
+                   value="${esc(r.queen_quiet_from || "")}">
+            <input id="roomQuietUntil" type="time"
+                   value="${esc(r.queen_quiet_until || "")}">
+          </span>
+        <span class="k">parallel tasks</span>
+          <input id="roomMaxTasks" type="number" min="1" max="10"
+                 value="${r.max_concurrent_tasks ?? 3}">
+        <span class="k">vote threshold</span>
+          ${sel("cfgThreshold",
+                ["majority", "two_thirds", "unanimous"],
+                cfg.voteThreshold || "majority")}
+        <span class="k">vote timeout (min)</span>
+          <input id="cfgVoteTimeout" type="number" min="1"
+                 value="${cfg.voteTimeoutMinutes ?? 10}">
+        <span class="k">queen tie-breaker</span>
+          <input id="cfgTieBreaker" type="checkbox"
+                 ${cfg.queenTieBreaker !== false ? "checked" : ""}>
+        <span class="k">sealed ballots</span>
+          <input id="cfgSealed" type="checkbox"
+                 ${cfg.sealedBallot ? "checked" : ""}>
+        <span class="k">auto-approve low impact</span>
+          <input id="cfgAutoApprove" type="checkbox"
+                 ${(cfg.autoApprove || ["low_impact"])
+                   .includes("low_impact") ? "checked" : ""}>
+      </div>
+      <div class="row">
+        <button class="act" onclick="roomConfigSave(${id})">
+          save settings</button>
+      </div>`;
+    })()}
     <h2 style="margin-top:.8rem">chat with the queen</h2>
     <div class="log" id="roomChat">${(chat.data || []).map(m =>
       `<div><span class="t">${esc(m.role)}</span>${esc(m.content)}</div>`
@@ -263,10 +318,35 @@ async function credDelete(id, name) {
   selectRoom(id);
 }
 
+let roomDetailCtx = {cfg: {}, gapMs: 1800000};
+
 async function roomConfigSave(id) {
+  const gapMin = parseFloat($("roomCycleGap").value);
   await api("PUT", `/api/rooms/${id}`, {
-    autonomyMode: $("roomAutonomy").value,
     goal: $("roomGoalEdit").value.trim(),
+    autonomyMode: $("roomAutonomy").value,
+    visibility: $("roomVisibility").value,
+    workerModel: $("roomWorkerModel").value.trim() || "tpu",
+    queenNickname: $("roomNickname").value.trim(),
+    // blank or non-positive input keeps the stored gap (0 would make
+    // the loop spin back-to-back cycles)
+    queenCycleGapMs: gapMin > 0 ? Math.round(gapMin * 60000)
+      : roomDetailCtx.gapMs,
+    queenMaxTurns: parseInt($("roomMaxTurns").value, 10) || 50,
+    queenQuietFrom: $("roomQuietFrom").value.trim() || null,
+    queenQuietUntil: $("roomQuietUntil").value.trim() || null,
+    maxConcurrentTasks: parseInt($("roomMaxTasks").value, 10) || 3,
+    // spread the loaded config so keys this panel doesn't render
+    // (e.g. minVoterHealth) survive a save
+    config: {
+      ...roomDetailCtx.cfg,
+      voteThreshold: $("cfgThreshold").value,
+      voteTimeoutMinutes:
+        parseInt($("cfgVoteTimeout").value, 10) || 10,
+      queenTieBreaker: $("cfgTieBreaker").checked,
+      sealedBallot: $("cfgSealed").checked,
+      autoApprove: $("cfgAutoApprove").checked ? ["low_impact"] : [],
+    },
   });
   selectRoom(id);
 }
