@@ -327,6 +327,9 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
         _auto_wip(db, worker, result)
 
         status = "success" if result.success else "error"
+        # flush buffered logs BEFORE the row flips to finished: a reader
+        # that sees status=success must also see the cycle's logs
+        logs.flush()
         db.execute(
             "UPDATE worker_cycles SET finished_at=?, status=?, "
             "error_message=?, duration_ms=?, input_tokens=?, "

@@ -270,7 +270,7 @@ class ApiServer:
                     ))
                     return
 
-                if not path.startswith("/api/"):
+                if not path.startswith(("/api/", "/v1/")):
                     self._serve_static(path)
                     return
 
@@ -316,12 +316,46 @@ class ApiServer:
                 )
                 out = handler(ctx)
                 status = out.get("status", 200)
+                if "sse" in out:
+                    self._respond_sse(status, out["sse"])
+                    return
+                if path.startswith("/v1/"):
+                    # OpenAI wire shapes, not the internal envelope
+                    if out.get("error"):
+                        payload = {"error": {
+                            "message": out["error"],
+                            "type": "invalid_request_error",
+                        }}
+                    else:
+                        payload = out.get("data", {})
+                    self._respond(status, payload)
+                    return
                 payload = {"status": status}
                 if "data" in out:
                     payload["data"] = out["data"]
                 if out.get("error"):
                     payload["error"] = out["error"]
                 self._respond(status, payload)
+
+            def _respond_sse(self, status: int, events) -> None:
+                """Server-sent events (OpenAI streaming): no
+                Content-Length, connection closes to delimit."""
+                self._drain_unread_body()
+                self.send_response(status)
+                self._common_headers()
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.close_connection = True
+                self.end_headers()
+                try:
+                    for item in events:
+                        data = item if isinstance(item, str) \
+                            else json.dumps(item)
+                        self.wfile.write(f"data: {data}\n\n".encode())
+                        self.wfile.flush()
+                except BrokenPipeError:
+                    pass
 
             def _serve_static(self, path: str) -> None:
                 """SPA static serving with traversal guard (reference

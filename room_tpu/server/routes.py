@@ -4,6 +4,7 @@ grouped here by domain, same /api contract shape {status,data,error})."""
 from __future__ import annotations
 
 import json
+import os
 import re
 from typing import Any
 
@@ -58,6 +59,257 @@ def register_all_routes(r: Router) -> None:
     register_provider_routes(r)
     register_contact_routes(r)
     register_aux_routes(r)
+    register_openai_routes(r)
+
+
+def register_openai_routes(r: Router) -> None:
+    """OpenAI-compatible inference surface — the drop-in equivalent of
+    the Ollama endpoint the reference points every OpenAI-style client
+    at (reference: src/shared/local-model.ts:3-5 pins
+    127.0.0.1:11434/v1/chat/completions; agent-executor.ts:327-338).
+    Any OpenAI SDK pointed at this server with its API token chats with
+    the TPU-served models. http.py unwraps /v1/ responses from the
+    internal envelope and streams ``sse`` payloads as server-sent
+    events."""
+
+    def models(ctx):
+        from ..providers.tpu import MODEL_CONFIGS, get_model_host
+
+        data = []
+        for name in sorted(MODEL_CONFIGS):
+            ready, _ = get_model_host(name).readiness()
+            data.append({
+                "id": f"tpu:{name}", "object": "model",
+                "owned_by": "room_tpu", "ready": ready,
+            })
+        return ok({"object": "list", "data": data})
+
+    def chat(ctx):
+        import queue as queue_mod
+        import time as time_mod
+        import uuid
+
+        from ..providers.base import ProviderError
+        from ..providers.tpu import MODEL_CONFIGS, get_model_host
+        from ..serving import (
+            SamplingParams, extract_tool_call, render_chat,
+        )
+
+        b = ctx.body or {}
+        raw_model = b.get("model") or "tpu:qwen3-coder-30b"
+        name = raw_model[4:] if raw_model.startswith("tpu:") \
+            else raw_model
+        if name not in MODEL_CONFIGS:
+            return err(f"unknown model {raw_model!r}", 404)
+        messages = b.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return err("messages (a non-empty list) is required")
+        try:
+            engine = get_model_host(name).engine()
+        except ProviderError as e:
+            return err(str(e), 503)
+
+        tok = engine.tokenizer
+        tools = b.get("tools")
+        prompt_tokens = tok.encode(render_chat(messages, tools))
+
+        def num(key, default):
+            # OpenAI treats an explicit JSON null as "use the default"
+            v = b.get(key)
+            return default if v is None else v
+
+        sampling = SamplingParams(
+            temperature=float(num("temperature", 0.7)),
+            top_p=float(num("top_p", 1.0)),
+            max_new_tokens=int(
+                num("max_completion_tokens", None)
+                or num("max_tokens", None) or 1024
+            ),
+        )
+
+        def visible_text(token_ids):
+            """Decoded reply without chat scaffolding: trailing stop
+            tokens dropped, any literal im_end remnant stripped (same
+            posture as the internal provider)."""
+            toks = list(token_ids)
+            while toks and toks[-1] in engine.stop_token_ids:
+                toks.pop()
+            return tok.decode(toks).replace("<|im_end|>", "")
+
+        def tool_calls_of(text):
+            call = extract_tool_call(text)
+            if call is None:
+                return None
+            return [{
+                "id": f"call_{uuid.uuid4().hex[:12]}",
+                "type": "function",
+                "function": {
+                    "name": call.get("name", ""),
+                    "arguments": json.dumps(
+                        call.get("arguments", {}) or {}
+                    ),
+                },
+            }]
+        created = int(time_mod.time())
+        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        timeout_s = float(os.environ.get("ROOM_TPU_V1_TIMEOUT_S", "600"))
+        finish_map = {"stop": "stop", "length": "length",
+                      "tool_call": "tool_calls"}
+
+        if b.get("stream"):
+            q: queue_mod.Queue = queue_mod.Queue()
+            turn = engine.submit(
+                prompt_tokens, sampling=sampling, on_token=q.put
+            )
+
+            def sse():
+                ids: list[int] = []
+                committed = 0        # tokens already turned into text
+                sent = ""            # text already delivered
+                held = ""            # decoded but not yet delivered
+                deadline = time_mod.monotonic() + timeout_s
+
+                def chunk(delta, finish=None):
+                    return {
+                        "id": cid, "object": "chat.completion.chunk",
+                        "created": created, "model": raw_model,
+                        "choices": [{
+                            "index": 0, "delta": delta,
+                            "finish_reason": finish,
+                        }],
+                    }
+
+                TOOL_TAG = "<tool_call>"
+
+                def emit_new(final=False):
+                    """Incremental detokenization: decode only the
+                    uncommitted tail (linear total cost) and hold back
+                    text that ends in a replacement char (a split
+                    multi-byte sequence) or in a prefix of the
+                    tool-call tag — tool-call XML must never leak as
+                    content. ``final`` flushes everything still held."""
+                    nonlocal committed, sent, held
+                    tail = tok.decode([
+                        t for t in ids[committed:]
+                        if t not in engine.stop_token_ids
+                    ])
+                    committed = len(ids)
+                    held += tail
+                    if TOOL_TAG in held:
+                        out_text = held.split(TOOL_TAG)[0]
+                        held = ""   # XML and beyond stays unsent
+                    elif not final and held.endswith("�"):
+                        # split multi-byte sequence: wait for the rest
+                        return None
+                    else:
+                        # longest suffix that could still grow into the
+                        # tool tag stays held (unless flushing)
+                        hold_n = 0
+                        if not final:
+                            for n in range(
+                                min(len(TOOL_TAG) - 1, len(held)), 0, -1
+                            ):
+                                if TOOL_TAG.startswith(held[-n:]):
+                                    hold_n = n
+                                    break
+                        out_text = held[: len(held) - hold_n]
+                        held = held[len(held) - hold_n:]
+                    out_text = out_text.replace("<|im_end|>", "")
+                    if out_text:
+                        sent += out_text
+                        return chunk({"content": out_text})
+                    return None
+
+                try:
+                    yield chunk({"role": "assistant", "content": ""})
+                    while time_mod.monotonic() < deadline:
+                        try:
+                            ids.append(q.get(timeout=0.1))
+                        except queue_mod.Empty:
+                            if turn.done.is_set() and q.empty():
+                                break
+                            continue
+                        c = emit_new()
+                        if c is not None:
+                            yield c
+                    if turn.finish_reason == "error":
+                        # OpenAI streams signal failures as an error
+                        # event, not a normal finish
+                        yield {"error": {
+                            "message": turn.error or "generation failed",
+                            "type": "server_error",
+                        }}
+                        return
+                    ids = list(turn.new_tokens)
+                    c = emit_new(final=True)
+                    if c is not None:
+                        yield c
+                    if not turn.done.is_set():
+                        # deadline hit mid-generation: a truncated reply
+                        # must not masquerade as a clean stop
+                        finish = "length"
+                    else:
+                        finish = finish_map.get(turn.finish_reason,
+                                                "stop")
+                    if finish == "tool_calls":
+                        calls = tool_calls_of(
+                            tok.decode(turn.new_tokens)
+                        )
+                        if calls:
+                            for call in calls:
+                                call["index"] = 0
+                            yield chunk({"tool_calls": calls})
+                    yield chunk({}, finish)
+                    yield "[DONE]"
+                finally:
+                    # runs on normal completion AND client disconnect
+                    # (GeneratorExit): the one-shot session must not pin
+                    # its pages
+                    engine.release_session(turn.session_id)
+
+            return {"status": 200, "sse": sse()}
+
+        turn = engine.submit(prompt_tokens, sampling=sampling)
+        if not turn.done.wait(timeout=timeout_s):
+            # release now: deferred-release frees the pages once the
+            # in-flight turn finishes, so timeouts can't pin the pool
+            engine.release_session(turn.session_id)
+            return err("generation timed out", 504)
+        raw_text = tok.decode(turn.new_tokens)
+        engine.release_session(turn.session_id)
+        if turn.finish_reason == "error":
+            return err(turn.error or "generation failed", 500)
+
+        text = visible_text(turn.new_tokens)
+        message: dict = {"role": "assistant", "content": text}
+        finish = finish_map.get(turn.finish_reason, "stop")
+        if turn.finish_reason == "tool_call":
+            calls = tool_calls_of(raw_text)
+            if calls is not None:
+                pre = text[: text.find("<tool_call>")].strip() \
+                    if "<tool_call>" in text else ""
+                message = {
+                    "role": "assistant",
+                    "content": pre or None,
+                    "tool_calls": calls,
+                }
+        return ok({
+            "id": cid, "object": "chat.completion", "created": created,
+            "model": raw_model,
+            "choices": [{
+                "index": 0, "message": message,
+                "finish_reason": finish,
+            }],
+            "usage": {
+                "prompt_tokens": len(prompt_tokens),
+                "completion_tokens": len(turn.new_tokens),
+                "total_tokens":
+                    len(prompt_tokens) + len(turn.new_tokens),
+            },
+        })
+
+    r.get("/v1/models", models)
+    r.post("/v1/chat/completions", chat)
 
 
 def register_extended_routes(r: Router) -> None:

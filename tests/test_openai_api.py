@@ -1,0 +1,169 @@
+"""OpenAI-compatible /v1 surface: the drop-in equivalent of the Ollama
+endpoint the reference points OpenAI-style clients at
+(src/shared/local-model.ts:3-5, agent-executor.ts:327-338)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.providers.tpu import reset_model_hosts
+from room_tpu.server.http import ApiServer
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "data"))
+    db = Database(":memory:")
+    srv = ApiServer(db)
+    srv.start()
+    reset_model_hosts()
+    yield srv
+    reset_model_hosts()
+    srv.stop()
+
+
+def call(server, method, path, body=None, token=True, raw=False):
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {server.tokens['agent']}"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers, method=method,
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=300) as resp:
+            data = resp.read()
+            return resp.status, data if raw else json.loads(data)
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, data if raw else json.loads(data)
+
+
+def test_v1_models_lists_tpu_models(server):
+    status, out = call(server, "GET", "/v1/models")
+    assert status == 200
+    assert out["object"] == "list"
+    ids = {m["id"] for m in out["data"]}
+    assert "tpu:qwen3-coder-30b" in ids and "tpu:tiny-moe" in ids
+    tiny = next(m for m in out["data"] if m["id"] == "tpu:tiny-moe")
+    assert tiny["ready"] is True
+
+
+def test_v1_requires_auth(server):
+    status, out = call(server, "GET", "/v1/models", token=False)
+    assert status == 401
+
+
+def test_v1_chat_completion(server):
+    status, out = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [
+            {"role": "system", "content": "you are terse"},
+            {"role": "user", "content": "say something"},
+        ],
+        "max_tokens": 6,
+        "temperature": 0,
+    })
+    assert status == 200, out
+    # OpenAI wire shape, not the internal {status,data} envelope
+    assert out["object"] == "chat.completion"
+    assert out["id"].startswith("chatcmpl-")
+    choice = out["choices"][0]
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    u = out["usage"]
+    assert u["prompt_tokens"] > 0 and 1 <= u["completion_tokens"] <= 6
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+
+def test_v1_chat_unknown_model_openai_error_shape(server):
+    status, out = call(server, "POST", "/v1/chat/completions", {
+        "model": "gpt-4o", "messages": [{"role": "user", "content": "x"}],
+    })
+    assert status == 404
+    assert out["error"]["message"].startswith("unknown model")
+    assert out["error"]["type"] == "invalid_request_error"
+
+
+def test_v1_chat_validates_messages(server):
+    status, out = call(server, "POST", "/v1/chat/completions",
+                       {"model": "tpu:tiny-moe"})
+    assert status == 400
+    assert "messages" in out["error"]["message"]
+
+
+def test_v1_chat_streaming_sse(server):
+    status, body = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "stream it"}],
+        "max_tokens": 5, "temperature": 0, "stream": True,
+    }, raw=True)
+    assert status == 200
+    events = [
+        line[len("data: "):]
+        for line in body.decode().splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    # a finish_reason arrives on the last content-bearing chunk
+    assert chunks[-1]["choices"][0]["finish_reason"] in (
+        "stop", "length"
+    )
+    # streamed content concatenates to the non-streamed completion
+    text = "".join(
+        c["choices"][0]["delta"].get("content") or "" for c in chunks
+    )
+    _, full = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "stream it"}],
+        "max_tokens": 5, "temperature": 0,
+    })
+    assert text == full["choices"][0]["message"]["content"]
+
+
+def test_v1_null_params_use_defaults(server):
+    """OpenAI clients serialize unset knobs as JSON null."""
+    status, out = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "hello"}],
+        "temperature": None, "top_p": None, "max_tokens": 4,
+    })
+    assert status == 200, out
+    assert out["choices"][0]["message"]["content"] is not None
+
+
+def test_v1_no_chat_scaffolding_in_content(server):
+    """Stop tokens (<|im_end|>) must never reach the client, streamed
+    or not."""
+    body = {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "talk"}],
+        "max_tokens": 8, "temperature": 0,
+    }
+    _, out = call(server, "POST", "/v1/chat/completions", body)
+    assert "<|im_end|>" not in (out["choices"][0]["message"]["content"]
+                                or "")
+    _, raw = call(server, "POST", "/v1/chat/completions",
+                  {**body, "stream": True}, raw=True)
+    assert b"<|im_end|>" not in raw
+
+
+def test_v1_sessions_released_after_turn(server):
+    from room_tpu.providers.tpu import get_model_host
+
+    for _ in range(3):
+        status, _ = call(server, "POST", "/v1/chat/completions", {
+            "model": "tpu:tiny-moe",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3,
+        })
+        assert status == 200
+    eng = get_model_host("tiny-moe")._engine
+    assert len(eng.sessions) == 0
