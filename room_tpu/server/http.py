@@ -103,6 +103,23 @@ class ApiServer:
                     remaining -= len(chunk)
 
             def _respond(self, status: int, payload: dict) -> None:
+                # /v1 (OpenAI-compatible) errors use the OpenAI error
+                # object with a type SDK retry logic understands — this
+                # covers pre-handler rejections (401/403/404/429) and
+                # the catch-all 500 too
+                if self.path.startswith("/v1/") and isinstance(
+                    payload.get("error"), str
+                ):
+                    etype = (
+                        "server_error" if status >= 500
+                        else "rate_limit_error" if status == 429
+                        else "authentication_error" if status == 401
+                        else "permission_error" if status == 403
+                        else "invalid_request_error"
+                    )
+                    payload = {"error": {
+                        "message": payload["error"], "type": etype,
+                    }}
                 self._drain_unread_body()
                 body = json.dumps(payload).encode()
                 self.send_response(status)
@@ -321,11 +338,10 @@ class ApiServer:
                     return
                 if path.startswith("/v1/"):
                     # OpenAI wire shapes, not the internal envelope
+                    # (_respond converts string errors to the OpenAI
+                    # error object, typed by status)
                     if out.get("error"):
-                        payload = {"error": {
-                            "message": out["error"],
-                            "type": "invalid_request_error",
-                        }}
+                        payload = {"error": out["error"]}
                     else:
                         payload = out.get("data", {})
                     self._respond(status, payload)

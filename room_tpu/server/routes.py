@@ -167,6 +167,7 @@ def register_openai_routes(r: Router) -> None:
                 committed = 0        # tokens already turned into text
                 sent = ""            # text already delivered
                 held = ""            # decoded but not yet delivered
+                suppressing = False  # inside tool-call XML: drop text
                 deadline = time_mod.monotonic() + timeout_s
 
                 def chunk(delta, finish=None):
@@ -188,16 +189,21 @@ def register_openai_routes(r: Router) -> None:
                     multi-byte sequence) or in a prefix of the
                     tool-call tag — tool-call XML must never leak as
                     content. ``final`` flushes everything still held."""
-                    nonlocal committed, sent, held
+                    nonlocal committed, sent, held, suppressing
                     tail = tok.decode([
                         t for t in ids[committed:]
                         if t not in engine.stop_token_ids
                     ])
                     committed = len(ids)
+                    if suppressing:
+                        # everything after the tag is tool-call XML:
+                        # it surfaces via the tool_calls chunk instead
+                        return None
                     held += tail
                     if TOOL_TAG in held:
                         out_text = held.split(TOOL_TAG)[0]
                         held = ""   # XML and beyond stays unsent
+                        suppressing = True
                     elif not final and held.endswith("�"):
                         # split multi-byte sequence: wait for the rest
                         return None
@@ -308,8 +314,48 @@ def register_openai_routes(r: Router) -> None:
             },
         })
 
+    def embeddings(ctx):
+        b = ctx.body or {}
+        raw = b.get("input")
+        if isinstance(raw, str):
+            texts = [raw]
+        elif isinstance(raw, list) and raw and all(
+            isinstance(t, str) for t in raw
+        ):
+            texts = raw
+        else:
+            return err("input must be a string or a non-empty list "
+                       "of strings")
+        if len(texts) > 512:
+            return err("too many inputs (max 512)")
+        from ..serving.embed_service import (
+            MAX_TOKENS, embed_texts, get_embed_host,
+        )
+
+        host = get_embed_host()
+        vecs = embed_texts(texts)
+        # the tokens the encoder actually consumed (it truncates at
+        # MAX_TOKENS), counted with its own tokenizer
+        n_tokens = sum(
+            min(len(host.tokenizer.encode(t)), MAX_TOKENS)
+            for t in texts
+        )
+        return ok({
+            "object": "list",
+            "model": b.get("model") or f"room-embed-{host.dim}",
+            "data": [{
+                "object": "embedding", "index": i,
+                "embedding": [float(x) for x in v],
+            } for i, v in enumerate(vecs)],
+            "usage": {
+                "prompt_tokens": n_tokens,
+                "total_tokens": n_tokens,
+            },
+        })
+
     r.get("/v1/models", models)
     r.post("/v1/chat/completions", chat)
+    r.post("/v1/embeddings", embeddings)
 
 
 def register_extended_routes(r: Router) -> None:

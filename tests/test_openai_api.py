@@ -55,6 +55,9 @@ def test_v1_models_lists_tpu_models(server):
 def test_v1_requires_auth(server):
     status, out = call(server, "GET", "/v1/models", token=False)
     assert status == 401
+    # even pre-handler rejections carry the OpenAI error object
+    assert out["error"]["type"] == "authentication_error"
+    assert isinstance(out["error"]["message"], str)
 
 
 def test_v1_chat_completion(server):
@@ -153,6 +156,127 @@ def test_v1_no_chat_scaffolding_in_content(server):
     _, raw = call(server, "POST", "/v1/chat/completions",
                   {**body, "stream": True}, raw=True)
     assert b"<|im_end|>" not in raw
+
+
+class _ScriptedEngine:
+    """Engine stand-in that streams a fixed reply (tool call included)
+    so the route's streaming/tool plumbing is testable independently of
+    what a random-weight model happens to emit."""
+
+    def __init__(self, text):
+        import threading
+
+        from room_tpu.serving import ByteTokenizer
+
+        self.tokenizer = ByteTokenizer()
+        self.stop_token_ids = {self.tokenizer.IM_END}
+        self.sessions = {}
+        self.released = []
+        self._text = text
+        self._threading = threading
+
+    def submit(self, prompt_tokens, *, sampling=None, on_token=None,
+               session_id=None):
+        th = self._threading
+
+        class Turn:
+            pass
+
+        turn = Turn()
+        turn.session_id = session_id or "scripted"
+        turn.new_tokens = []
+        turn.finish_reason = None
+        turn.error = None
+        turn.done = th.Event()
+        ids = self.tokenizer.encode(self._text)
+
+        def run():
+            for t in ids:
+                turn.new_tokens.append(t)
+                if on_token:
+                    on_token(t)
+            turn.finish_reason = "tool_call"
+            turn.done.set()
+
+        th.Thread(target=run, daemon=True).start()
+        return turn
+
+    def release_session(self, sid):
+        self.released.append(sid)
+
+
+def test_v1_streaming_tool_call_never_leaks_xml(server, monkeypatch):
+    eng = _ScriptedEngine(
+        'Checking. <tool_call>\n{"name": "get_weather", '
+        '"arguments": {"city": "Oslo"}}\n</tool_call>'
+    )
+
+    class Host:
+        def engine(self):
+            return eng
+
+    import room_tpu.providers.tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "get_model_host", lambda name: Host())
+
+    status, body = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "weather in Oslo?"}],
+        "stream": True,
+        "tools": [{"type": "function",
+                   "function": {"name": "get_weather"}}],
+    }, raw=True)
+    assert status == 200
+    events = [
+        json.loads(line[len("data: "):])
+        for line in body.decode().splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    content = "".join(
+        e["choices"][0]["delta"].get("content") or ""
+        for e in events if "choices" in e
+    )
+    assert "<tool_call>" not in content and "get_weather" not in content
+    assert content.strip() == "Checking."
+    tool_chunks = [
+        e for e in events
+        if "choices" in e and e["choices"][0]["delta"].get("tool_calls")
+    ]
+    assert tool_chunks, events
+    fn = tool_chunks[0]["choices"][0]["delta"]["tool_calls"][0]["function"]
+    assert fn["name"] == "get_weather"
+    assert json.loads(fn["arguments"]) == {"city": "Oslo"}
+    finish = [e for e in events if "choices" in e and
+              e["choices"][0]["finish_reason"]]
+    assert finish[-1]["choices"][0]["finish_reason"] == "tool_calls"
+    assert eng.released  # session freed after the stream
+
+
+def test_v1_embeddings(server):
+    """The 384-d on-mesh encoder behind the OpenAI embeddings shape."""
+    status, out = call(server, "POST", "/v1/embeddings", {
+        "input": ["alpha beta", "gamma"],
+    })
+    assert status == 200, out
+    from room_tpu.serving.embed_service import get_embed_host
+
+    assert out["object"] == "list" and len(out["data"]) == 2
+    v0 = out["data"][0]["embedding"]
+    # 384-d with the real checkpoint; the hermetic tiny encoder's dim
+    # otherwise — the route reports whichever is loaded
+    assert len(v0) == get_embed_host().dim
+    assert out["model"] == f"room-embed-{get_embed_host().dim}"
+    # unit-normalized (cosine-ready), deterministic
+    import math
+    assert abs(math.sqrt(sum(x * x for x in v0)) - 1.0) < 1e-3
+    _, again = call(server, "POST", "/v1/embeddings",
+                    {"input": "alpha beta"})
+    v1 = again["data"][0]["embedding"]
+    # deterministic up to batch-shape float noise
+    assert max(abs(a - b) for a, b in zip(v0, v1)) < 1e-5
+
+    status, out = call(server, "POST", "/v1/embeddings", {})
+    assert status == 400 and "input" in out["error"]["message"]
 
 
 def test_v1_sessions_released_after_turn(server):

@@ -64,6 +64,12 @@ BODIES = {
     ("POST", "/api/tasks/:id/resume"): {},
     ("PUT", "/api/settings"): {"swept": "1"},
     ("POST", "/api/clerk/message"): {"content": "hi"},
+    ("POST", "/v1/chat/completions"): {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "swept"}],
+        "max_tokens": 2,
+    },
+    ("POST", "/v1/embeddings"): {"input": "swept"},
     ("POST", "/api/templates/instantiate"):
         {"template": "ops-room", "workerModel": "echo"},
     ("POST", "/api/watches"):
