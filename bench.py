@@ -23,6 +23,7 @@ WATCHDOG_S = float(os.environ.get("ROOM_TPU_BENCH_WATCHDOG_S", "480"))
 TINY = os.environ.get("ROOM_TPU_BENCH_TINY") == "1"  # CPU smoke mode
 
 _result_printed = threading.Event()
+_deadline = [0.0]  # extended when the XLA fallback re-measures
 
 
 def _emit(value: float, unit: str, note: str = "",
@@ -62,9 +63,17 @@ def decode_flops_per_token(cfg, mean_ctx: float) -> float:
 
 
 def _watchdog() -> None:
-    time.sleep(WATCHDOG_S)
+    _deadline[0] = time.monotonic() + WATCHDOG_S
+    while True:
+        now = time.monotonic()
+        if now >= _deadline[0]:
+            break
+        time.sleep(min(_deadline[0] - now, 5.0))
     if not _result_printed.is_set():
-        _emit(0.0, "tok/s", "watchdog: TPU backend unreachable")
+        _emit(0.0, "tok/s",
+              f"watchdog: no result after {WATCHDOG_S:.0f}s "
+              "(TPU unreachable or compile exceeded the window; "
+              "raise ROOM_TPU_BENCH_WATCHDOG_S)")
         os._exit(1)
 
 
@@ -179,7 +188,25 @@ def main() -> None:
                    - start["tokens_decoded"])
         return decoded / dt, decoded, dt, eng.stats()
 
-    tok_s, decoded, dt, eng_stats = measure()
+    from room_tpu.serving.kv_pages import use_pallas_kernel
+
+    kernel_fallback = None
+    try:
+        tok_s, decoded, dt, eng_stats = measure()
+    except Exception as e:
+        # A Pallas lowering failure must not zero the round: retry on
+        # the XLA gather path and report both facts. Only a run that
+        # actually used the Pallas kernel qualifies.
+        if not use_pallas_kernel():
+            raise
+        kernel_fallback = f"{type(e).__name__}: {e}"[:300]
+    if kernel_fallback is not None:
+        # retried outside the except block so the failed engine (and
+        # its KV pool) isn't pinned by the live traceback during the
+        # second attempt; give the retry its own full window
+        os.environ["ROOM_TPU_PAGED_KERNEL"] = "xla"
+        _deadline[0] = time.monotonic() + WATCHDOG_S
+        tok_s, decoded, dt, eng_stats = measure()
 
     # MFU estimate against the chip's peak bf16 matmul throughput
     # (override ROOM_TPU_PEAK_TFLOPS for the actual TPU generation;
@@ -196,6 +223,9 @@ def main() -> None:
         "mfu_peak_tflops_assumed": peak_tflops,
         "flops_per_token": int(flops_tok),
     }
+    if kernel_fallback:
+        extra["pallas_error"] = kernel_fallback
+        extra["kernel"] = "xla-fallback"
     if quant:
         extra["quant"] = quant
     spec_env = os.environ.get("ROOM_TPU_SPEC_TOKENS")
@@ -211,8 +241,10 @@ def main() -> None:
     # XLA gather reference) — only meaningful on real TPU hardware
     if platform == "tpu":
         compare = {}
-        for backend in ("pallas", "xla"):
+        backends = ("xla",) if kernel_fallback else ("pallas", "xla")
+        for backend in backends:
             os.environ["ROOM_TPU_PAGED_KERNEL"] = backend
+            _deadline[0] = time.monotonic() + WATCHDOG_S
             try:
                 b_tok_s, _, _, _ = measure()
                 compare[backend] = round(b_tok_s, 2)

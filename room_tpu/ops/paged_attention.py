@@ -14,6 +14,19 @@ Shapes
     lengths   [B]                 int32 valid tokens (scalar-prefetched)
     out       [B, Hq, D]
 
+Mosaic-shaped design (constraints observed compiling on a real v5e):
+batched ``dot_general`` with non-leading batch dims and sub-tile HBM
+slices both fail to lower, so the kernel never slices the Hkv dim.
+Each page is viewed as a flat ``[page*Hkv, D]`` tile (a row-major
+bitcast done by the wrapper), one DMA per page, and the GQA dot runs
+over ALL (query-head, kv-head) pairs in a single MXU matmul per page —
+entries whose kv head doesn't own the query head are masked to -inf
+before the online softmax, so they contribute exp(-inf)=0 to both the
+normalizer and the PV accumulation. For the qwen3 shapes
+(page=32 × Hkv=4 = 128 rows) the "wasted" lanes are exactly the MXU's
+native width: the pair-masked matmul costs the same wall-clock as the
+per-head ideal and avoids every layout hazard.
+
 The XLA reference path (kv_pages.make_paged_kv_hook) stays the default
 on CPU; the engine switches to this kernel on TPU via
 ROOM_TPU_PAGED_KERNEL=pallas. Numerics are pinned against
@@ -38,20 +51,20 @@ def _decode_kernel(
     lengths_ref,     # [B] SMEM
     # inputs
     q_ref,           # [1, Hq, D] VMEM (this sequence's query)
-    k_pages_hbm,     # [P, page, Hkv, D] ANY/HBM
-    v_pages_hbm,     # [P, page, Hkv, D] ANY/HBM
+    k_pages_hbm,     # [P, page*Hkv, D] ANY/HBM (flattened view)
+    v_pages_hbm,     # [P, page*Hkv, D] ANY/HBM
     # output
     o_ref,           # [1, Hq, D] VMEM
     # scratch
-    k_buf,           # [2, page, Hkv, D] VMEM
-    v_buf,           # [2, page, Hkv, D] VMEM
+    k_buf,           # [2, page*Hkv, D] VMEM
+    v_buf,           # [2, page*Hkv, D] VMEM
     acc_ref,         # [Hq, D] f32 VMEM
     m_ref,           # [Hq, 1] f32 VMEM
     l_ref,           # [Hq, 1] f32 VMEM
     sems,            # DMA sems [2, 2]
     *,
     page_size: int,
-    max_pages: int,
+    n_kv_heads: int,
     scale: float,
 ):
     b = pl.program_id(0)
@@ -59,9 +72,10 @@ def _decode_kernel(
     n_pages = jax.lax.div(length + page_size - 1, page_size)
 
     hq = q_ref.shape[1]
-    hkv = k_buf.shape[2]
+    hkv = n_kv_heads
     d = q_ref.shape[2]
     group = hq // hkv
+    rows = page_size * hkv
 
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
@@ -90,7 +104,13 @@ def _decode_kernel(
         start_fetch(0, 0)
 
     q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
-    qg = q.reshape(hkv, group, d)
+
+    # Static (head-pair) half of the mask: row j of a flat page belongs
+    # to kv head j % Hkv; query head h reads kv head h // group.
+    j = jax.lax.broadcasted_iota(jnp.int32, (hq, rows), 1)
+    h = jax.lax.broadcasted_iota(jnp.int32, (hq, rows), 0)
+    pair_ok = jax.lax.rem(j, hkv) == jax.lax.div(h, group)
+    tok_of_j = jax.lax.div(j, hkv)                    # token within page
 
     def body(i, _):
         slot = jax.lax.rem(i, 2)
@@ -100,37 +120,32 @@ def _decode_kernel(
             start_fetch(i + 1, 1 - slot)
 
         wait_fetch(i, slot)
-        k = k_buf[slot].astype(jnp.float32)           # [page, Hkv, D]
+        k = k_buf[slot].astype(jnp.float32)           # [rows, D]
         v = v_buf[slot].astype(jnp.float32)
 
-        # logits [Hkv, G, page]
+        # one MXU matmul for every (query head, kv row) pair
         logits = jax.lax.dot_general(
-            qg, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
-        # mask past the sequence length within this page
-        pos = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, page_size), 2
-        )
-        logits = jnp.where(pos < length, logits, NEG_INF)
-        logits2 = logits.reshape(hq, page_size)
+        )                                             # [Hq, rows]
+        valid = pair_ok & ((i * page_size + tok_of_j) < length)
+        logits = jnp.where(valid, logits, NEG_INF)
 
         m_prev = m_ref[:]                              # [Hq, 1]
         m_new = jnp.maximum(
-            m_prev, jnp.max(logits2, axis=1, keepdims=True)
+            m_prev, jnp.max(logits, axis=1, keepdims=True)
         )
-        p = jnp.exp(logits2 - m_new)                   # [Hq, page]
+        p = jnp.exp(logits - m_new)                    # [Hq, rows]
         alpha = jnp.exp(m_prev - m_new)                # [Hq, 1]
 
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        # pv [Hkv, G, D]
         pv = jax.lax.dot_general(
-            p.reshape(hkv, group, page_size), v,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
-        acc_ref[:] = acc_ref[:] * alpha + pv.reshape(hq, d)
+        )                                              # [Hq, D]
+        acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = m_new
         return 0
 
@@ -154,9 +169,14 @@ def paged_attention_decode(
     interpret: bool = False,
 ) -> jax.Array:
     b, hq, d = q.shape
-    _, _, hkv, _ = k_pages.shape
-    max_pages = tables.shape[1]
+    p_count, _, hkv, _ = k_pages.shape
     scale = 1.0 / float(np.sqrt(d))
+    rows = page_size * hkv
+
+    # Row-major bitcast: a page [page, Hkv, D] viewed as [page*Hkv, D]
+    # so the kernel's DMAs and dots never slice the tiled Hkv dim.
+    k_flat = k_pages.reshape(p_count, rows, d)
+    v_flat = v_pages.reshape(p_count, rows, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -174,8 +194,8 @@ def paged_attention_decode(
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, hkv, d), k_pages.dtype),
-            pltpu.VMEM((2, page_size, hkv, d), v_pages.dtype),
+            pltpu.VMEM((2, rows, d), k_pages.dtype),
+            pltpu.VMEM((2, rows, d), v_pages.dtype),
             pltpu.VMEM((hq, d), jnp.float32),
             pltpu.VMEM((hq, 1), jnp.float32),
             pltpu.VMEM((hq, 1), jnp.float32),
@@ -186,7 +206,7 @@ def paged_attention_decode(
     kernel = functools.partial(
         _decode_kernel,
         page_size=page_size,
-        max_pages=max_pages,
+        n_kv_heads=hkv,
         scale=scale,
     )
     return pl.pallas_call(
@@ -194,4 +214,4 @@ def paged_attention_decode(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
         interpret=interpret,
-    )(tables, lengths, q, k_pages, v_pages)
+    )(tables, lengths, q, k_flat, v_flat)
