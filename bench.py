@@ -223,6 +223,16 @@ def main() -> None:
         "mfu_peak_tflops_assumed": peak_tflops,
         "flops_per_token": int(flops_tok),
     }
+    if not TINY:
+        # implied single-chip throughput on the full 30B target at the
+        # measured MFU (decode is bandwidth/latency-bound, so this is an
+        # optimistic ceiling, not a claim of 30B tok/s)
+        from room_tpu.models.config import qwen3_coder_30b
+
+        flops_full = decode_flops_per_token(qwen3_coder_30b(), mean_ctx)
+        extra["implied_30b_tok_s_at_measured_mfu"] = round(
+            mfu * peak_tflops * 1e12 / flops_full, 1
+        )
     if kernel_fallback:
         extra["pallas_error"] = kernel_fallback
         extra["kernel"] = "xla-fallback"

@@ -79,11 +79,15 @@ def add_observation(
     return oid
 
 
-def get_observations(db: Database, entity_id: int) -> list[dict]:
-    return db.query(
-        "SELECT * FROM observations WHERE entity_id=? ORDER BY id",
-        (entity_id,),
-    )
+def get_observations(
+    db: Database, entity_id: int,
+    newest_first: bool = False, limit: Optional[int] = None,
+) -> list[dict]:
+    order = "DESC" if newest_first else "ASC"
+    sql = f"SELECT * FROM observations WHERE entity_id=? ORDER BY id {order}"
+    if limit is not None:
+        return db.query(sql + " LIMIT ?", (entity_id, limit))
+    return db.query(sql, (entity_id,))
 
 
 def create_relation(

@@ -536,7 +536,14 @@ def register_extended_routes(r: Router) -> None:
         )
         return ok({"deleted": int(ctx.params["id"])})
 
+    def list_observations(ctx):
+        return ok(memory_mod.get_observations(
+            ctx.db, int(ctx.params["id"]),
+            newest_first=True, limit=100,
+        ))
+
     r.get("/api/memory/entities", list_entities)
+    r.get("/api/memory/entities/:id/observations", list_observations)
     r.get("/api/memory/stats", memory_stats)
     r.post("/api/memory/entities/:id/observations",
            add_observation_route)

@@ -1,0 +1,123 @@
+"""Single-process TPU tuning sweep for the serving engine.
+
+Runs bench-shaped decode measurements across the engine's perf knobs —
+decode chunk, batch size, page size, weight quantization, speculative
+tokens — sequentially in ONE process (the axon tunnel wedges if two
+processes claim the chip). Prints one JSON line per configuration and a
+final "best" line; use the winner to set bench.py / engine defaults.
+
+Usage (on the real chip):
+    python scripts/tpu_tune.py                 # default grid
+    python scripts/tpu_tune.py --quick         # 1 rep, small grid
+    ROOM_TPU_TUNE_GRID=chunk=8,16,32;batch=8,16 python scripts/tpu_tune.py
+
+Each measurement reuses the same params (one init) but builds a fresh
+engine, so compile caches persist across configs that share shapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_grid(spec: str) -> dict[str, list]:
+    grid: dict[str, list] = {}
+    for part in filter(None, spec.split(";")):
+        key, _, vals = part.partition("=")
+        grid[key.strip()] = [v.strip() for v in vals.split(",") if v.strip()]
+    return grid
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--gen", type=int, default=128,
+                    help="timed tokens per request")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    from bench import bench_config
+    from room_tpu.models import qwen3
+    from room_tpu.serving import SamplingParams, ServingEngine
+
+    platform = jax.devices()[0].platform
+    cfg = bench_config()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+
+    default_grid = {
+        "chunk": ["8", "16", "32"],
+        "batch": ["8", "16"],
+        "page": ["32"],
+        "quant": ["none", "int8"],
+    }
+    if args.quick:
+        default_grid = {"chunk": ["16"], "batch": ["8"],
+                        "page": ["32"], "quant": ["none"]}
+    grid = parse_grid(os.environ.get("ROOM_TPU_TUNE_GRID", "")) or default_grid
+
+    from room_tpu.ops.quant import quantize_decoder_params
+
+    q_params = None
+
+    def measure(chunk: int, batch: int, page: int, quant: str) -> dict:
+        nonlocal q_params
+        os.environ["ROOM_TPU_DECODE_CHUNK"] = str(chunk)
+        p = params
+        if quant == "int8":
+            if q_params is None:
+                q_params = quantize_decoder_params(params, cfg)
+            p = q_params
+        eng = ServingEngine(cfg, p, max_batch=batch, page_size=page,
+                            n_pages=2048)
+        prompt = list(range(1, 33))
+        sp = SamplingParams(temperature=0.0, max_new_tokens=32)
+        warm = [eng.submit(prompt, sampling=sp) for _ in range(batch)]
+        eng.run_until_idle()
+        for t in warm:
+            eng.release_session(t.session_id)
+        start = eng.stats()["tokens_decoded"]
+        for _ in range(batch * 2):
+            eng.submit(prompt, sampling=SamplingParams(
+                temperature=0.0, max_new_tokens=args.gen))
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        decoded = eng.stats()["tokens_decoded"] - start
+        return {"chunk": chunk, "batch": batch, "page": page,
+                "quant": quant, "tok_s": round(decoded / dt, 2),
+                "decoded": decoded, "dt": round(dt, 2)}
+
+    results = []
+    combos = list(itertools.product(
+        grid.get("chunk", ["16"]), grid.get("batch", ["8"]),
+        grid.get("page", ["32"]), grid.get("quant", ["none"])))
+    for chunk, batch, page, quant in combos:
+        try:
+            row = measure(int(chunk), int(batch), int(page), quant)
+        except Exception as e:  # keep sweeping; record the failure
+            row = {"chunk": chunk, "batch": batch, "page": page,
+                   "quant": quant, "error": f"{type(e).__name__}: {e}"[:200]}
+        row["platform"] = platform
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    ok = [r for r in results if "tok_s" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["tok_s"])
+        print(json.dumps({"best": best}), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -137,6 +137,8 @@ def _panel_api_calls() -> list[tuple[str, str]]:
         "/api/rooms/1/@A@": ("start", "stop", "pause"),
         "/api/tasks/1/@A@": ("run", "pause", "resume"),
         "/api/escalations/1/@A@": ("answer", "dismiss"),
+        "/api/providers/@A@/sessions/1": ("auth", "install"),
+        "/api/providers/@A@/sessions/1/cancel": ("auth", "install"),
     }
     calls = set()
     for m in re.finditer(
@@ -216,6 +218,10 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/rooms/1/messages"):
             {"toRoomId": 1, "subject": "s", "body": "b"},
         ("POST", "/api/goals/1/updates"): {"update": "progress note"},
+        ("POST", "/api/memory/entities/1/observations"):
+            {"content": "seen in the ui sweep"},
+        ("POST", "/api/memory/relations"):
+            {"fromId": 1, "toId": 1, "relationType": "relates_to"},
     }
     # endpoints whose 4xx is data-dependent, not drift
     allowed_4xx = {
@@ -224,6 +230,11 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/providers/1/auth/start"),  # mock id, no CLI
         ("GET", "/api/providers/1/auth"),         # no active session
         ("GET", "/api/providers/auth/sessions/1"),  # unknown session
+        ("GET", "/api/providers/install/sessions/1"),   # unknown session
+        ("POST", "/api/providers/auth/sessions/1/cancel"),
+        ("POST", "/api/providers/install/sessions/1/cancel"),
+        ("POST", "/api/providers/1/install/start"),  # mock provider id
+        ("POST", "/api/invites"),                 # no JWT secret (503)
         ("GET", "/api/tpu/provision/1"),          # unknown session
         ("POST", "/api/tpu/provision"),           # spawns a load thread
         ("POST", "/api/rooms/1/start"),           # provider not ready
@@ -234,7 +245,11 @@ def test_every_panel_call_resolves(server):
         ("POST", "/api/tasks/1/run"),             # no runtime thread (503)
         ("GET", "/api/rooms/1/wallet/balance"),   # no chain RPC (503)
     }
-    for method, path in _panel_api_calls():
+    # destructive calls go last so a DELETE doesn't remove the row a
+    # later POST/GET in the sorted sweep targets
+    ordered = sorted(_panel_api_calls(),
+                     key=lambda mp: (mp[0] == "DELETE", mp))
+    for method, path in ordered:
         body = bodies.get((method, path))
         headers = {
             "Authorization": f"Bearer {server.tokens['user']}",

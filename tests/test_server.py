@@ -380,7 +380,7 @@ def test_dashboard_served_and_wired(server):
     # every /api path the bundle references — double-quoted literals AND
     # template literals like `/api/rooms/${id}/chat` — must match a
     # registered route (params substituted with 1)
-    refs = set(_re.findall(r'["`](/api/[a-z\-/${}.]+)', html))
+    refs = set(_re.findall(r'["`](/api/[a-zA-Z\-/${}.]+)', html))
     assert any("${" in m for m in refs), "template-literal routes missed"
     pre_router = {
         "/api/auth/handshake", "/api/server/restart",
@@ -391,7 +391,7 @@ def test_dashboard_served_and_wired(server):
             continue  # handled before the router
         actions = (
             ("start", "stop", "pause", "run", "resume", "complete",
-             "abandon", "answer", "dismiss")
+             "abandon", "answer", "dismiss", "auth", "install")
             if "${action}" in m else (None,)
         )
         hits = 0

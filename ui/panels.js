@@ -378,10 +378,27 @@ async function renderWorkers(el) {
       <div class="row">
         <input id="newWorker-${r.id}" placeholder="new worker name…">
         <button class="ghost" onclick="addWorker(${r.id})">add</button>
-      </div></div>`;
+        <button class="ghost" onclick="promptsExport(${r.id})">
+          export prompts</button>
+        <button class="ghost" onclick="promptsImport(${r.id})">
+          import prompts</button>
+      </div><div id="promptSync-${r.id}" class="dim"
+        style="font-size:.82em"></div></div>`;
   }));
   el.innerHTML = blocks.join("") ||
     '<div class="panel"><div class="dim">no rooms yet</div></div>';
+}
+
+async function promptsExport(roomId) {
+  const out = await api("POST", `/api/rooms/${roomId}/prompts/export`);
+  $(`promptSync-${roomId}`).textContent =
+    "exported: " + ((out.data || {}).paths || []).join(", ");
+}
+
+async function promptsImport(roomId) {
+  const out = await api("POST", `/api/rooms/${roomId}/prompts/import`, {});
+  $(`promptSync-${roomId}`).textContent =
+    "import: " + JSON.stringify(out.data || {});
 }
 
 async function triggerWorker(id) {
@@ -440,7 +457,18 @@ async function showRuns(id) {
 // ---- memory ----
 
 async function renderMemory(el) {
-  el.innerHTML = `<div class="panel"><h2>memory</h2>
+  if (memTab === "graph") {
+    el.innerHTML = `<div class="panel"><h2>memory
+      <button class="ghost" onclick="memShowTab('search')">search</button>
+      <button class="act" onclick="memShowTab('graph')">graph</button>
+      </h2><div id="memGraph"></div></div>`;
+    renderMemoryGraph($("memGraph"));
+    return;
+  }
+  el.innerHTML = `<div class="panel"><h2>memory
+      <button class="act" onclick="memShowTab('search')">search</button>
+      <button class="ghost" onclick="memShowTab('graph')">graph</button>
+    </h2>
     <div class="row">
       <input id="memQuery" placeholder="search memories…"
         onkeydown="if(event.key==='Enter')memSearch()">
@@ -680,7 +708,12 @@ wsHandlers.clerk = (msg) => {
 
 async function renderClerk(el) {
   const out = await api("GET", "/api/clerk/messages");
-  el.innerHTML = `<div class="panel"><h2>clerk</h2>
+  const st = (await api("GET", "/api/clerk/status")).data || {};
+  el.innerHTML = `<div class="panel"><h2>clerk
+      <span class="dim" style="font-size:.6em">${st.messages || 0}
+        messages · ${st.turns || 0} turns ·
+        last ${esc(when(st.lastMessageAt) || "never")}</span>
+      <button class="ghost" onclick="clerkReset()">reset</button></h2>
     <div class="log" id="clerkLog" style="max-height:460px">
       ${(out.data || []).map(m =>
         `<div><span class="t">${esc(m.role)}</span>${esc(m.content)}</div>`
@@ -692,6 +725,11 @@ async function renderClerk(el) {
     </div></div>`;
   const log = $("clerkLog");
   if (log) log.scrollTop = log.scrollHeight;
+}
+
+async function clerkReset() {
+  await api("POST", "/api/clerk/reset", {});
+  refreshView();
 }
 
 async function clerkSend() {
@@ -884,10 +922,11 @@ async function loadCycleLogs(cid) {
 // ---- system (self-mod audit, watches, updates) ----
 
 async function renderSystem(el) {
-  const [audit, watches, update] = await Promise.all([
+  const [audit, watches, update, prof] = await Promise.all([
     api("GET", "/api/self-mod/audit"),
     api("GET", "/api/watches"),
     api("GET", "/api/update"),
+    api("GET", "/api/profiling/http"),
   ]);
   const u = update.data || {};
   const auto = u.autoUpdate || {state: "idle"};
@@ -932,7 +971,36 @@ async function renderSystem(el) {
           onclick="selfmodRevert(${a.id})">revert</button></td></tr>`
       ).join("") ||
         '<tr><td class="dim">no self-modifications recorded</td></tr>'}
-      </table></div>`;
+      </table></div>
+    <div class="panel"><h2>http profiling
+        <span class="dim" style="font-size:.6em">set
+          ROOM_TPU_PROFILE_HTTP=1 to record</span></h2>
+      <table><tr><th>endpoint</th><th>calls</th><th>mean ms</th>
+        <th>p95 ms</th></tr>
+      ${Object.entries(prof.data || {})
+        .sort((a, b) => (b[1].count || 0) - (a[1].count || 0))
+        .slice(0, 20).map(([k, p]) => `
+        <tr><td><code>${esc(k)}</code></td>
+        <td>${p.count || 0}</td>
+        <td>${p.mean_ms ?? ""}</td>
+        <td>${p.p95_ms ?? ""}</td>
+        </tr>`).join("") ||
+        '<tr><td class="dim">profiling off or no samples</td></tr>'}
+      </table></div>
+    <div class="panel"><h2>member invites</h2>
+      <div class="row">
+        <button class="ghost" onclick="inviteCreate()">
+          mint member invite token</button></div>
+      <pre class="log" id="inviteOut" style="display:none"></pre></div>`;
+}
+
+async function inviteCreate() {
+  const out = await api("POST", "/api/invites", {});
+  const el = $("inviteOut");
+  el.style.display = "block";
+  el.textContent = out.data?.token
+    ? `member token (share with a collaborator):\n${out.data.token}`
+    : (out.error || "invites disabled: set ROOM_TPU_CLOUD_JWT_SECRET");
 }
 
 async function updateCheck() {
@@ -1413,6 +1481,191 @@ async function setupCreate() {
   }
 }
 
+// ---- usage (token accounting; reference: routes/rooms.ts usage +
+// clerk_usage table driving the ref UI's usage readouts) ----
+
+async function renderUsage(el) {
+  const rooms = (await api("GET", "/api/rooms")).data || [];
+  const usages = await Promise.all(rooms.map(async r => ({
+    room: r,
+    u: (await api("GET", `/api/rooms/${r.id}/usage`)).data || {},
+  })));
+  const maxTok = Math.max(1, ...usages.map(x =>
+    (x.u.input_tokens || 0) + (x.u.output_tokens || 0)));
+  const clerkRows = (await api("GET", "/api/clerk/usage")).data || [];
+  const clerkTok = clerkRows.reduce((a, c) =>
+    a + (c.input_tokens || 0) + (c.output_tokens || 0), 0);
+  el.innerHTML = `<div class="panel"><h2>token usage by room</h2>
+    <table><tr><th>room</th><th>cycles</th><th>in</th><th>out</th>
+      <th style="width:40%"></th></tr>
+    ${usages.map(({room, u}) => {
+      const tot = (u.input_tokens || 0) + (u.output_tokens || 0);
+      return `<tr><td>${esc(room.name)}</td>
+        <td>${u.cycles || 0}</td>
+        <td>${(u.input_tokens || 0).toLocaleString()}</td>
+        <td>${(u.output_tokens || 0).toLocaleString()}</td>
+        <td><div class="bar" style="width:${
+          Math.round(100 * tot / maxTok)}%"></div></td></tr>`;
+    }).join("")}</table></div>
+    <div class="panel"><h2>clerk usage</h2>
+    <div class="dim">${clerkRows.length} turns ·
+      ${clerkTok.toLocaleString()} tokens</div>
+    <table><tr><th>when</th><th>model</th><th>in</th><th>out</th></tr>
+    ${clerkRows.slice(0, 25).map(c => `
+      <tr><td>${esc(when(c.created_at))}</td><td>${esc(c.model || "")}</td>
+      <td>${c.input_tokens || 0}</td><td>${c.output_tokens || 0}</td>
+      </tr>`).join("")}</table></div>`;
+}
+
+// ---- providers (status, login + install sessions; reference:
+// provider-auth.ts / provider-install.ts session UX) ----
+
+let provPollTimer = null;
+
+async function renderProviders(el) {
+  const provs = (await api("GET", "/api/providers")).data || {};
+  el.innerHTML = `<div class="panel"><h2>model providers</h2>
+    <table><tr><th>provider</th><th>installed</th><th>connected</th>
+      <th></th></tr>
+    ${Object.entries(provs).map(([name, p]) => `<tr>
+      <td><b>${esc(name)}</b>
+        <div class="dim" style="font-size:.82em">
+          ${esc(p.version || "")}</div></td>
+      <td><span class="pill ${p.installed ? "ok" : ""}">
+        ${p.installed ? "yes" : "no"}</span></td>
+      <td><span class="pill ${p.connected ? "ok" : ""}">
+        ${p.connected ? "yes" : "no"}</span></td>
+      <td class="row" style="margin:0">
+        <button class="ghost"
+          onclick="provAuthStart('${esc(name)}')">login</button>
+        <button class="ghost"
+          onclick="provInstallStart('${esc(name)}')">install</button>
+      </td></tr>`).join("")}</table>
+    <div id="provSession"></div></div>`;
+  if (provActive) provPollSession(provActive.action, provActive.sid);
+}
+
+let provActive = null;
+
+async function provAuthStart(provider) {
+  const out = await api("POST", `/api/providers/${provider}/auth/start`);
+  if (out.data) {
+    provPollSession("auth", out.data.sessionId);
+  }
+}
+
+async function provInstallStart(provider) {
+  const out = await api("POST",
+    `/api/providers/${provider}/install/start`);
+  if (out.data) {
+    provPollSession("install", out.data.sessionId);
+  }
+}
+
+async function provPollSession(action, sid) {
+  if (!sid) return;
+  clearTimeout(provPollTimer);
+  const out = await api("GET", `/api/providers/${action}/sessions/${sid}`);
+  const s = out.data;
+  const box = $("provSession");
+  if (!s || !box) return;           // session gone or panel left
+  provActive = s.active ? {action, sid} : null;
+  box.innerHTML = `
+    <h2 style="margin-top:.8rem">${esc(s.provider)} ${action} session
+      <span class="pill ${s.status === "completed" ? "ok" : ""}">
+        ${esc(s.status)}</span></h2>
+    ${s.verificationUrl ? `<div>open
+      <a href="${esc(s.verificationUrl)}" target="_blank">
+        ${esc(s.verificationUrl)}</a>
+      ${s.deviceCode ? `and enter <b>${esc(s.deviceCode)}</b>` : ""}
+      </div>` : ""}
+    <pre class="log">${esc((s.lines || []).slice(-30)
+      .map(l => l.text ?? l).join("\n"))}</pre>
+    ${s.active ? `<button class="ghost"
+      onclick="provCancelSession('${action}','${esc(sid)}')">cancel
+      </button>` : ""}`;
+  if (s.active) {
+    provPollTimer = setTimeout(() => provPollSession(action, sid), 1500);
+  }
+}
+
+async function provCancelSession(action, sid) {
+  await api("POST", `/api/providers/${action}/sessions/${sid}/cancel`);
+  provPollSession(action, sid);
+}
+
+// ---- memory graph + stats (reference: MemoryPanel + memory routes) ----
+
+let memTab = "search";
+
+function memShowTab(tab) {
+  memTab = tab;
+  refreshView();
+}
+
+async function renderMemoryGraph(container) {
+  const stats = (await api("GET", "/api/memory/stats")).data || {};
+  const ents = (await api("GET", "/api/memory/entities?limit=50"))
+    .data || [];
+  container.innerHTML = `
+    <div class="dim" style="margin:.4rem 0">
+      ${stats.entities || 0} entities · ${stats.observations || 0}
+      observations · ${stats.relations || 0} relations ·
+      ${stats.embedded || 0} embedded</div>
+    <table>${ents.map(e => `
+      <tr><td><b>${esc(e.name)}</b>
+        <span class="dim">${esc(e.entity_type || "")}</span>
+        <div id="entObs-${e.id}"></div></td>
+      <td style="width:8rem" class="row">
+        <button class="ghost" onclick="entObservations(${e.id})">
+          observations</button>
+      </td></tr>`).join("")}</table>
+    <div class="row">
+      <input id="relFrom" placeholder="from entity id…" style="width:8rem">
+      <input id="relType" placeholder="type…" style="width:6rem">
+      <input id="relTo" placeholder="to entity id…" style="width:8rem">
+      <button class="ghost" onclick="relAdd()">relate</button>
+    </div>`;
+}
+
+async function entObservations(id) {
+  const out = await api("GET",
+    `/api/memory/entities/${id}/observations`);
+  const rows = out.data || [];
+  $(`entObs-${id}`).innerHTML = `
+    <ul style="margin:.3rem 0 .2rem 1rem;padding:0">
+      ${rows.map(o => `<li style="font-size:.85em">${esc(o.content)}
+        <a href="#" onclick="obsDelete(${o.id},${id});return false"
+          class="dim">×</a></li>`).join("")}</ul>
+    <div class="row" style="margin:.2rem 0 0">
+      <input id="obsNew-${id}" placeholder="add observation…"
+        style="font-size:.85em">
+      <button class="ghost" onclick="obsAdd(${id})">+</button></div>`;
+}
+
+async function obsAdd(entityId) {
+  const v = $(`obsNew-${entityId}`).value.trim();
+  if (!v) return;
+  await api("POST", `/api/memory/entities/${entityId}/observations`,
+    {content: v});
+  entObservations(entityId);
+}
+
+async function obsDelete(obsId, entityId) {
+  await api("DELETE", `/api/memory/observations/${obsId}`);
+  entObservations(entityId);
+}
+
+async function relAdd() {
+  const fromId = parseInt($("relFrom").value.trim(), 10);
+  const type = $("relType").value.trim() || "relates_to";
+  const toId = parseInt($("relTo").value.trim(), 10);
+  if (!fromId || !toId) return;
+  await api("POST", "/api/memory/relations",
+    {fromId, toId, relationType: type});
+  refreshView();
+}
+
 // ---- registry ----
 
 const PANELS = {
@@ -1432,6 +1685,8 @@ const PANELS = {
   transactions: {title: "transactions", render: renderTransactions},
   tpu: {title: "tpu", render: renderTpu},
   cycles: {title: "cycles", render: renderCycles},
+  usage: {title: "usage", render: renderUsage},
+  providers: {title: "providers", render: renderProviders},
   clerk: {title: "clerk", render: renderClerk},
   status: {title: "status", render: renderStatus},
   feed: {title: "feed", render: renderFeed},
