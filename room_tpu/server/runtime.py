@@ -57,6 +57,12 @@ class ServerRuntime:
 
         self.indexer = EmbeddingIndexer(self.db)
         self.indexer.start()
+        # precompile the encoder's input buckets off the serving path so
+        # the first cycles after boot don't stall on XLA compiles
+        threading.Thread(
+            target=self._warm_embedder, daemon=True,
+            name="embed-warmup",
+        ).start()
         self.watch_runtime = WatchRuntime(self.db)
         self.watch_runtime.start()
         self.commentary = CommentaryEngine(self.db)
@@ -225,6 +231,14 @@ class ServerRuntime:
         task_runner.cancel_running_tasks_for_room(self.db, room_id)
         event_bus.emit("room:stopped", f"room:{room_id}", {})
         return n
+
+    def _warm_embedder(self) -> None:
+        try:
+            from ..serving.embed_service import get_embed_host
+
+            get_embed_host().warmup()
+        except Exception:
+            pass  # warmup is best-effort; first embed still compiles
 
     def cleanup_stale(self, startup: bool = False) -> int:
         """Mark long-running/orphaned runs and cycles failed (reference:

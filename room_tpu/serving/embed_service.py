@@ -45,6 +45,17 @@ class EmbedHost:
         )
         self.dim = self.cfg.hidden
 
+    def warmup(self) -> None:
+        """Compile the (1, bucket) encoder shapes up front so the first
+        swarm cycles don't each pay a ~1s XLA compile mid-prompt."""
+        # probe by TOKEN count (tokenizers differ in tokens-per-char):
+        # find a text unit, then size each probe to land in its bucket
+        unit = "w "
+        per_unit = max(1, len(self.tokenizer.encode(unit * 8)) // 8)
+        for bucket in (16, 32, 64, 128):
+            n_units = -(-(bucket // 2 + 1) // per_unit)  # ceil
+            self.embed([unit * n_units])
+
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         import jax.numpy as jnp
 
@@ -56,19 +67,24 @@ class EmbedHost:
             ids = [min(t, self.cfg.vocab_size - 1) for t in ids] or [0]
             batch.append(ids)
         max_len = max(len(x) for x in batch)
-        # bucket to limit recompiles
+        # bucket BOTH dims so the jit cache converges to a handful of
+        # shapes (an unpadded batch dim made every new batch size a
+        # fresh ~1s XLA compile — a per-cycle stall under swarm load)
         bucket = 16
         while bucket < max_len:
             bucket *= 2
-        toks = np.zeros((len(batch), bucket), np.int32)
-        mask = np.zeros((len(batch), bucket), np.float32)
+        rows = 1
+        while rows < len(batch):
+            rows *= 2
+        toks = np.zeros((rows, bucket), np.int32)
+        mask = np.zeros((rows, bucket), np.float32)
         for i, ids in enumerate(batch):
             toks[i, : len(ids)] = ids
             mask[i, : len(ids)] = 1.0
         out = self._encode(
             self.params, jnp.asarray(toks), jnp.asarray(mask)
         )
-        return np.asarray(out, np.float32)
+        return np.asarray(out, np.float32)[: len(batch)]
 
 
 def get_embed_host() -> EmbedHost:

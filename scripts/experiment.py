@@ -16,7 +16,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import statistics
 import sys
 import tempfile
 import time
@@ -24,6 +23,20 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)
 )))
+
+
+def _pct(vals: list[float], q: float) -> float:
+    """Linear-interpolated percentile (statistics.quantiles inclusive
+    convention) shared by both runners so modes are comparable."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    if len(s) == 1:
+        return round(s[0], 3)
+    pos = q * (len(s) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    return round(s[lo] + (s[hi] - s[lo]) * (pos - lo), 3)
 
 
 def run_config(model: str, n_workers: int, n_cycles: int) -> dict:
@@ -63,20 +76,110 @@ def run_config(model: str, n_workers: int, n_cycles: int) -> dict:
 
     agent_loop.set_room_launch_enabled(room["id"], False)
     db.close()
-    lat_sorted = sorted(latencies) or [0.0]
     return {
         "model": model,
         "agents": len(team),
         "cycles_run": len(latencies),
         "errors": errors,
-        "p50_cycle_s": round(statistics.median(lat_sorted), 3),
-        "p90_cycle_s": round(
-            lat_sorted[min(len(lat_sorted) - 1,
-                           -(-9 * len(lat_sorted) // 10) - 1)], 3
-        ),
+        "p50_cycle_s": _pct(latencies, 0.5),
+        "p90_cycle_s": _pct(latencies, 0.9),
         "output_tokens": tokens_out,
         "wall_s": round(wall, 2),
         "tokens_per_s": round(tokens_out / wall, 1) if wall else 0.0,
+    }
+
+
+def run_config_concurrent(
+    model: str, n_workers: int, n_cycles: int
+) -> dict:
+    """The north-star shape (BASELINE.md): every worker streams cycles
+    concurrently while the queen takes turns — queen-turn latency is
+    reported separately. All agents share one serving engine, so this
+    exercises the continuous batcher the way a live swarm does."""
+    import threading
+
+    from room_tpu.core import agent_loop, rooms, workers
+    from room_tpu.db import Database
+
+    db = Database(":memory:")
+    room = rooms.create_room(
+        db, f"bench-{model.replace(':', '-')}", goal="benchmark run",
+        worker_model=model, create_wallet=False,
+    )
+    agent_loop.set_room_launch_enabled(room["id"], True)
+    queen_id = room["queen_worker_id"]
+    worker_ids = [
+        workers.create_worker(
+            db, f"w{i}", "benchmark worker", room_id=room["id"],
+            role="executor", model=model,
+        )
+        for i in range(n_workers)
+    ]
+
+    queen_lat: list[float] = []
+    worker_lat: list[float] = []
+    tokens = [0]
+    errors = [0]
+    lock = threading.Lock()
+
+    # barrier: every agent runs one untimed warmup cycle (XLA compiles
+    # are a boot cost), then the timed phase starts together
+    warm_barrier = threading.Barrier(1 + n_workers)
+    wall_box = [0.0]
+
+    def drive(wid: int, sink: list[float]) -> None:
+        for cycle_no in range(n_cycles + 1):
+            w = workers.get_worker(db, wid)
+            t0 = time.perf_counter()
+            try:
+                row = agent_loop.run_cycle(db, room, w)
+                dt = time.perf_counter() - t0
+                if cycle_no > 0:
+                    with lock:
+                        sink.append(dt)
+                        tokens[0] += row["output_tokens"] or 0
+                        if row["status"] != "success":
+                            errors[0] += 1
+            except Exception:
+                if cycle_no > 0:
+                    with lock:
+                        errors[0] += 1
+            if cycle_no == 0:
+                warm_barrier.wait()
+                if wid == queen_id:
+                    wall_box[0] = time.perf_counter()
+
+    from room_tpu.serving.embed_service import get_embed_host
+
+    get_embed_host().warmup()
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=drive, args=(wid, worker_lat))
+        for wid in worker_ids
+    ]
+    for t in threads:
+        t.start()
+    drive(queen_id, queen_lat)          # queen turns on this thread
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - (wall_box[0] or t_start)
+
+    agent_loop.set_room_launch_enabled(room["id"], False)
+    db.close()
+
+    return {
+        "model": model,
+        "agents": 1 + n_workers,
+        "cycles_run": len(queen_lat) + len(worker_lat),
+        "errors": errors[0],
+        "p50_cycle_s": _pct(worker_lat + queen_lat, 0.5),
+        "p90_cycle_s": _pct(worker_lat + queen_lat, 0.9),
+        "queen_p50_s": _pct(queen_lat, 0.5),
+        "queen_p90_s": _pct(queen_lat, 0.9),
+        "output_tokens": tokens[0],
+        "wall_s": round(wall, 2),
+        "tokens_per_s": round(tokens[0] / wall, 1) if wall else 0.0,
     }
 
 
@@ -85,16 +188,27 @@ def main() -> int:
     ap.add_argument("--models", nargs="+", default=["echo"])
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--cycles", type=int, default=3)
+    ap.add_argument("--concurrent", action="store_true",
+                    help="all agents cycle in parallel against the "
+                         "shared engine; queen latency reported "
+                         "separately (the BASELINE.md p50 shape)")
+    ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
     os.environ.setdefault("ROOM_TPU_DATA_DIR", tempfile.mkdtemp())
 
+    runner = run_config_concurrent if args.concurrent else run_config
     results = [
-        run_config(m, args.workers, args.cycles) for m in args.models
+        runner(m, args.workers, args.cycles) for m in args.models
     ]
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump({"results": results}, f, indent=1)
 
     cols = ("model", "agents", "cycles_run", "errors", "p50_cycle_s",
             "p90_cycle_s", "output_tokens", "tokens_per_s")
+    if args.concurrent:
+        cols = cols[:6] + ("queen_p50_s", "queen_p90_s") + cols[6:]
     widths = {c: max(len(c), *(len(str(r[c])) for r in results))
               for c in cols}
     print("  ".join(c.ljust(widths[c]) for c in cols))
