@@ -38,3 +38,46 @@ def db():
     d = Database(":memory:")
     yield d
     d.close()
+
+
+@pytest.fixture()
+def http_server(tmp_path, monkeypatch):
+    """Real ApiServer on port 0 with a runtime attached — the shared
+    harness for HTTP flow suites (reference: helpers/test-server.ts).
+    Chain RPC is pinned to a dead socket so wallet paths fail closed
+    instead of calling public endpoints from tests."""
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    monkeypatch.setenv("ROOM_TPU_EMAIL_OUTBOX", str(tmp_path / "outbox"))
+    for chain in ("BASE", "ETHEREUM", "ARBITRUM", "OPTIMISM", "POLYGON"):
+        monkeypatch.setenv(f"ROOM_TPU_RPC_{chain}", "http://127.0.0.1:1")
+    from room_tpu.server.http import ApiServer
+    from room_tpu.server.runtime import ServerRuntime
+
+    d = Database(":memory:")
+    runtime = ServerRuntime(db=d)
+    api = ApiServer(d, runtime=runtime, port=0)
+    api.start()
+    yield api
+    api.stop()
+    d.close()
+
+
+def http_req(server, method, path, body=None, token="agent"):
+    """Drive the shared server over real HTTP; returns (status, json)."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    headers = {"Authorization": f"Bearer {server.tokens[token]}"}
+    data = _json.dumps(body).encode() if body is not None else None
+    if data:
+        headers["Content-Type"] = "application/json"
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=data, headers=headers, method=method,
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return resp.status, _json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, _json.loads(e.read() or b"{}")
