@@ -330,20 +330,25 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
         # flush buffered logs BEFORE the row flips to finished: a reader
         # that sees status=success must also see the cycle's logs
         logs.flush()
+        duration_ms = int((time.monotonic() - started) * 1000)
         db.execute(
             "UPDATE worker_cycles SET finished_at=?, status=?, "
             "error_message=?, duration_ms=?, input_tokens=?, "
             "output_tokens=? WHERE id=?",
             (
-                utc_now(), status, result.error,
-                int((time.monotonic() - started) * 1000),
+                utc_now(), status, result.error, duration_ms,
                 result.input_tokens, result.output_tokens, cycle_id,
             ),
         )
         _prune_old_cycles(db, room["id"])
         event_bus.emit(
             "cycle:finished", f"room:{room['id']}",
-            {"cycle_id": cycle_id, "status": status},
+            {
+                "cycle_id": cycle_id, "status": status,
+                "worker_id": worker["id"],
+                "duration_ms": duration_ms,
+                "output_tokens": result.output_tokens,
+            },
         )
         return db.query_one(
             "SELECT * FROM worker_cycles WHERE id=?", (cycle_id,)

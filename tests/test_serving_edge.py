@@ -2,6 +2,8 @@
 token identity, eviction interplay with parked tool-call sessions,
 stats surface, StepTimer/HttpProfiler (density push, VERDICT r1 #9)."""
 
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -171,9 +173,16 @@ def test_http_profiling_endpoint(tmp_path, monkeypatch):
     srv.start()
     try:
         req(srv, "GET", "/api/rooms")
-        status, out = req(srv, "GET", "/api/profiling/http")
-        assert status == 200
-        assert any("rooms" in k for k in out["data"])
+        # the sample is recorded in the handler's finally block AFTER
+        # the response flushes, so poll briefly instead of racing it
+        deadline = time.time() + 5
+        while True:
+            status, out = req(srv, "GET", "/api/profiling/http")
+            assert status == 200
+            if any("rooms" in k for k in out["data"]):
+                break
+            assert time.time() < deadline, out["data"]
+            time.sleep(0.05)
     finally:
         srv.stop()
 

@@ -98,6 +98,13 @@ function subscribe(channel) {
   }
 }
 
+function unsubscribe(channel) {
+  if (ws && ws.readyState === 1 && subscribed.has(channel)) {
+    ws.send(JSON.stringify({type: "unsubscribe", channel}));
+    subscribed.delete(channel);
+  }
+}
+
 function connectWs() {
   ws = new WebSocket(
     `${location.protocol === "https:" ? "wss" : "ws"}://${location.host}` +

@@ -7,16 +7,18 @@
 
 let selectedRoom = null;
 
-// ---- swarm (live view over cycle events) ----
+// ---- swarm (live view over cycle events; reference:
+// SwarmPanel.tsx + hooks/useSwarmEvents.ts) ----
 
-const swarmState = {cards: {}, logs: {}};
+const swarmState = {cards: {}, logs: {}, focus: null};
 
 wsHandlers.swarm = (msg) => {
   const m = /^room:(\d+)$/.exec(msg.channel || "");
   if (m) {
     const d = msg.data || {};
     if (msg.type === "cycle:started") {
-      swarmState.cards[d.worker_id] = {
+      const prev = swarmState.cards[d.worker_id] || {};
+      swarmState.cards[d.worker_id] = {...prev,
         status: "cycling", cycle: d.cycle_id, at: Date.now()};
       subscribe(`cycle:${d.cycle_id}`);
       swarmState.logs[d.cycle_id] = [];
@@ -27,6 +29,14 @@ wsHandlers.swarm = (msg) => {
           card.status = msg.type === "cycle:error" ? "err"
             : (d.status === "error" ? "err" : "idle");
           card.last = d.status || d.error || "";
+          if (d.duration_ms != null) card.duration_ms = d.duration_ms;
+          if (d.output_tokens != null) card.tokens = d.output_tokens;
+          card.cycles = (card.cycles || 0) + 1;
+          // keep only the focused worker's finished-cycle logs
+          if (swarmState.focus !== Number(wid)) {
+            delete swarmState.logs[card.cycle];
+            unsubscribe(`cycle:${card.cycle}`);
+          }
         }
       }
     }
@@ -35,7 +45,7 @@ wsHandlers.swarm = (msg) => {
   if (c && msg.type === "cycle:log") {
     const logs = swarmState.logs[c[1]] || (swarmState.logs[c[1]] = []);
     logs.push(msg.data || {});
-    if (logs.length > 30) logs.shift();
+    if (logs.length > 200) logs.shift();
   }
   if ((m || c) && currentView === "swarm") renderSwarmCards();
 };
@@ -44,7 +54,14 @@ async function renderSwarm(el) {
   el.innerHTML = `
     <div class="panel"><h2>swarm</h2>
       <div class="dim" id="swarmSummary">loading…</div>
-      <div class="swarm-grid" id="swarmGrid" style="margin-top:.6rem"></div>
+      <div id="swarmRooms" style="margin-top:.6rem"></div>
+    </div>
+    <div class="panel" id="swarmConsoleBox" style="display:none">
+      <h2>live console <span class="dim" id="swarmConsoleWho"
+        style="font-size:.6em"></span>
+        <button class="ghost" onclick="swarmFocus(null)">close</button>
+      </h2>
+      <div class="log" id="swarmConsole" style="max-height:340px"></div>
     </div>
     <div class="panel"><h2>event feed</h2>
       <div class="log" id="eventLog"></div></div>`;
@@ -56,6 +73,7 @@ async function renderSwarm(el) {
     subscribe(`room:${r.id}`);
   }));
   swarmState.workers = workers;
+  swarmState.rooms = rooms;
   $("swarmSummary").textContent =
     `${rooms.length} rooms · ${workers.length} workers · ` +
     `${rooms.filter(r => r.launched).length} running`;
@@ -63,27 +81,86 @@ async function renderSwarm(el) {
   renderEventFeed();
 }
 
+function swarmFocus(workerId) {
+  swarmState.focus = workerId;
+  renderSwarmCards();
+}
+
+async function swarmRoomAction(roomId, action) {
+  await api("POST", `/api/rooms/${roomId}/${action}`);
+  showView("swarm");
+}
+
 function renderSwarmCards() {
-  const grid = $("swarmGrid");
+  const grid = $("swarmRooms");
   if (!grid) return;
   const workers = swarmState.workers || [];
-  grid.innerHTML = workers.map(w => {
-    const card = swarmState.cards[w.id] || {};
-    const cls = card.status === "cycling" ? "cycling"
-      : card.status === "err" ? "err" : "";
-    const logs = (swarmState.logs[card.cycle] || []).slice(-4);
-    return `<div class="swarm-card ${cls}">
-      <div class="who">${esc(w.name)}
-        <span class="pill">${esc(w.room_name || "")}</span></div>
-      <div class="dim" style="font-size:.8em">${esc(w.role || "worker")}
-        · ${esc(card.status || w.agent_state || "idle")}</div>
-      <div class="what">${logs.map(l =>
-        `[${esc(l.entry_type)}] ${esc(String(l.content).slice(0, 160))}`
-      ).join("\n") || esc(card.last || "")}</div>
-    </div>`;
+  const rooms = swarmState.rooms || [];
+  grid.innerHTML = rooms.map(r => {
+    const team = workers.filter(w => w.room_id === r.id);
+    return `<div style="margin-bottom:.8rem">
+      <div class="row" style="align-items:center;margin:.2rem 0">
+        <b>${esc(r.name)}</b>
+        <span class="pill ${r.launched ? "running" : "stopped"}">
+          ${r.launched ? "running" : "stopped"}</span>
+        <button class="ghost" onclick="swarmRoomAction(${r.id},
+          '${r.launched ? "stop" : "start"}')">
+          ${r.launched ? "stop" : "start"}</button>
+      </div>
+      <div class="swarm-grid">${team.map(w =>
+        swarmCard(w)).join("") ||
+        '<div class="dim">no workers in this room yet</div>'}
+      </div></div>`;
   }).join("") ||
     '<div class="dim">no workers yet — create a room first</div>';
+  renderSwarmConsole();
   renderEventFeed();
+}
+
+function swarmCard(w) {
+  const card = swarmState.cards[w.id] || {};
+  const cls = card.status === "cycling" ? "cycling"
+    : card.status === "err" ? "err" : "";
+  const logs = (swarmState.logs[card.cycle] || []).slice(-4);
+  const stats = [];
+  if (card.duration_ms != null) {
+    stats.push(`${(card.duration_ms / 1000).toFixed(1)}s`);
+  }
+  if (card.tokens != null) stats.push(`${card.tokens} tok`);
+  if (card.cycles) stats.push(`${card.cycles} cycles live`);
+  return `<div class="swarm-card ${cls}"
+      onclick="swarmFocus(${w.id})" style="cursor:pointer">
+    <div class="who">${esc(w.name)}
+      ${w.is_default ? "👑" : ""}
+      <span class="pill">${esc(w.role || "worker")}</span></div>
+    <div class="dim" style="font-size:.8em">
+      ${esc(card.status || w.agent_state || "idle")}
+      ${stats.length ? " · " + stats.join(" · ") : ""}</div>
+    ${w.wip ? `<div class="dim" style="font-size:.78em">
+      WIP: ${esc(String(w.wip).slice(0, 90))}</div>` : ""}
+    <div class="what">${logs.map(l =>
+      `[${esc(l.entry_type)}] ${esc(String(l.content).slice(0, 160))}`
+    ).join("\n") || esc(card.last || "")}</div>
+  </div>`;
+}
+
+function renderSwarmConsole() {
+  const box = $("swarmConsoleBox");
+  if (!box) return;
+  const wid = swarmState.focus;
+  if (!wid) { box.style.display = "none"; return; }
+  const w = (swarmState.workers || []).find(x => x.id === wid) || {};
+  const card = swarmState.cards[wid] || {};
+  const logs = swarmState.logs[card.cycle] || [];
+  box.style.display = "";
+  $("swarmConsoleWho").textContent =
+    `${w.name || "#" + wid} · cycle ${card.cycle || "—"}`;
+  const el = $("swarmConsole");
+  el.innerHTML = logs.map(l =>
+    `<div><span class="t">${esc(l.entry_type)}</span>` +
+    `${esc(String(l.content).slice(0, 800))}</div>`).join("") ||
+    '<div class="dim">no live logs yet — trigger a cycle</div>';
+  el.scrollTop = el.scrollHeight;
 }
 
 function renderEventFeed() {
