@@ -19,7 +19,9 @@ import threading
 import time
 
 BASELINE_TOK_S = 800.0
-WATCHDOG_S = float(os.environ.get("ROOM_TPU_BENCH_WATCHDOG_S", "480"))
+# first compile of the full bench model over the axon remote-compile
+# tunnel runs >8 min cold; the watchdog must outlast it
+WATCHDOG_S = float(os.environ.get("ROOM_TPU_BENCH_WATCHDOG_S", "1500"))
 TINY = os.environ.get("ROOM_TPU_BENCH_TINY") == "1"  # CPU smoke mode
 
 _result_printed = threading.Event()
@@ -104,6 +106,18 @@ def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
 
     import jax
+
+    # persistent compile cache: a warm run earlier in the round turns
+    # the driver's end-of-round bench into cache hits
+    try:
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", "/tmp/room_tpu_jax_cache"
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
     platform = jax.devices()[0].platform
     if platform != "cpu":
