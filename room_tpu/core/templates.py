@@ -65,6 +65,22 @@ WORKER_TEMPLATES: dict[str, WorkerTemplate] = {
             "reconcile them against goals, and surface trends the queen "
             "should act on.",
         ),
+        WorkerTemplate(
+            "herald", "Herald", "writer",
+            "Keeps the keeper and other rooms informed.",
+            "You are Herald. Watch for milestones, blockers, and "
+            "decisions that the keeper or peer rooms should hear about; "
+            "send concise messages when they happen and answer incoming "
+            "mail promptly.",
+        ),
+        WorkerTemplate(
+            "probe", "Probe", "researcher",
+            "Stress-tests the room's own plans.",
+            "You are Probe. Each cycle pick one active goal or recent "
+            "decision and try to break it: find the failure mode, the "
+            "missing dependency, the untested assumption. File what you "
+            "find as objections or memory notes.",
+        ),
     )
 }
 
@@ -99,6 +115,19 @@ ROOM_TEMPLATES: dict[str, RoomTemplate] = {
             "Keep scheduled jobs healthy and report anomalies.",
             "Executor + analyst + guardian for steady-state operations.",
             ("forge", "ledger", "warden"),
+        ),
+        RoomTemplate(
+            "content-studio", "Content Studio",
+            "Produce a steady stream of written artifacts on a theme.",
+            "Research feeds writing; a herald publishes updates.",
+            ("scout", "scribe", "scribe", "herald"),
+        ),
+        RoomTemplate(
+            "red-team", "Red Team",
+            "Adversarially probe a plan, product, or codebase and "
+            "report weaknesses.",
+            "Probes attack, a warden triages, a scribe writes it up.",
+            ("probe", "probe", "warden", "scribe"),
         ),
     )
 }

@@ -1,0 +1,188 @@
+"""Coverage for the quiet infra modules: telemetry (dedupe, gating),
+process supervisor (tree walk + kill sweep), learned-context distill
+triggers, secrets envelope edges, rate-limit reset parsing corners.
+(Reference analogues: telemetry.test.ts, process-supervisor tests,
+learned-context.test.ts.)"""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from room_tpu.core import learned_context, rate_limit, supervisor, telemetry
+from room_tpu.core.secrets import decrypt_secret, encrypt_secret
+
+
+# ---- telemetry ----
+
+def test_telemetry_machine_id_stable_and_anonymous():
+    a = telemetry.get_machine_id()
+    b = telemetry.get_machine_id()
+    assert a == b and len(a) == 12
+    assert os.uname().nodename not in a
+
+
+def test_crash_report_dedupes(db, monkeypatch):
+    sent = []
+    monkeypatch.setenv("ROOM_TPU_TELEMETRY_TOKEN", "t0k")
+    monkeypatch.setenv("ROOM_TPU_TELEMETRY_URL", "http://127.0.0.1:1")
+    monkeypatch.setattr(
+        telemetry, "_post", lambda payload: sent.append(payload) or True
+    )
+    err = RuntimeError("boom")
+    assert telemetry.submit_crash_report(db, err, "ctx") is True
+    assert telemetry.submit_crash_report(db, err, "ctx") is False
+    assert len(sent) == 1  # same signature sent once per day
+    assert sent[0]["error"].startswith("RuntimeError")
+
+
+# ---- process supervisor ----
+
+def test_tree_kill_reaps_every_descendant():
+    # spawn a parent that spawns a child, then kill the tree
+    proc = subprocess.Popen(
+        ["/bin/sh", "-c", "sleep 30 & wait"],
+    )
+    supervisor.register_managed_process(proc.pid, "test-tree")
+    try:
+        deadline = time.time() + 5
+        kids = []
+        while time.time() < deadline:
+            kids = supervisor._descendants(proc.pid)
+            if kids:
+                break
+            time.sleep(0.05)
+        assert kids, "child sleep never appeared"
+        killed = supervisor.kill_pid_tree(proc.pid)
+        assert killed >= 1
+        proc.wait(timeout=5)
+        # SIGKILL delivery to the orphaned child is async: poll
+        deadline = time.time() + 5
+        for pid in kids:
+            while time.time() < deadline:
+                try:
+                    os.kill(pid, 0)
+                    time.sleep(0.05)
+                except OSError:
+                    break
+            else:
+                pytest.fail(f"descendant {pid} survived tree kill")
+    finally:
+        supervisor.unregister_managed_process(proc.pid)
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_terminate_managed_sweep():
+    proc = subprocess.Popen(["sleep", "30"])
+    supervisor.register_managed_process(proc.pid, "sweep-me")
+    n = supervisor.terminate_managed_processes(grace_s=0.5)
+    assert n >= 1
+    proc.wait(timeout=5)
+    assert proc.pid not in supervisor.managed_processes()
+
+
+def test_spawn_managed_registers_and_cleans():
+    proc = supervisor.spawn_managed(["sleep", "0.1"], label="quick")
+    assert proc.pid in supervisor.managed_processes()
+    proc.wait(timeout=5)
+    supervisor.unregister_managed_process(proc.pid)
+
+
+# ---- learned context ----
+
+def test_should_distill_every_three_runs():
+    fire = [learned_context.should_distill(
+        {"run_count": n, "learned_context": "x" if n else None}
+    ) for n in range(1, 10)]
+    # fires at 3, 6, 9 (refresh cadence), never below 3
+    assert fire == [False, False, True, False, False, True,
+                    False, False, True]
+
+
+def test_distill_persists_memo(db):
+    from room_tpu.core import task_runner
+    from room_tpu.providers import reset_provider_cache
+
+    reset_provider_cache()
+    tid = task_runner.create_task(db, "t", "do", trigger_type="manual")
+    for _ in range(3):
+        db.insert(
+            "INSERT INTO task_runs(task_id, status, result) "
+            "VALUES (?, 'success', 'built the thing')", (tid,),
+        )
+    db.execute("UPDATE tasks SET run_count=3 WHERE id=?", (tid,))
+
+    memo = learned_context.distill_learned_context(
+        db, task_runner.get_task(db, tid), "echo"
+    )
+    assert memo
+    assert task_runner.get_task(db, tid)["learned_context"] == memo
+
+
+class _LongProvider:
+    def execute(self, req):
+        from room_tpu.providers.base import ExecutionResult
+
+        return ExecutionResult(success=True, text="y" * 9000)
+
+
+def test_distill_caps_length(db, monkeypatch):
+    from room_tpu.core import task_runner
+
+    tid = task_runner.create_task(db, "t2", "do", trigger_type="manual")
+    db.insert(
+        "INSERT INTO task_runs(task_id, status, result) "
+        "VALUES (?, 'success', 'r')", (tid,),
+    )
+    db.execute("UPDATE tasks SET run_count=3 WHERE id=?", (tid,))
+    monkeypatch.setattr(
+        learned_context, "get_model_provider",
+        lambda model, db=None: _LongProvider(),
+    )
+    memo = learned_context.distill_learned_context(
+        db, task_runner.get_task(db, tid), "echo"
+    )
+    assert memo is not None and len(memo) <= 1500
+
+
+# ---- secrets envelope edges ----
+
+def test_secret_envelope_roundtrip_and_tamper():
+    enc = encrypt_secret("hunter2")
+    assert enc.startswith("enc:v1:")
+    assert decrypt_secret(enc) == "hunter2"
+    # bit-flip the ciphertext: must raise, not return garbage
+    tampered = enc[:-4] + ("AAAA" if not enc.endswith("AAAA") else "BBBB")
+    with pytest.raises(Exception):
+        decrypt_secret(tampered)
+
+
+def test_decrypt_rejects_plaintext():
+    # a non-envelope value must be rejected loudly, not decrypted
+    with pytest.raises(ValueError, match="envelope"):
+        decrypt_secret("plain-old-value")
+
+
+# ---- rate limit parsing corners ----
+
+@pytest.mark.parametrize("msg", [
+    "usage limit reached|please wait",
+    "429 Too Many Requests",
+    "rate limit exceeded, try again later",
+])
+def test_detect_rate_limit_patterns(msg):
+    assert rate_limit.detect_rate_limit(msg) is not None
+
+
+def test_rate_limit_wait_clamped():
+    w = rate_limit.detect_rate_limit(
+        "rate limit exceeded. resets in 9 hours"
+    )
+    assert w is not None and w <= 60 * 60  # seconds, 60-min clamp
+
+
+def test_non_rate_limit_errors_pass():
+    assert rate_limit.detect_rate_limit("file not found") is None
+    assert rate_limit.detect_rate_limit("") is None
