@@ -121,11 +121,25 @@ def register_openai_routes(r: Router) -> None:
         sampling = SamplingParams(
             temperature=float(num("temperature", 0.7)),
             top_p=float(num("top_p", 1.0)),
+            # top_k is the Ollama/openai-compat extension the reference
+            # relied on (agent-executor.ts options passthrough)
+            top_k=int(num("top_k", 0)),
             max_new_tokens=int(
                 num("max_completion_tokens", None)
                 or num("max_tokens", None) or 1024
             ),
         )
+        stop_raw = b.get("stop")
+        if isinstance(stop_raw, str):
+            stop_list = [stop_raw]
+        elif isinstance(stop_raw, list):
+            if not all(isinstance(x, str) for x in stop_raw):
+                return err("stop must be a string or list of strings")
+            stop_list = [x for x in stop_raw if x]
+        elif stop_raw is None:
+            stop_list = []
+        else:
+            return err("stop must be a string or list of strings")
 
         def visible_text(token_ids):
             """Decoded reply without chat scaffolding: trailing stop
@@ -159,7 +173,8 @@ def register_openai_routes(r: Router) -> None:
         if b.get("stream"):
             q: queue_mod.Queue = queue_mod.Queue()
             turn = engine.submit(
-                prompt_tokens, sampling=sampling, on_token=q.put
+                prompt_tokens, sampling=sampling, on_token=q.put,
+                stop_strings=stop_list,
             )
 
             def sse():
@@ -181,6 +196,7 @@ def register_openai_routes(r: Router) -> None:
                     }
 
                 TOOL_TAG = "<tool_call>"
+                hold_pats = [TOOL_TAG] + stop_list
 
                 def emit_new(final=False):
                     """Incremental detokenization: decode only the
@@ -200,24 +216,39 @@ def register_openai_routes(r: Router) -> None:
                         # it surfaces via the tool_calls chunk instead
                         return None
                     held += tail
-                    if TOOL_TAG in held:
+                    stop_cut = min(
+                        (held.index(p) for p in stop_list if p in held),
+                        default=None,
+                    )
+                    if TOOL_TAG in held and (
+                        stop_cut is None
+                        or held.index(TOOL_TAG) < stop_cut
+                    ):
                         out_text = held.split(TOOL_TAG)[0]
                         held = ""   # XML and beyond stays unsent
+                        suppressing = True
+                    elif stop_cut is not None:
+                        # custom stop sequence: deliver text before it,
+                        # drop the sequence and everything after
+                        out_text = held[:stop_cut]
+                        held = ""
                         suppressing = True
                     elif not final and held.endswith("�"):
                         # split multi-byte sequence: wait for the rest
                         return None
                     else:
                         # longest suffix that could still grow into the
-                        # tool tag stays held (unless flushing)
+                        # tool tag or a stop sequence stays held
+                        # (unless flushing)
                         hold_n = 0
                         if not final:
-                            for n in range(
-                                min(len(TOOL_TAG) - 1, len(held)), 0, -1
-                            ):
-                                if TOOL_TAG.startswith(held[-n:]):
-                                    hold_n = n
-                                    break
+                            for pat in hold_pats:
+                                for n in range(
+                                    min(len(pat) - 1, len(held)), 0, -1
+                                ):
+                                    if pat.startswith(held[-n:]):
+                                        hold_n = max(hold_n, n)
+                                        break
                         out_text = held[: len(held) - hold_n]
                         held = held[len(held) - hold_n:]
                     out_text = out_text.replace("<|im_end|>", "")
@@ -275,7 +306,8 @@ def register_openai_routes(r: Router) -> None:
 
             return {"status": 200, "sse": sse()}
 
-        turn = engine.submit(prompt_tokens, sampling=sampling)
+        turn = engine.submit(prompt_tokens, sampling=sampling,
+                             stop_strings=stop_list)
         if not turn.done.wait(timeout=timeout_s):
             # release now: deferred-release frees the pages once the
             # in-flight turn finishes, so timeouts can't pin the pool
@@ -287,6 +319,9 @@ def register_openai_routes(r: Router) -> None:
             return err(turn.error or "generation failed", 500)
 
         text = visible_text(turn.new_tokens)
+        if turn.stop_hit and turn.stop_hit in text:
+            # OpenAI semantics: the matched stop sequence is excluded
+            text = text[: text.index(turn.stop_hit)]
         message: dict = {"role": "assistant", "content": text}
         finish = finish_map.get(turn.finish_reason, "stop")
         if turn.finish_reason == "tool_call":

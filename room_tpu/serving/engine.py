@@ -82,9 +82,13 @@ class Turn:
     prompt_tokens: list[int]
     sampling: SamplingParams
     on_token: Optional[Callable[[int], None]] = None
+    # custom stop sequences matched against the decoded tail (OpenAI
+    # `stop`; the reference's Ollama daemon honored these natively)
+    stop_strings: list[str] = field(default_factory=list)
     # filled by the engine:
     new_tokens: list[int] = field(default_factory=list)
     finish_reason: Optional[str] = None   # stop | length | tool_call | error
+    stop_hit: Optional[str] = None        # which stop string fired
     error: Optional[str] = None
     done: threading.Event = field(default_factory=threading.Event)
 
@@ -394,6 +398,7 @@ class ServingEngine:
         session_id: Optional[str] = None,
         sampling: Optional[SamplingParams] = None,
         on_token: Optional[Callable[[int], None]] = None,
+        stop_strings: Optional[list[str]] = None,
     ) -> Turn:
         """Queue a turn. If session_id names a parked session, generation
         resumes on top of its retained KV."""
@@ -403,6 +408,7 @@ class ServingEngine:
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
             on_token=on_token,
+            stop_strings=[s for s in (stop_strings or []) if s],
         )
         self._queue.put(turn)
         return turn
@@ -1109,8 +1115,6 @@ class ServingEngine:
         reason = None
         if token in self.stop_token_ids:
             reason = "stop"
-        elif len(turn.new_tokens) >= turn.sampling.max_new_tokens:
-            reason = "length"
         elif self._tool_end_id is not None:
             if token == self._tool_end_id:
                 reason = "tool_call"
@@ -1118,6 +1122,26 @@ class ServingEngine:
             tail = self.tokenizer.decode(turn.new_tokens[-24:])
             if "</tool_call>" in tail:
                 reason = "tool_call"
+
+        if reason is None and turn.stop_strings:
+            # window sized in UTF-8 BYTES: byte-level tokenizers emit
+            # one token per byte, BPE merges only shrink that, so a
+            # (bytes+8)-token tail always covers the longest stop
+            # string plus boundary slack
+            longest = max(
+                len(x.encode("utf-8")) for x in turn.stop_strings
+            )
+            tail = self.tokenizer.decode(
+                turn.new_tokens[-(longest + 8):]
+            )
+            for stop_s in turn.stop_strings:
+                if stop_s in tail:
+                    turn.stop_hit = stop_s
+                    reason = "stop"  # beats "length" on the last token
+                    break
+
+        if reason is None and                 len(turn.new_tokens) >= turn.sampling.max_new_tokens:
+            reason = "length"
 
         if reason is not None:
             self._finish_turn(slot, turn, reason)

@@ -176,7 +176,7 @@ class _ScriptedEngine:
         self._threading = threading
 
     def submit(self, prompt_tokens, *, sampling=None, on_token=None,
-               session_id=None):
+               session_id=None, stop_strings=None):
         th = self._threading
 
         class Turn:
@@ -186,6 +186,7 @@ class _ScriptedEngine:
         turn.session_id = session_id or "scripted"
         turn.new_tokens = []
         turn.finish_reason = None
+        turn.stop_hit = None
         turn.error = None
         turn.done = th.Event()
         ids = self.tokenizer.encode(self._text)
@@ -291,3 +292,112 @@ def test_v1_sessions_released_after_turn(server):
         assert status == 200
     eng = get_model_host("tiny-moe")._engine
     assert len(eng.sessions) == 0
+
+
+def test_v1_stop_sequence_nonstream(server, monkeypatch):
+    """A custom stop string ends generation and is excluded from the
+    reply (OpenAI `stop` semantics; the reference's Ollama daemon
+    honored these natively)."""
+    eng = _ScriptedEngine("alpha STOPWORD omega never-seen")
+    # scripted engine ignores stops itself; emulate the real engine's
+    # behavior by exposing stop_hit through a subclassed submit
+    real_submit = eng.submit
+
+    def submit(prompt_tokens, **kw):
+        turn = real_submit(prompt_tokens, **kw)
+        turn.done.wait(5)
+        if kw.get("stop_strings"):
+            text = eng.tokenizer.decode(turn.new_tokens)
+            for s in kw["stop_strings"]:
+                if s in text:
+                    turn.stop_hit = s
+                    turn.finish_reason = "stop"
+        return turn
+
+    eng.submit = submit
+
+    class Host:
+        def engine(self):
+            return eng
+
+    import room_tpu.providers.tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "get_model_host", lambda name: Host())
+    status, out = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "go"}],
+        "stop": "STOPWORD",
+    })
+    assert status == 200
+    content = out["choices"][0]["message"]["content"]
+    assert "STOPWORD" not in content
+    assert content.startswith("alpha")
+    assert "omega" not in content
+
+
+def test_v1_stop_sequence_streaming_never_leaks(server, monkeypatch):
+    """Streaming must hold back any suffix that could grow into a stop
+    sequence and never deliver the sequence or what follows."""
+    eng = _ScriptedEngine("one two STOPWORD three four")
+
+    class Host:
+        def engine(self):
+            return eng
+
+    import room_tpu.providers.tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "get_model_host", lambda name: Host())
+    status, body = call(server, "POST", "/v1/chat/completions", {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "go"}],
+        "stream": True,
+        "stop": ["STOPWORD"],
+    }, raw=True)
+    assert status == 200
+    events = [
+        json.loads(line[len("data: "):])
+        for line in body.decode().splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    content = "".join(
+        e["choices"][0]["delta"].get("content") or ""
+        for e in events if "choices" in e
+    )
+    assert "STOPWORD" not in content
+    assert "three" not in content
+    assert content.startswith("one two")
+
+
+def test_engine_stop_strings_end_generation(server):
+    """Real engine path: stop_strings finish the turn with reason
+    'stop' and record which string fired, even when the string spans
+    token boundaries."""
+    import jax
+
+    from room_tpu.models import qwen3
+    from room_tpu.models.config import tiny_moe
+    from room_tpu.serving import SamplingParams, ServingEngine
+
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, max_batch=1, page_size=8,
+                        n_pages=64)
+    # byte tokenizer: every decoded token is one char, so pick a stop
+    # string the random model will hit quickly (any single byte it
+    # emits early)
+    probe = eng.submit([1, 2, 3], sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=8))
+    eng.run_until_idle()
+    assert probe.new_tokens
+    decoded = eng.tokenizer.decode(probe.new_tokens)
+    assert decoded, "greedy run emitted no decodable text"
+    stop_char = decoded[:2] if len(decoded) >= 2 else decoded
+    eng.release_session(probe.session_id)
+
+    t = eng.submit([1, 2, 3], sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=64),
+        stop_strings=[stop_char])
+    eng.run_until_idle()
+    assert t.finish_reason == "stop"
+    assert t.stop_hit == stop_char
+    assert len(t.new_tokens) < 64
