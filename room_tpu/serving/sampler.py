@@ -15,6 +15,29 @@ class SamplingParams:
     top_k: int = 0          # 0 = off
     top_p: float = 1.0      # 1.0 = off
     max_new_tokens: int = 1024
+    # OpenAI-style repetition controls over THIS request's generated
+    # tokens: presence subtracts a flat penalty from every token already
+    # emitted, frequency subtracts proportionally to its count
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+
+    @property
+    def penalized(self) -> bool:
+        return bool(self.presence_penalty or self.frequency_penalty)
+
+
+def apply_penalties(
+    logits,          # [B, V] f32
+    counts,          # [B, V] int32 — this request's generated-token counts
+    presence,        # [B] f32
+    frequency,       # [B] f32
+):
+    """OpenAI penalty semantics: logits[b, v] -= presence[b]*(count>0)
+    + frequency[b]*count. Rows with both zero are untouched."""
+    import jax.numpy as _jnp
+
+    c = counts.astype(_jnp.float32)
+    return logits - presence[:, None] * (c > 0) - frequency[:, None] * c
 
 
 def sample(
