@@ -118,17 +118,28 @@ def register_openai_routes(r: Router) -> None:
             v = b.get(key)
             return default if v is None else v
 
-        sampling = SamplingParams(
-            temperature=float(num("temperature", 0.7)),
-            top_p=float(num("top_p", 1.0)),
-            # top_k is the Ollama/openai-compat extension the reference
-            # relied on (agent-executor.ts options passthrough)
-            top_k=int(num("top_k", 0)),
-            max_new_tokens=int(
-                num("max_completion_tokens", None)
-                or num("max_tokens", None) or 1024
-            ),
-        )
+        try:
+            presence = float(num("presence_penalty", 0.0))
+            frequency = float(num("frequency_penalty", 0.0))
+            sampling = SamplingParams(
+                temperature=float(num("temperature", 0.7)),
+                top_p=float(num("top_p", 1.0)),
+                # top_k is the Ollama/openai-compat extension the
+                # reference relied on (agent-executor.ts passthrough)
+                top_k=int(num("top_k", 0)),
+                max_new_tokens=int(
+                    num("max_completion_tokens", None)
+                    or num("max_tokens", None) or 1024
+                ),
+                presence_penalty=presence,
+                frequency_penalty=frequency,
+            )
+        except (TypeError, ValueError):
+            return err("sampling parameters must be numbers")
+        if not (-2.0 <= presence <= 2.0):
+            return err("presence_penalty must be in [-2, 2]")
+        if not (-2.0 <= frequency <= 2.0):
+            return err("frequency_penalty must be in [-2, 2]")
         stop_raw = b.get("stop")
         if isinstance(stop_raw, str):
             stop_list = [stop_raw]
@@ -140,6 +151,13 @@ def register_openai_routes(r: Router) -> None:
             stop_list = []
         else:
             return err("stop must be a string or list of strings")
+        # OpenAI caps stop at 4 sequences; bounding each sequence's
+        # length also bounds the decoded-tail window the engine rescans
+        # on every generated token
+        if len(stop_list) > 4:
+            return err("at most 4 stop sequences are supported")
+        if any(len(s.encode("utf-8")) > 64 for s in stop_list):
+            return err("each stop sequence must be at most 64 bytes")
 
         def visible_text(token_ids):
             """Decoded reply without chat scaffolding: trailing stop

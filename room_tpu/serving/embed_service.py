@@ -46,15 +46,20 @@ class EmbedHost:
         self.dim = self.cfg.hidden
 
     def warmup(self) -> None:
-        """Compile the (1, bucket) encoder shapes up front so the first
-        swarm cycles don't each pay a ~1s XLA compile mid-prompt."""
+        """Compile the encoder shapes up front so the first swarm cycles
+        don't each pay a ~1s XLA compile mid-prompt. Rows are bucketed
+        too, so each length bucket is warmed at 1 row AND the indexer's
+        typical batch size (reference indexes in batches of 10 →
+        rows bucket 16; embedding-indexer.ts:5)."""
         # probe by TOKEN count (tokenizers differ in tokens-per-char):
         # find a text unit, then size each probe to land in its bucket
         unit = "w "
         per_unit = max(1, len(self.tokenizer.encode(unit * 8)) // 8)
         for bucket in (16, 32, 64, 128):
             n_units = -(-(bucket // 2 + 1) // per_unit)  # ceil
-            self.embed([unit * n_units])
+            text = unit * n_units
+            self.embed([text])
+            self.embed([text] * 10)
 
     def embed(self, texts: Sequence[str]) -> np.ndarray:
         import jax.numpy as jnp

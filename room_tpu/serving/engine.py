@@ -39,12 +39,24 @@ import numpy as np
 
 from ..models import qwen3
 from ..models.config import DecoderConfig
-from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
-from .sampler import SamplingParams, sample_batched, spec_verify
+from .kv_pages import (
+    PageTable, init_page_cache, make_paged_kv_hook, use_pallas_kernel,
+)
+from .sampler import (
+    SamplingParams, apply_penalties, sample_batched, spec_verify,
+)
 from .tokenizer import ByteTokenizer, Tokenizer
 
 PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
                    16384, 32768)
+
+
+@jax.jit
+def _reset_count_row(counts, slot, tok):
+    """Zero one slot's penalty-count row and count its first sampled
+    token (runs at admission; device-side so the [B, V] array never
+    round-trips to host)."""
+    return counts.at[slot].set(0).at[slot, tok].add(1)
 
 
 def propose_ngram(seq: list[int], gamma: int) -> list[int]:
@@ -241,6 +253,21 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(rng_seed)
         self._deferred_release: set[str] = set()
         self._admitting: set[str] = set()
+        # concurrency contract: ALL mutation of sessions / page table /
+        # slot arrays / prefix cache happens on the engine thread (the
+        # thread driving step()). Other threads only enqueue: submit()
+        # puts turns on _queue; release_session() puts ids on
+        # _release_requests when a loop thread owns the engine, and
+        # step() applies them before admission. _lock covers only the
+        # small cross-thread handoffs (loop-thread identity, deferred
+        # set, stats snapshot).
+        self._release_requests: "queue.SimpleQueue[str]" = \
+            queue.SimpleQueue()
+        self._loop_thread: Optional[threading.Thread] = None
+        # [max_batch, V] per-request generated-token counts for OpenAI
+        # presence/frequency penalties; allocated on first penalized
+        # turn (most traffic never pays the HBM)
+        self._counts: Optional[jax.Array] = None
         # automatic prefix caching (0 disables; value = min prefix
         # pages worth sharing)
         self.prefix_cache_min_pages = int(
@@ -292,8 +319,30 @@ class ServingEngine:
             x = jax.device_put(x, NamedSharding(self.mesh, spec))
         return x
 
-    def _prefill_fn(self, bucket: int, fresh: bool):
-        key = ("prefill", bucket, fresh)
+    def _pages_bucket(self, n_tokens: int) -> Optional[int]:
+        """Static bound on how many leading block-table pages attention
+        must gather for sequences reaching ``n_tokens``: ceil(/page)
+        rounded up to a power of two (so compile variants stay
+        O(log capacity)), clamped to the table width. None when the
+        bound equals capacity (no slicing to do)."""
+        need = max(1, -(-n_tokens // self.page_size))
+        b = 1
+        while b < need:
+            b *= 2
+        return b if b < self.max_pages_per_seq else None
+
+    def _counts_array(self) -> jax.Array:
+        if self._counts is None:
+            self._counts = self._place_batch(
+                np.zeros(
+                    (self.max_batch, self.cfg.vocab_size), np.int32
+                )
+            )
+        return self._counts
+
+    def _prefill_fn(self, bucket: int, fresh: bool,
+                    active_pages: Optional[int] = None):
+        key = ("prefill", bucket, fresh, active_pages)
         if key not in self._jit_cache:
             cfg = self.cfg
 
@@ -302,7 +351,7 @@ class ServingEngine:
                         last_idx):
                 hook = make_paged_kv_hook(
                     block_table, length, self.page_size,
-                    fresh_prefill=fresh,
+                    fresh_prefill=fresh, active_pages=active_pages,
                 )
                 positions = length[:, None] + jnp.arange(tokens.shape[1])
                 # only each row's last real position gets sampled; at a
@@ -321,51 +370,73 @@ class ServingEngine:
             self._jit_cache[key] = prefill
         return self._jit_cache[key]
 
-    def _decode_fn(self, n_steps: int):
+    def _decode_fn(self, n_steps: int,
+                   active_pages: Optional[int] = None,
+                   penalized: bool = False):
         """One compiled function advancing every slot ``n_steps`` tokens
         with a single host round-trip (lax.scan over the decode step).
         Slots that hit a stop mid-chunk keep generating; the host trims
         — their extra KV writes sit beyond the session length and are
-        overwritten on resume."""
-        key = ("decode", n_steps)
+        overwritten on resume.
+
+        ``penalized`` compiles the OpenAI presence/frequency-penalty
+        variant: a [B, V] per-request generated-token count array rides
+        the scan carry, logits are penalized before sampling (greedy
+        rows argmax the penalized logits too), each sampled token bumps
+        its row's count."""
+        key = ("decode", n_steps, active_pages, penalized)
         if key not in self._jit_cache:
             cfg = self.cfg
 
-            @partial(jax.jit, donate_argnums=(1,))
-            def decode(params, cache, tokens, block_tables, lengths, rng,
-                       temperature, top_p, top_k):
+            @partial(jax.jit,
+                     donate_argnums=(1, 2) if penalized else (1,))
+            def decode(params, cache, counts, tokens, block_tables,
+                       lengths, rng, temperature, top_p, top_k,
+                       presence, frequency):
                 def step(carry, step_rng):
-                    toks, cache, lens = carry
+                    toks, cache, lens, cnts = carry
                     hook = make_paged_kv_hook(
-                        block_tables, lens, self.page_size
+                        block_tables, lens, self.page_size,
+                        active_pages=active_pages,
                     )
                     logits, cache = qwen3.forward(
                         params, cfg, toks[:, None], lens[:, None],
                         cache, kv_hook=hook,
                     )
+                    row_logits = logits[:, 0]
+                    if penalized:
+                        row_logits = apply_penalties(
+                            row_logits.astype(jnp.float32), cnts,
+                            presence, frequency,
+                        )
                     nxt = sample_batched(
-                        logits[:, 0], step_rng, temperature, top_p,
+                        row_logits, step_rng, temperature, top_p,
                         top_k,
                     )
-                    return (nxt, cache, lens + 1), nxt
+                    if penalized:
+                        cnts = cnts.at[
+                            jnp.arange(nxt.shape[0]), nxt
+                        ].add(1)
+                    return (nxt, cache, lens + 1, cnts), nxt
 
-                (_, cache, _), out = jax.lax.scan(
-                    step, (tokens, cache, lengths),
+                (_, cache, _, counts), out = jax.lax.scan(
+                    step, (tokens, cache, lengths, counts),
                     jax.random.split(rng, n_steps),
                 )
-                return out.T, self._constrain_cache(cache)  # [B, n_steps]
+                return out.T, counts, \
+                    self._constrain_cache(cache)  # [B, n_steps]
 
             self._jit_cache[key] = decode
         return self._jit_cache[key]
 
-    def _spec_fn(self, width: int):
+    def _spec_fn(self, width: int, active_pages: Optional[int] = None):
         """Speculative verify: one forward over [B, width] windows
         (current token + width-1 draft tokens), KV written through the
         paged hook at positions length..length+width-1. Verification is
         full speculative sampling (sampler.spec_verify): greedy rows
         reduce to exact argmax equivalence, stochastic rows keep their
         exact sampling distribution via accept/residual draws."""
-        key = ("spec", width)
+        key = ("spec", width, active_pages)
         if key not in self._jit_cache:
             cfg = self.cfg
 
@@ -373,7 +444,8 @@ class ServingEngine:
             def spec(params, cache, tokens, block_tables, lengths, rng,
                      temperature, top_p, top_k):
                 hook = make_paged_kv_hook(
-                    block_tables, lengths, self.page_size
+                    block_tables, lengths, self.page_size,
+                    active_pages=active_pages,
                 )
                 positions = lengths[:, None] + jnp.arange(width)
                 logits, cache = qwen3.forward(
@@ -416,12 +488,44 @@ class ServingEngine:
     def release_session(self, session_id: str) -> None:
         """Free a session's pages. If the session is mid-turn, the release
         happens when that turn finishes (freeing live pages would let a
-        new session reuse them while the old slot still writes KV)."""
+        new session reuse them while the old slot still writes KV).
+
+        Thread-safe: when a loop thread owns the engine (serve_forever),
+        the release is routed through the command queue and applied on
+        the engine thread before the next admission — so a release can
+        never race _admit/_decode_once on the page table. Without a
+        loop thread (synchronous step()/run_until_idle use) it applies
+        inline."""
+        with self._lock:
+            loop = self._loop_thread
+        if loop is not None and loop.is_alive() and \
+                loop is not threading.current_thread():
+            self._release_requests.put(session_id)
+            # the loop may have exited between the check and the put;
+            # if nobody owns the engine anymore, apply the queue now
+            with self._lock:
+                loop = self._loop_thread
+            if loop is None or not loop.is_alive():
+                self._drain_releases()
+            return
+        self._do_release(session_id)
+
+    def _drain_releases(self) -> None:
+        while True:
+            try:
+                sid = self._release_requests.get_nowait()
+            except queue.Empty:
+                return
+            self._do_release(sid)
+
+    def _do_release(self, session_id: str) -> None:
+        """Apply a release on the engine thread (or synchronously when
+        no loop thread owns the engine)."""
         with self._lock:
             if any(
                 t is not None and t.session_id == session_id
                 for t in self._active
-            ):
+            ) or session_id in self._admitting:
                 self._deferred_release.add(session_id)
                 return
             sess = self.sessions.pop(session_id, None)
@@ -442,8 +546,9 @@ class ServingEngine:
     # ---- engine loop ----
 
     def step(self) -> int:
-        """One scheduler iteration: admit + one decode step. Returns the
-        number of active slots (0 = idle)."""
+        """One scheduler iteration: apply queued releases, admit, one
+        decode step. Returns the number of active slots (0 = idle)."""
+        self._drain_releases()
         self._admit()
         return self._decode_once()
 
@@ -454,9 +559,17 @@ class ServingEngine:
         raise RuntimeError("run_until_idle exceeded max_steps")
 
     def serve_forever(self, stop_event: threading.Event, idle_sleep=0.002):
-        while not stop_event.is_set():
-            if self.step() == 0 and self._queue.empty():
-                time.sleep(idle_sleep)
+        with self._lock:
+            self._loop_thread = threading.current_thread()
+        try:
+            while not stop_event.is_set():
+                if self.step() == 0 and self._queue.empty():
+                    time.sleep(idle_sleep)
+        finally:
+            with self._lock:
+                self._loop_thread = None
+            # releases enqueued while stopping still apply
+            self._drain_releases()
 
     # ---- internals ----
 
@@ -589,14 +702,23 @@ class ServingEngine:
         multi-tenant rooms submitting simultaneously don't serialize."""
         free = self._free_slots()
         preps: list[dict] = []
-        self._admitting.clear()
+        with self._lock:
+            self._admitting.clear()
         try:
             while free and not self._queue.empty() and \
                     len(preps) < len(free):
                 turn = self._queue.get()
+                # registered BEFORE pages are reserved so an inline
+                # release from another thread can't free a batchmate's
+                # reservation mid-admission (it defers instead);
+                # mutation under _lock because _do_release reads it
+                with self._lock:
+                    self._admitting.add(turn.session_id)
                 try:
                     prep = self._prepare_turn(turn)
                 except MemoryError as e:
+                    with self._lock:
+                        self._admitting.discard(turn.session_id)
                     # pool exhausted: requeue and stop admitting; decode
                     # will drain sessions and free pages
                     if self._free_slots() == \
@@ -609,19 +731,37 @@ class ServingEngine:
                     break
                 if prep is not None:
                     preps.append(prep)
-                    self._admitting.add(turn.session_id)
+                else:
+                    with self._lock:
+                        self._admitting.discard(turn.session_id)
 
             # group by identical prefill shape
             groups: dict[tuple, list[dict]] = {}
             for prep in preps:
                 groups.setdefault(
-                    (prep["bucket"], prep["fresh"]), []
+                    (prep["bucket"], prep["fresh"], prep["active_pages"]),
+                    [],
                 ).append(prep)
-            for (bucket, fresh), group in groups.items():
+            for (bucket, fresh, active_pages), group in groups.items():
                 slots = [free.pop(0) for _ in group]
-                self._prefill_group(bucket, fresh, group, slots)
+                self._prefill_group(
+                    bucket, fresh, group, slots,
+                    active_pages=active_pages,
+                )
         finally:
-            self._admitting.clear()
+            with self._lock:
+                self._admitting.clear()
+                deferred = set(self._deferred_release)
+            # releases deferred while a session was mid-admission whose
+            # turn never reached a slot (prep failed / requeued) would
+            # otherwise linger: _finish_turn only sees slotted turns
+            for sid in deferred:
+                if not any(
+                    t is not None and t.session_id == sid
+                    for t in self._active
+                ):
+                    self._deferred_release.discard(sid)
+                    self._do_release(sid)
 
     def _prepare_turn(self, turn: Turn) -> Optional[dict]:
         """Validate + reserve pages for a queued turn. Returns the
@@ -751,10 +891,15 @@ class ServingEngine:
         table[: len(all_pages)] = all_pages
         for chunk_toks in pre_chunks:
             self._prefill_write_chunk(sess, chunk_toks, table)
+        fresh = sess.length == 0
         return {
             "turn": turn, "sess": sess, "prompt": tail,
-            "bucket": bucket, "fresh": sess.length == 0,
+            "bucket": bucket, "fresh": fresh,
             "table": table, "base_length": sess.length,
+            # continuation prefill gathers only the pages this turn can
+            # reach (bucketed), not the table's full capacity
+            "active_pages": None if fresh else
+            self._pages_bucket(sess.length + bucket),
         }
 
     def _prefill_write_chunk(
@@ -764,7 +909,9 @@ class ServingEngine:
         sampling)."""
         width = len(toks)
         fresh = sess.length == 0
-        key = ("prefill_write", width, fresh)
+        active = None if fresh else \
+            self._pages_bucket(sess.length + width)
+        key = ("prefill_write", width, fresh, active)
         if key not in self._jit_cache:
             cfg = self.cfg
 
@@ -772,7 +919,7 @@ class ServingEngine:
             def write(params, cache, tokens, block_table, length):
                 hook = make_paged_kv_hook(
                     block_table, length, self.page_size,
-                    fresh_prefill=fresh,
+                    fresh_prefill=fresh, active_pages=active,
                 )
                 positions = length[:, None] + \
                     jnp.arange(tokens.shape[1])
@@ -798,7 +945,7 @@ class ServingEngine:
 
     def _prefill_group(
         self, bucket: int, fresh: bool, group: list[dict],
-        slots: list[int],
+        slots: list[int], active_pages: Optional[int] = None,
     ) -> None:
         n = len(group)
         # pad the batch to a power of two so compiles stay bounded at
@@ -815,7 +962,9 @@ class ServingEngine:
             tables[r] = prep["table"]
             lengths[r] = prep["base_length"]
 
-        prefill = self._prefill_fn(bucket, fresh=fresh)
+        prefill = self._prefill_fn(
+            bucket, fresh=fresh, active_pages=active_pages,
+        )
         with self.timer.phase(f"prefill_{bucket}x{n}"):
             # first generated token per row comes from its last real
             # position (the head runs only there, device-side)
@@ -842,6 +991,23 @@ class ServingEngine:
                 jnp.asarray(top_ps + [1.0] * (n_pad - n), jnp.float32),
                 jnp.asarray(top_ks + [0] * (n_pad - n), jnp.int32),
             ))
+
+        # per-request penalty counts start fresh at admission; the first
+        # sampled token is generated text, so it counts. Only penalized
+        # turns pay the row reset — non-penalized rows are never read,
+        # and a penalized reuse of a slot resets it at its own admission
+        pen = [
+            (slot, int(firsts[r]))
+            for r, (prep, slot) in enumerate(zip(group, slots))
+            if prep["turn"].sampling.penalized
+        ]
+        if pen:
+            counts = self._counts_array()
+            for slot, tok in pen:
+                counts = _reset_count_row(
+                    counts, jnp.int32(slot), jnp.int32(tok)
+                )
+            self._counts = counts
 
         for r, (prep, slot) in enumerate(zip(group, slots)):
             turn, sess = prep["turn"], prep["sess"]
@@ -901,7 +1067,12 @@ class ServingEngine:
         ]
         if not active_idx:
             return 0
-        if self.spec_tokens > 0:
+        penalized = any(
+            self._active[i].sampling.penalized for i in active_idx
+        )
+        if self.spec_tokens > 0 and not penalized:
+            # spec verify has no penalty path: penalized rows take the
+            # sequential scan so their counts stay exact
             n = self._decode_once_spec(active_idx)
             if n is not None:
                 return n
@@ -936,12 +1107,37 @@ class ServingEngine:
             top_ps[i] = sp.top_p
             top_ks[i] = sp.top_k
 
-        decode = self._decode_fn(chunk)
+        # bound the XLA fallback's page gather to the batch's actual
+        # reach (the Pallas kernel is already length-bounded — passing a
+        # varying static bound there would only churn compiles)
+        ap = None
+        if not use_pallas_kernel():
+            max_len = max(
+                int(self._slot_lengths[i]) for i in active_idx
+            )
+            ap = self._pages_bucket(max_len + chunk)
+        if penalized:
+            presence = np.zeros((self.max_batch,), np.float32)
+            frequency = np.zeros((self.max_batch,), np.float32)
+            for i in active_idx:
+                sp = self._active[i].sampling
+                presence[i] = sp.presence_penalty
+                frequency[i] = sp.frequency_penalty
+            counts = self._counts_array()
+            pen_args = (
+                self._place_batch(presence),
+                self._place_batch(frequency),
+            )
+        else:
+            counts = jnp.int32(0)
+            pen_args = (jnp.float32(0), jnp.float32(0))
+        decode = self._decode_fn(chunk, ap, penalized)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode"):
-            next_tokens, self.cache = decode(
+            next_tokens, counts_out, self.cache = decode(
                 self.params,
                 self.cache,
+                counts,
                 self._place_batch(tokens),
                 self._place_batch(self._slot_tables),
                 self._place_batch(self._slot_lengths),
@@ -949,7 +1145,10 @@ class ServingEngine:
                 self._place_batch(temps),
                 self._place_batch(top_ps),
                 self._place_batch(top_ks),
+                *pen_args,
             )
+            if penalized:
+                self._counts = counts_out
             next_host = np.asarray(next_tokens)   # [B, chunk]
         self._stats["decode_steps"] += 1
 
@@ -1048,7 +1247,12 @@ class ServingEngine:
             top_ps[i] = sp.top_p
             top_ks[i] = sp.top_k
 
-        spec = self._spec_fn(width)
+        # the verify forward is S>1 and always takes the gather path:
+        # bound it to the batch's reach
+        max_len = max(int(self._slot_lengths[i]) for i in active_idx)
+        spec = self._spec_fn(
+            width, self._pages_bucket(max_len + width)
+        )
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode_spec"):
             accept_d, residual_d, plain_d, self.cache = spec(

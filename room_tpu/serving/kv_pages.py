@@ -56,6 +56,7 @@ def make_paged_kv_hook(
     page_size: int,
     pallas_decode: Optional[bool] = None,
     fresh_prefill: bool = False,
+    active_pages: Optional[int] = None,
 ):
     """Build the kv_hook used by models.qwen3.forward: writes the chunk's
     k/v into the page pool and attends over (prefix + chunk).
@@ -66,6 +67,13 @@ def make_paged_kv_hook(
     every sequence starts at length 0, so attention runs over the chunk
     itself and the page gather is skipped entirely — the common
     new-session prefill does no cache reads at all.
+
+    ``active_pages`` is a static caller promise that every sequence's
+    final length (prefix + this chunk) fits in that many leading
+    block-table pages: the XLA gather then reads only those pages, so
+    continuation prefill / decode cost scales with the actual session
+    length instead of the table's full 32k-token capacity. Callers
+    bucket it (powers of two) to bound compile variants.
     """
     b, max_pages = block_tables.shape
     if pallas_decode is None:
@@ -116,10 +124,14 @@ def make_paged_kv_hook(
             return attn, {"k_pages": kp, "v_pages": vp}
 
         # gather this batch's pages into a dense view (XLA reference path;
-        # the Pallas kernel replaces this gather)
-        k_all = kp[block_tables]                                 # [B,P,p,H,D]
-        v_all = vp[block_tables]
-        kv_len = max_pages * page_size
+        # the Pallas kernel replaces this gather), bounded to the pages
+        # the batch can actually reach when the caller promised a limit
+        tbl = block_tables
+        if active_pages is not None and active_pages < max_pages:
+            tbl = block_tables[:, :active_pages]
+        k_all = kp[tbl]                                          # [B,P,p,H,D]
+        v_all = vp[tbl]
+        kv_len = tbl.shape[1] * page_size
         k_all = k_all.reshape(b, kv_len, *k.shape[2:])
         v_all = v_all.reshape(b, kv_len, *v.shape[2:])
 

@@ -129,9 +129,12 @@ def run_config_concurrent(
 
     def drive(wid: int, sink: list[float]) -> None:
         for cycle_no in range(n_cycles + 1):
-            w = workers.get_worker(db, wid)
             t0 = time.perf_counter()
             try:
+                # inside the try: a get_worker failure during warmup
+                # must still reach the barrier below or every other
+                # thread deadlocks at it
+                w = workers.get_worker(db, wid)
                 row = agent_loop.run_cycle(db, room, w)
                 dt = time.perf_counter() - t0
                 if cycle_no > 0:
@@ -145,7 +148,12 @@ def run_config_concurrent(
                     with lock:
                         errors[0] += 1
             if cycle_no == 0:
-                warm_barrier.wait()
+                try:
+                    # timeout breaks the barrier for everyone instead of
+                    # hanging the run if a peer died before reaching it
+                    warm_barrier.wait(timeout=600)
+                except threading.BrokenBarrierError:
+                    pass
                 if wid == queen_id:
                     wall_box[0] = time.perf_counter()
 

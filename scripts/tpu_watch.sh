@@ -1,0 +1,46 @@
+#!/bin/bash
+# TPU window watcher (round 3): probe the axon tunnel until a green
+# window opens, then immediately run the full bench + autotune sweep so
+# the round records a real hardware number (VERDICT r2 item #1).
+#
+# Usage: bash scripts/tpu_watch.sh  (intended to run in the background)
+# Logs:  /tmp/tpu_watch3.log, results in /tmp/bench_r3.json
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch3.log}
+PROBE_TIMEOUT=${TPU_PROBE_TIMEOUT:-300}
+COOLDOWN=${TPU_PROBE_COOLDOWN:-480}
+cd "$(dirname "$0")/.." || exit 1
+
+while true; do
+  ts=$(date -u +%FT%TZ)
+  echo "[$ts] probe start" >>"$LOG"
+  if timeout "$PROBE_TIMEOUT" python -c "
+import jax
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+import jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+print('probe ok:', (x @ x).sum(), d)
+" >>"$LOG" 2>&1; then
+    ts=$(date -u +%FT%TZ)
+    echo "[$ts] PROBE GREEN - running bench" >>"$LOG"
+    timeout 2100 python bench.py >/tmp/bench_r3.json 2>>"$LOG"
+    cat /tmp/bench_r3.json >>"$LOG"
+    val=$(python -c "
+import json
+try:
+    print(json.load(open('/tmp/bench_r3.json'))['value'])
+except Exception:
+    print(0)
+")
+    if python -c "import sys; sys.exit(0 if float('${val:-0}') > 0 else 1)"; then
+      ts=$(date -u +%FT%TZ)
+      echo "[$ts] BENCH NONZERO ($val tok/s) - running tune sweep" >>"$LOG"
+      timeout 3600 python scripts/tpu_tune.py --quick --out /tmp/tpu_tune_r3.json \
+        >>"$LOG" 2>&1
+      echo "[$ts] watcher done" >>"$LOG"
+      exit 0
+    fi
+    echo "[$ts] bench returned zero; cooling down" >>"$LOG"
+  fi
+  sleep "$COOLDOWN"
+done

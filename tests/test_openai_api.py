@@ -3,6 +3,7 @@ endpoint the reference points OpenAI-style clients at
 (src/shared/local-model.ts:3-5, agent-executor.ts:327-338)."""
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -81,6 +82,43 @@ def test_v1_chat_completion(server):
     u = out["usage"]
     assert u["prompt_tokens"] > 0 and 1 <= u["completion_tokens"] <= 6
     assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+
+
+def test_v1_penalties_accepted_and_validated(server):
+    body = {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "no repeats"}],
+        "max_tokens": 5, "temperature": 0,
+        "presence_penalty": 1.5, "frequency_penalty": 0.5,
+    }
+    status, out = call(server, "POST", "/v1/chat/completions", body)
+    assert status == 200, out
+    assert out["choices"][0]["finish_reason"] in ("stop", "length")
+
+    status, out = call(server, "POST", "/v1/chat/completions",
+                       {**body, "presence_penalty": 2.5})
+    assert status == 400
+    assert "presence_penalty" in out["error"]["message"]
+    status, out = call(server, "POST", "/v1/chat/completions",
+                       {**body, "frequency_penalty": -3})
+    assert status == 400
+    assert "frequency_penalty" in out["error"]["message"]
+
+
+def test_v1_stop_sequence_caps(server):
+    body = {
+        "model": "tpu:tiny-moe",
+        "messages": [{"role": "user", "content": "x"}],
+        "max_tokens": 3, "temperature": 0,
+    }
+    status, out = call(server, "POST", "/v1/chat/completions",
+                       {**body, "stop": ["a", "b", "c", "d", "e"]})
+    assert status == 400
+    assert "4 stop sequences" in out["error"]["message"]
+    status, out = call(server, "POST", "/v1/chat/completions",
+                       {**body, "stop": "q" * 65})
+    assert status == 400
+    assert "64 bytes" in out["error"]["message"]
 
 
 def test_v1_chat_unknown_model_openai_error_shape(server):
@@ -291,6 +329,11 @@ def test_v1_sessions_released_after_turn(server):
         })
         assert status == 200
     eng = get_model_host("tiny-moe")._engine
+    # releases apply on the engine thread (concurrency contract): give
+    # its loop a moment to drain the release queue
+    deadline = time.time() + 10
+    while time.time() < deadline and eng.sessions:
+        time.sleep(0.02)
     assert len(eng.sessions) == 0
 
 

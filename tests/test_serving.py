@@ -57,6 +57,113 @@ def test_paged_matches_dense_cache():
     )
 
 
+def test_bounded_gather_matches_full_capacity():
+    """active_pages bounds the XLA gather to the batch's reach; results
+    must be identical to the full-capacity gather (VERDICT r2 #2:
+    prefill cost scales with session length, not table capacity)."""
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    b, s, page_size = 2, 6, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                cfg.vocab_size)
+    # wide table (16 slots) but sequences only ever reach 2 pages + the
+    # chunk: active_pages=4 must cover prefix+chunk exactly
+    tables = jnp.zeros((b, 16), jnp.int32)
+    tables = tables.at[0, :4].set(jnp.array([1, 2, 5, 6]))
+    tables = tables.at[1, :4].set(jnp.array([3, 4, 7, 8]))
+
+    def run(active_pages):
+        cache = init_page_cache(cfg, n_pages=16, page_size=page_size)
+        lengths = jnp.zeros((b,), jnp.int32)
+        hook = make_paged_kv_hook(tables, lengths, page_size)
+        positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        _, cache = qwen3.forward(
+            params, cfg, tokens, positions, cache, kv_hook=hook
+        )
+        # continuation chunk at length s: takes the gather path
+        hook2 = make_paged_kv_hook(
+            tables, jnp.full((b,), s, jnp.int32), page_size,
+            active_pages=active_pages,
+        )
+        cont = jax.random.randint(jax.random.PRNGKey(2), (b, 3), 0,
+                                  cfg.vocab_size)
+        pos2 = s + jnp.broadcast_to(jnp.arange(3)[None], (b, 3))
+        out, _ = qwen3.forward(
+            params, cfg, cont, pos2, cache, kv_hook=hook2
+        )
+        return out
+
+    np.testing.assert_allclose(run(None), run(4), rtol=1e-5, atol=1e-5)
+
+
+def test_pages_bucket_arithmetic():
+    eng = ServingEngine.__new__(ServingEngine)
+    eng.page_size = 32
+    eng.max_pages_per_seq = 64
+    assert eng._pages_bucket(1) == 1
+    assert eng._pages_bucket(32) == 1
+    assert eng._pages_bucket(33) == 2
+    assert eng._pages_bucket(200) == 8       # 7 pages -> pow2
+    # at/beyond capacity: None (no slicing, full table)
+    assert eng._pages_bucket(64 * 32) is None
+    assert eng._pages_bucket(10 ** 6) is None
+
+
+def test_engine_concurrency_stress(engine_setup):
+    """Concurrency contract (VERDICT r2 #4): client threads submitting
+    and releasing against a running serve_forever engine never corrupt
+    page accounting — all mutation lands on the engine thread; releases
+    route through the command queue. Closes to zero leaked pages."""
+    import threading
+
+    cfg, params = engine_setup
+    eng = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                        n_pages=96)
+    stop = threading.Event()
+    loop = threading.Thread(
+        target=eng.serve_forever, args=(stop,), daemon=True
+    )
+    loop.start()
+    errors: list[Exception] = []
+
+    def client(tid: int) -> None:
+        try:
+            rng = np.random.default_rng(tid)
+            for r in range(5):
+                sid = f"stress-{tid}-{r}"
+                toks = rng.integers(
+                    0, cfg.vocab_size, size=5
+                ).tolist()
+                turn = eng.submit(
+                    toks, session_id=sid,
+                    sampling=SamplingParams(
+                        max_new_tokens=3, temperature=0.0
+                    ),
+                )
+                assert turn.done.wait(300), "turn timed out"
+                eng.release_session(sid)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(360)
+        assert not t.is_alive(), "client thread hung"
+    stop.set()
+    loop.join(60)
+    assert not loop.is_alive()
+    assert not errors, errors
+    # every session was released: the pool must close to full (page 0
+    # stays reserved as the engine's scratch page)
+    assert eng.page_table.free_pages == eng.page_table.n_pages - 1
+    assert not eng.sessions
+    assert eng.stats()["turns_completed"] == 30
+
+
 def test_page_table_accounting():
     pt = PageTable(n_pages=8, page_size=4)
     pages = pt.ensure_capacity("a", 10)  # 3 pages
@@ -99,6 +206,54 @@ def test_engine_single_turn_greedy(engine_setup):
     assert 1 <= len(turn.new_tokens) <= 8
     st = eng.stats()
     assert st["turns_completed"] == 1
+
+
+def test_penalties_prevent_repeats(engine_setup):
+    """A huge frequency penalty makes every generated token of a request
+    unique (each sampled token's count immediately knocks it out of the
+    greedy argmax) — proving the count array resets at admission, rides
+    the decode scan, and reaches the logits before sampling."""
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6,
+                        frequency_penalty=1e9)
+    t1 = eng.submit([1, 2, 3], sampling=sp)
+    eng.run_until_idle()
+    body = t1.new_tokens[:-1] if t1.finish_reason == "stop" \
+        else t1.new_tokens
+    assert len(set(body)) == len(body), body
+
+    # second request on a fresh session: counts must reset (its first
+    # token may repeat tokens from request one)
+    t2 = eng.submit([1, 2, 3], sampling=sp)
+    eng.run_until_idle()
+    assert t2.new_tokens[0] == t1.new_tokens[0]
+
+    # unpenalized turns are unaffected by batchmates with penalties
+    eng2 = make_engine(cfg, params)
+    plain_sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    alone = eng2.submit([9, 8, 7], sampling=plain_sp)
+    eng2.run_until_idle()
+    eng3 = make_engine(cfg, params)
+    pair = [eng3.submit([9, 8, 7], sampling=plain_sp),
+            eng3.submit([1, 2, 3], sampling=sp)]
+    eng3.run_until_idle()
+    assert pair[0].new_tokens == alone.new_tokens
+
+
+def test_apply_penalties_math():
+    from room_tpu.serving.sampler import apply_penalties
+
+    logits = jnp.zeros((2, 5), jnp.float32)
+    counts = jnp.array([[0, 1, 3, 0, 0], [0, 0, 0, 0, 0]], jnp.int32)
+    out = apply_penalties(
+        logits, counts,
+        jnp.array([0.5, 0.5]), jnp.array([0.25, 0.25]),
+    )
+    np.testing.assert_allclose(
+        out[0], [0.0, -0.75, -1.25, 0.0, 0.0], atol=1e-6
+    )
+    np.testing.assert_allclose(out[1], np.zeros(5), atol=1e-6)
 
 
 def test_engine_batched_turns_match_sequential(engine_setup):
