@@ -261,6 +261,110 @@ def main() -> None:
         for k in ("spec_rounds", "spec_proposed", "spec_accepted"):
             extra[k] = eng_stats[k]
 
+    # speculative decoding A/B on agent-shaped traffic (VERDICT r2 #8):
+    # tool-call JSON repetition is the motivating case — prompt-lookup
+    # drafting only engages when context repeats, so generic prompts
+    # can't measure it
+    def measure_spec(spec_tokens: int) -> dict:
+        eng = ServingEngine(
+            cfg, params, max_batch=max_batch, page_size=32,
+            n_pages=1024, spec_tokens=spec_tokens,
+        )
+        text = (
+            '{"tool_call": {"name": "web_search", "arguments": '
+            '{"query": "swarm status report"}}}\n'
+        ) * (2 if TINY else 6)
+        ptoks = eng.tokenizer.encode(text)
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=16 if TINY else 96,
+        )
+        warm = [eng.submit(ptoks, sampling=sp) for _ in range(max_batch)]
+        eng.run_until_idle()
+        for t in warm:
+            eng.release_session(t.session_id)
+        start = eng.stats()
+        for _ in range(max_batch):
+            eng.submit(ptoks, sampling=sp)
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        st = eng.stats()
+        decoded = st["tokens_decoded"] - start["tokens_decoded"]
+        out = {"tok_s": round(decoded / dt, 2)}
+        if spec_tokens:
+            proposed = st["spec_proposed"] - start["spec_proposed"]
+            accepted = st["spec_accepted"] - start["spec_accepted"]
+            out["proposed"] = proposed
+            out["acceptance"] = round(accepted / max(proposed, 1), 3)
+        return out
+
+    if os.environ.get("ROOM_TPU_BENCH_SPEC", "1") != "0":
+        spec_ab = {}
+        for gamma in (0, 4):
+            _deadline[0] = time.monotonic() + WATCHDOG_S
+            try:
+                spec_ab["off" if gamma == 0 else f"gamma{gamma}"] = \
+                    measure_spec(gamma)
+            except Exception as e:
+                spec_ab[f"gamma{gamma}"] = f"error: {e}"
+        extra["spec_agent"] = spec_ab
+
+    # queen-turn latency under swarm concurrency (BASELINE: p50 < 4 s
+    # with 32 workers): concurrent queen-shaped turns against ONE
+    # engine; queue wait beyond max_batch counts, as it does live
+    def measure_latency(n_clients: int) -> dict:
+        eng = ServingEngine(
+            cfg, params, max_batch=max_batch, page_size=32,
+            n_pages=1024,
+        )
+        stop = threading.Event()
+        loop = threading.Thread(
+            target=eng.serve_forever, args=(stop,), daemon=True,
+        )
+        loop.start()
+        qprompt = list(range(1, 257))       # queen-cycle-sized context
+        sp = SamplingParams(
+            temperature=temp, top_p=top_p,
+            max_new_tokens=16 if TINY else 64,
+        )
+        warm = eng.submit(qprompt, sampling=sp)
+        warm.done.wait(WATCHDOG_S)
+        eng.release_session(warm.session_id)
+        lats: list[float] = []
+        lock = threading.Lock()
+
+        def client() -> None:
+            t0 = time.perf_counter()
+            turn = eng.submit(qprompt, sampling=sp)
+            turn.done.wait(WATCHDOG_S)
+            with lock:
+                lats.append(time.perf_counter() - t0)
+
+        threads = [
+            threading.Thread(target=client) for _ in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(WATCHDOG_S)
+        stop.set()
+        loop.join(30)
+        lats.sort()
+        return {
+            "p50_s": round(lats[len(lats) // 2], 3),
+            "p90_s": round(lats[int(len(lats) * 0.9)], 3),
+        }
+
+    if os.environ.get("ROOM_TPU_BENCH_LATENCY", "1") != "0":
+        lat = {}
+        for n in ((4,) if TINY else (8, 32)):
+            _deadline[0] = time.monotonic() + WATCHDOG_S
+            try:
+                lat[f"clients{n}"] = measure_latency(n)
+            except Exception as e:
+                lat[f"clients{n}"] = f"error: {e}"
+        extra["queen_turn_latency"] = lat
+
     # decode-attention backend comparison (Pallas paged kernel vs the
     # XLA gather reference) — only meaningful on real TPU hardware
     if platform == "tpu":

@@ -101,8 +101,11 @@ def encode(
             x + attn_out, lp["attn_ln_scale"], lp["attn_ln_bias"],
             cfg.layer_norm_eps,
         )
+        # exact (erf) GELU: BERT/MiniLM checkpoints are trained with it,
+        # and the tanh approximation drifts the converted embeddings
         h = jax.nn.gelu(
-            jnp.einsum("bsd,df->bsf", x, lp["w_in"]) + lp["b_in"]
+            jnp.einsum("bsd,df->bsf", x, lp["w_in"]) + lp["b_in"],
+            approximate=False,
         )
         h = jnp.einsum("bsf,fd->bsd", h, lp["w_out"]) + lp["b_out"]
         x = _layer_norm(

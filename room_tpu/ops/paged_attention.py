@@ -155,6 +155,201 @@ def _decode_kernel(
     o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
 
 
+PREFILL_Q_BLOCK = 8
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    tables_ref,      # [B, max_pages] SMEM
+    lengths_ref,     # [B] SMEM (prefix length BEFORE this chunk)
+    # inputs
+    q_ref,           # [1, QB, Hq, D] VMEM (one query block)
+    k_pages_hbm,     # [P, page*Hkv, D] ANY/HBM (flattened view)
+    v_pages_hbm,     # [P, page*Hkv, D] ANY/HBM
+    # output
+    o_ref,           # [1, QB, Hq, D] VMEM
+    # scratch
+    k_buf,           # [2, page*Hkv, D] VMEM
+    v_buf,           # [2, page*Hkv, D] VMEM
+    acc_ref,         # [QB*Hq, D] f32
+    m_ref,           # [QB*Hq, 1] f32
+    l_ref,           # [QB*Hq, 1] f32
+    sems,            # DMA sems [2, 2]
+    *,
+    page_size: int,
+    n_kv_heads: int,
+    scale: float,
+):
+    """Ragged paged attention over an S>1 query block: chunked prefill
+    on top of an arbitrary-length paged prefix, O(actual context) page
+    traffic per block (VERDICT r2 #2 — replaces the full-capacity XLA
+    gather on TPU). Same Mosaic-shaped design as the decode kernel
+    (flat [page*Hkv, D] tiles, one DMA per page, all head pairs in one
+    MXU matmul, invalid pairs masked before the online softmax) plus a
+    causal mask inside the chunk: query at position len+t sees kv
+    positions <= len+t."""
+    b = pl.program_id(0)
+    qb = pl.program_id(1)
+
+    length = lengths_ref[b]
+    _, qblk, hq, d = q_ref.shape
+    hkv = n_kv_heads
+    group = hq // hkv
+    rows = page_size * hkv
+    qrows = qblk * hq
+
+    # this block's highest query position decides how many pages to walk
+    hi_pos = length + (qb + 1) * qblk - 1
+    n_pages = jax.lax.div(hi_pos, page_size) + 1
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def start_fetch(i, slot):
+        page_id = tables_ref[b, i]
+        pltpu.make_async_copy(
+            k_pages_hbm.at[page_id], k_buf.at[slot], sems.at[slot, 0]
+        ).start()
+        pltpu.make_async_copy(
+            v_pages_hbm.at[page_id], v_buf.at[slot], sems.at[slot, 1]
+        ).start()
+
+    def wait_fetch(i, slot):
+        page_id = tables_ref[b, i]
+        pltpu.make_async_copy(
+            k_pages_hbm.at[page_id], k_buf.at[slot], sems.at[slot, 0]
+        ).wait()
+        pltpu.make_async_copy(
+            v_pages_hbm.at[page_id], v_buf.at[slot], sems.at[slot, 1]
+        ).wait()
+
+    start_fetch(0, 0)
+
+    q = q_ref[0].astype(jnp.float32).reshape(qrows, d) * scale
+
+    # row r = (token t within block) * Hq + head h; col j of a flat page
+    # = (token within page) * Hkv + kv head
+    j = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 1)
+    r = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 0)
+    pair_ok = jax.lax.rem(j, hkv) == jax.lax.div(
+        jax.lax.rem(r, hq), group
+    )
+    tok_of_j = jax.lax.div(j, hkv)
+    q_pos = length + qb * qblk + jax.lax.div(r, hq)
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            start_fetch(i + 1, 1 - slot)
+
+        wait_fetch(i, slot)
+        k = k_buf[slot].astype(jnp.float32)           # [rows, D]
+        v = v_buf[slot].astype(jnp.float32)
+
+        logits = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [qrows, rows]
+        kv_pos = i * page_size + tok_of_j
+        valid = pair_ok & (kv_pos <= q_pos)
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(logits, axis=1, keepdims=True)
+        )
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    denom = jnp.maximum(l_ref[:], 1e-30)
+    o_ref[0] = (acc_ref[:] / denom).reshape(qblk, hq, d).astype(
+        o_ref.dtype
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "interpret")
+)
+def paged_attention_prefill(
+    q: jax.Array,          # [B, S, Hq, D] chunk queries
+    k_pages: jax.Array,    # [P, page, Hkv, D]
+    v_pages: jax.Array,    # [P, page, Hkv, D]
+    tables: jax.Array,     # [B, max_pages] int32
+    lengths: jax.Array,    # [B] int32 prefix length BEFORE the chunk
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """S>1 companion to paged_attention_decode: the chunk's KV must
+    already be written into the pages (the kv_hook does this first).
+    Requires S % PREFILL_Q_BLOCK == 0 (engine chunk widths are powers
+    of two >= 16; callers fall back to the XLA gather otherwise)."""
+    b, s, hq, d = q.shape
+    p_count, _, hkv, _ = k_pages.shape
+    scale = 1.0 / float(np.sqrt(d))
+    rows = page_size * hkv
+    qblk = PREFILL_Q_BLOCK
+    if s % qblk != 0:
+        raise ValueError(f"S={s} not divisible by {qblk}")
+
+    k_flat = k_pages.reshape(p_count, rows, d)
+    v_flat = v_pages.reshape(p_count, rows, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, s // qblk),
+        in_specs=[
+            pl.BlockSpec(
+                (1, qblk, hq, d), lambda i, j, *_: (i, j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, qblk, hq, d), lambda i, j, *_: (i, j, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, d), k_pages.dtype),
+            pltpu.VMEM((2, rows, d), v_pages.dtype),
+            pltpu.VMEM((qblk * hq, d), jnp.float32),
+            pltpu.VMEM((qblk * hq, 1), jnp.float32),
+            pltpu.VMEM((qblk * hq, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    kernel = functools.partial(
+        _prefill_kernel,
+        page_size=page_size,
+        n_kv_heads=hkv,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, q, k_flat, v_flat)
+
+
 @functools.partial(
     jax.jit, static_argnames=("page_size", "interpret")
 )

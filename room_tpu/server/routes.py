@@ -1062,6 +1062,26 @@ def register_aux_routes(r: Router) -> None:
             ctx.db, (ctx.body or {}).get("model", "qwen3-coder-30b")
         ))
 
+    def tpu_plan(ctx):
+        """Hetero capacity planner: will these model placements fit
+        their submeshes (weights at the chosen quant + KV pool +
+        workspace vs HBM)? Suggests int8 / more chips when not."""
+        from .tpu_manager import plan_mesh
+
+        b = ctx.body or {}
+        placements = b.get("placements")
+        if not isinstance(placements, list) or not placements:
+            return err("placements (a non-empty list) is required")
+        try:
+            plan = plan_mesh(
+                placements,
+                int(b.get("totalChips", 8)),
+                b.get("hbmPerChipGb"),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            return err(f"bad placement: {e}")
+        return ok(plan)
+
     def public_feed(ctx):
         return ok(activity_mod.get_public_feed(ctx.db))
 
@@ -1127,6 +1147,7 @@ def register_aux_routes(r: Router) -> None:
     r.post("/api/tpu/provision", tpu_provision)
     r.get("/api/tpu/provision/:sid", tpu_session)
     r.post("/api/tpu/apply", tpu_apply)
+    r.post("/api/tpu/plan", tpu_plan)
     r.get("/api/feed", public_feed)
     r.post("/api/invites", create_invite)
 

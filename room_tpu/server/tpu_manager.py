@@ -35,7 +35,7 @@ def _bytes_per_param(dtype: str) -> int:
     return 2 if dtype == "bfloat16" else 4
 
 
-def model_weight_bytes(name: str) -> int:
+def model_param_count(name: str) -> int:
     cfg = MODEL_CONFIGS[name]()
     D, L = cfg.hidden, cfg.n_layers
     attn = D * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * D
@@ -44,7 +44,110 @@ def model_weight_bytes(name: str) -> int:
     else:
         ffn = 3 * D * cfg.intermediate
     embed = cfg.vocab_size * D * 2  # embed + lm head
-    return (L * (attn + ffn) + embed) * _bytes_per_param(cfg.dtype)
+    return L * (attn + ffn) + embed
+
+
+def model_weight_bytes(name: str) -> int:
+    cfg = MODEL_CONFIGS[name]()
+    return model_param_count(name) * _bytes_per_param(cfg.dtype)
+
+
+# ---- hetero capacity planner (VERDICT r2 #6) ----
+# v5e: 16 GB HBM per chip. Overheads are explicit so the arithmetic is
+# testable: the XLA allocator keeps a slice of HBM for itself, and
+# decode/prefill activations + collective scratch need a workspace.
+V5E_HBM_PER_CHIP_GB = 16.0
+HBM_USABLE_FRACTION = 0.92
+WORKSPACE_FRACTION = 0.08
+# int8 weights carry one f32 scale per output channel (ops/quant.py):
+# ~1/16 overhead at the 128-wide granularity the quantizer uses
+QUANT_BYTES_PER_PARAM = {"bf16": 2.0, "int8": 1.0625}
+KV_DTYPE_BYTES = 2  # pages are bf16
+
+
+def model_kv_bytes_per_token(name: str) -> int:
+    cfg = MODEL_CONFIGS[name]()
+    return cfg.n_layers * 2 * cfg.kv_dim * KV_DTYPE_BYTES
+
+
+def plan_placement(
+    model: str,
+    chips: int,
+    quant: str = "bf16",
+    kv_tokens: int = 131_072,
+    hbm_per_chip_gb: Optional[float] = None,
+) -> dict:
+    """Does ``model`` at ``quant`` fit a ``chips``-device submesh with a
+    ``kv_tokens`` page pool?  Returns the arithmetic and, when it does
+    not fit, what would: the int8 ladder first (ops/quant.py serves it),
+    then the minimum chip count at the requested quant.
+
+    The motivating case (BASELINE config #5): qwen2.5-72b bf16 is
+    ~145 GB of weights — more than a whole v5e-8 — so a planner must
+    force int8 (or more chips) rather than let provisioning OOM."""
+    if quant not in QUANT_BYTES_PER_PARAM:
+        raise ValueError(f"unknown quant {quant!r}")
+    if model not in MODEL_CONFIGS:
+        raise ValueError(f"unknown model {model!r}")
+    hbm = (hbm_per_chip_gb or V5E_HBM_PER_CHIP_GB) * 1e9
+    usable = chips * hbm * HBM_USABLE_FRACTION
+    weights = model_param_count(model) * QUANT_BYTES_PER_PARAM[quant]
+    kv = kv_tokens * model_kv_bytes_per_token(model)
+    workspace = usable * WORKSPACE_FRACTION
+    need = weights + kv + workspace
+    fits = need <= usable
+
+    suggestion = None
+    if not fits:
+        if quant == "bf16":
+            int8_plan = plan_placement(
+                model, chips, "int8", kv_tokens, hbm_per_chip_gb
+            )
+            if int8_plan["fits"]:
+                suggestion = "int8"
+        if suggestion is None:
+            # minimum chips at this quant (workspace scales with chips)
+            per_chip_usable = hbm * HBM_USABLE_FRACTION
+            denom = per_chip_usable * (1 - WORKSPACE_FRACTION)
+            min_chips = max(1, -(-int(weights + kv) // int(denom)))
+            suggestion = f"chips>={min_chips}"
+    return {
+        "model": model,
+        "chips": chips,
+        "quant": quant,
+        "kv_tokens": kv_tokens,
+        "weight_gb": round(weights / 1e9, 2),
+        "kv_gb": round(kv / 1e9, 2),
+        "workspace_gb": round(workspace / 1e9, 2),
+        "usable_hbm_gb": round(usable / 1e9, 2),
+        "fits": fits,
+        "suggestion": suggestion,
+    }
+
+
+def plan_mesh(
+    placements: list[dict],
+    total_chips: int,
+    hbm_per_chip_gb: Optional[float] = None,
+) -> dict:
+    """Plan a hetero mesh (e.g. 72b queen + 30b workers on disjoint
+    submeshes): every placement must fit its submesh AND the submeshes
+    must fit the pod."""
+    plans = [
+        plan_placement(
+            p["model"], int(p["chips"]), p.get("quant", "bf16"),
+            int(p.get("kv_tokens", 131_072)), hbm_per_chip_gb,
+        )
+        for p in placements
+    ]
+    chips_used = sum(p["chips"] for p in plans)
+    return {
+        "placements": plans,
+        "chips_used": chips_used,
+        "total_chips": total_chips,
+        "ok": chips_used <= total_chips
+        and all(p["fits"] for p in plans),
+    }
 
 
 def get_tpu_status(model: str = "qwen3-coder-30b") -> dict:
@@ -69,14 +172,19 @@ def get_tpu_status(model: str = "qwen3-coder-30b") -> dict:
             hbm_bytes = stats.get("bytes_limit", 0)
         except Exception:
             pass
-        need = model_weight_bytes(model)
         if hbm_bytes:
-            total = hbm_bytes * n_devices
-            check(
-                "hbm", need * 1.3 < total,
-                f"model needs ~{need/1e9:.1f} GB, mesh has "
-                f"{total/1e9:.1f} GB",
+            plan = plan_placement(
+                model, n_devices,
+                hbm_per_chip_gb=hbm_bytes / 1e9,
             )
+            detail = (
+                f"weights {plan['weight_gb']} GB + kv {plan['kv_gb']} "
+                f"GB + workspace {plan['workspace_gb']} GB vs usable "
+                f"{plan['usable_hbm_gb']} GB"
+            )
+            if plan["suggestion"]:
+                detail += f" — try {plan['suggestion']}"
+            check("hbm", plan["fits"], detail)
         else:
             check("hbm", True, "memory stats unavailable; unchecked")
     except Exception as e:

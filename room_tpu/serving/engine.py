@@ -892,14 +892,18 @@ class ServingEngine:
         for chunk_toks in pre_chunks:
             self._prefill_write_chunk(sess, chunk_toks, table)
         fresh = sess.length == 0
+        # continuation prefill gathers only the pages this turn can
+        # reach (bucketed), not the table's full capacity; with the
+        # Pallas prefill kernel (S % q-block == 0) there is no gather
+        # at all, so no bound to key compiles on
+        active_pages = None
+        if not fresh and not (use_pallas_kernel() and bucket % 8 == 0):
+            active_pages = self._pages_bucket(sess.length + bucket)
         return {
             "turn": turn, "sess": sess, "prompt": tail,
             "bucket": bucket, "fresh": fresh,
             "table": table, "base_length": sess.length,
-            # continuation prefill gathers only the pages this turn can
-            # reach (bucketed), not the table's full capacity
-            "active_pages": None if fresh else
-            self._pages_bucket(sess.length + bucket),
+            "active_pages": active_pages,
         }
 
     def _prefill_write_chunk(
@@ -909,8 +913,9 @@ class ServingEngine:
         sampling)."""
         width = len(toks)
         fresh = sess.length == 0
-        active = None if fresh else \
-            self._pages_bucket(sess.length + width)
+        active = None
+        if not fresh and not (use_pallas_kernel() and width % 8 == 0):
+            active = self._pages_bucket(sess.length + width)
         key = ("prefill_write", width, fresh, active)
         if key not in self._jit_cache:
             cfg = self.cfg
@@ -1247,12 +1252,15 @@ class ServingEngine:
             top_ps[i] = sp.top_p
             top_ks[i] = sp.top_k
 
-        # the verify forward is S>1 and always takes the gather path:
-        # bound it to the batch's reach
-        max_len = max(int(self._slot_lengths[i]) for i in active_idx)
-        spec = self._spec_fn(
-            width, self._pages_bucket(max_len + width)
-        )
+        # the S>1 verify forward gathers unless the Pallas prefill
+        # kernel covers its width: bound the gather to the batch's reach
+        ap = None
+        if not (use_pallas_kernel() and width % 8 == 0):
+            max_len = max(
+                int(self._slot_lengths[i]) for i in active_idx
+            )
+            ap = self._pages_bucket(max_len + width)
+        spec = self._spec_fn(width, ap)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode_spec"):
             accept_d, residual_d, plain_d, self.cache = spec(

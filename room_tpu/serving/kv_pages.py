@@ -123,6 +123,21 @@ def make_paged_kv_hook(
             )[:, None]
             return attn, {"k_pages": kp, "v_pages": vp}
 
+        if s > 1 and pallas_decode:
+            from ..ops.paged_attention import (
+                PREFILL_Q_BLOCK, paged_attention_prefill,
+            )
+
+            if s % PREFILL_Q_BLOCK == 0:
+                # ragged chunked-prefill kernel: walks each row's own
+                # pages (prefix + the chunk KV written above) — page
+                # traffic scales with actual context, never capacity
+                attn = paged_attention_prefill(
+                    q, kp, vp, block_tables, lengths,
+                    page_size=page_size,
+                )
+                return attn, {"k_pages": kp, "v_pages": vp}
+
         # gather this batch's pages into a dense view (XLA reference path;
         # the Pallas kernel replaces this gather), bounded to the pages
         # the batch can actually reach when the caller promised a limit

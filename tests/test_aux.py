@@ -175,6 +175,61 @@ def test_tpu_status_gate(monkeypatch):
     assert 40 < gb < 80
 
 
+def test_capacity_planner_refuses_72b_bf16(monkeypatch):
+    """BASELINE config #5 arithmetic (VERDICT r2 #6): qwen2.5-72b bf16
+    is ~145 GB of weights — it must NEVER be placed on 4 (or even 8)
+    v5e chips at bf16; int8 fits a v5e-8 submesh."""
+    from room_tpu.server.tpu_manager import plan_mesh, plan_placement
+
+    p4 = plan_placement("qwen2.5-72b", 4, "bf16", hbm_per_chip_gb=16.0)
+    assert not p4["fits"]
+    assert p4["weight_gb"] > 120
+    # int8 doesn't rescue 4 chips either: the suggestion is more chips
+    assert p4["suggestion"].startswith("chips>=")
+
+    p8 = plan_placement(
+        "qwen2.5-72b", 8, "bf16",
+        kv_tokens=65_536, hbm_per_chip_gb=16.0,
+    )
+    assert not p8["fits"]
+    assert p8["suggestion"] == "int8"
+    assert plan_placement(
+        "qwen2.5-72b", 8, "int8",
+        kv_tokens=65_536, hbm_per_chip_gb=16.0,
+    )["fits"]
+
+    # the 30B worker at bf16 fits 8 chips with a large page pool
+    assert plan_placement(
+        "qwen3-coder-30b", 8, "bf16", hbm_per_chip_gb=16.0
+    )["fits"]
+
+    # hetero pod: 72b-int8 queen + 30b-bf16 workers on disjoint
+    # submeshes of a 16-chip pod
+    mesh = plan_mesh(
+        [
+            {"model": "qwen2.5-72b", "chips": 8, "quant": "int8",
+             "kv_tokens": 65_536},
+            {"model": "qwen3-coder-30b", "chips": 8},
+        ],
+        total_chips=16, hbm_per_chip_gb=16.0,
+    )
+    assert mesh["ok"] and mesh["chips_used"] == 16
+    # same placements on one v5e-8: refused (submeshes exceed the pod)
+    assert not plan_mesh(
+        [
+            {"model": "qwen2.5-72b", "chips": 8, "quant": "int8",
+             "kv_tokens": 65_536},
+            {"model": "qwen3-coder-30b", "chips": 8},
+        ],
+        total_chips=8, hbm_per_chip_gb=16.0,
+    )["ok"]
+
+    with pytest.raises(ValueError):
+        plan_placement("qwen2.5-72b", 8, "fp4")
+    with pytest.raises(ValueError):
+        plan_placement("nonexistent-model", 8)
+
+
 def test_apply_tpu_model_to_all(db):
     r1 = rooms.create_room(db, "a", create_wallet=False)
     r2 = rooms.create_room(db, "b", create_wallet=False)

@@ -79,6 +79,10 @@ BODIES = {
     ("POST", "/api/contacts/email/verify"): {"code": "123456"},
     ("POST", "/api/tpu/provision"): {"model": "tiny-moe"},
     ("POST", "/api/tpu/apply"): {"model": "tiny-moe"},
+    ("POST", "/api/tpu/plan"): {
+        "placements": [{"model": "qwen3-coder-30b", "chips": 8}],
+        "totalChips": 8, "hbmPerChipGb": 16.0,
+    },
     ("POST", "/api/self-mod/:id/revert"): {},
     ("POST", "/api/update/check"): {},
     ("POST", "/api/goals/:id/updates"): {"update": "making progress"},

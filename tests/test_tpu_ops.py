@@ -60,6 +60,115 @@ def test_pallas_paged_decode_page_boundaries():
     _pallas_case([1, 9, 17])      # one past each boundary
 
 
+def _pallas_prefill_case(prefix_list, S=16, B=3, Hq=8, Hkv=2, D=32,
+                         page=8, P=32, maxp=8, seed=0):
+    """Chunk of S queries on top of per-row paged prefixes: kernel vs
+    dense reference with a causal-within-chunk mask."""
+    from room_tpu.ops.paged_attention import paged_attention_prefill
+
+    rng = np.random.default_rng(seed)
+    q = jnp.array(rng.standard_normal((B, S, Hq, D)), jnp.float32)
+    k_pages = jnp.array(rng.standard_normal((P, page, Hkv, D)),
+                        jnp.float32)
+    v_pages = jnp.array(rng.standard_normal((P, page, Hkv, D)),
+                        jnp.float32)
+    tables = jnp.array(
+        [[(b * maxp + i) % (P - 1) + 1 for i in range(maxp)]
+         for b in range(B)],
+        jnp.int32,
+    )
+    lengths = jnp.array(prefix_list, jnp.int32)
+    got = paged_attention_prefill(
+        q, k_pages, v_pages, tables, lengths, page_size=page,
+        interpret=True,
+    )
+    kv_len = maxp * page
+    k_all = k_pages[tables].reshape(B, kv_len, Hkv, D)
+    v_all = v_pages[tables].reshape(B, kv_len, Hkv, D)
+    kv_pos = jnp.broadcast_to(jnp.arange(kv_len)[None], (B, kv_len))
+    q_pos = lengths[:, None] + jnp.arange(S)[None]
+    want = attention_ref(
+        q, k_all, v_all, causal=True,
+        q_positions=q_pos, kv_positions=kv_pos,
+        kv_mask=kv_pos < (lengths + S)[:, None],
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_paged_prefill_matches_reference():
+    _pallas_prefill_case([20, 11, 0])       # incl. a fresh row
+
+
+def test_pallas_paged_prefill_page_boundaries():
+    _pallas_prefill_case([8, 16, 32])       # exact page multiples
+    _pallas_prefill_case([1, 9, 17])        # one past each boundary
+
+
+def test_pallas_paged_prefill_gqa_30b_shape():
+    # the qwen3-coder 32/4-head 128-dim shape, S=32 chunk
+    _pallas_prefill_case([5, 40, 64], S=32, B=3, Hq=32, Hkv=4, D=128,
+                         page=32, P=16, maxp=8)
+
+
+def test_pallas_prefill_rejects_ragged_block():
+    from room_tpu.ops.paged_attention import paged_attention_prefill
+
+    q = jnp.zeros((1, 5, 8, 32), jnp.float32)   # S=5 not / 8
+    kp = jnp.zeros((4, 8, 2, 32), jnp.float32)
+    with pytest.raises(ValueError):
+        paged_attention_prefill(
+            q, kp, kp, jnp.zeros((1, 4), jnp.int32),
+            jnp.zeros((1,), jnp.int32), page_size=8, interpret=True,
+        )
+
+
+def test_pallas_prefill_in_engine_hook():
+    """The S>1 hook path with the prefill kernel must equal the XLA
+    gather path (a continuation chunk on a non-empty session)."""
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    b, s, page = 2, 8, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                cfg.vocab_size)
+    tables = jnp.array([[1, 2, 5, 6, 0], [3, 4, 7, 8, 0]], jnp.int32)
+
+    def run(pallas):
+        cache = init_page_cache(cfg, 16, page)
+        hook = make_paged_kv_hook(
+            tables, jnp.zeros((b,), jnp.int32), page,
+            pallas_decode=False,
+        )
+        pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        _, cache = qwen3.forward(params, cfg, tokens, pos, cache,
+                                 kv_hook=hook)
+        # continuation chunk of 8 at length s (s>1 → prefill kernel)
+        hook2 = make_paged_kv_hook(
+            tables, jnp.full((b,), s, jnp.int32), page,
+            pallas_decode=pallas,
+        )
+        cont = jax.random.randint(jax.random.PRNGKey(2), (b, 8), 0,
+                                  cfg.vocab_size)
+        logits, _ = qwen3.forward(
+            params, cfg, cont,
+            s + jnp.broadcast_to(jnp.arange(8)[None], (b, 8)),
+            cache, kv_hook=hook2,
+        )
+        return logits
+
+    import functools
+
+    import room_tpu.ops.paged_attention as pa
+
+    orig = pa.paged_attention_prefill
+    pa.paged_attention_prefill = functools.partial(orig, interpret=True)
+    try:
+        got = run(pallas=True)
+    finally:
+        pa.paged_attention_prefill = orig
+    want = run(pallas=False)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
 def test_pallas_kernel_in_engine_hook():
     """The engine hook with pallas_decode=True must equal the XLA path."""
     cfg = tiny_moe()

@@ -1,0 +1,182 @@
+"""Upstream-architecture fidelity: REAL transformers checkpoints (tiny,
+generated in-test on CPU torch) → ``save_pretrained`` safetensors →
+``utils/convert.py`` → forward logits and *served* greedy tokens
+cross-checked against the transformers reference implementation.
+
+This proves convert→serve fidelity on upstream tensor names/layouts and
+upstream *math* (rope, GQA, qk-norm, MoE routing, BERT pooling), not
+just against the in-house init tree (VERDICT r2 #3; the reference pins
+exact model behavior: src/shared/local-model.ts:3-5)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3
+from room_tpu.models.config import DecoderConfig, EncoderConfig
+from room_tpu.serving import SamplingParams, ServingEngine
+from room_tpu.utils.convert import convert_hf_decoder, convert_hf_encoder
+
+torch = pytest.importorskip("torch")
+
+
+def _f32_tree(params):
+    return jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+
+
+def _served_greedy(cfg, params, prompt, n_new, eos_id):
+    eng = ServingEngine(
+        cfg, _f32_tree(params), max_batch=2, page_size=8, n_pages=32,
+        stop_token_ids=[eos_id],
+    )
+    turn = eng.submit(
+        list(prompt),
+        sampling=SamplingParams(temperature=0.0, max_new_tokens=n_new),
+    )
+    eng.run_until_idle()
+    return turn.new_tokens
+
+
+def test_qwen2_checkpoint_logits_and_served_tokens(tmp_path):
+    """Qwen2 architecture (the qwen2.5-72b queen family: GQA + qkv bias,
+    no qk-norm)."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_cfg = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, eos_token_id=127, bos_token_id=126,
+    )
+    torch.manual_seed(0)
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(tmp_path))
+
+    has_bias = model.model.layers[0].self_attn.q_proj.bias is not None
+    cfg = DecoderConfig(
+        name="hf-qwen2-tiny", vocab_size=128, hidden=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, intermediate=96,
+        rope_theta=10000.0, rms_eps=1e-6, qkv_bias=has_bias,
+        qk_norm=False, dtype="float32", max_seq_len=256,
+    )
+    params = convert_hf_decoder(str(tmp_path), cfg, dtype="float32")
+
+    prompt = [3, 17, 42, 9, 88, 5]
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        want = model(ids).logits.numpy()[0]
+    got, _ = qwen3.forward(
+        _f32_tree(params), cfg, np.asarray([prompt], np.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[0], want, rtol=2e-3, atol=2e-3
+    )
+
+    with torch.no_grad():
+        hf_out = model.generate(
+            ids, max_new_tokens=8, do_sample=False,
+            eos_token_id=127, pad_token_id=0,
+        )[0].tolist()[len(prompt):]
+    served = _served_greedy(cfg, params, prompt, 8, eos_id=127)
+    assert served[: len(hf_out)] == hf_out
+
+
+def test_qwen3moe_checkpoint_logits_and_served_tokens(tmp_path):
+    """Qwen3-MoE architecture — the qwen3-coder-30b flagship family:
+    GQA + per-head qk RMSNorm + softmax-topk expert routing."""
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    hf_cfg = Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=32, num_experts=8, num_experts_per_tok=2,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        norm_topk_prob=True, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        eos_token_id=127, bos_token_id=126,
+        router_aux_loss_coef=0.0,
+    )
+    torch.manual_seed(1)
+    model = Qwen3MoeForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(tmp_path))
+
+    cfg = DecoderConfig(
+        name="hf-qwen3moe-tiny", vocab_size=128, hidden=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, head_dim=16, intermediate=0,
+        rope_theta=10000.0, rms_eps=1e-6, qkv_bias=False, qk_norm=True,
+        n_experts=8, top_k=2, moe_intermediate=32, norm_topk_prob=True,
+        dtype="float32", max_seq_len=256,
+    )
+    params = convert_hf_decoder(str(tmp_path), cfg, dtype="float32")
+
+    prompt = [11, 4, 99, 23, 56]
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        want = model(ids).logits.numpy()[0]
+    got, _ = qwen3.forward(
+        _f32_tree(params), cfg, np.asarray([prompt], np.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got)[0], want, rtol=2e-3, atol=2e-3
+    )
+
+    with torch.no_grad():
+        hf_out = model.generate(
+            ids, max_new_tokens=8, do_sample=False,
+            eos_token_id=127, pad_token_id=0,
+        )[0].tolist()[len(prompt):]
+    served = _served_greedy(cfg, params, prompt, 8, eos_id=127)
+    assert served[: len(hf_out)] == hf_out
+
+
+def test_bert_checkpoint_embeddings_match_transformers(tmp_path):
+    """BERT/MiniLM encoder family (the 384-d memory embedder): converted
+    weights must reproduce transformers' mean-pooled, L2-normalized
+    sentence vectors (the all-MiniLM-L6-v2 recipe the reference ran via
+    ONNX; src/shared/embeddings.ts:33-100)."""
+    from transformers import BertConfig, BertModel
+
+    from room_tpu.models import embedder
+
+    hf_cfg = BertConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_act="gelu", layer_norm_eps=1e-12,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(2)
+    model = BertModel(hf_cfg).eval()
+    model.save_pretrained(str(tmp_path))
+
+    cfg = EncoderConfig(
+        name="hf-bert-tiny", vocab_size=100, hidden=32, n_layers=2,
+        n_heads=4, intermediate=64, max_positions=64,
+        layer_norm_eps=1e-12,
+    )
+    params = convert_hf_encoder(str(tmp_path), cfg)
+
+    tokens = np.array([[5, 6, 7, 8, 9], [11, 12, 13, 0, 0]], np.int32)
+    mask = np.array(
+        [[1, 1, 1, 1, 1], [1, 1, 1, 0, 0]], np.float32
+    )
+    with torch.no_grad():
+        hidden = model(
+            input_ids=torch.tensor(tokens, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+    m = mask[:, :, None]
+    pooled = (hidden * m).sum(1) / np.maximum(m.sum(1), 1e-9)
+    want = pooled / np.maximum(
+        np.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )
+
+    cfg32 = dataclasses.replace(cfg, dtype="float32")
+    got = embedder.encode(params, cfg32, tokens, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=2e-4, atol=2e-4
+    )
