@@ -252,6 +252,8 @@ def main() -> None:
         extra["kernel"] = "xla-fallback"
     if quant:
         extra["quant"] = quant
+    if os.environ.get("ROOM_TPU_KV_QUANT"):
+        extra["kv_quant"] = os.environ["ROOM_TPU_KV_QUANT"]
     spec_env = os.environ.get("ROOM_TPU_SPEC_TOKENS")
     if spec_env and spec_env != "0":
         # speculation engages only when contexts repeat (prompt-lookup
@@ -309,6 +311,48 @@ def main() -> None:
                 spec_ab[f"gamma{gamma}"] = f"error: {e}"
         extra["spec_agent"] = spec_ab
 
+    # long-context chunked prefill (VERDICT r2 #2's phase row): fresh
+    # prefill of a long prompt, then a session continuation on top of
+    # it — the continuation is the path whose page traffic must scale
+    # with actual context (Pallas ragged prefill / bounded gather),
+    # never the table's 32k capacity. ROOM_TPU_BENCH_CTX=32768 on
+    # hardware with headroom.
+    def measure_prefill(ctx: int) -> dict:
+        n_pages = max(1024, (ctx + 4096) // 32 + 32)
+        eng = ServingEngine(
+            cfg, params, max_batch=2, page_size=32, n_pages=n_pages,
+        )
+        long_prompt = [1 + (i % 1000) for i in range(ctx)]
+        one = SamplingParams(temperature=0.0, max_new_tokens=1)
+        t0 = time.perf_counter()
+        eng.submit(long_prompt, session_id="ctx", sampling=one)
+        eng.run_until_idle()
+        fresh_s = time.perf_counter() - t0
+        # continuation: sessions take DELTA submission (the resumed
+        # turn prefills only the new tokens on top of parked KV)
+        t0 = time.perf_counter()
+        eng.submit([2] * 256, session_id="ctx", sampling=one)
+        eng.run_until_idle()
+        cont_s = time.perf_counter() - t0
+        return {
+            "fresh_prefill_s": round(fresh_s, 3),
+            "fresh_tok_per_s": round(ctx / fresh_s, 1),
+            "continuation_256_s": round(cont_s, 3),
+        }
+
+    if os.environ.get("ROOM_TPU_BENCH_PREFILL", "1") != "0":
+        ctxs = os.environ.get(
+            "ROOM_TPU_BENCH_CTX", "512" if TINY else "4096,16384"
+        )
+        pf = {}
+        for ctx in (int(x) for x in ctxs.split(",") if x.strip()):
+            _deadline[0] = time.monotonic() + WATCHDOG_S
+            try:
+                pf[f"ctx{ctx}"] = measure_prefill(ctx)
+            except Exception as e:
+                pf[f"ctx{ctx}"] = f"error: {e}"
+        extra["long_context_prefill"] = pf
+
     # queen-turn latency under swarm concurrency (BASELINE: p50 < 4 s
     # with 32 workers): concurrent queen-shaped turns against ONE
     # engine; queue wait beyond max_batch counts, as it does live
@@ -331,14 +375,25 @@ def main() -> None:
         warm.done.wait(WATCHDOG_S)
         eng.release_session(warm.session_id)
         lats: list[float] = []
+        timeouts = [0]
         lock = threading.Lock()
 
         def client() -> None:
             t0 = time.perf_counter()
             turn = eng.submit(qprompt, sampling=sp)
-            turn.done.wait(WATCHDOG_S)
+            done = turn.done.wait(WATCHDOG_S)
+            # timed-out turns must not blend the watchdog ceiling into
+            # p50/p90, and their sessions must not leak for the rest of
+            # the measurement
+            try:
+                eng.release_session(turn.session_id)
+            except Exception:
+                pass
             with lock:
-                lats.append(time.perf_counter() - t0)
+                if done:
+                    lats.append(time.perf_counter() - t0)
+                else:
+                    timeouts[0] += 1
 
         threads = [
             threading.Thread(target=client) for _ in range(n_clients)
@@ -350,10 +405,14 @@ def main() -> None:
         stop.set()
         loop.join(30)
         lats.sort()
-        return {
-            "p50_s": round(lats[len(lats) // 2], 3),
-            "p90_s": round(lats[int(len(lats) * 0.9)], 3),
-        }
+        out: dict = {}
+        if lats:
+            out["p50_s"] = round(lats[len(lats) // 2], 3)
+            out["p90_s"] = round(lats[min(int(len(lats) * 0.9),
+                                          len(lats) - 1)], 3)
+        if timeouts[0]:
+            out["timeouts"] = timeouts[0]
+        return out
 
     if os.environ.get("ROOM_TPU_BENCH_LATENCY", "1") != "0":
         lat = {}

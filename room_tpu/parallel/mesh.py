@@ -136,7 +136,9 @@ def kv_cache_specs(cfg: DecoderConfig) -> dict[str, Any]:
     }
 
 
-def page_cache_specs(cfg: DecoderConfig, mesh: Mesh) -> dict[str, Any]:
+def page_cache_specs(
+    cfg: DecoderConfig, mesh: Mesh, quant: Optional[str] = None
+) -> dict[str, Any]:
     """Sharding rule for the serving engine's paged KV pool
     [L, n_pages, page_size, Hkv, Dh].
 
@@ -149,7 +151,13 @@ def page_cache_specs(cfg: DecoderConfig, mesh: Mesh) -> dict[str, Any]:
     tp = mesh.shape.get("tp", 1)
     head_ax = "tp" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
     spec = P(None, None, None, head_ax, None)
-    return {"k_pages": spec, "v_pages": spec}
+    out = {"k_pages": spec, "v_pages": spec}
+    if quant == "int8":
+        # scales [L, n_pages, page, Hkv] shard with their pages
+        sspec = P(None, None, None, head_ax)
+        out["k_scale"] = sspec
+        out["v_scale"] = sspec
+    return out
 
 
 def encoder_param_specs(cfg: EncoderConfig) -> dict[str, Any]:

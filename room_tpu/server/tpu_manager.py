@@ -65,9 +65,34 @@ QUANT_BYTES_PER_PARAM = {"bf16": 2.0, "int8": 1.0625}
 KV_DTYPE_BYTES = 2  # pages are bf16
 
 
-def model_kv_bytes_per_token(name: str) -> int:
+def model_kv_bytes_per_token(
+    name: str, kv_quant: Optional[str] = None
+) -> int:
     cfg = MODEL_CONFIGS[name]()
+    if kv_quant == "int8":
+        # int8 payload + one f32 scale per (token, kv head), k and v
+        return cfg.n_layers * 2 * (cfg.kv_dim + 4 * cfg.n_kv_heads)
     return cfg.n_layers * 2 * cfg.kv_dim * KV_DTYPE_BYTES
+
+
+def configured_kv_quant() -> Optional[str]:
+    """Mirrors serving.kv_pages.kv_quant_mode (same whitelist, same
+    ValueError) without importing the jax-heavy serving stack into the
+    status gate — a value the engine would refuse must fail the gate,
+    not read as bf16 and pass it."""
+    mode = os.environ.get("ROOM_TPU_KV_QUANT", "").strip() or None
+    if mode not in (None, "int8"):
+        raise ValueError(f"unknown ROOM_TPU_KV_QUANT {mode!r}")
+    return mode
+
+
+def configured_kv_tokens() -> int:
+    """The page pool the engine will actually allocate (providers/tpu.py
+    reads the same env vars) — the status gate must plan with this, not
+    the planner's 131k default, or a deployment tuned to a smaller pool
+    reads as not fitting."""
+    return int(os.environ.get("ROOM_TPU_N_PAGES", "2048")) * \
+        int(os.environ.get("ROOM_TPU_PAGE_SIZE", "16"))
 
 
 def plan_placement(
@@ -76,6 +101,7 @@ def plan_placement(
     quant: str = "bf16",
     kv_tokens: int = 131_072,
     hbm_per_chip_gb: Optional[float] = None,
+    kv_quant: Optional[str] = None,
 ) -> dict:
     """Does ``model`` at ``quant`` fit a ``chips``-device submesh with a
     ``kv_tokens`` page pool?  Returns the arithmetic and, when it does
@@ -92,7 +118,7 @@ def plan_placement(
     hbm = (hbm_per_chip_gb or V5E_HBM_PER_CHIP_GB) * 1e9
     usable = chips * hbm * HBM_USABLE_FRACTION
     weights = model_param_count(model) * QUANT_BYTES_PER_PARAM[quant]
-    kv = kv_tokens * model_kv_bytes_per_token(model)
+    kv = kv_tokens * model_kv_bytes_per_token(model, kv_quant)
     workspace = usable * WORKSPACE_FRACTION
     need = weights + kv + workspace
     fits = need <= usable
@@ -101,7 +127,8 @@ def plan_placement(
     if not fits:
         if quant == "bf16":
             int8_plan = plan_placement(
-                model, chips, "int8", kv_tokens, hbm_per_chip_gb
+                model, chips, "int8", kv_tokens, hbm_per_chip_gb,
+                kv_quant,
             )
             if int8_plan["fits"]:
                 suggestion = "int8"
@@ -137,6 +164,7 @@ def plan_mesh(
         plan_placement(
             p["model"], int(p["chips"]), p.get("quant", "bf16"),
             int(p.get("kv_tokens", 131_072)), hbm_per_chip_gb,
+            p.get("kv_quant"),
         )
         for p in placements
     ]
@@ -175,7 +203,9 @@ def get_tpu_status(model: str = "qwen3-coder-30b") -> dict:
         if hbm_bytes:
             plan = plan_placement(
                 model, n_devices,
+                kv_tokens=configured_kv_tokens(),
                 hbm_per_chip_gb=hbm_bytes / 1e9,
+                kv_quant=configured_kv_quant(),
             )
             detail = (
                 f"weights {plan['weight_gb']} GB + kv {plan['kv_gb']} "

@@ -40,7 +40,8 @@ import numpy as np
 from ..models import qwen3
 from ..models.config import DecoderConfig
 from .kv_pages import (
-    PageTable, init_page_cache, make_paged_kv_hook, use_pallas_kernel,
+    PageTable, init_page_cache, kv_quant_mode, make_paged_kv_hook,
+    pallas_decode_int8_ok, pallas_prefill_ok, use_pallas_kernel,
 )
 from .sampler import (
     SamplingParams, apply_penalties, sample_batched, spec_verify,
@@ -51,11 +52,13 @@ PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
                    16384, 32768)
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _reset_count_row(counts, slot, tok):
     """Zero one slot's penalty-count row and count its first sampled
     token (runs at admission; device-side so the [B, V] array never
-    round-trips to host)."""
+    round-trips to host). Donates ``counts`` — the caller immediately
+    rebinds it, and without donation each admission would copy the full
+    [max_batch, vocab] array (~38 MB at the 30B vocab, batch 64)."""
     return counts.at[slot].set(0).at[slot, tok].add(1)
 
 
@@ -230,13 +233,45 @@ class ServingEngine:
         self.page_table = PageTable(n_pages, page_size)
         self.page_table.ensure_capacity("__null__", page_size)
 
-        self.cache = init_page_cache(cfg, n_pages, page_size)
+        # ROOM_TPU_KV_QUANT=int8: int8 pages + per-(token, head) f32
+        # scales — ~49% of the bf16 pool's HBM footprint and decode
+        # read traffic. The S>1 Pallas prefill kernel has no int8
+        # variant yet, so quantized engines take the bounded XLA
+        # dequant gather for chunked prefill.
+        self.kv_quant = kv_quant_mode()
+
+        # startup smoke of the S>1 Pallas prefill kernel (ADVICE r3):
+        # one tiny compile + numerics check against attention_ref before
+        # any production traffic routes through it; a failed probe pins
+        # every S>1 path to the bounded XLA gather for this engine
+        self._pallas_prefill = (
+            self.kv_quant is None and use_pallas_kernel()
+            and pallas_prefill_ok(
+                cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, page_size
+            )
+        )
+        # whether S=1 decode actually runs a Pallas kernel (bf16 kernel,
+        # or the int8 variant IF its startup probe passes) — the
+        # active_pages bucket decision must mirror the hook's routing,
+        # or a probe-failed int8 engine would take the XLA dequant
+        # gather UNBOUNDED (full 32k capacity per step)
+        self._pallas_decode = use_pallas_kernel() and (
+            self.kv_quant is None or pallas_decode_int8_ok(
+                cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, page_size
+            )
+        )
+
+        self.cache = init_page_cache(
+            cfg, n_pages, page_size, quant=self.kv_quant
+        )
         self._cache_specs = None
         self._dp_size = 1
         if mesh is not None:
             from ..parallel.mesh import page_cache_specs, shard_pytree
 
-            self._cache_specs = page_cache_specs(cfg, mesh)
+            self._cache_specs = page_cache_specs(
+                cfg, mesh, quant=self.kv_quant
+            )
             self.cache = shard_pytree(self.cache, self._cache_specs, mesh)
             dp = mesh.shape.get("dp", 1)
             if dp > 1 and max_batch % dp == 0:
@@ -282,6 +317,7 @@ class ServingEngine:
             "prefix_hits": 0, "prefix_tokens_reused": 0,
             "prefix_evictions": 0,
             "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
+            "spec_rows_sequential": 0,
         }
         from collections import Counter
 
@@ -352,6 +388,7 @@ class ServingEngine:
                 hook = make_paged_kv_hook(
                     block_table, length, self.page_size,
                     fresh_prefill=fresh, active_pages=active_pages,
+                    pallas_prefill=self._pallas_prefill,
                 )
                 positions = length[:, None] + jnp.arange(tokens.shape[1])
                 # only each row's last real position gets sampled; at a
@@ -446,6 +483,7 @@ class ServingEngine:
                 hook = make_paged_kv_hook(
                     block_tables, lengths, self.page_size,
                     active_pages=active_pages,
+                    pallas_prefill=self._pallas_prefill,
                 )
                 positions = lengths[:, None] + jnp.arange(width)
                 logits, cache = qwen3.forward(
@@ -897,7 +935,7 @@ class ServingEngine:
         # Pallas prefill kernel (S % q-block == 0) there is no gather
         # at all, so no bound to key compiles on
         active_pages = None
-        if not fresh and not (use_pallas_kernel() and bucket % 8 == 0):
+        if not fresh and not (self._pallas_prefill and bucket % 8 == 0):
             active_pages = self._pages_bucket(sess.length + bucket)
         return {
             "turn": turn, "sess": sess, "prompt": tail,
@@ -914,7 +952,7 @@ class ServingEngine:
         width = len(toks)
         fresh = sess.length == 0
         active = None
-        if not fresh and not (use_pallas_kernel() and width % 8 == 0):
+        if not fresh and not (self._pallas_prefill and width % 8 == 0):
             active = self._pages_bucket(sess.length + width)
         key = ("prefill_write", width, fresh, active)
         if key not in self._jit_cache:
@@ -925,6 +963,7 @@ class ServingEngine:
                 hook = make_paged_kv_hook(
                     block_table, length, self.page_size,
                     fresh_prefill=fresh, active_pages=active,
+                    pallas_prefill=self._pallas_prefill,
                 )
                 positions = length[:, None] + \
                     jnp.arange(tokens.shape[1])
@@ -1029,6 +1068,30 @@ class ServingEngine:
             self._active[slot] = turn
             self._append_token(slot, turn, int(firsts[r]))
 
+    def _slot_arrays_excluding(
+        self, active_idx: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Block tables + lengths for a device call only ``active_idx``
+        rows participate in. Any OTHER still-active row is diverted to
+        the scratch page: its slot arrays can be stale (the session
+        advanced since its last reserve — e.g. a penalized row sitting
+        out a spec round, or a spec row sitting out the penalty scan),
+        so letting the forward write its garbage KV at the recorded
+        position would corrupt KV that is already valid."""
+        tables = self._slot_tables
+        lengths = self._slot_lengths
+        active = set(active_idx)
+        stale = [
+            i for i in range(self.max_batch)
+            if self._active[i] is not None and i not in active
+        ]
+        if stale:
+            tables = tables.copy()
+            lengths = lengths.copy()
+            tables[stale] = 0
+            lengths[stale] = 0
+        return tables, lengths
+
     def _reserve_slot(self, i: int, want_tokens: int) -> bool:
         """Reserve pages so slot ``i``'s session can hold
         length+want_tokens (clamped to capacity), degrading to a single
@@ -1072,18 +1135,35 @@ class ServingEngine:
         ]
         if not active_idx:
             return 0
+        # spec verify has no penalty path: penalized rows take the
+        # sequential scan (their counts stay exact) while the rest of
+        # the batch still rides spec — one tenant's sampling knobs must
+        # not cut every batchmate's decode throughput (ADVICE r3)
+        n_spec = 0
+        if self.spec_tokens > 0:
+            spec_rows = [
+                i for i in active_idx
+                if not self._active[i].sampling.penalized
+            ]
+            pen_rows = [i for i in active_idx if i not in spec_rows]
+            if spec_rows:
+                r = self._decode_once_spec(list(spec_rows))
+                if r is not None:
+                    if not pen_rows:
+                        return r
+                    n_spec = r
+                    self._stats["spec_rows_sequential"] += len(pen_rows)
+                    # the scan below runs for the penalized rows only;
+                    # _slot_arrays_excluding diverts the spec rows (now
+                    # stale) to the scratch page
+                    active_idx = pen_rows
+                # None: no row drafted anything; the chunked scan below
+                # advances the whole batch together (it amortizes host
+                # round-trips)
+
         penalized = any(
             self._active[i].sampling.penalized for i in active_idx
         )
-        if self.spec_tokens > 0 and not penalized:
-            # spec verify has no penalty path: penalized rows take the
-            # sequential scan so their counts stay exact
-            n = self._decode_once_spec(active_idx)
-            if n is not None:
-                return n
-            # no row drafted anything this round: the chunked scan path
-            # below is strictly better (it amortizes host round-trips)
-
         chunk = self.decode_chunk
         # ensure pages only for tokens the turn can actually accept:
         # min(chunk, its remaining budget), clamped to capacity
@@ -1095,7 +1175,7 @@ class ServingEngine:
             if not self._reserve_slot(i, min(chunk, remaining)):
                 active_idx.remove(i)
         if not active_idx:
-            return 0
+            return n_spec
 
         tokens = np.zeros((self.max_batch,), np.int32)
         for i in active_idx:
@@ -1116,7 +1196,7 @@ class ServingEngine:
         # reach (the Pallas kernel is already length-bounded — passing a
         # varying static bound there would only churn compiles)
         ap = None
-        if not use_pallas_kernel():
+        if not self._pallas_decode:
             max_len = max(
                 int(self._slot_lengths[i]) for i in active_idx
             )
@@ -1137,6 +1217,8 @@ class ServingEngine:
             counts = jnp.int32(0)
             pen_args = (jnp.float32(0), jnp.float32(0))
         decode = self._decode_fn(chunk, ap, penalized)
+        scan_tables, scan_lengths = \
+            self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode"):
             next_tokens, counts_out, self.cache = decode(
@@ -1144,8 +1226,8 @@ class ServingEngine:
                 self.cache,
                 counts,
                 self._place_batch(tokens),
-                self._place_batch(self._slot_tables),
-                self._place_batch(self._slot_lengths),
+                self._place_batch(scan_tables),
+                self._place_batch(scan_lengths),
                 sub,
                 self._place_batch(temps),
                 self._place_batch(top_ps),
@@ -1174,7 +1256,7 @@ class ServingEngine:
                     # tokens (and their KV writes past sess.length) are
                     # discarded
                     break
-        return len(active_idx)
+        return n_spec + len(active_idx)
 
     def _decode_once_spec(self, active_idx: list[int]) -> Optional[int]:
         """One speculative round: active slots draft continuation tokens
@@ -1255,20 +1337,22 @@ class ServingEngine:
         # the S>1 verify forward gathers unless the Pallas prefill
         # kernel covers its width: bound the gather to the batch's reach
         ap = None
-        if not (use_pallas_kernel() and width % 8 == 0):
+        if not (self._pallas_prefill and width % 8 == 0):
             max_len = max(
                 int(self._slot_lengths[i]) for i in active_idx
             )
             ap = self._pages_bucket(max_len + width)
         spec = self._spec_fn(width, ap)
+        spec_tables, spec_lengths = \
+            self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
         with self.timer.phase("decode_spec"):
             accept_d, residual_d, plain_d, self.cache = spec(
                 self.params,
                 self.cache,
                 self._place_batch(tokens),
-                self._place_batch(self._slot_tables),
-                self._place_batch(self._slot_lengths),
+                self._place_batch(spec_tables),
+                self._place_batch(spec_lengths),
                 sub,
                 self._place_batch(temps),
                 self._place_batch(top_ps),

@@ -26,12 +26,46 @@ from ..ops import attention_ref
 Params = dict[str, Any]
 
 
+def kv_quant_mode() -> Optional[str]:
+    """KV-cache quantization (env ROOM_TPU_KV_QUANT): ``int8`` stores
+    pages as int8 with one f32 scale per (token, kv-head) — ~49% of the
+    bf16 pool's HBM bytes AND decode-attention read traffic, the
+    dominant cost at long context. None (default) keeps bf16 pages."""
+    import os
+
+    mode = os.environ.get("ROOM_TPU_KV_QUANT", "").strip() or None
+    if mode not in (None, "int8"):
+        raise ValueError(f"unknown ROOM_TPU_KV_QUANT {mode!r}")
+    return mode
+
+
 def init_page_cache(
-    cfg: DecoderConfig, n_pages: int, page_size: int, dtype=None
+    cfg: DecoderConfig, n_pages: int, page_size: int, dtype=None,
+    quant: Optional[str] = None,
 ) -> Params:
     dt = dtype or cfg.activation_dtype
     shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    if quant == "int8":
+        sshape = shape[:-1]
+        return {
+            "k_pages": jnp.zeros(shape, jnp.int8),
+            "v_pages": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32),
+        }
     return {"k_pages": jnp.zeros(shape, dt), "v_pages": jnp.zeros(shape, dt)}
+
+
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(token, head) symmetric int8: scale = max|x| / 127 along D."""
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1), 1e-8
+    ) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[..., None]),
+        -127, 127,
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def use_pallas_kernel() -> bool:
@@ -50,6 +84,198 @@ def use_pallas_kernel() -> bool:
         return False
 
 
+_PREFILL_PROBE: dict[tuple, bool] = {}
+_DECODE_INT8_PROBE: dict[tuple, bool] = {}
+
+
+def _probe_gate(
+    env_var: str, cache: dict, probe_fn,
+    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int,
+) -> bool:
+    """Shared kernel-gating scaffold: env force (on|off), else a
+    one-shot compile + numerics probe cached per shape."""
+    import os
+
+    mode = os.environ.get(env_var, "auto")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    key = (int(n_q_heads), int(n_kv_heads), int(head_dim), int(page_size))
+    got = cache.get(key)
+    if got is None:
+        got = probe_fn(*key)
+        cache[key] = got
+    return got
+
+
+def pallas_prefill_ok(
+    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int
+) -> bool:
+    """One-shot compile + numerics smoke of the S>1 Pallas prefill
+    kernel for these shapes, cached per shape. The decode kernel was
+    validated on real v5e, but Mosaic has diverged from interpret mode
+    on this hardware before (see ops/paged_attention.py docstring), and
+    the prefill kernel's [qblk*Hq, rows] dots are a different lowering:
+    routing production continuation-prefill through an unproven compile
+    could crash serving. A failed probe falls back to the bounded XLA
+    gather. ROOM_TPU_PREFILL_KERNEL=on|off skips the probe either way.
+    """
+    return _probe_gate(
+        "ROOM_TPU_PREFILL_KERNEL", _PREFILL_PROBE,
+        _probe_prefill_kernel,
+        n_q_heads, n_kv_heads, head_dim, page_size,
+    )
+
+
+def pallas_decode_int8_ok(
+    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int
+) -> bool:
+    """Startup smoke for the int8-KV decode kernel (same contract as
+    pallas_prefill_ok): the bf16 decode kernel is hardware-validated,
+    but the int8 variant's scale DMAs are a new lowering — probe
+    compile + numerics before routing traffic, fall back to the XLA
+    dequant gather otherwise."""
+    return _probe_gate(
+        "ROOM_TPU_PAGED_INT8_KERNEL", _DECODE_INT8_PROBE,
+        _probe_decode_int8_kernel,
+        n_q_heads, n_kv_heads, head_dim, page_size,
+    )
+
+
+def _probe_decode_int8_kernel(
+    hq: int, hkv: int, d: int, page_size: int
+) -> bool:
+    import logging
+
+    import numpy as np
+
+    from ..ops.paged_attention import paged_attention_decode_int8
+
+    try:
+        total = 2 * page_size + 3          # ragged tail crosses a page
+        npg = -(-total // page_size)
+        rng = np.random.default_rng(1)
+        k = rng.standard_normal((total, hkv, d)).astype(np.float32)
+        v = rng.standard_normal((total, hkv, d)).astype(np.float32)
+        q = rng.standard_normal((1, hq, d)).astype(np.float32)
+        pad = npg * page_size - total
+        kpad = np.concatenate([k, np.zeros((pad, hkv, d), np.float32)])
+        vpad = np.concatenate([v, np.zeros((pad, hkv, d), np.float32)])
+        qk, sk = _quantize_kv(jnp.asarray(kpad))
+        qv, sv = _quantize_kv(jnp.asarray(vpad))
+        k_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.int8)
+        k_pages = k_pages.at[1:].set(
+            qk.reshape(npg, page_size, hkv, d))
+        v_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.int8)
+        v_pages = v_pages.at[1:].set(
+            qv.reshape(npg, page_size, hkv, d))
+        k_scale = jnp.zeros((npg + 1, page_size, hkv), jnp.float32)
+        k_scale = k_scale.at[1:].set(sk.reshape(npg, page_size, hkv))
+        v_scale = jnp.zeros((npg + 1, page_size, hkv), jnp.float32)
+        v_scale = v_scale.at[1:].set(sv.reshape(npg, page_size, hkv))
+        tables = jnp.arange(1, npg + 1, dtype=jnp.int32)[None]
+        lengths = jnp.full((1,), total, jnp.int32)
+
+        out = paged_attention_decode_int8(
+            jnp.asarray(q, jnp.bfloat16), k_pages, v_pages,
+            k_scale, v_scale, tables, lengths, page_size=page_size,
+        )
+        kd = (qk.astype(jnp.float32) * sk[..., None])[:total]
+        vd = (qv.astype(jnp.float32) * sv[..., None])[:total]
+        expected = attention_ref(
+            jnp.asarray(q, jnp.bfloat16)[:, None],
+            kd[None].astype(jnp.bfloat16),
+            vd[None].astype(jnp.bfloat16),
+            causal=True,
+            q_positions=jnp.full((1, 1), total - 1, jnp.int32),
+            kv_positions=jnp.arange(total)[None],
+        )[:, 0]
+        ok = bool(np.allclose(
+            np.asarray(out, np.float32),
+            np.asarray(expected, np.float32),
+            atol=6e-2,
+        ))
+        if not ok:
+            logging.getLogger(__name__).warning(
+                "int8 decode kernel probe: numerics mismatch at "
+                "hq=%d hkv=%d d=%d page=%d; using XLA dequant gather",
+                hq, hkv, d, page_size,
+            )
+        return ok
+    except Exception as e:
+        logging.getLogger(__name__).warning(
+            "int8 decode kernel probe failed (%s); using XLA dequant "
+            "gather", e,
+        )
+        return False
+
+
+def _probe_prefill_kernel(hq: int, hkv: int, d: int, page_size: int) -> bool:
+    import logging
+
+    import numpy as np
+
+    from ..ops.paged_attention import (
+        PREFILL_Q_BLOCK, paged_attention_prefill,
+    )
+
+    try:
+        s = PREFILL_Q_BLOCK
+        prefix = page_size              # one full page of paged prefix
+        total = prefix + s
+        npg = -(-total // page_size)
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((total, hkv, d)).astype(np.float32) * 0.5
+        v = rng.standard_normal((total, hkv, d)).astype(np.float32) * 0.5
+        q = rng.standard_normal((1, s, hq, d)).astype(np.float32) * 0.5
+        pad = npg * page_size - total
+        kpad = np.concatenate(
+            [k, np.zeros((pad, hkv, d), np.float32)]
+        ).reshape(npg, page_size, hkv, d)
+        vpad = np.concatenate(
+            [v, np.zeros((pad, hkv, d), np.float32)]
+        ).reshape(npg, page_size, hkv, d)
+        # page 0 stays scratch, as in production tables
+        k_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.bfloat16)
+        k_pages = k_pages.at[1:].set(jnp.asarray(kpad, jnp.bfloat16))
+        v_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.bfloat16)
+        v_pages = v_pages.at[1:].set(jnp.asarray(vpad, jnp.bfloat16))
+        tables = jnp.arange(1, npg + 1, dtype=jnp.int32)[None]
+        lengths = jnp.full((1,), prefix, jnp.int32)
+
+        out = paged_attention_prefill(
+            jnp.asarray(q, jnp.bfloat16), k_pages, v_pages,
+            tables, lengths, page_size=page_size,
+        )
+        q_pos = prefix + jnp.arange(s)[None]
+        kv_pos = jnp.arange(total)[None]
+        expected = attention_ref(
+            jnp.asarray(q, jnp.bfloat16),
+            jnp.asarray(k, jnp.bfloat16)[None],
+            jnp.asarray(v, jnp.bfloat16)[None],
+            causal=True, q_positions=q_pos, kv_positions=kv_pos,
+        )
+        ok = bool(np.allclose(
+            np.asarray(out, np.float32),
+            np.asarray(expected, np.float32),
+            atol=6e-2,
+        ))
+        if not ok:
+            logging.getLogger(__name__).warning(
+                "pallas prefill kernel probe: numerics mismatch at "
+                "hq=%d hkv=%d d=%d page=%d; using XLA gather",
+                hq, hkv, d, page_size,
+            )
+        return ok
+    except Exception as e:  # compile/lowering failure -> XLA fallback
+        logging.getLogger(__name__).warning(
+            "pallas prefill kernel probe failed (%s); using XLA gather",
+            e,
+        )
+        return False
+
+
 def make_paged_kv_hook(
     block_tables: jax.Array,   # [B, max_pages] page ids (0 = also a real page; unused slots may be any valid id, masked by length)
     lengths: jax.Array,        # [B] tokens already in cache per sequence
@@ -57,6 +283,7 @@ def make_paged_kv_hook(
     pallas_decode: Optional[bool] = None,
     fresh_prefill: bool = False,
     active_pages: Optional[int] = None,
+    pallas_prefill: Optional[bool] = None,
 ):
     """Build the kv_hook used by models.qwen3.forward: writes the chunk's
     k/v into the page pool and attends over (prefix + chunk).
@@ -81,6 +308,7 @@ def make_paged_kv_hook(
 
     def hook(q, k, v, layer_cache):
         s = q.shape[1]
+        quantized = "k_scale" in layer_cache
         positions = lengths[:, None] + jnp.arange(s)[None]      # [B, S]
         # positions beyond the block table (chunked decode can overrun a
         # finishing turn) divert to scratch page 0 rather than clamping
@@ -99,12 +327,28 @@ def make_paged_kv_hook(
 
         flat_pages = page_of.reshape(-1)
         flat_off = offset.reshape(-1)
-        kp = layer_cache["k_pages"].at[flat_pages, flat_off].set(
-            k.reshape(-1, *k.shape[2:])
-        )
-        vp = layer_cache["v_pages"].at[flat_pages, flat_off].set(
-            v.reshape(-1, *v.shape[2:])
-        )
+        k_flat = k.reshape(-1, *k.shape[2:])
+        v_flat = v.reshape(-1, *v.shape[2:])
+        if quantized:
+            qk, sk = _quantize_kv(k_flat)
+            qv, sv = _quantize_kv(v_flat)
+            kp = layer_cache["k_pages"].at[flat_pages, flat_off].set(qk)
+            vp = layer_cache["v_pages"].at[flat_pages, flat_off].set(qv)
+            ks = layer_cache["k_scale"].at[flat_pages, flat_off].set(sk)
+            vs = layer_cache["v_scale"].at[flat_pages, flat_off].set(sv)
+            out_cache = {
+                "k_pages": kp, "v_pages": vp,
+                "k_scale": ks, "v_scale": vs,
+            }
+        else:
+            kp = layer_cache["k_pages"].at[flat_pages, flat_off].set(
+                k_flat
+            )
+            vp = layer_cache["v_pages"].at[flat_pages, flat_off].set(
+                v_flat
+            )
+            ks = vs = None
+            out_cache = {"k_pages": kp, "v_pages": vp}
 
         if fresh_prefill:
             positions_q = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
@@ -112,23 +356,43 @@ def make_paged_kv_hook(
                 q, k, v, causal=True,
                 q_positions=positions_q, kv_positions=positions_q,
             )
-            return attn, {"k_pages": kp, "v_pages": vp}
+            return attn, out_cache
 
-        if s == 1 and pallas_decode:
-            from ..ops.paged_attention import paged_attention_decode
+        if s == 1 and pallas_decode and (
+            not quantized or pallas_decode_int8_ok(
+                q.shape[2], k.shape[2], k.shape[3], page_size
+            )
+        ):
+            if quantized:
+                from ..ops.paged_attention import (
+                    paged_attention_decode_int8,
+                )
 
-            attn = paged_attention_decode(
-                q[:, 0], kp, vp, block_tables, lengths + 1,
-                page_size=page_size,
-            )[:, None]
-            return attn, {"k_pages": kp, "v_pages": vp}
+                attn = paged_attention_decode_int8(
+                    q[:, 0], kp, vp, ks, vs, block_tables,
+                    lengths + 1, page_size=page_size,
+                )[:, None]
+            else:
+                from ..ops.paged_attention import paged_attention_decode
 
-        if s > 1 and pallas_decode:
+                attn = paged_attention_decode(
+                    q[:, 0], kp, vp, block_tables, lengths + 1,
+                    page_size=page_size,
+                )[:, None]
+            return attn, out_cache
+
+        if s > 1 and not quantized:
             from ..ops.paged_attention import (
                 PREFILL_Q_BLOCK, paged_attention_prefill,
             )
 
-            if s % PREFILL_Q_BLOCK == 0:
+            use_prefill = pallas_prefill
+            if use_prefill is None and pallas_decode \
+                    and s % PREFILL_Q_BLOCK == 0:
+                use_prefill = pallas_prefill_ok(
+                    q.shape[2], k.shape[2], k.shape[3], page_size
+                )
+            if use_prefill and s % PREFILL_Q_BLOCK == 0:
                 # ragged chunked-prefill kernel: walks each row's own
                 # pages (prefix + the chunk KV written above) — page
                 # traffic scales with actual context, never capacity
@@ -136,7 +400,7 @@ def make_paged_kv_hook(
                     q, kp, vp, block_tables, lengths,
                     page_size=page_size,
                 )
-                return attn, {"k_pages": kp, "v_pages": vp}
+                return attn, out_cache
 
         # gather this batch's pages into a dense view (XLA reference path;
         # the Pallas kernel replaces this gather), bounded to the pages
@@ -144,9 +408,19 @@ def make_paged_kv_hook(
         tbl = block_tables
         if active_pages is not None and active_pages < max_pages:
             tbl = block_tables[:, :active_pages]
-        k_all = kp[tbl]                                          # [B,P,p,H,D]
-        v_all = vp[tbl]
         kv_len = tbl.shape[1] * page_size
+        if quantized:
+            # dequantize right after the gather; bf16 keeps the dense
+            # view at the unquantized path's footprint
+            k_all = (
+                kp[tbl].astype(jnp.float32) * ks[tbl][..., None]
+            ).astype(jnp.bfloat16)
+            v_all = (
+                vp[tbl].astype(jnp.float32) * vs[tbl][..., None]
+            ).astype(jnp.bfloat16)
+        else:
+            k_all = kp[tbl]                                  # [B,P,p,H,D]
+            v_all = vp[tbl]
         k_all = k_all.reshape(b, kv_len, *k.shape[2:])
         v_all = v_all.reshape(b, kv_len, *v.shape[2:])
 
@@ -159,7 +433,7 @@ def make_paged_kv_hook(
             q_positions=positions, kv_positions=kv_positions,
             kv_mask=kv_mask,
         )
-        return attn, {"k_pages": kp, "v_pages": vp}
+        return attn, out_cache
 
     return hook
 

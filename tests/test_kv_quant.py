@@ -1,0 +1,149 @@
+"""int8 KV-cache quantization (ROOM_TPU_KV_QUANT=int8): pages stored
+as int8 + per-(token, head) f32 scales — ~49% of the bf16 pool's HBM
+bytes and decode read traffic. No reference counterpart (the
+reference's decoding lives inside Ollama); vLLM-style KV quantization
+re-designed for the TPU paged layout."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine
+from room_tpu.serving.kv_pages import (
+    _quantize_kv, init_page_cache, make_paged_kv_hook,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((64, 4, 32)).astype(np.float32) * 3.0
+    )
+    q, s = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    deq = q.astype(jnp.float32) * s[..., None]
+    # symmetric int8: max error is half a quantization step per row
+    step = np.asarray(s)[..., None]
+    assert np.all(np.abs(np.asarray(deq - x)) <= step * 0.5 + 1e-6)
+
+
+def test_quantized_hook_attention_close_to_dense(setup):
+    """The dequant-gather attention path must track the unquantized
+    path within int8 tolerance for a decode step over a real prefix."""
+    cfg, _ = setup
+    hkv, d, page = cfg.n_kv_heads, cfg.head_dim, 8
+    rng = np.random.default_rng(2)
+    b, prefix = 2, 13
+
+    def run(quant):
+        cache = init_page_cache(cfg, n_pages=16, page_size=page,
+                                quant=quant)
+        layer = {k: v[0] for k, v in cache.items()}
+        tables = jnp.asarray([[1, 2, 0, 0], [3, 4, 0, 0]], jnp.int32)
+        # write the prefix through the hook itself (fresh prefill)
+        kpre = jnp.asarray(rng.standard_normal(
+            (b, prefix, hkv, d)).astype(np.float32))
+        vpre = jnp.asarray(rng.standard_normal(
+            (b, prefix, hkv, d)).astype(np.float32))
+        qpre = jnp.asarray(rng.standard_normal(
+            (b, prefix, cfg.n_heads, d)).astype(np.float32))
+        hook = make_paged_kv_hook(
+            tables, jnp.zeros((b,), jnp.int32), page,
+            pallas_decode=False, fresh_prefill=True,
+        )
+        _, layer = hook(qpre, kpre, vpre, layer)
+        # one decode token on top
+        hook2 = make_paged_kv_hook(
+            tables, jnp.full((b,), prefix, jnp.int32), page,
+            pallas_decode=False,
+        )
+        q1 = jnp.asarray(rng.standard_normal(
+            (b, 1, cfg.n_heads, d)).astype(np.float32))
+        k1 = jnp.asarray(rng.standard_normal(
+            (b, 1, hkv, d)).astype(np.float32))
+        v1 = jnp.asarray(rng.standard_normal(
+            (b, 1, hkv, d)).astype(np.float32))
+        out, _ = hook2(q1, k1, v1, layer)
+        return np.asarray(out, np.float32)
+
+    rng = np.random.default_rng(2)
+    dense = run(None)
+    rng = np.random.default_rng(2)
+    quant = run("int8")
+    assert np.allclose(dense, quant, atol=8e-2), (
+        np.abs(dense - quant).max()
+    )
+
+
+def test_int8_decode_kernel_interpret_matches_dequant():
+    """Kernel logic vs the dequantized dense reference (interpret mode;
+    the hardware lowering is probe-gated at engine startup)."""
+    from room_tpu.ops import paged_attention as pa
+    from room_tpu.serving import kv_pages
+
+    real = pa.paged_attention_decode_int8
+    try:
+        pa.paged_attention_decode_int8 = (
+            lambda *a, **k: real(*a, **{**k, "interpret": True})
+        )
+        kv_pages._DECODE_INT8_PROBE.clear()
+        assert kv_pages.pallas_decode_int8_ok(8, 2, 64, 16) is True
+        # page-boundary sweep: ragged lengths across page edges
+        assert kv_pages._probe_decode_int8_kernel(4, 4, 32, 8) is True
+    finally:
+        pa.paged_attention_decode_int8 = real
+        kv_pages._DECODE_INT8_PROBE.clear()
+
+
+def test_engine_serves_with_int8_kv(setup, monkeypatch):
+    """End-to-end: quantized engine completes turns; the first sampled
+    token is exact (fresh prefill never reads the cache), the rest
+    stays plausible under int8 noise; session continuation (dequant
+    gather over a real prefix) works."""
+    cfg, params = setup
+    monkeypatch.setenv("ROOM_TPU_KV_QUANT", "int8")
+    eng = ServingEngine(cfg, params, max_batch=2, page_size=8,
+                        n_pages=64)
+    assert eng.kv_quant == "int8"
+    assert "k_scale" in eng.cache
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    t1 = eng.submit([5, 6, 7, 8], session_id="a", sampling=sp)
+    eng.run_until_idle()
+    assert t1.finish_reason in ("stop", "length")
+    assert len(t1.new_tokens) >= 1
+
+    monkeypatch.delenv("ROOM_TPU_KV_QUANT")
+    base = ServingEngine(cfg, params, max_batch=2, page_size=8,
+                         n_pages=64)
+    b1 = base.submit([5, 6, 7, 8], session_id="a", sampling=sp)
+    base.run_until_idle()
+    assert t1.new_tokens[0] == b1.new_tokens[0]
+
+    # continuation on the quantized engine (delta submission): prefix
+    # KV is read back through the dequant gather
+    monkeypatch.setenv("ROOM_TPU_KV_QUANT", "int8")
+    t2 = eng.submit([9, 9], session_id="a", sampling=sp)
+    eng.run_until_idle()
+    assert t2.finish_reason in ("stop", "length")
+
+
+def test_quantized_cache_sharding_specs(setup):
+    from room_tpu.parallel import MeshSpec, make_mesh, page_cache_specs
+    from room_tpu.parallel.mesh import shard_pytree
+
+    cfg, _ = setup
+    mesh = make_mesh(MeshSpec(2, 2, 2))
+    specs = page_cache_specs(cfg, mesh, quant="int8")
+    assert set(specs) == {"k_pages", "v_pages", "k_scale", "v_scale"}
+    cache = init_page_cache(cfg, n_pages=16, page_size=8, quant="int8")
+    sharded = shard_pytree(cache, specs, mesh)
+    assert sharded["k_scale"].shape == cache["k_scale"].shape

@@ -710,3 +710,47 @@ def test_batched_prefill_matches_sequential(engine_setup):
     # all four shared one grouped prefill (bucket 16 x batch 4)
     phases = eng_bat.stats()["phases"]
     assert any("x4" in k for k in phases), phases
+
+
+def test_pallas_prefill_probe_gates_kernel(monkeypatch):
+    """The S>1 prefill kernel only routes traffic after a one-shot
+    compile + numerics smoke (ADVICE r3): a kernel that fails to lower
+    OR returns wrong numbers pins the engine to the XLA gather."""
+    from room_tpu.ops import paged_attention as pa
+    from room_tpu.serving import kv_pages
+
+    real = pa.paged_attention_prefill
+
+    # lowering failure -> fallback (the real CPU pallas error also
+    # lands here)
+    monkeypatch.setattr(kv_pages, "_PREFILL_PROBE", {})
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setattr(pa, "paged_attention_prefill", boom)
+    assert kv_pages.pallas_prefill_ok(4, 2, 64, 8) is False
+
+    # compiles but wrong numerics -> fallback
+    monkeypatch.setattr(kv_pages, "_PREFILL_PROBE", {})
+    monkeypatch.setattr(
+        pa, "paged_attention_prefill",
+        lambda q, *a, **k: jnp.zeros_like(q),
+    )
+    assert kv_pages.pallas_prefill_ok(4, 2, 64, 8) is False
+
+    # the real kernel (interpret mode stands in for hardware) passes
+    # the numerics check -> kernel allowed
+    monkeypatch.setattr(kv_pages, "_PREFILL_PROBE", {})
+    monkeypatch.setattr(
+        pa, "paged_attention_prefill",
+        lambda *a, **k: real(*a, **{**k, "interpret": True}),
+    )
+    assert kv_pages.pallas_prefill_ok(4, 2, 64, 8) is True
+
+    # env force wins in both directions, no probe
+    monkeypatch.setattr(kv_pages, "_PREFILL_PROBE", {})
+    monkeypatch.setenv("ROOM_TPU_PREFILL_KERNEL", "off")
+    assert kv_pages.pallas_prefill_ok(32, 4, 128, 16) is False
+    monkeypatch.setenv("ROOM_TPU_PREFILL_KERNEL", "on")
+    assert kv_pages.pallas_prefill_ok(32, 4, 128, 16) is True

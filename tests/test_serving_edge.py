@@ -495,3 +495,58 @@ def test_prefix_hit_session_survives_own_eviction(setup):
         return t.new_tokens
 
     assert run(n_pages=15) == run(n_pages=64)
+
+
+# ---- eviction x prefix-cache x spec-decode interaction matrix
+# (VERDICT r2 #10): the three features compose — a pressure-cooker
+# engine with every combination must stay token-identical to a
+# pressure-free run and close its page accounting to zero leaks ----
+
+@pytest.mark.parametrize("spec", [0, 4])
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_eviction_prefix_spec_matrix(
+    setup, spec, prefix_cache, monkeypatch
+):
+    cfg, params = setup
+    monkeypatch.setenv(
+        "ROOM_TPU_PREFIX_CACHE_PAGES", "2" if prefix_cache else "0"
+    )
+    # shared long-ish prefix so the prefix cache engages; repetitive
+    # body so spec drafts engage; greedy so identity is exact
+    prefix = [5, 6, 7, 5, 6, 7, 5, 6]
+    prompts = [prefix + [10 + i] for i in range(6)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+
+    def run(n_pages):
+        eng = ServingEngine(
+            cfg, params, max_batch=2, page_size=4, n_pages=n_pages,
+            spec_tokens=spec,
+        )
+        turns = [
+            eng.submit(list(p), session_id=f"s{i}", sampling=sp)
+            for i, p in enumerate(prompts)
+        ]
+        eng.run_until_idle()
+        for i in range(len(prompts)):
+            eng.release_session(f"s{i}")
+        st = eng.stats()
+        free = eng.page_table.free_pages
+        return [t.new_tokens for t in turns], st, free, eng
+
+    # roomy pool: no eviction pressure
+    want, _, _, _ = run(n_pages=256)
+    # tight pool: evictions forced (6 sessions x ~4 pages on 25 usable)
+    got, st, free, eng = run(n_pages=26)
+
+    assert got == want
+    # all sessions released: every page is either free, the scratch
+    # page, or retained by a live prefix-cache entry (that's the
+    # cache working, not a leak)
+    held_by_prefix = sum(
+        len(e.pages) for e in eng._prefix_cache.values()
+    )
+    assert free == eng.page_table.n_pages - 1 - held_by_prefix, (
+        free, eng.page_table.n_pages, held_by_prefix, st,
+    )
+    if not prefix_cache:
+        assert held_by_prefix == 0

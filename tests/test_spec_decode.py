@@ -264,3 +264,36 @@ def test_spec_oversubscribed_pool_completes(setup):
     ]
     eng.run_until_idle()
     assert all(t.finish_reason in ("stop", "length") for t in turns)
+
+
+def test_spec_mixed_penalized_batch_rides_spec_per_row():
+    """One penalized tenant must not pull the whole batch off spec
+    (ADVICE r3): non-penalized rows still ride spec (token-identical to
+    the non-spec engine), the penalized row takes the sequential scan
+    in the same round, and the split is visible in stats."""
+    # an 8-token vocabulary forces greedy generation into a cycle, so
+    # the plain row's drafts engage deterministically
+    cfg = tiny_moe(vocab_size=8)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
+    rep = [1, 2, 3, 1, 2, 3]
+    plain_sp = SamplingParams(temperature=0.0, max_new_tokens=32)
+    pen_sp = SamplingParams(
+        temperature=0.0, max_new_tokens=32,
+        presence_penalty=0.6, frequency_penalty=0.2,
+    )
+
+    base = make_engine(cfg, params, spec_tokens=0)
+    b1 = base.submit(rep, sampling=plain_sp, session_id="p1")
+    b2 = base.submit(list(rep), sampling=pen_sp, session_id="p2")
+    base.run_until_idle()
+
+    eng = make_engine(cfg, params, spec_tokens=4)
+    g1 = eng.submit(rep, sampling=plain_sp, session_id="p1")
+    g2 = eng.submit(list(rep), sampling=pen_sp, session_id="p2")
+    eng.run_until_idle()
+
+    assert g1.new_tokens == b1.new_tokens
+    assert g2.new_tokens == b2.new_tokens
+    st = eng.stats()
+    assert st["spec_rounds"] > 0
+    assert st["spec_rows_sequential"] > 0
