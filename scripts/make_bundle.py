@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Build the staged-update bundle ``server/updater.py`` consumes
+(reference: .github/workflows/release.yml packaging the autoUpdate.ts
+bundle — tar.gz + sha256 manifest).
+
+Layout of ``room-tpu-update-<version>.tar.gz``::
+
+    version.json          {"version": V, "checksums": {rel: sha256}}
+    room_tpu/**           the package tree (py only)
+    ui/**                 dashboard bundle
+    bench.py              driver benchmark entry
+
+The updater downloads it, extracts to a scratch dir, verifies every
+checksum, atomically renames into the staging dir, and promotes on the
+next update-restart (updater.py:248-305). CI builds this on tag; the
+round-trip is pinned by tests/test_updater.py.
+
+Usage: python scripts/make_bundle.py [--version 1.2.3] [--out dist/]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tarfile
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+INCLUDE_TREES = ("room_tpu", "ui")
+INCLUDE_FILES = ("bench.py",)
+EXCLUDE_DIRS = {"__pycache__", ".pytest_cache"}
+EXCLUDE_SUFFIXES = (".pyc", ".so", ".o")
+
+
+def bundle_files() -> list[str]:
+    """Repo-relative paths that ship in the bundle, sorted for a
+    deterministic manifest."""
+    out: list[str] = []
+    for tree in INCLUDE_TREES:
+        for root, dirs, files in os.walk(os.path.join(REPO, tree)):
+            dirs[:] = sorted(d for d in dirs if d not in EXCLUDE_DIRS)
+            for f in sorted(files):
+                if f.endswith(EXCLUDE_SUFFIXES):
+                    continue
+                out.append(
+                    os.path.relpath(os.path.join(root, f), REPO)
+                )
+    for f in INCLUDE_FILES:
+        if os.path.exists(os.path.join(REPO, f)):
+            out.append(f)
+    return sorted(out)
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(65536), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def build_bundle(version: str, out_dir: str) -> str:
+    """Write room-tpu-update-<version>.tar.gz and return its path."""
+    files = bundle_files()
+    checksums = {
+        rel: sha256_file(os.path.join(REPO, rel)) for rel in files
+    }
+    manifest = {"version": version, "checksums": checksums}
+
+    os.makedirs(out_dir, exist_ok=True)
+    bundle = os.path.join(
+        out_dir, f"room-tpu-update-{version}.tar.gz"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        vf = os.path.join(td, "version.json")
+        with open(vf, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        with tarfile.open(bundle, "w:gz") as tf:
+            tf.add(vf, arcname="version.json")
+            for rel in files:
+                tf.add(os.path.join(REPO, rel), arcname=rel)
+    return bundle
+
+
+def main(argv: list[str] | None = None) -> int:
+    sys.path.insert(0, REPO)
+    from room_tpu import __version__
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--version", default=__version__)
+    ap.add_argument("--out", default=os.path.join(REPO, "dist"))
+    args = ap.parse_args(argv)
+
+    bundle = build_bundle(args.version.lstrip("v"), args.out)
+    sha = sha256_file(bundle)
+    print(json.dumps({
+        "bundle": bundle,
+        "sha256": sha,
+        "bytes": os.path.getsize(bundle),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

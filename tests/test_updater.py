@@ -416,3 +416,77 @@ def test_cli_uninstall_requires_confirmation(tmp_path, monkeypatch,
     assert (tmp_path / "d").exists()
     assert main(["uninstall", "--yes"]) == 0
     assert not (tmp_path / "d").exists()
+
+
+# ---- release pipeline: the bundle CI actually builds round-trips ----
+
+def test_built_bundle_round_trips_check_stage_promote(
+    tmp_path, monkeypatch
+):
+    """scripts/make_bundle.py output (the artifact release.yml attaches
+    to a tag) must round-trip through the updater's own
+    check -> download -> checksum-verify -> stage -> promote path
+    (VERDICT r2 #9: nothing in-tree produced the bundle the updater
+    consumes)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from scripts.make_bundle import build_bundle, sha256_file
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "data"))
+    version = "99.1.0"
+    bundle_path = build_bundle(version, str(tmp_path / "dist"))
+    assert os.path.basename(bundle_path) == \
+        f"room-tpu-update-{version}.tar.gz"
+    with open(bundle_path, "rb") as f:
+        bundle_bytes = f.read()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/release.json":
+                body = json.dumps({
+                    "version": version,
+                    "updateBundleUrl":
+                        f"http://127.0.0.1:{srv.server_address[1]}"
+                        "/bundle.tar.gz",
+                    "releaseUrl": "http://example/release",
+                }).encode()
+            else:
+                body = bundle_bytes
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        monkeypatch.setenv(
+            "ROOM_TPU_UPDATE_SOURCE_URL",
+            f"http://127.0.0.1:{srv.server_address[1]}/release.json",
+        )
+        checker = UpdateChecker()
+        checker.force_check()
+        assert checker.auto_status == {
+            "state": "ready", "version": version,
+        }, checker.auto_status
+        assert get_ready_update_version() == version
+
+        assert promote_staged_update() == version
+        app = updater.app_dir()
+        # the promoted tree is the real package: version manifest +
+        # every checksummed file present and intact
+        with open(os.path.join(app, "version.json")) as f:
+            manifest = json.load(f)
+        assert manifest["version"] == version
+        assert "room_tpu/serving/engine.py" in manifest["checksums"]
+        assert "ui/panels.js" in manifest["checksums"]
+        assert "bench.py" in manifest["checksums"]
+        for rel, want in manifest["checksums"].items():
+            assert sha256_file(os.path.join(app, rel)) == want, rel
+    finally:
+        srv.shutdown()
+        srv.server_close()
