@@ -63,6 +63,12 @@ def create_room(
             "UPDATE rooms SET queen_worker_id=? WHERE id=?",
             (queen_id, room_id),
         )
+        # workers.is_default mirrors queen_worker_id so list consumers
+        # (dashboard swarm cards/graph, MCP worker_list) can spot the
+        # queen without a rooms join
+        db.execute(
+            "UPDATE workers SET is_default=1 WHERE id=?", (queen_id,)
+        )
         if goal:
             goals_mod.set_room_objective(db, room_id, goal)
         if create_wallet:

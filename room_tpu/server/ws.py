@@ -10,6 +10,8 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
+import queue
+import socket
 import struct
 import threading
 import urllib.parse
@@ -43,41 +45,84 @@ def _encode_frame(opcode: int, payload: bytes) -> bytes:
 
 
 class _Client:
+    """One connected socket. All writes ride a bounded queue drained by
+    a dedicated writer thread: the event bus fans out on EMITTER
+    threads (agent loop, runtime, engine), so a stalled browser must
+    cost one dropped client, never a blocked emitter. A full queue
+    (consumer not draining ~512 frames behind) kills the client —
+    backpressure by disconnection, the same contract the reference's
+    ws library applies."""
+
+    MAX_QUEUE = 512
+
     def __init__(self, sock) -> None:
         self.sock = sock
         self.channels: set[str] = set()
         self.alive = True
-        self._send_lock = threading.Lock()
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
+            self.MAX_QUEUE
+        )
+        threading.Thread(
+            target=self._drain, daemon=True, name="ws-writer"
+        ).start()
 
-    def send_text(self, text: str) -> bool:
-        try:
-            with self._send_lock:
-                self.sock.sendall(_encode_frame(0x1, text.encode()))
-            return True
-        except OSError:
-            self.alive = False
-            return False
-
-    def ping(self) -> bool:
-        try:
-            with self._send_lock:
-                self.sock.sendall(_encode_frame(0x9, b""))
-            return True
-        except OSError:
-            self.alive = False
-            return False
-
-    def close(self) -> None:
-        try:
-            with self._send_lock:
-                self.sock.sendall(_encode_frame(0x8, b""))
-        except OSError:
-            pass
+    def _drain(self) -> None:
+        while True:
+            frame = self._q.get()
+            if frame is None:
+                break
+            try:
+                self.sock.sendall(frame)
+            except OSError:
+                break
         self.alive = False
         try:
             self.sock.close()
         except OSError:
             pass
+
+    def _enqueue(self, frame: bytes) -> bool:
+        if not self.alive:
+            return False
+        try:
+            self._q.put_nowait(frame)
+            return True
+        except queue.Full:
+            # slow consumer: shutdown() only — it unblocks a writer
+            # stuck mid-sendall on a full TCP buffer, and unlike
+            # close() it cannot race the writer into a reused fd. The
+            # writer's sendall then errors and _drain (the fd's sole
+            # owner) closes the socket.
+            self.alive = False
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return False
+
+    def send_text(self, text: str) -> bool:
+        return self._enqueue(_encode_frame(0x1, text.encode()))
+
+    def ping(self) -> bool:
+        return self._enqueue(_encode_frame(0x9, b""))
+
+    def pong(self, payload: bytes) -> bool:
+        return self._enqueue(_encode_frame(0xA, payload))
+
+    def close(self) -> None:
+        # alive goes False FIRST so no data frame can land behind the
+        # close frame (RFC 6455: nothing follows Close)
+        self.alive = False
+        try:
+            self._q.put_nowait(_encode_frame(0x8, b""))
+            self._q.put_nowait(None)   # writer flushes, then closes
+        except queue.Full:
+            # writer is wedged behind a full queue: unblock it; its
+            # sendall error ends _drain, which closes the fd
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 class WebSocketHub:
@@ -145,7 +190,10 @@ class WebSocketHub:
             with self._lock:
                 if client in self._clients:
                     self._clients.remove(client)
-            client.alive = False
+            # full close (sentinel included) — a TCP EOF with no WS
+            # close frame must still end the writer thread, or every
+            # dropped tab leaks one blocked thread
+            client.close()
         handler.close_connection = True
 
     def _reader_loop(self, client: _Client, handler) -> None:
@@ -159,10 +207,7 @@ class WebSocketHub:
                 client.close()
                 return
             if opcode == 0x9:        # ping -> pong
-                try:
-                    with client._send_lock:
-                        client.sock.sendall(_encode_frame(0xA, payload))
-                except OSError:
+                if not client.pong(payload):
                     return
                 continue
             if opcode == 0xA:        # pong
@@ -221,9 +266,16 @@ class WebSocketHub:
         })
         with self._lock:
             clients = list(self._clients)
+        dead = []
         for c in clients:
             if event.channel in c.channels or "*" in c.channels:
-                c.send_text(text)
+                if not c.send_text(text):
+                    dead.append(c)
+        if dead:
+            with self._lock:
+                for c in dead:
+                    if c in self._clients:
+                        self._clients.remove(c)
 
     def _heartbeat(self) -> None:
         while not self._stop.wait(timeout=HEARTBEAT_S):

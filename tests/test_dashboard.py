@@ -274,3 +274,75 @@ def test_every_panel_call_resolves(server):
         assert 200 <= status < 300, (
             f"{method} {path} -> {status} (panel/API drift)"
         )
+
+
+def test_panel_payload_shapes(server):
+    """Beyond 2xx: the exact fields the panels RENDER exist in the
+    responses (VERDICT r2 #7 — the drift test must catch a renamed
+    column, not just a dead route). Field lists mirror panels.js
+    render functions."""
+    from room_tpu.core import (
+        escalations as esc_mod, goals as goals_mod,
+        memory as memory_mod, quorum as quorum_mod,
+        rooms as rooms_mod, skills as skills_mod, task_runner,
+    )
+
+    db = server.db
+    room = rooms_mod.create_room(db, "shapes", worker_model="echo")
+    rid = room["id"]
+    goals_mod.create_goal(db, rid, "a goal")
+    task_runner.create_task(db, "t", "do", trigger_type="manual")
+    memory_mod.remember(db, "shape-fact", "fact body")
+    skills_mod.create_skill(db, "s", "how-to")
+    quorum_mod.announce(db, rid, None, "proposal text")
+    esc_mod.create_escalation(db, rid, "question?")
+
+    def get(path):
+        status, _, body = fetch(server, path, token=True)
+        assert status == 200, (path, status, body)
+        return json.loads(body)["data"]
+
+    # app.js statusline
+    st = get("/api/status")
+    assert {"version", "platform", "devices", "activeRooms"} <= set(st)
+
+    # renderSwarm / renderRooms: r.id/name/launched; workers feed
+    # swarmCard: id/name/role/room_id/is_default
+    rooms = get("/api/rooms")
+    assert rooms and {"id", "name", "launched"} <= set(rooms[0])
+    workers = get(f"/api/rooms/{rid}/workers")
+    assert workers and \
+        {"id", "name", "role", "room_id", "is_default"} <= \
+        set(workers[0])
+    # the queen carries is_default so the swarm graph can hub on her
+    assert any(w["is_default"] for w in workers)
+
+    # renderTasks: id/name/prompt/trigger_type/run_count/status
+    tasks = get("/api/tasks")
+    assert tasks and {
+        "id", "name", "prompt", "trigger_type", "run_count",
+        "status",
+    } <= set(tasks[0])
+
+    # renderSkills: id/name/content
+    skills = get("/api/skills")
+    assert skills and {"id", "name", "content"} <= set(skills[0])
+
+    # memSearch: entity_id/name/observations/category/score
+    mem = get("/api/memory/search?q=fact")
+    assert mem and {
+        "entity_id", "name", "observations", "category", "score",
+    } <= set(mem[0])
+
+    # renderVotes: id/proposal/status/created_at
+    ds = get(f"/api/rooms/{rid}/decisions")
+    assert ds and {"id", "proposal", "status", "created_at"} <= \
+        set(ds[0])
+
+    # renderGoals tree: id/description/status
+    goals = get(f"/api/rooms/{rid}/goals")
+    assert goals and {"id", "description", "status"} <= set(goals[0])
+
+    # renderInbox escalations: id/question/status
+    escs = get("/api/escalations")
+    assert escs and {"id", "question", "status"} <= set(escs[0])

@@ -115,3 +115,47 @@ def test_client_disconnect_does_not_break_fanout(server):
     event_bus.emit("run:created", "tasks", {"run_id": 4})
     assert b.recv_json()["data"] == {"run_id": 4}
     b.close()
+
+
+def test_reconnect_resubscribes_and_receives(server):
+    """A dropped client that reconnects (the dashboard's 3s retry)
+    gets a clean slate: new subscribe, events flow again."""
+    ws1 = WsClient(server.port, server.tokens["user"])
+    ws1.send_json({"type": "subscribe", "channel": "tasks"})
+    assert ws1.recv_json()["type"] == "subscribed"
+    ws1.close()
+    time.sleep(0.1)
+
+    ws2 = WsClient(server.port, server.tokens["user"])
+    ws2.send_json({"type": "subscribe", "channel": "tasks"})
+    assert ws2.recv_json()["type"] == "subscribed"
+    event_bus.emit("run:created", "tasks", {"run_id": 1})
+    got = ws2.recv_json()
+    assert got["type"] == "run:created"
+    ws2.close()
+
+
+def test_slow_consumer_never_blocks_emitters(server):
+    """Backpressure contract: a client that stops reading must not
+    stall the event bus (fan-out runs on agent-loop/runtime threads).
+    The hub queues a bounded number of frames, then drops the client;
+    emitting thousands of events stays fast throughout."""
+    ws = WsClient(server.port, server.tokens["user"])
+    ws.send_json({"type": "subscribe", "channel": "*"})
+    assert ws.recv_json()["type"] == "subscribed"
+    # stop reading entirely; flood with frames big enough to fill the
+    # socket buffer plus the bounded queue
+    blob = "x" * 4096
+    t0 = time.monotonic()
+    for i in range(2000):
+        event_bus.emit("cycle:log", "flood", {"seq": i, "blob": blob})
+    elapsed = time.monotonic() - t0
+    # sendall on a full TCP buffer would hang for the whole default
+    # socket timeout; the queue bound must keep emit() near-instant
+    assert elapsed < 10.0, f"emitters blocked for {elapsed:.1f}s"
+    # the stalled client was disconnected rather than serviced forever
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and server.ws_hub.client_count:
+        event_bus.emit("cycle:log", "flood", {"seq": -1, "blob": blob})
+        time.sleep(0.05)
+    assert server.ws_hub.client_count == 0

@@ -158,4 +158,58 @@ async function boot() {
   showView(currentView in PANELS ? currentView : "swarm");
   connectWs();
   setInterval(refreshView, 20000);
+  // first run, nothing configured yet: open the guided walkthrough
+  if (!localStorage.getItem("room_tpu_tour_done") &&
+      !(st.data.activeRooms > 0) && typeof tourStart === "function") {
+    tourStart();
+  }
+}
+
+// ---- dialog layer (reference: the SPA's ConfirmDialog/PromptDialog
+// modal system — destructive actions must never fire on a stray
+// click, and inputs should not ride window.prompt) ----
+
+function _dialog({text, input, placeholder, okLabel}) {
+  return new Promise((resolve) => {
+    const wrap = document.createElement("div");
+    wrap.className = "dialog-backdrop";
+    wrap.innerHTML = `
+      <div class="dialog panel" role="dialog" aria-modal="true">
+        <div class="dialog-text">${esc(text)}</div>
+        ${input ? `<input id="dialogInput"
+          placeholder="${esc(placeholder || "")}"
+          style="width:100%;margin:.5rem 0">` : ""}
+        <div class="row" style="justify-content:flex-end">
+          <button class="ghost" id="dialogCancel">cancel</button>
+          <button class="act" id="dialogOk">
+            ${esc(okLabel || "ok")}</button>
+        </div>
+      </div>`;
+    document.body.appendChild(wrap);
+    const done = (val) => { wrap.remove(); resolve(val); };
+    wrap.querySelector("#dialogCancel").onclick =
+      () => done(input ? null : false);
+    wrap.querySelector("#dialogOk").onclick = () => done(
+      input ? wrap.querySelector("#dialogInput").value : true);
+    wrap.onclick = (e) => {
+      if (e.target === wrap) done(input ? null : false);
+    };
+    wrap.addEventListener("keydown", (e) => {
+      if (e.key === "Escape") done(input ? null : false);
+      if (e.key === "Enter" && input) {
+        done(wrap.querySelector("#dialogInput").value);
+      }
+    });
+    const inp = wrap.querySelector("#dialogInput");
+    if (inp) inp.focus();
+    else wrap.querySelector("#dialogOk").focus();
+  });
+}
+
+function confirmDialog(text, okLabel) {
+  return _dialog({text, okLabel: okLabel || "confirm"});
+}
+
+function promptDialog(text, placeholder) {
+  return _dialog({text, input: true, placeholder});
 }

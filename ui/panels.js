@@ -52,7 +52,12 @@ wsHandlers.swarm = (msg) => {
 
 async function renderSwarm(el) {
   el.innerHTML = `
-    <div class="panel"><h2>swarm</h2>
+    <div class="panel"><h2>swarm
+      <button class="${swarmState.tab !== "graph" ? "act" : "ghost"}"
+        onclick="swarmShowTab('cards')">cards</button>
+      <button class="${swarmState.tab === "graph" ? "act" : "ghost"}"
+        onclick="swarmShowTab('graph')">graph</button>
+    </h2>
       <div class="dim" id="swarmSummary">loading…</div>
       <div id="swarmRooms" style="margin-top:.6rem"></div>
     </div>
@@ -91,9 +96,15 @@ async function swarmRoomAction(roomId, action) {
   showView("swarm");
 }
 
+function swarmShowTab(tab) {
+  swarmState.tab = tab;
+  showView("swarm");
+}
+
 function renderSwarmCards() {
   const grid = $("swarmRooms");
   if (!grid) return;
+  if (swarmState.tab === "graph") { renderSwarmGraph(grid); return; }
   const workers = swarmState.workers || [];
   const rooms = swarmState.rooms || [];
   grid.innerHTML = rooms.map(r => {
@@ -142,6 +153,68 @@ function swarmCard(w) {
       `[${esc(l.entry_type)}] ${esc(String(l.content).slice(0, 160))}`
     ).join("\n") || esc(card.last || "")}</div>
   </div>`;
+}
+
+function renderSwarmGraph(grid) {
+  // live graph view (reference: SwarmPanel.tsx's node/edge viz over
+  // useSwarmEvents): queen at the hub, workers on a ring, edges light
+  // up while a worker is mid-cycle
+  const workers = swarmState.workers || [];
+  const rooms = swarmState.rooms || [];
+  grid.innerHTML = rooms.map(r => {
+    const team = workers.filter(w => w.room_id === r.id);
+    if (!team.length) return "";
+    const queen = team.find(w => w.is_default) || team[0];
+    const rest = team.filter(w => w !== queen);
+    const W = 460, H = Math.max(240, 120 + rest.length * 26);
+    const cx = W / 2, cy = H / 2;
+    const rad = Math.min(cx, cy) - 52;
+    const pos = {};
+    pos[queen.id] = [cx, cy];
+    rest.forEach((w, i) => {
+      const a = (2 * Math.PI * i) / Math.max(rest.length, 1)
+        - Math.PI / 2;
+      pos[w.id] = [cx + rad * Math.cos(a), cy + rad * Math.sin(a)];
+    });
+    const edge = (w) => {
+      const card = swarmState.cards[w.id] || {};
+      const [x1, y1] = pos[queen.id], [x2, y2] = pos[w.id];
+      return `<line class="swarm-graph-edge
+        ${card.status === "cycling" ? "cycling" : ""}"
+        x1="${x1}" y1="${y1}" x2="${x2}" y2="${y2}"/>`;
+    };
+    const node = (w) => {
+      const card = swarmState.cards[w.id] || {};
+      const [x, y] = pos[w.id];
+      const cls = card.status === "cycling" ? "cycling"
+        : card.status === "err" ? "err" : "";
+      const sub = card.status || w.agent_state || "idle";
+      return `<g class="swarm-graph-node ${cls}"
+          onclick="swarmFocus(${w.id})">
+        <circle cx="${x}" cy="${y}" r="${w === queen ? 26 : 20}"/>
+        <text x="${x}" y="${y - 2}">${esc(w.name.slice(0, 10))}
+          ${w === queen ? "👑" : ""}</text>
+        <text x="${x}" y="${y + 12}" class="dim"
+          style="font-size:9px;fill:var(--dim)">
+          ${esc(String(sub).slice(0, 12))}</text>
+      </g>`;
+    };
+    return `<div style="margin-bottom:.8rem">
+      <div class="row" style="align-items:center;margin:.2rem 0">
+        <b>${esc(r.name)}</b>
+        <span class="pill ${r.launched ? "running" : "stopped"}">
+          ${r.launched ? "running" : "stopped"}</span>
+      </div>
+      <svg width="${W}" height="${H}"
+        viewBox="0 0 ${W} ${H}" style="max-width:100%">
+        ${rest.map(edge).join("")}
+        ${rest.map(node).join("")}
+        ${node(queen)}
+      </svg></div>`;
+  }).join("") ||
+    '<div class="dim">no workers yet — create a room first</div>';
+  renderSwarmConsole();
+  renderEventFeed();
 }
 
 function renderSwarmConsole() {
@@ -390,6 +463,9 @@ async function credAdd(id) {
 }
 
 async function credDelete(id, name) {
+  if (!await confirmDialog(`delete credential "${name}"?`, "delete")) {
+    return;
+  }
   await api("DELETE",
     `/api/rooms/${id}/credentials/${encodeURIComponent(name)}`);
   selectRoom(id);
@@ -501,7 +577,7 @@ async function renderTasks(el) {
     ${(out.data || []).map(t => `
       <tr><td>#${t.id} ${esc(t.name)}
         <div class="dim" style="font-size:.82em">
-          ${esc((t.instructions || "").slice(0, 110))}</div></td>
+          ${esc((t.prompt || "").slice(0, 110))}</div></td>
       <td>${esc(t.cron_expression || t.trigger_type)}</td>
       <td><a href="#" onclick="showRuns(${t.id});return false">
         ${t.run_count}</a></td>
@@ -565,11 +641,14 @@ async function memSearch() {
     "/api/memory/search?q=" + encodeURIComponent(q || ""));
   $("memResults").innerHTML = `<table>
     ${(out.data || []).map(m => `
-      <tr><td>${esc(m.content)}
+      <tr><td><b>${esc(m.name)}</b>
+        ${esc((m.observations || []).join(" · ").slice(0, 220))}
         <div class="dim" style="font-size:.8em">
-          ${esc(m.category || "")} · ${esc(when(m.created_at))}</div></td>
+          ${esc(m.category || "")} · score ` +
+          `${Number(m.score || 0).toFixed(4)}</div></td>
       <td style="width:4rem">
-        <button class="ghost" onclick="memDelete(${m.id})">forget</button>
+        <button class="ghost"
+          onclick="memDelete(${m.entity_id})">forget</button>
       </td></tr>`).join("")}
   </table>` || '<div class="dim">nothing stored yet</div>';
 }
@@ -585,6 +664,7 @@ async function memAdd() {
 }
 
 async function memDelete(id) {
+  if (!await confirmDialog(`delete memory #${id}?`, "delete")) return;
   await api("DELETE", `/api/memory/${id}`);
   memSearch();
 }
@@ -618,6 +698,7 @@ async function skillAdd() {
 }
 
 async function skillDelete(id) {
+  if (!await confirmDialog(`delete skill #${id}?`, "delete")) return;
   await api("DELETE", `/api/skills/${id}`);
   refreshView();
 }
@@ -805,6 +886,8 @@ async function renderClerk(el) {
 }
 
 async function clerkReset() {
+  if (!await confirmDialog(
+    "reset the clerk conversation?", "reset")) return;
   await api("POST", "/api/clerk/reset", {});
   refreshView();
 }
@@ -1086,12 +1169,16 @@ async function updateCheck() {
 }
 
 async function updateRestart() {
+  if (!await confirmDialog(
+    "apply the staged update and restart the server?",
+    "update + restart")) return;
   // localhost-only pre-auth endpoint (no bearer token needed)
   await fetch("/api/server/update-restart", {method: "POST"});
   toast("applying update and restarting…");
 }
 
 async function serverRestart() {
+  if (!await confirmDialog("restart the server?", "restart")) return;
   await fetch("/api/server/restart", {method: "POST"});
   toast("restarting…");
 }
@@ -1105,11 +1192,14 @@ async function watchAdd() {
 }
 
 async function watchDelete(id) {
+  if (!await confirmDialog(`delete watch #${id}?`, "delete")) return;
   await api("DELETE", `/api/watches/${id}`);
   refreshView();
 }
 
 async function selfmodRevert(id) {
+  if (!await confirmDialog(
+    `revert self-modification #${id}?`, "revert")) return;
   await api("POST", `/api/self-mod/${id}/revert`, {});
   refreshView();
 }
@@ -1304,7 +1394,8 @@ async function goalAddTo(roomId) {
 }
 
 async function goalNote(goalId) {
-  const update = prompt("progress note for goal #" + goalId);
+  const update = await promptDialog(
+    "progress note for goal #" + goalId);
   if (!update) return;
   await api("POST", `/api/goals/${goalId}/updates`, {update});
   refreshView();
@@ -1390,7 +1481,7 @@ async function msgReadAll() {
 }
 
 async function msgReply(id) {
-  const body = prompt("reply to message #" + id);
+  const body = await promptDialog("reply to message #" + id);
   if (!body) return;
   await api("POST", `/api/messages/${id}/reply`, {body});
   loadMessages();
@@ -1743,6 +1834,110 @@ async function relAdd() {
   refreshView();
 }
 
+// ---- help + guided walkthrough (reference: HelpPanel.tsx,
+// RoomSetupGuideModal.tsx / ClerkSetupGuide.tsx step flows) ----
+
+const TOUR_STEPS = [
+  {view: "setup", text: "Welcome! This wizard creates your first " +
+    "room: a queen plus a worker team with a shared goal. Pick a " +
+    "template or describe the mission."},
+  {view: "providers", text: "Connect a model provider. Local TPU " +
+    "serving needs no login; claude:/codex: drive the CLIs; API " +
+    "providers take a key."},
+  {view: "tpu", text: "Provision the TPU model host here — the " +
+    "hardware gate checks devices, HBM fit (with an int8 fallback " +
+    "plan) and weights before loading."},
+  {view: "rooms", text: "Start the room. The runtime loop wakes " +
+    "workers on a cadence; quorum votes gate irreversible actions."},
+  {view: "swarm", text: "Watch the swarm live — cards or the graph " +
+    "view. Click a worker for its streaming cycle console."},
+  {view: "clerk", text: "The clerk is your concierge: chat here to " +
+    "steer rooms, or wire email/Telegram in settings for digests. " +
+    "That's the loop — enjoy!"},
+];
+
+let tourIdx = -1;
+
+function tourShow() {
+  let box = $("tourBox");
+  if (tourIdx < 0 || tourIdx >= TOUR_STEPS.length) {
+    if (box) box.remove();
+    if (tourIdx >= TOUR_STEPS.length) {
+      localStorage.setItem("room_tpu_tour_done", "1");
+    }
+    return;
+  }
+  const step = TOUR_STEPS[tourIdx];
+  if (currentView !== step.view) showView(step.view);
+  if (!box) {
+    box = document.createElement("div");
+    box.id = "tourBox";
+    box.className = "panel tour-box";
+    document.body.appendChild(box);
+  }
+  box.innerHTML = `
+    <div class="dim">setup guide · step ${tourIdx + 1}/` +
+    `${TOUR_STEPS.length}</div>
+    <div style="margin:.4rem 0">${esc(step.text)}</div>
+    <div class="row" style="justify-content:flex-end">
+      <button class="ghost" onclick="tourEnd()">skip</button>
+      ${tourIdx > 0 ? `<button class="ghost"
+        onclick="tourMove(-1)">back</button>` : ""}
+      <button class="act" onclick="tourMove(1)">
+        ${tourIdx === TOUR_STEPS.length - 1 ? "done" : "next"}</button>
+    </div>`;
+}
+
+function tourStart() { tourIdx = 0; tourShow(); }
+function tourMove(d) { tourIdx += d; tourShow(); }
+function tourEnd() {
+  tourIdx = TOUR_STEPS.length;
+  tourShow();
+}
+
+const HELP_SECTIONS = [
+  ["quickstart", "1. setup — create a room from a template or a " +
+   "mission statement.\n2. providers — connect tpu:/claude:/codex:/" +
+   "API models.\n3. rooms — start the room; the runtime wakes " +
+   "workers on a cadence.\n4. swarm — watch cycles live; click a " +
+   "worker for its console.\nRun the guided walkthrough any time " +
+   "with the button above."],
+  ["panels", "swarm: live worker cards + graph, streaming consoles\n" +
+   "rooms: lifecycle, goals, credentials, quorum config, chat\n" +
+   "setup: first-room wizard\nworkers: roster, prompts " +
+   "export/import, manual trigger\ngoals: tree with progress " +
+   "rollup\ntasks/runs: schedules (cron/once/watch) + run history\n" +
+   "inbox: escalations to the keeper + inter-room mail\nvotes: " +
+   "quorum ballots (worker + keeper votes)\nmemory: hybrid search " +
+   "+ entity graph\nskills: reusable playbooks injected into " +
+   "cycles\nwallet/transactions: balances, ERC-8004 identity, " +
+   "signed transfers\ntpu: device gate, model provisioning, " +
+   "capacity planner\ncycles: recent agent cycles with full logs\n" +
+   "usage: per-provider token/cost rollups\nclerk: concierge chat\n" +
+   "system: updates, watches, self-mod audit, invites\nsettings: " +
+   "runtime knobs, provider logins, contacts"],
+  ["keyboard + auth", "The dashboard reads the user token from the " +
+   "localhost handshake automatically; paste it once for remote " +
+   "browsers. Esc closes dialogs; Enter submits prompts."],
+  ["agents", "Queens plan and delegate; workers execute cycles " +
+   "against their goal queue; the clerk narrates and routes " +
+   "keeper questions. Quiet hours, rotation and compression are " +
+   "per-room settings."],
+];
+
+async function renderHelp(el) {
+  el.innerHTML = `
+    <div class="panel"><h2>help
+      <button class="act" onclick="tourStart()">
+        start guided walkthrough</button>
+    </h2></div>
+    ${HELP_SECTIONS.map(([title, body]) => `
+      <div class="panel"><h2>${esc(title)}</h2>
+        <pre style="white-space:pre-wrap;margin:0" class="dim">` +
+        `${esc(body)}</pre>
+      </div>`).join("")}`;
+}
+
 // ---- registry ----
 
 const PANELS = {
@@ -1769,4 +1964,5 @@ const PANELS = {
   feed: {title: "feed", render: renderFeed},
   system: {title: "system", render: renderSystem},
   settings: {title: "settings", render: renderSettings},
+  help: {title: "help", render: renderHelp},
 };
