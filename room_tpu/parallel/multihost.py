@@ -37,10 +37,16 @@ def initialize_multihost(
     coordinator: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    timeout_s: Optional[float] = None,
 ) -> bool:
     """Idempotent `jax.distributed.initialize` from args or env.
     Returns True when multi-process mode is active (False = single
-    process, nothing to do)."""
+    process, nothing to do).
+
+    ``timeout_s`` (env ROOM_TPU_DCN_TIMEOUT_S) bounds the coordinator
+    barrier: a pod launcher whose process 0 never came up must fail
+    fast with a clear error, not hang for JAX's default five minutes
+    (failure-detection contract, SURVEY §5)."""
     coordinator = coordinator or os.environ.get("ROOM_TPU_COORDINATOR")
     if num_processes is None:
         raw = os.environ.get("ROOM_TPU_NUM_PROCESSES")
@@ -48,9 +54,19 @@ def initialize_multihost(
     if process_id is None:
         raw = os.environ.get("ROOM_TPU_PROCESS_ID")
         process_id = int(raw) if raw else None
+    if timeout_s is None:
+        raw = os.environ.get("ROOM_TPU_DCN_TIMEOUT_S")
+        timeout_s = float(raw) if raw else None
 
     if not coordinator or not num_processes or num_processes <= 1:
         return False
+    if process_id is not None and not (
+        0 <= process_id < num_processes
+    ):
+        raise ValueError(
+            f"ROOM_TPU_PROCESS_ID={process_id} outside world size "
+            f"{num_processes}"
+        )
     # probe initialization state WITHOUT jax.process_count(): that
     # would initialize the XLA backend, after which distributed
     # initialize refuses to run
@@ -58,10 +74,14 @@ def initialize_multihost(
 
     if getattr(_dist.global_state, "coordinator_address", None):
         return True  # already initialized
+    kwargs = {}
+    if timeout_s is not None:
+        kwargs["initialization_timeout"] = int(timeout_s)
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id or 0,
+        **kwargs,
     )
     return True
 

@@ -60,3 +60,55 @@ def test_two_process_collectives_and_train_step():
         if "train OK" in line
     }
     assert len(losses) == 1, losses
+
+
+# ---- failure paths (VERDICT r2 #10: multihost failure coverage) ----
+
+def test_single_process_is_noop(monkeypatch):
+    from room_tpu.parallel.multihost import initialize_multihost
+
+    for k in ("ROOM_TPU_COORDINATOR", "ROOM_TPU_NUM_PROCESSES",
+              "ROOM_TPU_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    assert initialize_multihost() is False
+    # world size 1 is also single-process, whatever else is set
+    assert initialize_multihost("127.0.0.1:1", 1, 0) is False
+
+
+def test_rank_outside_world_size_rejected():
+    import pytest as _pytest
+
+    from room_tpu.parallel.multihost import initialize_multihost
+
+    with _pytest.raises(ValueError, match="outside world size"):
+        initialize_multihost("127.0.0.1:1", 2, 5)
+    with _pytest.raises(ValueError, match="outside world size"):
+        initialize_multihost("127.0.0.1:1", 2, -1)
+
+
+def test_unreachable_coordinator_fails_fast():
+    """A worker pointed at a coordinator that never comes up must exit
+    with a clear error within ROOM_TPU_DCN_TIMEOUT_S — not hang for
+    JAX's five-minute default (pod-launch failure detection)."""
+    import time
+
+    port = _free_port()   # nothing listening on it
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "ROOM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+        "ROOM_TPU_NUM_PROCESSES": "2",
+        "ROOM_TPU_PROCESS_ID": "1",   # not 0: rank 0 hosts the service
+        "ROOM_TPU_DCN_TIMEOUT_S": "5",
+    }
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from room_tpu.parallel.multihost import initialize_multihost;"
+         "initialize_multihost()"],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode != 0
+    assert elapsed < 60, f"init hung {elapsed:.0f}s despite timeout"
