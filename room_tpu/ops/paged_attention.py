@@ -533,6 +533,199 @@ def paged_attention_prefill(
     )(tables, lengths, q, k_flat, v_flat)
 
 
+def _prefill_kernel_int8(
+    # scalar prefetch
+    tables_ref,      # [B, max_pages] SMEM
+    lengths_ref,     # [B] SMEM (prefix length BEFORE this chunk)
+    # inputs
+    q_ref,           # [1, QB, Hq, D] VMEM
+    k_pages_hbm,     # [P, page*Hkv, D] int8 ANY/HBM
+    v_pages_hbm,     # [P, page*Hkv, D] int8 ANY/HBM
+    k_scale_hbm,     # [P, page*Hkv, 1] f32 ANY/HBM
+    v_scale_hbm,     # [P, page*Hkv, 1] f32 ANY/HBM
+    # output
+    o_ref,           # [1, QB, Hq, D] VMEM
+    # scratch
+    k_buf,           # [2, page*Hkv, D] int8 VMEM
+    v_buf,
+    ks_buf,          # [2, page*Hkv, 1] f32 VMEM
+    vs_buf,
+    acc_ref, m_ref, l_ref,
+    sems,            # DMA sems [2, 4]
+    *,
+    page_size: int,
+    n_kv_heads: int,
+    scale: float,
+):
+    """int8-KV variant of _prefill_kernel: same ragged chunked-prefill
+    walk (O(actual context) page traffic per query block) with the
+    int8 decode kernel's dequant posture — int8 page tiles at half the
+    DMA bytes plus [rows, 1] f32 scale tiles broadcast over lanes."""
+    b = pl.program_id(0)
+    qb = pl.program_id(1)
+
+    length = lengths_ref[b]
+    _, qblk, hq, d = q_ref.shape
+    hkv = n_kv_heads
+    group = hq // hkv
+    rows = page_size * hkv
+    qrows = qblk * hq
+
+    hi_pos = length + (qb + 1) * qblk - 1
+    n_pages = jax.lax.div(hi_pos, page_size) + 1
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def start_fetch(i, slot):
+        page_id = tables_ref[b, i]
+        for src, dst, sem in (
+            (k_pages_hbm, k_buf, 0), (v_pages_hbm, v_buf, 1),
+            (k_scale_hbm, ks_buf, 2), (v_scale_hbm, vs_buf, 3),
+        ):
+            pltpu.make_async_copy(
+                src.at[page_id], dst.at[slot], sems.at[slot, sem]
+            ).start()
+
+    def wait_fetch(i, slot):
+        page_id = tables_ref[b, i]
+        for src, dst, sem in (
+            (k_pages_hbm, k_buf, 0), (v_pages_hbm, v_buf, 1),
+            (k_scale_hbm, ks_buf, 2), (v_scale_hbm, vs_buf, 3),
+        ):
+            pltpu.make_async_copy(
+                src.at[page_id], dst.at[slot], sems.at[slot, sem]
+            ).wait()
+
+    start_fetch(0, 0)
+
+    q = q_ref[0].astype(jnp.float32).reshape(qrows, d) * scale
+
+    j = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 1)
+    r = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 0)
+    pair_ok = jax.lax.rem(j, hkv) == jax.lax.div(
+        jax.lax.rem(r, hq), group
+    )
+    tok_of_j = jax.lax.div(j, hkv)
+    q_pos = length + qb * qblk + jax.lax.div(r, hq)
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            start_fetch(i + 1, 1 - slot)
+
+        wait_fetch(i, slot)
+        k = k_buf[slot].astype(jnp.float32) * ks_buf[slot]
+        v = v_buf[slot].astype(jnp.float32) * vs_buf[slot]
+
+        logits = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [qrows, rows]
+        kv_pos = i * page_size + tok_of_j
+        valid = pair_ok & (kv_pos <= q_pos)
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(logits, axis=1, keepdims=True)
+        )
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    denom = jnp.maximum(l_ref[:], 1e-30)
+    o_ref[0] = (acc_ref[:] / denom).reshape(qblk, hq, d).astype(
+        o_ref.dtype
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "interpret")
+)
+def paged_attention_prefill_int8(
+    q: jax.Array,          # [B, S, Hq, D]
+    k_pages: jax.Array,    # [P, page, Hkv, D] int8
+    v_pages: jax.Array,    # [P, page, Hkv, D] int8
+    k_scale: jax.Array,    # [P, page, Hkv] f32
+    v_scale: jax.Array,    # [P, page, Hkv] f32
+    tables: jax.Array,     # [B, max_pages] int32
+    lengths: jax.Array,    # [B] int32 prefix length BEFORE the chunk
+    *,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    b, s, hq, d = q.shape
+    p_count, _, hkv, _ = k_pages.shape
+    scale = 1.0 / float(np.sqrt(d))
+    rows = page_size * hkv
+    qblk = PREFILL_Q_BLOCK
+    if s % qblk != 0:
+        raise ValueError(f"S={s} not divisible by {qblk}")
+
+    k_flat = k_pages.reshape(p_count, rows, d)
+    v_flat = v_pages.reshape(p_count, rows, d)
+    ks_flat = k_scale.reshape(p_count, rows, 1)
+    vs_flat = v_scale.reshape(p_count, rows, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, s // qblk),
+        in_specs=[
+            pl.BlockSpec(
+                (1, qblk, hq, d), lambda i, j, *_: (i, j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, qblk, hq, d), lambda i, j, *_: (i, j, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, d), k_pages.dtype),
+            pltpu.VMEM((2, rows, d), v_pages.dtype),
+            pltpu.VMEM((2, rows, 1), jnp.float32),
+            pltpu.VMEM((2, rows, 1), jnp.float32),
+            pltpu.VMEM((qblk * hq, d), jnp.float32),
+            pltpu.VMEM((qblk * hq, 1), jnp.float32),
+            pltpu.VMEM((qblk * hq, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+    )
+
+    kernel = functools.partial(
+        _prefill_kernel_int8,
+        page_size=page_size,
+        n_kv_heads=hkv,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, hq, d), q.dtype),
+        interpret=interpret,
+    )(tables, lengths, q, k_flat, v_flat, ks_flat, vs_flat)
+
+
 @functools.partial(
     jax.jit, static_argnames=("page_size", "interpret")
 )

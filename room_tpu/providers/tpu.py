@@ -180,6 +180,15 @@ class ModelHost:
                 page_size=int(os.environ.get("ROOM_TPU_PAGE_SIZE", "16")),
                 n_pages=int(os.environ.get("ROOM_TPU_N_PAGES", "2048")),
                 mesh=mesh,
+                # speculative decoding ON by default in deployment
+                # (VERDICT r2 #8, from the bench spec_agent A/B: 3.1x
+                # tok/s at gamma=4 with 100% acceptance on tool-call-
+                # repeating agent traffic; a no-draft round falls back
+                # to the chunked scan, so non-repeating traffic pays
+                # nothing). ROOM_TPU_SPEC_TOKENS=0 opts out.
+                spec_tokens=int(
+                    os.environ.get("ROOM_TPU_SPEC_TOKENS", "4")
+                ),
             )
             self._thread = threading.Thread(
                 target=self._engine.serve_forever,

@@ -143,54 +143,90 @@ def pallas_decode_int8_ok(
     )
 
 
-def _probe_decode_int8_kernel(
-    hq: int, hkv: int, d: int, page_size: int
+_PREFILL_INT8_PROBE: dict[tuple, bool] = {}
+
+
+def pallas_prefill_int8_ok(
+    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int
 ) -> bool:
+    """Startup smoke for the int8-KV S>1 prefill kernel (env
+    ROOM_TPU_PREFILL_INT8_KERNEL) — keeps quantized chunked prefill
+    O(actual context) instead of falling back to the dequant gather."""
+    return _probe_gate(
+        "ROOM_TPU_PREFILL_INT8_KERNEL", _PREFILL_INT8_PROBE,
+        _probe_prefill_int8_kernel,
+        n_q_heads, n_kv_heads, head_dim, page_size,
+    )
+
+
+def _probe_pages(
+    seed: int, total: int, hkv: int, d: int, page_size: int,
+    quantize: bool,
+):
+    """Shared probe scaffold: random k/v packed into pool pages (page 0
+    stays scratch, as in production tables), optionally int8-quantized.
+    Returns (page_inputs, tables, kd, vd, q_rng) where page_inputs is
+    the positional tuple the kernel takes after q — (k, v) or
+    (k, v, k_scale, v_scale) — and kd/vd are the [total, hkv, d] bf16
+    references the expected attention is computed over (dequantized
+    when quantize: the quantization error is the cache's, not the
+    kernel's)."""
+    import numpy as np
+
+    npg = -(-total // page_size)
+    rng = np.random.default_rng(seed)
+    # 0.5 scale keeps bf16 softmax rounding inside the probe tolerance
+    k = rng.standard_normal((total, hkv, d)).astype(np.float32) * 0.5
+    v = rng.standard_normal((total, hkv, d)).astype(np.float32) * 0.5
+    pad = npg * page_size - total
+    kpad = np.concatenate([k, np.zeros((pad, hkv, d), np.float32)])
+    vpad = np.concatenate([v, np.zeros((pad, hkv, d), np.float32)])
+    tables = jnp.arange(1, npg + 1, dtype=jnp.int32)[None]
+    if quantize:
+        qk, sk = _quantize_kv(jnp.asarray(kpad))
+        qv, sv = _quantize_kv(jnp.asarray(vpad))
+        k_pages = jnp.zeros(
+            (npg + 1, page_size, hkv, d), jnp.int8
+        ).at[1:].set(qk.reshape(npg, page_size, hkv, d))
+        v_pages = jnp.zeros(
+            (npg + 1, page_size, hkv, d), jnp.int8
+        ).at[1:].set(qv.reshape(npg, page_size, hkv, d))
+        k_scale = jnp.zeros(
+            (npg + 1, page_size, hkv), jnp.float32
+        ).at[1:].set(sk.reshape(npg, page_size, hkv))
+        v_scale = jnp.zeros(
+            (npg + 1, page_size, hkv), jnp.float32
+        ).at[1:].set(sv.reshape(npg, page_size, hkv))
+        kd = (qk.astype(jnp.float32) * sk[..., None])[:total]
+        vd = (qv.astype(jnp.float32) * sv[..., None])[:total]
+        return (
+            (k_pages, v_pages, k_scale, v_scale), tables,
+            kd.astype(jnp.bfloat16), vd.astype(jnp.bfloat16), rng,
+        )
+    k_pages = jnp.zeros(
+        (npg + 1, page_size, hkv, d), jnp.bfloat16
+    ).at[1:].set(jnp.asarray(
+        kpad.reshape(npg, page_size, hkv, d), jnp.bfloat16))
+    v_pages = jnp.zeros(
+        (npg + 1, page_size, hkv, d), jnp.bfloat16
+    ).at[1:].set(jnp.asarray(
+        vpad.reshape(npg, page_size, hkv, d), jnp.bfloat16))
+    return (
+        (k_pages, v_pages), tables,
+        jnp.asarray(k, jnp.bfloat16), jnp.asarray(v, jnp.bfloat16),
+        rng,
+    )
+
+
+def _probe_run(label: str, fn) -> bool:
+    """Shared probe harness: run fn() -> (out, expected); any
+    compile/lowering failure or numerics mismatch means fallback."""
     import logging
 
     import numpy as np
 
-    from ..ops.paged_attention import paged_attention_decode_int8
-
     try:
-        total = 2 * page_size + 3          # ragged tail crosses a page
-        npg = -(-total // page_size)
-        rng = np.random.default_rng(1)
-        k = rng.standard_normal((total, hkv, d)).astype(np.float32)
-        v = rng.standard_normal((total, hkv, d)).astype(np.float32)
-        q = rng.standard_normal((1, hq, d)).astype(np.float32)
-        pad = npg * page_size - total
-        kpad = np.concatenate([k, np.zeros((pad, hkv, d), np.float32)])
-        vpad = np.concatenate([v, np.zeros((pad, hkv, d), np.float32)])
-        qk, sk = _quantize_kv(jnp.asarray(kpad))
-        qv, sv = _quantize_kv(jnp.asarray(vpad))
-        k_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.int8)
-        k_pages = k_pages.at[1:].set(
-            qk.reshape(npg, page_size, hkv, d))
-        v_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.int8)
-        v_pages = v_pages.at[1:].set(
-            qv.reshape(npg, page_size, hkv, d))
-        k_scale = jnp.zeros((npg + 1, page_size, hkv), jnp.float32)
-        k_scale = k_scale.at[1:].set(sk.reshape(npg, page_size, hkv))
-        v_scale = jnp.zeros((npg + 1, page_size, hkv), jnp.float32)
-        v_scale = v_scale.at[1:].set(sv.reshape(npg, page_size, hkv))
-        tables = jnp.arange(1, npg + 1, dtype=jnp.int32)[None]
-        lengths = jnp.full((1,), total, jnp.int32)
-
-        out = paged_attention_decode_int8(
-            jnp.asarray(q, jnp.bfloat16), k_pages, v_pages,
-            k_scale, v_scale, tables, lengths, page_size=page_size,
-        )
-        kd = (qk.astype(jnp.float32) * sk[..., None])[:total]
-        vd = (qv.astype(jnp.float32) * sv[..., None])[:total]
-        expected = attention_ref(
-            jnp.asarray(q, jnp.bfloat16)[:, None],
-            kd[None].astype(jnp.bfloat16),
-            vd[None].astype(jnp.bfloat16),
-            causal=True,
-            q_positions=jnp.full((1, 1), total - 1, jnp.int32),
-            kv_positions=jnp.arange(total)[None],
-        )[:, 0]
+        out, expected = fn()
         ok = bool(np.allclose(
             np.asarray(out, np.float32),
             np.asarray(expected, np.float32),
@@ -198,82 +234,88 @@ def _probe_decode_int8_kernel(
         ))
         if not ok:
             logging.getLogger(__name__).warning(
-                "int8 decode kernel probe: numerics mismatch at "
-                "hq=%d hkv=%d d=%d page=%d; using XLA dequant gather",
-                hq, hkv, d, page_size,
+                "%s kernel probe: numerics mismatch; using XLA "
+                "fallback", label,
             )
         return ok
     except Exception as e:
         logging.getLogger(__name__).warning(
-            "int8 decode kernel probe failed (%s); using XLA dequant "
-            "gather", e,
+            "%s kernel probe failed (%s); using XLA fallback",
+            label, e,
         )
         return False
 
 
-def _probe_prefill_kernel(hq: int, hkv: int, d: int, page_size: int) -> bool:
-    import logging
-
-    import numpy as np
-
+def _probe_prefill_common(
+    hq: int, hkv: int, d: int, page_size: int, quantize: bool
+) -> bool:
     from ..ops.paged_attention import (
         PREFILL_Q_BLOCK, paged_attention_prefill,
+        paged_attention_prefill_int8,
     )
 
-    try:
+    def run():
         s = PREFILL_Q_BLOCK
-        prefix = page_size              # one full page of paged prefix
+        prefix = page_size          # one full page of paged prefix
         total = prefix + s
-        npg = -(-total // page_size)
-        rng = np.random.default_rng(0)
-        k = rng.standard_normal((total, hkv, d)).astype(np.float32) * 0.5
-        v = rng.standard_normal((total, hkv, d)).astype(np.float32) * 0.5
-        q = rng.standard_normal((1, s, hq, d)).astype(np.float32) * 0.5
-        pad = npg * page_size - total
-        kpad = np.concatenate(
-            [k, np.zeros((pad, hkv, d), np.float32)]
-        ).reshape(npg, page_size, hkv, d)
-        vpad = np.concatenate(
-            [v, np.zeros((pad, hkv, d), np.float32)]
-        ).reshape(npg, page_size, hkv, d)
-        # page 0 stays scratch, as in production tables
-        k_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.bfloat16)
-        k_pages = k_pages.at[1:].set(jnp.asarray(kpad, jnp.bfloat16))
-        v_pages = jnp.zeros((npg + 1, page_size, hkv, d), jnp.bfloat16)
-        v_pages = v_pages.at[1:].set(jnp.asarray(vpad, jnp.bfloat16))
-        tables = jnp.arange(1, npg + 1, dtype=jnp.int32)[None]
+        inputs, tables, kd, vd, rng = _probe_pages(
+            3 if quantize else 0, total, hkv, d, page_size, quantize
+        )
+        q = jnp.asarray(
+            rng.standard_normal((1, s, hq, d)) * 0.5, jnp.bfloat16
+        )
         lengths = jnp.full((1,), prefix, jnp.int32)
-
-        out = paged_attention_prefill(
-            jnp.asarray(q, jnp.bfloat16), k_pages, v_pages,
-            tables, lengths, page_size=page_size,
-        )
-        q_pos = prefix + jnp.arange(s)[None]
-        kv_pos = jnp.arange(total)[None]
+        kernel = paged_attention_prefill_int8 if quantize \
+            else paged_attention_prefill
+        out = kernel(q, *inputs, tables, lengths, page_size=page_size)
         expected = attention_ref(
-            jnp.asarray(q, jnp.bfloat16),
-            jnp.asarray(k, jnp.bfloat16)[None],
-            jnp.asarray(v, jnp.bfloat16)[None],
-            causal=True, q_positions=q_pos, kv_positions=kv_pos,
+            q, kd[None], vd[None], causal=True,
+            q_positions=prefix + jnp.arange(s)[None],
+            kv_positions=jnp.arange(total)[None],
         )
-        ok = bool(np.allclose(
-            np.asarray(out, np.float32),
-            np.asarray(expected, np.float32),
-            atol=6e-2,
-        ))
-        if not ok:
-            logging.getLogger(__name__).warning(
-                "pallas prefill kernel probe: numerics mismatch at "
-                "hq=%d hkv=%d d=%d page=%d; using XLA gather",
-                hq, hkv, d, page_size,
-            )
-        return ok
-    except Exception as e:  # compile/lowering failure -> XLA fallback
-        logging.getLogger(__name__).warning(
-            "pallas prefill kernel probe failed (%s); using XLA gather",
-            e,
+        return out, expected
+
+    label = "int8 prefill" if quantize else "pallas prefill"
+    return _probe_run(label, run)
+
+
+def _probe_prefill_kernel(
+    hq: int, hkv: int, d: int, page_size: int
+) -> bool:
+    return _probe_prefill_common(hq, hkv, d, page_size, False)
+
+
+def _probe_prefill_int8_kernel(
+    hq: int, hkv: int, d: int, page_size: int
+) -> bool:
+    return _probe_prefill_common(hq, hkv, d, page_size, True)
+
+
+def _probe_decode_int8_kernel(
+    hq: int, hkv: int, d: int, page_size: int
+) -> bool:
+    from ..ops.paged_attention import paged_attention_decode_int8
+
+    def run():
+        total = 2 * page_size + 3    # ragged tail crosses a page
+        inputs, tables, kd, vd, rng = _probe_pages(
+            1, total, hkv, d, page_size, True
         )
-        return False
+        q = jnp.asarray(
+            rng.standard_normal((1, hq, d)) * 0.5, jnp.bfloat16
+        )
+        lengths = jnp.full((1,), total, jnp.int32)
+        out = paged_attention_decode_int8(
+            q, *inputs, tables, lengths, page_size=page_size
+        )
+        expected = attention_ref(
+            q[:, None], kd[None], vd[None], causal=True,
+            q_positions=jnp.full((1, 1), total - 1, jnp.int32),
+            kv_positions=jnp.arange(total)[None],
+        )[:, 0]
+        return out, expected
+
+    return _probe_run("int8 decode", run)
 
 
 def make_paged_kv_hook(
@@ -381,25 +423,34 @@ def make_paged_kv_hook(
                 )[:, None]
             return attn, out_cache
 
-        if s > 1 and not quantized:
+        if s > 1:
             from ..ops.paged_attention import (
                 PREFILL_Q_BLOCK, paged_attention_prefill,
+                paged_attention_prefill_int8,
             )
 
             use_prefill = pallas_prefill
             if use_prefill is None and pallas_decode \
                     and s % PREFILL_Q_BLOCK == 0:
-                use_prefill = pallas_prefill_ok(
+                ok_fn = pallas_prefill_int8_ok if quantized \
+                    else pallas_prefill_ok
+                use_prefill = ok_fn(
                     q.shape[2], k.shape[2], k.shape[3], page_size
                 )
             if use_prefill and s % PREFILL_Q_BLOCK == 0:
                 # ragged chunked-prefill kernel: walks each row's own
                 # pages (prefix + the chunk KV written above) — page
                 # traffic scales with actual context, never capacity
-                attn = paged_attention_prefill(
-                    q, kp, vp, block_tables, lengths,
-                    page_size=page_size,
-                )
+                if quantized:
+                    attn = paged_attention_prefill_int8(
+                        q, kp, vp, ks, vs, block_tables, lengths,
+                        page_size=page_size,
+                    )
+                else:
+                    attn = paged_attention_prefill(
+                        q, kp, vp, block_tables, lengths,
+                        page_size=page_size,
+                    )
                 return attn, out_cache
 
         # gather this batch's pages into a dense view (XLA reference path;

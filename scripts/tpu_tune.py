@@ -43,9 +43,12 @@ def main() -> None:
     ap.add_argument("--out", default="")
     args = ap.parse_args()
 
+    from bench import acquire_chip_lock, bench_config
+
+    _chip_lock = acquire_chip_lock()  # noqa: F841 (held till exit)
+
     import jax
 
-    from bench import bench_config
     from room_tpu.models import qwen3
     from room_tpu.serving import SamplingParams, ServingEngine
 
@@ -58,19 +61,26 @@ def main() -> None:
         "batch": ["8", "16"],
         "page": ["32"],
         "quant": ["none", "int8"],
+        "kvq": ["none", "int8"],
     }
     if args.quick:
         default_grid = {"chunk": ["16"], "batch": ["8"],
-                        "page": ["32"], "quant": ["none"]}
+                        "page": ["32"], "quant": ["none"],
+                        "kvq": ["none", "int8"]}
     grid = parse_grid(os.environ.get("ROOM_TPU_TUNE_GRID", "")) or default_grid
 
     from room_tpu.ops.quant import quantize_decoder_params
 
     q_params = None
 
-    def measure(chunk: int, batch: int, page: int, quant: str) -> dict:
+    def measure(chunk: int, batch: int, page: int, quant: str,
+                kvq: str = "none") -> dict:
         nonlocal q_params
         os.environ["ROOM_TPU_DECODE_CHUNK"] = str(chunk)
+        if kvq == "int8":
+            os.environ["ROOM_TPU_KV_QUANT"] = "int8"
+        else:
+            os.environ.pop("ROOM_TPU_KV_QUANT", None)
         p = params
         if quant == "int8":
             if q_params is None:
@@ -93,19 +103,22 @@ def main() -> None:
         dt = time.perf_counter() - t0
         decoded = eng.stats()["tokens_decoded"] - start
         return {"chunk": chunk, "batch": batch, "page": page,
-                "quant": quant, "tok_s": round(decoded / dt, 2),
+                "quant": quant, "kvq": kvq,
+                "tok_s": round(decoded / dt, 2),
                 "decoded": decoded, "dt": round(dt, 2)}
 
     results = []
     combos = list(itertools.product(
         grid.get("chunk", ["16"]), grid.get("batch", ["8"]),
-        grid.get("page", ["32"]), grid.get("quant", ["none"])))
-    for chunk, batch, page, quant in combos:
+        grid.get("page", ["32"]), grid.get("quant", ["none"]),
+        grid.get("kvq", ["none"])))
+    for chunk, batch, page, quant, kvq in combos:
         try:
-            row = measure(int(chunk), int(batch), int(page), quant)
+            row = measure(int(chunk), int(batch), int(page), quant, kvq)
         except Exception as e:  # keep sweeping; record the failure
             row = {"chunk": chunk, "batch": batch, "page": page,
-                   "quant": quant, "error": f"{type(e).__name__}: {e}"[:200]}
+                   "quant": quant, "kvq": kvq,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
         row["platform"] = platform
         results.append(row)
         print(json.dumps(row), flush=True)

@@ -1,19 +1,37 @@
 #!/bin/bash
-# TPU window watcher (round 3): probe the axon tunnel until a green
-# window opens, then immediately run the full bench + autotune sweep so
-# the round records a real hardware number (VERDICT r2 item #1).
+# TPU window watcher: probe the axon tunnel until a green window opens,
+# then immediately run the full bench + autotune sweep so the round
+# records a real hardware number (VERDICT r2 #1).
+#
+# HARD RULE (learned in round 4): exactly ONE process may touch the
+# chip. Three stale watchers probing concurrently — and SIGKILLing
+# their own probes mid-claim on timeout — is itself the documented
+# tunnel-wedge trigger. Exclusivity is a cooperative flock on
+# /tmp/axon_chip.lock shared by every chip entry point: this watcher
+# wraps each probe in it, and bench.py / scripts/tpu_tune.py acquire
+# it themselves (bench.acquire_chip_lock), so the watcher must NOT
+# hold it while invoking them. A separate instance lock stops a second
+# watcher from ever starting.
 #
 # Usage: bash scripts/tpu_watch.sh  (intended to run in the background)
-# Logs:  /tmp/tpu_watch3.log, results in /tmp/bench_r3.json
-LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch3.log}
-PROBE_TIMEOUT=${TPU_PROBE_TIMEOUT:-300}
-COOLDOWN=${TPU_PROBE_COOLDOWN:-480}
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch4.log}
+CHIP_LOCK=${ROOM_TPU_CHIP_LOCK:-/tmp/axon_chip.lock}
+INSTANCE_LOCK=${TPU_WATCH_INSTANCE_LOCK:-/tmp/tpu_watch.instance.lock}
+PROBE_TIMEOUT=${TPU_PROBE_TIMEOUT:-600}
+COOLDOWN=${TPU_PROBE_COOLDOWN:-900}
+OUT=${TPU_BENCH_OUT:-/tmp/bench_r4.json}
 cd "$(dirname "$0")/.." || exit 1
+
+exec 8>"$INSTANCE_LOCK"
+if ! flock -n 8; then
+  echo "another watcher instance is running; refusing to double-probe" >&2
+  exit 1
+fi
 
 while true; do
   ts=$(date -u +%FT%TZ)
-  echo "[$ts] probe start" >>"$LOG"
-  if timeout "$PROBE_TIMEOUT" python -c "
+  echo "[$ts] probe start (timeout ${PROBE_TIMEOUT}s)" >>"$LOG"
+  if flock -w 900 "$CHIP_LOCK" timeout "$PROBE_TIMEOUT" python -c "
 import jax
 d = jax.devices()
 assert d and d[0].platform == 'tpu', d
@@ -23,24 +41,28 @@ print('probe ok:', (x @ x).sum(), d)
 " >>"$LOG" 2>&1; then
     ts=$(date -u +%FT%TZ)
     echo "[$ts] PROBE GREEN - running bench" >>"$LOG"
-    timeout 2100 python bench.py >/tmp/bench_r3.json 2>>"$LOG"
-    cat /tmp/bench_r3.json >>"$LOG"
+    # bench/tune take the chip lock themselves (acquire_chip_lock)
+    timeout 2100 python bench.py >"$OUT" 2>>"$LOG"
+    cat "$OUT" >>"$LOG"
     val=$(python -c "
 import json
 try:
-    print(json.load(open('/tmp/bench_r3.json'))['value'])
+    print(json.load(open('$OUT'))['value'])
 except Exception:
     print(0)
 ")
     if python -c "import sys; sys.exit(0 if float('${val:-0}') > 0 else 1)"; then
       ts=$(date -u +%FT%TZ)
       echo "[$ts] BENCH NONZERO ($val tok/s) - running tune sweep" >>"$LOG"
-      timeout 3600 python scripts/tpu_tune.py --quick --out /tmp/tpu_tune_r3.json \
-        >>"$LOG" 2>&1
+      timeout 3600 python scripts/tpu_tune.py --quick \
+        --out /tmp/tpu_tune_r4.json >>"$LOG" 2>&1
       echo "[$ts] watcher done" >>"$LOG"
       exit 0
     fi
     echo "[$ts] bench returned zero; cooling down" >>"$LOG"
+  else
+    ts=$(date -u +%FT%TZ)
+    echo "[$ts] probe timed out/failed; cooldown ${COOLDOWN}s" >>"$LOG"
   fi
   sleep "$COOLDOWN"
 done

@@ -346,3 +346,17 @@ def test_panel_payload_shapes(server):
     # renderInbox escalations: id/question/status
     escs = get("/api/escalations")
     assert escs and {"id", "question", "status"} <= set(escs[0])
+
+
+def test_tour_steps_reference_real_panels():
+    """Every guided-walkthrough step targets a registered panel, and
+    the help panel itself is registered (the tour switches views by
+    key, so a renamed panel must fail CI, not no-op at runtime)."""
+    js = open(os.path.join(UI_DIR, "panels.js")).read()
+    steps = re.findall(r'\{view: "(\w+)"', js)
+    assert len(steps) >= 5
+    m = re.search(r"const PANELS = \{(.*?)\n\};", js, re.S)
+    assert m, "PANELS registry not found"
+    panels = set(re.findall(r"(\w+): \{title", m.group(1)))
+    assert set(steps) <= panels, set(steps) - panels
+    assert "help" in panels

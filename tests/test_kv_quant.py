@@ -147,3 +147,22 @@ def test_quantized_cache_sharding_specs(setup):
     cache = init_page_cache(cfg, n_pages=16, page_size=8, quant="int8")
     sharded = shard_pytree(cache, specs, mesh)
     assert sharded["k_scale"].shape == cache["k_scale"].shape
+
+
+def test_int8_prefill_kernel_interpret_matches_dequant():
+    """int8 chunked-prefill kernel vs the dequantized dense reference
+    (interpret mode; probe-gated on hardware like its siblings)."""
+    from room_tpu.ops import paged_attention as pa
+    from room_tpu.serving import kv_pages
+
+    real = pa.paged_attention_prefill_int8
+    try:
+        pa.paged_attention_prefill_int8 = (
+            lambda *a, **k: real(*a, **{**k, "interpret": True})
+        )
+        kv_pages._PREFILL_INT8_PROBE.clear()
+        assert kv_pages.pallas_prefill_int8_ok(8, 2, 64, 16) is True
+        assert kv_pages._probe_prefill_int8_kernel(4, 4, 32, 8) is True
+    finally:
+        pa.paged_attention_prefill_int8 = real
+        kv_pages._PREFILL_INT8_PROBE.clear()
