@@ -376,3 +376,109 @@ def test_explicit_zero_overrides_preset(db, room):
     )
     w = workers.get_worker(db, wid)
     assert w["cycle_gap_ms"] == 0 and w["max_turns"] == 0
+
+
+# ---- queen tool dispatcher edges (density toward the reference's
+# tool-surface coverage; ref: queen tool tests in agent-loop.test.ts) ----
+
+class TestQueenToolDispatch:
+    @staticmethod
+    def _room(db):
+        from room_tpu.core import rooms as rooms_mod
+
+        room = rooms_mod.create_room(db, "qt", worker_model="echo",
+                                     create_wallet=False)
+        return room["id"], room["queen_worker_id"]
+
+    def test_cross_room_worker_is_rejected(self, db):
+        from room_tpu.core.queen_tools import execute_queen_tool
+        from room_tpu.core import rooms as rooms_mod
+
+        rid, qid = self._room(db)
+        other = rooms_mod.create_room(db, "other", worker_model="echo",
+                                      create_wallet=False)
+        out = execute_queen_tool(
+            db, rid, qid, "delegate",
+            {"worker_id": other["queen_worker_id"],
+             "description": "steal"},
+        )
+        assert "no worker" in out
+
+    def test_cross_room_goal_is_rejected(self, db):
+        from room_tpu.core import goals as goals_mod
+        from room_tpu.core.queen_tools import execute_queen_tool
+
+        rid, qid = self._room(db)
+        rid2, _ = self._room(db)
+        foreign = goals_mod.create_goal(db, rid2, "theirs")
+        out = execute_queen_tool(
+            db, rid, qid, "complete_goal", {"goal_id": foreign}
+        )
+        assert "no goal" in out
+
+    def test_announce_decision_dedupes_open_proposal(self, db):
+        from room_tpu.core.queen_tools import execute_queen_tool
+
+        rid, qid = self._room(db)
+        first = execute_queen_tool(
+            db, rid, qid, "announce_decision",
+            {"proposal": "buy a tpu", "decision_type": "high_impact"},
+        )
+        again = execute_queen_tool(
+            db, rid, qid, "announce_decision",
+            {"proposal": "buy a tpu", "decision_type": "high_impact"},
+        )
+        assert "already announced" in again
+        assert first.split()[1] == again.split()[1]  # same #id
+
+    def test_unknown_tool_and_bad_args_are_reported_not_raised(self, db):
+        from room_tpu.core.queen_tools import execute_queen_tool
+
+        rid, qid = self._room(db)
+        out = execute_queen_tool(db, rid, qid, "no_such_tool", {})
+        assert "unknown tool" in out or "tool error" in out
+        # missing required arg -> tool error string, never an exception
+        out = execute_queen_tool(db, rid, qid, "set_goal", {})
+        assert out.startswith("tool error")
+
+    def test_update_goal_progress_records_metric(self, db):
+        from room_tpu.core import goals as goals_mod
+        from room_tpu.core.queen_tools import execute_queen_tool
+
+        rid, qid = self._room(db)
+        gid = goals_mod.create_goal(db, rid, "measure me")
+        out = execute_queen_tool(
+            db, rid, qid, "update_goal_progress",
+            {"goal_id": gid, "progress": 0.5, "observation": "half"},
+        )
+        assert "progress=0.5" in out
+        rows = db.query(
+            "SELECT metric_value FROM goal_updates WHERE goal_id=?",
+            (gid,),
+        )
+        assert rows and rows[-1]["metric_value"] == 0.5
+
+    def test_wallet_status_without_wallet(self, db):
+        from room_tpu.core.queen_tools import execute_queen_tool
+
+        rid, qid = self._room(db)
+        assert "no wallet" in execute_queen_tool(
+            db, rid, qid, "wallet_status", {}
+        )
+
+    def test_escalate_emits_event(self, db):
+        from room_tpu.core.events import event_bus
+        from room_tpu.core.queen_tools import execute_queen_tool
+
+        rid, qid = self._room(db)
+        got = []
+        unsub = event_bus.subscribe(f"room:{rid}", got.append)
+        try:
+            out = execute_queen_tool(
+                db, rid, qid, "escalate_to_keeper",
+                {"question": "may I?"},
+            )
+            assert "sent to keeper" in out
+            assert any(e.type == "escalation:created" for e in got)
+        finally:
+            unsub()

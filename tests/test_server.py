@@ -529,3 +529,42 @@ def test_invite_ttl_validation(server, monkeypatch):
         status, _ = req(server, "POST", "/api/invites",
                         {"ttlDays": bad})
         assert status == 400, bad
+
+
+def test_webhook_rate_limit_and_eviction(server):
+    """Pre-auth surface: 30 req/min per token, then 429; the tracker is
+    bounded so attacker-supplied tokens can't grow memory unboundedly
+    (webhooks.py _rate_ok)."""
+    from room_tpu.server import webhooks as wh
+
+    # isolate the module-global tracker
+    old = dict(wh._hits)
+    wh._hits.clear()
+    try:
+        for i in range(wh.WEBHOOK_RATE_PER_MIN):
+            status, _ = req(
+                server, "POST", "/api/hooks/task/not-a-real-token",
+                {}, token=None,
+            )
+            assert status == 404, (i, status)   # unknown token, counted
+        status, out = req(
+            server, "POST", "/api/hooks/task/not-a-real-token",
+            {}, token=None,
+        )
+        assert status == 429
+
+        # saturation fails closed, stale tokens get evicted
+        now = __import__("time").monotonic()
+        for i in range(wh.MAX_TRACKED_TOKENS):
+            wh._hits[f"tok{i}"] = [now - 120]   # stale
+        status, _ = req(
+            server, "POST", "/api/hooks/task/fresh-token", {},
+            token=None,
+        )
+        # eviction freed room: the fresh token must be SERVED (404 =
+        # unknown token), not fail-closed rate-limited
+        assert status == 404
+        assert len(wh._hits) < wh.MAX_TRACKED_TOKENS  # evicted stale
+    finally:
+        wh._hits.clear()
+        wh._hits.update(old)

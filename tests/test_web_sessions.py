@@ -61,7 +61,19 @@ class _Site(BaseHTTPRequestHandler):
 
     def do_GET(self):
         parsed = urlparse(self.path)
-        if parsed.path == "/search":
+        if parsed.path == "/jump":
+            self.send_response(302)
+            self.send_header("Location", "/about")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        elif parsed.path == "/data.json":
+            data = b'{"answer": 42}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        elif parsed.path == "/search":
             q = parse_qs(parsed.query).get("q", [""])[0]
             self._send(f"<html><body><h1>Results for {q}</h1>"
                        "</body></html>")
@@ -204,3 +216,56 @@ def test_web_browse_tool_dispatch(site):
         None, None, None, "web_browse",
         {"action": "click", "session_id": sid, "index": 0},
     )
+
+
+def test_redirect_followed_and_recorded(site):
+    """302 is followed transparently; the session records the FINAL
+    url (what the agent acts on next)."""
+    s = open_web_session()
+    out = s.goto(site + "/jump")
+    assert "error" not in out
+    assert s.url.endswith("/about")
+    assert "About" in s.text()
+
+
+def test_goto_rejects_non_http_schemes():
+    s = open_web_session()
+    for bad in ("file:///etc/passwd", "ftp://x", "javascript:alert(1)"):
+        out = s.goto(bad)
+        assert "error" in out, bad
+
+
+def test_404_is_reported_not_raised(site):
+    s = open_web_session()
+    out = s.goto(site + "/definitely-missing")
+    assert "error" in out and "404" in out["error"]
+
+
+def test_non_html_body_served_as_text(site):
+    s = open_web_session()
+    out = s.goto(site + "/data.json")
+    assert "error" not in out
+    # non-HTML gets the plain {url, text} snapshot, not an outline
+    assert set(out) == {"url", "text"}
+    assert s.text().strip().startswith("{")
+
+
+def test_click_before_any_page_errors(site):
+    s = open_web_session()
+    assert "error" in s.click(0)          # nothing loaded yet
+
+
+def test_back_without_history_errors(site):
+    s = open_web_session()
+    s.goto(site + "/")
+    assert "error" in s.back()
+
+
+def test_close_session_removes_it():
+    from room_tpu.core.web_tools import close_web_session
+
+    s = open_web_session()
+    assert get_web_session(s.id) is s
+    assert close_web_session(s.id) is True
+    assert get_web_session(s.id) is None
+    assert close_web_session(s.id) is False
