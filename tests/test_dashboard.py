@@ -360,3 +360,67 @@ def test_tour_steps_reference_real_panels():
     panels = set(re.findall(r"(\w+): \{title", m.group(1)))
     assert set(steps) <= panels, set(steps) - panels
     assert "help" in panels
+
+
+def test_dom_ids_referenced_exist_in_templates():
+    """DOM-level drift check (no browser in the image — the jsdom-style
+    stand-in): every element id a panel reads via $("id") must be
+    PRODUCED somewhere in the bundle — an id="..." in a template
+    literal/HTML, or a createElement+.id assignment. A typo'd id means
+    a runtime null deref in the panel."""
+    js = open(os.path.join(UI_DIR, "app.js")).read()
+    js += open(os.path.join(UI_DIR, "panels.js")).read()
+    html = open(os.path.join(UI_DIR, "index.html")).read()
+    bundle = js + html
+
+    read = set(re.findall(r'\$\("([\w-]+)"\)', js))
+    # ids produced statically...
+    produced = set(re.findall(r'id="([\w-]+)"', bundle))
+    # ...or assigned programmatically (el.id = "toast")
+    produced |= set(re.findall(r'\.id\s*=\s*"([\w-]+)"', js))
+    # ...or through the sel("id", ...) select-builder helper, whose
+    # template emits id="${id_}"
+    produced |= set(re.findall(r'sel\("([\w-]+)"', js))
+    # ...or templated with a dynamic suffix (id="view-${key}")
+    dynamic_prefixes = [
+        m.split("${", 1)[0]
+        for m in re.findall(r'id="([^"]*\$\{[^"]*)"', bundle)
+    ]
+    # $("view-" + k) style reads resolve against dynamic templates
+    dyn_reads = set(re.findall(r'\$\("([\w-]+)"\s*\+', js))
+
+    missing = {
+        i for i in read
+        if i not in produced
+        and not any(i.startswith(p) for p in dynamic_prefixes if p)
+    }
+    assert not missing, f"$() reads with no produced id: {missing}"
+    for r in dyn_reads:
+        assert any(p == r for p in dynamic_prefixes), (
+            f'dynamic read $("{r}" + ...) has no id="{r}${{...}}" '
+            "template"
+        )
+
+
+def test_pwa_assets_serve(server):
+    """manifest + service worker + icon serve with usable types, and
+    the bundle registers the worker (reference: the SPA's PWA layer)."""
+    for path, frag in [
+        ("/manifest.json", b'"start_url"'),
+        ("/sw.js", b"addEventListener"),
+        ("/icon.svg", b"<svg"),
+    ]:
+        status, headers, body = fetch(server, path)
+        assert status == 200 and frag in body, path
+    html = open(os.path.join(UI_DIR, "index.html")).read()
+    assert 'rel="manifest"' in html
+    js = open(os.path.join(UI_DIR, "app.js")).read()
+    assert "serviceWorker" in js and 'register("/sw.js")' in js
+    # sw.js never caches live surfaces or foreign origins — assert on
+    # the actual guards, not comments
+    sw = open(os.path.join(UI_DIR, "sw.js")).read()
+    assert 'url.pathname.startsWith("/api")' in sw
+    assert "url.origin !== self.location.origin" in sw
+    # version state is persisted, not an in-memory global the browser
+    # can reap with the idle worker
+    assert 'match("/__version")' in sw

@@ -158,6 +158,7 @@ async function boot() {
   showView(currentView in PANELS ? currentView : "swarm");
   connectWs();
   setInterval(refreshView, 20000);
+  registerServiceWorker(st.data.version);
   // first run, nothing configured yet: open the guided walkthrough
   if (!localStorage.getItem("room_tpu_tour_done") &&
       !(st.data.activeRooms > 0) && typeof tourStart === "function") {
@@ -212,4 +213,21 @@ function confirmDialog(text, okLabel) {
 
 function promptDialog(text, placeholder) {
   return _dialog({text, input: true, placeholder});
+}
+
+
+// ---- PWA (reference: the SPA's service-worker layer) ----
+
+function registerServiceWorker(version) {
+  if (!("serviceWorker" in navigator)) return;
+  navigator.serviceWorker.register("/sw.js").then((reg) => {
+    // re-key the static cache per server version so an update-restart
+    // invalidates stale assets; on updatefound the message must reach
+    // the INSTALLING worker (reg.active is the old one)
+    const post = (w) => {
+      if (w) w.postMessage({type: "version", version});
+    };
+    post(reg.active || reg.waiting || reg.installing);
+    reg.addEventListener("updatefound", () => post(reg.installing));
+  }).catch(() => {});
 }

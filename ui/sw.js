@@ -1,0 +1,80 @@
+/* room_tpu service worker (reference: the SPA's PWA layer + the
+   update-restart cleanup in its UI tests): cache-first for the static
+   bundle, never caching /api or /ws, with the active server version
+   PERSISTED in a meta cache — in-memory SW globals die whenever the
+   browser reaps the idle worker, and lookups must only ever hit the
+   current version's cache or an update-restart would serve the old
+   bundle forever. */
+"use strict";
+
+const STATIC = ["/", "/app.js", "/panels.js", "/style.css",
+                "/manifest.json", "/icon.svg"];
+const META = "room-tpu-meta";
+
+async function currentCacheName() {
+  const meta = await caches.open(META);
+  const hit = await meta.match("/__version");
+  const v = hit ? await hit.text() : "v1";
+  return "room-tpu-static-" + v;
+}
+
+async function setVersion(version) {
+  const meta = await caches.open(META);
+  await meta.put("/__version", new Response(String(version)));
+  const keep = new Set([META, await currentCacheName()]);
+  const keys = await caches.keys();
+  await Promise.all(
+    keys.filter((k) => !keep.has(k)).map((k) => caches.delete(k))
+  );
+}
+
+self.addEventListener("install", (e) => {
+  e.waitUntil(
+    currentCacheName()
+      .then((name) => caches.open(name))
+      .then((c) => c.addAll(STATIC))
+      .then(() => self.skipWaiting())
+  );
+});
+
+self.addEventListener("activate", (e) => {
+  e.waitUntil(
+    currentCacheName().then(async (name) => {
+      const keep = new Set([META, name]);
+      const keys = await caches.keys();
+      await Promise.all(
+        keys.filter((k) => !keep.has(k)).map((k) => caches.delete(k))
+      );
+      await self.clients.claim();
+    })
+  );
+});
+
+self.addEventListener("message", (e) => {
+  if (e.data && e.data.type === "version") {
+    e.waitUntil
+      ? e.waitUntil(setVersion(e.data.version))
+      : setVersion(e.data.version);
+  }
+});
+
+self.addEventListener("fetch", (e) => {
+  const url = new URL(e.request.url);
+  if (url.origin !== self.location.origin ||
+      url.pathname.startsWith("/api") || url.pathname === "/ws" ||
+      e.request.method !== "GET") {
+    return; // live data / foreign origins never come from cache
+  }
+  e.respondWith((async () => {
+    const cache = await caches.open(await currentCacheName());
+    const hit = await cache.match(e.request);
+    if (hit) {
+      return hit;
+    }
+    const resp = await fetch(e.request);
+    if (resp.ok && STATIC.includes(url.pathname)) {
+      cache.put(e.request, resp.clone());
+    }
+    return resp;
+  })());
+});
