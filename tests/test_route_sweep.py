@@ -324,6 +324,79 @@ def test_get_payload_shapes(swept_server):
     assert not failures, "\n".join(failures)
 
 
+# pattern -> keys required in the POST/PUT response's data payload
+WRITE_SHAPES = {
+    ("POST", "/api/rooms"): {"id", "name", "queen_worker_id",
+                             "status"},
+    ("POST", "/api/rooms/:id/goals"): {"id", "description", "status"},
+    ("POST", "/api/rooms/:id/workers"): {"id", "name", "room_id"},
+    ("POST", "/api/rooms/:id/chat"): set(),   # clerk/queen reply text
+    ("POST", "/api/memory"): {"entityId"},
+    ("POST", "/api/skills"): {"id", "name", "content"},
+    ("POST", "/api/tasks"): {"id", "name", "status"},
+    ("POST", "/api/watches"): {"id"},
+    ("POST", "/api/templates/instantiate"): {"id", "name",
+                                             "queen_worker_id"},
+    ("PUT", "/api/rooms/:id"): {"id", "goal"},
+    ("PUT", "/api/settings"): set(),
+}
+
+WRITE_BODIES = {
+    ("POST", "/api/rooms"): {"name": "shaped", "workerModel": "echo",
+                             "createWallet": False},
+    ("POST", "/api/rooms/:id/goals"): {"description": "shaped goal"},
+    ("POST", "/api/rooms/:id/workers"): {"name": "shaped-worker"},
+    ("POST", "/api/rooms/:id/chat"): {"content": "hello"},
+    ("POST", "/api/memory"): {"name": "shaped", "content": "c"},
+    ("POST", "/api/skills"): {"name": "shaped", "content": "how"},
+    ("POST", "/api/tasks"): {"name": "shaped-task", "prompt": "p",
+                             "triggerType": "manual"},
+    ("POST", "/api/watches"): {"path": "/tmp/shaped-watch",
+                               "actionPrompt": "act"},
+    ("POST", "/api/templates/instantiate"):
+        {"template": "research-desk", "workerModel": "echo"},
+    ("PUT", "/api/rooms/:id"): {"goal": "shaped objective"},
+    ("PUT", "/api/settings"): {"shaped_key": "1"},
+}
+
+
+def test_write_payload_shapes(swept_server):
+    """Write endpoints return the created/updated entity with the
+    fields the dashboard immediately re-renders from (VERDICT r2 #10:
+    payload assertions beyond sane-status)."""
+    failures = []
+    for (method, pattern), keys in sorted(WRITE_SHAPES.items()):
+        path = pattern.replace(":id", "1")
+        body = WRITE_BODIES[(method, pattern)]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{swept_server.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={
+                "Authorization":
+                    f"Bearer {swept_server.tokens['user']}",
+                "Content-Type": "application/json",
+            },
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            failures.append(f"{method} {pattern} -> {e.code}")
+            continue
+        data = out.get("data")
+        if keys:
+            if not isinstance(data, dict):
+                failures.append(f"{method} {pattern}: data not a dict")
+                continue
+            missing = keys - set(data)
+            if missing:
+                failures.append(
+                    f"{method} {pattern}: missing {missing}"
+                )
+    assert not failures, "\n".join(failures)
+
+
 
 def test_sweep_deletes_last(swept_server):
     # children before their room: DELETE /api/rooms/:id cascades, so it
