@@ -490,3 +490,32 @@ def test_built_bundle_round_trips_check_stage_promote(
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_cli_status_against_live_server(tmp_path, monkeypatch, capsys):
+    """`room-tpu status` reads api.port/api.token from the data dir and
+    prints the live /api/status payload (reference: cli status)."""
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path))
+    from room_tpu.db import Database
+    from room_tpu.server.http import ApiServer
+    from room_tpu.cli.main import main
+
+    db = Database(":memory:")
+    srv = ApiServer(db)
+    srv.start()
+    try:
+        assert main(["status"]) == 0
+        out = capsys.readouterr().out
+        data = json.loads(out)
+        assert {"version", "platform", "devices"} <= set(data)
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_cli_status_unreachable(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "empty"))
+    from room_tpu.cli.main import main
+
+    assert main(["status"]) == 1
+    assert "not reachable" in capsys.readouterr().err
