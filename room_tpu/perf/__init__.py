@@ -1,0 +1,10 @@
+"""Performance modeling: roofline predictions for the serving path."""
+
+from room_tpu.perf.roofline import (  # noqa: F401
+    V5E,
+    ChipSpec,
+    decode_flops_per_token,
+    predict_decode,
+    roofline_table,
+    spec_expected_tokens,
+)

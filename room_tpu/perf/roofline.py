@@ -1,0 +1,219 @@
+"""Roofline decode-performance model (VERDICT r4 #2).
+
+Predicts decode tok/s + MFU from first principles so the perf story is
+falsifiable before (and cross-checkable after) a hardware run. Per
+decode step the chip must:
+
+  (a) read every *active* weight byte once from HBM (batch rows share
+      the read — this is why batching lifts decode throughput),
+  (b) read each row's KV cache over its mean context,
+  (c) compute ~2 FLOPs per active weight per token on the MXU.
+
+Step time is the roofline max(bytes / HBM_BW, FLOPs / peak); decode on
+a single chip is HBM-bandwidth-bound at every batch size this framework
+serves (see the `bound` field), which is why the int8 levers (halving
+weight or KV bytes) move the headline and extra MXU FLOPs are nearly
+free — the basis for speculative decoding's uplift.
+
+Speculative decoding is modeled as verify rounds: one forward over
+(gamma+1) positions per row (weights read once per round, KV read once
+per round per row — the verify pass is prefill-shaped), emitting
+E[gamma, a] = sum_{i=0..gamma} a^i tokens per round at draft-acceptance
+rate `a`. Draft generation itself is host-side n-gram lookup, ~free.
+
+The FLOPs model here is the canonical one; bench.py imports it so the
+measured MFU and the predicted MFU share arithmetic.
+
+reference: BASELINE.md:34-35 (800 tok/s/chip, p50<4s) — the targets
+these predictions are checked against; no reference-source counterpart
+(the reference delegates serving perf to Ollama).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Peak numbers for one TPU chip (per-chip, not per-host)."""
+
+    name: str
+    peak_bf16_tflops: float   # dense bf16 matmul peak
+    hbm_gbps: float           # HBM bandwidth, GB/s
+    hbm_gib: float            # HBM capacity, GiB
+
+
+# v5e: 197 bf16 TFLOP/s, 819 GB/s, 16 GiB — the chip BASELINE.md's
+# 800 tok/s/chip target assumes.
+V5E = ChipSpec("v5e", peak_bf16_tflops=197.0, hbm_gbps=819.0,
+               hbm_gib=16.0)
+
+
+def decode_flops_per_token(cfg, mean_ctx: float) -> float:
+    """Forward FLOPs per decoded token: 2*active-params matmuls +
+    attention score/value reads over the mean context."""
+    d, dh = cfg.hidden, cfg.head_dim
+    hq, hkv = cfg.n_heads, cfg.n_kv_heads
+    attn_w = d * hq * dh + 2 * d * hkv * dh + hq * dh * d
+    if cfg.is_moe:
+        ffn_w = cfg.top_k * 3 * d * cfg.moe_intermediate
+        ffn_w += d * cfg.n_experts  # router
+    else:
+        ffn_w = 3 * d * cfg.intermediate
+    per_layer = 2 * (attn_w + ffn_w)
+    # attention score+value FLOPs against the KV cache
+    per_layer += 2 * 2 * mean_ctx * hq * dh
+    head = 2 * d * cfg.vocab_size
+    return cfg.n_layers * per_layer + head
+
+
+def expected_experts_touched(cfg, tokens: int) -> float:
+    """Expected distinct experts activated by `tokens` routed positions
+    under uniform routing — the fraction of expert weight bytes a step
+    actually reads. 1 - (1 - top_k/E)^tokens per expert."""
+    if not cfg.is_moe:
+        return 0.0
+    p_miss = (1.0 - cfg.top_k / cfg.n_experts) ** tokens
+    return cfg.n_experts * (1.0 - p_miss)
+
+
+def step_weight_bytes(cfg, tokens: int, weight_bytes: float = 2.0) -> float:
+    """HBM bytes of weights one forward step reads, shared across the
+    `tokens` positions it processes (batch rows for plain decode,
+    batch*(gamma+1) for a spec verify round). MoE expert bytes are
+    scaled by the expected fraction of experts those tokens route to;
+    embedding-table reads are row-gathers (negligible) but the LM head
+    is a full matmul."""
+    d, dh = cfg.hidden, cfg.head_dim
+    hq, hkv = cfg.n_heads, cfg.n_kv_heads
+    attn_p = d * hq * dh + 2 * d * hkv * dh + hq * dh * d
+    if cfg.is_moe:
+        per_expert = 3 * d * cfg.moe_intermediate
+        ffn_p = expected_experts_touched(cfg, tokens) * per_expert
+        ffn_p += d * cfg.n_experts
+    else:
+        ffn_p = 3 * d * cfg.intermediate
+    head_p = d * cfg.vocab_size
+    return (cfg.n_layers * (attn_p + ffn_p) + head_p) * weight_bytes
+
+
+def kv_bytes_per_row(cfg, mean_ctx: float, kv_bytes: float = 2.0) -> float:
+    """HBM bytes of KV cache one row's attention reads per step (K+V
+    across all layers over the mean context)."""
+    return cfg.n_layers * mean_ctx * 2 * cfg.kv_dim * kv_bytes
+
+
+def spec_expected_tokens(gamma: int, acceptance: float) -> float:
+    """Expected tokens emitted per speculative verify round: the bonus
+    token plus each draft token surviving with prob a^i —
+    sum_{i=0..gamma} a^i."""
+    if not 0.0 <= acceptance <= 1.0:
+        raise ValueError(f"acceptance must be in [0,1], got {acceptance}")
+    return sum(acceptance ** i for i in range(gamma + 1))
+
+
+def predict_decode(
+    cfg,
+    chip: ChipSpec = V5E,
+    batch: int = 8,
+    mean_ctx: float = 2048.0,
+    weight_bytes: float = 2.0,
+    kv_bytes: float = 2.0,
+    spec_gamma: int = 0,
+    spec_acceptance: float = 0.0,
+) -> dict:
+    """Roofline prediction for one chip serving `batch` concurrent rows.
+
+    Returns tok_s, per-step times, the binding resource, and MFU
+    (achieved MXU FLOP/s over peak — decode MFU is inherently low
+    because the workload is bandwidth-bound; that is the finding, not a
+    bug)."""
+    flops_tok = decode_flops_per_token(cfg, mean_ctx)
+    positions = spec_gamma + 1  # verify round width (1 = plain decode)
+    out_tokens = (batch * spec_expected_tokens(spec_gamma, spec_acceptance)
+                  if spec_gamma else batch)
+
+    # a verify round routes batch*(gamma+1) tokens through the MoE
+    # router — it touches more distinct experts (more weight bytes)
+    # than a plain decode step of the same batch
+    step_bytes = (step_weight_bytes(cfg, batch * positions, weight_bytes)
+                  + batch * kv_bytes_per_row(cfg, mean_ctx, kv_bytes))
+    step_flops = batch * positions * flops_tok
+
+    t_hbm = step_bytes / (chip.hbm_gbps * 1e9)
+    t_mxu = step_flops / (chip.peak_bf16_tflops * 1e12)
+    t_step = max(t_hbm, t_mxu)
+    tok_s = out_tokens / t_step
+    return {
+        "tok_s": tok_s,
+        "mfu": (step_flops / t_step) / (chip.peak_bf16_tflops * 1e12),
+        "bound": "hbm" if t_hbm >= t_mxu else "mxu",
+        "t_hbm_us": t_hbm * 1e6,
+        "t_mxu_us": t_mxu * 1e6,
+        "step_bytes": step_bytes,
+        "step_flops": step_flops,
+        "flops_per_token": flops_tok,
+    }
+
+
+# (label, weight_bytes, kv_bytes) — the serving engine's quant levers:
+# ROOM_TPU_QUANT=int8 halves weight bytes, ROOM_TPU_KV_QUANT=int8
+# halves KV bytes; both compute in bf16 on the MXU after dequant.
+VARIANTS = (
+    ("bf16", 2.0, 2.0),
+    ("int8-weights", 1.0, 2.0),
+    ("int8-kv", 2.0, 1.0),
+    ("int8-w+kv", 1.0, 1.0),
+)
+
+
+def roofline_table(
+    cfg,
+    chip: ChipSpec = V5E,
+    batches: Iterable[int] = (8, 32),
+    mean_ctx: float = 2048.0,
+    spec_gamma: int = 4,
+    spec_acceptance: float = 0.8,
+) -> list[dict]:
+    """{bf16, int8-weights, int8-kv, int8-w+kv} x {spec off/on} x
+    batches — the falsifiable prediction grid for the first green
+    hardware window."""
+    rows = []
+    for label, wb, kb in VARIANTS:
+        for batch in batches:
+            for spec in (False, True):
+                p = predict_decode(
+                    cfg, chip, batch=batch, mean_ctx=mean_ctx,
+                    weight_bytes=wb, kv_bytes=kb,
+                    spec_gamma=spec_gamma if spec else 0,
+                    spec_acceptance=spec_acceptance if spec else 0.0,
+                )
+                rows.append({
+                    "variant": label,
+                    "batch": batch,
+                    "spec": (f"gamma{spec_gamma}@a={spec_acceptance}"
+                             if spec else "off"),
+                    "tok_s": round(p["tok_s"], 1),
+                    "mfu": round(p["mfu"], 4),
+                    "bound": p["bound"],
+                })
+    return rows
+
+
+def format_markdown(rows: list[dict], chip: ChipSpec, cfg,
+                    mean_ctx: float) -> str:
+    head = (
+        f"Roofline predictions — {cfg.name} on {chip.name} "
+        f"({chip.peak_bf16_tflops:.0f} bf16 TFLOP/s, "
+        f"{chip.hbm_gbps:.0f} GB/s HBM), mean ctx {mean_ctx:.0f}\n\n"
+        "| variant | batch | spec | pred tok/s | pred MFU | bound |\n"
+        "|---|---|---|---|---|---|\n"
+    )
+    body = "".join(
+        f"| {r['variant']} | {r['batch']} | {r['spec']} | "
+        f"{r['tok_s']} | {r['mfu']} | {r['bound']} |\n"
+        for r in rows
+    )
+    return head + body

@@ -1,0 +1,38 @@
+"""Print the roofline prediction tables (markdown) for ROUND5.md.
+
+Usage: python scripts/roofline_table.py [--ctx 2048]
+
+Covers the flagship serving model (qwen3-coder-30b), the hetero queen
+model (qwen2.5-72b, per-chip slice not modeled — whole-model on one
+chip shown for the bound structure), and the bench model bench.py
+actually measures, so the first green hardware window can be compared
+line-for-line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ctx", type=float, default=2048.0)
+    ap.add_argument("--acceptance", type=float, default=0.8)
+    args = ap.parse_args()
+
+    from bench import bench_config
+    from room_tpu.models.config import qwen3_coder_30b
+    from room_tpu.perf.roofline import V5E, format_markdown, roofline_table
+
+    for cfg in (bench_config(), qwen3_coder_30b()):
+        rows = roofline_table(cfg, V5E, mean_ctx=args.ctx,
+                              spec_acceptance=args.acceptance)
+        print(format_markdown(rows, V5E, cfg, args.ctx))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""Roofline model arithmetic (VERDICT r4 #2): the predicted-perf table
+must come from tested math, not prose."""
+
+import math
+
+import pytest
+
+from room_tpu.models.config import (
+    qwen2_72b,
+    qwen3_coder_30b,
+    tiny_dense,
+)
+from room_tpu.perf.roofline import (
+    V5E,
+    VARIANTS,
+    ChipSpec,
+    decode_flops_per_token,
+    expected_experts_touched,
+    format_markdown,
+    kv_bytes_per_row,
+    predict_decode,
+    roofline_table,
+    spec_expected_tokens,
+    step_weight_bytes,
+)
+
+
+def test_bench_shares_the_flops_model():
+    # bench delegates lazily (its import must not precede main()'s
+    # try/except), so compare values, not identity
+    import bench
+
+    cfg = qwen3_coder_30b()
+    assert bench.decode_flops_per_token(cfg, 777.0) == \
+        decode_flops_per_token(cfg, 777.0)
+
+
+def test_dense_flops_closed_form():
+    cfg = tiny_dense()
+    d, dh = cfg.hidden, cfg.head_dim
+    attn = d * cfg.n_heads * dh + 2 * d * cfg.n_kv_heads * dh \
+        + cfg.n_heads * dh * d
+    ffn = 3 * d * cfg.intermediate
+    ctx = 100.0
+    per_layer = 2 * (attn + ffn) + 4 * ctx * cfg.n_heads * dh
+    want = cfg.n_layers * per_layer + 2 * d * cfg.vocab_size
+    assert decode_flops_per_token(cfg, ctx) == want
+
+
+def test_moe_flops_count_only_topk_experts():
+    cfg = qwen3_coder_30b()
+    f = decode_flops_per_token(cfg, 0.0)
+    # all-expert dense equivalent would be ~16x the FFN term; active
+    # params of the 30B-A3B are ~3B => ~6 GFLOPs/token + head
+    assert 5e9 < f < 9e9
+
+
+def test_spec_expected_tokens_limits():
+    assert spec_expected_tokens(4, 0.0) == 1.0
+    assert spec_expected_tokens(4, 1.0) == 5.0
+    assert spec_expected_tokens(0, 0.7) == 1.0
+    seq = [spec_expected_tokens(4, a) for a in (0.2, 0.5, 0.8)]
+    assert seq == sorted(seq)
+    with pytest.raises(ValueError):
+        spec_expected_tokens(4, 1.5)
+
+
+def test_expected_experts_touched_limits():
+    cfg = qwen3_coder_30b()
+    # one row touches exactly top_k experts in expectation
+    assert expected_experts_touched(cfg, 1) == pytest.approx(cfg.top_k)
+    # a huge batch touches (nearly) all experts
+    assert expected_experts_touched(cfg, 4096) == pytest.approx(
+        cfg.n_experts, rel=1e-6
+    )
+    assert expected_experts_touched(tiny_dense(), 8) == 0.0
+
+
+def test_decode_is_hbm_bound_at_serving_batches():
+    cfg = qwen3_coder_30b()
+    for batch in (1, 8, 32):
+        p = predict_decode(cfg, V5E, batch=batch, mean_ctx=2048.0)
+        assert p["bound"] == "hbm"
+        assert 0.0 < p["mfu"] < 0.1  # bandwidth-bound decode: low MFU
+
+
+def test_int8_weights_lift_bw_bound_throughput():
+    cfg = qwen3_coder_30b()
+    bf16 = predict_decode(cfg, V5E, batch=8, weight_bytes=2.0)
+    int8 = predict_decode(cfg, V5E, batch=8, weight_bytes=1.0)
+    assert bf16["bound"] == "hbm"
+    assert 1.0 < int8["tok_s"] / bf16["tok_s"] <= 2.0
+
+
+def test_batching_amortizes_weight_reads():
+    cfg = qwen3_coder_30b()
+    t1 = predict_decode(cfg, V5E, batch=1)["tok_s"]
+    t8 = predict_decode(cfg, V5E, batch=8)["tok_s"]
+    t32 = predict_decode(cfg, V5E, batch=32)["tok_s"]
+    assert t1 < t8 < t32
+
+
+def test_kv_bytes_scale_with_context_and_dtype():
+    cfg = qwen2_72b()
+    b2 = kv_bytes_per_row(cfg, 1000.0, 2.0)
+    assert b2 == cfg.n_layers * 1000.0 * 2 * cfg.kv_dim * 2.0
+    assert kv_bytes_per_row(cfg, 1000.0, 1.0) == b2 / 2
+
+
+def test_spec_uplift_monotone_and_bounded():
+    cfg = qwen3_coder_30b()
+    base = predict_decode(cfg, V5E, batch=8)["tok_s"]
+    prev = 0.0
+    for a in (0.0, 0.5, 0.9, 1.0):
+        s = predict_decode(cfg, V5E, batch=8, spec_gamma=4,
+                           spec_acceptance=a)["tok_s"]
+        assert s > prev
+        prev = s
+    # acceptance 1.0 with near-free verify cannot exceed (gamma+1)x
+    assert prev / base <= 5.0
+    # zero acceptance emits only the bonus token per round while the
+    # verify round routes 5x the tokens (touching ~2x the experts on
+    # the 128-expert MoE) — the model must predict a real slowdown
+    # (why the engine's no-draft fallback exists), but bounded by the
+    # extra expert bytes, not a collapse
+    worst = predict_decode(cfg, V5E, batch=8, spec_gamma=4,
+                           spec_acceptance=0.0)
+    assert 0.25 * base < worst["tok_s"] < base
+
+
+def test_step_weight_bytes_int8_halves():
+    cfg = qwen3_coder_30b()
+    assert step_weight_bytes(cfg, 8, 1.0) == pytest.approx(
+        step_weight_bytes(cfg, 8, 2.0) / 2
+    )
+
+
+def test_table_covers_the_grid_and_formats():
+    cfg = qwen3_coder_30b()
+    rows = roofline_table(cfg, V5E, batches=(8, 32))
+    assert len(rows) == len(VARIANTS) * 2 * 2
+    labels = {r["variant"] for r in rows}
+    assert labels == {v[0] for v in VARIANTS}
+    md = format_markdown(rows, V5E, cfg, 2048.0)
+    assert "| variant | batch | spec |" in md
+    assert md.count("\n") == len(rows) + 4  # header block + one per row
+
+
+def test_prediction_brackets_the_baseline_target():
+    """BASELINE.md:34 asks >=800 decode tok/s/chip on the 30B-A3B.
+    The roofline says bf16@bs=8 cannot reach it on v5e bandwidth, and
+    the shipped levers (int8 weights + KV, batch 32, spec) clear it —
+    i.e. the target is reachable exactly via the engine's defaults."""
+    cfg = qwen3_coder_30b()
+    bf16_8 = predict_decode(cfg, V5E, batch=8)["tok_s"]
+    assert bf16_8 < 800.0
+    best = predict_decode(cfg, V5E, batch=32, weight_bytes=1.0,
+                          kv_bytes=1.0, spec_gamma=4,
+                          spec_acceptance=0.8)["tok_s"]
+    assert best > 800.0
+
+
+def test_custom_chip_spec_scales_linearly():
+    cfg = qwen3_coder_30b()
+    fast = ChipSpec("2x", V5E.peak_bf16_tflops * 2, V5E.hbm_gbps * 2,
+                    V5E.hbm_gib)
+    a = predict_decode(cfg, V5E, batch=8)
+    b = predict_decode(cfg, fast, batch=8)
+    assert b["tok_s"] == pytest.approx(a["tok_s"] * 2)
+    assert math.isclose(a["mfu"], b["mfu"])
