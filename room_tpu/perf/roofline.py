@@ -105,6 +105,63 @@ def kv_bytes_per_row(cfg, mean_ctx: float, kv_bytes: float = 2.0) -> float:
     return cfg.n_layers * mean_ctx * 2 * cfg.kv_dim * kv_bytes
 
 
+def step_components(
+    cfg,
+    chip: ChipSpec,
+    batch: int,
+    positions: int,
+    mean_ctx: float,
+    weight_bytes: float = 2.0,
+    kv_bytes: float = 2.0,
+) -> dict:
+    """Bytes/FLOPs and HBM/MXU times of one forward step over batch
+    rows x positions tokens each. The single source for
+    predict_decode / predict_spec_class / spec_cost_ratio — the
+    engine's throttle floor and the published tables must share this
+    arithmetic."""
+    b = (step_weight_bytes(cfg, batch * positions, weight_bytes)
+         + batch * kv_bytes_per_row(cfg, mean_ctx, kv_bytes))
+    f = batch * positions * decode_flops_per_token(cfg, mean_ctx)
+    t_hbm = b / (chip.hbm_gbps * 1e9)
+    t_mxu = f / (chip.peak_bf16_tflops * 1e12)
+    return {"bytes": b, "flops": f, "t_hbm": t_hbm, "t_mxu": t_mxu,
+            "t_step": max(t_hbm, t_mxu)}
+
+
+def step_time_s(
+    cfg,
+    chip: ChipSpec,
+    batch: int,
+    positions: int,
+    mean_ctx: float,
+    weight_bytes: float = 2.0,
+    kv_bytes: float = 2.0,
+) -> float:
+    """Roofline step time: max of HBM streaming and MXU compute."""
+    return step_components(cfg, chip, batch, positions, mean_ctx,
+                           weight_bytes, kv_bytes)["t_step"]
+
+
+def spec_cost_ratio(
+    cfg,
+    batch: int,
+    gamma: int,
+    chip: ChipSpec = V5E,
+    mean_ctx: float = 1024.0,
+    weight_bytes: float = 2.0,
+    kv_bytes: float = 2.0,
+) -> float:
+    """How much more a verify round costs than a plain decode step at
+    the same (fixed) batch shape. >1 on MoE at small batch because the
+    gamma+1 positions route through more distinct experts; ~1 for
+    bandwidth-bound dense models (the extra FLOPs ride idle MXU)."""
+    t_v = step_time_s(cfg, chip, batch, gamma + 1, mean_ctx,
+                      weight_bytes, kv_bytes)
+    t_p = step_time_s(cfg, chip, batch, 1, mean_ctx,
+                      weight_bytes, kv_bytes)
+    return t_v / t_p
+
+
 def spec_expected_tokens(gamma: int, acceptance: float) -> float:
     """Expected tokens emitted per speculative verify round: the bonus
     token plus each draft token surviving with prob a^i —
@@ -138,24 +195,80 @@ def predict_decode(
     # a verify round routes batch*(gamma+1) tokens through the MoE
     # router — it touches more distinct experts (more weight bytes)
     # than a plain decode step of the same batch
-    step_bytes = (step_weight_bytes(cfg, batch * positions, weight_bytes)
-                  + batch * kv_bytes_per_row(cfg, mean_ctx, kv_bytes))
-    step_flops = batch * positions * flops_tok
-
-    t_hbm = step_bytes / (chip.hbm_gbps * 1e9)
-    t_mxu = step_flops / (chip.peak_bf16_tflops * 1e12)
-    t_step = max(t_hbm, t_mxu)
-    tok_s = out_tokens / t_step
+    c = step_components(cfg, chip, batch, positions, mean_ctx,
+                        weight_bytes, kv_bytes)
+    tok_s = out_tokens / c["t_step"]
     return {
         "tok_s": tok_s,
-        "mfu": (step_flops / t_step) / (chip.peak_bf16_tflops * 1e12),
-        "bound": "hbm" if t_hbm >= t_mxu else "mxu",
-        "t_hbm_us": t_hbm * 1e6,
-        "t_mxu_us": t_mxu * 1e6,
-        "step_bytes": step_bytes,
-        "step_flops": step_flops,
+        "mfu": (c["flops"] / c["t_step"])
+        / (chip.peak_bf16_tflops * 1e12),
+        "bound": "hbm" if c["t_hbm"] >= c["t_mxu"] else "mxu",
+        "t_hbm_us": c["t_hbm"] * 1e6,
+        "t_mxu_us": c["t_mxu"] * 1e6,
+        "step_bytes": c["bytes"],
+        "step_flops": c["flops"],
         "flops_per_token": flops_tok,
     }
+
+
+def predict_spec_class(
+    cfg,
+    chip: ChipSpec,
+    batch: int,
+    mean_ctx: float,
+    gamma: int,
+    rounds: int,
+    plain_steps: int,
+    emitted: int,
+    weight_bytes: float = 2.0,
+    kv_bytes: float = 2.0,
+) -> dict:
+    """Net TPU uplift of speculation for one traffic class, from
+    replayed counters (room_tpu/serving/spec_replay.py): verify rounds
+    pay the (gamma+1)-position step cost (more MoE experts touched),
+    plain fallback rounds pay the 1-position cost, and the class emits
+    `emitted` tokens over them. Uplift is vs all-plain sequential
+    decode of the same tokens."""
+    t_plain = step_time_s(cfg, chip, batch, 1, mean_ctx,
+                          weight_bytes, kv_bytes)
+    t_verify = step_time_s(cfg, chip, batch, gamma + 1, mean_ctx,
+                           weight_bytes, kv_bytes)
+    t_total = rounds * t_verify + plain_steps * t_plain
+    tok_s = batch * emitted / t_total if t_total else 0.0
+    baseline = batch / t_plain
+    return {
+        "tok_s": tok_s,
+        "uplift": tok_s / baseline,
+        "verify_cost_ratio": t_verify / t_plain,
+    }
+
+
+def spec_accept_floor(
+    cfg,
+    batch: int,
+    gamma: int,
+    chip: ChipSpec = V5E,
+    mean_ctx: float = 1024.0,
+    weight_bytes: float = 2.0,
+    kv_bytes: float = 2.0,
+) -> float:
+    """Acceptance below which a verify round loses to plain decode on
+    this model/batch shape: solves sum_{i<=gamma} a^i =
+    t_verify/t_plain for a — the homogeneous-batch breakeven the
+    published tables report (the engine's live gate works on expected
+    emission instead: engine._decode_once_spec)."""
+    ratio = spec_cost_ratio(cfg, batch, gamma, chip, mean_ctx,
+                            weight_bytes, kv_bytes)
+    if ratio <= 1.0:
+        return 0.0
+    lo, hi = 0.0, 1.0
+    for _ in range(40):
+        mid = (lo + hi) / 2
+        if sum(mid ** i for i in range(gamma + 1)) < ratio:
+            lo = mid
+        else:
+            hi = mid
+    return hi
 
 
 # (label, weight_bytes, kv_bytes) — the serving engine's quant levers:

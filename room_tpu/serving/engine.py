@@ -106,6 +106,10 @@ class Turn:
     stop_hit: Optional[str] = None        # which stop string fired
     error: Optional[str] = None
     done: threading.Event = field(default_factory=threading.Event)
+    # rolling per-token draft-acceptance estimate for this row (EMA,
+    # optimistic start so new rows probe); feeds the engine's
+    # batch-level speculation profitability gate
+    spec_accept_ema: float = 1.0
 
     def wait(self, timeout: Optional[float] = None) -> "Turn":
         self.done.wait(timeout)
@@ -210,6 +214,38 @@ class ServingEngine:
         # the bench A/B (VERDICT r2 #8).
         self.spec_tokens = spec_tokens if spec_tokens is not None else \
             int(os.environ.get("ROOM_TPU_SPEC_TOKENS", "0"))
+        # Adaptive speculation gate (spec-acceptance study, round 5):
+        # the verify forward runs at fixed [max_batch, gamma+1] shape,
+        # so muting individual rows saves nothing — the decision is
+        # whether a whole ROUND is profitable: expected emission (from
+        # per-row acceptance EMAs over each row's actual draft) must
+        # clear the verify/plain cost ratio of this engine's fixed
+        # shape (roofline.spec_cost_ratio; ~2x for the 128-expert MoE
+        # at bs=8, ~1x for bandwidth-bound dense). Unprofitable rounds
+        # decode plainly for SPEC_COOLDOWN tokens/row, then one probe
+        # round refreshes the EMAs (traffic class changes mid-turn).
+        # alpha/cooldown = 0.1/16 from the replay sweep (ROUND5.md §3):
+        # worst class (prose on 30b-moe bs8) recovers 0.75x -> 0.98x
+        # while code at bs32 keeps its full 2.34x
+        self.spec_ema_alpha = float(
+            os.environ.get("ROOM_TPU_SPEC_EMA", "0.1")
+        )
+        self.spec_cooldown_len = int(
+            os.environ.get("ROOM_TPU_SPEC_COOLDOWN", "16")
+        )
+        env_floor = os.environ.get("ROOM_TPU_SPEC_MIN_ACCEPT")
+        self.spec_min_accept = (
+            float(env_floor) if env_floor is not None else None
+        )
+        self._spec_ratio = 1.0
+        if self.spec_tokens > 0:
+            from room_tpu.perf.roofline import spec_cost_ratio
+
+            self._spec_ratio = spec_cost_ratio(
+                cfg, self.max_batch, self.spec_tokens
+            )
+        self._spec_resume_at = 0   # tokens_decoded gate re-opens at
+        self._spec_probe = False   # one forced round after cooldown
 
         if stop_token_ids is not None:
             self.stop_token_ids = set(stop_token_ids)
@@ -320,7 +356,7 @@ class ServingEngine:
             "prefix_hits": 0, "prefix_tokens_reused": 0,
             "prefix_evictions": 0,
             "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
-            "spec_rows_sequential": 0,
+            "spec_rows_sequential": 0, "spec_throttles": 0,
         }
         from collections import Counter
 
@@ -1148,7 +1184,8 @@ class ServingEngine:
         # the batch still rides spec — one tenant's sampling knobs must
         # not cut every batchmate's decode throughput (ADVICE r3)
         n_spec = 0
-        if self.spec_tokens > 0:
+        if self.spec_tokens > 0 and \
+                self._stats["tokens_decoded"] >= self._spec_resume_at:
             spec_rows = [
                 i for i in active_idx
                 if not self._active[i].sampling.penalized
@@ -1307,6 +1344,41 @@ class ServingEngine:
         if n_proposed == 0:
             return None
 
+        # round-profitability gate: expected emission this round (per
+        # row: the bonus token + sum ema^i over its actual draft) must
+        # clear the fixed-shape verify/plain cost ratio, or the round
+        # decodes plainly and the gate closes for a cooldown. With
+        # ROOM_TPU_SPEC_MIN_ACCEPT set, the gate compares the
+        # draft-weighted mean EMA against that floor instead.
+        if self._spec_probe:
+            self._spec_probe = False  # forced EMA-refresh round
+        else:
+            n_act = len(active_idx)
+            if self.spec_min_accept is not None:
+                prop_tot = sum(len(drafts[i][1]) for i in active_idx)
+                mean_acc = sum(
+                    self._active[i].spec_accept_ema * len(drafts[i][1])
+                    for i in active_idx
+                ) / max(prop_tot, 1)
+                profitable = mean_acc >= self.spec_min_accept
+            else:
+                exp_emit = 0.0
+                for i in active_idx:
+                    ema = self._active[i].spec_accept_ema
+                    exp_emit += 1.0 + sum(
+                        ema ** k
+                        for k in range(1, len(drafts[i][1]) + 1)
+                    )
+                profitable = exp_emit >= self._spec_ratio * n_act
+            if not profitable:
+                self._stats["spec_throttles"] += 1
+                self._spec_resume_at = (
+                    self._stats["tokens_decoded"]
+                    + self.spec_cooldown_len * n_act
+                )
+                self._spec_probe = True
+                return None
+
         # reserve only what each row can actually consume: its drafts'
         # KV plus the current token (the bonus token stays pending)
         max_accept: dict[int, int] = {}
@@ -1382,6 +1454,13 @@ class ServingEngine:
             a = 0
             while a < n and accept[i, a]:
                 a += 1
+            if n:
+                # refresh the row's acceptance estimate for the
+                # profitability gate
+                al = self.spec_ema_alpha
+                turn.spec_accept_ema = (
+                    (1 - al) * turn.spec_accept_ema + al * (a / n)
+                )
             if a < n:
                 # first rejection: emit the residual draw (for greedy
                 # rows that's the argmax — identical to plain decoding)
