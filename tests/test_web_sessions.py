@@ -42,6 +42,21 @@ PAGES = {
         <input type="text" name="q">
       </form>
       </body></html>""",
+    # SPA shell: script-heavy, no static content — what a React/Vue
+    # bundle page looks like to a non-JS client
+    "/app": ("""
+      <html><head><title>App</title>
+      <script src="/static/runtime.js"></script>
+      <script src="/static/vendors.js"></script>
+      <script src="/static/main.js"></script>
+      </head><body><div id="root"></div>
+      <script>window.__BOOT__ = {};</script>
+      </body></html>""" + "<!-- bundle padding -->" * 128),
+    "/noscript": """
+      <html><head><title>NS</title></head><body>
+      <noscript>Please enable JavaScript to use this site.</noscript>
+      <div id="app"></div>
+      </body></html>""",
 }
 
 
@@ -269,3 +284,54 @@ def test_close_session_removes_it():
     assert close_web_session(s.id) is True
     assert get_web_session(s.id) is None
     assert close_web_session(s.id) is False
+
+
+def test_js_rendered_spa_shell_flagged(site):
+    """A script-heavy page with no static text must carry an explicit
+    js_rendered signal (VERDICT r4 #7) instead of a silently empty
+    outline."""
+    s = open_web_session()
+    out = s.goto(site + "/app")
+    assert out.get("js_rendered") is True
+    assert "JS-rendered" in out["warning"]
+    # navigating to a real content page clears the flag
+    out2 = s.goto(site + "/about")
+    assert "js_rendered" not in out2
+
+
+def test_noscript_plea_flagged(site):
+    s = open_web_session()
+    out = s.goto(site + "/noscript")
+    assert out.get("js_rendered") is True
+
+
+def test_content_pages_not_flagged(site):
+    s = open_web_session()
+    for path in ("/", "/about", "/login"):
+        out = s.goto(site + path)
+        assert "js_rendered" not in out, path
+
+
+def test_web_fetch_marks_js_rendered(site):
+    from room_tpu.core.web_tools import web_fetch
+
+    body = web_fetch(site + "/app")
+    assert body.startswith("[page appears to be JS-rendered")
+    body2 = web_fetch(site + "/about")
+    assert "JS-rendered" not in body2
+
+
+def test_detect_js_rendered_unit():
+    from room_tpu.core.web_tools import detect_js_rendered
+
+    spa = ("<html><head><script src=a.js></script>"
+           "<script src=b.js></script><script>boot()</script></head>"
+           "<body><div id=root></div></body></html>" + "<!-- -->" * 400)
+    assert detect_js_rendered(spa, "")
+    # long static text wins even with many scripts
+    assert not detect_js_rendered(spa, "real words " * 50)
+    # noscript plea with thin text
+    assert detect_js_rendered(
+        "<noscript>please enable JavaScript</noscript>", "")
+    # small plain page: not flagged
+    assert not detect_js_rendered("<html><body>hi</body></html>", "hi")
