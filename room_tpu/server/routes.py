@@ -1534,16 +1534,32 @@ def register_memory_routes(r: Router) -> None:
         room_id = ctx.query.get("roomId")
         limit = int(ctx.query.get("limit", "10"))
         if not q:
-            # memory browser: empty query lists the newest entities
+            # memory browser: empty query lists the newest entities —
+            # in the SAME row shape as hybrid_search (the panel renders
+            # entity_id/observations/score either way; the render
+            # harness caught the old id/content shape producing
+            # memDelete(undefined) buttons on first load)
             rows = ctx.db.query(
-                "SELECT e.*, (SELECT content FROM observations o "
-                " WHERE o.entity_id = e.id ORDER BY o.id DESC LIMIT 1)"
-                " AS content FROM entities e "
+                "SELECT e.* FROM entities e "
                 + ("WHERE e.room_id=? " if room_id else "")
                 + "ORDER BY e.id DESC LIMIT ?",
                 ((int(room_id), limit) if room_id else (limit,)),
             )
-            return ok(rows)
+            return ok([{
+                "entity_id": row["id"],
+                "name": row["name"],
+                "category": row.get("category"),
+                "score": 0.0,
+                # same 5-newest cap as hybrid_search rows, oldest
+                # first (an entity can carry hundreds of observations)
+                "observations": [
+                    o["content"] for o in reversed(
+                        memory_mod.get_observations(
+                            ctx.db, row["id"],
+                            newest_first=True, limit=5,
+                        ))
+                ],
+            } for row in rows])
         from ..core.queen_tools import _embed_query
 
         return ok(memory_mod.hybrid_search(

@@ -1,0 +1,15 @@
+"""Stdlib-only JS execution harness for the dashboard (VERDICT r4 #3).
+
+No JS engine exists in this image (no node/quickjs/duktape), so the
+render harness ships its own: a tree-walking interpreter for the
+bounded modern-JS subset ui/panels.js is written in (template
+literals, arrow functions, async/await, destructuring, spread,
+optional chaining, nullish coalescing — no classes, no generators),
+plus a minimal DOM shim. tests/test_ui_render.py executes every
+panel's real render function against payloads served by the real HTTP
+routes and asserts on the produced HTML — the field-drift class of bug
+(round 4 found two) can no longer hide in a render path.
+"""
+
+from tests.jsdom.dom import Document, Element  # noqa: F401
+from tests.jsdom.mini_js import JSInterpreter, JSObject, UNDEFINED  # noqa: F401

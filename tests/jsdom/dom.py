@@ -1,0 +1,74 @@
+"""Minimal DOM shim for the panels render harness (VERDICT r4 #3).
+
+Elements are JSObjects (so `el.innerHTML = ...` rides the
+interpreter's normal member assignment); the document keeps an id
+registry so `$("x")` resolves, auto-creating stubs for ids that the
+panels themselves create via innerHTML (the harness asserts on the
+HTML strings, it does not build a layout tree).
+"""
+
+from __future__ import annotations
+
+from tests.jsdom.mini_js import JSObject, UNDEFINED, to_js_string
+
+
+class Element(JSObject):
+    def __init__(self, tag: str = "div", elt_id: str = ""):
+        super().__init__()
+        self["tagName"] = tag.upper()
+        self["id"] = elt_id
+        self["innerHTML"] = ""
+        self["textContent"] = ""
+        self["value"] = ""
+        self["checked"] = False
+        self["style"] = JSObject({"cssText": "", "display": ""})
+        self["dataset"] = JSObject()
+        classes: set = set()
+        self["classList"] = JSObject({
+            "add": lambda *cs: [classes.add(to_js_string(c))
+                                for c in cs] and None,
+            "remove": lambda *cs: [classes.discard(to_js_string(c))
+                                   for c in cs] and None,
+            "contains": lambda c="": to_js_string(c) in classes,
+            "toggle": lambda c, force=UNDEFINED: _toggle(
+                classes, to_js_string(c), force),
+        })
+        self["remove"] = lambda: None
+        self["focus"] = lambda: None
+        self["appendChild"] = lambda child: child
+        self["addEventListener"] = lambda *a: None
+        self["querySelector"] = lambda sel="": None
+        self["querySelectorAll"] = lambda sel="": []
+        self["getContext"] = lambda *a: None
+
+
+def _toggle(classes, c, force):
+    if force is not UNDEFINED:
+        (classes.add if force else classes.discard)(c)
+        return bool(force)
+    if c in classes:
+        classes.discard(c)
+        return False
+    classes.add(c)
+    return True
+
+
+class Document(JSObject):
+    def __init__(self):
+        super().__init__()
+        self._by_id: dict[str, Element] = {}
+        self["body"] = Element("body")
+        self["createElement"] = self.create_element
+        self["getElementById"] = self.get_element_by_id
+
+    def create_element(self, tag="div"):
+        return Element(to_js_string(tag))
+
+    def get_element_by_id(self, elt_id=""):
+        """Auto-create: panels write ids via innerHTML then $() them;
+        the harness asserts on HTML strings, so a fresh stub is the
+        right answer for any id."""
+        key = to_js_string(elt_id)
+        if key not in self._by_id:
+            self._by_id[key] = Element("div", key)
+        return self._by_id[key]

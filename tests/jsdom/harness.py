@@ -1,0 +1,136 @@
+"""Panel render harness: executes ui/panels.js with native stand-ins
+for the app.js helper surface ($, esc, when, api, toast, dialogs, ws
+plumbing) and a pluggable payload source, so tests render every panel
+against REAL route payloads and assert on the produced HTML
+(VERDICT r4 #3).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tests.jsdom.dom import Document, Element
+from tests.jsdom.mini_js import (
+    UNDEFINED,
+    JSInterpreter,
+    JSObject,
+    js_to_py,
+    py_to_js,
+    to_js_string,
+)
+
+PANELS_JS = os.path.join(os.path.dirname(__file__), "..", "..",
+                         "ui", "panels.js")
+
+
+def _esc(v=UNDEFINED, *rest):
+    s = "" if v is None or v is UNDEFINED else to_js_string(v)
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;").replace('"', "&quot;"))
+
+
+def _when(ts=UNDEFINED, *rest):
+    if ts is None or ts is UNDEFINED or ts == 0 or ts == "":
+        return ""
+    if isinstance(ts, (int, float)):
+        import datetime
+
+        return datetime.datetime.fromtimestamp(
+            float(ts), datetime.timezone.utc
+        ).strftime("%Y-%m-%d %H:%M:%S")
+    return to_js_string(ts)
+
+
+class PanelHarness:
+    """api_fn(method: str, path: str, body: dict|None) -> dict —
+    typically backed by a live test server so field drift between
+    routes and panels is caught, not fixtured away."""
+
+    def __init__(self, api_fn, confirm_answer=True,
+                 prompt_answer="harness-input"):
+        self.api_fn = api_fn
+        self.api_calls: list[tuple] = []
+        self.toasts: list[str] = []
+        self.subscriptions: list[str] = []
+        self.timeouts: list = []       # recorded, never fired
+        self.confirm_answer = confirm_answer
+        self.prompt_answer = prompt_answer
+
+        self.interp = JSInterpreter()
+        self.document = Document()
+        g = self.interp.set_global
+        g("document", self.document)
+        g("$", self.document.get_element_by_id)
+        g("esc", _esc)
+        g("when", _when)
+        g("api", self._api)
+        g("toast", lambda text=UNDEFINED, *r: self.toasts.append(
+            to_js_string(text)))
+        g("subscribe", lambda ch=UNDEFINED, *r:
+          self.subscriptions.append(to_js_string(ch)))
+        g("unsubscribe", lambda ch=UNDEFINED, *r: None)
+        g("wsHandlers", JSObject())
+        g("wsLog", [])
+        g("currentView", "swarm")
+        g("selectedRoom", None)
+        g("confirmDialog", lambda text=UNDEFINED, ok=UNDEFINED, *r:
+          self.confirm_answer)
+        g("promptDialog", lambda text=UNDEFINED, ph=UNDEFINED, *r:
+          self.prompt_answer)
+        g("refreshView", lambda *r: UNDEFINED)
+        g("showView", lambda *r: UNDEFINED)
+        g("setTimeout", self._set_timeout)
+        g("clearTimeout", lambda *r: None)
+        g("setInterval", self._set_timeout)
+        g("clearInterval", lambda *r: None)
+        g("TOKEN", "harness-token")
+
+        with open(PANELS_JS) as f:
+            self.interp.run(f.read())
+
+    # -- shims --
+
+    def _api(self, method=UNDEFINED, path=UNDEFINED, body=UNDEFINED):
+        m = to_js_string(method)
+        p = to_js_string(path)
+        b = js_to_py(body) if body is not UNDEFINED else None
+        self.api_calls.append((m, p, b))
+        return py_to_js(self.api_fn(m, p, b))
+
+    def _set_timeout(self, fn=UNDEFINED, delay=0, *rest):
+        # recorded but never run: poll loops must not spin the harness
+        self.timeouts.append((fn, delay))
+        return len(self.timeouts)
+
+    # -- drive --
+
+    def panels(self) -> dict:
+        return self.interp.get_global("PANELS")
+
+    def panel_keys(self) -> list[str]:
+        return list(self.panels().keys())
+
+    def render(self, key: str) -> str:
+        """Run PANELS[key].render(el); return the element's HTML."""
+        panel = self.panels().get_prop(key)
+        if panel is UNDEFINED:
+            raise KeyError(f"no panel {key!r}")
+        el = Element("div", f"view-{key}")
+        self.document._by_id[f"view-{key}"] = el
+        self.interp.call(panel.get_prop("render"), el)
+        return to_js_string(el.get_prop("innerHTML"))
+
+    def call_global(self, name: str, *args):
+        return self.interp.call(self.interp.get_global(name), *args)
+
+    def element_html(self, elt_id: str) -> str:
+        return to_js_string(
+            self.document.get_element_by_id(elt_id)
+            .get_prop("innerHTML"))
+
+    def ws_dispatch(self, msg: dict):
+        """Deliver one WS message to every registered handler (the
+        app.js onmessage loop)."""
+        handlers = self.interp.get_global("wsHandlers")
+        for fn in list(handlers.values()):
+            self.interp.call(fn, py_to_js(msg))

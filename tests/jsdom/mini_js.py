@@ -1,0 +1,2085 @@
+"""A small tree-walking interpreter for the JS subset used by
+ui/panels.js (VERDICT r4 #3: execute the dashboard's render functions
+in CI with no JS engine in the image).
+
+Supported: const/let/var with object/array destructuring (+defaults,
+rest), function declarations/expressions/arrows (async treated as
+synchronous — the harness's fetch substitute resolves immediately),
+template literals (nested), regex literals, spread in
+calls/arrays/objects, optional chaining, nullish coalescing, ternary,
+for / for-of / while, try/catch(/finally) with optional binding,
+throw, JS truthiness and string coercion, === semantics, undefined vs
+null, and the built-ins the dashboard uses (Object, Math, JSON,
+Date.now, parseInt/Float, encodeURIComponent, Array/String methods).
+
+Deliberately NOT a general engine: no classes, generators, labels,
+getters, prototypes, `new`, or event loop — panels.js uses none of
+them, and the full-panel render sweep in tests/test_ui_render.py keeps
+it inside this subset (a construct the interpreter lacks fails the
+sweep with a SyntaxError at parse time).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+import re
+import urllib.parse
+
+# ---------------------------------------------------------------- values
+
+
+class _Undefined:
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "undefined"
+
+    def __bool__(self):
+        return False
+
+
+UNDEFINED = _Undefined()
+
+
+class JSObject(dict):
+    """A JS object: plain dict with attribute-ish get returning
+    UNDEFINED for missing keys."""
+
+    def get_prop(self, name):
+        return self[name] if name in self else UNDEFINED
+
+
+class JSRegex:
+    def __init__(self, pattern: str, flags: str):
+        f = 0
+        if "i" in flags:
+            f |= re.IGNORECASE
+        if "m" in flags:
+            f |= re.MULTILINE
+        if "s" in flags:
+            f |= re.DOTALL
+        self.global_ = "g" in flags
+        self.re = re.compile(_js_regex_to_py(pattern), f)
+
+    def exec(self, s):
+        m = self.re.search(to_js_string(s))
+        if not m:
+            return None
+        out = [m.group(0)] + [
+            g if g is not None else UNDEFINED for g in m.groups()
+        ]
+        return out
+
+    def test(self, s):
+        return self.re.search(to_js_string(s)) is not None
+
+
+def _js_regex_to_py(pat: str) -> str:
+    # \d \w etc. are shared; JS's `\/` escape is meaningless to Python
+    return pat.replace(r"\/", "/")
+
+
+class JSFunction:
+    def __init__(self, name, params, body, env, interp,
+                 is_expr_body=False):
+        self.name = name or "<anonymous>"
+        self.params = params          # list of patterns
+        self.body = body
+        self.env = env
+        self.interp = interp
+        self.is_expr_body = is_expr_body
+
+    def call(self, this, args):
+        env = Env(parent=self.env)
+        self.interp.bind_params(env, self.params, args)
+        env.declare("this", this if this is not None else UNDEFINED)
+        if self.is_expr_body:
+            return self.interp.eval_expr(self.body, env)
+        try:
+            self.interp.exec_block(self.body, env)
+        except _Return as r:
+            return r.value
+        return UNDEFINED
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class JSThrow(Exception):
+    def __init__(self, value):
+        self.value = value
+        super().__init__(to_js_string(
+            value.get_prop("message") if isinstance(value, JSObject)
+            else value
+        ))
+
+
+# ------------------------------------------------------------- coercions
+
+
+def truthy(v) -> bool:
+    if v is UNDEFINED or v is None or v is False:
+        return False
+    if v is True:
+        return True
+    if isinstance(v, (int, float)):
+        return v != 0 and not (isinstance(v, float) and math.isnan(v))
+    if isinstance(v, str):
+        return len(v) > 0
+    return True  # objects, arrays, functions
+
+
+def to_js_string(v) -> str:
+    if v is UNDEFINED:
+        return "undefined"
+    if v is None:
+        return "null"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if v == math.inf:
+            return "Infinity"
+        if v == -math.inf:
+            return "-Infinity"
+        if v.is_integer() and abs(v) < 1e21:
+            return str(int(v))
+        return repr(v)
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, str):
+        return v
+    if isinstance(v, list):
+        return ",".join(
+            "" if x is None or x is UNDEFINED else to_js_string(x)
+            for x in v
+        )
+    if isinstance(v, JSObject):
+        return "[object Object]"
+    if isinstance(v, (JSFunction,)) or callable(v):
+        return f"function {getattr(v, 'name', '')}() {{ ... }}"
+    return str(v)
+
+
+def to_number(v):
+    if v is True:
+        return 1
+    if v is False or v is None:
+        return 0
+    if v is UNDEFINED:
+        return math.nan
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        s = v.strip()
+        if not s:
+            return 0
+        try:
+            return int(s)
+        except ValueError:
+            try:
+                return float(s)
+            except ValueError:
+                return math.nan
+    return math.nan
+
+
+def js_equals_strict(a, b) -> bool:
+    if a is UNDEFINED or b is UNDEFINED:
+        return a is b
+    if a is None or b is None:
+        return a is b
+    if isinstance(a, bool) or isinstance(b, bool):
+        return a is b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return float(a) == float(b)
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    return a is b
+
+
+def js_equals_loose(a, b) -> bool:
+    nullish_a = a is None or a is UNDEFINED
+    nullish_b = b is None or b is UNDEFINED
+    if nullish_a or nullish_b:
+        return nullish_a and nullish_b
+    if isinstance(a, str) and isinstance(b, (int, float)) or \
+            isinstance(b, str) and isinstance(a, (int, float)):
+        return to_number(a) == to_number(b)
+    return js_equals_strict(a, b)
+
+
+# ------------------------------------------------------------- tokenizer
+
+KEYWORDS = {
+    "const", "let", "var", "function", "return", "if", "else", "for",
+    "while", "of", "in", "break", "continue", "try", "catch",
+    "finally", "throw", "true", "false", "null", "undefined", "async",
+    "await", "typeof", "delete", "new", "this", "do",
+}
+
+PUNCT3 = ("===", "!==", "**=", "...", "??=", "&&=", "||=")
+PUNCT2 = ("=>", "==", "!=", "<=", ">=", "&&", "||", "??", "?.", "+=",
+          "-=", "*=", "/=", "%=", "++", "--", "**")
+
+
+class Token:
+    __slots__ = ("type", "value", "pos")
+
+    def __init__(self, type_, value, pos):
+        self.type = type_
+        self.value = value
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.type}:{self.value!r}"
+
+
+class Tokenizer:
+    def __init__(self, src: str):
+        self.src = src
+        self.i = 0
+        self.n = len(src)
+        self.tokens: list[Token] = []
+
+    def error(self, msg):
+        line = self.src.count("\n", 0, self.i) + 1
+        raise SyntaxError(f"js tokenize: {msg} at line {line}")
+
+    def run(self) -> list[Token]:
+        while self.i < self.n:
+            c = self.src[self.i]
+            if c in " \t\r\n":
+                self.i += 1
+            elif self.src.startswith("//", self.i):
+                j = self.src.find("\n", self.i)
+                self.i = self.n if j < 0 else j
+            elif self.src.startswith("/*", self.i):
+                j = self.src.find("*/", self.i + 2)
+                if j < 0:
+                    self.error("unterminated block comment")
+                self.i = j + 2
+            elif c in "'\"":
+                self.tokens.append(self.read_string(c))
+            elif c == "`":
+                self.tokens.append(self.read_template())
+            elif c.isdigit() or (c == "." and self.i + 1 < self.n
+                                 and self.src[self.i + 1].isdigit()):
+                self.tokens.append(self.read_number())
+            elif c.isalpha() or c in "_$":
+                self.tokens.append(self.read_ident())
+            elif c == "/" and self.regex_allowed():
+                self.tokens.append(self.read_regex())
+            else:
+                self.tokens.append(self.read_punct())
+        self.tokens.append(Token("eof", None, self.i))
+        return self.tokens
+
+    def regex_allowed(self) -> bool:
+        """A `/` starts a regex unless the previous token can end an
+        expression."""
+        for t in reversed(self.tokens):
+            if t.type in ("num", "str", "tmpl", "regex"):
+                return False
+            if t.type == "ident":
+                return t.value in KEYWORDS and t.value not in (
+                    "this", "true", "false", "null", "undefined",
+                )
+            if t.type == "punct":
+                return t.value not in (")", "]", "}")
+            return True
+        return True
+
+    def read_string(self, quote) -> Token:
+        start = self.i
+        self.i += 1
+        out = []
+        while self.i < self.n:
+            c = self.src[self.i]
+            if c == "\\":
+                out.append(self.read_escape())
+            elif c == quote:
+                self.i += 1
+                return Token("str", "".join(out), start)
+            elif c == "\n":
+                self.error("newline in string")
+            else:
+                out.append(c)
+                self.i += 1
+        self.error("unterminated string")
+
+    def read_escape(self) -> str:
+        self.i += 1  # backslash
+        c = self.src[self.i]
+        self.i += 1
+        table = {"n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+                 "v": "\v", "0": "\0"}
+        if c == "u":
+            if self.src[self.i] == "{":
+                j = self.src.index("}", self.i)
+                code = int(self.src[self.i + 1:j], 16)
+                self.i = j + 1
+            else:
+                code = int(self.src[self.i:self.i + 4], 16)
+                self.i += 4
+            return chr(code)
+        if c == "x":
+            code = int(self.src[self.i:self.i + 2], 16)
+            self.i += 2
+            return chr(code)
+        if c == "\n":
+            return ""
+        return table.get(c, c)
+
+    def read_template(self) -> Token:
+        """Parts: ("str", text) | ("expr", [tokens])."""
+        start = self.i
+        self.i += 1
+        parts = []
+        buf = []
+        while True:
+            if self.i >= self.n:
+                self.error("unterminated template literal")
+            c = self.src[self.i]
+            if c == "\\":
+                buf.append(self.read_escape())
+            elif c == "`":
+                self.i += 1
+                if buf:
+                    parts.append(("str", "".join(buf)))
+                return Token("tmpl", parts, start)
+            elif self.src.startswith("${", self.i):
+                if buf:
+                    parts.append(("str", "".join(buf)))
+                    buf = []
+                j = self.find_matching_brace(self.i + 2)
+                inner = self.src[self.i + 2:j]
+                parts.append(("expr", Tokenizer(inner).run()))
+                self.i = j + 1
+            else:
+                buf.append(c)
+                self.i += 1
+
+    def find_matching_brace(self, start: int) -> int:
+        """Index of the `}` closing the `${` whose body starts at
+        `start`, skipping strings / templates / comments."""
+        depth = 1
+        i = start
+        while i < self.n:
+            c = self.src[i]
+            if c in "'\"":
+                i = self.skip_string(i, c)
+            elif c == "`":
+                i = self.skip_template(i)
+            elif self.src.startswith("//", i):
+                j = self.src.find("\n", i)
+                i = self.n if j < 0 else j
+            elif self.src.startswith("/*", i):
+                i = self.src.index("*/", i) + 2
+            elif c == "{":
+                depth += 1
+                i += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    return i
+                i += 1
+            else:
+                i += 1
+        self.error("unterminated ${...}")
+
+    def skip_string(self, i: int, quote: str) -> int:
+        i += 1
+        while i < self.n:
+            if self.src[i] == "\\":
+                i += 2
+            elif self.src[i] == quote:
+                return i + 1
+            else:
+                i += 1
+        self.error("unterminated string")
+
+    def skip_template(self, i: int) -> int:
+        i += 1
+        while i < self.n:
+            c = self.src[i]
+            if c == "\\":
+                i += 2
+            elif c == "`":
+                return i + 1
+            elif self.src.startswith("${", i):
+                i = self.find_matching_brace(i + 2) + 1
+            else:
+                i += 1
+        self.error("unterminated template")
+
+    def read_number(self) -> Token:
+        start = self.i
+        if self.src.startswith(("0x", "0X"), self.i):
+            self.i += 2
+            while self.i < self.n and self.src[self.i] in \
+                    "0123456789abcdefABCDEF_":
+                self.i += 1
+            return Token(
+                "num",
+                int(self.src[start + 2:self.i].replace("_", ""), 16),
+                start,
+            )
+        seen_dot = seen_e = False
+        while self.i < self.n:
+            c = self.src[self.i]
+            if c.isdigit() or c == "_":
+                self.i += 1
+            elif c == "." and not seen_dot and not seen_e:
+                seen_dot = True
+                self.i += 1
+            elif c in "eE" and not seen_e:
+                seen_e = True
+                self.i += 1
+                if self.i < self.n and self.src[self.i] in "+-":
+                    self.i += 1
+            else:
+                break
+        text = self.src[start:self.i].replace("_", "")
+        value = float(text) if (seen_dot or seen_e) else int(text)
+        return Token("num", value, start)
+
+    def read_ident(self) -> Token:
+        start = self.i
+        while self.i < self.n and (self.src[self.i].isalnum()
+                                   or self.src[self.i] in "_$"):
+            self.i += 1
+        return Token("ident", self.src[start:self.i], start)
+
+    def read_regex(self) -> Token:
+        start = self.i
+        self.i += 1
+        in_class = False
+        pat = []
+        while self.i < self.n:
+            c = self.src[self.i]
+            if c == "\\":
+                pat.append(self.src[self.i:self.i + 2])
+                self.i += 2
+            elif c == "[":
+                in_class = True
+                pat.append(c)
+                self.i += 1
+            elif c == "]":
+                in_class = False
+                pat.append(c)
+                self.i += 1
+            elif c == "/" and not in_class:
+                self.i += 1
+                fstart = self.i
+                while self.i < self.n and self.src[self.i].isalpha():
+                    self.i += 1
+                return Token(
+                    "regex",
+                    ("".join(pat), self.src[fstart:self.i]),
+                    start,
+                )
+            elif c == "\n":
+                self.error("newline in regex")
+            else:
+                pat.append(c)
+                self.i += 1
+        self.error("unterminated regex")
+
+    def read_punct(self) -> Token:
+        for group in (PUNCT3, PUNCT2):
+            for p in group:
+                if self.src.startswith(p, self.i):
+                    t = Token("punct", p, self.i)
+                    self.i += len(p)
+                    return t
+        t = Token("punct", self.src[self.i], self.i)
+        self.i += 1
+        return t
+
+
+# ---------------------------------------------------------------- parser
+
+
+class Parser:
+    def __init__(self, tokens: list[Token], src: str = ""):
+        self.toks = tokens
+        self.i = 0
+        self.src = src
+
+    # -- token helpers --
+
+    def peek(self, k=0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.type != "eof":
+            self.i += 1
+        return t
+
+    def at_punct(self, *vals) -> bool:
+        t = self.peek()
+        return t.type == "punct" and t.value in vals
+
+    def at_kw(self, *vals) -> bool:
+        t = self.peek()
+        return t.type == "ident" and t.value in vals
+
+    def expect(self, value):
+        t = self.next()
+        ok = (t.type == "punct" and t.value == value) or \
+             (t.type == "ident" and t.value == value)
+        if not ok:
+            self.error(f"expected {value!r}, got {t!r}")
+        return t
+
+    def error(self, msg):
+        t = self.peek()
+        line = self.src.count("\n", 0, t.pos) + 1 if self.src else "?"
+        raise SyntaxError(f"js parse: {msg} (line {line})")
+
+    def eat_semi(self):
+        if self.at_punct(";"):
+            self.next()
+
+    # -- program / statements --
+
+    def parse_program(self) -> list:
+        stmts = []
+        while self.peek().type != "eof":
+            stmts.append(self.parse_statement())
+        return stmts
+
+    def parse_statement(self):
+        t = self.peek()
+        if t.type == "punct" and t.value == "{":
+            return ("block", self.parse_block())
+        if t.type == "punct" and t.value == ";":
+            self.next()
+            return ("empty",)
+        if t.type == "str" and self.peek(1).type == "punct" and \
+                self.peek(1).value == ";":
+            self.next()  # "use strict" etc.
+            self.next()
+            return ("empty",)
+        if t.type != "ident":
+            e = self.parse_expression()
+            self.eat_semi()
+            return ("expr", e)
+        kw = t.value
+        if kw in ("const", "let", "var"):
+            return self.parse_var()
+        if kw == "function" or (kw == "async"
+                                and self.peek(1).type == "ident"
+                                and self.peek(1).value == "function"):
+            return self.parse_function_decl()
+        if kw == "if":
+            return self.parse_if()
+        if kw == "for":
+            return self.parse_for()
+        if kw == "while":
+            return self.parse_while()
+        if kw == "return":
+            self.next()
+            if self.at_punct(";") or self.at_punct("}"):
+                self.eat_semi()
+                return ("ret", ("undef",))
+            e = self.parse_expression()
+            self.eat_semi()
+            return ("ret", e)
+        if kw == "break":
+            self.next()
+            self.eat_semi()
+            return ("brk",)
+        if kw == "continue":
+            self.next()
+            self.eat_semi()
+            return ("cont",)
+        if kw == "throw":
+            self.next()
+            e = self.parse_expression()
+            self.eat_semi()
+            return ("throw", e)
+        if kw == "try":
+            return self.parse_try()
+        e = self.parse_expression()
+        self.eat_semi()
+        return ("expr", e)
+
+    def parse_block(self) -> list:
+        self.expect("{")
+        out = []
+        while not self.at_punct("}"):
+            out.append(self.parse_statement())
+        self.expect("}")
+        return out
+
+    def parse_var(self):
+        kind = self.next().value
+        decls = []
+        while True:
+            pattern = self.parse_binding_pattern()
+            init = ("undef",)
+            if self.at_punct("="):
+                self.next()
+                init = self.parse_assignment()
+            decls.append((pattern, init))
+            if self.at_punct(","):
+                self.next()
+                continue
+            break
+        self.eat_semi()
+        return ("var", kind, decls)
+
+    def parse_binding_pattern(self):
+        if self.at_punct("{"):
+            self.next()
+            props = []
+            rest = None
+            while not self.at_punct("}"):
+                if self.at_punct("..."):
+                    self.next()
+                    rest = self.next().value
+                else:
+                    key = self.next().value
+                    sub = ("pid", key, None)
+                    if self.at_punct(":"):
+                        self.next()
+                        sub = self.parse_binding_pattern()
+                    if self.at_punct("="):
+                        self.next()
+                        default = self.parse_assignment()
+                        if sub[0] == "pid":
+                            sub = ("pid", sub[1], default)
+                        else:
+                            sub = ("pdefault", sub, default)
+                    props.append((key, sub))
+                if self.at_punct(","):
+                    self.next()
+            self.expect("}")
+            return ("pobj", props, rest)
+        if self.at_punct("["):
+            self.next()
+            elts = []
+            while not self.at_punct("]"):
+                if self.at_punct(","):
+                    elts.append(None)
+                    self.next()
+                    continue
+                sub = self.parse_binding_pattern()
+                if self.at_punct("="):
+                    self.next()
+                    sub = ("pdefault", sub, self.parse_assignment())
+                elts.append(sub)
+                if self.at_punct(","):
+                    self.next()
+            self.expect("]")
+            return ("parr", elts)
+        name = self.next()
+        if name.type != "ident":
+            self.error(f"bad binding target {name!r}")
+        return ("pid", name.value, None)
+
+    def parse_function_decl(self):
+        is_async = False
+        if self.at_kw("async"):
+            self.next()
+            is_async = True
+        self.expect("function")
+        name = self.next().value
+        params = self.parse_params()
+        body = self.parse_block()
+        return ("fndecl", name, params, body, is_async)
+
+    def parse_params(self) -> list:
+        self.expect("(")
+        params = []
+        while not self.at_punct(")"):
+            if self.at_punct("..."):
+                self.next()
+                params.append(("prest", self.next().value))
+            else:
+                p = self.parse_binding_pattern()
+                if self.at_punct("="):
+                    self.next()
+                    p = ("pdefault", p, self.parse_assignment())
+                params.append(p)
+            if self.at_punct(","):
+                self.next()
+        self.expect(")")
+        return params
+
+    def parse_if(self):
+        self.expect("if")
+        self.expect("(")
+        cond = self.parse_expression()
+        self.expect(")")
+        then = self.parse_statement()
+        other = None
+        if self.at_kw("else"):
+            self.next()
+            other = self.parse_statement()
+        return ("if", cond, then, other)
+
+    def parse_for(self):
+        self.expect("for")
+        self.expect("(")
+        init = None
+        if self.at_punct(";"):
+            self.next()
+        elif self.at_kw("const", "let", "var"):
+            kind = self.next().value
+            pattern = self.parse_binding_pattern()
+            if self.at_kw("of", "in"):
+                mode = self.next().value
+                it = self.parse_expression()
+                self.expect(")")
+                body = self.parse_statement()
+                return ("forof" if mode == "of" else "forin",
+                        kind, pattern, it, body)
+            init_expr = ("undef",)
+            if self.at_punct("="):
+                self.next()
+                init_expr = self.parse_assignment()
+            init = ("var", kind, [(pattern, init_expr)])
+            self.expect(";")
+        else:
+            init = ("expr", self.parse_expression())
+            self.expect(";")
+        cond = None
+        if not self.at_punct(";"):
+            cond = self.parse_expression()
+        self.expect(";")
+        update = None
+        if not self.at_punct(")"):
+            update = self.parse_expression()
+        self.expect(")")
+        body = self.parse_statement()
+        return ("for", init, cond, update, body)
+
+    def parse_while(self):
+        self.expect("while")
+        self.expect("(")
+        cond = self.parse_expression()
+        self.expect(")")
+        return ("while", cond, self.parse_statement())
+
+    def parse_try(self):
+        self.expect("try")
+        block = self.parse_block()
+        param = None
+        handler = None
+        final = None
+        if self.at_kw("catch"):
+            self.next()
+            if self.at_punct("("):
+                self.next()
+                param = self.parse_binding_pattern()
+                self.expect(")")
+            handler = self.parse_block()
+        if self.at_kw("finally"):
+            self.next()
+            final = self.parse_block()
+        return ("try", block, param, handler, final)
+
+    # -- expressions (precedence climbing) --
+
+    def parse_expression(self):
+        e = self.parse_assignment()
+        while self.at_punct(","):
+            self.next()
+            e = ("seq", e, self.parse_assignment())
+        return e
+
+    def parse_assignment(self):
+        if self.is_arrow_ahead():
+            return self.parse_arrow()
+        left = self.parse_conditional()
+        if self.at_punct("=", "+=", "-=", "*=", "/=", "%=", "??="):
+            op = self.next().value
+            right = self.parse_assignment()
+            return ("assign", op, left, right)
+        return left
+
+    def is_arrow_ahead(self) -> bool:
+        """Lookahead for `ident =>`, `async ident =>`, `( ... ) =>`,
+        `async ( ... ) =>`."""
+        j = self.i
+        toks = self.toks
+        if toks[j].type == "ident" and toks[j].value == "async":
+            j += 1
+        t = toks[j]
+        if t.type == "ident" and t.value not in KEYWORDS:
+            nxt = toks[j + 1]
+            return nxt.type == "punct" and nxt.value == "=>"
+        if t.type == "punct" and t.value == "(":
+            depth = 0
+            while j < len(toks):
+                tj = toks[j]
+                if tj.type == "punct" and tj.value == "(":
+                    depth += 1
+                elif tj.type == "punct" and tj.value == ")":
+                    depth -= 1
+                    if depth == 0:
+                        nxt = toks[j + 1]
+                        return nxt.type == "punct" and \
+                            nxt.value == "=>"
+                elif tj.type == "eof":
+                    return False
+                j += 1
+        return False
+
+    def parse_arrow(self):
+        if self.at_kw("async"):
+            self.next()
+        if self.at_punct("("):
+            params = self.parse_params()
+        else:
+            params = [("pid", self.next().value, None)]
+        self.expect("=>")
+        if self.at_punct("{"):
+            body = self.parse_block()
+            return ("arrow", params, body, False)
+        return ("arrow", params, self.parse_assignment(), True)
+
+    def parse_conditional(self):
+        cond = self.parse_nullish()
+        if self.at_punct("?"):
+            self.next()
+            a = self.parse_assignment()
+            self.expect(":")
+            b = self.parse_assignment()
+            return ("cond", cond, a, b)
+        return cond
+
+    def _binary(self, sub, *ops):
+        e = sub()
+        while self.at_punct(*ops):
+            op = self.next().value
+            e = ("bin", op, e, sub())
+        return e
+
+    def parse_nullish(self):
+        e = self.parse_or()
+        while self.at_punct("??"):
+            self.next()
+            e = ("nullish", e, self.parse_or())
+        return e
+
+    def parse_or(self):
+        e = self.parse_and()
+        while self.at_punct("||"):
+            self.next()
+            e = ("or", e, self.parse_and())
+        return e
+
+    def parse_and(self):
+        e = self.parse_equality()
+        while self.at_punct("&&"):
+            self.next()
+            e = ("and", e, self.parse_equality())
+        return e
+
+    def parse_equality(self):
+        return self._binary(self.parse_relational,
+                            "===", "!==", "==", "!=")
+
+    def parse_relational(self):
+        e = self.parse_additive()
+        while self.at_punct("<", ">", "<=", ">=") or self.at_kw("in"):
+            if self.at_kw("in"):
+                self.next()
+                e = ("bin", "in", e, self.parse_additive())
+            else:
+                op = self.next().value
+                e = ("bin", op, e, self.parse_additive())
+        return e
+
+    def parse_additive(self):
+        return self._binary(self.parse_multiplicative, "+", "-")
+
+    def parse_multiplicative(self):
+        return self._binary(self.parse_unary, "*", "/", "%")
+
+    def parse_unary(self):
+        if self.at_punct("!", "-", "+"):
+            op = self.next().value
+            return ("un", op, self.parse_unary())
+        if self.at_kw("typeof"):
+            self.next()
+            return ("typeof", self.parse_unary())
+        if self.at_kw("delete"):
+            self.next()
+            return ("delete", self.parse_unary())
+        if self.at_kw("await"):
+            self.next()
+            return ("await", self.parse_unary())
+        return self.parse_postfix()
+
+    def parse_postfix(self):
+        e = self.parse_primary()
+        while True:
+            if self.at_punct("."):
+                self.next()
+                e = ("member", e, self.next().value, False)
+            elif self.at_punct("?."):
+                self.next()
+                if self.at_punct("("):
+                    e = ("call", e, self.parse_args(), True)
+                elif self.at_punct("["):
+                    self.next()
+                    idx = self.parse_expression()
+                    self.expect("]")
+                    e = ("index", e, idx, True)
+                else:
+                    e = ("member", e, self.next().value, True)
+            elif self.at_punct("["):
+                self.next()
+                idx = self.parse_expression()
+                self.expect("]")
+                e = ("index", e, idx, False)
+            elif self.at_punct("("):
+                e = ("call", e, self.parse_args(), False)
+            else:
+                return e
+
+    def parse_args(self) -> list:
+        self.expect("(")
+        args = []
+        while not self.at_punct(")"):
+            if self.at_punct("..."):
+                self.next()
+                args.append(("spread", self.parse_assignment()))
+            else:
+                args.append(self.parse_assignment())
+            if self.at_punct(","):
+                self.next()
+        self.expect(")")
+        return args
+
+    def parse_primary(self):
+        t = self.peek()
+        if t.type == "num":
+            self.next()
+            return ("num", t.value)
+        if t.type == "str":
+            self.next()
+            return ("strlit", t.value)
+        if t.type == "tmpl":
+            self.next()
+            parts = []
+            for kind, payload in t.value:
+                if kind == "str":
+                    parts.append(("str", payload))
+                else:
+                    parts.append(
+                        ("expr",
+                         Parser(payload, self.src).parse_expression())
+                    )
+            return ("tmpl", parts)
+        if t.type == "regex":
+            self.next()
+            return ("regex", t.value[0], t.value[1])
+        if t.type == "punct":
+            if t.value == "(":
+                self.next()
+                e = self.parse_expression()
+                self.expect(")")
+                return e
+            if t.value == "[":
+                return self.parse_array()
+            if t.value == "{":
+                return self.parse_object()
+            self.error(f"unexpected token {t!r}")
+        kw = t.value
+        if kw == "function" or (
+                kw == "async" and self.peek(1).type == "ident"
+                and self.peek(1).value == "function"):
+            if kw == "async":
+                self.next()
+            self.next()
+            name = None
+            if self.peek().type == "ident" and not self.at_punct("("):
+                name = self.next().value
+            params = self.parse_params()
+            body = self.parse_block()
+            return ("funcexpr", name, params, body)
+        if kw == "true":
+            self.next()
+            return ("bool", True)
+        if kw == "false":
+            self.next()
+            return ("bool", False)
+        if kw == "null":
+            self.next()
+            return ("null",)
+        if kw == "undefined":
+            self.next()
+            return ("undef",)
+        if kw == "this":
+            self.next()
+            return ("ident", "this")
+        if kw == "new":
+            self.error("`new` is outside the supported subset")
+        self.next()
+        return ("ident", kw)
+
+    def parse_array(self):
+        self.expect("[")
+        elts = []
+        while not self.at_punct("]"):
+            if self.at_punct("..."):
+                self.next()
+                elts.append(("spread", self.parse_assignment()))
+            else:
+                elts.append(self.parse_assignment())
+            if self.at_punct(","):
+                self.next()
+        self.expect("]")
+        return ("arr", elts)
+
+    def parse_object(self):
+        self.expect("{")
+        props = []
+        while not self.at_punct("}"):
+            if self.at_punct("..."):
+                self.next()
+                props.append(("spread", self.parse_assignment()))
+            else:
+                t = self.next()
+                if t.type in ("str", "num"):
+                    key = to_js_string(t.value)
+                elif t.type == "punct" and t.value == "[":
+                    key_expr = self.parse_assignment()
+                    self.expect("]")
+                    self.expect(":")
+                    props.append(("computed", key_expr,
+                                  self.parse_assignment()))
+                    if self.at_punct(","):
+                        self.next()
+                    continue
+                else:
+                    key = t.value
+                if self.at_punct(":"):
+                    self.next()
+                    props.append(("kv", key, self.parse_assignment()))
+                elif self.at_punct("("):
+                    params = self.parse_params()
+                    body = self.parse_block()
+                    props.append(
+                        ("kv", key, ("funcexpr", key, params, body)))
+                else:
+                    props.append(("kv", key, ("ident", key)))
+            if self.at_punct(","):
+                self.next()
+        self.expect("}")
+        return ("obj", props)
+
+
+# ------------------------------------------------------------ evaluator
+
+
+class Env:
+    __slots__ = ("vars", "parent")
+
+    def __init__(self, parent=None):
+        self.vars: dict = {}
+        self.parent = parent
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+    def lookup_env(self, name):
+        e = self
+        while e is not None:
+            if name in e.vars:
+                return e
+            e = e.parent
+        return None
+
+    def get(self, name):
+        e = self.lookup_env(name)
+        if e is None:
+            raise JSThrow(f"ReferenceError: {name} is not defined")
+        return e.vars[name]
+
+    def set(self, name, value):
+        e = self.lookup_env(name)
+        if e is None:
+            # non-declared assignment lands on the global env (panels
+            # run as classic scripts, not modules)
+            e = self
+            while e.parent is not None:
+                e = e.parent
+        e.vars[name] = value
+
+
+class JSInterpreter:
+    def __init__(self):
+        self.global_env = Env()
+        self._install_builtins()
+
+    # -- public API --
+
+    def run(self, src: str):
+        toks = Tokenizer(src).run()
+        prog = Parser(toks, src).parse_program()
+        # hoist function declarations (panels call across definition
+        # order)
+        for st in prog:
+            if st[0] == "fndecl":
+                _, name, params, body, _async = st
+                self.global_env.declare(
+                    name,
+                    JSFunction(name, params, body, self.global_env,
+                               self),
+                )
+        for st in prog:
+            if st[0] != "fndecl":
+                self.exec_stmt(st, self.global_env)
+
+    def call(self, fn, *args):
+        if isinstance(fn, JSFunction):
+            return fn.call(UNDEFINED, list(args))
+        return fn(*args)
+
+    def get_global(self, name):
+        return self.global_env.get(name)
+
+    def set_global(self, name, value):
+        self.global_env.declare(name, value)
+
+    # -- statements --
+
+    def exec_block(self, stmts, env):
+        for st in stmts:
+            if st[0] == "fndecl":
+                _, name, params, body, _async = st
+                env.declare(
+                    name, JSFunction(name, params, body, env, self))
+        for st in stmts:
+            if st[0] != "fndecl":
+                self.exec_stmt(st, env)
+
+    def exec_stmt(self, st, env):
+        tag = st[0]
+        if tag == "expr":
+            self.eval_expr(st[1], env)
+        elif tag == "var":
+            for pattern, init in st[2]:
+                self.bind_pattern(env, pattern,
+                                  self.eval_expr(init, env),
+                                  declare=True)
+        elif tag == "if":
+            if truthy(self.eval_expr(st[1], env)):
+                self.exec_stmt(st[2], env)
+            elif st[3] is not None:
+                self.exec_stmt(st[3], env)
+        elif tag == "block":
+            self.exec_block(st[1], Env(env))
+        elif tag == "ret":
+            raise _Return(self.eval_expr(st[1], env))
+        elif tag == "brk":
+            raise _Break()
+        elif tag == "cont":
+            raise _Continue()
+        elif tag == "throw":
+            raise JSThrow(self.eval_expr(st[1], env))
+        elif tag == "for":
+            self.exec_for(st, env)
+        elif tag == "forof":
+            self.exec_forof(st, env)
+        elif tag == "forin":
+            self.exec_forin(st, env)
+        elif tag == "while":
+            while truthy(self.eval_expr(st[1], env)):
+                try:
+                    self.exec_stmt(st[2], Env(env))
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+        elif tag == "try":
+            self.exec_try(st, env)
+        elif tag == "empty":
+            pass
+        elif tag == "fndecl":
+            _, name, params, body, _async = st
+            env.declare(name,
+                        JSFunction(name, params, body, env, self))
+        else:
+            raise JSThrow(f"unsupported statement {tag}")
+
+    def exec_for(self, st, env):
+        _, init, cond, update, body = st
+        loop_env = Env(env)
+        if init is not None:
+            self.exec_stmt(init, loop_env)
+        while cond is None or truthy(self.eval_expr(cond, loop_env)):
+            try:
+                self.exec_stmt(body, Env(loop_env))
+            except _Break:
+                break
+            except _Continue:
+                pass
+            if update is not None:
+                self.eval_expr(update, loop_env)
+
+    def _iterate(self, value):
+        if isinstance(value, list):
+            return list(value)
+        if isinstance(value, str):
+            return list(value)
+        if isinstance(value, JSObject):
+            raise JSThrow("object is not iterable (no Symbol.iterator)")
+        raise JSThrow(f"{to_js_string(value)} is not iterable")
+
+    def exec_forof(self, st, env):
+        _, _kind, pattern, it, body = st
+        for item in self._iterate(self.eval_expr(it, env)):
+            iter_env = Env(env)
+            self.bind_pattern(iter_env, pattern, item, declare=True)
+            try:
+                self.exec_stmt(body, iter_env)
+            except _Break:
+                break
+            except _Continue:
+                continue
+
+    def exec_forin(self, st, env):
+        _, _kind, pattern, it, body = st
+        obj = self.eval_expr(it, env)
+        keys = list(obj.keys()) if isinstance(obj, JSObject) else \
+            [to_js_string(i) for i in range(len(obj))] \
+            if isinstance(obj, list) else []
+        for key in keys:
+            iter_env = Env(env)
+            self.bind_pattern(iter_env, pattern, key, declare=True)
+            try:
+                self.exec_stmt(body, iter_env)
+            except _Break:
+                break
+            except _Continue:
+                continue
+
+    def exec_try(self, st, env):
+        _, block, param, handler, final = st
+        try:
+            self.exec_block(block, Env(env))
+        except JSThrow as e:
+            if handler is not None:
+                h_env = Env(env)
+                if param is not None:
+                    val = e.value
+                    if isinstance(val, str):
+                        val = JSObject(
+                            {"message": val, "name": "Error"})
+                    self.bind_pattern(h_env, param, val, declare=True)
+                self.exec_block(handler, h_env)
+            elif final is None:
+                raise
+        finally:
+            if final is not None:
+                self.exec_block(final, Env(env))
+
+    # -- binding --
+
+    def bind_params(self, env, params, args):
+        ai = 0
+        for p in params:
+            if p[0] == "prest":
+                env.declare(p[1], list(args[ai:]))
+                ai = len(args)
+                continue
+            val = args[ai] if ai < len(args) else UNDEFINED
+            ai += 1
+            self.bind_pattern(env, p, val, declare=True)
+
+    def bind_pattern(self, env, pattern, value, declare=False):
+        tag = pattern[0]
+        if tag == "pid":
+            _, name, default = pattern
+            if value is UNDEFINED and default is not None:
+                value = self.eval_expr(default, env)
+            if declare:
+                env.declare(name, value)
+            else:
+                env.set(name, value)
+        elif tag == "pdefault":
+            _, sub, default = pattern
+            if value is UNDEFINED:
+                value = self.eval_expr(default, env)
+            self.bind_pattern(env, sub, value, declare)
+        elif tag == "pobj":
+            _, props, rest = pattern
+            taken = set()
+            for key, sub in props:
+                taken.add(key)
+                v = value.get_prop(key) \
+                    if isinstance(value, JSObject) else UNDEFINED
+                self.bind_pattern(env, sub, v, declare)
+            if rest is not None:
+                leftover = JSObject({
+                    k: v for k, v in value.items() if k not in taken
+                }) if isinstance(value, JSObject) else JSObject()
+                if declare:
+                    env.declare(rest, leftover)
+                else:
+                    env.set(rest, leftover)
+        elif tag == "parr":
+            _, elts = pattern
+            seq = self._iterate(value)
+            for idx, sub in enumerate(elts):
+                if sub is None:
+                    continue
+                v = seq[idx] if idx < len(seq) else UNDEFINED
+                self.bind_pattern(env, sub, v, declare)
+        else:
+            raise JSThrow(f"unsupported pattern {tag}")
+
+    # -- expressions --
+
+    def eval_expr(self, e, env):
+        tag = e[0]
+        if tag == "num":
+            return e[1]
+        if tag == "strlit":
+            return e[1]
+        if tag == "bool":
+            return e[1]
+        if tag == "null":
+            return None
+        if tag == "undef":
+            return UNDEFINED
+        if tag == "ident":
+            return env.get(e[1])
+        if tag == "tmpl":
+            out = []
+            for kind, payload in e[1]:
+                if kind == "str":
+                    out.append(payload)
+                else:
+                    out.append(
+                        to_js_string(self.eval_expr(payload, env)))
+            return "".join(out)
+        if tag == "regex":
+            return JSRegex(e[1], e[2])
+        if tag == "arr":
+            out = []
+            for elt in e[1]:
+                if elt[0] == "spread":
+                    out.extend(
+                        self._iterate(self.eval_expr(elt[1], env)))
+                else:
+                    out.append(self.eval_expr(elt, env))
+            return out
+        if tag == "obj":
+            obj = JSObject()
+            for prop in e[1]:
+                if prop[0] == "spread":
+                    src = self.eval_expr(prop[1], env)
+                    if isinstance(src, JSObject):
+                        obj.update(src)
+                elif prop[0] == "computed":
+                    obj[to_js_string(self.eval_expr(prop[1], env))] = \
+                        self.eval_expr(prop[2], env)
+                else:
+                    obj[prop[1]] = self.eval_expr(prop[2], env)
+            return obj
+        if tag == "arrow":
+            _, params, body, is_expr = e
+            return JSFunction(None, params, body, env, self,
+                              is_expr_body=is_expr)
+        if tag == "funcexpr":
+            _, name, params, body = e
+            return JSFunction(name, params, body, env, self)
+        if tag == "member":
+            obj = self.eval_expr(e[1], env)
+            if e[3] and (obj is None or obj is UNDEFINED):
+                return UNDEFINED
+            return self.get_member(obj, e[2])
+        if tag == "index":
+            obj = self.eval_expr(e[1], env)
+            if e[3] and (obj is None or obj is UNDEFINED):
+                return UNDEFINED
+            return self.get_index(obj, self.eval_expr(e[2], env))
+        if tag == "call":
+            return self.eval_call(e, env)
+        if tag == "assign":
+            return self.eval_assign(e, env)
+        if tag == "cond":
+            return self.eval_expr(
+                e[2] if truthy(self.eval_expr(e[1], env)) else e[3],
+                env,
+            )
+        if tag == "and":
+            left = self.eval_expr(e[1], env)
+            return self.eval_expr(e[2], env) if truthy(left) else left
+        if tag == "or":
+            left = self.eval_expr(e[1], env)
+            return left if truthy(left) else self.eval_expr(e[2], env)
+        if tag == "nullish":
+            left = self.eval_expr(e[1], env)
+            if left is None or left is UNDEFINED:
+                return self.eval_expr(e[2], env)
+            return left
+        if tag == "bin":
+            return self.eval_binary(
+                e[1],
+                self.eval_expr(e[2], env),
+                self.eval_expr(e[3], env),
+            )
+        if tag == "un":
+            op = e[1]
+            v = self.eval_expr(e[2], env)
+            if op == "!":
+                return not truthy(v)
+            if op == "-":
+                return -to_number(v)
+            if op == "+":
+                return to_number(v)
+        if tag == "typeof":
+            try:
+                v = self.eval_expr(e[1], env)
+            except JSThrow:
+                return "undefined"
+            if v is UNDEFINED:
+                return "undefined"
+            if v is None:
+                return "object"
+            if isinstance(v, bool):
+                return "boolean"
+            if isinstance(v, (int, float)):
+                return "number"
+            if isinstance(v, str):
+                return "string"
+            if isinstance(v, JSFunction) or callable(v):
+                return "function"
+            return "object"
+        if tag == "delete":
+            target = e[1]
+            if target[0] == "member":
+                obj = self.eval_expr(target[1], env)
+                if isinstance(obj, JSObject):
+                    obj.pop(target[2], None)
+                return True
+            if target[0] == "index":
+                obj = self.eval_expr(target[1], env)
+                key = self.eval_expr(target[2], env)
+                if isinstance(obj, JSObject):
+                    obj.pop(to_js_string(key), None)
+                return True
+            return True
+        if tag == "await":
+            return self.eval_expr(e[1], env)
+        if tag == "seq":
+            self.eval_expr(e[1], env)
+            return self.eval_expr(e[2], env)
+        raise JSThrow(f"unsupported expression {tag}")
+
+    def eval_binary(self, op, left, right):
+        if op == "+":
+            if isinstance(left, str) or isinstance(right, str):
+                return to_js_string(left) + to_js_string(right)
+            return to_number(left) + to_number(right)
+        if op == "-":
+            return to_number(left) - to_number(right)
+        if op == "*":
+            return to_number(left) * to_number(right)
+        if op == "/":
+            rn = to_number(right)
+            ln = to_number(left)
+            if rn == 0:
+                if ln == 0 or math.isnan(ln):
+                    return math.nan
+                return math.inf if ln > 0 else -math.inf
+            return ln / rn
+        if op == "%":
+            rn = to_number(right)
+            if rn == 0:
+                return math.nan
+            return math.fmod(to_number(left), rn)
+        if op == "===":
+            return js_equals_strict(left, right)
+        if op == "!==":
+            return not js_equals_strict(left, right)
+        if op == "==":
+            return js_equals_loose(left, right)
+        if op == "!=":
+            return not js_equals_loose(left, right)
+        if op in ("<", ">", "<=", ">="):
+            if isinstance(left, str) and isinstance(right, str):
+                pass
+            else:
+                left, right = to_number(left), to_number(right)
+                if isinstance(left, float) and math.isnan(left) or \
+                        isinstance(right, float) and math.isnan(right):
+                    return False
+            if op == "<":
+                return left < right
+            if op == ">":
+                return left > right
+            if op == "<=":
+                return left <= right
+            return left >= right
+        if op == "in":
+            if isinstance(right, JSObject):
+                return to_js_string(left) in right
+            if isinstance(right, list):
+                idx = to_number(left)
+                return 0 <= idx < len(right)
+            return False
+        raise JSThrow(f"unsupported operator {op}")
+
+    def eval_call(self, e, env):
+        _, callee, arg_exprs, optional = e
+        args = []
+        for a in arg_exprs:
+            if a[0] == "spread":
+                args.extend(self._iterate(self.eval_expr(a[1], env)))
+            else:
+                args.append(self.eval_expr(a, env))
+        # method call: evaluate receiver once, dispatch on it
+        if callee[0] == "member":
+            obj = self.eval_expr(callee[1], env)
+            if callee[3] and (obj is None or obj is UNDEFINED):
+                return UNDEFINED
+            fn = self.get_member(obj, callee[2])
+            if fn is UNDEFINED or fn is None:
+                if optional:
+                    return UNDEFINED
+                raise JSThrow(
+                    f"TypeError: {to_js_string(obj)[:40]}."
+                    f"{callee[2]} is not a function")
+            return self.invoke(fn, obj, args)
+        fn = self.eval_expr(callee, env)
+        if (fn is UNDEFINED or fn is None) and optional:
+            return UNDEFINED
+        return self.invoke(fn, UNDEFINED, args)
+
+    def invoke(self, fn, this, args):
+        if isinstance(fn, JSFunction):
+            return fn.call(this, args)
+        if callable(fn):
+            return fn(*args)
+        raise JSThrow(
+            f"TypeError: {to_js_string(fn)[:40]} is not a function")
+
+    def eval_assign(self, e, env):
+        _, op, target, value_expr = e
+        if op == "??=":
+            current = self.eval_expr(target, env)
+            if not (current is None or current is UNDEFINED):
+                return current
+            value = self.eval_expr(value_expr, env)
+        else:
+            value = self.eval_expr(value_expr, env)
+            if op != "=":
+                current = self.eval_expr(target, env)
+                value = self.eval_binary(op[0], current, value)
+        tag = target[0]
+        if tag == "ident":
+            env.set(target[1], value)
+        elif tag == "member":
+            obj = self.eval_expr(target[1], env)
+            self.set_member(obj, target[2], value)
+        elif tag == "index":
+            obj = self.eval_expr(target[1], env)
+            key = self.eval_expr(target[2], env)
+            self.set_index(obj, key, value)
+        else:
+            raise JSThrow(f"invalid assignment target {tag}")
+        return value
+
+    # -- member access / built-in methods --
+
+    def get_member(self, obj, name):
+        if obj is None or obj is UNDEFINED:
+            raise JSThrow(
+                f"TypeError: cannot read properties of "
+                f"{to_js_string(obj)} (reading '{name}')")
+        if isinstance(obj, JSObject):
+            if name in obj:
+                return obj[name]
+            return UNDEFINED
+        if isinstance(obj, str):
+            return self.string_member(obj, name)
+        if isinstance(obj, list):
+            return self.array_member(obj, name)
+        if isinstance(obj, (int, float)) and not isinstance(obj, bool):
+            return self.number_member(obj, name)
+        if isinstance(obj, JSRegex):
+            if name == "exec":
+                return obj.exec
+            if name == "test":
+                return obj.test
+        if isinstance(obj, JSFunction):
+            if name == "name":
+                return obj.name
+            if name == "call":
+                return lambda this=UNDEFINED, *a: obj.call(
+                    this, list(a))
+        return UNDEFINED
+
+    def set_member(self, obj, name, value):
+        if isinstance(obj, JSObject):
+            obj[name] = value
+        elif isinstance(obj, list) and name == "length":
+            n = int(to_number(value))
+            del obj[n:]
+        else:
+            raise JSThrow(
+                f"TypeError: cannot set {name} on "
+                f"{to_js_string(obj)[:40]}")
+
+    def get_index(self, obj, key):
+        if isinstance(obj, list):
+            if isinstance(key, (int, float)) and \
+                    not isinstance(key, bool):
+                idx = int(key)
+                if 0 <= idx < len(obj):
+                    return obj[idx]
+                return UNDEFINED
+            return self.get_member(obj, to_js_string(key))
+        if isinstance(obj, str):
+            if isinstance(key, (int, float)) and \
+                    not isinstance(key, bool):
+                idx = int(key)
+                if 0 <= idx < len(obj):
+                    return obj[idx]
+                return UNDEFINED
+            return self.get_member(obj, to_js_string(key))
+        if isinstance(obj, JSObject):
+            return obj.get_prop(to_js_string(key))
+        return self.get_member(obj, to_js_string(key))
+
+    def set_index(self, obj, key, value):
+        if isinstance(obj, list):
+            idx = int(to_number(key))
+            while len(obj) <= idx:
+                obj.append(UNDEFINED)
+            obj[idx] = value
+        elif isinstance(obj, JSObject):
+            obj[to_js_string(key)] = value
+        else:
+            raise JSThrow("TypeError: cannot index-assign on "
+                          f"{to_js_string(obj)[:40]}")
+
+    # -- built-in method tables --
+
+    def string_member(self, s: str, name):
+        i = self  # noqa: F841
+
+        def method(fn):
+            return fn
+
+        table = {
+            "length": len(s),
+            "slice": lambda a=0, b=None: _slice(s, a, b),
+            "substring": lambda a=0, b=None: _substring(s, a, b),
+            "toUpperCase": lambda: s.upper(),
+            "toLowerCase": lambda: s.lower(),
+            "trim": lambda: s.strip(),
+            "split": lambda sep=UNDEFINED, n=None: _split(s, sep),
+            "includes": lambda sub="": to_js_string(sub) in s,
+            "startsWith": lambda sub="": s.startswith(
+                to_js_string(sub)),
+            "endsWith": lambda sub="": s.endswith(to_js_string(sub)),
+            "indexOf": lambda sub="": s.find(to_js_string(sub)),
+            "lastIndexOf": lambda sub="": s.rfind(to_js_string(sub)),
+            "charAt": lambda idx=0: s[int(to_number(idx))]
+            if 0 <= int(to_number(idx)) < len(s) else "",
+            "charCodeAt": lambda idx=0: ord(s[int(to_number(idx))])
+            if 0 <= int(to_number(idx)) < len(s) else math.nan,
+            "padStart": lambda n=0, fill=" ": _pad(s, n, fill, True),
+            "padEnd": lambda n=0, fill=" ": _pad(s, n, fill, False),
+            "repeat": lambda n=0: s * int(to_number(n)),
+            "replace": lambda pat, rep="": _replace(s, pat, rep,
+                                                    all_=False),
+            "replaceAll": lambda pat, rep="": _replace(s, pat, rep,
+                                                       all_=True),
+            "match": lambda pat: pat.exec(s)
+            if isinstance(pat, JSRegex) else None,
+            "concat": lambda *a: s + "".join(to_js_string(x)
+                                             for x in a),
+            "toString": lambda: s,
+            "localeCompare": lambda o="": (s > to_js_string(o))
+            - (s < to_js_string(o)),
+        }
+        v = table.get(name, UNDEFINED)
+        return method(v) if callable(v) else v
+
+    def array_member(self, arr: list, name):
+        interp = self
+
+        def as_fn(f):
+            return lambda *cb_args: interp.invoke(
+                f, UNDEFINED, list(cb_args))
+
+        table = {
+            "length": len(arr),
+            "map": lambda f: [
+                interp.invoke(f, UNDEFINED, [x, i, arr])
+                for i, x in enumerate(list(arr))
+            ],
+            "filter": lambda f: [
+                x for i, x in enumerate(list(arr))
+                if truthy(interp.invoke(f, UNDEFINED, [x, i, arr]))
+            ],
+            "forEach": lambda f: _foreach(interp, arr, f),
+            "join": lambda sep=",": to_js_string(sep).join(
+                "" if x is None or x is UNDEFINED else to_js_string(x)
+                for x in arr
+            ),
+            "slice": lambda a=0, b=None: _slice(arr, a, b),
+            "concat": lambda *others: _concat(arr, others),
+            "includes": lambda v=UNDEFINED: any(
+                js_equals_strict(x, v) for x in arr),
+            "indexOf": lambda v=UNDEFINED: next(
+                (i for i, x in enumerate(arr)
+                 if js_equals_strict(x, v)), -1),
+            "find": lambda f: next(
+                (x for i, x in enumerate(list(arr))
+                 if truthy(interp.invoke(f, UNDEFINED, [x, i, arr]))),
+                UNDEFINED,
+            ),
+            "findIndex": lambda f: next(
+                (i for i, x in enumerate(list(arr))
+                 if truthy(interp.invoke(f, UNDEFINED, [x, i, arr]))),
+                -1,
+            ),
+            "some": lambda f: any(
+                truthy(interp.invoke(f, UNDEFINED, [x, i, arr]))
+                for i, x in enumerate(list(arr))
+            ),
+            "every": lambda f: all(
+                truthy(interp.invoke(f, UNDEFINED, [x, i, arr]))
+                for i, x in enumerate(list(arr))
+            ),
+            "push": lambda *v: (arr.extend(v), len(arr))[1],
+            "pop": lambda: arr.pop() if arr else UNDEFINED,
+            "shift": lambda: arr.pop(0) if arr else UNDEFINED,
+            "unshift": lambda *v: (arr.__setitem__(
+                slice(0, 0), list(v)), len(arr))[1],
+            "reverse": lambda: (arr.reverse(), arr)[1],
+            "flat": lambda depth=1: _flat(arr, int(to_number(depth))),
+            "flatMap": lambda f: _flat(
+                [interp.invoke(f, UNDEFINED, [x, i, arr])
+                 for i, x in enumerate(list(arr))], 1),
+            "reduce": lambda f, *init: _reduce(interp, arr, f, init),
+            "sort": lambda f=None: _sort(interp, arr, f),
+            "keys": lambda: list(range(len(arr))),
+            "entries": lambda: [[i, x] for i, x in enumerate(arr)],
+        }
+        v = table.get(name, UNDEFINED)
+        return v
+
+    def number_member(self, num, name):
+        if name == "toFixed":
+            return lambda digits=0: (
+                f"{float(num):.{int(to_number(digits))}f}")
+        if name == "toString":
+            return lambda: to_js_string(num)
+        if name == "toLocaleString":
+            return lambda: f"{num:,}"
+        return UNDEFINED
+
+    # -- global built-ins --
+
+    def _install_builtins(self):
+        g = self.global_env
+        interp = self
+
+        g.declare("undefined", UNDEFINED)
+        g.declare("NaN", math.nan)
+        g.declare("Infinity", math.inf)
+        g.declare("globalThis", JSObject())
+
+        g.declare("Object", JSObject({
+            "keys": lambda o: list(o.keys())
+            if isinstance(o, JSObject) else [],
+            "values": lambda o: list(o.values())
+            if isinstance(o, JSObject) else [],
+            "entries": lambda o: [[k, v] for k, v in o.items()]
+            if isinstance(o, JSObject) else [],
+            "assign": lambda target, *rest: _assign(target, rest),
+            "fromEntries": lambda pairs: JSObject({
+                to_js_string(p[0]): p[1] for p in pairs
+            }),
+        }))
+        g.declare("Array", JSObject({
+            "isArray": lambda v=UNDEFINED: isinstance(v, list),
+            "from": lambda v=UNDEFINED, f=None: [
+                interp.invoke(f, UNDEFINED, [x, i]) if f else x
+                for i, x in enumerate(interp._iterate(v))
+            ] if not (v is UNDEFINED or v is None) else [],
+        }))
+        g.declare("Math", JSObject({
+            "round": lambda x=math.nan: _js_round(to_number(x)),
+            "floor": lambda x=math.nan: math.floor(to_number(x)),
+            "ceil": lambda x=math.nan: math.ceil(to_number(x)),
+            "abs": lambda x=math.nan: abs(to_number(x)),
+            "max": lambda *a: max((to_number(x) for x in a),
+                                  default=-math.inf),
+            "min": lambda *a: min((to_number(x) for x in a),
+                                  default=math.inf),
+            "cos": lambda x=math.nan: math.cos(to_number(x)),
+            "sin": lambda x=math.nan: math.sin(to_number(x)),
+            "sqrt": lambda x=math.nan: math.sqrt(to_number(x)),
+            "pow": lambda a=math.nan, b=math.nan: to_number(a)
+            ** to_number(b),
+            "random": lambda: 0.5,  # deterministic for tests
+            "PI": math.pi,
+        }))
+        g.declare("JSON", JSObject({
+            "parse": _json_parse,
+            "stringify": _json_stringify,
+        }))
+        g.declare("Date", JSObject({
+            "now": lambda: 1_785_400_000_000,  # fixed test clock (ms)
+        }))
+        # async runs synchronously in this interpreter, so promises
+        # are already-resolved plain values
+        g.declare("Promise", JSObject({
+            "all": lambda arr=UNDEFINED: list(arr)
+            if isinstance(arr, list) else [],
+            "resolve": lambda v=UNDEFINED: v,
+            "reject": lambda v=UNDEFINED: _promise_reject(v),
+        }))
+        g.declare("console", JSObject({
+            "log": lambda *a: None,
+            "warn": lambda *a: None,
+            "error": lambda *a: None,
+        }))
+        # *rest swallows the (value, index, array) triple Array.map
+        # passes when these are used as callbacks (`.map(String)`)
+        g.declare("parseInt",
+                  lambda s=UNDEFINED, base=10, *rest: _parse_int(
+                      s, base if not rest else 10))
+        g.declare("parseFloat",
+                  lambda s=UNDEFINED, *rest: _parse_float(s))
+        g.declare("isNaN", lambda v=UNDEFINED, *rest: isinstance(
+            to_number(v), float) and math.isnan(to_number(v)))
+        g.declare("String",
+                  lambda v=UNDEFINED, *rest: to_js_string(v))
+        g.declare("Number", lambda v=UNDEFINED, *rest: to_number(v))
+        g.declare("Boolean", lambda v=UNDEFINED, *rest: truthy(v))
+        g.declare("encodeURIComponent",
+                  lambda s="": urllib.parse.quote(
+                      to_js_string(s), safe="!'()*-._~"))
+        g.declare("decodeURIComponent",
+                  lambda s="": urllib.parse.unquote(to_js_string(s)))
+
+
+# ----------------------------------------------------- builtin helpers
+
+
+def _promise_reject(v):
+    raise JSThrow(v)
+
+
+def _js_round(x):
+    if math.isnan(x) or math.isinf(x):
+        return x
+    return math.floor(x + 0.5)  # JS rounds .5 up, not banker's
+
+
+def _slice(seq, a=0, b=None):
+    n = len(seq)
+    a = int(to_number(a)) if a is not None and a is not UNDEFINED else 0
+    if a < 0:
+        a = max(0, n + a)
+    if b is None or b is UNDEFINED:
+        b = n
+    else:
+        b = int(to_number(b))
+        if b < 0:
+            b = max(0, n + b)
+    return seq[a:b]
+
+
+def _substring(s, a=0, b=None):
+    n = len(s)
+    a = max(0, min(n, int(to_number(a))))
+    b = n if (b is None or b is UNDEFINED) else \
+        max(0, min(n, int(to_number(b))))
+    if a > b:
+        a, b = b, a
+    return s[a:b]
+
+
+def _split(s, sep):
+    if sep is UNDEFINED:
+        return [s]
+    sep = to_js_string(sep)
+    if sep == "":
+        return list(s)
+    return s.split(sep)
+
+
+def _pad(s, n, fill, start):
+    n = int(to_number(n))
+    fill = to_js_string(fill) or " "
+    while len(s) < n:
+        add = fill[: n - len(s)]
+        s = add + s if start else s + add
+    return s
+
+
+def _replace(s, pat, rep, all_):
+    rep_s = to_js_string(rep) if not callable(rep) and \
+        not isinstance(rep, JSFunction) else rep
+    if isinstance(pat, JSRegex):
+        count = 0 if (pat.global_ or all_) else 1
+        if isinstance(rep_s, str):
+            py_rep = re.sub(r"\$(\d)", r"\\\1", rep_s)
+            return pat.re.sub(py_rep, s, count=count)
+        return pat.re.sub(lambda m: to_js_string(rep_s(m.group(0))),
+                          s, count=count)
+    pat_s = to_js_string(pat)
+    if all_:
+        return s.replace(pat_s, to_js_string(rep_s))
+    return s.replace(pat_s, to_js_string(rep_s), 1)
+
+
+def _concat(arr, others):
+    out = list(arr)
+    for o in others:
+        if isinstance(o, list):
+            out.extend(o)
+        else:
+            out.append(o)
+    return out
+
+
+def _flat(arr, depth):
+    out = []
+    for x in arr:
+        if isinstance(x, list) and depth > 0:
+            out.extend(_flat(x, depth - 1))
+        else:
+            out.append(x)
+    return out
+
+
+def _foreach(interp, arr, f):
+    for i, x in enumerate(list(arr)):
+        interp.invoke(f, UNDEFINED, [x, i, arr])
+    return UNDEFINED
+
+
+def _reduce(interp, arr, f, init):
+    items = list(arr)
+    if init:
+        acc = init[0]
+        start = 0
+    else:
+        if not items:
+            raise JSThrow("TypeError: reduce of empty array "
+                          "with no initial value")
+        acc = items[0]
+        start = 1
+    for i in range(start, len(items)):
+        acc = interp.invoke(f, UNDEFINED, [acc, items[i], i, arr])
+    return acc
+
+
+def _sort(interp, arr, f):
+    if f is None or f is UNDEFINED:
+        arr.sort(key=to_js_string)
+    else:
+        def cmp(a, b):
+            r = to_number(interp.invoke(f, UNDEFINED, [a, b]))
+            if math.isnan(r):
+                return 0
+            return -1 if r < 0 else (1 if r > 0 else 0)
+
+        arr.sort(key=functools.cmp_to_key(cmp))
+    return arr
+
+
+def _assign(target, rest):
+    for o in rest:
+        if isinstance(o, JSObject):
+            target.update(o)
+    return target
+
+
+def py_to_js(v):
+    """Convert parsed-JSON Python values into interpreter values."""
+    if isinstance(v, dict):
+        return JSObject({k: py_to_js(x) for k, x in v.items()})
+    if isinstance(v, list):
+        return [py_to_js(x) for x in v]
+    return v
+
+
+def js_to_py(v):
+    if v is UNDEFINED:
+        return None
+    if isinstance(v, JSObject):
+        return {k: js_to_py(x) for k, x in v.items()
+                if x is not UNDEFINED}
+    if isinstance(v, list):
+        return [js_to_py(x) for x in v]
+    return v
+
+
+def _json_parse(s="null"):
+    try:
+        return py_to_js(json.loads(to_js_string(s)))
+    except (ValueError, TypeError) as e:
+        raise JSThrow(f"SyntaxError: {e}") from None
+
+
+def _json_stringify(v=UNDEFINED, _replacer=None, indent=None):
+    if v is UNDEFINED:
+        return UNDEFINED
+    kw = {}
+    if indent is not None and indent is not UNDEFINED:
+        kw["indent"] = int(to_number(indent))
+    return json.dumps(js_to_py(v), **kw)
+
+
+def _parse_int(s, base=10):
+    base = int(to_number(base)) or 10
+    m = re.match(r"\s*[+-]?(0[xX][0-9a-fA-F]+|\d+)",
+                 to_js_string(s))
+    if not m:
+        return math.nan
+    text = m.group(0).strip()
+    try:
+        if text.lower().startswith(("0x", "+0x", "-0x")):
+            return int(text, 16)
+        return int(text, base)
+    except ValueError:
+        return math.nan
+
+
+def _parse_float(s=UNDEFINED):
+    m = re.match(r"\s*[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?",
+                 to_js_string(s))
+    if not m:
+        return math.nan
+    return float(m.group(0))

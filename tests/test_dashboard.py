@@ -246,9 +246,17 @@ def test_every_panel_call_resolves(server):
         ("GET", "/api/rooms/1/wallet/balance"),   # no chain RPC (503)
     }
     # destructive calls go last so a DELETE doesn't remove the row a
-    # later POST/GET in the sorted sweep targets
-    ordered = sorted(_panel_api_calls(),
-                     key=lambda mp: (mp[0] == "DELETE", mp))
+    # later POST/GET in the sorted sweep targets; among DELETEs,
+    # children before parents (deepest path first) so archiving
+    # /api/rooms/1 doesn't cascade-404 /api/rooms/1/credentials/1
+    ordered = sorted(
+        _panel_api_calls(),
+        key=lambda mp: (
+            mp[0] == "DELETE",
+            -len(mp[1]) if mp[0] == "DELETE" else 0,
+            mp,
+        ),
+    )
     for method, path in ordered:
         body = bodies.get((method, path))
         headers = {

@@ -1,0 +1,271 @@
+"""DOM-level render tests (VERDICT r4 #3): every dashboard panel's
+real render function executes (tests/jsdom mini-JS interpreter +
+DOM shim) against payloads served by the live HTTP routes, and the
+produced HTML is asserted on. The round-4 field-drift class
+(`t.instructions` vs `prompt`, `m.content` vs `observations`) now
+fails CI in the render path itself: a missing field interpolates as
+the literal string "undefined", which the sweep rejects in every
+panel."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.server.http import ApiServer
+from tests.jsdom.harness import PanelHarness
+
+UI_DIR = os.path.join(os.path.dirname(__file__), "..", "ui")
+
+
+def _seed(db):
+    from room_tpu.core import (
+        escalations as esc_mod, goals as goals_mod,
+        memory as memory_mod, messages as messages_mod,
+        quorum as quorum_mod, rooms as rooms_mod,
+        skills as skills_mod, task_runner,
+    )
+
+    room = rooms_mod.create_room(db, "render-room",
+                                 worker_model="echo")
+    rid = room["id"]
+    task_runner.create_task(db, "render-task", "do the thing",
+                            trigger_type="manual")
+    goals_mod.create_goal(db, rid, "render-goal")
+    # high-impact stays open for votes (low-impact auto-approves)
+    quorum_mod.announce(db, rid, None, "render-proposal",
+                        decision_type="high_impact")
+    esc_mod.create_escalation(db, rid, "render-question")
+    messages_mod.send_room_message(db, rid, rid, "render-subject",
+                                   "render-body")
+    memory_mod.remember(db, "render-fact", "render-content")
+    skills_mod.create_skill(db, "render-skill", "render-how")
+    db.insert("INSERT INTO task_runs(task_id, status) VALUES (1, 'ok')")
+    return rid
+
+
+@pytest.fixture(scope="module")
+def harness(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ui-render")
+    os.environ["ROOM_TPU_DATA_DIR"] = str(tmp / "data")
+    db = Database(":memory:")
+    srv = ApiServer(db, static_dir=UI_DIR)
+    srv.start()
+    _seed(db)
+    token = srv.tokens["user"]
+
+    def api(method, path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}",
+            method=method,
+            headers={
+                "Authorization": f"Bearer {token}",
+                **({"Content-Type": "application/json"}
+                   if body is not None else {}),
+            },
+            data=json.dumps(body).encode()
+            if body is not None else None,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read() or b"{}")
+            except ValueError:
+                return {"error": f"http {e.code}"}
+
+    h = PanelHarness(api)
+    yield h
+    srv.stop()
+
+
+ALL_PANELS = [
+    "swarm", "rooms", "setup", "workers", "goals", "tasks", "runs",
+    "inbox", "messages", "votes", "memory", "skills", "wallet",
+    "transactions", "tpu", "cycles", "usage", "providers", "clerk",
+    "status", "feed", "system", "settings", "help",
+]
+
+
+def test_panel_registry_complete(harness):
+    assert harness.panel_keys() == ALL_PANELS
+
+
+@pytest.mark.parametrize("key", ALL_PANELS)
+def test_panel_renders_clean(harness, key):
+    """Every panel: renders against live payloads, produces real
+    markup, and never interpolates a missing field ("undefined"),
+    a numeric hole ("NaN"), or an unstringified object."""
+    html = harness.render(key)
+    assert len(html) > 40, f"{key}: near-empty render"
+    for poison in ("undefined", "NaN", "[object Object]"):
+        assert poison not in html, f"{key}: {poison!r} in HTML"
+
+
+def test_rooms_panel_shows_seeded_room(harness):
+    harness.render("rooms")
+    # the room list loads into its own element (loadRoomList)
+    assert "render-room" in harness.element_html("roomList")
+
+
+def test_tasks_panel_shows_seeded_task(harness):
+    html = harness.render("tasks")
+    assert "render-task" in html
+    assert "do the thing" in html     # prompt column (r4 drift bug)
+
+
+def test_goals_panel_shows_seeded_goal(harness):
+    assert "render-goal" in harness.render("goals")
+
+
+def test_memory_panel_shows_observations(harness):
+    harness.render("memory")
+    html = harness.element_html("memResults")  # memSearch target
+    assert "render-fact" in html
+    assert "render-content" in html   # observation body (r4 drift bug)
+
+
+def test_skills_panel_shows_seeded_skill(harness):
+    assert "render-skill" in harness.render("skills")
+
+
+def test_inbox_panel_shows_escalation(harness):
+    assert "render-question" in harness.render("inbox")
+
+
+def test_messages_panel_shows_message(harness):
+    harness.render("messages")
+    assert "render-subject" in harness.element_html("msgTable")
+
+
+def test_votes_panel_shows_proposal(harness):
+    assert "render-proposal" in harness.render("votes")
+
+
+def test_runs_panel_shows_run_status(harness):
+    html = harness.render("runs")
+    assert "render-task" in html or "#1" in html
+
+
+def test_workers_panel_shows_queen(harness):
+    # every room auto-creates its queen
+    html = harness.render("workers")
+    assert "queen" in html.lower()
+
+
+def test_status_panel_shows_version(harness):
+    import room_tpu
+
+    assert room_tpu.__version__ in harness.render("status")
+
+
+def test_tasks_panel_run_link_calls_runs_route(harness):
+    """Panel-driven interaction: showRuns(1) must hit the runs route
+    and render the run row into the taskRuns element."""
+    harness.render("tasks")
+    harness.call_global("showRuns", 1)
+    assert ("GET", "/api/tasks/1/runs", None) in harness.api_calls
+    assert "pill" in harness.element_html("taskRuns")
+
+
+def test_swarm_ws_cycle_events_render_cards(harness):
+    """The swarm panel's WS path: a cycle:started event for a seeded
+    worker produces a cycling card."""
+    harness.render("swarm")
+    harness.interp.set_global("currentView", "swarm")
+    harness.ws_dispatch({
+        "channel": "room:1", "type": "cycle:started",
+        "data": {"worker_id": 1, "cycle_id": 7},
+    })
+    assert "cycling" in harness.element_html("swarmRooms")
+
+
+def test_help_panel_static_sections(harness):
+    html = harness.render("help")
+    assert html.count("<h2>") >= 4
+
+
+def test_render_panel_error_boundary(harness):
+    """A throwing panel renders an inline error card with retry, not a
+    blank view (renderPanel is the app-wide boundary)."""
+    harness.interp.run(
+        'PANELS.broken = {title: "broken", '
+        'render: async () => { throw {message: "boom-123"}; }};'
+    )
+    from tests.jsdom.dom import Element
+
+    el = Element("div", "view-broken")
+    harness.call_global("renderPanel", "broken", el)
+    from tests.jsdom.mini_js import to_js_string
+
+    html = to_js_string(el.get_prop("innerHTML"))
+    assert "failed to render" in html
+    assert "boom-123" in html
+    assert "retry" in html
+
+
+def test_room_settings_validation_blocks_bad_save(harness):
+    harness.render("rooms")
+    harness.call_global("selectRoom", 1)
+    doc = harness.document
+    doc.get_element_by_id("roomMaxTurns")["value"] = "0"
+    n_calls = len(harness.api_calls)
+    harness.call_global("roomConfigSave", 1)
+    assert "max turns" in harness.element_html("roomCfgError") \
+        or "max turns" in harness.document.get_element_by_id(
+            "roomCfgError").get_prop("textContent")
+    # no PUT fired
+    assert not any(m == "PUT" for m, p, b in harness.api_calls[n_calls:])
+
+
+def test_room_settings_valid_save_puts_all_knobs(harness):
+    harness.render("rooms")
+    harness.call_global("selectRoom", 1)
+    doc = harness.document
+    # element stubs don't inherit rendered values: set every
+    # validated field explicitly
+    for elt_id, val in (
+        ("roomNameEdit", "renamed-room"), ("roomMaxTurns", "40"),
+        ("roomMaxTasks", "3"), ("cfgVoteTimeout", "10"),
+        ("cfgMinVoters", "2"), ("roomCycleGap", "30"),
+    ):
+        doc.get_element_by_id(elt_id)["value"] = val
+    harness.call_global("roomConfigSave", 1)
+    puts = [(m, p, b) for m, p, b in harness.api_calls
+            if m == "PUT" and p == "/api/rooms/1"]
+    assert puts, harness.api_calls[-5:]
+    body = puts[-1][2]
+    assert body["name"] == "renamed-room"
+    assert body["queenMaxTurns"] == 40
+    assert body["config"]["minVoters"] == 2
+    # unknown config keys survive the save (spread of loaded config)
+    assert "voteThreshold" in body["config"]
+
+
+def test_room_archive_needs_confirmation(harness):
+    harness.render("rooms")
+    harness.call_global("selectRoom", 1)
+    harness.confirm_answer = False
+    n = len(harness.api_calls)
+    harness.call_global("roomArchive", 1)
+    assert not any(m == "DELETE" for m, p, b in harness.api_calls[n:])
+    harness.confirm_answer = True  # restore for other tests
+
+
+def test_clerk_setup_guide_steps(harness):
+    harness.interp.set_global("clerkGuideStep", 1)
+    html = harness.render("clerk")
+    assert "clerk setup guide" in html
+    assert "backend" in html
+    harness.interp.set_global("clerkGuideStep", 3)
+    harness.render("clerk")
+    harness.document.get_element_by_id(
+        "clerkModelPick")["value"] = "tpu:qwen3-coder-30b"
+    harness.call_global("clerkGuideSaveModel")
+    assert ("PUT", "/api/settings/clerk_model",
+            {"value": "tpu:qwen3-coder-30b"}) in harness.api_calls
+    harness.interp.set_global("clerkGuideStep", 0)
