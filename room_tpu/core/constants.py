@@ -101,6 +101,9 @@ class RoomConfig:
     auto_approve: tuple[str, ...] = ("low_impact",)
     sealed_ballot: bool = False
     min_voter_health: float = 0.0
+    # ballots resolve against max(actual voters, min_voters): a keeper
+    # can require e.g. 3 votes even in a 2-worker room
+    min_voters: int = 0
 
     @classmethod
     def from_json(cls, raw: dict | None) -> "RoomConfig":
@@ -121,6 +124,7 @@ class RoomConfig:
         cfg.min_voter_health = float(
             raw.get("minVoterHealth", cfg.min_voter_health)
         )
+        cfg.min_voters = int(raw.get("minVoters", cfg.min_voters))
         return cfg
 
     def to_json(self) -> dict:
@@ -131,6 +135,7 @@ class RoomConfig:
             "autoApprove": list(self.auto_approve),
             "sealedBallot": self.sealed_ballot,
             "minVoterHealth": self.min_voter_health,
+            "minVoters": self.min_voters,
         }
 
 

@@ -141,6 +141,18 @@ QUEEN_TOOLS: list[dict] = [
         ["proposal"],
     ),
     _tool(
+        "open_ballot",
+        "Open an explicit vote on a proposal; workers cast votes and "
+        "it resolves by the room's threshold when the electorate "
+        "(at least the room's min-voters setting) has spoken or the "
+        "timeout passes.",
+        {
+            "proposal": {"type": "string"},
+            "timeout_minutes": {"type": "number"},
+        },
+        ["proposal"],
+    ),
+    _tool(
         "create_worker",
         "Add a worker to the room with a role preset.",
         {
@@ -286,6 +298,17 @@ def _dispatch(
             args.get("decision_type", "low_impact"),
         )
         return f"decision #{d['id']} {d['status']}"
+
+    if name == "open_ballot":
+        for d in quorum_mod.pending_decisions(db, room_id):
+            if d["proposal"] == args["proposal"]:
+                return f"decision #{d['id']} already open"
+        d = quorum_mod.open_ballot(
+            db, room_id, worker_id, args["proposal"],
+            timeout_minutes=float(args.get("timeout_minutes", 10)),
+        )
+        return (f"ballot #{d['id']} open (threshold "
+                f"{d['threshold']}, min voters {d['min_voters']})")
 
     if name == "create_worker":
         wid = workers_mod.create_worker(

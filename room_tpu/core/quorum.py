@@ -150,13 +150,17 @@ def open_ballot(
     decision_type: str = "high_impact",
     timeout_minutes: float = 10,
     threshold: Optional[str] = None,
-    min_voters: int = 0,
+    min_voters: Optional[int] = None,
     sealed: bool = False,
 ) -> dict:
     room = get_room(db, room_id)
     if room is None:
         raise QuorumError(f"room {room_id} not found")
     cfg = room_config(room)
+    if min_voters is None:
+        # the room-settings knob (config.minVoters) is the default;
+        # an explicit argument still wins
+        min_voters = cfg.min_voters
     did = db.insert(
         "INSERT INTO quorum_decisions"
         "(room_id, proposer_id, proposal, decision_type, status, threshold, "

@@ -482,3 +482,42 @@ class TestQueenToolDispatch:
             assert any(e.type == "escalation:created" for e in got)
         finally:
             unsub()
+
+
+def test_room_config_min_voters_is_ballot_default(db, room):
+    """The dashboard's min-voters knob (config.minVoters) must actually
+    bind: open_ballot with no explicit arg inherits it."""
+    import json
+
+    db.execute(
+        "UPDATE rooms SET config=? WHERE id=?",
+        (json.dumps({"minVoters": 3}), room["id"]),
+    )
+    d = quorum.open_ballot(db, room["id"], None, "needs-three")
+    assert d["min_voters"] == 3
+    quorum.vote(db, d["id"], room["queen_worker_id"], "yes")
+    # one yes against an electorate floor of 3 cannot resolve
+    assert quorum.get_decision(db, d["id"])["status"] == "voting"
+    # explicit argument still wins over the config default
+    d2 = quorum.open_ballot(db, room["id"], None, "explicit",
+                            min_voters=1)
+    assert d2["min_voters"] == 1
+
+
+def test_queen_open_ballot_tool(db, room):
+    from room_tpu.core.queen_tools import execute_queen_tool
+
+    out = execute_queen_tool(
+        db, room["id"], room["queen_worker_id"], "open_ballot",
+        {"proposal": "tooled-vote"},
+    )
+    assert "ballot #" in out
+    open_ = quorum.pending_decisions(db, room["id"])
+    assert any(d["proposal"] == "tooled-vote"
+               and d["status"] == "voting" for d in open_)
+    # dedupe: same proposal while open returns the existing ballot
+    again = execute_queen_tool(
+        db, room["id"], room["queen_worker_id"], "open_ballot",
+        {"proposal": "tooled-vote"},
+    )
+    assert "already open" in again

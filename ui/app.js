@@ -83,9 +83,10 @@ function showView(key) {
 }
 
 function refreshView() {
-  const panel = PANELS[currentView];
-  if (panel) panel.render($("view-" + currentView)).catch(e =>
-    toast(`${currentView}: ${e.message}`));
+  // renderPanel (panels.js) is the error boundary: a throwing panel
+  // renders an inline error card with a retry button instead of
+  // blanking the view
+  renderPanel(currentView, $("view-" + currentView));
 }
 
 // ---- websocket ----

@@ -369,6 +369,8 @@ async function selectRoom(id) {
           ? " selected" : ""}>${o}</option>`).join("")}</select>`;
       return `
       <div class="kv">
+        <span class="k">name</span>
+          <input id="roomNameEdit" value="${esc(r.name || "")}">
         <span class="k">objective</span>
           <input id="roomGoalEdit" value="${esc(r.goal || "")}">
         <span class="k">autonomy</span>
@@ -406,6 +408,9 @@ async function selectRoom(id) {
         <span class="k">vote timeout (min)</span>
           <input id="cfgVoteTimeout" type="number" min="1"
                  value="${cfg.voteTimeoutMinutes ?? 10}">
+        <span class="k">min voters</span>
+          <input id="cfgMinVoters" type="number" min="0"
+                 value="${cfg.minVoters ?? 0}">
         <span class="k">queen tie-breaker</span>
           <input id="cfgTieBreaker" type="checkbox"
                  ${cfg.queenTieBreaker !== false ? "checked" : ""}>
@@ -417,9 +422,12 @@ async function selectRoom(id) {
                  ${(cfg.autoApprove || ["low_impact"])
                    .includes("low_impact") ? "checked" : ""}>
       </div>
+      <div class="dim" id="roomCfgError" style="color:#ff9b9b"></div>
       <div class="row">
         <button class="act" onclick="roomConfigSave(${id})">
           save settings</button>
+        <button class="ghost" onclick="roomArchive(${id})">
+          archive room</button>
       </div>`;
     })()}
     <h2 style="margin-top:.8rem">chat with the queen</h2>
@@ -473,9 +481,53 @@ async function credDelete(id, name) {
 
 let roomDetailCtx = {cfg: {}, gapMs: 1800000};
 
+function roomConfigValidate() {
+  // inline validation (reference: RoomSettingsPanel's per-field
+  // checks): reject before the PUT, render why next to the button
+  const errs = [];
+  if (!$("roomNameEdit").value.trim()) {
+    errs.push("name must not be empty");
+  }
+  const gap = parseFloat($("roomCycleGap").value);
+  if ($("roomCycleGap").value.trim() !== "" &&
+      !(gap > 0 && gap <= 24 * 60)) {
+    errs.push("cycle gap must be between 0 and 1440 minutes");
+  }
+  const turns = parseInt($("roomMaxTurns").value, 10);
+  if (!(turns >= 1 && turns <= 500)) {
+    errs.push("max turns must be 1–500");
+  }
+  const tasks = parseInt($("roomMaxTasks").value, 10);
+  if (!(tasks >= 1 && tasks <= 10)) {
+    errs.push("parallel tasks must be 1–10");
+  }
+  const vt = parseInt($("cfgVoteTimeout").value, 10);
+  if (!(vt >= 1 && vt <= 7 * 24 * 60)) {
+    errs.push("vote timeout must be at least 1 minute");
+  }
+  const mv = parseInt($("cfgMinVoters").value, 10);
+  if (!(mv >= 0 && mv <= 64)) {
+    errs.push("min voters must be 0–64");
+  }
+  const from = $("roomQuietFrom").value.trim();
+  const until = $("roomQuietUntil").value.trim();
+  if (!!from !== !!until) {
+    errs.push("quiet hours need both a from and an until time");
+  }
+  return errs;
+}
+
 async function roomConfigSave(id) {
+  const errBox = $("roomCfgError");
+  const errs = roomConfigValidate();
+  if (errs.length) {
+    if (errBox) errBox.textContent = errs.join(" · ");
+    return;
+  }
+  if (errBox) errBox.textContent = "";
   const gapMin = parseFloat($("roomCycleGap").value);
   await api("PUT", `/api/rooms/${id}`, {
+    name: $("roomNameEdit").value.trim(),
     goal: $("roomGoalEdit").value.trim(),
     autonomyMode: $("roomAutonomy").value,
     visibility: $("roomVisibility").value,
@@ -496,12 +548,22 @@ async function roomConfigSave(id) {
       voteThreshold: $("cfgThreshold").value,
       voteTimeoutMinutes:
         parseInt($("cfgVoteTimeout").value, 10) || 10,
+      minVoters: parseInt($("cfgMinVoters").value, 10) || 0,
       queenTieBreaker: $("cfgTieBreaker").checked,
       sealedBallot: $("cfgSealed").checked,
       autoApprove: $("cfgAutoApprove").checked ? ["low_impact"] : [],
     },
   });
   selectRoom(id);
+}
+
+async function roomArchive(id) {
+  if (!await confirmDialog(
+    `archive room #${id}? Its loops stop and the room is removed ` +
+    "from the swarm.", "archive")) return;
+  await api("DELETE", `/api/rooms/${id}`);
+  selectedRoom = null;
+  refreshView();
 }
 
 async function roomChatSend(id) {
@@ -864,13 +926,124 @@ wsHandlers.clerk = (msg) => {
   }
 };
 
+// clerk setup guide (reference: ClerkSetupGuide.tsx — a step flow
+// that takes the keeper from nothing-configured to a verified clerk
+// turn; here: backend -> connect -> model -> test)
+let clerkGuideStep = 0;   // 0 = closed
+
+function clerkGuideOpen() {
+  clerkGuideStep = 1;
+  refreshView();
+}
+
+function clerkGuideClose() {
+  clerkGuideStep = 0;
+  refreshView();
+}
+
+async function clerkGuideHtml() {
+  if (!clerkGuideStep) return "";
+  const steps = ["backend", "connect", "model", "test"];
+  const crumbs = steps.map((s, i) =>
+    `<span class="pill ${i + 1 === clerkGuideStep ? "verified" : ""}">
+      ${i + 1} · ${s}</span>`).join(" ");
+  let body = "";
+  if (clerkGuideStep === 1) {
+    const ms = (await api("GET", "/api/models/status")).data || {};
+    const tpuReady = Object.values(ms).some(m => m.ready);
+    body = `<p class="dim">The clerk answers the keeper directly; it
+      rides the first backend in its fallback chain that works. Pick
+      what to set up:</p>
+      <table>
+        <tr><td>tpu (in-tree serving)</td>
+          <td>${tpuReady
+            ? '<span class="pill verified">weights ready</span>'
+            : '<span class="pill pending">weights not loaded</span>'}
+          </td></tr>
+        <tr><td>CLI provider (claude / codex)</td>
+          <td class="dim">uses your existing CLI login</td></tr>
+        <tr><td>API provider (openai / anthropic / gemini)</td>
+          <td class="dim">needs an API key in the environment</td></tr>
+      </table>`;
+  } else if (clerkGuideStep === 2) {
+    const provs = (await api("GET", "/api/providers")).data || {};
+    body = `<p class="dim">Connect a provider (skip if the tpu
+      backend already shows ready):</p>
+      <table>${Object.entries(provs).map(([key, p]) => `
+        <tr><td>${esc(key)}</td>
+        <td>${p.connected
+          ? '<span class="pill verified">connected</span>'
+          : p.installed
+            ? '<span class="pill pending">not logged in</span>'
+            : '<span class="pill pending">not installed</span>'}</td>
+        <td>${p.connected ? "" : p.installed
+          ? `<button class="ghost"
+               onclick="provAuthStart('${esc(key)}')">log in</button>`
+          : `<button class="ghost"
+               onclick="provInstallStart('${esc(key)}')">install</button>`}
+        </td></tr>`).join("")}</table>
+      <p class="dim">Install/login sessions stream into the providers
+        panel; come back here when a row shows connected.</p>`;
+  } else if (clerkGuideStep === 3) {
+    const cur = ((await api("GET", "/api/settings/clerk_model"))
+      .data || {}).value || "";
+    body = `<p class="dim">Preferred clerk model (first try in the
+      fallback chain). Examples: <code>tpu:qwen3-coder-30b</code>,
+      <code>claude:sonnet</code>, <code>openai:gpt-4o-mini</code>.</p>
+      <div class="row">
+        <input id="clerkModelPick" value="${esc(cur)}"
+          placeholder="provider:model">
+        <button class="act" onclick="clerkGuideSaveModel()">
+          save</button>
+      </div>`;
+  } else {
+    body = `<p class="dim">Send a test turn; a reply below means the
+      clerk is live end-to-end.</p>
+      <div class="row">
+        <button class="act" onclick="clerkGuideTest()">
+          send test message</button>
+      </div>
+      <div class="dim" id="clerkGuideTestOut"></div>`;
+  }
+  const nav = `<div class="row" style="margin-top:.6rem">
+    ${clerkGuideStep > 1 ? `<button class="ghost"
+      onclick="clerkGuideStep--;refreshView()">back</button>` : ""}
+    ${clerkGuideStep < 4 ? `<button class="act"
+      onclick="clerkGuideStep++;refreshView()">next</button>`
+      : `<button class="act" onclick="clerkGuideClose()">
+           done</button>`}
+    <button class="ghost" onclick="clerkGuideClose()">close</button>
+  </div>`;
+  return `<div class="panel"><h2>clerk setup guide</h2>
+    <div class="row">${crumbs}</div>${body}${nav}</div>`;
+}
+
+async function clerkGuideSaveModel() {
+  const v = $("clerkModelPick").value.trim();
+  await api("PUT", "/api/settings/clerk_model", {value: v});
+  clerkGuideStep = 4;
+  refreshView();
+}
+
+async function clerkGuideTest() {
+  $("clerkGuideTestOut").textContent = "asking the clerk…";
+  const out = await api("POST", "/api/clerk/message",
+    {content: "setup check: reply with one short sentence."});
+  $("clerkGuideTestOut").textContent =
+    (out.data && (out.data.reply || out.data.content)) ||
+    out.error || "no reply — check the providers panel";
+}
+
 async function renderClerk(el) {
   const out = await api("GET", "/api/clerk/messages");
   const st = (await api("GET", "/api/clerk/status")).data || {};
-  el.innerHTML = `<div class="panel"><h2>clerk
+  const guide = await clerkGuideHtml();
+  el.innerHTML = `${guide}<div class="panel"><h2>clerk
       <span class="dim" style="font-size:.6em">${st.messages || 0}
         messages · ${st.turns || 0} turns ·
         last ${esc(when(st.lastMessageAt) || "never")}</span>
+      <button class="ghost" onclick="clerkGuideOpen()">
+        setup guide</button>
       <button class="ghost" onclick="clerkReset()">reset</button></h2>
     <div class="log" id="clerkLog" style="max-height:460px">
       ${(out.data || []).map(m =>
@@ -1936,6 +2109,24 @@ async function renderHelp(el) {
         <pre style="white-space:pre-wrap;margin:0" class="dim">` +
         `${esc(body)}</pre>
       </div>`).join("")}`;
+}
+
+// ---- error boundary (reference: the SPA's per-panel ErrorBoundary
+// components — one broken panel must not blank the app) ----
+
+async function renderPanel(key, el) {
+  const panel = PANELS[key];
+  if (!panel || !el) return;
+  try {
+    await panel.render(el);
+  } catch (e) {
+    el.innerHTML = `<div class="panel">
+      <h2>${esc(key)} failed to render</h2>
+      <div class="dim">${esc(e && e.message || String(e))}</div>
+      <div class="row">
+        <button class="ghost" onclick="refreshView()">retry</button>
+      </div></div>`;
+  }
 }
 
 // ---- registry ----
