@@ -32,8 +32,9 @@ def main() -> None:
     rank = jax.process_index()
     n_local = len(jax.local_devices())
     n_global = len(jax.devices())
-    assert jax.process_count() == 2
-    assert n_global == 2 * n_local
+    n_procs = int(os.environ["ROOM_TPU_NUM_PROCESSES"])
+    assert jax.process_count() == n_procs
+    assert n_global == n_procs * n_local
 
     # 1. cross-process psum: every device contributes global_index + 1
     mesh = make_global_mesh(MeshSpec(dp=n_global, ep=1, tp=1))
@@ -57,7 +58,7 @@ def main() -> None:
     print(f"RANK{rank} psum OK ({got})", flush=True)
 
     # 2. one sharded training step with the batch dp-split ACROSS the
-    # two processes (grad all-reduce crosses the process boundary)
+    # processes (grad all-reduce crosses the process boundary)
     cfg = tiny_moe()
     spec = MeshSpec(dp=n_global, ep=1, tp=1)
     tmesh = make_global_mesh(spec)
@@ -75,7 +76,8 @@ def main() -> None:
     ).astype(np.int32)
     mask_all = np.ones((batch, seq), np.float32)
     tok_shard = NamedSharding(tmesh, P("dp", None))
-    local_rows = slice(rank * (batch // 2), (rank + 1) * (batch // 2))
+    rows_per = batch // n_procs
+    local_rows = slice(rank * rows_per, (rank + 1) * rows_per)
     tokens = jax.make_array_from_process_local_data(
         tok_shard, tokens_all[local_rows], (batch, seq)
     )

@@ -1,0 +1,188 @@
+"""Systematic serving-feature interaction matrix (VERDICT r4 #8).
+
+Eviction x prefix-cache x speculation x int8-KV x per-row-penalty,
+fully crossed: for each cell, a variant engine exercising the features
+must emit EXACTLY the tokens of a plain baseline engine that shares
+the cell's numeric config (kv-quant changes numerics legitimately, so
+the baseline carries it too — the invariant is that the serving
+MACHINERY is token-invisible), and its page accounting must close back
+to the fresh-engine state after every session is released.
+"""
+
+import itertools
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3
+from room_tpu.models.config import tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+GREEDY = dict(temperature=0.0, max_new_tokens=5)
+# penalties force the per-row sequential path next to spec rows
+PENALIZED = dict(temperature=0.0, max_new_tokens=5,
+                 presence_penalty=0.4, frequency_penalty=0.2)
+
+CELLS = list(itertools.product(
+    ("bf16", "int8"),        # KV cache dtype
+    (0, 4),                  # spec_tokens
+    (False, True),           # tight pool (forces eviction)
+    (False, True),           # one penalized row in the batch
+    (False, True),           # shared-prefix prompts (prefix cache)
+))
+
+
+def _prompts(shared_prefix: bool):
+    if shared_prefix:
+        p1 = list(range(1, 21))
+        p2 = p1[:16] + [30, 31, 32, 33]
+    else:
+        p1 = list(range(1, 21))
+        p2 = list(range(40, 60))
+    return p1, p2
+
+
+# baselines depend only on (kv, penalized, shared): cache them so the
+# 32-cell sweep runs 8 baselines, not 32; same for fresh free counts
+_BASELINES: dict = {}
+_FRESH_FREE: dict = {}
+
+
+def _run_scenario(cfg, params, *, n_pages, spec, penalized, prompts):
+    """Submit two concurrent sessions, a repeat of prompt 1 (prefix
+    path), then a delta continuation of session 1 (park/evict/resume
+    path). Returns (tokens per step, engine)."""
+    eng = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                        n_pages=n_pages, spec_tokens=spec)
+    p1, p2 = prompts
+    sp1 = SamplingParams(**GREEDY)
+    sp2 = SamplingParams(**(PENALIZED if penalized else GREEDY))
+    t1 = eng.submit(p1, session_id="s1", sampling=sp1)
+    t2 = eng.submit(p2, session_id="s2", sampling=sp2)
+    eng.run_until_idle()
+    # repeat of p1 as a fresh session: prefix-cache candidate
+    t3 = eng.submit(p1, session_id="s3", sampling=sp1)
+    eng.run_until_idle()
+    # delta continuation of s1 (if s1 was evicted for s3's pages this
+    # re-prefills from the host history mirror)
+    t4 = eng.submit([7, 7, 7], session_id="s1", sampling=sp1)
+    eng.run_until_idle()
+    for t in (t1, t2, t3, t4):
+        assert t.finish_reason in ("stop", "length"), t.error
+    return [t.new_tokens for t in (t1, t2, t3, t4)], eng
+
+
+@pytest.mark.parametrize(
+    "kv,spec,tight,penalized,shared", CELLS,
+    ids=[f"kv={k}-spec={s}-tight={t}-pen={p}-prefix={sh}"
+         for k, s, t, p, sh in CELLS],
+)
+def test_machinery_is_token_invisible(setup, monkeypatch, kv, spec,
+                                      tight, penalized, shared):
+    cfg, params = setup
+    if kv == "int8":
+        monkeypatch.setenv("ROOM_TPU_KV_QUANT", "int8")
+    else:
+        monkeypatch.delenv("ROOM_TPU_KV_QUANT", raising=False)
+    prompts = _prompts(shared)
+
+    # baseline: same numerics, generous pool, no spec, same penalties
+    base_key = (kv, penalized, shared)
+    if base_key not in _BASELINES:
+        _BASELINES[base_key], _ = _run_scenario(
+            cfg, params, n_pages=64, spec=0, penalized=penalized,
+            prompts=prompts,
+        )
+    want = _BASELINES[base_key]
+
+    # tight pool: page 0 is scratch, so 8 usable pages hold exactly
+    # the first two sessions (4 pages each) — s3's admission must
+    # evict, and the s1 continuation re-prefills from host history
+    n_pages = 9 if tight else 64
+    got, eng = _run_scenario(
+        cfg, params, n_pages=n_pages, spec=spec, penalized=penalized,
+        prompts=prompts,
+    )
+    assert got == want, {
+        "cell": (kv, spec, tight, penalized, shared),
+        "stats": eng.stats(),
+    }
+
+    if tight:
+        assert eng.stats()["evictions"] >= 1, eng.stats()
+
+    # page accounting closes: after releasing every session AND
+    # draining the prefix cache (cached prefixes own pages by design),
+    # the pool returns to its fresh-engine free count
+    if n_pages not in _FRESH_FREE:
+        _FRESH_FREE[n_pages] = ServingEngine(
+            cfg, params, max_batch=4, page_size=8, n_pages=n_pages,
+        ).page_table.free_pages
+    for sid in list(eng.sessions):
+        eng.release_session(sid)
+    while eng._evict_prefix():
+        pass
+    assert eng.page_table.free_pages == _FRESH_FREE[n_pages], \
+        eng.stats()
+
+
+def test_prefix_cache_engages_in_generous_shared_cells(setup,
+                                                      monkeypatch):
+    """The matrix must not silently never-exercise the prefix cache:
+    in the generous shared-prefix cell the repeat submission hits."""
+    monkeypatch.delenv("ROOM_TPU_KV_QUANT", raising=False)
+    cfg, params = setup
+    _, eng = _run_scenario(
+        cfg, params, n_pages=64, spec=0, penalized=False,
+        prompts=_prompts(True),
+    )
+    assert eng.stats()["prefix_hits"] >= 1, eng.stats()
+
+
+def test_eviction_engages_in_tight_cells(setup, monkeypatch):
+    monkeypatch.delenv("ROOM_TPU_KV_QUANT", raising=False)
+    cfg, params = setup
+    _, eng = _run_scenario(
+        cfg, params, n_pages=9, spec=0, penalized=False,
+        prompts=_prompts(False),
+    )
+    assert eng.stats()["evictions"] >= 1, eng.stats()
+
+
+def test_spec_and_penalized_rows_share_a_batch(monkeypatch):
+    """Deterministic spec engagement (8-token vocab forces a greedy
+    cycle) with a penalized batchmate: verify rounds must actually
+    run, the penalized row must take the sequential path, and both
+    rows' tokens must match their plain-engine twins."""
+    monkeypatch.delenv("ROOM_TPU_KV_QUANT", raising=False)
+    cfg = tiny_moe(vocab_size=8)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
+    prompt = [1, 2, 3, 1, 2, 3]
+    sp_plain = SamplingParams(temperature=0.0, max_new_tokens=24)
+    sp_pen = SamplingParams(temperature=0.0, max_new_tokens=24,
+                            presence_penalty=0.4)
+
+    base = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                         n_pages=64)
+    b1 = base.submit(prompt, sampling=sp_plain)
+    b2 = base.submit(prompt, sampling=sp_pen)
+    base.run_until_idle()
+
+    eng = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                        n_pages=64, spec_tokens=4)
+    t1 = eng.submit(prompt, sampling=sp_plain)
+    t2 = eng.submit(prompt, sampling=sp_pen)
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["spec_rounds"] >= 1, st
+    assert st["spec_rows_sequential"] >= 1, st
+    assert t1.new_tokens == b1.new_tokens
+    assert t2.new_tokens == b2.new_tokens

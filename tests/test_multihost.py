@@ -1,8 +1,9 @@
-"""Multi-host distributed backend: TWO real OS processes initialize
-jax.distributed against a local coordinator, form one global 8-device
-mesh, run a cross-process psum and a full dp-sharded training step
-(SURVEY §2.7 — the reference family's NCCL/MPI multi-host role,
-exercised for real, not simulated)."""
+"""Multi-host distributed backend: 2 and 3 real OS processes
+initialize jax.distributed against a local coordinator, form one
+global 8- or 12-device mesh, run a cross-process psum and a full
+dp-sharded training step (SURVEY §2.7 — the reference family's
+NCCL/MPI multi-host role, exercised for real, not simulated; the odd
+world catches rank arithmetic a world of two cannot)."""
 
 import os
 import socket
@@ -22,17 +23,24 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_collectives_and_train_step():
+import pytest
+
+
+@pytest.mark.parametrize("n_procs", [2, 3])
+def test_multi_process_collectives_and_train_step(n_procs):
+    """2- and 3-process topologies (VERDICT r4 #8 asked for a
+    3-process case: odd worlds catch rank arithmetic that a world of
+    two cannot)."""
     port = _free_port()
     procs = []
-    for rank in range(2):
+    for rank in range(n_procs):
         env = dict(os.environ)
         env.update(
             PALLAS_AXON_POOL_IPS="",
             JAX_PLATFORMS="cpu",
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
             ROOM_TPU_COORDINATOR=f"127.0.0.1:{port}",
-            ROOM_TPU_NUM_PROCESSES="2",
+            ROOM_TPU_NUM_PROCESSES=str(n_procs),
             ROOM_TPU_PROCESS_ID=str(rank),
         )
         procs.append(subprocess.Popen(
