@@ -29,12 +29,25 @@ def main() -> None:
     ap.add_argument("--split", type=float, default=0.5,
                     help="fraction of each transcript used as history")
     ap.add_argument("--gammas", default="2,4,8")
+    ap.add_argument("--tokenizer", choices=("byte", "bpe"),
+                    default="byte",
+                    help="byte = deployment ByteTokenizer; bpe = the "
+                    "qwen-style mini BPE fixture (sensitivity check: "
+                    "BPE merges shrink byte-level repetition)")
     args = ap.parse_args()
 
     from room_tpu.serving.spec_replay import replay_acceptance
     from room_tpu.serving.tokenizer import ByteTokenizer
 
-    tok = ByteTokenizer()
+    if args.tokenizer == "bpe":
+        from room_tpu.serving.tokenizer import HFTokenizer
+
+        tok = HFTokenizer(os.path.join(
+            os.path.dirname(__file__), "..", "tests", "fixtures",
+            "qwen_mini_tokenizer",
+        ))
+    else:
+        tok = ByteTokenizer()
     gammas = [int(g) for g in args.gammas.split(",")]
 
     from room_tpu.models.config import qwen2_72b, qwen3_coder_30b

@@ -19,7 +19,7 @@ CHIP_LOCK=${ROOM_TPU_CHIP_LOCK:-/tmp/axon_chip.lock}
 INSTANCE_LOCK=${TPU_WATCH_INSTANCE_LOCK:-/tmp/tpu_watch.instance.lock}
 PROBE_TIMEOUT=${TPU_PROBE_TIMEOUT:-600}
 COOLDOWN=${TPU_PROBE_COOLDOWN:-900}
-OUT=${TPU_BENCH_OUT:-/tmp/bench_r4.json}
+OUT=${TPU_BENCH_OUT:-/tmp/bench_r5.json}
 cd "$(dirname "$0")/.." || exit 1
 
 exec 8>"$INSTANCE_LOCK"
@@ -55,7 +55,7 @@ except Exception:
       ts=$(date -u +%FT%TZ)
       echo "[$ts] BENCH NONZERO ($val tok/s) - running tune sweep" >>"$LOG"
       timeout 3600 python scripts/tpu_tune.py --quick \
-        --out /tmp/tpu_tune_r4.json >>"$LOG" 2>&1
+        --out /tmp/tpu_tune_r5.json >>"$LOG" 2>&1
       echo "[$ts] watcher done" >>"$LOG"
       exit 0
     fi

@@ -162,3 +162,27 @@ def test_engine_throttle_engages_and_preserves_tokens(monkeypatch):
     assert turn.new_tokens == want.new_tokens
     # throttled rounds decode plainly: fewer verify rounds than free
     assert st["spec_rounds"] < base.stats()["spec_rounds"]
+
+
+def test_bpe_tokenizer_preserves_class_ordering():
+    """The gamma default's evidence must not be a byte-tokenizer
+    artifact: under the qwen-style mini-BPE the per-class acceptance
+    ordering (prose < toolcalls/code) and the code-class uplift
+    survive."""
+    from room_tpu.serving.tokenizer import HFTokenizer
+
+    tok = HFTokenizer(os.path.join(FIXTURES, "..",
+                                   "qwen_mini_tokenizer"))
+    rates = {}
+    tpf = {}
+    for cls in ("prose", "code", "toolcalls"):
+        toks = tok.encode(
+            open(os.path.join(FIXTURES, cls + ".txt")).read()
+        )
+        cut = len(toks) // 2
+        st = replay_acceptance(toks[:cut], toks[cut:], 4)
+        rates[cls] = st.acceptance
+        tpf[cls] = st.tokens_per_forward
+    assert rates["prose"] < rates["toolcalls"]
+    assert rates["prose"] < rates["code"]
+    assert tpf["code"] > 1.5
