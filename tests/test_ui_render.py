@@ -269,3 +269,70 @@ def test_clerk_setup_guide_steps(harness):
     assert ("PUT", "/api/settings/clerk_model",
             {"value": "tpu:qwen3-coder-30b"}) in harness.api_calls
     harness.interp.set_global("clerkGuideStep", 0)
+
+
+def test_memory_graph_view_renders_entities(harness):
+    """The memory panel's graph tab: stats line + entity table with
+    observation drill-down, driven through the live memory routes."""
+    harness.interp.set_global("memTab", "graph")
+    try:
+        harness.render("memory")
+        graph = harness.element_html("memGraph")
+        assert "entities" in graph
+        assert "render-fact" in graph
+        # drill into entity 1's observations
+        harness.call_global("entObservations", 1)
+        obs = harness.element_html("entObs-1")
+        assert "render-content" in obs
+        for poison in ("undefined", "NaN", "[object Object]"):
+            assert poison not in graph + obs
+    finally:
+        harness.interp.set_global("memTab", "search")
+
+
+def test_swarm_graph_view_draws_queen_hub(harness):
+    """The swarm graph view (SVG queen hub + worker ring) renders from
+    worker state: the seeded room's queen must appear as the hub."""
+    harness.render("swarm")
+    state = harness.interp.get_global("swarmState")
+    # mirror what the swarm loader stores (workers + rooms), saving
+    # the loader-populated values for restore (module-scoped harness)
+    from tests.jsdom.mini_js import UNDEFINED, py_to_js
+
+    saved = {k: state.get(k, UNDEFINED)
+             for k in ("rooms", "workers", "tab")}
+    state["rooms"] = py_to_js([{"id": 1, "name": "render-room"}])
+    state["workers"] = py_to_js([
+        {"id": 1, "room_id": 1, "name": "queen", "is_default": True},
+        {"id": 2, "room_id": 1, "name": "scout", "is_default": False},
+    ])
+    state["tab"] = "graph"
+    try:
+        harness.call_global("renderSwarmCards")
+        html = harness.element_html("swarmRooms")
+        assert "<svg" in html
+        assert "queen" in html
+        assert "scout" in html
+    finally:
+        for k, v in saved.items():
+            if v is UNDEFINED:
+                state.pop(k, None)
+            else:
+                state[k] = v
+
+
+def test_setup_create_room_round_trip(harness):
+    """Setup panel drives the real create-room route; the result line
+    reports the new room id."""
+    harness.render("setup")
+    harness.document.get_element_by_id(
+        "setupName")["value"] = "made-in-setup"
+    harness.document.get_element_by_id("setupTemplate")["value"] = ""
+    harness.document.get_element_by_id("setupModel")["value"] = "echo"
+    harness.call_global("setupCreate")
+    posts = [b for m, p, b in harness.api_calls
+             if m == "POST" and p == "/api/rooms"]
+    assert {"name": "made-in-setup", "workerModel": "echo"} in posts
+    out = harness.document.get_element_by_id(
+        "setupResult").get_prop("textContent")
+    assert "created" in out
