@@ -1,0 +1,252 @@
+"""Unit tests for the mini-JS interpreter (tests/jsdom/mini_js.py) —
+the render harness's engine. Panel renders cover it end-to-end; these
+pin the JS semantics corners a refactor could silently break."""
+
+import math
+
+import pytest
+
+from tests.jsdom.mini_js import (
+    UNDEFINED,
+    JSInterpreter,
+    JSObject,
+    JSThrow,
+    to_js_string,
+)
+
+
+def run(src, want_global=None):
+    i = JSInterpreter()
+    i.run(src)
+    if want_global is not None:
+        return i.get_global(want_global)
+    return i
+
+
+def test_truthiness_and_coercion():
+    i = run("""
+      const checks = [
+        !!"", !!0, !!null, !!undefined, !!NaN, !![], !!{}, !!"x",
+      ];
+      const plus = 1 + "2";
+      const num = "3" * "4";
+      const arrstr = [1, null, 2] + "";
+    """)
+    assert i.get_global("checks") == [
+        False, False, False, False, False, True, True, True,
+    ]
+    assert i.get_global("plus") == "12"
+    assert i.get_global("num") == 12
+    assert i.get_global("arrstr") == "1,,2"
+
+
+def test_equality_semantics():
+    i = run("""
+      const a = null == undefined;    // true (loose)
+      const b = null === undefined;   // false
+      const c = 0 == "0";             // true
+      const d = 0 === "";             // false
+      const e = NaN === NaN;          // false
+    """)
+    assert i.get_global("a") is True
+    assert i.get_global("b") is False
+    assert i.get_global("c") is True
+    assert i.get_global("d") is False
+    assert i.get_global("e") is False
+
+
+def test_nullish_vs_or():
+    i = run("""
+      const zero = 0 || 5;        // 5 (falsy)
+      const zkeep = 0 ?? 5;       // 0 (not nullish)
+      const u = undefined ?? "d";
+      const chain = ({}).a?.b?.c; // undefined, no throw
+    """)
+    assert i.get_global("zero") == 5
+    assert i.get_global("zkeep") == 0
+    assert i.get_global("u") == "d"
+    assert i.get_global("chain") is UNDEFINED
+
+
+def test_closures_and_hoisting():
+    assert run("""
+      const out = before();      // function decls hoist
+      function before() { return make(3)(4); }
+      function make(x) { return (y) => x + y; }
+    """, "out") == 7
+
+
+def test_destructuring_defaults_and_rest():
+    i = run("""
+      const {a, b = 9, ...rest} = {a: 1, c: 3, d: 4};
+      const [x, , z = 7] = [10, 20];
+      function f({k} = {}, ...args) { return [k, args.length]; }
+      const fr = f({k: "v"}, 1, 2, 3);
+    """)
+    assert i.get_global("a") == 1
+    assert i.get_global("b") == 9
+    assert dict(i.get_global("rest")) == {"c": 3, "d": 4}
+    assert i.get_global("x") == 10
+    assert i.get_global("z") == 7
+    assert i.get_global("fr") == ["v", 3]
+
+
+def test_template_literals_nested():
+    assert run("""
+      const xs = [{n: "a"}, {n: "b"}];
+      const out = `<ul>${xs.map(x => `<li>${x.n.toUpperCase()}` +
+        `${x.missing ?? ""}</li>`).join("")}</ul>`;
+    """, "out") == "<ul><li>A</li><li>B</li></ul>"
+
+
+def test_regex_exec_and_groups():
+    i = run("""
+      const m = /^room:(\\d+)$/.exec("room:42");
+      const none = /^x$/.exec("y");
+      const t = /ab+/.test("slabby");
+    """)
+    assert i.get_global("m") == ["room:42", "42"]
+    assert i.get_global("none") is None
+    assert i.get_global("t") is True
+
+
+def test_sort_is_stable_and_comparator_driven():
+    assert run("""
+      const xs = [{k: 2, t: "a"}, {k: 1, t: "b"}, {k: 2, t: "c"}];
+      const out = xs.sort((p, q) => p.k - q.k).map(x => x.t).join("");
+    """, "out") == "bac"
+
+
+def test_array_methods():
+    i = run("""
+      const r = [1, 2, 3, 4].reduce((acc, x) => acc + x, 10);
+      const f = [[1, 2], [3]].flat().filter(x => x > 1);
+      const fm = [1, 2].flatMap(x => [x, x * 10]);
+      const sl = [0, 1, 2, 3, 4].slice(-2);
+      const found = [5, 6, 7].findIndex(x => x === 6);
+    """)
+    assert i.get_global("r") == 20
+    assert i.get_global("f") == [2, 3]
+    assert i.get_global("fm") == [1, 10, 2, 20]
+    assert i.get_global("sl") == [3, 4]
+    assert i.get_global("found") == 1
+
+
+def test_try_catch_finally_and_throw():
+    i = run("""
+      let order = [];
+      function f() {
+        try { throw {message: "boom"}; }
+        catch (e) { order.push("caught:" + e.message); return 1; }
+        finally { order.push("fin"); }
+      }
+      const r = f();
+      let bare = 0;
+      try { JSON.parse("{bad"); } catch { bare = 1; }
+    """)
+    assert i.get_global("r") == 1
+    assert i.get_global("order") == ["caught:boom", "fin"]
+    assert i.get_global("bare") == 1
+
+
+def test_for_of_entries_and_for_classic():
+    i = run("""
+      let s = 0;
+      for (let i = 0; i < 5; i = i + 1) { if (i === 3) continue; s += i; }
+      let keys = [];
+      for (const [k, v] of Object.entries({a: 1, b: 2})) {
+        keys.push(k + v);
+      }
+    """)
+    assert i.get_global("s") == 0 + 1 + 2 + 4
+    assert i.get_global("keys") == ["a1", "b2"]
+
+
+def test_number_formatting_matches_js():
+    assert to_js_string(3.0) == "3"
+    assert to_js_string(3.5) == "3.5"
+    assert to_js_string(math.nan) == "NaN"
+    assert run("const s = (0.1 + 0.2).toFixed(2);", "s") == "0.30"
+    assert run("const s = Math.round(2.5);", "s") == 3  # not banker's
+    assert run("const s = Math.round(-2.5);", "s") == -2
+
+
+def test_async_await_runs_synchronously():
+    assert run("""
+      async function a() { return 5; }
+      async function b() { return (await a()) + (await Promise.resolve(2)); }
+      let out = 0;
+      b().then ? 0 : 0;   // result is a plain value, not a thenable
+      async function top() { out = await b(); }
+      top();
+    """, "out") == 7
+
+
+def test_spread_in_calls_arrays_objects():
+    i = run("""
+      const arr = [...[1, 2], 3];
+      const obj = {...{a: 1, b: 2}, b: 9};
+      const mx = Math.max(...[4, 8, 2]);
+    """)
+    assert i.get_global("arr") == [1, 2, 3]
+    assert dict(i.get_global("obj")) == {"a": 1, "b": 9}
+    assert i.get_global("mx") == 8
+
+
+def test_delete_and_in_operator():
+    i = run("""
+      const o = {a: 1, b: 2};
+      delete o.a;
+      const hasA = "a" in o;
+      const hasB = "b" in o;
+    """)
+    assert i.get_global("hasA") is False
+    assert i.get_global("hasB") is True
+
+
+def test_strings_methods():
+    i = run("""
+      const p = "7".padStart(3, "0");
+      const r = "a-b-c".replaceAll("-", "+");
+      const sp = "x,y,,z".split(",");
+      const inc = "hello".includes("ell");
+    """)
+    assert i.get_global("p") == "007"
+    assert i.get_global("r") == "a+b+c"
+    assert i.get_global("sp") == ["x", "y", "", "z"]
+    assert i.get_global("inc") is True
+
+
+def test_undefined_member_read_throws():
+    with pytest.raises(JSThrow):
+        run("const x = undefined.anything;")
+
+
+def test_new_is_rejected_loudly():
+    # `new` is outside the subset: a panel drifting into it must fail
+    # at parse time, not render garbage
+    with pytest.raises(SyntaxError):
+        run("const d = new Date();")
+
+
+def test_json_round_trip():
+    i = run("""
+      const o = JSON.parse('{"a": [1, 2], "b": null}');
+      const s = JSON.stringify({x: o.a, y: undefined});
+    """)
+    assert i.get_global("s") == '{"x": [1, 2]}'
+
+
+def test_global_assignment_without_declaration():
+    # classic-script behavior panels rely on (provPollTimer etc.)
+    assert run("""
+      function set() { implicitGlobal = 42; }
+      set();
+      const out = implicitGlobal;
+    """, "out") == 42
+
+
+def test_js_object_prop_default():
+    o = JSObject({"a": 1})
+    assert o.get_prop("a") == 1
+    assert o.get_prop("missing") is UNDEFINED
