@@ -14,11 +14,18 @@ def create_escalation(
     from_agent_id: Optional[int] = None,
     to_agent_id: Optional[int] = None,
 ) -> int:
-    return db.insert(
+    eid = db.insert(
         "INSERT INTO escalations(room_id, from_agent_id, to_agent_id, "
         "question) VALUES (?,?,?,?)",
         (room_id, from_agent_id, to_agent_id, question),
     )
+    # emitted here so EVERY creation path (queen tool, webhook, MCP)
+    # reaches the dashboard's desktop-notification handler
+    from .events import event_bus
+
+    event_bus.emit("escalation:created", f"room:{room_id}",
+                   {"id": eid, "question": question})
+    return eid
 
 
 def get_escalation(db: Database, escalation_id: int) -> Optional[dict]:

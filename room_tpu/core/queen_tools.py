@@ -338,11 +338,10 @@ def _dispatch(
         return "room configured"
 
     if name == "escalate_to_keeper":
+        # create_escalation emits escalation:created itself (all
+        # creation paths must reach the notification handler)
         eid = escalations_mod.create_escalation(
             db, room_id, args["question"], from_agent_id=worker_id
-        )
-        event_bus.emit(
-            "escalation:created", f"room:{room_id}", {"id": eid}
         )
         return f"escalation #{eid} sent to keeper"
 

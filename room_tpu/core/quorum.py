@@ -84,7 +84,18 @@ def announce(
         f"Announced: {proposal} (effective in {delay:g} min)",
         actor_id=proposer_id,
     )
+    _emit_decision(room_id, did, proposal, "announced")
     return get_decision(db, did)  # type: ignore[return-value]
+
+
+def _emit_decision(room_id: int, did: int, proposal: str,
+                   status: str) -> None:
+    """Open decisions reach the dashboard's desktop-notification
+    handler (decision:announced on the room channel)."""
+    from .events import event_bus
+
+    event_bus.emit("decision:announced", f"room:{room_id}",
+                   {"id": did, "proposal": proposal, "status": status})
 
 
 def object_to(
@@ -171,6 +182,7 @@ def open_ballot(
             _future(timeout_minutes), min_voters, int(sealed),
         ),
     )
+    _emit_decision(room_id, did, proposal, "voting")
     return get_decision(db, did)  # type: ignore[return-value]
 
 

@@ -521,3 +521,35 @@ def test_queen_open_ballot_tool(db, room):
         {"proposal": "tooled-vote"},
     )
     assert "already open" in again
+
+
+def test_escalation_and_decision_events_reach_the_bus(db, room):
+    """Desktop notifications ride these: EVERY escalation creation
+    path and every open decision must emit on the room channel
+    (create_escalation emits itself; quorum announce/open_ballot
+    emit decision:announced)."""
+    from room_tpu.core import escalations
+    from room_tpu.core.events import event_bus
+
+    rid = room["id"]
+    got = []
+    unsub = event_bus.subscribe(f"room:{rid}", got.append)
+    try:
+        eid = escalations.create_escalation(db, rid, "need keeper")
+        d1 = quorum.announce(db, rid, None, "evt-prop",
+                             decision_type="high_impact")
+        d2 = quorum.open_ballot(db, rid, None, "evt-ballot")
+        auto = quorum.announce(db, rid, None, "auto-ok")  # low impact
+    finally:
+        unsub()
+    by_type = {}
+    for e in got:
+        by_type.setdefault(e.type, []).append(e.data)
+    assert {"id": eid, "question": "need keeper"} in \
+        by_type["escalation:created"]
+    props = {d["proposal"]: d for d in by_type["decision:announced"]}
+    assert props["evt-prop"]["id"] == d1["id"]
+    assert props["evt-ballot"]["status"] == "voting"
+    # auto-approved decisions don't ping the keeper
+    assert "auto-ok" not in props
+    assert auto["status"] == "approved"

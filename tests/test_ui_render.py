@@ -336,3 +336,22 @@ def test_setup_create_room_round_trip(harness):
     out = harness.document.get_element_by_id(
         "setupResult").get_prop("textContent")
     assert "created" in out
+
+
+def test_settings_notifications_row(harness):
+    """Desktop-notification UX (reference useNotifications): the
+    settings row degrades when the Notification API is absent, shows
+    the enable button when unpermitted, and the verified pill when
+    granted."""
+    html = harness.render("settings")
+    assert "desktop notifications" in html
+    assert "not supported here" in html  # no Notification API in shim
+
+    harness.interp.set_global("notifySupported", lambda *a: True)
+    harness.interp.set_global("notifyPermitted", lambda *a: False)
+    html = harness.render("settings")
+    assert "notifyRequest()" in html     # enable button wired
+
+    harness.interp.set_global("notifyPermitted", lambda *a: True)
+    html = harness.render("settings")
+    assert "enabled" in html

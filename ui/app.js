@@ -217,6 +217,57 @@ function promptDialog(text, placeholder) {
 }
 
 
+// ---- desktop notifications (reference: ui/lib/notifications.ts +
+// useNotifications — browser alerts for escalations and new
+// proposals, with a PWA app badge cleared on focus) ----
+
+let notifyBadge = 0;
+
+function notifySupported() {
+  return "Notification" in window;
+}
+
+function notifyPermitted() {
+  return notifySupported() && Notification.permission === "granted";
+}
+
+async function notifyRequest() {
+  if (!notifySupported()) return false;
+  const ok = (await Notification.requestPermission()) === "granted";
+  refreshView();   // settings panel shows the new state
+  return ok;
+}
+
+function setAppBadge(count) {
+  notifyBadge = count;
+  if (typeof navigator !== "undefined" && "setAppBadge" in navigator) {
+    if (count > 0) navigator.setAppBadge(count).catch(() => {});
+    else navigator.clearAppBadge().catch(() => {});
+  }
+}
+
+function notifyShow(title, body) {
+  // only alert when the tab can't be seen: a focused keeper is
+  // already looking at the event
+  if (!notifyPermitted() || !document.hidden) return;
+  const n = new Notification(title, {body, icon: "/icon.svg"});
+  n.onclick = () => { window.focus(); n.close(); };
+  setAppBadge(notifyBadge + 1);
+}
+
+wsHandlers.notify = (msg) => {
+  if (msg.type === "escalation:created") {
+    notifyShow("keeper needed",
+      (msg.data && msg.data.question) ||
+      "an agent escalated a question to you");
+  } else if (msg.type === "decision:announced") {
+    notifyShow("new proposal",
+      (msg.data && msg.data.proposal) || "a decision was announced");
+  }
+};
+
+window.addEventListener("focus", () => setAppBadge(0));
+
 // ---- PWA (reference: the SPA's service-worker layer) ----
 
 function registerServiceWorker(version) {

@@ -1142,6 +1142,18 @@ async function renderSettings(el) {
         <button class="ghost" onclick="tgStart()">
           connect telegram</button>
         <span id="tgLink" class="dim"></span>
+      </div>
+      <div class="row" style="align-items:center">
+        <span class="k">desktop notifications</span>
+        ${typeof notifySupported === "function" && notifySupported()
+          ? (notifyPermitted()
+            ? '<span class="pill verified">enabled</span>'
+            : `<button class="ghost" onclick="notifyRequest()">
+                 enable</button>`)
+          : '<span class="dim">not supported here</span>'}
+        <span class="dim" style="font-size:.85em">
+          escalations + new proposals alert even when this tab is in
+          the background</span>
       </div></div>
     <div class="panel"><h2>settings</h2>
       <table id="settingsTable">${
