@@ -526,6 +526,12 @@ class Parser:
         self.toks = tokens
         self.i = 0
         self.src = src
+        self._newlines = [i for i, c in enumerate(src) if c == "\n"]
+
+    def _line(self, pos: int) -> int:
+        import bisect
+
+        return bisect.bisect_right(self._newlines, pos)
 
     # -- token helpers --
 
@@ -923,6 +929,9 @@ class Parser:
         return self._binary(self.parse_unary, "*", "/", "%")
 
     def parse_unary(self):
+        if self.at_punct("++", "--"):
+            op = self.next().value
+            return ("predec", op, self.parse_unary())
         if self.at_punct("!", "-", "+"):
             op = self.next().value
             return ("un", op, self.parse_unary())
@@ -961,6 +970,12 @@ class Parser:
                 e = ("index", e, idx, False)
             elif self.at_punct("("):
                 e = ("call", e, self.parse_args(), False)
+            elif self.at_punct("++", "--") and self._line(
+                    self.peek().pos) == self._line(
+                    self.toks[self.i - 1].pos):
+                # ASI: postfix ++/-- must sit on the operand's line
+                op = self.next().value
+                e = ("postdec", op, e)
             else:
                 return e
 
@@ -1493,6 +1508,29 @@ class JSInterpreter:
             return True
         if tag == "await":
             return self.eval_expr(e[1], env)
+        if tag in ("predec", "postdec"):
+            # resolve the reference ONCE: a side-effecting operand
+            # (a[f()]++) must read and write the same slot
+            op, target = e[1], e[2]
+            ttag = target[0]
+            if ttag == "ident":
+                old = to_number(env.get(target[1]))
+                new = old + (1 if op == "++" else -1)
+                env.set(target[1], new)
+            elif ttag == "member":
+                obj = self.eval_expr(target[1], env)
+                old = to_number(self.get_member(obj, target[2]))
+                new = old + (1 if op == "++" else -1)
+                self.set_member(obj, target[2], new)
+            elif ttag == "index":
+                obj = self.eval_expr(target[1], env)
+                key = self.eval_expr(target[2], env)
+                old = to_number(self.get_index(obj, key))
+                new = old + (1 if op == "++" else -1)
+                self.set_index(obj, key, new)
+            else:
+                raise JSThrow(f"invalid ++/-- target {ttag}")
+            return new if tag == "predec" else old
         if tag == "seq":
             self.eval_expr(e[1], env)
             return self.eval_expr(e[2], env)

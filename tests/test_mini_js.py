@@ -250,3 +250,38 @@ def test_js_object_prop_default():
     o = JSObject({"a": 1})
     assert o.get_prop("a") == 1
     assert o.get_prop("missing") is UNDEFINED
+
+
+def test_increment_decrement():
+    i = run("""
+      let n = 5;
+      const post = n++;   // 5, n=6
+      const pre = ++n;    // 7
+      const o = {c: 3};
+      o.c--;
+      let loopSum = 0;
+      for (let j = 0; j < 3; j++) loopSum += j;
+    """)
+    assert i.get_global("post") == 5
+    assert i.get_global("pre") == 7
+    assert i.get_global("n") == 7
+    assert dict(i.get_global("o")) == {"c": 2}
+    assert i.get_global("loopSum") == 3
+
+
+def test_increment_single_evaluation_and_asi():
+    i = run("""
+      let calls = 0;
+      function f() { calls++; return 0; }
+      const a = [10];
+      a[f()]++;
+      let x = 1;
+      let y = 2;
+      const c = x
+      ++y;
+    """)
+    assert i.get_global("calls") == 1      # operand evaluated once
+    assert i.get_global("a") == [11]
+    assert i.get_global("x") == 1          # ASI: x stays untouched
+    assert i.get_global("y") == 3          # ++y on the next line
+    assert i.get_global("c") == 1
