@@ -16,7 +16,21 @@ const when = (ts) => {
   return isNaN(d) ? String(ts) : d.toLocaleString();
 };
 
-async function api(method, path, body) {
+async function tryHandshake() {
+  // localhost-gated token mint; shared by boot() and the 401-retry
+  try {
+    const res = await fetch("/api/auth/handshake");
+    const out = await res.json();
+    if (out.data?.userToken) {
+      TOKEN = out.data.userToken;
+      localStorage.setItem("room_tpu_token", TOKEN);
+      return true;
+    }
+  } catch {}
+  return false;
+}
+
+async function api(method, path, body, retried) {
   const res = await fetch(path, {
     method,
     headers: {
@@ -25,7 +39,17 @@ async function api(method, path, body) {
     },
     body: body ? JSON.stringify(body) : undefined,
   });
-  if (res.status === 401) { showLogin(); throw new Error("unauthorized"); }
+  if (res.status === 401) {
+    // one silent refresh via the localhost handshake before bouncing
+    // to the login screen (reference: ui/lib/client.ts 401-retry) —
+    // a restarted server mints new tokens and the old one in
+    // localStorage would otherwise strand every open tab
+    if (!retried && await tryHandshake()) {
+      return api(method, path, body, true);
+    }
+    showLogin();
+    throw new Error("unauthorized");
+  }
   const out = await res.json().catch(() => ({}));
   if (out.error && res.status >= 400) toast(out.error);
   return out;
@@ -137,14 +161,7 @@ const wsHandlers = {};     // name -> fn(msg), panels register here
 
 async function boot() {
   if (!TOKEN) {
-    try {
-      const res = await fetch("/api/auth/handshake");
-      const out = await res.json();
-      if (out.data?.userToken) {
-        TOKEN = out.data.userToken;
-        localStorage.setItem("room_tpu_token", TOKEN);
-      }
-    } catch {}
+    await tryHandshake();
   }
   let st;
   try {
