@@ -52,6 +52,17 @@ PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
                    16384, 32768)
 
 
+@jax.jit
+def _sample_first(logits, key, temps, top_ps, top_ks):
+    """Jitted first-token sampling for prefill groups. Calling
+    sample_batched eagerly here cost ~50 primitive dispatches plus an
+    eagerly-traced lax.cond per prefill — each one a host<->device
+    round trip over the TPU tunnel, straight onto queen-turn latency.
+    Shapes are bounded by the power-of-two batch padding, so compiles
+    stay bounded too."""
+    return sample_batched(logits, key, temps, top_ps, top_ks)
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _reset_count_row(counts, slot, tok):
     """Zero one slot's penalty-count row and count its first sampled
@@ -1073,7 +1084,7 @@ class ServingEngine:
             temps = [p["turn"].sampling.temperature for p in group]
             top_ps = [p["turn"].sampling.top_p for p in group]
             top_ks = [p["turn"].sampling.top_k for p in group]
-            firsts = np.asarray(sample_batched(
+            firsts = np.asarray(_sample_first(
                 last_logits, sub,
                 jnp.asarray(temps + [1.0] * (n_pad - n), jnp.float32),
                 jnp.asarray(top_ps + [1.0] * (n_pad - n), jnp.float32),
