@@ -100,7 +100,7 @@ CLERK_TOOLS: list[dict] = [
         "keeper_vote", "Cast the keeper's vote on a decision.",
         {
             "decision_id": {"type": "integer"},
-            "vote": {"type": "string", "enum": ["yes", "no", "abstain"]},
+            "vote": {"type": "string", "enum": ["yes", "no"]},
         },
         ["decision_id", "vote"],
     ),

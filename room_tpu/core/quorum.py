@@ -224,6 +224,10 @@ def vote(
 
 
 def keeper_vote(db: Database, decision_id: int, vote_value: str) -> dict:
+    if vote_value not in ("yes", "no"):
+        # fail loudly: the non-"no" branch below approves, so a typo'd
+        # veto must never silently become an approval
+        raise QuorumError(f"invalid keeper vote {vote_value!r}")
     decision = get_decision(db, decision_id)
     if decision is None:
         raise QuorumError(f"decision {decision_id} not found")

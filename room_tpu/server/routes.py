@@ -1611,22 +1611,40 @@ def register_decision_routes(r: Router) -> None:
             (room["id"],),
         ))
 
+    def _normalize_vote(body) -> str:
+        """UI vocabulary (approve/reject, reference VotesPanel) ->
+        quorum core vocabulary (yes/no); anything non-string passes
+        through as "" so the core's validation 409s instead of a
+        TypeError 500."""
+        v = (body or {}).get("vote", "")
+        if not isinstance(v, str):
+            return ""
+        return {"approve": "yes", "reject": "no"}.get(v, v)
+
     def vote(ctx):
         b = ctx.body or {}
+        if not b.get("workerId"):
+            # worker ballots need an actor; the human keeper votes via
+            # /keeper-vote (worker 0 used to FK-crash into a 500 here)
+            return err("workerId is required (keeper votes go to "
+                       "/api/decisions/:id/keeper-vote)", 400)
         try:
             d = quorum_mod.vote(
-                ctx.db, int(ctx.params["id"]), int(b.get("workerId", 0)),
-                b.get("vote", ""), b.get("reasoning"),
+                ctx.db, int(ctx.params["id"]), int(b["workerId"]),
+                _normalize_vote(b), b.get("reasoning"),
             )
         except quorum_mod.QuorumError as e:
             return err(str(e), 409)
         return ok(d)
 
     def keeper_vote(ctx):
-        b = ctx.body or {}
+        # the core's keeper_vote approves on anything but "no", so the
+        # mapping (reject -> no) must happen before the call — an
+        # unmapped "reject" would INVERT a keeper veto into approval
         try:
             d = quorum_mod.keeper_vote(
-                ctx.db, int(ctx.params["id"]), b.get("vote", "")
+                ctx.db, int(ctx.params["id"]),
+                _normalize_vote(ctx.body)
             )
         except quorum_mod.QuorumError as e:
             return err(str(e), 409)

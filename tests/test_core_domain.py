@@ -553,3 +553,11 @@ def test_escalation_and_decision_events_reach_the_bus(db, room):
     # auto-approved decisions don't ping the keeper
     assert "auto-ok" not in props
     assert auto["status"] == "approved"
+
+
+def test_keeper_vote_rejects_unknown_vocabulary(db, room):
+    d = quorum.open_ballot(db, room["id"], None, "strict-veto")
+    with pytest.raises(quorum.QuorumError):
+        quorum.keeper_vote(db, d["id"], "reject")   # UI word, not core
+    # unchanged — the typo'd veto did NOT approve
+    assert quorum.get_decision(db, d["id"])["status"] == "voting"

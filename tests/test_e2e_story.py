@@ -177,3 +177,63 @@ def test_swarm_cycle_on_real_engine(server, monkeypatch):
     finally:
         req(server, "POST", f"/api/rooms/{room_id}/stop")
         reset_model_hosts()
+
+
+def test_round5_feature_story(server):
+    """Round-5 capstone over real HTTP + WS: keeper configures the
+    room via the validated settings PUT (min voters included), the
+    queen opens an explicit ballot with her new tool, the open
+    decision reaches a live WS subscriber as decision:announced (the
+    desktop-notification feed), votes tally against the configured
+    electorate floor, and the clerk guide's model setting round-trips
+    through the settings route."""
+    from room_tpu.core.queen_tools import execute_queen_tool
+    from tests.test_server import WsClient
+
+    db = server.db
+    _, room = req(server, "POST", "/api/rooms",
+                  {"name": "r5-story", "workerModel": "echo"})
+    rid = room["data"]["id"]
+
+    ws = WsClient(server.port, server.tokens["user"])
+    ws.send_json({"type": "subscribe", "channel": f"room:{rid}"})
+    assert ws.recv_json()["type"] == "subscribed"
+
+    # settings PUT exactly as the dashboard's roomConfigSave sends it
+    st, out = req(server, "PUT", f"/api/rooms/{rid}", {
+        "name": "r5-story-renamed",
+        "queenMaxTurns": 40,
+        "config": {"voteThreshold": "majority", "minVoters": 2,
+                   "voteTimeoutMinutes": 10},
+    })
+    assert st == 200 and out["data"]["name"] == "r5-story-renamed"
+
+    # queen opens an explicit ballot; the configured floor binds
+    queen = db.query_one(
+        "SELECT id FROM workers WHERE room_id=? AND is_default=1",
+        (rid,),
+    )["id"]
+    msg = execute_queen_tool(db, rid, queen, "open_ballot",
+                             {"proposal": "ship round 5"})
+    assert "min voters 2" in msg
+
+    evt = ws.recv_json()
+    assert evt["type"] == "decision:announced"
+    assert evt["data"]["proposal"] == "ship round 5"
+    did = evt["data"]["id"]
+
+    # one yes from the queen cannot clear majority-of-2
+    st, _ = req(server, "POST", f"/api/decisions/{did}/vote",
+                {"vote": "approve", "workerId": queen})
+    assert st == 200
+    st, d = req(server, "GET", f"/api/rooms/{rid}/decisions")
+    ballot = next(x for x in d["data"] if x["id"] == did)
+    assert ballot["status"] == "voting"
+
+    # clerk guide's model pick round-trips
+    st, _ = req(server, "PUT", "/api/settings/clerk_model",
+                {"value": "echo:test"})
+    assert st == 200
+    st, got = req(server, "GET", "/api/settings/clerk_model")
+    assert got["data"]["value"] == "echo:test"
+    ws.close()

@@ -568,3 +568,52 @@ def test_webhook_rate_limit_and_eviction(server):
     finally:
         wh._hits.clear()
         wh._hits.update(old)
+
+
+def test_vote_routes_accept_dashboard_vocabulary(server):
+    """The votes panel speaks approve/reject; the quorum core speaks
+    yes/no. The routes must translate — before the mapping, every
+    panel vote 409ed and a keeper 'reject' would have APPROVED."""
+    from room_tpu.core import quorum, rooms
+
+    db = server.db
+    room = rooms.create_room(db, "vocab", worker_model="echo")
+    d = quorum.open_ballot(db, room["id"], None, "map-me")
+    st, out = req(server, "POST", f"/api/decisions/{d['id']}/vote",
+                  {"vote": "approve",
+                   "workerId": room["queen_worker_id"]})
+    assert st == 200, out
+    votes = db.query(
+        "SELECT vote FROM quorum_votes WHERE decision_id=?", (d["id"],)
+    )
+    assert votes[0]["vote"] == "yes"
+
+    # no workerId: clean 400 pointing at keeper-vote, never an FK 500
+    st, out = req(server, "POST", f"/api/decisions/{d['id']}/vote",
+                  {"vote": "approve"})
+    assert st == 400 and "keeper-vote" in out["error"]
+    # non-string vote: clean 4xx, never a TypeError 500
+    st, _ = req(server, "POST", f"/api/decisions/{d['id']}/vote",
+                {"vote": ["yes"],
+                 "workerId": room["queen_worker_id"]})
+    assert st == 409
+
+    # on an open ballot the keeper is one voter: "reject" must be
+    # recorded as a "no" ballot vote (pre-mapping it would have been
+    # stored raw and, on the announced path, treated as approval)
+    d2 = quorum.open_ballot(db, room["id"], None, "veto-me")
+    st, out = req(server, "POST",
+                  f"/api/decisions/{d2['id']}/keeper-vote",
+                  {"vote": "reject"})
+    assert st == 200, out
+    assert quorum.get_decision(db, d2["id"])["keeper_vote"] == "no"
+
+    # on an ANNOUNCED decision the keeper veto is absolute: "reject"
+    # must object, never approve
+    d3 = quorum.announce(db, room["id"], None, "announced-veto",
+                         decision_type="high_impact")
+    st, out = req(server, "POST",
+                  f"/api/decisions/{d3['id']}/keeper-vote",
+                  {"vote": "reject"})
+    assert st == 200, out
+    assert quorum.get_decision(db, d3["id"])["status"] == "objected"

@@ -355,3 +355,16 @@ def test_settings_notifications_row(harness):
     harness.interp.set_global("notifyPermitted", lambda *a: True)
     html = harness.render("settings")
     assert "enabled" in html
+
+
+def test_votes_panel_buttons_ride_keeper_route(harness):
+    """The human at the dashboard is the keeper: approve/reject must
+    hit /keeper-vote (posting to /vote without a workerId was an FK
+    500 before)."""
+    harness.render("votes")
+    harness.call_global("vote", 1, "approve")
+    assert ("POST", "/api/decisions/1/keeper-vote",
+            {"vote": "approve"}) in harness.api_calls
+    assert not any(
+        p == "/api/decisions/1/vote" for _, p, _ in harness.api_calls
+    )

@@ -850,7 +850,10 @@ async function renderVotes(el) {
 }
 
 async function vote(id, v) {
-  await api("POST", `/api/decisions/${id}/vote`, {vote: v});
+  // the dashboard user IS the keeper: approve/reject ride the
+  // keeper-vote route (worker ballots need a workerId and come from
+  // agents/MCP, not this panel)
+  await api("POST", `/api/decisions/${id}/keeper-vote`, {vote: v});
   refreshView();
 }
 
