@@ -211,6 +211,14 @@ class ApiServer:
                     self._handle_inner()
                 except BrokenPipeError:
                     pass
+                except (ValueError, TypeError) as e:
+                    # malformed client scalars (e.g. /api/rooms/NaN
+                    # int-converted in a handler) are the CLIENT's
+                    # fault — 400, not an internal 500
+                    try:
+                        self._respond(400, {"error": f"bad request: {e}"})
+                    except Exception:
+                        pass
                 except Exception as e:
                     try:
                         self._respond(500, {"error": str(e)})

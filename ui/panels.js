@@ -1641,7 +1641,9 @@ async function loadMessages() {
 }
 
 function msgPick() {
-  msgRoom = parseInt($("msgRoomSel").value, 10);
+  const v = parseInt($("msgRoomSel").value, 10);
+  if (isNaN(v)) return;   // empty select: nothing to open
+  msgRoom = v;
   loadMessages();
 }
 
