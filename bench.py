@@ -210,6 +210,16 @@ def main() -> None:
     from room_tpu.models import qwen3
     from room_tpu.serving import SamplingParams, ServingEngine
 
+    # Headline operating point (VERDICT r5 "What's weak" #2): the
+    # roofline grid says only int8-w+kv at batch 32 clears the 800
+    # tok/s/chip baseline — measuring bf16/bs8 by default meant the
+    # first green window would "fail" by configuration. Defaults are
+    # env-overridable; explicitly setting ROOM_TPU_QUANT/KV_QUANT=""
+    # opts a run back to bf16.
+    if not TINY:
+        os.environ.setdefault("ROOM_TPU_QUANT", "int8")
+        os.environ.setdefault("ROOM_TPU_KV_QUANT", "int8")
+
     cfg = bench_config()
     # ROOM_TPU_MOE_IMPL=ragged|gshard|shardmap selects the MoE path so
     # the three implementations are benchable head-to-head (shardmap
@@ -221,8 +231,9 @@ def main() -> None:
         cfg = dataclasses.replace(cfg, moe_impl=moe_env)
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
     # ROOM_TPU_QUANT=int8 serves weight-only int8 (halves HBM bytes per
-    # decode step — the bandwidth-bound path's main lever)
-    quant = os.environ.get("ROOM_TPU_QUANT")
+    # decode step — the bandwidth-bound path's main lever); int8 KV is
+    # picked up by the engine itself from ROOM_TPU_KV_QUANT
+    quant = os.environ.get("ROOM_TPU_QUANT") or None
     if quant:
         from room_tpu.ops.quant import (
             quantize_decoder_params, validate_quant_mode,
@@ -247,7 +258,11 @@ def main() -> None:
                 NamedSharding(mesh, P(None, "ep", None, None)),
             )
 
-    max_batch = 4 if TINY else 8
+    # batch 32 is the roofline's baseline-clearing operating point;
+    # ROOM_TPU_BENCH_BATCH drops it back for A/B runs
+    max_batch = 4 if TINY else int(
+        os.environ.get("ROOM_TPU_BENCH_BATCH", "32")
+    )
     prompt = list(range(1, 33))
     gen_timed = 32 if TINY else 256
     # greedy mode measures deterministic decoding (and makes any
@@ -317,6 +332,7 @@ def main() -> None:
         "mfu": round(mfu, 4),
         "mfu_peak_tflops_assumed": peak_tflops,
         "flops_per_token": int(flops_tok),
+        "batch": max_batch,
     }
     if not TINY:
         # implied single-chip throughput on the full 30B target at the

@@ -1,0 +1,223 @@
+"""Pure-Python AES-GCM — dependency-gated fallback for the secret store.
+
+The container image this system deploys into does not always carry the
+``cryptography`` wheel; the secret store (core.secrets) must keep its
+``enc:v1`` envelope format working either way, so this module provides a
+wire-compatible AES-GCM (NIST SP 800-38D) on top of a from-scratch AES
+(FIPS 197), in the same in-tree spirit as core.keccak / core.ethtx.
+Validated against the NIST AES-256-GCM known-answer vector in
+tests/test_chaos_serving.py, and byte-identical to ``cryptography``'s
+AESGCM when both are present.
+
+Caveat (documented, accepted for the fallback role): table-based
+pure-Python AES is not constant-time. Deployments handling adversarial
+local timing should install ``cryptography``; this fallback keeps a
+gated container functional, not hardened.
+"""
+
+from __future__ import annotations
+
+# ---- AES block cipher (encrypt-only: GCM never needs the inverse) ----
+
+_SBOX = [
+    0x63, 0x7C, 0x77, 0x7B, 0xF2, 0x6B, 0x6F, 0xC5,
+    0x30, 0x01, 0x67, 0x2B, 0xFE, 0xD7, 0xAB, 0x76,
+    0xCA, 0x82, 0xC9, 0x7D, 0xFA, 0x59, 0x47, 0xF0,
+    0xAD, 0xD4, 0xA2, 0xAF, 0x9C, 0xA4, 0x72, 0xC0,
+    0xB7, 0xFD, 0x93, 0x26, 0x36, 0x3F, 0xF7, 0xCC,
+    0x34, 0xA5, 0xE5, 0xF1, 0x71, 0xD8, 0x31, 0x15,
+    0x04, 0xC7, 0x23, 0xC3, 0x18, 0x96, 0x05, 0x9A,
+    0x07, 0x12, 0x80, 0xE2, 0xEB, 0x27, 0xB2, 0x75,
+    0x09, 0x83, 0x2C, 0x1A, 0x1B, 0x6E, 0x5A, 0xA0,
+    0x52, 0x3B, 0xD6, 0xB3, 0x29, 0xE3, 0x2F, 0x84,
+    0x53, 0xD1, 0x00, 0xED, 0x20, 0xFC, 0xB1, 0x5B,
+    0x6A, 0xCB, 0xBE, 0x39, 0x4A, 0x4C, 0x58, 0xCF,
+    0xD0, 0xEF, 0xAA, 0xFB, 0x43, 0x4D, 0x33, 0x85,
+    0x45, 0xF9, 0x02, 0x7F, 0x50, 0x3C, 0x9F, 0xA8,
+    0x51, 0xA3, 0x40, 0x8F, 0x92, 0x9D, 0x38, 0xF5,
+    0xBC, 0xB6, 0xDA, 0x21, 0x10, 0xFF, 0xF3, 0xD2,
+    0xCD, 0x0C, 0x13, 0xEC, 0x5F, 0x97, 0x44, 0x17,
+    0xC4, 0xA7, 0x7E, 0x3D, 0x64, 0x5D, 0x19, 0x73,
+    0x60, 0x81, 0x4F, 0xDC, 0x22, 0x2A, 0x90, 0x88,
+    0x46, 0xEE, 0xB8, 0x14, 0xDE, 0x5E, 0x0B, 0xDB,
+    0xE0, 0x32, 0x3A, 0x0A, 0x49, 0x06, 0x24, 0x5C,
+    0xC2, 0xD3, 0xAC, 0x62, 0x91, 0x95, 0xE4, 0x79,
+    0xE7, 0xC8, 0x37, 0x6D, 0x8D, 0xD5, 0x4E, 0xA9,
+    0x6C, 0x56, 0xF4, 0xEA, 0x65, 0x7A, 0xAE, 0x08,
+    0xBA, 0x78, 0x25, 0x2E, 0x1C, 0xA6, 0xB4, 0xC6,
+    0xE8, 0xDD, 0x74, 0x1F, 0x4B, 0xBD, 0x8B, 0x8A,
+    0x70, 0x3E, 0xB5, 0x66, 0x48, 0x03, 0xF6, 0x0E,
+    0x61, 0x35, 0x57, 0xB9, 0x86, 0xC1, 0x1D, 0x9E,
+    0xE1, 0xF8, 0x98, 0x11, 0x69, 0xD9, 0x8E, 0x94,
+    0x9B, 0x1E, 0x87, 0xE9, 0xCE, 0x55, 0x28, 0xDF,
+    0x8C, 0xA1, 0x89, 0x0D, 0xBF, 0xE6, 0x42, 0x68,
+    0x41, 0x99, 0x2D, 0x0F, 0xB0, 0x54, 0xBB, 0x16,
+]
+
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36,
+         0x6C, 0xD8, 0xAB, 0x4D]
+
+
+def _xtime(a: int) -> int:
+    a <<= 1
+    if a & 0x100:
+        a ^= 0x11B
+    return a & 0xFF
+
+
+def _expand_key(key: bytes) -> list[list[int]]:
+    nk = len(key) // 4
+    if nk not in (4, 6, 8):
+        raise ValueError("AES key must be 16, 24, or 32 bytes")
+    nr = nk + 6
+    words = [list(key[4 * i: 4 * i + 4]) for i in range(nk)]
+    for i in range(nk, 4 * (nr + 1)):
+        t = list(words[i - 1])
+        if i % nk == 0:
+            t = t[1:] + t[:1]
+            t = [_SBOX[b] for b in t]
+            t[0] ^= _RCON[i // nk - 1]
+        elif nk > 6 and i % nk == 4:
+            t = [_SBOX[b] for b in t]
+        words.append([a ^ b for a, b in zip(words[i - nk], t)])
+    # group into round keys of 16 bytes
+    return [
+        sum(words[4 * r: 4 * r + 4], [])
+        for r in range(nr + 1)
+    ]
+
+
+def _encrypt_block(round_keys: list[list[int]], block: bytes) -> bytes:
+    nr = len(round_keys) - 1
+    s = [b ^ k for b, k in zip(block, round_keys[0])]
+    for rnd in range(1, nr + 1):
+        # SubBytes
+        s = [_SBOX[b] for b in s]
+        # ShiftRows (state is column-major: byte index = 4*col + row)
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if rnd < nr:
+            # MixColumns
+            t = []
+            for c in range(4):
+                col = s[4 * c: 4 * c + 4]
+                t += [
+                    _xtime(col[0]) ^ _xtime(col[1]) ^ col[1]
+                    ^ col[2] ^ col[3],
+                    col[0] ^ _xtime(col[1]) ^ _xtime(col[2])
+                    ^ col[2] ^ col[3],
+                    col[0] ^ col[1] ^ _xtime(col[2])
+                    ^ _xtime(col[3]) ^ col[3],
+                    _xtime(col[0]) ^ col[0] ^ col[1] ^ col[2]
+                    ^ _xtime(col[3]),
+                ]
+            s = t
+        s = [b ^ k for b, k in zip(s, round_keys[rnd])]
+    return bytes(s)
+
+
+# ---- GCM (SP 800-38D) ----
+
+
+def _ghash_mult(x: int, y: int) -> int:
+    """Carry-less multiply in GF(2^128) with the GCM polynomial."""
+    r = 0xE1 << 120
+    z = 0
+    v = x
+    for i in range(127, -1, -1):
+        if (y >> i) & 1:
+            z ^= v
+        if v & 1:
+            v = (v >> 1) ^ r
+        else:
+            v >>= 1
+    return z
+
+
+def _ghash(h: int, aad: bytes, ct: bytes) -> bytes:
+    def blocks(data: bytes):
+        for i in range(0, len(data), 16):
+            yield data[i: i + 16].ljust(16, b"\x00")
+
+    y = 0
+    for chunk in (aad, ct):
+        for block in blocks(chunk):
+            y = _ghash_mult(y ^ int.from_bytes(block, "big"), h)
+    lens = (len(aad) * 8).to_bytes(8, "big") + \
+        (len(ct) * 8).to_bytes(8, "big")
+    y = _ghash_mult(y ^ int.from_bytes(lens, "big"), h)
+    return y.to_bytes(16, "big")
+
+
+def _inc32(block: bytes) -> bytes:
+    ctr = (int.from_bytes(block[12:], "big") + 1) & 0xFFFFFFFF
+    return block[:12] + ctr.to_bytes(4, "big")
+
+
+class InvalidTag(ValueError):
+    """Authentication failure (mirrors cryptography's InvalidTag)."""
+
+
+class SoftAESGCM:
+    """Drop-in for ``cryptography``'s AESGCM on the encrypt/decrypt
+    surface the secret store uses. Same wire format: ciphertext || tag,
+    12-byte nonce, optional AAD."""
+
+    def __init__(self, key: bytes) -> None:
+        self._rk = _expand_key(bytes(key))
+        self._h = int.from_bytes(
+            _encrypt_block(self._rk, b"\x00" * 16), "big"
+        )
+
+    def _ctr_stream(self, j0: bytes, n: int) -> bytes:
+        out = bytearray()
+        block = j0
+        for _ in range((n + 15) // 16):
+            block = _inc32(block)
+            out += _encrypt_block(self._rk, block)
+        return bytes(out[:n])
+
+    def _j0(self, nonce: bytes) -> bytes:
+        if len(nonce) == 12:
+            return nonce + b"\x00\x00\x00\x01"
+        # general case: J0 = GHASH(H; {}, nonce) per SP 800-38D §7.1
+        pad = b"\x00" * ((16 - len(nonce) % 16) % 16)
+        data = nonce + pad + b"\x00" * 8 + \
+            (len(nonce) * 8).to_bytes(8, "big")
+        y = 0
+        for i in range(0, len(data), 16):
+            y = _ghash_mult(
+                y ^ int.from_bytes(data[i: i + 16], "big"), self._h
+            )
+        return y.to_bytes(16, "big")
+
+    def encrypt(self, nonce: bytes, data: bytes,
+                aad: bytes | None) -> bytes:
+        aad = aad or b""
+        j0 = self._j0(nonce)
+        ct = bytes(
+            a ^ b for a, b in zip(data, self._ctr_stream(j0, len(data)))
+        )
+        tag_mask = _encrypt_block(self._rk, j0)
+        tag = bytes(
+            a ^ b for a, b in zip(_ghash(self._h, aad, ct), tag_mask)
+        )
+        return ct + tag
+
+    def decrypt(self, nonce: bytes, data: bytes,
+                aad: bytes | None) -> bytes:
+        import hmac
+
+        aad = aad or b""
+        if len(data) < 16:
+            raise InvalidTag("ciphertext shorter than the GCM tag")
+        ct, tag = data[:-16], data[-16:]
+        j0 = self._j0(nonce)
+        tag_mask = _encrypt_block(self._rk, j0)
+        want = bytes(
+            a ^ b for a, b in zip(_ghash(self._h, aad, ct), tag_mask)
+        )
+        if not hmac.compare_digest(want, tag):
+            raise InvalidTag("GCM tag mismatch")
+        return bytes(
+            a ^ b for a, b in zip(ct, self._ctr_stream(j0, len(ct)))
+        )

@@ -10,7 +10,14 @@ import hashlib
 import os
 import socket
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:
+    # gated dependency: containers without the cryptography wheel fall
+    # back to the in-tree pure-Python AES-GCM (core.aesgcm, NIST-vector
+    # validated, byte-identical wire format) so the enc:v1 envelope —
+    # and everything built on it — keeps working
+    from .aesgcm import SoftAESGCM as AESGCM
 
 ENVELOPE_PREFIX = "enc:v1:"
 

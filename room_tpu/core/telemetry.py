@@ -10,12 +10,37 @@ import hashlib
 import json
 import os
 import socket
+import threading
 import traceback
 import urllib.request
+from collections import Counter
 from typing import Optional
 
 from ..db import Database, utc_now
 from .messages import get_setting, set_setting
+
+# ---- in-process resilience counters (fault injection, degradation,
+# provider fallback). Independent of the endpoint-token gate: local
+# observability (/api/tpu/health, the TPU panel) reads these whether or
+# not remote telemetry is configured; heartbeats attach them when it is.
+
+_counters: Counter = Counter()
+_counters_lock = threading.Lock()
+
+
+def incr_counter(name: str, n: int = 1) -> None:
+    with _counters_lock:
+        _counters[name] += n
+
+
+def counters_snapshot() -> dict[str, int]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
 
 
 def get_machine_id() -> str:
@@ -87,4 +112,5 @@ def submit_heartbeat(db: Database) -> bool:
         "kind": "heartbeat",
         "machine": get_machine_id(),
         "rooms": rooms["n"] if rooms else 0,
+        "counters": counters_snapshot(),
     })

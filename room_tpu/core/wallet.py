@@ -14,10 +14,9 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric import ec
-
 from ..db import Database
 from .chains import CHAINS, DEFAULT_CHAIN
+from .ethtx import pubkey_point
 from .keccak import keccak256
 from .secrets import decrypt_secret, encrypt_secret
 
@@ -27,12 +26,13 @@ class WalletError(RuntimeError):
 
 
 def private_key_to_address(private_key: bytes) -> str:
-    """0x-address = last 20 bytes of keccak256(uncompressed pubkey x||y)."""
-    sk = ec.derive_private_key(
-        int.from_bytes(private_key, "big"), ec.SECP256K1()
-    )
-    nums = sk.public_key().public_numbers()
-    pub = nums.x.to_bytes(32, "big") + nums.y.to_bytes(32, "big")
+    """0x-address = last 20 bytes of keccak256(uncompressed pubkey x||y).
+
+    Derivation runs on the in-tree secp256k1 (core.ethtx, cross-checked
+    against an independent verifier in tests/test_ethtx.py) — no
+    external crypto dependency on this path."""
+    x, y = pubkey_point(private_key)
+    pub = x.to_bytes(32, "big") + y.to_bytes(32, "big")
     return to_checksum_address("0x" + keccak256(pub)[-20:].hex())
 
 

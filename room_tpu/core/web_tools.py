@@ -75,19 +75,50 @@ JS_RENDERED_NOTICE = (
 )
 
 
+_SCRIPT_SPAN_RE = re.compile(
+    r"<script\b[^>]*>.*?</script>", re.IGNORECASE | re.DOTALL
+)
+_SPA_MOUNT_RE = re.compile(
+    r"<(?:div|main|section)\b[^>]*\bid\s*=\s*[\"']?"
+    r"(?:root|app|__next|__nuxt|main)[\"'\s>]",
+    re.IGNORECASE,
+)
+
+
+def _script_fraction(body: str) -> float:
+    """Fraction of the document's bytes inside <script> spans (inline
+    code + tag overhead; external bundles count their tag only)."""
+    if not body:
+        return 0.0
+    total = sum(
+        len(m.group(0)) for m in _SCRIPT_SPAN_RE.finditer(body)
+    )
+    return total / len(body)
+
+
 def detect_js_rendered(body: str, extracted_text: str) -> bool:
     """Heuristic for SPA shells the stdlib browser cannot read
     (VERDICT r4 #7): a script-heavy document whose static text is
     near-empty, or an explicit noscript plea on a page with little
     other text. The reference solves this with real Chromium
     (src/shared/web-tools.ts:19-116); here the agent at least gets an
-    explicit signal instead of silent emptiness."""
+    explicit signal instead of silent emptiness.
+
+    Sparse-but-complete pages (a minimal landing/redirect page that
+    happens to load three analytics scripts) must NOT be flagged
+    (ADVICE r5): beyond being script-heavy with thin text, the page
+    must also look like an app shell — script bytes dominating the
+    body, or a root SPA mount point (#root/#app/#__next/...)."""
     text_len = len(extracted_text.strip())
     if _NOSCRIPT_PLEA_RE.search(body) and text_len < 400:
         return True
-    return (len(_SCRIPT_TAG_RE.findall(body)) >= 3
-            and text_len < 200
-            and len(body) > 2000)
+    script_heavy = (len(_SCRIPT_TAG_RE.findall(body)) >= 3
+                    and text_len < 200
+                    and len(body) > 2000)
+    if not script_heavy:
+        return False
+    return (_script_fraction(body) >= 0.25
+            or _SPA_MOUNT_RE.search(body) is not None)
 
 
 def web_fetch(url: str) -> str:
@@ -404,9 +435,11 @@ MAX_SESSIONS = 8
 
 _sessions: dict[str, WebSession] = {}
 _sessions_lock = threading.Lock()
+_session_seq = 0
 
 
 def open_web_session() -> WebSession:
+    global _session_seq
     with _sessions_lock:
         now = time.time()
         for sid in [s for s, v in _sessions.items()
@@ -415,7 +448,12 @@ def open_web_session() -> WebSession:
         if len(_sessions) >= MAX_SESSIONS:
             oldest = min(_sessions.values(), key=lambda s: s.last_used)
             del _sessions[oldest.id]
-        sess = WebSession(f"web-{int(now * 1000) % 10**10}")
+        # sequence suffix: millisecond ids alone collide when two
+        # sessions open inside the same ms, silently aliasing them
+        _session_seq += 1
+        sess = WebSession(
+            f"web-{int(now * 1000) % 10**10}-{_session_seq}"
+        )
         _sessions[sess.id] = sess
         return sess
 

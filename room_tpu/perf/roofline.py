@@ -49,6 +49,41 @@ class ChipSpec:
 # 800 tok/s/chip target assumes.
 V5E = ChipSpec("v5e", peak_bf16_tflops=197.0, hbm_gbps=819.0,
                hbm_gib=16.0)
+# other generations the serving engine may land on (published peaks):
+V4 = ChipSpec("v4", peak_bf16_tflops=275.0, hbm_gbps=1228.0,
+              hbm_gib=32.0)
+V5P = ChipSpec("v5p", peak_bf16_tflops=459.0, hbm_gbps=2765.0,
+               hbm_gib=95.0)
+V6E = ChipSpec("v6e", peak_bf16_tflops=918.0, hbm_gbps=1640.0,
+               hbm_gib=32.0)
+
+# substring of jax's device_kind (lowercased) -> spec; order matters
+# ("v5p" must match before the bare "v5")
+_KIND_TABLE = (
+    ("v6e", V6E), ("trillium", V6E),
+    ("v5p", V5P),
+    ("v5e", V5E), ("v5 lite", V5E), ("v5litepod", V5E),
+    ("v4", V4),
+)
+
+
+def detect_chip_spec(default: ChipSpec = V5E) -> ChipSpec:
+    """ChipSpec for the device this process actually runs on, resolved
+    from jax's device_kind (ADVICE r5: the engine's speculation gate
+    must not assume V5E on every platform). CPU runs and unknown TPU
+    generations fall back to ``default`` — V5E, the documented
+    deployment target — which keeps gating behavior identical to the
+    pre-detection code everywhere detection can't improve it."""
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return default
+    for sub, spec in _KIND_TABLE:
+        if sub in kind:
+            return spec
+    return default
 
 
 def decode_flops_per_token(cfg, mean_ctx: float) -> float:

@@ -10,14 +10,25 @@ Grammar:
     ollama:<tag>                localhost Ollama daemon (compat path)
     claude / claude:<model>     installed claude CLI (subscription auth)
     codex / codex:<model>       installed codex CLI (subscription auth)
+
+Resilience: with ``ROOM_TPU_FALLBACK_MODELS`` set (comma-separated
+model strings, e.g. ``claude,openai:gpt-4o-mini``), tpu: providers are
+wrapped in a fail-closed fallback chain — when the in-tree engine is
+unhealthy (crash loop) or errors out of execution, the request routes
+to the first ready fallback provider instead of dying with the engine.
+Fail-closed means: if no fallback is ready either, the original error
+surfaces; nothing silently swallows failures.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from ..db import Database
-from .base import Provider, ProviderError
+from .base import (
+    ExecutionRequest, ExecutionResult, Provider, ProviderError,
+)
 
 PROVIDER_PREFIXES = (
     "tpu", "echo", "openai", "anthropic", "gemini", "ollama",
@@ -53,19 +64,118 @@ def model_name(model: Optional[str]) -> str:
     return model
 
 
+FALLBACK_ENV = "ROOM_TPU_FALLBACK_MODELS"
+
+
+def fallback_models() -> list[str]:
+    return [
+        m.strip()
+        for m in os.environ.get(FALLBACK_ENV, "").split(",")
+        if m.strip()
+    ]
+
+
+class FallbackProvider:
+    """Fail-closed fallback chain around the tpu: provider: when the
+    engine is unhealthy (crash loop / not ready) or raises out of
+    execution, try the configured CLI/HTTP fallbacks in order — first
+    ready one serves the request. If nothing is ready, the PRIMARY
+    error surfaces (never a silent swallow). Result- level failures
+    (model said something wrong, max_turns) do NOT fall back: only
+    infrastructure failures reroute."""
+
+    def __init__(
+        self,
+        primary: Provider,
+        chain: list[str],
+        db: Optional[Database] = None,
+    ) -> None:
+        self.name = f"{primary.name}+fallback"
+        self.primary = primary
+        self.chain = chain
+        self._db = db
+
+    def _primary_healthy(self) -> bool:
+        try:
+            from .tpu import get_model_host
+
+            return get_model_host(
+                getattr(self.primary, "model_name", "")
+            ).is_healthy()
+        except Exception:
+            return False
+
+    def is_ready(self) -> tuple[bool, str]:
+        ready, detail = (False, "unknown")
+        try:
+            ready, detail = self.primary.is_ready()
+        except Exception as e:
+            detail = str(e)
+        if ready:
+            return True, detail
+        for model in self.chain:
+            try:
+                # chain entries resolve UNWRAPPED (wrap_fallback=False)
+                # so a tpu: fallback can never recurse into this chain
+                fb_ready, fb_detail = get_model_provider(
+                    model, self._db, wrap_fallback=False
+                ).is_ready()
+            except Exception:
+                continue
+            if fb_ready:
+                return True, (
+                    f"primary not ready ({detail}); falling back to "
+                    f"{model}: {fb_detail}"
+                )
+        return False, detail
+
+    def execute(self, request: ExecutionRequest) -> ExecutionResult:
+        primary_error: Optional[BaseException] = None
+        if self._primary_healthy():
+            try:
+                return self.primary.execute(request)
+            except ProviderError as e:
+                primary_error = e
+        else:
+            primary_error = ProviderError(
+                "tpu engine unhealthy (crash loop)"
+            )
+        for model in self.chain:
+            try:
+                provider = get_model_provider(
+                    model, self._db, wrap_fallback=False
+                )
+                ready, _ = provider.is_ready()
+                if not ready:
+                    continue
+                try:
+                    from ..core.telemetry import incr_counter
+
+                    incr_counter("provider.fallback")
+                except Exception:
+                    pass
+                return provider.execute(request)
+            except ProviderError:
+                continue
+        raise primary_error  # fail closed: surface the real failure
+
+
 def get_model_provider(
-    model: Optional[str], db: Optional[Database] = None
+    model: Optional[str], db: Optional[Database] = None,
+    wrap_fallback: bool = True,
 ) -> Provider:
     kind = provider_kind(model)
+    fb = fallback_models() if (kind == "tpu" and wrap_fallback) else []
     # HTTP providers resolve credentials through the db, so the binding
     # is part of their identity (a db-less probe must not pin a cached
     # instance that can never see DB-stored keys); tpu/echo are db-free
-    # and stay process-wide.
+    # and stay process-wide. A fallback-wrapped tpu provider carries the
+    # db (its chain may include HTTP providers) and its chain spec.
     db_key = id(db) if (
-        db is not None and kind in ("openai", "anthropic", "gemini",
-                                    "ollama")
+        db is not None and (fb or kind in ("openai", "anthropic",
+                                           "gemini", "ollama"))
     ) else 0
-    key = f"{kind}:{model_name(model)}:{db_key}"
+    key = f"{kind}:{model_name(model)}:{db_key}:{','.join(fb)}"
     if key in _instances:
         return _instances[key]
 
@@ -77,6 +187,8 @@ def get_model_provider(
         from .tpu import TpuProvider
 
         inst = TpuProvider(model_name(model) or DEFAULT_TPU_MODEL)
+        if fb:
+            inst = FallbackProvider(inst, fb, db=db)
     elif kind in ("openai", "gemini", "ollama"):
         from .http_api import OpenAICompatProvider
 

@@ -25,6 +25,8 @@ from typing import Callable, Optional
 
 from ..models import config as model_configs
 from ..models import qwen3
+from ..serving import faults
+from ..serving.faults import FaultError
 from .base import ExecutionRequest, ExecutionResult, ProviderError
 
 MODEL_CONFIGS: dict[str, Callable] = {
@@ -108,9 +110,49 @@ class ModelHost:
             "ROOM_TPU_ALLOW_RANDOM_INIT=1 for synthetic weights"
         )
 
+    def is_healthy(self) -> bool:
+        """False once the engine has exhausted its crash-restart budget
+        (or its thread died without supervision) — the registry's
+        fallback chain keys off this."""
+        with self._lock:
+            eng = self._engine
+            if eng is None:
+                return True   # cold is not unhealthy
+            # getattr: tests stub the engine with minimal doubles
+            if not getattr(eng, "healthy", True):
+                return False
+            if self._thread is not None and \
+                    not self._thread.is_alive() and \
+                    not self._stop.is_set():
+                return False
+        return True
+
+    def _start_engine_thread(self) -> None:
+        self._thread = threading.Thread(
+            target=self._engine.serve_forever,
+            args=(self._stop,),
+            daemon=True,
+            name=f"tpu-engine-{self.name}",
+        )
+        self._thread.start()
+
     def engine(self):
         with self._lock:
             if self._engine is not None:
+                if not getattr(self._engine, "healthy", True):
+                    # fail-closed: a crash-looping engine must not
+                    # accept traffic it will lose
+                    raise ProviderError(
+                        f"tpu engine for {self.name} is unhealthy "
+                        "(crash-restart budget exhausted)"
+                    )
+                if self._thread is not None and \
+                        not self._thread.is_alive() and \
+                        not self._stop.is_set():
+                    # supervised restart: the loop thread died (e.g. a
+                    # crash escaped recovery) but the engine itself is
+                    # serviceable
+                    self._start_engine_thread()
                 return self._engine
             ok, why = self.readiness()
             if not ok:
@@ -190,13 +232,7 @@ class ModelHost:
                     os.environ.get("ROOM_TPU_SPEC_TOKENS", "4")
                 ),
             )
-            self._thread = threading.Thread(
-                target=self._engine.serve_forever,
-                args=(self._stop,),
-                daemon=True,
-                name=f"tpu-engine-{self.name}",
-            )
-            self._thread.start()
+            self._start_engine_thread()
             return self._engine
 
     def shutdown(self) -> None:
@@ -232,16 +268,35 @@ def engines_snapshot() -> dict[str, dict]:
     for name, host in hosts.items():
         engine = host._engine
         if engine is None:
-            out[name] = {"status": "cold"}
+            out[name] = {"status": "cold", "healthy": True}
         else:
+            healthy = host.is_healthy()
             out[name] = {
-                "status": "serving",
+                "status": "serving" if healthy else "unhealthy",
                 **engine.stats(),
                 "free_pages": engine.page_table.free_pages,
                 "sessions": len(engine.sessions),
                 "max_batch": engine.max_batch,
+                "healthy": healthy,
             }
     return out
+
+
+def _encode_retrying(tok, text: str) -> list[int]:
+    """Tokenizer call with a bounded retry on injected transient
+    faults; exhaustion surfaces as ProviderError (a failed result /
+    registry fallback), never a half-submitted turn."""
+    last: Optional[BaseException] = None
+    for attempt in range(3):
+        try:
+            faults.maybe_fail("tokenizer")
+            return tok.encode(text)
+        except FaultError as e:
+            last = e
+            if not e.transient:
+                break
+            time.sleep(0.01 * (attempt + 1))
+    raise ProviderError(f"tokenizer failed: {last}")
 
 
 class TpuProvider:
@@ -250,12 +305,16 @@ class TpuProvider:
         self.model_name = model_name
 
     def is_ready(self) -> tuple[bool, str]:
-        return get_model_host(self.model_name).readiness()
+        host = get_model_host(self.model_name)
+        if not host.is_healthy():
+            return False, (
+                f"tpu engine for {self.model_name} is unhealthy "
+                "(crash loop)"
+            )
+        return host.readiness()
 
     def execute(self, request: ExecutionRequest) -> ExecutionResult:
-        from ..serving import (
-            SamplingParams, extract_tool_call, render_chat,
-        )
+        from ..serving import SamplingParams, render_chat
 
         host = get_model_host(self.model_name)
         engine = host.engine()
@@ -290,10 +349,40 @@ class TpuProvider:
         )
 
         deadline = time.monotonic() + request.timeout_s
+        if faults.should_fire("provider_timeout"):
+            # chaos fault point: force the deadline so the timeout
+            # path (clean failure + session release) is exercised
+            deadline = time.monotonic()
         result = ExecutionResult(session_id=session_id)
         assistant_text = ""
-        prompt_tokens = tok.encode(prompt_text)
+        try:
+            prompt_tokens = _encode_retrying(tok, prompt_text)
+            assistant_text = self._turn_loop(
+                request, engine, tok, session_id, sampling,
+                prompt_tokens, deadline, result,
+            )
+        finally:
+            if ephemeral:
+                # every exit — success, timeout, tokenizer fault,
+                # engine crash — must return the one-shot session's
+                # paged-KV pages to the pool
+                engine.release_session(session_id)
+                result.session_id = None
 
+        # strip chat scaffolding from the visible reply
+        visible = assistant_text.replace("<|im_end|>", "").strip()
+        result.text = visible
+        messages.append({"role": "assistant", "content": visible})
+        result.messages = messages
+        return result
+
+    def _turn_loop(
+        self, request, engine, tok, session_id, sampling,
+        prompt_tokens, deadline, result,
+    ) -> str:
+        from ..serving import extract_tool_call
+
+        assistant_text = ""
         for turn_no in range(max(request.max_turns, 1)):
             t = engine.submit(
                 prompt_tokens, session_id=session_id, sampling=sampling
@@ -321,7 +410,8 @@ class TpuProvider:
                 assistant_text += text
                 if call is None:
                     # corrective nudge instead of failing the turn
-                    prompt_tokens = tok.encode(
+                    prompt_tokens = _encode_retrying(
+                        tok,
                         "\n<tool_response>\nerror: malformed tool call —"
                         " emit exactly one JSON object with \"name\" and"
                         " \"arguments\".\n</tool_response>\n"
@@ -338,7 +428,8 @@ class TpuProvider:
                     }
                 )
                 # resume the parked session with only the tool response
-                prompt_tokens = tok.encode(
+                prompt_tokens = _encode_retrying(
+                    tok,
                     f"\n<tool_response>\n{tool_result}\n"
                     "</tool_response>\n"
                 )
@@ -349,14 +440,4 @@ class TpuProvider:
         else:
             result.success = False
             result.error = f"max_turns {request.max_turns} exceeded"
-
-        # strip chat scaffolding from the visible reply
-        visible = assistant_text.replace("<|im_end|>", "").strip()
-        result.text = visible
-        messages.append({"role": "assistant", "content": visible})
-        result.messages = messages
-        if ephemeral:
-            # one-shot calls must not leak paged-KV pages
-            engine.release_session(session_id)
-            result.session_id = None
-        return result
+        return assistant_text

@@ -21,7 +21,7 @@ from .auth import (
     allowed_origin, get_token_principal, load_or_create_tokens,
     write_runtime_files,
 )
-from .router import RequestContext, Router
+from .router import BadRequest, RequestContext, Router
 from .routes import register_all_routes
 from .webhooks import handle_webhook_request
 from .ws import WebSocketHub
@@ -102,7 +102,8 @@ class ApiServer:
                         break
                     remaining -= len(chunk)
 
-            def _respond(self, status: int, payload: dict) -> None:
+            def _respond(self, status: int, payload: dict,
+                         headers: Optional[dict] = None) -> None:
                 # /v1 (OpenAI-compatible) errors use the OpenAI error
                 # object with a type SDK retry logic understands — this
                 # covers pre-handler rejections (401/403/404/429) and
@@ -111,7 +112,8 @@ class ApiServer:
                     payload.get("error"), str
                 ):
                     etype = (
-                        "server_error" if status >= 500
+                        "overloaded_error" if status == 503
+                        else "server_error" if status >= 500
                         else "rate_limit_error" if status == 429
                         else "authentication_error" if status == 401
                         else "permission_error" if status == 403
@@ -126,6 +128,8 @@ class ApiServer:
                 self._common_headers()
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -211,15 +215,27 @@ class ApiServer:
                     self._handle_inner()
                 except BrokenPipeError:
                     pass
-                except (ValueError, TypeError) as e:
-                    # malformed client scalars (e.g. /api/rooms/NaN
-                    # int-converted in a handler) are the CLIENT's
-                    # fault — 400, not an internal 500
+                except BadRequest as e:
+                    # request-PARAMETER parsing failures only (the
+                    # RequestContext coercion helpers): the client's
+                    # fault, 400. Any other ValueError/TypeError from
+                    # a handler is a server bug and falls through to
+                    # the logged 500 below (ADVICE r5: the old blanket
+                    # 400 hid real handler bugs)
                     try:
                         self._respond(400, {"error": f"bad request: {e}"})
                     except Exception:
                         pass
                 except Exception as e:
+                    import sys
+                    import traceback
+
+                    print(
+                        f"[http] 500 on {self.command} {self.path}: "
+                        f"{type(e).__name__}: {e}\n"
+                        + traceback.format_exc(),
+                        file=sys.stderr,
+                    )
                     try:
                         self._respond(500, {"error": str(e)})
                     except Exception:
@@ -341,6 +357,7 @@ class ApiServer:
                 )
                 out = handler(ctx)
                 status = out.get("status", 200)
+                extra_headers = out.get("headers")
                 if "sse" in out:
                     self._respond_sse(status, out["sse"])
                     return
@@ -352,14 +369,14 @@ class ApiServer:
                         payload = {"error": out["error"]}
                     else:
                         payload = out.get("data", {})
-                    self._respond(status, payload)
+                    self._respond(status, payload, extra_headers)
                     return
                 payload = {"status": status}
                 if "data" in out:
                     payload["data"] = out["data"]
                 if out.get("error"):
                     payload["error"] = out["error"]
-                self._respond(status, payload)
+                self._respond(status, payload, extra_headers)
 
             def _respond_sse(self, status: int, events) -> None:
                 """Server-sent events (OpenAI streaming): no
@@ -401,8 +418,14 @@ class ApiServer:
                     if not os.path.isfile(full):
                         self._respond(404, {"error": "not found"})
                         return
-                ctype = mimetypes.guess_type(full)[0] or \
-                    "application/octet-stream"
+                if full.endswith((".js", ".mjs")):
+                    # pinned: host mimetypes DBs disagree
+                    # (application/javascript vs the WHATWG-standard
+                    # text/javascript) across distros
+                    ctype = "text/javascript"
+                else:
+                    ctype = mimetypes.guess_type(full)[0] or \
+                        "application/octet-stream"
                 with open(full, "rb") as f:
                     body = f.read()
                 self._drain_unread_body()

@@ -7,8 +7,18 @@ import re
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
-# handler(ctx) -> {"status": int, "data": ..., "error": ...}
+# handler(ctx) -> {"status": int, "data": ..., "error": ...,
+#                  "headers": {...}?}
 Handler = Callable[["RequestContext"], dict]
+
+
+class BadRequest(ValueError):
+    """Request-parameter parsing failure — the CLIENT's fault, mapped
+    to HTTP 400 by the server. Raised only by the RequestContext
+    coercion helpers (and handlers validating client input); any other
+    ValueError/TypeError escaping a handler is a server bug and
+    surfaces as a logged 500 (ADVICE r5: the old blanket 400 hid real
+    handler bugs from error visibility)."""
 
 
 @dataclass
@@ -21,6 +31,52 @@ class RequestContext:
     principal: Optional[dict] = None  # {"role": agent|user|member}
     db: Any = None
     runtime: Any = None
+
+    def int_param(self, name: str) -> int:
+        """Path-parameter int coercion; a non-integer segment (e.g.
+        /api/rooms/NaN) is a 400, never a 500."""
+        try:
+            return int(self.params[name])
+        except (KeyError, ValueError, TypeError):
+            raise BadRequest(
+                f"path parameter {name!r} must be an integer, got "
+                f"{self.params.get(name)!r}"
+            ) from None
+
+    def int_query(self, name: str, default: int) -> int:
+        raw = self.query.get(name)
+        if raw is None:
+            return default
+        try:
+            return int(raw)
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"query parameter {name!r} must be an integer, got "
+                f"{raw!r}"
+            ) from None
+
+    def _body_number(self, name: str, default, caster, kind: str):
+        body = self.body if isinstance(self.body, dict) else {}
+        if name not in body:
+            if default is not None:
+                return default
+            raise BadRequest(f"body field {name!r} is required")
+        try:
+            return caster(body[name])
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"body field {name!r} must be {kind}, got "
+                f"{body[name]!r}"
+            ) from None
+
+    def int_body(self, name: str, default: Optional[int] = None) -> int:
+        """Body-field int coercion: malformed client JSON scalars are a
+        400, never a logged 500."""
+        return self._body_number(name, default, int, "an integer")
+
+    def float_body(self, name: str,
+                   default: Optional[float] = None) -> float:
+        return self._body_number(name, default, float, "a number")
 
 
 def ok(data: Any = None, status: int = 200) -> dict:

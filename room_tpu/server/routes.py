@@ -31,7 +31,7 @@ from .router import RequestContext, Router, err, ok
 
 
 def _room_or_404(ctx: RequestContext):
-    room = rooms_mod.get_room(ctx.db, int(ctx.params["id"]))
+    room = rooms_mod.get_room(ctx.db, ctx.int_param("id"))
     if room is None:
         return None, err("room not found", 404)
     return room, None
@@ -92,7 +92,7 @@ def register_openai_routes(r: Router) -> None:
         from ..providers.base import ProviderError
         from ..providers.tpu import MODEL_CONFIGS, get_model_host
         from ..serving import (
-            SamplingParams, extract_tool_call, render_chat,
+            SamplingParams, extract_tool_call, faults, render_chat,
         )
 
         b = ctx.body or {}
@@ -107,7 +107,10 @@ def register_openai_routes(r: Router) -> None:
         try:
             engine = get_model_host(name).engine()
         except ProviderError as e:
-            return err(str(e), 503)
+            # cold-failed OR crash-looped engine: tell SDK retry logic
+            # when to come back instead of letting it hammer
+            return {"status": 503, "error": str(e),
+                    "headers": {"Retry-After": "30"}}
 
         tok = engine.tokenizer
         tools = b.get("tools")
@@ -278,6 +281,11 @@ def register_openai_routes(r: Router) -> None:
                 try:
                     yield chunk({"role": "assistant", "content": ""})
                     while time_mod.monotonic() < deadline:
+                        if faults.should_fire("client_disconnect"):
+                            # chaos fault point: the browser vanished
+                            # mid-stream — the finally below must still
+                            # return the session's pages to the pool
+                            return
                         try:
                             ids.append(q.get(timeout=0.1))
                         except queue_mod.Empty:
@@ -292,7 +300,8 @@ def register_openai_routes(r: Router) -> None:
                         # event, not a normal finish
                         yield {"error": {
                             "message": turn.error or "generation failed",
-                            "type": "server_error",
+                            "type": "overloaded_error" if turn.shed
+                                    else "server_error",
                         }}
                         return
                     ids = list(turn.new_tokens)
@@ -334,6 +343,13 @@ def register_openai_routes(r: Router) -> None:
         raw_text = tok.decode(turn.new_tokens)
         engine.release_session(turn.session_id)
         if turn.finish_reason == "error":
+            if turn.shed:
+                # degradation ladder rung 3: the engine shed this turn
+                # under sustained pressure — 503 + Retry-After, the
+                # backoff contract SDK retry logic understands
+                return {"status": 503,
+                        "error": turn.error or "server overloaded",
+                        "headers": {"Retry-After": "30"}}
             return err(turn.error or "generation failed", 500)
 
         text = visible_text(turn.new_tokens)
@@ -418,7 +434,7 @@ def register_extended_routes(r: Router) -> None:
 
     # -- goals --
     def get_goal_detail(ctx):
-        g = goals_mod.get_goal(ctx.db, int(ctx.params["id"]))
+        g = goals_mod.get_goal(ctx.db, ctx.int_param("id"))
         if g is None:
             return err("goal not found", 404)
         g["updates"] = ctx.db.query(
@@ -433,19 +449,19 @@ def register_extended_routes(r: Router) -> None:
 
     def add_goal_update_route(ctx):
         b = ctx.body or {}
-        if goals_mod.get_goal(ctx.db, int(ctx.params["id"])) is None:
+        if goals_mod.get_goal(ctx.db, ctx.int_param("id")) is None:
             return err("goal not found", 404)
         goals_mod.add_goal_update(
-            ctx.db, int(ctx.params["id"]),
+            ctx.db, ctx.int_param("id"),
             b.get("update") or b.get("content") or "",
             worker_id=b.get("workerId"),
             metric_value=b.get("progress"),
         )
-        return ok(goals_mod.get_goal(ctx.db, int(ctx.params["id"])),
+        return ok(goals_mod.get_goal(ctx.db, ctx.int_param("id")),
                   201)
 
     def patch_goal(ctx):
-        gid = int(ctx.params["id"])
+        gid = ctx.int_param("id")
         g = goals_mod.get_goal(ctx.db, gid)
         if g is None:
             return err("goal not found", 404)
@@ -459,12 +475,12 @@ def register_extended_routes(r: Router) -> None:
             goals_mod.assign_goal(ctx.db, gid, b["workerId"])
         if "progress" in b:
             goals_mod.set_goal_progress(
-                ctx.db, gid, float(b["progress"])
+                ctx.db, gid, ctx.float_body("progress")
             )
         return ok(goals_mod.get_goal(ctx.db, gid))
 
     def delete_goal(ctx):
-        gid = int(ctx.params["id"])
+        gid = ctx.int_param("id")
         if goals_mod.get_goal(ctx.db, gid) is None:
             return err("goal not found", 404)
         ctx.db.execute("DELETE FROM goals WHERE id=?", (gid,))
@@ -477,7 +493,7 @@ def register_extended_routes(r: Router) -> None:
 
     # -- decisions --
     def get_decision_detail(ctx):
-        d = quorum_mod.get_decision(ctx.db, int(ctx.params["id"]))
+        d = quorum_mod.get_decision(ctx.db, ctx.int_param("id"))
         if d is None:
             return err("decision not found", 404)
         d["votes"] = ctx.db.query(
@@ -490,7 +506,7 @@ def register_extended_routes(r: Router) -> None:
     def decision_votes(ctx):
         return ok(ctx.db.query(
             "SELECT * FROM quorum_votes WHERE decision_id=? ORDER BY id",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         ))
 
     def create_decision(ctx):
@@ -512,7 +528,7 @@ def register_extended_routes(r: Router) -> None:
 
     def resolve_decision(ctx):
         """Keeper force-resolve (reference: decisions.ts resolve)."""
-        did = int(ctx.params["id"])
+        did = ctx.int_param("id")
         d = quorum_mod.get_decision(ctx.db, did)
         if d is None:
             return err("decision not found", 404)
@@ -533,13 +549,13 @@ def register_extended_routes(r: Router) -> None:
 
     # -- memory graph --
     def list_entities(ctx):
-        room_id = ctx.query.get("roomId")
-        limit = int(ctx.query.get("limit", "100"))
+        room_id = ctx.int_query("roomId", 0) or None
+        limit = ctx.int_query("limit", 100)
         return ok(ctx.db.query(
             "SELECT * FROM entities "
             + ("WHERE room_id=? " if room_id else "")
             + "ORDER BY id DESC LIMIT ?",
-            ((int(room_id), limit) if room_id else (limit,)),
+            ((room_id, limit) if room_id else (limit,)),
         ))
 
     def memory_stats(ctx):
@@ -555,7 +571,7 @@ def register_extended_routes(r: Router) -> None:
         })
 
     def add_observation_route(ctx):
-        eid = int(ctx.params["id"])
+        eid = ctx.int_param("id")
         if memory_mod.get_entity(ctx.db, eid) is None:
             return err("entity not found", 404)
         content = (ctx.body or {}).get("content")
@@ -570,7 +586,7 @@ def register_extended_routes(r: Router) -> None:
             if not b.get(field):
                 return err(f"{field} is required")
         rid = memory_mod.create_relation(
-            ctx.db, int(b["fromId"]), int(b["toId"]),
+            ctx.db, ctx.int_body("fromId"), ctx.int_body("toId"),
             b["relationType"],
         )
         return ok({"relationId": rid}, 201)
@@ -578,20 +594,20 @@ def register_extended_routes(r: Router) -> None:
     def delete_observation(ctx):
         ctx.db.execute(
             "DELETE FROM observations WHERE id=?",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         )
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     def delete_relation(ctx):
         ctx.db.execute(
             "DELETE FROM relations WHERE id=?",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         )
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     def list_observations(ctx):
         return ok(memory_mod.get_observations(
-            ctx.db, int(ctx.params["id"]),
+            ctx.db, ctx.int_param("id"),
             newest_first=True, limit=100,
         ))
 
@@ -608,16 +624,16 @@ def register_extended_routes(r: Router) -> None:
     def get_message(ctx):
         m = ctx.db.query_one(
             "SELECT * FROM room_messages WHERE id=?",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         )
         return ok(m) if m else err("message not found", 404)
 
     def delete_message(ctx):
         ctx.db.execute(
             "DELETE FROM room_messages WHERE id=?",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         )
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     def read_all_messages(ctx):
         room, e = _room_or_404(ctx)
@@ -641,7 +657,7 @@ def register_extended_routes(r: Router) -> None:
         ))
 
     def stop_worker(ctx):
-        w = workers_mod.get_worker(ctx.db, int(ctx.params["id"]))
+        w = workers_mod.get_worker(ctx.db, ctx.int_param("id"))
         if w is None:
             return err("worker not found", 404)
         from ..core.agent_loop import stop_worker_loop
@@ -654,7 +670,7 @@ def register_extended_routes(r: Router) -> None:
             "SELECT tr.*, t.name AS task_name FROM task_runs tr "
             "LEFT JOIN tasks t ON t.id = tr.task_id "
             "ORDER BY tr.id DESC LIMIT ?",
-            (int(ctx.query.get("limit", "50")),),
+            (ctx.int_query("limit", 50),),
         ))
 
     def room_queen(ctx):
@@ -720,7 +736,7 @@ def register_extended_routes(r: Router) -> None:
         return ok({"key": ctx.params["key"], "value": value})
 
     def patch_task(ctx):
-        tid = int(ctx.params["id"])
+        tid = ctx.int_param("id")
         if task_runner.get_task(ctx.db, tid) is None:
             return err("task not found", 404)
         b = ctx.body or {}
@@ -736,7 +752,7 @@ def register_extended_routes(r: Router) -> None:
         return ok(task_runner.get_task(ctx.db, tid))
 
     def reset_task_session(ctx):
-        tid = int(ctx.params["id"])
+        tid = ctx.int_param("id")
         if task_runner.get_task(ctx.db, tid) is None:
             return err("task not found", 404)
         ctx.db.execute(
@@ -972,7 +988,7 @@ def register_aux_routes(r: Router) -> None:
     def identity(ctx):
         from ..core.identity import get_identity
 
-        ident = get_identity(ctx.db, int(ctx.params["id"]))
+        ident = get_identity(ctx.db, ctx.int_param("id"))
         return ok(ident) if ident else err("room has no wallet", 404)
 
     def identity_register(ctx):
@@ -981,7 +997,7 @@ def register_aux_routes(r: Router) -> None:
 
         try:
             out = register_room_identity(
-                ctx.db, int(ctx.params["id"]),
+                ctx.db, ctx.int_param("id"),
                 dry_run=bool((ctx.body or {}).get("dryRun", True)),
             )
         except WalletError as e:
@@ -991,9 +1007,9 @@ def register_aux_routes(r: Router) -> None:
     def list_watches_route(ctx):
         from ..core.watches import list_watches
 
-        room_id = ctx.query.get("roomId")
+        room_id = ctx.int_query("roomId", 0) or None
         return ok(list_watches(
-            ctx.db, int(room_id) if room_id else None
+            ctx.db, room_id
         ))
 
     def create_watch_route(ctx):
@@ -1015,22 +1031,22 @@ def register_aux_routes(r: Router) -> None:
     def delete_watch_route(ctx):
         from ..core.watches import delete_watch
 
-        if not delete_watch(ctx.db, int(ctx.params["id"])):
+        if not delete_watch(ctx.db, ctx.int_param("id")):
             return err("watch not found", 404)
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     def export_prompts(ctx):
         from ..core.prompt_sync import export_worker_prompts
 
         return ok({"paths": export_worker_prompts(
-            ctx.db, int(ctx.params["id"])
+            ctx.db, ctx.int_param("id")
         )})
 
     def import_prompts(ctx):
         from ..core.prompt_sync import import_worker_prompts
 
         return ok(import_worker_prompts(
-            ctx.db, int(ctx.params["id"]),
+            ctx.db, ctx.int_param("id"),
             force=bool((ctx.body or {}).get("force")),
         ))
 
@@ -1136,6 +1152,37 @@ def register_aux_routes(r: Router) -> None:
 
         return ok(engines_snapshot())
 
+    def tpu_health(ctx):
+        """Degraded-mode health surface (docs/chaos.md): per-engine
+        degradation rung + crash/stall/requeue/shed counters, armed
+        fault points, and process resilience counters — what the TPU
+        panel and external monitors poll."""
+        from ..core.telemetry import counters_snapshot
+        from ..providers.registry import fallback_models
+        from ..providers.tpu import engines_snapshot
+        from ..serving import faults as faults_mod
+
+        engines = engines_snapshot()
+        keys = ("degradation_level", "engine_crashes", "stall_events",
+                "requeues", "shed_turns", "deadline_timeouts",
+                "fault_retries", "healthy")
+        summary = {
+            name: {k: e[k] for k in keys if k in e}
+            for name, e in engines.items()
+        }
+        degraded = any(
+            e.get("degradation_level", 0) > 0 or not e.get("healthy",
+                                                           True)
+            for e in engines.values()
+        )
+        return ok({
+            "degraded": degraded,
+            "engines": summary,
+            "faults": faults_mod.snapshot(),
+            "counters": counters_snapshot(),
+            "fallback_models": fallback_models(),
+        })
+
     def profiling(ctx):
         from ..utils.profiling import http_profiler
 
@@ -1143,6 +1190,7 @@ def register_aux_routes(r: Router) -> None:
 
     r.get("/api/profiling/http", profiling)
     r.get("/api/tpu/engines", engine_stats)
+    r.get("/api/tpu/health", tpu_health)
     r.get("/api/tpu/status", tpu_status)
     r.post("/api/tpu/provision", tpu_provision)
     r.get("/api/tpu/provision/:sid", tpu_session)
@@ -1239,10 +1287,10 @@ def register_room_routes(r: Router) -> None:
         return ok({"paused": room["id"]})
 
     def room_status(ctx):
-        st = rooms_mod.get_room_status(ctx.db, int(ctx.params["id"]))
+        st = rooms_mod.get_room_status(ctx.db, ctx.int_param("id"))
         if st is None:
             return err("room not found", 404)
-        st["launched"] = agent_loop.is_room_launched(int(ctx.params["id"]))
+        st["launched"] = agent_loop.is_room_launched(ctx.int_param("id"))
         return ok(st)
 
     def room_cycles(ctx):
@@ -1256,7 +1304,7 @@ def register_room_routes(r: Router) -> None:
         ))
 
     def cycle_logs(ctx):
-        return ok(get_cycle_logs(ctx.db, int(ctx.params["cycle_id"])))
+        return ok(get_cycle_logs(ctx.db, ctx.int_param("cycle_id")))
 
     def room_activity(ctx):
         room, e = _room_or_404(ctx)
@@ -1340,11 +1388,11 @@ def register_worker_routes(r: Router) -> None:
         return ok(workers_mod.get_worker(ctx.db, wid), 201)
 
     def get_worker(ctx):
-        w = workers_mod.get_worker(ctx.db, int(ctx.params["id"]))
+        w = workers_mod.get_worker(ctx.db, ctx.int_param("id"))
         return ok(w) if w else err("worker not found", 404)
 
     def update_worker(ctx):
-        wid = int(ctx.params["id"])
+        wid = ctx.int_param("id")
         if workers_mod.get_worker(ctx.db, wid) is None:
             return err("worker not found", 404)
         b = ctx.body or {}
@@ -1363,7 +1411,7 @@ def register_worker_routes(r: Router) -> None:
         return ok(workers_mod.get_worker(ctx.db, wid))
 
     def delete_worker(ctx):
-        wid = int(ctx.params["id"])
+        wid = ctx.int_param("id")
         w = workers_mod.get_worker(ctx.db, wid)
         if w is None:
             return err("worker not found", 404)
@@ -1377,7 +1425,7 @@ def register_worker_routes(r: Router) -> None:
 
     def start_worker(ctx):
         """The cross-process nudge target (reference mcp/nudge.ts)."""
-        wid = int(ctx.params["id"])
+        wid = ctx.int_param("id")
         w = workers_mod.get_worker(ctx.db, wid)
         if w is None or w["room_id"] is None:
             return err("worker not found", 404)
@@ -1417,14 +1465,14 @@ def register_goal_routes(r: Router) -> None:
         return ok(goals_mod.get_goal(ctx.db, gid), 201)
 
     def complete(ctx):
-        gid = int(ctx.params["id"])
+        gid = ctx.int_param("id")
         if goals_mod.get_goal(ctx.db, gid) is None:
             return err("goal not found", 404)
         goals_mod.complete_goal(ctx.db, gid)
         return ok(goals_mod.get_goal(ctx.db, gid))
 
     def abandon(ctx):
-        gid = int(ctx.params["id"])
+        gid = ctx.int_param("id")
         if goals_mod.get_goal(ctx.db, gid) is None:
             return err("goal not found", 404)
         goals_mod.abandon_goal(ctx.db, gid)
@@ -1440,9 +1488,9 @@ def register_goal_routes(r: Router) -> None:
 
 def register_task_routes(r: Router) -> None:
     def list_tasks(ctx):
-        room_id = ctx.query.get("roomId")
+        room_id = ctx.int_query("roomId", 0) or None
         return ok(task_runner.list_tasks(
-            ctx.db, int(room_id) if room_id else None
+            ctx.db, room_id
         ))
 
     def create_task(ctx):
@@ -1469,16 +1517,16 @@ def register_task_routes(r: Router) -> None:
         return ok(task_runner.get_task(ctx.db, tid), 201)
 
     def get_task(ctx):
-        t = task_runner.get_task(ctx.db, int(ctx.params["id"]))
+        t = task_runner.get_task(ctx.db, ctx.int_param("id"))
         return ok(t) if t else err("task not found", 404)
 
     def delete_task(ctx):
-        if not task_runner.delete_task(ctx.db, int(ctx.params["id"])):
+        if not task_runner.delete_task(ctx.db, ctx.int_param("id")):
             return err("task not found", 404)
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     def run_now(ctx):
-        tid = int(ctx.params["id"])
+        tid = ctx.int_param("id")
         if task_runner.get_task(ctx.db, tid) is None:
             return err("task not found", 404)
         if ctx.runtime is None:
@@ -1487,31 +1535,31 @@ def register_task_routes(r: Router) -> None:
         return ok({"queued": queued})
 
     def pause(ctx):
-        task_runner.pause_task(ctx.db, int(ctx.params["id"]))
-        return ok(task_runner.get_task(ctx.db, int(ctx.params["id"])))
+        task_runner.pause_task(ctx.db, ctx.int_param("id"))
+        return ok(task_runner.get_task(ctx.db, ctx.int_param("id")))
 
     def resume(ctx):
-        task_runner.resume_task(ctx.db, int(ctx.params["id"]))
-        return ok(task_runner.get_task(ctx.db, int(ctx.params["id"])))
+        task_runner.resume_task(ctx.db, ctx.int_param("id"))
+        return ok(task_runner.get_task(ctx.db, ctx.int_param("id")))
 
     def task_runs(ctx):
         return ok(ctx.db.query(
             "SELECT * FROM task_runs WHERE task_id=? ORDER BY id DESC "
             "LIMIT 50",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         ))
 
     def get_run(ctx):
         run = ctx.db.query_one(
             "SELECT * FROM task_runs WHERE id=?",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         )
         return ok(run) if run else err("run not found", 404)
 
     def run_logs(ctx):
         return ok(ctx.db.query(
             "SELECT * FROM console_logs WHERE run_id=? ORDER BY seq",
-            (int(ctx.params["id"]),),
+            (ctx.int_param("id"),),
         ))
 
     r.get("/api/tasks", list_tasks)
@@ -1531,8 +1579,8 @@ def register_task_routes(r: Router) -> None:
 def register_memory_routes(r: Router) -> None:
     def search(ctx):
         q = ctx.query.get("q", "")
-        room_id = ctx.query.get("roomId")
-        limit = int(ctx.query.get("limit", "10"))
+        room_id = ctx.int_query("roomId", 0) or None
+        limit = ctx.int_query("limit", 10)
         if not q:
             # memory browser: empty query lists the newest entities —
             # in the SAME row shape as hybrid_search (the panel renders
@@ -1543,7 +1591,7 @@ def register_memory_routes(r: Router) -> None:
                 "SELECT e.* FROM entities e "
                 + ("WHERE e.room_id=? " if room_id else "")
                 + "ORDER BY e.id DESC LIMIT ?",
-                ((int(room_id), limit) if room_id else (limit,)),
+                ((room_id, limit) if room_id else (limit,)),
             )
             return ok([{
                 "entity_id": row["id"],
@@ -1564,7 +1612,7 @@ def register_memory_routes(r: Router) -> None:
 
         return ok(memory_mod.hybrid_search(
             ctx.db, q, query_vector=_embed_query(q),
-            room_id=int(room_id) if room_id else None,
+            room_id=room_id,
             limit=limit,
         ))
 
@@ -1580,7 +1628,7 @@ def register_memory_routes(r: Router) -> None:
         return ok({"entityId": eid}, 201)
 
     def get_entity(ctx):
-        ent = memory_mod.get_entity(ctx.db, int(ctx.params["id"]))
+        ent = memory_mod.get_entity(ctx.db, ctx.int_param("id"))
         if ent is None:
             return err("entity not found", 404)
         ent["observations"] = memory_mod.get_observations(
@@ -1590,9 +1638,9 @@ def register_memory_routes(r: Router) -> None:
         return ok(ent)
 
     def delete_entity(ctx):
-        if not memory_mod.delete_entity(ctx.db, int(ctx.params["id"])):
+        if not memory_mod.delete_entity(ctx.db, ctx.int_param("id")):
             return err("entity not found", 404)
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     r.get("/api/memory/search", search)
     r.post("/api/memory", remember)
@@ -1630,7 +1678,7 @@ def register_decision_routes(r: Router) -> None:
                        "/api/decisions/:id/keeper-vote)", 400)
         try:
             d = quorum_mod.vote(
-                ctx.db, int(ctx.params["id"]), int(b["workerId"]),
+                ctx.db, ctx.int_param("id"), ctx.int_body("workerId"),
                 _normalize_vote(b), b.get("reasoning"),
             )
         except quorum_mod.QuorumError as e:
@@ -1643,7 +1691,7 @@ def register_decision_routes(r: Router) -> None:
         # unmapped "reject" would INVERT a keeper veto into approval
         try:
             d = quorum_mod.keeper_vote(
-                ctx.db, int(ctx.params["id"]),
+                ctx.db, ctx.int_param("id"),
                 _normalize_vote(ctx.body)
             )
         except quorum_mod.QuorumError as e:
@@ -1654,8 +1702,8 @@ def register_decision_routes(r: Router) -> None:
         b = ctx.body or {}
         try:
             d = quorum_mod.object_to(
-                ctx.db, int(ctx.params["id"]),
-                int(b.get("workerId", 0)), b.get("reason", ""),
+                ctx.db, ctx.int_param("id"),
+                ctx.int_body("workerId", 0), b.get("reason", ""),
             )
         except quorum_mod.QuorumError as e:
             return err(str(e), 409)
@@ -1671,9 +1719,9 @@ def register_decision_routes(r: Router) -> None:
 
 def register_skill_routes(r: Router) -> None:
     def list_skills(ctx):
-        room_id = ctx.query.get("roomId")
+        room_id = ctx.int_query("roomId", 0) or None
         return ok(skills_mod.list_skills(
-            ctx.db, int(room_id) if room_id else None
+            ctx.db, room_id
         ))
 
     def create(ctx):
@@ -1689,7 +1737,7 @@ def register_skill_routes(r: Router) -> None:
         return ok(skills_mod.get_skill(ctx.db, sid), 201)
 
     def update(ctx):
-        sid = int(ctx.params["id"])
+        sid = ctx.int_param("id")
         if skills_mod.get_skill(ctx.db, sid) is None:
             return err("skill not found", 404)
         content = (ctx.body or {}).get("content")
@@ -1699,26 +1747,26 @@ def register_skill_routes(r: Router) -> None:
         return ok(skills_mod.get_skill(ctx.db, sid))
 
     def delete(ctx):
-        if not skills_mod.delete_skill(ctx.db, int(ctx.params["id"])):
+        if not skills_mod.delete_skill(ctx.db, ctx.int_param("id")):
             return err("skill not found", 404)
-        return ok({"deleted": int(ctx.params["id"])})
+        return ok({"deleted": ctx.int_param("id")})
 
     def audit(ctx):
-        room_id = ctx.query.get("roomId")
+        room_id = ctx.int_query("roomId", 0) or None
         return ok(selfmod_mod.audit_log(
-            ctx.db, int(room_id) if room_id else None
+            ctx.db, room_id
         ))
 
     def revert(ctx):
         try:
             done = selfmod_mod.revert_modification(
-                ctx.db, int(ctx.params["id"])
+                ctx.db, ctx.int_param("id")
             )
         except selfmod_mod.SelfModError as e:
             return err(str(e), 409)
         if not done:
             return err("nothing to revert", 409)
-        return ok({"reverted": int(ctx.params["id"])})
+        return ok({"reverted": ctx.int_param("id")})
 
     r.get("/api/skills", list_skills)
     r.post("/api/skills", create)
@@ -1732,13 +1780,13 @@ def register_skill_routes(r: Router) -> None:
 
 def register_escalation_routes(r: Router) -> None:
     def list_escalations(ctx):
-        room_id = ctx.query.get("roomId")
+        room_id = ctx.int_query("roomId", 0) or None
         return ok(escalations_mod.pending_escalations(
-            ctx.db, int(room_id) if room_id else None
+            ctx.db, room_id
         ))
 
     def answer(ctx):
-        eid = int(ctx.params["id"])
+        eid = ctx.int_param("id")
         esc = escalations_mod.get_escalation(ctx.db, eid)
         if esc is None:
             return err("escalation not found", 404)
@@ -1755,7 +1803,7 @@ def register_escalation_routes(r: Router) -> None:
         return ok(escalations_mod.get_escalation(ctx.db, eid))
 
     def dismiss(ctx):
-        eid = int(ctx.params["id"])
+        eid = ctx.int_param("id")
         if escalations_mod.get_escalation(ctx.db, eid) is None:
             return err("escalation not found", 404)
         escalations_mod.dismiss_escalation(ctx.db, eid)
@@ -1782,23 +1830,23 @@ def register_message_routes(r: Router) -> None:
         if e:
             return e
         b = ctx.body or {}
-        to_room = b.get("toRoomId")
-        if to_room is None or not b.get("body"):
+        if b.get("toRoomId") is None or not b.get("body"):
             return err("toRoomId and body are required")
-        if rooms_mod.get_room(ctx.db, int(to_room)) is None:
+        to_room = ctx.int_body("toRoomId")
+        if rooms_mod.get_room(ctx.db, to_room) is None:
             return err("destination room not found", 404)
         out_id, in_id = messages_mod.send_room_message(
-            ctx.db, room["id"], int(to_room), b.get("subject", ""),
+            ctx.db, room["id"], to_room, b.get("subject", ""),
             b["body"],
         )
         return ok({"outboundId": out_id, "inboundId": in_id}, 201)
 
     def mark_read(ctx):
-        messages_mod.mark_message_read(ctx.db, int(ctx.params["id"]))
-        return ok({"read": int(ctx.params["id"])})
+        messages_mod.mark_message_read(ctx.db, ctx.int_param("id"))
+        return ok({"read": ctx.int_param("id")})
 
     def reply(ctx):
-        mid = int(ctx.params["id"])
+        mid = ctx.int_param("id")
         msg = ctx.db.query_one(
             "SELECT * FROM room_messages WHERE id=?", (mid,)
         )

@@ -1,4 +1,6 @@
+from . import faults
 from .engine import ServingEngine, Turn
+from .faults import FaultError
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
 from .sampler import SamplingParams, sample, sample_batched
 from .tokenizer import (
@@ -12,6 +14,8 @@ from .tokenizer import (
 __all__ = [
     "ServingEngine",
     "Turn",
+    "faults",
+    "FaultError",
     "PageTable",
     "init_page_cache",
     "make_paged_kv_hook",

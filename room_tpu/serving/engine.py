@@ -29,6 +29,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Optional
@@ -39,6 +40,8 @@ import numpy as np
 
 from ..models import qwen3
 from ..models.config import DecoderConfig
+from . import faults
+from .faults import FaultError
 from .kv_pages import (
     PageTable, init_page_cache, kv_quant_mode, make_paged_kv_hook,
     pallas_decode_int8_ok, pallas_prefill_ok, use_pallas_kernel,
@@ -121,6 +124,23 @@ class Turn:
     # optimistic start so new rows probe); feeds the engine's
     # batch-level speculation profitability gate
     spec_accept_ema: float = 1.0
+    # ---- robustness (chaos layer) ----
+    # absolute monotonic deadline; past it the turn fails cleanly with
+    # a timeout error instead of occupying a slot forever
+    deadline: Optional[float] = None
+    # shed ordering under sustained pressure: lowest priority goes
+    # first when the degradation ladder reaches the shedding rung
+    priority: int = 0
+    # stall-watchdog park+requeue budget consumed so far
+    requeues: int = 0
+    # set when the engine disturbed this turn (requeue, prefill retry):
+    # chaos tests exempt disrupted turns from exact-stream assertions
+    disrupted: bool = False
+    # shed by the degradation ladder (maps to HTTP 503 + Retry-After)
+    shed: bool = False
+    # requeued mid-generation: prompt KV is already materialized, only
+    # the pending token re-enters at re-admission
+    _mid_stream: bool = False
 
     def wait(self, timeout: Optional[float] = None) -> "Turn":
         self.done.wait(timeout)
@@ -248,15 +268,80 @@ class ServingEngine:
         self.spec_min_accept = (
             float(env_floor) if env_floor is not None else None
         )
+        # the profitability gate's cost model runs against the chip the
+        # engine actually landed on (ADVICE r5: the hard-coded V5E
+        # mis-calibrated the threshold on other generations; CPU runs
+        # fall back to V5E as the documented deployment target) and the
+        # batch's RUNNING mean context instead of a fixed 1024
+        self._chip_spec = None
+        self._spec_ratio_cache: dict[int, float] = {}
         self._spec_ratio = 1.0
         if self.spec_tokens > 0:
-            from room_tpu.perf.roofline import spec_cost_ratio
+            from room_tpu.perf.roofline import detect_chip_spec
 
-            self._spec_ratio = spec_cost_ratio(
-                cfg, self.max_batch, self.spec_tokens
-            )
+            self._chip_spec = detect_chip_spec()
+            self._spec_ratio = self._spec_ratio_for(1024.0)
         self._spec_resume_at = 0   # tokens_decoded gate re-opens at
         self._spec_probe = False   # one forced round after cooldown
+
+        # ---- robustness knobs (chaos layer; docs/chaos.md) ----
+        # default per-turn deadline in seconds (0 disables); submit()
+        # callers can set a per-request deadline_s on top
+        self.turn_deadline_s = float(
+            os.environ.get("ROOM_TPU_TURN_DEADLINE_S", "0")
+        )
+        # a decode/verify device round slower than this counts as a
+        # stall: its sessions are parked + requeued (KV retained) and
+        # the ladder notes pressure. Generous default — first calls pay
+        # jit compiles, and a false stall only costs a requeue.
+        self.step_stall_s = float(
+            os.environ.get("ROOM_TPU_STEP_STALL_S", "120")
+        )
+        # park+requeue budget per turn before it just rides out slowness
+        self.max_requeues = int(
+            os.environ.get("ROOM_TPU_MAX_REQUEUES", "3")
+        )
+        # transient-fault retry-with-backoff bounds (device-call sites)
+        self.fault_retries = int(
+            os.environ.get("ROOM_TPU_FAULT_RETRIES", "3")
+        )
+        self.retry_backoff_s = float(
+            os.environ.get("ROOM_TPU_RETRY_BACKOFF_S", "0.05")
+        )
+        # degradation ladder: pressure events (stalls, pool exhaustion,
+        # prefill faults, crashes) within the sliding window map to a
+        # level: >=t1 -> 1 (spec decode off), >=t2 -> 2 (admission batch
+        # halved), >=t3 -> 3 (lowest-priority queued turns shed w/ 503)
+        self.degrade_window_s = float(
+            os.environ.get("ROOM_TPU_DEGRADE_WINDOW_S", "30")
+        )
+        thresholds = os.environ.get(
+            "ROOM_TPU_DEGRADE_THRESHOLDS", "2,5,10"
+        )
+        self.degrade_thresholds = tuple(
+            int(x) for x in thresholds.split(",")
+        )
+        if len(self.degrade_thresholds) != 3:
+            # fail at construction, not inside degradation_level()
+            # where the crash supervisor would loop on a config typo
+            raise ValueError(
+                "ROOM_TPU_DEGRADE_THRESHOLDS needs exactly 3 "
+                f"comma-separated ints, got {thresholds!r}"
+            )
+        self._pressure: deque = deque(maxlen=1024)
+        # degradation_level() is read from HTTP threads (stats(),
+        # /api/tpu/health) while the engine thread appends/drains —
+        # its own lock, never nested with self._lock
+        self._pressure_lock = threading.Lock()
+        self._forced_degradation: Optional[int] = None
+        # engine-thread supervision: crashes within the window beyond
+        # this budget mark the engine unhealthy (fail-closed: the
+        # provider registry then falls back)
+        self.max_crash_restarts = int(
+            os.environ.get("ROOM_TPU_ENGINE_MAX_RESTARTS", "3")
+        )
+        self._crash_times: deque = deque(maxlen=64)
+        self.healthy = True
 
         if stop_token_ids is not None:
             self.stop_token_ids = set(stop_token_ids)
@@ -328,6 +413,12 @@ class ServingEngine:
                 self._dp_size = dp
         self.sessions: dict[str, _Session] = {}
         self._queue: queue.Queue[Turn] = queue.Queue()
+        # refcount of queued turns per session (mutated under _lock via
+        # _queue_put/_queue_get*): lets release_session defer for a
+        # session whose turn is still QUEUED in O(1) instead of
+        # scanning the queue — releasing under a queued turn would
+        # free the session only for admission to silently recreate it
+        self._queued_sids: dict[str, int] = {}
         self._active: list[Optional[Turn]] = [None] * max_batch
         self._slot_tables = np.zeros(
             (max_batch, self.max_pages_per_seq), np.int32
@@ -368,6 +459,8 @@ class ServingEngine:
             "prefix_evictions": 0,
             "spec_rounds": 0, "spec_proposed": 0, "spec_accepted": 0,
             "spec_rows_sequential": 0, "spec_throttles": 0,
+            "deadline_timeouts": 0, "stall_events": 0, "requeues": 0,
+            "shed_turns": 0, "fault_retries": 0, "engine_crashes": 0,
         }
         from collections import Counter
 
@@ -425,6 +518,217 @@ class ServingEngine:
                 )
             )
         return self._counts
+
+    # ---- robustness helpers (chaos layer) ----
+
+    def _spec_ratio_for(self, mean_ctx: float) -> float:
+        """Verify/plain cost ratio for the detected chip at the given
+        mean context, cached per power-of-two context bucket so the
+        per-round cost is a dict lookup."""
+        bucket = 256
+        while bucket < mean_ctx:
+            bucket *= 2
+        got = self._spec_ratio_cache.get(bucket)
+        if got is None:
+            from room_tpu.perf.roofline import (
+                detect_chip_spec, spec_cost_ratio,
+            )
+
+            if self._chip_spec is None:
+                self._chip_spec = detect_chip_spec()
+            got = spec_cost_ratio(
+                self.cfg, self.max_batch, self.spec_tokens,
+                chip=self._chip_spec, mean_ctx=float(bucket),
+            )
+            self._spec_ratio_cache[bucket] = got
+        return got
+
+    def _note_pressure(self) -> None:
+        with self._pressure_lock:
+            self._pressure.append(time.monotonic())
+
+    def degradation_level(self) -> int:
+        """Current rung of the degradation ladder, derived from
+        pressure events in the sliding window (stateless, so recovery
+        is automatic once pressure stops): 0 healthy, 1 spec decode
+        off, 2 admission batch halved, 3 shedding."""
+        if self._forced_degradation is not None:
+            return self._forced_degradation
+        cutoff = time.monotonic() - self.degrade_window_s
+        with self._pressure_lock:
+            while self._pressure and self._pressure[0] < cutoff:
+                self._pressure.popleft()
+            n = len(self._pressure)
+        t1, t2, t3 = self.degrade_thresholds
+        if n >= t3:
+            return 3
+        if n >= t2:
+            return 2
+        if n >= t1:
+            return 1
+        return 0
+
+    def set_degradation(self, level: Optional[int]) -> None:
+        """Pin the ladder to a rung (operator override / tests);
+        None returns control to the pressure window."""
+        self._forced_degradation = level
+
+    def _retrying(self, what: str, fn: Callable):
+        """Bounded retry-with-backoff around a device-call site for
+        *transient* injected faults. Real device errors (and
+        non-transient faults) propagate to the crash supervisor. Fault
+        points fire BEFORE the jitted call, so no donated buffer is
+        ever consumed by a failed attempt."""
+        delay = self.retry_backoff_s
+        for attempt in range(self.fault_retries + 1):
+            try:
+                return fn()
+            except FaultError as e:
+                if not e.transient or attempt >= self.fault_retries:
+                    raise
+                self._stats["fault_retries"] += 1
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def _park_and_requeue(self, slot: int, turn: Turn) -> None:
+        """Stall recovery: take the turn out of its slot with KV
+        retained (park) and requeue it to continue later — stuck
+        sessions are never dropped. The last sampled token becomes the
+        session's pending token, exactly like a tool-call park."""
+        sess = self.sessions[turn.session_id]
+        sess.last_used = time.monotonic()
+        if turn.new_tokens:
+            sess.pending = turn.new_tokens[-1]
+        sess.parked = True
+        turn.requeues += 1
+        turn.disrupted = True
+        turn._mid_stream = bool(turn.new_tokens)
+        self._active[slot] = None
+        self._slot_tables[slot] = 0
+        self._slot_lengths[slot] = 0
+        self._stats["requeues"] += 1
+        self._queue_put(turn)
+
+    def _handle_stall(self, active_idx: list[int], elapsed: float) -> None:
+        """Decode-step watchdog: a device round slower than the stall
+        threshold parks + requeues its still-active sessions (bounded
+        per-turn) and notes ladder pressure."""
+        if self.step_stall_s <= 0 or elapsed <= self.step_stall_s:
+            return
+        self._stats["stall_events"] += 1
+        self._note_pressure()
+        for i in active_idx:
+            turn = self._active[i]
+            if turn is not None and turn.requeues < self.max_requeues:
+                self._park_and_requeue(i, turn)
+
+    def _enforce_deadlines(self) -> None:
+        """Fail active turns past their deadline cleanly (the session's
+        KV survives via park semantics; only the request dies)."""
+        now = time.monotonic()
+        for i, turn in enumerate(self._active):
+            if turn is None or turn.deadline is None or \
+                    now < turn.deadline:
+                continue
+            turn.error = "deadline exceeded"
+            self._stats["deadline_timeouts"] += 1
+            self._finish_turn(i, turn, "error")
+
+    def _shed_if_overloaded(self) -> None:
+        """Ladder rung 3: when the queue is deeper than the engine can
+        plausibly serve, shed the lowest-priority queued turns with an
+        explicit overload error (routes map it to 503 + Retry-After)
+        instead of letting every tenant time out."""
+        if self.degradation_level() < 3:
+            return
+        keep_n = self.max_batch * 2
+        if self._queue.qsize() <= keep_n:
+            return
+        drained: list[Turn] = []
+        while True:
+            try:
+                drained.append(self._queue_get_nowait())
+            except queue.Empty:
+                break
+        drained.sort(key=lambda t: -t.priority)
+        for t in drained[:keep_n]:
+            self._queue_put(t)
+        for t in drained[keep_n:]:
+            t.shed = True
+            t.error = ("shedding load: engine degraded under sustained "
+                       "pressure; retry later")
+            t.finish_reason = "error"
+            self._stats["shed_turns"] += 1
+            t.done.set()
+
+    def _fail_turn_unslotted(self, turn: Turn, msg: str) -> None:
+        """Fail a turn that never reached a slot (queued / admitting)."""
+        turn.error = msg
+        turn.finish_reason = "error"
+        turn.done.set()
+
+    def _recover_from_crash(self, exc: BaseException) -> bool:
+        """Engine-thread supervision: a crashed scheduler iteration
+        fails every pending request cleanly, resets host+device state
+        to a provably leak-free baseline (fresh page table + page
+        cache), and lets the loop continue. Returns False — and marks
+        the engine unhealthy, which fail-closes the tpu: provider into
+        registry fallback — once crashes exceed the restart budget
+        within the pressure window."""
+        self._stats["engine_crashes"] += 1
+        self._note_pressure()
+        try:
+            from ..core.telemetry import incr_counter
+
+            incr_counter("engine.crash")
+        except Exception:
+            pass
+        msg = f"engine crashed: {type(exc).__name__}: {exc}"
+        for i, turn in enumerate(self._active):
+            if turn is not None:
+                self._fail_turn_unslotted(turn, msg)
+            self._active[i] = None
+        while True:
+            try:
+                self._fail_turn_unslotted(self._queue_get_nowait(), msg)
+            except queue.Empty:
+                break
+        self._drain_releases()
+        with self._lock:
+            self._admitting.clear()
+            self._deferred_release.clear()
+        self.sessions.clear()
+        self._prefix_cache.clear()
+        self._prefix_lengths.clear()
+        self._slot_tables[:] = 0
+        self._slot_lengths[:] = 0
+        self._reserved_tokens[:] = 0
+        # a crash mid-device-call may have consumed a donated cache
+        # buffer: rebuild the pool (and allocator) from scratch rather
+        # than trust either side of the page accounting
+        self.page_table = PageTable(self.n_pages, self.page_size)
+        self.page_table.ensure_capacity("__null__", self.page_size)
+        self.cache = init_page_cache(
+            self.cfg, self.n_pages, self.page_size, quant=self.kv_quant
+        )
+        if self._cache_specs is not None:
+            from ..parallel.mesh import shard_pytree
+
+            self.cache = shard_pytree(
+                self.cache, self._cache_specs, self.mesh
+            )
+        self._counts = None
+        now = time.monotonic()
+        self._crash_times.append(now)
+        window = max(self.degrade_window_s, 60.0)
+        recent = sum(1 for t in self._crash_times if now - t < window)
+        if recent > self.max_crash_restarts:
+            self.healthy = False
+            return False
+        # backoff before resuming: a hard-failing dependency (device,
+        # params) must not spin the supervisor at 100% CPU
+        time.sleep(min(0.05 * (2 ** min(recent, 6)), 2.0))
+        return True
 
     def _prefill_fn(self, bucket: int, fresh: bool,
                     active_pages: Optional[int] = None):
@@ -559,18 +863,27 @@ class ServingEngine:
         sampling: Optional[SamplingParams] = None,
         on_token: Optional[Callable[[int], None]] = None,
         stop_strings: Optional[list[str]] = None,
+        deadline_s: Optional[float] = None,
+        priority: int = 0,
     ) -> Turn:
         """Queue a turn. If session_id names a parked session, generation
-        resumes on top of its retained KV."""
+        resumes on top of its retained KV. ``deadline_s`` bounds the
+        request end to end (default ROOM_TPU_TURN_DEADLINE_S; 0 = no
+        deadline); ``priority`` orders load shedding under degradation
+        (lowest sheds first)."""
         sid = session_id or f"s{id(object())}-{time.monotonic_ns()}"
+        budget = deadline_s if deadline_s is not None \
+            else self.turn_deadline_s
         turn = Turn(
             session_id=sid,
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
             on_token=on_token,
             stop_strings=[s for s in (stop_strings or []) if s],
+            deadline=(time.monotonic() + budget) if budget > 0 else None,
+            priority=priority,
         )
-        self._queue.put(turn)
+        self._queue_put(turn)
         return turn
 
     def release_session(self, session_id: str) -> None:
@@ -606,14 +919,49 @@ class ServingEngine:
                 return
             self._do_release(sid)
 
+    def _queue_put(self, turn: Turn) -> None:
+        with self._lock:
+            self._queued_sids[turn.session_id] = \
+                self._queued_sids.get(turn.session_id, 0) + 1
+        self._queue.put(turn)
+
+    def _queue_uncount(self, turn: Turn) -> None:
+        with self._lock:
+            n = self._queued_sids.get(turn.session_id, 0) - 1
+            if n > 0:
+                self._queued_sids[turn.session_id] = n
+            else:
+                self._queued_sids.pop(turn.session_id, None)
+
+    def _queue_get(self) -> Turn:
+        turn = self._queue.get()
+        self._queue_uncount(turn)
+        return turn
+
+    def _queue_get_nowait(self) -> Turn:
+        turn = self._queue.get_nowait()
+        self._queue_uncount(turn)
+        return turn
+
+    def _session_in_flight(self, session_id: str) -> bool:
+        """True while any live turn (active in a slot, mid-admission,
+        or still QUEUED) references the session. Queued turns count:
+        releasing under a queued turn would free the session now only
+        for admission to silently recreate it — the chaos suite caught
+        exactly that leak with the provider_timeout fault. Callers
+        hold self._lock."""
+        if any(
+            t is not None and t.session_id == session_id
+            for t in self._active
+        ) or session_id in self._admitting:
+            return True
+        return self._queued_sids.get(session_id, 0) > 0
+
     def _do_release(self, session_id: str) -> None:
         """Apply a release on the engine thread (or synchronously when
         no loop thread owns the engine)."""
         with self._lock:
-            if any(
-                t is not None and t.session_id == session_id
-                for t in self._active
-            ) or session_id in self._admitting:
+            if self._session_in_flight(session_id):
                 self._deferred_release.add(session_id)
                 return
             sess = self.sessions.pop(session_id, None)
@@ -634,14 +982,22 @@ class ServingEngine:
         out["active_slots"] = sum(
             1 for t in self._active if t is not None
         )
+        out["degradation_level"] = self.degradation_level()
+        out["healthy"] = self.healthy
         return out
 
     # ---- engine loop ----
 
     def step(self) -> int:
-        """One scheduler iteration: apply queued releases, admit, one
-        decode step. Returns the number of active slots (0 = idle)."""
+        """One scheduler iteration: apply queued releases, enforce
+        deadlines, shed under overload, admit, one decode step.
+        Returns the number of active slots (0 = idle)."""
+        # chaos fault point: a non-transient scheduler crash — the
+        # serve_forever supervisor must fail pending work and recover
+        faults.maybe_fail("engine_crash")
         self._drain_releases()
+        self._enforce_deadlines()
+        self._shed_if_overloaded()
         self._admit()
         return self._decode_once()
 
@@ -652,12 +1008,21 @@ class ServingEngine:
         raise RuntimeError("run_until_idle exceeded max_steps")
 
     def serve_forever(self, stop_event: threading.Event, idle_sleep=0.002):
+        """Supervised scheduler loop: a crashed iteration fails pending
+        requests cleanly, resets to a leak-free baseline, and restarts
+        — until the restart budget is spent, at which point the engine
+        marks itself unhealthy and exits (the tpu: provider then
+        fail-closes into registry fallback)."""
         with self._lock:
             self._loop_thread = threading.current_thread()
         try:
             while not stop_event.is_set():
-                if self.step() == 0 and self._queue.empty():
-                    time.sleep(idle_sleep)
+                try:
+                    if self.step() == 0 and self._queue.empty():
+                        time.sleep(idle_sleep)
+                except Exception as e:   # noqa: BLE001 — supervisor
+                    if not self._recover_from_crash(e):
+                        return
         finally:
             with self._lock:
                 self._loop_thread = None
@@ -795,12 +1160,19 @@ class ServingEngine:
         multi-tenant rooms submitting simultaneously don't serialize."""
         free = self._free_slots()
         preps: list[dict] = []
+        # ladder rung 2: halve the admission batch so a pressured pool
+        # drains instead of thrashing on eviction
+        cap = len(free) if self.degradation_level() < 2 \
+            else max(1, self.max_batch // 2)
+        attempts = 0
         with self._lock:
             self._admitting.clear()
         try:
             while free and not self._queue.empty() and \
-                    len(preps) < len(free):
-                turn = self._queue.get()
+                    len(preps) < min(len(free), cap) and \
+                    attempts < self.max_batch * 2:
+                attempts += 1
+                turn = self._queue_get()
                 # registered BEFORE pages are reserved so an inline
                 # release from another thread can't free a batchmate's
                 # reservation mid-admission (it defers instead);
@@ -812,16 +1184,30 @@ class ServingEngine:
                 except MemoryError as e:
                     with self._lock:
                         self._admitting.discard(turn.session_id)
+                    self._note_pressure()
                     # pool exhausted: requeue and stop admitting; decode
                     # will drain sessions and free pages
                     if self._free_slots() == \
                             list(range(self.max_batch)) and not preps:
-                        turn.error = str(e)
-                        turn.finish_reason = "error"
-                        turn.done.set()
+                        self._fail_turn_unslotted(turn, str(e))
                     else:
-                        self._queue.put(turn)
+                        turn.disrupted = True
+                        self._queue_put(turn)
                     break
+                except FaultError as e:
+                    # transient prefill fault survived its retry budget:
+                    # requeue (bounded) rather than drop the turn
+                    with self._lock:
+                        self._admitting.discard(turn.session_id)
+                    self._note_pressure()
+                    turn.requeues += 1
+                    turn.disrupted = True
+                    if turn.requeues > self.max_requeues:
+                        self._fail_turn_unslotted(turn, str(e))
+                    else:
+                        self._stats["requeues"] += 1
+                        self._queue_put(turn)
+                    continue
                 if prep is not None:
                     preps.append(prep)
                 else:
@@ -846,24 +1232,78 @@ class ServingEngine:
                 self._admitting.clear()
                 deferred = set(self._deferred_release)
             # releases deferred while a session was mid-admission whose
-            # turn never reached a slot (prep failed / requeued) would
-            # otherwise linger: _finish_turn only sees slotted turns
+            # turn never reached a slot (prep failed / shed / crashed)
+            # would otherwise linger: _finish_turn only sees slotted
+            # turns. A still-queued turn keeps its deferral.
             for sid in deferred:
-                if not any(
-                    t is not None and t.session_id == sid
-                    for t in self._active
-                ):
+                with self._lock:
+                    in_flight = self._session_in_flight(sid)
+                if not in_flight:
                     self._deferred_release.discard(sid)
                     self._do_release(sid)
+
+    def _restore_session_snapshot(self, sess: _Session, snap: dict) -> None:
+        """Roll a session back to its pre-preparation state after a
+        failed admission (pool exhaustion or an injected prefill
+        fault), including dropping a prefix-cache entry or reference
+        the failed preparation created."""
+        if sess.prefix_key is not None and \
+                sess.prefix_key != snap["prefix_key"]:
+            key = sess.prefix_key
+            self._release_session_prefix(sess)
+            entry = self._prefix_cache.get(key)
+            if entry is not None and not entry.ready and \
+                    not entry.sessions:
+                self.page_table.release(entry.owner_id)
+                del self._prefix_cache[key]
+                self._prefix_lengths[entry.length] -= 1
+                if self._prefix_lengths[entry.length] <= 0:
+                    del self._prefix_lengths[entry.length]
+        sess.prefix_key = snap["prefix_key"]
+        sess.prefix_pages = list(snap["prefix_pages"])
+        sess.prefix_len = snap["prefix_len"]
+        sess.pending = snap["pending"]
+        sess.length = snap["length"]
+        sess.history = list(snap["history"])
+        sess.parked = snap["parked"]
 
     def _prepare_turn(self, turn: Turn) -> Optional[dict]:
         """Validate + reserve pages for a queued turn. Returns the
         prefill prep dict, or None when the turn ended during
-        validation. Raises MemoryError when the pool can't hold it."""
+        validation. Raises MemoryError (pool can't hold it) or
+        FaultError (injected prefill fault past its retry budget) with
+        the session rolled back to its pre-preparation state either
+        way, so a requeue re-prepares from scratch losing nothing."""
+        if turn.deadline is not None and \
+                time.monotonic() > turn.deadline:
+            self._stats["deadline_timeouts"] += 1
+            self._fail_turn_unslotted(
+                turn, "deadline exceeded while queued"
+            )
+            return None
         sess = self.sessions.get(turn.session_id)
         if sess is None:
             sess = _Session(id=turn.session_id)
             self.sessions[turn.session_id] = sess
+        snap = {
+            "pending": sess.pending, "length": sess.length,
+            "history": list(sess.history), "parked": sess.parked,
+            "prefix_key": sess.prefix_key,
+            "prefix_pages": list(sess.prefix_pages),
+            "prefix_len": sess.prefix_len,
+        }
+        try:
+            prep = self._prepare_turn_inner(turn, sess)
+        except (MemoryError, FaultError):
+            self._restore_session_snapshot(sess, snap)
+            raise
+        if prep is not None:
+            prep["snap"] = snap
+        return prep
+
+    def _prepare_turn_inner(
+        self, turn: Turn, sess: _Session
+    ) -> Optional[dict]:
         sess.parked = False
         sess.last_used = time.monotonic()
 
@@ -872,6 +1312,11 @@ class ServingEngine:
             turn.done.set()
             return None
         prompt = turn.prompt_tokens
+        if turn._mid_stream:
+            # requeued mid-generation (stall watchdog): the prompt's KV
+            # is already materialized (or lives in the history mirror);
+            # only the pending token re-enters below
+            prompt = []
         if sess.pending is not None:
             # re-materialize the sampled-but-unwritten token from the
             # previous turn so its KV lands before the continuation.
@@ -885,8 +1330,18 @@ class ServingEngine:
             # after pages are reserved (the prefill bookkeeping re-fills
             # it), so a MemoryError requeue loses nothing.
             prompt = sess.history + prompt
+        if not prompt:
+            # mid-stream requeue whose session vanished (released while
+            # queued): nothing to continue from
+            self._fail_turn_unslotted(turn, "session lost while requeued")
+            return None
         total = sess.length + len(prompt)
-        if total + turn.sampling.max_new_tokens > self.max_seq_len:
+        # remaining (not full) generation budget: a requeued mid-stream
+        # turn already spent part of max_new_tokens
+        remaining_budget = max(
+            turn.sampling.max_new_tokens - len(turn.new_tokens), 1
+        )
+        if total + remaining_budget > self.max_seq_len:
             turn.error = (
                 f"sequence would exceed max_seq_len {self.max_seq_len}"
             )
@@ -953,26 +1408,10 @@ class ServingEngine:
             return None
 
         own_target = sess.length + pre_total + bucket - sess.prefix_len
-        try:
-            pages = self._ensure_capacity_evicting(sess.id, own_target)
-        except MemoryError:
-            # roll the prefix state back so the requeued turn
-            # re-prepares from scratch (hit: undo the consumed prefix;
-            # registration: free the cache-owned pages)
-            if sess.prefix_key is not None:
-                key = sess.prefix_key
-                self._release_session_prefix(sess)
-                entry = self._prefix_cache.get(key)
-                if entry is not None and not entry.ready and \
-                        not entry.sessions:
-                    self.page_table.release(entry.owner_id)
-                    del self._prefix_cache[key]
-                    self._prefix_lengths[entry.length] -= 1
-                    if self._prefix_lengths[entry.length] <= 0:
-                        del self._prefix_lengths[entry.length]
-                sess.length = 0
-                sess.history = []
-            raise
+        # MemoryError propagates to _prepare_turn, which rolls the
+        # session (including any prefix hit/registration) back to its
+        # pre-preparation snapshot before requeueing
+        pages = self._ensure_capacity_evicting(sess.id, own_target)
         sess.pending = None
         if restoring and sess.length == 0:
             # a prefix HIT already rebuilt history as prompt[:L] (and
@@ -1030,14 +1469,20 @@ class ServingEngine:
 
             self._jit_cache[key] = write
 
-        with self.timer.phase(f"prefill_write_{width}"):
-            self.cache = self._jit_cache[key](
+        def call():
+            # chaos fault point fires BEFORE the jitted call so no
+            # donated buffer is consumed by a failed attempt
+            faults.maybe_fail("prefill_oom")
+            return self._jit_cache[key](
                 self.params,
                 self.cache,
                 jnp.asarray([toks], jnp.int32),
                 jnp.asarray(table[None, :]),
                 jnp.asarray([sess.length], jnp.int32),
             )
+
+        with self.timer.phase(f"prefill_write_{width}"):
+            self.cache = self._retrying("prefill_write", call)
         self._stats["prefill_tokens"] += width
         sess.length += width
         sess.history.extend(toks)
@@ -1064,15 +1509,19 @@ class ServingEngine:
         prefill = self._prefill_fn(
             bucket, fresh=fresh, active_pages=active_pages,
         )
-        with self.timer.phase(f"prefill_{bucket}x{n}"):
-            # first generated token per row comes from its last real
-            # position (the head runs only there, device-side)
-            last_idx = jnp.asarray(
-                [len(p["prompt"]) - 1 for p in group]
-                + [0] * (n_pad - n),
-                jnp.int32,
-            )
-            last_logits, self.cache = prefill(
+        # first generated token per row comes from its last real
+        # position (the head runs only there, device-side)
+        last_idx = jnp.asarray(
+            [len(p["prompt"]) - 1 for p in group]
+            + [0] * (n_pad - n),
+            jnp.int32,
+        )
+
+        def call():
+            # chaos fault point fires BEFORE the jitted call so no
+            # donated buffer is consumed by a failed attempt
+            faults.maybe_fail("prefill_oom")
+            return prefill(
                 self.params,
                 self.cache,
                 jnp.asarray(toks),
@@ -1080,6 +1529,30 @@ class ServingEngine:
                 jnp.asarray(lengths),
                 last_idx,
             )
+
+        try:
+            with self.timer.phase(f"prefill_{bucket}x{n}"):
+                last_logits, self.cache = \
+                    self._retrying("prefill", call)
+        except FaultError as e:
+            # prefill fault survived its retry budget: roll every
+            # batchmate's session back to its pre-preparation snapshot
+            # and requeue (bounded) — nothing admitted, nothing lost
+            self._note_pressure()
+            for prep in group:
+                self._restore_session_snapshot(
+                    prep["sess"], prep["snap"]
+                )
+                turn = prep["turn"]
+                turn.requeues += 1
+                turn.disrupted = True
+                if turn.requeues > self.max_requeues:
+                    self._fail_turn_unslotted(turn, str(e))
+                else:
+                    self._stats["requeues"] += 1
+                    self._queue_put(turn)
+            return
+        with self.timer.phase(f"prefill_{bucket}x{n}_sample"):
             self._key, sub = jax.random.split(self._key)
             temps = [p["turn"].sampling.temperature for p in group]
             top_ps = [p["turn"].sampling.top_p for p in group]
@@ -1194,9 +1667,12 @@ class ServingEngine:
         # sequential scan (their counts stay exact) while the rest of
         # the batch still rides spec — one tenant's sampling knobs must
         # not cut every batchmate's decode throughput (ADVICE r3)
+        # ladder rung 1: speculation off under pressure — verify rounds
+        # amplify device load exactly when the engine can least afford it
         n_spec = 0
         if self.spec_tokens > 0 and \
-                self._stats["tokens_decoded"] >= self._spec_resume_at:
+                self._stats["tokens_decoded"] >= self._spec_resume_at \
+                and self.degradation_level() < 1:
             spec_rows = [
                 i for i in active_idx
                 if not self._active[i].sampling.penalized
@@ -1276,8 +1752,13 @@ class ServingEngine:
         scan_tables, scan_lengths = \
             self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
-        with self.timer.phase("decode"):
-            next_tokens, counts_out, self.cache = decode(
+
+        def call():
+            # chaos fault points: transient device error (retried with
+            # backoff) and injected stall latency (trips the watchdog)
+            faults.maybe_fail("decode_step")
+            faults.maybe_delay("decode_stall")
+            return decode(
                 self.params,
                 self.cache,
                 counts,
@@ -1290,9 +1771,15 @@ class ServingEngine:
                 self._place_batch(top_ks),
                 *pen_args,
             )
+
+        t0 = time.monotonic()
+        with self.timer.phase("decode"):
+            next_tokens, counts_out, self.cache = \
+                self._retrying("decode", call)
             if penalized:
                 self._counts = counts_out
             next_host = np.asarray(next_tokens)   # [B, chunk]
+        step_elapsed = time.monotonic() - t0
         self._stats["decode_steps"] += 1
 
         for i in active_idx:
@@ -1312,6 +1799,9 @@ class ServingEngine:
                     # tokens (and their KV writes past sess.length) are
                     # discarded
                     break
+        # after the bookkeeping so parked sessions carry every token
+        # the slow step actually produced
+        self._handle_stall(active_idx, step_elapsed)
         return n_spec + len(active_idx)
 
     def _decode_once_spec(self, active_idx: list[int]) -> Optional[int]:
@@ -1380,6 +1870,14 @@ class ServingEngine:
                         ema ** k
                         for k in range(1, len(drafts[i][1]) + 1)
                     )
+                # cost ratio for the detected chip at the batch's
+                # actual mean context (ADVICE r5: a fixed V5E@1024
+                # threshold mis-gates other generations / long context)
+                mean_ctx = max(1.0, float(np.mean([
+                    self.sessions[self._active[i].session_id].length
+                    for i in active_idx
+                ])))
+                self._spec_ratio = self._spec_ratio_for(mean_ctx)
                 profitable = exp_emit >= self._spec_ratio * n_act
             if not profitable:
                 self._stats["spec_throttles"] += 1
@@ -1437,8 +1935,11 @@ class ServingEngine:
         spec_tables, spec_lengths = \
             self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
-        with self.timer.phase("decode_spec"):
-            accept_d, residual_d, plain_d, self.cache = spec(
+
+        def call():
+            faults.maybe_fail("decode_step")
+            faults.maybe_delay("decode_stall")
+            return spec(
                 self.params,
                 self.cache,
                 self._place_batch(tokens),
@@ -1449,9 +1950,15 @@ class ServingEngine:
                 self._place_batch(top_ps),
                 self._place_batch(top_ks),
             )
+
+        t0 = time.monotonic()
+        with self.timer.phase("decode_spec"):
+            accept_d, residual_d, plain_d, self.cache = \
+                self._retrying("decode_spec", call)
             accept = np.asarray(accept_d)     # [B, width-1]
             residual = np.asarray(residual_d)  # [B, width-1]
             plain = np.asarray(plain_d)       # [B, width]
+        step_elapsed = time.monotonic() - t0
         self._stats["decode_steps"] += 1
         self._stats["spec_rounds"] += 1
         self._stats["spec_proposed"] += sum(
@@ -1496,6 +2003,7 @@ class ServingEngine:
                 self._append_token(i, turn, tok)
                 if self._active[i] is not turn:
                     break
+        self._handle_stall(active_idx, step_elapsed)
         return len(active_idx)
 
     def _append_token(self, slot: int, turn: Turn, token: int) -> None:

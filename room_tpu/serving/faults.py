@@ -1,0 +1,245 @@
+"""Injectable fault layer for the serving stack (chaos engineering).
+
+The engine's concurrency invariants were discipline, not proof (VERDICT
+r5 "What's weak" #6): nothing hammered submit/park/resume/release/evict
+under induced failure. This module gives every hot-path failure mode a
+named *fault point* that tests (and staging deployments) can arm:
+
+    kv_alloc           page allocation fails (MemoryError)
+    prefill_oom        prefill device call fails (transient)
+    decode_step        decode device call fails (transient)
+    decode_stall       decode step sleeps `latency` seconds
+    tokenizer          tokenizer encode/decode fails (transient)
+    engine_crash       scheduler iteration raises (non-transient)
+    client_disconnect  SSE stream aborts mid-generation
+    provider_timeout   provider-level turn deadline forced to expire
+
+Arming is per-point with probability / latency / one-shot triggers,
+via code (`inject`) or env (`ROOM_TPU_FAULTS`), e.g.::
+
+    ROOM_TPU_FAULTS="kv_alloc:p=0.1;decode_stall:latency=0.5,times=3"
+
+The disarmed path costs one module-global bool check — production
+traffic with no faults configured pays nothing measurable. All state is
+process-global (the engine, providers, and HTTP layer must see one
+registry) and thread-safe: tests arm from the driving thread while the
+engine thread rolls the dice.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+__all__ = [
+    "FaultError", "FaultSpec", "FAULT_POINTS", "inject", "clear",
+    "configure_from_env", "is_active", "should_fire", "maybe_fail",
+    "maybe_delay", "fired", "snapshot",
+]
+
+FAULT_POINTS = (
+    "kv_alloc", "prefill_oom", "decode_step", "decode_stall",
+    "tokenizer", "engine_crash", "client_disconnect",
+    "provider_timeout",
+)
+
+
+class FaultError(RuntimeError):
+    """An injected fault. ``transient`` marks faults the caller should
+    retry with backoff (allocation races, flaky device calls); a
+    non-transient fault models a real crash and must propagate to the
+    supervisor."""
+
+    def __init__(self, message: str, transient: bool = True) -> None:
+        super().__init__(message)
+        self.transient = transient
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault point."""
+
+    name: str
+    probability: float = 1.0      # chance each check fires
+    latency_s: float = 0.0        # sleep instead of / before raising
+    times: Optional[int] = None   # remaining firings (None = unlimited)
+    transient: bool = True        # retryable by the caller
+    fired: int = 0
+    _rng: random.Random = field(
+        default_factory=lambda: random.Random(0xC4A05), repr=False
+    )
+
+
+_lock = threading.Lock()
+_active: dict[str, FaultSpec] = {}
+# fast-path flag: checked without the lock on every fault point
+_armed = False
+
+
+def _telemetry_count(name: str) -> None:
+    # lazy import breaks any serving<->core import cycle; telemetry is
+    # strictly best-effort from a fault point
+    try:
+        from ..core.telemetry import incr_counter
+
+        incr_counter(f"fault.{name}")
+    except Exception:
+        pass
+
+
+def inject(
+    name: str,
+    *,
+    probability: float = 1.0,
+    latency_s: float = 0.0,
+    times: Optional[int] = None,
+    transient: bool = True,
+    seed: Optional[int] = None,
+) -> FaultSpec:
+    """Arm a fault point. ``times=1`` is a one-shot trigger."""
+    global _armed
+    if name not in FAULT_POINTS:
+        raise ValueError(
+            f"unknown fault point {name!r}; known: {FAULT_POINTS}"
+        )
+    spec = FaultSpec(
+        name=name, probability=probability, latency_s=latency_s,
+        times=times, transient=transient,
+    )
+    if seed is not None:
+        spec._rng = random.Random(seed)
+    with _lock:
+        _active[name] = spec
+        _armed = True
+    return spec
+
+
+def clear(name: Optional[str] = None) -> None:
+    """Disarm one fault point, or all of them (name=None)."""
+    global _armed
+    with _lock:
+        if name is None:
+            _active.clear()
+        else:
+            _active.pop(name, None)
+        _armed = bool(_active)
+
+
+def configure_from_env(env: Optional[str] = None) -> None:
+    """Parse ``ROOM_TPU_FAULTS`` — ``;``-separated points, each
+    ``name[:k=v,k=v...]`` with keys p/probability, latency, times,
+    permanent (non-transient). Unknown names raise so a typo in a
+    chaos-staging deployment is loud, not silently inert."""
+    spec_str = env if env is not None else \
+        os.environ.get("ROOM_TPU_FAULTS", "")
+    for part in filter(None, (s.strip() for s in spec_str.split(";"))):
+        name, _, args = part.partition(":")
+        kw: dict = {}
+        for pair in filter(None, (a.strip() for a in args.split(","))):
+            k, _, v = pair.partition("=")
+            if k in ("p", "probability"):
+                kw["probability"] = float(v)
+            elif k == "latency":
+                kw["latency_s"] = float(v)
+            elif k == "times":
+                kw["times"] = int(v)
+            elif k == "once":
+                kw["times"] = 1
+            elif k == "permanent":
+                kw["transient"] = False
+            elif k == "seed":
+                kw["seed"] = int(v)
+            else:
+                raise ValueError(f"unknown fault arg {k!r} in {part!r}")
+        inject(name.strip(), **kw)
+
+
+def is_active(name: str) -> bool:
+    if not _armed:
+        return False
+    with _lock:
+        return name in _active
+
+
+def should_fire(name: str) -> Optional[FaultSpec]:
+    """Roll the dice for a fault point; consumes a one-shot budget and
+    counts the firing. Returns the spec when the fault fires."""
+    if not _armed:
+        return None
+    with _lock:
+        spec = _active.get(name)
+        if spec is None:
+            return None
+        if spec.times is not None and spec.times <= 0:
+            return None
+        if spec.probability < 1.0 and \
+                spec._rng.random() >= spec.probability:
+            return None
+        if spec.times is not None:
+            spec.times -= 1
+        spec.fired += 1
+    _telemetry_count(name)
+    return spec
+
+
+def maybe_fail(
+    name: str,
+    exc_factory: Optional[Callable[[str], BaseException]] = None,
+) -> None:
+    """Fault point: raise when the named fault fires. The default
+    exception is FaultError carrying the spec's transience; pass
+    ``exc_factory`` to raise the error class the surrounding recovery
+    path actually handles (e.g. MemoryError for allocation)."""
+    spec = should_fire(name)
+    if spec is None:
+        return
+    if spec.latency_s > 0:
+        import time
+
+        time.sleep(spec.latency_s)
+    msg = f"injected fault: {name}"
+    if exc_factory is not None:
+        raise exc_factory(msg)
+    raise FaultError(msg, transient=spec.transient)
+
+
+def maybe_delay(name: str) -> float:
+    """Fault point: sleep the spec's latency when the fault fires (for
+    stall injection). Returns the seconds slept."""
+    spec = should_fire(name)
+    if spec is None or spec.latency_s <= 0:
+        return 0.0
+    import time
+
+    time.sleep(spec.latency_s)
+    return spec.latency_s
+
+
+def fired(name: str) -> int:
+    with _lock:
+        spec = _active.get(name)
+        return spec.fired if spec else 0
+
+
+def snapshot() -> dict[str, dict]:
+    """Armed fault points and their firing counts (for /api/tpu/health
+    and the TPU panel)."""
+    with _lock:
+        return {
+            n: {
+                "probability": s.probability,
+                "latency_s": s.latency_s,
+                "times_remaining": s.times,
+                "transient": s.transient,
+                "fired": s.fired,
+            }
+            for n, s in _active.items()
+        }
+
+
+# a chaos-staging deployment arms faults for the whole process lifetime
+if os.environ.get("ROOM_TPU_FAULTS"):
+    configure_from_env()

@@ -515,6 +515,16 @@ class PageTable:
         """Grow the session's page list to hold n_tokens total. Raises
         MemoryError when the pool is exhausted (caller pre-empts or
         queues)."""
+        # chaos fault point: an injected allocation failure takes the
+        # same MemoryError recovery path (evict -> degrade -> requeue)
+        # a genuinely exhausted pool does. The __null__ scratch page is
+        # exempt — it's allocated by engine bootstrap and crash
+        # RECOVERY, where a fired fault would kill the supervisor
+        # itself instead of exercising a traffic path.
+        if session_id != "__null__":
+            from .faults import maybe_fail
+
+            maybe_fail("kv_alloc", MemoryError)
         with self._lock:
             pages = self._sessions.setdefault(session_id, [])
             need = -(-n_tokens // self.page_size) - len(pages)

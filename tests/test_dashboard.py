@@ -410,6 +410,27 @@ def test_dom_ids_referenced_exist_in_templates():
         )
 
 
+def test_notifications_subscribe_all_rooms_on_ws_open():
+    """Desktop notifications (ADVICE r5): the client must subscribe to
+    every room channel on boot and on every WS (re)open, independent of
+    which panel renders — a keeper parked on another view still gets
+    escalation/decision alerts. Pinned at the source level: onopen
+    re-subscribes the wildcard AND fetches /api/rooms to subscribe each
+    room:{id} channel explicitly."""
+    js = open(os.path.join(UI_DIR, "app.js")).read()
+    onopen = js.split("ws.onopen", 1)[1].split("};", 1)[0]
+    assert "subscribed.clear()" in onopen
+    assert "subscribe" in onopen and '"*"' in onopen
+    assert "subscribeRoomChannels()" in onopen
+    fn = js.split("async function subscribeRoomChannels", 1)[1] \
+        .split("\n}", 1)[0]
+    assert '"/api/rooms"' in fn
+    assert "subscribe(`room:${r.id}`)" in fn
+    # the notify handler stays registered at module level, not inside
+    # any panel render
+    assert "wsHandlers.notify" in js
+
+
 def test_pwa_assets_serve(server):
     """manifest + service worker + icon serve with usable types, and
     the bundle registers the worker (reference: the SPA's PWA layer)."""

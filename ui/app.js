@@ -130,6 +130,19 @@ function unsubscribe(channel) {
   }
 }
 
+async function subscribeRoomChannels() {
+  // desktop notifications (escalation:created / decision:announced)
+  // ride room:{id} channels: subscribe them ALL on boot and after
+  // every reconnect, independent of which panel happens to render —
+  // a keeper parked on another view must still get alerts. Belt and
+  // braces with the "*" wildcard: explicit room subscriptions keep
+  // notifications alive even if wildcard fan-out ever changes.
+  try {
+    const out = await api("GET", "/api/rooms");
+    for (const r of out.data || []) subscribe(`room:${r.id}`);
+  } catch {}
+}
+
 function connectWs() {
   ws = new WebSocket(
     `${location.protocol === "https:" ? "wss" : "ws"}://${location.host}` +
@@ -137,6 +150,7 @@ function connectWs() {
   ws.onopen = () => {
     subscribed.clear();
     ["*"].forEach(subscribe);
+    subscribeRoomChannels();
   };
   ws.onmessage = (e) => {
     let msg;

@@ -1405,12 +1405,23 @@ wsHandlers.tpu = (msg) => {
 };
 
 async function renderTpu(el) {
-  const [status, engines, models] = await Promise.all([
+  const [status, engines, models, health] = await Promise.all([
     api("GET", "/api/tpu/status"),
     api("GET", "/api/tpu/engines"),
     api("GET", "/api/models/status"),
+    api("GET", "/api/tpu/health"),
   ]);
   const st = status.data || {};
+  const hl = health.data || {};
+  const DEGRADE_LABELS = ["healthy", "spec off", "batch shrunk",
+                          "shedding"];
+  const healthPill = (e) => {
+    if (e.healthy === false)
+      return '<span class="pill failed">crash loop</span>';
+    const lvl = e.degradation_level || 0;
+    return `<span class="pill ${lvl ? "pending" : "verified"}">` +
+      `${esc(DEGRADE_LABELS[lvl] || lvl)}</span>`;
+  };
   el.innerHTML = `
     <div class="panel"><h2>accelerator</h2>
       <div class="kv">
@@ -1423,20 +1434,44 @@ async function renderTpu(el) {
                <span class="dim">${esc(st.reason || "")}</span>`}</span>
       </div></div>
     <div class="panel"><h2>serving engines</h2>
-      <table><tr><th>model</th><th>status</th><th>decoded</th>
-        <th>prefill</th><th>sessions</th><th>free pages</th>
-        <th>evictions</th></tr>
+      <table><tr><th>model</th><th>status</th><th>health</th>
+        <th>decoded</th><th>prefill</th><th>sessions</th>
+        <th>free pages</th><th>evictions</th></tr>
       ${Object.entries(engines.data || {}).map(([name, e]) => `
         <tr><td>${esc(name)}</td>
         <td><span class="pill ${esc(e.status)}">${esc(e.status)}</span>
         </td>
+        <td>${healthPill(e)}</td>
         <td>${e.tokens_decoded ?? ""}</td>
         <td>${e.prefill_tokens ?? ""}</td>
         <td>${e.sessions ?? ""}</td>
         <td>${e.free_pages ?? ""}</td>
         <td>${e.evictions ?? ""}</td></tr>`).join("") ||
-        '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
+        '<tr><td class="dim" colspan="8">no engines warm</td></tr>'}
       </table></div>
+    <div class="panel"><h2>resilience</h2>
+      <table><tr><th>engine</th><th>crashes</th><th>stalls</th>
+        <th>requeues</th><th>shed</th><th>timeouts</th>
+        <th>retries</th></tr>
+      ${Object.entries(hl.engines || {}).map(([name, e]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${e.engine_crashes ?? 0}</td>
+        <td>${e.stall_events ?? 0}</td>
+        <td>${e.requeues ?? 0}</td>
+        <td>${e.shed_turns ?? 0}</td>
+        <td>${e.deadline_timeouts ?? 0}</td>
+        <td>${e.fault_retries ?? 0}</td></tr>`).join("") ||
+        '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
+      </table>
+      ${Object.keys(hl.faults || {}).length
+        ? `<div class="dim" style="margin-top:.4rem">armed faults: ${
+            Object.entries(hl.faults).map(([n, f]) =>
+              `${esc(n)} (fired ${f.fired})`).join(", ")}</div>`
+        : ""}
+      ${(hl.fallback_models || []).length
+        ? `<div class="dim">fallback chain: ${
+            esc((hl.fallback_models || []).join(" → "))}</div>`
+        : ""}</div>
     <div class="panel"><h2>model status</h2>
       <table>${Object.entries(models.data || {}).map(([name, m]) => `
         <tr><td>${esc(name)}</td>
