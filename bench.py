@@ -41,6 +41,19 @@ _result_printed = threading.Event()
 _emit_lock = threading.Lock()
 _bench_done = threading.Event()
 _deadline = [0.0]  # extended before every long-running phase
+_T0 = time.monotonic()
+# phase breadcrumbs (weights loaded / compile done / first token):
+# stamped as the run progresses AND attached to any 0.0 result line, so
+# a watchdog-fired round is diagnosable (which stage never finished)
+# instead of a silent zero (VERDICT r5: five consecutive 0.0 rounds).
+_breadcrumbs: dict[str, float] = {}
+
+
+def _crumb(name: str) -> None:
+    if name in _breadcrumbs:
+        return
+    _breadcrumbs[name] = round(time.monotonic() - _T0, 2)
+    _phase("breadcrumb", {"name": name, "t_s": _breadcrumbs[name]})
 
 
 def _extend_deadline() -> None:
@@ -146,7 +159,8 @@ def _watchdog() -> None:
         _emit(0.0, "tok/s",
               f"watchdog: no result after {WATCHDOG_S:.0f}s "
               "(TPU unreachable or compile exceeded the window; "
-              "raise ROOM_TPU_BENCH_WATCHDOG_S)")
+              "raise ROOM_TPU_BENCH_WATCHDOG_S)",
+              extra={"breadcrumbs": dict(_breadcrumbs)})
         os._exit(1)
     # headline already on stdout: a hung later phase must not turn a
     # green decode measurement into a dead process
@@ -185,6 +199,8 @@ def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
 
     import jax
+
+    _crumb("jax_imported")
 
     # persistent compile cache: a warm run earlier in the round turns
     # the driver's end-of-round bench into cache hits
@@ -241,6 +257,7 @@ def main() -> None:
 
         validate_quant_mode(quant)
         params = quantize_decoder_params(params, cfg)
+    _crumb("weights_loaded")
     if cfg.moe_impl == "shardmap":
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -276,13 +293,19 @@ def main() -> None:
             cfg, params, max_batch=max_batch, page_size=32,
             n_pages=1024,
         )
+        _crumb("engine_built")
         sp = SamplingParams(
             temperature=temp, top_p=top_p,
             max_new_tokens=16 if TINY else 64,
         )
-        warm = [eng.submit(prompt, sampling=sp)
-                for _ in range(max_batch)]
+        warm = [eng.submit(
+            prompt, sampling=sp,
+            # the first sampled token proves prefill compiled AND ran
+            on_token=(lambda tok: _crumb("first_token")) if i == 0
+            else None,
+        ) for i in range(max_batch)]
         eng.run_until_idle()
+        _crumb("compile_done")
         for t in warm:
             eng.release_session(t.session_id)
         start = eng.stats()
@@ -532,6 +555,53 @@ def main() -> None:
             except Exception as e:
                 _phase("queen_turn_latency", {f"clients{n}": f"error: {e}"})
 
+    # tiered KV offload churn (docs/kv_offload.md): park a batch of
+    # sessions, hibernate them all, resume them all — reports bytes
+    # moved each way, restore latency, and the prefetch hit count, so
+    # a round can see what a parked room costs to swap out and back
+    def measure_offload() -> dict:
+        n_sess = 4 if TINY else 8
+        eng = ServingEngine(
+            cfg, params, max_batch=4, page_size=32, n_pages=1024,
+            offload=True,
+        )
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=8 if TINY else 32,
+        )
+        for i in range(n_sess):
+            eng.submit(prompt, session_id=f"off{i}", sampling=sp)
+        eng.run_until_idle()
+        t0 = time.perf_counter()
+        n_off = sum(
+            1 for i in range(n_sess)
+            if eng.offload_session(f"off{i}")
+        )
+        offload_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(n_sess):
+            eng.submit([2, 3, 4], session_id=f"off{i}", sampling=sp)
+        eng.run_until_idle()
+        resume_s = time.perf_counter() - t0
+        st = eng.stats()
+        ost = st["offload"]
+        return {
+            "sessions": n_sess, "offloaded": n_off,
+            "offload_s": round(offload_s, 3),
+            "resume_s": round(resume_s, 3),
+            "bytes_out": ost["bytes_out"],
+            "bytes_in": ost["bytes_in"],
+            "restores": st["offload_restores"],
+            "prefetches": st["offload_prefetches"],
+            "restore_ms_hist": ost["restore_ms_hist"],
+        }
+
+    if os.environ.get("ROOM_TPU_BENCH_OFFLOAD", "1") != "0":
+        _extend_deadline()
+        try:
+            _phase("kv_offload", measure_offload())
+        except Exception as e:
+            _phase("kv_offload", {"error": str(e)[:300]})
+
     # decode-attention backend comparison (Pallas paged kernel vs the
     # XLA gather reference) — only meaningful on real TPU hardware
     if platform == "tpu":
@@ -585,5 +655,6 @@ if __name__ == "__main__":
             _phase("error_after_headline",
                    {"error": f"{type(e).__name__}: {e}"[:300]})
             sys.exit(0)
-        _emit(0.0, "tok/s", f"error: {type(e).__name__}: {e}")
+        _emit(0.0, "tok/s", f"error: {type(e).__name__}: {e}",
+              extra={"breadcrumbs": dict(_breadcrumbs)})
         sys.exit(1)

@@ -33,6 +33,19 @@ def incr_counter(name: str, n: int = 1) -> None:
         _counters[name] += n
 
 
+def observe_ms(name: str, ms: float,
+               buckets: tuple = (1, 5, 20, 100, 500)) -> None:
+    """Cheap latency histogram over the shared counter map: one
+    ``<name>.le_<edge>ms`` bucket counter per observation (or
+    ``.gt_<last>ms`` past the final edge). Heartbeats and
+    /api/tpu/health pick the buckets up with every other counter."""
+    for edge in buckets:
+        if ms <= edge:
+            incr_counter(f"{name}.le_{edge:g}ms")
+            return
+    incr_counter(f"{name}.gt_{buckets[-1]:g}ms")
+
+
 def counters_snapshot() -> dict[str, int]:
     with _counters_lock:
         return dict(_counters)

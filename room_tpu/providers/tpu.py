@@ -27,6 +27,7 @@ from ..models import config as model_configs
 from ..models import qwen3
 from ..serving import faults
 from ..serving.faults import FaultError
+from ..serving.kv_offload import offload_enabled_from_env
 from .base import ExecutionRequest, ExecutionResult, ProviderError
 
 MODEL_CONFIGS: dict[str, Callable] = {
@@ -231,6 +232,13 @@ class ModelHost:
                 spec_tokens=int(
                     os.environ.get("ROOM_TPU_SPEC_TOKENS", "4")
                 ),
+                # tiered KV offload ON by default in deployment
+                # (docs/kv_offload.md): the room workload parks every
+                # worker mid-turn for tool calls, and hibernating
+                # parked KV to host RAM/disk is what lets room size
+                # scale past HBM capacity. The library default stays
+                # off; ROOM_TPU_OFFLOAD=0 opts a deployment out.
+                offload=offload_enabled_from_env("1"),
             )
             self._start_engine_thread()
             return self._engine

@@ -1165,11 +1165,19 @@ def register_aux_routes(r: Router) -> None:
         engines = engines_snapshot()
         keys = ("degradation_level", "engine_crashes", "stall_events",
                 "requeues", "shed_turns", "deadline_timeouts",
-                "fault_retries", "healthy")
+                "fault_retries", "healthy",
+                # tiered KV offload churn (docs/kv_offload.md)
+                "offloads", "offload_restores", "offload_prefetches",
+                "offload_resident_fallbacks", "offload_reprefills")
         summary = {
             name: {k: e[k] for k in keys if k in e}
             for name, e in engines.items()
         }
+        # tier occupancy + restore-latency histogram ride along whole:
+        # the TPU panel's offload row renders them directly
+        for name, e in engines.items():
+            if e.get("offload") is not None:
+                summary[name]["offload"] = e["offload"]
         degraded = any(
             e.get("degradation_level", 0) > 0 or not e.get("healthy",
                                                            True)

@@ -1,6 +1,7 @@
 from . import faults
 from .engine import ServingEngine, Turn
 from .faults import FaultError
+from .kv_offload import TieredKVStore
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
 from .sampler import SamplingParams, sample, sample_batched
 from .tokenizer import (
@@ -17,6 +18,7 @@ __all__ = [
     "faults",
     "FaultError",
     "PageTable",
+    "TieredKVStore",
     "init_page_cache",
     "make_paged_kv_hook",
     "SamplingParams",

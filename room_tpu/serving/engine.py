@@ -17,7 +17,9 @@ Shape of the loop (SURVEY.md §7 stage 5):
   (reference: src/shared/agent-executor.ts:404-471)
 - sessions map 1:1 onto the engine's page table; parked sessions keep
   their KV (the serving-side twin of the reference's agent_sessions
-  continuity rules)
+  continuity rules) — resident in HBM, or hibernated to host RAM/disk
+  by the tiered offload layer (kv_offload.py) and restored, byte-exact,
+  before their next prefill
 
 Everything device-side is static-shaped: fixed decode slots, fixed page
 pool, bucketed prefill lengths.
@@ -42,6 +44,7 @@ from ..models import qwen3
 from ..models.config import DecoderConfig
 from . import faults
 from .faults import FaultError
+from .kv_offload import TieredKVStore, offload_enabled_from_env
 from .kv_pages import (
     PageTable, init_page_cache, kv_quant_mode, make_paged_kv_hook,
     pallas_decode_int8_ok, pallas_prefill_ok, use_pallas_kernel,
@@ -202,6 +205,7 @@ class ServingEngine:
         rng_seed: int = 0,
         mesh: Optional[Any] = None,
         spec_tokens: Optional[int] = None,
+        offload: Optional[bool] = None,
     ) -> None:
         self.cfg = cfg
         self.params = params
@@ -310,22 +314,23 @@ class ServingEngine:
         )
         # degradation ladder: pressure events (stalls, pool exhaustion,
         # prefill faults, crashes) within the sliding window map to a
-        # level: >=t1 -> 1 (spec decode off), >=t2 -> 2 (admission batch
-        # halved), >=t3 -> 3 (lowest-priority queued turns shed w/ 503)
+        # level: >=t1 -> 1 (spec decode off), >=t2 -> 2 (cold sessions
+        # offloaded to host/disk), >=t3 -> 3 (admission batch halved),
+        # >=t4 -> 4 (lowest-priority queued turns shed w/ 503)
         self.degrade_window_s = float(
             os.environ.get("ROOM_TPU_DEGRADE_WINDOW_S", "30")
         )
         thresholds = os.environ.get(
-            "ROOM_TPU_DEGRADE_THRESHOLDS", "2,5,10"
+            "ROOM_TPU_DEGRADE_THRESHOLDS", "2,4,6,12"
         )
         self.degrade_thresholds = tuple(
             int(x) for x in thresholds.split(",")
         )
-        if len(self.degrade_thresholds) != 3:
+        if len(self.degrade_thresholds) != 4:
             # fail at construction, not inside degradation_level()
             # where the crash supervisor would loop on a config typo
             raise ValueError(
-                "ROOM_TPU_DEGRADE_THRESHOLDS needs exactly 3 "
+                "ROOM_TPU_DEGRADE_THRESHOLDS needs exactly 4 "
                 f"comma-separated ints, got {thresholds!r}"
             )
         self._pressure: deque = deque(maxlen=1024)
@@ -342,6 +347,29 @@ class ServingEngine:
         )
         self._crash_times: deque = deque(maxlen=64)
         self.healthy = True
+
+        # ---- tiered KV offload (docs/kv_offload.md) ----
+        # hibernate cold sessions' non-prefix pages to host RAM / disk:
+        # parked tool-call sessions, watermark pressure, and ladder
+        # rung 2 all route through the same store. Library default OFF
+        # (ROOM_TPU_OFFLOAD / the ``offload`` arg opt in); the
+        # deployment path (providers/tpu.ModelHost) defaults ON.
+        self.offload_enabled = offload if offload is not None \
+            else offload_enabled_from_env()
+        self.offload_low_wm = float(
+            os.environ.get("ROOM_TPU_OFFLOAD_LOW_WM", "0.25")
+        )
+        self.offload_high_wm = float(
+            os.environ.get("ROOM_TPU_OFFLOAD_HIGH_WM", "0.5")
+        )
+        self.offload_on_park = os.environ.get(
+            "ROOM_TPU_OFFLOAD_ON_PARK", "1"
+        ) != "0"
+        self.offload_prefetch = int(
+            os.environ.get("ROOM_TPU_OFFLOAD_PREFETCH", "2")
+        )
+        self.offload_store: Optional[TieredKVStore] = \
+            TieredKVStore() if self.offload_enabled else None
 
         if stop_token_ids is not None:
             self.stop_token_ids = set(stop_token_ids)
@@ -429,6 +457,11 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(rng_seed)
         self._deferred_release: set[str] = set()
         self._admitting: set[str] = set()
+        # turns popped from the queue but not yet slotted (mid-_admit):
+        # a scheduler crash here would otherwise leave them in neither
+        # _active nor _queue, so _recover_from_crash could never fail
+        # them and their callers would hang on done.wait() forever
+        self._admission_turns: list[Turn] = []
         # concurrency contract: ALL mutation of sessions / page table /
         # slot arrays / prefix cache happens on the engine thread (the
         # thread driving step()). Other threads only enqueue: submit()
@@ -461,6 +494,10 @@ class ServingEngine:
             "spec_rows_sequential": 0, "spec_throttles": 0,
             "deadline_timeouts": 0, "stall_events": 0, "requeues": 0,
             "shed_turns": 0, "fault_retries": 0, "engine_crashes": 0,
+            "offloads": 0, "offload_pages_out": 0,
+            "offload_restores": 0, "offload_pages_in": 0,
+            "offload_prefetches": 0, "offload_resident_fallbacks": 0,
+            "offload_reprefills": 0,
         }
         from collections import Counter
 
@@ -551,7 +588,8 @@ class ServingEngine:
         """Current rung of the degradation ladder, derived from
         pressure events in the sliding window (stateless, so recovery
         is automatic once pressure stops): 0 healthy, 1 spec decode
-        off, 2 admission batch halved, 3 shedding."""
+        off, 2 cold sessions offloaded to host/disk, 3 admission batch
+        halved, 4 shedding."""
         if self._forced_degradation is not None:
             return self._forced_degradation
         cutoff = time.monotonic() - self.degrade_window_s
@@ -559,13 +597,9 @@ class ServingEngine:
             while self._pressure and self._pressure[0] < cutoff:
                 self._pressure.popleft()
             n = len(self._pressure)
-        t1, t2, t3 = self.degrade_thresholds
-        if n >= t3:
-            return 3
-        if n >= t2:
-            return 2
-        if n >= t1:
-            return 1
+        for level in range(len(self.degrade_thresholds), 0, -1):
+            if n >= self.degrade_thresholds[level - 1]:
+                return level
         return 0
 
     def set_degradation(self, level: Optional[int]) -> None:
@@ -608,6 +642,12 @@ class ServingEngine:
         self._slot_lengths[slot] = 0
         self._stats["requeues"] += 1
         self._queue_put(turn)
+        # a stall-watchdog park under pool pressure hibernates the
+        # session too — its requeued turn restores via prefetch (or at
+        # admission) once the engine digs out
+        if self.offload_store is not None and \
+                self.page_table.free_fraction < self.offload_low_wm:
+            self._offload_session(sess)
 
     def _handle_stall(self, active_idx: list[int], elapsed: float) -> None:
         """Decode-step watchdog: a device round slower than the stall
@@ -635,11 +675,11 @@ class ServingEngine:
             self._finish_turn(i, turn, "error")
 
     def _shed_if_overloaded(self) -> None:
-        """Ladder rung 3: when the queue is deeper than the engine can
+        """Ladder rung 4: when the queue is deeper than the engine can
         plausibly serve, shed the lowest-priority queued turns with an
         explicit overload error (routes map it to 503 + Retry-After)
         instead of letting every tenant time out."""
-        if self.degradation_level() < 3:
+        if self.degradation_level() < 4:
             return
         keep_n = self.max_batch * 2
         if self._queue.qsize() <= keep_n:
@@ -693,6 +733,13 @@ class ServingEngine:
                 self._fail_turn_unslotted(self._queue_get_nowait(), msg)
             except queue.Empty:
                 break
+        # turns the crash caught mid-admission (popped but unslotted):
+        # anything already failed/slotted above has done set and is
+        # skipped; the rest would hang their callers forever
+        for turn in self._admission_turns:
+            if not turn.done.is_set():
+                self._fail_turn_unslotted(turn, msg)
+        self._admission_turns = []
         self._drain_releases()
         with self._lock:
             self._admitting.clear()
@@ -703,6 +750,10 @@ class ServingEngine:
         self._slot_tables[:] = 0
         self._slot_lengths[:] = 0
         self._reserved_tokens[:] = 0
+        # host/disk copies reference sessions that no longer exist (and
+        # a crash mid-restore may have half-consumed one): drop them all
+        if self.offload_store is not None:
+            self.offload_store.clear()
         # a crash mid-device-call may have consumed a donated cache
         # buffer: rebuild the pool (and allocator) from scratch rather
         # than trust either side of the page accounting
@@ -853,6 +904,48 @@ class ServingEngine:
             self._jit_cache[key] = spec
         return self._jit_cache[key]
 
+    @staticmethod
+    def _pow2(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _offload_gather_fn(self, n_pad: int):
+        """Gather ``n_pad`` pages of every cache array into contiguous
+        [L, n_pad, ...] blocks for the host copy-out. Page counts are
+        padded to powers of two (pad ids point at scratch page 0 and
+        are sliced off host-side) so compile variants stay
+        O(log capacity). No donation — the pool stays live."""
+        key = ("offload_gather", n_pad)
+        if key not in self._jit_cache:
+
+            @jax.jit
+            def gather(cache, ids):
+                return {k: v[:, ids] for k, v in cache.items()}
+
+            self._jit_cache[key] = gather
+        return self._jit_cache[key]
+
+    def _offload_scatter_fn(self, n_pad: int):
+        """Scatter host page blocks back into the pool at fresh page
+        ids (restore). Donates the cache like every other cache-writing
+        fn; pad rows write zeros into scratch page 0, which is garbage
+        by contract."""
+        key = ("offload_scatter", n_pad)
+        if key not in self._jit_cache:
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def scatter(cache, ids, host):
+                out = {
+                    k: v.at[:, ids].set(host[k])
+                    for k, v in cache.items()
+                }
+                return self._constrain_cache(out)
+
+            self._jit_cache[key] = scatter
+        return self._jit_cache[key]
+
     # ---- public API ----
 
     def submit(
@@ -968,6 +1061,8 @@ class ServingEngine:
             if sess is not None:
                 self._release_session_prefix(sess)
             self.page_table.release(session_id)
+            if self.offload_store is not None:
+                self.offload_store.discard(session_id)
 
     def stats(self) -> dict:
         with self._lock:
@@ -984,20 +1079,27 @@ class ServingEngine:
         )
         out["degradation_level"] = self.degradation_level()
         out["healthy"] = self.healthy
+        out["offload"] = self.offload_store.stats() \
+            if self.offload_store is not None else None
         return out
 
     # ---- engine loop ----
 
     def step(self) -> int:
         """One scheduler iteration: apply queued releases, enforce
-        deadlines, shed under overload, admit, one decode step.
-        Returns the number of active slots (0 = idle)."""
+        deadlines, shed under overload, offload cold sessions under
+        watermark pressure, prefetch queued hibernated sessions,
+        admit, one decode step. Returns the number of active slots
+        (0 = idle)."""
         # chaos fault point: a non-transient scheduler crash — the
         # serve_forever supervisor must fail pending work and recover
         faults.maybe_fail("engine_crash")
         self._drain_releases()
         self._enforce_deadlines()
         self._shed_if_overloaded()
+        # sweep before prefetch: demotions free the pages restores need
+        self._offload_sweep()
+        self._prefetch_offloaded()
         self._admit()
         return self._decode_once()
 
@@ -1048,7 +1150,11 @@ class ServingEngine:
                     session_id, n_tokens
                 )
             except MemoryError:
-                if not self._evict_lru(exclude=session_id) and \
+                # cheapest relief first: hibernating a cold session
+                # frees its pages without losing its KV (the resume is
+                # a memcpy); only then drop KV via LRU eviction
+                if not self._offload_coldest(exclude=session_id) and \
+                        not self._evict_lru(exclude=session_id) and \
                         not self._evict_prefix():
                     raise
 
@@ -1096,6 +1202,253 @@ class ServingEngine:
             del self._prefix_lengths[victim.length]
         self._stats["prefix_evictions"] += 1
         return True
+
+    # ---- tiered KV offload (kv_offload.py, docs/kv_offload.md) ----
+
+    def _session_is_cold(self, sess: _Session) -> bool:
+        """Cold = no live turn references the session (active slot,
+        mid-admission, or queued). Queued sessions are excluded so the
+        pressure sweep never ping-pongs with the prefetcher."""
+        with self._lock:
+            return not self._session_in_flight(sess.id)
+
+    def _offload_session(self, sess: _Session) -> bool:
+        """Copy the session's non-prefix KV pages out to the tiered
+        store (async device->host) and release its HBM pages. Returns
+        True when pages were freed. An offload_io fault surviving its
+        retry budget FAILS BACK TO RESIDENT: the session keeps its
+        pages and nothing is lost."""
+        store = self.offload_store
+        if store is None or sess.length <= sess.prefix_len:
+            return False
+        pages = self.page_table.pages_of(sess.id)
+        if not pages:
+            return False
+        own_tokens = sess.length - sess.prefix_len
+        n_used = -(-own_tokens // self.page_size)
+        used = pages[:n_used]
+        n_pad = self._pow2(max(n_used, 1))
+        ids = np.zeros((n_pad,), np.int32)
+        ids[:n_used] = used
+        gather = self._offload_gather_fn(n_pad)
+
+        def call():
+            # fault point fires BEFORE the device call (no donation to
+            # protect here, but the contract stays uniform)
+            faults.maybe_fail("offload_io")
+            return gather(self.cache, jnp.asarray(ids))
+
+        try:
+            with self.timer.phase("offload_out"):
+                out = self._retrying("offload_out", call)
+                # start every device->host copy before materializing
+                # any of them, so transfers overlap
+                for a in out.values():
+                    try:
+                        a.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                # ascontiguousarray: a plain slice would be a VIEW
+                # pinning the whole pow2-padded transfer buffer (~2x
+                # the real bytes), silently defeating the host-tier cap
+                host = {
+                    k: np.ascontiguousarray(np.asarray(a)[:, :n_used])
+                    for k, a in out.items()
+                }
+        except FaultError:
+            self._stats["offload_resident_fallbacks"] += 1
+            self._note_pressure()
+            return False
+        entry = store.put(sess.id, host, own_tokens, n_used)
+        self.page_table.release(sess.id)
+        self._stats["offloads"] += 1
+        self._stats["offload_pages_out"] += n_used
+        try:
+            from ..core.telemetry import incr_counter
+
+            incr_counter("offload.out")
+            incr_counter("offload.bytes_out", entry.nbytes)
+        except Exception:
+            pass
+        return True
+
+    def offload_session(self, session_id: str) -> bool:
+        """Operator/test surface: hibernate one cold session now.
+        Engine-thread semantics — call it only from the engine thread
+        or while no loop thread owns the engine."""
+        sess = self.sessions.get(session_id)
+        if sess is None or not self._session_is_cold(sess):
+            return False
+        return self._offload_session(sess)
+
+    def _restore_session(self, sess: _Session, *, evict: bool = True) -> bool:
+        """device_put a hibernated session's pages back into the pool
+        before its next prefill. Raises MemoryError when the pool can't
+        hold it even after eviction (caller requeues; the entry stays
+        intact). ``evict=False`` (speculative prefetch) only takes
+        genuinely free pages — an opportunistic restore must never
+        evict another queued session's live KV to make room. An
+        offload_io fault surviving its retry budget — or a
+        dropped/unreadable entry — falls back to the history-mirror
+        re-prefill path (sess.length = 0), trading compute for
+        correctness."""
+        store = self.offload_store
+        if store is None:
+            return False
+        got = store.get(sess.id)
+        if got is None:
+            return False
+        entry, host = got
+        t0 = time.monotonic()
+        # MemoryError propagates with the entry intact; ensure_capacity
+        # is all-or-nothing so no pages leak on the raise
+        if evict:
+            pages = self._ensure_capacity_evicting(
+                sess.id, entry.own_tokens
+            )
+        else:
+            pages = self.page_table.ensure_capacity(
+                sess.id, entry.own_tokens
+            )
+        n_used = entry.n_pages
+        n_pad = self._pow2(max(n_used, 1))
+        ids = np.zeros((n_pad,), np.int32)
+        ids[:n_used] = pages[:n_used]
+        padded = {}
+        for k, a in host.items():
+            buf = np.zeros((a.shape[0], n_pad) + a.shape[2:], a.dtype)
+            buf[:, :n_used] = a
+            padded[k] = buf
+        scatter = self._offload_scatter_fn(n_pad)
+
+        def call():
+            # fault point fires BEFORE the jitted call so no donated
+            # buffer is consumed by a failed attempt
+            faults.maybe_fail("offload_io")
+            return scatter(self.cache, jnp.asarray(ids), padded)
+
+        try:
+            with self.timer.phase("offload_in"):
+                self.cache = self._retrying("offload_in", call)
+        except FaultError:
+            # fail back to re-prefill: release the just-allocated
+            # pages, drop the copy, and let the restoring path rebuild
+            # the context from the host-side history mirror
+            self.page_table.release(sess.id)
+            store.discard(sess.id)
+            sess.length = 0
+            self._stats["offload_reprefills"] += 1
+            self._note_pressure()
+            return False
+        store.discard(sess.id)
+        elapsed = time.monotonic() - t0
+        store.observe_restore(elapsed, entry.nbytes)
+        self._stats["offload_restores"] += 1
+        self._stats["offload_pages_in"] += n_used
+        try:
+            from ..core.telemetry import incr_counter, observe_ms
+
+            incr_counter("offload.in")
+            observe_ms("offload.restore", elapsed * 1000.0)
+        except Exception:
+            pass
+        return True
+
+    def _ensure_resident(self, sess: _Session) -> None:
+        """Make an offloaded (or copy-lost) session's KV usable before
+        turn preparation: restore its pages, or — when the copy is gone
+        (disk-cap drop, spool I/O error, restore fault) — reset to the
+        history-mirror re-prefill path. Called BEFORE the preparation
+        snapshot so rollback can never mix restored and hibernated
+        state."""
+        if sess.length <= sess.prefix_len:
+            return
+        if self.page_table.pages_of(sess.id):
+            return   # resident
+        if self.offload_store is not None and \
+                self.offload_store.has(sess.id):
+            if self._restore_session(sess):
+                return
+        if sess.length > 0:
+            # no copy to restore: |history| == length always, so the
+            # restoring path in _prepare_turn_inner rebuilds the
+            # context exactly
+            self._stats["offload_reprefills"] += 1
+            sess.length = 0
+
+    def _offload_coldest(self, exclude: str) -> bool:
+        """Pool-pressure fallback, tried before LRU eviction: hibernate
+        the coldest cold session instead of dropping its KV — frees the
+        same pages but the resume is a memcpy, not a re-prefill."""
+        if self.offload_store is None:
+            return False
+        candidates = [
+            s for s in self.sessions.values()
+            if s.id != exclude and s.length > s.prefix_len
+            and self.page_table.pages_of(s.id)
+            and self._session_is_cold(s)
+        ]
+        for victim in sorted(candidates, key=lambda s: s.last_used):
+            if self._offload_session(victim):
+                return True
+        return False
+
+    def _offload_sweep(self) -> None:
+        """Watermark-driven demotion, run every scheduler step: when
+        free pages fall under the low watermark (or ladder rung >= 2
+        turns the sweep aggressive), hibernate cold sessions coldest-
+        first until the high watermark is restored (aggressive: until
+        no cold session holds pages)."""
+        if self.offload_store is None:
+            return
+        aggressive = self.degradation_level() >= 2
+        if not aggressive and \
+                self.page_table.free_fraction >= self.offload_low_wm:
+            return
+        candidates = [
+            s for s in self.sessions.values()
+            if s.length > s.prefix_len
+            and self.page_table.pages_of(s.id)
+            and self._session_is_cold(s)
+        ]
+        for victim in sorted(candidates, key=lambda s: s.last_used):
+            if not aggressive and self.page_table.free_fraction \
+                    >= self.offload_high_wm:
+                break
+            self._offload_session(victim)
+
+    def _prefetch_offloaded(self) -> None:
+        """Restore hibernated sessions whose next turn is already
+        QUEUED, overlapping the host->device copy with ongoing decode
+        instead of paying it inside the admission path. Bounded per
+        step; a full pool just defers to admission-time restore."""
+        store = self.offload_store
+        if store is None or len(store) == 0:
+            return
+        # never prefetch INTO a pressured pool: below the low watermark
+        # the pages are better spent on the active batch (and restoring
+        # a stall-parked session the watchdog just hibernated would be
+        # a guaranteed wasted round trip) — admission restores when the
+        # turn actually lands
+        if self.page_table.free_fraction < self.offload_low_wm:
+            return
+        with self._lock:
+            queued = list(self._queued_sids)
+        budget = self.offload_prefetch
+        for sid in queued:
+            if budget <= 0:
+                return
+            sess = self.sessions.get(sid)
+            if sess is None or not store.has(sid):
+                continue
+            try:
+                # evict=False: a speculative restore takes only free
+                # pages — admission (which may evict) restores the rest
+                if self._restore_session(sess, evict=False):
+                    budget -= 1
+                    self._stats["offload_prefetches"] += 1
+            except MemoryError:
+                return   # pool busy; admission will retry
 
     def _prefix_lookup(self, prompt: list[int]) -> Optional["_PrefixEntry"]:
         """Longest ready cached prefix of ``prompt`` (only lengths that
@@ -1160,9 +1513,9 @@ class ServingEngine:
         multi-tenant rooms submitting simultaneously don't serialize."""
         free = self._free_slots()
         preps: list[dict] = []
-        # ladder rung 2: halve the admission batch so a pressured pool
+        # ladder rung 3: halve the admission batch so a pressured pool
         # drains instead of thrashing on eviction
-        cap = len(free) if self.degradation_level() < 2 \
+        cap = len(free) if self.degradation_level() < 3 \
             else max(1, self.max_batch // 2)
         attempts = 0
         with self._lock:
@@ -1173,6 +1526,7 @@ class ServingEngine:
                     attempts < self.max_batch * 2:
                 attempts += 1
                 turn = self._queue_get()
+                self._admission_turns.append(turn)
                 # registered BEFORE pages are reserved so an inline
                 # release from another thread can't free a batchmate's
                 # reservation mid-admission (it defers instead);
@@ -1227,6 +1581,10 @@ class ServingEngine:
                     bucket, fresh, group, slots,
                     active_pages=active_pages,
                 )
+            # normal exit: every popped turn is slotted, requeued, or
+            # already failed. Cleared HERE (not in finally) so a crash
+            # escaping admission leaves the list for the supervisor.
+            self._admission_turns.clear()
         finally:
             with self._lock:
                 self._admitting.clear()
@@ -1285,6 +1643,11 @@ class ServingEngine:
         if sess is None:
             sess = _Session(id=turn.session_id)
             self.sessions[turn.session_id] = sess
+        # hibernated sessions come back BEFORE the snapshot: a later
+        # rollback then restores a consistent resident (or re-prefill)
+        # state, never a half-restored one. MemoryError propagates to
+        # _admit (requeue) with the host copy intact.
+        self._ensure_resident(sess)
         snap = {
             "pending": sess.pending, "length": sess.length,
             "history": list(sess.history), "parked": sess.parked,
@@ -2056,7 +2419,7 @@ class ServingEngine:
             # is unwritten; it re-enters via the next resume prompt
             sess.pending = turn.new_tokens[-1]
         if reason == "tool_call":
-            sess.parked = True        # pages retained for resume
+            sess.parked = True        # KV retained (HBM or hibernated)
         turn.finish_reason = reason
         self._active[slot] = None
         # point the freed slot at the scratch page so idle rows of the
@@ -2070,6 +2433,15 @@ class ServingEngine:
             self.sessions.pop(sess.id, None)
             self._release_session_prefix(sess)
             self.page_table.release(sess.id)
+            if self.offload_store is not None:
+                self.offload_store.discard(sess.id)
+        elif reason == "tool_call" and self.offload_store is not None \
+                and self.offload_on_park and self._session_is_cold(sess):
+            # the tool-call park: the session goes cold for however
+            # long the host-side tool runs — hibernate its pages so a
+            # parked room stops billing HBM (restore is prefetched the
+            # moment the resume turn queues)
+            self._offload_session(sess)
         turn.done.set()
 
     def text_of(self, turn: Turn) -> str:

@@ -13,6 +13,9 @@ named *fault point* that tests (and staging deployments) can arm:
     engine_crash       scheduler iteration raises (non-transient)
     client_disconnect  SSE stream aborts mid-generation
     provider_timeout   provider-level turn deadline forced to expire
+    offload_io         KV offload copy-out / restore fails (transient;
+                       exhaustion fails back to resident pages on the
+                       way out, to a history re-prefill on the way in)
 
 Arming is per-point with probability / latency / one-shot triggers,
 via code (`inject`) or env (`ROOM_TPU_FAULTS`), e.g.::
@@ -43,7 +46,7 @@ __all__ = [
 FAULT_POINTS = (
     "kv_alloc", "prefill_oom", "decode_step", "decode_stall",
     "tokenizer", "engine_crash", "client_disconnect",
-    "provider_timeout",
+    "provider_timeout", "offload_io",
 )
 
 

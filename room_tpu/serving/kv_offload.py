@@ -1,0 +1,391 @@
+"""Tiered KV offload: hibernate parked sessions to host RAM / disk.
+
+The room workload (PAPER.md) is dominated by agent turns that *park*
+mid-turn for tool calls: today every parked session keeps all of its KV
+pages resident in HBM, so HBM capacity — not compute — caps room size.
+This module is the host side of a three-tier page store:
+
+    tier 0  HBM          the engine's paged pool (kv_pages.py)
+    tier 1  host RAM     byte-exact page copies, size-capped, LRU
+    tier 2  disk spool   LRU demotions from tier 1, size-capped
+
+The engine (serving/engine.py) copies a cold session's non-prefix pages
+out with `jax.device_get` (async host copies), releases the HBM pages
+back to the pool, and records the copy here. On the session's next turn
+(or earlier, via prefetch while other sessions keep decoding) the pages
+are re-allocated and `device_put` back before the prefill step — a
+memcpy round trip, not a recompute, so greedy continuations are
+token-identical to a never-offloaded run (the restore canary in
+tests/test_kv_offload.py pins this).
+
+Degradation-safe by construction: an entry that gets dropped (disk cap,
+spool I/O error) is not fatal — the engine's host-side history mirror
+re-prefills the context, trading compute for correctness. The store
+never throws at the engine for I/O problems; it degrades and counts.
+
+Env knobs (docs/kv_offload.md):
+
+    ROOM_TPU_OFFLOAD           enable ("1"/"0"; engines also take an
+                               explicit ``offload=`` constructor arg)
+    ROOM_TPU_OFFLOAD_HOST_MB   tier-1 cap (default 512)
+    ROOM_TPU_OFFLOAD_DISK_MB   tier-2 cap (default 2048; 0 disables
+                               the spool — demotions become drops)
+    ROOM_TPU_OFFLOAD_DIR       spool directory (default a per-process
+                               dir under the system temp dir)
+    ROOM_TPU_OFFLOAD_LOW_WM    free-page fraction that starts the
+                               pressure sweep (default 0.25)
+    ROOM_TPU_OFFLOAD_HIGH_WM   free-page fraction the sweep restores
+                               (default 0.5)
+    ROOM_TPU_OFFLOAD_ON_PARK   offload immediately on tool-call park
+                               (default 1)
+    ROOM_TPU_OFFLOAD_PREFETCH  queued-session restores started per
+                               scheduler step (default 2)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "OffloadEntry", "TieredKVStore", "offload_enabled_from_env",
+    "RESTORE_HIST_BUCKETS_MS",
+]
+
+# restore-latency histogram buckets (milliseconds, upper bounds; the
+# final bucket is unbounded). Shared with /api/tpu/health and the TPU
+# panel so every surface renders the same edges.
+RESTORE_HIST_BUCKETS_MS = (1.0, 5.0, 20.0, 100.0, 500.0)
+
+
+def offload_enabled_from_env(default: str = "0") -> bool:
+    return os.environ.get("ROOM_TPU_OFFLOAD", default).strip() not in (
+        "0", "", "off", "false",
+    )
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name saved in a spool header. bfloat16 (and
+    friends) are registered by ml_dtypes — imported lazily so a plain
+    int8/float32 spool never needs it."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _write_spool(path: str, arrays: dict[str, np.ndarray]) -> None:
+    """One entry -> one file: json header (dtype/shape per key) + raw
+    buffers in sorted-key order. Raw bytes instead of np.savez because
+    bfloat16 is not a savez-portable dtype. Atomic via rename."""
+    tmp = path + ".tmp"
+    meta = {
+        k: {"dtype": a.dtype.name, "shape": list(a.shape)}
+        for k, a in arrays.items()
+    }
+    hdr = json.dumps(meta).encode()
+    with open(tmp, "wb") as f:
+        f.write(len(hdr).to_bytes(8, "little"))
+        f.write(hdr)
+        for k in sorted(arrays):
+            f.write(np.ascontiguousarray(arrays[k]).tobytes())
+    os.replace(tmp, path)
+
+
+def _read_spool(path: str) -> dict[str, np.ndarray]:
+    with open(path, "rb") as f:
+        hdr_len = int.from_bytes(f.read(8), "little")
+        meta = json.loads(f.read(hdr_len).decode())
+        out: dict[str, np.ndarray] = {}
+        for k in sorted(meta):
+            dt = _np_dtype(meta[k]["dtype"])
+            shape = tuple(meta[k]["shape"])
+            n = int(np.prod(shape)) * dt.itemsize
+            buf = f.read(n)
+            if len(buf) != n:
+                raise OSError(f"truncated spool file {path!r}")
+            out[k] = np.frombuffer(buf, dtype=dt).reshape(shape)
+    return out
+
+
+@dataclass
+class OffloadEntry:
+    """One hibernated session: byte-exact copies of its non-prefix KV
+    pages, resident in host RAM (``arrays``) or spooled to ``path``."""
+
+    session_id: str
+    own_tokens: int                 # tokens the pages cover (past prefix)
+    n_pages: int
+    nbytes: int
+    arrays: Optional[dict[str, np.ndarray]] = None   # tier 1
+    path: Optional[str] = None                       # tier 2
+    created_at: float = field(default_factory=time.monotonic)
+    last_used: float = field(default_factory=time.monotonic)
+
+    @property
+    def tier(self) -> str:
+        return "host" if self.arrays is not None else "disk"
+
+
+class TieredKVStore:
+    """Host RAM + disk spool tiers of the offload hierarchy.
+
+    Pure host-side bookkeeping: the engine owns all device copies and
+    all page-table mutation; this class only holds bytes and applies
+    the LRU cap policy (host overflow demotes to disk, disk overflow
+    drops the oldest entry — the engine re-prefills a dropped session
+    from its history mirror, so a drop costs compute, never
+    correctness).
+
+    Thread-safe: the engine thread mutates while HTTP threads snapshot
+    ``stats()``.
+    """
+
+    def __init__(
+        self,
+        host_bytes_cap: Optional[int] = None,
+        disk_bytes_cap: Optional[int] = None,
+        spool_dir: Optional[str] = None,
+    ) -> None:
+        mb = 1024 * 1024
+        if host_bytes_cap is None:
+            host_bytes_cap = int(float(
+                os.environ.get("ROOM_TPU_OFFLOAD_HOST_MB", "512")
+            ) * mb)
+        if disk_bytes_cap is None:
+            disk_bytes_cap = int(float(
+                os.environ.get("ROOM_TPU_OFFLOAD_DISK_MB", "2048")
+            ) * mb)
+        self.host_bytes_cap = host_bytes_cap
+        self.disk_bytes_cap = disk_bytes_cap
+        self._spool_dir = spool_dir or \
+            os.environ.get("ROOM_TPU_OFFLOAD_DIR") or None
+        self._own_spool = self._spool_dir is None
+        self._entries: dict[str, OffloadEntry] = {}
+        self._lock = threading.Lock()
+        self._stats = {
+            "host_hits": 0, "disk_hits": 0, "misses": 0,
+            "demotions": 0, "disk_drops": 0, "spool_errors": 0,
+            "bytes_out": 0, "bytes_in": 0,
+        }
+        self._hist = [0] * (len(RESTORE_HIST_BUCKETS_MS) + 1)
+
+    # ---- spool dir ----
+
+    def _ensure_spool_dir(self) -> str:
+        if self._spool_dir is None:
+            self._spool_dir = tempfile.mkdtemp(prefix="room_tpu_kv_")
+        else:
+            os.makedirs(self._spool_dir, exist_ok=True)
+        return self._spool_dir
+
+    def _spool_path(self, session_id: str) -> str:
+        slug = hashlib.sha1(session_id.encode()).hexdigest()[:16]
+        return os.path.join(self._ensure_spool_dir(),
+                            f"{slug}.kvspool")
+
+    # ---- tier accounting (callers hold self._lock) ----
+
+    def _host_bytes(self) -> int:
+        return sum(
+            e.nbytes for e in self._entries.values()
+            if e.arrays is not None
+        )
+
+    def _disk_bytes(self) -> int:
+        return sum(
+            e.nbytes for e in self._entries.values() if e.path
+        )
+
+    def _drop_entry(self, entry: OffloadEntry) -> None:
+        self._entries.pop(entry.session_id, None)
+        if entry.path:
+            try:
+                os.unlink(entry.path)
+            except OSError:
+                pass
+
+    def _rebalance(self) -> None:
+        """LRU-demote host entries to the spool until tier 1 fits its
+        cap, then drop LRU disk entries until tier 2 fits. A failed
+        spool write (or a zero disk cap) drops the victim outright —
+        the engine's history mirror makes that safe.
+
+        Spool WRITES happen outside the lock (they can be hundreds of
+        MB; stats()/has()/get() from HTTP threads must not stall on
+        them). Safe because the engine thread is the store's only
+        mutator — the lock only protects reader snapshots."""
+        while True:
+            with self._lock:
+                if self._host_bytes() <= self.host_bytes_cap:
+                    break
+                victims = [
+                    e for e in self._entries.values()
+                    if e.arrays is not None
+                ]
+                if not victims:
+                    break
+                victim = min(victims, key=lambda e: e.last_used)
+                if self.disk_bytes_cap <= 0:
+                    self._stats["disk_drops"] += 1
+                    self._drop_entry(victim)
+                    continue
+                arrays = victim.arrays
+                path = self._spool_path(victim.session_id)
+            try:
+                _write_spool(path, arrays)
+            except OSError:
+                with self._lock:
+                    self._stats["spool_errors"] += 1
+                    self._drop_entry(victim)
+                continue
+            with self._lock:
+                victim.path = path
+                victim.arrays = None
+                self._stats["demotions"] += 1
+        with self._lock:
+            while self._disk_bytes() > self.disk_bytes_cap:
+                victims = [
+                    e for e in self._entries.values() if e.path
+                ]
+                if not victims:
+                    break
+                victim = min(victims, key=lambda e: e.last_used)
+                self._stats["disk_drops"] += 1
+                self._drop_entry(victim)
+
+    # ---- public API (engine thread mutates; HTTP threads read) ----
+
+    def put(
+        self, session_id: str, arrays: dict[str, np.ndarray],
+        own_tokens: int, n_pages: int,
+    ) -> OffloadEntry:
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        entry = OffloadEntry(
+            session_id=session_id, own_tokens=own_tokens,
+            n_pages=n_pages, nbytes=nbytes, arrays=arrays,
+        )
+        with self._lock:
+            old = self._entries.pop(session_id, None)
+            if old is not None:
+                self._drop_entry(old)
+            self._entries[session_id] = entry
+            self._stats["bytes_out"] += nbytes
+        self._rebalance()
+        return entry
+
+    def has(self, session_id: str) -> bool:
+        with self._lock:
+            return session_id in self._entries
+
+    def tier_of(self, session_id: str) -> Optional[str]:
+        with self._lock:
+            e = self._entries.get(session_id)
+            return e.tier if e else None
+
+    def get(
+        self, session_id: str
+    ) -> Optional[tuple[OffloadEntry, dict[str, np.ndarray]]]:
+        """Load an entry's arrays (from RAM or spool) WITHOUT removing
+        it — the engine discards only after the device scatter lands,
+        so a failed restore leaves the copy intact. A spool read error
+        degrades to a miss (entry dropped; history re-prefills)."""
+        with self._lock:
+            entry = self._entries.get(session_id)
+            if entry is None:
+                self._stats["misses"] += 1
+                return None
+            entry.last_used = time.monotonic()
+            if entry.arrays is not None:
+                self._stats["host_hits"] += 1
+                return entry, entry.arrays
+            path = entry.path
+        try:
+            arrays = _read_spool(path)
+        except (OSError, ValueError, KeyError):
+            # truncated file, garbage header, or shape/dtype mismatch
+            # all degrade the same way: a miss the engine re-prefills
+            with self._lock:
+                self._stats["spool_errors"] += 1
+                self._stats["misses"] += 1
+                self._drop_entry(entry)
+            return None
+        with self._lock:
+            self._stats["disk_hits"] += 1
+        return entry, arrays
+
+    def discard(self, session_id: str) -> bool:
+        with self._lock:
+            entry = self._entries.get(session_id)
+            if entry is None:
+                return False
+            self._drop_entry(entry)
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            for entry in list(self._entries.values()):
+                self._drop_entry(entry)
+            self._entries.clear()
+        if self._own_spool and self._spool_dir:
+            shutil.rmtree(self._spool_dir, ignore_errors=True)
+            self._spool_dir = None
+
+    def observe_restore(self, seconds: float, nbytes: int) -> None:
+        ms = seconds * 1000.0
+        idx = len(RESTORE_HIST_BUCKETS_MS)
+        for i, edge in enumerate(RESTORE_HIST_BUCKETS_MS):
+            if ms <= edge:
+                idx = i
+                break
+        with self._lock:
+            self._hist[idx] += 1
+            self._stats["bytes_in"] += nbytes
+
+    def restore_hist(self) -> dict[str, int]:
+        with self._lock:
+            hist = list(self._hist)
+        out = {}
+        for i, edge in enumerate(RESTORE_HIST_BUCKETS_MS):
+            out[f"le_{edge:g}ms"] = hist[i]
+        out[f"gt_{RESTORE_HIST_BUCKETS_MS[-1]:g}ms"] = hist[-1]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        """Tier occupancy + hit/miss/byte counters + restore-latency
+        histogram (for engine.stats(), /api/tpu/health, the TPU
+        panel)."""
+        with self._lock:
+            host_entries = sum(
+                1 for e in self._entries.values()
+                if e.arrays is not None
+            )
+            disk_entries = sum(
+                1 for e in self._entries.values() if e.path
+            )
+            out = {
+                "host_entries": host_entries,
+                "disk_entries": disk_entries,
+                "host_bytes": self._host_bytes(),
+                "disk_bytes": self._disk_bytes(),
+                "host_bytes_cap": self.host_bytes_cap,
+                "disk_bytes_cap": self.disk_bytes_cap,
+                **self._stats,
+            }
+        out["restore_ms_hist"] = self.restore_hist()
+        return out

@@ -504,6 +504,13 @@ class PageTable:
         with self._lock:
             return len(self._free)
 
+    @property
+    def free_fraction(self) -> float:
+        """Fraction of the pool currently free — the offload tier
+        manager's watermark signal (kv_offload.py)."""
+        with self._lock:
+            return len(self._free) / max(self.n_pages, 1)
+
     def pages_of(self, session_id: str) -> list[int]:
         with self._lock:
             return list(self._sessions.get(session_id, []))

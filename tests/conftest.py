@@ -26,6 +26,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache (same dir bench.py uses): the suite
+# builds hundreds of ServingEngine/jit instances over the SAME tiny
+# model shapes, and each engine's private jit cache recompiles them
+# from scratch — the disk cache dedupes identical programs both within
+# one run and across runs, keeping tier-1 inside its timeout window.
+try:
+    _cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/room_tpu_jax_cache"
+    )
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 from room_tpu.db import Database  # noqa: E402

@@ -246,6 +246,42 @@ def test_engine_crash_supervision_restarts(make_engine):
     _assert_pages_balanced(eng)
 
 
+def test_crash_mid_admission_fails_popped_turns_cleanly(make_engine):
+    """A crash AFTER a turn is popped from the queue but BEFORE it
+    reaches a slot must still fail it cleanly — mid-admission turns
+    are in neither _active nor _queue, and losing them would hang
+    their callers on done.wait() forever."""
+    eng = make_engine()
+    orig = eng._prefill_group
+
+    def boom(*a, **k):
+        raise RuntimeError("injected mid-admission crash")
+
+    eng._prefill_group = boom
+    stop = threading.Event()
+    th = threading.Thread(
+        target=eng.serve_forever, args=(stop,), daemon=True
+    )
+    th.start()
+    try:
+        t = eng.submit([1, 2, 3], sampling=_greedy())
+        assert t.done.wait(30), "mid-admission turn leaked on crash"
+        assert t.finish_reason == "error"
+        assert "engine crashed" in t.error
+        # the supervisor recovered: admission works again
+        eng._prefill_group = orig
+        t2 = eng.submit([4, 5, 6], sampling=_greedy())
+        assert t2.done.wait(30)
+        assert t2.finish_reason in ("stop", "length")
+        _release_all(eng)
+        time.sleep(0.2)
+    finally:
+        eng._prefill_group = orig
+        stop.set()
+        th.join(5)
+    _assert_pages_balanced(eng)
+
+
 def test_engine_crash_loop_marks_unhealthy(make_engine):
     """Crashes past the restart budget mark the engine unhealthy and
     end the loop — the fail-closed signal the provider registry keys
@@ -285,9 +321,9 @@ def test_degradation_level_from_pressure_window(make_engine):
     for _ in range(eng.degrade_thresholds[0]):
         eng._note_pressure()
     assert eng.degradation_level() == 1
-    for _ in range(eng.degrade_thresholds[2]):
+    for _ in range(eng.degrade_thresholds[3]):
         eng._note_pressure()
-    assert eng.degradation_level() == 3
+    assert eng.degradation_level() == 4
     time.sleep(0.35)                   # window drains -> recovery
     assert eng.degradation_level() == 0
 
@@ -312,9 +348,9 @@ def test_degradation_rung1_disables_spec(make_engine):
     eng.set_degradation(None)
 
 
-def test_degradation_rung2_halves_admission(make_engine):
+def test_degradation_rung3_halves_admission(make_engine):
     eng = make_engine()
-    eng.set_degradation(2)
+    eng.set_degradation(3)
     for i in range(4):
         eng.submit([i + 1], sampling=_greedy())
     eng.step()
@@ -323,9 +359,9 @@ def test_degradation_rung2_halves_admission(make_engine):
     eng.run_until_idle()
 
 
-def test_degradation_rung3_sheds_lowest_priority(make_engine):
+def test_degradation_rung4_sheds_lowest_priority(make_engine):
     eng = make_engine()
-    eng.set_degradation(3)
+    eng.set_degradation(4)
     keep_n = eng.max_batch * 2
     low = [
         eng.submit([i + 1], sampling=_greedy(), priority=0)
@@ -542,7 +578,7 @@ def test_shed_turn_maps_to_503_with_retry_after(tpu_host):
     from room_tpu.server.routes import register_openai_routes
 
     engine = tpu_host.engine()
-    engine.set_degradation(3)
+    engine.set_degradation(4)
     try:
         # saturate the queue well past keep_n (max_batch*2) so the
         # ladder is guaranteed to shed the priority-0 turn below
@@ -695,6 +731,10 @@ def _stress(eng, duration_s, n_threads, crash_faults=False):
     faults.inject("prefill_oom", probability=0.02, seed=2)
     faults.inject("decode_stall", probability=0.008, latency_s=0.1,
                   seed=3)
+    if eng.offload_store is not None:
+        # tiered-offload chaos: copy-out/restore I/O faults exercise
+        # both fallbacks (fail-back-to-resident, history re-prefill)
+        faults.inject("offload_io", probability=0.05, seed=5)
     if crash_faults:
         faults.inject("engine_crash", probability=0.002, seed=4)
 
@@ -773,8 +813,13 @@ def _assert_stress_invariants(eng, turns, expected, crash_faults=False):
     # every turn terminated (no hangs, no drops)
     flat = [t[1] if isinstance(t, tuple) else t for t in turns]
     assert flat and all(t.done.is_set() for t in flat)
-    # invariant 1: zero KV page leaks
+    # invariant 1: zero KV page leaks — and with offload on, the
+    # host/disk tiers drained too (every release dropped its copy)
     _assert_pages_balanced(eng)
+    if eng.offload_store is not None:
+        assert len(eng.offload_store) == 0, (
+            "offload store leaked hibernated sessions"
+        )
     # invariant 2: unfaulted canaries are token-deterministic
     canaries = [t for t in turns if isinstance(t, tuple)]
     undisrupted = [
@@ -801,9 +846,11 @@ def test_chaos_stress_quick(make_engine):
 
 @pytest.mark.slow
 def test_chaos_stress_soak(make_engine):
-    """Soak tier (>=30 s, more threads, occasional engine crashes) —
-    the acceptance-criteria stress run."""
-    eng = make_engine(n_pages=128, max_batch=8)
+    """Soak tier (>=30 s, more threads, occasional engine crashes,
+    tiered KV offload live with offload_io armed) — the
+    acceptance-criteria stress run: zero page leaks, no dropped turns,
+    hibernation round trips under fire."""
+    eng = make_engine(n_pages=128, max_batch=8, offload=True)
     turns, expected = _stress(
         eng, duration_s=35, n_threads=6, crash_faults=True
     )

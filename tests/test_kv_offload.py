@@ -1,0 +1,478 @@
+"""Tiered KV offload suite (docs/kv_offload.md).
+
+Pins the subsystem's core contract on the CPU backend: hibernating a
+parked session measurably releases its HBM pages (PageTable free count
+rises) and the resumed turn is token-identical to a never-offloaded
+control — through the host-RAM tier, the disk spool, watermark-driven
+demotion, prefetch, and every offload_io fault fallback. The quick
+chaos burst runs in the CI chaos job; page accounting and store
+drainage are asserted after every scenario.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving.kv_offload import (
+    TieredKVStore, _read_spool, _write_spool,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture()
+def make_engine(model, monkeypatch, tmp_path):
+    """Offload-enabled engine factory: prefix cache off so page-balance
+    checks reduce to 'every session released -> pool full', spool under
+    tmp_path so nothing leaks across tests."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path / "spool"))
+    cfg, params = model
+
+    def build(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("offload", True)
+        return ServingEngine(cfg, params, **kw)
+
+    return build
+
+
+def _greedy(n=8, **kw):
+    return SamplingParams(temperature=0.0, max_new_tokens=n, **kw)
+
+
+def _drain(eng):
+    for sid in list(eng.sessions):
+        eng.release_session(sid)
+    assert eng.page_table.free_pages == eng.n_pages - 1, (
+        "KV page leak after releasing every session"
+    )
+    if eng.offload_store is not None:
+        assert len(eng.offload_store) == 0, "offload store leaked"
+
+
+# ---- store unit tier ----
+
+def _arrays(nbytes=1024):
+    return {"k": np.arange(nbytes, dtype=np.uint8).reshape(1, -1),
+            "v": np.zeros((1, nbytes), np.uint8)}
+
+
+def test_store_put_get_discard(tmp_path):
+    st = TieredKVStore(host_bytes_cap=1 << 20, disk_bytes_cap=1 << 20,
+                       spool_dir=str(tmp_path))
+    st.put("a", _arrays(), own_tokens=16, n_pages=2)
+    assert st.has("a") and st.tier_of("a") == "host"
+    entry, arrays = st.get("a")
+    assert entry.own_tokens == 16 and entry.n_pages == 2
+    assert (arrays["k"] == _arrays()["k"]).all()
+    assert st.discard("a") and not st.has("a")
+    assert st.stats()["host_hits"] == 1
+
+
+def test_store_lru_demotes_to_disk_and_drops(tmp_path):
+    """Tier caps: host overflow demotes OLDEST-first to the spool;
+    disk overflow drops oldest-first — strict LRU at both edges."""
+    st = TieredKVStore(host_bytes_cap=5000, disk_bytes_cap=5000,
+                       spool_dir=str(tmp_path))
+    for i, sid in enumerate(("old", "mid", "new")):
+        st.put(sid, _arrays(), own_tokens=8, n_pages=1)   # 2048 B each
+        time.sleep(0.01)
+    # 3 * 2048 > 5000: the oldest went to disk
+    assert st.tier_of("old") == "disk"
+    assert st.tier_of("mid") == "host" and st.tier_of("new") == "host"
+    # disk read round-trips bytes exactly
+    _, arrays = st.get("old")
+    assert (arrays["k"] == _arrays()["k"]).all()
+    assert st.stats()["disk_hits"] == 1
+    # overflow the disk tier too: oldest disk entry is dropped outright
+    for i in range(4):
+        st.put(f"x{i}", _arrays(), own_tokens=8, n_pages=1)
+        time.sleep(0.01)
+    stats = st.stats()
+    assert stats["disk_drops"] >= 1
+    assert not st.has("old"), "oldest entry should have dropped"
+    st.clear()
+    assert len(st) == 0
+
+
+def test_spool_roundtrip_preserves_bfloat16(tmp_path):
+    """The raw spool format (json header + buffers) must round-trip
+    bfloat16 byte-exactly — np.savez can't, which is why it exists."""
+    import ml_dtypes
+
+    path = str(tmp_path / "s.kvspool")
+    arrays = {
+        "k_pages": np.arange(24, dtype=np.float32).astype(
+            ml_dtypes.bfloat16).reshape(2, 3, 4),
+        "v_pages": np.ones((2, 2), np.int8),
+        "k_scale": np.full((2, 2), 0.5, np.float32),
+    }
+    _write_spool(path, arrays)
+    got = _read_spool(path)
+    for k, a in arrays.items():
+        assert got[k].dtype == a.dtype and got[k].shape == a.shape
+        assert got[k].tobytes() == a.tobytes()
+
+
+def test_store_spool_read_error_degrades_to_miss(tmp_path):
+    st = TieredKVStore(host_bytes_cap=0, disk_bytes_cap=1 << 20,
+                       spool_dir=str(tmp_path))
+    st.put("a", _arrays(), own_tokens=8, n_pages=1)   # demoted at once
+    assert st.tier_of("a") == "disk"
+    entry = st._entries["a"]
+    with open(entry.path, "wb") as f:
+        f.write(b"\x10")                               # truncate/corrupt
+    assert st.get("a") is None                         # miss, not raise
+    assert not st.has("a")
+    assert st.stats()["spool_errors"] == 1
+
+
+# ---- engine round trip (acceptance criteria) ----
+
+def test_offload_releases_pages_and_resume_is_token_identical(
+    make_engine,
+):
+    """THE acceptance canary: a parked session's non-prefix HBM pages
+    are measurably released (free-page count rises) and the resumed
+    greedy turn matches a never-offloaded control token for token."""
+    prompt = list(range(1, 20))
+    cont = [7, 7, 7]
+
+    ctrl = make_engine(offload=False)
+    c1 = ctrl.submit(prompt, session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    c2 = ctrl.submit(cont, session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    _drain(ctrl)
+
+    eng = make_engine()
+    t1 = eng.submit(prompt, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    # the tool-call park semantics: session is cold, pages resident
+    assert eng.page_table.pages_of("s")
+    free_before = eng.page_table.free_pages
+    assert eng.offload_session("s")
+    assert eng.page_table.free_pages > free_before, (
+        "offload must measurably release HBM pages"
+    )
+    assert not eng.page_table.pages_of("s")
+    assert eng.offload_store.tier_of("s") == "host"
+
+    t2 = eng.submit(cont, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["offload_restores"] == 1, "resume must restore, not re-prefill"
+    assert st["offload_reprefills"] == 0
+    assert t1.new_tokens == c1.new_tokens
+    assert t2.new_tokens == c2.new_tokens, (
+        "offload round trip changed the greedy stream"
+    )
+    _drain(eng)
+
+
+def test_resume_from_disk_tier_is_token_identical(
+    make_engine, monkeypatch,
+):
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_HOST_MB", "0.001")
+    prompt = list(range(1, 20))
+
+    ctrl = make_engine(offload=False)
+    c1 = ctrl.submit(prompt, session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    c2 = ctrl.submit([9, 9], session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    _drain(ctrl)
+
+    eng = make_engine()
+    t1 = eng.submit(prompt, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_session("s")
+    # ~1 KB host cap: the entry demoted straight to the disk spool
+    assert eng.offload_store.tier_of("s") == "disk"
+    t2 = eng.submit([9, 9], session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_store.stats()["disk_hits"] == 1
+    assert t1.new_tokens == c1.new_tokens
+    assert t2.new_tokens == c2.new_tokens
+    _drain(eng)
+
+
+def test_prefetch_restores_queued_session_before_admission(make_engine):
+    eng = make_engine()
+    eng.submit(list(range(1, 20)), session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_session("s")
+    eng.submit([5, 5], session_id="s", sampling=_greedy())
+    # a scheduler step prefetches the queued session's pages back
+    # (overlapping restore with decode) before admission prefills
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["offload_prefetches"] == 1
+    assert st["offload_restores"] == 1
+    _drain(eng)
+
+
+def test_watermark_sweep_offloads_coldest_first(make_engine):
+    """Pool pressure under the low watermark hibernates cold sessions
+    in strict last_used order (coldest first) until the high watermark
+    is restored."""
+    eng = make_engine(n_pages=96)
+    sids = ["cold", "cool", "warm"]
+    for i, sid in enumerate(sids):
+        eng.submit(list(range(1, 20)), session_id=sid,
+                   sampling=_greedy(4))
+        eng.run_until_idle()
+    # age the sessions explicitly (submission order isn't enough: the
+    # engine bumps last_used at finish time too)
+    now = time.monotonic()
+    eng.sessions["cold"].last_used = now - 30
+    eng.sessions["cool"].last_used = now - 20
+    eng.sessions["warm"].last_used = now - 10
+    # force pressure: pretend the pool is nearly exhausted
+    eng.offload_low_wm = 1.1       # always under the low watermark
+    eng.offload_high_wm = eng.page_table.free_fraction + \
+        len(eng.page_table.pages_of("cold")) / eng.n_pages
+    eng._offload_sweep()
+    assert eng.offload_store.has("cold"), "coldest must offload first"
+    assert not eng.offload_store.has("warm")
+    # aggressive rung (ladder level 2) hibernates every cold session
+    eng.set_degradation(2)
+    eng._offload_sweep()
+    assert eng.offload_store.has("cool") and eng.offload_store.has("warm")
+    eng.set_degradation(None)
+    _drain(eng)
+
+
+def test_pool_exhaustion_prefers_offload_over_eviction(make_engine):
+    """_ensure_capacity_evicting tries hibernation (KV kept, memcpy
+    resume) before LRU eviction (KV dropped, re-prefill resume)."""
+    eng = make_engine(n_pages=24)       # 23 usable pages
+    eng.submit(list(range(1, 40)), session_id="a", sampling=_greedy(4))
+    eng.run_until_idle()
+    # a second long session can't fit alongside: admission pressure
+    # must hibernate "a" rather than evict it
+    eng.submit(list(range(1, 80)), session_id="b", sampling=_greedy(4))
+    eng.run_until_idle()
+    st = eng.stats()
+    assert st["offloads"] >= 1
+    assert st["evictions"] == 0, (
+        "offload must satisfy pressure before eviction drops KV"
+    )
+    assert eng.offload_store.has("a")
+    _drain(eng)
+
+
+# ---- offload_io fault fallbacks ----
+
+def test_offload_io_fault_fails_back_to_resident(make_engine):
+    eng = make_engine()
+    eng.submit(list(range(1, 20)), session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    free_before = eng.page_table.free_pages
+    faults.inject("offload_io", times=eng.fault_retries + 1)
+    assert not eng.offload_session("s")
+    # fail-back-to-resident: pages untouched, no half-written entry
+    assert eng.page_table.free_pages == free_before
+    assert eng.page_table.pages_of("s")
+    assert not eng.offload_store.has("s")
+    assert eng.stats()["offload_resident_fallbacks"] == 1
+    faults.clear()
+    # the session is still fully serviceable
+    t = eng.submit([5], session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length")
+    _drain(eng)
+
+
+def test_offload_io_transient_fault_is_retried_transparently(
+    make_engine,
+):
+    eng = make_engine()
+    eng.submit(list(range(1, 20)), session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    faults.inject("offload_io", times=1)     # within the retry budget
+    assert eng.offload_session("s")
+    assert eng.stats()["fault_retries"] >= 1
+    assert eng.offload_store.has("s")
+    _drain(eng)
+
+
+def test_offload_io_restore_fault_falls_back_to_reprefill(make_engine):
+    """A restore that outlives its retry budget re-prefills from the
+    history mirror — slower, but the greedy stream is unchanged and
+    nothing leaks."""
+    prompt = list(range(1, 20))
+    ctrl = make_engine(offload=False)
+    ctrl.submit(prompt, session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    c2 = ctrl.submit([9, 9], session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    _drain(ctrl)
+
+    eng = make_engine()
+    eng.submit(prompt, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_session("s")
+    faults.inject("offload_io", times=eng.fault_retries + 1)
+    t2 = eng.submit([9, 9], session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    st = eng.stats()
+    assert st["offload_reprefills"] >= 1
+    assert not eng.offload_store.has("s")
+    assert t2.new_tokens == c2.new_tokens, (
+        "re-prefill fallback changed the greedy stream"
+    )
+    _drain(eng)
+
+
+def test_dropped_entry_reprefills_from_history(make_engine):
+    """A session whose copy was dropped (disk-cap pressure) silently
+    rebuilds via re-prefill at its next turn — drops cost compute,
+    never correctness or liveness."""
+    eng = make_engine()
+    eng.submit(list(range(1, 20)), session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_session("s")
+    eng.offload_store.discard("s")       # simulate a cap drop
+    t = eng.submit([9, 9], session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length")
+    assert eng.stats()["offload_reprefills"] >= 1
+    _drain(eng)
+
+
+def test_tool_call_park_offloads_and_release_drops_copy(make_engine):
+    """Tool-call park semantics drive offload directly; releasing a
+    hibernated session drops its host/disk copy with its pages."""
+    eng = make_engine()
+    eng.submit(list(range(1, 20)), session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    sess = eng.sessions["s"]
+    sess.parked = True                   # as a </tool_call> stop does
+    assert eng.offload_session("s")
+    assert eng.offload_store.has("s")
+    eng.release_session("s")
+    assert not eng.offload_store.has("s")
+    assert "s" not in eng.sessions
+    _drain(eng)
+
+
+# ---- health surface ----
+
+def test_health_route_reports_offload_tiers(make_engine, monkeypatch):
+    import room_tpu.providers.tpu as tpu_mod
+    from room_tpu.server.router import RequestContext, Router
+    from room_tpu.server.routes import register_all_routes
+
+    eng = make_engine()
+    eng.submit(list(range(1, 20)), session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_session("s")
+
+    class FakeHost:
+        _engine = eng
+
+        @staticmethod
+        def is_healthy():
+            return True
+
+    monkeypatch.setattr(tpu_mod, "_hosts", {"tiny-moe-off": FakeHost()})
+    router = Router()
+    register_all_routes(router)
+    handler, params = router.match("GET", "/api/tpu/health")
+    out = handler(RequestContext(
+        method="GET", path="/api/tpu/health", params=params, query={},
+        body=None,
+    ))
+    row = out["data"]["engines"]["tiny-moe-off"]
+    assert row["offloads"] == 1
+    off = row["offload"]
+    assert off["host_entries"] + off["disk_entries"] == 1
+    assert "restore_ms_hist" in off and "host_bytes" in off
+    _drain(eng)
+
+
+# ---- quick chaos burst (CI chaos job) ----
+
+def test_offload_chaos_quick(make_engine):
+    """~6 s of multi-threaded park/offload/restore churn with
+    offload_io armed: no dropped turns, zero page leaks, store
+    drained. The >=35 s soak (offload + crashes) lives behind the
+    slow marker in test_chaos_serving.py."""
+    eng = make_engine(n_pages=64)
+    eng.offload_low_wm = 1.1             # every step sweeps...
+    eng.offload_high_wm = 1.2            # ...and never stops early
+    # warm the jit cache (CPU compiles would eat the whole window)
+    warm = []
+    for sid in ("w0", "w1", "w2"):
+        warm.append(eng.submit([1, 2, 3], session_id=sid,
+                               sampling=_greedy(4)))
+        eng.run_until_idle()
+        warm.append(eng.submit([4, 5], session_id=sid,
+                               sampling=_greedy(4)))
+        eng.run_until_idle()
+        eng.release_session(sid)
+
+    faults.inject("offload_io", probability=0.15, seed=11)
+    stop = threading.Event()
+    loop = threading.Thread(
+        target=eng.serve_forever, args=(stop,), daemon=True
+    )
+    loop.start()
+    errors: list[str] = []
+    deadline = time.monotonic() + 6
+
+    def worker(widx):
+        sid = f"off-w{widx}"
+        i = 0
+        while time.monotonic() < deadline:
+            i += 1
+            t = eng.submit([widx + 1, i % 40 + 1], session_id=sid,
+                           sampling=_greedy(4))
+            if not t.done.wait(60):
+                errors.append(f"worker {widx} hung")
+                return
+            if i % 5 == 0:
+                eng.release_session(sid)
+
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(3)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+        assert not th.is_alive(), "offload chaos thread wedged"
+    assert not errors, errors
+    faults.clear()
+    drain_deadline = time.monotonic() + 60
+    while (eng.stats()["active_slots"] or eng.stats()["queued"]) and \
+            time.monotonic() < drain_deadline:
+        time.sleep(0.05)
+    stop.set()
+    loop.join(10)
+    st = eng.stats()
+    assert st["offloads"] > 0, "chaos burst never exercised offload"
+    _drain(eng)

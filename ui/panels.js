@@ -1413,8 +1413,13 @@ async function renderTpu(el) {
   ]);
   const st = status.data || {};
   const hl = health.data || {};
-  const DEGRADE_LABELS = ["healthy", "spec off", "batch shrunk",
-                          "shedding"];
+  const DEGRADE_LABELS = ["healthy", "spec off", "offloading",
+                          "batch shrunk", "shedding"];
+  const mb = (b) => b == null ? "" : `${(b / 1048576).toFixed(1)}MB`;
+  const histStr = (h) => Object.entries(h || {})
+    .filter(([k, n]) => n > 0)
+    .map(([k, n]) => `${k.replace("le_", "≤").replace("gt_", ">")}:${n}`)
+    .join(" ") || "—";
   const healthPill = (e) => {
     if (e.healthy === false)
       return '<span class="pill failed">crash loop</span>';
@@ -1462,6 +1467,25 @@ async function renderTpu(el) {
         <td>${e.deadline_timeouts ?? 0}</td>
         <td>${e.fault_retries ?? 0}</td></tr>`).join("") ||
         '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
+      </table>
+      <h2 style="margin-top:.6rem">kv offload</h2>
+      <table><tr><th>engine</th><th>host tier</th><th>disk tier</th>
+        <th>out</th><th>in</th><th>prefetch</th><th>fallbacks</th>
+        <th>restore latency</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.offload)
+        .map(([name, e]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${e.offload.host_entries} · ${mb(e.offload.host_bytes)}</td>
+        <td>${e.offload.disk_entries} · ${mb(e.offload.disk_bytes)}</td>
+        <td>${e.offloads ?? 0}</td>
+        <td>${e.offload_restores ?? 0}</td>
+        <td>${e.offload_prefetches ?? 0}</td>
+        <td>${(e.offload_resident_fallbacks ?? 0) +
+              (e.offload_reprefills ?? 0)}</td>
+        <td class="dim">${esc(histStr(e.offload.restore_ms_hist))}</td>
+        </tr>`).join("") ||
+        '<tr><td class="dim" colspan="8">offload disabled / no engines warm</td></tr>'}
       </table>
       ${Object.keys(hl.faults || {}).length
         ? `<div class="dim" style="margin-top:.4rem">armed faults: ${
