@@ -13,8 +13,10 @@ execution path.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Optional
@@ -26,6 +28,7 @@ from ..providers import (
 from . import (
     escalations as escalations_mod,
     goals as goals_mod,
+    journal as journal_mod,
     memory as memory_mod,
     messages as messages_mod,
     quorum as quorum_mod,
@@ -48,6 +51,20 @@ from .rate_limit import clamp_wait
 
 WIP_MOMENTUM_GAP_S = 10.0
 STUCK_CYCLE_WINDOW = 5
+CYCLE_ERROR_GAP_S = 30.0  # backoff after an unexpected cycle error
+
+# Loop-thread supervision (docs/swarm_recovery.md), mirroring the
+# engine's crash budget: a dead/hung loop is restarted until more than
+# LOOP_RESTART_BUDGET strikes land inside LOOP_RESTART_WINDOW_S, then
+# the worker is marked unhealthy and keeper-escalated. A loop counts as
+# hung when it has been inside one cycle (state == "running") longer
+# than LOOP_HANG_S without a heartbeat.
+LOOP_RESTART_BUDGET = int(os.environ.get("ROOM_TPU_LOOP_MAX_RESTARTS",
+                                         "3"))
+LOOP_RESTART_WINDOW_S = float(
+    os.environ.get("ROOM_TPU_LOOP_RESTART_WINDOW_S", "300")
+)
+LOOP_HANG_S = float(os.environ.get("ROOM_TPU_LOOP_HANG_S", "1800"))
 
 # execution-plane tools: fine for workers, a logged deviation when the
 # queen runs them herself instead of delegating
@@ -62,11 +79,39 @@ class LoopHandle:
     stop: threading.Event = field(default_factory=threading.Event)
     wake: threading.Event = field(default_factory=threading.Event)
     state: str = "idle"
+    # supervision telemetry: last iteration heartbeat (monotonic), the
+    # deadline by which the loop promises its next heartbeat (stalls
+    # ANYWHERE in the iteration — db fetch, cycle, state write — blow
+    # past it; sleeps extend it by their own duration first), and the
+    # error that killed the thread, if it crashed
+    beat: float = field(default_factory=time.monotonic)
+    expect_by: float = field(
+        default_factory=lambda: time.monotonic() + LOOP_HANG_S
+    )
+    crash_error: Optional[str] = None
 
 
 _running_loops: dict[int, LoopHandle] = {}
 _launched_rooms: set[int] = set()
 _registry_lock = threading.Lock()
+
+# crash-strike history + unhealthy roster for supervise_loops
+_supervision_lock = threading.Lock()
+_strikes: dict[int, deque] = {}
+_unhealthy: dict[int, dict] = {}
+_supervision_counts = {"restarts": 0, "hang_replacements": 0,
+                       "crashes": 0, "budget_exhausted": 0}
+
+
+def _incr(name: str, n: int = 1) -> None:
+    from .telemetry import incr_counter
+
+    incr_counter(name, n)
+
+
+def _owns_registry_entry(handle: LoopHandle) -> bool:
+    with _registry_lock:
+        return _running_loops.get(handle.worker_id) is handle
 
 
 # ---- lifecycle ----
@@ -92,10 +137,63 @@ def running_workers() -> list[int]:
         ]
 
 
+def _locked_out_handle(worker_id: int, room_id: int) -> LoopHandle:
+    """Inert handle for a worker past its restart budget: no thread is
+    started and nothing is registered — only a keeper room restart
+    (reset_supervision) revives the worker."""
+    handle = LoopHandle(worker_id=worker_id, room_id=room_id)
+    handle.stop.set()
+    handle.state = "unhealthy"
+    return handle
+
+
 def start_agent_loop(
     db: Database, room_id: int, worker_id: int
 ) -> LoopHandle:
+    with _supervision_lock:
+        locked_out = worker_id in _unhealthy
+    if locked_out:
+        return _locked_out_handle(worker_id, room_id)
     with _registry_lock:
+        existing = _running_loops.get(worker_id)
+        if (
+            existing
+            and existing.thread
+            and existing.thread.is_alive()
+            and not existing.stop.is_set()
+        ):
+            existing.wake.set()
+            return existing
+        crashed_corpse = (
+            existing is not None
+            and existing.thread is not None
+            and not existing.thread.is_alive()
+            and not existing.stop.is_set()
+        )
+    if crashed_corpse:
+        # a crashed loop must pass through supervision — journal
+        # recovery, strike accounting, the unhealthy lockout — before
+        # any replacement runs. Wake paths (inbox poll, webhooks,
+        # delegation) used to replace the corpse silently, bypassing
+        # all three.
+        supervise_loops(db)
+        with _registry_lock:
+            replacement = _running_loops.get(worker_id)
+        if replacement is not None:
+            return replacement
+        with _supervision_lock:
+            if worker_id in _unhealthy:
+                return _locked_out_handle(worker_id, room_id)
+        # supervision declined to restart (room stopped/gone): fall
+        # through and let the normal path re-check the room state
+    with _registry_lock:
+        # re-check under the lock: between the first check and here a
+        # concurrent wake path may have registered a live loop (two
+        # threads for one worker would cycle unsupervised forever), or
+        # supervision may have locked the worker out
+        with _supervision_lock:
+            if worker_id in _unhealthy:
+                return _locked_out_handle(worker_id, room_id)
         existing = _running_loops.get(worker_id)
         if (
             existing
@@ -110,7 +208,7 @@ def start_agent_loop(
         handle = LoopHandle(worker_id=worker_id, room_id=room_id)
         _running_loops[worker_id] = handle
     handle.thread = threading.Thread(
-        target=_loop, args=(db, handle), daemon=True,
+        target=_loop_main, args=(db, handle), daemon=True,
         name=f"agent-loop-{worker_id}",
     )
     handle.thread.start()
@@ -167,17 +265,204 @@ def stop_room_loops(db: Database, room_id: int, reason: str = "") -> int:
     return n
 
 
+# ---- loop-thread supervision (docs/swarm_recovery.md) ----
+
+def supervise_loops(db: Database) -> dict:
+    """Detect dead or hung loop threads and restart them under the
+    restart budget; past budget, mark the worker unhealthy and escalate
+    to the keeper. Called from the server runtime's supervision tick
+    (and directly by chaos tests). Returns a summary of actions taken.
+
+    Mirrors the engine's crash supervision: strikes inside
+    LOOP_RESTART_WINDOW_S count against LOOP_RESTART_BUDGET; a budget
+    breach is terminal until the keeper restarts the room (which resets
+    the budget via reset_supervision)."""
+    actions = {"restarted": [], "replaced_hung": [], "unhealthy": []}
+    now = time.monotonic()
+    with _registry_lock:
+        snapshot = list(_running_loops.values())
+    for h in snapshot:
+        if h.thread is None:
+            continue
+        dead = not h.thread.is_alive()
+        # a loop is hung when it blew past its own promised-heartbeat
+        # deadline — covers stalls anywhere in the iteration (db fetch,
+        # cycle, state write), not just inside run_cycle; sleeping
+        # loops extend expect_by before waiting, so they never trip it
+        hung = (
+            not dead
+            and not h.stop.is_set()
+            and now > h.expect_by
+        )
+        if h.stop.is_set():
+            if dead:
+                # crashed mid-shutdown: just drop the stale entry
+                with _registry_lock:
+                    if _running_loops.get(h.worker_id) is h:
+                        del _running_loops[h.worker_id]
+            continue
+        if not dead and not hung:
+            continue
+
+        # a dead-or-hung loop whose room is gone/stopped needs no
+        # restart — clear the corpse and move on
+        try:
+            worker = workers_mod.get_worker(db, h.worker_id)
+            room = rooms_mod.get_room(db, h.room_id)
+        except Exception:
+            continue  # db unavailable; retry next tick
+        with _registry_lock:
+            # claim the corpse exactly once: the supervision tick and a
+            # wake-path start_agent_loop may both be supervising
+            already_claimed = h.stop.is_set()
+            h.stop.set()
+            if _running_loops.get(h.worker_id) is h:
+                del _running_loops[h.worker_id]
+        h.wake.set()
+        if already_claimed:
+            continue
+        if dead:
+            # resolve the dead loop's interrupted cycle and arm replay
+            # protection BEFORE any replacement runs — the exactly-once
+            # guarantee must hold across a supervised in-process
+            # restart, not just a full process restart. (Hung threads
+            # are excluded: they may still complete their cycle.)
+            try:
+                journal_mod.recover(db, worker_id=h.worker_id)
+            except Exception:
+                pass  # db unavailable; startup recovery will catch it
+        if (
+            worker is None or room is None
+            or room["status"] != "active"
+            or not is_room_launched(h.room_id)
+        ):
+            continue
+
+        with _supervision_lock:
+            strikes = _strikes.setdefault(h.worker_id, deque(maxlen=32))
+            strikes.append(now)
+            recent = sum(
+                1 for t in strikes if now - t < LOOP_RESTART_WINDOW_S
+            )
+        if recent > LOOP_RESTART_BUDGET:
+            detail = h.crash_error or (
+                f"hung for >{LOOP_HANG_S:g}s" if hung else "thread died"
+            )
+            with _supervision_lock:
+                _supervision_counts["budget_exhausted"] += 1
+                _unhealthy[h.worker_id] = {
+                    "room_id": h.room_id,
+                    "error": detail,
+                    "strikes": recent,
+                    "at": utc_now(),
+                }
+            _incr("loop.budget_exhausted")
+            # close the race with a wake path that slipped a fresh loop
+            # in between the corpse claim and the lockout insertion
+            # above: anything registered for this worker now dies
+            with _registry_lock:
+                raced = _running_loops.pop(h.worker_id, None)
+            if raced is not None:
+                raced.stop.set()
+                raced.wake.set()
+            try:
+                workers_mod.set_agent_state(db, h.worker_id, "unhealthy")
+                escalations_mod.create_escalation(
+                    db, h.room_id,
+                    f"Worker #{h.worker_id} ({worker['name']}) agent "
+                    f"loop failed {recent} times inside "
+                    f"{LOOP_RESTART_WINDOW_S:g}s (last: {detail}). "
+                    "Loop stopped past its restart budget — investigate "
+                    "and restart the room to re-arm it.",
+                    from_agent_id=h.worker_id,
+                )
+            except Exception:
+                pass  # escalation is best-effort under db chaos
+            event_bus.emit(
+                "loop:unhealthy", f"room:{h.room_id}",
+                {"worker_id": h.worker_id, "error": detail},
+            )
+            actions["unhealthy"].append(h.worker_id)
+            continue
+
+        start_agent_loop(db, h.room_id, h.worker_id)
+        with _supervision_lock:
+            _supervision_counts["restarts"] += 1
+            if hung:
+                _supervision_counts["hang_replacements"] += 1
+        _incr("loop.restarts")
+        if hung:
+            _incr("loop.hang_replacements")
+        event_bus.emit(
+            "loop:restarted", f"room:{h.room_id}",
+            {"worker_id": h.worker_id, "hung": hung,
+             "error": h.crash_error},
+        )
+        (actions["replaced_hung"] if hung
+         else actions["restarted"]).append(h.worker_id)
+    return actions
+
+
+def reset_supervision(worker_ids) -> None:
+    """Forget crash strikes and unhealthy status for these workers —
+    called when the keeper restarts a room, so a deliberate restart
+    re-arms the full budget."""
+    with _supervision_lock:
+        for wid in worker_ids:
+            _strikes.pop(wid, None)
+            _unhealthy.pop(wid, None)
+
+
+def supervision_snapshot() -> dict:
+    """Swarm-loop health for /api/tpu/health and the TPU panel."""
+    with _registry_lock:
+        alive = sum(
+            1 for h in _running_loops.values()
+            if h.thread is not None and h.thread.is_alive()
+        )
+    with _supervision_lock:
+        return {
+            "loops_alive": alive,
+            "unhealthy_workers": {
+                str(k): dict(v) for k, v in _unhealthy.items()
+            },
+            **dict(_supervision_counts),
+        }
+
+
 # ---- the loop ----
+
+def _loop_main(db: Database, handle: LoopHandle) -> None:
+    """Thread target: run the loop, and on an escaped exception leave
+    the registry entry in place with the crash recorded, so
+    supervise_loops can find the corpse and restart under budget (a
+    dead thread silently unregistering itself is exactly the failure
+    mode this PR removes)."""
+    try:
+        _loop(db, handle)
+    except Exception as e:
+        handle.crash_error = f"{type(e).__name__}: {e}"
+        handle.state = "crashed"
+        with _supervision_lock:
+            _supervision_counts["crashes"] += 1
+        _incr("loop.crashes")
+        event_bus.emit(
+            "loop:crashed", f"room:{handle.room_id}",
+            {"worker_id": handle.worker_id, "error": handle.crash_error},
+        )
+
 
 def _loop(db: Database, handle: LoopHandle) -> None:
     import sqlite3
 
     while not handle.stop.is_set():
+        handle.beat = time.monotonic()
+        handle.expect_by = handle.beat + LOOP_HANG_S
         try:
             worker = workers_mod.get_worker(db, handle.worker_id)
             room = rooms_mod.get_room(db, handle.room_id)
         except sqlite3.ProgrammingError:
-            return  # database closed underneath us: shutdown in progress
+            break  # database closed underneath us: shutdown in progress
         if worker is None or room is None:
             break
         if room["status"] != "active" or not is_room_launched(room["id"]):
@@ -185,12 +470,15 @@ def _loop(db: Database, handle: LoopHandle) -> None:
 
         if _in_quiet_hours(room):
             handle.state = "waiting"
-            workers_mod.set_agent_state(db, worker["id"], "waiting")
+            if _owns_registry_entry(handle):
+                workers_mod.set_agent_state(db, worker["id"], "waiting")
+            handle.expect_by = time.monotonic() + 60 + LOOP_HANG_S
             if handle.wake.wait(timeout=60):
                 handle.wake.clear()
             continue
 
         handle.state = "running"
+        journal_mod.chaos_delay("loop_hang")
         rate_limited = False
         try:
             run_cycle(db, room, worker)
@@ -199,30 +487,43 @@ def _loop(db: Database, handle: LoopHandle) -> None:
             rate_limited = True
             gap_s = clamp_wait(e.wait_s)
         except Exception as e:
+            if getattr(e, "transient", True) is False:
+                # a non-transient fault models a real crash escaping
+                # the cycle handler: propagate so the thread dies and
+                # supervision (not this handler) owns recovery
+                raise
             event_bus.emit(
                 "cycle:error", f"room:{room['id']}",
                 {"worker_id": worker["id"], "error": str(e)},
             )
-            gap_s = 30.0
+            gap_s = CYCLE_ERROR_GAP_S
 
-        # the wait state stays observable for the whole backoff window
+        # the wait state stays observable for the whole backoff window;
+        # a loop supervision already replaced (hang) must not clobber
+        # its successor's — or an unhealthy worker's — db state
         state = "rate_limited" if rate_limited else "idle"
         handle.state = state
         try:
-            workers_mod.set_agent_state(db, handle.worker_id, state)
+            if _owns_registry_entry(handle):
+                workers_mod.set_agent_state(db, handle.worker_id, state)
         except sqlite3.ProgrammingError:
-            return
+            break
+        handle.expect_by = time.monotonic() + gap_s + LOOP_HANG_S
         if handle.wake.wait(timeout=gap_s):
             handle.wake.clear()
 
     handle.state = "stopped"
-    try:
-        workers_mod.set_agent_state(db, handle.worker_id, "stopped")
-    except sqlite3.ProgrammingError:
-        pass  # database already closed during shutdown
+    # a hung loop that supervision already replaced must not clobber
+    # its successor's registry entry or the worker's agent_state
     with _registry_lock:
-        if _running_loops.get(handle.worker_id) is handle:
+        own = _running_loops.get(handle.worker_id) is handle
+        if own:
             del _running_loops[handle.worker_id]
+    if own:
+        try:
+            workers_mod.set_agent_state(db, handle.worker_id, "stopped")
+        except Exception:
+            pass  # database already closed during shutdown
 
 
 def _cycle_gap_s(db: Database, room: dict, worker: dict) -> float:
@@ -256,17 +557,28 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
     is_queen = worker["id"] == room["queen_worker_id"]
     model = worker["model"] or room["worker_model"]
 
-    cycle_id = db.insert(
-        "INSERT INTO worker_cycles(worker_id, room_id, model) "
-        "VALUES (?,?,?)",
-        (worker["id"], room["id"], model),
-    )
+    # the cycle row and its journal entry commit atomically: a crash
+    # between them would leave a 'running' row recovery can never find
+    with db.transaction():
+        cycle_id = db.insert(
+            "INSERT INTO worker_cycles(worker_id, room_id, model) "
+            "VALUES (?,?,?)",
+            (worker["id"], room["id"], model),
+        )
+        journal_mod.record_started(
+            db, "cycle", cycle_id, room["id"], worker["id"]
+        )
     logs = CycleLogBuffer(db, cycle_id)
     event_bus.emit(
         "cycle:started", f"room:{room['id']}",
         {"cycle_id": cycle_id, "worker_id": worker["id"]},
     )
     started = time.monotonic()
+
+    # cycle_crash fires BEFORE the error handler exists: the cycle row
+    # stays 'running' and the journal entry open, exactly like a real
+    # crash — only journal recovery can resolve it to a terminal state
+    journal_mod.chaos("cycle_crash")
 
     try:
         provider = get_model_provider(model, db)
@@ -299,11 +611,27 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
                     "delegating",
                     actor_id=worker["id"], is_public=False,
                 )
-            out = execute_queen_tool(db, room["id"], worker["id"], name,
-                                     args)
+            if name in journal_mod.JOURNALED_TOOLS:
+                # externally-visible side effects run under journal
+                # protection: a retry after crash recovery skips the
+                # ones that already committed
+                out = journal_mod.run_journaled_effect(
+                    db, "cycle", cycle_id, room["id"], worker["id"],
+                    name, args,
+                    lambda: execute_queen_tool(
+                        db, room["id"], worker["id"], name, args
+                    ),
+                )
+            else:
+                out = execute_queen_tool(db, room["id"], worker["id"],
+                                         name, args)
             logs.append("tool_result", out[:2000])
             return out
 
+        call_key = f"cycle:{cycle_id}:w{worker['id']}"
+        journal_mod.record_provider_call(
+            db, "cycle", cycle_id, call_key, room["id"], worker["id"]
+        )
         result = provider.execute(ExecutionRequest(
             prompt=prompt,
             system_prompt=worker["system_prompt"],
@@ -314,6 +642,7 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
             session_id=session_id,
             messages=messages,
             on_text=lambda t: logs.append("assistant", t[:4000]),
+            idempotency_key=call_key,
         ))
 
         if not result.success and result.error:
@@ -340,6 +669,7 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
                 result.input_tokens, result.output_tokens, cycle_id,
             ),
         )
+        journal_mod.record_finished(db, "cycle", cycle_id)
         _prune_old_cycles(db, room["id"])
         event_bus.emit(
             "cycle:finished", f"room:{room['id']}",
@@ -360,6 +690,9 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
             (utc_now(), str(e),
              int((time.monotonic() - started) * 1000), cycle_id),
         )
+        # a clean failure closes its own journal; if the db is already
+        # gone the entry stays open and startup recovery resolves it
+        journal_mod.record_finished(db, "cycle", cycle_id)
         raise
     finally:
         logs.close()

@@ -1,0 +1,438 @@
+"""Durable cycle journal: crash-safe intent records for the swarm
+runtime (docs/swarm_recovery.md).
+
+The serving layer survives failure (docs/chaos.md); this module gives
+the swarm runtime above it the same property. Every agent cycle and
+task run appends intent records to the ``cycle_journal`` table —
+*started*, *provider_call* (with an idempotency key), *effect*
+intent/commit around journaled tool side effects, and a close on
+finish. Work interrupted by a crash leaves its entries open; startup
+recovery (:func:`recover`) scans them and immediately fails/requeues
+the ref rows — replacing the 120-minute stale sweep for crash cases —
+while flagging committed side effects so a retried cycle never fires
+the same wallet tx, message send, or self-mod twice.
+
+Entry lifecycle::
+
+    started / provider_call:  open -> closed            (clean finish)
+                              open -> recovered         (crash recovery)
+    effect:                   intent -> committed        (ran cleanly)
+                              intent -> abandoned        (never committed:
+                                                          replay RE-RUNS it)
+                              committed -> replay_skip   (recovery: parent
+                                                          was interrupted)
+                              replay_skip -> consumed    (a retry skipped it
+                                                          and reused the
+                                                          recorded result)
+
+Idempotency keys are content-derived (kind + actor + tool + canonical
+args), so the retried incarnation of interrupted work — a brand-new
+cycle/run row — still matches the committed effects of its dead
+predecessor. ``replay_skip`` matches are bounded by
+``ROOM_TPU_REPLAY_WINDOW_S`` so a legitimate repeat of the same action
+next week executes normally.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from typing import Callable, Optional
+
+from ..db import Database, utc_now
+
+# tables a journal kind refers into (ref_id -> <table>.id)
+KIND_TABLE = {"cycle": "worker_cycles", "task_run": "task_runs"}
+
+# Tool side effects that are externally visible or irreversible enough
+# to warrant exactly-once-on-replay protection. Everything else
+# (save_wip, recall, web_fetch, ...) is idempotent or harmless to
+# repeat and stays un-journaled.
+JOURNALED_TOOLS = frozenset({
+    "send_message", "escalate_to_keeper", "announce_decision",
+    "create_worker", "create_skill",
+})
+
+# how long a recovery-flagged effect stays skippable (seconds)
+REPLAY_WINDOW_S = float(os.environ.get("ROOM_TPU_REPLAY_WINDOW_S",
+                                       "21600"))
+# queen_tools.execute_queen_tool's error convention: tool failures come
+# back as strings with this prefix, never as exceptions
+TOOL_ERROR_PREFIX = "tool error:"
+# terminal journal rows older than this are pruned (hours)
+PRUNE_AFTER_H = float(os.environ.get("ROOM_TPU_JOURNAL_PRUNE_H", "72"))
+
+_TERMINAL = ("closed", "recovered", "committed", "consumed",
+             "abandoned")
+
+
+def _incr(name: str, n: int = 1) -> None:
+    from .telemetry import incr_counter
+
+    incr_counter(name, n)
+
+
+def chaos(point: str) -> None:
+    """Swarm-layer chaos fault point, resolved through sys.modules like
+    the db layer's: no serving import unless the fault registry is
+    already loaded (in which case arming was possible at all). The
+    agent loop and task runner call this for ``cycle_crash`` /
+    ``loop_hang``; this module calls it for ``tool_exec``."""
+    faults = sys.modules.get("room_tpu.serving.faults")
+    if faults is not None and faults.is_armed():
+        faults.maybe_fail(point)
+
+
+def chaos_delay(point: str) -> float:
+    """Latency-style fault point (``loop_hang``): sleeps the armed
+    spec's latency, returns seconds slept."""
+    faults = sys.modules.get("room_tpu.serving.faults")
+    if faults is not None and faults.is_armed():
+        return faults.maybe_delay(point)
+    return 0.0
+
+
+def effect_key(kind: str, actor_id: Optional[int], name: str,
+               args: dict) -> str:
+    """Content-derived idempotency key: stable across the crash/retry
+    boundary (the retry is a different cycle row, same logical act)."""
+    canon = json.dumps(args, sort_keys=True, separators=(",", ":"),
+                       default=str)
+    digest = hashlib.sha256(
+        f"{kind}:{actor_id}:{name}:{canon}".encode()
+    ).hexdigest()[:24]
+    return f"{name}:{digest}"
+
+
+# ---- append paths (hot: one insert each) ----
+
+def record_started(
+    db: Database, kind: str, ref_id: int,
+    room_id: Optional[int] = None, worker_id: Optional[int] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO cycle_journal(kind, ref_id, room_id, worker_id, "
+        "entry, status) VALUES (?,?,?,?,'started','open')",
+        (kind, ref_id, room_id, worker_id),
+    )
+
+
+def record_provider_call(
+    db: Database, kind: str, ref_id: int, idem_key: str,
+    room_id: Optional[int] = None, worker_id: Optional[int] = None,
+) -> int:
+    return db.insert(
+        "INSERT INTO cycle_journal(kind, ref_id, room_id, worker_id, "
+        "entry, status, idem_key) VALUES "
+        "(?,?,?,?,'provider_call','open',?)",
+        (kind, ref_id, room_id, worker_id, idem_key),
+    )
+
+
+def record_finished(db: Database, kind: str, ref_id: int) -> None:
+    """Close the ref's open bookkeeping on any clean finish (success,
+    error, cancel). Dangling effect intents — the tool never committed
+    because the cycle failed mid-call — are marked abandoned so a
+    retry re-runs them (at-least-once for uncommitted effects)."""
+    now = utc_now()
+    # intents first, the 'started' entry last: a crash between the two
+    # statements leaves the ref discoverable by recovery either way
+    db.execute(
+        "UPDATE cycle_journal SET status='abandoned', updated_at=? "
+        "WHERE kind=? AND ref_id=? AND entry='effect' AND "
+        "status='intent'",
+        (now, kind, ref_id),
+    )
+    db.execute(
+        "UPDATE cycle_journal SET status='closed', updated_at=? "
+        "WHERE kind=? AND ref_id=? AND "
+        "entry IN ('started','provider_call') AND status='open'",
+        (now, kind, ref_id),
+    )
+
+
+# ---- journaled side effects ----
+
+def run_journaled_effect(
+    db: Database,
+    kind: str,
+    ref_id: int,
+    room_id: Optional[int],
+    actor_id: Optional[int],
+    name: str,
+    args: dict,
+    fn: Callable[[], str],
+) -> str:
+    """Execute a side-effecting tool under journal protection: intent
+    before, commit after. If crash recovery flagged a committed entry
+    with the same idempotency key (the effect already fired in an
+    interrupted predecessor), skip execution and return the recorded
+    result instead — the replay never double-fires."""
+    key = effect_key(kind, actor_id, name, args)
+    cutoff = f"-{int(REPLAY_WINDOW_S)} seconds"
+    # windowed on updated_at — recovery stamps it when flagging
+    # replay_skip — so the skip survives an outage of ANY length and
+    # the window runs from the restart, not the original execution
+    prior = db.query_one(
+        "SELECT * FROM cycle_journal WHERE entry='effect' AND "
+        "idem_key=? AND status='replay_skip' AND updated_at > "
+        "strftime('%Y-%m-%dT%H:%M:%fZ','now', ?) "
+        "ORDER BY id DESC LIMIT 1",
+        (key, cutoff),
+    )
+    if prior is not None:
+        payload = json.loads(prior["payload"] or "{}")
+        result = payload.get(
+            "result", f"[recovered] {name} already executed before the "
+            "crash; not re-fired"
+        )
+        # consume the old marker AND record a committed marker on the
+        # consuming ref, atomically: if THIS retry also crashes after
+        # the skip point, recovery flags the new marker replay_skip and
+        # the next retry skips again — the protection chains through
+        # any number of crash/retry rounds
+        with db.transaction():
+            db.execute(
+                "UPDATE cycle_journal SET status='consumed', "
+                "updated_at=? WHERE id=?",
+                (utc_now(), prior["id"]),
+            )
+            db.insert(
+                "INSERT INTO cycle_journal(kind, ref_id, room_id, "
+                "worker_id, entry, status, idem_key, payload) VALUES "
+                "(?,?,?,?,'effect','committed',?,?)",
+                (kind, ref_id, room_id, actor_id, key,
+                 json.dumps({"tool": name, "args": args,
+                             "result": result,
+                             "replayed_from": prior["id"]},
+                            default=str)),
+            )
+        _incr("journal.effects_skipped")
+        return result
+
+    if kind == "cycle":
+        # a committed entry with this key from ANOTHER still-running
+        # cycle of the same worker means the act already fired in a
+        # predecessor that never reached terminal state — an
+        # un-recovered in-process crash orphan, or the hung twin a
+        # supervision hang-replacement left behind. Skip without
+        # consuming (the owner's recovery settles its entry); record a
+        # committed marker on this ref so the protection chains.
+        live = db.query_one(
+            "SELECT j.payload FROM cycle_journal j "
+            "JOIN worker_cycles c ON c.id = j.ref_id "
+            "WHERE j.entry='effect' AND j.status='committed' AND "
+            "j.kind='cycle' AND j.idem_key=? AND j.worker_id=? AND "
+            "j.ref_id != ? AND c.status='running' AND j.updated_at > "
+            "strftime('%Y-%m-%dT%H:%M:%fZ','now', ?) "
+            "ORDER BY j.id DESC LIMIT 1",
+            (key, actor_id, ref_id, cutoff),
+        )
+        if live is not None:
+            payload = json.loads(live["payload"] or "{}")
+            result = payload.get(
+                "result", f"[recovered] {name} already executed by an "
+                "interrupted predecessor; not re-fired"
+            )
+            db.insert(
+                "INSERT INTO cycle_journal(kind, ref_id, room_id, "
+                "worker_id, entry, status, idem_key, payload) VALUES "
+                "(?,?,?,?,'effect','committed',?,?)",
+                (kind, ref_id, room_id, actor_id, key,
+                 json.dumps({"tool": name, "args": args,
+                             "result": result, "live_skip": True},
+                            default=str)),
+            )
+            _incr("journal.effects_skipped")
+            return result
+
+    entry_id = db.insert(
+        "INSERT INTO cycle_journal(kind, ref_id, room_id, worker_id, "
+        "entry, status, idem_key, payload) VALUES "
+        "(?,?,?,?,'effect','intent',?,?)",
+        (kind, ref_id, room_id, actor_id, key,
+         json.dumps({"tool": name, "args": args}, default=str)),
+    )
+    chaos("tool_exec")
+    # journaled tools are db-only: effect AND its committed marker land
+    # in ONE transaction, so every crash leaves exactly two possible
+    # states — intent (nothing applied; replay re-runs) or committed
+    # (fully applied; replay skips). No partial apply, no applied-but-
+    # unmarked window.
+    with db.transaction():
+        out = fn()
+        # execute_queen_tool converts tool exceptions into a
+        # "tool error: ..." string instead of raising — that is a
+        # FAILED effect, and committing it would make replay suppress
+        # a retry of something that never happened
+        failed = (out or "").startswith(TOOL_ERROR_PREFIX)
+        db.execute(
+            "UPDATE cycle_journal SET status=?, payload=?, "
+            "updated_at=? WHERE id=?",
+            ("abandoned" if failed else "committed",
+             json.dumps({"tool": name, "args": args,
+                         "result": (out or "")[:2000]}, default=str),
+             utc_now(), entry_id),
+        )
+    return out
+
+
+# ---- startup recovery ----
+
+def recover(db: Database, worker_id: Optional[int] = None) -> dict:
+    """Scan open journal entries and resolve every crash-interrupted
+    ref to a terminal state *now* (not 120 minutes from now):
+
+    - cycles / task runs still ``running`` are failed with an explicit
+      recovery message; interrupted ``once`` tasks stay active, so the
+      scheduler immediately requeues them (archiving only happens in a
+      clean ``_finish_run``);
+    - their committed effects become ``replay_skip`` (never re-fired),
+      their un-committed intents ``abandoned`` (re-run on retry);
+    - entries whose ref already reached a terminal state (the crash hit
+      after the status update but before the journal close) are closed
+      quietly.
+
+    With ``worker_id`` the scan is scoped to that worker's refs — the
+    supervised in-process restart path (agent_loop.supervise_loops)
+    uses this so a crashed loop's interrupted cycle is resolved and its
+    committed effects are replay-protected *before* the replacement
+    loop runs, not at the next full process restart. Scoped runs skip
+    the orphan-intent catch-all: other workers' intents are live.
+    """
+    summary = {"cycles": 0, "task_runs": 0, "effects_flagged": 0,
+               "closed": 0}
+    if worker_id is None:
+        open_rows = db.query(
+            "SELECT DISTINCT kind, ref_id FROM cycle_journal WHERE "
+            "entry IN ('started','provider_call') AND status='open' "
+            "ORDER BY ref_id",
+        )
+    else:
+        # cycles only: task runs execute on their own threads and are
+        # not interrupted by a loop-thread death
+        open_rows = db.query(
+            "SELECT DISTINCT kind, ref_id FROM cycle_journal WHERE "
+            "entry IN ('started','provider_call') AND status='open' "
+            "AND worker_id=? AND kind='cycle' ORDER BY ref_id",
+            (worker_id,),
+        )
+    now = utc_now()
+    for row in open_rows:
+        kind, ref_id = row["kind"], row["ref_id"]
+        table = KIND_TABLE[kind]
+        with db.transaction():
+            ref = db.query_one(
+                f"SELECT id, status FROM {table} WHERE id=?", (ref_id,)
+            )
+            if ref is not None and ref["status"] == "running":
+                db.execute(
+                    f"UPDATE {table} SET status='error', "
+                    "error_message='recovered: interrupted by crash', "
+                    "finished_at=? WHERE id=?",
+                    (now, ref_id),
+                )
+                flagged = db.execute(
+                    "UPDATE cycle_journal SET status='replay_skip', "
+                    "updated_at=? WHERE kind=? AND ref_id=? AND "
+                    "entry='effect' AND status='committed'",
+                    (now, kind, ref_id),
+                ).rowcount
+                db.execute(
+                    "UPDATE cycle_journal SET status='abandoned', "
+                    "updated_at=? WHERE kind=? AND ref_id=? AND "
+                    "entry='effect' AND status='intent'",
+                    (now, kind, ref_id),
+                )
+                db.execute(
+                    "UPDATE cycle_journal SET status='recovered', "
+                    "updated_at=? WHERE kind=? AND ref_id=? AND "
+                    "entry IN ('started','provider_call') AND "
+                    "status='open'",
+                    (now, kind, ref_id),
+                )
+                summary["effects_flagged"] += flagged
+                if kind == "cycle":
+                    summary["cycles"] += 1
+                    _incr("journal.recovered_cycles")
+                else:
+                    summary["task_runs"] += 1
+                    _incr("journal.recovered_runs")
+            else:
+                # ref finished (or was deleted) but the journal close
+                # was lost: pure bookkeeping
+                db.execute(
+                    "UPDATE cycle_journal SET status='closed', "
+                    "updated_at=? WHERE kind=? AND ref_id=? AND "
+                    "status IN ('open','intent')",
+                    (now, kind, ref_id),
+                )
+                summary["closed"] += 1
+    # catch-all (startup only): recovery runs when nothing is in
+    # flight, so any intent still standing is an orphan (e.g. a crash
+    # inside the journal close itself) — abandon it so backlog reads
+    # true
+    if worker_id is None:
+        db.execute(
+            "UPDATE cycle_journal SET status='abandoned', updated_at=? "
+            "WHERE entry='effect' AND status='intent'",
+            (now,),
+        )
+    if summary["cycles"] or summary["task_runs"]:
+        from .events import event_bus
+
+        event_bus.emit("journal:recovered", "runtime", summary)
+    return summary
+
+
+# ---- observability + hygiene ----
+
+def backlog(db: Database) -> int:
+    """Open in-flight entries — the health surface's 'journal backlog'.
+    Grows while work is in flight; a persistently large value means
+    cycles are piling up faster than they finish (or leak)."""
+    row = db.query_one(
+        "SELECT COUNT(*) AS n FROM cycle_journal WHERE "
+        "status IN ('open','intent')",
+    )
+    return row["n"] if row else 0
+
+
+def stats(db: Database) -> dict:
+    counts = {
+        r["status"]: r["n"]
+        for r in db.query(
+            "SELECT status, COUNT(*) AS n FROM cycle_journal "
+            "GROUP BY status"
+        )
+    }
+    return {
+        "backlog": counts.get("open", 0) + counts.get("intent", 0),
+        "recovered": counts.get("recovered", 0),
+        "replay_pending": counts.get("replay_skip", 0),
+        "replay_consumed": counts.get("consumed", 0),
+    }
+
+
+def prune(db: Database, keep_hours: Optional[float] = None) -> int:
+    """Delete terminal journal rows past the retention window. Open
+    rows are never pruned — they carry recovery state. A replay_skip
+    row older than REPLAY_WINDOW_S can never match the consumption
+    query again (the retry evidently never repeated the act), so those
+    expire too instead of accumulating forever."""
+    hours = PRUNE_AFTER_H if keep_hours is None else keep_hours
+    cutoff = f"-{int(hours * 3600)} seconds"
+    placeholders = ",".join("?" for _ in _TERMINAL)
+    n = db.execute(
+        f"DELETE FROM cycle_journal WHERE status IN ({placeholders}) "
+        "AND updated_at < strftime('%Y-%m-%dT%H:%M:%fZ','now', ?)",
+        (*_TERMINAL, cutoff),
+    ).rowcount
+    n += db.execute(
+        "DELETE FROM cycle_journal WHERE status='replay_skip' AND "
+        "updated_at < strftime('%Y-%m-%dT%H:%M:%fZ','now', ?)",
+        (f"-{int(REPLAY_WINDOW_S)} seconds",),
+    ).rowcount
+    return n

@@ -18,6 +18,7 @@ from ..db import Database, utc_now
 from ..providers import (
     ExecutionRequest, RateLimitExceeded, get_model_provider,
 )
+from . import journal as journal_mod
 from . import memory as memory_mod
 from .constants import (
     MAX_CONCURRENT_TASKS_DEFAULT,
@@ -166,6 +167,7 @@ def cancel_running_tasks_for_room(db: Database, room_id: int) -> int:
             "WHERE id=?",
             (utc_now(), r["id"]),
         )
+        journal_mod.record_finished(db, "task_run", r["id"])
     return len(rows)
 
 
@@ -194,37 +196,59 @@ def execute_task(
     if not slots.acquire(task["room_id"], limit):
         return None
 
-    run_id = db.insert(
-        "INSERT INTO task_runs(task_id) VALUES (?)", (task_id,)
-    )
-    event_bus.emit("run:created", "tasks",
-                   {"run_id": run_id, "task_id": task_id})
-    started = time.monotonic()
+    # everything after the slot acquire sits inside try/finally: no
+    # exception path — injected or real — may leak a slot
+    run_id: Optional[int] = None
     try:
-        if task["executor"] in _BUILTIN_EXECUTORS:
-            result_text = _BUILTIN_EXECUTORS[task["executor"]](db, task)
-            success, error = True, None
-            session_id = None
-        else:
-            success, result_text, error, session_id = _run_llm_task(
-                db, task, abort
+        # run row + journal entry commit atomically (see run_cycle)
+        with db.transaction():
+            run_id = db.insert(
+                "INSERT INTO task_runs(task_id) VALUES (?)", (task_id,)
             )
-        _finish_run(
-            db, task, run_id, success, result_text, error, session_id,
-            int((time.monotonic() - started) * 1000),
-        )
-    except Exception as e:
-        _finish_run(
-            db, task, run_id, False, "", str(e), None,
-            int((time.monotonic() - started) * 1000),
-        )
+            journal_mod.record_started(
+                db, "task_run", run_id, task["room_id"],
+                task["worker_id"],
+            )
+        event_bus.emit("run:created", "tasks",
+                       {"run_id": run_id, "task_id": task_id})
+        started = time.monotonic()
+        # crash model as in run_cycle: fires before the error handler,
+        # so the run stays 'running' and only recovery can requeue it
+        journal_mod.chaos("cycle_crash")
+        try:
+            if task["executor"] in _BUILTIN_EXECUTORS:
+                result_text = _BUILTIN_EXECUTORS[task["executor"]](db,
+                                                                   task)
+                success, error = True, None
+                session_id = None
+            else:
+                success, result_text, error, session_id = _run_llm_task(
+                    db, task, run_id, abort
+                )
+            _finish_run(
+                db, task, run_id, success, result_text, error,
+                session_id, int((time.monotonic() - started) * 1000),
+            )
+        except Exception as e:
+            if getattr(e, "transient", True) is False:
+                # hard-crash model: skip _finish_run so the run keeps
+                # status 'running' with an open journal entry — exactly
+                # the state a killed process leaves behind
+                raise
+            _finish_run(
+                db, task, run_id, False, "", str(e), None,
+                int((time.monotonic() - started) * 1000),
+            )
     finally:
         slots.release(task["room_id"])
+    if run_id is None:
+        return None
     return db.query_one("SELECT * FROM task_runs WHERE id=?", (run_id,))
 
 
 def _run_llm_task(
-    db: Database, task: dict, abort: Optional[threading.Event]
+    db: Database, task: dict, run_id: int,
+    abort: Optional[threading.Event],
 ) -> tuple[bool, str, Optional[str], Optional[str]]:
     model = _resolve_task_model(db, task)
     provider = get_model_provider(model, db)
@@ -240,12 +264,18 @@ def _run_llm_task(
             task["run_count"] % TASK_SESSION_ROTATE_RUNS == 0:
         session_id = None  # rotate
 
+    call_key = f"task:{task['id']}:run:{run_id}"
+    journal_mod.record_provider_call(
+        db, "task_run", run_id, call_key, task["room_id"],
+        task["worker_id"],
+    )
     request = ExecutionRequest(
         prompt=prompt,
         model=model,
         session_id=session_id,
         max_turns=task["max_turns"] or 10,
         timeout_s=(task["timeout_minutes"] or 15) * 60,
+        idempotency_key=call_key,
     )
 
     last_error: Optional[str] = None
@@ -337,6 +367,10 @@ def _finish_run(
             duration_ms, session_id, run_id,
         ),
     )
+    # journal close strictly AFTER the ref row flips terminal (same
+    # order as run_cycle): a crash in between leaves an open entry
+    # recovery can find, never a stuck 'running' row with a closed one
+    journal_mod.record_finished(db, "task_run", run_id)
     db.execute(
         "UPDATE tasks SET last_run=?, last_result=?, run_count=run_count+1,"
         " error_count=?, session_id=?, updated_at=? WHERE id=?",

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import sqlite3
+import sys
 import threading
 from contextlib import contextmanager
 from datetime import datetime, timezone
@@ -21,7 +22,28 @@ from .schema import SCHEMA, SCHEMA_VERSION
 
 # Ordered (version, ddl) pairs applied after the base schema. Version 1 is
 # the base schema itself. Future migrations append here.
-MIGRATIONS: list[tuple[int, str]] = []
+MIGRATIONS: list[tuple[int, str]] = [
+    # v2: cycle_journal (docs/swarm_recovery.md). The idempotent base
+    # SCHEMA — executescript'd on every open, before _migrate — already
+    # creates the table on pre-v2 databases, so the body is empty: the
+    # stamp records the shape change without duplicating DDL here.
+    (2, ""),
+]
+
+
+def _maybe_db_fault() -> None:
+    """`db_io` chaos fault point (docs/chaos.md) on every statement
+    helper. Resolved through sys.modules so the data layer never
+    imports the serving package (and its jax dependency): if the fault
+    registry was never imported, nothing can be armed and this is a
+    dict lookup. Raises sqlite3.OperationalError — the same shape as a
+    real locked/corrupt-database hiccup — so recovery paths see exactly
+    what production would throw."""
+    faults = sys.modules.get("room_tpu.serving.faults")
+    if faults is not None and faults.is_armed():
+        faults.maybe_fail(
+            "db_io", exc_factory=sqlite3.OperationalError
+        )
 
 
 def utc_now() -> str:
@@ -90,6 +112,7 @@ class Database:
     # -- statement helpers ----------------------------------------------
 
     def execute(self, sql: str, params: tuple | dict = ()) -> sqlite3.Cursor:
+        _maybe_db_fault()
         with self._lock:
             return self._conn.execute(sql, params)
 
@@ -100,16 +123,19 @@ class Database:
         UPDATE branch, sqlite leaves lastrowid at the previous successful
         insert. Upsert callers must re-select the id instead.
         """
+        _maybe_db_fault()
         with self._lock:
             return int(self._conn.execute(sql, params).lastrowid or 0)
 
     def query(self, sql: str, params: tuple | dict = ()) -> list[dict[str, Any]]:
+        _maybe_db_fault()
         with self._lock:
             return [dict(r) for r in self._conn.execute(sql, params).fetchall()]
 
     def query_one(
         self, sql: str, params: tuple | dict = ()
     ) -> Optional[dict[str, Any]]:
+        _maybe_db_fault()
         with self._lock:
             row = self._conn.execute(sql, params).fetchone()
             return dict(row) if row is not None else None

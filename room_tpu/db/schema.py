@@ -18,7 +18,7 @@ All DDL is idempotent (CREATE ... IF NOT EXISTS) so it can run on any
 database. Table order respects foreign keys (PRAGMA foreign_keys = ON).
 """
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # v2: cycle_journal (docs/swarm_recovery.md)
 
 # UTC ISO-8601 with millisecond precision, e.g. 2026-07-28T19:04:11.123Z
 NOW_SQL = "(strftime('%Y-%m-%dT%H:%M:%fZ','now'))"
@@ -463,6 +463,34 @@ CREATE TABLE IF NOT EXISTS worker_cycles (
 CREATE INDEX IF NOT EXISTS ix_worker_cycles_room
     ON worker_cycles(room_id, started_at DESC);
 CREATE INDEX IF NOT EXISTS ix_worker_cycles_status ON worker_cycles(status);
+
+-- Durable crash journal (docs/swarm_recovery.md): intent records for
+-- agent cycles and task runs. 'started'/'provider_call' entries stay
+-- 'open' while work is in flight and flip to 'closed' on a clean
+-- finish; an entry still open at startup marks work a crash
+-- interrupted, and recovery fails/requeues its ref row. 'effect'
+-- entries track journaled tool side effects: 'intent' before the
+-- effect runs, 'committed' after — recovery flags committed effects of
+-- interrupted work as 'replay_skip' so a retried cycle never fires the
+-- same wallet tx / message send / self-mod twice ('consumed' once the
+-- retry skips it, 'abandoned' for intents that never committed).
+CREATE TABLE IF NOT EXISTS cycle_journal (
+    id         INTEGER PRIMARY KEY AUTOINCREMENT,
+    kind       TEXT NOT NULL CHECK(kind IN ('cycle','task_run')),
+    ref_id     INTEGER NOT NULL,
+    room_id    INTEGER,
+    worker_id  INTEGER,
+    entry      TEXT NOT NULL CHECK(entry IN
+                   ('started','provider_call','effect')),
+    status     TEXT NOT NULL DEFAULT 'open',
+    idem_key   TEXT,
+    payload    TEXT,
+    created_at TEXT DEFAULT {NOW},
+    updated_at TEXT DEFAULT {NOW}
+);
+CREATE INDEX IF NOT EXISTS ix_journal_ref ON cycle_journal(kind, ref_id);
+CREATE INDEX IF NOT EXISTS ix_journal_status ON cycle_journal(status);
+CREATE INDEX IF NOT EXISTS ix_journal_idem ON cycle_journal(idem_key);
 
 CREATE TABLE IF NOT EXISTS cycle_logs (
     id         INTEGER PRIMARY KEY AUTOINCREMENT,

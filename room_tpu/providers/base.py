@@ -34,6 +34,13 @@ class ExecutionRequest:
     temperature: float = 0.7
     max_new_tokens: int = 1024
     on_text: Optional[Callable[[str], None]] = None
+    # audit tag from journaled callers (agent loop / task runner),
+    # matching the journal's provider_call record for this attempt
+    # (docs/swarm_recovery.md). Scoped to ONE attempt — a recovery
+    # retry is a new cycle/run and carries a new key — so forwarding it
+    # upstream dedupes transport-level retries of the same request, not
+    # crash replays (the journal's effect layer owns those).
+    idempotency_key: Optional[str] = None
 
 
 @dataclass

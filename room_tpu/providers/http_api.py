@@ -119,9 +119,14 @@ class OpenAICompatProvider:
             if tools:
                 body["tools"] = tools
             try:
+                headers = self._headers()
+                if request.idempotency_key:
+                    # transport-retry dedup where the service supports
+                    # it; unknown headers are ignored harmlessly
+                    headers["Idempotency-Key"] = request.idempotency_key
                 out = _post_json(
                     f"{self.base}/chat/completions", body,
-                    self._headers(), request.timeout_s,
+                    headers, request.timeout_s,
                 )
             except RateLimitExceeded:
                 raise
@@ -222,8 +227,11 @@ class AnthropicProvider:
             if tools:
                 body["tools"] = tools
             try:
+                headers = self._headers()
+                if request.idempotency_key:
+                    headers["Idempotency-Key"] = request.idempotency_key
                 out = _post_json(
-                    f"{self.base}/messages", body, self._headers(),
+                    f"{self.base}/messages", body, headers,
                     request.timeout_s,
                 )
             except RateLimitExceeded:

@@ -14,10 +14,15 @@ Grammar:
 Resilience: with ``ROOM_TPU_FALLBACK_MODELS`` set (comma-separated
 model strings, e.g. ``claude,openai:gpt-4o-mini``), tpu: providers are
 wrapped in a fail-closed fallback chain — when the in-tree engine is
-unhealthy (crash loop) or errors out of execution, the request routes
-to the first ready fallback provider instead of dying with the engine.
-Fail-closed means: if no fallback is ready either, the original error
-surfaces; nothing silently swallows failures.
+unhealthy (crash loop) or raises a ProviderError out of execution, the
+request routes to the first ready fallback provider instead of dying
+with the engine. A single engine crash *within* the restart budget
+surfaces as a crash-failed ExecutionResult, not an exception; set
+``ROOM_TPU_FALLBACK_ON_CRASH=1`` to reroute those through the chain
+too (off by default: the crashed attempt already burned a full turn's
+latency, so the reroute roughly doubles time-to-answer). Fail-closed
+means: if no fallback is ready either, the original error surfaces;
+nothing silently swallows failures.
 """
 
 from __future__ import annotations
@@ -65,6 +70,7 @@ def model_name(model: Optional[str]) -> str:
 
 
 FALLBACK_ENV = "ROOM_TPU_FALLBACK_MODELS"
+FALLBACK_ON_CRASH_ENV = "ROOM_TPU_FALLBACK_ON_CRASH"
 
 
 def fallback_models() -> list[str]:
@@ -75,12 +81,36 @@ def fallback_models() -> list[str]:
     ]
 
 
+def fallback_on_crash() -> bool:
+    """Opt-in reroute of crash-failed ExecutionResults (engine crashed
+    mid-turn but stayed within its restart budget) through the fallback
+    chain. Default off: the primary already spent the turn's latency
+    before crashing, so rerouting roughly doubles time-to-answer."""
+    return os.environ.get(FALLBACK_ON_CRASH_ENV, "").lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def _is_crash_result(result: ExecutionResult) -> bool:
+    """An engine-infrastructure crash surfaced as a failed result (the
+    engine fails pending turns with an explicit 'engine crashed: ...'
+    message). Model-level failures (max_turns, refusals, timeouts) are
+    NOT crashes and never reroute."""
+    return (
+        not result.success
+        and bool(result.error)
+        and "engine crashed" in result.error
+    )
+
+
 class FallbackProvider:
     """Fail-closed fallback chain around the tpu: provider: when the
     engine is unhealthy (crash loop / not ready) or raises out of
     execution, try the configured CLI/HTTP fallbacks in order — first
-    ready one serves the request. If nothing is ready, the PRIMARY
-    error surfaces (never a silent swallow). Result- level failures
+    ready one serves the request. With ROOM_TPU_FALLBACK_ON_CRASH set,
+    a crash-failed ExecutionResult (engine crashed within its restart
+    budget) also reroutes. If nothing is ready, the PRIMARY error
+    surfaces (never a silent swallow). Other result-level failures
     (model said something wrong, max_turns) do NOT fall back: only
     infrastructure failures reroute."""
 
@@ -131,9 +161,16 @@ class FallbackProvider:
 
     def execute(self, request: ExecutionRequest) -> ExecutionResult:
         primary_error: Optional[BaseException] = None
+        crash_result: Optional[ExecutionResult] = None
         if self._primary_healthy():
             try:
-                return self.primary.execute(request)
+                result = self.primary.execute(request)
+                if not (_is_crash_result(result) and fallback_on_crash()):
+                    return result
+                # engine crashed mid-turn (within its restart budget)
+                # and the operator opted into the double-latency reroute
+                crash_result = result
+                primary_error = ProviderError(result.error)
             except ProviderError as e:
                 primary_error = e
         else:
@@ -152,11 +189,17 @@ class FallbackProvider:
                     from ..core.telemetry import incr_counter
 
                     incr_counter("provider.fallback")
+                    if crash_result is not None:
+                        incr_counter("provider.fallback_on_crash")
                 except Exception:
                     pass
                 return provider.execute(request)
             except ProviderError:
                 continue
+        if crash_result is not None:
+            # chain exhausted: surface the crash-failed result exactly
+            # as the un-rerouted path would have
+            return crash_result
         raise primary_error  # fail closed: surface the real failure
 
 

@@ -1155,8 +1155,12 @@ def register_aux_routes(r: Router) -> None:
     def tpu_health(ctx):
         """Degraded-mode health surface (docs/chaos.md): per-engine
         degradation rung + crash/stall/requeue/shed counters, armed
-        fault points, and process resilience counters — what the TPU
-        panel and external monitors poll."""
+        fault points, process resilience counters, and the swarm
+        runtime's loop-supervision + crash-journal state
+        (docs/swarm_recovery.md) — what the TPU panel and external
+        monitors poll."""
+        from ..core import journal as journal_mod
+        from ..core.agent_loop import supervision_snapshot
         from ..core.telemetry import counters_snapshot
         from ..providers.registry import fallback_models
         from ..providers.tpu import engines_snapshot
@@ -1178,14 +1182,21 @@ def register_aux_routes(r: Router) -> None:
         for name, e in engines.items():
             if e.get("offload") is not None:
                 summary[name]["offload"] = e["offload"]
+        swarm = supervision_snapshot()
+        # db-less contexts (bare router probes) get zeroed journal stats
+        swarm["journal"] = journal_mod.stats(ctx.db) if ctx.db else {
+            "backlog": 0, "recovered": 0,
+            "replay_pending": 0, "replay_consumed": 0,
+        }
         degraded = any(
             e.get("degradation_level", 0) > 0 or not e.get("healthy",
                                                            True)
             for e in engines.values()
-        )
+        ) or bool(swarm["unhealthy_workers"])
         return ok({
             "degraded": degraded,
             "engines": summary,
+            "swarm": swarm,
             "faults": faults_mod.snapshot(),
             "counters": counters_snapshot(),
             "fallback_models": fallback_models(),

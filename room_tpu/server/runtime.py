@@ -13,11 +13,13 @@ from datetime import datetime
 from typing import Optional
 
 from ..db import Database, utc_now
+from ..core import journal as journal_mod
 from ..core import messages as messages_mod
 from ..core import rooms as rooms_mod
 from ..core import task_runner
 from ..core.agent_loop import (
-    is_room_launched, set_room_launch_enabled, stop_room_loops,
+    is_room_launched, reset_supervision, running_workers,
+    set_room_launch_enabled, stop_room_loops, supervise_loops,
     trigger_agent,
 )
 from ..core.cron import cron_matches
@@ -26,6 +28,7 @@ from ..core.events import event_bus
 SCHEDULER_TICK_S = 15.0
 MAINTENANCE_TICK_S = 60.0
 INBOX_POLL_S = 2.5
+SUPERVISION_TICK_S = 10.0
 STALE_RUN_MINUTES = 120
 
 
@@ -47,6 +50,10 @@ class ServerRuntime:
     cloud: Optional[object] = None
 
     def start(self) -> None:
+        # crash recovery FIRST: resolve journal-open work to terminal
+        # states (and flag committed side effects against replay)
+        # before the stale sweep or the scheduler can touch it
+        journal_mod.recover(self.db)
         self.cleanup_stale(startup=True)
         self.scheduler_tick()
         from ..core.embedding_indexer import EmbeddingIndexer
@@ -76,6 +83,7 @@ class ServerRuntime:
             (self.scheduler_tick, SCHEDULER_TICK_S),
             (self.maintenance_tick, MAINTENANCE_TICK_S),
             (self.inbox_poll, INBOX_POLL_S),
+            (self.supervision_tick, SUPERVISION_TICK_S),
         ):
             t = threading.Thread(
                 target=self._loop, args=(target, interval),
@@ -166,6 +174,13 @@ class ServerRuntime:
 
     def maintenance_tick(self) -> None:
         self.cleanup_stale()
+        journal_mod.prune(self.db)
+
+    def supervision_tick(self) -> None:
+        """Restart dead/hung agent-loop threads under budget; past
+        budget the worker goes unhealthy + keeper-escalated
+        (docs/swarm_recovery.md)."""
+        supervise_loops(self.db)
 
     def inbox_poll(self) -> None:
         """Unanswered keeper chat wakes the room's queen (reference:
@@ -217,6 +232,17 @@ class ServerRuntime:
         if room is None or not room["queen_worker_id"]:
             return False
         rooms_mod.restart_room(self.db, room_id)
+        # a deliberate keeper restart re-arms the loop restart budget
+        # and clears unhealthy flags for the room's workers
+        team = self.db.query(
+            "SELECT id FROM workers WHERE room_id=?", (room_id,)
+        )
+        reset_supervision([w["id"] for w in team])
+        self.db.execute(
+            "UPDATE workers SET agent_state='idle', updated_at=? "
+            "WHERE room_id=? AND agent_state='unhealthy'",
+            (utc_now(), room_id),
+        )
         set_room_launch_enabled(room_id, True)
         stop_room_loops(self.db, room_id, "runtime reset")
         trigger_agent(
@@ -242,7 +268,10 @@ class ServerRuntime:
 
     def cleanup_stale(self, startup: bool = False) -> int:
         """Mark long-running/orphaned runs and cycles failed (reference:
-        db-queries.ts:544-573, runtime.ts:336)."""
+        db-queries.ts:544-573, runtime.ts:336), and reset workers
+        stranded mid-state by a crash. Crash-interrupted work with a
+        journal entry is resolved immediately by journal recovery; this
+        sweep catches whatever predates the journal."""
         n = 0
         cutoff = f"-{STALE_RUN_MINUTES} minutes"
         for table, col in (("task_runs", "started_at"),
@@ -255,6 +284,24 @@ class ServerRuntime:
                 (utc_now(), cutoff, 1 if startup else 0),
             )
             n += cur.rowcount
+        # workers stuck in 'running'/'rate_limited' with no loop thread
+        # behind them: at startup no loop exists yet, so reset them all;
+        # during operation only those whose loop is gone (a live loop
+        # legitimately holds these states for the whole backoff window)
+        live = set() if startup else set(running_workers())
+        stranded = self.db.query(
+            "SELECT id FROM workers WHERE agent_state IN "
+            "('running','rate_limited')"
+        )
+        for w in stranded:
+            if w["id"] in live:
+                continue
+            self.db.execute(
+                "UPDATE workers SET agent_state='idle', updated_at=? "
+                "WHERE id=?",
+                (utc_now(), w["id"]),
+            )
+            n += 1
         return n
 
 

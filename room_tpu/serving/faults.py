@@ -17,6 +17,18 @@ named *fault point* that tests (and staging deployments) can arm:
                        exhaustion fails back to resident pages on the
                        way out, to a history re-prefill on the way in)
 
+Swarm-layer points (docs/swarm_recovery.md) thread the same registry
+up through the agent runtime above the engine:
+
+    db_io              SQLite statement helper raises OperationalError
+    cycle_crash        agent cycle / task run dies before its cleanup
+                       handler (arm ``permanent`` to model a hard crash
+                       that escapes the loop's handler entirely)
+    loop_hang          agent-loop iteration stalls `latency` seconds
+                       (stale-heartbeat watchdog territory)
+    tool_exec          journaled tool side effect crashes between its
+                       intent record and execution
+
 Arming is per-point with probability / latency / one-shot triggers,
 via code (`inject`) or env (`ROOM_TPU_FAULTS`), e.g.::
 
@@ -39,14 +51,16 @@ from typing import Callable, Optional
 
 __all__ = [
     "FaultError", "FaultSpec", "FAULT_POINTS", "inject", "clear",
-    "configure_from_env", "is_active", "should_fire", "maybe_fail",
-    "maybe_delay", "fired", "snapshot",
+    "configure_from_env", "is_active", "is_armed", "should_fire",
+    "maybe_fail", "maybe_delay", "fired", "snapshot",
 ]
 
 FAULT_POINTS = (
     "kv_alloc", "prefill_oom", "decode_step", "decode_stall",
     "tokenizer", "engine_crash", "client_disconnect",
     "provider_timeout", "offload_io",
+    # swarm runtime (docs/swarm_recovery.md)
+    "db_io", "cycle_crash", "loop_hang", "tool_exec",
 )
 
 
@@ -158,6 +172,13 @@ def configure_from_env(env: Optional[str] = None) -> None:
             else:
                 raise ValueError(f"unknown fault arg {k!r} in {part!r}")
         inject(name.strip(), **kw)
+
+
+def is_armed() -> bool:
+    """Lock-free fast-path flag: is ANY fault point armed? Layers that
+    must not import this module unconditionally (the db layer resolves
+    it through sys.modules) use this to skip maybe_fail entirely."""
+    return _armed
 
 
 def is_active(name: str) -> bool:

@@ -1,0 +1,806 @@
+"""Chaos suite for the swarm runtime (docs/swarm_recovery.md).
+
+The serving chaos suite (test_chaos_serving.py) proves the engine
+survives induced failure; this suite proves the swarm layer above it
+does too. Each swarm fault point — db_io, cycle_crash, loop_hang,
+tool_exec — gets a targeted recovery test, plus a multi-room crash
+storm asserting the acceptance invariants:
+
+  1. every started cycle / task run reaches a terminal journal state
+     (after journal recovery, nothing is left 'running');
+  2. no journaled side effect executes twice — committed effects of
+     interrupted work are replay-skipped, never re-fired;
+  3. _SlotPool slots never leak, whatever the crash path;
+  4. a loop past its restart budget is keeper-escalated, marked
+     unhealthy, and visible in /api/tpu/health.
+
+The quick tier is CI-bounded (ci.yml chaos job); the >=30 s soak tier
+runs behind the `slow` marker.
+"""
+
+import threading
+import time
+
+import pytest
+
+from room_tpu.core import (
+    agent_loop, journal, rooms, task_runner, workers,
+)
+from room_tpu.core.telemetry import reset_counters
+from room_tpu.providers import get_model_provider, reset_provider_cache
+from room_tpu.providers.base import ExecutionRequest, ExecutionResult
+from room_tpu.serving import faults
+from tests.conftest import http_req
+
+
+def _drain_loops(timeout=10.0):
+    """Stop and JOIN every registered loop thread, then drop the
+    handles. Joining matters: a straggler mid-iteration from a previous
+    test can consume the next test's one-shot global fault (its exit
+    path swallows the injected error), turning deterministic tests
+    flaky."""
+    with agent_loop._registry_lock:
+        handles = list(agent_loop._running_loops.values())
+    for h in handles:
+        h.stop.set()
+        h.wake.set()
+    for h in handles:
+        if h.thread is not None:
+            h.thread.join(timeout=timeout)
+    with agent_loop._registry_lock:
+        for wid, h in list(agent_loop._running_loops.items()):
+            if h.thread is None or not h.thread.is_alive():
+                del agent_loop._running_loops[wid]
+
+
+@pytest.fixture(autouse=True)
+def _clean_swarm_state():
+    """Faults disarmed, loops drained, and supervision state forgotten
+    around every test — module-global state must never leak across
+    tests."""
+    faults.clear()
+    _drain_loops()
+    agent_loop.reset_supervision(list(agent_loop._strikes)
+                                 + list(agent_loop._unhealthy))
+    for k in agent_loop._supervision_counts:
+        agent_loop._supervision_counts[k] = 0
+    reset_counters()
+    yield
+    faults.clear()
+    _drain_loops()
+    agent_loop.reset_supervision(list(agent_loop._strikes)
+                                 + list(agent_loop._unhealthy))
+
+
+@pytest.fixture()
+def room(db):
+    r = rooms.create_room(
+        db, "hive", goal="survive crashes", worker_model="echo",
+        create_wallet=False,
+    )
+    agent_loop.set_room_launch_enabled(r["id"], True)
+    yield r
+    agent_loop.set_room_launch_enabled(r["id"], False)
+    agent_loop.stop_room_loops(db, r["id"], "test done")
+
+
+@pytest.fixture()
+def echo(room):
+    reset_provider_cache()
+    provider = get_model_provider("echo")
+    provider.responses.clear()
+    provider.tool_script.clear()
+    provider.calls.clear()
+    provider.fail_with = None
+    return provider
+
+
+def queen_of(db, room):
+    return workers.get_worker(db, room["queen_worker_id"])
+
+
+def _wait(predicate, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _start_loop(db, room, worker_id, gap_ms=3_600_000):
+    """Start a loop and wait until it finished its first cycle and went
+    to sleep (state 'idle'), so fault arming afterwards hits the NEXT
+    iteration deterministically — never the in-flight first cycle."""
+    rooms.update_room(db, room["id"], queen_cycle_gap_ms=gap_ms)
+    handle = agent_loop.start_agent_loop(db, room["id"], worker_id)
+    # generous timeout: the very first cycle in a process pays one-off
+    # embed/skills warmup
+    assert _wait(lambda: handle.state == "idle", timeout=20.0), \
+        f"loop never went idle (state={handle.state})"
+    return handle
+
+
+# ---- fault registry ----
+
+def test_swarm_fault_points_registered():
+    for point in ("db_io", "cycle_crash", "loop_hang", "tool_exec"):
+        assert point in faults.FAULT_POINTS
+    faults.configure_from_env("cycle_crash:times=2;db_io:p=0.5")
+    snap = faults.snapshot()
+    assert snap["cycle_crash"]["times_remaining"] == 2
+    assert snap["db_io"]["probability"] == 0.5
+
+
+# ---- journal lifecycle ----
+
+def test_clean_cycle_closes_its_journal(db, room, echo):
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    assert cycle["status"] == "success"
+    rows = db.query(
+        "SELECT * FROM cycle_journal WHERE kind='cycle' AND ref_id=?",
+        (cycle["id"],),
+    )
+    entries = {r["entry"]: r["status"] for r in rows}
+    assert entries["started"] == "closed"
+    assert entries["provider_call"] == "closed"
+    assert journal.backlog(db) == 0
+
+
+def test_clean_failure_closes_its_journal(db, room, echo):
+    echo.fail_with = "provider exploded"
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    assert cycle["status"] == "error"
+    assert journal.backlog(db) == 0
+
+
+def test_journaled_tool_commits_effect(db, room, echo):
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "status ok"})
+    )
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    row = db.query_one(
+        "SELECT * FROM cycle_journal WHERE kind='cycle' AND ref_id=? "
+        "AND entry='effect'",
+        (cycle["id"],),
+    )
+    assert row is not None and row["status"] == "committed"
+    assert "status ok" in (row["payload"] or "")
+
+
+def test_clean_task_run_closes_its_journal(db, room, echo):
+    tid = task_runner.create_task(
+        db, "t", "do it", trigger_type="once", room_id=room["id"]
+    )
+    run = task_runner.execute_task(db, tid)
+    assert run["status"] == "success"
+    assert journal.backlog(db) == 0
+    assert task_runner.slots.in_use(room["id"]) == 0
+
+
+# ---- crash recovery ----
+
+def test_recovery_fails_interrupted_cycle_immediately(db, room, echo):
+    """A cycle_crash leaves the cycle 'running' with an open journal —
+    recovery resolves it to a terminal state NOW, not 120 min later."""
+    faults.inject("cycle_crash", times=1)
+    with pytest.raises(faults.FaultError):
+        agent_loop.run_cycle(db, room, queen_of(db, room))
+    stuck = db.query_one(
+        "SELECT * FROM worker_cycles ORDER BY id DESC LIMIT 1"
+    )
+    assert stuck["status"] == "running"
+    assert journal.backlog(db) > 0
+
+    summary = journal.recover(db)
+    assert summary["cycles"] == 1
+    after = db.query_one(
+        "SELECT * FROM worker_cycles WHERE id=?", (stuck["id"],)
+    )
+    assert after["status"] == "error"
+    assert "recovered" in after["error_message"]
+    assert journal.backlog(db) == 0
+
+
+def test_recovery_requeues_interrupted_task_run(db, room, echo):
+    """An interrupted 'once' task run is failed by recovery but the
+    task stays active — the scheduler requeues it, and the retry
+    completes."""
+    tid = task_runner.create_task(
+        db, "t", "do it", trigger_type="once", room_id=room["id"]
+    )
+    faults.inject("cycle_crash", times=1, transient=False)
+    with pytest.raises(faults.FaultError):
+        task_runner.execute_task(db, tid)
+    assert task_runner.slots.in_use(room["id"]) == 0  # no slot leak
+    run = db.query_one("SELECT * FROM task_runs ORDER BY id DESC LIMIT 1")
+    assert run["status"] == "running"  # crash model: no cleanup ran
+
+    summary = journal.recover(db)
+    assert summary["task_runs"] == 1
+    assert db.query_one(
+        "SELECT status FROM task_runs WHERE id=?", (run["id"],)
+    )["status"] == "error"
+    # not archived: still schedulable, and the retry succeeds
+    assert task_runner.get_task(db, tid)["status"] == "active"
+    retry = task_runner.execute_task(db, tid)
+    assert retry["status"] == "success"
+
+
+def test_recovery_closes_bookkeeping_for_finished_refs(db, room, echo):
+    """Crash after the status update but before the journal close:
+    recovery must close the entry quietly, not double-fail the ref."""
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    db.execute(
+        "UPDATE cycle_journal SET status='open' WHERE kind='cycle' "
+        "AND ref_id=? AND entry='started'",
+        (cycle["id"],),
+    )
+    summary = journal.recover(db)
+    assert summary["closed"] == 1 and summary["cycles"] == 0
+    assert db.query_one(
+        "SELECT status FROM worker_cycles WHERE id=?", (cycle["id"],)
+    )["status"] == "success"
+
+
+# ---- side-effect idempotency ----
+
+def test_committed_effect_is_not_double_fired_on_replay(db, room, echo):
+    """The core exactly-once guarantee: a message sent before the crash
+    is NOT re-sent by the recovered retry."""
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "wire the payment"})
+    )
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    sent = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    assert len(sent) == 1
+
+    # simulate the crash window: cycle died after the tool committed
+    # but before finishing — reopen its journal and roll the row back
+    db.execute(
+        "UPDATE worker_cycles SET status='running', finished_at=NULL "
+        "WHERE id=?", (cycle["id"],),
+    )
+    db.execute(
+        "UPDATE cycle_journal SET status='open' WHERE kind='cycle' "
+        "AND ref_id=? AND entry='started'",
+        (cycle["id"],),
+    )
+    summary = journal.recover(db)
+    assert summary["effects_flagged"] == 1
+
+    # the retry runs the same logical cycle (same tool, same args)
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    sent = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    assert len(sent) == 1, "replay double-fired a committed side effect"
+    consumed = db.query_one(
+        "SELECT * FROM cycle_journal WHERE entry='effect' AND "
+        "status='consumed'"
+    )
+    assert consumed is not None
+
+
+def test_replay_protection_chains_through_repeated_crashes(db, room,
+                                                           echo):
+    """If the RETRY also crashes after its skip point, the third
+    attempt must still skip: consuming a marker records a committed
+    marker on the consuming ref, so protection survives chained
+    crash/retry rounds."""
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "wire it"})
+    )
+
+    def crash_after(cycle_id):
+        db.execute(
+            "UPDATE worker_cycles SET status='running', "
+            "finished_at=NULL WHERE id=?", (cycle_id,),
+        )
+        db.execute(
+            "UPDATE cycle_journal SET status='open' WHERE kind='cycle' "
+            "AND ref_id=? AND entry='started'", (cycle_id,),
+        )
+        journal.recover(db)
+
+    for round_no in range(3):
+        cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+        sent = db.query(
+            "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+        )
+        assert len(sent) == 1, (
+            f"round {round_no}: effect fired {len(sent)} times"
+        )
+        if round_no < 2:
+            crash_after(cycle["id"])
+
+
+def test_uncommitted_intent_reruns_on_retry(db, room, echo):
+    """tool_exec crashes the effect between intent and execution: the
+    message was never sent, so the retry must send it — exactly once
+    in total."""
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "hello"})
+    )
+    faults.inject("tool_exec", times=1)
+    with pytest.raises(faults.FaultError):
+        agent_loop.run_cycle(db, room, queen_of(db, room))
+    cycle = db.query_one(
+        "SELECT * FROM worker_cycles ORDER BY id DESC LIMIT 1"
+    )
+    assert cycle["status"] == "error"
+    assert not db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    intent = db.query_one(
+        "SELECT status FROM cycle_journal WHERE entry='effect'"
+    )
+    assert intent["status"] == "abandoned"
+
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    sent = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    assert len(sent) == 1
+
+
+def test_failed_tool_is_not_committed(db, room, echo):
+    """execute_queen_tool returns 'tool error: ...' strings instead of
+    raising; a failed effect must be abandoned, not committed —
+    otherwise replay protection would suppress the retry of an act
+    that never happened."""
+    echo.tool_script.append(("send_message", {"to": "keeper"}))  # no body
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    row = db.query_one(
+        "SELECT status, payload FROM cycle_journal WHERE entry='effect'"
+    )
+    assert row["status"] == "abandoned"
+    assert "tool error" in row["payload"]
+    # the corrected retry executes normally
+    echo.tool_script.clear()
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "fixed"})
+    )
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    sent = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    assert len(sent) == 1
+
+
+def test_second_legitimate_send_still_executes(db, room, echo):
+    """Idempotency must not turn into dedupe of legitimate repeats:
+    the same message sent by two SUCCESSFUL cycles goes out twice."""
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "daily report"})
+    )
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    sent = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    assert len(sent) == 2
+
+
+# ---- db_io + loop supervision ----
+
+def test_db_io_fault_kills_loop_and_supervisor_restarts(db, room, echo):
+    queen = queen_of(db, room)
+    handle = _start_loop(db, room, queen["id"])
+    assert handle.thread.is_alive()
+
+    faults.inject("db_io", times=1)
+    handle.wake.set()  # next iteration hits the injected OperationalError
+    assert _wait(lambda: not handle.thread.is_alive()), \
+        "db_io fault did not kill the loop thread"
+    assert handle.state == "crashed"
+    assert "OperationalError" in (handle.crash_error or "")
+    # the corpse stays in the registry for the supervisor to find
+    assert agent_loop._running_loops.get(queen["id"]) is handle
+
+    actions = agent_loop.supervise_loops(db)
+    assert queen["id"] in actions["restarted"]
+    new = agent_loop._running_loops.get(queen["id"])
+    assert new is not None and new is not handle
+    assert new.thread.is_alive()
+    snap = agent_loop.supervision_snapshot()
+    assert snap["restarts"] == 1 and snap["crashes"] == 1
+
+
+def test_wake_path_routes_crashed_corpse_through_supervision(db, room,
+                                                            echo):
+    """trigger_agent / start_agent_loop on a crashed corpse must NOT
+    silently replace it: supervision (journal recovery + strike
+    accounting) runs first, and an unhealthy worker stays locked out
+    until the keeper resets it."""
+    queen = queen_of(db, room)
+    handle = _start_loop(db, room, queen["id"])
+    faults.inject("cycle_crash", times=1, transient=False)
+    handle.wake.set()
+    assert _wait(lambda: not handle.thread.is_alive())
+    orphan = db.query_one(
+        "SELECT id FROM worker_cycles WHERE status='running'"
+    )
+    assert orphan is not None
+
+    # the wake path — not supervise_loops — triggers the restart
+    new = agent_loop.trigger_agent(db, room["id"], queen["id"])
+    assert new is not None and new is not handle
+    # ...and supervision bookkeeping still happened
+    assert db.query_one(
+        "SELECT status FROM worker_cycles WHERE id=?", (orphan["id"],)
+    )["status"] == "error"
+    assert agent_loop.supervision_snapshot()["restarts"] == 1
+
+    # lockout: an unhealthy worker cannot be resurrected by a wake
+    agent_loop.pause_agent(queen["id"])
+    _drain_loops()
+    with agent_loop._supervision_lock:
+        agent_loop._unhealthy[queen["id"]] = {"room_id": room["id"],
+                                              "error": "test",
+                                              "strikes": 9,
+                                              "at": "now"}
+    locked = agent_loop.start_agent_loop(db, room["id"], queen["id"])
+    assert locked.state == "unhealthy" and locked.thread is None
+    assert queen["id"] not in agent_loop._running_loops
+    # keeper reset re-enables the worker
+    agent_loop.reset_supervision([queen["id"]])
+    revived = agent_loop.start_agent_loop(db, room["id"], queen["id"])
+    assert revived.thread is not None and revived.thread.is_alive()
+
+
+def test_live_committed_effect_skipped_without_recovery(db, room, echo):
+    """An un-recovered predecessor stuck 'running' (in-process crash
+    orphan or hung twin) already committed the effect: the next cycle
+    must skip it even though recover() never ran."""
+    echo.tool_script.append(
+        ("send_message", {"to": "keeper", "body": "ship it"})
+    )
+    cycle = agent_loop.run_cycle(db, room, queen_of(db, room))
+    # freeze the predecessor mid-flight: row back to running, journal
+    # open, NO recovery
+    db.execute(
+        "UPDATE worker_cycles SET status='running', finished_at=NULL "
+        "WHERE id=?", (cycle["id"],),
+    )
+    db.execute(
+        "UPDATE cycle_journal SET status='open' WHERE kind='cycle' "
+        "AND ref_id=? AND entry='started'", (cycle["id"],),
+    )
+
+    agent_loop.run_cycle(db, room, queen_of(db, room))
+    sent = db.query(
+        "SELECT * FROM chat_messages WHERE room_id=?", (room["id"],)
+    )
+    assert len(sent) == 1, "live-committed effect was re-fired"
+
+
+def test_supervised_restart_recovers_interrupted_cycle(db, room, echo):
+    """An in-process crash restart must arm the same journal recovery
+    as a full process restart: the dead loop's interrupted cycle
+    resolves to terminal (and its effects get replay protection)
+    BEFORE the replacement loop runs."""
+    queen = queen_of(db, room)
+    handle = _start_loop(db, room, queen["id"])
+
+    # a non-transient cycle_crash escapes the loop's handler: the
+    # thread dies mid-cycle, leaving the cycle row 'running'
+    faults.inject("cycle_crash", times=1, transient=False)
+    handle.wake.set()
+    assert _wait(lambda: not handle.thread.is_alive())
+    orphan = db.query_one(
+        "SELECT id FROM worker_cycles WHERE status='running'"
+    )
+    assert orphan is not None
+
+    actions = agent_loop.supervise_loops(db)
+    assert queen["id"] in actions["restarted"]
+    after = db.query_one(
+        "SELECT status, error_message FROM worker_cycles WHERE id=?",
+        (orphan["id"],),
+    )
+    assert after["status"] == "error"
+    assert "recovered" in after["error_message"]
+
+
+def test_prune_expires_stale_replay_skip(db):
+    db.insert(
+        "INSERT INTO cycle_journal(kind, ref_id, entry, status, "
+        "idem_key, updated_at) VALUES "
+        "('cycle', 1, 'effect', 'replay_skip', 'k:1', "
+        "'2020-01-01T00:00:00.000Z')",
+    )
+    db.insert(
+        "INSERT INTO cycle_journal(kind, ref_id, entry, status, "
+        "idem_key) VALUES ('cycle', 2, 'effect', 'replay_skip', 'k:2')",
+    )
+    n = journal.prune(db)
+    assert n == 1  # only the expired one; the fresh skip survives
+    left = db.query("SELECT idem_key FROM cycle_journal")
+    assert [r["idem_key"] for r in left] == ["k:2"]
+
+
+def test_restart_budget_exhaustion_escalates(db, room, echo,
+                                             monkeypatch):
+    monkeypatch.setattr(agent_loop, "LOOP_RESTART_BUDGET", 1)
+    queen = queen_of(db, room)
+    handle = _start_loop(db, room, queen["id"])
+
+    for strike in range(2):
+        faults.inject("db_io", times=1)
+        handle.wake.set()
+        assert _wait(lambda: not handle.thread.is_alive())
+        agent_loop.supervise_loops(db)
+        handle = agent_loop._running_loops.get(queen["id"])
+        if handle is None:
+            break
+        assert _wait(lambda: handle.state == "idle")
+
+    # past budget: no loop, unhealthy worker, keeper escalation
+    assert agent_loop._running_loops.get(queen["id"]) is None
+    assert workers.get_worker(db, queen["id"])["agent_state"] == \
+        "unhealthy"
+    esc = db.query(
+        "SELECT * FROM escalations WHERE room_id=?", (room["id"],)
+    )
+    assert esc and "restart budget" in esc[-1]["question"]
+    snap = agent_loop.supervision_snapshot()
+    assert str(queen["id"]) in snap["unhealthy_workers"]
+    assert snap["budget_exhausted"] == 1
+
+    # keeper restart re-arms the budget
+    agent_loop.reset_supervision([queen["id"]])
+    assert not agent_loop.supervision_snapshot()["unhealthy_workers"]
+
+
+def test_hung_loop_is_detected_and_replaced(db, room, echo,
+                                            monkeypatch):
+    monkeypatch.setattr(agent_loop, "LOOP_HANG_S", 0.2)
+    queen = queen_of(db, room)
+    handle = _start_loop(db, room, queen["id"])
+
+    faults.inject("loop_hang", latency_s=3.0, times=1)
+    handle.wake.set()  # iteration enters the injected stall
+    assert _wait(
+        lambda: handle.state == "running"
+        and time.monotonic() - handle.beat > 0.25
+    )
+    actions = agent_loop.supervise_loops(db)
+    assert queen["id"] in actions["replaced_hung"]
+    new = agent_loop._running_loops.get(queen["id"])
+    assert new is not None and new is not handle
+    assert handle.stop.is_set()  # old thread told to die when it unsticks
+    assert agent_loop.supervision_snapshot()["hang_replacements"] == 1
+    # the stuck thread exits without clobbering its successor
+    assert _wait(lambda: not handle.thread.is_alive(), timeout=6.0)
+    assert agent_loop._running_loops.get(queen["id"]) is new
+
+
+# ---- stranded worker reset (satellite) ----
+
+def test_cleanup_stale_resets_stranded_workers(db, room, echo):
+    from room_tpu.server.runtime import ServerRuntime
+
+    queen = queen_of(db, room)
+    workers.set_agent_state(db, queen["id"], "running")
+    wid = workers.create_worker(db, "w2", "p", room_id=room["id"])
+    workers.set_agent_state(db, wid, "rate_limited")
+
+    rt = ServerRuntime(db=db)
+    n = rt.cleanup_stale(startup=True)
+    assert n >= 2
+    assert workers.get_worker(db, queen["id"])["agent_state"] == "idle"
+    assert workers.get_worker(db, wid)["agent_state"] == "idle"
+
+
+def test_cleanup_stale_spares_live_loops(db, room, echo):
+    from room_tpu.server.runtime import ServerRuntime
+
+    queen = queen_of(db, room)
+    handle = _start_loop(db, room, queen["id"])
+    workers.set_agent_state(db, queen["id"], "rate_limited")
+    rt = ServerRuntime(db=db)
+    rt.cleanup_stale(startup=False)  # periodic sweep, loop is alive
+    assert workers.get_worker(db, queen["id"])["agent_state"] == \
+        "rate_limited"
+    handle.stop.set()
+    handle.wake.set()
+
+
+# ---- provider fallback on crash (satellite) ----
+
+class _CrashingPrimary:
+    name = "tpu"
+    model_name = ""
+
+    def is_ready(self):
+        return True, "ok"
+
+    def execute(self, request):
+        return ExecutionResult(
+            success=False,
+            error="engine crashed: RuntimeError: injected",
+        )
+
+
+def test_crash_failed_result_reroutes_when_opted_in(monkeypatch):
+    from room_tpu.providers.registry import FallbackProvider
+
+    reset_provider_cache()
+    echo = get_model_provider("echo")
+    echo.responses.clear()
+    echo.fail_with = None
+    fb = FallbackProvider(_CrashingPrimary(), ["echo"])
+    monkeypatch.setattr(fb, "_primary_healthy", lambda: True)
+
+    # default: crash-failed result surfaces unchanged (no reroute)
+    monkeypatch.delenv("ROOM_TPU_FALLBACK_ON_CRASH", raising=False)
+    result = fb.execute(ExecutionRequest(prompt="hi"))
+    assert not result.success and "engine crashed" in result.error
+
+    echo.responses.append("fallback answer")
+    monkeypatch.setenv("ROOM_TPU_FALLBACK_ON_CRASH", "1")
+    result = fb.execute(ExecutionRequest(prompt="hi"))
+    assert result.success and result.text == "fallback answer"
+
+
+def test_crash_reroute_fails_closed_without_ready_fallback(monkeypatch):
+    from room_tpu.providers.registry import FallbackProvider
+
+    monkeypatch.setenv("ROOM_TPU_FALLBACK_ON_CRASH", "1")
+    fb = FallbackProvider(_CrashingPrimary(), [])  # empty chain
+    monkeypatch.setattr(fb, "_primary_healthy", lambda: True)
+    result = fb.execute(ExecutionRequest(prompt="hi"))
+    # chain exhausted: the original crash-failed result surfaces
+    assert not result.success and "engine crashed" in result.error
+
+
+# ---- health surface ----
+
+def test_health_route_exposes_swarm_state(http_server):
+    status, body = http_req(http_server, "GET", "/api/tpu/health")
+    assert status == 200
+    swarm = body["data"]["swarm"]
+    assert "loops_alive" in swarm and "journal" in swarm
+    assert set(swarm["journal"]) >= {"backlog", "recovered"}
+    assert "unhealthy_workers" in swarm
+
+
+# ---- multi-room crash storm (acceptance) ----
+
+class _StormProvider:
+    """Deterministic provider issuing one uniquely-bodied journaled
+    send per cycle — any duplicated body is a double-fired effect."""
+
+    name = "storm"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def is_ready(self):
+        return True, "ok"
+
+    def execute(self, request):
+        with self._lock:
+            self.n += 1
+            n = self.n
+        if request.on_tool_call:
+            request.on_tool_call(
+                "send_message", {"to": "keeper", "body": f"storm-{n}"}
+            )
+        return ExecutionResult(
+            text=f"cycle {n}", success=True,
+            session_id=request.session_id or "storm-session",
+            input_tokens=1, output_tokens=1,
+        )
+
+
+def _run_crash_storm(db, monkeypatch, n_rooms, min_cycles, min_run_s,
+                     max_s):
+    """Drive n_rooms of looping workers under armed swarm faults with
+    the supervisor running, then assert the acceptance invariants."""
+    provider = _StormProvider()
+    monkeypatch.setattr(
+        agent_loop, "get_model_provider", lambda m, d=None: provider
+    )
+    monkeypatch.setattr(agent_loop, "LOOP_RESTART_BUDGET", 10_000)
+    monkeypatch.setattr(agent_loop, "CYCLE_ERROR_GAP_S", 0.05)
+
+    room_ids = []
+    for i in range(n_rooms):
+        r = rooms.create_room(
+            db, f"storm-{i}", goal="survive", worker_model="storm",
+            create_wallet=False,
+        )
+        agent_loop.set_room_launch_enabled(r["id"], True)
+        room_ids.append(r["id"])
+        rooms.update_room(db, r["id"], queen_cycle_gap_ms=30)
+        # the queen worker row carries its own gap, which overrides the
+        # room's — shrink it too or the storm idles 30 min per cycle
+        workers.update_worker(
+            db, r["queen_worker_id"], cycle_gap_ms=30
+        )
+        agent_loop.start_agent_loop(db, r["id"], r["queen_worker_id"])
+
+    faults.inject("cycle_crash", probability=0.25, seed=11)
+    faults.inject("db_io", probability=0.003, seed=13)
+    faults.inject("tool_exec", probability=0.15, seed=17)
+    faults.inject("loop_hang", probability=0.05, latency_s=0.3, seed=19)
+
+    t0 = time.monotonic()
+    try:
+        while time.monotonic() - t0 < max_s:
+            agent_loop.supervise_loops(db)
+            time.sleep(0.1)
+            if time.monotonic() - t0 < min_run_s:
+                continue
+            try:
+                n = db.query_one(
+                    "SELECT COUNT(*) AS n FROM worker_cycles"
+                )["n"]
+            except Exception:
+                continue  # db_io fault hit the driver's own query
+            if n >= min_cycles:
+                break
+    finally:
+        faults.clear()
+        for rid in room_ids:
+            agent_loop.stop_room_loops(db, rid, "storm over")
+        storm_rooms = set(room_ids)
+        _wait(lambda: not any(
+            h.thread is not None and h.thread.is_alive()
+            for h in list(agent_loop._running_loops.values())
+            if h.room_id in storm_rooms
+        ), timeout=10.0)
+
+    started = db.query_one("SELECT COUNT(*) AS n FROM worker_cycles")["n"]
+    assert started >= min_cycles, (
+        f"storm too quiet: only {started} cycles started"
+    )
+
+    # simulated restart: journal recovery resolves interrupted work
+    journal.recover(db)
+
+    # 1. every started cycle reached a terminal state
+    stuck = db.query(
+        "SELECT * FROM worker_cycles WHERE status='running'"
+    )
+    assert not stuck, f"{len(stuck)} cycles never reached terminal state"
+    assert journal.backlog(db) == 0
+
+    # 2. no journaled side effect executed twice (unique bodies)
+    sent = db.query(
+        "SELECT content FROM chat_messages WHERE role='assistant'"
+    )
+    bodies = [r["content"] for r in sent]
+    assert len(bodies) == len(set(bodies)), "a send was double-fired"
+
+    # 3. no slot leaks anywhere
+    for rid in room_ids:
+        assert task_runner.slots.in_use(rid) == 0
+
+    return started
+
+
+def test_crash_storm_quick(db, monkeypatch):
+    """Quick tier: >=20 cycles across 2 concurrent rooms under
+    cycle_crash + db_io + tool_exec + loop_hang."""
+    started = _run_crash_storm(
+        db, monkeypatch, n_rooms=2, min_cycles=20, min_run_s=2.0,
+        max_s=15.0,
+    )
+    assert started >= 20
+
+
+@pytest.mark.slow
+def test_crash_storm_soak(db, monkeypatch):
+    """Soak tier: 3 rooms, >=30 s of sustained crash pressure."""
+    t0 = time.monotonic()
+    _run_crash_storm(
+        db, monkeypatch, n_rooms=3, min_cycles=150, min_run_s=30.5,
+        max_s=45.0,
+    )
+    assert time.monotonic() - t0 >= 30.0

@@ -5,6 +5,7 @@ triggers, secrets envelope edges, rate-limit reset parsing corners.
 learned-context.test.ts.)"""
 
 import os
+import signal
 import subprocess
 import time
 
@@ -88,6 +89,57 @@ def test_spawn_managed_registers_and_cleans():
     assert proc.pid in supervisor.managed_processes()
     proc.wait(timeout=5)
     supervisor.unregister_managed_process(proc.pid)
+
+
+def test_descendants_ps_fallback(monkeypatch):
+    """With /proc unreadable, _descendants must find the same children
+    via the `ps` fallback path."""
+    proc = subprocess.Popen(["/bin/sh", "-c", "sleep 30 & wait"])
+    try:
+        deadline = time.time() + 5
+        kids = []
+        while time.time() < deadline:
+            kids = supervisor._descendants(proc.pid)
+            if kids:
+                break
+            time.sleep(0.05)
+        assert kids, "child sleep never appeared via /proc"
+
+        real_listdir = os.listdir
+
+        def no_proc(path, *a, **kw):
+            if str(path) == "/proc":
+                raise OSError("proc unavailable")
+            return real_listdir(path, *a, **kw)
+
+        monkeypatch.setattr(os, "listdir", no_proc)
+        via_ps = supervisor._descendants(proc.pid)
+        assert set(kids) <= set(via_ps), (
+            f"/proc saw {kids}, ps fallback saw {via_ps}"
+        )
+    finally:
+        supervisor.kill_pid_tree(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=5)
+
+
+def test_terminate_sweep_forces_sigterm_ignorer():
+    """The graceful-then-forced sweep: a child that traps SIGTERM must
+    still die (SIGKILL) and leave the registry empty."""
+    proc = subprocess.Popen(
+        ["/bin/sh", "-c", "trap '' TERM; sleep 30"],
+    )
+    # wait until the trap is installed (sh execs the trap immediately,
+    # but give the process a moment to start)
+    time.sleep(0.2)
+    supervisor.register_managed_process(proc.pid, "stubborn")
+    t0 = time.time()
+    n = supervisor.terminate_managed_processes(grace_s=0.5)
+    assert n >= 1
+    proc.wait(timeout=5)
+    assert proc.returncode == -signal.SIGKILL
+    # the sweep waited for the grace window before forcing
+    assert time.time() - t0 >= 0.5
+    assert proc.pid not in supervisor.managed_processes()
 
 
 # ---- learned context ----

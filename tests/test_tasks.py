@@ -311,7 +311,8 @@ def test_runtime_restart_still_starts_loops(db, tmp_path, monkeypatch):
     rt2 = ServerRuntime(db=db)  # same DB: settings flag already set
     rt2.start()
     try:
-        assert len(rt2.threads) == n1 == 3
+        # scheduler + maintenance + inbox + supervision
+        assert len(rt2.threads) == n1 == 4
         # contact checks were not duplicated
         n_checks = db.query_one(
             "SELECT COUNT(*) AS n FROM tasks WHERE "

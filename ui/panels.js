@@ -1487,6 +1487,30 @@ async function renderTpu(el) {
         </tr>`).join("") ||
         '<tr><td class="dim" colspan="8">offload disabled / no engines warm</td></tr>'}
       </table>
+      <h2 style="margin-top:.6rem">swarm runtime</h2>
+      <div class="kv">
+        <span class="k">agent loops alive</span>
+          <span>${hl.swarm?.loops_alive ?? 0}</span>
+        <span class="k">loop restarts</span>
+          <span>${hl.swarm?.restarts ?? 0}
+            ${hl.swarm?.hang_replacements
+              ? `<span class="dim">(${hl.swarm.hang_replacements} hung)</span>`
+              : ""}</span>
+        <span class="k">loop crashes</span>
+          <span>${hl.swarm?.crashes ?? 0}</span>
+        <span class="k">unhealthy workers</span>
+          <span>${Object.keys(hl.swarm?.unhealthy_workers || {}).length
+            ? `<span class="pill failed">${
+                Object.keys(hl.swarm.unhealthy_workers).map((w) =>
+                  `#${esc(w)}`).join(" ")}</span>`
+            : '<span class="pill verified">none</span>'}</span>
+        <span class="k">journal backlog</span>
+          <span>${hl.swarm?.journal?.backlog ?? 0}</span>
+        <span class="k">recovered after crash</span>
+          <span>${hl.swarm?.journal?.recovered ?? 0}
+            <span class="dim">effects replay-skipped:
+              ${hl.swarm?.journal?.replay_consumed ?? 0}</span></span>
+      </div>
       ${Object.keys(hl.faults || {}).length
         ? `<div class="dim" style="margin-top:.4rem">armed faults: ${
             Object.entries(hl.faults).map(([n, f]) =>
