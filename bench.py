@@ -202,25 +202,28 @@ def main() -> None:
 
     _crumb("jax_imported")
 
-    # persistent compile cache: a warm run earlier in the round turns
-    # the driver's end-of-round bench into cache hits
-    try:
-        cache_dir = os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR", "/tmp/room_tpu_jax_cache"
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    # persistent compile cache (ROOM_TPU_JAX_CACHE): a warm run earlier
+    # in the round turns the driver's end-of-round bench into cache
+    # hits. The breadcrumb distinguishes a warm-start round from a
+    # cold-compile one — BENCH_r01–r05 died inside the compile
+    # watchdog with no way to tell which.
+    from room_tpu.utils.compile_cache import enable_compile_cache
+
+    cache_dir, cache_entries = enable_compile_cache()
+    _phase("compile_cache", {
+        "dir": cache_dir, "preexisting_entries": cache_entries,
+    })
+    if cache_entries:
+        _crumb("compile_cache_hit")
 
     platform = jax.devices()[0].platform
     _phase("start", {"platform": platform, "tiny": TINY,
                      "watchdog_s": WATCHDOG_S})
     if platform != "cpu":
-        # amortize host<->device round-trips (the tunnel makes per-token
-        # syncs ruinous); exact-equivalence is pinned in tests
-        os.environ.setdefault("ROOM_TPU_DECODE_CHUNK", "16")
+        # deep dispatch windows amortize host<->device round-trips (the
+        # tunnel makes per-token syncs ruinous); greedy exactness across
+        # window sizes is pinned in tests/test_decode_pipeline.py
+        os.environ.setdefault("ROOM_TPU_DECODE_STEPS_PER_DISPATCH", "16")
     import jax.numpy as jnp
 
     from room_tpu.models import qwen3
@@ -317,9 +320,14 @@ def main() -> None:
         t0 = time.perf_counter()
         eng.run_until_idle()
         dt = time.perf_counter() - t0
-        decoded = (eng.stats()["tokens_decoded"]
-                   - start["tokens_decoded"])
-        return decoded / dt, decoded, dt, eng.stats()
+        end = eng.stats()
+        decoded = end["tokens_decoded"] - start["tokens_decoded"]
+        # host-stall over the TIMED segment only (warmup compiles would
+        # otherwise swamp the per-token figure)
+        end["host_stall_ms_measured"] = round(
+            end["host_stall_ms"] - start["host_stall_ms"], 3
+        )
+        return decoded / dt, decoded, dt, end
 
     from room_tpu.serving.kv_pages import use_pallas_kernel
 
@@ -356,6 +364,14 @@ def main() -> None:
         "mfu_peak_tflops_assumed": peak_tflops,
         "flops_per_token": int(flops_tok),
         "batch": max_batch,
+        # decode-pipeline visibility (docs/serving.md): ms the host
+        # spent blocked on device drains per emitted token — the
+        # quantity the multi-step window exists to shrink
+        "steps_per_dispatch": eng_stats.get("steps_per_dispatch"),
+        "host_stall_ms_per_tok": round(
+            eng_stats.get("host_stall_ms_measured", 0.0)
+            / max(decoded, 1), 4
+        ),
     }
     if not TINY:
         # implied single-chip throughput on the full 30B target at the
@@ -397,6 +413,38 @@ def main() -> None:
         "tok_s": round(tok_s, 2), "decoded": decoded,
         "dt_s": round(dt, 2), "platform": platform, **extra,
     })
+
+    # multi-step pipeline A/B: the dispatch-window win must be visible
+    # even on CPU-only rounds — host_stall_ms_per_tok at steps=4 must
+    # come in under steps=1 (the acceptance gate for the pipeline),
+    # with tok/s riding along for the absolute picture
+    if os.environ.get("ROOM_TPU_BENCH_PIPELINE", "1") != "0":
+        prev_steps = os.environ.get("ROOM_TPU_DECODE_STEPS_PER_DISPATCH")
+        ab: dict = {}
+        try:
+            for s in (1, 4):
+                os.environ["ROOM_TPU_DECODE_STEPS_PER_DISPATCH"] = str(s)
+                _extend_deadline()
+                try:
+                    s_tok, s_dec, _, s_stats = measure()
+                    ab[f"steps{s}"] = {
+                        "tok_s": round(s_tok, 2),
+                        "host_stall_ms_per_tok": round(
+                            s_stats.get("host_stall_ms_measured", 0.0)
+                            / max(s_dec, 1), 4
+                        ),
+                    }
+                except Exception as e:
+                    ab[f"steps{s}"] = f"error: {e}"
+        finally:
+            if prev_steps is None:
+                os.environ.pop(
+                    "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", None
+                )
+            else:
+                os.environ["ROOM_TPU_DECODE_STEPS_PER_DISPATCH"] = \
+                    prev_steps
+        _phase("decode_pipeline", ab)
 
     # speculative decoding A/B on agent-shaped traffic (VERDICT r2 #8):
     # tool-call JSON repetition is the motivating case — prompt-lookup

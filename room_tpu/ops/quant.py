@@ -90,14 +90,32 @@ def qragged_dot(
 ) -> jax.Array:
     """ragged_dot over expert weights [E, in, out]; for a QTensor the
     per-(expert, out-channel) scale is gathered per row by its expert id
-    (``eid_sorted``, aligned with ``xs``)."""
-    if isinstance(w, QTensor):
-        y = jax.lax.ragged_dot(
-            xs, w.q.astype(xs.dtype), group_sizes, precision=precision
+    (``eid_sorted``, aligned with ``xs``).
+
+    On the CPU backend the grouped matmul is computed as a per-row
+    gather + einsum instead of ``lax.ragged_dot``: XLA:CPU's GSPMD
+    partitioner miscomputes ragged_dot when the expert axis is sharded
+    on a multi-axis mesh (verified on jax 0.4.37 with [E,D,F] under
+    P('ep', None, 'tp'): O(1) absolute error, the round-1 root cause of
+    the CPU-mesh token-identity xfails). The gather formulation is
+    mathematically identical row-for-row, and its all-gather of the
+    expert weights partitions correctly. CPU is the hermetic-test
+    backend, so the extra [rows, in, out] gather memory never ships to
+    TPU hardware, where ragged_dot stays the fast path."""
+    wq = w.q if isinstance(w, QTensor) else w
+    if jax.default_backend() == "cpu":
+        y = jnp.einsum(
+            "ti,tio->to", xs, wq[eid_sorted].astype(xs.dtype),
+            precision=precision,
         )
+    else:
+        y = jax.lax.ragged_dot(
+            xs, wq.astype(xs.dtype), group_sizes, precision=precision
+        )
+    if isinstance(w, QTensor):
         scale = jnp.squeeze(w.s, axis=1)[eid_sorted]  # [rows, out]
         return (y.astype(jnp.float32) * scale).astype(xs.dtype)
-    return jax.lax.ragged_dot(xs, w, group_sizes, precision=precision)
+    return y
 
 
 def qexpert_einsum(sub: str, x: jax.Array, w: Any) -> jax.Array:

@@ -1172,7 +1172,12 @@ def register_aux_routes(r: Router) -> None:
                 "fault_retries", "healthy",
                 # tiered KV offload churn (docs/kv_offload.md)
                 "offloads", "offload_restores", "offload_prefetches",
-                "offload_resident_fallbacks", "offload_reprefills")
+                "offload_resident_fallbacks", "offload_reprefills",
+                # multi-step decode pipeline (docs/serving.md): window
+                # depth, host time blocked on drains, injected-window
+                # failures and trimmed overshoot
+                "steps_per_dispatch", "host_stall_ms",
+                "decode_windows", "window_faults", "overshoot_tokens")
         summary = {
             name: {k: e[k] for k in keys if k in e}
             for name, e in engines.items()

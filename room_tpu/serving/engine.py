@@ -207,6 +207,14 @@ class ServingEngine:
         spec_tokens: Optional[int] = None,
         offload: Optional[bool] = None,
     ) -> None:
+        # persistent XLA compile cache (ROOM_TPU_JAX_CACHE): an engine
+        # jits dozens of shapes, and each process's in-memory jit cache
+        # starts empty — the disk cache makes cold-compile a per-machine
+        # cost instead of a per-process one (bench rounds died in the
+        # compile watchdog before this was wired)
+        from ..utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
         self.cfg = cfg
         self.params = params
         # multi-chip serving: cache+params live together on the mesh —
@@ -222,16 +230,23 @@ class ServingEngine:
             cfg.max_seq_len, (n_pages - 1) * page_size
         )
         self.max_pages_per_seq = -(-self.max_seq_len // page_size)
-        # tokens decoded per host round-trip: high values amortize host
-        # sync latency (vital over the TPU tunnel); finished slots waste
-        # their chunk remainder
-        env_chunk = os.environ.get("ROOM_TPU_DECODE_CHUNK")
-        if env_chunk:
-            self.decode_chunk = max(1, int(env_chunk))
-        else:
-            self.decode_chunk = (
-                8 if jax.default_backend() == "tpu" else 1
-            )
+        # multi-step decode pipeline (docs/serving.md): tokens decoded
+        # per device dispatch. Each dispatch rolls this many steps
+        # inside one jitted lax.scan — sampled ids stay on device and
+        # feed the next step's embedding lookup — and writes each
+        # step's tokens into a device-resident [steps, max_batch] ring
+        # the host drains ASYNCHRONOUSLY, double-buffered against the
+        # next dispatch: stop-token detection, stream callbacks,
+        # admission and offload sweeps overlap the in-flight window.
+        # 1 = legacy step-at-a-time behavior (dispatch + blocking
+        # drain every iteration). ROOM_TPU_DECODE_CHUNK is honored as
+        # a back-compat alias.
+        env_steps = (
+            os.environ.get("ROOM_TPU_DECODE_STEPS_PER_DISPATCH")
+            or os.environ.get("ROOM_TPU_DECODE_CHUNK")
+        )
+        self.steps_per_dispatch = max(1, int(env_steps)) if env_steps \
+            else 4
         # long prompts prefill in chunks of this width (0 disables):
         # bounds compile widths + prefill activation memory at 32k ctx
         self.prefill_chunk = int(
@@ -454,6 +469,27 @@ class ServingEngine:
         self._slot_lengths = np.zeros((max_batch,), np.int32)
         # tokens of page headroom _reserve_slot actually secured per slot
         self._reserved_tokens = np.zeros((max_batch,), np.int32)
+        # ---- multi-step decode pipeline state (docs/serving.md) ----
+        # the one window whose tokens are still on device awaiting the
+        # host drain (depth-1 double buffer: window k executes while
+        # window k-1's ring materializes + books)
+        self._inflight: Optional[dict] = None
+        # per-slot count of KV positions dispatched but not yet drained:
+        # reservations and block-table lengths must address the DEVICE's
+        # view of the sequence, which runs ahead of sess.length by one
+        # window while a dispatch is in flight
+        self._slot_ahead = np.zeros((max_batch,), np.int32)
+        # device-resident [max_batch] feed: the previous window's final
+        # sampled token per slot, consumed by the next dispatch without
+        # a host hop (rows with no undrained window feed from host)
+        self._feed_tokens: Optional[jax.Array] = None
+        # slot occupancy generation, bumped at every admission into the
+        # slot: the drain's liveness check needs it because a parked+
+        # requeued turn can re-admit into the SAME slot while the old
+        # incarnation's window is still in flight — object identity
+        # alone would then book the stale window's overshoot tokens
+        # into the fresh stream
+        self._slot_gen = np.zeros((max_batch,), np.int64)
         self._key = jax.random.PRNGKey(rng_seed)
         self._deferred_release: set[str] = set()
         self._admitting: set[str] = set()
@@ -498,6 +534,11 @@ class ServingEngine:
             "offload_restores": 0, "offload_pages_in": 0,
             "offload_prefetches": 0, "offload_resident_fallbacks": 0,
             "offload_reprefills": 0,
+            # decode-pipeline telemetry (docs/serving.md): cumulative ms
+            # the host spent BLOCKED on a device drain, and windows whose
+            # dispatch failed under an injected decode_window fault
+            "host_stall_ms": 0.0, "decode_windows": 0,
+            "window_faults": 0, "overshoot_tokens": 0,
         }
         from collections import Counter
 
@@ -580,6 +621,16 @@ class ServingEngine:
             self._spec_ratio_cache[bucket] = got
         return got
 
+    def _bump(self, key: str, n=1) -> None:
+        """Counter mutation under the engine lock. stats() snapshots
+        under the same lock from HTTP/route threads, so engine-thread
+        increments must not race the dict copy — the async drain makes
+        the window where a route thread reads mid-update much wider
+        than the old synchronous loop did. Never called while holding
+        _lock (it would self-deadlock on the non-reentrant lock)."""
+        with self._lock:
+            self._stats[key] += n
+
     def _note_pressure(self) -> None:
         with self._pressure_lock:
             self._pressure.append(time.monotonic())
@@ -620,7 +671,7 @@ class ServingEngine:
             except FaultError as e:
                 if not e.transient or attempt >= self.fault_retries:
                     raise
-                self._stats["fault_retries"] += 1
+                self._bump("fault_retries")
                 time.sleep(delay)
                 delay = min(delay * 2, 1.0)
 
@@ -640,7 +691,8 @@ class ServingEngine:
         self._active[slot] = None
         self._slot_tables[slot] = 0
         self._slot_lengths[slot] = 0
-        self._stats["requeues"] += 1
+        self._slot_ahead[slot] = 0
+        self._bump("requeues")
         self._queue_put(turn)
         # a stall-watchdog park under pool pressure hibernates the
         # session too — its requeued turn restores via prefetch (or at
@@ -655,7 +707,7 @@ class ServingEngine:
         per-turn) and notes ladder pressure."""
         if self.step_stall_s <= 0 or elapsed <= self.step_stall_s:
             return
-        self._stats["stall_events"] += 1
+        self._bump("stall_events")
         self._note_pressure()
         for i in active_idx:
             turn = self._active[i]
@@ -671,7 +723,7 @@ class ServingEngine:
                     now < turn.deadline:
                 continue
             turn.error = "deadline exceeded"
-            self._stats["deadline_timeouts"] += 1
+            self._bump("deadline_timeouts")
             self._finish_turn(i, turn, "error")
 
     def _shed_if_overloaded(self) -> None:
@@ -698,7 +750,7 @@ class ServingEngine:
             t.error = ("shedding load: engine degraded under sustained "
                        "pressure; retry later")
             t.finish_reason = "error"
-            self._stats["shed_turns"] += 1
+            self._bump("shed_turns")
             t.done.set()
 
     def _fail_turn_unslotted(self, turn: Turn, msg: str) -> None:
@@ -715,7 +767,7 @@ class ServingEngine:
         the engine unhealthy, which fail-closes the tpu: provider into
         registry fallback — once crashes exceed the restart budget
         within the pressure window."""
-        self._stats["engine_crashes"] += 1
+        self._bump("engine_crashes")
         self._note_pressure()
         try:
             from ..core.telemetry import incr_counter
@@ -750,6 +802,12 @@ class ServingEngine:
         self._slot_tables[:] = 0
         self._slot_lengths[:] = 0
         self._reserved_tokens[:] = 0
+        # the in-flight window's futures may hold the crash exception
+        # (or a donated-away cache): drop them with the rest of the
+        # device state — its turns were failed above
+        self._inflight = None
+        self._slot_ahead[:] = 0
+        self._feed_tokens = None
         # host/disk copies reference sessions that no longer exist (and
         # a crash mid-restore may have half-consumed one): drop them all
         if self.offload_store is not None:
@@ -815,11 +873,24 @@ class ServingEngine:
     def _decode_fn(self, n_steps: int,
                    active_pages: Optional[int] = None,
                    penalized: bool = False):
-        """One compiled function advancing every slot ``n_steps`` tokens
-        with a single host round-trip (lax.scan over the decode step).
-        Slots that hit a stop mid-chunk keep generating; the host trims
-        — their extra KV writes sit beyond the session length and are
+        """One compiled dispatch window advancing every slot ``n_steps``
+        tokens (lax.scan over the fused forward+sample step). Sampled
+        ids never leave the device inside the window: each step's token
+        feeds the next step's embedding lookup directly, and every
+        step writes its sampled row into the [n_steps, max_batch] ring
+        (stacked scan output) the host drains asynchronously. Slots
+        that hit a stop mid-window keep generating; the host trims —
+        their extra KV writes sit beyond the session length and are
         overwritten on resume.
+
+        Inputs are split so the window can chain off the PREVIOUS
+        window without a host hop: ``prev_tokens`` is the last ring
+        column of the prior dispatch (device-resident), ``fresh_tokens``
+        / ``fresh_mask`` override rows whose feed the host owns (new
+        admissions, post-flush rows). ``active_mask`` marks live slots:
+        finished/parked rows keep their static batch lane but emit pad
+        tokens (and never bump penalty counts) instead of forcing an
+        early exit or a recompile.
 
         ``penalized`` compiles the OpenAI presence/frequency-penalty
         variant: a [B, V] per-request generated-token count array rides
@@ -829,12 +900,16 @@ class ServingEngine:
         key = ("decode", n_steps, active_pages, penalized)
         if key not in self._jit_cache:
             cfg = self.cfg
+            pad_id = self.tokenizer.pad_id
 
             @partial(jax.jit,
                      donate_argnums=(1, 2) if penalized else (1,))
-            def decode(params, cache, counts, tokens, block_tables,
-                       lengths, rng, temperature, top_p, top_k,
+            def decode(params, cache, counts, prev_tokens, fresh_tokens,
+                       fresh_mask, active_mask, block_tables, lengths,
+                       rng, temperature, top_p, top_k,
                        presence, frequency):
+                tokens = jnp.where(fresh_mask, fresh_tokens, prev_tokens)
+
                 def step(carry, step_rng):
                     toks, cache, lens, cnts = carry
                     hook = make_paged_kv_hook(
@@ -855,17 +930,22 @@ class ServingEngine:
                         row_logits, step_rng, temperature, top_p,
                         top_k,
                     )
+                    nxt = jnp.where(
+                        active_mask, nxt, jnp.int32(pad_id)
+                    )
                     if penalized:
+                        # masked lanes must not pollute their slot's
+                        # count row with pad garbage
                         cnts = cnts.at[
                             jnp.arange(nxt.shape[0]), nxt
-                        ].add(1)
+                        ].add(active_mask.astype(jnp.int32))
                     return (nxt, cache, lens + 1, cnts), nxt
 
-                (_, cache, _, counts), out = jax.lax.scan(
+                (_, cache, _, counts), ring = jax.lax.scan(
                     step, (tokens, cache, lengths, counts),
                     jax.random.split(rng, n_steps),
                 )
-                return out.T, counts, \
+                return ring.T, counts, \
                     self._constrain_cache(cache)  # [B, n_steps]
 
             self._jit_cache[key] = decode
@@ -1067,6 +1147,8 @@ class ServingEngine:
     def stats(self) -> dict:
         with self._lock:
             out = dict(self._stats)
+        out["host_stall_ms"] = round(out["host_stall_ms"], 3)
+        out["steps_per_dispatch"] = self.steps_per_dispatch
         out["phases"] = self.timer.snapshot()
         out["queued"] = self._queue.qsize()
         # which attention path decode/prefill actually route through
@@ -1126,6 +1208,13 @@ class ServingEngine:
                     if not self._recover_from_crash(e):
                         return
         finally:
+            # a window still on device at shutdown carries real tokens:
+            # drain it so waiting callers see their final stream (a
+            # window whose computation itself died is just dropped)
+            try:
+                self._flush_pipeline()
+            except Exception:
+                self._inflight = None
             with self._lock:
                 self._loop_thread = None
             # releases enqueued while stopping still apply
@@ -1183,7 +1272,7 @@ class ServingEngine:
         self.page_table.release(victim.id)
         self._release_session_prefix(victim)
         victim.length = 0
-        self._stats["evictions"] += 1
+        self._bump("evictions")
         return True
 
     def _evict_prefix(self) -> bool:
@@ -1200,7 +1289,7 @@ class ServingEngine:
         self._prefix_lengths[victim.length] -= 1
         if self._prefix_lengths[victim.length] <= 0:
             del self._prefix_lengths[victim.length]
-        self._stats["prefix_evictions"] += 1
+        self._bump("prefix_evictions")
         return True
 
     # ---- tiered KV offload (kv_offload.py, docs/kv_offload.md) ----
@@ -1256,13 +1345,13 @@ class ServingEngine:
                     for k, a in out.items()
                 }
         except FaultError:
-            self._stats["offload_resident_fallbacks"] += 1
+            self._bump("offload_resident_fallbacks")
             self._note_pressure()
             return False
         entry = store.put(sess.id, host, own_tokens, n_used)
         self.page_table.release(sess.id)
-        self._stats["offloads"] += 1
-        self._stats["offload_pages_out"] += n_used
+        self._bump("offloads")
+        self._bump("offload_pages_out", n_used)
         try:
             from ..core.telemetry import incr_counter
 
@@ -1337,14 +1426,14 @@ class ServingEngine:
             self.page_table.release(sess.id)
             store.discard(sess.id)
             sess.length = 0
-            self._stats["offload_reprefills"] += 1
+            self._bump("offload_reprefills")
             self._note_pressure()
             return False
         store.discard(sess.id)
         elapsed = time.monotonic() - t0
         store.observe_restore(elapsed, entry.nbytes)
-        self._stats["offload_restores"] += 1
-        self._stats["offload_pages_in"] += n_used
+        self._bump("offload_restores")
+        self._bump("offload_pages_in", n_used)
         try:
             from ..core.telemetry import incr_counter, observe_ms
 
@@ -1373,7 +1462,7 @@ class ServingEngine:
             # no copy to restore: |history| == length always, so the
             # restoring path in _prepare_turn_inner rebuilds the
             # context exactly
-            self._stats["offload_reprefills"] += 1
+            self._bump("offload_reprefills")
             sess.length = 0
 
     def _offload_coldest(self, exclude: str) -> bool:
@@ -1446,7 +1535,7 @@ class ServingEngine:
                 # pages — admission (which may evict) restores the rest
                 if self._restore_session(sess, evict=False):
                     budget -= 1
-                    self._stats["offload_prefetches"] += 1
+                    self._bump("offload_prefetches")
             except MemoryError:
                 return   # pool busy; admission will retry
 
@@ -1559,7 +1648,7 @@ class ServingEngine:
                     if turn.requeues > self.max_requeues:
                         self._fail_turn_unslotted(turn, str(e))
                     else:
-                        self._stats["requeues"] += 1
+                        self._bump("requeues")
                         self._queue_put(turn)
                     continue
                 if prep is not None:
@@ -1634,7 +1723,7 @@ class ServingEngine:
         way, so a requeue re-prepares from scratch losing nothing."""
         if turn.deadline is not None and \
                 time.monotonic() > turn.deadline:
-            self._stats["deadline_timeouts"] += 1
+            self._bump("deadline_timeouts")
             self._fail_turn_unslotted(
                 turn, "deadline exceeded while queued"
             )
@@ -1705,6 +1794,13 @@ class ServingEngine:
             turn.sampling.max_new_tokens - len(turn.new_tokens), 1
         )
         if total + remaining_budget > self.max_seq_len:
+            if turn._mid_stream:
+                # a mid-generation requeue (stall park, degraded
+                # reservation) that ran out of context: the stream
+                # legitimately ends at the tokens already delivered
+                turn.finish_reason = "length"
+                turn.done.set()
+                return None
             turn.error = (
                 f"sequence would exceed max_seq_len {self.max_seq_len}"
             )
@@ -1729,8 +1825,8 @@ class ServingEngine:
                 sess.length = hit.length
                 sess.history = list(prompt[: hit.length])
                 prompt = prompt[hit.length:]
-                self._stats["prefix_hits"] += 1
-                self._stats["prefix_tokens_reused"] += hit.length
+                self._bump("prefix_hits")
+                self._bump("prefix_tokens_reused", hit.length)
             else:
                 register_entry = self._prefix_register(sess, prompt)
 
@@ -1846,7 +1942,7 @@ class ServingEngine:
 
         with self.timer.phase(f"prefill_write_{width}"):
             self.cache = self._retrying("prefill_write", call)
-        self._stats["prefill_tokens"] += width
+        self._bump("prefill_tokens", width)
         sess.length += width
         sess.history.extend(toks)
 
@@ -1912,7 +2008,7 @@ class ServingEngine:
                 if turn.requeues > self.max_requeues:
                     self._fail_turn_unslotted(turn, str(e))
                 else:
-                    self._stats["requeues"] += 1
+                    self._bump("requeues")
                     self._queue_put(turn)
             return
         with self.timer.phase(f"prefill_{bucket}x{n}_sample"):
@@ -1946,7 +2042,7 @@ class ServingEngine:
 
         for r, (prep, slot) in enumerate(zip(group, slots)):
             turn, sess = prep["turn"], prep["sess"]
-            self._stats["prefill_tokens"] += len(prep["prompt"])
+            self._bump("prefill_tokens", len(prep["prompt"]))
             sess.length += len(prep["prompt"])
             sess.history.extend(prep["prompt"])
             # a prefix this session registered is fully written now
@@ -1956,6 +2052,7 @@ class ServingEngine:
                     entry.ready = True
             self._slot_tables[slot] = prep["table"]
             self._slot_lengths[slot] = sess.length
+            self._slot_gen[slot] += 1
             self._active[slot] = turn
             self._append_token(slot, turn, int(firsts[r]))
 
@@ -1985,15 +2082,35 @@ class ServingEngine:
 
     def _reserve_slot(self, i: int, want_tokens: int) -> bool:
         """Reserve pages so slot ``i``'s session can hold
-        length+want_tokens (clamped to capacity), degrading to a single
+        base+want_tokens (clamped to capacity), degrading to a single
         token under pool pressure; device writes past the reservation
         divert to the scratch page and the host trims. Finishes the
         turn with an error only when even one token won't fit. Updates
-        the slot's block table + length row."""
+        the slot's block table + length row.
+
+        ``base`` is the DEVICE's view of the sequence: sess.length plus
+        any positions an undrained in-flight window has already been
+        dispatched to write (_slot_ahead) — the next window's KV lands
+        after those, whether or not the host has drained them yet."""
         turn = self._active[i]
         sess = self.sessions[turn.session_id]
         capacity = self.max_pages_per_seq * self.page_size
-        target = min(sess.length + want_tokens, capacity)
+        base = sess.length + int(self._slot_ahead[i])
+        if base >= capacity:
+            if self._slot_ahead[i] > 0:
+                # an undrained window still covers this row: its drain
+                # settles the turn from REAL state (budget finish, or
+                # trim+park at the reservation clamp) — sit the row out
+                # of this dispatch rather than finishing on the
+                # speculative length
+                return False
+            # context capacity exhausted with budget remaining: the
+            # stream legitimately ends here — dispatching the row would
+            # only produce scratch-diverted writes the drain must park
+            # away with zero progress
+            self._finish_turn(i, turn, "length")
+            return False
+        target = min(base + want_tokens, capacity)
         try:
             pages = self._ensure_capacity_evicting(
                 sess.id, target - sess.prefix_len
@@ -2003,7 +2120,7 @@ class ServingEngine:
             # finishing within its current pages must not die because
             # the full chunk couldn't be reserved
             try:
-                target = min(sess.length + 1, capacity)
+                target = min(base + 1, capacity)
                 pages = self._ensure_capacity_evicting(
                     sess.id, target - sess.prefix_len
                 )
@@ -2016,15 +2133,29 @@ class ServingEngine:
         # stale entries from a previous occupant of this slot must
         # never receive overrun writes — point them at scratch
         self._slot_tables[i, len(all_pages):] = 0
-        self._slot_lengths[i] = sess.length
-        self._reserved_tokens[i] = target - sess.length
+        self._slot_lengths[i] = base
+        self._reserved_tokens[i] = target - base
         return True
 
     def _decode_once(self) -> int:
+        """One decode iteration of the scheduler.
+
+        steps_per_dispatch == 1 (legacy): dispatch one step and drain
+        it synchronously, exactly the old loop.
+
+        steps_per_dispatch > 1 (pipeline, docs/serving.md): dispatch
+        window k FIRST, then drain window k-1 — so all of k-1's host
+        work (stop detection, stream callbacks, detokenization, and
+        next iteration's admission/offload scheduling) overlaps k's
+        device execution. A stop/park the drain discovers is
+        reconciled at the NEXT dispatch boundary: the finished slot is
+        masked out of window k+1 and its window-k overshoot tokens are
+        trimmed, which keeps greedy output token-identical to the
+        step-at-a-time engine."""
         active_idx = [
             i for i, t in enumerate(self._active) if t is not None
         ]
-        if not active_idx:
+        if not active_idx and self._inflight is None:
             return 0
         # spec verify has no penalty path: penalized rows take the
         # sequential scan (their counts stay exact) while the rest of
@@ -2033,9 +2164,18 @@ class ServingEngine:
         # ladder rung 1: speculation off under pressure — verify rounds
         # amplify device load exactly when the engine can least afford it
         n_spec = 0
-        if self.spec_tokens > 0 and \
+        spec_ran = False
+        if active_idx and self.spec_tokens > 0 and \
                 self._stats["tokens_decoded"] >= self._spec_resume_at \
                 and self.degradation_level() < 1:
+            # drafting reads each session's host-side history, which an
+            # undrained window is still ahead of: speculation composes
+            # with the pipeline by flushing it at the round boundary
+            # (spec rounds are themselves one-dispatch-one-drain)
+            self._flush_pipeline()
+            active_idx = [
+                i for i, t in enumerate(self._active) if t is not None
+            ]
             spec_rows = [
                 i for i in active_idx
                 if not self._active[i].sampling.penalized
@@ -2044,39 +2184,129 @@ class ServingEngine:
             if spec_rows:
                 r = self._decode_once_spec(list(spec_rows))
                 if r is not None:
+                    spec_ran = True
                     if not pen_rows:
                         return r
                     n_spec = r
-                    self._stats["spec_rows_sequential"] += len(pen_rows)
+                    self._bump("spec_rows_sequential", len(pen_rows))
                     # the scan below runs for the penalized rows only;
                     # _slot_arrays_excluding diverts the spec rows (now
                     # stale) to the scratch page
                     active_idx = pen_rows
-                # None: no row drafted anything; the chunked scan below
+                # None: no row drafted anything; the windowed scan below
                 # advances the whole batch together (it amortizes host
                 # round-trips)
 
+        if self.steps_per_dispatch == 1 or spec_ran:
+            # legacy / spec-mixed iteration: dispatch + blocking drain
+            # (a spec round already forced a flush, and its slot state
+            # must not run a window ahead of the next round's drafts)
+            window = None
+            if active_idx:
+                try:
+                    window = self._dispatch_window(active_idx)
+                except FaultError as e:
+                    if getattr(e, "point", None) != "decode_window":
+                        raise   # decode_step budget: crash supervisor
+                    self._fail_window_turns(active_idx, e)
+            if window is None:
+                return n_spec
+            return n_spec + self._drain_window(window)
+
+        prev, self._inflight = self._inflight, None
+        window_fault: Optional[FaultError] = None
+        if active_idx:
+            try:
+                self._inflight = self._dispatch_window(active_idx)
+            except FaultError as e:
+                if getattr(e, "point", None) != "decode_window":
+                    # decode_step past its budget heads for the crash
+                    # supervisor — but the previous window's tokens are
+                    # real; deliver them before the supervisor fails
+                    # everything pending
+                    if prev is not None:
+                        self._drain_window(prev)
+                    raise
+                window_fault = e
+        n = self._drain_window(prev) if prev is not None else 0
+        if window_fault is not None:
+            # fail the faulted window's turns only AFTER the previous
+            # window drained: its tokens are real, the device computed
+            # them, and the fault's contract is to lose ONLY the
+            # faulted window (a turn the drain just completed normally
+            # isn't failed at all)
+            self._fail_window_turns(active_idx, window_fault)
+        active_now = sum(1 for t in self._active if t is not None)
+        if active_now == 0 and self._inflight is None:
+            return n_spec
+        # non-zero while a window is still in flight so serve_forever /
+        # run_until_idle never declare idle with tokens on device
+        return n_spec + max(n, active_now, 1)
+
+    def _fail_window_turns(self, active_idx: list[int],
+                           err: FaultError) -> None:
+        """decode_window fault past its retry budget: fail exactly the
+        turns that were in the faulted window and still need tokens.
+        Queued work, parked sessions, and the page pool are untouched —
+        sessions keep their pages and KV, so nothing leaks."""
+        for i in active_idx:
+            turn = self._active[i]
+            if turn is not None:
+                turn.error = str(err)
+                self._finish_turn(i, turn, "error")
+
+    def _flush_pipeline(self) -> int:
+        """Drain the in-flight window, if any (spec round boundaries,
+        shutdown). Returns rows advanced."""
+        prev, self._inflight = self._inflight, None
+        return self._drain_window(prev) if prev is not None else 0
+
+    def _dispatch_window(self, active_idx: list[int]) -> Optional[dict]:
+        """Reserve pages and launch one decode window (non-blocking:
+        the jitted call returns futures). Returns the window record the
+        drain consumes, or None when nothing could dispatch. An
+        injected decode_window fault past its retry budget raises
+        FaultError for the CALLER to handle (it drains the previous
+        window first so its real tokens are delivered, then fails this
+        window's turns); ``active_idx`` is mutated in place to the rows
+        that were actually in the window."""
+        steps = self.steps_per_dispatch
         penalized = any(
             self._active[i].sampling.penalized for i in active_idx
         )
-        chunk = self.decode_chunk
         # ensure pages only for tokens the turn can actually accept:
-        # min(chunk, its remaining budget), clamped to capacity
+        # min(steps, its remaining budget net of undrained positions),
+        # clamped to capacity. The scan still writes `steps` positions;
+        # writes past the reservation divert to scratch and the host
+        # trims the overshoot at drain.
         for i in list(active_idx):
             turn = self._active[i]
             remaining = max(
-                turn.sampling.max_new_tokens - len(turn.new_tokens), 1
+                turn.sampling.max_new_tokens - len(turn.new_tokens)
+                - int(self._slot_ahead[i]), 1
             )
-            if not self._reserve_slot(i, min(chunk, remaining)):
+            if not self._reserve_slot(i, min(steps, remaining)):
                 active_idx.remove(i)
         if not active_idx:
-            return n_spec
+            return None
 
-        tokens = np.zeros((self.max_batch,), np.int32)
+        # rows whose feed token the host owns (no undrained window):
+        # new admissions, first window after a flush. Everything else
+        # chains off the previous window's on-device ring tail.
+        fresh_tokens = np.zeros((self.max_batch,), np.int32)
+        fresh_mask = np.zeros((self.max_batch,), bool)
+        active_mask = np.zeros((self.max_batch,), bool)
         for i in active_idx:
             t = self._active[i]
-            tokens[i] = t.new_tokens[-1] if t.new_tokens else \
-                t.prompt_tokens[-1]
+            active_mask[i] = True
+            if self._slot_ahead[i] == 0 or self._feed_tokens is None:
+                fresh_mask[i] = True
+                fresh_tokens[i] = t.new_tokens[-1] if t.new_tokens \
+                    else t.prompt_tokens[-1]
+        if self._feed_tokens is None:
+            self._feed_tokens = self._place_batch(
+                np.zeros((self.max_batch,), np.int32)
+            )
 
         temps = np.ones((self.max_batch,), np.float32)
         top_ps = np.ones((self.max_batch,), np.float32)
@@ -2095,7 +2325,7 @@ class ServingEngine:
             max_len = max(
                 int(self._slot_lengths[i]) for i in active_idx
             )
-            ap = self._pages_bucket(max_len + chunk)
+            ap = self._pages_bucket(max_len + steps)
         if penalized:
             presence = np.zeros((self.max_batch,), np.float32)
             frequency = np.zeros((self.max_batch,), np.float32)
@@ -2111,21 +2341,28 @@ class ServingEngine:
         else:
             counts = jnp.int32(0)
             pen_args = (jnp.float32(0), jnp.float32(0))
-        decode = self._decode_fn(chunk, ap, penalized)
+        decode = self._decode_fn(steps, ap, penalized)
         scan_tables, scan_lengths = \
             self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
 
         def call():
-            # chaos fault points: transient device error (retried with
-            # backoff) and injected stall latency (trips the watchdog)
+            # chaos fault points: decode_window fails ONLY this
+            # window's turns (caught below); decode_step models a
+            # transient device error retried with backoff and escalates
+            # to the crash supervisor past its budget; decode_stall
+            # injects latency that trips the watchdog
+            faults.maybe_fail("decode_window")
             faults.maybe_fail("decode_step")
             faults.maybe_delay("decode_stall")
             return decode(
                 self.params,
                 self.cache,
                 counts,
-                self._place_batch(tokens),
+                self._feed_tokens,
+                self._place_batch(fresh_tokens),
+                self._place_batch(fresh_mask),
+                self._place_batch(active_mask),
                 self._place_batch(scan_tables),
                 self._place_batch(scan_lengths),
                 sub,
@@ -2136,36 +2373,128 @@ class ServingEngine:
             )
 
         t0 = time.monotonic()
-        with self.timer.phase("decode"):
-            next_tokens, counts_out, self.cache = \
-                self._retrying("decode", call)
-            if penalized:
-                self._counts = counts_out
-            next_host = np.asarray(next_tokens)   # [B, chunk]
-        step_elapsed = time.monotonic() - t0
-        self._stats["decode_steps"] += 1
-
+        try:
+            with self.timer.phase("decode"):
+                ring, counts_out, self.cache = \
+                    self._retrying("decode", call)
+        except FaultError as e:
+            if getattr(e, "point", None) != "decode_window":
+                raise   # decode_step past its budget: crash supervisor
+            # window-scoped failure: note it and let the caller fail
+            # the turns — AFTER draining any previous window, whose
+            # already-computed tokens must still be delivered
+            self._note_pressure()
+            self._bump("window_faults")
+            raise
+        if penalized:
+            self._counts = counts_out
+        # the ring tail feeds the next dispatch without a host hop
+        self._feed_tokens = ring[:, -1]
+        # start the device->host copy NOW so it overlaps whatever the
+        # host does before the drain materializes it
+        try:
+            ring.copy_to_host_async()
+        except AttributeError:
+            pass
         for i in active_idx:
-            turn = self._active[i]
+            self._slot_ahead[i] += steps
+        self._bump("decode_steps")
+        self._bump("decode_windows")
+        return {
+            "ring": ring,
+            "active_idx": list(active_idx),
+            "turns": {i: self._active[i] for i in active_idx},
+            "gen": {i: int(self._slot_gen[i]) for i in active_idx},
+            # headroom actually secured per row at dispatch (the degrade
+            # path can grant a single token): the drain accepts at most
+            # this many tokens per row — writes past it went to scratch
+            "reserved": {
+                i: int(self._reserved_tokens[i]) for i in active_idx
+            },
+            "steps": steps,
+            # time spent inside the decode dispatch itself (injected
+            # stalls, retry backoff, this function's own jit compile) —
+            # the stall watchdog's input, so host work between dispatch
+            # and drain (admission prefill compiles, offload sweeps)
+            # can't masquerade as a device stall
+            "dispatch_s": time.monotonic() - t0,
+        }
+
+    def _drain_window(self, window: dict) -> int:
+        """Materialize a window's ring buffer and run the host-side
+        bookkeeping: history/length advance, stop-token + stop-string
+        detection, stream callbacks, finish/park transitions. Rows
+        whose turn left its slot since dispatch (stop or park found in
+        an earlier drain, deadline, requeue) are overshoot — their
+        tokens are trimmed and their KV writes sit past the recorded
+        session length, overwritten on resume."""
+        t0 = time.monotonic()
+        with self.timer.phase("decode_drain"):
+            ring_host = np.asarray(window["ring"])   # [B, steps]
+        wait_s = time.monotonic() - t0
+        self._bump("host_stall_ms", wait_s * 1000.0)
+        steps = window["steps"]
+        decoded = 0
+        overshoot = 0
+        live_idx: list[int] = []
+        for i in window["active_idx"]:
+            turn = window["turns"][i]
+            if self._active[i] is not turn or \
+                    int(self._slot_gen[i]) != window["gen"][i]:
+                # late reconciliation: the slot was finished/parked (or
+                # reused — possibly by a requeued incarnation of the
+                # SAME turn, which the generation counter catches)
+                # after this window dispatched: every token it produced
+                # for the row is overshoot
+                overshoot += steps
+                continue
+            self._slot_ahead[i] = max(
+                0, int(self._slot_ahead[i]) - steps
+            )
+            live_idx.append(i)
             sess = self.sessions[turn.session_id]
-            for j in range(chunk):
-                # step j wrote the previous token at position `length`
-                # and sampled next_host[i, j]
+            prev_tok = turn.new_tokens[-1] if turn.new_tokens else \
+                turn.prompt_tokens[-1]
+            reserved = window["reserved"][i]
+            for j in range(steps):
+                if j >= reserved:
+                    # degraded reservation (pool pressure granted fewer
+                    # than `steps` positions): this step's input KV went
+                    # to the scratch page, so the chain past it attended
+                    # garbage. Park on the last durably-written token —
+                    # it becomes the session's pending token, exactly
+                    # the mid-stream requeue contract — and let
+                    # re-admission re-materialize it with a fresh
+                    # reservation. Greedy streams stay identical to the
+                    # step-at-a-time engine.
+                    overshoot += steps - j
+                    self._park_and_requeue(i, turn)
+                    break
+                # step j wrote the previous token's KV at `length` and
+                # sampled ring_host[i, j]
                 sess.history.append(
-                    int(tokens[i]) if j == 0 else int(next_host[i, j - 1])
+                    prev_tok if j == 0 else int(ring_host[i, j - 1])
                 )
                 sess.length += 1
-                self._stats["tokens_decoded"] += 1
-                self._append_token(i, turn, int(next_host[i, j]))
+                decoded += 1
+                self._append_token(i, turn, int(ring_host[i, j]))
                 if self._active[i] is not turn:
-                    # turn finished mid-chunk: the remaining sampled
+                    # turn finished mid-window: the remaining sampled
                     # tokens (and their KV writes past sess.length) are
                     # discarded
+                    overshoot += steps - 1 - j
                     break
+        if decoded:
+            self._bump("tokens_decoded", decoded)
+        if overshoot:
+            self._bump("overshoot_tokens", overshoot)
         # after the bookkeeping so parked sessions carry every token
-        # the slow step actually produced
-        self._handle_stall(active_idx, step_elapsed)
-        return n_spec + len(active_idx)
+        # the slow window actually produced. Elapsed = time blocked in
+        # the dispatch call + time blocked materializing the ring: a
+        # stalled device surfaces in one of the two, while host work
+        # that merely overlapped a healthy window counts in neither.
+        self._handle_stall(live_idx, window["dispatch_s"] + wait_s)
+        return len(live_idx)
 
     def _decode_once_spec(self, active_idx: list[int]) -> Optional[int]:
         """One speculative round: active slots draft continuation tokens
@@ -2206,6 +2535,19 @@ class ServingEngine:
             drafts[i] = (last, p)
             n_proposed += len(p)
         if n_proposed == 0:
+            # nothing draftable this round. In pipelined mode the probe
+            # itself cost a full pipeline flush, so close the gate for
+            # a cooldown (the same bound an unprofitable round pays)
+            # instead of re-flushing every iteration on non-repetitive
+            # traffic — otherwise spec_tokens>0 (the deployment
+            # default) would silently disable the dispatch-window
+            # overlap exactly where it matters. Legacy mode keeps the
+            # zero-cost every-round probe.
+            if self.steps_per_dispatch > 1:
+                self._spec_resume_at = (
+                    self._stats["tokens_decoded"]
+                    + self.spec_cooldown_len * len(active_idx)
+                )
             return None
 
         # round-profitability gate: expected emission this round (per
@@ -2243,7 +2585,7 @@ class ServingEngine:
                 self._spec_ratio = self._spec_ratio_for(mean_ctx)
                 profitable = exp_emit >= self._spec_ratio * n_act
             if not profitable:
-                self._stats["spec_throttles"] += 1
+                self._bump("spec_throttles")
                 self._spec_resume_at = (
                     self._stats["tokens_decoded"]
                     + self.spec_cooldown_len * n_act
@@ -2322,12 +2664,14 @@ class ServingEngine:
             residual = np.asarray(residual_d)  # [B, width-1]
             plain = np.asarray(plain_d)       # [B, width]
         step_elapsed = time.monotonic() - t0
-        self._stats["decode_steps"] += 1
-        self._stats["spec_rounds"] += 1
-        self._stats["spec_proposed"] += sum(
+        self._bump("decode_steps")
+        self._bump("spec_rounds")
+        self._bump("spec_proposed", sum(
             len(props[i]) for i in active_idx
-        )
+        ))
 
+        n_decoded = 0
+        n_accepted = 0
         for i in active_idx:
             turn = self._active[i]
             sess = self.sessions[turn.session_id]
@@ -2357,15 +2701,19 @@ class ServingEngine:
                     int(tokens[i, 0]) if j == 0 else emitted[j - 1]
                 )
                 sess.length += 1
-                self._stats["tokens_decoded"] += 1
+                n_decoded += 1
                 # emitted[j] for j < accepted is a consumed draft token
                 # (count only drafts the turn actually kept — a stop
                 # token mid-window discards the rest)
                 if j < len(props[i]) and j < len(emitted) - 1:
-                    self._stats["spec_accepted"] += 1
+                    n_accepted += 1
                 self._append_token(i, turn, tok)
                 if self._active[i] is not turn:
                     break
+        if n_decoded:
+            self._bump("tokens_decoded", n_decoded)
+        if n_accepted:
+            self._bump("spec_accepted", n_accepted)
         self._handle_stall(active_idx, step_elapsed)
         return len(active_idx)
 
@@ -2427,7 +2775,11 @@ class ServingEngine:
         # pages that get reallocated to another session
         self._slot_tables[slot] = 0
         self._slot_lengths[slot] = 0
-        self._stats["turns_completed"] += 1
+        # an in-flight window that still covers this slot reconciles at
+        # its drain via the turn-identity check; the slot's NEXT
+        # occupant starts with no undrained positions
+        self._slot_ahead[slot] = 0
+        self._bump("turns_completed")
         if sess.id in self._deferred_release:
             self._deferred_release.discard(sess.id)
             self.sessions.pop(sess.id, None)

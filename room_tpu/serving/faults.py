@@ -8,6 +8,10 @@ named *fault point* that tests (and staging deployments) can arm:
     kv_alloc           page allocation fails (MemoryError)
     prefill_oom        prefill device call fails (transient)
     decode_step        decode device call fails (transient)
+    decode_window      multi-step dispatch window fails: the engine
+                       fails ONLY the turns in that window (queued
+                       work, parked sessions, and the page pool are
+                       untouched; docs/serving.md)
     decode_stall       decode step sleeps `latency` seconds
     tokenizer          tokenizer encode/decode fails (transient)
     engine_crash       scheduler iteration raises (non-transient)
@@ -56,8 +60,8 @@ __all__ = [
 ]
 
 FAULT_POINTS = (
-    "kv_alloc", "prefill_oom", "decode_step", "decode_stall",
-    "tokenizer", "engine_crash", "client_disconnect",
+    "kv_alloc", "prefill_oom", "decode_step", "decode_window",
+    "decode_stall", "tokenizer", "engine_crash", "client_disconnect",
     "provider_timeout", "offload_io",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
@@ -68,11 +72,16 @@ class FaultError(RuntimeError):
     """An injected fault. ``transient`` marks faults the caller should
     retry with backoff (allocation races, flaky device calls); a
     non-transient fault models a real crash and must propagate to the
-    supervisor."""
+    supervisor. ``point`` names the fault point that fired — recovery
+    paths that scope differently per point (decode_window fails only
+    the window's turns; decode_step escalates to the crash supervisor)
+    must dispatch on it, never on the message text."""
 
-    def __init__(self, message: str, transient: bool = True) -> None:
+    def __init__(self, message: str, transient: bool = True,
+                 point: Optional[str] = None) -> None:
         super().__init__(message)
         self.transient = transient
+        self.point = point
 
 
 @dataclass
@@ -227,7 +236,7 @@ def maybe_fail(
     msg = f"injected fault: {name}"
     if exc_factory is not None:
         raise exc_factory(msg)
-    raise FaultError(msg, transient=spec.transient)
+    raise FaultError(msg, transient=spec.transient, point=name)
 
 
 def maybe_delay(name: str) -> float:

@@ -343,6 +343,16 @@ def make_paged_kv_hook(
     continuation prefill / decode cost scales with the actual session
     length instead of the table's full 32k-token capacity. Callers
     bucket it (powers of two) to bound compile variants.
+
+    Multi-step dispatch windows (docs/serving.md) rebuild this hook
+    every scan step with the CARRIED lengths, so step j of a window
+    writes at position length+j with no host involvement; the engine's
+    reservations address length+window ahead of the drain. Two
+    contracts the pipeline leans on live here: (1) positions past the
+    block table divert to scratch page 0 (a finishing turn's overshoot
+    can overrun its reservation), and (2) writes past a session's
+    recorded length are garbage by construction — attention masks reads
+    by length, and resumes overwrite them in device order.
     """
     b, max_pages = block_tables.shape
     if pallas_decode is None:

@@ -3,10 +3,36 @@ knobs folded into the compiled step."""
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+
+# Greedy tie band: logits within this distance of the row max count as
+# tied, and the LOWEST index wins. The band is RELATIVE to the max's
+# magnitude (floored at 1): reduction-order noise is a few f32 ULPs,
+# and a ULP scales with the value — an absolute band tuned on small
+# logits (measured ~5e-7 on the 8-device virtual mesh at tiny-moe
+# scale) would fall below one ULP once row maxima exceed ~8 and the
+# determinism guarantee would silently lapse at realistic magnitudes.
+# 1e-6 relative stays ~2x above per-ULP noise at every scale while
+# remaining far below any gap that reflects a real model decision.
+# Read once at import — it participates in compiled programs.
+GREEDY_TIE_EPS = float(os.environ.get("ROOM_TPU_GREEDY_TIE_EPS", "1e-6"))
+
+
+def greedy_argmax(logits: jax.Array) -> jax.Array:
+    """Index-ordered argmax over stably-banded logits [..., V]: every
+    greedy pick in the repo (plain decode, prefill first token,
+    speculative verify) routes through here so mesh-vs-single-device
+    reduction-order noise can never flip a near-tie differently in two
+    places."""
+    x = logits.astype(jnp.float32)
+    mx = jnp.max(x, axis=-1, keepdims=True)
+    band = GREEDY_TIE_EPS * jnp.maximum(1.0, jnp.abs(mx))
+    # first index within the tie band of the row max
+    return jnp.argmax(x >= mx - band, axis=-1)
 
 
 @dataclass(frozen=True)
@@ -47,7 +73,7 @@ def sample(
 ) -> jax.Array:
     """Returns sampled token ids [B]. Greedy when temperature == 0."""
     if params.temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
+        return greedy_argmax(logits)
 
     logits = logits.astype(jnp.float32) / params.temperature
 
@@ -125,7 +151,7 @@ def sample_batched(
     top_k=0 samples the full vocabulary regardless of its batchmates.
     (`_sample_batched_sorted` is the full-sort test oracle.)"""
     logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1)
+    greedy = greedy_argmax(logits)
     masked = masked_scaled_logits(logits, temperature, top_p, top_k)
     sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy)
@@ -163,7 +189,7 @@ def spec_verify(
     masked = masked_scaled_logits(
         flat, rep(temperature), rep(top_p), rep(top_k)
     )
-    argmax_full = jnp.argmax(flat, axis=-1)             # [B*W]
+    argmax_full = greedy_argmax(flat)                   # [B*W]
 
     k_u, k_resid, k_plain = jax.random.split(key, 3)
     stoch = (rep(temperature) > 0)
@@ -191,10 +217,15 @@ def spec_verify(
     )
 
     resid_logits = masked.at[jnp.arange(b * w), d_flat].set(-jnp.inf)
+    # greedy rows: the residual is only consumed at a rejection, i.e.
+    # when the draft is NOT the greedy pick — so the pick itself is the
+    # exact sequential-decoding token. Using argmax_full (not an argmax
+    # over the draft-masked row) keeps the tie-banded greedy rule
+    # identical between the spec path and plain decode.
     residual_flat = jnp.where(
         stoch,
         jax.random.categorical(k_resid, resid_logits, axis=-1),
-        jnp.argmax(resid_logits, axis=-1),
+        argmax_full,
     )
     accept = accept_flat.reshape(b, w)[:, : w - 1]
     residual = residual_flat.reshape(b, w)[:, : w - 1]
@@ -284,7 +315,7 @@ def _sample_batched_sorted(
     """Reference implementation: one full-vocab sort (the test oracle
     for the fast path)."""
     logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1)
+    greedy = greedy_argmax(logits)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
     scaled = logits / safe_t
     masked = _mask_sorted(

@@ -2,14 +2,23 @@
 (VERDICT r4 #5).
 
 For greedy rows the engine accepts the longest draft prefix that
-matches the model's own argmax (engine._decode_once_spec). If a
-transcript's continuation IS what the model would have emitted, then
-acceptance is a pure function of (history, continuation, gamma) and the
-drafting algorithm — so the per-class acceptance of prompt-lookup
-drafting on realistic traffic can be measured exactly, offline, with no
-model in the loop. tests/test_spec_acceptance.py pins replay==engine on
-live engine output; scripts/spec_acceptance.py reports the per-class
-table that backs the deployment gamma default.
+matches the model's own (tie-banded) argmax (engine._decode_once_spec).
+If a transcript's continuation IS what the model would have emitted,
+then acceptance is a pure function of (history, continuation, gamma)
+and the drafting algorithm — so the per-class acceptance of
+prompt-lookup drafting on realistic traffic can be measured exactly,
+offline, with no model in the loop. tests/test_spec_acceptance.py pins
+replay==engine on live engine output; scripts/spec_acceptance.py
+reports the per-class table that backs the deployment gamma default.
+
+Interaction with the multi-step dispatch window (docs/serving.md):
+speculation composes with the pipeline by FLUSHING it at every round
+boundary — drafting reads each session's host-side history, which an
+undrained window still runs ahead of, so a spec round is always one
+dispatch + one synchronous drain (effectively steps=1 for that
+iteration). The replay therefore models spec rounds exactly as before:
+round structure is unaffected by ROOM_TPU_DECODE_STEPS_PER_DISPATCH,
+only the plain-decode segments between rounds ride the window.
 
 reference: none (the reference delegates decoding to Ollama and has no
 speculative path); the acceptance rule replayed here is

@@ -76,7 +76,8 @@ def main() -> None:
     def measure(chunk: int, batch: int, page: int, quant: str,
                 kvq: str = "none") -> dict:
         nonlocal q_params
-        os.environ["ROOM_TPU_DECODE_CHUNK"] = str(chunk)
+        # chunk tunes the dispatch-window depth (docs/serving.md)
+        os.environ["ROOM_TPU_DECODE_STEPS_PER_DISPATCH"] = str(chunk)
         if kvq == "int8":
             os.environ["ROOM_TPU_KV_QUANT"] = "int8"
         else:

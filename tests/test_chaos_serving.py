@@ -569,7 +569,10 @@ def test_tpu_health_route(tpu_host):
     eng_row = data["engines"].get("tiny-moe")
     assert eng_row is not None
     for key in ("degradation_level", "engine_crashes", "stall_events",
-                "requeues", "shed_turns", "healthy"):
+                "requeues", "shed_turns", "healthy",
+                # multi-step decode pipeline (docs/serving.md)
+                "steps_per_dispatch", "host_stall_ms",
+                "decode_windows", "window_faults"):
         assert key in eng_row
 
 

@@ -1,0 +1,302 @@
+"""Multi-step decode pipeline (docs/serving.md).
+
+Greedy decode must be byte-identical across dispatch-window depths
+(ROOM_TPU_DECODE_STEPS_PER_DISPATCH in {1, 2, 4}) through every
+disruptive path the engine has — mid-window stops, park+requeue,
+prefix-cache hits, KV-offload hibernate/restore — because the window
+only changes WHEN the host learns about tokens, never which tokens the
+model samples. A decode_window fault must fail exactly the turns in the
+faulted window and leak no KV pages. Quick tier: runs in the ci.yml
+chaos job.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+
+STEPS = (1, 2, 4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def build(model, monkeypatch):
+    cfg, params = model
+
+    def make(steps, **kw):
+        monkeypatch.setenv(
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", str(steps)
+        )
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        return ServingEngine(cfg, params, **kw)
+
+    return make
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+def test_identity_mid_window_stop_and_resume(build):
+    """A turn whose budget (7) never lands on a window boundary (2, 4)
+    stops mid-window; the trimmed stream and the parked session's
+    resume must match the step-at-a-time engine exactly."""
+    streams = {}
+    for steps in STEPS:
+        eng = build(steps)
+        sp = _greedy(7)
+        a = eng.submit([4, 8, 15], session_id="s", sampling=sp)
+        eng.run_until_idle()
+        b = eng.submit([16, 23], session_id="s", sampling=sp)
+        eng.run_until_idle()
+        streams[steps] = (a.new_tokens, b.new_tokens)
+        if steps > 1:
+            assert eng.stats()["decode_windows"] >= 1
+    assert streams[2] == streams[1]
+    assert streams[4] == streams[1]
+
+
+def test_identity_across_park_requeue(build):
+    """The stall watchdog parks+requeues mid-stream; the pipeline's
+    late-reconciled park (discovered one window after dispatch) must
+    still resume from the pending token with zero divergence."""
+    base = None
+    for steps in STEPS:
+        eng = build(steps)
+        clean = eng.submit([9, 8, 7], sampling=_greedy(12))
+        eng.run_until_idle()
+        if base is None:
+            base = clean.new_tokens
+        assert clean.new_tokens == base
+        eng.step_stall_s = 0.05
+        faults.inject("decode_stall", latency_s=0.2, times=2)
+        turn = eng.submit([9, 8, 7], sampling=_greedy(12))
+        eng.run_until_idle()
+        faults.clear()
+        eng.step_stall_s = 120.0
+        assert turn.finish_reason in ("stop", "length")
+        assert turn.requeues >= 1 and turn.disrupted
+        assert turn.new_tokens == base, f"steps={steps}"
+
+
+def test_identity_prefix_cache_hit(build):
+    """The second session references the first's cached page-aligned
+    prefix instead of re-prefilling; its stream must be window-depth
+    invariant."""
+    prefix = list(range(1, 25))          # 24 tokens = 3 aligned pages
+    base = None
+    for steps in STEPS:
+        eng = build(steps)
+        t1 = eng.submit(prefix + [31, 32, 33], sampling=_greedy(6))
+        eng.run_until_idle()             # registers the prefix
+        t2 = eng.submit(prefix + [41, 42], sampling=_greedy(6))
+        eng.run_until_idle()             # block-table hit
+        assert eng.stats()["prefix_hits"] >= 1
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"steps={steps}"
+
+
+def test_identity_kv_offload_restore(build):
+    """Hibernate a parked session to the host tier and resume it: the
+    restored-KV continuation must match across window depths."""
+    base = None
+    for steps in STEPS:
+        eng = build(steps, offload=True)
+        t1 = eng.submit(list(range(1, 20)), session_id="h",
+                        sampling=_greedy(6))
+        eng.run_until_idle()
+        assert eng.offload_session("h")
+        t2 = eng.submit([5, 6, 7], session_id="h", sampling=_greedy(6))
+        eng.run_until_idle()
+        assert eng.stats()["offload_restores"] >= 1
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"steps={steps}"
+
+
+def test_pipeline_serve_forever_matches_sync(build):
+    """The threaded loop (with its shutdown flush) produces the same
+    streams as the synchronous legacy engine."""
+    prompts = [[3, 1, 4], [1, 5, 9], [2, 6]]
+    eng_sync = build(1)
+    want = []
+    for p in prompts:
+        t = eng_sync.submit(p, sampling=_greedy(8))
+        eng_sync.run_until_idle()
+        want.append(t.new_tokens)
+
+    eng = build(4)
+    stop = threading.Event()
+    loop = threading.Thread(
+        target=eng.serve_forever, args=(stop,), daemon=True
+    )
+    loop.start()
+    turns = [eng.submit(p, sampling=_greedy(8)) for p in prompts]
+    for t in turns:
+        assert t.done.wait(300), "turn timed out"
+    stop.set()
+    loop.join(60)
+    assert not loop.is_alive()
+    assert [t.new_tokens for t in turns] == want
+
+
+def test_identity_penalized_rows(build):
+    """Penalty counts ride the scan carry on device; a penalized turn's
+    stream must be window-depth invariant too (the count array must not
+    absorb pad tokens from masked lanes or overshoot double-counts)."""
+    sp = SamplingParams(temperature=0.0, max_new_tokens=9,
+                        frequency_penalty=1e9)
+    base = None
+    for steps in STEPS:
+        eng = build(steps)
+        t = eng.submit([1, 2, 3], sampling=sp)
+        eng.run_until_idle()
+        if base is None:
+            base = t.new_tokens
+        assert t.new_tokens == base, f"steps={steps}"
+        body = t.new_tokens[:-1] if t.finish_reason == "stop" \
+            else t.new_tokens
+        assert len(set(body)) == len(body)
+
+
+def test_decode_window_fault_fails_only_window(build, monkeypatch):
+    """An injected decode_window fault fails exactly the turns in the
+    faulted window: queued turns still complete, the engine stays
+    healthy (no crash-supervisor reset), and no KV page leaks."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    eng = build(4, max_batch=2)
+    faults.inject("decode_window", times=1, transient=False)
+    turns = [
+        eng.submit([i + 1, i + 2, i + 3], session_id=f"w{i}",
+                   sampling=_greedy(6))
+        for i in range(4)
+    ]
+    eng.run_until_idle()
+    failed = [t for t in turns if t.finish_reason == "error"]
+    ok = [t for t in turns if t.finish_reason in ("stop", "length")]
+    assert len(failed) == 2 and len(ok) == 2
+    assert all("decode_window" in (t.error or "") for t in failed)
+    st = eng.stats()
+    assert st["window_faults"] == 1
+    assert st["healthy"] is True and st["engine_crashes"] == 0
+    for i in range(4):
+        eng.release_session(f"w{i}")
+    assert eng.page_table.free_pages == eng.page_table.n_pages - 1
+    assert not eng.sessions
+
+
+def test_degraded_reservation_parks_instead_of_corrupting(build,
+                                                          monkeypatch):
+    """Pool pressure can grant a window a single token of KV headroom
+    while the scan still runs `steps` steps: the drain must accept only
+    the durably-written tokens and park+requeue on the last one (the
+    pending-token contract) — never book tokens whose KV landed on the
+    scratch page. Stream stays identical to the legacy engine."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    e1 = build(1)
+    base = e1.submit([7, 7, 3], sampling=_greedy(12))
+    e1.run_until_idle()
+
+    eng = build(4)
+    turn = eng.submit([7, 7, 3], sampling=_greedy(12))
+    eng.step()                    # admit + dispatch window 1
+    # the next window's reservation hits an allocation failure, no
+    # relief available -> _reserve_slot degrades to a 1-token grant
+    faults.inject("kv_alloc", times=1)
+    eng.run_until_idle()
+    faults.clear()
+    assert turn.finish_reason in ("stop", "length")
+    assert turn.requeues >= 1 and turn.disrupted
+    assert turn.new_tokens == base.new_tokens
+
+
+def test_spec_no_draft_does_not_disable_pipeline(build):
+    """spec_tokens>0 on non-repetitive traffic (nothing draftable) must
+    not flush the pipeline every iteration: the empty-draft probe arms
+    the spec cooldown, and dispatch windows keep rolling between
+    probes."""
+    eng = build(4, spec_tokens=4)
+    t = eng.submit(list(range(1, 9)), sampling=_greedy(24))
+    eng.run_until_idle()
+    st = eng.stats()
+    assert t.finish_reason in ("stop", "length")
+    # windows actually dispatched (the pipeline ran) even though the
+    # spec gate kept probing and never found a draft
+    assert st["decode_windows"] >= 2, st
+    assert st["steps_per_dispatch"] == 4
+
+
+def test_decode_window_fault_preserves_previous_window_tokens(
+        build, monkeypatch):
+    """A fault discovered at dispatch k must not discard window k-1's
+    already-computed tokens: the previous window drains to the stream
+    (callbacks, history, length) BEFORE the faulted window's turn
+    fails — the fault's blast radius is exactly one window."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    eng = build(4)
+    got = []
+    turn = eng.submit([1, 2, 3], sampling=_greedy(64),
+                      on_token=got.append)
+    eng.step()                 # admit + dispatch window 1 (in flight)
+    assert eng.stats()["decode_windows"] == 1
+    faults.inject("decode_window", times=1, transient=False)
+    eng.step()                 # window 2 faults; window 1 drains first
+    assert turn.finish_reason == "error"
+    assert "decode_window" in turn.error
+    # the prefill token + all 4 tokens window 1 really computed
+    assert len(turn.new_tokens) == 5
+    assert got == turn.new_tokens
+    eng.release_session(turn.session_id)
+    assert eng.page_table.free_pages == eng.page_table.n_pages - 1
+
+
+def test_pipeline_stats_surface(build):
+    eng = build(4)
+    t = eng.submit([1, 2, 3], sampling=_greedy(9))
+    eng.run_until_idle()
+    st = eng.stats()
+    assert t.finish_reason in ("stop", "length")
+    assert st["steps_per_dispatch"] == 4
+    assert st["decode_windows"] >= 2
+    assert st["host_stall_ms"] > 0.0
+    assert st["overshoot_tokens"] >= 0
+
+
+def test_greedy_argmax_tie_break_index_ordered():
+    """The stable greedy rule: lowest index wins inside the tie band,
+    the true argmax wins outside it — so cross-mesh reduction-order
+    noise can never flip a near-tie two different ways (ROADMAP
+    CPU-mesh determinism item)."""
+    import jax.numpy as jnp
+
+    from room_tpu.serving.sampler import GREEDY_TIE_EPS, greedy_argmax
+
+    logits = jnp.asarray([
+        [0.0, 1.0, 1.0, 0.5],
+        [0.0, 1.0 - GREEDY_TIE_EPS / 2, 1.0, 0.5],
+        [0.0, 1.0 - GREEDY_TIE_EPS * 4, 1.0, 0.5],
+    ], jnp.float32)
+    got = np.asarray(greedy_argmax(logits))
+    assert got.tolist() == [1, 1, 2]

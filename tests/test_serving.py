@@ -277,24 +277,6 @@ def test_engine_batched_turns_match_sequential(engine_setup):
     assert alone == together
 
 
-# Tracked skip-guard (ROADMAP.md open items): on the 8-device VIRTUAL
-# CPU mesh these two mesh-vs-single-device token-identity tests fail
-# environmentally at the seed (greedy argmax tie-breaks flip under the
-# CPU backend's sharded-reduction ordering; verified pre-existing in a
-# clean seed worktree). Real-TPU meshes are covered by bench.py /
-# __graft_entry__.dryrun_multichip on hardware. strict=False keeps them
-# running so an unexpected pass (or a NEW kind of failure) stays
-# visible in the report instead of silently skipped.
-_cpu_mesh_xfail = pytest.mark.xfail(
-    jax.default_backend() == "cpu",
-    reason="pre-existing: sharded CPU-mesh reduction order flips greedy "
-           "argmax ties vs single-device (ROADMAP.md open item; passes "
-           "on real TPU)",
-    strict=False,
-)
-
-
-@_cpu_mesh_xfail
 def test_engine_on_mesh_matches_single_device(engine_setup):
     """The serving engine on an 8-device dp/ep/tp mesh (sharded params +
     sharded page pool + dp-sharded decode batch) generates the same
@@ -325,7 +307,6 @@ def test_engine_on_mesh_matches_single_device(engine_setup):
     assert shard_mesh.shape == mesh.shape
 
 
-@_cpu_mesh_xfail
 def test_hetero_disjoint_submeshes(engine_setup):
     """Hetero-swarm placement (BASELINE config #5): two engines on
     disjoint device windows of one pod — params and KV pools must land
