@@ -25,6 +25,7 @@ hostage to later phases):
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -649,6 +650,77 @@ def main() -> None:
             _phase("kv_offload", measure_offload())
         except Exception as e:
             _phase("kv_offload", {"error": str(e)[:300]})
+
+    # warm-restart lifecycle (docs/lifecycle.md): drain a warm engine
+    # to its manifest, boot a fresh one on the same weights, and
+    # measure time-to-first-token on the resumed session. The restore
+    # is a byte-exact KV memcpy and the persistent compile cache
+    # (utils/compile_cache.py) covers the jit shapes, so the restart
+    # tax should be milliseconds, not a re-prefill + recompile.
+    def measure_warm_restart() -> dict:
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        lc_dir = _tempfile.mkdtemp(prefix="room_tpu_bench_lc_")
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=8 if TINY else 32,
+        )
+        try:
+            eng = ServingEngine(
+                cfg, params, max_batch=4, page_size=32, n_pages=1024,
+                offload=True,
+            )
+            eng.submit(prompt, session_id="wr", sampling=sp)
+            eng.run_until_idle()
+            t0 = time.perf_counter()
+            drained = eng.drain(lc_dir)
+            drain_s = time.perf_counter() - t0
+            # drain spools sessions but does not free the device KV
+            # cache — drop the engine before building its successor so
+            # the phase keeps the one-engine-at-a-time memory footprint
+            # every other phase has (two n_pages=1024 caches can OOM a
+            # device sized near HBM capacity); the engine sits in
+            # reference cycles (jit closures capture self), so del
+            # alone leaves the KV pool to the cyclic GC's schedule
+            del eng
+            gc.collect()
+
+            eng2 = ServingEngine(
+                cfg, params, max_batch=4, page_size=32, n_pages=1024,
+                offload=True,
+            )
+            t0 = time.perf_counter()
+            restored = eng2.restore_from_manifest(lc_dir)
+            restore_s = time.perf_counter() - t0
+            first: dict = {}
+            t0 = time.perf_counter()
+            eng2.submit(
+                [2, 3, 4], session_id="wr", sampling=sp,
+                on_token=lambda tok: first.setdefault(
+                    "t", time.perf_counter()
+                ),
+            )
+            eng2.run_until_idle()
+            return {
+                "drain_s": round(drain_s, 3),
+                "restore_s": round(restore_s, 3),
+                # null, not phase-elapsed, when no token ever streamed:
+                # a failed resume must not fabricate a plausible TTFT
+                "ttft_after_restart_s": round(first["t"] - t0, 3)
+                if "t" in first else None,
+                "sessions_spooled": drained["sessions_spooled"],
+                "sessions_resumed": restored["resumed"],
+                "sessions_reprefill": restored["reprefill"],
+            }
+        finally:
+            _shutil.rmtree(lc_dir, ignore_errors=True)
+
+    if os.environ.get("ROOM_TPU_BENCH_RESTART", "1") != "0":
+        _extend_deadline()
+        try:
+            _phase("warm_restart", measure_warm_restart())
+        except Exception as e:
+            _phase("warm_restart", {"error": str(e)[:300]})
 
     # decode-attention backend comparison (Pallas paged kernel vs the
     # XLA gather reference) — only meaningful on real TPU hardware

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 from ..models import config as model_configs
 from ..models import qwen3
 from ..serving import faults
+from ..serving import lifecycle as lifecycle_mod
 from ..serving.faults import FaultError
 from ..serving.kv_offload import offload_enabled_from_env
 from .base import ExecutionRequest, ExecutionResult, ProviderError
@@ -41,6 +42,12 @@ MODEL_CONFIGS: dict[str, Callable] = {
 
 _hosts: dict[str, "ModelHost"] = {}
 _hosts_lock = threading.Lock()
+# flipped by begin_drain_model_hosts: while True, engine() refuses to
+# cold-build (a straggler request during the drain window would
+# otherwise rebuild a host whose restore consumes the manifest the
+# drain just wrote). Cleared by reset_model_hosts for same-process
+# reuse (tests); a fresh process starts False.
+_draining = False
 
 
 def _random_init_allowed(name: str) -> bool:
@@ -155,6 +162,18 @@ class ModelHost:
                     # serviceable
                     self._start_engine_thread()
                 return self._engine
+            if _draining:
+                # the process is draining to its lifecycle manifest: a
+                # straggler request must NOT cold-build a fresh engine
+                # here — its restore_from_manifest would consume the
+                # manifest the drain just wrote, destroying the
+                # warm-restart handoff (the new engine would die
+                # un-drained at exit while the clean marker still
+                # claims every step completed)
+                raise ProviderError(
+                    f"tpu engine for {self.name} is draining for a "
+                    "process restart; retry shortly"
+                )
             ok, why = self.readiness()
             if not ok:
                 raise ProviderError(why)
@@ -240,17 +259,114 @@ class ModelHost:
                 # off; ROOM_TPU_OFFLOAD=0 opts a deployment out.
                 offload=offload_enabled_from_env("1"),
             )
+            # warm restart (docs/lifecycle.md): rehydrate sessions a
+            # previous process drained — BEFORE the serve thread owns
+            # the engine (restore has engine-thread semantics). A
+            # missing/stale manifest is a no-op; deployment default ON
+            # (ROOM_TPU_LIFECYCLE=0 opts out) and drains only happen on
+            # the graceful shutdown path, so tests never cross-talk.
+            if lifecycle_mod.lifecycle_enabled_from_env("1"):
+                self._engine.restore_from_manifest(
+                    lifecycle_mod.engine_dir(self.name)
+                )
             self._start_engine_thread()
             return self._engine
 
-    def shutdown(self) -> None:
+    def shutdown(
+        self, drain: bool = False, budget_s: Optional[float] = None,
+    ) -> Optional[dict]:
+        """Stop the serve thread; with ``drain=True`` (the graceful
+        SIGTERM path) also quiesce + spool the engine's sessions to the
+        lifecycle manifest so the next process resumes them warm.
+        ``budget_s`` lets drain_model_hosts hand each host the
+        REMAINDER of one shared budget instead of a fresh one."""
+        # ONE budget for the whole graceful path (ROOM_TPU_DRAIN_
+        # DEADLINE_S, default 30 s): the serve-thread join and the KV
+        # spooling share it, so a supervisor's terminationGracePeriod
+        # sized to the knob actually holds — a join that eats the
+        # budget leaves deadline 0 for drain (history-only manifest),
+        # never budget + budget serially.
+        if not drain:
+            budget = 5.0
+        elif budget_s is not None:
+            budget = max(budget_s, 0.0)
+        else:
+            budget = max(5.0, lifecycle_mod.drain_deadline_s())
+        t0 = time.monotonic()
+        # _stop BEFORE the lock: a builder holding self._lock that is
+        # about to start the serve thread will start it already-stopped
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        # serialize the ref capture against an in-flight engine()
+        # build (which holds self._lock through build + restore +
+        # thread start): without this, a straggler's
+        # restore_from_manifest can consume the manifest this drain is
+        # writing and sweep its fresh spool files — a "clean" shutdown
+        # with every session's warm state destroyed. The wait burns
+        # shared drain budget; if the builder eats it all the drain
+        # degrades to a history-only manifest, still a correct (cold)
+        # handoff. But the join + spool themselves run OUTSIDE the
+        # lock: health probes (is_healthy) and straggler engine()
+        # calls must get their fast draining/503 answer during the
+        # drain window, not block on the host lock for 30 s — new
+        # builds stay barred by _draining, so nothing can swap
+        # self._engine under the drain. The acquire is BOUNDED by the
+        # remaining budget: a multi-minute cold build must not stall
+        # the exit past the deadline a supervisor's grace period is
+        # sized to — on timeout there is no built engine serving
+        # sessions to drain, so exit; with drain=True report the
+        # failure so the clean marker is withheld (the mid-build
+        # engine dies un-drained: next boot must say crash, not clean)
+        thread = eng = None
+        timed_out = not self._lock.acquire(
+            timeout=max(0.1, budget - (time.monotonic() - t0))
+        )
+        if not timed_out:
+            try:
+                thread = self._thread
+                eng = self._engine
+            finally:
+                self._lock.release()
+        wedged = False
+        if thread is not None:
+            # wait the full budget, not a token 5 s: the serve thread
+            # can only observe _stop between steps, and a cold
+            # first-dispatch compile legitimately runs for most of a
+            # minute — calling that "wedged" would abandon every
+            # session's KV on a healthy engine. Operators with slower
+            # compiles raise the knob; a second SIGTERM always
+            # escalates past the wait.
+            thread.join(
+                timeout=max(0.0, budget - (time.monotonic() - t0))
+            )
+            wedged = thread.is_alive()
+        summary = None
+        if drain and eng is not None and hasattr(eng, "drain") and \
+                getattr(eng, "healthy", True):
+            try:
+                # a serve thread that failed to quiesce may still be
+                # mutating slot/KV state: never flush its window or
+                # gather pages from under it — a zero deadline routes
+                # every session to the history-only abandonment path
+                # (the next boot re-prefills; nothing is adopted from
+                # pages that might have been mid-mutation)
+                remaining = max(
+                    0.0, budget - (time.monotonic() - t0)
+                )
+                summary = eng.drain(
+                    lifecycle_mod.engine_dir(self.name),
+                    deadline_s=0.0 if wedged else remaining,
+                    flush=not wedged,
+                )
+            except Exception:
+                summary = None   # best-effort; never block exit
+        if drain and timed_out:
+            summary = {"manifest_written": False,
+                       "error": "host lock timeout (build in flight)"}
         if self.cfg.moe_impl == "shardmap":
             from ..ops.moe_shardmap import set_ep_mesh
 
             set_ep_mesh(None, key=self.cfg.name)
+        return summary
 
 
 def get_model_host(name: str) -> ModelHost:
@@ -261,10 +377,73 @@ def get_model_host(name: str) -> ModelHost:
 
 
 def reset_model_hosts() -> None:
+    """Hard reset (tests, crash paths): no drain, no manifest."""
+    global _draining
     with _hosts_lock:
         for h in _hosts.values():
             h.shutdown()
         _hosts.clear()
+        _draining = False
+
+
+def begin_drain_model_hosts() -> None:
+    """Flip every warm engine to draining NOW (admission 503s) and bar
+    cold engine builds, without waiting for the serve threads to
+    quiesce — the first thing the SIGTERM handler does."""
+    global _draining
+    with _hosts_lock:
+        _draining = True
+        hosts = list(_hosts.values())
+    for h in hosts:
+        eng = h._engine
+        if eng is not None and hasattr(eng, "begin_drain"):
+            eng.begin_drain()
+
+
+def end_drain_model_hosts() -> None:
+    """Re-open engine builds once the graceful stop has fully torn
+    down (API stopped, marker written). Not part of drain_model_hosts:
+    while the old API is still accepting requests a straggler must
+    keep getting 503s, or it could rebuild a host whose restore
+    consumes the manifest the drain just wrote. After teardown the
+    flag would only sabotage a same-process start_server(), whose
+    first build SHOULD consume that manifest — that is the
+    warm-restart contract."""
+    global _draining
+    with _hosts_lock:
+        _draining = False
+
+
+def drain_model_hosts() -> dict[str, dict]:
+    """Graceful counterpart of reset_model_hosts: drain every warm
+    engine to its lifecycle manifest (docs/lifecycle.md). Returns the
+    per-model drain summaries."""
+    with _hosts_lock:
+        hosts = dict(_hosts)
+        _hosts.clear()
+    out: dict[str, dict] = {}
+    enabled = lifecycle_mod.lifecycle_enabled_from_env("1")
+    # ONE drain budget shared across every host (serial drains): a
+    # supervisor grace period sized to ROOM_TPU_DRAIN_DEADLINE_S must
+    # bound the whole exit, not deadline × n_models — later hosts get
+    # whatever remains (0 ⇒ history-only manifest, still written)
+    budget = max(5.0, lifecycle_mod.drain_deadline_s())
+    t0 = time.monotonic()
+    for name, h in hosts.items():
+        had_engine = h._engine is not None
+        summary = h.shutdown(
+            drain=enabled,
+            budget_s=max(0.0, budget - (time.monotonic() - t0)),
+        )
+        if summary is not None:
+            out[name] = summary
+        elif enabled and had_engine:
+            # drain raised (shutdown swallowed it): record the failure
+            # so the caller can withhold the clean-shutdown marker —
+            # this shutdown did NOT complete every step
+            out[name] = {"manifest_written": False,
+                         "error": "drain failed"}
+    return out
 
 
 def engines_snapshot() -> dict[str, dict]:

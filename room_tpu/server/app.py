@@ -1,19 +1,29 @@
 """Server boot orchestration (reference: src/server/index.ts
 startServer:867): open the DB, start runtime loops, bring up the API
 server (+WS), write token/port files, and tear it all down in reverse
-order on shutdown."""
+order on shutdown.
+
+Two shutdown shapes (docs/lifecycle.md): ``stop()`` is the hard path
+(tests, crash handling) — engines reset, nothing spooled; ``stop(
+graceful=True)`` is the SIGTERM path — admission 503s immediately, the
+swarm quiesces, every warm engine drains its sessions to the lifecycle
+manifest, and the clean-shutdown marker is written last so the next
+boot knows this was a rolling restart, not a crash."""
 
 from __future__ import annotations
 
 import os
-import signal
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 from ..db import Database, get_database
 from .http import ApiServer
-from .runtime import ServerRuntime, start_server_runtime, stop_server_runtime
+from .runtime import (
+    ServerRuntime, install_lifecycle_signal_handlers,
+    note_drain_result, note_drain_started, set_lifecycle_phase,
+    start_server_runtime, stop_server_runtime,
+)
 
 
 @dataclass
@@ -21,22 +31,62 @@ class ServerApp:
     db: Database
     runtime: ServerRuntime
     api: ApiServer
+    _stopped: threading.Event = field(default_factory=threading.Event)
 
     @property
     def port(self) -> int:
         return self.api.port
 
-    def stop(self) -> None:
+    def stop(self, graceful: bool = False) -> None:
         # reverse boot order: stop loops, stop serving, close DB
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        from ..providers.tpu import (
+            begin_drain_model_hosts, drain_model_hosts,
+            end_drain_model_hosts, reset_model_hosts,
+        )
+        from ..serving import lifecycle as lifecycle_helpers
         from .updater import reset_update_checker
 
+        if graceful:
+            # close engine admission FIRST: in-flight turns finish (or
+            # hit the drain deadline), everything new gets 503 +
+            # Retry-After while the rest of the teardown proceeds
+            note_drain_started()
+            begin_drain_model_hosts()
         reset_update_checker()
         stop_server_runtime()
+        drain_ok = True
+        if graceful:
+            summaries = drain_model_hosts()
+            note_drain_result(summaries)
+            drain_ok = all(
+                s.get("manifest_written", False)
+                for s in summaries.values()
+            )
         self.api.stop()
-        from ..providers.tpu import reset_model_hosts
-
-        reset_model_hosts()
+        if not graceful:
+            reset_model_hosts()
+        elif drain_ok:
+            # marker LAST, and only when every engine's drain actually
+            # landed its manifest: it attests that every step above
+            # completed. A crash mid-drain OR a failed manifest write
+            # leaves no marker — the next boot reports "crash" and its
+            # journal recovery treats the lost state as the loss it was
+            # instead of a green "clean" pill over vanished sessions.
+            # NOT gated on ROOM_TPU_LIFECYCLE: the knob disables
+            # drains/manifests, but the marker records HOW the process
+            # exited, which the (unconditional) boot check reads either
+            # way — without it every graceful stop of a
+            # lifecycle-disabled deployment reads as a crash
+            lifecycle_helpers.write_clean_marker()
+        if graceful:
+            # API is down, drains are landed: builds may resume so a
+            # same-process start_server() can warm-restart
+            end_drain_model_hosts()
         self.db.close()
+        set_lifecycle_phase("stopped")
 
 
 def start_server(
@@ -88,13 +138,10 @@ def start_server(
     app = ServerApp(db=db, runtime=runtime, api=api)
 
     if install_signal_handlers:
-        done = threading.Event()
-
-        def shutdown(signum, frame):
-            app.stop()
-            done.set()
-
-        signal.signal(signal.SIGINT, shutdown)
-        signal.signal(signal.SIGTERM, shutdown)
+        # SIGTERM/SIGINT take the graceful path: drain + manifest +
+        # clean-shutdown marker (docs/lifecycle.md)
+        done = install_lifecycle_signal_handlers(
+            lambda: app.stop(graceful=True)
+        )
         app._done = done  # type: ignore[attr-defined]
     return app

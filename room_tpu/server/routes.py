@@ -1187,6 +1187,10 @@ def register_aux_routes(r: Router) -> None:
         for name, e in engines.items():
             if e.get("offload") is not None:
                 summary[name]["offload"] = e["offload"]
+            # per-engine lifecycle block (docs/lifecycle.md): phase +
+            # drain/restore counters, rendered whole by the TPU panel
+            if e.get("lifecycle") is not None:
+                summary[name]["lifecycle"] = e["lifecycle"]
         swarm = supervision_snapshot()
         # db-less contexts (bare router probes) get zeroed journal stats
         swarm["journal"] = journal_mod.stats(ctx.db) if ctx.db else {
@@ -1198,8 +1202,13 @@ def register_aux_routes(r: Router) -> None:
                                                            True)
             for e in engines.values()
         ) or bool(swarm["unhealthy_workers"])
+        from .runtime import lifecycle_snapshot
+
         return ok({
             "degraded": degraded,
+            # process lifecycle (docs/lifecycle.md): phase, how the
+            # previous process died, and the last drain's summaries
+            "lifecycle": lifecycle_snapshot(),
             "engines": summary,
             "swarm": swarm,
             "faults": faults_mod.snapshot(),

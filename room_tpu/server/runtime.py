@@ -2,7 +2,15 @@
 tick (cron + due one-time tasks), maintenance sweep (stale runs/cycles),
 queen inbox poll, with in-flight flags so a slow tick never stacks.
 
-Thread-per-loop replaces node timers; all loops stop via one event."""
+Thread-per-loop replaces node timers; all loops stop via one event.
+
+Also the process-lifecycle authority (docs/lifecycle.md): boot consumes
+the previous process's clean-shutdown marker (so journal recovery can
+tell a rolling restart from a crash), the phase
+(starting/warming/serving/draining) is exported to /api/tpu/health and
+the TPU panel, and ``begin_graceful_drain`` runs the SIGTERM sequence —
+engines flip to 503 admission, the swarm loops quiesce, engines spool
+their sessions to the drain manifest, and the marker is written last."""
 
 from __future__ import annotations
 
@@ -31,6 +39,78 @@ INBOX_POLL_S = 2.5
 SUPERVISION_TICK_S = 10.0
 STALE_RUN_MINUTES = 120
 
+# ---- process lifecycle (docs/lifecycle.md) ----
+# starting -> warming (boot recovery running) -> serving -> draining.
+# Module-global because exactly one server process owns the lifecycle;
+# snapshotted by /api/tpu/health from route threads.
+_lifecycle_lock = threading.Lock()
+_lifecycle = {
+    "phase": "starting",
+    "last_shutdown": None,      # clean | crash | first_boot
+    "drain": None,              # per-model drain summaries, once drained
+    "drain_started_at": None,
+    "drain_ms": None,
+}
+
+
+def set_lifecycle_phase(phase: str) -> None:
+    with _lifecycle_lock:
+        _lifecycle["phase"] = phase
+
+
+def lifecycle_snapshot() -> dict:
+    with _lifecycle_lock:
+        return dict(_lifecycle)
+
+
+def note_drain_started() -> None:
+    with _lifecycle_lock:
+        _lifecycle["phase"] = "draining"
+        _lifecycle["drain_started_at"] = time.time()
+
+
+def note_drain_result(summaries: dict) -> None:
+    with _lifecycle_lock:
+        _lifecycle["drain"] = summaries
+        started = _lifecycle.get("drain_started_at")
+        if started:
+            _lifecycle["drain_ms"] = round(
+                (time.time() - started) * 1000.0, 1
+            )
+    event_bus.emit("lifecycle:drained", "runtime",
+                   {"engines": list(summaries)})
+
+
+def install_lifecycle_signal_handlers(graceful_stop) -> threading.Event:
+    """SIGTERM/SIGINT → graceful drain (docs/lifecycle.md): admission
+    flips to 503 + Retry-After, the decode window flushes, sessions
+    spool to the drain manifest, the clean-shutdown marker lands last.
+    Returns the event set once shutdown completes (the serve loop waits
+    on it). A second signal during the drain restores the default
+    disposition and re-raises itself — the drain deadline bounds the
+    common exits, but an operator's repeated Ctrl-C (or a supervisor's
+    escalating SIGTERM) must always be able to take the process down
+    even if some drain step wedges past the deadline's reach."""
+    import signal
+
+    done = threading.Event()
+    state = {"stopping": False}
+
+    def handler(signum, frame):
+        if state["stopping"]:
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        state["stopping"] = True
+        try:
+            graceful_stop()
+        finally:
+            done.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    return done
+
 
 @dataclass
 class ServerRuntime:
@@ -50,6 +130,25 @@ class ServerRuntime:
     cloud: Optional[object] = None
 
     def start(self) -> None:
+        set_lifecycle_phase("warming")
+        # how did the last process die? The clean-shutdown marker
+        # (written after a full graceful drain) distinguishes a rolling
+        # restart from a crash; journal recovery below is idempotent
+        # and runs either way, but after a clean drain it finds nothing
+        # to repair.
+        from ..serving import lifecycle as lifecycle_helpers
+
+        last = lifecycle_helpers.consume_clean_marker()
+        lifecycle_helpers.record_boot()
+        with _lifecycle_lock:
+            _lifecycle["last_shutdown"] = last
+            # a same-process reboot (tests, embedders) must not report
+            # the previous incarnation's drain summary as its own
+            _lifecycle["drain"] = None
+            _lifecycle["drain_started_at"] = None
+            _lifecycle["drain_ms"] = None
+        event_bus.emit("lifecycle:boot", "runtime",
+                       {"last_shutdown": last})
         # crash recovery FIRST: resolve journal-open work to terminal
         # states (and flag committed side effects against replay)
         # before the stale sweep or the scheduler can touch it
@@ -91,6 +190,7 @@ class ServerRuntime:
             )
             t.start()
             self.threads.append(t)
+        set_lifecycle_phase("serving")
 
     def _schedule_contact_checks(self) -> None:
         """First-boot keeper contact checks at day 1 and day 7

@@ -1,4 +1,4 @@
-from . import faults
+from . import faults, lifecycle
 from .engine import ServingEngine, Turn
 from .faults import FaultError
 from .kv_offload import TieredKVStore
@@ -16,6 +16,7 @@ __all__ = [
     "ServingEngine",
     "Turn",
     "faults",
+    "lifecycle",
     "FaultError",
     "PageTable",
     "TieredKVStore",

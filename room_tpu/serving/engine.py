@@ -170,6 +170,10 @@ class _Session:
     prefix_key: Optional[tuple] = None
     prefix_pages: list[int] = field(default_factory=list)
     prefix_len: int = 0
+    # turns this session has admitted, across warm restarts (rides the
+    # drain manifest so operators can see a session's age after N
+    # rolling restarts)
+    generation: int = 0
 
 
 @dataclass
@@ -215,6 +219,11 @@ class ServingEngine:
         from ..utils.compile_cache import enable_compile_cache
 
         enable_compile_cache()
+        # process-lifecycle phase (docs/lifecycle.md): starting ->
+        # (warming, during a manifest restore) -> serving -> draining.
+        # Plain str writes are atomic; readers (stats, routes) only
+        # ever snapshot it.
+        self.lifecycle_phase = "starting"
         self.cfg = cfg
         self.params = params
         # multi-chip serving: cache+params live together on the mesh —
@@ -546,6 +555,17 @@ class ServingEngine:
         from ..utils.profiling import StepTimer
 
         self.timer = StepTimer()
+        # lifecycle telemetry (docs/lifecycle.md), mutated only on the
+        # drain/restore caller's thread, snapshotted under _lock by
+        # stats(): drain duration + sessions preserved/resumed/
+        # fallback counters the health surface and bench read
+        self._lifecycle_stats = {
+            "drain_ms": 0.0, "sessions_spooled": 0,
+            "sessions_fallback": 0, "sessions_abandoned": 0,
+            "sessions_resumed": 0, "sessions_reprefill": 0,
+            "manifest_errors": 0,
+        }
+        self.lifecycle_phase = "serving"
 
     # ---- jitted device functions ----
 
@@ -780,19 +800,7 @@ class ServingEngine:
             if turn is not None:
                 self._fail_turn_unslotted(turn, msg)
             self._active[i] = None
-        while True:
-            try:
-                self._fail_turn_unslotted(self._queue_get_nowait(), msg)
-            except queue.Empty:
-                break
-        # turns the crash caught mid-admission (popped but unslotted):
-        # anything already failed/slotted above has done set and is
-        # skipped; the rest would hang their callers forever
-        for turn in self._admission_turns:
-            if not turn.done.is_set():
-                self._fail_turn_unslotted(turn, msg)
-        self._admission_turns = []
-        self._drain_releases()
+        self._fail_all_pending(msg)
         with self._lock:
             self._admitting.clear()
             self._deferred_release.clear()
@@ -1026,6 +1034,39 @@ class ServingEngine:
             self._jit_cache[key] = scatter
         return self._jit_cache[key]
 
+    def _gather_pages_host(
+        self, sess: _Session
+    ) -> tuple[dict[str, np.ndarray], int]:
+        """Copy a session's own (non-prefix) KV pages out to host
+        arrays keyed like the cache. Returns (arrays, n_used). Shared
+        by the offload path and the drain spooler — callers own fault
+        points and retry policy."""
+        pages = self.page_table.pages_of(sess.id)
+        own_tokens = sess.length - sess.prefix_len
+        n_used = -(-own_tokens // self.page_size)
+        used = pages[:n_used]
+        n_pad = self._pow2(max(n_used, 1))
+        ids = np.zeros((n_pad,), np.int32)
+        ids[:n_used] = used
+        out = self._offload_gather_fn(n_pad)(
+            self.cache, jnp.asarray(ids)
+        )
+        # start every device->host copy before materializing any of
+        # them, so transfers overlap
+        for a in out.values():
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass
+        # ascontiguousarray: a plain slice would be a VIEW pinning the
+        # whole pow2-padded transfer buffer (~2x the real bytes),
+        # silently defeating the host-tier cap
+        host = {
+            k: np.ascontiguousarray(np.asarray(a)[:, :n_used])
+            for k, a in out.items()
+        }
+        return host, n_used
+
     # ---- public API ----
 
     def submit(
@@ -1056,7 +1097,15 @@ class ServingEngine:
             deadline=(time.monotonic() + budget) if budget > 0 else None,
             priority=priority,
         )
-        self._queue_put(turn)
+        if not self._queue_put(turn, unless_draining=True):
+            # graceful drain (docs/lifecycle.md): admission is closed.
+            # Same shed contract as ladder rung 4 — routes map it to
+            # 503 + Retry-After, and the session (if any) stays parked
+            # for the restarted process to resume.
+            turn.shed = True
+            self._fail_turn_unslotted(
+                turn, "draining: engine is restarting; retry shortly"
+            )
         return turn
 
     def release_session(self, session_id: str) -> None:
@@ -1092,11 +1141,23 @@ class ServingEngine:
                 return
             self._do_release(sid)
 
-    def _queue_put(self, turn: Turn) -> None:
+    def _queue_put(
+        self, turn: Turn, *, unless_draining: bool = False
+    ) -> bool:
+        """Count + enqueue atomically. With ``unless_draining`` the
+        lifecycle-phase check shares the same lock hold, closing the
+        submit-vs-drain race: begin_drain() flips the phase under this
+        lock and drain()'s sweep runs after, so a turn either lands in
+        the queue before the sweep (and is shed by it) or is refused
+        here and shed by the caller — never stranded in a queue no
+        thread will read again."""
         with self._lock:
+            if unless_draining and self.lifecycle_phase == "draining":
+                return False
             self._queued_sids[turn.session_id] = \
                 self._queued_sids.get(turn.session_id, 0) + 1
-        self._queue.put(turn)
+            self._queue.put(turn)
+        return True
 
     def _queue_uncount(self, turn: Turn) -> None:
         with self._lock:
@@ -1115,6 +1176,30 @@ class ServingEngine:
         turn = self._queue.get_nowait()
         self._queue_uncount(turn)
         return turn
+
+    def _fail_all_pending(self, msg: str, *, shed: bool = False) -> None:
+        """Fail every not-yet-slotted turn: drain the submit queue,
+        then sweep turns caught mid-admission (popped but unslotted —
+        anything already failed/slotted has ``done`` set and is
+        skipped; the rest would hang their callers forever), and flush
+        deferred releases. Shared by crash recovery and graceful drain
+        (the latter marks turns ``shed`` so routes answer 503 +
+        Retry-After)."""
+        while True:
+            try:
+                turn = self._queue_get_nowait()
+            except queue.Empty:
+                break
+            if shed:
+                turn.shed = True
+            self._fail_turn_unslotted(turn, msg)
+        for turn in self._admission_turns:
+            if not turn.done.is_set():
+                if shed:
+                    turn.shed = True
+                self._fail_turn_unslotted(turn, msg)
+        self._admission_turns = []
+        self._drain_releases()
 
     def _session_in_flight(self, session_id: str) -> bool:
         """True while any live turn (active in a slot, mid-admission,
@@ -1163,6 +1248,10 @@ class ServingEngine:
         out["healthy"] = self.healthy
         out["offload"] = self.offload_store.stats() \
             if self.offload_store is not None else None
+        with self._lock:
+            lc = dict(self._lifecycle_stats)
+        lc["phase"] = self.lifecycle_phase
+        out["lifecycle"] = lc
         return out
 
     # ---- engine loop ----
@@ -1310,40 +1399,19 @@ class ServingEngine:
         store = self.offload_store
         if store is None or sess.length <= sess.prefix_len:
             return False
-        pages = self.page_table.pages_of(sess.id)
-        if not pages:
+        if not self.page_table.pages_of(sess.id):
             return False
         own_tokens = sess.length - sess.prefix_len
-        n_used = -(-own_tokens // self.page_size)
-        used = pages[:n_used]
-        n_pad = self._pow2(max(n_used, 1))
-        ids = np.zeros((n_pad,), np.int32)
-        ids[:n_used] = used
-        gather = self._offload_gather_fn(n_pad)
 
         def call():
             # fault point fires BEFORE the device call (no donation to
             # protect here, but the contract stays uniform)
             faults.maybe_fail("offload_io")
-            return gather(self.cache, jnp.asarray(ids))
+            return self._gather_pages_host(sess)
 
         try:
             with self.timer.phase("offload_out"):
-                out = self._retrying("offload_out", call)
-                # start every device->host copy before materializing
-                # any of them, so transfers overlap
-                for a in out.values():
-                    try:
-                        a.copy_to_host_async()
-                    except AttributeError:
-                        pass
-                # ascontiguousarray: a plain slice would be a VIEW
-                # pinning the whole pow2-padded transfer buffer (~2x
-                # the real bytes), silently defeating the host-tier cap
-                host = {
-                    k: np.ascontiguousarray(np.asarray(a)[:, :n_used])
-                    for k, a in out.items()
-                }
+                host, n_used = self._retrying("offload_out", call)
         except FaultError:
             self._bump("offload_resident_fallbacks")
             self._note_pressure()
@@ -1758,6 +1826,7 @@ class ServingEngine:
     ) -> Optional[dict]:
         sess.parked = False
         sess.last_used = time.monotonic()
+        sess.generation += 1
 
         if turn.sampling.max_new_tokens <= 0:
             turn.finish_reason = "length"
@@ -2798,3 +2867,461 @@ class ServingEngine:
 
     def text_of(self, turn: Turn) -> str:
         return self.tokenizer.decode(turn.new_tokens)
+
+    # ---- durable process lifecycle (lifecycle.py, docs/lifecycle.md) ----
+
+    def begin_drain(self) -> None:
+        """Close admission: submit() sheds every new turn with the
+        ladder's 503 + Retry-After contract from this point on. The
+        flip shares the engine lock with _queue_put, so a racing
+        submit either enqueued before it (drain()'s sweep sheds the
+        turn) or sees the new phase and sheds at the door; the quiesce
+        + spool happens in drain()."""
+        with self._lock:
+            self.lifecycle_phase = "draining"
+
+    def _lifecycle_fingerprint(self) -> dict:
+        """What a spooled KV entry must match to be scattered into THIS
+        engine: model, page geometry, quant mode, and the cache's
+        per-array dtype/shape (page axis excluded — pool size may
+        legitimately differ across a restart). JSON-stable types only,
+        so equality survives the manifest round trip."""
+        layout = {
+            k: [str(v.dtype),
+                [int(d) for i, d in enumerate(v.shape) if i != 1]]
+            for k, v in self.cache.items()
+        }
+        return {
+            "model": self.cfg.name,
+            "page_size": int(self.page_size),
+            "kv_quant": self.kv_quant,
+            "cache_layout": layout,
+        }
+
+    def _lc_bump(self, key: str, n=1) -> None:
+        with self._lock:
+            self._lifecycle_stats[key] += n
+
+    def _spool_session_kv(
+        self, sess: _Session, lifecycle_dir: str
+    ) -> Optional[dict]:
+        """Write one session's KV to a durable spool file for the next
+        process. Source is the live pool (gather) or the offload store
+        (whichever holds the pages). Returns the manifest kv record, or
+        None — shared prefix pages, injected shutdown_io/offload_io
+        faults, and real I/O errors all degrade to a history re-prefill
+        entry, never an exception."""
+        import hashlib
+
+        from .kv_offload import _copy_spool, _write_spool
+
+        if sess.prefix_len > 0:
+            # prefix pages are shared with other sessions and owned by
+            # the (process-local) prefix cache: not reconstructible
+            # across a restart — re-prefill rebuilds prefix + own KV
+            return None
+        own_tokens = sess.length
+        if own_tokens <= 0:
+            return None
+        try:
+            faults.maybe_fail("shutdown_io")
+            host = src_path = None
+            if self.page_table.pages_of(sess.id):
+                faults.maybe_fail("offload_io")
+                host, n_used = self._gather_pages_host(sess)
+            elif self.offload_store is not None and \
+                    self.offload_store.has(sess.id):
+                copy_src = self.offload_store.spool_copy_source(
+                    sess.id
+                )
+                if copy_src is not None:
+                    # disk-tier hibernated session: the file is
+                    # already in spool format — byte-copy it instead
+                    # of parsing the whole KV into RAM to re-serialize
+                    src_path, n_used = copy_src
+                else:
+                    got = self.offload_store.get(sess.id)
+                    if got is None:
+                        return None
+                    entry, host = got
+                    n_used = entry.n_pages
+            else:
+                return None
+            fname = hashlib.sha1(
+                sess.id.encode()
+            ).hexdigest()[:16] + ".kvspool"
+            path = os.path.join(lifecycle_dir, fname)
+            digest = _write_spool(path, host, want_digest=True) \
+                if host is not None else _copy_spool(src_path, path)
+            return {
+                "file": fname,
+                "own_tokens": int(own_tokens),
+                "n_pages": int(n_used),
+                "nbytes": int(os.path.getsize(path)),
+                "sha256": digest,
+            }
+        except Exception:
+            # FaultError/OSError from the spool I/O, but also device-
+            # side failures (XlaRuntimeError out of the page gather):
+            # the per-session contract is degrade-to-history, and one
+            # bad gather must not abort the whole drain before the
+            # manifest lands every other session's history
+            return None
+
+    def drain(
+        self,
+        lifecycle_dir: Optional[str] = None,
+        *,
+        deadline_s: Optional[float] = None,
+        flush: bool = True,
+    ) -> dict:
+        """Graceful quiesce for a process restart (docs/lifecycle.md):
+        close admission, flush the in-flight decode window (every
+        durably-streamed token reaches its session's history), park all
+        active sessions, shed queued turns with 503 semantics, and
+        spool every session to ``lifecycle_dir`` under a versioned
+        manifest the next boot rehydrates from.
+
+        Bounded: past ``deadline_s`` (ROOM_TPU_DRAIN_DEADLINE_S,
+        default 30) remaining sessions skip the KV copy and are
+        recorded in the manifest's ``abandoned`` intent list with their
+        token history intact — a restart re-prefills them; the exit is
+        never blocked. A wedged shutdown_io/offload_io fault costs at
+        most one firing per session, then the same fallback.
+
+        Engine-thread semantics: stop and join the serve_forever
+        thread first (its shutdown flush already ran then). For the
+        drain's duration THIS thread claims loop-thread ownership, so
+        a route thread's release_session defers to the command queue
+        instead of popping self.sessions/page-table state out from
+        under the spool loop (the HTTP server is still answering
+        during the drain window — that's where the 503s come from);
+        deferred releases are applied on the way out."""
+        from . import lifecycle as lc
+
+        if lifecycle_dir is None:
+            lifecycle_dir = lc.engine_dir(self.cfg.name)
+        if deadline_s is None:
+            deadline_s = lc.drain_deadline_s()
+        t0 = time.monotonic()
+        deadline = t0 + max(deadline_s, 0.0)
+        with self._lock:
+            self._loop_thread = threading.current_thread()
+        try:
+            return self._drain_inner(
+                lifecycle_dir, deadline, t0, flush
+            )
+        finally:
+            with self._lock:
+                self._loop_thread = None
+            self._drain_releases()
+
+    def _drain_inner(
+        self, lifecycle_dir: str, deadline: float, t0: float,
+        flush: bool,
+    ) -> dict:
+        from . import lifecycle as lc
+
+        self.begin_drain()
+        if flush:
+            try:
+                self._flush_pipeline()
+            except Exception:
+                self._inflight = None
+        else:
+            # caller could not quiesce the serve thread (it may still
+            # own the in-flight window and a wedged device op): drop
+            # the window rather than block on — or race — it
+            self._inflight = None
+        drain_msg = "draining: engine is restarting; retry shortly"
+        sampling_of: dict[str, Any] = {}
+        for i, turn in enumerate(self._active):
+            if turn is None:
+                continue
+            sess = self.sessions.get(turn.session_id)
+            if sess is not None:
+                sess.last_used = time.monotonic()
+                if turn.new_tokens:
+                    # the park contract: the final sampled token's KV
+                    # is unwritten — it re-enters via the resume prompt
+                    sess.pending = turn.new_tokens[-1]
+                sess.parked = True
+                try:
+                    import dataclasses
+
+                    sampling_of[sess.id] = dataclasses.asdict(
+                        turn.sampling
+                    )
+                except (TypeError, ValueError):
+                    pass
+            self._active[i] = None
+            self._slot_tables[i] = 0
+            self._slot_lengths[i] = 0
+            self._slot_ahead[i] = 0
+            turn.shed = True
+            self._fail_turn_unslotted(turn, drain_msg)
+        self._fail_all_pending(drain_msg, shed=True)
+
+        entries: list[dict] = []
+        abandoned: list[str] = []
+        fallback_ids: set[str] = set()
+        try:
+            os.makedirs(lifecycle_dir, exist_ok=True)
+            dir_ok = True
+        except OSError:
+            dir_ok = False
+        # warmest first: the sessions most likely to resume right after
+        # the restart make the deadline cut. Snapshot under the lock —
+        # a racing submit can still insert a session entry before its
+        # turn is refused at the draining gate
+        with self._lock:
+            drain_order = sorted(
+                self.sessions.values(), key=lambda s: -s.last_used
+            )
+        for sess in drain_order:
+            if not sess.history and sess.pending is None:
+                continue
+            entry = {
+                "id": sess.id,
+                "history": [int(t) for t in sess.history],
+                "pending": sess.pending,
+                "length": int(sess.length),
+                "generation": int(sess.generation),
+                "sampling": sampling_of.get(sess.id),
+                "kv": None,
+            }
+            preservable = sess.length > sess.prefix_len or (
+                self.offload_store is not None
+                and self.offload_store.has(sess.id)
+            )
+            if dir_ok and time.monotonic() >= deadline:
+                # out of budget: record the abandonment intent (history
+                # still rides the manifest, so nothing is LOST — the
+                # restart re-prefills) and keep moving toward the exit
+                if preservable:
+                    abandoned.append(sess.id)
+                entries.append(entry)
+                continue
+            kv = self._spool_session_kv(sess, lifecycle_dir) \
+                if dir_ok else None
+            if kv is not None:
+                entry["kv"] = kv
+            elif preservable:
+                fallback_ids.add(sess.id)
+            entries.append(entry)
+        # apply releases that arrived during the spool loop BEFORE the
+        # manifest lands: a session the client explicitly released must
+        # not be resurrected parked on the next boot with the very
+        # history the release discarded (its orphaned spool file is
+        # swept by the restore; a release in the post-write window
+        # still leaks one boot's worth of parked state — the restore's
+        # idle sweep is the backstop)
+        released: set[str] = set()
+        while True:
+            try:
+                sid = self._release_requests.get_nowait()
+            except queue.Empty:
+                break
+            released.add(sid)
+            self._do_release(sid)
+        if released:
+            entries = [
+                e for e in entries if e["id"] not in released
+            ]
+            abandoned = [s for s in abandoned if s not in released]
+        spooled = sum(1 for e in entries if e.get("kv"))
+        fallback = len(fallback_ids - released)
+        manifest = {
+            "version": lc.MANIFEST_VERSION,
+            "generation": lc.next_generation(lifecycle_dir),
+            "written_at": time.time(),
+            "fingerprint": self._lifecycle_fingerprint(),
+            "sessions": entries,
+            "abandoned": abandoned,
+        }
+        wrote = lc.write_manifest(lifecycle_dir, manifest)
+        drain_ms = (time.monotonic() - t0) * 1000.0
+        with self._lock:
+            st = self._lifecycle_stats
+            st["drain_ms"] = round(drain_ms, 3)
+            st["sessions_spooled"] += spooled
+            st["sessions_fallback"] += fallback
+            st["sessions_abandoned"] += len(abandoned)
+            if not wrote:
+                st["manifest_errors"] += 1
+        try:
+            from ..core.telemetry import incr_counter, observe_ms
+
+            observe_ms("lifecycle.drain", drain_ms)
+            incr_counter("lifecycle.sessions_spooled", spooled)
+            if abandoned:
+                incr_counter("lifecycle.sessions_abandoned",
+                             len(abandoned))
+        except Exception:
+            pass
+        return {
+            "drain_ms": round(drain_ms, 3),
+            "sessions_total": len(entries),
+            "sessions_spooled": spooled,
+            "sessions_fallback": fallback,
+            "sessions_abandoned": len(abandoned),
+            "manifest_written": wrote,
+            "dir": lifecycle_dir,
+        }
+
+    def restore_from_manifest(
+        self, lifecycle_dir: Optional[str] = None
+    ) -> dict:
+        """Warm restart (docs/lifecycle.md): scan the drain manifest,
+        validate every entry against THIS engine's config, and
+        rehydrate sessions as restorable-parked. Valid KV spool files
+        are adopted into the offload store's disk tier — the session's
+        next prefill restores them through the ordinary byte-exact
+        disk-hit path, so greedy continuations are token-identical
+        across the restart. A layout/config/size mismatch, a truncated
+        file, or an injected shutdown_io fault falls back to the
+        history re-prefill path here; the manifest's sha256 is checked
+        lazily at the first spool read (boot stays a metadata scan),
+        where a mismatch degrades to the same re-prefill (still
+        token-identical, just slower). Never raises; consumes the
+        manifest so a later crash
+        cannot resurrect stale sessions; sweeps orphaned spool files on
+        the way out."""
+        from . import lifecycle as lc
+
+        if lifecycle_dir is None:
+            lifecycle_dir = lc.engine_dir(self.cfg.name)
+        prev_phase = self.lifecycle_phase
+        self.lifecycle_phase = "warming"
+        summary = {"resumed": 0, "reprefill": 0, "skipped": 0,
+                   "manifest": False}
+        manifest = lc.read_manifest(lifecycle_dir)
+        if manifest is None:
+            if os.path.exists(
+                os.path.join(lifecycle_dir, lc.MANIFEST_NAME)
+            ):
+                self._lc_bump("manifest_errors")
+            lc.sweep_orphans(lifecycle_dir)
+            with self._lock:
+                # same begin-drain guard as the manifest-present exit:
+                # a SIGTERM landing mid-restore must not be clobbered
+                # back to serving, reopening admission mid-shutdown
+                if self.lifecycle_phase == "warming":
+                    self.lifecycle_phase = "serving" \
+                        if prev_phase != "draining" else prev_phase
+            return summary
+        summary["manifest"] = True
+        fp_ok = manifest.get("version") == lc.MANIFEST_VERSION and \
+            manifest.get("fingerprint") == self._lifecycle_fingerprint()
+        adopted_files: set[str] = set()
+        adopted_sess: dict[str, _Session] = {}
+        # COLDEST first: adopt() rebalances the disk tier by evicting
+        # the lowest last_used entry, and adoption time IS last_used —
+        # so when the manifest's bytes exceed this engine's disk cap,
+        # iterating the (warmest-first) manifest in reverse makes the
+        # overflow evict the coldest sessions, preserving the drain's
+        # warmest-first priority instead of inverting it
+        for entry in reversed(manifest.get("sessions", [])):
+            try:
+                sid = entry["id"]
+                history = [int(t) for t in entry["history"]]
+                pending = entry.get("pending")
+                pending = int(pending) if pending is not None else None
+                generation = int(entry.get("generation") or 0)
+                if not isinstance(sid, str) or not sid or (
+                    not history and pending is None
+                ) or sid in self.sessions:
+                    summary["skipped"] += 1
+                    continue
+            except (KeyError, TypeError, ValueError):
+                summary["skipped"] += 1
+                continue
+            sess = _Session(
+                id=sid, parked=True, pending=pending,
+                history=history, generation=generation,
+            )
+            kv = entry.get("kv")
+            adopted = False
+            if isinstance(kv, dict) and fp_ok and \
+                    self.offload_store is not None:
+                fname = os.path.basename(str(kv.get("file") or ""))
+                path = os.path.join(lifecycle_dir, fname)
+                try:
+                    faults.maybe_fail("shutdown_io")
+                    own = int(kv["own_tokens"])
+                    n_pages = int(kv["n_pages"])
+                    # metadata-only validation — the manifest's sha256
+                    # is verified lazily at the session's first spool
+                    # read (TieredKVStore.get), so boot never reads
+                    # the KV bytes; a size mismatch is caught here for
+                    # free, anything subtler degrades to a re-prefill
+                    # miss at first use
+                    good = (
+                        fname.endswith(".kvspool")
+                        and own == len(history) == int(
+                            entry.get("length") or -1
+                        )
+                        and bool(kv.get("sha256"))
+                        and n_pages == -(-own // self.page_size)
+                        and os.path.getsize(path) == int(
+                            kv.get("nbytes") or -1
+                        )
+                    )
+                except (FaultError, KeyError, TypeError, ValueError,
+                        OSError):
+                    good = False
+                if good and self.offload_store.adopt(
+                    sid, path, own, n_pages,
+                    int(kv.get("nbytes") or 0),
+                    sha256=str(kv["sha256"]),
+                ):
+                    sess.length = own
+                    adopted = True
+                    adopted_files.add(fname)
+            if adopted:
+                adopted_sess[sid] = sess
+            else:
+                # history mirror re-prefill (|history| == length holds
+                # once the resume prefill rebuilds the pages)
+                sess.length = 0
+                summary["reprefill"] += 1
+            self.sessions[sid] = sess
+        # a later adopt's rebalance may have evicted an earlier one
+        # (disk cap overflow): count only entries that SURVIVED the
+        # whole restore as resumed, and demote the evicted back to the
+        # re-prefill path — health/bench must never claim warmth the
+        # store no longer holds
+        for sid, sess in adopted_sess.items():
+            if self.offload_store is not None and \
+                    self.offload_store.has(sid):
+                summary["resumed"] += 1
+            else:
+                sess.length = 0
+                summary["reprefill"] += 1
+        with self._lock:
+            st = self._lifecycle_stats
+            st["sessions_resumed"] += summary["resumed"]
+            st["sessions_reprefill"] += summary["reprefill"]
+        lc.consume_manifest(lifecycle_dir)
+        # everything the manifest no longer protects: fallback spool
+        # files from THIS restore plus any older process's leavings
+        lc.sweep_orphans(lifecycle_dir, keep=adopted_files,
+                         max_age_s=0.0)
+        try:
+            from ..core.telemetry import incr_counter
+
+            incr_counter("lifecycle.sessions_resumed",
+                         summary["resumed"])
+            incr_counter("lifecycle.sessions_reprefill",
+                         summary["reprefill"])
+        except Exception:
+            pass
+        with self._lock:
+            # begin_drain() may have landed mid-restore (SIGTERM during
+            # a boot-time warm-up): never clobber a live 'draining'
+            # back to serving off the stale entry snapshot — that would
+            # reopen admission on an engine the process is quiescing
+            if self.lifecycle_phase == "warming":
+                self.lifecycle_phase = "serving" \
+                    if prev_phase != "draining" else prev_phase
+        return summary

@@ -20,6 +20,11 @@ named *fault point* that tests (and staging deployments) can arm:
     offload_io         KV offload copy-out / restore fails (transient;
                        exhaustion fails back to resident pages on the
                        way out, to a history re-prefill on the way in)
+    shutdown_io        lifecycle manifest / drain-spool / marker I/O
+                       fails (docs/lifecycle.md): a failed write loses
+                       warmth (the restart re-prefills), a failed read
+                       cold-starts — a drain or boot never hangs or
+                       crashes on it
 
 Swarm-layer points (docs/swarm_recovery.md) thread the same registry
 up through the agent runtime above the engine:
@@ -62,7 +67,7 @@ __all__ = [
 FAULT_POINTS = (
     "kv_alloc", "prefill_oom", "decode_step", "decode_window",
     "decode_stall", "tokenizer", "engine_crash", "client_disconnect",
-    "provider_timeout", "offload_io",
+    "provider_timeout", "offload_io", "shutdown_io",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
 )

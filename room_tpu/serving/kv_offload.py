@@ -85,28 +85,73 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def _write_spool(path: str, arrays: dict[str, np.ndarray]) -> None:
+def _write_spool(
+    path: str, arrays: dict[str, np.ndarray], want_digest: bool = False,
+) -> Optional[str]:
     """One entry -> one file: json header (dtype/shape per key) + raw
     buffers in sorted-key order. Raw bytes instead of np.savez because
-    bfloat16 is not a savez-portable dtype. Atomic via rename."""
+    bfloat16 is not a savez-portable dtype. Atomic via rename. With
+    ``want_digest`` also returns the file's sha256, hashed as the
+    bytes stream out — the drain manifest needs it, and re-reading a
+    multi-hundred-MB spool to hash it would double the I/O inside the
+    drain deadline. The ordinary host->disk demotion path skips the
+    hash: it runs under pool pressure and nothing consumes the digest
+    there."""
     tmp = path + ".tmp"
     meta = {
         k: {"dtype": a.dtype.name, "shape": list(a.shape)}
         for k, a in arrays.items()
     }
     hdr = json.dumps(meta).encode()
+    h = hashlib.sha256() if want_digest else None
     with open(tmp, "wb") as f:
-        f.write(len(hdr).to_bytes(8, "little"))
-        f.write(hdr)
+        for chunk in (len(hdr).to_bytes(8, "little"), hdr):
+            f.write(chunk)
+            if h is not None:
+                h.update(chunk)
         for k in sorted(arrays):
-            f.write(np.ascontiguousarray(arrays[k]).tobytes())
+            buf = np.ascontiguousarray(arrays[k]).tobytes()
+            f.write(buf)
+            if h is not None:
+                h.update(buf)
     os.replace(tmp, path)
+    return h.hexdigest() if h is not None else None
 
 
-def _read_spool(path: str) -> dict[str, np.ndarray]:
+def _copy_spool(src: str, dst: str) -> str:
+    """Streaming byte copy of an existing spool file (atomic via
+    rename, sha256 hashed in transit) — drain's fast path for
+    disk-tier hibernated sessions: the bytes are already in spool
+    format, so parsing them into host RAM just to re-serialize would
+    double the I/O and transiently hold the whole KV resident inside
+    the drain deadline."""
+    h = hashlib.sha256()
+    tmp = dst + ".tmp"
+    with open(src, "rb") as fi, open(tmp, "wb") as fo:
+        for chunk in iter(lambda: fi.read(1 << 20), b""):
+            fo.write(chunk)
+            h.update(chunk)
+    os.replace(tmp, dst)
+    return h.hexdigest()
+
+
+def _read_spool(
+    path: str, expected_sha: Optional[str] = None
+) -> dict[str, np.ndarray]:
+    """Parse a spool file; with ``expected_sha`` also verify the file's
+    sha256 (hashed incrementally over the same read — adopted
+    warm-restart spools defer their integrity check to this first read
+    so boot stays a metadata scan) and raise ValueError on mismatch."""
+    h = hashlib.sha256() if expected_sha else None
     with open(path, "rb") as f:
-        hdr_len = int.from_bytes(f.read(8), "little")
-        meta = json.loads(f.read(hdr_len).decode())
+        raw = f.read(8)
+        hdr_len = int.from_bytes(raw, "little")
+        if h is not None:
+            h.update(raw)
+        raw = f.read(hdr_len)
+        meta = json.loads(raw.decode())
+        if h is not None:
+            h.update(raw)
         out: dict[str, np.ndarray] = {}
         for k in sorted(meta):
             dt = _np_dtype(meta[k]["dtype"])
@@ -115,7 +160,15 @@ def _read_spool(path: str) -> dict[str, np.ndarray]:
             buf = f.read(n)
             if len(buf) != n:
                 raise OSError(f"truncated spool file {path!r}")
+            if h is not None:
+                h.update(buf)
             out[k] = np.frombuffer(buf, dtype=dt).reshape(shape)
+        if h is not None:
+            h.update(f.read())   # any trailing bytes count too
+            if h.hexdigest() != expected_sha:
+                raise ValueError(
+                    f"checksum mismatch for spool {path!r}"
+                )
     return out
 
 
@@ -130,6 +183,9 @@ class OffloadEntry:
     nbytes: int
     arrays: Optional[dict[str, np.ndarray]] = None   # tier 1
     path: Optional[str] = None                       # tier 2
+    # expected file sha256 for ADOPTED warm-restart spools, verified
+    # lazily at first read (None for spools this process wrote itself)
+    sha256: Optional[str] = None
     created_at: float = field(default_factory=time.monotonic)
     last_used: float = field(default_factory=time.monotonic)
 
@@ -172,6 +228,17 @@ class TieredKVStore:
         self._spool_dir = spool_dir or \
             os.environ.get("ROOM_TPU_OFFLOAD_DIR") or None
         self._own_spool = self._spool_dir is None
+        # a SHARED spool dir (env/arg — the durable deployment shape,
+        # docs/lifecycle.md) accumulates files from processes that died
+        # uncleanly: sweep age-thresholded orphans at construction,
+        # never files a live drain manifest still references
+        if self._spool_dir and os.path.isdir(self._spool_dir):
+            try:
+                from .lifecycle import sweep_orphans
+
+                sweep_orphans(self._spool_dir)
+            except Exception:
+                pass  # hygiene is best-effort; the store must come up
         self._entries: dict[str, OffloadEntry] = {}
         self._lock = threading.Lock()
         self._stats = {
@@ -191,9 +258,13 @@ class TieredKVStore:
         return self._spool_dir
 
     def _spool_path(self, session_id: str) -> str:
+        # PID-tagged (lifecycle.spool_owner_pid): in a SHARED durable
+        # spool dir, a sibling process's boot sweep must be able to
+        # tell "hibernated by a live engine" (skip, whatever the age)
+        # from "leaked by a dead one" (sweep past the age threshold)
         slug = hashlib.sha1(session_id.encode()).hexdigest()[:16]
         return os.path.join(self._ensure_spool_dir(),
-                            f"{slug}.kvspool")
+                            f"pid{os.getpid()}-{slug}.kvspool")
 
     # ---- tier accounting (callers hold self._lock) ----
 
@@ -285,9 +356,72 @@ class TieredKVStore:
         self._rebalance()
         return entry
 
+    def adopt(
+        self, session_id: str, path: str, own_tokens: int,
+        n_pages: int, nbytes: int, sha256: Optional[str] = None,
+    ) -> bool:
+        """Register an EXISTING spool file as a disk-tier entry without
+        reading it — warm-restart rehydration (serving/lifecycle.py):
+        the restored engine's next prefill for the session restores it
+        through the ordinary disk-hit path, byte-exact. ``sha256`` (the
+        manifest's digest) is verified lazily on that first read, so
+        boot stays a metadata scan; a mismatch degrades to the same
+        re-prefill miss as a truncated file. The store takes ownership
+        of the file (a later discard/drop unlinks it). Returns False
+        when the disk cap can't hold the entry — the caller falls back
+        to a history re-prefill."""
+        if self.disk_bytes_cap <= 0 or nbytes > self.disk_bytes_cap:
+            return False
+        from .lifecycle import spool_owner_pid
+
+        if spool_owner_pid(path) != os.getpid():
+            # re-tag with the adopting PID: drain spools carry untagged
+            # names, and in a shared engine dir a sibling boot's sweep
+            # only age-protects untagged files — the PID tag is what
+            # keeps a live engine's adopted sessions safe past the age
+            # threshold (same-dir rename, so the move stays atomic)
+            tagged = os.path.join(
+                os.path.dirname(path),
+                f"pid{os.getpid()}-{os.path.basename(path)}",
+            )
+            try:
+                os.replace(path, tagged)
+                path = tagged
+            except OSError:
+                pass  # keep the untagged name; age still protects it
+        entry = OffloadEntry(
+            session_id=session_id, own_tokens=own_tokens,
+            n_pages=n_pages, nbytes=nbytes, arrays=None, path=path,
+            sha256=sha256,
+        )
+        with self._lock:
+            old = self._entries.pop(session_id, None)
+            if old is not None:
+                self._drop_entry(old)
+            self._entries[session_id] = entry
+        self._rebalance()
+        return self.has(session_id)
+
     def has(self, session_id: str) -> bool:
         with self._lock:
             return session_id in self._entries
+
+    def spool_copy_source(
+        self, session_id: str
+    ) -> Optional[tuple[str, int]]:
+        """(path, n_pages) when a session's KV can be byte-copied
+        straight off the disk tier — already in spool format and
+        written (hence implicitly trusted) by THIS process. Adopted
+        entries, whose sha256 is still pending its lazy first-read
+        verification, are excluded: byte-copying them would re-digest
+        unverified bytes and launder an earlier corruption through the
+        next manifest's checksum. Drain's fast path."""
+        with self._lock:
+            e = self._entries.get(session_id)
+            if e is None or e.arrays is not None or e.path is None \
+                    or e.sha256 is not None:
+                return None
+            return e.path, e.n_pages
 
     def tier_of(self, session_id: str) -> Optional[str]:
         with self._lock:
@@ -312,9 +446,10 @@ class TieredKVStore:
                 return entry, entry.arrays
             path = entry.path
         try:
-            arrays = _read_spool(path)
+            arrays = _read_spool(path, expected_sha=entry.sha256)
         except (OSError, ValueError, KeyError):
-            # truncated file, garbage header, or shape/dtype mismatch
+            # truncated file, garbage header, shape/dtype mismatch, or
+            # an adopted spool failing its (lazy) checksum
             # all degrade the same way: a miss the engine re-prefills
             with self._lock:
                 self._stats["spool_errors"] += 1

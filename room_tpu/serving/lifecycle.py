@@ -1,0 +1,340 @@
+"""Durable process lifecycle: drain manifests, clean-shutdown markers,
+spool hygiene (docs/lifecycle.md).
+
+The chaos layer (docs/chaos.md), the cycle journal
+(docs/swarm_recovery.md), and the decode pipeline's crash recovery all
+keep a *live* process correct under failure — but the process boundary
+was a cliff: a SIGTERM (the normal event in any production rollout)
+dropped every in-flight turn and every hibernated session, because the
+offload spool defaulted to a per-process tempdir and nothing wrote
+restart state. This module is the durable half of the fix:
+
+- a **versioned session manifest** written at graceful drain
+  (``ServingEngine.drain``): per session — id, full token history,
+  pending token, generation counter, sampling params, and (when the KV
+  could be spooled) a dtype/layout-fingerprinted spool file with a
+  per-file sha256. The next boot (``restore_from_manifest``) validates
+  every entry against the live engine's config; anything corrupt,
+  truncated, or config-mismatched falls back to a history re-prefill —
+  never a crash, and greedy continuations stay token-identical either
+  way;
+- a **clean-shutdown marker** the server runtime consumes at boot, so
+  journal recovery can tell a rolling restart from a crash;
+- an **orphan spool sweep** that deletes ``*.kvspool`` files left by
+  dead processes (age-thresholded, and never a file a live manifest
+  still references) — an unclean exit no longer leaks the spool dir
+  forever.
+
+Failure policy: every read/write here sits behind the ``shutdown_io``
+fault point and degrades — a failed manifest write loses warmth, not
+the exit; a failed read cold-starts. Nothing in this module may hang or
+crash a drain or a boot.
+
+Env knobs (docs/lifecycle.md):
+
+    ROOM_TPU_LIFECYCLE           enable drain/restore on the provider
+                                 path ("1"/"0"; deployment default on,
+                                 library engines opt in per call)
+    ROOM_TPU_LIFECYCLE_DIR       durable state root (default
+                                 <tmp>/room_tpu_lifecycle — stable
+                                 across process restarts on one host)
+    ROOM_TPU_DRAIN_DEADLINE_S    drain budget (default 30); past it
+                                 remaining sessions are abandoned to
+                                 the manifest's intent record instead
+                                 of blocking the exit
+    ROOM_TPU_SPOOL_SWEEP_AGE_S   orphan spool files older than this are
+                                 swept at boot (default 3600)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+from typing import Iterable, Optional
+
+from . import faults
+from .faults import FaultError
+
+__all__ = [
+    "MANIFEST_VERSION", "MANIFEST_NAME", "MARKER_NAME",
+    "lifecycle_enabled_from_env", "lifecycle_root", "engine_dir",
+    "drain_deadline_s", "sweep_age_s", "file_sha256",
+    "write_manifest", "read_manifest", "consume_manifest",
+    "manifest_spool_files", "spool_owner_pid", "sweep_orphans",
+    "write_clean_marker", "consume_clean_marker", "record_boot",
+]
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+MARKER_NAME = "clean_shutdown.marker"
+STATE_NAME = "lifecycle_state.json"
+SPOOL_SUFFIX = ".kvspool"
+
+
+def lifecycle_enabled_from_env(default: str = "0") -> bool:
+    return os.environ.get("ROOM_TPU_LIFECYCLE", default).strip() not in (
+        "0", "", "off", "false",
+    )
+
+
+def lifecycle_root() -> str:
+    """Durable state root. The default lives under the system temp dir
+    — stable across process restarts on one host (the rolling-restart
+    case this subsystem exists for), without writing to $HOME from
+    library code. Deployments that need reboot durability point
+    ROOM_TPU_LIFECYCLE_DIR at a real volume."""
+    return os.environ.get("ROOM_TPU_LIFECYCLE_DIR") or os.path.join(
+        tempfile.gettempdir(), "room_tpu_lifecycle"
+    )
+
+
+def engine_dir(model_name: str) -> str:
+    """Per-model manifest/spool dir: rolling restarts hand state from
+    exactly one old process to one new one per served model."""
+    slug = "".join(
+        c if c.isalnum() or c in "._-" else "_" for c in model_name
+    )
+    return os.path.join(lifecycle_root(), "engines", slug)
+
+
+def drain_deadline_s() -> float:
+    try:
+        return float(os.environ.get("ROOM_TPU_DRAIN_DEADLINE_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def sweep_age_s() -> float:
+    try:
+        return float(
+            os.environ.get("ROOM_TPU_SPOOL_SWEEP_AGE_S", "3600")
+        )
+    except ValueError:
+        return 3600.0
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+# ---- manifest ----
+
+def write_manifest(dir_path: str, manifest: dict) -> bool:
+    """Atomic (tmp + rename) manifest write. Returns False — never
+    raises, never hangs the drain — on an injected ``shutdown_io``
+    fault or a real I/O error."""
+    try:
+        faults.maybe_fail("shutdown_io")
+        os.makedirs(dir_path, exist_ok=True)
+        path = os.path.join(dir_path, MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, separators=(",", ":"))
+        os.replace(tmp, path)
+        return True
+    except (FaultError, OSError, TypeError, ValueError):
+        return False
+
+
+def read_manifest(dir_path: str) -> Optional[dict]:
+    """Load + minimally validate the manifest. Any failure — injected
+    ``shutdown_io`` fault, missing/truncated/garbage file — returns
+    None: the caller cold-starts (or re-prefills), never crashes."""
+    path = os.path.join(dir_path, MANIFEST_NAME)
+    try:
+        faults.maybe_fail("shutdown_io")
+        with open(path, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (FaultError, OSError, ValueError):
+        return None
+    if not isinstance(manifest, dict) or \
+            not isinstance(manifest.get("sessions"), list):
+        return None
+    return manifest
+
+
+def next_generation(dir_path: str) -> int:
+    """Monotonic manifest generation: max of the previous manifest's
+    and the per-engine-dir state sidecar's, + 1. The sidecar is what
+    makes the counter honest — restore_from_manifest *consumes*
+    (unlinks) the manifest, so without it every normal drain→restore
+    cycle reset the count to 1 and it never actually counted rolling
+    restarts. Deliberately NOT behind the shutdown_io fault point —
+    it's advisory bookkeeping and must not consume a one-shot fault
+    budget armed for the real manifest write."""
+    last = 0
+    for name in (MANIFEST_NAME, STATE_NAME):
+        try:
+            with open(os.path.join(dir_path, name), "r",
+                      encoding="utf-8") as f:
+                last = max(
+                    last,
+                    int((json.load(f) or {}).get("generation") or 0),
+                )
+        except (OSError, ValueError, TypeError, AttributeError):
+            pass
+    gen = last + 1
+    try:
+        os.makedirs(dir_path, exist_ok=True)
+        tmp = os.path.join(dir_path, STATE_NAME + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"generation": gen}, f)
+        os.replace(tmp, os.path.join(dir_path, STATE_NAME))
+    except OSError:
+        pass
+    return gen
+
+
+def consume_manifest(dir_path: str) -> None:
+    try:
+        os.unlink(os.path.join(dir_path, MANIFEST_NAME))
+    except OSError:
+        pass
+
+
+def spool_owner_pid(name: str) -> Optional[int]:
+    """Owner PID encoded in a live-tier spool filename
+    (``pid<NNN>-<slug>.kvspool`` — see TieredKVStore._spool_path), or
+    None for unowned files (drain spools, pre-PID-tag leftovers)."""
+    base = os.path.basename(name)
+    if not base.startswith("pid"):
+        return None
+    head, sep, _ = base[3:].partition("-")
+    if not sep or not head.isdigit():
+        return None
+    return int(head)
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        pass  # EPERM etc. — it exists, just isn't ours
+    return True
+
+
+def manifest_spool_files(manifest: Optional[dict]) -> set[str]:
+    """Basenames of every spool file a manifest still references —
+    the sweep's protected set."""
+    out: set[str] = set()
+    for entry in (manifest or {}).get("sessions", ()):
+        kv = entry.get("kv") if isinstance(entry, dict) else None
+        if isinstance(kv, dict) and kv.get("file"):
+            out.add(os.path.basename(str(kv["file"])))
+    return out
+
+
+def sweep_orphans(
+    dir_path: str,
+    keep: Iterable[str] = (),
+    max_age_s: Optional[float] = None,
+) -> int:
+    """Delete orphaned ``*.kvspool`` files (and ``*.kvspool.tmp``
+    partials from writes a crash interrupted) left behind by dead
+    processes. Skips files referenced by a live manifest in the same
+    dir, files in ``keep``, files whose PID-tagged owner process is
+    still alive (a SHARED offload dir holds live sibling engines'
+    hibernated sessions — age alone must never delete those; a
+    recycled PID can over-protect an orphan, which merely defers the
+    sweep to that process's exit), and anything younger than
+    ``max_age_s`` (default ROOM_TPU_SPOOL_SWEEP_AGE_S) — a concurrent
+    drain's fresh spool files must survive a racing boot. Returns
+    files removed; never raises."""
+    if max_age_s is None:
+        max_age_s = sweep_age_s()
+    manifest = read_manifest(dir_path)
+    if manifest is None and os.path.exists(
+        os.path.join(dir_path, MANIFEST_NAME)
+    ):
+        # a manifest is PRESENT but unreadable (transient I/O error or
+        # an armed shutdown_io fault): its protected set is unknown, so
+        # deleting anything could destroy still-referenced warm-restart
+        # data — "a failed read cold-starts", it never destroys. A
+        # permanently corrupt manifest merely defers the sweep until
+        # the next successful drain replaces it.
+        return 0
+    protected = set(keep) | manifest_spool_files(manifest)
+    removed = 0
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return 0
+    cutoff = time.time() - max(max_age_s, 0.0)
+    for name in names:
+        # .kvspool.tmp: a crash mid-_write_spool leaves the partial
+        # file under the tmp name forever (the rename never ran) — no
+        # manifest can reference it, so only age and a live PID tag
+        # protect it
+        if not name.endswith((SPOOL_SUFFIX, SPOOL_SUFFIX + ".tmp")) \
+                or name in protected:
+            continue
+        owner = spool_owner_pid(name)
+        if owner is not None and _pid_alive(owner):
+            continue
+        path = os.path.join(dir_path, name)
+        try:
+            if os.path.getmtime(path) > cutoff:
+                continue
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            continue
+    return removed
+
+
+# ---- clean-shutdown marker ----
+
+def write_clean_marker(root: Optional[str] = None) -> bool:
+    """Stamp the root after a fully-drained shutdown. Consumed (and
+    deleted) by the next boot; its absence while prior lifecycle state
+    exists means the last process crashed."""
+    root = root or lifecycle_root()
+    try:
+        faults.maybe_fail("shutdown_io")
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, MARKER_NAME), "w",
+                  encoding="utf-8") as f:
+            json.dump({"written_at": time.time()}, f)
+        return True
+    except (FaultError, OSError):
+        return False
+
+
+def record_boot(root: Optional[str] = None) -> None:
+    """Leave a state stamp so the NEXT boot can tell "no marker because
+    we crashed" from "no marker because this host never ran room-tpu"."""
+    root = root or lifecycle_root()
+    try:
+        os.makedirs(root, exist_ok=True)
+        with open(os.path.join(root, STATE_NAME), "w",
+                  encoding="utf-8") as f:
+            json.dump({"booted_at": time.time()}, f)
+    except OSError:
+        pass
+
+
+def consume_clean_marker(root: Optional[str] = None) -> str:
+    """How did the previous process die? ``clean`` (marker present —
+    consumed here so it attests exactly one shutdown), ``crash`` (prior
+    state but no marker: journal recovery has real work), or
+    ``first_boot``. Never raises."""
+    root = root or lifecycle_root()
+    marker = os.path.join(root, MARKER_NAME)
+    try:
+        os.unlink(marker)
+        return "clean"
+    except OSError:
+        pass
+    return "crash" if os.path.exists(os.path.join(root, STATE_NAME)) \
+        else "first_boot"

@@ -41,6 +41,21 @@ try:
 except Exception:
     pass
 
+# Hermetic lifecycle state (docs/lifecycle.md): the durable drain
+# manifest / clean-shutdown marker root defaults to a STABLE path under
+# the system tempdir — exactly right in production (state survives the
+# restart), exactly wrong in tests (one run's drain would warm-restore
+# into the next). Pin it to a fresh per-run dir unless a test (or the
+# caller) chose its own.
+if "ROOM_TPU_LIFECYCLE_DIR" not in os.environ:
+    import atexit as _atexit
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    _lc_tmp = _tempfile.mkdtemp(prefix="room_tpu_test_lifecycle_")
+    os.environ["ROOM_TPU_LIFECYCLE_DIR"] = _lc_tmp
+    _atexit.register(_shutil.rmtree, _lc_tmp, ignore_errors=True)
+
 import pytest  # noqa: E402
 
 from room_tpu.db import Database  # noqa: E402

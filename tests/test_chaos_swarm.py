@@ -391,7 +391,11 @@ def test_db_io_fault_kills_loop_and_supervisor_restarts(db, room, echo):
     handle = _start_loop(db, room, queen["id"])
     assert handle.thread.is_alive()
 
-    faults.inject("db_io", times=1)
+    # burst, not one-shot: a lone arm can land mid-spontaneous-cycle
+    # (WIP momentum) where the transient cycle-error handler swallows
+    # it; the burst leaves an arm for the fatal tail write / next
+    # top-of-loop get_worker
+    faults.inject("db_io", times=3)
     handle.wake.set()  # next iteration hits the injected OperationalError
     assert _wait(lambda: not handle.thread.is_alive()), \
         "db_io fault did not kill the loop thread"
@@ -400,6 +404,7 @@ def test_db_io_fault_kills_loop_and_supervisor_restarts(db, room, echo):
     # the corpse stays in the registry for the supervisor to find
     assert agent_loop._running_loops.get(queen["id"]) is handle
 
+    faults.clear()   # unconsumed arms must not hit the restart below
     actions = agent_loop.supervise_loops(db)
     assert queen["id"] in actions["restarted"]
     new = agent_loop._running_loops.get(queen["id"])
@@ -529,9 +534,18 @@ def test_restart_budget_exhaustion_escalates(db, room, echo,
     handle = _start_loop(db, room, queen["id"])
 
     for strike in range(2):
-        faults.inject("db_io", times=1)
+        # a burst, not a one-shot: the loop runs spontaneous cycles
+        # (WIP momentum), and a single arm landing mid-cycle is
+        # swallowed by the transient cycle-error handler — the loop
+        # survives and the strike never lands. With a burst, the
+        # cycle's swallow still leaves an arm for the fatal tail
+        # set_agent_state / next top-of-loop get_worker.
+        faults.inject("db_io", times=3)
         handle.wake.set()
         assert _wait(lambda: not handle.thread.is_alive())
+        # unconsumed arms must not hit supervise/restart or this
+        # thread's own queries below
+        faults.clear()
         agent_loop.supervise_loops(db)
         handle = agent_loop._running_loops.get(queen["id"])
         if handle is None:

@@ -1,0 +1,928 @@
+"""Durable process lifecycle suite (docs/lifecycle.md).
+
+Pins the restart contract end to end on the CPU backend:
+
+- a drained-then-restarted engine resumes a parked greedy session with
+  output TOKEN-IDENTICAL to an uninterrupted run — through the
+  byte-exact KV spool (manifest + checksummed spool file adopted into
+  the offload store) AND through every degraded fallback (no offload
+  store, corrupt manifest, truncated spool, model-config mismatch):
+  the fallbacks re-prefill from the manifest's token history, trading
+  compute, never correctness;
+- SIGTERM mid-decode-window loses no durably-streamed tokens: the
+  shutdown flush books the in-flight window, the drain parks on the
+  last sampled token, and the resumed stream continues exactly where
+  the interrupted one stopped;
+- the drain is BOUNDED: a wedged offload_io/shutdown_io fault or a
+  blown ROOM_TPU_DRAIN_DEADLINE_S abandons remaining KV copies to the
+  manifest's intent record instead of blocking the exit;
+- spool hygiene: orphan files from dead processes are swept
+  (age-thresholded, manifest-referenced files protected);
+- the clean-shutdown marker round-trips, and its absence routes the
+  next boot through journal crash recovery (docs/swarm_recovery.md).
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import (
+    SamplingParams, ServingEngine, faults, lifecycle,
+)
+from room_tpu.serving.kv_offload import TieredKVStore, _write_spool
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture()
+def make_engine(model, monkeypatch, tmp_path):
+    """Engine factory: prefix cache off so every session's KV is
+    spoolable (shared prefixes legitimately re-prefill), offload spool
+    under tmp_path, and NO stop tokens — greedy streams always run to
+    their budget, so mid-stream interruption points are controllable."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path / "spool"))
+    cfg, params = model
+
+    def build(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("offload", True)
+        kw.setdefault("stop_token_ids", [])
+        return ServingEngine(cfg, params, **kw)
+
+    return build
+
+
+@pytest.fixture()
+def lc_dir(tmp_path):
+    return str(tmp_path / "lifecycle")
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+PROMPT = list(range(1, 20))
+CONT = [7, 7, 7]
+
+
+def _control_streams(make_engine, n1=8, n2=8):
+    """Uninterrupted two-turn reference streams."""
+    ctrl = make_engine(offload=False)
+    c1 = ctrl.submit(PROMPT, session_id="s", sampling=_greedy(n1))
+    ctrl.run_until_idle()
+    c2 = ctrl.submit(CONT, session_id="s", sampling=_greedy(n2))
+    ctrl.run_until_idle()
+    return c1.new_tokens, c2.new_tokens
+
+
+# ---- drain manifest shape ----
+
+def test_drain_writes_versioned_checksummed_manifest(make_engine, lc_dir):
+    eng = make_engine()
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    summary = eng.drain(lc_dir)
+    assert summary["manifest_written"] and summary["sessions_spooled"] == 1
+    assert eng.lifecycle_phase == "draining"
+
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        m = json.load(f)
+    assert m["version"] == lifecycle.MANIFEST_VERSION
+    assert m["generation"] == 1
+    assert m["fingerprint"]["page_size"] == 8
+    (entry,) = m["sessions"]
+    sess_len = entry["length"]
+    assert entry["id"] == "s"
+    assert len(entry["history"]) == sess_len
+    assert entry["pending"] is not None
+    assert entry["generation"] == 1
+    kv = entry["kv"]
+    path = os.path.join(lc_dir, kv["file"])
+    assert os.path.getsize(path) == kv["nbytes"]
+    assert lifecycle.file_sha256(path) == kv["sha256"]
+    assert kv["own_tokens"] == sess_len
+
+    # a second drain bumps the manifest generation (rolling restarts
+    # can tell stale state from fresh)
+    eng2 = make_engine()
+    eng2.submit(PROMPT, session_id="t", sampling=_greedy())
+    eng2.run_until_idle()
+    eng2.drain(lc_dir)
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        assert json.load(f)["generation"] == 2
+
+    # ... and the counter survives the restore CONSUMING the manifest
+    # (the per-dir state sidecar carries it), so it genuinely counts
+    # rolling restarts instead of resetting to 1 each cycle
+    eng3 = make_engine()
+    eng3.restore_from_manifest(lc_dir)
+    assert not os.path.exists(
+        os.path.join(lc_dir, lifecycle.MANIFEST_NAME)
+    )
+    eng3.drain(lc_dir)
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        assert json.load(f)["generation"] == 3
+
+
+def test_submit_while_draining_sheds_with_503_contract(make_engine):
+    eng = make_engine()
+    eng.begin_drain()
+    t = eng.submit(PROMPT, session_id="x", sampling=_greedy())
+    assert t.done.is_set() and t.shed and t.finish_reason == "error"
+    # routes map turn.shed to 503 + Retry-After (PR 1 ladder plumbing)
+
+
+# ---- THE acceptance canary: warm restart token identity ----
+
+def test_drain_restart_resumes_token_identical(make_engine, lc_dir):
+    c1, c2 = _control_streams(make_engine)
+
+    a = make_engine()
+    t1 = a.submit(PROMPT, session_id="s", sampling=_greedy())
+    a.run_until_idle()
+    assert t1.new_tokens == c1
+    assert a.drain(lc_dir)["sessions_spooled"] == 1
+
+    b = make_engine()
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored == {"resumed": 1, "reprefill": 0, "skipped": 0,
+                        "manifest": True}
+    assert b.sessions["s"].parked
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    st = b.stats()
+    assert st["offload_restores"] == 1, \
+        "warm restart must restore spooled KV, not re-prefill"
+    assert st["lifecycle"]["sessions_resumed"] == 1
+    assert t2.new_tokens == c2, (
+        "restart round trip changed the greedy stream"
+    )
+    # consumed: a second boot must not resurrect stale sessions
+    assert not os.path.exists(
+        os.path.join(lc_dir, lifecycle.MANIFEST_NAME)
+    )
+
+
+def test_restart_without_offload_store_reprefills_identical(
+    make_engine, lc_dir,
+):
+    _, c2 = _control_streams(make_engine)
+    a = make_engine()
+    a.submit(PROMPT, session_id="s", sampling=_greedy())
+    a.run_until_idle()
+    a.drain(lc_dir)
+
+    b = make_engine(offload=False)   # no store to adopt KV into
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored["resumed"] == 0 and restored["reprefill"] == 1
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert b.stats()["lifecycle"]["sessions_reprefill"] == 1
+    assert t2.new_tokens == c2
+
+
+def test_sigterm_mid_decode_window_loses_no_streamed_tokens(
+    make_engine, lc_dir,
+):
+    """Acceptance: interrupt serve_forever mid-stream (pipelined
+    dispatch windows in flight), drain, restart, resume — the streamed
+    prefix plus the resumed stream equals the uninterrupted run."""
+    budget = 32
+    ctrl = make_engine(offload=False)
+    full = ctrl.submit(PROMPT, session_id="s", sampling=_greedy(budget))
+    ctrl.run_until_idle()
+    assert len(full.new_tokens) == budget   # no stop tokens configured
+
+    eng = make_engine()
+    eng.steps_per_dispatch = 4
+    stop = threading.Event()
+    seen: list[int] = []
+
+    def on_token(tok):
+        seen.append(tok)
+        if len(seen) == 3:
+            stop.set()   # SIGTERM lands mid-window
+
+    t1 = eng.submit(PROMPT, session_id="s",
+                    sampling=_greedy(budget), on_token=on_token)
+    thread = threading.Thread(target=eng.serve_forever, args=(stop,))
+    thread.start()
+    thread.join(timeout=60)
+    assert not thread.is_alive()
+    summary = eng.drain(lc_dir)
+    assert summary["sessions_total"] == 1
+    # the shutdown flush booked every dispatched token: the failed
+    # turn's stream IS the durable prefix
+    assert t1.shed and t1.new_tokens == seen
+    assert 3 <= len(seen) < budget, "interruption must be mid-stream"
+    assert seen == full.new_tokens[: len(seen)]
+
+    b = make_engine()
+    b.steps_per_dispatch = 4
+    assert b.restore_from_manifest(lc_dir)["manifest"]
+    t2 = b.submit([], session_id="s",
+                  sampling=_greedy(budget - len(seen)))
+    b.run_until_idle()
+    assert seen + t2.new_tokens == full.new_tokens, (
+        "restart dropped or duplicated streamed tokens"
+    )
+
+
+def test_disk_cap_overflow_keeps_warmest_and_counts_honestly(
+    make_engine, lc_dir, monkeypatch,
+):
+    """Review hardening: when the manifest's spooled bytes exceed the
+    restoring engine's disk cap, the WARMEST session must keep its
+    byte-exact KV (adoption runs coldest-first so the overflow evicts
+    cold entries) and the resumed/reprefill counts must reflect what
+    actually survived — never claim warmth the store no longer holds.
+    Both sessions stay token-identical either way."""
+    ctrl = make_engine(offload=False)
+    expect = {}
+    for sid in ("cold", "warm"):
+        ctrl.submit(PROMPT, session_id=sid, sampling=_greedy())
+        ctrl.run_until_idle()
+        t = ctrl.submit(CONT, session_id=sid, sampling=_greedy())
+        ctrl.run_until_idle()
+        expect[sid] = t.new_tokens
+
+    eng = make_engine()
+    for sid in ("cold", "warm"):   # "warm" submitted last = warmest
+        eng.submit(PROMPT, session_id=sid, sampling=_greedy())
+        eng.run_until_idle()
+    summary = eng.drain(lc_dir)
+    assert summary["sessions_spooled"] == 2
+    sizes = [os.path.getsize(os.path.join(lc_dir, f))
+             for f in os.listdir(lc_dir) if f.endswith(".kvspool")]
+    assert len(sizes) == 2 and sizes[0] == sizes[1]
+    # cap fits exactly one spool
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DISK_MB",
+                       str(sizes[0] * 1.5 / (1024 * 1024)))
+    b = make_engine()
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored == {"resumed": 1, "reprefill": 1, "skipped": 0,
+                        "manifest": True}
+    assert b.offload_store.has("warm"), \
+        "disk-cap overflow must evict the coldest session, not the warmest"
+    assert not b.offload_store.has("cold")
+    for sid in ("cold", "warm"):
+        t = b.submit(CONT, session_id=sid, sampling=_greedy())
+        b.run_until_idle()
+        assert t.new_tokens == expect[sid], sid
+
+
+def test_unquiesced_drain_spools_nothing_but_keeps_history(
+    make_engine, lc_dir,
+):
+    """Review hardening: when the serve thread failed to join
+    (ModelHost.shutdown's wedged path), drain must not flush the
+    pipeline or gather KV from under a possibly-live loop —
+    ``drain(deadline_s=0, flush=False)`` records history-only entries
+    and the restart re-prefills token-identical."""
+    _, c2 = _control_streams(make_engine)
+    eng = make_engine()
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    summary = eng.drain(lc_dir, deadline_s=0.0, flush=False)
+    assert summary["sessions_spooled"] == 0
+    assert summary["sessions_abandoned"] == 1
+    assert summary["manifest_written"]
+    assert not [f for f in os.listdir(lc_dir)
+                if f.endswith(".kvspool")], \
+        "no KV may be gathered from an unquiesced engine"
+
+    b = make_engine()
+    assert b.restore_from_manifest(lc_dir)["reprefill"] == 1
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_drain_byte_copies_disk_tier_spool(
+    make_engine, lc_dir, monkeypatch,
+):
+    """A disk-tier hibernated session drains via a streaming byte copy
+    (the file is already in spool format — no parse, no full-KV RAM
+    residency inside the deadline) and still restores
+    token-identical."""
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_HOST_MB", "0")  # disk tier
+    _, c2 = _control_streams(make_engine)
+    eng = make_engine()
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    eng.sessions["s"].parked = True      # as a </tool_call> stop does
+    assert eng.offload_session("s")
+    assert eng.offload_store.tier_of("s") == "disk"
+    assert eng.offload_store.spool_copy_source("s") is not None
+    summary = eng.drain(lc_dir)
+    assert summary["sessions_spooled"] == 1
+
+    b = make_engine()
+    assert b.restore_from_manifest(lc_dir)["resumed"] == 1
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_release_during_drain_defers_until_after_spool(
+    make_engine, lc_dir,
+):
+    """Review hardening: HTTP route threads are still finishing (the
+    API stops after the drain — that's where the 503s come from) and
+    their finally-blocks call release_session. During drain() the
+    drain thread claims loop-thread ownership, so a racing release
+    defers to the command queue instead of popping self.sessions out
+    from under the spool loop; it applies on the way out."""
+    eng = make_engine()
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    gate = threading.Event()
+    entered = threading.Event()
+    real = eng._spool_session_kv
+
+    def slow_spool(sess, d):
+        entered.set()
+        gate.wait(10)
+        return real(sess, d)
+
+    eng._spool_session_kv = slow_spool
+    out: dict = {}
+    th = threading.Thread(
+        target=lambda: out.update(s=eng.drain(lc_dir))
+    )
+    th.start()
+    assert entered.wait(10), "drain never reached the spool loop"
+    eng.release_session("s")   # a route thread's finally, mid-drain
+    assert "s" in eng.sessions, "release must defer during the drain"
+    gate.set()
+    th.join(20)
+    assert not th.is_alive()
+    assert out["s"]["manifest_written"]
+    assert "s" not in eng.sessions, \
+        "the deferred release applies before the manifest lands"
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        m = json.load(f)
+    assert [e["id"] for e in m["sessions"]] == [], \
+        "a released session must not be resurrected by the next boot"
+    assert out["s"]["sessions_spooled"] == 0
+
+
+def test_graceful_stop_marks_clean_with_lifecycle_disabled(
+    tmp_path, monkeypatch,
+):
+    """Review hardening: ROOM_TPU_LIFECYCLE=0 disables drains and
+    manifests, but the boot-side marker check runs unconditionally —
+    so the graceful path must still stamp the marker, or every clean
+    stop of a lifecycle-disabled deployment reads as a crash."""
+    import room_tpu.providers.tpu as tpu_mod
+    from room_tpu.db import Database
+    from room_tpu.server import runtime as rt_mod
+    from room_tpu.server.app import start_server
+
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE", "0")
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR",
+                       str(tmp_path / "root"))
+    monkeypatch.setenv("ROOM_TPU_MCP_AUTOREGISTER", "0")
+    rt_mod._runtime = None
+    app = start_server(port=0, db=Database(":memory:"))
+    try:
+        app.stop(graceful=True)
+    finally:
+        rt_mod._runtime = None
+        tpu_mod.reset_model_hosts()
+    assert lifecycle.consume_clean_marker() == "clean"
+
+
+def test_drain_window_blocks_cold_engine_builds():
+    """Review hardening: between begin_drain_model_hosts and process
+    exit, a straggler request must get a ProviderError (routes map it
+    to 503 + Retry-After) instead of cold-building a fresh engine —
+    that engine's restore would consume the manifest the drain just
+    wrote and then die un-drained at exit behind a clean marker."""
+    from room_tpu.providers import tpu as tpu_provider
+    from room_tpu.providers.base import ProviderError
+
+    tpu_provider.reset_model_hosts()
+    tpu_provider.begin_drain_model_hosts()
+    try:
+        with pytest.raises(ProviderError, match="draining"):
+            tpu_provider.get_model_host("tiny-moe").engine()
+    finally:
+        tpu_provider.reset_model_hosts()
+    assert not tpu_provider._draining
+
+
+def test_second_signal_escalates_past_wedged_drain(tmp_path):
+    """Review hardening: the SIGTERM handler takes the graceful path
+    once; a second signal while the drain is wedged must restore the
+    default disposition and kill the process — an operator's repeated
+    Ctrl-C can never be swallowed forever."""
+    import signal
+    import subprocess
+    import sys
+
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "from room_tpu.server.runtime import "
+        "install_lifecycle_signal_handlers\n"
+        "install_lifecycle_signal_handlers(lambda: time.sleep(120))\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    try:
+        assert p.stdout.readline().strip() == "ready"
+        p.send_signal(signal.SIGTERM)   # enters the wedged drain
+        time.sleep(0.5)
+        p.send_signal(signal.SIGTERM)   # must escalate
+        rc = p.wait(timeout=20)
+    finally:
+        p.kill()
+    assert rc == -signal.SIGTERM, (
+        f"second SIGTERM did not terminate the process (rc={rc})"
+    )
+
+
+# ---- degraded fallbacks: never a crash, always the full history ----
+
+def test_corrupt_manifest_cold_starts_cleanly(make_engine, lc_dir):
+    os.makedirs(lc_dir)
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME), "w") as f:
+        f.write('{"version": 1, "sessions": [{"id":')   # truncated
+    b = make_engine()
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored == {"resumed": 0, "reprefill": 0, "skipped": 0,
+                        "manifest": False}
+    assert b.stats()["lifecycle"]["manifest_errors"] == 1
+    assert b.sessions == {} and b.lifecycle_phase == "serving"
+
+
+def test_truncated_spool_file_falls_back_to_reprefill(
+    make_engine, lc_dir,
+):
+    _, c2 = _control_streams(make_engine)
+    a = make_engine()
+    a.submit(PROMPT, session_id="s", sampling=_greedy())
+    a.run_until_idle()
+    a.drain(lc_dir)
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        kv = json.load(f)["sessions"][0]["kv"]
+    with open(os.path.join(lc_dir, kv["file"]), "r+b") as f:
+        f.truncate(kv["nbytes"] // 2)   # size validation now fails
+
+    b = make_engine()
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored["resumed"] == 0 and restored["reprefill"] == 1
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_bitflipped_spool_caught_lazily_stays_token_identical(
+    make_engine, lc_dir,
+):
+    """The manifest's sha256 is verified at the first spool READ, not
+    at boot (restore stays a metadata scan): a same-size corruption is
+    adopted, then the first restore attempt fails the checksum and
+    degrades to the re-prefill miss path — output still
+    token-identical."""
+    _, c2 = _control_streams(make_engine)
+    a = make_engine()
+    a.submit(PROMPT, session_id="s", sampling=_greedy())
+    a.run_until_idle()
+    a.drain(lc_dir)
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        kv = json.load(f)["sessions"][0]["kv"]
+    with open(os.path.join(lc_dir, kv["file"]), "r+b") as f:
+        f.seek(kv["nbytes"] - 8)
+        (b0,) = f.read(1)
+        f.seek(kv["nbytes"] - 8)
+        f.write(bytes([b0 ^ 0xFF]))   # size unchanged: boot can't see it
+
+    b = make_engine()
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored["resumed"] == 1, "lazy check: adoption succeeds"
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert t2.new_tokens == c2
+    assert b.offload_store._stats["spool_errors"] >= 1, \
+        "the corruption must be caught at first read"
+    assert not b.offload_store.has("s")
+
+
+def test_model_config_mismatch_falls_back_to_reprefill(
+    make_engine, lc_dir,
+):
+    _, c2 = _control_streams(make_engine)
+    a = make_engine()
+    a.submit(PROMPT, session_id="s", sampling=_greedy())
+    a.run_until_idle()
+    a.drain(lc_dir)
+
+    # page geometry changed across the restart: the spooled pages no
+    # longer line up — KV must be rejected, history must still resume
+    b = make_engine(page_size=16, n_pages=48)
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored["resumed"] == 0 and restored["reprefill"] == 1
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+# ---- bounded drain ----
+
+def test_wedged_offload_io_cannot_stall_shutdown(
+    make_engine, lc_dir, monkeypatch,
+):
+    """Satellite: a wedged KV copy (permanent offload_io with latency)
+    burns at most one firing before the deadline abandons the rest —
+    the drain returns promptly and every session's history still rides
+    the manifest, so the restart loses nothing but warmth."""
+    monkeypatch.setenv("ROOM_TPU_DRAIN_DEADLINE_S", "0.2")
+    _, c2 = _control_streams(make_engine)
+    eng = make_engine()
+    for i, sid in enumerate(("s", "t", "u")):
+        eng.submit(PROMPT, session_id=sid, sampling=_greedy())
+        eng.run_until_idle()
+    faults.inject("offload_io", latency_s=0.5, transient=False)
+    t0 = time.monotonic()
+    summary = eng.drain(lc_dir)
+    elapsed = time.monotonic() - t0
+    faults.clear()
+    assert elapsed < 3.0, f"drain stalled for {elapsed:.1f}s"
+    assert summary["sessions_total"] == 3
+    assert summary["sessions_spooled"] == 0
+    assert summary["sessions_fallback"] + \
+        summary["sessions_abandoned"] == 3
+    assert summary["sessions_abandoned"] >= 1
+    assert summary["manifest_written"]
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        m = json.load(f)
+    assert {e["id"] for e in m["sessions"]} == {"s", "t", "u"}
+    assert set(m["abandoned"]) <= {"s", "t", "u"}
+
+    b = make_engine()
+    restored = b.restore_from_manifest(lc_dir)
+    assert restored["reprefill"] == 3
+    t2 = b.submit(CONT, session_id="s", sampling=_greedy())
+    b.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_shutdown_io_chaos_burst(make_engine, tmp_path):
+    """Manifest/spool I/O failing 50% of the time across repeated
+    drain->restore->continue rounds: phases always settle, streams stay
+    greedy-identical whether each round restored KV or re-prefilled,
+    and nothing ever raises out of the lifecycle layer."""
+    rounds = 4
+    ctrl = make_engine(offload=False)
+    ctrl.submit(PROMPT, session_id="s", sampling=_greedy())
+    ctrl.run_until_idle()
+    expected = []
+    for i in range(rounds):
+        c = ctrl.submit(CONT, session_id="s", sampling=_greedy())
+        ctrl.run_until_idle()
+        expected.append(c.new_tokens)
+
+    eng = make_engine()
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    faults.inject("shutdown_io", probability=0.5, seed=7)
+    for i in range(rounds):
+        d = str(tmp_path / f"burst{i}")
+        eng.drain(d)
+        eng = make_engine()
+        eng.restore_from_manifest(d)
+        if "s" not in eng.sessions:
+            # the manifest write itself failed this round: warmth is
+            # lost, correctness of LATER rounds can't be compared —
+            # rebuild the session from scratch and keep hammering
+            faults.clear(); eng.submit(PROMPT, session_id="s",
+                                       sampling=_greedy())
+            eng.run_until_idle()
+            for c in expected[:i + 1]:
+                t = eng.submit(CONT, session_id="s",
+                               sampling=_greedy())
+                eng.run_until_idle()
+                assert t.new_tokens == c
+            faults.inject("shutdown_io", probability=0.5, seed=7 + i)
+            continue
+        t = eng.submit(CONT, session_id="s", sampling=_greedy())
+        eng.run_until_idle()
+        assert t.new_tokens == expected[i], f"round {i} diverged"
+        assert eng.lifecycle_phase == "serving"
+        assert eng.healthy
+    faults.clear()
+
+
+# ---- spool hygiene ----
+
+def test_orphan_spool_sweep_is_age_thresholded_and_manifest_aware(
+    tmp_path,
+):
+    d = str(tmp_path)
+    old = os.path.join(d, "dead.kvspool")
+    partial = os.path.join(d, "crashed.kvspool.tmp")
+    fresh = os.path.join(d, "fresh.kvspool")
+    kept = os.path.join(d, "kept.kvspool")
+    for p in (old, partial, fresh, kept):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    stale_t = time.time() - 7200
+    os.utime(old, (stale_t, stale_t))
+    os.utime(partial, (stale_t, stale_t))
+    os.utime(kept, (stale_t, stale_t))
+    with open(os.path.join(d, lifecycle.MANIFEST_NAME), "w") as f:
+        json.dump({"version": 1, "sessions": [
+            {"id": "k", "history": [1], "kv": {"file": "kept.kvspool"}},
+        ]}, f)
+
+    removed = lifecycle.sweep_orphans(d, max_age_s=3600)
+    assert removed == 2
+    assert not os.path.exists(old), "aged orphan must be swept"
+    assert not os.path.exists(partial), \
+        "crash-interrupted .tmp partials must be swept too"
+    assert os.path.exists(fresh), "fresh files survive (racing drain)"
+    assert os.path.exists(kept), "manifest-referenced files survive"
+
+
+def test_sweep_protects_everything_when_manifest_unreadable(tmp_path):
+    """Review hardening: a manifest that is PRESENT but unreadable
+    (transient I/O error, armed shutdown_io fault) has an unknown
+    protected set — the sweep must delete NOTHING rather than destroy
+    still-referenced warm-restart data. 'A failed read cold-starts',
+    it never destroys."""
+    d = str(tmp_path)
+    spool = os.path.join(d, "referenced.kvspool")
+    with open(spool, "wb") as f:
+        f.write(b"x")
+    stale_t = time.time() - 7200
+    os.utime(spool, (stale_t, stale_t))
+    with open(os.path.join(d, lifecycle.MANIFEST_NAME), "w") as f:
+        f.write("{not json")
+
+    assert lifecycle.sweep_orphans(d, max_age_s=3600) == 0
+    assert os.path.exists(spool)
+
+    # same protection when the read fails via the fault point
+    with open(os.path.join(d, lifecycle.MANIFEST_NAME), "w") as f:
+        json.dump({"version": 1, "sessions": []}, f)
+    faults.inject("shutdown_io", times=1)
+    assert lifecycle.sweep_orphans(d, max_age_s=3600) == 0
+    faults.clear()
+    assert os.path.exists(spool)
+
+    # a READABLE manifest that no longer references the file sweeps it
+    assert lifecycle.sweep_orphans(d, max_age_s=3600) == 1
+    assert not os.path.exists(spool)
+
+
+def test_sweep_skips_live_pid_owned_spools(tmp_path):
+    """A SHARED offload dir holds live sibling engines' hibernated
+    sessions: the boot sweep must never delete a PID-tagged spool whose
+    owner process is still alive, whatever its age — while a dead
+    owner's files sweep normally past the threshold."""
+    import subprocess
+    import sys
+
+    d = str(tmp_path)
+    live = os.path.join(d, f"pid{os.getpid()}-aaaa.kvspool")
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    dead = os.path.join(d, f"pid{child.pid}-bbbb.kvspool")
+    untagged = os.path.join(d, "legacy.kvspool")
+    for p in (live, dead, untagged):
+        with open(p, "wb") as f:
+            f.write(b"x")
+        stale_t = time.time() - 7200
+        os.utime(p, (stale_t, stale_t))
+
+    assert lifecycle.spool_owner_pid(live) == os.getpid()
+    assert lifecycle.spool_owner_pid(untagged) is None
+    removed = lifecycle.sweep_orphans(d, max_age_s=3600)
+    assert removed == 2
+    assert os.path.exists(live), "live sibling's spool must survive"
+    assert not os.path.exists(dead), "dead owner's spool is swept"
+    assert not os.path.exists(untagged), "untagged aged file is swept"
+
+
+def test_store_init_sweeps_shared_spool_dir(tmp_path, monkeypatch):
+    """Satellite: a durable (shared) ROOM_TPU_OFFLOAD_DIR no longer
+    leaks dead processes' spool files forever — store construction
+    sweeps aged orphans."""
+    d = tmp_path / "spool"
+    d.mkdir()
+    orphan = d / "leak.kvspool"
+    _write_spool(str(orphan), {"k": np.zeros((1, 4))})
+    stale_t = time.time() - 7200
+    os.utime(orphan, (stale_t, stale_t))
+    monkeypatch.setenv("ROOM_TPU_SPOOL_SWEEP_AGE_S", "3600")
+    TieredKVStore(spool_dir=str(d))
+    assert not orphan.exists()
+
+
+def test_adopt_retags_spool_with_owner_pid(tmp_path):
+    """Review hardening: a drain spool keeps its untagged name through
+    adoption unless adopt() re-tags it — and untagged files in a shared
+    engine dir are only age-protected, so a blue/green sibling's boot
+    sweep could delete a live engine's disk-tier session after the age
+    threshold. adopt() must rename the file to pid<self>-<name>."""
+    d = tmp_path / "engines"
+    d.mkdir()
+    path = d / "abcd.kvspool"
+    _write_spool(str(path), {"k": np.ones((2, 4), np.float32)})
+    nbytes = path.stat().st_size
+    store = TieredKVStore(spool_dir=str(tmp_path / "spool"))
+    assert store.adopt("s", str(path), 8, 1, nbytes)
+    tagged = d / f"pid{os.getpid()}-abcd.kvspool"
+    assert tagged.exists() and not path.exists()
+    stale_t = time.time() - 7200
+    os.utime(tagged, (stale_t, stale_t))
+    # no manifest protects it any more — only the live PID tag does
+    assert lifecycle.sweep_orphans(str(d), max_age_s=0.0) == 0
+    assert tagged.exists()
+
+
+def test_marker_withheld_when_drain_fails(tmp_path, monkeypatch):
+    """Review hardening: a graceful stop whose engine drain did NOT
+    land its manifest must not stamp the shutdown clean — the next
+    boot has real losses to report, not a green pill."""
+    import room_tpu.providers.tpu as tpu_mod
+    from room_tpu.db import Database
+    from room_tpu.server import runtime as rt_mod
+    from room_tpu.server.app import start_server
+
+    root = str(tmp_path / "root")
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", root)
+    monkeypatch.setenv("ROOM_TPU_MCP_AUTOREGISTER", "0")
+    monkeypatch.setattr(
+        tpu_mod, "drain_model_hosts",
+        lambda: {"tiny-moe": {"manifest_written": False,
+                              "error": "drain failed"}},
+    )
+    rt_mod._runtime = None
+    app = start_server(port=0, db=Database(":memory:"))
+    try:
+        app.stop(graceful=True)
+    finally:
+        rt_mod._runtime = None
+        # the monkeypatched drain skipped the real host teardown; clear
+        # the module _draining flag so later tests can cold-build
+        tpu_mod.reset_model_hosts()
+    assert not os.path.exists(
+        os.path.join(root, lifecycle.MARKER_NAME)
+    ), "a failed drain must withhold the clean-shutdown marker"
+    assert lifecycle.consume_clean_marker() == "crash"
+
+
+def test_same_process_restart_after_graceful_stop(tmp_path, monkeypatch):
+    """Review hardening: a graceful stop must leave the module state
+    restartable — the build bar lifts once teardown completes (so a
+    same-process start_server() can cold-build engines again) and the
+    new incarnation must not report the previous server's drain
+    summary in /api/tpu/health as its own."""
+    import room_tpu.providers.tpu as tpu_mod
+    from room_tpu.db import Database
+    from room_tpu.server import runtime as rt_mod
+    from room_tpu.server.app import start_server
+
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "root"))
+    monkeypatch.setenv("ROOM_TPU_MCP_AUTOREGISTER", "0")
+    rt_mod._runtime = None
+    app = start_server(port=0, db=Database(":memory:"))
+    try:
+        app.stop(graceful=True)
+    finally:
+        rt_mod._runtime = None
+    assert not tpu_mod._draining, \
+        "graceful stop must re-open engine builds once torn down"
+    assert rt_mod.lifecycle_snapshot()["drain"] is not None
+    # second incarnation, same process: boot reads the clean marker
+    # and starts with fresh drain telemetry
+    app2 = start_server(port=0, db=Database(":memory:"))
+    try:
+        snap = rt_mod.lifecycle_snapshot()
+        assert snap["last_shutdown"] == "clean"
+        assert snap["drain"] is None
+        assert snap["drain_ms"] is None
+    finally:
+        app2.stop()
+        rt_mod._runtime = None
+        tpu_mod.reset_model_hosts()
+
+
+# ---- clean-shutdown marker + journal crash recovery ----
+
+def test_clean_marker_roundtrip(tmp_path, monkeypatch):
+    root = str(tmp_path / "root")
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", root)
+    assert lifecycle.consume_clean_marker() == "first_boot"
+    lifecycle.record_boot()
+    assert lifecycle.write_clean_marker()
+    assert lifecycle.consume_clean_marker() == "clean"
+    # marker is consume-once: the NEXT boot without a fresh marker is
+    # a crash (prior state exists)
+    assert lifecycle.consume_clean_marker() == "crash"
+
+
+def test_marker_write_survives_shutdown_io_fault(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "r"))
+    faults.inject("shutdown_io", times=1)
+    assert lifecycle.write_clean_marker() is False   # degraded, no raise
+    assert lifecycle.write_clean_marker() is True
+
+
+def test_crash_boot_routes_through_journal_recovery(db, tmp_path,
+                                                    monkeypatch):
+    """Crash (no marker) -> the journal path recovers interrupted
+    work; clean drain (marker) -> recovery finds nothing. The swarm
+    side of the restart contract (docs/swarm_recovery.md)."""
+    from room_tpu.core import journal, rooms, workers
+
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "lr"))
+    room = rooms.create_room(db, "hive", worker_model="echo",
+                             create_wallet=False)
+    queen = workers.get_worker(db, room["queen_worker_id"])
+    cycle_id = db.insert(
+        "INSERT INTO worker_cycles(worker_id, room_id, status) "
+        "VALUES (?,?,'running')",
+        (queen["id"], room["id"]),
+    )
+    journal.record_started(db, "cycle", cycle_id, room_id=room["id"],
+                           worker_id=queen["id"])
+    lifecycle.record_boot()            # a previous life existed…
+    assert lifecycle.consume_clean_marker() == "crash"   # …no marker
+    summary = journal.recover(db)
+    assert summary["cycles"] == 1
+    row = db.query_one("SELECT status, error_message FROM "
+                       "worker_cycles WHERE id=?", (cycle_id,))
+    assert row["status"] == "error"
+    assert "recovered" in row["error_message"]
+    # the clean path: marker present, nothing open, recovery is a no-op
+    lifecycle.write_clean_marker()
+    assert lifecycle.consume_clean_marker() == "clean"
+    assert journal.recover(db) == {"cycles": 0, "task_runs": 0,
+                                   "effects_flagged": 0, "closed": 0}
+
+
+def test_health_route_reports_lifecycle(make_engine, monkeypatch):
+    """/api/tpu/health carries the process phase + per-engine
+    lifecycle blocks the TPU panel renders."""
+    import room_tpu.providers.tpu as tpu_mod
+    from room_tpu.server.router import RequestContext, Router
+    from room_tpu.server.routes import register_all_routes
+    from room_tpu.server.runtime import set_lifecycle_phase
+
+    eng = make_engine()
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+
+    class FakeHost:
+        _engine = eng
+
+        @staticmethod
+        def is_healthy():
+            return True
+
+    monkeypatch.setattr(tpu_mod, "_hosts", {"tiny-moe": FakeHost()})
+    set_lifecycle_phase("serving")
+    router = Router()
+    register_all_routes(router)
+    handler, params = router.match("GET", "/api/tpu/health")
+    out = handler(RequestContext(
+        method="GET", path="/api/tpu/health", params=params, query={},
+        body=None,
+    ))
+    data = out["data"]
+    assert data["lifecycle"]["phase"] == "serving"
+    assert "last_shutdown" in data["lifecycle"]
+    row = data["engines"]["tiny-moe"]
+    assert row["lifecycle"]["phase"] == "serving"
+    assert "sessions_resumed" in row["lifecycle"]
+    assert "drain_ms" in row["lifecycle"]

@@ -449,3 +449,30 @@ def test_onclick_sweep_no_server_errors():
         assert not fives, f"panel buttons caused 5xx: {fives}"
     finally:
         srv.stop()
+
+
+# ---- real-engine syntax gate (node-dependent; docs/lifecycle.md CI) ----
+
+def test_ui_js_parses_under_real_node():
+    """The mini-JS interpreter accepts a bounded JS *subset* — syntax
+    it happens to tolerate could still be invalid JS in a browser. When
+    a real node binary exists, `node --check` every UI source; on bare
+    containers (this image ships no JS engine) skip cleanly instead of
+    reporting a spurious failure."""
+    import shutil
+    import subprocess
+
+    node = shutil.which("node")
+    if node is None:
+        pytest.skip("node not installed; jsdom shim covers the render "
+                    "path without it")
+    for fname in sorted(os.listdir(UI_DIR)):
+        if not fname.endswith(".js"):
+            continue
+        proc = subprocess.run(
+            [node, "--check", os.path.join(UI_DIR, fname)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, (
+            f"{fname} failed node --check:\n{proc.stderr}"
+        )

@@ -1423,6 +1423,9 @@ async function renderTpu(el) {
   const healthPill = (e) => {
     if (e.healthy === false)
       return '<span class="pill failed">crash loop</span>';
+    const phase = e.lifecycle?.phase;
+    if (phase && phase !== "serving")
+      return `<span class="pill pending">${esc(phase)}</span>`;
     const lvl = e.degradation_level || 0;
     return `<span class="pill ${lvl ? "pending" : "verified"}">` +
       `${esc(DEGRADE_LABELS[lvl] || lvl)}</span>`;
@@ -1511,6 +1514,40 @@ async function renderTpu(el) {
             <span class="dim">effects replay-skipped:
               ${hl.swarm?.journal?.replay_consumed ?? 0}</span></span>
       </div>
+      <h2 style="margin-top:.6rem">lifecycle</h2>
+      <div class="kv">
+        <span class="k">process phase</span>
+          <span><span class="pill ${
+            hl.lifecycle?.phase === "serving" ? "verified"
+            : hl.lifecycle?.phase === "draining" ? "failed" : "pending"
+          }">${esc(hl.lifecycle?.phase || "unknown")}</span></span>
+        <span class="k">last shutdown</span>
+          <span>${hl.lifecycle?.last_shutdown === "crash"
+            ? '<span class="pill failed">crash</span>'
+            : hl.lifecycle?.last_shutdown === "clean"
+              ? '<span class="pill verified">clean</span>'
+              : esc(hl.lifecycle?.last_shutdown || "—")}</span>
+        ${hl.lifecycle?.drain_ms != null
+          ? `<span class="k">last drain</span>
+             <span>${hl.lifecycle.drain_ms}ms</span>`
+          : ""}
+      </div>
+      <table><tr><th>engine</th><th>phase</th><th>resumed</th>
+        <th>re-prefilled</th><th>spooled</th><th>abandoned</th>
+        <th>drain</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.lifecycle)
+        .map(([name, e]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${esc(e.lifecycle.phase || "")}</td>
+        <td>${e.lifecycle.sessions_resumed ?? 0}</td>
+        <td>${e.lifecycle.sessions_reprefill ?? 0}</td>
+        <td>${e.lifecycle.sessions_spooled ?? 0}</td>
+        <td>${e.lifecycle.sessions_abandoned ?? 0}</td>
+        <td class="dim">${e.lifecycle.drain_ms
+          ? `${e.lifecycle.drain_ms}ms` : "—"}</td></tr>`).join("") ||
+        '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
+      </table>
       ${Object.keys(hl.faults || {}).length
         ? `<div class="dim" style="margin-top:.4rem">armed faults: ${
             Object.entries(hl.faults).map(([n, f]) =>
