@@ -643,6 +643,9 @@ def run_cycle(db: Database, room: dict, worker: dict) -> dict:
             messages=messages,
             on_text=lambda t: logs.append("assistant", t[:4000]),
             idempotency_key=call_key,
+            # SLO class for the serving scheduler (docs/scheduler.md):
+            # queen turns are the room's p50-critical path
+            turn_class="queen" if is_queen else "worker",
         ))
 
         if not result.success and result.error:
@@ -938,6 +941,7 @@ def _compress_messages(
             max_turns=1,
             max_new_tokens=512,
             timeout_s=120,
+            turn_class="background",
         ))
         summary = r.text if r.success and r.text else None
     except Exception:

@@ -47,6 +47,7 @@ def distill_learned_context(
             max_turns=1,
             max_new_tokens=400,
             timeout_s=120,
+            turn_class="background",
         ))
         if not (r.success and r.text):
             return None

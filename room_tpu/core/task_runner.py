@@ -276,6 +276,10 @@ def _run_llm_task(
         max_turns=task["max_turns"] or 10,
         timeout_s=(task["timeout_minutes"] or 15) * 60,
         idempotency_key=call_key,
+        # scheduled task runs are the shed-first, chunk-budget-last
+        # SLO class (docs/scheduler.md): their multi-thousand-token
+        # prompts must never stall a queen turn
+        turn_class="background",
     )
 
     last_error: Optional[str] = None

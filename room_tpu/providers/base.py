@@ -34,6 +34,12 @@ class ExecutionRequest:
     temperature: float = 0.7
     max_new_tokens: int = 1024
     on_text: Optional[Callable[[str], None]] = None
+    # SLO class for the serving scheduler (docs/scheduler.md):
+    # "queen" | "worker" | "background", tagged from the swarm role
+    # that produced the turn (agent loop cycles, task runner). None /
+    # unknown runs as "worker". Providers without class-aware
+    # scheduling (API backends) simply ignore it.
+    turn_class: Optional[str] = None
     # audit tag from journaled callers (agent loop / task runner),
     # matching the journal's provider_call record for this attempt
     # (docs/swarm_recovery.md). Scoped to ONE attempt — a recovery

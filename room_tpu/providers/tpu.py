@@ -572,7 +572,12 @@ class TpuProvider:
         assistant_text = ""
         for turn_no in range(max(request.max_turns, 1)):
             t = engine.submit(
-                prompt_tokens, session_id=session_id, sampling=sampling
+                prompt_tokens, session_id=session_id, sampling=sampling,
+                # SLO class from the swarm role (docs/scheduler.md):
+                # queen turns admit ahead of workers ahead of
+                # background task runs, and the ladder sheds in the
+                # reverse order
+                turn_class=request.turn_class,
             )
             remaining = deadline - time.monotonic()
             if not t.done.wait(timeout=max(remaining, 0.001)):

@@ -80,6 +80,7 @@ class CommentaryEngine:
             prompt="\n".join(events[-20:]),
             system_prompt=COMMENTARY_PROMPT,
             max_turns=1, max_new_tokens=120, timeout_s=60,
+            turn_class="background",
         ))
         self.db.insert(
             "INSERT INTO clerk_usage(source, model, input_tokens, "

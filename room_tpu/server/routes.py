@@ -1177,7 +1177,11 @@ def register_aux_routes(r: Router) -> None:
                 # depth, host time blocked on drains, injected-window
                 # failures and trimmed overshoot
                 "steps_per_dispatch", "host_stall_ms",
-                "decode_windows", "window_faults", "overshoot_tokens")
+                "decode_windows", "window_faults", "overshoot_tokens",
+                # SLO scheduler (docs/scheduler.md): interleaved
+                # chunked-prefill churn
+                "prefill_chunks_interleaved", "prefill_chunk_defers",
+                "prefill_chunk_faults")
         summary = {
             name: {k: e[k] for k in keys if k in e}
             for name, e in engines.items()
@@ -1191,6 +1195,12 @@ def register_aux_routes(r: Router) -> None:
             # drain/restore counters, rendered whole by the TPU panel
             if e.get("lifecycle") is not None:
                 summary[name]["lifecycle"] = e["lifecycle"]
+            # per-engine scheduler block (docs/scheduler.md): per-class
+            # queue depth, TTFT/TPOT vs target, chunk budget
+            # utilization, and per-class ladder rung — rendered whole
+            # by the TPU panel's scheduler table
+            if e.get("scheduler") is not None:
+                summary[name]["scheduler"] = e["scheduler"]
         swarm = supervision_snapshot()
         # db-less contexts (bare router probes) get zeroed journal stats
         swarm["journal"] = journal_mod.stats(ctx.db) if ctx.db else {

@@ -1,9 +1,10 @@
-from . import faults, lifecycle
+from . import faults, lifecycle, scheduler
 from .engine import ServingEngine, Turn
 from .faults import FaultError
 from .kv_offload import TieredKVStore
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
 from .sampler import SamplingParams, sample, sample_batched
+from .scheduler import TURN_CLASSES, ClassTargets, RequestScheduler
 from .tokenizer import (
     ByteTokenizer,
     HFTokenizer,
@@ -17,6 +18,10 @@ __all__ = [
     "Turn",
     "faults",
     "lifecycle",
+    "scheduler",
+    "TURN_CLASSES",
+    "ClassTargets",
+    "RequestScheduler",
     "FaultError",
     "PageTable",
     "TieredKVStore",

@@ -49,6 +49,10 @@ from .kv_pages import (
     PageTable, init_page_cache, kv_quant_mode, make_paged_kv_hook,
     pallas_decode_int8_ok, pallas_prefill_ok, use_pallas_kernel,
 )
+from .scheduler import (
+    CLASS_PRIORITY, CLASS_RANK, RequestScheduler, chunk_pages_from_env,
+    normalize_class,
+)
 from .sampler import (
     SamplingParams, apply_penalties, sample_batched, spec_verify,
 )
@@ -144,6 +148,29 @@ class Turn:
     # requeued mid-generation: prompt KV is already materialized, only
     # the pending token re-enters at re-admission
     _mid_stream: bool = False
+    # ---- SLO scheduler (scheduler.py, docs/scheduler.md) ----
+    # priority class (queen > worker > background), tagged from the
+    # swarm role by providers/tpu.py; orders admission (EDF against
+    # the class TTFT target), chunk budgets, and per-class shedding
+    turn_class: str = "worker"
+    submitted_at: float = field(default_factory=time.monotonic)
+    # EDF admission key: submitted_at + class TTFT target (set by
+    # submit(); requeues keep the original so a disrupted turn retains
+    # its queue position)
+    admit_by: float = 0.0
+    first_token_at: Optional[float] = None
+    # interleaved prefill chunks written for this turn (telemetry)
+    prefill_chunks: int = 0
+    # tokens durably written by interleaved chunked prefill that have
+    # NOT yet led to a slot admission: while nonzero, a turn death
+    # rolls the session back to _prefill_snap so a client retry of the
+    # full prompt never lands on a half-prefilled session
+    _chunk_committed: int = 0
+    _prefill_snap: Optional[dict] = None
+    # popped by admission but deferred (chunk budget / pool pressure):
+    # re-queued at the end of the admission pass, not re-popped within
+    # it
+    _admit_deferred: bool = False
 
     def wait(self, timeout: Optional[float] = None) -> "Turn":
         self.done.wait(timeout)
@@ -261,6 +288,20 @@ class ServingEngine:
         self.prefill_chunk = int(
             os.environ.get("ROOM_TPU_PREFILL_CHUNK", "2048")
         )
+        # ---- SLO-aware scheduler (scheduler.py, docs/scheduler.md) ----
+        # interleaved chunked prefill: long prompts are written
+        # ROOM_TPU_PREFILL_CHUNK_PAGES-page chunks ACROSS scheduler
+        # steps, a decode window running between chunks, so no single
+        # prompt monopolizes a dispatch (0 = monolithic admission-time
+        # prefill, the pre-scheduler behavior). The legacy
+        # ROOM_TPU_PREFILL_CHUNK width still caps the compile width.
+        chunk_pages = chunk_pages_from_env()
+        self.sched_chunk_tokens = chunk_pages * page_size
+        if self.prefill_chunk:
+            self.sched_chunk_tokens = min(
+                self.sched_chunk_tokens, self.prefill_chunk
+            )
+        self.scheduler = RequestScheduler()
         # speculative decoding (prompt-lookup drafting): propose up to
         # this many tokens per round from each session's own history and
         # verify them in ONE forward — decode streams the full weight
@@ -464,7 +505,9 @@ class ServingEngine:
             if dp > 1 and max_batch % dp == 0:
                 self._dp_size = dp
         self.sessions: dict[str, _Session] = {}
-        self._queue: queue.Queue[Turn] = queue.Queue()
+        # admission queue: the scheduler's EDF heap (class TTFT target
+        # deadlines), drop-in for the old FIFO queue.Queue surface
+        self._queue = self.scheduler
         # refcount of queued turns per session (mutated under _lock via
         # _queue_put/_queue_get*): lets release_session defer for a
         # session whose turn is still QUEUED in O(1) instead of
@@ -548,6 +591,11 @@ class ServingEngine:
             # dispatch failed under an injected decode_window fault
             "host_stall_ms": 0.0, "decode_windows": 0,
             "window_faults": 0, "overshoot_tokens": 0,
+            # SLO scheduler (docs/scheduler.md): interleaved prefill
+            # chunks written, admissions deferred by the per-step
+            # chunk budget, and chunk faults requeued at a boundary
+            "prefill_chunks_interleaved": 0, "prefill_chunk_defers": 0,
+            "prefill_chunk_faults": 0,
         }
         from collections import Counter
 
@@ -747,10 +795,13 @@ class ServingEngine:
             self._finish_turn(i, turn, "error")
 
     def _shed_if_overloaded(self) -> None:
-        """Ladder rung 4: when the queue is deeper than the engine can
-        plausibly serve, shed the lowest-priority queued turns with an
-        explicit overload error (routes map it to 503 + Retry-After)
-        instead of letting every tenant time out."""
+        """Ladder rung 4, per-class (docs/scheduler.md): when the queue
+        is deeper than the engine can plausibly serve, shed queued
+        turns with an explicit overload error (routes map it to 503 +
+        Retry-After) instead of letting every tenant time out —
+        background turns first, then workers, then queens; within a
+        class, lowest priority first. A queen is dropped only once
+        every lower-class turn over the cap already was."""
         if self.degradation_level() < 4:
             return
         keep_n = self.max_batch * 2
@@ -762,7 +813,11 @@ class ServingEngine:
                 drained.append(self._queue_get_nowait())
             except queue.Empty:
                 break
-        drained.sort(key=lambda t: -t.priority)
+        # most-keepable first: queen < worker < background, then
+        # higher explicit priority
+        drained.sort(key=lambda t: (
+            CLASS_RANK.get(t.turn_class, 1), -t.priority
+        ))
         for t in drained[:keep_n]:
             self._queue_put(t)
         for t in drained[keep_n:]:
@@ -771,13 +826,46 @@ class ServingEngine:
                        "pressure; retry later")
             t.finish_reason = "error"
             self._bump("shed_turns")
+            self.scheduler.note_shed(t.turn_class)
+            self._rollback_partial_prefill(t)
             t.done.set()
 
     def _fail_turn_unslotted(self, turn: Turn, msg: str) -> None:
-        """Fail a turn that never reached a slot (queued / admitting)."""
+        """Fail a turn that never reached a slot (queued / admitting).
+        A turn that died with interleaved prefill chunks committed
+        rolls its session back to the pre-turn state first, so a
+        client retry of the full prompt never lands on a
+        half-prefilled session (docs/scheduler.md)."""
+        self._rollback_partial_prefill(turn)
         turn.error = msg
         turn.finish_reason = "error"
         turn.done.set()
+
+    def _rollback_partial_prefill(self, turn: Turn) -> None:
+        """Undo a dying turn's committed-but-unadmitted prefill chunks:
+        restore the session's pre-turn snapshot (history mirror,
+        pending token, prefix refs). The chunk KV already in pages
+        sits past the restored length — the standard overrun contract;
+        pages stay owned by the session and are reused or released
+        normally, so nothing leaks. No-op for turns without chunk
+        progress, and engine-thread-only by construction (every death
+        path for a queued turn runs there; submit()'s draining refusal
+        happens before any chunk can be written)."""
+        snap = turn._prefill_snap
+        if snap is None or turn._chunk_committed <= 0:
+            return
+        turn._chunk_committed = 0
+        turn._prefill_snap = None
+        sess = self.sessions.get(turn.session_id)
+        if sess is None:
+            return
+        try:
+            self._restore_session_snapshot(sess, snap)
+        except Exception:
+            # rollback is best-effort cleanup on a turn that already
+            # failed; the history-mirror re-prefill path remains the
+            # correctness backstop
+            pass
 
     def _recover_from_crash(self, exc: BaseException) -> bool:
         """Engine-thread supervision: a crashed scheduler iteration
@@ -1078,25 +1166,40 @@ class ServingEngine:
         on_token: Optional[Callable[[int], None]] = None,
         stop_strings: Optional[list[str]] = None,
         deadline_s: Optional[float] = None,
-        priority: int = 0,
+        priority: Optional[int] = None,
+        turn_class: Optional[str] = None,
     ) -> Turn:
         """Queue a turn. If session_id names a parked session, generation
         resumes on top of its retained KV. ``deadline_s`` bounds the
         request end to end (default ROOM_TPU_TURN_DEADLINE_S; 0 = no
         deadline); ``priority`` orders load shedding under degradation
-        (lowest sheds first)."""
+        (lowest sheds first). ``turn_class`` (queen/worker/background;
+        docs/scheduler.md) sets the SLO class: admission is ordered by
+        each class's TTFT-target deadline, chunked prefill draws from
+        the class's per-window budget, and the degradation ladder
+        sheds background before workers before queens. Unset/unknown
+        classes run as ``worker``; an explicit ``priority`` (any int,
+        including 0) still sets shed ordering within a class — only an
+        UNSET priority takes the class default."""
         sid = session_id or f"s{id(object())}-{time.monotonic_ns()}"
         budget = deadline_s if deadline_s is not None \
             else self.turn_deadline_s
+        cls = normalize_class(turn_class)
+        now = time.monotonic()
         turn = Turn(
             session_id=sid,
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
             on_token=on_token,
             stop_strings=[s for s in (stop_strings or []) if s],
-            deadline=(time.monotonic() + budget) if budget > 0 else None,
-            priority=priority,
+            deadline=(now + budget) if budget > 0 else None,
+            priority=priority if priority is not None
+            else CLASS_PRIORITY[cls],
+            turn_class=cls,
+            submitted_at=now,
         )
+        turn.admit_by = self.scheduler.admit_deadline(cls, now)
+        self.scheduler.note_submitted(cls)
         if not self._queue_put(turn, unless_draining=True):
             # graceful drain (docs/lifecycle.md): admission is closed.
             # Same shed contract as ladder rung 4 — routes map it to
@@ -1246,6 +1349,12 @@ class ServingEngine:
         )
         out["degradation_level"] = self.degradation_level()
         out["healthy"] = self.healthy
+        # SLO scheduler block (docs/scheduler.md): per-class queue
+        # depth, TTFT/TPOT vs target, chunk-budget utilization, and
+        # the ladder rung each class experiences
+        sched = self.scheduler.snapshot(out["degradation_level"])
+        sched["chunk_tokens"] = self.sched_chunk_tokens
+        out["scheduler"] = sched
         out["offload"] = self.offload_store.stats() \
             if self.offload_store is not None else None
         with self._lock:
@@ -1265,6 +1374,10 @@ class ServingEngine:
         # chaos fault point: a non-transient scheduler crash — the
         # serve_forever supervisor must fail pending work and recover
         faults.maybe_fail("engine_crash")
+        # fresh per-class chunk budgets for this step's admission pass
+        # (docs/scheduler.md): one step = one decode window, so the
+        # budget is per-window
+        self.scheduler.begin_step()
         self._drain_releases()
         self._enforce_deadlines()
         self._shed_if_overloaded()
@@ -1670,20 +1783,32 @@ class ServingEngine:
         multi-tenant rooms submitting simultaneously don't serialize."""
         free = self._free_slots()
         preps: list[dict] = []
-        # ladder rung 3: halve the admission batch so a pressured pool
-        # drains instead of thrashing on eviction
-        cap = len(free) if self.degradation_level() < 3 \
-            else max(1, self.max_batch // 2)
+        # popped but deferred to the next step (per-class admission
+        # halving, chunk budget, pool pressure on a background chunk):
+        # re-queued at the end of the pass with their original EDF key
+        # (distinct from the deferred-RELEASE session-id set the
+        # finally block reads)
+        held_turns: list[Turn] = []
+        raw_level = self.degradation_level()
+        # ladder rung 3, per-class (docs/scheduler.md): halve the
+        # admission batch for classes experiencing rung >= 3 so a
+        # pressured pool drains instead of thrashing on eviction;
+        # queens get one rung of grace
+        halved = max(1, self.max_batch // 2)
         attempts = 0
         with self._lock:
             self._admitting.clear()
         try:
             while free and not self._queue.empty() and \
-                    len(preps) < min(len(free), cap) and \
+                    len(preps) < len(free) and \
                     attempts < self.max_batch * 2:
                 attempts += 1
                 turn = self._queue_get()
                 self._admission_turns.append(turn)
+                if len(preps) >= halved and self.scheduler.class_rung(
+                        turn.turn_class, raw_level) >= 3:
+                    held_turns.append(turn)
+                    continue
                 # registered BEFORE pages are reserved so an inline
                 # release from another thread can't free a batchmate's
                 # reservation mid-admission (it defers instead);
@@ -1724,6 +1849,12 @@ class ServingEngine:
                 else:
                     with self._lock:
                         self._admitting.discard(turn.session_id)
+                    if turn._admit_deferred:
+                        # chunk budget / pool pressure mid-chunked-
+                        # prefill: hold the turn for the next step (a
+                        # decode window runs in between)
+                        turn._admit_deferred = False
+                        held_turns.append(turn)
 
             # group by identical prefill shape
             groups: dict[tuple, list[dict]] = {}
@@ -1738,6 +1869,12 @@ class ServingEngine:
                     bucket, fresh, group, slots,
                     active_pages=active_pages,
                 )
+            # held turns re-enter the queue with their original EDF
+            # key and seq (before the clear below, so a crash in
+            # between cannot orphan them in neither structure)
+            for t in held_turns:
+                self._queue_put(t)
+            held_turns = []
             # normal exit: every popped turn is slotted, requeued, or
             # already failed. Cleared HERE (not in finally) so a crash
             # escaping admission leaves the list for the supervisor.
@@ -1813,7 +1950,7 @@ class ServingEngine:
             "prefix_len": sess.prefix_len,
         }
         try:
-            prep = self._prepare_turn_inner(turn, sess)
+            prep = self._prepare_turn_inner(turn, sess, snap)
         except (MemoryError, FaultError):
             self._restore_session_snapshot(sess, snap)
             raise
@@ -1822,7 +1959,7 @@ class ServingEngine:
         return prep
 
     def _prepare_turn_inner(
-        self, turn: Turn, sess: _Session
+        self, turn: Turn, sess: _Session, snap: Optional[dict] = None
     ) -> Optional[dict]:
         sess.parked = False
         sess.last_used = time.monotonic()
@@ -1899,6 +2036,21 @@ class ServingEngine:
             else:
                 register_entry = self._prefix_register(sess, prompt)
 
+        # interleaved chunked prefill (scheduler.py, docs/scheduler.md):
+        # page-chunk writes spread ACROSS scheduler steps under the
+        # class's per-window budget — a decode window runs between
+        # chunks, so a multi-thousand-token prompt never monopolizes a
+        # dispatch. Token-identical to the monolithic path: the same
+        # positions get the same KV, only WHEN they are written moves.
+        cw = self.sched_chunk_tokens
+        if cw and len(prompt) > cw:
+            prompt = self._advance_chunked_prefill(
+                turn, sess, prompt, restoring, snap
+            )
+            if prompt is None:
+                return None     # deferred / requeued / failed
+            restoring = False   # chunk writes re-materialized history
+
         # long prompts prefill in fixed-width chunks through the
         # KV-continuation path, so compile widths and activation memory
         # are bounded by prefill_chunk regardless of prompt length; only
@@ -1965,6 +2117,141 @@ class ServingEngine:
             "table": table, "base_length": sess.length,
             "active_pages": active_pages,
         }
+
+    def _advance_chunked_prefill(
+        self, turn: Turn, sess: _Session, prompt: list[int],
+        restoring: bool, snap: Optional[dict],
+    ) -> Optional[list[int]]:
+        """Write a long prompt's full-width prefill chunks under the
+        turn's class budget (docs/scheduler.md), committing progress
+        at every chunk boundary. Returns the remaining tail (<= one
+        chunk) once the prompt is fully chunk-written and ready for
+        the sampling tail admission — or None when the turn was
+        deferred to the next step (budget / pool pressure; _admit
+        re-queues it), re-queued at a boundary (an injected
+        prefill_chunk fault), or failed (requeue budget spent).
+
+        Progress is durable: each committed chunk advances
+        sess.length/history, clears the pending token, and rewrites
+        turn.prompt_tokens to the unwritten suffix — a later admission
+        resumes at the last chunk boundary, and a turn that dies
+        mid-prefill rolls the session back to its pre-turn snapshot
+        (_rollback_partial_prefill) so a client retry of the full
+        prompt is safe.
+
+        Reservations are per-chunk (partial-prefill reservations,
+        kv_pages.py), not whole-prompt: a 4k prompt holds pages only
+        for the chunks it has actually written. Background-class
+        chunks take free pages only (PageTable.try_capacity) — a
+        background prefill must never evict live KV to make room."""
+        cw = self.sched_chunk_tokens
+        cls = turn.turn_class
+
+        def to_boundary() -> None:
+            # every early exit rolls the session back to ``snap`` —
+            # the last durable chunk boundary (refreshed in place at
+            # each commit), or the admission-start state when nothing
+            # committed yet. This is what makes a defer/requeue safe
+            # after THIS admission's non-durable mutations: a prefix
+            # hit taken above (re-admission re-resolves it against the
+            # full prompt), or the restoring-path history clear below
+            # (the mirror must survive a first-chunk fault).
+            if snap is not None:
+                self._restore_session_snapshot(sess, snap)
+
+        while len(prompt) > cw:
+            if not self.scheduler.take_chunk(cls):
+                # per-window budget spent: hold position (the EDF key
+                # is unchanged), resume after the next decode window
+                self._bump("prefill_chunk_defers")
+                turn._admit_deferred = True
+                to_boundary()
+                return None
+            need = sess.length + cw - sess.prefix_len
+            try:
+                if cls == "background":
+                    pages = self.page_table.try_capacity(sess.id, need)
+                else:
+                    pages = self._ensure_capacity_evicting(
+                        sess.id, need
+                    )
+            except MemoryError:
+                pages = None
+            if pages is None:
+                # pool pressure: defer rather than fail — decode
+                # drains and the offload sweep free pages between
+                # steps. The consumed budget unit is refunded: nothing
+                # was written, and a same-class sibling with free
+                # pages must not be starved for the step.
+                self.scheduler.refund_chunk(cls)
+                self._note_pressure()
+                turn._admit_deferred = True
+                to_boundary()
+                return None
+            if turn._prefill_snap is None:
+                # rollback baseline: a COPY of the session's state
+                # before this turn touched it (kept across requeues —
+                # ``snap`` itself is refreshed to each durable
+                # boundary below, so it must not be aliased)
+                turn._prefill_snap = {
+                    k: list(v) if isinstance(v, list) else v
+                    for k, v in snap.items()
+                }
+            if restoring and sess.length == 0:
+                # the mirror is re-materialized by the chunk writes;
+                # ``prompt`` already carries its tokens in order
+                sess.history = []
+                restoring = False
+            chunk = prompt[:cw]
+            table = np.zeros((self.max_pages_per_seq,), np.int32)
+            all_pages = sess.prefix_pages + pages
+            table[: len(all_pages)] = all_pages
+            try:
+                # chaos fault point (docs/chaos.md): a failed chunk
+                # re-queues the turn at its last durable chunk
+                # boundary — committed chunks stay, pages stay owned
+                # by the session, nothing leaks
+                faults.maybe_fail("prefill_chunk")
+                self._prefill_write_chunk(sess, chunk, table)
+            except FaultError as e:
+                self._bump("prefill_chunk_faults")
+                self._note_pressure()
+                # the faulted chunk never wrote: refund its budget
+                # unit and roll back to the last durable boundary
+                # (restores a restoring session's history mirror if
+                # the FIRST chunk faulted after the clear above)
+                self.scheduler.refund_chunk(cls)
+                to_boundary()
+                turn.requeues += 1
+                turn.disrupted = True
+                if turn.requeues > self.max_requeues:
+                    self._fail_turn_unslotted(turn, str(e))
+                else:
+                    self._bump("requeues")
+                    self._queue_put(turn)
+                return None
+            # durable boundary: the chunk (and any pending token it
+            # carried) is in KV + history; only the suffix re-enters
+            # on a requeue
+            sess.pending = None
+            prompt = prompt[cw:]
+            turn.prompt_tokens = list(prompt)
+            turn._chunk_committed += cw
+            turn.prefill_chunks += 1
+            self._bump("prefill_chunks_interleaved")
+            # refresh the caller's rollback snapshot IN PLACE to this
+            # durable boundary: chunk progress must survive a later
+            # tail-admission failure (which rolls back to ``snap`` and
+            # re-queues turn.prompt_tokens — now just the suffix).
+            # The pre-turn state lives on in turn._prefill_snap.
+            snap.update(
+                pending=sess.pending, length=sess.length,
+                history=list(sess.history), parked=sess.parked,
+                prefix_key=sess.prefix_key,
+                prefix_pages=list(sess.prefix_pages),
+                prefix_len=sess.prefix_len,
+            )
+        return prompt
 
     def _prefill_write_chunk(
         self, sess: _Session, toks: list[int], table: np.ndarray
@@ -2123,6 +2410,12 @@ class ServingEngine:
             self._slot_lengths[slot] = sess.length
             self._slot_gen[slot] += 1
             self._active[slot] = turn
+            # the turn reached a slot: its chunked-prefill progress is
+            # now ordinary session state (a death from here on follows
+            # the park contract, never the pre-turn rollback)
+            turn._chunk_committed = 0
+            turn._prefill_snap = None
+            self.scheduler.note_admitted(turn.turn_class)
             self._append_token(slot, turn, int(firsts[r]))
 
     def _slot_arrays_excluding(
@@ -2788,6 +3081,15 @@ class ServingEngine:
 
     def _append_token(self, slot: int, turn: Turn, token: int) -> None:
         turn.new_tokens.append(token)
+        if turn.first_token_at is None:
+            # TTFT against the class target (docs/scheduler.md) —
+            # measured at the host-side booking of the first token,
+            # which for pipelined windows is the drain
+            turn.first_token_at = time.monotonic()
+            self.scheduler.observe_ttft(
+                turn.turn_class,
+                turn.first_token_at - turn.submitted_at,
+            )
         if turn.on_token is not None:
             try:
                 turn.on_token(token)
@@ -2838,6 +3140,16 @@ class ServingEngine:
         if reason == "tool_call":
             sess.parked = True        # KV retained (HBM or hibernated)
         turn.finish_reason = reason
+        # per-class latency accounting (docs/scheduler.md): TPOT over
+        # the streamed span; ladder-shed / error turns count completed
+        # too (the class saw an answer, even a 503)
+        self.scheduler.note_completed(turn.turn_class)
+        if turn.first_token_at is not None and len(turn.new_tokens) > 1:
+            self.scheduler.observe_tpot(
+                turn.turn_class,
+                (time.monotonic() - turn.first_token_at)
+                / (len(turn.new_tokens) - 1),
+            )
         self._active[slot] = None
         # point the freed slot at the scratch page so idle rows of the
         # batched decode never write through a stale block table into

@@ -7,6 +7,10 @@ named *fault point* that tests (and staging deployments) can arm:
 
     kv_alloc           page allocation fails (MemoryError)
     prefill_oom        prefill device call fails (transient)
+    prefill_chunk      one interleaved chunked-prefill write fails
+                       (docs/scheduler.md): the turn re-queues at its
+                       last durable chunk boundary — committed chunks
+                       stay, KV pages stay owned, nothing leaks
     decode_step        decode device call fails (transient)
     decode_window      multi-step dispatch window fails: the engine
                        fails ONLY the turns in that window (queued
@@ -65,7 +69,8 @@ __all__ = [
 ]
 
 FAULT_POINTS = (
-    "kv_alloc", "prefill_oom", "decode_step", "decode_window",
+    "kv_alloc", "prefill_oom", "prefill_chunk",
+    "decode_step", "decode_window",
     "decode_stall", "tokenizer", "engine_crash", "client_disconnect",
     "provider_timeout", "offload_io", "shutdown_io",
     # swarm runtime (docs/swarm_recovery.md)

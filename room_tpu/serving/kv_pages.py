@@ -554,6 +554,19 @@ class PageTable:
                 pages.append(self._free.pop())
             return list(pages)
 
+    def try_capacity(
+        self, session_id: str, n_tokens: int
+    ) -> Optional[list[int]]:
+        """ensure_capacity that takes FREE pages only: returns None
+        instead of raising when the pool can't grow the session.
+        Partial-prefill reservations (docs/scheduler.md) use this for
+        background-class chunks — an opportunistic chunk write must
+        never push the caller into evicting live KV to make room."""
+        try:
+            return self.ensure_capacity(session_id, n_tokens)
+        except MemoryError:
+            return None
+
     def release(self, session_id: str) -> int:
         """Free all pages of a session (session end or eviction)."""
         with self._lock:

@@ -1,0 +1,403 @@
+"""SLO-aware request scheduler (docs/scheduler.md).
+
+The subsystem between ``ServingEngine.submit()`` and the decode
+pipeline. Three jobs:
+
+1. **Priority classes with TTFT/TPOT targets.** Every turn carries a
+   class — ``queen`` > ``worker`` > ``background`` — mapped from the
+   swarm role that produced it (providers/tpu.py tags queen cycles,
+   worker cycles, and background task runs). Each class has a
+   time-to-first-token / time-per-output-token target
+   (``ROOM_TPU_CLASS_TARGETS``); the scheduler tracks observed EMAs
+   against them for the health surface.
+
+2. **Deadline-aware admission ordering.** The queue is
+   earliest-admission-deadline-first: a turn's admission deadline is
+   ``submitted_at + its class's TTFT target``. A queen turn (tight
+   target) beats a background turn submitted earlier, but a background
+   turn can never starve — its deadline eventually becomes the
+   earliest. Ties break by class rank, then submission order (so
+   same-class traffic stays FIFO, which the engine's tests rely on).
+
+3. **Class-weighted chunk budgets.** Long prompts prefill in
+   page-sized chunks interleaved between decode windows (the engine's
+   multi-step host-overlap seam). Per scheduler step, each class may
+   write at most its chunk budget (``ROOM_TPU_CLASS_CHUNKS``): a
+   4k-token background prefill advances one chunk per window instead
+   of monopolizing a dispatch — the head-of-line-blocking fix from
+   PAPERS.md "Inference Optimization of Foundation Models on AI
+   Accelerators" (continuous batching with chunked prefill).
+
+The scheduler also gives the degradation ladder (docs/chaos.md) its
+per-class shape: shedding at rung 4 drops background turns before
+workers before queens, and queens get one rung of grace on admission
+halving. ``class_rung`` reports the rung each class actually
+experiences.
+
+Thread-safety: the queue is locked internally (submit() runs on HTTP
+threads, pops on the engine thread); the budget/telemetry state shares
+that lock.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import queue as queue_mod
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+__all__ = [
+    "TURN_CLASSES", "CLASS_RANK", "DEFAULT_CLASS", "ClassTargets",
+    "RequestScheduler", "normalize_class", "class_targets_from_env",
+    "class_chunks_from_env", "chunk_pages_from_env",
+]
+
+# rank orders shed/keep decisions: lower rank is kept longest
+TURN_CLASSES = ("queen", "worker", "background")
+CLASS_RANK = {"queen": 0, "worker": 1, "background": 2}
+DEFAULT_CLASS = "worker"
+
+# rungs of ladder grace on ADMISSION pressure (rungs 3/4): queens keep
+# full admission until the raw ladder is one rung deeper. Rungs 1/2
+# (spec off, offload) are engine-global and get no grace.
+CLASS_GRACE = {"queen": 1, "worker": 0, "background": 0}
+
+# shed-ordering priority when the caller didn't set one explicitly
+CLASS_PRIORITY = {"queen": 2, "worker": 1, "background": 0}
+
+
+@dataclass(frozen=True)
+class ClassTargets:
+    """Per-class latency targets, in seconds."""
+
+    ttft_s: float   # submit -> first streamed token
+    tpot_s: float   # per-token interval once streaming
+
+
+DEFAULT_TARGETS = {
+    # queen turns are the p50 the paper's <4 s v5e-8 target hangs on
+    "queen": ClassTargets(ttft_s=2.0, tpot_s=0.10),
+    "worker": ClassTargets(ttft_s=8.0, tpot_s=0.25),
+    "background": ClassTargets(ttft_s=30.0, tpot_s=1.0),
+}
+
+# chunks of interleaved prefill a class may write per scheduler step
+DEFAULT_CHUNKS = {"queen": 4, "worker": 2, "background": 1}
+
+
+def normalize_class(turn_class: Optional[str]) -> str:
+    """Map an arbitrary tag to a known class (unknown -> worker: the
+    middle class is the safe default for untagged external traffic)."""
+    if turn_class in CLASS_RANK:
+        return turn_class
+    return DEFAULT_CLASS
+
+
+def class_targets_from_env(
+    env: Optional[str] = None,
+) -> dict[str, ClassTargets]:
+    """Parse ``ROOM_TPU_CLASS_TARGETS`` — ``;``-separated
+    ``class=ttft:tpot`` (seconds), e.g.
+    ``queen=2:0.1;worker=8:0.25;background=30:1``. Unknown classes and
+    malformed entries raise (a typo'd SLO config must be loud)."""
+    spec = env if env is not None else \
+        os.environ.get("ROOM_TPU_CLASS_TARGETS", "")
+    out = dict(DEFAULT_TARGETS)
+    for part in filter(None, (s.strip() for s in spec.split(";"))):
+        name, _, vals = part.partition("=")
+        name = name.strip()
+        if name not in CLASS_RANK:
+            raise ValueError(
+                f"unknown class {name!r} in ROOM_TPU_CLASS_TARGETS; "
+                f"known: {TURN_CLASSES}"
+            )
+        ttft_s, sep, tpot_s = vals.partition(":")
+        if not sep:
+            raise ValueError(
+                f"ROOM_TPU_CLASS_TARGETS entry {part!r} must be "
+                "class=ttft:tpot (seconds)"
+            )
+        out[name] = ClassTargets(
+            ttft_s=float(ttft_s), tpot_s=float(tpot_s)
+        )
+    return out
+
+
+def class_chunks_from_env(env: Optional[str] = None) -> dict[str, int]:
+    """Parse ``ROOM_TPU_CLASS_CHUNKS`` — ``;``-separated
+    ``class=n`` per-step chunk budgets. Clamped to >= 1: a zero budget
+    would park a class's prefills forever."""
+    spec = env if env is not None else \
+        os.environ.get("ROOM_TPU_CLASS_CHUNKS", "")
+    out = dict(DEFAULT_CHUNKS)
+    for part in filter(None, (s.strip() for s in spec.split(";"))):
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in CLASS_RANK:
+            raise ValueError(
+                f"unknown class {name!r} in ROOM_TPU_CLASS_CHUNKS; "
+                f"known: {TURN_CLASSES}"
+            )
+        out[name] = max(1, int(val))
+    return out
+
+
+def chunk_pages_from_env(default: int = 16) -> int:
+    """``ROOM_TPU_PREFILL_CHUNK_PAGES``: width of an interleaved
+    prefill chunk, in KV pages. 0 disables interleaving (monolithic
+    admission-time prefill, the pre-scheduler behavior)."""
+    raw = os.environ.get("ROOM_TPU_PREFILL_CHUNK_PAGES")
+    if raw is None:
+        return default
+    return max(0, int(raw))
+
+
+class _ClassStats:
+    """Observed latency + throughput accounting for one class.
+    Mutated under the scheduler lock."""
+
+    __slots__ = (
+        "submitted", "admitted", "completed", "shed",
+        "ttft_ema", "tpot_ema", "ttft_worst", "chunks_written",
+    )
+
+    def __init__(self) -> None:
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.ttft_ema: Optional[float] = None
+        self.tpot_ema: Optional[float] = None
+        self.ttft_worst = 0.0
+        self.chunks_written = 0
+
+
+class RequestScheduler:
+    """Class-aware admission queue + per-step chunk budgets.
+
+    Exposes the queue.Queue surface the engine already speaks
+    (put / get / get_nowait / qsize / empty) so it drops in as the
+    engine's ``_queue``; pops are earliest-admission-deadline-first
+    instead of FIFO. Budget and telemetry methods are called from the
+    engine thread; put() also from submit() threads.
+    """
+
+    EMA_ALPHA = 0.2
+
+    def __init__(
+        self,
+        targets: Optional[dict[str, ClassTargets]] = None,
+        chunk_budgets: Optional[dict[str, int]] = None,
+    ) -> None:
+        self.targets = targets or class_targets_from_env()
+        self.chunk_budgets = chunk_budgets or class_chunks_from_env()
+        self._lock = threading.Lock()
+        self._heap: list[tuple[float, int, int, Any]] = []
+        self._seq = 0
+        self._depth = {c: 0 for c in TURN_CLASSES}
+        self._stats = {c: _ClassStats() for c in TURN_CLASSES}
+        # per-step chunk accounting (begin_step resets)
+        self._step_chunks = {c: 0 for c in TURN_CLASSES}
+        self._steps = 0
+        self._budget_hits = 0   # times a class ran out of step budget
+
+    # ---- class helpers ----
+
+    def admit_deadline(self, turn_class: str, submitted_at: float) -> float:
+        """EDF key: the moment this turn's class TTFT target expires."""
+        t = self.targets.get(
+            normalize_class(turn_class), DEFAULT_TARGETS[DEFAULT_CLASS]
+        )
+        return submitted_at + t.ttft_s
+
+    @staticmethod
+    def class_rung(turn_class: str, raw_level: int) -> int:
+        """The degradation rung a class actually experiences: rungs
+        1/2 (spec off, offload) are engine-global; rungs 3/4
+        (admission halved, shed) reach higher classes one raw rung
+        later. Shedding inside rung 4 is additionally class-ordered —
+        a queen queued behind the shed cap is dropped only once every
+        background and worker turn already was."""
+        if raw_level <= 2:
+            return raw_level
+        return max(2, raw_level - CLASS_GRACE.get(
+            normalize_class(turn_class), 0
+        ))
+
+    # ---- queue surface (engine._queue drop-in) ----
+
+    def put(self, turn) -> None:
+        cls = normalize_class(getattr(turn, "turn_class", None))
+        key = getattr(turn, "admit_by", 0.0) or self.admit_deadline(
+            cls, getattr(turn, "submitted_at", time.monotonic())
+        )
+        with self._lock:
+            # the seq tiebreak is pinned at FIRST enqueue and kept for
+            # the turn's lifetime: a deferral/fault requeue re-enters
+            # at its ORIGINAL queue position (same admit_by, same
+            # seq), so same-class ordering stays stable — a turn
+            # submitted later can never leapfrog a deferred one
+            seq = getattr(turn, "_sched_seq", None)
+            if seq is None:
+                self._seq += 1
+                seq = self._seq
+                try:
+                    turn._sched_seq = seq
+                except Exception:
+                    pass
+            heapq.heappush(
+                self._heap, (key, CLASS_RANK[cls], seq, turn)
+            )
+            self._depth[cls] += 1
+
+    def _pop(self):
+        _, _, _, turn = heapq.heappop(self._heap)
+        cls = normalize_class(getattr(turn, "turn_class", None))
+        self._depth[cls] -= 1
+        return turn
+
+    def get_nowait(self):
+        with self._lock:
+            if not self._heap:
+                raise queue_mod.Empty
+            return self._pop()
+
+    def get(self):
+        # the engine only calls get() after checking non-empty, from
+        # the single scheduler thread — blocking semantics are not
+        # needed, but keep the contract honest
+        return self.get_nowait()
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def depth_by_class(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._depth)
+
+    # ---- per-step chunk budget ----
+
+    def begin_step(self) -> None:
+        """Reset per-step chunk counters; called once per engine
+        scheduler step (= once per dispatch window)."""
+        with self._lock:
+            self._steps += 1
+            for c in self._step_chunks:
+                self._step_chunks[c] = 0
+
+    def take_chunk(self, turn_class: str) -> bool:
+        """Consume one unit of the class's per-step chunk budget.
+        False = budget exhausted; the caller defers the prefill to the
+        next step (a decode window runs in between)."""
+        cls = normalize_class(turn_class)
+        budget = max(1, self.chunk_budgets.get(
+            cls, DEFAULT_CHUNKS[DEFAULT_CLASS]
+        ))
+        with self._lock:
+            if self._step_chunks[cls] >= budget:
+                self._budget_hits += 1
+                return False
+            self._step_chunks[cls] += 1
+            self._stats[cls].chunks_written += 1
+            return True
+
+    def refund_chunk(self, turn_class: str) -> None:
+        """Return a consumed budget unit whose chunk never wrote
+        (capacity deferral, injected fault): the class keeps its full
+        step budget for siblings, and chunks_written stays an honest
+        count of chunks actually on device."""
+        cls = normalize_class(turn_class)
+        with self._lock:
+            if self._step_chunks[cls] > 0:
+                self._step_chunks[cls] -= 1
+            st = self._stats[cls]
+            if st.chunks_written > 0:
+                st.chunks_written -= 1
+
+    # ---- telemetry ----
+
+    def note_submitted(self, turn_class: str) -> None:
+        with self._lock:
+            self._stats[normalize_class(turn_class)].submitted += 1
+
+    def note_admitted(self, turn_class: str) -> None:
+        with self._lock:
+            self._stats[normalize_class(turn_class)].admitted += 1
+
+    def note_shed(self, turn_class: str) -> None:
+        with self._lock:
+            self._stats[normalize_class(turn_class)].shed += 1
+
+    def observe_ttft(self, turn_class: str, ttft_s: float) -> None:
+        with self._lock:
+            st = self._stats[normalize_class(turn_class)]
+            st.ttft_ema = ttft_s if st.ttft_ema is None else (
+                (1 - self.EMA_ALPHA) * st.ttft_ema
+                + self.EMA_ALPHA * ttft_s
+            )
+            st.ttft_worst = max(st.ttft_worst, ttft_s)
+
+    def observe_tpot(self, turn_class: str, tpot_s: float) -> None:
+        with self._lock:
+            st = self._stats[normalize_class(turn_class)]
+            st.tpot_ema = tpot_s if st.tpot_ema is None else (
+                (1 - self.EMA_ALPHA) * st.tpot_ema
+                + self.EMA_ALPHA * tpot_s
+            )
+
+    def note_completed(self, turn_class: str) -> None:
+        with self._lock:
+            self._stats[normalize_class(turn_class)].completed += 1
+
+    def snapshot(self, raw_level: int = 0) -> dict:
+        """Per-class scheduler state for stats()/health/the TPU panel:
+        queue depth, observed TTFT/TPOT vs target, shed counts, chunk
+        budget + utilization, and the rung each class experiences."""
+        with self._lock:
+            depth = dict(self._depth)
+            steps = self._steps
+            budget_hits = self._budget_hits
+            rows = {}
+            for cls in TURN_CLASSES:
+                st = self._stats[cls]
+                tgt = self.targets[cls]
+                budget = max(1, self.chunk_budgets.get(
+                    cls, DEFAULT_CHUNKS[DEFAULT_CLASS]
+                ))
+                rows[cls] = {
+                    "queued": depth[cls],
+                    "rung": self.class_rung(cls, raw_level),
+                    "submitted": st.submitted,
+                    "admitted": st.admitted,
+                    "completed": st.completed,
+                    "shed": st.shed,
+                    "ttft_target_s": tgt.ttft_s,
+                    "ttft_ema_s": round(st.ttft_ema, 4)
+                    if st.ttft_ema is not None else None,
+                    "ttft_worst_s": round(st.ttft_worst, 4),
+                    "ttft_ok": st.ttft_ema is None
+                    or st.ttft_ema <= tgt.ttft_s,
+                    "tpot_target_s": tgt.tpot_s,
+                    "tpot_ema_s": round(st.tpot_ema, 4)
+                    if st.tpot_ema is not None else None,
+                    "tpot_ok": st.tpot_ema is None
+                    or st.tpot_ema <= tgt.tpot_s,
+                    "chunk_budget": budget,
+                    "chunks_written": st.chunks_written,
+                    # mean chunks actually written per step vs budget
+                    "chunk_budget_util": round(
+                        st.chunks_written / (budget * steps), 4
+                    ) if steps else 0.0,
+                }
+        return {
+            "classes": rows,
+            "steps": steps,
+            "budget_hits": budget_hits,
+        }

@@ -151,6 +151,49 @@ def test_prefill_fault_exhaustion_requeues(make_engine):
     _assert_pages_balanced(eng)
 
 
+def test_prefill_chunk_fault_requeues_at_boundary(make_engine,
+                                                  monkeypatch):
+    """prefill_chunk (docs/scheduler.md): a failed interleaved chunk
+    write re-queues the turn at its last DURABLE chunk boundary —
+    committed chunks stay (the retry does not rewrite them), the
+    stream matches the clean run, and no KV page leaks. A burst that
+    outlives the requeue budget fails the turn cleanly and rolls the
+    session back so a full-prompt retry is safe."""
+    monkeypatch.setenv("ROOM_TPU_PREFILL_CHUNK_PAGES", "1")
+    long = [1 + (i % 31) for i in range(80)]
+    eng = make_engine()
+    clean = eng.submit(long, sampling=_greedy())
+    eng.run_until_idle()
+
+    faults.inject("prefill_chunk", times=2)
+    turn = eng.submit(long, session_id="pc", sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    assert turn.finish_reason in ("stop", "length")
+    assert turn.requeues >= 1 and turn.disrupted
+    assert turn.new_tokens == clean.new_tokens
+    st = eng.stats()
+    assert st["prefill_chunk_faults"] >= 1
+    # boundary resume, not from-scratch: committed chunks were not
+    # rewritten, so total chunk writes stay below 2x the chunk count
+    assert st["prefill_chunks_interleaved"] < 2 * (len(long) // 8 + 1)
+
+    # exhaustion: every chunk faults -> clean failure + rollback,
+    # then an unfaulted full retry streams the clean tokens
+    faults.inject("prefill_chunk")
+    eng.max_requeues = 1
+    dead = eng.submit(long, session_id="pc2", sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    eng.max_requeues = 3
+    assert dead.finish_reason == "error"
+    retry = eng.submit(long, session_id="pc2", sampling=_greedy())
+    eng.run_until_idle()
+    assert retry.new_tokens == clean.new_tokens
+    _release_all(eng)
+    _assert_pages_balanced(eng)
+
+
 def test_decode_stall_watchdog_parks_and_requeues(make_engine):
     """A stalled decode step parks its sessions (KV retained) and
     requeues the turns instead of dropping them."""

@@ -138,7 +138,7 @@ class _StubEngine:
         self.sessions.pop(session_id, None)
 
     def submit(self, tokens, *, session_id=None, sampling=None,
-               on_token=None):
+               on_token=None, turn_class=None):
         self.submits.append((list(tokens), session_id))
         self.sessions.setdefault(session_id, object())
         text, reason = self.script.pop(0)

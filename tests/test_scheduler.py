@@ -1,0 +1,433 @@
+"""SLO-aware request scheduler (docs/scheduler.md).
+
+Chunked prefill must be GREEDY-TOKEN-IDENTICAL to monolithic prefill —
+across chunk sizes {1 page, 4 pages, full} × steps_per_dispatch {1, 4}
+× prefix-hit × offload-restore × mid-prefill disruption (fault requeue,
+pool-pressure deferral, drain) — because chunking only moves WHEN KV is
+written, never which values land at which positions. Priority classes
+must order admission (a background prefill cannot starve a queen turn),
+shedding (background before workers before queens), and per-class chunk
+budgets. Quick tier: runs in the ci.yml chaos job.
+"""
+
+import threading
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving.scheduler import (
+    RequestScheduler, class_chunks_from_env, class_targets_from_env,
+    normalize_class,
+)
+
+CHUNK_PAGES = (0, 1, 4)   # 0 = monolithic (pre-scheduler behavior)
+STEPS = (1, 4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def build(model, monkeypatch):
+    cfg, params = model
+
+    def make(chunk_pages, steps=4, **kw):
+        monkeypatch.setenv(
+            "ROOM_TPU_PREFILL_CHUNK_PAGES", str(chunk_pages)
+        )
+        monkeypatch.setenv(
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", str(steps)
+        )
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 128)
+        return ServingEngine(cfg, params, **kw)
+
+    return make
+
+
+def _greedy(n=6):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+LONG = [1 + (i % 53) for i in range(100)]   # 13 pages at page_size 8
+
+
+# ---- token identity: chunk size × pipeline depth matrix ----
+
+def test_identity_chunk_sizes_x_steps(build):
+    """The acceptance matrix: greedy output identical across
+    {monolithic, 1-page, 4-page} chunking × steps_per_dispatch {1,4},
+    including a session continuation on top of the chunked prefill."""
+    base = None
+    for steps in STEPS:
+        for pages in CHUNK_PAGES:
+            eng = build(pages, steps=steps)
+            a = eng.submit(LONG, session_id="s", sampling=_greedy())
+            eng.run_until_idle()
+            b = eng.submit([7, 8, 9], session_id="s",
+                           sampling=_greedy())
+            eng.run_until_idle()
+            got = (a.new_tokens, b.new_tokens)
+            if base is None:
+                base = got
+            assert got == base, f"pages={pages} steps={steps}"
+            if pages:
+                assert eng.stats()["prefill_chunks_interleaved"] > 0
+
+
+def test_identity_prefix_hit_under_chunking(build):
+    """A second session whose prompt starts with the first's cached
+    page-aligned prefix must stream identically whether the registering
+    prefill was chunked or monolithic."""
+    prefix = list(range(1, 41))             # 5 aligned pages
+    base = None
+    for pages in CHUNK_PAGES:
+        eng = build(pages)
+        t1 = eng.submit(prefix + [61, 62, 63], sampling=_greedy())
+        eng.run_until_idle()
+        t2 = eng.submit(prefix + [71, 72], sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.stats()["prefix_hits"] >= 1
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"pages={pages}"
+
+
+def test_identity_offload_restore_then_chunked_continuation(build):
+    """Hibernate a session, then resume it with a long (chunked)
+    continuation prompt: the restored-KV + chunk-written continuation
+    must match the monolithic engine exactly."""
+    base = None
+    for pages in CHUNK_PAGES:
+        eng = build(pages, offload=True)
+        t1 = eng.submit(list(range(1, 20)), session_id="h",
+                        sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.offload_session("h")
+        t2 = eng.submit(LONG, session_id="h", sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.stats()["offload_restores"] >= 1
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"pages={pages}"
+
+
+def test_identity_chunk_fault_requeues_at_boundary(build, monkeypatch):
+    """An injected prefill_chunk fault re-queues the turn at its last
+    durable chunk boundary: the stream still matches the clean run, the
+    turn is marked disrupted, and no KV page leaks."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    clean = build(0)
+    want = clean.submit(LONG, sampling=_greedy())
+    clean.run_until_idle()
+
+    eng = build(1)
+    faults.inject("prefill_chunk", times=1)
+    t = eng.submit(LONG, session_id="f", sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    assert t.finish_reason in ("stop", "length")
+    assert t.requeues >= 1 and t.disrupted
+    assert t.new_tokens == want.new_tokens
+    st = eng.stats()
+    assert st["prefill_chunk_faults"] == 1
+    eng.release_session("f")
+    assert eng.page_table.free_pages == eng.page_table.n_pages - 1
+    assert not eng.sessions
+
+
+def test_identity_pool_pressure_defers_chunk(build):
+    """A kv_alloc failure mid-chunked-prefill defers the turn to the
+    next step (no rollback, no divergence) instead of failing it."""
+    clean = build(0)
+    want = clean.submit(LONG, sampling=_greedy())
+    clean.run_until_idle()
+
+    eng = build(1)
+    t = eng.submit(LONG, sampling=_greedy())
+    eng.step()                      # first chunk(s) written
+    faults.inject("kv_alloc", times=1)
+    eng.run_until_idle()
+    faults.clear()
+    assert t.finish_reason in ("stop", "length")
+    assert t.new_tokens == want.new_tokens
+
+
+def test_identity_prefix_hit_then_defer_before_first_chunk(build):
+    """A prefix HIT taken in the same admission as a pre-first-commit
+    deferral (class chunk budget already spent by a sibling) must be
+    rolled back with the deferral: re-admission re-resolves the hit
+    against the FULL prompt instead of chunk-writing the prefix tokens
+    a second time on top of the cached pages."""
+    prefix = list(range(1, 41))             # 5 aligned pages
+    tail2 = [71 + (i % 7) for i in range(20)]   # > 1 chunk after the hit
+    base = None
+    for pages in (0, 1):
+        eng = build(pages)
+        t1 = eng.submit(prefix + [61, 62, 63], sampling=_greedy(),
+                        turn_class="background")
+        eng.run_until_idle()            # registers + readies the prefix
+        # sibling background turn burns the class's 1-chunk budget in
+        # the same admission pass the hit turn defers in
+        t3 = eng.submit([9] * 80, sampling=_greedy(),
+                        turn_class="background")
+        t2 = eng.submit(prefix + tail2, sampling=_greedy(),
+                        turn_class="background")
+        eng.run_until_idle()
+        for t in (t1, t2, t3):
+            assert t.finish_reason in ("stop", "length")
+        got = (t1.new_tokens, t2.new_tokens, t3.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"pages={pages}"
+
+
+def test_identity_restoring_session_first_chunk_fault(build):
+    """A prefill_chunk fault on the FIRST chunk of an evicted
+    (history-mirror re-prefill) session must not lose the mirror: the
+    requeue restores it, and the resumed turn streams exactly the
+    clean run."""
+    clean = build(1)
+    c1 = clean.submit(list(range(1, 30)), session_id="v",
+                      sampling=_greedy())
+    clean.run_until_idle()
+    c2 = clean.submit(LONG, session_id="v", sampling=_greedy())
+    clean.run_until_idle()
+
+    eng = build(1)
+    t1 = eng.submit(list(range(1, 30)), session_id="v",
+                    sampling=_greedy())
+    eng.run_until_idle()
+    assert t1.new_tokens == c1.new_tokens
+    # drop the session's pages: its context now lives only in the
+    # host-side history mirror (the re-prefill path)
+    assert eng._evict_lru(exclude="__none__")
+    assert eng.sessions["v"].length == 0
+    assert eng.sessions["v"].history
+    faults.inject("prefill_chunk", times=1)
+    t2 = eng.submit(LONG, session_id="v", sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    assert t2.finish_reason in ("stop", "length")
+    assert t2.requeues >= 1
+    assert t2.new_tokens == c2.new_tokens
+
+
+# ---- priority classes ----
+
+def test_background_prefill_cannot_starve_queen(build):
+    """Priority inversion guard: with a background long prefill already
+    in progress, a queen turn must admit, stream, and finish before the
+    background turn produces its first token — decode windows and
+    admission keep running between the background's budgeted chunks."""
+    eng = build(1)
+    events = []
+    bg = eng.submit(
+        [2 + (i % 11) for i in range(200)],   # 25 chunks at budget 1
+        sampling=_greedy(4), turn_class="background",
+        on_token=lambda tok: events.append("bg"),
+    )
+    eng.step()          # background prefill begins (1 chunk written)
+    assert eng.stats()["prefill_chunks_interleaved"] >= 1
+    assert bg.done.is_set() is False
+    queen = eng.submit(
+        [5, 6, 7], sampling=_greedy(4), turn_class="queen",
+        on_token=lambda tok: events.append("q"),
+    )
+    eng.run_until_idle()
+    assert queen.finish_reason in ("stop", "length")
+    assert bg.finish_reason in ("stop", "length")
+    # every queen token preceded the background's first token
+    assert "q" in events and "bg" in events
+    assert events.index("bg") > max(
+        i for i, e in enumerate(events) if e == "q"
+    )
+
+
+def test_queue_orders_by_class_deadline(build):
+    """EDF admission: a queen submitted AFTER a background turn pops
+    first (tighter TTFT target), same-class stays FIFO."""
+    sched = RequestScheduler()
+
+    class T:
+        def __init__(self, cls, at):
+            self.turn_class = cls
+            self.submitted_at = at
+            self.admit_by = sched.admit_deadline(cls, at)
+
+    bg = T("background", 0.0)
+    w1 = T("worker", 1.0)
+    w2 = T("worker", 2.0)
+    q = T("queen", 5.0)
+    for t in (bg, w1, w2, q):
+        sched.put(t)
+    assert [sched.get_nowait() for _ in range(4)] == [q, w1, w2, bg]
+
+
+def test_shed_order_background_before_worker_before_queen(build):
+    eng = build(0, max_batch=2)
+    eng.set_degradation(4)
+    keep_n = eng.max_batch * 2
+    queens = [
+        eng.submit([i + 1], sampling=_greedy(), turn_class="queen")
+        for i in range(keep_n)
+    ]
+    workers = [
+        eng.submit([i + 1], sampling=_greedy(), turn_class="worker")
+        for i in range(2)
+    ]
+    bgs = [
+        eng.submit([i + 1], sampling=_greedy(),
+                   turn_class="background")
+        for i in range(2)
+    ]
+    eng.step()
+    assert all(t.shed for t in bgs), "background sheds first"
+    assert all(t.shed for t in workers), "workers shed next"
+    assert not any(t.shed for t in queens), "queens kept"
+    sched = eng.stats()["scheduler"]["classes"]
+    assert sched["background"]["shed"] == 2
+    assert sched["worker"]["shed"] == 2
+    assert sched["queen"]["shed"] == 0
+    eng.set_degradation(None)
+    eng.run_until_idle()
+
+
+def test_class_rung_grace():
+    assert RequestScheduler.class_rung("queen", 0) == 0
+    assert RequestScheduler.class_rung("queen", 2) == 2
+    assert RequestScheduler.class_rung("queen", 3) == 2
+    assert RequestScheduler.class_rung("queen", 4) == 3
+    assert RequestScheduler.class_rung("worker", 3) == 3
+    assert RequestScheduler.class_rung("background", 4) == 4
+
+
+# ---- drain / warm restart composition ----
+
+def test_drain_mid_chunk_resumes_token_identically(build, tmp_path):
+    """SIGTERM mid-chunked-prefill: the dying turn rolls its session
+    back to the last pre-turn state, the drain manifest carries that
+    state, and a client retry of the SAME prompt against the restored
+    engine streams exactly what an undisturbed engine would."""
+    control = build(0, offload=True)
+    c1 = control.submit([9, 8, 7], session_id="d", sampling=_greedy())
+    control.run_until_idle()
+    c2 = control.submit(LONG, session_id="d", sampling=_greedy())
+    control.run_until_idle()
+
+    lc = str(tmp_path / "lc")
+    eng = build(1, offload=True)
+    t1 = eng.submit([9, 8, 7], session_id="d", sampling=_greedy())
+    eng.run_until_idle()
+    assert t1.new_tokens == c1.new_tokens
+    t2 = eng.submit(LONG, session_id="d", sampling=_greedy())
+    eng.step()
+    eng.step()          # a few chunks written, prefill mid-flight
+    assert t2.done.is_set() is False
+    summary = eng.drain(lc)
+    assert summary["manifest_written"]
+    assert t2.shed and t2.finish_reason == "error"
+
+    eng2 = build(1, offload=True)
+    restored = eng2.restore_from_manifest(lc)
+    assert restored["resumed"] + restored["reprefill"] >= 1
+    t2b = eng2.submit(LONG, session_id="d", sampling=_greedy())
+    eng2.run_until_idle()
+    assert t2b.new_tokens == c2.new_tokens
+
+
+def test_failed_chunked_turn_rolls_session_back(build):
+    """A chunked turn that dies while queued must leave the session in
+    its pre-turn state: a full-prompt retry produces the undisturbed
+    stream (no half-prefilled duplication)."""
+    control = build(0)
+    c1 = control.submit([4, 5, 6], session_id="r", sampling=_greedy())
+    control.run_until_idle()
+    c2 = control.submit(LONG, session_id="r", sampling=_greedy())
+    control.run_until_idle()
+
+    eng = build(1)
+    eng.max_requeues = 0
+    t1 = eng.submit([4, 5, 6], session_id="r", sampling=_greedy())
+    eng.run_until_idle()
+    assert t1.new_tokens == c1.new_tokens
+    faults.inject("prefill_chunk", times=1)
+    t2 = eng.submit(LONG, session_id="r", sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    assert t2.finish_reason == "error"
+    eng.max_requeues = 3
+    retry = eng.submit(LONG, session_id="r", sampling=_greedy())
+    eng.run_until_idle()
+    assert retry.new_tokens == c2.new_tokens
+
+
+# ---- surface / config ----
+
+def test_scheduler_stats_surface(build):
+    eng = build(1)
+    t = eng.submit(LONG, sampling=_greedy(), turn_class="queen")
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length")
+    sched = eng.stats()["scheduler"]
+    assert sched["chunk_tokens"] == 8
+    q = sched["classes"]["queen"]
+    assert q["submitted"] == 1 and q["completed"] == 1
+    assert q["ttft_ema_s"] is not None and q["ttft_target_s"] == 2.0
+    assert q["tpot_ema_s"] is not None
+    assert q["chunks_written"] > 0
+    assert 0.0 < q["chunk_budget_util"] <= 1.0
+    for cls in ("queen", "worker", "background"):
+        row = sched["classes"][cls]
+        assert {"queued", "rung", "shed", "ttft_ok", "tpot_ok",
+                "chunk_budget"} <= set(row)
+
+
+def test_class_env_parsers(monkeypatch):
+    assert normalize_class(None) == "worker"
+    assert normalize_class("nonsense") == "worker"
+    assert normalize_class("queen") == "queen"
+    t = class_targets_from_env("queen=1.5:0.05;background=60:2")
+    assert t["queen"].ttft_s == 1.5 and t["queen"].tpot_s == 0.05
+    assert t["background"].ttft_s == 60.0
+    assert t["worker"].ttft_s == 8.0, "unset classes keep defaults"
+    with pytest.raises(ValueError):
+        class_targets_from_env("drone=1:1")
+    with pytest.raises(ValueError):
+        class_targets_from_env("queen=oops")
+    c = class_chunks_from_env("queen=8;background=0")
+    assert c["queen"] == 8
+    assert c["background"] == 1, "budgets clamp to >= 1"
+    with pytest.raises(ValueError):
+        class_chunks_from_env("drone=3")
+
+
+def test_chunk_budget_paces_background(build):
+    """One background turn writes at most its per-step budget (default
+    1 chunk) per scheduler step."""
+    eng = build(1)
+    eng.submit([3] * 50, sampling=_greedy(2), turn_class="background")
+    before = eng.stats()["prefill_chunks_interleaved"]
+    eng.step()
+    mid = eng.stats()["prefill_chunks_interleaved"]
+    eng.step()
+    after = eng.stats()["prefill_chunks_interleaved"]
+    assert mid - before == 1
+    assert after - mid == 1
+    eng.run_until_idle()

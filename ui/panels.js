@@ -1471,6 +1471,33 @@ async function renderTpu(el) {
         <td>${e.fault_retries ?? 0}</td></tr>`).join("") ||
         '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
       </table>
+      <h2 style="margin-top:.6rem">scheduler</h2>
+      <table><tr><th>engine</th><th>class</th><th>queued</th>
+        <th>ttft (target)</th><th>tpot (target)</th>
+        <th>chunk budget</th><th>shed</th><th>rung</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.scheduler)
+        .flatMap(([name, e]) =>
+          Object.entries(e.scheduler.classes || {}).map(([cls, c]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${esc(cls)}</td>
+        <td>${c.queued ?? 0}</td>
+        <td><span class="pill ${c.ttft_ok ? "verified" : "failed"}">${
+          c.ttft_ema_s == null ? "—" : `${c.ttft_ema_s}s`}</span>
+          <span class="dim">(${c.ttft_target_s}s)</span></td>
+        <td><span class="pill ${c.tpot_ok ? "verified" : "failed"}">${
+          c.tpot_ema_s == null ? "—" : `${c.tpot_ema_s}s`}</span>
+          <span class="dim">(${c.tpot_target_s}s)</span></td>
+        <td>${c.chunk_budget}/win
+          <span class="dim">${Math.round(
+            (c.chunk_budget_util || 0) * 100)}% used ·
+            ${c.chunks_written ?? 0} chunks</span></td>
+        <td>${c.shed ?? 0}</td>
+        <td><span class="pill ${c.rung ? "pending" : "verified"}">${
+          esc(DEGRADE_LABELS[c.rung] || c.rung)}</span></td>
+        </tr>`)).join("") ||
+        '<tr><td class="dim" colspan="8">no engines warm</td></tr>'}
+      </table>
       <h2 style="margin-top:.6rem">kv offload</h2>
       <table><tr><th>engine</th><th>host tier</th><th>disk tier</th>
         <th>out</th><th>in</th><th>prefetch</th><th>fallbacks</th>
