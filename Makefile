@@ -3,10 +3,19 @@
 PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: test bench bench-tiny serve mcp native experiment dryrun clean
+.PHONY: test lint knobs-doc bench bench-tiny serve mcp native experiment dryrun clean
 
 test:            ## hermetic suite on the virtual 8-device CPU mesh
 	$(PY) -m pytest tests/ -q
+
+lint:            ## roomlint (docs/static_analysis.md) + knobs.md freshness + ruff
+	$(PY) -m room_tpu.analysis
+	$(PY) -m room_tpu.analysis --check-docs
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
+	else echo "ruff not installed; skipping generic lint tier"; fi
+
+knobs-doc:       ## regenerate docs/knobs.md from room_tpu/utils/knobs.py
+	$(PY) -m room_tpu.analysis --write-docs
 
 bench:           ## decode benchmark (real accelerator; one JSON line)
 	$(PY) bench.py

@@ -1,0 +1,91 @@
+"""roomlint — the in-tree static-analysis suite (docs/static_analysis.md).
+
+Four AST-based checkers keep the invariants that used to live in
+review comments machine-enforced on every PR:
+
+1. **knob discipline** (`knob_checker`) — every ``ROOM_TPU_*`` env
+   read goes through the `room_tpu.utils.knobs` registry, and the
+   registry and the generated ``docs/knobs.md`` agree.
+2. **lock/stats + host-sync discipline** (`lock_checker`) —
+   ``self._stats`` mutates only via ``_bump``; no blocking
+   host-device sync under a lock or inside the dispatch window.
+3. **fault-point coverage** (`fault_checker.check_coverage`) — every
+   ``faults.FAULT_POINTS`` entry is chaos-tested somewhere under
+   ``tests/`` and documented in the ``docs/chaos.md`` fault table.
+4. **FaultError dispatch** (`fault_checker.check_dispatch`) — recovery
+   code matches the typed ``FaultError.point``, never message text.
+
+Run: ``python -m room_tpu.analysis`` (or ``make lint``). Exit 0 =
+no unsuppressed violations. Intentional violations live in
+``.roomlint.suppress`` with a reason, or as inline
+``# roomlint: allow[rule]`` comments.
+
+This package imports nothing heavier than ``room_tpu.utils.knobs``
+(stdlib-only), so the lint gate runs without jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+from . import (
+    dispatch_checker, fault_checker, knob_checker, knobs_doc,
+    lock_checker,
+)
+from .common import (
+    SourceFile, Violation, apply_suppressions, iter_py_files,
+    load_suppressions,
+)
+
+__all__ = [
+    "Violation", "SourceFile", "run_checks", "DEFAULT_SCAN_ROOTS",
+    "SUPPRESS_FILE", "KNOBS_DOC",
+]
+
+# the tree the per-file checkers walk by default; tests/ is only read
+# by the fault-coverage cross-check (fixtures with seeded violations
+# live under tests/fixtures/ and must not fail the real gate)
+DEFAULT_SCAN_ROOTS = ("room_tpu",)
+SUPPRESS_FILE = ".roomlint.suppress"
+KNOBS_DOC = os.path.join("docs", "knobs.md")
+
+
+def check_file(src: SourceFile, fault_points: tuple[str, ...]
+               ) -> list[Violation]:
+    """All per-file checkers against one parsed source file."""
+    out: list[Violation] = []
+    out += knob_checker.check_source(src)
+    out += lock_checker.check_source(src)
+    out += fault_checker.check_arm_sites(src, fault_points)
+    out += dispatch_checker.check_dispatch(src, fault_points)
+    return out
+
+
+def run_checks(
+    repo_root: str,
+    roots: Optional[Iterable[str]] = None,
+    suppress_path: Optional[str] = None,
+    cross_checks: bool = True,
+) -> tuple[list[Violation], list[Violation]]:
+    """Run the suite; returns (active, suppressed) violations.
+
+    ``roots=None`` scans DEFAULT_SCAN_ROOTS. ``cross_checks`` adds the
+    repo-level passes (fault coverage vs tests+docs, knob docs
+    freshness) on top of the per-file walks.
+    """
+    fault_points = fault_checker.load_fault_points(repo_root)
+    violations: list[Violation] = []
+    for src in iter_py_files(roots or DEFAULT_SCAN_ROOTS, repo_root):
+        violations += check_file(src, fault_points)
+    if cross_checks:
+        violations += fault_checker.check_coverage(repo_root)
+        violations += knob_checker.check_docs(
+            os.path.join(repo_root, KNOBS_DOC)
+        )
+    spath = suppress_path if suppress_path is not None else \
+        os.path.join(repo_root, SUPPRESS_FILE)
+    entries = load_suppressions(spath)
+    return apply_suppressions(
+        violations, entries, os.path.relpath(spath, repo_root)
+    )

@@ -1,0 +1,89 @@
+"""CLI for roomlint: ``python -m room_tpu.analysis`` (docs/static_analysis.md).
+
+Exit codes: 0 clean, 1 unsuppressed violations (or stale docs with
+--check-docs), 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import KNOBS_DOC, knobs_doc, run_checks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m room_tpu.analysis",
+        description="roomlint — in-tree static analysis "
+                    "(knob/lock/fault/dispatch discipline)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files or dirs to scan (default: room_tpu/)")
+    ap.add_argument("--repo-root", default=None,
+                    help="repo root (default: cwd, or the checkout "
+                         "containing this package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--suppress", default=None,
+                    help="suppression file (default: .roomlint.suppress)")
+    ap.add_argument("--no-cross-checks", action="store_true",
+                    help="skip repo-level fault-coverage and knob-docs "
+                         "passes (per-file rules only)")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate docs/knobs.md from the registry "
+                         "and exit")
+    ap.add_argument("--check-docs", action="store_true",
+                    help="exit 1 if docs/knobs.md is stale w.r.t. the "
+                         "registry")
+    args = ap.parse_args(argv)
+
+    root = args.repo_root
+    if root is None:
+        here = os.getcwd()
+        if os.path.isdir(os.path.join(here, "room_tpu")):
+            root = here
+        else:
+            root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ))
+    doc_path = os.path.join(root, KNOBS_DOC)
+
+    if args.write_docs:
+        knobs_doc.write(doc_path)
+        print(f"wrote {doc_path}")
+        return 0
+    if args.check_docs:
+        if knobs_doc.is_fresh(doc_path):
+            print("docs/knobs.md is in sync with the registry")
+            return 0
+        print("docs/knobs.md is STALE — run "
+              "`python -m room_tpu.analysis --write-docs` and commit",
+              file=sys.stderr)
+        return 1
+
+    active, suppressed = run_checks(
+        root,
+        roots=args.paths or None,
+        suppress_path=args.suppress,
+        cross_checks=not args.no_cross_checks,
+    )
+    if args.json:
+        print(json.dumps({
+            "violations": [vars(v) for v in active],
+            "suppressed": len(suppressed),
+        }, indent=2))
+    else:
+        for v in active:
+            print(v.render())
+        tail = (f"roomlint: {len(active)} violation(s), "
+                f"{len(suppressed)} suppressed")
+        print(tail if active else
+              f"roomlint: clean ({len(suppressed)} suppressed)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

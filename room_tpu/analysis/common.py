@@ -1,0 +1,219 @@
+"""Shared scaffolding for roomlint checkers: parsed-source handling,
+violation records, inline allows, and the suppression file.
+
+Suppression surfaces (docs/static_analysis.md):
+
+- inline, on the flagged line::
+
+      mode = os.environ.get("ROOM_TPU_X")  # roomlint: allow[knob-raw-env-read]
+
+- the repo-level file ``.roomlint.suppress``: one violation class per
+  line, ``<rule> <path> <qualname-or-*>``, with an explanation after
+  ``#``. Entries that match nothing are themselves reported
+  (``suppression-unused``) so the file can only shrink, never rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+_ALLOW_RE = re.compile(r"roomlint:\s*allow\[([a-z0-9-]+)\]")
+_REGION_RE = re.compile(r"roomlint:\s*region=([a-z0-9-]+)")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+    qualname: str = ""
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        if self.qualname:
+            where += f" ({self.qualname})"
+        return f"{where}: {self.rule}: {self.message}"
+
+
+@dataclass
+class SuppressEntry:
+    rule: str
+    path: str
+    qualname: str
+    reason: str
+    lineno: int
+    hits: int = 0
+
+    def matches(self, v: Violation) -> bool:
+        if self.rule != v.rule:
+            return False
+        if os.path.normpath(self.path) != os.path.normpath(v.path):
+            return False
+        return self.qualname == "*" or self.qualname == v.qualname
+
+
+class SourceFile:
+    """One parsed Python file plus the line-level metadata checkers
+    need: enclosing-function qualnames, inline allows, region marks."""
+
+    def __init__(self, path: str, text: Optional[str] = None,
+                 rel: Optional[str] = None) -> None:
+        self.path = rel if rel is not None else path
+        self.text = text if text is not None else \
+            open(path, encoding="utf-8").read()
+        self.lines = self.text.split("\n")
+        self.tree = ast.parse(self.text, filename=path)
+        # (start, end, qualname) per function, innermost-last
+        self._funcs: list[tuple[int, int, str]] = []
+        self._regions: dict[str, str] = {}  # qualname -> region name
+        self._index_functions()
+
+    def _index_functions(self) -> None:
+        def walk(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    end = getattr(child, "end_lineno", child.lineno)
+                    self._funcs.append((child.lineno, end, qual))
+                    marker = self._region_marker(child)
+                    if marker:
+                        self._regions[qual] = marker
+                    walk(child, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, f"{prefix}{child.name}.")
+                else:
+                    walk(child, prefix)
+
+        walk(self.tree, "")
+        # innermost function should win the qualname lookup
+        self._funcs.sort(key=lambda t: (t[0], -t[1]))
+
+    def _region_marker(self, fn: ast.AST) -> Optional[str]:
+        """A ``# roomlint: region=<name>`` comment on the def line, or
+        on either of the two lines above it (decorators/comments)."""
+        for ln in range(max(1, fn.lineno - 2), fn.lineno + 1):
+            m = _REGION_RE.search(self.lines[ln - 1])
+            if m:
+                return m.group(1)
+        return None
+
+    def qualname_at(self, line: int) -> str:
+        best = ""
+        for start, end, qual in self._funcs:
+            if start <= line <= end:
+                best = qual  # list is ordered, innermost overrides
+        return best
+
+    def region_functions(self, region: str) -> list[tuple[int, int, str]]:
+        return [
+            (s, e, q) for s, e, q in self._funcs
+            if self._regions.get(q) == region
+        ]
+
+    def inline_allowed(self, line: int, rule: str) -> bool:
+        if 1 <= line <= len(self.lines):
+            for m in _ALLOW_RE.finditer(self.lines[line - 1]):
+                if m.group(1) == rule:
+                    return True
+        return False
+
+    def violation(self, rule: str, node_or_line, message: str
+                  ) -> Optional[Violation]:
+        """Build a Violation unless an inline allow covers the line."""
+        line = node_or_line if isinstance(node_or_line, int) else \
+            getattr(node_or_line, "lineno", 0)
+        if self.inline_allowed(line, rule):
+            return None
+        return Violation(rule, self.path, line, message,
+                         self.qualname_at(line))
+
+
+def iter_py_files(roots: Iterable[str], repo_root: str
+                  ) -> Iterable[SourceFile]:
+    """Yield SourceFile for every .py under the given roots (files or
+    dirs), with paths reported relative to the repo root."""
+    seen = set()
+    for root in roots:
+        absroot = os.path.join(repo_root, root) \
+            if not os.path.isabs(root) else root
+        if os.path.isfile(absroot):
+            paths = [absroot]
+        else:
+            paths = []
+            for dirpath, dirnames, filenames in os.walk(absroot):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                paths.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        for p in sorted(paths):
+            if p in seen:
+                continue
+            seen.add(p)
+            rel = os.path.relpath(p, repo_root)
+            try:
+                yield SourceFile(p, rel=rel)
+            except SyntaxError:
+                # generic lint (ruff tier) owns syntax errors
+                continue
+
+
+def load_suppressions(path: str) -> list[SuppressEntry]:
+    entries: list[SuppressEntry] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            body, _, reason = line.partition("#")
+            parts = body.split()
+            if len(parts) != 3:
+                raise ValueError(
+                    f"{path}:{lineno}: suppression lines are "
+                    f"'<rule> <path> <qualname-or-*>  # reason', "
+                    f"got {line!r}"
+                )
+            if not reason.strip():
+                raise ValueError(
+                    f"{path}:{lineno}: suppression for {parts[0]} "
+                    "needs a '# reason'"
+                )
+            entries.append(SuppressEntry(
+                parts[0], parts[1], parts[2], reason.strip(), lineno
+            ))
+    return entries
+
+
+def apply_suppressions(
+    violations: list[Violation],
+    entries: list[SuppressEntry],
+    suppress_path: str,
+) -> tuple[list[Violation], list[Violation]]:
+    """Split into (active, suppressed); unused entries come back as
+    ``suppression-unused`` violations so stale lines fail the gate."""
+    active: list[Violation] = []
+    suppressed: list[Violation] = []
+    for v in violations:
+        hit = next((e for e in entries if e.matches(v)), None)
+        if hit is not None:
+            hit.hits += 1
+            suppressed.append(v)
+        else:
+            active.append(v)
+    for e in entries:
+        if e.hits == 0:
+            active.append(Violation(
+                "suppression-unused", suppress_path, e.lineno,
+                f"suppression '{e.rule} {e.path} {e.qualname}' "
+                "matched nothing — delete it",
+            ))
+    return active, suppressed

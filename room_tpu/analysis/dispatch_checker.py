@@ -1,0 +1,64 @@
+"""roomlint checker 4 — FaultError dispatch discipline.
+
+``FaultError.point`` names the fault point that fired; recovery paths
+that scope differently per point (decode_window fails only the
+window's turns; decode_step escalates to the crash supervisor) must
+dispatch on it (faults.py docstring). Matching on message TEXT —
+``"decode_window" in str(e)`` — works until anyone rewords the
+message, then the recovery path silently widens or vanishes.
+
+Rule ``fault-substring-dispatch``: inside an ``except`` handler, a
+string-membership test whose literal is a fault-point name (or
+contains "injected") against an expression derived from the caught
+exception.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import SourceFile, Violation
+
+
+def _mentions_exception(node: ast.AST, exc_names: set[str]) -> bool:
+    for inner in ast.walk(node):
+        if isinstance(inner, ast.Name) and inner.id in exc_names:
+            return True
+    return False
+
+
+def check_dispatch(src: SourceFile, points: tuple[str, ...]
+                   ) -> list[Violation]:
+    out: list[Violation] = []
+    point_set = set(points)
+
+    class Visitor(ast.NodeVisitor):
+        def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+            exc_names = {node.name} if node.name else set()
+            for inner in ast.walk(node):
+                if not (isinstance(inner, ast.Compare)
+                        and len(inner.ops) == 1
+                        and isinstance(inner.ops[0], (ast.In, ast.NotIn))
+                        and isinstance(inner.left, ast.Constant)
+                        and isinstance(inner.left.value, str)):
+                    continue
+                lit = inner.left.value
+                if not (lit in point_set or "injected" in lit):
+                    continue
+                cmp = inner.comparators[0]
+                texty = "str(" in ast.unparse(cmp) or \
+                    _mentions_exception(cmp, exc_names)
+                if texty:
+                    v = src.violation(
+                        "fault-substring-dispatch", inner,
+                        f"dispatching on fault message substring "
+                        f"{lit!r}; match the typed FaultError.point "
+                        "attribute instead "
+                        "(getattr(e, 'point', None))",
+                    )
+                    if v:
+                        out.append(v)
+            self.generic_visit(node)
+
+    Visitor().visit(src.tree)
+    return out

@@ -1,0 +1,159 @@
+"""roomlint checker 3 — fault-point coverage, and 4 — FaultError
+dispatch discipline.
+
+Checker 3 cross-checks three surfaces that must stay in lockstep:
+
+- ``room_tpu/serving/faults.py`` ``FAULT_POINTS`` (the registry of
+  injectable failure modes),
+- the test suite (EVERY test file counts, not just ``test_chaos_*`` —
+  ``decode_window`` lives in ``test_decode_pipeline.py`` and
+  ``shutdown_io`` in ``test_lifecycle.py``; the mapping is discovered
+  by scanning ``tests/`` for ``faults.inject("<point>"...)`` arms),
+- the ``docs/chaos.md`` fault table.
+
+Rules: ``fault-point-untested`` (no test arms it),
+``fault-point-undocumented`` (no chaos.md row),
+``fault-point-unknown`` (code/tests/docs name a point the registry
+does not define).
+
+Checker 4 (FaultError dispatch) lives in ``dispatch_checker``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from .common import SourceFile, Violation
+
+FAULTS_MODULE = os.path.join("room_tpu", "serving", "faults.py")
+
+# inject("x"...) / maybe_fail("x") / maybe_delay("x") / is_active("x")
+_ARM_RE = re.compile(
+    r"(?:inject|maybe_fail|maybe_delay|is_active|fired)\(\s*"
+    r"['\"]([a-z_]+)['\"]"
+)
+# entries inside a ROOM_TPU_FAULTS env spec string: name[:k=v...]
+_ENV_SPEC_RE = re.compile(r"ROOM_TPU_FAULTS[^\n]*?['\"]([a-z_:,;=.0-9 ]+)['\"]")
+_DOC_ROW_RE = re.compile(r"^\| `([a-z_]+)` \|")
+
+
+def load_fault_points(repo_root: str) -> tuple[str, ...]:
+    """Parse FAULT_POINTS out of faults.py without importing the
+    serving package (which drags in jax)."""
+    path = os.path.join(repo_root, FAULTS_MODULE)
+    tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and \
+                        tgt.id == "FAULT_POINTS":
+                    return tuple(ast.literal_eval(node.value))
+    raise RuntimeError(f"FAULT_POINTS not found in {path}")
+
+
+def _points_mentioned(text: str) -> set[str]:
+    found = set(_ARM_RE.findall(text))
+    for spec in _ENV_SPEC_RE.findall(text):
+        for part in spec.split(";"):
+            name = part.strip().partition(":")[0].strip()
+            if name:
+                found.add(name)
+    return found
+
+
+def check_coverage(
+    repo_root: str,
+    tests_dir: str = "tests",
+    doc_path: str = os.path.join("docs", "chaos.md"),
+) -> list[Violation]:
+    points = load_fault_points(repo_root)
+    out: list[Violation] = []
+
+    # ---- test mapping: point -> test files that arm it ---------------
+    tested: dict[str, list[str]] = {p: [] for p in points}
+    unknown_in_tests: dict[str, str] = {}
+    tests_abs = os.path.join(repo_root, tests_dir)
+    for dirpath, dirnames, filenames in os.walk(tests_abs):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"
+                       and d != "fixtures"]
+        for fname in sorted(filenames):
+            if not (fname.startswith("test_") and fname.endswith(".py")):
+                continue
+            fpath = os.path.join(dirpath, fname)
+            text = open(fpath, encoding="utf-8").read()
+            rel = os.path.relpath(fpath, repo_root)
+            for name in _points_mentioned(text):
+                if name in tested:
+                    tested[name].append(rel)
+                elif name not in ("no_such_point",):
+                    # tests deliberately probing unknown-point errors
+                    # name themselves no_such_point
+                    unknown_in_tests.setdefault(name, rel)
+    for name, files in tested.items():
+        if not files:
+            out.append(Violation(
+                "fault-point-untested", FAULTS_MODULE, 1,
+                f"fault point {name!r} is never armed by any test "
+                f"under {tests_dir}/ — every FAULT_POINTS entry needs "
+                "a recovery test",
+            ))
+    for name, rel in sorted(unknown_in_tests.items()):
+        out.append(Violation(
+            "fault-point-unknown", rel, 1,
+            f"test arms unknown fault point {name!r} "
+            f"(known: {', '.join(points)})",
+        ))
+
+    # ---- docs/chaos.md fault table -----------------------------------
+    doc_abs = os.path.join(repo_root, doc_path)
+    documented: dict[str, int] = {}
+    if os.path.exists(doc_abs):
+        with open(doc_abs, encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                m = _DOC_ROW_RE.match(line)
+                if m:
+                    documented[m.group(1)] = lineno
+    for name in points:
+        if name not in documented:
+            out.append(Violation(
+                "fault-point-undocumented", doc_path, 1,
+                f"fault point {name!r} missing from the {doc_path} "
+                "fault table",
+            ))
+    for name, lineno in documented.items():
+        if name not in points:
+            out.append(Violation(
+                "fault-point-unknown", doc_path, lineno,
+                f"{doc_path} documents fault point {name!r} but "
+                "faults.FAULT_POINTS does not define it",
+            ))
+    return out
+
+
+def check_arm_sites(src: SourceFile, points: tuple[str, ...]
+                    ) -> list[Violation]:
+    """Library-side arm/check sites naming an unknown point (a typo'd
+    maybe_fail would make a fault path silently untestable)."""
+    out: list[Violation] = []
+    if os.path.normpath(src.path).endswith(FAULTS_MODULE):
+        return out
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("maybe_fail", "maybe_delay",
+                                       "inject", "is_active", "fired")
+                and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value not in points:
+            v = src.violation(
+                "fault-point-unknown", node,
+                f"arms unknown fault point {arg.value!r}",
+            )
+            if v:
+                out.append(v)
+    return out

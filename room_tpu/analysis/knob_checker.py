@@ -1,0 +1,187 @@
+"""roomlint checker 1 — knob discipline.
+
+Rules:
+
+``knob-raw-env-read``
+    A raw ``os.environ`` / ``os.getenv`` read of a ``ROOM_TPU_*`` name
+    (including f-string-built names) anywhere under ``room_tpu/``
+    except the registry module itself. All knob reads go through
+    ``room_tpu.utils.knobs``.
+``knob-unregistered``
+    A ``knobs.get_*`` / ``knobs.is_set`` / ``knobs.get_dynamic`` call
+    whose literal name/pattern is not in the registry.
+``knob-undocumented`` / ``knob-doc-drift`` / ``knob-unknown-doc``
+    Registry vs generated ``docs/knobs.md`` cross-check: a registered
+    knob missing from the doc, a default cell that disagrees with the
+    registry (hand-edit drift), or a documented knob the registry does
+    not know. ``--write-docs`` regenerates the file from the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from .common import SourceFile, Violation
+
+# the one module allowed to touch os.environ for ROOM_TPU_* names
+REGISTRY_MODULE = os.path.join("room_tpu", "utils", "knobs.py")
+
+_GETTERS = (
+    "get_raw", "get_str", "get_int", "get_float", "get_bool",
+    "is_set", "resolve_default",
+)
+
+
+def _is_environ(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _env_name_literal(node: ast.AST) -> Optional[str]:
+    """The ROOM_TPU_* name an expression mentions, if any: a str
+    constant, an f-string with a ROOM_TPU_ chunk, or a concat/format
+    whose source text carries the prefix."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value.startswith("ROOM_TPU_") else None
+    if isinstance(node, ast.JoinedStr):
+        for part in node.values:
+            if isinstance(part, ast.Constant) and \
+                    isinstance(part.value, str) and \
+                    "ROOM_TPU_" in part.value:
+                return part.value.strip("_") + "{...}"
+    try:
+        src = ast.unparse(node)
+    except Exception:
+        return None
+    return src if "ROOM_TPU_" in src else None
+
+
+def _knobs_registry():
+    from room_tpu.utils.knobs import DYNAMIC, REGISTRY
+
+    return REGISTRY, DYNAMIC
+
+
+def check_source(src: SourceFile) -> list[Violation]:
+    out: list[Violation] = []
+    if os.path.normpath(src.path).endswith(REGISTRY_MODULE):
+        return out
+    registry, dynamic = _knobs_registry()
+
+    for node in ast.walk(src.tree):
+        # -- raw environ reads -------------------------------------
+        hit_name: Optional[str] = None
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("get", "pop", "setdefault") and \
+                    _is_environ(fn.value) and node.args:
+                hit_name = _env_name_literal(node.args[0])
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr == "getenv" and node.args:
+                hit_name = _env_name_literal(node.args[0])
+        elif isinstance(node, ast.Subscript) and \
+                _is_environ(node.value):
+            hit_name = _env_name_literal(node.slice)
+        elif isinstance(node, ast.Compare) and \
+                len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                _is_environ(node.comparators[0]):
+            hit_name = _env_name_literal(node.left)
+        if hit_name is not None:
+            v = src.violation(
+                "knob-raw-env-read", node,
+                f"raw environ read of {hit_name!r}; go through "
+                "room_tpu.utils.knobs",
+            )
+            if v:
+                out.append(v)
+            continue
+
+        # -- registry lookups with unknown names --------------------
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "knobs" and node.args:
+            name = node.args[0]
+            if not (isinstance(name, ast.Constant)
+                    and isinstance(name.value, str)):
+                continue
+            if node.func.attr in _GETTERS and \
+                    name.value.startswith("ROOM_TPU_") and \
+                    name.value not in registry:
+                v = src.violation(
+                    "knob-unregistered", node,
+                    f"knob {name.value!r} is not registered in "
+                    "room_tpu/utils/knobs.py",
+                )
+                if v:
+                    out.append(v)
+            elif node.func.attr == "get_dynamic" and \
+                    name.value not in dynamic:
+                v = src.violation(
+                    "knob-unregistered", node,
+                    f"dynamic knob family {name.value!r} is not "
+                    "registered in room_tpu/utils/knobs.py",
+                )
+                if v:
+                    out.append(v)
+    return out
+
+
+# ---- registry <-> docs/knobs.md cross-check ---------------------------
+
+_DOC_ROW = re.compile(r"^\| `([A-Z0-9_{}]+)` \| \S+ \| (.*?) \|")
+
+
+def _doc_default_cell(default: Optional[str]) -> str:
+    if default is None:
+        return "_unset_"
+    return f"`{default}`" if default != "" else "`\"\"`"
+
+
+def check_docs(doc_path: str) -> list[Violation]:
+    registry, dynamic = _knobs_registry()
+    known = dict(registry)
+    known.update(dynamic)
+    out: list[Violation] = []
+    if not os.path.exists(doc_path):
+        return [Violation(
+            "knob-undocumented", doc_path, 1,
+            "docs/knobs.md missing — run "
+            "`python -m room_tpu.analysis --write-docs`",
+        )]
+    documented: dict[str, tuple[int, str]] = {}
+    with open(doc_path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            m = _DOC_ROW.match(line)
+            if m:
+                documented[m.group(1)] = (lineno, m.group(2))
+    for name, knob in known.items():
+        if name not in documented:
+            out.append(Violation(
+                "knob-undocumented", doc_path, 1,
+                f"registered knob {name} missing from docs/knobs.md "
+                "(regenerate with --write-docs)",
+            ))
+            continue
+        lineno, cell = documented[name]
+        if cell != _doc_default_cell(knob.default):
+            out.append(Violation(
+                "knob-doc-drift", doc_path, lineno,
+                f"{name}: documented default {cell} != registry "
+                f"default {_doc_default_cell(knob.default)} "
+                "(regenerate with --write-docs)",
+            ))
+    for name, (lineno, _) in documented.items():
+        if name not in known:
+            out.append(Violation(
+                "knob-unknown-doc", doc_path, lineno,
+                f"docs/knobs.md documents {name} but the registry "
+                "does not know it",
+            ))
+    return out

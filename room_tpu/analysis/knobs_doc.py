@@ -1,0 +1,90 @@
+"""Generator for ``docs/knobs.md`` — the knob reference is BUILT from
+``room_tpu.utils.knobs``, never hand-edited. ``python -m
+room_tpu.analysis --write-docs`` regenerates it; the CI lint job
+regenerates and diffs, so a registry change that forgets the doc (or a
+hand edit that drifts from the registry) fails the gate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_HEADER = """\
+# ROOM_TPU_* configuration knobs
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source of truth: room_tpu/utils/knobs.py.
+     Regenerate: python -m room_tpu.analysis --write-docs -->
+
+Every environment knob the engine, server, swarm runtime, and bench
+harness read. Reads go through the central registry
+(`room_tpu.utils.knobs`) — a raw `os.environ` read of this namespace
+is a roomlint violation (`docs/static_analysis.md`).
+
+A knob with a **provider default** follows the provider-on /
+library-off convention: library constructors apply the plain default,
+the production deployment path (`providers/tpu.ModelHost`) applies the
+provider default. `ROOM_TPU_SPEC_TOKENS`, `ROOM_TPU_OFFLOAD`, and
+`ROOM_TPU_LIFECYCLE` share this split.
+
+Names containing `{...}` are dynamic families: the placeholder is
+filled at runtime (e.g. `ROOM_TPU_MESH_{MODEL}` ->
+`ROOM_TPU_MESH_QWEN3_30B_MOE`).
+"""
+
+_SCOPE_TITLES = (
+    ("library", "Library (engine / serving / core)"),
+    ("provider", "Provider deployment path"),
+    ("server", "Server / HTTP / cloud"),
+    ("swarm", "Swarm runtime"),
+    ("bench", "Bench + tuning harness"),
+    ("test-seam", "Test seams"),
+)
+
+
+def _default_cell(default: Optional[str]) -> str:
+    if default is None:
+        return "_unset_"
+    return f"`{default}`" if default != "" else "`\"\"`"
+
+
+def render() -> str:
+    from room_tpu.utils.knobs import all_knobs
+
+    knobs = all_knobs()
+    lines = [_HEADER]
+    for scope, title in _SCOPE_TITLES:
+        rows = [k for k in knobs.values() if k.scope == scope]
+        if not rows:
+            continue
+        lines.append(f"\n## {title}\n")
+        lines.append("| knob | type | default | provider default | "
+                     "description |")
+        lines.append("|---|---|---|---|---|")
+        for k in sorted(rows, key=lambda k: k.name):
+            prov = _default_cell(k.provider_default) \
+                if k.provider_default is not None else ""
+            doc = k.doc
+            if k.choices:
+                opts = " \\| ".join(c if c else '""' for c in k.choices)
+                doc = f"{doc} ({opts})"
+            lines.append(
+                f"| `{k.name}` | {k.type} | {_default_cell(k.default)} "
+                f"| {prov} | {doc} |"
+            )
+    lines.append("")
+    lines.append(f"\n_{len(knobs)} knobs registered._")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write(path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(render())
+
+
+def is_fresh(path: str) -> bool:
+    try:
+        return open(path, encoding="utf-8").read() == render()
+    except OSError:
+        return False

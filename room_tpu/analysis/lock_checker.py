@@ -1,0 +1,127 @@
+"""roomlint checker 2 — lock / stats / host-sync discipline.
+
+The engine's counter invariant: ``self._stats[...]`` mutates ONLY
+inside ``_bump`` (which takes the engine lock); every other mutation
+races the ``stats()`` snapshot. And the decode pipeline's reason to
+exist (docs/serving.md; arXiv 2407.09111 on host-sync overhead) dies
+the moment someone blocks on the device inside the engine lock or
+inside the dispatch window, so the sync primitives are flagged there.
+
+Rules:
+
+``stats-outside-bump``
+    Assignment / augmented assignment to a ``self._stats[...]``
+    subscript in any function not named ``_bump``.
+``sync-under-lock``
+    A blocking host-device sync call (``block_until_ready``,
+    ``jax.device_get``, ``np.asarray`` / ``numpy.asarray``) lexically
+    inside a ``with self._lock:`` (or ``*_lock``) block.
+``sync-in-dispatch-window``
+    The same sync calls inside a function marked
+    ``# roomlint: region=dispatch-window`` — code that runs between
+    dispatching a decode window and draining it, where a host sync
+    serializes the pipeline. The drain itself is the one sanctioned
+    materialization point and is simply not marked.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import SourceFile, Violation
+
+_SYNC_ATTRS = ("block_until_ready", "device_get")
+_ASARRAY_MODULES = ("np", "numpy", "jnp")
+
+
+def _sync_call_name(node: ast.AST) -> str:
+    """Non-empty description when the node is a blocking-sync call."""
+    if not isinstance(node, ast.Call):
+        return ""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _SYNC_ATTRS:
+            return ast.unparse(fn)
+        if fn.attr == "asarray" and isinstance(fn.value, ast.Name) \
+                and fn.value.id in _ASARRAY_MODULES:
+            # jnp.asarray is device-side and lazy; only numpy blocks
+            if fn.value.id == "jnp":
+                return ""
+            return ast.unparse(fn)
+    return ""
+
+
+def _is_lock_with(node: ast.With) -> bool:
+    for item in node.items:
+        try:
+            src = ast.unparse(item.context_expr)
+        except Exception:
+            continue
+        if "_lock" in src and not src.startswith("open("):
+            return True
+    return False
+
+
+def check_source(src: SourceFile) -> list[Violation]:
+    out: list[Violation] = []
+
+    # ---- stats-outside-bump ------------------------------------------
+    for node in ast.walk(src.tree):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Attribute) and \
+                    tgt.value.attr == "_stats" and \
+                    isinstance(tgt.value.value, ast.Name) and \
+                    tgt.value.value.id == "self":
+                qual = src.qualname_at(node.lineno)
+                if qual.split(".")[-1] == "_bump":
+                    continue
+                v = src.violation(
+                    "stats-outside-bump", node,
+                    "direct self._stats[...] mutation outside _bump "
+                    "races the stats() snapshot — route through "
+                    "self._bump()",
+                )
+                if v:
+                    out.append(v)
+
+    # ---- sync-under-lock ---------------------------------------------
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.With) and _is_lock_with(node):
+            for inner in ast.walk(node):
+                name = _sync_call_name(inner)
+                if name:
+                    v = src.violation(
+                        "sync-under-lock", inner,
+                        f"blocking host-device sync {name}() while "
+                        "holding a lock stalls every reader thread",
+                    )
+                    if v:
+                        out.append(v)
+
+    # ---- sync-in-dispatch-window -------------------------------------
+    for start, end, qual in src.region_functions("dispatch-window"):
+        fn_node = next(
+            (n for n in ast.walk(src.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and n.lineno == start), None,
+        )
+        if fn_node is None:
+            continue
+        for inner in ast.walk(fn_node):
+            name = _sync_call_name(inner)
+            if name:
+                v = src.violation(
+                    "sync-in-dispatch-window", inner,
+                    f"blocking host-device sync {name}() between "
+                    "dispatch and drain serializes the decode "
+                    "pipeline (docs/serving.md)",
+                )
+                if v:
+                    out.append(v)
+    return out

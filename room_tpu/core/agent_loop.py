@@ -13,7 +13,6 @@ execution path.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from collections import deque
@@ -22,6 +21,7 @@ from datetime import datetime
 from typing import Optional
 
 from ..db import Database, utc_now
+from ..utils import knobs
 from ..providers import (
     ExecutionRequest, RateLimitExceeded, get_model_provider,
 )
@@ -59,12 +59,11 @@ CYCLE_ERROR_GAP_S = 30.0  # backoff after an unexpected cycle error
 # the worker is marked unhealthy and keeper-escalated. A loop counts as
 # hung when it has been inside one cycle (state == "running") longer
 # than LOOP_HANG_S without a heartbeat.
-LOOP_RESTART_BUDGET = int(os.environ.get("ROOM_TPU_LOOP_MAX_RESTARTS",
-                                         "3"))
-LOOP_RESTART_WINDOW_S = float(
-    os.environ.get("ROOM_TPU_LOOP_RESTART_WINDOW_S", "300")
+LOOP_RESTART_BUDGET = knobs.get_int("ROOM_TPU_LOOP_MAX_RESTARTS")
+LOOP_RESTART_WINDOW_S = knobs.get_float(
+    "ROOM_TPU_LOOP_RESTART_WINDOW_S"
 )
-LOOP_HANG_S = float(os.environ.get("ROOM_TPU_LOOP_HANG_S", "1800"))
+LOOP_HANG_S = knobs.get_float("ROOM_TPU_LOOP_HANG_S")
 
 # execution-plane tools: fine for workers, a logged deviation when the
 # queen runs them herself instead of delegating

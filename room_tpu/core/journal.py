@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import hashlib
 import json
-import os
+from ..utils import knobs
 import sys
 from typing import Callable, Optional
 
@@ -56,13 +56,12 @@ JOURNALED_TOOLS = frozenset({
 })
 
 # how long a recovery-flagged effect stays skippable (seconds)
-REPLAY_WINDOW_S = float(os.environ.get("ROOM_TPU_REPLAY_WINDOW_S",
-                                       "21600"))
+REPLAY_WINDOW_S = knobs.get_float("ROOM_TPU_REPLAY_WINDOW_S")
 # queen_tools.execute_queen_tool's error convention: tool failures come
 # back as strings with this prefix, never as exceptions
 TOOL_ERROR_PREFIX = "tool error:"
 # terminal journal rows older than this are pruned (hours)
-PRUNE_AFTER_H = float(os.environ.get("ROOM_TPU_JOURNAL_PRUNE_H", "72"))
+PRUNE_AFTER_H = knobs.get_float("ROOM_TPU_JOURNAL_PRUNE_H")
 
 _TERMINAL = ("closed", "recovered", "committed", "consumed",
              "abandoned")

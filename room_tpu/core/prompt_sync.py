@@ -11,14 +11,12 @@ from datetime import datetime, timezone
 from typing import Optional
 
 from ..db import Database
+from ..utils import knobs
 from . import workers as workers_mod
 
 
 def prompts_dir(room_id: int) -> str:
-    base = os.environ.get(
-        "ROOM_TPU_DATA_DIR",
-        os.path.join(os.path.expanduser("~"), ".room_tpu"),
-    )
+    base = os.path.expanduser(knobs.get_str("ROOM_TPU_DATA_DIR"))
     d = os.path.join(base, "prompts", "workers", f"room-{room_id}")
     os.makedirs(d, exist_ok=True)
     return d

@@ -9,6 +9,7 @@ import getpass
 import hashlib
 import os
 import socket
+from ..utils import knobs
 
 try:
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
@@ -23,7 +24,7 @@ ENVELOPE_PREFIX = "enc:v1:"
 
 
 def _derive_key(extra: str = "") -> bytes:
-    seed = os.environ.get("ROOM_TPU_SECRET_KEY")
+    seed = knobs.get_str("ROOM_TPU_SECRET_KEY")
     if not seed:
         seed = socket.gethostname() + ":" + getpass.getuser()
     return hashlib.sha256((seed + extra).encode()).digest()

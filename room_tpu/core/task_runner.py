@@ -15,6 +15,7 @@ import time
 from typing import Optional
 
 from ..db import Database, utc_now
+from ..utils import knobs
 from ..providers import (
     ExecutionRequest, RateLimitExceeded, get_model_provider,
 )
@@ -423,9 +424,7 @@ def _finish_run(
 
 
 def _save_result_file(task: dict, run_id: int, text: str) -> Optional[str]:
-    base = os.environ.get("ROOM_TPU_DATA_DIR")
-    if not base:
-        base = os.path.join(os.path.expanduser("~"), ".room_tpu")
+    base = os.path.expanduser(knobs.get_str("ROOM_TPU_DATA_DIR"))
     try:
         results_dir = os.path.join(base, "results")
         os.makedirs(results_dir, exist_ok=True)

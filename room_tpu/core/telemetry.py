@@ -8,7 +8,6 @@ from __future__ import annotations
 import getpass
 import hashlib
 import json
-import os
 import socket
 import threading
 import traceback
@@ -17,6 +16,7 @@ from collections import Counter
 from typing import Optional
 
 from ..db import Database, utc_now
+from ..utils import knobs
 from .messages import get_setting, set_setting
 
 # ---- in-process resilience counters (fault injection, degradation,
@@ -62,11 +62,11 @@ def get_machine_id() -> str:
 
 
 def telemetry_enabled() -> bool:
-    return bool(os.environ.get("ROOM_TPU_TELEMETRY_TOKEN"))
+    return bool(knobs.get_str("ROOM_TPU_TELEMETRY_TOKEN"))
 
 
 def _endpoint() -> Optional[str]:
-    return os.environ.get("ROOM_TPU_TELEMETRY_URL")
+    return knobs.get_str("ROOM_TPU_TELEMETRY_URL")
 
 
 def _post(payload: dict) -> bool:
@@ -80,7 +80,7 @@ def _post(payload: dict) -> bool:
             headers={
                 "Content-Type": "application/json",
                 "Authorization":
-                    f"Bearer {os.environ['ROOM_TPU_TELEMETRY_TOKEN']}",
+                    f"Bearer {knobs.get_str('ROOM_TPU_TELEMETRY_TOKEN')}",
             },
         )
         with urllib.request.urlopen(req, timeout=10):

@@ -15,6 +15,7 @@ import urllib.request
 from typing import Optional
 
 from ..db import Database
+from ..utils import knobs
 from .chains import CHAINS, DEFAULT_CHAIN
 from .ethtx import pubkey_point
 from .keccak import keccak256
@@ -117,7 +118,9 @@ def _rpc(chain: str, method: str, params: list) -> dict:
     cfg = CHAINS.get(chain)
     if cfg is None:
         raise WalletError(f"unknown chain {chain!r}")
-    url = os.environ.get(f"ROOM_TPU_RPC_{chain.upper()}", cfg.rpc_url)
+    url = knobs.get_dynamic(
+        "ROOM_TPU_RPC_{CHAIN}", chain.upper(), default=cfg.rpc_url
+    )
     body = json.dumps(
         {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
     ).encode()

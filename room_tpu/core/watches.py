@@ -16,6 +16,7 @@ import threading
 from typing import Optional
 
 from ..db import Database, utc_now
+from ..utils import knobs
 
 DENIED_PARTS = {
     ".ssh", ".aws", ".gnupg", ".gpg", ".keychain", ".password-store",
@@ -29,7 +30,7 @@ def validate_watch_path(path: str) -> Optional[str]:
     home = os.path.realpath(os.path.expanduser("~"))
     tmp = os.path.realpath("/tmp")
     data_dir = os.path.realpath(
-        os.environ.get("ROOM_TPU_DATA_DIR", os.path.join(home, ".room_tpu"))
+        os.path.expanduser(knobs.get_str("ROOM_TPU_DATA_DIR"))
     )
     if not (
         real == home or real.startswith(home + os.sep)

@@ -19,6 +19,7 @@ from datetime import datetime, timezone
 from typing import Any, Iterator, Optional
 
 from .schema import SCHEMA, SCHEMA_VERSION
+from ..utils import knobs
 
 # Ordered (version, ddl) pairs applied after the base schema. Version 1 is
 # the base schema itself. Future migrations append here.
@@ -182,12 +183,10 @@ _default_lock = threading.Lock()
 def default_db_path() -> str:
     """Resolve the on-disk database path (env-overridable like the
     reference's QUOROOM_DB_PATH / QUOROOM_DATA_DIR, src/server/db.ts:28-39)."""
-    explicit = os.environ.get("ROOM_TPU_DB_PATH")
+    explicit = knobs.get_str("ROOM_TPU_DB_PATH")
     if explicit:
         return explicit
-    data_dir = os.environ.get(
-        "ROOM_TPU_DATA_DIR", os.path.join(os.path.expanduser("~"), ".room_tpu")
-    )
+    data_dir = os.path.expanduser(knobs.get_str("ROOM_TPU_DATA_DIR"))
     os.makedirs(data_dir, exist_ok=True)
     return os.path.join(data_dir, "data.db")
 

@@ -6,12 +6,12 @@ initialize, tools/list, tools/call — against the shared SQLite file
 from __future__ import annotations
 
 import json
-import os
 import sys
 from typing import Any, Optional, TextIO
 
 from .. import __version__
 from ..db import Database
+from ..utils import knobs
 from .tools import TOOLS
 
 PROTOCOL_VERSION = "2024-11-05"
@@ -31,7 +31,7 @@ def tools_list_payload() -> list[dict]:
 class McpServer:
     def __init__(self, db: Optional[Database] = None) -> None:
         if db is None:
-            path = os.environ.get("ROOM_TPU_DB_PATH")
+            path = knobs.get_str("ROOM_TPU_DB_PATH")
             if not path:
                 from ..db.database import default_db_path
 

@@ -23,7 +23,6 @@ host rides ICI; only the cross-host slice crosses DCN).
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import jax
@@ -31,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .mesh import AXES, MeshSpec
+from ..utils import knobs
 
 
 def initialize_multihost(
@@ -47,15 +47,15 @@ def initialize_multihost(
     barrier: a pod launcher whose process 0 never came up must fail
     fast with a clear error, not hang for JAX's default five minutes
     (failure-detection contract, SURVEY §5)."""
-    coordinator = coordinator or os.environ.get("ROOM_TPU_COORDINATOR")
+    coordinator = coordinator or knobs.get_str("ROOM_TPU_COORDINATOR")
     if num_processes is None:
-        raw = os.environ.get("ROOM_TPU_NUM_PROCESSES")
+        raw = knobs.get_raw("ROOM_TPU_NUM_PROCESSES")
         num_processes = int(raw) if raw else None
     if process_id is None:
-        raw = os.environ.get("ROOM_TPU_PROCESS_ID")
+        raw = knobs.get_raw("ROOM_TPU_PROCESS_ID")
         process_id = int(raw) if raw else None
     if timeout_s is None:
-        raw = os.environ.get("ROOM_TPU_DCN_TIMEOUT_S")
+        raw = knobs.get_raw("ROOM_TPU_DCN_TIMEOUT_S")
         timeout_s = float(raw) if raw else None
 
     if not coordinator or not num_processes or num_processes <= 1:

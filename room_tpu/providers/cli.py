@@ -26,13 +26,16 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .base import ExecutionRequest, ExecutionResult
+from ..utils import knobs
 
 PROBE_TIMEOUT_S = 1.5
 KILL_GRACE_S = 5.0
 
 
 def resolve_cli_path(provider: str) -> Optional[str]:
-    env_override = os.environ.get(f"ROOM_TPU_{provider.upper()}_CLI")
+    env_override = knobs.get_dynamic(
+        "ROOM_TPU_{PROVIDER}_CLI", provider.upper()
+    )
     if env_override:
         return env_override if os.path.exists(env_override) else None
     return shutil.which(provider)

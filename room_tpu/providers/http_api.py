@@ -15,6 +15,7 @@ import urllib.request
 from typing import Any, Optional
 
 from ..core.rate_limit import detect_rate_limit
+from ..utils import knobs
 from .base import (
     ExecutionRequest,
     ExecutionResult,
@@ -78,8 +79,9 @@ class OpenAICompatProvider:
         self.name = kind
         self.model = model
         self._db = db
-        self.base = os.environ.get(
-            f"ROOM_TPU_{kind.upper()}_BASE", API_BASES[kind]
+        self.base = knobs.get_dynamic(
+            "ROOM_TPU_{KIND}_BASE", kind.upper(),
+            default=API_BASES[kind],
         )
 
     def is_ready(self) -> tuple[bool, str]:
@@ -185,8 +187,9 @@ class AnthropicProvider:
         self.name = "anthropic"
         self.model = model
         self._db = db
-        self.base = os.environ.get(
-            "ROOM_TPU_ANTHROPIC_BASE", API_BASES["anthropic"]
+        self.base = knobs.get_dynamic(
+            "ROOM_TPU_{KIND}_BASE", "ANTHROPIC",
+            default=API_BASES["anthropic"],
         )
 
     def is_ready(self) -> tuple[bool, str]:

@@ -27,10 +27,10 @@ nothing silently swallows failures.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 from ..db import Database
+from ..utils import knobs
 from .base import (
     ExecutionRequest, ExecutionResult, Provider, ProviderError,
 )
@@ -76,7 +76,7 @@ FALLBACK_ON_CRASH_ENV = "ROOM_TPU_FALLBACK_ON_CRASH"
 def fallback_models() -> list[str]:
     return [
         m.strip()
-        for m in os.environ.get(FALLBACK_ENV, "").split(",")
+        for m in (knobs.get_str(FALLBACK_ENV) or "").split(",")
         if m.strip()
     ]
 
@@ -86,9 +86,7 @@ def fallback_on_crash() -> bool:
     mid-turn but stayed within its restart budget) through the fallback
     chain. Default off: the primary already spent the turn's latency
     before crashing, so rerouting roughly doubles time-to-answer."""
-    return os.environ.get(FALLBACK_ON_CRASH_ENV, "").lower() in (
-        "1", "true", "yes", "on",
-    )
+    return knobs.get_bool(FALLBACK_ON_CRASH_ENV)
 
 
 def _is_crash_result(result: ExecutionResult) -> bool:

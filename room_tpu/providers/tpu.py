@@ -26,6 +26,7 @@ from typing import Callable, Optional
 from ..models import config as model_configs
 from ..models import qwen3
 from ..serving import faults
+from ..utils import knobs
 from ..serving import lifecycle as lifecycle_mod
 from ..serving.faults import FaultError
 from ..serving.kv_offload import offload_enabled_from_env
@@ -53,12 +54,12 @@ _draining = False
 def _random_init_allowed(name: str) -> bool:
     return (
         name.startswith("tiny")
-        or os.environ.get("ROOM_TPU_ALLOW_RANDOM_INIT") == "1"
+        or knobs.get_bool("ROOM_TPU_ALLOW_RANDOM_INIT")
     )
 
 
 def checkpoint_dir(name: str) -> Optional[str]:
-    base = os.environ.get("ROOM_TPU_CKPT_DIR")
+    base = knobs.get_str("ROOM_TPU_CKPT_DIR")
     if not base:
         return None
     path = os.path.join(base, name)
@@ -72,7 +73,8 @@ def _env_for(prefix: str, name: str) -> Optional[str]:
 
     slug = re.sub(r"[^A-Z0-9]", "_", name.upper())
     return (
-        os.environ.get(f"{prefix}_{slug}") or os.environ.get(prefix)
+        knobs.get_dynamic(prefix + "_{MODEL}", slug)
+        or knobs.get_raw(prefix)
     )
 
 
@@ -186,7 +188,7 @@ class ModelHost:
             )
             from ..serving import ServingEngine, load_tokenizer
 
-            moe_env = os.environ.get("ROOM_TPU_MOE_IMPL")
+            moe_env = knobs.get_str("ROOM_TPU_MOE_IMPL")
             if moe_env and self.cfg.is_moe:
                 import dataclasses
 
@@ -238,18 +240,21 @@ class ModelHost:
                 self.cfg,
                 params,
                 tokenizer=load_tokenizer(),
-                max_batch=int(os.environ.get("ROOM_TPU_MAX_BATCH", "8")),
-                page_size=int(os.environ.get("ROOM_TPU_PAGE_SIZE", "16")),
-                n_pages=int(os.environ.get("ROOM_TPU_N_PAGES", "2048")),
+                max_batch=knobs.get_int("ROOM_TPU_MAX_BATCH"),
+                page_size=knobs.get_int("ROOM_TPU_PAGE_SIZE"),
+                n_pages=knobs.get_int("ROOM_TPU_N_PAGES"),
                 mesh=mesh,
                 # speculative decoding ON by default in deployment
                 # (VERDICT r2 #8, from the bench spec_agent A/B: 3.1x
                 # tok/s at gamma=4 with 100% acceptance on tool-call-
                 # repeating agent traffic; a no-draft round falls back
                 # to the chunked scan, so non-repeating traffic pays
-                # nothing). ROOM_TPU_SPEC_TOKENS=0 opts out.
-                spec_tokens=int(
-                    os.environ.get("ROOM_TPU_SPEC_TOKENS", "4")
+                # nothing). ROOM_TPU_SPEC_TOKENS=0 opts out. The
+                # provider-on/library-off split is declared in the
+                # knob registry (provider_default=4 vs default=0),
+                # same convention as ROOM_TPU_OFFLOAD/LIFECYCLE.
+                spec_tokens=knobs.get_int(
+                    "ROOM_TPU_SPEC_TOKENS", scope="provider"
                 ),
                 # tiered KV offload ON by default in deployment
                 # (docs/kv_offload.md): the room workload parks every

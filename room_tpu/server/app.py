@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..db import Database, get_database
+from ..utils import knobs
 from .http import ApiServer
 from .runtime import (
     ServerRuntime, install_lifecycle_signal_handlers,
@@ -110,14 +111,14 @@ def start_server(
 
     # register our MCP server with installed AI clients (reference
     # registerMcpGlobally; never breaks startup)
-    if os.environ.get("ROOM_TPU_MCP_AUTOREGISTER", "1") != "0":
+    if knobs.get_bool("ROOM_TPU_MCP_AUTOREGISTER"):
         from ..mcp.autoregister import register_mcp_globally
 
         register_mcp_globally(db.path or "")
 
     get_update_checker().start()
     if static_dir is None:
-        static_dir = os.environ.get("ROOM_TPU_STATIC_DIR")
+        static_dir = knobs.get_str("ROOM_TPU_STATIC_DIR")
     if static_dir is None:
         bundled = os.path.join(
             os.path.dirname(
@@ -132,7 +133,7 @@ def start_server(
         runtime=runtime,
         port=port,
         static_dir=static_dir,
-        cloud_mode=os.environ.get("ROOM_TPU_DEPLOYMENT_MODE") == "cloud",
+        cloud_mode=knobs.get_str("ROOM_TPU_DEPLOYMENT_MODE") == "cloud",
     )
     api.start()
     app = ServerApp(db=db, runtime=runtime, api=api)

@@ -13,15 +13,13 @@ import os
 import secrets
 import time
 from typing import Optional
+from ..utils import knobs
 
 TOKENS_FILE = "auth.tokens.json"
 
 
 def data_dir() -> str:
-    d = os.environ.get(
-        "ROOM_TPU_DATA_DIR",
-        os.path.join(os.path.expanduser("~"), ".room_tpu"),
-    )
+    d = os.path.expanduser(knobs.get_str("ROOM_TPU_DATA_DIR"))
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -118,7 +116,7 @@ def validate_cloud_jwt(token: str) -> Optional[dict]:
     """Validate iss/aud/exp/nbf + instance binding against the deployment
     secret (reference: validateCloudJwt:106-165). Returns a member/user
     principal or None."""
-    secret = os.environ.get("ROOM_TPU_CLOUD_JWT_SECRET")
+    secret = knobs.get_str("ROOM_TPU_CLOUD_JWT_SECRET")
     if not secret or token.count(".") != 2:
         return None
     head_s, claims_s, sig_s = token.split(".")
@@ -151,7 +149,7 @@ def validate_cloud_jwt(token: str) -> Optional[dict]:
     sub = claims.get("sub")
     if not isinstance(sub, str) or not sub:
         return None
-    instance = os.environ.get("ROOM_TPU_INSTANCE_ID")
+    instance = knobs.get_str("ROOM_TPU_INSTANCE_ID")
     if instance and claims.get("instanceId") != instance:
         return None
     role = claims.get("role", "member")
@@ -170,5 +168,5 @@ def allowed_origin(origin: Optional[str], port: int) -> bool:
     }
     if origin in local:
         return True
-    extra = os.environ.get("ROOM_TPU_ALLOWED_ORIGINS", "")
+    extra = knobs.get_str("ROOM_TPU_ALLOWED_ORIGINS")
     return origin in {o.strip() for o in extra.split(",") if o.strip()}

@@ -15,6 +15,7 @@ from typing import Optional
 from ..core import messages as messages_mod
 from ..core.telemetry import get_machine_id
 from ..db import Database
+from ..utils import knobs
 
 HEARTBEAT_S = 5 * 60.0
 MESSAGE_SYNC_S = 60.0
@@ -22,7 +23,7 @@ TOKENS_FILE = "cloud-room-tokens.json"
 
 
 def cloud_api_base() -> Optional[str]:
-    return os.environ.get("ROOM_TPU_CLOUD_API")
+    return knobs.get_str("ROOM_TPU_CLOUD_API")
 
 
 def _tokens_path() -> str:

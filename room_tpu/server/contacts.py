@@ -26,6 +26,7 @@ from typing import Optional
 
 from ..core.messages import get_setting, set_setting
 from ..db import Database
+from ..utils import knobs
 
 EMAIL_CODE_TTL_MIN = 15
 EMAIL_RESEND_COOLDOWN_S = 60
@@ -104,7 +105,7 @@ def _hashes_equal(a: str, b: str) -> bool:
 
 def send_email(db: Database, to: str, subject: str, body: str) -> None:
     """Raises ApiError(502) when no transport is configured/working."""
-    outbox = os.environ.get("ROOM_TPU_EMAIL_OUTBOX")
+    outbox = knobs.get_str("ROOM_TPU_EMAIL_OUTBOX")
     if outbox:
         os.makedirs(outbox, exist_ok=True)
         name = f"{int(time.time() * 1000)}-{secrets.token_hex(4)}.json"
@@ -112,26 +113,24 @@ def send_email(db: Database, to: str, subject: str, body: str) -> None:
             json.dump({"to": to, "subject": subject, "body": body}, f)
         return
 
-    host = os.environ.get("ROOM_TPU_SMTP_HOST")
+    host = knobs.get_str("ROOM_TPU_SMTP_HOST")
     if host:
         import smtplib
         from email.message import EmailMessage
 
         msg = EmailMessage()
-        msg["From"] = os.environ.get(
-            "ROOM_TPU_SMTP_FROM", "clerk@room-tpu.local"
-        )
+        msg["From"] = knobs.get_str("ROOM_TPU_SMTP_FROM")
         msg["To"] = to
         msg["Subject"] = subject
         msg.set_content(body)
         try:
-            port = int(os.environ.get("ROOM_TPU_SMTP_PORT", "587"))
+            port = knobs.get_int("ROOM_TPU_SMTP_PORT")
             with smtplib.SMTP(host, port, timeout=12) as smtp:
                 smtp.starttls()
-                user = os.environ.get("ROOM_TPU_SMTP_USER")
+                user = knobs.get_str("ROOM_TPU_SMTP_USER")
                 if user:
                     smtp.login(
-                        user, os.environ.get("ROOM_TPU_SMTP_PASS", "")
+                        user, knobs.get_str("ROOM_TPU_SMTP_PASS")
                     )
                 smtp.send_message(msg)
             return
@@ -221,7 +220,7 @@ def verify_email_code(db: Database, code: str) -> dict:
 
 def telegram_bot_username() -> str:
     configured = (
-        os.environ.get("ROOM_TPU_TELEGRAM_BOT", "").strip().lstrip("@")
+        knobs.get_str("ROOM_TPU_TELEGRAM_BOT").strip().lstrip("@")
     )
     return configured or DEFAULT_TELEGRAM_BOT
 

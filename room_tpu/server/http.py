@@ -25,6 +25,7 @@ from .router import BadRequest, RequestContext, Router
 from .routes import register_all_routes
 from .webhooks import handle_webhook_request
 from .ws import WebSocketHub
+from ..utils import knobs
 
 RATE_LIMIT_GET_PER_MIN = 300
 RATE_LIMIT_WRITE_PER_MIN = 120
@@ -437,7 +438,7 @@ class ApiServer:
                 self.wfile.write(body)
 
         self._handler_cls = Handler
-        bind_host = os.environ.get("ROOM_TPU_BIND_HOST", host)
+        bind_host = knobs.get_str("ROOM_TPU_BIND_HOST", default=host)
         # explicit-port conflicts reclaim the port from a stale
         # instance, kill-and-retry up to 3 times (reference:
         # index.ts:944-962)

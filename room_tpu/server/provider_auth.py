@@ -8,7 +8,6 @@ machinery around `npm install -g <package>`).
 
 from __future__ import annotations
 
-import os
 import re
 import subprocess
 import threading
@@ -18,17 +17,18 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..providers.cli import _clean_env, resolve_cli_path
+from ..utils import knobs
 
 MAX_LINES = max(
-    50, int(os.environ.get("ROOM_TPU_PROVIDER_AUTH_MAX_LINES", "300"))
+    50, knobs.get_int("ROOM_TPU_PROVIDER_AUTH_MAX_LINES")
 )
 SESSION_TIMEOUT_S = max(
     30.0,
-    float(os.environ.get("ROOM_TPU_PROVIDER_AUTH_TIMEOUT_S", "900")),
+    knobs.get_float("ROOM_TPU_PROVIDER_AUTH_TIMEOUT_S"),
 )
 SESSION_TTL_S = max(
     60.0,
-    float(os.environ.get("ROOM_TPU_PROVIDER_AUTH_TTL_S", "7200")),
+    knobs.get_float("ROOM_TPU_PROVIDER_AUTH_TTL_S"),
 )
 
 _URL_RE = re.compile(r"https://\S+", re.IGNORECASE)
@@ -267,7 +267,7 @@ class ProviderInstallManager(ProviderAuthManager):
     def _command_for(self, provider: str) -> list[str]:
         import shutil
 
-        npm = os.environ.get("ROOM_TPU_NPM") or shutil.which("npm")
+        npm = knobs.get_str("ROOM_TPU_NPM") or shutil.which("npm")
         if not npm:
             raise FileNotFoundError(
                 "npm not found; install Node.js to install provider "

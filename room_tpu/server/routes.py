@@ -28,6 +28,7 @@ from ..core import (
 from ..core.cycle_logs import get_cycle_logs
 from ..providers import get_model_auth_status
 from .router import RequestContext, Router, err, ok
+from ..utils import knobs
 
 
 def _room_or_404(ctx: RequestContext):
@@ -187,7 +188,7 @@ def register_openai_routes(r: Router) -> None:
             }]
         created = int(time_mod.time())
         cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
-        timeout_s = float(os.environ.get("ROOM_TPU_V1_TIMEOUT_S", "600"))
+        timeout_s = knobs.get_float("ROOM_TPU_V1_TIMEOUT_S")
         finish_map = {"stop": "stop", "length": "length",
                       "tool_call": "tool_calls"}
 
@@ -1112,7 +1113,7 @@ def register_aux_routes(r: Router) -> None:
 
         # member POSTs never reach here: access.py whitelists exclude
         # /api/invites, so only agent/user tokens can mint
-        secret = _os.environ.get("ROOM_TPU_CLOUD_JWT_SECRET")
+        secret = knobs.get_str("ROOM_TPU_CLOUD_JWT_SECRET")
         if not secret:
             return err(
                 "set ROOM_TPU_CLOUD_JWT_SECRET to enable invites", 503
@@ -1129,7 +1130,7 @@ def register_aux_routes(r: Router) -> None:
             "sub": f"invite-{_secrets.token_hex(8)}",
             "exp": _time.time() + days * 86400,
         }
-        instance = _os.environ.get("ROOM_TPU_INSTANCE_ID")
+        instance = knobs.get_str("ROOM_TPU_INSTANCE_ID")
         if instance:
             claims["instanceId"] = instance
         return ok({

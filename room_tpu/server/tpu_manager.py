@@ -12,7 +12,6 @@ host gate + one-click install session, re-targeted at TPU serving):
 
 from __future__ import annotations
 
-import os
 import shutil
 import threading
 import time
@@ -23,6 +22,7 @@ from ..core.events import event_bus
 from ..core.messages import set_setting
 from ..db import Database, utc_now
 from ..providers.tpu import MODEL_CONFIGS, checkpoint_dir, get_model_host
+from ..utils import knobs
 
 MIN_HOST_RAM_GB = 8
 MIN_FREE_DISK_GB = 10
@@ -80,7 +80,7 @@ def configured_kv_quant() -> Optional[str]:
     ValueError) without importing the jax-heavy serving stack into the
     status gate — a value the engine would refuse must fail the gate,
     not read as bf16 and pass it."""
-    mode = os.environ.get("ROOM_TPU_KV_QUANT", "").strip() or None
+    mode = knobs.get_str("ROOM_TPU_KV_QUANT").strip() or None
     if mode not in (None, "int8"):
         raise ValueError(f"unknown ROOM_TPU_KV_QUANT {mode!r}")
     return mode
@@ -91,8 +91,8 @@ def configured_kv_tokens() -> int:
     reads the same env vars) — the status gate must plan with this, not
     the planner's 131k default, or a deployment tuned to a smaller pool
     reads as not fitting."""
-    return int(os.environ.get("ROOM_TPU_N_PAGES", "2048")) * \
-        int(os.environ.get("ROOM_TPU_PAGE_SIZE", "16"))
+    return knobs.get_int("ROOM_TPU_N_PAGES") * \
+        knobs.get_int("ROOM_TPU_PAGE_SIZE")
 
 
 def plan_placement(
@@ -236,7 +236,7 @@ def get_tpu_status(model: str = "qwen3-coder-30b") -> dict:
     ckpt = checkpoint_dir(model)
     check(
         "weights",
-        bool(ckpt) or os.environ.get("ROOM_TPU_ALLOW_RANDOM_INIT") == "1"
+        bool(ckpt) or knobs.get_bool("ROOM_TPU_ALLOW_RANDOM_INIT")
         or model.startswith("tiny"),
         ckpt or "no checkpoint (set ROOM_TPU_CKPT_DIR or allow "
         "random init)",

@@ -29,6 +29,7 @@ import urllib.request
 from typing import Any, Callable, Optional
 
 from .. import __version__
+from ..utils import knobs
 
 DEFAULT_POLL_S = 4 * 3600.0
 INITIAL_DELAY_S = 15.0
@@ -39,10 +40,7 @@ CRASH_ROLLBACK_THRESHOLD = 3
 
 
 def data_dir() -> str:
-    return os.environ.get(
-        "ROOM_TPU_DATA_DIR",
-        os.path.join(os.path.expanduser("~"), ".room_tpu"),
-    )
+    return os.path.expanduser(knobs.get_str("ROOM_TPU_DATA_DIR"))
 
 
 def app_dir() -> str:
@@ -101,16 +99,16 @@ class UpdateChecker:
     # -- sources --
 
     def _cloud_source(self) -> Optional[dict]:
-        url = (os.environ.get("ROOM_TPU_UPDATE_SOURCE_URL")
+        url = (knobs.get_str("ROOM_TPU_UPDATE_SOURCE_URL")
                or "").strip()
         if not url:
             return None
-        token = (os.environ.get("ROOM_TPU_UPDATE_SOURCE_TOKEN")
+        token = (knobs.get_str("ROOM_TPU_UPDATE_SOURCE_TOKEN")
                  or "").strip() or None
         return {"url": url, "token": token}
 
     def _github_repo(self) -> Optional[str]:
-        return (os.environ.get("ROOM_TPU_UPDATE_GITHUB_REPO")
+        return (knobs.get_str("ROOM_TPU_UPDATE_GITHUB_REPO")
                 or "").strip() or None
 
     def _fetch_json(self, url: str,

@@ -16,6 +16,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..utils import knobs
+
 _host_lock = threading.Lock()
 _host: Optional["EmbedHost"] = None
 
@@ -30,11 +32,11 @@ class EmbedHost:
         from ..models.config import minilm_384, tiny_encoder
         from .tokenizer import load_tokenizer
 
-        use_real = bool(os.environ.get("ROOM_TPU_EMBED_CKPT"))
+        use_real = bool(knobs.get_str("ROOM_TPU_EMBED_CKPT"))
         self.cfg = minilm_384() if use_real else tiny_encoder()
         self.tokenizer = load_tokenizer()
         params = embedder.init_params(self.cfg, jax.random.PRNGKey(7))
-        ckpt = os.environ.get("ROOM_TPU_EMBED_CKPT")
+        ckpt = knobs.get_str("ROOM_TPU_EMBED_CKPT")
         if ckpt and os.path.isdir(ckpt):
             from ..utils.checkpoint import load_params
 
@@ -147,15 +149,19 @@ class DeviceEmbedIndex:
     ) -> list[tuple[int, float]]:
         import jax
 
+        # snapshot under the lock, compute + materialize OUTSIDE it:
+        # jax arrays are immutable, so concurrent rebuild() just swaps
+        # the references — and the device matmul + host sync no longer
+        # stall every reader on this lock (roomlint sync-under-lock)
         with self._lock:
             if not self._ids:
                 return []
-            q = np.asarray(query, np.float32)
-            q = q / max(float(np.linalg.norm(q)), 1e-9)
-            sims = self._matrix @ self._jnp.asarray(q)
-            k_eff = min(k, len(self._ids))
-            vals, idx = jax.lax.top_k(sims, k_eff)
-            return [
-                (self._ids[int(i)], float(v))
-                for v, i in zip(np.asarray(vals), np.asarray(idx))
-            ]
+            matrix, ids = self._matrix, list(self._ids)
+        q = np.asarray(query, np.float32)
+        q = q / max(float(np.linalg.norm(q)), 1e-9)
+        sims = matrix @ self._jnp.asarray(q)
+        vals, idx = jax.lax.top_k(sims, min(k, len(ids)))
+        return [
+            (ids[int(i)], float(v))
+            for v, i in zip(np.asarray(vals), np.asarray(idx))
+        ]

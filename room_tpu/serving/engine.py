@@ -42,6 +42,7 @@ import numpy as np
 
 from ..models import qwen3
 from ..models.config import DecoderConfig
+from ..utils import knobs
 from . import faults
 from .faults import FaultError
 from .kv_offload import TieredKVStore, offload_enabled_from_env
@@ -278,16 +279,14 @@ class ServingEngine:
         # drain every iteration). ROOM_TPU_DECODE_CHUNK is honored as
         # a back-compat alias.
         env_steps = (
-            os.environ.get("ROOM_TPU_DECODE_STEPS_PER_DISPATCH")
-            or os.environ.get("ROOM_TPU_DECODE_CHUNK")
+            knobs.get_raw("ROOM_TPU_DECODE_STEPS_PER_DISPATCH")
+            or knobs.get_raw("ROOM_TPU_DECODE_CHUNK")
         )
         self.steps_per_dispatch = max(1, int(env_steps)) if env_steps \
             else 4
         # long prompts prefill in chunks of this width (0 disables):
         # bounds compile widths + prefill activation memory at 32k ctx
-        self.prefill_chunk = int(
-            os.environ.get("ROOM_TPU_PREFILL_CHUNK", "2048")
-        )
+        self.prefill_chunk = knobs.get_int("ROOM_TPU_PREFILL_CHUNK")
         # ---- SLO-aware scheduler (scheduler.py, docs/scheduler.md) ----
         # interleaved chunked prefill: long prompts are written
         # ROOM_TPU_PREFILL_CHUNK_PAGES-page chunks ACROSS scheduler
@@ -313,7 +312,7 @@ class ServingEngine:
         # (providers/tpu.ModelHost) defaults to gamma=4, chosen from
         # the bench A/B (VERDICT r2 #8).
         self.spec_tokens = spec_tokens if spec_tokens is not None else \
-            int(os.environ.get("ROOM_TPU_SPEC_TOKENS", "0"))
+            knobs.get_int("ROOM_TPU_SPEC_TOKENS")
         # Adaptive speculation gate (spec-acceptance study, round 5):
         # the verify forward runs at fixed [max_batch, gamma+1] shape,
         # so muting individual rows saves nothing — the decision is
@@ -327,16 +326,9 @@ class ServingEngine:
         # alpha/cooldown = 0.1/16 from the replay sweep (ROUND5.md §3):
         # worst class (prose on 30b-moe bs8) recovers 0.75x -> 0.98x
         # while code at bs32 keeps its full 2.34x
-        self.spec_ema_alpha = float(
-            os.environ.get("ROOM_TPU_SPEC_EMA", "0.1")
-        )
-        self.spec_cooldown_len = int(
-            os.environ.get("ROOM_TPU_SPEC_COOLDOWN", "16")
-        )
-        env_floor = os.environ.get("ROOM_TPU_SPEC_MIN_ACCEPT")
-        self.spec_min_accept = (
-            float(env_floor) if env_floor is not None else None
-        )
+        self.spec_ema_alpha = knobs.get_float("ROOM_TPU_SPEC_EMA")
+        self.spec_cooldown_len = knobs.get_int("ROOM_TPU_SPEC_COOLDOWN")
+        self.spec_min_accept = knobs.get_float("ROOM_TPU_SPEC_MIN_ACCEPT")
         # the profitability gate's cost model runs against the chip the
         # engine actually landed on (ADVICE r5: the hard-coded V5E
         # mis-calibrated the threshold on other generations; CPU runs
@@ -356,38 +348,24 @@ class ServingEngine:
         # ---- robustness knobs (chaos layer; docs/chaos.md) ----
         # default per-turn deadline in seconds (0 disables); submit()
         # callers can set a per-request deadline_s on top
-        self.turn_deadline_s = float(
-            os.environ.get("ROOM_TPU_TURN_DEADLINE_S", "0")
-        )
+        self.turn_deadline_s = knobs.get_float("ROOM_TPU_TURN_DEADLINE_S")
         # a decode/verify device round slower than this counts as a
         # stall: its sessions are parked + requeued (KV retained) and
         # the ladder notes pressure. Generous default — first calls pay
         # jit compiles, and a false stall only costs a requeue.
-        self.step_stall_s = float(
-            os.environ.get("ROOM_TPU_STEP_STALL_S", "120")
-        )
+        self.step_stall_s = knobs.get_float("ROOM_TPU_STEP_STALL_S")
         # park+requeue budget per turn before it just rides out slowness
-        self.max_requeues = int(
-            os.environ.get("ROOM_TPU_MAX_REQUEUES", "3")
-        )
+        self.max_requeues = knobs.get_int("ROOM_TPU_MAX_REQUEUES")
         # transient-fault retry-with-backoff bounds (device-call sites)
-        self.fault_retries = int(
-            os.environ.get("ROOM_TPU_FAULT_RETRIES", "3")
-        )
-        self.retry_backoff_s = float(
-            os.environ.get("ROOM_TPU_RETRY_BACKOFF_S", "0.05")
-        )
+        self.fault_retries = knobs.get_int("ROOM_TPU_FAULT_RETRIES")
+        self.retry_backoff_s = knobs.get_float("ROOM_TPU_RETRY_BACKOFF_S")
         # degradation ladder: pressure events (stalls, pool exhaustion,
         # prefill faults, crashes) within the sliding window map to a
         # level: >=t1 -> 1 (spec decode off), >=t2 -> 2 (cold sessions
         # offloaded to host/disk), >=t3 -> 3 (admission batch halved),
         # >=t4 -> 4 (lowest-priority queued turns shed w/ 503)
-        self.degrade_window_s = float(
-            os.environ.get("ROOM_TPU_DEGRADE_WINDOW_S", "30")
-        )
-        thresholds = os.environ.get(
-            "ROOM_TPU_DEGRADE_THRESHOLDS", "2,4,6,12"
-        )
+        self.degrade_window_s = knobs.get_float("ROOM_TPU_DEGRADE_WINDOW_S")
+        thresholds = knobs.get_str("ROOM_TPU_DEGRADE_THRESHOLDS")
         self.degrade_thresholds = tuple(
             int(x) for x in thresholds.split(",")
         )
@@ -407,8 +385,8 @@ class ServingEngine:
         # engine-thread supervision: crashes within the window beyond
         # this budget mark the engine unhealthy (fail-closed: the
         # provider registry then falls back)
-        self.max_crash_restarts = int(
-            os.environ.get("ROOM_TPU_ENGINE_MAX_RESTARTS", "3")
+        self.max_crash_restarts = knobs.get_int(
+            "ROOM_TPU_ENGINE_MAX_RESTARTS"
         )
         self._crash_times: deque = deque(maxlen=64)
         self.healthy = True
@@ -421,18 +399,10 @@ class ServingEngine:
         # deployment path (providers/tpu.ModelHost) defaults ON.
         self.offload_enabled = offload if offload is not None \
             else offload_enabled_from_env()
-        self.offload_low_wm = float(
-            os.environ.get("ROOM_TPU_OFFLOAD_LOW_WM", "0.25")
-        )
-        self.offload_high_wm = float(
-            os.environ.get("ROOM_TPU_OFFLOAD_HIGH_WM", "0.5")
-        )
-        self.offload_on_park = os.environ.get(
-            "ROOM_TPU_OFFLOAD_ON_PARK", "1"
-        ) != "0"
-        self.offload_prefetch = int(
-            os.environ.get("ROOM_TPU_OFFLOAD_PREFETCH", "2")
-        )
+        self.offload_low_wm = knobs.get_float("ROOM_TPU_OFFLOAD_LOW_WM")
+        self.offload_high_wm = knobs.get_float("ROOM_TPU_OFFLOAD_HIGH_WM")
+        self.offload_on_park = knobs.get_bool("ROOM_TPU_OFFLOAD_ON_PARK")
+        self.offload_prefetch = knobs.get_int("ROOM_TPU_OFFLOAD_PREFETCH")
         self.offload_store: Optional[TieredKVStore] = \
             TieredKVStore() if self.offload_enabled else None
 
@@ -567,8 +537,8 @@ class ServingEngine:
         self._counts: Optional[jax.Array] = None
         # automatic prefix caching (0 disables; value = min prefix
         # pages worth sharing)
-        self.prefix_cache_min_pages = int(
-            os.environ.get("ROOM_TPU_PREFIX_CACHE_PAGES", "2")
+        self.prefix_cache_min_pages = knobs.get_int(
+            "ROOM_TPU_PREFIX_CACHE_PAGES"
         )
         self._prefix_cache: dict[tuple, _PrefixEntry] = {}
         self._lock = threading.Lock()
@@ -2623,6 +2593,7 @@ class ServingEngine:
         prev, self._inflight = self._inflight, None
         return self._drain_window(prev) if prev is not None else 0
 
+    # roomlint: region=dispatch-window
     def _dispatch_window(self, active_idx: list[int]) -> Optional[dict]:
         """Reserve pages and launch one decode window (non-blocking:
         the jitted call returns futures). Returns the window record the

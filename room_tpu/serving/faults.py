@@ -56,11 +56,12 @@ engine thread rolls the dice.
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
+
+from ..utils import knobs
 
 __all__ = [
     "FaultError", "FaultSpec", "FAULT_POINTS", "inject", "clear",
@@ -170,7 +171,7 @@ def configure_from_env(env: Optional[str] = None) -> None:
     permanent (non-transient). Unknown names raise so a typo in a
     chaos-staging deployment is loud, not silently inert."""
     spec_str = env if env is not None else \
-        os.environ.get("ROOM_TPU_FAULTS", "")
+        knobs.get_str("ROOM_TPU_FAULTS")
     for part in filter(None, (s.strip() for s in spec_str.split(";"))):
         name, _, args = part.partition(":")
         kw: dict = {}
@@ -284,5 +285,5 @@ def snapshot() -> dict[str, dict]:
 
 
 # a chaos-staging deployment arms faults for the whole process lifetime
-if os.environ.get("ROOM_TPU_FAULTS"):
+if knobs.get_str("ROOM_TPU_FAULTS"):
     configure_from_env()

@@ -56,6 +56,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..utils import knobs
+
 __all__ = [
     "OffloadEntry", "TieredKVStore", "offload_enabled_from_env",
     "RESTORE_HIST_BUCKETS_MS",
@@ -68,9 +70,7 @@ RESTORE_HIST_BUCKETS_MS = (1.0, 5.0, 20.0, 100.0, 500.0)
 
 
 def offload_enabled_from_env(default: str = "0") -> bool:
-    return os.environ.get("ROOM_TPU_OFFLOAD", default).strip() not in (
-        "0", "", "off", "false",
-    )
+    return knobs.get_bool("ROOM_TPU_OFFLOAD", default=default)
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -216,17 +216,17 @@ class TieredKVStore:
     ) -> None:
         mb = 1024 * 1024
         if host_bytes_cap is None:
-            host_bytes_cap = int(float(
-                os.environ.get("ROOM_TPU_OFFLOAD_HOST_MB", "512")
-            ) * mb)
+            host_bytes_cap = int(
+                knobs.get_float("ROOM_TPU_OFFLOAD_HOST_MB") * mb
+            )
         if disk_bytes_cap is None:
-            disk_bytes_cap = int(float(
-                os.environ.get("ROOM_TPU_OFFLOAD_DISK_MB", "2048")
-            ) * mb)
+            disk_bytes_cap = int(
+                knobs.get_float("ROOM_TPU_OFFLOAD_DISK_MB") * mb
+            )
         self.host_bytes_cap = host_bytes_cap
         self.disk_bytes_cap = disk_bytes_cap
         self._spool_dir = spool_dir or \
-            os.environ.get("ROOM_TPU_OFFLOAD_DIR") or None
+            knobs.get_str("ROOM_TPU_OFFLOAD_DIR") or None
         self._own_spool = self._spool_dir is None
         # a SHARED spool dir (env/arg — the durable deployment shape,
         # docs/lifecycle.md) accumulates files from processes that died

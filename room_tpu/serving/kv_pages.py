@@ -22,6 +22,7 @@ import jax.numpy as jnp  # noqa: F401  (used in jit-side helpers)
 
 from ..models.config import DecoderConfig
 from ..ops import attention_ref
+from ..utils import knobs
 
 Params = dict[str, Any]
 
@@ -31,9 +32,7 @@ def kv_quant_mode() -> Optional[str]:
     pages as int8 with one f32 scale per (token, kv-head) — ~49% of the
     bf16 pool's HBM bytes AND decode-attention read traffic, the
     dominant cost at long context. None (default) keeps bf16 pages."""
-    import os
-
-    mode = os.environ.get("ROOM_TPU_KV_QUANT", "").strip() or None
+    mode = knobs.get_str("ROOM_TPU_KV_QUANT").strip() or None
     if mode not in (None, "int8"):
         raise ValueError(f"unknown ROOM_TPU_KV_QUANT {mode!r}")
     return mode
@@ -71,9 +70,7 @@ def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 def use_pallas_kernel() -> bool:
     """Decode attention backend selection: the Pallas kernel on TPU when
     ROOM_TPU_PAGED_KERNEL=pallas, XLA gather reference otherwise."""
-    import os
-
-    mode = os.environ.get("ROOM_TPU_PAGED_KERNEL", "auto")
+    mode = knobs.get_str("ROOM_TPU_PAGED_KERNEL")
     if mode == "pallas":
         return True
     if mode == "xla":
@@ -94,9 +91,7 @@ def _probe_gate(
 ) -> bool:
     """Shared kernel-gating scaffold: env force (on|off), else a
     one-shot compile + numerics probe cached per shape."""
-    import os
-
-    mode = os.environ.get(env_var, "auto")
+    mode = knobs.get_str(env_var)
     if mode == "on":
         return True
     if mode == "off":

@@ -56,6 +56,7 @@ import time
 from typing import Iterable, Optional
 
 from . import faults
+from ..utils import knobs
 from .faults import FaultError
 
 __all__ = [
@@ -75,9 +76,7 @@ SPOOL_SUFFIX = ".kvspool"
 
 
 def lifecycle_enabled_from_env(default: str = "0") -> bool:
-    return os.environ.get("ROOM_TPU_LIFECYCLE", default).strip() not in (
-        "0", "", "off", "false",
-    )
+    return knobs.get_bool("ROOM_TPU_LIFECYCLE", default=default)
 
 
 def lifecycle_root() -> str:
@@ -86,7 +85,7 @@ def lifecycle_root() -> str:
     case this subsystem exists for), without writing to $HOME from
     library code. Deployments that need reboot durability point
     ROOM_TPU_LIFECYCLE_DIR at a real volume."""
-    return os.environ.get("ROOM_TPU_LIFECYCLE_DIR") or os.path.join(
+    return knobs.get_str("ROOM_TPU_LIFECYCLE_DIR") or os.path.join(
         tempfile.gettempdir(), "room_tpu_lifecycle"
     )
 
@@ -102,16 +101,14 @@ def engine_dir(model_name: str) -> str:
 
 def drain_deadline_s() -> float:
     try:
-        return float(os.environ.get("ROOM_TPU_DRAIN_DEADLINE_S", "30"))
+        return knobs.get_float("ROOM_TPU_DRAIN_DEADLINE_S")
     except ValueError:
         return 30.0
 
 
 def sweep_age_s() -> float:
     try:
-        return float(
-            os.environ.get("ROOM_TPU_SPOOL_SWEEP_AGE_S", "3600")
-        )
+        return knobs.get_float("ROOM_TPU_SPOOL_SWEEP_AGE_S")
     except ValueError:
         return 3600.0
 

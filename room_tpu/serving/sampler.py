@@ -3,11 +3,12 @@ knobs folded into the compiled step."""
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+
+from ..utils import knobs
 
 # Greedy tie band: logits within this distance of the row max count as
 # tied, and the LOWEST index wins. The band is RELATIVE to the max's
@@ -19,7 +20,7 @@ import jax.numpy as jnp
 # 1e-6 relative stays ~2x above per-ULP noise at every scale while
 # remaining far below any gap that reflects a real model decision.
 # Read once at import — it participates in compiled programs.
-GREEDY_TIE_EPS = float(os.environ.get("ROOM_TPU_GREEDY_TIE_EPS", "1e-6"))
+GREEDY_TIE_EPS = knobs.get_float("ROOM_TPU_GREEDY_TIE_EPS")
 
 
 def greedy_argmax(logits: jax.Array) -> jax.Array:

@@ -42,12 +42,13 @@ that lock.
 from __future__ import annotations
 
 import heapq
-import os
 import queue as queue_mod
 import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Optional
+
+from ..utils import knobs
 
 __all__ = [
     "TURN_CLASSES", "CLASS_RANK", "DEFAULT_CLASS", "ClassTargets",
@@ -104,7 +105,7 @@ def class_targets_from_env(
     ``queen=2:0.1;worker=8:0.25;background=30:1``. Unknown classes and
     malformed entries raise (a typo'd SLO config must be loud)."""
     spec = env if env is not None else \
-        os.environ.get("ROOM_TPU_CLASS_TARGETS", "")
+        knobs.get_str("ROOM_TPU_CLASS_TARGETS")
     out = dict(DEFAULT_TARGETS)
     for part in filter(None, (s.strip() for s in spec.split(";"))):
         name, _, vals = part.partition("=")
@@ -131,7 +132,7 @@ def class_chunks_from_env(env: Optional[str] = None) -> dict[str, int]:
     ``class=n`` per-step chunk budgets. Clamped to >= 1: a zero budget
     would park a class's prefills forever."""
     spec = env if env is not None else \
-        os.environ.get("ROOM_TPU_CLASS_CHUNKS", "")
+        knobs.get_str("ROOM_TPU_CLASS_CHUNKS")
     out = dict(DEFAULT_CHUNKS)
     for part in filter(None, (s.strip() for s in spec.split(";"))):
         name, _, val = part.partition("=")
@@ -145,14 +146,12 @@ def class_chunks_from_env(env: Optional[str] = None) -> dict[str, int]:
     return out
 
 
-def chunk_pages_from_env(default: int = 16) -> int:
+def chunk_pages_from_env() -> int:
     """``ROOM_TPU_PREFILL_CHUNK_PAGES``: width of an interleaved
-    prefill chunk, in KV pages. 0 disables interleaving (monolithic
-    admission-time prefill, the pre-scheduler behavior)."""
-    raw = os.environ.get("ROOM_TPU_PREFILL_CHUNK_PAGES")
-    if raw is None:
-        return default
-    return max(0, int(raw))
+    prefill chunk, in KV pages (registry default 16). 0 disables
+    interleaving (monolithic admission-time prefill, the
+    pre-scheduler behavior)."""
+    return max(0, knobs.get_int("ROOM_TPU_PREFILL_CHUNK_PAGES"))
 
 
 class _ClassStats:

@@ -17,6 +17,8 @@ import json
 import os
 from typing import Optional, Protocol
 
+from ..utils import knobs
+
 
 class Tokenizer(Protocol):
     vocab_size: int
@@ -105,7 +107,7 @@ class HFTokenizer:
 
 
 def load_tokenizer(path: Optional[str] = None) -> Tokenizer:
-    path = path or os.environ.get("ROOM_TPU_TOKENIZER_PATH")
+    path = path or knobs.get_str("ROOM_TPU_TOKENIZER_PATH")
     if path and os.path.isdir(path):
         return HFTokenizer(path)
     return ByteTokenizer()

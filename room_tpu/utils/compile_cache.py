@@ -20,15 +20,17 @@ import os
 import threading
 from typing import Optional
 
+from . import knobs
+
 _lock = threading.Lock()
 _configured: Optional[tuple[Optional[str], int]] = None
 
 
 def cache_dir_from_env() -> Optional[str]:
-    raw = os.environ.get(
+    raw = knobs.get_str(
         "ROOM_TPU_JAX_CACHE",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                       "/tmp/room_tpu_jax_cache"),
+        default=os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               "/tmp/room_tpu_jax_cache"),
     ).strip()
     if raw.lower() in ("", "0", "off", "none"):
         return None
@@ -46,7 +48,7 @@ def enable_compile_cache() -> tuple[Optional[str], int]:
     with _lock:
         if _configured is not None:
             return _configured
-        explicit = "ROOM_TPU_JAX_CACHE" in os.environ
+        explicit = knobs.is_set("ROOM_TPU_JAX_CACHE")
         path = cache_dir_from_env()
         result: tuple[Optional[str], int] = (None, 0)
         try:

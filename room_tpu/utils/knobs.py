@@ -1,0 +1,610 @@
+"""Central registry for every ``ROOM_TPU_*`` configuration knob.
+
+This module is the ONLY sanctioned way to read a ``ROOM_TPU_*``
+environment variable from library code — roomlint
+(``python -m room_tpu.analysis``, rule ``knob-raw-env-read``) flags raw
+``os.environ`` reads of the namespace anywhere else under ``room_tpu/``.
+Registering here is what makes a knob exist: the registry carries the
+name, type, default, one-line doc, and scope, and ``docs/knobs.md`` is
+GENERATED from it (``python -m room_tpu.analysis --write-docs``), so a
+knob can no longer ship undocumented or drift between a call site's
+inline default and the docs.
+
+Scopes
+------
+``library``
+    Read by engine/serving/core library code. The registered ``default``
+    applies when the env var is unset.
+``provider``
+    Same knob, but the production deployment path
+    (``providers/tpu.ModelHost``) applies ``provider_default`` instead —
+    the documented provider-on / library-off convention that
+    ``ROOM_TPU_OFFLOAD``, ``ROOM_TPU_LIFECYCLE`` and (as of this
+    registry) ``ROOM_TPU_SPEC_TOKENS`` share. Call sites on the
+    deployment path pass ``scope="provider"`` to the typed getters.
+``server`` / ``swarm`` / ``bench`` / ``test-seam``
+    Documentation grouping only; resolution is identical to ``library``.
+
+Typed getters re-read ``os.environ`` on every call (tests monkeypatch
+env vars and re-construct engines), so nothing is cached here.
+
+Boolean semantics are standardized: unset -> registered default; a set
+value is false iff it strips/lowers to one of ``"", "0", "off",
+"false", "no"``. The handful of legacy sites that compared ``== "1"``
+or ``!= "0"`` now share this one rule.
+
+Dynamic families (per-model / per-provider names such as
+``ROOM_TPU_MESH_<SLUG>`` or ``ROOM_TPU_CLAUDE_CLI``) are registered as
+patterns with ``{PLACEHOLDER}`` segments and resolved through
+``get_dynamic`` — an f-string fed straight to ``os.environ`` is a lint
+violation, a registered pattern is not.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = [
+    "Knob", "REGISTRY", "DYNAMIC", "register", "register_dynamic",
+    "get_raw", "get_str", "get_int", "get_float", "get_bool",
+    "is_set", "get_dynamic", "all_knobs", "resolve_default",
+    "FALSEY",
+]
+
+# standardized false spellings for bool knobs (after strip().lower())
+FALSEY = ("", "0", "off", "false", "no")
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered configuration knob.
+
+    ``default`` is the raw env-string default (``None`` = unset, the
+    knob is optional); ``provider_default`` is the deployment-path
+    default when the provider/library split applies (None = same as
+    ``default``). ``type`` is documentation + getter intent: one of
+    ``str``/``int``/``float``/``bool``/``path``/``list``/``secret``.
+    """
+
+    name: str
+    type: str
+    default: Optional[str]
+    doc: str
+    scope: str = "library"
+    provider_default: Optional[str] = None
+    choices: Optional[tuple] = None
+
+
+REGISTRY: dict[str, Knob] = {}
+# pattern -> Knob, e.g. "ROOM_TPU_MESH_{MODEL}"
+DYNAMIC: dict[str, Knob] = {}
+
+_TYPES = ("str", "int", "float", "bool", "path", "list", "secret")
+_SCOPES = ("library", "provider", "server", "swarm", "bench",
+           "test-seam")
+
+
+def register(
+    name: str,
+    type: str,
+    default: Optional[str],
+    doc: str,
+    scope: str = "library",
+    provider_default: Optional[str] = None,
+    choices: Optional[tuple] = None,
+) -> Knob:
+    if not name.startswith("ROOM_TPU_"):
+        raise ValueError(f"knob {name!r} outside the ROOM_TPU_ namespace")
+    if type not in _TYPES:
+        raise ValueError(f"knob {name!r}: unknown type {type!r}")
+    if scope not in _SCOPES:
+        raise ValueError(f"knob {name!r}: unknown scope {scope!r}")
+    if name in REGISTRY:
+        raise ValueError(f"knob {name!r} registered twice")
+    if not doc.strip():
+        raise ValueError(f"knob {name!r} registered without a doc line")
+    knob = Knob(name, type, default, doc, scope, provider_default, choices)
+    REGISTRY[name] = knob
+    return knob
+
+
+def register_dynamic(
+    pattern: str,
+    type: str,
+    default: Optional[str],
+    doc: str,
+    scope: str = "library",
+) -> Knob:
+    """Register a family of knobs whose concrete names carry a runtime
+    part, e.g. ``ROOM_TPU_MESH_{MODEL}``."""
+    if "{" not in pattern:
+        raise ValueError(f"dynamic knob {pattern!r} has no placeholder")
+    if pattern in DYNAMIC:
+        raise ValueError(f"dynamic knob {pattern!r} registered twice")
+    knob = Knob(pattern, type, default, doc, scope)
+    DYNAMIC[pattern] = knob
+    return knob
+
+
+class _Unset:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "<registered default>"
+
+
+_UNSET = _Unset()
+
+
+def _lookup(name: str) -> Knob:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"unregistered knob {name!r}: add it to "
+            "room_tpu/utils/knobs.py (roomlint rule knob-unregistered)"
+        )
+    return knob
+
+
+def resolve_default(name: str, scope: Optional[str] = None) -> Optional[str]:
+    """Registered default for a knob under the given scope: the
+    provider scope prefers ``provider_default`` when declared."""
+    knob = _lookup(name)
+    if scope == "provider" and knob.provider_default is not None:
+        return knob.provider_default
+    return knob.default
+
+
+def get_raw(
+    name: str,
+    default=_UNSET,
+    scope: Optional[str] = None,
+) -> Optional[str]:
+    """The raw env string for a registered knob: the live env value,
+    else the explicit call-site ``default`` (for the few contextual
+    defaults, e.g. ``ROOM_TPU_BIND_HOST`` falling back to the
+    configured host), else the registered (scope-resolved) default."""
+    val = os.environ.get(name)
+    if val is not None:
+        return val
+    if not isinstance(default, _Unset):
+        _lookup(name)  # even explicit-default reads must be registered
+        return default
+    return resolve_default(name, scope)
+
+
+def get_str(name: str, default=_UNSET, scope: Optional[str] = None
+            ) -> Optional[str]:
+    return get_raw(name, default, scope)
+
+
+def get_int(name: str, default=_UNSET, scope: Optional[str] = None
+            ) -> Optional[int]:
+    raw = get_raw(name, default, scope)
+    if raw is None or isinstance(raw, int):
+        return raw
+    return int(str(raw).strip())
+
+
+def get_float(name: str, default=_UNSET, scope: Optional[str] = None
+              ) -> Optional[float]:
+    raw = get_raw(name, default, scope)
+    if raw is None or isinstance(raw, float):
+        return raw
+    return float(str(raw).strip())
+
+
+def get_bool(name: str, default=_UNSET, scope: Optional[str] = None
+             ) -> bool:
+    raw = get_raw(name, default, scope)
+    if raw is None or isinstance(raw, bool):
+        return bool(raw)
+    return str(raw).strip().lower() not in FALSEY
+
+
+def is_set(name: str) -> bool:
+    """Whether the env var is explicitly present (registered knobs
+    only) — for sites where an explicit empty/zero value means
+    something different from unset (e.g. ``ROOM_TPU_JAX_CACHE=0``)."""
+    _lookup(name)
+    return name in os.environ
+
+
+def get_dynamic(pattern: str, *parts: str, default: Optional[str] = None
+                ) -> Optional[str]:
+    """Resolve one member of a registered dynamic family:
+    ``get_dynamic("ROOM_TPU_MESH_{MODEL}", "QWEN3_30B")`` reads
+    ``ROOM_TPU_MESH_QWEN3_30B``. Placeholders are substituted left to
+    right with ``parts``."""
+    knob = DYNAMIC.get(pattern)
+    if knob is None:
+        raise KeyError(
+            f"unregistered dynamic knob family {pattern!r}: add it to "
+            "room_tpu/utils/knobs.py (roomlint rule knob-unregistered)"
+        )
+    name = pattern
+    for part in parts:
+        name = re.sub(r"\{[A-Za-z_]+\}", part, name, count=1)
+    if "{" in name:
+        raise ValueError(
+            f"dynamic knob {pattern!r}: unresolved placeholder in {name!r}"
+        )
+    val = os.environ.get(name)
+    if val is not None:
+        return val
+    if default is not None:
+        return default
+    return knob.default
+
+
+def all_knobs() -> dict[str, Knob]:
+    """Static + dynamic registry, for the doc generator and roomlint."""
+    out = dict(REGISTRY)
+    out.update(DYNAMIC)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The registry. Grouped by subsystem; docs/knobs.md mirrors these groups.
+# Keep doc lines to one sentence — the generator renders them verbatim.
+# ---------------------------------------------------------------------------
+
+# ---- serving engine: decode pipeline + prefill (docs/serving.md) ----
+register("ROOM_TPU_DECODE_STEPS_PER_DISPATCH", "int", None,
+         "Tokens decoded per device dispatch (multi-step pipeline window "
+         "depth); unset -> 4, 1 = legacy step-at-a-time.")
+register("ROOM_TPU_DECODE_CHUNK", "int", None,
+         "Back-compat alias for ROOM_TPU_DECODE_STEPS_PER_DISPATCH "
+         "(honored when the primary is unset).")
+register("ROOM_TPU_PREFILL_CHUNK", "int", "2048",
+         "Compile-width cap for chunked prefill in tokens (0 disables "
+         "chunking).")
+register("ROOM_TPU_PREFILL_CHUNK_PAGES", "int", "16",
+         "Width in KV pages of one interleaved scheduler prefill chunk "
+         "(0 = monolithic admission-time prefill).")
+register("ROOM_TPU_PREFIX_CACHE_PAGES", "int", "2",
+         "Minimum shared-prefix length in pages before a prefix-cache "
+         "entry is published.")
+register("ROOM_TPU_GREEDY_TIE_EPS", "float", "1e-6",
+         "Logit-tie epsilon for greedy sampling (argmax determinism "
+         "guard across backends).")
+
+# ---- speculative decoding (docs/serving.md, ROUND5.md) ----
+register("ROOM_TPU_SPEC_TOKENS", "int", "0",
+         "Draft tokens proposed per speculative round (gamma); 0 "
+         "disables speculation.",
+         scope="provider", provider_default="4")
+register("ROOM_TPU_SPEC_EMA", "float", "0.1",
+         "EMA alpha for per-row speculative acceptance tracking.")
+register("ROOM_TPU_SPEC_COOLDOWN", "int", "16",
+         "Plain-decode tokens per row after an unprofitable speculative "
+         "round before the next probe round.")
+register("ROOM_TPU_SPEC_MIN_ACCEPT", "float", None,
+         "Explicit acceptance-EMA floor for the speculation gate "
+         "(unset = roofline cost-ratio gate).")
+
+# ---- serving engine: robustness / chaos (docs/chaos.md) ----
+register("ROOM_TPU_TURN_DEADLINE_S", "float", "0",
+         "Default per-turn deadline in seconds (0 disables; submit() "
+         "can set a per-request deadline on top).")
+register("ROOM_TPU_STEP_STALL_S", "float", "120",
+         "Decode-round duration that counts as a stall and triggers "
+         "park+requeue of its sessions.")
+register("ROOM_TPU_MAX_REQUEUES", "int", "3",
+         "Stall-watchdog park+requeue budget per turn before it rides "
+         "out the slowness.")
+register("ROOM_TPU_FAULT_RETRIES", "int", "3",
+         "Bounded retries for transient faults at device-call sites.")
+register("ROOM_TPU_RETRY_BACKOFF_S", "float", "0.05",
+         "Initial exponential-backoff delay for transient-fault "
+         "retries.")
+register("ROOM_TPU_DEGRADE_WINDOW_S", "float", "30",
+         "Sliding window over pressure events that drives the "
+         "degradation ladder.")
+register("ROOM_TPU_DEGRADE_THRESHOLDS", "list", "2,4,6,12",
+         "Four comma-separated pressure-event counts mapping to ladder "
+         "rungs 1-4.")
+register("ROOM_TPU_ENGINE_MAX_RESTARTS", "int", "3",
+         "Engine-thread crash restarts inside the window before the "
+         "engine is marked unhealthy (fail-closed).")
+register("ROOM_TPU_FAULTS", "str", "",
+         "Chaos fault-arming spec, ';'-separated "
+         "name[:k=v,...] entries (docs/chaos.md).",
+         scope="test-seam")
+
+# ---- tiered KV offload (docs/kv_offload.md) ----
+register("ROOM_TPU_OFFLOAD", "bool", "0",
+         "Enable tiered KV offload (host RAM + disk spool) for cold "
+         "sessions.",
+         scope="provider", provider_default="1")
+register("ROOM_TPU_OFFLOAD_HOST_MB", "float", "512",
+         "Host-RAM tier cap in MB for offloaded KV pages.")
+register("ROOM_TPU_OFFLOAD_DISK_MB", "float", "2048",
+         "Disk-spool tier cap in MB (0 disables the disk tier).")
+register("ROOM_TPU_OFFLOAD_DIR", "path", None,
+         "KV spool directory (default: a per-process temp dir).")
+register("ROOM_TPU_OFFLOAD_LOW_WM", "float", "0.25",
+         "Free-page fraction below which the offload sweep starts "
+         "hibernating cold sessions.")
+register("ROOM_TPU_OFFLOAD_HIGH_WM", "float", "0.5",
+         "Free-page fraction at which the sweep stops / restores.")
+register("ROOM_TPU_OFFLOAD_ON_PARK", "bool", "1",
+         "Offload a session's pages immediately on tool-call park.")
+register("ROOM_TPU_OFFLOAD_PREFETCH", "int", "2",
+         "Queued-session restores started per scheduler step "
+         "(prefetch-on-queue).")
+
+# ---- process lifecycle (docs/lifecycle.md) ----
+register("ROOM_TPU_LIFECYCLE", "bool", "0",
+         "Enable graceful drain to a manifest on SIGTERM and warm "
+         "restore on boot.",
+         scope="provider", provider_default="1")
+register("ROOM_TPU_LIFECYCLE_DIR", "path", None,
+         "Durable lifecycle state root (default: a stable dir under "
+         "the system temp dir).")
+register("ROOM_TPU_DRAIN_DEADLINE_S", "float", "30",
+         "Budget in seconds for the whole graceful-drain path.")
+register("ROOM_TPU_SPOOL_SWEEP_AGE_S", "float", "3600",
+         "Orphan spool files older than this are swept at store "
+         "construction.")
+
+# ---- SLO scheduler (docs/scheduler.md) ----
+register("ROOM_TPU_CLASS_TARGETS", "str", "",
+         "Per-class SLO targets, ';'-separated "
+         "class=ttft_s:tpot_ms entries.")
+register("ROOM_TPU_CLASS_CHUNKS", "str", "",
+         "Per-class interleaved-prefill chunk budgets per decode "
+         "window, ';'-separated class=n entries.")
+
+# ---- kernels / quantization (docs/ARCHITECTURE.md) ----
+register("ROOM_TPU_KV_QUANT", "str", "",
+         "KV-cache quantization mode: 'int8' stores pages int8 with "
+         "f32 scales, empty keeps bf16.",
+         choices=("", "int8"))
+register("ROOM_TPU_QUANT", "str", None,
+         "Weight quantization mode ('int8' serves int8 weight-only).",
+         choices=("int8",))
+register("ROOM_TPU_PAGED_KERNEL", "str", "auto",
+         "Decode attention backend: pallas | xla | auto (Pallas on "
+         "TPU).",
+         choices=("pallas", "xla", "auto"))
+register("ROOM_TPU_PREFILL_KERNEL", "str", "auto",
+         "S>1 Pallas prefill kernel gate: on | off | auto (one-shot "
+         "compile+numerics probe).",
+         choices=("on", "off", "auto"))
+register("ROOM_TPU_PAGED_INT8_KERNEL", "str", "auto",
+         "int8-KV decode kernel gate: on | off | auto (probe).",
+         choices=("on", "off", "auto"))
+register("ROOM_TPU_PREFILL_INT8_KERNEL", "str", "auto",
+         "int8-KV S>1 prefill kernel gate: on | off | auto (probe).",
+         choices=("on", "off", "auto"))
+register("ROOM_TPU_MOE_IMPL", "str", None,
+         "MoE dispatch implementation: ragged | gshard | shardmap.",
+         choices=("ragged", "gshard", "shardmap"))
+
+# ---- provider deployment path (providers/tpu.py) ----
+register("ROOM_TPU_CKPT_DIR", "path", None,
+         "Checkpoint root; each served model loads from "
+         "<dir>/<model-name>.",
+         scope="provider")
+register("ROOM_TPU_ALLOW_RANDOM_INIT", "bool", "0",
+         "Allow synthetic (random-init) weights when no checkpoint "
+         "exists — dev/test only.",
+         scope="provider")
+register("ROOM_TPU_MAX_BATCH", "int", "8",
+         "Decode batch rows for deployment-path engines.",
+         scope="provider")
+register("ROOM_TPU_PAGE_SIZE", "int", "16",
+         "KV page size in tokens for deployment-path engines.",
+         scope="provider")
+register("ROOM_TPU_N_PAGES", "int", "2048",
+         "KV page-pool size for deployment-path engines.",
+         scope="provider")
+register("ROOM_TPU_MESH", "str", None,
+         "Global device-mesh spec 'dp,pp,tp[@start]' for served "
+         "models.",
+         scope="provider")
+register_dynamic("ROOM_TPU_MESH_{MODEL}", "str", None,
+                 "Per-model mesh override (slug = model name "
+                 "uppercased, non-alnum -> '_'); wins over "
+                 "ROOM_TPU_MESH.",
+                 scope="provider")
+register_dynamic("ROOM_TPU_QUANT_{MODEL}", "str", None,
+                 "Per-model weight-quantization override; wins over "
+                 "ROOM_TPU_QUANT.",
+                 scope="provider")
+register("ROOM_TPU_TOKENIZER_PATH", "path", None,
+         "HF tokenizer directory (unset = hermetic byte-level "
+         "tokenizer).",
+         scope="provider")
+register("ROOM_TPU_EMBED_CKPT", "path", None,
+         "Embedder checkpoint; unset serves the hash-projection "
+         "fallback embedder.",
+         scope="provider")
+register("ROOM_TPU_FALLBACK_MODELS", "list", "",
+         "Comma-separated fallback provider chain for unhealthy tpu: "
+         "engines.",
+         scope="provider")
+register("ROOM_TPU_FALLBACK_ON_CRASH", "bool", "0",
+         "Also reroute crash-failed results (within the restart "
+         "budget) through the fallback chain.",
+         scope="provider")
+register_dynamic("ROOM_TPU_{PROVIDER}_CLI", "path", None,
+                 "CLI binary override per CLI provider (e.g. "
+                 "ROOM_TPU_CLAUDE_CLI); the test seam for subprocess "
+                 "providers.",
+                 scope="test-seam")
+register_dynamic("ROOM_TPU_{KIND}_BASE", "str", None,
+                 "API base-URL override per HTTP provider (e.g. "
+                 "ROOM_TPU_OPENAI_BASE, ROOM_TPU_ANTHROPIC_BASE).",
+                 scope="server")
+
+# ---- multihost / parallel (parallel/multihost.py) ----
+register("ROOM_TPU_COORDINATOR", "str", None,
+         "host:port of process 0 for multihost jax.distributed "
+         "initialization.")
+register("ROOM_TPU_NUM_PROCESSES", "int", None,
+         "Multihost world size.")
+register("ROOM_TPU_PROCESS_ID", "int", None,
+         "This process's multihost rank.")
+register("ROOM_TPU_DCN_TIMEOUT_S", "float", None,
+         "Coordinator barrier timeout for multihost startup.")
+
+# ---- utils: caches, profiling ----
+register("ROOM_TPU_JAX_CACHE", "path", None,
+         "Persistent XLA compile-cache dir (default "
+         "/tmp/room_tpu_jax_cache; '0'/'off' disables).")
+register("ROOM_TPU_PROFILE_SLOW_MS", "float", "500",
+         "HTTP endpoint latency above this is logged as slow.")
+register("ROOM_TPU_PROFILE_HTTP", "bool", "0",
+         "Enable per-endpoint HTTP latency profiling.")
+register("ROOM_TPU_TRACE_DIR", "path", None,
+         "jax.profiler trace output dir (unset disables tracing).")
+
+# ---- server / HTTP / cloud ----
+register("ROOM_TPU_DATA_DIR", "path", "~/.room_tpu",
+         "Data root for DB, auth tokens, prompts and app state.",
+         scope="server")
+register("ROOM_TPU_DB_PATH", "path", None,
+         "Explicit SQLite database path; wins over ROOM_TPU_DATA_DIR.",
+         scope="server")
+register("ROOM_TPU_BIND_HOST", "str", None,
+         "Overrides the HTTP server bind address.", scope="server")
+register("ROOM_TPU_STATIC_DIR", "path", None,
+         "Dashboard static-asset dir override.", scope="server")
+register("ROOM_TPU_DEPLOYMENT_MODE", "str", None,
+         "'cloud' enables cloud deployment mode.", scope="server")
+register("ROOM_TPU_MCP_AUTOREGISTER", "bool", "1",
+         "Autoregister the MCP server into detected agent CLI "
+         "configs at startup.", scope="server")
+register("ROOM_TPU_V1_TIMEOUT_S", "float", "600",
+         "Per-request execution timeout for /v1/chat/completions.",
+         scope="server")
+register("ROOM_TPU_ALLOWED_ORIGINS", "list", "",
+         "Extra comma-separated allowed CORS origins.", scope="server")
+register("ROOM_TPU_CLOUD_JWT_SECRET", "secret", None,
+         "HS256 secret for cloud invite JWTs (unset disables "
+         "invites).", scope="server")
+register("ROOM_TPU_INSTANCE_ID", "str", None,
+         "Instance identifier embedded in cloud invite tokens.",
+         scope="server")
+register("ROOM_TPU_CLOUD_API", "str", None,
+         "Cloud relay API base URL.", scope="server")
+register("ROOM_TPU_SECRET_KEY", "secret", None,
+         "Seed for the at-rest secret-encryption key.", scope="server")
+register("ROOM_TPU_TELEMETRY_TOKEN", "secret", None,
+         "Bearer token for heartbeat telemetry (unset disables "
+         "telemetry).", scope="server")
+register("ROOM_TPU_TELEMETRY_URL", "str", None,
+         "Heartbeat telemetry endpoint URL.", scope="server")
+register("ROOM_TPU_UPDATE_SOURCE_URL", "str", None,
+         "Self-update metadata endpoint.", scope="server")
+register("ROOM_TPU_UPDATE_SOURCE_TOKEN", "secret", None,
+         "Bearer token for the self-update endpoint.", scope="server")
+register("ROOM_TPU_UPDATE_GITHUB_REPO", "str", None,
+         "GitHub repo ('owner/name') for release-API self-updates.",
+         scope="server")
+register("ROOM_TPU_EMAIL_OUTBOX", "path", None,
+         "File-outbox dir for the email transport (also the test "
+         "seam).", scope="test-seam")
+register("ROOM_TPU_SMTP_HOST", "str", None,
+         "SMTP relay host for outbound email.", scope="server")
+register("ROOM_TPU_SMTP_PORT", "int", "587",
+         "SMTP relay port.", scope="server")
+register("ROOM_TPU_SMTP_USER", "str", None,
+         "SMTP auth user (unset = unauthenticated relay).",
+         scope="server")
+register("ROOM_TPU_SMTP_PASS", "secret", "",
+         "SMTP auth password.", scope="server")
+register("ROOM_TPU_SMTP_FROM", "str", "clerk@room-tpu.local",
+         "From address for outbound email.", scope="server")
+register("ROOM_TPU_TELEGRAM_BOT", "str", "",
+         "Telegram bot username for contact deep links.",
+         scope="server")
+register("ROOM_TPU_NPM", "path", None,
+         "npm binary override for provider-auth installs.",
+         scope="server")
+register("ROOM_TPU_PROVIDER_AUTH_MAX_LINES", "int", "300",
+         "Captured output lines kept per provider-auth session.",
+         scope="server")
+register("ROOM_TPU_PROVIDER_AUTH_TIMEOUT_S", "float", "900",
+         "Idle timeout for an interactive provider-auth session.",
+         scope="server")
+register("ROOM_TPU_PROVIDER_AUTH_TTL_S", "float", "7200",
+         "Hard lifetime cap for a provider-auth session.",
+         scope="server")
+register_dynamic("ROOM_TPU_RPC_{CHAIN}", "str", None,
+                 "JSON-RPC endpoint override per wallet chain (e.g. "
+                 "ROOM_TPU_RPC_BASE).",
+                 scope="server")
+
+# ---- swarm runtime (docs/swarm_recovery.md) ----
+register("ROOM_TPU_LOOP_MAX_RESTARTS", "int", "3",
+         "Loop-thread restarts inside the window before a worker is "
+         "marked unhealthy.", scope="swarm")
+register("ROOM_TPU_LOOP_RESTART_WINDOW_S", "float", "300",
+         "Sliding window for the loop restart budget.", scope="swarm")
+register("ROOM_TPU_LOOP_HANG_S", "float", "1800",
+         "Heartbeat age after which a running agent loop counts as "
+         "hung.", scope="swarm")
+register("ROOM_TPU_REPLAY_WINDOW_S", "float", "21600",
+         "How long a recovery-flagged journal effect stays skippable.",
+         scope="swarm")
+register("ROOM_TPU_JOURNAL_PRUNE_H", "float", "72",
+         "Terminal journal rows older than this many hours are "
+         "pruned.", scope="swarm")
+
+# ---- bench / tuning harness (bench.py, scripts/) ----
+register("ROOM_TPU_BENCH_TINY", "bool", "0",
+         "CPU smoke profile for the bench harness.", scope="bench")
+register("ROOM_TPU_BENCH_CPU_PROXY", "bool", "0",
+         "CPU-proxy bench tier: tiny model on the virtual mesh with "
+         "real relative deltas.", scope="bench")
+register("ROOM_TPU_BENCH_WATCHDOG_S", "float", "1500",
+         "Bench phase watchdog budget.", scope="bench")
+register("ROOM_TPU_BENCH_PHASES", "path", None,
+         "Per-phase JSONL output path (default ./BENCH_PHASES.jsonl).",
+         scope="bench")
+register("ROOM_TPU_BENCH_BATCH", "int", "32",
+         "Decode batch rows for bench runs.", scope="bench")
+register("ROOM_TPU_BENCH_GREEDY", "bool", "0",
+         "Force greedy sampling in bench decode phases.",
+         scope="bench")
+register("ROOM_TPU_BENCH_CTX", "list", None,
+         "Comma-separated prefill context lengths for the prefill "
+         "phase.", scope="bench")
+register("ROOM_TPU_BENCH_BG_CTX", "int", None,
+         "Background prefill length for the scheduler stall A/B.",
+         scope="bench")
+register("ROOM_TPU_BENCH_CHUNK_PAGES", "int", None,
+         "Chunk width for the scheduler bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_SPEC", "bool", "1",
+         "Run the speculative-decode bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_PREFILL", "bool", "1",
+         "Run the prefill bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_LATENCY", "bool", "1",
+         "Run the latency bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_OFFLOAD", "bool", "1",
+         "Run the KV-offload bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_RESTART", "bool", "1",
+         "Run the warm-restart bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_PIPELINE", "bool", "1",
+         "Run the decode-pipeline steps=1 vs steps=4 A/B phase.",
+         scope="bench")
+register("ROOM_TPU_BENCH_SCHED", "bool", "1",
+         "Run the scheduler bench phase.", scope="bench")
+register("ROOM_TPU_BENCH_KVQ", "bool", "1",
+         "Run the int8-KV bench variant.", scope="bench")
+register("ROOM_TPU_PEAK_TFLOPS", "float", "197",
+         "Accelerator peak TFLOPs for roofline normalization.",
+         scope="bench")
+register("ROOM_TPU_CHIP_LOCK", "path", None,
+         "Lock-file path serializing real-chip bench runs.",
+         scope="bench")
+register("ROOM_TPU_CHIP_LOCK_WAIT_S", "float", "300",
+         "How long a bench run waits on the chip lock.",
+         scope="bench")
+register("ROOM_TPU_TUNE_GRID", "str", None,
+         "Parameter grid for scripts/tpu_tune.py "
+         "(e.g. 'chunk=8,16;batch=8').", scope="bench")

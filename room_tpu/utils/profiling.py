@@ -15,9 +15,10 @@ import re
 import threading
 import time
 from contextlib import contextmanager
+from . import knobs
 from typing import Iterator
 
-SLOW_MS = float(os.environ.get("ROOM_TPU_PROFILE_SLOW_MS", "500"))
+SLOW_MS = knobs.get_float("ROOM_TPU_PROFILE_SLOW_MS")
 
 _ID_SEG = re.compile(r"/\d+")
 # opaque ids/secrets: webhook tokens, session ids, uuids — any long
@@ -27,7 +28,7 @@ MAX_KEYS = 512
 
 
 def http_profiling_enabled() -> bool:
-    return os.environ.get("ROOM_TPU_PROFILE_HTTP") == "1"
+    return knobs.get_bool("ROOM_TPU_PROFILE_HTTP")
 
 
 def normalize_path(path: str) -> str:
@@ -78,7 +79,7 @@ def device_trace(name: str = "room-tpu") -> Iterator[None]:
     data dir); open the output with TensorBoard or xprof."""
     import jax
 
-    base = os.environ.get("ROOM_TPU_TRACE_DIR")
+    base = knobs.get_str("ROOM_TPU_TRACE_DIR")
     if not base:
         from ..server.auth import data_dir
 

@@ -1,0 +1,29 @@
+"""Fixture: recovery code dispatching on fault message substrings
+(fault-substring-dispatch) and arming an unknown fault point
+(fault-point-unknown)."""
+
+from room_tpu.serving import faults
+
+
+def bad_substring_dispatch(fn):
+    try:
+        return fn()
+    except RuntimeError as e:
+        if "decode_window" in str(e):      # VIOLATION
+            return "window"
+        if "injected fault" in e.args[0]:  # VIOLATION
+            return "injected"
+        raise
+
+
+def good_typed_dispatch(fn):
+    try:
+        return fn()
+    except RuntimeError as e:
+        if getattr(e, "point", None) == "decode_window":  # sanctioned
+            return "window"
+        raise
+
+
+def bad_unknown_point():
+    faults.maybe_fail("decode_widnow")     # VIOLATION (typo'd point)

@@ -1,0 +1,35 @@
+"""Fixture: every spelling of a raw ROOM_TPU_* env read roomlint must
+flag (knob-raw-env-read), plus an unregistered-knob accessor call
+(knob-unregistered). Never imported — parsed by tests/test_analysis.py.
+"""
+
+import os
+import os as _os
+
+from room_tpu.utils import knobs
+
+
+def get_reads():
+    a = os.environ.get("ROOM_TPU_MAX_BATCH", "8")          # .get
+    b = os.environ["ROOM_TPU_PAGE_SIZE"]                   # subscript
+    c = os.getenv("ROOM_TPU_N_PAGES")                      # getenv
+    d = "ROOM_TPU_JAX_CACHE" in os.environ                 # contains
+    e = _os.environ.get("ROOM_TPU_SPEC_TOKENS")            # aliased os
+    return a, b, c, d, e
+
+
+def fstring_family(provider: str):
+    return os.environ.get(f"ROOM_TPU_{provider.upper()}_CLI")
+
+
+def unregistered():
+    return knobs.get_int("ROOM_TPU_NOT_A_REAL_KNOB")
+
+
+def unregistered_family(kind: str):
+    return knobs.get_dynamic("ROOM_TPU_{NOPE}_FAKE", kind)
+
+
+def allowed_read():
+    # the inline escape hatch must keep working
+    return os.environ.get("ROOM_TPU_FAULTS")  # roomlint: allow[knob-raw-env-read]

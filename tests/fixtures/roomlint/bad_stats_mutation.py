@@ -1,0 +1,42 @@
+"""Fixture: direct self._stats mutations outside _bump
+(stats-outside-bump) and blocking syncs under a lock / inside a
+marked dispatch-window region (sync-under-lock,
+sync-in-dispatch-window)."""
+
+import threading
+
+import jax
+import numpy as np
+
+
+class FakeEngine:
+    def __init__(self):
+        self._stats = {"tokens": 0}
+        self._lock = threading.Lock()
+
+    def _bump(self, key, n=1):
+        with self._lock:
+            self._stats[key] += n          # sanctioned: inside _bump
+
+    def bad_direct_increment(self):
+        self._stats["tokens"] += 1         # VIOLATION
+
+    def bad_plain_assign(self, n):
+        self._stats["tokens"] = n          # VIOLATION
+
+    def bad_sync_under_lock(self, arr):
+        with self._lock:
+            return np.asarray(arr)         # VIOLATION
+
+    def bad_block_under_lock(self, arr):
+        with self._lock:
+            arr.block_until_ready()        # VIOLATION
+            return jax.device_get(arr)     # VIOLATION
+
+    # roomlint: region=dispatch-window
+    def bad_sync_in_window(self, ring):
+        host = np.asarray(ring)            # VIOLATION (in region)
+        return host
+
+    def ok_sync_outside(self, ring):
+        return np.asarray(ring)            # fine: no lock, no region

@@ -1,0 +1,47 @@
+"""Fixture: idiomatic code that must produce ZERO roomlint violations
+(the no-false-positive pass)."""
+
+import os
+import threading
+
+import numpy as np
+
+from room_tpu.serving import faults
+from room_tpu.utils import knobs
+
+# non-ROOM_TPU env reads are out of scope
+HOME = os.environ.get("HOME", "/root")
+PATH = os.getenv("PATH")
+
+
+class CleanEngine:
+    def __init__(self):
+        self.max_batch = knobs.get_int("ROOM_TPU_MAX_BATCH")
+        self.offload = knobs.get_bool("ROOM_TPU_OFFLOAD",
+                                      scope="provider")
+        self.mesh = knobs.get_dynamic("ROOM_TPU_MESH_{MODEL}", "TINY")
+        self._stats = {"tokens": 0}        # initialization is fine
+        self._lock = threading.Lock()
+
+    def _bump(self, key, n=1):
+        with self._lock:
+            self._stats[key] += n
+
+    def step(self):
+        faults.maybe_fail("decode_step")
+        self._bump("tokens")
+
+    def drain(self, ring):
+        # host sync outside any lock/region is the sanctioned pattern
+        host = np.asarray(ring)
+        with self._lock:
+            snapshot = dict(self._stats)
+        return host, snapshot
+
+    def recover(self, fn):
+        try:
+            return fn()
+        except RuntimeError as e:
+            if getattr(e, "point", None) == "decode_window":
+                return None
+            raise

@@ -1,0 +1,315 @@
+"""roomlint (room_tpu.analysis) — the static-analysis suite's own
+tests: each checker fires on its seeded fixture violations, the clean
+fixture stays clean, the generated docs/knobs.md round-trips against
+the registry, suppressions work both ways, and the real tree passes
+the same gate CI enforces (docs/static_analysis.md)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import pytest
+
+from room_tpu import analysis
+from room_tpu.analysis import (
+    dispatch_checker, fault_checker, knob_checker, knobs_doc,
+    lock_checker,
+)
+from room_tpu.analysis.common import (
+    SourceFile, apply_suppressions, load_suppressions,
+)
+from room_tpu.utils import knobs
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "fixtures" / "roomlint"
+FAULT_POINTS = fault_checker.load_fault_points(str(REPO))
+
+
+def _src(name: str) -> SourceFile:
+    path = FIXTURES / name
+    return SourceFile(str(path), rel=os.path.relpath(path, REPO))
+
+
+def _rules(violations) -> list[str]:
+    return sorted(v.rule for v in violations)
+
+
+# ---- checker 1: knob discipline ---------------------------------------
+
+def test_knob_checker_flags_every_raw_read_spelling():
+    out = knob_checker.check_source(_src("bad_knob_read.py"))
+    raw = [v for v in out if v.rule == "knob-raw-env-read"]
+    # .get / subscript / getenv / contains / aliased-os / f-string
+    assert len(raw) == 6, [v.render() for v in out]
+    lines = {v.line for v in raw}
+    assert len(lines) == 6  # six distinct seeded sites
+
+
+def test_knob_checker_flags_unregistered_names():
+    out = knob_checker.check_source(_src("bad_knob_read.py"))
+    unreg = [v for v in out if v.rule == "knob-unregistered"]
+    msgs = " ".join(v.message for v in unreg)
+    assert len(unreg) == 2
+    assert "ROOM_TPU_NOT_A_REAL_KNOB" in msgs
+    assert "ROOM_TPU_{NOPE}_FAKE" in msgs
+
+
+def test_inline_allow_is_honored():
+    out = knob_checker.check_source(_src("bad_knob_read.py"))
+    flagged_lines = {v.line for v in out}
+    src = _src("bad_knob_read.py")
+    allow_line = next(
+        i + 1 for i, ln in enumerate(src.lines)
+        if "allow[knob-raw-env-read]" in ln
+    )
+    assert allow_line not in flagged_lines
+
+
+def test_registry_module_itself_is_exempt():
+    src = SourceFile(str(REPO / "room_tpu" / "utils" / "knobs.py"),
+                     rel=os.path.join("room_tpu", "utils", "knobs.py"))
+    assert knob_checker.check_source(src) == []
+
+
+# ---- checker 2: lock/stats + host-sync discipline ---------------------
+
+def test_lock_checker_flags_seeded_violations():
+    out = lock_checker.check_source(_src("bad_stats_mutation.py"))
+    by_rule = {}
+    for v in out:
+        by_rule.setdefault(v.rule, []).append(v)
+    assert len(by_rule["stats-outside-bump"]) == 2
+    assert len(by_rule["sync-under-lock"]) == 3
+    assert len(by_rule["sync-in-dispatch-window"]) == 1
+    # _bump itself is sanctioned
+    assert all("_bump" not in v.qualname.split(".")[-1]
+               for v in by_rule["stats-outside-bump"])
+
+
+# ---- checkers 3+4: fault coverage and dispatch ------------------------
+
+def test_dispatch_checker_flags_substring_matching():
+    out = dispatch_checker.check_dispatch(
+        _src("bad_fault_dispatch.py"), FAULT_POINTS
+    )
+    assert len(out) == 2
+    assert all(v.rule == "fault-substring-dispatch" for v in out)
+
+
+def test_fault_checker_flags_unknown_point_arms():
+    out = fault_checker.check_arm_sites(
+        _src("bad_fault_dispatch.py"), FAULT_POINTS
+    )
+    assert _rules(out) == ["fault-point-unknown"]
+    assert "decode_widnow" in out[0].message
+
+
+def test_fault_coverage_cross_check_on_real_tree():
+    """Every FAULT_POINTS entry is armed by some test file (the FULL
+    test mapping — decode_window lives in test_decode_pipeline.py,
+    shutdown_io in test_lifecycle.py, both also in the chaos suite
+    now) and documented in docs/chaos.md."""
+    out = fault_checker.check_coverage(str(REPO))
+    assert out == [], [v.render() for v in out]
+
+
+def test_fault_coverage_detects_untested_points(tmp_path):
+    empty_tests = tmp_path / "tests"
+    empty_tests.mkdir()
+    out = fault_checker.check_coverage(
+        str(REPO), tests_dir=str(empty_tests)
+    )
+    untested = {v.message.split("'")[1] for v in out
+                if v.rule == "fault-point-untested"}
+    assert untested == set(FAULT_POINTS)
+
+
+def test_chaos_doc_drift_detected(tmp_path):
+    doc = tmp_path / "chaos.md"
+    doc.write_text("| `kv_alloc` | x | y |\n| `not_a_point` | x | y |\n")
+    out = fault_checker.check_coverage(
+        str(REPO), tests_dir="tests", doc_path=str(doc)
+    )
+    rules = _rules(out)
+    assert "fault-point-undocumented" in rules  # 15 missing rows
+    assert "fault-point-unknown" in rules       # not_a_point
+
+
+# ---- clean fixture: no false positives --------------------------------
+
+def test_clean_fixture_has_zero_violations():
+    src = _src("clean_module.py")
+    out = analysis.check_file(src, FAULT_POINTS)
+    assert out == [], [v.render() for v in out]
+
+
+# ---- knobs registry + generated docs round trip -----------------------
+
+def test_generated_knobs_doc_is_fresh():
+    """docs/knobs.md must be exactly what the registry generates —
+    the same check CI runs (--check-docs)."""
+    assert knobs_doc.is_fresh(str(REPO / "docs" / "knobs.md")), (
+        "docs/knobs.md is stale: run "
+        "`python -m room_tpu.analysis --write-docs`"
+    )
+
+
+def test_doc_drift_detected(tmp_path):
+    stale = tmp_path / "knobs.md"
+    text = knobs_doc.render().replace(
+        "| `ROOM_TPU_MAX_BATCH` | int | `8` |",
+        "| `ROOM_TPU_MAX_BATCH` | int | `32` |",
+    )
+    stale.write_text(text)
+    out = knob_checker.check_docs(str(stale))
+    assert any(v.rule == "knob-doc-drift"
+               and "ROOM_TPU_MAX_BATCH" in v.message for v in out)
+
+
+def test_missing_and_unknown_doc_rows(tmp_path):
+    doc = tmp_path / "knobs.md"
+    doc.write_text("| `ROOM_TPU_BOGUS` | str | `x` | | made up |\n")
+    out = knob_checker.check_docs(str(doc))
+    rules = set(_rules(out))
+    assert "knob-undocumented" in rules
+    assert "knob-unknown-doc" in rules
+
+
+def test_every_registered_knob_has_doc_and_valid_shape():
+    for knob in knobs.all_knobs().values():
+        assert knob.doc.strip(), knob.name
+        assert knob.name.startswith("ROOM_TPU_")
+        if knob.provider_default is not None:
+            assert knob.provider_default != knob.default, (
+                f"{knob.name}: provider_default equal to default is "
+                "redundant — drop it"
+            )
+
+
+# ---- suppression mechanics --------------------------------------------
+
+def test_suppression_file_round_trip(tmp_path):
+    sup = tmp_path / ".roomlint.suppress"
+    sup.write_text(
+        "stats-outside-bump  tests/fixtures/roomlint/"
+        "bad_stats_mutation.py  *  # fixture\n"
+        "knob-raw-env-read  room_tpu/never/matches.py  *  # stale\n"
+    )
+    entries = load_suppressions(str(sup))
+    violations = lock_checker.check_source(_src("bad_stats_mutation.py"))
+    active, suppressed = apply_suppressions(
+        violations, entries, ".roomlint.suppress"
+    )
+    assert all(v.rule != "stats-outside-bump" for v in active)
+    assert len(suppressed) == 2
+    # the never-matching entry surfaces as suppression-unused
+    assert any(v.rule == "suppression-unused" for v in active)
+
+
+def test_suppression_without_reason_rejected(tmp_path):
+    sup = tmp_path / "s"
+    sup.write_text("knob-raw-env-read  a.py  *\n")
+    with pytest.raises(ValueError, match="reason"):
+        load_suppressions(str(sup))
+
+
+# ---- the real gate ----------------------------------------------------
+
+def test_tree_is_clean_under_roomlint():
+    """The acceptance gate: zero unsuppressed violations on the tree,
+    exactly what `python -m room_tpu.analysis` / CI enforces."""
+    active, suppressed = analysis.run_checks(str(REPO))
+    assert active == [], [v.render() for v in active]
+    # the suppression file is small and every entry earns its keep
+    assert 0 < len(suppressed) < 20
+
+
+def test_cli_exits_nonzero_on_fixture_violations():
+    from room_tpu.analysis.__main__ import main
+
+    rc = main([
+        str(FIXTURES / "bad_knob_read.py"),
+        "--repo-root", str(REPO), "--no-cross-checks",
+        "--suppress", os.devnull,
+    ])
+    assert rc == 1
+    rc_clean = main([
+        str(FIXTURES / "clean_module.py"),
+        "--repo-root", str(REPO), "--no-cross-checks",
+        "--suppress", os.devnull,
+    ])
+    assert rc_clean == 0
+
+
+# ---- ROOM_TPU_SPEC_TOKENS drift regression (ISSUE 8 satellite) --------
+
+class TestSpecTokensSplit:
+    """The provider-on / library-off split for speculative decoding is
+    now DECLARED in the registry (default=0, provider_default=4) —
+    the drift between providers/tpu.py ("4") and serving/engine.py
+    ("0") inline defaults cannot recur because neither file carries an
+    inline default anymore."""
+
+    def test_registry_declares_the_split(self):
+        knob = knobs.REGISTRY["ROOM_TPU_SPEC_TOKENS"]
+        assert knob.default == "0"
+        assert knob.provider_default == "4"
+        assert knob.scope == "provider"
+
+    def test_library_scope_defaults_off(self, monkeypatch):
+        monkeypatch.delenv("ROOM_TPU_SPEC_TOKENS", raising=False)
+        assert knobs.get_int("ROOM_TPU_SPEC_TOKENS") == 0
+
+    def test_provider_scope_defaults_on(self, monkeypatch):
+        monkeypatch.delenv("ROOM_TPU_SPEC_TOKENS", raising=False)
+        assert knobs.get_int("ROOM_TPU_SPEC_TOKENS",
+                             scope="provider") == 4
+
+    def test_env_override_wins_in_both_scopes(self, monkeypatch):
+        monkeypatch.setenv("ROOM_TPU_SPEC_TOKENS", "7")
+        assert knobs.get_int("ROOM_TPU_SPEC_TOKENS") == 7
+        assert knobs.get_int("ROOM_TPU_SPEC_TOKENS",
+                             scope="provider") == 7
+
+    def test_call_sites_pin_their_scopes(self):
+        """engine.py reads library scope, providers/tpu.py provider
+        scope — the regression pin for the exact files that drifted."""
+        engine = (REPO / "room_tpu" / "serving" / "engine.py").read_text()
+        tpu = (REPO / "room_tpu" / "providers" / "tpu.py").read_text()
+        assert 'knobs.get_int("ROOM_TPU_SPEC_TOKENS")' in engine
+        assert '"ROOM_TPU_SPEC_TOKENS", scope="provider"' in tpu
+        # neither carries an inline default anymore
+        assert 'SPEC_TOKENS", "0"' not in engine
+        assert 'SPEC_TOKENS", "4"' not in tpu
+
+
+# ---- knobs accessor semantics -----------------------------------------
+
+def test_unregistered_name_raises():
+    with pytest.raises(KeyError, match="unregistered knob"):
+        knobs.get_str("ROOM_TPU_TOTALLY_FAKE")
+    with pytest.raises(KeyError, match="dynamic"):
+        knobs.get_dynamic("ROOM_TPU_{X}_FAKE", "A")
+
+
+def test_bool_semantics(monkeypatch):
+    for falsey in ("", "0", "off", "FALSE", "no"):
+        monkeypatch.setenv("ROOM_TPU_OFFLOAD", falsey)
+        assert knobs.get_bool("ROOM_TPU_OFFLOAD") is False
+    for truthy in ("1", "true", "on", "yes"):
+        monkeypatch.setenv("ROOM_TPU_OFFLOAD", truthy)
+        assert knobs.get_bool("ROOM_TPU_OFFLOAD") is True
+    monkeypatch.delenv("ROOM_TPU_OFFLOAD", raising=False)
+    assert knobs.get_bool("ROOM_TPU_OFFLOAD") is False
+    assert knobs.get_bool("ROOM_TPU_OFFLOAD", scope="provider") is True
+
+
+def test_dynamic_family_resolution(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_MESH_TINY_LLAMA", "1,1,4@0")
+    got = knobs.get_dynamic("ROOM_TPU_MESH_{MODEL}", "TINY_LLAMA")
+    assert got == "1,1,4@0"
+    assert knobs.get_dynamic("ROOM_TPU_MESH_{MODEL}", "OTHER") is None
+    assert knobs.get_dynamic(
+        "ROOM_TPU_{KIND}_BASE", "OPENAI", default="https://x"
+    ) == "https://x"

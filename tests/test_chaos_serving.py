@@ -224,6 +224,71 @@ def test_decode_step_fault_retried(make_engine):
     assert eng.stats()["fault_retries"] >= 1
 
 
+def test_decode_window_fault_scoped_recovery(make_engine):
+    """Chaos-tier coverage for the decode_window point (previously
+    only in test_decode_pipeline.py; roomlint's fault-coverage
+    cross-check keeps the full mapping honest). Transient window
+    faults inside the retry budget are invisible to the stream; a
+    non-transient one fails ONLY the faulted window's turns, and a
+    canary submitted AFTER the fault decodes the clean-run stream
+    with the pool balanced."""
+    eng = make_engine()
+    clean = eng.submit([5, 6, 7], sampling=_greedy(10))
+    eng.run_until_idle()
+
+    # within the retry budget: the stream must not notice
+    faults.inject("decode_window", times=1)
+    retried = eng.submit([5, 6, 7], session_id="rw",
+                         sampling=_greedy(10))
+    eng.run_until_idle()
+    assert retried.new_tokens == clean.new_tokens
+    assert eng.stats()["fault_retries"] >= 1
+
+    # past the budget (non-transient): window-scoped failure only
+    faults.inject("decode_window", times=1, transient=False)
+    failed = eng.submit([5, 6, 7], session_id="fw",
+                        sampling=_greedy(10))
+    eng.run_until_idle()
+    assert failed.finish_reason == "error"
+    st = eng.stats()
+    assert st["window_faults"] >= 1
+    assert st["healthy"] is True and st["engine_crashes"] == 0
+
+    # recovery canary: the engine serves identically after the fault
+    canary = eng.submit([5, 6, 7], session_id="cw",
+                        sampling=_greedy(10))
+    eng.run_until_idle()
+    assert canary.new_tokens == clean.new_tokens
+    _release_all(eng)
+    _assert_pages_balanced(eng)
+
+
+def test_shutdown_io_drain_fails_soft(make_engine, tmp_path):
+    """Chaos-tier coverage for the shutdown_io point (previously only
+    in test_lifecycle.py): with EVERY lifecycle write failing, a drain
+    must neither raise nor hang — warmth is lost, and the next boot
+    cold-starts into a healthy serving engine whose streams match the
+    clean run."""
+    eng = make_engine()
+    clean = eng.submit([3, 1, 4], session_id="s",
+                       sampling=_greedy(10))
+    eng.run_until_idle()
+
+    faults.inject("shutdown_io")            # every write fails
+    eng.drain(str(tmp_path / "d"))          # must not raise
+    faults.clear("shutdown_io")
+
+    eng2 = make_engine()
+    eng2.restore_from_manifest(str(tmp_path / "d"))
+    assert "s" not in eng2.sessions          # cold start, not a crash
+    assert eng2.lifecycle_phase == "serving" and eng2.healthy
+    turn = eng2.submit([3, 1, 4], sampling=_greedy(10))
+    eng2.run_until_idle()
+    assert turn.new_tokens == clean.new_tokens
+    _release_all(eng2)
+    _assert_pages_balanced(eng2)
+
+
 def test_decode_step_nontransient_escapes_to_supervisor(make_engine):
     """A non-transient device fault is NOT retried — it propagates (the
     crash path) so the supervisor owns recovery."""
