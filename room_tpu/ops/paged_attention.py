@@ -726,6 +726,474 @@ def paged_attention_prefill_int8(
     )(tables, lengths, q, k_flat, v_flat, ks_flat, vs_flat)
 
 
+# ---------------------------------------------------------------------------
+# Unified ragged kernel: one dispatch for mixed prefill-chunks + decode-lanes
+# ---------------------------------------------------------------------------
+
+RAGGED_Q_BLOCK = 8
+
+
+def ragged_block_layout(
+    q_lens: "list[int] | tuple[int, ...]", q_block: int = RAGGED_Q_BLOCK,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Static q-block layout for the ragged kernel.
+
+    Each row's query segment is padded up to q-block granularity (at
+    most q_block-1 pad positions per row — never to the batch max, and
+    never to the KV capacity), so a block always belongs to exactly one
+    row. Returns int32 arrays:
+
+      row_of_block [NB]  — which ragged row each q-block serves
+      blk_in_row   [NB]  — the block's index within its row
+      gather_idx   [NB*q_block] — padded position -> flat token index
+                    (pad slots point at token 0; the kernel masks them)
+      scatter_idx  [T]   — flat token index -> padded position
+
+    The maps are pure functions of the per-row query lengths, which the
+    engine's fused dispatch keys statically (decode lanes are length 1,
+    chunk rows are the fixed scheduler chunk width) — so there is one
+    compile per (batch, chunks) shape, never per chunk width."""
+    row_of_block: list[int] = []
+    blk_in_row: list[int] = []
+    gather: list[int] = []
+    scatter: list[int] = []
+    src = 0
+    for r, ql in enumerate(q_lens):
+        if ql <= 0:
+            raise ValueError(f"ragged row {r} has query_len {ql}")
+        n_blocks = -(-ql // q_block)
+        base = len(row_of_block) * q_block
+        for b in range(n_blocks):
+            row_of_block.append(r)
+            blk_in_row.append(b)
+            for t in range(q_block):
+                tok = b * q_block + t
+                gather.append(src + tok if tok < ql else 0)
+        scatter.extend(base + t for t in range(ql))
+        src += ql
+    return (
+        np.asarray(row_of_block, np.int32),
+        np.asarray(blk_in_row, np.int32),
+        np.asarray(gather, np.int32),
+        np.asarray(scatter, np.int32),
+    )
+
+
+def _ragged_kernel(
+    # scalar prefetch
+    tables_ref,      # [R, max_pages] SMEM
+    plens_ref,       # [R] SMEM prefix length BEFORE each row's queries
+    qlens_ref,       # [R] SMEM query tokens per row (decode lane = 1)
+    rowmap_ref,      # [NB] SMEM q-block -> row
+    blkmap_ref,      # [NB] SMEM q-block -> index within row
+    # inputs
+    q_ref,           # [1, QB*Hq, D] VMEM (one block-aligned q tile)
+    k_pages_hbm,     # [P, page*Hkv, D] ANY/HBM (flattened view)
+    v_pages_hbm,     # [P, page*Hkv, D] ANY/HBM
+    # output
+    o_ref,           # [1, QB*Hq, D] VMEM
+    # scratch
+    k_buf,           # [2, page*Hkv, D] VMEM
+    v_buf,           # [2, page*Hkv, D] VMEM
+    acc_ref,         # [QB*Hq, D] f32
+    m_ref,           # [QB*Hq, 1] f32
+    l_ref,           # [QB*Hq, 1] f32
+    sems,            # DMA sems [2, 2]
+    *,
+    page_size: int,
+    n_kv_heads: int,
+    q_block: int,
+    scale: float,
+):
+    """Unified ragged paged attention (PAPERS.md lead citation): one
+    grid over the ragged [prefill-chunks + decode-lanes] batch. Every
+    q-block belongs to exactly one row (ragged_block_layout); a decode
+    lane is simply a row with query_len 1 whose pad q positions are
+    masked, a prefill chunk a row with query_len S — the SAME kernel,
+    page walk, and online softmax serve both, so a scheduler window's
+    chunk writes and decode steps share one dispatch with no padding
+    to the batch max length. Same Mosaic posture as the split kernels:
+    flat [page*Hkv, D] tiles, one double-buffered DMA per page, all
+    (query-head, kv-row) pairs in one MXU matmul, invalid pairs masked
+    to -inf before the online softmax."""
+    blk = pl.program_id(0)
+    r = rowmap_ref[blk]
+    qb = blkmap_ref[blk]
+    prefix = plens_ref[r]
+    qlen = qlens_ref[r]
+
+    qrows, d = q_ref.shape[1], q_ref.shape[2]
+    hq = qrows // q_block
+    hkv = n_kv_heads
+    group = hq // hkv
+    rows = page_size * hkv
+
+    # this block's highest VALID query position bounds the page walk:
+    # O(actual context) traffic per block, never table capacity
+    hi_tok = jnp.minimum(qlen, (qb + 1) * q_block)   # exclusive
+    n_pages = jax.lax.div(prefix + hi_tok - 1, page_size) + 1
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def start_fetch(i, slot):
+        page_id = tables_ref[r, i]
+        pltpu.make_async_copy(
+            k_pages_hbm.at[page_id], k_buf.at[slot], sems.at[slot, 0]
+        ).start()
+        pltpu.make_async_copy(
+            v_pages_hbm.at[page_id], v_buf.at[slot], sems.at[slot, 1]
+        ).start()
+
+    def wait_fetch(i, slot):
+        page_id = tables_ref[r, i]
+        pltpu.make_async_copy(
+            k_pages_hbm.at[page_id], k_buf.at[slot], sems.at[slot, 0]
+        ).wait()
+        pltpu.make_async_copy(
+            v_pages_hbm.at[page_id], v_buf.at[slot], sems.at[slot, 1]
+        ).wait()
+
+    start_fetch(0, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [qrows, D]
+
+    # row rr = (token t within block) * Hq + head h; col j of a flat
+    # page = (token within page) * Hkv + kv head
+    j = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 1)
+    rr = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 0)
+    pair_ok = jax.lax.rem(j, hkv) == jax.lax.div(
+        jax.lax.rem(rr, hq), group
+    )
+    tok_of_j = jax.lax.div(j, hkv)
+    t_of_r = jax.lax.div(rr, hq)                      # token in block
+    q_pos = prefix + qb * q_block + t_of_r
+    # pad q positions past the row's ragged query length mask out
+    q_valid = (qb * q_block + t_of_r) < qlen
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            start_fetch(i + 1, 1 - slot)
+
+        wait_fetch(i, slot)
+        k = k_buf[slot].astype(jnp.float32)           # [rows, D]
+        v = v_buf[slot].astype(jnp.float32)
+
+        logits = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # [qrows, rows]
+        kv_pos = i * page_size + tok_of_j
+        valid = pair_ok & q_valid & (kv_pos <= q_pos)
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(logits, axis=1, keepdims=True)
+        )
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    denom = jnp.maximum(l_ref[:], 1e-30)
+    o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _ragged_kernel_int8(
+    # scalar prefetch
+    tables_ref, plens_ref, qlens_ref, rowmap_ref, blkmap_ref,
+    # inputs
+    q_ref,           # [1, QB*Hq, D] VMEM
+    k_pages_hbm,     # [P, page*Hkv, D] int8 ANY/HBM
+    v_pages_hbm,     # [P, page*Hkv, D] int8 ANY/HBM
+    k_scale_hbm,     # [P, page*Hkv, 1] f32 ANY/HBM
+    v_scale_hbm,     # [P, page*Hkv, 1] f32 ANY/HBM
+    # output
+    o_ref,           # [1, QB*Hq, D] VMEM
+    # scratch
+    k_buf, v_buf,    # [2, page*Hkv, D] int8 VMEM
+    ks_buf, vs_buf,  # [2, page*Hkv, 1] f32 VMEM
+    acc_ref, m_ref, l_ref,
+    sems,            # DMA sems [2, 4]
+    *,
+    page_size: int,
+    n_kv_heads: int,
+    q_block: int,
+    scale: float,
+):
+    """int8-KV variant of _ragged_kernel: the unified ragged walk with
+    the in-kernel dequant posture the split int8 kernels established —
+    int8 page tiles at half the DMA bytes plus [rows, 1] f32 scale
+    tiles broadcast over lanes. One dispatch covers the window's mixed
+    chunk/decode batch reading the quantized pool directly."""
+    blk = pl.program_id(0)
+    r = rowmap_ref[blk]
+    qb = blkmap_ref[blk]
+    prefix = plens_ref[r]
+    qlen = qlens_ref[r]
+
+    qrows, d = q_ref.shape[1], q_ref.shape[2]
+    hq = qrows // q_block
+    hkv = n_kv_heads
+    group = hq // hkv
+    rows = page_size * hkv
+
+    hi_tok = jnp.minimum(qlen, (qb + 1) * q_block)
+    n_pages = jax.lax.div(prefix + hi_tok - 1, page_size) + 1
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def start_fetch(i, slot):
+        page_id = tables_ref[r, i]
+        for src, dst, sem in (
+            (k_pages_hbm, k_buf, 0), (v_pages_hbm, v_buf, 1),
+            (k_scale_hbm, ks_buf, 2), (v_scale_hbm, vs_buf, 3),
+        ):
+            pltpu.make_async_copy(
+                src.at[page_id], dst.at[slot], sems.at[slot, sem]
+            ).start()
+
+    def wait_fetch(i, slot):
+        page_id = tables_ref[r, i]
+        for src, dst, sem in (
+            (k_pages_hbm, k_buf, 0), (v_pages_hbm, v_buf, 1),
+            (k_scale_hbm, ks_buf, 2), (v_scale_hbm, vs_buf, 3),
+        ):
+            pltpu.make_async_copy(
+                src.at[page_id], dst.at[slot], sems.at[slot, sem]
+            ).wait()
+
+    start_fetch(0, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    j = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 1)
+    rr = jax.lax.broadcasted_iota(jnp.int32, (qrows, rows), 0)
+    pair_ok = jax.lax.rem(j, hkv) == jax.lax.div(
+        jax.lax.rem(rr, hq), group
+    )
+    tok_of_j = jax.lax.div(j, hkv)
+    t_of_r = jax.lax.div(rr, hq)
+    q_pos = prefix + qb * q_block + t_of_r
+    q_valid = (qb * q_block + t_of_r) < qlen
+
+    def body(i, _):
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_pages)
+        def _():
+            start_fetch(i + 1, 1 - slot)
+
+        wait_fetch(i, slot)
+        k = k_buf[slot].astype(jnp.float32) * ks_buf[slot]
+        v = v_buf[slot].astype(jnp.float32) * vs_buf[slot]
+
+        logits = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        kv_pos = i * page_size + tok_of_j
+        valid = pair_ok & q_valid & (kv_pos <= q_pos)
+        logits = jnp.where(valid, logits, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(logits, axis=1, keepdims=True)
+        )
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    denom = jnp.maximum(l_ref[:], 1e-30)
+    o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "q_block", "interpret")
+)
+def paged_attention_ragged(
+    q: jax.Array,            # [NB, q_block, Hq, D] block-aligned queries
+    k_pages: jax.Array,      # [P, page, Hkv, D]
+    v_pages: jax.Array,      # [P, page, Hkv, D]
+    tables: jax.Array,       # [R, max_pages] int32
+    prefix_lens: jax.Array,  # [R] int32 KV tokens BEFORE each row's queries
+    q_lens: jax.Array,       # [R] int32 ragged query length per row
+    row_of_block: jax.Array,  # [NB] int32 (ragged_block_layout)
+    blk_in_row: jax.Array,    # [NB] int32
+    *,
+    page_size: int,
+    q_block: int = RAGGED_Q_BLOCK,
+    interpret: bool = False,
+) -> jax.Array:
+    """One kernel over the ragged [prefill-chunks + decode-lanes]
+    batch: row i contributes q_lens[i] query tokens (1 for a decode
+    lane, the chunk width for a prefill chunk) on top of prefix_lens[i]
+    tokens already in its pages. Callers lay queries out block-aligned
+    via ragged_block_layout; output has the same [NB, q_block, Hq, D]
+    layout (pad positions are garbage, gathered away by scatter_idx)."""
+    nb, qblk, hq, d = q.shape
+    if qblk != q_block:
+        raise ValueError(f"q block dim {qblk} != q_block {q_block}")
+    p_count, _, hkv, _ = k_pages.shape
+    scale = 1.0 / float(np.sqrt(d))
+    rows = page_size * hkv
+    qrows = q_block * hq
+
+    k_flat = k_pages.reshape(p_count, rows, d)
+    v_flat = v_pages.reshape(p_count, rows, d)
+    q_flat = q.reshape(nb, qrows, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, qrows, d), lambda i, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, qrows, d), lambda i, *_: (i, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, d), k_pages.dtype),
+            pltpu.VMEM((2, rows, d), v_pages.dtype),
+            pltpu.VMEM((qrows, d), jnp.float32),
+            pltpu.VMEM((qrows, 1), jnp.float32),
+            pltpu.VMEM((qrows, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    kernel = functools.partial(
+        _ragged_kernel,
+        page_size=page_size,
+        n_kv_heads=hkv,
+        q_block=q_block,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nb, qrows, d), q.dtype),
+        interpret=interpret,
+    )(tables, prefix_lens, q_lens, row_of_block, blk_in_row,
+      q_flat, k_flat, v_flat)
+    return out.reshape(nb, q_block, hq, d)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("page_size", "q_block", "interpret")
+)
+def paged_attention_ragged_int8(
+    q: jax.Array,            # [NB, q_block, Hq, D]
+    k_pages: jax.Array,      # [P, page, Hkv, D] int8
+    v_pages: jax.Array,      # [P, page, Hkv, D] int8
+    k_scale: jax.Array,      # [P, page, Hkv] f32
+    v_scale: jax.Array,      # [P, page, Hkv] f32
+    tables: jax.Array,       # [R, max_pages] int32
+    prefix_lens: jax.Array,  # [R] int32
+    q_lens: jax.Array,       # [R] int32
+    row_of_block: jax.Array,  # [NB] int32
+    blk_in_row: jax.Array,    # [NB] int32
+    *,
+    page_size: int,
+    q_block: int = RAGGED_Q_BLOCK,
+    interpret: bool = False,
+) -> jax.Array:
+    nb, qblk, hq, d = q.shape
+    if qblk != q_block:
+        raise ValueError(f"q block dim {qblk} != q_block {q_block}")
+    p_count, _, hkv, _ = k_pages.shape
+    scale = 1.0 / float(np.sqrt(d))
+    rows = page_size * hkv
+    qrows = q_block * hq
+
+    k_flat = k_pages.reshape(p_count, rows, d)
+    v_flat = v_pages.reshape(p_count, rows, d)
+    ks_flat = k_scale.reshape(p_count, rows, 1)
+    vs_flat = v_scale.reshape(p_count, rows, 1)
+    q_flat = q.reshape(nb, qrows, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, qrows, d), lambda i, *_: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, qrows, d), lambda i, *_: (i, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, d), k_pages.dtype),
+            pltpu.VMEM((2, rows, d), v_pages.dtype),
+            pltpu.VMEM((2, rows, 1), jnp.float32),
+            pltpu.VMEM((2, rows, 1), jnp.float32),
+            pltpu.VMEM((qrows, d), jnp.float32),
+            pltpu.VMEM((qrows, 1), jnp.float32),
+            pltpu.VMEM((qrows, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 4)),
+        ],
+    )
+
+    kernel = functools.partial(
+        _ragged_kernel_int8,
+        page_size=page_size,
+        n_kv_heads=hkv,
+        q_block=q_block,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nb, qrows, d), q.dtype),
+        interpret=interpret,
+    )(tables, prefix_lens, q_lens, row_of_block, blk_in_row,
+      q_flat, k_flat, v_flat, ks_flat, vs_flat)
+    return out.reshape(nb, q_block, hq, d)
+
+
 @functools.partial(
     jax.jit, static_argnames=("page_size", "interpret")
 )

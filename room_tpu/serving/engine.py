@@ -48,7 +48,8 @@ from .faults import FaultError
 from .kv_offload import TieredKVStore, offload_enabled_from_env
 from .kv_pages import (
     PageTable, init_page_cache, kv_quant_mode, make_paged_kv_hook,
-    pallas_decode_int8_ok, pallas_prefill_ok, use_pallas_kernel,
+    make_ragged_kv_hook, pallas_decode_int8_ok, pallas_prefill_ok,
+    pallas_ragged_int8_ok, pallas_ragged_ok, use_pallas_kernel,
 )
 from .scheduler import (
     CLASS_PRIORITY, CLASS_RANK, RequestScheduler, chunk_pages_from_env,
@@ -458,6 +459,28 @@ class ServingEngine:
                 cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, page_size
             )
         )
+        # unified ragged kernel (ops/paged_attention.paged_attention_
+        # ragged): ONE Pallas dispatch over the mixed [prefill-chunks +
+        # decode-lanes] batch of a fused scheduler window. Probe-gated
+        # like the split kernels (ROOM_TPU_RAGGED_KERNEL /
+        # _INT8_KERNEL); a failed probe keeps the fused dispatch on the
+        # XLA gather+einsum reference (the CPU/tier-1 path).
+        self.ragged_qblock = max(1, knobs.get_int(
+            "ROOM_TPU_RAGGED_QBLOCK"
+        ))
+        ragged_ok = pallas_ragged_int8_ok if self.kv_quant \
+            else pallas_ragged_ok
+        ragged_forced = \
+            knobs.get_str("ROOM_TPU_PAGED_KERNEL") == "ragged"
+        self._pallas_ragged = (
+            use_pallas_kernel()
+            and self.sched_chunk_tokens > 0
+            and self.sched_chunk_tokens % self.ragged_qblock == 0
+            and (ragged_forced or ragged_ok(
+                cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, page_size,
+                self.ragged_qblock,
+            ))
+        )
 
         self.cache = init_page_cache(
             cfg, n_pages, page_size, quant=self.kv_quant
@@ -474,6 +497,16 @@ class ServingEngine:
             dp = mesh.shape.get("dp", 1)
             if dp > 1 and max_batch % dp == 0:
                 self._dp_size = dp
+        # fused dispatch window (docs/serving.md): the step's admitted
+        # interleaved prefill chunks ride the SAME device dispatch as
+        # the decode window — one host round trip per scheduler window
+        # instead of one per chunk plus one for decode. Disabled under
+        # dp sharding (the ragged [1, T] token stream has no dp axis).
+        self.fused_window = (
+            knobs.get_bool("ROOM_TPU_FUSED_WINDOW")
+            and self.sched_chunk_tokens > 0
+            and self._dp_size == 1
+        )
         self.sessions: dict[str, _Session] = {}
         # admission queue: the scheduler's EDF heap (class TTFT target
         # deadlines), drop-in for the old FIFO queue.Queue surface
@@ -496,6 +529,14 @@ class ServingEngine:
         # host drain (depth-1 double buffer: window k executes while
         # window k-1's ring materializes + books)
         self._inflight: Optional[dict] = None
+        # fused-window chunk staging (docs/serving.md): interleaved
+        # prefill chunks admitted THIS step, host-committed but not yet
+        # on device — consumed by the step's one fused dispatch (or the
+        # chunk-only flush), rolled back to the last durable boundary
+        # if that dispatch faults. _staged_sids guards the sessions
+        # against eviction/offload in the stage->dispatch gap.
+        self._staged_chunks: list[dict] = []
+        self._staged_sids: set[str] = set()
         # per-slot count of KV positions dispatched but not yet drained:
         # reservations and block-table lengths must address the DEVICE's
         # view of the sequence, which runs ahead of sess.length by one
@@ -566,6 +607,12 @@ class ServingEngine:
             # chunk budget, and chunk faults requeued at a boundary
             "prefill_chunks_interleaved": 0, "prefill_chunk_defers": 0,
             "prefill_chunk_faults": 0,
+            # unified ragged fused window (docs/serving.md): device
+            # dispatches that carried ONLY chunk writes (split path +
+            # chunk-only flushes), windows whose dispatch fused chunk
+            # writes with the decode scan, and chunks that rode fused
+            "chunk_dispatches": 0, "fused_windows": 0,
+            "fused_chunks": 0,
         }
         from collections import Counter
 
@@ -870,8 +917,12 @@ class ServingEngine:
         self._reserved_tokens[:] = 0
         # the in-flight window's futures may hold the crash exception
         # (or a donated-away cache): drop them with the rest of the
-        # device state — its turns were failed above
+        # device state — its turns were failed above. Staged fused-
+        # window chunks go with them (their turns were failed+rolled
+        # back via _fail_all_pending's partial-prefill rollback).
         self._inflight = None
+        self._staged_chunks = []
+        self._staged_sids.clear()
         self._slot_ahead[:] = 0
         self._feed_tokens = None
         # host/disk copies reference sessions that no longer exist (and
@@ -1015,6 +1066,118 @@ class ServingEngine:
                     self._constrain_cache(cache)  # [B, n_steps]
 
             self._jit_cache[key] = decode
+        return self._jit_cache[key]
+
+    def _fused_fn(self, n_steps: int, n_chunks: int,
+                  active_pages: Optional[int] = None,
+                  penalized: bool = False):
+        """Fused-window variant of _decode_fn: ONE compiled dispatch
+        covering the scheduler window's staged prefill chunks AND its
+        decode steps. Step 0 is a forward over the ragged
+        [decode-lanes + chunk-rows] token stream — per layer, one
+        attention dispatch through the unified ragged kernel (TPU) or
+        the bounded gather+einsum reference (CPU) writes every row's KV
+        and attends; the decode lanes' logits come off that same
+        forward. Steps 1..n-1 are the standard decode scan. Chunk
+        hidden states are discarded (apply_head=False; chunked prefill
+        samples nothing until its tail admission), and the decode
+        lanes are token-identical to the split path: the same KV lands
+        at the same positions and sampling consumes the same per-step
+        rng keys."""
+        cw = self.sched_chunk_tokens
+        key = ("fused", n_steps, n_chunks, cw, active_pages, penalized)
+        if key not in self._jit_cache:
+            cfg = self.cfg
+            pad_id = self.tokenizer.pad_id
+            b = self.max_batch
+
+            @partial(jax.jit,
+                     donate_argnums=(1, 2) if penalized else (1,))
+            def fused(params, cache, counts, prev_tokens, fresh_tokens,
+                      fresh_mask, active_mask, block_tables, lengths,
+                      rng, temperature, top_p, top_k,
+                      presence, frequency,
+                      chunk_tokens, chunk_tables, chunk_lens):
+                tokens0 = jnp.where(fresh_mask, fresh_tokens,
+                                    prev_tokens)
+                flat = jnp.concatenate(
+                    [tokens0, chunk_tokens.reshape(-1)]
+                )[None]                                # [1, B + C*cw]
+                pos = jnp.concatenate([
+                    lengths,
+                    (chunk_lens[:, None] + jnp.arange(cw)).reshape(-1),
+                ])[None]
+                tables_r = jnp.concatenate(
+                    [block_tables, chunk_tables], axis=0
+                )
+                prefix_r = jnp.concatenate([lengths, chunk_lens])
+                hook = make_ragged_kv_hook(
+                    tables_r, prefix_r, self.page_size,
+                    n_decode=b, n_chunks=n_chunks, chunk_width=cw,
+                    active_pages=active_pages,
+                    pallas_ragged=self._pallas_ragged,
+                    q_block=self.ragged_qblock,
+                )
+                hidden, cache = qwen3.forward(
+                    params, cfg, flat, pos, cache, kv_hook=hook,
+                    apply_head=False,
+                )
+                logits0 = qwen3.lm_head(
+                    params, cfg, hidden[0, :b][:, None]
+                )[:, 0]                                # [B, V]
+                keys = jax.random.split(rng, n_steps)
+                row_logits = logits0
+                if penalized:
+                    row_logits = apply_penalties(
+                        row_logits.astype(jnp.float32), counts,
+                        presence, frequency,
+                    )
+                nxt0 = sample_batched(
+                    row_logits, keys[0], temperature, top_p, top_k
+                )
+                nxt0 = jnp.where(active_mask, nxt0, jnp.int32(pad_id))
+                if penalized:
+                    counts = counts.at[
+                        jnp.arange(b), nxt0
+                    ].add(active_mask.astype(jnp.int32))
+
+                def step(carry, step_rng):
+                    toks, cache, lens, cnts = carry
+                    hook = make_paged_kv_hook(
+                        block_tables, lens, self.page_size,
+                        active_pages=active_pages,
+                    )
+                    logits, cache = qwen3.forward(
+                        params, cfg, toks[:, None], lens[:, None],
+                        cache, kv_hook=hook,
+                    )
+                    row_logits = logits[:, 0]
+                    if penalized:
+                        row_logits = apply_penalties(
+                            row_logits.astype(jnp.float32), cnts,
+                            presence, frequency,
+                        )
+                    nxt = sample_batched(
+                        row_logits, step_rng, temperature, top_p,
+                        top_k,
+                    )
+                    nxt = jnp.where(
+                        active_mask, nxt, jnp.int32(pad_id)
+                    )
+                    if penalized:
+                        cnts = cnts.at[
+                            jnp.arange(nxt.shape[0]), nxt
+                        ].add(active_mask.astype(jnp.int32))
+                    return (nxt, cache, lens + 1, cnts), nxt
+
+                (_, cache, _, counts), ring_rest = jax.lax.scan(
+                    step, (nxt0, cache, lengths + 1, counts), keys[1:]
+                )
+                ring = jnp.concatenate([nxt0[None], ring_rest], axis=0)
+                return ring.T, counts, \
+                    self._constrain_cache(cache)  # [B, n_steps]
+
+            self._jit_cache[key] = fused
         return self._jit_cache[key]
 
     def _spec_fn(self, width: int, active_pages: Optional[int] = None):
@@ -1284,7 +1447,11 @@ class ServingEngine:
         if any(
             t is not None and t.session_id == session_id
             for t in self._active
-        ) or session_id in self._admitting:
+        ) or session_id in self._admitting \
+                or session_id in self._staged_sids:
+            # staged fused-window chunks count too: releasing the
+            # session before its staged dispatch lands would free pages
+            # the dispatch is about to write into
             return True
         return self._queued_sids.get(session_id, 0) > 0
 
@@ -1428,6 +1595,10 @@ class ServingEngine:
         # would hand its pages to a batchmate and the imminent batched
         # prefill would write two sessions' KV into the same pages
         active_ids |= self._admitting
+        # sessions with staged (not yet dispatched) fused-window chunks
+        # hold page reservations the fused dispatch will write into —
+        # evicting one would point those writes at reallocated pages
+        active_ids |= self._staged_sids
         candidates = [
             s for s in self.sessions.values()
             if s.id != exclude and s.id not in active_ids
@@ -1624,7 +1795,8 @@ class ServingEngine:
             return False
         candidates = [
             s for s in self.sessions.values()
-            if s.id != exclude and s.length > s.prefix_len
+            if s.id != exclude and s.id not in self._staged_sids
+            and s.length > s.prefix_len
             and self.page_table.pages_of(s.id)
             and self._session_is_cold(s)
         ]
@@ -1648,6 +1820,7 @@ class ServingEngine:
         candidates = [
             s for s in self.sessions.values()
             if s.length > s.prefix_len
+            and s.id not in self._staged_sids
             and self.page_table.pages_of(s.id)
             and self._session_is_cold(s)
         ]
@@ -1896,12 +2069,23 @@ class ServingEngine:
         FaultError (injected prefill fault past its retry budget) with
         the session rolled back to its pre-preparation state either
         way, so a requeue re-prepares from scratch losing nothing."""
+        if turn.done.is_set():
+            # already finished while queued (staged-chunk rollback past
+            # its requeue budget, shed race): never re-prefill it
+            return None
         if turn.deadline is not None and \
                 time.monotonic() > turn.deadline:
             self._bump("deadline_timeouts")
             self._fail_turn_unslotted(
                 turn, "deadline exceeded while queued"
             )
+            return None
+        if turn.session_id in self._staged_sids:
+            # the session's staged fused-window chunks haven't landed
+            # on device yet (a second turn queued on the same session
+            # in the same admission pass): admitting on top of them
+            # would prefill against unwritten KV — hold one step
+            turn._admit_deferred = True
             return None
         sess = self.sessions.get(turn.session_id)
         if sess is None:
@@ -2116,6 +2300,13 @@ class ServingEngine:
         background prefill must never evict live KV to make room."""
         cw = self.sched_chunk_tokens
         cls = turn.turn_class
+        # fused window (docs/serving.md): chunks are STAGED instead of
+        # dispatched — host bookkeeping commits now, the KV write rides
+        # this step's one fused device dispatch, and a faulted dispatch
+        # rolls the turn back to the pre-stage boundary via ``undo``.
+        fused = self.fused_window and snap is not None
+        staged_undo: Optional[dict] = None
+        staged_any = False
 
         def to_boundary() -> None:
             # every early exit rolls the session back to ``snap`` —
@@ -2158,6 +2349,20 @@ class ServingEngine:
                 turn._admit_deferred = True
                 to_boundary()
                 return None
+            if fused and staged_undo is None:
+                # pre-stage boundary for _rollback_staged: the state a
+                # faulted fused dispatch restores this turn to (deep
+                # copies — ``snap`` mutates at every staged commit)
+                staged_undo = {
+                    "snap": {
+                        k: list(v) if isinstance(v, list) else v
+                        for k, v in snap.items()
+                    },
+                    "prompt_tokens": list(turn.prompt_tokens),
+                    "chunk_committed": turn._chunk_committed,
+                    "prefill_chunks": turn.prefill_chunks,
+                    "prefill_snap": turn._prefill_snap,
+                }
             if turn._prefill_snap is None:
                 # rollback baseline: a COPY of the session's state
                 # before this turn touched it (kept across requeues —
@@ -2182,7 +2387,24 @@ class ServingEngine:
                 # boundary — committed chunks stay, pages stay owned
                 # by the session, nothing leaks
                 faults.maybe_fail("prefill_chunk")
-                self._prefill_write_chunk(sess, chunk, table)
+                if fused:
+                    # stage for this step's fused dispatch: host state
+                    # advances now, the device write lands with the
+                    # decode window (_dispatch_window) or the chunk
+                    # flush; _staged_sids bars eviction/offload of the
+                    # session until the dispatch settles
+                    self._staged_chunks.append({
+                        "turn": turn, "sess": sess,
+                        "toks": list(chunk), "table": table,
+                        "base_len": sess.length, "cls": cls,
+                        "undo": staged_undo,
+                    })
+                    self._staged_sids.add(sess.id)
+                    staged_any = True
+                    sess.length += cw
+                    sess.history.extend(chunk)
+                else:
+                    self._prefill_write_chunk(sess, chunk, table)
             except FaultError as e:
                 self._bump("prefill_chunk_faults")
                 self._note_pressure()
@@ -2208,7 +2430,11 @@ class ServingEngine:
             turn.prompt_tokens = list(prompt)
             turn._chunk_committed += cw
             turn.prefill_chunks += 1
-            self._bump("prefill_chunks_interleaved")
+            if not fused:
+                # staged chunks count when their dispatch lands
+                # (_commit_staged), keeping the counter an honest
+                # record of chunks actually on device
+                self._bump("prefill_chunks_interleaved")
             # refresh the caller's rollback snapshot IN PLACE to this
             # durable boundary: chunk progress must survive a later
             # tail-admission failure (which rolls back to ``snap`` and
@@ -2221,7 +2447,42 @@ class ServingEngine:
                 prefix_pages=list(sess.prefix_pages),
                 prefix_len=sess.prefix_len,
             )
+        if staged_any:
+            # the tail admits NEXT step, at the durable boundary the
+            # staged chunks establish once this step's fused dispatch
+            # lands (scheduling-only delay: the token stream is
+            # unchanged)
+            turn._admit_deferred = True
+            return None
         return prompt
+
+    def _chunk_write_fn(self, fresh: bool,
+                        active: Optional[int] = None):
+        """Jitted KV-write-only chunk prefill (no head, no sampling),
+        shared by the split per-chunk path (batch [1, width]) and the
+        staged chunk flush (batch [N, width]) — one compiled family
+        for both."""
+        key = ("chunk_write", fresh, active)
+        if key not in self._jit_cache:
+            cfg = self.cfg
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def write(params, cache, tokens, block_tables, lengths):
+                hook = make_paged_kv_hook(
+                    block_tables, lengths, self.page_size,
+                    fresh_prefill=fresh, active_pages=active,
+                    pallas_prefill=self._pallas_prefill,
+                )
+                positions = lengths[:, None] + \
+                    jnp.arange(tokens.shape[1])
+                _, cache = qwen3.forward(
+                    params, cfg, tokens, positions, cache,
+                    kv_hook=hook, apply_head=False,
+                )
+                return self._constrain_cache(cache)
+
+            self._jit_cache[key] = write
+        return self._jit_cache[key]
 
     def _prefill_write_chunk(
         self, sess: _Session, toks: list[int], table: np.ndarray
@@ -2233,32 +2494,13 @@ class ServingEngine:
         active = None
         if not fresh and not (self._pallas_prefill and width % 8 == 0):
             active = self._pages_bucket(sess.length + width)
-        key = ("prefill_write", width, fresh, active)
-        if key not in self._jit_cache:
-            cfg = self.cfg
-
-            @partial(jax.jit, donate_argnums=(1,))
-            def write(params, cache, tokens, block_table, length):
-                hook = make_paged_kv_hook(
-                    block_table, length, self.page_size,
-                    fresh_prefill=fresh, active_pages=active,
-                    pallas_prefill=self._pallas_prefill,
-                )
-                positions = length[:, None] + \
-                    jnp.arange(tokens.shape[1])
-                _, cache = qwen3.forward(
-                    params, cfg, tokens, positions, cache,
-                    kv_hook=hook, apply_head=False,
-                )
-                return self._constrain_cache(cache)
-
-            self._jit_cache[key] = write
+        write = self._chunk_write_fn(fresh, active)
 
         def call():
             # chaos fault point fires BEFORE the jitted call so no
             # donated buffer is consumed by a failed attempt
             faults.maybe_fail("prefill_oom")
-            return self._jit_cache[key](
+            return write(
                 self.params,
                 self.cache,
                 jnp.asarray([toks], jnp.int32),
@@ -2268,6 +2510,7 @@ class ServingEngine:
 
         with self.timer.phase(f"prefill_write_{width}"):
             self.cache = self._retrying("prefill_write", call)
+        self._bump("chunk_dispatches")
         self._bump("prefill_tokens", width)
         sess.length += width
         sess.history.extend(toks)
@@ -2488,6 +2731,11 @@ class ServingEngine:
             i for i, t in enumerate(self._active) if t is not None
         ]
         if not active_idx and self._inflight is None:
+            if self._staged_chunks:
+                # no decode lanes to fuse with: the staged chunks
+                # still land in ONE batched dispatch this step
+                self._dispatch_staged_chunks()
+                return 1
             return 0
         # spec verify has no penalty path: penalized rows take the
         # sequential scan (their counts stay exact) while the rest of
@@ -2547,6 +2795,12 @@ class ServingEngine:
 
         prev, self._inflight = self._inflight, None
         window_fault: Optional[FaultError] = None
+        if not active_idx and self._staged_chunks:
+            # no decode lanes this step but a window still in flight:
+            # staged chunks must still land THIS step — the next
+            # step's admission runs before its _decode_once and may
+            # tail-admit on top of them
+            self._dispatch_staged_chunks()
         if active_idx:
             try:
                 self._inflight = self._dispatch_window(active_idx)
@@ -2589,9 +2843,114 @@ class ServingEngine:
 
     def _flush_pipeline(self) -> int:
         """Drain the in-flight window, if any (spec round boundaries,
-        shutdown). Returns rows advanced."""
+        shutdown), after landing any staged chunk writes — a flush must
+        leave no host-committed KV still waiting for a device dispatch.
+        Returns rows advanced."""
+        self._dispatch_staged_chunks()
         prev, self._inflight = self._inflight, None
         return self._drain_window(prev) if prev is not None else 0
+
+    def _dispatch_staged_chunks(self) -> None:
+        """Land staged chunk writes in ONE batched device dispatch when
+        there is no decode window to fuse them with (idle batch,
+        spec-round boundary, pipeline flush, shutdown). A dispatch
+        fault past the retry budget rolls the staged turns back to
+        their last durable chunk boundary — committed chunks stay, the
+        already-queued turns re-prepare from the boundary, pages stay
+        owned (no leak)."""
+        staged = self._staged_chunks
+        if not staged:
+            return
+        cw = self.sched_chunk_tokens
+        c_pad = self._pow2(len(staged))
+        toks = np.full((c_pad, cw), self.tokenizer.pad_id, np.int32)
+        tables = np.zeros((c_pad, self.max_pages_per_seq), np.int32)
+        lens = np.zeros((c_pad,), np.int32)
+        for r, rec in enumerate(staged):
+            toks[r] = rec["toks"]
+            tables[r] = rec["table"]
+            lens[r] = rec["base_len"]
+        active = None
+        if not (self._pallas_prefill and cw % 8 == 0):
+            active = self._pages_bucket(
+                max(int(r["base_len"]) for r in staged) + cw
+            )
+        write = self._chunk_write_fn(False, active)
+
+        def call():
+            # chaos fault point fires BEFORE the jitted call so no
+            # donated buffer is consumed by a failed attempt
+            faults.maybe_fail("prefill_oom")
+            return write(
+                self.params, self.cache, jnp.asarray(toks),
+                jnp.asarray(tables), jnp.asarray(lens),
+            )
+
+        try:
+            with self.timer.phase(f"chunk_flush_{cw}x{len(staged)}"):
+                self.cache = self._retrying("chunk_flush", call)
+        except FaultError as e:
+            self._rollback_staged(e)
+            return
+        self._bump("chunk_dispatches")
+        self._commit_staged(staged, fused=False)
+
+    def _commit_staged(self, staged: list[dict], *, fused: bool) -> None:
+        """The dispatch carrying the staged chunks landed: their host
+        bookkeeping (committed at stage time) is now durable."""
+        self._staged_chunks = []
+        self._staged_sids.clear()
+        self._bump("prefill_chunks_interleaved", len(staged))
+        self._bump(
+            "prefill_tokens", sum(len(r["toks"]) for r in staged)
+        )
+        if fused:
+            self._bump("fused_windows")
+            self._bump("fused_chunks", len(staged))
+
+    def _rollback_staged(self, err: FaultError) -> None:
+        """A dispatch carrying staged chunks faulted past its retry
+        budget: none of the staged KV landed. Restore every staged
+        turn's session to its pre-stage state (the last durable chunk
+        boundary — chunks committed by EARLIER dispatches stay),
+        refund the consumed chunk-budget units, and let the
+        already-queued turns re-prepare from the boundary (bounded by
+        the requeue budget). Pages stay owned by their sessions, so
+        nothing leaks."""
+        staged, self._staged_chunks = self._staged_chunks, []
+        self._staged_sids.clear()
+        first_rec: dict[int, dict] = {}
+        for rec in staged:
+            first_rec.setdefault(id(rec["turn"]), rec)
+            self.scheduler.refund_chunk(rec["cls"])
+        self._bump("prefill_chunk_faults")
+        self._note_pressure()
+        for rec in first_rec.values():
+            turn = rec["turn"]
+            undo = rec["undo"]
+            sess = self.sessions.get(turn.session_id)
+            if undo is not None:
+                if sess is not None:
+                    try:
+                        self._restore_session_snapshot(
+                            sess, undo["snap"]
+                        )
+                    except Exception:
+                        # best-effort: the history-mirror re-prefill
+                        # path remains the correctness backstop
+                        pass
+                turn.prompt_tokens = list(undo["prompt_tokens"])
+                turn._chunk_committed = undo["chunk_committed"]
+                turn.prefill_chunks = undo["prefill_chunks"]
+                turn._prefill_snap = undo["prefill_snap"]
+            turn.requeues += 1
+            turn.disrupted = True
+            if turn.requeues > self.max_requeues:
+                # the queued entry remains; _prepare_turn's done-guard
+                # skips it when popped
+                self._fail_turn_unslotted(turn, str(err))
+            else:
+                self._bump("requeues")
 
     # roomlint: region=dispatch-window
     def _dispatch_window(self, active_idx: list[int]) -> Optional[dict]:
@@ -2602,7 +2961,14 @@ class ServingEngine:
         FaultError for the CALLER to handle (it drains the previous
         window first so its real tokens are delivered, then fails this
         window's turns); ``active_idx`` is mutated in place to the rows
-        that were actually in the window."""
+        that were actually in the window.
+
+        When the step staged interleaved prefill chunks (fused window,
+        docs/serving.md), they ride THIS dispatch: step 0 of the jitted
+        call runs the ragged [decode-lanes + chunk-rows] forward — one
+        attention dispatch per layer through the unified ragged kernel
+        (or the bounded-gather reference on CPU) — so the whole
+        scheduler window costs one host round trip."""
         steps = self.steps_per_dispatch
         penalized = any(
             self._active[i].sampling.penalized for i in active_idx
@@ -2621,7 +2987,10 @@ class ServingEngine:
             if not self._reserve_slot(i, min(steps, remaining)):
                 active_idx.remove(i)
         if not active_idx:
+            if self._staged_chunks:
+                self._dispatch_staged_chunks()
             return None
+        staged = list(self._staged_chunks)
 
         # rows whose feed token the host owns (no undrained window):
         # new admissions, first window after a flush. Everything else
@@ -2652,13 +3021,22 @@ class ServingEngine:
 
         # bound the XLA fallback's page gather to the batch's actual
         # reach (the Pallas kernel is already length-bounded — passing a
-        # varying static bound there would only churn compiles)
+        # varying static bound there would only churn compiles). A
+        # fused window taking the gather reference must also cover the
+        # staged chunks' reach.
+        cw = self.sched_chunk_tokens
         ap = None
-        if not self._pallas_decode:
+        if not self._pallas_decode or \
+                (staged and not self._pallas_ragged):
             max_len = max(
                 int(self._slot_lengths[i]) for i in active_idx
             )
-            ap = self._pages_bucket(max_len + steps)
+            reach = max_len + steps
+            if staged:
+                reach = max(reach, max(
+                    int(r["base_len"]) for r in staged
+                ) + cw)
+            ap = self._pages_bucket(reach)
         if penalized:
             presence = np.zeros((self.max_batch,), np.float32)
             frequency = np.zeros((self.max_batch,), np.float32)
@@ -2674,7 +3052,29 @@ class ServingEngine:
         else:
             counts = jnp.int32(0)
             pen_args = (jnp.float32(0), jnp.float32(0))
-        decode = self._decode_fn(steps, ap, penalized)
+        chunk_args: tuple = ()
+        if staged:
+            # fused window: the staged chunk batch rides this dispatch
+            c_pad = self._pow2(len(staged))
+            chunk_tokens = np.full(
+                (c_pad, cw), self.tokenizer.pad_id, np.int32
+            )
+            chunk_tables = np.zeros(
+                (c_pad, self.max_pages_per_seq), np.int32
+            )
+            chunk_lens = np.zeros((c_pad,), np.int32)
+            for r, rec in enumerate(staged):
+                chunk_tokens[r] = rec["toks"]
+                chunk_tables[r] = rec["table"]
+                chunk_lens[r] = rec["base_len"]
+            chunk_args = (
+                jnp.asarray(chunk_tokens),
+                jnp.asarray(chunk_tables),
+                jnp.asarray(chunk_lens),
+            )
+            decode = self._fused_fn(steps, c_pad, ap, penalized)
+        else:
+            decode = self._decode_fn(steps, ap, penalized)
         scan_tables, scan_lengths = \
             self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
@@ -2703,6 +3103,7 @@ class ServingEngine:
                 self._place_batch(top_ps),
                 self._place_batch(top_ks),
                 *pen_args,
+                *chunk_args,
             )
 
         t0 = time.monotonic()
@@ -2711,6 +3112,11 @@ class ServingEngine:
                 ring, counts_out, self.cache = \
                     self._retrying("decode", call)
         except FaultError as e:
+            # a fused window's staged chunk KV never landed: roll the
+            # chunk turns back to their last durable boundary (their
+            # committed chunks stay; only this step's staging is lost)
+            if staged:
+                self._rollback_staged(e)
             if getattr(e, "point", None) != "decode_window":
                 raise   # decode_step past its budget: crash supervisor
             # window-scoped failure: note it and let the caller fail
@@ -2719,6 +3125,8 @@ class ServingEngine:
             self._note_pressure()
             self._bump("window_faults")
             raise
+        if staged:
+            self._commit_staged(staged, fused=True)
         if penalized:
             self._counts = counts_out
         # the ring tail feeds the next dispatch without a host hop

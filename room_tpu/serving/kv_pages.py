@@ -68,10 +68,12 @@ def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 
 def use_pallas_kernel() -> bool:
-    """Decode attention backend selection: the Pallas kernel on TPU when
-    ROOM_TPU_PAGED_KERNEL=pallas, XLA gather reference otherwise."""
+    """Paged attention backend selection: the Pallas kernels on TPU
+    when ROOM_TPU_PAGED_KERNEL is pallas/ragged (ragged additionally
+    forces the unified ragged kernel for fused windows), the XLA
+    gather reference otherwise."""
     mode = knobs.get_str("ROOM_TPU_PAGED_KERNEL")
-    if mode == "pallas":
+    if mode in ("pallas", "ragged"):
         return True
     if mode == "xla":
         return False
@@ -85,18 +87,16 @@ _PREFILL_PROBE: dict[tuple, bool] = {}
 _DECODE_INT8_PROBE: dict[tuple, bool] = {}
 
 
-def _probe_gate(
-    env_var: str, cache: dict, probe_fn,
-    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int,
-) -> bool:
+def _probe_gate(env_var: str, cache: dict, probe_fn, *shape) -> bool:
     """Shared kernel-gating scaffold: env force (on|off), else a
-    one-shot compile + numerics probe cached per shape."""
+    one-shot compile + numerics probe cached per shape (the shape key
+    is (n_q_heads, n_kv_heads, head_dim, page_size[, q_block]))."""
     mode = knobs.get_str(env_var)
     if mode == "on":
         return True
     if mode == "off":
         return False
-    key = (int(n_q_heads), int(n_kv_heads), int(head_dim), int(page_size))
+    key = tuple(int(x) for x in shape)
     got = cache.get(key)
     if got is None:
         got = probe_fn(*key)
@@ -139,6 +139,39 @@ def pallas_decode_int8_ok(
 
 
 _PREFILL_INT8_PROBE: dict[tuple, bool] = {}
+_RAGGED_PROBE: dict[tuple, bool] = {}
+_RAGGED_INT8_PROBE: dict[tuple, bool] = {}
+
+
+def pallas_ragged_ok(
+    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int,
+    q_block: int,
+) -> bool:
+    """Startup smoke for the unified ragged kernel (env
+    ROOM_TPU_RAGGED_KERNEL, same contract as pallas_prefill_ok): one
+    compile + numerics check of a mixed [decode-lane + prefill-chunk]
+    batch before the fused dispatch routes production traffic through
+    it. A failed probe keeps the fused dispatch on the XLA
+    gather+einsum reference."""
+    return _probe_gate(
+        "ROOM_TPU_RAGGED_KERNEL", _RAGGED_PROBE,
+        _probe_ragged_kernel,
+        n_q_heads, n_kv_heads, head_dim, page_size, q_block,
+    )
+
+
+def pallas_ragged_int8_ok(
+    n_q_heads: int, n_kv_heads: int, head_dim: int, page_size: int,
+    q_block: int,
+) -> bool:
+    """Startup smoke for the int8-KV unified ragged kernel (env
+    ROOM_TPU_RAGGED_INT8_KERNEL) — in-kernel dequant of the quantized
+    pool for the fused mixed dispatch."""
+    return _probe_gate(
+        "ROOM_TPU_RAGGED_INT8_KERNEL", _RAGGED_INT8_PROBE,
+        _probe_ragged_int8_kernel,
+        n_q_heads, n_kv_heads, head_dim, page_size, q_block,
+    )
 
 
 def pallas_prefill_int8_ok(
@@ -311,6 +344,285 @@ def _probe_decode_int8_kernel(
         return out, expected
 
     return _probe_run("int8 decode", run)
+
+
+def _probe_ragged_common(
+    hq: int, hkv: int, d: int, page_size: int, q_block: int,
+    quantize: bool,
+) -> bool:
+    """Mixed-batch probe of the unified ragged kernel: one decode lane
+    (query_len 1) and one two-block prefill chunk share the dispatch,
+    each checked against attention_ref over its own dequantized
+    pages."""
+    import numpy as np
+
+    from ..ops.paged_attention import (
+        paged_attention_ragged, paged_attention_ragged_int8,
+        ragged_block_layout,
+    )
+
+    def run():
+        chunk = 2 * q_block
+        rows = ((1, page_size + 3), (chunk, page_size))  # (qlen, prefix)
+        seeds = (5, 7)
+        max_pages = 8
+        tables_np, refs, qs = [], [], []
+        # build each row's pages with the shared scaffold, then merge
+        # the single-row pools into one (offsetting page ids past the
+        # one shared scratch page 0)
+        merged: Optional[list] = None
+        next_page = 1
+        rng = np.random.default_rng(11)
+        for (ql, prefix), seed in zip(rows, seeds):
+            total = prefix + ql
+            inputs, tbl, kd, vd, _ = _probe_pages(
+                seed, total, hkv, d, page_size, quantize
+            )
+            npg = tbl.shape[1]
+            row_tbl = np.zeros((max_pages,), np.int32)
+            row_tbl[:npg] = np.arange(next_page, next_page + npg)
+            tables_np.append(row_tbl)
+            refs.append((kd, vd, total, prefix, ql))
+            qs.append(jnp.asarray(
+                rng.standard_normal((ql, hq, d)) * 0.5, jnp.bfloat16
+            ))
+            if merged is None:
+                merged = [[jnp.asarray(x)[0:1]] for x in inputs]
+            for li, leaf in enumerate(inputs):
+                merged[li].append(jnp.asarray(leaf)[1:])
+            next_page += npg
+        merged_pool = tuple(
+            jnp.concatenate(parts, axis=0) for parts in merged
+        )
+        q_lens = [r[0] for r in rows]
+        prefixes = [r[1] for r in rows]
+        rowmap, blkmap, gather, scatter = ragged_block_layout(
+            q_lens, q_block
+        )
+        q_flat = jnp.concatenate(qs, axis=0)
+        q_pad = q_flat[jnp.asarray(gather)].reshape(
+            len(rowmap), q_block, hq, d
+        )
+        kernel = paged_attention_ragged_int8 if quantize \
+            else paged_attention_ragged
+        out = kernel(
+            q_pad, *merged_pool,
+            jnp.asarray(np.stack(tables_np)),
+            jnp.asarray(prefixes, jnp.int32),
+            jnp.asarray(q_lens, jnp.int32),
+            jnp.asarray(rowmap), jnp.asarray(blkmap),
+            page_size=page_size, q_block=q_block,
+        )
+        out_flat = out.reshape(-1, hq, d)[jnp.asarray(scatter)]
+        got, expected = [], []
+        off = 0
+        for (kd, vd, total, prefix, ql), qrow in zip(refs, qs):
+            exp = attention_ref(
+                qrow[None], kd[None], vd[None], causal=True,
+                q_positions=prefix + jnp.arange(ql)[None],
+                kv_positions=jnp.arange(total)[None],
+            )[0]
+            expected.append(np.asarray(exp, np.float32))
+            got.append(np.asarray(out_flat[off:off + ql], np.float32))
+            off += ql
+        return np.concatenate(got), np.concatenate(expected)
+
+    label = "int8 ragged" if quantize else "pallas ragged"
+    return _probe_run(label, run)
+
+
+def _probe_ragged_kernel(
+    hq: int, hkv: int, d: int, page_size: int, q_block: int
+) -> bool:
+    return _probe_ragged_common(hq, hkv, d, page_size, q_block, False)
+
+
+def _probe_ragged_int8_kernel(
+    hq: int, hkv: int, d: int, page_size: int, q_block: int
+) -> bool:
+    return _probe_ragged_common(hq, hkv, d, page_size, q_block, True)
+
+
+def make_ragged_kv_hook(
+    block_tables: jax.Array,   # [R, max_pages] page ids per ragged row
+    prefix_lens: jax.Array,    # [R] KV tokens already in cache per row
+    page_size: int,
+    *,
+    n_decode: int,             # rows 0..n_decode-1 carry ONE query token
+    n_chunks: int,             # rows n_decode.. carry chunk_width tokens
+    chunk_width: int,
+    active_pages: Optional[int] = None,
+    pallas_ragged: Optional[bool] = None,
+    q_block: int = 8,
+):
+    """kv_hook for the engine's FUSED dispatch: one forward over the
+    ragged [decode-lanes + prefill-chunks] token stream (shape
+    [1, n_decode + n_chunks*chunk_width]), laid out decode lanes first.
+    Writes every token's k/v into its row's pages at
+    prefix_lens[row] + offset, then attends — through the unified
+    Pallas ragged kernel when ``pallas_ragged`` (one kernel, no padding
+    to the batch max, O(actual context) page traffic per q-block), or
+    through the XLA gather+einsum reference otherwise (the CPU/tier-1
+    fallback: the decode segment and the chunk segment each take
+    exactly the same bounded-gather attention_ref path the split
+    dispatches take, so greedy streams stay token-identical to the
+    split engine).
+
+    The same overrun contracts as make_paged_kv_hook hold: positions
+    past the block table divert to scratch page 0, and rows that are
+    padding (inactive decode lanes, chunk-batch pad rows) write scratch
+    KV that is garbage by construction."""
+    import numpy as np
+
+    r_total, max_pages = block_tables.shape
+    if r_total != n_decode + n_chunks:
+        raise ValueError(
+            f"ragged rows {r_total} != {n_decode} decode + "
+            f"{n_chunks} chunks"
+        )
+    # static token -> row / offset maps (the ragged layout is a pure
+    # function of the fused batch shape, so these fold into the jit)
+    row_of_token = np.concatenate([
+        np.arange(n_decode, dtype=np.int32),
+        np.repeat(
+            n_decode + np.arange(n_chunks, dtype=np.int32), chunk_width
+        ),
+    ]) if n_chunks else np.arange(n_decode, dtype=np.int32)
+    off_in_row = np.concatenate([
+        np.zeros(n_decode, np.int32),
+        np.tile(np.arange(chunk_width, dtype=np.int32), n_chunks),
+    ]) if n_chunks else np.zeros(n_decode, np.int32)
+    n_tokens = row_of_token.shape[0]
+
+    def hook(q, k, v, layer_cache):
+        if q.shape[0] != 1 or q.shape[1] != n_tokens:
+            raise ValueError(
+                f"ragged hook expects [1, {n_tokens}, H, D] q, got "
+                f"{q.shape}"
+            )
+        quantized = "k_scale" in layer_cache
+        rows_j = jnp.asarray(row_of_token)
+        positions = prefix_lens[rows_j] + jnp.asarray(off_in_row)  # [T]
+        page_idx = positions // page_size
+        in_range = page_idx < max_pages
+        page_of = jnp.where(
+            in_range,
+            block_tables[rows_j, jnp.minimum(page_idx, max_pages - 1)],
+            0,
+        )
+        offset = positions % page_size
+
+        k_flat = k[0]                                  # [T, Hkv, D]
+        v_flat = v[0]
+        if quantized:
+            qk, sk = _quantize_kv(k_flat)
+            qv, sv = _quantize_kv(v_flat)
+            kp = layer_cache["k_pages"].at[page_of, offset].set(qk)
+            vp = layer_cache["v_pages"].at[page_of, offset].set(qv)
+            ks = layer_cache["k_scale"].at[page_of, offset].set(sk)
+            vs = layer_cache["v_scale"].at[page_of, offset].set(sv)
+            out_cache = {
+                "k_pages": kp, "v_pages": vp,
+                "k_scale": ks, "v_scale": vs,
+            }
+        else:
+            kp = layer_cache["k_pages"].at[page_of, offset].set(k_flat)
+            vp = layer_cache["v_pages"].at[page_of, offset].set(v_flat)
+            ks = vs = None
+            out_cache = {"k_pages": kp, "v_pages": vp}
+
+        hq_n, d_n = q.shape[2], q.shape[3]
+
+        use_ragged = pallas_ragged
+        if use_ragged is None:
+            use_ragged = use_pallas_kernel() and \
+                (n_chunks == 0 or chunk_width % q_block == 0)
+        if use_ragged:
+            from ..ops.paged_attention import (
+                paged_attention_ragged, paged_attention_ragged_int8,
+                ragged_block_layout,
+            )
+
+            q_lens = (1,) * n_decode + (chunk_width,) * n_chunks
+            rowmap, blkmap, gather, scatter = ragged_block_layout(
+                q_lens, q_block
+            )
+            q_pad = q[0][jnp.asarray(gather)].reshape(
+                len(rowmap), q_block, hq_n, d_n
+            )
+            args = (kp, vp, ks, vs) if quantized else (kp, vp)
+            kernel = paged_attention_ragged_int8 if quantized \
+                else paged_attention_ragged
+            out_pad = kernel(
+                q_pad, *args, block_tables, prefix_lens,
+                jnp.asarray(q_lens, jnp.int32),
+                jnp.asarray(rowmap), jnp.asarray(blkmap),
+                page_size=page_size, q_block=q_block,
+            )
+            attn = out_pad.reshape(-1, hq_n, d_n)[
+                jnp.asarray(scatter)
+            ][None]
+            return attn, out_cache
+
+        # XLA reference: bounded page gather + attention_ref per
+        # segment — the decode rows as a [n_decode, 1] batch and the
+        # chunk rows as an [n_chunks, chunk_width] batch, exactly the
+        # shapes the SPLIT dispatches feed it (masked positions
+        # contribute exact zeros, so the fused result is bit-identical
+        # per row)
+        tbl = block_tables
+        if active_pages is not None and active_pages < max_pages:
+            tbl = block_tables[:, :active_pages]
+        kv_len = tbl.shape[1] * page_size
+        if quantized:
+            k_all = (
+                kp[tbl].astype(jnp.float32) * ks[tbl][..., None]
+            ).astype(jnp.bfloat16)
+            v_all = (
+                vp[tbl].astype(jnp.float32) * vs[tbl][..., None]
+            ).astype(jnp.bfloat16)
+        else:
+            k_all = kp[tbl]
+            v_all = vp[tbl]
+        k_all = k_all.reshape(r_total, kv_len, *k.shape[2:])
+        v_all = v_all.reshape(r_total, kv_len, *v.shape[2:])
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(kv_len)[None], (r_total, kv_len)
+        )
+
+        parts = []
+        if n_decode:
+            q_dec = q[0, :n_decode][:, None]       # [B, 1, Hq, D]
+            attn_dec = attention_ref(
+                q_dec, k_all[:n_decode], v_all[:n_decode],
+                causal=True,
+                q_positions=prefix_lens[:n_decode, None],
+                kv_positions=kv_positions[:n_decode],
+                kv_mask=kv_positions[:n_decode]
+                < (prefix_lens[:n_decode] + 1)[:, None],
+            )
+            parts.append(attn_dec.reshape(n_decode, hq_n, d_n))
+        if n_chunks:
+            q_ch = q[0, n_decode:].reshape(
+                n_chunks, chunk_width, hq_n, d_n
+            )
+            ch_prefix = prefix_lens[n_decode:]
+            attn_ch = attention_ref(
+                q_ch, k_all[n_decode:], v_all[n_decode:],
+                causal=True,
+                q_positions=ch_prefix[:, None]
+                + jnp.arange(chunk_width)[None],
+                kv_positions=kv_positions[n_decode:],
+                kv_mask=kv_positions[n_decode:]
+                < (ch_prefix + chunk_width)[:, None],
+            )
+            parts.append(
+                attn_ch.reshape(n_chunks * chunk_width, hq_n, d_n)
+            )
+        attn = jnp.concatenate(parts, axis=0)[None]
+        return attn, out_cache
+
+    return hook
 
 
 def make_paged_kv_hook(

@@ -366,9 +366,25 @@ register("ROOM_TPU_QUANT", "str", None,
          "Weight quantization mode ('int8' serves int8 weight-only).",
          choices=("int8",))
 register("ROOM_TPU_PAGED_KERNEL", "str", "auto",
-         "Decode attention backend: pallas | xla | auto (Pallas on "
-         "TPU).",
-         choices=("pallas", "xla", "auto"))
+         "Paged attention backend: pallas | ragged | xla | auto "
+         "(pallas/ragged on TPU; ragged additionally forces the "
+         "unified ragged kernel for fused windows).",
+         choices=("pallas", "ragged", "xla", "auto"))
+register("ROOM_TPU_RAGGED_KERNEL", "str", "auto",
+         "Unified ragged [prefill-chunks + decode-lanes] kernel gate: "
+         "on | off | auto (one-shot compile+numerics probe).",
+         choices=("on", "off", "auto"))
+register("ROOM_TPU_RAGGED_INT8_KERNEL", "str", "auto",
+         "int8-KV unified ragged kernel gate: on | off | auto "
+         "(probe).",
+         choices=("on", "off", "auto"))
+register("ROOM_TPU_RAGGED_QBLOCK", "int", "8",
+         "Query-block rows of the unified ragged kernel (ragged rows "
+         "pad to this granularity, never to the batch max).")
+register("ROOM_TPU_FUSED_WINDOW", "bool", "1",
+         "Fuse the scheduler window's interleaved prefill chunks into "
+         "the decode dispatch (one device round trip per window); 0 "
+         "keeps the split per-chunk dispatches.")
 register("ROOM_TPU_PREFILL_KERNEL", "str", "auto",
          "S>1 Pallas prefill kernel gate: on | off | auto (one-shot "
          "compile+numerics probe).",
@@ -596,6 +612,16 @@ register("ROOM_TPU_BENCH_SCHED", "bool", "1",
          "Run the scheduler bench phase.", scope="bench")
 register("ROOM_TPU_BENCH_KVQ", "bool", "1",
          "Run the int8-KV bench variant.", scope="bench")
+register("ROOM_TPU_BENCH_RAGGED", "bool", "1",
+         "Run the ragged_kernel split-vs-unified fused-window A/B "
+         "phase.", scope="bench")
+register("ROOM_TPU_BENCH_TPU_FALLBACK", "bool", "1",
+         "Re-exec the bench as the CPU-proxy profile when the TPU "
+         "tunnel is unreachable (instead of the watchdog 0.0 "
+         "headline).", scope="bench")
+register("ROOM_TPU_BENCH_TPU_PROBE_S", "float", "120",
+         "Bounded wait for the TPU-reachability probe before the "
+         "CPU-proxy fallback.", scope="bench")
 register("ROOM_TPU_PEAK_TFLOPS", "float", "197",
          "Accelerator peak TFLOPs for roofline normalization.",
          scope="bench")

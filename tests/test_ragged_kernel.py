@@ -1,0 +1,562 @@
+"""Unified ragged paged-attention kernel + fused dispatch window.
+
+One dispatch for the mixed [prefill-chunks + decode-lanes] batch
+(PAPERS.md lead citation "Ragged Paged Attention"): the Pallas kernel
+is pinned against attention_ref in interpret mode (bf16 AND the
+in-kernel-dequant int8 variant), the XLA gather+einsum reference stays
+the CPU/tier-1 fallback, and the engine's FUSED window — the step's
+interleaved prefill chunks riding the decode dispatch — must be
+greedy-token-identical to the split path across steps_per_dispatch
+{1, 4} x chunk sizes {1 page, 4 pages, full} x bf16/int8 x prefix-hit
+x offload-restore, including a decode_window fault shot through the
+fused dispatch (no KV leak, chunk boundaries durable). Quick tier:
+runs in the ci.yml chaos job.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.ops import attention_ref
+from room_tpu.ops.paged_attention import (
+    paged_attention_ragged, paged_attention_ragged_int8,
+    ragged_block_layout,
+)
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving.kv_pages import (
+    _quantize_kv, make_paged_kv_hook, make_ragged_kv_hook,
+)
+
+QB = 8
+LONG = [1 + (i % 53) for i in range(100)]   # 13 pages at page_size 8
+STEPS = (1, 4)
+CHUNK_PAGES = (1, 4, 0)                     # 0 = full/monolithic
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def build(model, monkeypatch):
+    cfg, params = model
+
+    def make(chunk_pages, steps=4, fused=True, kv_quant=None, **kw):
+        monkeypatch.setenv(
+            "ROOM_TPU_PREFILL_CHUNK_PAGES", str(chunk_pages)
+        )
+        monkeypatch.setenv(
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", str(steps)
+        )
+        monkeypatch.setenv(
+            "ROOM_TPU_FUSED_WINDOW", "1" if fused else "0"
+        )
+        if kv_quant:
+            monkeypatch.setenv("ROOM_TPU_KV_QUANT", kv_quant)
+        else:
+            monkeypatch.delenv("ROOM_TPU_KV_QUANT", raising=False)
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 128)
+        return ServingEngine(cfg, params, **kw)
+
+    return make
+
+
+def _greedy(n=6):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+# ---- kernel numerics (interpret mode) ----
+
+def _build_ragged_case(rows, page, hkv, hq, d, seed=0):
+    """Pack per-row (q_len, prefix) sequences into one shared pool and
+    return everything the ragged kernel and the per-row reference
+    need."""
+    rng = np.random.default_rng(seed)
+    maxp = 10
+    total_pages = 1 + sum(-(-(ql + pl) // page) for ql, pl in rows)
+    kpool = np.zeros((total_pages, page, hkv, d), np.float32)
+    vpool = np.zeros_like(kpool)
+    tables = np.zeros((len(rows), maxp), np.int32)
+    refs, qs = [], []
+    nxt = 1
+    for r, (ql, pl) in enumerate(rows):
+        total = ql + pl
+        npg = -(-total // page)
+        k = rng.standard_normal((total, hkv, d)).astype(np.float32) * .5
+        v = rng.standard_normal((total, hkv, d)).astype(np.float32) * .5
+        pad = npg * page - total
+        kpool[nxt:nxt + npg] = np.concatenate(
+            [k, np.zeros((pad, hkv, d), np.float32)]
+        ).reshape(npg, page, hkv, d)
+        vpool[nxt:nxt + npg] = np.concatenate(
+            [v, np.zeros((pad, hkv, d), np.float32)]
+        ).reshape(npg, page, hkv, d)
+        tables[r, :npg] = np.arange(nxt, nxt + npg)
+        nxt += npg
+        refs.append((k, v))
+        qs.append(
+            rng.standard_normal((ql, hq, d)).astype(np.float32) * .5
+        )
+    return kpool, vpool, tables, refs, qs
+
+
+def test_ragged_kernel_mixed_batch_matches_reference():
+    """The headline shape: decode lanes (q_len 1) and multi-block
+    prefill chunks (q_len 16/24) with ragged prefixes, one kernel
+    call, each row checked against attention_ref."""
+    page, hkv, hq, d = 8, 2, 4, 32
+    rows = [(1, 11), (16, 9), (1, 3), (24, 0)]
+    kpool, vpool, tables, refs, qs = _build_ragged_case(
+        rows, page, hkv, hq, d
+    )
+    q_lens = [r[0] for r in rows]
+    prefixes = [r[1] for r in rows]
+    rowmap, blkmap, gather, scatter = ragged_block_layout(q_lens, QB)
+    q_flat = np.concatenate(qs, axis=0)
+    q_pad = q_flat[gather].reshape(len(rowmap), QB, hq, d)
+
+    out = paged_attention_ragged(
+        jnp.asarray(q_pad, jnp.bfloat16),
+        jnp.asarray(kpool, jnp.bfloat16),
+        jnp.asarray(vpool, jnp.bfloat16),
+        jnp.asarray(tables), jnp.asarray(prefixes, jnp.int32),
+        jnp.asarray(q_lens, jnp.int32),
+        jnp.asarray(rowmap), jnp.asarray(blkmap),
+        page_size=page, q_block=QB, interpret=True,
+    )
+    out_flat = np.asarray(out.reshape(-1, hq, d), np.float32)[scatter]
+    off = 0
+    for (ql, pl), (k, v), q in zip(rows, refs, qs):
+        total = ql + pl
+        exp = attention_ref(
+            jnp.asarray(q[None], jnp.bfloat16),
+            jnp.asarray(k[None], jnp.bfloat16),
+            jnp.asarray(v[None], jnp.bfloat16),
+            causal=True,
+            q_positions=pl + jnp.arange(ql)[None],
+            kv_positions=jnp.arange(total)[None],
+        )[0]
+        np.testing.assert_allclose(
+            out_flat[off:off + ql], np.asarray(exp, np.float32),
+            atol=6e-2,
+        )
+        off += ql
+
+
+def test_ragged_kernel_int8_in_kernel_dequant():
+    """The int8 variant dequantizes pages IN-KERNEL: the only error vs
+    the bf16 reference over dequantized values is quantization noise
+    already in the cache, not the kernel's."""
+    page, hkv, hq, d = 8, 2, 4, 32
+    rows = [(1, page + 3), (16, page)]
+    kpool, vpool, tables, refs, qs = _build_ragged_case(
+        rows, page, hkv, hq, d, seed=1
+    )
+    qk, sk = _quantize_kv(jnp.asarray(kpool))
+    qv, sv = _quantize_kv(jnp.asarray(vpool))
+    q_lens = [r[0] for r in rows]
+    prefixes = [r[1] for r in rows]
+    rowmap, blkmap, gather, scatter = ragged_block_layout(q_lens, QB)
+    q_flat = np.concatenate(qs, axis=0)
+    q_pad = q_flat[gather].reshape(len(rowmap), QB, hq, d)
+
+    out = paged_attention_ragged_int8(
+        jnp.asarray(q_pad, jnp.bfloat16), qk, qv, sk, sv,
+        jnp.asarray(tables), jnp.asarray(prefixes, jnp.int32),
+        jnp.asarray(q_lens, jnp.int32),
+        jnp.asarray(rowmap), jnp.asarray(blkmap),
+        page_size=page, q_block=QB, interpret=True,
+    )
+    out_flat = np.asarray(out.reshape(-1, hq, d), np.float32)[scatter]
+    kdq = np.asarray(qk, np.float32) * np.asarray(sk)[..., None]
+    vdq = np.asarray(qv, np.float32) * np.asarray(sv)[..., None]
+    off = 0
+    for r, (ql, pl) in enumerate(rows):
+        total = ql + pl
+        npg = -(-total // page)
+        pids = tables[r, :npg]
+        kd = kdq[pids].reshape(-1, hkv, d)[:total]
+        vd = vdq[pids].reshape(-1, hkv, d)[:total]
+        exp = attention_ref(
+            jnp.asarray(qs[r][None], jnp.bfloat16),
+            jnp.asarray(kd[None], jnp.bfloat16),
+            jnp.asarray(vd[None], jnp.bfloat16),
+            causal=True,
+            q_positions=pl + jnp.arange(ql)[None],
+            kv_positions=jnp.arange(total)[None],
+        )[0]
+        np.testing.assert_allclose(
+            out_flat[off:off + ql], np.asarray(exp, np.float32),
+            atol=6e-2,
+        )
+        off += ql
+
+
+def test_ragged_block_layout_shapes():
+    rowmap, blkmap, gather, scatter = ragged_block_layout(
+        (1, 16, 1, 3), 8
+    )
+    # 1 + 2 + 1 + 1 blocks; every row starts a fresh block
+    assert rowmap.tolist() == [0, 1, 1, 2, 3]
+    assert blkmap.tolist() == [0, 0, 1, 0, 0]
+    assert len(gather) == 5 * 8
+    assert len(scatter) == 1 + 16 + 1 + 3
+    # round trip: scatter pulls each flat token back out of the padded
+    # layout gather built
+    assert gather[scatter].tolist() == list(range(21))
+    with pytest.raises(ValueError):
+        ragged_block_layout((1, 0), 8)
+
+
+def test_ragged_probes_cpu_fallback_and_interpret(monkeypatch):
+    """On CPU the real-Pallas probe must fail soft (fallback, no
+    crash); under interpret patching the same probe passes — the gate
+    is the numerics, not the platform."""
+    from room_tpu.serving import kv_pages as kvp
+
+    assert kvp._probe_ragged_kernel(4, 2, 32, 8, 8) is False
+    import room_tpu.ops.paged_attention as pa
+
+    monkeypatch.setattr(
+        pa, "paged_attention_ragged",
+        functools.partial(paged_attention_ragged, interpret=True),
+    )
+    monkeypatch.setattr(
+        pa, "paged_attention_ragged_int8",
+        functools.partial(paged_attention_ragged_int8, interpret=True),
+    )
+    assert kvp._probe_ragged_kernel(4, 2, 32, 8, 8) is True
+    assert kvp._probe_ragged_int8_kernel(4, 2, 32, 8, 8) is True
+
+
+# ---- ragged hook: fused == split, bit-identical on the XLA path ----
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_ragged_hook_matches_split_hooks_bitwise(quant):
+    """The fused hook's XLA fallback computes each segment with the
+    exact gather+einsum the split dispatches use, so attention outputs
+    AND cache writes are bit-identical — the structural guarantee
+    behind the engine-level token-identity matrix."""
+    page, hkv, hq, d, maxp, pool = 4, 2, 4, 8, 6, 16
+    rng = np.random.default_rng(3)
+    if quant:
+        cache = {
+            "k_pages": jnp.zeros((pool, page, hkv, d), jnp.int8),
+            "v_pages": jnp.zeros((pool, page, hkv, d), jnp.int8),
+            "k_scale": jnp.zeros((pool, page, hkv), jnp.float32),
+            "v_scale": jnp.zeros((pool, page, hkv), jnp.float32),
+        }
+    else:
+        cache = {
+            "k_pages": jnp.zeros((pool, page, hkv, d), jnp.bfloat16),
+            "v_pages": jnp.zeros((pool, page, hkv, d), jnp.bfloat16),
+        }
+    B, C, cw = 2, 1, 8
+    dec_tables = np.array(
+        [[1, 2, 0, 0, 0, 0], [3, 0, 0, 0, 0, 0]], np.int32
+    )
+    dec_lens = np.array([5, 2], np.int32)
+    ch_tables = np.array([[4, 5, 6, 0, 0, 0]], np.int32)
+    ch_lens = np.array([3], np.int32)
+
+    def prefill_row(cache, table, toks_n):
+        hook = make_paged_kv_hook(
+            jnp.asarray(table[None]), jnp.asarray([0], jnp.int32),
+            page, pallas_decode=False, fresh_prefill=True,
+        )
+        k = jnp.asarray(
+            rng.standard_normal((1, toks_n, hkv, d)) * .5, jnp.bfloat16
+        )
+        v = jnp.asarray(
+            rng.standard_normal((1, toks_n, hkv, d)) * .5, jnp.bfloat16
+        )
+        q = jnp.asarray(
+            rng.standard_normal((1, toks_n, hq, d)) * .5, jnp.bfloat16
+        )
+        _, cache = hook(q, k, v, cache)
+        return cache
+
+    cache = prefill_row(cache, dec_tables[0], 5)
+    cache = prefill_row(cache, dec_tables[1], 2)
+    cache = prefill_row(cache, ch_tables[0], 3)
+
+    def rand(shape):
+        return jnp.asarray(
+            rng.standard_normal(shape) * .5, jnp.bfloat16
+        )
+
+    qd, kd, vd = rand((B, 1, hq, d)), rand((B, 1, hkv, d)), \
+        rand((B, 1, hkv, d))
+    qc, kc, vc = rand((C, cw, hq, d)), rand((C, cw, hkv, d)), \
+        rand((C, cw, hkv, d))
+
+    dhook = make_paged_kv_hook(
+        jnp.asarray(dec_tables), jnp.asarray(dec_lens), page,
+        pallas_decode=False, active_pages=4,
+    )
+    attn_d, cache_s = dhook(qd, kd, vd, dict(cache))
+    chook = make_paged_kv_hook(
+        jnp.asarray(ch_tables), jnp.asarray(ch_lens), page,
+        pallas_decode=False, pallas_prefill=False, active_pages=4,
+    )
+    attn_c, cache_split = chook(qc, kc, vc, cache_s)
+
+    rhook = make_ragged_kv_hook(
+        jnp.asarray(np.concatenate([dec_tables, ch_tables])),
+        jnp.asarray(np.concatenate([dec_lens, ch_lens])),
+        page, n_decode=B, n_chunks=C, chunk_width=cw,
+        active_pages=4, pallas_ragged=False,
+    )
+    q_all = jnp.concatenate([qd[:, 0], qc.reshape(C * cw, hq, d)])[None]
+    k_all = jnp.concatenate([kd[:, 0], kc.reshape(C * cw, hkv, d)])[None]
+    v_all = jnp.concatenate([vd[:, 0], vc.reshape(C * cw, hkv, d)])[None]
+    attn_r, cache_fused = rhook(q_all, k_all, v_all, dict(cache))
+
+    np.testing.assert_array_equal(
+        np.asarray(attn_r[0, :B], np.float32),
+        np.asarray(attn_d[:, 0], np.float32),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(attn_r[0, B:], np.float32),
+        np.asarray(attn_c.reshape(C * cw, hq, d), np.float32),
+    )
+    for key in cache:
+        np.testing.assert_array_equal(
+            np.asarray(cache_fused[key].astype(jnp.float32)),
+            np.asarray(cache_split[key].astype(jnp.float32)),
+        )
+
+
+# ---- engine: fused window token identity ----
+
+def _run_streams(eng):
+    """Canonical traffic: a short decode turn, a long (chunked) prompt,
+    and a continuation on the chunked session."""
+    a = eng.submit([5, 6, 7], session_id="dec", sampling=_greedy(10))
+    b = eng.submit(LONG, session_id="long", sampling=_greedy())
+    eng.run_until_idle()
+    c = eng.submit([7, 8, 9], session_id="long", sampling=_greedy())
+    eng.run_until_idle()
+    return (a.new_tokens, b.new_tokens, c.new_tokens)
+
+
+def test_identity_fused_vs_split_matrix(build):
+    """The acceptance matrix: the fused window (unified dispatch) is
+    greedy-token-identical to the split path across steps {1,4} x
+    chunk sizes {1 page, 4 pages, full}."""
+    base = _run_streams(build(4, steps=4, fused=False))
+    for steps in STEPS:
+        for pages in CHUNK_PAGES:
+            eng = build(pages, steps=steps, fused=True)
+            got = _run_streams(eng)
+            assert got == base, f"pages={pages} steps={steps}"
+            st = eng.stats()
+            if pages:
+                assert st["prefill_chunks_interleaved"] > 0
+                # chunks rode the fused dispatch (or an idle-batch
+                # flush), never per-chunk device calls
+                assert st["chunk_dispatches"] < \
+                    st["prefill_chunks_interleaved"]
+
+
+def test_identity_fused_int8(build):
+    """bf16/int8 axis: the fused dispatch through the quantized pool
+    (in-kernel dequant on TPU, dequant gather on CPU) matches the
+    split int8 path."""
+    base = _run_streams(build(1, steps=4, fused=False,
+                              kv_quant="int8"))
+    for steps in STEPS:
+        eng = build(1, steps=steps, fused=True, kv_quant="int8")
+        assert _run_streams(eng) == base, f"steps={steps}"
+        assert eng.stats()["fused_windows"] > 0
+
+
+def test_identity_fused_prefix_hit(build):
+    """Prefix-hit axis: a second session hitting the first's cached
+    prefix must stream identically through the fused path."""
+    prefix = list(range(1, 41))             # 5 aligned pages
+    base = None
+    for fused in (False, True):
+        eng = build(1, fused=fused)
+        t1 = eng.submit(prefix + [61, 62, 63], sampling=_greedy())
+        eng.run_until_idle()
+        t2 = eng.submit(prefix + [71, 72], sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.stats()["prefix_hits"] >= 1
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"fused={fused}"
+
+
+def test_identity_fused_offload_restore(build):
+    """Offload-restore axis: hibernate a session, resume it with a
+    long chunked continuation through the fused dispatch."""
+    base = None
+    for fused in (False, True):
+        eng = build(1, fused=fused, offload=True)
+        t1 = eng.submit(list(range(1, 20)), session_id="h",
+                        sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.offload_session("h")
+        t2 = eng.submit(LONG, session_id="h", sampling=_greedy())
+        eng.run_until_idle()
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"fused={fused}"
+        assert eng.stats()["offload_restores"] >= 1
+
+
+def test_chunk_only_flush_idle_batch(build):
+    """No decode lanes to fuse with: staged chunks land in ONE batched
+    flush dispatch per step, still token-identical to split."""
+    eng0 = build(1, fused=False)
+    b0 = eng0.submit(LONG, sampling=_greedy())
+    eng0.run_until_idle()
+
+    eng = build(1, fused=True)
+    b1 = eng.submit(LONG, sampling=_greedy())
+    eng.run_until_idle()
+    assert b1.new_tokens == b0.new_tokens
+    st = eng.stats()
+    assert st["prefill_chunks_interleaved"] > 0
+    # one flush dispatch can carry the whole step's budget of chunks
+    assert st["chunk_dispatches"] <= st["prefill_chunks_interleaved"]
+
+
+def test_fused_dispatch_count_delta(build):
+    """The measurable claim: fused mode collapses per-chunk device
+    dispatches into the window dispatch — the split engine pays one
+    device call PER chunk, the fused engine near zero."""
+    results = {}
+    for fused in (False, True):
+        eng = build(1, steps=4, fused=fused)
+        eng.submit([5, 6, 7], sampling=_greedy(12))
+        eng.submit(LONG, sampling=_greedy())
+        eng.run_until_idle()
+        results[fused] = eng.stats()
+    split, unified = results[False], results[True]
+    assert split["chunk_dispatches"] == \
+        split["prefill_chunks_interleaved"]
+    assert unified["fused_windows"] > 0
+    assert unified["chunk_dispatches"] < split["chunk_dispatches"]
+
+
+# ---- chaos: decode_window fault through the fused dispatch ----
+
+def test_decode_window_fault_through_fused_dispatch(build, monkeypatch):
+    """A non-transient decode_window fault on a FUSED window (decode
+    lanes + staged chunks) fails only the window's decode turns; the
+    chunked turn rolls back to its last durable chunk boundary,
+    re-prepares, and completes with the clean stream. No KV leaks."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    # clean baseline streams
+    eng0 = build(1, fused=True)
+    d0 = eng0.submit([5, 6, 7], sampling=_greedy(10))
+    b0 = eng0.submit(LONG, sampling=_greedy())
+    eng0.run_until_idle()
+
+    eng = build(1, fused=True)
+    dec = eng.submit([5, 6, 7], session_id="dec", sampling=_greedy(10))
+    # get the decode turn into a slot first
+    for _ in range(2):
+        eng.step()
+    chunked = eng.submit(LONG, session_id="long", sampling=_greedy())
+    faults.inject("decode_window", times=1, transient=False)
+    eng.run_until_idle()
+    faults.clear()
+
+    st = eng.stats()
+    assert st["window_faults"] >= 1
+    assert st["healthy"] is True and st["engine_crashes"] == 0
+    # the decode turn was in the faulted window: window-scoped failure
+    assert dec.finish_reason == "error"
+    # the chunked turn re-prepared from its durable boundary and
+    # streams the clean tokens (disrupted, but token-identical greedy)
+    assert chunked.finish_reason is not None
+    assert chunked.new_tokens == b0.new_tokens
+    assert d0.new_tokens  # baseline decode stream existed
+
+    # canary after the fault: clean stream, balanced pool
+    canary = eng.submit([5, 6, 7], sampling=_greedy(10))
+    eng.run_until_idle()
+    assert canary.new_tokens == d0.new_tokens
+    for sid in list(eng.sessions):
+        eng.release_session(sid)
+    eng.step()
+    assert eng.page_table.free_pages == eng.n_pages - 1, (
+        "KV page leak after fused-window fault"
+    )
+
+
+def test_staged_chunks_never_survive_a_step(build):
+    """Invariant behind the fused window's durability story: every
+    step's staged chunks land on device within THAT step's
+    _decode_once — even when the step has no active decode slots but a
+    window still in flight (the hazard: the NEXT step's admission runs
+    before its _decode_once and could tail-admit on top of unwritten
+    chunk KV). Pinned by driving steps manually around a window that
+    finishes its turns while in flight."""
+    eng0 = build(1, steps=4, fused=False)
+    d0 = eng0.submit([5, 6, 7], sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=3))
+    b0 = eng0.submit(LONG, sampling=_greedy())
+    eng0.run_until_idle()
+
+    eng = build(1, steps=4, fused=True)
+    dec = eng.submit([5, 6, 7], sampling=SamplingParams(
+        temperature=0.0, max_new_tokens=3))
+    # two steps: the short turn finishes at a drain while the next
+    # window is still in flight, emptying the active slots
+    eng.step()
+    eng.step()
+    long_turn = eng.submit(LONG, sampling=_greedy())
+    for _ in range(400):
+        eng.step()
+        assert not eng._staged_chunks, (
+            "staged chunks survived a scheduler step"
+        )
+        if long_turn.done.is_set() and dec.done.is_set():
+            break
+    assert dec.new_tokens == d0.new_tokens
+    assert long_turn.new_tokens == b0.new_tokens
+
+
+def test_staged_rollback_restores_boundary(build):
+    """Arm the fault BEFORE any window lands: the first fused dispatch
+    (carrying the first staged chunks) faults — the turn must roll
+    back to its pre-stage state (no phantom committed chunks) and
+    still produce the clean stream on retry."""
+    eng0 = build(1, fused=True)
+    b0 = eng0.submit(LONG, sampling=_greedy())
+    eng0.run_until_idle()
+
+    eng = build(1, fused=True)
+    faults.inject("decode_window", times=1, transient=False)
+    dec = eng.submit([5, 6, 7], sampling=_greedy(4))
+    turn = eng.submit(LONG, sampling=_greedy())
+    eng.run_until_idle()
+    faults.clear()
+    assert turn.new_tokens == b0.new_tokens
+    assert dec.finish_reason is not None
+    st = eng.stats()
+    # landed chunk count stays honest: exactly the chunks the prompt
+    # needs (rolled-back staging never double-counts)
+    assert st["prefill_chunks_interleaved"] >= 1
