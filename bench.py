@@ -818,6 +818,112 @@ def main() -> None:
         except Exception as e:
             _phase("warm_restart", {"error": str(e)[:300]})
 
+    # fleet failover (docs/fleet.md): 3 replicas serving, kill the one
+    # holding a mid-stream session, measure TTFT of the re-homed
+    # continuation and assert zero durably-streamed tokens were lost
+    # (the streamed prefix + the resumed stream must equal an unkilled
+    # run). CPU-proxy-falsifiable like the scheduler A/B: the token-
+    # loss count and re-home counters are real on any backend.
+    def measure_fleet_failover() -> dict:
+        from room_tpu.serving.fleet import EngineFleet
+
+        budget = 24 if TINY else 48
+        sp = SamplingParams(temperature=0.0, max_new_tokens=budget)
+        small = SamplingParams(temperature=0.0, max_new_tokens=4)
+
+        def build(i):
+            return ServingEngine(
+                cfg, params, max_batch=4, page_size=16, n_pages=512,
+            )
+
+        ctrl = ServingEngine(
+            cfg, params, max_batch=4, page_size=16, n_pages=512,
+        )
+        cf = ctrl.submit(prompt, session_id="c", sampling=sp)
+        ctrl.run_until_idle()
+        full = list(cf.new_tokens)
+        del ctrl
+        gc.collect()
+
+        fleet = EngineFleet(
+            "bench", build, 3, auto_rebuild=False,
+        )
+        try:
+            # warm pass: every replica compiles its shapes so the
+            # failover TTFT measures re-homing, not XLA
+            for h in fleet.replicas:
+                h.engine.submit(prompt, session_id="warm",
+                                sampling=small)
+                h.engine.run_until_idle()
+                h.engine.release_session("warm")
+            streamed: list = []
+            fleet.submit(prompt, session_id="s", sampling=sp,
+                         on_token=streamed.append)
+            bystanders = [
+                fleet.submit(prompt, session_id=f"lane{i}",
+                             sampling=small)
+                for i in range(2)
+            ]
+            victim = fleet._handle(fleet._records["s"].rid)
+            for _ in range(2000):
+                victim.engine.step()
+                if len(streamed) >= max(4, budget // 4):
+                    break
+            t0 = time.perf_counter()
+            fleet.kill_replica(victim.rid, "bench failover")
+            failover_s = time.perf_counter() - t0
+            n = len(streamed)
+            first: dict = {}
+            t0 = time.perf_counter()
+            t2 = fleet.submit(
+                [], session_id="s",
+                sampling=SamplingParams(
+                    temperature=0.0, max_new_tokens=budget - n,
+                ),
+                on_token=lambda tok: first.setdefault(
+                    "t", time.perf_counter()
+                ),
+            )
+            fleet.run_until_idle()
+            resumed = streamed + list(t2.new_tokens)
+            token_loss = 0 if resumed == full else (
+                len(full) - sum(
+                    1 for a, b in zip(resumed, full) if a == b
+                )
+            )
+            ttft = round(first["t"] - t0, 3) if "t" in first else None
+            if CPU_PROXY and ttft is not None:
+                _proxy_deltas["fleet_failover_ttft_s"] = ttft
+            st = fleet.fleet_stats()
+            return {
+                "replicas": 3,
+                "streamed_before_kill": n,
+                "failover_s": round(failover_s, 3),
+                # null, not phase-elapsed, when the resume never
+                # streamed — a failed failover must not fabricate TTFT
+                "ttft_after_failover_s": ttft,
+                # the acceptance number: MUST be 0 — durably-streamed
+                # tokens survive the kill and the continuation is
+                # token-identical to the unkilled run
+                "tokens_lost": token_loss,
+                "sessions_rehomed": st["sessions_rehomed"],
+                "rehomed_warm": st["sessions_rehomed_warm"],
+                "bystanders_ok": sum(
+                    1 for b in bystanders
+                    if b.finish_reason == "length"
+                ),
+            }
+        finally:
+            del fleet
+            gc.collect()
+
+    if os.environ.get("ROOM_TPU_BENCH_FLEET", "1") != "0":
+        _extend_deadline()
+        try:
+            _phase("fleet_failover", measure_fleet_failover())
+        except Exception as e:
+            _phase("fleet_failover", {"error": str(e)[:300]})
+
     # SLO scheduler A/B (docs/scheduler.md): inject a multi-thousand-
     # token BACKGROUND prefill into a busy room (worker lanes decoding)
     # and land a QUEEN turn mid-prefill. Chunked interleave must bound

@@ -1182,7 +1182,11 @@ def register_aux_routes(r: Router) -> None:
                 # SLO scheduler (docs/scheduler.md): interleaved
                 # chunked-prefill churn
                 "prefill_chunks_interleaved", "prefill_chunk_defers",
-                "prefill_chunk_faults")
+                "prefill_chunk_faults",
+                # fused-window diagnosability (docs/serving.md): a
+                # mixed-mesh fleet must show WHY a replica fell back
+                # to split per-chunk dispatches
+                "fused_window", "fused_window_disabled_reason")
         summary = {
             name: {k: e[k] for k in keys if k in e}
             for name, e in engines.items()
@@ -1202,6 +1206,15 @@ def register_aux_routes(r: Router) -> None:
             # by the TPU panel's scheduler table
             if e.get("scheduler") is not None:
                 summary[name]["scheduler"] = e["scheduler"]
+            # fleet blocks (docs/fleet.md): the aggregate (bare model
+            # key) carries router/failover counters + per-replica
+            # health scores; each model#rid key carries its replica's
+            # placement identity — keyed PER REPLICA so siblings never
+            # overwrite each other's engine blocks
+            if e.get("fleet") is not None:
+                summary[name]["fleet"] = e["fleet"]
+            if e.get("replica") is not None:
+                summary[name]["replica"] = e["replica"]
         swarm = supervision_snapshot()
         # db-less contexts (bare router probes) get zeroed journal stats
         swarm["journal"] = journal_mod.stats(ctx.db) if ctx.db else {

@@ -1,6 +1,7 @@
 from . import faults, lifecycle, scheduler
 from .engine import ServingEngine, Turn
 from .faults import FaultError
+from .fleet import EngineFleet
 from .kv_offload import TieredKVStore
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
 from .sampler import SamplingParams, sample, sample_batched
@@ -15,6 +16,7 @@ from .tokenizer import (
 
 __all__ = [
     "ServingEngine",
+    "EngineFleet",
     "Turn",
     "faults",
     "lifecycle",

@@ -507,6 +507,31 @@ class ServingEngine:
             and self.sched_chunk_tokens > 0
             and self._dp_size == 1
         )
+        # why the fused window is off, for stats()/health/panel: a
+        # fleet of mixed-mesh replicas (some dp-sharded, some not) is
+        # otherwise undiagnosable — the dp auto-off was silent
+        if not knobs.get_bool("ROOM_TPU_FUSED_WINDOW"):
+            self.fused_window_disabled_reason: Optional[str] = \
+                "disabled by ROOM_TPU_FUSED_WINDOW=0"
+        elif self.sched_chunk_tokens <= 0:
+            self.fused_window_disabled_reason = (
+                "interleaved chunked prefill disabled "
+                "(ROOM_TPU_PREFILL_CHUNK_PAGES=0)"
+            )
+        elif self._dp_size != 1:
+            self.fused_window_disabled_reason = (
+                f"auto-off under dp sharding (dp={self._dp_size}): the "
+                "ragged [1, T] token stream has no dp axis (ROADMAP "
+                "dp-sharded fused window open item)"
+            )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "fused dispatch window %s for %s",
+                self.fused_window_disabled_reason, cfg.name,
+            )
+        else:
+            self.fused_window_disabled_reason = None
         self.sessions: dict[str, _Session] = {}
         # admission queue: the scheduler's EDF heap (class TTFT target
         # deadlines), drop-in for the old FIFO queue.Queue surface
@@ -571,6 +596,24 @@ class ServingEngine:
         # set, stats snapshot).
         self._release_requests: "queue.SimpleQueue[str]" = \
             queue.SimpleQueue()
+        # fleet / warm-handoff adoption seam (serving/fleet.py,
+        # docs/fleet.md): parked sessions re-homed onto THIS engine
+        # from a drained or crashed sibling replica. Cross-thread like
+        # _release_requests: the engine thread applies queued adoptions
+        # at the top of each step, BEFORE admission, so a turn
+        # submitted after its session's adoption was enqueued can never
+        # be admitted ahead of it (the turn would otherwise prefill a
+        # fresh session missing its history).
+        self._adoption_requests: "queue.SimpleQueue[tuple]" = \
+            queue.SimpleQueue()
+        # best-effort session state preserved past a FATAL engine
+        # crash (restart budget exhausted) for a fleet supervisor to
+        # re-home; None on a healthy engine. Only collected when a
+        # supervisor is attached (fleet_supervised, set by
+        # EngineFleet) — a lone engine has no consumer, and detaching
+        # spool files for nobody would just leak them
+        self.crash_salvage: Optional[dict] = None
+        self.fleet_supervised = False
         self._loop_thread: Optional[threading.Thread] = None
         # [max_batch, V] per-request generated-token counts for OpenAI
         # presence/frequency penalties; allocated on first penalized
@@ -901,6 +944,26 @@ class ServingEngine:
         except Exception:
             pass
         msg = f"engine crashed: {type(exc).__name__}: {exc}"
+        now = time.monotonic()
+        self._crash_times.append(now)
+        window = max(self.degrade_window_s, 60.0)
+        recent = sum(1 for t in self._crash_times if now - t < window)
+        fatal = recent > self.max_crash_restarts
+        # the restart budget is spent AND a fleet supervisor will
+        # consume the hand-off: preserve what it can re-home onto
+        # sibling replicas before the clears below wipe every session
+        # — parked sessions' history mirrors, plus spool files
+        # detached from the offload store for hibernated ones
+        # (byte-exact warm failover). Pure host work — the device is
+        # exactly what just crashed and is never touched. A LONE
+        # engine skips this: nothing would ever adopt the detached
+        # files, so collecting them would only leak spool bytes.
+        salvaging = fatal and self.fleet_supervised
+        if salvaging:
+            try:
+                self.crash_salvage = self._collect_crash_salvage()
+            except Exception:
+                self.crash_salvage = None
         for i, turn in enumerate(self._active):
             if turn is not None:
                 self._fail_turn_unslotted(turn, msg)
@@ -926,9 +989,13 @@ class ServingEngine:
         self._slot_ahead[:] = 0
         self._feed_tokens = None
         # host/disk copies reference sessions that no longer exist (and
-        # a crash mid-restore may have half-consumed one): drop them all
+        # a crash mid-restore may have half-consumed one): drop them
+        # all. On a FATAL supervised crash the spool dir itself must
+        # survive — crash_salvage just detached spool files in it for
+        # a fleet sibling to adopt, and rmtree would delete those
+        # bytes out from under the hand-off.
         if self.offload_store is not None:
-            self.offload_store.clear()
+            self.offload_store.clear(remove_spool_dir=not salvaging)
         # a crash mid-device-call may have consumed a donated cache
         # buffer: rebuild the pool (and allocator) from scratch rather
         # than trust either side of the page accounting
@@ -944,17 +1011,49 @@ class ServingEngine:
                 self.cache, self._cache_specs, self.mesh
             )
         self._counts = None
-        now = time.monotonic()
-        self._crash_times.append(now)
-        window = max(self.degrade_window_s, 60.0)
-        recent = sum(1 for t in self._crash_times if now - t < window)
-        if recent > self.max_crash_restarts:
+        if fatal:
             self.healthy = False
             return False
         # backoff before resuming: a hard-failing dependency (device,
         # params) must not spin the supervisor at 100% CPU
         time.sleep(min(0.05 * (2 ** min(recent, 6)), 2.0))
         return True
+
+    def _collect_crash_salvage(self) -> dict:
+        """Manifest-style entries for every QUIESCENT session (parked
+        for a tool call, or idle between turns — history/pending
+        consistent by the park/finish contract). Sessions with an
+        active, staged, or queued turn are deliberately excluded:
+        their exact streamed-token state lives in the fleet router's
+        history mirror (serving/fleet.py), which is authoritative for
+        mid-turn failover. Hibernated sessions' offload entries are
+        exported (the spool file detached for a sibling to adopt,
+        byte-exact); resident-only KV re-prefills — those pages belong
+        to the device state that just crashed."""
+        out: dict[str, dict] = {}
+        for sid, sess in list(self.sessions.items()):
+            if self._session_in_flight(sid):
+                continue
+            if not sess.history and sess.pending is None:
+                continue
+            entry: dict = {
+                "id": sid,
+                "history": [int(t) for t in sess.history],
+                "pending": int(sess.pending)
+                if sess.pending is not None else None,
+                "length": len(sess.history),
+                "generation": int(sess.generation),
+                "kv": None,
+            }
+            if self.offload_store is not None and \
+                    sess.prefix_len == 0 and \
+                    len(sess.history) == sess.length:
+                try:
+                    entry["kv"] = self.offload_store.export_entry(sid)
+                except Exception:
+                    entry["kv"] = None
+            out[sid] = entry
+        return out
 
     def _prefill_fn(self, bucket: int, fresh: bool,
                     active_pages: Optional[int] = None):
@@ -1481,6 +1580,12 @@ class ServingEngine:
         out["pallas_decode"] = self._pallas_decode
         out["pallas_prefill"] = self._pallas_prefill
         out["kv_quant"] = self.kv_quant
+        # fused-window diagnosability (docs/serving.md): a fleet of
+        # mixed-mesh replicas (some dp-sharded) must be able to tell
+        # WHY a replica fell back to split per-chunk dispatches
+        out["fused_window"] = self.fused_window
+        out["fused_window_disabled_reason"] = \
+            self.fused_window_disabled_reason
         out["active_slots"] = sum(
             1 for t in self._active if t is not None
         )
@@ -1516,6 +1621,7 @@ class ServingEngine:
         # budget is per-window
         self.scheduler.begin_step()
         self._drain_releases()
+        self._drain_adoptions()
         self._enforce_deadlines()
         self._shed_if_overloaded()
         # sweep before prefetch: demotions free the pages restores need
@@ -1556,8 +1662,9 @@ class ServingEngine:
                 self._inflight = None
             with self._lock:
                 self._loop_thread = None
-            # releases enqueued while stopping still apply
+            # releases / adoptions enqueued while stopping still apply
             self._drain_releases()
+            self._drain_adoptions()
 
     # ---- internals ----
 
@@ -3714,6 +3821,12 @@ class ServingEngine:
         from . import lifecycle as lc
 
         self.begin_drain()
+        # adoptions enqueued but not yet applied (the serve thread
+        # exited before its next step): apply them NOW so a session a
+        # sibling just handed over rides THIS manifest instead of
+        # vanishing — its donor manifest is already consumed, this is
+        # its only record
+        self._drain_adoptions()
         if flush:
             try:
                 self._flush_pipeline()
@@ -3860,6 +3973,160 @@ class ServingEngine:
             "dir": lifecycle_dir,
         }
 
+    def _adopt_entry(
+        self, entry: dict, lifecycle_dir: Optional[str], fp_ok: bool,
+        *, require_sha: bool = True,
+    ) -> tuple[str, Optional[_Session], Optional[str]]:
+        """Validate + register ONE manifest-style session entry — the
+        shared per-entry half of restore_from_manifest and the fleet's
+        cross-replica adoption seam (docs/fleet.md). Returns (status,
+        session, adopted spool basename): 'resumed' (spool adopted
+        into the offload disk tier — the next prefill restores
+        byte-exact), 'reprefill' (history-mirror fallback), or
+        'skipped' (malformed / empty / duplicate id). ``require_sha``
+        relaxes the manifest's checksum requirement for same-process
+        fleet handoffs, whose spool files were written by a replica
+        this process already trusts."""
+        try:
+            sid = entry["id"]
+            history = [int(t) for t in entry.get("history") or []]
+            pending = entry.get("pending")
+            pending = int(pending) if pending is not None else None
+            generation = int(entry.get("generation") or 0)
+            if not isinstance(sid, str) or not sid or (
+                not history and pending is None
+            ) or sid in self.sessions:
+                return "skipped", None, None
+        except (KeyError, TypeError, ValueError):
+            return "skipped", None, None
+        sess = _Session(
+            id=sid, parked=True, pending=pending,
+            history=history, generation=generation,
+        )
+        kv = entry.get("kv")
+        adopted_fname = None
+        if isinstance(kv, dict) and fp_ok and \
+                self.offload_store is not None:
+            raw = str(kv.get("file") or "")
+            fname = os.path.basename(raw)
+            # fleet handoffs carry absolute spool paths (the donor's
+            # own spool dir); manifest entries are basenames resolved
+            # against the manifest's dir
+            path = raw if os.path.isabs(raw) else os.path.join(
+                lifecycle_dir or "", fname
+            )
+            sha = kv.get("sha256")
+            try:
+                faults.maybe_fail("shutdown_io")
+                own = int(kv["own_tokens"])
+                n_pages = int(kv["n_pages"])
+                # metadata-only validation — the sha256 (when present)
+                # is verified lazily at the session's first spool read
+                # (TieredKVStore.get), so adoption never reads the KV
+                # bytes; a size mismatch is caught here for free,
+                # anything subtler degrades to a re-prefill miss at
+                # first use
+                good = (
+                    fname.endswith(".kvspool")
+                    and own == len(history) == int(
+                        entry.get("length") or -1
+                    )
+                    and (bool(sha) or not require_sha)
+                    and n_pages == -(-own // self.page_size)
+                    and os.path.getsize(path) == int(
+                        kv.get("nbytes") or -1
+                    )
+                )
+            except (FaultError, KeyError, TypeError, ValueError,
+                    OSError):
+                good = False
+            if good and self.offload_store.adopt(
+                sid, path, own, n_pages, int(kv.get("nbytes") or 0),
+                sha256=str(sha) if sha else None,
+            ):
+                sess.length = own
+                adopted_fname = fname
+        if adopted_fname is None:
+            # history mirror re-prefill (|history| == length holds
+            # once the resume prefill rebuilds the pages)
+            sess.length = 0
+        self.sessions[sid] = sess
+        return (
+            ("resumed" if adopted_fname else "reprefill"),
+            sess, adopted_fname,
+        )
+
+    def adopt_parked_session(
+        self,
+        entry: dict,
+        *,
+        lifecycle_dir: Optional[str] = None,
+        fingerprint: Optional[dict] = None,
+        require_sha: bool = False,
+    ) -> threading.Event:
+        """Re-home a parked session onto this engine (fleet failover /
+        blue-green absorb; docs/fleet.md). ``entry`` is a
+        manifest-style session record; its ``kv`` spool file (when
+        present and valid against this engine's config) is adopted
+        into the offload disk tier so the session's next turn restores
+        byte-exact — anything else re-prefills from the entry's token
+        history. ``fingerprint`` (the donor manifest's) must equal
+        this engine's; None means the caller vouches for config
+        identity (a same-process sibling replica of the same model).
+
+        Thread-safe: when a loop thread owns the engine the adoption
+        is queued and applied at the next step BEFORE admission —
+        callers enqueue the adoption, then submit the session's next
+        turn, and the step ordering guarantees admission sees the
+        adopted session. The returned Event is set once the adoption
+        has been applied (immediately when applied inline)."""
+        done = threading.Event()
+        with self._lock:
+            loop = self._loop_thread
+        if loop is not None and loop.is_alive() and \
+                loop is not threading.current_thread():
+            self._adoption_requests.put(
+                (entry, lifecycle_dir, fingerprint, require_sha, done)
+            )
+            # the loop may have exited between the check and the put;
+            # if nobody owns the engine anymore, apply the queue now
+            with self._lock:
+                loop = self._loop_thread
+            if loop is None or not loop.is_alive():
+                self._drain_adoptions()
+            return done
+        self._apply_adoption(
+            entry, lifecycle_dir, fingerprint, require_sha
+        )
+        done.set()
+        return done
+
+    def _drain_adoptions(self) -> None:
+        while True:
+            try:
+                entry, lc_dir, fp, require_sha, done = \
+                    self._adoption_requests.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._apply_adoption(entry, lc_dir, fp, require_sha)
+            finally:
+                done.set()
+
+    def _apply_adoption(
+        self, entry, lifecycle_dir, fingerprint, require_sha,
+    ) -> str:
+        fp_ok = fingerprint is None or \
+            fingerprint == self._lifecycle_fingerprint()
+        status, _, _ = self._adopt_entry(
+            entry, lifecycle_dir, fp_ok, require_sha=require_sha
+        )
+        if status == "resumed":
+            self._lc_bump("sessions_resumed")
+        elif status == "reprefill":
+            self._lc_bump("sessions_reprefill")
+        return status
+
     def restore_from_manifest(
         self, lifecycle_dir: Optional[str] = None
     ) -> dict:
@@ -3877,7 +4144,12 @@ class ServingEngine:
         token-identical, just slower). Never raises; consumes the
         manifest so a later crash
         cannot resurrect stale sessions; sweeps orphaned spool files on
-        the way out."""
+        the way out.
+
+        Also absorbs fleet per-replica sub-manifests (``replica-*/``
+        and ``bluegreen-*/`` under the dir, docs/fleet.md): rolling a
+        fleet deployment back to ROOM_TPU_FLEET_REPLICAS=1 must not
+        silently lose the sessions the fleet's drain spooled."""
         from . import lifecycle as lc
 
         if lifecycle_dir is None:
@@ -3886,97 +4158,10 @@ class ServingEngine:
         self.lifecycle_phase = "warming"
         summary = {"resumed": 0, "reprefill": 0, "skipped": 0,
                    "manifest": False}
-        manifest = lc.read_manifest(lifecycle_dir)
-        if manifest is None:
-            if os.path.exists(
-                os.path.join(lifecycle_dir, lc.MANIFEST_NAME)
-            ):
-                self._lc_bump("manifest_errors")
-            lc.sweep_orphans(lifecycle_dir)
-            with self._lock:
-                # same begin-drain guard as the manifest-present exit:
-                # a SIGTERM landing mid-restore must not be clobbered
-                # back to serving, reopening admission mid-shutdown
-                if self.lifecycle_phase == "warming":
-                    self.lifecycle_phase = "serving" \
-                        if prev_phase != "draining" else prev_phase
-            return summary
-        summary["manifest"] = True
-        fp_ok = manifest.get("version") == lc.MANIFEST_VERSION and \
-            manifest.get("fingerprint") == self._lifecycle_fingerprint()
-        adopted_files: set[str] = set()
         adopted_sess: dict[str, _Session] = {}
-        # COLDEST first: adopt() rebalances the disk tier by evicting
-        # the lowest last_used entry, and adoption time IS last_used —
-        # so when the manifest's bytes exceed this engine's disk cap,
-        # iterating the (warmest-first) manifest in reverse makes the
-        # overflow evict the coldest sessions, preserving the drain's
-        # warmest-first priority instead of inverting it
-        for entry in reversed(manifest.get("sessions", [])):
-            try:
-                sid = entry["id"]
-                history = [int(t) for t in entry["history"]]
-                pending = entry.get("pending")
-                pending = int(pending) if pending is not None else None
-                generation = int(entry.get("generation") or 0)
-                if not isinstance(sid, str) or not sid or (
-                    not history and pending is None
-                ) or sid in self.sessions:
-                    summary["skipped"] += 1
-                    continue
-            except (KeyError, TypeError, ValueError):
-                summary["skipped"] += 1
-                continue
-            sess = _Session(
-                id=sid, parked=True, pending=pending,
-                history=history, generation=generation,
-            )
-            kv = entry.get("kv")
-            adopted = False
-            if isinstance(kv, dict) and fp_ok and \
-                    self.offload_store is not None:
-                fname = os.path.basename(str(kv.get("file") or ""))
-                path = os.path.join(lifecycle_dir, fname)
-                try:
-                    faults.maybe_fail("shutdown_io")
-                    own = int(kv["own_tokens"])
-                    n_pages = int(kv["n_pages"])
-                    # metadata-only validation — the manifest's sha256
-                    # is verified lazily at the session's first spool
-                    # read (TieredKVStore.get), so boot never reads
-                    # the KV bytes; a size mismatch is caught here for
-                    # free, anything subtler degrades to a re-prefill
-                    # miss at first use
-                    good = (
-                        fname.endswith(".kvspool")
-                        and own == len(history) == int(
-                            entry.get("length") or -1
-                        )
-                        and bool(kv.get("sha256"))
-                        and n_pages == -(-own // self.page_size)
-                        and os.path.getsize(path) == int(
-                            kv.get("nbytes") or -1
-                        )
-                    )
-                except (FaultError, KeyError, TypeError, ValueError,
-                        OSError):
-                    good = False
-                if good and self.offload_store.adopt(
-                    sid, path, own, n_pages,
-                    int(kv.get("nbytes") or 0),
-                    sha256=str(kv["sha256"]),
-                ):
-                    sess.length = own
-                    adopted = True
-                    adopted_files.add(fname)
-            if adopted:
-                adopted_sess[sid] = sess
-            else:
-                # history mirror re-prefill (|history| == length holds
-                # once the resume prefill rebuilds the pages)
-                sess.length = 0
-                summary["reprefill"] += 1
-            self.sessions[sid] = sess
+        dirs = [lifecycle_dir] + lc.manifest_subdirs(lifecycle_dir)
+        for d in dirs:
+            self._restore_dir(d, summary, adopted_sess)
         # a later adopt's rebalance may have evicted an earlier one
         # (disk cap overflow): count only entries that SURVIVED the
         # whole restore as resumed, and demote the evicted back to the
@@ -3993,11 +4178,6 @@ class ServingEngine:
             st = self._lifecycle_stats
             st["sessions_resumed"] += summary["resumed"]
             st["sessions_reprefill"] += summary["reprefill"]
-        lc.consume_manifest(lifecycle_dir)
-        # everything the manifest no longer protects: fallback spool
-        # files from THIS restore plus any older process's leavings
-        lc.sweep_orphans(lifecycle_dir, keep=adopted_files,
-                         max_age_s=0.0)
         try:
             from ..core.telemetry import incr_counter
 
@@ -4016,3 +4196,48 @@ class ServingEngine:
                 self.lifecycle_phase = "serving" \
                     if prev_phase != "draining" else prev_phase
         return summary
+
+    def _restore_dir(
+        self, lifecycle_dir: str, summary: dict,
+        adopted_sess: dict,
+    ) -> None:
+        """Absorb ONE manifest dir into this engine (the per-dir half
+        of restore_from_manifest). Missing manifest → orphan sweep
+        only; present one is consumed and its unprotected spool files
+        swept."""
+        from . import lifecycle as lc
+
+        manifest = lc.read_manifest(lifecycle_dir)
+        if manifest is None:
+            if os.path.exists(
+                os.path.join(lifecycle_dir, lc.MANIFEST_NAME)
+            ):
+                self._lc_bump("manifest_errors")
+            lc.sweep_orphans(lifecycle_dir)
+            return
+        summary["manifest"] = True
+        fp_ok = manifest.get("version") == lc.MANIFEST_VERSION and \
+            manifest.get("fingerprint") == self._lifecycle_fingerprint()
+        adopted_files: set[str] = set()
+        # COLDEST first: adopt() rebalances the disk tier by evicting
+        # the lowest last_used entry, and adoption time IS last_used —
+        # so when the manifest's bytes exceed this engine's disk cap,
+        # iterating the (warmest-first) manifest in reverse makes the
+        # overflow evict the coldest sessions, preserving the drain's
+        # warmest-first priority instead of inverting it
+        for entry in reversed(manifest.get("sessions", [])):
+            status, sess, fname = self._adopt_entry(
+                entry, lifecycle_dir, fp_ok
+            )
+            if status == "resumed":
+                adopted_sess[sess.id] = sess
+                adopted_files.add(fname)
+            elif status == "reprefill":
+                summary["reprefill"] += 1
+            else:
+                summary["skipped"] += 1
+        lc.consume_manifest(lifecycle_dir)
+        # everything the manifest no longer protects: fallback spool
+        # files from THIS restore plus any older process's leavings
+        lc.sweep_orphans(lifecycle_dir, keep=adopted_files,
+                         max_age_s=0.0)

@@ -29,6 +29,16 @@ named *fault point* that tests (and staging deployments) can arm:
                        warmth (the restart re-prefills), a failed read
                        cold-starts — a drain or boot never hangs or
                        crashes on it
+    replica_crash      one engine replica of a fleet dies hard
+                       (docs/fleet.md): the supervisor re-homes its
+                       sessions onto siblings — warm via adopted spool
+                       files, re-prefill from the router's history
+                       mirror otherwise — losing zero durably-streamed
+                       tokens
+    router_io          the fleet router's placement lookup fails
+                       (docs/fleet.md): bounded retry; exhaustion sheds
+                       the turn with the 503 contract — a session is
+                       NEVER misrouted to a replica without its KV
 
 Swarm-layer points (docs/swarm_recovery.md) thread the same registry
 up through the agent runtime above the engine:
@@ -74,6 +84,8 @@ FAULT_POINTS = (
     "decode_step", "decode_window",
     "decode_stall", "tokenizer", "engine_crash", "client_disconnect",
     "provider_timeout", "offload_io", "shutdown_io",
+    # engine replica fleet (docs/fleet.md)
+    "replica_crash", "router_io",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
 )

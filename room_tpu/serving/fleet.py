@@ -1,0 +1,1234 @@
+"""Engine replica fleet: crash failover, KV-affinity routing,
+blue/green drains (docs/fleet.md).
+
+One ``ModelHost`` used to mean ONE engine per model — an engine that
+crash-looped past its restart budget took every Queen/Worker session
+with it, and a rolling deploy was a full outage. ``EngineFleet`` is the
+layer above: N ``ServingEngine`` replicas of one model (hetero
+submeshes on one host — the pattern MULTICHIP proves; cross-host later
+via ``parallel/multihost.py``) behind a KV-affinity router.
+
+**Routing.** Sessions are placed where their prefix/KV already lives: a
+session's first turn goes to the healthiest replica (health score =
+serving state × degradation rung × queue depth × active slots ×
+restart strikes) and every later turn follows the placement — routing a
+turn anywhere else would prefill a fresh session missing its history.
+The ``router_io`` fault point models the placement lookup failing:
+bounded retry, then a clean 503-contract shed — a session is NEVER
+misrouted. EDF class priorities (queen > worker > background,
+docs/scheduler.md) pass through untouched: each replica runs its own
+scheduler, and the router only picks WHICH replica admits the turn.
+
+**Crash failover.** The router keeps a per-session history mirror (the
+prompt + streamed tokens — ints, same cost argument as the engine's own
+mirror). When a replica dies — engine thread crash past the restart
+budget, or the ``replica_crash`` fault — the supervisor re-homes its
+sessions onto siblings through the engine's adoption seam
+(``ServingEngine.adopt_parked_session``): **warm** via spool files a
+drain/hibernate landed (the dying engine's ``crash_salvage`` +
+``TieredKVStore.export_entry`` detach byte-exact KV for the sibling to
+adopt), **re-prefill from the mirror** otherwise. Zero durably-streamed
+tokens are lost either way: the mirror's last streamed token re-enters
+as the session's pending token, exactly the park contract, so greedy
+continuations are token-identical to an unkilled run.
+
+**Blue/green.** ``drain_replica`` is the deploy primitive: stop routing
+to the replica, let its in-flight turns finish streaming (no 503s —
+queen turns survive a rolling deploy), drain it to a handoff manifest
+(``ServingEngine.drain``), absorb the manifest's sessions into the
+siblings, then ``rebuild_replica`` swaps in the new build. The process
+level drain/restore (``ModelHost`` SIGTERM path) fans out per replica:
+each drains to its own subdir, and the next boot's restore absorbs
+every manifest it finds — tolerant of a fleet-size change across the
+restart.
+
+Env knobs (docs/knobs.md):
+
+    ROOM_TPU_FLEET_REPLICAS   engine replicas per served model (1 =
+                              no fleet, the classic single engine)
+    ROOM_TPU_FLEET_MESHES     ';'-separated per-replica mesh specs
+    ROOM_TPU_FLEET_STRIKES    replica death strikes before the
+                              supervisor stops rebuilding it
+    ROOM_TPU_FLEET_TICK_S     supervision poll interval
+    ROOM_TPU_FLEET_REBUILD    auto-rebuild crashed replicas (within
+                              the strike budget)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from . import faults
+from . import lifecycle as lifecycle_mod
+from ..utils import knobs
+from .engine import Turn
+from .faults import FaultError
+from .sampler import SamplingParams
+
+__all__ = ["EngineFleet", "ReplicaHandle", "fleet_replicas_from_env"]
+
+log = logging.getLogger(__name__)
+
+
+def fleet_replicas_from_env() -> int:
+    try:
+        return max(1, knobs.get_int(
+            "ROOM_TPU_FLEET_REPLICAS", scope="provider"
+        ))
+    except ValueError:
+        return 1
+
+
+@dataclass
+class _SessionRecord:
+    """Router-level view of one session: which replica holds its KV,
+    and the token stream (prompt + streamed tokens) needed to re-home
+    it if that replica dies mid-turn. Ints only — same cost argument
+    as the engine's own history mirror."""
+
+    sid: str
+    rid: str
+    tokens: list = field(default_factory=list)
+    generation: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+    rehomed: int = 0
+    # a re-home that found NO serving sibling defers: the manifest
+    # entry parks here (rid="") and the next _route adopts it into
+    # whichever replica it places the session on. pending_fingerprint
+    # rides along for entries from a manifest (None = same-process
+    # salvage, config identity vouched)
+    pending_entry: Optional[dict] = None
+    pending_fingerprint: Optional[dict] = None
+    # per-record lock for the token mirror: the hot per-token append
+    # must not contend on the fleet-wide lock across replicas (one
+    # session has at most one active turn, so this lock only ever
+    # serializes the appender against a failover's mirror read)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class ReplicaHandle:
+    """One engine replica under fleet supervision."""
+
+    def __init__(self, rid: str, index: int, engine: Any) -> None:
+        self.rid = rid
+        self.index = index
+        self.engine = engine
+        self.thread: Optional[threading.Thread] = None
+        self.stop = threading.Event()
+        # serving -> draining -> drained (blue/green) | dead (crash)
+        self.state = "serving"
+        self.strikes = 0
+        # set once a dead replica's sessions have been re-homed; stays
+        # False while a wedged serve thread could still be streaming
+        # (re-homing then would fork the mirror mid-stream)
+        self.rehomed_done = False
+        # set once a blue/green drain has absorbed this replica's
+        # sessions into siblings: affinity-blocked submitters wait on
+        # it instead of 503ing
+        self.drained = threading.Event()
+
+    def start_thread(self) -> None:
+        if self.thread is not None and self.thread.is_alive():
+            return
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.engine.serve_forever,
+            args=(self.stop,),
+            daemon=True,
+            name=f"fleet-replica-{self.rid}",
+        )
+        self.thread.start()
+
+    def is_serving(self) -> bool:
+        return self.state == "serving" and \
+            getattr(self.engine, "healthy", True)
+
+    def health_score(self) -> float:
+        """Placement score, higher = better home for a new session.
+        Dead/draining replicas score 0; among serving replicas the
+        score penalizes queue depth, occupied slots, the degradation
+        rung, and restart strikes — the router sends new sessions
+        where capacity and stability actually are."""
+        if not self.is_serving():
+            return 0.0
+        eng = self.engine
+        try:
+            queued = eng._queue.qsize()
+            active = sum(1 for t in eng._active if t is not None)
+            rung = eng.degradation_level()
+        except Exception:
+            queued = active = rung = 0
+        return max(
+            1.0,
+            100.0 - 2.0 * queued - 1.0 * active - 10.0 * rung
+            - 5.0 * self.strikes,
+        )
+
+
+class _FleetSessions:
+    """Read-only merged view over the replicas' session dicts.
+    ``in`` / ``len`` (the provider's per-execute hot path) are one
+    atomic dict op per replica; iteration snapshots with a bounded
+    retry against concurrent serve-thread mutation."""
+
+    def __init__(self, fleet: "EngineFleet") -> None:
+        self._fleet = fleet
+
+    def _live(self) -> list[dict]:
+        return [
+            h.engine.sessions for h in self._fleet.replicas
+            if h.state != "dead"
+        ]
+
+    def __contains__(self, sid) -> bool:
+        return any(sid in d for d in self._live())
+
+    def __len__(self) -> int:
+        return sum(len(d) for d in self._live())
+
+    def _snapshot(self) -> dict:
+        out: dict = {}
+        for d in self._live():
+            for _ in range(3):
+                try:
+                    out.update(d)
+                    break
+                except RuntimeError:
+                    continue  # resized mid-copy; retry
+        return out
+
+    def __iter__(self):
+        return iter(self._snapshot())
+
+    def __getitem__(self, sid):
+        for d in self._live():
+            try:
+                return d[sid]
+            except KeyError:
+                continue
+        raise KeyError(sid)
+
+    def get(self, sid, default=None):
+        try:
+            return self[sid]
+        except KeyError:
+            return default
+
+    def keys(self):
+        return self._snapshot().keys()
+
+    def items(self):
+        return self._snapshot().items()
+
+    def values(self):
+        return self._snapshot().values()
+
+
+class EngineFleet:
+    """N engine replicas of one model behind a KV-affinity router.
+
+    Drop-in for a single ``ServingEngine`` on the provider surface:
+    ``submit / text_of / release_session / sessions / stats / healthy /
+    begin_drain / drain / restore_from_manifest / serve_forever`` all
+    exist with fleet-wide semantics, so ``providers/tpu.ModelHost``
+    holds either without caring which.
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        build_engine: Callable[[int], Any],
+        n_replicas: Optional[int] = None,
+        *,
+        auto_rebuild: Optional[bool] = None,
+    ) -> None:
+        self.model_name = model_name
+        self._build_engine = build_engine
+        self.n_replicas = n_replicas or fleet_replicas_from_env()
+        self.max_strikes = knobs.get_int("ROOM_TPU_FLEET_STRIKES")
+        self.tick_s = knobs.get_float("ROOM_TPU_FLEET_TICK_S")
+        self.auto_rebuild = auto_rebuild if auto_rebuild is not None \
+            else knobs.get_bool("ROOM_TPU_FLEET_REBUILD")
+        self._lock = threading.Lock()
+        self._records: dict[str, _SessionRecord] = {}
+        self._rr = 0   # round-robin cursor for re-home spreading
+        self._threads_started = False
+        self.lifecycle_phase = "starting"
+        self._stats = {
+            "failovers": 0, "sessions_rehomed": 0,
+            "sessions_rehomed_warm": 0,
+            "sessions_rehomed_reprefill": 0,
+            "replica_rebuilds": 0, "bluegreen_drains": 0,
+            "router_retries": 0, "router_shed": 0,
+        }
+        self.replicas: list[ReplicaHandle] = [
+            ReplicaHandle(f"r{i}", i, build_engine(i))
+            for i in range(self.n_replicas)
+        ]
+        for h in self.replicas:
+            # arms fatal-crash salvage: the engine only detaches spool
+            # files for a hand-off when a supervisor exists to consume
+            # it (engine._recover_from_crash)
+            h.engine.fleet_supervised = True
+        self.lifecycle_phase = "serving"
+
+    # ---- small helpers ----
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def _handle(self, rid: str) -> Optional[ReplicaHandle]:
+        for h in self.replicas:
+            if h.rid == rid:
+                return h
+        return None
+
+    def _serving_replicas(
+        self, exclude: Optional[str] = None
+    ) -> list[ReplicaHandle]:
+        return [
+            h for h in self.replicas
+            if h.is_serving() and h.rid != exclude
+        ]
+
+    @property
+    def healthy(self) -> bool:
+        """The fleet fails closed only when NO replica can serve —
+        one crashed sibling is the failover path working, not an
+        unhealthy model."""
+        return bool(self._serving_replicas())
+
+    @property
+    def tokenizer(self):
+        return self.replicas[0].engine.tokenizer
+
+    @property
+    def max_batch(self) -> int:
+        return sum(
+            h.engine.max_batch for h in self.replicas
+            if h.state != "dead"
+        )
+
+    @property
+    def sessions(self) -> "_FleetSessions":
+        """Merged read-only session view across live replicas
+        (provider surface: membership tests and counts, the hot
+        paths, are single GIL-atomic dict ops per replica — never an
+        iteration over a dict a serve thread is mutating)."""
+        return _FleetSessions(self)
+
+    def text_of(self, turn: Turn) -> str:
+        return self.tokenizer.decode(turn.new_tokens)
+
+    # ---- routing ----
+
+    def _shed_turn(
+        self, sid: str, prompt_tokens, sampling, turn_class, msg: str,
+    ) -> Turn:
+        """Fail a turn at the router with the engine's exact shed
+        contract (503 + Retry-After at the routes layer)."""
+        turn = Turn(
+            session_id=sid,
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling or SamplingParams(),
+            turn_class=turn_class or "worker",
+        )
+        turn.shed = True
+        turn.error = msg
+        turn.finish_reason = "error"
+        turn.done.set()
+        self._bump("router_shed")
+        return turn
+
+    def _route(
+        self, sid: str, wait_s: float = 60.0
+    ) -> Optional[ReplicaHandle]:
+        """Resolve a session to its replica. Affinity first: a placed
+        session ALWAYS goes where its KV/history lives. A placement on
+        a draining replica waits for the blue/green absorb to move it
+        (bounded), then follows the new placement; a placement on a
+        dead replica triggers failover re-homing inline (the
+        supervisor normally got there first)."""
+        deadline = time.monotonic() + wait_s
+        while True:
+            with self._lock:
+                rec = self._records.get(sid)
+                rid = rec.rid if rec else None
+            if rid is None:
+                return self._pick_replica()
+            if rid == "":
+                # deferred re-home: a failover found no serving
+                # sibling and parked the session's entry on the
+                # record — adopt it into the replica we place on now
+                handle = self._pick_replica()
+                if handle is None:
+                    return None
+                with self._lock:
+                    if rec.rid != "":
+                        continue   # a concurrent route placed it
+                    entry = rec.pending_entry
+                    fp = rec.pending_fingerprint
+                    rec.pending_entry = None
+                    rec.pending_fingerprint = None
+                    rec.rid = handle.rid
+                if entry is not None:
+                    # enqueued BEFORE the caller submits the turn, so
+                    # the engine applies it ahead of admission
+                    handle.engine.adopt_parked_session(
+                        entry, fingerprint=fp, require_sha=False,
+                    )
+                return handle
+            handle = self._handle(rid)
+            if handle is None:
+                with self._lock:
+                    self._records.pop(sid, None)
+                return self._pick_replica()
+            if handle.is_serving():
+                return handle
+            if handle.state in ("draining", "drained"):
+                # blue/green: the session is being absorbed by a
+                # sibling — wait for the handoff instead of 503ing
+                # (queen turns must survive a rolling deploy), then
+                # loop to follow the updated placement
+                if not handle.drained.wait(
+                    timeout=max(0.0, deadline - time.monotonic())
+                ):
+                    return None
+                with self._lock:
+                    rec = self._records.get(sid)
+                    if rec is not None and rec.rid == handle.rid:
+                        # the handoff completed WITHOUT this session
+                        # (a record can exist for a turn the replica
+                        # shed before any engine session formed):
+                        # nothing durable lives there — place fresh
+                        # instead of spinning on the stale record
+                        self._records.pop(sid, None)
+                if time.monotonic() > deadline:
+                    return None
+                continue
+            # dead and not yet re-homed: run the failover now (_bury
+            # is idempotent — a concurrent supervisor pass may be
+            # mid-re-home, so back off briefly instead of spinning on
+            # the fleet lock it needs)
+            self._bury(handle, "dead replica found at routing")
+            time.sleep(0.01)
+            if time.monotonic() > deadline:
+                return None
+
+    def _pick_replica(self) -> Optional[ReplicaHandle]:
+        cands = self._serving_replicas()
+        if not cands:
+            return None
+        return max(cands, key=lambda h: h.health_score())
+
+    def submit(
+        self,
+        prompt_tokens,
+        *,
+        session_id: Optional[str] = None,
+        sampling: Optional[SamplingParams] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        stop_strings: Optional[list] = None,
+        deadline_s: Optional[float] = None,
+        priority: Optional[int] = None,
+        turn_class: Optional[str] = None,
+    ) -> Turn:
+        """Queue a turn on the session's replica (KV affinity), or the
+        healthiest replica for a fresh session. Same signature and
+        Turn contract as ``ServingEngine.submit``; the priority class
+        rides through to the replica's own EDF scheduler untouched."""
+        sid = session_id or f"s{id(object())}-{time.monotonic_ns()}"
+        if self.lifecycle_phase == "draining":
+            return self._shed_turn(
+                sid, prompt_tokens, sampling, turn_class,
+                "draining: engine is restarting; retry shortly",
+            )
+        # router_io fault point: the placement lookup fails — bounded
+        # retry, then shed cleanly. NEVER fall through to an arbitrary
+        # replica: a misrouted session would prefill fresh and fork
+        # its history.
+        err: Optional[FaultError] = None
+        for attempt in range(3):
+            try:
+                faults.maybe_fail("router_io")
+                err = None
+                break
+            except FaultError as e:
+                err = e
+                self._bump("router_retries")
+                if not e.transient:
+                    break
+                time.sleep(0.005 * (attempt + 1))
+        if err is not None:
+            return self._shed_turn(
+                sid, prompt_tokens, sampling, turn_class,
+                f"fleet router unavailable: {err}",
+            )
+        handle = self._route(sid)
+        if handle is None:
+            return self._shed_turn(
+                sid, prompt_tokens, sampling, turn_class,
+                "no healthy replica available; retry shortly",
+            )
+        rec = self._record_for(sid, handle)
+        wrapped = self._mirror_on_token(
+            rec, list(prompt_tokens), on_token
+        )
+        turn = handle.engine.submit(
+            prompt_tokens,
+            session_id=sid,
+            sampling=sampling,
+            on_token=wrapped,
+            stop_strings=stop_strings,
+            deadline_s=deadline_s,
+            priority=priority,
+            turn_class=turn_class,
+        )
+        if not handle.is_serving() and not turn.done.is_set():
+            # TOCTOU: the replica died between routing and the
+            # enqueue — a turn parked on a dead engine's queue would
+            # never be stepped OR failed, hanging its caller for the
+            # full wait timeout. The engine skips done-set turns at
+            # admission, so failing it here is race-safe; the caller
+            # gets the fast shed/503 contract and retries onto the
+            # re-homed session.
+            turn.shed = True
+            turn.error = "replica died during submit; retry shortly"
+            turn.finish_reason = "error"
+            turn.done.set()
+            self._bump("router_shed")
+        return turn
+
+    def _record_for(
+        self, sid: str, handle: ReplicaHandle
+    ) -> _SessionRecord:
+        with self._lock:
+            rec = self._records.get(sid)
+            if rec is None:
+                rec = _SessionRecord(sid=sid, rid=handle.rid)
+                self._records[sid] = rec
+            else:
+                rec.rid = handle.rid
+            rec.last_used = time.monotonic()
+            return rec
+
+    def _mirror_on_token(
+        self, rec: _SessionRecord, prompt: list, cb,
+    ) -> Callable[[int], None]:
+        """Wrap a turn's on_token so the router mirror tracks exactly
+        the durably-streamed tokens. The turn's prompt is booked at the
+        FIRST streamed token: a turn that dies before streaming did
+        nothing durable, so its retry against a re-homed session must
+        behave as if the turn never ran."""
+        state = {"booked": False}
+
+        def wrapped(tok: int) -> None:
+            with rec.lock:
+                if not state["booked"]:
+                    rec.tokens.extend(int(t) for t in prompt)
+                    state["booked"] = True
+                rec.tokens.append(int(tok))
+                rec.last_used = time.monotonic()
+            if cb is not None:
+                cb(tok)
+
+        return wrapped
+
+    def release_session(self, session_id: str) -> None:
+        with self._lock:
+            rec = self._records.pop(session_id, None)
+        if rec is not None:
+            handle = self._handle(rec.rid)
+            targets = [handle] if handle is not None else []
+        else:
+            targets = list(self.replicas)
+        for h in targets:
+            if h.state != "dead":
+                h.engine.release_session(session_id)
+
+    # ---- supervision / failover ----
+
+    def serve_forever(
+        self, stop_event: threading.Event, idle_sleep: Optional[float] = None,
+    ) -> None:
+        """The fleet's background loop (what ModelHost's engine thread
+        runs): start every replica's serve thread, then supervise —
+        detect dead replicas, re-home their sessions, rebuild under the
+        strike budget."""
+        self.start_threads()
+        tick = idle_sleep if idle_sleep is not None else \
+            max(0.05, self.tick_s)
+        try:
+            while not stop_event.wait(tick):
+                self.supervise()
+        finally:
+            for h in self.replicas:
+                h.stop.set()
+
+    def start_threads(self) -> None:
+        self._threads_started = True
+        for h in self.replicas:
+            if h.state == "serving":
+                h.start_thread()
+
+    def supervise(self) -> None:
+        """One supervision pass: fire the ``replica_crash`` chaos
+        fault (kills the busiest serving replica), bury replicas whose
+        engine went unhealthy or whose thread died un-asked, restart
+        threads that merely exited, rebuild dead replicas under the
+        strike budget."""
+        spec = faults.should_fire("replica_crash")
+        if spec is not None:
+            victim = self._pick_crash_victim()
+            if victim is not None:
+                self.kill_replica(
+                    victim.rid, reason="injected replica_crash"
+                )
+        for h in list(self.replicas):
+            if h.state != "serving":
+                continue
+            if not getattr(h.engine, "healthy", True):
+                self._bury(h, "engine crash-restart budget exhausted")
+                continue
+            if h.thread is not None and not h.thread.is_alive() and \
+                    not h.stop.is_set():
+                # the loop thread died but the engine is serviceable:
+                # supervised restart (same contract ModelHost gave a
+                # single engine)
+                h.start_thread()
+        for h in list(self.replicas):
+            # a re-home deferred on a wedged serve thread completes
+            # the moment the thread actually exits
+            if h.state == "dead" and not h.rehomed_done and (
+                h.thread is None or not h.thread.is_alive()
+            ):
+                self._finish_rehome(h)
+        if self.auto_rebuild:
+            for h in list(self.replicas):
+                if h.state == "dead" and h.strikes <= self.max_strikes:
+                    self.rebuild_replica(h.rid)
+
+    def _pick_crash_victim(self) -> Optional[ReplicaHandle]:
+        cands = self._serving_replicas()
+        if not cands:
+            return None
+        # the busiest replica: the worst case a chaos test wants
+        return min(cands, key=lambda h: h.health_score())
+
+    def kill_replica(self, rid: str, reason: str = "killed") -> bool:
+        """Hard-kill a replica (chaos / ops): stop its thread, mark
+        the engine dead, and re-home its sessions. Models a crash past
+        the restart budget — the in-flight window is dropped, never
+        flushed."""
+        h = self._handle(rid)
+        if h is None or h.state in ("dead",):
+            return False
+        h.stop.set()
+        if h.thread is not None:
+            h.thread.join(timeout=30.0)
+        h.engine.healthy = False
+        self._bury(h, reason)
+        return True
+
+    def _bury(self, h: ReplicaHandle, reason: str) -> None:
+        """Mark a replica dead and re-home everything it held. A
+        WEDGED serve thread (kill join timed out) defers the re-home:
+        the thread could still be streaming into the session mirrors,
+        and a snapshot taken now would fork mid-stream — supervise()
+        finishes the job once the thread actually dies (affinity turns
+        shed 503 in the meantime)."""
+        with self._lock:
+            if h.state == "dead":
+                return
+            h.state = "dead"
+            h.strikes += 1
+            h.rehomed_done = False
+        self._bump("failovers")
+        log.warning(
+            "fleet %s: replica %s died (%s); re-homing sessions",
+            self.model_name, h.rid, reason,
+        )
+        if h.thread is not None and h.thread.is_alive():
+            log.warning(
+                "fleet %s: replica %s serve thread still alive; "
+                "deferring re-home until it exits",
+                self.model_name, h.rid,
+            )
+            return
+        self._finish_rehome(h)
+
+    def _finish_rehome(self, h: ReplicaHandle) -> None:
+        try:
+            self._rehome_all(h)
+        except Exception:
+            log.exception(
+                "fleet %s: re-homing from %s failed",
+                self.model_name, h.rid,
+            )
+        h.rehomed_done = True
+
+    def _rehome_all(self, h: ReplicaHandle) -> None:
+        eng = h.engine
+        # 1) what the dying engine preserved: its fatal-crash salvage
+        #    (set by _recover_from_crash), or — for a hard kill that
+        #    bypassed the crash path — collect it now from the intact
+        #    engine object (thread confirmed dead, so host state is
+        #    quiescent)
+        salvage: dict = getattr(eng, "crash_salvage", None) or {}
+        thread_dead = h.thread is None or not h.thread.is_alive()
+        if not salvage and thread_dead:
+            try:
+                salvage = self._salvage_from_engine(eng)
+            except Exception:
+                salvage = {}
+        # 2) fail whatever turns the dead replica still holds, so no
+        #    caller hangs on done.wait() (the engine's own crash path
+        #    already did this; the hard-kill path did not)
+        if thread_dead:
+            self._fail_engine_turns(
+                eng, "replica crashed; session re-homed — retry shortly"
+            )
+        # 3) re-home every session the router placed on this replica:
+        #    warm via salvaged spool files, mirror re-prefill otherwise
+        with self._lock:
+            recs = [
+                r for r in self._records.values() if r.rid == h.rid
+            ]
+        pending: list[tuple] = []
+        for rec in recs:
+            entry = salvage.pop(rec.sid, None)
+            if entry is None:
+                entry = self._entry_from_mirror(rec)
+            self._rehome_entry(
+                rec, entry, exclude=h.rid, pending=pending
+            )
+        # sessions the engine knew but the router never placed (e.g.
+        # restored-then-never-touched): still re-home from salvage
+        for sid, entry in list(salvage.items()):
+            with self._lock:
+                known = sid in self._records
+            if known:
+                continue
+            rec = _SessionRecord(sid=sid, rid=h.rid)
+            toks = list(entry.get("history") or [])
+            if entry.get("pending") is not None:
+                toks.append(int(entry["pending"]))
+            rec.tokens = toks
+            rec.generation = int(entry.get("generation") or 0)
+            with self._lock:
+                self._records[sid] = rec
+            self._rehome_entry(
+                rec, entry, exclude=h.rid, pending=pending
+            )
+        deadline = time.monotonic() + 10.0
+        for rec, entry, target, ev in pending:
+            ev.wait(timeout=max(0.0, deadline - time.monotonic()))
+            # warm is an OUTCOME, not an intent: only count it when
+            # the sibling's store actually holds the adopted entry (a
+            # disk-cap refusal or bad spool degraded to re-prefill)
+            store = getattr(target.engine, "offload_store", None)
+            warm = entry.get("kv") is not None and \
+                store is not None and store.has(rec.sid)
+            self._bump(
+                "sessions_rehomed_warm" if warm
+                else "sessions_rehomed_reprefill"
+            )
+
+    def _entry_from_mirror(
+        self, rec: _SessionRecord
+    ) -> Optional[dict]:
+        with rec.lock:
+            toks = list(rec.tokens)
+            generation = rec.generation
+        if not toks:
+            return None
+        # the mirror's last streamed token re-enters as the pending
+        # token — exactly the park contract, so the resumed stream
+        # continues where the durable stream stopped
+        return {
+            "id": rec.sid,
+            "history": toks[:-1],
+            "pending": toks[-1],
+            "length": len(toks) - 1,
+            "generation": generation,
+            "kv": None,
+        }
+
+    def _rehome_entry(
+        self,
+        rec: _SessionRecord,
+        entry: Optional[dict],
+        exclude: Optional[str],
+        pending: list,
+    ) -> None:
+        if entry is None:
+            # nothing durable ever happened on this session: drop the
+            # placement; its next turn starts fresh wherever the
+            # router puts it
+            with self._lock:
+                self._records.pop(rec.sid, None)
+            return
+        target = self._next_target(exclude)
+        if target is None:
+            # no sibling to absorb it RIGHT NOW (e.g. the only other
+            # replica is mid-drain): keep the record, park the entry
+            # on it, and mark it unplaced — the next _route for this
+            # session adopts the entry into whatever replica serves
+            # by then, so the history is never silently dropped
+            with self._lock:
+                rec.rid = ""
+                rec.pending_entry = entry
+            return
+        ev = target.engine.adopt_parked_session(
+            entry, fingerprint=None, require_sha=False,
+        )
+        pending.append((rec, entry, target, ev))
+        with self._lock:
+            rec.rid = target.rid
+            rec.rehomed += 1
+        self._bump("sessions_rehomed")
+
+    def _next_target(
+        self, exclude: Optional[str]
+    ) -> Optional[ReplicaHandle]:
+        """Round-robin over serving siblings so a dead replica's
+        sessions spread instead of piling onto one survivor."""
+        cands = self._serving_replicas(exclude=exclude)
+        if not cands:
+            return None
+        with self._lock:
+            self._rr += 1
+            return cands[self._rr % len(cands)]
+
+    def _salvage_from_engine(self, eng) -> dict:
+        """Hard-kill salvage: the engine object is intact and its
+        thread is dead — collect the same parked-session entries the
+        fatal-crash path preserves."""
+        try:
+            return eng._collect_crash_salvage()
+        except Exception:
+            return {}
+
+    def _fail_engine_turns(self, eng, msg: str) -> None:
+        """Fail every turn a dead replica still holds. Safe only with
+        the replica's serve thread confirmed dead; claims loop-thread
+        ownership (the drain() pattern) so a racing release_session
+        defers to the command queue instead of mutating under us."""
+        with eng._lock:
+            eng._loop_thread = threading.current_thread()
+        try:
+            for i, turn in enumerate(eng._active):
+                if turn is not None and not turn.done.is_set():
+                    turn.shed = True
+                    eng._fail_turn_unslotted(turn, msg)
+                eng._active[i] = None
+            eng._fail_all_pending(msg, shed=True)
+        except Exception:
+            pass
+        finally:
+            with eng._lock:
+                eng._loop_thread = None
+
+    def rebuild_replica(self, rid: str) -> bool:
+        """Swap a fresh engine into a dead or drained slot (the
+        blue/green re-admit, and the supervisor's crash rebuild)."""
+        h = self._handle(rid)
+        if h is None or h.state == "serving":
+            return False
+        if h.state == "dead" and h.strikes > self.max_strikes:
+            return False
+        if h.state == "dead" and not h.rehomed_done:
+            # the old engine still owes its sessions a (deferred)
+            # re-home — discarding it now would orphan them
+            return False
+        try:
+            engine = self._build_engine(h.index)
+        except Exception:
+            log.exception(
+                "fleet %s: rebuild of %s failed", self.model_name, rid,
+            )
+            return False
+        h.engine = engine
+        h.engine.fleet_supervised = True
+        h.thread = None
+        h.stop = threading.Event()
+        h.drained = threading.Event()
+        h.state = "serving"
+        self._bump("replica_rebuilds")
+        if self._threads_started:
+            h.start_thread()
+        return True
+
+    # ---- blue/green ----
+
+    def drain_replica(
+        self, rid: str, deadline_s: Optional[float] = None,
+    ) -> dict:
+        """The blue/green primitive: quiesce one replica (in-flight
+        turns finish streaming — no 503s), drain it to a handoff
+        manifest, absorb its sessions into the siblings. Affinity
+        routing blocks (bounded) rather than sheds while this runs, so
+        a rolling deploy is invisible to queen-class turns. Call
+        ``rebuild_replica`` afterwards to swap in the new build."""
+        h = self._handle(rid)
+        if h is None or h.state != "serving":
+            return {"error": f"replica {rid!r} not serving"}
+        if len(self._serving_replicas(exclude=rid)) == 0:
+            return {"error": "no sibling to absorb sessions; refusing "
+                             "to drain the last serving replica"}
+        if deadline_s is None:
+            deadline_s = lifecycle_mod.drain_deadline_s()
+        t0 = time.monotonic()
+        deadline = t0 + max(deadline_s, 1.0)
+        self._bump("bluegreen_drains")
+        h.state = "draining"
+        h.drained.clear()
+        eng = h.engine
+        try:
+            # quiesce: new turns already route elsewhere (or wait on
+            # the handoff); let admitted work finish streaming
+            threaded = h.thread is not None and h.thread.is_alive()
+            while time.monotonic() < deadline - (deadline - t0) * 0.3:
+                busy = (
+                    any(t is not None for t in eng._active)
+                    or not eng._queue.empty()
+                    or eng._inflight is not None
+                    or bool(eng._staged_chunks)
+                )
+                if not busy:
+                    break
+                if threaded:
+                    time.sleep(0.005)
+                else:
+                    try:
+                        eng.step()
+                    except Exception as e:
+                        # same supervision contract as run_until_idle:
+                        # a crashed step inside the quiesce fails its
+                        # work cleanly; past budget the drain proceeds
+                        # history-only on the unhealthy engine
+                        if not eng._recover_from_crash(e):
+                            break
+            h.stop.set()
+            if h.thread is not None:
+                h.thread.join(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )
+            wedged = h.thread is not None and h.thread.is_alive()
+            handoff = os.path.join(
+                lifecycle_mod.engine_dir(self.model_name),
+                f"bluegreen-{h.rid}",
+            )
+            summary = eng.drain(
+                handoff,
+                deadline_s=max(0.0, deadline - time.monotonic()),
+                flush=not wedged,
+            )
+            absorbed = self._absorb_manifest(handoff, exclude=h.rid)
+        except Exception as e:
+            # a drain that died must not strand the replica in
+            # 'draining' with submitters parked on the handoff event
+            # forever: bury it — the crash path re-homes whatever the
+            # engine salvage + router mirror still cover
+            log.exception(
+                "fleet %s: blue/green drain of %s failed; falling "
+                "back to crash failover", self.model_name, rid,
+            )
+            eng.healthy = False
+            self._bury(h, f"drain failed: {e}")
+            h.drained.set()
+            return {"error": f"drain failed: {e}", "rid": rid}
+        h.state = "drained"
+        h.drained.set()
+        log.info(
+            "fleet %s: blue/green drained %s (%s absorbed warm, %s "
+            "re-prefill)", self.model_name, rid,
+            absorbed.get("resumed", 0), absorbed.get("reprefill", 0),
+        )
+        return {**summary, "absorbed": absorbed}
+
+    def _absorb_manifest(
+        self, dir_path: str, exclude: Optional[str] = None,
+    ) -> dict:
+        """Distribute a drain manifest's sessions across the serving
+        replicas (blue/green absorb; also the per-subdir worker of the
+        boot-time restore). Consumes the manifest and sweeps what it
+        no longer protects, mirroring ``restore_from_manifest``."""
+        out = {"resumed": 0, "reprefill": 0, "skipped": 0,
+               "deferred": 0, "manifest": False}
+        manifest = lifecycle_mod.read_manifest(dir_path)
+        if manifest is None:
+            lifecycle_mod.sweep_orphans(dir_path)
+            return out
+        out["manifest"] = True
+        version_ok = manifest.get("version") == \
+            lifecycle_mod.MANIFEST_VERSION
+        # NEVER pass fingerprint=None here: None means "the caller
+        # vouches for config identity" to adopt_parked_session, and a
+        # manifest MISSING its fingerprint is exactly the stale/legacy
+        # case the check exists for — a sentinel that can't equal any
+        # real fingerprint degrades those entries to re-prefill
+        fingerprint = (
+            manifest.get("fingerprint") or {"fingerprint": "missing"}
+        ) if version_ok else {"version": "mismatch"}
+        pending: list[tuple[_SessionRecord, dict,
+                            ReplicaHandle, threading.Event]] = []
+        # COLDEST first (same guard as engine._restore_dir): adoption
+        # time is last_used, so when the manifest's bytes overflow the
+        # absorbing stores' disk caps, the rebalance must evict the
+        # coldest sessions — iterating the warmest-first manifest in
+        # order would invert the drain's priority
+        deferred_keep: set[str] = set()
+        for entry in reversed(manifest.get("sessions", [])):
+            if not isinstance(entry, dict) or not entry.get("id"):
+                out["skipped"] += 1
+                continue
+            sid = str(entry["id"])
+            target = self._next_target(exclude)
+            if target is None:
+                # no serving sibling RIGHT NOW (e.g. the only one
+                # crashed mid-absorb): the manifest below gets
+                # consumed, so this entry must not be dropped — park
+                # it on the router record (absolute spool path; the
+                # sweep keeps the file) and the next _route adopts it
+                # into whatever replica serves by then
+                entry = dict(entry)
+                kv = entry.get("kv")
+                if isinstance(kv, dict) and kv.get("file"):
+                    fname = os.path.basename(str(kv["file"]))
+                    kv = dict(kv)
+                    kv["file"] = os.path.join(dir_path, fname)
+                    entry["kv"] = kv
+                    deferred_keep.add(fname)
+                rec = _SessionRecord(sid=sid, rid="")
+                toks = [int(t) for t in entry.get("history") or []]
+                if entry.get("pending") is not None:
+                    toks.append(int(entry["pending"]))
+                rec.tokens = toks
+                rec.generation = int(entry.get("generation") or 0)
+                rec.pending_entry = entry
+                rec.pending_fingerprint = fingerprint
+                with self._lock:
+                    old = self._records.get(sid)
+                    if old is not None:
+                        rec.rehomed = old.rehomed
+                    self._records[sid] = rec
+                out["deferred"] += 1
+                continue
+            ev = target.engine.adopt_parked_session(
+                entry,
+                lifecycle_dir=dir_path,
+                fingerprint=fingerprint,
+                require_sha=True,
+            )
+            # rebuild the router mirror from the manifest so a LATER
+            # crash of the absorbing replica can still re-home this
+            # session exactly
+            rec = _SessionRecord(sid=sid, rid=target.rid)
+            toks = [int(t) for t in entry.get("history") or []]
+            if entry.get("pending") is not None:
+                toks.append(int(entry["pending"]))
+            rec.tokens = toks
+            rec.generation = int(entry.get("generation") or 0)
+            with self._lock:
+                old = self._records.get(sid)
+                if old is not None:
+                    rec.rehomed = old.rehomed + 1
+                self._records[sid] = rec
+            pending.append((rec, entry, target, ev))
+        wait_until = time.monotonic() + 30.0
+        for rec, entry, target, ev in pending:
+            ev.wait(timeout=max(0.0, wait_until - time.monotonic()))
+            store = getattr(target.engine, "offload_store", None)
+            if store is not None and store.has(rec.sid):
+                out["resumed"] += 1
+            elif rec.sid in target.engine.sessions:
+                out["reprefill"] += 1
+            else:
+                out["skipped"] += 1
+        lifecycle_mod.consume_manifest(dir_path)
+        # adopted spools were PID-re-tagged in place by adopt(); the
+        # live-PID guard protects them from this sweep. Deferred
+        # entries' spools are kept explicitly — their adoption happens
+        # at the session's next route. Everything else the manifest
+        # stopped protecting goes now.
+        lifecycle_mod.sweep_orphans(
+            dir_path, keep=deferred_keep, max_age_s=0.0
+        )
+        return out
+
+    # ---- process lifecycle (ModelHost facade) ----
+
+    def begin_drain(self) -> None:
+        self.lifecycle_phase = "draining"
+        for h in self.replicas:
+            if h.state != "dead" and hasattr(h.engine, "begin_drain"):
+                h.engine.begin_drain()
+
+    def drain(
+        self,
+        lifecycle_dir: Optional[str] = None,
+        *,
+        deadline_s: Optional[float] = None,
+        flush: bool = True,
+    ) -> dict:
+        """Process-shutdown drain: every replica drains to its own
+        subdir under the model's lifecycle dir, sharing ONE deadline
+        budget. ``manifest_written`` is the AND across replicas — the
+        clean-shutdown marker must not paper over one replica's lost
+        sessions."""
+        if lifecycle_dir is None:
+            lifecycle_dir = lifecycle_mod.engine_dir(self.model_name)
+        if deadline_s is None:
+            deadline_s = lifecycle_mod.drain_deadline_s()
+        t0 = time.monotonic()
+        budget_end = t0 + max(deadline_s, 0.0)
+        self.begin_drain()
+        summaries: dict[str, dict] = {}
+        wrote_all = True
+        totals = {"sessions_total": 0, "sessions_spooled": 0,
+                  "sessions_fallback": 0, "sessions_abandoned": 0}
+        for h in self.replicas:
+            h.stop.set()
+        for h in self.replicas:
+            if h.state == "dead":
+                continue
+            wedged = False
+            if h.thread is not None:
+                h.thread.join(
+                    timeout=max(0.0, budget_end - time.monotonic())
+                )
+                wedged = h.thread.is_alive()
+            sub = os.path.join(lifecycle_dir, f"replica-{h.rid}")
+            try:
+                s = h.engine.drain(
+                    sub,
+                    deadline_s=max(
+                        0.0, budget_end - time.monotonic()
+                    ) if not wedged else 0.0,
+                    flush=flush and not wedged,
+                )
+            except Exception:
+                s = {"manifest_written": False, "error": "drain failed"}
+            summaries[h.rid] = s
+            wrote_all = wrote_all and s.get("manifest_written", False)
+            for k in totals:
+                totals[k] += int(s.get(k) or 0)
+        return {
+            "drain_ms": round((time.monotonic() - t0) * 1000.0, 3),
+            "manifest_written": wrote_all,
+            "dir": lifecycle_dir,
+            "replicas": summaries,
+            **totals,
+        }
+
+    def restore_from_manifest(
+        self, lifecycle_dir: Optional[str] = None
+    ) -> dict:
+        """Warm restart for the whole fleet: absorb every manifest
+        under the model's lifecycle dir — per-replica subdirs from a
+        previous fleet's drain, blue/green handoff leftovers, and the
+        dir itself (a previous SINGLE-engine incarnation's manifest) —
+        distributing sessions across the current replicas. Tolerant of
+        a fleet-size change across the restart by construction."""
+        if lifecycle_dir is None:
+            lifecycle_dir = lifecycle_mod.engine_dir(self.model_name)
+        total = {"resumed": 0, "reprefill": 0, "skipped": 0,
+                 "deferred": 0, "manifest": False}
+        dirs = [lifecycle_dir] + \
+            lifecycle_mod.manifest_subdirs(lifecycle_dir)
+        for d in dirs:
+            got = self._absorb_manifest(d)
+            for k in ("resumed", "reprefill", "skipped", "deferred"):
+                total[k] += got[k]
+            total["manifest"] = total["manifest"] or got["manifest"]
+        return total
+
+    # ---- observability ----
+
+    def fleet_stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            placements: dict[str, int] = {}
+            for rec in self._records.values():
+                placements[rec.rid] = placements.get(rec.rid, 0) + 1
+        out["replicas"] = len(self.replicas)
+        out["serving"] = sum(
+            1 for h in self.replicas if h.is_serving()
+        )
+        out["placements"] = placements
+        out["health"] = {
+            h.rid: {
+                "state": h.state,
+                "healthy": getattr(h.engine, "healthy", True),
+                "score": round(h.health_score(), 1),
+                "strikes": h.strikes,
+            }
+            for h in self.replicas
+        }
+        return out
+
+    def stats(self) -> dict:
+        """Aggregate engine-stats view (numeric counters summed across
+        live replicas) + the fleet block. Per-replica blocks are NOT
+        nested here — ``providers.tpu.engines_snapshot`` emits them
+        under their own ``model#rid`` keys so fleet siblings never
+        overwrite each other's scheduler/offload/lifecycle blocks."""
+        agg: dict = {}
+        for h in self.replicas:
+            if h.state == "dead":
+                continue
+            st = h.engine.stats()
+            for k, v in st.items():
+                if isinstance(v, bool) or not isinstance(
+                    v, (int, float)
+                ):
+                    continue
+                agg[k] = agg.get(k, 0) + v
+        ref = self.replicas[0].engine
+        agg["steps_per_dispatch"] = ref.steps_per_dispatch
+        agg["healthy"] = self.healthy
+        agg["queued"] = sum(
+            h.engine._queue.qsize() for h in self.replicas
+            if h.state != "dead"
+        )
+        agg["degradation_level"] = max(
+            (h.engine.degradation_level() for h in self.replicas
+             if h.state != "dead"), default=0,
+        )
+        lc = {"phase": self.lifecycle_phase}
+        agg["lifecycle"] = lc
+        agg["fleet"] = self.fleet_stats()
+        return agg
+
+    # ---- test / synchronous driving ----
+
+    def run_until_idle(self, max_steps: int = 100_000) -> None:
+        """Synchronous driver (tests, notebooks): steps every
+        thread-less serving replica round-robin, supervising between
+        rounds, until the whole fleet is idle."""
+        for _ in range(max_steps):
+            self.supervise()
+            busy = 0
+            for h in self.replicas:
+                if h.state != "serving" or (
+                    h.thread is not None and h.thread.is_alive()
+                ):
+                    continue
+                try:
+                    busy += h.engine.step()
+                except Exception as e:
+                    if not h.engine._recover_from_crash(e):
+                        continue
+                if not h.engine._queue.empty() or \
+                        h.engine._inflight is not None:
+                    busy += 1
+            if busy == 0:
+                return
+        raise RuntimeError("fleet run_until_idle exceeded max_steps")

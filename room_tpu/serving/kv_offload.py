@@ -406,6 +406,44 @@ class TieredKVStore:
         with self._lock:
             return session_id in self._entries
 
+    def export_entry(self, session_id: str) -> Optional[dict]:
+        """Detach a session's entry for cross-replica handoff (fleet
+        failover, docs/fleet.md): a disk-tier entry gives up its spool
+        file — removed from this store WITHOUT unlinking, the adopting
+        sibling takes ownership of the file; a host-tier entry is
+        spooled to disk first (pure host bytes: safe even when the
+        owning engine's device state is suspect). Returns a
+        manifest-style kv record (absolute ``file`` path) or None —
+        absent entries and spool I/O errors both degrade to the
+        caller's history re-prefill path, never an exception."""
+        with self._lock:
+            entry = self._entries.get(session_id)
+            if entry is None:
+                return None
+            arrays, path, sha = entry.arrays, entry.path, entry.sha256
+        if arrays is not None:
+            path = self._spool_path(session_id)
+            try:
+                sha = _write_spool(path, arrays, want_digest=True)
+            except OSError:
+                with self._lock:
+                    self._stats["spool_errors"] += 1
+                return None
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            return None
+        with self._lock:
+            # detach, don't drop: the file now belongs to the adopter
+            self._entries.pop(session_id, None)
+        return {
+            "file": path,
+            "own_tokens": int(entry.own_tokens),
+            "n_pages": int(entry.n_pages),
+            "nbytes": int(nbytes),
+            "sha256": sha,
+        }
+
     def spool_copy_source(
         self, session_id: str
     ) -> Optional[tuple[str, int]]:
@@ -468,12 +506,18 @@ class TieredKVStore:
             self._drop_entry(entry)
             return True
 
-    def clear(self) -> None:
+    def clear(self, remove_spool_dir: bool = True) -> None:
+        """Drop every entry (unlinking their files). With
+        ``remove_spool_dir=False`` a store-owned spool dir survives —
+        the fatal-crash salvage path (engine._collect_crash_salvage)
+        has just DETACHED spool files still sitting in that dir for a
+        fleet sibling to adopt, and the rmtree would delete the very
+        bytes the salvage hand-off points at."""
         with self._lock:
             for entry in list(self._entries.values()):
                 self._drop_entry(entry)
             self._entries.clear()
-        if self._own_spool and self._spool_dir:
+        if remove_spool_dir and self._own_spool and self._spool_dir:
             shutil.rmtree(self._spool_dir, ignore_errors=True)
             self._spool_dir = None
 

@@ -64,7 +64,8 @@ __all__ = [
     "lifecycle_enabled_from_env", "lifecycle_root", "engine_dir",
     "drain_deadline_s", "sweep_age_s", "file_sha256",
     "write_manifest", "read_manifest", "consume_manifest",
-    "manifest_spool_files", "spool_owner_pid", "sweep_orphans",
+    "manifest_spool_files", "manifest_subdirs", "spool_owner_pid",
+    "sweep_orphans",
     "write_clean_marker", "consume_clean_marker", "record_boot",
 ]
 
@@ -187,6 +188,25 @@ def next_generation(dir_path: str) -> int:
     except OSError:
         pass
     return gen
+
+
+def manifest_subdirs(dir_path: str) -> list[str]:
+    """Per-replica manifest subdirs under an engine dir: a fleet
+    drains ``replica-<rid>/`` dirs and blue/green handoffs leave
+    ``bluegreen-<rid>/`` (docs/fleet.md). Both the fleet restore and a
+    single engine restoring after a fleet-size rollback must absorb
+    every one — this is the ONE place the naming convention lives."""
+    out: list[str] = []
+    try:
+        for name in sorted(os.listdir(dir_path)):
+            sub = os.path.join(dir_path, name)
+            if os.path.isdir(sub) and name.startswith(
+                ("replica-", "bluegreen-")
+            ):
+                out.append(sub)
+    except OSError:
+        pass
+    return out
 
 
 def consume_manifest(dir_path: str) -> None:

@@ -349,6 +349,25 @@ register("ROOM_TPU_SPOOL_SWEEP_AGE_S", "float", "3600",
          "Orphan spool files older than this are swept at store "
          "construction.")
 
+# ---- engine replica fleet (docs/fleet.md) ----
+register("ROOM_TPU_FLEET_REPLICAS", "int", "1",
+         "Engine replicas per served model (1 = no fleet); a fleet "
+         "fails replicas over to siblings and drains blue/green.",
+         scope="provider")
+register("ROOM_TPU_FLEET_MESHES", "str", None,
+         "Per-replica mesh specs, ';'-separated 'dp,pp,tp[@start]' "
+         "entries (replica i takes entry i, wrapping); unset falls "
+         "back to the model's single-engine mesh.",
+         scope="provider")
+register("ROOM_TPU_FLEET_STRIKES", "int", "3",
+         "Replica death strikes before the fleet supervisor stops "
+         "rebuilding it.")
+register("ROOM_TPU_FLEET_TICK_S", "float", "0.5",
+         "Fleet supervision poll interval in seconds.")
+register("ROOM_TPU_FLEET_REBUILD", "bool", "1",
+         "Auto-rebuild crashed replicas (within the strike budget); "
+         "0 leaves them dead for operator-driven re-admission.")
+
 # ---- SLO scheduler (docs/scheduler.md) ----
 register("ROOM_TPU_CLASS_TARGETS", "str", "",
          "Per-class SLO targets, ';'-separated "
@@ -615,6 +634,10 @@ register("ROOM_TPU_BENCH_KVQ", "bool", "1",
 register("ROOM_TPU_BENCH_RAGGED", "bool", "1",
          "Run the ragged_kernel split-vs-unified fused-window A/B "
          "phase.", scope="bench")
+register("ROOM_TPU_BENCH_FLEET", "bool", "1",
+         "Run the fleet_failover bench phase (TTFT after a replica "
+         "kill, zero-token-loss check, sessions re-homed).",
+         scope="bench")
 register("ROOM_TPU_BENCH_TPU_FALLBACK", "bool", "1",
          "Re-exec the bench as the CPU-proxy profile when the TPU "
          "tunnel is unreachable (instead of the watchdog 0.0 "

@@ -1,0 +1,830 @@
+"""Engine replica fleet suite (docs/fleet.md).
+
+Pins the fleet contract on the CPU backend:
+
+- KV-affinity routing: a session's turns always land on the replica
+  holding its KV/history; fresh sessions spread by health score.
+- Crash failover: killing a replica mid-decode-window re-homes its
+  sessions onto siblings with ZERO durably-streamed tokens lost and
+  greedy continuations token-identical to an unkilled run — warm via
+  adopted spool files where a hibernate landed them, re-prefill from
+  the router's history mirror otherwise. The real crash-loop path
+  (engine_crash past the restart budget) rides the same re-homing.
+- Blue/green: draining one replica lets in-flight turns finish (no
+  503s to queen-class turns), absorbs its sessions into siblings
+  byte-exact, and `rebuild_replica` re-admits the slot.
+- The two fleet fault points: `replica_crash` (supervisor kills the
+  busiest replica; recovery is the failover above) and `router_io`
+  (bounded retry; exhaustion sheds with the 503 contract — a session
+  is never misrouted).
+- Satellite pins: /api/tpu/health keyed per replica + fleet aggregate;
+  `fused_window_disabled_reason` diagnosability; the PID re-tag on
+  adopt protecting a just-handed-off session from the donor's orphan
+  sweep through REPEATED failovers.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving import lifecycle
+from room_tpu.serving.fleet import EngineFleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture()
+def make_fleet(model, monkeypatch, tmp_path):
+    """Fleet factory: prefix cache off (every session's KV is
+    spoolable), shared offload spool + lifecycle dirs under tmp_path,
+    no stop tokens (greedy streams run to budget, so interruption
+    points are controllable)."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path / "spool"))
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "lc"))
+    cfg, params = model
+
+    def build_engine(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("offload", True)
+        kw.setdefault("stop_token_ids", [])
+        return ServingEngine(cfg, params, **kw)
+
+    def build(n=3, auto_rebuild=False, **kw):
+        return EngineFleet(
+            "tiny-moe", lambda i: build_engine(**kw), n,
+            auto_rebuild=auto_rebuild,
+        )
+
+    build.engine = build_engine
+    return build
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+PROMPT = list(range(1, 20))
+CONT = [7, 7, 7]
+
+
+@pytest.fixture(scope="module")
+def control(model):
+    """Uninterrupted two-turn reference streams."""
+    cfg, params = model
+    eng = ServingEngine(
+        cfg, params, max_batch=4, page_size=8, n_pages=96,
+        offload=False, stop_token_ids=[],
+    )
+    c1 = eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    c2 = eng.submit(CONT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    return c1.new_tokens, c2.new_tokens
+
+
+# ---- routing ----
+
+def test_affinity_keeps_session_on_its_replica(make_fleet, control):
+    c1, c2 = control
+    fleet = make_fleet()
+    t1 = fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t1.new_tokens == c1
+    home = fleet._records["s"].rid
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert fleet._records["s"].rid == home, \
+        "a placed session must stay on its replica (KV affinity)"
+    assert t2.new_tokens == c2
+
+
+def test_fresh_sessions_spread_by_health_score(make_fleet):
+    fleet = make_fleet()
+    # submit without stepping: each queued turn raises its replica's
+    # queue depth, so the router spreads the next session elsewhere
+    for i in range(3):
+        fleet.submit(PROMPT, session_id=f"s{i}", sampling=_greedy(2))
+    homes = {fleet._records[f"s{i}"].rid for i in range(3)}
+    assert len(homes) == 3, f"expected 3 distinct homes, got {homes}"
+    fleet.run_until_idle()
+
+
+def test_class_priority_rides_through_to_the_replica(make_fleet):
+    fleet = make_fleet(n=2)
+    t = fleet.submit(
+        PROMPT, session_id="q", sampling=_greedy(2), turn_class="queen",
+    )
+    fleet.run_until_idle()
+    assert t.finish_reason == "length"
+    eng = fleet._handle(fleet._records["q"].rid).engine
+    assert eng.scheduler.snapshot(0)["classes"]["queen"]["completed"] >= 1
+
+
+# ---- crash failover (THE acceptance canary) ----
+
+def test_kill_mid_decode_window_zero_streamed_token_loss(
+    make_fleet, control,
+):
+    """Kill a replica while its decode window is in flight: every
+    DURABLY-streamed token survives (the mirror carries the streamed
+    prefix), the in-flight window's undrained tokens are dropped (they
+    never reached a client), and the resumed stream continues exactly
+    where the durable stream stopped — token-identical to an unkilled
+    run. Sibling replicas' sessions are untouched."""
+    cfg_budget = 32
+    fleet = make_fleet()
+    ctrl = make_fleet(n=1)
+    full = ctrl.submit(PROMPT, session_id="s", sampling=_greedy(cfg_budget))
+    ctrl.run_until_idle()
+    assert len(full.new_tokens) == cfg_budget
+
+    streamed: list[int] = []
+    t1 = fleet.submit(
+        PROMPT, session_id="s", sampling=_greedy(cfg_budget),
+        on_token=streamed.append,
+    )
+    # a sibling session that must ride through the failover untouched
+    bystander = fleet.submit(
+        PROMPT, session_id="b", sampling=_greedy(4),
+    )
+    victim = fleet._handle(fleet._records["s"].rid)
+    victim.engine.steps_per_dispatch = 4
+    # step the victim until a window is in flight and tokens streamed
+    for _ in range(200):
+        victim.engine.step()
+        if streamed and victim.engine._inflight is not None:
+            break
+    assert streamed and victim.engine._inflight is not None, \
+        "kill point must be mid-decode-window with a streamed prefix"
+    n_streamed = len(streamed)
+    fleet.kill_replica(victim.rid, "chaos: mid-window kill")
+    # the failed turn reports the shed contract; its streamed tokens
+    # are exactly the durable prefix
+    assert t1.done.is_set() and t1.finish_reason == "error"
+    assert t1.new_tokens == streamed
+    assert 0 < n_streamed < cfg_budget
+    assert streamed == full.new_tokens[:n_streamed]
+    assert fleet._records["s"].rid != victim.rid
+
+    fleet.run_until_idle()   # bystander finishes on its own replica
+    assert bystander.finish_reason == "length"
+
+    t2 = fleet.submit(
+        [], session_id="s", sampling=_greedy(cfg_budget - n_streamed),
+    )
+    fleet.run_until_idle()
+    assert streamed + t2.new_tokens == full.new_tokens, \
+        "failover dropped or duplicated streamed tokens"
+
+
+def test_failover_warm_via_adopted_spool(make_fleet, control):
+    """A hibernated session hands its byte-exact spool to a sibling:
+    the continuation restores (offload_restores) instead of
+    re-prefilling, token-identical."""
+    _, c2 = control
+    fleet = make_fleet()
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    victim = fleet._handle(fleet._records["s"].rid)
+    assert victim.engine.offload_session("s")
+    fleet.kill_replica(victim.rid, "test")
+    assert fleet._stats["sessions_rehomed_warm"] == 1
+    target = fleet._handle(fleet._records["s"].rid)
+    assert target.rid != victim.rid
+    assert target.engine.offload_store.has("s")
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2
+    st = target.engine.stats()
+    assert st["offload_restores"] == 1 and st["offload_reprefills"] == 0
+
+
+def test_crash_storm_across_three_replicas(make_fleet, control):
+    """Repeated failovers: kill the session's home replica, rebuild
+    it, kill the new home — the session survives every hop with
+    greedy continuations token-identical (the mirror + adoption chain
+    never loses a streamed token)."""
+    c1, c2 = control
+    fleet = make_fleet()
+    t1 = fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t1.new_tokens == c1
+    for _ in range(2):
+        victim = fleet._handle(fleet._records["s"].rid)
+        fleet.kill_replica(victim.rid, "storm")
+        assert fleet._records["s"].rid != victim.rid
+        assert fleet.rebuild_replica(victim.rid)
+    assert fleet._stats["failovers"] == 2
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_engine_crash_loop_past_budget_triggers_failover(
+    make_fleet, control, monkeypatch,
+):
+    """The REAL death path: engine_crash armed permanent crash-loops
+    one replica past its restart budget; _recover_from_crash preserves
+    crash_salvage, the supervisor buries the replica, and the session
+    continues on a sibling token-identical."""
+    monkeypatch.setenv("ROOM_TPU_ENGINE_MAX_RESTARTS", "1")
+    _, c2 = control
+    fleet = make_fleet()
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    victim = fleet._handle(fleet._records["s"].rid)
+    faults.inject("engine_crash", transient=False)
+    # crash-loop the victim only: drive its steps directly
+    for _ in range(8):
+        try:
+            victim.engine.step()
+        except Exception as e:
+            if not victim.engine._recover_from_crash(e):
+                break
+    faults.clear("engine_crash")
+    assert not victim.engine.healthy
+    assert victim.engine.crash_salvage is not None
+    fleet.supervise()
+    assert victim.state == "dead"
+    new_home = fleet._records["s"].rid
+    assert new_home != victim.rid
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_fatal_crash_warm_salvage_survives_store_clear(
+    model, control, monkeypatch,
+):
+    """Regression (review finding): the fatal crash's
+    offload_store.clear() must NOT rmtree a store-OWNED spool dir —
+    crash_salvage just detached spool files in that dir for a sibling
+    to adopt, and the rmtree deleted the bytes out from under the
+    hand-off (silently degrading every 'warm' failover to re-prefill).
+    No ROOM_TPU_OFFLOAD_DIR here: the store must own its dir."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.delenv("ROOM_TPU_OFFLOAD_DIR", raising=False)
+    monkeypatch.setenv("ROOM_TPU_ENGINE_MAX_RESTARTS", "0")
+    _, c2 = control
+    cfg, params = model
+
+    def build(i):
+        return ServingEngine(
+            cfg, params, max_batch=4, page_size=8, n_pages=96,
+            offload=True, stop_token_ids=[],
+        )
+
+    fleet = EngineFleet("tiny-moe", build, 2, auto_rebuild=False)
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    victim = fleet._handle(fleet._records["s"].rid)
+    assert victim.engine.offload_session("s")
+    faults.inject("engine_crash", transient=False, times=1)
+    try:
+        victim.engine.step()
+    except Exception as e:
+        assert not victim.engine._recover_from_crash(e)
+    assert not victim.engine.healthy
+    kv = victim.engine.crash_salvage["s"]["kv"]
+    assert kv is not None and os.path.exists(kv["file"]), \
+        "clear() deleted the salvaged spool file"
+    fleet.supervise()
+    assert fleet._stats["sessions_rehomed_warm"] == 1
+    target = fleet._handle(fleet._records["s"].rid)
+    assert target.engine.offload_store.has("s")
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2
+    st = target.engine.stats()
+    assert st["offload_restores"] == 1 and st["offload_reprefills"] == 0
+
+
+def test_drain_applies_queued_adoptions(make_fleet, tmp_path):
+    """Regression (review finding): an adoption enqueued while a loop
+    thread owned the engine, but not yet applied when the thread
+    exited, must ride the drain's manifest — its donor manifest is
+    already consumed, so dropping it would lose the session."""
+    eng = make_fleet.engine()
+
+    class FakeAliveThread:
+        @staticmethod
+        def is_alive():
+            return True
+
+    eng._loop_thread = FakeAliveThread()
+    ev = eng.adopt_parked_session({
+        "id": "handed-off", "history": [1, 2, 3], "pending": 4,
+        "length": 3, "generation": 0, "kv": None,
+    })
+    assert not ev.is_set(), "must queue while a loop owns the engine"
+    eng._loop_thread = None
+    lc_dir = str(tmp_path / "drainlc")
+    summary = eng.drain(lc_dir)
+    assert ev.is_set(), "drain must apply queued adoptions"
+    assert summary["manifest_written"]
+    import json
+
+    with open(os.path.join(lc_dir, lifecycle.MANIFEST_NAME)) as f:
+        ids = [e["id"] for e in json.load(f)["sessions"]]
+    assert "handed-off" in ids
+
+
+def test_failover_with_no_sibling_defers_then_adopts(
+    make_fleet, control,
+):
+    """Regression (review finding): when a replica dies with NO
+    serving sibling to absorb its sessions, the router must keep the
+    history (deferred entry on the record) and adopt it into the next
+    replica that serves — never silently drop the conversation."""
+    _, c2 = control
+    fleet = make_fleet(n=2)
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    first = fleet._records["s"].rid
+    fleet.kill_replica(first, "kill 1")
+    second = fleet._records["s"].rid
+    assert second != first
+    fleet.kill_replica(second, "kill 2")
+    rec = fleet._records["s"]
+    assert rec.rid == "" and rec.pending_entry is not None, \
+        "no-sibling failover must defer, not drop, the session"
+    assert fleet.rebuild_replica(first)
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2, "deferred re-home lost history"
+
+
+def test_lone_engine_fatal_crash_does_not_detach_spools(
+    model, control, monkeypatch,
+):
+    """Regression (review finding): an UNSUPERVISED engine's fatal
+    crash must not detach spool files (nothing will ever adopt them)
+    — the store clears fully, spool dir included."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.delenv("ROOM_TPU_OFFLOAD_DIR", raising=False)
+    monkeypatch.setenv("ROOM_TPU_ENGINE_MAX_RESTARTS", "0")
+    cfg, params = model
+    eng = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                        n_pages=96, offload=True, stop_token_ids=[])
+    eng.submit(PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    assert eng.offload_session("s")
+    spool_dir = eng.offload_store._spool_dir
+    faults.inject("engine_crash", transient=False, times=1)
+    try:
+        eng.step()
+    except Exception as e:
+        assert not eng._recover_from_crash(e)
+    assert eng.crash_salvage is None
+    assert spool_dir is None or not os.path.isdir(spool_dir), \
+        "lone-engine crash must not leak a preserved spool dir"
+
+
+def test_no_serving_replica_sheds_with_503_contract(make_fleet):
+    fleet = make_fleet(n=2)
+    for h in list(fleet.replicas):
+        fleet.kill_replica(h.rid, "test")
+    t = fleet.submit(PROMPT, session_id="x", sampling=_greedy())
+    assert t.done.is_set() and t.shed and t.finish_reason == "error"
+
+
+# ---- fault points ----
+
+def test_replica_crash_fault_point_recovers(make_fleet, control):
+    """faults.inject("replica_crash") kills the busiest replica at the
+    next supervision pass; recovery is the standard failover — the
+    surviving session's continuation is token-identical."""
+    c1, c2 = control
+    fleet = make_fleet()
+    t1 = fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t1.new_tokens == c1
+    faults.inject("replica_crash", times=1)
+    fleet.supervise()
+    assert faults.fired("replica_crash") == 1
+    assert fleet._stats["failovers"] == 1
+    assert sum(1 for h in fleet.replicas if h.state == "dead") == 1
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2
+
+
+def test_router_io_transient_retries_then_routes(make_fleet, control):
+    c1, _ = control
+    fleet = make_fleet()
+    faults.inject("router_io", times=1)
+    t = fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert not t.shed and t.new_tokens == c1
+    assert fleet._stats["router_retries"] == 1
+
+
+def test_router_io_exhaustion_sheds_never_misroutes(make_fleet):
+    """Past the retry budget the turn sheds cleanly (503 contract);
+    the session is NEVER placed on an arbitrary replica."""
+    fleet = make_fleet()
+    faults.inject("router_io", times=10)
+    t = fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    assert t.done.is_set() and t.shed and t.finish_reason == "error"
+    assert "s" not in fleet._records, "a shed turn must not place"
+    faults.clear("router_io")
+    # permanent faults short-circuit the retry loop
+    faults.inject("router_io", transient=False)
+    t2 = fleet.submit(PROMPT, session_id="s2", sampling=_greedy())
+    assert t2.shed and "s2" not in fleet._records
+
+
+# ---- blue/green ----
+
+def test_bluegreen_drain_absorbs_warm_no_queen_503(
+    make_fleet, control,
+):
+    """The deploy primitive: drain one replica of a busy fleet —
+    in-flight turns finish streaming (nothing shed), its sessions
+    absorb into siblings byte-exact (spool adoption, not re-prefill),
+    queen turns keep flowing with zero 503s, and the drained slot
+    re-admits a fresh build."""
+    c1, c2 = control
+    fleet = make_fleet()
+    turns = [
+        fleet.submit(PROMPT, session_id=f"s{i}",
+                     sampling=_greedy(), turn_class="queen")
+        for i in range(3)
+    ]
+    fleet.run_until_idle()
+    assert all(t.new_tokens == c1 for t in turns)
+    victim_rid = fleet._records["s0"].rid
+    summary = fleet.drain_replica(victim_rid)
+    assert summary["manifest_written"]
+    assert summary["absorbed"]["resumed"] >= 1, \
+        "blue/green handoff must adopt spooled KV, not re-prefill"
+    assert summary["absorbed"]["reprefill"] == 0
+    # every queen continuation — including the moved sessions — flows
+    # with no 503 and token-identical streams
+    conts = [
+        fleet.submit(CONT, session_id=f"s{i}",
+                     sampling=_greedy(), turn_class="queen")
+        for i in range(3)
+    ]
+    fleet.run_until_idle()
+    for t in conts:
+        assert not t.shed and t.finish_reason == "length"
+        assert t.new_tokens == c2
+    assert all(
+        fleet._records[f"s{i}"].rid != victim_rid for i in range(3)
+    )
+    # swap in the "new build" and verify the slot serves again
+    assert fleet.rebuild_replica(victim_rid)
+    assert fleet._handle(victim_rid).is_serving()
+
+
+def test_drain_refuses_last_serving_replica(make_fleet):
+    fleet = make_fleet(n=2)
+    fleet.kill_replica("r0", "test")
+    out = fleet.drain_replica("r1")
+    assert "error" in out
+
+
+def test_failover_during_in_progress_drain(make_fleet, control):
+    """Crash a SIBLING while a blue/green drain is absorbing: the
+    drained replica's sessions and the crashed replica's sessions all
+    land somewhere serving, token-identical."""
+    c1, c2 = control
+    fleet = make_fleet()
+    for i in range(3):
+        fleet.submit(PROMPT, session_id=f"s{i}", sampling=_greedy())
+    fleet.run_until_idle()
+    homes = {i: fleet._records[f"s{i}"].rid for i in range(3)}
+    distinct = sorted(set(homes.values()))
+    assert len(distinct) == 3
+    drain_rid = homes[0]
+    # kill a sibling FIRST so the drain's absorb must route around it
+    crash_rid = next(r for r in distinct if r != drain_rid)
+    fleet.kill_replica(crash_rid, "mid-drain crash")
+    summary = fleet.drain_replica(drain_rid)
+    assert summary["manifest_written"]
+    survivor = next(
+        r for r in distinct if r not in (drain_rid, crash_rid)
+    )
+    for i in range(3):
+        assert fleet._records[f"s{i}"].rid == survivor
+        t = fleet.submit(CONT, session_id=f"s{i}", sampling=_greedy())
+        fleet.run_until_idle()
+        assert t.new_tokens == c2, f"s{i} diverged"
+
+
+def test_fleet_drain_restore_roundtrip_tolerates_resize(
+    make_fleet, control, tmp_path,
+):
+    """Process-level lifecycle: a 3-replica fleet drains (per-replica
+    manifests, manifest_written ANDed), and a DIFFERENT-sized fleet
+    restores every session — warm — on the next boot."""
+    c1, c2 = control
+    lc_dir = str(tmp_path / "lc" / "engines" / "tiny-moe")
+    fleet = make_fleet()
+    for i in range(3):
+        t = fleet.submit(PROMPT, session_id=f"s{i}", sampling=_greedy())
+    fleet.run_until_idle()
+    summary = fleet.drain(lc_dir)
+    assert summary["manifest_written"]
+    assert summary["sessions_spooled"] == 3
+    assert len(summary["replicas"]) == 3
+
+    fleet2 = make_fleet(n=2)
+    restored = fleet2.restore_from_manifest(lc_dir)
+    assert restored["manifest"] and restored["resumed"] == 3
+    for i in range(3):
+        t2 = fleet2.submit(CONT, session_id=f"s{i}", sampling=_greedy())
+        fleet2.run_until_idle()
+        assert t2.new_tokens == c2, f"s{i} diverged across restart"
+
+
+def test_fleet_drain_restores_into_single_engine(
+    make_fleet, control, tmp_path,
+):
+    """Regression (review finding): rolling a fleet deployment back to
+    ROOM_TPU_FLEET_REPLICAS=1 must not lose the fleet's drained
+    sessions — a plain ServingEngine's restore absorbs the
+    per-replica sub-manifests too."""
+    _, c2 = control
+    lc_dir = str(tmp_path / "lc" / "engines" / "tiny-moe")
+    fleet = make_fleet()
+    for i in range(3):
+        fleet.submit(PROMPT, session_id=f"s{i}", sampling=_greedy())
+    fleet.run_until_idle()
+    assert fleet.drain(lc_dir)["manifest_written"]
+
+    eng = make_fleet.engine()
+    restored = eng.restore_from_manifest(lc_dir)
+    assert restored["manifest"] and restored["resumed"] == 3
+    for i in range(3):
+        t = eng.submit(CONT, session_id=f"s{i}", sampling=_greedy())
+        eng.run_until_idle()
+        assert t.new_tokens == c2, f"s{i} diverged across rollback"
+
+
+def test_absorb_missing_fingerprint_reprefills_never_vouches(
+    make_fleet, control, tmp_path,
+):
+    """Regression (review finding): a manifest MISSING its fingerprint
+    must degrade its KV entries to re-prefill at absorb — None must
+    not read as 'caller vouches for config identity'."""
+    import glob
+    import json
+
+    _, c2 = control
+    lc_dir = str(tmp_path / "lc" / "engines" / "tiny-moe")
+    fleet = make_fleet()
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert fleet.drain(lc_dir)["sessions_spooled"] == 1
+    for mf in glob.glob(os.path.join(lc_dir, "replica-*",
+                                     "manifest.json")):
+        with open(mf) as f:
+            m = json.load(f)
+        m.pop("fingerprint", None)
+        with open(mf, "w") as f:
+            json.dump(m, f)
+
+    fleet2 = make_fleet(n=2)
+    restored = fleet2.restore_from_manifest(lc_dir)
+    assert restored["resumed"] == 0 and restored["reprefill"] == 1
+    t = fleet2.submit(CONT, session_id="s", sampling=_greedy())
+    fleet2.run_until_idle()
+    assert t.new_tokens == c2, "re-prefill fallback diverged"
+
+
+# ---- satellite: PID re-tag vs the donor's orphan sweep ----
+
+def test_adopt_retag_survives_donor_sweep_through_repeated_failovers(
+    make_fleet, control, tmp_path,
+):
+    """Satellite pin: TieredKVStore.adopt re-tags a handed-off spool
+    with the adopting PID, so the donor's (or any third sibling's)
+    age-0 orphan sweep can never delete a live engine's adopted
+    session — through REPEATED blue/green handoffs of the same
+    session."""
+    _, c2 = control
+    fleet = make_fleet()
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    for hop in range(2):
+        rid = fleet._records["s"].rid
+        summary = fleet.drain_replica(rid)
+        assert summary["absorbed"]["resumed"] == 1, f"hop {hop}"
+        handoff = summary["dir"]
+        # the donor's own hygiene pass, max-age 0: everything
+        # unprotected dies NOW — the adopted spool must survive on
+        # its live-PID tag alone (the manifest is already consumed)
+        lifecycle.sweep_orphans(handoff, max_age_s=0.0)
+        target = fleet._handle(fleet._records["s"].rid)
+        assert target.engine.offload_store.has("s"), f"hop {hop}"
+        assert fleet.rebuild_replica(rid)
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t2.new_tokens == c2, "sweep destroyed adopted KV"
+    st = fleet._handle(fleet._records["s"].rid).engine.stats()
+    assert st["offload_restores"] == 1 and st["offload_reprefills"] == 0
+
+
+def test_adopt_retag_protects_against_foreign_pid_sweep(
+    tmp_path, monkeypatch,
+):
+    """Cross-process story, unit-level: a spool file tagged with a
+    DEAD donor PID is adopted (re-tagged to the live PID); the dead
+    donor's sweep then removes genuinely orphaned files but never the
+    adopted one."""
+    import numpy as np
+
+    from room_tpu.serving import lifecycle as lc
+    from room_tpu.serving.kv_offload import TieredKVStore, _write_spool
+
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path))
+    dead_pid = 4100100  # beyond pid_max on any default Linux host
+    monkeypatch.setattr(
+        lc, "_pid_alive", lambda pid: pid == os.getpid()
+    )
+    arrays = {"k": np.arange(16, dtype=np.int8).reshape(2, 8)}
+    donor_file = str(tmp_path / f"pid{dead_pid}-cafe.kvspool")
+    _write_spool(donor_file, arrays)
+    orphan_file = str(tmp_path / f"pid{dead_pid}-dead.kvspool")
+    _write_spool(orphan_file, arrays)
+
+    store = TieredKVStore(spool_dir=str(tmp_path))
+    nbytes = os.path.getsize(donor_file)
+    assert store.adopt("s", donor_file, 16, 2, nbytes)
+    # the donor's sweep: age 0, no manifest — only the PID tag saves
+    # the adopted file
+    removed = lc.sweep_orphans(str(tmp_path), max_age_s=0.0)
+    assert removed == 1 and not os.path.exists(orphan_file)
+    assert store.has("s")
+    got = store.get("s")
+    assert got is not None
+    np.testing.assert_array_equal(got[1]["k"], arrays["k"])
+    # second failover: the next store adopts the already-live-tagged
+    # file (same PID — no rename needed) and a third sibling's sweep
+    # still cannot touch it
+    entry = store.export_entry("s")
+    assert entry is not None
+    store2 = TieredKVStore(spool_dir=str(tmp_path))
+    assert store2.adopt("s", entry["file"], 16, 2, entry["nbytes"])
+    assert lc.sweep_orphans(str(tmp_path), max_age_s=0.0) == 0
+    got2 = store2.get("s")
+    np.testing.assert_array_equal(got2[1]["k"], arrays["k"])
+
+
+# ---- observability ----
+
+def test_health_route_keys_engine_blocks_per_replica(
+    make_fleet, monkeypatch,
+):
+    """Satellite pin: /api/tpu/health must key fleet siblings'
+    scheduler/offload/lifecycle blocks by replica id (model#rid) —
+    not collapse them under the model name — plus a fleet aggregate
+    with router/failover counters."""
+    import room_tpu.providers.tpu as tpu_mod
+    from room_tpu.server.router import RequestContext, Router
+    from room_tpu.server.routes import register_all_routes
+
+    fleet = make_fleet()
+    fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    fleet.kill_replica(fleet._records["s"].rid, "test")
+
+    class FakeHost:
+        _engine = fleet
+
+        @staticmethod
+        def is_healthy():
+            return True
+
+    monkeypatch.setattr(tpu_mod, "_hosts", {"tiny-moe": FakeHost()})
+    router = Router()
+    register_all_routes(router)
+    handler, params = router.match("GET", "/api/tpu/health")
+    out = handler(RequestContext(
+        method="GET", path="/api/tpu/health", params=params, query={},
+        body=None,
+    ))
+    engines = out["data"]["engines"]
+    rows = {k for k in engines if k.startswith("tiny-moe#")}
+    assert rows == {"tiny-moe#r0", "tiny-moe#r1", "tiny-moe#r2"}
+    agg = engines["tiny-moe"]
+    assert agg["fleet"]["replicas"] == 3
+    assert agg["fleet"]["failovers"] == 1
+    assert agg["fleet"]["sessions_rehomed"] == 1
+    # per-replica blocks are the FULL engine surface, not a summary:
+    # each sibling keeps its own scheduler/offload/lifecycle blocks
+    for rid in rows:
+        row = engines[rid]
+        assert "scheduler" in row and "offload" in row
+        assert "lifecycle" in row and "replica" in row
+    dead = [r for r in rows if engines[r]["replica"]["state"] == "dead"]
+    assert len(dead) == 1
+
+
+def test_fleet_stats_aggregate_and_placements(make_fleet):
+    fleet = make_fleet()
+    for i in range(2):
+        fleet.submit(PROMPT, session_id=f"s{i}", sampling=_greedy(2))
+    fleet.run_until_idle()
+    st = fleet.stats()
+    assert st["fleet"]["serving"] == 3
+    assert sum(st["fleet"]["placements"].values()) == 2
+    assert st["tokens_decoded"] >= 2   # summed across replicas
+    assert st["healthy"] is True
+
+
+# ---- satellite: fused-window diagnosability ----
+
+def test_fused_window_disabled_reason_surfaces(model, monkeypatch):
+    cfg, params = model
+    eng = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                        n_pages=64)
+    st = eng.stats()
+    assert st["fused_window"] is True
+    assert st["fused_window_disabled_reason"] is None
+
+    monkeypatch.setenv("ROOM_TPU_FUSED_WINDOW", "0")
+    eng2 = ServingEngine(cfg, params, max_batch=4, page_size=8,
+                         n_pages=64)
+    st2 = eng2.stats()
+    assert st2["fused_window"] is False
+    assert "ROOM_TPU_FUSED_WINDOW=0" in \
+        st2["fused_window_disabled_reason"]
+
+
+def test_fused_window_dp_auto_off_is_logged_and_reported(
+    model, monkeypatch, caplog,
+):
+    """The dp auto-off (ROADMAP open item) must be diagnosable: a
+    warning at engine build and a reason string in stats()."""
+    import logging
+
+    from room_tpu.parallel import (
+        MeshSpec, decoder_param_specs, make_mesh, shard_pytree,
+    )
+
+    cfg, params = model
+    mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2))
+    sharded = shard_pytree(params, decoder_param_specs(cfg), mesh)
+    with caplog.at_level(logging.WARNING,
+                         logger="room_tpu.serving.engine"):
+        eng = ServingEngine(cfg, sharded, max_batch=4, page_size=8,
+                            n_pages=64, mesh=mesh)
+    assert eng._dp_size == 2
+    st = eng.stats()
+    assert st["fused_window"] is False
+    assert "dp" in st["fused_window_disabled_reason"]
+    assert any("fused dispatch window" in r.message
+               for r in caplog.records)
+
+
+# ---- threaded mode ----
+
+@pytest.mark.parametrize("n", [2])
+def test_threaded_fleet_serves_and_fails_over(make_fleet, control, n):
+    """The deployment shape: replica serve threads + the supervisor
+    loop. A kill mid-traffic re-homes and the continuation is
+    token-identical (adoption rides the engine thread's step)."""
+    c1, c2 = control
+    fleet = make_fleet(n=n)
+    stop = threading.Event()
+    sup = threading.Thread(
+        target=fleet.serve_forever, args=(stop,),
+        kwargs={"idle_sleep": 0.02}, daemon=True,
+    )
+    sup.start()
+    try:
+        t1 = fleet.submit(PROMPT, session_id="s", sampling=_greedy())
+        assert t1.done.wait(60) and t1.new_tokens == c1
+        victim = fleet._records["s"].rid
+        fleet.kill_replica(victim, "threaded kill")
+        assert fleet._records["s"].rid != victim
+        t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+        assert t2.done.wait(60), "adoption must apply before admission"
+        assert t2.new_tokens == c2
+    finally:
+        stop.set()
+        sup.join(timeout=30)
+    assert not sup.is_alive()

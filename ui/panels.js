@@ -1575,6 +1575,31 @@ async function renderTpu(el) {
           ? `${e.lifecycle.drain_ms}ms` : "—"}</td></tr>`).join("") ||
         '<tr><td class="dim" colspan="7">no engines warm</td></tr>'}
       </table>
+      ${Object.entries(hl.engines || {}).some(([n, e]) => e.fleet) ? `
+      <h2 style="margin-top:.6rem">fleet</h2>
+      <table><tr><th>model</th><th>replica</th><th>state</th>
+        <th>score</th><th>strikes</th><th>placed</th>
+        <th>failovers</th><th>re-homed</th><th>drains</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.fleet)
+        .flatMap(([name, e]) =>
+          Object.entries(e.fleet.health || {}).map(([rid, r]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${esc(rid)}</td>
+        <td><span class="pill ${
+          r.state === "serving" && r.healthy ? "verified"
+          : r.state === "dead" ? "failed" : "pending"
+        }">${esc(r.state)}</span></td>
+        <td>${r.score ?? ""}</td>
+        <td>${r.strikes ?? 0}</td>
+        <td>${e.fleet.placements?.[rid] ?? 0}</td>
+        <td>${e.fleet.failovers ?? 0}</td>
+        <td>${e.fleet.sessions_rehomed ?? 0}
+          <span class="dim">(${e.fleet.sessions_rehomed_warm ?? 0}
+            warm)</span></td>
+        <td>${e.fleet.bluegreen_drains ?? 0}</td>
+        </tr>`)).join("")}
+      </table>` : ""}
       ${Object.keys(hl.faults || {}).length
         ? `<div class="dim" style="margin-top:.4rem">armed faults: ${
             Object.entries(hl.faults).map(([n, f]) =>
