@@ -14,6 +14,11 @@ review comments machine-enforced on every PR:
    ``tests/`` and documented in the ``docs/chaos.md`` fault table.
 4. **FaultError dispatch** (`fault_checker.check_dispatch`) — recovery
    code matches the typed ``FaultError.point``, never message text.
+5. **fault trace/telemetry coverage**
+   (`trace_checker.check_fault_trace_coverage`) — every fault point
+   maps to a flight-recorder trace event + telemetry counter in
+   ``serving/trace.py``'s FAULT_EVENTS, and ``faults.should_fire``
+   stays wired through both (docs/observability.md).
 
 Run: ``python -m room_tpu.analysis`` (or ``make lint``). Exit 0 =
 no unsuppressed violations. Intentional violations live in
@@ -31,7 +36,7 @@ from typing import Iterable, Optional
 
 from . import (
     dispatch_checker, fault_checker, knob_checker, knobs_doc,
-    lock_checker,
+    lock_checker, trace_checker,
 )
 from .common import (
     SourceFile, Violation, apply_suppressions, iter_py_files,
@@ -80,6 +85,9 @@ def run_checks(
         violations += check_file(src, fault_points)
     if cross_checks:
         violations += fault_checker.check_coverage(repo_root)
+        violations += trace_checker.check_fault_trace_coverage(
+            repo_root
+        )
         violations += knob_checker.check_docs(
             os.path.join(repo_root, KNOBS_DOC)
         )

@@ -1,0 +1,97 @@
+"""roomlint checker 5 — fault-point trace/telemetry coverage.
+
+Every ``faults.FAULT_POINTS`` entry must be visible to the
+observability layer (docs/observability.md): a firing emits both a
+telemetry counter and a flight-recorder trace event, routed through
+``serving/trace.py``'s ``FAULT_EVENTS`` mapping. This cross-check
+keeps the three surfaces in lockstep — the same pattern as the
+fault-point coverage checker (tests + chaos.md table):
+
+- ``fault-point-untraced`` — a FAULT_POINTS entry missing from
+  ``trace.FAULT_EVENTS`` (a new fault point would fire invisibly:
+  no span event, no counter name for dashboards to alert on);
+- ``fault-trace-unknown`` — a FAULT_EVENTS key naming a point the
+  registry does not define (a typo'd mapping silently never fires);
+- ``fault-point-unwired`` — ``faults.should_fire`` no longer routes
+  firings through BOTH ``_telemetry_count`` and ``_trace_event``
+  (the central wiring the per-point mapping relies on).
+
+Both files are parsed with ``ast`` — no import of the serving package
+(which drags jax), so the lint gate stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Violation
+from .fault_checker import FAULTS_MODULE, load_fault_points
+
+TRACE_MODULE = os.path.join("room_tpu", "serving", "trace.py")
+
+
+def load_fault_events(repo_root: str) -> dict[str, str]:
+    """Parse FAULT_EVENTS out of trace.py without importing it."""
+    path = os.path.join(repo_root, TRACE_MODULE)
+    tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and \
+                        tgt.id == "FAULT_EVENTS":
+                    return dict(ast.literal_eval(node.value))
+    raise RuntimeError(f"FAULT_EVENTS not found in {path}")
+
+
+def _should_fire_calls(repo_root: str) -> set[str]:
+    """Function names called inside faults.should_fire (the central
+    firing path every armed point funnels through)."""
+    path = os.path.join(repo_root, FAULTS_MODULE)
+    tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    called: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "should_fire":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name):
+                    called.add(sub.func.id)
+    return called
+
+
+def check_fault_trace_coverage(repo_root: str) -> list[Violation]:
+    points = load_fault_points(repo_root)
+    out: list[Violation] = []
+    try:
+        events = load_fault_events(repo_root)
+    except (OSError, RuntimeError) as e:
+        return [Violation(
+            "fault-point-untraced", TRACE_MODULE, 1,
+            f"cannot load trace.FAULT_EVENTS: {e}",
+        )]
+    for name in points:
+        if name not in events:
+            out.append(Violation(
+                "fault-point-untraced", TRACE_MODULE, 1,
+                f"fault point {name!r} missing from trace.FAULT_EVENTS"
+                " — every FAULT_POINTS entry must map to the trace "
+                "event / telemetry counter its firing emits",
+            ))
+    for name in events:
+        if name not in points:
+            out.append(Violation(
+                "fault-trace-unknown", TRACE_MODULE, 1,
+                f"trace.FAULT_EVENTS maps unknown fault point "
+                f"{name!r} (known: {', '.join(points)})",
+            ))
+    called = _should_fire_calls(repo_root)
+    for fn in ("_telemetry_count", "_trace_event"):
+        if fn not in called:
+            out.append(Violation(
+                "fault-point-unwired", FAULTS_MODULE, 1,
+                f"faults.should_fire no longer calls {fn} — firings "
+                "must reach both the telemetry counter and the "
+                "flight recorder",
+            ))
+    return out

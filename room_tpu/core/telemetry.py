@@ -21,11 +21,32 @@ from .messages import get_setting, set_setting
 
 # ---- in-process resilience counters (fault injection, degradation,
 # provider fallback). Independent of the endpoint-token gate: local
-# observability (/api/tpu/health, the TPU panel) reads these whether or
-# not remote telemetry is configured; heartbeats attach them when it is.
+# observability (/api/tpu/health, the TPU panel, /metrics) reads these
+# whether or not remote telemetry is configured; heartbeats attach them
+# when it is.
 
 _counters: Counter = Counter()
 _counters_lock = threading.Lock()
+
+# fixed latency histograms (Prometheus semantics): per-bin counts
+# internally, CUMULATIVE `le` counts + _count/_sum at exposition.
+# Buckets are fixed at a histogram's first observation — mixed-bucket
+# observations against one name would corrupt the percentile math, so
+# they raise.
+DEFAULT_MS_BUCKETS = (1.0, 5.0, 20.0, 100.0, 500.0)
+
+
+class _Hist:
+    __slots__ = ("buckets", "bins", "count", "sum")
+
+    def __init__(self, buckets: tuple) -> None:
+        self.buckets = tuple(float(b) for b in buckets)
+        self.bins = [0] * (len(self.buckets) + 1)   # last = +Inf
+        self.count = 0
+        self.sum = 0.0
+
+
+_hists: dict[str, _Hist] = {}
 
 
 def incr_counter(name: str, n: int = 1) -> None:
@@ -34,16 +55,30 @@ def incr_counter(name: str, n: int = 1) -> None:
 
 
 def observe_ms(name: str, ms: float,
-               buckets: tuple = (1, 5, 20, 100, 500)) -> None:
-    """Cheap latency histogram over the shared counter map: one
-    ``<name>.le_<edge>ms`` bucket counter per observation (or
-    ``.gt_<last>ms`` past the final edge). Heartbeats and
-    /api/tpu/health pick the buckets up with every other counter."""
-    for edge in buckets:
-        if ms <= edge:
-            incr_counter(f"{name}.le_{edge:g}ms")
-            return
-    incr_counter(f"{name}.gt_{buckets[-1]:g}ms")
+               buckets: tuple = DEFAULT_MS_BUCKETS) -> None:
+    """Record one latency observation into the named fixed-bucket
+    histogram. Exposition (``histograms_snapshot`` / the /metrics
+    endpoint) is Prometheus-cumulative: each ``le`` bucket counts
+    every observation <= its edge, closed by ``_count``/``_sum`` —
+    NOT the old one-bucket-per-observation counters, whose
+    non-cumulative counts made downstream percentile math wrong."""
+    with _counters_lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = _Hist(buckets)
+        elif h.buckets != tuple(float(b) for b in buckets):
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}, got {buckets}"
+            )
+        for i, edge in enumerate(h.buckets):
+            if ms <= edge:
+                h.bins[i] += 1
+                break
+        else:
+            h.bins[-1] += 1
+        h.count += 1
+        h.sum += ms
 
 
 def counters_snapshot() -> dict[str, int]:
@@ -51,9 +86,31 @@ def counters_snapshot() -> dict[str, int]:
         return dict(_counters)
 
 
+def histograms_snapshot() -> dict[str, dict]:
+    """Cumulative (``le``-semantics) view of every histogram:
+    ``buckets`` are the finite edges, ``cumulative`` the running
+    counts per edge (the +Inf bucket equals ``count``)."""
+    with _counters_lock:
+        out = {}
+        for name, h in _hists.items():
+            cum = []
+            running = 0
+            for n in h.bins[:-1]:
+                running += n
+                cum.append(running)
+            out[name] = {
+                "buckets": list(h.buckets),
+                "cumulative": cum,
+                "count": h.count,
+                "sum": round(h.sum, 6),
+            }
+        return out
+
+
 def reset_counters() -> None:
     with _counters_lock:
         _counters.clear()
+        _hists.clear()
 
 
 def get_machine_id() -> str:
@@ -89,6 +146,22 @@ def _post(payload: dict) -> bool:
         return False
 
 
+def _flight_recorder_evidence(limit: int = 8) -> list:
+    """Recent SLO-violating / faulted turn traces for crash reports —
+    resolved through sys.modules (the db-layer faults pattern) so
+    telemetry never drags the serving stack in; a process that never
+    imported it simply attaches nothing."""
+    import sys
+
+    mod = sys.modules.get("room_tpu.serving.trace")
+    if mod is None:
+        return []
+    try:
+        return mod.recorder.snapshot(limit=limit)["violations"]
+    except Exception:
+        return []
+
+
 def submit_crash_report(
     db: Database, error: BaseException, context: str = ""
 ) -> bool:
@@ -110,6 +183,9 @@ def submit_crash_report(
         "error": f"{type(error).__name__}: {error}",
         "trace": "".join(traceback.format_exception(error))[-4000:],
         "context": context,
+        # flight-recorder evidence (docs/observability.md): the turn
+        # traces that were violating SLOs or faulting when we died
+        "turn_traces": _flight_recorder_evidence(),
     })
 
 
@@ -126,4 +202,5 @@ def submit_heartbeat(db: Database) -> bool:
         "machine": get_machine_id(),
         "rooms": rooms["n"] if rooms else 0,
         "counters": counters_snapshot(),
+        "histograms": histograms_snapshot(),
     })

@@ -134,6 +134,19 @@ class ApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _respond_text(self, status: int, body: str,
+                              content_type: str = "text/plain") -> None:
+                """Plain-text response (the Prometheus exposition —
+                JSON envelopes would break scrapers)."""
+                self._drain_unread_body()
+                raw = body.encode("utf-8")
+                self.send_response(status)
+                self._common_headers()
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
             def _common_headers(self) -> None:
                 origin = self.headers.get("Origin")
                 if origin and allowed_origin(origin, server.port):
@@ -310,6 +323,23 @@ class ApiServer:
                     self._respond(*handle_webhook_request(
                         server, self.command, path, self._read_body()
                     ))
+                    return
+
+                # Prometheus exposition, before auth (scrapers on a
+                # private network don't carry bearer tokens; the
+                # payload is operational counters only —
+                # docs/observability.md). ROOM_TPU_METRICS=0 disables.
+                if path == "/metrics" and self.command == "GET":
+                    from .metrics import (
+                        CONTENT_TYPE, metrics_enabled, render_metrics,
+                    )
+
+                    if not metrics_enabled():
+                        self._respond(404, {"error": "not found"})
+                        return
+                    self._respond_text(
+                        200, render_metrics(), CONTENT_TYPE
+                    )
                     return
 
                 if not path.startswith(("/api/", "/v1/")):

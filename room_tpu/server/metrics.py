@@ -1,0 +1,331 @@
+"""Prometheus text exposition (``GET /metrics``, docs/observability.md).
+
+One scrape unifies every surface the stack already tracks:
+
+- ``core/telemetry`` process counters (fault firings, offload churn,
+  engine crashes) as ``room_tpu_events_total{event=...}``;
+- ``core/telemetry`` fixed latency histograms (cumulative ``le``
+  semantics with ``_count``/``_sum`` — observe_ms's exposition
+  contract) as ``room_tpu_latency_ms``;
+- per-engine stats (``engines_snapshot()``: decode/prefill counters,
+  pipeline depth, offload tiers, degradation rung) as
+  ``room_tpu_engine_*`` gauges keyed by ``model`` (fleet replicas keep
+  their ``model#rid`` keys);
+- per-class SLO gauges from each engine's scheduler block (queue
+  depth, TTFT/TPOT EMA vs target, shed counts);
+- turnscope per-class SLO attribution (serving/trace.py: where each
+  class's latency budget went) as
+  ``room_tpu_slo_attribution_ms_total{class,component}``;
+- ``HttpProfiler`` endpoint latency snapshots;
+- armed chaos fault points.
+
+The format is strict text-format 0.0.4 (``# HELP``/``# TYPE`` once per
+family, families contiguous, labels escaped) — CI parses a scrape with
+a strict parser (tests/test_trace.py) so a malformed family can't
+ship. The endpoint is served pre-auth for scrapers (standard
+Prometheus deployment on a private network); ROOM_TPU_METRICS=0
+disables it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..utils import knobs
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def metrics_enabled() -> bool:
+    return knobs.get_bool("ROOM_TPU_METRICS")
+
+
+def _escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace("\n", r"\n")
+        .replace('"', r'\"')
+    )
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "NaN"
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, float):
+        if value != value:   # NaN
+            return "NaN"
+        return repr(round(value, 6))
+    return str(value)
+
+
+class _Family:
+    """One metric family: TYPE/HELP emitted once, samples contiguous
+    (the text-format invariant strict parsers enforce)."""
+
+    def __init__(self, name: str, kind: str, help_: str) -> None:
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.samples: list[tuple[str, dict, object]] = []
+
+    def add(self, labels: Optional[dict], value, suffix: str = "") -> None:
+        self.samples.append((suffix, labels or {}, value))
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {_escape(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        for suffix, labels, value in self.samples:
+            if labels:
+                lab = ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in labels.items()
+                )
+                lines.append(
+                    f"{self.name}{suffix}{{{lab}}} {_fmt(value)}"
+                )
+            else:
+                lines.append(f"{self.name}{suffix} {_fmt(value)}")
+        return "\n".join(lines)
+
+
+# engines_snapshot() top-level numeric stats exported per model; the
+# allowlist keeps the exposition stable (new nested blocks don't
+# silently become malformed gauges)
+_ENGINE_GAUGES = (
+    "tokens_decoded", "turns_completed", "prefill_tokens",
+    "decode_steps", "decode_windows", "window_faults",
+    "overshoot_tokens", "host_stall_ms", "steps_per_dispatch",
+    "evictions", "prefix_hits", "prefix_tokens_reused",
+    "engine_crashes", "stall_events", "requeues", "shed_turns",
+    "deadline_timeouts", "fault_retries", "degradation_level",
+    "offloads", "offload_restores", "offload_prefetches",
+    "offload_resident_fallbacks", "offload_reprefills",
+    "prefill_chunks_interleaved", "prefill_chunk_defers",
+    "prefill_chunk_faults", "chunk_dispatches", "fused_windows",
+    "fused_chunks", "spec_rounds", "spec_proposed", "spec_accepted",
+    "queued", "sessions", "free_pages", "max_batch", "active_slots",
+)
+
+
+def render_metrics() -> str:
+    """One Prometheus scrape of the whole process. Engine snapshots
+    are best-effort: a cold / import-failed provider layer yields the
+    process-level families only."""
+    from ..core.telemetry import counters_snapshot, histograms_snapshot
+
+    families: list[_Family] = []
+
+    # ---- process counters + histograms ----
+    events = _Family(
+        "room_tpu_events_total", "counter",
+        "Process event counters (core/telemetry.py): fault firings, "
+        "offload churn, crash/recovery events.",
+    )
+    for name, n in sorted(counters_snapshot().items()):
+        events.add({"event": name}, n)
+    families.append(events)
+
+    hist = _Family(
+        "room_tpu_latency_ms", "histogram",
+        "Fixed latency histograms (telemetry.observe_ms): cumulative "
+        "le buckets.",
+    )
+    for name, h in sorted(histograms_snapshot().items()):
+        for edge, cum in zip(h["buckets"], h["cumulative"]):
+            hist.add({"name": name, "le": f"{edge:g}"}, cum,
+                     suffix="_bucket")
+        hist.add({"name": name, "le": "+Inf"}, h["count"],
+                 suffix="_bucket")
+        hist.add({"name": name}, h["sum"], suffix="_sum")
+        hist.add({"name": name}, h["count"], suffix="_count")
+    families.append(hist)
+
+    # ---- engine / fleet / scheduler / offload ----
+    try:
+        from ..providers.tpu import engines_snapshot
+
+        engines = engines_snapshot()
+    except Exception:
+        engines = {}
+    eng_fam = _Family(
+        "room_tpu_engine", "gauge",
+        "Per-engine serving stats (engines_snapshot), keyed by model "
+        "(fleet replicas as model#rid) and stat.",
+    )
+    healthy_fam = _Family(
+        "room_tpu_engine_healthy", "gauge",
+        "1 = engine healthy, 0 = crash-restart budget exhausted.",
+    )
+    cls_fams = {
+        "queued": _Family(
+            "room_tpu_class_queue_depth", "gauge",
+            "Queued turns per SLO class (docs/scheduler.md).",
+        ),
+        "ttft_ema_s": _Family(
+            "room_tpu_class_ttft_ema_seconds", "gauge",
+            "Observed TTFT EMA per class.",
+        ),
+        "ttft_target_s": _Family(
+            "room_tpu_class_ttft_target_seconds", "gauge",
+            "TTFT target per class (ROOM_TPU_CLASS_TARGETS).",
+        ),
+        "tpot_ema_s": _Family(
+            "room_tpu_class_tpot_ema_seconds", "gauge",
+            "Observed per-token interval EMA per class.",
+        ),
+        "tpot_target_s": _Family(
+            "room_tpu_class_tpot_target_seconds", "gauge",
+            "TPOT target per class.",
+        ),
+        "shed": _Family(
+            "room_tpu_class_shed_total", "counter",
+            "Turns shed per class by the degradation ladder.",
+        ),
+        "completed": _Family(
+            "room_tpu_class_completed_total", "counter",
+            "Turns completed per class.",
+        ),
+        "rung": _Family(
+            "room_tpu_class_rung", "gauge",
+            "Degradation-ladder rung each class experiences.",
+        ),
+    }
+    offload_fams = {
+        "host_entries": _Family(
+            "room_tpu_offload_host_entries", "gauge",
+            "Hibernated sessions resident in the host-RAM tier.",
+        ),
+        "host_bytes": _Family(
+            "room_tpu_offload_host_bytes", "gauge",
+            "Host-RAM tier bytes.",
+        ),
+        "disk_entries": _Family(
+            "room_tpu_offload_disk_entries", "gauge",
+            "Hibernated sessions in the disk spool tier.",
+        ),
+        "disk_bytes": _Family(
+            "room_tpu_offload_disk_bytes", "gauge",
+            "Disk spool tier bytes.",
+        ),
+    }
+    for model, e in sorted(engines.items()):
+        for stat in _ENGINE_GAUGES:
+            v = e.get(stat)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                eng_fam.add({"model": model, "stat": stat}, v)
+        if "healthy" in e:
+            healthy_fam.add({"model": model}, bool(e["healthy"]))
+        sched = e.get("scheduler") or {}
+        for cls, row in sorted((sched.get("classes") or {}).items()):
+            for key, fam in cls_fams.items():
+                if row.get(key) is not None:
+                    fam.add({"model": model, "class": cls}, row[key])
+        off = e.get("offload") or {}
+        for key, fam in offload_fams.items():
+            if off.get(key) is not None:
+                fam.add({"model": model}, off[key])
+    families.append(eng_fam)
+    families.append(healthy_fam)
+    families.extend(cls_fams.values())
+    families.extend(offload_fams.values())
+
+    # ---- turnscope SLO attribution (serving/trace.py) ----
+    try:
+        from ..serving import trace as trace_mod
+
+        attribution = trace_mod.recorder.attribution()
+        components = trace_mod.ATTRIBUTION_COMPONENTS
+    except Exception:
+        attribution, components = {"classes": {}}, ()
+    attr_fam = _Family(
+        "room_tpu_slo_attribution_ms_total", "counter",
+        "Where each class's latency budget went, summed over finished "
+        "turns (turnscope, docs/observability.md).",
+    )
+    viol_fam = _Family(
+        "room_tpu_slo_violations_total", "counter",
+        "Turns finishing over their class TTFT/TPOT target.",
+    )
+    turns_fam = _Family(
+        "room_tpu_turns_total", "counter",
+        "Finished turns per class (turnscope), by outcome.",
+    )
+    for cls, a in sorted(attribution.get("classes", {}).items()):
+        for comp in components:
+            attr_fam.add(
+                {"class": cls, "component": comp[:-3]}, a[comp]
+            )
+        viol_fam.add({"class": cls, "kind": "ttft"},
+                     a["ttft_violations"])
+        viol_fam.add({"class": cls, "kind": "tpot"},
+                     a["tpot_violations"])
+        turns_fam.add({"class": cls, "outcome": "all"}, a["turns"])
+        turns_fam.add({"class": cls, "outcome": "error"}, a["errors"])
+        turns_fam.add({"class": cls, "outcome": "shed"}, a["shed"])
+        turns_fam.add({"class": cls, "outcome": "faulted"},
+                      a["faulted"])
+    families.append(attr_fam)
+    families.append(viol_fam)
+    families.append(turns_fam)
+
+    # ---- HTTP endpoint latency (utils/profiling.HttpProfiler) ----
+    from ..utils.profiling import http_profiler
+
+    http_n = _Family(
+        "room_tpu_http_requests_total", "counter",
+        "HTTP requests per normalized endpoint (ROOM_TPU_PROFILE_HTTP "
+        "sampling window of 500 per endpoint).",
+    )
+    http_ms = _Family(
+        "room_tpu_http_latency_ms", "gauge",
+        "HTTP endpoint latency over the profiler's sampling window.",
+    )
+    for key, row in sorted(http_profiler.snapshot().items()):
+        http_n.add({"endpoint": key}, row["count"])
+        http_ms.add({"endpoint": key, "stat": "mean"}, row["mean_ms"])
+        http_ms.add({"endpoint": key, "stat": "p95"}, row["p95_ms"])
+    families.append(http_n)
+    families.append(http_ms)
+
+    # ---- armed chaos fault points (docs/chaos.md) ----
+    try:
+        from ..serving import faults as faults_mod
+
+        armed = faults_mod.snapshot()
+    except Exception:
+        armed = {}
+    fault_fam = _Family(
+        "room_tpu_fault_armed", "gauge",
+        "Armed chaos fault points (1 = armed); firing counts ride "
+        "room_tpu_events_total{event=\"fault.<point>\"}.",
+    )
+    fired_fam = _Family(
+        "room_tpu_fault_fired_total", "counter",
+        "Firings of currently-armed fault points.",
+    )
+    for point, spec in sorted(armed.items()):
+        fault_fam.add({"point": point}, 1)
+        fired_fam.add({"point": point}, spec["fired"])
+    families.append(fault_fam)
+    families.append(fired_fam)
+
+    return "\n".join(f.render() for f in families) + "\n"
+
+
+def render_families(names: Iterable[str]) -> str:
+    """Subset render for tests: only families whose name is in
+    ``names`` (keeps strict-parser fixtures small)."""
+    full = render_metrics()
+    keep = set(names)
+    out = []
+    current_keep = False
+    for line in full.splitlines():
+        if line.startswith("# HELP "):
+            current_keep = line.split()[2] in keep
+        if current_keep:
+            out.append(line)
+    return "\n".join(out) + "\n"

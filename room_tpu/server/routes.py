@@ -1215,6 +1215,9 @@ def register_aux_routes(r: Router) -> None:
                 summary[name]["fleet"] = e["fleet"]
             if e.get("replica") is not None:
                 summary[name]["replica"] = e["replica"]
+        from ..core.telemetry import histograms_snapshot
+        from ..serving import trace as trace_mod
+
         swarm = supervision_snapshot()
         # db-less contexts (bare router probes) get zeroed journal stats
         swarm["journal"] = journal_mod.stats(ctx.db) if ctx.db else {
@@ -1237,6 +1240,13 @@ def register_aux_routes(r: Router) -> None:
             "swarm": swarm,
             "faults": faults_mod.snapshot(),
             "counters": counters_snapshot(),
+            # cumulative latency histograms (telemetry.observe_ms,
+            # le semantics) — the same data /metrics exposes
+            "histograms": histograms_snapshot(),
+            # turnscope per-class SLO attribution
+            # (docs/observability.md): where each class's latency
+            # budget went — the TPU panel's attribution table
+            "trace": trace_mod.recorder.attribution(),
             "fallback_models": fallback_models(),
         })
 
@@ -1245,7 +1255,51 @@ def register_aux_routes(r: Router) -> None:
 
         return ok(http_profiler.snapshot())
 
+    def tpu_trace(ctx):
+        """Flight-recorder dump (docs/observability.md): recent turn
+        span trees, the SLO-violation/fault evidence ring, global
+        serving events (fault firings, re-homes), and per-class
+        attribution aggregates."""
+        from ..serving import trace as trace_mod
+
+        return ok(trace_mod.recorder.snapshot(
+            limit=ctx.int_query("limit", 64)
+        ))
+
+    def tpu_profile(ctx):
+        """Trigger a bounded on-demand jax.profiler device-trace
+        capture (docs/observability.md): writes a TensorBoard trace
+        dir under ROOM_TPU_TRACE_DIR while the engines keep serving.
+        409 while a capture is already running."""
+        from ..utils.profiling import device_profiler
+
+        try:
+            started = device_profiler.start(
+                ctx.float_body("duration_s", 5.0)
+            )
+        except RuntimeError as e:
+            return err(str(e), 409)
+        return ok(started, 202)
+
+    def tpu_profile_status(ctx):
+        from ..utils.profiling import device_profiler
+
+        return ok(device_profiler.status())
+
+    def tpu_metrics(ctx):
+        """Authed JSON wrapper over the Prometheus exposition (the
+        dashboard's view; scrapers use the pre-auth GET /metrics)."""
+        from .metrics import metrics_enabled, render_metrics
+
+        if not metrics_enabled():
+            return err("metrics disabled (ROOM_TPU_METRICS=0)", 404)
+        return ok({"exposition": render_metrics()})
+
     r.get("/api/profiling/http", profiling)
+    r.get("/api/tpu/trace", tpu_trace)
+    r.post("/api/tpu/profile", tpu_profile)
+    r.get("/api/tpu/profile", tpu_profile_status)
+    r.get("/api/tpu/metrics", tpu_metrics)
     r.get("/api/tpu/engines", engine_stats)
     r.get("/api/tpu/health", tpu_health)
     r.get("/api/tpu/status", tpu_status)

@@ -1,4 +1,4 @@
-from . import faults, lifecycle, scheduler
+from . import faults, lifecycle, scheduler, trace
 from .engine import ServingEngine, Turn
 from .faults import FaultError
 from .fleet import EngineFleet
@@ -21,6 +21,7 @@ __all__ = [
     "faults",
     "lifecycle",
     "scheduler",
+    "trace",
     "TURN_CLASSES",
     "ClassTargets",
     "RequestScheduler",

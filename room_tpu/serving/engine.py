@@ -44,6 +44,7 @@ from ..models import qwen3
 from ..models.config import DecoderConfig
 from ..utils import knobs
 from . import faults
+from . import trace as trace_mod
 from .faults import FaultError
 from .kv_offload import TieredKVStore, offload_enabled_from_env
 from .kv_pages import (
@@ -173,6 +174,11 @@ class Turn:
     # re-queued at the end of the admission pass, not re-popped within
     # it
     _admit_deferred: bool = False
+    # ---- turnscope (trace.py, docs/observability.md) ----
+    # per-turn span trace (None when ROOM_TPU_TRACE=0): queue /
+    # prefill / window spans, token timestamps for TTFT/TPOT, fault
+    # and offload events — pushed into the flight recorder at finish
+    trace: Optional[Any] = None
 
     def wait(self, timeout: Optional[float] = None) -> "Turn":
         self.done.wait(timeout)
@@ -816,6 +822,8 @@ class ServingEngine:
         turn.requeues += 1
         turn.disrupted = True
         turn._mid_stream = bool(turn.new_tokens)
+        if turn.trace is not None:
+            turn.trace.ev("park", slot=slot, tokens=len(turn.new_tokens))
         self._active[slot] = None
         self._slot_tables[slot] = 0
         self._slot_lengths[slot] = 0
@@ -888,6 +896,7 @@ class ServingEngine:
             self._bump("shed_turns")
             self.scheduler.note_shed(t.turn_class)
             self._rollback_partial_prefill(t)
+            trace_mod.finish(t, self.scheduler.targets)
             t.done.set()
 
     def _fail_turn_unslotted(self, turn: Turn, msg: str) -> None:
@@ -899,6 +908,7 @@ class ServingEngine:
         self._rollback_partial_prefill(turn)
         turn.error = msg
         turn.finish_reason = "error"
+        trace_mod.finish(turn, self.scheduler.targets)
         turn.done.set()
 
     def _rollback_partial_prefill(self, turn: Turn) -> None:
@@ -1431,6 +1441,10 @@ class ServingEngine:
             submitted_at=now,
         )
         turn.admit_by = self.scheduler.admit_deadline(cls, now)
+        # turnscope (docs/observability.md): the span trace follows the
+        # turn through admission, chunked prefill, decode windows, and
+        # every death path; None when tracing is off
+        turn.trace = trace_mod.begin(sid, cls, t_submit=now)
         self.scheduler.note_submitted(cls)
         if not self._queue_put(turn, unless_draining=True):
             # graceful drain (docs/lifecycle.md): admission is closed.
@@ -1505,11 +1519,13 @@ class ServingEngine:
     def _queue_get(self) -> Turn:
         turn = self._queue.get()
         self._queue_uncount(turn)
+        trace_mod.note_dequeue(turn.trace)
         return turn
 
     def _queue_get_nowait(self) -> Turn:
         turn = self._queue.get_nowait()
         self._queue_uncount(turn)
+        trace_mod.note_dequeue(turn.trace)
         return turn
 
     def _fail_all_pending(self, msg: str, *, shed: bool = False) -> None:
@@ -1967,6 +1983,13 @@ class ServingEngine:
                 if self._restore_session(sess, evict=False):
                     budget -= 1
                     self._bump("offload_prefetches")
+                    # turnscope: a prefetch restore OVERLAPS decode —
+                    # it never blocks the turn, so it is a global
+                    # event, not a span on the turn's latency (the
+                    # blocking admission-time restore is)
+                    trace_mod.note_event(
+                        "offload_prefetch", {"session": sid}
+                    )
             except MemoryError:
                 return   # pool busy; admission will retry
 
@@ -2086,6 +2109,9 @@ class ServingEngine:
                     with self._lock:
                         self._admitting.discard(turn.session_id)
                     self._note_pressure()
+                    trace_mod.note_fault(
+                        turn.trace, getattr(e, "point", None)
+                    )
                     turn.requeues += 1
                     turn.disrupted = True
                     if turn.requeues > self.max_requeues:
@@ -2202,7 +2228,27 @@ class ServingEngine:
         # rollback then restores a consistent resident (or re-prefill)
         # state, never a half-restored one. MemoryError propagates to
         # _admit (requeue) with the host copy intact.
-        self._ensure_resident(sess)
+        tr = turn.trace
+        was_hibernated = (
+            tr is not None and self.offload_store is not None
+            and self.offload_store.has(sess.id)
+        )
+        if was_hibernated:
+            t_restore = time.monotonic()
+            pre_len = sess.length
+            self._ensure_resident(sess)
+            dt_ms = (time.monotonic() - t_restore) * 1000.0
+            tr.offload_restore_ms += dt_ms
+            if sess.length == 0 and pre_len > 0:
+                # the copy was unusable: this turn pays a history
+                # re-prefill instead of a restore
+                tr.reprefills += 1
+                tr.ev("offload_reprefill", ms=round(dt_ms, 3))
+            else:
+                tr.offload_restores += 1
+                tr.ev("offload_restore", ms=round(dt_ms, 3))
+        else:
+            self._ensure_resident(sess)
         snap = {
             "pending": sess.pending, "length": sess.length,
             "history": list(sess.history), "parked": sess.parked,
@@ -2228,6 +2274,7 @@ class ServingEngine:
 
         if turn.sampling.max_new_tokens <= 0:
             turn.finish_reason = "length"
+            trace_mod.finish(turn, self.scheduler.targets)
             turn.done.set()
             return None
         prompt = turn.prompt_tokens
@@ -2266,12 +2313,14 @@ class ServingEngine:
                 # reservation) that ran out of context: the stream
                 # legitimately ends at the tokens already delivered
                 turn.finish_reason = "length"
+                trace_mod.finish(turn, self.scheduler.targets)
                 turn.done.set()
                 return None
             turn.error = (
                 f"sequence would exceed max_seq_len {self.max_seq_len}"
             )
             turn.finish_reason = "error"
+            trace_mod.finish(turn, self.scheduler.targets)
             turn.done.set()
             return None
 
@@ -2345,6 +2394,7 @@ class ServingEngine:
                 f"{sess.length} (capacity {capacity})"
             )
             turn.finish_reason = "error"
+            trace_mod.finish(turn, self.scheduler.targets)
             turn.done.set()
             return None
 
@@ -2432,6 +2482,9 @@ class ServingEngine:
                 # per-window budget spent: hold position (the EDF key
                 # is unchanged), resume after the next decode window
                 self._bump("prefill_chunk_defers")
+                if turn.trace is not None:
+                    turn.trace.chunk_defers += 1
+                    turn.trace.ev("chunk_defer", reason="budget")
                 turn._admit_deferred = True
                 to_boundary()
                 return None
@@ -2453,6 +2506,9 @@ class ServingEngine:
                 # pages must not be starved for the step.
                 self.scheduler.refund_chunk(cls)
                 self._note_pressure()
+                if turn.trace is not None:
+                    turn.trace.chunk_defers += 1
+                    turn.trace.ev("chunk_defer", reason="pool")
                 turn._admit_deferred = True
                 to_boundary()
                 return None
@@ -2515,6 +2571,10 @@ class ServingEngine:
             except FaultError as e:
                 self._bump("prefill_chunk_faults")
                 self._note_pressure()
+                trace_mod.note_fault(
+                    turn.trace, getattr(e, "point", None) or
+                    "prefill_chunk"
+                )
                 # the faulted chunk never wrote: refund its budget
                 # unit and roll back to the last durable boundary
                 # (restores a restoring session's history mirror if
@@ -2540,8 +2600,14 @@ class ServingEngine:
             if not fused:
                 # staged chunks count when their dispatch lands
                 # (_commit_staged), keeping the counter an honest
-                # record of chunks actually on device
+                # record of chunks actually on device — same for the
+                # trace's chunk accounting
                 self._bump("prefill_chunks_interleaved")
+                if turn.trace is not None:
+                    turn.trace.chunks += 1
+                    turn.trace.chunk_tokens += cw
+                    turn.trace.ev("chunk_landed", tokens=cw,
+                                  fused=False)
             # refresh the caller's rollback snapshot IN PLACE to this
             # durable boundary: chunk progress must survive a later
             # tail-admission failure (which rolls back to ``snap`` and
@@ -2736,6 +2802,10 @@ class ServingEngine:
             turn._chunk_committed = 0
             turn._prefill_snap = None
             self.scheduler.note_admitted(turn.turn_class)
+            # prefill span ends here — the first sampled token books
+            # in the _append_token below, so TTFT sits at the same
+            # host moment the stream callback fires
+            trace_mod.note_slotted(turn.trace, sess.generation)
             self._append_token(slot, turn, int(firsts[r]))
 
     def _slot_arrays_excluding(
@@ -2946,6 +3016,10 @@ class ServingEngine:
             turn = self._active[i]
             if turn is not None:
                 turn.error = str(err)
+                trace_mod.note_fault(
+                    turn.trace,
+                    getattr(err, "point", None) or "decode_window",
+                )
                 self._finish_turn(i, turn, "error")
 
     def _flush_pipeline(self) -> int:
@@ -3014,6 +3088,13 @@ class ServingEngine:
         if fused:
             self._bump("fused_windows")
             self._bump("fused_chunks", len(staged))
+        for rec in staged:
+            tr = rec["turn"].trace
+            if tr is not None:
+                tr.chunks += 1
+                tr.chunk_tokens += len(rec["toks"])
+                tr.ev("chunk_landed", tokens=len(rec["toks"]),
+                      fused=fused)
 
     def _rollback_staged(self, err: FaultError) -> None:
         """A dispatch carrying staged chunks faulted past its retry
@@ -3035,6 +3116,10 @@ class ServingEngine:
         for rec in first_rec.values():
             turn = rec["turn"]
             undo = rec["undo"]
+            trace_mod.note_fault(
+                turn.trace, getattr(err, "point", None) or
+                "decode_window"
+            )
             sess = self.sessions.get(turn.session_id)
             if undo is not None:
                 if sess is not None:
@@ -3248,6 +3333,14 @@ class ServingEngine:
             self._slot_ahead[i] += steps
         self._bump("decode_steps")
         self._bump("decode_windows")
+        # turnscope: bill this window's dispatch wall to every turn
+        # riding it (pure host bookkeeping — no sync, the ring is
+        # still futures)
+        dispatch_s = time.monotonic() - t0
+        for i in active_idx:
+            t = self._active[i]
+            if t is not None and t.trace is not None:
+                t.trace.note_window(dispatch_s)
         return {
             "ring": ring,
             "active_idx": list(active_idx),
@@ -3265,7 +3358,7 @@ class ServingEngine:
             # the stall watchdog's input, so host work between dispatch
             # and drain (admission prefill compiles, offload sweeps)
             # can't masquerade as a device stall
-            "dispatch_s": time.monotonic() - t0,
+            "dispatch_s": dispatch_s,
         }
 
     def _drain_window(self, window: dict) -> int:
@@ -3281,6 +3374,14 @@ class ServingEngine:
             ring_host = np.asarray(window["ring"])   # [B, steps]
         wait_s = time.monotonic() - t0
         self._bump("host_stall_ms", wait_s * 1000.0)
+        # turnscope: the drain wait is billed to every turn whose
+        # tokens this window carries (still-live check happens in the
+        # loop below; an overshoot row's turn already finished and its
+        # trace is closed)
+        for i in window["active_idx"]:
+            t = window["turns"][i]
+            if t.trace is not None and not t.trace.finished:
+                t.trace.note_drain(wait_s)
         steps = window["steps"]
         decoded = 0
         overshoot = 0
@@ -3567,6 +3668,8 @@ class ServingEngine:
 
     def _append_token(self, slot: int, turn: Turn, token: int) -> None:
         turn.new_tokens.append(token)
+        if turn.trace is not None:
+            turn.trace.note_token(time.monotonic())
         if turn.first_token_at is None:
             # TTFT against the class target (docs/scheduler.md) —
             # measured at the host-side booking of the first token,
@@ -3647,6 +3750,7 @@ class ServingEngine:
         # occupant starts with no undrained positions
         self._slot_ahead[slot] = 0
         self._bump("turns_completed")
+        trace_mod.finish(turn, self.scheduler.targets)
         if sess.id in self._deferred_release:
             self._deferred_release.discard(sess.id)
             self.sessions.pop(sess.id, None)

@@ -139,6 +139,20 @@ def _telemetry_count(name: str) -> None:
         pass
 
 
+def _trace_event(name: str) -> None:
+    # every firing lands in the flight recorder's global event ring
+    # (docs/observability.md) under its trace.FAULT_EVENTS name —
+    # roomlint's fault-trace coverage cross-check pins that every
+    # FAULT_POINTS entry has one. Lazy + best-effort like telemetry.
+    try:
+        from . import trace
+
+        trace.note_event(trace.FAULT_EVENTS.get(name, f"fault.{name}"),
+                         {"point": name})
+    except Exception:
+        pass
+
+
 def inject(
     name: str,
     *,
@@ -238,6 +252,7 @@ def should_fire(name: str) -> Optional[FaultSpec]:
             spec.times -= 1
         spec.fired += 1
     _telemetry_count(name)
+    _trace_event(name)
     return spec
 
 

@@ -65,6 +65,7 @@ from typing import Any, Callable, Optional
 
 from . import faults
 from . import lifecycle as lifecycle_mod
+from . import trace as trace_mod
 from ..utils import knobs
 from .engine import Turn
 from .faults import FaultError
@@ -342,6 +343,10 @@ class EngineFleet:
         turn.shed = True
         turn.error = msg
         turn.finish_reason = "error"
+        # turnscope: router-level sheds never reach an engine, so the
+        # flight recorder books them here (evidence ring: shed=True)
+        turn.trace = trace_mod.begin(sid, turn.turn_class)
+        trace_mod.finish(turn)
         turn.done.set()
         self._bump("router_shed")
         return turn
@@ -490,6 +495,9 @@ class EngineFleet:
             priority=priority,
             turn_class=turn_class,
         )
+        # turnscope: record the placement on the turn's trace (the
+        # engine created it inside submit)
+        trace_mod.note_route(turn.trace, handle.rid)
         if not handle.is_serving() and not turn.done.is_set():
             # TOCTOU: the replica died between routing and the
             # enqueue — a turn parked on a dead engine's queue would
@@ -501,6 +509,7 @@ class EngineFleet:
             turn.shed = True
             turn.error = "replica died during submit; retry shortly"
             turn.finish_reason = "error"
+            trace_mod.finish(turn)
             turn.done.set()
             self._bump("router_shed")
         return turn
@@ -784,6 +793,9 @@ class EngineFleet:
             with self._lock:
                 rec.rid = ""
                 rec.pending_entry = entry
+            trace_mod.note_event("rehome_deferred", {
+                "session": rec.sid, "from": exclude or "",
+            })
             return
         ev = target.engine.adopt_parked_session(
             entry, fingerprint=None, require_sha=False,
@@ -793,6 +805,13 @@ class EngineFleet:
             rec.rid = target.rid
             rec.rehomed += 1
         self._bump("sessions_rehomed")
+        # turnscope: failover re-homes land in the flight recorder's
+        # global event ring — the trace answer to "why did this
+        # session's next TTFT spike" (docs/observability.md)
+        trace_mod.note_event("rehome", {
+            "session": rec.sid, "from": exclude or "",
+            "to": target.rid, "warm": entry.get("kv") is not None,
+        })
 
     def _next_target(
         self, exclude: Optional[str]

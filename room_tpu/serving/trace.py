@@ -1,0 +1,559 @@
+"""turnscope — end-to-end turn tracing + flight recorder
+(docs/observability.md).
+
+The serving stack is five layers deep (fleet router, EDF scheduler with
+chunked prefill, fused decode windows, KV offload, failover) and the
+production question is always the same: *why did this turn miss its
+TTFT target?* This module answers it with an always-on, host-side span
+recorder threading one correlation id — session id + turn sequence +
+session generation — from submit through routing, admission, chunked
+prefill, decode-window dispatch/drain, offload restore, and failover
+re-home.
+
+Span model (per turn, contiguous so components sum to wall):
+
+    turn (submit -> done)                      wall_ms
+      queue    submit -> first queue pop       queue_ms
+      prefill  first pop -> slot admission     prefill_ms
+               (chunk writes, budget defers, offload restore)
+      decode   slot admission -> done          decode_ms
+               = dispatch_ms + drain_ms + host_ms
+
+TTFT/TPOT derive from host-side token-booking timestamps (the drain
+for pipelined windows — the same moment the stream callback fires, so
+the trace measures what the client experienced).
+
+Discipline:
+
+- **Monotonic clocks only** (`time.monotonic`), never wall clocks —
+  spans must survive NTP steps.
+- **No device sync**: every hook reads host state the engine already
+  has; nothing here calls into jax. Token identity with tracing on vs
+  off is pinned in tests/test_trace.py.
+- **Bounded memory**: per-turn events are capped
+  (ROOM_TPU_TRACE_EVENTS); the flight recorder keeps two rings —
+  recently completed turns (ROOM_TPU_TRACE_RING) plus ALL
+  SLO-violating / faulted / shed turns (ROOM_TPU_TRACE_VIOLATION_RING,
+  a separate ring so a burst of healthy traffic never evicts
+  evidence). Served at /api/tpu/trace, summarized in /metrics, and
+  attached to telemetry crash reports.
+
+Threading: a TurnTrace is created on the submit thread and mutated on
+the engine thread; the fleet router annotates from the submit thread.
+Every cross-thread mutation is a GIL-atomic attribute write or list
+append; aggregate state (the recorder rings + per-class attribution)
+mutates only under the recorder lock at turn finish.
+
+The disarmed path (ROOM_TPU_TRACE=0) costs one boolean check at
+submit: `begin()` returns None and every engine hook guards on
+``turn.trace is None``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..utils import knobs
+
+__all__ = [
+    "TurnTrace", "FlightRecorder", "recorder", "FAULT_EVENTS",
+    "enabled", "set_enabled", "begin", "finish",
+    "note_dequeue", "note_slotted", "note_route", "note_fault",
+    "note_event",
+]
+
+# Every faults.FAULT_POINTS entry maps to the span-event / telemetry
+# counter name a firing emits (faults.should_fire routes through
+# _telemetry_count + _trace_event with these names). roomlint's
+# fault-trace coverage cross-check (analysis/trace_checker.py) keeps
+# this dict in lockstep with FAULT_POINTS: a new fault point cannot
+# ship invisible to the trace layer. Keep it a literal dict — the
+# checker parses it without importing this module.
+FAULT_EVENTS = {
+    "kv_alloc": "fault.kv_alloc",
+    "prefill_oom": "fault.prefill_oom",
+    "prefill_chunk": "fault.prefill_chunk",
+    "decode_step": "fault.decode_step",
+    "decode_window": "fault.decode_window",
+    "decode_stall": "fault.decode_stall",
+    "tokenizer": "fault.tokenizer",
+    "engine_crash": "fault.engine_crash",
+    "client_disconnect": "fault.client_disconnect",
+    "provider_timeout": "fault.provider_timeout",
+    "offload_io": "fault.offload_io",
+    "shutdown_io": "fault.shutdown_io",
+    "replica_crash": "fault.replica_crash",
+    "router_io": "fault.router_io",
+    "db_io": "fault.db_io",
+    "cycle_crash": "fault.cycle_crash",
+    "loop_hang": "fault.loop_hang",
+    "tool_exec": "fault.tool_exec",
+}
+
+# attribution components (per class, ms): where a class's latency
+# budget actually went, summed over finished turns
+ATTRIBUTION_COMPONENTS = (
+    "queue_ms", "prefill_ms", "dispatch_ms", "drain_ms",
+    "decode_host_ms", "offload_restore_ms", "wall_ms",
+)
+
+_turn_seq = 0
+_seq_lock = threading.Lock()
+# finish() can race between the engine thread and a fleet-router shed
+# (the submit-side TOCTOU path): the idempotency flip must be atomic
+# or a turn could book twice into the recorder
+_finish_lock = threading.Lock()
+# tests / bench A/B override the knob without re-reading env per turn
+_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    if _override is not None:
+        return _override
+    return knobs.get_bool("ROOM_TPU_TRACE")
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force tracing on/off (bench A/B, tests); None returns control
+    to ROOM_TPU_TRACE."""
+    global _override
+    _override = value
+
+
+def _next_seq() -> int:
+    global _turn_seq
+    with _seq_lock:
+        _turn_seq += 1
+        return _turn_seq
+
+
+class TurnTrace:
+    """Span accumulator for one turn. Engine-thread mutation except
+    where noted; every field is host state (ints/floats/small lists)."""
+
+    __slots__ = (
+        "cid", "sid", "seq", "cls", "rid", "generation",
+        "t_submit", "t_dequeue", "t_slotted", "t_done",
+        "t_first_token", "t_last_token", "n_tokens",
+        "windows", "dispatch_ms", "drain_ms",
+        "chunks", "chunk_tokens", "chunk_defers",
+        "offload_restore_ms", "offload_restores", "reprefills",
+        "requeues", "rehomes",
+        "events", "faults", "max_events",
+        "shed", "finish_reason", "error", "finished",
+        "ttft_target_s", "tpot_target_s",
+    )
+
+    def __init__(self, sid: str, cls: str, max_events: int,
+                 t_submit: Optional[float] = None) -> None:
+        self.sid = sid
+        self.seq = _next_seq()
+        self.cls = cls
+        self.rid = ""
+        self.generation = 0
+        self.cid = f"{sid}#{self.seq}"
+        self.t_submit = t_submit if t_submit is not None \
+            else time.monotonic()
+        self.t_dequeue: Optional[float] = None
+        self.t_slotted: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
+        self.n_tokens = 0
+        self.windows = 0
+        self.dispatch_ms = 0.0
+        self.drain_ms = 0.0
+        self.chunks = 0
+        self.chunk_tokens = 0
+        self.chunk_defers = 0
+        self.offload_restore_ms = 0.0
+        self.offload_restores = 0
+        self.reprefills = 0
+        self.requeues = 0
+        self.rehomes = 0
+        self.events: list[tuple] = []
+        self.faults: list[str] = []
+        self.max_events = max_events
+        self.shed = False
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.finished = False
+        self.ttft_target_s: Optional[float] = None
+        self.tpot_target_s: Optional[float] = None
+
+    # ---- hooks (hot path: attribute writes only) ----
+
+    def ev(self, name: str, **detail) -> None:
+        if len(self.events) >= self.max_events:
+            return
+        rel_ms = round((time.monotonic() - self.t_submit) * 1000.0, 3)
+        self.events.append(
+            (name, rel_ms, detail) if detail else (name, rel_ms)
+        )
+
+    def note_token(self, now: float) -> None:
+        self.n_tokens += 1
+        if self.t_first_token is None:
+            self.t_first_token = now
+            self.ev("first_token")
+        self.t_last_token = now
+
+    def note_window(self, dispatch_s: float) -> None:
+        self.windows += 1
+        self.dispatch_ms += dispatch_s * 1000.0
+
+    def note_drain(self, wait_s: float) -> None:
+        self.drain_ms += wait_s * 1000.0
+
+    def note_fault(self, point: str) -> None:
+        name = FAULT_EVENTS.get(point, f"fault.{point}")
+        self.faults.append(point)
+        self.ev(name)
+
+    # ---- derived spans ----
+
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return (self.t_first_token - self.t_submit) * 1000.0
+
+    def tpot_ms(self) -> Optional[float]:
+        if self.t_first_token is None or self.n_tokens < 2:
+            return None
+        return (
+            (self.t_last_token - self.t_first_token) * 1000.0
+            / (self.n_tokens - 1)
+        )
+
+    def spans(self) -> dict:
+        """Contiguous top-level spans: queue + prefill + decode sum to
+        wall exactly for a slotted turn (unattributed covers turns that
+        died queued / mid-admission)."""
+        done = self.t_done if self.t_done is not None else \
+            time.monotonic()
+        wall = (done - self.t_submit) * 1000.0
+        dequeue = self.t_dequeue
+        slotted = self.t_slotted
+        queue = ((dequeue if dequeue is not None else done)
+                 - self.t_submit) * 1000.0
+        prefill = decode = 0.0
+        if dequeue is not None:
+            prefill = ((slotted if slotted is not None else done)
+                       - dequeue) * 1000.0
+        if slotted is not None:
+            decode = (done - slotted) * 1000.0
+        host = max(0.0, decode - self.dispatch_ms - self.drain_ms)
+        return {
+            "wall_ms": round(wall, 3),
+            "queue_ms": round(queue, 3),
+            "prefill_ms": round(prefill, 3),
+            "decode_ms": round(decode, 3),
+            "dispatch_ms": round(self.dispatch_ms, 3),
+            "drain_ms": round(self.drain_ms, 3),
+            "decode_host_ms": round(host, 3),
+            "unattributed_ms": round(
+                max(0.0, wall - queue - prefill - decode), 3
+            ),
+        }
+
+    def violated(self) -> dict:
+        """SLO verdicts against the class targets captured at finish."""
+        ttft = self.ttft_ms()
+        tpot = self.tpot_ms()
+        return {
+            "ttft": (
+                self.ttft_target_s is not None and ttft is not None
+                and ttft > self.ttft_target_s * 1000.0
+            ),
+            "tpot": (
+                self.tpot_target_s is not None and tpot is not None
+                and tpot > self.tpot_target_s * 1000.0
+            ),
+        }
+
+    def to_dict(self) -> dict:
+        ttft = self.ttft_ms()
+        tpot = self.tpot_ms()
+        return {
+            "cid": self.cid,
+            "session": self.sid,
+            "class": self.cls,
+            "replica": self.rid or None,
+            "generation": self.generation,
+            "finish_reason": self.finish_reason,
+            "error": self.error,
+            "shed": self.shed,
+            "tokens": self.n_tokens,
+            "requeues": self.requeues,
+            "ttft_ms": round(ttft, 3) if ttft is not None else None,
+            "tpot_ms": round(tpot, 3) if tpot is not None else None,
+            "ttft_target_s": self.ttft_target_s,
+            "tpot_target_s": self.tpot_target_s,
+            "slo_violated": self.violated(),
+            "spans": self.spans(),
+            "prefill": {
+                "chunks": self.chunks,
+                "chunk_tokens": self.chunk_tokens,
+                "chunk_defers": self.chunk_defers,
+                "offload_restores": self.offload_restores,
+                "offload_restore_ms": round(self.offload_restore_ms, 3),
+                "reprefills": self.reprefills,
+            },
+            "decode": {
+                "windows": self.windows,
+                "dispatch_ms": round(self.dispatch_ms, 3),
+                "drain_ms": round(self.drain_ms, 3),
+            },
+            "rehomes": self.rehomes,
+            "faults": list(self.faults),
+            "events": [list(e) for e in self.events],
+        }
+
+
+class _ClassAttribution:
+    """Monotonic per-class budget-attribution sums (the /metrics
+    counters and the TPU panel's attribution table). Mutated under
+    the recorder lock."""
+
+    __slots__ = (
+        "turns", "errors", "shed", "ttft_violations",
+        "tpot_violations", "faulted", "tokens", "ttft_ms_sum",
+        "ttft_n",
+    ) + ATTRIBUTION_COMPONENTS
+
+    def __init__(self) -> None:
+        self.turns = 0
+        self.errors = 0
+        self.shed = 0
+        self.ttft_violations = 0
+        self.tpot_violations = 0
+        self.faulted = 0
+        self.tokens = 0
+        self.ttft_ms_sum = 0.0
+        self.ttft_n = 0
+        for c in ATTRIBUTION_COMPONENTS:
+            setattr(self, c, 0.0)
+
+    def snapshot(self) -> dict:
+        out = {
+            "turns": self.turns,
+            "errors": self.errors,
+            "shed": self.shed,
+            "faulted": self.faulted,
+            "ttft_violations": self.ttft_violations,
+            "tpot_violations": self.tpot_violations,
+            "tokens": self.tokens,
+            "ttft_ms_mean": round(self.ttft_ms_sum / self.ttft_n, 3)
+            if self.ttft_n else None,
+        }
+        for c in ATTRIBUTION_COMPONENTS:
+            out[c] = round(getattr(self, c), 3)
+        return out
+
+
+class FlightRecorder:
+    """Bounded retention of completed turn traces + global serving
+    events (fault firings, re-homes, profile captures).
+
+    Two turn rings: ``recent`` (every completed turn, FIFO-evicted)
+    and ``violations`` (SLO-violating, faulted, errored, or shed turns
+    — kept separately so a burst of healthy traffic can't evict the
+    evidence an incident review needs)."""
+
+    def __init__(
+        self,
+        recent_cap: Optional[int] = None,
+        violation_cap: Optional[int] = None,
+        event_cap: int = 512,
+    ) -> None:
+        if recent_cap is None:
+            recent_cap = max(1, knobs.get_int("ROOM_TPU_TRACE_RING"))
+        if violation_cap is None:
+            violation_cap = max(
+                1, knobs.get_int("ROOM_TPU_TRACE_VIOLATION_RING")
+            )
+        self._lock = threading.Lock()
+        self._recent: deque = deque(maxlen=recent_cap)
+        self._violations: deque = deque(maxlen=violation_cap)
+        self._events: deque = deque(maxlen=event_cap)
+        self._attr: dict[str, _ClassAttribution] = {}
+        self._finished = 0
+
+    def reset(self) -> None:
+        """Re-read ring caps from the knobs and clear state (tests)."""
+        with self._lock:
+            self._recent = deque(
+                maxlen=max(1, knobs.get_int("ROOM_TPU_TRACE_RING"))
+            )
+            self._violations = deque(maxlen=max(
+                1, knobs.get_int("ROOM_TPU_TRACE_VIOLATION_RING")
+            ))
+            self._events.clear()
+            self._attr.clear()
+            self._finished = 0
+
+    def note_event(self, kind: str, detail: Optional[dict] = None) -> None:
+        """Global (non-turn) serving event: fault firings, failover
+        re-homes, drains, profile captures."""
+        rec = {"kind": kind, "t_mono": round(time.monotonic(), 3)}
+        if detail:
+            rec.update(detail)
+        with self._lock:
+            self._events.append(rec)
+
+    def record(self, tr: TurnTrace) -> None:
+        viol = tr.violated()
+        keep_evidence = (
+            viol["ttft"] or viol["tpot"] or tr.shed
+            or bool(tr.faults) or tr.finish_reason == "error"
+        )
+        rec = tr.to_dict()
+        with self._lock:
+            self._finished += 1
+            self._recent.append(rec)
+            if keep_evidence:
+                self._violations.append(rec)
+            a = self._attr.get(tr.cls)
+            if a is None:
+                a = self._attr[tr.cls] = _ClassAttribution()
+            a.turns += 1
+            a.tokens += tr.n_tokens
+            if tr.finish_reason == "error":
+                a.errors += 1
+            if tr.shed:
+                a.shed += 1
+            if tr.faults:
+                a.faulted += 1
+            if viol["ttft"]:
+                a.ttft_violations += 1
+            if viol["tpot"]:
+                a.tpot_violations += 1
+            ttft = tr.ttft_ms()
+            if ttft is not None:
+                a.ttft_ms_sum += ttft
+                a.ttft_n += 1
+            spans = rec["spans"]
+            a.queue_ms += spans["queue_ms"]
+            a.prefill_ms += spans["prefill_ms"]
+            a.dispatch_ms += spans["dispatch_ms"]
+            a.drain_ms += spans["drain_ms"]
+            a.decode_host_ms += spans["decode_host_ms"]
+            a.offload_restore_ms += tr.offload_restore_ms
+            a.wall_ms += spans["wall_ms"]
+
+    def _attribution_locked(self) -> dict:
+        # callers hold self._lock
+        return {
+            "finished_turns": self._finished,
+            "classes": {
+                cls: a.snapshot()
+                for cls, a in sorted(self._attr.items())
+            },
+        }
+
+    def attribution(self) -> dict:
+        """Per-class SLO attribution: where each class's latency
+        budget went (health / /metrics / the TPU panel)."""
+        with self._lock:
+            return self._attribution_locked()
+
+    def snapshot(self, limit: int = 64) -> dict:
+        """The /api/tpu/trace payload: recent + violation turn traces
+        (newest last), global events, attribution aggregates."""
+        limit = max(1, limit)
+        with self._lock:
+            return {
+                "enabled": enabled(),
+                "recent": list(self._recent)[-limit:],
+                "violations": list(self._violations)[-limit:],
+                "events": list(self._events)[-limit:],
+                "attribution": self._attribution_locked(),
+            }
+
+
+recorder = FlightRecorder()
+
+
+# ---- module-level hooks (every caller guards on a None trace) ----
+
+def begin(sid: str, cls: str,
+          t_submit: Optional[float] = None) -> Optional[TurnTrace]:
+    """Create a turn trace (submit thread). None when disabled — the
+    engine's hooks all no-op on a None trace. ``t_submit`` aligns the
+    trace origin with the Turn's own monotonic submit stamp."""
+    if not enabled():
+        return None
+    return TurnTrace(
+        sid, cls,
+        max_events=max(8, knobs.get_int("ROOM_TPU_TRACE_EVENTS")),
+        t_submit=t_submit,
+    )
+
+
+def note_dequeue(tr: Optional[TurnTrace]) -> None:
+    """First pop from the admission queue ends the queue span
+    (requeues keep the original boundary — the queue span measures
+    time to FIRST service, the EDF wait)."""
+    if tr is not None and tr.t_dequeue is None:
+        tr.t_dequeue = time.monotonic()
+        tr.ev("dequeue")
+
+
+def note_slotted(tr: Optional[TurnTrace], generation: int) -> None:
+    """Slot admission ends the prefill span and starts decode."""
+    if tr is None:
+        return
+    if tr.t_slotted is None:
+        tr.t_slotted = time.monotonic()
+        tr.ev("slotted")
+    tr.generation = generation
+
+
+def note_route(tr: Optional[TurnTrace], rid: str) -> None:
+    """Fleet router placement (submit thread)."""
+    if tr is not None:
+        tr.rid = rid
+        tr.ev("routed", rid=rid)
+
+
+def note_fault(tr: Optional[TurnTrace], point: Optional[str]) -> None:
+    if tr is not None and point:
+        tr.note_fault(point)
+
+
+def note_event(kind: str, detail: Optional[dict] = None) -> None:
+    """Global serving event into the flight recorder (fault firings
+    via faults.should_fire, failover re-homes, profile captures).
+    Cheap no-op path when tracing is disabled."""
+    if not enabled():
+        return
+    recorder.note_event(kind, detail)
+
+
+def finish(turn, targets=None) -> None:
+    """Close a turn's trace and push it into the flight recorder.
+    Idempotent (several death paths can reach the same turn). Reads
+    the Turn's outcome fields directly; ``targets`` is the scheduler's
+    class-targets map for the SLO verdict."""
+    tr = getattr(turn, "trace", None)
+    if tr is None:
+        return
+    with _finish_lock:
+        if tr.finished:
+            return
+        tr.finished = True
+    tr.t_done = time.monotonic()
+    tr.finish_reason = getattr(turn, "finish_reason", None)
+    tr.error = getattr(turn, "error", None)
+    tr.shed = bool(getattr(turn, "shed", False))
+    tr.requeues = int(getattr(turn, "requeues", 0))
+    if targets is not None:
+        t = targets.get(tr.cls)
+        if t is not None:
+            tr.ttft_target_s = t.ttft_s
+            tr.tpot_target_s = t.tpot_s
+    tr.ev("done", reason=tr.finish_reason)
+    recorder.record(tr)

@@ -495,7 +495,32 @@ register("ROOM_TPU_PROFILE_SLOW_MS", "float", "500",
 register("ROOM_TPU_PROFILE_HTTP", "bool", "0",
          "Enable per-endpoint HTTP latency profiling.")
 register("ROOM_TPU_TRACE_DIR", "path", None,
-         "jax.profiler trace output dir (unset disables tracing).")
+         "jax.profiler device-trace output dir (default: "
+         "<data dir>/traces; used by POST /api/tpu/profile).")
+register("ROOM_TPU_PROFILE_MAX_S", "float", "120",
+         "Upper bound on an on-demand jax.profiler device-trace "
+         "capture requested via POST /api/tpu/profile.")
+
+# ---- turnscope: turn tracing / flight recorder / metrics ----
+register("ROOM_TPU_TRACE", "bool", "1",
+         "Always-on host-side turn tracing (docs/observability.md): "
+         "per-turn span trees, the flight recorder, and per-class SLO "
+         "attribution. 0 disables (begin() returns None; every "
+         "engine hook no-ops).")
+register("ROOM_TPU_TRACE_RING", "int", "256",
+         "Flight-recorder ring: recently completed turn traces "
+         "retained for /api/tpu/trace.")
+register("ROOM_TPU_TRACE_VIOLATION_RING", "int", "256",
+         "Flight-recorder evidence ring: SLO-violating / faulted / "
+         "shed turn traces, retained separately so healthy traffic "
+         "bursts never evict them.")
+register("ROOM_TPU_TRACE_EVENTS", "int", "128",
+         "Per-turn span-event cap (events past it are dropped; span "
+         "accumulators keep counting).")
+register("ROOM_TPU_METRICS", "bool", "1",
+         "Serve the Prometheus text exposition at GET /metrics "
+         "(unauthenticated, for scrapers on a private network; 0 "
+         "disables the endpoint).", scope="server")
 
 # ---- server / HTTP / cloud ----
 register("ROOM_TPU_DATA_DIR", "path", "~/.room_tpu",
@@ -638,6 +663,10 @@ register("ROOM_TPU_BENCH_FLEET", "bool", "1",
          "Run the fleet_failover bench phase (TTFT after a replica "
          "kill, zero-token-loss check, sessions re-homed).",
          scope="bench")
+register("ROOM_TPU_BENCH_TRACE", "bool", "1",
+         "Run the turnscope phases: trace-on-vs-off overhead A/B "
+         "(p50 turn latency budget <= 5%) and the per-class SLO "
+         "attribution pass (docs/observability.md).", scope="bench")
 register("ROOM_TPU_BENCH_TPU_FALLBACK", "bool", "1",
          "Re-exec the bench as the CPU-proxy profile when the TPU "
          "tunnel is unreachable (instead of the watchdog 0.0 "

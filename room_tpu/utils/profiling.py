@@ -4,19 +4,25 @@
   (reference: QUOROOM_PROFILE_HTTP, src/server/index.ts:289-320):
   per-endpoint count/mean/p95 with slow-request marks and path
   normalization (ids collapsed to :id).
-- Device traces: jax.profiler wrapper writing TensorBoard-format traces
-  (the reference had nothing on this axis; the TPU engine does).
+- Device traces: jax.profiler wrappers writing TensorBoard-format
+  traces (the reference had nothing on this axis; the TPU engine
+  does) — the inline ``device_trace`` context manager, plus the
+  on-demand ``device_profiler`` capture that POST /api/tpu/profile
+  triggers against a live serving process (docs/observability.md).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import threading
 import time
 from contextlib import contextmanager
 from . import knobs
-from typing import Iterator
+from typing import Iterator, Optional
+
+log = logging.getLogger(__name__)
 
 SLOW_MS = knobs.get_float("ROOM_TPU_PROFILE_SLOW_MS")
 
@@ -50,7 +56,10 @@ class HttpProfiler:
             samples.append(ms)
             del samples[:-500]
         if ms >= SLOW_MS:
-            print(f"[http-prof] SLOW {key} {ms:.0f}ms", flush=True)
+            # the server's logging path (the engine/fleet idiom), not
+            # a bare print: slow-request marks must land wherever the
+            # deployment routes its logs
+            log.warning("slow http request: %s %.0fms", key, ms)
 
     def reset(self) -> None:
         with self._lock:
@@ -100,6 +109,98 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def _trace_base_dir() -> str:
+    base = knobs.get_str("ROOM_TPU_TRACE_DIR")
+    if not base:
+        from ..server.auth import data_dir
+
+        base = os.path.join(data_dir(), "traces")
+    return base
+
+
+class DeviceProfiler:
+    """On-demand jax.profiler capture against a LIVE serving process —
+    what POST /api/tpu/profile triggers (docs/observability.md). One
+    capture at a time: jax.profiler is a process-global singleton, so
+    a second start would corrupt the first. The capture runs on its
+    own daemon thread (start_trace/stop_trace bracket whatever the
+    engine threads dispatch in between) and writes a timestamped
+    TensorBoard trace dir under ROOM_TPU_TRACE_DIR."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._state: dict = {"running": False}
+        self._seq = 0
+
+    def start(self, duration_s: float) -> dict:
+        """Begin a bounded capture; raises RuntimeError if one is
+        already running. Returns {dir, duration_s}."""
+        duration_s = min(
+            max(0.01, duration_s),
+            max(0.01, knobs.get_float("ROOM_TPU_PROFILE_MAX_S")),
+        )
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        # the capture counter disambiguates back-to-back captures
+        # landing in the same wall-clock second (interleaved traces in
+        # one dir corrupt the TensorBoard view)
+        out_dir = os.path.join(
+            _trace_base_dir(),
+            f"capture-{time.strftime('%Y%m%d-%H%M%S')}-{seq}",
+        )
+        with self._lock:
+            if self._state.get("running"):
+                raise RuntimeError(
+                    "a device-trace capture is already running "
+                    f"(dir {self._state.get('dir')})"
+                )
+            self._state = {
+                "running": True, "dir": out_dir,
+                "duration_s": duration_s, "error": None,
+            }
+            self._thread = threading.Thread(
+                target=self._run, args=(out_dir, duration_s),
+                daemon=True, name="device-profile",
+            )
+            self._thread.start()
+        return {"dir": out_dir, "duration_s": duration_s}
+
+    def _run(self, out_dir: str, duration_s: float) -> None:
+        try:
+            import jax
+
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(duration_s)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as e:   # noqa: BLE001 — reported via status
+            log.warning("device-trace capture failed: %s", e)
+            with self._lock:
+                self._state["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            with self._lock:
+                self._state["running"] = False
+            try:
+                from ..serving import trace as trace_mod
+
+                trace_mod.note_event("profile_capture", {
+                    "dir": out_dir, "duration_s": duration_s,
+                })
+            except Exception:
+                pass
+
+    def status(self) -> dict:
+        with self._lock:
+            return dict(self._state)
+
+
+device_profiler = DeviceProfiler()
 
 
 class StepTimer:

@@ -79,6 +79,9 @@ BODIES = {
     ("POST", "/api/contacts/email/verify"): {"code": "123456"},
     ("POST", "/api/tpu/provision"): {"model": "tiny-moe"},
     ("POST", "/api/tpu/apply"): {"model": "tiny-moe"},
+    # a bounded capture so the sweep doesn't leave a 5 s profiler
+    # running in the test server process
+    ("POST", "/api/tpu/profile"): {"duration_s": 0.05},
     ("POST", "/api/tpu/plan"): {
         "placements": [{"model": "qwen3-coder-30b", "chips": 8}],
         "totalChips": 8, "hbmPerChipGb": 16.0,

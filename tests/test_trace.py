@@ -1,0 +1,658 @@
+"""turnscope — end-to-end turn tracing, flight recorder, /metrics
+(docs/observability.md).
+
+Pins the observability layer's three hard contracts:
+
+1. **Token identity**: tracing on vs off changes NO greedy stream,
+   across dispatch-window depths {1,4} x fused/split chunk dispatch x
+   offload restore — the span recorder reads host state only, never
+   touches the device.
+2. **Honest spans**: a turn's contiguous top-level spans (queue +
+   prefill + decode) sum to its wall latency; window
+   dispatch/drain/host components live inside decode; a faulted or
+   shedded turn's trace survives in the flight recorder's evidence
+   ring with the fault point recorded.
+3. **Strict exposition**: /metrics parses with a strict Prometheus
+   text-format 0.0.4 parser (typed contiguous families, cumulative
+   histogram buckets closed by _count/_sum), and the
+   telemetry.observe_ms bucket math is le-cumulative.
+
+Quick tier: runs in the ci.yml chaos job.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import (
+    SamplingParams, ServingEngine, faults, trace,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.clear()
+    trace.set_enabled(None)
+    trace.recorder.reset()
+    yield
+    faults.clear()
+    trace.set_enabled(None)
+    trace.recorder.reset()
+
+
+@pytest.fixture()
+def build(model, monkeypatch):
+    cfg, params = model
+
+    def make(steps=4, **kw):
+        monkeypatch.setenv(
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", str(steps)
+        )
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        return ServingEngine(cfg, params, **kw)
+
+    return make
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+# ---- 1. token identity: tracing must be a pure observer -------------
+
+def test_identity_trace_on_vs_off(build, monkeypatch):
+    """Greedy streams are byte-identical with tracing enabled vs
+    disabled, across steps {1,4} x fused/split x an offload
+    hibernate/restore round trip."""
+    base = None
+    # prefetch off: the restore happens BLOCKING at admission, which
+    # is the path the trace attributes to the turn (a prefetch restore
+    # overlaps decode and is a global event instead)
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_PREFETCH", "0")
+    for steps in (1, 4):
+        for fused in ("0", "1"):
+            monkeypatch.setenv("ROOM_TPU_FUSED_WINDOW", fused)
+            # narrow interleaved chunks so the long prompt actually
+            # chunk-prefills (and fuses when fused=1)
+            monkeypatch.setenv("ROOM_TPU_PREFILL_CHUNK_PAGES", "2")
+            for arm in (False, True):
+                trace.set_enabled(arm)
+                eng = build(steps, offload=True, n_pages=128)
+                t1 = eng.submit(list(range(1, 40)), session_id="h",
+                                sampling=_greedy(6))
+                eng.run_until_idle()
+                assert eng.offload_session("h")
+                t2 = eng.submit([5, 6, 7], session_id="h",
+                                sampling=_greedy(6))
+                eng.run_until_idle()
+                assert eng.stats()["offload_restores"] >= 1
+                got = (t1.new_tokens, t2.new_tokens)
+                if base is None:
+                    base = got
+                assert got == base, \
+                    f"steps={steps} fused={fused} trace={arm}"
+                if arm:
+                    assert t1.trace is not None
+                    assert t1.trace.to_dict()["prefill"]["chunks"] > 0
+                    assert t2.trace.to_dict()["prefill"][
+                        "offload_restores"] >= 1
+                else:
+                    assert t1.trace is None
+
+
+# ---- 2. span honesty ------------------------------------------------
+
+def test_span_components_sum_to_wall(build):
+    """Contiguous spans: queue + prefill + decode == wall (to within
+    10%, per the acceptance criterion; in-process they are exact to
+    rounding), and the decode sub-spans stay inside decode."""
+    eng = build(4)
+    t = eng.submit([4, 8, 15, 16], session_id="s",
+                   sampling=_greedy(10), turn_class="queen")
+    eng.run_until_idle()
+    d = t.trace.to_dict()
+    s = d["spans"]
+    covered = s["queue_ms"] + s["prefill_ms"] + s["decode_ms"]
+    assert covered == pytest.approx(s["wall_ms"], rel=0.10)
+    assert s["unattributed_ms"] <= s["wall_ms"] * 0.10 + 1.0
+    assert d["decode"]["windows"] >= 1
+    assert s["dispatch_ms"] + s["drain_ms"] <= s["decode_ms"] + 1.0
+    assert d["ttft_ms"] is not None and d["ttft_ms"] > 0
+    assert d["tokens"] == len(t.new_tokens)
+    assert d["cid"].startswith("s#")
+    assert d["class"] == "queen" and d["generation"] == 1
+    # the recorder booked it
+    snap = trace.recorder.snapshot()
+    assert any(r["cid"] == d["cid"] for r in snap["recent"])
+    attr = trace.recorder.attribution()
+    assert attr["classes"]["queen"]["turns"] == 1
+    assert attr["classes"]["queen"]["wall_ms"] > 0
+
+
+def test_ttft_tpot_derivation(build):
+    """TTFT/TPOT derive from host token-booking timestamps and carry
+    the class targets captured at finish."""
+    eng = build(1)
+    t = eng.submit([1, 2, 3], sampling=_greedy(8),
+                   turn_class="background")
+    eng.run_until_idle()
+    d = t.trace.to_dict()
+    assert d["ttft_target_s"] == eng.scheduler.targets[
+        "background"].ttft_s
+    assert d["tpot_ms"] is not None
+    # 8 tokens booked over a tiny CPU run: tpot is (last-first)/7
+    assert 0 <= d["tpot_ms"] < 60_000
+
+
+def test_chunked_prefill_attribution(build, monkeypatch):
+    """A long prompt's interleaved chunk writes land in the trace
+    (chunk count + tokens), and the prefill span covers them."""
+    monkeypatch.setenv("ROOM_TPU_PREFILL_CHUNK_PAGES", "2")
+    eng = build(4, n_pages=128)
+    t = eng.submit(list(range(1, 60)), sampling=_greedy(4))
+    eng.run_until_idle()
+    d = t.trace.to_dict()
+    assert d["prefill"]["chunks"] >= 2
+    assert d["prefill"]["chunk_tokens"] >= 32
+    assert d["spans"]["prefill_ms"] > 0
+    names = [e[0] for e in d["events"]]
+    assert "chunk_landed" in names
+
+
+def test_queue_span_under_load(build):
+    """A turn submitted behind a full batch spends real time queued —
+    the queue span must show it."""
+    eng = build(1, max_batch=1)
+    a = eng.submit([1, 2, 3], sampling=_greedy(12))
+    b = eng.submit([4, 5, 6], sampling=_greedy(4))
+    eng.run_until_idle()
+    assert a.finish_reason and b.finish_reason
+    db = b.trace.to_dict()
+    # b waited for a's slot: queue span is a real fraction of wall
+    assert db["spans"]["queue_ms"] > 0
+
+
+# ---- 3. flight recorder ---------------------------------------------
+
+def test_faulted_turn_survives_in_evidence_ring(build, monkeypatch):
+    """A decode_window fault fails the window's turns; their traces
+    must land in the violations ring with the fault point recorded —
+    and survive a burst of healthy traffic that overflows the recent
+    ring."""
+    monkeypatch.setenv("ROOM_TPU_TRACE_RING", "4")
+    trace.recorder.reset()
+    eng = build(4, max_batch=2)
+    faults.inject("decode_window", times=1, transient=False)
+    victim = eng.submit([7, 7, 7], sampling=_greedy(6))
+    eng.run_until_idle()
+    faults.clear()
+    assert victim.finish_reason == "error"
+    d = victim.trace.to_dict()
+    assert "decode_window" in d["faults"]
+    # healthy burst overflows the 4-deep recent ring
+    for i in range(8):
+        t = eng.submit([1, 2, i + 1], sampling=_greedy(3))
+        eng.run_until_idle()
+        eng.release_session(t.session_id)
+    snap = trace.recorder.snapshot()
+    assert len(snap["recent"]) <= 4
+    assert not any(r["cid"] == d["cid"] for r in snap["recent"])
+    viol = [r for r in snap["violations"] if r["cid"] == d["cid"]]
+    assert viol and "decode_window" in viol[0]["faults"]
+    # the firing also landed in the global event ring
+    assert any(
+        e["kind"] == "fault.decode_window" for e in snap["events"]
+    )
+    # attribution counted the faulted turn
+    attr = snap["attribution"]["classes"]["worker"]
+    assert attr["faulted"] >= 1 and attr["errors"] >= 1
+
+
+def test_shedded_turn_retained(build):
+    """A ladder-shed turn (503 contract) is evidence: its trace lands
+    in the violations ring with shed=True."""
+    eng = build(1, max_batch=2)
+    eng.set_degradation(4)
+    turns = [
+        eng.submit([1, 2, i + 1], sampling=_greedy(2),
+                   turn_class="background")
+        for i in range(8)
+    ]
+    eng.step()
+    eng.set_degradation(None)
+    eng.run_until_idle()
+    shed = [t for t in turns if t.shed]
+    assert shed, "rung-4 shedding never fired"
+    snap = trace.recorder.snapshot()
+    shed_cids = {t.trace.cid for t in shed if t.trace is not None}
+    viol_cids = {r["cid"] for r in snap["violations"]}
+    assert shed_cids & viol_cids
+    rec = next(r for r in snap["violations"]
+               if r["cid"] in shed_cids)
+    assert rec["shed"] is True
+
+
+def test_disabled_tracing_records_nothing(build):
+    trace.set_enabled(False)
+    eng = build(1)
+    t = eng.submit([1, 2, 3], sampling=_greedy(3))
+    eng.run_until_idle()
+    assert t.trace is None
+    snap = trace.recorder.snapshot()
+    assert snap["recent"] == [] and snap["enabled"] is False
+
+
+def test_event_cap_bounds_turn_events(build, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_TRACE_EVENTS", "8")
+    monkeypatch.setenv("ROOM_TPU_PREFILL_CHUNK_PAGES", "1")
+    eng = build(4, n_pages=128)
+    t = eng.submit(list(range(1, 80)), sampling=_greedy(6))
+    eng.run_until_idle()
+    assert len(t.trace.events) <= 8
+    # accumulators keep counting past the event cap
+    assert t.trace.chunks >= 4
+
+
+# ---- 4. telemetry histogram bucket math -----------------------------
+
+def test_observe_ms_cumulative_buckets():
+    from room_tpu.core import telemetry
+
+    telemetry.reset_counters()
+    # edges (1, 5, 20, 100, 500): one obs per region + one overflow
+    for ms in (0.5, 3, 15, 60, 300, 900):
+        telemetry.observe_ms("t.hist", ms)
+    h = telemetry.histograms_snapshot()["t.hist"]
+    assert h["buckets"] == [1.0, 5.0, 20.0, 100.0, 500.0]
+    # cumulative le semantics: each bucket counts everything <= edge
+    assert h["cumulative"] == [1, 2, 3, 4, 5]
+    assert h["count"] == 6          # +Inf bucket == count
+    assert h["sum"] == pytest.approx(0.5 + 3 + 15 + 60 + 300 + 900)
+    # histograms no longer pollute the counter map with .le_ keys
+    assert not any(
+        ".le_" in k or ".gt_" in k
+        for k in telemetry.counters_snapshot()
+    )
+    # monotonic: a second observation only grows the counts
+    telemetry.observe_ms("t.hist", 2)
+    h2 = telemetry.histograms_snapshot()["t.hist"]
+    assert h2["cumulative"] == [1, 3, 4, 5, 6] and h2["count"] == 7
+    # mixed buckets against one name are a bug, not silent corruption
+    with pytest.raises(ValueError):
+        telemetry.observe_ms("t.hist", 1, buckets=(1, 2))
+    telemetry.reset_counters()
+
+
+def test_observe_ms_boundary_is_le():
+    from room_tpu.core import telemetry
+
+    telemetry.reset_counters()
+    telemetry.observe_ms("t.edge", 5)     # exactly on an edge: le
+    h = telemetry.histograms_snapshot()["t.edge"]
+    assert h["cumulative"] == [0, 1, 1, 1, 1]
+    telemetry.reset_counters()
+
+
+# ---- 5. /metrics strict text-format parse ---------------------------
+
+def _strict_parse(text: str) -> dict:
+    """Minimal strict Prometheus text-format 0.0.4 parser: families
+    must be typed before samples, contiguous, with escaped labels and
+    float-parseable values. Returns {family: {"type", "samples"}}."""
+    import re
+
+    families: dict = {}
+    current = None
+    seen_done = set()
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="
+        r'"(?:[^"\\]|\\.)*",?)*)\})?'
+        r" (NaN|[-+]?[0-9.eE+-]+)$"
+    )
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in seen_done, \
+                f"family {name} not contiguous"
+            current = name
+            families[name] = {"type": None, "samples": []}
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name == current, "TYPE without preceding HELP"
+            assert kind in ("counter", "gauge", "histogram",
+                            "summary", "untyped")
+            families[name]["type"] = kind
+            continue
+        assert not line.startswith("#"), f"bad comment: {line}"
+        m = sample_re.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = m.group(1)
+        assert current is not None, "sample before any family"
+        stripped = base
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            if base == current + suffix:
+                stripped = current
+        assert stripped == current or base == current, \
+            f"sample {base} outside family {current}"
+        labels = {}
+        if m.group(2):
+            for pair in re.findall(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                m.group(2),
+            ):
+                labels[pair[0]] = pair[1]
+        value = float(m.group(3)) if m.group(3) != "NaN" else None
+        seen_done.add(current)
+        families[current]["samples"].append((base, labels, value))
+    return families
+
+
+def _histogram_consistent(samples, name):
+    """Cumulative buckets monotonic per label-set, +Inf == _count."""
+    series: dict = {}
+    for base, labels, value in samples:
+        key = labels.get("name", "")
+        series.setdefault(key, {"buckets": [], "count": None,
+                                "sum": None})
+        if base.endswith("_bucket"):
+            series[key]["buckets"].append(
+                (labels["le"], value)
+            )
+        elif base.endswith("_count"):
+            series[key]["count"] = value
+        elif base.endswith("_sum"):
+            series[key]["sum"] = value
+    for key, s in series.items():
+        assert s["count"] is not None and s["sum"] is not None, \
+            (name, key)
+        prev = -1.0
+        for le, v in s["buckets"]:
+            assert v >= prev, f"{name}{{{key}}} not cumulative"
+            prev = v
+        assert s["buckets"][-1][0] == "+Inf"
+        assert s["buckets"][-1][1] == s["count"]
+
+
+def test_metrics_exposition_strict_parse(build):
+    from room_tpu.core import telemetry
+    from room_tpu.server import metrics
+
+    telemetry.reset_counters()
+    telemetry.incr_counter("fault.decode_window")
+    telemetry.incr_counter('weird"name\nwith\\escapes')
+    telemetry.observe_ms("offload.restore", 12.5)
+    telemetry.observe_ms("offload.restore", 700.0)
+    eng = build(1)
+    t = eng.submit([1, 2, 3], sampling=_greedy(4),
+                   turn_class="queen")
+    eng.run_until_idle()
+    text = metrics.render_metrics()
+    fams = _strict_parse(text)
+    assert fams["room_tpu_events_total"]["type"] == "counter"
+    events = {
+        s[1]["event"]: s[2]
+        for s in fams["room_tpu_events_total"]["samples"]
+    }
+    assert events["fault.decode_window"] == 1
+    hist = fams["room_tpu_latency_ms"]
+    assert hist["type"] == "histogram"
+    _histogram_consistent(hist["samples"], "room_tpu_latency_ms")
+    restore = [s for s in hist["samples"]
+               if s[1].get("name") == "offload.restore"]
+    assert restore, "offload.restore histogram missing"
+    # turnscope attribution families
+    attr = fams["room_tpu_slo_attribution_ms_total"]
+    assert attr["type"] == "counter"
+    comps = {(s[1]["class"], s[1]["component"]) for s in
+             attr["samples"]}
+    assert ("queen", "queue") in comps
+    assert ("queen", "wall") in comps
+    turns = {(s[1]["class"], s[1]["outcome"]): s[2]
+             for s in fams["room_tpu_turns_total"]["samples"]}
+    assert turns[("queen", "all")] >= 1
+    telemetry.reset_counters()
+
+
+def test_metrics_disabled_knob(monkeypatch):
+    from room_tpu.server import metrics
+
+    monkeypatch.setenv("ROOM_TPU_METRICS", "0")
+    assert not metrics.metrics_enabled()
+    monkeypatch.setenv("ROOM_TPU_METRICS", "1")
+    assert metrics.metrics_enabled()
+
+
+# ---- 6. routes ------------------------------------------------------
+
+def _route(method, path, body=None, query=None):
+    from room_tpu.server.router import RequestContext, Router
+    from room_tpu.server.routes import register_aux_routes
+
+    router = Router()
+    register_aux_routes(router)
+    matched = router.match(method, path)
+    assert matched is not None, f"{method} {path} unrouted"
+    handler, params = matched
+    return handler(RequestContext(
+        method=method, path=path, params=params,
+        query=query or {}, body=body, principal={"role": "user"},
+        db=None,
+    ))
+
+
+def test_trace_route(build):
+    eng = build(1)
+    t = eng.submit([9, 9], sampling=_greedy(3), turn_class="queen")
+    eng.run_until_idle()
+    out = _route("GET", "/api/tpu/trace", query={"limit": "5"})
+    assert out["status"] == 200
+    data = out["data"]
+    assert data["enabled"] is True
+    assert data["attribution"]["classes"]["queen"]["turns"] >= 1
+    assert len(data["recent"]) >= 1
+    rec = data["recent"][-1]
+    assert {"cid", "spans", "events", "class"} <= set(rec)
+
+
+def test_metrics_route_wrapper():
+    out = _route("GET", "/api/tpu/metrics")
+    assert out["status"] == 200
+    assert "# TYPE room_tpu_events_total counter" in \
+        out["data"]["exposition"]
+
+
+def test_profile_route(tmp_path, monkeypatch):
+    """POST /api/tpu/profile runs a bounded jax.profiler capture
+    against the live process and 409s a concurrent start."""
+    monkeypatch.setenv("ROOM_TPU_TRACE_DIR", str(tmp_path))
+    out = _route("POST", "/api/tpu/profile",
+                 body={"duration_s": 0.2})
+    assert out["status"] == 202
+    assert out["data"]["dir"].startswith(str(tmp_path))
+    # a second capture while one runs is a 409, not a corrupted trace
+    dup = _route("POST", "/api/tpu/profile",
+                 body={"duration_s": 0.2})
+    assert dup["status"] == 409
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = _route("GET", "/api/tpu/profile")["data"]
+        if not st["running"]:
+            break
+        time.sleep(0.05)
+    assert not st["running"]
+    assert st.get("error") is None, st
+    import os
+
+    assert os.path.isdir(out["data"]["dir"])
+    # the capture itself landed in the flight recorder's event ring
+    snap = trace.recorder.snapshot()
+    assert any(e["kind"] == "profile_capture" for e in snap["events"])
+
+
+def test_profile_duration_clamped(tmp_path, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("ROOM_TPU_PROFILE_MAX_S", "0.1")
+    out = _route("POST", "/api/tpu/profile",
+                 body={"duration_s": 9999})
+    assert out["status"] == 202
+    assert out["data"]["duration_s"] <= 0.1
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not _route("GET", "/api/tpu/profile")["data"]["running"]:
+            break
+        time.sleep(0.05)
+
+
+def test_health_route_carries_trace_block(build):
+    from room_tpu.server.router import RequestContext, Router
+    from room_tpu.server.routes import register_aux_routes
+
+    eng = build(1)
+    t = eng.submit([1, 2], sampling=_greedy(3), turn_class="worker")
+    eng.run_until_idle()
+    router = Router()
+    register_aux_routes(router)
+    handler, params = router.match("GET", "/api/tpu/health")
+    out = handler(RequestContext(
+        method="GET", path="/api/tpu/health", params=params,
+        query={}, body=None, principal={"role": "user"}, db=None,
+    ))
+    assert out["status"] == 200
+    data = out["data"]
+    assert "trace" in data and "histograms" in data
+    assert data["trace"]["classes"]["worker"]["turns"] >= 1
+
+
+def test_metrics_http_endpoint(tmp_path, monkeypatch):
+    """GET /metrics over real HTTP: served pre-auth (scraper
+    contract) with the Prometheus content type, 404 when disabled."""
+    import urllib.error
+    import urllib.request
+
+    from room_tpu.db import Database
+    from room_tpu.server.http import ApiServer
+
+    monkeypatch.setenv("ROOM_TPU_DATA_DIR", str(tmp_path / "data"))
+    db = Database(":memory:")
+    srv = ApiServer(db)
+    srv.start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+            body = resp.read().decode()
+        fams = _strict_parse(body)
+        assert "room_tpu_events_total" in fams
+        monkeypatch.setenv("ROOM_TPU_METRICS", "0")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 404
+    finally:
+        srv.stop()
+
+
+# ---- 7. fleet + crash-report integration ----------------------------
+
+def test_router_shed_turn_traced():
+    """A fleet-router shed (no healthy replica) books an evidence-ring
+    trace even though no engine ever saw the turn."""
+    from room_tpu.serving.fleet import EngineFleet
+
+    fleet = EngineFleet.__new__(EngineFleet)
+    fleet._stats = {"router_shed": 0}
+    fleet._lock = threading.Lock()
+    turn = EngineFleet._shed_turn(
+        fleet, "lost-session", [1, 2, 3], None, "queen",
+        "no healthy replica available; retry shortly",
+    )
+    assert turn.shed and turn.done.is_set()
+    snap = trace.recorder.snapshot()
+    viol = [r for r in snap["violations"]
+            if r["session"] == "lost-session"]
+    assert viol and viol[0]["shed"] is True
+    assert viol[0]["class"] == "queen"
+
+
+def test_crash_report_attaches_evidence(build, monkeypatch, tmp_path):
+    """telemetry.submit_crash_report attaches the flight recorder's
+    violation traces (resolved through sys.modules, no serving
+    import)."""
+    from room_tpu.core import telemetry
+
+    eng = build(4, max_batch=2)
+    faults.inject("decode_window", times=1, transient=False)
+    victim = eng.submit([7, 7], sampling=_greedy(4))
+    eng.run_until_idle()
+    faults.clear()
+    assert victim.finish_reason == "error"
+    ev = telemetry._flight_recorder_evidence()
+    assert ev and any("decode_window" in r["faults"] for r in ev)
+
+
+# ---- 8. roomlint fault-trace coverage cross-check -------------------
+
+def test_trace_checker_clean_on_real_tree():
+    from room_tpu.analysis.trace_checker import (
+        check_fault_trace_coverage,
+    )
+
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert check_fault_trace_coverage(root) == []
+
+
+def test_trace_checker_flags_missing_and_unknown(tmp_path):
+    """A FAULT_POINTS entry missing from FAULT_EVENTS (or a mapping
+    for an unknown point, or an unwired should_fire) fails lint."""
+    from room_tpu.analysis.trace_checker import (
+        check_fault_trace_coverage,
+    )
+
+    serving = tmp_path / "room_tpu" / "serving"
+    serving.mkdir(parents=True)
+    (serving / "faults.py").write_text(
+        'FAULT_POINTS = ("kv_alloc", "new_point")\n'
+        "def should_fire(name):\n"
+        "    _telemetry_count(name)\n"
+    )
+    (serving / "trace.py").write_text(
+        'FAULT_EVENTS = {\n'
+        '    "kv_alloc": "fault.kv_alloc",\n'
+        '    "typo_point": "fault.typo_point",\n'
+        "}\n"
+    )
+    out = check_fault_trace_coverage(str(tmp_path))
+    rules = sorted(v.rule for v in out)
+    assert "fault-point-untraced" in rules      # new_point unmapped
+    assert "fault-trace-unknown" in rules       # typo_point unknown
+    assert "fault-point-unwired" in rules       # _trace_event missing
+
+
+def test_fault_events_match_registry():
+    """Belt-and-braces runtime twin of the static check."""
+    assert set(trace.FAULT_EVENTS) == set(faults.FAULT_POINTS)
+    for point, event in trace.FAULT_EVENTS.items():
+        assert event == f"fault.{point}"

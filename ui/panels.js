@@ -1498,6 +1498,34 @@ async function renderTpu(el) {
         </tr>`)).join("") ||
         '<tr><td class="dim" colspan="8">no engines warm</td></tr>'}
       </table>
+      <h2 style="margin-top:.6rem">slo attribution</h2>
+      <table><tr><th>class</th><th>turns</th><th>ttft mean</th>
+        <th>slo misses</th><th>queue</th><th>prefill</th>
+        <th>dispatch</th><th>drain</th><th>host</th>
+        <th>offload</th></tr>
+      ${Object.entries(hl.trace?.classes || {}).map(([cls, a]) => {
+        const share = (ms) => a.wall_ms
+          ? `${Math.round((ms / a.wall_ms) * 100)}%` : "—";
+        const misses = (a.ttft_violations || 0) + (a.tpot_violations || 0);
+        return `
+        <tr><td>${esc(cls)}</td>
+        <td>${a.turns ?? 0}
+          <span class="dim">${a.errors ? `${a.errors} err` : ""}
+            ${a.shed ? `${a.shed} shed` : ""}
+            ${a.faulted ? `${a.faulted} faulted` : ""}</span></td>
+        <td>${a.ttft_ms_mean == null ? "—"
+          : `${a.ttft_ms_mean.toFixed(0)}ms`}</td>
+        <td><span class="pill ${misses ? "failed" : "verified"}">${
+          misses}</span></td>
+        <td>${share(a.queue_ms)}</td>
+        <td>${share(a.prefill_ms)}</td>
+        <td>${share(a.dispatch_ms)}</td>
+        <td>${share(a.drain_ms)}</td>
+        <td>${share(a.decode_host_ms)}</td>
+        <td>${share(a.offload_restore_ms)}</td></tr>`;
+      }).join("") ||
+        '<tr><td class="dim" colspan="10">no finished turns traced (ROOM_TPU_TRACE)</td></tr>'}
+      </table>
       <h2 style="margin-top:.6rem">kv offload</h2>
       <table><tr><th>engine</th><th>host tier</th><th>disk tier</th>
         <th>out</th><th>in</th><th>prefetch</th><th>fallbacks</th>
