@@ -924,6 +924,236 @@ def main() -> None:
         except Exception as e:
             _phase("fleet_failover", {"error": str(e)[:300]})
 
+    # Disaggregated prefill/decode A/B (docs/disagg.md): a burst of
+    # 2k-token prompts against (a) a mixed fleet — every replica eats
+    # prefill chunks between its decode windows — and (b) a
+    # role-split fleet where the burst lands on the prefill replica
+    # and queen turns on clean decode replicas. Plus the shared
+    # prefix store's session-resume delta: a second engine admitting
+    # the same system prefix pulls spooled KV instead of re-running
+    # the prefill chunks.
+    def measure_disagg_profile(roles) -> dict:
+        from room_tpu.serving.fleet import EngineFleet
+
+        bg_ctx = int(os.environ.get(
+            "ROOM_TPU_BENCH_BG_CTX", "2048" if TINY else "4096"
+        ))
+        page_size = 16
+        n_pages = max(1024, (bg_ctx * 4) // page_size + 256)
+        prev = os.environ.get("ROOM_TPU_DISAGG_PREFILL_TOKENS")
+        os.environ["ROOM_TPU_DISAGG_PREFILL_TOKENS"] = "256"
+
+        def build(i):
+            return ServingEngine(
+                cfg, params, max_batch=4, page_size=page_size,
+                n_pages=n_pages, offload=True,
+            )
+
+        try:
+            fleet = EngineFleet(
+                "bench-disagg", build, 3, auto_rebuild=False,
+                roles=roles,
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("ROOM_TPU_DISAGG_PREFILL_TOKENS", None)
+            else:
+                os.environ["ROOM_TPU_DISAGG_PREFILL_TOKENS"] = prev
+        stop = threading.Event()
+        loop = threading.Thread(
+            target=fleet.serve_forever, args=(stop,), daemon=True,
+        )
+        loop.start()
+        one = SamplingParams(temperature=0.0, max_new_tokens=2)
+        qprompt = list(range(1, 33))
+
+        def scenario(run: int) -> dict:
+            # run 0 warms every replica's shape set so run 1 measures
+            # routing + scheduling, not XLA compiles
+            burst = [
+                fleet.submit(
+                    [2 + ((run * 7 + i) % 400)] * bg_ctx,
+                    session_id=f"burst{run}_{i}",
+                    sampling=one, turn_class="background",
+                )
+                for i in range(3)
+            ]
+            # wait until the burst's chunked prefills are actually in
+            # flight — a queen submitted before that measures nothing
+            base = fleet.stats().get("prefill_chunks_interleaved", 0)
+            wait_until = time.perf_counter() + 10
+            while time.perf_counter() < wait_until:
+                if fleet.stats().get(
+                    "prefill_chunks_interleaved", 0
+                ) > base:
+                    break
+                time.sleep(0.002)
+            first: dict = {}
+            t0 = time.perf_counter()
+            q = fleet.submit(
+                qprompt, session_id=f"queen{run}", sampling=one,
+                turn_class="queen",
+                on_token=lambda tok: first.setdefault(
+                    "t", time.perf_counter()),
+            )
+            q.done.wait(WATCHDOG_S)
+            for b in burst:
+                b.done.wait(WATCHDOG_S)
+            if fleet.disagg.enabled:
+                # let the turn-boundary KV ships land before the
+                # sessions are released (the handoff is the thing
+                # this phase exists to measure)
+                base_ships = run * len(burst)
+                wait_until = time.perf_counter() + 5
+                while time.perf_counter() < wait_until:
+                    if fleet.disagg.stats()["ships"] >= \
+                            base_ships + len(burst):
+                        break
+                    time.sleep(0.01)
+            for t in burst + [q]:
+                fleet.release_session(t.session_id)
+            return {
+                "ttft": (first["t"] - t0) if "t" in first else None,
+                "queen_finish": q.finish_reason,
+                "queen_rid": getattr(q.trace, "rid", None),
+            }
+
+        try:
+            scenario(0)
+            _extend_deadline()
+            m = scenario(1)
+        finally:
+            stop.set()
+            loop.join(30)
+            fleet.disagg.close()
+        st = fleet.fleet_stats()
+        out = {
+            "roles": roles,
+            "bg_ctx": bg_ctx,
+            "queen_ttft_under_burst_s": round(m["ttft"], 4)
+            if m["ttft"] is not None else None,
+            "queen_finish": m["queen_finish"],
+            "prefill_placements":
+                st["disagg"].get("prefill_placements", 0),
+            "ships": st["disagg"].get("ships", 0),
+            "ships_warm": st["disagg"].get("ships_warm", 0),
+        }
+        del fleet
+        gc.collect()
+        return out
+
+    def measure_prefix_store_resume() -> dict:
+        import tempfile
+
+        sys_ctx = 1024 if TINY else 2048
+        sysp = [3 + (i % 350) for i in range(sys_ctx)]
+        page_size = 16
+        n_pages = max(512, (sys_ctx * 3) // page_size + 128)
+        pfx_dir = tempfile.mkdtemp(prefix="room_tpu_bench_pfx_")
+        prev_dir = os.environ.get("ROOM_TPU_PREFIX_STORE_DIR")
+        prev_pages = os.environ.get("ROOM_TPU_PREFIX_CACHE_PAGES")
+        os.environ["ROOM_TPU_PREFIX_STORE_DIR"] = pfx_dir
+        os.environ.setdefault("ROOM_TPU_PREFIX_CACHE_PAGES", "2")
+
+        def build(store: bool):
+            return ServingEngine(
+                cfg, params, max_batch=4, page_size=page_size,
+                n_pages=n_pages, prefix_store=store,
+            )
+
+        def resume_cost(store: bool) -> dict:
+            eng = build(store)
+            t0 = time.perf_counter()
+            t = eng.submit(sysp + [7, 8, 9], session_id="resume",
+                           sampling=SamplingParams(
+                               temperature=0.0, max_new_tokens=2))
+            eng.run_until_idle()
+            wall = time.perf_counter() - t0
+            st = eng.stats()
+            out = {
+                "wall_s": round(wall, 4),
+                "prefill_chunks":
+                    st.get("prefill_chunks_interleaved", 0),
+                "chunk_dispatches": st.get("chunk_dispatches", 0)
+                + st.get("fused_chunks", 0),
+                "store_hits": st.get("prefix_store_hits", 0),
+                "finish": t.finish_reason,
+            }
+            del eng
+            gc.collect()
+            return out
+
+        try:
+            # publisher pass: computes + publishes the shared prefix
+            pub = build(True)
+            w = pub.submit(sysp + [5, 6], session_id="warm",
+                           sampling=SamplingParams(
+                               temperature=0.0, max_new_tokens=2))
+            pub.run_until_idle()
+            published = pub.stats().get("prefix_store_publishes", 0)
+            del pub, w
+            gc.collect()
+            cold = resume_cost(False)   # re-prefills everything
+            warm = resume_cost(True)    # pulls the published prefix
+        finally:
+            if prev_dir is None:
+                os.environ.pop("ROOM_TPU_PREFIX_STORE_DIR", None)
+            else:
+                os.environ["ROOM_TPU_PREFIX_STORE_DIR"] = prev_dir
+            if prev_pages is None:
+                os.environ.pop("ROOM_TPU_PREFIX_CACHE_PAGES", None)
+            else:
+                os.environ["ROOM_TPU_PREFIX_CACHE_PAGES"] = prev_pages
+            import shutil
+
+            shutil.rmtree(pfx_dir, ignore_errors=True)
+        return {
+            "sys_ctx": sys_ctx,
+            "published": published,
+            "cold": cold,
+            "warm": warm,
+            # the acceptance number: chunk dispatches the store hit
+            # removed from the resume re-prefill (must be > 0)
+            "prefill_chunk_dispatch_delta":
+                cold["prefill_chunks"] - warm["prefill_chunks"],
+            "reprefill_wall_delta_s": round(
+                cold["wall_s"] - warm["wall_s"], 4),
+        }
+
+    if os.environ.get("ROOM_TPU_BENCH_DISAGG", "1") != "0":
+        ab = {}
+        for label, roles in (
+            ("mixed", ["mixed", "mixed", "mixed"]),
+            ("roles", ["prefill", "decode", "decode"]),
+        ):
+            _extend_deadline()
+            try:
+                ab[label] = measure_disagg_profile(roles)
+            except Exception as e:
+                ab[label] = {"error": str(e)[:300]}
+        if "error" not in ab.get("mixed", {}) and \
+                "error" not in ab.get("roles", {}):
+            mixed_ttft = ab["mixed"]["queen_ttft_under_burst_s"]
+            roles_ttft = ab["roles"]["queen_ttft_under_burst_s"]
+            # positive = role specialization protected that much
+            # queen TTFT from the prompt burst
+            ab["queen_ttft_delta_s"] = round(
+                mixed_ttft - roles_ttft, 4
+            ) if mixed_ttft is not None and roles_ttft is not None \
+                else None
+            if CPU_PROXY and ab["queen_ttft_delta_s"] is not None:
+                _proxy_deltas["disagg_queen_ttft_delta_s"] = \
+                    ab["queen_ttft_delta_s"]
+        _extend_deadline()
+        try:
+            ab["prefix_store"] = measure_prefix_store_resume()
+            if CPU_PROXY:
+                _proxy_deltas["prefix_store_chunk_dispatch_delta"] = \
+                    ab["prefix_store"]["prefill_chunk_dispatch_delta"]
+        except Exception as e:
+            ab["prefix_store"] = {"error": str(e)[:300]}
+        _phase("disagg", ab)
+
     # SLO scheduler A/B (docs/scheduler.md): inject a multi-thousand-
     # token BACKGROUND prefill into a busy room (worker lanes decoding)
     # and land a QUEEN turn mid-prefill. Chunked interleave must bound

@@ -19,11 +19,40 @@ the same `psum`/`all_gather`/`ppermute` code that runs single-host.
 Data order note: `make_global_mesh` keeps device order host-major so
 the dp axis splits across hosts first (gradient all-reduce inside a
 host rides ICI; only the cross-host slice crosses DCN).
+
+KV wire (docs/disagg.md)
+------------------------
+The second half of this module is the *host-side* DCN story: shipping
+finished prefill KV between replicas that do not share a process (or a
+host). A shipment is one spool-format payload — the exact bytes a
+``.kvspool`` file holds — framed as::
+
+    b"RTKW" | u32 version | u64 header_len | header json
+           | u64 payload_len | payload bytes
+
+The header carries the manifest-style session entry (history, pending
+token, generation, kv metadata incl. the spool's sha256) plus the
+donor's config fingerprint; the receiver hashes the payload while
+writing it to its local spool dir and refuses a digest mismatch — the
+same checksum contract ``.kvspool`` files already have, applied in
+transit. A refused/lost/corrupt shipment degrades to the router's
+history-mirror re-prefill, never a misroute: ``kv_wire`` is the fault
+point. ``KVWireServer`` is the receiving end (one per fleet/host);
+``kv_wire_send`` the sending call. Tests and single-host deployments
+run it over loopback (ROOM_TPU_DISAGG_WIRE=loopback); a cross-host pod
+points the sender at the decode host's listener.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import hashlib
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+from typing import Callable, Optional
 
 import jax
 import numpy as np
@@ -100,3 +129,321 @@ def make_global_mesh(spec: MeshSpec) -> Mesh:
         spec.dp, spec.ep, spec.tp
     )
     return Mesh(arr, AXES)
+
+
+# ---- KV wire: cross-host prefill->decode shipments (docs/disagg.md) ----
+
+log = logging.getLogger(__name__)
+
+WIRE_MAGIC = b"RTKW"
+WIRE_VERSION = 1
+# a header is a session entry (token ints) + kv metadata: far under
+# this, and an unbounded length prefix must never allocate unbounded
+_MAX_HEADER = 64 * 1024 * 1024
+# payloads are KV spool bytes; generous but bounded
+_MAX_PAYLOAD = 64 * 1024 * 1024 * 1024
+
+
+class KVWireError(RuntimeError):
+    """A shipment failed in transit (socket error, protocol garbage,
+    checksum mismatch, receiver refusal). Callers degrade to the
+    history-mirror re-prefill — this error never propagates past the
+    ship coordinator."""
+
+
+def wire_timeout_s() -> float:
+    try:
+        return max(0.1, knobs.get_float("ROOM_TPU_KV_WIRE_TIMEOUT_S"))
+    except ValueError:
+        return 10.0
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise KVWireError("connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _send_json(conn: socket.socket, obj: dict) -> None:
+    raw = json.dumps(obj, separators=(",", ":")).encode()
+    conn.sendall(struct.pack("<Q", len(raw)) + raw)
+
+
+def _recv_json(conn: socket.socket, cap: int = _MAX_HEADER) -> dict:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    if n > cap:
+        raise KVWireError(f"oversized wire frame ({n} bytes)")
+    try:
+        obj = json.loads(_recv_exact(conn, n).decode())
+    except ValueError as e:
+        raise KVWireError(f"bad wire json: {e}") from e
+    if not isinstance(obj, dict):
+        raise KVWireError("wire frame is not an object")
+    return obj
+
+
+def kv_wire_send(
+    address: tuple[str, int],
+    entry: dict,
+    *,
+    fingerprint: Optional[dict] = None,
+    target_rid: Optional[str] = None,
+    timeout_s: Optional[float] = None,
+) -> dict:
+    """Ship one manifest-style session entry (and its spool file's
+    bytes, when ``entry['kv']`` names one) to a ``KVWireServer``.
+    Returns the receiver's reply dict; raises KVWireError on any
+    transport/protocol/refusal failure — the caller owns the
+    degrade-to-re-prefill fallback. The local spool file is NOT
+    consumed; the caller unlinks it after a successful send."""
+    from ..serving import faults
+
+    faults.maybe_fail("kv_wire")
+    timeout_s = timeout_s if timeout_s is not None else wire_timeout_s()
+    kv = entry.get("kv") if isinstance(entry.get("kv"), dict) else None
+    src = str(kv["file"]) if kv and kv.get("file") else None
+    header_entry = dict(entry)
+    payload_len = 0
+    if kv is not None and src:
+        try:
+            payload_len = os.path.getsize(src)
+        except OSError as e:
+            raise KVWireError(f"spool file unreadable: {e}") from e
+        kv = dict(kv)
+        kv["file"] = os.path.basename(src)
+        header_entry["kv"] = kv
+    else:
+        header_entry["kv"] = None
+    header = {
+        "entry": header_entry,
+        "fingerprint": fingerprint,
+        "target_rid": target_rid,
+        "payload_sha256": (kv or {}).get("sha256"),
+    }
+    raw = json.dumps(header, separators=(",", ":")).encode()
+    try:
+        with socket.create_connection(
+            address, timeout=timeout_s
+        ) as conn:
+            conn.sendall(
+                WIRE_MAGIC + struct.pack("<I", WIRE_VERSION)
+                + struct.pack("<Q", len(raw)) + raw
+                + struct.pack("<Q", payload_len)
+            )
+            if payload_len:
+                with open(src, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        conn.sendall(chunk)
+            reply = _recv_json(conn)
+    except (OSError, struct.error) as e:
+        raise KVWireError(f"wire send failed: {e}") from e
+    if not reply.get("ok"):
+        raise KVWireError(
+            f"receiver refused shipment: {reply.get('error')}"
+        )
+    return reply
+
+
+class KVWireServer:
+    """Receiving end of the KV wire: accepts framed shipments, writes
+    the spool payload into ``spool_dir`` (sha256 verified in transit,
+    atomic rename, receiver-PID-tagged so the dir's orphan sweeps
+    protect it), then hands the localized entry to ``on_entry`` —
+    the fleet adopts it into a decode replica there — and replies with
+    that callback's dict.
+
+    One listener per fleet/host; connections are handled serially per
+    accept thread (shipments are rare, multi-MB events — simplicity
+    over concurrency)."""
+
+    def __init__(
+        self,
+        spool_dir: str,
+        on_entry: Callable[[dict, Optional[dict], Optional[str]], dict],
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+    ) -> None:
+        self.spool_dir = spool_dir
+        self.on_entry = on_entry
+        if port is None:
+            try:
+                port = knobs.get_int("ROOM_TPU_KV_WIRE_PORT")
+            except ValueError:
+                port = 0
+        os.makedirs(spool_dir, exist_ok=True)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self._sock.settimeout(0.25)
+        self.address: tuple[str, int] = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"kv-wire-{self.address[1]}",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with conn:
+                    conn.settimeout(wire_timeout_s())
+                    self._serve_one(conn)
+            except Exception:
+                log.exception("kv wire: connection handler failed")
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            reply = self._receive(conn)
+        except KVWireError as e:
+            reply = {"ok": False, "error": str(e)}
+        except Exception as e:   # noqa: BLE001 — reply, never die
+            reply = {"ok": False, "error": f"receiver error: {e}"}
+        try:
+            _send_json(conn, reply)
+        except OSError:
+            pass
+
+    def _receive(self, conn: socket.socket) -> dict:
+        from ..serving import faults
+
+        faults.maybe_fail("kv_wire")
+        magic = _recv_exact(conn, 4)
+        if magic != WIRE_MAGIC:
+            raise KVWireError(f"bad magic {magic!r}")
+        (version,) = struct.unpack("<I", _recv_exact(conn, 4))
+        if version != WIRE_VERSION:
+            raise KVWireError(f"unsupported wire version {version}")
+        (hdr_len,) = struct.unpack("<Q", _recv_exact(conn, 8))
+        if hdr_len > _MAX_HEADER:
+            raise KVWireError(f"oversized header ({hdr_len} bytes)")
+        try:
+            header = json.loads(_recv_exact(conn, hdr_len).decode())
+        except ValueError as e:
+            raise KVWireError(f"bad header json: {e}") from e
+        (payload_len,) = struct.unpack("<Q", _recv_exact(conn, 8))
+        if payload_len > _MAX_PAYLOAD:
+            raise KVWireError(f"oversized payload ({payload_len} bytes)")
+        entry = header.get("entry")
+        if not isinstance(entry, dict):
+            raise KVWireError("header missing entry")
+        kv = entry.get("kv") if isinstance(entry.get("kv"), dict) \
+            else None
+        if payload_len and kv is not None:
+            # single accept thread: the counter needs no lock
+            self._seq += 1
+            seq = self._seq
+            fname = f"pid{os.getpid()}-wire{seq}-" \
+                f"{os.path.basename(str(kv.get('file') or 'kv'))}"
+            if not fname.endswith(".kvspool"):
+                fname += ".kvspool"
+            path = os.path.join(self.spool_dir, fname)
+            tmp = path + ".tmp"
+            h = hashlib.sha256()
+            remaining = payload_len
+            try:
+                with open(tmp, "wb") as f:
+                    while remaining:
+                        chunk = conn.recv(min(1 << 20, remaining))
+                        if not chunk:
+                            raise KVWireError(
+                                "connection closed mid-payload"
+                            )
+                        f.write(chunk)
+                        h.update(chunk)
+                        remaining -= len(chunk)
+            except KVWireError:
+                # a sender dying mid-payload must not leave the
+                # partial .tmp on disk any more than an I/O error does
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            except OSError as e:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise KVWireError(f"payload write failed: {e}") from e
+            expected = header.get("payload_sha256")
+            if expected and h.hexdigest() != expected:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise KVWireError("payload checksum mismatch")
+            os.replace(tmp, path)
+            kv = dict(kv)
+            kv["file"] = path
+            # the receiver recomputed the digest over the exact bytes
+            # it persisted: that is the sha the adopting store should
+            # verify lazily at first read
+            kv["sha256"] = h.hexdigest()
+            kv["nbytes"] = payload_len
+            entry = dict(entry)
+            entry["kv"] = kv
+        else:
+            if payload_len:
+                # payload with no kv record: drain it to keep the
+                # connection sane, then refuse
+                remaining = payload_len
+                while remaining:
+                    chunk = conn.recv(min(1 << 20, remaining))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+                raise KVWireError("payload without kv metadata")
+            entry = dict(entry)
+            entry["kv"] = None
+        persisted = (entry.get("kv") or {}).get("file") \
+            if isinstance(entry.get("kv"), dict) else None
+        try:
+            result = self.on_entry(
+                entry, header.get("fingerprint"),
+                header.get("target_rid"),
+            )
+        except Exception:
+            # the callback never queued an adoption: the persisted
+            # spool has no consumer — drop it, don't fill wire-in
+            if persisted:
+                try:
+                    os.unlink(persisted)
+                except OSError:
+                    pass
+            raise
+        out = {"ok": True}
+        if isinstance(result, dict):
+            out.update(result)
+        if not out.get("ok", True) and persisted:
+            # an explicit refusal (e.g. named target not serving) also
+            # leaves the spool unowned; a queued-but-slow adoption
+            # replies ok=True and keeps it
+            try:
+                os.unlink(persisted)
+            except OSError:
+                pass
+        return out

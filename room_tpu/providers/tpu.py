@@ -31,6 +31,7 @@ from ..serving import lifecycle as lifecycle_mod
 from ..serving.faults import FaultError
 from ..serving.fleet import fleet_replicas_from_env
 from ..serving.kv_offload import offload_enabled_from_env
+from ..serving.prefix_store import prefix_store_enabled_from_env
 from .base import ExecutionRequest, ExecutionResult, ProviderError
 
 MODEL_CONFIGS: dict[str, Callable] = {
@@ -337,6 +338,13 @@ class ModelHost:
             # scale past HBM capacity. The library default stays
             # off; ROOM_TPU_OFFLOAD=0 opts a deployment out.
             offload=offload_enabled_from_env("1"),
+            # fleet-global shared prefix store ON by default in
+            # deployment (docs/disagg.md): replicas publish computed
+            # system-prompt prefix KV and pull it instead of
+            # re-prefilling — the multiplier under disaggregated
+            # routing, where decode replicas admit re-homed sessions.
+            # Library default off; ROOM_TPU_PREFIX_STORE=0 opts out.
+            prefix_store=prefix_store_enabled_from_env("1"),
         )
 
     def shutdown(

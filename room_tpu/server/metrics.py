@@ -108,6 +108,10 @@ _ENGINE_GAUGES = (
     "prefill_chunk_faults", "chunk_dispatches", "fused_windows",
     "fused_chunks", "spec_rounds", "spec_proposed", "spec_accepted",
     "queued", "sessions", "free_pages", "max_batch", "active_slots",
+    # shared prefix store + disagg ships (docs/disagg.md)
+    "prefix_store_hits", "prefix_store_tokens_reused",
+    "prefix_store_pull_fallbacks", "prefix_store_publishes",
+    "sessions_shipped",
 )
 
 
@@ -194,6 +198,16 @@ def render_metrics() -> str:
             "Degradation-ladder rung each class experiences.",
         ),
     }
+    pfx_fam = _Family(
+        "room_tpu_prefix_store", "gauge",
+        "Fleet-global shared prefix store counters per engine "
+        "(docs/disagg.md).",
+    )
+    ship_fam = _Family(
+        "room_tpu_disagg_ships_total", "counter",
+        "Prefill->decode KV shipments per fleet, by outcome "
+        "(docs/disagg.md).",
+    )
     offload_fams = {
         "host_entries": _Family(
             "room_tpu_offload_host_entries", "gauge",
@@ -228,10 +242,26 @@ def render_metrics() -> str:
         for key, fam in offload_fams.items():
             if off.get(key) is not None:
                 fam.add({"model": model}, off[key])
+        pfx = e.get("prefix_store") or {}
+        for key in ("publishes", "hits", "misses", "evictions",
+                    "pull_errors", "publish_errors",
+                    "bytes_published", "bytes_pulled", "entries"):
+            v = pfx.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                pfx_fam.add({"model": model, "stat": key}, v)
+        dis = (e.get("fleet") or {}).get("disagg") or {}
+        for key in ("ships", "ships_warm", "ships_reprefill",
+                    "ships_deferred", "ships_refused", "ship_wire",
+                    "wire_errors"):
+            v = dis.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                ship_fam.add({"model": model, "outcome": key}, v)
     families.append(eng_fam)
     families.append(healthy_fam)
     families.extend(cls_fams.values())
     families.extend(offload_fams.values())
+    families.append(pfx_fam)
+    families.append(ship_fam)
 
     # ---- turnscope SLO attribution (serving/trace.py) ----
     try:

@@ -1186,7 +1186,11 @@ def register_aux_routes(r: Router) -> None:
                 # fused-window diagnosability (docs/serving.md): a
                 # mixed-mesh fleet must show WHY a replica fell back
                 # to split per-chunk dispatches
-                "fused_window", "fused_window_disabled_reason")
+                "fused_window", "fused_window_disabled_reason",
+                # shared prefix store + disagg ships (docs/disagg.md)
+                "prefix_store_hits", "prefix_store_tokens_reused",
+                "prefix_store_pull_fallbacks",
+                "prefix_store_publishes", "sessions_shipped")
         summary = {
             name: {k: e[k] for k in keys if k in e}
             for name, e in engines.items()
@@ -1215,6 +1219,11 @@ def register_aux_routes(r: Router) -> None:
                 summary[name]["fleet"] = e["fleet"]
             if e.get("replica") is not None:
                 summary[name]["replica"] = e["replica"]
+            # shared prefix store block (docs/disagg.md): publish/
+            # pull/eviction counters + dir occupancy, rendered whole
+            # by the TPU panel's prefix-store row
+            if e.get("prefix_store") is not None:
+                summary[name]["prefix_store"] = e["prefix_store"]
         from ..core.telemetry import histograms_snapshot
         from ..serving import trace as trace_mod
 

@@ -1,8 +1,9 @@
-from . import faults, lifecycle, scheduler, trace
+from . import disagg, faults, lifecycle, scheduler, trace
 from .engine import ServingEngine, Turn
 from .faults import FaultError
 from .fleet import EngineFleet
 from .kv_offload import TieredKVStore
+from .prefix_store import SharedPrefixStore
 from .kv_pages import PageTable, init_page_cache, make_paged_kv_hook
 from .sampler import SamplingParams, sample, sample_batched
 from .scheduler import TURN_CLASSES, ClassTargets, RequestScheduler
@@ -17,7 +18,9 @@ from .tokenizer import (
 __all__ = [
     "ServingEngine",
     "EngineFleet",
+    "SharedPrefixStore",
     "Turn",
+    "disagg",
     "faults",
     "lifecycle",
     "scheduler",

@@ -1,0 +1,642 @@
+"""Disaggregated prefill/decode serving (docs/disagg.md).
+
+The fleet (serving/fleet.py) made replicas interchangeable; this module
+makes them *specialized*. A burst of 2k-token prompts from a thousand
+rooms used to land its chunked prefills between every replica's decode
+windows — each chunk a dispatch stolen from live sessions' token
+cadence. With ``ROOM_TPU_FLEET_ROLES`` the router knows which replicas
+are **prefill** (admit fresh long-prompt sessions, run chunked prefill
+to completion on wide submeshes), which are **decode** (serve the
+steady token streams), and which stay **mixed** (the classic fleet
+behavior). The standard disaggregated-serving architecture surveyed in
+PAPERS.md ("Inference Optimization of Foundation Models on AI
+Accelerators", 2407.09111), built on three seams that already exist:
+
+- **Placement**: the router sends a fresh session whose prompt is at
+  least ``ROOM_TPU_DISAGG_PREFILL_TOKENS`` to the healthiest prefill
+  replica; everything else prefers decode/mixed replicas. Affinity
+  still wins for placed sessions — roles only choose the FIRST home.
+
+- **Shipment**: when a prefill-homed session's turn completes (the
+  prompt's KV fully materialized, the stream delivered contiguously
+  from one replica — a turn's stream never splices across replicas),
+  the coordinator exports the session (``ServingEngine.
+  export_session``: park + offload + detached-spool, the exact crash-
+  salvage format) and a decode replica adopts it
+  (``adopt_parked_session``) so every subsequent turn decodes there.
+  Same-process ships hand the detached spool file over directly —
+  byte-identical to failover; with ``ROOM_TPU_DISAGG_WIRE=loopback``
+  (or a cross-host deployment) the spool bytes travel as
+  length-prefixed sha256-checksummed frames through
+  ``parallel/multihost.KVWireServer`` — the first concrete cross-host
+  pod seam.
+
+- **Degradation**: the router's per-session history mirror is the
+  fallback at every failure point. A refused export retries at the
+  next turn boundary; a lost/corrupt/refused shipment (the ``kv_wire``
+  fault point) adopts history-only — the decode replica re-prefills,
+  pulling the shared system-prompt prefix from the prefix store
+  (prefix_store.py) when it can. Zero durably-streamed tokens are ever
+  lost, a session is never misrouted, and greedy continuations stay
+  token-identical through every path (pinned in tests/test_disagg.py).
+
+Thread model: the coordinator is driven by ``EngineFleet.supervise()``
+(the fleet serve thread, or the synchronous ``run_until_idle`` driver)
+and mutates ship state only under the fleet lock; engine interaction
+happens exclusively through the queued export/adopt seams, which carry
+their own engine-thread contracts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import TYPE_CHECKING, Optional
+
+from . import lifecycle as lifecycle_mod
+from . import trace as trace_mod
+from ..utils import knobs
+
+if TYPE_CHECKING:   # pragma: no cover - import cycle guard
+    from .fleet import EngineFleet, ReplicaHandle, _SessionRecord
+
+__all__ = [
+    "ROLES", "normalize_roles", "roles_from_env",
+    "prefill_threshold_tokens", "wire_mode", "DisaggCoordinator",
+]
+
+log = logging.getLogger(__name__)
+
+ROLES = ("prefill", "decode", "mixed")
+
+
+def normalize_roles(roles, n_replicas: int) -> list[str]:
+    """Pad/validate an explicit per-replica role list: missing
+    entries default to ``mixed``, extras are ignored, an unknown role
+    raises (a typo must be loud, not silently mixed)."""
+    out = ["mixed"] * n_replicas
+    for i, part in enumerate(list(roles)[:n_replicas]):
+        part = str(part).strip() or "mixed"
+        if part not in ROLES:
+            raise ValueError(
+                f"unknown fleet role {part!r}; known: {ROLES}"
+            )
+        out[i] = part
+    return out
+
+
+def roles_from_env(
+    n_replicas: int, env: Optional[str] = None
+) -> list[str]:
+    """Parse ``ROOM_TPU_FLEET_ROLES`` — ','/';'-separated
+    prefill|decode|mixed entries, replica i taking entry i. Missing
+    entries default to ``mixed``; extras are ignored; an unknown role
+    raises (a typo'd deployment must be loud, not silently mixed)."""
+    spec = env if env is not None else \
+        (knobs.get_str("ROOM_TPU_FLEET_ROLES") or "")
+    # positions are the contract (replica i takes entry i): empty
+    # entries stay IN PLACE and normalize to mixed — filtering them
+    # out would silently shift roles onto the wrong replicas
+    parts = [p.strip() for p in spec.replace(";", ",").split(",")]
+    return normalize_roles(parts, n_replicas)
+
+
+def prefill_threshold_tokens() -> int:
+    try:
+        return max(1, knobs.get_int("ROOM_TPU_DISAGG_PREFILL_TOKENS"))
+    except ValueError:
+        return 512
+
+
+def wire_mode() -> str:
+    mode = knobs.get_str("ROOM_TPU_DISAGG_WIRE") or "0"
+    return mode if mode in ("0", "loopback") else "0"
+
+
+class DisaggCoordinator:
+    """Role-aware placement + the prefill->decode KV shipment state
+    machine for one fleet.
+
+    Ship states live on the router's ``_SessionRecord``
+    (``ship_state``): None -> ``exporting`` (export queued on the
+    donor engine) -> ``adopting`` (entry handed to the target's
+    adoption queue) -> None. All transitions happen under the fleet
+    lock inside ``advance()`` (the supervise tick) or ``cancel()``
+    (the routing path when a new turn must land before the ship
+    finishes)."""
+
+    def __init__(self, fleet: "EngineFleet", roles: list[str]) -> None:
+        self.fleet = fleet
+        self.roles = list(roles)
+        self.enabled = any(r != "mixed" for r in roles)
+        self.threshold = prefill_threshold_tokens()
+        self.wire = wire_mode()
+        self._wire_server = None
+        self._stats = {
+            "prefill_placements": 0, "decode_placements": 0,
+            "ships": 0, "ships_warm": 0, "ships_reprefill": 0,
+            "ships_deferred": 0, "ships_refused": 0,
+            "ship_wire": 0, "wire_errors": 0,
+        }
+        # records with a ship mid-flight (sid -> record), INDEPENDENT
+        # of the router's record map: a session released mid-ship is
+        # popped from fleet._records, and the coordinator must still
+        # revisit it to discard the exported entry / release the
+        # adopted ghost — mutated under the fleet lock
+        self._inflight: dict = {}
+        if self.enabled and self.wire == "loopback":
+            self._start_wire_server()
+
+    # ---- observability ----
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self.fleet._lock:
+            self._stats[key] += n
+
+    def stats(self) -> dict:
+        with self.fleet._lock:
+            out = dict(self._stats)
+        out["enabled"] = self.enabled
+        out["wire"] = self.wire
+        out["prefill_threshold_tokens"] = self.threshold
+        if self._wire_server is not None:
+            out["wire_address"] = list(self._wire_server.address)
+        return out
+
+    # ---- placement ----
+
+    def pick(
+        self, prompt_len: int, fresh: bool
+    ) -> Optional["ReplicaHandle"]:
+        """Role-aware replacement for the fleet's health-score pick.
+        Fresh long prompts go to prefill replicas; everything else
+        prefers decode/mixed. A missing role tier falls back to ANY
+        serving replica — specialization degrades, availability does
+        not."""
+        fleet = self.fleet
+        serving = fleet._serving_replicas()
+        if not serving:
+            return None
+        best = lambda hs: max(hs, key=lambda h: h.health_score())  # noqa: E731
+        if fresh and prompt_len >= self.threshold:
+            pre = [h for h in serving if h.role == "prefill"]
+            if pre:
+                self._bump("prefill_placements")
+                return best(pre)
+        dec = [h for h in serving if h.role != "prefill"]
+        if dec:
+            self._bump("decode_placements")
+            return best(dec)
+        return best(serving)
+
+    # ---- shipment state machine ----
+
+    def pending(self) -> int:
+        """Ships mid-flight (exporting/adopting) — the synchronous
+        driver counts them as busy so ``run_until_idle`` returns only
+        once every started handoff has landed (including ships whose
+        record was released mid-flight and still owes cleanup)."""
+        with self.fleet._lock:
+            return len(self._inflight)
+
+    def advance(self) -> None:
+        """One coordinator tick (from EngineFleet.supervise): mark
+        ships due at turn boundaries, collect finished exports, hand
+        entries to decode replicas, finalize outcomes. ONE pass under
+        the fleet lock pre-filters to actionable records (mid-flight
+        ships + prefill-homed sessions with a completed turn) so the
+        steady state — thousands of decode-homed sessions — costs one
+        lock hold per tick, not one per record."""
+        if not self.enabled:
+            return
+        fleet = self.fleet
+        if fleet.lifecycle_phase == "draining":
+            return
+        with fleet._lock:
+            # mid-flight ships first — tracked independently of the
+            # record map so a release mid-ship can't orphan cleanup
+            actionable = list(self._inflight.values())
+            for rec in fleet._records.values():
+                if rec.ship_state is not None:
+                    continue   # already in _inflight
+                if rec.routing > 0:
+                    continue
+                turn = rec.last_turn
+                if turn is None or not turn.done.is_set():
+                    continue
+                donor = fleet._handle(rec.rid)
+                if donor is not None and donor.role == "prefill":
+                    actionable.append(rec)
+        for rec in actionable:
+            state = rec.ship_state
+            if state is None:
+                self._maybe_start(rec)
+            elif state == "exporting":
+                self._collect_export(rec)
+            elif state == "adopting":
+                self._finalize(rec)
+
+    def _ship_targets(
+        self, exclude: str
+    ) -> list["ReplicaHandle"]:
+        return [
+            h for h in self.fleet._serving_replicas(exclude=exclude)
+            if h.role != "prefill"
+        ]
+
+    def _maybe_start(self, rec) -> None:
+        fleet = self.fleet
+        with fleet._lock:
+            if rec.ship_state is not None:
+                return
+            if rec.routing > 0:
+                # a submit resolved its route but hasn't enqueued yet:
+                # starting a ship now would export the session out
+                # from under that turn (fork on the donor) — re-arm at
+                # the next tick
+                return
+            if fleet._records.get(rec.sid) is not rec:
+                return   # released/replaced meanwhile
+            donor = fleet._handle(rec.rid)
+            if donor is None or donor.role != "prefill" or \
+                    not donor.is_serving():
+                return
+            turn = rec.last_turn
+            if turn is None or not turn.done.is_set():
+                return   # stream still in flight (or never started)
+            if not self._ship_targets(donor.rid):
+                return   # no decode home right now; retry next tick
+            rec.ship_state = "exporting"
+            rec.ship_event = threading.Event()
+            rec.ship_t0 = time.monotonic()
+            self._inflight[rec.sid] = rec
+        # engine interaction outside the fleet lock: the export is
+        # queued to the donor's engine thread (applied inline when no
+        # loop owns it — the synchronous test driver)
+        done, holder = donor.engine.export_session(rec.sid)
+        with fleet._lock:
+            rec.ship_export = (done, holder, donor.rid)
+        self._collect_export(rec)
+
+    def _collect_export(self, rec) -> None:
+        fleet = self.fleet
+        with fleet._lock:
+            if rec.ship_state != "exporting" or rec.ship_export is None:
+                return
+            done, holder, donor_rid = rec.ship_export
+            donor = fleet._handle(donor_rid)
+        if donor is None or donor.state == "dead":
+            # the donor died mid-export: failover owns this session
+            # now — and a completed export's detached spool belongs to
+            # nobody, so drop it rather than leak it
+            if done.is_set():
+                self._discard_entry(holder.get("entry"))
+            self._abort(rec)
+            return
+        if not done.is_set():
+            return   # engine hasn't applied the export yet; next tick
+        with fleet._lock:
+            released = fleet._records.get(rec.sid) is not rec
+        if released:
+            # the session was released mid-export: nothing must adopt
+            # it anywhere — drop the exported entry (and its detached
+            # spool) instead of creating an unreleasable ghost
+            self._discard_entry(holder.get("entry"))
+            self._abort(rec)
+            return
+        entry = holder.get("entry")
+        if entry is None:
+            # refused: back off. A BUSY session re-arms when the
+            # racing turn completes (that turn replaced last_turn). An
+            # unknown/durably-empty one (e.g. its only turn was shed
+            # before any engine session formed) clears last_turn so
+            # the ship re-arms at the NEXT completed turn — never a
+            # permanent pin to the prefill replica, never a per-tick
+            # retry of the same dead turn
+            err = str(holder.get("error") or "")
+            with fleet._lock:
+                self.abort_ship_locked(rec)
+                if err != "session busy" and \
+                        rec.last_turn is not None and \
+                        rec.last_turn.done.is_set():
+                    rec.last_turn = None
+            self._bump("ships_refused")
+            return
+        self._dispatch_entry(rec, entry, donor_rid)
+
+    def _dispatch_entry(self, rec, entry: dict, donor_rid: str) -> None:
+        """The exported entry is in hand: pick the decode target and
+        hand the entry over — detached-spool adopt in-process, framed
+        spool bytes over the wire in loopback mode — falling back to a
+        history-only adopt on any wire failure (the kv_wire contract:
+        degraded warmth, never a misroute or a fork)."""
+        fleet = self.fleet
+        with fleet._lock:
+            released = fleet._records.get(rec.sid) is not rec
+        if released:
+            self._discard_entry(entry)
+            self._abort(rec)
+            return
+        targets = self._ship_targets(donor_rid)
+        if not targets:
+            # every decode sibling vanished between start and now:
+            # park the entry on the record exactly like a deferred
+            # failover re-home — the next route adopts it wherever
+            # the fleet serves by then
+            with fleet._lock:
+                rec.rid = ""
+                rec.pending_entry = entry
+                rec.pending_fingerprint = None
+                self._finish_ship(rec, outcome="deferred")
+            self._bump_outcome("deferred")
+            trace_mod.note_event("kv_ship_deferred", {
+                "session": rec.sid, "from": donor_rid,
+            })
+            return
+        target = max(targets, key=lambda h: h.health_score())
+        if self.wire == "loopback" and self._wire_server is not None:
+            reply, entry = self._ship_over_wire(rec, entry, target)
+            if reply is not None and reply.get("adopted"):
+                # the wire receiver already adopted into the target —
+                # flip the placement and finalize (unless the session
+                # was released mid-wire: then release the adopted copy
+                # so no ghost survives)
+                outcome = "warm" if reply.get("warm") else "reprefill"
+                adopted_rid = str(reply.get("rid") or target.rid)
+                with fleet._lock:
+                    released = fleet._records.get(rec.sid) is not rec
+                    if not released:
+                        rec.rid = adopted_rid
+                        rec.rehomed += 1
+                    self._finish_ship(rec, outcome)
+                if released:
+                    adopter = fleet._handle(adopted_rid)
+                    if adopter is not None:
+                        try:
+                            adopter.engine.release_session(rec.sid)
+                        except Exception:
+                            pass
+                    return
+                self._bump_outcome(outcome)
+                self._note_shipped(
+                    rec, donor_rid, target,
+                    warm=bool(reply.get("warm")), wired=True,
+                )
+                return
+            if reply is not None:
+                # the receiver ACCEPTED the frame but its queued
+                # adoption hadn't applied by the reply deadline — it
+                # may still land. Fall back history-only onto the SAME
+                # replica the receiver named, so the engine-level
+                # duplicate-sid guard dedupes the two adoptions on one
+                # engine instead of registering the session twice
+                # (the sender-side spool was consumed by the send)
+                named = self.fleet._handle(
+                    str(reply.get("rid") or "")
+                )
+                if named is not None and named.is_serving():
+                    target = named
+                entry = dict(entry)
+                entry["kv"] = None
+            # wire refused/failed: ``entry`` is history-only now —
+            # adopt locally so the session is never lost
+        ev = target.engine.adopt_parked_session(
+            entry, fingerprint=None, require_sha=False,
+        )
+        with fleet._lock:
+            rec.rid = target.rid
+            rec.rehomed += 1
+            rec.ship_state = "adopting"
+            rec.ship_export = None
+            rec.ship_adopt = (ev, entry, target.rid)
+        self._note_shipped(rec, donor_rid, target,
+                           entry.get("kv") is not None, wired=False)
+        self._finalize(rec)
+
+    def _ship_over_wire(
+        self, rec, entry: dict, target
+    ) -> tuple[Optional[dict], dict]:
+        """Frame the entry (+ spool bytes) through the loopback wire.
+        Returns (reply, entry): on any failure — kv_wire fault, socket
+        error, checksum refusal — the reply is None, the spool file is
+        dropped, and the returned entry is history-only: re-prefill
+        from the mirror, the documented degradation. The local spool
+        file is consumed either way (the receiver persisted its own
+        verified copy on success)."""
+        from ..parallel.multihost import kv_wire_send
+
+        donor_fp = None
+        try:
+            donor_fp = self.fleet._handle(
+                rec.rid
+            ).engine._lifecycle_fingerprint()
+        except Exception:
+            pass
+        kv = entry.get("kv") if isinstance(entry.get("kv"), dict) \
+            else None
+        src = str(kv["file"]) if kv and kv.get("file") else None
+        self._bump("ship_wire")
+        try:
+            reply = kv_wire_send(
+                self._wire_server.address, entry,
+                fingerprint=donor_fp, target_rid=target.rid,
+            )
+        except Exception as e:   # KVWireError / FaultError / OSError
+            self._bump("wire_errors")
+            log.warning(
+                "fleet %s: kv wire ship of %s failed (%s); adopting "
+                "history-only", self.fleet.model_name, rec.sid, e,
+            )
+            if src:
+                try:
+                    os.unlink(src)
+                except OSError:
+                    pass
+            fallback = dict(entry)
+            fallback["kv"] = None
+            return None, fallback
+        if src:
+            try:
+                os.unlink(src)   # receiver holds its own copy now
+            except OSError:
+                pass
+        return reply, entry
+
+    def _finalize(self, rec) -> None:
+        fleet = self.fleet
+        with fleet._lock:
+            if rec.ship_state != "adopting" or rec.ship_adopt is None:
+                return
+            ev, entry, target_rid = rec.ship_adopt
+            target = fleet._handle(target_rid)
+        if target is None or target.state == "dead":
+            self._abort(rec)
+            return
+        if not ev.is_set():
+            return   # adoption applies at the target's next step
+        with fleet._lock:
+            released = fleet._records.get(rec.sid) is not rec
+        if released:
+            # released after the dispatch re-check: the target just
+            # adopted a session nobody owns — release it there so no
+            # ghost holds pages/spool
+            try:
+                target.engine.release_session(rec.sid)
+            except Exception:
+                pass
+            self._abort(rec)
+            return
+        warm = False
+        if entry.get("kv") is not None:
+            store = getattr(target.engine, "offload_store", None)
+            warm = store is not None and store.has(rec.sid)
+        outcome = "warm" if warm else "reprefill"
+        with fleet._lock:
+            self._finish_ship(rec, outcome)
+        self._bump_outcome(outcome)
+
+    def _finish_ship(self, rec, outcome: str) -> None:
+        """Terminal state cleanup; caller holds the fleet lock. The
+        outcome counters go through _bump AFTER the caller releases
+        it (``_bump_outcome``) — the fleet lock is not reentrant."""
+        rec.ship_state = None
+        rec.ship_export = None
+        rec.ship_adopt = None
+        rec.last_turn = None
+        if self._inflight.get(rec.sid) is rec:
+            del self._inflight[rec.sid]
+        if rec.ship_event is not None:
+            rec.ship_event.set()
+            rec.ship_event = None
+
+    def _bump_outcome(self, outcome: str) -> None:
+        self._bump("ships")
+        if outcome == "warm":
+            self._bump("ships_warm")
+        elif outcome == "reprefill":
+            self._bump("ships_reprefill")
+        elif outcome == "deferred":
+            self._bump("ships_deferred")
+
+    @staticmethod
+    def _discard_entry(entry: Optional[dict]) -> None:
+        """Unlink a no-longer-wanted exported entry's detached spool
+        file (the adopter would have taken ownership; nobody will)."""
+        if not isinstance(entry, dict):
+            return
+        kv = entry.get("kv")
+        if isinstance(kv, dict) and kv.get("file"):
+            try:
+                os.unlink(str(kv["file"]))
+            except OSError:
+                pass
+
+    def _abort(self, rec) -> None:
+        with self.fleet._lock:
+            entry = self.abort_ship_locked(rec)
+        self._discard_entry(entry)
+
+    def abort_ship_locked(self, rec) -> Optional[dict]:
+        """Terminal ship cleanup for callers ALREADY HOLDING the fleet
+        lock (the failover re-home path). Returns the completed
+        export's entry, if any — the caller must ``_discard_entry`` it
+        OUTSIDE the lock (its detached spool belongs to nobody once
+        the ship dies)."""
+        entry = None
+        if rec.ship_export is not None:
+            done, holder, _ = rec.ship_export
+            if done.is_set():
+                entry = holder.get("entry")
+        if entry is None and rec.ship_adopt is not None:
+            # an adoption the target never APPLIED (its thread died
+            # before draining the queue) strands the entry's detached
+            # spool; an applied one (ev set) moved ownership to the
+            # target's store — salvage re-homes it from there
+            ev, adopt_entry, _ = rec.ship_adopt
+            if not ev.is_set():
+                entry = adopt_entry
+        rec.ship_state = None
+        rec.ship_export = None
+        rec.ship_adopt = None
+        if self._inflight.get(rec.sid) is rec:
+            del self._inflight[rec.sid]
+        if rec.ship_event is not None:
+            rec.ship_event.set()
+            rec.ship_event = None
+        return entry
+
+    def _note_shipped(
+        self, rec, donor_rid: str, target, warm: bool, wired: bool,
+    ) -> None:
+        ms = None
+        if rec.ship_t0 is not None:
+            ms = round((time.monotonic() - rec.ship_t0) * 1000.0, 3)
+        # turnscope (docs/observability.md): ships happen BETWEEN
+        # turns, so they land in the flight recorder's global event
+        # ring — the trace answer to "why did this session move"
+        trace_mod.note_event("kv_ship", {
+            "session": rec.sid, "from": donor_rid, "to": target.rid,
+            "warm": warm, "wire": wired, "ms": ms,
+        })
+
+    # ---- wire server (the cross-host receive seam) ----
+
+    def _start_wire_server(self) -> None:
+        from ..parallel.multihost import KVWireServer
+
+        spool_dir = os.path.join(
+            lifecycle_mod.engine_dir(self.fleet.model_name), "wire-in"
+        )
+        try:
+            self._wire_server = KVWireServer(
+                spool_dir, self._on_wire_entry
+            )
+        except OSError:
+            log.exception(
+                "fleet %s: kv wire server failed to start; ships "
+                "fall back to in-process handoff",
+                self.fleet.model_name,
+            )
+            self._wire_server = None
+
+    def _on_wire_entry(
+        self, entry: dict, fingerprint: Optional[dict],
+        target_rid: Optional[str],
+    ) -> dict:
+        """Receiver side: adopt a wire-shipped entry into the named
+        decode replica (or the healthiest one). Runs on the wire
+        server's accept thread; adoption rides the engine's queued
+        seam. The wire re-checksummed the payload in transit; the
+        fingerprint check (against the receiving engine's config) and
+        the spool sha verify-at-first-read run in adopt."""
+        # adopt ONLY into the replica the sender named: re-targeting
+        # here would let a lost reply leave the session adopted on a
+        # replica the sender doesn't know about (a two-engine ghost).
+        # A refusal keeps placement authority with the sender, whose
+        # history-only fallback never diverges.
+        handle = self.fleet._handle(target_rid) if target_rid else None
+        if handle is None or not handle.is_serving():
+            return {"ok": False,
+                    "error": f"target {target_rid!r} not serving"}
+        from ..parallel.multihost import wire_timeout_s
+
+        ev = handle.engine.adopt_parked_session(
+            entry, fingerprint=fingerprint, require_sha=True,
+        )
+        # the reply must beat the SENDER's socket timeout or the wait
+        # is wasted (the sender would count a wire error and enqueue a
+        # redundant history-only adoption a slow-but-alive target then
+        # dedupes) — leave it margin to read the reply
+        ev.wait(timeout=max(0.5, wire_timeout_s() * 0.8))
+        store = getattr(handle.engine, "offload_store", None)
+        warm = entry.get("kv") is not None and store is not None \
+            and store.has(str(entry.get("id")))
+        return {"adopted": ev.is_set(), "warm": warm,
+                "rid": handle.rid}
+
+    def close(self) -> None:
+        if self._wire_server is not None:
+            self._wire_server.close()
+            self._wire_server = None

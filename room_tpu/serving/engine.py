@@ -47,6 +47,7 @@ from . import faults
 from . import trace as trace_mod
 from .faults import FaultError
 from .kv_offload import TieredKVStore, offload_enabled_from_env
+from .prefix_store import SharedPrefixStore, prefix_store_enabled_from_env
 from .kv_pages import (
     PageTable, init_page_cache, kv_quant_mode, make_paged_kv_hook,
     make_ragged_kv_hook, pallas_decode_int8_ok, pallas_prefill_ok,
@@ -245,6 +246,7 @@ class ServingEngine:
         mesh: Optional[Any] = None,
         spec_tokens: Optional[int] = None,
         offload: Optional[bool] = None,
+        prefix_store: Optional[bool] = None,
     ) -> None:
         # persistent XLA compile cache (ROOM_TPU_JAX_CACHE): an engine
         # jits dozens of shapes, and each process's in-memory jit cache
@@ -612,6 +614,14 @@ class ServingEngine:
         # fresh session missing its history).
         self._adoption_requests: "queue.SimpleQueue[tuple]" = \
             queue.SimpleQueue()
+        # disaggregated prefill->decode handoff seam (serving/disagg.py,
+        # docs/disagg.md): the router asks THIS engine to export a
+        # quiescent session — park + offload + detach its spool — for a
+        # decode replica to adopt. Cross-thread like adoptions: queued
+        # and applied at the top of each step, refused (not blocked) if
+        # the session picked up a live turn in the meantime.
+        self._ship_requests: "queue.SimpleQueue[tuple]" = \
+            queue.SimpleQueue()
         # best-effort session state preserved past a FATAL engine
         # crash (restart budget exhausted) for a fleet supervisor to
         # re-home; None on a healthy engine. Only collected when a
@@ -631,6 +641,36 @@ class ServingEngine:
             "ROOM_TPU_PREFIX_CACHE_PAGES"
         )
         self._prefix_cache: dict[tuple, _PrefixEntry] = {}
+        # fleet-global shared prefix store (prefix_store.py,
+        # docs/disagg.md): a content-addressed spool tier underneath
+        # the in-process prefix cache, shared across replicas /
+        # processes / hosts. A local-cache miss pulls the prefix KV and
+        # scatters it into fresh pages (copy-on-adopt); a locally
+        # computed prefix is published when it becomes ready. Library
+        # default off (ROOM_TPU_PREFIX_STORE / the ``prefix_store``
+        # ctor arg opt in; providers/tpu.ModelHost defaults on).
+        # Requires the in-process prefix cache — the store's entries
+        # materialize AS local prefix entries.
+        store_on = prefix_store if prefix_store is not None \
+            else prefix_store_enabled_from_env()
+        self.prefix_store: Optional[SharedPrefixStore] = None
+        self.prefix_store_publish = knobs.get_bool(
+            "ROOM_TPU_PREFIX_STORE_PUBLISH"
+        )
+        if store_on and self.prefix_cache_min_pages > 0:
+            import logging
+
+            try:
+                self.prefix_store = SharedPrefixStore(
+                    self._lifecycle_fingerprint(),
+                    page_size=page_size,
+                )
+            except Exception:
+                # the store is an accelerator, never a dependency: a
+                # bad dir/cap config degrades to process-local caching
+                logging.getLogger(__name__).exception(
+                    "shared prefix store unavailable for %s", cfg.name,
+                )
         self._lock = threading.Lock()
         self._jit_cache: dict[Any, Callable] = {}
         self._stats = {
@@ -662,6 +702,16 @@ class ServingEngine:
             # writes with the decode scan, and chunks that rode fused
             "chunk_dispatches": 0, "fused_windows": 0,
             "fused_chunks": 0,
+            # shared prefix store (docs/disagg.md): local-cache misses
+            # served by a pull from the fleet-global tier, tokens those
+            # pulls saved re-prefilling, pulls that degraded to an
+            # ordinary miss, and prefixes this engine published
+            "prefix_store_hits": 0, "prefix_store_tokens_reused": 0,
+            "prefix_store_pull_fallbacks": 0,
+            "prefix_store_publishes": 0,
+            # disaggregated serving (docs/disagg.md): sessions this
+            # engine exported for a prefill->decode handoff
+            "sessions_shipped": 0,
         }
         from collections import Counter
 
@@ -1046,24 +1096,39 @@ class ServingEngine:
                 continue
             if not sess.history and sess.pending is None:
                 continue
-            entry: dict = {
-                "id": sid,
-                "history": [int(t) for t in sess.history],
-                "pending": int(sess.pending)
-                if sess.pending is not None else None,
-                "length": len(sess.history),
-                "generation": int(sess.generation),
-                "kv": None,
-            }
+            entry = self._session_entry(sess)
             if self.offload_store is not None and \
-                    sess.prefix_len == 0 and \
-                    len(sess.history) == sess.length:
+                    self._kv_export_eligible(sess):
                 try:
                     entry["kv"] = self.offload_store.export_entry(sid)
                 except Exception:
                     entry["kv"] = None
             out[sid] = entry
         return out
+
+    def _session_entry(self, sess: _Session) -> dict:
+        """Manifest-style record of one session's host state (the
+        crash-salvage / ship-export shape; ``kv`` filled by callers
+        that manage a spool export)."""
+        return {
+            "id": sess.id,
+            "history": [int(t) for t in sess.history],
+            "pending": int(sess.pending)
+            if sess.pending is not None else None,
+            "length": len(sess.history),
+            "generation": int(sess.generation),
+            "kv": None,
+        }
+
+    @staticmethod
+    def _kv_export_eligible(sess: _Session) -> bool:
+        """A session's KV may travel byte-exact only when it is wholly
+        its own (shared prefix pages are cache-owned — they travel via
+        the prefix STORE, docs/disagg.md) and the history mirror
+        covers it exactly. Shared by crash salvage and the disagg ship
+        export."""
+        return sess.prefix_len == 0 and \
+            len(sess.history) == sess.length
 
     def _prefill_fn(self, bucket: int, fresh: bool,
                     active_pages: Optional[int] = None):
@@ -1374,7 +1439,16 @@ class ServingEngine:
         pages = self.page_table.pages_of(sess.id)
         own_tokens = sess.length - sess.prefix_len
         n_used = -(-own_tokens // self.page_size)
-        used = pages[:n_used]
+        return self._gather_page_ids_host(pages[:n_used]), n_used
+
+    def _gather_page_ids_host(
+        self, used: list
+    ) -> dict[str, np.ndarray]:
+        """Copy an explicit page-id list out to host arrays keyed like
+        the cache (the session offload gather, and the prefix-store
+        publish gather — prefix pages belong to a cache-owned
+        pseudo-session, not a real one)."""
+        n_used = len(used)
         n_pad = self._pow2(max(n_used, 1))
         ids = np.zeros((n_pad,), np.int32)
         ids[:n_used] = used
@@ -1391,11 +1465,10 @@ class ServingEngine:
         # ascontiguousarray: a plain slice would be a VIEW pinning the
         # whole pow2-padded transfer buffer (~2x the real bytes),
         # silently defeating the host-tier cap
-        host = {
+        return {
             k: np.ascontiguousarray(np.asarray(a)[:, :n_used])
             for k, a in out.items()
         }
-        return host, n_used
 
     # ---- public API ----
 
@@ -1615,6 +1688,8 @@ class ServingEngine:
         out["scheduler"] = sched
         out["offload"] = self.offload_store.stats() \
             if self.offload_store is not None else None
+        out["prefix_store"] = self.prefix_store.stats() \
+            if self.prefix_store is not None else None
         with self._lock:
             lc = dict(self._lifecycle_stats)
         lc["phase"] = self.lifecycle_phase
@@ -1638,6 +1713,7 @@ class ServingEngine:
         self.scheduler.begin_step()
         self._drain_releases()
         self._drain_adoptions()
+        self._drain_ships()
         self._enforce_deadlines()
         self._shed_if_overloaded()
         # sweep before prefetch: demotions free the pages restores need
@@ -1678,9 +1754,11 @@ class ServingEngine:
                 self._inflight = None
             with self._lock:
                 self._loop_thread = None
-            # releases / adoptions enqueued while stopping still apply
+            # releases / adoptions / ships enqueued while stopping
+            # still apply
             self._drain_releases()
             self._drain_adoptions()
+            self._drain_ships()
 
     # ---- internals ----
 
@@ -2050,6 +2128,118 @@ class ServingEngine:
         sess.prefix_pages = []
         sess.prefix_len = 0
 
+    # ---- shared prefix store (prefix_store.py, docs/disagg.md) ----
+
+    def _prefix_store_pull(
+        self, turn: Turn, prompt: list[int]
+    ) -> Optional["_PrefixEntry"]:
+        """Local prefix-cache miss: pull the longest stored prefix of
+        ``prompt`` from the fleet-global store and COPY-ON-ADOPT it —
+        scatter the spooled KV bytes into freshly allocated cache-owned
+        pages, materializing a ready local ``_PrefixEntry`` every later
+        session shares for free. Degrades to None (the ordinary miss)
+        on store miss, prefix_io fault, checksum failure, pool
+        pressure, or a scatter error — correctness never depends on
+        the store. Engine-thread only (admission path)."""
+        store = self.prefix_store
+        if store is None:
+            return None
+        page = self.page_size
+        max_len = min(
+            ((len(prompt) - 1) // page) * page,
+            (self.max_pages_per_seq - 1) * page,
+        )
+        if max_len < self.prefix_cache_min_pages * page:
+            return None
+        t0 = time.monotonic()
+        got = store.fetch_longest(prompt, max_len)
+        if got is None:
+            return None
+        length, meta, arrays = got
+        if length < self.prefix_cache_min_pages * page:
+            return None
+        key = tuple(prompt[:length])
+        cached = self._prefix_cache.get(key)
+        if cached is not None:
+            # raced our own earlier pull (or a register that became
+            # ready between lookup and pull): use the local entry
+            return cached if cached.ready else None
+        n_used = length // page
+        try:
+            meta_pages = int(meta.get("n_pages"))
+        except (TypeError, ValueError):
+            meta_pages = -1
+        if meta_pages != n_used:
+            self._bump("prefix_store_pull_fallbacks")
+            return None
+        owner = f"__prefix__{len(self._prefix_cache)}_" \
+            f"{time.monotonic_ns()}"
+        try:
+            pages = self.page_table.ensure_capacity(owner, length)
+        except MemoryError:
+            self._bump("prefix_store_pull_fallbacks")
+            return None
+        n_pad = self._pow2(max(n_used, 1))
+        ids = np.zeros((n_pad,), np.int32)
+        ids[:n_used] = pages[:n_used]
+        try:
+            padded = {}
+            for k, a in arrays.items():
+                buf = np.zeros(
+                    (a.shape[0], n_pad) + a.shape[2:], a.dtype
+                )
+                buf[:, :n_used] = a
+                padded[k] = buf
+            self.cache = self._offload_scatter_fn(n_pad)(
+                self.cache, jnp.asarray(ids), padded
+            )
+        except Exception:
+            # shape/dtype surprises or a device-side scatter failure:
+            # release the just-allocated pages and take the miss
+            self.page_table.release(owner)
+            self._bump("prefix_store_pull_fallbacks")
+            return None
+        entry = _PrefixEntry(
+            key=key, owner_id=owner, pages=list(pages),
+            length=length, ready=True,
+        )
+        self._prefix_cache[key] = entry
+        self._prefix_lengths[length] += 1
+        self._bump("prefix_store_hits")
+        self._bump("prefix_store_tokens_reused", length)
+        pull_ms = round((time.monotonic() - t0) * 1000.0, 3)
+        # turnscope (docs/observability.md): the pull blocks THIS
+        # turn's prefill span — event it on the turn, and into the
+        # global ring for cross-turn store visibility
+        if turn.trace is not None:
+            turn.trace.ev("prefix_pull", tokens=length, ms=pull_ms)
+        trace_mod.note_event("prefix_pull", {
+            "session": turn.session_id, "tokens": length,
+            "ms": pull_ms,
+        })
+        return entry
+
+    def _prefix_store_maybe_publish(self, entry: "_PrefixEntry") -> None:
+        """A locally computed prefix just became ready: publish its KV
+        pages to the shared store so sibling replicas (and the next
+        process/host) pull instead of re-prefilling. Best-effort and
+        bounded — one gather of the prefix's own pages; failures count
+        and skip. Engine-thread only."""
+        store = self.prefix_store
+        if store is None or not self.prefix_store_publish:
+            return
+        if store.has(entry.key):
+            return
+        try:
+            arrays = self._gather_page_ids_host(entry.pages)
+        except Exception:
+            return
+        if store.publish(entry.key, arrays, len(entry.pages)):
+            self._bump("prefix_store_publishes")
+            trace_mod.note_event("prefix_publish", {
+                "tokens": entry.length,
+            })
+
     def _admit(self) -> None:
         """Admission with batched prefill: queued turns that share a
         (bucket, fresh) shape prefill together in one device call —
@@ -2332,6 +2522,12 @@ class ServingEngine:
         register_entry: Optional[_PrefixEntry] = None
         if sess.length == 0 and self.prefix_cache_min_pages > 0:
             hit = self._prefix_lookup(prompt)
+            if hit is None:
+                # fleet-global shared prefix store (docs/disagg.md): a
+                # sibling replica / process / host may already hold
+                # this prompt's prefix KV — pull + scatter it into
+                # local pages instead of re-prefilling it
+                hit = self._prefix_store_pull(turn, prompt)
             if hit is not None:
                 hit.sessions.add(sess.id)
                 hit.last_used = time.monotonic()
@@ -2791,7 +2987,13 @@ class ServingEngine:
             if sess.prefix_key is not None:
                 entry = self._prefix_cache.get(sess.prefix_key)
                 if entry is not None:
+                    fresh_ready = not entry.ready
                     entry.ready = True
+                    if fresh_ready and self.prefix_store is not None:
+                        # publish the freshly computed prefix to the
+                        # fleet-global store (one bounded page gather;
+                        # failures count and skip)
+                        self._prefix_store_maybe_publish(entry)
             self._slot_tables[slot] = prep["table"]
             self._slot_lengths[slot] = sess.length
             self._slot_gen[slot] += 1
@@ -4230,6 +4432,100 @@ class ServingEngine:
         elif status == "reprefill":
             self._lc_bump("sessions_reprefill")
         return status
+
+    def export_session(
+        self, session_id: str
+    ) -> tuple[threading.Event, dict]:
+        """Detach a quiescent session for a prefill->decode handoff
+        (serving/disagg.py, docs/disagg.md): park + offload its KV,
+        detach the spool file (TieredKVStore.export_entry) and remove
+        the session from this engine, handing back a manifest-style
+        entry the adopting replica consumes. The inverse of
+        ``adopt_parked_session`` and the same thread contract: queued
+        to the engine thread when a loop owns it, applied inline
+        otherwise. Returns ``(done, holder)``; once ``done`` is set,
+        ``holder['entry']`` is the exported entry (``kv`` None when
+        only the history could travel) or None with
+        ``holder['error']`` — a session that picked up a live turn is
+        REFUSED, never blocked on."""
+        holder: dict = {"entry": None, "error": None}
+        done = threading.Event()
+        with self._lock:
+            loop = self._loop_thread
+        if loop is not None and loop.is_alive() and \
+                loop is not threading.current_thread():
+            self._ship_requests.put((session_id, holder, done))
+            # the loop may have exited between the check and the put;
+            # if nobody owns the engine anymore, apply the queue now
+            with self._lock:
+                loop = self._loop_thread
+            if loop is None or not loop.is_alive():
+                self._drain_ships()
+            return done, holder
+        self._apply_ship(session_id, holder)
+        done.set()
+        return done, holder
+
+    def _drain_ships(self) -> None:
+        while True:
+            try:
+                sid, holder, done = self._ship_requests.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._apply_ship(sid, holder)
+            finally:
+                done.set()
+
+    def _apply_ship(self, session_id: str, holder: dict) -> None:
+        if self.lifecycle_phase == "draining":
+            # a queued export applied during the shutdown drain would
+            # pop the session AFTER nobody remains to adopt it — the
+            # manifest must cover it instead (refusal keeps it here)
+            holder["error"] = "draining"
+            return
+        with self._lock:
+            busy = self._session_in_flight(session_id)
+        if busy:
+            # a turn raced the ship (possibly queued ahead of the
+            # session's very first admission): refuse — the router
+            # keeps the placement here and retries at the next turn
+            # boundary
+            holder["error"] = "session busy"
+            return
+        sess = self.sessions.get(session_id)
+        if sess is None:
+            holder["error"] = "unknown session"
+            return
+        if not sess.history and sess.pending is None:
+            holder["error"] = "nothing durable to ship"
+            return
+        entry = self._session_entry(sess)
+        # warm shipment under the same eligibility rule as crash
+        # salvage; unlike salvage, a HEALTHY engine may actively
+        # offload resident pages first (the device state is trusted)
+        if self.offload_store is not None and \
+                self._kv_export_eligible(sess):
+            try:
+                if self.page_table.pages_of(sess.id):
+                    self._offload_session(sess)
+                if self.offload_store.has(sess.id):
+                    entry["kv"] = \
+                        self.offload_store.export_entry(sess.id)
+            except Exception:
+                entry["kv"] = None   # degrade to history-only
+        # the session now belongs to the adopter: remove it here so a
+        # stale affinity submit can't fork it (the router re-points
+        # before any such submit can land)
+        self.sessions.pop(sess.id, None)
+        self._release_session_prefix(sess)
+        self.page_table.release(sess.id)
+        if self.offload_store is not None:
+            self.offload_store.discard(sess.id)
+        with self._lock:
+            self._deferred_release.discard(sess.id)
+        self._bump("sessions_shipped")
+        holder["entry"] = entry
 
     def restore_from_manifest(
         self, lifecycle_dir: Optional[str] = None

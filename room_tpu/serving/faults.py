@@ -39,6 +39,15 @@ named *fault point* that tests (and staging deployments) can arm:
                        (docs/fleet.md): bounded retry; exhaustion sheds
                        the turn with the 503 contract — a session is
                        NEVER misrouted to a replica without its KV
+    kv_wire            a prefill->decode KV shipment fails in transit
+                       (docs/disagg.md): the decode replica adopts the
+                       session history-only and re-prefills from the
+                       router mirror — degraded warmth, zero
+                       durably-streamed tokens lost, never a misroute
+    prefix_io          shared prefix-store publish/pull I/O fails
+                       (docs/disagg.md): a failed pull degrades to the
+                       ordinary prefill miss, a failed publish skips —
+                       correctness never depends on the store
 
 Swarm-layer points (docs/swarm_recovery.md) thread the same registry
 up through the agent runtime above the engine:
@@ -86,6 +95,9 @@ FAULT_POINTS = (
     "provider_timeout", "offload_io", "shutdown_io",
     # engine replica fleet (docs/fleet.md)
     "replica_crash", "router_io",
+    # disaggregated prefill/decode + shared prefix store
+    # (docs/disagg.md)
+    "kv_wire", "prefix_io",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
 )

@@ -63,6 +63,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from . import disagg as disagg_mod
 from . import faults
 from . import lifecycle as lifecycle_mod
 from . import trace as trace_mod
@@ -70,6 +71,7 @@ from ..utils import knobs
 from .engine import Turn
 from .faults import FaultError
 from .sampler import SamplingParams
+from .scheduler import classify_turn
 
 __all__ = ["EngineFleet", "ReplicaHandle", "fleet_replicas_from_env"]
 
@@ -110,15 +112,42 @@ class _SessionRecord:
     # session has at most one active turn, so this lock only ever
     # serializes the appender against a failover's mirror read)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    # mirror cap (ROOM_TPU_FLEET_MIRROR_TOKENS): set when this
+    # record's token mirror was LRU-evicted — the partial tokens that
+    # accumulate afterwards must never be mistaken for a full history
+    # (failover for a dropped-mirror session is warm-salvage only)
+    mirror_dropped: bool = False
+    # disaggregated prefill->decode handoff (serving/disagg.py):
+    # ship state machine fields, mutated under the fleet lock
+    ship_state: Optional[str] = None      # exporting | adopting
+    ship_event: Optional[threading.Event] = None
+    ship_export: Optional[tuple] = None   # (done, holder, donor_rid)
+    ship_adopt: Optional[tuple] = None    # (ev, entry, target_rid)
+    ship_t0: Optional[float] = None
+    # count of submits between routing and the engine-queue put: the
+    # coordinator must not START a ship in that window (the exported
+    # session would vanish from under the about-to-enqueue turn,
+    # which would then prefill a forked fresh session on the donor)
+    routing: int = 0
+    # the session's most recent turn (the ship fires at its
+    # completion); cleared when the ship lands
+    last_turn: Optional[Any] = None
 
 
 class ReplicaHandle:
     """One engine replica under fleet supervision."""
 
-    def __init__(self, rid: str, index: int, engine: Any) -> None:
+    def __init__(
+        self, rid: str, index: int, engine: Any,
+        role: str = "mixed",
+    ) -> None:
         self.rid = rid
         self.index = index
         self.engine = engine
+        # disaggregated serving role (docs/disagg.md): prefill
+        # replicas absorb fresh long-prompt sessions and ship finished
+        # KV to decode replicas; mixed is the classic fleet behavior
+        self.role = role
         self.thread: Optional[threading.Thread] = None
         self.stop = threading.Event()
         # serving -> draining -> drained (blue/green) | dead (crash)
@@ -247,6 +276,7 @@ class EngineFleet:
         n_replicas: Optional[int] = None,
         *,
         auto_rebuild: Optional[bool] = None,
+        roles: Optional[list[str]] = None,
     ) -> None:
         self.model_name = model_name
         self._build_engine = build_engine
@@ -266,9 +296,32 @@ class EngineFleet:
             "sessions_rehomed_reprefill": 0,
             "replica_rebuilds": 0, "bluegreen_drains": 0,
             "router_retries": 0, "router_shed": 0,
+            "mirror_evictions": 0, "mirror_tokens_evicted": 0,
         }
+        # bounded router history mirror (docs/fleet.md): the per-token
+        # mirror grows for the life of a room, and disaggregation's
+        # re-prefill fallback leans on it harder — past the fleet-wide
+        # cap the least-recently-used records drop their mirrors
+        # (warm-only failover for those sessions), counted in
+        # mirror_evictions. 0 = unbounded.
+        try:
+            self.mirror_cap_tokens = knobs.get_int(
+                "ROOM_TPU_FLEET_MIRROR_TOKENS"
+            )
+        except ValueError:
+            self.mirror_cap_tokens = 0
+        self._mirror_tokens = 0
+        self._mirror_lock = threading.Lock()
+        self._mirror_sweep_at = 0.0
+        self._mirror_sweep_futile = False
+        role_list = (
+            disagg_mod.normalize_roles(roles, self.n_replicas)
+            if roles is not None
+            else disagg_mod.roles_from_env(self.n_replicas)
+        )
         self.replicas: list[ReplicaHandle] = [
-            ReplicaHandle(f"r{i}", i, build_engine(i))
+            ReplicaHandle(f"r{i}", i, build_engine(i),
+                          role=role_list[i])
             for i in range(self.n_replicas)
         ]
         for h in self.replicas:
@@ -276,6 +329,10 @@ class EngineFleet:
             # files for a hand-off when a supervisor exists to consume
             # it (engine._recover_from_crash)
             h.engine.fleet_supervised = True
+        # disaggregated prefill/decode (serving/disagg.py,
+        # docs/disagg.md): role-aware placement + the prefill->decode
+        # KV shipment state machine; inert when every role is mixed
+        self.disagg = disagg_mod.DisaggCoordinator(self, role_list)
         self.lifecycle_phase = "serving"
 
     # ---- small helpers ----
@@ -331,14 +388,18 @@ class EngineFleet:
 
     def _shed_turn(
         self, sid: str, prompt_tokens, sampling, turn_class, msg: str,
+        priority: Optional[int] = None,
     ) -> Turn:
         """Fail a turn at the router with the engine's exact shed
-        contract (503 + Retry-After at the routes layer)."""
+        contract (503 + Retry-After at the routes layer). The class
+        comes from the scheduler's classifier — an untagged turn that
+        carries a background priority is shed (and accounted) as
+        background, never silently promoted to worker."""
         turn = Turn(
             session_id=sid,
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
-            turn_class=turn_class or "worker",
+            turn_class=classify_turn(turn_class, priority),
         )
         turn.shed = True
         turn.error = msg
@@ -352,21 +413,34 @@ class EngineFleet:
         return turn
 
     def _route(
-        self, sid: str, wait_s: float = 60.0
+        self, sid: str, wait_s: float = 60.0, prompt_len: int = 0,
     ) -> Optional[ReplicaHandle]:
         """Resolve a session to its replica. Affinity first: a placed
         session ALWAYS goes where its KV/history lives. A placement on
         a draining replica waits for the blue/green absorb to move it
-        (bounded), then follows the new placement; a placement on a
-        dead replica triggers failover re-homing inline (the
-        supervisor normally got there first)."""
+        (bounded), then follows the new placement; a mid-flight
+        prefill->decode ship likewise blocks (bounded) until the
+        handoff lands, then follows it; a placement on a dead replica
+        triggers failover re-homing inline (the supervisor normally
+        got there first)."""
         deadline = time.monotonic() + wait_s
         while True:
             with self._lock:
                 rec = self._records.get(sid)
                 rid = rec.rid if rec else None
+                ship_ev = rec.ship_event if rec else None
+            if rec is not None and ship_ev is not None:
+                # disagg ship mid-flight (docs/disagg.md): the session
+                # is between replicas — routing to either side now
+                # could fork it. Wait for the handoff (the coordinator
+                # bounds every stage), then follow the new placement.
+                if not ship_ev.wait(
+                    timeout=max(0.0, deadline - time.monotonic())
+                ):
+                    return None
+                continue
             if rid is None:
-                return self._pick_replica()
+                return self._pick_replica(prompt_len, fresh=True)
             if rid == "":
                 # deferred re-home: a failover found no serving
                 # sibling and parked the session's entry on the
@@ -426,7 +500,14 @@ class EngineFleet:
             if time.monotonic() > deadline:
                 return None
 
-    def _pick_replica(self) -> Optional[ReplicaHandle]:
+    def _pick_replica(
+        self, prompt_len: int = 0, fresh: bool = False,
+    ) -> Optional[ReplicaHandle]:
+        if self.disagg.enabled:
+            # role-aware placement (docs/disagg.md): fresh long
+            # prompts to prefill replicas, everything else prefers
+            # decode/mixed
+            return self.disagg.pick(prompt_len, fresh)
         cands = self._serving_replicas()
         if not cands:
             return None
@@ -449,10 +530,15 @@ class EngineFleet:
         Turn contract as ``ServingEngine.submit``; the priority class
         rides through to the replica's own EDF scheduler untouched."""
         sid = session_id or f"s{id(object())}-{time.monotonic_ns()}"
+        # the scheduler's classifier, not a silent `or "worker"`: an
+        # untagged turn carrying an explicit background priority stays
+        # background through routing, shedding, and the replica's EDF
+        turn_class = classify_turn(turn_class, priority)
         if self.lifecycle_phase == "draining":
             return self._shed_turn(
                 sid, prompt_tokens, sampling, turn_class,
                 "draining: engine is restarting; retry shortly",
+                priority,
             )
         # router_io fault point: the placement lookup fails — bounded
         # retry, then shed cleanly. NEVER fall through to an arbitrary
@@ -473,28 +559,65 @@ class EngineFleet:
         if err is not None:
             return self._shed_turn(
                 sid, prompt_tokens, sampling, turn_class,
-                f"fleet router unavailable: {err}",
+                f"fleet router unavailable: {err}", priority,
             )
-        handle = self._route(sid)
-        if handle is None:
-            return self._shed_turn(
-                sid, prompt_tokens, sampling, turn_class,
-                "no healthy replica available; retry shortly",
-            )
+        while True:
+            handle = self._route(sid, prompt_len=len(prompt_tokens))
+            if handle is None:
+                return self._shed_turn(
+                    sid, prompt_tokens, sampling, turn_class,
+                    "no healthy replica available; retry shortly",
+                    priority,
+                )
+            with self._lock:
+                rec = self._records.get(sid)
+                if rec is not None and rec.ship_state is not None:
+                    # a ship started in the routing window: loop back
+                    # to _route, which waits the handoff out — a turn
+                    # enqueued on the donor NOW would land after the
+                    # export and fork a fresh session there
+                    continue
+                if rec is not None and rec.rid and \
+                        rec.rid != handle.rid:
+                    # the placement MOVED in the routing window (a
+                    # ship that started AND landed, or a re-home):
+                    # submitting to the stale handle would fork —
+                    # re-resolve against the new placement
+                    continue
+                # bar the coordinator from STARTING a ship until this
+                # turn is on the engine queue (where export_session's
+                # in-flight check takes over)
+                if rec is not None:
+                    rec.routing += 1
+                routing_rec = rec
+            break
         rec = self._record_for(sid, handle)
         wrapped = self._mirror_on_token(
             rec, list(prompt_tokens), on_token
         )
-        turn = handle.engine.submit(
-            prompt_tokens,
-            session_id=sid,
-            sampling=sampling,
-            on_token=wrapped,
-            stop_strings=stop_strings,
-            deadline_s=deadline_s,
-            priority=priority,
-            turn_class=turn_class,
-        )
+        try:
+            turn = handle.engine.submit(
+                prompt_tokens,
+                session_id=sid,
+                sampling=sampling,
+                on_token=wrapped,
+                stop_strings=stop_strings,
+                deadline_s=deadline_s,
+                priority=priority,
+                turn_class=turn_class,
+            )
+            # the disagg coordinator ships a prefill-homed session at
+            # this turn's completion (docs/disagg.md) — tracked ONLY
+            # where a ship can actually fire, so mixed fleets and
+            # decode-homed sessions never pin a Turn (with its prompt
+            # list and callback closure) on the record
+            if self.disagg.enabled and handle.role == "prefill":
+                with self._lock:
+                    rec.last_turn = turn
+        finally:
+            if routing_rec is not None:
+                with self._lock:
+                    routing_rec.routing -= 1
         # turnscope: record the placement on the turn's trace (the
         # engine created it inside submit)
         trace_mod.note_route(turn.trace, handle.rid)
@@ -539,20 +662,117 @@ class EngineFleet:
 
         def wrapped(tok: int) -> None:
             with rec.lock:
-                if not state["booked"]:
-                    rec.tokens.extend(int(t) for t in prompt)
-                    state["booked"] = True
-                rec.tokens.append(int(tok))
+                added = 0
+                if not rec.mirror_dropped:
+                    # a cap-evicted record stops mirroring entirely:
+                    # appending a partial suffix would be unusable for
+                    # re-prefill AND unevictable — the exact unbounded
+                    # growth the cap exists to stop
+                    if not state["booked"]:
+                        rec.tokens.extend(int(t) for t in prompt)
+                        state["booked"] = True
+                        added += len(prompt)
+                    rec.tokens.append(int(tok))
+                    added += 1
                 rec.last_used = time.monotonic()
+            if added:
+                self._mirror_account(added)
             if cb is not None:
                 cb(tok)
 
         return wrapped
 
+    # ---- bounded history mirror (ROOM_TPU_FLEET_MIRROR_TOKENS) ----
+
+    def _mirror_account(self, delta: int) -> None:
+        """Track the fleet-wide mirror footprint; past the cap, LRU
+        records drop their mirrors. The hot path pays one small-lock
+        increment; the eviction sweep runs only on crossings, and is
+        rate-limited so a corner where nothing is evictable (every
+        surviving mirror mid-ship or deferred) cannot turn every
+        streamed token into a fleet-lock sort."""
+        with self._mirror_lock:
+            self._mirror_tokens += delta
+            over = self.mirror_cap_tokens > 0 and \
+                self._mirror_tokens > self.mirror_cap_tokens
+            if not (over and delta > 0):
+                return
+            now = time.monotonic()
+            if self._mirror_sweep_futile and \
+                    now - self._mirror_sweep_at < 0.2:
+                return
+            self._mirror_sweep_at = now
+        self._mirror_sweep_futile = self._evict_mirrors() == 0
+
+    def _evict_mirrors(self) -> int:
+        """Drop least-recently-used records' token mirrors until the
+        fleet fits its cap again. A dropped mirror costs failover
+        warmth for that session (warm salvage still works; the
+        re-prefill fallback does not — `mirror_dropped` stops further
+        appends, so an evicted record never accumulates an unusable,
+        unevictable partial suffix), never correctness of the live
+        placement. Returns mirrors dropped."""
+        with self._lock:
+            recs = sorted(
+                (r for r in self._records.values()
+                 if r.tokens and not r.mirror_dropped
+                 and r.ship_state is None and r.pending_entry is None),
+                key=lambda r: r.last_used,
+            )
+        evicted = 0
+        for rec in recs:
+            with self._mirror_lock:
+                if self.mirror_cap_tokens <= 0 or \
+                        self._mirror_tokens <= self.mirror_cap_tokens:
+                    return evicted
+            with rec.lock:
+                dropped = len(rec.tokens)
+                rec.tokens = []
+                rec.mirror_dropped = True
+            if dropped:
+                evicted += 1
+                with self._mirror_lock:
+                    self._mirror_tokens -= dropped
+                self._bump("mirror_evictions")
+                self._bump("mirror_tokens_evicted", dropped)
+        return evicted
+
+    def _mirror_release(self, rec: _SessionRecord) -> None:
+        with rec.lock:
+            n = len(rec.tokens)
+            rec.tokens = []
+            # a turn may still be streaming into this (released/
+            # replaced) record's callback: mark it dropped so the
+            # orphaned closure stops booking tokens nobody will ever
+            # release from the fleet-wide counter
+            rec.mirror_dropped = True
+        if n:
+            with self._mirror_lock:
+                self._mirror_tokens -= n
+
+    def _set_record_tokens(
+        self, rec: _SessionRecord, toks: list
+    ) -> None:
+        """Replace a record's mirror (absorb/re-home paths) with cap
+        accounting."""
+        with rec.lock:
+            old = len(rec.tokens)
+            rec.tokens = toks
+            rec.mirror_dropped = False
+        with self._mirror_lock:
+            self._mirror_tokens += len(toks) - old
+
     def release_session(self, session_id: str) -> None:
         with self._lock:
             rec = self._records.pop(session_id, None)
+            if rec is not None and rec.ship_event is not None:
+                # a released session's ship is moot: unblock any
+                # waiter; the coordinator's liveness re-checks see the
+                # popped record and discard the exported entry instead
+                # of adopting a ghost
+                rec.ship_event.set()
         if rec is not None:
+            self._mirror_release(rec)
             handle = self._handle(rec.rid)
             targets = [handle] if handle is not None else []
         else:
@@ -599,6 +819,9 @@ class EngineFleet:
                 self.kill_replica(
                     victim.rid, reason="injected replica_crash"
                 )
+        # disaggregated prefill->decode ships fire at turn boundaries
+        # noticed here (docs/disagg.md); inert without roles
+        self.disagg.advance()
         for h in list(self.replicas):
             if h.state != "serving":
                 continue
@@ -705,10 +928,23 @@ class EngineFleet:
             )
         # 3) re-home every session the router placed on this replica:
         #    warm via salvaged spool files, mirror re-prefill otherwise
+        orphaned_entries: list = []
         with self._lock:
             recs = [
                 r for r in self._records.values() if r.rid == h.rid
             ]
+            # abort any disagg ship touching the dead replica: the
+            # failover below owns these sessions now (waiters on the
+            # ship event re-route against the re-homed placement).
+            # Routed through the coordinator so its in-flight tracking
+            # drains and a completed export's detached spool is
+            # discarded, not leaked.
+            for r in recs:
+                entry = self.disagg.abort_ship_locked(r)
+                if entry is not None:
+                    orphaned_entries.append(entry)
+        for entry in orphaned_entries:
+            self.disagg._discard_entry(entry)
         pending: list[tuple] = []
         for rec in recs:
             entry = salvage.pop(rec.sid, None)
@@ -728,7 +964,7 @@ class EngineFleet:
             toks = list(entry.get("history") or [])
             if entry.get("pending") is not None:
                 toks.append(int(entry["pending"]))
-            rec.tokens = toks
+            self._set_record_tokens(rec, toks)
             rec.generation = int(entry.get("generation") or 0)
             with self._lock:
                 self._records[sid] = rec
@@ -755,7 +991,10 @@ class EngineFleet:
         with rec.lock:
             toks = list(rec.tokens)
             generation = rec.generation
-        if not toks:
+            dropped = rec.mirror_dropped
+        if not toks or dropped:
+            # a cap-evicted mirror's later appends are a SUFFIX of the
+            # history — re-prefilling from them would fork the session
             return None
         # the mirror's last streamed token re-enters as the pending
         # token — exactly the park contract, so the resumed stream
@@ -777,11 +1016,13 @@ class EngineFleet:
         pending: list,
     ) -> None:
         if entry is None:
-            # nothing durable ever happened on this session: drop the
+            # nothing durable ever happened on this session (or its
+            # mirror was cap-evicted with no warm salvage): drop the
             # placement; its next turn starts fresh wherever the
             # router puts it
             with self._lock:
                 self._records.pop(rec.sid, None)
+            self._mirror_release(rec)
             return
         target = self._next_target(exclude)
         if target is None:
@@ -1029,7 +1270,7 @@ class EngineFleet:
                 toks = [int(t) for t in entry.get("history") or []]
                 if entry.get("pending") is not None:
                     toks.append(int(entry["pending"]))
-                rec.tokens = toks
+                self._set_record_tokens(rec, toks)
                 rec.generation = int(entry.get("generation") or 0)
                 rec.pending_entry = entry
                 rec.pending_fingerprint = fingerprint
@@ -1038,6 +1279,8 @@ class EngineFleet:
                     if old is not None:
                         rec.rehomed = old.rehomed
                     self._records[sid] = rec
+                if old is not None:
+                    self._mirror_release(old)
                 out["deferred"] += 1
                 continue
             ev = target.engine.adopt_parked_session(
@@ -1053,13 +1296,15 @@ class EngineFleet:
             toks = [int(t) for t in entry.get("history") or []]
             if entry.get("pending") is not None:
                 toks.append(int(entry["pending"]))
-            rec.tokens = toks
+            self._set_record_tokens(rec, toks)
             rec.generation = int(entry.get("generation") or 0)
             with self._lock:
                 old = self._records.get(sid)
                 if old is not None:
                     rec.rehomed = old.rehomed + 1
                 self._records[sid] = rec
+            if old is not None:
+                self._mirror_release(old)
             pending.append((rec, entry, target, ev))
         wait_until = time.monotonic() + 30.0
         for rec, entry, target, ev in pending:
@@ -1083,6 +1328,47 @@ class EngineFleet:
         return out
 
     # ---- process lifecycle (ModelHost facade) ----
+
+    def _fold_inflight_ships(self) -> None:
+        """Process-drain fold for ships caught mid-flight: a COMPLETED
+        export's entry exists only in its holder — no engine would
+        manifest it — so hand it to a live replica's adoption queue
+        (``engine.drain`` applies queued adoptions before writing the
+        manifest). A ship whose adoption is already queued on a live
+        target is left alone (that engine's drain applies + manifests
+        it); a still-queued export is refused by the draining donor, so
+        the session stays in the donor's manifest."""
+        with self._lock:
+            folds = []
+            for rec in list(self.disagg._inflight.values()):
+                exported = None
+                if rec.ship_export is not None:
+                    done, holder, _ = rec.ship_export
+                    if done.is_set():
+                        exported = holder.get("entry")
+                queued_adopt = rec.ship_adopt is not None
+                rec.ship_state = None
+                rec.ship_export = None
+                rec.ship_adopt = None
+                if rec.ship_event is not None:
+                    rec.ship_event.set()
+                    rec.ship_event = None
+                if exported is not None and not queued_adopt:
+                    folds.append((rec, exported))
+            self.disagg._inflight.clear()
+        for rec, entry in folds:
+            target = next(
+                (h for h in self.replicas if h.state != "dead"), None,
+            )
+            if target is None:
+                self.disagg._discard_entry(entry)
+                continue
+            target.engine.adopt_parked_session(
+                entry, fingerprint=None, require_sha=False,
+            )
+            with self._lock:
+                if self._records.get(rec.sid) is rec:
+                    rec.rid = target.rid
 
     def begin_drain(self) -> None:
         self.lifecycle_phase = "draining"
@@ -1109,6 +1395,12 @@ class EngineFleet:
         t0 = time.monotonic()
         budget_end = t0 + max(deadline_s, 0.0)
         self.begin_drain()
+        # no ships once the process is draining; the wire listener
+        # closes with the fleet, and any ship already mid-flight is
+        # folded back so its session reaches SOME replica's manifest
+        # (the zero-durable-loss drain contract)
+        self.disagg.close()
+        self._fold_inflight_ships()
         summaries: dict[str, dict] = {}
         wrote_all = True
         totals = {"sessions_total": 0, "sessions_spooled": 0,
@@ -1185,12 +1477,22 @@ class EngineFleet:
         out["health"] = {
             h.rid: {
                 "state": h.state,
+                "role": h.role,
                 "healthy": getattr(h.engine, "healthy", True),
                 "score": round(h.health_score(), 1),
                 "strikes": h.strikes,
             }
             for h in self.replicas
         }
+        with self._mirror_lock:
+            mirror_tokens = self._mirror_tokens
+        out["mirror"] = {
+            "tokens": mirror_tokens,
+            "cap_tokens": self.mirror_cap_tokens,
+            "evictions": out.pop("mirror_evictions"),
+            "tokens_evicted": out.pop("mirror_tokens_evicted"),
+        }
+        out["disagg"] = self.disagg.stats()
         return out
 
     def stats(self) -> dict:
@@ -1231,10 +1533,13 @@ class EngineFleet:
     def run_until_idle(self, max_steps: int = 100_000) -> None:
         """Synchronous driver (tests, notebooks): steps every
         thread-less serving replica round-robin, supervising between
-        rounds, until the whole fleet is idle."""
+        rounds, until the whole fleet is idle — including the disagg
+        coordinator, whose turn-boundary KV ships run synchronously
+        inside the supervision pass here (a turn that finished on the
+        step right before idle still gets its ship before return)."""
         for _ in range(max_steps):
             self.supervise()
-            busy = 0
+            busy = self.disagg.pending()
             for h in self.replicas:
                 if h.state != "serving" or (
                     h.thread is not None and h.thread.is_alive()
@@ -1249,5 +1554,9 @@ class EngineFleet:
                         h.engine._inflight is not None:
                     busy += 1
             if busy == 0:
-                return
+                # one more supervision pass: a turn that completed on
+                # this round's final step may owe a disagg ship
+                self.supervise()
+                if self.disagg.pending() == 0:
+                    return
         raise RuntimeError("fleet run_until_idle exceeded max_steps")

@@ -1,0 +1,351 @@
+"""Fleet-global shared prefix store (docs/disagg.md).
+
+The engine's automatic prefix cache (engine._PrefixEntry) is
+process-local: every replica — and every re-homed or freshly placed
+session on one — re-prefills the identical multi-thousand-token
+queen/worker system prompt its sibling already computed. At millions of
+sessions the same few KB of boilerplate is recomputed millions of
+times. This module is the shared tier underneath those caches: a
+content-addressed, filesystem-backed store of page-aligned prompt
+prefix KV, layered on the same spool machinery as the tiered offload
+(kv_offload._write_spool/_read_spool — bf16-safe raw buffers behind a
+json header, atomic tmp+rename writes).
+
+Addressing
+----------
+An entry's key is ``sha256(config fingerprint || token prefix)`` where
+the fingerprint is the engine's lifecycle fingerprint (model name,
+dtype/layout, page size, KV quant mode): two engines produce the same
+key only when their KV bytes are interchangeable. The prefix is always
+page-aligned — the same alignment rule as the in-process prefix cache
+— so a pulled entry scatters directly into whole pages.
+
+Sharing model
+-------------
+One directory, many readers and writers — sibling replicas in one
+process, other processes on the host, and (via a shared volume) other
+hosts. There is no coordination protocol:
+
+- **publish** writes ``<key>.pfxspool`` via tmp+rename; the sidecar
+  ``<key>.pfxmeta`` (token count, page count, nbytes, spool sha256) is
+  written after the spool and is what lookups trust. Content
+  addressing makes concurrent publishes of the same prefix idempotent
+  — both writers produce identical bytes.
+- **pull** reads the sidecar, then the spool (sha256 verified over the
+  same read). Any mismatch/truncation/eviction race degrades to a
+  miss — the caller prefills as if the store never existed.
+- **eviction** is byte-cap LRU by file mtime (reads touch the spool's
+  mtime, best-effort). A racing reader losing its file mid-read is a
+  miss, never an error.
+
+Files carry no owner PID: unlike live-session hibernation spools
+(lifecycle.sweep_orphans territory), prefix KV is immortal shared
+content — a dead donor's entries are exactly as valid as a live one's,
+which is what makes cross-process adoption after a crash free. The
+``.pfxspool`` suffix keeps these files invisible to the ``.kvspool``
+orphan sweeps.
+
+Every read/write sits behind the ``prefix_io`` fault point and
+degrades: publish failures skip, pull failures miss. Nothing here may
+raise into the engine.
+
+Env knobs (docs/knobs.md): ROOM_TPU_PREFIX_STORE,
+ROOM_TPU_PREFIX_STORE_DIR, ROOM_TPU_PREFIX_STORE_MB,
+ROOM_TPU_PREFIX_STORE_PUBLISH.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from . import faults
+from ..utils import knobs
+from .faults import FaultError
+from .kv_offload import _read_spool, _write_spool
+
+__all__ = [
+    "SharedPrefixStore", "prefix_store_enabled_from_env",
+    "prefix_store_dir",
+]
+
+SPOOL_SUFFIX = ".pfxspool"
+META_SUFFIX = ".pfxmeta"
+
+
+def prefix_store_enabled_from_env(default: str = "0") -> bool:
+    return knobs.get_bool("ROOM_TPU_PREFIX_STORE", default=default)
+
+
+def prefix_store_dir() -> str:
+    """Store root: explicit ROOM_TPU_PREFIX_STORE_DIR, else a stable
+    dir under the lifecycle root (same durability story as drain
+    manifests — survives process restarts on one host; deployments
+    point it at a shared volume for cross-host sharing)."""
+    explicit = knobs.get_str("ROOM_TPU_PREFIX_STORE_DIR")
+    if explicit:
+        return explicit
+    from .lifecycle import lifecycle_root
+
+    return os.path.join(lifecycle_root(), "prefix_store")
+
+
+class SharedPrefixStore:
+    """Content-addressed prefix-KV tier shared across replicas, processes
+    and hosts.
+
+    Pure host-side bytes: the engine owns all device copies
+    (copy-on-adopt scatter into its local pool) and all page-table
+    mutation. Thread-safe for the in-process part (the lock covers the
+    length index and counters); cross-process safety comes from atomic
+    renames + verify-on-read, not locking.
+    """
+
+    def __init__(
+        self,
+        fingerprint: dict,
+        dir_path: Optional[str] = None,
+        bytes_cap: Optional[int] = None,
+        page_size: int = 16,
+    ) -> None:
+        self.dir = dir_path or prefix_store_dir()
+        if bytes_cap is None:
+            bytes_cap = int(
+                knobs.get_float("ROOM_TPU_PREFIX_STORE_MB")
+                * 1024 * 1024
+            )
+        self.bytes_cap = bytes_cap
+        self.page_size = page_size
+        # the fingerprint participates in every key: KV bytes are only
+        # interchangeable between identically-configured engines
+        self._fp_digest = hashlib.sha256(
+            json.dumps(fingerprint, sort_keys=True).encode()
+        ).digest()
+        self._lock = threading.Lock()
+        # prefix lengths (tokens) known to exist in the dir — bounds
+        # the longest-prefix probe to O(|lengths|) hashes instead of
+        # one per aligned length. Refreshed by directory scan (other
+        # processes publish too).
+        self._lengths: set[int] = set()
+        self._scanned_at = 0.0
+        self._stats = {
+            "publishes": 0, "publish_skips": 0, "publish_errors": 0,
+            "hits": 0, "misses": 0, "pull_errors": 0,
+            "evictions": 0, "bytes_published": 0, "bytes_pulled": 0,
+        }
+        self._scan(force=True)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    # ---- addressing ----
+
+    def key_of(self, tokens) -> str:
+        h = hashlib.sha256(self._fp_digest)
+        h.update(np.asarray(list(tokens), np.int64).tobytes())
+        return h.hexdigest()
+
+    def _paths(self, key: str) -> tuple[str, str]:
+        return (
+            os.path.join(self.dir, key + SPOOL_SUFFIX),
+            os.path.join(self.dir, key + META_SUFFIX),
+        )
+
+    # ---- length index (in-process hint; misses rescan) ----
+
+    def _scan(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._scanned_at < 2.0:
+                return
+            self._scanned_at = now
+        lengths: set[int] = set()
+        try:
+            for name in os.listdir(self.dir):
+                if not name.endswith(META_SUFFIX):
+                    continue
+                try:
+                    with open(os.path.join(self.dir, name), "r",
+                              encoding="utf-8") as f:
+                        meta = json.load(f)
+                    lengths.add(int(meta["tokens"]))
+                except (OSError, ValueError, KeyError, TypeError):
+                    continue
+        except OSError:
+            pass
+        with self._lock:
+            self._lengths = lengths
+
+    # ---- publish ----
+
+    def has(self, tokens) -> bool:
+        spool, meta = self._paths(self.key_of(tokens))
+        return os.path.exists(spool) and os.path.exists(meta)
+
+    def publish(
+        self, tokens, arrays: dict[str, np.ndarray], n_pages: int,
+    ) -> bool:
+        """Write one prefix's KV page block (host arrays keyed like the
+        engine cache, ``[L, n_pages, ...]``) under its content key.
+        Idempotent; never raises — a prefix_io fault or real I/O error
+        counts and skips (the store is an accelerator, not a
+        dependency). Returns True when the entry is (now) present."""
+        tokens = [int(t) for t in tokens]
+        if not tokens or len(tokens) % self.page_size != 0:
+            return False
+        key = self.key_of(tokens)
+        spool, meta = self._paths(key)
+        if os.path.exists(meta) and os.path.exists(spool):
+            with self._lock:
+                self._lengths.add(len(tokens))
+            self._bump("publish_skips")
+            return True
+        tmp = meta + f".tmp{os.getpid()}"
+        try:
+            faults.maybe_fail("prefix_io")
+            os.makedirs(self.dir, exist_ok=True)
+            digest = _write_spool(spool, arrays, want_digest=True)
+            nbytes = os.path.getsize(spool)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({
+                    "tokens": len(tokens),
+                    "n_pages": int(n_pages),
+                    "nbytes": int(nbytes),
+                    "sha256": digest,
+                }, f)
+            os.replace(tmp, meta)
+        except (FaultError, OSError, TypeError, ValueError):
+            # the sidecar tmp is invisible to every sweep (length
+            # scan, byte-cap eviction, .kvspool orphan sweeps) — a
+            # failed publish must clean it up itself
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            self._bump("publish_errors")
+            return False
+        with self._lock:
+            self._lengths.add(len(tokens))
+        self._bump("publishes")
+        self._bump("bytes_published", int(nbytes))
+        self._evict_over_cap()
+        return True
+
+    # ---- pull ----
+
+    def fetch_longest(
+        self, prompt, max_len: int
+    ) -> Optional[tuple[int, dict, dict[str, np.ndarray]]]:
+        """Longest stored page-aligned prefix of ``prompt`` with length
+        <= ``max_len``; returns (length, meta, arrays) or None. The
+        spool's sha256 is verified over the read; any failure —
+        prefix_io fault, eviction race, truncation, checksum mismatch
+        — degrades to a miss and cleans the length index."""
+        self._scan()
+        with self._lock:
+            lengths = sorted(
+                (n for n in self._lengths
+                 if n <= max_len and n % self.page_size == 0),
+                reverse=True,
+            )
+        if not lengths:
+            self._bump("misses")
+            return None
+        try:
+            faults.maybe_fail("prefix_io")
+        except FaultError:
+            self._bump("pull_errors")
+            self._bump("misses")
+            return None
+        for length in lengths:
+            key = self.key_of(prompt[:length])
+            spool, meta_path = self._paths(key)
+            try:
+                with open(meta_path, "r", encoding="utf-8") as f:
+                    meta = json.load(f)
+                arrays = _read_spool(
+                    spool, expected_sha=meta.get("sha256")
+                )
+            except (OSError, ValueError, KeyError, TypeError):
+                if not os.path.exists(meta_path):
+                    continue  # plain miss at this length
+                # present but unreadable/corrupt: drop the pair so the
+                # next publisher can repair it
+                self._discard(key)
+                self._bump("pull_errors")
+                continue
+            try:
+                # LRU touch for the byte-cap eviction
+                now = time.time()
+                os.utime(spool, (now, now))
+            except OSError:
+                pass
+            self._bump("hits")
+            self._bump("bytes_pulled", int(meta.get("nbytes") or 0))
+            return length, meta, arrays
+        self._bump("misses")
+        return None
+
+    # ---- hygiene ----
+
+    def _discard(self, key: str) -> None:
+        for path in self._paths(key):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _evict_over_cap(self) -> None:
+        """LRU (mtime) eviction down to the byte cap. Best-effort and
+        cross-process racy by design: a concurrent reader losing its
+        file takes a miss; a concurrent evictor's unlink failure is
+        ignored."""
+        if self.bytes_cap <= 0:
+            return
+        try:
+            entries = []
+            total = 0
+            for name in os.listdir(self.dir):
+                if not name.endswith(SPOOL_SUFFIX):
+                    continue
+                path = os.path.join(self.dir, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, name))
+                total += st.st_size
+            if total <= self.bytes_cap:
+                return
+            for _, size, name in sorted(entries):
+                self._discard(name[: -len(SPOOL_SUFFIX)])
+                self._bump("evictions")
+                total -= size
+                if total <= self.bytes_cap:
+                    break
+        except OSError:
+            pass
+
+    # ---- observability ----
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["known_lengths"] = len(self._lengths)
+        out["dir"] = self.dir
+        out["bytes_cap"] = self.bytes_cap
+        try:
+            out["entries"] = sum(
+                1 for n in os.listdir(self.dir)
+                if n.endswith(SPOOL_SUFFIX)
+            )
+        except OSError:
+            out["entries"] = 0
+        return out

@@ -52,7 +52,8 @@ from ..utils import knobs
 
 __all__ = [
     "TURN_CLASSES", "CLASS_RANK", "DEFAULT_CLASS", "ClassTargets",
-    "RequestScheduler", "normalize_class", "class_targets_from_env",
+    "RequestScheduler", "normalize_class", "classify_turn",
+    "class_targets_from_env",
     "class_chunks_from_env", "chunk_pages_from_env",
 ]
 
@@ -94,6 +95,28 @@ def normalize_class(turn_class: Optional[str]) -> str:
     middle class is the safe default for untagged external traffic)."""
     if turn_class in CLASS_RANK:
         return turn_class
+    return DEFAULT_CLASS
+
+
+def classify_turn(
+    turn_class: Optional[str], priority: Optional[int] = None,
+) -> str:
+    """The scheduler's classifier for traffic that reaches a routing
+    layer without an explicit class tag. A known tag always wins; an
+    UNTAGGED turn that carries an explicit shed priority is classified
+    from it through the inverse of CLASS_PRIORITY (0 -> background,
+    1 -> worker, >=2 -> queen; negatives are background) — a
+    background-priority turn must not be silently promoted to worker
+    class just because its submitter forgot the tag. No signal at all
+    falls back to the worker default, same as ``normalize_class``."""
+    if turn_class in CLASS_RANK:
+        return turn_class
+    if priority is not None:
+        if priority <= CLASS_PRIORITY["background"]:
+            return "background"
+        if priority >= CLASS_PRIORITY["queen"]:
+            return "queen"
+        return "worker"
     return DEFAULT_CLASS
 
 

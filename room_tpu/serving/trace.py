@@ -87,6 +87,8 @@ FAULT_EVENTS = {
     "shutdown_io": "fault.shutdown_io",
     "replica_crash": "fault.replica_crash",
     "router_io": "fault.router_io",
+    "kv_wire": "fault.kv_wire",
+    "prefix_io": "fault.prefix_io",
     "db_io": "fault.db_io",
     "cycle_crash": "fault.cycle_crash",
     "loop_hang": "fault.loop_hang",

@@ -367,6 +367,47 @@ register("ROOM_TPU_FLEET_TICK_S", "float", "0.5",
 register("ROOM_TPU_FLEET_REBUILD", "bool", "1",
          "Auto-rebuild crashed replicas (within the strike budget); "
          "0 leaves them dead for operator-driven re-admission.")
+register("ROOM_TPU_FLEET_MIRROR_TOKENS", "int", "2000000",
+         "Fleet-wide cap on router history-mirror tokens; past it the "
+         "least-recently-used sessions' mirrors are dropped (warm-only "
+         "failover for those sessions; 0 = unbounded).")
+
+# ---- disaggregated prefill/decode serving (docs/disagg.md) ----
+register("ROOM_TPU_FLEET_ROLES", "str", None,
+         "Per-replica roles, ','/';'-separated prefill|decode|mixed "
+         "entries (replica i takes entry i; missing entries default "
+         "mixed). Any non-mixed entry enables disaggregated routing.",
+         scope="provider")
+register("ROOM_TPU_DISAGG_PREFILL_TOKENS", "int", "512",
+         "Fresh-session prompt length (tokens) at or past which the "
+         "router places the session on a prefill-role replica.")
+register("ROOM_TPU_DISAGG_WIRE", "str", "0",
+         "KV shipment transport: '0' ships via the in-process "
+         "detached-spool adopt; 'loopback' ships spool bytes as "
+         "length-prefixed checksummed frames over a local socket "
+         "(the cross-host seam, exercised by tests/bench).",
+         choices=("0", "loopback"))
+register("ROOM_TPU_KV_WIRE_PORT", "int", "0",
+         "Listen port for the KV wire receiver (0 = ephemeral).")
+register("ROOM_TPU_KV_WIRE_TIMEOUT_S", "float", "10",
+         "Per-shipment socket timeout for the KV wire, seconds.")
+
+# ---- fleet-global shared prefix store (docs/disagg.md) ----
+register("ROOM_TPU_PREFIX_STORE", "bool", "0",
+         "Content-addressed shared prefix KV store: replicas/hosts "
+         "publish page-aligned prompt-prefix KV and pull it instead "
+         "of re-prefilling (library default off; deployment on).",
+         scope="provider", provider_default="1")
+register("ROOM_TPU_PREFIX_STORE_DIR", "path", None,
+         "Shared prefix-store directory (default "
+         "<lifecycle root>/prefix_store; point it at a shared volume "
+         "for cross-host sharing).")
+register("ROOM_TPU_PREFIX_STORE_MB", "float", "512",
+         "Prefix-store byte cap; past it the least-recently-used "
+         "entries are evicted.")
+register("ROOM_TPU_PREFIX_STORE_PUBLISH", "bool", "1",
+         "Publish locally computed prefix-cache entries to the "
+         "shared store (0 = pull-only).")
 
 # ---- SLO scheduler (docs/scheduler.md) ----
 register("ROOM_TPU_CLASS_TARGETS", "str", "",
@@ -663,6 +704,10 @@ register("ROOM_TPU_BENCH_FLEET", "bool", "1",
          "Run the fleet_failover bench phase (TTFT after a replica "
          "kill, zero-token-loss check, sessions re-homed).",
          scope="bench")
+register("ROOM_TPU_BENCH_DISAGG", "bool", "1",
+         "Run the disagg bench phase (role-split fleet vs mixed "
+         "baseline under a 2k-token prompt burst + prefix-store "
+         "resume re-prefill delta).", scope="bench")
 register("ROOM_TPU_BENCH_TRACE", "bool", "1",
          "Run the turnscope phases: trace-on-vs-off overhead A/B "
          "(p50 turn latency budget <= 5%) and the per-class SLO "

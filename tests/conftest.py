@@ -56,6 +56,16 @@ if "ROOM_TPU_LIFECYCLE_DIR" not in os.environ:
     os.environ["ROOM_TPU_LIFECYCLE_DIR"] = _lc_tmp
     _atexit.register(_shutil.rmtree, _lc_tmp, ignore_errors=True)
 
+# The fleet-global shared prefix store (docs/disagg.md) is ON by
+# default on the provider path, and its dir is shared for the whole
+# run — so an engine built in one test FILE would pull prefix KV
+# another file's engine published, changing which jit variants and
+# prefill paths later suites compile mid-test (a 30 s release-wait in
+# the chaos suite flaked exactly that way). Suites that test the store
+# opt in explicitly (ctor arg / env); everything else runs store-off
+# unless the caller chose otherwise.
+os.environ.setdefault("ROOM_TPU_PREFIX_STORE", "0")
+
 import pytest  # noqa: E402
 
 from room_tpu.db import Database  # noqa: E402

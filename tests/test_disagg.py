@@ -1,0 +1,574 @@
+"""Disaggregated prefill/decode serving suite (docs/disagg.md).
+
+Pins the disaggregation contract on the CPU backend:
+
+- Role parsing + role-aware placement: fresh long-prompt sessions land
+  on prefill replicas, short/continuation traffic prefers decode.
+- The prefill->decode KV handoff: greedy continuations are
+  token-identical to the monolithic single-replica baseline through
+  BOTH ship transports — the same-host detached-spool adopt and the
+  loopback-socket wire (length-prefixed, sha256-checksummed frames) —
+  and the handoff is warm (adopted spool, no re-prefill) when the KV
+  is eligible.
+- The `kv_wire` fault point: a chaos burst over every shipment
+  degrades to the router-mirror re-prefill with ZERO
+  durably-streamed-token loss and identical continuations.
+- Ship/turn races: an export is refused (never blocked on) while the
+  session has a live turn; routing waits out a mid-flight ship rather
+  than forking the session.
+- Satellite pins: the bounded router history mirror
+  (ROOM_TPU_FLEET_MIRROR_TOKENS cap + eviction stat, warm-only
+  failover afterwards) and the scheduler-classifier fix (an untagged
+  background-priority turn is NOT promoted to worker class).
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving import disagg
+from room_tpu.serving.fleet import EngineFleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture()
+def make_fleet(model, monkeypatch, tmp_path):
+    """Role-split fleet factory: prefix cache off (KV wholly
+    session-owned, so ships are warm-eligible), offload on (the ship's
+    spool source), a low prefill threshold so the small test prompts
+    exercise the role router."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path / "spool"))
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "lc"))
+    monkeypatch.setenv("ROOM_TPU_DISAGG_PREFILL_TOKENS", "8")
+    cfg, params = model
+
+    def build_engine(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("offload", True)
+        kw.setdefault("stop_token_ids", [])
+        return ServingEngine(cfg, params, **kw)
+
+    def build(n=2, roles=("prefill", "decode"), **kw):
+        return EngineFleet(
+            "tiny-moe", lambda i: build_engine(**kw), n,
+            auto_rebuild=False, roles=list(roles),
+        )
+
+    build.engine = build_engine
+    return build
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+LONG_PROMPT = list(range(1, 20))     # >= threshold -> prefill replica
+SHORT_PROMPT = [5, 6, 7]             # < threshold -> decode replica
+CONT = [7, 7, 7]
+
+
+@pytest.fixture(scope="module")
+def control(model):
+    """Uninterrupted two-turn reference streams on one engine."""
+    cfg, params = model
+    eng = ServingEngine(
+        cfg, params, max_batch=4, page_size=8, n_pages=96,
+        offload=False, stop_token_ids=[],
+    )
+    c1 = eng.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    c2 = eng.submit(CONT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    return c1.new_tokens, c2.new_tokens
+
+
+# ---- roles + placement ----
+
+def test_roles_from_env_parsing():
+    assert disagg.roles_from_env(3, "prefill,decode") == \
+        ["prefill", "decode", "mixed"]
+    assert disagg.roles_from_env(2, "prefill; decode; decode") == \
+        ["prefill", "decode"]
+    assert disagg.roles_from_env(2, "") == ["mixed", "mixed"]
+    # positions are the contract: empty entries normalize to mixed IN
+    # PLACE, never shifting later roles onto earlier replicas
+    assert disagg.roles_from_env(3, ",,prefill") == \
+        ["mixed", "mixed", "prefill"]
+    with pytest.raises(ValueError):
+        disagg.roles_from_env(2, "prefill,typo")
+
+
+def test_explicit_roles_list_is_padded(make_fleet):
+    # a ctor roles list shorter than the fleet pads to mixed, exactly
+    # like the env path (it must not crash mid-construction)
+    fleet = make_fleet(n=3, roles=("prefill",))
+    assert [h.role for h in fleet.replicas] == \
+        ["prefill", "mixed", "mixed"]
+    with pytest.raises(ValueError):
+        make_fleet(n=2, roles=("typo",))
+
+
+def test_release_mid_export_never_adopts_ghost(make_fleet):
+    """A session released while its export is in flight must NOT be
+    adopted anywhere (an unreleasable ghost) — the coordinator's
+    liveness re-check discards the exported entry and its spool."""
+    fleet = make_fleet()
+    t1 = fleet.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    for _ in range(2000):   # step WITHOUT supervise: no ship starts
+        busy = sum(
+            h.engine.step() for h in fleet.replicas
+            if h.state == "serving"
+        )
+        if t1.done.is_set() and busy == 0:
+            break
+    assert t1.finish_reason == "length"
+    rec = fleet._records["s"]
+    donor = fleet._handle(rec.rid)
+    # stage the export by hand, then release before the collect
+    done, holder = donor.engine.export_session("s")
+    assert done.is_set() and holder["entry"] is not None
+    rec.ship_state = "exporting"
+    rec.ship_event = threading.Event()
+    rec.ship_export = (done, holder, donor.rid)
+    fleet.release_session("s")
+    fleet.disagg._collect_export(rec)
+    assert rec.ship_state is None
+    for h in fleet.replicas:
+        assert "s" not in h.engine.sessions, \
+            "a released session must not be re-adopted by the ship"
+    kv = holder["entry"].get("kv")
+    if kv:
+        import os as _os
+
+        assert not _os.path.exists(kv["file"]), \
+            "the discarded entry's detached spool must be unlinked"
+
+
+def test_placement_by_role(make_fleet):
+    fleet = make_fleet(n=3, roles=("prefill", "decode", "decode"))
+    fleet.submit(LONG_PROMPT, session_id="long", sampling=_greedy(2))
+    fleet.submit(SHORT_PROMPT, session_id="short", sampling=_greedy(2))
+    assert fleet._records["long"].rid == "r0", \
+        "fresh long prompt must land on the prefill replica"
+    assert fleet._records["short"].rid in ("r1", "r2"), \
+        "short prompt must prefer a decode replica"
+    st = fleet.disagg.stats()
+    assert st["prefill_placements"] == 1
+    assert st["decode_placements"] == 1
+    fleet.run_until_idle()
+
+
+def test_placement_degrades_without_role_tier(make_fleet):
+    # no decode/mixed sibling: short prompts still get served (on the
+    # prefill replica) — specialization degrades, availability doesn't
+    fleet = make_fleet(n=1, roles=("prefill",))
+    t = fleet.submit(SHORT_PROMPT, session_id="s", sampling=_greedy(2))
+    fleet.run_until_idle()
+    assert t.finish_reason == "length"
+    assert fleet._records["s"].rid == "r0"
+
+
+# ---- the handoff: token identity through every path ----
+
+def test_same_host_handoff_token_identity(make_fleet, control):
+    c1, c2 = control
+    fleet = make_fleet()
+    t1 = fleet.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert t1.new_tokens == c1
+    st = fleet.disagg.stats()
+    assert st["ships"] == 1 and st["ships_warm"] == 1, st
+    assert fleet._records["s"].rid == "r1", \
+        "after the ship the session must live on the decode replica"
+    t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    assert fleet._records["s"].rid == "r1"
+    assert t2.new_tokens == c2, \
+        "greedy continuation must be token-identical across the " \
+        "prefill->decode handoff"
+
+
+def test_wire_handoff_token_identity(make_fleet, control, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_DISAGG_WIRE", "loopback")
+    c1, c2 = control
+    fleet = make_fleet()
+    try:
+        assert fleet.disagg._wire_server is not None
+        t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                          sampling=_greedy())
+        fleet.run_until_idle()
+        assert t1.new_tokens == c1
+        st = fleet.disagg.stats()
+        assert st["ship_wire"] == 1 and st["wire_errors"] == 0, st
+        assert st["ships_warm"] == 1, \
+            "the wire shipment must adopt the spool bytes warm"
+        t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+        fleet.run_until_idle()
+        assert t2.new_tokens == c2, \
+            "greedy continuation must be token-identical across the " \
+            "loopback-wire handoff"
+    finally:
+        fleet.disagg.close()
+
+
+def test_threaded_handoff_token_identity(make_fleet, control):
+    c1, c2 = control
+    fleet = make_fleet()
+    stop = threading.Event()
+    th = threading.Thread(
+        target=fleet.serve_forever, args=(stop,), daemon=True,
+    )
+    th.start()
+    try:
+        t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                          sampling=_greedy())
+        assert t1.wait(60).new_tokens == c1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                fleet.disagg.stats()["ships"] < 1:
+            time.sleep(0.02)
+        assert fleet.disagg.stats()["ships"] == 1
+        t2 = fleet.submit(CONT, session_id="s", sampling=_greedy())
+        assert t2.wait(60).new_tokens == c2
+        assert fleet._records["s"].rid == "r1"
+    finally:
+        stop.set()
+        th.join(30)
+
+
+# ---- kv_wire chaos ----
+
+def test_kv_wire_chaos_burst_reprefill_fallback_zero_loss(
+    make_fleet, control, monkeypatch,
+):
+    """Every wire shipment fails (send-side and receive-side
+    firings): the coordinator adopts history-only, the decode replica
+    re-prefills from the router mirror, and the continuation stream
+    is token-identical — zero durably-streamed tokens lost."""
+    monkeypatch.setenv("ROOM_TPU_DISAGG_WIRE", "loopback")
+    c1, c2 = control
+    faults.inject("kv_wire", times=8)
+    fleet = make_fleet()
+    try:
+        streams = {}
+        for i in range(3):
+            sid = f"s{i}"
+            t = fleet.submit(LONG_PROMPT, session_id=sid,
+                             sampling=_greedy())
+            fleet.run_until_idle()
+            streams[sid] = list(t.new_tokens)
+            assert streams[sid] == c1
+        st = fleet.disagg.stats()
+        assert st["ships"] == 3, st
+        assert st["ships_reprefill"] == 3 and st["ships_warm"] == 0, \
+            f"wire faults must degrade every ship to re-prefill: {st}"
+        assert st["wire_errors"] == 3
+        assert faults.fired("kv_wire") >= 3
+        for i in range(3):
+            sid = f"s{i}"
+            t2 = fleet.submit(CONT, session_id=sid,
+                              sampling=_greedy())
+            fleet.run_until_idle()
+            assert t2.new_tokens == c2, \
+                "re-prefill fallback must keep greedy continuations " \
+                "token-identical (zero token loss)"
+    finally:
+        fleet.disagg.close()
+
+
+def test_kv_wire_checksum_mismatch_degrades(make_fleet, tmp_path):
+    """A corrupted payload is refused by the receiver's in-transit
+    sha256 — the sender gets a typed error, never a silent adoption
+    of bad KV bytes."""
+    from room_tpu.parallel.multihost import (
+        KVWireError, KVWireServer, kv_wire_send,
+    )
+
+    got = []
+    srv = KVWireServer(str(tmp_path / "in"), lambda *a: got.append(a))
+    try:
+        spool = tmp_path / "x.kvspool"
+        spool.write_bytes(b"\x08\x00\x00\x00\x00\x00\x00\x00{}bytes!")
+        entry = {
+            "id": "s", "history": [1, 2], "pending": 3, "length": 2,
+            "generation": 1,
+            "kv": {"file": str(spool), "own_tokens": 2, "n_pages": 1,
+                   "nbytes": spool.stat().st_size,
+                   "sha256": "0" * 64},   # wrong digest
+        }
+        with pytest.raises(KVWireError, match="checksum"):
+            kv_wire_send(srv.address, entry)
+        assert not got, "a refused shipment must never reach adoption"
+        assert not list((tmp_path / "in").glob("*.kvspool")), \
+            "the corrupt payload must not be persisted"
+    finally:
+        srv.close()
+
+
+def test_donor_death_mid_ship_drains_and_discards(make_fleet):
+    """A replica dying with a ship mid-flight must drain the
+    coordinator's in-flight tracking (run_until_idle would otherwise
+    spin on pending() forever) and discard the completed export's
+    detached spool instead of leaking it."""
+    import os as _os
+
+    fleet = make_fleet(n=3, roles=("prefill", "decode", "decode"))
+    t1 = fleet.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    for _ in range(2000):   # step WITHOUT supervise: no ship starts
+        busy = sum(
+            h.engine.step() for h in fleet.replicas
+            if h.state == "serving"
+        )
+        if t1.done.is_set() and busy == 0:
+            break
+    rec = fleet._records["s"]
+    donor = fleet._handle(rec.rid)
+    done, holder = donor.engine.export_session("s")
+    assert holder["entry"] is not None
+    spool = (holder["entry"].get("kv") or {}).get("file")
+    rec.ship_state = "exporting"
+    rec.ship_event = threading.Event()
+    rec.ship_export = (done, holder, donor.rid)
+    with fleet._lock:
+        fleet.disagg._inflight[rec.sid] = rec
+    fleet.kill_replica(donor.rid, "test")
+    assert fleet.disagg.pending() == 0, \
+        "a dead donor's ship must drain the in-flight tracking"
+    if spool:
+        assert not _os.path.exists(spool), \
+            "the dead ship's detached spool must be discarded"
+    fleet.run_until_idle()   # must terminate, not spin on pending()
+
+
+def test_drain_folds_inflight_ship_into_manifest(
+    make_fleet, model, tmp_path,
+):
+    """A process drain catching a ship mid-flight (export applied, no
+    adoption yet) must fold the exported session into SOME replica's
+    manifest — the zero-durable-loss drain contract."""
+    cfg, params = model
+    fleet = make_fleet()
+    t1 = fleet.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    for _ in range(2000):   # step WITHOUT supervise: no ship starts
+        busy = sum(
+            h.engine.step() for h in fleet.replicas
+            if h.state == "serving"
+        )
+        if t1.done.is_set() and busy == 0:
+            break
+    rec = fleet._records["s"]
+    donor = fleet._handle(rec.rid)
+    done, holder = donor.engine.export_session("s")
+    assert holder["entry"] is not None
+    rec.ship_state = "exporting"
+    rec.ship_event = threading.Event()
+    rec.ship_export = (done, holder, donor.rid)
+    with fleet._lock:
+        fleet.disagg._inflight[rec.sid] = rec
+    dump = str(tmp_path / "drainfold")
+    fleet.drain(dump, deadline_s=20.0)
+    eng = ServingEngine(
+        cfg, params, max_batch=4, page_size=8, n_pages=96,
+        offload=True, stop_token_ids=[],
+    )
+    eng.restore_from_manifest(dump)
+    sess = eng.sessions.get("s")
+    assert sess is not None, \
+        "the mid-ship session must survive the drain in a manifest"
+    full = sess.history + (
+        [sess.pending] if sess.pending is not None else []
+    )
+    assert full[: len(LONG_PROMPT)] == LONG_PROMPT
+
+
+def test_wire_refusal_drops_persisted_spool(tmp_path):
+    """A receiver that refuses a shipment (e.g. named target not
+    serving) must not leave the already-persisted payload filling the
+    wire-in dir — only an accepted (possibly still-queued) adoption
+    keeps its spool."""
+    from room_tpu.parallel.multihost import KVWireError, kv_wire_send
+    from room_tpu.parallel.multihost import KVWireServer
+    import hashlib as _hashlib
+
+    srv = KVWireServer(
+        str(tmp_path / "in"),
+        lambda *a: {"ok": False, "error": "target not serving"},
+    )
+    try:
+        payload = b"\x02\x00\x00\x00\x00\x00\x00\x00{}kv"
+        spool = tmp_path / "x.kvspool"
+        spool.write_bytes(payload)
+        entry = {
+            "id": "s", "history": [1], "pending": 2, "length": 1,
+            "generation": 1,
+            "kv": {"file": str(spool), "own_tokens": 1, "n_pages": 1,
+                   "nbytes": len(payload),
+                   "sha256": _hashlib.sha256(payload).hexdigest()},
+        }
+        with pytest.raises(KVWireError, match="not serving"):
+            kv_wire_send(srv.address, entry, target_rid="r9")
+        assert not list((tmp_path / "in").glob("*.kvspool")), \
+            "a refused shipment's persisted spool must be unlinked"
+        assert not list((tmp_path / "in").glob("*.tmp"))
+    finally:
+        srv.close()
+
+
+def test_export_refused_while_turn_live(make_fleet):
+    """The engine's export seam refuses (never blocks on) a session
+    with a live turn — the ship retries at the next boundary."""
+    fleet = make_fleet()
+    t1 = fleet.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    eng = fleet._handle("r0").engine
+    # the turn is queued, not yet admitted: export must refuse
+    done, holder = eng.export_session("s")
+    assert done.is_set()
+    assert holder["entry"] is None
+    assert "busy" in holder["error"]
+    fleet.run_until_idle()
+    assert t1.finish_reason == "length"
+    # quiescent now: the coordinator's ship went through normally
+    assert fleet.disagg.stats()["ships"] == 1
+    assert fleet._records["s"].rid == "r1"
+
+
+# ---- satellite: bounded router history mirror ----
+
+def test_mirror_cap_evicts_lru_with_stat(make_fleet, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_FLEET_MIRROR_TOKENS", "40")
+    fleet = make_fleet(n=2, roles=("mixed", "mixed"))
+    assert fleet.mirror_cap_tokens == 40
+    for i in range(3):
+        fleet.submit(LONG_PROMPT, session_id=f"m{i}",
+                     sampling=_greedy())
+        fleet.run_until_idle()
+    st = fleet.fleet_stats()["mirror"]
+    assert st["evictions"] > 0, "the cap must evict LRU mirrors"
+    assert st["tokens_evicted"] > 0
+    assert st["tokens"] <= 40 + len(LONG_PROMPT) + 9, \
+        "the mirror total must stay near the cap"
+    # the COLDEST record lost its mirror; the hottest kept it
+    assert fleet._records["m0"].mirror_dropped
+    assert not fleet._records["m2"].mirror_dropped
+    # a dropped record stops mirroring entirely: no unusable (and
+    # unevictable) partial suffix may accumulate after the drop
+    dropped = fleet._records["m0"]
+    before = len(dropped.tokens)
+    fleet.submit(CONT, session_id="m0", sampling=_greedy())
+    fleet.run_until_idle()
+    assert len(dropped.tokens) == before == 0, \
+        "cap-evicted mirrors must not keep growing"
+
+
+def test_dropped_mirror_never_forks_on_failover(
+    make_fleet, monkeypatch,
+):
+    """A cap-evicted mirror makes failover warm-only for that session:
+    with no salvage either, the record is dropped — the session's next
+    turn starts FRESH (a visible reset), never a silently forked
+    history re-prefilled from a partial mirror."""
+    monkeypatch.setenv("ROOM_TPU_FLEET_MIRROR_TOKENS", "10")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DISK_MB", "0")
+    fleet = make_fleet(n=2, roles=("mixed", "mixed"))
+    fleet.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    fleet.run_until_idle()
+    rec = fleet._records["s"]
+    assert rec.mirror_dropped    # cap 10 < prompt+stream
+    assert fleet._entry_from_mirror(rec) is None, \
+        "a dropped mirror's partial suffix must never become a " \
+        "re-home entry"
+    home = rec.rid
+    fleet.kill_replica(home, "test")
+    # the router mirror is gone, so the re-home may only come from
+    # the dying engine's OWN salvage (full history / exported spool)
+    # — or drop the record entirely. A partial-suffix re-prefill
+    # (forked history) must be impossible.
+    rec = fleet._records.get("s")
+    if rec is not None and rec.rid:
+        target = fleet._handle(rec.rid)
+        sess = target.engine.sessions.get("s")
+        assert sess is not None
+        full = sess.history + (
+            [sess.pending] if sess.pending is not None else []
+        )
+        assert full[: len(LONG_PROMPT)] == LONG_PROMPT, \
+            "re-homed context must contain the FULL prompt (salvage " \
+            "history), never the dropped mirror's partial suffix"
+
+
+# ---- satellite: scheduler classifier ----
+
+def test_untagged_background_priority_not_promoted(make_fleet):
+    """fleet.submit with turn_class=None but an explicit background
+    priority must classify through the scheduler (background), not
+    silently promote to worker."""
+    fleet = make_fleet(n=2, roles=("mixed", "mixed"))
+    t = fleet.submit(SHORT_PROMPT, session_id="x", sampling=_greedy(2),
+                     priority=0)
+    assert t.turn_class == "background"
+    fleet.run_until_idle()
+    assert t.finish_reason == "length"
+    # and through the router shed path (no serving replica)
+    for h in fleet.replicas:
+        fleet.kill_replica(h.rid, "test")
+    shed = fleet.submit(SHORT_PROMPT, session_id="y",
+                        sampling=_greedy(2), priority=0)
+    assert shed.shed and shed.turn_class == "background"
+    tagged = fleet.submit(SHORT_PROMPT, session_id="z",
+                          sampling=_greedy(2), turn_class="queen")
+    assert tagged.turn_class == "queen"
+
+
+def test_classify_turn_table():
+    from room_tpu.serving.scheduler import classify_turn
+
+    assert classify_turn("queen") == "queen"
+    assert classify_turn("background", priority=2) == "background"
+    assert classify_turn(None, priority=0) == "background"
+    assert classify_turn(None, priority=-5) == "background"
+    assert classify_turn(None, priority=1) == "worker"
+    assert classify_turn(None, priority=7) == "queen"
+    assert classify_turn(None) == "worker"
+    assert classify_turn("typo") == "worker"
+
+
+# ---- class budgets on prefill replicas ----
+
+def test_prefill_replica_honors_class_chunk_budgets(
+    make_fleet, monkeypatch,
+):
+    """A prefill replica still runs the SLO scheduler: a background
+    long prompt prefills under its per-window chunk budget (deferred
+    chunks counted), it does not monopolize the replica."""
+    monkeypatch.setenv("ROOM_TPU_PREFILL_CHUNK_PAGES", "1")
+    fleet = make_fleet(n=2, roles=("prefill", "decode"))
+    big = list(range(1, 70))   # several 8-token pages of chunks
+    t = fleet.submit(big, session_id="bg", sampling=_greedy(2),
+                     turn_class="background")
+    fleet.run_until_idle()
+    assert t.finish_reason == "length"
+    eng = fleet._handle("r0").engine
+    st = eng.stats()
+    assert st["prefill_chunks_interleaved"] > 0, \
+        "the prefill replica must chunk the long prompt through the " \
+        "scheduler budget, not prefill it monolithically"

@@ -1,0 +1,310 @@
+"""Fleet-global shared prefix store suite (docs/disagg.md).
+
+Pins the content-addressed prefix tier on the CPU backend:
+
+- Store unit contract: publish/fetch round trip (bf16-safe spool
+  bytes, sha256 verified on read), content addressing (same tokens +
+  same config fingerprint -> same key; different fingerprint -> no
+  cross-hit), longest-prefix probing, byte-cap LRU eviction, corrupt
+  entries degrading to a miss and self-healing.
+- Cross-process adoption: a second store instance (fresh process
+  emulation) over the same dir serves entries its dead donor
+  published — prefix KV carries no owner PID, and the `.kvspool`
+  orphan sweeps never touch `.pfxspool` files.
+- Engine integration: greedy streams TOKEN-IDENTICAL across all
+  three prefill paths — monolithic (prefix cache off), local
+  prefix-cache miss-then-register, and the prefix-store pull
+  (copy-on-adopt scatter) — plus the store actually removing prefill
+  work (prefill_tokens delta) on the pulling engine.
+- The `prefix_io` fault point: a failed pull is an ordinary miss, a
+  failed publish skips; correctness never depends on the store.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving import lifecycle
+from room_tpu.serving.prefix_store import SharedPrefixStore
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _arrays(n_pages=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "k": rng.standard_normal((2, n_pages, 8, 4)).astype(
+            np.float32
+        ),
+        "v": rng.standard_normal((2, n_pages, 8, 4)).astype(
+            np.float32
+        ),
+    }
+
+
+FP = {"model": "t", "page_size": 8, "kv_quant": None}
+
+
+# ---- store unit contract ----
+
+def test_publish_fetch_round_trip(tmp_path):
+    store = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    toks = list(range(16))
+    arrays = _arrays()
+    assert store.publish(toks, arrays, n_pages=2)
+    got = store.fetch_longest(toks + [99, 98], max_len=16)
+    assert got is not None
+    length, meta, back = got
+    assert length == 16 and meta["n_pages"] == 2
+    for k in arrays:
+        np.testing.assert_array_equal(arrays[k], back[k])
+    st = store.stats()
+    assert st["publishes"] == 1 and st["hits"] == 1
+
+
+def test_longest_prefix_wins(tmp_path):
+    store = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    toks = list(range(32))
+    store.publish(toks[:8], _arrays(1), n_pages=1)
+    store.publish(toks[:24], _arrays(3, seed=1), n_pages=3)
+    got = store.fetch_longest(toks, max_len=32)
+    assert got is not None and got[0] == 24
+    # max_len clamps below the longer entry
+    got = store.fetch_longest(toks, max_len=16)
+    assert got is not None and got[0] == 8
+
+
+def test_fingerprint_separates_keys(tmp_path):
+    a = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    b = SharedPrefixStore({**FP, "kv_quant": "int8"}, str(tmp_path),
+                          page_size=8)
+    toks = list(range(8))
+    assert a.key_of(toks) != b.key_of(toks)
+    a.publish(toks, _arrays(1), n_pages=1)
+    assert b.fetch_longest(toks, max_len=8) is None, \
+        "a differently-configured engine must never hit another " \
+        "config's KV bytes"
+
+
+def test_publish_idempotent_and_unaligned_refused(tmp_path):
+    store = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    toks = list(range(8))
+    assert store.publish(toks, _arrays(1), n_pages=1)
+    assert store.publish(toks, _arrays(1), n_pages=1)   # skip, True
+    assert store.stats()["publish_skips"] == 1
+    assert not store.publish(list(range(5)), _arrays(1), n_pages=1), \
+        "a non-page-aligned prefix must be refused"
+
+
+def test_byte_cap_evicts_lru(tmp_path):
+    one = sum(a.nbytes for a in _arrays(1).values())
+    store = SharedPrefixStore(
+        FP, str(tmp_path), bytes_cap=int(one * 2.5), page_size=8,
+    )
+    for i in range(4):
+        store.publish([i * 100 + j for j in range(8)], _arrays(1, i),
+                      n_pages=1)
+    st = store.stats()
+    assert st["evictions"] >= 1
+    assert st["entries"] <= 2
+
+
+def test_corrupt_spool_degrades_to_miss_and_heals(tmp_path):
+    store = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    toks = list(range(8))
+    store.publish(toks, _arrays(1), n_pages=1)
+    spool, _meta = store._paths(store.key_of(toks))
+    with open(spool, "r+b") as f:
+        f.seek(40)
+        f.write(b"\xff\xff\xff\xff")
+    assert store.fetch_longest(toks, max_len=8) is None
+    assert store.stats()["pull_errors"] == 1
+    assert not os.path.exists(spool), \
+        "a corrupt entry is dropped so the next publisher can repair"
+    assert store.publish(toks, _arrays(1), n_pages=1)
+    assert store.fetch_longest(toks, max_len=8) is not None
+
+
+def test_prefix_io_fault_degrades(tmp_path):
+    store = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    toks = list(range(8))
+    faults.inject("prefix_io", times=1)
+    assert not store.publish(toks, _arrays(1), n_pages=1)
+    assert store.stats()["publish_errors"] == 1
+    store.publish(toks, _arrays(1), n_pages=1)
+    faults.inject("prefix_io", times=1)
+    assert store.fetch_longest(toks, max_len=8) is None
+    assert store.stats()["pull_errors"] == 1
+    assert store.fetch_longest(toks, max_len=8) is not None
+
+
+# ---- cross-process adoption ----
+
+def test_cross_process_adoption_dead_pid_donor(tmp_path):
+    """Two stores share a dir; the donor 'process' is gone. The
+    adopting store (a fresh instance = fresh process) must still
+    serve the entries, and the lifecycle orphan sweeps — which DO
+    delete dead-PID `.kvspool` files — must leave prefix entries
+    alone: shared prefix KV is immortal content, not process state."""
+    donor = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    toks = list(range(16))
+    arrays = _arrays()
+    donor.publish(toks, arrays, n_pages=2)
+    del donor   # donor process dead; files carry no live-PID tag
+
+    # a dead-PID .kvspool sibling IS swept by the same dir's hygiene
+    dead = tmp_path / "pid999999-deadbeef.kvspool"
+    dead.write_bytes(b"leftover")
+    os.utime(dead, (1, 1))
+    removed = lifecycle.sweep_orphans(str(tmp_path), max_age_s=0.0)
+    assert removed == 1 and not dead.exists()
+
+    adopter = SharedPrefixStore(FP, str(tmp_path), page_size=8)
+    got = adopter.fetch_longest(toks, max_len=16)
+    assert got is not None, \
+        "a fresh store over the shared dir must adopt the dead " \
+        "donor's entries"
+    for k in arrays:
+        np.testing.assert_array_equal(arrays[k], got[2][k])
+
+
+# ---- engine integration: three-path token identity ----
+
+@pytest.fixture()
+def engines(model, monkeypatch, tmp_path):
+    monkeypatch.setenv("ROOM_TPU_PREFIX_STORE_DIR",
+                       str(tmp_path / "pfx"))
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "lc"))
+    cfg, params = model
+
+    def build(prefix_pages="2", store=True, **kw):
+        monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES",
+                           prefix_pages)
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("stop_token_ids", [])
+        return ServingEngine(cfg, params, prefix_store=store, **kw)
+
+    return build
+
+
+SYS = list(range(3, 40))          # 32-token aligned shared prefix
+PROMPT = SYS + [9, 9, 5]
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+def test_three_path_token_identity_and_prefill_delta(engines):
+    # path 1: monolithic (prefix caching off entirely)
+    mono = engines(prefix_pages="0", store=False)
+    c = mono.submit(PROMPT, session_id="a", sampling=_greedy())
+    mono.run_until_idle()
+    control = list(c.new_tokens)
+
+    # path 2: local prefix cache, no store (miss -> register)
+    local = engines(store=False)
+    t2 = local.submit(PROMPT, session_id="a", sampling=_greedy())
+    local.run_until_idle()
+    assert t2.new_tokens == control
+    assert local.prefix_store is None
+
+    # path 3: store-enabled publisher, then a FRESH engine pulling
+    pub = engines()
+    t3 = pub.submit(PROMPT, session_id="a", sampling=_greedy())
+    pub.run_until_idle()
+    assert t3.new_tokens == control
+    assert pub.stats()["prefix_store_publishes"] == 1
+
+    puller = engines()
+    t4 = puller.submit(PROMPT, session_id="b", sampling=_greedy())
+    puller.run_until_idle()
+    st = puller.stats()
+    assert t4.new_tokens == control, \
+        "a prefix-store pull must be token-identical to the " \
+        "monolithic prefill"
+    assert st["prefix_store_hits"] == 1
+    assert st["prefix_store_tokens_reused"] == 32
+    assert st["prefill_tokens"] == pub.stats()["prefill_tokens"] - 32, \
+        "the pull must actually remove the prefix from prefill work"
+    assert st["prefix_store"]["hits"] == 1
+
+
+def test_pull_materializes_shareable_local_entry(engines):
+    pub = engines()
+    pub.submit(PROMPT, session_id="a", sampling=_greedy())
+    pub.run_until_idle()
+    puller = engines()
+    puller.submit(PROMPT, session_id="b", sampling=_greedy())
+    puller.run_until_idle()
+    # a SECOND session on the pulling engine hits the local entry the
+    # pull materialized — no second store read
+    bytes_before = puller.prefix_store.stats()["bytes_pulled"]
+    puller.submit(SYS + [1, 2], session_id="c", sampling=_greedy())
+    puller.run_until_idle()
+    st = puller.stats()
+    assert st["prefix_hits"] >= 2     # pull-hit + local hit
+    assert st["prefix_store_hits"] == 1
+    assert puller.prefix_store.stats()["bytes_pulled"] == bytes_before
+
+
+def test_prefix_io_fault_on_pull_is_plain_miss(engines):
+    pub = engines()
+    pub.submit(PROMPT, session_id="a", sampling=_greedy())
+    pub.run_until_idle()
+    control = None
+    mono = engines(prefix_pages="0", store=False)
+    c = mono.submit(PROMPT, session_id="a", sampling=_greedy())
+    mono.run_until_idle()
+    control = list(c.new_tokens)
+
+    faults.inject("prefix_io")
+    eng = engines()
+    t = eng.submit(PROMPT, session_id="d", sampling=_greedy())
+    eng.run_until_idle()
+    assert t.new_tokens == control
+    assert eng.stats()["prefix_store_hits"] == 0
+    faults.clear()
+
+
+def test_session_resume_reprefill_pulls_prefix(engines, model):
+    """The disagg synergy: a re-homed/re-prefilling session (history
+    re-enters as a fresh prefill) pulls the shared prefix instead of
+    recomputing it — the engine adoption seam + store together."""
+    pub = engines()
+    pub.submit(PROMPT, session_id="a", sampling=_greedy())
+    pub.run_until_idle()
+
+    target = engines()
+    # adopt a history-only entry (the mirror re-prefill path)
+    entry = {
+        "id": "moved", "history": list(PROMPT), "pending": 11,
+        "length": len(PROMPT), "generation": 1, "kv": None,
+    }
+    target.adopt_parked_session(entry, fingerprint=None)
+    t = target.submit([4, 4], session_id="moved",
+                      sampling=_greedy())
+    target.run_until_idle()
+    assert t.finish_reason == "length"
+    st = target.stats()
+    assert st["prefix_store_hits"] == 1, \
+        "the resume re-prefill must pull the shared prefix"
+    assert st["prefix_store_tokens_reused"] == 32

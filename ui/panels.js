@@ -1605,8 +1605,8 @@ async function renderTpu(el) {
       </table>
       ${Object.entries(hl.engines || {}).some(([n, e]) => e.fleet) ? `
       <h2 style="margin-top:.6rem">fleet</h2>
-      <table><tr><th>model</th><th>replica</th><th>state</th>
-        <th>score</th><th>strikes</th><th>placed</th>
+      <table><tr><th>model</th><th>replica</th><th>role</th>
+        <th>state</th><th>score</th><th>strikes</th><th>placed</th>
         <th>failovers</th><th>re-homed</th><th>drains</th></tr>
       ${Object.entries(hl.engines || {})
         .filter(([name, e]) => e.fleet)
@@ -1614,6 +1614,7 @@ async function renderTpu(el) {
           Object.entries(e.fleet.health || {}).map(([rid, r]) => `
         <tr><td>${esc(name)}</td>
         <td>${esc(rid)}</td>
+        <td class="dim">${esc(r.role || "mixed")}</td>
         <td><span class="pill ${
           r.state === "serving" && r.healthy ? "verified"
           : r.state === "dead" ? "failed" : "pending"
@@ -1627,6 +1628,42 @@ async function renderTpu(el) {
             warm)</span></td>
         <td>${e.fleet.bluegreen_drains ?? 0}</td>
         </tr>`)).join("")}
+      </table>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.fleet?.disagg?.enabled)
+        .map(([name, e]) => `
+      <div class="kv" style="margin-top:.4rem">
+        <span class="k">disagg ships (${esc(name)})</span>
+          <span>${e.fleet.disagg.ships ?? 0}
+            <span class="dim">(${e.fleet.disagg.ships_warm ?? 0} warm,
+              ${e.fleet.disagg.ships_reprefill ?? 0} re-prefill,
+              ${e.fleet.disagg.wire_errors ?? 0} wire errors)</span>
+          </span>
+        <span class="k">mirror</span>
+          <span>${e.fleet.mirror?.tokens ?? 0} tokens
+            <span class="dim">(cap ${e.fleet.mirror?.cap_tokens ?? 0},
+              ${e.fleet.mirror?.evictions ?? 0} evictions)</span>
+          </span>
+      </div>`).join("")}` : ""}
+      ${Object.entries(hl.engines || {}).some(
+        ([n, e]) => e.prefix_store) ? `
+      <h2 style="margin-top:.6rem">prefix store</h2>
+      <table><tr><th>engine</th><th>entries</th><th>hits</th>
+        <th>misses</th><th>publishes</th><th>evictions</th>
+        <th>pulled</th><th>errors</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.prefix_store)
+        .map(([name, e]) => `
+        <tr><td>${esc(name)}</td>
+        <td>${e.prefix_store.entries ?? 0}</td>
+        <td>${e.prefix_store.hits ?? 0}</td>
+        <td>${e.prefix_store.misses ?? 0}</td>
+        <td>${e.prefix_store.publishes ?? 0}</td>
+        <td>${e.prefix_store.evictions ?? 0}</td>
+        <td class="dim">${Math.round(
+          (e.prefix_store.bytes_pulled ?? 0) / 1024)}KB</td>
+        <td>${(e.prefix_store.pull_errors ?? 0) +
+          (e.prefix_store.publish_errors ?? 0)}</td></tr>`).join("")}
       </table>` : ""}
       ${Object.keys(hl.faults || {}).length
         ? `<div class="dim" style="margin-top:.4rem">armed faults: ${
