@@ -574,6 +574,116 @@ def main() -> None:
             except Exception as e:
                 _phase("spec_agent", {key: f"error: {e}"})
 
+    # in-window speculation x multi-step pipeline (docs/serving.md):
+    # the fusion acceptance gate. On repetitive agent traffic at FULL
+    # window depth, spec-on must emit > 1 token per device forward
+    # with ZERO spec-induced window flushes (spec rounds ride inside
+    # dispatches that still run the configured steps — the old path
+    # composed every round as a steps=1 iteration) and pay no more
+    # host stall per token than spec-off.
+    def measure_spec_pipeline(spec_tokens: int) -> dict:
+        eng = ServingEngine(
+            cfg, params, max_batch=max_batch, page_size=32,
+            n_pages=1024, spec_tokens=spec_tokens,
+        )
+        text = (
+            '{"tool_call": {"name": "quorum_vote", "arguments": '
+            '{"vote": "approve", "reasoning": "quorum boilerplate"}}}\n'
+        ) * (2 if TINY else 6)
+        ptoks = eng.tokenizer.encode(text)
+        # long enough for greedy generation to settle into its loop —
+        # prompt-lookup only drafts once the TAIL repeats, and the
+        # trailing n-gram ends with generated tokens, not the prompt
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=48 if TINY else 96,
+        )
+        warm = [eng.submit(ptoks, sampling=sp) for _ in range(max_batch)]
+        eng.run_until_idle()
+        for t in warm:
+            eng.release_session(t.session_id)
+        start = eng.stats()
+        for _ in range(max_batch):
+            eng.submit(ptoks, sampling=sp)
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        st = eng.stats()
+        decoded = st["tokens_decoded"] - start["tokens_decoded"]
+        windows = st["decode_windows"] - start["decode_windows"]
+        stall = st["host_stall_ms"] - start["host_stall_ms"]
+        out = {
+            "tok_s": round(decoded / dt, 2),
+            # every dispatch runs `steps` scan forwards over the whole
+            # batch: > 1 token per forward PER LANE is speculation
+            # paying off inside the window (a non-drafting lane emits
+            # exactly 1, and early stops only drag the ratio down)
+            "tokens_per_forward": round(
+                decoded / max(
+                    windows * st["steps_per_dispatch"] * max_batch, 1
+                ), 3
+            ),
+            "host_stall_ms_per_tok": round(
+                stall / max(decoded, 1), 4
+            ),
+            "decode_windows": windows,
+            "steps_per_dispatch": st["steps_per_dispatch"],
+        }
+        if spec_tokens:
+            out["spec_rounds"] = \
+                st["spec_rounds"] - start["spec_rounds"]
+            proposed = st["spec_proposed"] - start["spec_proposed"]
+            accepted = st["spec_accepted"] - start["spec_accepted"]
+            out["acceptance"] = round(accepted / max(proposed, 1), 3)
+        return out
+
+    if os.environ.get("ROOM_TPU_BENCH_SPEC_PIPELINE", "1") != "0":
+        # pin the window depth AND the gamma tuner for the A/B: live
+        # adaptation (scheduler.SpecTuner) changes the compiled window
+        # width mid-measurement, so on the CPU proxy a re-jit lands
+        # inside the timed region and swamps the steady-state signal.
+        # Adaptation itself is pinned by tests/test_scheduler.py.
+        prev = {k: os.environ.get(k) for k in (
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH",
+            "ROOM_TPU_SPEC_TUNE_EVERY",
+        )}
+        os.environ["ROOM_TPU_DECODE_STEPS_PER_DISPATCH"] = "4"
+        os.environ["ROOM_TPU_SPEC_TUNE_EVERY"] = "1000000"
+        ab = {}
+        try:
+            for gamma in (0, 4):
+                _extend_deadline()
+                key = "off" if gamma == 0 else f"gamma{gamma}"
+                try:
+                    ab[key] = measure_spec_pipeline(gamma)
+                except Exception as e:
+                    ab[key] = f"error: {e}"
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if isinstance(ab.get("off"), dict) and \
+                isinstance(ab.get("gamma4"), dict):
+            on = ab["gamma4"]
+            # the no-flush evidence: spec rounds happened while every
+            # dispatch still ran the full 4-step window (flushes would
+            # surface as extra shallow windows, dragging per-lane
+            # tokens_per_forward to <= 1)
+            ab["flush_free"] = bool(
+                on["spec_rounds"] > 0
+                and on["steps_per_dispatch"] == 4
+                and on["tokens_per_forward"] > 1.0
+            )
+            ab["stall_delta_ms_per_tok"] = round(
+                on["host_stall_ms_per_tok"]
+                - ab["off"]["host_stall_ms_per_tok"], 4
+            )
+            if CPU_PROXY:
+                _proxy_deltas["spec_tokens_per_forward"] = \
+                    on["tokens_per_forward"]
+        _phase("spec_pipeline", ab)
+
     # long-context chunked prefill (VERDICT r2 #2's phase row): fresh
     # prefill of a long prompt, then a session continuation on top of
     # it — the continuation is the path whose page traffic must scale

@@ -183,6 +183,69 @@ def tiny_dense(vocab_size: int = 512) -> DecoderConfig:
     )
 
 
+def qwen3_draft(vocab_size: int = 151_936) -> DecoderConfig:
+    """Small qwen3-family draft decoder for on-mesh speculative
+    decoding (docs/serving.md): rides the serving mesh next to the
+    target (like the embedder) and proposes greedy draft tokens inside
+    the dispatch window, where the target's batched forward verifies
+    them. The shape is chosen so one draft forward over the
+    ROOM_TPU_DRAFT_WINDOW tail costs well under one target decode
+    step."""
+    return DecoderConfig(
+        name="qwen3-draft",
+        vocab_size=vocab_size,
+        hidden=512,
+        n_layers=4,
+        n_heads=8,
+        n_kv_heads=2,
+        head_dim=64,
+        intermediate=1024,
+        rope_theta=1e6,
+        qkv_bias=False,
+        qk_norm=True,
+    )
+
+
+def tiny_draft(vocab_size: int = 512) -> DecoderConfig:
+    """Hermetic-test draft decoder (1 layer): drafting quality is
+    irrelevant to correctness — every proposal is verified by the
+    target — so tests only need the smallest thing that runs."""
+    return DecoderConfig(
+        name="tiny-draft",
+        vocab_size=vocab_size,
+        hidden=32,
+        n_layers=1,
+        n_heads=2,
+        n_kv_heads=1,
+        head_dim=16,
+        intermediate=64,
+        rope_theta=1e4,
+        qk_norm=False,
+        dtype="float32",
+        max_seq_len=8192,
+    )
+
+
+DRAFT_PRESETS = {
+    "qwen3-draft": qwen3_draft,
+    "tiny-draft": tiny_draft,
+}
+
+
+def resolve_draft_config(name: str, vocab_size: int) -> DecoderConfig:
+    """Resolve ``ROOM_TPU_DRAFT_MODEL`` to a draft config sharing the
+    target's vocabulary (proposals are token ids the target's verify
+    looks up — a mismatched vocab would index out of range). Unknown
+    names raise so a typo'd deployment knob is loud."""
+    fn = DRAFT_PRESETS.get(name)
+    if fn is None:
+        raise ValueError(
+            f"unknown draft model {name!r}; known: "
+            f"{sorted(DRAFT_PRESETS)}"
+        )
+    return fn(vocab_size=vocab_size)
+
+
 @dataclass(frozen=True)
 class EncoderConfig:
     """Bidirectional encoder for the 384-d memory embedder (the reference

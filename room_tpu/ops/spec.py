@@ -1,0 +1,145 @@
+"""On-mesh speculative-drafting primitives (docs/serving.md).
+
+The serving engine's dispatch window keeps a device-resident recent-token
+tail per decode lane so prompt-lookup (n-gram) drafts are computed ON THE
+MESH, inside the jitted ``lax.scan`` step — the reason the old
+speculative path had to flush the multi-step pipeline was that drafting
+read each session's *host-side* history, which an undrained window runs
+ahead of. With the tail on device, a spec round is just a window step
+that happens to emit up to ``1 + gamma`` tokens.
+
+Three pure-JAX helpers live here so they are unit-testable against the
+host reference (``engine.propose_ngram``):
+
+``ngram_propose``
+    Batched prompt-lookup over the tail — trailing 3-gram match with a
+    2-gram fallback, most recent occurrence wins, exactly the host
+    rule. Tokens are right-aligned in the tail; ``-1`` marks padding
+    (never a valid token, so padded windows can't match).
+``shift_tail``
+    Roll a variable number of freshly emitted tokens per lane into the
+    tail (the per-step carry update).
+``draft_propose``
+    The optional second draft tier (``ROOM_TPU_DRAFT_MODEL``): a tiny
+    on-mesh qwen3 decoder proposes ``gamma`` greedy tokens from the
+    tail's trailing window. No persistent draft KV — each proposal step
+    is a full causal forward over the (small) window, which a
+    few-layer draft model amortizes trivially, and a wrong draft is
+    merely rejected by the target's verify, never emitted.
+
+reference: none (the reference delegates decoding to Ollama); the
+prompt-lookup rule mirrors engine.propose_ngram and the verify rule is
+sampler.spec_verify.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["seed_tail", "ngram_propose", "shift_tail", "draft_propose"]
+
+# tail padding marker: never equals a real token id, so a window that
+# still contains padding can never match a (real-token) pattern
+TAIL_PAD = -1
+
+
+def seed_tail(tokens: list, tail_len: int) -> np.ndarray:
+    """Host-side seed for one lane's device tail: the last ``tail_len``
+    tokens right-aligned, left-padded with ``TAIL_PAD``. ``tokens``
+    must end with the lane's feed token (the pending token about to be
+    dispatched), matching the in-scan invariant."""
+    arr = np.full((tail_len,), TAIL_PAD, np.int32)
+    src = tokens[-tail_len:]
+    if src:
+        arr[tail_len - len(src):] = src
+    return arr
+
+
+def _match_last(tail: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
+    """Most recent occurrence of each row's trailing ``n``-gram within
+    the body (windows may not reach the final token, and the trailing
+    n-gram itself is excluded) — the host rule, batched. Returns
+    (found [B] bool, start [B] int32) where ``start`` indexes the first
+    proposal token."""
+    t = tail.shape[1]
+    pat = tail[:, t - n:]                                # [B, n]
+    idx = jnp.arange(t - n)[:, None] + jnp.arange(n)     # [T-n, n]
+    wins = tail[:, idx]                                  # [B, T-n, n]
+    ok = (wins == pat[:, None, :]).all(-1)
+    ok &= (wins >= 0).all(-1)                            # no padding
+    found = ok.any(-1)
+    # index of the LAST matching window start
+    last = (t - n - 1) - jnp.argmax(ok[:, ::-1], axis=-1)
+    return found, (last + n).astype(jnp.int32)
+
+
+def ngram_propose(
+    tail: jax.Array, gamma: int
+) -> tuple[jax.Array, jax.Array]:
+    """Batched prompt-lookup draft over the device tail: match the
+    trailing 3-gram (2-gram fallback) against the row's own earlier
+    content and propose the tokens that followed the most recent
+    previous occurrence — ``engine.propose_ngram``, on-mesh. Returns
+    ``(n_prop [B] int32, prop [B, gamma] int32)``; rows with no
+    repeating n-gram propose nothing (``n_prop == 0``)."""
+    t = tail.shape[1]
+    f3, s3 = _match_last(tail, 3)
+    f2, s2 = _match_last(tail, 2)
+    found = f3 | f2
+    start = jnp.where(f3, s3, s2)
+    gidx = start[:, None] + jnp.arange(gamma)
+    prop = jnp.take_along_axis(
+        tail, jnp.clip(gidx, 0, t - 1), axis=1
+    )
+    avail = jnp.clip(t - start, 0, gamma)
+    n_prop = jnp.where(found, avail, 0).astype(jnp.int32)
+    return n_prop, prop
+
+
+def shift_tail(
+    tail: jax.Array, emitted: jax.Array, emit_n: jax.Array
+) -> jax.Array:
+    """Shift ``emit_n[b]`` freshly emitted tokens into each row's tail
+    (per-row dynamic roll): the new tail is the last T tokens of
+    ``tail ++ emitted[:emit_n]``."""
+    t = tail.shape[1]
+    ext = jnp.concatenate([tail, emitted], axis=1)
+    idx = jnp.arange(t)[None] + emit_n[:, None]
+    return jnp.take_along_axis(ext, idx, axis=1)
+
+
+def draft_propose(
+    draft_params: Any,
+    draft_cfg: Any,
+    tail: jax.Array,
+    gamma: int,
+    window: int,
+) -> jax.Array:
+    """Tier-2 drafting: the tiny on-mesh draft decoder greedily
+    proposes ``gamma`` tokens from the tail's trailing ``window``
+    tokens. Stateless — each proposal step is one causal forward over
+    the rolled window (padding clamps to token 0; an imperfect draft
+    costs a rejection, never a wrong emission). Returns
+    ``prop [B, gamma]``."""
+    from ..models import qwen3
+    from ..serving.sampler import greedy_argmax
+
+    w = min(window, tail.shape[1])
+    seq = tail[:, tail.shape[1] - w:]
+
+    def step(carry, _):
+        cur = carry                                   # [B, w]
+        logits, _ = qwen3.forward(
+            draft_params, draft_cfg, jnp.maximum(cur, 0)
+        )
+        nxt = greedy_argmax(logits[:, -1].astype(jnp.float32))
+        nxt = nxt.astype(jnp.int32)
+        cur = jnp.concatenate([cur[:, 1:], nxt[:, None]], axis=1)
+        return cur, nxt
+
+    _, props = jax.lax.scan(step, seq, None, length=gamma)
+    return props.T                                    # [B, gamma]

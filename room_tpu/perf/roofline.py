@@ -290,8 +290,9 @@ def spec_accept_floor(
     """Acceptance below which a verify round loses to plain decode on
     this model/batch shape: solves sum_{i<=gamma} a^i =
     t_verify/t_plain for a — the homogeneous-batch breakeven the
-    published tables report (the engine's live gate works on expected
-    emission instead: engine._decode_once_spec)."""
+    published tables report, and the default per-class spec-off
+    floor of the live gamma tuner (scheduler.SpecTuner;
+    ROOM_TPU_SPEC_MIN_ACCEPT overrides)."""
     ratio = spec_cost_ratio(cfg, batch, gamma, chip, mean_ctx,
                             weight_bytes, kv_bytes)
     if ratio <= 1.0:

@@ -126,6 +126,7 @@ class ModelHost:
         # host-side params (post-load/quant) cached across fleet
         # replica builds — init + checkpoint load runs once per host
         self._built_params = None
+        self._built_draft = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -229,6 +230,7 @@ class ModelHost:
                 # pinning a second multi-GB weight set for the host's
                 # lifetime (fleets keep it for replica rebuilds)
                 self._built_params = None
+                self._built_draft = None
             # warm restart (docs/lifecycle.md): rehydrate sessions a
             # previous process drained — BEFORE the serve thread owns
             # the engine (restore has engine-thread semantics). A
@@ -309,6 +311,44 @@ class ModelHost:
 
             set_ep_mesh(mesh, key=self.cfg.name)
 
+        # optional tier-2 draft model (docs/serving.md): a tiny
+        # decoder riding the SAME serving mesh as the target (the
+        # embedder convention), proposing in-window drafts for lanes
+        # where prompt-lookup finds no repeating n-gram.
+        # ROOM_TPU_DRAFT_MODEL names a models.config.DRAFT_PRESETS
+        # entry; its checkpoint loads from the shared CKPT_DIR under
+        # that name. A missing checkpoint falls back to prompt-lookup
+        # only (a randomly-initialized draft can't hurt correctness —
+        # the target's verify rejects its noise — but drafting noise
+        # burns verify width for nothing) unless random init is
+        # explicitly allowed for tiny/test configs.
+        draft = None
+        draft_name = knobs.get_str(
+            "ROOM_TPU_DRAFT_MODEL", scope="provider"
+        )
+        if draft_name:
+            if self._built_draft is None:
+                dcfg = model_configs.resolve_draft_config(
+                    draft_name, self.cfg.vocab_size
+                )
+                dckpt = checkpoint_dir(draft_name)
+                if dckpt or _random_init_allowed(draft_name):
+                    dparams = qwen3.init_params(
+                        dcfg, jax.random.PRNGKey(7)
+                    )
+                    if dckpt:
+                        from ..utils.checkpoint import load_params
+
+                        dparams = load_params(dckpt, like=dparams)
+                    self._built_draft = (dcfg, dparams)
+            if self._built_draft is not None:
+                dcfg, dparams = self._built_draft
+                if mesh is not None:
+                    dparams = shard_pytree(
+                        dparams, decoder_param_specs(dcfg), mesh
+                    )
+                draft = (dcfg, dparams)
+
         # the engine places its page pool on the same mesh as the
         # params so KV reads never cross chips
         return ServingEngine(
@@ -331,6 +371,7 @@ class ModelHost:
             spec_tokens=knobs.get_int(
                 "ROOM_TPU_SPEC_TOKENS", scope="provider"
             ),
+            draft=draft,
             # tiered KV offload ON by default in deployment
             # (docs/kv_offload.md): the room workload parks every
             # worker mid-turn for tool calls, and hibernating

@@ -107,6 +107,7 @@ _ENGINE_GAUGES = (
     "prefill_chunks_interleaved", "prefill_chunk_defers",
     "prefill_chunk_faults", "chunk_dispatches", "fused_windows",
     "fused_chunks", "spec_rounds", "spec_proposed", "spec_accepted",
+    "spec_throttles", "spec_rows_sequential",
     "queued", "sessions", "free_pages", "max_batch", "active_slots",
     # shared prefix store + disagg ships (docs/disagg.md)
     "prefix_store_hits", "prefix_store_tokens_reused",
@@ -198,6 +199,13 @@ def render_metrics() -> str:
             "Degradation-ladder rung each class experiences.",
         ),
     }
+    spec_fam = _Family(
+        "room_tpu_spec_class", "gauge",
+        "Per-traffic-class speculative decoding state "
+        "(scheduler.SpecTuner, docs/serving.md): live gamma, adapted "
+        "gamma, acceptance EMA, lifetime acceptance, proposal/accept "
+        "counters, spec-off flag, throttle/probe events.",
+    )
     pfx_fam = _Family(
         "room_tpu_prefix_store", "gauge",
         "Fleet-global shared prefix store counters per engine "
@@ -238,6 +246,18 @@ def render_metrics() -> str:
             for key, fam in cls_fams.items():
                 if row.get(key) is not None:
                     fam.add({"model": model, "class": cls}, row[key])
+        spec = e.get("spec") or {}
+        for cls, row in sorted((spec.get("classes") or {}).items()):
+            for key in ("gamma", "gamma_adapted", "accept_ema",
+                        "acceptance", "proposed", "accepted",
+                        "emitted", "off", "throttles", "probes"):
+                v = row.get(key)
+                if v is None:
+                    continue
+                spec_fam.add(
+                    {"model": model, "class": cls, "stat": key},
+                    float(v) if isinstance(v, bool) else v,
+                )
         off = e.get("offload") or {}
         for key, fam in offload_fams.items():
             if off.get(key) is not None:
@@ -259,6 +279,7 @@ def render_metrics() -> str:
     families.append(eng_fam)
     families.append(healthy_fam)
     families.extend(cls_fams.values())
+    families.append(spec_fam)
     families.extend(offload_fams.values())
     families.append(pfx_fam)
     families.append(ship_fam)

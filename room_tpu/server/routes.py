@@ -1179,6 +1179,9 @@ def register_aux_routes(r: Router) -> None:
                 # failures and trimmed overshoot
                 "steps_per_dispatch", "host_stall_ms",
                 "decode_windows", "window_faults", "overshoot_tokens",
+                # in-window speculative decoding (docs/serving.md)
+                "spec_rounds", "spec_proposed", "spec_accepted",
+                "spec_throttles",
                 # SLO scheduler (docs/scheduler.md): interleaved
                 # chunked-prefill churn
                 "prefill_chunks_interleaved", "prefill_chunk_defers",
@@ -1210,6 +1213,12 @@ def register_aux_routes(r: Router) -> None:
             # by the TPU panel's scheduler table
             if e.get("scheduler") is not None:
                 summary[name]["scheduler"] = e["scheduler"]
+            # per-class speculative decoding block (docs/serving.md):
+            # live gamma, acceptance EMA, spec-off decisions from the
+            # gamma tuner — rendered whole by the TPU panel's
+            # speculation table
+            if e.get("spec") is not None:
+                summary[name]["spec"] = e["spec"]
             # fleet blocks (docs/fleet.md): the aggregate (bare model
             # key) carries router/failover counters + per-replica
             # health scores; each model#rid key carries its replica's

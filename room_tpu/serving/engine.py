@@ -42,6 +42,7 @@ import numpy as np
 
 from ..models import qwen3
 from ..models.config import DecoderConfig
+from ..ops import spec as spec_ops
 from ..utils import knobs
 from . import faults
 from . import trace as trace_mod
@@ -54,8 +55,8 @@ from .kv_pages import (
     pallas_ragged_int8_ok, pallas_ragged_ok, use_pallas_kernel,
 )
 from .scheduler import (
-    CLASS_PRIORITY, CLASS_RANK, RequestScheduler, chunk_pages_from_env,
-    normalize_class,
+    CLASS_PRIORITY, CLASS_RANK, RequestScheduler, SpecTuner,
+    chunk_pages_from_env, normalize_class,
 )
 from .sampler import (
     SamplingParams, apply_penalties, sample_batched, spec_verify,
@@ -131,10 +132,6 @@ class Turn:
     stop_hit: Optional[str] = None        # which stop string fired
     error: Optional[str] = None
     done: threading.Event = field(default_factory=threading.Event)
-    # rolling per-token draft-acceptance estimate for this row (EMA,
-    # optimistic start so new rows probe); feeds the engine's
-    # batch-level speculation profitability gate
-    spec_accept_ema: float = 1.0
     # ---- robustness (chaos layer) ----
     # absolute monotonic deadline; past it the turn fails cleanly with
     # a timeout error instead of occupying a slot forever
@@ -245,6 +242,7 @@ class ServingEngine:
         rng_seed: int = 0,
         mesh: Optional[Any] = None,
         spec_tokens: Optional[int] = None,
+        draft: Optional[tuple] = None,
         offload: Optional[bool] = None,
         prefix_store: Optional[bool] = None,
     ) -> None:
@@ -310,49 +308,71 @@ class ServingEngine:
                 self.sched_chunk_tokens, self.prefill_chunk
             )
         self.scheduler = RequestScheduler()
-        # speculative decoding (prompt-lookup drafting): propose up to
-        # this many tokens per round from each session's own history and
-        # verify them in ONE forward — decode streams the full weight
-        # set per device call, so every accepted token divides the HBM
-        # bill. 0 disables (the chunked scan path runs instead). Greedy
-        # rows are token-identical to non-speculative decoding; sampling
-        # rows fall back to one token per round.
+        # On-mesh speculative decoding (docs/serving.md): up to
+        # spec_tokens prompt-lookup drafts are proposed PER WINDOW STEP
+        # from a device-resident recent-token tail, verified by the
+        # same step's batched forward, and accepted/rejected inside the
+        # jitted lax.scan — a spec round is a normal window step that
+        # emits up to 1+gamma tokens per lane, so speculation no longer
+        # flushes the multi-step pipeline. Decode streams the full
+        # weight set per device call, so every accepted token divides
+        # the HBM bill — multiplicatively with the pipeline's
+        # host-stall win. 0 disables (the plain scan runs). Greedy rows
+        # are token-identical to non-speculative decoding; stochastic
+        # rows keep their exact sampling distribution (spec_verify).
         # The library default stays 0; the production deployment path
-        # (providers/tpu.ModelHost) defaults to gamma=4, chosen from
-        # the bench A/B (VERDICT r2 #8).
+        # (providers/tpu.ModelHost) defaults to gamma=4 (VERDICT r2 #8).
         self.spec_tokens = spec_tokens if spec_tokens is not None else \
             knobs.get_int("ROOM_TPU_SPEC_TOKENS")
-        # Adaptive speculation gate (spec-acceptance study, round 5):
-        # the verify forward runs at fixed [max_batch, gamma+1] shape,
-        # so muting individual rows saves nothing — the decision is
-        # whether a whole ROUND is profitable: expected emission (from
-        # per-row acceptance EMAs over each row's actual draft) must
-        # clear the verify/plain cost ratio of this engine's fixed
-        # shape (roofline.spec_cost_ratio; ~2x for the 128-expert MoE
-        # at bs=8, ~1x for bandwidth-bound dense). Unprofitable rounds
-        # decode plainly for SPEC_COOLDOWN tokens/row, then one probe
-        # round refreshes the EMAs (traffic class changes mid-turn).
-        # alpha/cooldown = 0.1/16 from the replay sweep (ROUND5.md §3):
-        # worst class (prose on 30b-moe bs8) recovers 0.75x -> 0.98x
-        # while code at bs32 keeps its full 2.34x
-        self.spec_ema_alpha = knobs.get_float("ROOM_TPU_SPEC_EMA")
-        self.spec_cooldown_len = knobs.get_int("ROOM_TPU_SPEC_COOLDOWN")
-        self.spec_min_accept = knobs.get_float("ROOM_TPU_SPEC_MIN_ACCEPT")
-        # the profitability gate's cost model runs against the chip the
-        # engine actually landed on (ADVICE r5: the hard-coded V5E
-        # mis-calibrated the threshold on other generations; CPU runs
-        # fall back to V5E as the documented deployment target) and the
-        # batch's RUNNING mean context instead of a fixed 1024
-        self._chip_spec = None
-        self._spec_ratio_cache: dict[int, float] = {}
-        self._spec_ratio = 1.0
+        # device tail length the on-mesh n-gram matcher sees (host
+        # drafting read unbounded history; the tail bounds device
+        # memory/compute — repeats beyond it stop drafting, which only
+        # costs acceptance, never correctness)
+        self.spec_tail_len = max(8, knobs.get_int("ROOM_TPU_SPEC_TAIL"))
+        # optional tier-2 draft model (ROOM_TPU_DRAFT_MODEL): a tiny
+        # on-mesh decoder sharing the serving mesh, proposing where
+        # prompt-lookup found nothing; same in-window verify path.
+        # ``draft`` is (DecoderConfig, params).
+        self._draft = draft
+        self.draft_window = max(4, knobs.get_int("ROOM_TPU_DRAFT_WINDOW"))
+        if draft is not None:
+            if draft[0].vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft model {draft[0].name} vocab "
+                    f"{draft[0].vocab_size} != target vocab "
+                    f"{cfg.vocab_size}"
+                )
+        # Per-class gamma auto-tuning (scheduler.SpecTuner): each
+        # traffic class adapts its own draft depth from live window
+        # acceptance and owns its own spec-off decision — replacing the
+        # old engine-global EMA/cost-ratio gate. The off floor defaults
+        # to the roofline breakeven for this model/batch/gamma shape on
+        # the detected chip (ROOM_TPU_SPEC_MIN_ACCEPT overrides).
+        spec_min_accept = knobs.get_float("ROOM_TPU_SPEC_MIN_ACCEPT")
+        floor = 0.0
+        # when the floor is roofline-derived (no explicit override) it
+        # is re-solved at drains against the batch's LIVE mean context:
+        # at long context KV reads dominate verify and plain decode
+        # alike, the cost ratio falls toward 1, and a floor frozen at
+        # the 1024-token default would throttle drafting exactly where
+        # it is still profitable.
+        self._spec_floor_fn = None
+        self._spec_floor_in = 0
         if self.spec_tokens > 0:
-            from room_tpu.perf.roofline import detect_chip_spec
+            if spec_min_accept is not None:
+                floor = spec_min_accept
+            else:
+                from room_tpu.perf.roofline import (
+                    detect_chip_spec, spec_accept_floor,
+                )
 
-            self._chip_spec = detect_chip_spec()
-            self._spec_ratio = self._spec_ratio_for(1024.0)
-        self._spec_resume_at = 0   # tokens_decoded gate re-opens at
-        self._spec_probe = False   # one forced round after cooldown
+                chip = detect_chip_spec()
+                self._spec_floor_fn = lambda mean_ctx: spec_accept_floor(
+                    cfg, max_batch, self.spec_tokens, chip=chip,
+                    mean_ctx=mean_ctx,
+                )
+                floor = self._spec_floor_fn(1024.0)
+        self.spec_tuner = SpecTuner(self.spec_tokens, floor=floor)
 
         # ---- robustness knobs (chaos layer; docs/chaos.md) ----
         # default per-turn deadline in seconds (0 disables); submit()
@@ -579,6 +599,18 @@ class ServingEngine:
         # sampled token per slot, consumed by the next dispatch without
         # a host hop (rows with no undrained window feed from host)
         self._feed_tokens: Optional[jax.Array] = None
+        # ---- on-mesh speculative window state (docs/serving.md) ----
+        # with spec enabled a window emits a VARIABLE number of tokens
+        # per lane per step, so the device's sequence length (and each
+        # lane's remaining generation budget) can no longer be derived
+        # host-side while a window is in flight: both ride the scan
+        # carry and chain window-to-window on device, host-overridden
+        # only for fresh rows (same contract as _feed_tokens). The
+        # [max_batch, spec_tail_len] tail is what on-mesh prompt-lookup
+        # drafting matches against.
+        self._feed_lens: Optional[jax.Array] = None
+        self._feed_rem: Optional[jax.Array] = None
+        self._spec_tail_dev: Optional[jax.Array] = None
         # slot occupancy generation, bumped at every admission into the
         # slot: the drain's liveness check needs it because a parked+
         # requeued turn can re-admit into the SAME slot while the old
@@ -783,28 +815,6 @@ class ServingEngine:
 
     # ---- robustness helpers (chaos layer) ----
 
-    def _spec_ratio_for(self, mean_ctx: float) -> float:
-        """Verify/plain cost ratio for the detected chip at the given
-        mean context, cached per power-of-two context bucket so the
-        per-round cost is a dict lookup."""
-        bucket = 256
-        while bucket < mean_ctx:
-            bucket *= 2
-        got = self._spec_ratio_cache.get(bucket)
-        if got is None:
-            from room_tpu.perf.roofline import (
-                detect_chip_spec, spec_cost_ratio,
-            )
-
-            if self._chip_spec is None:
-                self._chip_spec = detect_chip_spec()
-            got = spec_cost_ratio(
-                self.cfg, self.max_batch, self.spec_tokens,
-                chip=self._chip_spec, mean_ctx=float(bucket),
-            )
-            self._spec_ratio_cache[bucket] = got
-        return got
-
     def _bump(self, key: str, n=1) -> None:
         """Counter mutation under the engine lock. stats() snapshots
         under the same lock from HTTP/route threads, so engine-thread
@@ -823,8 +833,9 @@ class ServingEngine:
         """Current rung of the degradation ladder, derived from
         pressure events in the sliding window (stateless, so recovery
         is automatic once pressure stops): 0 healthy, 1 spec decode
-        off, 2 cold sessions offloaded to host/disk, 3 admission batch
-        halved, 4 shedding."""
+        off (per class — queens keep drafting until rung 2,
+        scheduler.SpecTuner.gamma_for), 2 cold sessions offloaded to
+        host/disk, 3 admission batch halved, 4 shedding."""
         if self._forced_degradation is not None:
             return self._forced_degradation
         cutoff = time.monotonic() - self.degrade_window_s
@@ -1048,6 +1059,9 @@ class ServingEngine:
         self._staged_sids.clear()
         self._slot_ahead[:] = 0
         self._feed_tokens = None
+        self._feed_lens = None
+        self._feed_rem = None
+        self._spec_tail_dev = None
         # host/disk copies reference sessions that no longer exist (and
         # a crash mid-restore may have half-consumed one): drop them
         # all. On a FATAL supervised crash the spool dir itself must
@@ -1354,37 +1368,304 @@ class ServingEngine:
             self._jit_cache[key] = fused
         return self._jit_cache[key]
 
-    def _spec_fn(self, width: int, active_pages: Optional[int] = None):
-        """Speculative verify: one forward over [B, width] windows
-        (current token + width-1 draft tokens), KV written through the
-        paged hook at positions length..length+width-1. Verification is
-        full speculative sampling (sampler.spec_verify): greedy rows
-        reduce to exact argmax equivalence, stochastic rows keep their
-        exact sampling distribution via accept/residual draws."""
-        key = ("spec", width, active_pages)
+    def _spec_window_fn(self, n_steps: int, width: int, n_chunks: int,
+                        active_pages: Optional[int] = None,
+                        penalized: bool = False):
+        """The speculative dispatch window (docs/serving.md): one
+        compiled window whose every scan step drafts ON-MESH, verifies,
+        and emits a VARIABLE 1..width tokens per lane — no host round
+        trip, no pipeline flush.
+
+        Each step: (1) prompt-lookup proposals are matched against the
+        lane's device-resident recent-token tail (ops/spec.ngram_
+        propose — the exact host propose_ngram rule), optionally backed
+        by the tiny on-mesh draft model for lanes where no n-gram
+        repeats; per-lane draft depth is clamped by the class gamma
+        (``gamma_caps``) and the lane's remaining generation budget.
+        (2) one [B, width] forward writes KV at positions
+        lens..lens+width-1 and yields verify logits. (3) sampler.
+        spec_verify accepts the longest draft prefix (greedy rows:
+        exact tie-banded argmax equivalence; stochastic rows: exact
+        speculative sampling), the bonus/residual token is appended,
+        and lens/tail/budget advance by the emitted count. Rejected
+        positions' KV sits past the advanced length and is overwritten
+        by the next step's writes (lens' + width >= lens + width, so
+        nothing stale is ever attended).
+
+        ``width == 1`` compiles the degenerate no-drafting variant
+        (every class at gamma 0) that still maintains the device
+        lens/tail chain; ``n_chunks > 0`` fuses the scheduler window's
+        staged prefill chunks into step 0 exactly like _fused_fn (step
+        0 then emits one token per lane — drafting starts at step 1).
+
+        The ring is [n_steps, B, width] (pad-filled past each step's
+        emission) with sibling [n_steps, B] emitted/drafted counts the
+        host drains asynchronously."""
+        use_draft = self._draft is not None and width > 1
+        key = ("spec_window", n_steps, width, n_chunks, active_pages,
+               penalized, use_draft)
         if key not in self._jit_cache:
             cfg = self.cfg
+            pad_id = self.tokenizer.pad_id
+            b = self.max_batch
+            gamma = width - 1
+            cw = self.sched_chunk_tokens
+            dcfg = self._draft[0] if use_draft else None
+            dwindow = self.draft_window
 
-            @partial(jax.jit, donate_argnums=(1,))
-            def spec(params, cache, tokens, block_tables, lengths, rng,
-                     temperature, top_p, top_k):
+            def spec_step(params, cache, cnts, toks, lens, rem, cov,
+                          tail, active_mask, gamma_caps, block_tables,
+                          step_rng, temperature, top_p, top_k,
+                          presence, frequency, draft_params):
+                """One in-window speculative step (traced inside the
+                scan): draft -> verify -> accept -> advance."""
+                if gamma > 0:
+                    # clamp draft depth by the remaining generation
+                    # budget AND the row's reserved page coverage
+                    # (``cov``, absolute): an accepted token must have
+                    # real KV, and positions past the reservation
+                    # divert to scratch — so never accept into them.
+                    # This keeps the device's length advance inside
+                    # max(reserved, steps), which is what lets the
+                    # host's _slot_ahead bound stay tight under pool
+                    # pressure instead of booking gamma-inflated pages
+                    # it can never use.
+                    depth_cap = jnp.minimum(
+                        jnp.maximum(rem - 1, 0),
+                        jnp.maximum(cov - lens - 1, 0),
+                    )
+                    n_raw, prop = spec_ops.ngram_propose(tail, gamma)
+                    n_prop = jnp.minimum(
+                        jnp.minimum(n_raw, gamma_caps), depth_cap
+                    )
+                    if use_draft:
+                        dm = spec_ops.draft_propose(
+                            draft_params, dcfg, tail, gamma, dwindow
+                        )
+                        use_dm = (n_prop == 0) & (gamma_caps > 0) & \
+                            (depth_cap > 0)
+                        prop = jnp.where(use_dm[:, None], dm, prop)
+                        n_prop = jnp.where(
+                            use_dm,
+                            jnp.minimum(gamma_caps, depth_cap),
+                            n_prop,
+                        )
+                    n_prop = jnp.where(active_mask, n_prop, 0)
+                    jg = jnp.arange(gamma)[None]
+                    draft_mask = jg < n_prop[:, None]
+                    ver = jnp.concatenate(
+                        [toks[:, None],
+                         jnp.where(draft_mask, prop, jnp.int32(pad_id))],
+                        axis=1,
+                    )
+                else:
+                    n_prop = jnp.zeros((b,), jnp.int32)
+                    ver = toks[:, None]
                 hook = make_paged_kv_hook(
-                    block_tables, lengths, self.page_size,
+                    block_tables, lens, self.page_size,
                     active_pages=active_pages,
-                    pallas_prefill=self._pallas_prefill,
+                    pallas_prefill=self._pallas_prefill
+                    if width > 1 else None,
                 )
-                positions = lengths[:, None] + jnp.arange(width)
+                positions = lens[:, None] + jnp.arange(width)
                 logits, cache = qwen3.forward(
-                    params, cfg, tokens, positions, cache, kv_hook=hook,
+                    params, cfg, ver, positions, cache, kv_hook=hook,
                 )
-                accept, residual, plain = spec_verify(
-                    logits, tokens[:, 1:], rng,
-                    temperature, top_p, top_k,
+                logits = logits.astype(jnp.float32)
+                if penalized:
+                    # penalties apply to the lane's NEXT-token logits;
+                    # penalized lanes never draft (gamma_caps 0 at
+                    # dispatch), so position 0 is the only one sampled
+                    logits = logits.at[:, 0].set(apply_penalties(
+                        logits[:, 0], cnts, presence, frequency,
+                    ))
+                if gamma > 0:
+                    accept, residual, plain = spec_verify(
+                        logits, ver[:, 1:], step_rng,
+                        temperature, top_p, top_k,
+                    )
+                    acc = jnp.cumprod(
+                        (accept & (jnp.arange(gamma)[None]
+                                   < n_prop[:, None])).astype(jnp.int32),
+                        axis=1,
+                    )
+                    n_acc = acc.sum(axis=1)
+                    bonus = jnp.where(
+                        n_acc < n_prop,
+                        jnp.take_along_axis(
+                            residual,
+                            jnp.minimum(n_acc, gamma - 1)[:, None],
+                            axis=1,
+                        )[:, 0],
+                        jnp.take_along_axis(
+                            plain, jnp.minimum(n_prop, gamma)[:, None],
+                            axis=1,
+                        )[:, 0],
+                    )
+                    widx = jnp.arange(width)[None]
+                    ext = jnp.concatenate(
+                        [ver[:, 1:],
+                         jnp.full((b, 1), pad_id, jnp.int32)], axis=1,
+                    )
+                    emitted = jnp.where(
+                        widx < n_acc[:, None], ext,
+                        jnp.where(widx == n_acc[:, None],
+                                  bonus[:, None], jnp.int32(pad_id)),
+                    )
+                else:
+                    n_acc = jnp.zeros((b,), jnp.int32)
+                    bonus = sample_batched(
+                        logits[:, 0], step_rng,
+                        temperature, top_p, top_k,
+                    )
+                    emitted = bonus[:, None]
+                    widx = jnp.arange(width)[None]
+                emitted = jnp.where(
+                    active_mask[:, None], emitted, jnp.int32(pad_id)
                 )
-                return accept, residual, plain, \
-                    self._constrain_cache(cache)
+                emit_n = jnp.where(active_mask, n_acc + 1, 1) \
+                    .astype(jnp.int32)
+                if penalized:
+                    upd = (widx < emit_n[:, None]) & active_mask[:, None]
+                    cnts = cnts.at[
+                        jnp.arange(b)[:, None], emitted
+                    ].add(upd.astype(jnp.int32))
+                new_toks = jnp.where(
+                    active_mask, bonus, jnp.int32(pad_id)
+                ).astype(jnp.int32)
+                lens = lens + emit_n
+                rem = jnp.where(
+                    active_mask, jnp.maximum(rem - emit_n, 0), rem
+                )
+                tail = spec_ops.shift_tail(tail, emitted, emit_n)
+                return cache, cnts, new_toks, lens, rem, tail, \
+                    emitted, emit_n, n_prop
 
-            self._jit_cache[key] = spec
+            @partial(jax.jit,
+                     donate_argnums=(1, 2) if penalized else (1,))
+            def specwin(params, cache, counts, draft_params,
+                        prev_tokens, fresh_tokens, fresh_mask,
+                        active_mask, gamma_caps, coverage,
+                        block_tables,
+                        host_lengths, prev_lens, fresh_rem, prev_rem,
+                        fresh_tails, prev_tail, rng,
+                        temperature, top_p, top_k, presence, frequency,
+                        chunk_tokens, chunk_tables, chunk_lens):
+                toks = jnp.where(fresh_mask, fresh_tokens, prev_tokens)
+                lens = jnp.where(fresh_mask, host_lengths, prev_lens)
+                rem = jnp.where(fresh_mask, fresh_rem, prev_rem)
+                tail = jnp.where(
+                    fresh_mask[:, None], fresh_tails, prev_tail
+                )
+                keys = jax.random.split(rng, n_steps)
+                rings = []
+                if n_chunks > 0:
+                    # fused step 0: the ragged [decode-lanes +
+                    # chunk-rows] forward, exactly _fused_fn's — one
+                    # token per lane, drafting starts at step 1
+                    flat = jnp.concatenate(
+                        [toks, chunk_tokens.reshape(-1)]
+                    )[None]
+                    pos = jnp.concatenate([
+                        lens,
+                        (chunk_lens[:, None]
+                         + jnp.arange(cw)).reshape(-1),
+                    ])[None]
+                    tables_r = jnp.concatenate(
+                        [block_tables, chunk_tables], axis=0
+                    )
+                    prefix_r = jnp.concatenate([lens, chunk_lens])
+                    hook = make_ragged_kv_hook(
+                        tables_r, prefix_r, self.page_size,
+                        n_decode=b, n_chunks=n_chunks, chunk_width=cw,
+                        active_pages=active_pages,
+                        pallas_ragged=self._pallas_ragged,
+                        q_block=self.ragged_qblock,
+                    )
+                    hidden, cache = qwen3.forward(
+                        params, cfg, flat, pos, cache, kv_hook=hook,
+                        apply_head=False,
+                    )
+                    logits0 = qwen3.lm_head(
+                        params, cfg, hidden[0, :b][:, None]
+                    )[:, 0].astype(jnp.float32)
+                    if penalized:
+                        logits0 = apply_penalties(
+                            logits0, counts, presence, frequency,
+                        )
+                    nxt0 = sample_batched(
+                        logits0, keys[0], temperature, top_p, top_k
+                    )
+                    nxt0 = jnp.where(
+                        active_mask, nxt0, jnp.int32(pad_id)
+                    ).astype(jnp.int32)
+                    if penalized:
+                        counts = counts.at[
+                            jnp.arange(b), nxt0
+                        ].add(active_mask.astype(jnp.int32))
+                    emitted0 = jnp.concatenate([
+                        nxt0[:, None],
+                        jnp.full((b, width - 1), pad_id, jnp.int32),
+                    ], axis=1) if width > 1 else nxt0[:, None]
+                    emit0 = jnp.ones((b,), jnp.int32)
+                    toks = nxt0
+                    lens = lens + 1
+                    rem = jnp.where(
+                        active_mask, jnp.maximum(rem - 1, 0), rem
+                    )
+                    tail = spec_ops.shift_tail(tail, emitted0, emit0)
+                    rings.append(
+                        (emitted0, emit0, jnp.zeros((b,), jnp.int32))
+                    )
+                    step_keys = keys[1:]
+                else:
+                    step_keys = keys
+
+                def step(carry, step_rng):
+                    toks, cache, lens, rem, tail, cnts = carry
+                    cache, cnts, toks, lens, rem, tail, emitted, \
+                        emit_n, n_prop = spec_step(
+                            params, cache, cnts, toks, lens, rem,
+                            coverage, tail, active_mask, gamma_caps,
+                            block_tables, step_rng, temperature,
+                            top_p, top_k, presence, frequency,
+                            draft_params,
+                        )
+                    return (toks, cache, lens, rem, tail, cnts), \
+                        (emitted, emit_n, n_prop)
+
+                if len(step_keys):
+                    (toks, cache, lens, rem, tail, counts), \
+                        (ring_s, emits_s, drafted_s) = jax.lax.scan(
+                            step,
+                            (toks, cache, lens, rem, tail, counts),
+                            step_keys,
+                        )
+                    if rings:
+                        e0, n0, d0 = rings[0]
+                        ring_s = jnp.concatenate(
+                            [e0[None], ring_s], axis=0
+                        )
+                        emits_s = jnp.concatenate(
+                            [n0[None], emits_s], axis=0
+                        )
+                        drafted_s = jnp.concatenate(
+                            [d0[None], drafted_s], axis=0
+                        )
+                else:
+                    e0, n0, d0 = rings[0]
+                    ring_s = e0[None]
+                    emits_s = n0[None]
+                    drafted_s = d0[None]
+                return (
+                    ring_s.transpose(1, 0, 2),   # [B, steps, width]
+                    emits_s.T,                   # [B, steps]
+                    drafted_s.T,                 # [B, steps]
+                    toks, lens, rem, tail, counts,
+                    self._constrain_cache(cache),
+                )
+
+            self._jit_cache[key] = specwin
         return self._jit_cache[key]
 
     @staticmethod
@@ -1686,6 +1967,18 @@ class ServingEngine:
         sched = self.scheduler.snapshot(out["degradation_level"])
         sched["chunk_tokens"] = self.sched_chunk_tokens
         out["scheduler"] = sched
+        # on-mesh speculative decoding (docs/serving.md): per-class
+        # live gamma, acceptance EMA, and off decisions from the tuner
+        out["spec"] = {
+            "gamma_max": self.spec_tokens,
+            "tail_tokens": self.spec_tail_len,
+            "accept_floor": round(self.spec_tuner.floor, 4),
+            "draft_model": self._draft[0].name
+            if self._draft is not None else None,
+            "classes": self.spec_tuner.snapshot(
+                out["degradation_level"]
+            ),
+        }
         out["offload"] = self.offload_store.stats() \
             if self.offload_store is not None else None
         out["prefix_store"] = self.prefix_store.stats() \
@@ -3016,9 +3309,9 @@ class ServingEngine:
         """Block tables + lengths for a device call only ``active_idx``
         rows participate in. Any OTHER still-active row is diverted to
         the scratch page: its slot arrays can be stale (the session
-        advanced since its last reserve — e.g. a penalized row sitting
-        out a spec round, or a spec row sitting out the penalty scan),
-        so letting the forward write its garbage KV at the recorded
+        advanced since its last reserve — e.g. a row sitting out a
+        window at capacity until its covering drain settles it), so
+        letting the forward write its garbage KV at the recorded
         position would corrupt KV that is already valid."""
         tables = self._slot_tables
         lengths = self._slot_lengths
@@ -3116,50 +3409,16 @@ class ServingEngine:
                 self._dispatch_staged_chunks()
                 return 1
             return 0
-        # spec verify has no penalty path: penalized rows take the
-        # sequential scan (their counts stay exact) while the rest of
-        # the batch still rides spec — one tenant's sampling knobs must
-        # not cut every batchmate's decode throughput (ADVICE r3)
-        # ladder rung 1: speculation off under pressure — verify rounds
-        # amplify device load exactly when the engine can least afford it
-        n_spec = 0
-        spec_ran = False
-        if active_idx and self.spec_tokens > 0 and \
-                self._stats["tokens_decoded"] >= self._spec_resume_at \
-                and self.degradation_level() < 1:
-            # drafting reads each session's host-side history, which an
-            # undrained window is still ahead of: speculation composes
-            # with the pipeline by flushing it at the round boundary
-            # (spec rounds are themselves one-dispatch-one-drain)
-            self._flush_pipeline()
-            active_idx = [
-                i for i, t in enumerate(self._active) if t is not None
-            ]
-            spec_rows = [
-                i for i in active_idx
-                if not self._active[i].sampling.penalized
-            ]
-            pen_rows = [i for i in active_idx if i not in spec_rows]
-            if spec_rows:
-                r = self._decode_once_spec(list(spec_rows))
-                if r is not None:
-                    spec_ran = True
-                    if not pen_rows:
-                        return r
-                    n_spec = r
-                    self._bump("spec_rows_sequential", len(pen_rows))
-                    # the scan below runs for the penalized rows only;
-                    # _slot_arrays_excluding diverts the spec rows (now
-                    # stale) to the scratch page
-                    active_idx = pen_rows
-                # None: no row drafted anything; the windowed scan below
-                # advances the whole batch together (it amortizes host
-                # round-trips)
-
-        if self.steps_per_dispatch == 1 or spec_ran:
-            # legacy / spec-mixed iteration: dispatch + blocking drain
-            # (a spec round already forced a flush, and its slot state
-            # must not run a window ahead of the next round's drafts)
+        # speculation rides INSIDE the window (docs/serving.md): each
+        # scan step drafts on-mesh from the device-resident tail and
+        # verifies in the same batched forward, so a spec round is a
+        # normal window step emitting up to 1+gamma tokens per lane —
+        # no flush, no host round trip, no sequential split for
+        # penalized batchmates (their lanes simply run at gamma 0).
+        # Per-class gamma (and the ladder's per-class spec-off rung)
+        # is resolved at dispatch time in _dispatch_window.
+        if self.steps_per_dispatch == 1:
+            # legacy iteration: dispatch + blocking drain
             window = None
             if active_idx:
                 try:
@@ -3169,8 +3428,8 @@ class ServingEngine:
                         raise   # decode_step budget: crash supervisor
                     self._fail_window_turns(active_idx, e)
             if window is None:
-                return n_spec
-            return n_spec + self._drain_window(window)
+                return 0
+            return self._drain_window(window)
 
         prev, self._inflight = self._inflight, None
         window_fault: Optional[FaultError] = None
@@ -3203,10 +3462,10 @@ class ServingEngine:
             self._fail_window_turns(active_idx, window_fault)
         active_now = sum(1 for t in self._active if t is not None)
         if active_now == 0 and self._inflight is None:
-            return n_spec
+            return 0
         # non-zero while a window is still in flight so serve_forever /
         # run_until_idle never declare idle with tokens on device
-        return n_spec + max(n, active_now, 1)
+        return max(n, active_now, 1)
 
     def _fail_window_turns(self, active_idx: list[int],
                            err: FaultError) -> None:
@@ -3236,7 +3495,7 @@ class ServingEngine:
     def _dispatch_staged_chunks(self) -> None:
         """Land staged chunk writes in ONE batched device dispatch when
         there is no decode window to fuse them with (idle batch,
-        spec-round boundary, pipeline flush, shutdown). A dispatch
+        pipeline flush, shutdown). A dispatch
         fault past the retry budget rolls the staged turns back to
         their last durable chunk boundary — committed chunks stay, the
         already-queued turns re-prepare from the boundary, pages stay
@@ -3367,18 +3626,38 @@ class ServingEngine:
         penalized = any(
             self._active[i].sampling.penalized for i in active_idx
         )
+        # on-mesh speculation (docs/serving.md): per-row draft depth is
+        # the row's CLASS gamma (scheduler.SpecTuner — live acceptance
+        # adaptation + the per-class ladder spec-off rung), zero for
+        # penalized rows (their [B, V] counts must advance one exact
+        # token per sampled position). The compiled window width is
+        # 1 + max over the batch; narrower rows mask their extra draft
+        # slots, so heterogeneous classes share one dispatch.
+        spec_on = self.spec_tokens > 0
+        gammas = np.zeros((self.max_batch,), np.int32)
+        if spec_on:
+            raw_level = self.degradation_level()
+            for i in active_idx:
+                t = self._active[i]
+                if t.sampling.penalized:
+                    continue
+                gammas[i] = self.spec_tuner.gamma_for(
+                    t.turn_class, raw_level
+                )
         # ensure pages only for tokens the turn can actually accept:
-        # min(steps, its remaining budget net of undrained positions),
-        # clamped to capacity. The scan still writes `steps` positions;
-        # writes past the reservation divert to scratch and the host
-        # trims the overshoot at drain.
+        # min(per-step emission ceiling x steps, its remaining budget
+        # net of undrained positions), clamped to capacity. The scan
+        # still writes its full width of positions; writes past the
+        # reservation divert to scratch and the host trims at drain.
         for i in list(active_idx):
             turn = self._active[i]
             remaining = max(
                 turn.sampling.max_new_tokens - len(turn.new_tokens)
                 - int(self._slot_ahead[i]), 1
             )
-            if not self._reserve_slot(i, min(steps, remaining)):
+            want = min(steps * (1 + int(gammas[i])), remaining) \
+                if spec_on else min(steps, remaining)
+            if not self._reserve_slot(i, want):
                 active_idx.remove(i)
         if not active_idx:
             if self._staged_chunks:
@@ -3419,13 +3698,19 @@ class ServingEngine:
         # fused window taking the gather reference must also cover the
         # staged chunks' reach.
         cw = self.sched_chunk_tokens
+        width = 1 + (int(gammas[active_idx].max()) if spec_on else 0)
         ap = None
-        if not self._pallas_decode or \
+        # the S>1 verify steps of a drafting window gather unless the
+        # Pallas prefill kernel covers their width — same bound rule as
+        # chunked prefill
+        spec_gather = width > 1 and \
+            not (self._pallas_prefill and width % 8 == 0)
+        if not self._pallas_decode or spec_gather or \
                 (staged and not self._pallas_ragged):
             max_len = max(
                 int(self._slot_lengths[i]) for i in active_idx
             )
-            reach = max_len + steps
+            reach = max_len + steps * width
             if staged:
                 reach = max(reach, max(
                     int(r["base_len"]) for r in staged
@@ -3447,6 +3732,7 @@ class ServingEngine:
             counts = jnp.int32(0)
             pen_args = (jnp.float32(0), jnp.float32(0))
         chunk_args: tuple = ()
+        c_pad = 0
         if staged:
             # fused window: the staged chunk batch rides this dispatch
             c_pad = self._pow2(len(staged))
@@ -3466,45 +3752,131 @@ class ServingEngine:
                 jnp.asarray(chunk_tables),
                 jnp.asarray(chunk_lens),
             )
-            decode = self._fused_fn(steps, c_pad, ap, penalized)
-        else:
-            decode = self._decode_fn(steps, ap, penalized)
         scan_tables, scan_lengths = \
             self._slot_arrays_excluding(active_idx)
         self._key, sub = jax.random.split(self._key)
 
-        def call():
-            # chaos fault points: decode_window fails ONLY this
-            # window's turns (caught below); decode_step models a
-            # transient device error retried with backoff and escalates
-            # to the crash supervisor past its budget; decode_stall
-            # injects latency that trips the watchdog
-            faults.maybe_fail("decode_window")
-            faults.maybe_fail("decode_step")
-            faults.maybe_delay("decode_stall")
-            return decode(
-                self.params,
-                self.cache,
-                counts,
-                self._feed_tokens,
-                self._place_batch(fresh_tokens),
-                self._place_batch(fresh_mask),
-                self._place_batch(active_mask),
-                self._place_batch(scan_tables),
-                self._place_batch(scan_lengths),
-                sub,
-                self._place_batch(temps),
-                self._place_batch(top_ps),
-                self._place_batch(top_ks),
-                *pen_args,
-                *chunk_args,
+        if spec_on:
+            # host-owned seeds for rows whose device chain broke (new
+            # admission / first window): sequence length, remaining
+            # generation budget, and the recent-token tail drafting
+            # matches against. Continuing rows carry all three on
+            # device — the host cannot know them while a variable-
+            # emission window is in flight, which is exactly why the
+            # old spec path had to flush.
+            tail_t = self.spec_tail_len
+            fresh_rem = np.zeros((self.max_batch,), np.int32)
+            fresh_tails = np.full(
+                (self.max_batch, tail_t), spec_ops.TAIL_PAD, np.int32
             )
+            for i in active_idx:
+                if not fresh_mask[i]:
+                    continue
+                t = self._active[i]
+                sess = self.sessions[t.session_id]
+                fresh_rem[i] = max(
+                    t.sampling.max_new_tokens - len(t.new_tokens), 1
+                )
+                fresh_tails[i] = spec_ops.seed_tail(
+                    sess.history[-tail_t:] + [int(fresh_tokens[i])],
+                    tail_t,
+                )
+            if self._feed_lens is None or self._spec_tail_dev is None:
+                zeros = np.zeros((self.max_batch,), np.int32)
+                self._feed_lens = self._place_batch(zeros)
+                self._feed_rem = self._place_batch(zeros)
+                self._spec_tail_dev = self._place_batch(
+                    np.full((self.max_batch, tail_t),
+                            spec_ops.TAIL_PAD, np.int32)
+                )
+            # absolute reserved-coverage cap per row: on-device
+            # drafting never accepts into a position past it
+            coverage = np.zeros((self.max_batch,), np.int32)
+            for i in active_idx:
+                coverage[i] = int(self._slot_lengths[i]) \
+                    + int(self._reserved_tokens[i])
+            specwin = self._spec_window_fn(
+                steps, width, c_pad, ap, penalized
+            )
+            draft_params = self._draft[1] if self._draft is not None \
+                else jnp.int32(0)
+            spec_chunk_args = chunk_args if staged else (
+                jnp.int32(0), jnp.int32(0), jnp.int32(0)
+            )
+
+            def call():
+                # chaos fault points: same contract as the plain window
+                faults.maybe_fail("decode_window")
+                faults.maybe_fail("decode_step")
+                faults.maybe_delay("decode_stall")
+                return specwin(
+                    self.params,
+                    self.cache,
+                    counts,
+                    draft_params,
+                    self._feed_tokens,
+                    self._place_batch(fresh_tokens),
+                    self._place_batch(fresh_mask),
+                    self._place_batch(active_mask),
+                    self._place_batch(gammas),
+                    self._place_batch(coverage),
+                    self._place_batch(scan_tables),
+                    self._place_batch(scan_lengths),
+                    self._feed_lens,
+                    self._place_batch(fresh_rem),
+                    self._feed_rem,
+                    self._place_batch(fresh_tails),
+                    self._spec_tail_dev,
+                    sub,
+                    self._place_batch(temps),
+                    self._place_batch(top_ps),
+                    self._place_batch(top_ks),
+                    *pen_args,
+                    *spec_chunk_args,
+                )
+        else:
+            if staged:
+                decode = self._fused_fn(steps, c_pad, ap, penalized)
+            else:
+                decode = self._decode_fn(steps, ap, penalized)
+
+            def call():
+                # chaos fault points: decode_window fails ONLY this
+                # window's turns (caught below); decode_step models a
+                # transient device error retried with backoff and
+                # escalates to the crash supervisor past its budget;
+                # decode_stall injects latency that trips the watchdog
+                faults.maybe_fail("decode_window")
+                faults.maybe_fail("decode_step")
+                faults.maybe_delay("decode_stall")
+                return decode(
+                    self.params,
+                    self.cache,
+                    counts,
+                    self._feed_tokens,
+                    self._place_batch(fresh_tokens),
+                    self._place_batch(fresh_mask),
+                    self._place_batch(active_mask),
+                    self._place_batch(scan_tables),
+                    self._place_batch(scan_lengths),
+                    sub,
+                    self._place_batch(temps),
+                    self._place_batch(top_ps),
+                    self._place_batch(top_ks),
+                    *pen_args,
+                    *chunk_args,
+                )
 
         t0 = time.monotonic()
         try:
             with self.timer.phase("decode"):
-                ring, counts_out, self.cache = \
-                    self._retrying("decode", call)
+                if spec_on:
+                    (ring, emits_d, drafted_d, feed_toks, feed_lens,
+                     feed_rem, tail_out, counts_out, self.cache) = \
+                        self._retrying("decode", call)
+                else:
+                    ring, counts_out, self.cache = \
+                        self._retrying("decode", call)
         except FaultError as e:
             # a fused window's staged chunk KV never landed: roll the
             # chunk turns back to their last durable boundary (their
@@ -3523,16 +3895,40 @@ class ServingEngine:
             self._commit_staged(staged, fused=True)
         if penalized:
             self._counts = counts_out
-        # the ring tail feeds the next dispatch without a host hop
-        self._feed_tokens = ring[:, -1]
+        if spec_on:
+            # device-resident chain for the next dispatch: last emitted
+            # token, sequence length, remaining budget, drafting tail
+            self._feed_tokens = feed_toks
+            self._feed_lens = feed_lens
+            self._feed_rem = feed_rem
+            self._spec_tail_dev = tail_out
+        else:
+            # the ring tail feeds the next dispatch without a host hop
+            self._feed_tokens = ring[:, -1]
         # start the device->host copy NOW so it overlaps whatever the
         # host does before the drain materializes it
         try:
             ring.copy_to_host_async()
+            if spec_on:
+                emits_d.copy_to_host_async()
+                drafted_d.copy_to_host_async()
         except AttributeError:
             pass
+        # the device's view of each row runs ahead by the window's
+        # per-row emission CEILING (actual emission is data-dependent;
+        # the drain reconciles) — reservations for the next window
+        # address this upper bound, so nothing host-side ever lags the
+        # device's real write positions. With spec the ceiling is
+        # max(reserved, steps): accepted drafts are coverage-clamped on
+        # device, and a bonus-only chain past coverage advances one
+        # position per step like the plain scan.
+        ahead = {
+            i: max(int(self._reserved_tokens[i]), steps)
+            if spec_on else steps
+            for i in active_idx
+        }
         for i in active_idx:
-            self._slot_ahead[i] += steps
+            self._slot_ahead[i] += ahead[i]
         self._bump("decode_steps")
         self._bump("decode_windows")
         # turnscope: bill this window's dispatch wall to every turn
@@ -3545,6 +3941,9 @@ class ServingEngine:
                 t.trace.note_window(dispatch_s)
         return {
             "ring": ring,
+            "spec": spec_on,
+            "emits": emits_d if spec_on else None,
+            "drafted": drafted_d if spec_on else None,
             "active_idx": list(active_idx),
             "turns": {i: self._active[i] for i in active_idx},
             "gen": {i: int(self._slot_gen[i]) for i in active_idx},
@@ -3554,6 +3953,16 @@ class ServingEngine:
             "reserved": {
                 i: int(self._reserved_tokens[i]) for i in active_idx
             },
+            # absolute session position each row's page reservation
+            # covers (spec windows start below the host base when a
+            # prior window under-emitted, so the durability bound is
+            # absolute, not an offset)
+            "limit": {
+                i: int(self._slot_lengths[i])
+                + int(self._reserved_tokens[i])
+                for i in active_idx
+            },
+            "ahead": ahead,
             "steps": steps,
             # time spent inside the decode dispatch itself (injected
             # stalls, retry backoff, this function's own jit compile) —
@@ -3571,6 +3980,8 @@ class ServingEngine:
         an earlier drain, deadline, requeue) are overshoot — their
         tokens are trimmed and their KV writes sit past the recorded
         session length, overwritten on resume."""
+        if window.get("spec"):
+            return self._drain_window_spec(window)
         t0 = time.monotonic()
         with self.timer.phase("decode_drain"):
             ring_host = np.asarray(window["ring"])   # [B, steps]
@@ -3647,226 +4058,151 @@ class ServingEngine:
         self._handle_stall(live_idx, window["dispatch_s"] + wait_s)
         return len(live_idx)
 
-    def _decode_once_spec(self, active_idx: list[int]) -> Optional[int]:
-        """One speculative round: active slots draft continuation tokens
-        from their own history (prompt-lookup), one forward verifies the
-        whole window via speculative sampling (sampler.spec_verify) —
-        greedy rows keep the longest draft prefix matching the model's
-        own argmax (token-identical to sequential decoding); stochastic
-        rows accept each draft with the target distribution's own
-        probability and emit a residual draw on rejection (exactly
-        preserving their sampling distribution). Every accepted token
-        amortizes the per-call weight streaming. KV for rejected draft
-        positions sits past the session length and is overwritten by
-        later writes (the same overrun contract as the chunked scan
-        path).
+    def _drain_window_spec(self, window: dict) -> int:
+        """Drain a speculative window: variable tokens per step per
+        lane. The ring is [B, steps, width] with sibling emitted/
+        drafted counts; each consumed token's KV sits at the session's
+        running length (accepted drafts were written by the verify
+        forward that accepted them; the bonus/residual token is
+        pending, written by the next step as its feed — the same
+        contract as every other decode path). Tokens whose position
+        reaches the row's page-reservation limit attended scratch KV:
+        the row parks on the last durable token, exactly the degraded-
+        reservation rule of the plain drain.
 
-        Returns None (caller runs the chunked scan path, which
-        amortizes host round-trips) when no row drafted anything — i.e.
-        no active context has a repeating n-gram this round."""
-        gamma = self.spec_tokens
-        width = gamma + 1
-
-        # draft first: any row with token budget proposes (greedy rows
-        # verify by argmax; stochastic rows by speculative sampling —
-        # both exactly preserve their decoding distribution)
-        drafts: dict[int, tuple[int, list[int]]] = {}
-        n_proposed = 0
-        for i in active_idx:
-            t = self._active[i]
-            sess = self.sessions[t.session_id]
-            last = t.new_tokens[-1] if t.new_tokens else \
-                t.prompt_tokens[-1]
-            p: list[int] = []
-            remaining = t.sampling.max_new_tokens - len(t.new_tokens)
-            if remaining > 1:
-                p = propose_ngram(
-                    sess.history + [last], min(gamma, remaining - 1)
-                )
-            drafts[i] = (last, p)
-            n_proposed += len(p)
-        if n_proposed == 0:
-            # nothing draftable this round. In pipelined mode the probe
-            # itself cost a full pipeline flush, so close the gate for
-            # a cooldown (the same bound an unprofitable round pays)
-            # instead of re-flushing every iteration on non-repetitive
-            # traffic — otherwise spec_tokens>0 (the deployment
-            # default) would silently disable the dispatch-window
-            # overlap exactly where it matters. Legacy mode keeps the
-            # zero-cost every-round probe.
-            if self.steps_per_dispatch > 1:
-                self._spec_resume_at = (
-                    self._stats["tokens_decoded"]
-                    + self.spec_cooldown_len * len(active_idx)
-                )
-            return None
-
-        # round-profitability gate: expected emission this round (per
-        # row: the bonus token + sum ema^i over its actual draft) must
-        # clear the fixed-shape verify/plain cost ratio, or the round
-        # decodes plainly and the gate closes for a cooldown. With
-        # ROOM_TPU_SPEC_MIN_ACCEPT set, the gate compares the
-        # draft-weighted mean EMA against that floor instead.
-        if self._spec_probe:
-            self._spec_probe = False  # forced EMA-refresh round
-        else:
-            n_act = len(active_idx)
-            if self.spec_min_accept is not None:
-                prop_tot = sum(len(drafts[i][1]) for i in active_idx)
-                mean_acc = sum(
-                    self._active[i].spec_accept_ema * len(drafts[i][1])
-                    for i in active_idx
-                ) / max(prop_tot, 1)
-                profitable = mean_acc >= self.spec_min_accept
-            else:
-                exp_emit = 0.0
-                for i in active_idx:
-                    ema = self._active[i].spec_accept_ema
-                    exp_emit += 1.0 + sum(
-                        ema ** k
-                        for k in range(1, len(drafts[i][1]) + 1)
-                    )
-                # cost ratio for the detected chip at the batch's
-                # actual mean context (ADVICE r5: a fixed V5E@1024
-                # threshold mis-gates other generations / long context)
-                mean_ctx = max(1.0, float(np.mean([
-                    self.sessions[self._active[i].session_id].length
-                    for i in active_idx
-                ])))
-                self._spec_ratio = self._spec_ratio_for(mean_ctx)
-                profitable = exp_emit >= self._spec_ratio * n_act
-            if not profitable:
-                self._bump("spec_throttles")
-                self._spec_resume_at = (
-                    self._stats["tokens_decoded"]
-                    + self.spec_cooldown_len * n_act
-                )
-                self._spec_probe = True
-                return None
-
-        # reserve only what each row can actually consume: its drafts'
-        # KV plus the current token (the bonus token stays pending)
-        max_accept: dict[int, int] = {}
-        for i in list(active_idx):
-            sess = self.sessions[self._active[i].session_id]
-            if not self._reserve_slot(i, 1 + len(drafts[i][1])):
-                active_idx.remove(i)
-                continue
-            # accepted tokens must have real KV: cap by the headroom
-            # actually reserved (degrade path may have given only 1)
-            max_accept[i] = max(
-                0, min(len(drafts[i][1]),
-                       int(self._reserved_tokens[i]) - 1)
-            )
-        if not active_idx:
-            return 0
-
-        tokens = np.zeros((self.max_batch, width), np.int32)
-        props: dict[int, list[int]] = {}
-        for i in active_idx:
-            last, p = drafts[i]
-            p = p[: max_accept[i]]
-            props[i] = p
-            tokens[i, 0] = last
-            tokens[i, 1:1 + len(p)] = p
-
-        temps = np.ones((self.max_batch,), np.float32)
-        top_ps = np.ones((self.max_batch,), np.float32)
-        top_ks = np.zeros((self.max_batch,), np.int32)
-        for i in active_idx:
-            sp = self._active[i].sampling
-            temps[i] = sp.temperature
-            top_ps[i] = sp.top_p
-            top_ks[i] = sp.top_k
-
-        # the S>1 verify forward gathers unless the Pallas prefill
-        # kernel covers its width: bound the gather to the batch's reach
-        ap = None
-        if not (self._pallas_prefill and width % 8 == 0):
-            max_len = max(
-                int(self._slot_lengths[i]) for i in active_idx
-            )
-            ap = self._pages_bucket(max_len + width)
-        spec = self._spec_fn(width, ap)
-        spec_tables, spec_lengths = \
-            self._slot_arrays_excluding(active_idx)
-        self._key, sub = jax.random.split(self._key)
-
-        def call():
-            faults.maybe_fail("decode_step")
-            faults.maybe_delay("decode_stall")
-            return spec(
-                self.params,
-                self.cache,
-                self._place_batch(tokens),
-                self._place_batch(spec_tables),
-                self._place_batch(spec_lengths),
-                sub,
-                self._place_batch(temps),
-                self._place_batch(top_ps),
-                self._place_batch(top_ks),
-            )
-
+        Spec telemetry and the per-class gamma tuner feed from here:
+        proposed/accepted are counted only for steps the turn actually
+        consumed (a stop mid-window discards the rest), mirroring the
+        offline replay's accounting (spec_replay.ReplayStats)."""
         t0 = time.monotonic()
-        with self.timer.phase("decode_spec"):
-            accept_d, residual_d, plain_d, self.cache = \
-                self._retrying("decode_spec", call)
-            accept = np.asarray(accept_d)     # [B, width-1]
-            residual = np.asarray(residual_d)  # [B, width-1]
-            plain = np.asarray(plain_d)       # [B, width]
-        step_elapsed = time.monotonic() - t0
-        self._bump("decode_steps")
-        self._bump("spec_rounds")
-        self._bump("spec_proposed", sum(
-            len(props[i]) for i in active_idx
-        ))
-
-        n_decoded = 0
-        n_accepted = 0
-        for i in active_idx:
-            turn = self._active[i]
+        with self.timer.phase("decode_drain"):
+            ring_host = np.asarray(window["ring"])     # [B, steps, W]
+            emits = np.asarray(window["emits"])        # [B, steps]
+            drafted = np.asarray(window["drafted"])    # [B, steps]
+        wait_s = time.monotonic() - t0
+        self._bump("host_stall_ms", wait_s * 1000.0)
+        for i in window["active_idx"]:
+            t = window["turns"][i]
+            if t.trace is not None and not t.trace.finished:
+                t.trace.note_drain(wait_s)
+        steps = window["steps"]
+        decoded = 0
+        accepted_total = 0
+        proposed_total = 0
+        overshoot = 0
+        seq_rows = 0
+        live_idx: list[int] = []
+        round_steps: set[int] = set()
+        # per-class accounting for the gamma tuner, one observe() per
+        # (class) per drain so the tune_every window sees whole batches
+        cls_acc: dict[str, list[int]] = {}
+        for i in window["active_idx"]:
+            turn = window["turns"][i]
+            total_i = int(emits[i].sum())
+            if self._active[i] is not turn or \
+                    int(self._slot_gen[i]) != window["gen"][i]:
+                # late reconciliation: the slot was finished/parked (or
+                # reused) after this window dispatched — every token it
+                # produced for the row is overshoot
+                overshoot += total_i
+                continue
+            self._slot_ahead[i] = max(
+                0, int(self._slot_ahead[i]) - window["ahead"][i]
+            )
+            live_idx.append(i)
             sess = self.sessions[turn.session_id]
-            n = len(props[i])
-            a = 0
-            while a < n and accept[i, a]:
-                a += 1
-            if n:
-                # refresh the row's acceptance estimate for the
-                # profitability gate
-                al = self.spec_ema_alpha
-                turn.spec_accept_ema = (
-                    (1 - al) * turn.spec_accept_ema + al * (a / n)
-                )
-            if a < n:
-                # first rejection: emit the residual draw (for greedy
-                # rows that's the argmax — identical to plain decoding)
-                emitted = props[i][:a] + [int(residual[i, a])]
-            else:
-                # every draft accepted: bonus token from position n
-                emitted = props[i][:n] + [int(plain[i, n])]
-            for j, tok in enumerate(emitted):
-                # token j's KV was written at sess.length by the verify
-                # forward (the final emitted token stays pending, like
-                # every other decode path)
-                sess.history.append(
-                    int(tokens[i, 0]) if j == 0 else emitted[j - 1]
-                )
-                sess.length += 1
-                n_decoded += 1
-                # emitted[j] for j < accepted is a consumed draft token
-                # (count only drafts the turn actually kept — a stop
-                # token mid-window discards the rest)
-                if j < len(props[i]) and j < len(emitted) - 1:
-                    n_accepted += 1
-                self._append_token(i, turn, tok)
+            limit = window["limit"][i]
+            prev = turn.new_tokens[-1] if turn.new_tokens else \
+                turn.prompt_tokens[-1]
+            consumed_i = 0
+            prop_i = 0
+            acc_i = 0
+            for s in range(steps):
                 if self._active[i] is not turn:
                     break
-        if n_decoded:
-            self._bump("tokens_decoded", n_decoded)
-        if n_accepted:
-            self._bump("spec_accepted", n_accepted)
-        self._handle_stall(active_idx, step_elapsed)
-        return len(active_idx)
+                e = int(emits[i, s])
+                d = int(drafted[i, s])
+                consumed_step = 0
+                for j in range(e):
+                    if sess.length >= limit:
+                        # degraded reservation: this position's KV went
+                        # to the scratch page, so the chain past it
+                        # attended garbage. Park on the last durably-
+                        # written token (the mid-stream requeue
+                        # contract); greedy streams stay identical to
+                        # the step-at-a-time engine.
+                        self._park_and_requeue(i, turn)
+                        break
+                    tok = int(ring_host[i, s, j])
+                    # token j's KV chain: `prev` was written at
+                    # sess.length by the verify forward that emitted it
+                    sess.history.append(prev)
+                    sess.length += 1
+                    decoded += 1
+                    consumed_i += 1
+                    consumed_step += 1
+                    # emitted[j] for j < d is a consumed draft token
+                    # (count only drafts the turn actually kept)
+                    if j < d and j < e - 1:
+                        acc_i += 1
+                    self._append_token(i, turn, tok)
+                    prev = tok
+                    if self._active[i] is not turn:
+                        break
+                if consumed_step and d:
+                    # this step's verify forward carried a live draft
+                    prop_i += d
+                    round_steps.add(s)
+                if self._active[i] is not turn:
+                    break
+            overshoot += total_i - consumed_i
+            if consumed_i:
+                row = cls_acc.setdefault(turn.turn_class, [0, 0, 0])
+                row[0] += prop_i
+                row[1] += acc_i
+                row[2] += consumed_i
+            proposed_total += prop_i
+            accepted_total += acc_i
+            if turn.trace is not None and prop_i:
+                turn.trace.spec_proposed += prop_i
+                turn.trace.spec_accepted += acc_i
+        if round_steps:
+            # rows that decoded sequentially while a batchmate drafted
+            # (penalized lanes, spec-off classes): the mixed batch's
+            # split stays diagnosable in stats
+            seq_rows = sum(
+                1 for i in live_idx if int(drafted[i].sum()) == 0
+            )
+        if decoded:
+            self._bump("tokens_decoded", decoded)
+        if overshoot:
+            self._bump("overshoot_tokens", overshoot)
+        if round_steps:
+            self._bump("spec_rounds", len(round_steps))
+        if proposed_total:
+            self._bump("spec_proposed", proposed_total)
+        if accepted_total:
+            self._bump("spec_accepted", accepted_total)
+        if seq_rows:
+            self._bump("spec_rows_sequential", seq_rows)
+        throttles = 0
+        for cls, (p, a, e) in cls_acc.items():
+            throttles += self.spec_tuner.observe(cls, p, a, e)
+        if throttles:
+            self._bump("spec_throttles", throttles)
+        if self._spec_floor_fn is not None and live_idx:
+            self._spec_floor_in -= 1
+            if self._spec_floor_in <= 0:
+                self._spec_floor_in = 32
+                mean_ctx = sum(
+                    int(self._slot_lengths[i]) for i in live_idx
+                ) / len(live_idx)
+                self.spec_tuner.floor = \
+                    self._spec_floor_fn(max(mean_ctx, 1.0))
+        # after the bookkeeping so parked sessions carry every token
+        # the slow window actually produced
+        self._handle_stall(live_idx, window["dispatch_s"] + wait_s)
+        return len(live_idx)
 
     def _append_token(self, slot: int, turn: Turn, token: int) -> None:
         turn.new_tokens.append(token)

@@ -52,7 +52,7 @@ from ..utils import knobs
 
 __all__ = [
     "TURN_CLASSES", "CLASS_RANK", "DEFAULT_CLASS", "ClassTargets",
-    "RequestScheduler", "normalize_class", "classify_turn",
+    "RequestScheduler", "SpecTuner", "normalize_class", "classify_turn",
     "class_targets_from_env",
     "class_chunks_from_env", "chunk_pages_from_env",
 ]
@@ -175,6 +175,217 @@ def chunk_pages_from_env() -> int:
     interleaving (monolithic admission-time prefill, the
     pre-scheduler behavior)."""
     return max(0, knobs.get_int("ROOM_TPU_PREFILL_CHUNK_PAGES"))
+
+
+class _SpecClassState:
+    """Per-class speculative-drafting state, mutated on the engine
+    thread at window drains (read by stats()/health snapshots)."""
+
+    __slots__ = (
+        "gamma", "ema", "proposed", "accepted", "emitted",
+        "win_prop", "win_acc", "win_dry", "off", "resume_at",
+        "throttles", "probes", "probe_pending",
+    )
+
+    def __init__(self, gamma: int) -> None:
+        self.gamma = gamma
+        self.ema: Optional[float] = None
+        self.proposed = 0
+        self.accepted = 0
+        self.emitted = 0
+        # acceptance window since the last adjustment
+        self.win_prop = 0
+        self.win_acc = 0
+        # tokens emitted through proposal-less windows since the last
+        # proposal/adjustment (nothing draftable in the class's traffic)
+        self.win_dry = 0
+        self.off = False
+        self.resume_at = 0      # emitted-token count the probe re-arms at
+        self.throttles = 0
+        self.probes = 0
+        # one dry drain has already arrived past resume_at: that
+        # window was dispatched at gamma 0 BEFORE the cooldown
+        # expired (pipelined windows drain one behind the dispatch
+        # clock), so only the NEXT dry drain is the probe itself
+        # coming back empty
+        self.probe_pending = False
+
+
+class SpecTuner:
+    """Per-traffic-class speculative gamma auto-tuner (docs/serving.md).
+
+    Replaces the engine's old GLOBAL acceptance-EMA/cost-ratio gate:
+    each class (queen / worker / background) tracks its own running
+    draft acceptance from live window drains (the same accounting
+    ``spec_replay.ReplayStats`` models offline) and owns its own gamma
+    and spec-off decision — queen tool-call echo traffic keeps a deep
+    gamma while background prose ratchets down to spec-off, without
+    either decision leaking across classes.
+
+    Rules, applied once a class accumulates ``tune_every`` proposals:
+    the class acceptance EMA updates; below ``floor`` the class goes
+    SPEC-OFF for ``cooldown`` emitted tokens, after which single
+    gamma-1 probe rounds refresh the estimate (the old global
+    cooldown/probe contract, now per class); at or above the floor,
+    gamma tracks ``ceil(ema * gamma_max)`` so a half-accepting class
+    drafts half as deep instead of paying full-width verifies.
+
+    The degradation ladder's spec-off rung is per-class too:
+    ``gamma_for`` takes the RAW ladder level and applies CLASS_GRACE,
+    so rung 1 silences background/worker drafting while queens keep
+    theirs until rung 2.
+
+    Single-writer (the engine thread, at drains); snapshots are
+    GIL-atomic reads of plain ints/floats.
+    """
+
+    def __init__(
+        self,
+        gamma_max: int,
+        *,
+        floor: float = 0.0,
+        ema_alpha: Optional[float] = None,
+        cooldown: Optional[int] = None,
+        tune_every: Optional[int] = None,
+    ) -> None:
+        self.gamma_max = max(0, int(gamma_max))
+        self.floor = float(floor)
+        self.ema_alpha = ema_alpha if ema_alpha is not None else \
+            knobs.get_float("ROOM_TPU_SPEC_EMA")
+        self.cooldown = cooldown if cooldown is not None else \
+            knobs.get_int("ROOM_TPU_SPEC_COOLDOWN")
+        self.tune_every = max(1, tune_every if tune_every is not None
+                              else knobs.get_int("ROOM_TPU_SPEC_TUNE_EVERY"))
+        self._cls = {c: _SpecClassState(self.gamma_max)
+                     for c in TURN_CLASSES}
+
+    def gamma_for(self, turn_class: str, raw_level: int) -> int:
+        """Draft depth this class runs at right now: 0 under its
+        per-class ladder spec-off rung, 0 while spec-off cooling down,
+        1 for a post-cooldown probe round, else the adapted gamma."""
+        if self.gamma_max <= 0:
+            return 0
+        cls = normalize_class(turn_class)
+        if raw_level - CLASS_GRACE.get(cls, 0) >= 1:
+            return 0
+        st = self._cls[cls]
+        if st.off:
+            if st.emitted >= st.resume_at:
+                return 1                      # probe round
+            return 0
+        return st.gamma
+
+    def observe(
+        self, turn_class: str, proposed: int, accepted: int,
+        emitted: int,
+    ) -> int:
+        """Feed one drained turn-window's spec accounting. Returns the
+        number of throttle events (off decisions) this observation
+        triggered, so the engine can mirror them into
+        ``stats()["spec_throttles"]``."""
+        st = self._cls[normalize_class(turn_class)]
+        st.emitted += emitted
+        if proposed <= 0:
+            # Dry emission: the window carried no proposals (nothing
+            # in the class's traffic matched). While ON that is itself
+            # a profitability signal — the acceptance EMA only sees
+            # windows that carried drafts, so without this a class
+            # serving non-repetitive prose would pin gamma at
+            # gamma_max and pay the full-width verify forward forever.
+            # A tune_every run of dry tokens decays the EMA toward
+            # zero: gamma ratchets down and the floor can engage.
+            # While OFF a gamma-0 cooldown window is expected to be
+            # dry and only ticks the cooldown clock — but a dry PROBE
+            # window (the gamma-1 round drafted nothing) counts as a
+            # failed probe and re-arms the cooldown, or an undraftable
+            # class would sit at gamma-1 probes forever. The first dry
+            # drain past resume_at only marks the probe pending: under
+            # pipelining that window was dispatched at gamma 0 before
+            # the cooldown expired, and the probe itself drains next.
+            if emitted <= 0:
+                return 0
+            if st.off:
+                if st.emitted >= st.resume_at:
+                    if st.probe_pending:
+                        st.probe_pending = False
+                        st.probes += 1
+                        st.throttles += 1
+                        st.resume_at = st.emitted + self.cooldown
+                        return 1
+                    st.probe_pending = True
+                return 0
+            st.win_dry += emitted
+            if st.win_dry < self.tune_every:
+                return 0
+            st.win_dry = 0
+            st.ema = 0.0 if st.ema is None else \
+                (1 - self.ema_alpha) * st.ema
+            st.gamma = self._gamma_from_ema(st.ema)
+            if st.ema < self.floor:
+                st.off = True
+                st.throttles += 1
+                st.resume_at = st.emitted + self.cooldown
+                return 1
+            return 0
+        st.win_dry = 0
+        st.probe_pending = False   # the probe did draft something
+        st.proposed += proposed
+        st.accepted += accepted
+        st.win_prop += proposed
+        st.win_acc += accepted
+        # while off, a probe's small sample must be enough to decide —
+        # waiting for a full tune_every of gamma-1 probes would pin the
+        # class off for far longer than the cooldown promises
+        need = max(1, self.tune_every // 4) if st.off else \
+            self.tune_every
+        if st.win_prop < need:
+            return 0
+        rate = st.win_acc / st.win_prop
+        st.win_prop = st.win_acc = 0
+        st.ema = rate if st.ema is None else (
+            (1 - self.ema_alpha) * st.ema + self.ema_alpha * rate
+        )
+        if st.ema < self.floor:
+            if st.off:
+                st.probes += 1
+            st.off = True
+            st.throttles += 1
+            st.resume_at = st.emitted + self.cooldown
+            return 1
+        if st.off:
+            st.probes += 1
+        st.off = False
+        st.gamma = self._gamma_from_ema(st.ema)
+        return 0
+
+    def _gamma_from_ema(self, ema: float) -> int:
+        """ceil(ema * gamma_max) with a 0.01 tolerance (the x100 int
+        truncation) so float noise just under a boundary doesn't bump
+        the depth, clamped to [1, gamma_max]."""
+        return max(1, min(
+            self.gamma_max, -(-int(ema * self.gamma_max * 100) // 100)
+        ))
+
+    def snapshot(self, raw_level: int = 0) -> dict:
+        """Per-class spec state for stats()/health/metrics/panel."""
+        out = {}
+        for cls in TURN_CLASSES:
+            st = self._cls[cls]
+            out[cls] = {
+                "gamma": self.gamma_for(cls, raw_level),
+                "gamma_adapted": st.gamma,
+                "accept_ema": round(st.ema, 4)
+                if st.ema is not None else None,
+                "acceptance": round(st.accepted / st.proposed, 4)
+                if st.proposed else None,
+                "proposed": st.proposed,
+                "accepted": st.accepted,
+                "emitted": st.emitted,
+                "off": st.off,
+                "throttles": st.throttles,
+                "probes": st.probes,
+            }
+        return out
 
 
 class _ClassStats:

@@ -2,27 +2,34 @@
 (VERDICT r4 #5).
 
 For greedy rows the engine accepts the longest draft prefix that
-matches the model's own (tie-banded) argmax (engine._decode_once_spec).
-If a transcript's continuation IS what the model would have emitted,
-then acceptance is a pure function of (history, continuation, gamma)
-and the drafting algorithm — so the per-class acceptance of
-prompt-lookup drafting on realistic traffic can be measured exactly,
-offline, with no model in the loop. tests/test_spec_acceptance.py pins
+matches the model's own (tie-banded) argmax (`sampler.spec_verify`
+inside the jitted window scan, `engine._spec_window_fn`). If a
+transcript's continuation IS what the model would have emitted, then
+acceptance is a pure function of (history, continuation, gamma) and
+the drafting algorithm — so the per-class acceptance of prompt-lookup
+drafting on realistic traffic can be measured exactly, offline, with
+no model in the loop. tests/test_spec_acceptance.py pins
 replay==engine on live engine output; scripts/spec_acceptance.py
 reports the per-class table that backs the deployment gamma default.
 
 Interaction with the multi-step dispatch window (docs/serving.md):
-speculation composes with the pipeline by FLUSHING it at every round
-boundary — drafting reads each session's host-side history, which an
-undrained window still runs ahead of, so a spec round is always one
-dispatch + one synchronous drain (effectively steps=1 for that
-iteration). The replay therefore models spec rounds exactly as before:
-round structure is unaffected by ROOM_TPU_DECODE_STEPS_PER_DISPATCH,
-only the plain-decode segments between rounds ride the window.
+speculation rides INSIDE the window — drafting matches each lane's
+device-resident recent-token tail (ops/spec.py; the same trailing
+3-gram/2-gram rule as `propose_ngram` here), verification is the
+window step's own batched forward, and accept/reject happens inside
+the `lax.scan`, so a spec round is a normal window step emitting up
+to 1+gamma tokens and NEVER flushes the pipeline. A "round" in this
+replay therefore corresponds to one drafting window STEP, not one
+dispatch; round structure is still unaffected by
+ROOM_TPU_DECODE_STEPS_PER_DISPATCH. The live counterpart of this
+module's accounting is `scheduler.SpecTuner`, which adapts each
+traffic class's gamma (and its spec-off decision) from exactly these
+proposed/accepted counts observed at window drains.
 
 reference: none (the reference delegates decoding to Ollama and has no
-speculative path); the acceptance rule replayed here is
-engine.py:_decode_once_spec.
+speculative path); the drafting rule replayed here is
+ops/spec.ngram_propose (== engine.propose_ngram) and the acceptance
+rule is sampler.spec_verify's greedy reduction.
 """
 
 from __future__ import annotations
@@ -69,24 +76,32 @@ class ReplayStats:
 def replay_acceptance(history: list[int], continuation: list[int],
                       gamma: int, min_accept: float = 0.0,
                       cooldown: int = 16, ema_alpha: float = 0.1,
-                      cost_ratio: float | None = None) -> ReplayStats:
+                      cost_ratio: float | None = None,
+                      tail: int = 256) -> ReplayStats:
     """Replay the engine's greedy speculative loop: draft via
-    propose_ngram over (history + emitted), accept the longest prefix
-    matching the true continuation, emit accepted+1 per round (the
-    bonus/corrected token), fall back to a plain step when nothing
-    drafts — the same round structure as engine._decode_once_spec with
-    remaining-budget capping elided (replay has no max_new_tokens).
+    propose_ngram over the trailing ``tail`` tokens of
+    (history + emitted) — the engine's device-resident tail is
+    bounded (ROOM_TPU_SPEC_TAIL, default 256), so an occurrence
+    further back is invisible to live drafting and must be invisible
+    here too — accept the longest prefix matching the true
+    continuation, emit accepted+1 per round (the bonus/corrected
+    token), fall back to a plain step when nothing drafts — the same
+    per-step structure as the in-window scan (engine._spec_window_fn)
+    with remaining-budget/coverage capping elided (replay has no
+    max_new_tokens or page pool).
 
-    The adaptive gate mirrors the engine for a homogeneous single-row
-    batch: `cost_ratio` gates a round unless the expected emission
-    1 + sum ema^i over the draft clears it (the engine default;
-    roofline.spec_cost_ratio supplies the ratio), `min_accept` gates on
-    the acceptance EMA directly (the ROOM_TPU_SPEC_MIN_ACCEPT
-    override). An unprofitable round closes the gate for `cooldown`
-    emitted tokens, then one probe round refreshes the EMA. Defaults
-    disable both gates (an unthrottled engine)."""
+    The adaptive gate models scheduler.SpecTuner for a homogeneous
+    single-row, single-class stream: `min_accept` gates on the
+    acceptance EMA directly (the ROOM_TPU_SPEC_MIN_ACCEPT floor);
+    `cost_ratio` keeps the legacy expected-emission rule
+    (1 + sum ema^i over the draft must clear it;
+    roofline.spec_cost_ratio supplies the ratio) for the published
+    round-5 tables. An unprofitable round closes the gate for
+    `cooldown` emitted tokens, then one probe round refreshes the
+    EMA. Defaults disable both gates (an unthrottled engine)."""
     if gamma <= 0:
         raise ValueError(f"gamma must be positive, got {gamma}")
+    tail = max(8, tail)   # engine.spec_tail_len's own lower bound
     st = ReplayStats()
     n = len(continuation)
     if n == 0:
@@ -104,7 +119,7 @@ def replay_acceptance(history: list[int], continuation: list[int],
     while pos < n:
         draft: list[int] = []
         if st.emitted >= resume_at and n - pos > 1:
-            draft = propose_ngram(seq, min(gamma, n - pos - 1))
+            draft = propose_ngram(seq[-tail:], min(gamma, n - pos - 1))
         if draft:
             if probe:
                 probe = False  # forced EMA-refresh round

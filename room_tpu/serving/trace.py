@@ -142,6 +142,7 @@ class TurnTrace:
         "t_first_token", "t_last_token", "n_tokens",
         "windows", "dispatch_ms", "drain_ms",
         "chunks", "chunk_tokens", "chunk_defers",
+        "spec_proposed", "spec_accepted",
         "offload_restore_ms", "offload_restores", "reprefills",
         "requeues", "rehomes",
         "events", "faults", "max_events",
@@ -171,6 +172,12 @@ class TurnTrace:
         self.chunks = 0
         self.chunk_tokens = 0
         self.chunk_defers = 0
+        # on-mesh speculative drafting consumed by this turn
+        # (docs/serving.md): drafts its verify forwards carried, and
+        # how many it kept — the per-turn view of the class acceptance
+        # the gamma tuner adapts on
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.offload_restore_ms = 0.0
         self.offload_restores = 0
         self.reprefills = 0
@@ -308,6 +315,8 @@ class TurnTrace:
                 "windows": self.windows,
                 "dispatch_ms": round(self.dispatch_ms, 3),
                 "drain_ms": round(self.drain_ms, 3),
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
             },
             "rehomes": self.rehomes,
             "faults": list(self.faults),

@@ -272,17 +272,37 @@ register("ROOM_TPU_GREEDY_TIE_EPS", "float", "1e-6",
 
 # ---- speculative decoding (docs/serving.md, ROUND5.md) ----
 register("ROOM_TPU_SPEC_TOKENS", "int", "0",
-         "Draft tokens proposed per speculative round (gamma); 0 "
-         "disables speculation.",
+         "Max draft tokens per in-window speculative step (gamma "
+         "ceiling; per-class gamma adapts below it); 0 disables "
+         "speculation.",
          scope="provider", provider_default="4")
 register("ROOM_TPU_SPEC_EMA", "float", "0.1",
-         "EMA alpha for per-row speculative acceptance tracking.")
+         "EMA alpha for per-class speculative acceptance tracking "
+         "(scheduler.SpecTuner).")
 register("ROOM_TPU_SPEC_COOLDOWN", "int", "16",
-         "Plain-decode tokens per row after an unprofitable speculative "
-         "round before the next probe round.")
+         "Emitted tokens a class decodes plainly after its acceptance "
+         "EMA falls below the floor, before a gamma-1 probe round.")
 register("ROOM_TPU_SPEC_MIN_ACCEPT", "float", None,
-         "Explicit acceptance-EMA floor for the speculation gate "
-         "(unset = roofline cost-ratio gate).")
+         "Explicit per-class acceptance-EMA floor below which a class "
+         "goes spec-off (unset = roofline spec_accept_floor for this "
+         "model/batch/gamma shape).")
+register("ROOM_TPU_SPEC_TUNE_EVERY", "int", "16",
+         "Draft proposals a class accumulates between gamma "
+         "adjustments (scheduler.SpecTuner).")
+register("ROOM_TPU_SPEC_TAIL", "int", "256",
+         "Device-resident recent-token tail per decode lane that "
+         "on-mesh prompt-lookup drafting matches against (ops/spec.py). "
+         "The replay study (scripts/spec_acceptance.py) picks 256: "
+         "tool-call acceptance falls ~30% at 128 and the matching "
+         "cost is negligible next to the verify forward.")
+register("ROOM_TPU_DRAFT_MODEL", "str", None,
+         "Tier-2 draft model config name (models/config.py "
+         "resolve_draft_config) loaded onto the serving mesh alongside "
+         "the target; unset = prompt-lookup drafting only.",
+         scope="provider")
+register("ROOM_TPU_DRAFT_WINDOW", "int", "64",
+         "Trailing tail tokens the on-mesh draft model reads per "
+         "proposal step.")
 
 # ---- serving engine: robustness / chaos (docs/chaos.md) ----
 register("ROOM_TPU_TURN_DEADLINE_S", "float", "0",
@@ -708,6 +728,11 @@ register("ROOM_TPU_BENCH_DISAGG", "bool", "1",
          "Run the disagg bench phase (role-split fleet vs mixed "
          "baseline under a 2k-token prompt burst + prefix-store "
          "resume re-prefill delta).", scope="bench")
+register("ROOM_TPU_BENCH_SPEC_PIPELINE", "bool", "1",
+         "Run the spec_pipeline phase: spec-off vs in-window spec-on "
+         "A/B on repetitive traffic at full window depth "
+         "(tokens_per_forward, host_stall_ms_per_tok, zero "
+         "spec-induced flushes).", scope="bench")
 register("ROOM_TPU_BENCH_TRACE", "bool", "1",
          "Run the turnscope phases: trace-on-vs-off overhead A/B "
          "(p50 turn latency budget <= 5%) and the per-class SLO "

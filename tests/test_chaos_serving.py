@@ -437,22 +437,27 @@ def test_degradation_level_from_pressure_window(make_engine):
 
 
 def test_degradation_rung1_disables_spec(make_engine):
+    """Ladder rung 1 is per-class spec-off (scheduler.SpecTuner):
+    worker/background drafting stops at rung 1, queens keep theirs
+    one rung longer (CLASS_GRACE)."""
     eng = make_engine(spec_tokens=4)
-    spec_calls = []
-    orig = eng._decode_once_spec
-    eng._decode_once_spec = \
-        lambda idx: (spec_calls.append(1), orig(idx))[1]
     # repeated prompt guarantees prompt-lookup drafts exist
     prompt = list(range(10, 20)) * 3
     eng.submit(prompt, sampling=_greedy())
     eng.run_until_idle()
-    assert spec_calls, "sanity: spec path engages when healthy"
+    rounds0 = eng.stats()["spec_rounds"]
+    assert rounds0 > 0, "sanity: spec path engages when healthy"
 
-    spec_calls.clear()
     eng.set_degradation(1)
-    eng.submit(prompt, sampling=_greedy())
+    assert eng.spec_tuner.gamma_for("worker", 1) == 0
+    assert eng.spec_tuner.gamma_for("background", 1) == 0
+    assert eng.spec_tuner.gamma_for("queen", 1) > 0, \
+        "queens keep drafting until rung 2"
+    assert eng.spec_tuner.gamma_for("queen", 2) == 0
+    eng.submit(prompt, sampling=_greedy())   # default class: worker
     eng.run_until_idle()
-    assert not spec_calls, "rung 1 must bypass speculation"
+    assert eng.stats()["spec_rounds"] == rounds0, \
+        "rung 1 must bypass speculation for worker turns"
     eng.set_degradation(None)
 
 
@@ -490,26 +495,46 @@ def test_degradation_rung4_sheds_lowest_priority(make_engine):
     _assert_pages_balanced(eng)
 
 
-# ---- chip-aware speculation gate (ADVICE r5 satellite) ----
+# ---- chip-aware speculation floor (ADVICE r5 satellite) ----
 
-def test_spec_gate_uses_detected_chip_and_running_ctx(make_engine):
+def test_spec_floor_uses_detected_chip(make_engine, monkeypatch):
+    """The per-class tuner's default spec-off floor is the roofline
+    acceptance breakeven for this model/batch/gamma shape on the chip
+    the engine actually landed on (ROOM_TPU_SPEC_MIN_ACCEPT
+    overrides it)."""
     from room_tpu.perf.roofline import (
-        V5E, detect_chip_spec, spec_cost_ratio,
+        V5E, detect_chip_spec, spec_accept_floor,
     )
 
     # CPU test runs resolve to the documented V5E default
     assert detect_chip_spec() is V5E
     eng = make_engine(spec_tokens=4)
-    assert eng._chip_spec is V5E
-    ratio = eng._spec_ratio_for(300.0)   # buckets to 512
-    assert ratio == pytest.approx(spec_cost_ratio(
-        eng.cfg, eng.max_batch, 4, chip=V5E, mean_ctx=512.0
+    assert eng.spec_tuner.floor == pytest.approx(spec_accept_floor(
+        eng.cfg, eng.max_batch, 4, chip=V5E
     ))
-    assert 512 in eng._spec_ratio_cache
-    # KV reads dominate both sides at long context, so the verify/plain
-    # ratio shrinks toward 1 — the gate must track that, not a fixed
-    # 1024-token assumption
-    assert eng._spec_ratio_for(8000.0) <= ratio
+    monkeypatch.setenv("ROOM_TPU_SPEC_MIN_ACCEPT", "0.66")
+    eng2 = make_engine(spec_tokens=4)
+    assert eng2.spec_tuner.floor == pytest.approx(0.66)
+    assert eng2._spec_floor_fn is None, \
+        "an explicit floor override must never be recalibrated"
+
+
+def test_spec_floor_recalibrates_to_live_context(make_engine):
+    """The roofline-derived spec-off floor is re-solved at drains
+    against the batch's live mean context: at long context KV reads
+    dominate verify and plain decode alike, so a floor frozen at the
+    1024-token init default would throttle drafting exactly where it
+    is still profitable."""
+    eng = make_engine(spec_tokens=4)
+    seen = []
+    real = eng._spec_floor_fn
+    eng._spec_floor_fn = lambda ctx: seen.append(ctx) or real(ctx)
+    t = eng.submit([1, 2, 3, 4] * 8, sampling=_greedy(8))
+    eng.run_until_idle()
+    eng.release_session(t.session_id)
+    assert seen, "no floor recalibration happened at drains"
+    assert all(ctx >= 32 for ctx in seen), seen
+    assert eng.spec_tuner.floor == pytest.approx(real(seen[-1]))
 
 
 # ---- provider stack ----
@@ -692,7 +717,11 @@ def test_shed_turn_maps_to_503_with_retry_after(tpu_host):
     engine.set_degradation(4)
     try:
         # saturate the queue well past keep_n (max_batch*2) so the
-        # ladder is guaranteed to shed the priority-0 turn below
+        # ladder is guaranteed to shed the priority-0 turn below; the
+        # injected stall keeps the engine from draining the fillers
+        # before the probe submit lands (in-window spec makes these
+        # one-token prompts finish in very few windows otherwise)
+        faults.inject("decode_stall", latency_s=0.2, times=8)
         filler = [
             engine.submit([1], sampling=_greedy(), priority=9)
             for _ in range(engine.max_batch * 4)
@@ -713,6 +742,7 @@ def test_shed_turn_maps_to_503_with_retry_after(tpu_host):
         for t in filler:
             t.done.wait(60)
     finally:
+        faults.clear("decode_stall")
         engine.set_degradation(None)
         deadline = time.monotonic() + 30
         while engine.stats()["active_slots"] and \

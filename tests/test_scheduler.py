@@ -431,3 +431,98 @@ def test_chunk_budget_paces_background(build):
     assert mid - before == 1
     assert after - mid == 1
     eng.run_until_idle()
+
+
+# ---- per-class speculative gamma tuner (docs/serving.md) ----
+
+def test_spec_tuner_per_class_independence():
+    """One class's spec-off decision must never leak into another:
+    a starved class throttles to gamma 0 while its siblings keep
+    their full depth, and a good probe brings it back."""
+    from room_tpu.serving.scheduler import SpecTuner
+
+    tu = SpecTuner(4, floor=0.5, ema_alpha=1.0, cooldown=8,
+                   tune_every=8)
+    # starve the worker: a full tune window of rejected proposals
+    assert tu.observe("worker", 8, 0, 8) == 1
+    assert tu.gamma_for("worker", 0) == 0
+    # queen accepts everything in the same drains: unaffected
+    assert tu.observe("queen", 8, 8, 8) == 0
+    assert tu.gamma_for("queen", 0) == 4
+    snap = tu.snapshot()
+    assert snap["worker"]["off"] is True
+    assert snap["queen"]["off"] is False
+    assert snap["background"]["proposed"] == 0
+    # the worker decodes plainly through its cooldown, then probes
+    tu.observe("worker", 0, 0, 8)        # plain tokens tick the clock
+    assert tu.gamma_for("worker", 0) == 1, "post-cooldown probe round"
+    # a fully-accepted probe restores the class (alpha=1: ema=rate)
+    assert tu.observe("worker", 2, 2, 2) == 0
+    assert tu.gamma_for("worker", 0) == 4
+    assert tu.snapshot()["worker"]["probes"] == 1
+
+
+def test_spec_tuner_gamma_tracks_acceptance():
+    """At or above the floor, gamma follows ceil(ema * gamma_max):
+    a half-accepting class drafts half as deep instead of paying
+    full-width verifies."""
+    from room_tpu.serving.scheduler import SpecTuner
+
+    tu = SpecTuner(4, floor=0.1, ema_alpha=1.0, tune_every=4)
+    tu.observe("worker", 4, 2, 4)        # rate 0.5 -> gamma 2
+    assert tu.gamma_for("worker", 0) == 2
+    tu.observe("worker", 4, 1, 4)        # rate 0.25 -> gamma 1
+    assert tu.gamma_for("worker", 0) == 1
+    tu.observe("worker", 4, 4, 4)        # rate 1.0 -> back to 4
+    assert tu.gamma_for("worker", 0) == 4
+
+
+def test_spec_tuner_dry_traffic_ratchets_down():
+    """A class whose traffic never matches (zero-proposal windows)
+    must not pin gamma at gamma_max paying full-width verifies
+    forever: dry emission decays the acceptance EMA so gamma ratchets
+    down and the floor's spec-off can engage. Off-state dry windows
+    stay inert (riding at gamma 0 is expected to propose nothing)."""
+    from room_tpu.serving.scheduler import SpecTuner
+
+    tu = SpecTuner(4, floor=0.0, ema_alpha=0.5, tune_every=4)
+    tu.observe("worker", 4, 4, 4)          # ema 1.0 -> gamma 4
+    assert tu.gamma_for("worker", 0) == 4
+    tu.observe("worker", 0, 0, 4)          # dry tune window: ema 0.5
+    assert tu.gamma_for("worker", 0) == 2
+    tu.observe("worker", 0, 0, 4)          # ema 0.25 -> gamma 1
+    assert tu.gamma_for("worker", 0) == 1
+    # with a positive floor, a dry run drives the class spec-off like
+    # a below-floor tune; cooldown-period dry windows only tick the
+    # clock (no repeated throttle events)
+    tu2 = SpecTuner(4, floor=0.3, ema_alpha=1.0, cooldown=8,
+                    tune_every=4)
+    assert tu2.observe("queen", 0, 0, 4) == 1
+    assert tu2.gamma_for("queen", 0) == 0
+    assert tu2.observe("queen", 0, 0, 2) == 0
+    assert tu2.snapshot()["queen"]["off"] is True
+    # past the cooldown a probe round is handed out. The first dry
+    # drain past resume_at only marks the probe pending (under
+    # pipelining that window predates the probe); the SECOND dry
+    # drain is the probe coming back empty — a failed probe that
+    # re-arms the cooldown, so an undraftable class never sits at
+    # permanent gamma-1 probing. gamma_for stays a pure read
+    # (snapshot()/stats() call it from non-engine threads).
+    assert tu2.observe("queen", 0, 0, 8) == 0   # marks probe pending
+    assert tu2.gamma_for("queen", 0) == 1       # probe handed out
+    assert tu2.observe("queen", 0, 0, 4) == 1   # dry probe: re-off
+    assert tu2.gamma_for("queen", 0) == 0       # cooling again
+    assert tu2.snapshot()["queen"]["probes"] == 1
+
+
+def test_spec_tuner_ladder_rung_is_per_class():
+    """The degradation ladder's spec-off rung honors CLASS_GRACE:
+    rung 1 silences worker/background drafting while queens keep
+    theirs until rung 2."""
+    from room_tpu.serving.scheduler import SpecTuner
+
+    tu = SpecTuner(4, floor=0.0)
+    assert tu.gamma_for("worker", 1) == 0
+    assert tu.gamma_for("background", 1) == 0
+    assert tu.gamma_for("queen", 1) == 4
+    assert tu.gamma_for("queen", 2) == 0

@@ -99,6 +99,22 @@ def test_per_class_acceptance_ordering():
     assert replay_acceptance(hist, cont, 4).tokens_per_forward > 1.5
 
 
+def test_replay_tail_bound_matches_device_tail():
+    """The engine drafts from a bounded device tail
+    (ROOM_TPU_SPEC_TAIL, default 256): an n-gram whose only earlier
+    occurrence lies further back than the tail is invisible to live
+    drafting, so replay — the number behind the deployment gamma
+    default — must not credit it either. A wide tail still sees it."""
+    filler = list(range(1000, 1300))
+    hist = [7, 8, 9, 41, 42, 43, 44] + filler + [7, 8, 9]
+    cont = [41, 42, 43, 44, 5]
+    wide = replay_acceptance(hist, cont, 4, tail=4096)
+    assert wide.accepted >= 3
+    bounded = replay_acceptance(hist, cont, 4)   # default: engine's 256
+    assert bounded.accepted == 0
+    assert bounded.plain_steps == len(cont) - 1
+
+
 def test_tokens_per_forward_bounded_by_gamma_plus_one():
     for cls in ("prose", "code", "toolcalls"):
         hist, cont = load_class(cls)
@@ -137,13 +153,16 @@ def test_replay_throttle_reduces_rounds():
 
 
 def test_engine_throttle_engages_and_preserves_tokens(monkeypatch):
-    """With an impossible acceptance floor every filled window
-    throttles; generated tokens must be identical to the unthrottled
-    engine (the throttle changes cost, never content)."""
+    """With an impossible acceptance floor the class tuner drives the
+    turn's class to spec-off; generated tokens must be identical to
+    the unthrottled engine (the throttle changes cost, never
+    content). The off decision lands at a window drain (one window
+    after the acceptance evidence, the pipelined-tuner lag), so the
+    run must be long enough to decode several windows past it."""
     cfg = tiny_moe(vocab_size=8)
     params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
     prompt = [1, 2, 3, 1, 2, 3]
-    sp = SamplingParams(temperature=0.0, max_new_tokens=48)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=128)
 
     base = ServingEngine(cfg, params, max_batch=4, page_size=8,
                          n_pages=64, spec_tokens=4)
@@ -152,15 +171,17 @@ def test_engine_throttle_engages_and_preserves_tokens(monkeypatch):
     assert base.stats()["spec_throttles"] == 0
 
     monkeypatch.setenv("ROOM_TPU_SPEC_MIN_ACCEPT", "1.1")
-    monkeypatch.setenv("ROOM_TPU_SPEC_COOLDOWN", "4")
+    monkeypatch.setenv("ROOM_TPU_SPEC_COOLDOWN", "16")
+    monkeypatch.setenv("ROOM_TPU_SPEC_TUNE_EVERY", "8")
     eng = ServingEngine(cfg, params, max_batch=4, page_size=8,
                         n_pages=64, spec_tokens=4)
     turn = eng.submit(prompt, sampling=sp)
     eng.run_until_idle()
     st = eng.stats()
     assert st["spec_throttles"] > 0
+    assert eng.spec_tuner.snapshot()["worker"]["off"] is True
     assert turn.new_tokens == want.new_tokens
-    # throttled rounds decode plainly: fewer verify rounds than free
+    # throttled windows decode plainly: fewer verify rounds than free
     assert st["spec_rounds"] < base.stats()["spec_rounds"]
 
 

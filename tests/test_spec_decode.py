@@ -1,5 +1,8 @@
-"""Prompt-lookup speculative decoding: drafting from the session's own
-history, one-forward verification, exact greedy equivalence.
+"""On-mesh speculative decoding fused into the dispatch window
+(docs/serving.md): device-tail prompt-lookup drafting, in-scan
+verification, exact greedy equivalence — and the pinned identity
+matrix across window depth x fused dispatch x prefix-hit x
+offload-restore.
 
 No reference counterpart (the reference's decoding lives inside Ollama);
 TPU-first new work — decode streams the full weight set per device call,
@@ -11,7 +14,7 @@ import numpy as np
 import pytest
 
 from room_tpu.models import qwen3, tiny_moe
-from room_tpu.serving import SamplingParams, ServingEngine
+from room_tpu.serving import SamplingParams, ServingEngine, faults
 from room_tpu.serving.engine import propose_ngram
 
 
@@ -20,6 +23,13 @@ def setup():
     cfg = tiny_moe()
     params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
     return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
 
 
 def make_engine(cfg, params, **kw):
@@ -297,3 +307,228 @@ def test_spec_mixed_penalized_batch_rides_spec_per_row():
     st = eng.stats()
     assert st["spec_rounds"] > 0
     assert st["spec_rows_sequential"] > 0
+
+
+# ---- the pinned identity matrix (docs/serving.md) ----
+# An 8-token vocabulary forces greedy generation into a cycle within a
+# few steps, so in-window drafting engages (and accepts) determinist-
+# ically on every cell of the matrix.
+
+REP = [1, 2, 3, 1, 2, 3]
+LONG = ([1, 2, 3, 4, 5, 6, 7, 0] * 5)[:37]   # 5 pages -> chunked
+PREFIX = [2, 4, 6, 1, 3, 5, 7, 2] * 3        # 24 tokens = 3 aligned pages
+
+
+@pytest.fixture(scope="module")
+def model8():
+    cfg = tiny_moe(vocab_size=8)
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
+    return cfg, params
+
+
+@pytest.fixture()
+def build8(model8, monkeypatch):
+    cfg, params = model8
+
+    def make(steps, fused=True, chunk_pages=1, **kw):
+        monkeypatch.setenv(
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", str(steps)
+        )
+        monkeypatch.setenv(
+            "ROOM_TPU_FUSED_WINDOW", "1" if fused else "0"
+        )
+        monkeypatch.setenv(
+            "ROOM_TPU_PREFILL_CHUNK_PAGES", str(chunk_pages)
+        )
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 128)
+        return ServingEngine(cfg, params, **kw)
+
+    return make
+
+
+def _g(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+def _matrix_streams(eng):
+    """Canonical matrix traffic: a repetitive decode turn (drafting
+    engages), a long chunked prompt (rides the fused window when
+    enabled), a prefix-cache hit pair, and an offload-hibernate/
+    restore continuation."""
+    a = eng.submit(REP, session_id="rep", sampling=_g(24))
+    b = eng.submit(LONG, session_id="long", sampling=_g(8))
+    eng.run_until_idle()
+    c = eng.submit(PREFIX + [1, 2], session_id="pfx1", sampling=_g(6))
+    eng.run_until_idle()                     # registers the prefix
+    d = eng.submit(PREFIX + [4, 5], session_id="pfx2", sampling=_g(6))
+    eng.run_until_idle()
+    assert eng.offload_session("rep")
+    e = eng.submit([1, 2, 3], session_id="rep", sampling=_g(8))
+    eng.run_until_idle()
+    return [t.new_tokens for t in (a, b, c, d, e)]
+
+
+def test_spec_identity_full_matrix(build8):
+    """The acceptance matrix: greedy streams are token-identical
+    spec-on vs spec-off across steps {1,4} x fused/split window x
+    prefix-hit x offload-restore — and spec rounds no longer flush
+    the dispatch window (the engine keeps running at the configured
+    multi-step depth while drafting)."""
+    base = _matrix_streams(build8(4, fused=True, spec_tokens=0,
+                                  offload=True))
+    for steps in (1, 4):
+        for fused in (True, False):
+            eng = build8(steps, fused=fused, spec_tokens=4,
+                         offload=True)
+            got = _matrix_streams(eng)
+            assert got == base, f"steps={steps} fused={fused}"
+            st = eng.stats()
+            tag = f"steps={steps} fused={fused}: {st}"
+            # drafting engaged and accepted on every cell...
+            assert st["spec_rounds"] > 0, tag
+            assert st["spec_accepted"] > 0, tag
+            # ...without composing the window down to steps=1: the
+            # engine still dispatched at the configured depth (the old
+            # path flushed the pipeline at every spec-round boundary)
+            assert st["steps_per_dispatch"] == steps, tag
+            # the matrix legs actually exercised their paths
+            assert st["prefix_hits"] >= 1, tag
+            assert st["offload_restores"] >= 1, tag
+            assert st["prefill_chunks_interleaved"] > 0, tag
+            if fused:
+                assert st["fused_windows"] > 0, tag
+
+
+def test_decode_window_fault_mid_spec_round(build8, monkeypatch):
+    """Chaos: decode_window armed while speculative windows are in
+    flight. Accepted-draft tokens up to the last durable boundary
+    (the previous window's drain) survive to the stream; the faulted
+    window's turn fails cleanly and releases every KV page."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    eng = build8(4, spec_tokens=4)
+    got = []
+    turn = eng.submit(REP, sampling=_g(256), on_token=got.append)
+    # run windows until accepted drafts are riding the pipeline
+    for _ in range(8):
+        eng.step()
+        if eng.stats()["spec_accepted"] > 0:
+            break
+    assert eng.stats()["spec_accepted"] > 0, \
+        "sanity: drafts accepted before the fault"
+    n_before = len(turn.new_tokens)
+    faults.inject("decode_window", times=1, transient=False)
+    eng.step()       # next dispatch faults; in-flight window drains
+    eng.run_until_idle()
+    assert turn.finish_reason == "error"
+    assert "decode_window" in (turn.error or "")
+    # the undrained spec window's tokens (accepted drafts included)
+    # were NOT discarded by the fault one window later
+    assert len(turn.new_tokens) >= n_before
+    assert got == turn.new_tokens
+    eng.release_session(turn.session_id)
+    assert eng.page_table.free_pages == eng.page_table.n_pages - 1, \
+        "KV page leak after mid-spec-round window fault"
+
+
+def test_spec_off_class_runs_gamma_zero_in_mixed_batch(
+        build8, monkeypatch):
+    """Per-class spec-off is a LANE decision, not a batch one: an
+    acceptance-starved class rides the same window at gamma 0 while
+    its batchmates keep drafting — tokens identical to spec-off, the
+    starved class stays off, the healthy class keeps its gamma."""
+    monkeypatch.setenv("ROOM_TPU_SPEC_MIN_ACCEPT", "0.5")
+    # park the starved class well past this test's traffic so a
+    # probe round can't re-arm it mid-run
+    monkeypatch.setenv("ROOM_TPU_SPEC_COOLDOWN", "100000")
+    base = build8(4, spec_tokens=0)
+    b1 = base.submit(REP, sampling=_g(24), turn_class="queen",
+                     session_id="q")
+    b2 = base.submit([3, 2, 1, 3, 2, 1], sampling=_g(24),
+                     turn_class="worker", session_id="w")
+    base.run_until_idle()
+
+    eng = build8(4, spec_tokens=4)
+    # starve the worker class through the tuner's own accounting: a
+    # full tune window of rejected proposals drives it spec-off
+    assert eng.spec_tuner.observe("worker", 16, 0, 16) == 1
+    assert eng.spec_tuner.gamma_for("worker", 0) == 0
+    assert eng.spec_tuner.gamma_for("queen", 0) == 4
+    g1 = eng.submit(REP, sampling=_g(24), turn_class="queen",
+                    session_id="q")
+    g2 = eng.submit([3, 2, 1, 3, 2, 1], sampling=_g(24),
+                    turn_class="worker", session_id="w")
+    eng.run_until_idle()
+    assert g1.new_tokens == b1.new_tokens
+    assert g2.new_tokens == b2.new_tokens
+    st = eng.stats()
+    snap = st["spec"]["classes"]
+    assert st["spec_rounds"] > 0, "queen lanes kept drafting"
+    assert snap["worker"]["off"] is True
+    assert snap["queen"]["off"] is False
+    assert snap["queen"]["gamma"] == 4
+    # the worker's lanes decoded sequentially inside drafting windows
+    assert st["spec_rows_sequential"] > 0
+    # and its tuner state never gained a proposal (no probe fired)
+    assert snap["worker"]["proposed"] == 16
+
+
+def test_draft_model_tier_proposes_and_stays_identical(build8):
+    """Tier-2 drafting (ROOM_TPU_DRAFT_MODEL, docs/serving.md): the
+    tiny on-mesh draft decoder proposes where prompt-lookup finds no
+    repeating n-gram, behind the SAME in-window verify — so its
+    quality is a throughput knob, never a correctness one. Greedy
+    streams stay token-identical to spec-off, and the draft tier is
+    attributed differentially: both engines emit the same stream, so
+    the lookup-only engine's proposal count is exactly the lookup
+    share — the draft engine proposing strictly more is the tier-2
+    path firing on the lookup-empty steps."""
+    from room_tpu.models.config import tiny_draft
+
+    arb = [4, 1, 6, 2, 7, 0, 5, 3]           # no repeating 2-gram
+    base = build8(4, spec_tokens=0)
+    b = base.submit(arb, sampling=_g(8))
+    base.run_until_idle()
+
+    lookup_only = build8(4, spec_tokens=4)
+    l = lookup_only.submit(arb, sampling=_g(8))
+    lookup_only.run_until_idle()
+    assert l.new_tokens == b.new_tokens
+    lookup_proposed = lookup_only.stats()["spec_proposed"]
+
+    dcfg = tiny_draft(vocab_size=8)
+    dparams = qwen3.init_params(dcfg, jax.random.PRNGKey(11))
+    eng = build8(4, spec_tokens=4, draft=(dcfg, dparams))
+    g = eng.submit(arb, sampling=_g(8))
+    eng.run_until_idle()
+    assert g.new_tokens == b.new_tokens
+    st = eng.stats()
+    assert st["spec_proposed"] > lookup_proposed, \
+        "draft tier never proposed on lookup-empty steps"
+    assert st["spec"]["draft_model"] == "tiny-draft"
+
+
+def test_draft_model_vocab_mismatch_raises(model8):
+    """A draft whose vocabulary differs from the target's would
+    propose token ids the verify gather can't index — refused loudly
+    at engine build, not silently at serve time."""
+    from room_tpu.models.config import tiny_draft
+
+    cfg, params = model8
+    dcfg = tiny_draft(vocab_size=16)
+    dparams = qwen3.init_params(dcfg, jax.random.PRNGKey(11))
+    with pytest.raises(ValueError, match="vocab"):
+        ServingEngine(cfg, params, max_batch=4, page_size=8,
+                      n_pages=128, spec_tokens=4,
+                      draft=(dcfg, dparams))
+
+
+def test_resolve_draft_config_unknown_name_raises():
+    """ROOM_TPU_DRAFT_MODEL typos fail loudly at host build."""
+    from room_tpu.models.config import resolve_draft_config
+
+    with pytest.raises(ValueError, match="unknown draft model"):
+        resolve_draft_config("qwen3-drafty", 512)
+    cfg = resolve_draft_config("qwen3-draft", 1234)
+    assert cfg.vocab_size == 1234

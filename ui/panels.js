@@ -1498,6 +1498,31 @@ async function renderTpu(el) {
         </tr>`)).join("") ||
         '<tr><td class="dim" colspan="8">no engines warm</td></tr>'}
       </table>
+      <h2 style="margin-top:.6rem">speculation</h2>
+      <table><tr><th>engine</th><th>class</th><th>γ live</th>
+        <th>γ adapted</th><th>accept ema</th><th>acceptance</th>
+        <th>proposed</th><th>accepted</th><th>state</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.spec && e.spec.gamma_max > 0)
+        .flatMap(([name, e]) =>
+          Object.entries(e.spec.classes || {}).map(([cls, s]) => `
+        <tr><td>${esc(name)}
+          <span class="dim">γmax ${e.spec.gamma_max}${
+            e.spec.draft_model
+              ? ` · draft ${esc(e.spec.draft_model)}` : ""}</span></td>
+        <td>${esc(cls)}</td>
+        <td>${s.gamma ?? 0}</td>
+        <td>${s.gamma_adapted ?? 0}</td>
+        <td>${s.accept_ema == null ? "—" : s.accept_ema.toFixed(2)}</td>
+        <td>${s.acceptance == null ? "—" : s.acceptance.toFixed(2)}</td>
+        <td>${s.proposed ?? 0}</td>
+        <td>${s.accepted ?? 0}</td>
+        <td><span class="pill ${s.off ? "pending" : "verified"}">${
+          s.off ? `off (${s.throttles ?? 0} throttles)` : "drafting"}
+          </span></td>
+        </tr>`)).join("") ||
+        '<tr><td class="dim" colspan="9">speculation disabled / no engines warm</td></tr>'}
+      </table>
       <h2 style="margin-top:.6rem">slo attribution</h2>
       <table><tr><th>class</th><th>turns</th><th>ttft mean</th>
         <th>slo misses</th><th>queue</th><th>prefill</th>
