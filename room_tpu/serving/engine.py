@@ -4890,8 +4890,18 @@ class ServingEngine:
 
         if lifecycle_dir is None:
             lifecycle_dir = lc.engine_dir(self.cfg.name)
-        prev_phase = self.lifecycle_phase
-        self.lifecycle_phase = "warming"
+        with self._lock:
+            # snapshot + flip atomically: a begin_drain() landing
+            # between an unlocked read and the 'warming' write would
+            # be clobbered — admission re-opens mid-shutdown and the
+            # exit guard below can no longer tell (the same hole the
+            # exit re-read closed, on the entry side). An engine
+            # already draining stays draining; the restore still runs
+            # (adopted sessions land in the manifest the drain
+            # writes).
+            prev_phase = self.lifecycle_phase
+            if prev_phase != "draining":
+                self.lifecycle_phase = "warming"
         summary = {"resumed": 0, "reprefill": 0, "skipped": 0,
                    "manifest": False}
         adopted_sess: dict[str, _Session] = {}
@@ -4929,8 +4939,10 @@ class ServingEngine:
             # back to serving off the stale entry snapshot — that would
             # reopen admission on an engine the process is quiescing
             if self.lifecycle_phase == "warming":
-                self.lifecycle_phase = "serving" \
-                    if prev_phase != "draining" else prev_phase
+                # only the entry flip (guarded against a draining
+                # prev_phase) writes 'warming', so reaching here means
+                # the restore owned the phase throughout
+                self.lifecycle_phase = "serving"
         return summary
 
     def _restore_dir(

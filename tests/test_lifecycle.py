@@ -319,6 +319,32 @@ def test_unquiesced_drain_spools_nothing_but_keeps_history(
     assert t2.new_tokens == c2
 
 
+def test_restore_entry_keeps_live_drain_visible_throughout(
+    make_engine, lc_dir, monkeypatch,
+):
+    """lockmap regression (lock-guarded-write on lifecycle_phase): the
+    restore entry path used to snapshot the phase and write 'warming'
+    WITHOUT the engine lock — an engine already draining had its phase
+    overwritten for the whole restore (re-opening the draining check
+    at admission) and a begin_drain landing inside the unlocked window
+    was clobbered outright. Restore on a draining engine must leave
+    'draining' visible at every point of the scan and at exit."""
+    eng = make_engine()
+    eng.begin_drain()
+    assert eng.lifecycle_phase == "draining"
+    seen = []
+    orig = eng._restore_dir
+
+    def spy(d, summary, adopted):
+        seen.append(eng.lifecycle_phase)
+        return orig(d, summary, adopted)
+
+    monkeypatch.setattr(eng, "_restore_dir", spy)
+    eng.restore_from_manifest(lc_dir)
+    assert seen and all(p == "draining" for p in seen), seen
+    assert eng.lifecycle_phase == "draining"
+
+
 def test_drain_byte_copies_disk_tier_spool(
     make_engine, lc_dir, monkeypatch,
 ):
