@@ -2649,8 +2649,14 @@ class ServingEngine:
             for sid in deferred:
                 with self._lock:
                     in_flight = self._session_in_flight(sid)
+                    if not in_flight:
+                        # consume the deferral atomically with the
+                        # in-flight check: release_session defers
+                        # under the same lock, so an unlocked discard
+                        # here could swallow a deferral booked for a
+                        # NEWER turn between check and discard
+                        self._deferred_release.discard(sid)
                 if not in_flight:
-                    self._deferred_release.discard(sid)
                     self._do_release(sid)
 
     def _restore_session_snapshot(self, sess: _Session, snap: dict) -> None:
@@ -4289,8 +4295,15 @@ class ServingEngine:
         self._slot_ahead[slot] = 0
         self._bump("turns_completed")
         trace_mod.finish(turn, self.scheduler.targets)
-        if sess.id in self._deferred_release:
-            self._deferred_release.discard(sess.id)
+        with self._lock:
+            # consume atomically against release_session's deferral
+            # add (cross-thread, same lock): an unlocked check-then-
+            # discard pair here races the add and can strand a
+            # deferral booked for the turn we are finishing
+            deferred_now = sess.id in self._deferred_release
+            if deferred_now:
+                self._deferred_release.discard(sess.id)
+        if deferred_now:
             self.sessions.pop(sess.id, None)
             self._release_session_prefix(sess)
             self.page_table.release(sess.id)

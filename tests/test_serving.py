@@ -1,6 +1,8 @@
 """Serving-engine tests: paged KV correctness vs dense cache, page table
 accounting, continuous batching, tool-call parking + resume, tokenizer."""
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -641,6 +643,33 @@ def test_release_active_session_is_deferred(engine_setup):
     eng.run_until_idle()
     assert eng.page_table.pages_of("live") == []
     assert t.finish_reason in ("stop", "length")
+
+
+def test_deferred_release_consumed_atomically_with_finish(engine_setup):
+    """lockmap regression (lock-guarded-write on _deferred_release):
+    _finish_turn and the admission sweep used to check-then-discard
+    the deferral set WITHOUT the engine lock, racing the cross-thread
+    add in release_session (under the lock). Both consumers now take
+    the lock, so a deferral is consumed exactly once: the session is
+    fully released at finish, nothing lingers in the deferral set, and
+    a release landing from another thread mid-stream still converges
+    to a released session."""
+    cfg, params = engine_setup
+    eng = make_engine(cfg, params)
+    t = eng.submit([1, 2, 3], session_id="live",
+                   sampling=SamplingParams(temperature=0.0,
+                                           max_new_tokens=6))
+    eng._admit()
+    releaser = threading.Thread(
+        target=eng.release_session, args=("live",))
+    releaser.start()
+    releaser.join()
+    assert "live" in eng._deferred_release
+    eng.run_until_idle()
+    assert t.finish_reason in ("stop", "length")
+    assert eng._deferred_release == set()
+    assert eng.page_table.pages_of("live") == []
+    assert "live" not in eng.sessions
 
 
 def test_resume_near_capacity_rejected_cleanly(engine_setup):
