@@ -3,19 +3,23 @@
 PY ?= python
 CPU_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
 
-.PHONY: test lint knobs-doc bench bench-tiny serve mcp native experiment dryrun clean
+.PHONY: test lint knobs-doc lock-graph bench bench-tiny serve mcp native experiment dryrun clean
 
 test:            ## hermetic suite on the virtual 8-device CPU mesh
 	$(PY) -m pytest tests/ -q
 
-lint:            ## roomlint (docs/static_analysis.md) + knobs.md freshness + ruff
+lint:            ## roomlint (docs/static_analysis.md) + knobs.md/lock_graph.dot freshness + ruff
 	$(PY) -m room_tpu.analysis
 	$(PY) -m room_tpu.analysis --check-docs
+	$(PY) -m room_tpu.analysis --graph | diff - docs/lock_graph.dot
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
 	else echo "ruff not installed; skipping generic lint tier"; fi
 
 knobs-doc:       ## regenerate docs/knobs.md from room_tpu/utils/knobs.py
 	$(PY) -m room_tpu.analysis --write-docs
+
+lock-graph:      ## regenerate docs/lock_graph.dot (lockmap, docs/static_analysis.md)
+	$(PY) -m room_tpu.analysis --graph > docs/lock_graph.dot
 
 bench:           ## decode benchmark (real accelerator; one JSON line)
 	$(PY) bench.py

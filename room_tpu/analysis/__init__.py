@@ -19,6 +19,14 @@ review comments machine-enforced on every PR:
    maps to a flight-recorder trace event + telemetry counter in
    ``serving/trace.py``'s FAULT_EVENTS, and ``faults.should_fire``
    stays wired through both (docs/observability.md).
+6. **lockmap — whole-program concurrency** (`lockmap`) — every lock
+   acquisition resolves to the central named-lock registry
+   (``room_tpu/utils/locks.py``), the acquisition graph (lexical
+   nesting + one call level deep) stays cycle-free, guarded fields
+   stay accessed under their inferred guard, and no blocking call
+   (socket/file I/O, timeout-less join/get/wait) runs under a lock.
+   ``--graph`` exports the graph as DOT; the runtime twin is the
+   ``ROOM_TPU_LOCKDEP`` witness in ``room_tpu/utils/lockdep.py``.
 
 Run: ``python -m room_tpu.analysis`` (or ``make lint``). Exit 0 =
 no unsuppressed violations. Intentional violations live in
@@ -36,16 +44,16 @@ from typing import Iterable, Optional
 
 from . import (
     dispatch_checker, fault_checker, knob_checker, knobs_doc,
-    lock_checker, trace_checker,
+    lock_checker, lockmap, trace_checker,
 )
 from .common import (
-    SourceFile, Violation, apply_suppressions, iter_py_files,
-    load_suppressions,
+    SourceCache, SourceFile, Violation, apply_suppressions,
+    iter_py_files, load_suppressions,
 )
 
 __all__ = [
-    "Violation", "SourceFile", "run_checks", "DEFAULT_SCAN_ROOTS",
-    "SUPPRESS_FILE", "KNOBS_DOC",
+    "Violation", "SourceFile", "SourceCache", "run_checks",
+    "DEFAULT_SCAN_ROOTS", "SUPPRESS_FILE", "KNOBS_DOC",
 ]
 
 # the tree the per-file checkers walk by default; tests/ is only read
@@ -76,17 +84,28 @@ def run_checks(
     """Run the suite; returns (active, suppressed) violations.
 
     ``roots=None`` scans DEFAULT_SCAN_ROOTS. ``cross_checks`` adds the
-    repo-level passes (fault coverage vs tests+docs, knob docs
-    freshness) on top of the per-file walks.
+    repo-level passes (fault coverage vs tests+docs, the lockmap
+    whole-program concurrency pass, knob docs freshness) on top of
+    the per-file walks. One SourceCache backs every pass, so each
+    file is read and ``ast.parse``d exactly once per run.
     """
-    fault_points = fault_checker.load_fault_points(repo_root)
+    cache = SourceCache(repo_root)
+    fault_points = fault_checker.load_fault_points(repo_root, cache)
     violations: list[Violation] = []
-    for src in iter_py_files(roots or DEFAULT_SCAN_ROOTS, repo_root):
+    for src in iter_py_files(roots or DEFAULT_SCAN_ROOTS, repo_root,
+                             cache):
         violations += check_file(src, fault_points)
     if cross_checks:
-        violations += fault_checker.check_coverage(repo_root)
+        violations += fault_checker.check_coverage(
+            repo_root, cache=cache
+        )
         violations += trace_checker.check_fault_trace_coverage(
-            repo_root
+            repo_root, cache
+        )
+        # whole-program concurrency pass: always over the full tree
+        # (a partial graph would under-report cycles)
+        violations += lockmap.check_whole_program(
+            repo_root, DEFAULT_SCAN_ROOTS, cache
         )
         violations += knob_checker.check_docs(
             os.path.join(repo_root, KNOBS_DOC)

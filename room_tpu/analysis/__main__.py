@@ -38,6 +38,9 @@ def main(argv=None) -> int:
     ap.add_argument("--check-docs", action="store_true",
                     help="exit 1 if docs/knobs.md is stale w.r.t. the "
                          "registry")
+    ap.add_argument("--graph", action="store_true",
+                    help="emit the whole-program lock-acquisition "
+                         "graph as DOT on stdout and exit")
     args = ap.parse_args(argv)
 
     root = args.repo_root
@@ -50,6 +53,15 @@ def main(argv=None) -> int:
                 os.path.dirname(os.path.abspath(__file__))
             ))
     doc_path = os.path.join(root, KNOBS_DOC)
+
+    if args.graph:
+        from . import DEFAULT_SCAN_ROOTS, lockmap
+        from .common import iter_py_files
+
+        facts = lockmap.collect_facts(iter_py_files(
+            args.paths or DEFAULT_SCAN_ROOTS, root))
+        sys.stdout.write(lockmap.render_dot(facts))
+        return 0
 
     if args.write_docs:
         knobs_doc.write(doc_path)

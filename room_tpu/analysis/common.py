@@ -134,10 +134,64 @@ class SourceFile:
                          self.qualname_at(line))
 
 
-def iter_py_files(roots: Iterable[str], repo_root: str
-                  ) -> Iterable[SourceFile]:
-    """Yield SourceFile for every .py under the given roots (files or
-    dirs), with paths reported relative to the repo root."""
+class SourceCache:
+    """One ``ast.parse`` (and one disk read) per file per run.
+
+    Every checker used to re-read and re-parse what it needed —
+    ``load_fault_points`` alone parsed ``faults.py`` three times per
+    run (run_checks, the trace cross-check, ``_should_fire_calls``),
+    and the whole-program lockmap pass would have doubled the tree
+    walk. All paths now funnel through one cache keyed on the
+    absolute path; ``tests/test_analysis.py`` pins the
+    exactly-once-per-file property."""
+
+    def __init__(self, repo_root: str) -> None:
+        self.repo_root = repo_root
+        self._sources: dict[str, Optional[SourceFile]] = {}
+        self._texts: dict[str, str] = {}
+
+    def _abs(self, path: str) -> str:
+        return path if os.path.isabs(path) else \
+            os.path.join(self.repo_root, path)
+
+    def text(self, path: str) -> str:
+        """Raw file text (for regex-only passes: test mapping, doc
+        tables)."""
+        p = self._abs(path)
+        if p not in self._texts:
+            src = self._sources.get(p)
+            if src is not None:
+                self._texts[p] = src.text
+            else:
+                self._texts[p] = open(p, encoding="utf-8").read()
+        return self._texts[p]
+
+    def source(self, path: str) -> Optional[SourceFile]:
+        """Parsed SourceFile, or None on a syntax error (the ruff
+        tier owns those)."""
+        p = self._abs(path)
+        if p not in self._sources:
+            rel = os.path.relpath(p, self.repo_root)
+            try:
+                self._sources[p] = SourceFile(
+                    p, text=self.text(path), rel=rel
+                )
+            except SyntaxError:
+                self._sources[p] = None
+        return self._sources[p]
+
+    def tree(self, path: str) -> ast.AST:
+        """The parsed AST for checkers that only need the tree."""
+        src = self.source(path)
+        if src is None:
+            raise SyntaxError(f"unparsable: {path}")
+        return src.tree
+
+
+def iter_py_paths(roots: Iterable[str], repo_root: str
+                  ) -> Iterable[str]:
+    """Absolute paths of every .py under the given roots (files or
+    dirs), deduped and sorted."""
     seen = set()
     for root in roots:
         absroot = os.path.join(repo_root, root) \
@@ -154,15 +208,24 @@ def iter_py_files(roots: Iterable[str], repo_root: str
                     for f in sorted(filenames) if f.endswith(".py")
                 )
         for p in sorted(paths):
-            if p in seen:
-                continue
-            seen.add(p)
-            rel = os.path.relpath(p, repo_root)
-            try:
-                yield SourceFile(p, rel=rel)
-            except SyntaxError:
-                # generic lint (ruff tier) owns syntax errors
-                continue
+            if p not in seen:
+                seen.add(p)
+                yield p
+
+
+def iter_py_files(roots: Iterable[str], repo_root: str,
+                  cache: Optional[SourceCache] = None
+                  ) -> Iterable[SourceFile]:
+    """Yield SourceFile for every .py under the given roots (files or
+    dirs), with paths reported relative to the repo root. With a
+    cache, each file is parsed at most once per run across all
+    passes."""
+    if cache is None:
+        cache = SourceCache(repo_root)
+    for p in iter_py_paths(roots, repo_root):
+        src = cache.source(p)
+        if src is not None:
+            yield src
 
 
 def load_suppressions(path: str) -> list[SuppressEntry]:

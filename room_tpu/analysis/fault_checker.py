@@ -26,7 +26,7 @@ import os
 import re
 from typing import Optional
 
-from .common import SourceFile, Violation
+from .common import SourceCache, SourceFile, Violation
 
 FAULTS_MODULE = os.path.join("room_tpu", "serving", "faults.py")
 
@@ -40,11 +40,15 @@ _ENV_SPEC_RE = re.compile(r"ROOM_TPU_FAULTS[^\n]*?['\"]([a-z_:,;=.0-9 ]+)['\"]")
 _DOC_ROW_RE = re.compile(r"^\| `([a-z_]+)` \|")
 
 
-def load_fault_points(repo_root: str) -> tuple[str, ...]:
+def load_fault_points(repo_root: str,
+                      cache: Optional[SourceCache] = None
+                      ) -> tuple[str, ...]:
     """Parse FAULT_POINTS out of faults.py without importing the
     serving package (which drags in jax)."""
     path = os.path.join(repo_root, FAULTS_MODULE)
-    tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    if cache is None:
+        cache = SourceCache(repo_root)
+    tree = cache.tree(FAULTS_MODULE)
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for tgt in node.targets:
@@ -68,8 +72,11 @@ def check_coverage(
     repo_root: str,
     tests_dir: str = "tests",
     doc_path: str = os.path.join("docs", "chaos.md"),
+    cache: Optional[SourceCache] = None,
 ) -> list[Violation]:
-    points = load_fault_points(repo_root)
+    if cache is None:
+        cache = SourceCache(repo_root)
+    points = load_fault_points(repo_root, cache)
     out: list[Violation] = []
 
     # ---- test mapping: point -> test files that arm it ---------------
@@ -83,7 +90,7 @@ def check_coverage(
             if not (fname.startswith("test_") and fname.endswith(".py")):
                 continue
             fpath = os.path.join(dirpath, fname)
-            text = open(fpath, encoding="utf-8").read()
+            text = cache.text(fpath)
             rel = os.path.relpath(fpath, repo_root)
             for name in _points_mentioned(text):
                 if name in tested:
@@ -111,11 +118,11 @@ def check_coverage(
     doc_abs = os.path.join(repo_root, doc_path)
     documented: dict[str, int] = {}
     if os.path.exists(doc_abs):
-        with open(doc_abs, encoding="utf-8") as fh:
-            for lineno, line in enumerate(fh, 1):
-                m = _DOC_ROW_RE.match(line)
-                if m:
-                    documented[m.group(1)] = lineno
+        for lineno, line in enumerate(
+                cache.text(doc_abs).split("\n"), 1):
+            m = _DOC_ROW_RE.match(line)
+            if m:
+                documented[m.group(1)] = lineno
     for name in points:
         if name not in documented:
             out.append(Violation(

@@ -1,0 +1,1016 @@
+"""roomlint checker 6 — lockmap: whole-program concurrency analysis.
+
+The serving stack holds ~40 registered locks (``room_tpu/utils/locks.py``)
+across ~30 modules; every threaded PR since the fleet landed has
+burned review passes hand-finding lock-order inversions, unguarded
+shared state, and blocking calls held under a lock. This pass makes
+those review comments machine-checked:
+
+1. **Lock-acquisition graph** — every ``with <lock>:`` site resolves
+   to a registry name (via the registered (module, class, attr)
+   binding, the registry's ``hints`` spellings for foreign
+   acquisitions like ``fleet._lock``, attribute-type inference for
+   ``self._store._lock``, or an explicit ``# lockmap: name=<name>``
+   pin). Nesting — lexical, and one call-graph level deep (calls made
+   under a held lock into functions that acquire locks of their own) —
+   contributes directed edges. Rules:
+
+   - ``lock-order-cycle``: the named graph has a cycle — two threads
+     walking the cycle from different entry points deadlock.
+   - ``lock-self-nest``: a *same-instance* re-acquire of a
+     non-reentrant lock (lexical self-nesting, a ``self.method()``
+     call under the lock into a method that takes it again, or any
+     self-edge on a module-global lock) — guaranteed deadlock.
+   - ``lock-unresolved``: a lock-looking ``with`` site the registry
+     cannot name. Register the lock or pin the site.
+
+2. **Guarded-state inference** — a field written under the same named
+   lock at >= 2 sites is *guarded* by it. Elsewhere:
+
+   - ``lock-guarded-write``: a write to a guarded field without the
+     guard held (TOCTOU / lost-update window);
+   - ``lock-guarded-iter``: direct iteration over a guarded container
+     without the guard held (dict-changed-size crash on the GIL's
+     honor system). Plain loads stay legal — the tree's documented
+     convention is that single GIL-atomic reads are sanctioned
+     snapshots.
+
+   ``__init__``-time writes are construction (happens-before publish)
+   and exempt, as are helpers named ``*_locked`` (the documented
+   caller-holds-the-lock convention).
+
+3. **Blocking-call-under-lock taxonomy** (``blocking-under-lock``) —
+   the lock_checker's device-sync rule generalized to the classes the
+   PR-review lists kept catching by hand: socket send/recv, file I/O
+   (``open``, ``os.replace``/``fsync``, ``shutil`` copies, pathlib
+   read/write), ``Thread.join()`` with no timeout, and timeout-less
+   ``Queue.get()`` / ``Event.wait()`` / ``Condition.wait()`` — any of
+   them lexically under a held lock stalls every thread queued on it.
+
+``python -m room_tpu.analysis --graph`` renders the extracted graph
+as DOT (docs/static_analysis.md has the docs pipeline). The runtime
+twin is ``room_tpu/utils/lockdep.py``; tests pin that lockdep's
+observed edges are a subset of this pass's static graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .common import SourceCache, SourceFile, Violation, iter_py_files
+
+_PIN_RE = re.compile(r"lockmap:\s*name=([a-z0-9_]+)")
+
+# function-name suffix documenting the caller-holds-the-lock contract
+_LOCKED_SUFFIX = "_locked"
+
+# mutating container methods that count as writes for guard inference
+_MUTATORS = (
+    "append", "appendleft", "add", "remove", "discard", "pop",
+    "popleft", "popitem", "clear", "update", "extend", "insert",
+    "setdefault", "sort",
+)
+
+_ITER_METHODS = ("items", "values", "keys")
+
+
+def _locks_registry():
+    from room_tpu.utils.locks import LOCK_REGISTRY
+
+    return LOCK_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# per-file fact extraction
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Acquire:
+    name: Optional[str]        # resolved registry name, None = unresolved
+    expr: str                  # source spelling of the lock expression
+    line: int
+    qual: str                  # enclosing function qualname
+    held: tuple                # resolved names held when acquiring
+    base_kind: str             # "self" | "global" | "foreign"
+
+
+@dataclass
+class _CallSite:
+    line: int
+    qual: str
+    held: tuple                # resolved names held at the call
+    callee: Optional[tuple]    # (module, qualname) if resolved
+    same_instance: bool        # self.method() / same-module global fn
+
+
+@dataclass
+class _AttrEvent:
+    cls: str                   # owning class (resolved, maybe foreign)
+    attr: str
+    line: int
+    qual: str
+    held: tuple
+    kind: str                  # "write" | "iter"
+
+
+@dataclass
+class _FileFacts:
+    src: SourceFile
+    module: str                # repo-relative path, forward slashes
+    acquires: list = field(default_factory=list)
+    calls: list = field(default_factory=list)
+    attr_events: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)  # (desc, node, held)
+    # function qualname -> set of resolved lock names acquired directly
+    fn_locks: dict = field(default_factory=dict)
+    # class -> attr -> ClassName (self.attr = ClassName(...))
+    attr_types: dict = field(default_factory=dict)
+    # import alias -> dotted module name
+    imports: dict = field(default_factory=dict)
+    classes: set = field(default_factory=set)
+
+
+def _module_rel(src: SourceFile) -> str:
+    return src.path.replace(os.sep, "/")
+
+
+def _is_lockish(expr: ast.AST) -> Optional[tuple]:
+    """(base_src, attr_or_name) when the with-item expression looks
+    like a lock acquisition; base_src '' for a bare name."""
+    if isinstance(expr, ast.Name):
+        if "lock" in expr.id.lower():
+            return ("", expr.id)
+        return None
+    if isinstance(expr, ast.Attribute):
+        if "lock" not in expr.attr.lower():
+            return None
+        try:
+            base = ast.unparse(expr.value)
+        except Exception:
+            return None
+        return (base, expr.attr)
+    return None
+
+
+def _dotted_module_to_rel(dotted: str) -> Optional[str]:
+    """'room_tpu.serving.trace' -> 'room_tpu/serving/trace.py'."""
+    if not dotted.startswith("room_tpu"):
+        return None
+    return dotted.replace(".", "/") + ".py"
+
+
+def _collect_imports(facts: _FileFacts) -> None:
+    """alias -> dotted room_tpu module, resolving relative imports
+    against the file's package path."""
+    pkg_parts = facts.module.split("/")[:-1]   # e.g. room_tpu/serving
+    for node in ast.walk(facts.src.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("room_tpu"):
+                    facts.imports[a.asname or a.name.split(".")[0]] = \
+                        a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                prefix = ".".join(base)
+                if node.module:
+                    prefix = f"{prefix}.{node.module}" if prefix \
+                        else node.module
+            elif node.module and node.module.startswith("room_tpu"):
+                prefix = node.module
+            else:
+                continue
+            for a in node.names:
+                facts.imports[a.asname or a.name] = \
+                    f"{prefix}.{a.name}"
+
+
+def _collect_attr_types(facts: _FileFacts) -> None:
+    """Per class: self.X = ClassName(...) / self.X: ClassName."""
+    tree = facts.src.tree
+    for cls_node in ast.walk(tree):
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        facts.classes.add(cls_node.name)
+        types = facts.attr_types.setdefault(cls_node.name, {})
+        aliases: list[tuple] = []   # self.X = self.Y
+        for node in ast.walk(cls_node):
+            tgt = val = ann = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                tgt, val, ann = node.target, node.value, \
+                    node.annotation
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            cname = None
+            if isinstance(val, ast.Call):
+                fn = val.func
+                if isinstance(fn, ast.Name):
+                    cname = fn.id
+                elif isinstance(fn, ast.Attribute):
+                    cname = fn.attr
+            elif isinstance(val, ast.Attribute) and \
+                    isinstance(val.value, ast.Name) and \
+                    val.value.id == "self":
+                # alias: self._queue = self.scheduler — resolve to the
+                # aliased attribute's type once the walk completes
+                aliases.append((tgt.attr, val.attr))
+            if (not cname or not cname[:1].isupper()) and \
+                    ann is not None:
+                # Optional["TieredKVStore"] and friends: the first
+                # class-looking name inside the annotation
+                for sub in ast.walk(ann):
+                    cand = None
+                    if isinstance(sub, ast.Name):
+                        cand = sub.id
+                    elif isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        cand = sub.value
+                    if cand and cand[:1].isupper() and cand not in (
+                            "Optional", "List", "Dict", "Set",
+                            "Tuple", "Any", "Callable", "Union",
+                            "Iterable", "Sequence", "Mapping"):
+                        cname = cand
+                        break
+            if cname and cname[:1].isupper():
+                types.setdefault(tgt.attr, cname)
+        for _ in range(3):   # short alias chains converge fast
+            for dst, src_attr in aliases:
+                if src_attr in types:
+                    types.setdefault(dst, types[src_attr])
+
+
+class _Resolver:
+    """Whole-program lock + callee + attribute-owner resolution over
+    the registry and the scanned files' class index."""
+
+    def __init__(self, registry: dict, facts_by_module: dict) -> None:
+        self.registry = registry
+        self.facts_by_module = facts_by_module
+        # class name -> module (only when unambiguous tree-wide)
+        self.class_index: dict[str, Optional[str]] = {}
+        for mod, facts in facts_by_module.items():
+            for cname in facts.classes:
+                if cname in self.class_index and \
+                        self.class_index[cname] != mod:
+                    self.class_index[cname] = None   # ambiguous
+                else:
+                    self.class_index[cname] = mod
+        # hint spelling -> decl (unambiguous only)
+        self.hint_index: dict[tuple, Optional[object]] = {}
+        for decl in registry.values():
+            for hint in decl.hints:
+                key = (hint, decl.attr)
+                if key in self.hint_index and \
+                        self.hint_index[key] is not decl:
+                    self.hint_index[key] = None
+                else:
+                    self.hint_index[key] = decl
+        # attr -> decls (for unique-attr fallback)
+        self.attr_index: dict[str, list] = {}
+        for decl in registry.values():
+            self.attr_index.setdefault(decl.attr, []).append(decl)
+
+    def decl_by_binding(self, module: str, cls: str, attr: str):
+        for decl in self.registry.values():
+            if decl.module == module and decl.cls == cls \
+                    and decl.attr == attr:
+                return decl
+        return None
+
+    def owner_class(self, facts: _FileFacts, cls_ctx: str,
+                    base: str) -> Optional[tuple]:
+        """(module, ClassName) the expression `base` denotes, via
+        self, attribute-type inference, or registry hints."""
+        if base == "self" and cls_ctx:
+            return (facts.module, cls_ctx)
+        if base.startswith("self.") and cls_ctx:
+            attr = base.split(".", 1)[1]
+            cname = facts.attr_types.get(cls_ctx, {}).get(attr)
+            if cname:
+                mod = self.class_index.get(cname)
+                if mod:
+                    return (mod, cname)
+        return None
+
+    def resolve_lock(self, facts: _FileFacts, cls_ctx: str,
+                     base: str, attr: str) -> Optional[object]:
+        """The LockDecl a with-site acquires, or None."""
+        # bare module-global name
+        if base == "":
+            for decl in self.attr_index.get(attr, []):
+                if decl.cls == "" and decl.module == facts.module:
+                    return decl
+            cands = [d for d in self.attr_index.get(attr, [])
+                     if d.cls == ""]
+            return cands[0] if len(cands) == 1 else None
+        # exact owner (self.X, or inferred object type)
+        owner = self.owner_class(facts, cls_ctx, base)
+        if owner is not None:
+            mod, cname = owner
+            decl = self.decl_by_binding(mod, cname, attr)
+            if decl is not None:
+                return decl
+            # class defined elsewhere than its registration (rare) —
+            # fall through to hint/unique resolution
+        # registered hint spellings (fleet._lock, rec.lock, ...)
+        hint = self.hint_index.get((base, attr)) or \
+            self.hint_index.get((base.split(".")[-1], attr))
+        if hint is not None:
+            return hint
+        # unique attribute name tree-wide
+        cands = self.attr_index.get(attr, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def resolve_callee(self, facts: _FileFacts, cls_ctx: str,
+                       call: ast.Call) -> tuple:
+        """((module, qualname) or None, same_instance bool)."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # same-module function, or imported function
+            if fn.id in facts.imports:
+                dotted = facts.imports[fn.id]
+                mod = _dotted_module_to_rel(
+                    ".".join(dotted.split(".")[:-1]))
+                if mod in self.facts_by_module:
+                    return ((mod, dotted.split(".")[-1]), False)
+                return (None, False)
+            return ((facts.module, fn.id), True)
+        if not isinstance(fn, ast.Attribute):
+            return (None, False)
+        meth = fn.attr
+        try:
+            base = ast.unparse(fn.value)
+        except Exception:
+            return (None, False)
+        if base == "self" and cls_ctx:
+            return ((facts.module, f"{cls_ctx}.{meth}"), True)
+        # imported module alias: trace_mod.note_event(...)
+        if isinstance(fn.value, ast.Name) and base in facts.imports:
+            dotted = facts.imports[base]
+            mod = _dotted_module_to_rel(dotted)
+            if mod in self.facts_by_module:
+                return ((mod, meth), False)
+            return (None, False)
+        owner = self.owner_class(facts, cls_ctx, base)
+        if owner is not None:
+            mod, cname = owner
+            return ((mod, f"{cname}.{meth}"), False)
+        # registry hints name the class for conventional spellings
+        for decl in self.registry.values():
+            last = base.split(".")[-1]
+            if decl.cls and (base in decl.hints or last in decl.hints):
+                return ((decl.module, f"{decl.cls}.{meth}"), False)
+        return (None, False)
+
+    def owner_for_attr_event(self, facts: _FileFacts, cls_ctx: str,
+                             base: str) -> Optional[tuple]:
+        """(module, class) owning `base.attr` state, for guard
+        inference — self and hint spellings only (inferred foreign
+        object types are too weak a signal for a gate)."""
+        if base == "self" and cls_ctx:
+            return (facts.module, cls_ctx)
+        for decl in self.registry.values():
+            last = base.split(".")[-1]
+            if decl.cls and (base in decl.hints or last in decl.hints):
+                return (decl.module, decl.cls)
+        return None
+
+
+# ---- blocking-call taxonomy -------------------------------------------
+
+def _is_none_const(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _is_true_const(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _timeout_bounds(node: ast.Call, positional_idx: Optional[int]
+                    ) -> bool:
+    """True when the call carries a timeout that actually bounds it —
+    ``timeout=None`` (keyword or positional) blocks forever and does
+    NOT count."""
+    for kw in node.keywords:
+        if kw.arg == "timeout":
+            return not _is_none_const(kw.value)
+    if positional_idx is not None and len(node.args) > positional_idx:
+        return not _is_none_const(node.args[positional_idx])
+    return False
+
+
+def _blocking_call(node: ast.AST) -> Optional[str]:
+    """Human description when the node is a blocking call from the
+    under-a-lock taxonomy (docs/static_analysis.md)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    kwargs = {k.arg for k in node.keywords}
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "file I/O open()"
+        if fn.id == "create_connection":
+            return "socket create_connection()"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    base = ""
+    if isinstance(fn.value, ast.Name):
+        base = fn.value.id
+    attr = fn.attr
+    if attr in ("sendall", "recv", "recv_into", "accept"):
+        return f"socket {attr}()"
+    if base == "socket" and attr == "create_connection":
+        return "socket create_connection()"
+    if base == "os" and attr in ("replace", "fsync", "rename"):
+        return f"file I/O os.{attr}()"
+    if base == "shutil" and attr in (
+            "copy", "copy2", "copyfile", "copyfileobj", "copytree",
+            "move", "rmtree"):
+        return f"file I/O shutil.{attr}()"
+    if attr in ("read_bytes", "write_bytes", "read_text",
+                "write_text"):
+        return f"file I/O .{attr}()"
+    if attr == "join":
+        # Thread.join(timeout=...); str.join takes a real iterable,
+        # so join() / join(None) / join(timeout=None) are thread-like
+        args_blocking = not node.args or (
+            len(node.args) == 1 and _is_none_const(node.args[0]))
+        if args_blocking and not _timeout_bounds(node, 0):
+            return "Thread.join() without timeout"
+        return None
+    if attr == "get":
+        # Queue.get(block=True, timeout=None); dict.get needs a real
+        # key, so the queue-like spellings are zero-arg, block=True
+        # (positional or keyword), and any timeout=None
+        block_true = (
+            (not node.args and ("block" not in kwargs
+                                or any(kw.arg == "block"
+                                       and _is_true_const(kw.value)
+                                       for kw in node.keywords)))
+            or (node.args and _is_true_const(node.args[0]))
+        )
+        known_kwargs = kwargs <= {"block", "timeout"}
+        if block_true and known_kwargs and len(node.args) <= 2 and \
+                not _timeout_bounds(node, 1):
+            return "Queue.get() without timeout"
+        return None
+    if attr == "wait":
+        # Event/Condition/Popen .wait(timeout=...); wait(None) and
+        # wait(timeout=None) block exactly like wait()
+        args_blocking = not node.args or (
+            len(node.args) == 1 and _is_none_const(node.args[0]))
+        if args_blocking and not _timeout_bounds(node, 0):
+            return ".wait() without timeout"
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# extraction visitor
+# ---------------------------------------------------------------------------
+
+class _FunctionWalker(ast.NodeVisitor):
+    """Walks one module; tracks the enclosing class/function and the
+    lexical stack of held (resolved) locks."""
+
+    def __init__(self, facts: _FileFacts, resolver: _Resolver) -> None:
+        self.facts = facts
+        self.resolver = resolver
+        self.cls_stack: list[str] = []
+        self.fn_stack: list[str] = []
+        self.held: list = []       # resolved names (None = unresolved)
+
+    # -- context maintenance --
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.cls_stack.append(node.name)
+        self.generic_visit(node)
+        self.cls_stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        self.fn_stack.append(node.name)
+        outer_held, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = outer_held
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    @property
+    def _cls(self) -> str:
+        return self.cls_stack[-1] if self.cls_stack else ""
+
+    @property
+    def _qual(self) -> str:
+        parts = list(self.cls_stack) + list(self.fn_stack)
+        return ".".join(parts)
+
+    def _held_names(self) -> tuple:
+        return tuple(h for h in self.held if h)
+
+    # -- the interesting nodes --
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = 0
+        for item in node.items:
+            lockish = _is_lockish(item.context_expr)
+            if lockish is None:
+                continue
+            base, attr = lockish
+            pin = _PIN_RE.search(
+                self.facts.src.lines[node.lineno - 1]
+                if node.lineno <= len(self.facts.src.lines) else ""
+            )
+            decl = None
+            if pin:
+                decl = self.resolver.registry.get(pin.group(1))
+            if decl is None:
+                decl = self.resolver.resolve_lock(
+                    self.facts, self._cls, base, attr)
+            name = decl.name if decl is not None else None
+            base_kind = "self" if base == "self" else (
+                "global" if base == "" else "foreign")
+            self.facts.acquires.append(_Acquire(
+                name, f"{base}.{attr}" if base else attr,
+                node.lineno, self._qual, self._held_names(), base_kind,
+            ))
+            if self.fn_stack:
+                self.facts.fn_locks.setdefault(
+                    self._qual, []).append(
+                        (name, base_kind, node.lineno))
+            self.held.append(name)
+            acquired += 1
+        self.generic_visit(node)
+        for _ in range(acquired):
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        held = self._held_names()
+        if held:
+            desc = _blocking_call(node)
+            if desc:
+                self.facts.blocking.append((desc, node, held))
+            callee, same_inst = self.resolver.resolve_callee(
+                self.facts, self._cls, node)
+            if callee is not None:
+                self.facts.calls.append(_CallSite(
+                    node.lineno, self._qual, held, callee, same_inst,
+                ))
+        # attribute mutator calls count as writes
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS \
+                and isinstance(fn.value, ast.Attribute):
+            self._attr_event(fn.value, "write", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._maybe_write_target(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._maybe_write_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            self._maybe_write_target(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._maybe_iter(node.iter, node.lineno)
+        self.generic_visit(node)
+
+    def visit_comprehension_iter(self, node) -> None:
+        for gen in node.generators:
+            self._maybe_iter(gen.iter, node.lineno)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_comprehension_iter
+    visit_SetComp = visit_comprehension_iter
+    visit_DictComp = visit_comprehension_iter
+    visit_GeneratorExp = visit_comprehension_iter
+
+    # -- attr event helpers --
+
+    def _maybe_write_target(self, tgt: ast.AST, line: int) -> None:
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value
+        if isinstance(tgt, ast.Attribute):
+            self._attr_event(tgt, "write", line)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._maybe_write_target(el, line)
+
+    def _maybe_iter(self, expr: ast.AST, line: int) -> None:
+        tgt = expr
+        if isinstance(tgt, ast.Call) and \
+                isinstance(tgt.func, ast.Attribute) and \
+                tgt.func.attr in _ITER_METHODS and not tgt.args:
+            tgt = tgt.func.value
+        if isinstance(tgt, ast.Attribute):
+            self._attr_event(tgt, "iter", line)
+
+    def _attr_event(self, attr_node: ast.Attribute, kind: str,
+                    line: int) -> None:
+        attr = attr_node.attr
+        if attr.startswith("__") or "lock" in attr.lower():
+            return
+        try:
+            base = ast.unparse(attr_node.value)
+        except Exception:
+            return
+        owner = self.resolver.owner_for_attr_event(
+            self.facts, self._cls, base)
+        if owner is None:
+            return
+        self.facts.attr_events.append(_AttrEvent(
+            f"{owner[0]}::{owner[1]}", attr, line, self._qual,
+            self._held_names(), kind,
+        ))
+
+
+# ---------------------------------------------------------------------------
+# whole-program passes
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LockGraph:
+    # (a, b) -> list of witness strings "path:line (qual) [via]"
+    edges: dict
+    # name -> decl
+    nodes: dict
+    # self-edges that carry same-instance evidence: name -> witnesses
+    self_nests: dict
+    unresolved: list           # (facts, _Acquire)
+
+
+def collect_facts(sources: Iterable[SourceFile]) -> dict:
+    registry = _locks_registry()
+    facts_by_module: dict[str, _FileFacts] = {}
+    all_facts = []
+    for src in sources:
+        facts = _FileFacts(src, _module_rel(src))
+        facts_by_module[facts.module] = facts
+        all_facts.append(facts)
+    # imports / class index / attr types must exist tree-wide BEFORE
+    # the resolver snapshots them and the walk resolves foreign bases
+    for facts in all_facts:
+        _collect_imports(facts)
+        _collect_attr_types(facts)
+    resolver = _Resolver(registry, facts_by_module)
+    for facts in all_facts:
+        _FunctionWalker(facts, resolver).visit(facts.src.tree)
+    return facts_by_module
+
+
+def build_graph(facts_by_module: dict) -> LockGraph:
+    registry = _locks_registry()
+    edges: dict = {}
+    self_nests: dict = {}
+    unresolved = []
+
+    def witness(facts, line, qual, via) -> str:
+        where = f"{facts.module}:{line}"
+        if qual:
+            where += f" ({qual})"
+        return f"{where} [{via}]"
+
+    def add_edge(a: str, b: str, w: str, same_instance: bool) -> None:
+        if a == b:
+            decl = registry.get(a)
+            multi = decl is not None and decl.multi_instance
+            if same_instance or not multi:
+                self_nests.setdefault(a, []).append(w)
+            return
+        edges.setdefault((a, b), []).append(w)
+
+    # function qualname index -> direct acquisitions
+    fn_index: dict[tuple, list] = {}
+    for mod, facts in facts_by_module.items():
+        for qual, entries in facts.fn_locks.items():
+            fn_index[(mod, qual)] = entries
+
+    for mod, facts in facts_by_module.items():
+        for acq in facts.acquires:
+            if acq.name is None:
+                unresolved.append((facts, acq))
+                continue
+            for held in acq.held:
+                add_edge(
+                    held, acq.name,
+                    witness(facts, acq.line, acq.qual, "nested"),
+                    same_instance=(acq.base_kind in
+                                   ("self", "global")),
+                )
+        for call in facts.calls:
+            entries = fn_index.get(call.callee)
+            if not entries:
+                continue
+            cmod, cqual = call.callee
+            for (name, base_kind, line) in entries:
+                if name is None:
+                    continue
+                for held in call.held:
+                    add_edge(
+                        held, name,
+                        witness(facts, call.line, call.qual,
+                                f"calls {cqual}"),
+                        same_instance=(
+                            call.same_instance
+                            and base_kind in ("self", "global")
+                        ),
+                    )
+    return LockGraph(edges, dict(registry), self_nests, unresolved)
+
+
+def _find_cycles(edges: dict) -> list:
+    """Strongly connected components with >1 node (Tarjan)."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: dict = {}
+    low: dict = {}
+    on_stack: dict = {}
+    stack: list = []
+    counter = [0]
+    sccs: list = []
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack[w] = True
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif on_stack.get(w):
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def check_lock_graph(facts_by_module: dict) -> list:
+    graph = build_graph(facts_by_module)
+    out: list[Violation] = []
+
+    for facts, acq in graph.unresolved:
+        v = facts.src.violation(
+            "lock-unresolved", acq.line,
+            f"cannot resolve lock acquisition '{acq.expr}' to a "
+            "registered lock — register it in "
+            "room_tpu/utils/locks.py or pin the site with "
+            "'# lockmap: name=<name>'",
+        )
+        if v:
+            out.append(v)
+
+    for name, witnesses in sorted(graph.self_nests.items()):
+        decl = graph.nodes.get(name)
+        kind = decl.kind if decl else "lock"
+        if kind == "rlock":
+            continue   # reentrant by design
+        first = witnesses[0]
+        path, _, rest = first.partition(":")
+        line = int(rest.split(" ")[0].split("[")[0] or 1)
+        out.append(Violation(
+            "lock-self-nest", path, line,
+            f"non-reentrant lock '{name}' re-acquired while already "
+            f"held by the same holder — guaranteed deadlock "
+            f"({len(witnesses)} site(s); first: {first})",
+        ))
+
+    for cycle in _find_cycles(graph.edges):
+        witnesses = []
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            ws = graph.edges.get((a, b))
+            if ws:
+                witnesses.append(f"{a}->{b} at {ws[0]}")
+        first_edge = None
+        for (a, b), ws in sorted(graph.edges.items()):
+            if a in cycle and b in cycle:
+                first_edge = ws[0]
+                break
+        path, _, rest = (first_edge or "?:1").partition(":")
+        try:
+            line = int(rest.split(" ")[0].split("[")[0])
+        except ValueError:
+            line = 1
+        out.append(Violation(
+            "lock-order-cycle", path, line,
+            "lock-order cycle {" + " -> ".join(cycle + [cycle[0]])
+            + "}: threads entering at different points deadlock; "
+            "witnesses: " + "; ".join(witnesses),
+        ))
+    return out
+
+
+def check_guarded_state(facts_by_module: dict) -> list:
+    """Guard inference + unguarded-access rules over every class's
+    attribute events."""
+    out: list[Violation] = []
+    # (owner, attr) -> events
+    by_field: dict = {}
+    for facts in facts_by_module.values():
+        for ev in facts.attr_events:
+            by_field.setdefault((ev.cls, ev.attr), []).append(
+                (facts, ev))
+
+    for (owner, attr), events in sorted(by_field.items()):
+        # guard inference: writes under a named lock, >= 2 distinct
+        # sites agreeing on the same lock
+        write_sites: dict = {}
+        for facts, ev in events:
+            if ev.kind != "write" or not ev.held:
+                continue
+            if ev.qual.split(".")[-1] in ("__init__", "__post_init__"):
+                continue
+            for name in ev.held:
+                write_sites.setdefault(name, set()).add(
+                    (facts.module, ev.line))
+        guard = None
+        best = 0
+        for name in sorted(write_sites):
+            if len(write_sites[name]) > best:
+                guard, best = name, len(write_sites[name])
+        if guard is None or best < 2:
+            continue
+        for facts, ev in events:
+            fn_name = ev.qual.split(".")[-1]
+            if fn_name in ("__init__", "__post_init__", "__new__"):
+                continue
+            if fn_name.endswith(_LOCKED_SUFFIX):
+                continue   # documented caller-holds-the-lock helpers
+            if guard in ev.held:
+                continue
+            if ev.kind == "write":
+                v = facts.src.violation(
+                    "lock-guarded-write", ev.line,
+                    f"{owner.split('::')[-1]}.{attr} is guarded by "
+                    f"lock '{guard}' ({best} locked write sites) but "
+                    "written here without it — lost-update/TOCTOU "
+                    "window",
+                )
+            else:
+                v = facts.src.violation(
+                    "lock-guarded-iter", ev.line,
+                    f"iterating {owner.split('::')[-1]}.{attr} "
+                    f"without its guard lock '{guard}' — a concurrent "
+                    "mutation raises dict/list-changed-size mid-loop",
+                )
+            if v:
+                out.append(v)
+    return out
+
+
+def check_blocking(facts_by_module: dict) -> list:
+    out: list[Violation] = []
+    for facts in facts_by_module.values():
+        for desc, node, held in facts.blocking:
+            v = facts.src.violation(
+                "blocking-under-lock", node,
+                f"blocking {desc} while holding lock(s) "
+                f"{', '.join(repr(h) for h in held)} stalls every "
+                "thread queued on them (docs/static_analysis.md "
+                "taxonomy)",
+            )
+            if v:
+                out.append(v)
+    return out
+
+
+def check_registry_drift(facts_by_module: dict) -> list:
+    """A registered lock whose declared module (when scanned) has no
+    ``make_lock("<name>")`` / ``make_rlock("<name>")`` creation site —
+    the registry entry rotted away from the code. Modules outside the
+    scan (fixture bindings in tests) are skipped."""
+    out: list[Violation] = []
+    registry = _locks_registry()
+    for decl in sorted(registry.values(), key=lambda d: d.name):
+        facts = facts_by_module.get(decl.module)
+        if facts is None:
+            continue
+        text = facts.src.text
+        if f'make_lock("{decl.name}")' in text or \
+                f"make_lock('{decl.name}')" in text or \
+                f'make_rlock("{decl.name}")' in text or \
+                f"make_rlock('{decl.name}')" in text:
+            continue
+        out.append(Violation(
+            "lock-registry-drift", decl.module, 1,
+            f"registered lock {decl.name!r} has no "
+            f"locks.make_{'r' if decl.kind == 'rlock' else ''}lock"
+            f"({decl.name!r}) creation site in {decl.module} — "
+            "update or delete the registration",
+        ))
+    return out
+
+
+def check_whole_program(
+    repo_root: str,
+    roots: Iterable[str],
+    cache: Optional[SourceCache] = None,
+) -> list:
+    """All lockmap rules over the tree (the roomlint cross-check
+    entry point)."""
+    if cache is None:
+        cache = SourceCache(repo_root)
+    facts = collect_facts(iter_py_files(roots, repo_root, cache))
+    out = []
+    out += check_lock_graph(facts)
+    out += check_guarded_state(facts)
+    out += check_blocking(facts)
+    out += check_registry_drift(facts)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DOT export (python -m room_tpu.analysis --graph)
+# ---------------------------------------------------------------------------
+
+def render_dot(facts_by_module: dict) -> str:
+    """The acquisition graph in DOT: solid = lexical nesting, dashed
+    = one-level call edge; node labels carry the owning module.
+    Tooltips carry the witness site WITHOUT its line number — the
+    checked-in render is freshness-gated in CI, and unrelated line
+    drift in a witness file must not churn it (only a real edge
+    change should)."""
+    graph = build_graph(facts_by_module)
+    used = set()
+    for (a, b) in graph.edges:
+        used.add(a)
+        used.add(b)
+    lines = [
+        "digraph lockmap {",
+        '  rankdir=LR;',
+        '  node [shape=box, fontsize=10, fontname="monospace"];',
+        '  edge [fontsize=8, fontname="monospace"];',
+    ]
+    for name in sorted(used):
+        decl = graph.nodes.get(name)
+        mod = decl.module.split("/")[-1] if decl else "?"
+        lines.append(
+            f'  "{name}" [label="{name}\\n{mod}"];'
+        )
+    for (a, b), ws in sorted(graph.edges.items()):
+        via_call = all("[calls " in w for w in ws)
+        style = ' style=dashed' if via_call else ""
+        tip = re.sub(r":\d+", "", ws[0])
+        lines.append(
+            f'  "{a}" -> "{b}" [tooltip="{tip}"{style}];'
+        )
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def graph_edges(repo_root: str, roots: Iterable[str],
+                cache: Optional[SourceCache] = None) -> set:
+    """The static (a, b) edge set — the lockdep runtime witness's
+    test oracle (observed edges must be a subset)."""
+    if cache is None:
+        cache = SourceCache(repo_root)
+    facts = collect_facts(iter_py_files(roots, repo_root, cache))
+    return set(build_graph(facts).edges)

@@ -24,17 +24,22 @@ from __future__ import annotations
 
 import ast
 import os
+from typing import Optional
 
-from .common import Violation
+from .common import SourceCache, Violation
 from .fault_checker import FAULTS_MODULE, load_fault_points
 
 TRACE_MODULE = os.path.join("room_tpu", "serving", "trace.py")
 
 
-def load_fault_events(repo_root: str) -> dict[str, str]:
+def load_fault_events(repo_root: str,
+                      cache: Optional[SourceCache] = None
+                      ) -> dict[str, str]:
     """Parse FAULT_EVENTS out of trace.py without importing it."""
     path = os.path.join(repo_root, TRACE_MODULE)
-    tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    if cache is None:
+        cache = SourceCache(repo_root)
+    tree = cache.tree(TRACE_MODULE)
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for tgt in node.targets:
@@ -44,11 +49,13 @@ def load_fault_events(repo_root: str) -> dict[str, str]:
     raise RuntimeError(f"FAULT_EVENTS not found in {path}")
 
 
-def _should_fire_calls(repo_root: str) -> set[str]:
+def _should_fire_calls(repo_root: str,
+                       cache: Optional[SourceCache] = None) -> set[str]:
     """Function names called inside faults.should_fire (the central
     firing path every armed point funnels through)."""
-    path = os.path.join(repo_root, FAULTS_MODULE)
-    tree = ast.parse(open(path, encoding="utf-8").read(), path)
+    if cache is None:
+        cache = SourceCache(repo_root)
+    tree = cache.tree(FAULTS_MODULE)
     called: set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.FunctionDef) and \
@@ -60,12 +67,16 @@ def _should_fire_calls(repo_root: str) -> set[str]:
     return called
 
 
-def check_fault_trace_coverage(repo_root: str) -> list[Violation]:
-    points = load_fault_points(repo_root)
+def check_fault_trace_coverage(
+    repo_root: str, cache: Optional[SourceCache] = None
+) -> list[Violation]:
+    if cache is None:
+        cache = SourceCache(repo_root)
+    points = load_fault_points(repo_root, cache)
     out: list[Violation] = []
     try:
-        events = load_fault_events(repo_root)
-    except (OSError, RuntimeError) as e:
+        events = load_fault_events(repo_root, cache)
+    except (OSError, RuntimeError, SyntaxError) as e:
         return [Violation(
             "fault-point-untraced", TRACE_MODULE, 1,
             f"cannot load trace.FAULT_EVENTS: {e}",
@@ -85,7 +96,7 @@ def check_fault_trace_coverage(repo_root: str) -> list[Violation]:
                 f"trace.FAULT_EVENTS maps unknown fault point "
                 f"{name!r} (known: {', '.join(points)})",
             ))
-    called = _should_fire_calls(repo_root)
+    called = _should_fire_calls(repo_root, cache)
     for fn in ("_telemetry_count", "_trace_event"):
         if fn not in called:
             out.append(Violation(

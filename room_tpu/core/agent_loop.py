@@ -21,7 +21,7 @@ from datetime import datetime
 from typing import Optional
 
 from ..db import Database, utc_now
-from ..utils import knobs
+from ..utils import knobs, locks
 from ..providers import (
     ExecutionRequest, RateLimitExceeded, get_model_provider,
 )
@@ -92,10 +92,10 @@ class LoopHandle:
 
 _running_loops: dict[int, LoopHandle] = {}
 _launched_rooms: set[int] = set()
-_registry_lock = threading.Lock()
+_registry_lock = locks.make_lock("agent_registry")
 
 # crash-strike history + unhealthy roster for supervise_loops
-_supervision_lock = threading.Lock()
+_supervision_lock = locks.make_lock("agent_supervision")
 _strikes: dict[int, deque] = {}
 _unhealthy: dict[int, dict] = {}
 _supervision_counts = {"restarts": 0, "hang_replacements": 0,

@@ -10,6 +10,7 @@ from typing import Optional
 
 from ..db import Database
 from .events import event_bus
+from ..utils import locks
 
 FLUSH_INTERVAL_S = 1.0
 
@@ -26,7 +27,7 @@ class CycleLogBuffer:
         self.flush_interval_s = flush_interval_s
         self._seq = 0
         self._pending: list[tuple[int, str, str]] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("cycle_logs")
         self._last_flush = time.monotonic()
 
     def append(self, entry_type: str, content: str) -> int:

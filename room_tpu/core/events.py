@@ -8,6 +8,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from ..db import utc_now
+from ..utils import locks
 
 Handler = Callable[["Event"], None]
 
@@ -24,7 +25,7 @@ class EventBus:
     def __init__(self) -> None:
         self._handlers: dict[str, list[Handler]] = {}
         self._wildcard: list[Handler] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("event_bus")
 
     def subscribe(
         self, channel: Optional[str], handler: Handler

@@ -12,9 +12,10 @@ import subprocess
 import threading
 import time
 from typing import Optional
+from ..utils import locks
 
 _managed: dict[int, str] = {}
-_lock = threading.Lock()
+_lock = locks.make_lock("supervisor")
 
 
 def register_managed_process(pid: int, label: str = "") -> None:

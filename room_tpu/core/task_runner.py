@@ -15,7 +15,7 @@ import time
 from typing import Optional
 
 from ..db import Database, utc_now
-from ..utils import knobs
+from ..utils import knobs, locks
 from ..providers import (
     ExecutionRequest, RateLimitExceeded, get_model_provider,
 )
@@ -39,7 +39,7 @@ AUTO_PAUSE_ERROR_COUNT = 5
 class _SlotPool:
     def __init__(self) -> None:
         self._used: dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("task_slots")
 
     def acquire(self, room_id: Optional[int], limit: int) -> bool:
         key = room_id or 0

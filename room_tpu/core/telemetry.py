@@ -16,7 +16,7 @@ from collections import Counter
 from typing import Optional
 
 from ..db import Database, utc_now
-from ..utils import knobs
+from ..utils import knobs, locks
 from .messages import get_setting, set_setting
 
 # ---- in-process resilience counters (fault injection, degradation,
@@ -26,7 +26,7 @@ from .messages import get_setting, set_setting
 # when it is.
 
 _counters: Counter = Counter()
-_counters_lock = threading.Lock()
+_counters_lock = locks.make_lock("telemetry")
 
 # fixed latency histograms (Prometheus semantics): per-bin counts
 # internally, CUMULATIVE `le` counts + _count/_sum at exposition.

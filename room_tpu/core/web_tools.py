@@ -24,6 +24,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from ..utils import locks
 
 FETCH_TIMEOUT_S = 20
 MAX_TEXT_CHARS = 8000
@@ -434,7 +435,7 @@ SESSION_TTL_S = 1800.0
 MAX_SESSIONS = 8
 
 _sessions: dict[str, WebSession] = {}
-_sessions_lock = threading.Lock()
+_sessions_lock = locks.make_lock("web_sessions")
 _session_seq = 0
 
 

@@ -19,7 +19,7 @@ from datetime import datetime, timezone
 from typing import Any, Iterator, Optional
 
 from .schema import SCHEMA, SCHEMA_VERSION
-from ..utils import knobs
+from ..utils import knobs, locks
 
 # Ordered (version, ddl) pairs applied after the base schema. Version 1 is
 # the base schema itself. Future migrations append here.
@@ -62,7 +62,7 @@ class Database:
 
     def __init__(self, path: str = ":memory:") -> None:
         self.path = path
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("db")
         self._txn_depth = 0
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None
@@ -177,7 +177,7 @@ class Database:
 
 
 _default_db: Optional[Database] = None
-_default_lock = threading.Lock()
+_default_lock = locks.make_lock("db_default")
 
 
 def default_db_path() -> str:

@@ -26,7 +26,7 @@ from typing import Callable, Optional
 from ..models import config as model_configs
 from ..models import qwen3
 from ..serving import faults
-from ..utils import knobs
+from ..utils import knobs, locks
 from ..serving import lifecycle as lifecycle_mod
 from ..serving.faults import FaultError
 from ..serving.fleet import fleet_replicas_from_env
@@ -44,7 +44,7 @@ MODEL_CONFIGS: dict[str, Callable] = {
 }
 
 _hosts: dict[str, "ModelHost"] = {}
-_hosts_lock = threading.Lock()
+_hosts_lock = locks.make_lock("model_hosts")
 # flipped by begin_drain_model_hosts: while True, engine() refuses to
 # cold-build (a straggler request during the drain window would
 # otherwise rebuild a host whose restore consumes the manifest the
@@ -129,7 +129,7 @@ class ModelHost:
         self._built_draft = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("model_host")
 
     def readiness(self) -> tuple[bool, str]:
         if checkpoint_dir(self.name):

@@ -14,6 +14,7 @@ from ..core.events import event_bus
 from ..core.messages import get_setting
 from ..db import Database
 from ..providers import ExecutionRequest, get_model_provider
+from ..utils import locks
 
 ACTIVE_PACE_S = (8.0, 30.0)
 LIGHT_PACE_S = 2 * 3600.0
@@ -33,7 +34,7 @@ class CommentaryEngine:
         self.db = db
         self._model = model
         self._buffer: list[str] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("commentary")
         self._last_keeper_msg = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

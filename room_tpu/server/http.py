@@ -25,7 +25,7 @@ from .router import BadRequest, RequestContext, Router
 from .routes import register_all_routes
 from .webhooks import handle_webhook_request
 from .ws import WebSocketHub
-from ..utils import knobs
+from ..utils import knobs, locks
 
 RATE_LIMIT_GET_PER_MIN = 300
 RATE_LIMIT_WRITE_PER_MIN = 120
@@ -34,7 +34,7 @@ RATE_LIMIT_WRITE_PER_MIN = 120
 class _RateLimiter:
     def __init__(self) -> None:
         self._hits: dict[tuple[str, str], list[float]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("http_rate_limiter")
 
     def allow(self, ip: str, kind: str, limit: int) -> bool:
         now = time.monotonic()

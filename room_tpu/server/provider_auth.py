@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..providers.cli import _clean_env, resolve_cli_path
-from ..utils import knobs
+from ..utils import knobs, locks
 
 MAX_LINES = max(
     50, knobs.get_int("ROOM_TPU_PROVIDER_AUTH_MAX_LINES")
@@ -75,7 +75,7 @@ class ProviderAuthManager:
     def __init__(self) -> None:
         self._sessions: dict[str, AuthSession] = {}
         self._active_by_provider: dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("provider_auth")
 
     def _command_for(self, provider: str) -> list[str]:
         path = resolve_cli_path(provider)
@@ -281,7 +281,7 @@ class ProviderInstallManager(ProviderAuthManager):
 
 _manager: Optional[ProviderAuthManager] = None
 _install_manager: Optional[ProviderInstallManager] = None
-_manager_lock = threading.Lock()
+_manager_lock = locks.make_lock("provider_auth_manager")
 
 
 def get_auth_manager() -> ProviderAuthManager:

@@ -32,6 +32,7 @@ from ..core.agent_loop import (
 )
 from ..core.cron import cron_matches
 from ..core.events import event_bus
+from ..utils import locks
 
 SCHEDULER_TICK_S = 15.0
 MAINTENANCE_TICK_S = 60.0
@@ -43,7 +44,7 @@ STALE_RUN_MINUTES = 120
 # starting -> warming (boot recovery running) -> serving -> draining.
 # Module-global because exactly one server process owns the lifecycle;
 # snapshotted by /api/tpu/health from route threads.
-_lifecycle_lock = threading.Lock()
+_lifecycle_lock = locks.make_lock("lifecycle")
 _lifecycle = {
     "phase": "starting",
     "last_shutdown": None,      # clean | crash | first_boot
@@ -118,7 +119,9 @@ class ServerRuntime:
     stop_event: threading.Event = field(default_factory=threading.Event)
     threads: list[threading.Thread] = field(default_factory=list)
     _pending_tasks: set[int] = field(default_factory=set)
-    _pending_lock: threading.Lock = field(default_factory=threading.Lock)
+    _pending_lock: threading.Lock = field(
+        default_factory=lambda: locks.make_lock("runtime_pending")
+    )
     _last_cron_minute: Optional[str] = None
 
     # ---- lifecycle ----

@@ -22,13 +22,13 @@ from ..core.events import event_bus
 from ..core.messages import set_setting
 from ..db import Database, utc_now
 from ..providers.tpu import MODEL_CONFIGS, checkpoint_dir, get_model_host
-from ..utils import knobs
+from ..utils import knobs, locks
 
 MIN_HOST_RAM_GB = 8
 MIN_FREE_DISK_GB = 10
 
 _sessions: dict[str, dict] = {}
-_lock = threading.Lock()
+_lock = locks.make_lock("tpu_manager")
 
 
 def _bytes_per_param(dtype: str) -> int:

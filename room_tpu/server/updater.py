@@ -29,7 +29,7 @@ import urllib.request
 from typing import Any, Callable, Optional
 
 from .. import __version__
-from ..utils import knobs
+from ..utils import knobs, locks
 
 DEFAULT_POLL_S = 4 * 3600.0
 INITIAL_DELAY_S = 15.0
@@ -94,7 +94,7 @@ class UpdateChecker:
         self._backoff_until = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("updater")
 
     # -- sources --
 

@@ -8,11 +8,12 @@ from __future__ import annotations
 import threading
 import time
 from typing import Any
+from ..utils import locks
 
 WEBHOOK_RATE_PER_MIN = 30
 
 _hits: dict[str, list[float]] = {}
-_lock = threading.Lock()
+_lock = locks.make_lock("webhooks")
 
 
 MAX_TRACKED_TOKENS = 4096

@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..core.events import event_bus
 from .auth import get_token_principal
+from ..utils import locks
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 HEARTBEAT_S = 30.0
@@ -129,7 +130,7 @@ class WebSocketHub:
     def __init__(self, server) -> None:
         self.server = server
         self._clients: list[_Client] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ws_hub")
         self._stop = threading.Event()
         self._unsubscribe = None
 

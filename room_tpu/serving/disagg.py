@@ -349,7 +349,7 @@ class DisaggCoordinator:
                 rec.rid = ""
                 rec.pending_entry = entry
                 rec.pending_fingerprint = None
-                self._finish_ship(rec, outcome="deferred")
+                self._finish_ship_locked(rec, outcome="deferred")
             self._bump_outcome("deferred")
             trace_mod.note_event("kv_ship_deferred", {
                 "session": rec.sid, "from": donor_rid,
@@ -370,7 +370,7 @@ class DisaggCoordinator:
                     if not released:
                         rec.rid = adopted_rid
                         rec.rehomed += 1
-                    self._finish_ship(rec, outcome)
+                    self._finish_ship_locked(rec, outcome)
                 if released:
                     adopter = fleet._handle(adopted_rid)
                     if adopter is not None:
@@ -494,10 +494,10 @@ class DisaggCoordinator:
             warm = store is not None and store.has(rec.sid)
         outcome = "warm" if warm else "reprefill"
         with fleet._lock:
-            self._finish_ship(rec, outcome)
+            self._finish_ship_locked(rec, outcome)
         self._bump_outcome(outcome)
 
-    def _finish_ship(self, rec, outcome: str) -> None:
+    def _finish_ship_locked(self, rec, outcome: str) -> None:
         """Terminal state cleanup; caller holds the fleet lock. The
         outcome counters go through _bump AFTER the caller releases
         it (``_bump_outcome``) — the fleet lock is not reentrant."""

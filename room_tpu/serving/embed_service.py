@@ -16,9 +16,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..utils import knobs
+from ..utils import knobs, locks
 
-_host_lock = threading.Lock()
+_host_lock = locks.make_lock("embed_host")
 _host: Optional["EmbedHost"] = None
 
 MAX_TOKENS = 128
@@ -124,7 +124,7 @@ class DeviceEmbedIndex:
         self._jnp = jnp
         self._matrix = jnp.zeros((0, dim), jnp.float32)
         self._ids: list[int] = []
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("embed_index")
 
     def rebuild(self, vectors: np.ndarray, ids: list[int]) -> None:
         import jax.numpy as jnp

@@ -43,7 +43,7 @@ import numpy as np
 from ..models import qwen3
 from ..models.config import DecoderConfig
 from ..ops import spec as spec_ops
-from ..utils import knobs
+from ..utils import knobs, locks
 from . import faults
 from . import trace as trace_mod
 from .faults import FaultError
@@ -409,7 +409,7 @@ class ServingEngine:
         # degradation_level() is read from HTTP threads (stats(),
         # /api/tpu/health) while the engine thread appends/drains —
         # its own lock, never nested with self._lock
-        self._pressure_lock = threading.Lock()
+        self._pressure_lock = locks.make_lock("engine_pressure")
         self._forced_degradation: Optional[int] = None
         # engine-thread supervision: crashes within the window beyond
         # this budget mark the engine unhealthy (fail-closed: the
@@ -703,7 +703,7 @@ class ServingEngine:
                 logging.getLogger(__name__).exception(
                     "shared prefix store unavailable for %s", cfg.name,
                 )
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("engine")
         self._jit_cache: dict[Any, Callable] = {}
         self._stats = {
             "tokens_decoded": 0, "turns_completed": 0, "prefill_tokens": 0,

@@ -80,7 +80,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..utils import knobs
+from ..utils import knobs, locks
 
 __all__ = [
     "FaultError", "FaultSpec", "FAULT_POINTS", "inject", "clear",
@@ -134,7 +134,7 @@ class FaultSpec:
     )
 
 
-_lock = threading.Lock()
+_lock = locks.make_lock("faults")
 _active: dict[str, FaultSpec] = {}
 # fast-path flag: checked without the lock on every fault point
 _armed = False

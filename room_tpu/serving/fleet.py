@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import logging
 import os
+from ..utils import locks
 import threading
 import time
 from dataclasses import dataclass, field
@@ -111,7 +112,9 @@ class _SessionRecord:
     # must not contend on the fleet-wide lock across replicas (one
     # session has at most one active turn, so this lock only ever
     # serializes the appender against a failover's mirror read)
-    lock: threading.Lock = field(default_factory=threading.Lock)
+    lock: threading.Lock = field(
+        default_factory=lambda: locks.make_lock("fleet_record")
+    )
     # mirror cap (ROOM_TPU_FLEET_MIRROR_TOKENS): set when this
     # record's token mirror was LRU-evicted — the partial tokens that
     # accumulate afterwards must never be mistaken for a full history
@@ -285,7 +288,7 @@ class EngineFleet:
         self.tick_s = knobs.get_float("ROOM_TPU_FLEET_TICK_S")
         self.auto_rebuild = auto_rebuild if auto_rebuild is not None \
             else knobs.get_bool("ROOM_TPU_FLEET_REBUILD")
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("fleet")
         self._records: dict[str, _SessionRecord] = {}
         self._rr = 0   # round-robin cursor for re-home spreading
         self._threads_started = False
@@ -311,7 +314,7 @@ class EngineFleet:
         except ValueError:
             self.mirror_cap_tokens = 0
         self._mirror_tokens = 0
-        self._mirror_lock = threading.Lock()
+        self._mirror_lock = locks.make_lock("fleet_mirror")
         self._mirror_sweep_at = 0.0
         self._mirror_sweep_futile = False
         role_list = (
@@ -1272,9 +1275,14 @@ class EngineFleet:
                     toks.append(int(entry["pending"]))
                 self._set_record_tokens(rec, toks)
                 rec.generation = int(entry.get("generation") or 0)
-                rec.pending_entry = entry
-                rec.pending_fingerprint = fingerprint
                 with self._lock:
+                    # the deferral fields flip under the fleet lock
+                    # everywhere else (_route consumes them under it);
+                    # setting them inside the publish section keeps
+                    # the write discipline uniform even though this
+                    # record is not yet reachable
+                    rec.pending_entry = entry
+                    rec.pending_fingerprint = fingerprint
                     old = self._records.get(sid)
                     if old is not None:
                         rec.rehomed = old.rehomed

@@ -56,7 +56,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..utils import knobs
+from ..utils import knobs, locks
 
 __all__ = [
     "OffloadEntry", "TieredKVStore", "offload_enabled_from_env",
@@ -240,7 +240,7 @@ class TieredKVStore:
             except Exception:
                 pass  # hygiene is best-effort; the store must come up
         self._entries: dict[str, OffloadEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("kv_offload")
         self._stats = {
             "host_hits": 0, "disk_hits": 0, "misses": 0,
             "demotions": 0, "disk_drops": 0, "spool_errors": 0,
@@ -268,18 +268,18 @@ class TieredKVStore:
 
     # ---- tier accounting (callers hold self._lock) ----
 
-    def _host_bytes(self) -> int:
+    def _host_bytes_locked(self) -> int:
         return sum(
             e.nbytes for e in self._entries.values()
             if e.arrays is not None
         )
 
-    def _disk_bytes(self) -> int:
+    def _disk_bytes_locked(self) -> int:
         return sum(
             e.nbytes for e in self._entries.values() if e.path
         )
 
-    def _drop_entry(self, entry: OffloadEntry) -> None:
+    def _drop_entry_locked(self, entry: OffloadEntry) -> None:
         self._entries.pop(entry.session_id, None)
         if entry.path:
             try:
@@ -299,7 +299,7 @@ class TieredKVStore:
         mutator — the lock only protects reader snapshots."""
         while True:
             with self._lock:
-                if self._host_bytes() <= self.host_bytes_cap:
+                if self._host_bytes_locked() <= self.host_bytes_cap:
                     break
                 victims = [
                     e for e in self._entries.values()
@@ -310,7 +310,7 @@ class TieredKVStore:
                 victim = min(victims, key=lambda e: e.last_used)
                 if self.disk_bytes_cap <= 0:
                     self._stats["disk_drops"] += 1
-                    self._drop_entry(victim)
+                    self._drop_entry_locked(victim)
                     continue
                 arrays = victim.arrays
                 path = self._spool_path(victim.session_id)
@@ -319,14 +319,14 @@ class TieredKVStore:
             except OSError:
                 with self._lock:
                     self._stats["spool_errors"] += 1
-                    self._drop_entry(victim)
+                    self._drop_entry_locked(victim)
                 continue
             with self._lock:
                 victim.path = path
                 victim.arrays = None
                 self._stats["demotions"] += 1
         with self._lock:
-            while self._disk_bytes() > self.disk_bytes_cap:
+            while self._disk_bytes_locked() > self.disk_bytes_cap:
                 victims = [
                     e for e in self._entries.values() if e.path
                 ]
@@ -334,7 +334,7 @@ class TieredKVStore:
                     break
                 victim = min(victims, key=lambda e: e.last_used)
                 self._stats["disk_drops"] += 1
-                self._drop_entry(victim)
+                self._drop_entry_locked(victim)
 
     # ---- public API (engine thread mutates; HTTP threads read) ----
 
@@ -350,7 +350,7 @@ class TieredKVStore:
         with self._lock:
             old = self._entries.pop(session_id, None)
             if old is not None:
-                self._drop_entry(old)
+                self._drop_entry_locked(old)
             self._entries[session_id] = entry
             self._stats["bytes_out"] += nbytes
         self._rebalance()
@@ -397,7 +397,7 @@ class TieredKVStore:
         with self._lock:
             old = self._entries.pop(session_id, None)
             if old is not None:
-                self._drop_entry(old)
+                self._drop_entry_locked(old)
             self._entries[session_id] = entry
         self._rebalance()
         return self.has(session_id)
@@ -492,7 +492,7 @@ class TieredKVStore:
             with self._lock:
                 self._stats["spool_errors"] += 1
                 self._stats["misses"] += 1
-                self._drop_entry(entry)
+                self._drop_entry_locked(entry)
             return None
         with self._lock:
             self._stats["disk_hits"] += 1
@@ -503,7 +503,7 @@ class TieredKVStore:
             entry = self._entries.get(session_id)
             if entry is None:
                 return False
-            self._drop_entry(entry)
+            self._drop_entry_locked(entry)
             return True
 
     def clear(self, remove_spool_dir: bool = True) -> None:
@@ -515,7 +515,7 @@ class TieredKVStore:
         bytes the salvage hand-off points at."""
         with self._lock:
             for entry in list(self._entries.values()):
-                self._drop_entry(entry)
+                self._drop_entry_locked(entry)
             self._entries.clear()
         if remove_spool_dir and self._own_spool and self._spool_dir:
             shutil.rmtree(self._spool_dir, ignore_errors=True)
@@ -560,8 +560,8 @@ class TieredKVStore:
             out = {
                 "host_entries": host_entries,
                 "disk_entries": disk_entries,
-                "host_bytes": self._host_bytes(),
-                "disk_bytes": self._disk_bytes(),
+                "host_bytes": self._host_bytes_locked(),
+                "disk_bytes": self._disk_bytes_locked(),
                 "host_bytes_cap": self.host_bytes_cap,
                 "disk_bytes_cap": self.disk_bytes_cap,
                 **self._stats,

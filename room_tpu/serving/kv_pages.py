@@ -22,7 +22,7 @@ import jax.numpy as jnp  # noqa: F401  (used in jit-side helpers)
 
 from ..models.config import DecoderConfig
 from ..ops import attention_ref
-from ..utils import knobs
+from ..utils import knobs, locks
 
 Params = dict[str, Any]
 
@@ -814,7 +814,7 @@ class PageTable:
         self.page_size = page_size
         self._free: list[int] = list(range(n_pages - 1, -1, -1))
         self._sessions: dict[str, list[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("kv_page_table")
 
     @property
     def free_pages(self) -> int:

@@ -66,7 +66,7 @@ from typing import Optional
 import numpy as np
 
 from . import faults
-from ..utils import knobs
+from ..utils import knobs, locks
 from .faults import FaultError
 from .kv_offload import _read_spool, _write_spool
 
@@ -127,7 +127,7 @@ class SharedPrefixStore:
         self._fp_digest = hashlib.sha256(
             json.dumps(fingerprint, sort_keys=True).encode()
         ).digest()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("prefix_store")
         # prefix lengths (tokens) known to exist in the dir — bounds
         # the longest-prefix probe to O(|lengths|) hashes instead of
         # one per aligned length. Refreshed by directory scan (other

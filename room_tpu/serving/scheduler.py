@@ -48,7 +48,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
-from ..utils import knobs
+from ..utils import knobs, locks
 
 __all__ = [
     "TURN_CLASSES", "CLASS_RANK", "DEFAULT_CLASS", "ClassTargets",
@@ -427,7 +427,7 @@ class RequestScheduler:
     ) -> None:
         self.targets = targets or class_targets_from_env()
         self.chunk_budgets = chunk_budgets or class_chunks_from_env()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("scheduler")
         self._heap: list[tuple[float, int, int, Any]] = []
         self._seq = 0
         self._depth = {c: 0 for c in TURN_CLASSES}

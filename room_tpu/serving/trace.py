@@ -56,7 +56,7 @@ import time
 from collections import deque
 from typing import Optional
 
-from ..utils import knobs
+from ..utils import knobs, locks
 
 __all__ = [
     "TurnTrace", "FlightRecorder", "recorder", "FAULT_EVENTS",
@@ -103,11 +103,11 @@ ATTRIBUTION_COMPONENTS = (
 )
 
 _turn_seq = 0
-_seq_lock = threading.Lock()
+_seq_lock = locks.make_lock("trace_seq")
 # finish() can race between the engine thread and a fleet-router shed
 # (the submit-side TOCTOU path): the idempotency flip must be atomic
 # or a turn could book twice into the recorder
-_finish_lock = threading.Lock()
+_finish_lock = locks.make_lock("trace_finish")
 # tests / bench A/B override the knob without re-reading env per turn
 _override: Optional[bool] = None
 
@@ -386,7 +386,7 @@ class FlightRecorder:
             violation_cap = max(
                 1, knobs.get_int("ROOM_TPU_TRACE_VIOLATION_RING")
             )
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("trace_recorder")
         self._recent: deque = deque(maxlen=recent_cap)
         self._violations: deque = deque(maxlen=violation_cap)
         self._events: deque = deque(maxlen=event_cap)

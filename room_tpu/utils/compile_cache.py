@@ -20,9 +20,9 @@ import os
 import threading
 from typing import Optional
 
-from . import knobs
+from . import knobs, locks
 
-_lock = threading.Lock()
+_lock = locks.make_lock("compile_cache")
 _configured: Optional[tuple[Optional[str], int]] = None
 
 

@@ -562,6 +562,18 @@ register("ROOM_TPU_PROFILE_MAX_S", "float", "120",
          "Upper bound on an on-demand jax.profiler device-trace "
          "capture requested via POST /api/tpu/profile.")
 
+# ---- lockmap runtime witness (docs/static_analysis.md) ----
+register("ROOM_TPU_LOCKDEP", "bool", "0",
+         "Arm the lockdep runtime lock-order witness: registered "
+         "locks (room_tpu/utils/locks.py) record per-thread "
+         "acquisition order and hold times; the chaos/fleet/disagg "
+         "CI quick tiers run armed.")
+register("ROOM_TPU_LOCKDEP_STRICT", "bool", "1",
+         "With lockdep armed, raise LockOrderError on an observed "
+         "lock-order inversion (tests/CI); '0' counts inversions "
+         "(lockdep_inversions) and records evidence instead "
+         "(production posture).")
+
 # ---- turnscope: turn tracing / flight recorder / metrics ----
 register("ROOM_TPU_TRACE", "bool", "1",
          "Always-on host-side turn tracing (docs/observability.md): "

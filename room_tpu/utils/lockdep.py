@@ -1,0 +1,299 @@
+"""Runtime lock-order witness ("lockdep") — the dynamic half of the
+lockmap concurrency layer (docs/static_analysis.md).
+
+Armed via ``ROOM_TPU_LOCKDEP`` (off by default), ``locks.make_lock``
+returns a :class:`LockdepLock` instead of a bare ``threading`` lock.
+The wrapper records, process-wide:
+
+- the **observed acquisition order**: acquiring B while holding A
+  records the directed edge A -> B (lock *names*, the registry's
+  class-level granularity — same-name pairs are skipped, they are
+  cross-instance hierarchies the name graph cannot order);
+- an **inversion**: acquiring B while holding A when B -> A was
+  observed earlier anywhere in the process — the classic ABBA
+  deadlock precursor. Strict mode (``ROOM_TPU_LOCKDEP_STRICT``,
+  default on — the CI chaos tiers are the primary consumer) raises
+  :class:`LockOrderError` *before* blocking; production arms with
+  strict off and gets a counter (``lockdep_inversions``) plus the
+  recorded pair in :func:`snapshot` instead;
+- a **same-instance re-acquire** of a non-reentrant lock, which would
+  deadlock the thread silently: always raises, strict or not (raising
+  is strictly better than hanging);
+- **hold times** per lock name, into the telemetry histogram
+  ``lockdep_hold_ms.<name>`` when telemetry is loaded.
+
+The witness asserts observed order against the static graph only in
+tests (``tests/test_analysis.py`` pins observed edges ⊆ the lockmap
+AST graph); at runtime it is self-contained — no AST pass on the hot
+path. State is process-global so edges learned in one subsystem
+protect every other; :func:`reset` exists for test isolation.
+
+All bookkeeping runs under one plain meta-lock with a thread-local
+reentrancy guard, so instrumenting the telemetry counter lock itself
+cannot recurse.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "LockdepLock", "LockOrderError", "enabled", "strict",
+    "observed_edges", "inversions", "snapshot", "reset",
+]
+
+_MAX_INVERSIONS = 256   # bounded evidence ring; counter keeps the total
+
+
+class LockOrderError(RuntimeError):
+    """A lock-order inversion (or same-instance re-acquire) the
+    witness refused to let block."""
+
+
+def enabled() -> bool:
+    from . import knobs
+
+    return knobs.get_bool("ROOM_TPU_LOCKDEP")
+
+
+def strict() -> bool:
+    from . import knobs
+
+    return knobs.get_bool("ROOM_TPU_LOCKDEP_STRICT")
+
+
+# ---- global witness state (meta-locked, never instrumented) ----
+
+_meta = threading.Lock()
+# (held_name, acquired_name) -> first-witness description
+_edges: dict[tuple, str] = {}
+_inversion_count = 0
+_inversions: list[dict] = []
+
+
+class _PerThread(threading.local):
+    def __init__(self) -> None:
+        self.held: list = []     # [name, id(lock), t_acquire, depth]
+        self.in_lockdep = False  # reentrancy guard for telemetry
+
+
+_tls = _PerThread()
+
+
+def _telemetry_observe(name: str, ms: float) -> None:
+    """Hold-time histogram via telemetry IF it is loaded — resolved
+    through sys.modules so utils never imports the core package."""
+    mod = sys.modules.get("room_tpu.core.telemetry")
+    if mod is None:
+        return
+    try:
+        mod.observe_ms(f"lockdep_hold_ms.{name}", ms)
+    except Exception:
+        pass
+
+
+def _telemetry_count(name: str, n: int = 1) -> None:
+    mod = sys.modules.get("room_tpu.core.telemetry")
+    if mod is None:
+        return
+    try:
+        mod.incr_counter(name, n)
+    except Exception:
+        pass
+
+
+class LockdepLock:
+    """Instrumented Lock/RLock with the full ``threading`` surface the
+    tree uses: ``acquire(blocking=, timeout=)``, ``release()``,
+    context manager, ``locked()``."""
+
+    __slots__ = ("name", "_inner", "_kind")
+
+    def __init__(self, name: str, inner, kind: str) -> None:
+        self.name = name
+        self._inner = inner
+        self._kind = kind
+
+    # -- bookkeeping --------------------------------------------------
+
+    def _precheck(self) -> Optional[str]:
+        """Ordering verdict BEFORE blocking on the inner lock.
+        Returns an error description, or None when clean. Reentrant
+        re-acquires bump the held entry's depth and return None."""
+        tls = _tls
+        if tls.in_lockdep:
+            return None
+        me = id(self)
+        for entry in tls.held:
+            if entry[1] == me:
+                if self._kind == "rlock":
+                    return None   # counted at _book time
+                return (
+                    f"same-instance re-acquire of non-reentrant lock "
+                    f"'{self.name}' — this thread would deadlock"
+                )
+        verdict: Optional[str] = None
+        recorded = 0
+        with _meta:
+            for entry in tls.held:
+                held_name = entry[0]
+                if held_name == self.name:
+                    continue   # cross-instance hierarchy, unordered
+                if (self.name, held_name) in _edges:
+                    verdict = (
+                        f"lock-order inversion: acquiring "
+                        f"'{self.name}' while holding '{held_name}', "
+                        f"but '{self.name}' -> '{held_name}' was "
+                        f"observed at {_edges[(self.name, held_name)]}"
+                    )
+                    self._record_inversion(held_name)
+                    recorded += 1
+        if recorded:
+            # OUTSIDE _meta, reentrancy-guarded: the telemetry counter
+            # lock is itself a LockdepLock, so counting from inside
+            # the meta section would re-enter _precheck and deadlock
+            # on _meta — the witness must never hang the thread it is
+            # protecting
+            tls.in_lockdep = True
+            try:
+                _telemetry_count("lockdep_inversions", recorded)
+            finally:
+                tls.in_lockdep = False
+        return verdict
+
+    def _record_inversion(self, held_name: str) -> None:
+        # caller holds _meta; telemetry is counted by the caller AFTER
+        # _meta is released (counting here would recurse into lockdep
+        # through the instrumented telemetry lock)
+        global _inversion_count
+        _inversion_count += 1
+        if len(_inversions) < _MAX_INVERSIONS:
+            _inversions.append({
+                "acquired": self.name, "held": held_name,
+                "thread": threading.current_thread().name,
+                "prior": _edges.get((self.name, held_name), ""),
+            })
+
+    def _book(self) -> None:
+        """Record the successful acquire: held stack + order edges."""
+        tls = _tls
+        if tls.in_lockdep:
+            return
+        me = id(self)
+        for entry in tls.held:
+            if entry[1] == me:
+                entry[3] += 1
+                return
+        where = threading.current_thread().name
+        with _meta:
+            for entry in tls.held:
+                held_name = entry[0]
+                if held_name == self.name:
+                    continue
+                if (self.name, held_name) in _edges:
+                    # a counted (non-strict) inversion proceeds to
+                    # acquire: recording the reverse direction here
+                    # would make every LATER acquisition in the
+                    # original sanctioned order count as an inversion
+                    # too — the one real ABBA becomes unbounded noise.
+                    # The pair stays ordered as first witnessed.
+                    continue
+                if (held_name, self.name) not in _edges:
+                    _edges[(held_name, self.name)] = where
+        tls.held.append([self.name, me, time.monotonic(), 1])
+
+    def _unbook(self) -> None:
+        tls = _tls
+        if tls.in_lockdep:
+            return
+        me = id(self)
+        for i in range(len(tls.held) - 1, -1, -1):
+            entry = tls.held[i]
+            if entry[1] == me:
+                entry[3] -= 1
+                if entry[3] <= 0:
+                    del tls.held[i]
+                    tls.in_lockdep = True
+                    try:
+                        _telemetry_observe(
+                            self.name,
+                            (time.monotonic() - entry[2]) * 1000.0,
+                        )
+                    finally:
+                        tls.in_lockdep = False
+                return
+
+    # -- threading.Lock surface ---------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1
+                ) -> bool:
+        problem = self._precheck()
+        if problem is not None:
+            if "same-instance" in problem or strict():
+                raise LockOrderError(problem)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._book()
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._unbook()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        if inner_locked is not None:
+            return inner_locked()
+        return False   # RLock has no locked(); mirror that
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:   # pragma: no cover - debug aid
+        return f"<LockdepLock {self.name!r} {self._inner!r}>"
+
+
+# ---- inspection / test surface ----
+
+def observed_edges() -> set:
+    """The witnessed (held, then-acquired) name pairs so far."""
+    with _meta:
+        return set(_edges)
+
+
+def inversions() -> list[dict]:
+    with _meta:
+        return list(_inversions)
+
+
+def snapshot() -> dict:
+    """Health/metrics surface: edge count, inversion count + bounded
+    evidence."""
+    with _meta:
+        return {
+            "enabled": enabled(),
+            "edges": len(_edges),
+            "inversions": _inversion_count,
+            "evidence": list(_inversions),
+        }
+
+
+def reset() -> None:
+    """Drop all witnessed state (test isolation). Also clears the
+    CALLING thread's held stack — a strict-mode raise mid-test leaves
+    the outer acquire booked with no release, and carrying that
+    phantom hold across tests would fabricate edges implicating the
+    wrong code (other threads' stacks are unreachable thread-locals
+    and die with their threads)."""
+    global _inversion_count
+    with _meta:
+        _edges.clear()
+        _inversions.clear()
+        _inversion_count = 0
+    _tls.held.clear()

@@ -1,0 +1,372 @@
+"""Central registry for every shared lock in the process — the
+concurrency twin of the ``ROOM_TPU_*`` knob registry (docs/static_analysis.md).
+
+Registering here is what makes a lock *exist* to the tooling: the
+registry carries the lock's name, the source binding (module, class,
+attribute) the static lockmap pass uses to resolve ``with x._lock:``
+sites, a one-line doc, the kind (``lock``/``rlock``), and the variable
+spellings (``hints``) other modules use when they touch the lock
+directly (``fleet._lock`` from disagg.py, ``eng._lock`` from
+fleet.py). Two consumers:
+
+- **static** — ``room_tpu/analysis/lockmap.py`` extracts the
+  whole-program lock-acquisition graph over these names and fails CI
+  on cycles (rule ``lock-order-cycle``), unresolvable acquisition
+  sites (``lock-unresolved``), and guarded-state violations.
+- **runtime** — ``make_lock``/``make_rlock`` return a plain
+  ``threading`` primitive normally, or a ``room_tpu.utils.lockdep``
+  instrumented witness when ``ROOM_TPU_LOCKDEP`` is armed (the
+  chaos/fleet/disagg CI tiers run armed, so the crash-storm suites
+  double as race witnesses).
+
+``multi_instance=True`` documents locks that exist once per object of
+a many-instance class (engine replicas, fleet session records): a
+same-name nesting edge for those is cross-*instance* by design and is
+exempt from the static self-deadlock rule (the runtime witness still
+catches a same-*instance* re-acquire).
+
+This module is stdlib-only so the lint gate runs without jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+__all__ = [
+    "LockDecl", "LOCK_REGISTRY", "register_lock", "make_lock",
+    "make_rlock", "lookup", "all_locks",
+]
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    """One registered shared lock.
+
+    ``module`` is the repo-relative path of the module that creates
+    the lock; ``cls`` the owning class (``""`` = module-level global);
+    ``attr`` the attribute / global name. ``hints`` are the variable
+    spellings that denote the owning object at foreign acquisition
+    sites (``"fleet"``, ``"eng"``, ``"rec"``) — the lockmap resolver
+    matches ``<hint>.<attr>``.
+    """
+
+    name: str
+    doc: str
+    module: str
+    cls: str = ""
+    attr: str = "_lock"
+    kind: str = "lock"          # "lock" | "rlock"
+    hints: tuple = ()
+    multi_instance: bool = False
+
+
+LOCK_REGISTRY: dict[str, LockDecl] = {}
+
+_KINDS = ("lock", "rlock")
+
+
+def register_lock(
+    name: str,
+    doc: str,
+    *,
+    module: str,
+    cls: str = "",
+    attr: str = "_lock",
+    kind: str = "lock",
+    hints: tuple = (),
+    multi_instance: bool = False,
+) -> LockDecl:
+    if name in LOCK_REGISTRY:
+        raise ValueError(f"lock {name!r} registered twice")
+    if kind not in _KINDS:
+        raise ValueError(f"lock {name!r}: unknown kind {kind!r}")
+    if not doc.strip():
+        raise ValueError(f"lock {name!r} registered without a doc line")
+    if not module.endswith(".py"):
+        raise ValueError(f"lock {name!r}: module must be a repo-relative "
+                         f".py path, got {module!r}")
+    decl = LockDecl(name, doc, module, cls, attr, kind, tuple(hints),
+                    multi_instance)
+    LOCK_REGISTRY[name] = decl
+    return decl
+
+
+def lookup(name: str) -> LockDecl:
+    decl = LOCK_REGISTRY.get(name)
+    if decl is None:
+        raise KeyError(
+            f"unregistered lock {name!r}: add it to "
+            "room_tpu/utils/locks.py (lockmap rule lock-unresolved)"
+        )
+    return decl
+
+
+def all_locks() -> dict[str, LockDecl]:
+    return dict(LOCK_REGISTRY)
+
+
+def _lockdep_armed() -> bool:
+    from . import knobs
+
+    return knobs.get_bool("ROOM_TPU_LOCKDEP")
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` for a registered name — or the lockdep
+    witness wrapper when ``ROOM_TPU_LOCKDEP`` is armed."""
+    decl = lookup(name)
+    if decl.kind != "lock":
+        raise ValueError(f"lock {name!r} is registered as {decl.kind}; "
+                         "use make_rlock")
+    if _lockdep_armed():
+        from . import lockdep
+
+        return lockdep.LockdepLock(name, threading.Lock(), "lock")
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` for a registered name (lockdep-wrapped
+    when armed; reentrant re-acquires record no ordering edge)."""
+    decl = lookup(name)
+    if decl.kind != "rlock":
+        raise ValueError(f"lock {name!r} is registered as {decl.kind}; "
+                         "use make_lock")
+    if _lockdep_armed():
+        from . import lockdep
+
+        return lockdep.LockdepLock(name, threading.RLock(), "rlock")
+    return threading.RLock()
+
+
+# ---------------------------------------------------------------------------
+# The registry. Grouped by subsystem, mirroring docs/knobs.md's layout.
+# Order in this file is documentation only — the sanctioned acquisition
+# ORDER is the static graph (python -m room_tpu.analysis --graph).
+# ---------------------------------------------------------------------------
+
+# ---- serving: engine + KV (docs/serving.md) ----
+register_lock(
+    "engine", "ServingEngine state: sessions, queues, slot tables, "
+    "stats — the serving hot-path lock.",
+    module="room_tpu/serving/engine.py", cls="ServingEngine",
+    attr="_lock", hints=("eng", "engine", "self.engine", "h.engine"),
+    multi_instance=True,
+)
+register_lock(
+    "engine_pressure", "Pool-pressure deque behind degradation_level() "
+    "— its own lock, never nested with the engine lock.",
+    module="room_tpu/serving/engine.py", cls="ServingEngine",
+    attr="_pressure_lock", multi_instance=True,
+)
+register_lock(
+    "kv_page_table", "PageTable free-list + per-session block tables.",
+    module="room_tpu/serving/kv_pages.py", cls="PageTable",
+    attr="_lock", multi_instance=True,
+)
+register_lock(
+    "kv_offload", "TieredKVStore host/disk tier maps and counters "
+    "(engine thread mutates; HTTP stats() snapshots).",
+    module="room_tpu/serving/kv_offload.py", cls="TieredKVStore",
+    attr="_lock", hints=("store", "self._offload"), multi_instance=True,
+)
+register_lock(
+    "scheduler", "RequestScheduler per-class EDF queues + chunk "
+    "budgets.",
+    module="room_tpu/serving/scheduler.py", cls="RequestScheduler",
+    attr="_lock", multi_instance=True,
+)
+register_lock(
+    "prefix_store", "SharedPrefixStore byte accounting + LRU index "
+    "over the content-addressed prefix tier.",
+    module="room_tpu/serving/prefix_store.py", cls="SharedPrefixStore",
+    attr="_lock", multi_instance=True,
+)
+register_lock(
+    "embed_host", "Process-wide embed-service host singleton build.",
+    module="room_tpu/serving/embed_service.py", attr="_host_lock",
+)
+register_lock(
+    "embed_index", "DeviceEmbedIndex row/id maps + device buffer "
+    "snapshot.",
+    module="room_tpu/serving/embed_service.py", cls="DeviceEmbedIndex",
+    attr="_lock", multi_instance=True,
+)
+
+# ---- serving: fleet + disaggregation (docs/fleet.md, docs/disagg.md) ----
+register_lock(
+    "fleet", "EngineFleet routing table: session records, replica "
+    "states, fleet stats, disagg ship bookkeeping.",
+    module="room_tpu/serving/fleet.py", cls="EngineFleet",
+    attr="_lock", hints=("fleet", "self.fleet"),
+)
+register_lock(
+    "fleet_mirror", "Fleet-wide history-mirror token accounting "
+    "(per-token hot path takes the per-record lock, not this).",
+    module="room_tpu/serving/fleet.py", cls="EngineFleet",
+    attr="_mirror_lock", hints=("fleet", "self.fleet"),
+)
+register_lock(
+    "fleet_record", "Per-session router record: history mirror "
+    "append + routing fields (one per live session).",
+    module="room_tpu/serving/fleet.py", cls="_SessionRecord",
+    attr="lock", hints=("rec", "r"), multi_instance=True,
+)
+
+# ---- serving: faults + trace (docs/chaos.md, docs/observability.md) ----
+register_lock(
+    "faults", "Armed fault-point table + firing counters.",
+    module="room_tpu/serving/faults.py", attr="_lock",
+)
+register_lock(
+    "trace_seq", "Per-session turn-sequence counter for correlation "
+    "ids.",
+    module="room_tpu/serving/trace.py", attr="_seq_lock",
+)
+register_lock(
+    "trace_finish", "TurnTrace finish/emit critical section.",
+    module="room_tpu/serving/trace.py", attr="_finish_lock",
+)
+register_lock(
+    "trace_recorder", "FlightRecorder rings (recent / violations / "
+    "events).",
+    module="room_tpu/serving/trace.py", cls="FlightRecorder",
+    attr="_lock",
+)
+
+# ---- core: swarm runtime (docs/swarm_recovery.md) ----
+register_lock(
+    "telemetry", "In-process resilience counters + latency "
+    "histograms.",
+    module="room_tpu/core/telemetry.py", attr="_counters_lock",
+)
+register_lock(
+    "agent_registry", "Agent-loop registry: running loops + launched "
+    "rooms.",
+    module="room_tpu/core/agent_loop.py", attr="_registry_lock",
+)
+register_lock(
+    "agent_supervision", "Crash-strike history + unhealthy-worker "
+    "roster for supervise_loops.",
+    module="room_tpu/core/agent_loop.py", attr="_supervision_lock",
+)
+register_lock(
+    "event_bus", "EventBus subscriber lists.",
+    module="room_tpu/core/events.py", cls="EventBus", attr="_lock",
+)
+register_lock(
+    "task_slots", "Per-room concurrent task-run slot pool.",
+    module="room_tpu/core/task_runner.py", cls="_SlotPool",
+    attr="_lock",
+)
+register_lock(
+    "cycle_logs", "In-memory cycle log ring buffer.",
+    module="room_tpu/core/cycle_logs.py", cls="CycleLogBuffer",
+    attr="_lock",
+)
+register_lock(
+    "supervisor", "Tracked child-process table.",
+    module="room_tpu/core/supervisor.py", attr="_lock",
+)
+register_lock(
+    "web_sessions", "Web-automation session table.",
+    module="room_tpu/core/web_tools.py", attr="_sessions_lock",
+)
+
+# ---- db ----
+register_lock(
+    "db", "SQLite connection serialization (reentrant: transaction "
+    "helpers nest).",
+    module="room_tpu/db/database.py", cls="Database", attr="_lock",
+    kind="rlock", multi_instance=True,
+)
+register_lock(
+    "db_default", "Process-default Database singleton build.",
+    module="room_tpu/db/database.py", attr="_default_lock",
+)
+
+# ---- providers (docs/lifecycle.md) ----
+register_lock(
+    "model_hosts", "Process-wide ModelHost registry build/teardown.",
+    module="room_tpu/providers/tpu.py", attr="_hosts_lock",
+)
+register_lock(
+    "model_host", "One ModelHost's engine build / drain / restore "
+    "serialization.",
+    module="room_tpu/providers/tpu.py", cls="ModelHost", attr="_lock",
+    hints=("host",), multi_instance=True,
+)
+
+# ---- server ----
+register_lock(
+    "lifecycle", "Server lifecycle phase + drain summary fields.",
+    module="room_tpu/server/runtime.py", attr="_lifecycle_lock",
+)
+register_lock(
+    "runtime_pending", "ServerRuntime pending-notification queue.",
+    module="room_tpu/server/runtime.py", cls="ServerRuntime",
+    attr="_pending_lock",
+)
+register_lock(
+    "http_rate_limiter", "Per-client HTTP rate-limit window.",
+    module="room_tpu/server/http.py", cls="_RateLimiter", attr="_lock",
+)
+register_lock(
+    "webhooks", "Registered webhook table.",
+    module="room_tpu/server/webhooks.py", attr="_lock",
+)
+register_lock(
+    "updater", "UpdateChecker cached release state.",
+    module="room_tpu/server/updater.py", cls="UpdateChecker",
+    attr="_lock",
+)
+register_lock(
+    "tpu_manager", "TPU runtime singleton build.",
+    module="room_tpu/server/tpu_manager.py", attr="_lock",
+)
+register_lock(
+    "commentary", "CommentaryEngine recent-line ring.",
+    module="room_tpu/server/commentary.py", cls="CommentaryEngine",
+    attr="_lock",
+)
+register_lock(
+    "ws_hub", "WebSocketHub client set + channel subscriptions.",
+    module="room_tpu/server/ws.py", cls="WebSocketHub", attr="_lock",
+)
+register_lock(
+    "provider_auth", "ProviderAuthManager session map.",
+    module="room_tpu/server/provider_auth.py", cls="ProviderAuthManager",
+    attr="_lock",
+)
+register_lock(
+    "provider_auth_manager", "Process-default ProviderAuthManager "
+    "singleton build.",
+    module="room_tpu/server/provider_auth.py", attr="_manager_lock",
+)
+
+# ---- utils ----
+register_lock(
+    "native_lib", "Lazy native-library dlopen singleton.",
+    module="room_tpu/utils/native.py", attr="_lock",
+)
+register_lock(
+    "compile_cache", "One-shot XLA compile-cache enablement.",
+    module="room_tpu/utils/compile_cache.py", attr="_lock",
+)
+register_lock(
+    "http_profiler", "HttpProfiler slow-request ring.",
+    module="room_tpu/utils/profiling.py", cls="HttpProfiler",
+    attr="_lock",
+)
+register_lock(
+    "device_profiler", "DeviceProfiler capture state (one capture at "
+    "a time).",
+    module="room_tpu/utils/profiling.py", cls="DeviceProfiler",
+    attr="_lock",
+)
+register_lock(
+    "step_timer", "StepTimer rolling duration window.",
+    module="room_tpu/utils/profiling.py", cls="StepTimer", attr="_lock",
+    multi_instance=True,
+)

@@ -11,6 +11,7 @@ import threading
 from typing import Optional
 
 import numpy as np
+from . import locks
 
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
@@ -19,7 +20,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libvecsearch.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
-_lock = threading.Lock()
+_lock = locks.make_lock("native_lib")
 
 
 def _bind(path: str) -> Optional[ctypes.CDLL]:

@@ -19,7 +19,7 @@ import re
 import threading
 import time
 from contextlib import contextmanager
-from . import knobs
+from . import knobs, locks
 from typing import Iterator, Optional
 
 log = logging.getLogger(__name__)
@@ -45,7 +45,7 @@ def normalize_path(path: str) -> str:
 class HttpProfiler:
     def __init__(self) -> None:
         self._stats: dict[str, list[float]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("http_profiler")
 
     def record(self, method: str, path: str, ms: float) -> None:
         key = f"{method} {normalize_path(path)}"
@@ -130,7 +130,7 @@ class DeviceProfiler:
     TensorBoard trace dir under ROOM_TPU_TRACE_DIR."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("device_profiler")
         self._thread: Optional[threading.Thread] = None
         self._state: dict = {"running": False}
         self._seq = 0
@@ -210,7 +210,7 @@ class StepTimer:
     def __init__(self) -> None:
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("step_timer")
 
     @contextmanager
     def phase(self, name: str) -> Iterator[None]:

@@ -1,0 +1,57 @@
+"""Seeded lockmap violations: every blocking-call-under-lock class.
+
+``file_io`` (open / os.replace / shutil copy), ``sockets``
+(sendall / recv), ``joins`` (timeout-less Thread.join, Queue.get,
+Event.wait). ``bounded_ok`` holds the same lock but bounds every
+call — zero findings expected there.
+"""
+
+import os
+import shutil
+import threading
+
+_io_lock = threading.Lock()
+
+
+def file_io(path, tmp):
+    with _io_lock:
+        with open(path) as fh:           # blocking-under-lock
+            data = fh.read()
+        os.replace(tmp, path)            # blocking-under-lock
+        shutil.copyfile(path, tmp)       # blocking-under-lock
+    return data
+
+
+def sockets(sock):
+    with _io_lock:
+        sock.sendall(b"ping")            # blocking-under-lock
+        return sock.recv(1024)           # blocking-under-lock
+
+
+def joins(thread, q, ev):
+    with _io_lock:
+        thread.join()                    # blocking-under-lock
+        item = q.get()                   # blocking-under-lock
+        ev.wait()                        # blocking-under-lock
+    return item
+
+
+def timeout_none_spellings(thread, q, ev):
+    # every one of these blocks exactly like the bare calls above
+    with _io_lock:
+        thread.join(timeout=None)        # blocking-under-lock
+        item = q.get(block=True)         # blocking-under-lock
+        item = q.get(True, None)         # blocking-under-lock
+        ev.wait(None)                    # blocking-under-lock
+    return item
+
+
+def bounded_ok(thread, q, ev, d):
+    with _io_lock:
+        thread.join(timeout=1.0)
+        item = q.get(timeout=0.5)
+        item = q.get(True, 0.5)
+        ev.wait(0.5)
+        item = d.get("key")              # dict.get: not queue-like
+        item = d.get("key", None)
+    return item

@@ -1,0 +1,39 @@
+"""Seeded lockmap violations: guarded-state inference.
+
+``Tracker._items`` and ``Tracker.count`` are written under
+``self._lock`` at two sites each, so both infer as guarded. The
+unlocked subscript write and the unlocked direct iteration must be
+flagged; the ``*_locked`` helper, the ``__init__`` writes, and the
+plain GIL-atomic load must not.
+"""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self.count = 0
+
+    def add(self, key, val):
+        with self._lock:
+            self._items[key] = val
+            self.count += 1
+
+    def drop(self, key):
+        with self._lock:
+            self._items.pop(key, None)
+            self.count -= 1
+
+    def racy_write(self, key):
+        self._items[key] = None          # lock-guarded-write
+
+    def racy_iter(self):
+        return [k for k in self._items]  # lock-guarded-iter
+
+    def _sweep_locked(self):
+        self._items.clear()              # exempt: caller holds lock
+
+    def snapshot_count(self):
+        return self.count                # plain load: sanctioned

@@ -1,0 +1,25 @@
+"""Seeded lockmap violation: AB/BA lock-order cycle.
+
+Two module-global locks acquired in both nesting orders — two threads
+entering from different sides deadlock. The analysis-suite tests
+register ``fx_alpha``/``fx_beta`` bindings for this file and expect
+one ``lock-order-cycle`` finding.
+"""
+
+import threading
+
+_alpha_lock = threading.Lock()
+_beta_lock = threading.Lock()
+shared = 0
+
+
+def forward():
+    with _alpha_lock:
+        with _beta_lock:
+            return shared
+
+
+def backward():
+    with _beta_lock:
+        with _alpha_lock:
+            return shared

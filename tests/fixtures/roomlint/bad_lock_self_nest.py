@@ -1,0 +1,33 @@
+"""Seeded lockmap violations: same-instance re-acquires of
+non-reentrant locks — guaranteed deadlocks.
+
+- ``lexical``: a module-global lock nested inside itself;
+- ``Worker.outer``: a ``self.method()`` call under ``self._lock``
+  into a method that takes the same lock (the class is registered
+  ``multi_instance`` — the ``self.`` call is same-instance evidence
+  that overrides that exemption).
+"""
+
+import threading
+
+_gamma_lock = threading.Lock()
+
+
+def lexical():
+    with _gamma_lock:
+        with _gamma_lock:
+            pass
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = []
+
+    def _inner(self):
+        with self._lock:
+            return len(self.jobs)
+
+    def outer(self):
+        with self._lock:
+            return self._inner()

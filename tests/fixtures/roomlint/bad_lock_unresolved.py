@@ -1,0 +1,16 @@
+"""Seeded lockmap violation: an acquisition site the registry cannot
+name (``lock-unresolved``) — the lock was never registered and the
+site carries no ``# lockmap: name=...`` pin.
+"""
+
+import threading
+
+
+class Mystery:
+    def __init__(self):
+        self._mystery_lock = threading.Lock()
+        self.state = {}
+
+    def touch(self, key):
+        with self._mystery_lock:
+            self.state[key] = True

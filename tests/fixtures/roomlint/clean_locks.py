@@ -1,0 +1,60 @@
+"""Clean lockmap fixture — disciplined concurrency that must produce
+ZERO findings (the zero-false-positive pass):
+
+- consistent guard discipline on every shared field;
+- a ``*_locked`` caller-holds helper;
+- bounded waits/joins under the lock;
+- a one-way nesting order (outer -> inner only);
+- an explicitly pinned acquisition (``# lockmap: name=...``).
+
+The analysis-suite tests register ``fx_clean`` / ``fx_clean_inner``
+bindings for this file.
+"""
+
+import threading
+
+_clean_outer_lock = threading.Lock()
+_clean_inner_lock = threading.Lock()
+_totals = {"events": 0}
+
+
+def account(n):
+    with _clean_outer_lock:
+        with _clean_inner_lock:
+            _totals["events"] += n
+
+
+_renamed_lock = _clean_inner_lock
+
+
+def account_pinned(n):
+    # an aliased spelling the resolver cannot bind on its own: the
+    # inline pin names it
+    with _renamed_lock:  # lockmap: name=fx_clean_inner
+        _totals["events"] += n
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def put(self, key, val):
+        with self._lock:
+            self._rows[key] = val
+
+    def drop(self, key):
+        with self._lock:
+            self._rows.pop(key, None)
+
+    def keys(self):
+        with self._lock:
+            return list(self._rows)
+
+    def _clear_locked(self):
+        self._rows.clear()
+
+    def wait_bounded(self, ev, thread):
+        with self._lock:
+            ev.wait(0.1)
+            thread.join(timeout=0.1)

@@ -313,3 +313,404 @@ def test_dynamic_family_resolution(monkeypatch):
     assert knobs.get_dynamic(
         "ROOM_TPU_{KIND}_BASE", "OPENAI", default="https://x"
     ) == "https://x"
+
+
+# ---- checker 6: lockmap — whole-program concurrency (ISSUE 14) --------
+
+import contextlib  # noqa: E402
+import threading  # noqa: E402
+
+from room_tpu.analysis import lockmap  # noqa: E402
+from room_tpu.utils import lockdep, locks  # noqa: E402
+
+_FX = "tests/fixtures/roomlint"
+
+
+@contextlib.contextmanager
+def _fixture_locks():
+    """Temporarily register the fixture files' lock bindings (the
+    real registry only knows real locks)."""
+    added = []
+
+    def add(name, **kw):
+        locks.register_lock(name, "fixture binding", **kw)
+        added.append(name)
+
+    add("fx_alpha", module=f"{_FX}/bad_lock_cycle.py",
+        attr="_alpha_lock")
+    add("fx_beta", module=f"{_FX}/bad_lock_cycle.py",
+        attr="_beta_lock")
+    add("fx_gamma", module=f"{_FX}/bad_lock_self_nest.py",
+        attr="_gamma_lock")
+    add("fx_worker", module=f"{_FX}/bad_lock_self_nest.py",
+        cls="Worker", attr="_lock", multi_instance=True)
+    add("fx_tracker", module=f"{_FX}/bad_guarded_field.py",
+        cls="Tracker", attr="_lock")
+    add("fx_io", module=f"{_FX}/bad_blocking_under_lock.py",
+        attr="_io_lock")
+    add("fx_clean_outer", module=f"{_FX}/clean_locks.py",
+        attr="_clean_outer_lock")
+    add("fx_clean_inner", module=f"{_FX}/clean_locks.py",
+        attr="_clean_inner_lock")
+    add("fx_ledger", module=f"{_FX}/clean_locks.py",
+        cls="Ledger", attr="_lock")
+    try:
+        yield
+    finally:
+        for name in added:
+            locks.LOCK_REGISTRY.pop(name, None)
+
+
+def _lockmap_findings(*names):
+    facts = lockmap.collect_facts([_src(n) for n in names])
+    return (
+        lockmap.check_lock_graph(facts)
+        + lockmap.check_guarded_state(facts)
+        + lockmap.check_blocking(facts)
+    )
+
+
+def test_lockmap_detects_ab_ba_cycle():
+    with _fixture_locks():
+        out = _lockmap_findings("bad_lock_cycle.py")
+    cycles = [v for v in out if v.rule == "lock-order-cycle"]
+    assert len(cycles) == 1, [v.render() for v in out]
+    assert "fx_alpha" in cycles[0].message
+    assert "fx_beta" in cycles[0].message
+
+
+def test_lockmap_detects_same_instance_self_nest():
+    with _fixture_locks():
+        out = _lockmap_findings("bad_lock_self_nest.py")
+    nests = {v.message.split("'")[1] for v in out
+             if v.rule == "lock-self-nest"}
+    # the lexical global re-acquire AND the self.method() call-path
+    # re-acquire — multi_instance does not exempt same-instance
+    # evidence
+    assert nests == {"fx_gamma", "fx_worker"}, \
+        [v.render() for v in out]
+
+
+def test_lockmap_guard_inference_flags_unlocked_access():
+    with _fixture_locks():
+        out = _lockmap_findings("bad_guarded_field.py")
+    by_rule = {}
+    for v in out:
+        by_rule.setdefault(v.rule, []).append(v)
+    writes = by_rule.get("lock-guarded-write", [])
+    iters = by_rule.get("lock-guarded-iter", [])
+    assert len(writes) == 1 and "_items" in writes[0].message
+    assert "racy_write" in writes[0].qualname
+    assert len(iters) == 1 and "racy_iter" in iters[0].qualname
+    # __init__ writes, the *_locked helper, and the plain load are
+    # exempt: nothing else fires
+    assert len(out) == 2, [v.render() for v in out]
+
+
+def test_lockmap_blocking_taxonomy_per_class():
+    with _fixture_locks():
+        out = _lockmap_findings("bad_blocking_under_lock.py")
+    blocking = [v for v in out if v.rule == "blocking-under-lock"]
+    msgs = " ".join(v.message for v in blocking)
+    for needle in ("open()", "os.replace()", "shutil.copyfile()",
+                   "sendall()", "recv()", "Thread.join()",
+                   "Queue.get()", ".wait()"):
+        assert needle in msgs, (needle, msgs)
+    # 8 bare-call sites + 4 timeout=None/block=True spellings
+    assert len(blocking) == 12, [v.render() for v in blocking]
+    assert sum("timeout_none_spellings" in v.qualname
+               for v in blocking) == 4
+    # bounded calls and dict.get stay clean
+    assert all("bounded_ok" not in v.qualname for v in blocking)
+
+
+def test_lockmap_unresolved_lock_is_flagged():
+    out = _lockmap_findings("bad_lock_unresolved.py")
+    unres = [v for v in out if v.rule == "lock-unresolved"]
+    assert len(unres) == 1 and "_mystery_lock" in unres[0].message
+
+
+def test_lockmap_clean_fixture_zero_false_positives():
+    with _fixture_locks():
+        out = _lockmap_findings("clean_locks.py")
+    assert out == [], [v.render() for v in out]
+
+
+def test_lockmap_inline_pin_resolves_aliased_spelling():
+    """Without its pin the aliased acquisition in clean_locks.py would
+    be lock-unresolved: strip the pin comment and assert exactly that
+    finding appears."""
+    path = FIXTURES / "clean_locks.py"
+    text = path.read_text().replace("  # lockmap: name=fx_clean_inner",
+                                    "")
+    src = SourceFile(str(path), text=text,
+                     rel=os.path.relpath(path, REPO))
+    with _fixture_locks():
+        facts = lockmap.collect_facts([src])
+        out = lockmap.check_lock_graph(facts)
+    assert [v.rule for v in out] == ["lock-unresolved"], \
+        [v.render() for v in out]
+
+
+def test_lock_registry_drift_detected():
+    with _fixture_locks():
+        # fixture locks are created via bare threading.Lock(), so the
+        # drift rule fires for each binding when their module is in
+        # the scanned set
+        facts = lockmap.collect_facts([_src("bad_lock_cycle.py")])
+        out = lockmap.check_registry_drift(facts)
+    names = {v.message.split("'")[1] for v in out}
+    assert names == {"fx_alpha", "fx_beta"}
+
+
+def test_lock_registry_bindings_match_real_tree():
+    """Every real registry entry's module creates its lock through the
+    factory (the gate's lock-registry-drift rule stays empty)."""
+    from room_tpu.analysis.common import SourceCache, iter_py_paths
+
+    cache = SourceCache(str(REPO))
+    sources = [s for s in (cache.source(p) for p in iter_py_paths(
+        ("room_tpu",), str(REPO))) if s is not None]
+    facts = lockmap.collect_facts(sources)
+    out = lockmap.check_registry_drift(facts)
+    assert out == [], [v.render() for v in out]
+    # and every decl's module is actually part of the tree
+    for decl in locks.LOCK_REGISTRY.values():
+        assert (REPO / decl.module).exists(), decl.name
+
+
+def test_lock_graph_dot_export():
+    from room_tpu.analysis.common import SourceCache, iter_py_paths
+
+    cache = SourceCache(str(REPO))
+    sources = [s for s in (cache.source(p) for p in iter_py_paths(
+        ("room_tpu",), str(REPO))) if s is not None]
+    facts = lockmap.collect_facts(sources)
+    dot = lockmap.render_dot(facts)
+    assert dot.startswith("digraph lockmap")
+    # the engine->kv edges PR 14 made the graph's first citizens
+    assert '"engine" -> "kv_page_table"' in dot
+    assert '"engine" -> "kv_offload"' in dot
+    # the alias-typed edge the runtime witness surfaced first:
+    # engine._queue = engine.scheduler, so _queue_put's enqueue under
+    # the engine lock takes the scheduler lock one call deep
+    assert '"engine" -> "scheduler"' in dot
+
+
+# ---- single-parse AST cache (ISSUE 14 satellite) ----------------------
+
+def test_run_checks_parses_each_file_exactly_once(monkeypatch):
+    """The measurable `make lint` speedup: one ast.parse per file per
+    run across ALL passes (per-file checkers, the lockmap
+    whole-program pass, the fault/trace cross-checks that used to
+    re-parse faults.py three times)."""
+    import ast as ast_mod
+    from collections import Counter
+
+    calls = Counter()
+    real_parse = ast_mod.parse
+
+    def counting(source, filename="<unknown>", *a, **kw):
+        calls[str(filename)] += 1
+        return real_parse(source, filename, *a, **kw)
+
+    monkeypatch.setattr(ast_mod, "parse", counting)
+    active, _ = analysis.run_checks(str(REPO))
+    assert active == [], [v.render() for v in active]
+    repeated = {f: n for f, n in calls.items() if n > 1}
+    assert repeated == {}, repeated
+    # the historically thrice-parsed files are parsed exactly once
+    faults_path = str(REPO / "room_tpu" / "serving" / "faults.py")
+    trace_path = str(REPO / "room_tpu" / "serving" / "trace.py")
+    assert calls[faults_path] == 1
+    assert calls[trace_path] == 1
+
+
+# ---- lockdep: the runtime witness (ISSUE 14) --------------------------
+
+@pytest.fixture()
+def _lockdep_armed(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_LOCKDEP", "1")
+    monkeypatch.setenv("ROOM_TPU_LOCKDEP_STRICT", "1")
+    lockdep.reset()
+    yield
+    lockdep.reset()
+
+
+def test_lockdep_clean_pass_records_edges(_lockdep_armed):
+    a = lockdep.LockdepLock("wa", threading.Lock(), "lock")
+    b = lockdep.LockdepLock("wb", threading.Lock(), "lock")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    snap = lockdep.snapshot()
+    assert snap["inversions"] == 0
+    assert ("wa", "wb") in lockdep.observed_edges()
+
+
+def test_lockdep_inversion_raises_in_strict_mode(_lockdep_armed):
+    a = lockdep.LockdepLock("wa", threading.Lock(), "lock")
+    b = lockdep.LockdepLock("wb", threading.Lock(), "lock")
+    with a:
+        with b:
+            pass
+    errors = []
+
+    def reversed_order():
+        try:
+            with b:
+                with a:
+                    pass
+        except lockdep.LockOrderError as e:
+            errors.append(str(e))
+
+    t = threading.Thread(target=reversed_order)
+    t.start()
+    t.join()
+    assert errors and "inversion" in errors[0]
+    assert lockdep.snapshot()["inversions"] == 1
+
+
+def test_lockdep_inversion_counts_when_not_strict(
+    _lockdep_armed, monkeypatch,
+):
+    monkeypatch.setenv("ROOM_TPU_LOCKDEP_STRICT", "0")
+    a = lockdep.LockdepLock("wa", threading.Lock(), "lock")
+    b = lockdep.LockdepLock("wb", threading.Lock(), "lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:   # inversion: recorded, not raised
+            pass
+    snap = lockdep.snapshot()
+    assert snap["inversions"] == 1
+    assert snap["evidence"][0]["acquired"] == "wa"
+    assert snap["evidence"][0]["held"] == "wb"
+    # review regression: the counted inversion proceeds to acquire,
+    # but must NOT record the reverse edge — acquisitions in the
+    # original sanctioned order stay clean afterwards (one real ABBA
+    # must not inflate the counter on every later normal nesting)
+    with a:
+        with b:
+            pass
+    assert lockdep.snapshot()["inversions"] == 1
+    assert lockdep.observed_edges() == {("wa", "wb")}
+
+
+def test_lockdep_inversion_with_telemetry_loaded_never_hangs(
+    _lockdep_armed, monkeypatch,
+):
+    """Review regression: the telemetry counter lock is itself a
+    LockdepLock, so counting an inversion from inside the meta-locked
+    section re-entered _precheck and self-deadlocked on the meta lock
+    — the witness hung the exact thread it was protecting. The count
+    now happens after the meta lock is released, under the
+    reentrancy guard: an inversion with telemetry live must resolve
+    promptly in both modes."""
+    import room_tpu.core.telemetry as telemetry
+
+    # telemetry may have been imported before arming: force its
+    # counter lock onto the instrumented path like an armed boot
+    monkeypatch.setattr(
+        telemetry, "_counters_lock",
+        lockdep.LockdepLock("telemetry", threading.Lock(), "lock"),
+    )
+    a = lockdep.LockdepLock("wa", threading.Lock(), "lock")
+    b = lockdep.LockdepLock("wb", threading.Lock(), "lock")
+    with a:
+        with b:
+            pass
+    # strict: raises (never hangs)
+    with pytest.raises(lockdep.LockOrderError, match="inversion"):
+        with b:
+            with a:
+                pass
+    # non-strict: counts through the live telemetry lock (never hangs)
+    monkeypatch.setenv("ROOM_TPU_LOCKDEP_STRICT", "0")
+    before = telemetry.counters_snapshot().get("lockdep_inversions", 0)
+    with b:
+        with a:
+            pass
+    after = telemetry.counters_snapshot().get("lockdep_inversions", 0)
+    assert after > before
+    assert lockdep.snapshot()["inversions"] >= 2
+
+
+def test_lockdep_same_instance_reacquire_raises_even_lenient(
+    _lockdep_armed, monkeypatch,
+):
+    monkeypatch.setenv("ROOM_TPU_LOCKDEP_STRICT", "0")
+    a = lockdep.LockdepLock("wa", threading.Lock(), "lock")
+    with pytest.raises(lockdep.LockOrderError, match="same-instance"):
+        with a:
+            with a:
+                pass
+
+
+def test_lockdep_rlock_reentry_is_clean(_lockdep_armed):
+    r = lockdep.LockdepLock("wr", threading.RLock(), "rlock")
+    with r:
+        with r:
+            pass
+    assert lockdep.snapshot()["inversions"] == 0
+    assert lockdep.observed_edges() == set()
+
+
+def test_make_lock_plain_by_default_instrumented_when_armed(
+    monkeypatch,
+):
+    monkeypatch.delenv("ROOM_TPU_LOCKDEP", raising=False)
+    plain = locks.make_lock("engine")
+    assert type(plain).__name__ != "LockdepLock"
+    monkeypatch.setenv("ROOM_TPU_LOCKDEP", "1")
+    inst = locks.make_lock("engine")
+    assert isinstance(inst, lockdep.LockdepLock)
+    assert inst.name == "engine"
+    # bounded/non-blocking acquire surface survives wrapping
+    assert inst.acquire(timeout=0.5)
+    inst.release()
+    assert inst.acquire(blocking=False)
+    inst.release()
+    with pytest.raises(ValueError, match="registered as"):
+        locks.make_rlock("engine")
+    with pytest.raises(KeyError, match="unregistered lock"):
+        locks.make_lock("nope")
+
+
+def test_lockdep_observed_order_consistent_with_static_graph(
+    _lockdep_armed,
+):
+    """The witness contract: acquiring registered locks in the static
+    graph's direction records no inversion, and the combined
+    static+observed edge set stays acyclic."""
+    static = lockmap.graph_edges(str(REPO), ("room_tpu",))
+    assert ("engine", "kv_page_table") in static
+    eng = locks.make_lock("engine")
+    pt = locks.make_lock("kv_page_table")
+    with eng:
+        with pt:
+            pass
+    assert lockdep.snapshot()["inversions"] == 0
+    combined = static | lockdep.observed_edges()
+
+    def acyclic(edges):
+        adj = {}
+        for x, y in edges:
+            adj.setdefault(x, set()).add(y)
+        seen, done = set(), set()
+
+        def dfs(n):
+            if n in done:
+                return True
+            if n in seen:
+                return False
+            seen.add(n)
+            ok = all(dfs(m) for m in adj.get(n, ()))
+            done.add(n)
+            return ok
+
+        return all(dfs(n) for n in list(adj))
+
+    assert acyclic(combined)
