@@ -1034,6 +1034,124 @@ def main() -> None:
         except Exception as e:
             _phase("fleet_failover", {"error": str(e)[:300]})
 
+    # Pod partition failover (docs/podnet.md): partition the KV wire
+    # mid-ship (wire_partition armed for every attempt), let
+    # kv_wire_send exhaust its retry budget into the mirror
+    # re-prefill degradation, and measure the first-token latency of
+    # the continuation after the partition. The acceptance number is
+    # tokens_lost == 0 — the partition may cost warmth, never tokens.
+    def measure_partition_failover() -> dict:
+        from room_tpu.serving import faults as faults_mod
+        from room_tpu.serving import podnet as podnet_mod
+        from room_tpu.serving.fleet import EngineFleet
+
+        budget = 16 if TINY else 32
+        sp = SamplingParams(temperature=0.0, max_new_tokens=budget)
+        cont_sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        cont = [7, 7, 7]
+        ctrl = ServingEngine(
+            cfg, params, max_batch=4, page_size=16, n_pages=512,
+        )
+        c1 = ctrl.submit(prompt, session_id="c", sampling=sp)
+        ctrl.run_until_idle()
+        c2 = ctrl.submit(cont, session_id="c", sampling=cont_sp)
+        ctrl.run_until_idle()
+        full, full2 = list(c1.new_tokens), list(c2.new_tokens)
+        del ctrl
+        gc.collect()
+
+        # the wire knobs are read PER SEND, so they stay overridden
+        # for the whole phase (restored in the outer finally)
+        overrides = {
+            "ROOM_TPU_DISAGG_WIRE": "loopback",
+            "ROOM_TPU_DISAGG_PREFILL_TOKENS": "16",
+            "ROOM_TPU_WIRE_RETRIES": "2",
+            "ROOM_TPU_WIRE_BACKOFF_S": "0.005",
+        }
+        prev = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+
+        def build(i):
+            return ServingEngine(
+                cfg, params, max_batch=4, page_size=16,
+                n_pages=512, offload=True,
+            )
+
+        fleet = None
+        try:
+            fleet = EngineFleet(
+                "bench-podnet", build, 2, auto_rebuild=False,
+                roles=["prefill", "decode"],
+            )
+            for h in fleet.replicas:
+                h.engine.submit(prompt, session_id="warm",
+                                sampling=cont_sp)
+                h.engine.run_until_idle()
+                h.engine.release_session("warm")
+            t1 = fleet.submit(prompt, session_id="s", sampling=sp)
+            donor = fleet._handle(fleet._records["s"].rid)
+            for _ in range(5000):
+                donor.engine.step()
+                if t1.done.is_set():
+                    break
+            # the partition lands NOW: the turn-boundary ship fires
+            # into a dead wire, retries, exhausts, and degrades
+            faults_mod.inject("wire_partition")
+            fleet.supervise()
+            wire_attempts = faults_mod.fired("wire_partition")
+            faults_mod.clear("wire_partition")
+            first: dict = {}
+            t0 = time.perf_counter()
+            t2 = fleet.submit(
+                cont, session_id="s", sampling=cont_sp,
+                on_token=lambda tok: first.setdefault(
+                    "t", time.perf_counter()
+                ),
+            )
+            fleet.run_until_idle()
+            ttft = round(first["t"] - t0, 3) if "t" in first else None
+            token_loss = 0
+            if list(t1.new_tokens) != full or \
+                    list(t2.new_tokens) != full2:
+                token_loss = sum(
+                    1 for a, b in zip(
+                        list(t1.new_tokens) + list(t2.new_tokens),
+                        full + full2,
+                    ) if a != b
+                ) or 1
+            dst = fleet.fleet_stats()["disagg"]
+            if CPU_PROXY and ttft is not None:
+                _proxy_deltas["partition_failover_ttft_s"] = ttft
+            return {
+                "wire_attempts": wire_attempts,
+                "wire_errors": dst["wire_errors"],
+                "ships_reprefill": dst["ships_reprefill"],
+                # the acceptance number: MUST be 0 — the exhausted
+                # wire degrades to mirror re-prefill, token-identical
+                "tokens_lost": token_loss,
+                "ttft_after_partition_s": ttft,
+                "breakers": podnet_mod.breakers_snapshot(),
+            }
+        finally:
+            faults_mod.clear()
+            if fleet is not None:
+                fleet.disagg.close()
+            podnet_mod.reset_breakers()
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            del fleet
+            gc.collect()
+
+    if os.environ.get("ROOM_TPU_BENCH_PODNET", "1") != "0":
+        _extend_deadline()
+        try:
+            _phase("partition_failover", measure_partition_failover())
+        except Exception as e:
+            _phase("partition_failover", {"error": str(e)[:300]})
+
     # Disaggregated prefill/decode A/B (docs/disagg.md): a burst of
     # 2k-token prompts against (a) a mixed fleet — every replica eats
     # prefill chunks between its decode windows — and (b) a

@@ -41,6 +41,27 @@ point. ``KVWireServer`` is the receiving end (one per fleet/host);
 ``kv_wire_send`` the sending call. Tests and single-host deployments
 run it over loopback (ROOM_TPU_DISAGG_WIRE=loopback); a cross-host pod
 points the sender at the decode host's listener.
+
+Pod hardening (docs/podnet.md)
+------------------------------
+``kv_wire_send`` and ``wire_send_control`` retry transport failures
+(``ROOM_TPU_WIRE_RETRIES`` attempts, jittered exponential backoff)
+behind a per-peer circuit breaker (``serving/podnet.py``): a
+partitioned peer costs one fast ``KVWireError`` once the breaker
+opens, not a timeout per shipment, and a half-open probe per cooldown
+re-closes it when the peer heals. A receiver REFUSAL
+(``KVWireRefused``) is an application answer from a reachable peer —
+it feeds the breaker a success and is never retried. The
+``wire_partition`` fault point fails individual connection attempts
+so chaos tests drive retry, breaker, and exhaustion separately from
+the whole-shipment ``kv_wire`` point. Control frames (same RTKW
+framing, ``header["control"]`` instead of a session entry, empty
+payload) carry pod membership heartbeats; session entries carry their
+ownership fence token (``entry["fence"]``) for the receiver's
+stale-generation refusal. The server handles each connection on its
+own bounded worker thread — one wedged peer can no longer hold the
+acceptor — and reports a failed accept-thread join in ``stats()``
+instead of silently proceeding.
 """
 
 from __future__ import annotations
@@ -52,6 +73,7 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -59,7 +81,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .mesh import AXES, MeshSpec
-from ..utils import knobs
+from ..utils import knobs, locks
 
 
 def initialize_multihost(
@@ -151,6 +173,13 @@ class KVWireError(RuntimeError):
     ship coordinator."""
 
 
+class KVWireRefused(KVWireError):
+    """The peer ANSWERED and refused (stale fence, unknown target,
+    checksum mismatch). Reachability is proven, so the breaker books a
+    success and the retry loop never re-sends — a deterministic
+    refusal repeated N times is still a refusal."""
+
+
 def wire_timeout_s() -> float:
     try:
         return max(0.1, knobs.get_float("ROOM_TPU_KV_WIRE_TIMEOUT_S"))
@@ -186,6 +215,105 @@ def _recv_json(conn: socket.socket, cap: int = _MAX_HEADER) -> dict:
     return obj
 
 
+def _wire_attempt(
+    address: tuple[str, int],
+    raw: bytes,
+    src: Optional[str],
+    payload_len: int,
+    timeout_s: float,
+) -> dict:
+    """One framed send + reply read; raises KVWireError on transport
+    failure."""
+    try:
+        with socket.create_connection(
+            address, timeout=timeout_s
+        ) as conn:
+            conn.sendall(
+                WIRE_MAGIC + struct.pack("<I", WIRE_VERSION)
+                + struct.pack("<Q", len(raw)) + raw
+                + struct.pack("<Q", payload_len)
+            )
+            if payload_len:
+                with open(src, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        conn.sendall(chunk)
+            return _recv_json(conn)
+    except (OSError, struct.error) as e:
+        raise KVWireError(f"wire send failed: {e}") from e
+
+
+def _send_with_retry(
+    address: tuple[str, int],
+    header: dict,
+    src: Optional[str],
+    payload_len: int,
+    timeout_s: Optional[float],
+    retries: Optional[int],
+) -> dict:
+    """Bounded-retry, breaker-guarded frame send (docs/podnet.md):
+    transport failures (and the ``wire_partition`` fault) consume
+    attempts with jittered backoff between them; an open breaker
+    refuses fast; a receiver refusal raises ``KVWireRefused`` without
+    burning retries. Exhaustion raises KVWireError — the caller owns
+    the degrade-to-mirror contract."""
+    from ..serving import faults, podnet
+    from ..serving.faults import FaultError
+
+    timeout_s = timeout_s if timeout_s is not None else wire_timeout_s()
+    attempts = retries if retries is not None else podnet.wire_retries()
+    attempts = max(1, attempts)
+    raw = json.dumps(header, separators=(",", ":")).encode()
+    breaker = podnet.breaker_for(address)
+    last: Optional[Exception] = None
+    for attempt in range(attempts):
+        if not breaker.allow():
+            raise KVWireError(
+                f"circuit open to {address[0]}:{address[1]} "
+                f"({breaker.snapshot()['consecutive_failures']} "
+                "consecutive failures)"
+            )
+        try:
+            faults.maybe_fail("wire_partition")
+            reply = _wire_attempt(
+                address, raw, src, payload_len, timeout_s
+            )
+        except (KVWireError, FaultError) as e:
+            breaker.record_failure()
+            last = e
+            if attempt + 1 < attempts:
+                delay = podnet.wire_backoff_s(attempt)
+                if delay > 0:
+                    time.sleep(delay)
+            continue
+        if not reply.get("ok") and reply.get("retryable"):
+            # transient backpressure (e.g. a saturated receiver): a
+            # real failure for the breaker and the retry budget, NOT
+            # an application refusal — one backoff later the peer may
+            # have a free handler slot
+            breaker.record_failure()
+            last = KVWireError(
+                f"receiver backpressure: {reply.get('error')}"
+            )
+            if attempt + 1 < attempts:
+                delay = podnet.wire_backoff_s(attempt)
+                if delay > 0:
+                    time.sleep(delay)
+            continue
+        # the peer answered: reachability proven either way
+        breaker.record_success()
+        if not reply.get("ok"):
+            raise KVWireRefused(
+                f"receiver refused shipment: {reply.get('error')}"
+            )
+        return reply
+    raise KVWireError(
+        f"wire send exhausted {attempts} attempt(s): {last}"
+    )
+
+
 def kv_wire_send(
     address: tuple[str, int],
     entry: dict,
@@ -193,17 +321,21 @@ def kv_wire_send(
     fingerprint: Optional[dict] = None,
     target_rid: Optional[str] = None,
     timeout_s: Optional[float] = None,
+    retries: Optional[int] = None,
 ) -> dict:
     """Ship one manifest-style session entry (and its spool file's
     bytes, when ``entry['kv']`` names one) to a ``KVWireServer``.
     Returns the receiver's reply dict; raises KVWireError on any
     transport/protocol/refusal failure — the caller owns the
     degrade-to-re-prefill fallback. The local spool file is NOT
-    consumed; the caller unlinks it after a successful send."""
+    consumed; the caller unlinks it after a successful send.
+
+    Transport failures retry with jittered backoff behind the peer's
+    circuit breaker (docs/podnet.md); the entry's ``fence`` rides the
+    frame so the receiver can refuse a stale-generation export."""
     from ..serving import faults
 
     faults.maybe_fail("kv_wire")
-    timeout_s = timeout_s if timeout_s is not None else wire_timeout_s()
     kv = entry.get("kv") if isinstance(entry.get("kv"), dict) else None
     src = str(kv["file"]) if kv and kv.get("file") else None
     header_entry = dict(entry)
@@ -224,31 +356,24 @@ def kv_wire_send(
         "target_rid": target_rid,
         "payload_sha256": (kv or {}).get("sha256"),
     }
-    raw = json.dumps(header, separators=(",", ":")).encode()
-    try:
-        with socket.create_connection(
-            address, timeout=timeout_s
-        ) as conn:
-            conn.sendall(
-                WIRE_MAGIC + struct.pack("<I", WIRE_VERSION)
-                + struct.pack("<Q", len(raw)) + raw
-                + struct.pack("<Q", payload_len)
-            )
-            if payload_len:
-                with open(src, "rb") as f:
-                    while True:
-                        chunk = f.read(1 << 20)
-                        if not chunk:
-                            break
-                        conn.sendall(chunk)
-            reply = _recv_json(conn)
-    except (OSError, struct.error) as e:
-        raise KVWireError(f"wire send failed: {e}") from e
-    if not reply.get("ok"):
-        raise KVWireError(
-            f"receiver refused shipment: {reply.get('error')}"
-        )
-    return reply
+    return _send_with_retry(
+        address, header, src, payload_len, timeout_s, retries
+    )
+
+
+def wire_send_control(
+    address: tuple[str, int],
+    control: dict,
+    *,
+    timeout_s: Optional[float] = None,
+    retries: Optional[int] = None,
+) -> dict:
+    """Send one payloadless control frame (pod heartbeats,
+    docs/podnet.md) through the same RTKW framing, retry policy, and
+    per-peer breaker as a KV shipment."""
+    return _send_with_retry(
+        address, {"control": control}, None, 0, timeout_s, retries
+    )
 
 
 class KVWireServer:
@@ -257,11 +382,18 @@ class KVWireServer:
     atomic rename, receiver-PID-tagged so the dir's orphan sweeps
     protect it), then hands the localized entry to ``on_entry`` —
     the fleet adopts it into a decode replica there — and replies with
-    that callback's dict.
+    that callback's dict. Control frames (``header["control"]`` —
+    pod heartbeats, docs/podnet.md) dispatch to ``on_control``.
 
-    One listener per fleet/host; connections are handled serially per
-    accept thread (shipments are rare, multi-MB events — simplicity
-    over concurrency)."""
+    One listener per fleet/host. Each accepted connection is handled
+    on its own bounded worker thread (capped at ``max_handlers``,
+    every read under the wire timeout): a peer that wedges mid-frame
+    stalls only its handler, never the acceptor — heartbeats keep
+    landing while a partition strands a shipment. At boot the spool
+    dir is swept of payloads a DEAD receiver process persisted but
+    never adopted (a crash between persist and adopt; the dead-PID
+    check in lifecycle.sweep_orphans — live siblings' files are
+    untouchable)."""
 
     def __init__(
         self,
@@ -269,15 +401,29 @@ class KVWireServer:
         on_entry: Callable[[dict, Optional[dict], Optional[str]], dict],
         host: str = "127.0.0.1",
         port: Optional[int] = None,
+        on_control: Optional[Callable[[dict], dict]] = None,
+        max_handlers: int = 16,
     ) -> None:
         self.spool_dir = spool_dir
         self.on_entry = on_entry
+        self.on_control = on_control
+        self.max_handlers = max(1, max_handlers)
         if port is None:
             try:
                 port = knobs.get_int("ROOM_TPU_KV_WIRE_PORT")
             except ValueError:
                 port = 0
         os.makedirs(spool_dir, exist_ok=True)
+        # wire-received payload files are PID-tagged by THIS receiver
+        # at persist time; one left by a receiver that crashed between
+        # persist and adopt has no consumer — sweep it now (age 0: the
+        # dead-PID / live-PID check is the whole guard here)
+        try:
+            from ..serving.lifecycle import sweep_orphans
+
+            swept = sweep_orphans(spool_dir, max_age_s=0.0)
+        except Exception:
+            swept = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -285,12 +431,34 @@ class KVWireServer:
         self._sock.settimeout(0.25)
         self.address: tuple[str, int] = self._sock.getsockname()[:2]
         self._stop = threading.Event()
+        self._lock = locks.make_lock("kv_wire_server")
         self._seq = 0
+        self._handlers = 0
+        self._stats = {
+            "frames": 0, "control_frames": 0, "refusals": 0,
+            "handler_errors": 0, "handlers_capped": 0,
+            "orphans_swept": swept, "accept_join_failed": 0,
+        }
         self._thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"kv-wire-{self.address[1]}",
         )
         self._thread.start()
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[key] += n
+
+    def stats(self) -> dict:
+        """Receive counters + liveness for health surfaces: a non-zero
+        ``accept_join_failed`` means a close() left the accept thread
+        wedged (reported, like ModelHost.shutdown, never silent)."""
+        with self._lock:
+            out = dict(self._stats)
+            out["open_handlers"] = self._handlers
+        out["address"] = list(self.address)
+        out["accept_alive"] = self._thread.is_alive()
+        return out
 
     def close(self) -> None:
         self._stop.set()
@@ -299,6 +467,18 @@ class KVWireServer:
         except OSError:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # a wedged accept thread is an operational fact, not a
+            # silent shrug: count it where stats()/health can see it
+            self._bump("accept_join_failed")
+            log.warning(
+                "kv wire %s: accept thread did not join within 5s; "
+                "proceeding (reported in stats)", self.address,
+            )
+
+    # operational alias (docs/podnet.md runbook speaks of stopping
+    # the listener)
+    stop = close
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -308,12 +488,59 @@ class KVWireServer:
                 continue
             except OSError:
                 return
+            with self._lock:
+                capped = self._handlers >= self.max_handlers
+                if not capped:
+                    self._handlers += 1
+            if capped:
+                # every handler slot is wedged/busy: answer FAST with
+                # a RETRYABLE refusal — the sender's retry loop books
+                # it as a transport-class failure (breaker failure,
+                # backoff, retry), never as an application refusal a
+                # heartbeat or shipment would give up on
+                self._bump("handlers_capped")
+                try:
+                    conn.settimeout(1.0)
+                    _send_json(conn, {
+                        "ok": False, "retryable": True,
+                        "error": "receiver saturated",
+                    })
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             try:
-                with conn:
-                    conn.settimeout(wire_timeout_s())
-                    self._serve_one(conn)
-            except Exception:
-                log.exception("kv wire: connection handler failed")
+                threading.Thread(
+                    target=self._handle_conn, args=(conn,),
+                    daemon=True,
+                    name=f"kv-wire-conn-{self.address[1]}",
+                ).start()
+            except RuntimeError:
+                # thread exhaustion: give the slot and the socket
+                # back and keep ACCEPTING — the acceptor dying here
+                # would silently kill the whole receive side
+                self._bump("handler_errors")
+                with self._lock:
+                    self._handlers -= 1
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(wire_timeout_s())
+                self._serve_one(conn)
+        except Exception:
+            self._bump("handler_errors")
+            log.exception("kv wire: connection handler failed")
+        finally:
+            with self._lock:
+                self._handlers -= 1
 
     def _serve_one(self, conn: socket.socket) -> None:
         try:
@@ -322,15 +549,14 @@ class KVWireServer:
             reply = {"ok": False, "error": str(e)}
         except Exception as e:   # noqa: BLE001 — reply, never die
             reply = {"ok": False, "error": f"receiver error: {e}"}
+        if not reply.get("ok", True):
+            self._bump("refusals")
         try:
             _send_json(conn, reply)
         except OSError:
             pass
 
     def _receive(self, conn: socket.socket) -> dict:
-        from ..serving import faults
-
-        faults.maybe_fail("kv_wire")
         magic = _recv_exact(conn, 4)
         if magic != WIRE_MAGIC:
             raise KVWireError(f"bad magic {magic!r}")
@@ -347,15 +573,35 @@ class KVWireServer:
         (payload_len,) = struct.unpack("<Q", _recv_exact(conn, 8))
         if payload_len > _MAX_PAYLOAD:
             raise KVWireError(f"oversized payload ({payload_len} bytes)")
+        control = header.get("control")
+        if isinstance(control, dict):
+            # control frame (pod heartbeat): payloadless by contract
+            if payload_len:
+                raise KVWireError("control frame with payload")
+            self._bump("control_frames")
+            if self.on_control is None:
+                raise KVWireError("receiver handles no control frames")
+            result = self.on_control(control)
+            out = {"ok": True}
+            if isinstance(result, dict):
+                out.update(result)
+            return out
         entry = header.get("entry")
         if not isinstance(entry, dict):
             raise KVWireError("header missing entry")
+        self._bump("frames")
+        # the shipment-loss fault scopes to SHIPMENTS: heartbeats ride
+        # the same framing but model their loss via heartbeat_loss
+        from ..serving import faults
+
+        faults.maybe_fail("kv_wire")
         kv = entry.get("kv") if isinstance(entry.get("kv"), dict) \
             else None
         if payload_len and kv is not None:
-            # single accept thread: the counter needs no lock
-            self._seq += 1
-            seq = self._seq
+            # handler threads race the counter now
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
             fname = f"pid{os.getpid()}-wire{seq}-" \
                 f"{os.path.basename(str(kv.get('file') or 'kv'))}"
             if not fname.endswith(".kvspool"):

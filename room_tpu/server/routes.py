@@ -1246,7 +1246,15 @@ def register_aux_routes(r: Router) -> None:
             e.get("degradation_level", 0) > 0 or not e.get("healthy",
                                                            True)
             for e in engines.values()
-        ) or bool(swarm["unhealthy_workers"])
+        ) or bool(swarm["unhealthy_workers"]) or any(
+            # a suspect/dead pod member (docs/podnet.md) is a
+            # degraded pod even while the surviving replicas keep the
+            # model healthy — monitors must see the partition
+            m.get("state") != "alive"
+            for e in engines.values()
+            for m in (((e.get("fleet") or {}).get("pod") or {})
+                      .get("members") or {}).values()
+        )
         from .runtime import lifecycle_snapshot
 
         return ok({

@@ -1,4 +1,4 @@
-from . import disagg, faults, lifecycle, scheduler, trace
+from . import disagg, faults, lifecycle, podnet, scheduler, trace
 from .engine import ServingEngine, Turn
 from .faults import FaultError
 from .fleet import EngineFleet

@@ -163,6 +163,9 @@ class DisaggCoordinator:
         out["prefill_threshold_tokens"] = self.threshold
         if self._wire_server is not None:
             out["wire_address"] = list(self._wire_server.address)
+            # receive counters + acceptor liveness incl. the
+            # failed-join report (docs/podnet.md)
+            out["wire_server"] = self._wire_server.stats()
         return out
 
     # ---- placement ----
@@ -271,6 +274,11 @@ class DisaggCoordinator:
             rec.ship_state = "exporting"
             rec.ship_event = threading.Event()
             rec.ship_t0 = time.monotonic()
+            # fence the ship (docs/podnet.md): the export is valid for
+            # THIS ownership generation only — a re-home while the
+            # ship is in flight supersedes it and the dispatch below
+            # refuses the stale entry instead of forking the session
+            rec.ship_fence = rec.fence
             self._inflight[rec.sid] = rec
         # engine interaction outside the fleet lock: the export is
         # queued to the donor's engine thread (applied inline when no
@@ -335,21 +343,53 @@ class DisaggCoordinator:
         fleet = self.fleet
         with fleet._lock:
             released = fleet._records.get(rec.sid) is not rec
+            stale = not released and rec.fence != rec.ship_fence
         if released:
             self._discard_entry(entry)
             self._abort(rec)
             return
+        if stale:
+            # a failover/re-home advanced the fence while the export
+            # was in flight: the entry is a stale generation — refuse
+            # it (the re-homed placement owns the history now)
+            fleet.note_fence_refusal(
+                rec.sid, rec.ship_fence,
+                f"ship export from {donor_rid}",
+            )
+            self._discard_entry(entry)
+            self._abort(rec)
+            return
+        entry["fence"] = rec.ship_fence
         targets = self._ship_targets(donor_rid)
         if not targets:
             # every decode sibling vanished between start and now:
             # park the entry on the record exactly like a deferred
             # failover re-home — the next route adopts it wherever
-            # the fleet serves by then
+            # the fleet serves by then. Re-verify ownership INSIDE
+            # the lock: a re-home racing this branch must not have
+            # its newer placement unrouted by a stale park.
             with fleet._lock:
-                rec.rid = ""
-                rec.pending_entry = entry
-                rec.pending_fingerprint = None
-                self._finish_ship_locked(rec, outcome="deferred")
+                released = fleet._records.get(rec.sid) is not rec
+                stale = not released and rec.fence != rec.ship_fence
+                if not released and not stale:
+                    rec.rid = ""
+                    rec.fence += 1
+                    entry["fence"] = rec.fence
+                    rec.pending_entry = entry
+                    rec.pending_fingerprint = None
+                    self._finish_ship_locked(
+                        rec, outcome="deferred"
+                    )
+            if released or stale:
+                if stale:
+                    fleet.note_fence_refusal(
+                        rec.sid, rec.ship_fence,
+                        "ship defer superseded",
+                    )
+                self._discard_entry(entry)
+                self._abort(rec)
+                return
+            fleet._journal_place(rec)
             self._bump_outcome("deferred")
             trace_mod.note_event("kv_ship_deferred", {
                 "session": rec.sid, "from": donor_rid,
@@ -367,18 +407,39 @@ class DisaggCoordinator:
                 adopted_rid = str(reply.get("rid") or target.rid)
                 with fleet._lock:
                     released = fleet._records.get(rec.sid) is not rec
-                    if not released:
+                    # a re-home landing during the wire roundtrip
+                    # advanced the fence: the receiver's adopted copy
+                    # is an OLDER history and must not supersede the
+                    # re-homed placement
+                    stale = not released and \
+                        rec.fence != rec.ship_fence
+                    if not released and not stale:
                         rec.rid = adopted_rid
                         rec.rehomed += 1
+                        rec.fence += 1
+                    cur_rid = rec.rid
                     self._finish_ship_locked(rec, outcome)
-                if released:
+                if released or stale:
+                    if stale:
+                        fleet.note_fence_refusal(
+                            rec.sid, rec.ship_fence,
+                            "wire ship superseded",
+                        )
+                    # same exception as _finalize: when the
+                    # superseding placement itself landed on the
+                    # adopting replica, the engine's duplicate-sid
+                    # guard collapsed the copies — releasing there
+                    # would destroy the LIVE session
                     adopter = fleet._handle(adopted_rid)
-                    if adopter is not None:
+                    if adopter is not None and (
+                        released or cur_rid != adopted_rid
+                    ):
                         try:
                             adopter.engine.release_session(rec.sid)
                         except Exception:
                             pass
                     return
+                fleet._journal_place(rec)
                 self._bump_outcome(outcome)
                 self._note_shipped(
                     rec, donor_rid, target,
@@ -402,17 +463,46 @@ class DisaggCoordinator:
                 entry["kv"] = None
             # wire refused/failed: ``entry`` is history-only now —
             # adopt locally so the session is never lost
+        # last ownership re-check before the adoption is queued: a
+        # re-home that landed during the wire roundtrip (the receiver
+        # may have refused this very entry as stale) advanced the
+        # fence — adopting the older history now would fork the
+        # session the fence refusal just protected
+        with fleet._lock:
+            released = fleet._records.get(rec.sid) is not rec
+            stale = not released and rec.fence != rec.ship_fence
+        if released or stale:
+            if stale:
+                fleet.note_fence_refusal(
+                    rec.sid, rec.ship_fence,
+                    "ship adopt superseded",
+                )
+            self._discard_entry(entry)
+            self._abort(rec)
+            return
         ev = target.engine.adopt_parked_session(
             entry, fingerprint=None, require_sha=False,
         )
         with fleet._lock:
-            rec.rid = target.rid
-            rec.rehomed += 1
+            released = fleet._records.get(rec.sid) is not rec
+            stale = not released and rec.fence != rec.ship_fence
+            if not released and not stale:
+                rec.rid = target.rid
+                rec.rehomed += 1
+                rec.fence += 1
+                # re-mint the ship fence to the new generation so
+                # _finalize's supersede check tracks LATER re-homes,
+                # not this (sanctioned) transfer itself
+                rec.ship_fence = rec.fence
             rec.ship_state = "adopting"
             rec.ship_export = None
             rec.ship_adopt = (ev, entry, target.rid)
-        self._note_shipped(rec, donor_rid, target,
-                           entry.get("kv") is not None, wired=False)
+        if not released and not stale:
+            fleet._journal_place(rec)
+            self._note_shipped(
+                rec, donor_rid, target,
+                entry.get("kv") is not None, wired=False,
+            )
         self._finalize(rec)
 
     def _ship_over_wire(
@@ -439,9 +529,19 @@ class DisaggCoordinator:
         src = str(kv["file"]) if kv and kv.get("file") else None
         self._bump("ship_wire")
         try:
+            from ..parallel.multihost import wire_timeout_s
+            from . import podnet as podnet_mod
+
+            # this runs on the SUPERVISE thread: split the configured
+            # shipment timeout across the retry attempts so a
+            # partitioned peer costs roughly one old-style timeout in
+            # total (plus backoffs), not one per attempt — heartbeats,
+            # failover detection, and routed turns wait behind this
+            attempts = podnet_mod.wire_retries()
             reply = kv_wire_send(
                 self._wire_server.address, entry,
                 fingerprint=donor_fp, target_rid=target.rid,
+                timeout_s=max(1.0, wire_timeout_s() / attempts),
             )
         except Exception as e:   # KVWireError / FaultError / OSError
             self._bump("wire_errors")
@@ -478,14 +578,29 @@ class DisaggCoordinator:
             return   # adoption applies at the target's next step
         with fleet._lock:
             released = fleet._records.get(rec.sid) is not rec
-        if released:
-            # released after the dispatch re-check: the target just
-            # adopted a session nobody owns — release it there so no
-            # ghost holds pages/spool
-            try:
-                target.engine.release_session(rec.sid)
-            except Exception:
-                pass
+            # ship_fence was re-minted at the dispatch flip, so a
+            # mismatch here means a LATER re-home superseded this
+            # adoption — the adopted copy is an older history
+            stale = not released and rec.fence != rec.ship_fence
+            cur_rid = rec.rid
+        if released or stale:
+            # the target just adopted a session nobody owns (released)
+            # or that a newer generation owns elsewhere (stale) —
+            # release it there so no ghost holds pages/spool and no
+            # fork survives. Exception: when the superseding placement
+            # itself landed on this target, the engine's duplicate-sid
+            # guard collapsed the two adoptions into the one session
+            # that placement owns — releasing would destroy it.
+            if stale:
+                fleet.note_fence_refusal(
+                    rec.sid, rec.ship_fence,
+                    "ship finalize superseded",
+                )
+            if released or cur_rid != target.rid:
+                try:
+                    target.engine.release_session(rec.sid)
+                except Exception:
+                    pass
             self._abort(rec)
             return
         warm = False
@@ -591,7 +706,8 @@ class DisaggCoordinator:
         )
         try:
             self._wire_server = KVWireServer(
-                spool_dir, self._on_wire_entry
+                spool_dir, self._on_wire_entry,
+                on_control=self._on_wire_control,
             )
         except OSError:
             log.exception(
@@ -611,6 +727,16 @@ class DisaggCoordinator:
         seam. The wire re-checksummed the payload in transit; the
         fingerprint check (against the receiving engine's config) and
         the spool sha verify-at-first-read run in adopt."""
+        # fencing (docs/podnet.md): an export minted under an older
+        # ownership generation — a sender healing from a partition
+        # whose sessions were re-homed off it — is refused before any
+        # engine sees it; split-brain cannot fork the history
+        if self.fleet.refuse_stale_fence(
+            str(entry.get("id") or ""), entry.get("fence"),
+            origin="wire entry",
+        ):
+            return {"ok": False,
+                    "error": "stale fence: ownership superseded"}
         # adopt ONLY into the replica the sender named: re-targeting
         # here would let a lost reply leave the session adopted on a
         # replica the sender doesn't know about (a two-engine ghost).
@@ -621,6 +747,7 @@ class DisaggCoordinator:
             return {"ok": False,
                     "error": f"target {target_rid!r} not serving"}
         from ..parallel.multihost import wire_timeout_s
+        from . import podnet as podnet_mod
 
         ev = handle.engine.adopt_parked_session(
             entry, fingerprint=fingerprint, require_sha=True,
@@ -628,13 +755,23 @@ class DisaggCoordinator:
         # the reply must beat the SENDER's socket timeout or the wait
         # is wasted (the sender would count a wire error and enqueue a
         # redundant history-only adoption a slow-but-alive target then
-        # dedupes) — leave it margin to read the reply
-        ev.wait(timeout=max(0.5, wire_timeout_s() * 0.8))
+        # dedupes) — and the sender splits its shipment timeout across
+        # its retry attempts (_ship_over_wire), so the margin is
+        # against the PER-ATTEMPT timeout, not the whole budget
+        sender_attempt_s = max(
+            1.0, wire_timeout_s() / podnet_mod.wire_retries()
+        )
+        ev.wait(timeout=max(0.5, sender_attempt_s * 0.8))
         store = getattr(handle.engine, "offload_store", None)
         warm = entry.get("kv") is not None and store is not None \
             and store.has(str(entry.get("id")))
         return {"adopted": ev.is_set(), "warm": warm,
                 "rid": handle.rid}
+
+    def _on_wire_control(self, control: dict) -> dict:
+        """Control frames (pod heartbeats over the RTKW wire,
+        docs/podnet.md) dispatch to the fleet's pod coordinator."""
+        return self.fleet.pod.handle_control(control)
 
     def close(self) -> None:
         if self._wire_server is not None:

@@ -48,6 +48,23 @@ named *fault point* that tests (and staging deployments) can arm:
                        (docs/disagg.md): a failed pull degrades to the
                        ordinary prefill miss, a failed publish skips —
                        correctness never depends on the store
+    wire_partition     one KV-wire connection attempt fails in
+                       transit (docs/podnet.md): bounded retry with
+                       jittered backoff, a per-peer circuit breaker
+                       past consecutive failures, and exhaustion
+                       still degrades to the mirror re-prefill —
+                       zero durably-streamed-token loss
+    heartbeat_loss     a pod membership heartbeat is dropped
+                       (docs/podnet.md): the member walks alive ->
+                       suspect -> dead; past its session lease the
+                       re-home machinery moves its sessions; a late
+                       heartbeat before the lease expires heals it
+    mirror_journal_io  a router-mirror journal read/write fails
+                       (docs/podnet.md): the append is dropped (a
+                       router crash then loses that much resume
+                       warmth, never live correctness) and a corrupt
+                       journal line is skipped at replay, never a
+                       crash
 
 Swarm-layer points (docs/swarm_recovery.md) thread the same registry
 up through the agent runtime above the engine:
@@ -98,6 +115,8 @@ FAULT_POINTS = (
     # disaggregated prefill/decode + shared prefix store
     # (docs/disagg.md)
     "kv_wire", "prefix_io",
+    # pod fault tolerance (docs/podnet.md)
+    "wire_partition", "heartbeat_loss", "mirror_journal_io",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
 )

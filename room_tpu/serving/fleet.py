@@ -67,6 +67,7 @@ from typing import Any, Callable, Optional
 from . import disagg as disagg_mod
 from . import faults
 from . import lifecycle as lifecycle_mod
+from . import podnet as podnet_mod
 from . import trace as trace_mod
 from ..utils import knobs
 from .engine import Turn
@@ -135,6 +136,17 @@ class _SessionRecord:
     # the session's most recent turn (the ship fires at its
     # completion); cleared when the ship lands
     last_turn: Optional[Any] = None
+    # pod fencing (docs/podnet.md): monotonic session-ownership
+    # generation — the per-slot admission-generation pattern lifted to
+    # the router. Every ownership transfer (re-home, ship, absorb)
+    # advances it under the fleet lock; exports and wire frames carry
+    # the fence they were minted under, and anything presenting an
+    # older fence (a host healing from a partition) is REFUSED — a
+    # session's history structurally cannot fork
+    fence: int = 0
+    # fence the in-flight disagg ship was minted under; a mismatch at
+    # collect/dispatch means a re-home superseded the export
+    ship_fence: int = 0
 
 
 class ReplicaHandle:
@@ -300,6 +312,7 @@ class EngineFleet:
             "replica_rebuilds": 0, "bluegreen_drains": 0,
             "router_retries": 0, "router_shed": 0,
             "mirror_evictions": 0, "mirror_tokens_evicted": 0,
+            "fence_refusals": 0, "mirror_restored": 0,
         }
         # bounded router history mirror (docs/fleet.md): the per-token
         # mirror grows for the life of a room, and disaggregation's
@@ -336,6 +349,21 @@ class EngineFleet:
         # docs/disagg.md): role-aware placement + the prefill->decode
         # KV shipment state machine; inert when every role is mixed
         self.disagg = disagg_mod.DisaggCoordinator(self, role_list)
+        # pod fault tolerance (docs/podnet.md): membership heartbeats
+        # + lease-gated re-home (inert without ROOM_TPU_POD_MEMBERSHIP)
+        # and the crash-durable router mirror (ROOM_TPU_POD_MIRROR) —
+        # replayed NOW so a router restart re-parks every in-flight
+        # session the journal still covers instead of orphaning it
+        self.pod = podnet_mod.PodCoordinator(self)
+        self.mirror_journal: Optional[podnet_mod.MirrorJournal] = None
+        if knobs.get_bool("ROOM_TPU_POD_MIRROR"):
+            self.mirror_journal = podnet_mod.MirrorJournal(
+                os.path.join(
+                    lifecycle_mod.engine_dir(model_name),
+                    "router-mirror",
+                )
+            )
+            self._replay_mirror_journal()
         self.lifecycle_phase = "serving"
 
     # ---- small helpers ----
@@ -459,6 +487,7 @@ class EngineFleet:
                     rec.pending_entry = None
                     rec.pending_fingerprint = None
                     rec.rid = handle.rid
+                self._journal_place(rec)
                 if entry is not None:
                     # enqueued BEFORE the caller submits the turn, so
                     # the engine applies it ahead of admission
@@ -645,13 +674,16 @@ class EngineFleet:
     ) -> _SessionRecord:
         with self._lock:
             rec = self._records.get(sid)
+            placed = rec is None or rec.rid != handle.rid
             if rec is None:
                 rec = _SessionRecord(sid=sid, rid=handle.rid)
                 self._records[sid] = rec
             else:
                 rec.rid = handle.rid
             rec.last_used = time.monotonic()
-            return rec
+        if placed:
+            self._journal_place(rec)
+        return rec
 
     def _mirror_on_token(
         self, rec: _SessionRecord, prompt: list, cb,
@@ -662,8 +694,11 @@ class EngineFleet:
         nothing durable, so its retry against a re-homed session must
         behave as if the turn never ran."""
         state = {"booked": False}
+        journal = self.mirror_journal
 
         def wrapped(tok: int) -> None:
+            appended: Optional[list] = None
+            offset = 0
             with rec.lock:
                 added = 0
                 if not rec.mirror_dropped:
@@ -677,9 +712,18 @@ class EngineFleet:
                         added += len(prompt)
                     rec.tokens.append(int(tok))
                     added += 1
+                    if journal is not None:
+                        offset = len(rec.tokens) - added
+                        appended = rec.tokens[-added:]
                 rec.last_used = time.monotonic()
             if added:
                 self._mirror_account(added)
+            if appended is not None:
+                # crash-durable mirror (docs/podnet.md): the journal
+                # append happens BEFORE the caller's callback — at
+                # batch=1 a token is journaled before anything
+                # downstream treats it as durably streamed
+                journal.append_tokens(rec.sid, appended, offset)
             if cb is not None:
                 cb(tok)
 
@@ -736,6 +780,14 @@ class EngineFleet:
                 evicted += 1
                 with self._mirror_lock:
                     self._mirror_tokens -= dropped
+                if self.mirror_journal is not None:
+                    # the journal must stop claiming this mirror: a
+                    # router crash replaying the evicted PREFIX as a
+                    # complete history would fork the session the
+                    # warm-salvage-only rule protects. A TOMBSTONE,
+                    # not a rel — an in-flight token append racing
+                    # this eviction must not resurrect the prefix
+                    self.mirror_journal.record_drop(rec.sid)
                 self._bump("mirror_evictions")
                 self._bump("mirror_tokens_evicted", dropped)
         return evicted
@@ -765,6 +817,121 @@ class EngineFleet:
         with self._mirror_lock:
             self._mirror_tokens += len(toks) - old
 
+    # ---- pod fencing + crash-durable mirror (docs/podnet.md) ----
+
+    def fence_stale(self, sid: str, fence) -> bool:
+        """Is ``fence`` older than the session's current ownership
+        generation? A frame/export carrying no fence predates fencing
+        and passes (the in-transit checksum and fingerprint gates
+        still apply); an unknown session has no generation to be
+        stale against."""
+        if fence is None:
+            return False
+        try:
+            fence = int(fence)
+        except (TypeError, ValueError):
+            return True
+        with self._lock:
+            rec = self._records.get(sid)
+            return rec is not None and fence < rec.fence
+
+    def note_fence_refusal(self, sid: str, fence, origin: str) -> None:
+        """The bookkeeping every stale-fence refusal owes, wherever
+        the staleness was detected: counted in ``fence_refusals`` and
+        booked in the flight recorder."""
+        self._bump("fence_refusals")
+        trace_mod.note_event("fence_refused", {
+            "session": sid, "fence": fence, "origin": origin,
+        })
+        log.warning(
+            "fleet %s: refused stale-fence %s from %s for session %s",
+            self.model_name, fence, origin, sid,
+        )
+
+    def refuse_stale_fence(self, sid: str, fence, origin: str) -> bool:
+        """fence_stale + the refusal bookkeeping."""
+        if not self.fence_stale(sid, fence):
+            return False
+        self.note_fence_refusal(sid, fence, origin)
+        return True
+
+    def _journal_place(self, rec: _SessionRecord) -> None:
+        if self.mirror_journal is not None:
+            self.mirror_journal.record_place(
+                rec.sid, rec.rid, rec.fence, rec.generation
+            )
+
+    def _mirror_snapshot_sessions(self) -> list[dict]:
+        """Authoritative record view for a journal compaction (tokens
+        copied under each record's own lock, never nested inside the
+        fleet lock)."""
+        with self._lock:
+            recs = list(self._records.values())
+        out = []
+        for rec in recs:
+            with rec.lock:
+                toks = list(rec.tokens) if not rec.mirror_dropped \
+                    else []
+            with self._lock:
+                if self._records.get(rec.sid) is not rec:
+                    continue
+                out.append({
+                    "sid": rec.sid, "rid": rec.rid,
+                    "fence": rec.fence, "gen": rec.generation,
+                    "tokens": toks,
+                })
+        return out
+
+    def _replay_mirror_journal(self) -> None:
+        """Router-restart recovery: rebuild placements + mirrors from
+        the journal. Every complete session re-parks exactly like a
+        deferred re-home (rid="" + pending entry), so its next route
+        adopts it into whichever replica serves — the placement the
+        journal names may not exist in this incarnation. Incomplete
+        mirrors (a hole from a dropped journal line) are NOT resumed:
+        re-prefilling a holey history would fork the session."""
+        journal = self.mirror_journal
+        if journal is None:
+            return
+        restored = 0
+        for sid, state in journal.replay().items():
+            toks = state.get("tokens") or []
+            if not state.get("complete") or not toks:
+                continue
+            with self._lock:
+                known = sid in self._records
+            if known:
+                continue
+            rec = _SessionRecord(sid=sid, rid="")
+            rec.generation = int(state.get("generation") or 0)
+            self._set_record_tokens(rec, [int(t) for t in toks])
+            # ONE mirror->entry shape for failover and replay; the
+            # NEXT ownership transfer (the adopting route) must
+            # supersede anything the pre-crash incarnation exported
+            fence = int(state.get("fence") or 0) + 1
+            entry = self._entry_from_mirror(rec)
+            if entry is None:
+                self._mirror_release(rec)
+                continue
+            entry["fence"] = fence
+            with self._lock:
+                rec.fence = fence
+                rec.pending_entry = entry
+                rec.pending_fingerprint = None
+                self._records[sid] = rec
+            self._journal_place(rec)
+            restored += 1
+        if restored:
+            self._bump("mirror_restored", restored)
+            trace_mod.note_event("mirror_restore", {
+                "sessions": restored,
+            })
+            log.info(
+                "fleet %s: mirror journal re-parked %d in-flight "
+                "session(s) after router restart",
+                self.model_name, restored,
+            )
+
     def release_session(self, session_id: str) -> None:
         with self._lock:
             rec = self._records.pop(session_id, None)
@@ -780,6 +947,8 @@ class EngineFleet:
             targets = [handle] if handle is not None else []
         else:
             targets = list(self.replicas)
+        if rec is not None and self.mirror_journal is not None:
+            self.mirror_journal.record_release(session_id)
         for h in targets:
             if h.state != "dead":
                 h.engine.release_session(session_id)
@@ -825,6 +994,20 @@ class EngineFleet:
         # disaggregated prefill->decode ships fire at turn boundaries
         # noticed here (docs/disagg.md); inert without roles
         self.disagg.advance()
+        # pod membership: heartbeats + lease-expiry re-homes
+        # (docs/podnet.md); inert without ROOM_TPU_POD_MEMBERSHIP
+        self.pod.tick()
+        if self.mirror_journal is not None:
+            # push any batched token appends to disk each tick, and
+            # compact the journal once it outgrows its threshold —
+            # the CALLABLE form: the journal parks concurrent appends
+            # before the snapshot is built, so none can be lost to
+            # the file swap
+            self.mirror_journal.flush_all()
+            if self.mirror_journal.should_compact():
+                self.mirror_journal.compact(
+                    self._mirror_snapshot_sessions
+                )
         for h in list(self.replicas):
             if h.state != "serving":
                 continue
@@ -1036,11 +1219,21 @@ class EngineFleet:
             # by then, so the history is never silently dropped
             with self._lock:
                 rec.rid = ""
+                rec.fence += 1
+                entry["fence"] = rec.fence
                 rec.pending_entry = entry
+            self._journal_place(rec)
             trace_mod.note_event("rehome_deferred", {
                 "session": rec.sid, "from": exclude or "",
             })
             return
+        # fencing (docs/podnet.md): ownership leaves the dead replica
+        # NOW — anything it exported under the old generation (a host
+        # healing from a partition replaying its ship) is stale from
+        # this point and will be refused
+        with self._lock:
+            rec.fence += 1
+            entry["fence"] = rec.fence
         ev = target.engine.adopt_parked_session(
             entry, fingerprint=None, require_sha=False,
         )
@@ -1048,6 +1241,7 @@ class EngineFleet:
         with self._lock:
             rec.rid = target.rid
             rec.rehomed += 1
+        self._journal_place(rec)
         self._bump("sessions_rehomed")
         # turnscope: failover re-homes land in the flight recorder's
         # global event ring — the trace answer to "why did this
@@ -1281,14 +1475,18 @@ class EngineFleet:
                     # setting them inside the publish section keeps
                     # the write discipline uniform even though this
                     # record is not yet reachable
+                    old = self._records.get(sid)
+                    rec.fence = (old.fence if old is not None
+                                 else 0) + 1
+                    entry["fence"] = rec.fence
                     rec.pending_entry = entry
                     rec.pending_fingerprint = fingerprint
-                    old = self._records.get(sid)
                     if old is not None:
                         rec.rehomed = old.rehomed
                     self._records[sid] = rec
                 if old is not None:
                     self._mirror_release(old)
+                self._journal_place(rec)
                 out["deferred"] += 1
                 continue
             ev = target.engine.adopt_parked_session(
@@ -1308,11 +1506,13 @@ class EngineFleet:
             rec.generation = int(entry.get("generation") or 0)
             with self._lock:
                 old = self._records.get(sid)
+                rec.fence = (old.fence if old is not None else 0) + 1
                 if old is not None:
                     rec.rehomed = old.rehomed + 1
                 self._records[sid] = rec
             if old is not None:
                 self._mirror_release(old)
+            self._journal_place(rec)
             pending.append((rec, entry, target, ev))
         wait_until = time.monotonic() + 30.0
         for rec, entry, target, ev in pending:
@@ -1439,6 +1639,16 @@ class EngineFleet:
             wrote_all = wrote_all and s.get("manifest_written", False)
             for k in totals:
                 totals[k] += int(s.get(k) or 0)
+        if self.mirror_journal is not None:
+            if wrote_all:
+                # the manifests are now the authoritative restart
+                # state; stale journal entries must not resurrect
+                # sessions the drain already handed off
+                self.mirror_journal.clear()
+            else:
+                # a failed manifest write keeps the journal as the
+                # fallback recovery source for the next boot
+                self.mirror_journal.close()
         return {
             "drain_ms": round((time.monotonic() - t0) * 1000.0, 3),
             "manifest_written": wrote_all,
@@ -1500,7 +1710,13 @@ class EngineFleet:
             "evictions": out.pop("mirror_evictions"),
             "tokens_evicted": out.pop("mirror_tokens_evicted"),
         }
+        if self.mirror_journal is not None:
+            out["mirror"]["journal"] = self.mirror_journal.stats()
         out["disagg"] = self.disagg.stats()
+        # pod membership + per-peer wire breakers (docs/podnet.md);
+        # pod.stats() takes the fleet lock itself — outside the
+        # snapshot section above
+        out["pod"] = self.pod.stats()
         return out
 
     def stats(self) -> dict:

@@ -89,6 +89,9 @@ FAULT_EVENTS = {
     "router_io": "fault.router_io",
     "kv_wire": "fault.kv_wire",
     "prefix_io": "fault.prefix_io",
+    "wire_partition": "fault.wire_partition",
+    "heartbeat_loss": "fault.heartbeat_loss",
+    "mirror_journal_io": "fault.mirror_journal_io",
     "db_io": "fault.db_io",
     "cycle_crash": "fault.cycle_crash",
     "loop_hang": "fault.loop_hang",

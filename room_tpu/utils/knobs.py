@@ -412,6 +412,55 @@ register("ROOM_TPU_KV_WIRE_PORT", "int", "0",
 register("ROOM_TPU_KV_WIRE_TIMEOUT_S", "float", "10",
          "Per-shipment socket timeout for the KV wire, seconds.")
 
+# ---- pod fault tolerance (docs/podnet.md) ----
+register("ROOM_TPU_WIRE_RETRIES", "int", "3",
+         "Total connection attempts for one KV-wire send or control "
+         "frame (1 = no retry); exhaustion degrades to the router-"
+         "mirror re-prefill contract, never a misroute.")
+register("ROOM_TPU_WIRE_BACKOFF_S", "float", "0.05",
+         "Base wire retry backoff, seconds; attempt n sleeps "
+         "base*2^n with +/-50% jitter so a healing pod is not "
+         "thundering-herded.")
+register("ROOM_TPU_WIRE_BACKOFF_MAX_S", "float", "2.0",
+         "Upper bound on one jittered wire retry backoff sleep.")
+register("ROOM_TPU_WIRE_BREAKER_FAILS", "int", "5",
+         "Consecutive wire failures to one peer that open its "
+         "circuit breaker (0 disables the breaker).")
+register("ROOM_TPU_WIRE_BREAKER_COOLDOWN_S", "float", "5.0",
+         "Open-breaker cooldown before a half-open probe is allowed "
+         "through to the peer.")
+register("ROOM_TPU_POD_MEMBERSHIP", "bool", "0",
+         "Enable the pod membership service: replicas/hosts heartbeat "
+         "and a deadline-with-suspicion detector re-homes a dead "
+         "member's sessions after its lease expires "
+         "(docs/podnet.md).")
+register("ROOM_TPU_POD_HEARTBEAT_S", "float", "1.0",
+         "Pod heartbeat send interval, seconds.")
+register("ROOM_TPU_POD_SUSPECT_S", "float", "3.0",
+         "Silence after which a pod member is SUSPECT (routing "
+         "unchanged; re-home not yet armed).")
+register("ROOM_TPU_POD_DEAD_S", "float", "6.0",
+         "Silence after which a suspect pod member is DEAD; its "
+         "session lease starts expiring.")
+register("ROOM_TPU_POD_LEASE_S", "float", "2.0",
+         "Session-ownership lease beyond the DEAD declaration; only "
+         "past it are the member's sessions re-homed (a lagging but "
+         "alive host gets this long to reappear before fencing).")
+register("ROOM_TPU_POD_MIRROR", "bool", "0",
+         "Crash-durable router mirror: journal session placements + "
+         "streamed tokens to a checksummed sidecar so a router "
+         "restart rebuilds its mirror instead of orphaning in-flight "
+         "rooms (docs/podnet.md).")
+register("ROOM_TPU_POD_MIRROR_BATCH", "int", "1",
+         "Token-append batching for the mirror journal: tokens "
+         "buffered in memory before one journal line is written. 1 "
+         "journals every durably-streamed token (token-identical "
+         "resume after a router crash); larger values trade a "
+         "bounded resume-warmth window for fewer writes.")
+register("ROOM_TPU_POD_MIRROR_COMPACT", "int", "4096",
+         "Journal lines past which the supervise tick compacts the "
+         "mirror journal into a fresh checksummed snapshot.")
+
 # ---- fleet-global shared prefix store (docs/disagg.md) ----
 register("ROOM_TPU_PREFIX_STORE", "bool", "0",
          "Content-addressed shared prefix KV store: replicas/hosts "

@@ -214,6 +214,38 @@ register_lock(
     attr="lock", hints=("rec", "r"), multi_instance=True,
 )
 
+# ---- serving: pod fault tolerance (docs/podnet.md) ----
+register_lock(
+    "podnet_membership", "PodMembership member table + failure-"
+    "detector state transitions.",
+    module="room_tpu/serving/podnet.py", cls="PodMembership",
+    attr="_lock", hints=("membership", "self.membership"),
+    multi_instance=True,
+)
+register_lock(
+    "podnet_breaker", "One per-peer wire circuit breaker's "
+    "state/counters (one per peer address).",
+    module="room_tpu/serving/podnet.py", cls="CircuitBreaker",
+    attr="_lock", hints=("breaker",), multi_instance=True,
+)
+register_lock(
+    "podnet_breakers", "Process-wide peer-address -> CircuitBreaker "
+    "registry build.",
+    module="room_tpu/serving/podnet.py", attr="_breakers_lock",
+)
+register_lock(
+    "pod_mirror_journal", "MirrorJournal append buffers, line "
+    "counters, and file-handle swap (one per fleet).",
+    module="room_tpu/serving/podnet.py", cls="MirrorJournal",
+    attr="_lock", hints=("journal",), multi_instance=True,
+)
+register_lock(
+    "kv_wire_server", "KVWireServer payload sequence counter + "
+    "receive stats (per-connection handler threads share them).",
+    module="room_tpu/parallel/multihost.py", cls="KVWireServer",
+    attr="_lock", multi_instance=True,
+)
+
 # ---- serving: faults + trace (docs/chaos.md, docs/observability.md) ----
 register_lock(
     "faults", "Armed fault-point table + firing counters.",

@@ -1,0 +1,822 @@
+"""Pod fault-tolerance suite (docs/podnet.md).
+
+Chaos matrix for the membership / fencing / wire-hardening / durable-
+mirror layer on the CPU backend:
+
+- Circuit breaker unit contract: closed -> open after N consecutive
+  failures -> half-open single probe after the cooldown -> closed on
+  success / re-open on probe failure.
+- Membership ladder: alive -> suspect -> dead on silence, heal at any
+  rung before the lease fires, `heartbeat_loss` drops beats without
+  touching liveness of the detector itself.
+- Partition mid-decode: a partitioned replica's sessions are re-homed
+  only after its session lease expires, with zero durably-streamed
+  token loss and greedy continuations token-identical to the
+  unpartitioned control.
+- Partition during an in-flight disagg ship: the ship is aborted, the
+  session re-homes from the mirror, the continuation is identical.
+- Stale-fence refusal: after a partition heals, the old owner's
+  replayed export (over the real RTKW wire) is refused — no session
+  fork, no double adoption.
+- Wire retry/backoff: `wire_partition` on one attempt is absorbed by
+  the retry budget; exhaustion degrades to the documented mirror
+  re-prefill (token-identical), and the per-peer breaker opens.
+- Router restart: a crash (no drain) mid-stream is recovered from the
+  journaled mirror — the rebuilt router re-parks the session and the
+  resumed stream is token-identical; `mirror_journal_io` drops are
+  detected as holes (cold start, never a forked re-prefill).
+- Satellites: the wire-in orphan sweep (dead-PID payloads from a
+  receiver that crashed between persist and adopt), the acceptor
+  surviving a wedged peer, and the reported (never silent) failed
+  accept-thread join.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving import podnet
+from room_tpu.serving.fleet import EngineFleet
+from room_tpu.parallel import multihost
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    podnet.reset_breakers()
+    yield
+    faults.clear()
+    podnet.reset_breakers()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+LONG_PROMPT = list(range(1, 20))
+CONT = [7, 7, 7]
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+@pytest.fixture(scope="module")
+def control(model):
+    """Uninterrupted two-turn reference streams on one engine."""
+    cfg, params = model
+    eng = ServingEngine(
+        cfg, params, max_batch=4, page_size=8, n_pages=96,
+        offload=False, stop_token_ids=[],
+    )
+    c1 = eng.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    c2 = eng.submit(CONT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    return list(c1.new_tokens), list(c2.new_tokens)
+
+
+@pytest.fixture()
+def make_fleet(model, monkeypatch, tmp_path):
+    """Fleet factory with the pod knobs tuned for test-speed walks of
+    the membership ladder and no real backoff sleeps."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path / "spool"))
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "lc"))
+    monkeypatch.setenv("ROOM_TPU_DISAGG_PREFILL_TOKENS", "8")
+    monkeypatch.setenv("ROOM_TPU_WIRE_BACKOFF_S", "0.001")
+    monkeypatch.setenv("ROOM_TPU_POD_HEARTBEAT_S", "0.01")
+    monkeypatch.setenv("ROOM_TPU_POD_SUSPECT_S", "0.05")
+    monkeypatch.setenv("ROOM_TPU_POD_DEAD_S", "0.1")
+    monkeypatch.setenv("ROOM_TPU_POD_LEASE_S", "0.05")
+    cfg, params = model
+
+    def build_engine(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("offload", True)
+        kw.setdefault("stop_token_ids", [])
+        return ServingEngine(cfg, params, **kw)
+
+    def build(n=2, roles=None, env=None, **kw):
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        return EngineFleet(
+            "tiny-moe", lambda i: build_engine(**kw), n,
+            auto_rebuild=False,
+            roles=list(roles) if roles is not None else None,
+        )
+
+    build.engine = build_engine
+    return build
+
+
+def _stream_partial(fleet, sid, budget, min_tokens):
+    """Submit a greedy turn and step its replica until at least
+    ``min_tokens`` streamed; returns (streamed_list, handle)."""
+    streamed: list = []
+    fleet.submit(LONG_PROMPT, session_id=sid, sampling=_greedy(budget),
+                 on_token=streamed.append)
+    handle = fleet._handle(fleet._records[sid].rid)
+    for _ in range(3000):
+        handle.engine.step()
+        if len(streamed) >= min_tokens:
+            break
+    assert len(streamed) >= min_tokens
+    return streamed, handle
+
+
+def _supervise_until(fleet, cond, timeout_s=5.0, sleep_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        fleet.supervise()
+        if cond():
+            return True
+        time.sleep(sleep_s)
+    return False
+
+
+# ---- circuit breaker ----
+
+def test_breaker_opens_half_opens_closes():
+    t = [0.0]
+    b = podnet.CircuitBreaker(
+        "peer", threshold=3, cooldown_s=1.0, clock=lambda: t[0]
+    )
+    for _ in range(2):
+        assert b.allow()
+        b.record_failure()
+    assert b.state == "closed"
+    assert b.allow()
+    b.record_failure()            # third consecutive failure
+    assert b.state == "open"
+    assert not b.allow()          # fast refusal while open
+    t[0] = 1.5
+    assert b.allow()              # cooldown elapsed: half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()          # only ONE probe outstanding
+    b.record_failure()            # probe failed -> re-open
+    assert b.state == "open"
+    t[0] = 3.0
+    assert b.allow()
+    b.record_success()            # probe succeeded -> closed
+    assert b.state == "closed"
+    assert b.allow()
+    snap = b.snapshot()
+    assert snap["opens"] == 2 and snap["rejections"] >= 2
+
+
+def test_breaker_threshold_zero_disables():
+    b = podnet.CircuitBreaker("p", threshold=0, cooldown_s=0.0)
+    for _ in range(10):
+        b.record_failure()
+        assert b.allow()
+    assert b.state == "closed"
+
+
+def test_backoff_is_bounded_and_jittered(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_WIRE_BACKOFF_S", "0.05")
+    monkeypatch.setenv("ROOM_TPU_WIRE_BACKOFF_MAX_S", "0.4")
+    import random
+
+    seen = {
+        podnet.wire_backoff_s(a, random.Random(seed))
+        for a in range(6) for seed in (1, 2, 3)
+    }
+    assert all(0.0 < v <= 0.4 for v in seen)
+    assert len(seen) > 6   # jitter actually varies
+    # deep attempts saturate at the cap
+    assert podnet.wire_backoff_s(20, random.Random(0)) == 0.4
+
+
+# ---- membership ladder ----
+
+def test_membership_ladder_and_heal():
+    t = [0.0]
+    m = podnet.PodMembership(
+        suspect_s=1.0, dead_s=2.0, lease_s=1.0, clock=lambda: t[0]
+    )
+    m.register("a")
+    m.observe("a")
+    t[0] = 1.2
+    assert ("a", "alive", "suspect") in m.tick()
+    # heal from suspect: nothing lost
+    m.observe("a")
+    assert m.state_of("a") == "alive"
+    t[0] = 3.5
+    events = m.tick()
+    assert ("a", "alive", "suspect") in events
+    assert ("a", "suspect", "dead") in events
+    # the lease holds the re-home back...
+    assert m.lease_expired() == []
+    # ...and a late heartbeat inside the lease heals without a re-home
+    m.observe("a")
+    assert m.state_of("a") == "alive"
+    t[0] = 6.0
+    m.tick()
+    t[0] = 7.1
+    assert m.lease_expired() == ["a"]
+    assert m.lease_expired() == []   # consumed exactly once
+    snap = m.snapshot()
+    assert snap["a"]["lease_fired"] is True
+    m.observe("a")                   # the healed host re-registers
+    assert m.state_of("a") == "alive"
+    assert m.snapshot()["a"]["lease_fired"] is False
+
+
+def test_heartbeat_loss_fault_drops_beats():
+    t = [0.0]
+    m = podnet.PodMembership(
+        suspect_s=1.0, dead_s=2.0, lease_s=0.5, clock=lambda: t[0]
+    )
+    m.register("a")
+    faults.inject("heartbeat_loss", times=3)
+    t[0] = 1.5
+    for _ in range(3):
+        assert m.observe("a") is False   # dropped
+    assert m.tick() and m.state_of("a") == "suspect"
+    assert faults.fired("heartbeat_loss") == 3
+    assert m.observe("a") is True        # budget exhausted: delivered
+    assert m.state_of("a") == "alive"
+    assert m.snapshot()["a"]["heartbeats_lost"] == 3
+
+
+# ---- partition chaos: lease-gated re-home, token identity ----
+
+def test_partition_mid_decode_rehomes_after_lease(
+    make_fleet, control,
+):
+    full, cont = control
+    fleet = make_fleet(
+        n=2, env={"ROOM_TPU_POD_MEMBERSHIP": "1"},
+    )
+    streamed, victim = _stream_partial(fleet, "s", len(full), 3)
+    n = len(streamed)
+    # a fresh heartbeat right before the partition: the detector walks
+    # the ladder from NOW, not from the pre-jit-compile registration
+    fleet.pod._last_beat = 0.0
+    fleet.supervise()
+    fleet.pod.partition(victim.rid)
+    # suspicion first: no re-home before the DEAD + lease deadline
+    assert _supervise_until(
+        fleet,
+        lambda: fleet.pod.membership.state_of(victim.rid) == "suspect",
+    )
+    assert fleet._handle(victim.rid).state == "serving"
+    assert fleet.fleet_stats()["pod"]["lease_rehomes"] == 0
+    # then death + lease expiry drives the replica_crash re-home
+    assert _supervise_until(
+        fleet, lambda: fleet._handle(victim.rid).state == "dead",
+    )
+    st = fleet.fleet_stats()
+    assert st["pod"]["lease_rehomes"] == 1
+    assert st["sessions_rehomed"] >= 1
+    t2 = fleet.submit(
+        [], session_id="s",
+        sampling=_greedy(len(full) - n),
+    )
+    fleet.run_until_idle()
+    assert streamed + list(t2.new_tokens) == full
+    # the record's ownership generation advanced at the transfer
+    assert fleet._records["s"].fence >= 1
+
+
+def test_partition_during_inflight_ship_aborts_and_rehomes(
+    make_fleet, control,
+):
+    full, cont = control
+    fleet = make_fleet(
+        n=2, roles=("prefill", "decode"),
+        env={"ROOM_TPU_POD_MEMBERSHIP": "1"},
+    )
+    fleet.pod.tick()
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    donor = fleet._handle(fleet._records["s"].rid)
+    assert donor.role == "prefill"
+    # freeze the donor's engine behind a fake loop thread so the ship
+    # export QUEUES instead of applying inline -> ship stays in flight
+    for _ in range(3000):
+        donor.engine.step()
+        if t1.done.is_set():
+            break
+    assert t1.done.is_set()
+    assert list(t1.new_tokens) == full
+
+    class FakeAliveThread:
+        @staticmethod
+        def is_alive():
+            return True
+
+    donor.engine._loop_thread = FakeAliveThread()
+    fleet.disagg.advance()
+    rec = fleet._records["s"]
+    assert rec.ship_state == "exporting"
+    donor.engine._loop_thread = None
+    fleet.pod.partition(donor.rid)
+    assert _supervise_until(
+        fleet, lambda: fleet._handle(donor.rid).state == "dead",
+    )
+    rec = fleet._records["s"]
+    assert rec.ship_state is None          # aborted, not leaked
+    assert rec.rid and rec.rid != donor.rid
+    t2 = fleet.submit(CONT, session_id="s",
+                      sampling=_greedy(len(cont)))
+    fleet.run_until_idle()
+    assert list(t2.new_tokens) == cont
+
+
+# ---- fencing: the healed host cannot fork a session ----
+
+def test_stale_fence_export_refused_over_wire(make_fleet, control):
+    full, cont = control
+    fleet = make_fleet(
+        n=3, roles=("prefill", "decode", "decode"),
+        env={"ROOM_TPU_DISAGG_WIRE": "loopback"},
+    )
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1.new_tokens) == full
+    rec = fleet._records["s"]
+    # the ship moved the session to a decode replica and advanced the
+    # fence; capture the PRE-transfer generation a partitioned host
+    # would still hold
+    assert rec.fence >= 1
+    stale_fence = rec.fence - 1
+    owner_rid = rec.rid
+    owner = fleet._handle(owner_rid)
+    # a healed host replays its stale export over the real wire
+    stale_entry = {
+        "id": "s",
+        "history": [int(t) for t in (LONG_PROMPT + full)[:-1]],
+        "pending": int(full[-1]),
+        "length": len(LONG_PROMPT) + len(full) - 1,
+        "generation": 0,
+        "fence": stale_fence,
+        "kv": None,
+    }
+    other = next(
+        h for h in fleet.replicas
+        if h.role == "decode" and h.rid != owner_rid
+    )
+    with pytest.raises(multihost.KVWireRefused, match="stale fence"):
+        multihost.kv_wire_send(
+            fleet.disagg._wire_server.address, stale_entry,
+            target_rid=other.rid,
+        )
+    assert fleet.fleet_stats()["fence_refusals"] >= 1
+    # no fork: the session exists on exactly its owner, and its
+    # continuation is token-identical
+    assert "s" not in other.engine.sessions
+    assert "s" in owner.engine.sessions
+    t2 = fleet.submit(CONT, session_id="s",
+                      sampling=_greedy(len(cont)))
+    fleet.run_until_idle()
+    assert list(t2.new_tokens) == cont
+    # a CURRENT-fence frame is not refused by the fence gate
+    fresh = dict(stale_entry)
+    fresh["fence"] = fleet._records["s"].fence
+    fresh["id"] = "s"
+    reply = multihost.kv_wire_send(
+        fleet.disagg._wire_server.address, fresh,
+        target_rid=fleet._records["s"].rid,
+    )
+    assert reply.get("ok")
+    fleet.disagg.close()
+
+
+def test_inflight_ship_superseded_by_rehome_is_discarded(
+    make_fleet, control,
+):
+    """A re-home that lands while an export is in flight advances the
+    fence; the ship's dispatch then refuses its own stale entry."""
+    full, _ = control
+    fleet = make_fleet(n=2, roles=("prefill", "decode"))
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(4))
+    donor = fleet._handle(fleet._records["s"].rid)
+    for _ in range(3000):
+        donor.engine.step()
+        if t1.done.is_set():
+            break
+
+    class FakeAliveThread:
+        @staticmethod
+        def is_alive():
+            return True
+
+    donor.engine._loop_thread = FakeAliveThread()
+    fleet.disagg.advance()
+    rec = fleet._records["s"]
+    assert rec.ship_state == "exporting"
+    # a concurrent failover advances the ownership generation
+    with fleet._lock:
+        rec.fence += 1
+    donor.engine._loop_thread = None
+    donor.engine._drain_ships()
+    before = fleet.fleet_stats()["fence_refusals"]
+    fleet.disagg.advance()
+    rec = fleet._records["s"]
+    assert rec.ship_state is None
+    assert fleet.fleet_stats()["fence_refusals"] == before + 1
+
+
+# ---- wire retry / backoff / breaker ----
+
+def _echo_server(tmp_path):
+    got: list = []
+
+    def on_entry(entry, fingerprint, target_rid):
+        got.append(entry)
+        return {"ok": True, "adopted": False}
+
+    srv = multihost.KVWireServer(str(tmp_path / "wire-in"), on_entry)
+    return srv, got
+
+
+def test_wire_retry_absorbs_transient_partition(
+    tmp_path, monkeypatch,
+):
+    monkeypatch.setenv("ROOM_TPU_WIRE_BACKOFF_S", "0.001")
+    srv, got = _echo_server(tmp_path)
+    try:
+        faults.inject("wire_partition", times=1)
+        entry = {"id": "x", "history": [1, 2], "pending": 3,
+                 "length": 2, "generation": 0, "kv": None}
+        reply = multihost.kv_wire_send(srv.address, entry, retries=3)
+        assert reply.get("ok")
+        assert faults.fired("wire_partition") == 1
+        assert len(got) == 1
+        assert podnet.breaker_for(srv.address).state == "closed"
+    finally:
+        srv.close()
+
+
+def test_wire_exhaustion_opens_breaker_and_fails_fast(
+    tmp_path, monkeypatch,
+):
+    monkeypatch.setenv("ROOM_TPU_WIRE_BACKOFF_S", "0.001")
+    monkeypatch.setenv("ROOM_TPU_WIRE_BREAKER_FAILS", "3")
+    monkeypatch.setenv("ROOM_TPU_WIRE_BREAKER_COOLDOWN_S", "60")
+    srv, got = _echo_server(tmp_path)
+    try:
+        faults.inject("wire_partition")   # every attempt fails
+        entry = {"id": "x", "history": [1], "pending": 2,
+                 "length": 1, "generation": 0, "kv": None}
+        with pytest.raises(multihost.KVWireError, match="exhausted"):
+            multihost.kv_wire_send(srv.address, entry, retries=3)
+        assert podnet.breaker_for(srv.address).state == "open"
+        # the open breaker refuses BEFORE any socket work
+        with pytest.raises(multihost.KVWireError,
+                           match="circuit open"):
+            multihost.kv_wire_send(srv.address, entry, retries=3)
+        assert not got
+    finally:
+        srv.close()
+
+
+def test_ship_degrades_to_mirror_reprefill_on_wire_exhaustion(
+    make_fleet, control,
+):
+    """Acceptance (d): kv_wire_send exhausts its retry budget into the
+    documented re-prefill degradation — zero durable-token loss,
+    token-identical continuation."""
+    full, cont = control
+    fleet = make_fleet(
+        n=2, roles=("prefill", "decode"),
+        env={
+            "ROOM_TPU_DISAGG_WIRE": "loopback",
+            "ROOM_TPU_WIRE_RETRIES": "2",
+        },
+    )
+    faults.inject("wire_partition")   # every attempt, every send
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1.new_tokens) == full
+    st = fleet.fleet_stats()["disagg"]
+    assert st["wire_errors"] >= 1
+    assert st["ships_reprefill"] >= 1
+    assert faults.fired("wire_partition") >= 2   # retries consumed
+    faults.clear()
+    t2 = fleet.submit(CONT, session_id="s",
+                      sampling=_greedy(len(cont)))
+    fleet.run_until_idle()
+    assert list(t2.new_tokens) == cont
+    fleet.disagg.close()
+
+
+def test_wire_heartbeats_ride_the_rtkw_wire(make_fleet):
+    fleet = make_fleet(
+        n=2, roles=("prefill", "decode"),
+        env={
+            "ROOM_TPU_DISAGG_WIRE": "loopback",
+            "ROOM_TPU_POD_MEMBERSHIP": "1",
+        },
+    )
+    try:
+        fleet.supervise()
+        pod = fleet.fleet_stats()["pod"]
+        assert pod["heartbeats_wire"] >= 2
+        wire = fleet.fleet_stats()["disagg"]["wire_server"]
+        assert wire["control_frames"] >= 2
+        states = {m["state"] for m in pod["members"].values()}
+        assert states == {"alive"}
+    finally:
+        fleet.disagg.close()
+
+
+def test_dead_listener_does_not_kill_healthy_replicas(make_fleet):
+    """A wire-listener-only failure must not escalate to a fleet-wide
+    kill: in-process members fall back to the direct observe (the
+    wire loss stays visible in heartbeats_lost)."""
+    fleet = make_fleet(
+        n=2, roles=("prefill", "decode"),
+        env={
+            "ROOM_TPU_DISAGG_WIRE": "loopback",
+            "ROOM_TPU_POD_MEMBERSHIP": "1",
+            "ROOM_TPU_WIRE_RETRIES": "1",
+        },
+    )
+    try:
+        fleet.supervise()
+        fleet.disagg._wire_server.close()   # the listener dies
+        deadline = time.monotonic() + 1.0   # >> dead_s + lease_s
+        while time.monotonic() < deadline:
+            fleet.supervise()
+            time.sleep(0.02)
+        pod = fleet.fleet_stats()["pod"]
+        assert pod["heartbeats_lost"] >= 1
+        assert all(
+            m["state"] == "alive" for m in pod["members"].values()
+        ), pod
+        assert pod["lease_rehomes"] == 0
+        assert all(h.state == "serving" for h in fleet.replicas)
+    finally:
+        fleet.disagg.close()
+
+
+# ---- crash-durable router mirror ----
+
+def test_router_restart_recovers_mid_stream_from_journal(
+    make_fleet, control,
+):
+    """Acceptance (c): a router process restart (no drain — the crash
+    case) rebuilds its mirror from the journal and the mid-stream
+    session resumes token-identically."""
+    full, cont = control
+    env = {"ROOM_TPU_POD_MIRROR": "1"}
+    fleet1 = make_fleet(n=1, roles=("mixed",), env=env)
+    streamed, handle = _stream_partial(fleet1, "s", len(full), 3)
+    n = len(streamed)
+    # router process "crashes": no drain, no manifest — the journal is
+    # all that survives
+    del fleet1, handle
+    fleet2 = make_fleet(n=1, roles=("mixed",))
+    st = fleet2.fleet_stats()
+    assert st["mirror_restored"] == 1
+    assert st["mirror"]["journal"]["replayed_sessions"] == 1
+    t2 = fleet2.submit(
+        [], session_id="s", sampling=_greedy(len(full) - n),
+    )
+    fleet2.run_until_idle()
+    assert streamed + list(t2.new_tokens) == full
+    # and the NEXT turn keeps flowing through the rebuilt mirror
+    t3 = fleet2.submit(CONT, session_id="s",
+                       sampling=_greedy(len(cont)))
+    fleet2.run_until_idle()
+    assert list(t3.new_tokens) == cont
+
+
+def test_clean_drain_clears_journal_no_double_restore(
+    make_fleet, control,
+):
+    full, _ = control
+    env = {"ROOM_TPU_POD_MIRROR": "1", "ROOM_TPU_LIFECYCLE": "1"}
+    fleet1 = make_fleet(n=1, roles=("mixed",), env=env)
+    t1 = fleet1.submit(LONG_PROMPT, session_id="s",
+                       sampling=_greedy(len(full)))
+    fleet1.run_until_idle()
+    assert list(t1.new_tokens) == full
+    summary = fleet1.drain()
+    assert summary["manifest_written"]
+    fleet2 = make_fleet(n=1, roles=("mixed",))
+    # the manifest is the restart authority; the consumed journal must
+    # not resurrect a second copy
+    assert fleet2.fleet_stats()["mirror_restored"] == 0
+    restored = fleet2.restore_from_manifest()
+    assert restored["resumed"] + restored["reprefill"] >= 1
+
+
+def test_mirror_journal_io_fault_degrades_never_breaks_serving(
+    make_fleet, control,
+):
+    full, _ = control
+    fleet = make_fleet(
+        n=1, roles=("mixed",), env={"ROOM_TPU_POD_MIRROR": "1"},
+    )
+    faults.inject("mirror_journal_io", probability=0.5, seed=7)
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    # live serving is untouched by journal failures
+    assert list(t1.new_tokens) == full
+    assert faults.fired("mirror_journal_io") >= 1
+    stats = fleet.mirror_journal.stats()
+    assert stats["errors"] >= 1
+    faults.clear()
+    # a replay over the holey journal either restores the session
+    # complete or refuses it — never a partial/forked mirror
+    state = fleet.mirror_journal.replay()
+    if "s" in state and state["s"]["complete"]:
+        assert state["s"]["tokens"] == LONG_PROMPT + full
+
+
+def test_cap_evicted_mirror_never_resumes_from_journal(
+    make_fleet, control,
+):
+    """A cap-evicted mirror keeps streaming durable tokens the
+    journal no longer sees — replaying its truncated prefix after a
+    router crash would fork the session. The eviction must drop the
+    journal's claim."""
+    full, _ = control
+    fleet = make_fleet(
+        n=1, roles=("mixed",),
+        env={
+            "ROOM_TPU_POD_MIRROR": "1",
+            "ROOM_TPU_FLEET_MIRROR_TOKENS": "4",
+        },
+    )
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1.new_tokens) == full
+    assert fleet.fleet_stats()["mirror"]["evictions"] >= 1
+    state = fleet.mirror_journal.replay()
+    assert "s" not in state or not state["s"]["tokens"]
+    # and a rebuilt router must NOT restore it from the journal
+    fleet2 = make_fleet(n=1, roles=("mixed",))
+    assert fleet2.fleet_stats()["mirror_restored"] == 0
+
+
+def test_journal_compaction_preserves_replay(make_fleet, control):
+    full, _ = control
+    fleet = make_fleet(
+        n=1, roles=("mixed",), env={"ROOM_TPU_POD_MIRROR": "1"},
+    )
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert fleet.mirror_journal.compact(
+        fleet._mirror_snapshot_sessions()
+    )
+    state = fleet.mirror_journal.replay()
+    assert state["s"]["complete"]
+    assert state["s"]["tokens"] == LONG_PROMPT + full
+    assert state["s"]["rid"] == fleet._records["s"].rid
+
+
+# ---- wire server satellites ----
+
+def test_wire_in_orphan_sweep_dead_pid(tmp_path):
+    wire_dir = tmp_path / "wire-in"
+    wire_dir.mkdir()
+    dead = wire_dir / "pid999999-wire1-kv.kvspool"
+    dead.write_bytes(b"orphaned payload")
+    live = wire_dir / f"pid{os.getpid()}-wire2-kv.kvspool"
+    live.write_bytes(b"live payload")
+    srv = multihost.KVWireServer(
+        str(wire_dir), lambda e, f, t: {"ok": True}
+    )
+    try:
+        assert not dead.exists()      # dead-PID payload swept at boot
+        assert live.exists()          # live sibling's file untouched
+        assert srv.stats()["orphans_swept"] == 1
+    finally:
+        srv.close()
+
+
+def test_wedged_peer_does_not_hold_the_acceptor(tmp_path):
+    srv, got = _echo_server(tmp_path)
+    wedged = socket.create_connection(srv.address, timeout=5.0)
+    try:
+        wedged.sendall(b"RT")   # partial magic, then silence
+        t0 = time.monotonic()
+        # no on_control wired here: the prompt REFUSAL is the proof —
+        # the frame was read and answered on its own handler thread
+        # while the wedged peer still held a connection open
+        with pytest.raises(multihost.KVWireRefused,
+                           match="no control frames"):
+            multihost.wire_send_control(
+                srv.address, {"kind": "heartbeat", "member": "m0"},
+                retries=1,
+            )
+        elapsed = time.monotonic() - t0
+        assert elapsed < multihost.wire_timeout_s() / 2
+        st = srv.stats()
+        assert st["open_handlers"] >= 1
+        assert st["accept_alive"]
+    finally:
+        wedged.close()
+        srv.close()
+
+
+def test_failed_accept_join_is_reported_not_silent(tmp_path):
+    srv, _ = _echo_server(tmp_path)
+
+    class WedgedThread:
+        @staticmethod
+        def join(timeout=None):
+            pass              # "join" that never succeeds
+
+        @staticmethod
+        def is_alive():
+            return True
+
+    real = srv._thread
+    srv._thread = WedgedThread()
+    srv.close()
+    assert srv.stats()["accept_join_failed"] == 1
+    assert srv.stats()["accept_alive"]
+    srv._thread = real
+    real.join(timeout=5.0)
+
+
+def test_saturated_receiver_is_retryable_not_a_refusal(tmp_path):
+    """Backpressure must feed the retry budget and the breaker as a
+    FAILURE — a saturated receiver is not an application refusal a
+    heartbeat or shipment should give up on."""
+    srv, _ = _echo_server(tmp_path)
+    srv.max_handlers = 0   # every slot "busy": instant saturation
+    try:
+        with pytest.raises(multihost.KVWireError,
+                           match="backpressure") as ei:
+            multihost.wire_send_control(
+                srv.address, {"kind": "heartbeat", "member": "m"},
+                retries=2,
+            )
+        assert not isinstance(ei.value, multihost.KVWireRefused)
+        snap = podnet.breaker_for(srv.address).snapshot()
+        assert snap["consecutive_failures"] == 2   # both attempts
+        assert srv.stats()["handlers_capped"] == 2
+    finally:
+        srv.max_handlers = 16
+        srv.close()
+
+
+def test_journal_compact_callable_never_loses_racing_appends(
+    tmp_path,
+):
+    """The fleet's callable-compaction form: appends racing the
+    snapshot/swap park in memory and land in the new journal — a
+    replay sees every token exactly once (overlaps absorbed)."""
+    j = podnet.MirrorJournal(str(tmp_path), batch=1, compact_lines=4)
+    j.record_place("s", "r0", 1, 0)
+    j.append_tokens("s", [1, 2, 3], 0)
+
+    def sessions():
+        # an append lands mid-snapshot-build: the snapshot below
+        # already covers token 4, and its journal line is parked
+        j.append_tokens("s", [4], 3)
+        return [{"sid": "s", "rid": "r0", "fence": 1, "gen": 0,
+                 "tokens": [1, 2, 3, 4]}]
+
+    assert j.compact(sessions)
+    j.append_tokens("s", [5], 4)
+    state = j.replay()
+    assert state["s"]["complete"]
+    assert state["s"]["tokens"] == [1, 2, 3, 4, 5]
+
+
+def test_control_frame_with_payload_is_refused(tmp_path):
+    srv, _ = _echo_server(tmp_path)
+    try:
+        import json
+        import struct
+
+        header = json.dumps(
+            {"control": {"kind": "heartbeat", "member": "x"}}
+        ).encode()
+        with socket.create_connection(srv.address, timeout=5.0) as c:
+            c.sendall(
+                multihost.WIRE_MAGIC
+                + struct.pack("<I", multihost.WIRE_VERSION)
+                + struct.pack("<Q", len(header)) + header
+                + struct.pack("<Q", 4) + b"XXXX"
+            )
+            reply = multihost._recv_json(c)
+        assert reply["ok"] is False
+        assert "control frame with payload" in reply["error"]
+    finally:
+        srv.close()

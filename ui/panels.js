@@ -1667,7 +1667,27 @@ async function renderTpu(el) {
         <span class="k">mirror</span>
           <span>${e.fleet.mirror?.tokens ?? 0} tokens
             <span class="dim">(cap ${e.fleet.mirror?.cap_tokens ?? 0},
-              ${e.fleet.mirror?.evictions ?? 0} evictions)</span>
+              ${e.fleet.mirror?.evictions ?? 0} evictions${
+              e.fleet.mirror?.journal
+                ? `, journal ${e.fleet.mirror.journal.appends ?? 0}
+                   appends / ${e.fleet.mirror.journal.errors ?? 0}
+                   errors` : ""})</span>
+          </span>
+      </div>`).join("")}
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.fleet?.pod?.enabled)
+        .map(([name, e]) => `
+      <div class="kv" style="margin-top:.4rem">
+        <span class="k">pod members (${esc(name)})</span>
+          <span>${Object.entries(e.fleet.pod.members || {})
+            .map(([mid, m]) => `<span class="pill ${
+              m.state === "alive" ? "verified"
+              : m.state === "dead" ? "failed" : "pending"
+            }">${esc(mid)}: ${esc(m.state)}</span>`).join(" ")}
+            <span class="dim">(${e.fleet.pod.heartbeats_sent ?? 0}
+              beats, ${e.fleet.pod.heartbeats_lost ?? 0} lost,
+              ${e.fleet.pod.lease_rehomes ?? 0} lease re-homes,
+              ${e.fleet.fence_refusals ?? 0} fence refusals)</span>
           </span>
       </div>`).join("")}` : ""}
       ${Object.entries(hl.engines || {}).some(
