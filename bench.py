@@ -1145,12 +1145,183 @@ def main() -> None:
             del fleet
             gc.collect()
 
+    def measure_router_failover() -> dict:
+        """Sharded router tier (docs/podnet.md): kill one of two
+        router shards MID-STREAM, then prove (a) zero durably-streamed
+        tokens lost — the victim room's engine session is untouched
+        and every turn stays token-identical to an unkilled control,
+        (b) the bystander shard's room never stalls, (c) after the
+        sibling adopts the dead shard's journal, a submit carrying the
+        pre-failover placement epoch is refused."""
+        import shutil
+        import tempfile
+
+        from room_tpu.serving import faults as faults_mod
+        from room_tpu.serving import podnet as podnet_mod
+        from room_tpu.serving.fleet import EngineFleet
+
+        budget = 16 if TINY else 32
+        sp = SamplingParams(temperature=0.0, max_new_tokens=budget)
+        cont_sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+        cont = [7, 7, 7]
+        # two rooms that hash onto DIFFERENT router shards
+        pm = podnet_mod.PlacementMap(2)
+        sid_a = next(
+            f"room-{i}" for i in range(64)
+            if pm.shard_of(f"room-{i}") == 0
+        )
+        sid_b = next(
+            f"room-{i}" for i in range(64)
+            if pm.shard_of(f"room-{i}") == 1
+        )
+        ctrl = ServingEngine(
+            cfg, params, max_batch=4, page_size=16, n_pages=512,
+        )
+        ref: dict[str, list] = {}
+        for sid in (sid_a, sid_b):
+            ref[sid] = []
+            for turn_prompt, turn_sp in (
+                (prompt, sp), (cont, cont_sp), (cont, cont_sp),
+            ):
+                t = ctrl.submit(
+                    turn_prompt, session_id=sid, sampling=turn_sp,
+                )
+                ctrl.run_until_idle()
+                ref[sid].append(list(t.new_tokens))
+        del ctrl
+        gc.collect()
+
+        tmp = tempfile.mkdtemp(prefix="bench-router-")
+        overrides = {
+            "ROOM_TPU_ROUTER_SHARDS": "2",
+            # effectively-infinite lease; the phase expires it by hand
+            # so the dead window and the adoption are deterministic
+            "ROOM_TPU_ROUTER_LEASE_S": "600",
+            "ROOM_TPU_POD_MIRROR_BATCH": "1",
+            "ROOM_TPU_LIFECYCLE_DIR": tmp,
+        }
+        prev = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+
+        def build(i):
+            return ServingEngine(
+                cfg, params, max_batch=4, page_size=16,
+                n_pages=512, offload=True,
+            )
+
+        fleet = None
+        try:
+            fleet = EngineFleet(
+                "bench-router", build, 2, auto_rebuild=False,
+            )
+            got: dict[str, list] = {sid_a: [], sid_b: []}
+            t1a = fleet.submit(prompt, session_id=sid_a, sampling=sp)
+            t1b = fleet.submit(prompt, session_id=sid_b, sampling=sp)
+            fleet.run_until_idle()
+            got[sid_a].append(list(t1a.new_tokens))
+            got[sid_b].append(list(t1b.new_tokens))
+            # kill the victim's shard at sid_a's SECOND streamed token
+            seen = {"n": 0}
+
+            def killer(tok: int) -> None:
+                seen["n"] += 1
+                if seen["n"] == 2:
+                    fleet.kill_router_shard(0, reason="bench")
+
+            t2a = fleet.submit(
+                cont, session_id=sid_a, sampling=cont_sp,
+                on_token=killer,
+            )
+            fleet.run_until_idle()
+            got[sid_a].append(list(t2a.new_tokens))
+            # dead window: victim rooms shed, bystander rooms stream
+            shed_probe = fleet.submit(
+                cont, session_id=sid_a, sampling=cont_sp,
+            )
+            victim_shed = bool(shed_probe.shed)
+            t2b = fleet.submit(
+                cont, session_id=sid_b, sampling=cont_sp,
+            )
+            fleet.run_until_idle()
+            got[sid_b].append(list(t2b.new_tokens))
+            bystander_ok = not t2b.shed and \
+                list(t2b.new_tokens) == ref[sid_b][1]
+            # expire the lease by hand -> sibling adopts the journal
+            stale_epoch = fleet.placement.epoch
+            fleet.router_lease_s = 0.0
+            fleet.supervise()
+            rs = fleet.fleet_stats()["router_shards"]
+            # a healed stale router replaying the pre-failover epoch
+            stale_turn = fleet.submit(
+                cont, session_id=sid_a, sampling=cont_sp,
+                placement_epoch=stale_epoch,
+            )
+            stale_refused = bool(stale_turn.shed)
+            first: dict = {}
+            t0 = time.perf_counter()
+            t3a = fleet.submit(
+                cont, session_id=sid_a, sampling=cont_sp,
+                on_token=lambda tok: first.setdefault(
+                    "t", time.perf_counter()
+                ),
+            )
+            t3b = fleet.submit(
+                cont, session_id=sid_b, sampling=cont_sp,
+            )
+            fleet.run_until_idle()
+            ttft = round(first["t"] - t0, 3) if "t" in first else None
+            got[sid_a].append(list(t3a.new_tokens))
+            got[sid_b].append(list(t3b.new_tokens))
+            token_loss = sum(
+                1 for sid in (sid_a, sid_b)
+                for got_turn, ref_turn in zip(got[sid], ref[sid])
+                for a, b in zip(got_turn, ref_turn)
+                if a != b
+            ) + sum(
+                abs(len(got_turn) - len(ref_turn))
+                for sid in (sid_a, sid_b)
+                for got_turn, ref_turn in zip(got[sid], ref[sid])
+            )
+            if CPU_PROXY and ttft is not None:
+                _proxy_deltas["router_failover_ttft_s"] = ttft
+            return {
+                # the acceptance numbers: tokens_lost MUST be 0, the
+                # bystander shard's room must never stall, and the
+                # stale epoch must be refused after the heal
+                "tokens_lost": token_loss,
+                "bystander_ok": bystander_ok,
+                "victim_shed_during_lease": victim_shed,
+                "stale_epoch_refused": stale_refused,
+                "adoptions": rs["adoptions"],
+                "sessions_adopted": rs["sessions_adopted"],
+                "placement_epoch": rs["epoch"],
+                "ttft_after_adoption_s": ttft,
+            }
+        finally:
+            faults_mod.clear()
+            if fleet is not None:
+                fleet.disagg.close()
+            podnet_mod.reset_breakers()
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            del fleet
+            gc.collect()
+            shutil.rmtree(tmp, ignore_errors=True)
+
     if os.environ.get("ROOM_TPU_BENCH_PODNET", "1") != "0":
         _extend_deadline()
         try:
             _phase("partition_failover", measure_partition_failover())
         except Exception as e:
             _phase("partition_failover", {"error": str(e)[:300]})
+        _extend_deadline()
+        try:
+            _phase("router_failover", measure_router_failover())
+        except Exception as e:
+            _phase("router_failover", {"error": str(e)[:300]})
 
     # Disaggregated prefill/decode A/B (docs/disagg.md): a burst of
     # 2k-token prompts against (a) a mixed fleet — every replica eats

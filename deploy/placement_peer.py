@@ -1,0 +1,87 @@
+"""Standalone pod-peer control endpoint (docs/podnet.md).
+
+Hosts a real ``KVWireServer`` and answers the pod's control frames the
+way a sibling router process would: heartbeats are observed into a
+``PodMembership``, replicated placement frames install into a
+``PlacementMap`` under the strictly-newer epoch fence. Used by
+``run_partition_bench.sh --local`` so the bench's placement publishes
+and heartbeats cross a REAL process + socket boundary instead of the
+in-process loopback the unit tiers use.
+
+    python deploy/placement_peer.py --port 3710
+
+Prints ``PEER_READY host:port`` once listening, then one line per
+control frame; exits on SIGTERM/SIGINT.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+
+# runnable from a source checkout (no `pip install -e .`): the repo
+# root is this file's grandparent
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--shards", type=int, default=2)
+    args = ap.parse_args()
+
+    from room_tpu.parallel.multihost import KVWireServer
+    from room_tpu.serving.podnet import PlacementMap, PodMembership
+
+    placement = PlacementMap(args.shards)
+    membership = PodMembership()
+
+    def on_control(control: dict) -> dict:
+        kind = control.get("kind")
+        if kind == "heartbeat":
+            member = str(control.get("member") or "")
+            membership.register(member)
+            applied = membership.observe(member)
+            print(json.dumps({"control": "heartbeat",
+                              "member": member}), flush=True)
+            return {"ok": True, "applied": applied,
+                    "member_state": membership.state_of(member)}
+        if kind == "placement":
+            applied = placement.apply(control)
+            print(json.dumps({
+                "control": "placement",
+                "epoch": control.get("epoch"),
+                "applied": applied,
+                "local_epoch": placement.epoch,
+            }), flush=True)
+            return {"ok": True, "applied": applied,
+                    "epoch": placement.epoch}
+        return {"ok": False, "error": f"unknown control {kind!r}"}
+
+    def on_entry(header, payload, path):
+        # a control-only peer: KV shipments belong to the fleet tier
+        return {"ok": False, "error": "control-only peer"}
+
+    spool = tempfile.mkdtemp(prefix="placement-peer-")
+    server = KVWireServer(
+        spool, on_entry, host=args.host, port=args.port,
+        on_control=on_control,
+    )
+    print(f"PEER_READY {server.address[0]}:{server.address[1]}",
+          flush=True)
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Run the bench partition_failover + router_failover phases across
+# ACTUAL processes (docs/podnet.md) — the wire, the heartbeats, and
+# the replicated placement epochs cross real socket boundaries.
+#
+#   deploy/run_partition_bench.sh            # compose mode: 2 containers
+#   deploy/run_partition_bench.sh --local    # no docker: a peer process
+#
+# Compose mode brings up the 2-member pod from docker-compose.yml and
+# runs the bench inside router-a, with router-b's wire address as the
+# pod peer. Local mode spawns deploy/placement_peer.py (a real
+# KVWireServer in its own process) and points ROOM_TPU_POD_PEERS at
+# it. Either way the gate is the same as ci.yml's cpu-proxy smoke:
+# tokens_lost==0 on both phases, bystander unstalled, stale epoch
+# refused after the shard adoption.
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(dirname "$HERE")"
+PHASES="$(mktemp /tmp/bench_pod_phases.XXXXXX.jsonl)"
+
+# every phase except the two podnet failover phases is switched off
+BENCH_ENV=(
+  JAX_PLATFORMS=cpu
+  ROOM_TPU_BENCH_CPU_PROXY=1
+  ROOM_TPU_BENCH_PIPELINE=0 ROOM_TPU_BENCH_SPEC=0
+  ROOM_TPU_BENCH_SPEC_PIPELINE=0 ROOM_TPU_BENCH_PREFILL=0
+  ROOM_TPU_BENCH_LATENCY=0 ROOM_TPU_BENCH_OFFLOAD=0
+  ROOM_TPU_BENCH_RESTART=0 ROOM_TPU_BENCH_FLEET=0
+  ROOM_TPU_BENCH_DISAGG=0 ROOM_TPU_BENCH_SCHED=0
+  ROOM_TPU_BENCH_RAGGED=0 ROOM_TPU_BENCH_KVQ=0
+  ROOM_TPU_BENCH_TRACE=0
+)
+
+assert_phases() {
+  python - "$1" <<'PYEOF'
+import json, sys
+
+phases = [json.loads(ln) for ln in open(sys.argv[1]) if ln.strip()]
+part = [p for p in phases if p.get("phase") == "partition_failover"]
+assert part, "partition_failover phase missing"
+row = part[-1]
+assert row.get("tokens_lost") == 0, row
+assert row.get("ships_reprefill", 0) >= 1, row
+print("partition_failover: tokens_lost=0, ttft",
+      row.get("ttft_after_partition_s"))
+rt = [p for p in phases if p.get("phase") == "router_failover"]
+assert rt, "router_failover phase missing"
+row = rt[-1]
+assert row.get("tokens_lost") == 0, row
+assert row.get("bystander_ok") is True, row
+assert row.get("victim_shed_during_lease") is True, row
+assert row.get("stale_epoch_refused") is True, row
+assert row.get("adoptions", 0) >= 1, row
+print("router_failover: tokens_lost=0, adoption ttft",
+      row.get("ttft_after_adoption_s"))
+print("POD BENCH OK")
+PYEOF
+}
+
+if [[ "${1:-}" == "--local" ]]; then
+  # ---- local mode: a real peer process, no docker ----
+  cd "$REPO"
+  PEER_LOG="$(mktemp /tmp/placement_peer.XXXXXX.log)"
+  python deploy/placement_peer.py --port 0 >"$PEER_LOG" 2>&1 &
+  PEER_PID=$!
+  trap 'kill "$PEER_PID" 2>/dev/null || true' EXIT
+  for _ in $(seq 1 50); do
+    if grep -q PEER_READY "$PEER_LOG"; then break; fi
+    sleep 0.2
+  done
+  PEER_ADDR="$(grep PEER_READY "$PEER_LOG" | awk '{print $2}' || true)"
+  [[ -n "$PEER_ADDR" ]] || { echo "peer never came up"; cat "$PEER_LOG"; exit 1; }
+  echo "pod peer listening at $PEER_ADDR"
+  env "${BENCH_ENV[@]}" \
+    ROOM_TPU_POD_PEERS="$PEER_ADDR" \
+    ROOM_TPU_BENCH_PHASES="$PHASES" \
+    python bench.py >/dev/null
+  assert_phases "$PHASES"
+  # the adoption's epoch bump must have reached the peer process
+  grep -q '"control": "placement"' "$PEER_LOG" || {
+    echo "peer process never received a placement frame"; exit 1; }
+  echo "placement frames crossed the process boundary:"
+  grep '"control": "placement"' "$PEER_LOG"
+else
+  # ---- compose mode: the 2-member pod from docker-compose.yml ----
+  cd "$HERE"
+  docker compose up -d --build
+  trap 'docker compose down -v' EXIT
+  echo "waiting for both members to report healthy..."
+  for _ in $(seq 1 60); do
+    healthy="$(docker compose ps --format json 2>/dev/null \
+      | grep -c '"Health":"healthy"' || true)"
+    if [[ "$healthy" == "2" ]]; then break; fi
+    sleep 5
+  done
+  docker compose exec -T \
+    $(for kv in "${BENCH_ENV[@]}"; do printf -- "-e %s " "$kv"; done) \
+    -e ROOM_TPU_POD_PEERS=router-b:3710 \
+    -e ROOM_TPU_BENCH_PHASES=/tmp/pod_phases.jsonl \
+    router-a python bench.py >/dev/null
+  docker compose cp router-a:/tmp/pod_phases.jsonl "$PHASES"
+  assert_phases "$PHASES"
+fi

@@ -56,9 +56,11 @@ it feeds the breaker a success and is never retried. The
 so chaos tests drive retry, breaker, and exhaustion separately from
 the whole-shipment ``kv_wire`` point. Control frames (same RTKW
 framing, ``header["control"]`` instead of a session entry, empty
-payload) carry pod membership heartbeats; session entries carry their
-ownership fence token (``entry["fence"]``) for the receiver's
-stale-generation refusal. The server handles each connection on its
+payload) carry pod membership heartbeats and the sharded router
+tier's placement-map epochs (``wire_broadcast_control`` fans one
+frame out to every ``ROOM_TPU_POD_PEERS`` member); session entries
+carry their ownership fence token (``entry["fence"]``) for the
+receiver's stale-generation refusal. The server handles each connection on its
 own bounded worker thread — one wedged peer can no longer hold the
 acceptor — and reports a failed accept-thread join in ``stats()``
 instead of silently proceeding.
@@ -374,6 +376,35 @@ def wire_send_control(
     return _send_with_retry(
         address, {"control": control}, None, 0, timeout_s, retries
     )
+
+
+def wire_broadcast_control(
+    addresses,
+    control: dict,
+    *,
+    timeout_s: Optional[float] = None,
+    retries: Optional[int] = None,
+) -> dict:
+    """Fan one control frame (a placement-map epoch, an adoption
+    notice) out to every peer address. Best-effort per peer: a
+    partitioned member costs its breaker-bounded refusal and an error
+    entry in the result, never the other peers' delivery — the
+    replication contract is 'newest epoch wins at each receiver', not
+    atomic broadcast. Returns peer-key -> reply dict (or
+    ``{"error": ...}``)."""
+    out: dict = {}
+    for address in addresses:
+        key = f"{address[0]}:{address[1]}" if isinstance(
+            address, (tuple, list)
+        ) else str(address)
+        try:
+            out[key] = wire_send_control(
+                tuple(address), control,
+                timeout_s=timeout_s, retries=retries,
+            )
+        except (KVWireError, KVWireRefused, OSError) as e:
+            out[key] = {"error": str(e)[:200]}
+    return out
 
 
 class KVWireServer:

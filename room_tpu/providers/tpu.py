@@ -587,6 +587,10 @@ def engines_snapshot() -> dict[str, dict]:
                 "sessions": len(engine.sessions),
                 "max_batch": engine.max_batch,
                 "healthy": healthy,
+                # sharded router tier (docs/podnet.md): the full
+                # per-shard block rides inside the fleet stats above;
+                # the flat count is the cheap capacity-planning signal
+                "router_shards": engine.n_router_shards,
             }
             for h in engine.replicas:
                 e = h.engine

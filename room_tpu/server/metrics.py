@@ -216,6 +216,13 @@ def render_metrics() -> str:
         "Prefill->decode KV shipments per fleet, by outcome "
         "(docs/disagg.md).",
     )
+    shard_fam = _Family(
+        "room_tpu_router_shard", "gauge",
+        "Sharded router tier per-shard state (docs/podnet.md): rooms "
+        "owned, journal bytes, adoptions, serving flag, keyed by "
+        "model and shard; plus fleet-wide placement epoch/crash "
+        "counters under shard=\"all\".",
+    )
     offload_fams = {
         "host_entries": _Family(
             "room_tpu_offload_host_entries", "gauge",
@@ -276,6 +283,31 @@ def render_metrics() -> str:
             v = dis.get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 ship_fam.add({"model": model, "outcome": key}, v)
+        shards = (e.get("fleet") or {}).get("router_shards") or {}
+        for key in ("count", "serving", "epoch", "crashes",
+                    "adoptions", "sessions_adopted",
+                    "placement_refusals"):
+            v = shards.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                shard_fam.add(
+                    {"model": model, "shard": "all", "stat": key}, v,
+                )
+        for sk, blk in (shards.get("shards") or {}).items():
+            if not isinstance(blk, dict):
+                continue
+            shard_fam.add(
+                {"model": model, "shard": str(sk),
+                 "stat": "serving"},
+                1 if blk.get("state") == "serving" else 0,
+            )
+            for key in ("rooms", "journal_bytes", "adoptions"):
+                v = blk.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    shard_fam.add(
+                        {"model": model, "shard": str(sk),
+                         "stat": key}, v,
+                    )
     families.append(eng_fam)
     families.append(healthy_fam)
     families.extend(cls_fams.values())
@@ -283,6 +315,7 @@ def render_metrics() -> str:
     families.extend(offload_fams.values())
     families.append(pfx_fam)
     families.append(ship_fam)
+    families.append(shard_fam)
 
     # ---- turnscope SLO attribution (serving/trace.py) ----
     try:

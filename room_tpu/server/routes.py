@@ -1254,6 +1254,15 @@ def register_aux_routes(r: Router) -> None:
             for e in engines.values()
             for m in (((e.get("fleet") or {}).get("pod") or {})
                       .get("members") or {}).values()
+        ) or any(
+            # a dead router shard (docs/podnet.md) is degraded until a
+            # sibling adopts its journal — its rooms shed meanwhile.
+            # "retired" (journal adopted, placement redirected) is the
+            # failover COMPLETE, not a degradation.
+            s.get("state") == "dead"
+            for e in engines.values()
+            for s in (((e.get("fleet") or {}).get("router_shards")
+                       or {}).get("shards") or {}).values()
         )
         from .runtime import lifecycle_snapshot
 

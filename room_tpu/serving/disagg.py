@@ -40,6 +40,14 @@ Accelerators", 2407.09111), built on three seams that already exist:
   lost, a session is never misrouted, and greedy continuations stay
   token-identical through every path (pinned in tests/test_disagg.py).
 
+Role routing composes with the sharded router tier (docs/podnet.md):
+roles pick the REPLICA a session computes on, router shards own the
+RECORD that tracks it. A router shard crash aborts its records'
+in-flight ships (``abort_ship_locked`` — the detached spool is
+discarded, never adopted under a dead owner); after the sibling
+adopts the shard's journal, the next turn re-ships or re-prefills
+through the same degradation ladder as a lost shipment.
+
 Thread model: the coordinator is driven by ``EngineFleet.supervise()``
 (the fleet serve thread, or the synchronous ``run_until_idle`` driver)
 and mutates ship state only under the fleet lock; engine interaction

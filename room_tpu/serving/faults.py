@@ -65,6 +65,19 @@ named *fault point* that tests (and staging deployments) can arm:
                        warmth, never live correctness) and a corrupt
                        journal line is skipped at replay, never a
                        crash
+    placement_io       a placement-map publish or apply is dropped
+                       (docs/podnet.md): the epoch-versioned map is
+                       re-published every supervise tick, so a lost
+                       frame costs staleness (refused submits that
+                       retry), never a fork — and a stale APPLY is
+                       refused by the epoch check regardless
+    router_shard_crash one router shard of N dies hard
+                       (docs/podnet.md): its rooms' records and
+                       journal freeze, submits for those rooms shed
+                       until a surviving sibling adopts the shard's
+                       mirror journal past the router lease, mints
+                       fences +1, and publishes a new placement
+                       epoch — bystander shards' rooms never stall
 
 Swarm-layer points (docs/swarm_recovery.md) thread the same registry
 up through the agent runtime above the engine:
@@ -117,6 +130,8 @@ FAULT_POINTS = (
     "kv_wire", "prefix_io",
     # pod fault tolerance (docs/podnet.md)
     "wire_partition", "heartbeat_loss", "mirror_journal_io",
+    # sharded router tier (docs/podnet.md)
+    "placement_io", "router_shard_crash",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
 )

@@ -42,6 +42,20 @@ each drains to its own subdir, and the next boot's restore absorbs
 every manifest it finds — tolerant of a fleet-size change across the
 restart.
 
+**Sharded router tier (docs/podnet.md).** Router state itself is
+partitioned by room id across ``ROOM_TPU_ROUTER_SHARDS`` shards: each
+``_RouterShard`` owns the ``_SessionRecord``s, fences, and mirror
+journal for its rooms (placement = crc32(room) mod N via the
+epoch-versioned ``PlacementMap``, replicated to pod peers over control
+frames). A shard that dies (the ``router_shard_crash`` fault, or ops)
+sheds its rooms until its lease (``ROOM_TPU_ROUTER_LEASE_S``)
+expires, then a surviving sibling ADOPTS its mirror journal — replay
+with the journal's hole/tombstone discipline, fences minted +1, a new
+placement epoch published — while every other shard's rooms keep
+streaming untouched. Submits carrying a pre-failover placement epoch
+are refused (``stale placement epoch``), so a healed stale router can
+never re-install the old ownership: one room, one owner, always.
+
 Env knobs (docs/knobs.md):
 
     ROOM_TPU_FLEET_REPLICAS   engine replicas per served model (1 =
@@ -52,6 +66,10 @@ Env knobs (docs/knobs.md):
     ROOM_TPU_FLEET_TICK_S     supervision poll interval
     ROOM_TPU_FLEET_REBUILD    auto-rebuild crashed replicas (within
                               the strike budget)
+    ROOM_TPU_ROUTER_SHARDS    room-id partitions of the router tier
+                              (1 = the classic single router slice)
+    ROOM_TPU_ROUTER_LEASE_S   dead router shard's lease before a
+                              sibling adopts its journal
 """
 
 from __future__ import annotations
@@ -75,7 +93,10 @@ from .faults import FaultError
 from .sampler import SamplingParams
 from .scheduler import classify_turn
 
-__all__ = ["EngineFleet", "ReplicaHandle", "fleet_replicas_from_env"]
+__all__ = [
+    "EngineFleet", "ReplicaHandle", "fleet_replicas_from_env",
+    "router_shards_from_env",
+]
 
 log = logging.getLogger(__name__)
 
@@ -84,6 +105,15 @@ def fleet_replicas_from_env() -> int:
     try:
         return max(1, knobs.get_int(
             "ROOM_TPU_FLEET_REPLICAS", scope="provider"
+        ))
+    except ValueError:
+        return 1
+
+
+def router_shards_from_env() -> int:
+    try:
+        return max(1, knobs.get_int(
+            "ROOM_TPU_ROUTER_SHARDS", scope="provider"
         ))
     except ValueError:
         return 1
@@ -147,6 +177,10 @@ class _SessionRecord:
     # fence the in-flight disagg ship was minted under; a mismatch at
     # collect/dispatch means a re-home superseded the export
     ship_fence: int = 0
+    # sharded router tier (docs/podnet.md): index of the _RouterShard
+    # whose record map and mirror journal own this session; rewritten
+    # (under the fleet lock) when a dead shard's journal is adopted
+    shard: int = 0
 
 
 class ReplicaHandle:
@@ -274,6 +308,106 @@ class _FleetSessions:
         return self._snapshot().values()
 
 
+class _RouterShard:
+    """One room-id partition of the router tier (docs/podnet.md): its
+    own ``_SessionRecord`` map and mirror journal. A shard is the
+    router-side failure domain — killing one loses exactly its rooms'
+    in-memory records (the journal on disk survives for a sibling to
+    adopt), never a sibling shard's, and never any engine KV."""
+
+    def __init__(
+        self, shard_id: int,
+        journal: Optional[podnet_mod.MirrorJournal] = None,
+    ) -> None:
+        self.shard_id = shard_id
+        self.records: dict[str, _SessionRecord] = {}
+        self.journal = journal
+        # serving -> dead (crashed; lease running) -> retired (journal
+        # adopted by a sibling; placement redirected away)
+        self.state = "serving"
+        self.died_at = 0.0
+        self.adoptions = 0
+
+
+class _ShardedRecords:
+    """Dict-shaped facade over the router shards' record maps, so
+    every existing ``_records`` call site (and the tests/bench that
+    poke it) keeps its semantics — including the ``get(sid) is rec``
+    identity checks the disagg coordinator leans on. Reads scan the
+    shard maps; writes home the record on its placement-map shard.
+    Mutating call sites already hold the fleet lock."""
+
+    def __init__(self, fleet: "EngineFleet") -> None:
+        self._fleet = fleet
+
+    def _maps(self) -> list[dict]:
+        return [s.records for s in self._fleet._shards]
+
+    def get(self, sid, default=None):
+        for m in self._maps():
+            rec = m.get(sid)
+            if rec is not None:
+                return rec
+        return default
+
+    def __getitem__(self, sid) -> _SessionRecord:
+        rec = self.get(sid)
+        if rec is None:
+            raise KeyError(sid)
+        return rec
+
+    def __setitem__(self, sid, rec: _SessionRecord) -> None:
+        shards = self._fleet._shards
+        k = self._fleet.placement.shard_of(sid)
+        if shards[k].state != "serving":
+            # the room's shard is down with its lease still running
+            # (a salvage re-home or boot replay minted this record,
+            # not a submit — those shed): home it provisionally on
+            # the emptiest serving sibling. Lookups scan every map,
+            # so the placement redirect that lands at adoption never
+            # loses the record.
+            live = [s for s in shards if s.state == "serving"]
+            if live:
+                k = min(live, key=lambda s: len(s.records)).shard_id
+        for s in shards:
+            if s.shard_id != k:
+                s.records.pop(sid, None)
+        rec.shard = k
+        shards[k].records[sid] = rec
+
+    def pop(self, sid, default=None):
+        out = default
+        for m in self._maps():
+            rec = m.pop(sid, None)
+            if rec is not None:
+                out = rec
+        return out
+
+    def __contains__(self, sid) -> bool:
+        return self.get(sid) is not None
+
+    def __len__(self) -> int:
+        return sum(len(m) for m in self._maps())
+
+    def _merged(self) -> dict:
+        out: dict = {}
+        for m in self._maps():
+            out.update(m)
+        return out
+
+    def __iter__(self):
+        return iter(self._merged())
+
+    def keys(self):
+        return self._merged().keys()
+
+    def values(self):
+        return self._merged().values()
+
+    def items(self):
+        return self._merged().items()
+
+
 class EngineFleet:
     """N engine replicas of one model behind a KV-affinity router.
 
@@ -301,7 +435,21 @@ class EngineFleet:
         self.auto_rebuild = auto_rebuild if auto_rebuild is not None \
             else knobs.get_bool("ROOM_TPU_FLEET_REBUILD")
         self._lock = locks.make_lock("fleet")
-        self._records: dict[str, _SessionRecord] = {}
+        # sharded router tier (docs/podnet.md): room-id-partitioned
+        # record maps behind a dict-shaped facade; 1 shard = the
+        # classic single router slice
+        self.n_router_shards = router_shards_from_env()
+        try:
+            self.router_lease_s = knobs.get_float(
+                "ROOM_TPU_ROUTER_LEASE_S"
+            )
+        except ValueError:
+            self.router_lease_s = 2.0
+        self.placement = podnet_mod.PlacementMap(self.n_router_shards)
+        self._shards: list[_RouterShard] = [
+            _RouterShard(i) for i in range(self.n_router_shards)
+        ]
+        self._records = _ShardedRecords(self)
         self._rr = 0   # round-robin cursor for re-home spreading
         self._threads_started = False
         self.lifecycle_phase = "starting"
@@ -313,6 +461,8 @@ class EngineFleet:
             "router_retries": 0, "router_shed": 0,
             "mirror_evictions": 0, "mirror_tokens_evicted": 0,
             "fence_refusals": 0, "mirror_restored": 0,
+            "router_shard_crashes": 0, "router_shard_adoptions": 0,
+            "sessions_adopted": 0, "placement_refusals": 0,
         }
         # bounded router history mirror (docs/fleet.md): the per-token
         # mirror grows for the life of a room, and disaggregation's
@@ -355,16 +505,30 @@ class EngineFleet:
         # replayed NOW so a router restart re-parks every in-flight
         # session the journal still covers instead of orphaning it
         self.pod = podnet_mod.PodCoordinator(self)
-        self.mirror_journal: Optional[podnet_mod.MirrorJournal] = None
-        if knobs.get_bool("ROOM_TPU_POD_MIRROR"):
-            self.mirror_journal = podnet_mod.MirrorJournal(
-                os.path.join(
-                    lifecycle_mod.engine_dir(model_name),
-                    "router-mirror",
-                )
+        # journals exist when the pod mirror knob asks for crash
+        # durability OR the router tier is sharded — shard failover IS
+        # journal adoption, so a multi-shard router always journals. A
+        # single shard keeps the flat router-mirror dir (back compat
+        # with pre-shard sidecars); shards get one subdir each.
+        if knobs.get_bool("ROOM_TPU_POD_MIRROR") or \
+                self.n_router_shards > 1:
+            root = os.path.join(
+                lifecycle_mod.engine_dir(model_name), "router-mirror",
             )
-            self._replay_mirror_journal()
+            for shard in self._shards:
+                shard.journal = podnet_mod.MirrorJournal(
+                    root if self.n_router_shards == 1
+                    else os.path.join(root, f"shard-{shard.shard_id}")
+                )
+            self._replay_mirror_journals()
         self.lifecycle_phase = "serving"
+
+    @property
+    def mirror_journal(self) -> Optional[podnet_mod.MirrorJournal]:
+        """Shard 0's journal — THE journal for a single-shard router
+        (the pre-shard surface tests and ops scripts poke); per-record
+        paths resolve their own shard's journal via _journal_for."""
+        return self._shards[0].journal
 
     # ---- small helpers ----
 
@@ -556,11 +720,16 @@ class EngineFleet:
         deadline_s: Optional[float] = None,
         priority: Optional[int] = None,
         turn_class: Optional[str] = None,
+        placement_epoch: Optional[int] = None,
     ) -> Turn:
         """Queue a turn on the session's replica (KV affinity), or the
         healthiest replica for a fresh session. Same signature and
         Turn contract as ``ServingEngine.submit``; the priority class
-        rides through to the replica's own EDF scheduler untouched."""
+        rides through to the replica's own EDF scheduler untouched.
+        ``placement_epoch`` is the sharded-router fence: a submitter
+        that resolved its room's shard under an older placement epoch
+        (a healed router re-playing pre-failover traffic) is refused
+        and must re-resolve — never silently re-routed."""
         sid = session_id or f"s{id(object())}-{time.monotonic_ns()}"
         # the scheduler's classifier, not a silent `or "worker"`: an
         # untagged turn carrying an explicit background priority stays
@@ -571,6 +740,26 @@ class EngineFleet:
                 sid, prompt_tokens, sampling, turn_class,
                 "draining: engine is restarting; retry shortly",
                 priority,
+            )
+        # sharded router tier (docs/podnet.md): refuse stale placement
+        # epochs (the split-brain fence), and shed rooms whose shard is
+        # dead with its lease still running — routing such a room
+        # FRESH could pick a different replica than its live engine
+        # session and fork its history; the shed costs a bounded retry
+        # until a sibling adopts the shard's journal.
+        if self.placement.stale_epoch(placement_epoch):
+            self._bump("placement_refusals")
+            return self._shed_turn(
+                sid, prompt_tokens, sampling, turn_class,
+                "stale placement epoch: the room's router shard "
+                "moved; re-resolve placement and retry", priority,
+            )
+        if self._shards[self.placement.shard_of(sid)].state \
+                != "serving":
+            return self._shed_turn(
+                sid, prompt_tokens, sampling, turn_class,
+                "router shard down; sibling adoption pending — "
+                "retry shortly", priority,
             )
         # router_io fault point: the placement lookup fails — bounded
         # retry, then shed cleanly. NEVER fall through to an arbitrary
@@ -616,12 +805,24 @@ class EngineFleet:
                     # submitting to the stale handle would fork —
                     # re-resolve against the new placement
                     continue
+                # TOCTOU vs a router-shard crash in the routing
+                # window: the record was just swept — shed instead of
+                # enqueueing a turn the adoption machinery can't see
+                shard_down = self._shards[
+                    self.placement.shard_of(sid)
+                ].state != "serving"
                 # bar the coordinator from STARTING a ship until this
                 # turn is on the engine queue (where export_session's
                 # in-flight check takes over)
-                if rec is not None:
+                if rec is not None and not shard_down:
                     rec.routing += 1
-                routing_rec = rec
+                routing_rec = rec if not shard_down else None
+            if shard_down:
+                return self._shed_turn(
+                    sid, prompt_tokens, sampling, turn_class,
+                    "router shard down; sibling adoption pending — "
+                    "retry shortly", priority,
+                )
             break
         rec = self._record_for(sid, handle)
         wrapped = self._mirror_on_token(
@@ -694,11 +895,15 @@ class EngineFleet:
         nothing durable, so its retry against a re-homed session must
         behave as if the turn never ran."""
         state = {"booked": False}
-        journal = self.mirror_journal
 
         def wrapped(tok: int) -> None:
             appended: Optional[list] = None
             offset = 0
+            # per-call resolution, not captured at wrap time: an
+            # adoption may move rec to a sibling shard mid-stream, and
+            # the crashed journal's dead handle drops (never forks)
+            # the one append that can race the move
+            journal = self._journal_for(rec)
             with rec.lock:
                 added = 0
                 if not rec.mirror_dropped:
@@ -780,14 +985,15 @@ class EngineFleet:
                 evicted += 1
                 with self._mirror_lock:
                     self._mirror_tokens -= dropped
-                if self.mirror_journal is not None:
+                journal = self._journal_for(rec)
+                if journal is not None:
                     # the journal must stop claiming this mirror: a
                     # router crash replaying the evicted PREFIX as a
                     # complete history would fork the session the
                     # warm-salvage-only rule protects. A TOMBSTONE,
                     # not a rel — an in-flight token append racing
                     # this eviction must not resurrect the prefix
-                    self.mirror_journal.record_drop(rec.sid)
+                    journal.record_drop(rec.sid)
                 self._bump("mirror_evictions")
                 self._bump("mirror_tokens_evicted", dropped)
         return evicted
@@ -855,18 +1061,40 @@ class EngineFleet:
         self.note_fence_refusal(sid, fence, origin)
         return True
 
+    def _journal_for(
+        self, rec: _SessionRecord
+    ) -> Optional[podnet_mod.MirrorJournal]:
+        """The journal owning ``rec``'s shard. Lock-free: the shard
+        list has fixed length, ``rec.shard`` only moves under the
+        fleet lock at adoption, and an append that races the move
+        lands in the crashed journal's dead handle (dropped, counted,
+        never forked)."""
+        try:
+            return self._shards[rec.shard].journal
+        except IndexError:
+            return None
+
     def _journal_place(self, rec: _SessionRecord) -> None:
-        if self.mirror_journal is not None:
-            self.mirror_journal.record_place(
+        journal = self._journal_for(rec)
+        if journal is not None:
+            journal.record_place(
                 rec.sid, rec.rid, rec.fence, rec.generation
             )
 
-    def _mirror_snapshot_sessions(self) -> list[dict]:
+    def _mirror_snapshot_sessions(
+        self, shard_id: Optional[int] = None,
+    ) -> list[dict]:
         """Authoritative record view for a journal compaction (tokens
         copied under each record's own lock, never nested inside the
-        fleet lock)."""
+        fleet lock). ``shard_id`` scopes the snapshot to one router
+        shard's records — each shard's journal compacts against ITS
+        rooms only; None (the pre-shard surface) snapshots them
+        all."""
         with self._lock:
-            recs = list(self._records.values())
+            recs = list(
+                self._records.values() if shard_id is None
+                else self._shards[shard_id].records.values()
+            )
         out = []
         for rec in recs:
             with rec.lock:
@@ -882,45 +1110,91 @@ class EngineFleet:
                 })
         return out
 
-    def _replay_mirror_journal(self) -> None:
+    def _replay_mirror_journals(self) -> None:
         """Router-restart recovery: rebuild placements + mirrors from
-        the journal. Every complete session re-parks exactly like a
+        every journal source under the model's router-mirror dir —
+        the flat dir (a previous single-shard incarnation) plus every
+        ``shard-*`` subdir (a previous sharded incarnation, ANY shard
+        count: a session whose old shard no longer exists re-homes
+        onto its hash-current shard, so an N->M change absorbs every
+        journal). Every complete session re-parks exactly like a
         deferred re-home (rid="" + pending entry), so its next route
         adopts it into whichever replica serves — the placement the
         journal names may not exist in this incarnation. Incomplete
         mirrors (a hole from a dropped journal line) are NOT resumed:
-        re-prefilling a holey history would fork the session."""
-        journal = self.mirror_journal
-        if journal is None:
-            return
+        re-prefilling a holey history would fork the session. Sessions
+        that cross journals re-log into their current shard (place +
+        tokens) and release out of the source, so a SECOND restart
+        replays one authoritative copy; sources no current shard owns
+        are consumed outright."""
+        root = os.path.join(
+            lifecycle_mod.engine_dir(self.model_name), "router-mirror",
+        )
+        current = {
+            s.journal.dir: s for s in self._shards
+            if s.journal is not None
+        }
+        sources = [root]
+        try:
+            for name in sorted(os.listdir(root)):
+                if name.startswith("shard-") and \
+                        os.path.isdir(os.path.join(root, name)):
+                    sources.append(os.path.join(root, name))
+        except OSError:
+            pass
         restored = 0
-        for sid, state in journal.replay().items():
-            toks = state.get("tokens") or []
-            if not state.get("complete") or not toks:
-                continue
-            with self._lock:
-                known = sid in self._records
-            if known:
-                continue
-            rec = _SessionRecord(sid=sid, rid="")
-            rec.generation = int(state.get("generation") or 0)
-            self._set_record_tokens(rec, [int(t) for t in toks])
-            # ONE mirror->entry shape for failover and replay; the
-            # NEXT ownership transfer (the adopting route) must
-            # supersede anything the pre-crash incarnation exported
-            fence = int(state.get("fence") or 0) + 1
-            entry = self._entry_from_mirror(rec)
-            if entry is None:
-                self._mirror_release(rec)
-                continue
-            entry["fence"] = fence
-            with self._lock:
-                rec.fence = fence
-                rec.pending_entry = entry
-                rec.pending_fingerprint = None
-                self._records[sid] = rec
-            self._journal_place(rec)
-            restored += 1
+        for src in sources:
+            src_journal = getattr(current.get(src), "journal", None)
+            try:
+                # a current shard's own sidecar replays through its
+                # live journal (stats accounting: replayed_sessions /
+                # replay_incomplete); orphaned sources read raw
+                state_map = (
+                    src_journal.replay() if src_journal is not None
+                    else podnet_mod.replay_journal_dir(src)
+                )
+            except Exception:
+                state_map = {}
+            for sid, state in state_map.items():
+                toks = state.get("tokens") or []
+                if state.get("dropped") or \
+                        not state.get("complete") or not toks:
+                    continue
+                with self._lock:
+                    known = sid in self._records
+                if known:
+                    continue
+                rec = _SessionRecord(sid=sid, rid="")
+                rec.generation = int(state.get("generation") or 0)
+                self._set_record_tokens(rec, [int(t) for t in toks])
+                # ONE mirror->entry shape for failover and replay; the
+                # NEXT ownership transfer (the adopting route) must
+                # supersede anything the pre-crash incarnation exported
+                fence = int(state.get("fence") or 0) + 1
+                entry = self._entry_from_mirror(rec)
+                if entry is None:
+                    self._mirror_release(rec)
+                    continue
+                entry["fence"] = fence
+                with self._lock:
+                    rec.fence = fence
+                    rec.pending_entry = entry
+                    rec.pending_fingerprint = None
+                    self._records[sid] = rec
+                self._journal_place(rec)
+                journal = self._journal_for(rec)
+                if journal is not None and journal.dir != src:
+                    # crossed journals (shard-count change, or the
+                    # flat pre-shard dir): the current shard's journal
+                    # becomes the one authoritative copy
+                    journal.append_tokens(sid, list(toks), 0)
+                    journal.flush(sid)
+                    if src_journal is not None:
+                        src_journal.record_release(sid)
+                restored += 1
+        for src in sources:
+            if src not in current:
+                podnet_mod.consume_journal_dir(src)
         if restored:
             self._bump("mirror_restored", restored)
             trace_mod.note_event("mirror_restore", {
@@ -947,8 +1221,10 @@ class EngineFleet:
             targets = [handle] if handle is not None else []
         else:
             targets = list(self.replicas)
-        if rec is not None and self.mirror_journal is not None:
-            self.mirror_journal.record_release(session_id)
+        if rec is not None:
+            journal = self._journal_for(rec)
+            if journal is not None:
+                journal.record_release(session_id)
         for h in targets:
             if h.state != "dead":
                 h.engine.release_session(session_id)
@@ -991,22 +1267,46 @@ class EngineFleet:
                 self.kill_replica(
                     victim.rid, reason="injected replica_crash"
                 )
+        # router_shard_crash chaos (docs/podnet.md): kill the busiest
+        # serving router shard — the worst case for adoption — when a
+        # sibling exists to adopt it
+        spec = faults.should_fire("router_shard_crash")
+        if spec is not None:
+            with self._lock:
+                shards = [
+                    s for s in self._shards if s.state == "serving"
+                ]
+            if len(shards) >= 2:
+                victim_shard = max(
+                    shards, key=lambda s: len(s.records)
+                )
+                self.kill_router_shard(
+                    victim_shard.shard_id,
+                    reason="injected router_shard_crash",
+                )
+        self._adopt_dead_shards()
         # disaggregated prefill->decode ships fire at turn boundaries
         # noticed here (docs/disagg.md); inert without roles
         self.disagg.advance()
         # pod membership: heartbeats + lease-expiry re-homes
         # (docs/podnet.md); inert without ROOM_TPU_POD_MEMBERSHIP
         self.pod.tick()
-        if self.mirror_journal is not None:
+        for shard in self._shards:
+            journal = shard.journal
+            if journal is None or shard.state != "serving":
+                continue
             # push any batched token appends to disk each tick, and
-            # compact the journal once it outgrows its threshold —
-            # the CALLABLE form: the journal parks concurrent appends
-            # before the snapshot is built, so none can be lost to
-            # the file swap
-            self.mirror_journal.flush_all()
-            if self.mirror_journal.should_compact():
-                self.mirror_journal.compact(
-                    self._mirror_snapshot_sessions
+            # compact each shard's journal once it outgrows its
+            # threshold — the CALLABLE form: the journal parks
+            # concurrent appends before the snapshot is built, so
+            # none can be lost to the file swap. The snapshot is
+            # scoped to the SHARD's records: compacting against the
+            # whole fleet would resurrect siblings' rooms here.
+            journal.flush_all()
+            if journal.should_compact():
+                journal.compact(
+                    lambda k=shard.shard_id:
+                        self._mirror_snapshot_sessions(k)
                 )
         for h in list(self.replicas):
             if h.state != "serving":
@@ -1053,6 +1353,187 @@ class EngineFleet:
         h.engine.healthy = False
         self._bury(h, reason)
         return True
+
+    # ---- sharded router tier: shard crash + journal adoption ----
+
+    def kill_router_shard(
+        self, shard_id: int, reason: str = "killed"
+    ) -> bool:
+        """Chaos/ops: kill one ROUTER shard — not an engine replica.
+        Its in-memory records vanish (exactly what a router process
+        death loses), its journal handle crashes (buffered tokens
+        lost, on-disk files kept for the adopter), and its rooms shed
+        at submit until a sibling adopts the journal past the lease.
+        Engine KV is untouched — the shard's rooms keep their live
+        engine sessions and resume token-identically after adoption.
+        Refused for a single-shard router (nobody left to adopt)."""
+        if self.n_router_shards < 2:
+            return False
+        try:
+            shard = self._shards[shard_id]
+        except IndexError:
+            return False
+        orphaned: list = []
+        with self._lock:
+            if shard.state != "serving":
+                return False
+            shard.state = "dead"
+            shard.died_at = time.monotonic()
+            recs = list(shard.records.values())
+            shard.records.clear()
+            # a ship mid-flight for a dying shard's room is moot: the
+            # adoption replay owns the session's future — drain it
+            # through the coordinator so waiters unblock and a
+            # completed export's spool is discarded, not leaked
+            for rec in recs:
+                entry = self.disagg.abort_ship_locked(rec)
+                if entry is not None:
+                    orphaned.append(entry)
+        self._bump("router_shard_crashes")
+        for entry in orphaned:
+            self.disagg._discard_entry(entry)
+        for rec in recs:
+            # releases the cap accounting AND marks the records
+            # dropped, so orphaned on_token closures of still-running
+            # turns stop booking tokens into dead state
+            self._mirror_release(rec)
+        if shard.journal is not None:
+            shard.journal.crash()
+        trace_mod.note_event("router_shard_crash", {
+            "shard": shard_id, "rooms": len(recs), "reason": reason,
+        })
+        log.warning(
+            "fleet %s: router shard %d died (%s); %d room(s) shed "
+            "until a sibling adopts its journal",
+            self.model_name, shard_id, reason, len(recs),
+        )
+        return True
+
+    def _adopt_dead_shards(self) -> None:
+        """Drive journal adoption for every dead shard whose lease
+        (``ROOM_TPU_ROUTER_LEASE_S``) has expired. The lease is the
+        fencing dance's timing half: in-process the crash seam already
+        closed the journal, but the state machine must stay honest for
+        the cross-process deploy where 'dead' is a heartbeat verdict —
+        adopting a journal a slow owner could still append to would
+        split ownership."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [
+                s for s in self._shards
+                if s.state == "dead"
+                and now - s.died_at >= self.router_lease_s
+            ]
+            serving = [
+                s for s in self._shards if s.state == "serving"
+            ]
+        if not serving:
+            return
+        for shard in dead:
+            adopter = min(serving, key=lambda s: len(s.records))
+            self._adopt_shard_journal(shard, adopter)
+
+    def _adopt_shard_journal(
+        self, dead: _RouterShard, adopter: _RouterShard,
+    ) -> None:
+        """Replay a dead shard's on-disk journal into ``adopter``:
+        fences mint +1 (anything the dead incarnation exported is
+        stale from here), offset holes and tombstones degrade exactly
+        as the journal contract says, and the placement map re-homes
+        the dead shard's rooms under a NEW epoch published to pod
+        peers — stale-epoch submits are refused from that instant.
+
+        A room whose engine session is still live adopts WARM-ONLY
+        (``mirror_dropped``): tokens its in-flight turn streamed after
+        the shard died were never journaled, so the journal's mirror
+        may be a stale prefix — restoring it would hand a later
+        re-prefill a forked history. The live engine session itself is
+        the token-exact resume path. Only a room whose engine side is
+        gone too (the double failure) re-parks its journal mirror as a
+        deferred re-home entry."""
+        state_map: dict = {}
+        if dead.journal is not None:
+            try:
+                state_map = podnet_mod.replay_journal_dir(
+                    dead.journal.dir
+                )
+            except Exception:
+                state_map = {}
+        adopted = 0
+        for sid, state in state_map.items():
+            toks = [int(t) for t in state.get("tokens") or []]
+            complete = bool(state.get("complete")) and bool(toks)
+            dropped = bool(state.get("dropped"))
+            handle = self._handle(str(state.get("rid") or ""))
+            engine_live = (
+                handle is not None and handle.state != "dead"
+                and sid in handle.engine.sessions
+            )
+            fence = int(state.get("fence") or 0) + 1
+            rec = _SessionRecord(sid=sid, rid="")
+            rec.generation = int(state.get("generation") or 0)
+            entry = None
+            if engine_live:
+                # affinity survives; the mirror does not (see above)
+                with rec.lock:
+                    rec.mirror_dropped = True
+            elif complete and not dropped:
+                self._set_record_tokens(rec, toks)
+                entry = self._entry_from_mirror(rec)
+                if entry is None:
+                    self._mirror_release(rec)
+                    continue
+                entry["fence"] = fence
+            else:
+                # tombstoned or holey with no live engine session:
+                # nothing durable survives — the room starts cold
+                continue
+            superseded = False
+            with self._lock:
+                if self._records.get(sid) is not None:
+                    # a salvage re-home minted a newer record while
+                    # the lease ran; it wins
+                    superseded = True
+                else:
+                    rec.fence = fence
+                    rec.shard = adopter.shard_id
+                    if engine_live:
+                        rec.rid = handle.rid
+                    else:
+                        rec.pending_entry = entry
+                        rec.pending_fingerprint = None
+                    adopter.records[sid] = rec
+            if superseded:
+                self._mirror_release(rec)
+                continue
+            self._journal_place(rec)
+            journal = adopter.journal
+            if journal is not None:
+                if rec.mirror_dropped:
+                    journal.record_drop(sid)
+                elif rec.tokens:
+                    journal.append_tokens(sid, list(rec.tokens), 0)
+                    journal.flush(sid)
+            adopted += 1
+        with self._lock:
+            dead.state = "retired"
+            adopter.adoptions += 1
+        self._bump("router_shard_adoptions")
+        self._bump("sessions_adopted", adopted)
+        epoch = self.placement.rehome(
+            dead.shard_id, adopter.shard_id
+        )
+        self.pod.publish_placement()
+        trace_mod.note_event("router_shard_adopt", {
+            "shard": dead.shard_id, "adopter": adopter.shard_id,
+            "sessions": adopted, "epoch": epoch,
+        })
+        log.warning(
+            "fleet %s: router shard %d adopted shard %d's journal "
+            "(%d session(s), placement epoch %d)",
+            self.model_name, adopter.shard_id, dead.shard_id,
+            adopted, epoch,
+        )
 
     def _bury(self, h: ReplicaHandle, reason: str) -> None:
         """Mark a replica dead and re-home everything it held. A
@@ -1639,16 +2120,18 @@ class EngineFleet:
             wrote_all = wrote_all and s.get("manifest_written", False)
             for k in totals:
                 totals[k] += int(s.get(k) or 0)
-        if self.mirror_journal is not None:
+        for shard in self._shards:
+            if shard.journal is None:
+                continue
             if wrote_all:
                 # the manifests are now the authoritative restart
                 # state; stale journal entries must not resurrect
                 # sessions the drain already handed off
-                self.mirror_journal.clear()
+                shard.journal.clear()
             else:
-                # a failed manifest write keeps the journal as the
+                # a failed manifest write keeps the journals as the
                 # fallback recovery source for the next boot
-                self.mirror_journal.close()
+                shard.journal.close()
         return {
             "drain_ms": round((time.monotonic() - t0) * 1000.0, 3),
             "manifest_written": wrote_all,
@@ -1712,6 +2195,32 @@ class EngineFleet:
         }
         if self.mirror_journal is not None:
             out["mirror"]["journal"] = self.mirror_journal.stats()
+        # sharded router tier (docs/podnet.md): per-shard health the
+        # /api/tpu/health router block and /metrics family read
+        out["router_shards"] = {
+            "count": self.n_router_shards,
+            "serving": sum(
+                1 for s in self._shards if s.state == "serving"
+            ),
+            "epoch": self.placement.epoch,
+            "crashes": out.pop("router_shard_crashes"),
+            "adoptions": out.pop("router_shard_adoptions"),
+            "sessions_adopted": out.pop("sessions_adopted"),
+            "placement_refusals": out.pop("placement_refusals"),
+            "placement": self.placement.snapshot(),
+            "shards": {
+                str(s.shard_id): {
+                    "state": s.state,
+                    "rooms": len(s.records),
+                    "journal_bytes": (
+                        s.journal.size_bytes()
+                        if s.journal is not None else 0
+                    ),
+                    "adoptions": s.adoptions,
+                }
+                for s in self._shards
+            },
+        }
         out["disagg"] = self.disagg.stats()
         # pod membership + per-peer wire breakers (docs/podnet.md);
         # pod.stats() takes the fleet lock itself — outside the

@@ -57,11 +57,28 @@ pod item blocks on, in four pieces:
   replay and that session degrades to a cold start — never a forked
   re-prefill.
 
-Thread model: the membership table, each breaker, and the journal
-buffers sit behind their own registered locks (``locks.make_lock`` —
-lockmap/lockdep cover them); none of them calls into an engine or the
-fleet while held. The coordinator runs inside the fleet's supervise
-tick and takes the fleet lock only through the fleet's own seams.
+- **Sharded router tier** (``PlacementMap`` + the fleet's
+  ``_RouterShard`` slices, docs/podnet.md): with
+  ``ROOM_TPU_ROUTER_SHARDS`` > 1 the router's session records, fences,
+  and mirror journal partition by room-id hash across N independent
+  shards, fronted by an epoch-versioned placement map (room-id ->
+  shard) replicated to pod peers (``ROOM_TPU_POD_PEERS``) over the
+  same ``wire_send_control`` frames heartbeats use. Router failover is
+  the lease/fence dance replicas already do: a dead shard's rooms shed
+  (retryable 503) for ``ROOM_TPU_ROUTER_LEASE_S``, then a surviving
+  sibling adopts the dead shard's journal (``replay_journal_dir`` —
+  offset holes refused, tombstones honored), mints every fence +1, and
+  publishes a new placement epoch; a healed stale-epoch router's
+  submits are refused by the epoch check — one room structurally has
+  one owner. ``placement_io`` drops publish/apply frames;
+  ``router_shard_crash`` kills the busiest shard in supervise.
+
+Thread model: the membership table, each breaker, the placement map,
+and the journal buffers sit behind their own registered locks
+(``locks.make_lock`` — lockmap/lockdep cover them); none of them calls
+into an engine or the fleet while held. The coordinator runs inside
+the fleet's supervise tick and takes the fleet lock only through the
+fleet's own seams.
 """
 
 from __future__ import annotations
@@ -83,7 +100,8 @@ __all__ = [
     "wire_retries", "wire_backoff_s",
     "MEMBER_ALIVE", "MEMBER_SUSPECT", "MEMBER_DEAD",
     "PodMember", "PodMembership", "PodCoordinator",
-    "MirrorJournal",
+    "PlacementMap", "MirrorJournal",
+    "replay_journal_dir", "consume_journal_dir",
 ]
 
 log = logging.getLogger(__name__)
@@ -419,6 +437,149 @@ class PodMembership:
             }
 
 
+class PlacementMap:
+    """Epoch-versioned room-id -> router-shard map (docs/podnet.md).
+
+    The base placement is a stable content hash (crc32 of the session
+    id mod ``n_shards`` — deterministic across processes and restarts,
+    so every pod member computes the same home without coordination).
+    A shard failover overlays a **redirect** (dead shard -> adopter,
+    chains followed) and bumps the **epoch**; the map replicates to
+    pod peers as a control frame, and ``apply`` refuses any frame
+    whose epoch is not strictly newer — a healed stale router cannot
+    re-install the pre-failover ownership, so one room structurally
+    has one owner. ``placement_io`` fires at the publish and apply
+    seams (a dropped frame costs staleness, never a fork)."""
+
+    def __init__(self, n_shards: int) -> None:
+        self.n_shards = max(1, int(n_shards))
+        self._lock = locks.make_lock("placement_map")
+        self._epoch = 0
+        self._redirects: dict[int, int] = {}
+        self._stats = {
+            "rehomes": 0, "stale_applies_refused": 0,
+            "applies": 0, "submit_refusals": 0,
+        }
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        # callers hold self._lock (non-reentrant): this is the single
+        # mutation point the stats()/snapshot() readers rely on, not a
+        # lock-taking helper like the engine's
+        self._stats[key] += n
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def shard_of(self, sid: str) -> int:
+        """Resolve a room/session id to its current owning shard:
+        stable hash, then follow failover redirects (cycle-guarded —
+        a malformed replicated frame must not hang the router)."""
+        k = zlib.crc32(str(sid).encode("utf-8")) % self.n_shards
+        with self._lock:
+            seen = set()
+            while k in self._redirects and k not in seen:
+                seen.add(k)
+                k = self._redirects[k]
+        return k % self.n_shards
+
+    def rehome(self, dead: int, adopter: int) -> int:
+        """Record a shard failover (dead -> adopter) and bump the
+        epoch. Returns the new epoch; the caller owes a publish."""
+        with self._lock:
+            self._redirects[int(dead)] = int(adopter)
+            # an earlier failover may have redirected INTO the shard
+            # that just died: re-point those chains at the adopter so
+            # lookups stay one hop deep
+            for src, dst in list(self._redirects.items()):
+                if dst == int(dead):
+                    self._redirects[src] = int(adopter)
+            self._epoch += 1
+            self._bump("rehomes")
+            return self._epoch
+
+    def frame(self) -> dict:
+        """The replicated control-frame payload."""
+        with self._lock:
+            return {
+                "kind": "placement",
+                "epoch": self._epoch,
+                "n_shards": self.n_shards,
+                "redirects": {
+                    str(k): int(v)
+                    for k, v in self._redirects.items()
+                },
+            }
+
+    def apply(self, frame: dict) -> bool:
+        """Install a replicated placement frame. Refused (False) when
+        the frame's epoch is not strictly newer than ours — the
+        split-brain guard: after a heal, whichever side published last
+        wins and the stale side's map (and its submits, via
+        ``stale_epoch``) is rejected. The ``placement_io`` fault drops
+        the apply the way a lost frame would."""
+        from . import faults
+
+        try:
+            faults.maybe_fail("placement_io")
+            epoch = int(frame.get("epoch"))
+            redirects = {
+                int(k): int(v)
+                for k, v in (frame.get("redirects") or {}).items()
+            }
+        except Exception:
+            return False
+        with self._lock:
+            if epoch <= self._epoch:
+                self._bump("stale_applies_refused")
+                return False
+            self._epoch = epoch
+            self._redirects = redirects
+            self._bump("applies")
+        return True
+
+    def stale_epoch(self, epoch) -> bool:
+        """Is a submitter's captured epoch older than the map's? (A
+        healed router re-submitting under the pre-failover epoch must
+        be refused and told to re-route.)"""
+        if epoch is None:
+            return False
+        try:
+            epoch = int(epoch)
+        except (TypeError, ValueError):
+            return True
+        with self._lock:
+            if epoch < self._epoch:
+                self._bump("submit_refusals")
+                return True
+        return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "epoch": self._epoch,
+                "n_shards": self.n_shards,
+                "redirects": {
+                    str(k): v for k, v in self._redirects.items()
+                },
+                **self._stats,
+            }
+
+
+def pod_peers() -> list[tuple[str, int]]:
+    """Parse ``ROOM_TPU_POD_PEERS`` into control-wire addresses."""
+    raw = knobs.get_str("ROOM_TPU_POD_PEERS") or ""
+    out: list[tuple[str, int]] = []
+    for part in filter(None, (p.strip() for p in raw.split(","))):
+        host, _, port = part.rpartition(":")
+        try:
+            out.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            log.warning("ROOM_TPU_POD_PEERS: bad address %r", part)
+    return out
+
+
 class PodCoordinator:
     """Glue between the membership detector and one ``EngineFleet``:
     registers every replica as a pod member, heartbeats them each
@@ -450,6 +611,7 @@ class PodCoordinator:
             "heartbeats_sent": 0, "heartbeats_lost": 0,
             "heartbeats_wire": 0, "members_suspected": 0,
             "members_died": 0, "lease_rehomes": 0,
+            "placements_published": 0, "placement_publish_drops": 0,
         }
         if self.enabled:
             for h in fleet.replicas:
@@ -489,7 +651,59 @@ class PodCoordinator:
                 "ok": True, "applied": applied,
                 "member_state": self.membership.state_of(member),
             }
+        if kind == "placement":
+            # replicated placement map (sharded router tier): install
+            # iff strictly newer — the receive half of the epoch fence
+            placement = getattr(self.fleet, "placement", None)
+            if placement is None:
+                return {"ok": False, "error": "no placement map"}
+            applied = placement.apply(control)
+            return {
+                "ok": True, "applied": applied,
+                "epoch": placement.epoch,
+            }
         return {"ok": False, "error": f"unknown control {kind!r}"}
+
+    def publish_placement(self) -> int:
+        """Replicate the fleet's placement map to every configured
+        pod peer (``ROOM_TPU_POD_PEERS``) as a control frame. Runs on
+        the supervise thread after every epoch bump; best-effort per
+        peer (the breaker + retry policy bound a partitioned peer's
+        cost, and the next bump re-publishes). Returns peers that
+        acknowledged. Independent of the membership knob: shard
+        failover needs the epoch fence even in a single-member pod,
+        where the peer list is simply empty."""
+        from . import faults, trace as trace_mod
+        from .faults import FaultError
+
+        placement = getattr(self.fleet, "placement", None)
+        if placement is None:
+            return 0
+        frame = placement.frame()
+        try:
+            faults.maybe_fail("placement_io")
+        except FaultError:
+            # the publish was dropped in flight: peers stay one epoch
+            # behind until the next bump — their stale submits are
+            # refused by the epoch check, so staleness never forks
+            self._bump("placement_publish_drops")
+            return 0
+        peers = pod_peers()
+        acked = 0
+        if peers:
+            from ..parallel.multihost import wire_broadcast_control
+
+            replies = wire_broadcast_control(peers, frame)
+            acked = sum(
+                1 for r in replies.values()
+                if isinstance(r, dict) and r.get("ok")
+            )
+        self._bump("placements_published")
+        trace_mod.note_event("placement_published", {
+            "epoch": frame["epoch"], "peers": len(peers),
+            "acked": acked,
+        })
+        return acked
 
     def _beat_one(self, rid: str, wire_address) -> None:
         if wire_address is not None:
@@ -938,6 +1152,37 @@ class MirrorJournal:
             except OSError:
                 pass
 
+    # ---- crash seam (router-shard chaos) ----
+
+    def crash(self) -> None:
+        """Model the owning router shard dying hard: in-memory token
+        buffers and any compaction-parked lines are LOST (a real
+        process death loses exactly those), the file handle closes
+        without a flush, and the on-disk journal/snapshot stay put for
+        a surviving sibling to adopt via ``replay_journal_dir``."""
+        with self._lock:
+            old = self._fh
+            self._fh = None
+            self._buffers.clear()
+            self._pending_lines = []
+            self._swapping = False
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+
+    def size_bytes(self) -> int:
+        """On-disk sidecar footprint (journal + snapshot), for the
+        per-shard health block."""
+        total = 0
+        for name in (JOURNAL_NAME, SNAPSHOT_NAME):
+            try:
+                total += os.path.getsize(os.path.join(self.dir, name))
+            except OSError:
+                pass
+        return total
+
     # ---- replay ----
 
     def replay(self) -> dict[str, dict]:
@@ -946,100 +1191,21 @@ class MirrorJournal:
         ignored (journal offsets then expose the gap), corrupt lines
         are skipped, and any offset discontinuity marks that session
         ``complete=False`` — the caller must treat an incomplete
-        mirror as cold (re-prefilling a holey history would fork)."""
-        from . import faults
-
-        state: dict[str, dict] = {}
-
-        def entry(sid: str) -> dict:
-            e = state.get(sid)
-            if e is None:
-                e = state[sid] = {
-                    "tokens": [], "rid": "", "fence": 0,
-                    "generation": 0, "complete": True,
-                }
-            return e
-
-        try:
-            faults.maybe_fail("mirror_journal_io")
-            with open(os.path.join(self.dir, SNAPSHOT_NAME),
-                      "r", encoding="utf-8") as f:
-                snap = json.load(f)
-        except Exception:
-            snap = None
-        if isinstance(snap, dict) and \
-                snap.get("version") == JOURNAL_VERSION and \
-                isinstance(snap.get("sessions"), list):
-            payload = json.dumps(
-                snap["sessions"], separators=(",", ":")
-            )
-            if hashlib.sha256(
-                payload.encode("utf-8")
-            ).hexdigest() == snap.get("sha256"):
-                for s in snap["sessions"]:
-                    if not isinstance(s, dict) or not s.get("sid"):
-                        continue
-                    e = entry(str(s["sid"]))
-                    e["tokens"] = [int(t) for t in s.get("tokens")
-                                   or []]
-                    e["rid"] = str(s.get("rid") or "")
-                    e["fence"] = int(s.get("fence") or 0)
-                    e["generation"] = int(s.get("gen") or 0)
-        try:
-            with open(os.path.join(self.dir, JOURNAL_NAME),
-                      "r", encoding="utf-8") as f:
-                lines = f.readlines()
-        except OSError:
-            lines = []
-        tombstoned: set[str] = set()
-        for line in lines:
-            obj = _parse_line(line)
-            if obj is None:
-                continue
-            op = obj.get("op")
-            sid = str(obj.get("sid") or "")
-            if not sid:
-                continue
-            if op == "drop":
-                state.pop(sid, None)
-                tombstoned.add(sid)
-                continue
-            if sid in tombstoned:
-                continue
-            if op == "rel":
-                state.pop(sid, None)
-            elif op == "place":
-                e = entry(sid)
-                e["rid"] = str(obj.get("rid") or "")
-                e["fence"] = max(
-                    e["fence"], int(obj.get("fence") or 0)
-                )
-                e["generation"] = int(obj.get("gen") or 0)
-            elif op == "tok":
-                e = entry(sid)
-                off = int(obj.get("off") or 0)
-                toks = obj.get("t") or []
-                if off != len(e["tokens"]):
-                    if off < len(e["tokens"]):
-                        # overlap from a line racing a compaction
-                        # snapshot: positions are authoritative, so
-                        # keep the covered prefix and extend with
-                        # whatever suffix is new (possibly nothing)
-                        skip = len(e["tokens"]) - off
-                        if len(toks) > skip:
-                            e["tokens"].extend(
-                                int(t) for t in toks[skip:]
-                            )
-                        continue
-                    # off > len: a dropped line left a HOLE — only an
-                    # exact continuation is trustworthy
-                    e["complete"] = False
-                    continue
-                e["tokens"].extend(int(t) for t in toks)
-        good = sum(1 for e in state.values() if e["complete"])
+        mirror as cold (re-prefilling a holey history would fork).
+        Tombstoned (cap-evicted) sessions do not appear here — the
+        adoption path reads them via ``replay_journal_dir``."""
+        state = replay_journal_dir(self.dir)
+        good = sum(1 for e in state.values()
+                   if e["complete"] and not e.get("dropped"))
         self._bump("replayed_sessions", good)
-        self._bump("replay_incomplete", len(state) - good)
-        return state
+        self._bump(
+            "replay_incomplete",
+            sum(1 for e in state.values()
+                if not e["complete"] and not e.get("dropped")),
+        )
+        return {
+            sid: e for sid, e in state.items() if not e.get("dropped")
+        }
 
     def stats(self) -> dict:
         with self._lock:
@@ -1048,3 +1214,120 @@ class MirrorJournal:
             out["lines"] = self._lines
             out["batch"] = self.batch
         return out
+
+
+def replay_journal_dir(dir_path: str) -> dict[str, dict]:
+    """Rebuild sid -> {tokens, rid, fence, generation, complete,
+    dropped} from one journal directory, WITHOUT a live MirrorJournal
+    instance — the shard-adoption and boot-absorption paths read dead
+    shards' sidecars this way. Same hole/overlap discipline as
+    ``MirrorJournal.replay``; additionally, a tombstoned (``drop``)
+    session survives as ``dropped=True`` carrying its last placement —
+    the adopter must keep honoring the eviction (warm-only failover,
+    never a resurrected prefix) while preserving the room's replica
+    affinity."""
+    from . import faults
+
+    state: dict[str, dict] = {}
+
+    def entry(sid: str) -> dict:
+        e = state.get(sid)
+        if e is None:
+            e = state[sid] = {
+                "tokens": [], "rid": "", "fence": 0,
+                "generation": 0, "complete": True, "dropped": False,
+            }
+        return e
+
+    try:
+        faults.maybe_fail("mirror_journal_io")
+        with open(os.path.join(dir_path, SNAPSHOT_NAME),
+                  "r", encoding="utf-8") as f:
+            snap = json.load(f)
+    except Exception:
+        snap = None
+    if isinstance(snap, dict) and \
+            snap.get("version") == JOURNAL_VERSION and \
+            isinstance(snap.get("sessions"), list):
+        payload = json.dumps(
+            snap["sessions"], separators=(",", ":")
+        )
+        if hashlib.sha256(
+            payload.encode("utf-8")
+        ).hexdigest() == snap.get("sha256"):
+            for s in snap["sessions"]:
+                if not isinstance(s, dict) or not s.get("sid"):
+                    continue
+                e = entry(str(s["sid"]))
+                e["tokens"] = [int(t) for t in s.get("tokens")
+                               or []]
+                e["rid"] = str(s.get("rid") or "")
+                e["fence"] = int(s.get("fence") or 0)
+                e["generation"] = int(s.get("gen") or 0)
+    try:
+        with open(os.path.join(dir_path, JOURNAL_NAME),
+                  "r", encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        lines = []
+    for line in lines:
+        obj = _parse_line(line)
+        if obj is None:
+            continue
+        op = obj.get("op")
+        sid = str(obj.get("sid") or "")
+        if not sid:
+            continue
+        if op == "drop":
+            # tombstone: the mirror prefix is dead for the REST of
+            # this journal, but the placement/fence survive so an
+            # adopting shard keeps the room's affinity warm-only
+            e = entry(sid)
+            e["tokens"] = []
+            e["complete"] = False
+            e["dropped"] = True
+            continue
+        if state.get(sid, {}).get("dropped"):
+            continue
+        if op == "rel":
+            state.pop(sid, None)
+        elif op == "place":
+            e = entry(sid)
+            e["rid"] = str(obj.get("rid") or "")
+            e["fence"] = max(
+                e["fence"], int(obj.get("fence") or 0)
+            )
+            e["generation"] = int(obj.get("gen") or 0)
+        elif op == "tok":
+            e = entry(sid)
+            off = int(obj.get("off") or 0)
+            toks = obj.get("t") or []
+            if off != len(e["tokens"]):
+                if off < len(e["tokens"]):
+                    # overlap from a line racing a compaction
+                    # snapshot: positions are authoritative, so
+                    # keep the covered prefix and extend with
+                    # whatever suffix is new (possibly nothing)
+                    skip = len(e["tokens"]) - off
+                    if len(toks) > skip:
+                        e["tokens"].extend(
+                            int(t) for t in toks[skip:]
+                        )
+                    continue
+                # off > len: a dropped line left a HOLE — only an
+                # exact continuation is trustworthy
+                e["complete"] = False
+                continue
+            e["tokens"].extend(int(t) for t in toks)
+    return state
+
+
+def consume_journal_dir(dir_path: str) -> None:
+    """Unlink one journal directory's sidecar files (its sessions were
+    absorbed elsewhere — a stale journal must not resurrect them at
+    the next replay). Best-effort, like every journal file op."""
+    for name in (JOURNAL_NAME, SNAPSHOT_NAME):
+        try:
+            os.unlink(os.path.join(dir_path, name))
+        except OSError:
+            pass

@@ -92,6 +92,8 @@ FAULT_EVENTS = {
     "wire_partition": "fault.wire_partition",
     "heartbeat_loss": "fault.heartbeat_loss",
     "mirror_journal_io": "fault.mirror_journal_io",
+    "placement_io": "fault.placement_io",
+    "router_shard_crash": "fault.router_shard_crash",
     "db_io": "fault.db_io",
     "cycle_crash": "fault.cycle_crash",
     "loop_hang": "fault.loop_hang",

@@ -461,6 +461,25 @@ register("ROOM_TPU_POD_MIRROR_COMPACT", "int", "4096",
          "Journal lines past which the supervise tick compacts the "
          "mirror journal into a fresh checksummed snapshot.")
 
+# ---- sharded router tier (docs/podnet.md) ----
+register("ROOM_TPU_ROUTER_SHARDS", "int", "1",
+         "Router shards per fleet: session records, fences, and the "
+         "mirror journal partition by room-id hash across N "
+         "independent router slices fronted by the epoch-versioned "
+         "placement map (1 = the classic single router). N>1 "
+         "journals each shard regardless of ROOM_TPU_POD_MIRROR — "
+         "shard failover is journal adoption.",
+         scope="provider")
+register("ROOM_TPU_ROUTER_LEASE_S", "float", "2.0",
+         "Router-shard ownership lease: a dead router shard's rooms "
+         "shed (retryable) this long before a surviving sibling "
+         "adopts its mirror journal, mints fences +1, and publishes "
+         "a new placement epoch.")
+register("ROOM_TPU_POD_PEERS", "str", None,
+         "','-separated host:port control-wire addresses of peer pod "
+         "members; placement-map epochs publish to each over "
+         "wire_send_control frames (empty = single-process pod).")
+
 # ---- fleet-global shared prefix store (docs/disagg.md) ----
 register("ROOM_TPU_PREFIX_STORE", "bool", "0",
          "Content-addressed shared prefix KV store: replicas/hosts "

@@ -235,9 +235,18 @@ register_lock(
 )
 register_lock(
     "pod_mirror_journal", "MirrorJournal append buffers, line "
-    "counters, and file-handle swap (one per fleet).",
+    "counters, and file-handle swap (one per router shard; a "
+    "single-shard fleet has exactly one).",
     module="room_tpu/serving/podnet.py", cls="MirrorJournal",
     attr="_lock", hints=("journal",), multi_instance=True,
+)
+register_lock(
+    "placement_map", "PlacementMap epoch + shard-redirect table "
+    "(room-id -> router shard; one per fleet, replicated to pod "
+    "peers over control frames).",
+    module="room_tpu/serving/podnet.py", cls="PlacementMap",
+    attr="_lock", hints=("placement", "self.placement"),
+    multi_instance=True,
 )
 register_lock(
     "kv_wire_server", "KVWireServer payload sequence counter + "

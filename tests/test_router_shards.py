@@ -1,0 +1,395 @@
+"""Sharded router tier suite (docs/podnet.md).
+
+CI quick tier (lockdep-armed in the chaos job) for the room-id-
+partitioned router: placement map + epoch fencing, shard crash +
+journal adoption, and the interactions with the mirror cap and the
+shard-count lifecycle:
+
+- PlacementMap unit contract: stable hashing, redirect chains after a
+  rehome, strictly-newer epoch applies, stale-epoch submit refusal.
+- Kill one of two router shards MID-DECODE: zero durably-streamed
+  token loss (every turn token-identical to an unkilled control), the
+  bystander shard's room never stalls, the victim's rooms shed during
+  the lease, and after the sibling adopts the journal a submit (or a
+  replicated frame) carrying the pre-failover epoch is refused — one
+  room, one owner, no fork after a heal.
+- Journal adoption replay: a room whose engine side is gone re-parks
+  from the dead shard's journal and resumes token-identically via
+  re-prefill.
+- Shard-count change N->M across a router crash: every journal is
+  absorbed and sessions re-home onto their hash-current shard.
+- Mirror-cap eviction tombstones are honored ACROSS adoption: the
+  truncated prefix never resurrects, the live engine session still
+  resumes exactly.
+- Single-shard back-compat: flat journal dir, kill refused, the
+  pre-shard surface unchanged.
+- Chaos fault points: ``placement_io`` (dropped publish/apply costs
+  staleness, never a fork) and ``router_shard_crash`` (supervisor
+  kills the busiest shard; adoption heals it).
+"""
+
+import os
+import time
+
+import jax
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+from room_tpu.serving import podnet
+from room_tpu.serving.fleet import EngineFleet
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    podnet.reset_breakers()
+    yield
+    faults.clear()
+    podnet.reset_breakers()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+LONG_PROMPT = list(range(1, 20))
+CONT = [7, 7, 7]
+
+
+def _greedy(n=8):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+@pytest.fixture(scope="module")
+def control(model):
+    """Uninterrupted three-turn reference streams on one engine
+    (greedy => sid-independent)."""
+    cfg, params = model
+    eng = ServingEngine(
+        cfg, params, max_batch=4, page_size=8, n_pages=96,
+        offload=False, stop_token_ids=[],
+    )
+    c1 = eng.submit(LONG_PROMPT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    c2 = eng.submit(CONT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    c3 = eng.submit(CONT, session_id="s", sampling=_greedy())
+    eng.run_until_idle()
+    return list(c1.new_tokens), list(c2.new_tokens), \
+        list(c3.new_tokens)
+
+
+@pytest.fixture()
+def make_fleet(model, monkeypatch, tmp_path):
+    """Fleet factory: sharded router tier armed, lease effectively
+    infinite (tests expire it by hand for deterministic dead
+    windows), journal batch 1 so every streamed token is on disk."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    monkeypatch.setenv("ROOM_TPU_OFFLOAD_DIR", str(tmp_path / "spool"))
+    monkeypatch.setenv("ROOM_TPU_LIFECYCLE_DIR", str(tmp_path / "lc"))
+    monkeypatch.setenv("ROOM_TPU_WIRE_BACKOFF_S", "0.001")
+    monkeypatch.setenv("ROOM_TPU_ROUTER_LEASE_S", "600")
+    monkeypatch.setenv("ROOM_TPU_POD_MIRROR_BATCH", "1")
+    cfg, params = model
+
+    def build_engine(**kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 96)
+        kw.setdefault("offload", True)
+        kw.setdefault("stop_token_ids", [])
+        return ServingEngine(cfg, params, **kw)
+
+    def build(n=2, shards=2, env=None, **kw):
+        monkeypatch.setenv("ROOM_TPU_ROUTER_SHARDS", str(shards))
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        return EngineFleet(
+            "tiny-moe", lambda i: build_engine(**kw), n,
+            auto_rebuild=False,
+        )
+
+    build.engine = build_engine
+    return build
+
+
+def _sids_on_shards(n_shards):
+    """One room id per shard under the stable hash."""
+    pm = podnet.PlacementMap(n_shards)
+    out = {}
+    for i in range(512):
+        sid = f"room-{i}"
+        out.setdefault(pm.shard_of(sid), sid)
+        if len(out) == n_shards:
+            return [out[k] for k in range(n_shards)]
+    raise AssertionError("hash never covered every shard")
+
+
+# ---- placement map unit contract ----
+
+def test_placement_map_contract():
+    pm = podnet.PlacementMap(4)
+    sid = "room-x"
+    assert pm.shard_of(sid) == pm.shard_of(sid)  # stable
+    assert pm.epoch == 0
+    dead = pm.shard_of(sid)
+    adopter = (dead + 1) % 4
+    assert pm.rehome(dead, adopter) == 1
+    assert pm.shard_of(sid) == adopter
+    # a second failover re-points chains INTO the newly dead shard
+    adopter2 = (adopter + 1) % 4
+    assert pm.rehome(adopter, adopter2) == 2
+    assert pm.shard_of(sid) == adopter2
+    # replication: strictly-newer applies, stale refused
+    peer = podnet.PlacementMap(4)
+    frame = pm.frame()
+    assert peer.apply(frame) is True
+    assert peer.epoch == 2
+    assert peer.apply(frame) is False        # same epoch: refused
+    assert peer.snapshot()["stale_applies_refused"] == 1
+    # submit-side fencing
+    assert peer.stale_epoch(None) is False   # pre-epoch submitter
+    assert peer.stale_epoch(1) is True
+    assert peer.stale_epoch(2) is False
+    assert peer.stale_epoch("garbage") is True
+
+
+# ---- shard crash + journal adoption ----
+
+def test_kill_shard_mid_decode_zero_token_loss(make_fleet, control):
+    """Acceptance: killing 1 of 2 router shards mid-decode loses zero
+    durably-streamed tokens, never stalls the bystander shard's room,
+    and refuses pre-failover placement epochs after the heal."""
+    full, cont, cont2 = control
+    fleet = make_fleet(n=2, shards=2)
+    sa, sb = _sids_on_shards(2)
+    t1a = fleet.submit(LONG_PROMPT, session_id=sa,
+                       sampling=_greedy(len(full)))
+    t1b = fleet.submit(LONG_PROMPT, session_id=sb,
+                       sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1a.new_tokens) == full
+    assert list(t1b.new_tokens) == full
+    pre_frame = fleet.placement.frame()
+    pre_epoch = fleet.placement.epoch
+    # the victim shard dies at sa's SECOND streamed token of turn 2
+    seen = {"n": 0}
+
+    def killer(tok):
+        seen["n"] += 1
+        if seen["n"] == 2:
+            assert fleet.kill_router_shard(0, reason="test")
+
+    t2a = fleet.submit(CONT, session_id=sa, sampling=_greedy(len(cont)),
+                       on_token=killer)
+    fleet.run_until_idle()
+    # the engine session was never touched: the in-flight turn streams
+    # to completion token-identically
+    assert list(t2a.new_tokens) == cont
+    assert fleet._shards[0].state == "dead"
+    # dead window: the victim's rooms shed with the 503 contract...
+    probe = fleet.submit(CONT, session_id=sa, sampling=_greedy(3))
+    assert probe.shed and "router shard down" in probe.error
+    # ...while the bystander shard's room streams, unstalled
+    t2b = fleet.submit(CONT, session_id=sb, sampling=_greedy(len(cont)))
+    fleet.run_until_idle()
+    assert not t2b.shed
+    assert list(t2b.new_tokens) == cont
+    # lease expires -> the sibling adopts the journal
+    fleet.router_lease_s = 0.0
+    fleet.supervise()
+    rs = fleet.fleet_stats()["router_shards"]
+    assert rs["adoptions"] == 1
+    assert rs["epoch"] == pre_epoch + 1
+    assert rs["shards"]["0"]["state"] == "retired"
+    assert rs["shards"]["1"]["state"] == "serving"
+    # a healed stale router: its replayed frame and its stale-epoch
+    # submits are both refused — one room, one owner
+    assert fleet.placement.apply(pre_frame) is False
+    stale = fleet.submit(CONT, session_id=sa, sampling=_greedy(3),
+                         placement_epoch=pre_epoch)
+    assert stale.shed and "stale placement epoch" in stale.error
+    assert fleet.fleet_stats()["router_shards"][
+        "placement_refusals"] >= 1
+    # both rooms resume token-identically after adoption
+    t3a = fleet.submit(CONT, session_id=sa, sampling=_greedy(len(cont2)))
+    t3b = fleet.submit(CONT, session_id=sb, sampling=_greedy(len(cont2)))
+    fleet.run_until_idle()
+    assert list(t3a.new_tokens) == cont2
+    assert list(t3b.new_tokens) == cont2
+
+
+def test_adoption_replays_journal_token_identical(make_fleet, control):
+    """A room whose ENGINE side is gone too (the double failure)
+    re-parks from the dead shard's journal and resumes via re-prefill,
+    token-identical to the control."""
+    full, cont, _ = control
+    fleet = make_fleet(n=1, shards=2)
+    sa = _sids_on_shards(2)[0]
+    t1 = fleet.submit(LONG_PROMPT, session_id=sa,
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1.new_tokens) == full
+    handle = fleet._handle(fleet._records[sa].rid)
+    # the engine loses the session (models the engine side of a dead
+    # router PROCESS) without the router seeing a release
+    handle.engine.release_session(sa)
+    handle.engine.run_until_idle()
+    assert sa not in handle.engine.sessions
+    assert fleet.kill_router_shard(0, reason="test")
+    fleet.router_lease_s = 0.0
+    fleet.supervise()
+    rec = fleet._records[sa]
+    assert rec.shard == 1
+    assert rec.rid == "" and rec.pending_entry is not None
+    assert fleet.fleet_stats()["router_shards"][
+        "sessions_adopted"] == 1
+    # the adopting route re-prefills from the journal mirror; greedy
+    # continuation is token-identical
+    t2 = fleet.submit(CONT, session_id=sa, sampling=_greedy(len(cont)))
+    fleet.run_until_idle()
+    assert list(t2.new_tokens) == cont
+
+
+def test_shard_count_change_absorbs_every_journal(make_fleet, control):
+    """Router crash + restart with a DIFFERENT shard count (2 -> 3):
+    every old journal is absorbed and each session re-homes onto its
+    hash-current shard."""
+    full, cont, _ = control
+    fleet1 = make_fleet(n=1, shards=2)
+    sa, sb = _sids_on_shards(2)
+    for sid in (sa, sb):
+        t = fleet1.submit(LONG_PROMPT, session_id=sid,
+                          sampling=_greedy(len(full)))
+        fleet1.run_until_idle()
+        assert list(t.new_tokens) == full
+    # router process crashes: no drain — the journals are all that
+    # survive
+    del fleet1
+    fleet2 = make_fleet(n=1, shards=3)
+    assert fleet2.fleet_stats()["mirror_restored"] == 2
+    pm3 = podnet.PlacementMap(3)
+    for sid in (sa, sb):
+        rec = fleet2._records[sid]
+        assert rec.shard == pm3.shard_of(sid)
+        assert rec.rid == "" and rec.pending_entry is not None
+    for sid in (sa, sb):
+        t = fleet2.submit(CONT, session_id=sid,
+                          sampling=_greedy(len(cont)))
+        fleet2.run_until_idle()
+        assert list(t.new_tokens) == cont
+
+
+def test_eviction_tombstone_honored_across_adoption(
+    make_fleet, control,
+):
+    """A cap-evicted mirror's journal tombstone survives adoption: the
+    truncated prefix never resurrects as a history (warm-only), while
+    the live engine session still resumes token-identically."""
+    full, cont, _ = control
+    fleet = make_fleet(
+        n=2, shards=2,
+        env={"ROOM_TPU_FLEET_MIRROR_TOKENS": "4"},
+    )
+    sa = _sids_on_shards(2)[0]
+    t1 = fleet.submit(LONG_PROMPT, session_id=sa,
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1.new_tokens) == full
+    assert fleet.fleet_stats()["mirror"]["evictions"] >= 1
+    assert fleet.kill_router_shard(0, reason="test")
+    fleet.router_lease_s = 0.0
+    fleet.supervise()
+    rec = fleet._records[sa]
+    assert rec.mirror_dropped and not rec.tokens
+    assert rec.pending_entry is None and rec.rid
+    # the adopter's journal carries the tombstone, not the prefix
+    state = fleet._shards[1].journal.replay()
+    assert sa not in state
+    # the live engine session is the exact resume path
+    t2 = fleet.submit(CONT, session_id=sa, sampling=_greedy(len(cont)))
+    fleet.run_until_idle()
+    assert list(t2.new_tokens) == cont
+    # and a later router restart must NOT restore the evicted room
+    del fleet
+    fleet2 = make_fleet(n=2, shards=2)
+    assert fleet2.fleet_stats()["mirror_restored"] == 0
+
+
+def test_single_shard_back_compat(make_fleet, control):
+    """ROOM_TPU_ROUTER_SHARDS=1 is the classic router: flat journal
+    dir, kill refused (nobody to adopt), pre-shard stats intact."""
+    full, _, _ = control
+    fleet = make_fleet(
+        n=2, shards=1, env={"ROOM_TPU_POD_MIRROR": "1"},
+    )
+    assert fleet.kill_router_shard(0) is False
+    assert os.path.basename(fleet.mirror_journal.dir) == \
+        "router-mirror"
+    t1 = fleet.submit(LONG_PROMPT, session_id="s",
+                      sampling=_greedy(len(full)))
+    fleet.run_until_idle()
+    assert list(t1.new_tokens) == full
+    rs = fleet.fleet_stats()["router_shards"]
+    assert rs["count"] == 1 and rs["serving"] == 1
+    assert rs["epoch"] == 0
+
+
+# ---- chaos fault points ----
+
+def test_placement_io_fault_costs_staleness_never_forks(make_fleet):
+    fleet = make_fleet(n=1, shards=2)
+    # publish side: the dropped frame is counted, peers stay behind
+    faults.inject("placement_io", times=1)
+    assert fleet.pod.publish_placement() == 0
+    assert fleet.pod._stats["placement_publish_drops"] == 1
+    assert faults.fired("placement_io") == 1
+    # apply side: the dropped install refuses, state unchanged
+    faults.inject("placement_io", times=1)
+    frame = {"kind": "placement", "epoch": 5, "redirects": {}}
+    assert fleet.placement.apply(frame) is False
+    assert fleet.placement.epoch == 0
+    faults.clear()
+    # the retransmit (next publish/apply) heals the staleness
+    reply = fleet.pod.handle_control(frame)
+    assert reply["ok"] and reply["applied"]
+    assert fleet.placement.epoch == 5
+
+
+def test_router_shard_crash_fault_point_heals(make_fleet, control):
+    """faults.inject("router_shard_crash") kills the busiest serving
+    shard at the next supervise; the sibling adopts past the lease and
+    every room resumes token-identically."""
+    full, cont, _ = control
+    fleet = make_fleet(n=2, shards=2)
+    sa, sb = _sids_on_shards(2)
+    for sid in (sa, sb):
+        t = fleet.submit(LONG_PROMPT, session_id=sid,
+                         sampling=_greedy(len(full)))
+        fleet.run_until_idle()
+        assert list(t.new_tokens) == full
+    faults.inject("router_shard_crash", times=1)
+    fleet.supervise()
+    assert faults.fired("router_shard_crash") == 1
+    rs = fleet.fleet_stats()["router_shards"]
+    assert rs["crashes"] == 1 and rs["serving"] == 1
+    dead = next(s for s in fleet._shards if s.state == "dead")
+    victim_sid = sa if fleet.placement.shard_of(sa) == \
+        dead.shard_id else sb
+    probe = fleet.submit(CONT, session_id=victim_sid,
+                         sampling=_greedy(3))
+    assert probe.shed
+    fleet.router_lease_s = 0.0
+    deadline = time.monotonic() + 5.0
+    while fleet.fleet_stats()["router_shards"]["adoptions"] < 1:
+        fleet.supervise()
+        assert time.monotonic() < deadline
+    for sid in (sa, sb):
+        t = fleet.submit(CONT, session_id=sid,
+                         sampling=_greedy(len(cont)))
+        fleet.run_until_idle()
+        assert list(t.new_tokens) == cont

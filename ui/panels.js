@@ -1675,6 +1675,34 @@ async function renderTpu(el) {
           </span>
       </div>`).join("")}
       ${Object.entries(hl.engines || {})
+        .filter(([name, e]) =>
+          (e.fleet?.router_shards?.count ?? 1) > 1)
+        .map(([name, e]) => `
+      <h2 style="margin-top:.6rem">router shards (${esc(name)})</h2>
+      <table><tr><th>shard</th><th>state</th><th>rooms</th>
+        <th>journal</th><th>adoptions</th></tr>
+      ${Object.entries(e.fleet.router_shards.shards || {})
+        .map(([sk, s]) => `
+        <tr><td>${esc(sk)}</td>
+        <td><span class="pill ${
+          s.state === "serving" ? "verified"
+          : s.state === "dead" ? "failed" : "pending"
+        }">${esc(s.state)}</span></td>
+        <td>${s.rooms ?? 0}</td>
+        <td class="dim">${s.journal_bytes ?? 0} B</td>
+        <td>${s.adoptions ?? 0}</td></tr>`).join("")}
+      </table>
+      <div class="kv" style="margin-top:.2rem">
+        <span class="k">placement</span>
+          <span>epoch ${e.fleet.router_shards.epoch ?? 0}
+            <span class="dim">(${e.fleet.router_shards.crashes ?? 0}
+              shard crashes,
+              ${e.fleet.router_shards.sessions_adopted ?? 0} sessions
+              adopted,
+              ${e.fleet.router_shards.placement_refusals ?? 0}
+              stale-epoch refusals)</span></span>
+      </div>`).join("")}
+      ${Object.entries(hl.engines || {})
         .filter(([name, e]) => e.fleet?.pod?.enabled)
         .map(([name, e]) => `
       <div class="kv" style="margin-top:.4rem">
