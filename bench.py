@@ -1323,6 +1323,291 @@ def main() -> None:
         except Exception as e:
             _phase("router_failover", {"error": str(e)[:300]})
 
+    # Swarm-shard storm (docs/swarmshard.md): 100+ rooms drive
+    # journaled queen turns plus cross-room messages through the
+    # room-partitioned swarm runtime, 1-shard vs 4-shard A/B on the
+    # same workload. Each CPU-proxy queen turn is everything a real
+    # cycle writes EXCEPT the model forward: journal started +
+    # provider_call + a journaled effect + close, then one
+    # message_send to another room. The sharded arm additionally eats
+    # a mid-storm shard crash + sibling adoption and a duplicate
+    # redispatch wave. Acceptance: 4-shard throughput beats 1-shard,
+    # zero messages lost, zero double-fired effects.
+    def measure_swarm_storm(n_shards: int) -> dict:
+        import shutil
+        import tempfile
+        import threading as _threading
+
+        from room_tpu.core import journal as journal_mod
+        from room_tpu.swarm import SwarmRouter
+
+        n_rooms = int(
+            os.environ.get("ROOM_TPU_BENCH_SWARM_ROOMS", "112")
+        )
+        cycles = int(
+            os.environ.get("ROOM_TPU_BENCH_SWARM_CYCLES", "4")
+        )
+        n_threads = 8
+        tmp = tempfile.mkdtemp(prefix=f"bench-swarm{n_shards}-")
+        prev_stats = os.environ.get("ROOM_TPU_DB_LOCK_STATS")
+        os.environ["ROOM_TPU_DB_LOCK_STATS"] = "1"
+        router = None
+        try:
+            router = SwarmRouter(
+                n_shards=n_shards, db_dir=tmp, lease_s=0.0,
+            )
+            rids = [
+                router.create_room(f"storm-{i}")["id"]
+                for i in range(n_rooms)
+            ]
+            # recall corpus: each room carries ~32 KB of notes, so a
+            # turn's memory-recall scan reads the WHOLE shard file —
+            # the per-shard working set (and the scan) shrinks with
+            # the shard count, which is half the point of partitioning
+            seed_body = "lorem swarm recall corpus " * 80
+            for rid in rids:
+                db = router.db_for(rid)
+                with db.transaction():
+                    for k in range(16):
+                        db.execute(
+                            "INSERT INTO room_messages(room_id, "
+                            "direction, subject, body) VALUES "
+                            "(?,'outbound',?,?)",
+                            (rid, f"note {k}",
+                             f"{seed_body} {rid} {k}"),
+                        )
+            sent: list[str] = []
+            turn_s: list[float] = []
+            shed = {"n": 0}
+
+            def one_turn(i: int, turn: int) -> None:
+                """One CPU-proxy queen turn: everything a real cycle
+                does around the model forward — memory-recall scan
+                (context assembly), one journal transaction (started,
+                provider_call, journaled effect, close), one
+                message_send to another room."""
+                rid = rids[i]
+                db = router.db_for(rid)
+                ref = rid * 10_000 + turn
+                t0 = time.perf_counter()
+                db.query_one(
+                    "SELECT COUNT(*) AS n, SUM(LENGTH(body)) AS b "
+                    "FROM room_messages WHERE body LIKE ?",
+                    (f"%recall corpus%{turn}%",),
+                )
+                with db.transaction():
+                    journal_mod.record_started(
+                        db, "cycle", ref, room_id=rid,
+                    )
+                    journal_mod.record_provider_call(
+                        db, "cycle", ref,
+                        journal_mod.effect_key(
+                            "cycle", rid, "turn", {"turn": turn}
+                        ),
+                        room_id=rid,
+                    )
+                    journal_mod.run_journaled_effect(
+                        db, "cycle", ref, rid, None, "storm_note",
+                        {"rid": rid, "turn": turn}, lambda: "noted",
+                    )
+                    journal_mod.record_finished(db, "cycle", ref)
+                subject = f"storm {i}:{turn}"
+                router.send_message(
+                    rid, rids[(i + 17) % n_rooms], subject,
+                    f"turn {turn} of room {rid}",
+                )
+                turn_s.append(time.perf_counter() - t0)
+                sent.append(subject)
+
+            def redispatch(i: int, turn: int) -> None:
+                """Byte-identical duplicate of an already-delivered
+                send (a healed caller replaying) — the journal's
+                content-derived key must swallow it."""
+                router.send_message(
+                    rids[i], rids[(i + 17) % n_rooms],
+                    f"storm {i}:{turn}",
+                    f"turn {turn} of room {rids[i]}",
+                )
+
+            def storm(turns, crash_at=None) -> float:
+                idx = {"n": 0}
+                fails: list[tuple[int, int]] = []
+                lock = _threading.Lock()
+
+                def work():
+                    while True:
+                        with lock:
+                            k = idx["n"]
+                            if k >= len(turns):
+                                return
+                            idx["n"] = k + 1
+                        i, turn = turns[k]
+                        try:
+                            one_turn(i, turn)
+                        except Exception:
+                            shed["n"] += 1
+                            with lock:
+                                fails.append((i, turn))
+
+                t0 = time.perf_counter()
+                threads = [
+                    _threading.Thread(target=work, daemon=True)
+                    for _ in range(n_threads)
+                ]
+                for t in threads:
+                    t.start()
+                if crash_at is not None:
+                    while True:
+                        with lock:
+                            if idx["n"] >= crash_at:
+                                break
+                        time.sleep(0.002)
+                    victim = max(
+                        (s for s in router.shards
+                         if s.state == "serving"),
+                        key=lambda s: s.stats["rooms_created"],
+                    )
+                    router.kill_shard(
+                        victim.shard_id, reason="bench storm"
+                    )
+                    router.adopt_dead_shards()
+                for t in threads:
+                    t.join()
+                # whatever the crash window shed is replayed whole:
+                # recovery flagged the committed halves, so the
+                # journal swallows them and only the missing work
+                # fires
+                for i, turn in fails:
+                    one_turn(i, turn)
+                return time.perf_counter() - t0
+
+            # timed clean section — the A/B numbers
+            clean = [
+                (i, t) for t in range(cycles) for i in range(n_rooms)
+            ]
+            elapsed = storm(clean)
+            tput = round(len(clean) / max(elapsed, 1e-9), 1)
+            ordered = sorted(turn_s)
+            p50_ms = round(
+                ordered[len(ordered) // 2] * 1e3, 3
+            ) if ordered else None
+            p95_ms = round(
+                ordered[int(len(ordered) * 0.95)] * 1e3, 3
+            ) if ordered else None
+            lock_waits = sum(db.lock_waits for db in router.all_dbs())
+            lock_wait_s = round(
+                sum(db.lock_wait_s for db in router.all_dbs()), 4
+            )
+            # chaos section (untimed, multi-shard only): crash a
+            # shard mid-storm, adopt, replay, then a duplicate
+            # redispatch wave
+            if n_shards > 1:
+                chaos = [
+                    (i, cycles + t) for t in range(2)
+                    for i in range(n_rooms)
+                ]
+                storm(chaos, crash_at=len(chaos) // 2)
+                for i, turn in [
+                    (k % n_rooms, cycles + (k % 2))
+                    for k in range(25)
+                ]:
+                    redispatch(i, turn)
+            # exactly-once accounting across every shard file: each
+            # logical subject must land exactly one inbound row
+            delivered: dict[str, int] = {}
+            for db in router.all_dbs():
+                for row in db.query(
+                    "SELECT subject, COUNT(*) AS n FROM room_messages "
+                    "WHERE direction='inbound' AND "
+                    "subject LIKE 'storm %' GROUP BY subject"
+                ):
+                    delivered[row["subject"]] = (
+                        delivered.get(row["subject"], 0) + row["n"]
+                    )
+            unique_sent = set(sent)
+            lost = sum(
+                1 for s in unique_sent if delivered.get(s, 0) == 0
+            )
+            double_fired = sum(
+                n - 1 for n in delivered.values() if n > 1
+            )
+            snap = router.snapshot()
+            if CPU_PROXY and n_shards == 1:
+                _proxy_deltas["swarm_storm_1shard_tput"] = tput
+            return {
+                "n_shards": n_shards,
+                "rooms": n_rooms,
+                "turns_timed": len(clean),
+                "cycle_tput_per_s": tput,
+                "queen_turn_p50_ms": p50_ms,
+                "queen_turn_p95_ms": p95_ms,
+                "journal_lock_waits": lock_waits,
+                "journal_lock_wait_s": lock_wait_s,
+                "messages_sent": len(unique_sent),
+                "messages_lost": lost,
+                "double_fired": double_fired,
+                "shed_turns": shed["n"],
+                "dedup_skips": snap["dedup_skips"],
+                "shard_crashes": snap["shard_crashes"],
+                "adoptions": snap["adoptions"],
+                "placement_epoch": snap["placement"]["epoch"],
+            }
+        finally:
+            if router is not None:
+                router.close()
+            if prev_stats is None:
+                os.environ.pop("ROOM_TPU_DB_LOCK_STATS", None)
+            else:
+                os.environ["ROOM_TPU_DB_LOCK_STATS"] = prev_stats
+            del router
+            gc.collect()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    if os.environ.get("ROOM_TPU_BENCH_SWARM", "1") != "0":
+        _extend_deadline()
+        one_shard = None
+        try:
+            one_shard = measure_swarm_storm(1)
+            _phase("swarm_storm_1shard", one_shard)
+        except Exception as e:
+            _phase("swarm_storm_1shard", {"error": str(e)[:300]})
+        _extend_deadline()
+        try:
+            four_shard = measure_swarm_storm(4)
+            _phase("swarm_storm_4shard", four_shard)
+            if one_shard and "cycle_tput_per_s" in one_shard:
+                speedup = round(
+                    four_shard["cycle_tput_per_s"]
+                    / max(one_shard["cycle_tput_per_s"], 1e-9), 3,
+                )
+                if CPU_PROXY:
+                    _proxy_deltas["swarm_storm_speedup"] = speedup
+                _phase("swarm_storm_ab", {
+                    # acceptance: speedup > 1.0, zero lost, zero
+                    # double-fired — asserted by the CI smoke
+                    "tput_1shard": one_shard["cycle_tput_per_s"],
+                    "tput_4shard": four_shard["cycle_tput_per_s"],
+                    "speedup": speedup,
+                    "lock_waits_1shard":
+                        one_shard["journal_lock_waits"],
+                    "lock_waits_4shard":
+                        four_shard["journal_lock_waits"],
+                    "queen_turn_p50_ms_1shard":
+                        one_shard["queen_turn_p50_ms"],
+                    "queen_turn_p50_ms_4shard":
+                        four_shard["queen_turn_p50_ms"],
+                    "messages_lost":
+                        one_shard["messages_lost"]
+                        + four_shard["messages_lost"],
+                    "double_fired":
+                        one_shard["double_fired"]
+                        + four_shard["double_fired"],
+                    "shard_crashes": four_shard["shard_crashes"],
+                    "adoptions": four_shard["adoptions"],
+                })
+        except Exception as e:
+            _phase("swarm_storm_4shard", {"error": str(e)[:300]})
+
     # Disaggregated prefill/decode A/B (docs/disagg.md): a burst of
     # 2k-token prompts against (a) a mixed fleet — every replica eats
     # prefill chunks between its decode windows — and (b) a

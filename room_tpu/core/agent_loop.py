@@ -88,18 +88,43 @@ class LoopHandle:
         default_factory=lambda: time.monotonic() + LOOP_HANG_S
     )
     crash_error: Optional[str] = None
+    # the supervision domain this loop is registered in (None = the
+    # process default; swarm shards pass their own)
+    domain: Optional["LoopDomain"] = None
 
 
-_running_loops: dict[int, LoopHandle] = {}
-_launched_rooms: set[int] = set()
-_registry_lock = locks.make_lock("agent_registry")
+class LoopDomain:
+    """One agent-loop supervision domain: loop registry, room launch
+    roster, crash-strike history, unhealthy roster, and the restart
+    counters — everything supervise_loops arbitrates over. The classic
+    single-runtime process uses the module default; each swarm shard
+    (docs/swarmshard.md) owns a private domain, so one shard's crash
+    storm, hang replacements, or budget lockouts never bleed into a
+    sibling shard's supervision."""
 
-# crash-strike history + unhealthy roster for supervise_loops
-_supervision_lock = locks.make_lock("agent_supervision")
-_strikes: dict[int, deque] = {}
-_unhealthy: dict[int, dict] = {}
-_supervision_counts = {"restarts": 0, "hang_replacements": 0,
+    def __init__(self) -> None:
+        self._registry_lock = locks.make_lock("agent_registry")
+        self._supervision_lock = locks.make_lock("agent_supervision")
+        self.loops: dict[int, LoopHandle] = {}
+        self.launched_rooms: set[int] = set()
+        self.strikes: dict[int, deque] = {}
+        self.unhealthy: dict[int, dict] = {}
+        self.counts = {"restarts": 0, "hang_replacements": 0,
                        "crashes": 0, "budget_exhausted": 0}
+
+
+_DEFAULT_DOMAIN = LoopDomain()
+
+# Back-compat aliases: the default domain's state under the classic
+# module-level names. Same objects — mutations through either name are
+# seen by both — so pre-domain call sites and tests keep working.
+_running_loops = _DEFAULT_DOMAIN.loops
+_launched_rooms = _DEFAULT_DOMAIN.launched_rooms
+_registry_lock = _DEFAULT_DOMAIN._registry_lock
+_supervision_lock = _DEFAULT_DOMAIN._supervision_lock
+_strikes = _DEFAULT_DOMAIN.strikes
+_unhealthy = _DEFAULT_DOMAIN.unhealthy
+_supervision_counts = _DEFAULT_DOMAIN.counts
 
 
 def _incr(name: str, n: int = 1) -> None:
@@ -109,29 +134,38 @@ def _incr(name: str, n: int = 1) -> None:
 
 
 def _owns_registry_entry(handle: LoopHandle) -> bool:
-    with _registry_lock:
-        return _running_loops.get(handle.worker_id) is handle
+    dom = handle.domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
+        return dom.loops.get(handle.worker_id) is handle
 
 
 # ---- lifecycle ----
 
-def set_room_launch_enabled(room_id: int, enabled: bool) -> None:
-    with _registry_lock:
+def set_room_launch_enabled(
+    room_id: int, enabled: bool,
+    domain: Optional[LoopDomain] = None,
+) -> None:
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
         if enabled:
-            _launched_rooms.add(room_id)
+            dom.launched_rooms.add(room_id)
         else:
-            _launched_rooms.discard(room_id)
+            dom.launched_rooms.discard(room_id)
 
 
-def is_room_launched(room_id: int) -> bool:
-    with _registry_lock:
-        return room_id in _launched_rooms
+def is_room_launched(
+    room_id: int, domain: Optional[LoopDomain] = None
+) -> bool:
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
+        return room_id in dom.launched_rooms
 
 
-def running_workers() -> list[int]:
-    with _registry_lock:
+def running_workers(domain: Optional[LoopDomain] = None) -> list[int]:
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
         return [
-            wid for wid, h in _running_loops.items()
+            wid for wid, h in dom.loops.items()
             if h.thread is not None and h.thread.is_alive()
         ]
 
@@ -147,14 +181,16 @@ def _locked_out_handle(worker_id: int, room_id: int) -> LoopHandle:
 
 
 def start_agent_loop(
-    db: Database, room_id: int, worker_id: int
+    db: Database, room_id: int, worker_id: int,
+    domain: Optional[LoopDomain] = None,
 ) -> LoopHandle:
-    with _supervision_lock:
-        locked_out = worker_id in _unhealthy
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._supervision_lock:
+        locked_out = worker_id in dom.unhealthy
     if locked_out:
         return _locked_out_handle(worker_id, room_id)
-    with _registry_lock:
-        existing = _running_loops.get(worker_id)
+    with dom._registry_lock:
+        existing = dom.loops.get(worker_id)
         if (
             existing
             and existing.thread
@@ -175,25 +211,25 @@ def start_agent_loop(
         # any replacement runs. Wake paths (inbox poll, webhooks,
         # delegation) used to replace the corpse silently, bypassing
         # all three.
-        supervise_loops(db)
-        with _registry_lock:
-            replacement = _running_loops.get(worker_id)
+        supervise_loops(db, domain=dom)
+        with dom._registry_lock:
+            replacement = dom.loops.get(worker_id)
         if replacement is not None:
             return replacement
-        with _supervision_lock:
-            if worker_id in _unhealthy:
+        with dom._supervision_lock:
+            if worker_id in dom.unhealthy:
                 return _locked_out_handle(worker_id, room_id)
         # supervision declined to restart (room stopped/gone): fall
         # through and let the normal path re-check the room state
-    with _registry_lock:
+    with dom._registry_lock:
         # re-check under the lock: between the first check and here a
         # concurrent wake path may have registered a live loop (two
         # threads for one worker would cycle unsupervised forever), or
         # supervision may have locked the worker out
-        with _supervision_lock:
-            if worker_id in _unhealthy:
+        with dom._supervision_lock:
+            if worker_id in dom.unhealthy:
                 return _locked_out_handle(worker_id, room_id)
-        existing = _running_loops.get(worker_id)
+        existing = dom.loops.get(worker_id)
         if (
             existing
             and existing.thread
@@ -204,8 +240,9 @@ def start_agent_loop(
             return existing
         # a stopping handle is as good as dead: replace it (the old
         # thread only deletes the registry entry if it is still its own)
-        handle = LoopHandle(worker_id=worker_id, room_id=room_id)
-        _running_loops[worker_id] = handle
+        handle = LoopHandle(worker_id=worker_id, room_id=room_id,
+                            domain=dom)
+        dom.loops[worker_id] = handle
     handle.thread = threading.Thread(
         target=_loop_main, args=(db, handle), daemon=True,
         name=f"agent-loop-{worker_id}",
@@ -219,18 +256,22 @@ def trigger_agent(
     room_id: int,
     worker_id: int,
     allow_cold_start: bool = False,
+    domain: Optional[LoopDomain] = None,
 ) -> Optional[LoopHandle]:
     """Wake a sleeping loop, or start one (reference: triggerAgent:266)."""
     if allow_cold_start:
-        set_room_launch_enabled(room_id, True)
-    if not is_room_launched(room_id):
+        set_room_launch_enabled(room_id, True, domain=domain)
+    if not is_room_launched(room_id, domain=domain):
         return None
-    return start_agent_loop(db, room_id, worker_id)
+    return start_agent_loop(db, room_id, worker_id, domain=domain)
 
 
-def pause_agent(worker_id: int) -> bool:
-    with _registry_lock:
-        handle = _running_loops.get(worker_id)
+def pause_agent(
+    worker_id: int, domain: Optional[LoopDomain] = None
+) -> bool:
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
+        handle = dom.loops.get(worker_id)
     if handle is None:
         return False
     handle.stop.set()
@@ -238,11 +279,14 @@ def pause_agent(worker_id: int) -> bool:
     return True
 
 
-def stop_worker_loop(worker_id: int) -> bool:
+def stop_worker_loop(
+    worker_id: int, domain: Optional[LoopDomain] = None
+) -> bool:
     """Stop one worker's loop thread (reference: per-worker stop route
     routes/workers.ts)."""
-    with _registry_lock:
-        handle = _running_loops.get(worker_id)
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
+        handle = dom.loops.get(worker_id)
     if handle is None:
         return False
     handle.stop.set()
@@ -250,12 +294,16 @@ def stop_worker_loop(worker_id: int) -> bool:
     return True
 
 
-def stop_room_loops(db: Database, room_id: int, reason: str = "") -> int:
-    set_room_launch_enabled(room_id, False)
+def stop_room_loops(
+    db: Database, room_id: int, reason: str = "",
+    domain: Optional[LoopDomain] = None,
+) -> int:
+    dom = domain or _DEFAULT_DOMAIN
+    set_room_launch_enabled(room_id, False, domain=dom)
     n = 0
-    with _registry_lock:
+    with dom._registry_lock:
         handles = [
-            h for h in _running_loops.values() if h.room_id == room_id
+            h for h in dom.loops.values() if h.room_id == room_id
         ]
     for h in handles:
         h.stop.set()
@@ -264,9 +312,24 @@ def stop_room_loops(db: Database, room_id: int, reason: str = "") -> int:
     return n
 
 
+def stop_domain_loops(domain: LoopDomain) -> int:
+    """Stop every loop in a domain without touching its launch roster —
+    the swarm shard crash path (SwarmRouter.kill_shard): the dead
+    shard's threads must die, but the rooms stay launch-enabled so the
+    adopter can restart them in its own domain."""
+    with domain._registry_lock:
+        handles = list(domain.loops.values())
+    for h in handles:
+        h.stop.set()
+        h.wake.set()
+    return len(handles)
+
+
 # ---- loop-thread supervision (docs/swarm_recovery.md) ----
 
-def supervise_loops(db: Database) -> dict:
+def supervise_loops(
+    db: Database, domain: Optional[LoopDomain] = None
+) -> dict:
     """Detect dead or hung loop threads and restart them under the
     restart budget; past budget, mark the worker unhealthy and escalate
     to the keeper. Called from the server runtime's supervision tick
@@ -276,10 +339,11 @@ def supervise_loops(db: Database) -> dict:
     LOOP_RESTART_WINDOW_S count against LOOP_RESTART_BUDGET; a budget
     breach is terminal until the keeper restarts the room (which resets
     the budget via reset_supervision)."""
+    dom = domain or _DEFAULT_DOMAIN
     actions = {"restarted": [], "replaced_hung": [], "unhealthy": []}
     now = time.monotonic()
-    with _registry_lock:
-        snapshot = list(_running_loops.values())
+    with dom._registry_lock:
+        snapshot = list(dom.loops.values())
     for h in snapshot:
         if h.thread is None:
             continue
@@ -296,9 +360,9 @@ def supervise_loops(db: Database) -> dict:
         if h.stop.is_set():
             if dead:
                 # crashed mid-shutdown: just drop the stale entry
-                with _registry_lock:
-                    if _running_loops.get(h.worker_id) is h:
-                        del _running_loops[h.worker_id]
+                with dom._registry_lock:
+                    if dom.loops.get(h.worker_id) is h:
+                        del dom.loops[h.worker_id]
             continue
         if not dead and not hung:
             continue
@@ -310,13 +374,13 @@ def supervise_loops(db: Database) -> dict:
             room = rooms_mod.get_room(db, h.room_id)
         except Exception:
             continue  # db unavailable; retry next tick
-        with _registry_lock:
+        with dom._registry_lock:
             # claim the corpse exactly once: the supervision tick and a
             # wake-path start_agent_loop may both be supervising
             already_claimed = h.stop.is_set()
             h.stop.set()
-            if _running_loops.get(h.worker_id) is h:
-                del _running_loops[h.worker_id]
+            if dom.loops.get(h.worker_id) is h:
+                del dom.loops[h.worker_id]
         h.wake.set()
         if already_claimed:
             continue
@@ -333,12 +397,14 @@ def supervise_loops(db: Database) -> dict:
         if (
             worker is None or room is None
             or room["status"] != "active"
-            or not is_room_launched(h.room_id)
+            or not is_room_launched(h.room_id, domain=dom)
         ):
             continue
 
-        with _supervision_lock:
-            strikes = _strikes.setdefault(h.worker_id, deque(maxlen=32))
+        with dom._supervision_lock:
+            strikes = dom.strikes.setdefault(
+                h.worker_id, deque(maxlen=32)
+            )
             strikes.append(now)
             recent = sum(
                 1 for t in strikes if now - t < LOOP_RESTART_WINDOW_S
@@ -347,9 +413,9 @@ def supervise_loops(db: Database) -> dict:
             detail = h.crash_error or (
                 f"hung for >{LOOP_HANG_S:g}s" if hung else "thread died"
             )
-            with _supervision_lock:
-                _supervision_counts["budget_exhausted"] += 1
-                _unhealthy[h.worker_id] = {
+            with dom._supervision_lock:
+                dom.counts["budget_exhausted"] += 1
+                dom.unhealthy[h.worker_id] = {
                     "room_id": h.room_id,
                     "error": detail,
                     "strikes": recent,
@@ -359,8 +425,8 @@ def supervise_loops(db: Database) -> dict:
             # close the race with a wake path that slipped a fresh loop
             # in between the corpse claim and the lockout insertion
             # above: anything registered for this worker now dies
-            with _registry_lock:
-                raced = _running_loops.pop(h.worker_id, None)
+            with dom._registry_lock:
+                raced = dom.loops.pop(h.worker_id, None)
             if raced is not None:
                 raced.stop.set()
                 raced.wake.set()
@@ -384,11 +450,11 @@ def supervise_loops(db: Database) -> dict:
             actions["unhealthy"].append(h.worker_id)
             continue
 
-        start_agent_loop(db, h.room_id, h.worker_id)
-        with _supervision_lock:
-            _supervision_counts["restarts"] += 1
+        start_agent_loop(db, h.room_id, h.worker_id, domain=dom)
+        with dom._supervision_lock:
+            dom.counts["restarts"] += 1
             if hung:
-                _supervision_counts["hang_replacements"] += 1
+                dom.counts["hang_replacements"] += 1
         _incr("loop.restarts")
         if hung:
             _incr("loop.hang_replacements")
@@ -402,30 +468,34 @@ def supervise_loops(db: Database) -> dict:
     return actions
 
 
-def reset_supervision(worker_ids) -> None:
+def reset_supervision(
+    worker_ids, domain: Optional[LoopDomain] = None
+) -> None:
     """Forget crash strikes and unhealthy status for these workers —
     called when the keeper restarts a room, so a deliberate restart
     re-arms the full budget."""
-    with _supervision_lock:
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._supervision_lock:
         for wid in worker_ids:
-            _strikes.pop(wid, None)
-            _unhealthy.pop(wid, None)
+            dom.strikes.pop(wid, None)
+            dom.unhealthy.pop(wid, None)
 
 
-def supervision_snapshot() -> dict:
+def supervision_snapshot(domain: Optional[LoopDomain] = None) -> dict:
     """Swarm-loop health for /api/tpu/health and the TPU panel."""
-    with _registry_lock:
+    dom = domain or _DEFAULT_DOMAIN
+    with dom._registry_lock:
         alive = sum(
-            1 for h in _running_loops.values()
+            1 for h in dom.loops.values()
             if h.thread is not None and h.thread.is_alive()
         )
-    with _supervision_lock:
+    with dom._supervision_lock:
         return {
             "loops_alive": alive,
             "unhealthy_workers": {
-                str(k): dict(v) for k, v in _unhealthy.items()
+                str(k): dict(v) for k, v in dom.unhealthy.items()
             },
-            **dict(_supervision_counts),
+            **dict(dom.counts),
         }
 
 
@@ -437,13 +507,14 @@ def _loop_main(db: Database, handle: LoopHandle) -> None:
     supervise_loops can find the corpse and restart under budget (a
     dead thread silently unregistering itself is exactly the failure
     mode this PR removes)."""
+    dom = handle.domain or _DEFAULT_DOMAIN
     try:
         _loop(db, handle)
     except Exception as e:
         handle.crash_error = f"{type(e).__name__}: {e}"
         handle.state = "crashed"
-        with _supervision_lock:
-            _supervision_counts["crashes"] += 1
+        with dom._supervision_lock:
+            dom.counts["crashes"] += 1
         _incr("loop.crashes")
         event_bus.emit(
             "loop:crashed", f"room:{handle.room_id}",
@@ -454,6 +525,7 @@ def _loop_main(db: Database, handle: LoopHandle) -> None:
 def _loop(db: Database, handle: LoopHandle) -> None:
     import sqlite3
 
+    dom = handle.domain or _DEFAULT_DOMAIN
     while not handle.stop.is_set():
         handle.beat = time.monotonic()
         handle.expect_by = handle.beat + LOOP_HANG_S
@@ -464,7 +536,9 @@ def _loop(db: Database, handle: LoopHandle) -> None:
             break  # database closed underneath us: shutdown in progress
         if worker is None or room is None:
             break
-        if room["status"] != "active" or not is_room_launched(room["id"]):
+        if room["status"] != "active" or not is_room_launched(
+            room["id"], domain=dom
+        ):
             break
 
         if _in_quiet_hours(room):
@@ -514,10 +588,10 @@ def _loop(db: Database, handle: LoopHandle) -> None:
     handle.state = "stopped"
     # a hung loop that supervision already replaced must not clobber
     # its successor's registry entry or the worker's agent_state
-    with _registry_lock:
-        own = _running_loops.get(handle.worker_id) is handle
+    with dom._registry_lock:
+        own = dom.loops.get(handle.worker_id) is handle
         if own:
-            del _running_loops[handle.worker_id]
+            del dom.loops[handle.worker_id]
     if own:
         try:
             workers_mod.set_agent_state(db, handle.worker_id, "stopped")

@@ -32,23 +32,43 @@ def create_room(
     queen_cycle_gap_ms: int = QUEEN_CYCLE_GAP_MS_DEFAULT,
     config: Optional[RoomConfig] = None,
     create_wallet: bool = True,
+    room_id: Optional[int] = None,
 ) -> dict:
-    """Create room + queen + root goal (+ wallet). Returns the room row."""
+    """Create room + queen + root goal (+ wallet). Returns the room row.
+
+    ``room_id`` pins an explicit id instead of the file's AUTOINCREMENT
+    sequence — the swarm shard router allocates ids from a swarm-global
+    counter so a room's id (and hence its placement hash) is unique
+    across every shard file (docs/swarmshard.md)."""
     from . import goals as goals_mod
     from . import wallet as wallet_mod
     from .workers import create_worker
 
     with db.transaction():
-        room_id = db.insert(
-            "INSERT INTO rooms(name, goal, worker_model, queen_cycle_gap_ms, "
-            "queen_max_turns, config, webhook_token) VALUES (?,?,?,?,?,?,?)",
-            (
-                name, goal, worker_model, queen_cycle_gap_ms,
-                QUEEN_MAX_TURNS_DEFAULT,
-                json.dumps((config or RoomConfig()).to_json()),
-                secrets.token_urlsafe(24),
-            ),
-        )
+        if room_id is None:
+            room_id = db.insert(
+                "INSERT INTO rooms(name, goal, worker_model, "
+                "queen_cycle_gap_ms, queen_max_turns, config, "
+                "webhook_token) VALUES (?,?,?,?,?,?,?)",
+                (
+                    name, goal, worker_model, queen_cycle_gap_ms,
+                    QUEEN_MAX_TURNS_DEFAULT,
+                    json.dumps((config or RoomConfig()).to_json()),
+                    secrets.token_urlsafe(24),
+                ),
+            )
+        else:
+            db.insert(
+                "INSERT INTO rooms(id, name, goal, worker_model, "
+                "queen_cycle_gap_ms, queen_max_turns, config, "
+                "webhook_token) VALUES (?,?,?,?,?,?,?,?)",
+                (
+                    room_id, name, goal, worker_model,
+                    queen_cycle_gap_ms, QUEEN_MAX_TURNS_DEFAULT,
+                    json.dumps((config or RoomConfig()).to_json()),
+                    secrets.token_urlsafe(24),
+                ),
+            )
         queen_id = create_worker(
             db,
             name=f"{name} Queen",

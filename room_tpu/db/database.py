@@ -14,11 +14,12 @@ import os
 import sqlite3
 import sys
 import threading
+import time
 from contextlib import contextmanager
 from datetime import datetime, timezone
 from typing import Any, Iterator, Optional
 
-from .schema import SCHEMA, SCHEMA_VERSION
+from .schema import MIGRATION_V3, SCHEMA, SCHEMA_VERSION
 from ..utils import knobs, locks
 
 # Ordered (version, ddl) pairs applied after the base schema. Version 1 is
@@ -29,6 +30,10 @@ MIGRATIONS: list[tuple[int, str]] = [
     # creates the table on pre-v2 databases, so the body is empty: the
     # stamp records the shape change without duplicating DDL here.
     (2, ""),
+    # v3: admit kind='xshard' (cross-shard dispatch journal entries,
+    # docs/swarmshard.md). A CHECK can't be widened in place, so pre-v3
+    # files get the rename/recreate/copy rebuild.
+    (3, MIGRATION_V3),
 ]
 
 
@@ -64,6 +69,13 @@ class Database:
         self.path = path
         self._lock = locks.make_rlock("db")
         self._txn_depth = 0
+        # opt-in contention probe (ROOM_TPU_DB_LOCK_STATS): the
+        # swarm_storm bench reads these to compare journal-write
+        # contention 1-shard vs N-shard; counters are mutated under
+        # the db lock itself, so no extra lock is needed
+        self._track_contention = knobs.get_bool("ROOM_TPU_DB_LOCK_STATS")
+        self.lock_waits = 0
+        self.lock_wait_s = 0.0
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None
         )
@@ -112,9 +124,29 @@ class Database:
 
     # -- statement helpers ----------------------------------------------
 
+    @contextmanager
+    def _guard(self) -> Iterator[None]:
+        """The connection lock, with the opt-in contention probe: a
+        contended acquire is counted and timed (a per-shard writer's
+        queueing delay IS the single-writer bottleneck the swarm shard
+        tier exists to split)."""
+        if self._track_contention and not self._lock.acquire(
+            blocking=False
+        ):
+            t0 = time.perf_counter()
+            self._lock.acquire()
+            self.lock_waits += 1
+            self.lock_wait_s += time.perf_counter() - t0
+        elif not self._track_contention:
+            self._lock.acquire()
+        try:
+            yield
+        finally:
+            self._lock.release()
+
     def execute(self, sql: str, params: tuple | dict = ()) -> sqlite3.Cursor:
         _maybe_db_fault()
-        with self._lock:
+        with self._guard():
             return self._conn.execute(sql, params)
 
     def insert(self, sql: str, params: tuple | dict = ()) -> int:
@@ -125,19 +157,19 @@ class Database:
         insert. Upsert callers must re-select the id instead.
         """
         _maybe_db_fault()
-        with self._lock:
+        with self._guard():
             return int(self._conn.execute(sql, params).lastrowid or 0)
 
     def query(self, sql: str, params: tuple | dict = ()) -> list[dict[str, Any]]:
         _maybe_db_fault()
-        with self._lock:
+        with self._guard():
             return [dict(r) for r in self._conn.execute(sql, params).fetchall()]
 
     def query_one(
         self, sql: str, params: tuple | dict = ()
     ) -> Optional[dict[str, Any]]:
         _maybe_db_fault()
-        with self._lock:
+        with self._guard():
             row = self._conn.execute(sql, params).fetchone()
             return dict(row) if row is not None else None
 
@@ -148,7 +180,7 @@ class Database:
         Re-entrant: nested calls become savepoints, so an inner rollback
         only unwinds the inner scope.
         """
-        with self._lock:
+        with self._guard():
             if self._txn_depth == 0:
                 begin, commit, rollback = (
                     "BEGIN IMMEDIATE", "COMMIT", "ROLLBACK"
@@ -191,8 +223,17 @@ def default_db_path() -> str:
     return os.path.join(data_dir, "data.db")
 
 
-def get_database() -> Database:
-    """Process-wide singleton opened on first use."""
+def get_database(room_id: Optional[int] = None) -> Database:
+    """Process-wide singleton — or, with ``ROOM_TPU_SWARM_SHARDS`` > 1,
+    the room-id-keyed shard resolver (docs/swarmshard.md): ``room_id``
+    selects the owning shard's database file, ``None`` resolves to
+    shard 0 (which carries the swarm-global tables). The classic path
+    costs one knob read; the swarm package is only imported once
+    sharding is actually configured."""
+    if knobs.get_int("ROOM_TPU_SWARM_SHARDS") > 1:
+        from ..swarm import shard as swarm_shard
+
+        return swarm_shard.default_router().db_for(room_id)
     global _default_db
     with _default_lock:
         if _default_db is None:
@@ -201,9 +242,14 @@ def get_database() -> Database:
 
 
 def reset_database_singleton() -> None:
-    """Testing hook: drop the singleton so the next get_database() reopens."""
+    """Testing hook: drop the singleton so the next get_database()
+    reopens. Also drops the swarm shard router, when one was built —
+    the two are the same process-wide storage root."""
     global _default_db
     with _default_lock:
         if _default_db is not None:
             _default_db.close()
         _default_db = None
+    swarm_shard = sys.modules.get("room_tpu.swarm.shard")
+    if swarm_shard is not None:
+        swarm_shard.reset_default_router()

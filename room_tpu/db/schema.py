@@ -18,7 +18,7 @@ All DDL is idempotent (CREATE ... IF NOT EXISTS) so it can run on any
 database. Table order respects foreign keys (PRAGMA foreign_keys = ON).
 """
 
-SCHEMA_VERSION = 2  # v2: cycle_journal (docs/swarm_recovery.md)
+SCHEMA_VERSION = 3  # v3: cycle_journal kind 'xshard' (docs/swarmshard.md)
 
 # UTC ISO-8601 with millisecond precision, e.g. 2026-07-28T19:04:11.123Z
 NOW_SQL = "(strftime('%Y-%m-%dT%H:%M:%fZ','now'))"
@@ -476,7 +476,7 @@ CREATE INDEX IF NOT EXISTS ix_worker_cycles_status ON worker_cycles(status);
 -- retry skips it, 'abandoned' for intents that never committed).
 CREATE TABLE IF NOT EXISTS cycle_journal (
     id         INTEGER PRIMARY KEY AUTOINCREMENT,
-    kind       TEXT NOT NULL CHECK(kind IN ('cycle','task_run')),
+    kind       TEXT NOT NULL CHECK(kind IN ('cycle','task_run','xshard')),
     ref_id     INTEGER NOT NULL,
     room_id    INTEGER,
     worker_id  INTEGER,
@@ -547,4 +547,34 @@ CREATE TABLE IF NOT EXISTS schema_migrations (
     version    INTEGER PRIMARY KEY,
     applied_at TEXT DEFAULT {NOW}
 );
+""")
+
+
+# v3 rebuild of cycle_journal for pre-v3 databases: SQLite cannot widen
+# a CHECK in place, so the table is renamed, recreated with the 'xshard'
+# kind admitted (cross-shard dispatch entries, docs/swarmshard.md), and
+# copied back. Indexes follow the rename and die with the old table, so
+# they are recreated. Fresh databases get this shape straight from
+# SCHEMA and only stamp the version (database.MIGRATIONS).
+MIGRATION_V3 = _t("""
+ALTER TABLE cycle_journal RENAME TO cycle_journal_v2;
+CREATE TABLE cycle_journal (
+    id         INTEGER PRIMARY KEY AUTOINCREMENT,
+    kind       TEXT NOT NULL CHECK(kind IN ('cycle','task_run','xshard')),
+    ref_id     INTEGER NOT NULL,
+    room_id    INTEGER,
+    worker_id  INTEGER,
+    entry      TEXT NOT NULL CHECK(entry IN
+                   ('started','provider_call','effect')),
+    status     TEXT NOT NULL DEFAULT 'open',
+    idem_key   TEXT,
+    payload    TEXT,
+    created_at TEXT DEFAULT {NOW},
+    updated_at TEXT DEFAULT {NOW}
+);
+INSERT INTO cycle_journal SELECT * FROM cycle_journal_v2;
+DROP TABLE cycle_journal_v2;
+CREATE INDEX IF NOT EXISTS ix_journal_ref ON cycle_journal(kind, ref_id);
+CREATE INDEX IF NOT EXISTS ix_journal_status ON cycle_journal(status);
+CREATE INDEX IF NOT EXISTS ix_journal_idem ON cycle_journal(idem_key);
 """)

@@ -317,6 +317,52 @@ def render_metrics() -> str:
     families.append(ship_fam)
     families.append(shard_fam)
 
+    # ---- swarm shard tier (docs/swarmshard.md) ----
+    try:
+        from ..swarm import maybe_default_router
+
+        swarm_router = maybe_default_router()
+    except Exception:
+        swarm_router = None
+    swarm_fam = _Family(
+        "room_tpu_swarm_shard", "gauge",
+        "Room-partitioned swarm-runtime shards (docs/swarmshard.md): "
+        "per-shard state/rooms/events/cross-shard traffic keyed by "
+        "shard; fleet-wide placement epoch, crash/adoption/dedup "
+        "counters under shard=\"all\".",
+    )
+    if swarm_router is not None:
+        snap = swarm_router.snapshot()
+        for key in ("n_shards", "cross_shard_messages",
+                    "cross_shard_escalations", "dedup_skips",
+                    "shard_crashes", "adoptions", "sheds", "resizes"):
+            v = snap.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                swarm_fam.add({"shard": "all", "stat": key}, v)
+        swarm_fam.add(
+            {"shard": "all", "stat": "epoch"},
+            (snap.get("placement") or {}).get("epoch", 0),
+        )
+        swarm_fam.add(
+            {"shard": "all", "stat": "serving"},
+            sum(1 for s in snap["shards"]
+                if s.get("state") == "serving"),
+        )
+        for s in snap["shards"]:
+            sk = str(s.get("shard"))
+            swarm_fam.add(
+                {"shard": sk, "stat": "serving"},
+                1 if s.get("state") == "serving" else 0,
+            )
+            for key in ("events", "messages_in", "messages_out",
+                        "escalations", "adoptions", "dedup_skips",
+                        "rooms_created"):
+                v = s.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    swarm_fam.add({"shard": sk, "stat": key}, v)
+    families.append(swarm_fam)
+
     # ---- turnscope SLO attribution (serving/trace.py) ----
     try:
         from ..serving import trace as trace_mod

@@ -1242,6 +1242,26 @@ def register_aux_routes(r: Router) -> None:
             "backlog": 0, "recovered": 0,
             "replay_pending": 0, "replay_consumed": 0,
         }
+        # swarm shard tier (docs/swarmshard.md): per-shard state +
+        # supervision + journal, and the fleet-wide journal aggregate —
+        # with shards the default-domain supervision above only covers
+        # rooms that never went through the router
+        from ..swarm import maybe_default_router as _maybe_swarm
+
+        swarm_router = _maybe_swarm()
+        if swarm_router is not None:
+            shard_block = swarm_router.snapshot()
+            agg = dict.fromkeys(swarm["journal"], 0)
+            for s, shard in zip(shard_block["shards"],
+                                swarm_router.shards):
+                db = shard.db
+                s["journal"] = (
+                    journal_mod.stats(db) if db is not None else None
+                )
+                for k in agg:
+                    agg[k] += (s["journal"] or {}).get(k, 0)
+            swarm["journal"] = agg
+            swarm["shards"] = shard_block
         degraded = any(
             e.get("degradation_level", 0) > 0 or not e.get("healthy",
                                                            True)
@@ -1263,6 +1283,11 @@ def register_aux_routes(r: Router) -> None:
             for e in engines.values()
             for s in (((e.get("fleet") or {}).get("router_shards")
                        or {}).get("shards") or {}).values()
+        ) or any(
+            # a dead SWARM shard (docs/swarmshard.md): its rooms shed
+            # until a sibling adopts the file; "retired" is healed
+            s.get("state") == "dead"
+            for s in (swarm.get("shards") or {}).get("shards", [])
         )
         from .runtime import lifecycle_snapshot
 

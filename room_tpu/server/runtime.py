@@ -154,8 +154,10 @@ class ServerRuntime:
                        {"last_shutdown": last})
         # crash recovery FIRST: resolve journal-open work to terminal
         # states (and flag committed side effects against replay)
-        # before the stale sweep or the scheduler can touch it
-        journal_mod.recover(self.db)
+        # before the stale sweep or the scheduler can touch it —
+        # every swarm shard's journal when sharded
+        for db, _dom in self._targets():
+            journal_mod.recover(db)
         self.cleanup_stale(startup=True)
         self.scheduler_tick()
         from ..core.embedding_indexer import EmbeddingIndexer
@@ -244,6 +246,42 @@ class ServerRuntime:
             finally:
                 busy.release()
 
+    # ---- swarm shards (docs/swarmshard.md) ----
+
+    @staticmethod
+    def _swarm():
+        from ..swarm import maybe_default_router
+
+        return maybe_default_router()
+
+    def _targets(self) -> list:
+        """The ``(db, domain)`` pairs every tick iterates. Unsharded
+        that is the classic ``(self.db, None)`` (None = the default
+        supervision domain); with ``ROOM_TPU_SWARM_SHARDS`` > 1 it is
+        each serving shard's DB — plus the DBs it adopted from dead
+        siblings — under that shard's own LoopDomain, so one shard's
+        supervision verdicts never touch another's threads."""
+        router = self._swarm()
+        if router is None:
+            return [(self.db, None)]
+        out = []
+        for shard in router.shards:
+            if shard.state != "serving":
+                continue
+            out.append((shard.db, shard.domain))
+            for adb in shard.adopted.values():
+                out.append((adb, shard.domain))
+        return out or [(self.db, None)]
+
+    def _room_target(self, room_id: int):
+        """(db, domain) owning one room — placement-map routed when
+        sharded (raises ShardDownError during a dead shard's lease)."""
+        router = self._swarm()
+        if router is None:
+            return self.db, None
+        shard = router.shard_for(room_id)
+        return router.db_for(room_id), shard.domain
+
     # ---- ticks ----
 
     def scheduler_tick(self) -> None:
@@ -253,58 +291,74 @@ class ServerRuntime:
         if fire_cron:
             self._last_cron_minute = minute_key
 
-        if fire_cron:
-            for task in self.db.query(
-                "SELECT * FROM tasks WHERE status='active' AND "
-                "trigger_type='cron' AND cron_expression IS NOT NULL"
-            ):
-                try:
-                    due = cron_matches(task["cron_expression"], now)
-                except Exception:
-                    continue
-                if due:
-                    self.queue_task_execution(task["id"])
+        for db, _dom in self._targets():
+            if fire_cron:
+                for task in db.query(
+                    "SELECT * FROM tasks WHERE status='active' AND "
+                    "trigger_type='cron' AND cron_expression IS NOT NULL"
+                ):
+                    try:
+                        due = cron_matches(task["cron_expression"], now)
+                    except Exception:
+                        continue
+                    if due:
+                        self.queue_task_execution(task["id"], db=db)
 
-        for task in self.db.query(
-            "SELECT * FROM tasks WHERE status='active' AND "
-            "trigger_type='once' AND scheduled_at IS NOT NULL AND "
-            "scheduled_at <= ?",
-            (utc_now(),),
-        ):
-            # archiving happens in _finish_run, after the run completes;
-            # archiving here would race the worker's active-status check
-            self.queue_task_execution(task["id"])
+            for task in db.query(
+                "SELECT * FROM tasks WHERE status='active' AND "
+                "trigger_type='once' AND scheduled_at IS NOT NULL AND "
+                "scheduled_at <= ?",
+                (utc_now(),),
+            ):
+                # archiving happens in _finish_run, after the run
+                # completes; archiving here would race the worker's
+                # active-status check
+                self.queue_task_execution(task["id"], db=db)
 
     def maintenance_tick(self) -> None:
         self.cleanup_stale()
-        journal_mod.prune(self.db)
+        for db, _dom in self._targets():
+            journal_mod.prune(db)
 
     def supervision_tick(self) -> None:
         """Restart dead/hung agent-loop threads under budget; past
         budget the worker goes unhealthy + keeper-escalated
-        (docs/swarm_recovery.md)."""
-        supervise_loops(self.db)
+        (docs/swarm_recovery.md). Sharded, each shard's domain is
+        supervised separately and the swarm router runs its own
+        supervise pass (shard_crash fault + dead-shard adoption)."""
+        router = self._swarm()
+        if router is not None:
+            router.supervise()
+        for db, dom in self._targets():
+            supervise_loops(db, domain=dom)
 
     def inbox_poll(self) -> None:
         """Unanswered keeper chat wakes the room's queen (reference:
         runtime.ts:47-61)."""
-        for room in rooms_mod.list_rooms(self.db, status="active"):
-            if not is_room_launched(room["id"]):
-                continue
-            if not room["queen_worker_id"]:
-                continue
-            if messages_mod.unanswered_keeper_messages(
-                self.db, room["id"]
-            ):
-                trigger_agent(
-                    self.db, room["id"], room["queen_worker_id"]
-                )
+        for db, dom in self._targets():
+            for room in rooms_mod.list_rooms(db, status="active"):
+                if not is_room_launched(room["id"], domain=dom):
+                    continue
+                if not room["queen_worker_id"]:
+                    continue
+                if messages_mod.unanswered_keeper_messages(
+                    db, room["id"]
+                ):
+                    trigger_agent(
+                        db, room["id"], room["queen_worker_id"],
+                        domain=dom,
+                    )
 
     # ---- operations ----
 
-    def queue_task_execution(self, task_id: int) -> bool:
+    def queue_task_execution(
+        self, task_id: int, db: Optional[Database] = None,
+    ) -> bool:
         """Dedupe + background execution (reference:
-        queueTaskExecution:96-150)."""
+        queueTaskExecution:96-150). Shard ID striding keeps task ids
+        globally unique, so one pending set covers every shard."""
+        if db is None:
+            db = self._find_task_db(task_id)
         with self._pending_lock:
             if task_id in self._pending_tasks:
                 return False
@@ -313,7 +367,7 @@ class ServerRuntime:
         def run() -> None:
             try:
                 task_runner.execute_task(
-                    self.db, task_id, abort=self.stop_event
+                    db, task_id, abort=self.stop_event
                 )
             finally:
                 with self._pending_lock:
@@ -324,6 +378,15 @@ class ServerRuntime:
         ).start()
         return True
 
+    def _find_task_db(self, task_id: int) -> Database:
+        """Shard holding a task (API callers pass only the id)."""
+        for db, _dom in self._targets():
+            if db.query_one(
+                "SELECT id FROM tasks WHERE id=?", (task_id,)
+            ):
+                return db
+        return self.db
+
     def run_task_now(self, task_id: int) -> bool:
         return self.queue_task_execution(task_id)
 
@@ -331,33 +394,36 @@ class ServerRuntime:
         """POST /rooms/:id/start semantics (reference:
         routes/rooms.ts:336-359): enable launch, reset runtime, cold-start
         the queen."""
-        room = rooms_mod.get_room(self.db, room_id)
+        db, dom = self._room_target(room_id)
+        room = rooms_mod.get_room(db, room_id)
         if room is None or not room["queen_worker_id"]:
             return False
-        rooms_mod.restart_room(self.db, room_id)
+        rooms_mod.restart_room(db, room_id)
         # a deliberate keeper restart re-arms the loop restart budget
         # and clears unhealthy flags for the room's workers
-        team = self.db.query(
+        team = db.query(
             "SELECT id FROM workers WHERE room_id=?", (room_id,)
         )
-        reset_supervision([w["id"] for w in team])
-        self.db.execute(
+        reset_supervision([w["id"] for w in team], domain=dom)
+        db.execute(
             "UPDATE workers SET agent_state='idle', updated_at=? "
             "WHERE room_id=? AND agent_state='unhealthy'",
             (utc_now(), room_id),
         )
-        set_room_launch_enabled(room_id, True)
-        stop_room_loops(self.db, room_id, "runtime reset")
+        set_room_launch_enabled(room_id, True, domain=dom)
+        stop_room_loops(db, room_id, "runtime reset", domain=dom)
         trigger_agent(
-            self.db, room_id, room["queen_worker_id"],
-            allow_cold_start=True,
+            db, room_id, room["queen_worker_id"],
+            allow_cold_start=True, domain=dom,
         )
         event_bus.emit("room:started", f"room:{room_id}", {})
         return True
 
     def stop_room(self, room_id: int) -> int:
-        n = stop_room_loops(self.db, room_id, "stopped by keeper")
-        task_runner.cancel_running_tasks_for_room(self.db, room_id)
+        db, dom = self._room_target(room_id)
+        n = stop_room_loops(db, room_id, "stopped by keeper",
+                            domain=dom)
+        task_runner.cancel_running_tasks_for_room(db, room_id)
         event_bus.emit("room:stopped", f"room:{room_id}", {})
         return n
 
@@ -377,34 +443,38 @@ class ServerRuntime:
         sweep catches whatever predates the journal."""
         n = 0
         cutoff = f"-{STALE_RUN_MINUTES} minutes"
-        for table, col in (("task_runs", "started_at"),
-                           ("worker_cycles", "started_at")):
-            cur = self.db.execute(
-                f"UPDATE {table} SET status='error', "
-                "error_message='stale: abandoned run', finished_at=? "
-                f"WHERE status='running' AND ({col} < "
-                "strftime('%Y-%m-%dT%H:%M:%fZ','now', ?) OR ?)",
-                (utc_now(), cutoff, 1 if startup else 0),
+        for db, dom in self._targets():
+            for table, col in (("task_runs", "started_at"),
+                               ("worker_cycles", "started_at")):
+                cur = db.execute(
+                    f"UPDATE {table} SET status='error', "
+                    "error_message='stale: abandoned run', "
+                    "finished_at=? "
+                    f"WHERE status='running' AND ({col} < "
+                    "strftime('%Y-%m-%dT%H:%M:%fZ','now', ?) OR ?)",
+                    (utc_now(), cutoff, 1 if startup else 0),
+                )
+                n += cur.rowcount
+            # workers stuck in 'running'/'rate_limited' with no loop
+            # thread behind them: at startup no loop exists yet, so
+            # reset them all; during operation only those whose loop is
+            # gone (a live loop legitimately holds these states for the
+            # whole backoff window)
+            live = set() if startup else \
+                set(running_workers(domain=dom))
+            stranded = db.query(
+                "SELECT id FROM workers WHERE agent_state IN "
+                "('running','rate_limited')"
             )
-            n += cur.rowcount
-        # workers stuck in 'running'/'rate_limited' with no loop thread
-        # behind them: at startup no loop exists yet, so reset them all;
-        # during operation only those whose loop is gone (a live loop
-        # legitimately holds these states for the whole backoff window)
-        live = set() if startup else set(running_workers())
-        stranded = self.db.query(
-            "SELECT id FROM workers WHERE agent_state IN "
-            "('running','rate_limited')"
-        )
-        for w in stranded:
-            if w["id"] in live:
-                continue
-            self.db.execute(
-                "UPDATE workers SET agent_state='idle', updated_at=? "
-                "WHERE id=?",
-                (utc_now(), w["id"]),
-            )
-            n += 1
+            for w in stranded:
+                if w["id"] in live:
+                    continue
+                db.execute(
+                    "UPDATE workers SET agent_state='idle', "
+                    "updated_at=? WHERE id=?",
+                    (utc_now(), w["id"]),
+                )
+                n += 1
         return n
 
 

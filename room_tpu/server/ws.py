@@ -127,27 +127,93 @@ class _Client:
 
 
 class WebSocketHub:
+    """Fan-out is subscription-driven, not a firehose: the hub holds
+    one ref-counted event-bus subscription per channel a client has
+    asked for (``"*"`` maps to the bus wildcard), so an event on a
+    channel nobody watches never reaches the hub at all. With swarm
+    shards emitting every room's traffic onto the global bus, the old
+    subscribe-everything handler made every WS hub pay O(events) for
+    O(subscribed) interest."""
+
     def __init__(self, server) -> None:
         self.server = server
         self._clients: list[_Client] = []
         self._lock = locks.make_lock("ws_hub")
         self._stop = threading.Event()
-        self._unsubscribe = None
+        # channel -> [bus_unsubscribe, client_refcount]
+        self._subs: dict[str, list] = {}
 
     def start(self) -> None:
-        self._unsubscribe = event_bus.subscribe(None, self._on_event)
         threading.Thread(
             target=self._heartbeat, daemon=True, name="ws-heartbeat"
         ).start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._unsubscribe:
-            self._unsubscribe()
         with self._lock:
+            subs, self._subs = self._subs, {}
             clients, self._clients = self._clients, []
+        for unsub, _ in subs.values():
+            if unsub is not None:
+                unsub()
         for c in clients:
             c.close()
+
+    # ---- per-channel bus subscriptions (ref-counted) ----
+
+    def _acquire_channel(self, channel: str) -> None:
+        with self._lock:
+            entry = self._subs.get(channel)
+            if entry is not None:
+                entry[1] += 1
+                return
+            # claim the channel with a placeholder; the bus subscribe
+            # happens OUTSIDE the hub lock because its handler
+            # (_fanout) re-enters it
+            entry = [None, 1]
+            self._subs[channel] = entry
+        bus_channel = None if channel == "*" else channel
+        unsub = event_bus.subscribe(
+            bus_channel,
+            lambda ev, ch=channel: self._fanout(ev, ch),
+        )
+        with self._lock:
+            if self._subs.get(channel) is entry:
+                entry[0] = unsub
+                return
+        # lost a race with release/stop while subscribing: undo
+        unsub()
+
+    def _release_channel(self, channel: str) -> None:
+        with self._lock:
+            entry = self._subs.get(channel)
+            if entry is None:
+                return
+            entry[1] -= 1
+            if entry[1] > 0:
+                return
+            del self._subs[channel]
+            unsub = entry[0]
+        # unsub is None when the acquirer is still mid-subscribe; it
+        # sees its entry gone and undoes the subscription itself
+        if unsub is not None:
+            unsub()
+
+    def _drop_client(self, client: _Client) -> None:
+        """Unregister a client and release its channel refs (must run
+        exactly once per removal path)."""
+        with self._lock:
+            if client not in self._clients:
+                return
+            self._clients.remove(client)
+        for channel in list(client.channels):
+            self._release_channel(channel)
+        client.channels.clear()
+
+    @property
+    def subscribed_channels(self) -> list[str]:
+        with self._lock:
+            return sorted(self._subs)
 
     @property
     def client_count(self) -> int:
@@ -188,9 +254,7 @@ class WebSocketHub:
         try:
             self._reader_loop(client, handler)
         finally:
-            with self._lock:
-                if client in self._clients:
-                    self._clients.remove(client)
+            self._drop_client(client)
             # full close (sentinel included) — a TCP EOF with no WS
             # close frame must still end the writer thread, or every
             # dropped tab leaks one blocked thread
@@ -222,12 +286,16 @@ class WebSocketHub:
             action = msg.get("type")
             channel = msg.get("channel")
             if action == "subscribe" and channel:
-                client.channels.add(channel)
+                if channel not in client.channels:
+                    client.channels.add(channel)
+                    self._acquire_channel(channel)
                 client.send_text(json.dumps(
                     {"type": "subscribed", "channel": channel}
                 ))
             elif action == "unsubscribe" and channel:
-                client.channels.discard(channel)
+                if channel in client.channels:
+                    client.channels.discard(channel)
+                    self._release_channel(channel)
                 client.send_text(json.dumps(
                     {"type": "unsubscribed", "channel": channel}
                 ))
@@ -258,7 +326,11 @@ class WebSocketHub:
 
     # ---- fan-out ----
 
-    def _on_event(self, event) -> None:
+    def _fanout(self, event, sub_channel: str) -> None:
+        """Deliver one event to the clients holding ``sub_channel``.
+        A client subscribed to both ``"*"`` and the event's channel is
+        reached by the exact-channel handler; the wildcard handler
+        skips it, so it still sees each event once."""
         text = json.dumps({
             "type": event.type,
             "channel": event.channel,
@@ -269,14 +341,14 @@ class WebSocketHub:
             clients = list(self._clients)
         dead = []
         for c in clients:
-            if event.channel in c.channels or "*" in c.channels:
-                if not c.send_text(text):
-                    dead.append(c)
-        if dead:
-            with self._lock:
-                for c in dead:
-                    if c in self._clients:
-                        self._clients.remove(c)
+            if sub_channel not in c.channels:
+                continue
+            if sub_channel == "*" and event.channel in c.channels:
+                continue
+            if not c.send_text(text):
+                dead.append(c)
+        for c in dead:
+            self._drop_client(c)
 
     def _heartbeat(self) -> None:
         while not self._stop.wait(timeout=HEARTBEAT_S):
@@ -284,6 +356,4 @@ class WebSocketHub:
                 clients = list(self._clients)
             for c in clients:
                 if not c.ping():
-                    with self._lock:
-                        if c in self._clients:
-                            self._clients.remove(c)
+                    self._drop_client(c)

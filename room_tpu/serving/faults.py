@@ -90,6 +90,14 @@ up through the agent runtime above the engine:
                        (stale-heartbeat watchdog territory)
     tool_exec          journaled tool side effect crashes between its
                        intent record and execution
+    shard_crash        one swarm-runtime shard of N dies hard
+                       (docs/swarmshard.md): its database handle
+                       closes mid-flight, its agent loops stop, its
+                       rooms shed until a sibling shard reopens the
+                       file past the swarm lease, journal-recovers
+                       it, and publishes a new placement epoch —
+                       cross-shard dispatch redelivered afterwards
+                       dedups on the journal's idempotency keys
 
 Arming is per-point with probability / latency / one-shot triggers,
 via code (`inject`) or env (`ROOM_TPU_FAULTS`), e.g.::
@@ -134,6 +142,8 @@ FAULT_POINTS = (
     "placement_io", "router_shard_crash",
     # swarm runtime (docs/swarm_recovery.md)
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
+    # swarm shard tier (docs/swarmshard.md)
+    "shard_crash",
 )
 
 

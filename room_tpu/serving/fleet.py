@@ -449,6 +449,26 @@ class EngineFleet:
         self._shards: list[_RouterShard] = [
             _RouterShard(i) for i in range(self.n_router_shards)
         ]
+        # ROOM_TPU_ROUTER_SHARD_HEARTBEATS: shard death and lease
+        # expiry come from a PodMembership detector fed per-shard wire
+        # heartbeats — the same verdict machinery pods use — instead of
+        # the in-process died_at timer. The detector's lease is the
+        # router lease, so the adoption timing contract is unchanged;
+        # what changes is WHO decides a shard is adoptable (heartbeat
+        # silence, not the killer's own timestamp).
+        self.shard_heartbeats = knobs.get_bool(
+            "ROOM_TPU_ROUTER_SHARD_HEARTBEATS"
+        )
+        self._shard_membership: Optional[podnet_mod.PodMembership] = None
+        self._shard_leases_fired: set[int] = set()
+        if self.shard_heartbeats and self.n_router_shards > 1:
+            self._shard_membership = podnet_mod.PodMembership(
+                lease_s=self.router_lease_s,
+            )
+            for s in self._shards:
+                self._shard_membership.register(
+                    f"shard-{s.shard_id}"
+                )
         self._records = _ShardedRecords(self)
         self._rr = 0   # round-robin cursor for re-home spreading
         self._threads_started = False
@@ -1284,6 +1304,21 @@ class EngineFleet:
                     victim_shard.shard_id,
                     reason="injected router_shard_crash",
                 )
+        # heartbeat-driven shard leases: every serving shard beats into
+        # the membership detector each supervise tick; a dead shard
+        # goes silent, and the detector's suspect->dead->lease-expired
+        # verdict (not the killer's timestamp) gates adoption below
+        if self._shard_membership is not None:
+            for s in self._shards:
+                if s.state == "serving":
+                    self._shard_membership.observe(
+                        f"shard-{s.shard_id}"
+                    )
+            self._shard_membership.tick()
+            self._shard_leases_fired.update(
+                int(mid.rsplit("-", 1)[1])
+                for mid in self._shard_membership.lease_expired()
+            )
         self._adopt_dead_shards()
         # disaggregated prefill->decode ships fire at turn boundaries
         # noticed here (docs/disagg.md); inert without roles
@@ -1416,14 +1451,27 @@ class EngineFleet:
         closed the journal, but the state machine must stay honest for
         the cross-process deploy where 'dead' is a heartbeat verdict —
         adopting a journal a slow owner could still append to would
-        split ownership."""
+        split ownership.
+
+        With ``ROOM_TPU_ROUTER_SHARD_HEARTBEATS`` the timing half is
+        the membership detector's instead: a shard is adoptable only
+        once its member's lease has *fired* (heartbeat silence ran the
+        whole suspect -> dead -> lease course), never on the killer's
+        own clock."""
         now = time.monotonic()
         with self._lock:
-            dead = [
-                s for s in self._shards
-                if s.state == "dead"
-                and now - s.died_at >= self.router_lease_s
-            ]
+            if self._shard_membership is not None:
+                dead = [
+                    s for s in self._shards
+                    if s.state == "dead"
+                    and s.shard_id in self._shard_leases_fired
+                ]
+            else:
+                dead = [
+                    s for s in self._shards
+                    if s.state == "dead"
+                    and now - s.died_at >= self.router_lease_s
+                ]
             serving = [
                 s for s in self._shards if s.state == "serving"
             ]
@@ -1432,6 +1480,7 @@ class EngineFleet:
         for shard in dead:
             adopter = min(serving, key=lambda s: len(s.records))
             self._adopt_shard_journal(shard, adopter)
+            self._shard_leases_fired.discard(shard.shard_id)
 
     def _adopt_shard_journal(
         self, dead: _RouterShard, adopter: _RouterShard,
@@ -2208,6 +2257,10 @@ class EngineFleet:
             "sessions_adopted": out.pop("sessions_adopted"),
             "placement_refusals": out.pop("placement_refusals"),
             "placement": self.placement.snapshot(),
+            "heartbeats": (
+                self._shard_membership.snapshot()
+                if self._shard_membership is not None else None
+            ),
             "shards": {
                 str(s.shard_id): {
                     "state": s.state,

@@ -98,6 +98,7 @@ FAULT_EVENTS = {
     "cycle_crash": "fault.cycle_crash",
     "loop_hang": "fault.loop_hang",
     "tool_exec": "fault.tool_exec",
+    "shard_crash": "fault.shard_crash",
 }
 
 # attribution components (per class, ms): where a class's latency

@@ -1,0 +1,7 @@
+"""Sharded swarm control plane (docs/swarmshard.md)."""
+
+from .shard import (  # noqa: F401
+    ShardDownError, SwarmRouter, SwarmShard, default_router,
+    maybe_default_router, reset_default_router, resize_swarm,
+    shard_db_path,
+)

@@ -1,0 +1,855 @@
+"""Room-partitioned swarm-runtime shards behind the placement map
+(docs/swarmshard.md).
+
+Everything below the providers scales out (fleet replicas, disagg
+roles, pod membership, the room-id-sharded router tier), but the swarm
+runtime — Queens, Workers, Quorum, goals, the cycle journal — was one
+process around one WAL-mode SQLite singleton: every cycle's journal
+writes, every room's events, and every loop's supervision shared a
+single writer. ``ROOM_TPU_SWARM_SHARDS`` > 1 partitions rooms across N
+swarm-runtime shards. Each :class:`SwarmShard` owns
+
+- its own SQLite file (``shard<k>.db``; schema and migrations are the
+  classic ones, applied per shard by ``Database`` itself),
+- its own agent-loop supervision domain (``agent_loop.LoopDomain`` —
+  registry, crash strikes, unhealthy roster), and
+- its own event-bus segment (an ``EventBus`` fed the shard's
+  ``room:<id>`` traffic by the router's tap on the global bus).
+
+Placement is the SAME epoch-versioned machinery the router tier uses
+(``serving.podnet.PlacementMap``): the room's **data home** is the
+stable crc32 hash of its id (which file holds its rows — never changes
+while the file lives), and its **owner** is the redirect-followed
+placement lookup (which shard's supervision domain runs it — changes
+on failover, fenced by the epoch).
+
+Cross-shard seams:
+
+- **Identity.** Every AUTOINCREMENT sequence is strided per shard
+  (shard k mints ids from ``k * 10^9``), so worker/cycle/journal ids
+  are globally unique and an N→M re-placement moves rows between
+  files without collision — zero journal loss, ids preserved. Room
+  ids come from a swarm-global counter (shard 0's settings) and the
+  hash of the allocated id decides the home shard.
+- **Dispatch.** Inter-room ``message_send`` and escalations route
+  through :meth:`SwarmRouter.send_message` / :meth:`SwarmRouter.escalate`:
+  each remote half is journaled on the *target* shard's database under
+  the cycle journal's content-derived idempotency key
+  (``journal.effect_key``), so a crashed dispatch redelivered after
+  adoption commits exactly once — the same exactly-once contract
+  in-shard tool effects already carry.
+- **Failover.** ``kill_shard`` (chaos: the ``shard_crash`` fault in
+  :meth:`SwarmRouter.supervise`) closes a shard's database mid-flight;
+  its rooms shed (``ShardDownError``, retryable) for
+  ``ROOM_TPU_SWARM_LEASE_S``, then a sibling reopens the file, runs
+  ``journal.recover`` over it (interrupted cycles failed, committed
+  effects replay-flagged), and the placement rehome + epoch bump
+  fences the dead owner out.
+- **Resize.** :func:`resize_swarm` re-places every room under an
+  M-shard map, moving whole room row-sets between shard files over an
+  ATTACHed connection in one transaction per room.
+
+Single-shard mode (``ROOM_TPU_SWARM_SHARDS`` unset or 1) never builds
+a router: ``db.get_database()`` keeps its classic singleton behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import zlib
+from typing import Callable, Optional
+
+from ..core.events import EventBus, event_bus
+from ..db import Database
+from ..db.database import default_db_path
+from ..core import journal as journal_mod
+from ..core import messages as messages_mod
+from ..utils import knobs, locks
+
+# per-shard AUTOINCREMENT id stride: shard k mints ids >= k * STRIDE,
+# making every row id globally unique across shard files (the property
+# resize_swarm's row moves and the cross-shard journal rely on)
+ID_STRIDE = 1_000_000_000
+
+# the swarm-global room-id counter, kept in shard 0's settings table
+_ROOM_COUNTER_KEY = "swarm:next_room_id"
+
+# every AUTOINCREMENT table (sqlite_sequence rows are seeded to the
+# shard's stride floor on open; tables created empty have no row yet)
+_SEQ_TABLES = (
+    "workers", "rooms", "entities", "observations", "relations",
+    "embeddings", "tasks", "task_runs", "console_logs", "watches",
+    "chat_messages", "room_activity", "quorum_decisions",
+    "quorum_votes", "goals", "goal_updates", "skills",
+    "self_mod_audit", "escalations", "credentials", "wallets",
+    "wallet_transactions", "room_messages", "worker_cycles",
+    "cycle_journal", "cycle_logs", "clerk_messages", "clerk_usage",
+)
+
+# room-scoped row-set spec for resize_swarm, copy order respects FKs
+# (parents before children); the WHERE clauses run with the source
+# file ATTACHed as ``src`` on the target connection
+_ROOM_TABLES: tuple[tuple[str, str], ...] = (
+    # workers first: rooms.queen_worker_id REFERENCES workers(id)
+    # (workers.room_id is a plain column, so no cycle)
+    ("workers", "room_id=?"),
+    ("rooms", "id=?"),
+    ("agent_sessions",
+     "worker_id IN (SELECT id FROM src.workers WHERE room_id=?)"),
+    ("entities", "room_id=?"),
+    ("observations",
+     "entity_id IN (SELECT id FROM src.entities WHERE room_id=?)"),
+    ("relations",
+     "from_entity IN (SELECT id FROM src.entities WHERE room_id=?)"),
+    ("embeddings",
+     "entity_id IN (SELECT id FROM src.entities WHERE room_id=?)"),
+    ("tasks", "room_id=?"),
+    ("task_runs",
+     "task_id IN (SELECT id FROM src.tasks WHERE room_id=?)"),
+    ("console_logs",
+     "run_id IN (SELECT id FROM src.task_runs WHERE task_id IN "
+     "(SELECT id FROM src.tasks WHERE room_id=?))"),
+    ("watches", "room_id=?"),
+    ("chat_messages", "room_id=?"),
+    ("room_activity", "room_id=?"),
+    ("quorum_decisions", "room_id=?"),
+    ("quorum_votes",
+     "decision_id IN (SELECT id FROM src.quorum_decisions "
+     "WHERE room_id=?)"),
+    ("goals", "room_id=?"),
+    ("goal_updates",
+     "goal_id IN (SELECT id FROM src.goals WHERE room_id=?)"),
+    ("skills", "room_id=?"),
+    ("self_mod_audit", "room_id=?"),
+    ("self_mod_snapshots",
+     "audit_id IN (SELECT id FROM src.self_mod_audit "
+     "WHERE room_id=?)"),
+    ("escalations", "room_id=?"),
+    ("credentials", "room_id=?"),
+    ("wallets", "room_id=?"),
+    ("wallet_transactions",
+     "wallet_id IN (SELECT id FROM src.wallets WHERE room_id=?)"),
+    ("room_messages", "room_id=?"),
+    ("worker_cycles", "room_id=?"),
+    ("cycle_journal", "room_id=?"),
+    ("cycle_logs",
+     "cycle_id IN (SELECT id FROM src.worker_cycles "
+     "WHERE room_id=?)"),
+)
+
+
+class ShardDownError(RuntimeError):
+    """The room's shard is dead and not yet adopted: shed, retryable
+    once a sibling finishes the lease/adopt dance."""
+
+    def __init__(self, shard_id: int) -> None:
+        super().__init__(
+            f"swarm shard {shard_id} is down; retry after adoption"
+        )
+        self.shard_id = shard_id
+        self.transient = True
+
+
+def shard_db_path(shard_id: int, db_dir: Optional[str] = None) -> str:
+    """Shard k's database file. With ``ROOM_TPU_SWARM_DB_DIR`` (or an
+    explicit ``db_dir``) every shard lives as ``<dir>/shard<k>.db``;
+    otherwise shard 0 keeps the classic ``default_db_path()`` file —
+    a 1→N resize starts from the data already on disk — and shard
+    k > 0 gets a ``.shard<k>`` sibling."""
+    directory = db_dir or knobs.get_str("ROOM_TPU_SWARM_DB_DIR")
+    if directory:
+        directory = os.path.expanduser(directory)
+        os.makedirs(directory, exist_ok=True)
+        return os.path.join(directory, f"shard{shard_id}.db")
+    base = default_db_path()
+    if shard_id == 0:
+        return base
+    root, ext = os.path.splitext(base)
+    return f"{root}.shard{shard_id}{ext}"
+
+
+def _stride_sequences(db: Database, shard_id: int) -> None:
+    """Seed every AUTOINCREMENT sequence to the shard's id floor so no
+    two shards can mint the same row id. Idempotent; an existing
+    sequence already past the floor is left alone."""
+    floor = shard_id * ID_STRIDE
+    with db.transaction():
+        for table in _SEQ_TABLES:
+            row = db.query_one(
+                "SELECT seq FROM sqlite_sequence WHERE name=?", (table,)
+            )
+            if row is None:
+                db.execute(
+                    "INSERT INTO sqlite_sequence(name, seq) "
+                    "VALUES (?,?)", (table, floor),
+                )
+            elif int(row["seq"]) < floor:
+                db.execute(
+                    "UPDATE sqlite_sequence SET seq=? WHERE name=?",
+                    (floor, table),
+                )
+
+
+def journaled_once(
+    db: Database,
+    room_id: Optional[int],
+    actor_id: Optional[int],
+    name: str,
+    args: dict,
+    fn: Callable[[], str],
+) -> tuple[str, bool]:
+    """Exactly-once cross-shard delivery on the *target* shard's
+    database: the cycle journal's content-derived idempotency key
+    (``journal.effect_key`` — kind ``xshard``) dedups any prior
+    committed or replay-flagged entry inside the replay window, so a
+    dispatch redelivered after a sender crash or a shard adoption
+    commits once and only once. Returns ``(result, deduped)``.
+
+    The intent→commit protocol is the journal's own: a crash between
+    intent and commit leaves an ``intent`` row that startup recovery
+    abandons, and the redelivery re-runs the effect; a crash after
+    commit leaves the ``committed`` row this very dedup matches.
+
+    The dedup check is check-then-act: callers serialize concurrent
+    dispatches onto one database under that shard's
+    ``_dispatch_lock``.
+    """
+    key = journal_mod.effect_key("xshard", actor_id, name, args)
+    cutoff = f"-{int(journal_mod.REPLAY_WINDOW_S)} seconds"
+    prior = db.query_one(
+        "SELECT payload FROM cycle_journal WHERE entry='effect' AND "
+        "idem_key=? AND status IN ('committed','replay_skip') AND "
+        "updated_at > strftime('%Y-%m-%dT%H:%M:%fZ','now', ?) "
+        "ORDER BY id DESC LIMIT 1",
+        (key, cutoff),
+    )
+    if prior is not None:
+        payload = json.loads(prior["payload"] or "{}")
+        return payload.get("result", ""), True
+    entry_id = db.insert(
+        "INSERT INTO cycle_journal(kind, ref_id, room_id, worker_id, "
+        "entry, status, idem_key, payload) VALUES "
+        "('xshard',0,?,?,'effect','intent',?,?)",
+        (room_id, actor_id, key,
+         json.dumps({"tool": name, "args": args}, default=str)),
+    )
+    with db.transaction():
+        out = fn()
+        db.execute(
+            "UPDATE cycle_journal SET status='committed', payload=?, "
+            "updated_at=? WHERE id=?",
+            (json.dumps({"tool": name, "args": args,
+                         "result": (out or "")[:2000]}, default=str),
+             journal_mod.utc_now(), entry_id),
+        )
+    return out, False
+
+
+class SwarmShard:
+    """One swarm-runtime shard: a database file, an event-bus segment,
+    and (lazily) an agent-loop supervision domain. State transitions
+    ``serving`` → ``dead`` (kill/crash) → ``retired`` (adopted)."""
+
+    def __init__(self, shard_id: int, db: Database) -> None:
+        self.shard_id = shard_id
+        self.db: Optional[Database] = db
+        self.bus = EventBus()
+        self.state = "serving"
+        self.died_at: Optional[float] = None
+        self._domain = None
+        # serializes journaled_once's check-then-act sequence for
+        # effects landing on THIS shard's file (concurrent
+        # redeliveries of one idempotency key must queue)
+        self._dispatch_lock = locks.make_lock("swarm_dispatch")
+        self.stats = {
+            "events": 0, "messages_in": 0, "messages_out": 0,
+            "escalations": 0, "adoptions": 0, "dedup_skips": 0,
+            "rooms_created": 0,
+        }
+        # origin shard id -> reopened Database, for files this shard
+        # adopted after a sibling died
+        self.adopted: dict[int, Database] = {}
+
+    @property
+    def domain(self):
+        """The shard's ``agent_loop.LoopDomain`` (imported lazily: the
+        data path must not drag the provider stack in)."""
+        if self._domain is None:
+            from ..core import agent_loop
+
+            self._domain = agent_loop.LoopDomain()
+        return self._domain
+
+    def snapshot(self) -> dict:
+        out = {
+            "shard": self.shard_id,
+            "state": self.state,
+            "adopted": sorted(self.adopted),
+            **self.stats,
+        }
+        if self._domain is not None:
+            from ..core import agent_loop
+
+            out["supervision"] = agent_loop.supervision_snapshot(
+                domain=self._domain
+            )
+        return out
+
+
+class SwarmRouter:
+    """The shard-aware control plane: placement, per-room database
+    resolution, cross-shard dispatch, chaos, failover, and the health/
+    metrics snapshot. One per process (``default_router``); tests and
+    the bench build private ones over temp directories."""
+
+    def __init__(
+        self,
+        n_shards: Optional[int] = None,
+        db_dir: Optional[str] = None,
+        lease_s: Optional[float] = None,
+        db_factory: Optional[Callable[[int], Database]] = None,
+    ) -> None:
+        from ..serving import podnet as podnet_mod
+
+        self.n_shards = max(1, int(
+            n_shards if n_shards is not None
+            else knobs.get_int("ROOM_TPU_SWARM_SHARDS")
+        ))
+        self.lease_s = float(
+            lease_s if lease_s is not None
+            else knobs.get_float("ROOM_TPU_SWARM_LEASE_S")
+        )
+        self._db_dir = db_dir
+        self._db_factory = db_factory or (
+            lambda k: Database(shard_db_path(k, db_dir))
+        )
+        self._lock = locks.make_lock("swarm_router")
+        self.placement = podnet_mod.PlacementMap(self.n_shards)
+        self.shards: list[SwarmShard] = []
+        # data home (origin shard id) -> live Database over that file;
+        # a dead shard's slot is None until a sibling adopts the file
+        self._dbs: dict[int, Optional[Database]] = {}
+        for k in range(self.n_shards):
+            db = self._db_factory(k)
+            if self.n_shards > 1:
+                _stride_sequences(db, k)
+            self.shards.append(SwarmShard(k, db))
+            self._dbs[k] = db
+        self.stats = {
+            "cross_shard_messages": 0, "cross_shard_escalations": 0,
+            "dedup_skips": 0, "shard_crashes": 0, "adoptions": 0,
+            "sheds": 0, "resizes": 0,
+        }
+        self._seed_room_counter()
+        # the event tap: room-channel traffic on the global bus fans
+        # into the owning shard's segment (per-shard WS fan-out and
+        # metrics read the segments; the global bus stays the
+        # process-wide aggregate)
+        self._untap = event_bus.subscribe(None, self._route_event)
+        self._closed = False
+
+    # ---- placement ----
+
+    def base_home(self, room_id) -> int:
+        """The room's *data home*: which shard file holds its rows.
+        The stable crc32 hash — deliberately the same formula as
+        ``PlacementMap.shard_of``'s base hash, minus the failover
+        redirects (adoption reopens the origin file; it never moves
+        rows)."""
+        return zlib.crc32(str(room_id).encode("utf-8")) % self.n_shards
+
+    def owner_of(self, room_id) -> int:
+        """The room's *owner*: which shard's supervision domain runs
+        it — the redirect-followed placement lookup."""
+        return self.placement.shard_of(str(room_id))
+
+    def db_for(self, room_id: Optional[int] = None) -> Database:
+        """Resolve a room id to the live database over its home file.
+        ``None`` means the swarm-global tables (settings, clerk) on
+        shard 0. Raises :class:`ShardDownError` while the home shard
+        is dead and unadopted — the shed window."""
+        home = 0 if room_id is None else self.base_home(room_id)
+        db = self._dbs.get(home)
+        if db is None:
+            with self._lock:
+                self.stats["sheds"] += 1
+            raise ShardDownError(home)
+        return db
+
+    def shard_for(self, room_id) -> SwarmShard:
+        """The shard whose supervision domain / event segment owns the
+        room right now (post-failover: the adopter)."""
+        return self.shards[self.owner_of(room_id)]
+
+    def all_dbs(self) -> list[Database]:
+        """One live Database per shard *file* (serving shards plus
+        adopted files), for runtime loops that sweep every room."""
+        seen: list[Database] = []
+        for k in sorted(self._dbs):
+            db = self._dbs[k]
+            if db is not None and db not in seen:
+                seen.append(db)
+        return seen
+
+    # ---- room identity ----
+
+    def _meta_db(self) -> Database:
+        db = self._dbs.get(0)
+        if db is None:
+            raise ShardDownError(0)
+        return db
+
+    def _seed_room_counter(self) -> None:
+        """Start the room-id counter above any room already on disk
+        (a 1→N resize inherits the classic file's rooms)."""
+        top = 0
+        for db in self.all_dbs():
+            row = db.query_one("SELECT MAX(id) AS m FROM rooms")
+            if row and row["m"]:
+                top = max(top, int(row["m"]))
+        meta = self._meta_db()
+        cur = int(
+            messages_mod.get_setting(meta, _ROOM_COUNTER_KEY) or "1"
+        )
+        if top >= cur:
+            messages_mod.set_setting(
+                meta, _ROOM_COUNTER_KEY, str(top + 1)
+            )
+
+    def allocate_room_id(self) -> int:
+        """Mint a swarm-unique room id from the shard-0 counter. The
+        crc32 of the *allocated id* decides the home shard — identity
+        drives placement, never the other way around."""
+        meta = self._meta_db()
+        with self._lock:
+            with meta.transaction():
+                cur = int(
+                    messages_mod.get_setting(meta, _ROOM_COUNTER_KEY)
+                    or "1"
+                )
+                messages_mod.set_setting(
+                    meta, _ROOM_COUNTER_KEY, str(cur + 1)
+                )
+        return cur
+
+    def create_room(self, name: str, **kwargs) -> dict:
+        """Create a room on the shard its allocated id hashes to."""
+        from ..core import rooms as rooms_mod
+
+        rid = self.allocate_room_id()
+        db = self.db_for(rid)
+        room = rooms_mod.create_room(db, name, room_id=rid, **kwargs)
+        shard = self.shards[self.base_home(rid)]
+        shard.stats["rooms_created"] += 1
+        return room
+
+    # ---- cross-shard dispatch ----
+
+    def send_message(
+        self,
+        from_room_id: int,
+        to_room_id: int,
+        subject: str,
+        body: str,
+        actor_id: Optional[int] = None,
+    ) -> tuple[int, int]:
+        """Shard-aware ``message_send``: the single-shard swarm keeps
+        the classic two-insert path; any multi-shard topology journals
+        each half on its room's home shard under one content-derived
+        key, so redelivery after a crash/adoption — or after a resize
+        merged the pair onto ONE file, where the committed journal
+        rows moved with their rooms — is exactly-once."""
+        src = self.db_for(from_room_id)
+        dst = self.db_for(to_room_id)
+        if src is dst and self.n_shards == 1:
+            return messages_mod.send_room_message(
+                src, from_room_id, to_room_id, subject, body
+            )
+        args = {"from": from_room_id, "to": to_room_id,
+                "subject": subject, "body": body}
+        shard_src = self.shards[self.base_home(from_room_id)]
+        shard_dst = self.shards[self.base_home(to_room_id)]
+        # each half serializes on ITS shard's dispatch lock
+        # (sequentially, never nested — opposite-direction sends can't
+        # deadlock); journaled_once's dedup check is check-then-act
+        with shard_src._dispatch_lock:
+            out_raw, out_dup = journaled_once(
+                src, from_room_id, actor_id, "xshard_msg_out", args,
+                lambda: str(src.insert(
+                    "INSERT INTO room_messages(room_id, direction, "
+                    "from_room_id, to_room_id, subject, body, status) "
+                    "VALUES (?,?,?,?,?,?,'read')",
+                    (from_room_id, "outbound", str(from_room_id),
+                     str(to_room_id), subject, body),
+                )),
+            )
+        with shard_dst._dispatch_lock:
+            in_raw, in_dup = journaled_once(
+                dst, to_room_id, actor_id, "xshard_msg_in", args,
+                lambda: str(dst.insert(
+                    "INSERT INTO room_messages(room_id, direction, "
+                    "from_room_id, to_room_id, subject, body) "
+                    "VALUES (?,?,?,?,?,?)",
+                    (to_room_id, "inbound", str(from_room_id),
+                     str(to_room_id), subject, body),
+                )),
+            )
+        with self._lock:
+            if src is not dst:
+                self.stats["cross_shard_messages"] += 1
+            if out_dup or in_dup:
+                self.stats["dedup_skips"] += 1
+        self.shards[self.base_home(from_room_id)].stats[
+            "messages_out"] += 1
+        self.shards[self.base_home(to_room_id)].stats[
+            "messages_in"] += 1
+        if out_dup or in_dup:
+            self.shards[self.base_home(to_room_id)].stats[
+                "dedup_skips"] += 1
+        return int(out_raw or 0), int(in_raw or 0)
+
+    def escalate(
+        self,
+        room_id: int,
+        question: str,
+        from_agent_id: Optional[int] = None,
+        to_agent_id: Optional[int] = None,
+    ) -> int:
+        """Shard-aware escalation: journaled on the room's shard under
+        the content-derived key (a webhook/MCP caller retrying after a
+        shard crash lands exactly one escalation row)."""
+        from ..core import escalations as escalations_mod
+
+        db = self.db_for(room_id)
+        args = {"room": room_id, "question": question,
+                "from": from_agent_id, "to": to_agent_id}
+        shard_dst = self.shards[self.base_home(room_id)]
+        with shard_dst._dispatch_lock:
+            raw, dup = journaled_once(
+                db, room_id, from_agent_id, "xshard_escalation", args,
+                lambda: str(escalations_mod.create_escalation(
+                    db, room_id, question,
+                    from_agent_id=from_agent_id, to_agent_id=to_agent_id,
+                )),
+            )
+        with self._lock:
+            self.stats["cross_shard_escalations"] += 1
+            if dup:
+                self.stats["dedup_skips"] += 1
+        shard = self.shards[self.base_home(room_id)]
+        shard.stats["escalations"] += 1
+        if dup:
+            shard.stats["dedup_skips"] += 1
+        return int(raw or 0)
+
+    # ---- chaos + failover ----
+
+    def kill_shard(
+        self, shard_id: int, reason: str = "killed"
+    ) -> bool:
+        """Crash one serving shard: its database handle closes (every
+        in-flight statement errors like a process death) and its rooms
+        shed until a sibling adopts the file after the lease. Refused
+        when it would kill the last serving shard."""
+        with self._lock:
+            shard = self.shards[shard_id]
+            serving = [
+                s for s in self.shards if s.state == "serving"
+            ]
+            if shard.state != "serving" or len(serving) < 2:
+                return False
+            shard.state = "dead"
+            shard.died_at = time.monotonic()
+            db, shard.db = shard.db, None
+            self._dbs[shard_id] = None
+            self.stats["shard_crashes"] += 1
+        if db is not None:
+            try:
+                db.close()
+            except Exception:
+                pass
+        if shard._domain is not None:
+            from ..core import agent_loop
+
+            agent_loop.stop_domain_loops(shard._domain)
+        event_bus.emit(
+            "swarm:shard_dead", "runtime",
+            {"shard": shard_id, "reason": reason},
+        )
+        self._trace_note("swarm.shard_crash",
+                         {"shard": shard_id, "reason": reason})
+        return True
+
+    def adopt_dead_shards(
+        self, now: Optional[float] = None
+    ) -> list[dict]:
+        """Sibling adoption of dead shards past their lease: reopen
+        the dead shard's file, run journal recovery over it
+        (interrupted cycles failed, committed effects replay-flagged),
+        hand the live handle to the emptiest serving sibling, and
+        fence the dead owner out with a placement rehome + epoch
+        bump."""
+        now = time.monotonic() if now is None else now
+        out: list[dict] = []
+        with self._lock:
+            expired = [
+                s for s in self.shards
+                if s.state == "dead" and s.died_at is not None
+                and now - s.died_at >= self.lease_s
+            ]
+            for s in expired:
+                s.state = "adopting"
+        for s in expired:
+            serving = [
+                x for x in self.shards if x.state == "serving"
+            ]
+            if not serving:
+                s.state = "dead"  # nobody left to adopt into
+                continue
+            adopter = min(
+                serving, key=lambda x: (len(x.adopted), x.shard_id)
+            )
+            db = self._db_factory(s.shard_id)
+            summary = journal_mod.recover(db)
+            epoch = self.placement.rehome(
+                s.shard_id, adopter.shard_id
+            )
+            with self._lock:
+                adopter.adopted[s.shard_id] = db
+                self._dbs[s.shard_id] = db
+                s.state = "retired"
+                adopter.stats["adoptions"] += 1
+                self.stats["adoptions"] += 1
+            entry = {
+                "shard": s.shard_id, "adopter": adopter.shard_id,
+                "epoch": epoch, **summary,
+            }
+            out.append(entry)
+            event_bus.emit("swarm:shard_adopted", "runtime", entry)
+            self._trace_note("swarm.shard_adopted", entry)
+        return out
+
+    def supervise(self, now: Optional[float] = None) -> list[dict]:
+        """One supervision pass: fire the ``shard_crash`` chaos point
+        (kills the busiest serving shard when a sibling exists — the
+        swarm twin of ``router_shard_crash``), then run the adoption
+        sweep. Returns the adoptions performed."""
+        faults = sys.modules.get("room_tpu.serving.faults")
+        if faults is not None and faults.is_armed() and \
+                faults.should_fire("shard_crash") is not None:
+            with self._lock:
+                serving = [
+                    s for s in self.shards if s.state == "serving"
+                ]
+            if len(serving) >= 2:
+                busiest = max(
+                    serving,
+                    key=lambda s: (s.stats["rooms_created"],
+                                   -s.shard_id),
+                )
+                self.kill_shard(busiest.shard_id,
+                                reason="chaos: shard_crash")
+        return self.adopt_dead_shards(now=now)
+
+    # ---- events ----
+
+    def _route_event(self, event) -> None:
+        """Global-bus tap: fan ``room:<id>`` traffic into the owning
+        shard's segment (one O(1) handler, not one per socket — the
+        firehose the WS hub used to be)."""
+        channel = getattr(event, "channel", "") or ""
+        if not channel.startswith("room:"):
+            return
+        try:
+            room_id = int(channel.split(":", 1)[1])
+        except ValueError:
+            return
+        shard = self.shards[self.owner_of(room_id)]
+        shard.stats["events"] += 1
+        shard.bus.emit(event.type, event.channel, event.data)
+
+    # ---- observability + teardown ----
+
+    def _trace_note(self, kind: str, data: dict) -> None:
+        trace = sys.modules.get("room_tpu.serving.trace")
+        if trace is not None:
+            try:
+                trace.note_event(kind, data)
+            except Exception:
+                pass
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            shards = [s.snapshot() for s in self.shards]
+            stats = dict(self.stats)
+        return {
+            "n_shards": self.n_shards,
+            "lease_s": self.lease_s,
+            "placement": self.placement.snapshot(),
+            "shards": shards,
+            **stats,
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._untap()
+        except Exception:
+            pass
+        for db in self.all_dbs():
+            try:
+                db.close()
+            except Exception:
+                pass
+
+
+def resize_swarm(
+    router: SwarmRouter,
+    new_n: int,
+    db_dir: Optional[str] = None,
+    db_factory: Optional[Callable[[int], Database]] = None,
+) -> tuple[SwarmRouter, dict]:
+    """Shard-count change N→M: close the old router, open an M-shard
+    one over the same directory, and re-place every room under the new
+    map — each room whose home changed moves as one whole row-set
+    (ids preserved; the strided sequences guarantee no collisions)
+    inside a single cross-file transaction per room. Returns the new
+    router and a summary whose ``journal_rows_lost`` is asserted zero
+    by the swarm tier."""
+    old_n = router.n_shards
+    db_dir = db_dir if db_dir is not None else router._db_dir
+    old_paths = {
+        k: shard_db_path(k, db_dir) for k in range(old_n)
+    }
+    with router._lock:
+        dbs_snapshot = dict(router._dbs)
+    for k, db in dbs_snapshot.items():
+        if db is not None and k in old_paths:
+            old_paths[k] = db.path
+    journal_before = _count_journal_rows(router.all_dbs())
+    router.close()
+
+    new_router = SwarmRouter(
+        n_shards=new_n, db_dir=db_dir, db_factory=db_factory
+    )
+    summary = {
+        "old_shards": old_n, "new_shards": new_router.n_shards,
+        "rooms_moved": 0, "rooms_kept": 0,
+        "journal_rows_before": journal_before,
+    }
+    # files beyond the new count are drained too: their rooms all
+    # re-place somewhere under the new map
+    orphan_dbs: dict[str, Database] = {}
+    try:
+        for old_k in sorted(old_paths):
+            src_path = old_paths[old_k]
+            if not os.path.exists(src_path):
+                continue
+            if old_k < new_router.n_shards and \
+                    new_router._dbs[old_k] is not None and \
+                    new_router._dbs[old_k].path == src_path:
+                src_db = new_router._dbs[old_k]
+            else:
+                src_db = orphan_dbs.setdefault(
+                    src_path, Database(src_path)
+                )
+            rooms = src_db.query("SELECT id FROM rooms ORDER BY id")
+            for row in rooms:
+                rid = int(row["id"])
+                new_home = new_router.base_home(rid)
+                dst_db = new_router._dbs[new_home]
+                if dst_db is not None and dst_db.path == src_path:
+                    summary["rooms_kept"] += 1
+                    continue
+                _move_room(src_db, dst_db, rid)
+                summary["rooms_moved"] += 1
+        new_router._seed_room_counter()
+    finally:
+        for db in orphan_dbs.values():
+            try:
+                db.close()
+            except Exception:
+                pass
+    after = _count_journal_rows(new_router.all_dbs())
+    # journal rows with no room (room_id NULL or pruned) stay in their
+    # origin file only while that file is still a live shard; count
+    # only what the new map can see, and compare against the before
+    summary["journal_rows_after"] = after
+    summary["journal_rows_lost"] = max(0, journal_before - after)
+    new_router.stats["resizes"] += 1
+    event_bus.emit("swarm:resized", "runtime", dict(summary))
+    return new_router, summary
+
+
+def _count_journal_rows(dbs: list[Database]) -> int:
+    n = 0
+    for db in dbs:
+        row = db.query_one("SELECT COUNT(*) AS n FROM cycle_journal")
+        n += int(row["n"]) if row else 0
+    return n
+
+
+def _move_room(src_db: Database, dst_db: Database, room_id: int) -> None:
+    """Move one room's whole row-set between shard files: ATTACH the
+    source on the destination connection, copy parents→children,
+    delete children→parents, all in one transaction spanning both
+    files."""
+    dst_db.execute("ATTACH DATABASE ? AS src", (src_db.path,))
+    try:
+        with dst_db.transaction():
+            for table, cond in _ROOM_TABLES:
+                dst_db.execute(
+                    f"INSERT INTO {table} SELECT * FROM src.{table} "
+                    f"WHERE {cond}",
+                    (room_id,),
+                )
+            for table, cond in reversed(_ROOM_TABLES):
+                dst_db.execute(
+                    f"DELETE FROM src.{table} WHERE {cond}",
+                    (room_id,),
+                )
+    finally:
+        dst_db.execute("DETACH DATABASE src")
+
+
+# ---- process-wide default router ----
+
+_default_router: Optional[SwarmRouter] = None
+_default_router_lock = locks.make_lock("swarm_default")
+
+
+def default_router() -> SwarmRouter:
+    """The process-wide router, built on first use from
+    ``ROOM_TPU_SWARM_SHARDS`` (callers check the knob first:
+    ``db.get_database`` only routes here when it is > 1)."""
+    global _default_router
+    with _default_router_lock:
+        if _default_router is None:
+            _default_router = SwarmRouter()
+        return _default_router
+
+
+def maybe_default_router() -> Optional[SwarmRouter]:
+    """The default router when swarm sharding is configured, else
+    None — the cheap guard surfaces (health, metrics, runtime loops)
+    call this every tick."""
+    if _default_router is not None:
+        return _default_router
+    if knobs.get_int("ROOM_TPU_SWARM_SHARDS") > 1:
+        return default_router()
+    return None
+
+
+def reset_default_router() -> None:
+    """Testing hook (reached via db.reset_database_singleton)."""
+    global _default_router
+    with _default_router_lock:
+        if _default_router is not None:
+            _default_router.close()
+        _default_router = None

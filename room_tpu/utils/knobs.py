@@ -479,6 +479,35 @@ register("ROOM_TPU_POD_PEERS", "str", None,
          "','-separated host:port control-wire addresses of peer pod "
          "members; placement-map epochs publish to each over "
          "wire_send_control frames (empty = single-process pod).")
+register("ROOM_TPU_ROUTER_SHARD_HEARTBEATS", "bool", "0",
+         "Drive router-shard failover from per-shard heartbeats into "
+         "a PodMembership deadline-with-suspicion detector instead of "
+         "the in-process died_at timer — the detector works unchanged "
+         "when shard beats arrive over the control wire from separate "
+         "processes (docs/podnet.md).")
+
+# ---- swarm shards (docs/swarmshard.md) ----
+register("ROOM_TPU_SWARM_SHARDS", "int", "1",
+         "Swarm-runtime shards: rooms partition by room-id hash "
+         "across N shard SQLite files, each with its own agent-loop "
+         "supervision domain and event-bus segment, fronted by the "
+         "epoch-versioned placement map (1 = the classic singleton "
+         "database).", scope="swarm")
+register("ROOM_TPU_SWARM_LEASE_S", "float", "2.0",
+         "Swarm-shard ownership lease: a dead shard's rooms shed "
+         "(retryable) this long before a surviving sibling reopens "
+         "its database file, runs journal recovery over it, and "
+         "publishes a new placement epoch.", scope="swarm")
+register("ROOM_TPU_SWARM_DB_DIR", "path", None,
+         "Directory for swarm-shard database files (shard<k>.db); "
+         "default derives shard files next to the classic "
+         "ROOM_TPU_DB_PATH / ROOM_TPU_DATA_DIR database.",
+         scope="swarm")
+register("ROOM_TPU_DB_LOCK_STATS", "bool", "0",
+         "Track per-Database lock contention (waits + waited seconds) "
+         "— the swarm_storm bench's journal-write-contention probe; "
+         "off in production (two clock reads per contended "
+         "statement).", scope="bench")
 
 # ---- fleet-global shared prefix store (docs/disagg.md) ----
 register("ROOM_TPU_PREFIX_STORE", "bool", "0",

@@ -283,18 +283,25 @@ register_lock(
     module="room_tpu/core/telemetry.py", attr="_counters_lock",
 )
 register_lock(
-    "agent_registry", "Agent-loop registry: running loops + launched "
-    "rooms.",
-    module="room_tpu/core/agent_loop.py", attr="_registry_lock",
+    "agent_registry", "LoopDomain registry: running loops + launched "
+    "rooms (one domain per swarm shard; classic mode has exactly "
+    "one).",
+    module="room_tpu/core/agent_loop.py", cls="LoopDomain",
+    attr="_registry_lock", hints=("dom", "domain", "self.domain"),
+    multi_instance=True,
 )
 register_lock(
-    "agent_supervision", "Crash-strike history + unhealthy-worker "
-    "roster for supervise_loops.",
-    module="room_tpu/core/agent_loop.py", attr="_supervision_lock",
+    "agent_supervision", "LoopDomain crash-strike history + "
+    "unhealthy-worker roster for supervise_loops (one per domain).",
+    module="room_tpu/core/agent_loop.py", cls="LoopDomain",
+    attr="_supervision_lock", hints=("dom", "domain", "self.domain"),
+    multi_instance=True,
 )
 register_lock(
-    "event_bus", "EventBus subscriber lists.",
+    "event_bus", "EventBus subscriber lists (the global bus plus one "
+    "segment per swarm shard).",
     module="room_tpu/core/events.py", cls="EventBus", attr="_lock",
+    hints=("bus", "self.bus"), multi_instance=True,
 )
 register_lock(
     "task_slots", "Per-room concurrent task-run slot pool.",
@@ -313,6 +320,28 @@ register_lock(
 register_lock(
     "web_sessions", "Web-automation session table.",
     module="room_tpu/core/web_tools.py", attr="_sessions_lock",
+)
+
+# ---- swarm shards (docs/swarmshard.md) ----
+register_lock(
+    "swarm_router", "SwarmRouter shard table: shard states, adopted "
+    "db handles, cross-shard dispatch counters.",
+    module="room_tpu/swarm/shard.py", cls="SwarmRouter", attr="_lock",
+    hints=("router", "self.router"),
+)
+register_lock(
+    "swarm_default", "Process-default SwarmRouter singleton build.",
+    module="room_tpu/swarm/shard.py", attr="_default_router_lock",
+)
+register_lock(
+    "swarm_dispatch", "Per-shard cross-shard dispatch serialization: "
+    "journaled_once's dedup-check -> intent -> commit sequence is "
+    "check-then-act, so concurrent redeliveries of one idempotency "
+    "key must queue (one lock per shard file).",
+    module="room_tpu/swarm/shard.py", cls="SwarmShard",
+    attr="_dispatch_lock",
+    hints=("shard", "shard_src", "shard_dst", "self.shards"),
+    multi_instance=True,
 )
 
 # ---- db ----

@@ -339,6 +339,58 @@ def test_single_shard_back_compat(make_fleet, control):
     assert rs["epoch"] == 0
 
 
+def test_shard_heartbeat_leases_gate_adoption(make_fleet, control):
+    """ROOM_TPU_ROUTER_SHARD_HEARTBEATS: adoption waits for the
+    membership detector's suspect -> dead -> lease-expired verdict on
+    the dead shard's heartbeat silence, not the killer's died_at
+    timestamp — and serving shards keep beating alive."""
+    full, cont, _ = control
+    fleet = make_fleet(
+        n=1, shards=2,
+        env={
+            "ROOM_TPU_ROUTER_SHARD_HEARTBEATS": "1",
+            "ROOM_TPU_POD_SUSPECT_S": "0.01",
+            "ROOM_TPU_POD_DEAD_S": "0.02",
+        },
+    )
+    assert fleet._shard_membership is not None
+    sa, sb = _sids_on_shards(2)
+    for sid in (sa, sb):
+        t = fleet.submit(LONG_PROMPT, session_id=sid,
+                         sampling=_greedy(len(full)))
+        fleet.run_until_idle()
+        assert list(t.new_tokens) == full
+    fleet.supervise()
+    hb = fleet.fleet_stats()["router_shards"]["heartbeats"]
+    assert hb["shard-0"]["state"] == "alive"
+    assert hb["shard-1"]["state"] == "alive"
+    assert fleet.kill_router_shard(0, reason="test")
+    # the in-process timer contract is OFF: even with the router lease
+    # forced to zero, adoption waits for the detector's verdict
+    fleet.router_lease_s = 0.0
+    fleet.supervise()
+    assert fleet.fleet_stats()["router_shards"]["adoptions"] == 0
+    # silence runs the suspect -> dead -> lease course
+    fleet._shard_membership.lease_s = 0.0
+    deadline = time.monotonic() + 5.0
+    while fleet.fleet_stats()["router_shards"]["adoptions"] < 1:
+        time.sleep(0.02)
+        fleet.supervise()
+        assert time.monotonic() < deadline
+    hb = fleet.fleet_stats()["router_shards"]["heartbeats"]
+    assert hb["shard-0"]["state"] == "dead"
+    assert hb["shard-0"]["lease_fired"] is True
+    assert hb["shard-1"]["state"] == "alive"
+    rs = fleet.fleet_stats()["router_shards"]
+    assert rs["shards"]["0"]["state"] == "retired"
+    # both rooms resume on the adopter
+    for sid in (sa, sb):
+        t = fleet.submit(CONT, session_id=sid,
+                         sampling=_greedy(len(cont)))
+        fleet.run_until_idle()
+        assert list(t.new_tokens) == cont
+
+
 # ---- chaos fault points ----
 
 def test_placement_io_fault_costs_staleness_never_forks(make_fleet):

@@ -1,0 +1,424 @@
+"""Swarm shard suite (docs/swarmshard.md).
+
+CI quick tier (lockdep-armed in the chaos job) for the room-partitioned
+swarm runtime: placement + ID striding, cross-shard dispatch
+exactly-once, shard crash + journal adoption, N→M re-placement, and the
+``shard_crash`` chaos fault point:
+
+- ID striding: every shard file mints from its own
+  billion-wide band, so ids (and their placement hashes) never collide
+  across files.
+- Cross-shard message_send / escalation ride journaled effect intents
+  keyed by content-derived idempotency keys: a duplicate dispatch (the
+  retry after a crash) returns the SAME row ids and writes nothing.
+- Killing a shard sheds its rooms (ShardDownError) for the swarm
+  lease; the least-loaded sibling then reopens the file, runs journal
+  recovery, and takes ownership under a NEW placement epoch — after
+  which a redelivery of the pre-crash dispatch still dedups (zero
+  double-fired effects).
+- resize_swarm N→M moves every re-homed room's whole row-set with zero
+  journal rows lost and ids preserved.
+- faults.inject("shard_crash") kills the busiest serving shard at the
+  next supervise; adoption heals it.
+- The runtime ticks iterate every shard when the default router is
+  armed (ROOM_TPU_SWARM_SHARDS).
+"""
+
+import threading
+
+import pytest
+
+from room_tpu.core import journal as journal_mod
+from room_tpu.core.events import event_bus
+from room_tpu.db import Database
+from room_tpu.serving import faults
+from room_tpu.swarm import (
+    ShardDownError, SwarmRouter, maybe_default_router,
+    reset_default_router, resize_swarm, shard_db_path,
+)
+from room_tpu.swarm.shard import ID_STRIDE
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    reset_default_router()
+    yield
+    faults.clear()
+    reset_default_router()
+
+
+@pytest.fixture()
+def router(tmp_path):
+    r = SwarmRouter(n_shards=4, db_dir=str(tmp_path), lease_s=0.0)
+    yield r
+    r.close()
+
+
+def _room_on_shard(router, shard_id):
+    """Create rooms until one lands on ``shard_id`` (data home)."""
+    for i in range(64):
+        room = router.create_room(f"probe-{i}")
+        if router.base_home(room["id"]) == shard_id:
+            return room
+    raise AssertionError("allocator never hit the shard")
+
+
+def _two_rooms_on_distinct_shards(router):
+    a = router.create_room("alpha")
+    for _ in range(64):
+        b = router.create_room("beta")
+        if router.base_home(b["id"]) != router.base_home(a["id"]):
+            return a, b
+    raise AssertionError("allocator never split shards")
+
+
+# ---- placement + striding ----
+
+def test_id_striding_and_placement(router):
+    """Each shard file mints from its own billion-wide band; the
+    swarm-global room counter keeps room ids unique; db_for routes by
+    the placement map."""
+    rooms = [router.create_room(f"room-{i}") for i in range(8)]
+    ids = [r["id"] for r in rooms]
+    assert len(set(ids)) == 8
+    homes = {router.base_home(i) for i in ids}
+    assert len(homes) > 1           # 8 rooms spread over 4 shards
+    for rid in ids:
+        home = router.base_home(rid)
+        db = router.db_for(rid)
+        assert db is router.shards[home].db
+        row = db.query_one("SELECT id FROM rooms WHERE id=?", (rid,))
+        assert row is not None
+        # the queen worker's id came from the shard's strided band
+        w = db.query_one(
+            "SELECT id FROM workers WHERE room_id=?", (rid,)
+        )
+        if home > 0:
+            assert w["id"] >= home * ID_STRIDE
+        else:
+            assert w["id"] < ID_STRIDE
+
+
+def test_shard_db_paths(tmp_path):
+    assert shard_db_path(2, str(tmp_path)).endswith("shard2.db")
+    assert shard_db_path(0, str(tmp_path)).endswith("shard0.db")
+
+
+def test_meta_db_and_single_shard_back_compat(tmp_path):
+    """n_shards=1 is the classic runtime: one file, no striding, no
+    cross-shard seam — send_message stays a same-DB insert pair."""
+    r = SwarmRouter(n_shards=1, db_dir=str(tmp_path))
+    try:
+        a = r.create_room("a")
+        b = r.create_room("b")
+        assert a["id"] < ID_STRIDE and b["id"] < ID_STRIDE
+        out_id, in_id = r.send_message(a["id"], b["id"], "s", "hello")
+        assert out_id and in_id
+        assert r.stats["cross_shard_messages"] == 0
+    finally:
+        r.close()
+
+
+def test_maybe_default_router_gated_by_knob(monkeypatch, tmp_path):
+    monkeypatch.setenv("ROOM_TPU_SWARM_DB_DIR", str(tmp_path))
+    monkeypatch.setenv("ROOM_TPU_SWARM_SHARDS", "1")
+    assert maybe_default_router() is None
+    monkeypatch.setenv("ROOM_TPU_SWARM_SHARDS", "3")
+    r = maybe_default_router()
+    assert r is not None and r.n_shards == 3
+    assert maybe_default_router() is r    # cached singleton
+    reset_default_router()
+    assert r._closed
+
+
+# ---- cross-shard dispatch: exactly-once ----
+
+def test_cross_shard_message_exactly_once(router):
+    a, b = _two_rooms_on_distinct_shards(router)
+    out_id, in_id = router.send_message(
+        a["id"], b["id"], "subj", "body-1"
+    )
+    assert out_id and in_id
+    # the duplicate dispatch (a retry) returns the SAME ids and
+    # writes nothing
+    again = router.send_message(a["id"], b["id"], "subj", "body-1")
+    assert again == (out_id, in_id)
+    assert router.stats["dedup_skips"] >= 1
+    inbound = router.db_for(b["id"]).query(
+        "SELECT * FROM room_messages WHERE to_room_id=?", (b["id"],)
+    )
+    assert len(inbound) == 1
+    # a DIFFERENT body is a different effect: new row
+    router.send_message(a["id"], b["id"], "subj", "body-2")
+    inbound = router.db_for(b["id"]).query(
+        "SELECT * FROM room_messages WHERE to_room_id=?", (b["id"],)
+    )
+    assert len(inbound) == 2
+    assert router.stats["cross_shard_messages"] >= 2
+
+
+def test_cross_shard_escalation_exactly_once(router):
+    a, b = _two_rooms_on_distinct_shards(router)
+    del a
+    eid = router.escalate(b["id"], "need a keeper?")
+    assert router.escalate(b["id"], "need a keeper?") == eid
+    rows = router.db_for(b["id"]).query(
+        "SELECT * FROM escalations WHERE room_id=?", (b["id"],)
+    )
+    assert len(rows) == 1
+    assert router.stats["cross_shard_escalations"] >= 1
+
+
+def test_xshard_journal_rows_survive_recovery(router):
+    """journal.recover() must not flag committed xshard effect rows:
+    they are the dedup evidence, not abandoned work."""
+    a, b = _two_rooms_on_distinct_shards(router)
+    ids = router.send_message(a["id"], b["id"], "s", "m")
+    db = router.db_for(b["id"])
+    journal_mod.recover(db)
+    assert router.send_message(a["id"], b["id"], "s", "m") == ids
+
+
+# ---- shard crash + adoption ----
+
+def test_shard_crash_sheds_then_adoption_redelivers_exactly_once(
+    router,
+):
+    a, b = _two_rooms_on_distinct_shards(router)
+    victim = router.base_home(b["id"])
+    pre_epoch = router.placement.epoch
+    ids = router.send_message(a["id"], b["id"], "s", "pre-crash")
+    router.kill_shard(victim, reason="test")
+    assert router.shards[victim].state == "dead"
+    # dead window (lease_s=0 still sheds until adopt runs): the
+    # victim's rooms shed with the transient-error contract
+    with pytest.raises(ShardDownError) as ei:
+        router.db_for(b["id"])
+    assert ei.value.shard_id == victim and ei.value.transient
+    assert router.stats["sheds"] >= 1
+    adopted = router.adopt_dead_shards()
+    assert len(adopted) == 1
+    assert adopted[0]["shard"] == victim
+    assert router.placement.epoch == pre_epoch + 1
+    assert router.shards[victim].state == "retired"
+    # ownership moved: the adopter serves the victim's rooms over the
+    # reopened origin file
+    adopter = adopted[0]["adopter"]
+    assert router.owner_of(b["id"]) == adopter
+    db = router.db_for(b["id"])
+    assert db.query_one(
+        "SELECT id FROM rooms WHERE id=?", (b["id"],)
+    )
+    # the pre-crash dispatch REDELIVERED post-adoption dedups: zero
+    # double-fired effects across the failover
+    assert router.send_message(a["id"], b["id"], "s", "pre-crash") \
+        == ids
+    inbound = db.query(
+        "SELECT * FROM room_messages WHERE to_room_id=?", (b["id"],)
+    )
+    assert len(inbound) == 1
+
+
+def test_kill_last_serving_shard_refused(tmp_path):
+    r = SwarmRouter(n_shards=2, db_dir=str(tmp_path), lease_s=0.0)
+    try:
+        assert r.kill_shard(0) is True
+        assert r.kill_shard(1) is False   # nobody left to adopt
+        assert r.shards[1].state == "serving"
+    finally:
+        r.close()
+
+
+def test_shard_crash_fault_point_heals(router):
+    """faults.inject("shard_crash") kills the busiest serving shard at
+    the next supervise; the same pass adopts it (lease 0)."""
+    _room_on_shard(router, 2)
+    faults.inject("shard_crash", times=1)
+    router.supervise()
+    assert faults.fired("shard_crash") == 1
+    assert router.stats["shard_crashes"] == 1
+    assert router.stats["adoptions"] == 1
+    states = [s.state for s in router.shards]
+    assert states.count("retired") == 1
+    assert states.count("serving") == 3
+
+
+# ---- event-bus segments ----
+
+def test_room_events_land_on_owning_shard_segment(router):
+    a, b = _two_rooms_on_distinct_shards(router)
+    sa = router.shards[router.base_home(a["id"])]
+    sb = router.shards[router.base_home(b["id"])]
+    got = []
+    unsub = sb.bus.subscribe(None, got.append)
+    try:
+        event_bus.emit("x:ping", f"room:{a['id']}", {})
+        event_bus.emit("x:ping", f"room:{b['id']}", {})
+        event_bus.emit("x:ping", "runtime", {})   # non-room: untouched
+    finally:
+        unsub()
+    assert [e.channel for e in got] == [f"room:{b['id']}"]
+    assert sa.stats["events"] >= 1 and sb.stats["events"] >= 1
+
+
+# ---- N→M re-placement ----
+
+def test_resize_moves_rooms_zero_journal_loss(tmp_path):
+    r = SwarmRouter(n_shards=4, db_dir=str(tmp_path), lease_s=0.0)
+    rooms = [
+        r.create_room(f"room-{i}", goal=f"goal {i}") for i in range(6)
+    ]
+    a, b = rooms[0], next(
+        x for x in rooms[1:]
+        if r.base_home(x["id"]) != r.base_home(rooms[0]["id"])
+    )
+    ids = r.send_message(a["id"], b["id"], "s", "survives resize")
+    r2, summary = resize_swarm(r, 2, db_dir=str(tmp_path))
+    try:
+        assert summary["old_shards"] == 4
+        assert summary["new_shards"] == 2
+        assert summary["journal_rows_lost"] == 0
+        assert summary["rooms_moved"] + summary["rooms_kept"] == 6
+        for room in rooms:
+            db = r2.db_for(room["id"])
+            row = db.query_one(
+                "SELECT id, name FROM rooms WHERE id=?", (room["id"],)
+            )
+            assert row is not None and row["name"] == room["name"]
+            # the whole row-set moved with it
+            assert db.query_one(
+                "SELECT id FROM workers WHERE room_id=?",
+                (room["id"],),
+            ) is not None
+            assert db.query_one(
+                "SELECT id FROM goals WHERE room_id=?", (room["id"],)
+            ) is not None
+        # dedup evidence moved too: the pre-resize dispatch still
+        # dedups on the new topology
+        assert r2.send_message(a["id"], b["id"], "s",
+                               "survives resize") == ids
+        # new rooms keep minting unique ids
+        extra = r2.create_room("post-resize")
+        assert extra["id"] not in {x["id"] for x in rooms}
+    finally:
+        r2.close()
+
+
+def test_resize_scale_up(tmp_path):
+    r = SwarmRouter(n_shards=2, db_dir=str(tmp_path), lease_s=0.0)
+    rooms = [r.create_room(f"room-{i}") for i in range(5)]
+    r2, summary = resize_swarm(r, 5, db_dir=str(tmp_path))
+    try:
+        assert summary["new_shards"] == 5
+        assert summary["journal_rows_lost"] == 0
+        for room in rooms:
+            assert r2.db_for(room["id"]).query_one(
+                "SELECT id FROM rooms WHERE id=?", (room["id"],)
+            ) is not None
+    finally:
+        r2.close()
+
+
+# ---- schema v3 migration ----
+
+def test_v2_journal_migrates_to_xshard_check(tmp_path):
+    """A pre-v3 file (kind CHECK without 'xshard') is rebuilt in place:
+    old rows survive, new xshard intents insert."""
+    from room_tpu.db.schema import SCHEMA
+
+    path = str(tmp_path / "old.db")
+    import sqlite3
+
+    conn = sqlite3.connect(path)
+    conn.executescript(
+        SCHEMA.replace("'cycle','task_run','xshard'",
+                       "'cycle','task_run'")
+    )
+    conn.execute(
+        "INSERT INTO cycle_journal(kind, ref_id, room_id, worker_id, "
+        "entry, status) VALUES ('cycle', 7, 1, 1, 'started', 'open')"
+    )
+    # stamp the ledger at v2 so the next open runs the v3 rebuild
+    # (an EMPTY ledger means a fresh file: migrations stamp-only)
+    conn.execute("INSERT INTO schema_migrations(version) VALUES (1)")
+    conn.execute("INSERT INTO schema_migrations(version) VALUES (2)")
+    conn.commit()
+    conn.close()
+    db = Database(path)
+    try:
+        rows = db.query("SELECT * FROM cycle_journal")
+        assert len(rows) == 1 and rows[0]["kind"] == "cycle"
+        assert rows[0]["ref_id"] == 7
+        db.execute(
+            "INSERT INTO cycle_journal(kind, ref_id, room_id, "
+            "worker_id, entry, status, idem_key) VALUES "
+            "('xshard', 0, 1, 1, 'effect', 'intent', 'k1')"
+        )
+        assert db.query_one(
+            "SELECT kind FROM cycle_journal WHERE idem_key='k1'"
+        )["kind"] == "xshard"
+    finally:
+        db.close()
+
+
+# ---- runtime integration ----
+
+def test_runtime_ticks_iterate_all_shards(monkeypatch, tmp_path):
+    """With the default router armed, ServerRuntime's ticks cover
+    every shard: a stale run on shard N is swept without the runtime
+    holding that shard's handle."""
+    from room_tpu.server.runtime import ServerRuntime
+
+    monkeypatch.setenv("ROOM_TPU_SWARM_DB_DIR", str(tmp_path))
+    monkeypatch.setenv("ROOM_TPU_SWARM_SHARDS", "3")
+    router = maybe_default_router()
+    assert router is not None
+    rooms = [router.create_room(f"room-{i}") for i in range(4)]
+    rt = ServerRuntime(db=router.db_for())
+    # a crash-stranded worker on every shard
+    for room in rooms:
+        db = router.db_for(room["id"])
+        db.execute(
+            "UPDATE workers SET agent_state='running' WHERE room_id=?",
+            (room["id"],),
+        )
+    n = rt.cleanup_stale(startup=True)
+    assert n >= len(rooms)
+    for room in rooms:
+        w = router.db_for(room["id"]).query_one(
+            "SELECT agent_state FROM workers WHERE room_id=?",
+            (room["id"],),
+        )
+        assert w["agent_state"] == "idle"
+    # per-shard supervision domains are distinct objects
+    doms = {id(s.domain) for s in router.shards}
+    assert len(doms) == len(router.shards)
+    rt.supervision_tick()   # covers router.supervise() + every domain
+
+
+def test_concurrent_cross_shard_sends_stay_exactly_once(router):
+    """The storm seam in miniature: many threads redeliver the same
+    logical message; exactly one inbound row lands."""
+    a, b = _two_rooms_on_distinct_shards(router)
+    results, errs = [], []
+
+    def fire():
+        try:
+            results.append(
+                router.send_message(a["id"], b["id"], "s", "dup")
+            )
+        except Exception as e:       # pragma: no cover - diagnostics
+            errs.append(e)
+
+    threads = [threading.Thread(target=fire) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(set(results)) == 1
+    inbound = router.db_for(b["id"]).query(
+        "SELECT * FROM room_messages WHERE to_room_id=?", (b["id"],)
+    )
+    assert len(inbound) == 1

@@ -1,0 +1,130 @@
+"""WebSocket hub per-channel subscription suite (docs/swarmshard.md).
+
+Regression for the firehose removal: the hub holds ONE ref-counted
+event-bus subscription per channel some client asked for, so an event
+on a channel nobody watches never reaches the hub's fan-out handler at
+all — with swarm shards emitting every room's traffic onto the global
+bus, the old subscribe-everything handler made every hub pay O(events)
+for O(subscribed) interest.
+"""
+
+import pytest
+
+from room_tpu.core.events import event_bus
+from room_tpu.server.ws import WebSocketHub, _Client
+
+
+class _FakeSock:
+    def __init__(self):
+        self.sent = []
+
+    def sendall(self, data):
+        self.sent.append(data)
+
+    def close(self):
+        pass
+
+    def shutdown(self, how):
+        pass
+
+
+@pytest.fixture()
+def hub():
+    h = WebSocketHub(server=object())
+    yield h
+    h.stop()
+
+
+def _attach(hub, channels=()):
+    """A connected client the way handle_upgrade + the reader loop
+    build one: registered, then per-channel acquire."""
+    client = _Client(_FakeSock())
+    client.frames = []
+    client.send_text = lambda t: client.frames.append(t) or True
+    with hub._lock:
+        hub._clients.append(client)
+    for ch in channels:
+        client.channels.add(ch)
+        hub._acquire_channel(ch)
+    return client
+
+
+def test_no_subscription_no_bus_handler(hub, monkeypatch):
+    """THE regression: before any subscribe the hub holds zero bus
+    subscriptions, and an event on an unwatched channel never invokes
+    the fan-out handler."""
+    calls = []
+    monkeypatch.setattr(
+        hub, "_fanout",
+        lambda ev, ch: calls.append((ev.channel, ch)),
+    )
+    client = _attach(hub)
+    assert hub.subscribed_channels == []
+    event_bus.emit("x", "room:1", {})
+    event_bus.emit("x", "runtime", {})
+    assert calls == []
+    assert client.frames == []
+    # subscribing arms exactly that channel — other channels still
+    # never reach the handler
+    client.channels.add("room:1")
+    hub._acquire_channel("room:1")
+    event_bus.emit("x", "room:1", {})
+    event_bus.emit("x", "room:2", {})
+    assert calls == [("room:1", "room:1")]
+
+
+def test_subscribed_channel_delivers_and_unsubscribe_stops(hub):
+    client = _attach(hub, ["room:7"])
+    event_bus.emit("x", "room:7", {"k": 1})
+    assert len(client.frames) == 1
+    assert '"channel": "room:7"' in client.frames[0]
+    # unsubscribe (the reader-loop path): bus subscription released
+    client.channels.discard("room:7")
+    hub._release_channel("room:7")
+    assert hub.subscribed_channels == []
+    event_bus.emit("x", "room:7", {"k": 2})
+    assert len(client.frames) == 1
+
+
+def test_wildcard_subscription_and_exact_dedup(hub):
+    """A client on both "*" and an exact channel sees each event
+    exactly once."""
+    client = _attach(hub, ["*", "room:3"])
+    event_bus.emit("x", "room:3", {})
+    event_bus.emit("x", "room:9", {})
+    assert len(client.frames) == 2
+    channels = [f for f in client.frames]
+    assert sum('"room:3"' in f for f in channels) == 1
+    assert sum('"room:9"' in f for f in channels) == 1
+
+
+def test_channel_refcount_across_clients(hub):
+    a = _attach(hub, ["room:5"])
+    b = _attach(hub, ["room:5"])
+    assert hub.subscribed_channels == ["room:5"]
+    hub._drop_client(a)
+    # still subscribed for b
+    assert hub.subscribed_channels == ["room:5"]
+    event_bus.emit("x", "room:5", {})
+    assert len(b.frames) == 1 and a.frames == []
+    hub._drop_client(b)
+    assert hub.subscribed_channels == []
+    # double-drop is a no-op
+    hub._drop_client(b)
+
+
+def test_dead_client_releases_its_channels(hub):
+    """A send failure (slow consumer) drops the client and releases
+    its subscriptions."""
+    client = _attach(hub, ["room:2"])
+    client.send_text = lambda t: False   # writer queue full / dead
+    event_bus.emit("x", "room:2", {})
+    assert hub.client_count == 0
+    assert hub.subscribed_channels == []
+
+
+def test_stop_releases_everything(hub):
+    _attach(hub, ["room:1", "*"])
+    hub.stop()
+    assert hub.subscribed_channels == []
+    assert hub.client_count == 0

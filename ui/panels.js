@@ -1594,6 +1594,30 @@ async function renderTpu(el) {
             <span class="dim">effects replay-skipped:
               ${hl.swarm?.journal?.replay_consumed ?? 0}</span></span>
       </div>
+      ${(hl.swarm?.shards?.n_shards ?? 1) > 1 ? `
+      <h2 style="margin-top:.6rem">swarm shards
+        <span class="dim">epoch ${hl.swarm.shards.placement?.epoch ?? 0}
+          · ${hl.swarm.shards.cross_shard_messages ?? 0} x-shard msgs
+          · ${hl.swarm.shards.dedup_skips ?? 0} deduped
+          · ${hl.swarm.shards.adoptions ?? 0} adoptions</span></h2>
+      <table><tr><th>shard</th><th>state</th><th>rooms</th>
+        <th>events</th><th>msgs in/out</th><th>escalations</th>
+        <th>journal backlog</th><th>adopted</th></tr>
+      ${(hl.swarm.shards.shards || []).map((s) => `
+        <tr><td>${s.shard}</td>
+        <td><span class="pill ${
+          s.state === "serving" ? "verified"
+          : s.state === "dead" ? "failed" : "pending"
+        }">${esc(s.state)}</span></td>
+        <td>${s.rooms_created ?? 0}</td>
+        <td>${s.events ?? 0}</td>
+        <td>${s.messages_in ?? 0} / ${s.messages_out ?? 0}</td>
+        <td>${s.escalations ?? 0}</td>
+        <td class="dim">${s.journal?.backlog ?? 0}</td>
+        <td class="dim">${(s.adopted || []).map((a) =>
+          `#${a}`).join(" ") || "—"}</td>
+        </tr>`).join("")}
+      </table>` : ""}
       <h2 style="margin-top:.6rem">lifecycle</h2>
       <div class="kv">
         <span class="k">process phase</span>
