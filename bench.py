@@ -1339,7 +1339,7 @@ def main() -> None:
         import threading as _threading
 
         from room_tpu.core import journal as journal_mod
-        from room_tpu.swarm import SwarmRouter
+        from room_tpu.swarm import SwarmRouter, shard_db_path
 
         n_rooms = int(
             os.environ.get("ROOM_TPU_BENCH_SWARM_ROOMS", "112")
@@ -1378,6 +1378,11 @@ def main() -> None:
                         )
             sent: list[str] = []
             turn_s: list[float] = []
+            # per-HOME turn latency: the per-shard p50/p95 columns
+            # expose a hot shard hiding inside a healthy global p95
+            shard_turn_s: dict[int, list[float]] = {
+                k: [] for k in range(n_shards)
+            }
             shed = {"n": 0}
 
             def one_turn(i: int, turn: int) -> None:
@@ -1416,7 +1421,9 @@ def main() -> None:
                     rid, rids[(i + 17) % n_rooms], subject,
                     f"turn {turn} of room {rid}",
                 )
-                turn_s.append(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                turn_s.append(dt)
+                shard_turn_s[router.base_home(rid)].append(dt)
                 sent.append(subject)
 
             def redispatch(i: int, turn: int) -> None:
@@ -1532,8 +1539,35 @@ def main() -> None:
                 n - 1 for n in delivered.values() if n > 1
             )
             snap = router.snapshot()
+            # per-shard columns: cycle-latency spread + on-disk
+            # journal growth (file size is the durability bill the
+            # shard paid for the storm)
+            per_shard = []
+            for k in range(n_shards):
+                samples = sorted(shard_turn_s[k])
+                try:
+                    jbytes = os.path.getsize(shard_db_path(k, tmp))
+                except OSError:
+                    jbytes = 0
+                per_shard.append({
+                    "shard": k,
+                    "turns": len(samples),
+                    "turn_p50_ms": round(
+                        samples[len(samples) // 2] * 1e3, 3
+                    ) if samples else None,
+                    "turn_p95_ms": round(
+                        samples[int(len(samples) * 0.95)] * 1e3, 3
+                    ) if samples else None,
+                    "journal_bytes": jbytes,
+                })
             if CPU_PROXY and n_shards == 1:
                 _proxy_deltas["swarm_storm_1shard_tput"] = tput
+            if CPU_PROXY and n_shards > 1:
+                _proxy_deltas["swarm_storm_shard_p95_ms_max"] = max(
+                    (s["turn_p95_ms"] or 0) for s in per_shard
+                )
+                _proxy_deltas["swarm_storm_journal_bytes_total"] = \
+                    sum(s["journal_bytes"] for s in per_shard)
             return {
                 "n_shards": n_shards,
                 "rooms": n_rooms,
@@ -1551,6 +1585,7 @@ def main() -> None:
                 "shard_crashes": snap["shard_crashes"],
                 "adoptions": snap["adoptions"],
                 "placement_epoch": snap["placement"]["epoch"],
+                "per_shard": per_shard,
             }
         finally:
             if router is not None:
@@ -1607,6 +1642,314 @@ def main() -> None:
                 })
         except Exception as e:
             _phase("swarm_storm_4shard", {"error": str(e)[:300]})
+
+    # Process-mode swarm storm (docs/swarmshard.md "Process mode"):
+    # the same cross-room message workload against (a) the in-process
+    # 4-shard router and (b) 4 supervised shard child PROCESSES with
+    # every dispatch riding a framed control-wire frame — including a
+    # SIGKILL of one live child mid-storm (supervised restart +
+    # journal replay), a byte-identical duplicate wave, and a
+    # budget-exhaustion arm degrading to sibling adoption.
+    # Acceptance: zero messages lost, zero double-fired, a restart
+    # observed, the bystander shards' p95 unaffected, and the
+    # exhausted-budget shard unhealthy after adoption.
+    def measure_swarm_storm_proc() -> dict:
+        import shutil
+        import signal as _signal
+        import tempfile
+        import threading as _threading
+
+        from room_tpu.db import Database
+        from room_tpu.swarm import (
+            ProcSupervisor, ShardDownError, SwarmRouter,
+            shard_db_path,
+        )
+
+        n_rooms = int(os.environ.get(
+            "ROOM_TPU_BENCH_SWARM_PROC_ROOMS", "112"
+        ))
+        waves = int(os.environ.get(
+            "ROOM_TPU_BENCH_SWARM_PROC_WAVES", "2"
+        ))
+        n_threads = 8
+        fast = dict(suspect_s=0.6, dead_s=1.2, lease_s=0.4,
+                    backoff_s=0.05, hb_s=0.15)
+        out: dict = {"n_shards": 4, "rooms": n_rooms,
+                     "waves": waves}
+
+        def run_sends(send, rids, tag, victim_home=None,
+                      on_victim_pick=None):
+            """Fire waves*n_rooms cross-room sends on 8 threads;
+            returns (elapsed_s, all_lat, bystander_lat, fails)."""
+            jobs = [(i, t) for t in range(waves)
+                    for i in range(n_rooms)]
+            idx = {"n": 0}
+            lock = _threading.Lock()
+            lat: list[tuple[float, bool]] = []
+            fails: list[tuple[int, int]] = []
+
+            def work():
+                while True:
+                    with lock:
+                        k = idx["n"]
+                        if k >= len(jobs):
+                            return
+                        idx["n"] = k + 1
+                    i, t = jobs[k]
+                    src, dst = rids[i], rids[(i + 17) % n_rooms]
+                    t0 = time.perf_counter()
+                    try:
+                        send(src, dst, f"{tag} {i}:{t}",
+                             f"wave {t} room {src}")
+                    except Exception:
+                        with lock:
+                            fails.append((i, t))
+                        continue
+                    bystander = victim_home is None or (
+                        victim_home["k"] is not None
+                        and victim_home["k"] not in (
+                            base_home(src), base_home(dst),
+                        )
+                    )
+                    with lock:
+                        lat.append(
+                            (time.perf_counter() - t0, bystander)
+                        )
+
+            t0 = time.perf_counter()
+            threads = [
+                _threading.Thread(target=work, daemon=True)
+                for _ in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            if on_victim_pick is not None:
+                while True:
+                    with lock:
+                        if idx["n"] >= len(jobs) // 3:
+                            break
+                    time.sleep(0.002)
+                on_victim_pick()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, lat, fails
+
+        def pctl(samples, q):
+            s = sorted(samples)
+            return round(s[int(len(s) * q)] * 1e3, 3) if s else None
+
+        # ---- arm A: the in-process 4-shard router, same workload
+        tmp_a = tempfile.mkdtemp(prefix="bench-swarmproc-a-")
+        router = None
+        try:
+            router = SwarmRouter(n_shards=4, db_dir=tmp_a,
+                                 lease_s=0.0)
+            base_home = router.base_home
+            rids = [
+                router.create_room(f"pstorm-{i}")["id"]
+                for i in range(n_rooms)
+            ]
+            elapsed, lat, fails = run_sends(
+                router.send_message, rids, "inproc"
+            )
+            assert not fails, fails[:3]
+            out["inproc_send_tput_per_s"] = round(
+                (waves * n_rooms) / max(elapsed, 1e-9), 1
+            )
+            out["inproc_send_p50_ms"] = pctl(
+                [d for d, _ in lat], 0.5
+            )
+        finally:
+            if router is not None:
+                router.close()
+            del router
+            gc.collect()
+            shutil.rmtree(tmp_a, ignore_errors=True)
+
+        # ---- arm B: 4 shard child processes, crash mid-storm
+        tmp_b = tempfile.mkdtemp(prefix="bench-swarmproc-b-")
+        sup = None
+        try:
+            sup = ProcSupervisor(n_shards=4, db_dir=str(tmp_b),
+                                 **fast)
+            base_home = sup.base_home
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if all(c["state"] == "serving"
+                       for c in sup.snapshot()["children"]):
+                    break
+                time.sleep(0.1)
+            rids = [
+                sup.create_room(f"pstorm-{i}")["id"]
+                for i in range(n_rooms)
+            ]
+            stop = _threading.Event()
+
+            def supervise_loop():
+                while not stop.is_set():
+                    sup.supervise()
+                    time.sleep(0.05)
+
+            sup_thread = _threading.Thread(
+                target=supervise_loop, daemon=True
+            )
+            sup_thread.start()
+
+            def send_retrying(src, dst, subject, body):
+                give_up = time.monotonic() + 30
+                while True:
+                    try:
+                        return sup.send_message(
+                            src, dst, subject, body
+                        )
+                    except ShardDownError:
+                        if time.monotonic() >= give_up:
+                            raise
+                        time.sleep(0.05)
+
+            victim_home = {"k": None}
+
+            def kill_one():
+                live = [
+                    c for c in sup.snapshot()["children"]
+                    if c["state"] == "serving"
+                    and c["pid"] is not None
+                ]
+                if not live:
+                    return
+                victim = max(live, key=lambda c: c["frames"])
+                victim_home["k"] = victim["shard"]
+                try:
+                    os.kill(victim["pid"], _signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+            elapsed, lat, fails = run_sends(
+                send_retrying, rids, "pstorm",
+                victim_home=victim_home, on_victim_pick=kill_one,
+            )
+            assert not fails, fails[:3]
+            # the timed section EATS the crash: restart + shed
+            # retries are inside this wall-clock, the bystander p95
+            # is the sends that touched neither half of the victim
+            out["proc_send_tput_per_s"] = round(
+                (waves * n_rooms) / max(elapsed, 1e-9), 1
+            )
+            out["proc_send_p50_ms"] = pctl([d for d, _ in lat], 0.5)
+            out["proc_send_p95_ms"] = pctl([d for d, _ in lat], 0.95)
+            out["bystander_p95_ms"] = pctl(
+                [d for d, by in lat if by], 0.95
+            )
+            out["victim_shard"] = victim_home["k"]
+            # byte-identical duplicate wave: every one must dedup
+            for k in range(25):
+                i, t = k % n_rooms, k % waves
+                send_retrying(
+                    rids[i], rids[(i + 17) % n_rooms],
+                    f"pstorm {i}:{t}", f"wave {t} room {rids[i]}",
+                )
+            out["restarts"] = sup.stats["restarts"]
+            out["dedup_skips"] = sup.stats["dedup_skips"]
+            stop.set()
+            sup_thread.join(timeout=5)
+            sup.stop()
+            # exactly-once accounting straight off the shard files
+            delivered: dict[str, int] = {}
+            for k in range(4):
+                db = Database(shard_db_path(k, str(tmp_b)))
+                try:
+                    for row in db.query(
+                        "SELECT subject, COUNT(*) AS n FROM "
+                        "room_messages WHERE direction='inbound' "
+                        "AND subject LIKE 'pstorm %' "
+                        "GROUP BY subject"
+                    ):
+                        delivered[row["subject"]] = (
+                            delivered.get(row["subject"], 0)
+                            + row["n"]
+                        )
+                finally:
+                    db.close()
+            expect = {
+                f"pstorm {i}:{t}" for t in range(waves)
+                for i in range(n_rooms)
+            }
+            out["messages_sent"] = len(expect)
+            out["messages_lost"] = sum(
+                1 for s in expect if delivered.get(s, 0) == 0
+            )
+            out["double_fired"] = sum(
+                n - 1 for n in delivered.values() if n > 1
+            )
+        finally:
+            if sup is not None:
+                sup.stop()
+            del sup
+            gc.collect()
+            shutil.rmtree(tmp_b, ignore_errors=True)
+
+        # ---- arm C: restart budget exhausted -> sibling adoption
+        tmp_c = tempfile.mkdtemp(prefix="bench-swarmproc-c-")
+        sup = None
+        try:
+            sup = ProcSupervisor(n_shards=2, db_dir=str(tmp_c),
+                                 restart_budget=0, **fast)
+            base_home = sup.base_home
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if all(c["state"] == "serving"
+                       for c in sup.snapshot()["children"]):
+                    break
+                time.sleep(0.1)
+            rids = [
+                sup.create_room(f"bstorm-{i}")["id"]
+                for i in range(8)
+            ]
+            victim = sup.snapshot()["children"][1]
+            if victim["pid"] is not None:
+                os.kill(victim["pid"], _signal.SIGKILL)
+            deadline = time.monotonic() + 25
+            adoptions = []
+            while time.monotonic() < deadline and not adoptions:
+                adoptions = sup.supervise()
+                time.sleep(0.05)
+            out["budget_adoptions"] = len(adoptions)
+            out["budget_unhealthy"] = sup.unhealthy_shards()
+            # traffic keeps flowing through the adopter
+            give_up = time.monotonic() + 20
+            while True:
+                try:
+                    sup.send_message(rids[0], rids[1],
+                                     "post-adopt", "x")
+                    break
+                except ShardDownError:
+                    if time.monotonic() >= give_up:
+                        raise
+                    time.sleep(0.05)
+            out["budget_post_adopt_send_ok"] = True
+        finally:
+            if sup is not None:
+                sup.stop()
+            del sup
+            gc.collect()
+            shutil.rmtree(tmp_c, ignore_errors=True)
+
+        if CPU_PROXY:
+            _proxy_deltas["swarm_storm_proc_tput"] = \
+                out["proc_send_tput_per_s"]
+            _proxy_deltas["swarm_storm_proc_wire_overhead"] = round(
+                out["inproc_send_tput_per_s"]
+                / max(out["proc_send_tput_per_s"], 1e-9), 3,
+            )
+        return out
+
+    if os.environ.get("ROOM_TPU_BENCH_SWARM", "1") != "0" and \
+            os.environ.get("ROOM_TPU_BENCH_SWARM_PROC", "1") != "0":
+        _extend_deadline()
+        try:
+            _phase("swarm_storm_proc", measure_swarm_storm_proc())
+        except Exception as e:
+            _phase("swarm_storm_proc", {"error": str(e)[:300]})
 
     # Disaggregated prefill/decode A/B (docs/disagg.md): a burst of
     # 2k-token prompts against (a) a mixed fleet — every replica eats

@@ -363,6 +363,88 @@ def render_metrics() -> str:
                     swarm_fam.add({"shard": sk, "stat": key}, v)
     families.append(swarm_fam)
 
+    # ---- multi-process swarm shards (docs/swarmshard.md) ----
+    try:
+        from ..swarm import maybe_default_proc
+
+        swarm_proc = maybe_default_proc()
+    except Exception:
+        swarm_proc = None
+    proc_fam = _Family(
+        "room_tpu_swarm_proc", "gauge",
+        "Supervised swarm shard child processes (docs/swarmshard.md "
+        "\"Process mode\"): per-child state/restarts/traffic keyed by "
+        "shard; dispatch/restart/adoption/orphan counters under "
+        "shard=\"all\".",
+    )
+    proc_slo_fam = _Family(
+        "room_tpu_proc_slo_attribution_ms_total", "counter",
+        "Process-spanning per-class latency attribution: the parent's "
+        "recorder merged with the latest stats frame from every shard "
+        "child (turnscope over N processes).",
+    )
+    proc_turns_fam = _Family(
+        "room_tpu_proc_turns_total", "counter",
+        "Finished turns per class summed across shard child "
+        "processes, by outcome.",
+    )
+    if swarm_proc is not None:
+        snap = swarm_proc.snapshot()
+        for key in ("n_shards", "dispatches", "dedup_skips",
+                    "restarts", "adoptions", "proc_kills",
+                    "wire_retries", "sheds", "orphans_reaped",
+                    "forced_kills"):
+            v = snap.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                proc_fam.add({"shard": "all", "stat": key}, v)
+        proc_fam.add(
+            {"shard": "all", "stat": "epoch"},
+            (snap.get("placement") or {}).get("epoch", 0),
+        )
+        proc_fam.add(
+            {"shard": "all", "stat": "serving"},
+            sum(1 for c in snap["children"]
+                if c.get("state") == "serving"),
+        )
+        for c in snap["children"]:
+            sk = str(c.get("shard"))
+            proc_fam.add(
+                {"shard": sk, "stat": "serving"},
+                1 if c.get("state") == "serving" else 0,
+            )
+            for key in ("restarts_in_window", "frames",
+                        "messages_in", "messages_out", "escalations",
+                        "dedup_skips", "rooms_created",
+                        "journal_bytes"):
+                v = c.get(key)
+                if isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    proc_fam.add({"shard": sk, "stat": key}, v)
+        try:
+            from ..serving import trace as _trace_mod
+
+            _components = _trace_mod.ATTRIBUTION_COMPONENTS
+        except Exception:
+            _components = ()
+        merged = snap.get("slo") or {}
+        for cls, a in sorted((merged.get("classes") or {}).items()):
+            for comp in _components:
+                proc_slo_fam.add(
+                    {"class": cls, "component": comp[:-3]},
+                    a.get(comp, 0),
+                )
+            proc_turns_fam.add({"class": cls, "outcome": "all"},
+                               a.get("turns", 0))
+            proc_turns_fam.add({"class": cls, "outcome": "error"},
+                               a.get("errors", 0))
+            proc_turns_fam.add({"class": cls, "outcome": "shed"},
+                               a.get("shed", 0))
+            proc_turns_fam.add({"class": cls, "outcome": "faulted"},
+                               a.get("faulted", 0))
+    families.append(proc_fam)
+    families.append(proc_slo_fam)
+    families.append(proc_turns_fam)
+
     # ---- turnscope SLO attribution (serving/trace.py) ----
     try:
         from ..serving import trace as trace_mod

@@ -1262,6 +1262,14 @@ def register_aux_routes(r: Router) -> None:
                     agg[k] += (s["journal"] or {}).get(k, 0)
             swarm["journal"] = agg
             swarm["shards"] = shard_block
+        # multi-process swarm shards (docs/swarmshard.md "Process
+        # mode"): per-child state, restart ledger, placement, and the
+        # process-spanning SLO merge ride the supervisor's snapshot
+        from ..swarm import maybe_default_proc as _maybe_proc
+
+        swarm_proc = _maybe_proc()
+        if swarm_proc is not None:
+            swarm["proc"] = swarm_proc.snapshot()
         degraded = any(
             e.get("degradation_level", 0) > 0 or not e.get("healthy",
                                                            True)
@@ -1288,6 +1296,13 @@ def register_aux_routes(r: Router) -> None:
             # until a sibling adopts the file; "retired" is healed
             s.get("state") == "dead"
             for s in (swarm.get("shards") or {}).get("shards", [])
+        ) or any(
+            # a dead/failed shard CHILD PROCESS (process mode): dead
+            # until the restart lands; failed (budget exhausted,
+            # sibling adopted the file) stays unhealthy until an
+            # operator intervenes
+            c.get("state") in ("dead", "failed")
+            for c in (swarm.get("proc") or {}).get("children", [])
         )
         from .runtime import lifecycle_snapshot
 

@@ -227,6 +227,18 @@ class ServerRuntime:
                     self.notifications, self.cloud):
             if aux is not None:
                 aux.stop()
+        # shard children first: graceful drain (journaled in-flight
+        # halves commit) + forced-kill sweep, BEFORE the generic
+        # managed-process SIGTERM pass, so the parent never writes
+        # its clean marker over children still holding shard files
+        proc = self._swarm_proc()
+        if proc is not None:
+            try:
+                proc.stop()
+            except Exception as e:
+                event_bus.emit("runtime:error", "runtime",
+                               {"loop": "swarm_proc_stop",
+                                "error": str(e)})
         from ..core.supervisor import terminate_managed_processes
 
         terminate_managed_processes()
@@ -253,6 +265,12 @@ class ServerRuntime:
         from ..swarm import maybe_default_router
 
         return maybe_default_router()
+
+    @staticmethod
+    def _swarm_proc():
+        from ..swarm import maybe_default_proc
+
+        return maybe_default_proc()
 
     def _targets(self) -> list:
         """The ``(db, domain)`` pairs every tick iterates. Unsharded
@@ -329,6 +347,11 @@ class ServerRuntime:
         router = self._swarm()
         if router is not None:
             router.supervise()
+        proc = self._swarm_proc()
+        if proc is not None:
+            # process mode: heartbeat detector + restart budget +
+            # sibling adoption run on the parent's supervision cadence
+            proc.supervise()
         for db, dom in self._targets():
             supervise_loops(db, domain=dom)
 

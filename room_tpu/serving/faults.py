@@ -98,6 +98,23 @@ up through the agent runtime above the engine:
                        it, and publishes a new placement epoch —
                        cross-shard dispatch redelivered afterwards
                        dedups on the journal's idempotency keys
+    shard_proc_kill    SIGKILL one live swarm-shard child process at
+                       the supervisor seam (docs/swarmshard.md
+                       process mode): its rooms shed until the
+                       supervisor restarts it under the
+                       ROOM_TPU_SWARM_PROC_RESTARTS/window budget
+                       (boot journal recovery abandons the intent a
+                       mid-transaction kill left); past budget the
+                       shard degrades to sibling adoption and goes
+                       unhealthy — either way redelivered dispatch
+                       halves dedup on their journal keys
+    shard_wire_io      one cross-shard dispatch frame fails in
+                       flight (parent→child wire_send_control):
+                       the parent retries the frame — safe because
+                       every frame carries its content-derived
+                       idempotency key and the child journals
+                       check-then-act, so a frame that DID land
+                       before the failure report dedups on retry
 
 Arming is per-point with probability / latency / one-shot triggers,
 via code (`inject`) or env (`ROOM_TPU_FAULTS`), e.g.::
@@ -144,6 +161,8 @@ FAULT_POINTS = (
     "db_io", "cycle_crash", "loop_hang", "tool_exec",
     # swarm shard tier (docs/swarmshard.md)
     "shard_crash",
+    # multi-process swarm shards (docs/swarmshard.md "Process mode")
+    "shard_proc_kill", "shard_wire_io",
 )
 
 

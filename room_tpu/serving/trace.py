@@ -99,6 +99,8 @@ FAULT_EVENTS = {
     "loop_hang": "fault.loop_hang",
     "tool_exec": "fault.tool_exec",
     "shard_crash": "fault.shard_crash",
+    "shard_proc_kill": "fault.shard_proc_kill",
+    "shard_wire_io": "fault.shard_wire_io",
 }
 
 # attribution components (per class, ms): where a class's latency

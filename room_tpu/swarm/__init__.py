@@ -5,3 +5,7 @@ from .shard import (  # noqa: F401
     maybe_default_router, reset_default_router, resize_swarm,
     shard_db_path,
 )
+from .procshard import (  # noqa: F401
+    ProcSupervisor, ShardChild, ShardLockHeld, default_proc,
+    maybe_default_proc, merge_attributions, reset_default_proc,
+)

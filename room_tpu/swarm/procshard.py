@@ -1,0 +1,1365 @@
+"""Multi-process swarm shards (docs/swarmshard.md "Process mode").
+
+PR 17's swarm shards gave each shard its own SQLite file, supervision
+domain, and journal keys — but all of them live in one Python process:
+one segfault, OOM-kill, or wedged GIL holder takes every room down at
+once. ``ROOM_TPU_SWARM_PROC=1`` (with ``ROOM_TPU_SWARM_SHARDS`` > 1)
+launches each shard as a **supervised child OS process** — its own
+interpreter, its own SQLite handle, its own agent-loop domain —
+speaking to the parent runtime over the framed-RTKW control wire
+(``parallel.multihost.wire_send_control``), the same checksummed
+framing, retry policy, and per-peer circuit breakers that carry KV
+shipments and pod heartbeats.
+
+Three cooperating pieces:
+
+- :class:`ShardChild` — the child-process runtime
+  (``python -m room_tpu.swarm.procshard --shard K ...``). At boot it
+  takes the shard's **PID-tagged lockfile** (``shard<k>.db.lock``;
+  refused while a live process holds it — a restarted parent can never
+  double-open a live child's SQLite file), opens the shard database,
+  runs journal recovery (a SIGKILL mid-transaction leaves an intent
+  row recovery abandons), then serves cross-shard dispatch frames and
+  streams heartbeat + stats frames at the parent.
+
+- :class:`ProcSupervisor` — the parent-side process supervisor in the
+  PodMembership mold: per-child heartbeats feed the alive → suspect →
+  dead detector; a dead child is restarted with jittered exponential
+  backoff under the ``ROOM_TPU_SWARM_PROC_RESTARTS``-per-window
+  budget; past budget the shard degrades to **sibling adoption**
+  (a live child reopens the dead shard's file, journal-recovers it,
+  and the placement map rehomes + bumps the epoch — exactly the
+  in-process ``shard_crash`` dance) and is reported unhealthy in
+  ``/api/tpu/health``. Graceful ``stop()`` drains children over
+  SIGTERM and escalates stragglers to SIGKILL after
+  ``ROOM_TPU_SWARM_PROC_DRAIN_S`` — the ``core.supervisor``
+  forced-kill sweep contract. At boot the supervisor **reaps
+  orphans**: shard lockfiles naming live PIDs from a crashed previous
+  parent are killed before any child re-opens their files.
+
+- the **exactly-once dispatch plane**: cross-shard ``send_message`` /
+  ``escalate`` halves ride ``wire_send_control`` frames carrying the
+  same content-derived idempotency keys the in-process tier journals
+  (``cycle_journal`` v3 ``kind='xshard'``, ``shard.journaled_once``).
+  The child's dedup is check-then-act under its dispatch lock, so the
+  contract survives a child dying between halves (the redelivery
+  fires only the missing half), a duplicate frame redelivered after a
+  restart (both halves dedup), and a SIGKILL mid-transaction (the
+  intent is abandoned at boot recovery; the retry re-runs it). The
+  parent retries individual failed frames (the ``shard_wire_io``
+  fault fires here) — safe precisely because frames are idempotent.
+
+The per-class scheduler/SLO attribution (queue/TTFT/TPOT per
+queen/worker/background, serving/trace.py) rides the heartbeat stats
+frames: :func:`merge_attributions` folds the latest per-child snapshot
+into the parent's own recorder so ``/api/tpu/health`` → ``swarm.proc``
+``slo``, ``/metrics`` (``room_tpu_swarm_proc``), and the TPU panel
+read the N-process pod as **one SLO surface**.
+
+Chaos: ``shard_proc_kill`` SIGKILLs a live child at the supervisor
+seam; ``shard_wire_io`` fails individual dispatch frames
+(docs/chaos.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from typing import Callable, Optional
+
+from ..db import Database
+from ..utils import knobs, locks
+from .shard import (
+    ShardDownError, _stride_sequences, journaled_once, shard_db_path,
+)
+
+__all__ = [
+    "ShardChild", "ProcSupervisor", "ShardLockHeld",
+    "acquire_shard_lock", "release_shard_lock", "read_shard_lock",
+    "reap_orphan_children", "merge_attributions", "default_proc",
+    "maybe_default_proc", "reset_default_proc", "main",
+]
+
+# child states the parent tracks (membership drives dead; the budget
+# decision drives restarting vs failed)
+CHILD_STARTING = "starting"
+CHILD_SERVING = "serving"
+CHILD_DEAD = "dead"
+CHILD_RESTARTING = "restarting"
+CHILD_FAILED = "failed"      # budget exhausted -> sibling adopted
+CHILD_STOPPED = "stopped"
+
+
+# ---- PID-tagged shard lockfiles ----
+#
+# ``shard<k>.db.lock`` next to the shard file, JSON {pid, shard, ts}.
+# The holder is whoever's live PID the file names: a dead holder's
+# lock is stale and silently replaced; a live holder's lock refuses
+# the open — the guard that makes "restarted parent + still-running
+# orphan child" structurally unable to double-open one SQLite file.
+
+
+class ShardLockHeld(RuntimeError):
+    def __init__(self, path: str, pid: int) -> None:
+        super().__init__(
+            f"shard lockfile {path} held by live pid {pid}"
+        )
+        self.path = path
+        self.pid = pid
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def lock_path_for(db_path: str) -> str:
+    return db_path + ".lock"
+
+
+def read_shard_lock(db_path: str) -> Optional[dict]:
+    try:
+        with open(lock_path_for(db_path)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def acquire_shard_lock(db_path: str, shard_id: int) -> str:
+    """Take the shard's PID lockfile or raise :class:`ShardLockHeld`
+    while a live process holds it. A stale lock (dead PID, corrupt
+    JSON) is replaced."""
+    path = lock_path_for(db_path)
+    held = read_shard_lock(db_path)
+    if held is not None:
+        pid = int(held.get("pid") or 0)
+        if pid != os.getpid() and _pid_alive(pid):
+            raise ShardLockHeld(path, pid)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "shard": shard_id,
+                   "ts": time.time()}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def release_shard_lock(db_path: str) -> None:
+    """Drop the lockfile — only if THIS process still holds it (a
+    successor that already replaced it keeps its own)."""
+    held = read_shard_lock(db_path)
+    if held is not None and int(held.get("pid") or 0) == os.getpid():
+        try:
+            os.unlink(lock_path_for(db_path))
+        except OSError:
+            pass
+
+
+def reap_orphan_children(db_dir: Optional[str],
+                         n_shards: int) -> list[int]:
+    """Parent-crash orphan reap: kill any live process still holding a
+    shard lockfile under this swarm's directory (a child the previous
+    parent spawned and then died without sweeping), and clear the
+    stale locks. Returns the PIDs killed. Runs BEFORE the new parent
+    spawns anything — the spawned children then take the locks
+    cleanly."""
+    from ..core.supervisor import kill_pid_tree
+
+    reaped: list[int] = []
+    for k in range(n_shards):
+        db_path = shard_db_path(k, db_dir)
+        held = read_shard_lock(db_path)
+        if held is None:
+            continue
+        pid = int(held.get("pid") or 0)
+        if pid > 0 and pid != os.getpid() and _pid_alive(pid):
+            kill_pid_tree(pid, signal.SIGKILL)
+            reaped.append(pid)
+        try:
+            os.unlink(lock_path_for(db_path))
+        except OSError:
+            pass
+    return reaped
+
+
+# ---- cross-process SLO attribution merge ----
+
+def merge_attributions(snaps: list) -> dict:
+    """Fold per-process ``trace.recorder.attribution()`` snapshots
+    (parent + the latest heartbeat from every child) into one surface:
+    counters and component-ms sums add; ``ttft_ms_mean`` re-averages
+    weighted by each process's finished turns of that class. Snapshots
+    are monotonic per process, so summing the LATEST per child is the
+    fleet total."""
+    merged: dict = {"finished_turns": 0, "classes": {}}
+    weights: dict[str, float] = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        merged["finished_turns"] += int(
+            snap.get("finished_turns") or 0
+        )
+        for cls, a in (snap.get("classes") or {}).items():
+            if not isinstance(a, dict):
+                continue
+            out = merged["classes"].setdefault(cls, {})
+            turns = float(a.get("turns") or 0)
+            mean = a.get("ttft_ms_mean")
+            if mean is not None and turns > 0:
+                prev_w = weights.get(cls, 0.0)
+                prev = out.get("ttft_ms_mean") or 0.0
+                weights[cls] = prev_w + turns
+                out["ttft_ms_mean"] = round(
+                    (prev * prev_w + float(mean) * turns)
+                    / weights[cls], 3,
+                )
+            for key, v in a.items():
+                if key == "ttft_ms_mean":
+                    continue
+                if isinstance(v, bool) or \
+                        not isinstance(v, (int, float)):
+                    continue
+                out[key] = round(out.get(key, 0) + v, 3)
+    return merged
+
+
+# ---- the child process ----
+
+class ShardChild:
+    """One swarm shard as a process: lockfile, database, journal
+    recovery, a control-wire listener for dispatch frames, and a
+    heartbeat loop streaming stats at the parent."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        db_dir: Optional[str] = None,
+        parent: Optional[tuple[str, int]] = None,
+        hb_s: Optional[float] = None,
+        bind_host: Optional[str] = None,
+        advertise_host: Optional[str] = None,
+    ) -> None:
+        self.shard_id = shard_id
+        self.db_dir = db_dir
+        self.parent = parent
+        # containerized children bind 0.0.0.0 but must advertise an
+        # address the parent can dial (service DNS / pod IP)
+        self.bind_host = bind_host or "127.0.0.1"
+        self.advertise_host = advertise_host or (
+            self.bind_host if self.bind_host != "0.0.0.0"
+            else "127.0.0.1"
+        )
+        self.hb_s = float(
+            hb_s if hb_s is not None
+            else knobs.get_float("ROOM_TPU_SWARM_PROC_HB_S")
+        )
+        self.db_path = shard_db_path(shard_id, db_dir)
+        acquire_shard_lock(self.db_path, shard_id)
+        self.db = Database(self.db_path)
+        _stride_sequences(self.db, shard_id)
+        from ..core import journal as journal_mod
+
+        self.boot_recovery = journal_mod.recover(self.db)
+        if shard_id == 0:
+            self._seed_room_counter()
+        # check-then-act dedup serialization for every xshard frame
+        # this child journals (own file AND adopted files — coarser
+        # than the in-process per-file lock, equally correct)
+        self._dispatch_lock = locks.make_lock("swarm_proc_child")
+        self._stop = threading.Event()
+        self._domain = None
+        # origin shard id -> Database, files adopted after a sibling
+        # child exhausted its restart budget
+        self.adopted: dict[int, Database] = {}
+        self.stats = {
+            "frames": 0, "messages_in": 0, "messages_out": 0,
+            "escalations": 0, "dedup_skips": 0, "rooms_created": 0,
+            "adoptions": 0, "heartbeats_sent": 0,
+        }
+        from ..parallel.multihost import KVWireServer
+
+        spool = os.path.join(
+            os.path.dirname(self.db_path) or ".",
+            f"proc-spool-{os.getpid()}",
+        )
+        self.server = KVWireServer(
+            spool, self._refuse_entry, host=self.bind_host, port=0,
+            on_control=self._on_control,
+        )
+
+    def _seed_room_counter(self) -> None:
+        """Shard 0 holds the swarm-global room-id counter: start it
+        above any room already in this file so a proc swarm over a
+        pre-existing shard 0 can't re-mint a taken id."""
+        from ..core import messages as messages_mod
+        from .shard import _ROOM_COUNTER_KEY
+
+        row = self.db.query_one("SELECT MAX(id) AS m FROM rooms")
+        top = int(row["m"]) if row and row["m"] else 0
+        cur = int(
+            messages_mod.get_setting(self.db, _ROOM_COUNTER_KEY)
+            or "1"
+        )
+        if top >= cur:
+            messages_mod.set_setting(
+                self.db, _ROOM_COUNTER_KEY, str(top + 1)
+            )
+
+    # the swarm control plane never ships KV payloads
+    def _refuse_entry(self, entry, kv, src):
+        from ..parallel.multihost import KVWireError
+
+        raise KVWireError("swarm shard child accepts control frames only")
+
+    @property
+    def domain(self):
+        if self._domain is None:
+            from ..core import agent_loop
+
+            self._domain = agent_loop.LoopDomain()
+        return self._domain
+
+    def _db_for_home(self, home: int) -> Database:
+        if home == self.shard_id:
+            return self.db
+        db = self.adopted.get(home)
+        if db is None:
+            from ..parallel.multihost import KVWireError
+
+            raise KVWireError(
+                f"shard child {self.shard_id} does not own home {home}"
+            )
+        return db
+
+    # ---- control ops ----
+
+    def _on_control(self, control: dict) -> dict:
+        op = control.get("op")
+        self.stats["frames"] += 1
+        if op == "swarm_ping":
+            return {"pong": True, "shard": self.shard_id,
+                    "pid": os.getpid()}
+        if op == "swarm_xshard":
+            return self._op_xshard(control)
+        if op == "swarm_alloc_room_id":
+            return self._op_alloc_room_id(control)
+        if op == "swarm_create_room":
+            return self._op_create_room(control)
+        if op == "swarm_adopt":
+            return self._op_adopt(control)
+        if op == "swarm_query":
+            return self._op_query(control)
+        if op == "swarm_stats":
+            return {"stats": self.snapshot()}
+        if op == "swarm_drain":
+            # the test seam models a fully wedged child: deaf to the
+            # drain frame AND to SIGTERM, so only the supervisor's
+            # forced-kill sweep can clear it
+            if not knobs.get_bool("ROOM_TPU_SWARM_PROC_IGNORE_TERM"):
+                self._stop.set()
+            return {"draining": True, "shard": self.shard_id}
+        from ..parallel.multihost import KVWireError
+
+        raise KVWireError(f"unknown swarm control op {op!r}")
+
+    def _op_xshard(self, control: dict) -> dict:
+        """One journaled dispatch half. The frame carries everything
+        the journal key derives from (name + args), so a redelivered
+        byte-identical frame dedups here no matter which incarnation
+        of this child — or which adopter — it lands on."""
+        name = control.get("name")
+        args = control.get("args")
+        if not isinstance(args, dict) or not isinstance(name, str):
+            from ..parallel.multihost import KVWireError
+
+            raise KVWireError("xshard frame missing name/args")
+        home = int(control.get("home", self.shard_id))
+        room_id = control.get("room_id")
+        actor_id = control.get("actor_id")
+        db = self._db_for_home(home)
+        fn = self._effect_fn(db, name, args)
+        with self._dispatch_lock:
+            result, deduped = journaled_once(
+                db, room_id, actor_id, name, args, fn
+            )
+        if name == "xshard_msg_out":
+            self.stats["messages_out"] += 1
+        elif name == "xshard_msg_in":
+            self.stats["messages_in"] += 1
+        elif name == "xshard_escalation":
+            self.stats["escalations"] += 1
+        if deduped:
+            self.stats["dedup_skips"] += 1
+        return {"result": result, "deduped": deduped}
+
+    def _effect_fn(self, db: Database, name: str,
+                   args: dict) -> Callable[[], str]:
+        """The effect bodies — byte-for-byte the rows the in-process
+        ``SwarmRouter`` halves insert, so proc mode and in-process
+        mode journal interchangeably over the same files."""
+        if name == "xshard_msg_out":
+            return lambda: str(db.insert(
+                "INSERT INTO room_messages(room_id, direction, "
+                "from_room_id, to_room_id, subject, body, status) "
+                "VALUES (?,?,?,?,?,?,'read')",
+                (args["from"], "outbound", str(args["from"]),
+                 str(args["to"]), args["subject"], args["body"]),
+            ))
+        if name == "xshard_msg_in":
+            return lambda: str(db.insert(
+                "INSERT INTO room_messages(room_id, direction, "
+                "from_room_id, to_room_id, subject, body) "
+                "VALUES (?,?,?,?,?,?)",
+                (args["to"], "inbound", str(args["from"]),
+                 str(args["to"]), args["subject"], args["body"]),
+            ))
+        if name == "xshard_escalation":
+            def _escalate() -> str:
+                from ..core import escalations as escalations_mod
+
+                return str(escalations_mod.create_escalation(
+                    db, args["room"], args["question"],
+                    from_agent_id=args.get("from"),
+                    to_agent_id=args.get("to"),
+                ))
+            return _escalate
+        from ..parallel.multihost import KVWireError
+
+        raise KVWireError(f"unknown xshard effect {name!r}")
+
+    def _op_alloc_room_id(self, control: dict) -> dict:
+        from ..core import messages as messages_mod
+        from .shard import _ROOM_COUNTER_KEY
+
+        db = self._db_for_home(int(control.get("home", 0)))
+        with self._dispatch_lock:
+            with db.transaction():
+                cur = int(
+                    messages_mod.get_setting(db, _ROOM_COUNTER_KEY)
+                    or "1"
+                )
+                messages_mod.set_setting(
+                    db, _ROOM_COUNTER_KEY, str(cur + 1)
+                )
+        return {"room_id": cur}
+
+    def _op_create_room(self, control: dict) -> dict:
+        from ..core import rooms as rooms_mod
+
+        home = int(control.get("home", self.shard_id))
+        rid = int(control["room_id"])
+        db = self._db_for_home(home)
+        with self._dispatch_lock:
+            # id is pinned by the caller: a redelivered frame whose
+            # first reply was lost finds the row and dedups
+            prior = db.query_one(
+                "SELECT id, name FROM rooms WHERE id=?", (rid,)
+            )
+            if prior is not None:
+                return {"room_id": int(prior["id"]),
+                        "name": prior["name"], "deduped": True}
+            room = rooms_mod.create_room(
+                db, str(control.get("name")), room_id=rid
+            )
+        self.stats["rooms_created"] += 1
+        return {"room_id": int(room["id"]), "name": room["name"]}
+
+    def _op_adopt(self, control: dict) -> dict:
+        """Budget-exhausted sibling adoption: reopen the dead shard's
+        file (taking its lockfile — refused while the old child is
+        somehow still alive), journal-recover it, and serve its homes
+        from here on."""
+        from ..core import journal as journal_mod
+
+        dead = int(control["shard"])
+        if dead == self.shard_id or dead in self.adopted:
+            return {"adopted": dead, "already": True}
+        dead_path = shard_db_path(dead, self.db_dir)
+        acquire_shard_lock(dead_path, dead)
+        db = Database(dead_path)
+        summary = journal_mod.recover(db)
+        with self._dispatch_lock:
+            self.adopted[dead] = db
+        self.stats["adoptions"] += 1
+        return {"adopted": dead, "recovery": summary}
+
+    def _op_query(self, control: dict) -> dict:
+        """SELECT-only diagnostics seam (bench + test accounting read
+        shard state without opening the child's SQLite file)."""
+        from ..parallel.multihost import KVWireError
+
+        sql = str(control.get("sql") or "")
+        if not sql.lstrip().lower().startswith("select"):
+            raise KVWireError("swarm_query is SELECT-only")
+        db = self._db_for_home(int(control.get("home",
+                                               self.shard_id)))
+        rows = db.query(sql, tuple(control.get("params") or ()))
+        return {"rows": [dict(r) for r in rows]}
+
+    # ---- snapshot + heartbeat ----
+
+    def snapshot(self) -> dict:
+        from ..core import journal as journal_mod
+        from ..serving import trace as trace_mod
+
+        try:
+            journal_bytes = os.path.getsize(self.db_path)
+        except OSError:
+            journal_bytes = 0
+        out = {
+            "shard": self.shard_id,
+            "pid": os.getpid(),
+            "state": "draining" if self._stop.is_set() else "serving",
+            "adopted": sorted(self.adopted),
+            "journal": journal_mod.stats(self.db),
+            "journal_bytes": journal_bytes,
+            "boot_recovery": self.boot_recovery,
+            "attribution": trace_mod.recorder.attribution(),
+            **self.stats,
+        }
+        if self._domain is not None:
+            from ..core import agent_loop
+
+            out["supervision"] = agent_loop.supervision_snapshot(
+                domain=self._domain
+            )
+        return out
+
+    def _beat(self) -> None:
+        if self.parent is None:
+            return
+        from ..parallel.multihost import KVWireError, \
+            wire_send_control
+
+        try:
+            wire_send_control(
+                self.parent,
+                {"op": "swarm_heartbeat", "shard": self.shard_id,
+                 "pid": os.getpid(),
+                 "host": self.advertise_host,
+                 "port": self.server.address[1],
+                 "stats": self.snapshot()},
+                retries=1,
+            )
+            self.stats["heartbeats_sent"] += 1
+        except (KVWireError, OSError):
+            # a briefly-absent parent costs a beat, never the child
+            pass
+
+    def run(self) -> None:
+        """Serve until SIGTERM / a drain frame; then drain: wait out
+        in-flight dispatch (the lock), close the listener, close the
+        database cleanly, drop the lockfile, say goodbye."""
+        if knobs.get_bool("ROOM_TPU_SWARM_PROC_IGNORE_TERM"):
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        else:
+            signal.signal(
+                signal.SIGTERM, lambda *_: self._stop.set()
+            )
+        self._beat()  # hello: registers address with the parent now
+        while not self._stop.wait(timeout=self.hb_s):
+            self._beat()
+        self.close()
+
+    def close(self) -> None:
+        # in-flight journaled halves commit before the db closes:
+        # taking the dispatch lock queues behind them
+        with self._dispatch_lock:
+            self.server.close()
+            try:
+                self.db.close()
+            except Exception:
+                pass
+            for db in self.adopted.values():
+                try:
+                    db.close()
+                except Exception:
+                    pass
+        release_shard_lock(self.db_path)
+        for dead in self.adopted:
+            release_shard_lock(shard_db_path(dead, self.db_dir))
+        if self.parent is not None:
+            from ..parallel.multihost import KVWireError, \
+                wire_send_control
+
+            try:
+                wire_send_control(
+                    self.parent,
+                    {"op": "swarm_goodbye",
+                     "shard": self.shard_id, "pid": os.getpid()},
+                    retries=1,
+                )
+            except (KVWireError, OSError):
+                pass
+
+
+# ---- the parent-side supervisor ----
+
+class _Child:
+    """Parent-side record of one shard child (mutated under the
+    supervisor's lock)."""
+
+    __slots__ = (
+        "shard", "proc", "pid", "address", "state", "restarts",
+        "next_restart_at", "last_stats", "spawned_at", "adopter",
+    )
+
+    def __init__(self, shard: int) -> None:
+        self.shard = shard
+        self.proc: Optional[subprocess.Popen] = None
+        self.pid: Optional[int] = None
+        self.address: Optional[tuple[str, int]] = None
+        self.state = CHILD_STARTING
+        self.restarts: list[float] = []   # monotonic restart stamps
+        self.next_restart_at: Optional[float] = None
+        self.last_stats: dict = {}
+        self.spawned_at: Optional[float] = None
+        self.adopter: Optional[int] = None
+
+
+class ProcSupervisor:
+    """The parent: spawns one :class:`ShardChild` per shard, feeds
+    their wire heartbeats into a PodMembership detector, restarts the
+    dead under a windowed budget with jittered backoff, degrades past
+    budget to sibling adoption + unhealthy, and carries the
+    exactly-once cross-shard dispatch plane over
+    ``wire_send_control``."""
+
+    def __init__(
+        self,
+        n_shards: Optional[int] = None,
+        db_dir: Optional[str] = None,
+        restart_budget: Optional[int] = None,
+        restart_window_s: Optional[float] = None,
+        backoff_s: Optional[float] = None,
+        drain_s: Optional[float] = None,
+        hb_s: Optional[float] = None,
+        suspect_s: Optional[float] = None,
+        dead_s: Optional[float] = None,
+        lease_s: Optional[float] = None,
+        child_env: Optional[dict] = None,
+        spawn: bool = True,
+        external: Optional[bool] = None,
+    ) -> None:
+        from ..parallel.multihost import KVWireServer
+        from ..serving import podnet as podnet_mod
+
+        # external = shard children run as separate containers
+        # (launched by compose/kubelet, not by this parent): the
+        # supervisor keeps the heartbeat ladder, dispatch plane, and
+        # adoption, but never spawns, signals, or lockfile-reaps —
+        # every PID it sees lives in a foreign namespace
+        self.external = bool(
+            external if external is not None
+            else knobs.get_bool("ROOM_TPU_SWARM_PROC_EXTERNAL")
+        )
+
+        self.n_shards = max(1, int(
+            n_shards if n_shards is not None
+            else knobs.get_int("ROOM_TPU_SWARM_SHARDS")
+        ))
+        self.db_dir = db_dir
+        self.restart_budget = int(
+            restart_budget if restart_budget is not None
+            else knobs.get_int("ROOM_TPU_SWARM_PROC_RESTARTS")
+        )
+        self.restart_window_s = float(
+            restart_window_s if restart_window_s is not None
+            else knobs.get_float("ROOM_TPU_SWARM_PROC_WINDOW_S")
+        )
+        self.backoff_s = float(
+            backoff_s if backoff_s is not None
+            else knobs.get_float("ROOM_TPU_SWARM_PROC_BACKOFF_S")
+        )
+        self.drain_s = float(
+            drain_s if drain_s is not None
+            else knobs.get_float("ROOM_TPU_SWARM_PROC_DRAIN_S")
+        )
+        self.hb_s = float(
+            hb_s if hb_s is not None
+            else knobs.get_float("ROOM_TPU_SWARM_PROC_HB_S")
+        )
+        self._child_env = child_env
+        self._lock = locks.make_lock("swarm_proc")
+        self.placement = podnet_mod.PlacementMap(self.n_shards)
+        self.membership = podnet_mod.PodMembership(
+            suspect_s=suspect_s, dead_s=dead_s, lease_s=lease_s,
+        )
+        self.stats = {
+            "dispatches": 0, "dedup_skips": 0, "restarts": 0,
+            "adoptions": 0, "proc_kills": 0, "wire_retries": 0,
+            "sheds": 0, "orphans_reaped": 0, "forced_kills": 0,
+        }
+        self.children: dict[int, _Child] = {
+            k: _Child(k) for k in range(self.n_shards)
+        }
+        # a previous parent may have died leaving live children
+        # holding the shard locks: reap them BEFORE spawning (skipped
+        # in external mode — lockfile PIDs belong to other containers
+        # and signalling them here would hit unrelated local
+        # processes)
+        if not self.external:
+            reaped = reap_orphan_children(db_dir, self.n_shards)
+            self.stats["orphans_reaped"] = len(reaped)
+        spool = os.path.join(
+            os.path.dirname(shard_db_path(0, db_dir)) or ".",
+            f"proc-parent-spool-{os.getpid()}",
+        )
+        self.server = KVWireServer(
+            spool, self._refuse_entry,
+            host=knobs.get_str("ROOM_TPU_SWARM_PROC_HOST"),
+            port=knobs.get_int("ROOM_TPU_SWARM_PROC_PORT"),
+            on_control=self._on_control,
+        )
+        for k in range(self.n_shards):
+            self.membership.register(f"shard{k}")
+        self._closed = False
+        if spawn and not self.external:
+            for k in range(self.n_shards):
+                self._spawn(k)
+
+    def _refuse_entry(self, entry, kv, src):
+        from ..parallel.multihost import KVWireError
+
+        raise KVWireError("swarm proc supervisor accepts control "
+                          "frames only")
+
+    # ---- spawn / reap ----
+
+    def _spawn(self, shard: int) -> None:
+        from ..core.supervisor import spawn_managed
+
+        cmd = [
+            sys.executable, "-m", "room_tpu.swarm.procshard",
+            "--shard", str(shard),
+            "--parent",
+            f"{self.server.address[0]}:{self.server.address[1]}",
+            "--hb-s", str(self.hb_s),
+        ]
+        if self.db_dir:
+            cmd += ["--db-dir", self.db_dir]
+        env = dict(os.environ)
+        # the child boots via `-m room_tpu.swarm.procshard`, which
+        # resolves the package through the CHILD's sys.path — pin the
+        # parent's package root onto PYTHONPATH so supervisors driven
+        # from outside the repo/install dir (embedding scripts, cwd
+        # elsewhere) still spawn importable children
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        if self._child_env:
+            env.update(self._child_env)
+        proc = spawn_managed(
+            cmd, label=f"swarm-shard-{shard}", env=env,
+            stdout=subprocess.DEVNULL,
+        )
+        with self._lock:
+            child = self.children[shard]
+            child.proc = proc
+            child.pid = proc.pid
+            child.address = None
+            child.state = CHILD_STARTING
+            child.spawned_at = time.monotonic()
+            child.next_restart_at = None
+
+    def _reap(self, child: _Child) -> None:
+        """Make a declared-dead child REALLY dead (SIGKILL the tree)
+        and reap the zombie so the PID table stays clean."""
+        from ..core.supervisor import kill_pid_tree, \
+            unregister_managed_process
+
+        proc, pid = child.proc, child.pid
+        if pid is not None:
+            kill_pid_tree(pid, signal.SIGKILL)
+            unregister_managed_process(pid)
+        if proc is not None:
+            try:
+                proc.wait(timeout=5.0)
+            except Exception:
+                pass
+
+    # ---- heartbeats (wire server callback) ----
+
+    def _on_control(self, control: dict) -> dict:
+        op = control.get("op")
+        if op in ("swarm_heartbeat", "swarm_goodbye"):
+            shard = int(control.get("shard", -1))
+            pid = int(control.get("pid") or 0)
+            with self._lock:
+                child = self.children.get(shard)
+                stale = child is None or (
+                    child.pid is not None and pid != child.pid
+                )
+                if not stale and op == "swarm_heartbeat":
+                    port = int(control.get("port") or 0)
+                    if port:
+                        child.address = (
+                            str(control.get("host") or "127.0.0.1"),
+                            port,
+                        )
+                    child.last_stats = control.get("stats") or {}
+                    if child.state == CHILD_STARTING:
+                        child.state = CHILD_SERVING
+                        if self.external and child.pid is None:
+                            child.pid = pid or None
+                if not stale and op == "swarm_goodbye":
+                    child.state = CHILD_STOPPED
+            # a beat from a replaced incarnation must not heal the
+            # member its successor owns
+            if not stale and op == "swarm_heartbeat":
+                self.membership.observe(f"shard{shard}")
+            return {"ok": True}
+        from ..parallel.multihost import KVWireError
+
+        raise KVWireError(f"unknown swarm control op {op!r}")
+
+    # ---- placement ----
+
+    def base_home(self, room_id) -> int:
+        return zlib.crc32(str(room_id).encode("utf-8")) % self.n_shards
+
+    def owner_of_home(self, home: int) -> int:
+        """Follow placement redirects home→adopter (chains collapse to
+        one hop on rehome)."""
+        redirects = self.placement.frame()["redirects"]
+        k, seen = int(home), set()
+        while str(k) in redirects and k not in seen:
+            seen.add(k)
+            k = int(redirects[str(k)])
+        return k % self.n_shards
+
+    def _address_for_home(self, home: int) -> tuple[str, int]:
+        owner = self.owner_of_home(home)
+        with self._lock:
+            child = self.children[owner]
+            if child.state != CHILD_SERVING or child.address is None:
+                self.stats["sheds"] += 1
+                raise ShardDownError(home)
+            return child.address
+
+    # ---- dispatch plane ----
+
+    def _frame(self, home: int, control: dict) -> dict:
+        """One retried dispatch frame to the home's owning child. The
+        ``shard_wire_io`` fault fires per attempt; retrying a frame
+        that may ALREADY have landed is safe because every frame
+        journals under its content-derived key on the child."""
+        from ..parallel.multihost import (
+            KVWireError, KVWireRefused, wire_send_control,
+        )
+        from ..serving import faults
+        from ..serving import podnet as podnet_mod
+        from ..serving.faults import FaultError
+
+        attempts = max(1, podnet_mod.wire_retries())
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                faults.maybe_fail("shard_wire_io")
+                return wire_send_control(
+                    self._address_for_home(home), control, retries=1,
+                )
+            except KVWireRefused:
+                raise
+            except (KVWireError, FaultError) as e:
+                last = e
+                with self._lock:
+                    self.stats["wire_retries"] += 1
+                if attempt + 1 < attempts:
+                    delay = podnet_mod.wire_backoff_s(attempt)
+                    if delay > 0:
+                        time.sleep(delay)
+        raise ShardDownError(home) from last
+
+    def _xshard(
+        self,
+        home: int,
+        name: str,
+        args: dict,
+        room_id: Optional[int],
+        actor_id: Optional[int],
+    ) -> tuple[str, bool]:
+        reply = self._frame(home, {
+            "op": "swarm_xshard", "home": home, "name": name,
+            "args": args, "room_id": room_id, "actor_id": actor_id,
+        })
+        with self._lock:
+            self.stats["dispatches"] += 1
+            if reply.get("deduped"):
+                self.stats["dedup_skips"] += 1
+        return str(reply.get("result") or ""), \
+            bool(reply.get("deduped"))
+
+    def send_message(
+        self,
+        from_room_id: int,
+        to_room_id: int,
+        subject: str,
+        body: str,
+        actor_id: Optional[int] = None,
+    ) -> tuple[int, int]:
+        """Cross-shard ``message_send`` over the wire: the same two
+        journaled halves as the in-process tier, each riding its own
+        idempotent frame — a child dying between them leaves one
+        committed half, and the caller's retry fires only the missing
+        one."""
+        args = {"from": from_room_id, "to": to_room_id,
+                "subject": subject, "body": body}
+        out_raw, _ = self._xshard(
+            self.base_home(from_room_id), "xshard_msg_out", args,
+            from_room_id, actor_id,
+        )
+        in_raw, _ = self._xshard(
+            self.base_home(to_room_id), "xshard_msg_in", args,
+            to_room_id, actor_id,
+        )
+        return int(out_raw or 0), int(in_raw or 0)
+
+    def escalate(
+        self,
+        room_id: int,
+        question: str,
+        from_agent_id: Optional[int] = None,
+        to_agent_id: Optional[int] = None,
+    ) -> int:
+        args = {"room": room_id, "question": question,
+                "from": from_agent_id, "to": to_agent_id}
+        raw, _ = self._xshard(
+            self.base_home(room_id), "xshard_escalation", args,
+            room_id, from_agent_id,
+        )
+        return int(raw or 0)
+
+    def create_room(self, name: str) -> dict:
+        """Mint a swarm-unique id from the home-0 counter (wherever
+        home 0 is currently served), then create the room on the
+        shard the id hashes to."""
+        reply = self._frame(0, {"op": "swarm_alloc_room_id",
+                                "home": 0})
+        rid = int(reply["room_id"])
+        home = self.base_home(rid)
+        out = self._frame(home, {
+            "op": "swarm_create_room", "home": home,
+            "room_id": rid, "name": name,
+        })
+        return {"id": int(out["room_id"]), "name": out.get("name")}
+
+    def query(self, home: int, sql: str,
+              params: tuple = ()) -> list[dict]:
+        """SELECT-only pass-through to the home's owning child."""
+        reply = self._frame(home, {
+            "op": "swarm_query", "home": home, "sql": sql,
+            "params": list(params),
+        })
+        return list(reply.get("rows") or [])
+
+    # ---- supervision ----
+
+    def _maybe_chaos_kill(self) -> None:
+        if self.external:
+            return
+        faults = sys.modules.get("room_tpu.serving.faults")
+        if faults is None or not faults.is_armed() or \
+                faults.should_fire("shard_proc_kill") is None:
+            return
+        from ..core.supervisor import kill_pid_tree
+
+        with self._lock:
+            live = [
+                c for c in self.children.values()
+                if c.state == CHILD_SERVING and c.pid is not None
+            ]
+            if not live:
+                return
+            victim = max(
+                live,
+                key=lambda c: (
+                    (c.last_stats or {}).get("frames", 0), -c.shard,
+                ),
+            )
+            self.stats["proc_kills"] += 1
+        kill_pid_tree(victim.pid, signal.SIGKILL)
+        self._trace_note("swarm.shard_proc_kill",
+                         {"shard": victim.shard, "pid": victim.pid})
+
+    def supervise(self, now: Optional[float] = None) -> list[dict]:
+        """One supervision pass: roll the ``shard_proc_kill`` chaos
+        point, advance the membership detector, reap children it
+        declared dead, then restart-under-budget or degrade to
+        sibling adoption. Returns the adoptions performed."""
+        self._maybe_chaos_kill()
+        mono = time.monotonic() if now is None else now
+        for member, _old, new in self.membership.tick(now=now):
+            if new != "dead":
+                continue
+            shard = int(member.removeprefix("shard"))
+            with self._lock:
+                child = self.children.get(shard)
+                if child is None or child.state in (
+                    CHILD_FAILED, CHILD_STOPPED,
+                ):
+                    continue
+                child.state = CHILD_DEAD
+                child.address = None
+            if self.external:
+                # the PID is another container's; the replacement
+                # incarnation (container-runtime restart) registers
+                # itself by heartbeat
+                with self._lock:
+                    child.pid = None
+            else:
+                self._reap(child)
+            self._trace_note("swarm.shard_proc_dead",
+                             {"shard": shard})
+        adoptions: list[dict] = []
+        for member in self.membership.lease_expired(now=now):
+            shard = int(member.removeprefix("shard"))
+            with self._lock:
+                child = self.children.get(shard)
+                if child is None or child.state != CHILD_DEAD:
+                    continue
+                child.restarts = [
+                    t for t in child.restarts
+                    if mono - t < self.restart_window_s
+                ]
+                over_budget = \
+                    len(child.restarts) >= self.restart_budget
+                if not over_budget:
+                    n = len(child.restarts)
+                    child.next_restart_at = mono + \
+                        self.backoff_s * (2 ** n) * \
+                        (1.0 + random.random())
+                    child.state = CHILD_RESTARTING
+            if over_budget:
+                entry = self._adopt(shard)
+                if entry is not None:
+                    adoptions.append(entry)
+                else:
+                    # no sibling could adopt (none serving yet, or
+                    # the frame failed): re-arm the member so the
+                    # dead→lease cycle fires again and we retry
+                    self.membership.forget(member)
+                    self.membership.register(member)
+        self._restart_due(mono)
+        return adoptions
+
+    def _restart_due(self, mono: float) -> None:
+        due: list[int] = []
+        with self._lock:
+            for child in self.children.values():
+                if child.state == CHILD_RESTARTING and \
+                        child.next_restart_at is not None and \
+                        mono >= child.next_restart_at:
+                    child.restarts.append(mono)
+                    due.append(child.shard)
+        for shard in due:
+            # the dead incarnation's lease already fired: re-arm the
+            # detector so the replacement's first beat registers fresh
+            self.membership.forget(f"shard{shard}")
+            self.membership.register(f"shard{shard}")
+            if self.external:
+                # the container runtime owns the actual respawn; open
+                # the slot so the replacement's hello registers
+                with self._lock:
+                    child = self.children[shard]
+                    child.pid = None
+                    child.address = None
+                    child.state = CHILD_STARTING
+                    child.next_restart_at = None
+            else:
+                self._spawn(shard)
+            with self._lock:
+                self.stats["restarts"] += 1
+            self._trace_note("swarm.shard_proc_restart",
+                             {"shard": shard})
+
+    def _adopt(self, shard: int) -> Optional[dict]:
+        """Past the restart budget: a serving sibling child reopens
+        the shard's file (journal recovery included), the placement
+        map rehomes + bumps the epoch, and the shard is FAILED —
+        unhealthy in /api/tpu/health until an operator intervenes."""
+        with self._lock:
+            serving = [
+                c for c in self.children.values()
+                if c.state == CHILD_SERVING and c.address is not None
+            ]
+            if not serving:
+                return None
+            adopter = min(
+                serving,
+                key=lambda c: (
+                    len((c.last_stats or {}).get("adopted") or []),
+                    c.shard,
+                ),
+            )
+        from ..parallel.multihost import KVWireError
+
+        try:
+            reply = self._frame(adopter.shard, {
+                "op": "swarm_adopt", "home": adopter.shard,
+                "shard": shard,
+            })
+        except (KVWireError, ShardDownError):
+            return None
+        epoch = self.placement.rehome(shard, adopter.shard)
+        with self._lock:
+            child = self.children[shard]
+            child.state = CHILD_FAILED
+            child.adopter = adopter.shard
+            self.stats["adoptions"] += 1
+        entry = {
+            "shard": shard, "adopter": adopter.shard,
+            "epoch": epoch,
+            "recovery": reply.get("recovery"),
+        }
+        self._trace_note("swarm.shard_proc_adopted", entry)
+        from ..core.events import event_bus
+
+        event_bus.emit("swarm:proc_adopted", "runtime", entry)
+        return entry
+
+    # ---- shutdown ----
+
+    def stop(self, drain_s: Optional[float] = None) -> dict:
+        """Graceful shutdown: drain frame + SIGTERM to every child,
+        wait out the drain deadline, SIGKILL the stragglers (the
+        forced-kill sweep — a SIGTERM-ignoring child cannot wedge the
+        parent), reap, release. Runs BEFORE the parent's clean
+        shutdown marker is written (server runtime stop order)."""
+        from ..core.supervisor import kill_pid_tree, \
+            unregister_managed_process
+        from ..parallel.multihost import KVWireError, \
+            wire_send_control
+
+        drain_s = self.drain_s if drain_s is None else float(drain_s)
+        with self._lock:
+            targets = [
+                c for c in self.children.values()
+                if c.pid is not None and c.state in (
+                    CHILD_STARTING, CHILD_SERVING, CHILD_DEAD,
+                    CHILD_RESTARTING,
+                )
+            ]
+        if self.external:
+            # container children: ask them to drain over the wire —
+            # their lifecycle (and any escalation) is the container
+            # runtime's, not ours to signal
+            for child in targets:
+                if child.address is not None:
+                    try:
+                        wire_send_control(
+                            child.address, {"op": "swarm_drain"},
+                            retries=1, timeout_s=1.0,
+                        )
+                    except (KVWireError, OSError):
+                        pass
+                with self._lock:
+                    child.state = CHILD_STOPPED
+            self.server.close()
+            self._closed = True
+            return {"stopped": len(targets), "forced_kills": 0}
+        for child in targets:
+            if child.address is not None:
+                try:
+                    wire_send_control(
+                        child.address, {"op": "swarm_drain"},
+                        retries=1, timeout_s=1.0,
+                    )
+                except (KVWireError, OSError):
+                    pass
+            kill_pid_tree(child.pid, signal.SIGTERM)
+        deadline = time.monotonic() + drain_s
+        pending = list(targets)
+        while pending and time.monotonic() < deadline:
+            pending = [
+                c for c in pending
+                if c.proc is not None and c.proc.poll() is None
+            ]
+            if pending:
+                time.sleep(0.05)
+        forced = 0
+        for child in pending:
+            kill_pid_tree(child.pid, signal.SIGKILL)
+            forced += 1
+        for child in targets:
+            if child.proc is not None:
+                try:
+                    child.proc.wait(timeout=5.0)
+                except Exception:
+                    pass
+            unregister_managed_process(child.pid)
+            with self._lock:
+                child.state = CHILD_STOPPED
+        with self._lock:
+            self.stats["forced_kills"] += forced
+        self.server.close()
+        self._closed = True
+        return {"stopped": len(targets), "forced_kills": forced}
+
+    close = stop
+
+    # ---- observability ----
+
+    def _trace_note(self, kind: str, data: dict) -> None:
+        trace = sys.modules.get("room_tpu.serving.trace")
+        if trace is not None:
+            try:
+                trace.note_event(kind, data)
+            except Exception:
+                pass
+
+    def attribution(self) -> dict:
+        """The process-spanning per-class SLO surface: the parent's
+        own recorder plus the latest stats frame from every child."""
+        from ..serving import trace as trace_mod
+
+        snaps = [trace_mod.recorder.attribution()]
+        with self._lock:
+            for child in self.children.values():
+                attr = (child.last_stats or {}).get("attribution")
+                if attr:
+                    snaps.append(attr)
+        return merge_attributions(snaps)
+
+    def unhealthy_shards(self) -> list[int]:
+        with self._lock:
+            return sorted(
+                c.shard for c in self.children.values()
+                if c.state in (CHILD_DEAD, CHILD_FAILED)
+            )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            children = []
+            for c in sorted(self.children.values(),
+                            key=lambda x: x.shard):
+                stats = c.last_stats or {}
+                children.append({
+                    "shard": c.shard,
+                    "state": c.state,
+                    "pid": c.pid,
+                    "address": list(c.address) if c.address else None,
+                    "restarts_in_window": len(c.restarts),
+                    "adopter": c.adopter,
+                    "adopted": stats.get("adopted") or [],
+                    "journal": stats.get("journal"),
+                    "journal_bytes": stats.get("journal_bytes", 0),
+                    "frames": stats.get("frames", 0),
+                    "messages_in": stats.get("messages_in", 0),
+                    "messages_out": stats.get("messages_out", 0),
+                    "escalations": stats.get("escalations", 0),
+                    "dedup_skips": stats.get("dedup_skips", 0),
+                    "rooms_created": stats.get("rooms_created", 0),
+                    "supervision": stats.get("supervision"),
+                })
+            stats = dict(self.stats)
+        return {
+            "mode": "proc",
+            "external": self.external,
+            "n_shards": self.n_shards,
+            "restart_budget": self.restart_budget,
+            "restart_window_s": self.restart_window_s,
+            "placement": self.placement.snapshot(),
+            "membership": self.membership.snapshot(),
+            "children": children,
+            "slo": self.attribution(),
+            **stats,
+        }
+
+
+# ---- process-wide default supervisor ----
+
+_default_proc: Optional[ProcSupervisor] = None
+_default_proc_lock = locks.make_lock("swarm_proc_default")
+
+
+def default_proc() -> ProcSupervisor:
+    global _default_proc
+    with _default_proc_lock:
+        if _default_proc is None:
+            _default_proc = ProcSupervisor()
+        return _default_proc
+
+
+def maybe_default_proc() -> Optional[ProcSupervisor]:
+    """The default process-mode supervisor when
+    ``ROOM_TPU_SWARM_PROC`` is armed over a multi-shard swarm, else
+    None — the cheap guard the health/metrics/runtime surfaces call
+    every tick."""
+    if _default_proc is not None:
+        return _default_proc
+    if knobs.get_bool("ROOM_TPU_SWARM_PROC") and \
+            knobs.get_int("ROOM_TPU_SWARM_SHARDS") > 1:
+        return default_proc()
+    return None
+
+
+def reset_default_proc() -> None:
+    """Testing hook."""
+    global _default_proc
+    with _default_proc_lock:
+        if _default_proc is not None:
+            try:
+                _default_proc.stop()
+            except Exception:
+                pass
+        _default_proc = None
+
+
+# ---- child entry point ----
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="room-tpu swarm shard child process"
+    )
+    parser.add_argument("--shard", type=int, required=True)
+    parser.add_argument("--db-dir", default=None)
+    parser.add_argument("--parent", default=None,
+                        help="host:port of the parent's control wire")
+    parser.add_argument("--hb-s", type=float, default=None)
+    parser.add_argument("--bind-host", default=None,
+                        help="bind the child's wire listener here "
+                        "(0.0.0.0 for containerized children)")
+    parser.add_argument("--advertise-host", default=None,
+                        help="address the parent dials back "
+                        "(service DNS / pod IP when containerized)")
+    args = parser.parse_args(argv)
+    parent = None
+    if args.parent:
+        host, _, port = args.parent.rpartition(":")
+        parent = (host or "127.0.0.1", int(port))
+    from ..serving import faults
+
+    faults.configure_from_env()
+    try:
+        child = ShardChild(
+            args.shard, db_dir=args.db_dir, parent=parent,
+            hb_s=args.hb_s, bind_host=args.bind_host,
+            advertise_host=args.advertise_host,
+        )
+    except ShardLockHeld as e:
+        print(f"refusing to start: {e}", file=sys.stderr)
+        return 3
+    child.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

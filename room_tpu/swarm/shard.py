@@ -841,6 +841,11 @@ def maybe_default_router() -> Optional[SwarmRouter]:
     call this every tick."""
     if _default_router is not None:
         return _default_router
+    if knobs.get_bool("ROOM_TPU_SWARM_PROC"):
+        # process mode: the shard runtimes live in child processes
+        # (procshard.ProcSupervisor owns the files), so the
+        # in-process router must never open them here
+        return None
     if knobs.get_int("ROOM_TPU_SWARM_SHARDS") > 1:
         return default_router()
     return None

@@ -509,6 +509,60 @@ register("ROOM_TPU_DB_LOCK_STATS", "bool", "0",
          "off in production (two clock reads per contended "
          "statement).", scope="bench")
 
+# ---- multi-process swarm shards (docs/swarmshard.md "Process mode") ----
+register("ROOM_TPU_SWARM_PROC", "bool", "0",
+         "Run each swarm shard as a supervised child OS process (own "
+         "interpreter, SQLite handle, agent-loop domain) speaking to "
+         "the parent over framed-RTKW control frames — a segfault or "
+         "OOM-kill in one shard's rooms no longer takes the whole "
+         "swarm down. Requires ROOM_TPU_SWARM_SHARDS > 1.",
+         scope="swarm")
+register("ROOM_TPU_SWARM_PROC_RESTARTS", "int", "3",
+         "Restart budget per shard child process within the sliding "
+         "ROOM_TPU_SWARM_PROC_WINDOW_S window; past it the shard "
+         "degrades to sibling adoption and is reported unhealthy in "
+         "/api/tpu/health.", scope="swarm")
+register("ROOM_TPU_SWARM_PROC_WINDOW_S", "float", "60.0",
+         "Sliding window for the shard-child restart budget.",
+         scope="swarm")
+register("ROOM_TPU_SWARM_PROC_HB_S", "float", "0.5",
+         "Shard-child heartbeat + stats-frame interval over the "
+         "control wire into the parent's alive→suspect→dead "
+         "detector (PodMembership thresholds apply).", scope="swarm")
+register("ROOM_TPU_SWARM_PROC_DRAIN_S", "float", "3.0",
+         "Graceful SIGTERM drain deadline for a shard child: past it "
+         "the supervisor escalates to SIGKILL (the forced-kill "
+         "sweep), on shutdown and on restart alike.", scope="swarm")
+register("ROOM_TPU_SWARM_PROC_BACKOFF_S", "float", "0.25",
+         "Base for the jittered exponential backoff between shard-"
+         "child restarts (doubled per consecutive restart).",
+         scope="swarm")
+register("ROOM_TPU_SWARM_PROC_HOST", "str", "127.0.0.1",
+         "Bind host for the parent supervisor's control-wire "
+         "listener. Keep loopback when children are local "
+         "subprocesses; bind 0.0.0.0 when shard children run as "
+         "separate containers (ROOM_TPU_SWARM_PROC_EXTERNAL=1).",
+         scope="swarm")
+register("ROOM_TPU_SWARM_PROC_PORT", "int", "0",
+         "Bind port for the parent supervisor's control-wire "
+         "listener (0 = ephemeral). Pin it when externally-launched "
+         "shard children must be pointed at the parent via "
+         "--parent host:port.", scope="swarm")
+register("ROOM_TPU_SWARM_PROC_EXTERNAL", "bool", "0",
+         "Shard children are launched OUTSIDE the parent (one "
+         "container per shard via `python -m room_tpu.swarm."
+         "procshard`). The supervisor still runs the heartbeat "
+         "ladder, dispatch plane, budget accounting, and sibling "
+         "adoption, but never signals or spawns processes — PIDs "
+         "live in foreign namespaces; restarts belong to the "
+         "container runtime. Adoption requires the shard SQLite "
+         "files on a volume shared across shard containers.",
+         scope="swarm")
+register("ROOM_TPU_SWARM_PROC_IGNORE_TERM", "bool", "0",
+         "Test seam: the shard child ignores SIGTERM, forcing the "
+         "supervisor's drain deadline to escalate to SIGKILL — the "
+         "forced-kill regression path.", scope="test-seam")
+
 # ---- fleet-global shared prefix store (docs/disagg.md) ----
 register("ROOM_TPU_PREFIX_STORE", "bool", "0",
          "Content-addressed shared prefix KV store: replicas/hosts "

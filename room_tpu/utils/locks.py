@@ -343,6 +343,27 @@ register_lock(
     hints=("shard", "shard_src", "shard_dst", "self.shards"),
     multi_instance=True,
 )
+register_lock(
+    "swarm_proc", "ProcSupervisor child table: per-shard child "
+    "state/pid/address, restart ledger, last heartbeat stats "
+    "(docs/swarmshard.md process mode).",
+    module="room_tpu/swarm/procshard.py", cls="ProcSupervisor",
+    attr="_lock", hints=("proc", "self.proc"),
+)
+register_lock(
+    "swarm_proc_default", "Process-default ProcSupervisor singleton "
+    "build.",
+    module="room_tpu/swarm/procshard.py",
+    attr="_default_proc_lock",
+)
+register_lock(
+    "swarm_proc_child", "Shard child process: serializes "
+    "journaled_once's check-then-act dedup for xshard frames landing "
+    "on the child's own (or adopted) file — the in-process "
+    "swarm_dispatch lock's cross-process twin.",
+    module="room_tpu/swarm/procshard.py", cls="ShardChild",
+    attr="_dispatch_lock", multi_instance=True,
+)
 
 # ---- db ----
 register_lock(

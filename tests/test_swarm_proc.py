@@ -1,0 +1,618 @@
+"""Multi-process swarm shard suite (docs/swarmshard.md "Process
+mode").
+
+Lockdep-armed suite for the OS-process shard isolation layer: every
+shard a supervised child process with its own interpreter/SQLite
+handle, cross-shard dispatch riding framed-RTKW control frames under
+the journaled exactly-once contract, and the PodMembership-mold
+supervisor.  The process-lifecycle scenarios (child spawns, SIGKILLs,
+restarts — seconds each) sit behind ``-m slow`` to keep the tier-1
+window lean; CI's dedicated swarm-proc step runs the FULL file, no
+marker filter.  Covered:
+
+- kill-between-halves: a child SIGKILLed after the outbound half
+  committed — the post-restart redelivery dedups the committed half
+  and fires ONLY the missing one.
+- duplicate-frame redelivery after restart: a byte-identical resend
+  lands on the replacement child and both halves dedup against the
+  journal rows on disk.
+- restart-budget exhaustion degrades to sibling adoption (placement
+  rehome + epoch bump) with the shard unhealthy.
+- PID-tagged shard lockfiles refuse a double-open while the holder
+  lives; a crashed parent's orphans are reaped at the next parent's
+  boot before any child re-opens their files.
+- graceful drain: SIGTERM commits in-flight halves then exits; a
+  SIGTERM-ignoring child is escalated to SIGKILL after the drain
+  deadline; ServerRuntime.stop() sweeps every child.
+- ``shard_proc_kill`` / ``shard_wire_io`` chaos points recover with
+  zero message loss and zero double-fire (docs/chaos.md).
+- a process-mode mini swarm_storm with a SIGKILL mid-storm loses
+  nothing and double-fires nothing.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from room_tpu.db import Database
+from room_tpu.serving import faults, podnet
+from room_tpu.swarm import (
+    ProcSupervisor, ShardDownError, ShardLockHeld, merge_attributions,
+    reset_default_proc, reset_default_router, shard_db_path,
+)
+from room_tpu.swarm.procshard import (
+    acquire_shard_lock, read_shard_lock, release_shard_lock,
+)
+
+# tight-but-safe supervisor timings: child boot is ~0.5s, a full
+# dead->lease->restart cycle ~1.5s
+FAST = dict(suspect_s=0.6, dead_s=1.2, lease_s=0.4,
+            backoff_s=0.05, hb_s=0.15)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    podnet.reset_breakers()
+    reset_default_router()
+    reset_default_proc()
+    yield
+    faults.clear()
+    podnet.reset_breakers()
+    reset_default_router()
+    reset_default_proc()
+
+
+def _wait_serving(sup, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = sup.snapshot()
+        if all(c["state"] == "serving" for c in snap["children"]):
+            return snap
+        time.sleep(0.1)
+    raise AssertionError(
+        f"children never all served: {sup.snapshot()['children']}"
+    )
+
+
+def _wait_restarted(sup, shard, old_pid, timeout=25.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.supervise()
+        c = sup.snapshot()["children"][shard]
+        if c["state"] == "serving" and c["pid"] != old_pid:
+            return c
+        time.sleep(0.1)
+    raise AssertionError(
+        f"shard {shard} never restarted: {sup.snapshot()['children']}"
+    )
+
+
+def _send_retrying(sup, *args, timeout=20.0, **kwargs):
+    """send_message with ShardDownError retries — the shed window
+    while a child restarts is the contract, not a failure."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return sup.send_message(*args, **kwargs)
+        except ShardDownError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+def _room_on_home(sup, home):
+    """Create rooms until one's id hashes to ``home`` (the
+    message/escalation FKs want real rooms)."""
+    for i in range(128):
+        room = sup.create_room(f"probe-{home}-{i}")
+        if sup.base_home(room["id"]) == home:
+            return room["id"]
+    raise AssertionError("allocator never hit the home")
+
+
+def _msg_rows(db_path, direction, subject):
+    db = Database(db_path)
+    try:
+        return db.query(
+            "SELECT id FROM room_messages WHERE direction=? AND "
+            "subject=?", (direction, subject),
+        )
+    finally:
+        db.close()
+
+
+@pytest.fixture()
+def sup(tmp_path):
+    s = ProcSupervisor(n_shards=2, db_dir=str(tmp_path), **FAST)
+    yield s
+    s.stop()
+
+
+# ---- exactly-once over the wire ----
+
+def test_cross_shard_message_exactly_once_over_wire(sup):
+    _wait_serving(sup)
+    a = sup.create_room("alpha")
+    b = sup.create_room("beta")
+    out1, in1 = sup.send_message(a["id"], b["id"], "s1", "b1")
+    out2, in2 = sup.send_message(a["id"], b["id"], "s1", "b1")
+    assert (out1, in1) == (out2, in2)
+    assert sup.stats["dedup_skips"] == 2
+    eid1 = sup.escalate(a["id"], "why?")
+    eid2 = sup.escalate(a["id"], "why?")
+    assert eid1 == eid2
+
+
+@pytest.mark.slow
+def test_kill_between_halves_fires_only_missing_half(sup, tmp_path):
+    """The out-half commits, the DESTINATION child dies before the
+    in-half: after the restart, the full resend dedups the committed
+    half and fires exactly the missing one."""
+    _wait_serving(sup)
+    src_rid = _room_on_home(sup, 0)
+    dst_rid = _room_on_home(sup, 1)
+    args = {"from": src_rid, "to": dst_rid,
+            "subject": "half", "body": "payload"}
+    # the first half, exactly as send_message would fire it
+    out1, dup = sup._xshard(0, "xshard_msg_out", args, src_rid, None)
+    assert not dup
+    victim = sup.snapshot()["children"][1]
+    os.kill(victim["pid"], signal.SIGKILL)
+    _wait_restarted(sup, 1, victim["pid"])
+    out2, in2 = _send_retrying(
+        sup, src_rid, dst_rid, "half", "payload"
+    )
+    assert out2 == int(out1)          # committed half deduped
+    assert len(_msg_rows(shard_db_path(0, str(tmp_path)),
+                         "outbound", "half")) == 1
+    assert len(_msg_rows(shard_db_path(1, str(tmp_path)),
+                         "inbound", "half")) == 1
+
+
+@pytest.mark.slow
+def test_duplicate_redelivery_after_restart_dedups(sup, tmp_path):
+    """A byte-identical resend after the child restarted dedups BOTH
+    halves against the journal rows on disk (the replacement process
+    reads the same file)."""
+    _wait_serving(sup)
+    src_rid = _room_on_home(sup, 0)
+    dst_rid = _room_on_home(sup, 1)
+    first = sup.send_message(src_rid, dst_rid, "dup", "again")
+    victim = sup.snapshot()["children"][1]
+    os.kill(victim["pid"], signal.SIGKILL)
+    _wait_restarted(sup, 1, victim["pid"])
+    second = _send_retrying(sup, src_rid, dst_rid, "dup", "again")
+    assert first == second
+    assert len(_msg_rows(shard_db_path(1, str(tmp_path)),
+                         "inbound", "dup")) == 1
+
+
+@pytest.mark.slow
+def test_restart_rearms_membership_and_counts(sup):
+    _wait_serving(sup)
+    victim = sup.snapshot()["children"][1]
+    os.kill(victim["pid"], signal.SIGKILL)
+    c = _wait_restarted(sup, 1, victim["pid"])
+    assert sup.stats["restarts"] == 1
+    assert c["restarts_in_window"] == 1
+    # the replacement's heartbeats keep the member alive
+    time.sleep(0.5)
+    sup.supervise()
+    assert sup.snapshot()["children"][1]["state"] == "serving"
+
+
+# ---- budget exhaustion -> sibling adoption ----
+
+@pytest.mark.slow
+def test_budget_exhaustion_degrades_to_adoption(tmp_path):
+    sup = ProcSupervisor(n_shards=2, db_dir=str(tmp_path),
+                         restart_budget=0, **FAST)
+    try:
+        _wait_serving(sup)
+        epoch0 = sup.placement.epoch
+        victim = sup.snapshot()["children"][1]
+        os.kill(victim["pid"], signal.SIGKILL)
+        deadline = time.monotonic() + 25
+        adoptions = []
+        while time.monotonic() < deadline and not adoptions:
+            adoptions = sup.supervise()
+            time.sleep(0.1)
+        assert adoptions and adoptions[0]["shard"] == 1
+        assert adoptions[0]["adopter"] == 0
+        assert sup.placement.epoch > epoch0
+        assert sup.unhealthy_shards() == [1]
+        assert sup.snapshot()["children"][1]["state"] == "failed"
+        # dispatch to the dead shard's homes lands on the adopter
+        rid = _room_on_home(sup, 1)
+        out, dup = sup._xshard(
+            1, "xshard_msg_in",
+            {"from": 1, "to": rid, "subject": "x", "body": "y"},
+            rid, None,
+        )
+        assert out and not dup
+        # the row landed in the DEAD shard's file, written by the
+        # adopter child — visible both on disk and over the wire
+        assert len(_msg_rows(shard_db_path(1, str(tmp_path)),
+                             "inbound", "x")) == 1
+        got = sup.query(
+            1, "SELECT COUNT(*) AS n FROM room_messages WHERE "
+            "direction='inbound' AND subject='x'",
+        )
+        assert got[0]["n"] == 1
+    finally:
+        sup.stop()
+
+
+# ---- lockfiles + orphan reap ----
+
+def test_lockfile_refuses_live_holder_and_heals_stale(tmp_path):
+    db_path = shard_db_path(0, str(tmp_path))
+    acquire_shard_lock(db_path, 0)
+    assert read_shard_lock(db_path)["pid"] == os.getpid()
+    # a stale lock (dead pid) is silently replaced
+    with open(db_path + ".lock", "w") as f:
+        json.dump({"pid": 2 ** 22 + 12345, "shard": 0, "ts": 0}, f)
+    acquire_shard_lock(db_path, 0)
+    assert read_shard_lock(db_path)["pid"] == os.getpid()
+    release_shard_lock(db_path)
+    assert read_shard_lock(db_path) is None
+
+
+@pytest.mark.slow
+def test_child_process_refuses_held_lockfile(sup, tmp_path):
+    """A second child for a LIVE shard exits 3 without touching the
+    file — the restarted-parent double-open guard."""
+    _wait_serving(sup)
+    with pytest.raises(ShardLockHeld):
+        acquire_shard_lock(shard_db_path(1, str(tmp_path)), 1)
+    proc = subprocess.run(
+        [sys.executable, "-m", "room_tpu.swarm.procshard",
+         "--shard", "1", "--db-dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+    assert proc.returncode == 3
+    assert "refusing to start" in proc.stderr
+
+
+def test_parent_crash_orphans_reaped_at_next_boot(tmp_path):
+    """A lockfile naming a live PID from a crashed parent's child is
+    killed + cleared before the new parent spawns anything."""
+    sleeper = subprocess.Popen(["sleep", "300"])
+    try:
+        db_path = shard_db_path(0, str(tmp_path))
+        with open(db_path + ".lock", "w") as f:
+            json.dump({"pid": sleeper.pid, "shard": 0, "ts": 0}, f)
+        sup = ProcSupervisor(n_shards=2, db_dir=str(tmp_path),
+                             spawn=False, **FAST)
+        try:
+            assert sup.stats["orphans_reaped"] == 1
+            assert read_shard_lock(db_path) is None
+            sleeper.wait(timeout=5)
+            assert sleeper.returncode is not None
+        finally:
+            sup.stop()
+    finally:
+        if sleeper.poll() is None:
+            sleeper.kill()
+            sleeper.wait()
+
+
+# ---- drain + forced kill ----
+
+@pytest.mark.slow
+def test_graceful_stop_drains_children(sup):
+    snap = _wait_serving(sup)
+    pids = [c["pid"] for c in snap["children"]]
+    out = sup.stop()
+    assert out["stopped"] == 2 and out["forced_kills"] == 0
+    for pid in pids:
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+
+
+@pytest.mark.slow
+def test_sigterm_ignoring_child_gets_forced_kill(tmp_path):
+    """A wedged child — deaf to the drain frame AND SIGTERM (the
+    ``ROOM_TPU_SWARM_PROC_IGNORE_TERM`` seam) — is SIGKILLed after
+    the drain deadline instead of hanging the parent."""
+    sup = ProcSupervisor(
+        n_shards=2, db_dir=str(tmp_path), drain_s=1.0,
+        child_env={"ROOM_TPU_SWARM_PROC_IGNORE_TERM": "1"}, **FAST,
+    )
+    stopped = False
+    try:
+        snap = _wait_serving(sup)
+        pids = [c["pid"] for c in snap["children"]]
+        t0 = time.monotonic()
+        out = sup.stop()
+        stopped = True
+        assert out["forced_kills"] == 2
+        assert time.monotonic() - t0 < 10
+        for pid in pids:
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+    finally:
+        if not stopped:
+            sup.stop()
+
+
+@pytest.mark.slow
+def test_runtime_stop_terminates_shard_children(monkeypatch, tmp_path):
+    """ServerRuntime.stop() sweeps the shard children BEFORE the
+    generic managed-process pass — the parent's clean shutdown
+    terminates every shard process."""
+    import room_tpu.swarm.procshard as procshard_mod
+    from room_tpu.server.runtime import ServerRuntime
+
+    monkeypatch.setenv("ROOM_TPU_SWARM_PROC", "1")
+    monkeypatch.setenv("ROOM_TPU_SWARM_SHARDS", "2")
+    sup = ProcSupervisor(n_shards=2, db_dir=str(tmp_path / "sw"),
+                         **FAST)
+    procshard_mod._default_proc = sup
+    snap = _wait_serving(sup)
+    pids = [c["pid"] for c in snap["children"]]
+    rt = ServerRuntime(db=Database(str(tmp_path / "main.db")))
+    rt.supervision_tick()       # proc.supervise() on the tick path
+    rt.stop()
+    for pid in pids:
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+
+
+# ---- chaos fault points ----
+
+@pytest.mark.slow
+def test_shard_proc_kill_fault_recovers_exactly_once(sup, tmp_path):
+    """faults.inject('shard_proc_kill') SIGKILLs a live child at the
+    next supervise; restart + journal replay keep the traffic
+    exactly-once."""
+    _wait_serving(sup)
+    src_rid = _room_on_home(sup, 0)
+    dst_rid = _room_on_home(sup, 1)
+    sup.send_message(src_rid, dst_rid, "chaos", "one")
+    before = {c["shard"]: c["pid"]
+              for c in sup.snapshot()["children"]}
+    faults.inject("shard_proc_kill", times=1)
+    sup.supervise()
+    assert faults.fired("shard_proc_kill") == 1
+    assert sup.stats["proc_kills"] == 1
+    # some child died; wait until every shard serves again (restart)
+    deadline = time.monotonic() + 25
+    while time.monotonic() < deadline:
+        sup.supervise()
+        snap = sup.snapshot()
+        if all(c["state"] == "serving" for c in snap["children"]) \
+                and any(c["pid"] != before[c["shard"]]
+                        for c in snap["children"]):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError(snap["children"])
+    second = _send_retrying(sup, src_rid, dst_rid, "chaos", "one")
+    assert second == _send_retrying(
+        sup, src_rid, dst_rid, "chaos", "one"
+    )
+    assert len(_msg_rows(shard_db_path(1, str(tmp_path)),
+                         "inbound", "chaos")) == 1
+
+
+@pytest.mark.slow
+def test_shard_wire_io_fault_retries_without_double_fire(sup,
+                                                         tmp_path):
+    """A failed dispatch frame is retried — safe because the frame's
+    journal key dedups a half that actually landed."""
+    _wait_serving(sup)
+    src_rid = _room_on_home(sup, 0)
+    dst_rid = _room_on_home(sup, 1)
+    faults.inject("shard_wire_io", times=1)
+    out, inn = sup.send_message(src_rid, dst_rid, "wio", "b")
+    assert faults.fired("shard_wire_io") == 1
+    assert sup.stats["wire_retries"] >= 1
+    assert out and inn
+    assert len(_msg_rows(shard_db_path(0, str(tmp_path)),
+                         "outbound", "wio")) == 1
+    assert len(_msg_rows(shard_db_path(1, str(tmp_path)),
+                         "inbound", "wio")) == 1
+
+
+# ---- process-mode storm: zero loss, zero double-fire ----
+
+@pytest.mark.slow
+def test_proc_storm_with_midstorm_kill_zero_loss(tmp_path):
+    """A mini process-mode swarm_storm: concurrent cross-shard sends
+    with a SIGKILL mid-storm and a supervise loop running; every
+    message lands exactly once on both sides."""
+    sup = ProcSupervisor(n_shards=2, db_dir=str(tmp_path), **FAST)
+    try:
+        _wait_serving(sup)
+        src_rid = _room_on_home(sup, 0)
+        dst_rid = _room_on_home(sup, 1)
+        stop = threading.Event()
+
+        def supervise_loop():
+            while not stop.is_set():
+                sup.supervise()
+                time.sleep(0.05)
+
+        sup_thread = threading.Thread(target=supervise_loop,
+                                      daemon=True)
+        sup_thread.start()
+        n_msgs, errors = 24, []
+
+        def storm(start, count):
+            for i in range(start, start + count):
+                try:
+                    _send_retrying(
+                        sup, src_rid, dst_rid, f"storm-{i}", "b",
+                        timeout=30,
+                    )
+                except Exception as e:   # noqa: BLE001
+                    errors.append((i, repr(e)))
+
+        threads = [
+            threading.Thread(target=storm, args=(s, 8))
+            for s in range(0, n_msgs, 8)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        victim = sup.snapshot()["children"][1]
+        if victim["pid"] is not None:
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        for t in threads:
+            t.join(timeout=60)
+        stop.set()
+        sup_thread.join(timeout=5)
+        assert not errors, errors
+    finally:
+        sup.stop()
+    # accounting straight off the files: zero lost, zero double-fired
+    out_db = Database(shard_db_path(0, str(tmp_path)))
+    in_db = Database(shard_db_path(1, str(tmp_path)))
+    try:
+        for i in range(24):
+            outs = out_db.query(
+                "SELECT id FROM room_messages WHERE direction="
+                "'outbound' AND subject=?", (f"storm-{i}",),
+            )
+            ins = in_db.query(
+                "SELECT id FROM room_messages WHERE direction="
+                "'inbound' AND subject=?", (f"storm-{i}",),
+            )
+            assert len(outs) == 1, (i, len(outs))   # no loss, no dup
+            assert len(ins) == 1, (i, len(ins))
+    finally:
+        out_db.close()
+        in_db.close()
+
+
+# ---- SLO merge + surfaces ----
+
+def test_merge_attributions_sums_and_reweights():
+    a = {"finished_turns": 2, "classes": {"queen": {
+        "turns": 2, "errors": 1, "queue_ms": 10.0,
+        "ttft_ms_mean": 100.0, "ttft_violations": 0,
+    }}}
+    b = {"finished_turns": 6, "classes": {"queen": {
+        "turns": 6, "errors": 0, "queue_ms": 30.0,
+        "ttft_ms_mean": 200.0, "ttft_violations": 2,
+    }, "worker": {"turns": 1, "errors": 0, "queue_ms": 5.0,
+                  "ttft_ms_mean": None, "ttft_violations": 0}}}
+    m = merge_attributions([a, b, None, "junk"])
+    assert m["finished_turns"] == 8
+    q = m["classes"]["queen"]
+    assert q["turns"] == 8 and q["errors"] == 1
+    assert q["queue_ms"] == 40.0 and q["ttft_violations"] == 2
+    assert q["ttft_ms_mean"] == 175.0    # (100*2 + 200*6) / 8
+    assert m["classes"]["worker"]["turns"] == 1
+    assert "ttft_ms_mean" not in m["classes"]["worker"]
+
+
+def test_snapshot_and_metrics_surface(sup):
+    import room_tpu.swarm.procshard as procshard_mod
+    from room_tpu.server.metrics import render_metrics
+
+    _wait_serving(sup)
+    a = sup.create_room("alpha")
+    b = sup.create_room("beta")
+    sup.send_message(a["id"], b["id"], "m", "b")
+    snap = sup.snapshot()
+    assert snap["mode"] == "proc" and snap["n_shards"] == 2
+    assert {c["shard"] for c in snap["children"]} == {0, 1}
+    assert "slo" in snap and "classes" in snap["slo"]
+    procshard_mod._default_proc = sup
+    try:
+        text = render_metrics()
+        assert "room_tpu_swarm_proc{" in text
+        assert 'stat="serving"' in text
+    finally:
+        procshard_mod._default_proc = None
+
+
+def test_maybe_default_router_gated_off_in_proc_mode(monkeypatch):
+    from room_tpu.swarm import maybe_default_router
+
+    monkeypatch.setenv("ROOM_TPU_SWARM_PROC", "1")
+    monkeypatch.setenv("ROOM_TPU_SWARM_SHARDS", "4")
+    assert maybe_default_router() is None
+
+
+# ---- external mode: shard children as separate containers ----
+
+def _launch_external(shard, db_dir, parent_port):
+    return subprocess.Popen(
+        [sys.executable, "-m", "room_tpu.swarm.procshard",
+         "--shard", str(shard), "--db-dir", db_dir,
+         "--parent", f"127.0.0.1:{parent_port}", "--hb-s", "0.15"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.slow
+def test_external_mode_supervises_foreign_children(tmp_path):
+    """ROOM_TPU_SWARM_PROC_EXTERNAL deployment shape (compose/k8s
+    shard containers): children the parent never spawned register by
+    heartbeat, dispatch exactly-once works unchanged, a killed child
+    opens its slot after the budgeted backoff for the container
+    runtime's replacement, and stop() drains over the wire without
+    ever signalling a foreign PID."""
+    sup = ProcSupervisor(n_shards=2, db_dir=str(tmp_path),
+                         external=True, **FAST)
+    kids = {}
+    try:
+        assert sup.external and sup.snapshot()["external"]
+        port = sup.server.address[1]
+        kids = {k: _launch_external(k, str(tmp_path), port)
+                for k in (0, 1)}
+        _wait_serving(sup)
+        a = sup.create_room("ext-a")
+        b = sup.create_room("ext-b")
+        out1, in1 = sup.send_message(a["id"], b["id"], "e1", "b1")
+        out2, in2 = sup.send_message(a["id"], b["id"], "e1", "b1")
+        assert (out1, in1) == (out2, in2)
+
+        # 'the container runtime' relaunches what the supervisor
+        # cannot: the kill is ours, the respawn is the test's
+        old_pid = sup.snapshot()["children"][1]["pid"]
+        kids[1].send_signal(signal.SIGKILL)
+        kids[1].wait()
+        relaunched = False
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            sup.supervise()
+            snap = sup.snapshot()
+            c1 = snap["children"][1]
+            if not relaunched and snap["restarts"] >= 1 and \
+                    c1["state"] == "starting":
+                assert c1["pid"] is None   # slot opened, not killed
+                kids[1] = _launch_external(1, str(tmp_path), port)
+                relaunched = True
+            if relaunched and c1["state"] == "serving" and \
+                    c1["pid"] != old_pid:
+                break
+            time.sleep(0.05)
+        c1 = sup.snapshot()["children"][1]
+        assert c1["state"] == "serving" and c1["pid"] != old_pid, c1
+        _send_retrying(sup, a["id"], b["id"], "e2", "b2")
+
+        res = sup.stop()
+        assert res["forced_kills"] == 0
+        for k, p in kids.items():
+            p.wait(timeout=10)   # drain frame alone stopped them
+    finally:
+        sup.stop()
+        for p in kids.values():
+            if p.poll() is None:
+                p.kill()

@@ -1618,6 +1618,51 @@ async function renderTpu(el) {
           `#${a}`).join(" ") || "—"}</td>
         </tr>`).join("")}
       </table>` : ""}
+      ${hl.swarm?.proc ? `
+      <h2 style="margin-top:.6rem">swarm shard processes
+        <span class="dim">epoch ${hl.swarm.proc.placement?.epoch ?? 0}
+          · ${hl.swarm.proc.dispatches ?? 0} dispatches
+          · ${hl.swarm.proc.dedup_skips ?? 0} deduped
+          · ${hl.swarm.proc.restarts ?? 0} restarts
+          · ${hl.swarm.proc.adoptions ?? 0} adoptions
+          · ${hl.swarm.proc.orphans_reaped ?? 0} orphans reaped</span></h2>
+      <table><tr><th>shard</th><th>state</th><th>pid</th>
+        <th>restarts/window</th><th>msgs in/out</th>
+        <th>escalations</th><th>journal backlog</th>
+        <th>journal bytes</th><th>adopted</th></tr>
+      ${(hl.swarm.proc.children || []).map((c) => `
+        <tr><td>${c.shard}</td>
+        <td><span class="pill ${
+          c.state === "serving" ? "verified"
+          : (c.state === "dead" || c.state === "failed") ? "failed"
+          : "pending"
+        }">${esc(c.state)}</span>${
+          c.adopter != null
+            ? ` <span class="dim">→ #${c.adopter}</span>` : ""
+        }</td>
+        <td class="dim">${c.pid ?? "—"}</td>
+        <td>${c.restarts_in_window ?? 0}/${
+          hl.swarm.proc.restart_budget ?? 0}</td>
+        <td>${c.messages_in ?? 0} / ${c.messages_out ?? 0}</td>
+        <td>${c.escalations ?? 0}</td>
+        <td class="dim">${c.journal?.backlog ?? 0}</td>
+        <td class="dim">${c.journal_bytes ?? 0}</td>
+        <td class="dim">${(c.adopted || []).map((a) =>
+          `#${a}`).join(" ") || "—"}</td>
+        </tr>`).join("")}
+      </table>
+      ${hl.swarm.proc.slo?.classes ? `
+      <div class="kv">
+        <span class="k">fleet SLO (all processes)</span>
+          <span>${Object.entries(hl.swarm.proc.slo.classes).map(
+            ([cls, a]) =>
+              `${esc(cls)}: ${a.turns ?? 0} turns` +
+              (a.ttft_ms_mean != null
+                ? ` · ttft ${a.ttft_ms_mean}ms` : "") +
+              ` · ${(a.ttft_violations ?? 0) +
+                    (a.tpot_violations ?? 0)} SLO misses`
+          ).join("<br>")}</span>
+      </div>` : ""}` : ""}
       <h2 style="margin-top:.6rem">lifecycle</h2>
       <div class="kv">
         <span class="k">process phase</span>
