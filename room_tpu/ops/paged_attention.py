@@ -779,6 +779,79 @@ def ragged_block_layout(
     )
 
 
+def ragged_shard_layout(
+    n_decode: int, n_chunks: int, chunk_width: int, n_shards: int,
+) -> dict[str, np.ndarray]:
+    """Static shard-local ragged layout for the dp-sharded fused window
+    (docs/serving.md).
+
+    The sharded window's token stream is [n_shards, T_local] with each
+    shard laid out exactly like the dp=1 ragged stream — its
+    ``n_decode/n_shards`` decode lanes first, then its
+    ``n_chunks/n_shards`` chunk rows of ``chunk_width`` tokens — and
+    rows stored shard-major, so every map here is per-shard-periodic
+    and no q-row (a fortiori no q-block) ever straddles a shard
+    boundary: each dp shard's slice of the stream is a complete,
+    self-contained ragged sub-batch the kernel (or the XLA reference)
+    can consume with zero cross-shard reads. Returns int32 maps over
+    the FLAT shard-major token/row order:
+
+      row_of_token [S*T_local] — flat token -> flat ragged row
+      off_in_row   [S*T_local] — token's offset within its row's chunk
+      dec_rows     [n_decode]  — flat row ids of the decode lanes
+      ch_rows      [n_chunks]  — flat row ids of the chunk rows
+      dec_toks     [n_decode]  — flat token ids of the decode queries
+      ch_toks      [n_chunks*chunk_width] — flat token ids of chunk qs
+      inv_perm     [S*T_local] — concat([dec, ch]) order -> flat order
+
+    With ``n_shards == 1`` every map degenerates to the unsharded fused
+    layout (decode tokens first, chunks after, inv_perm identity)."""
+    if n_decode % n_shards or n_chunks % n_shards:
+        raise ValueError(
+            f"decode {n_decode} / chunk {n_chunks} rows must divide "
+            f"over {n_shards} dp shards"
+        )
+    bl = n_decode // n_shards
+    cl = n_chunks // n_shards
+    r_local = bl + cl
+    t_local = bl + cl * chunk_width
+    sh = np.arange(n_shards, dtype=np.int32)
+    local_row = np.concatenate([
+        np.arange(bl, dtype=np.int32),
+        bl + np.repeat(np.arange(cl, dtype=np.int32), chunk_width),
+    ]) if cl else np.arange(bl, dtype=np.int32)
+    local_off = np.concatenate([
+        np.zeros(bl, np.int32),
+        np.tile(np.arange(chunk_width, dtype=np.int32), cl),
+    ]) if cl else np.zeros(bl, np.int32)
+    row_of_token = (
+        sh[:, None] * r_local + local_row[None]
+    ).reshape(-1)
+    off_in_row = np.tile(local_off, n_shards)
+    dec_rows = (
+        sh[:, None] * r_local + np.arange(bl, dtype=np.int32)[None]
+    ).reshape(-1)
+    ch_rows = (
+        sh[:, None] * r_local + bl
+        + np.arange(cl, dtype=np.int32)[None]
+    ).reshape(-1)
+    dec_toks = (
+        sh[:, None] * t_local + np.arange(bl, dtype=np.int32)[None]
+    ).reshape(-1)
+    ch_toks = (
+        sh[:, None] * t_local + bl
+        + np.arange(cl * chunk_width, dtype=np.int32)[None]
+    ).reshape(-1)
+    perm = np.concatenate([dec_toks, ch_toks])
+    inv_perm = np.argsort(perm).astype(np.int32)
+    return {
+        "row_of_token": row_of_token, "off_in_row": off_in_row,
+        "dec_rows": dec_rows, "ch_rows": ch_rows,
+        "dec_toks": dec_toks, "ch_toks": ch_toks,
+        "inv_perm": inv_perm,
+    }
+
+
 def _ragged_kernel(
     # scalar prefetch
     tables_ref,      # [R, max_pages] SMEM

@@ -160,6 +160,57 @@ def page_cache_specs(
     return out
 
 
+# ---- dp-sharded fused window: declarative sub-batch layout ----
+#
+# The engine's fused dispatch window (docs/serving.md) addresses every
+# array it stages — decode-lane vectors, the ragged token stream, the
+# chunk sub-batch, the spec emission ring — by NAME through this
+# regex -> axes rule table (first match wins) instead of hard-coding a
+# PartitionSpec at each call site. The table is the single place the
+# dp-sharded layout lives: swapping it re-lays the whole sub-batch
+# construction without touching the engine, and the default encodes
+# the no-cross-shard-collectives contract (everything leads with the
+# dp axis; trailing axes replicated; the page pool itself stays on
+# page_cache_specs).
+WINDOW_RULES: tuple[tuple[str, tuple], ...] = (
+    # ragged [ndp, T_local] token stream + its positions/hidden states
+    (r"^(tokens|positions|hidden)$", ("dp",)),
+    # chunk sub-batch [ndp*Cl, ...]: shard-major rows, equal blocks
+    # per shard, so the leading axis IS the dp axis
+    (r"^chunk_", ("dp",)),
+    # decode-batch vectors/matrices keyed by slot: [B, ...] with
+    # B % dp == 0 (engine admission invariant)
+    (r"^(slot|feed|fresh|spec|scan)_", ("dp",)),
+    # everything else the window stages follows the slot layout
+    (r".*", ("dp",)),
+)
+
+
+def window_spec(
+    name: str, ndim: int,
+    rules: Optional[tuple[tuple[str, tuple], ...]] = None,
+) -> P:
+    """Resolve a window array's PartitionSpec from the rule table:
+    first pattern matching ``name`` wins; its axes tuple is truncated
+    or right-padded with ``None`` to the array's rank (the
+    match_partition_rules idiom, specialized to the window's arrays)."""
+    import re
+
+    for pat, axes in (WINDOW_RULES if rules is None else rules):
+        if re.match(pat, name):
+            ax = tuple(axes)[:ndim]
+            return P(*(ax + (None,) * (ndim - len(ax))))
+    return P()
+
+
+def window_sharding(
+    mesh: Mesh, name: str, ndim: int,
+    rules: Optional[tuple[tuple[str, tuple], ...]] = None,
+) -> NamedSharding:
+    """NamedSharding for one named window array on ``mesh``."""
+    return NamedSharding(mesh, window_spec(name, ndim, rules))
+
+
 def encoder_param_specs(cfg: EncoderConfig) -> dict[str, Any]:
     return {
         "word_embed": P("tp", None),

@@ -106,7 +106,8 @@ _ENGINE_GAUGES = (
     "offload_resident_fallbacks", "offload_reprefills",
     "prefill_chunks_interleaved", "prefill_chunk_defers",
     "prefill_chunk_faults", "chunk_dispatches", "fused_windows",
-    "fused_chunks", "spec_rounds", "spec_proposed", "spec_accepted",
+    "fused_chunks", "fused_dp_windows",
+    "spec_rounds", "spec_proposed", "spec_accepted",
     "spec_throttles", "spec_rows_sequential",
     "queued", "sessions", "free_pages", "max_batch", "active_slots",
     # shared prefix store + disagg ships (docs/disagg.md)

@@ -1189,7 +1189,9 @@ def register_aux_routes(r: Router) -> None:
                 # fused-window diagnosability (docs/serving.md): a
                 # mixed-mesh fleet must show WHY a replica fell back
                 # to split per-chunk dispatches
-                "fused_window", "fused_window_disabled_reason",
+                "fused_window", "fused_window_mode",
+                "fused_window_disabled_reason",
+                "fused_windows", "fused_chunks", "fused_dp_windows",
                 # shared prefix store + disagg ships (docs/disagg.md)
                 "prefix_store_hits", "prefix_store_tokens_reused",
                 "prefix_store_pull_fallbacks",
@@ -1219,6 +1221,11 @@ def register_aux_routes(r: Router) -> None:
             # speculation table
             if e.get("spec") is not None:
                 summary[name]["spec"] = e["spec"]
+            # dp-sharded fused-window block (docs/serving.md): shard
+            # count, sharded-window count and per-shard chunk-row
+            # placement — rendered whole by the TPU panel
+            if e.get("fused_dp") is not None:
+                summary[name]["fused_dp"] = e["fused_dp"]
             # fleet blocks (docs/fleet.md): the aggregate (bare model
             # key) carries router/failover counters + per-replica
             # health scores; each model#rid key carries its replica's

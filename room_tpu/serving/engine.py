@@ -528,17 +528,26 @@ class ServingEngine:
         # fused dispatch window (docs/serving.md): the step's admitted
         # interleaved prefill chunks ride the SAME device dispatch as
         # the decode window — one host round trip per scheduler window
-        # instead of one per chunk plus one for decode. Disabled under
-        # dp sharding (the ragged [1, T] token stream has no dp axis).
+        # instead of one per chunk plus one for decode. Under dp
+        # sharding the ragged stream becomes per-dp-shard sub-batches
+        # ([ndp, T_local], shard-major chunk rows — the dp-sharded
+        # fused spec-window), unless ROOM_TPU_FUSED_WINDOW_DP=0
+        # restores the legacy split-per-chunk fallback.
+        fused_on = knobs.get_bool("ROOM_TPU_FUSED_WINDOW")
+        fused_dp_on = knobs.get_bool("ROOM_TPU_FUSED_WINDOW_DP")
         self.fused_window = (
-            knobs.get_bool("ROOM_TPU_FUSED_WINDOW")
+            fused_on
             and self.sched_chunk_tokens > 0
-            and self._dp_size == 1
+            and (self._dp_size == 1 or fused_dp_on)
         )
-        # why the fused window is off, for stats()/health/panel: a
+        # mode {off, fused, fused-dp} for stats()/health/panel: a
         # fleet of mixed-mesh replicas (some dp-sharded, some not) is
         # otherwise undiagnosable — the dp auto-off was silent
-        if not knobs.get_bool("ROOM_TPU_FUSED_WINDOW"):
+        self.fused_window_mode = (
+            "off" if not self.fused_window
+            else ("fused-dp" if self._dp_size > 1 else "fused")
+        )
+        if not fused_on:
             self.fused_window_disabled_reason: Optional[str] = \
                 "disabled by ROOM_TPU_FUSED_WINDOW=0"
         elif self.sched_chunk_tokens <= 0:
@@ -546,11 +555,11 @@ class ServingEngine:
                 "interleaved chunked prefill disabled "
                 "(ROOM_TPU_PREFILL_CHUNK_PAGES=0)"
             )
-        elif self._dp_size != 1:
+        elif self._dp_size > 1 and not fused_dp_on:
             self.fused_window_disabled_reason = (
-                f"auto-off under dp sharding (dp={self._dp_size}): the "
-                "ragged [1, T] token stream has no dp axis (ROADMAP "
-                "dp-sharded fused window open item)"
+                f"auto-off under dp sharding (dp={self._dp_size}): "
+                "sharded fused window disabled by "
+                "ROOM_TPU_FUSED_WINDOW_DP=0"
             )
             import logging
 
@@ -558,8 +567,30 @@ class ServingEngine:
                 "fused dispatch window %s for %s",
                 self.fused_window_disabled_reason, cfg.name,
             )
+        elif self._dp_size > 1:
+            # not a disablement: the sharded variant IS the fused
+            # window here — the reason string flips to a mode marker
+            # so mixed-mesh health surfaces show HOW, not just whether
+            self.fused_window_disabled_reason = (
+                f"sharded variant active (dp={self._dp_size})"
+            )
+            import logging
+
+            logging.getLogger(__name__).info(
+                "fused dispatch window %s for %s",
+                self.fused_window_disabled_reason, cfg.name,
+            )
         else:
             self.fused_window_disabled_reason = None
+        if self.fused_window_mode == "fused-dp":
+            # per-shard chunk budgets (docs/scheduler.md): each dp
+            # shard absorbs its own chunk rows at the same dispatch
+            # cost, so the per-step budget scales with the shard count
+            self.scheduler.chunk_shards = self._dp_size
+        # per-shard fused-window telemetry (stats()/health/TPU panel):
+        # chunk rows landed per dp shard, mutated under _lock by
+        # _commit_staged
+        self._fused_dp_shard_chunks = [0] * max(1, self._dp_size)
         self.sessions: dict[str, _Session] = {}
         # admission queue: the scheduler's EDF heap (class TTFT target
         # deadlines), drop-in for the old FIFO queue.Queue surface
@@ -734,6 +765,9 @@ class ServingEngine:
             # writes with the decode scan, and chunks that rode fused
             "chunk_dispatches": 0, "fused_windows": 0,
             "fused_chunks": 0,
+            # dp-sharded fused spec-window (docs/serving.md): fused
+            # windows dispatched as per-dp-shard ragged sub-batches
+            "fused_dp_windows": 0,
             # shared prefix store (docs/disagg.md): local-cache misses
             # served by a pull from the fleet-global tier, tokens those
             # pulls saved re-prefilling, pulls that degraded to an
@@ -780,17 +814,36 @@ class ServingEngine:
             cache, self._cache_specs,
         )
 
-    def _place_batch(self, arr: np.ndarray, *, jnp_dtype=None) -> jax.Array:
-        """Decode-batch inputs shard their leading (slot) axis over dp
-        when the mesh has one; replicated otherwise."""
+    def _place_batch(
+        self, arr: np.ndarray, *, jnp_dtype=None, name: str = "slot_batch"
+    ) -> jax.Array:
+        """Decode-batch inputs shard per the declarative window rule
+        table (parallel.mesh.WINDOW_RULES — regex name -> PartitionSpec,
+        leading slot axis over dp by default) when the mesh has a dp
+        axis; replicated otherwise."""
         x = jnp.asarray(arr) if jnp_dtype is None else \
             jnp.asarray(arr, jnp_dtype)
         if self._dp_size > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.mesh import window_sharding
 
-            spec = P(*(("dp",) + (None,) * (x.ndim - 1)))
-            x = jax.device_put(x, NamedSharding(self.mesh, spec))
+            x = jax.device_put(
+                x, window_sharding(self.mesh, name, x.ndim)
+            )
         return x
+
+    def _constrain_dp(self, x: jax.Array, name: str) -> jax.Array:
+        """In-trace sharding constraint for a dp-sharded fused-window
+        intermediate, resolved through the same rule table as
+        _place_batch — pins the ragged [ndp, T_local] stream to the dp
+        axis so GSPMD never inserts a cross-shard reshuffle on the
+        token path."""
+        if self._dp_size <= 1 or self.mesh is None:
+            return x
+        from ..parallel.mesh import window_sharding
+
+        return jax.lax.with_sharding_constraint(
+            x, window_sharding(self.mesh, name, x.ndim)
+        )
 
     def _pages_bucket(self, n_tokens: int) -> Optional[int]:
         """Static bound on how many leading block-table pages attention
@@ -1256,9 +1309,49 @@ class ServingEngine:
             self._jit_cache[key] = decode
         return self._jit_cache[key]
 
+    def _ragged_stream(self, ndp: int, n_chunks: int, tokens0,
+                       lengths, block_tables, chunk_tokens,
+                       chunk_tables, chunk_lens):
+        """Build the fused window's ragged token stream (traced).
+
+        dp=1: the classic [1, B + C*cw] flat stream, decode lanes
+        first. dp>1 (the sharded fused window, docs/serving.md): the
+        stream is [ndp, B/ndp + Cl*cw] — each dp shard's slice holds
+        ITS decode lanes followed by ITS Cl shard-major chunk rows, so
+        the forward is a per-shard ragged sub-batch with no cross-shard
+        collective on the token path. Returns (flat tokens, positions,
+        row-major block tables, row prefix lens)."""
+        cw = self.sched_chunk_tokens
+        b = self.max_batch
+        bl = b // ndp
+        cl = n_chunks // ndp
+        chunk_pos = (
+            chunk_lens[:, None] + jnp.arange(cw)
+        ).reshape(ndp, cl * cw)
+        flat = jnp.concatenate([
+            tokens0.reshape(ndp, bl),
+            chunk_tokens.reshape(ndp, cl * cw),
+        ], axis=1)                         # [ndp, bl + cl*cw]
+        pos = jnp.concatenate(
+            [lengths.reshape(ndp, bl), chunk_pos], axis=1
+        )
+        tables_r = jnp.concatenate([
+            block_tables.reshape(ndp, bl, -1),
+            chunk_tables.reshape(ndp, cl, -1),
+        ], axis=1).reshape(ndp * (bl + cl), -1)
+        prefix_r = jnp.concatenate(
+            [lengths.reshape(ndp, bl), chunk_lens.reshape(ndp, cl)],
+            axis=1,
+        ).reshape(-1)
+        return (
+            self._constrain_dp(flat, "tokens"),
+            self._constrain_dp(pos, "positions"),
+            tables_r, prefix_r,
+        )
+
     def _fused_fn(self, n_steps: int, n_chunks: int,
                   active_pages: Optional[int] = None,
-                  penalized: bool = False):
+                  penalized: bool = False, ndp: int = 1):
         """Fused-window variant of _decode_fn: ONE compiled dispatch
         covering the scheduler window's staged prefill chunks AND its
         decode steps. Step 0 is a forward over the ragged
@@ -1271,9 +1364,13 @@ class ServingEngine:
         samples nothing until its tail admission), and the decode
         lanes are token-identical to the split path: the same KV lands
         at the same positions and sampling consumes the same per-step
-        rng keys."""
+        rng keys. ``ndp > 1`` shards the stream into per-dp-shard
+        ragged sub-batches (_ragged_stream) — same rows, same write
+        positions, same sampling keys, so greedy streams stay
+        token-identical to the dp=1 window."""
         cw = self.sched_chunk_tokens
-        key = ("fused", n_steps, n_chunks, cw, active_pages, penalized)
+        key = ("fused", n_steps, n_chunks, cw, active_pages, penalized,
+               ndp)
         if key not in self._jit_cache:
             cfg = self.cfg
             pad_id = self.tokenizer.pad_id
@@ -1288,30 +1385,25 @@ class ServingEngine:
                       chunk_tokens, chunk_tables, chunk_lens):
                 tokens0 = jnp.where(fresh_mask, fresh_tokens,
                                     prev_tokens)
-                flat = jnp.concatenate(
-                    [tokens0, chunk_tokens.reshape(-1)]
-                )[None]                                # [1, B + C*cw]
-                pos = jnp.concatenate([
-                    lengths,
-                    (chunk_lens[:, None] + jnp.arange(cw)).reshape(-1),
-                ])[None]
-                tables_r = jnp.concatenate(
-                    [block_tables, chunk_tables], axis=0
+                flat, pos, tables_r, prefix_r = self._ragged_stream(
+                    ndp, n_chunks, tokens0, lengths, block_tables,
+                    chunk_tokens, chunk_tables, chunk_lens,
                 )
-                prefix_r = jnp.concatenate([lengths, chunk_lens])
                 hook = make_ragged_kv_hook(
                     tables_r, prefix_r, self.page_size,
                     n_decode=b, n_chunks=n_chunks, chunk_width=cw,
                     active_pages=active_pages,
                     pallas_ragged=self._pallas_ragged,
                     q_block=self.ragged_qblock,
+                    n_shards=ndp,
                 )
                 hidden, cache = qwen3.forward(
                     params, cfg, flat, pos, cache, kv_hook=hook,
                     apply_head=False,
                 )
                 logits0 = qwen3.lm_head(
-                    params, cfg, hidden[0, :b][:, None]
+                    params, cfg,
+                    hidden[:, :b // ndp].reshape(b, 1, -1)
                 )[:, 0]                                # [B, V]
                 keys = jax.random.split(rng, n_steps)
                 row_logits = logits0
@@ -1370,7 +1462,7 @@ class ServingEngine:
 
     def _spec_window_fn(self, n_steps: int, width: int, n_chunks: int,
                         active_pages: Optional[int] = None,
-                        penalized: bool = False):
+                        penalized: bool = False, ndp: int = 1):
         """The speculative dispatch window (docs/serving.md): one
         compiled window whose every scan step drafts ON-MESH, verifies,
         and emits a VARIABLE 1..width tokens per lane — no host round
@@ -1400,10 +1492,15 @@ class ServingEngine:
 
         The ring is [n_steps, B, width] (pad-filled past each step's
         emission) with sibling [n_steps, B] emitted/drafted counts the
-        host drains asynchronously."""
+        host drains asynchronously. ``ndp > 1`` is the dp-sharded
+        fused spec-window: step 0's ragged stream becomes per-dp-shard
+        sub-batches and every [B]-leading carry (tokens, lens, tails,
+        the emission ring) shards its slot axis over dp — spec_step's
+        math is row-wise, so drafting/verify/advance are shard-local
+        with no cross-shard collective on the token path."""
         use_draft = self._draft is not None and width > 1
         key = ("spec_window", n_steps, width, n_chunks, active_pages,
-               penalized, use_draft)
+               penalized, use_draft, ndp)
         if key not in self._jit_cache:
             cfg = self.cfg
             pad_id = self.tokenizer.pad_id
@@ -1562,32 +1659,28 @@ class ServingEngine:
                 if n_chunks > 0:
                     # fused step 0: the ragged [decode-lanes +
                     # chunk-rows] forward, exactly _fused_fn's — one
-                    # token per lane, drafting starts at step 1
-                    flat = jnp.concatenate(
-                        [toks, chunk_tokens.reshape(-1)]
-                    )[None]
-                    pos = jnp.concatenate([
-                        lens,
-                        (chunk_lens[:, None]
-                         + jnp.arange(cw)).reshape(-1),
-                    ])[None]
-                    tables_r = jnp.concatenate(
-                        [block_tables, chunk_tables], axis=0
-                    )
-                    prefix_r = jnp.concatenate([lens, chunk_lens])
+                    # token per lane, drafting starts at step 1 (dp>1:
+                    # per-dp-shard ragged sub-batches, _ragged_stream)
+                    flat, pos, tables_r, prefix_r = \
+                        self._ragged_stream(
+                            ndp, n_chunks, toks, lens, block_tables,
+                            chunk_tokens, chunk_tables, chunk_lens,
+                        )
                     hook = make_ragged_kv_hook(
                         tables_r, prefix_r, self.page_size,
                         n_decode=b, n_chunks=n_chunks, chunk_width=cw,
                         active_pages=active_pages,
                         pallas_ragged=self._pallas_ragged,
                         q_block=self.ragged_qblock,
+                        n_shards=ndp,
                     )
                     hidden, cache = qwen3.forward(
                         params, cfg, flat, pos, cache, kv_hook=hook,
                         apply_head=False,
                     )
                     logits0 = qwen3.lm_head(
-                        params, cfg, hidden[0, :b][:, None]
+                        params, cfg,
+                        hidden[:, :b // ndp].reshape(b, 1, -1)
                     )[:, 0].astype(jnp.float32)
                     if penalized:
                         logits0 = apply_penalties(
@@ -1954,8 +2047,18 @@ class ServingEngine:
         # mixed-mesh replicas (some dp-sharded) must be able to tell
         # WHY a replica fell back to split per-chunk dispatches
         out["fused_window"] = self.fused_window
+        out["fused_window_mode"] = self.fused_window_mode
         out["fused_window_disabled_reason"] = \
             self.fused_window_disabled_reason
+        if self._dp_size > 1:
+            # dp-sharded fused spec-window: per-shard chunk-row
+            # placement so a skewed shard (one dp slice absorbing all
+            # the chunk traffic) is visible from the health surface
+            out["fused_dp"] = {
+                "dp": self._dp_size,
+                "windows": out.get("fused_dp_windows", 0),
+                "chunks_per_shard": list(self._fused_dp_shard_chunks),
+            }
         out["active_slots"] = sum(
             1 for t in self._active if t is not None
         )
@@ -3510,11 +3613,20 @@ class ServingEngine:
         if not staged:
             return
         cw = self.sched_chunk_tokens
-        c_pad = self._pow2(len(staged))
+        # under the dp-sharded fused window the flush batch keeps the
+        # shard-major layout (equal rows per dp shard) so the write
+        # batch's leading axis shards over dp like the fused dispatch
+        ndp = self._dp_size if self.fused_window_mode == "fused-dp" \
+            else 1
+        cl = self._pow2(-(-len(staged) // ndp))
+        c_pad = cl * ndp
         toks = np.full((c_pad, cw), self.tokenizer.pad_id, np.int32)
         tables = np.zeros((c_pad, self.max_pages_per_seq), np.int32)
         lens = np.zeros((c_pad,), np.int32)
-        for r, rec in enumerate(staged):
+        for i, rec in enumerate(staged):
+            shard = i % ndp
+            rec["shard"] = shard
+            r = shard * cl + i // ndp
             toks[r] = rec["toks"]
             tables[r] = rec["table"]
             lens[r] = rec["base_len"]
@@ -3530,8 +3642,10 @@ class ServingEngine:
             # donated buffer is consumed by a failed attempt
             faults.maybe_fail("prefill_oom")
             return write(
-                self.params, self.cache, jnp.asarray(toks),
-                jnp.asarray(tables), jnp.asarray(lens),
+                self.params, self.cache,
+                self._place_batch(toks, name="chunk_tokens"),
+                self._place_batch(tables, name="chunk_tables"),
+                self._place_batch(lens, name="chunk_lens"),
             )
 
         try:
@@ -3555,6 +3669,13 @@ class ServingEngine:
         if fused:
             self._bump("fused_windows")
             self._bump("fused_chunks", len(staged))
+            if self.fused_window_mode == "fused-dp":
+                self._bump("fused_dp_windows")
+                with self._lock:
+                    for rec in staged:
+                        self._fused_dp_shard_chunks[
+                            rec.get("shard", 0)
+                        ] += 1
         for rec in staged:
             tr = rec["turn"].trace
             if tr is not None:
@@ -3739,9 +3860,19 @@ class ServingEngine:
             pen_args = (jnp.float32(0), jnp.float32(0))
         chunk_args: tuple = ()
         c_pad = 0
+        ndp = self._dp_size if self.fused_window_mode == "fused-dp" \
+            else 1
         if staged:
-            # fused window: the staged chunk batch rides this dispatch
-            c_pad = self._pow2(len(staged))
+            # fused window: the staged chunk batch rides this dispatch.
+            # dp>1 (sharded fused window): chunk rows are dealt
+            # round-robin over the dp shards and stored shard-major
+            # (row = shard * Cl + index-within-shard) with Cl equal
+            # per shard, so the [ndp*Cl, ...] arrays shard over dp in
+            # equal contiguous blocks — each shard's ragged sub-batch
+            # carries its own chunk rows. Pad rows (pad tokens, zero
+            # tables -> scratch page 0) fill each shard's remainder.
+            cl = self._pow2(-(-len(staged) // ndp))
+            c_pad = cl * ndp
             chunk_tokens = np.full(
                 (c_pad, cw), self.tokenizer.pad_id, np.int32
             )
@@ -3749,14 +3880,17 @@ class ServingEngine:
                 (c_pad, self.max_pages_per_seq), np.int32
             )
             chunk_lens = np.zeros((c_pad,), np.int32)
-            for r, rec in enumerate(staged):
+            for i, rec in enumerate(staged):
+                shard = i % ndp
+                rec["shard"] = shard
+                r = shard * cl + i // ndp
                 chunk_tokens[r] = rec["toks"]
                 chunk_tables[r] = rec["table"]
                 chunk_lens[r] = rec["base_len"]
             chunk_args = (
-                jnp.asarray(chunk_tokens),
-                jnp.asarray(chunk_tables),
-                jnp.asarray(chunk_lens),
+                self._place_batch(chunk_tokens, name="chunk_tokens"),
+                self._place_batch(chunk_tables, name="chunk_tables"),
+                self._place_batch(chunk_lens, name="chunk_lens"),
             )
         scan_tables, scan_lengths = \
             self._slot_arrays_excluding(active_idx)
@@ -3802,7 +3936,8 @@ class ServingEngine:
                 coverage[i] = int(self._slot_lengths[i]) \
                     + int(self._reserved_tokens[i])
             specwin = self._spec_window_fn(
-                steps, width, c_pad, ap, penalized
+                steps, width, c_pad, ap, penalized,
+                ndp=ndp if staged else 1,
             )
             draft_params = self._draft[1] if self._draft is not None \
                 else jnp.int32(0)
@@ -3842,7 +3977,9 @@ class ServingEngine:
                 )
         else:
             if staged:
-                decode = self._fused_fn(steps, c_pad, ap, penalized)
+                decode = self._fused_fn(
+                    steps, c_pad, ap, penalized, ndp=ndp
+                )
             else:
                 decode = self._decode_fn(steps, ap, penalized)
 

@@ -448,12 +448,13 @@ def make_ragged_kv_hook(
     prefix_lens: jax.Array,    # [R] KV tokens already in cache per row
     page_size: int,
     *,
-    n_decode: int,             # rows 0..n_decode-1 carry ONE query token
-    n_chunks: int,             # rows n_decode.. carry chunk_width tokens
+    n_decode: int,             # total decode rows (ONE query token each)
+    n_chunks: int,             # total chunk rows (chunk_width tokens)
     chunk_width: int,
     active_pages: Optional[int] = None,
     pallas_ragged: Optional[bool] = None,
     q_block: int = 8,
+    n_shards: int = 1,
 ):
     """kv_hook for the engine's FUSED dispatch: one forward over the
     ragged [decode-lanes + prefill-chunks] token stream (shape
@@ -471,8 +472,18 @@ def make_ragged_kv_hook(
     The same overrun contracts as make_paged_kv_hook hold: positions
     past the block table divert to scratch page 0, and rows that are
     padding (inactive decode lanes, chunk-batch pad rows) write scratch
-    KV that is garbage by construction."""
-    import numpy as np
+    KV that is garbage by construction.
+
+    ``n_shards > 1`` is the dp-sharded fused window (docs/serving.md):
+    the token stream arrives as [n_shards, T_local] with rows stored
+    shard-major and each shard laid out decode-lanes-first exactly like
+    the dp=1 stream (ops.paged_attention.ragged_shard_layout), so each
+    dp shard's slice is a self-contained ragged sub-batch — writes,
+    page gathers and attention are all row-local and the token path
+    needs no cross-shard collective. The per-segment reference math is
+    unchanged (the same rows through the same attention_ref), which is
+    what keeps dp-sharded greedy streams token-identical to dp=1."""
+    from ..ops.paged_attention import ragged_shard_layout
 
     r_total, max_pages = block_tables.shape
     if r_total != n_decode + n_chunks:
@@ -481,24 +492,21 @@ def make_ragged_kv_hook(
             f"{n_chunks} chunks"
         )
     # static token -> row / offset maps (the ragged layout is a pure
-    # function of the fused batch shape, so these fold into the jit)
-    row_of_token = np.concatenate([
-        np.arange(n_decode, dtype=np.int32),
-        np.repeat(
-            n_decode + np.arange(n_chunks, dtype=np.int32), chunk_width
-        ),
-    ]) if n_chunks else np.arange(n_decode, dtype=np.int32)
-    off_in_row = np.concatenate([
-        np.zeros(n_decode, np.int32),
-        np.tile(np.arange(chunk_width, dtype=np.int32), n_chunks),
-    ]) if n_chunks else np.zeros(n_decode, np.int32)
+    # function of the fused batch shape, so these fold into the jit);
+    # with n_shards == 1 these reduce to the flat decode-first layout
+    lay = ragged_shard_layout(
+        n_decode, n_chunks, chunk_width, n_shards
+    )
+    row_of_token = lay["row_of_token"]
+    off_in_row = lay["off_in_row"]
     n_tokens = row_of_token.shape[0]
+    t_local = n_tokens // n_shards
 
     def hook(q, k, v, layer_cache):
-        if q.shape[0] != 1 or q.shape[1] != n_tokens:
+        if q.shape[0] != n_shards or q.shape[1] != t_local:
             raise ValueError(
-                f"ragged hook expects [1, {n_tokens}, H, D] q, got "
-                f"{q.shape}"
+                f"ragged hook expects [{n_shards}, {t_local}, H, D] "
+                f"q, got {q.shape}"
             )
         quantized = "k_scale" in layer_cache
         rows_j = jnp.asarray(row_of_token)
@@ -512,8 +520,8 @@ def make_ragged_kv_hook(
         )
         offset = positions % page_size
 
-        k_flat = k[0]                                  # [T, Hkv, D]
-        v_flat = v[0]
+        k_flat = k.reshape(n_tokens, *k.shape[2:])     # [T, Hkv, D]
+        v_flat = v.reshape(n_tokens, *v.shape[2:])
         if quantized:
             qk, sk = _quantize_kv(k_flat)
             qv, sv = _quantize_kv(v_flat)
@@ -543,13 +551,19 @@ def make_ragged_kv_hook(
                 ragged_block_layout,
             )
 
-            q_lens = (1,) * n_decode + (chunk_width,) * n_chunks
+            # shard-major per-row query lengths: each shard's rows are
+            # [its decode lanes, its chunk rows] — rows never straddle
+            # shards, so the flat kernel layout is shard-local
+            q_lens = (
+                (1,) * (n_decode // n_shards)
+                + (chunk_width,) * (n_chunks // n_shards)
+            ) * n_shards
             rowmap, blkmap, gather, scatter = ragged_block_layout(
                 q_lens, q_block
             )
-            q_pad = q[0][jnp.asarray(gather)].reshape(
-                len(rowmap), q_block, hq_n, d_n
-            )
+            q_pad = q.reshape(n_tokens, hq_n, d_n)[
+                jnp.asarray(gather)
+            ].reshape(len(rowmap), q_block, hq_n, d_n)
             args = (kp, vp, ks, vs) if quantized else (kp, vp)
             kernel = paged_attention_ragged_int8 if quantized \
                 else paged_attention_ragged
@@ -561,7 +575,7 @@ def make_ragged_kv_hook(
             )
             attn = out_pad.reshape(-1, hq_n, d_n)[
                 jnp.asarray(scatter)
-            ][None]
+            ].reshape(n_shards, t_local, hq_n, d_n)
             return attn, out_cache
 
         # XLA reference: bounded page gather + attention_ref per
@@ -569,7 +583,9 @@ def make_ragged_kv_hook(
         # chunk rows as an [n_chunks, chunk_width] batch, exactly the
         # shapes the SPLIT dispatches feed it (masked positions
         # contribute exact zeros, so the fused result is bit-identical
-        # per row)
+        # per row). Sharded layouts gather each segment's rows through
+        # the static shard-major row maps — same rows, same math, so
+        # the dp-sharded result stays bit-identical to dp=1 per row.
         tbl = block_tables
         if active_pages is not None and active_pages < max_pages:
             tbl = block_tables[:, :active_pages]
@@ -589,37 +605,42 @@ def make_ragged_kv_hook(
         kv_positions = jnp.broadcast_to(
             jnp.arange(kv_len)[None], (r_total, kv_len)
         )
+        q_flat = q.reshape(n_tokens, hq_n, d_n)
+        dec_rows = jnp.asarray(lay["dec_rows"])
+        ch_rows = jnp.asarray(lay["ch_rows"])
 
         parts = []
         if n_decode:
-            q_dec = q[0, :n_decode][:, None]       # [B, 1, Hq, D]
+            q_dec = q_flat[jnp.asarray(lay["dec_toks"])][:, None]
             attn_dec = attention_ref(
-                q_dec, k_all[:n_decode], v_all[:n_decode],
+                q_dec, k_all[dec_rows], v_all[dec_rows],
                 causal=True,
-                q_positions=prefix_lens[:n_decode, None],
-                kv_positions=kv_positions[:n_decode],
-                kv_mask=kv_positions[:n_decode]
-                < (prefix_lens[:n_decode] + 1)[:, None],
+                q_positions=prefix_lens[dec_rows][:, None],
+                kv_positions=kv_positions[dec_rows],
+                kv_mask=kv_positions[dec_rows]
+                < (prefix_lens[dec_rows] + 1)[:, None],
             )
             parts.append(attn_dec.reshape(n_decode, hq_n, d_n))
         if n_chunks:
-            q_ch = q[0, n_decode:].reshape(
+            q_ch = q_flat[jnp.asarray(lay["ch_toks"])].reshape(
                 n_chunks, chunk_width, hq_n, d_n
             )
-            ch_prefix = prefix_lens[n_decode:]
+            ch_prefix = prefix_lens[ch_rows]
             attn_ch = attention_ref(
-                q_ch, k_all[n_decode:], v_all[n_decode:],
+                q_ch, k_all[ch_rows], v_all[ch_rows],
                 causal=True,
                 q_positions=ch_prefix[:, None]
                 + jnp.arange(chunk_width)[None],
-                kv_positions=kv_positions[n_decode:],
-                kv_mask=kv_positions[n_decode:]
+                kv_positions=kv_positions[ch_rows],
+                kv_mask=kv_positions[ch_rows]
                 < (ch_prefix + chunk_width)[:, None],
             )
             parts.append(
                 attn_ch.reshape(n_chunks * chunk_width, hq_n, d_n)
             )
-        attn = jnp.concatenate(parts, axis=0)[None]
+        attn = jnp.concatenate(parts, axis=0)[
+            jnp.asarray(lay["inv_perm"])
+        ].reshape(n_shards, t_local, hq_n, d_n)
         return attn, out_cache
 
     return hook

@@ -427,6 +427,12 @@ class RequestScheduler:
     ) -> None:
         self.targets = targets or class_targets_from_env()
         self.chunk_budgets = chunk_budgets or class_chunks_from_env()
+        # per-shard chunk budgets (docs/serving.md): under the
+        # dp-sharded fused window each dp shard carries its own chunk
+        # sub-batch in the same dispatch, so the engine scales the
+        # per-step budget by the shard count it sets here (1 = the
+        # unsharded window; set once at engine init, before traffic)
+        self.chunk_shards = 1
         self._lock = locks.make_lock("scheduler")
         self._heap: list[tuple[float, int, int, Any]] = []
         self._seq = 0
@@ -532,7 +538,7 @@ class RequestScheduler:
         cls = normalize_class(turn_class)
         budget = max(1, self.chunk_budgets.get(
             cls, DEFAULT_CHUNKS[DEFAULT_CLASS]
-        ))
+        )) * max(1, int(self.chunk_shards))
         with self._lock:
             if self._step_chunks[cls] >= budget:
                 self._budget_hits += 1
@@ -603,7 +609,7 @@ class RequestScheduler:
                 tgt = self.targets[cls]
                 budget = max(1, self.chunk_budgets.get(
                     cls, DEFAULT_CHUNKS[DEFAULT_CLASS]
-                ))
+                )) * max(1, int(self.chunk_shards))
                 rows[cls] = {
                     "queued": depth[cls],
                     "rung": self.class_rung(cls, raw_level),
@@ -633,4 +639,5 @@ class RequestScheduler:
             "classes": rows,
             "steps": steps,
             "budget_hits": budget_hits,
+            "chunk_shards": max(1, int(self.chunk_shards)),
         }

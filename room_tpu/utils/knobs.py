@@ -616,6 +616,13 @@ register("ROOM_TPU_FUSED_WINDOW", "bool", "1",
          "Fuse the scheduler window's interleaved prefill chunks into "
          "the decode dispatch (one device round trip per window); 0 "
          "keeps the split per-chunk dispatches.")
+register("ROOM_TPU_FUSED_WINDOW_DP", "bool", "1",
+         "dp-sharded fused spec-window: keep the fused dispatch "
+         "window (and in-window speculation) on when the decode batch "
+         "shards over the dp mesh axis — the ragged token stream "
+         "becomes per-dp-shard sub-batches ([ndp, T_local], "
+         "shard-major chunk rows). 0 restores the legacy dp auto-off "
+         "(split per-chunk dispatches under dp).")
 register("ROOM_TPU_PREFILL_KERNEL", "str", "auto",
          "S>1 Pallas prefill kernel gate: on | off | auto (one-shot "
          "compile+numerics probe).",

@@ -774,11 +774,15 @@ def test_fused_window_disabled_reason_surfaces(model, monkeypatch):
         st2["fused_window_disabled_reason"]
 
 
-def test_fused_window_dp_auto_off_is_logged_and_reported(
+def test_fused_window_dp_mode_is_logged_and_reported(
     model, monkeypatch, caplog,
 ):
-    """The dp auto-off (ROADMAP open item) must be diagnosable: a
-    warning at engine build and a reason string in stats()."""
+    """Under a dp mesh the fused window now stays ON as the sharded
+    variant (docs/serving.md "dp-sharded fused window"): mode
+    `fused-dp`, an INFO mode-marker reason string, and a build log
+    line. ROOM_TPU_FUSED_WINDOW_DP=0 restores the legacy auto-off
+    with the old warning — either way a mixed-mesh fleet is
+    diagnosable from stats()."""
     import logging
 
     from room_tpu.parallel import (
@@ -788,14 +792,30 @@ def test_fused_window_dp_auto_off_is_logged_and_reported(
     cfg, params = model
     mesh = make_mesh(MeshSpec(dp=2, ep=2, tp=2))
     sharded = shard_pytree(params, decoder_param_specs(cfg), mesh)
-    with caplog.at_level(logging.WARNING,
+    with caplog.at_level(logging.INFO,
                          logger="room_tpu.serving.engine"):
         eng = ServingEngine(cfg, sharded, max_batch=4, page_size=8,
                             n_pages=64, mesh=mesh)
     assert eng._dp_size == 2
     st = eng.stats()
-    assert st["fused_window"] is False
-    assert "dp" in st["fused_window_disabled_reason"]
+    assert st["fused_window"] is True
+    assert st["fused_window_mode"] == "fused-dp"
+    assert st["fused_window_disabled_reason"] == \
+        "sharded variant active (dp=2)"
+    assert any("fused dispatch window" in r.message
+               for r in caplog.records)
+
+    monkeypatch.setenv("ROOM_TPU_FUSED_WINDOW_DP", "0")
+    caplog.clear()
+    with caplog.at_level(logging.WARNING,
+                         logger="room_tpu.serving.engine"):
+        eng2 = ServingEngine(cfg, sharded, max_batch=4, page_size=8,
+                             n_pages=64, mesh=mesh)
+    st2 = eng2.stats()
+    assert st2["fused_window"] is False
+    assert st2["fused_window_mode"] == "off"
+    assert "ROOM_TPU_FUSED_WINDOW_DP=0" in \
+        st2["fused_window_disabled_reason"]
     assert any("fused dispatch window" in r.message
                for r in caplog.records)
 

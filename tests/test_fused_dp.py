@@ -1,0 +1,363 @@
+"""dp-sharded fused spec-window (docs/serving.md).
+
+The fused dispatch window — interleaved prefill chunks + decode lanes +
+in-window speculation in ONE device call — used to auto-disable under
+dp sharding. The sharded variant keeps it on: the ragged token stream
+becomes per-dp-shard sub-batches ``[ndp, B_local + C_local*cw]``
+(decode lanes contiguous per shard, chunk rows dealt round-robin,
+shard-major), dispatched once with no cross-shard collectives on the
+token path. Pinned here: the greedy token-identity matrix dp {1,2,4}
+x steps_per_dispatch {1,4} x spec on/off x prefix-hit x
+offload-restore on the 8-device virtual mesh; a decode_window fault
+shot through the dp-sharded dispatch (staged rollback durable, no KV
+leak, accepted drafts survive); the legacy ROOM_TPU_FUSED_WINDOW_DP=0
+auto-off; the shard-layout map's n_shards=1 degeneracy; and the
+persistent draft-KV rewrite's equivalence to stateless re-forwarding.
+Quick tier: runs in the ci.yml chaos job.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from room_tpu.models import qwen3, tiny_moe
+from room_tpu.ops.paged_attention import ragged_shard_layout
+from room_tpu.parallel import (
+    MeshSpec, decoder_param_specs, make_mesh, shard_pytree,
+)
+from room_tpu.serving import SamplingParams, ServingEngine, faults
+
+LONG = [1 + (i % 53) for i in range(100)]   # 13 pages at page_size 8
+DPS = (2, 4)
+STEPS = (1, 4)
+SPEC = (0, 4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_moe()
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def meshes(model):
+    """One mesh + sharded param set per dp degree (module-scoped: the
+    shard placement is static, only engines vary per test)."""
+    cfg, params = model
+    out = {}
+    for dp in DPS:
+        mesh = make_mesh(MeshSpec(dp, 1, 1))
+        out[dp] = (mesh, shard_pytree(
+            params, decoder_param_specs(cfg), mesh
+        ))
+    return out
+
+
+@pytest.fixture()
+def build(model, meshes, monkeypatch):
+    cfg, params = model
+
+    def make(dp=1, steps=4, spec=0, chunk_pages=1, fused_dp=True, **kw):
+        monkeypatch.setenv(
+            "ROOM_TPU_PREFILL_CHUNK_PAGES", str(chunk_pages)
+        )
+        monkeypatch.setenv(
+            "ROOM_TPU_DECODE_STEPS_PER_DISPATCH", str(steps)
+        )
+        monkeypatch.setenv("ROOM_TPU_FUSED_WINDOW", "1")
+        monkeypatch.setenv(
+            "ROOM_TPU_FUSED_WINDOW_DP", "1" if fused_dp else "0"
+        )
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("page_size", 8)
+        kw.setdefault("n_pages", 128)
+        kw.setdefault("spec_tokens", spec)
+        if dp > 1:
+            mesh, sharded = meshes[dp]
+            return ServingEngine(cfg, sharded, mesh=mesh, **kw)
+        return ServingEngine(cfg, params, **kw)
+
+    return make
+
+
+def _greedy(n=6):
+    return SamplingParams(temperature=0.0, max_new_tokens=n)
+
+
+def _run_streams(eng):
+    """Canonical mixed traffic: a short decode turn, a long (chunked)
+    prompt, and a continuation on the chunked session."""
+    a = eng.submit([5, 6, 7], session_id="dec", sampling=_greedy(10))
+    b = eng.submit(LONG, session_id="long", sampling=_greedy())
+    eng.run_until_idle()
+    c = eng.submit([7, 8, 9], session_id="long", sampling=_greedy())
+    eng.run_until_idle()
+    return (a.new_tokens, b.new_tokens, c.new_tokens)
+
+
+# ---- shard layout: static maps ----
+
+def test_ragged_shard_layout_degenerates_at_one_shard():
+    """n_shards=1 must reproduce the flat decode-first layout exactly
+    (identity inverse permutation) — the structural reason the sharded
+    stream is bit-identical at dp=1."""
+    lay = ragged_shard_layout(4, 2, 8, 1)
+    n = 4 + 2 * 8
+    assert lay["inv_perm"].tolist() == list(range(n))
+    assert lay["dec_toks"].tolist() == [0, 1, 2, 3]
+    assert lay["dec_rows"].tolist() == [0, 1, 2, 3]
+    assert lay["ch_rows"].tolist() == [4, 5]
+    assert lay["row_of_token"].tolist() == \
+        [0, 1, 2, 3] + [4] * 8 + [5] * 8
+
+
+def test_ragged_shard_layout_round_trip():
+    """Sharded maps stay a permutation: every global token lands in
+    exactly one (row, offset) slot and inv_perm undoes the dealing."""
+    for ndp in (2, 4):
+        lay = ragged_shard_layout(4, 4, 8, ndp)
+        n = 4 + 4 * 8
+        # decode tokens then chunk tokens, shard-major concatenation,
+        # pulled back through inv_perm == original order
+        seg = np.concatenate([lay["dec_toks"], lay["ch_toks"]])
+        assert seg[lay["inv_perm"]].tolist() == list(range(n))
+        # decode lanes contiguous per shard: slot i -> shard i // bl
+        bl = 4 // ndp
+        assert lay["dec_rows"].tolist() == [
+            s * (bl + 4 // ndp) + i for s in range(ndp)
+            for i in range(bl)
+        ]
+    with pytest.raises(ValueError):
+        ragged_shard_layout(3, 2, 8, 2)
+    with pytest.raises(ValueError):
+        ragged_shard_layout(4, 3, 8, 2)
+
+
+# ---- the identity matrix ----
+
+@pytest.mark.parametrize("spec", SPEC)
+@pytest.mark.parametrize("steps", STEPS)
+def test_identity_matrix_dp_sharded_vs_fused(build, steps, spec):
+    """The acceptance matrix: dp-sharded fused window (dp {2,4}) is
+    greedy-token-identical to the dp=1 fused engine, with the window
+    actually engaged (mode fused-dp, sharded windows counted, chunks
+    riding the window instead of per-chunk dispatches)."""
+    base = _run_streams(build(dp=1, steps=steps, spec=spec))
+    for dp in DPS:
+        eng = build(dp=dp, steps=steps, spec=spec)
+        assert eng.fused_window_mode == "fused-dp"
+        assert eng.fused_window_disabled_reason == \
+            f"sharded variant active (dp={dp})"
+        got = _run_streams(eng)
+        assert got == base, f"dp={dp} steps={steps} spec={spec}"
+        st = eng.stats()
+        assert st["fused_window_mode"] == "fused-dp"
+        assert st["fused_dp_windows"] > 0
+        assert st["prefill_chunks_interleaved"] > 0
+        # chunks rode the sharded window, never per-chunk device calls
+        assert st["chunk_dispatches"] < \
+            st["prefill_chunks_interleaved"]
+        # per-shard chunk-row placement is surfaced and accounts for
+        # every interleaved chunk
+        dpb = st["fused_dp"]
+        assert dpb["dp"] == dp and len(dpb["chunks_per_shard"]) == dp
+        assert sum(dpb["chunks_per_shard"]) == \
+            st["prefill_chunks_interleaved"]
+
+
+def test_identity_dp_prefix_hit(build):
+    """Prefix-hit axis: a second session hitting the first's cached
+    prefix streams identically through the dp-sharded window."""
+    prefix = list(range(1, 41))             # 5 aligned pages
+    base = None
+    for dp in (1, 2):
+        eng = build(dp=dp)
+        t1 = eng.submit(prefix + [61, 62, 63], sampling=_greedy())
+        eng.run_until_idle()
+        t2 = eng.submit(prefix + [71, 72], sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.stats()["prefix_hits"] >= 1
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"dp={dp}"
+
+
+def test_identity_dp_offload_restore(build):
+    """Offload-restore axis: hibernate a session, resume it with a
+    long chunked continuation through the dp-sharded dispatch."""
+    base = None
+    for dp in (1, 2):
+        eng = build(dp=dp, offload=True)
+        t1 = eng.submit(list(range(1, 20)), session_id="h",
+                        sampling=_greedy())
+        eng.run_until_idle()
+        assert eng.offload_session("h")
+        t2 = eng.submit(LONG, session_id="h", sampling=_greedy())
+        eng.run_until_idle()
+        got = (t1.new_tokens, t2.new_tokens)
+        if base is None:
+            base = got
+        assert got == base, f"dp={dp}"
+        assert eng.stats()["offload_restores"] >= 1
+
+
+def test_dp_knob_off_restores_legacy_auto_off(build):
+    """ROOM_TPU_FUSED_WINDOW_DP=0 restores the legacy behavior — the
+    fused window auto-disables under dp with the old split per-chunk
+    dispatches — and the disabled_reason says WHY (the knob), so a
+    mixed-mesh fleet can see which replica opted out."""
+    base = _run_streams(build(dp=1))
+    eng = build(dp=2, fused_dp=False)
+    assert eng.fused_window is False
+    assert eng.fused_window_mode == "off"
+    assert "ROOM_TPU_FUSED_WINDOW_DP=0" in \
+        eng.fused_window_disabled_reason
+    got = _run_streams(eng)
+    assert got == base
+    st = eng.stats()
+    assert st["fused_windows"] == 0 and st["fused_dp_windows"] == 0
+    # legacy path: one device call per interleaved chunk
+    assert st["chunk_dispatches"] == st["prefill_chunks_interleaved"]
+
+
+def test_dp_scheduler_budget_scales_with_shards(build):
+    """The per-step chunk budget multiplies by the shard count (each
+    dp shard carries its own chunk rows), and the scaling is visible
+    in the scheduler snapshot."""
+    eng = build(dp=2)
+    assert eng.scheduler.chunk_shards == 2
+    assert eng.stats()["scheduler"]["chunk_shards"] == 2
+    eng1 = build(dp=1)
+    assert eng1.scheduler.chunk_shards == 1
+
+
+# ---- chaos: decode_window fault through the dp-sharded dispatch ----
+
+def test_decode_window_fault_dp_sharded(build, monkeypatch):
+    """A non-transient decode_window fault on a dp-sharded fused
+    window fails only the window's decode turns; the chunked turn
+    rolls back to its last durable chunk boundary, re-prepares, and
+    completes with the clean stream. No KV leaks."""
+    monkeypatch.setenv("ROOM_TPU_PREFIX_CACHE_PAGES", "0")
+    eng0 = build(dp=2)
+    d0 = eng0.submit([5, 6, 7], sampling=_greedy(10))
+    b0 = eng0.submit(LONG, sampling=_greedy())
+    eng0.run_until_idle()
+
+    eng = build(dp=2)
+    dec = eng.submit([5, 6, 7], session_id="dec",
+                     sampling=_greedy(10))
+    for _ in range(2):
+        eng.step()
+    chunked = eng.submit(LONG, session_id="long", sampling=_greedy())
+    faults.inject("decode_window", times=1, transient=False)
+    eng.run_until_idle()
+    faults.clear()
+
+    st = eng.stats()
+    assert st["window_faults"] >= 1
+    assert st["healthy"] is True and st["engine_crashes"] == 0
+    assert dec.finish_reason == "error"
+    assert chunked.finish_reason is not None
+    assert chunked.new_tokens == b0.new_tokens
+    assert d0.new_tokens
+
+    # canary after the fault: clean stream, balanced pool
+    canary = eng.submit([5, 6, 7], sampling=_greedy(10))
+    eng.run_until_idle()
+    assert canary.new_tokens == d0.new_tokens
+    for sid in list(eng.sessions):
+        eng.release_session(sid)
+    eng.step()
+    assert eng.page_table.free_pages == eng.n_pages - 1, (
+        "KV page leak after dp-sharded fused-window fault"
+    )
+
+
+def test_decode_window_fault_dp_spec_accepted_drafts_survive(build):
+    """Spec-on variant: after the faulted window rolls back, the
+    retried stream still rides speculation (accepted drafts survive
+    the fault) and stays token-identical to the clean spec engine."""
+    cfg = tiny_moe(vocab_size=8)            # forces repetition
+    params = qwen3.init_params(cfg, jax.random.PRNGKey(3))
+    mesh = make_mesh(MeshSpec(2, 1, 1))
+    sharded = shard_pytree(params, decoder_param_specs(cfg), mesh)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=24)
+    prompt = [1, 2, 3, 1, 2, 3]
+
+    def make():
+        return ServingEngine(cfg, sharded, mesh=mesh, max_batch=4,
+                             page_size=8, n_pages=128, spec_tokens=4)
+
+    eng0 = make()
+    want = eng0.submit(prompt, sampling=sp)
+    eng0.run_until_idle()
+    assert eng0.stats()["spec_accepted"] > 0
+
+    eng = make()
+    faults.inject("decode_window", times=1, transient=True)
+    turn = eng.submit(prompt, sampling=sp)
+    eng.run_until_idle()
+    faults.clear()
+    st = eng.stats()
+    assert st["fault_retries"] >= 1
+    assert turn.new_tokens == want.new_tokens
+    assert st["spec_accepted"] > 0, "drafting never re-engaged"
+    assert st["fused_window_mode"] == "fused-dp"
+
+
+# ---- draft tier: persistent KV rewrite ----
+
+def test_draft_propose_incremental_matches_stateless():
+    """The persistent-draft-KV rewrite (one window prefill + gamma-1
+    single-token advances) proposes the same greedy tokens as the
+    stateless reference that re-forwards the whole growing sequence
+    every step — the cache is a cost optimization, not a behavior
+    change."""
+    from room_tpu.models.config import tiny_draft
+    from room_tpu.ops.spec import TAIL_PAD, draft_propose
+    from room_tpu.serving.sampler import greedy_argmax
+
+    import jax.numpy as jnp
+
+    dcfg = tiny_draft(vocab_size=64)
+    dparams = qwen3.init_params(dcfg, jax.random.PRNGKey(11))
+    gamma, window = 4, 8
+    tail = np.full((3, 12), TAIL_PAD, np.int32)
+    tail[0, -8:] = [5, 6, 7, 5, 6, 7, 5, 6]
+    tail[1, -4:] = [1, 2, 3, 4]
+    tail[2, -12:] = np.arange(12) % 64
+
+    got = np.asarray(draft_propose(
+        dparams, dcfg, jnp.asarray(tail), gamma, window
+    ))
+
+    # stateless reference: full re-forward of window + drafts-so-far
+    seq = np.maximum(tail[:, -window:], 0)
+    want = []
+    for _ in range(gamma):
+        logits, _ = qwen3.forward(
+            dparams, dcfg, jnp.asarray(seq)
+        )
+        nxt = np.asarray(greedy_argmax(
+            logits[:, -1].astype(jnp.float32)
+        ), np.int32)
+        want.append(nxt)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_fused_window_dp_knob_registered():
+    from room_tpu.utils.knobs import REGISTRY
+
+    assert "ROOM_TPU_FUSED_WINDOW_DP" in REGISTRY
+    assert REGISTRY["ROOM_TPU_FUSED_WINDOW_DP"].default == "1"

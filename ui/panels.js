@@ -1523,6 +1523,29 @@ async function renderTpu(el) {
         </tr>`)).join("") ||
         '<tr><td class="dim" colspan="9">speculation disabled / no engines warm</td></tr>'}
       </table>
+      <h2 style="margin-top:.6rem">fused window</h2>
+      <table><tr><th>engine</th><th>mode</th><th>windows</th>
+        <th>fused chunks</th><th>dp windows</th>
+        <th>chunks / shard</th></tr>
+      ${Object.entries(hl.engines || {})
+        .filter(([name, e]) => e.fused_window_mode)
+        .map(([name, e]) => `
+        <tr><td>${esc(name)}${
+          e.fused_window_disabled_reason
+            ? `<span class="dim">${esc(e.fused_window_disabled_reason)}</span>`
+            : ""}</td>
+        <td><span class="pill ${
+          e.fused_window_mode === "off" ? "pending" : "verified"}">${
+          esc(e.fused_window_mode)}</span></td>
+        <td>${e.fused_windows ?? 0}</td>
+        <td>${e.fused_chunks ?? 0}</td>
+        <td>${e.fused_dp ? e.fused_dp.windows ?? 0 : "—"}</td>
+        <td>${e.fused_dp
+          ? esc((e.fused_dp.chunks_per_shard || []).join(" / "))
+          : "—"}</td>
+        </tr>`).join("") ||
+        '<tr><td class="dim" colspan="6">no engines warm</td></tr>'}
+      </table>
       <h2 style="margin-top:.6rem">slo attribution</h2>
       <table><tr><th>class</th><th>turns</th><th>ttft mean</th>
         <th>slo misses</th><th>queue</th><th>prefill</th>
