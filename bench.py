@@ -2680,6 +2680,67 @@ def main() -> None:
         gc.collect()
         return out
 
+    # invariant-witness A/B (docs/chaosfuzz.md): the witness probes
+    # every engine.step() when armed, so production arming is only
+    # viable if the probe cost is negligible — same interleaved-pass
+    # shape as the turnscope A/B above, toggling ROOM_TPU_INVARIANTS
+    # (strict off: measuring the probe, not the raise path)
+    def measure_invariant_overhead() -> dict:
+        from room_tpu.chaos import invariants as invariants_mod
+
+        eng = ServingEngine(
+            cfg, params, max_batch=4, page_size=16, n_pages=512,
+        )
+        sp = SamplingParams(
+            temperature=0.0, max_new_tokens=16 if TINY else 32,
+        )
+        prompt = list(range(1, 33))
+        lats: dict[bool, list] = {True: [], False: []}
+        saved = {
+            k: os.environ.get(k)
+            for k in ("ROOM_TPU_INVARIANTS",
+                      "ROOM_TPU_INVARIANTS_STRICT")
+        }
+        os.environ["ROOM_TPU_INVARIANTS_STRICT"] = "0"
+
+        def _arm(on: bool) -> None:
+            os.environ["ROOM_TPU_INVARIANTS"] = "1" if on else "0"
+
+        try:
+            for arm in (False, True):   # warm pass for both arms
+                _arm(arm)
+                t = eng.submit(prompt, sampling=sp)
+                eng.run_until_idle()
+                eng.release_session(t.session_id)
+            reps = 8 if TINY else 12
+            for _ in range(reps):
+                for arm in (False, True):   # interleaved A/B
+                    _arm(arm)
+                    t0 = time.perf_counter()
+                    t = eng.submit(prompt, sampling=sp)
+                    eng.run_until_idle()
+                    lats[arm].append(time.perf_counter() - t0)
+                    eng.release_session(t.session_id)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            invariants_mod.reset()
+        p50 = {a: sorted(v)[len(v) // 2] for a, v in lats.items()}
+        out = {
+            "turns_per_arm": len(lats[True]),
+            "p50_turn_off_s": round(p50[False], 5),
+            "p50_turn_on_s": round(p50[True], 5),
+            # the CI budget: witness-on p50 <= 5% over witness-off
+            "overhead_ratio": round(p50[True] / max(p50[False], 1e-9),
+                                    4),
+        }
+        del eng
+        gc.collect()
+        return out
+
     def measure_slo_attribution() -> dict:
         from room_tpu.serving import trace as trace_mod
 
@@ -2773,6 +2834,15 @@ def main() -> None:
                     overhead["overhead_ratio"]
         except Exception as e:
             _phase("trace_overhead", {"error": str(e)[:300]})
+        _extend_deadline()
+        try:
+            inv_overhead = measure_invariant_overhead()
+            _phase("invariant_overhead", inv_overhead)
+            if CPU_PROXY:
+                _proxy_deltas["invariant_overhead_ratio"] = \
+                    inv_overhead["overhead_ratio"]
+        except Exception as e:
+            _phase("invariant_overhead", {"error": str(e)[:300]})
         _extend_deadline()
         try:
             _phase("slo_attribution", measure_slo_attribution())

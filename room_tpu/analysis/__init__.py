@@ -1,6 +1,6 @@
 """roomlint — the in-tree static-analysis suite (docs/static_analysis.md).
 
-Four AST-based checkers keep the invariants that used to live in
+Seven AST-based checkers keep the invariants that used to live in
 review comments machine-enforced on every PR:
 
 1. **knob discipline** (`knob_checker`) — every ``ROOM_TPU_*`` env
@@ -19,7 +19,13 @@ review comments machine-enforced on every PR:
    maps to a flight-recorder trace event + telemetry counter in
    ``serving/trace.py``'s FAULT_EVENTS, and ``faults.should_fire``
    stays wired through both (docs/observability.md).
-6. **lockmap — whole-program concurrency** (`lockmap`) — every lock
+6. **fault fuzz coverage**
+   (`chaosfuzz_checker.check_fuzz_coverage`) — every fault point is
+   either weighted into the schedule fuzzer's ``FUZZ_WEIGHTS``
+   (``room_tpu/chaos/fuzz.py``) or listed in ``FUZZ_EXCLUDED`` with a
+   reason naming its alternative coverage, never both
+   (docs/chaosfuzz.md).
+7. **lockmap — whole-program concurrency** (`lockmap`) — every lock
    acquisition resolves to the central named-lock registry
    (``room_tpu/utils/locks.py``), the acquisition graph (lexical
    nesting + one call level deep) stays cycle-free, guarded fields
@@ -43,8 +49,8 @@ import os
 from typing import Iterable, Optional
 
 from . import (
-    dispatch_checker, fault_checker, knob_checker, knobs_doc,
-    lock_checker, lockmap, trace_checker,
+    chaosfuzz_checker, dispatch_checker, fault_checker, knob_checker,
+    knobs_doc, lock_checker, lockmap, trace_checker,
 )
 from .common import (
     SourceCache, SourceFile, Violation, apply_suppressions,
@@ -100,6 +106,9 @@ def run_checks(
             repo_root, cache=cache
         )
         violations += trace_checker.check_fault_trace_coverage(
+            repo_root, cache
+        )
+        violations += chaosfuzz_checker.check_fuzz_coverage(
             repo_root, cache
         )
         # whole-program concurrency pass: always over the full tree

@@ -1,0 +1,94 @@
+"""roomlint checker 7 — fault-point fuzz coverage.
+
+Every ``faults.FAULT_POINTS`` entry must be reachable by the schedule
+fuzzer (docs/chaosfuzz.md): either weighted in
+``chaos/fuzz.py``'s ``FUZZ_WEIGHTS`` or explicitly excluded in
+``FUZZ_EXCLUDED`` with a reason naming where the point IS covered.
+Same lockstep pattern as the trace-coverage checker — a new fault
+point cannot ship invisible to the fuzzer:
+
+- ``fault-point-unfuzzed`` — a FAULT_POINTS entry in neither
+  FUZZ_WEIGHTS nor FUZZ_EXCLUDED (the fuzzer would never compose it
+  into a schedule, so its interactions go untested);
+- ``fuzz-weight-unknown`` — a FUZZ_WEIGHTS / FUZZ_EXCLUDED key naming
+  a point the registry does not define (a typo'd weight silently
+  fuzzes nothing);
+- ``fuzz-exclusion-overlap`` — a point both weighted and excluded
+  (the exclusion reason is dead text and the point still fuzzes).
+
+Both files are parsed with ``ast`` — no import of the chaos package.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from .common import SourceCache, Violation
+from .fault_checker import load_fault_points
+
+FUZZ_MODULE = os.path.join("room_tpu", "chaos", "fuzz.py")
+
+
+def _load_literal(repo_root: str, name: str,
+                  cache: Optional[SourceCache] = None) -> dict:
+    """Parse a module-level dict literal out of fuzz.py without
+    importing it."""
+    if cache is None:
+        cache = SourceCache(repo_root)
+    tree = cache.tree(FUZZ_MODULE)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return dict(ast.literal_eval(node.value))
+    raise RuntimeError(f"{name} not found in {FUZZ_MODULE}")
+
+
+def check_fuzz_coverage(
+    repo_root: str, cache: Optional[SourceCache] = None
+) -> list[Violation]:
+    if cache is None:
+        cache = SourceCache(repo_root)
+    points = load_fault_points(repo_root, cache)
+    out: list[Violation] = []
+    try:
+        weights = _load_literal(repo_root, "FUZZ_WEIGHTS", cache)
+        excluded = _load_literal(repo_root, "FUZZ_EXCLUDED", cache)
+    except (OSError, RuntimeError, SyntaxError, ValueError) as e:
+        return [Violation(
+            "fault-point-unfuzzed", FUZZ_MODULE, 1,
+            f"cannot load fuzz weight tables: {e}",
+        )]
+    for name in points:
+        if name not in weights and name not in excluded:
+            out.append(Violation(
+                "fault-point-unfuzzed", FUZZ_MODULE, 1,
+                f"fault point {name!r} in neither FUZZ_WEIGHTS nor "
+                "FUZZ_EXCLUDED — every fault point must be "
+                "schedule-fuzzable or excluded with a reason naming "
+                "its alternative coverage (docs/chaosfuzz.md)",
+            ))
+    for name in list(weights) + list(excluded):
+        if name not in points:
+            out.append(Violation(
+                "fuzz-weight-unknown", FUZZ_MODULE, 1,
+                f"fuzz table names unknown fault point {name!r} "
+                f"(known: {', '.join(points)})",
+            ))
+    for name in weights:
+        if name in excluded:
+            out.append(Violation(
+                "fuzz-exclusion-overlap", FUZZ_MODULE, 1,
+                f"fault point {name!r} is both weighted and excluded "
+                "— drop one: an excluded point must not fuzz",
+            ))
+    for name, reason in excluded.items():
+        if not str(reason).strip():
+            out.append(Violation(
+                "fault-point-unfuzzed", FUZZ_MODULE, 1,
+                f"FUZZ_EXCLUDED[{name!r}] has an empty reason — say "
+                "where the point IS covered",
+            ))
+    return out

@@ -1,0 +1,27 @@
+"""Composed-fault chaos harness (docs/chaosfuzz.md).
+
+Two halves:
+
+- :mod:`room_tpu.chaos.invariants` — the runtime **system-invariant
+  witness**, lockdep's sibling (``ROOM_TPU_INVARIANTS``): cheap
+  host-side checks of whole-system conservation laws (KV-page
+  conservation, fence monotonicity, exactly-once xshard effects,
+  single session ownership, ...) probed from existing seams — the
+  engine step boundary, the fleet supervise tick, the swarm shard
+  sweep, the clean-shutdown marker write.
+- :mod:`room_tpu.chaos.fuzz` — the **schedule fuzzer**: a seeded PRNG
+  composes weighted arm-windows over the fault-point registry
+  (``serving/faults.py``) into versioned, replayable schedules, drives
+  them against deterministic serving / swarm workloads with the
+  witness armed, and delta-debugs a failing schedule down to a locally
+  1-minimal reproducer. CLI: ``python -m room_tpu.chaos``.
+
+This ``__init__`` stays import-light on purpose: ``invariants`` is
+imported by the serving hot path (engine/fleet), so nothing here may
+drag jax or the workload harnesses in. Import ``room_tpu.chaos.fuzz``
+explicitly where the fuzzer is wanted.
+"""
+
+from . import invariants  # noqa: F401  (the witness is the light half)
+
+__all__ = ["invariants"]

@@ -1,0 +1,132 @@
+"""``python -m room_tpu.chaos`` — run seeded composed-fault schedules
+against the fuzz workloads with the invariant witness strict-armed.
+
+Quick CI tier::
+
+    python -m room_tpu.chaos --seeds 11,23 --workload both \\
+        --out chaosfuzz-artifacts
+
+Replay a saved schedule (bug report / CI artifact)::
+
+    python -m room_tpu.chaos --replay failing-schedule.json
+
+Every run writes its schedule JSON first, so any failure is already
+replayable before the workload starts. On failure the schedule is
+shrunk to a locally 1-minimal reproducer and both the original and
+shrunk schedules land in ``--out`` as CI artifacts; exit status 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _parse_seeds(text: str) -> list[int]:
+    return [int(s) for s in text.split(",") if s.strip()]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m room_tpu.chaos",
+        description="seeded composed-fault schedule fuzzer "
+        "(docs/chaosfuzz.md)",
+    )
+    ap.add_argument("--seeds", type=_parse_seeds, default=None,
+                    help="comma-separated schedule seeds")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="single schedule seed")
+    ap.add_argument("--workload", default="both",
+                    choices=("serving", "swarm", "both"))
+    ap.add_argument("--ticks", type=int, default=None,
+                    help="schedule length (default: "
+                    "ROOM_TPU_CHAOSFUZZ_TICKS)")
+    ap.add_argument("--out", default="chaosfuzz-artifacts",
+                    help="artifact dir for schedules + outcomes")
+    ap.add_argument("--replay", default=None, metavar="FILE",
+                    help="replay a saved schedule.json instead of "
+                    "generating")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="skip shrinking failing schedules")
+    args = ap.parse_args(argv)
+
+    # arm the witness before any room_tpu module loads its knobs;
+    # strict default ("1") makes violations abort the run. setdefault,
+    # not a knobs.get_bool round trip: an explicit =0 from the caller
+    # must win, and the knob layer has no "was it set?" probe.
+    os.environ.setdefault("ROOM_TPU_INVARIANTS", "1")  # roomlint: allow[knob-raw-env-read]
+
+    from . import fuzz, invariants
+
+    if not invariants.enabled():
+        print("warning: ROOM_TPU_INVARIANTS=0 — witness disarmed, "
+              "runs can only fail on lost/double deliveries",
+              file=sys.stderr)
+
+    failures = 0
+    if args.replay:
+        sched = fuzz.load_schedule(args.replay)
+        out = fuzz.run_schedule(sched)
+        print(json.dumps(
+            {"schedule": args.replay, "outcome": out}, sort_keys=True,
+            indent=2,
+        ))
+        return 1 if fuzz.outcome_failed(out) else 0
+
+    seeds = list(args.seeds or [])
+    if args.seed is not None:
+        seeds.append(args.seed)
+    if not seeds:
+        seeds = [11, 23]
+    workloads = ["serving", "swarm"] if args.workload == "both" \
+        else [args.workload]
+
+    os.makedirs(args.out, exist_ok=True)
+    for workload in workloads:
+        for seed in seeds:
+            sched = fuzz.generate_schedule(
+                seed, workload=workload, ticks=args.ticks,
+            )
+            tag = f"{workload}-{seed}"
+            # persisted BEFORE the run: a wedged/killed run is still
+            # replayable from the artifact
+            fuzz.save_schedule(
+                sched, os.path.join(args.out, f"schedule-{tag}.json"),
+            )
+            out = fuzz.run_schedule(sched)
+            ok = not fuzz.outcome_failed(out)
+            points = sorted({e["point"] for e in sched["events"]})
+            print(f"[{tag}] id={out['schedule_id']} "
+                  f"{'ok' if ok else 'FAIL'} "
+                  f"violations={out['violations']} "
+                  f"points={','.join(points)}")
+            if ok:
+                continue
+            failures += 1
+            fuzz.save_schedule(
+                sched,
+                os.path.join(args.out, f"failing-schedule-{tag}.json"),
+            )
+            with open(os.path.join(args.out, f"outcome-{tag}.json"),
+                      "w", encoding="utf-8") as f:
+                json.dump(out, f, sort_keys=True, indent=2)
+            if not args.no_shrink:
+                small = fuzz.shrink_schedule(sched)
+                fuzz.save_schedule(
+                    small,
+                    os.path.join(args.out, f"shrunk-{tag}.json"),
+                )
+                print(f"[{tag}] shrunk "
+                      f"{len(sched['events'])} -> "
+                      f"{len(small['events'])} events "
+                      f"(shrunk-{tag}.json)")
+    if failures:
+        print(f"{failures} failing schedule(s); artifacts in "
+              f"{args.out}/", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,632 @@
+"""Seeded composed-fault schedule fuzzer (docs/chaosfuzz.md).
+
+A schedule is a versioned, replayable JSON document: a PRNG seed plus
+the fully-resolved event list it generated — arm windows over the
+fault-point registry (``serving/faults.py``), burst budgets,
+probabilities, per-point RNG seeds, and at least one mid-schedule
+kill whose adoption/failover machinery the run must survive. Two
+deterministic single-threaded workloads drive the system under the
+schedule with the invariant witness (``chaos/invariants.py``) armed:
+
+- ``serving`` — an :class:`EngineFleet` (CPU-proxy tiny model, 3
+  replicas, 2 router shards) under greedy session traffic, driven by
+  ``run_until_idle`` between tick boundaries, finishing with a drain
+  + clean-marker attempt;
+- ``swarm`` — a :class:`SwarmRouter` storm (the ``swarm_storm`` bench
+  shape): journaled cross-room messages + escalations with
+  crash/adoption sweeps, ending in an exactly-once audit of every
+  acknowledged delivery.
+
+Same seed ⇒ byte-identical schedule JSON and identical outcome (the
+workloads use only the schedule's RNG, seeded fault specs, and
+zero-lease failover, so wall clock never reaches the outcome). On a
+violation, :func:`shrink_schedule` greedy-delta-debugs the event list
+to a locally 1-minimal failing schedule — the CI artifact.
+
+``ROOM_TPU_CHAOSFUZZ_PLANT`` (test seam) deliberately breaks the
+system mid-run — ``kv_leak`` steals a KV page once ``offload_io`` has
+fired, ``double_effect`` double-commits an xshard journal row once
+``db_io`` has — so the self-test can pin that the fuzzer finds real
+bugs and shrinks them.
+
+CLI: ``python -m room_tpu.chaos`` (see ``__main__.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import random
+import tempfile
+from typing import Callable, Optional
+
+from ..utils import knobs
+from . import invariants
+
+__all__ = [
+    "SCHEDULE_VERSION", "FUZZ_WEIGHTS", "FUZZ_EXCLUDED",
+    "SERVING_POINTS", "SWARM_POINTS", "KILL_POINTS",
+    "generate_schedule", "schedule_json", "schedule_id",
+    "save_schedule", "load_schedule", "run_schedule",
+    "shrink_schedule", "active_schedule_info",
+]
+
+SCHEDULE_VERSION = 1
+
+# Relative arm weights over the fault-point registry. roomlint checker
+# 7 (analysis/chaosfuzz_checker.py) pins FUZZ_WEIGHTS + FUZZ_EXCLUDED
+# == faults.FAULT_POINTS exactly, so a new fault point cannot ship
+# invisible to the fuzzer.
+FUZZ_WEIGHTS = {
+    "kv_alloc": 3,
+    "prefill_oom": 2,
+    "prefill_chunk": 2,
+    "decode_step": 2,
+    "decode_window": 2,
+    "decode_stall": 1,
+    "tokenizer": 1,
+    "engine_crash": 2,
+    "provider_timeout": 1,
+    "offload_io": 3,
+    "shutdown_io": 2,
+    "replica_crash": 3,
+    "router_io": 2,
+    "kv_wire": 1,
+    "prefix_io": 1,
+    "wire_partition": 1,
+    "heartbeat_loss": 1,
+    "mirror_journal_io": 2,
+    "placement_io": 2,
+    "router_shard_crash": 3,
+    "db_io": 3,
+    "cycle_crash": 1,
+    "loop_hang": 1,
+    "tool_exec": 1,
+    "shard_crash": 3,
+}
+
+# Points the in-process fuzz harness structurally cannot reach, with
+# the reason (the checker requires one). Everything here must be
+# covered elsewhere — noted per entry.
+FUZZ_EXCLUDED = {
+    "client_disconnect": (
+        "fires on the HTTP SSE stream seam; the fuzz workloads drive "
+        "engines directly with no socket to abort (covered by "
+        "tests/test_chaos_serving.py)"
+    ),
+    "shard_proc_kill": (
+        "fires at the multi-process supervisor seam; the in-process "
+        "fuzz harness has no shard child processes (covered by the "
+        "swarm_storm_proc bench tier + tests/test_swarm_proc.py)"
+    ),
+    "shard_wire_io": (
+        "parent->child control-wire frames exist only in process "
+        "mode (covered by the swarm_storm_proc bench tier + "
+        "tests/test_swarm_proc.py)"
+    ),
+}
+
+# Workload partition: which armable points each harness exposes.
+SERVING_POINTS = (
+    "kv_alloc", "prefill_oom", "prefill_chunk", "decode_step",
+    "decode_window", "decode_stall", "tokenizer", "engine_crash",
+    "provider_timeout", "offload_io", "shutdown_io", "replica_crash",
+    "router_io", "kv_wire", "prefix_io", "wire_partition",
+    "heartbeat_loss", "mirror_journal_io", "placement_io",
+    "router_shard_crash",
+)
+SWARM_POINTS = (
+    "db_io", "cycle_crash", "loop_hang", "tool_exec", "shard_crash",
+)
+# one-shot hard kills whose failover/adoption the schedule must ride
+KILL_POINTS = ("replica_crash", "router_shard_crash", "shard_crash")
+
+_PROMPT = list(range(1, 20))
+_CONT = [7, 7, 7]
+
+# set for the duration of run_schedule so telemetry crash reports can
+# attach the reproducer (core/telemetry.py resolves via sys.modules)
+_active_schedule: Optional[dict] = None
+
+
+def active_schedule_info() -> Optional[dict]:
+    """{id, seed, workload} of the schedule currently running (crash
+    report attachment), or None."""
+    return _active_schedule
+
+
+# ---- schedule generation ----
+
+def _weighted_sample(rng: random.Random, pool: list, k: int) -> list:
+    """k distinct points, weight-biased, deterministic."""
+    pool = list(pool)
+    out = []
+    while pool and len(out) < k:
+        weights = [FUZZ_WEIGHTS.get(p, 1) for p in pool]
+        total = sum(weights)
+        roll = rng.random() * total
+        acc = 0.0
+        for i, w in enumerate(weights):
+            acc += w
+            if roll < acc:
+                out.append(pool.pop(i))
+                break
+    return out
+
+
+def generate_schedule(
+    seed: int,
+    workload: str = "serving",
+    ticks: Optional[int] = None,
+) -> dict:
+    """Resolve one seeded schedule: distinct-point arm windows with
+    burst budgets and per-spec RNG seeds, exactly one mid-schedule
+    kill, and a guaranteed >= 2-point overlap."""
+    if workload not in ("serving", "swarm"):
+        raise ValueError(f"unknown workload {workload!r}")
+    if ticks is None:
+        ticks = max(6, knobs.get_int("ROOM_TPU_CHAOSFUZZ_TICKS"))
+    rng = random.Random(seed)
+    points = SERVING_POINTS if workload == "serving" else SWARM_POINTS
+    kills = [p for p in points if p in KILL_POINTS]
+    pool = [p for p in points if p not in KILL_POINTS]
+    n_events = rng.randint(3, min(8, len(pool)))
+    events = []
+    # the guaranteed kill+adoption, mid-schedule
+    kill_at = rng.randint(max(1, ticks // 4), max(2, (3 * ticks) // 4))
+    events.append({
+        "point": rng.choice(kills), "at": kill_at, "dur": 1,
+        "p": 1.0, "times": 1, "latency": 0.0,
+        "seed": rng.getrandbits(32),
+    })
+    for point in _weighted_sample(rng, pool, n_events):
+        at = rng.randint(1, max(1, ticks - 3))
+        dur = rng.randint(2, max(3, ticks // 3))
+        events.append({
+            "point": point, "at": at, "dur": dur,
+            "p": rng.choice([0.25, 0.5, 1.0]),
+            "times": rng.choice([None, 2, 4]),
+            "latency": 0.02 if point in ("decode_stall", "loop_hang")
+            else 0.0,
+            "seed": rng.getrandbits(32),
+        })
+    # guarantee a >= 2-point overlap somewhere in the schedule
+    if len(events) >= 2 and not _has_overlap(events):
+        events[1]["at"] = events[0]["at"]
+    events.sort(key=lambda e: (e["at"], e["point"]))
+    return {
+        "version": SCHEDULE_VERSION,
+        "workload": workload,
+        "seed": seed,
+        "ticks": ticks,
+        "events": events,
+    }
+
+
+def _has_overlap(events: list) -> bool:
+    spans = [(e["at"], e["at"] + e["dur"]) for e in events]
+    for i, (a0, a1) in enumerate(spans):
+        for b0, b1 in spans[i + 1:]:
+            if a0 < b1 and b0 < a1:
+                return True
+    return False
+
+
+def schedule_json(sched: dict) -> str:
+    """Canonical serialization — byte-identical for equal schedules
+    (sorted keys, fixed separators, trailing newline)."""
+    return json.dumps(sched, sort_keys=True,
+                      separators=(",", ":")) + "\n"
+
+
+def schedule_id(sched: dict) -> str:
+    return hashlib.sha1(
+        schedule_json(sched).encode("utf-8")
+    ).hexdigest()[:16]
+
+
+def save_schedule(sched: dict, path: str) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(schedule_json(sched))
+    return path
+
+
+def load_schedule(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        sched = json.load(f)
+    version = sched.get("version")
+    if version != SCHEDULE_VERSION:
+        raise ValueError(
+            f"schedule version {version!r} != {SCHEDULE_VERSION} "
+            f"(regenerate with this tree's fuzzer)"
+        )
+    return sched
+
+
+# ---- the arm/disarm tick driver ----
+
+class _Arming:
+    """Applies a schedule's arm windows at tick boundaries and folds
+    each point's firing count into the outcome as it disarms."""
+
+    def __init__(self, events: list) -> None:
+        self.events = events
+        self.fired: dict[str, int] = {}
+        self._armed: set[str] = set()
+
+    def apply(self, tick: int) -> None:
+        from ..serving import faults
+
+        for ev in self.events:
+            if ev["at"] + ev["dur"] == tick and \
+                    ev["point"] in self._armed:
+                self._disarm(ev["point"])
+        for ev in self.events:
+            if ev["at"] == tick:
+                faults.inject(
+                    ev["point"], probability=ev["p"],
+                    latency_s=ev["latency"], times=ev["times"],
+                    seed=ev["seed"],
+                )
+                self._armed.add(ev["point"])
+
+    def _disarm(self, point: str) -> None:
+        from ..serving import faults
+
+        self.fired[point] = self.fired.get(point, 0) \
+            + faults.fired(point)
+        faults.clear(point)
+        self._armed.discard(point)
+
+    def finish(self) -> dict[str, int]:
+        for point in list(self._armed):
+            self._disarm(point)
+        return {k: v for k, v in sorted(self.fired.items()) if v}
+
+
+@contextlib.contextmanager
+def _env(pins: dict):
+    saved = {k: os.environ.get(k) for k in pins}
+    os.environ.update({k: str(v) for k, v in pins.items()})
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _plant() -> Optional[str]:
+    return knobs.get_str("ROOM_TPU_CHAOSFUZZ_PLANT") or None
+
+
+# ---- serving workload ----
+
+_TINY = None
+
+
+def _tiny_model():
+    """Build (and cache) the CPU-proxy tiny model once per process."""
+    global _TINY
+    if _TINY is None:
+        import jax
+
+        from ..models import qwen3, tiny_moe
+
+        cfg = tiny_moe()
+        params = qwen3.init_params(cfg, jax.random.PRNGKey(0))
+        _TINY = (cfg, params)
+    return _TINY
+
+
+def _run_serving(sched: dict) -> dict:
+    from ..serving import SamplingParams, ServingEngine, faults
+    from ..serving import lifecycle as lifecycle_mod
+    from ..serving.fleet import EngineFleet
+
+    cfg, params = _tiny_model()
+    rng = random.Random(sched["seed"] ^ 0x5EED)
+    arming = _Arming(sched["events"])
+    plant = _plant()
+    planted = False
+    out = {
+        "turns_ok": 0, "turns_failed": 0, "turns_shed": 0,
+        "submit_errors": 0, "drive_errors": 0, "tokens": 0,
+        "aborted": False,
+    }
+    tmp = tempfile.mkdtemp(prefix="room_tpu_fuzz_")
+    pins = {
+        "ROOM_TPU_PREFIX_CACHE_PAGES": "0",
+        "ROOM_TPU_OFFLOAD_DIR": os.path.join(tmp, "spool"),
+        "ROOM_TPU_LIFECYCLE_DIR": os.path.join(tmp, "lc"),
+        "ROOM_TPU_ROUTER_SHARDS": "2",
+        # zero-lease failover: adoption lands on the next supervise
+        # tick, not after a wall-clock wait — outcome determinism
+        "ROOM_TPU_ROUTER_LEASE_S": "0",
+    }
+    with _env(pins):
+        fleet = EngineFleet(
+            "tiny-moe",
+            lambda i: ServingEngine(
+                cfg, params, max_batch=4, page_size=8, n_pages=96,
+                offload=True, stop_token_ids=[],
+            ),
+            3, auto_rebuild=False,
+        )
+        sids = [f"fz{i}" for i in range(6)]
+        turns = []
+        try:
+            for tick in range(sched["ticks"]):
+                arming.apply(tick)
+                for _ in range(2):
+                    sid = rng.choice(sids)
+                    prompt = _PROMPT if rng.random() < 0.5 else _CONT
+                    cls = rng.choice(
+                        ["queen", "worker", "worker", "background"]
+                    )
+                    try:
+                        turns.append(fleet.submit(
+                            prompt, session_id=sid,
+                            sampling=SamplingParams(
+                                temperature=0.0, max_new_tokens=6,
+                            ),
+                            turn_class=cls,
+                        ))
+                    except Exception:
+                        out["submit_errors"] += 1
+                try:
+                    fleet.run_until_idle(max_steps=20_000)
+                except invariants.InvariantViolation:
+                    out["aborted"] = True
+                    break
+                except Exception:
+                    # same contract as the swarm sweep: an injected
+                    # fault surfacing from the drive loop is storm
+                    # damage the next tick retries, not a verdict
+                    out["drive_errors"] += 1
+                if plant == "kv_leak" and not planted and \
+                        "offload_io" in faults.snapshot():
+                    victim = next(
+                        (h.engine for h in fleet.replicas
+                         if h.state == "serving"), None,
+                    )
+                    if victim is not None and \
+                            victim.page_table._free:
+                        victim.page_table._free.pop()
+                        planted = True
+            # settle: disarm everything, then one clean pass so
+            # in-flight work lands before the audit
+            out["fired"] = arming.finish()
+            if not out["aborted"]:
+                try:
+                    fleet.run_until_idle(max_steps=20_000)
+                except invariants.InvariantViolation:
+                    out["aborted"] = True
+            for t in turns:
+                if t.shed:
+                    out["turns_shed"] += 1
+                elif t.error:
+                    out["turns_failed"] += 1
+                elif t.done.is_set():
+                    out["turns_ok"] += 1
+                    out["tokens"] += len(t.new_tokens)
+            # drained shutdown: exercises shutdown_io + the
+            # drain-marker honesty seam exactly like server stop
+            try:
+                summary = fleet.drain(
+                    os.path.join(tmp, "lc", "drain"),
+                )
+                out["drained_ok"] = bool(summary["manifest_written"])
+                if out["drained_ok"]:
+                    lifecycle_mod.write_clean_marker(
+                        root=os.path.join(tmp, "lc"),
+                        summaries=summary["replicas"],
+                    )
+            except invariants.InvariantViolation:
+                out["aborted"] = True
+                out["drained_ok"] = False
+        finally:
+            from ..serving import faults as faults_mod
+
+            faults_mod.clear()
+    return out
+
+
+# ---- swarm workload ----
+
+def _run_swarm(sched: dict) -> dict:
+    from ..serving import faults
+    from ..swarm.shard import SwarmRouter
+
+    rng = random.Random(sched["seed"] ^ 0x5EED)
+    arming = _Arming(sched["events"])
+    plant = _plant()
+    planted = False
+    out = {
+        "sends_acked": 0, "escalations_acked": 0, "unresolved": 0,
+        "supervise_errors": 0,
+        "messages_lost": 0, "messages_double": 0, "aborted": False,
+    }
+    tmp = tempfile.mkdtemp(prefix="room_tpu_fuzz_")
+    router = SwarmRouter(n_shards=3, db_dir=tmp, lease_s=0.0)
+    acked: list[tuple[int, str]] = []   # (to_room, subject) delivered
+    pending: list[tuple[int, int, str]] = []
+    try:
+        rooms = [
+            router.create_room(f"fuzz-{i}")["id"] for i in range(6)
+        ]
+        for tick in range(sched["ticks"]):
+            arming.apply(tick)
+            batch = pending
+            pending = []
+            for _ in range(3):
+                src, dst = rng.sample(rooms, 2)
+                batch.append((src, dst, f"m{tick}-{rng.randrange(1 << 20)}"))
+            for src, dst, subject in batch:
+                try:
+                    router.send_message(src, dst, subject, "storm")
+                    acked.append((dst, subject))
+                    out["sends_acked"] += 1
+                except Exception:
+                    # retry next tick with IDENTICAL args: a half that
+                    # already landed dedups on its journal key — the
+                    # exactly-once contract under test
+                    pending.append((src, dst, subject))
+            try:
+                router.escalate(rng.choice(rooms), f"q{tick}")
+                out["escalations_acked"] += 1
+            except Exception:
+                pass
+            if plant == "double_effect" and not planted and \
+                    "db_io" in faults.snapshot():
+                planted = _plant_double_effect(router)
+            try:
+                router.supervise()
+            except invariants.InvariantViolation:
+                out["aborted"] = True
+                break
+            except Exception:
+                # an injected fault caught the supervise sweep itself
+                # mid-I/O; the sweep is idempotent and re-runs next
+                # tick — survivable storm damage, counted not fatal
+                out["supervise_errors"] += 1
+        out["fired"] = arming.finish()
+        # audit with faults off: every acked delivery exists exactly
+        # once (lost = exactly-once broken one way, double = the other)
+        try:
+            router.supervise()
+        except invariants.InvariantViolation:
+            out["aborted"] = True
+        out["unresolved"] = len(pending)
+        for dst, subject in acked:
+            try:
+                rows = router.db_for(dst).query(
+                    "SELECT COUNT(*) AS n FROM room_messages WHERE "
+                    "room_id=? AND direction='inbound' AND subject=?",
+                    (dst, subject),
+                )
+                n = rows[0]["n"] if rows else 0
+            except Exception:
+                continue
+            if n == 0:
+                out["messages_lost"] += 1
+            elif n > 1:
+                out["messages_double"] += 1
+    finally:
+        faults.clear()
+        router.close()
+    return out
+
+
+def _plant_double_effect(router) -> bool:
+    """Test-seam bug: clone one committed xshard journal row under
+    the SAME idempotency key — the double-commit the journal protocol
+    exists to prevent. Detected by the xshard_idempotency probe."""
+    from ..db.database import utc_now
+
+    for db in router.all_dbs():
+        try:
+            row = db.query_one(
+                "SELECT room_id, worker_id, idem_key, payload FROM "
+                "cycle_journal WHERE kind='xshard' AND entry='effect' "
+                "AND status='committed' ORDER BY id LIMIT 1"
+            )
+            if row is None:
+                continue
+            db.execute(
+                "INSERT INTO cycle_journal(kind, ref_id, room_id, "
+                "worker_id, entry, status, idem_key, payload, "
+                "updated_at) VALUES ('xshard',0,?,?,'effect',"
+                "'committed',?,?,?)",
+                (row["room_id"], row["worker_id"], row["idem_key"],
+                 row["payload"], utc_now()),
+            )
+            return True
+        except Exception:
+            continue
+    return False
+
+
+# ---- run + shrink ----
+
+def run_schedule(sched: dict) -> dict:
+    """Drive one schedule deterministically; returns the outcome dict
+    (fault firings, workload counters, and the invariant witness's
+    verdict). Clears fault + witness state before and after."""
+    global _active_schedule
+    from ..serving import faults
+
+    faults.clear()
+    invariants.reset()
+    _active_schedule = {
+        "id": schedule_id(sched),
+        "seed": sched["seed"],
+        "workload": sched["workload"],
+    }
+    try:
+        if sched["workload"] == "swarm":
+            out = _run_swarm(sched)
+        else:
+            out = _run_serving(sched)
+    finally:
+        faults.clear()
+        _active_schedule = None
+    snap = invariants.snapshot()
+    out["schedule_id"] = schedule_id(sched)
+    out["violations"] = snap["violations"]
+    out["by_invariant"] = snap["by_invariant"]
+    return out
+
+
+def outcome_failed(out: dict) -> bool:
+    """The fuzzer's verdict: any invariant violation, any lost or
+    double-delivered acked message, or an aborted (strict-raise) run."""
+    return bool(
+        out.get("violations", 0)
+        or out.get("messages_lost", 0)
+        or out.get("messages_double", 0)
+        or out.get("aborted")
+    )
+
+
+def shrink_schedule(
+    sched: dict,
+    fails: Optional[Callable[[dict], bool]] = None,
+    max_runs: int = 64,
+) -> dict:
+    """Greedy delta-debugging over the event list: drop windows
+    (halves, then singles) while the schedule still fails, down to a
+    locally 1-minimal reproducer. ``fails`` defaults to re-running the
+    schedule and checking :func:`outcome_failed`. Bounded by
+    ``max_runs`` evaluations."""
+    if fails is None:
+        fails = lambda s: outcome_failed(run_schedule(s))  # noqa: E731
+    events = list(sched["events"])
+    runs = 0
+
+    def mk(evs: list) -> dict:
+        return {**sched, "events": evs}
+
+    chunk = max(1, len(events) // 2)
+    while chunk >= 1 and runs < max_runs:
+        i = 0
+        removed_any = False
+        while i < len(events) and len(events) > 1 and runs < max_runs:
+            cand = events[:i] + events[i + chunk:]
+            if not cand:
+                break
+            runs += 1
+            if fails(mk(cand)):
+                events = cand
+                removed_any = True
+            else:
+                i += chunk
+        if chunk == 1 and not removed_any:
+            break
+        chunk = chunk // 2 if chunk > 1 else (1 if removed_any else 0)
+    return mk(events)

@@ -1,0 +1,406 @@
+"""Runtime system-invariant witness — lockdep's whole-system sibling
+(docs/chaosfuzz.md).
+
+Armed via ``ROOM_TPU_INVARIANTS`` (off by default, like
+``ROOM_TPU_LOCKDEP``), existing seams probe a registry of cheap,
+host-side conservation laws that 19 PRs of chaos suites previously
+asserted only inside individual tests:
+
+    kv_page_conservation   free + session-owned pages == pool total,
+                           no page owned twice (PageTable.audit)
+    slot_leak              every active slot's turn references a live
+                           session (a released session must not keep
+                           its slot)
+    fence_monotonic        a session record's ownership fence never
+                           moves backwards across probes
+    single_ownership       a sid is resident on <= 1 serving replica
+                           outside a tracked disagg ship
+    mirror_offset_contiguity  a router-mirror journal buffer never
+                           claims token offsets beyond the record's
+                           mirror (offset bookkeeping corruption)
+    thread_leak            a dead/buried replica's serve thread is
+                           not still running
+    xshard_idempotency     no idempotency key has two committed
+                           xshard effect rows in one shard db
+    drain_marker           the clean-shutdown marker is only written
+                           when every engine's drain manifested
+
+Probe seams: ``engine.step()`` boundary (cadence via
+``ROOM_TPU_INVARIANTS_EVERY``), the fleet ``supervise`` tick, the
+swarm shard ``supervise`` sweep, and ``lifecycle.write_clean_marker``.
+
+Violations are ALWAYS recorded first — counter + bounded evidence ring
++ telemetry (``invariant.<name>``) + flight-recorder event — and THEN
+strict mode (``ROOM_TPU_INVARIANTS_STRICT``, default on: CI posture)
+raises :class:`InvariantViolation`; production arms with strict off
+and reads ``invariant_violations`` off stats/health/metrics instead.
+The fuzzer reads :func:`snapshot` either way, so a strict raise
+swallowed by a crash supervisor still counts.
+
+Every ``check_*`` function is a pure reader over duck-typed state
+(tests feed seeded good/bad fakes); the ``probe_*`` wrappers add
+cadence, recording, and the strict raise. State is process-global;
+:func:`reset` exists for test isolation, exactly like lockdep.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Optional
+
+from ..utils import knobs
+
+__all__ = [
+    "INVARIANTS", "InvariantViolation", "enabled", "strict",
+    "record", "snapshot", "reset",
+    "check_kv_pages", "check_slots", "check_fences",
+    "check_ownership", "check_mirror_buffers", "check_threads",
+    "check_xshard", "check_drain",
+    "probe_engine", "probe_fleet", "probe_swarm",
+    "probe_drain_marker",
+]
+
+INVARIANTS = (
+    "kv_page_conservation", "slot_leak", "fence_monotonic",
+    "single_ownership", "mirror_offset_contiguity", "thread_leak",
+    "xshard_idempotency", "drain_marker",
+)
+
+_MAX_EVIDENCE = 256   # bounded evidence ring; counters keep totals
+
+
+class InvariantViolation(RuntimeError):
+    """A system invariant the witness caught broken. Carries the
+    recorded problem dicts (bounded) as ``problems``."""
+
+    def __init__(self, message: str, problems: list) -> None:
+        super().__init__(message)
+        self.problems = problems
+
+
+def enabled() -> bool:
+    return knobs.get_bool("ROOM_TPU_INVARIANTS")
+
+
+def strict() -> bool:
+    return knobs.get_bool("ROOM_TPU_INVARIANTS_STRICT")
+
+
+def _probe_every() -> int:
+    try:
+        return max(1, knobs.get_int("ROOM_TPU_INVARIANTS_EVERY"))
+    except ValueError:
+        return 1
+
+
+# ---- global witness state (meta-locked, like lockdep) ----
+
+_meta = threading.Lock()
+_violation_count = 0
+_counts: dict[str, int] = {}
+_evidence: list[dict] = []
+_probes = 0
+# fence-monotonicity memory: (id(fleet), sid) -> highest fence seen
+_fences: dict[tuple, int] = {}
+
+
+def _telemetry_count(name: str) -> None:
+    mod = sys.modules.get("room_tpu.core.telemetry")
+    if mod is None:
+        return
+    try:
+        mod.incr_counter(f"invariant.{name}")
+    except Exception:
+        pass
+
+
+def _trace_event(problem: dict) -> None:
+    mod = sys.modules.get("room_tpu.serving.trace")
+    if mod is None:
+        return
+    try:
+        mod.note_event("invariant.violation", problem)
+    except Exception:
+        pass
+
+
+def _bound(detail: dict) -> dict:
+    out = {}
+    for k, v in detail.items():
+        if isinstance(v, (int, float, bool, str, type(None))):
+            out[k] = v if not isinstance(v, str) else v[:200]
+        else:
+            out[k] = repr(v)[:200]
+    return out
+
+
+def record(name: str, detail: dict) -> dict:
+    """Record one violation: counter, bounded evidence, telemetry,
+    flight recorder. Returns the recorded problem dict. Recording
+    never raises — the strict raise is the PROBE's job, after every
+    problem from the pass is safely on the books."""
+    global _violation_count
+    problem = {
+        "invariant": name,
+        "thread": threading.current_thread().name,
+        **_bound(detail),
+    }
+    with _meta:
+        _violation_count += 1
+        _counts[name] = _counts.get(name, 0) + 1
+        if len(_evidence) < _MAX_EVIDENCE:
+            _evidence.append(problem)
+    _telemetry_count(name)
+    _trace_event(problem)
+    return problem
+
+
+def _finish(problems: list[dict]) -> list[dict]:
+    """Record a probe pass's problems, then raise in strict mode."""
+    recorded = [record(p.pop("invariant"), p) for p in problems]
+    if recorded and strict():
+        raise InvariantViolation(
+            f"{len(recorded)} system-invariant violation(s): "
+            + "; ".join(
+                f"{p['invariant']}" for p in recorded[:4]
+            ),
+            recorded,
+        )
+    return recorded
+
+
+# ---- checks: pure readers over duck-typed state ----
+
+def check_kv_pages(page_table) -> list[dict]:
+    """KV-page conservation: free + owned == pool total, no dupes,
+    nothing out of range (``PageTable.audit``)."""
+    audit = page_table.audit()
+    if audit["balanced"]:
+        return []
+    return [{
+        "invariant": "kv_page_conservation",
+        **{k: audit[k] for k in (
+            "n_pages", "free", "owned", "dupes", "out_of_range",
+        )},
+    }]
+
+
+def check_slots(engine) -> list[dict]:
+    """Slot leak: every active slot's turn must reference a session
+    the engine still tracks (live or mid-stage)."""
+    out = []
+    staged = getattr(engine, "_staged_sids", set())
+    for i, turn in enumerate(engine._active):
+        if turn is None:
+            continue
+        sid = turn.session_id
+        if sid not in engine.sessions and sid not in staged:
+            out.append({
+                "invariant": "slot_leak", "slot": i, "sid": sid,
+            })
+    return out
+
+
+def check_fences(fleet) -> list[dict]:
+    """Fence monotonicity: a record's ownership fence never moves
+    backwards between probes (a rewind would re-admit a stale owner —
+    the session-fork precursor the fence exists to refuse)."""
+    out = []
+    key0 = id(fleet)
+    with _meta:
+        for rec in list(fleet._records.values()):
+            key = (key0, rec.sid)
+            seen = _fences.get(key)
+            if seen is not None and rec.fence < seen:
+                out.append({
+                    "invariant": "fence_monotonic", "sid": rec.sid,
+                    "fence": rec.fence, "seen": seen,
+                })
+            else:
+                _fences[key] = rec.fence
+    return out
+
+
+def check_ownership(fleet) -> list[dict]:
+    """Single ownership: a sid's KV is resident on at most one
+    serving replica, unless a tracked disagg ship is mid-flight or
+    the record itself is mid-ship."""
+    out = []
+    inflight = getattr(fleet.disagg, "_inflight", {})
+    holders: dict[str, list] = {}
+    for h in fleet.replicas:
+        if h.state != "serving":
+            continue
+        for sid in list(h.engine.sessions):
+            if sid == "__null__" or sid.startswith("__prefix"):
+                continue
+            holders.setdefault(sid, []).append(h.rid)
+    for sid, rids in holders.items():
+        if len(rids) <= 1 or sid in inflight:
+            continue
+        rec = fleet._records.get(sid)
+        if rec is not None and rec.ship_state is not None:
+            continue
+        out.append({
+            "invariant": "single_ownership", "sid": sid,
+            "replicas": ",".join(rids),
+        })
+    return out
+
+
+def check_mirror_buffers(fleet) -> list[dict]:
+    """Mirror/journal offset contiguity: a shard journal's pending
+    token buffer may never claim offsets past the record's live
+    mirror (``start + len > len(tokens)`` means the offset
+    bookkeeping forked). Racing appends only GROW the mirror, so the
+    comparison is safe from any thread."""
+    out = []
+    for shard in fleet._shards:
+        journal = shard.journal
+        if journal is None:
+            continue
+        for sid, (start, length) in \
+                journal.pending_snapshot().items():
+            rec = shard.records.get(sid)
+            if rec is None or rec.mirror_dropped:
+                continue
+            have = len(rec.tokens)
+            if start + length > have or start < 0:
+                out.append({
+                    "invariant": "mirror_offset_contiguity",
+                    "sid": sid, "start": start, "pending": length,
+                    "mirror": have, "shard": shard.shard_id,
+                })
+    return out
+
+
+def check_threads(fleet) -> list[dict]:
+    """Thread leak: a dead/buried replica whose serve thread is still
+    alive after its re-home completed is leaked supervision."""
+    out = []
+    for h in fleet.replicas:
+        if h.state == "dead" and h.rehomed_done and \
+                h.thread is not None and h.thread.is_alive():
+            out.append({
+                "invariant": "thread_leak", "rid": h.rid,
+                "thread": h.thread.name,
+            })
+    return out
+
+
+def check_xshard(router) -> list[dict]:
+    """Exactly-once xshard effects: no idempotency key may own two
+    committed effect rows in one shard database. Probe I/O is
+    best-effort — an armed ``db_io`` fault or a mid-kill handle must
+    not crash the witness (the probe re-runs next sweep)."""
+    out = []
+    for db in router.all_dbs():
+        try:
+            rows = db.query(
+                "SELECT idem_key, COUNT(*) AS n FROM cycle_journal "
+                "WHERE kind='xshard' AND entry='effect' AND "
+                "status='committed' GROUP BY idem_key "
+                "HAVING COUNT(*) > 1"
+            )
+        except Exception:
+            continue
+        for row in rows:
+            out.append({
+                "invariant": "xshard_idempotency",
+                "idem_key": row["idem_key"], "committed": row["n"],
+            })
+    return out
+
+
+def check_drain(summaries) -> list[dict]:
+    """Drain-marker honesty: a clean-shutdown marker write attests
+    EVERY engine's drain landed its manifest; summaries saying
+    otherwise mean the marker would paper over lost sessions."""
+    out = []
+    for name, s in (summaries or {}).items():
+        if not (s or {}).get("manifest_written", False):
+            out.append({
+                "invariant": "drain_marker", "engine": name,
+                "error": (s or {}).get("error"),
+            })
+    return out
+
+
+# ---- probe seams ----
+
+def probe_engine(engine) -> list[dict]:
+    """Engine-step-boundary probe (the engine thread is the page
+    table / session map's only mutator there). Cadence via
+    ``ROOM_TPU_INVARIANTS_EVERY``."""
+    if not enabled():
+        return []
+    tick = getattr(engine, "_sysinv_tick", 0) + 1
+    engine._sysinv_tick = tick
+    if tick % _probe_every():
+        return []
+    _count_probe()
+    return _finish(check_kv_pages(engine.page_table)
+                   + check_slots(engine))
+
+
+def probe_fleet(fleet) -> list[dict]:
+    """Fleet supervise-tick probe: fences, ownership, mirror
+    buffers, thread leaks."""
+    if not enabled():
+        return []
+    _count_probe()
+    return _finish(
+        check_fences(fleet) + check_ownership(fleet)
+        + check_mirror_buffers(fleet) + check_threads(fleet)
+    )
+
+
+def probe_swarm(router) -> list[dict]:
+    """Swarm shard-sweep probe: exactly-once xshard effects."""
+    if not enabled():
+        return []
+    _count_probe()
+    return _finish(check_xshard(router))
+
+
+def probe_drain_marker(summaries) -> list[dict]:
+    """Clean-shutdown-marker probe (lifecycle.write_clean_marker)."""
+    if not enabled():
+        return []
+    _count_probe()
+    return _finish(check_drain(summaries))
+
+
+def _count_probe() -> None:
+    global _probes
+    with _meta:
+        _probes += 1
+
+
+# ---- inspection / test surface ----
+
+def snapshot() -> dict:
+    """Health/metrics surface: total + per-invariant counts and the
+    bounded evidence ring — the shape ``stats()["invariants"]``,
+    /api/tpu/health, and the fuzzer's outcome all read."""
+    with _meta:
+        return {
+            "enabled": enabled(),
+            "strict": strict(),
+            "probes": _probes,
+            "violations": _violation_count,
+            "by_invariant": dict(_counts),
+            "evidence": list(_evidence),
+        }
+
+
+def reset() -> None:
+    """Drop all witnessed state (test / fuzz-run isolation)."""
+    global _violation_count, _probes
+    with _meta:
+        _violation_count = 0
+        _probes = 0
+        _counts.clear()
+        _evidence.clear()
+        _fences.clear()

@@ -162,6 +162,21 @@ def _flight_recorder_evidence(limit: int = 8) -> list:
         return []
 
 
+def _active_chaos_schedule() -> Optional[dict]:
+    """{id, seed, workload} of the fuzz schedule running when we
+    crashed, via sys.modules (same pattern as the flight recorder) —
+    None outside a fuzz run or when chaos was never imported."""
+    import sys
+
+    mod = sys.modules.get("room_tpu.chaos.fuzz")
+    if mod is None:
+        return None
+    try:
+        return mod.active_schedule_info()
+    except Exception:
+        return None
+
+
 def submit_crash_report(
     db: Database, error: BaseException, context: str = ""
 ) -> bool:
@@ -186,6 +201,10 @@ def submit_crash_report(
         # flight-recorder evidence (docs/observability.md): the turn
         # traces that were violating SLOs or faulting when we died
         "turn_traces": _flight_recorder_evidence(),
+        # chaosfuzz reproducer (docs/chaosfuzz.md): when the crash
+        # happened under a fuzz schedule, its id + seed make the
+        # report replayable (--replay)
+        "chaos_schedule": _active_chaos_schedule(),
     })
 
 

@@ -81,7 +81,7 @@ class ServerApp:
             # exited, which the (unconditional) boot check reads either
             # way — without it every graceful stop of a
             # lifecycle-disabled deployment reads as a crash
-            lifecycle_helpers.write_clean_marker()
+            lifecycle_helpers.write_clean_marker(summaries=summaries)
         if graceful:
             # API is down, drains are landed: builds may resume so a
             # same-process start_server() can warm-restart

@@ -526,6 +526,26 @@ def render_metrics() -> str:
     families.append(fault_fam)
     families.append(fired_fam)
 
+    # ---- invariant witness (docs/chaosfuzz.md) ----
+    try:
+        from ..chaos import invariants as invariants_mod
+
+        inv_armed = invariants_mod.enabled()
+        inv_snap = invariants_mod.snapshot() if inv_armed else None
+    except Exception:
+        inv_armed, inv_snap = False, None
+    if inv_armed and inv_snap is not None:
+        inv_fam = _Family(
+            "room_tpu_invariant_violations_total", "counter",
+            "Runtime invariant-witness violations by invariant "
+            "(ROOM_TPU_INVARIANTS; zero samples present while armed "
+            "so alerts can rate() on them).",
+        )
+        by = inv_snap.get("by_invariant", {})
+        for name in invariants_mod.INVARIANTS:
+            inv_fam.add({"invariant": name}, by.get(name, 0))
+        families.append(inv_fam)
+
     return "\n".join(f.render() for f in families) + "\n"
 
 

@@ -1165,6 +1165,7 @@ def register_aux_routes(r: Router) -> None:
         from ..core.telemetry import counters_snapshot
         from ..providers.registry import fallback_models
         from ..providers.tpu import engines_snapshot
+        from ..chaos import invariants as invariants_mod
         from ..serving import faults as faults_mod
 
         engines = engines_snapshot()
@@ -1240,6 +1241,12 @@ def register_aux_routes(r: Router) -> None:
             # by the TPU panel's prefix-store row
             if e.get("prefix_store") is not None:
                 summary[name]["prefix_store"] = e["prefix_store"]
+            # invariant witness block (docs/chaosfuzz.md): probe count
+            # + per-invariant violation tallies, present only while
+            # ROOM_TPU_INVARIANTS is armed — rendered whole by the TPU
+            # panel's invariants row
+            if e.get("invariants") is not None:
+                summary[name]["invariants"] = e["invariants"]
         from ..core.telemetry import histograms_snapshot
         from ..serving import trace as trace_mod
 
@@ -1321,6 +1328,11 @@ def register_aux_routes(r: Router) -> None:
             "engines": summary,
             "swarm": swarm,
             "faults": faults_mod.snapshot(),
+            # process-wide invariant witness (docs/chaosfuzz.md):
+            # null while ROOM_TPU_INVARIANTS is off, else the armed
+            # snapshot — external monitors alert on .violations > 0
+            "invariants": invariants_mod.snapshot()
+            if invariants_mod.enabled() else None,
             "counters": counters_snapshot(),
             # cumulative latency histograms (telemetry.observe_ms,
             # le semantics) — the same data /metrics exposes

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos import invariants as invariants_mod
 from ..models import qwen3
 from ..models.config import DecoderConfig
 from ..ops import spec as spec_ops
@@ -2090,6 +2091,11 @@ class ServingEngine:
             lc = dict(self._lifecycle_stats)
         lc["phase"] = self.lifecycle_phase
         out["lifecycle"] = lc
+        # system-invariant witness block (docs/chaosfuzz.md): the
+        # process-global snapshot rides every engine's stats so the
+        # health passthrough + TPU panel see it wherever they look
+        out["invariants"] = invariants_mod.snapshot() \
+            if invariants_mod.enabled() else None
         return out
 
     # ---- engine loop ----
@@ -2116,7 +2122,14 @@ class ServingEngine:
         self._offload_sweep()
         self._prefetch_offloaded()
         self._admit()
-        return self._decode_once()
+        n = self._decode_once()
+        # system-invariant witness (docs/chaosfuzz.md): the step
+        # boundary is the engine thread's quiescent point — page
+        # conservation and slot/session consistency hold exactly
+        # here. Disarmed cost: one knob read.
+        if invariants_mod.enabled():
+            invariants_mod.probe_engine(self)
+        return n
 
     def run_until_idle(self, max_steps: int = 100_000) -> None:
         for _ in range(max_steps):

@@ -82,6 +82,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..chaos import invariants as invariants_mod
 from . import disagg as disagg_mod
 from . import faults
 from . import lifecycle as lifecycle_mod
@@ -1366,6 +1367,11 @@ class EngineFleet:
             for h in list(self.replicas):
                 if h.state == "dead" and h.strikes <= self.max_strikes:
                     self.rebuild_replica(h.rid)
+        # system-invariant witness (docs/chaosfuzz.md): the supervise
+        # tick is the fleet's quiescent seam — fences, ownership,
+        # mirror-buffer contiguity, and thread leaks are probed here
+        if invariants_mod.enabled():
+            invariants_mod.probe_fleet(self)
 
     def _pick_crash_victim(self) -> Optional[ReplicaHandle]:
         cands = self._serving_replicas()

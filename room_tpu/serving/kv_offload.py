@@ -268,6 +268,13 @@ class TieredKVStore:
 
     # ---- tier accounting (callers hold self._lock) ----
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        # callers hold self._lock (non-reentrant): the single counter
+        # mutation point the stats() reader snapshot relies on, not a
+        # lock-taking helper like the engine's (same shape as the
+        # mirror journal's _bump)
+        self._stats[key] += n
+
     def _host_bytes_locked(self) -> int:
         return sum(
             e.nbytes for e in self._entries.values()
@@ -309,7 +316,7 @@ class TieredKVStore:
                     break
                 victim = min(victims, key=lambda e: e.last_used)
                 if self.disk_bytes_cap <= 0:
-                    self._stats["disk_drops"] += 1
+                    self._bump("disk_drops")
                     self._drop_entry_locked(victim)
                     continue
                 arrays = victim.arrays
@@ -318,13 +325,13 @@ class TieredKVStore:
                 _write_spool(path, arrays)
             except OSError:
                 with self._lock:
-                    self._stats["spool_errors"] += 1
+                    self._bump("spool_errors")
                     self._drop_entry_locked(victim)
                 continue
             with self._lock:
                 victim.path = path
                 victim.arrays = None
-                self._stats["demotions"] += 1
+                self._bump("demotions")
         with self._lock:
             while self._disk_bytes_locked() > self.disk_bytes_cap:
                 victims = [
@@ -333,7 +340,7 @@ class TieredKVStore:
                 if not victims:
                     break
                 victim = min(victims, key=lambda e: e.last_used)
-                self._stats["disk_drops"] += 1
+                self._bump("disk_drops")
                 self._drop_entry_locked(victim)
 
     # ---- public API (engine thread mutates; HTTP threads read) ----
@@ -352,7 +359,7 @@ class TieredKVStore:
             if old is not None:
                 self._drop_entry_locked(old)
             self._entries[session_id] = entry
-            self._stats["bytes_out"] += nbytes
+            self._bump("bytes_out", nbytes)
         self._rebalance()
         return entry
 
@@ -427,7 +434,7 @@ class TieredKVStore:
                 sha = _write_spool(path, arrays, want_digest=True)
             except OSError:
                 with self._lock:
-                    self._stats["spool_errors"] += 1
+                    self._bump("spool_errors")
                 return None
         try:
             nbytes = os.path.getsize(path)
@@ -476,11 +483,11 @@ class TieredKVStore:
         with self._lock:
             entry = self._entries.get(session_id)
             if entry is None:
-                self._stats["misses"] += 1
+                self._bump("misses")
                 return None
             entry.last_used = time.monotonic()
             if entry.arrays is not None:
-                self._stats["host_hits"] += 1
+                self._bump("host_hits")
                 return entry, entry.arrays
             path = entry.path
         try:
@@ -490,12 +497,12 @@ class TieredKVStore:
             # an adopted spool failing its (lazy) checksum
             # all degrade the same way: a miss the engine re-prefills
             with self._lock:
-                self._stats["spool_errors"] += 1
-                self._stats["misses"] += 1
+                self._bump("spool_errors")
+                self._bump("misses")
                 self._drop_entry_locked(entry)
             return None
         with self._lock:
-            self._stats["disk_hits"] += 1
+            self._bump("disk_hits")
         return entry, arrays
 
     def discard(self, session_id: str) -> bool:
@@ -530,7 +537,7 @@ class TieredKVStore:
                 break
         with self._lock:
             self._hist[idx] += 1
-            self._stats["bytes_in"] += nbytes
+            self._bump("bytes_in", nbytes)
 
     def restore_hist(self) -> dict[str, int]:
         with self._lock:

@@ -901,3 +901,31 @@ class PageTable:
             pages = self._sessions.pop(session_id, [])
             self._free.extend(reversed(pages))
             return len(pages)
+
+    def audit(self) -> dict:
+        """Conservation audit for the invariant witness
+        (chaos/invariants.py): every page is either free or owned by
+        exactly one session, and the two partitions cover the pool.
+        A page counted twice (double-free / double-alloc) or missing
+        (leak) breaks ``balanced``."""
+        with self._lock:
+            free = list(self._free)
+            owned = [
+                p for pages in self._sessions.values() for p in pages
+            ]
+        every = free + owned
+        dupes = len(every) - len(set(every))
+        out_of_range = sum(
+            1 for p in every if not 0 <= p < self.n_pages
+        )
+        return {
+            "n_pages": self.n_pages,
+            "free": len(free),
+            "owned": len(owned),
+            "dupes": dupes,
+            "out_of_range": out_of_range,
+            "balanced": (
+                len(every) == self.n_pages and dupes == 0
+                and out_of_range == 0
+            ),
+        }

@@ -312,10 +312,23 @@ def sweep_orphans(
 
 # ---- clean-shutdown marker ----
 
-def write_clean_marker(root: Optional[str] = None) -> bool:
+def write_clean_marker(
+    root: Optional[str] = None,
+    summaries: Optional[dict] = None,
+) -> bool:
     """Stamp the root after a fully-drained shutdown. Consumed (and
     deleted) by the next boot; its absence while prior lifecycle state
-    exists means the last process crashed."""
+    exists means the last process crashed.
+
+    ``summaries`` (name -> drain summary) arms the drain-marker
+    honesty invariant (chaos/invariants.py): a marker attests every
+    engine's drain manifested, so writing one over a summary with
+    ``manifest_written`` False is the witness's business."""
+    if summaries is not None:
+        from ..chaos import invariants as invariants_mod
+
+        if invariants_mod.enabled():
+            invariants_mod.probe_drain_marker(summaries)
     root = root or lifecycle_root()
     try:
         faults.maybe_fail("shutdown_io")

@@ -987,6 +987,17 @@ class MirrorJournal:
             "op": "tok", "sid": sid, "off": buf[0], "t": buf[1],
         })
 
+    def pending_snapshot(self) -> dict:
+        """sid -> (start_offset, pending_len) for every un-flushed
+        token buffer — the invariant witness's offset-contiguity
+        probe (chaos/invariants.py) compares these against the live
+        record mirrors."""
+        with self._lock:
+            return {
+                sid: (buf[0], len(buf[1]))
+                for sid, buf in self._buffers.items()
+            }
+
     def record_release(self, sid: str) -> None:
         with self._lock:
             self._buffers.pop(sid, None)

@@ -653,7 +653,15 @@ class SwarmRouter:
                 )
                 self.kill_shard(busiest.shard_id,
                                 reason="chaos: shard_crash")
-        return self.adopt_dead_shards(now=now)
+        out = self.adopt_dead_shards(now=now)
+        # system-invariant witness (docs/chaosfuzz.md): the shard
+        # sweep probes exactly-once xshard effects across the live
+        # shard files — resolved like faults above, so the swarm
+        # layer never hard-depends on the chaos package
+        inv = sys.modules.get("room_tpu.chaos.invariants")
+        if inv is not None and inv.enabled():
+            inv.probe_swarm(self)
+        return out
 
     # ---- events ----
 

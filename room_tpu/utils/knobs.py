@@ -732,6 +732,33 @@ register("ROOM_TPU_LOCKDEP_STRICT", "bool", "1",
          "(lockdep_inversions) and records evidence instead "
          "(production posture).")
 
+# ---- chaosfuzz: system-invariant witness + schedule fuzzer ----
+register("ROOM_TPU_INVARIANTS", "bool", "0",
+         "Arm the runtime system-invariant witness "
+         "(docs/chaosfuzz.md): KV-page conservation, fence "
+         "monotonicity, single session ownership, exactly-once "
+         "xshard effects and friends, probed at the engine-step / "
+         "fleet-supervise / swarm-sweep seams.")
+register("ROOM_TPU_INVARIANTS_STRICT", "bool", "1",
+         "With the invariant witness armed, raise "
+         "InvariantViolation at the probing seam (tests/CI); '0' "
+         "counts violations into stats/health/metrics with bounded "
+         "evidence instead (production posture).")
+register("ROOM_TPU_INVARIANTS_EVERY", "int", "1",
+         "Engine-step probe cadence for the invariant witness: "
+         "check every Nth step (fleet/swarm probes always run each "
+         "supervise tick).")
+register("ROOM_TPU_CHAOSFUZZ_TICKS", "int", "24",
+         "Default schedule length (ticks) for python -m "
+         "room_tpu.chaos generated fault schedules.", scope="bench")
+register("ROOM_TPU_CHAOSFUZZ_PLANT", "str", None,
+         "Test-only planted bug for the chaosfuzz self-test: "
+         "'kv_leak' steals a KV page after the first offload_io "
+         "firing, 'double_effect' double-commits an xshard journal "
+         "row after the first db_io firing. The fuzzer must detect "
+         "and shrink both. Never set outside tests.",
+         scope="test-seam", choices=("kv_leak", "double_effect"))
+
 # ---- turnscope: turn tracing / flight recorder / metrics ----
 register("ROOM_TPU_TRACE", "bool", "1",
          "Always-on host-side turn tracing (docs/observability.md): "

@@ -1,0 +1,502 @@
+"""chaosfuzz self-test (docs/chaosfuzz.md): the invariant witness and
+the seeded schedule fuzzer.
+
+Witness half: each ``check_*`` is a pure reader over duck-typed state,
+so every invariant gets a known-good and a known-bad fixture, plus the
+strict-raise vs production-count contract and the snapshot surface.
+
+Fuzzer half: the acceptance pins — same seed ⇒ byte-identical schedule
+JSON and identical run outcome; a saved schedule replays to the same
+outcome; the generator guarantees a kill event and a ≥2-point overlap;
+a deliberately planted bug (``ROOM_TPU_CHAOSFUZZ_PLANT``) is detected
+by the witness and auto-shrunk to ≤3 events; and the roomlint checker
+keeps FUZZ_WEIGHTS ∪ FUZZ_EXCLUDED == faults.FAULT_POINTS.
+
+Quick tier drives the SWARM workload (no model build, seconds); the
+serving-workload determinism + kv_leak-plant runs live behind the
+``slow`` marker — CI's chaosfuzz quick tier exercises the serving
+workload through the CLI instead.
+"""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from room_tpu.chaos import fuzz, invariants
+from room_tpu.serving import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_witness():
+    faults.clear()
+    invariants.reset()
+    yield
+    faults.clear()
+    invariants.reset()
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_INVARIANTS", "1")
+    monkeypatch.setenv("ROOM_TPU_INVARIANTS_STRICT", "0")
+
+
+@pytest.fixture
+def armed_strict(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_INVARIANTS", "1")
+    monkeypatch.setenv("ROOM_TPU_INVARIANTS_STRICT", "1")
+
+
+# ---- invariant checkers: good vs bad states ----
+
+def test_kv_page_conservation_good_and_bad():
+    from room_tpu.serving.kv_pages import PageTable
+
+    pt = PageTable(n_pages=8, page_size=4)
+    pt.ensure_capacity("s1", 8)
+    assert invariants.check_kv_pages(pt) == []
+    pt._free.pop()   # leak a page: free+owned < total
+    bad = invariants.check_kv_pages(pt)
+    assert bad and bad[0]["invariant"] == "kv_page_conservation"
+    # double-ownership is a distinct corruption shape
+    pt2 = PageTable(n_pages=8, page_size=4)
+    pt2.ensure_capacity("a", 4)
+    pt2._sessions["b"] = list(pt2._sessions["a"])
+    bad2 = invariants.check_kv_pages(pt2)
+    assert bad2 and bad2[0]["dupes"] >= 1
+
+
+def test_slot_leak_good_and_bad():
+    turn = SimpleNamespace(session_id="live")
+    eng = SimpleNamespace(
+        _active=[turn, None],
+        sessions={"live": object()},
+        _staged_sids=set(),
+    )
+    assert invariants.check_slots(eng) == []
+    eng.sessions = {}   # session released, slot not reclaimed
+    bad = invariants.check_slots(eng)
+    assert bad and bad[0]["invariant"] == "slot_leak"
+    # a mid-stage sid is NOT a leak
+    eng._staged_sids = {"live"}
+    assert invariants.check_slots(eng) == []
+
+
+def test_fence_monotonic_good_and_bad():
+    fleet = SimpleNamespace(
+        _records={"s": SimpleNamespace(sid="s", fence=3)},
+    )
+    assert invariants.check_fences(fleet) == []
+    fleet._records["s"].fence = 5   # forward: fine
+    assert invariants.check_fences(fleet) == []
+    fleet._records["s"].fence = 2   # rewind: the fork precursor
+    bad = invariants.check_fences(fleet)
+    assert bad and bad[0]["invariant"] == "fence_monotonic"
+    assert bad[0]["seen"] == 5 and bad[0]["fence"] == 2
+
+
+def _fake_fleet_for_ownership(sids_by_rid, inflight=(), records=None):
+    replicas = [
+        SimpleNamespace(
+            rid=rid, state="serving",
+            engine=SimpleNamespace(
+                sessions={s: object() for s in sids}
+            ),
+        )
+        for rid, sids in sids_by_rid.items()
+    ]
+    return SimpleNamespace(
+        replicas=replicas,
+        disagg=SimpleNamespace(_inflight={s: 1 for s in inflight}),
+        _records=records or {},
+    )
+
+
+def test_single_ownership_good_and_bad():
+    good = _fake_fleet_for_ownership(
+        {"r0": ["a", "__null__"], "r1": ["b", "__null__"]},
+    )
+    assert invariants.check_ownership(good) == []
+    bad_fleet = _fake_fleet_for_ownership(
+        {"r0": ["a"], "r1": ["a"]},
+    )
+    bad = invariants.check_ownership(bad_fleet)
+    assert bad and bad[0]["invariant"] == "single_ownership"
+    # a tracked in-flight ship is the sanctioned two-owner window
+    shipping = _fake_fleet_for_ownership(
+        {"r0": ["a"], "r1": ["a"]}, inflight=["a"],
+    )
+    assert invariants.check_ownership(shipping) == []
+    # ...as is a record mid-ship
+    mid = _fake_fleet_for_ownership(
+        {"r0": ["a"], "r1": ["a"]},
+        records={"a": SimpleNamespace(ship_state="pushing")},
+    )
+    assert invariants.check_ownership(mid) == []
+
+
+def _fake_fleet_for_mirror(pending, tokens, dropped=False):
+    journal = SimpleNamespace(pending_snapshot=lambda: pending)
+    shard = SimpleNamespace(
+        journal=journal, shard_id=0,
+        records={"s": SimpleNamespace(
+            tokens=tokens, mirror_dropped=dropped,
+        )},
+    )
+    return SimpleNamespace(_shards=[shard])
+
+
+def test_mirror_offset_contiguity_good_and_bad():
+    good = _fake_fleet_for_mirror({"s": (1, 2)}, tokens=[7, 7, 7])
+    assert invariants.check_mirror_buffers(good) == []
+    bad_fleet = _fake_fleet_for_mirror({"s": (2, 4)}, tokens=[7, 7, 7])
+    bad = invariants.check_mirror_buffers(bad_fleet)
+    assert bad and bad[0]["invariant"] == "mirror_offset_contiguity"
+    # a capped-out (mirror_dropped) record is exempt by design
+    capped = _fake_fleet_for_mirror(
+        {"s": (2, 4)}, tokens=[7], dropped=True,
+    )
+    assert invariants.check_mirror_buffers(capped) == []
+
+
+def test_thread_leak_good_and_bad():
+    stop = threading.Event()
+    th = threading.Thread(target=stop.wait, daemon=True)
+    th.start()
+    try:
+        h = SimpleNamespace(
+            rid="r0", state="dead", rehomed_done=True, thread=th,
+        )
+        fleet = SimpleNamespace(replicas=[h])
+        bad = invariants.check_threads(fleet)
+        assert bad and bad[0]["invariant"] == "thread_leak"
+        h.state = "serving"   # alive thread on a live replica: fine
+        assert invariants.check_threads(fleet) == []
+        h.state, h.rehomed_done = "dead", False   # re-home pending
+        assert invariants.check_threads(fleet) == []
+    finally:
+        stop.set()
+        th.join(5)
+    h.state, h.rehomed_done = "dead", True
+    assert invariants.check_threads(fleet) == []   # thread exited
+
+
+def test_xshard_idempotency_good_and_bad(tmp_path):
+    from room_tpu.swarm.shard import SwarmRouter
+
+    router = SwarmRouter(n_shards=2, db_dir=str(tmp_path), lease_s=0.0)
+    try:
+        r1 = router.create_room("a")["id"]
+        router.create_room("b")
+        assert invariants.check_xshard(router) == []
+        # two committed effect rows under the SAME idem_key — the
+        # double-commit the journal exists to prevent
+        db = router.all_dbs()[0]
+        for _ in range(2):
+            db.execute(
+                "INSERT INTO cycle_journal(kind, ref_id, room_id, "
+                "worker_id, entry, status, idem_key, payload) "
+                "VALUES ('xshard',0,?,0,'effect','committed',"
+                "'dup-key','{}')",
+                (r1,),
+            )
+        bad = invariants.check_xshard(router)
+        assert bad and bad[0]["invariant"] == "xshard_idempotency"
+        assert bad[0]["idem_key"] == "dup-key"
+        assert bad[0]["committed"] == 2
+    finally:
+        router.close()
+
+
+def test_drain_marker_good_and_bad():
+    good = {"m": {"manifest_written": True}}
+    assert invariants.check_drain(good) == []
+    bad = invariants.check_drain(
+        {"m": {"manifest_written": True},
+         "n": {"manifest_written": False, "error": "disk full"}},
+    )
+    assert bad and bad[0]["invariant"] == "drain_marker"
+    assert bad[0]["engine"] == "n"
+
+
+# ---- strict vs count, snapshot, cadence ----
+
+def test_strict_mode_raises_after_recording(armed_strict):
+    with pytest.raises(invariants.InvariantViolation) as ei:
+        invariants.probe_drain_marker(
+            {"m": {"manifest_written": False}},
+        )
+    assert ei.value.problems[0]["invariant"] == "drain_marker"
+    # the violation is on the books BEFORE the raise — a supervisor
+    # swallowing the exception still leaves the count visible
+    snap = invariants.snapshot()
+    assert snap["violations"] == 1
+    assert snap["by_invariant"] == {"drain_marker": 1}
+    assert snap["evidence"][0]["invariant"] == "drain_marker"
+
+
+def test_production_mode_counts_without_raising(armed):
+    for _ in range(3):
+        probs = invariants.probe_drain_marker(
+            {"m": {"manifest_written": False}},
+        )
+        assert probs and probs[0]["invariant"] == "drain_marker"
+    snap = invariants.snapshot()
+    assert snap["violations"] == 3
+    assert snap["probes"] == 3
+    assert not snap["strict"]
+
+
+def test_disarmed_probes_are_free(monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_INVARIANTS", "0")
+    assert invariants.probe_drain_marker(
+        {"m": {"manifest_written": False}},
+    ) == []
+    assert invariants.snapshot()["violations"] == 0
+
+
+def test_probe_cadence(armed, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_INVARIANTS_EVERY", "4")
+    from room_tpu.serving.kv_pages import PageTable
+
+    eng = SimpleNamespace(
+        page_table=PageTable(4, 4), _active=[], sessions={},
+        _staged_sids=set(),
+    )
+    for _ in range(8):
+        invariants.probe_engine(eng)
+    assert invariants.snapshot()["probes"] == 2   # every 4th step
+
+
+# ---- schedule generation ----
+
+def test_schedule_generation_deterministic_and_versioned():
+    for workload in ("serving", "swarm"):
+        a = fuzz.generate_schedule(7, workload=workload, ticks=12)
+        b = fuzz.generate_schedule(7, workload=workload, ticks=12)
+        assert fuzz.schedule_json(a) == fuzz.schedule_json(b)
+        assert a["version"] == fuzz.SCHEDULE_VERSION
+        assert fuzz.schedule_id(a) == fuzz.schedule_id(b)
+    assert fuzz.schedule_json(
+        fuzz.generate_schedule(8, "swarm", 12)
+    ) != fuzz.schedule_json(fuzz.generate_schedule(9, "swarm", 12))
+
+
+def test_schedule_guarantees_kill_and_overlap():
+    for seed in range(1, 16):
+        for workload, points in (
+            ("serving", fuzz.SERVING_POINTS),
+            ("swarm", fuzz.SWARM_POINTS),
+        ):
+            s = fuzz.generate_schedule(seed, workload, ticks=12)
+            evs = s["events"]
+            kills = [e for e in evs if e["point"] in fuzz.KILL_POINTS]
+            assert kills and kills[0]["times"] == 1
+            assert fuzz._has_overlap(evs)
+            assert {e["point"] for e in evs} <= set(points)
+            # at most one window per point: overlapping windows on
+            # one point would re-inject over a live spec
+            pts = [e["point"] for e in evs]
+            assert len(pts) == len(set(pts))
+
+
+def test_schedule_version_pinned_on_load(tmp_path):
+    s = fuzz.generate_schedule(3, "swarm", 8)
+    path = str(tmp_path / "sched.json")
+    fuzz.save_schedule(s, path)
+    assert fuzz.load_schedule(path) == s
+    stale = dict(s, version=99)
+    with open(path, "w") as f:
+        json.dump(stale, f)
+    with pytest.raises(ValueError, match="version"):
+        fuzz.load_schedule(path)
+
+
+def test_weights_cover_fault_points_exactly():
+    pts = set(faults.FAULT_POINTS)
+    weighted = set(fuzz.FUZZ_WEIGHTS)
+    excluded = set(fuzz.FUZZ_EXCLUDED)
+    assert weighted | excluded == pts
+    assert not (weighted & excluded)
+    assert set(fuzz.SERVING_POINTS) | set(fuzz.SWARM_POINTS) \
+        == weighted
+    for reason in fuzz.FUZZ_EXCLUDED.values():
+        assert reason.strip()
+
+
+def test_roomlint_fuzz_checker_clean_on_repo(tmp_path):
+    import os
+
+    from room_tpu.analysis.chaosfuzz_checker import (
+        check_fuzz_coverage,
+    )
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))
+    assert check_fuzz_coverage(repo_root) == []
+    # seeded-violation fixture: a point in neither table, a typo'd
+    # weight, and a both-tables overlap must each get their rule
+    os.makedirs(tmp_path / "room_tpu" / "serving")
+    os.makedirs(tmp_path / "room_tpu" / "chaos")
+    (tmp_path / "room_tpu" / "serving" / "faults.py").write_text(
+        'FAULT_POINTS = ("a", "b", "c")\n'
+    )
+    (tmp_path / "room_tpu" / "chaos" / "fuzz.py").write_text(
+        'FUZZ_WEIGHTS = {"a": 1, "typo": 2, "b": 1}\n'
+        'FUZZ_EXCLUDED = {"b": "also weighted"}\n'
+    )
+    rules = sorted(
+        v.rule for v in check_fuzz_coverage(str(tmp_path))
+    )
+    assert rules == [
+        "fault-point-unfuzzed",      # "c" nowhere
+        "fuzz-exclusion-overlap",    # "b" in both
+        "fuzz-weight-unknown",       # "typo"
+    ]
+
+
+# ---- swarm workload: determinism, replay, plant, shrink ----
+
+def _swarm_sched(seed=11, ticks=8):
+    return fuzz.generate_schedule(seed, workload="swarm", ticks=ticks)
+
+
+def test_swarm_run_deterministic(armed_strict):
+    s = _swarm_sched(seed=11, ticks=10)
+    out1 = fuzz.run_schedule(s)
+    out2 = fuzz.run_schedule(s)
+    assert out1 == out2
+    assert out1["violations"] == 0
+    assert out1["messages_lost"] == 0
+    assert out1["messages_double"] == 0
+    assert out1["sends_acked"] > 0
+    assert out1["fired"].get("shard_crash") == 1   # kill + adoption
+
+
+def test_swarm_replay_round_trip(armed_strict, tmp_path):
+    s = _swarm_sched(seed=23)
+    path = str(tmp_path / "schedule.json")
+    fuzz.save_schedule(s, path)
+    out_orig = fuzz.run_schedule(s)
+    out_replay = fuzz.run_schedule(fuzz.load_schedule(path))
+    assert out_orig == out_replay
+    # the artifact itself is byte-stable
+    fuzz.save_schedule(fuzz.load_schedule(path),
+                       str(tmp_path / "again.json"))
+    assert (tmp_path / "schedule.json").read_bytes() \
+        == (tmp_path / "again.json").read_bytes()
+
+
+def _seed_arming_db_io(ticks=8):
+    """First seed whose swarm schedule arms db_io (the double_effect
+    plant's trigger window) — deterministic, so no flake."""
+    for seed in range(1, 64):
+        s = fuzz.generate_schedule(seed, "swarm", ticks)
+        if any(e["point"] == "db_io" for e in s["events"]):
+            return s
+    raise AssertionError("no seed arming db_io in range")
+
+
+def test_planted_double_effect_found_and_shrunk(armed, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_CHAOSFUZZ_PLANT", "double_effect")
+    s = _seed_arming_db_io()
+    out = fuzz.run_schedule(s)
+    assert out["violations"] > 0
+    assert "xshard_idempotency" in out["by_invariant"]
+    assert len(s["events"]) > 3   # something real to shrink
+    small = fuzz.shrink_schedule(s)
+    assert len(small["events"]) <= 3
+    assert fuzz.outcome_failed(fuzz.run_schedule(small))
+    # 1-minimality is local: the surviving events are all load-bearing
+    assert any(e["point"] == "db_io" for e in small["events"])
+
+
+def test_shrink_preserves_failure_with_custom_oracle():
+    # pure-oracle shrink (no workload): fails iff a db_io event
+    # survives — ddmin must strip everything else
+    s = _seed_arming_db_io(ticks=10)
+    calls = []
+
+    def fails(sched):
+        calls.append(1)
+        return any(e["point"] == "db_io" for e in sched["events"])
+
+    small = fuzz.shrink_schedule(s, fails=fails)
+    assert [e["point"] for e in small["events"]] == ["db_io"]
+    assert calls   # the oracle actually drove it
+
+
+def test_outcome_records_schedule_id_and_active_info(armed):
+    s = _swarm_sched(seed=5)
+    seen = {}
+    orig = fuzz._run_swarm
+
+    def spy(sched):
+        seen.update(fuzz.active_schedule_info() or {})
+        return orig(sched)
+
+    fuzz._run_swarm = spy
+    try:
+        out = fuzz.run_schedule(s)
+    finally:
+        fuzz._run_swarm = orig
+    assert out["schedule_id"] == fuzz.schedule_id(s)
+    # crash-report attachment surface: live during the run, id matches
+    assert seen == {
+        "id": fuzz.schedule_id(s), "seed": 5, "workload": "swarm",
+    }
+    assert fuzz.active_schedule_info() is None   # cleared after
+
+
+def test_telemetry_attaches_chaos_schedule(armed):
+    from room_tpu.core.telemetry import _active_chaos_schedule
+
+    assert _active_chaos_schedule() is None
+    fuzz._active_schedule = {"id": "abc", "seed": 1,
+                             "workload": "swarm"}
+    try:
+        assert _active_chaos_schedule() == {
+            "id": "abc", "seed": 1, "workload": "swarm",
+        }
+    finally:
+        fuzz._active_schedule = None
+
+
+# ---- slow soak: many seeds + the serving workload ----
+
+@pytest.mark.slow
+def test_swarm_soak_many_seeds(armed_strict):
+    t0 = time.monotonic()
+    for seed in range(50, 62):
+        out = fuzz.run_schedule(_swarm_sched(seed=seed, ticks=16))
+        assert out["violations"] == 0, (seed, out)
+        assert out["messages_lost"] == 0, (seed, out)
+        assert out["messages_double"] == 0, (seed, out)
+        if time.monotonic() - t0 > 300:
+            break
+
+
+@pytest.mark.slow
+def test_serving_run_deterministic_and_clean(armed_strict):
+    s = fuzz.generate_schedule(23, workload="serving", ticks=8)
+    out1 = fuzz.run_schedule(s)
+    out2 = fuzz.run_schedule(s)
+    assert out1 == out2
+    assert out1["violations"] == 0
+    assert out1["tokens"] > 0
+
+
+@pytest.mark.slow
+def test_planted_kv_leak_found(armed, monkeypatch):
+    monkeypatch.setenv("ROOM_TPU_CHAOSFUZZ_PLANT", "kv_leak")
+    for seed in range(1, 64):
+        s = fuzz.generate_schedule(seed, "serving", ticks=8)
+        if any(e["point"] == "offload_io" for e in s["events"]):
+            break
+    out = fuzz.run_schedule(s)
+    assert out["violations"] > 0
+    assert "kv_page_conservation" in out["by_invariant"]

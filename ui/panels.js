@@ -1835,6 +1835,17 @@ async function renderTpu(el) {
             Object.entries(hl.faults).map(([n, f]) =>
               `${esc(n)} (fired ${f.fired})`).join(", ")}</div>`
         : ""}
+      ${hl.invariants
+        ? `<div class="${hl.invariants.violations ? "" : "dim"}"
+             style="margin-top:.4rem">invariant witness: ${
+            hl.invariants.violations
+              ? `<span class="pill pending">${hl.invariants.violations
+                } violation(s)</span> ${
+                Object.entries(hl.invariants.by_invariant || {})
+                  .map(([n, c]) => `${esc(n)}×${c}`).join(", ")}`
+              : `armed, clean (${hl.invariants.probes ?? 0} probes)`
+          }</div>`
+        : ""}
       ${(hl.fallback_models || []).length
         ? `<div class="dim">fallback chain: ${
             esc((hl.fallback_models || []).join(" → "))}</div>`
